@@ -1,0 +1,30 @@
+"""cruise_control_tpu — a TPU-native rebuild of Cruise Control.
+
+Cruise Control (reference: /root/reference, LinkedIn/Shopify) is a control plane
+that keeps large Apache Kafka clusters balanced and healthy: it ingests broker /
+partition metrics, aggregates them into a windowed workload model, searches for
+replica/leader movement proposals that satisfy a prioritized list of *goals*,
+executes those proposals against the cluster, and runs anomaly detection with
+self-healing on top.
+
+This package keeps the product shape (monitor -> model -> analyzer -> executor
+-> detector -> API) but is designed TPU-first:
+
+- the in-memory ``ClusterModel`` (reference: ``model/ClusterModel.java``) is a
+  *flattened*, immutable pytree of device arrays
+  (``model/flat.py:FlatClusterModel``) instead of a rack->host->broker->replica
+  object graph;
+- the sequential per-replica greedy ``GoalOptimizer``
+  (reference: ``analyzer/GoalOptimizer.java``) is a *batched candidate-plan
+  search* (``analyzer/optimizer.py``): thousands of candidate replica/leader
+  moves are proposed, masked by vectorized hard-goal legality kernels, scored
+  by vmapped soft-goal cost kernels, and applied in jit-compiled
+  ``lax.scan`` rounds;
+- scale-out over the partition axis uses ``jax.sharding`` / ``shard_map`` over
+  a device Mesh (``parallel/``), not threads.
+
+Host-side subsystems (monitor ingestion, executor phases, detectors, REST API)
+remain I/O-bound Python, mirroring the reference's behavior contract.
+"""
+
+__version__ = "0.1.0"

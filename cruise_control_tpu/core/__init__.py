@@ -1,0 +1,6 @@
+"""Core, Kafka-free library layer (reference: cruise-control-core).
+
+Contains the typed config framework, metric definitions, resource model and
+the windowed metric-sample aggregator that is the numeric substrate of the
+cluster model.
+"""

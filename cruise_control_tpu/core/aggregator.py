@@ -1,0 +1,421 @@
+"""Windowed metric sample aggregation.
+
+Rebuild of the reference's core aggregator (``monitor/sampling/aggregator/
+MetricSampleAggregator.java:84`` with ``RawMetricValues.java``,
+``MetricSampleCompleteness.java``, ``AggregationOptions.java``): raw samples
+are rolled into fixed-width time windows per entity (partition or broker),
+with extrapolation for windows that have too few samples, and completeness
+accounting that gates model generation downstream.
+
+Unlike the reference's per-entity object graph, each entity's raw window
+state is a set of numpy ring buffers (``[num_windows+1, num_metrics]``), and
+aggregation emits dense ``[num_entities, num_metrics, num_windows]`` arrays
+ready to be flattened into the device-side cluster model.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Hashable, Mapping
+
+import numpy as np
+
+from .metricdef import AggregationFunction, MetricDef
+
+
+class Extrapolation(enum.Enum):
+    """Per-window extrapolation applied when samples are insufficient.
+
+    Mirrors ``Extrapolation.java``: NONE means the window had enough samples;
+    the others record how the gap was filled (and count against
+    ``max_allowed_extrapolations_per_entity``).
+    """
+
+    NONE = 0
+    AVG_AVAILABLE = 1
+    AVG_ADJACENT = 2
+    FORCED_INSUFFICIENT = 3
+    NO_VALID_EXTRAPOLATION = 4
+
+
+class NotEnoughValidWindowsError(RuntimeError):
+    """Raised when fewer valid windows exist than the caller requires
+    (ref MetricSampleAggregator.aggregate -> NotEnoughValidWindowsException)."""
+
+
+@dataclass(frozen=True)
+class MetricSample:
+    """One raw sample: an entity, a sample time, and a metric-id->value map."""
+
+    entity: Hashable
+    sample_time_ms: int
+    values: Mapping[int, float]
+    entity_group: Hashable | None = None
+
+
+class AggregationGranularity(enum.Enum):
+    """ref AggregationOptions.Granularity: whether one invalid entity
+    invalidates just itself or its whole entity group (topic)."""
+
+    ENTITY = "entity"
+    ENTITY_GROUP = "entity_group"
+
+
+@dataclass
+class AggregationOptions:
+    min_valid_entity_ratio: float = 0.0
+    min_valid_entity_group_ratio: float = 0.0
+    min_valid_windows: int = 1
+    max_allowed_extrapolations_per_entity: int = 5
+    granularity: AggregationGranularity = AggregationGranularity.ENTITY
+    interested_entities: set[Hashable] | None = None
+
+
+@dataclass
+class MetricSampleCompleteness:
+    """ref MetricSampleCompleteness.java: which windows are valid and how
+    much of the entity space they cover."""
+
+    generation: int
+    valid_windows: list[int] = field(default_factory=list)
+    valid_entity_ratio_by_window: dict[int, float] = field(default_factory=dict)
+    valid_entity_group_ratio_by_window: dict[int, float] = field(default_factory=dict)
+    valid_entities: set[Hashable] = field(default_factory=set)
+    valid_entity_groups: set[Hashable] = field(default_factory=set)
+    num_total_entities: int = 0
+
+    @property
+    def valid_entity_ratio(self) -> float:
+        if not self.num_total_entities:
+            return 0.0
+        return len(self.valid_entities) / self.num_total_entities
+
+
+@dataclass
+class ValuesAndExtrapolations:
+    """Aggregated values for one entity: ``[num_metrics, num_windows]`` plus
+    the extrapolation applied per window (ref ValuesAndExtrapolations.java)."""
+
+    values: np.ndarray
+    extrapolations: list[Extrapolation]
+    window_times_ms: list[int]
+
+
+@dataclass
+class MetricSampleAggregationResult:
+    generation: int
+    valid_windows: list[int]
+    entity_values: dict[Hashable, ValuesAndExtrapolations]
+    completeness: MetricSampleCompleteness
+    invalid_entities: set[Hashable]
+
+
+class _RawMetricValues:
+    """Ring-buffered raw window state for one entity (ref RawMetricValues.java).
+
+    Keeps per-window per-metric sum/count/max/latest so AVG/MAX/LATEST
+    aggregation strategies can all be served.
+    """
+
+    __slots__ = ("sums", "counts", "maxes", "latest_values", "latest_times",
+                 "sample_counts")
+
+    def __init__(self, num_slots: int, num_metrics: int) -> None:
+        self.sums = np.zeros((num_slots, num_metrics), dtype=np.float64)
+        self.counts = np.zeros((num_slots, num_metrics), dtype=np.int32)
+        self.maxes = np.full((num_slots, num_metrics), -np.inf, dtype=np.float64)
+        self.latest_values = np.zeros((num_slots, num_metrics), dtype=np.float64)
+        self.latest_times = np.full((num_slots, num_metrics), -1, dtype=np.int64)
+        self.sample_counts = np.zeros(num_slots, dtype=np.int32)
+
+    def clear_slot(self, slot: int) -> None:
+        self.sums[slot] = 0.0
+        self.counts[slot] = 0
+        self.maxes[slot] = -np.inf
+        self.latest_values[slot] = 0.0
+        self.latest_times[slot] = -1
+        self.sample_counts[slot] = 0
+
+    def add(self, slot: int, time_ms: int, values: Mapping[int, float]) -> None:
+        for metric_id, value in values.items():
+            self.sums[slot, metric_id] += value
+            self.counts[slot, metric_id] += 1
+            if value > self.maxes[slot, metric_id]:
+                self.maxes[slot, metric_id] = value
+            if time_ms >= self.latest_times[slot, metric_id]:
+                self.latest_times[slot, metric_id] = time_ms
+                self.latest_values[slot, metric_id] = value
+        self.sample_counts[slot] += 1
+
+
+class MetricSampleAggregator:
+    """The windowed aggregator (ref MetricSampleAggregator.java:84).
+
+    Thread-safe for concurrent ``add_sample`` / ``aggregate``. Window layout:
+    ``num_windows`` stable windows plus one *current* (in-flight) window; the
+    current window is never included in aggregation results (ref ``:193``
+    aggregates only rolled-out windows). Every window roll-out bumps
+    ``generation`` which downstream proposal caches key on
+    (ref LongGenerationed.java).
+    """
+
+    def __init__(self, num_windows: int, window_ms: int, min_samples_per_window: int,
+                 metric_def: MetricDef,
+                 entity_group_fn: Callable[[Hashable], Hashable] | None = None) -> None:
+        if num_windows <= 0 or window_ms <= 0 or min_samples_per_window <= 0:
+            raise ValueError("num_windows, window_ms, min_samples_per_window must be > 0")
+        self._num_windows = num_windows
+        self._window_ms = window_ms
+        self._min_samples = min_samples_per_window
+        self._metric_def = metric_def
+        self._num_metrics = metric_def.size()
+        self._num_slots = num_windows + 1
+        self._entity_group_fn = entity_group_fn or (lambda entity: entity)
+        self._raw: dict[Hashable, _RawMetricValues] = {}
+        self._oldest_window_index = 0        # window index of slot window_index % slots
+        self._current_window_index = 0
+        self._initialized = False
+        self._generation = 0
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------ api
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def window_ms(self) -> int:
+        return self._window_ms
+
+    @property
+    def num_windows(self) -> int:
+        return self._num_windows
+
+    def window_index(self, time_ms: int) -> int:
+        return time_ms // self._window_ms
+
+    def add_sample(self, sample: MetricSample) -> bool:
+        """Add one sample; returns False if it falls before the retained range
+        (ref addSample MetricSampleAggregator.java:141)."""
+        with self._lock:
+            index = self.window_index(sample.sample_time_ms)
+            if not self._initialized:
+                self._initialized = True
+                self._current_window_index = index
+                self._oldest_window_index = index
+            if index > self._current_window_index:
+                self._roll_out_to(index)
+            if index < self._oldest_window_index:
+                return False
+            raw = self._raw.get(sample.entity)
+            if raw is None:
+                raw = _RawMetricValues(self._num_slots, self._num_metrics)
+                self._raw[sample.entity] = raw
+            raw.add(index % self._num_slots, sample.sample_time_ms, sample.values)
+            return True
+
+    def retain_entities(self, entities: set[Hashable]) -> None:
+        """Drop state for entities no longer in the cluster (ref retainEntities)."""
+        with self._lock:
+            removed = set(self._raw) - entities
+            for entity in removed:
+                del self._raw[entity]
+            if removed:
+                self._generation += 1
+
+    def remove_entities(self, entities: set[Hashable]) -> None:
+        with self._lock:
+            for entity in entities:
+                self._raw.pop(entity, None)
+            if entities:
+                self._generation += 1
+
+    def all_entities(self) -> set[Hashable]:
+        with self._lock:
+            return set(self._raw)
+
+    def num_available_windows(self) -> int:
+        with self._lock:
+            if not self._initialized:
+                return 0
+            return self._current_window_index - self._oldest_window_index
+
+    def available_window_times(self) -> list[int]:
+        with self._lock:
+            return [w * self._window_ms
+                    for w in range(self._oldest_window_index, self._current_window_index)]
+
+    # ------------------------------------------------------------ aggregate
+    def aggregate(self, from_ms: int, to_ms: int,
+                  options: AggregationOptions | None = None) -> MetricSampleAggregationResult:
+        """Aggregate rolled-out windows overlapping [from_ms, to_ms]
+        (ref aggregate MetricSampleAggregator.java:193)."""
+        options = options or AggregationOptions()
+        with self._lock:
+            window_indices = [w for w in range(self._oldest_window_index,
+                                               self._current_window_index)
+                              if w * self._window_ms <= to_ms
+                              and (w + 1) * self._window_ms > from_ms]
+            entities = (set(self._raw) if options.interested_entities is None
+                        else set(self._raw) & options.interested_entities)
+            num_win = len(window_indices)
+            completeness = MetricSampleCompleteness(generation=self._generation,
+                                                    num_total_entities=len(entities))
+            entity_values: dict[Hashable, ValuesAndExtrapolations] = {}
+            invalid_entities: set[Hashable] = set()
+            if num_win == 0:
+                if options.min_valid_windows > 0:
+                    raise NotEnoughValidWindowsError(
+                        f"0 valid windows, {options.min_valid_windows} required "
+                        f"(in range [{from_ms}, {to_ms}])")
+                return MetricSampleAggregationResult(self._generation, [], {},
+                                                     completeness, entities)
+
+            valid_matrix = np.zeros((len(entities), num_win), dtype=bool)
+            entity_list = sorted(entities, key=repr)
+            for i, entity in enumerate(entity_list):
+                vae, window_valid = self._aggregate_entity(entity, window_indices, options)
+                entity_values[entity] = vae
+                valid_matrix[i] = window_valid
+                if window_valid.all():
+                    completeness.valid_entities.add(entity)
+                else:
+                    invalid_entities.add(entity)
+
+            if options.granularity is AggregationGranularity.ENTITY_GROUP:
+                # One invalid entity invalidates its whole group (ref
+                # AggregationOptions.Granularity.ENTITY_GROUP): demote every
+                # entity sharing a group with an invalid one.
+                invalid_groups = {self._entity_group_fn(e) for e in invalid_entities}
+                demoted = {e for e in completeness.valid_entities
+                           if self._entity_group_fn(e) in invalid_groups}
+                completeness.valid_entities -= demoted
+                invalid_entities |= demoted
+
+            self._fill_completeness(completeness, entity_list, valid_matrix,
+                                    window_indices, options)
+            if len(completeness.valid_windows) < options.min_valid_windows:
+                raise NotEnoughValidWindowsError(
+                    f"{len(completeness.valid_windows)} valid windows, "
+                    f"{options.min_valid_windows} required "
+                    f"(in range [{from_ms}, {to_ms}])")
+            return MetricSampleAggregationResult(self._generation,
+                                                 completeness.valid_windows,
+                                                 entity_values, completeness,
+                                                 invalid_entities)
+
+    def _aggregate_entity(self, entity: Hashable, window_indices: list[int],
+                          options: AggregationOptions
+                          ) -> tuple[ValuesAndExtrapolations, np.ndarray]:
+        raw = self._raw[entity]
+        num_win = len(window_indices)
+        values = np.zeros((self._num_metrics, num_win), dtype=np.float64)
+        extrapolations = [Extrapolation.NONE] * num_win
+        window_valid = np.zeros(num_win, dtype=bool)
+        num_extrapolations = 0
+
+        base = self._compute_window_values(raw)
+        counts = raw.sample_counts
+
+        for j, w in enumerate(window_indices):
+            slot = w % self._num_slots
+            count = int(counts[slot])
+            if count >= self._min_samples:
+                values[:, j] = base[:, slot]
+                window_valid[j] = True
+                continue
+            # Extrapolate (ref RawMetricValues extrapolation ladder):
+            if num_extrapolations >= options.max_allowed_extrapolations_per_entity:
+                extrapolations[j] = Extrapolation.NO_VALID_EXTRAPOLATION
+                continue
+            num_extrapolations += 1
+            half_min = max(1, self._min_samples // 2)
+            if count >= half_min:
+                values[:, j] = base[:, slot]
+                extrapolations[j] = Extrapolation.AVG_AVAILABLE
+                window_valid[j] = True
+                continue
+            prev_w, next_w = w - 1, w + 1
+            neighbor_slots = [x % self._num_slots for x in (prev_w, next_w)
+                              if self._oldest_window_index <= x < self._current_window_index
+                              and counts[x % self._num_slots] >= self._min_samples]
+            if neighbor_slots:
+                values[:, j] = base[:, neighbor_slots].mean(axis=1)
+                extrapolations[j] = Extrapolation.AVG_ADJACENT
+                window_valid[j] = True
+            elif count > 0:
+                values[:, j] = base[:, slot]
+                extrapolations[j] = Extrapolation.FORCED_INSUFFICIENT
+                window_valid[j] = True
+            else:
+                extrapolations[j] = Extrapolation.NO_VALID_EXTRAPOLATION
+        window_times = [w * self._window_ms for w in window_indices]
+        return ValuesAndExtrapolations(values, extrapolations, window_times), window_valid
+
+    def _compute_window_values(self, raw: _RawMetricValues) -> np.ndarray:
+        """Apply each metric's aggregation strategy over raw per-slot state.
+
+        Returns ``[num_metrics, num_slots]``.
+        """
+        out = np.zeros((self._num_metrics, self._num_slots), dtype=np.float64)
+        safe_counts = np.maximum(raw.counts, 1)
+        avg = (raw.sums / safe_counts).T
+        maxes = np.where(np.isfinite(raw.maxes), raw.maxes, 0.0).T
+        latest = raw.latest_values.T
+        for info in self._metric_def.all_metrics():
+            if info.strategy is AggregationFunction.AVG:
+                out[info.id] = avg[info.id]
+            elif info.strategy is AggregationFunction.MAX:
+                out[info.id] = maxes[info.id]
+            else:
+                out[info.id] = latest[info.id]
+        return out
+
+    def _fill_completeness(self, completeness: MetricSampleCompleteness,
+                           entity_list: list[Hashable], valid_matrix: np.ndarray,
+                           window_indices: list[int], options: AggregationOptions) -> None:
+        num_entities = max(1, len(entity_list))
+        groups = [self._entity_group_fn(entity) for entity in entity_list]
+        unique_groups = set(groups)
+        for j, w in enumerate(window_indices):
+            ratio = float(valid_matrix[:, j].sum()) / num_entities
+            completeness.valid_entity_ratio_by_window[w] = ratio
+            invalid_groups = {groups[i] for i in range(len(entity_list))
+                              if not valid_matrix[i, j]}
+            group_ratio = (1.0 - len(invalid_groups) / len(unique_groups)
+                           if unique_groups else 0.0)
+            completeness.valid_entity_group_ratio_by_window[w] = group_ratio
+            meets = ratio >= options.min_valid_entity_ratio
+            if options.granularity is AggregationGranularity.ENTITY_GROUP:
+                meets = meets and group_ratio >= options.min_valid_entity_group_ratio
+            if meets:
+                completeness.valid_windows.append(w)
+        for i, entity in enumerate(entity_list):
+            if valid_matrix[i].all():
+                completeness.valid_entity_groups.add(groups[i])
+        completeness.valid_entity_groups -= {self._entity_group_fn(entity)
+                                             for i, entity in enumerate(entity_list)
+                                             if not valid_matrix[i].all()}
+
+    # ------------------------------------------------------------- internal
+    def _roll_out_to(self, new_current: int) -> None:
+        steps = new_current - self._current_window_index
+        if steps >= self._num_slots:
+            for raw in self._raw.values():
+                for slot in range(self._num_slots):
+                    raw.clear_slot(slot)
+            self._current_window_index = new_current
+            self._oldest_window_index = new_current - self._num_windows
+            self._generation += 1
+            return
+        for w in range(self._current_window_index + 1, new_current + 1):
+            slot = w % self._num_slots
+            for raw in self._raw.values():
+                raw.clear_slot(slot)
+        self._current_window_index = new_current
+        self._oldest_window_index = max(self._oldest_window_index,
+                                        new_current - self._num_windows)
+        self._generation += 1

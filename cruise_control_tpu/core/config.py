@@ -1,0 +1,349 @@
+"""Kafka-style typed configuration framework.
+
+Mirrors the reference's config core (``cruise-control-core/.../common/config/
+ConfigDef.java`` and ``AbstractConfig.java``): every config key is *defined*
+with a type, default, optional validator, importance and doc string; a config
+instance parses a raw ``dict``/properties file against those definitions,
+rejects unknown values of the wrong shape, applies defaults, and supports
+reflective plugin loading (``getConfiguredInstance`` — here
+:meth:`AbstractConfig.get_configured_instance` using ``importlib``).
+"""
+
+from __future__ import annotations
+
+import enum
+import importlib
+from dataclasses import dataclass
+from typing import Any, Callable, Mapping
+
+
+class ConfigType(enum.Enum):
+    BOOLEAN = "boolean"
+    STRING = "string"
+    INT = "int"
+    LONG = "long"
+    DOUBLE = "double"
+    LIST = "list"
+    CLASS = "class"
+    PASSWORD = "password"
+
+
+class Importance(enum.Enum):
+    HIGH = "high"
+    MEDIUM = "medium"
+    LOW = "low"
+
+
+NO_DEFAULT = object()
+
+
+class ConfigException(ValueError):
+    """Raised when a config value fails to parse or validate."""
+
+
+class Password:
+    """Opaque wrapper hiding secrets from str()/repr() (ref: Password.java)."""
+
+    def __init__(self, value: str):
+        self.value = value
+
+    def __repr__(self) -> str:  # pragma: no cover - trivial
+        return "[hidden]"
+
+    __str__ = __repr__
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Password) and other.value == self.value
+
+    def __hash__(self) -> int:
+        return hash(self.value)
+
+
+# ---------------------------------------------------------------------------
+# Validators (ref: ConfigDef.Range / ConfigDef.ValidString / ValidList)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Range:
+    min: float | None = None
+    max: float | None = None
+
+    @staticmethod
+    def at_least(minimum: float) -> "Range":
+        return Range(min=minimum)
+
+    @staticmethod
+    def between(minimum: float, maximum: float) -> "Range":
+        return Range(min=minimum, max=maximum)
+
+    def __call__(self, name: str, value: Any) -> None:
+        if value is None:
+            return
+        if self.min is not None and value < self.min:
+            raise ConfigException(f"{name}: value {value} must be at least {self.min}")
+        if self.max is not None and value > self.max:
+            raise ConfigException(f"{name}: value {value} must be no more than {self.max}")
+
+
+@dataclass(frozen=True)
+class ValidString:
+    valid: tuple[str, ...]
+
+    @staticmethod
+    def in_(*valid: str) -> "ValidString":
+        return ValidString(tuple(valid))
+
+    def __call__(self, name: str, value: Any) -> None:
+        if value is not None and value not in self.valid:
+            raise ConfigException(f"{name}: {value!r} not one of {list(self.valid)}")
+
+
+Validator = Callable[[str, Any], None]
+
+
+@dataclass
+class ConfigKey:
+    name: str
+    type: ConfigType
+    default: Any = NO_DEFAULT
+    validator: Validator | None = None
+    importance: Importance = Importance.MEDIUM
+    doc: str = ""
+
+    @property
+    def has_default(self) -> bool:
+        return self.default is not NO_DEFAULT
+
+
+class ConfigDef:
+    """Registry of config key definitions (ref: ConfigDef.java)."""
+
+    def __init__(self) -> None:
+        self._keys: dict[str, ConfigKey] = {}
+
+    def define(self, name: str, type: ConfigType, default: Any = NO_DEFAULT,
+               validator: Validator | None = None,
+               importance: Importance = Importance.MEDIUM, doc: str = "") -> "ConfigDef":
+        if name in self._keys:
+            raise ConfigException(f"Config {name!r} is defined twice")
+        if default is not NO_DEFAULT and default is not None:
+            default = _parse_type(name, default, type)
+            if validator is not None:
+                validator(name, default)
+        self._keys[name] = ConfigKey(name, type, default, validator, importance, doc)
+        return self
+
+    def keys(self) -> Mapping[str, ConfigKey]:
+        return dict(self._keys)
+
+    def names(self) -> set[str]:
+        return set(self._keys)
+
+    def parse(self, props: Mapping[str, Any]) -> dict[str, Any]:
+        values: dict[str, Any] = {}
+        for name, key in self._keys.items():
+            if name in props:
+                value = _parse_type(name, props[name], key.type)
+            elif key.has_default:
+                value = key.default
+            else:
+                raise ConfigException(f"Missing required configuration {name!r} with no default")
+            if key.validator is not None:
+                key.validator(name, value)
+            values[name] = value
+        return values
+
+    def merge(self, other: "ConfigDef") -> "ConfigDef":
+        for key in other._keys.values():
+            if key.name not in self._keys:
+                self._keys[key.name] = key
+        return self
+
+
+def _parse_type(name: str, value: Any, ctype: ConfigType) -> Any:
+    """Coerce a raw value (possibly a properties-file string) to its type.
+
+    Mirrors ConfigDef.parseType (ConfigDef.java): trims strings, accepts
+    native python values, and parses "true"/"false", numerics and
+    comma-separated lists.
+    """
+    try:
+        if value is None:
+            return None
+        if ctype is ConfigType.BOOLEAN:
+            if isinstance(value, bool):
+                return value
+            if isinstance(value, str):
+                lowered = value.strip().lower()
+                if lowered == "true":
+                    return True
+                if lowered == "false":
+                    return False
+            raise ConfigException(f"{name}: expected boolean, got {value!r}")
+        if ctype is ConfigType.STRING or ctype is ConfigType.CLASS:
+            if isinstance(value, str):
+                return value.strip()
+            if ctype is ConfigType.CLASS and isinstance(value, type):
+                return value
+            raise ConfigException(f"{name}: expected string, got {value!r}")
+        if ctype is ConfigType.INT or ctype is ConfigType.LONG:
+            if isinstance(value, bool):
+                raise ConfigException(f"{name}: expected int, got bool")
+            if isinstance(value, int):
+                return value
+            if isinstance(value, str):
+                return int(value.strip())
+            raise ConfigException(f"{name}: expected int, got {value!r}")
+        if ctype is ConfigType.DOUBLE:
+            if isinstance(value, bool):
+                raise ConfigException(f"{name}: expected double, got bool")
+            if isinstance(value, (int, float)):
+                return float(value)
+            if isinstance(value, str):
+                return float(value.strip())
+            raise ConfigException(f"{name}: expected double, got {value!r}")
+        if ctype is ConfigType.LIST:
+            if isinstance(value, (list, tuple)):
+                return list(value)
+            if isinstance(value, str):
+                stripped = value.strip()
+                return [] if not stripped else [item.strip() for item in stripped.split(",")]
+            raise ConfigException(f"{name}: expected list, got {value!r}")
+        if ctype is ConfigType.PASSWORD:
+            if isinstance(value, Password):
+                return value
+            if isinstance(value, str):
+                return Password(value.strip())
+            raise ConfigException(f"{name}: expected password/string, got {value!r}")
+    except ConfigException:
+        raise
+    except (TypeError, ValueError) as exc:
+        raise ConfigException(f"{name}: cannot parse {value!r} as {ctype.value}: {exc}") from exc
+    raise ConfigException(f"{name}: unknown config type {ctype}")
+
+
+class AbstractConfig:
+    """A parsed config instance with typed getters (ref: AbstractConfig.java)."""
+
+    def __init__(self, definition: ConfigDef, props: Mapping[str, Any],
+                 allow_unknown: bool = True) -> None:
+        self._definition = definition
+        self._originals = dict(props)
+        if not allow_unknown:
+            unknown = set(props) - definition.names()
+            if unknown:
+                raise ConfigException(f"Unknown configuration(s): {sorted(unknown)}")
+        self._values = definition.parse(props)
+        self._used: set[str] = set()
+
+    # -- typed getters ------------------------------------------------------
+    def get(self, name: str) -> Any:
+        if name not in self._values:
+            raise ConfigException(f"Unknown configuration {name!r}")
+        self._used.add(name)
+        return self._values[name]
+
+    def get_int(self, name: str) -> int:
+        return self.get(name)
+
+    get_long = get_int
+
+    def get_double(self, name: str) -> float:
+        return self.get(name)
+
+    def get_boolean(self, name: str) -> bool:
+        return self.get(name)
+
+    def get_string(self, name: str) -> str:
+        return self.get(name)
+
+    def get_list(self, name: str) -> list[str]:
+        return self.get(name)
+
+    def get_password(self, name: str) -> Password:
+        return self.get(name)
+
+    def originals(self) -> dict[str, Any]:
+        return dict(self._originals)
+
+    def unused(self) -> set[str]:
+        return set(self._values) - self._used
+
+    def merged_values(self) -> dict[str, Any]:
+        return dict(self._values)
+
+    # -- plugin loading -----------------------------------------------------
+    def get_configured_instance(self, name: str, expected_type: type | None = None,
+                                **extra: Any) -> Any:
+        """Instantiate the class named by config ``name`` and configure it.
+
+        Mirrors AbstractConfig.getConfiguredInstance: the class is imported by
+        dotted path, instantiated with no args, and — if it has a
+        ``configure(config_dict)`` method (our ``CruiseControlConfigurable``
+        contract) — passed the full merged config plus ``extra`` overrides.
+        """
+        value = self.get(name)
+        return self._build_instance(name, value, expected_type, extra)
+
+    def get_configured_instances(self, name: str, expected_type: type | None = None,
+                                 **extra: Any) -> list[Any]:
+        values = self.get(name)
+        return [self._build_instance(name, v, expected_type, extra) for v in values]
+
+    def _build_instance(self, name: str, value: Any, expected_type: type | None,
+                        extra: Mapping[str, Any]) -> Any:
+        cls = value if isinstance(value, type) else load_class(value)
+        if expected_type is not None and not issubclass(cls, expected_type):
+            raise ConfigException(
+                f"{name}: {cls.__name__} is not a subclass of {expected_type.__name__}")
+        instance = cls()
+        configure = getattr(instance, "configure", None)
+        if callable(configure):
+            merged = self.merged_values()
+            merged.update(self._originals)
+            merged.update(extra)
+            configure(merged)
+        return instance
+
+
+def load_class(dotted_path: str) -> type:
+    """Import ``pkg.module.ClassName`` and return the class object."""
+    module_name, _, class_name = dotted_path.rpartition(".")
+    if not module_name:
+        raise ConfigException(f"Not a dotted class path: {dotted_path!r}")
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as exc:
+        raise ConfigException(f"Cannot import module {module_name!r}: {exc}") from exc
+    try:
+        return getattr(module, class_name)
+    except AttributeError as exc:
+        raise ConfigException(f"Module {module_name!r} has no class {class_name!r}") from exc
+
+
+def load_properties_file(path: str) -> dict[str, str]:
+    """Parse a java-style ``.properties`` file into a dict.
+
+    Handles ``#`` and ``!`` comments, ``=`` / ``:`` separators, preserves key
+    case, and honors trailing-backslash line continuations.
+    """
+    props: dict[str, str] = {}
+    with open(path) as handle:
+        pending = ""
+        for raw in handle:
+            line = pending + raw.strip()
+            pending = ""
+            if not line or line[0] in "#!":
+                continue
+            if line.endswith("\\") and not line.endswith("\\\\"):
+                pending = line[:-1]
+                continue
+            eq = min((i for i in (line.find("="), line.find(":")) if i >= 0),
+                     default=-1)
+            if eq < 0:
+                props[line.strip()] = ""
+            else:
+                props[line[:eq].strip()] = line[eq + 1:].strip()
+        if pending:
+            props[pending.strip()] = ""
+    return props

@@ -1,0 +1,64 @@
+"""Resource model (reference: ``common/Resource.java:17-25``).
+
+The four balanced resources and their array-axis order. This ordering is the
+contract for every ``[..., 4]`` resource axis in the flattened cluster model
+and the analyzer kernels — CPU=0, NW_IN=1, NW_OUT=2, DISK=3, matching the
+reference enum order so config defaults and score comparisons line up.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Resource(enum.IntEnum):
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+
+    @property
+    def is_host_resource(self) -> bool:
+        # ref Resource.java: CPU and NW are host-level, DISK is broker-level
+        return self in (Resource.CPU, Resource.NW_IN, Resource.NW_OUT)
+
+    @property
+    def is_broker_resource(self) -> bool:
+        return self in (Resource.CPU, Resource.NW_OUT, Resource.DISK)
+
+    @property
+    def epsilon(self) -> float:
+        # ref Resource.java EPSILON: tolerance for utilization comparison
+        return 1e-5 if self is Resource.CPU else 1e-3
+
+    @classmethod
+    def cached_values(cls) -> tuple["Resource", ...]:
+        return _RESOURCES
+
+
+_RESOURCES = (Resource.CPU, Resource.NW_IN, Resource.NW_OUT, Resource.DISK)
+
+NUM_RESOURCES = 4
+
+RESOURCE_NAMES = ("CPU", "NW_IN", "NW_OUT", "DISK")
+
+# Units (ref config/capacity.json doc): DISK in MB, CPU in percent (0-100 per
+# broker by default, cores-aware resolvers normalize), network in KB/s.
+RESOURCE_UNITS = ("%", "KB/s", "KB/s", "MB")
+
+
+class RawAndDerivedResource(enum.IntEnum):
+    """Derived per-replica resource split (ref: RawAndDerivedResource.java).
+
+    Used by the partition-load response layer where leader/follower shares of
+    network load are reported separately.
+    """
+
+    CPU = 0
+    NW_IN = 1
+    NW_OUT = 2
+    DISK = 3
+    LEADER_NW_IN = 4
+    FOLLOWER_NW_IN = 5
+    PWN_NW_OUT = 6
+    REPLICAS = 7

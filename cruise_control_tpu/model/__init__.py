@@ -1,0 +1,22 @@
+"""Cluster workload model layer (reference: ``cruise-control/.../model/``).
+
+The reference's mutable rack->host->broker->disk->replica object graph
+(``model/ClusterModel.java:48``) becomes two pieces here:
+
+- :mod:`~cruise_control_tpu.model.spec` — a host-side, human-assemblable
+  description of the cluster (brokers, racks, capacities, partitions, loads),
+  playing the role of the object graph for building/serialization; and
+- :mod:`~cruise_control_tpu.model.flat` — ``FlatClusterModel``, an immutable
+  pytree of padded device arrays that the analyzer kernels operate on. The
+  reference already sketches this layout in ``ClusterModel.utilizationMatrix()``
+  (``ClusterModel.java:1332``); here it is the primary representation, not a
+  derived view.
+"""
+
+from .flat import FlatClusterModel, Moves, MOVE_INTER_BROKER, MOVE_LEADERSHIP
+from .spec import BrokerSpec, PartitionSpec, ClusterSpec, ClusterMetadata, flatten_spec
+
+__all__ = [
+    "FlatClusterModel", "Moves", "MOVE_INTER_BROKER", "MOVE_LEADERSHIP",
+    "BrokerSpec", "PartitionSpec", "ClusterSpec", "ClusterMetadata", "flatten_spec",
+]

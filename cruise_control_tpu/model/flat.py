@@ -1,0 +1,303 @@
+"""``FlatClusterModel`` — the cluster workload model as device arrays.
+
+Rebuild of ``model/ClusterModel.java:48``. Instead of a mutable object graph
+with ``relocateReplica`` (``:380``) / ``relocateLeadership`` (``:409``)
+mutators, the model is an immutable pytree of padded, statically-shaped
+arrays; "mutation" is the pure function :func:`apply_moves` which returns a
+new model, and every read the goals need (``Load.expectedUtilizationFor``
+``Load.java:81-97``, ``ClusterModel.utilizationMatrix()`` ``:1332``,
+``brokerStats`` ``:1303``) is a vectorized reduction over these arrays.
+
+Layout (P = padded partition count, R = padded max replication factor,
+B = padded broker count, 4 = resources CPU/NW_IN/NW_OUT/DISK):
+
+- ``replica_broker  int32[P, R]`` — broker index per replica; **slot 0 is the
+  leader** (ref ``Partition.java`` keeps leader + follower list; we encode
+  leadership positionally). Empty replica slots and padding partitions hold
+  the sentinel ``B`` (one-past-last broker row) so scatter-adds land in a
+  discard row.
+- ``leader_load / follower_load  float32[P, 4]`` — per-partition resource
+  load when hosting the leader vs a follower (ref ``Load.java``: leader
+  carries CPU(leader), NW_IN, NW_OUT, DISK; followers carry CPU(follower),
+  replication NW_IN, zero NW_OUT, DISK).
+- ``partition_topic int32[P]``, ``partition_valid bool[P]``.
+- ``replica_offline bool[P, R]`` — replica currently on a dead broker or bad
+  disk (ref ``Replica.isCurrentOffline``); these MUST move.
+- broker-side: ``broker_capacity float32[B, 4]`` (ref capacity resolver),
+  ``broker_rack int32[B]``, ``broker_host int32[B]``, boolean state masks
+  mirroring ``ClusterModel``'s alive/dead/new/broken sets (``:57-77``), and
+  ``broker_set int32[B]`` for BrokerSetAwareGoal.
+
+All arrays are padded to static shapes so every analyzer kernel compiles
+once per (P, R, B) bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core.resources import NUM_RESOURCES
+
+# Move types (ref ActionType.java:23-28; intra-broker variants live in the
+# disk extension of Moves).
+MOVE_INTER_BROKER = 0
+MOVE_LEADERSHIP = 1
+
+
+@struct.dataclass
+class FlatClusterModel:
+    # --- replica/partition axis ------------------------------------------
+    replica_broker: jax.Array      # int32[P, R], sentinel B for empty slots
+    leader_load: jax.Array         # float32[P, 4]
+    follower_load: jax.Array       # float32[P, 4]
+    partition_topic: jax.Array     # int32[P]
+    partition_valid: jax.Array     # bool[P]
+    replica_offline: jax.Array     # bool[P, R]
+    # --- broker axis ------------------------------------------------------
+    broker_capacity: jax.Array     # float32[B, 4]
+    broker_rack: jax.Array         # int32[B]
+    broker_host: jax.Array         # int32[B]
+    broker_set: jax.Array          # int32[B]
+    broker_alive: jax.Array        # bool[B]  (ref Broker.State ALIVE/NEW)
+    broker_new: jax.Array          # bool[B]  (ref ClusterModel.newBrokers)
+    broker_demoted: jax.Array      # bool[B]  (ref DEMOTED state)
+    broker_broken_disk: jax.Array  # bool[B]  (ref brokenBrokers / BAD_DISKS)
+    broker_valid: jax.Array        # bool[B]  (padding mask)
+
+    # ------------------------------------------------------------ properties
+    @property
+    def num_partitions_padded(self) -> int:
+        return self.replica_broker.shape[0]
+
+    @property
+    def max_replication_factor(self) -> int:
+        return self.replica_broker.shape[1]
+
+    @property
+    def num_brokers_padded(self) -> int:
+        return self.broker_capacity.shape[0]
+
+    @property
+    def broker_sentinel(self) -> int:
+        return self.num_brokers_padded
+
+    @property
+    def replica_valid(self) -> jax.Array:
+        """bool[P, R] — true where a real replica occupies the slot."""
+        return self.replica_broker < self.broker_sentinel
+
+    @property
+    def leader_broker(self) -> jax.Array:
+        """int32[P] — broker of the leader replica (slot 0)."""
+        return self.replica_broker[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Derived reductions (the reads every goal kernel is built from)
+# ---------------------------------------------------------------------------
+
+def replica_loads(model: FlatClusterModel) -> jax.Array:
+    """float32[P, R, 4] — the load each replica slot contributes to its broker.
+
+    Slot 0 gets ``leader_load``, the rest ``follower_load``; empty slots get
+    zeros. This is the vectorized ``Load.expectedUtilizationFor`` across the
+    whole cluster (ref Load.java:81-97).
+    """
+    P, R = model.replica_broker.shape
+    is_leader_slot = (jnp.arange(R) == 0)[None, :, None]            # [1, R, 1]
+    loads = jnp.where(is_leader_slot, model.leader_load[:, None, :],
+                      model.follower_load[:, None, :])               # [P, R, 4]
+    return jnp.where(model.replica_valid[:, :, None], loads, 0.0)
+
+
+def broker_utilization(model: FlatClusterModel) -> jax.Array:
+    """float32[B, 4] — per-broker resource utilization.
+
+    The dense equivalent of ``ClusterModel.utilizationMatrix()``
+    (``ClusterModel.java:1332``), computed as one scatter-add of replica
+    loads into broker rows (sentinel row dropped).
+    """
+    B = model.num_brokers_padded
+    loads = replica_loads(model)                                     # [P, R, 4]
+    flat_idx = model.replica_broker.reshape(-1)                      # [P*R]
+    flat_loads = loads.reshape(-1, NUM_RESOURCES)
+    util = jnp.zeros((B + 1, NUM_RESOURCES), flat_loads.dtype)
+    util = util.at[flat_idx].add(flat_loads)
+    return util[:B]
+
+
+def broker_replica_counts(model: FlatClusterModel) -> jax.Array:
+    """int32[B] — replicas per broker (ref Broker.replicas().size())."""
+    B = model.num_brokers_padded
+    flat_idx = model.replica_broker.reshape(-1)
+    counts = jnp.zeros((B + 1,), jnp.int32).at[flat_idx].add(1)
+    return counts[:B]
+
+
+def broker_leader_counts(model: FlatClusterModel) -> jax.Array:
+    """int32[B] — leader replicas per broker (ref Broker.leaderReplicas())."""
+    B = model.num_brokers_padded
+    counts = jnp.zeros((B + 1,), jnp.int32).at[model.leader_broker].add(1)
+    return counts[:B]
+
+
+def broker_potential_nw_out(model: FlatClusterModel) -> jax.Array:
+    """float32[B] — potential leadership NW_OUT load per broker.
+
+    Ref ``ClusterModel.potentialLeadershipLoadFor`` (``ClusterModel.java:66``,
+    used by PotentialNwOutGoal): the NW_OUT the broker would serve if every
+    replica it hosts became the leader of its partition.
+    """
+    from ..core.resources import Resource
+    B = model.num_brokers_padded
+    potential = model.leader_load[:, Resource.NW_OUT][:, None]       # [P, 1]
+    potential = jnp.where(model.replica_valid, potential, 0.0)       # [P, R]
+    flat_idx = model.replica_broker.reshape(-1)
+    out = jnp.zeros((B + 1,), potential.dtype).at[flat_idx].add(potential.reshape(-1))
+    return out[:B]
+
+
+def topic_broker_replica_counts(model: FlatClusterModel, num_topics: int) -> jax.Array:
+    """int32[T, B] — replicas of each topic on each broker.
+
+    Backs TopicReplicaDistributionGoal / MinTopicLeadersPerBrokerGoal. Dense
+    [T, B] is only materialized when the caller asks (T×B can be large); the
+    scatter is a single ``at[].add`` on a flattened (topic*B' + broker) index.
+    """
+    B = model.num_brokers_padded
+    Bp = B + 1
+    topic = model.partition_topic[:, None]                           # [P, 1]
+    idx = topic * Bp + model.replica_broker                          # [P, R]
+    counts = jnp.zeros((num_topics * Bp,), jnp.int32).at[idx.reshape(-1)].add(
+        jnp.where(model.replica_valid, 1, 0).reshape(-1),
+        mode="drop")
+    return counts.reshape(num_topics, Bp)[:, :B]
+
+
+def topic_broker_leader_counts(model: FlatClusterModel, num_topics: int) -> jax.Array:
+    """int32[T, B] — leaders of each topic on each broker."""
+    B = model.num_brokers_padded
+    Bp = B + 1
+    idx = model.partition_topic * Bp + model.leader_broker           # [P]
+    counts = jnp.zeros((num_topics * Bp,), jnp.int32).at[idx].add(
+        jnp.where(model.partition_valid, 1, 0), mode="drop")
+    return counts.reshape(num_topics, Bp)[:, :B]
+
+
+def leader_bytes_in(model: FlatClusterModel) -> jax.Array:
+    """float32[B] — leader-only NW_IN per broker (ref LeaderBytesInDistributionGoal)."""
+    from ..core.resources import Resource
+    B = model.num_brokers_padded
+    lbi = jnp.where(model.partition_valid, model.leader_load[:, Resource.NW_IN], 0.0)
+    out = jnp.zeros((B + 1,), lbi.dtype).at[model.leader_broker].add(lbi)
+    return out[:B]
+
+
+# ---------------------------------------------------------------------------
+# Moves: the pure-functional mutation (ref relocateReplica/relocateLeadership)
+# ---------------------------------------------------------------------------
+
+@struct.dataclass
+class Moves:
+    """A batch of balancing actions as a struct-of-arrays.
+
+    Equivalent of a list of ``BalancingAction`` (ref BalancingAction.java:20):
+    each entry is (partition, slot, destination broker, type). For
+    INTER_BROKER_REPLICA_MOVEMENT the replica in ``slot`` relocates to
+    ``destination``; for LEADERSHIP_MOVEMENT the replica in ``slot`` swaps
+    positions with slot 0 (becoming the leader). Inactive entries (padding)
+    use ``partition == -1``.
+    """
+
+    partition: jax.Array   # int32[M]
+    slot: jax.Array        # int32[M]
+    destination: jax.Array  # int32[M] (ignored for leadership moves)
+    kind: jax.Array        # int32[M]: MOVE_INTER_BROKER | MOVE_LEADERSHIP
+
+    @property
+    def capacity(self) -> int:
+        return self.partition.shape[0]
+
+    @property
+    def active(self) -> jax.Array:
+        return self.partition >= 0
+
+    @staticmethod
+    def empty(capacity: int) -> "Moves":
+        return Moves(partition=jnp.full((capacity,), -1, jnp.int32),
+                     slot=jnp.zeros((capacity,), jnp.int32),
+                     destination=jnp.zeros((capacity,), jnp.int32),
+                     kind=jnp.zeros((capacity,), jnp.int32))
+
+
+def apply_moves(model: FlatClusterModel, moves: Moves) -> FlatClusterModel:
+    """Apply a batch of moves, returning a new model (pure).
+
+    Replaces the reference's in-place ``relocateReplica``
+    (``ClusterModel.java:380``) and ``relocateLeadership`` (``:409``). Moves
+    are applied in array order; later moves see earlier moves' effect via the
+    sequential scatter semantics of ``at[].set`` only when they touch
+    *different* (partition, slot) cells — the optimizer guarantees one move
+    per partition per batch, so order never matters in practice.
+    """
+    rb = model.replica_broker
+    off = model.replica_offline
+    P = model.num_partitions_padded
+    active = moves.active
+    slot = moves.slot
+
+    # Inactive / other-kind entries are routed to the out-of-bounds partition
+    # index P and dropped by the scatter, so they can never collide with a
+    # real move targeting partition 0.
+    is_move = active & (moves.kind == MOVE_INTER_BROKER)
+    mpart = jnp.where(is_move, moves.partition, P)
+    rb = rb.at[mpart, slot].set(moves.destination, mode="drop")
+    # A relocated replica is no longer offline (it moved to a live broker).
+    off = off.at[mpart, slot].set(False, mode="drop")
+
+    # Leadership transfer: swap slot <-> 0 (gathers on OOB rows clamp and are
+    # harmless because the corresponding writes are dropped).
+    is_lead = active & (moves.kind == MOVE_LEADERSHIP)
+    lpart = jnp.where(is_lead, moves.partition, P)
+    old_leader = rb[lpart, 0]
+    new_leader = rb[lpart, slot]
+    rb = rb.at[lpart, 0].set(new_leader, mode="drop")
+    rb = rb.at[lpart, slot].set(old_leader, mode="drop")
+    old_leader_off = off[lpart, 0]
+    slot_off = off[lpart, slot]
+    off = off.at[lpart, 0].set(slot_off, mode="drop")
+    off = off.at[lpart, slot].set(old_leader_off, mode="drop")
+
+    return model.replace(replica_broker=rb, replica_offline=off)
+
+
+def sanity_check(model: FlatClusterModel) -> dict[str, Any]:
+    """Host-side invariant checks (ref ClusterModel.sanityCheck :1147).
+
+    Returns a dict of violation counts; all zeros means healthy. NumPy-side —
+    not jitted — because it is a test/debug utility.
+    """
+    rb = np.asarray(model.replica_broker)
+    valid = rb < model.broker_sentinel
+    pvalid = np.asarray(model.partition_valid)
+    issues = {}
+    # Valid partitions must have a leader in slot 0.
+    issues["partitions_without_leader"] = int((pvalid & ~valid[:, 0]).sum())
+    # No two replicas of one partition on the same broker.
+    dup = 0
+    for p in np.nonzero(pvalid)[0]:
+        brokers = rb[p][valid[p]]
+        dup += len(brokers) - len(set(brokers.tolist()))
+    issues["duplicate_replica_brokers"] = dup
+    # Replicas must sit on valid broker rows.
+    bvalid = np.asarray(model.broker_valid)
+    on_invalid = valid & ~np.pad(bvalid, (0, 1))[rb]
+    issues["replicas_on_invalid_brokers"] = int(on_invalid.sum())
+    # Padding partitions must be fully empty.
+    issues["padding_with_replicas"] = int((~pvalid[:, None] & valid).sum())
+    return issues

@@ -1,0 +1,91 @@
+"""Execution proposals: the diff between two cluster models.
+
+Rebuild of ``ExecutionProposal`` and ``AnalyzerUtils.getDiff``
+(ref ``GoalOptimizer.java:508-513``): compare the initial and optimized
+replica placements and emit, per changed partition, the (old leader, old
+replica list, new replica list) triple the executor consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .flat import FlatClusterModel
+from .spec import ClusterMetadata
+
+
+@dataclass(frozen=True)
+class ExecutionProposal:
+    """One partition's reassignment (ref executor/ExecutionProposal.java)."""
+
+    topic: str
+    partition: int
+    old_leader: int                 # broker id
+    old_replicas: tuple[int, ...]   # broker ids, leader first
+    new_replicas: tuple[int, ...]   # broker ids, leader first
+
+    @property
+    def new_leader(self) -> int:
+        return self.new_replicas[0]
+
+    @property
+    def has_replica_action(self) -> bool:
+        return set(self.old_replicas) != set(self.new_replicas)
+
+    @property
+    def has_leader_action(self) -> bool:
+        return self.old_leader != self.new_leader
+
+    @property
+    def replicas_to_add(self) -> tuple[int, ...]:
+        old = set(self.old_replicas)
+        return tuple(b for b in self.new_replicas if b not in old)
+
+    @property
+    def replicas_to_remove(self) -> tuple[int, ...]:
+        new = set(self.new_replicas)
+        return tuple(b for b in self.old_replicas if b not in new)
+
+    def to_json(self) -> dict:
+        return {"topicPartition": {"topic": self.topic, "partition": self.partition},
+                "oldLeader": self.old_leader,
+                "oldReplicas": list(self.old_replicas),
+                "newReplicas": list(self.new_replicas)}
+
+
+def diff_proposals(initial: FlatClusterModel, final: FlatClusterModel,
+                   metadata: ClusterMetadata) -> list[ExecutionProposal]:
+    """Diff two models sharing one metadata/padding layout into proposals."""
+    rb0 = np.asarray(initial.replica_broker)
+    rb1 = np.asarray(final.replica_broker)
+    if rb0.shape != rb1.shape:
+        raise ValueError("models have different padded shapes")
+    sentinel = initial.broker_sentinel
+    changed = np.nonzero((rb0 != rb1).any(axis=1))[0]
+    broker_ids = np.asarray(metadata.broker_ids + [-1] * (sentinel + 1 - len(metadata.broker_ids)))
+    proposals: list[ExecutionProposal] = []
+    for p in changed:
+        if p >= len(metadata.partition_keys):
+            continue
+        topic, partition = metadata.partition_keys[p]
+        old = tuple(int(broker_ids[b]) for b in rb0[p] if b < sentinel)
+        new = tuple(int(broker_ids[b]) for b in rb1[p] if b < sentinel)
+        if old == new:
+            continue
+        proposals.append(ExecutionProposal(topic=topic, partition=partition,
+                                           old_leader=old[0] if old else -1,
+                                           old_replicas=old, new_replicas=new))
+    return proposals
+
+
+def proposal_summary(proposals: list[ExecutionProposal]) -> dict:
+    """Counts mirroring OptimizerResult proposal summary fields."""
+    return {
+        "numReplicaMovements": sum(len(p.replicas_to_add) for p in proposals),
+        "numLeaderMovements": sum(1 for p in proposals
+                                  if p.has_leader_action and not p.has_replica_action),
+        "numProposals": len(proposals),
+        "dataToMoveMB": None,  # filled by caller with disk loads when available
+    }

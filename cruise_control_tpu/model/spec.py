@@ -1,0 +1,252 @@
+"""Host-side cluster description and flattening into ``FlatClusterModel``.
+
+``ClusterSpec`` plays the role of the reference's object-graph building path
+(``LoadMonitor.clusterModel`` ``LoadMonitor.java:439`` populating
+``ClusterModel.createReplica``/``setReplicaLoad``): it is what the monitor
+layer assembles from aggregated samples + capacity/rack metadata, what tests
+hand-build (like the reference's ``DeterministicCluster``), and what the API
+layer serializes. :func:`flatten_spec` turns it into padded device arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..core.resources import NUM_RESOURCES, Resource
+
+
+def _round_up(n: int, multiple: int) -> int:
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclass
+class BrokerSpec:
+    """One broker (ref ``model/Broker.java``): identity, placement, capacity,
+    liveness state."""
+
+    broker_id: int
+    rack: str
+    host: str | None = None
+    capacity: Sequence[float] = (100.0, 10_000.0, 10_000.0, 100_000.0)  # ref config/capacity.json default
+    alive: bool = True
+    new: bool = False
+    demoted: bool = False
+    broken_disk: bool = False
+    broker_set: str | None = None
+
+
+@dataclass
+class PartitionSpec:
+    """One partition (ref ``model/Partition.java``): replica broker list with
+    the leader first, plus the leader/follower resource loads."""
+
+    topic: str
+    partition: int
+    replicas: Sequence[int]                      # broker ids, leader first
+    leader_load: Sequence[float] = (0.0, 0.0, 0.0, 0.0)    # CPU,NW_IN,NW_OUT,DISK
+    follower_load: Sequence[float] | None = None  # default derived from leader
+    offline_replicas: Sequence[int] = ()          # broker ids currently offline
+
+    def derived_follower_load(self) -> tuple[float, ...]:
+        """Follower load derived from leader load when not given explicitly.
+
+        Ref ``Load``/``SamplingUtils``: followers replicate the leader's
+        bytes-in (NW_IN), serve no client traffic (NW_OUT = 0), consume a
+        fraction of leader CPU (``ModelUtils.FOLLOWER_CPU_RATIO``-style
+        estimate), and hold the same DISK footprint.
+        """
+        if self.follower_load is not None:
+            return tuple(self.follower_load)
+        cpu, nw_in, _nw_out, disk = self.leader_load
+        return (0.5 * cpu, nw_in, 0.0, disk)
+
+
+@dataclass
+class ClusterSpec:
+    brokers: list[BrokerSpec] = field(default_factory=list)
+    partitions: list[PartitionSpec] = field(default_factory=list)
+
+    def broker_ids(self) -> list[int]:
+        return [b.broker_id for b in self.brokers]
+
+    def topics(self) -> list[str]:
+        seen: dict[str, None] = {}
+        for p in self.partitions:
+            seen.setdefault(p.topic, None)
+        return list(seen)
+
+    def max_replication_factor(self) -> int:
+        return max((len(p.replicas) for p in self.partitions), default=1)
+
+
+@dataclass
+class ClusterMetadata:
+    """Host-side lookup tables pairing a ``FlatClusterModel`` with names.
+
+    Keeps the string/broker-id world out of the device arrays: broker row ->
+    broker id, topic id -> topic name, partition row -> (topic, partition).
+    """
+
+    broker_ids: list[int]
+    broker_index: dict[int, int]
+    topics: list[str]
+    topic_index: dict[str, int]
+    partition_keys: list[tuple[str, int]]
+    partition_index: dict[tuple[str, int], int]
+    racks: list[str]
+    hosts: list[str]
+    broker_sets: list[str]
+
+    @property
+    def num_brokers(self) -> int:
+        return len(self.broker_ids)
+
+    @property
+    def num_partitions(self) -> int:
+        return len(self.partition_keys)
+
+    @property
+    def num_topics(self) -> int:
+        return len(self.topics)
+
+
+def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
+                 pad_brokers_to: int | None = None,
+                 pad_rf_to: int | None = None,
+                 partition_pad_multiple: int = 128,
+                 broker_pad_multiple: int = 8):
+    """Flatten a ``ClusterSpec`` into (FlatClusterModel, ClusterMetadata).
+
+    Shapes are padded (partitions to a multiple of ``partition_pad_multiple``,
+    brokers to ``broker_pad_multiple``) so repeated model builds for a slowly
+    growing cluster hit the same compiled analyzer kernels.
+    """
+    import jax.numpy as jnp
+    from .flat import FlatClusterModel
+
+    broker_ids = [b.broker_id for b in spec.brokers]
+    broker_index = {bid: i for i, bid in enumerate(broker_ids)}
+    if len(broker_index) != len(broker_ids):
+        raise ValueError("duplicate broker ids in spec")
+
+    racks: list[str] = []
+    rack_index: dict[str, int] = {}
+    hosts: list[str] = []
+    host_index: dict[str, int] = {}
+    broker_sets: list[str] = []
+    broker_set_index: dict[str, int] = {}
+
+    B = len(broker_ids)
+    Bpad = pad_brokers_to or _round_up(B, broker_pad_multiple)
+    if Bpad < B:
+        raise ValueError("pad_brokers_to smaller than broker count")
+
+    capacity = np.zeros((Bpad, NUM_RESOURCES), np.float32)
+    b_rack = np.zeros(Bpad, np.int32)
+    b_host = np.zeros(Bpad, np.int32)
+    b_set = np.full(Bpad, -1, np.int32)
+    alive = np.zeros(Bpad, bool)
+    new = np.zeros(Bpad, bool)
+    demoted = np.zeros(Bpad, bool)
+    broken = np.zeros(Bpad, bool)
+    bvalid = np.zeros(Bpad, bool)
+
+    for i, b in enumerate(spec.brokers):
+        capacity[i] = np.asarray(b.capacity, np.float32)
+        if b.rack not in rack_index:
+            rack_index[b.rack] = len(racks)
+            racks.append(b.rack)
+        b_rack[i] = rack_index[b.rack]
+        host = b.host if b.host is not None else f"host-{b.broker_id}"
+        if host not in host_index:
+            host_index[host] = len(hosts)
+            hosts.append(host)
+        b_host[i] = host_index[host]
+        if b.broker_set is not None:
+            if b.broker_set not in broker_set_index:
+                broker_set_index[b.broker_set] = len(broker_sets)
+                broker_sets.append(b.broker_set)
+            b_set[i] = broker_set_index[b.broker_set]
+        alive[i] = b.alive
+        new[i] = b.new
+        demoted[i] = b.demoted
+        broken[i] = b.broken_disk
+        bvalid[i] = True
+
+    topics = []
+    topic_index: dict[str, int] = {}
+    partition_keys: list[tuple[str, int]] = []
+    P = len(spec.partitions)
+    Ppad = pad_partitions_to or _round_up(P, partition_pad_multiple)
+    if Ppad < P:
+        raise ValueError("pad_partitions_to smaller than partition count")
+    R = max(spec.max_replication_factor(), 1)
+    Rpad = pad_rf_to or R
+    if Rpad < R:
+        raise ValueError("pad_rf_to smaller than max replication factor")
+
+    sentinel = Bpad
+    rb = np.full((Ppad, Rpad), sentinel, np.int32)
+    lead_load = np.zeros((Ppad, NUM_RESOURCES), np.float32)
+    foll_load = np.zeros((Ppad, NUM_RESOURCES), np.float32)
+    ptopic = np.full(Ppad, -1, np.int32)
+    pvalid = np.zeros(Ppad, bool)
+    offline = np.zeros((Ppad, Rpad), bool)
+
+    for p, part in enumerate(spec.partitions):
+        key = (part.topic, part.partition)
+        partition_keys.append(key)
+        if part.topic not in topic_index:
+            topic_index[part.topic] = len(topics)
+            topics.append(part.topic)
+        ptopic[p] = topic_index[part.topic]
+        pvalid[p] = True
+        if len(set(part.replicas)) != len(part.replicas):
+            raise ValueError(f"partition {key}: duplicate replica brokers")
+        offline_ids = set(part.offline_replicas)
+        for r, bid in enumerate(part.replicas):
+            if bid not in broker_index:
+                raise ValueError(f"partition {key}: unknown broker {bid}")
+            rb[p, r] = broker_index[bid]
+            offline[p, r] = bid in offline_ids
+        lead_load[p] = np.asarray(part.leader_load, np.float32)
+        foll_load[p] = np.asarray(part.derived_follower_load(), np.float32)
+
+    partition_index = {key: i for i, key in enumerate(partition_keys)}
+    if len(partition_index) != len(partition_keys):
+        raise ValueError("duplicate (topic, partition) in spec")
+
+    model = FlatClusterModel(
+        replica_broker=jnp.asarray(rb),
+        leader_load=jnp.asarray(lead_load),
+        follower_load=jnp.asarray(foll_load),
+        partition_topic=jnp.asarray(ptopic),
+        partition_valid=jnp.asarray(pvalid),
+        replica_offline=jnp.asarray(offline),
+        broker_capacity=jnp.asarray(capacity),
+        broker_rack=jnp.asarray(b_rack),
+        broker_host=jnp.asarray(b_host),
+        broker_set=jnp.asarray(b_set),
+        broker_alive=jnp.asarray(alive),
+        broker_new=jnp.asarray(new),
+        broker_demoted=jnp.asarray(demoted),
+        broker_broken_disk=jnp.asarray(broken),
+        broker_valid=jnp.asarray(bvalid),
+    )
+    metadata = ClusterMetadata(
+        broker_ids=broker_ids,
+        broker_index=broker_index,
+        topics=topics,
+        topic_index=topic_index,
+        partition_keys=partition_keys,
+        partition_index=partition_index,
+        racks=racks,
+        hosts=hosts,
+        broker_sets=broker_sets,
+    )
+    return model, metadata

@@ -1,0 +1,77 @@
+"""Cluster-level statistics (ref ``model/ClusterModelStats.java``).
+
+Per-resource average / standard deviation / max / min of broker utilization
+across alive brokers, plus replica- and leader-count statistics — the numbers
+goal comparators compare (ref ``ClusterModelStats`` fields consumed by
+``Goal.clusterModelStatsComparator``) and the payload of ``brokerStats``
+(``ClusterModel.java:1303``) responses.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.resources import NUM_RESOURCES, RESOURCE_NAMES
+from .flat import (FlatClusterModel, broker_leader_counts, broker_replica_counts,
+                   broker_utilization, broker_potential_nw_out)
+
+
+def cluster_stats(model: FlatClusterModel) -> dict[str, jax.Array]:
+    """Device-side stats pytree. All entries are computed over alive brokers
+    (dead/padding brokers excluded, matching ref ClusterModelStats which
+    iterates aliveBrokers)."""
+    util = broker_utilization(model)                      # [B, 4]
+    replicas = broker_replica_counts(model)               # [B]
+    leaders = broker_leader_counts(model)                 # [B]
+    potential_out = broker_potential_nw_out(model)        # [B]
+    alive = model.broker_alive & model.broker_valid
+    n = jnp.maximum(alive.sum(), 1)
+
+    def _stats(values: jax.Array) -> dict[str, jax.Array]:
+        # Mask along the broker axis (axis 0) regardless of value rank.
+        mask = alive[:, None] if values.ndim > 1 else alive
+        masked = jnp.where(mask, values, 0.0)
+        mean = masked.sum(axis=0) / n
+        var = jnp.where(mask, (values - mean) ** 2, 0.0).sum(axis=0) / n
+        big = jnp.where(mask, values, -jnp.inf).max(axis=0)
+        small = jnp.where(mask, values, jnp.inf).min(axis=0)
+        return {"avg": mean, "std": jnp.sqrt(var), "max": big, "min": small}
+
+    util_stats = _stats(util)
+    return {
+        "num_alive_brokers": alive.sum(),
+        "utilization": util,
+        "resource": util_stats,                            # each entry [4]
+        "replica_count": _stats(replicas.astype(jnp.float32)),
+        "leader_count": _stats(leaders.astype(jnp.float32)),
+        "potential_nw_out": _stats(potential_out),
+        "num_replicas": jnp.where(model.replica_valid, 1, 0).sum(),
+        "num_leaders": jnp.where(model.partition_valid, 1, 0).sum(),
+    }
+
+
+def resource_cv(stats: dict[str, jax.Array]) -> jax.Array:
+    """Coefficient of variation per resource — the reference's balance metric
+    (``ClusterModelStats.variance()`` normalized, cf. ClusterModel.java:1315)."""
+    res = stats["resource"]
+    return res["std"] / jnp.maximum(res["avg"], 1e-9)
+
+
+def stats_summary(model: FlatClusterModel) -> dict:
+    """Host-side JSON-friendly summary (for /state and /load responses)."""
+    stats = jax.device_get(cluster_stats(model))
+    out = {"numAliveBrokers": int(stats["num_alive_brokers"]),
+           "numReplicas": int(stats["num_replicas"]),
+           "numLeaders": int(stats["num_leaders"]),
+           "resources": {}}
+    for r in range(NUM_RESOURCES):
+        out["resources"][RESOURCE_NAMES[r]] = {
+            "avg": float(stats["resource"]["avg"][r]),
+            "std": float(stats["resource"]["std"][r]),
+            "max": float(stats["resource"]["max"][r]),
+            "min": float(stats["resource"]["min"][r]),
+        }
+    for key in ("replica_count", "leader_count", "potential_nw_out"):
+        out[key] = {k: float(v) for k, v in stats[key].items()}
+    return out

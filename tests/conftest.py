@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-virtual-device CPU platform.
+
+Tests never require real TPU hardware: sharding/pjit paths run on a virtual
+8-device CPU mesh (the driver separately dry-runs the multi-chip path via
+__graft_entry__.dryrun_multichip). The env vars must be set before jax
+initializes, hence this module-level block.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", False)

@@ -1,0 +1,162 @@
+"""Windowed aggregator tests.
+
+Modeled on the reference's MetricSampleAggregatorTest /
+KafkaPartitionMetricSampleAggregatorTest scenarios: window rollout,
+per-strategy aggregation, extrapolation ladder, completeness ratios,
+generation bumps.
+"""
+
+import numpy as np
+
+from cruise_control_tpu.core.aggregator import (AggregationGranularity,
+                                                AggregationOptions, Extrapolation,
+                                                MetricSample, MetricSampleAggregator)
+from cruise_control_tpu.core.metricdef import (AggregationFunction, MetricDef)
+
+WINDOW_MS = 1000
+
+
+def _metric_def():
+    return (MetricDef()
+            .define("m_avg", AggregationFunction.AVG)
+            .define("m_max", AggregationFunction.MAX)
+            .define("m_latest", AggregationFunction.LATEST))
+
+
+def _agg(num_windows=4, min_samples=2):
+    return MetricSampleAggregator(num_windows, WINDOW_MS, min_samples, _metric_def(),
+                                  entity_group_fn=lambda e: e[0])
+
+
+def _sample(entity, t, value):
+    return MetricSample(entity=entity, sample_time_ms=t,
+                        values={0: value, 1: value, 2: value})
+
+
+def test_basic_aggregation_strategies():
+    agg = _agg()
+    e = ("t1", 0)
+    # window 0: two samples 10 and 20 -> avg 15, max 20, latest 20
+    agg.add_sample(_sample(e, 100, 10.0))
+    agg.add_sample(_sample(e, 900, 20.0))
+    # roll out window 0 by writing into window 1, then window 2
+    agg.add_sample(_sample(e, 1100, 5.0))
+    agg.add_sample(_sample(e, 1200, 7.0))
+    agg.add_sample(_sample(e, 2100, 1.0))
+    result = agg.aggregate(0, 2000)
+    vae = result.entity_values[e]
+    np.testing.assert_allclose(vae.values[0], [15.0, 6.0])
+    np.testing.assert_allclose(vae.values[1], [20.0, 7.0])
+    np.testing.assert_allclose(vae.values[2], [20.0, 7.0])
+    assert vae.extrapolations == [Extrapolation.NONE, Extrapolation.NONE]
+    assert result.valid_windows == [0, 1]
+
+
+def test_avg_available_extrapolation():
+    agg = _agg(min_samples=4)
+    e = ("t1", 0)
+    # 2 samples with min 4 -> half-min reached -> AVG_AVAILABLE
+    agg.add_sample(_sample(e, 100, 10.0))
+    agg.add_sample(_sample(e, 200, 30.0))
+    agg.add_sample(_sample(e, 1100, 1.0))
+    result = agg.aggregate(0, 1000)
+    vae = result.entity_values[e]
+    assert vae.extrapolations[0] == Extrapolation.AVG_AVAILABLE
+    np.testing.assert_allclose(vae.values[0][0], 20.0)
+
+
+def test_avg_adjacent_extrapolation():
+    agg = _agg(num_windows=5, min_samples=2)
+    e = ("t1", 0)
+    for t in (100, 500):
+        agg.add_sample(_sample(e, t, 10.0))
+    # window 1 empty; window 2 full
+    for t in (2100, 2500):
+        agg.add_sample(_sample(e, t, 30.0))
+    agg.add_sample(_sample(e, 3100, 1.0))  # roll out window 2
+    result = agg.aggregate(0, 3000)
+    vae = result.entity_values[e]
+    assert vae.extrapolations[1] == Extrapolation.AVG_ADJACENT
+    np.testing.assert_allclose(vae.values[0][1], 20.0)  # avg of neighbors
+
+
+def test_no_valid_extrapolation_marks_entity_invalid():
+    agg = _agg(num_windows=3, min_samples=2)
+    good, bad = ("t1", 0), ("t1", 1)
+    for w in range(3):
+        t = w * WINDOW_MS + 100
+        agg.add_sample(_sample(good, t, 10.0))
+        agg.add_sample(_sample(good, t + 50, 10.0))
+    agg.add_sample(_sample(bad, 100, 5.0))  # only one sample, window 0 only
+    agg.add_sample(_sample(good, 3100, 1.0))  # rollout
+    agg.add_sample(_sample(bad, 3100, 1.0))
+    result = agg.aggregate(0, 3000)
+    assert good in result.completeness.valid_entities
+    assert bad in result.invalid_entities
+    vae = result.entity_values[bad]
+    # window 0 forced from the single sample; windows 1-2 have nothing
+    assert Extrapolation.NO_VALID_EXTRAPOLATION in vae.extrapolations
+
+
+def test_completeness_ratio_gating():
+    agg = _agg(num_windows=2, min_samples=1)
+    for i in range(4):
+        agg.add_sample(_sample(("t1", i), 100, 1.0))
+    agg.add_sample(_sample(("t1", 0), 1100, 1.0))  # only entity 0 in window 1
+    agg.add_sample(_sample(("t1", 0), 2100, 1.0))  # rollout
+    opts = AggregationOptions(min_valid_entity_ratio=0.5,
+                              max_allowed_extrapolations_per_entity=0)
+    result = agg.aggregate(0, 2000, opts)
+    ratios = result.completeness.valid_entity_ratio_by_window
+    assert ratios[0] == 1.0
+    assert ratios[1] == 0.25
+    assert result.valid_windows == [0]
+
+
+def test_generation_bumps_on_rollout_and_retention():
+    agg = _agg()
+    g0 = agg.generation
+    agg.add_sample(_sample(("t1", 0), 100, 1.0))
+    agg.add_sample(_sample(("t1", 0), 1100, 1.0))
+    assert agg.generation > g0
+    g1 = agg.generation
+    agg.retain_entities({("t1", 99)})
+    assert agg.generation > g1
+    assert agg.all_entities() == set()
+
+
+def test_min_valid_windows_enforced():
+    import pytest
+    from cruise_control_tpu.core.aggregator import NotEnoughValidWindowsError
+    agg = _agg(num_windows=4, min_samples=1)
+    agg.add_sample(_sample(("t1", 0), 100, 1.0))
+    agg.add_sample(_sample(("t1", 0), 1100, 1.0))  # one rolled-out window
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(0, 2000, AggregationOptions(min_valid_windows=5))
+    with pytest.raises(NotEnoughValidWindowsError):
+        _agg().aggregate(0, 2000)  # empty aggregator, default min 1
+
+
+def test_entity_group_granularity_demotes_group_peers():
+    agg = _agg(num_windows=2, min_samples=1)
+    # t1 has a fully-valid partition 0 and a never-sampled partition 1; t2 is clean
+    for w in range(3):
+        agg.add_sample(_sample(("t1", 0), w * WINDOW_MS + 100, 1.0))
+        agg.add_sample(_sample(("t2", 0), w * WINDOW_MS + 100, 1.0))
+    agg.add_sample(_sample(("t1", 1), 100, 1.0))
+    agg.add_sample(MetricSample(entity=("t1", 1), sample_time_ms=2100, values={0: 1.0}))
+    opts = AggregationOptions(granularity=AggregationGranularity.ENTITY_GROUP,
+                              max_allowed_extrapolations_per_entity=0)
+    result = agg.aggregate(0, 2000, opts)
+    assert ("t1", 1) in result.invalid_entities
+    # the valid partition of t1 is demoted with its group...
+    assert ("t1", 0) in result.invalid_entities
+    assert ("t1", 0) not in result.completeness.valid_entities
+    # ...but t2 is untouched
+    assert ("t2", 0) in result.completeness.valid_entities
+
+
+def test_old_sample_rejected():
+    agg = _agg(num_windows=2)
+    agg.add_sample(_sample(("t1", 0), 10_000, 1.0))
+    assert not agg.add_sample(_sample(("t1", 0), 1_000, 1.0))

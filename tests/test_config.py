@@ -1,0 +1,99 @@
+"""Config framework tests (ref core ConfigDef/AbstractConfig test coverage)."""
+
+import pytest
+
+from cruise_control_tpu.core.config import (AbstractConfig, ConfigDef, ConfigException,
+                                            ConfigType, Importance, Password, Range,
+                                            ValidString)
+
+
+def _def():
+    return (ConfigDef()
+            .define("a.int", ConfigType.INT, 5, Range.at_least(0))
+            .define("b.double", ConfigType.DOUBLE, 1.1, Range.between(0, 10))
+            .define("c.list", ConfigType.LIST, "x,y")
+            .define("d.bool", ConfigType.BOOLEAN, False)
+            .define("e.string", ConfigType.STRING, "hello", ValidString.in_("hello", "bye"))
+            .define("f.required", ConfigType.LONG)
+            .define("g.pass", ConfigType.PASSWORD, "secret"))
+
+
+def test_defaults_and_parsing():
+    cfg = AbstractConfig(_def(), {"f.required": "42"})
+    assert cfg.get_int("a.int") == 5
+    assert cfg.get_double("b.double") == 1.1
+    assert cfg.get_list("c.list") == ["x", "y"]
+    assert cfg.get_boolean("d.bool") is False
+    assert cfg.get_long("f.required") == 42
+    assert cfg.get_password("g.pass") == Password("secret")
+    assert "secret" not in repr(cfg.get_password("g.pass"))
+
+
+def test_string_coercion():
+    cfg = AbstractConfig(_def(), {"f.required": "42", "a.int": " 7 ",
+                                  "d.bool": "TRUE", "c.list": "p, q ,r"})
+    assert cfg.get_int("a.int") == 7
+    assert cfg.get_boolean("d.bool") is True
+    assert cfg.get_list("c.list") == ["p", "q", "r"]
+
+
+def test_missing_required():
+    with pytest.raises(ConfigException, match="f.required"):
+        AbstractConfig(_def(), {})
+
+
+def test_validators():
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {"f.required": 1, "a.int": -1})
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {"f.required": 1, "e.string": "nope"})
+    with pytest.raises(ConfigException):
+        AbstractConfig(_def(), {"f.required": "not-a-number"})
+
+
+def test_unknown_rejected_when_strict():
+    with pytest.raises(ConfigException, match="zzz"):
+        AbstractConfig(_def(), {"f.required": 1, "zzz": 1}, allow_unknown=False)
+
+
+def test_properties_file_java_semantics(tmp_path):
+    from cruise_control_tpu.core.config import load_properties_file
+    f = tmp_path / "test.properties"
+    f.write_text("# hash comment\n! bang comment\n"
+                 "someCamelKey=MixedCase\n"
+                 "colon.sep: value2\n"
+                 "spaced = v \n"
+                 "continued=a,\\\n   b\n"
+                 "flag\n")
+    props = load_properties_file(str(f))
+    assert props["someCamelKey"] == "MixedCase"   # case preserved
+    assert props["colon.sep"] == "value2"
+    assert props["spaced"] == "v"
+    assert props["continued"] == "a,b"
+    assert props["flag"] == ""
+    assert len(props) == 5
+
+
+def test_reference_properties_parse():
+    from cruise_control_tpu.core.config import load_properties_file
+    props = load_properties_file("/root/reference/config/cruisecontrol.properties")
+    assert props["proposal.expiration.ms"] == "60000"
+    assert props["cpu.balance.threshold"] == "1.1"
+
+
+class _Plugin:
+    def __init__(self):
+        self.configured = None
+
+    def configure(self, config):
+        self.configured = config
+
+
+def test_get_configured_instance():
+    definition = (ConfigDef()
+                  .define("plugin.class", ConfigType.CLASS,
+                          f"{__name__}._Plugin"))
+    cfg = AbstractConfig(definition, {})
+    instance = cfg.get_configured_instance("plugin.class", extra_key=3)
+    assert isinstance(instance, _Plugin)
+    assert instance.configured["extra_key"] == 3

@@ -1,0 +1,170 @@
+"""FlatClusterModel tests: flattening, reductions, moves, diff.
+
+The fixtures mirror the reference's DeterministicCluster small-model style
+(test/.../common/DeterministicCluster.java): hand-built clusters with exact
+loads so every reduction is checkable by hand.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.model import (BrokerSpec, ClusterSpec, PartitionSpec,
+                                      Moves, MOVE_INTER_BROKER, MOVE_LEADERSHIP,
+                                      flatten_spec)
+from cruise_control_tpu.model.flat import (apply_moves, broker_leader_counts,
+                                           broker_potential_nw_out,
+                                           broker_replica_counts,
+                                           broker_utilization, leader_bytes_in,
+                                           sanity_check,
+                                           topic_broker_leader_counts,
+                                           topic_broker_replica_counts)
+from cruise_control_tpu.model.proposals import diff_proposals
+from cruise_control_tpu.model.stats import cluster_stats, stats_summary
+
+
+def small_cluster():
+    """3 brokers in 2 racks, 3 partitions — like DeterministicCluster.smallClusterModel."""
+    spec = ClusterSpec(
+        brokers=[
+            BrokerSpec(0, rack="r0", capacity=(100, 100, 100, 1000)),
+            BrokerSpec(1, rack="r0", capacity=(100, 100, 100, 1000)),
+            BrokerSpec(2, rack="r1", capacity=(100, 100, 100, 1000)),
+        ],
+        partitions=[
+            PartitionSpec("A", 0, replicas=(0, 1), leader_load=(10, 20, 30, 40),
+                          follower_load=(5, 20, 0, 40)),
+            PartitionSpec("A", 1, replicas=(1, 2), leader_load=(8, 16, 24, 32),
+                          follower_load=(4, 16, 0, 32)),
+            PartitionSpec("B", 0, replicas=(2, 0), leader_load=(6, 12, 18, 24),
+                          follower_load=(3, 12, 0, 24)),
+        ],
+    )
+    return flatten_spec(spec, partition_pad_multiple=4, broker_pad_multiple=4)
+
+
+def test_flatten_shapes_and_sanity():
+    model, meta = small_cluster()
+    assert model.replica_broker.shape == (4, 2)
+    assert model.broker_capacity.shape == (4, 4)
+    assert meta.num_brokers == 3 and meta.num_partitions == 3
+    assert meta.racks == ["r0", "r1"]
+    issues = sanity_check(model)
+    assert all(v == 0 for v in issues.values()), issues
+
+
+def test_broker_utilization_exact():
+    model, _ = small_cluster()
+    util = np.asarray(broker_utilization(model))
+    # broker 0: leader A-0 (10,20,30,40) + follower B-0 (3,12,0,24)
+    np.testing.assert_allclose(util[0], [13, 32, 30, 64])
+    # broker 1: follower A-0 (5,20,0,40) + leader A-1 (8,16,24,32)
+    np.testing.assert_allclose(util[1], [13, 36, 24, 72])
+    # broker 2: follower A-1 (4,16,0,32) + leader B-0 (6,12,18,24)
+    np.testing.assert_allclose(util[2], [10, 28, 18, 56])
+    np.testing.assert_allclose(util[3], 0)  # padding row
+
+
+def test_counts_and_potential_out():
+    model, _ = small_cluster()
+    np.testing.assert_array_equal(np.asarray(broker_replica_counts(model))[:3], [2, 2, 2])
+    np.testing.assert_array_equal(np.asarray(broker_leader_counts(model))[:3], [1, 1, 1])
+    pot = np.asarray(broker_potential_nw_out(model))
+    # broker 0 hosts A-0 (leader nw_out 30) + B-0 follower (leader nw_out 18)
+    np.testing.assert_allclose(pot[:3], [48, 54, 42])
+    lbi = np.asarray(leader_bytes_in(model))
+    np.testing.assert_allclose(lbi[:3], [20, 16, 12])
+
+
+def test_topic_broker_counts():
+    model, meta = small_cluster()
+    counts = np.asarray(topic_broker_replica_counts(model, meta.num_topics))
+    # topic A on brokers 0,1 (p0) and 1,2 (p1)
+    np.testing.assert_array_equal(counts[0][:3], [1, 2, 1])
+    np.testing.assert_array_equal(counts[1][:3], [1, 0, 1])
+    leaders = np.asarray(topic_broker_leader_counts(model, meta.num_topics))
+    np.testing.assert_array_equal(leaders[0][:3], [1, 1, 0])
+    np.testing.assert_array_equal(leaders[1][:3], [0, 0, 1])
+
+
+def test_apply_inter_broker_move():
+    model, meta = small_cluster()
+    # move A-0 follower (slot 1, broker 1) -> broker 2
+    moves = Moves(partition=jnp.array([0], jnp.int32), slot=jnp.array([1], jnp.int32),
+                  destination=jnp.array([2], jnp.int32),
+                  kind=jnp.array([MOVE_INTER_BROKER], jnp.int32))
+    moved = apply_moves(model, moves)
+    rb = np.asarray(moved.replica_broker)
+    assert rb[0, 1] == 2 and rb[0, 0] == 0
+    util = np.asarray(broker_utilization(moved))
+    np.testing.assert_allclose(util[1], [8, 16, 24, 32])       # lost follower A-0
+    np.testing.assert_allclose(util[2], [15, 48, 18, 96])      # gained it
+    assert all(v == 0 for v in sanity_check(moved).values())
+
+
+def test_apply_leadership_move():
+    model, _ = small_cluster()
+    moves = Moves(partition=jnp.array([0], jnp.int32), slot=jnp.array([1], jnp.int32),
+                  destination=jnp.array([0], jnp.int32),
+                  kind=jnp.array([MOVE_LEADERSHIP], jnp.int32))
+    moved = apply_moves(model, moves)
+    rb = np.asarray(moved.replica_broker)
+    assert rb[0, 0] == 1 and rb[0, 1] == 0   # swapped
+    util = np.asarray(broker_utilization(moved))
+    # broker1 now leads A-0 and A-1: (10+8, 20+16, 30+24, 40+32)
+    np.testing.assert_allclose(util[1], [18, 36, 54, 72])
+
+
+def test_padding_moves_are_noops():
+    model, _ = small_cluster()
+    moves = Moves.empty(8)
+    moved = apply_moves(model, moves)
+    np.testing.assert_array_equal(np.asarray(moved.replica_broker),
+                                  np.asarray(model.replica_broker))
+
+
+def test_diff_proposals():
+    model, meta = small_cluster()
+    moves = Moves(partition=jnp.array([0, 1], jnp.int32),
+                  slot=jnp.array([1, 1], jnp.int32),
+                  destination=jnp.array([2, 0], jnp.int32),
+                  kind=jnp.array([MOVE_INTER_BROKER, MOVE_LEADERSHIP], jnp.int32))
+    moved = apply_moves(model, moves)
+    proposals = {(p.topic, p.partition): p for p in diff_proposals(model, moved, meta)}
+    assert proposals[("A", 0)].new_replicas == (0, 2)
+    assert proposals[("A", 0)].replicas_to_add == (2,)
+    assert proposals[("A", 0)].replicas_to_remove == (1,)
+    assert proposals[("A", 1)].new_replicas == (2, 1)
+    assert proposals[("A", 1)].has_leader_action
+    assert not proposals[("A", 1)].has_replica_action
+
+
+def test_cluster_stats():
+    model, _ = small_cluster()
+    summary = stats_summary(model)
+    assert summary["numAliveBrokers"] == 3
+    assert summary["numReplicas"] == 6
+    assert summary["numLeaders"] == 3
+    np.testing.assert_allclose(summary["resources"]["CPU"]["avg"], 12.0)
+    np.testing.assert_allclose(summary["resources"]["CPU"]["max"], 13.0)
+    # Regression: broker-axis masking must not alias the resource axis when
+    # the padded broker count happens to equal NUM_RESOURCES.
+    np.testing.assert_allclose(summary["resources"]["DISK"]["avg"], 64.0)
+    np.testing.assert_allclose(summary["resources"]["NW_OUT"]["min"], 18.0)
+
+
+def test_offline_replica_tracking():
+    spec = ClusterSpec(
+        brokers=[BrokerSpec(0, rack="r0"), BrokerSpec(1, rack="r1", alive=False)],
+        partitions=[PartitionSpec("A", 0, replicas=(0, 1), leader_load=(1, 1, 1, 1),
+                                  offline_replicas=(1,))],
+    )
+    model, _ = flatten_spec(spec, partition_pad_multiple=2, broker_pad_multiple=2)
+    assert bool(model.replica_offline[0, 1])
+    moves = Moves(partition=jnp.array([0], jnp.int32), slot=jnp.array([1], jnp.int32),
+                  destination=jnp.array([0], jnp.int32),
+                  kind=jnp.array([MOVE_INTER_BROKER], jnp.int32))
+    # moving the offline replica clears its offline flag
+    moved = apply_moves(model, moves)
+    assert not bool(moved.replica_offline[0, 1])
