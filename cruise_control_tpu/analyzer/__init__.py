@@ -1,0 +1,29 @@
+"""Analyzer — the TPU-native rebuild of Cruise Control's goal optimizer.
+
+Reference layer: ``cruise-control/.../analyzer/`` (``GoalOptimizer.java``,
+``goals/*``). The greedy per-replica search is replaced by batched candidate
+scoring on device; see :mod:`engine` for the search loop and :mod:`goals`
+for the goal catalog.
+"""
+
+from .constraint import BalancingConstraint, SearchConfig
+from .goals import (GOAL_REGISTRY, CapacityGoal, GoalKernel,
+                    LeaderBytesInDistributionGoal,
+                    LeaderReplicaDistributionGoal,
+                    PotentialNwOutGoal, PreferredLeaderElectionGoal,
+                    RackAwareGoal, ReplicaCapacityGoal,
+                    ReplicaDistributionGoal, ResourceDistributionGoal,
+                    TopicReplicaDistributionGoal, default_goals, goals_by_name)
+from .optimizer import GoalResult, OptimizerResult, TpuGoalOptimizer
+from .options import OptimizationOptions
+
+__all__ = [
+    "BalancingConstraint", "SearchConfig", "GoalKernel", "CapacityGoal",
+    "RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal",
+    "ResourceDistributionGoal", "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal", "PotentialNwOutGoal",
+    "PreferredLeaderElectionGoal", "TopicReplicaDistributionGoal",
+    "default_goals", "goals_by_name", "GOAL_REGISTRY",
+    "TpuGoalOptimizer", "OptimizerResult", "GoalResult",
+    "OptimizationOptions",
+]

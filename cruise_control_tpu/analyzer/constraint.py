@@ -1,0 +1,77 @@
+"""Balancing constraint + search hyper-parameters.
+
+Rebuild of ``analyzer/BalancingConstraint.java`` (ref :350): the per-resource
+balance margins and capacity thresholds every goal kernel reads. Defaults
+mirror ``config/constants/AnalyzerConfig.java`` (balance thresholds 1.10
+``:58-103``, topic replica 3.00/min-gap 2/max-gap 40 ``:112-131``, capacity
+thresholds CPU 0.7 / disk 0.8 / network 0.8 ``:141-169``, max replicas per
+broker 10000 ``:225``).
+
+Unlike the reference (an object threaded through every goal), these are plain
+frozen dataclasses of Python floats: they are *trace-time constants* baked
+into the compiled search kernels, so changing a threshold recompiles (rare)
+while re-running with new loads does not (common).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Tuple
+
+from ..core.resources import Resource
+
+
+@dataclass(frozen=True)
+class BalancingConstraint:
+    # avg * threshold = balance upper limit; avg * (2 - threshold) = lower.
+    resource_balance_threshold: Tuple[float, float, float, float] = (
+        1.10, 1.10, 1.10, 1.10)  # CPU, NW_IN, NW_OUT, DISK
+    replica_balance_threshold: float = 1.10
+    leader_replica_balance_threshold: float = 1.10
+    topic_replica_balance_threshold: float = 3.00
+    topic_replica_balance_min_gap: int = 2
+    topic_replica_balance_max_gap: int = 40
+    # capacity * threshold = usable capacity ceiling.
+    capacity_threshold: Tuple[float, float, float, float] = (
+        0.7, 0.8, 0.8, 0.8)  # CPU, NW_IN, NW_OUT, DISK
+    max_replicas_per_broker: int = 10_000
+    # LeaderBytesInDistributionGoal reuses the NW_IN balance threshold.
+
+    def balance_threshold(self, resource: Resource) -> float:
+        return self.resource_balance_threshold[int(resource)]
+
+    def cap_threshold(self, resource: Resource) -> float:
+        return self.capacity_threshold[int(resource)]
+
+    def with_overrides(self, **kwargs) -> "BalancingConstraint":
+        return replace(self, **kwargs)
+
+
+@dataclass(frozen=True)
+class SearchConfig:
+    """Batched-search hyper-parameters (no reference equivalent — this is the
+    TPU replacement for the greedy loop's implicit schedule).
+
+    Per iteration the engine short-lists ``num_replica_candidates`` replicas
+    (by goal-specific priority, ``lax.top_k`` over the flattened [P, R] grid)
+    and ``num_dest_candidates`` destination brokers, scores the full cross
+    product at once, and applies up to ``apply_per_iter`` non-conflicting
+    improving moves via a sequential re-checked scan.
+    """
+
+    num_replica_candidates: int = 256
+    num_dest_candidates: int = 16
+    apply_per_iter: int = 64
+    max_iters_per_goal: int = 256
+    epsilon: float = 1e-6
+    # Tie-break noise magnitude relative to priority scale (deterministic,
+    # PRNG-keyed; keeps tests reproducible while diversifying candidates).
+    noise_scale: float = 1e-3
+
+    def scaled_for(self, num_partitions: int, num_brokers: int) -> "SearchConfig":
+        """Clamp candidate pool sizes for tiny models (tests, demo clusters)."""
+        k = min(self.num_replica_candidates, max(8, num_partitions))
+        d = min(self.num_dest_candidates, max(2, num_brokers))
+        m = min(self.apply_per_iter, k)
+        return replace(self, num_replica_candidates=k, num_dest_candidates=d,
+                       apply_per_iter=m)

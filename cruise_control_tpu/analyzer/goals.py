@@ -1,0 +1,656 @@
+"""Goal kernels — the reference's goal catalog as vectorized cost functions.
+
+Each reference goal (``analyzer/goals/*.java``) is re-expressed as four
+vectorized functions over the :mod:`state` arrays instead of an imperative
+``rebalanceForBroker`` loop (ref ``AbstractGoal.java:82-135``):
+
+- ``violation(state, ctx)``      -> scalar residual (0 == satisfied), the
+  analog of the goal's success criterion / ``ClusterModelStatsComparator``;
+- ``propose(state, ctx, key)``   -> a batch of candidate actions the goal
+  wants to try (replaces the sorted-replica candidate walks,
+  ``maybeApplyBalancingAction`` ``AbstractGoal.java:230-272``);
+- ``delta(state, ctx, cands)``   -> per-candidate change in the residual
+  (negative = improvement), evaluated incrementally from the two touched
+  broker rows;
+- ``accepts(state, ctx, cands)`` -> per-candidate action acceptance when this
+  goal was already optimized earlier in the chain (ref
+  ``Goal.actionAcceptance`` ``goals/Goal.java:81``) — this is how the
+  reference's "later goals must not violate earlier ones" lexicographic
+  semantics survive batching.
+
+Most goals are instances of one parametric :class:`IntervalGoal` — "keep a
+per-broker metric inside [lower, upper]" — because that is what
+Capacity/Distribution goals all are underneath; only rack-awareness and
+topic-scoped distribution need bespoke kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.resources import Resource
+from ..model.flat import MOVE_INTER_BROKER, MOVE_LEADERSHIP
+from .constraint import BalancingConstraint, SearchConfig
+from .state import (Candidates, SearchContext, SearchState, concat_candidates,
+                    make_leadership_candidates, make_move_candidates,
+                    metric_deltas, metric_values,
+                    METRIC_LEADER_COUNT, METRIC_LEADER_NW_IN,
+                    METRIC_POTENTIAL_NW_OUT, METRIC_REPLICA_COUNT)
+
+_BIG = 1e12
+_NEG = -jnp.inf
+
+
+def _noise(key, shape, scale):
+    return scale * jax.random.uniform(key, shape)
+
+
+def _normalized(w: jax.Array) -> jax.Array:
+    """Scale weights into [-1, 1] so they compose with the _BIG tier offsets
+    without the tie-break noise (absolute magnitude ~cfg.noise_scale)
+    swamping them."""
+    return w / (jnp.abs(w).max() + 1.0)
+
+
+def _top_replica_dest_grid(state: SearchState, ctx: SearchContext, key,
+                           cfg: SearchConfig, replica_priority: jax.Array,
+                           dest_priority: jax.Array) -> Candidates:
+    """Shared candidate generator: top-K replicas x top-D destinations.
+
+    ``replica_priority`` is [P, R] with -inf for non-candidates;
+    ``dest_priority`` is [B1] with -inf for barred destinations. Offline
+    replicas always float to the top (self-healing must-move semantics, ref
+    ``Replica.isCurrentOffline`` handling in every goal's
+    ``brokersToBalance``).
+    """
+    P, R = replica_priority.shape
+    K = min(cfg.num_replica_candidates, P * R)
+    D = min(cfg.num_dest_candidates, dest_priority.shape[0])
+    krep, kdst = jax.random.split(key)
+
+    rp = jnp.where(ctx.movable, replica_priority, _NEG)
+    # Offline replicas outrank every goal-specific priority, even when the
+    # goal itself would not have short-listed them (self-healing must-move)
+    # or the topic is excluded from rebalancing.
+    rp = jnp.where(state.offline,
+                   2.0 * _BIG + jnp.maximum(jnp.where(jnp.isfinite(rp), rp,
+                                                      0.0), 0.0), rp)
+    # Priorities are tier offsets (multiples of _BIG) plus normalized [-1, 1]
+    # weights; absolute noise_scale-sized noise breaks ties within a tier
+    # without reordering the weights.
+    rp = rp + jnp.where(jnp.isfinite(rp),
+                        _noise(krep, rp.shape, cfg.noise_scale), 0.0)
+    rvals, ridx = jax.lax.top_k(rp.reshape(-1), K)
+    p, r = ridx // R, ridx % R
+
+    dp = jnp.where(ctx.dest_allowed, dest_priority, _NEG)
+    dp = dp + jnp.where(jnp.isfinite(dp),
+                        _noise(kdst, dp.shape, cfg.noise_scale), 0.0)
+    dvals, didx = jax.lax.top_k(dp, D)
+
+    pg = jnp.repeat(p, D)
+    rg = jnp.repeat(r, D)
+    dg = jnp.tile(didx, K)
+    valid = jnp.repeat(jnp.isfinite(rvals), D) & jnp.tile(jnp.isfinite(dvals), K)
+    return make_move_candidates(state, ctx, pg, rg, dg.astype(jnp.int32), valid)
+
+
+def _top_leadership(state: SearchState, ctx: SearchContext, key,
+                    cfg: SearchConfig, priority: jax.Array) -> Candidates:
+    """Top-K leadership-transfer candidates from a [P, R] priority grid
+    (slot r>0 becoming leader)."""
+    P, R = priority.shape
+    K = min(cfg.num_replica_candidates, P * R)
+    slot_ok = (jnp.arange(R)[None, :] > 0) & ctx.leadership_movable[:, None]
+    pr = jnp.where(slot_ok, priority, _NEG)
+    pr = pr + jnp.where(jnp.isfinite(pr),
+                        _noise(key, pr.shape, cfg.noise_scale), 0.0)
+    vals, idx = jax.lax.top_k(pr.reshape(-1), K)
+    p, r = idx // R, idx % R
+    return make_leadership_candidates(state, ctx, p, r, jnp.isfinite(vals))
+
+
+class GoalKernel:
+    """Base goal. Subclasses are stateless; all data flows through args."""
+
+    name: str = "goal"
+    hard: bool = False
+    uses_topic_counts: bool = False
+
+    def violation(self, state: SearchState, ctx: SearchContext) -> jax.Array:
+        raise NotImplementedError
+
+    def propose(self, state: SearchState, ctx: SearchContext, key,
+                cfg: SearchConfig) -> Candidates:
+        raise NotImplementedError
+
+    def delta(self, state: SearchState, ctx: SearchContext,
+              c: Candidates) -> jax.Array:
+        raise NotImplementedError
+
+    def accepts(self, state: SearchState, ctx: SearchContext,
+                c: Candidates) -> jax.Array:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<{type(self).__name__} {self.name}>"
+
+
+class IntervalGoal(GoalKernel):
+    """Keep ``metric[b]`` within [lower, upper] on every alive broker.
+
+    Parametrization covers (ref classes in analyzer/goals/):
+    - CapacityGoal family: upper = capacity * threshold, no lower bound
+      (``CapacityGoal.java``);
+    - ResourceDistributionGoal family: upper/lower = avg * (t)/(2 - t)
+      (``ResourceDistributionGoal.java:55``);
+    - Replica/LeaderReplica count distribution, PotentialNwOut,
+      LeaderBytesIn — same shape, different metric/bounds.
+    """
+
+    #: 'replica' | 'leadership' | 'both'
+    actions: str = "replica"
+    #: when True the goal only caps the upper side (capacity-style)
+    upper_only: bool = False
+
+    def __init__(self, name: str, metric, *, hard: bool,
+                 constraint: BalancingConstraint):
+        self.name = name
+        self.metric = metric
+        self.hard = hard
+        self.constraint = constraint
+
+    # -- bounds ----------------------------------------------------------
+    def bounds(self, state: SearchState, ctx: SearchContext):
+        """Return (lower[B1], upper[B1]) arrays (broadcast scalars ok)."""
+        raise NotImplementedError
+
+    def _avg_bounds(self, state: SearchState, ctx: SearchContext, t: float,
+                    *, integer: bool = False, upper_only: bool = False):
+        """avg-over-alive-brokers bounds: [avg*(2-t), avg*t].
+
+        The total includes load still parked on dead brokers — it has to land
+        on the alive ones, so the steady-state average accounts for it. With
+        ``integer`` the band is widened to at least +-1 unit around the
+        average so integer-count goals stay satisfiable on tiny clusters.
+        """
+        values = metric_values(state, self.metric)
+        total = jnp.where(ctx.broker_valid, values, 0.0).sum()
+        n = jnp.maximum(ctx.broker_alive.sum(), 1)
+        avg = total / n
+        upper = avg * t
+        lower = (jnp.full_like(avg, -jnp.inf) if upper_only
+                 else avg * (2.0 - t))
+        if integer:
+            upper = jnp.maximum(upper, jnp.ceil(avg))
+            if not upper_only:
+                lower = jnp.minimum(lower, jnp.floor(avg))
+        return lower, upper
+
+    # -- shared machinery ------------------------------------------------
+    def _penalty(self, values, lower, upper, alive):
+        over = jnp.maximum(values - upper, 0.0)
+        under = 0.0 if self.upper_only else jnp.maximum(lower - values, 0.0)
+        return jnp.where(alive, over + under, 0.0)
+
+    def violation(self, state, ctx):
+        values = metric_values(state, self.metric)
+        lower, upper = self.bounds(state, ctx)
+        return self._penalty(values, lower, upper, ctx.broker_alive).sum()
+
+    def delta(self, state, ctx, c):
+        values = metric_values(state, self.metric)
+        lower, upper = self.bounds(state, ctx)
+        lo = jnp.broadcast_to(lower, values.shape)
+        up = jnp.broadcast_to(upper, values.shape)
+        d_src, d_dst = metric_deltas(c, self.metric)
+        before = (self._penalty(values[c.src], lo[c.src], up[c.src],
+                                ctx.broker_alive[c.src])
+                  + self._penalty(values[c.dst], lo[c.dst], up[c.dst],
+                                  ctx.broker_alive[c.dst]))
+        after = (self._penalty(values[c.src] + d_src, lo[c.src], up[c.src],
+                               ctx.broker_alive[c.src])
+                 + self._penalty(values[c.dst] + d_dst, lo[c.dst], up[c.dst],
+                                 ctx.broker_alive[c.dst]))
+        return after - before
+
+    def accepts(self, state, ctx, c):
+        """Acceptance when previously optimized: destination must stay within
+        the upper limit, or at least remain no more loaded than the source
+        ends up (mirrors ResourceDistributionGoal.actionAcceptance's
+        no-new-violation rule); symmetrically the source must not sink below
+        the lower limit unless it stays above the destination."""
+        values = metric_values(state, self.metric)
+        lower, upper = self.bounds(state, ctx)
+        lo = jnp.broadcast_to(lower, values.shape)
+        up = jnp.broadcast_to(upper, values.shape)
+        d_src, d_dst = metric_deltas(c, self.metric)
+        src_after = values[c.src] + d_src
+        dst_after = values[c.dst] + d_dst
+        # Metric-neutral actions (d == 0, e.g. a leadership transfer judged by
+        # a replica-count goal) are always acceptable: they cannot worsen the
+        # goal even when a broker already violates a bound.
+        dst_ok = ((d_dst <= 0) | (dst_after <= up[c.dst])
+                  | (dst_after <= src_after))
+        if self.upper_only:
+            src_ok = True
+        else:
+            src_ok = ((d_src >= 0) | (src_after >= lo[c.src])
+                      | (src_after >= dst_after))
+        return dst_ok & src_ok
+
+    # -- candidate generation -------------------------------------------
+    def propose(self, state, ctx, key, cfg):
+        values = metric_values(state, self.metric)
+        lower, upper = self.bounds(state, ctx)
+        lo = jnp.broadcast_to(jnp.asarray(lower, values.dtype), values.shape)
+        up = jnp.broadcast_to(jnp.asarray(upper, values.dtype), values.shape)
+        alive = ctx.broker_alive
+        excess = jnp.where(alive, jnp.maximum(values - up, 0.0), 0.0)
+        deficit = (jnp.zeros_like(values) if self.upper_only else
+                   jnp.where(alive, jnp.maximum(lo - values, 0.0), 0.0))
+        any_deficit = deficit.sum() > 0
+        # Load still parked on dead/invalid brokers also counts as "excess":
+        # it must drain to alive brokers (self-healing).
+        excess = jnp.where(alive, excess, values)
+
+        parts = []
+        if self.actions in ("replica", "both"):
+            w = _normalized(self._replica_weight(state, ctx))       # [P, R]
+            src_b = state.rb                                        # [P, R]
+            src_excess = excess[src_b]
+            src_above_avg = values[src_b] > ((lo[src_b] + up[src_b]) * 0.5)
+            prio = jnp.where(src_excess > 0.0, _BIG + w,
+                             jnp.where(any_deficit & src_above_avg, w, _NEG))
+            if self.metric[0] in ("leaders", "leader_nw_in"):
+                # Only relocating the *leader* replica (slot 0) changes
+                # leader-scoped metrics; follower moves are dead weight.
+                R = state.rb.shape[1]
+                prio = jnp.where((jnp.arange(R) == 0)[None, :], prio, _NEG)
+            dest_prio = (jnp.where(deficit > 0.0, _BIG, 0.0)
+                         + _normalized(up - values))
+            kg, key = jax.random.split(key)
+            parts.append(_top_replica_dest_grid(state, ctx, kg, cfg, prio,
+                                                dest_prio))
+        if self.actions in ("leadership", "both"):
+            # moving leadership off slot-0's broker to the slot's broker
+            src_b = state.rb[:, 0:1]                                # [P, 1]
+            dst_b = state.rb                                        # [P, R]
+            gain = _normalized(excess)[src_b] + _normalized(deficit)[dst_b]
+            prio = jnp.where(excess[src_b] > 0.0, gain, _NEG)
+            kl, key = jax.random.split(key)
+            parts.append(_top_leadership(state, ctx, kl, cfg, prio))
+        out = parts[0]
+        for extra in parts[1:]:
+            out = concat_candidates(out, extra)
+        return out
+
+    def _replica_weight(self, state: SearchState, ctx: SearchContext):
+        """[P, R] preference among movable replicas on source brokers."""
+        which, res = self.metric
+        R = state.rb.shape[1]
+        is_leader = (jnp.arange(R) == 0)[None, :]
+        if which == "util":
+            load = jnp.where(is_leader[..., None],
+                             ctx.leader_load[:, None, :],
+                             ctx.follower_load[:, None, :])
+            return load[..., int(res)]
+        if which == "potential":
+            return jnp.broadcast_to(
+                ctx.leader_load[:, None, Resource.NW_OUT], state.rb.shape)
+        # count-style goals: prefer cheap-to-move (small disk) replicas
+        disk = jnp.where(is_leader[..., None], ctx.leader_load[:, None, :],
+                         ctx.follower_load[:, None, :])[..., Resource.DISK]
+        return -disk
+
+
+class CapacityGoal(IntervalGoal):
+    """Hard cap: util <= capacity * threshold (ref CapacityGoal.java and the
+    four resource-specific subclasses)."""
+
+    upper_only = True
+
+    def __init__(self, resource: Resource, constraint: BalancingConstraint):
+        name = {Resource.CPU: "CpuCapacityGoal",
+                Resource.NW_IN: "NetworkInboundCapacityGoal",
+                Resource.NW_OUT: "NetworkOutboundCapacityGoal",
+                Resource.DISK: "DiskCapacityGoal"}[resource]
+        super().__init__(name, ("util", resource), hard=True,
+                         constraint=constraint)
+        self.resource = resource
+        self.actions = ("both" if resource in (Resource.CPU, Resource.NW_OUT)
+                        else "replica")
+
+    def bounds(self, state, ctx):
+        thr = self.constraint.cap_threshold(self.resource)
+        upper = ctx.broker_capacity[:, int(self.resource)] * thr
+        return jnp.full_like(upper, -jnp.inf), upper
+
+    def accepts(self, state, ctx, c):
+        # Hard semantics: never push a broker above its capacity ceiling
+        # (additions only; removals always fine).
+        values = metric_values(state, self.metric)
+        _, upper = self.bounds(state, ctx)
+        _, d_dst = metric_deltas(c, self.metric)
+        return (d_dst <= 0) | (values[c.dst] + d_dst <= upper[c.dst])
+
+
+class ResourceDistributionGoal(IntervalGoal):
+    """Soft balance: util within avg*(2-t) .. avg*t over alive brokers
+    (ref ResourceDistributionGoal.java:55 + the four UsageDistribution
+    subclasses)."""
+
+    def __init__(self, resource: Resource, constraint: BalancingConstraint):
+        name = {Resource.CPU: "CpuUsageDistributionGoal",
+                Resource.NW_IN: "NetworkInboundUsageDistributionGoal",
+                Resource.NW_OUT: "NetworkOutboundUsageDistributionGoal",
+                Resource.DISK: "DiskUsageDistributionGoal"}[resource]
+        super().__init__(name, ("util", resource), hard=False,
+                         constraint=constraint)
+        self.resource = resource
+        self.actions = ("both" if resource in (Resource.CPU, Resource.NW_OUT)
+                        else "replica")
+
+    def bounds(self, state, ctx):
+        return self._avg_bounds(state, ctx,
+                                self.constraint.balance_threshold(self.resource))
+
+
+class ReplicaCapacityGoal(IntervalGoal):
+    """Hard cap on replica count per broker (ref ReplicaCapacityGoal.java,
+    max.replicas.per.broker AnalyzerConfig.java:225)."""
+
+    upper_only = True
+
+    def __init__(self, constraint: BalancingConstraint):
+        super().__init__("ReplicaCapacityGoal", METRIC_REPLICA_COUNT,
+                         hard=True, constraint=constraint)
+
+    def bounds(self, state, ctx):
+        upper = jnp.full((ctx.broker_capacity.shape[0],),
+                         float(self.constraint.max_replicas_per_broker))
+        return jnp.full_like(upper, -jnp.inf), upper
+
+    def accepts(self, state, ctx, c):
+        values = metric_values(state, self.metric)
+        _, upper = self.bounds(state, ctx)
+        _, d_dst = metric_deltas(c, self.metric)
+        return (d_dst <= 0) | (values[c.dst] + d_dst <= upper[c.dst])
+
+
+class ReplicaDistributionGoal(IntervalGoal):
+    """Soft balance of replica counts (ref ReplicaDistributionGoal.java)."""
+
+    def __init__(self, constraint: BalancingConstraint):
+        super().__init__("ReplicaDistributionGoal", METRIC_REPLICA_COUNT,
+                         hard=False, constraint=constraint)
+
+    def bounds(self, state, ctx):
+        return self._avg_bounds(state, ctx,
+                                self.constraint.replica_balance_threshold,
+                                integer=True)
+
+
+class LeaderReplicaDistributionGoal(IntervalGoal):
+    """Soft balance of leader counts via leadership transfers, falling back
+    to relocating leader replicas (ref LeaderReplicaDistributionGoal.java
+    tries leadership movement first, then leader-replica movement)."""
+
+    actions = "both"
+
+    def __init__(self, constraint: BalancingConstraint):
+        super().__init__("LeaderReplicaDistributionGoal", METRIC_LEADER_COUNT,
+                         hard=False, constraint=constraint)
+
+    def bounds(self, state, ctx):
+        return self._avg_bounds(
+            state, ctx, self.constraint.leader_replica_balance_threshold,
+            integer=True)
+
+
+class LeaderBytesInDistributionGoal(IntervalGoal):
+    """Cap leader bytes-in skew: leader NW_IN <= avg * threshold (ref
+    LeaderBytesInDistributionGoal.java — upper-side only)."""
+
+    actions = "leadership"
+    upper_only = True
+
+    def __init__(self, constraint: BalancingConstraint):
+        super().__init__("LeaderBytesInDistributionGoal", METRIC_LEADER_NW_IN,
+                         hard=False, constraint=constraint)
+
+    def bounds(self, state, ctx):
+        return self._avg_bounds(
+            state, ctx, self.constraint.balance_threshold(Resource.NW_IN),
+            upper_only=True)
+
+
+class PotentialNwOutGoal(IntervalGoal):
+    """Keep potential (all-leaders) NW_OUT under the capacity ceiling (ref
+    PotentialNwOutGoal.java)."""
+
+    upper_only = True
+
+    def __init__(self, constraint: BalancingConstraint):
+        super().__init__("PotentialNwOutGoal", METRIC_POTENTIAL_NW_OUT,
+                         hard=False, constraint=constraint)
+
+    def bounds(self, state, ctx):
+        thr = self.constraint.cap_threshold(Resource.NW_OUT)
+        upper = ctx.broker_capacity[:, int(Resource.NW_OUT)] * thr
+        return jnp.full_like(upper, -jnp.inf), upper
+
+
+class RackAwareGoal(GoalKernel):
+    """No two replicas of a partition on the same rack (ref
+    RackAwareGoal.java; hard)."""
+
+    name = "RackAwareGoal"
+    hard = True
+
+    def _dup_mask(self, state: SearchState, ctx: SearchContext) -> jax.Array:
+        """bool[P, R] — replica shares a rack with a lower slot's replica."""
+        racks = ctx.broker_rack[state.rb]                        # [P, R]
+        valid = state.rb < ctx.num_brokers_padded
+        R = racks.shape[1]
+        same = (racks[:, :, None] == racks[:, None, :])          # [P, R, R]
+        lower = jnp.tril(jnp.ones((R, R), bool), k=-1)[None]
+        both = valid[:, :, None] & valid[:, None, :]
+        return (same & lower & both).any(axis=-1)                # dup vs lower slot
+
+    def violation(self, state, ctx):
+        return self._dup_mask(state, ctx).sum().astype(jnp.float32)
+
+    def propose(self, state, ctx, key, cfg):
+        dup = self._dup_mask(state, ctx)
+        prio = jnp.where(dup, 1.0, _NEG)
+        # Prefer emptier destinations (fewer replicas) to also aid balance.
+        dest_prio = _normalized(-state.replica_count.astype(jnp.float32))
+        return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
+
+    def _dup_change(self, state, ctx, c):
+        """(before, after) duplicate status of the candidate replica."""
+        racks = ctx.broker_rack[state.rb[c.p]]                   # [N, R]
+        valid = state.rb[c.p] < ctx.num_brokers_padded
+        R = racks.shape[-1]
+        slots = jnp.arange(R)
+        others = valid & (slots != c.r[..., None])
+        my_rack = ctx.broker_rack[state.rb[c.p, c.r]]
+        dst_rack = ctx.broker_rack[c.dst]
+        before = ((racks == my_rack[..., None]) & others).any(axis=-1)
+        after = ((racks == dst_rack[..., None]) & others).any(axis=-1)
+        return before, after
+
+    def delta(self, state, ctx, c):
+        before, after = self._dup_change(state, ctx, c)
+        is_move = c.kind == MOVE_INTER_BROKER
+        d = after.astype(jnp.float32) - before.astype(jnp.float32)
+        return jnp.where(is_move, d, 0.0)
+
+    def accepts(self, state, ctx, c):
+        before, after = self._dup_change(state, ctx, c)
+        is_move = c.kind == MOVE_INTER_BROKER
+        return jnp.where(is_move, ~after | before, True)
+
+
+class TopicReplicaDistributionGoal(GoalKernel):
+    """Per-topic replica counts balanced across alive brokers (ref
+    TopicReplicaDistributionGoal.java; gap clamping per
+    AnalyzerConfig.java:112-131)."""
+
+    name = "TopicReplicaDistributionGoal"
+    hard = False
+    uses_topic_counts = True
+
+    def __init__(self, constraint: BalancingConstraint):
+        self.constraint = constraint
+
+    def _bounds(self, state: SearchState, ctx: SearchContext):
+        tc = state.topic_counts                                  # [T, B1]
+        total = jnp.where(ctx.broker_valid[None, :], tc, 0).sum(axis=1)
+        n = jnp.maximum(ctx.broker_alive.sum(), 1)
+        avg = total.astype(jnp.float32) / n                      # [T]
+        t = self.constraint.topic_replica_balance_threshold
+        gap = jnp.clip(avg * (t - 1.0),
+                       float(self.constraint.topic_replica_balance_min_gap),
+                       float(self.constraint.topic_replica_balance_max_gap))
+        return jnp.maximum(avg - gap, 0.0), avg + gap            # [T], [T]
+
+    def _penalty(self, counts, lower, upper, alive):
+        c = counts.astype(jnp.float32)
+        pen = jnp.maximum(c - upper, 0.0) + jnp.maximum(lower - c, 0.0)
+        return jnp.where(alive, pen, 0.0)
+
+    def violation(self, state, ctx):
+        lower, upper = self._bounds(state, ctx)
+        pen = self._penalty(state.topic_counts, lower[:, None], upper[:, None],
+                            ctx.broker_alive[None, :])
+        return pen.sum()
+
+    def propose(self, state, ctx, key, cfg):
+        lower, upper = self._bounds(state, ctx)
+        tc = state.topic_counts.astype(jnp.float32)              # [T, B1]
+        excess = jnp.where(ctx.broker_alive[None, :],
+                           jnp.maximum(tc - upper[:, None], 0.0), tc)
+        t_of_p = ctx.partition_topic                             # [P]
+        src_excess = excess[t_of_p[:, None], state.rb]           # [P, R]
+        prio = jnp.where(src_excess > 0.0, _normalized(src_excess), _NEG)
+        deficit = jnp.where(ctx.broker_alive[None, :],
+                            jnp.maximum(lower[:, None] - tc, 0.0), 0.0)
+        # Destination shortlist is topic-agnostic ([B1]); per-topic fit is
+        # resolved by delta scoring over the K x D grid.
+        dest_prio = (_normalized(deficit.sum(axis=0))
+                     + 1e-3 * _normalized(-state.replica_count.astype(jnp.float32)))
+        return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
+
+    def delta(self, state, ctx, c):
+        lower, upper = self._bounds(state, ctx)
+        t = ctx.partition_topic[c.p]
+        lo, up = lower[t], upper[t]
+        src_c = state.topic_counts[t, c.src]
+        dst_c = state.topic_counts[t, c.dst]
+        alive_s, alive_d = ctx.broker_alive[c.src], ctx.broker_alive[c.dst]
+        is_move = (c.kind == MOVE_INTER_BROKER).astype(jnp.int32)
+        before = (self._penalty(src_c, lo, up, alive_s)
+                  + self._penalty(dst_c, lo, up, alive_d))
+        after = (self._penalty(src_c - is_move, lo, up, alive_s)
+                 + self._penalty(dst_c + is_move, lo, up, alive_d))
+        return after - before
+
+    def accepts(self, state, ctx, c):
+        lower, upper = self._bounds(state, ctx)
+        t = ctx.partition_topic[c.p]
+        is_move = c.kind == MOVE_INTER_BROKER
+        dst_after = state.topic_counts[t, c.dst] + 1
+        src_after = state.topic_counts[t, c.src] - 1
+        ok = (dst_after <= upper[t]) | (dst_after <= src_after)
+        return jnp.where(is_move, ok, True)
+
+
+class PreferredLeaderElectionGoal(GoalKernel):
+    """Make the original first replica the leader again (ref
+    PreferredLeaderElectionGoal.java — used by DemoteBroker and the
+    kafka-assigner mode)."""
+
+    name = "PreferredLeaderElectionGoal"
+    hard = False
+
+    def violation(self, state, ctx):
+        leader_not_preferred = ctx.partition_valid & (state.pos[:, 0] != 0)
+        return leader_not_preferred.sum().astype(jnp.float32)
+
+    def propose(self, state, ctx, key, cfg):
+        # Candidate: the slot currently holding the preferred replica
+        # (pos == 0) for partitions whose leader is not preferred.
+        prio = jnp.where((state.pos == 0) & (state.pos[:, 0:1] != 0),
+                         1.0, _NEG)
+        return _top_leadership(state, ctx, key, cfg, prio)
+
+    def delta(self, state, ctx, c):
+        is_lead = c.kind == MOVE_LEADERSHIP
+        fixes = (state.pos[c.p, c.r] == 0) & (state.pos[c.p, 0] != 0)
+        breaks = state.pos[c.p, 0] == 0
+        return jnp.where(is_lead,
+                         jnp.where(fixes, -1.0, jnp.where(breaks, 1.0, 0.0)),
+                         0.0)
+
+    def accepts(self, state, ctx, c):
+        return jnp.ones(c.p.shape, bool)
+
+
+def default_goals(constraint: BalancingConstraint | None = None
+                  ) -> list[GoalKernel]:
+    """The reference's default goal chain in priority order
+    (``config/cruisecontrol.properties:96``)."""
+    cst = constraint or BalancingConstraint()
+    return [
+        RackAwareGoal(),
+        ReplicaCapacityGoal(cst),
+        CapacityGoal(Resource.DISK, cst),
+        CapacityGoal(Resource.NW_IN, cst),
+        CapacityGoal(Resource.NW_OUT, cst),
+        CapacityGoal(Resource.CPU, cst),
+        ReplicaDistributionGoal(cst),
+        PotentialNwOutGoal(cst),
+        ResourceDistributionGoal(Resource.DISK, cst),
+        ResourceDistributionGoal(Resource.NW_IN, cst),
+        ResourceDistributionGoal(Resource.NW_OUT, cst),
+        ResourceDistributionGoal(Resource.CPU, cst),
+        TopicReplicaDistributionGoal(cst),
+        LeaderReplicaDistributionGoal(cst),
+        LeaderBytesInDistributionGoal(cst),
+    ]
+
+
+GOAL_REGISTRY = {
+    "RackAwareGoal": lambda cst: RackAwareGoal(),
+    "ReplicaCapacityGoal": ReplicaCapacityGoal,
+    "DiskCapacityGoal": lambda cst: CapacityGoal(Resource.DISK, cst),
+    "NetworkInboundCapacityGoal": lambda cst: CapacityGoal(Resource.NW_IN, cst),
+    "NetworkOutboundCapacityGoal": lambda cst: CapacityGoal(Resource.NW_OUT, cst),
+    "CpuCapacityGoal": lambda cst: CapacityGoal(Resource.CPU, cst),
+    "ReplicaDistributionGoal": ReplicaDistributionGoal,
+    "PotentialNwOutGoal": PotentialNwOutGoal,
+    "DiskUsageDistributionGoal": lambda cst: ResourceDistributionGoal(Resource.DISK, cst),
+    "NetworkInboundUsageDistributionGoal": lambda cst: ResourceDistributionGoal(Resource.NW_IN, cst),
+    "NetworkOutboundUsageDistributionGoal": lambda cst: ResourceDistributionGoal(Resource.NW_OUT, cst),
+    "CpuUsageDistributionGoal": lambda cst: ResourceDistributionGoal(Resource.CPU, cst),
+    "TopicReplicaDistributionGoal": TopicReplicaDistributionGoal,
+    "LeaderReplicaDistributionGoal": LeaderReplicaDistributionGoal,
+    "LeaderBytesInDistributionGoal": LeaderBytesInDistributionGoal,
+    "PreferredLeaderElectionGoal": lambda cst: PreferredLeaderElectionGoal(),
+}
+
+
+def goals_by_name(names: list[str],
+                  constraint: BalancingConstraint | None = None
+                  ) -> list[GoalKernel]:
+    cst = constraint or BalancingConstraint()
+    out = []
+    for n in names:
+        short = n.rsplit(".", 1)[-1]
+        if short not in GOAL_REGISTRY:
+            raise ValueError(f"unknown goal {n!r}")
+        out.append(GOAL_REGISTRY[short](cst))
+    return out

@@ -1,0 +1,172 @@
+"""Host-side optimizer orchestration (ref ``analyzer/GoalOptimizer.java``).
+
+``TpuGoalOptimizer.optimize`` is the rebuild of
+``GoalOptimizer.optimizations`` (``GoalOptimizer.java:435-524``): run the
+goal chain in priority order (each pass a compiled batched search, see
+:mod:`engine`), then diff initial vs final placement into execution
+proposals (``AnalyzerUtils.getDiff``, ``:508-513``).
+
+Everything per-goal stays on device; the host only sequences goals, stamps
+wall-clock durations, and materializes the proposal diff at the end.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, replace
+
+import jax
+import numpy as np
+
+from ..model.flat import FlatClusterModel
+from ..model.proposals import ExecutionProposal, diff_proposals, proposal_summary
+from ..model.spec import ClusterMetadata
+from .constraint import BalancingConstraint, SearchConfig
+from .engine import CompiledGoalChain
+from .goals import GoalKernel, default_goals
+from .options import OptimizationOptions
+from .state import build_context, init_state, to_model
+
+
+@dataclass
+class GoalResult:
+    name: str
+    hard: bool
+    violation_before: float
+    violation_after: float
+    duration_s: float
+    iterations: int
+
+    @property
+    def satisfied(self) -> bool:
+        return self.violation_after <= 1e-6
+
+    def to_json(self) -> dict:
+        return {"goal": self.name, "hard": self.hard,
+                "violationBefore": self.violation_before,
+                "violationAfter": self.violation_after,
+                "optimizationDurationMs": round(self.duration_s * 1e3, 3),
+                "iterations": self.iterations,
+                "status": "NO-ACTION" if self.violation_before <= 1e-6
+                else ("FIXED" if self.satisfied else "VIOLATED")}
+
+
+@dataclass
+class OptimizerResult:
+    """Rebuild of ``analyzer/OptimizerResult.java``: proposals + per-goal
+    stats + violated-goal sets before/after."""
+
+    proposals: list[ExecutionProposal]
+    goal_results: list[GoalResult]
+    num_moves: int
+    duration_s: float
+    final_model: FlatClusterModel
+
+    @property
+    def violated_goals_before(self) -> list[str]:
+        return [g.name for g in self.goal_results if g.violation_before > 1e-6]
+
+    @property
+    def violated_goals_after(self) -> list[str]:
+        return [g.name for g in self.goal_results if not g.satisfied]
+
+    @property
+    def violated_hard_goals(self) -> list[str]:
+        return [g.name for g in self.goal_results
+                if g.hard and not g.satisfied]
+
+    def to_json(self) -> dict:
+        summary = proposal_summary(self.proposals)
+        summary["numActions"] = self.num_moves
+        return {"summary": summary,
+                "goalSummary": [g.to_json() for g in self.goal_results],
+                "violatedGoalsBefore": self.violated_goals_before,
+                "violatedGoalsAfter": self.violated_goals_after,
+                "proposals": [p.to_json() for p in self.proposals],
+                "optimizationDurationMs": round(self.duration_s * 1e3, 3)}
+
+
+class TpuGoalOptimizer:
+    """Owns compiled goal chains; reusable across models with the same padded
+    shapes (recompiles transparently otherwise — XLA cache keyed on shapes)."""
+
+    def __init__(self, goals: list[GoalKernel] | None = None,
+                 constraint: BalancingConstraint | None = None,
+                 config: SearchConfig | None = None):
+        self.constraint = constraint or BalancingConstraint()
+        self.goals = goals if goals is not None else default_goals(self.constraint)
+        self.config = config or SearchConfig()
+        self._chains: dict[tuple, CompiledGoalChain] = {}
+
+    def _chain_for(self, cfg: SearchConfig) -> CompiledGoalChain:
+        key = (cfg,)
+        if key not in self._chains:
+            self._chains[key] = CompiledGoalChain(self.goals, cfg)
+        return self._chains[key]
+
+    def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
+                 options: OptimizationOptions | None = None
+                 ) -> OptimizerResult:
+        options = options or OptimizationOptions()
+        t0 = time.monotonic()
+
+        P = model.num_partitions_padded
+        B = model.num_brokers_padded
+        cfg = self.config.scaled_for(metadata.num_partitions,
+                                     metadata.num_brokers)
+        if options.fast_mode:
+            cfg = replace(
+                cfg,
+                max_iters_per_goal=max(cfg.max_iters_per_goal // 4, 16)
+            ).scaled_for(max(metadata.num_partitions // 4, 8),
+                         metadata.num_brokers)
+        chain = self._chain_for(cfg)
+
+        excluded_parts = options.excluded_partition_mask(metadata, P)
+        ctx = build_context(
+            model,
+            excluded_partitions=None if excluded_parts is None
+            else jax.numpy.asarray(excluded_parts),
+            excluded_brokers_for_replica_move=_as_jnp(
+                options.replica_move_exclusion_mask(metadata, B)),
+            excluded_brokers_for_leadership=_as_jnp(
+                options.broker_mask(metadata, B,
+                                    options.excluded_brokers_for_leadership)))
+
+        needs_topics = any(g.uses_topic_counts for g in self.goals)
+        state = init_state(
+            model,
+            with_topic_counts=metadata.num_topics if needs_topics else None)
+
+        key = jax.random.PRNGKey(options.seed)
+
+        # One violation stack per goal boundary: stack[i] before goal i runs
+        # doubles as stack[j<i] "after" readings (matches the per-goal stats
+        # the reference records at GoalOptimizer.java:458-497).
+        goal_results: list[GoalResult] = []
+        boundary = np.asarray(chain.violations(state, ctx))
+        for i, (goal, gpass) in enumerate(zip(self.goals, chain.passes)):
+            g0 = time.monotonic()
+            before_i = float(boundary[i])
+            state, iters = gpass(state, ctx, jax.random.fold_in(key, i))
+            boundary = np.asarray(chain.violations(state, ctx))
+            goal_results.append(GoalResult(
+                name=goal.name, hard=goal.hard,
+                violation_before=before_i,
+                violation_after=float(boundary[i]),
+                duration_s=time.monotonic() - g0,
+                iterations=int(jax.device_get(iters))))
+
+        final = to_model(state, model)
+        proposals = diff_proposals(model, final, metadata)
+        return OptimizerResult(
+            proposals=proposals, goal_results=goal_results,
+            num_moves=int(jax.device_get(state.moves_applied)),
+            duration_s=time.monotonic() - t0, final_model=final)
+
+
+def _as_jnp(mask):
+    if mask is None:
+        return None
+    import jax.numpy as jnp
+    return jnp.asarray(mask)

@@ -1,0 +1,74 @@
+"""Optimization options (ref ``analyzer/OptimizationOptions.java``).
+
+Per-request knobs: excluded topics (regex or explicit set — their replicas
+don't move unless offline), brokers excluded from receiving leadership or
+replicas, destination-broker restriction, and fast mode (smaller candidate
+pools / fewer iterations).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..model.spec import ClusterMetadata
+
+
+@dataclass(frozen=True)
+class OptimizationOptions:
+    excluded_topics: frozenset[str] = frozenset()
+    excluded_topics_pattern: str | None = None
+    excluded_brokers_for_leadership: frozenset[int] = frozenset()
+    excluded_brokers_for_replica_move: frozenset[int] = frozenset()
+    # When non-empty, only these brokers may receive replicas
+    # (ref requestedDestinationBrokerIds, used by ADD_BROKER).
+    destination_broker_ids: frozenset[int] = frozenset()
+    fast_mode: bool = False
+    seed: int = 0
+
+    def excluded_partition_mask(self, metadata: ClusterMetadata,
+                                padded_partitions: int) -> np.ndarray | None:
+        pattern = (re.compile(self.excluded_topics_pattern)
+                   if self.excluded_topics_pattern else None)
+        if not self.excluded_topics and pattern is None:
+            return None
+        excluded_topic_ids = {
+            metadata.topic_index[t] for t in self.excluded_topics
+            if t in metadata.topic_index}
+        if pattern is not None:
+            for t, i in metadata.topic_index.items():
+                if pattern.fullmatch(t):
+                    excluded_topic_ids.add(i)
+        if not excluded_topic_ids:
+            return None
+        mask = np.zeros(padded_partitions, bool)
+        for p, (topic, _) in enumerate(metadata.partition_keys):
+            if metadata.topic_index[topic] in excluded_topic_ids:
+                mask[p] = True
+        return mask
+
+    def broker_mask(self, metadata: ClusterMetadata, padded_brokers: int,
+                    ids: frozenset[int]) -> np.ndarray | None:
+        if not ids:
+            return None
+        mask = np.zeros(padded_brokers, bool)
+        for bid in ids:
+            idx = metadata.broker_index.get(bid)
+            if idx is not None:
+                mask[idx] = True
+        return mask
+
+    def replica_move_exclusion_mask(self, metadata: ClusterMetadata,
+                                    padded_brokers: int) -> np.ndarray | None:
+        """Brokers that may NOT receive replicas: the explicit exclusion set,
+        plus (when a destination restriction is given) everything outside it."""
+        excl = self.broker_mask(metadata, padded_brokers,
+                                self.excluded_brokers_for_replica_move)
+        if self.destination_broker_ids:
+            allowed = self.broker_mask(metadata, padded_brokers,
+                                       self.destination_broker_ids)
+            inv = ~allowed
+            excl = inv if excl is None else (excl | inv)
+        return excl

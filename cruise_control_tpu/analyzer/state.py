@@ -1,0 +1,382 @@
+"""Search state: the analyzer's device-resident view of the cluster.
+
+The reference mutates a ``ClusterModel`` object graph in place while goals
+run (``relocateReplica`` ``ClusterModel.java:380``). Here the optimization
+state is a pytree of arrays with *incrementally maintained* broker
+aggregates: applying a move touches two rows of each aggregate instead of
+re-reducing the whole model, which is what makes scoring thousands of
+candidate actions per step cheap on the MXU-adjacent vector units.
+
+Terminology:
+- ``B1 = padded_brokers + 1``: broker-indexed arrays carry one trailing
+  sentinel row so scatter-updates for empty replica slots land in a discard
+  row (same trick as ``model/flat.py``).
+- A *candidate* is one potential balancing action (ref
+  ``BalancingAction.java:20``), represented as a struct-of-arrays so the
+  whole batch is scored with elementwise vector math.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from ..core.resources import NUM_RESOURCES, Resource
+from ..model.flat import (MOVE_INTER_BROKER, MOVE_LEADERSHIP, FlatClusterModel,
+                          replica_loads)
+
+# Metric selectors: which per-broker aggregate a goal balances/caps.
+METRIC_CPU = ("util", Resource.CPU)
+METRIC_NW_IN = ("util", Resource.NW_IN)
+METRIC_NW_OUT = ("util", Resource.NW_OUT)
+METRIC_DISK = ("util", Resource.DISK)
+METRIC_REPLICA_COUNT = ("count", None)
+METRIC_LEADER_COUNT = ("leaders", None)
+METRIC_POTENTIAL_NW_OUT = ("potential", None)
+METRIC_LEADER_NW_IN = ("leader_nw_in", None)
+
+
+@struct.dataclass
+class SearchContext:
+    """Immutable per-optimization inputs (loads, topology, option masks)."""
+
+    leader_load: jax.Array        # f32[P, 4]
+    follower_load: jax.Array      # f32[P, 4]
+    partition_topic: jax.Array    # i32[P]
+    partition_valid: jax.Array    # bool[P]
+    broker_capacity: jax.Array    # f32[B1, 4] (sentinel row: 0)
+    broker_rack: jax.Array        # i32[B1] (sentinel: -1)
+    broker_alive: jax.Array       # bool[B1]
+    broker_valid: jax.Array       # bool[B1]
+    dest_allowed: jax.Array       # bool[B1] — may receive replicas
+    leader_dest_allowed: jax.Array  # bool[B1] — may receive leadership
+    movable: jax.Array            # bool[P, R] — replica may be relocated
+    leadership_movable: jax.Array  # bool[P] — leadership may be transferred
+
+    @property
+    def num_brokers_padded(self) -> int:
+        return self.broker_capacity.shape[0] - 1
+
+
+@struct.dataclass
+class SearchState:
+    """Mutable (functionally-updated) optimization state."""
+
+    rb: jax.Array              # i32[P, R] replica -> broker (sentinel = empty)
+    pos: jax.Array             # i32[P, R] original assignment position of the
+    #                            replica in this slot (slot 0 = current leader;
+    #                            pos tracks Kafka's preferred-leader order)
+    offline: jax.Array         # bool[P, R] replica must move (dead broker/disk)
+    util: jax.Array            # f32[B1, 4]
+    replica_count: jax.Array   # i32[B1]
+    leader_count: jax.Array    # i32[B1]
+    potential_nw_out: jax.Array  # f32[B1]
+    leader_nw_in: jax.Array    # f32[B1]
+    topic_counts: jax.Array | None  # i32[T, B1] or None (only when a
+    #                                 topic-scoped goal is in the chain)
+    moves_applied: jax.Array   # i32 scalar — total actions applied so far
+
+
+@struct.dataclass
+class Candidates:
+    """A batch of N candidate balancing actions (struct-of-arrays)."""
+
+    p: jax.Array            # i32[N] partition row
+    r: jax.Array            # i32[N] replica slot
+    src: jax.Array          # i32[N] source broker (for leadership: slot-0 broker)
+    dst: jax.Array          # i32[N] destination broker
+    kind: jax.Array         # i32[N] MOVE_INTER_BROKER | MOVE_LEADERSHIP
+    valid: jax.Array        # bool[N] generated-slot validity
+    must: jax.Array         # bool[N] moves an offline replica (mandatory)
+    d_util_src: jax.Array   # f32[N, 4]
+    d_util_dst: jax.Array   # f32[N, 4]
+    d_cnt: jax.Array        # i32[N] replica-count delta magnitude (0/1)
+    d_lead: jax.Array       # i32[N] leader-count delta magnitude (0/1)
+    d_pot: jax.Array        # f32[N] potential-NW_OUT delta magnitude
+    d_lni: jax.Array        # f32[N] leader-NW_IN delta magnitude
+
+
+def init_state(model: FlatClusterModel, *, with_topic_counts: int | None = None
+               ) -> SearchState:
+    """Build the search state from a flat model (one full reduction; all
+    subsequent updates are incremental)."""
+    P, R = model.replica_broker.shape
+    B = model.num_brokers_padded
+    B1 = B + 1
+    # Fresh buffers: the engine's passes donate the state, and the caller's
+    # model must survive to be diffed against the optimized placement.
+    rb = jnp.array(model.replica_broker, copy=True)
+    loads = replica_loads(model)                                   # [P, R, 4]
+    flat_idx = rb.reshape(-1)
+    util = jnp.zeros((B1, NUM_RESOURCES), jnp.float32)
+    util = util.at[flat_idx].add(loads.reshape(-1, NUM_RESOURCES))
+    util = util.at[B].set(0.0)
+
+    valid = model.replica_valid
+    counts = jnp.zeros((B1,), jnp.int32).at[flat_idx].add(1).at[B].set(0)
+    leaders = jnp.zeros((B1,), jnp.int32).at[rb[:, 0]].add(
+        jnp.where(model.partition_valid, 1, 0)).at[B].set(0)
+    pot = jnp.where(valid, model.leader_load[:, None, Resource.NW_OUT], 0.0)
+    potential = jnp.zeros((B1,), jnp.float32).at[flat_idx].add(
+        pot.reshape(-1)).at[B].set(0.0)
+    lni = jnp.where(model.partition_valid,
+                    model.leader_load[:, Resource.NW_IN], 0.0)
+    leader_nw_in = jnp.zeros((B1,), jnp.float32).at[rb[:, 0]].add(lni).at[B].set(0.0)
+
+    topic_counts = None
+    if with_topic_counts is not None:
+        T = with_topic_counts
+        idx = model.partition_topic[:, None] * B1 + rb                # [P, R]
+        tc = jnp.zeros((T * B1,), jnp.int32).at[idx.reshape(-1)].add(
+            jnp.where(valid, 1, 0).reshape(-1), mode="drop")
+        topic_counts = tc.reshape(T, B1).at[:, B].set(0)
+
+    pos = jnp.tile(jnp.arange(R, dtype=jnp.int32)[None, :], (P, 1))
+    # A replica hosted on a dead (or padding) broker is offline whether or
+    # not the model builder flagged it (ref Replica.isCurrentOffline derives
+    # from broker state) — offline replicas are the must-move set that
+    # drives self-healing.
+    alive1 = jnp.concatenate([model.broker_alive & model.broker_valid,
+                              jnp.zeros((1,), bool)])
+    offline = model.replica_offline | (valid & ~alive1[rb])
+    return SearchState(rb=rb, pos=pos, offline=offline,
+                       util=util, replica_count=counts, leader_count=leaders,
+                       potential_nw_out=potential, leader_nw_in=leader_nw_in,
+                       topic_counts=topic_counts,
+                       moves_applied=jnp.zeros((), jnp.int32))
+
+
+def build_context(model: FlatClusterModel, *,
+                  excluded_partitions: jax.Array | None = None,
+                  excluded_brokers_for_replica_move: jax.Array | None = None,
+                  excluded_brokers_for_leadership: jax.Array | None = None
+                  ) -> SearchContext:
+    """Assemble the immutable context. Exclusion masks follow
+    ``OptimizationOptions`` semantics (ref analyzer/OptimizationOptions.java):
+    replicas of excluded topics never move *unless offline*; excluded brokers
+    never receive replicas / leadership."""
+    P, R = model.replica_broker.shape
+    B = model.num_brokers_padded
+
+    def _pad1(arr, fill):
+        return jnp.concatenate([arr, jnp.full((1,) + arr.shape[1:], fill,
+                                              arr.dtype)], axis=0)
+
+    alive = _pad1(model.broker_alive & model.broker_valid, False)
+    bvalid = _pad1(model.broker_valid, False)
+    capacity = _pad1(model.broker_capacity, 0.0)
+    rack = _pad1(model.broker_rack, -1)
+
+    dest = alive
+    if excluded_brokers_for_replica_move is not None:
+        dest = dest & ~_pad1(excluded_brokers_for_replica_move, True)
+    lead_dest = alive & ~_pad1(model.broker_demoted, True)
+    if excluded_brokers_for_leadership is not None:
+        lead_dest = lead_dest & ~_pad1(excluded_brokers_for_leadership, True)
+
+    # ``movable`` is the *static* exclusion mask: real slot, topic not
+    # excluded. The offline exception ("excluded topics still heal") is
+    # dynamic — an offline replica becomes immovable again once relocated —
+    # so it is resolved against ``state.offline`` in base_legality/propose,
+    # not frozen here.
+    slot_valid = model.replica_valid
+    if excluded_partitions is None:
+        excluded_partitions = jnp.zeros((P,), bool)
+    movable = slot_valid & ~excluded_partitions[:, None]
+    leadership_movable = model.partition_valid & ~excluded_partitions
+
+    return SearchContext(
+        leader_load=model.leader_load, follower_load=model.follower_load,
+        partition_topic=model.partition_topic,
+        partition_valid=model.partition_valid,
+        broker_capacity=capacity, broker_rack=rack, broker_alive=alive,
+        broker_valid=bvalid, dest_allowed=dest,
+        leader_dest_allowed=lead_dest, movable=movable,
+        leadership_movable=leadership_movable)
+
+
+# ---------------------------------------------------------------------------
+# Metric access (the vectorized Load.expectedUtilizationFor of the goals)
+# ---------------------------------------------------------------------------
+
+def metric_values(state: SearchState, metric) -> jax.Array:
+    """f32[B1] — current value of the balanced metric on every broker."""
+    which, res = metric
+    if which == "util":
+        return state.util[:, int(res)]
+    if which == "count":
+        return state.replica_count.astype(jnp.float32)
+    if which == "leaders":
+        return state.leader_count.astype(jnp.float32)
+    if which == "potential":
+        return state.potential_nw_out
+    if which == "leader_nw_in":
+        return state.leader_nw_in
+    raise ValueError(f"unknown metric {metric}")
+
+
+def metric_deltas(cand: Candidates, metric):
+    """(d_src, d_dst) f32[N] — metric change on source/destination rows."""
+    which, res = metric
+    if which == "util":
+        return cand.d_util_src[..., int(res)], cand.d_util_dst[..., int(res)]
+    if which == "count":
+        d = cand.d_cnt.astype(jnp.float32)
+        return -d, d
+    if which == "leaders":
+        d = cand.d_lead.astype(jnp.float32)
+        return -d, d
+    if which == "potential":
+        return -cand.d_pot, cand.d_pot
+    if which == "leader_nw_in":
+        return -cand.d_lni, cand.d_lni
+    raise ValueError(f"unknown metric {metric}")
+
+
+# ---------------------------------------------------------------------------
+# Candidate construction
+# ---------------------------------------------------------------------------
+
+def make_move_candidates(state: SearchState, ctx: SearchContext,
+                         p: jax.Array, r: jax.Array, dst: jax.Array,
+                         valid: jax.Array) -> Candidates:
+    """Inter-broker replica relocation candidates (ref ActionType
+    INTER_BROKER_REPLICA_MOVEMENT)."""
+    src = state.rb[p, r]
+    is_leader = (r == 0)
+    load = jnp.where(is_leader[..., None], ctx.leader_load[p],
+                     ctx.follower_load[p])                       # [N, 4]
+    d_pot = ctx.leader_load[p, Resource.NW_OUT]
+    d_lni = jnp.where(is_leader, ctx.leader_load[p, Resource.NW_IN], 0.0)
+    kind = jnp.full(p.shape, MOVE_INTER_BROKER, jnp.int32)
+    return Candidates(
+        p=p, r=r, src=src, dst=dst, kind=kind, valid=valid,
+        must=state.offline[p, r] & valid,
+        d_util_src=-load, d_util_dst=load,
+        d_cnt=jnp.ones(p.shape, jnp.int32),
+        d_lead=is_leader.astype(jnp.int32),
+        d_pot=d_pot, d_lni=d_lni)
+
+
+def make_leadership_candidates(state: SearchState, ctx: SearchContext,
+                               p: jax.Array, r: jax.Array,
+                               valid: jax.Array) -> Candidates:
+    """Leadership transfer candidates: slot ``r`` becomes the leader (ref
+    ActionType LEADERSHIP_MOVEMENT; model swap per relocateLeadership)."""
+    src = state.rb[p, 0]
+    dst = state.rb[p, r]
+    dload = ctx.leader_load[p] - ctx.follower_load[p]            # [N, 4]
+    kind = jnp.full(p.shape, MOVE_LEADERSHIP, jnp.int32)
+    zero = jnp.zeros(p.shape, jnp.float32)
+    return Candidates(
+        p=p, r=r, src=src, dst=dst, kind=kind, valid=valid,
+        must=jnp.zeros(p.shape, bool),
+        d_util_src=-dload, d_util_dst=dload,
+        d_cnt=jnp.zeros(p.shape, jnp.int32),
+        d_lead=jnp.ones(p.shape, jnp.int32),
+        d_pot=zero, d_lni=ctx.leader_load[p, Resource.NW_IN])
+
+
+def concat_candidates(a: Candidates, b: Candidates) -> Candidates:
+    return jax.tree.map(lambda x, y: jnp.concatenate([x, y], axis=0), a, b)
+
+
+def candidate_at(cand: Candidates, i: jax.Array) -> Candidates:
+    """Select candidate ``i`` (scalar leaves) — used by the apply scan."""
+    return jax.tree.map(lambda x: x[i], cand)
+
+
+# ---------------------------------------------------------------------------
+# Legality (base constraints every action must satisfy; goal acceptance is
+# layered on top by the engine)
+# ---------------------------------------------------------------------------
+
+def base_legality(state: SearchState, ctx: SearchContext,
+                  c: Candidates) -> jax.Array:
+    """bool[N]. Re-evaluable against a *changed* state: includes staleness
+    checks (slot still holds the broker captured at proposal time), so the
+    apply scan can safely re-test each candidate after earlier applies."""
+    row = state.rb[c.p]                                          # [N, R]
+    slot_broker = state.rb[c.p, c.r]
+    is_move = c.kind == MOVE_INTER_BROKER
+
+    hosts_dst = (row == c.dst[..., None]).any(axis=-1)
+    # Offline replicas are movable even when their topic is excluded from
+    # rebalancing (self-healing exception, evaluated against the *current*
+    # offline mask so a healed replica goes back to immovable).
+    movable = ctx.movable[c.p, c.r] | state.offline[c.p, c.r]
+    move_ok = (movable
+               & (slot_broker == c.src)
+               & ctx.dest_allowed[c.dst]
+               & ~hosts_dst
+               & (c.dst != c.src)
+               # relocating the leader replica implies moving leadership too
+               & jnp.where(c.r == 0, ctx.leader_dest_allowed[c.dst], True))
+
+    lead_ok = ((c.r > 0)
+               & ctx.leadership_movable[c.p]
+               & (state.rb[c.p, 0] == c.src)
+               & (slot_broker == c.dst)
+               & ctx.leader_dest_allowed[c.dst]
+               & ~state.offline[c.p, c.r])   # offline replica can't lead
+
+    return c.valid & jnp.where(is_move, move_ok, lead_ok)
+
+
+# ---------------------------------------------------------------------------
+# Applying one candidate (the pure relocateReplica / relocateLeadership)
+# ---------------------------------------------------------------------------
+
+def apply_candidate(state: SearchState, ctx: SearchContext,
+                    c: Candidates) -> SearchState:
+    """Apply a single (scalar) candidate, updating assignment + aggregates."""
+    p, r, src, dst = c.p, c.r, c.src, c.dst
+    is_move = c.kind == MOVE_INTER_BROKER
+
+    # Assignment update: move writes dst into the slot; leadership swaps
+    # slots 0 <-> r (and their pos/offline companions).
+    rb, pos, off = state.rb, state.pos, state.offline
+
+    def do_move(args):
+        rb, pos, off = args
+        return (rb.at[p, r].set(dst), pos, off.at[p, r].set(False))
+
+    def do_lead(args):
+        rb, pos, off = args
+        b0, br = rb[p, 0], rb[p, r]
+        rb = rb.at[p, 0].set(br).at[p, r].set(b0)
+        p0, pr = pos[p, 0], pos[p, r]
+        pos = pos.at[p, 0].set(pr).at[p, r].set(p0)
+        o0, orr = off[p, 0], off[p, r]
+        off = off.at[p, 0].set(orr).at[p, r].set(o0)
+        return (rb, pos, off)
+
+    rb, pos, off = jax.lax.cond(is_move, do_move, do_lead, (rb, pos, off))
+
+    util = state.util.at[src].add(c.d_util_src).at[dst].add(c.d_util_dst)
+    dcnt = jnp.where(is_move, c.d_cnt, 0)
+    counts = state.replica_count.at[src].add(-dcnt).at[dst].add(dcnt)
+    leaders = state.leader_count.at[src].add(-c.d_lead).at[dst].add(c.d_lead)
+    dpot = jnp.where(is_move, c.d_pot, 0.0)
+    potential = state.potential_nw_out.at[src].add(-dpot).at[dst].add(dpot)
+    lni = state.leader_nw_in.at[src].add(-c.d_lni).at[dst].add(c.d_lni)
+
+    topic_counts = state.topic_counts
+    if topic_counts is not None:
+        t = ctx.partition_topic[p]
+        tc_delta = jnp.where(is_move, 1, 0)
+        topic_counts = (topic_counts.at[t, src].add(-tc_delta)
+                        .at[t, dst].add(tc_delta))
+
+    return state.replace(rb=rb, pos=pos, offline=off, util=util,
+                         replica_count=counts, leader_count=leaders,
+                         potential_nw_out=potential, leader_nw_in=lni,
+                         topic_counts=topic_counts,
+                         moves_applied=state.moves_applied + 1)
+
+
+def to_model(state: SearchState, template: FlatClusterModel) -> FlatClusterModel:
+    """Re-wrap the optimized assignment as a FlatClusterModel."""
+    return template.replace(replica_broker=state.rb,
+                            replica_offline=state.offline)

@@ -259,8 +259,13 @@ class MetricSampleAggregator:
                                                self._current_window_index)
                               if w * self._window_ms <= to_ms
                               and (w + 1) * self._window_ms > from_ms]
+            # Interested entities with no samples at all still count: they are
+            # invalid and sit in the completeness denominator (ref
+            # MetricSampleAggregator peeks every interested entity; an
+            # unmonitored partition must drag the valid-entity ratio down,
+            # not silently vanish from it).
             entities = (set(self._raw) if options.interested_entities is None
-                        else set(self._raw) & options.interested_entities)
+                        else set(options.interested_entities))
             num_win = len(window_indices)
             completeness = MetricSampleCompleteness(generation=self._generation,
                                                     num_total_entities=len(entities))
@@ -310,12 +315,19 @@ class MetricSampleAggregator:
     def _aggregate_entity(self, entity: Hashable, window_indices: list[int],
                           options: AggregationOptions
                           ) -> tuple[ValuesAndExtrapolations, np.ndarray]:
-        raw = self._raw[entity]
         num_win = len(window_indices)
         values = np.zeros((self._num_metrics, num_win), dtype=np.float64)
         extrapolations = [Extrapolation.NONE] * num_win
         window_valid = np.zeros(num_win, dtype=bool)
         num_extrapolations = 0
+
+        raw = self._raw.get(entity)
+        if raw is None:
+            # Interested entity with no samples: every window invalid.
+            extrapolations = [Extrapolation.NO_VALID_EXTRAPOLATION] * num_win
+            window_times = [w * self._window_ms for w in window_indices]
+            return (ValuesAndExtrapolations(values, extrapolations,
+                                            window_times), window_valid)
 
         base = self._compute_window_values(raw)
         counts = raw.sample_counts
@@ -327,16 +339,18 @@ class MetricSampleAggregator:
                 values[:, j] = base[:, slot]
                 window_valid[j] = True
                 continue
-            # Extrapolate (ref RawMetricValues extrapolation ladder):
+            # Extrapolate (ref RawMetricValues extrapolation ladder). The
+            # budget is only consumed when an extrapolation actually applies —
+            # windows that end NO_VALID_EXTRAPOLATION never burn budget.
             if num_extrapolations >= options.max_allowed_extrapolations_per_entity:
                 extrapolations[j] = Extrapolation.NO_VALID_EXTRAPOLATION
                 continue
-            num_extrapolations += 1
             half_min = max(1, self._min_samples // 2)
             if count >= half_min:
                 values[:, j] = base[:, slot]
                 extrapolations[j] = Extrapolation.AVG_AVAILABLE
                 window_valid[j] = True
+                num_extrapolations += 1
                 continue
             prev_w, next_w = w - 1, w + 1
             neighbor_slots = [x % self._num_slots for x in (prev_w, next_w)
@@ -346,10 +360,12 @@ class MetricSampleAggregator:
                 values[:, j] = base[:, neighbor_slots].mean(axis=1)
                 extrapolations[j] = Extrapolation.AVG_ADJACENT
                 window_valid[j] = True
+                num_extrapolations += 1
             elif count > 0:
                 values[:, j] = base[:, slot]
                 extrapolations[j] = Extrapolation.FORCED_INSUFFICIENT
                 window_valid[j] = True
+                num_extrapolations += 1
             else:
                 extrapolations[j] = Extrapolation.NO_VALID_EXTRAPOLATION
         window_times = [w * self._window_ms for w in window_indices]
@@ -388,7 +404,11 @@ class MetricSampleAggregator:
             group_ratio = (1.0 - len(invalid_groups) / len(unique_groups)
                            if unique_groups else 0.0)
             completeness.valid_entity_group_ratio_by_window[w] = group_ratio
-            meets = ratio >= options.min_valid_entity_ratio
+            # A window with zero valid entities is never valid, even when the
+            # configured ratio floor is 0.0 (otherwise a time-jump reset would
+            # hand downstream an all-zero "complete" model).
+            meets = ratio >= options.min_valid_entity_ratio and bool(
+                valid_matrix[:, j].any())
             if options.granularity is AggregationGranularity.ENTITY_GROUP:
                 meets = meets and group_ratio >= options.min_valid_entity_group_ratio
             if meets:

@@ -300,7 +300,12 @@ class AbstractConfig:
         configure = getattr(instance, "configure", None)
         if callable(configure):
             merged = self.merged_values()
-            merged.update(self._originals)
+            # Unknown keys (not in the ConfigDef) pass through raw so plugins
+            # can read their own namespaced settings; known keys keep their
+            # parsed/typed values.
+            for key, value_ in self._originals.items():
+                if key not in merged:
+                    merged[key] = value_
             merged.update(extra)
             configure(merged)
         return instance

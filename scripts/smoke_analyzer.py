@@ -1,0 +1,43 @@
+"""Quick analyzer smoke: imbalanced 4-broker cluster -> optimizer -> checks."""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault(
+    "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+from cruise_control_tpu.model.spec import BrokerSpec, PartitionSpec, ClusterSpec, flatten_spec
+from cruise_control_tpu.model.flat import sanity_check, broker_utilization
+from cruise_control_tpu.analyzer import (TpuGoalOptimizer, OptimizationOptions,
+                                         SearchConfig, default_goals,
+                                         BalancingConstraint, goals_by_name)
+
+rng = np.random.default_rng(0)
+brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 2}") for i in range(4)]
+parts = []
+for t in range(6):
+    for p in range(8):
+        # all load piled on brokers 0/1 to force rebalancing
+        reps = [0, 1] if (t + p) % 2 == 0 else [1, 0]
+        load = (4.0 + rng.random(), 50.0, 80.0, 500.0)
+        parts.append(PartitionSpec(topic=f"topic-{t}", partition=p,
+                                   replicas=reps, leader_load=load))
+spec = ClusterSpec(brokers=brokers, partitions=parts)
+model, md = flatten_spec(spec)
+print("sanity:", sanity_check(model))
+print("util before:\n", np.asarray(broker_utilization(model))[:4])
+
+opt = TpuGoalOptimizer(
+    goals=goals_by_name(["RackAwareGoal", "ReplicaCapacityGoal",
+                         "DiskCapacityGoal", "ReplicaDistributionGoal",
+                         "DiskUsageDistributionGoal",
+                         "LeaderReplicaDistributionGoal"]),
+    config=SearchConfig(max_iters_per_goal=64))
+res = opt.optimize(model, md, OptimizationOptions(seed=1))
+print("moves:", res.num_moves, "proposals:", len(res.proposals),
+      "duration: %.2fs" % res.duration_s)
+for g in res.goal_results:
+    print(f"  {g.name:40s} before={g.violation_before:10.2f} "
+          f"after={g.violation_after:10.2f} iters={g.iterations}")
+print("sanity after:", sanity_check(res.final_model))
+print("util after:\n", np.asarray(broker_utilization(res.final_model))[:4])

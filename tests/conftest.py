@@ -2,13 +2,20 @@
 
 Tests never require real TPU hardware: sharding/pjit paths run on a virtual
 8-device CPU mesh (the driver separately dry-runs the multi-chip path via
-__graft_entry__.dryrun_multichip). The env vars must be set before jax
-initializes, hence this module-level block.
+__graft_entry__.dryrun_multichip).
+
+IMPORTANT: the ambient environment boots the axon (real-TPU tunnel) backend
+via a sitecustomize hook that imports jax at interpreter start — so jax's
+config has already snapshotted ``JAX_PLATFORMS=axon`` by the time this file
+runs, and setting the env var here is too late. ``jax.config.update`` is the
+reliable override; it must happen before any backend is initialized (i.e.
+before the first array op), which conftest import order guarantees.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Still set the env for any subprocesses tests may spawn.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,4 +23,5 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)

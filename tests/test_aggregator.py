@@ -160,3 +160,56 @@ def test_old_sample_rejected():
     agg = _agg(num_windows=2)
     agg.add_sample(_sample(("t1", 0), 10_000, 1.0))
     assert not agg.add_sample(_sample(("t1", 0), 1_000, 1.0))
+
+
+def test_interested_entity_without_samples_counts_invalid():
+    # An interested entity with no samples at all must appear in the
+    # denominator and the invalid set (regression: it used to vanish).
+    agg = _agg()
+    a, b = ("t1", 0), ("t1", 1)
+    agg.add_sample(_sample(a, 100, 10.0))
+    agg.add_sample(_sample(a, 200, 10.0))
+    agg.add_sample(_sample(a, 1100, 10.0))
+    agg.add_sample(_sample(a, 1200, 10.0))
+    opts = AggregationOptions(interested_entities={a, b})
+    result = agg.aggregate(0, 1000, opts)
+    assert result.completeness.num_total_entities == 2
+    assert b in result.invalid_entities
+    assert result.completeness.valid_entity_ratio == 0.5
+    assert all(x is Extrapolation.NO_VALID_EXTRAPOLATION
+               for x in result.entity_values[b].extrapolations)
+
+
+def test_empty_windows_after_time_jump_are_invalid():
+    # A forward time jump resets all slots; the resurrected empty windows
+    # must not count as valid (regression: all-zero "complete" model).
+    import pytest
+    from cruise_control_tpu.core.aggregator import NotEnoughValidWindowsError
+    agg = _agg()
+    e = ("t1", 0)
+    agg.add_sample(_sample(e, 100, 10.0))
+    agg.add_sample(_sample(e, 200, 10.0))
+    agg.add_sample(_sample(e, 500_000, 1.0))  # jump far forward
+    with pytest.raises(NotEnoughValidWindowsError):
+        agg.aggregate(0, 1_000_000_000)
+
+
+def test_extrapolation_budget_not_burned_by_failures():
+    # Windows that end NO_VALID_EXTRAPOLATION must not consume the
+    # extrapolation budget of later fixable windows.
+    agg = _agg(num_windows=8, min_samples=4)
+    e = ("t1", 0)
+    # Establish window range 0..8 with empty early windows.
+    agg.add_sample(_sample(e, 100, 10.0))  # w0: 1 sample < half-min(2)
+    # w1..w5 empty (no valid neighbors) -> NO_VALID_EXTRAPOLATION x5
+    # w6: 2 samples -> AVG_AVAILABLE (budget must still be available)
+    agg.add_sample(_sample(e, 6100, 20.0))
+    agg.add_sample(_sample(e, 6200, 40.0))
+    agg.add_sample(_sample(e, 8500, 1.0))  # roll out through w7
+    opts = AggregationOptions(max_allowed_extrapolations_per_entity=2,
+                              min_valid_windows=1)
+    result = agg.aggregate(0, 8000, opts)
+    vae = result.entity_values[e]
+    w6_idx = vae.window_times_ms.index(6000)
+    assert vae.extrapolations[w6_idx] is Extrapolation.AVG_AVAILABLE
+    np.testing.assert_allclose(vae.values[0][w6_idx], 30.0)

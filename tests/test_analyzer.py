@@ -1,0 +1,188 @@
+"""Analyzer tests mirroring the reference's OptimizationVerifier invariants
+(ref test/.../analyzer/OptimizationVerifier.java:42-53):
+
+- self-healing leaves no replicas on dead brokers;
+- optimization never worsens goal residuals (monotonicity is by construction
+  — every applied action strictly improves the active goal — but we assert
+  the end-to-end numbers anyway);
+- hard goals stay satisfied while later goals run;
+- excluded topics do not move; destination-restricted rebalances (add-broker)
+  do not shuffle replicas among pre-existing brokers;
+- model structural invariants (leader in slot 0, no duplicate brokers per
+  partition) hold after optimization;
+- fixed seeds give identical proposals.
+
+Deterministic fixtures play the role of the reference's DeterministicCluster
+(test/.../common/DeterministicCluster.java).
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (BalancingConstraint,
+                                         OptimizationOptions, SearchConfig,
+                                         TpuGoalOptimizer, goals_by_name)
+from cruise_control_tpu.model.flat import (broker_replica_counts,
+                                           broker_utilization, sanity_check)
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+
+CFG = SearchConfig(num_replica_candidates=64, num_dest_candidates=8,
+                   apply_per_iter=16, max_iters_per_goal=64)
+
+BALANCE_GOALS = ["RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+                 "ReplicaDistributionGoal", "DiskUsageDistributionGoal"]
+
+
+@pytest.fixture(scope="module")
+def balance_optimizer():
+    # Shared across tests: same goal chain + config reuses compiled passes.
+    return TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS), config=CFG)
+
+
+def make_cluster(num_brokers=4, num_racks=2, topics=4, parts_per_topic=8,
+                 rf=2, skew=True, dead=(), load=(4.0, 50.0, 80.0, 500.0)):
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % num_racks}",
+                          alive=i not in dead)
+               for i in range(num_brokers)]
+    alive_pool = [i for i in range(num_brokers) if i not in dead]
+    partitions = []
+    for t in range(topics):
+        for p in range(parts_per_topic):
+            if skew:
+                # Pile everything on the two lowest-id brokers.
+                reps = [(t + p) % 2, ((t + p) % 2 + 1) % 2][:rf]
+            else:
+                reps = [(t + p + k) % num_brokers for k in range(rf)]
+            # Note: offline_replicas deliberately NOT set for dead brokers —
+            # init_state must derive offline status from broker liveness.
+            partitions.append(PartitionSpec(
+                topic=f"topic-{t}", partition=p, replicas=reps,
+                leader_load=load))
+    return ClusterSpec(brokers=brokers, partitions=partitions)
+
+
+def test_balances_skewed_cluster(balance_optimizer):
+    model, md = flatten_spec(make_cluster())
+    res = balance_optimizer.optimize(model, md, OptimizationOptions(seed=3))
+    assert sanity_check(res.final_model) == {
+        "partitions_without_leader": 0, "duplicate_replica_brokers": 0,
+        "replicas_on_invalid_brokers": 0, "padding_with_replicas": 0}
+    by_name = {g.name: g for g in res.goal_results}
+    # Replica counts end balanced; no goal got worse.
+    assert by_name["ReplicaDistributionGoal"].violation_after <= 1e-6
+    for g in res.goal_results:
+        assert g.violation_after <= g.violation_before + 1e-6
+    counts = np.asarray(broker_replica_counts(res.final_model))[:4]
+    assert counts.max() - counts.min() <= 2
+    assert len(res.proposals) > 0
+
+
+def test_self_healing_dead_broker(balance_optimizer):
+    spec = make_cluster(skew=False, dead=(2,))
+    model, md = flatten_spec(spec)
+    res = balance_optimizer.optimize(model, md, OptimizationOptions(seed=0))
+    rb = np.asarray(res.final_model.replica_broker)
+    dead_row = md.broker_index[2]
+    assert not (rb == dead_row).any(), "replicas remain on dead broker"
+    assert not np.asarray(res.final_model.replica_offline).any()
+    assert sanity_check(res.final_model)["duplicate_replica_brokers"] == 0
+    # Dead broker must not appear in any proposal's new replica list.
+    for p in res.proposals:
+        assert 2 not in p.new_replicas
+
+
+def test_rack_awareness_fixed_and_preserved():
+    # 6 brokers over 3 racks; partitions deliberately rack-colocated.
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 3}") for i in range(6)]
+    partitions = []
+    for p in range(12):
+        # replicas on brokers 0 and 3 — both rack r0
+        partitions.append(PartitionSpec(topic="t", partition=p,
+                                        replicas=[0, 3],
+                                        leader_load=(2.0, 30.0, 40.0, 300.0)))
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=partitions))
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(["RackAwareGoal", "ReplicaDistributionGoal"]),
+        config=CFG)
+    res = opt.optimize(model, md, OptimizationOptions(seed=0))
+    rb = np.asarray(res.final_model.replica_broker)
+    racks = np.array([0, 1, 2, 0, 1, 2, -1, -1, -1])  # broker row -> rack
+    for p in range(12):
+        rep = rb[p][rb[p] < 8]
+        rr = racks[rep]
+        assert len(set(rr.tolist())) == len(rr), f"partition {p} rack collision"
+    by_name = {g.name: g for g in res.goal_results}
+    assert by_name["RackAwareGoal"].violation_before > 0
+    assert by_name["RackAwareGoal"].violation_after == 0
+
+
+def test_excluded_topics_do_not_move(balance_optimizer):
+    model, md = flatten_spec(make_cluster())
+    opts = OptimizationOptions(seed=1, excluded_topics=frozenset({"topic-0"}))
+    res = balance_optimizer.optimize(model, md, opts)
+    for p in res.proposals:
+        assert p.topic != "topic-0"
+
+
+def test_add_broker_destination_restriction(balance_optimizer):
+    # 3 loaded brokers + 1 new empty; destination restricted to the new one:
+    # replicas may only land on broker 3 (no old->old shuffling) — the
+    # AddBrokersRunnable invariant.
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 2}") for i in range(3)]
+    brokers.append(BrokerSpec(broker_id=3, rack="r1", new=True))
+    partitions = [PartitionSpec(topic="t", partition=p,
+                                replicas=[p % 3, (p + 1) % 3],
+                                leader_load=(3.0, 40.0, 60.0, 400.0))
+                  for p in range(24)]
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=partitions))
+    opts = OptimizationOptions(seed=2,
+                               destination_broker_ids=frozenset({3}))
+    res = balance_optimizer.optimize(model, md, opts)
+    assert len(res.proposals) > 0
+    for p in res.proposals:
+        added = set(p.new_replicas) - set(p.old_replicas)
+        assert added <= {3}, f"replica moved between old brokers: {p}"
+    counts = np.asarray(broker_replica_counts(res.final_model))[:4]
+    assert counts[3] > 0
+
+
+def test_capacity_goal_enforced():
+    # One broker over its disk capacity ceiling; capacity goal must shed.
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 2}",
+                          capacity=(100.0, 10_000.0, 10_000.0, 2_000.0))
+               for i in range(4)]
+    partitions = [PartitionSpec(topic="t", partition=p, replicas=[0],
+                                leader_load=(1.0, 10.0, 10.0, 300.0))
+                  for p in range(8)]  # 2400 MB on broker 0, ceiling 1600
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=partitions))
+    opt = TpuGoalOptimizer(goals=goals_by_name(["DiskCapacityGoal"]), config=CFG)
+    res = opt.optimize(model, md, OptimizationOptions(seed=0))
+    util = np.asarray(broker_utilization(res.final_model))
+    assert (util[:4, 3] <= 2_000.0 * 0.8 + 1e-3).all()
+    assert res.goal_results[0].violation_after <= 1e-6
+
+
+def test_deterministic_with_seed(balance_optimizer):
+    model, md = flatten_spec(make_cluster())
+    res1 = balance_optimizer.optimize(model, md, OptimizationOptions(seed=7))
+    res2 = balance_optimizer.optimize(model, md, OptimizationOptions(seed=7))
+    p1 = [(p.topic, p.partition, p.new_replicas) for p in res1.proposals]
+    p2 = [(p.topic, p.partition, p.new_replicas) for p in res2.proposals]
+    assert p1 == p2
+
+
+def test_leadership_distribution():
+    # All leaders on broker 0 while replicas are spread: leadership-only fix.
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 2}") for i in range(4)]
+    partitions = [PartitionSpec(topic="t", partition=p,
+                                replicas=[0, 1 + p % 3],
+                                leader_load=(2.0, 30.0, 50.0, 200.0))
+                  for p in range(12)]
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=partitions))
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(["LeaderReplicaDistributionGoal"]), config=CFG)
+    res = opt.optimize(model, md, OptimizationOptions(seed=0))
+    leaders = np.asarray(res.final_model.replica_broker[:, 0][:12])
+    counts = np.bincount(leaders, minlength=4)[:4]
+    assert counts.max() <= 5, f"leaders still skewed: {counts}"
