@@ -1,0 +1,175 @@
+"""Benchmark: rebalance-proposal wall-clock, TPU batched search vs greedy.
+
+Scenario #2 from BASELINE.md: synthetic 100-broker / 20K-partition cluster
+with skewed placement, ReplicaDistribution + resource UsageDistribution
+goals. The baseline is a host-side sequential greedy implementing the same
+goal semantics (the stand-in for the reference's GoalOptimizer greedy loop,
+which published no numbers — BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <warm wall-clock s>, "unit": "s",
+   "vs_baseline": <greedy_s / tpu_s speedup>}
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+NUM_BROKERS = 100
+NUM_PARTITIONS = 20_000
+RF = 2
+GOALS = ["ReplicaDistributionGoal", "DiskUsageDistributionGoal",
+         "NetworkInboundUsageDistributionGoal",
+         "NetworkOutboundUsageDistributionGoal"]
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def build_spec():
+    from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                               PartitionSpec)
+    rng = np.random.default_rng(42)
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 10}",
+                          capacity=(100.0, 1e6, 1e6, 1e8))
+               for i in range(NUM_BROKERS)]
+    # Skewed placement: half the partitions crowd onto 20% of brokers.
+    hot = np.arange(NUM_BROKERS // 5)
+    parts = []
+    for p in range(NUM_PARTITIONS):
+        if p % 2 == 0:
+            pool = hot
+        else:
+            pool = np.arange(NUM_BROKERS)
+        reps = rng.choice(pool, size=RF, replace=False).tolist()
+        load = (0.02 + 0.02 * rng.random(), 5 + 10 * rng.random(),
+                8 + 15 * rng.random(), 50 + 100 * rng.random())
+        parts.append(PartitionSpec(topic=f"t{p % 200}", partition=p,
+                                   replicas=[int(b) for b in reps],
+                                   leader_load=load))
+    return ClusterSpec(brokers=brokers, partitions=parts)
+
+
+def greedy_baseline(model, threshold=1.10, max_moves=60_000):
+    """Sequential greedy on host arrays: same bounds semantics as the goal
+    kernels (avg*(2-t)..avg*t per metric), one best move at a time."""
+    from cruise_control_tpu.model.flat import replica_loads
+    rb = np.asarray(model.replica_broker).copy()
+    loads = np.asarray(replica_loads(model))          # [P, R, 4]
+    B = model.num_brokers_padded
+    valid = rb < B
+    util = np.zeros((B, 4))
+    np.add.at(util, rb[valid], loads[valid])
+    counts = np.bincount(rb[valid], minlength=B + 1)[:B].astype(float)
+    nb = NUM_BROKERS
+    moves = 0
+    t0 = time.monotonic()
+    # Metric sequence: replica counts, then disk/nw_in/nw_out utilization.
+    for metric in ("count", 3, 1, 2):
+        for _ in range(max_moves):
+            vals = counts[:nb] if metric == "count" else util[:nb, metric]
+            avg = vals.mean()
+            upper, lower = avg * threshold, avg * (2 - threshold)
+            if metric == "count":
+                upper = max(upper, np.ceil(avg))
+                lower = min(lower, np.floor(avg))
+            over = vals - upper
+            src = int(np.argmax(over))
+            if over[src] <= 0:
+                break
+            # largest movable replica on src by this metric
+            on_src = (rb == src) & valid
+            w = (np.ones_like(loads[..., 0]) if metric == "count"
+                 else loads[..., metric])
+            w = np.where(on_src, w, -np.inf)
+            flat = int(np.argmax(w))
+            p, r = flat // rb.shape[1], flat % rb.shape[1]
+            if not np.isfinite(w[p, r]):
+                break
+            # best destination: lowest metric value not hosting p
+            hosting = np.zeros(nb, bool)
+            hosting[rb[p][valid[p]]] = True
+            dv = np.where(hosting[:nb], np.inf, vals)
+            dst = int(np.argmin(dv))
+            if not np.isfinite(dv[dst]):
+                break
+            delta = loads[p, r]
+            util[src] -= delta
+            util[dst] += delta
+            counts[src] -= 1
+            counts[dst] += 1
+            rb[p, r] = dst
+            moves += 1
+    dur = time.monotonic() - t0
+    return dur, moves, util, counts
+
+
+def residual(util, counts, nb, threshold=1.10):
+    tot = 0.0
+    for metric in ("count", 3, 1, 2):
+        vals = counts[:nb] if metric == "count" else util[:nb, metric]
+        avg = vals.mean()
+        upper, lower = avg * threshold, avg * (2 - threshold)
+        if metric == "count":
+            upper = max(upper, np.ceil(avg))
+            lower = min(lower, np.floor(avg))
+        tot += np.maximum(vals - upper, 0).sum() + np.maximum(lower - vals, 0).sum()
+    return float(tot)
+
+
+def main():
+    import jax
+    from cruise_control_tpu.analyzer import (OptimizationOptions, SearchConfig,
+                                             TpuGoalOptimizer, goals_by_name)
+    from cruise_control_tpu.model.flat import broker_utilization, broker_replica_counts
+    from cruise_control_tpu.model.spec import flatten_spec
+
+    log(f"platform: {jax.devices()[0].platform} ({jax.devices()[0]})")
+    t0 = time.monotonic()
+    spec = build_spec()
+    model, md = flatten_spec(spec)
+    log(f"build+flatten: {time.monotonic() - t0:.1f}s  "
+        f"({NUM_BROKERS} brokers, {NUM_PARTITIONS} partitions, rf={RF})")
+
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(GOALS),
+        config=SearchConfig(num_replica_candidates=512, num_dest_candidates=16,
+                            apply_per_iter=128, max_iters_per_goal=512))
+
+    t0 = time.monotonic()
+    res_cold = opt.optimize(model, md, OptimizationOptions(seed=0))
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    res = opt.optimize(model, md, OptimizationOptions(seed=1))
+    warm = time.monotonic() - t0
+    log(f"tpu search: cold {cold:.2f}s warm {warm:.2f}s "
+        f"moves={res.num_moves} proposals={len(res.proposals)}")
+    for g in res.goal_results:
+        log(f"  {g.name:42s} {g.violation_before:12.1f} -> "
+            f"{g.violation_after:10.1f} iters={g.iterations} "
+            f"({g.duration_s:.2f}s)")
+
+    g_dur, g_moves, g_util, g_counts = greedy_baseline(model)
+    g_res = residual(g_util, g_counts, NUM_BROKERS)
+    our_util = np.asarray(broker_utilization(res.final_model))
+    our_counts = np.asarray(broker_replica_counts(res.final_model)).astype(float)
+    our_res = residual(our_util, our_counts, NUM_BROKERS)
+    log(f"greedy baseline: {g_dur:.2f}s moves={g_moves} residual={g_res:.1f}")
+    log(f"tpu residual: {our_res:.1f} (must be <= greedy x1.05 + eps)")
+
+    print(json.dumps({
+        "metric": "rebalance_proposal_wall_clock_100x20k",
+        "value": round(warm, 3),
+        "unit": "s",
+        "vs_baseline": round(g_dur / warm, 3) if warm > 0 else None,
+    }))
+
+
+if __name__ == "__main__":
+    main()

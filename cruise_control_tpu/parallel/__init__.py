@@ -1,0 +1,15 @@
+"""Multi-chip scale-out for the analyzer search.
+
+The reference copes with model size by *shrinking the problem* (proposal
+cache, fast mode, topic exclusion — SURVEY.md §5.7); it never parallelizes
+the search. Here the partition axis of the flattened model shards across a
+``jax.sharding.Mesh`` and XLA inserts the collectives: per-broker aggregates
+are scatter-adds from sharded [P, R] arrays into replicated [B1, ...] rows
+(an implicit psum), and candidate top-k runs shard-local then gathers.
+"""
+
+from .sharding import (PARTITION_AXIS, make_mesh, model_shardings,
+                       shard_model, sharded_state_shardings)
+
+__all__ = ["PARTITION_AXIS", "make_mesh", "model_shardings", "shard_model",
+           "sharded_state_shardings"]

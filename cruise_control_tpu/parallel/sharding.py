@@ -1,0 +1,65 @@
+"""Mesh + sharding layout for the flattened cluster model.
+
+Layout decision (the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+
+- Partition-indexed arrays ([P] / [P, R] / [P, 4]) shard over the mesh axis
+  ``"p"`` — the partition axis is the big one (1M at LinkedIn scale) and
+  every per-replica computation is independent along it.
+- Broker-indexed arrays ([B1] / [B1, 4]) replicate: B is ~1000x smaller than
+  P, every candidate scoring step reads arbitrary broker rows (gathers), and
+  the scatter-add that builds them from sharded replica loads becomes an XLA
+  all-reduce over ICI — exactly the psum the hand-written version would do.
+- Scalars and candidate batches replicate.
+
+The same layout serves single-chip (trivial mesh) and multi-slice (mesh over
+DCN: keep "p" inside a slice so the per-iteration all-reduce of two [B1, 4]
+rows rides ICI; only the per-goal boundary syncs cross DCN).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..model.flat import FlatClusterModel
+
+PARTITION_AXIS = "p"
+
+
+def make_mesh(n_devices: int | None = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"need {n_devices} devices, have {len(devices)} "
+                f"(set --xla_force_host_platform_device_count for CPU tests)")
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+
+
+def _spec_for(leaf: jax.Array, num_partitions_padded: int) -> P:
+    """Partition-axis leaves shard on dim 0; everything else replicates."""
+    if leaf.ndim >= 1 and leaf.shape[0] == num_partitions_padded:
+        return P(PARTITION_AXIS, *([None] * (leaf.ndim - 1)))
+    return P()
+
+
+def model_shardings(model: FlatClusterModel, mesh: Mesh):
+    """Pytree of NamedShardings matching :class:`FlatClusterModel` leaves."""
+    Ppad = model.num_partitions_padded
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, _spec_for(leaf, Ppad)), model)
+
+
+def shard_model(model: FlatClusterModel, mesh: Mesh) -> FlatClusterModel:
+    """Place the model on the mesh (partition axis sharded)."""
+    return jax.device_put(model, model_shardings(model, mesh))
+
+
+def sharded_state_shardings(state, mesh: Mesh, num_partitions_padded: int):
+    """Shardings for a :class:`..analyzer.state.SearchState` pytree."""
+    return jax.tree.map(
+        lambda leaf: NamedSharding(mesh, _spec_for(leaf, num_partitions_padded)),
+        state)

@@ -1,0 +1,3 @@
+from .platform import respect_env_platforms
+
+__all__ = ["respect_env_platforms"]

@@ -1,0 +1,70 @@
+"""Platform selection workaround for hijacked JAX configs.
+
+The deployment environment boots a TPU-tunnel ("axon") PJRT backend from a
+``sitecustomize`` hook that imports jax at interpreter start and rewrites
+``jax.config.jax_platforms`` to ``"axon,cpu"`` — overriding whatever
+``JAX_PLATFORMS`` the caller exported. When the tunnel is unhealthy this
+hangs every ``jax.devices()`` deep in ``make_c_api_client``.
+
+:func:`respect_env_platforms` restores the contract that the env var wins:
+call it before the first array op in any entry-point script.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def respect_env_platforms() -> str | None:
+    """Make ``JAX_PLATFORMS`` authoritative over the snapshotted config.
+
+    Returns the platform list now in effect (or None if untouched). Safe to
+    call repeatedly; must run before the first backend initialization to
+    have any effect on device selection.
+    """
+    want = os.environ.get("JAX_PLATFORMS")
+    if not want:
+        return None
+    import jax
+    have = jax.config.jax_platforms
+    if have != want:
+        jax.config.update("jax_platforms", want)
+    return want
+
+
+def probe_default_backend(timeout_s: float = 120.0) -> str | None:
+    """Initialize the default JAX backend in a *subprocess* with a timeout.
+
+    Returns the default platform name ("tpu"/"cpu"/...) or None if backend
+    init hangs or fails — which happens whenever the axon tunnel relay is
+    down. Callers use this to fall back to CPU instead of hanging forever.
+    """
+    import subprocess
+    import sys
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, timeout=timeout_s,
+                             text=True)
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode != 0:
+        return None
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    return None
+
+
+def ensure_live_backend(timeout_s: float = 120.0) -> str:
+    """Probe the default backend; fall back to CPU if it is unreachable.
+
+    Must be called before the first array op. Returns the platform in use.
+    """
+    respect_env_platforms()
+    import jax
+    platform = probe_default_backend(timeout_s)
+    if platform is None:
+        jax.config.update("jax_platforms", "cpu")
+        return "cpu (fallback: default backend unreachable)"
+    return platform
