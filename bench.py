@@ -124,13 +124,18 @@ def residual(util, counts, nb, threshold=1.10):
 
 
 def main():
+    # Probe the default backend in a subprocess first: when the TPU tunnel is
+    # down, jax.devices() would otherwise hang/crash the whole bench. Falls
+    # back to CPU and still emits the JSON line (platform is logged).
+    from cruise_control_tpu.utils.platform import ensure_live_backend
+    platform = ensure_live_backend()
     import jax
     from cruise_control_tpu.analyzer import (OptimizationOptions, SearchConfig,
                                              TpuGoalOptimizer, goals_by_name)
     from cruise_control_tpu.model.flat import broker_utilization, broker_replica_counts
     from cruise_control_tpu.model.spec import flatten_spec
 
-    log(f"platform: {jax.devices()[0].platform} ({jax.devices()[0]})")
+    log(f"platform: {platform} -> {jax.devices()[0].platform} ({jax.devices()[0]})")
     t0 = time.monotonic()
     spec = build_spec()
     model, md = flatten_spec(spec)
