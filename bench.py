@@ -168,6 +168,16 @@ def main():
     log(f"greedy baseline: {g_dur:.2f}s moves={g_moves} residual={g_res:.1f}")
     log(f"tpu residual: {our_res:.1f} (must be <= greedy x1.05 + eps)")
 
+    # Quality gate (BASELINE.md: "score <= stock greedy"): a quality-losing
+    # run must fail loudly, not report a flattering wall-clock number. EPS
+    # absorbs cross-platform float noise only (~0.02% of one broker's
+    # balance band).
+    EPS = 10.0
+    if our_res > g_res * 1.05 + EPS:
+        raise RuntimeError(
+            f"quality regression: tpu residual {our_res:.1f} > "
+            f"greedy {g_res:.1f} x1.05 + {EPS}")
+
     print(json.dumps({
         "metric": "rebalance_proposal_wall_clock_100x20k",
         "value": round(warm, 3),

@@ -61,8 +61,24 @@ class SearchConfig:
 
     num_replica_candidates: int = 256
     num_dest_candidates: int = 16
-    apply_per_iter: int = 64
+    #: heavy-for-light swap pairs proposed per iteration by distribution
+    #: goals (ref ResourceDistributionGoal's swap sub-strategies); swaps are
+    #: count-neutral, escaping replica-count lexicographic dead-ends.
+    num_swap_candidates: int = 128
+    apply_per_iter: int = 256
+    #: conflict-resolution rounds per iteration; candidates still blocked
+    #: after this many rounds are deferred to the next iteration.
+    apply_groups: int = 64
     max_iters_per_goal: int = 256
+    #: consecutive zero-apply iterations (each with fresh tie-break noise)
+    #: before a goal pass is declared converged.
+    stall_patience: int = 5
+    #: extra host-side repetitions of the whole goal chain when residual
+    #: violations remain — later goals' accepted actions may drift earlier
+    #: goals slightly (the acceptance escape clauses allow bounded
+    #: regressions, ref ResourceDistributionGoal.actionAcceptance), and a
+    #: converged goal re-exits in ~stall_patience cheap iterations.
+    polish_passes: int = 2
     epsilon: float = 1e-6
     # Tie-break noise magnitude relative to priority scale (deterministic,
     # PRNG-keyed; keeps tests reproducible while diversifying candidates).
@@ -72,6 +88,7 @@ class SearchConfig:
         """Clamp candidate pool sizes for tiny models (tests, demo clusters)."""
         k = min(self.num_replica_candidates, max(8, num_partitions))
         d = min(self.num_dest_candidates, max(2, num_brokers))
-        m = min(self.apply_per_iter, k)
+        s = min(self.num_swap_candidates, k)
+        m = min(self.apply_per_iter, k + s)
         return replace(self, num_replica_candidates=k, num_dest_candidates=d,
-                       apply_per_iter=m)
+                       num_swap_candidates=s, apply_per_iter=m)

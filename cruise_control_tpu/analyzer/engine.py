@@ -4,16 +4,19 @@ Replaces ``AbstractGoal.optimize``'s triple-nested scalar loop
 (``AbstractGoal.java:82-135`` / ``maybeApplyBalancingAction`` ``:230-272``)
 with, per goal, a ``lax.while_loop`` whose body:
 
-1. asks the goal for a batch of candidate actions (top-K replicas x top-D
-   destinations — all device-side ``top_k``/gathers, no host round trips);
+1. asks the goal for a batch of candidate actions (flow-matched source
+   replica -> destination pairs, or top-K x top-D grids — all device-side
+   ``top_k``/``argsort``/``cumsum``, no host round trips);
 2. scores every candidate at once: base legality, acceptance by all
    previously-optimized goals (the lexicographic chain, ref
    ``AnalyzerUtils.isProposalAcceptableForOptimizedGoals``), and the goal's
    own residual delta;
-3. applies up to M best candidates through a sequential ``lax.scan`` that
-   re-validates each against the already-updated state (two-row aggregate
-   updates), so conflicting candidates in the same batch are skipped, not
-   mis-applied.
+3. partitions the best M candidates into *conflict-free groups* — within a
+   group no two candidates share a source broker, destination broker, or
+   partition row — via prefix-rank grouping (a candidate's group index is
+   the max count of earlier same-key candidates; same-key candidates form
+   cliques, so ranks are distinct within a key), then applies each group as
+   one vectorized scatter after re-validating against the updated state.
 
 The loop exits when an iteration applies nothing (no improving legal action
 — same fixed point as the reference's ``_finished`` flag). Mandatory moves
@@ -30,8 +33,11 @@ import jax.numpy as jnp
 
 from .constraint import SearchConfig
 from .goals import GoalKernel
-from .state import (SearchContext, SearchState, apply_candidate, base_legality,
-                    candidate_at)
+from .state import (SearchContext, SearchState, apply_group, base_legality)
+
+# Ordering sentinel only (never added to a metric value): mandatory moves
+# sort ahead of every improving move.
+_MUST_FIRST = -1e30
 
 
 def _chain_accepts(prev_goals: Sequence[GoalKernel], state, ctx, cands):
@@ -49,50 +55,104 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
     baked in at trace time (the goal chain is static configuration)."""
 
     eps = cfg.epsilon
+    G = cfg.apply_groups
+
+    def eligibility(state, ctx, cands):
+        ok = base_legality(state, ctx, cands)
+        ok = ok & _chain_accepts(prev_goals, state, ctx, cands)
+        delta = goal.delta(state, ctx, cands)
+        return ok & ((delta < -eps) | cands.must)
 
     def apply_batch(state: SearchState, ctx: SearchContext, cands, score):
         M = min(cfg.apply_per_iter, score.shape[0])
         _, order = jax.lax.top_k(-score, M)
+        c = jax.tree.map(lambda x: x[order], cands)
+        sel = jnp.isfinite(score[order])
 
-        def body(carry, i):
-            state, n = carry
-            c = candidate_at(cands, i)
-            ok = base_legality(state, ctx, c)
-            ok = ok & _chain_accepts(prev_goals, state, ctx, c)
-            d = goal.delta(state, ctx, c)
-            do = ok & ((d < -eps) | c.must)
-            state = jax.lax.cond(do, lambda s: apply_candidate(s, ctx, c),
-                                 lambda s: s, state)
-            return (state, n + do.astype(jnp.int32)), None
+        # Two candidates conflict when they touch a shared broker aggregate
+        # row as source-of-source / destination-of-destination, or a shared
+        # partition row (primary or swap counterpart — non-swaps carry
+        # p2 == p, so those terms degenerate). Cross src/dst sharing is NOT a
+        # conflict: scatter-adds keep aggregates exact, and deltas estimated
+        # against the round's start state only err conservatively.
+        def same(a, b):
+            return a[:, None] == b[None, :]
 
-        (state, n), _ = jax.lax.scan(body, (state, jnp.zeros((), jnp.int32)),
-                                     order)
+        conflict = (same(c.src, c.src) | same(c.dst, c.dst)
+                    | same(c.p, c.p) | same(c.p, c.p2)
+                    | same(c.p2, c.p) | same(c.p2, c.p2))
+        earlier = jnp.tril(jnp.ones((M, M), bool), k=-1)
+        conflict_earlier = conflict & earlier
+
+        # Pending-set rounds: each round applies every still-pending eligible
+        # candidate with no conflict against an earlier pending eligible one
+        # (so an applied set is always pairwise conflict-free and respects
+        # the priority order), then re-validates the rest against the updated
+        # state. Terminates when nothing applies or the round budget is hit.
+        def rcond(carry):
+            _, _, pending, rounds, progressed = carry
+            return pending.any() & (rounds < G) & progressed
+
+        def rbody(carry):
+            state, n, pending, rounds, _ = carry
+            elig = pending & eligibility(state, ctx, c)
+            blocked = (conflict_earlier & elig[None, :]).any(axis=1)
+            do = elig & ~blocked
+            state = apply_group(state, ctx, c, do)
+            return (state, n + do.sum(dtype=jnp.int32), pending & ~do,
+                    rounds + 1, do.any())
+
+        state, n, _, _, _ = jax.lax.while_loop(
+            rcond, rbody, (state, jnp.zeros((), jnp.int32), sel,
+                           jnp.zeros((), jnp.int32), jnp.ones((), bool)))
         return state, n
 
+    def steer_ctx(state: SearchState, ctx: SearchContext) -> SearchContext:
+        """Steer candidate generation toward destinations the earlier goals
+        in the chain can accept (e.g. don't flow disk moves onto a broker
+        whose replica count already sits at its balance ceiling). Pure
+        heuristic: acceptance is still enforced per candidate, and if the
+        intersection is empty the original destination set is kept so
+        mandatory moves stay routable."""
+        if not prev_goals:
+            return ctx
+        recv = jnp.ones(ctx.broker_alive.shape, bool)
+        for g in prev_goals:
+            recv = recv & g.receptive_dest(state, ctx)
+        dest = recv & ctx.dest_allowed
+        lead = recv & ctx.leader_dest_allowed
+        return ctx.replace(
+            dest_allowed=jnp.where(dest.any(), dest, ctx.dest_allowed),
+            leader_dest_allowed=jnp.where(lead.any(), lead,
+                                          ctx.leader_dest_allowed))
+
     def run(state: SearchState, ctx: SearchContext, key: jax.Array):
+        patience = cfg.stall_patience
+
         def cond(carry):
-            _, it, done = carry
-            return (~done) & (it < cfg.max_iters_per_goal)
+            _, it, stalls = carry
+            return (stalls < patience) & (it < cfg.max_iters_per_goal)
 
         def body(carry):
-            state, it, _ = carry
+            state, it, stalls = carry
             k = jax.random.fold_in(key, it)
-            cands = goal.propose(state, ctx, k, cfg)
-            ok = base_legality(state, ctx, cands)
-            ok = ok & _chain_accepts(prev_goals, state, ctx, cands)
+            cands = goal.propose(state, steer_ctx(state, ctx), k, cfg)
+            elig = eligibility(state, ctx, cands)
             delta = goal.delta(state, ctx, cands)
-            # Mandatory (offline) moves outrank everything; otherwise only
-            # improving actions are eligible.
-            eligible = ok & ((delta < -eps) | cands.must)
-            score = jnp.where(eligible,
-                              jnp.where(cands.must, delta - 1e12, delta),
-                              jnp.inf)
+            # Mandatory (offline) moves outrank everything; otherwise best
+            # (most-negative) deltas apply first.
+            score = jnp.where(
+                elig,
+                jnp.where(cands.must, _MUST_FIRST,
+                          jnp.clip(delta, -1e29, 1e29)),
+                jnp.inf)
             state, applied = apply_batch(state, ctx, cands, score)
-            return (state, it + 1, applied == 0)
+            stalls = jnp.where(applied == 0, stalls + 1, 0)
+            return (state, it + 1, stalls)
 
         state, iters, _ = jax.lax.while_loop(
             cond, body, (state, jnp.zeros((), jnp.int32),
-                         jnp.zeros((), bool)))
+                         jnp.zeros((), jnp.int32)))
         return state, iters
 
     return run

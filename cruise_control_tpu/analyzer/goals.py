@@ -30,15 +30,21 @@ import jax
 import jax.numpy as jnp
 
 from ..core.resources import Resource
-from ..model.flat import MOVE_INTER_BROKER, MOVE_LEADERSHIP
+from ..model.flat import MOVE_INTER_BROKER, MOVE_LEADERSHIP, MOVE_SWAP
 from .constraint import BalancingConstraint, SearchConfig
 from .state import (Candidates, SearchContext, SearchState, concat_candidates,
                     make_leadership_candidates, make_move_candidates,
-                    metric_deltas, metric_values,
+                    make_swap_candidates, metric_deltas, metric_values,
                     METRIC_LEADER_COUNT, METRIC_LEADER_NW_IN,
                     METRIC_POTENTIAL_NW_OUT, METRIC_REPLICA_COUNT)
 
-_BIG = 1e12
+# Candidate priorities are composed as TIER + weight-in-[0,1) + noise. Tiers
+# are small multiples of 4.0 so float32 keeps full precision for the weight
+# and the 1e-3 tie-break noise (the previous 1e12 offsets had ulp ~1.3e5 and
+# silently erased both, collapsing top_k to flat index order).
+_TIER_ASSIST = 0.0    # below-average source helping fill a deficit
+_TIER_EXCESS = 4.0    # source broker above its upper bound
+_TIER_OFFLINE = 8.0   # offline replica: must move (self-healing)
 _NEG = -jnp.inf
 
 
@@ -46,11 +52,16 @@ def _noise(key, shape, scale):
     return scale * jax.random.uniform(key, shape)
 
 
-def _normalized(w: jax.Array) -> jax.Array:
-    """Scale weights into [-1, 1] so they compose with the _BIG tier offsets
-    without the tie-break noise (absolute magnitude ~cfg.noise_scale)
-    swamping them."""
-    return w / (jnp.abs(w).max() + 1.0)
+def _norm01(x: jax.Array, mask: jax.Array | None = None) -> jax.Array:
+    """Scale finite (optionally masked-in) values into [0, 0.99]; everything
+    else maps to 0. Keeps weights strictly inside one tier step."""
+    if mask is not None:
+        x = jnp.where(mask, x, jnp.nan)
+    finite = jnp.isfinite(x)
+    xmin = jnp.min(jnp.where(finite, x, jnp.inf))
+    xmax = jnp.max(jnp.where(finite, x, -jnp.inf))
+    span = jnp.maximum(xmax - xmin, 1e-12)
+    return jnp.where(finite, (x - xmin) / span * 0.99, 0.0)
 
 
 def _top_replica_dest_grid(state: SearchState, ctx: SearchContext, key,
@@ -74,11 +85,10 @@ def _top_replica_dest_grid(state: SearchState, ctx: SearchContext, key,
     # goal itself would not have short-listed them (self-healing must-move)
     # or the topic is excluded from rebalancing.
     rp = jnp.where(state.offline,
-                   2.0 * _BIG + jnp.maximum(jnp.where(jnp.isfinite(rp), rp,
-                                                      0.0), 0.0), rp)
-    # Priorities are tier offsets (multiples of _BIG) plus normalized [-1, 1]
-    # weights; absolute noise_scale-sized noise breaks ties within a tier
-    # without reordering the weights.
+                   _TIER_OFFLINE + jnp.clip(jnp.where(jnp.isfinite(rp), rp,
+                                                      0.0), 0.0, 1.0), rp)
+    # Priorities are small tier offsets plus [0, 1) weights; noise_scale-sized
+    # noise breaks ties within a tier without reordering the weights.
     rp = rp + jnp.where(jnp.isfinite(rp),
                         _noise(krep, rp.shape, cfg.noise_scale), 0.0)
     rvals, ridx = jax.lax.top_k(rp.reshape(-1), K)
@@ -132,6 +142,14 @@ class GoalKernel:
     def accepts(self, state: SearchState, ctx: SearchContext,
                 c: Candidates) -> jax.Array:
         raise NotImplementedError
+
+    def receptive_dest(self, state: SearchState,
+                       ctx: SearchContext) -> jax.Array:
+        """bool[B1] — brokers that can receive a replica without this
+        (previously-optimized) goal likely rejecting the action. A candidate
+        *steering* hint for later goals' destination matching; actual
+        acceptance is still enforced per candidate. Default: everywhere."""
+        return jnp.ones(ctx.broker_alive.shape, bool)
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"<{type(self).__name__} {self.name}>"
@@ -240,6 +258,16 @@ class IntervalGoal(GoalKernel):
                       | (src_after >= dst_after))
         return dst_ok & src_ok
 
+    def receptive_dest(self, state, ctx):
+        values = metric_values(state, self.metric)
+        _, upper = self.bounds(state, ctx)
+        up = jnp.broadcast_to(jnp.asarray(upper, values.dtype), values.shape)
+        # Integer-count metrics need a whole unit of headroom; continuous
+        # metrics just need to be strictly below the ceiling.
+        if self.metric[0] in ("count", "leaders"):
+            return values + 1.0 <= up
+        return values < up
+
     # -- candidate generation -------------------------------------------
     def propose(self, state, ctx, key, cfg):
         values = metric_values(state, self.metric)
@@ -247,37 +275,28 @@ class IntervalGoal(GoalKernel):
         lo = jnp.broadcast_to(jnp.asarray(lower, values.dtype), values.shape)
         up = jnp.broadcast_to(jnp.asarray(upper, values.dtype), values.shape)
         alive = ctx.broker_alive
-        excess = jnp.where(alive, jnp.maximum(values - up, 0.0), 0.0)
-        deficit = (jnp.zeros_like(values) if self.upper_only else
-                   jnp.where(alive, jnp.maximum(lo - values, 0.0), 0.0))
-        any_deficit = deficit.sum() > 0
         # Load still parked on dead/invalid brokers also counts as "excess":
         # it must drain to alive brokers (self-healing).
-        excess = jnp.where(alive, excess, values)
+        excess = jnp.where(alive, jnp.maximum(values - up, 0.0), values)
+        deficit = (jnp.zeros_like(values) if self.upper_only else
+                   jnp.where(alive & jnp.isfinite(lo),
+                             jnp.maximum(lo - values, 0.0), 0.0))
 
         parts = []
         if self.actions in ("replica", "both"):
-            w = _normalized(self._replica_weight(state, ctx))       # [P, R]
-            src_b = state.rb                                        # [P, R]
-            src_excess = excess[src_b]
-            src_above_avg = values[src_b] > ((lo[src_b] + up[src_b]) * 0.5)
-            prio = jnp.where(src_excess > 0.0, _BIG + w,
-                             jnp.where(any_deficit & src_above_avg, w, _NEG))
-            if self.metric[0] in ("leaders", "leader_nw_in"):
-                # Only relocating the *leader* replica (slot 0) changes
-                # leader-scoped metrics; follower moves are dead weight.
-                R = state.rb.shape[1]
-                prio = jnp.where((jnp.arange(R) == 0)[None, :], prio, _NEG)
-            dest_prio = (jnp.where(deficit > 0.0, _BIG, 0.0)
-                         + _normalized(up - values))
             kg, key = jax.random.split(key)
-            parts.append(_top_replica_dest_grid(state, ctx, kg, cfg, prio,
-                                                dest_prio))
+            parts.append(self._flow_candidates(state, ctx, kg, cfg, values,
+                                               lo, up, excess, deficit))
+            if cfg.num_swap_candidates > 0 and self.metric[0] != "count":
+                ks, key = jax.random.split(key)
+                parts.append(self._swap_candidates(state, ctx, ks, cfg,
+                                                   values, lo, up, excess,
+                                                   deficit))
         if self.actions in ("leadership", "both"):
             # moving leadership off slot-0's broker to the slot's broker
             src_b = state.rb[:, 0:1]                                # [P, 1]
             dst_b = state.rb                                        # [P, R]
-            gain = _normalized(excess)[src_b] + _normalized(deficit)[dst_b]
+            gain = _norm01(excess)[src_b] + _norm01(deficit)[dst_b]
             prio = jnp.where(excess[src_b] > 0.0, gain, _NEG)
             kl, key = jax.random.split(key)
             parts.append(_top_leadership(state, ctx, kl, cfg, prio))
@@ -285,6 +304,164 @@ class IntervalGoal(GoalKernel):
         for extra in parts[1:]:
             out = concat_candidates(out, extra)
         return out
+
+    def _replica_metric_load(self, ctx: SearchContext, p: jax.Array,
+                             r: jax.Array) -> jax.Array:
+        """f32[N] — how much of this goal's metric arrives at a destination
+        when replica (p, r) moves there (== the d_dst component)."""
+        which, res = self.metric
+        is_leader = (r == 0)
+        if which == "util":
+            return jnp.where(is_leader, ctx.leader_load[p, int(res)],
+                             ctx.follower_load[p, int(res)])
+        if which == "count":
+            return jnp.ones(p.shape, jnp.float32)
+        if which == "leaders":
+            return is_leader.astype(jnp.float32)
+        if which == "potential":
+            return ctx.leader_load[p, Resource.NW_OUT]
+        return jnp.where(is_leader, ctx.leader_load[p, Resource.NW_IN], 0.0)
+
+    def _flow_candidates(self, state, ctx, key, cfg, values, lo, up,
+                         excess, deficit):
+        """Flow-matched move candidates: top-K source replicas, each assigned
+        its *own* destination by matching the cumulative outgoing load against
+        the cumulative destination headroom (a greedy transportation plan).
+
+        This replaces a K x D cross-product shortlist: with only D distinct
+        destinations per iteration the apply pass overshoots them and skips
+        the rest of the batch, stalling convergence. Matching by cumulative
+        headroom spreads the batch so nearly every candidate is applicable
+        in the same iteration.
+        """
+        P, R = state.rb.shape
+        B1 = values.shape[0]
+        K = min(cfg.num_replica_candidates, P * R)
+        krep, kdst = jax.random.split(key)
+
+        # --- source replicas: offline > excess-broker > deficit-assist tiers
+        w = _norm01(self._replica_weight(state, ctx))               # [P, R]
+        src_b = state.rb
+        any_deficit = deficit.sum() > 0.0
+        mid = jnp.where(jnp.isfinite(lo), (lo + up) * 0.5, up * 0.5)
+        assist = any_deficit & (values[src_b] > mid[src_b])
+        prio = jnp.where(excess[src_b] > 0.0, _TIER_EXCESS + w,
+                         jnp.where(assist, _TIER_ASSIST + w, _NEG))
+        if self.metric[0] in ("leaders", "leader_nw_in"):
+            # Only relocating the *leader* replica (slot 0) changes
+            # leader-scoped metrics; follower moves are dead weight.
+            prio = jnp.where((jnp.arange(R) == 0)[None, :], prio, _NEG)
+        prio = jnp.where(ctx.movable, prio, _NEG)
+        prio = jnp.where(state.offline, _TIER_OFFLINE + w, prio)
+        prio = prio + jnp.where(jnp.isfinite(prio),
+                                _noise(krep, prio.shape, cfg.noise_scale), 0.0)
+        vals, idx = jax.lax.top_k(prio.reshape(-1), K)
+        p, r = idx // R, idx % R
+        sel = jnp.isfinite(vals)
+
+        # --- destination matching by cumulative headroom.
+        # Balance goals fill destinations only to the *midpoint* (== the
+        # average), not the upper bound: packing a destination to the brim
+        # satisfies this goal but leaves zero slack for every later goal in
+        # the chain (whose actions this goal must then accept) — the
+        # sequential-greedy reference avoids the dead-end by always moving to
+        # the least-loaded broker. Capacity-style goals keep the full
+        # ceiling. If midpoint headroom is exhausted (everyone above average)
+        # fall back to the ceiling headroom.
+        ceiling = jnp.where(ctx.dest_allowed, jnp.maximum(up - values, 0.0),
+                            0.0)
+        if self.upper_only:
+            headroom = ceiling
+        else:
+            to_mid = jnp.where(ctx.dest_allowed,
+                               jnp.maximum(mid - values, 0.0), 0.0)
+            headroom = jnp.where(to_mid.sum() > 0.0, to_mid, ceiling)
+        dprio = jnp.where(ctx.dest_allowed,
+                          jnp.where(deficit > 0.0, _TIER_EXCESS, 0.0)
+                          + _norm01(headroom, ctx.dest_allowed), _NEG)
+        dprio = dprio + jnp.where(jnp.isfinite(dprio),
+                                  _noise(kdst, dprio.shape, cfg.noise_scale),
+                                  0.0)
+        order = jnp.argsort(-dprio)                                  # [B1]
+        cum_head = jnp.cumsum(headroom[order])
+        load = jnp.where(sel, self._replica_metric_load(ctx, p, r), 0.0)
+        cum_load = jnp.cumsum(load) - 0.5 * load                     # midpoints
+        slot = jnp.searchsorted(cum_head, cum_load)
+        covered = slot < B1
+        matched = order[jnp.clip(slot, 0, B1 - 1)]
+        # Mandatory (offline) moves get a round-robin destination even when
+        # no headroom is left — they must land somewhere alive.
+        n_ok = jnp.maximum(ctx.dest_allowed.sum(), 1)
+        fallback = order[jnp.arange(K) % n_ok]
+        must = state.offline[p, r] & sel
+        dst = jnp.where(covered, matched, fallback)
+        valid = sel & (covered | must) & ctx.dest_allowed[dst]
+        return make_move_candidates(state, ctx, p, r, dst.astype(jnp.int32),
+                                    valid)
+
+    def _swap_candidates(self, state, ctx, key, cfg, values, lo, up, excess,
+                         deficit):
+        """Heavy-for-light replica swaps between over-upper and below-average
+        brokers (ref ResourceDistributionGoal.java:689,779). Swaps are
+        count-neutral, so they fix load imbalance on brokers an earlier
+        distribution goal pinned to their replica-count floor/ceiling — the
+        lexicographic dead-end single moves cannot escape. The k-th heaviest
+        eligible replica pairs with the k-th lightest (largest net transfer
+        first); the engine's delta recheck discards overshooting pairs."""
+        P, R = state.rb.shape
+        K = min(cfg.num_swap_candidates, P * R)
+        kh, kl, kshift = jax.random.split(key, 3)
+        w = _norm01(self._replica_weight(state, ctx))               # [P, R]
+        src_b = state.rb
+        # Both sides exchange replicas, so both brokers must be able to
+        # receive; offline replicas go through mandatory moves instead.
+        swappable = ctx.movable & ~state.offline & ctx.dest_allowed[src_b]
+        leader_scoped = self.metric[0] in ("leaders", "leader_nw_in")
+        is_slot0 = (jnp.arange(R) == 0)[None, :]
+        mid = jnp.where(jnp.isfinite(lo), (lo + up) * 0.5, up * 0.5)
+
+        # Heavies come from over-upper brokers, or — when the imbalance is
+        # deficit-only (everyone under the ceiling, a few below the floor) —
+        # from any above-average broker: a heavy-in/light-out exchange is
+        # often the only action earlier tightly-packed goals still accept on
+        # the deficit broker (e.g. its disk is at the cap).
+        any_deficit = deficit.sum() > 0.0
+        hmask = swappable & ((excess[src_b] > 0.0)
+                             | (any_deficit & (values[src_b] > mid[src_b])))
+        lmask = swappable & (values[src_b] < mid[src_b])
+        if leader_scoped:
+            # Only slot-0 replicas carry the metric out; the incoming side
+            # must be a follower or it would haul leadership back in.
+            hmask = hmask & is_slot0
+            lmask = lmask & ~is_slot0
+        hprio = jnp.where(hmask, _TIER_EXCESS + w, _NEG)
+        hprio = hprio + jnp.where(jnp.isfinite(hprio),
+                                  _noise(kh, hprio.shape, cfg.noise_scale),
+                                  0.0)
+        # Replicas on *deficit* brokers lead the light side: a deficit broker
+        # with no slack on other metrics (e.g. disk at the cap) can only be
+        # filled by an exchange, and its own replicas must be the outgoing
+        # half of that exchange.
+        lprio = jnp.where(lmask,
+                          jnp.where(deficit[src_b] > 0.0, _TIER_EXCESS, 0.0)
+                          + (0.99 - w), _NEG)
+        lprio = lprio + jnp.where(jnp.isfinite(lprio),
+                                  _noise(kl, lprio.shape, cfg.noise_scale),
+                                  0.0)
+        hv, hidx = jax.lax.top_k(hprio.reshape(-1), K)
+        lv, lidx = jax.lax.top_k(lprio.reshape(-1), K)
+        # Rotate the pairing by a per-iteration random shift: the k-th
+        # heaviest meets a different light partner every iteration, so over
+        # the pass the generator explores K^2 pairings — the tail of a
+        # residual often needs a specific (heavy, light) combination that
+        # the default rank-aligned pairing never forms.
+        shift = jax.random.randint(kshift, (), 0, K)
+        lidx = jnp.roll(lidx, shift)
+        lv = jnp.roll(lv, shift)
+        p1, r1 = hidx // R, hidx % R
+        p2, r2 = lidx // R, lidx % R
+        valid = jnp.isfinite(hv) & jnp.isfinite(lv)
+        return make_swap_candidates(state, ctx, p1, r1, p2, r2, valid)
 
     def _replica_weight(self, state: SearchState, ctx: SearchContext):
         """[P, R] preference among movable replicas on source brokers."""
@@ -466,32 +643,48 @@ class RackAwareGoal(GoalKernel):
         dup = self._dup_mask(state, ctx)
         prio = jnp.where(dup, 1.0, _NEG)
         # Prefer emptier destinations (fewer replicas) to also aid balance.
-        dest_prio = _normalized(-state.replica_count.astype(jnp.float32))
+        dest_prio = _norm01(-state.replica_count.astype(jnp.float32))
         return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
 
-    def _dup_change(self, state, ctx, c):
-        """(before, after) duplicate status of the candidate replica."""
-        racks = ctx.broker_rack[state.rb[c.p]]                   # [N, R]
-        valid = state.rb[c.p] < ctx.num_brokers_padded
+    def _dup_change(self, state, ctx, p, r, new_broker):
+        """(before, after) count of same-rack *pairs* involving replica
+        (p, r) when it relocates to ``new_broker`` — counts, not booleans, so
+        the delta agrees with the pairwise ``violation`` metric at any
+        replication factor (an RF>=3 partition with two co-rack peers loses
+        two pairs when the replica leaves)."""
+        row = state.rb[p]                                        # [N, R]
+        racks = ctx.broker_rack[row]
+        valid = row < ctx.num_brokers_padded
         R = racks.shape[-1]
         slots = jnp.arange(R)
-        others = valid & (slots != c.r[..., None])
-        my_rack = ctx.broker_rack[state.rb[c.p, c.r]]
-        dst_rack = ctx.broker_rack[c.dst]
-        before = ((racks == my_rack[..., None]) & others).any(axis=-1)
-        after = ((racks == dst_rack[..., None]) & others).any(axis=-1)
+        others = valid & (slots != r[..., None])
+        my_rack = ctx.broker_rack[state.rb[p, r]]
+        new_rack = ctx.broker_rack[new_broker]
+        before = ((racks == my_rack[..., None]) & others).sum(axis=-1)
+        after = ((racks == new_rack[..., None]) & others).sum(axis=-1)
         return before, after
 
     def delta(self, state, ctx, c):
-        before, after = self._dup_change(state, ctx, c)
+        b1, a1 = self._dup_change(state, ctx, c.p, c.r, c.dst)
+        d1 = (a1 - b1).astype(jnp.float32)
         is_move = c.kind == MOVE_INTER_BROKER
-        d = after.astype(jnp.float32) - before.astype(jnp.float32)
-        return jnp.where(is_move, d, 0.0)
+        is_swap = c.kind == MOVE_SWAP
+        # Swap counterpart (a different partition) relocates to src; its
+        # pair-count change is independent of the primary's.
+        b2, a2 = self._dup_change(state, ctx, c.p2, c.r2, c.src)
+        d2 = (a2 - b2).astype(jnp.float32)
+        return jnp.where(is_move, d1, jnp.where(is_swap, d1 + d2, 0.0))
 
     def accepts(self, state, ctx, c):
-        before, after = self._dup_change(state, ctx, c)
+        # Reference parity (RackAwareGoal.actionAcceptance): an inter-broker
+        # move is rejected whenever the destination rack already hosts another
+        # replica of the partition — no "was already violating" relaxation.
+        _, a1 = self._dup_change(state, ctx, c.p, c.r, c.dst)
+        _, a2 = self._dup_change(state, ctx, c.p2, c.r2, c.src)
         is_move = c.kind == MOVE_INTER_BROKER
-        return jnp.where(is_move, ~after | before, True)
+        is_swap = c.kind == MOVE_SWAP
+        return jnp.where(is_move, a1 == 0,
+                         jnp.where(is_swap, (a1 == 0) & (a2 == 0), True))
 
 
 class TopicReplicaDistributionGoal(GoalKernel):
@@ -535,37 +728,62 @@ class TopicReplicaDistributionGoal(GoalKernel):
                            jnp.maximum(tc - upper[:, None], 0.0), tc)
         t_of_p = ctx.partition_topic                             # [P]
         src_excess = excess[t_of_p[:, None], state.rb]           # [P, R]
-        prio = jnp.where(src_excess > 0.0, _normalized(src_excess), _NEG)
+        prio = jnp.where(src_excess > 0.0,
+                         _TIER_EXCESS + _norm01(src_excess), _NEG)
         deficit = jnp.where(ctx.broker_alive[None, :],
                             jnp.maximum(lower[:, None] - tc, 0.0), 0.0)
         # Destination shortlist is topic-agnostic ([B1]); per-topic fit is
         # resolved by delta scoring over the K x D grid.
-        dest_prio = (_normalized(deficit.sum(axis=0))
-                     + 1e-3 * _normalized(-state.replica_count.astype(jnp.float32)))
+        dest_prio = (2.0 * _norm01(deficit.sum(axis=0))
+                     + _norm01(-state.replica_count.astype(jnp.float32)))
         return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
+
+    def _cell_deltas(self, ctx, c):
+        """Per-candidate topic-count deltas on the four (topic, broker)
+        cells a move or swap touches. When the swap counterpart shares the
+        topic the transfers cancel exactly."""
+        is_move = (c.kind == MOVE_INTER_BROKER).astype(jnp.int32)
+        is_swap = (c.kind == MOVE_SWAP).astype(jnp.int32)
+        m1 = is_move | is_swap          # topic of p: src -> dst
+        m2 = is_swap                    # topic of p2: dst -> src
+        t1 = ctx.partition_topic[c.p]
+        t2 = ctx.partition_topic[c.p2]
+        same_t = t1 == t2
+        m2_t1 = jnp.where(same_t, m2, 0)
+        d_src_t1 = -m1 + m2_t1
+        d_dst_t1 = m1 - m2_t1
+        m2_t2 = jnp.where(same_t, 0, m2)
+        return t1, t2, d_src_t1, d_dst_t1, m2_t2
 
     def delta(self, state, ctx, c):
         lower, upper = self._bounds(state, ctx)
-        t = ctx.partition_topic[c.p]
-        lo, up = lower[t], upper[t]
-        src_c = state.topic_counts[t, c.src]
-        dst_c = state.topic_counts[t, c.dst]
+        t1, t2, d_src_t1, d_dst_t1, m2 = self._cell_deltas(ctx, c)
+        tc = state.topic_counts
         alive_s, alive_d = ctx.broker_alive[c.src], ctx.broker_alive[c.dst]
-        is_move = (c.kind == MOVE_INTER_BROKER).astype(jnp.int32)
-        before = (self._penalty(src_c, lo, up, alive_s)
-                  + self._penalty(dst_c, lo, up, alive_d))
-        after = (self._penalty(src_c - is_move, lo, up, alive_s)
-                 + self._penalty(dst_c + is_move, lo, up, alive_d))
-        return after - before
+
+        def pen(t, b, alive, d):
+            cell = tc[t, b]
+            return (self._penalty(cell + d, lower[t], upper[t], alive)
+                    - self._penalty(cell, lower[t], upper[t], alive))
+        out = (pen(t1, c.src, alive_s, d_src_t1)
+               + pen(t1, c.dst, alive_d, d_dst_t1)
+               + pen(t2, c.dst, alive_d, -m2)
+               + pen(t2, c.src, alive_s, m2))
+        return out
 
     def accepts(self, state, ctx, c):
         lower, upper = self._bounds(state, ctx)
-        t = ctx.partition_topic[c.p]
-        is_move = c.kind == MOVE_INTER_BROKER
-        dst_after = state.topic_counts[t, c.dst] + 1
-        src_after = state.topic_counts[t, c.src] - 1
-        ok = (dst_after <= upper[t]) | (dst_after <= src_after)
-        return jnp.where(is_move, ok, True)
+        t1, t2, d_src_t1, d_dst_t1, m2 = self._cell_deltas(ctx, c)
+        tc = state.topic_counts
+        # Whichever side *gains* a topic replica must stay within the upper
+        # bound or at least not overtake the shrinking side.
+        dst_t1_after = tc[t1, c.dst] + d_dst_t1
+        ok1 = ((d_dst_t1 <= 0) | (dst_t1_after <= upper[t1])
+               | (dst_t1_after <= tc[t1, c.src] + d_src_t1))
+        src_t2_after = tc[t2, c.src] + m2
+        ok2 = ((m2 <= 0) | (src_t2_after <= upper[t2])
+               | (src_t2_after <= tc[t2, c.dst] - m2))
+        return ok1 & ok2
 
 
 class PreferredLeaderElectionGoal(GoalKernel):

@@ -23,8 +23,8 @@ import jax.numpy as jnp
 from flax import struct
 
 from ..core.resources import NUM_RESOURCES, Resource
-from ..model.flat import (MOVE_INTER_BROKER, MOVE_LEADERSHIP, FlatClusterModel,
-                          replica_loads)
+from ..model.flat import (MOVE_INTER_BROKER, MOVE_LEADERSHIP, MOVE_SWAP,
+                          FlatClusterModel, replica_loads)
 
 # Metric selectors: which per-broker aggregate a goal balances/caps.
 METRIC_CPU = ("util", Resource.CPU)
@@ -80,21 +80,31 @@ class SearchState:
 
 @struct.dataclass
 class Candidates:
-    """A batch of N candidate balancing actions (struct-of-arrays)."""
+    """A batch of N candidate balancing actions (struct-of-arrays).
+
+    Delta fields are *signed from the destination's perspective*: applying a
+    candidate adds ``-d`` to the source row and ``+d`` to the destination row
+    of the corresponding aggregate. For swaps (kind MOVE_SWAP) the second
+    replica (``p2``, ``r2``) — a replica of a different partition hosted on
+    ``dst`` — travels to ``src`` in the same action; non-swap candidates
+    carry ``p2 == p``/``r2 == r`` as an inert placeholder.
+    """
 
     p: jax.Array            # i32[N] partition row
     r: jax.Array            # i32[N] replica slot
+    p2: jax.Array           # i32[N] swap counterpart partition (== p otherwise)
+    r2: jax.Array           # i32[N] swap counterpart slot (== r otherwise)
     src: jax.Array          # i32[N] source broker (for leadership: slot-0 broker)
     dst: jax.Array          # i32[N] destination broker
-    kind: jax.Array         # i32[N] MOVE_INTER_BROKER | MOVE_LEADERSHIP
+    kind: jax.Array         # i32[N] MOVE_INTER_BROKER | MOVE_LEADERSHIP | MOVE_SWAP
     valid: jax.Array        # bool[N] generated-slot validity
     must: jax.Array         # bool[N] moves an offline replica (mandatory)
     d_util_src: jax.Array   # f32[N, 4]
     d_util_dst: jax.Array   # f32[N, 4]
-    d_cnt: jax.Array        # i32[N] replica-count delta magnitude (0/1)
-    d_lead: jax.Array       # i32[N] leader-count delta magnitude (0/1)
-    d_pot: jax.Array        # f32[N] potential-NW_OUT delta magnitude
-    d_lni: jax.Array        # f32[N] leader-NW_IN delta magnitude
+    d_cnt: jax.Array        # i32[N] replica-count delta (0/1; swaps: 0)
+    d_lead: jax.Array       # i32[N] leader-count delta (signed for swaps)
+    d_pot: jax.Array        # f32[N] potential-NW_OUT delta (signed for swaps)
+    d_lni: jax.Array        # f32[N] leader-NW_IN delta (signed for swaps)
 
 
 def init_state(model: FlatClusterModel, *, with_topic_counts: int | None = None
@@ -251,7 +261,7 @@ def make_move_candidates(state: SearchState, ctx: SearchContext,
     d_lni = jnp.where(is_leader, ctx.leader_load[p, Resource.NW_IN], 0.0)
     kind = jnp.full(p.shape, MOVE_INTER_BROKER, jnp.int32)
     return Candidates(
-        p=p, r=r, src=src, dst=dst, kind=kind, valid=valid,
+        p=p, r=r, p2=p, r2=r, src=src, dst=dst, kind=kind, valid=valid,
         must=state.offline[p, r] & valid,
         d_util_src=-load, d_util_dst=load,
         d_cnt=jnp.ones(p.shape, jnp.int32),
@@ -270,7 +280,7 @@ def make_leadership_candidates(state: SearchState, ctx: SearchContext,
     kind = jnp.full(p.shape, MOVE_LEADERSHIP, jnp.int32)
     zero = jnp.zeros(p.shape, jnp.float32)
     return Candidates(
-        p=p, r=r, src=src, dst=dst, kind=kind, valid=valid,
+        p=p, r=r, p2=p, r2=r, src=src, dst=dst, kind=kind, valid=valid,
         must=jnp.zeros(p.shape, bool),
         d_util_src=-dload, d_util_dst=dload,
         d_cnt=jnp.zeros(p.shape, jnp.int32),
@@ -278,13 +288,44 @@ def make_leadership_candidates(state: SearchState, ctx: SearchContext,
         d_pot=zero, d_lni=ctx.leader_load[p, Resource.NW_IN])
 
 
+def make_swap_candidates(state: SearchState, ctx: SearchContext,
+                         p1: jax.Array, r1: jax.Array,
+                         p2: jax.Array, r2: jax.Array,
+                         valid: jax.Array) -> Candidates:
+    """Inter-broker replica *swap* candidates (ref ActionType
+    INTER_BROKER_REPLICA_SWAP; ResourceDistributionGoal.java:689,779).
+
+    Replica (p1, r1) on broker ``src`` trades places with replica (p2, r2)
+    on broker ``dst``. Counts are unchanged on both sides — swaps are how
+    load imbalances get fixed on brokers already pinned to their replica-
+    count floor/ceiling by an earlier distribution goal.
+    """
+    src = state.rb[p1, r1]
+    dst = state.rb[p2, r2]
+    lead1 = (r1 == 0)
+    lead2 = (r2 == 0)
+    load1 = jnp.where(lead1[..., None], ctx.leader_load[p1],
+                      ctx.follower_load[p1])                      # [N, 4]
+    load2 = jnp.where(lead2[..., None], ctx.leader_load[p2],
+                      ctx.follower_load[p2])
+    net = load1 - load2              # arrives at dst; leaves src
+    pot1 = ctx.leader_load[p1, Resource.NW_OUT]
+    pot2 = ctx.leader_load[p2, Resource.NW_OUT]
+    lni1 = jnp.where(lead1, ctx.leader_load[p1, Resource.NW_IN], 0.0)
+    lni2 = jnp.where(lead2, ctx.leader_load[p2, Resource.NW_IN], 0.0)
+    kind = jnp.full(p1.shape, MOVE_SWAP, jnp.int32)
+    return Candidates(
+        p=p1, r=r1, p2=p2, r2=r2, src=src, dst=dst, kind=kind, valid=valid,
+        must=jnp.zeros(p1.shape, bool),
+        d_util_src=-net, d_util_dst=net,
+        d_cnt=jnp.zeros(p1.shape, jnp.int32),
+        d_lead=lead1.astype(jnp.int32) - lead2.astype(jnp.int32),
+        d_pot=pot1 - pot2,
+        d_lni=lni1 - lni2)
+
+
 def concat_candidates(a: Candidates, b: Candidates) -> Candidates:
     return jax.tree.map(lambda x, y: jnp.concatenate([x, y], axis=0), a, b)
-
-
-def candidate_at(cand: Candidates, i: jax.Array) -> Candidates:
-    """Select candidate ``i`` (scalar leaves) — used by the apply scan."""
-    return jax.tree.map(lambda x: x[i], cand)
 
 
 # ---------------------------------------------------------------------------
@@ -321,59 +362,128 @@ def base_legality(state: SearchState, ctx: SearchContext,
                & ctx.leader_dest_allowed[c.dst]
                & ~state.offline[c.p, c.r])   # offline replica can't lead
 
-    return c.valid & jnp.where(is_move, move_ok, lead_ok)
+    # Swap: (p, r) on src trades with (p2, r2) on dst. Both brokers must be
+    # allowed destinations, neither partition may already have a replica on
+    # the incoming broker, and a leader slot may only land where leadership
+    # is allowed.
+    row2 = state.rb[c.p2]                                        # [N, R]
+    hosts_src2 = (row2 == c.src[..., None]).any(axis=-1)
+    movable2 = ctx.movable[c.p2, c.r2] | state.offline[c.p2, c.r2]
+    swap_ok = (movable
+               & movable2
+               & (c.p != c.p2)
+               & (slot_broker == c.src)
+               & (state.rb[c.p2, c.r2] == c.dst)
+               & (c.src != c.dst)
+               & ctx.dest_allowed[c.dst]
+               & ctx.dest_allowed[c.src]
+               & ~hosts_dst
+               & ~hosts_src2
+               & jnp.where(c.r == 0, ctx.leader_dest_allowed[c.dst], True)
+               & jnp.where(c.r2 == 0, ctx.leader_dest_allowed[c.src], True))
+
+    is_lead = c.kind == MOVE_LEADERSHIP
+    return c.valid & jnp.where(is_move, move_ok,
+                               jnp.where(is_lead, lead_ok, swap_ok))
 
 
 # ---------------------------------------------------------------------------
-# Applying one candidate (the pure relocateReplica / relocateLeadership)
+# Applying candidates (the pure relocateReplica / relocateLeadership / swap)
 # ---------------------------------------------------------------------------
 
-def apply_candidate(state: SearchState, ctx: SearchContext,
-                    c: Candidates) -> SearchState:
-    """Apply a single (scalar) candidate, updating assignment + aggregates."""
-    p, r, src, dst = c.p, c.r, c.src, c.dst
-    is_move = c.kind == MOVE_INTER_BROKER
+def apply_group(state: SearchState, ctx: SearchContext, c: Candidates,
+                do: jax.Array) -> SearchState:
+    """Apply a *conflict-free group* of candidates at once (vectorized).
 
-    # Assignment update: move writes dst into the slot; leadership swaps
-    # slots 0 <-> r (and their pos/offline companions).
+    Preconditions (arranged by the engine's pending-set rounds): among
+    candidates with ``do=True``, all partition rows (``p`` and swap
+    counterpart ``p2``) are distinct, all sources are distinct, and all
+    destinations are distinct. Under those
+    invariants every slot/aggregate row is written by at most one candidate,
+    so plain scatters replace the reference's one-mutation-at-a-time
+    ``relocateReplica``/``relocateLeadership`` calls.
+    """
+    p, r = c.p, c.r
+    is_move = (c.kind == MOVE_INTER_BROKER) & do
+    is_lead = (c.kind == MOVE_LEADERSHIP) & do
+    is_swap = (c.kind == MOVE_SWAP) & do
+
     rb, pos, off = state.rb, state.pos, state.offline
+    # Non-applied candidates may share a partition row with an applied one
+    # (they sit in other groups / failed re-validation); their writes are
+    # routed out of bounds and dropped so they cannot clobber real updates
+    # with stale gathered values.
+    P = rb.shape[0]
+    pw = jnp.where(do, p, P)
+    cur_slot = rb[p, r]
+    cur0 = rb[p, 0]
+    # Slot r: move/swap writes dst; leadership swaps in the old leader broker.
+    new_slot = jnp.where(is_move | is_swap, c.dst, cur0)
+    # Slot 0: leadership swaps in slot r's broker; a *leader-replica* move or
+    # swap (r == 0) must also land in slot 0 or the second scatter would undo
+    # it.
+    new0 = jnp.where(is_lead, cur_slot,
+                     jnp.where((is_move | is_swap) & (r == 0), c.dst, cur0))
+    rb = (rb.at[pw, r].set(new_slot, mode="drop")
+          .at[pw, 0].set(new0, mode="drop"))
+    # Swap counterpart: replica (p2, r2) travels to src. p2 rows are distinct
+    # from every applied candidate's p row within a group (engine grouping).
+    p2w = jnp.where(is_swap, c.p2, P)
+    rb = rb.at[p2w, c.r2].set(c.src, mode="drop")
 
-    def do_move(args):
-        rb, pos, off = args
-        return (rb.at[p, r].set(dst), pos, off.at[p, r].set(False))
+    pos_r, pos_0 = pos[p, r], pos[p, 0]
+    pos = (pos.at[pw, r].set(jnp.where(is_lead, pos_0, pos_r), mode="drop")
+           .at[pw, 0].set(jnp.where(is_lead, pos_r, pos_0), mode="drop"))
 
-    def do_lead(args):
-        rb, pos, off = args
-        b0, br = rb[p, 0], rb[p, r]
-        rb = rb.at[p, 0].set(br).at[p, r].set(b0)
-        p0, pr = pos[p, 0], pos[p, r]
-        pos = pos.at[p, 0].set(pr).at[p, r].set(p0)
-        o0, orr = off[p, 0], off[p, r]
-        off = off.at[p, 0].set(orr).at[p, r].set(o0)
-        return (rb, pos, off)
+    off_r, off_0 = off[p, r], off[p, 0]
+    new_off_r = jnp.where(is_move | is_swap, False,
+                          jnp.where(is_lead, off_0, off_r))
+    new_off_0 = jnp.where(is_lead, off_r,
+                          jnp.where((is_move | is_swap) & (r == 0), False,
+                                    off_0))
+    off = (off.at[pw, r].set(new_off_r, mode="drop")
+           .at[pw, 0].set(new_off_0, mode="drop")
+           .at[p2w, c.r2].set(False, mode="drop"))
 
-    rb, pos, off = jax.lax.cond(is_move, do_move, do_lead, (rb, pos, off))
-
-    util = state.util.at[src].add(c.d_util_src).at[dst].add(c.d_util_dst)
+    # Aggregates: zero deltas for non-applied candidates make their scatter
+    # contributions no-ops, so no sentinel routing is needed.
+    dof = do[:, None]
+    util = (state.util.at[c.src].add(jnp.where(dof, c.d_util_src, 0.0))
+            .at[c.dst].add(jnp.where(dof, c.d_util_dst, 0.0)))
     dcnt = jnp.where(is_move, c.d_cnt, 0)
-    counts = state.replica_count.at[src].add(-dcnt).at[dst].add(dcnt)
-    leaders = state.leader_count.at[src].add(-c.d_lead).at[dst].add(c.d_lead)
-    dpot = jnp.where(is_move, c.d_pot, 0.0)
-    potential = state.potential_nw_out.at[src].add(-dpot).at[dst].add(dpot)
-    lni = state.leader_nw_in.at[src].add(-c.d_lni).at[dst].add(c.d_lni)
+    counts = (state.replica_count.at[c.src].add(-dcnt)
+              .at[c.dst].add(dcnt))
+    dlead = jnp.where(do, c.d_lead, 0)
+    leaders = (state.leader_count.at[c.src].add(-dlead)
+               .at[c.dst].add(dlead))
+    dpot = jnp.where(is_move | is_swap, c.d_pot, 0.0)
+    potential = (state.potential_nw_out.at[c.src].add(-dpot)
+                 .at[c.dst].add(dpot))
+    dlni = jnp.where(do, c.d_lni, 0.0)
+    lni = (state.leader_nw_in.at[c.src].add(-dlni)
+           .at[c.dst].add(dlni))
 
     topic_counts = state.topic_counts
     if topic_counts is not None:
+        B1 = state.util.shape[0]
         t = ctx.partition_topic[p]
-        tc_delta = jnp.where(is_move, 1, 0)
-        topic_counts = (topic_counts.at[t, src].add(-tc_delta)
-                        .at[t, dst].add(tc_delta))
+        tc_delta = jnp.where(is_move | is_swap, 1, 0)
+        flat = topic_counts.reshape(-1)
+        flat = (flat.at[t * B1 + c.src].add(-tc_delta)
+                .at[t * B1 + c.dst].add(tc_delta))
+        # Swap counterpart topic travels the other way.
+        t2 = ctx.partition_topic[c.p2]
+        tc2 = jnp.where(is_swap, 1, 0)
+        flat = (flat.at[t2 * B1 + c.dst].add(-tc2)
+                .at[t2 * B1 + c.src].add(tc2))
+        topic_counts = flat.reshape(topic_counts.shape)
 
     return state.replace(rb=rb, pos=pos, offline=off, util=util,
                          replica_count=counts, leader_count=leaders,
                          potential_nw_out=potential, leader_nw_in=lni,
                          topic_counts=topic_counts,
-                         moves_applied=state.moves_applied + 1)
+                         moves_applied=state.moves_applied
+                         + do.sum(dtype=jnp.int32))
 
 
 def to_model(state: SearchState, template: FlatClusterModel) -> FlatClusterModel:

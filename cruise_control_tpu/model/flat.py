@@ -44,9 +44,11 @@ from flax import struct
 from ..core.resources import NUM_RESOURCES
 
 # Move types (ref ActionType.java:23-28; intra-broker variants live in the
-# disk extension of Moves).
+# disk extension of Moves). MOVE_SWAP is INTER_BROKER_REPLICA_SWAP: two
+# replicas of different partitions exchange brokers (count-neutral).
 MOVE_INTER_BROKER = 0
 MOVE_LEADERSHIP = 1
+MOVE_SWAP = 2
 
 
 @struct.dataclass
