@@ -145,7 +145,7 @@ def main():
     opt = TpuGoalOptimizer(
         goals=goals_by_name(GOALS),
         config=SearchConfig(num_replica_candidates=512, num_dest_candidates=16,
-                            apply_per_iter=128, max_iters_per_goal=512))
+                            apply_per_iter=512, max_iters_per_goal=512))
 
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(seed=0))

@@ -69,38 +69,49 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
         c = jax.tree.map(lambda x: x[order], cands)
         sel = jnp.isfinite(score[order])
 
-        # Two candidates conflict when they touch a shared broker aggregate
-        # row as source-of-source / destination-of-destination, or a shared
-        # partition row (primary or swap counterpart — non-swaps carry
-        # p2 == p, so those terms degenerate). Cross src/dst sharing is NOT a
-        # conflict: scatter-adds keep aggregates exact, and deltas estimated
-        # against the round's start state only err conservatively.
         def same(a, b):
             return a[:, None] == b[None, :]
 
-        conflict = (same(c.src, c.src) | same(c.dst, c.dst)
-                    | same(c.p, c.p) | same(c.p, c.p2)
+        # Structural conflicts: shared *partition rows* only (primary or swap
+        # counterpart — non-swaps carry p2 == p, so those terms degenerate).
+        # ``apply_group``'s slot writes are per-partition-row; its broker
+        # aggregates are scatter-adds, which stay exact under any amount of
+        # source/destination sharing. Collective bound overshoot from broker
+        # sharing is handled exactly by the goals' prefix-sum guards below —
+        # this is what lets hundreds of moves into/out of the same hot broker
+        # apply in one round instead of one per round.
+        conflict = (same(c.p, c.p) | same(c.p, c.p2)
                     | same(c.p2, c.p) | same(c.p2, c.p2))
         earlier = jnp.tril(jnp.ones((M, M), bool), k=-1)
         conflict_earlier = conflict & earlier
 
-        # Pending-set rounds: each round applies every still-pending eligible
-        # candidate with no conflict against an earlier pending eligible one
-        # (so an applied set is always pairwise conflict-free and respects
-        # the priority order), then re-validates the rest against the updated
-        # state. Terminates when nothing applies or the round budget is hit.
-        def rcond(carry):
-            _, _, pending, rounds, progressed = carry
-            return pending.any() & (rounds < G) & progressed
+        guard_goals = [goal, *prev_goals]
 
         def rbody(carry):
             state, n, pending, rounds, _ = carry
             elig = pending & eligibility(state, ctx, c)
-            blocked = (conflict_earlier & elig[None, :]).any(axis=1)
-            do = elig & ~blocked
+            emask = conflict_earlier & elig[None, :]
+            blocked = emask.any(axis=1)
+            # Prefix mask for guards: earlier, eligible, not partition-blocked
+            # candidates are the ones that will actually co-apply; guards are
+            # evaluated against exactly that set. (A guarded-out earlier
+            # candidate still counts as pending next round — conservative.)
+            ok = jnp.ones((M,), bool)
+            gmask = earlier & elig[None, :]
+            for g in guard_goals:
+                gok = g.collective_guard(state, ctx, c, gmask)
+                if gok is None:
+                    gok = ~((same(c.src, c.src) | same(c.dst, c.dst))
+                            & gmask).any(axis=1)
+                ok = ok & gok
+            do = elig & ~blocked & ok
             state = apply_group(state, ctx, c, do)
             return (state, n + do.sum(dtype=jnp.int32), pending & ~do,
                     rounds + 1, do.any())
+
+        def rcond(carry):
+            _, _, pending, rounds, progressed = carry
+            return pending.any() & (rounds < G) & progressed
 
         state, n, _, _, _ = jax.lax.while_loop(
             rcond, rbody, (state, jnp.zeros((), jnp.int32), sel,
@@ -120,11 +131,11 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
         for g in prev_goals:
             recv = recv & g.receptive_dest(state, ctx)
         dest = recv & ctx.dest_allowed
-        lead = recv & ctx.leader_dest_allowed
+        # Only replica-move destinations are steered: leadership candidates'
+        # destinations are pinned to wherever replicas already sit, and
+        # legality/acceptance are enforced per candidate against the raw ctx.
         return ctx.replace(
-            dest_allowed=jnp.where(dest.any(), dest, ctx.dest_allowed),
-            leader_dest_allowed=jnp.where(lead.any(), lead,
-                                          ctx.leader_dest_allowed))
+            dest_allowed=jnp.where(dest.any(), dest, ctx.dest_allowed))
 
     def run(state: SearchState, ctx: SearchContext, key: jax.Array):
         patience = cfg.stall_patience

@@ -151,8 +151,59 @@ class GoalKernel:
         acceptance is still enforced per candidate. Default: everywhere."""
         return jnp.ones(ctx.broker_alive.shape, bool)
 
+    def collective_guard(self, state: SearchState, ctx: SearchContext,
+                         c: Candidates, earlier: jax.Array
+                         ) -> jax.Array | None:
+        """ok[N] — whether each candidate keeps this goal's bounds when
+        applied *together with* every earlier candidate flagged in
+        ``earlier`` ([N, N] bool, row i = candidates ranked before i that are
+        slated to apply this round).
+
+        This is what lets the engine bulk-apply candidates that share a
+        source/destination broker: per-candidate ``accepts``/``delta`` are
+        evaluated against the round-start state, so a crowd of individually
+        fine actions can collectively overshoot a bound. The guard re-checks
+        the bound with the *net* metric flow of earlier candidates included
+        (exact prefix accounting, not a heuristic).
+
+        Returning ``None`` opts out: the engine then falls back to treating
+        shared-broker pairs as conflicts (at most one candidate per
+        source/destination broker per round) — correct but serializing.
+        """
+        return None
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"<{type(self).__name__} {self.name}>"
+
+
+def _net_broker_flow(c: Candidates, earlier: jax.Array,
+                     d_src: jax.Array, d_dst: jax.Array):
+    """(net_src_lo[N], net_dst_hi[N]) — pessimistic bounds on the metric
+    change each candidate's source / destination broker accrues from earlier
+    candidates in its round group.
+
+    Pessimistic means one-sided: the destination estimate counts only
+    *positive* earlier contributions (inflows) and the source estimate only
+    *negative* ones (outflows). The set of earlier candidates that actually
+    applies is a subset of ``earlier`` (some get guarded out themselves), and
+    dropping a candidate can only lower real inflow / raise real outflow —
+    so upper-bound checks against ``net_dst_hi`` and lower-bound checks
+    against ``net_src_lo`` stay sound under ANY applied subset. Candidates
+    that needed an earlier drain to make room are merely deferred a round.
+
+    One [N, N] mask matmul per broker-role pair; N is a few hundred, so this
+    rides the MXU for free.
+    """
+    e = earlier.astype(d_src.dtype)
+    same_dd = e * (c.dst[:, None] == c.dst[None, :])
+    same_ds = e * (c.dst[:, None] == c.src[None, :])
+    same_sd = e * (c.src[:, None] == c.dst[None, :])
+    same_ss = e * (c.src[:, None] == c.src[None, :])
+    pos = lambda x: jnp.maximum(x, 0.0)
+    neg = lambda x: jnp.minimum(x, 0.0)
+    net_dst_hi = same_dd @ pos(d_dst) + same_ds @ pos(d_src)
+    net_src_lo = same_ss @ neg(d_src) + same_sd @ neg(d_dst)
+    return net_src_lo, net_dst_hi
 
 
 class IntervalGoal(GoalKernel):
@@ -255,6 +306,27 @@ class IntervalGoal(GoalKernel):
             src_ok = True
         else:
             src_ok = ((d_src >= 0) | (src_after >= lo[c.src])
+                      | (src_after >= dst_after))
+        return dst_ok & src_ok
+
+    def collective_guard(self, state, ctx, c, earlier):
+        values = metric_values(state, self.metric)
+        lower, upper = self.bounds(state, ctx)
+        lo = jnp.broadcast_to(lower, values.shape)
+        up = jnp.broadcast_to(upper, values.shape)
+        d_src, d_dst = metric_deltas(c, self.metric)
+        net_src_lo, net_dst_hi = _net_broker_flow(c, earlier, d_src, d_dst)
+        src_after = values[c.src] + net_src_lo + d_src   # lowest it can land
+        dst_after = values[c.dst] + net_dst_hi + d_dst   # highest it can land
+        # Same escape clauses as accepts(): a net-non-increasing destination
+        # is always fine, and an already-violating pair may proceed as long
+        # as the destination stays at or below where the source lands.
+        dst_ok = ((net_dst_hi + d_dst <= 0) | (dst_after <= up[c.dst])
+                  | (dst_after <= src_after))
+        if self.upper_only:
+            src_ok = True
+        else:
+            src_ok = ((net_src_lo + d_src >= 0) | (src_after >= lo[c.src])
                       | (src_after >= dst_after))
         return dst_ok & src_ok
 
@@ -415,7 +487,10 @@ class IntervalGoal(GoalKernel):
         src_b = state.rb
         # Both sides exchange replicas, so both brokers must be able to
         # receive; offline replicas go through mandatory moves instead.
-        swappable = ctx.movable & ~state.offline & ctx.dest_allowed[src_b]
+        # Raw (un-steered) mask: swaps are count/metric-neutral for earlier
+        # goals, so a broker the engine steered moves away from (no headroom
+        # to *gain* a replica) is still a legitimate swap partner.
+        swappable = ctx.movable & ~state.offline & ctx.raw_dest_allowed[src_b]
         leader_scoped = self.metric[0] in ("leaders", "leader_nw_in")
         is_slot0 = (jnp.arange(R) == 0)[None, :]
         mid = jnp.where(jnp.isfinite(lo), (lo + up) * 0.5, up * 0.5)
@@ -512,6 +587,17 @@ class CapacityGoal(IntervalGoal):
         _, d_dst = metric_deltas(c, self.metric)
         return (d_dst <= 0) | (values[c.dst] + d_dst <= upper[c.dst])
 
+    def collective_guard(self, state, ctx, c, earlier):
+        # Hard cap, so no already-violating escape clause: with net flow
+        # included the destination must stay under the ceiling outright.
+        values = metric_values(state, self.metric)
+        _, upper = self.bounds(state, ctx)
+        up = jnp.broadcast_to(upper, values.shape)
+        d_src, d_dst = metric_deltas(c, self.metric)
+        _, net_dst_hi = _net_broker_flow(c, earlier, d_src, d_dst)
+        dst_after = values[c.dst] + net_dst_hi + d_dst
+        return (net_dst_hi + d_dst <= 0) | (dst_after <= up[c.dst])
+
 
 class ResourceDistributionGoal(IntervalGoal):
     """Soft balance: util within avg*(2-t) .. avg*t over alive brokers
@@ -549,11 +635,8 @@ class ReplicaCapacityGoal(IntervalGoal):
                          float(self.constraint.max_replicas_per_broker))
         return jnp.full_like(upper, -jnp.inf), upper
 
-    def accepts(self, state, ctx, c):
-        values = metric_values(state, self.metric)
-        _, upper = self.bounds(state, ctx)
-        _, d_dst = metric_deltas(c, self.metric)
-        return (d_dst <= 0) | (values[c.dst] + d_dst <= upper[c.dst])
+    accepts = CapacityGoal.accepts
+    collective_guard = CapacityGoal.collective_guard
 
 
 class ReplicaDistributionGoal(IntervalGoal):
@@ -686,6 +769,12 @@ class RackAwareGoal(GoalKernel):
         return jnp.where(is_move, a1 == 0,
                          jnp.where(is_swap, (a1 == 0) & (a2 == 0), True))
 
+    def collective_guard(self, state, ctx, c, earlier):
+        # Rack duplication is a property of a single partition's replica row,
+        # and the engine already serializes candidates sharing a partition
+        # row — candidates of distinct partitions cannot interact.
+        return jnp.ones(c.p.shape, bool)
+
 
 class TopicReplicaDistributionGoal(GoalKernel):
     """Per-topic replica counts balanced across alive brokers (ref
@@ -785,6 +874,55 @@ class TopicReplicaDistributionGoal(GoalKernel):
                | (src_t2_after <= tc[t2, c.dst] - m2))
         return ok1 & ok2
 
+    def collective_guard(self, state, ctx, c, earlier):
+        # Net flow per (topic, broker) *cell*: candidates interact only when
+        # an earlier one moves a replica of the same topic onto/off the same
+        # broker. Cell ids (topic * B1 + broker) make that one mask matmul
+        # per gaining side, same shape as the broker-metric guards.
+        lower, upper = self._bounds(state, ctx)
+        t1, t2, d_src_t1, d_dst_t1, m2 = self._cell_deltas(ctx, c)
+        B1 = state.util.shape[0]
+        tc = state.topic_counts
+
+        # Per-candidate signed deltas on up to 4 cells; net effect on a given
+        # cell = sum over earlier candidates' deltas targeting that cell.
+        cells = jnp.stack([t1 * B1 + c.src, t1 * B1 + c.dst,
+                           t2 * B1 + c.dst, t2 * B1 + c.src])   # [4, N]
+        deltas = jnp.stack([d_src_t1, d_dst_t1, -m2, m2]
+                           ).astype(jnp.float32)                # [4, N]
+
+        def net_on(cell_ids, sign):
+            # [N] — pessimistic one-sided earlier flow on each candidate's
+            # cell: positive-only (sign=+1) overestimates inflow for
+            # upper-bound checks; negative-only (sign=-1) overestimates
+            # outflow for the shrinking side of escape clauses (see
+            # _net_broker_flow for why one-sided bounds stay sound under any
+            # applied subset).
+            acc = jnp.zeros(cell_ids.shape, jnp.float32)
+            e = earlier.astype(jnp.float32)
+            clip = (lambda x: jnp.maximum(x, 0.0)) if sign > 0 else (
+                lambda x: jnp.minimum(x, 0.0))
+            for k in range(4):
+                acc = acc + (e * (cell_ids[:, None] == cells[k][None, :])
+                             ) @ clip(deltas[k])
+            return acc
+
+        # Gaining cells checked against the upper bound with worst-case
+        # inflow; the escape clause ("stay at or below where the shrinking
+        # cell lands") uses the shrinking cell's worst-case *low* estimate so
+        # a crowd of same-topic moves can't all ride a stale source count.
+        net1 = net_on(cells[1], +1)
+        after1 = tc[t1, c.dst].astype(jnp.float32) + net1 + d_dst_t1
+        src1_lo = tc[t1, c.src].astype(jnp.float32) + net_on(cells[0], -1) + d_src_t1
+        ok1 = ((net1 + d_dst_t1 <= 0) | (after1 <= upper[t1])
+               | (after1 <= src1_lo))
+        net2 = net_on(cells[3], +1)
+        after2 = tc[t2, c.src].astype(jnp.float32) + net2 + m2
+        src2_lo = tc[t2, c.dst].astype(jnp.float32) + net_on(cells[2], -1) - m2
+        ok2 = ((net2 + m2 <= 0) | (after2 <= upper[t2])
+               | (after2 <= src2_lo))
+        return ok1 & ok2
+
 
 class PreferredLeaderElectionGoal(GoalKernel):
     """Make the original first replica the leader again (ref
@@ -814,6 +952,11 @@ class PreferredLeaderElectionGoal(GoalKernel):
                          0.0)
 
     def accepts(self, state, ctx, c):
+        return jnp.ones(c.p.shape, bool)
+
+    def collective_guard(self, state, ctx, c, earlier):
+        # Preferred-leader status is per-partition; partition-row exclusivity
+        # (engine) is the only interaction.
         return jnp.ones(c.p.shape, bool)
 
 

@@ -51,6 +51,12 @@ class SearchContext:
     broker_valid: jax.Array       # bool[B1]
     dest_allowed: jax.Array       # bool[B1] — may receive replicas
     leader_dest_allowed: jax.Array  # bool[B1] — may receive leadership
+    # Un-steered copy of dest_allowed. The engine's steer_ctx narrows
+    # dest_allowed toward brokers earlier goals can accept *gaining* replicas
+    # on; metric-neutral actions (swaps) must ignore that narrowing — a
+    # count-packed broker is a perfectly good swap partner — so their
+    # generator reads the raw mask.
+    raw_dest_allowed: jax.Array     # bool[B1]
     movable: jax.Array            # bool[P, R] — replica may be relocated
     leadership_movable: jax.Array  # bool[P] — leadership may be transferred
 
@@ -202,7 +208,8 @@ def build_context(model: FlatClusterModel, *,
         partition_valid=model.partition_valid,
         broker_capacity=capacity, broker_rack=rack, broker_alive=alive,
         broker_valid=bvalid, dest_allowed=dest,
-        leader_dest_allowed=lead_dest, movable=movable,
+        leader_dest_allowed=lead_dest, raw_dest_allowed=dest,
+        movable=movable,
         leadership_movable=leadership_movable)
 
 
@@ -393,14 +400,16 @@ def base_legality(state: SearchState, ctx: SearchContext,
 
 def apply_group(state: SearchState, ctx: SearchContext, c: Candidates,
                 do: jax.Array) -> SearchState:
-    """Apply a *conflict-free group* of candidates at once (vectorized).
+    """Apply a *partition-disjoint group* of candidates at once (vectorized).
 
-    Preconditions (arranged by the engine's pending-set rounds): among
+    Precondition (arranged by the engine's pending-set rounds): among
     candidates with ``do=True``, all partition rows (``p`` and swap
-    counterpart ``p2``) are distinct, all sources are distinct, and all
-    destinations are distinct. Under those
-    invariants every slot/aggregate row is written by at most one candidate,
-    so plain scatters replace the reference's one-mutation-at-a-time
+    counterpart ``p2``) are distinct — so every replica-slot row is written
+    by at most one candidate. Sources and destinations MAY be shared freely:
+    broker aggregates are updated with scatter-*adds*, which stay exact
+    under any amount of src/dst sharing (collective bound overshoot is the
+    engine's guard problem, not a correctness issue here). Plain scatters
+    replace the reference's one-mutation-at-a-time
     ``relocateReplica``/``relocateLeadership`` calls.
     """
     p, r = c.p, c.r
