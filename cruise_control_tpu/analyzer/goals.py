@@ -206,6 +206,18 @@ def _net_broker_flow(c: Candidates, earlier: jax.Array,
     return net_src_lo, net_dst_hi
 
 
+def _net_src_hi(c: Candidates, earlier: jax.Array,
+                d_src: jax.Array, d_dst: jax.Array) -> jax.Array:
+    """Positive-only earlier inflow on each candidate's *source* broker —
+    needed by hard caps because a swap can carry net load INTO its source
+    (d_src > 0 when the incoming replica is heavier on this metric)."""
+    e = earlier.astype(d_src.dtype)
+    same_ss = e * (c.src[:, None] == c.src[None, :])
+    same_sd = e * (c.src[:, None] == c.dst[None, :])
+    pos = lambda x: jnp.maximum(x, 0.0)
+    return same_ss @ pos(d_src) + same_sd @ pos(d_dst)
+
+
 class IntervalGoal(GoalKernel):
     """Keep ``metric[b]`` within [lower, upper] on every alive broker.
 
@@ -580,23 +592,30 @@ class CapacityGoal(IntervalGoal):
         return jnp.full_like(upper, -jnp.inf), upper
 
     def accepts(self, state, ctx, c):
-        # Hard semantics: never push a broker above its capacity ceiling
-        # (additions only; removals always fine).
+        # Hard semantics: never push a broker above its capacity ceiling.
+        # Both sides are checked — a swap carries net load INTO its source
+        # when the incoming replica is heavier on this metric.
         values = metric_values(state, self.metric)
         _, upper = self.bounds(state, ctx)
-        _, d_dst = metric_deltas(c, self.metric)
-        return (d_dst <= 0) | (values[c.dst] + d_dst <= upper[c.dst])
+        d_src, d_dst = metric_deltas(c, self.metric)
+        dst_ok = (d_dst <= 0) | (values[c.dst] + d_dst <= upper[c.dst])
+        src_ok = (d_src <= 0) | (values[c.src] + d_src <= upper[c.src])
+        return dst_ok & src_ok
 
     def collective_guard(self, state, ctx, c, earlier):
         # Hard cap, so no already-violating escape clause: with net flow
-        # included the destination must stay under the ceiling outright.
+        # included the gaining side(s) must stay under the ceiling outright.
         values = metric_values(state, self.metric)
         _, upper = self.bounds(state, ctx)
         up = jnp.broadcast_to(upper, values.shape)
         d_src, d_dst = metric_deltas(c, self.metric)
         _, net_dst_hi = _net_broker_flow(c, earlier, d_src, d_dst)
         dst_after = values[c.dst] + net_dst_hi + d_dst
-        return (net_dst_hi + d_dst <= 0) | (dst_after <= up[c.dst])
+        dst_ok = (net_dst_hi + d_dst <= 0) | (dst_after <= up[c.dst])
+        src_hi = _net_src_hi(c, earlier, d_src, d_dst)
+        src_after = values[c.src] + src_hi + d_src
+        src_ok = (src_hi + d_src <= 0) | (src_after <= up[c.src])
+        return dst_ok & src_ok
 
 
 class ResourceDistributionGoal(IntervalGoal):

@@ -1,0 +1,32 @@
+"""Executor layer (L7): applies optimization proposals to the cluster.
+
+Rebuild of ``cruise-control/.../executor/`` — see :mod:`.executor` for the
+phase driver, :mod:`.planner`/:mod:`.strategy` for batch planning,
+:mod:`.concurrency` for caps + the adaptive adjuster, :mod:`.throttle` for
+replication throttling, and :mod:`.simulated` for the in-process cluster
+double used by tests and demos.
+"""
+
+from .admin import ClusterAdminClient, PartitionInfo, ReassignmentInfo
+from .concurrency import (ConcurrencyAdjuster, ConcurrencyConfig,
+                          ConcurrencyType, ExecutionConcurrencyManager)
+from .executor import (ExecutionResult, Executor, ExecutorConfig,
+                       ExecutorNotifier, ExecutorState, OngoingExecutionError)
+from .planner import ExecutionTaskPlanner
+from .simulated import SimClock, SimulatedKafkaCluster
+from .strategy import (StrategyContext, ReplicaMovementStrategy,
+                       STRATEGY_REGISTRY, strategy_chain)
+from .tasks import (ExecutionTask, ExecutionTaskManager, ExecutionTaskTracker,
+                    IntraBrokerReplicaMove, TaskState, TaskType)
+
+__all__ = [
+    "ClusterAdminClient", "PartitionInfo", "ReassignmentInfo",
+    "ConcurrencyAdjuster", "ConcurrencyConfig", "ConcurrencyType",
+    "ExecutionConcurrencyManager", "ExecutionResult", "Executor",
+    "ExecutorConfig", "ExecutorNotifier", "ExecutorState",
+    "OngoingExecutionError", "ExecutionTaskPlanner", "SimClock",
+    "SimulatedKafkaCluster", "StrategyContext", "ReplicaMovementStrategy",
+    "STRATEGY_REGISTRY", "strategy_chain", "ExecutionTask",
+    "ExecutionTaskManager", "ExecutionTaskTracker", "IntraBrokerReplicaMove",
+    "TaskState", "TaskType",
+]

@@ -1,0 +1,387 @@
+"""The executor: applies optimization proposals to the (simulated or real)
+cluster (ref ``executor/Executor.java``).
+
+Mirrors ``ProposalExecutionRunnable.execute()`` (``Executor.java:1442-1502``)
+phase ordering::
+
+    1. inter-broker replica movements   (interBrokerMoveReplicas :1607)
+    2. intra-broker (logdir) movements  (intraBrokerMoveReplicas :1679)
+    3. leadership movements             (moveLeaderships :1742)
+
+with per-round planner batches under concurrency caps, progress polling
+every ``progress_check_interval_ms``, adaptive concurrency
+(``ConcurrencyAdjuster`` ``:493-644``), replication throttling, dead-task
+detection when brokers die mid-flight (``ExecutionUtils.maybeMarkTaskAsDead``),
+user-triggered stop (``userTriggeredStopExecution`` ``:1145``), and
+single-execution reservation (``:1100`` handshake).
+
+Host-side by design: execution is I/O-bound control-plane work — exactly the
+part of the reference that stays off the TPU.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+from ..model.proposals import ExecutionProposal
+from .admin import ClusterAdminClient
+from .concurrency import (ConcurrencyAdjuster, ConcurrencyConfig,
+                          ExecutionConcurrencyManager)
+from .planner import ExecutionTaskPlanner
+from .strategy import StrategyContext, strategy_chain
+from .tasks import (ExecutionTask, ExecutionTaskManager, IntraBrokerReplicaMove,
+                    TaskState, TaskType)
+from .throttle import ReplicationThrottleHelper
+
+
+class ExecutorState(enum.Enum):
+    """ref ``ExecutorState.State``."""
+
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
+        "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS")
+    INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = (
+        "INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS")
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+class ExecutorNotifier:
+    """SPI for execution lifecycle alerts (ref ExecutorNotifier.java)."""
+
+    def on_execution_started(self, uuid: str) -> None:  # pragma: no cover
+        pass
+
+    def on_execution_finished(self, result: "ExecutionResult") -> None:  # pragma: no cover
+        pass
+
+
+@dataclass
+class ExecutorConfig:
+    """Subset of ExecutorConfig constants (ref config/constants/ExecutorConfig)."""
+
+    progress_check_interval_ms: int = 10_000
+    #: per-task stall bound before it is declared DEAD
+    replica_movement_timeout_ms: int = 3_600_000
+    leadership_movement_timeout_ms: int = 180_000
+    default_replication_throttle_bytes: int | None = None
+    concurrency: ConcurrencyConfig = field(default_factory=ConcurrencyConfig)
+    concurrency_adjuster_enabled: bool = True
+
+
+@dataclass
+class ExecutionResult:
+    uuid: str
+    state_counts: dict
+    started_ms: int
+    finished_ms: int
+    stopped: bool
+    num_dead_tasks: int
+
+    @property
+    def succeeded(self) -> bool:
+        return not self.stopped and self.num_dead_tasks == 0
+
+
+class OngoingExecutionError(RuntimeError):
+    """ref OngoingExecutionException."""
+
+
+class Executor:
+    def __init__(self, admin: ClusterAdminClient,
+                 config: ExecutorConfig | None = None,
+                 notifier: ExecutorNotifier | None = None,
+                 now_ms=None, sleep_ms=None) -> None:
+        self.admin = admin
+        self.config = config or ExecutorConfig()
+        self.notifier = notifier or ExecutorNotifier()
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        self._sleep_ms = sleep_ms or (lambda ms: _time.sleep(ms / 1000))
+        self._lock = threading.RLock()
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = threading.Event()
+        self._task_manager: ExecutionTaskManager | None = None
+        self._current_uuid: str | None = None
+        #: brokers removed/demoted by recent executions (ref Executor.java:426-434)
+        self.recently_removed_brokers: set[int] = set()
+        self.recently_demoted_brokers: set[int] = set()
+
+    # ------------------------------------------------------------- state
+    @property
+    def state(self) -> ExecutorState:
+        return self._state
+
+    def has_ongoing_execution(self) -> bool:
+        return self._state is not ExecutorState.NO_TASK_IN_PROGRESS
+
+    def state_json(self) -> dict:
+        """Serialized for the /state endpoint (ref ExecutorState.java)."""
+        out = {"state": self._state.value}
+        tm = self._task_manager
+        if tm is not None:
+            out["taskSummary"] = tm.tracker.summary()
+            out["triggeredUserTaskId"] = self._current_uuid
+        return out
+
+    def stop_execution(self) -> None:
+        """User-triggered stop (ref userTriggeredStopExecution :1145)."""
+        if self.has_ongoing_execution():
+            self._stop_requested.set()
+
+    # ----------------------------------------------------------- execute
+    def execute_proposals(self, proposals: list[ExecutionProposal],
+                          uuid: str = "",
+                          intra_broker_moves: list[IntraBrokerReplicaMove] | None = None,
+                          strategy_names: list[str] | None = None,
+                          strategy_context: StrategyContext | None = None,
+                          throttle_bytes: int | None = None,
+                          removed_brokers: set[int] | None = None,
+                          demoted_brokers: set[int] | None = None,
+                          ) -> ExecutionResult:
+        """Apply proposals to the cluster; blocks until done/stopped (ref
+        ``executeProposals`` ``Executor.java:810`` + ProposalExecutionRunnable).
+        Call from a worker thread for async semantics (the API layer does)."""
+        with self._lock:
+            if self.has_ongoing_execution():
+                raise OngoingExecutionError(
+                    "an execution is already in progress")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested.clear()
+            self._task_manager = ExecutionTaskManager()
+            self._current_uuid = uuid
+        started = self._now_ms()
+        tm = self._task_manager
+        throttler = ReplicationThrottleHelper(
+            self.admin, throttle_bytes
+            if throttle_bytes is not None
+            else self.config.default_replication_throttle_bytes)
+        # Everything after the reservation sits inside try/finally: a
+        # transient admin failure during setup must release the
+        # single-execution reservation, or the executor is wedged in
+        # STARTING_EXECUTION forever.
+        try:
+            tasks = tm.add_execution_proposals(proposals)
+            if intra_broker_moves:
+                tm.add_intra_broker_tasks(intra_broker_moves)
+            planner = ExecutionTaskPlanner(strategy_chain(strategy_names))
+            concurrency = ExecutionConcurrencyManager(
+                self.config.concurrency, list(self.admin.describe_cluster()))
+            adjuster = (ConcurrencyAdjuster(concurrency)
+                        if self.config.concurrency_adjuster_enabled else None)
+            inter = [t for t in tasks
+                     if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
+            throttler.set_throttles(inter)
+            self.notifier.on_execution_started(uuid)
+            self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+            self._run_inter_broker_phase(planner, concurrency, adjuster,
+                                         strategy_context)
+            self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+            self._run_intra_broker_phase(planner, concurrency)
+            self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+            self._run_leadership_phase(planner, concurrency)
+        finally:
+            stopped = self._stop_requested.is_set()
+            if stopped:
+                self._state = ExecutorState.STOPPING_EXECUTION
+                self._abort_in_flight()
+            throttler.clear_throttles()
+            if removed_brokers:
+                self.recently_removed_brokers |= removed_brokers
+            if demoted_brokers:
+                self.recently_demoted_brokers |= demoted_brokers
+            dead = sum(tm.tracker.num_in(t, TaskState.DEAD) for t in TaskType)
+            result = ExecutionResult(
+                uuid=uuid, state_counts=tm.tracker.summary(),
+                started_ms=started, finished_ms=self._now_ms(),
+                stopped=stopped, num_dead_tasks=dead)
+            self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            self.notifier.on_execution_finished(result)
+        return result
+
+    # ------------------------------------------------------------ phases
+    def _run_inter_broker_phase(self, planner, concurrency, adjuster,
+                                strategy_context) -> None:
+        """ref interBrokerMoveReplicas Executor.java:1607: loop planner batch
+        -> alterPartitionReassignments -> poll until finished."""
+        tm = self._task_manager
+        tt = TaskType.INTER_BROKER_REPLICA_ACTION
+        ctx = strategy_context or self._build_strategy_context()
+        while (tm.tracker.num_remaining(tt) > 0
+               and not self._stop_requested.is_set()):
+            pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
+            in_progress = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
+            batch = planner.inter_broker_batch(pending, in_progress,
+                                               concurrency, ctx)
+            if batch:
+                targets = {t.topic_partition: list(t.proposal.new_replicas)
+                           for t in batch}
+                errors = self.admin.alter_partition_reassignments(targets)
+                now = self._now_ms()
+                for t in batch:
+                    if errors.get(t.topic_partition) is None:
+                        tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                    else:
+                        tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                        tm.tracker.transition(t, TaskState.DEAD, now)
+            elif not in_progress:
+                # Nothing in flight and nothing schedulable (all pending
+                # blocked by dead-broker caps): mark the rest dead.
+                now = self._now_ms()
+                for t in pending:
+                    tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                    tm.tracker.transition(t, TaskState.DEAD, now)
+                break
+            self._sleep_ms(self.config.progress_check_interval_ms)
+            self._poll_inter_broker_progress()
+            if adjuster is not None:
+                alive = self.admin.describe_cluster()
+                metrics = {b: self.admin.broker_metrics(b)
+                           for b, up in alive.items() if up}
+                # Partitions at/below min-ISR are the cluster-wide brake
+                # (ref Executor.java:560-584 min-ISR based adjustment).
+                num_min_isr = sum(
+                    1 for info in self.admin.describe_partitions().values()
+                    if len(info.isr) <= 1 and len(info.replicas) > 1)
+                adjuster.refresh(metrics, num_min_isr_partitions=num_min_isr)
+        # A completed reassignment leaves the old leader in charge when it
+        # is still a member of the new replica set; proposals that also
+        # demand a leader change finish with a preferred election (the
+        # reassignment made new_replicas[0] the preferred replica).
+        needs_election = [
+            t.topic_partition
+            for t in tm.tracker.tasks_in(tt, TaskState.COMPLETED)
+            if t.proposal.has_leader_action]
+        if needs_election and not self._stop_requested.is_set():
+            self.admin.elect_preferred_leaders(needs_election)
+
+    def _poll_inter_broker_progress(self) -> None:
+        tm = self._task_manager
+        tt = TaskType.INTER_BROKER_REPLICA_ACTION
+        in_flight = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
+        if not in_flight:
+            return
+        ongoing = self.admin.list_partition_reassignments()
+        alive = self.admin.describe_cluster()
+        now = self._now_ms()
+        cancels: dict[tuple[str, int], None] = {}
+        for t in in_flight:
+            tp = t.topic_partition
+            if tp not in ongoing:
+                tm.tracker.transition(t, TaskState.COMPLETED, now)
+                continue
+            # Dead destination => the copy can never finish (ref
+            # ExecutionUtils.maybeMarkTaskAsDead): cancel + DEAD.
+            dest_dead = any(not alive.get(b, False)
+                            for b in t.proposal.replicas_to_add)
+            timed_out = (t.start_time_ms is not None and
+                         now - t.start_time_ms
+                         > self.config.replica_movement_timeout_ms)
+            if dest_dead or timed_out:
+                cancels[tp] = None
+                tm.tracker.transition(t, TaskState.DEAD, now)
+        if cancels:
+            self.admin.alter_partition_reassignments(cancels)
+
+    def _run_intra_broker_phase(self, planner, concurrency) -> None:
+        """ref intraBrokerMoveReplicas Executor.java:1679 (logdir moves)."""
+        tm = self._task_manager
+        tt = TaskType.INTRA_BROKER_REPLICA_ACTION
+        while (tm.tracker.num_remaining(tt) > 0
+               and not self._stop_requested.is_set()):
+            pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
+            in_progress = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
+            batch = planner.intra_broker_batch(pending, in_progress, concurrency)
+            if batch:
+                moves = {(t.proposal.topic, t.proposal.partition,
+                          t.proposal.broker_id): t.proposal.dest_logdir
+                         for t in batch}
+                errors = self.admin.alter_replica_log_dirs(moves)
+                now = self._now_ms()
+                for t in batch:
+                    key = (t.proposal.topic, t.proposal.partition,
+                           t.proposal.broker_id)
+                    tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                    if errors.get(key) is not None:
+                        tm.tracker.transition(t, TaskState.DEAD, now)
+            elif not in_progress:
+                break
+            self._sleep_ms(self.config.progress_check_interval_ms)
+            dirs = self.admin.describe_replica_log_dirs()
+            alive = self.admin.describe_cluster()
+            now = self._now_ms()
+            for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
+                key = (t.proposal.topic, t.proposal.partition,
+                       t.proposal.broker_id)
+                if dirs.get(key) == t.proposal.dest_logdir:
+                    tm.tracker.transition(t, TaskState.COMPLETED, now)
+                elif not alive.get(t.proposal.broker_id, False):
+                    tm.tracker.transition(t, TaskState.DEAD, now)
+
+    def _run_leadership_phase(self, planner, concurrency) -> None:
+        """ref moveLeaderships Executor.java:1742 -> electLeaders batches."""
+        tm = self._task_manager
+        tt = TaskType.LEADER_ACTION
+        while (tm.tracker.num_remaining(tt) > 0
+               and not self._stop_requested.is_set()):
+            pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
+            batch = planner.leadership_batch(pending, concurrency)
+            if not batch:
+                break
+            # Leadership transfer = make the desired broker the preferred
+            # replica (a metadata-only reorder reassignment), then elect it
+            # (ref ExecutionUtils.java:435 electLeaders; Kafka applies
+            # same-set reassignments instantly).
+            current = self.admin.describe_partitions()
+            reorders = {
+                t.topic_partition: list(t.proposal.new_replicas)
+                for t in batch
+                if (info := current.get(t.topic_partition)) is not None
+                and info.replicas != list(t.proposal.new_replicas)}
+            if reorders:
+                self.admin.alter_partition_reassignments(reorders)
+            errors = self.admin.elect_preferred_leaders(
+                [t.topic_partition for t in batch])
+            now = self._now_ms()
+            for t in batch:
+                tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                tm.tracker.transition(
+                    t,
+                    TaskState.COMPLETED if errors.get(t.topic_partition) is None
+                    else TaskState.DEAD, now)
+            if tm.tracker.num_remaining(tt) > 0:
+                self._sleep_ms(self.config.progress_check_interval_ms)
+
+    # ------------------------------------------------------------ helpers
+    def _abort_in_flight(self) -> None:
+        """On stop: cancel reassignments and mark tasks aborted (ref
+        stopExecution's ABORTING/ABORTED path)."""
+        tm = self._task_manager
+        now = self._now_ms()
+        cancels = {}
+        for tt in TaskType:
+            for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
+                if tt is TaskType.INTER_BROKER_REPLICA_ACTION:
+                    cancels[t.topic_partition] = None
+                tm.tracker.transition(t, TaskState.ABORTING, now)
+                tm.tracker.transition(t, TaskState.ABORTED, now)
+        if cancels:
+            self.admin.alter_partition_reassignments(cancels)
+
+    def _build_strategy_context(self) -> StrategyContext:
+        parts = self.admin.describe_partitions()
+        alive = self.admin.describe_cluster()
+        urp = {tp for tp, info in parts.items()
+               if len(info.isr) < len(info.replicas)}
+        offline = {tp for tp, info in parts.items()
+                   if any(not alive.get(b, False) for b in info.replicas)}
+        return StrategyContext(
+            partition_size_mb={tp: info.size_mb for tp, info in parts.items()},
+            urp=urp,
+            min_isr_with_offline={tp for tp in offline
+                                  if len(parts[tp].isr) <= 1},
+            one_above_min_isr_with_offline={tp for tp in offline
+                                            if len(parts[tp].isr) == 2})

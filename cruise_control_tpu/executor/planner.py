@@ -1,0 +1,89 @@
+"""Execution task planner (ref ``executor/ExecutionTaskPlanner.java``).
+
+Hands the executor per-round batches of movement tasks honoring per-broker
+and cluster concurrency caps, in the order of the configured movement
+strategy chain (``getInterBrokerReplicaMovementTasks``
+``ExecutionTaskPlanner.java:348``, ``getLeadershipMovementTasks`` ``:302``).
+"""
+
+from __future__ import annotations
+
+from .concurrency import ExecutionConcurrencyManager
+from .strategy import ReplicaMovementStrategy, StrategyContext, strategy_chain
+from .tasks import ExecutionTask, TaskType
+
+
+class ExecutionTaskPlanner:
+    def __init__(self, strategy: ReplicaMovementStrategy | None = None):
+        self.strategy = strategy or strategy_chain(None)
+
+    def inter_broker_batch(self, pending: list[ExecutionTask],
+                           in_progress: list[ExecutionTask],
+                           concurrency: ExecutionConcurrencyManager,
+                           ctx: StrategyContext | None = None
+                           ) -> list[ExecutionTask]:
+        """Next batch of inter-broker movements.
+
+        A movement occupies a slot on every broker it adds a replica to AND
+        every broker it removes one from (ref
+        ``ExecutionTaskPlanner.java:348-420`` tracking both sides' in-progress
+        counts); the cluster-wide cap bounds total concurrent movements.
+        """
+        ctx = ctx or StrategyContext()
+        slots: dict[int, int] = {}
+        for t in in_progress:
+            for b in (*t.proposal.replicas_to_add, *t.proposal.replicas_to_remove):
+                slots[b] = slots.get(b, 0) + 1
+        budget = concurrency.cluster_movement_cap - len(in_progress)
+        batch: list[ExecutionTask] = []
+        for task in sorted(pending, key=lambda t: self.strategy.key(t, ctx)):
+            if budget <= 0:
+                break
+            brokers = (*task.proposal.replicas_to_add,
+                       *task.proposal.replicas_to_remove)
+            if any(slots.get(b, 0) >= concurrency.inter_broker_cap(b)
+                   for b in brokers):
+                continue
+            for b in brokers:
+                slots[b] = slots.get(b, 0) + 1
+            batch.append(task)
+            budget -= 1
+        return batch
+
+    def leadership_batch(self, pending: list[ExecutionTask],
+                         concurrency: ExecutionConcurrencyManager
+                         ) -> list[ExecutionTask]:
+        """Next batch of leadership movements: cluster cap plus a per-broker
+        cap on the broker *gaining* leadership (ref
+        ``ExecutionTaskPlanner.java:302-340``)."""
+        cap = concurrency.leadership_cluster_cap
+        per_broker: dict[int, int] = {}
+        batch: list[ExecutionTask] = []
+        for task in pending:
+            if len(batch) >= cap:
+                break
+            leader = task.proposal.new_leader
+            if per_broker.get(leader, 0) >= concurrency.leadership_broker_cap:
+                continue
+            per_broker[leader] = per_broker.get(leader, 0) + 1
+            batch.append(task)
+        return batch
+
+    def intra_broker_batch(self, pending: list[ExecutionTask],
+                           in_progress: list[ExecutionTask],
+                           concurrency: ExecutionConcurrencyManager
+                           ) -> list[ExecutionTask]:
+        """Next batch of intra-broker (disk) movements: per-broker cap on
+        concurrent logdir copies (ref ExecutionTaskPlanner's intra path)."""
+        slots: dict[int, int] = {}
+        for t in in_progress:
+            b = t.proposal.broker_id
+            slots[b] = slots.get(b, 0) + 1
+        batch: list[ExecutionTask] = []
+        for task in pending:
+            b = task.proposal.broker_id
+            if slots.get(b, 0) >= concurrency.intra_broker_cap:
+                continue
+            slots[b] = slots.get(b, 0) + 1
+            batch.append(task)
+        return batch

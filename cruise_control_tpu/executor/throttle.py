@@ -1,0 +1,86 @@
+"""Replication throttling around executions
+(ref ``executor/ReplicationThrottleHelper.java``).
+
+Before inter-broker movements start, set the leader/follower throttled-rate
+config on every participating broker and mark the moving replicas in each
+topic's throttled-replicas lists; after execution (or on stop), remove
+exactly what we added — configs set by operators are left intact (ref
+``ReplicationThrottleHelper`` only clears values it wrote).
+"""
+
+from __future__ import annotations
+
+from .admin import ClusterAdminClient
+from .simulated import (FOLLOWER_THROTTLED_RATE, FOLLOWER_THROTTLED_REPLICAS,
+                        LEADER_THROTTLED_RATE, LEADER_THROTTLED_REPLICAS)
+from .tasks import ExecutionTask
+
+
+class ReplicationThrottleHelper:
+    def __init__(self, admin: ClusterAdminClient,
+                 throttle_rate_bytes: int | None):
+        self.admin = admin
+        self.rate = throttle_rate_bytes
+        self._touched_brokers: set[tuple[int, str]] = set()  # (broker, key)
+        #: topic -> key -> replica entries ("partition:broker") we added
+        self._touched_topics: dict[str, dict[str, set[str]]] = {}
+
+    def set_throttles(self, tasks: list[ExecutionTask]) -> None:
+        if self.rate is None:
+            return
+        brokers: set[int] = set()
+        by_topic: dict[str, dict[str, set[str]]] = {}
+        for t in tasks:
+            p = t.proposal
+            # Old replicas serve the copies (leader-side throttle), new ones
+            # receive them (follower-side) — all participate. Keeping the
+            # two lists separate matters: putting an existing in-sync
+            # follower in the follower list would throttle its ordinary
+            # replication fetches and risk dropping it out of ISR.
+            for b in (*p.old_replicas, *p.replicas_to_add):
+                brokers.add(b)
+            lists = by_topic.setdefault(
+                p.topic, {LEADER_THROTTLED_REPLICAS: set(),
+                          FOLLOWER_THROTTLED_REPLICAS: set()})
+            # Kafka's "partition:broker" entry format.
+            for b in p.old_replicas:
+                lists[LEADER_THROTTLED_REPLICAS].add(f"{p.partition}:{b}")
+            for b in p.replicas_to_add:
+                lists[FOLLOWER_THROTTLED_REPLICAS].add(f"{p.partition}:{b}")
+        for b in brokers:
+            existing = self.admin.describe_broker_config(b)
+            cfg: dict[str, str | None] = {}
+            # Don't override an operator-set rate; only fill absent keys
+            # (and later clear exactly the keys we wrote).
+            for key in (LEADER_THROTTLED_RATE, FOLLOWER_THROTTLED_RATE):
+                if key not in existing:
+                    cfg[key] = str(self.rate)
+                    self._touched_brokers.add((b, key))
+            if cfg:
+                self.admin.alter_broker_config(b, cfg)
+        for topic, lists in by_topic.items():
+            existing = self.admin.describe_topic_config(topic)
+            added = self._touched_topics.setdefault(
+                topic, {LEADER_THROTTLED_REPLICAS: set(),
+                        FOLLOWER_THROTTLED_REPLICAS: set()})
+            for key, entries in lists.items():
+                prev = set(filter(None, existing.get(key, "").split(",")))
+                new = prev | entries
+                if new != prev:
+                    added[key] |= entries - prev
+                    self.admin.alter_topic_config(
+                        topic, {key: ",".join(sorted(new))})
+
+    def clear_throttles(self) -> None:
+        for b, key in self._touched_brokers:
+            self.admin.alter_broker_config(b, {key: None})
+        self._touched_brokers.clear()
+        for topic, added in self._touched_topics.items():
+            existing = self.admin.describe_topic_config(topic)
+            for key, entries in added.items():
+                prev = set(filter(None, existing.get(key, "").split(",")))
+                remaining = prev - entries
+                self.admin.alter_topic_config(
+                    topic, {key: ",".join(sorted(remaining)) if remaining
+                            else None})
+        self._touched_topics.clear()

@@ -1,0 +1,253 @@
+"""Executor layer tests: proposals -> execution -> converged simulated
+cluster (the rebuild of ExecutorTest's embedded-Kafka scenarios, run against
+the deterministic SimulatedKafkaCluster with a SimClock — no sleeps)."""
+
+import pytest
+
+from cruise_control_tpu.executor import (
+    ConcurrencyAdjuster, ConcurrencyConfig, ExecutionConcurrencyManager,
+    Executor, ExecutorConfig, ExecutorNotifier, ExecutorState,
+    IntraBrokerReplicaMove, OngoingExecutionError, SimClock,
+    SimulatedKafkaCluster, TaskState, TaskType, strategy_chain)
+from cruise_control_tpu.executor.simulated import (FOLLOWER_THROTTLED_RATE,
+                                                   LEADER_THROTTLED_RATE)
+from cruise_control_tpu.executor.strategy import (
+    PrioritizeSmallReplicaMovementStrategy, StrategyContext)
+from cruise_control_tpu.executor.tasks import ExecutionTask
+from cruise_control_tpu.model.proposals import ExecutionProposal
+
+
+def make_cluster(num_brokers=4, partitions=8, size_mb=50.0, rate=100.0):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=rate, logdirs=("logdir0", "logdir1"))
+    for p in range(partitions):
+        sim.add_partition("t", p, [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=size_mb)
+    return sim
+
+
+def make_executor(sim, **cfg_kwargs):
+    clock = SimClock(sim)
+    cfg = ExecutorConfig(progress_check_interval_ms=100, **cfg_kwargs)
+    return Executor(sim, cfg, now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+
+
+def test_inter_broker_and_leadership_execution_converges():
+    sim = make_cluster()
+    ex = make_executor(sim)
+    # Move partition 0's follower from broker 1 to broker 2, and transfer
+    # partition 1's leadership to its follower.
+    proposals = [
+        ExecutionProposal("t", 0, old_leader=0, old_replicas=(0, 1),
+                          new_replicas=(0, 2)),
+        ExecutionProposal("t", 1, old_leader=1, old_replicas=(1, 2),
+                          new_replicas=(2, 1)),
+    ]
+    res = ex.execute_proposals(proposals, uuid="u1")
+    assert res.succeeded
+    parts = sim.describe_partitions()
+    assert parts[("t", 0)].replicas == [0, 2]
+    assert parts[("t", 1)].leader == 2
+    assert not sim.list_partition_reassignments()
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+    # tracker: all tasks completed
+    assert res.state_counts[TaskType.INTER_BROKER_REPLICA_ACTION.value] == {
+        "COMPLETED": 1}
+    assert res.state_counts[TaskType.LEADER_ACTION.value] == {"COMPLETED": 1}
+
+
+def test_leadership_election_requires_new_preferred_order():
+    """A leadership-only proposal reorders replicas; preferred election in
+    the sim uses replicas[0], so the reassignment path runs first."""
+    sim = make_cluster()
+    # Reordering (1,2)->(2,1) is a replica action in Kafka terms (the
+    # replica list changes), executed via reassignment then election.
+    proposals = [ExecutionProposal("t", 1, old_leader=1, old_replicas=(1, 2),
+                                   new_replicas=(2, 1))]
+    ex = make_executor(sim)
+    res = ex.execute_proposals(proposals)
+    assert res.succeeded
+    assert sim.describe_partitions()[("t", 1)].leader == 2
+
+
+def test_per_broker_concurrency_batches():
+    """With per-broker cap 1, moves sharing a destination serialize into
+    multiple reassignment batches."""
+    sim = make_cluster(num_brokers=4, partitions=6, size_mb=10.0)
+    cfg = ConcurrencyConfig(num_concurrent_partition_movements_per_broker=1)
+    ex = Executor(sim, ExecutorConfig(progress_check_interval_ms=100,
+                                      concurrency=cfg,
+                                      concurrency_adjuster_enabled=False),
+                  now_ms=SimClock(sim).now_ms, sleep_ms=SimClock(sim).sleep_ms)
+    # All six proposals move a replica onto broker 3.
+    proposals = []
+    for p in range(6):
+        old = [p % 4, (p + 1) % 4]
+        if 3 in old:
+            continue
+        proposals.append(ExecutionProposal("t", p, old_leader=old[0],
+                                           old_replicas=tuple(old),
+                                           new_replicas=(old[0], 3)))
+    res = ex.execute_proposals(proposals)
+    assert res.succeeded
+    # one destination slot => one movement per batch
+    assert sim.num_reassignment_batches >= len(proposals)
+    for p in proposals:
+        assert 3 in sim.describe_partitions()[("t", p.partition)].replicas
+
+
+def test_broker_death_mid_flight_marks_tasks_dead_and_cleans_up():
+    sim = make_cluster(num_brokers=4, partitions=4, size_mb=1000.0, rate=10.0)
+    clock = SimClock(sim)
+    cfg = ExecutorConfig(progress_check_interval_ms=100)
+    killed = []
+
+    class KillAfterFirstPoll(ExecutorNotifier):
+        pass
+
+    ex = Executor(sim, cfg, now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    # Kill the destination broker after the first progress poll by hooking
+    # the sleep: the copy (1000MB at 10MB/s) cannot finish in one interval.
+    orig_sleep = clock.sleep_ms
+
+    def sleeping(ms):
+        orig_sleep(ms)
+        if not killed:
+            sim.kill_broker(3)
+            killed.append(True)
+
+    ex._sleep_ms = sleeping
+    proposals = [ExecutionProposal("t", 0, old_leader=0, old_replicas=(0, 1),
+                                   new_replicas=(0, 3))]
+    res = ex.execute_proposals(proposals)
+    assert not res.succeeded
+    assert res.num_dead_tasks == 1
+    # reassignment cancelled, replica set unchanged
+    assert not sim.list_partition_reassignments()
+    assert sim.describe_partitions()[("t", 0)].replicas == [0, 1]
+    assert ex.state is ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_stop_execution_aborts_cleanly():
+    sim = make_cluster(num_brokers=4, partitions=4, size_mb=1000.0, rate=10.0)
+    clock = SimClock(sim)
+    ex = Executor(sim, ExecutorConfig(progress_check_interval_ms=100),
+                  now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    orig_sleep = clock.sleep_ms
+    stopped = []
+
+    def sleeping(ms):
+        orig_sleep(ms)
+        if not stopped:
+            ex.stop_execution()
+            stopped.append(True)
+
+    ex._sleep_ms = sleeping
+    proposals = [ExecutionProposal("t", 0, old_leader=0, old_replicas=(0, 1),
+                                   new_replicas=(0, 2))]
+    res = ex.execute_proposals(proposals)
+    assert res.stopped
+    counts = res.state_counts[TaskType.INTER_BROKER_REPLICA_ACTION.value]
+    assert counts.get("ABORTED", 0) == 1
+    assert not sim.list_partition_reassignments()
+
+
+def test_throttles_set_and_cleared():
+    sim = make_cluster(size_mb=10.0)
+    clock = SimClock(sim)
+    seen = {}
+    orig_sleep = clock.sleep_ms
+
+    def sleeping(ms):
+        if not seen:
+            seen["broker0"] = sim.describe_broker_config(0)
+            seen["topic"] = sim.describe_topic_config("t")
+        orig_sleep(ms)
+
+    ex = Executor(sim, ExecutorConfig(progress_check_interval_ms=100,
+                                      default_replication_throttle_bytes=50_000_000),
+                  now_ms=clock.now_ms, sleep_ms=sleeping)
+    proposals = [ExecutionProposal("t", 0, old_leader=0, old_replicas=(0, 1),
+                                   new_replicas=(0, 2))]
+    res = ex.execute_proposals(proposals)
+    assert res.succeeded
+    # throttles present during execution...
+    assert seen["broker0"][LEADER_THROTTLED_RATE] == "50000000"
+    assert "0:2" in seen["topic"][FOLLOWER_THROTTLED_RATE.replace(
+        "rate", "replicas")]
+    # ...and fully cleared afterwards
+    assert LEADER_THROTTLED_RATE not in sim.describe_broker_config(0)
+    assert FOLLOWER_THROTTLED_RATE not in sim.describe_broker_config(2)
+    assert sim.describe_topic_config("t") == {}
+
+
+def test_throttle_preserves_operator_configs():
+    sim = make_cluster(size_mb=10.0)
+    sim.alter_broker_config(0, {LEADER_THROTTLED_RATE: "123"})
+    ex = make_executor(sim)
+    ex.config.default_replication_throttle_bytes = 999
+    proposals = [ExecutionProposal("t", 0, old_leader=0, old_replicas=(0, 1),
+                                   new_replicas=(0, 2))]
+    ex.execute_proposals(proposals)
+    # operator-set rate untouched
+    assert sim.describe_broker_config(0)[LEADER_THROTTLED_RATE] == "123"
+
+
+def test_intra_broker_logdir_moves():
+    sim = make_cluster(size_mb=10.0)
+    ex = make_executor(sim)
+    moves = [IntraBrokerReplicaMove("t", 0, broker_id=0,
+                                    source_logdir="logdir0",
+                                    dest_logdir="logdir1", size_mb=10.0)]
+    res = ex.execute_proposals([], intra_broker_moves=moves)
+    assert res.succeeded
+    assert sim.describe_replica_log_dirs()[("t", 0, 0)] == "logdir1"
+
+
+def test_concurrent_execution_rejected():
+    sim = make_cluster()
+    ex = make_executor(sim)
+    ex._state = ExecutorState.STARTING_EXECUTION  # simulate ongoing
+    with pytest.raises(OngoingExecutionError):
+        ex.execute_proposals([])
+    ex._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+
+def test_adjuster_aimd():
+    mgr = ExecutionConcurrencyManager(ConcurrencyConfig(), [0, 1])
+    adj = ConcurrencyAdjuster(mgr)
+    base = mgr.inter_broker_cap(0)
+    adj.refresh({0: {"request_queue_size": 0.0}, 1: {"request_queue_size": 0.0}})
+    assert mgr.inter_broker_cap(0) == base + 1
+    adj.refresh({0: {"request_queue_size": 1e9}, 1: {}})
+    assert mgr.inter_broker_cap(0) == (base + 1) // 2
+    assert mgr.inter_broker_cap(1) == base + 2
+    # min-ISR stress halves everyone and the leadership cap
+    lead = mgr.leadership_cluster_cap
+    adj.refresh({1: {}}, num_min_isr_partitions=3)
+    assert mgr.inter_broker_cap(1) <= (base + 2) // 2 + 1
+    assert mgr.leadership_cluster_cap <= lead
+
+
+def test_strategy_ordering():
+    ctx = StrategyContext(partition_size_mb={("t", 0): 100.0, ("t", 1): 1.0},
+                          urp={("t", 1)})
+    small = strategy_chain(["PrioritizeSmallReplicaMovementStrategy"])
+    t0 = ExecutionTask(0, ExecutionProposal("t", 0, 0, (0, 1), (0, 2)),
+                       TaskType.INTER_BROKER_REPLICA_ACTION)
+    t1 = ExecutionTask(1, ExecutionProposal("t", 1, 0, (0, 1), (0, 2)),
+                       TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert sorted([t0, t1], key=lambda t: small.key(t, ctx))[0] is t1
+    postpone = strategy_chain(["PostponeUrpReplicaMovementStrategy"])
+    assert sorted([t0, t1], key=lambda t: postpone.key(t, ctx))[0] is t0
+
+
+def test_task_state_machine_rejects_illegal_transitions():
+    t = ExecutionTask(0, ExecutionProposal("t", 0, 0, (0, 1), (0, 2)),
+                      TaskType.INTER_BROKER_REPLICA_ACTION)
+    with pytest.raises(ValueError):
+        t.transition(TaskState.COMPLETED, 0)  # PENDING -> COMPLETED illegal
+    t.transition(TaskState.IN_PROGRESS, 1)
+    t.transition(TaskState.COMPLETED, 2)
+    assert t.done and t.end_time_ms == 2
