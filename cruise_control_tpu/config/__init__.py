@@ -1,0 +1,10 @@
+"""Configuration subsystem: capacity resolution, broker sets, topic config
+providers, and the config-constant registry (ref ``config/`` +
+``config/constants/``)."""
+
+from .capacity import (BrokerCapacityConfigResolver, BrokerCapacityInfo,
+                       DEFAULT_CAPACITY, FileCapacityResolver,
+                       FixedCapacityResolver)
+
+__all__ = ["BrokerCapacityConfigResolver", "BrokerCapacityInfo",
+           "DEFAULT_CAPACITY", "FileCapacityResolver", "FixedCapacityResolver"]

@@ -1,0 +1,29 @@
+"""Monitor layer (L2): metric ingestion -> cluster model factory.
+
+Rebuild of ``cruise-control/.../monitor/``: samplers (:mod:`.sampler`),
+the raw-metrics processor with CPU attribution (:mod:`.processor`), sample
+persistence/replay (:mod:`.store`), fetch fan-out (:mod:`.fetcher`),
+completeness gating (:mod:`.requirements`), the load monitor itself
+(:mod:`.monitor`) and the sampling state machine (:mod:`.task_runner`).
+"""
+
+from .fetcher import MetricFetcherManager
+from .monitor import (ClusterModelResult, LoadMonitor, LoadMonitorState,
+                      MonitorConfig, NotEnoughValidWindowsException)
+from .processor import CruiseControlMetricsProcessor
+from .requirements import ModelCompletenessRequirements
+from .sampler import (AgentTopicSampler, MetricSampler, SamplerAssignment,
+                      Samples, SyntheticWorkloadSampler)
+from .samples import BrokerMetricSample, PartitionMetricSample
+from .store import FileSampleStore, NoopSampleStore, SampleStore
+from .task_runner import LoadMonitorTaskRunner, RunnerState
+
+__all__ = [
+    "MetricFetcherManager", "ClusterModelResult", "LoadMonitor",
+    "LoadMonitorState", "MonitorConfig", "NotEnoughValidWindowsException",
+    "CruiseControlMetricsProcessor", "ModelCompletenessRequirements",
+    "AgentTopicSampler", "MetricSampler", "SamplerAssignment", "Samples",
+    "SyntheticWorkloadSampler", "BrokerMetricSample", "PartitionMetricSample",
+    "FileSampleStore", "NoopSampleStore", "SampleStore",
+    "LoadMonitorTaskRunner", "RunnerState",
+]

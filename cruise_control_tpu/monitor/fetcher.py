@@ -1,0 +1,51 @@
+"""Metric fetch fan-out (ref ``monitor/sampling/MetricFetcherManager.java:37``
+and ``SamplingFetcher.java:31``).
+
+Splits the partition universe into N shards and runs the sampler once per
+shard — in a thread pool, like the reference's fetcher threads — then
+funnels every shard's samples through the sample store and into the load
+monitor's aggregators.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+
+from .sampler import MetricSampler, SamplerAssignment, Samples
+from .store import NoopSampleStore, SampleStore
+
+
+class MetricFetcherManager:
+    def __init__(self, sampler: MetricSampler, num_fetchers: int = 1,
+                 store: SampleStore | None = None) -> None:
+        self.sampler = sampler
+        self.num_fetchers = max(1, num_fetchers)
+        self.store = store or NoopSampleStore()
+
+    def fetch(self, partitions: list[tuple[str, int]], brokers: list[int],
+              start_ms: int, end_ms: int) -> Samples:
+        """One sampling round across all shards (ref
+        fetchMetricsFor... methods).
+
+        Sharding only applies to samplers that declare ``parallel_safe``:
+        samplers with cross-partition state (the agent-topic sampler's
+        processor buffer, the synthetic sampler's per-broker sums) must see
+        the whole assignment in one call or they would race / double-count.
+        """
+        parallel_safe = getattr(self.sampler, "parallel_safe", False)
+        n = self.num_fetchers if parallel_safe else 1
+        shards = [SamplerAssignment(partitions=partitions[i::n],
+                                    brokers=(brokers if i == 0 else []),
+                                    start_ms=start_ms, end_ms=end_ms)
+                  for i in range(n)]
+        if n == 1:
+            results = [self.sampler.get_samples(shards[0])]
+        else:
+            with ThreadPoolExecutor(max_workers=n) as pool:
+                results = list(pool.map(self.sampler.get_samples, shards))
+        merged = Samples([], [])
+        for r in results:
+            merged.partition_samples.extend(r.partition_samples)
+            merged.broker_samples.extend(r.broker_samples)
+        self.store.store_samples(merged)
+        return merged

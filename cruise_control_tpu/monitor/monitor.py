@@ -1,0 +1,264 @@
+"""The load monitor: metric ingestion -> cluster model factory.
+
+Rebuild of ``monitor/LoadMonitor.java:78``. Owns the partition/broker
+windowed aggregators, the capacity resolver, and the metadata source (the
+cluster admin client — same SPI the executor uses); ``cluster_model()``
+(ref ``:439``) aggregates the retained windows, checks the caller's
+completeness requirements, attributes per-replica loads, and flattens
+everything into a ``FlatClusterModel`` ready for the TPU analyzer.
+
+Window semantics: each partition's expected utilization is the mean over
+its valid aggregated windows (the vectorized equivalent of
+``Load.expectedUtilizationFor`` averaging ``AggregatedMetricValues`` rows);
+the per-window arrays are preserved on the result for the /load endpoint
+and for anomaly detection percentiles.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..core.aggregator import (AggregationGranularity, AggregationOptions,
+                               Extrapolation, MetricSampleAggregator,
+                               MetricSampleCompleteness,
+                               NotEnoughValidWindowsError)
+from ..core.metricdef import (KafkaMetric, broker_metric_def,
+                              partition_metric_def)
+from ..config.capacity import (BrokerCapacityConfigResolver,
+                               FixedCapacityResolver)
+from ..model.spec import BrokerSpec, ClusterSpec, PartitionSpec, flatten_spec
+from .requirements import ModelCompletenessRequirements
+from .sampler import Samples
+
+
+class NotEnoughValidWindowsException(NotEnoughValidWindowsError):
+    """Alias with the reference's exception name."""
+
+
+@dataclass
+class MonitorConfig:
+    """Subset of MonitorConfig constants (ref config/constants/MonitorConfig:
+    num.partition.metrics.windows=5, partition.metrics.window.ms=3600000,
+    min.samples.per.partition.metrics.window=1, broker variants)."""
+
+    num_windows: int = 5
+    window_ms: int = 3_600_000
+    min_samples_per_window: int = 1
+    num_broker_windows: int = 20
+    broker_window_ms: int = 300_000
+    min_samples_per_broker_window: int = 1
+    max_allowed_extrapolations_per_partition: int = 5
+    #: follower CPU as a fraction of the leader's attributed CPU (ref
+    #: ModelUtils leader/follower CPU estimation).
+    follower_cpu_ratio: float = 0.5
+
+
+@dataclass
+class LoadMonitorState:
+    """Serialized into /state (ref LoadMonitorState.java)."""
+
+    state: str
+    num_valid_windows: int
+    num_total_windows: int
+    valid_partition_ratio: float
+    num_monitored_partitions: int
+    generation: int
+
+    def to_json(self) -> dict:
+        return {"state": self.state,
+                "numValidWindows": self.num_valid_windows,
+                "numTotalWindows": self.num_total_windows,
+                "validPartitionsRatio": self.valid_partition_ratio,
+                "numMonitoredPartitions": self.num_monitored_partitions,
+                "generation": self.generation}
+
+
+@dataclass
+class ClusterModelResult:
+    """A flattened model + everything the API layers want alongside it."""
+
+    model: object               # FlatClusterModel
+    metadata: object            # ClusterMetadata
+    spec: ClusterSpec
+    completeness: MetricSampleCompleteness
+    #: (topic, partition) -> [num_metrics, num_windows] window values
+    partition_windows: dict[tuple[str, int], np.ndarray]
+    window_times_ms: list[int]
+    generation: int
+
+
+class LoadMonitor:
+    """ref LoadMonitor.java:78."""
+
+    def __init__(self, admin, config: MonitorConfig | None = None,
+                 capacity_resolver: BrokerCapacityConfigResolver | None = None,
+                 rack_by_broker: dict[int, str] | None = None,
+                 max_concurrent_model_builds: int = 2) -> None:
+        self.admin = admin
+        self.config = config or MonitorConfig()
+        self.capacity_resolver = capacity_resolver or FixedCapacityResolver()
+        self.rack_by_broker = rack_by_broker or {}
+        c = self.config
+        self.partition_aggregator = MetricSampleAggregator(
+            c.num_windows, c.window_ms, c.min_samples_per_window,
+            partition_metric_def(), entity_group_fn=lambda tp: tp[0])
+        self.broker_aggregator = MetricSampleAggregator(
+            c.num_broker_windows, c.broker_window_ms,
+            c.min_samples_per_broker_window, broker_metric_def())
+        #: bounds concurrent model builds (ref the model-generation
+        #: semaphore LoadMonitor.java:94,396); thread-safety of ingest lives
+        #: inside MetricSampleAggregator's own lock.
+        self._model_semaphore = threading.Semaphore(max_concurrent_model_builds)
+
+    # -------------------------------------------------------------- ingest
+    def add_samples(self, samples: Samples) -> None:
+        for s in samples.partition_samples:
+            self.partition_aggregator.add_sample(s.to_aggregator_sample())
+        for s in samples.broker_samples:
+            self.broker_aggregator.add_sample(s.to_aggregator_sample())
+
+    @property
+    def generation(self) -> int:
+        """Model generation: bumps when aggregation windows roll (the
+        proposal cache's staleness key, ref ModelGeneration)."""
+        return self.partition_aggregator.generation
+
+    def retain_current_topology(self) -> None:
+        """Drop aggregator state for partitions no longer in the cluster
+        (ref LoadMonitor's aggregator cleaner :813)."""
+        tps = set(self.admin.describe_partitions())
+        self.partition_aggregator.retain_entities(tps)
+        self.broker_aggregator.retain_entities(
+            set(self.admin.describe_cluster()))
+
+    # --------------------------------------------------------------- reads
+    def meets_completeness_requirements(
+            self, requirements: ModelCompletenessRequirements,
+            now_ms: int) -> bool:
+        """ref LoadMonitor.meetCompletenessRequirements (:655)."""
+        try:
+            completeness = self._aggregate(now_ms, requirements).completeness
+        except NotEnoughValidWindowsError:
+            return False
+        return requirements.met_by(completeness)
+
+    def state(self, now_ms: int) -> LoadMonitorState:
+        try:
+            result = self._aggregate(
+                now_ms, ModelCompletenessRequirements(min_required_num_windows=0))
+            valid_ratio = result.completeness.valid_entity_ratio
+            valid_windows = len(result.completeness.valid_windows)
+        except NotEnoughValidWindowsError:
+            valid_ratio, valid_windows = 0.0, 0
+        return LoadMonitorState(
+            state="RUNNING",
+            num_valid_windows=valid_windows,
+            num_total_windows=self.partition_aggregator.num_available_windows(),
+            valid_partition_ratio=valid_ratio,
+            num_monitored_partitions=len(
+                self.partition_aggregator.all_entities()),
+            generation=self.generation)
+
+    def _aggregate(self, now_ms: int,
+                   requirements: ModelCompletenessRequirements):
+        interested = set(self.admin.describe_partitions())
+        options = AggregationOptions(
+            min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+            min_valid_windows=requirements.min_required_num_windows,
+            max_allowed_extrapolations_per_entity=
+                self.config.max_allowed_extrapolations_per_partition,
+            granularity=(AggregationGranularity.ENTITY_GROUP
+                         if requirements.include_all_topics
+                         else AggregationGranularity.ENTITY),
+            interested_entities=interested)
+        return self.partition_aggregator.aggregate(0, now_ms, options)
+
+    def cluster_model(self, now_ms: int,
+                      requirements: ModelCompletenessRequirements | None = None,
+                      *, populate_replica_placement_only: bool = False
+                      ) -> ClusterModelResult:
+        """Build the flattened cluster model (ref LoadMonitor.clusterModel
+        :439). Raises NotEnoughValidWindowsError when the sample history
+        cannot satisfy ``requirements``."""
+        requirements = requirements or ModelCompletenessRequirements()
+        with self._model_semaphore:
+            return self._build_model(now_ms, requirements,
+                                     populate_replica_placement_only)
+
+    def _build_model(self, now_ms, requirements, placement_only):
+        partitions = self.admin.describe_partitions()
+        alive = self.admin.describe_cluster()
+        result = None
+        if not placement_only:
+            try:
+                result = self._aggregate(now_ms, requirements)
+            except NotEnoughValidWindowsError as e:
+                raise NotEnoughValidWindowsException(str(e)) from None
+            if not requirements.met_by(result.completeness):
+                raise NotEnoughValidWindowsException(
+                    f"completeness {result.completeness.valid_entity_ratio:.2f} "
+                    f"/ {len(result.completeness.valid_windows)} windows does "
+                    f"not meet {requirements}")
+
+        c = self.config
+        brokers: list[BrokerSpec] = []
+        for broker_id, is_alive in sorted(alive.items()):
+            rack = self.rack_by_broker.get(broker_id, f"rack-{broker_id}")
+            cap = self.capacity_resolver.capacity_for_broker(
+                rack, f"host-{broker_id}", broker_id)
+            brokers.append(BrokerSpec(
+                broker_id=broker_id, rack=rack, capacity=cap.as_vector(),
+                alive=is_alive))
+
+        pspecs: list[PartitionSpec] = []
+        windows: dict[tuple[str, int], np.ndarray] = {}
+        window_times: list[int] = []
+        for tp, info in sorted(partitions.items()):
+            leader_load = (0.0, 0.0, 0.0, float(info.size_mb))
+            follower_load = None
+            if result is not None:
+                vae = result.entity_values.get(tp)
+                valid_cols = [j for j, e in enumerate(vae.extrapolations)
+                              if e is not Extrapolation.NO_VALID_EXTRAPOLATION
+                              ] if vae is not None else []
+                if vae is not None and valid_cols:
+                    windows[tp] = vae.values
+                    window_times = vae.window_times_ms
+                    # Mean over *valid* windows only — invalid windows are
+                    # zero-filled columns that would silently dilute the load.
+                    mean = vae.values[:, valid_cols].mean(axis=1)
+                    cpu = float(mean[KafkaMetric.CPU_USAGE])
+                    nw_in = float(mean[KafkaMetric.LEADER_BYTES_IN])
+                    nw_out = float(mean[KafkaMetric.LEADER_BYTES_OUT])
+                    disk = float(mean[KafkaMetric.DISK_USAGE])
+                    leader_load = (cpu, nw_in, nw_out, disk)
+                    follower_load = (cpu * c.follower_cpu_ratio, nw_in, 0.0,
+                                     disk)
+            offline = [b for b in info.replicas if not alive.get(b, False)]
+            pspecs.append(PartitionSpec(
+                topic=tp[0], partition=tp[1], replicas=list(info.replicas),
+                leader_load=leader_load, follower_load=follower_load,
+                offline_replicas=offline))
+
+        spec = ClusterSpec(brokers=brokers, partitions=pspecs)
+        model, metadata = flatten_spec(spec)
+        return ClusterModelResult(
+            model=model, metadata=metadata, spec=spec,
+            completeness=(result.completeness if result is not None
+                          else MetricSampleCompleteness(generation=self.generation)),
+            partition_windows=windows, window_times_ms=window_times,
+            generation=self.generation)
+
+    def broker_window_stats(self, now_ms: int) -> dict[int, np.ndarray]:
+        """Per-broker [num_metrics, num_windows] aggregates (feeds slow-broker
+        and metric-anomaly detection)."""
+        try:
+            result = self.broker_aggregator.aggregate(
+                0, now_ms, AggregationOptions(min_valid_windows=0))
+        except NotEnoughValidWindowsError:
+            return {}
+        return {entity: vae.values
+                for entity, vae in result.entity_values.items()}

@@ -1,0 +1,153 @@
+"""Raw metrics -> metric samples, with CPU attribution.
+
+Rebuild of ``monitor/sampling/CruiseControlMetricsProcessor.java`` (+
+``SamplingUtils.java`` / ``ModelUtils.estimateLeaderCpuUtil``): buffers the
+raw :class:`CruiseControlMetric` records a sampler polled from the agent
+transport, then per window emits
+
+- one :class:`BrokerMetricSample` per broker with reported metrics, and
+- one :class:`PartitionMetricSample` per *leader* partition, whose CPU is
+  attributed from its broker's CPU by the partition's share of the broker's
+  leader bytes in+out (the reference's core estimation trick — per-partition
+  CPU is not directly measurable).
+
+Topic-level byte rates are apportioned to the topic's partitions on that
+broker by partition size share when sizes are known, else uniformly.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+from ..core.metricdef import BrokerMetric, KafkaMetric
+from ..reporter.metrics import CruiseControlMetric, MetricScope, RawMetricType
+from .samples import BrokerMetricSample, PartitionMetricSample
+from .sampler import SamplerAssignment, Samples
+
+#: follower CPU as a fraction of leader CPU for the same bytes (ref
+#: ModelUtils.FOLLOWER_FETCH_... estimation constants).
+DEFAULT_CPU_UTIL_FOR_MISSING = 0.0
+
+
+@dataclass
+class _BrokerLoad:
+    """Per-broker view of one processing window (ref holder/BrokerLoad.java)."""
+
+    broker_metrics: dict[RawMetricType, float] = field(default_factory=dict)
+    #: topic -> RawMetricType -> value
+    topic_metrics: dict[str, dict[RawMetricType, float]] = field(
+        default_factory=lambda: defaultdict(dict))
+    #: (topic, partition) -> size MB
+    partition_sizes: dict[tuple[str, int], float] = field(default_factory=dict)
+
+
+class CruiseControlMetricsProcessor:
+    def __init__(self) -> None:
+        self._records: list[CruiseControlMetric] = []
+
+    def add_metrics(self, records: list[CruiseControlMetric]) -> None:
+        self._records.extend(records)
+
+    def process(self, assignment: SamplerAssignment) -> Samples:
+        """Convert buffered records into samples for the assignment window
+        (ref CruiseControlMetricsProcessor.process). Clears the buffer."""
+        loads: dict[int, _BrokerLoad] = defaultdict(_BrokerLoad)
+        times: dict[int, int] = {}
+        for r in self._records:
+            if not (assignment.start_ms <= r.time_ms < assignment.end_ms):
+                continue
+            bl = loads[r.broker_id]
+            times[r.broker_id] = max(times.get(r.broker_id, 0), r.time_ms)
+            if r.metric_type.scope is MetricScope.BROKER:
+                bl.broker_metrics[r.metric_type] = r.value
+            elif r.metric_type.scope is MetricScope.TOPIC:
+                bl.topic_metrics[r.topic][r.metric_type] = r.value
+            else:
+                bl.partition_sizes[(r.topic, r.partition)] = r.value
+        self._records.clear()
+
+        wanted = set(assignment.partitions)
+        psamples: list[PartitionMetricSample] = []
+        bsamples: list[BrokerMetricSample] = []
+        for broker_id, bl in loads.items():
+            t = times[broker_id]
+            bsamples.append(self._broker_sample(broker_id, t, bl))
+            psamples.extend(self._partition_samples(broker_id, t, bl, wanted))
+        return Samples(psamples, bsamples)
+
+    def _broker_sample(self, broker_id: int, t: int,
+                       bl: _BrokerLoad) -> BrokerMetricSample:
+        s = BrokerMetricSample(broker_id, t)
+        m = bl.broker_metrics
+
+        def put(dst: BrokerMetric, src: RawMetricType):
+            if src in m:
+                s.record(dst, m[src])
+
+        put(BrokerMetric.CPU_USAGE, RawMetricType.BROKER_CPU_UTIL)
+        put(BrokerMetric.LEADER_BYTES_IN, RawMetricType.ALL_TOPIC_BYTES_IN)
+        put(BrokerMetric.LEADER_BYTES_OUT, RawMetricType.ALL_TOPIC_BYTES_OUT)
+        put(BrokerMetric.REPLICATION_BYTES_IN_RATE,
+            RawMetricType.ALL_TOPIC_REPLICATION_BYTES_IN)
+        put(BrokerMetric.REPLICATION_BYTES_OUT_RATE,
+            RawMetricType.ALL_TOPIC_REPLICATION_BYTES_OUT)
+        put(BrokerMetric.BROKER_PRODUCE_REQUEST_RATE,
+            RawMetricType.ALL_TOPIC_PRODUCE_REQUEST_RATE)
+        put(BrokerMetric.BROKER_CONSUMER_FETCH_REQUEST_RATE,
+            RawMetricType.ALL_TOPIC_FETCH_REQUEST_RATE)
+        put(BrokerMetric.BROKER_REQUEST_HANDLER_POOL_IDLE_PERCENT,
+            RawMetricType.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT)
+        put(BrokerMetric.BROKER_LOG_FLUSH_RATE, RawMetricType.BROKER_LOG_FLUSH_RATE)
+        put(BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_MEAN,
+            RawMetricType.BROKER_LOG_FLUSH_TIME_MS_MEAN)
+        put(BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_999TH,
+            RawMetricType.BROKER_LOG_FLUSH_TIME_MS_999TH)
+        s.record(BrokerMetric.DISK_USAGE, sum(bl.partition_sizes.values()))
+        return s
+
+    def _partition_samples(self, broker_id: int, t: int, bl: _BrokerLoad,
+                           wanted: set[tuple[str, int]]
+                           ) -> list[PartitionMetricSample]:
+        """Per-leader-partition samples with CPU attribution (ref
+        SamplingUtils.estimateLeaderCpuUtilPerCore)."""
+        broker_cpu = bl.broker_metrics.get(RawMetricType.BROKER_CPU_UTIL,
+                                           DEFAULT_CPU_UTIL_FOR_MISSING)
+        tot_in = bl.broker_metrics.get(RawMetricType.ALL_TOPIC_BYTES_IN, 0.0)
+        tot_out = bl.broker_metrics.get(RawMetricType.ALL_TOPIC_BYTES_OUT, 0.0)
+        denom = tot_in + tot_out
+
+        # Partition share of its topic's (per-broker) bytes: by size when
+        # known, else uniform across the topic's partitions on this broker.
+        by_topic: dict[str, list[tuple[str, int]]] = defaultdict(list)
+        for tp in bl.partition_sizes:
+            by_topic[tp[0]].append(tp)
+        out: list[PartitionMetricSample] = []
+        for topic, tms in bl.topic_metrics.items():
+            tps = by_topic.get(topic, [])
+            if not tps:
+                continue
+            sizes = {tp: max(bl.partition_sizes.get(tp, 0.0), 0.0)
+                     for tp in tps}
+            total_size = sum(sizes.values())
+            t_in = tms.get(RawMetricType.TOPIC_BYTES_IN, 0.0)
+            t_out = tms.get(RawMetricType.TOPIC_BYTES_OUT, 0.0)
+            t_msg = tms.get(RawMetricType.TOPIC_MESSAGES_IN_PER_SEC, 0.0)
+            for tp in tps:
+                if wanted and tp not in wanted:
+                    continue
+                share = (sizes[tp] / total_size if total_size > 0
+                         else 1.0 / len(tps))
+                p_in = t_in * share
+                p_out = t_out * share
+                s = PartitionMetricSample(tp[0], tp[1], t)
+                s.record(KafkaMetric.LEADER_BYTES_IN, p_in)
+                s.record(KafkaMetric.LEADER_BYTES_OUT, p_out)
+                s.record(KafkaMetric.DISK_USAGE, bl.partition_sizes.get(tp, 0.0))
+                s.record(KafkaMetric.MESSAGE_IN_RATE, t_msg * share)
+                # CPU attribution: broker CPU x partition share of broker
+                # leader bytes (ref ModelUtils.estimateLeaderCpuUtil).
+                cpu_share = (p_in + p_out) / denom if denom > 0 else 0.0
+                s.record(KafkaMetric.CPU_USAGE, broker_cpu * cpu_share)
+                out.append(s)
+        return out

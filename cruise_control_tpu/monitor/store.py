@@ -1,0 +1,104 @@
+"""Sample persistence: the checkpoint/resume mechanism.
+
+Rebuild of ``monitor/sampling/KafkaSampleStore.java:68`` (the reference
+stores every sample in two compacted Kafka topics and replays them on
+startup, so a restarted server regains its N-hour metrics window without
+re-sampling). Here the durable medium is an append-only JSONL file pair;
+the SPI is the same store/replay contract (``SampleStore.java``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Protocol
+
+from .sampler import Samples
+from .samples import BrokerMetricSample, PartitionMetricSample
+
+
+class SampleStore(Protocol):
+    """ref SampleStore.java:96."""
+
+    def store_samples(self, samples: Samples) -> None: ...
+
+    def load_samples(self) -> Samples: ...
+
+    def close(self) -> None: ...
+
+
+class NoopSampleStore:
+    """ref NoopSampleStore: persistence disabled."""
+
+    def store_samples(self, samples: Samples) -> None:
+        pass
+
+    def load_samples(self) -> Samples:
+        return Samples([], [])
+
+    def close(self) -> None:
+        pass
+
+
+class FileSampleStore:
+    """Append-only JSONL files, one line per sample (the file-backed
+    equivalent of the two sample-store topics,
+    ``partition.metric.sample.store.topic`` / ``broker.metric.sample.store.
+    topic`` ``KafkaSampleStore.java:93-94``)."""
+
+    def __init__(self, directory: str, *,
+                 retention_ms: int | None = None) -> None:
+        os.makedirs(directory, exist_ok=True)
+        self._dir = directory
+        self._retention_ms = retention_ms
+        self._lock = threading.Lock()
+        self._pfile = open(os.path.join(directory, "partition_samples.jsonl"),
+                           "a", encoding="utf-8")
+        self._bfile = open(os.path.join(directory, "broker_samples.jsonl"),
+                           "a", encoding="utf-8")
+
+    def store_samples(self, samples: Samples) -> None:
+        with self._lock:
+            for s in samples.partition_samples:
+                self._pfile.write(json.dumps(s.to_json()) + "\n")
+            for s in samples.broker_samples:
+                self._bfile.write(json.dumps(s.to_json()) + "\n")
+            self._pfile.flush()
+            self._bfile.flush()
+
+    def load_samples(self) -> Samples:
+        """Replay everything retained (ref KafkaSampleStore loadSamples -> the
+        LOADING monitor state)."""
+        with self._lock:
+            self._pfile.flush()
+            self._bfile.flush()
+            psamples = self._read(os.path.join(self._dir,
+                                               "partition_samples.jsonl"),
+                                  PartitionMetricSample.from_json)
+            bsamples = self._read(os.path.join(self._dir,
+                                               "broker_samples.jsonl"),
+                                  BrokerMetricSample.from_json)
+        latest = max([s.time_ms for s in psamples + bsamples], default=0)
+        if self._retention_ms is not None:
+            horizon = latest - self._retention_ms
+            psamples = [s for s in psamples if s.time_ms >= horizon]
+            bsamples = [s for s in bsamples if s.time_ms >= horizon]
+        return Samples(psamples, bsamples)
+
+    @staticmethod
+    def _read(path: str, parse):
+        out = []
+        if not os.path.exists(path):
+            return out
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    out.append(parse(json.loads(line)))
+        return out
+
+    def close(self) -> None:
+        with self._lock:
+            self._pfile.close()
+            self._bfile.close()

@@ -1,0 +1,13 @@
+"""Broker metrics reporter agent (L0) — rebuild of
+``cruise-control-metrics-reporter``: raw metric types + records
+(:mod:`.metrics`), the metrics-topic transport (:mod:`.transport`), and the
+per-broker harvesting agent (:mod:`.agent`)."""
+
+from .agent import (BrokerMetricsSource, MetricsReporterAgent,
+                    SimClusterMetricsSource)
+from .metrics import CruiseControlMetric, MetricScope, RawMetricType
+from .transport import MetricsTransport
+
+__all__ = ["BrokerMetricsSource", "MetricsReporterAgent",
+           "SimClusterMetricsSource", "CruiseControlMetric", "MetricScope",
+           "RawMetricType", "MetricsTransport"]

@@ -1,0 +1,145 @@
+"""The broker metrics reporter agent (L0).
+
+Rebuild of ``CruiseControlMetricsReporter.java:65``: runs alongside each
+broker, harvests its metrics on an interval, and produces
+:class:`CruiseControlMetric` records to the metrics transport. The
+reference plugs into Kafka's ``MetricsReporter`` and reads the Yammer
+registry; here the agent reads a :class:`BrokerMetricsSource` (implemented
+by ``SimulatedKafkaCluster`` views or any object exposing the same
+per-broker numbers) — the harvest/serialize/produce loop and record schema
+are the parity surface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Protocol
+
+from .metrics import CruiseControlMetric, RawMetricType
+from .transport import MetricsTransport
+
+
+class BrokerMetricsSource(Protocol):
+    """What the agent reads from its broker each interval."""
+
+    def broker_stats(self, broker_id: int) -> dict[str, float]:
+        """e.g. cpu_util, bytes_in/out, replication bytes, request rates."""
+        ...
+
+    def topic_stats(self, broker_id: int) -> dict[str, dict[str, float]]:
+        """topic -> {bytes_in, bytes_out, replication_bytes_in, ...} for
+        partitions led on this broker."""
+        ...
+
+    def partition_sizes(self, broker_id: int) -> dict[tuple[str, int], float]:
+        """(topic, partition) -> size MB for replicas hosted on this broker."""
+        ...
+
+
+_BROKER_STAT_TYPES = {
+    "cpu_util": RawMetricType.BROKER_CPU_UTIL,
+    "bytes_in": RawMetricType.ALL_TOPIC_BYTES_IN,
+    "bytes_out": RawMetricType.ALL_TOPIC_BYTES_OUT,
+    "replication_bytes_in": RawMetricType.ALL_TOPIC_REPLICATION_BYTES_IN,
+    "replication_bytes_out": RawMetricType.ALL_TOPIC_REPLICATION_BYTES_OUT,
+    "produce_request_rate": RawMetricType.ALL_TOPIC_PRODUCE_REQUEST_RATE,
+    "fetch_request_rate": RawMetricType.ALL_TOPIC_FETCH_REQUEST_RATE,
+    "messages_in_rate": RawMetricType.ALL_TOPIC_MESSAGES_IN_PER_SEC,
+    "request_handler_idle_percent":
+        RawMetricType.BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT,
+    "request_queue_size": RawMetricType.BROKER_REQUEST_QUEUE_SIZE,
+    "log_flush_rate": RawMetricType.BROKER_LOG_FLUSH_RATE,
+    "log_flush_time_ms": RawMetricType.BROKER_LOG_FLUSH_TIME_MS_MEAN,
+    "log_flush_time_ms_999": RawMetricType.BROKER_LOG_FLUSH_TIME_MS_999TH,
+}
+
+_TOPIC_STAT_TYPES = {
+    "bytes_in": RawMetricType.TOPIC_BYTES_IN,
+    "bytes_out": RawMetricType.TOPIC_BYTES_OUT,
+    "replication_bytes_in": RawMetricType.TOPIC_REPLICATION_BYTES_IN,
+    "messages_in_rate": RawMetricType.TOPIC_MESSAGES_IN_PER_SEC,
+}
+
+
+@dataclass
+class MetricsReporterAgent:
+    """One agent instance per broker (ref CruiseControlMetricsReporter)."""
+
+    broker_id: int
+    source: BrokerMetricsSource
+    transport: MetricsTransport
+    reporting_interval_ms: int = 60_000
+    _last_report_ms: int = -1
+
+    def maybe_report(self, now_ms: int) -> int:
+        """Harvest + produce if the interval elapsed; returns #records
+        produced (ref the reporter's scheduled ``run()``)."""
+        if (self._last_report_ms >= 0
+                and now_ms - self._last_report_ms < self.reporting_interval_ms):
+            return 0
+        self._last_report_ms = now_ms
+        return self.report(now_ms)
+
+    def report(self, now_ms: int) -> int:
+        records: list[CruiseControlMetric] = []
+        stats = self.source.broker_stats(self.broker_id)
+        for key, mtype in _BROKER_STAT_TYPES.items():
+            if key in stats:
+                records.append(CruiseControlMetric(
+                    mtype, now_ms, self.broker_id, float(stats[key])))
+        for topic, tstats in self.source.topic_stats(self.broker_id).items():
+            for key, mtype in _TOPIC_STAT_TYPES.items():
+                if key in tstats:
+                    records.append(CruiseControlMetric(
+                        mtype, now_ms, self.broker_id, float(tstats[key]),
+                        topic=topic))
+        for (topic, partition), size in self.source.partition_sizes(
+                self.broker_id).items():
+            records.append(CruiseControlMetric(
+                RawMetricType.PARTITION_SIZE, now_ms, self.broker_id,
+                float(size), topic=topic, partition=partition))
+        self.transport.produce_all(records)
+        return len(records)
+
+
+class SimClusterMetricsSource:
+    """Adapts a :class:`SimulatedKafkaCluster` + synthetic per-partition
+    rates into the agent's metrics source (what a real broker's Yammer
+    registry provides)."""
+
+    def __init__(self, cluster, rates):
+        """``rates``: (topic, partition) -> (bytes_in, bytes_out)."""
+        self.cluster = cluster
+        self.rates = rates
+
+    def _led(self, broker_id: int):
+        return [info for info in self.cluster.describe_partitions().values()
+                if info.leader == broker_id]
+
+    def broker_stats(self, broker_id: int) -> dict[str, float]:
+        led = self._led(broker_id)
+        bytes_in = sum(self.rates.get(i.tp, (0, 0))[0] for i in led)
+        bytes_out = sum(self.rates.get(i.tp, (0, 0))[1] for i in led)
+        repl_in = sum(self.rates.get(i.tp, (0, 0))[0]
+                      for i in self.cluster.describe_partitions().values()
+                      if broker_id in i.replicas and i.leader != broker_id)
+        sim = self.cluster.broker_metrics(broker_id)
+        return {"cpu_util": 0.001 * (bytes_in + bytes_out),
+                "bytes_in": bytes_in, "bytes_out": bytes_out,
+                "replication_bytes_in": repl_in,
+                "request_queue_size": sim.get("request_queue_size", 0.0),
+                "log_flush_time_ms": sim.get("log_flush_time_ms", 0.0)}
+
+    def topic_stats(self, broker_id: int) -> dict[str, dict[str, float]]:
+        out: dict[str, dict[str, float]] = {}
+        for info in self._led(broker_id):
+            r = self.rates.get(info.tp, (0.0, 0.0))
+            t = out.setdefault(info.topic, {"bytes_in": 0.0, "bytes_out": 0.0})
+            t["bytes_in"] += r[0]
+            t["bytes_out"] += r[1]
+        return out
+
+    def partition_sizes(self, broker_id: int) -> dict[tuple[str, int], float]:
+        return {info.tp: info.size_mb
+                for info in self.cluster.describe_partitions().values()
+                if broker_id in info.replicas}

@@ -1,0 +1,123 @@
+"""Raw metric records emitted by the broker agent (L0).
+
+Rebuild of ``cruise-control-metrics-reporter``'s metric model
+(``metricsreporter/metric/RawMetricType.java:27`` — 43 types across
+BROKER / TOPIC / PARTITION scopes — and ``CruiseControlMetric.java`` with
+its Broker/Topic/PartitionMetric subclasses + ``MetricSerde.java``).
+The monitor's processor consumes these records; the agent produces them.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass
+
+
+class MetricScope(enum.Enum):
+    BROKER = "BROKER"
+    TOPIC = "TOPIC"
+    PARTITION = "PARTITION"
+
+
+class RawMetricType(enum.Enum):
+    """ref RawMetricType.java:27+ (43 types; value = stable wire id)."""
+
+    # --- broker scope -----------------------------------------------------
+    ALL_TOPIC_BYTES_IN = 0
+    ALL_TOPIC_BYTES_OUT = 1
+    ALL_TOPIC_REPLICATION_BYTES_IN = 2
+    ALL_TOPIC_REPLICATION_BYTES_OUT = 3
+    ALL_TOPIC_FETCH_REQUEST_RATE = 4
+    ALL_TOPIC_PRODUCE_REQUEST_RATE = 5
+    ALL_TOPIC_MESSAGES_IN_PER_SEC = 6
+    BROKER_CPU_UTIL = 7
+    BROKER_PRODUCE_REQUEST_RATE = 8
+    BROKER_CONSUMER_FETCH_REQUEST_RATE = 9
+    BROKER_FOLLOWER_FETCH_REQUEST_RATE = 10
+    BROKER_REQUEST_HANDLER_AVG_IDLE_PERCENT = 11
+    BROKER_REQUEST_QUEUE_SIZE = 12
+    BROKER_RESPONSE_QUEUE_SIZE = 13
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MAX = 14
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_MEAN = 15
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = 16
+    BROKER_CONSUMER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = 17
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MAX = 18
+    BROKER_FOLLOWER_FETCH_REQUEST_QUEUE_TIME_MS_MEAN = 19
+    BROKER_PRODUCE_TOTAL_TIME_MS_MAX = 20
+    BROKER_PRODUCE_TOTAL_TIME_MS_MEAN = 21
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MAX = 22
+    BROKER_CONSUMER_FETCH_TOTAL_TIME_MS_MEAN = 23
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MAX = 24
+    BROKER_FOLLOWER_FETCH_TOTAL_TIME_MS_MEAN = 25
+    BROKER_PRODUCE_LOCAL_TIME_MS_MAX = 26
+    BROKER_PRODUCE_LOCAL_TIME_MS_MEAN = 27
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MAX = 28
+    BROKER_CONSUMER_FETCH_LOCAL_TIME_MS_MEAN = 29
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MAX = 30
+    BROKER_FOLLOWER_FETCH_LOCAL_TIME_MS_MEAN = 31
+    BROKER_LOG_FLUSH_RATE = 32
+    BROKER_LOG_FLUSH_TIME_MS_MAX = 33
+    BROKER_LOG_FLUSH_TIME_MS_MEAN = 34
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_50TH = 35
+    BROKER_PRODUCE_REQUEST_QUEUE_TIME_MS_999TH = 36
+    BROKER_LOG_FLUSH_TIME_MS_50TH = 37
+    BROKER_LOG_FLUSH_TIME_MS_999TH = 38
+    # --- topic scope ------------------------------------------------------
+    TOPIC_BYTES_IN = 39
+    TOPIC_BYTES_OUT = 40
+    TOPIC_REPLICATION_BYTES_IN = 41
+    TOPIC_REPLICATION_BYTES_OUT = 42
+    TOPIC_FETCH_REQUEST_RATE = 43
+    TOPIC_PRODUCE_REQUEST_RATE = 44
+    TOPIC_MESSAGES_IN_PER_SEC = 45
+    # --- partition scope --------------------------------------------------
+    PARTITION_SIZE = 46
+
+    @property
+    def scope(self) -> MetricScope:
+        v = self.value
+        if v <= 38:
+            return MetricScope.BROKER
+        if v <= 45:
+            return MetricScope.TOPIC
+        return MetricScope.PARTITION
+
+
+@dataclass(frozen=True)
+class CruiseControlMetric:
+    """One raw metric record (ref CruiseControlMetric.java + the
+    BrokerMetric/TopicMetric/PartitionMetric subclasses, collapsed into one
+    record with optional topic/partition fields)."""
+
+    metric_type: RawMetricType
+    time_ms: int
+    broker_id: int
+    value: float
+    topic: str | None = None
+    partition: int | None = None
+
+    def __post_init__(self):
+        scope = self.metric_type.scope
+        if scope is MetricScope.TOPIC and self.topic is None:
+            raise ValueError(f"{self.metric_type.name} requires a topic")
+        if scope is MetricScope.PARTITION and (self.topic is None
+                                               or self.partition is None):
+            raise ValueError(f"{self.metric_type.name} requires topic+partition")
+
+    # -- wire format (ref MetricSerde.java, JSON instead of binary) --------
+    def serialize(self) -> bytes:
+        d = {"t": self.metric_type.value, "ts": self.time_ms,
+             "b": self.broker_id, "v": self.value}
+        if self.topic is not None:
+            d["topic"] = self.topic
+        if self.partition is not None:
+            d["p"] = self.partition
+        return json.dumps(d).encode()
+
+    @classmethod
+    def deserialize(cls, raw: bytes) -> "CruiseControlMetric":
+        d = json.loads(raw.decode())
+        return cls(metric_type=RawMetricType(d["t"]), time_ms=d["ts"],
+                   broker_id=d["b"], value=d["v"], topic=d.get("topic"),
+                   partition=d.get("p"))

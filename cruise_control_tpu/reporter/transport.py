@@ -1,0 +1,44 @@
+"""Metrics transport: the stand-in for the ``__CruiseControlMetrics`` topic.
+
+The reference's agent produces serialized metric records to a Kafka topic
+the sampler later consumes (``CruiseControlMetricsReporter.java:65`` /
+``CruiseControlMetricsReporterSampler.java:93``). This in-process transport
+keeps the same produce/poll contract (append-only log, time-ranged reads,
+serialized records) so the agent -> sampler pipeline is exercised end to
+end; a Kafka-backed implementation would swap in a producer/consumer pair
+behind the same two methods.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .metrics import CruiseControlMetric
+
+
+class MetricsTransport:
+    def __init__(self, retention_ms: int | None = None):
+        self._records: list[tuple[int, bytes]] = []   # (time_ms, serialized)
+        self._lock = threading.Lock()
+        self._retention_ms = retention_ms
+
+    def produce(self, metric: CruiseControlMetric) -> None:
+        with self._lock:
+            self._records.append((metric.time_ms, metric.serialize()))
+
+    def produce_all(self, metrics) -> None:
+        for m in metrics:
+            self.produce(m)
+
+    def poll(self, start_ms: int, end_ms: int) -> list[CruiseControlMetric]:
+        """Records with start_ms <= time < end_ms (the sampler's window)."""
+        with self._lock:
+            if self._retention_ms is not None and self._records:
+                horizon = self._records[-1][0] - self._retention_ms
+                self._records = [r for r in self._records if r[0] >= horizon]
+            return [CruiseControlMetric.deserialize(raw)
+                    for t, raw in self._records if start_ms <= t < end_ms]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)

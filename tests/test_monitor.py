@@ -1,0 +1,238 @@
+"""Monitor layer tests: samples in -> model out -> optimizer runs, gated by
+completeness (the rebuild of LoadMonitorTest / CruiseControlMetricsProcessorTest
+/ KafkaSampleStoreTest scenarios, against the simulated cluster)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.metricdef import BrokerMetric, KafkaMetric
+from cruise_control_tpu.executor import SimulatedKafkaCluster
+from cruise_control_tpu.monitor import (
+    AgentTopicSampler, CruiseControlMetricsProcessor, FileSampleStore,
+    LoadMonitor, LoadMonitorTaskRunner, MetricFetcherManager, MonitorConfig,
+    ModelCompletenessRequirements, NotEnoughValidWindowsException,
+    RunnerState, SamplerAssignment, SyntheticWorkloadSampler)
+from cruise_control_tpu.reporter import (CruiseControlMetric,
+                                         MetricsReporterAgent,
+                                         MetricsTransport, RawMetricType,
+                                         SimClusterMetricsSource)
+
+WINDOW_MS = 1000
+
+
+def make_cluster(num_brokers=4, partitions=12):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b)
+    for p in range(partitions):
+        sim.add_partition(f"t{p % 3}", p, [p % num_brokers,
+                                           (p + 1) % num_brokers],
+                          size_mb=10.0 * (p + 1))
+    return sim
+
+
+def make_monitor(sim, **cfg):
+    config = MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                           min_samples_per_window=1,
+                           num_broker_windows=4, broker_window_ms=WINDOW_MS,
+                           **cfg)
+    return LoadMonitor(sim, config)
+
+
+def sample_windows(monitor, sim, n_windows, *, start=0):
+    sampler = SyntheticWorkloadSampler(sim)
+    fetcher = MetricFetcherManager(sampler)
+    partitions = sorted(sim.describe_partitions())
+    brokers = sorted(sim.describe_cluster())
+    for w in range(n_windows):
+        t = start + (w + 1) * WINDOW_MS - 1   # one sample per window
+        monitor.add_samples(fetcher.fetch(partitions, brokers, t - 1, t))
+
+
+def test_cluster_model_from_samples_and_completeness_gate():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    # Only the current (in-flight) window has data -> no valid windows.
+    sample_windows(monitor, sim, 1)
+    with pytest.raises(NotEnoughValidWindowsException):
+        monitor.cluster_model(WINDOW_MS + 1,
+                              ModelCompletenessRequirements(1, 0.0))
+    # Three more windows roll the first ones out; model builds.
+    sample_windows(monitor, sim, 3, start=WINDOW_MS)
+    result = monitor.cluster_model(4 * WINDOW_MS,
+                                   ModelCompletenessRequirements(2, 0.9))
+    assert result.model.num_brokers_padded >= 4
+    spec_parts = {(p.topic, p.partition): p for p in result.spec.partitions}
+    assert len(spec_parts) == 12
+    # Loads came from the sampler: nonzero NW_IN and disk = size_mb.
+    p0 = spec_parts[("t0", 0)]
+    assert p0.leader_load[1] > 0            # NW_IN
+    assert p0.leader_load[3] == 10.0        # DISK = size_mb
+    assert len(result.partition_windows) == 12
+    assert result.partition_windows[("t0", 0)].shape[1] == 3
+
+
+def test_meets_completeness_requirements():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    req = ModelCompletenessRequirements(min_required_num_windows=2,
+                                        min_monitored_partitions_percentage=0.5)
+    assert not monitor.meets_completeness_requirements(req, WINDOW_MS)
+    sample_windows(monitor, sim, 4)
+    assert monitor.meets_completeness_requirements(req, 4 * WINDOW_MS)
+
+
+def test_model_marks_dead_broker_replicas_offline():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    sample_windows(monitor, sim, 4)
+    sim.kill_broker(2)
+    result = monitor.cluster_model(4 * WINDOW_MS)
+    spec = result.spec
+    assert not [b for b in spec.brokers if b.broker_id == 2][0].alive
+    offline = [p for p in spec.partitions if 2 in p.offline_replicas]
+    assert offline  # every partition with a replica on broker 2
+    assert all(2 in p.replicas for p in offline)
+
+
+def test_monitor_to_optimizer_end_to_end():
+    sim = make_cluster(num_brokers=4, partitions=16)
+    monitor = make_monitor(sim)
+    sample_windows(monitor, sim, 4)
+    result = monitor.cluster_model(4 * WINDOW_MS)
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             TpuGoalOptimizer, goals_by_name)
+    opt = TpuGoalOptimizer(goals=goals_by_name(
+        ["ReplicaDistributionGoal", "DiskUsageDistributionGoal"]))
+    res = opt.optimize(result.model, result.metadata, OptimizationOptions())
+    for g in res.goal_results:
+        assert g.violation_after <= g.violation_before + 1e-6
+
+
+def test_sample_store_checkpoint_replay(tmp_path):
+    sim = make_cluster()
+    store_dir = str(tmp_path / "samples")
+    sampler = SyntheticWorkloadSampler(sim)
+    fetcher = MetricFetcherManager(sampler, store=FileSampleStore(store_dir))
+    monitor = make_monitor(sim)
+    runner = LoadMonitorTaskRunner(monitor, fetcher, sampling_interval_ms=WINDOW_MS)
+    runner.start(0)
+    assert runner.state is RunnerState.RUNNING
+    for w in range(4):
+        assert runner.maybe_run_sampling((w + 1) * WINDOW_MS)
+    assert not runner.maybe_run_sampling(4 * WINDOW_MS + 1)  # not due yet
+    gen1 = monitor.generation
+
+    # "Restart": a fresh monitor replays the store and can build a model
+    # without any new sampling (ref KafkaSampleStore LOADING state).
+    monitor2 = make_monitor(sim)
+    fetcher2 = MetricFetcherManager(SyntheticWorkloadSampler(sim),
+                                    store=FileSampleStore(store_dir))
+    runner2 = LoadMonitorTaskRunner(monitor2, fetcher2,
+                                    sampling_interval_ms=WINDOW_MS)
+    replayed = runner2.start(4 * WINDOW_MS)
+    assert replayed > 0
+    result = monitor2.cluster_model(4 * WINDOW_MS,
+                                    ModelCompletenessRequirements(2, 0.9))
+    assert len(result.spec.partitions) == 12
+    assert gen1 > 0
+
+
+def test_pause_resume_sampling():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    runner = LoadMonitorTaskRunner(monitor,
+                                   MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+                                   sampling_interval_ms=WINDOW_MS)
+    runner.start(0, skip_loading=True)
+    runner.pause("test")
+    assert runner.state is RunnerState.PAUSED
+    assert not runner.maybe_run_sampling(10 * WINDOW_MS)
+    runner.resume()
+    assert runner.maybe_run_sampling(10 * WINDOW_MS)
+
+
+def test_bootstrap_warms_window_history():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    runner = LoadMonitorTaskRunner(monitor,
+                                   MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+                                   sampling_interval_ms=WINDOW_MS)
+    runner.start(4 * WINDOW_MS, skip_loading=True)
+    rounds = runner.bootstrap(0, 4 * WINDOW_MS)
+    assert rounds == 4
+    result = monitor.cluster_model(4 * WINDOW_MS,
+                                   ModelCompletenessRequirements(2, 0.9))
+    assert len(result.partition_windows) == 12
+
+
+def test_processor_cpu_attribution():
+    """CPU attribution: partition CPU = broker CPU x its share of broker
+    leader bytes (ref CruiseControlMetricsProcessorTest)."""
+    proc = CruiseControlMetricsProcessor()
+    records = [
+        CruiseControlMetric(RawMetricType.BROKER_CPU_UTIL, 100, 0, 80.0),
+        CruiseControlMetric(RawMetricType.ALL_TOPIC_BYTES_IN, 100, 0, 300.0),
+        CruiseControlMetric(RawMetricType.ALL_TOPIC_BYTES_OUT, 100, 0, 100.0),
+        CruiseControlMetric(RawMetricType.TOPIC_BYTES_IN, 100, 0, 300.0,
+                            topic="t"),
+        CruiseControlMetric(RawMetricType.TOPIC_BYTES_OUT, 100, 0, 100.0,
+                            topic="t"),
+        CruiseControlMetric(RawMetricType.PARTITION_SIZE, 100, 0, 75.0,
+                            topic="t", partition=0),
+        CruiseControlMetric(RawMetricType.PARTITION_SIZE, 100, 0, 25.0,
+                            topic="t", partition=1),
+    ]
+    proc.add_metrics(records)
+    samples = proc.process(SamplerAssignment(
+        partitions=[("t", 0), ("t", 1)], brokers=[0], start_ms=0, end_ms=200))
+    ps = {s.entity: s for s in samples.partition_samples}
+    # partition 0 has 75% of size => 75% of bytes => CPU share 0.75 * 80
+    assert ps[("t", 0)].values[KafkaMetric.CPU_USAGE] == pytest.approx(60.0)
+    assert ps[("t", 1)].values[KafkaMetric.CPU_USAGE] == pytest.approx(20.0)
+    assert ps[("t", 0)].values[KafkaMetric.LEADER_BYTES_IN] == pytest.approx(225.0)
+    bs = {s.entity: s for s in samples.broker_samples}
+    assert bs[0].values[BrokerMetric.CPU_USAGE] == 80.0
+    assert bs[0].values[BrokerMetric.DISK_USAGE] == pytest.approx(100.0)
+
+
+def test_agent_to_monitor_pipeline():
+    """Full L0 -> L2 flow: reporter agents harvest the simulated brokers,
+    produce to the transport, the sampler+processor consume, the monitor
+    builds a model whose broker utilization reflects the workload."""
+    sim = make_cluster(num_brokers=3, partitions=9)
+    rates = {tp: (100.0 * (tp[1] + 1), 50.0) for tp in sim.describe_partitions()}
+    source = SimClusterMetricsSource(sim, rates)
+    transport = MetricsTransport()
+    agents = [MetricsReporterAgent(b, source, transport,
+                                   reporting_interval_ms=WINDOW_MS)
+              for b in sorted(sim.describe_cluster())]
+    sampler = AgentTopicSampler(transport, CruiseControlMetricsProcessor())
+    monitor = make_monitor(sim)
+    fetcher = MetricFetcherManager(sampler)
+    partitions = sorted(sim.describe_partitions())
+    brokers = sorted(sim.describe_cluster())
+    for w in range(4):
+        t = (w + 1) * WINDOW_MS - 2
+        for a in agents:
+            a.maybe_report(t)
+        monitor.add_samples(fetcher.fetch(partitions, brokers, t - 1, t + 1))
+    result = monitor.cluster_model(4 * WINDOW_MS,
+                                   ModelCompletenessRequirements(2, 0.8))
+    from cruise_control_tpu.model.flat import broker_utilization
+    util = np.asarray(broker_utilization(result.model))
+    # Some NW_IN landed on every broker (each leads some partition).
+    assert (util[:3, 1] > 0).all()
+
+
+def test_retain_current_topology_drops_stale_entities():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    sample_windows(monitor, sim, 2)
+    monitor.partition_aggregator.add_sample(
+        __import__("cruise_control_tpu.core.aggregator",
+                   fromlist=["MetricSample"]).MetricSample(
+            entity=("gone", 0), sample_time_ms=WINDOW_MS, values={0: 1.0}))
+    assert ("gone", 0) in monitor.partition_aggregator.all_entities()
+    monitor.retain_current_topology()
+    assert ("gone", 0) not in monitor.partition_aggregator.all_entities()
