@@ -1,0 +1,19 @@
+"""API layer (L6): REST endpoints, async user tasks, purgatory review flow,
+security, the product facade and the proposal precompute cache (ref
+``servlet/`` + ``KafkaCruiseControl.java``)."""
+
+from .facade import KafkaCruiseControl
+from .precompute import ProposalCache
+from .progress import OperationProgress
+from .purgatory import Purgatory, ReviewStatus
+from .security import (AllowAllSecurityProvider, AuthorizationError,
+                       BasicSecurityProvider, Principal, Role,
+                       TrustedProxySecurityProvider, check_access)
+from .server import CruiseControlApp
+from .tasks import TaskState, UserTaskManager
+
+__all__ = ["KafkaCruiseControl", "ProposalCache", "OperationProgress",
+           "Purgatory", "ReviewStatus", "AllowAllSecurityProvider",
+           "AuthorizationError", "BasicSecurityProvider", "Principal",
+           "Role", "TrustedProxySecurityProvider", "check_access",
+           "CruiseControlApp", "TaskState", "UserTaskManager"]

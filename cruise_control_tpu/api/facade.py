@@ -1,0 +1,369 @@
+"""The product facade: one object wiring monitor -> analyzer -> executor.
+
+Rebuild of ``KafkaCruiseControl.java:78`` (constructor wiring ``:112-129``,
+``startUp()`` ``:221-227``). Every REST endpoint's business logic lives
+here as a synchronous method the user-task pool invokes; the HTTP layer
+only parses parameters and serializes results.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time as _time
+
+import numpy as np
+
+from ..analyzer import (OptimizationOptions, SearchConfig, TpuGoalOptimizer,
+                        goals_by_name)
+from ..analyzer.optimizer import OptimizerResult
+from ..executor import (Executor, ExecutorConfig, OngoingExecutionError)
+from ..model.cpu_regression import LinearRegressionModelParameters
+from ..model.flat import (broker_replica_counts, broker_leader_counts,
+                          broker_utilization)
+from ..model.stats import stats_summary
+from ..monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                       ModelCompletenessRequirements)
+from ..core.metricdef import BrokerMetric
+from ..core.resources import Resource
+from .precompute import ProposalCache
+from .progress import OperationProgress
+
+
+class KafkaCruiseControl:
+    """ref KafkaCruiseControl.java:78."""
+
+    def __init__(self, admin, monitor: LoadMonitor,
+                 task_runner: LoadMonitorTaskRunner | None = None,
+                 optimizer: TpuGoalOptimizer | None = None,
+                 executor: Executor | None = None,
+                 detector=None,
+                 now_ms=None) -> None:
+        self.admin = admin
+        self.monitor = monitor
+        self.task_runner = task_runner
+        self.optimizer = optimizer or TpuGoalOptimizer()
+        self.executor = executor or Executor(admin)
+        self.detector = detector
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        self.proposal_cache = ProposalCache(monitor, self.optimizer)
+        self.cpu_model = LinearRegressionModelParameters()
+        self._lock = threading.RLock()
+
+    # ----------------------------------------------------------- lifecycle
+    def start_up(self, precompute_interval_s: float = 30.0,
+                 start_precompute: bool = True) -> None:
+        """ref startUp() KafkaCruiseControl.java:221-227."""
+        if self.task_runner is not None and \
+                self.task_runner.state.value == "NOT_STARTED":
+            self.task_runner.start(self._now_ms())
+        if start_precompute:
+            self.proposal_cache.start_refresher(precompute_interval_s,
+                                                self._now_ms)
+        if self.detector is not None:
+            self.detector.start_detection()
+
+    def shutdown(self) -> None:
+        self.proposal_cache.stop()
+        if self.detector is not None:
+            self.detector.stop_detection()
+
+    # ------------------------------------------------------ goal-based ops
+    def _optimize(self, progress: OperationProgress | None,
+                  goals: list[str] | None,
+                  options: OptimizationOptions,
+                  requirements: ModelCompletenessRequirements | None = None,
+                  spec_mutator=None) -> OptimizerResult:
+        if progress:
+            progress.add_step("WaitingForClusterModel")
+        result = self.monitor.cluster_model(self._now_ms(), requirements)
+        spec = result.spec
+        if spec_mutator is not None:
+            spec = spec_mutator(spec)
+            from ..model.spec import flatten_spec
+            model, metadata = flatten_spec(spec)
+        else:
+            model, metadata = result.model, result.metadata
+        opt = (TpuGoalOptimizer(goals=goals_by_name(goals),
+                                config=self.optimizer.config)
+               if goals else self.optimizer)
+        if progress:
+            progress.add_step("OptimizationProposalCandidateComputation")
+        return opt.optimize(model, metadata, options)
+
+    def _maybe_execute(self, res: OptimizerResult, dryrun: bool,
+                       uuid: str, progress: OperationProgress | None,
+                       **executor_kwargs):
+        if dryrun or not res.proposals:
+            return None
+        if progress:
+            progress.add_step("ExecutingProposals")
+        return self.executor.execute_proposals(res.proposals, uuid=uuid,
+                                               **executor_kwargs)
+
+    def rebalance(self, goals: list[str] | None = None, dryrun: bool = True,
+                  options: OptimizationOptions | None = None, uuid: str = "",
+                  progress: OperationProgress | None = None,
+                  ignore_proposal_cache: bool = False):
+        """ref RebalanceRunnable.java:30 (cache path :92-121)."""
+        options = options or OptimizationOptions()
+        use_cache = (not ignore_proposal_cache and goals is None
+                     and options == OptimizationOptions())
+        if use_cache:
+            res = self.proposal_cache.get(self._now_ms())
+        else:
+            res = self._optimize(progress, goals, options)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        return res, exec_res
+
+    def add_brokers(self, broker_ids: list[int], dryrun: bool = True,
+                    goals: list[str] | None = None, uuid: str = "",
+                    progress: OperationProgress | None = None):
+        """Move load onto the new brokers (ref AddBrokersRunnable; new
+        brokers become the only allowed destinations)."""
+        def mark_new(spec):
+            for b in spec.brokers:
+                if b.broker_id in set(broker_ids):
+                    b.new = True
+            return spec
+        options = OptimizationOptions(
+            destination_broker_ids=frozenset(broker_ids))
+        res = self._optimize(progress, goals, options, spec_mutator=mark_new)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        return res, exec_res
+
+    def remove_brokers(self, broker_ids: list[int], dryrun: bool = True,
+                       goals: list[str] | None = None, uuid: str = "",
+                       progress: OperationProgress | None = None):
+        """Drain the given brokers (ref RemoveBrokersRunnable: demoted to
+        dead state so every replica becomes a must-move)."""
+        removed = set(broker_ids)
+
+        def mark_dead(spec):
+            for b in spec.brokers:
+                if b.broker_id in removed:
+                    b.alive = False
+            return spec
+        res = self._optimize(progress, goals, OptimizationOptions(),
+                             spec_mutator=mark_dead)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress,
+                                       removed_brokers=removed)
+        return res, exec_res
+
+    def demote_brokers(self, broker_ids: list[int], dryrun: bool = True,
+                       uuid: str = "",
+                       progress: OperationProgress | None = None):
+        """Move leadership (and preferred-leader order) off the brokers
+        (ref DemoteBrokerRunnable + PreferredLeaderElectionGoal)."""
+        demoted = set(broker_ids)
+
+        def mark_demoted(spec):
+            for b in spec.brokers:
+                if b.broker_id in demoted:
+                    b.demoted = True
+            for p in spec.partitions:
+                # Demoted brokers also lose *preferred* leadership: rotate
+                # them out of the head of the replica list.
+                if p.replicas and p.replicas[0] in demoted:
+                    alive = [r for r in p.replicas if r not in demoted]
+                    if alive:
+                        head = alive[0]
+                        rest = [r for r in p.replicas if r != head]
+                        p.replicas = [head, *rest]
+            return spec
+        res = self._optimize(progress,
+                             ["PreferredLeaderElectionGoal"],
+                             OptimizationOptions(
+                                 excluded_brokers_for_leadership=
+                                 frozenset(broker_ids)),
+                             spec_mutator=mark_demoted)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress,
+                                       demoted_brokers=demoted)
+        return res, exec_res
+
+    def fix_offline_replicas(self, dryrun: bool = True, uuid: str = "",
+                             goals: list[str] | None = None,
+                             progress: OperationProgress | None = None):
+        """ref FixOfflineReplicasRunnable: offline replicas are must-moves
+        in the analyzer already; this runs the chain and executes."""
+        res = self._optimize(progress, goals, OptimizationOptions())
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        return res, exec_res
+
+    def update_topic_configuration(self, topic_pattern: str, target_rf: int,
+                                   dryrun: bool = True, uuid: str = "",
+                                   progress: OperationProgress | None = None):
+        """Replication-factor change (ref UpdateTopicConfigurationRunnable +
+        ClusterModel.createOrDeleteReplicas :962): adjust each matched
+        partition's replica list rack-aware, then rebalance."""
+        def change_rf(spec):
+            by_broker = {b.broker_id: b for b in spec.brokers}
+            alive = [b for b in spec.brokers if b.alive]
+            counts = {b.broker_id: 0 for b in alive}
+            for p in spec.partitions:
+                for r in p.replicas:
+                    if r in counts:
+                        counts[r] += 1
+            for p in spec.partitions:
+                if not fnmatch.fnmatch(p.topic, topic_pattern):
+                    continue
+                replicas = list(p.replicas)
+                while len(replicas) > target_rf:
+                    # Drop the last (least-preferred, never the leader).
+                    gone = replicas.pop()
+                    counts[gone] = counts.get(gone, 1) - 1
+                racks_used = {by_broker[r].rack for r in replicas
+                              if r in by_broker}
+                while len(replicas) < target_rf:
+                    # Least-loaded alive broker, new rack first (ref
+                    # rack-aware replica addition).
+                    candidates = [b for b in alive
+                                  if b.broker_id not in replicas]
+                    if not candidates:
+                        raise ValueError(
+                            f"not enough brokers for RF {target_rf}")
+                    fresh = [b for b in candidates
+                             if b.rack not in racks_used]
+                    pool = fresh or candidates
+                    pick = min(pool, key=lambda b: counts[b.broker_id])
+                    replicas.append(pick.broker_id)
+                    counts[pick.broker_id] += 1
+                    racks_used.add(pick.rack)
+                p.replicas = replicas
+            return spec
+        res = self._optimize(progress, None, OptimizationOptions(),
+                             spec_mutator=change_rf)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        return res, exec_res
+
+    # ----------------------------------------------------------- get ops
+    def proposals(self, ignore_cache: bool = False,
+                  progress: OperationProgress | None = None) -> OptimizerResult:
+        """ref ProposalsRunnable / getProposals KafkaCruiseControl.java:534."""
+        if ignore_cache:
+            return self._optimize(progress, None, OptimizationOptions())
+        return self.proposal_cache.get(self._now_ms())
+
+    def load(self) -> dict:
+        """Broker-level load stats (ref LoadRunnable -> BrokerStats)."""
+        result = self.monitor.cluster_model(self._now_ms())
+        model = result.model
+        util = np.asarray(broker_utilization(model))
+        counts = np.asarray(broker_replica_counts(model))
+        leaders = np.asarray(broker_leader_counts(model))
+        hosts = result.spec.brokers
+        brokers = []
+        for i, b in enumerate(hosts):
+            brokers.append({
+                "Broker": b.broker_id, "Rack": b.rack,
+                "BrokerState": "ALIVE" if b.alive else "DEAD",
+                "CpuPct": float(util[i, Resource.CPU]),
+                "NwInRate": float(util[i, Resource.NW_IN]),
+                "NwOutRate": float(util[i, Resource.NW_OUT]),
+                "DiskMB": float(util[i, Resource.DISK]),
+                "Replicas": int(counts[i]), "Leaders": int(leaders[i]),
+            })
+        return {"brokers": brokers, "summary": stats_summary(model),
+                "generation": result.generation}
+
+    def partition_load(self, resource: str = "DISK", start: int = 0,
+                       max_entries: int = 2**31) -> list[dict]:
+        """ref PartitionLoadRunnable: partitions sorted by a resource."""
+        result = self.monitor.cluster_model(self._now_ms())
+        res_idx = int(Resource[resource.upper()])
+        rows = []
+        for p in result.spec.partitions:
+            rows.append({
+                "topic": p.topic, "partition": p.partition,
+                "leader": p.replicas[0] if p.replicas else -1,
+                "followers": list(p.replicas[1:]),
+                "CPU": p.leader_load[0], "NW_IN": p.leader_load[1],
+                "NW_OUT": p.leader_load[2], "DISK": p.leader_load[3],
+            })
+        rows.sort(key=lambda r: -r[Resource(res_idx).name])
+        return rows[start:start + max_entries]
+
+    def kafka_cluster_state(self) -> dict:
+        """ref KafkaClusterStateRequest: topology + replica health."""
+        parts = self.admin.describe_partitions()
+        alive = self.admin.describe_cluster()
+        under_replicated = [list(tp) for tp, i in parts.items()
+                            if len(i.isr) < len(i.replicas)]
+        offline = [list(tp) for tp, i in parts.items()
+                   if any(not alive.get(b, False) for b in i.replicas)]
+        leader_count: dict[int, int] = {}
+        replica_count: dict[int, int] = {}
+        for i in parts.values():
+            leader_count[i.leader] = leader_count.get(i.leader, 0) + 1
+            for b in i.replicas:
+                replica_count[b] = replica_count.get(b, 0) + 1
+        return {"KafkaBrokerState": {
+                    "IsController": {},
+                    "Summary": {"Brokers": len(alive),
+                                "Alive": sum(alive.values())},
+                    "LeaderCountByBrokerId": leader_count,
+                    "ReplicaCountByBrokerId": replica_count},
+                "KafkaPartitionState": {
+                    "UnderReplicatedPartitions": under_replicated,
+                    "OfflinePartitions": offline,
+                    "TotalPartitions": len(parts)}}
+
+    def state(self, substates: list[str] | None = None) -> dict:
+        """ref GetStateRunnable -> CruiseControlState with substates."""
+        wanted = {s.lower() for s in (substates or
+                                      ["monitor", "executor", "analyzer",
+                                       "anomaly_detector"])}
+        out: dict = {}
+        if "monitor" in wanted:
+            mon = self.monitor.state(self._now_ms()).to_json()
+            if self.task_runner is not None:
+                mon["taskRunner"] = self.task_runner.state_json()
+            out["MonitorState"] = mon
+        if "executor" in wanted:
+            out["ExecutorState"] = self.executor.state_json()
+        if "analyzer" in wanted:
+            out["AnalyzerState"] = {
+                "isProposalReady": self.proposal_cache.valid(),
+                "readyGoals": [g.name for g in self.optimizer.goals]}
+        if "anomaly_detector" in wanted and self.detector is not None:
+            out["AnomalyDetectorState"] = self.detector.state_json()
+        return out
+
+    # ------------------------------------------------------- admin-ish ops
+    def stop_proposal_execution(self) -> None:
+        self.executor.stop_execution()
+
+    def pause_sampling(self, reason: str = "") -> None:
+        if self.task_runner is None:
+            raise RuntimeError("no sampling task runner configured")
+        self.task_runner.pause(reason)
+
+    def resume_sampling(self, reason: str = "") -> None:
+        if self.task_runner is None:
+            raise RuntimeError("no sampling task runner configured")
+        self.task_runner.resume(reason)
+
+    def bootstrap(self, start_ms: int, end_ms: int) -> int:
+        if self.task_runner is None:
+            raise RuntimeError("no sampling task runner configured")
+        return self.task_runner.bootstrap(start_ms, end_ms)
+
+    def train(self, now_ms: int | None = None) -> dict:
+        """Feed broker (bytes-in, bytes-out) -> CPU observations into the
+        linear regression (ref TrainRunnable + LinearRegressionModelParameters)."""
+        stats = self.monitor.broker_window_stats(now_ms or self._now_ms())
+        for _, values in stats.items():
+            for w in range(values.shape[1]):
+                self.cpu_model.add_observation(
+                    values[BrokerMetric.LEADER_BYTES_IN, w],
+                    values[BrokerMetric.LEADER_BYTES_OUT, w],
+                    values[BrokerMetric.CPU_USAGE, w])
+        self.cpu_model.fit()
+        return self.cpu_model.to_json()
+
+    def rightsize(self, **kwargs) -> dict:
+        """ref RightsizeRunnable -> Provisioner; concrete provisioning is
+        the detector layer's BasicProvisioner."""
+        if self.detector is None or not hasattr(self.detector, "provisioner"):
+            return {"provisionerState": "No provisioner configured"}
+        return self.detector.provisioner.rightsize(**kwargs)

@@ -1,0 +1,109 @@
+"""Proposal precompute + generation-keyed cache.
+
+Rebuild of the reference's background "train loop"
+(``GoalOptimizer.run()`` ``GoalOptimizer.java:152-203``): a cached
+optimization result serves ``GET /proposals`` and goal-violation-free
+rebalances instantly; the cache is valid while the monitor's model
+generation is unchanged (``:232-239``); readers either take the cache, or
+block until the in-flight computation lands (``:304-352``), or force a
+fresh computation (``ignore_proposal_cache``).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..analyzer import OptimizationOptions
+
+
+class ProposalCache:
+    def __init__(self, monitor, optimizer, *,
+                 options: OptimizationOptions | None = None) -> None:
+        self.monitor = monitor
+        self.optimizer = optimizer
+        self.options = options or OptimizationOptions()
+        self._lock = threading.Condition()
+        self._cached = None            # OptimizerResult
+        self._cached_generation: int | None = None
+        self._computing = False
+        self._refresher: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.num_computations = 0
+
+    # ------------------------------------------------------------- reads
+    def valid(self) -> bool:
+        """ref validCachedProposal GoalOptimizer.java:232-239."""
+        with self._lock:
+            return (self._cached is not None
+                    and self._cached_generation == self.monitor.generation)
+
+    def get(self, now_ms: int, timeout_s: float = 60.0):
+        """Serve the cached result, computing (or waiting on the in-flight
+        computation) when stale (ref blocking read :304-352). A waiter whose
+        in-flight computation fails takes over the computation itself (so
+        the original error surfaces rather than a bogus timeout)."""
+        import time as _t
+        deadline = _t.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                if self.valid():
+                    return self._cached
+                if self._computing:
+                    remaining = deadline - _t.monotonic()
+                    if remaining <= 0 or not self._lock.wait_for(
+                            lambda: self.valid() or not self._computing,
+                            timeout=remaining):
+                        raise TimeoutError(
+                            "proposal computation did not finish")
+                    continue   # re-check: either valid now, or take over
+                self._computing = True
+            try:
+                return self._compute(now_ms)
+            finally:
+                with self._lock:
+                    self._computing = False
+                    self._lock.notify_all()
+
+    def _compute(self, now_ms: int):
+        gen = self.monitor.generation
+        model_result = self.monitor.cluster_model(now_ms)
+        result = self.optimizer.optimize(model_result.model,
+                                         model_result.metadata, self.options)
+        with self._lock:
+            self._cached = result
+            self._cached_generation = gen
+            self.num_computations += 1
+            self._lock.notify_all()
+        return result
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._cached = None
+            self._cached_generation = None
+
+    # ------------------------------------------- background refresh loop
+    def start_refresher(self, interval_s: float, now_ms_fn) -> None:
+        """ref the precompute thread started by KafkaCruiseControl.startUp
+        (KafkaCruiseControl.java:225)."""
+        if self._refresher is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    if not self.valid():
+                        self.get(now_ms_fn())
+                except Exception:
+                    # Monitor not ready (NotEnoughValidWindows) or transient
+                    # failure: retry next tick (ref :160-167 skip states).
+                    pass
+
+        self._refresher = threading.Thread(target=loop, daemon=True,
+                                           name="proposal-precompute")
+        self._refresher.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._refresher is not None:
+            self._refresher.join(timeout=5)
+            self._refresher = None

@@ -1,0 +1,51 @@
+"""Operation progress tracking (ref ``servlet/.../async/progress/
+OperationProgress.java`` + step classes like ``OptimizationForGoal``,
+``WaitingForClusterModel``): an append-only list of named steps with
+completion percentages, attached to every async operation and rendered in
+``/user_tasks`` and in-flight responses."""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ProgressStep:
+    description: str
+    start_ms: int
+    completed_percent: float = 0.0
+    end_ms: int | None = None
+
+    def to_json(self) -> dict:
+        return {"step": self.description,
+                "completionPercentage": round(self.completed_percent, 2),
+                "timeInMs": ((self.end_ms or int(time.time() * 1000))
+                             - self.start_ms)}
+
+
+class OperationProgress:
+    def __init__(self) -> None:
+        self._steps: list[ProgressStep] = []
+        self._lock = threading.Lock()
+
+    def add_step(self, description: str) -> ProgressStep:
+        with self._lock:
+            now = int(time.time() * 1000)
+            if self._steps and self._steps[-1].end_ms is None:
+                self._steps[-1].end_ms = now
+                self._steps[-1].completed_percent = 100.0
+            step = ProgressStep(description, now)
+            self._steps.append(step)
+            return step
+
+    def finish(self) -> None:
+        with self._lock:
+            if self._steps and self._steps[-1].end_ms is None:
+                self._steps[-1].end_ms = int(time.time() * 1000)
+                self._steps[-1].completed_percent = 100.0
+
+    def to_json(self) -> list[dict]:
+        with self._lock:
+            return [s.to_json() for s in self._steps]

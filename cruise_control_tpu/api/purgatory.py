@@ -1,0 +1,107 @@
+"""Two-step request review flow (ref ``servlet/purgatory/Purgatory.java:42``).
+
+When ``two.step.verification.enabled`` is on, POST requests land in the
+purgatory as PENDING_REVIEW; a reviewer approves or discards them via the
+REVIEW endpoint (``applyReview`` ``:234``); an approved request id can then
+be submitted once (``submit`` ``:169``)."""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+
+class ReviewStatus(enum.Enum):
+    """ref purgatory/ReviewStatus.java."""
+
+    PENDING_REVIEW = "PENDING_REVIEW"
+    APPROVED = "APPROVED"
+    SUBMITTED = "SUBMITTED"
+    DISCARDED = "DISCARDED"
+
+
+_VALID = {
+    ReviewStatus.PENDING_REVIEW: {ReviewStatus.APPROVED,
+                                  ReviewStatus.DISCARDED},
+    ReviewStatus.APPROVED: {ReviewStatus.SUBMITTED, ReviewStatus.DISCARDED},
+    ReviewStatus.SUBMITTED: set(),
+    ReviewStatus.DISCARDED: set(),
+}
+
+
+@dataclass
+class RequestInfo:
+    review_id: int
+    endpoint: str
+    params: dict
+    submitter: str
+    status: ReviewStatus = ReviewStatus.PENDING_REVIEW
+    reason: str = ""
+    submitted_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+
+    def to_json(self) -> dict:
+        return {"Id": self.review_id, "EndPoint": self.endpoint,
+                "Status": self.status.value, "Reason": self.reason,
+                "SubmitterAddress": self.submitter,
+                "SubmissionTimeMs": self.submitted_ms}
+
+
+class Purgatory:
+    def __init__(self, retention_ms: int = 7 * 24 * 3600 * 1000) -> None:
+        self._requests: dict[int, RequestInfo] = {}
+        self._ids = itertools.count()
+        self._lock = threading.RLock()
+        self.retention_ms = retention_ms
+
+    def add(self, endpoint: str, params: dict, submitter: str) -> RequestInfo:
+        """ref maybeAddToPurgatory :115."""
+        with self._lock:
+            info = RequestInfo(next(self._ids), endpoint, params, submitter)
+            self._requests[info.review_id] = info
+            return info
+
+    def apply_review(self, approve: set[int], discard: set[int],
+                     reason: str = "") -> dict[int, RequestInfo]:
+        """ref applyReview :234."""
+        with self._lock:
+            touched = {}
+            for rid in approve | discard:
+                info = self._requests.get(rid)
+                if info is None:
+                    raise KeyError(f"no request with review id {rid}")
+                target = (ReviewStatus.APPROVED if rid in approve
+                          else ReviewStatus.DISCARDED)
+                if target not in _VALID[info.status]:
+                    raise ValueError(
+                        f"request {rid} is {info.status.value}; cannot "
+                        f"{target.value}")
+                info.status = target
+                info.reason = reason
+                touched[rid] = info
+            return touched
+
+    def submit(self, review_id: int) -> RequestInfo:
+        """Mark an approved request submitted, returning it for execution
+        (ref submit :169)."""
+        with self._lock:
+            info = self._requests.get(review_id)
+            if info is None:
+                raise KeyError(f"no request with review id {review_id}")
+            if ReviewStatus.SUBMITTED not in _VALID[info.status]:
+                raise ValueError(
+                    f"request {review_id} is {info.status.value}, not APPROVED")
+            info.status = ReviewStatus.SUBMITTED
+            return info
+
+    def review_board(self) -> list[RequestInfo]:
+        with self._lock:
+            now = int(time.time() * 1000)
+            stale = [rid for rid, r in self._requests.items()
+                     if now - r.submitted_ms > self.retention_ms]
+            for rid in stale:
+                del self._requests[rid]
+            return sorted(self._requests.values(),
+                          key=lambda r: r.review_id)

@@ -1,0 +1,118 @@
+"""Pluggable request security (ref ``servlet/security/``).
+
+The reference ships HTTP Basic, JWT, SPNEGO and trusted-proxy providers
+over a VIEWER/USER/ADMIN role model (``DefaultRoleSecurityProvider.java``,
+``UserPermissionsManager.java``). Endpoint-to-role mapping follows the
+reference: GET state/load/proposals = VIEWER, kafka-admin POSTs = USER,
+admin/review = ADMIN.
+"""
+
+from __future__ import annotations
+
+import base64
+import enum
+from dataclasses import dataclass
+from typing import Protocol
+
+
+class Role(enum.Enum):
+    VIEWER = 1
+    USER = 2
+    ADMIN = 3
+
+
+#: endpoint name -> minimum role (ref DefaultRoleSecurityProvider roles)
+ENDPOINT_MIN_ROLE: dict[str, Role] = {
+    "state": Role.VIEWER, "load": Role.VIEWER, "partition_load": Role.VIEWER,
+    "proposals": Role.VIEWER, "kafka_cluster_state": Role.VIEWER,
+    "user_tasks": Role.VIEWER, "review_board": Role.VIEWER,
+    "permissions": Role.VIEWER,
+    "rebalance": Role.USER, "add_broker": Role.USER,
+    "remove_broker": Role.USER, "demote_broker": Role.USER,
+    "fix_offline_replicas": Role.USER, "topic_configuration": Role.USER,
+    "rightsize": Role.USER, "remove_disks": Role.USER,
+    "stop_proposal_execution": Role.USER, "pause_sampling": Role.USER,
+    "resume_sampling": Role.USER, "bootstrap": Role.USER, "train": Role.USER,
+    "admin": Role.ADMIN, "review": Role.ADMIN,
+}
+
+
+class AuthorizationError(PermissionError):
+    def __init__(self, message: str, status: int = 401):
+        super().__init__(message)
+        self.status = status
+
+
+@dataclass
+class Principal:
+    name: str
+    role: Role
+
+
+class SecurityProvider(Protocol):
+    """ref SecurityProvider.java."""
+
+    def authenticate(self, headers: dict[str, str]) -> Principal: ...
+
+
+class AllowAllSecurityProvider:
+    """Security disabled (webserver.security.enable=false, the default)."""
+
+    def authenticate(self, headers) -> Principal:
+        return Principal("anonymous", Role.ADMIN)
+
+
+class BasicSecurityProvider:
+    """HTTP Basic auth against a static credentials map (ref
+    BasicSecurityProvider.java + the auth-file format)."""
+
+    def __init__(self, users: dict[str, tuple[str, Role]]):
+        """``users``: name -> (password, role)."""
+        self.users = users
+
+    def authenticate(self, headers: dict[str, str]) -> Principal:
+        auth = headers.get("authorization", headers.get("Authorization", ""))
+        if not auth.startswith("Basic "):
+            raise AuthorizationError("missing basic auth credentials", 401)
+        try:
+            raw = base64.b64decode(auth[6:]).decode()
+            name, _, password = raw.partition(":")
+        except Exception:
+            raise AuthorizationError("malformed basic auth header", 401)
+        entry = self.users.get(name)
+        if entry is None or entry[0] != password:
+            raise AuthorizationError("bad credentials", 401)
+        return Principal(name, entry[1])
+
+
+class TrustedProxySecurityProvider:
+    """Trusted-proxy auth: requests from listed proxies carry the acting
+    principal in a header (ref security/trustedproxy/)."""
+
+    def __init__(self, trusted_proxies: set[str],
+                 principal_header: str = "doAs",
+                 role: Role = Role.USER):
+        self.trusted_proxies = trusted_proxies
+        # The HTTP layer lowercases header names before dispatch.
+        self.principal_header = principal_header.lower()
+        self.role = role
+
+    def authenticate(self, headers: dict[str, str]) -> Principal:
+        proxy = headers.get("x-forwarded-by", "")
+        if proxy not in self.trusted_proxies:
+            raise AuthorizationError(f"untrusted proxy {proxy!r}", 403)
+        name = headers.get(self.principal_header, "")
+        if not name:
+            raise AuthorizationError("missing doAs principal", 401)
+        return Principal(name, self.role)
+
+
+def check_access(provider: SecurityProvider, endpoint: str,
+                 headers: dict[str, str]) -> Principal:
+    principal = provider.authenticate(headers)
+    required = ENDPOINT_MIN_ROLE.get(endpoint, Role.ADMIN)
+    if principal.role.value < required.value:
+        raise AuthorizationError(
+            f"{principal.name} ({principal.role.name}) lacks "
+            f"{required.name} for {endpoint}", 403)
+    return principal

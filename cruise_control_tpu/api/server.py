@@ -1,0 +1,377 @@
+"""REST API server — the 23 endpoints (ref
+``servlet/CruiseControlEndPoint.java:16-39``, dispatch per
+``KafkaCruiseControlRequestHandler``), async User-Task-ID semantics
+(``UserTaskManager.java:69``), two-step review purgatory, and pluggable
+security, over ``http.server`` (the stdlib stand-in for Jetty/Vert.x —
+``KafkaCruiseControlServletApp``/``KafkaCruiseControlVertxApp``).
+
+GET  : state, load, partition_load, proposals, kafka_cluster_state,
+       user_tasks, review_board, permissions, bootstrap, train
+POST : rebalance, add_broker, remove_broker, fix_offline_replicas,
+       demote_broker, topic_configuration, rightsize, remove_disks,
+       stop_proposal_execution, pause_sampling, resume_sampling, admin,
+       review
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+# Distinct from builtin TimeoutError before Python 3.11.
+from concurrent.futures import TimeoutError as _FuturesTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..analyzer import OptimizationOptions
+from .facade import KafkaCruiseControl
+from .purgatory import Purgatory
+from .security import (AllowAllSecurityProvider, AuthorizationError,
+                       SecurityProvider, check_access, ENDPOINT_MIN_ROLE)
+from .tasks import UserTaskManager
+
+GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
+                 "kafka_cluster_state", "user_tasks", "review_board",
+                 "permissions", "bootstrap", "train"}
+POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
+                  "fix_offline_replicas", "demote_broker",
+                  "topic_configuration", "rightsize", "remove_disks",
+                  "stop_proposal_execution", "pause_sampling",
+                  "resume_sampling", "admin", "review"}
+#: POSTs that execute immediately even with two-step verification on
+#: (ref Purgatory: REVIEW itself and flow-control endpoints skip review).
+NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution"}
+#: endpoints whose work runs async behind a User-Task-ID
+ASYNC_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
+                   "fix_offline_replicas", "demote_broker",
+                   "topic_configuration", "rightsize", "proposals", "load",
+                   "partition_load", "bootstrap", "train", "remove_disks"}
+
+
+def _flag(params: dict, name: str, default: bool = False) -> bool:
+    v = params.get(name, [None])[0]
+    if v is None:
+        return default
+    return str(v).lower() in ("true", "1", "yes")
+
+
+def _ids(params: dict, name: str) -> list[int]:
+    raw = params.get(name, [""])[0]
+    return [int(x) for x in raw.split(",") if x.strip()]
+
+
+def _goals(params: dict) -> list[str] | None:
+    raw = params.get("goals", [""])[0]
+    return [g.strip() for g in raw.split(",") if g.strip()] or None
+
+
+class CruiseControlApp:
+    """Wires facade + task manager + purgatory + security into a server
+    (ref KafkaCruiseControlApp.java)."""
+
+    def __init__(self, facade: KafkaCruiseControl, host: str = "127.0.0.1",
+                 port: int = 9090,
+                 security: SecurityProvider | None = None,
+                 two_step_verification: bool = False) -> None:
+        self.facade = facade
+        self.tasks = UserTaskManager()
+        self.purgatory = Purgatory() if two_step_verification else None
+        self.security = security or AllowAllSecurityProvider()
+        handler = _make_handler(self)
+        self.server = ThreadingHTTPServer((host, port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="cc-http")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.tasks.shutdown()
+        self.facade.shutdown()
+
+    # ------------------------------------------------------------ dispatch
+    def handle(self, method: str, endpoint: str, params: dict,
+               headers: dict) -> tuple[int, dict, dict]:
+        """Returns (status, response_json, extra_headers)."""
+        principal = check_access(self.security, endpoint, headers)
+        if method == "GET" and endpoint not in GET_ENDPOINTS:
+            return 405, {"errorMessage": f"{endpoint} is not a GET endpoint"}, {}
+        if method == "POST" and endpoint not in POST_ENDPOINTS:
+            return 405, {"errorMessage": f"{endpoint} is not a POST endpoint"}, {}
+
+        # Two-step verification: un-reviewed POSTs park in the purgatory.
+        if (method == "POST" and self.purgatory is not None
+                and endpoint not in NO_REVIEW_REQUIRED):
+            review_id = params.get("review_id", [None])[0]
+            if review_id is None:
+                info = self.purgatory.add(endpoint, {k: v[0] for k, v
+                                                     in params.items()},
+                                          principal.name)
+                return 202, {"reviewResult": info.to_json()}, {}
+            submitted = self.purgatory.submit(int(review_id))
+            merged = {k: [v] for k, v in submitted.params.items()}
+            merged.update(params)
+            params = merged
+
+        if endpoint in ASYNC_ENDPOINTS:
+            return self._handle_async(endpoint, params, headers)
+        return self._handle_sync(endpoint, params, principal)
+
+    def _handle_async(self, endpoint: str, params: dict,
+                      headers: dict) -> tuple[int, dict, dict]:
+        uuid = headers.get("user-task-id") or params.get(
+            "user_task_id", [None])[0]
+        existing = self.tasks.get(uuid) if uuid else None
+        if existing is None:
+            fn = self._operation(endpoint, params)
+            existing = self.tasks.submit(endpoint, endpoint, fn,
+                                         user_task_id=uuid)
+        hdrs = {"User-Task-ID": existing.user_task_id}
+        timeout = float(params.get("get_response_timeout_s", ["10"])[0])
+        try:
+            result = existing.future.result(timeout=timeout)
+            return 200, result, hdrs
+        except (TimeoutError, _FuturesTimeout):
+            return 202, {"progress": existing.progress.to_json(),
+                         "userTaskId": existing.user_task_id}, hdrs
+        except Exception as e:  # operation failed
+            return 500, {"errorMessage": str(e),
+                         "userTaskId": existing.user_task_id}, hdrs
+
+    def _operation(self, endpoint: str, params: dict):
+        """Build the callable a user task runs (ref the Runnable classes in
+        servlet/handler/async/runnable/)."""
+        facade = self.facade
+        dryrun = _flag(params, "dryrun", True)
+        goals = _goals(params)
+
+        def options_from(params) -> OptimizationOptions:
+            return OptimizationOptions(
+                excluded_topics=frozenset(
+                    t for t in params.get("excluded_topics", [""])[0].split(",")
+                    if t),
+                fast_mode=_flag(params, "fast_mode"),
+                excluded_brokers_for_leadership=frozenset(
+                    _ids(params, "exclude_brokers_for_leadership")),
+                excluded_brokers_for_replica_move=frozenset(
+                    _ids(params, "exclude_brokers_for_replica_move")),
+                destination_broker_ids=frozenset(
+                    _ids(params, "destination_broker_ids")))
+
+        if endpoint == "rebalance":
+            def run(progress):
+                res, exec_res = facade.rebalance(
+                    goals=goals, dryrun=dryrun, options=options_from(params),
+                    progress=progress,
+                    ignore_proposal_cache=_flag(params,
+                                                "ignore_proposal_cache"))
+                return _optimization_response(res, exec_res)
+        elif endpoint == "add_broker":
+            def run(progress):
+                res, exec_res = facade.add_brokers(
+                    _ids(params, "brokerid"), dryrun=dryrun, goals=goals,
+                    progress=progress)
+                return _optimization_response(res, exec_res)
+        elif endpoint == "remove_broker":
+            def run(progress):
+                res, exec_res = facade.remove_brokers(
+                    _ids(params, "brokerid"), dryrun=dryrun, goals=goals,
+                    progress=progress)
+                return _optimization_response(res, exec_res)
+        elif endpoint == "demote_broker":
+            def run(progress):
+                res, exec_res = facade.demote_brokers(
+                    _ids(params, "brokerid"), dryrun=dryrun,
+                    progress=progress)
+                return _optimization_response(res, exec_res)
+        elif endpoint == "fix_offline_replicas":
+            def run(progress):
+                res, exec_res = facade.fix_offline_replicas(
+                    dryrun=dryrun, goals=goals, progress=progress)
+                return _optimization_response(res, exec_res)
+        elif endpoint == "topic_configuration":
+            def run(progress):
+                res, exec_res = facade.update_topic_configuration(
+                    params.get("topic", ["*"])[0],
+                    int(params.get("replication_factor", ["2"])[0]),
+                    dryrun=dryrun, progress=progress)
+                return _optimization_response(res, exec_res)
+        elif endpoint == "proposals":
+            def run(progress):
+                res = facade.proposals(
+                    ignore_cache=_flag(params, "ignore_proposal_cache"),
+                    progress=progress)
+                return _optimization_response(res, None)
+        elif endpoint == "load":
+            def run(progress):
+                return facade.load()
+        elif endpoint == "partition_load":
+            def run(progress):
+                return {"records": facade.partition_load(
+                    resource=params.get("resource", ["DISK"])[0],
+                    start=int(params.get("start", ["0"])[0]),
+                    max_entries=int(params.get("entries", [str(2**31)])[0]))}
+        elif endpoint == "bootstrap":
+            def run(progress):
+                rounds = facade.bootstrap(
+                    int(params.get("start", ["0"])[0]),
+                    int(params.get("end", ["0"])[0]))
+                return {"message": f"bootstrapped {rounds} rounds"}
+        elif endpoint == "train":
+            def run(progress):
+                return facade.train()
+        elif endpoint == "rightsize":
+            def run(progress):
+                return facade.rightsize()
+        elif endpoint == "remove_disks":
+            def run(progress):
+                raise NotImplementedError(
+                    "remove_disks requires the intra-broker disk model")
+        else:  # pragma: no cover
+            raise ValueError(endpoint)
+        return run
+
+    def _handle_sync(self, endpoint: str, params: dict,
+                     principal) -> tuple[int, dict, dict]:
+        facade = self.facade
+        if endpoint == "state":
+            substates = params.get("substates", [None])[0]
+            return 200, facade.state(substates.split(",") if substates
+                                     else None), {}
+        if endpoint == "kafka_cluster_state":
+            return 200, facade.kafka_cluster_state(), {}
+        if endpoint == "user_tasks":
+            return 200, {"userTasks": [t.to_json()
+                                       for t in self.tasks.all_tasks()]}, {}
+        if endpoint == "permissions":
+            return 200, {"principal": principal.name,
+                         "role": principal.role.name,
+                         "endpoints": sorted(
+                             e for e, r in ENDPOINT_MIN_ROLE.items()
+                             if principal.role.value >= r.value)}, {}
+        if endpoint == "review_board":
+            if self.purgatory is None:
+                return 400, {"errorMessage":
+                             "two-step verification is disabled"}, {}
+            return 200, {"requestInfo": [
+                r.to_json() for r in self.purgatory.review_board()]}, {}
+        if endpoint == "review":
+            if self.purgatory is None:
+                return 400, {"errorMessage":
+                             "two-step verification is disabled"}, {}
+            touched = self.purgatory.apply_review(
+                set(_ids(params, "approve")), set(_ids(params, "discard")),
+                params.get("reason", [""])[0])
+            return 200, {"requestInfo": [r.to_json()
+                                         for r in touched.values()]}, {}
+        if endpoint == "stop_proposal_execution":
+            facade.stop_proposal_execution()
+            return 200, {"message": "Execution stop requested."}, {}
+        if endpoint == "pause_sampling":
+            facade.pause_sampling(params.get("reason", [""])[0])
+            return 200, {"message": "Sampling paused."}, {}
+        if endpoint == "resume_sampling":
+            facade.resume_sampling(params.get("reason", [""])[0])
+            return 200, {"message": "Sampling resumed."}, {}
+        if endpoint == "admin":
+            return 200, self._admin(params), {}
+        return 404, {"errorMessage": f"unknown endpoint {endpoint}"}, {}
+
+    def _admin(self, params: dict) -> dict:
+        """ref AdminParameters: runtime toggles."""
+        out: dict = {}
+        if "concurrent_partition_movements_per_broker" in params:
+            cap = int(params["concurrent_partition_movements_per_broker"][0])
+            self.facade.executor.config.concurrency.\
+                num_concurrent_partition_movements_per_broker = cap
+            out["concurrencyPerBroker"] = cap
+        if "concurrent_leader_movements" in params:
+            cap = int(params["concurrent_leader_movements"][0])
+            self.facade.executor.config.concurrency.\
+                num_concurrent_leader_movements = cap
+            out["concurrencyLeader"] = cap
+        if _flag(params, "drop_recently_removed_brokers"):
+            self.facade.executor.recently_removed_brokers.clear()
+            out["droppedRecentlyRemovedBrokers"] = True
+        if _flag(params, "drop_recently_demoted_brokers"):
+            self.facade.executor.recently_demoted_brokers.clear()
+            out["droppedRecentlyDemotedBrokers"] = True
+        detector = self.facade.detector
+        if detector is not None:
+            if "disable_self_healing_for" in params:
+                for name in params["disable_self_healing_for"][0].split(","):
+                    detector.set_self_healing_enabled(name.strip(), False)
+                out["disabledSelfHealing"] = params[
+                    "disable_self_healing_for"][0]
+            if "enable_self_healing_for" in params:
+                for name in params["enable_self_healing_for"][0].split(","):
+                    detector.set_self_healing_enabled(name.strip(), True)
+                out["enabledSelfHealing"] = params["enable_self_healing_for"][0]
+        return out or {"message": "no-op"}
+
+
+def _optimization_response(res, exec_res) -> dict:
+    out = res.to_json()
+    if exec_res is not None:
+        out["executionResult"] = {
+            "succeeded": exec_res.succeeded, "stopped": exec_res.stopped,
+            "numDeadTasks": exec_res.num_dead_tasks,
+            "taskSummary": exec_res.state_counts}
+    return out
+
+
+def _make_handler(app: CruiseControlApp):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):  # quiet
+            pass
+
+        def _serve(self, method: str):
+            parsed = urlparse(self.path)
+            parts = [p for p in parsed.path.split("/") if p]
+            # paths: /kafkacruisecontrol/<endpoint>
+            if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
+                self._send(404, {"errorMessage": f"bad path {parsed.path}"})
+                return
+            endpoint = parts[1].lower()
+            params = parse_qs(parsed.query)
+            if method == "POST":
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    body = self.rfile.read(length).decode()
+                    for k, v in parse_qs(body).items():
+                        params.setdefault(k, v)
+            headers = {k.lower(): v for k, v in self.headers.items()}
+            try:
+                status, payload, extra = app.handle(method, endpoint, params,
+                                                    headers)
+            except AuthorizationError as e:
+                status, payload, extra = e.status, {"errorMessage": str(e)}, {}
+            except (KeyError, ValueError) as e:
+                status, payload, extra = 400, {"errorMessage": str(e)}, {}
+            except Exception as e:
+                status, payload, extra = 500, {"errorMessage": str(e)}, {}
+            self._send(status, payload, extra)
+
+        def _send(self, status: int, payload: dict,
+                  extra: dict | None = None):
+            body = json.dumps({"version": 1, **payload}).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._serve("GET")
+
+        def do_POST(self):
+            self._serve("POST")
+
+    return Handler

@@ -1,0 +1,109 @@
+"""Async user task management (ref ``servlet/UserTaskManager.java:69``).
+
+Long-running requests get a ``User-Task-ID`` UUID; the work runs on an
+executor pool as an :class:`OperationFuture`; clients poll the same
+endpoint (or ``/user_tasks``) with the header until the future completes.
+Completed tasks are retained for a configurable time so late polls still
+see the result (ref completed-task retention ``UserTaskManager.java``).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+import time
+import uuid as uuidlib
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .progress import OperationProgress
+
+
+class TaskState(enum.Enum):
+    """ref UserTaskManager.TaskState."""
+
+    ACTIVE = "Active"
+    COMPLETED = "Completed"
+    COMPLETED_WITH_ERROR = "CompletedWithError"
+
+
+@dataclass
+class UserTaskInfo:
+    user_task_id: str
+    endpoint: str
+    request_url: str
+    start_ms: int
+    future: Future
+    progress: OperationProgress = field(default_factory=OperationProgress)
+
+    @property
+    def state(self) -> TaskState:
+        if not self.future.done():
+            return TaskState.ACTIVE
+        return (TaskState.COMPLETED_WITH_ERROR if self.future.exception()
+                else TaskState.COMPLETED)
+
+    def to_json(self) -> dict:
+        return {"UserTaskId": self.user_task_id,
+                "Status": self.state.value,
+                "RequestURL": self.request_url,
+                "StartMs": self.start_ms,
+                "Progress": self.progress.to_json()}
+
+
+class UserTaskManager:
+    def __init__(self, max_active_tasks: int = 25,
+                 completed_task_retention_ms: int = 24 * 3600 * 1000,
+                 num_threads: int = 8) -> None:
+        self._tasks: dict[str, UserTaskInfo] = {}
+        self._lock = threading.RLock()
+        self._pool = ThreadPoolExecutor(max_workers=num_threads,
+                                        thread_name_prefix="user-task")
+        self.max_active_tasks = max_active_tasks
+        self.retention_ms = completed_task_retention_ms
+
+    def submit(self, endpoint: str, request_url: str,
+               fn: Callable[[OperationProgress], Any],
+               user_task_id: str | None = None) -> UserTaskInfo:
+        """Create (or return the existing) task for this id (ref
+        getOrCreateUserTask: resubmitting with the same User-Task-ID header
+        reattaches rather than rerunning)."""
+        with self._lock:
+            self._expire_completed()
+            if user_task_id and user_task_id in self._tasks:
+                return self._tasks[user_task_id]
+            active = sum(1 for t in self._tasks.values()
+                         if t.state is TaskState.ACTIVE)
+            if active >= self.max_active_tasks:
+                raise RuntimeError(
+                    f"too many active user tasks ({active})")
+            tid = user_task_id or str(uuidlib.uuid4())
+            progress = OperationProgress()
+            future = self._pool.submit(fn, progress)
+            info = UserTaskInfo(user_task_id=tid, endpoint=endpoint,
+                                request_url=request_url,
+                                start_ms=int(time.time() * 1000),
+                                future=future, progress=progress)
+            self._tasks[tid] = info
+            return info
+
+    def get(self, user_task_id: str) -> UserTaskInfo | None:
+        with self._lock:
+            return self._tasks.get(user_task_id)
+
+    def all_tasks(self) -> list[UserTaskInfo]:
+        with self._lock:
+            self._expire_completed()
+            return sorted(self._tasks.values(), key=lambda t: t.start_ms)
+
+    def _expire_completed(self) -> None:
+        now = int(time.time() * 1000)
+        stale = [tid for tid, t in self._tasks.items()
+                 if t.state is not TaskState.ACTIVE
+                 and now - t.start_ms > self.retention_ms]
+        for tid in stale:
+            del self._tasks[tid]
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

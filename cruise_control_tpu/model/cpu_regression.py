@@ -1,0 +1,53 @@
+"""Linear-regression CPU estimation (ref ``model/ModelParameters.java`` +
+``LinearRegressionModelParameters.java``): the TRAIN endpoint collects
+(leader bytes-in, bytes-out) -> CPU observations from broker metrics and
+fits ``cpu ~ a*bytes_in + b*bytes_out (+ c)``; when trained, the monitor
+can estimate partition CPU from byte rates instead of attribution."""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class LinearRegressionModelParameters:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._obs: list[tuple[float, float, float]] = []  # (in, out, cpu)
+        self.coefficients: np.ndarray | None = None       # [a, b, c]
+        self.training_completed = False
+
+    def add_observation(self, bytes_in: float, bytes_out: float,
+                        cpu: float) -> None:
+        with self._lock:
+            self._obs.append((bytes_in, bytes_out, cpu))
+
+    @property
+    def num_observations(self) -> int:
+        with self._lock:
+            return len(self._obs)
+
+    def fit(self, min_observations: int = 10) -> bool:
+        with self._lock:
+            if len(self._obs) < min_observations:
+                return False
+            arr = np.asarray(self._obs, dtype=np.float64)
+            x = np.column_stack([arr[:, 0], arr[:, 1],
+                                 np.ones(arr.shape[0])])
+            coef, *_ = np.linalg.lstsq(x, arr[:, 2], rcond=None)
+            self.coefficients = coef
+            self.training_completed = True
+            return True
+
+    def estimate(self, bytes_in: float, bytes_out: float) -> float | None:
+        if not self.training_completed:
+            return None
+        a, b, c = self.coefficients
+        return float(max(a * bytes_in + b * bytes_out + c, 0.0))
+
+    def to_json(self) -> dict:
+        return {"trainingCompleted": self.training_completed,
+                "numObservations": self.num_observations,
+                "coefficients": (None if self.coefficients is None
+                                 else [float(v) for v in self.coefficients])}

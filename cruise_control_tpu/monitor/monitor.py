@@ -163,8 +163,10 @@ class LoadMonitor:
             generation=self.generation)
 
     def _aggregate(self, now_ms: int,
-                   requirements: ModelCompletenessRequirements):
-        interested = set(self.admin.describe_partitions())
+                   requirements: ModelCompletenessRequirements,
+                   partitions=None):
+        interested = set(partitions if partitions is not None
+                         else self.admin.describe_partitions())
         options = AggregationOptions(
             min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
             min_valid_windows=requirements.min_required_num_windows,
@@ -194,7 +196,7 @@ class LoadMonitor:
         result = None
         if not placement_only:
             try:
-                result = self._aggregate(now_ms, requirements)
+                result = self._aggregate(now_ms, requirements, partitions)
             except NotEnoughValidWindowsError as e:
                 raise NotEnoughValidWindowsException(str(e)) from None
             if not requirements.met_by(result.completeness):
@@ -238,8 +240,15 @@ class LoadMonitor:
                     follower_load = (cpu * c.follower_cpu_ratio, nw_in, 0.0,
                                      disk)
             offline = [b for b in info.replicas if not alive.get(b, False)]
+            # Slot 0 of the flat model is the leader positionally; the admin
+            # tracks leadership separately and it diverges from replicas[0]
+            # after failover/elections — reorder leader-first.
+            replicas = list(info.replicas)
+            if info.leader in replicas and replicas[0] != info.leader:
+                replicas = [info.leader,
+                            *[b for b in replicas if b != info.leader]]
             pspecs.append(PartitionSpec(
-                topic=tp[0], partition=tp[1], replicas=list(info.replicas),
+                topic=tp[0], partition=tp[1], replicas=replicas,
                 leader_load=leader_load, follower_load=follower_load,
                 offline_replicas=offline))
 

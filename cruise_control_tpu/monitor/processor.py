@@ -43,8 +43,15 @@ class _BrokerLoad:
 
 
 class CruiseControlMetricsProcessor:
-    def __init__(self) -> None:
+    def __init__(self, metadata_source=None) -> None:
+        """``metadata_source``: optional admin client
+        (``describe_partitions``) used to attribute topic byte rates only to
+        partitions the broker *leads* — the reference processor holds Kafka
+        ``Cluster`` metadata for exactly this (SamplingUtils leadership
+        checks). Without it, followers of a topic the broker also leads
+        would siphon off a share of the leader bytes."""
         self._records: list[CruiseControlMetric] = []
+        self._metadata_source = metadata_source
 
     def add_metrics(self, records: list[CruiseControlMetric]) -> None:
         self._records.extend(records)
@@ -68,12 +75,17 @@ class CruiseControlMetricsProcessor:
         self._records.clear()
 
         wanted = set(assignment.partitions)
+        leader_of: dict[tuple[str, int], int] | None = None
+        if self._metadata_source is not None:
+            leader_of = {tp: info.leader for tp, info in
+                         self._metadata_source.describe_partitions().items()}
         psamples: list[PartitionMetricSample] = []
         bsamples: list[BrokerMetricSample] = []
         for broker_id, bl in loads.items():
             t = times[broker_id]
             bsamples.append(self._broker_sample(broker_id, t, bl))
-            psamples.extend(self._partition_samples(broker_id, t, bl, wanted))
+            psamples.extend(self._partition_samples(broker_id, t, bl, wanted,
+                                                    leader_of))
         return Samples(psamples, bsamples)
 
     def _broker_sample(self, broker_id: int, t: int,
@@ -107,7 +119,8 @@ class CruiseControlMetricsProcessor:
         return s
 
     def _partition_samples(self, broker_id: int, t: int, bl: _BrokerLoad,
-                           wanted: set[tuple[str, int]]
+                           wanted: set[tuple[str, int]],
+                           leader_of: dict[tuple[str, int], int] | None
                            ) -> list[PartitionMetricSample]:
         """Per-leader-partition samples with CPU attribution (ref
         SamplingUtils.estimateLeaderCpuUtilPerCore)."""
@@ -118,9 +131,13 @@ class CruiseControlMetricsProcessor:
         denom = tot_in + tot_out
 
         # Partition share of its topic's (per-broker) bytes: by size when
-        # known, else uniform across the topic's partitions on this broker.
+        # known, else uniform — across the topic's partitions this broker
+        # LEADS (when metadata is available); the topic byte metrics only
+        # cover led partitions, so followers must not dilute the split.
         by_topic: dict[str, list[tuple[str, int]]] = defaultdict(list)
         for tp in bl.partition_sizes:
+            if leader_of is not None and leader_of.get(tp) != broker_id:
+                continue
             by_topic[tp[0]].append(tp)
         out: list[PartitionMetricSample] = []
         for topic, tms in bl.topic_metrics.items():

@@ -82,8 +82,13 @@ class SyntheticWorkloadSampler:
         self.broker_cpu_overrides = broker_cpu_overrides or {}
 
     def _partition_rates(self, tp: tuple[str, int], end_ms: int):
-        h = abs(hash((self.seed, tp))) % 1000 / 1000.0
-        rng = np.random.default_rng((abs(hash((self.seed, tp))) + end_ms) % 2**31)
+        # crc32, not hash(): Python's str hash is salted per process, which
+        # would make "deterministic" rates differ across restarts and break
+        # sample-store replay consistency.
+        import zlib
+        digest = zlib.crc32(f"{self.seed}:{tp[0]}:{tp[1]}".encode())
+        h = digest % 1000 / 1000.0
+        rng = np.random.default_rng((digest + end_ms) % 2**31)
         wobble = 1.0 + self.jitter * (2.0 * rng.random() - 1.0)
         bytes_in = self.base_bytes_in * (0.5 + h) * wobble
         bytes_out = bytes_in * self.fanout

@@ -109,6 +109,10 @@ class LoadMonitorTaskRunner:
             prev = self._state
             if prev is RunnerState.NOT_STARTED:
                 raise RuntimeError("start() the runner before bootstrapping")
+            if prev not in (RunnerState.RUNNING, RunnerState.PAUSED):
+                raise RuntimeError(
+                    f"cannot bootstrap while {prev.value} (a sampling or "
+                    "bootstrap round is in flight)")
             self._state = RunnerState.BOOTSTRAPPING
         rounds = 0
         try:
@@ -126,7 +130,8 @@ class LoadMonitorTaskRunner:
             return rounds
         finally:
             with self._lock:
-                self._state = prev
+                if self._state is RunnerState.BOOTSTRAPPING:
+                    self._state = prev
 
     def state_json(self) -> dict:
         return {"state": self._state.value,

@@ -1,0 +1,246 @@
+"""API layer tests: real HTTP against the full stack (simulated cluster ->
+monitor -> analyzer -> executor), User-Task-ID semantics, purgatory,
+security, precompute cache (the rebuild of
+KafkaCruiseControlServletEndpointTest / UserTaskManagerTest scenarios)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.analyzer import SearchConfig, TpuGoalOptimizer, goals_by_name
+from cruise_control_tpu.api import (BasicSecurityProvider, CruiseControlApp,
+                                    KafkaCruiseControl, Role)
+from cruise_control_tpu.executor import (Executor, ExecutorConfig, SimClock,
+                                         SimulatedKafkaCluster)
+from cruise_control_tpu.monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                                        MetricFetcherManager, MonitorConfig,
+                                        SyntheticWorkloadSampler)
+
+WINDOW_MS = 1000
+GOALS = ["RackAwareGoal", "ReplicaDistributionGoal",
+         "DiskUsageDistributionGoal"]
+
+
+def build_stack(num_brokers=4, partitions=16, two_step=False, security=None):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    # Skewed on purpose: brokers 0-2 carry everything, broker 3 is empty, so
+    # a rebalance always has work to do.
+    for p in range(partitions):
+        sim.add_partition(f"t{p % 3}", p, [p % 2, 1 + (p % 2)],
+                          size_mb=10.0 + p)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                                             min_samples_per_window=1))
+    fetcher = MetricFetcherManager(SyntheticWorkloadSampler(sim))
+    runner = LoadMonitorTaskRunner(monitor, fetcher,
+                                   sampling_interval_ms=WINDOW_MS)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        assert runner.maybe_run_sampling((w + 1) * WINDOW_MS - 1)
+    clock = SimClock(sim)
+    executor = Executor(sim, ExecutorConfig(progress_check_interval_ms=100),
+                        now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(goals=goals_by_name(GOALS)),
+        executor=executor, now_ms=lambda: 4 * WINDOW_MS)
+    app = CruiseControlApp(facade, port=0, two_step_verification=two_step,
+                           security=security)
+    app.start()
+    return sim, facade, app
+
+
+@pytest.fixture(scope="module")
+def stack():
+    sim, facade, app = build_stack()
+    yield sim, facade, app
+    app.stop()
+
+
+def call(app, method, endpoint, params="", headers=None, expect=200):
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/{endpoint}"
+    if params and method == "GET":
+        url += f"?{params}"
+    data = params.encode() if method == "POST" else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        body = json.loads(e.read() or b"{}")
+        assert e.code == expect, (e.code, body)
+        return e.code, body, dict(e.headers)
+
+
+def test_state_endpoint(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "state")
+    assert status == 200
+    assert body["MonitorState"]["numValidWindows"] == 3
+    assert body["ExecutorState"]["state"] == "NO_TASK_IN_PROGRESS"
+    assert body["AnalyzerState"]["readyGoals"] == GOALS
+    status, body, _ = call(app, "GET", "state", "substates=monitor")
+    assert "ExecutorState" not in body
+
+
+def test_load_and_partition_load(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "load")
+    assert status == 200
+    assert len(body["brokers"]) == 4
+    assert body["summary"]["numReplicas"] == 32
+    status, body, _ = call(app, "GET", "partition_load",
+                           "resource=DISK&entries=5")
+    assert len(body["records"]) == 5
+    disks = [r["DISK"] for r in body["records"]]
+    assert disks == sorted(disks, reverse=True)
+
+
+def test_kafka_cluster_state(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "kafka_cluster_state")
+    assert body["KafkaPartitionState"]["TotalPartitions"] == 16
+    assert body["KafkaBrokerState"]["Summary"]["Alive"] == 4
+
+
+def test_rebalance_dryrun_and_user_task(stack):
+    _, _, app = stack
+    status, body, headers = call(app, "POST", "rebalance",
+                                 "dryrun=true&get_response_timeout_s=0.01")
+    tid = headers["User-Task-ID"]
+    if status == 202:
+        # async semantics: poll with the User-Task-ID until complete
+        assert "progress" in body
+        deadline = time.time() + 120
+        while status == 202 and time.time() < deadline:
+            time.sleep(0.3)
+            status, body, _ = call(
+                app, "POST", "rebalance",
+                "dryrun=true&get_response_timeout_s=5",
+                headers={"User-Task-ID": tid})
+    assert status == 200
+    assert body["summary"]["numProposals"] > 0
+    # Re-poll with the same task id: same (cached) result, not a re-run.
+    status2, body2, _ = call(app, "POST", "rebalance",
+                             "dryrun=true&get_response_timeout_s=60",
+                             headers={"User-Task-ID": tid})
+    assert status2 == 200 and body2["summary"] == body["summary"]
+    status, body, _ = call(app, "GET", "user_tasks")
+    ids = [t["UserTaskId"] for t in body["userTasks"]]
+    assert tid in ids
+
+
+def test_rebalance_execute_moves_cluster(stack):
+    sim, _, app = stack
+    before = {tp: list(i.replicas)
+              for tp, i in sim.describe_partitions().items()}
+    status, body, _ = call(app, "POST", "rebalance",
+                           "dryrun=false&get_response_timeout_s=120")
+    assert status == 200
+    assert body["executionResult"]["succeeded"]
+    after = {tp: list(i.replicas) for tp, i in sim.describe_partitions().items()}
+    assert before != after
+
+
+def test_proposals_served_from_cache(stack):
+    _, facade, app = stack
+    call(app, "GET", "proposals")
+    n = facade.proposal_cache.num_computations
+    status, body, _ = call(app, "GET", "proposals")
+    assert status == 200
+    assert facade.proposal_cache.num_computations == n  # cache hit
+    assert "goalSummary" in body
+
+
+def test_pause_resume_sampling(stack):
+    _, facade, app = stack
+    call(app, "POST", "pause_sampling", "reason=maintenance")
+    assert facade.task_runner.state.value == "PAUSED"
+    call(app, "POST", "resume_sampling")
+    assert facade.task_runner.state.value == "RUNNING"
+
+
+def test_add_and_remove_broker(stack):
+    sim, _, app = stack
+    status, body, _ = call(app, "POST", "add_broker",
+                           "brokerid=3&dryrun=true&get_response_timeout_s=120")
+    assert status == 200
+    # every move targets broker 3
+    for p in body["proposals"]:
+        added = set(p["newReplicas"]) - set(p["oldReplicas"])
+        assert added <= {3}
+    status, body, _ = call(app, "POST", "remove_broker",
+                           "brokerid=0&dryrun=true&get_response_timeout_s=120")
+    assert status == 200
+    for p in body["proposals"]:
+        assert 0 not in p["newReplicas"]
+
+
+def test_unknown_endpoint_and_wrong_method(stack):
+    _, _, app = stack
+    call(app, "GET", "nonsense", expect=405)
+    call(app, "GET", "rebalance", expect=405)
+
+
+def test_train_endpoint(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "train")
+    assert status == 200
+    assert body["trainingCompleted"] in (True, False)
+
+
+def test_two_step_verification_flow():
+    sim, facade, app = build_stack(two_step=True)
+    try:
+        # POST without review -> parked
+        status, body, _ = call(app, "POST", "rebalance", "dryrun=true")
+        assert status == 202
+        rid = body["reviewResult"]["Id"]
+        assert body["reviewResult"]["Status"] == "PENDING_REVIEW"
+        # review board lists it; approve it; submit with review_id
+        status, body, _ = call(app, "GET", "review_board")
+        assert [r["Id"] for r in body["requestInfo"]] == [rid]
+        status, body, _ = call(app, "POST", "review", f"approve={rid}")
+        assert body["requestInfo"][0]["Status"] == "APPROVED"
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            f"review_id={rid}&dryrun=true&get_response_timeout_s=120")
+        assert status == 200 and body["summary"]["numProposals"] >= 0
+        # resubmitting the same review id fails (SUBMITTED is terminal)
+        call(app, "POST", "rebalance", f"review_id={rid}", expect=400)
+    finally:
+        app.stop()
+
+
+def test_basic_security_roles():
+    users = {"alice": ("pw", Role.ADMIN), "bob": ("pw", Role.VIEWER)}
+    sim, facade, app = build_stack(security=BasicSecurityProvider(users))
+    try:
+        import base64
+        def auth(u): return {"Authorization":
+                             "Basic " + base64.b64encode(
+                                 f"{u}:pw".encode()).decode()}
+        call(app, "GET", "state", expect=401)                    # no creds
+        status, _, _ = call(app, "GET", "state", headers=auth("bob"))
+        assert status == 200                                     # viewer GET
+        call(app, "POST", "rebalance", "dryrun=true",
+             headers=auth("bob"), expect=403)                    # viewer POST
+        status, body, _ = call(app, "GET", "permissions",
+                               headers=auth("alice"))
+        assert body["role"] == "ADMIN"
+    finally:
+        app.stop()
+
+
+def test_admin_endpoint(stack):
+    _, facade, app = stack
+    status, body, _ = call(app, "POST", "admin",
+                           "concurrent_partition_movements_per_broker=9")
+    assert status == 200
+    assert facade.executor.config.concurrency.\
+        num_concurrent_partition_movements_per_broker == 9

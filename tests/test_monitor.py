@@ -207,7 +207,7 @@ def test_agent_to_monitor_pipeline():
     agents = [MetricsReporterAgent(b, source, transport,
                                    reporting_interval_ms=WINDOW_MS)
               for b in sorted(sim.describe_cluster())]
-    sampler = AgentTopicSampler(transport, CruiseControlMetricsProcessor())
+    sampler = AgentTopicSampler(transport, CruiseControlMetricsProcessor(sim))
     monitor = make_monitor(sim)
     fetcher = MetricFetcherManager(sampler)
     partitions = sorted(sim.describe_partitions())
