@@ -36,6 +36,12 @@ class BalancingConstraint:
         0.7, 0.8, 0.8, 0.8)  # CPU, NW_IN, NW_OUT, DISK
     max_replicas_per_broker: int = 10_000
     # LeaderBytesInDistributionGoal reuses the NW_IN balance threshold.
+    # Provision verdicts (ref AnalyzerConfig overprovisioned.min.brokers and
+    # ResourceDistributionGoal's low.utilization.threshold — 0.0 disables
+    # over-provisioning detection, the reference default).
+    overprovisioned_min_brokers: int = 3
+    low_utilization_threshold: Tuple[float, float, float, float] = (
+        0.0, 0.0, 0.0, 0.0)
 
     def balance_threshold(self, resource: Resource) -> float:
         return self.resource_balance_threshold[int(resource)]

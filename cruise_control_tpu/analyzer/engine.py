@@ -103,6 +103,13 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
                 if gok is None:
                     gok = ~((same(c.src, c.src) | same(c.dst, c.dst))
                             & gmask).any(axis=1)
+                if g is goal:
+                    # The goal may not veto its own mandatory moves:
+                    # draining a dead broker leaves its source below any
+                    # lower bound by construction (matches eligibility's
+                    # must-bypass of the improvement test). Earlier goals'
+                    # guards still bind, like actionAcceptance does.
+                    gok = gok | c.must
                 ok = ok & gok
             do = elig & ~blocked & ok
             state = apply_group(state, ctx, c, do)

@@ -479,6 +479,19 @@ class IntervalGoal(GoalKernel):
         fallback = order[jnp.arange(K) % n_ok]
         must = state.offline[p, r] & sel
         dst = jnp.where(covered, matched, fallback)
+        # The flow matcher is partition-blind: on small clusters it often
+        # lands on a broker already hosting the partition, and a mandatory
+        # drain can stall on that collision forever. Re-route such
+        # candidates to their best *legal* destination (masked argmax).
+        row = state.rb[p]                                            # [K, R]
+        host_mask = jnp.zeros((K, B1), bool).at[
+            jnp.arange(K)[:, None], row].set(True, mode="drop")
+        bad = host_mask[jnp.arange(K), dst]
+        alt_score = jnp.where(host_mask | ~ctx.dest_allowed[None, :],
+                              -jnp.inf, dprio[None, :])
+        alt = jnp.argmax(alt_score, axis=1).astype(dst.dtype)
+        alt_ok = jnp.isfinite(jnp.max(alt_score, axis=1))
+        dst = jnp.where(bad & alt_ok, alt, dst)
         valid = sel & (covered | must) & ctx.dest_allowed[dst]
         return make_move_candidates(state, ctx, p, r, dst.astype(jnp.int32),
                                     valid)

@@ -54,13 +54,14 @@ class GoalResult:
 @dataclass
 class OptimizerResult:
     """Rebuild of ``analyzer/OptimizerResult.java``: proposals + per-goal
-    stats + violated-goal sets before/after."""
+    stats + violated-goal sets before/after + provision verdict."""
 
     proposals: list[ExecutionProposal]
     goal_results: list[GoalResult]
     num_moves: int
     duration_s: float
     final_model: FlatClusterModel
+    provision_response: object | None = None   # detector.ProvisionResponse
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -83,7 +84,9 @@ class OptimizerResult:
                 "violatedGoalsBefore": self.violated_goals_before,
                 "violatedGoalsAfter": self.violated_goals_after,
                 "proposals": [p.to_json() for p in self.proposals],
-                "optimizationDurationMs": round(self.duration_s * 1e3, 3)}
+                "optimizationDurationMs": round(self.duration_s * 1e3, 3),
+                "provisionResponse": (None if self.provision_response is None
+                                      else self.provision_response.to_json())}
 
 
 class TpuGoalOptimizer:
@@ -184,7 +187,83 @@ class TpuGoalOptimizer:
         return OptimizerResult(
             proposals=proposals, goal_results=goal_results,
             num_moves=int(jax.device_get(state.moves_applied)),
-            duration_s=time.monotonic() - t0, final_model=final)
+            duration_s=time.monotonic() - t0, final_model=final,
+            provision_response=self._provision_verdict(final, goal_results))
+
+    def _provision_verdict(self, final: FlatClusterModel,
+                           goal_results: list[GoalResult]):
+        """Under/over-provisioning verdict (ref CapacityGoal /
+        ResourceDistributionGoal attaching ProvisionRecommendation to the
+        result; BasicProvisioner acts on it).
+
+        UNDER: a hard capacity goal is still violated — no placement fits
+        the load; recommend the broker count that would. OVER: every
+        resource's cluster-wide utilization sits below its (opt-in)
+        low-utilization threshold; recommend shrinking to the smallest
+        broker count that keeps utilization under the usable ceiling.
+        """
+        from ..detector.provisioner import (ProvisionRecommendation,
+                                            ProvisionResponse,
+                                            ProvisionStatus)
+        from ..core.resources import RESOURCE_NAMES, Resource
+        from ..model.flat import broker_utilization
+        cst = self.constraint
+        response = ProvisionResponse()
+        util = np.asarray(jax.device_get(broker_utilization(final)))
+        alive = np.asarray(jax.device_get(final.broker_alive
+                                          & final.broker_valid))
+        caps = np.asarray(jax.device_get(final.broker_capacity))
+        n_alive = max(int(alive.sum()), 1)
+        violated_capacity = {g.name for g in goal_results
+                             if g.hard and not g.satisfied
+                             and "CapacityGoal" in g.name}
+        # Broker count needed per resource; shrink verdicts must respect the
+        # max over ALL resources (removing brokers a low-CPU cluster doesn't
+        # need could overload its disks).
+        needed_by_resource: dict[Resource, int] = {}
+        for r in Resource:
+            name = RESOURCE_NAMES[int(r)]
+            total = float(util[:, int(r)].sum())
+            usable_per_broker = float(
+                np.where(alive, caps[:, int(r)], 0.0).sum()
+            ) / n_alive * cst.cap_threshold(r)
+            if usable_per_broker <= 0:
+                continue
+            needed_by_resource[r] = int(np.ceil(total / usable_per_broker))
+            goal_name = {Resource.CPU: "CpuCapacityGoal",
+                         Resource.NW_IN: "NetworkInboundCapacityGoal",
+                         Resource.NW_OUT: "NetworkOutboundCapacityGoal",
+                         Resource.DISK: "DiskCapacityGoal"}[r]
+            if goal_name in violated_capacity:
+                response.aggregate(ProvisionRecommendation(
+                    ProvisionStatus.UNDER_PROVISIONED,
+                    num_brokers=max(needed_by_resource[r] - n_alive, 1),
+                    resource=name,
+                    reason=f"{name} demand {total:.0f} exceeds usable "
+                           f"capacity of {n_alive} brokers"))
+        if response.status is not ProvisionStatus.UNDER_PROVISIONED:
+            min_needed = max([*needed_by_resource.values(),
+                              cst.overprovisioned_min_brokers])
+            for r, low in zip(Resource, cst.low_utilization_threshold):
+                if low <= 0 or r not in needed_by_resource:
+                    continue
+                total = float(util[:, int(r)].sum())
+                usable_per_broker = float(
+                    np.where(alive, caps[:, int(r)], 0.0).sum()
+                ) / n_alive * cst.cap_threshold(r)
+                if (total < low * usable_per_broker * n_alive
+                        and min_needed < n_alive):
+                    response.aggregate(ProvisionRecommendation(
+                        ProvisionStatus.OVER_PROVISIONED,
+                        num_brokers=n_alive - min_needed,
+                        resource=RESOURCE_NAMES[int(r)],
+                        reason=f"{RESOURCE_NAMES[int(r)]} utilization below "
+                               f"{low:.0%} of usable capacity (cluster still "
+                               f"needs {min_needed} brokers for its most "
+                               "demanding resource)"))
+        if not response.recommendations:
+            response.status = ProvisionStatus.RIGHT_SIZED
+        return response
 
 
 def _as_jnp(mask):

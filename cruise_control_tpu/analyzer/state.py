@@ -184,7 +184,10 @@ def build_context(model: FlatClusterModel, *,
     capacity = _pad1(model.broker_capacity, 0.0)
     rack = _pad1(model.broker_rack, -1)
 
-    dest = alive
+    # Brokers with broken disks stay alive (healthy replicas keep serving)
+    # but may not RECEIVE replicas (ref ClusterModel BAD_DISKS broker state;
+    # new replicas would land on a half-dead broker).
+    dest = alive & ~_pad1(model.broker_broken_disk, True)
     if excluded_brokers_for_replica_move is not None:
         dest = dest & ~_pad1(excluded_brokers_for_replica_move, True)
     lead_dest = alive & ~_pad1(model.broker_demoted, True)

@@ -363,7 +363,16 @@ class KafkaCruiseControl:
 
     def rightsize(self, **kwargs) -> dict:
         """ref RightsizeRunnable -> Provisioner; concrete provisioning is
-        the detector layer's BasicProvisioner."""
+        the detector layer's BasicProvisioner acting on the current
+        optimization's provision verdict."""
         if self.detector is None or not hasattr(self.detector, "provisioner"):
             return {"provisionerState": "No provisioner configured"}
-        return self.detector.provisioner.rightsize(**kwargs)
+        from ..monitor import NotEnoughValidWindowsException
+        try:
+            res = self.proposal_cache.get(self._now_ms())
+        except (NotEnoughValidWindowsException, TimeoutError) as e:
+            return {"provisionerState": "NOT_READY", "reason": str(e)}
+        recs = (res.provision_response.recommendations
+                if res.provision_response is not None else [])
+        return self.detector.provisioner.rightsize(recommendations=recs,
+                                                   **kwargs)

@@ -1,0 +1,84 @@
+"""Generic anomaly SPIs (ref ``cruise-control-core``'s ``detector/`` package:
+``Anomaly.java``, ``AnomalyType.java``, ``MetricAnomalyFinder.java`` and
+``metricanomaly/PercentileMetricAnomalyFinder.java``)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Hashable, Protocol, Sequence
+
+import numpy as np
+
+
+class Anomaly(Protocol):
+    """ref Anomaly.java:51."""
+
+    anomaly_id: str
+
+    def fix(self) -> bool: ...
+
+    def reason(self) -> str: ...
+
+
+@dataclass(frozen=True)
+class MetricAnomaly:
+    """One detected metric anomaly (ref MetricAnomaly SPI)."""
+
+    entity: Hashable
+    metric_id: int
+    current_value: float
+    threshold: float
+    description: str
+
+
+class PercentileMetricAnomalyFinder:
+    """ref metricanomaly/PercentileMetricAnomalyFinder.java:201.
+
+    An entity's *current* (latest-window) metric value is anomalous when it
+    exceeds ``upper_percentile`` of its own history times
+    ``upper_margin`` (or sinks below ``lower_percentile`` divided by
+    ``lower_margin``). Needs at least ``min_history_windows`` of history.
+    Vectorized: one call scores every entity x metric at once.
+    """
+
+    def __init__(self, *, upper_percentile: float = 95.0,
+                 lower_percentile: float = 2.0, upper_margin: float = 0.5,
+                 lower_margin: float = 0.2, min_history_windows: int = 3,
+                 interested_metrics: Sequence[int] | None = None) -> None:
+        self.upper_percentile = upper_percentile
+        self.lower_percentile = lower_percentile
+        self.upper_margin = upper_margin
+        self.lower_margin = lower_margin
+        self.min_history_windows = min_history_windows
+        self.interested_metrics = (None if interested_metrics is None
+                                   else list(interested_metrics))
+
+    def anomalies(self, windows_by_entity: dict[Hashable, np.ndarray]
+                  ) -> list[MetricAnomaly]:
+        """``windows_by_entity``: entity -> [num_metrics, num_windows] with
+        the newest window last (history = all but last)."""
+        out: list[MetricAnomaly] = []
+        for entity, values in windows_by_entity.items():
+            if values.shape[1] < self.min_history_windows + 1:
+                continue
+            history = values[:, :-1]
+            current = values[:, -1]
+            upper = np.percentile(history, self.upper_percentile, axis=1)
+            lower = np.percentile(history, self.lower_percentile, axis=1)
+            metric_ids = (range(values.shape[0])
+                          if self.interested_metrics is None
+                          else self.interested_metrics)
+            for m in metric_ids:
+                hi = upper[m] * (1.0 + self.upper_margin)
+                lo = lower[m] * (1.0 - self.lower_margin)
+                if current[m] > hi and upper[m] > 0:
+                    out.append(MetricAnomaly(
+                        entity, m, float(current[m]), float(hi),
+                        f"metric {m} of {entity} = {current[m]:.2f} above "
+                        f"p{self.upper_percentile:.0f} margin {hi:.2f}"))
+                elif current[m] < lo:
+                    out.append(MetricAnomaly(
+                        entity, m, float(current[m]), float(lo),
+                        f"metric {m} of {entity} = {current[m]:.2f} below "
+                        f"p{self.lower_percentile:.0f} margin {lo:.2f}"))
+        return out

@@ -1,0 +1,31 @@
+"""Detector layer (L5): anomaly detection + self-healing (ref
+``cruise-control/.../detector/``)."""
+
+from .anomalies import (BrokerFailures, DiskFailures, GoalViolations,
+                        KafkaAnomaly, KafkaAnomalyType, KafkaMetricAnomaly,
+                        MaintenanceEvent, MaintenanceEventType, SlowBrokers,
+                        TopicReplicationFactorAnomaly)
+from .detectors import (BalancednessWeights, BrokerFailureDetector,
+                        DiskFailureDetector, GoalViolationDetector,
+                        MaintenanceEventDetector, MaintenanceEventReader,
+                        MetricAnomalyDetector, SlowBrokerFinder,
+                        TopicAnomalyDetector)
+from .manager import AnomalyDetectorManager, DetectorSchedule
+from .notifier import (AnomalyNotificationResult, AnomalyNotifier,
+                       NotificationAction, SelfHealingNotifier)
+from .provisioner import (BasicProvisioner, Provisioner,
+                          ProvisionRecommendation, ProvisionResponse,
+                          ProvisionStatus)
+
+__all__ = [
+    "BrokerFailures", "DiskFailures", "GoalViolations", "KafkaAnomaly",
+    "KafkaAnomalyType", "KafkaMetricAnomaly", "MaintenanceEvent",
+    "MaintenanceEventType", "SlowBrokers", "TopicReplicationFactorAnomaly",
+    "BalancednessWeights", "BrokerFailureDetector", "DiskFailureDetector",
+    "GoalViolationDetector", "MaintenanceEventDetector",
+    "MaintenanceEventReader", "MetricAnomalyDetector", "SlowBrokerFinder",
+    "TopicAnomalyDetector", "AnomalyDetectorManager", "DetectorSchedule",
+    "AnomalyNotificationResult", "AnomalyNotifier", "NotificationAction",
+    "SelfHealingNotifier", "BasicProvisioner", "Provisioner",
+    "ProvisionRecommendation", "ProvisionResponse", "ProvisionStatus",
+]

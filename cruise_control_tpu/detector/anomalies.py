@@ -1,0 +1,243 @@
+"""Kafka anomaly types (ref ``detector/KafkaAnomalyType.java:29`` and the
+``KafkaAnomaly`` subclasses: ``BrokerFailures``, ``DiskFailures``,
+``GoalViolations``, ``KafkaMetricAnomaly``, ``SlowBrokers``,
+``TopicReplicationFactorAnomaly``, ``MaintenanceEvent``).
+
+Each anomaly knows how to fix itself through the facade — the same
+runnables the REST endpoints use (ref each anomaly's ``fix()`` invoking
+Remove/Demote/Rebalance runnables with ``isTriggeredByAnomaly=true``).
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+from dataclasses import dataclass, field
+
+
+class KafkaAnomalyType(enum.IntEnum):
+    """Priority order: lower value = higher priority (ref
+    KafkaAnomalyType.java:29)."""
+
+    BROKER_FAILURE = 0
+    MAINTENANCE_EVENT = 1
+    DISK_FAILURE = 2
+    METRIC_ANOMALY = 3
+    TOPIC_ANOMALY = 4
+    GOAL_VIOLATION = 5
+
+
+_ids = itertools.count()
+
+
+@dataclass
+class KafkaAnomaly:
+    """ref KafkaAnomaly.java. ``fix`` returns True when a fix started."""
+
+    detected_ms: int
+    anomaly_id: str = field(default="", init=False)
+
+    def __post_init__(self):
+        self.anomaly_id = f"{type(self).__name__.lower()}-{next(_ids)}"
+
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.GOAL_VIOLATION
+
+    def reason(self) -> str:
+        return type(self).__name__
+
+    def fix(self, facade) -> bool:
+        raise NotImplementedError
+
+    def still_valid(self, facade) -> bool:
+        """Re-check against live cluster state before acting — a deferred
+        anomaly may describe a condition that has since recovered."""
+        return True
+
+    def merge_from(self, other: "KafkaAnomaly") -> None:
+        """Absorb a fresher detection of the same condition (the manager
+        de-dups by reason but keeps the earliest queue entry so notifier
+        time thresholds measure from first detection)."""
+
+    def to_json(self) -> dict:
+        return {"anomalyId": self.anomaly_id,
+                "type": self.anomaly_type.name,
+                "detectedMs": self.detected_ms,
+                "description": self.reason()}
+
+
+@dataclass
+class BrokerFailures(KafkaAnomaly):
+    """ref BrokerFailures.java."""
+
+    failed_brokers: dict[int, int] = field(default_factory=dict)  # id -> since
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.BROKER_FAILURE
+
+    def reason(self) -> str:
+        return f"Brokers {sorted(self.failed_brokers)} failed"
+
+    def still_valid(self, facade) -> bool:
+        """Drop brokers that came back; a fully-recovered failure must not
+        drain healthy brokers when its deferred fix finally fires."""
+        alive = facade.admin.describe_cluster()
+        self.failed_brokers = {b: t for b, t in self.failed_brokers.items()
+                               if not alive.get(b, False)}
+        return bool(self.failed_brokers)
+
+    def merge_from(self, other: "KafkaAnomaly") -> None:
+        if isinstance(other, BrokerFailures):
+            # Keep the earliest failure time per broker; adopt new failures.
+            for b, t in other.failed_brokers.items():
+                self.failed_brokers[b] = min(
+                    t, self.failed_brokers.get(b, t))
+
+    def fix(self, facade) -> bool:
+        res, exec_res = facade.remove_brokers(
+            sorted(self.failed_brokers), dryrun=False,
+            uuid=self.anomaly_id)
+        # No proposals == nothing left to move (already healed): success.
+        return exec_res is None or exec_res.succeeded
+
+
+@dataclass
+class DiskFailures(KafkaAnomaly):
+    """ref DiskFailures.java (offline logdirs)."""
+
+    failed_disks: dict[int, list[str]] = field(default_factory=dict)
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.DISK_FAILURE
+
+    def reason(self) -> str:
+        return f"Disks failed: {self.failed_disks}"
+
+    def fix(self, facade) -> bool:
+        res, exec_res = facade.fix_offline_replicas(dryrun=False,
+                                                    uuid=self.anomaly_id)
+        return exec_res is None or exec_res.succeeded
+
+
+@dataclass
+class GoalViolations(KafkaAnomaly):
+    """ref GoalViolations.java."""
+
+    fixable_violations: list[str] = field(default_factory=list)
+    unfixable_violations: list[str] = field(default_factory=list)
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.GOAL_VIOLATION
+
+    def reason(self) -> str:
+        return (f"Violated goals: fixable {self.fixable_violations}, "
+                f"unfixable {self.unfixable_violations}")
+
+    def fix(self, facade) -> bool:
+        if not self.fixable_violations:
+            return False
+        res, exec_res = facade.rebalance(dryrun=False, uuid=self.anomaly_id,
+                                         ignore_proposal_cache=True)
+        return exec_res is None or exec_res.succeeded
+
+
+@dataclass
+class KafkaMetricAnomaly(KafkaAnomaly):
+    """ref KafkaMetricAnomaly.java — alert-only by default."""
+
+    description: str = ""
+    broker_id: int | None = None
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.METRIC_ANOMALY
+
+    def reason(self) -> str:
+        return self.description
+
+    def fix(self, facade) -> bool:
+        return False   # ref: metric anomalies have no automatic fix
+
+
+@dataclass
+class SlowBrokers(KafkaAnomaly):
+    """ref SlowBrokers.java: fix = demote (remove leadership), or remove
+    when configured."""
+
+    slow_brokers: dict[int, float] = field(default_factory=dict)
+    remove_slow_brokers: bool = False
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.METRIC_ANOMALY
+
+    def reason(self) -> str:
+        return f"Slow brokers {sorted(self.slow_brokers)}"
+
+    def fix(self, facade) -> bool:
+        ids = sorted(self.slow_brokers)
+        if self.remove_slow_brokers:
+            _, exec_res = facade.remove_brokers(ids, dryrun=False,
+                                                uuid=self.anomaly_id)
+        else:
+            _, exec_res = facade.demote_brokers(ids, dryrun=False,
+                                                uuid=self.anomaly_id)
+        return exec_res is None or exec_res.succeeded
+
+
+@dataclass
+class TopicReplicationFactorAnomaly(KafkaAnomaly):
+    """ref TopicReplicationFactorAnomaly.java: topics whose RF deviates from
+    the target."""
+
+    bad_topics: dict[str, int] = field(default_factory=dict)  # topic -> rf
+    target_rf: int = 3
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.TOPIC_ANOMALY
+
+    def reason(self) -> str:
+        return (f"Topics with RF != {self.target_rf}: "
+                f"{sorted(self.bad_topics)}")
+
+    def fix(self, facade) -> bool:
+        ok = True
+        for topic in sorted(self.bad_topics):
+            _, exec_res = facade.update_topic_configuration(
+                topic, self.target_rf, dryrun=False, uuid=self.anomaly_id)
+            ok &= exec_res is None or exec_res.succeeded
+        return ok
+
+
+class MaintenanceEventType(enum.Enum):
+    """ref MaintenancePlan types."""
+
+    ADD_BROKER = "ADD_BROKER"
+    REMOVE_BROKER = "REMOVE_BROKER"
+    FIX_OFFLINE_REPLICAS = "FIX_OFFLINE_REPLICAS"
+    REBALANCE = "REBALANCE"
+    DEMOTE_BROKER = "DEMOTE_BROKER"
+    TOPIC_REPLICATION_FACTOR = "TOPIC_REPLICATION_FACTOR"
+
+
+@dataclass
+class MaintenanceEvent(KafkaAnomaly):
+    """ref MaintenanceEvent.java: operator-announced plan consumed from the
+    maintenance topic; 'fixing' = executing the plan."""
+
+    event_type: MaintenanceEventType = MaintenanceEventType.REBALANCE
+    broker_ids: list[int] = field(default_factory=list)
+    topic_pattern: str | None = None
+    target_rf: int | None = None
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.MAINTENANCE_EVENT
+
+    def reason(self) -> str:
+        return f"Maintenance: {self.event_type.value} {self.broker_ids}"
+
+    def fix(self, facade) -> bool:
+        t = self.event_type
+        if t is MaintenanceEventType.ADD_BROKER:
+            _, ex = facade.add_brokers(self.broker_ids, dryrun=False,
+                                       uuid=self.anomaly_id)
+        elif t is MaintenanceEventType.REMOVE_BROKER:
+            _, ex = facade.remove_brokers(self.broker_ids, dryrun=False,
+                                          uuid=self.anomaly_id)
+        elif t is MaintenanceEventType.DEMOTE_BROKER:
+            _, ex = facade.demote_brokers(self.broker_ids, dryrun=False,
+                                          uuid=self.anomaly_id)
+        elif t is MaintenanceEventType.FIX_OFFLINE_REPLICAS:
+            _, ex = facade.fix_offline_replicas(dryrun=False,
+                                                uuid=self.anomaly_id)
+        elif t is MaintenanceEventType.TOPIC_REPLICATION_FACTOR:
+            _, ex = facade.update_topic_configuration(
+                self.topic_pattern or "*", self.target_rf or 3,
+                dryrun=False, uuid=self.anomaly_id)
+        else:
+            _, ex = facade.rebalance(dryrun=False, uuid=self.anomaly_id,
+                                     ignore_proposal_cache=True)
+        return ex is None or ex.succeeded

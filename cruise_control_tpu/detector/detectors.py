@@ -1,0 +1,266 @@
+"""The six anomaly detectors (ref ``detector/GoalViolationDetector.java:56``,
+``AbstractBrokerFailureDetector.java`` / ``KafkaBrokerFailureDetector.java``
+(metadata-polling flavor), ``DiskFailureDetector.java``,
+``MetricAnomalyDetector.java``, ``SlowBrokerFinder.java``,
+``TopicAnomalyDetector.java`` + ``TopicReplicationFactorAnomalyFinder.java``,
+``MaintenanceEventDetector.java`` + ``MaintenanceEventTopicReader.java``).
+
+Each detector exposes ``detect(now_ms) -> list[KafkaAnomaly]``; the manager
+schedules them at their own intervals and queues what they return.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.anomaly import PercentileMetricAnomalyFinder
+from ..core.metricdef import BrokerMetric
+from .anomalies import (BrokerFailures, DiskFailures, GoalViolations,
+                        KafkaMetricAnomaly, MaintenanceEvent, SlowBrokers,
+                        TopicReplicationFactorAnomaly)
+
+
+class BrokerFailureDetector:
+    """Metadata-polling broker failure detection (ref
+    KafkaBrokerFailureDetector.java:23; the ZK-watcher flavor is an
+    event-push variant of the same comparison).
+
+    A broker is *failed* when it is expected (hosts replicas / was alive
+    before) but the metadata reports it dead. First-seen failure times
+    persist across restarts via a JSON file (ref the failed-broker file the
+    reference keeps) so the 15/30-minute notifier thresholds survive a
+    controller restart.
+    """
+
+    def __init__(self, admin, *, persist_path: str | None = None) -> None:
+        self.admin = admin
+        self.persist_path = persist_path
+        self._failed_since: dict[int, int] = {}
+        if persist_path and os.path.exists(persist_path):
+            with open(persist_path, encoding="utf-8") as f:
+                self._failed_since = {int(k): int(v)
+                                      for k, v in json.load(f).items()}
+
+    def detect(self, now_ms: int) -> list[BrokerFailures]:
+        alive = self.admin.describe_cluster()
+        dead = {b for b, up in alive.items() if not up}
+        for b in dead:
+            self._failed_since.setdefault(b, now_ms)
+        for b in list(self._failed_since):
+            if b not in dead:
+                del self._failed_since[b]
+        self._persist()
+        if not self._failed_since:
+            return []
+        return [BrokerFailures(detected_ms=now_ms,
+                               failed_brokers=dict(self._failed_since))]
+
+    def _persist(self) -> None:
+        if self.persist_path:
+            with open(self.persist_path, "w", encoding="utf-8") as f:
+                json.dump(self._failed_since, f)
+
+
+class DiskFailureDetector:
+    """Offline-logdir scan (ref DiskFailureDetector.java via
+    AdminClient.describeLogDirs)."""
+
+    def __init__(self, admin) -> None:
+        self.admin = admin
+
+    def detect(self, now_ms: int) -> list[DiskFailures]:
+        offline_fn = getattr(self.admin, "offline_logdirs", None)
+        if offline_fn is None:
+            return []
+        offline = {b: dirs for b, dirs in offline_fn().items() if dirs}
+        if not offline:
+            return []
+        return [DiskFailures(detected_ms=now_ms, failed_disks=offline)]
+
+
+@dataclass
+class BalancednessWeights:
+    """ref goal.balancedness.priority.weight / strictness.weight
+    (GoalOptimizer.java:136-137)."""
+
+    priority_weight: float = 1.1
+    strictness_weight: float = 1.5
+
+
+class GoalViolationDetector:
+    """Dry-runs the detection goals on a fresh model and reports violations
+    plus the balancedness score gauge [0, 100] (ref
+    GoalViolationDetector.java:56, balancednessScore()).
+
+    Score: 100 * (1 - sum(weight of violated goals) / sum(all weights)),
+    where goal i (priority order) has weight priority_weight^(n-i), doubled
+    by strictness_weight for hard goals — later(-priority) goals hurt less.
+    """
+
+    def __init__(self, monitor, optimizer,
+                 weights: BalancednessWeights | None = None) -> None:
+        self.monitor = monitor
+        self.optimizer = optimizer
+        self.weights = weights or BalancednessWeights()
+        self.last_balancedness: float = 100.0
+
+    def _goal_weight(self, index: int, hard: bool, total: int) -> float:
+        w = self.weights.priority_weight ** (total - index)
+        return w * (self.weights.strictness_weight if hard else 1.0)
+
+    def detect(self, now_ms: int) -> list[GoalViolations]:
+        from ..monitor import NotEnoughValidWindowsException
+        # Dead brokers / offline replicas are broker- and disk-failure
+        # territory; optimizing around them would report spurious unfixable
+        # violations (ref GoalViolationDetector skipping detection when the
+        # cluster has dead brokers or offline replicas).
+        alive = self.monitor.admin.describe_cluster()
+        if not all(alive.values()):
+            return []
+        offline_fn = getattr(self.monitor.admin, "offline_replicas", None)
+        if offline_fn is not None and offline_fn():
+            return []
+        try:
+            result = self.monitor.cluster_model(now_ms)
+        except NotEnoughValidWindowsException:
+            return []
+        from ..analyzer import OptimizationOptions
+        res = self.optimizer.optimize(result.model, result.metadata,
+                                      OptimizationOptions())
+        goals = self.optimizer.goals
+        total_w = sum(self._goal_weight(i, g.hard, len(goals))
+                      for i, g in enumerate(goals))
+        violated_w = sum(
+            self._goal_weight(i, g.hard, len(goals))
+            for i, (g, gr) in enumerate(zip(goals, res.goal_results))
+            if gr.violation_before > 1e-6)
+        self.last_balancedness = round(
+            100.0 * (1.0 - violated_w / total_w) if total_w else 100.0, 2)
+        fixable = [gr.name for gr in res.goal_results
+                   if gr.violation_before > 1e-6 and gr.satisfied]
+        unfixable = [gr.name for gr in res.goal_results
+                     if gr.violation_before > 1e-6 and not gr.satisfied]
+        if not fixable and not unfixable:
+            return []
+        return [GoalViolations(detected_ms=now_ms,
+                               fixable_violations=fixable,
+                               unfixable_violations=unfixable)]
+
+
+class MetricAnomalyDetector:
+    """Percentile-based broker metric anomalies (ref
+    MetricAnomalyDetector.java + KafkaMetricAnomalyFinder + the core
+    percentile finder)."""
+
+    def __init__(self, monitor,
+                 finder: PercentileMetricAnomalyFinder | None = None) -> None:
+        self.monitor = monitor
+        self.finder = finder or PercentileMetricAnomalyFinder(
+            interested_metrics=[int(BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_MEAN),
+                                int(BrokerMetric.CPU_USAGE)])
+
+    def detect(self, now_ms: int) -> list[KafkaMetricAnomaly]:
+        windows = self.monitor.broker_window_stats(now_ms)
+        return [KafkaMetricAnomaly(detected_ms=now_ms,
+                                   description=a.description,
+                                   broker_id=a.entity)
+                for a in self.finder.anomalies(windows)]
+
+
+class SlowBrokerFinder:
+    """Statistical slow-broker detection (ref SlowBrokerFinder.java:479):
+    a broker is slow when its log-flush-time *per byte handled* is an
+    outlier against the fleet (mean + ``num_std`` sigma) and its absolute
+    flush time exceeds a floor — high flush time on an idle broker or a
+    uniformly-loaded slow fleet should not page."""
+
+    def __init__(self, monitor, *, num_std: float = 3.0,
+                 flush_time_floor_ms: float = 100.0,
+                 remove_slow_brokers: bool = False) -> None:
+        self.monitor = monitor
+        self.num_std = num_std
+        self.flush_time_floor_ms = flush_time_floor_ms
+        self.remove_slow_brokers = remove_slow_brokers
+
+    def detect(self, now_ms: int) -> list[SlowBrokers]:
+        windows = self.monitor.broker_window_stats(now_ms)
+        if len(windows) < 2:
+            return []
+        ratios: dict[int, float] = {}
+        flush: dict[int, float] = {}
+        for broker, values in windows.items():
+            ft = float(values[BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_MEAN].mean())
+            by = float(values[BrokerMetric.LEADER_BYTES_IN].mean()
+                       + values[BrokerMetric.REPLICATION_BYTES_IN_RATE].mean())
+            ratios[broker] = ft / (by + 1.0)
+            flush[broker] = ft
+        vals = np.asarray(list(ratios.values()))
+        mean, std = vals.mean(), vals.std()
+        slow = {b: flush[b] for b, r in ratios.items()
+                if r > mean + self.num_std * std
+                and flush[b] > self.flush_time_floor_ms}
+        if not slow:
+            return []
+        return [SlowBrokers(detected_ms=now_ms, slow_brokers=slow,
+                            remove_slow_brokers=self.remove_slow_brokers)]
+
+
+class TopicAnomalyDetector:
+    """Replication-factor anomalies for matching topics (ref
+    TopicAnomalyDetector.java + TopicReplicationFactorAnomalyFinder.java)."""
+
+    def __init__(self, admin, *, target_rf: int = 2,
+                 topic_pattern: str = "*") -> None:
+        self.admin = admin
+        self.target_rf = target_rf
+        self.topic_pattern = topic_pattern
+
+    def detect(self, now_ms: int) -> list[TopicReplicationFactorAnomaly]:
+        by_topic: dict[str, set[int]] = {}
+        for (topic, _), info in self.admin.describe_partitions().items():
+            if fnmatch.fnmatch(topic, self.topic_pattern):
+                by_topic.setdefault(topic, set()).add(len(info.replicas))
+        bad = {t: min(rfs) for t, rfs in by_topic.items()
+               if rfs != {self.target_rf}}
+        if not bad:
+            return []
+        return [TopicReplicationFactorAnomaly(
+            detected_ms=now_ms, bad_topics=bad, target_rf=self.target_rf)]
+
+
+class MaintenanceEventReader:
+    """In-memory maintenance-plan source with idempotence de-dup (ref
+    MaintenanceEventTopicReader.java:350 + IdempotenceCache.java; the
+    reference reads serialized plans from a Kafka topic)."""
+
+    def __init__(self) -> None:
+        self._plans: list[MaintenanceEvent] = []
+        self._seen: set[tuple] = set()
+
+    def submit(self, event: MaintenanceEvent) -> bool:
+        key = (event.event_type, tuple(event.broker_ids),
+               event.topic_pattern, event.target_rf)
+        if key in self._seen:
+            return False
+        self._seen.add(key)
+        self._plans.append(event)
+        return True
+
+    def drain(self) -> list[MaintenanceEvent]:
+        plans, self._plans = self._plans, []
+        return plans
+
+
+class MaintenanceEventDetector:
+    """ref MaintenanceEventDetector.java."""
+
+    def __init__(self, reader: MaintenanceEventReader) -> None:
+        self.reader = reader
+
+    def detect(self, now_ms: int) -> list[MaintenanceEvent]:
+        return self.reader.drain()

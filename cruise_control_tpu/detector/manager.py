@@ -1,0 +1,198 @@
+"""Anomaly detector manager: the self-healing control loop (ref
+``detector/AnomalyDetectorManager.java:52``).
+
+Owns a priority queue of anomalies (``:74`` — priority by anomaly type,
+then detection time), schedules each detector at its own interval
+(``scheduleDetectorAtFixedRate`` ``:222``), and the handler step (ref
+``AnomalyHandlerTask`` ``:343``) consults the notifier per anomaly:
+FIX -> run the anomaly's fix through the facade (skipped while an
+execution is ongoing), CHECK -> requeue for later, IGNORE -> drop.
+
+Clock-driven: :meth:`run_once` performs one scheduling + handling round;
+:meth:`start_detection` runs it on a daemon thread for live deployments.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+from .anomalies import KafkaAnomaly, KafkaAnomalyType
+from .notifier import (AnomalyNotificationResult, AnomalyNotifier,
+                       SelfHealingNotifier)
+from .provisioner import BasicProvisioner, Provisioner
+
+
+@dataclass
+class DetectorSchedule:
+    detector: object            # has .detect(now_ms)
+    interval_ms: int
+    next_run_ms: int = 0
+
+
+class AnomalyDetectorManager:
+    def __init__(self, facade, notifier: AnomalyNotifier | None = None,
+                 provisioner: Provisioner | None = None,
+                 now_ms=None) -> None:
+        self.facade = facade
+        self.notifier = notifier or SelfHealingNotifier()
+        self.provisioner = provisioner or BasicProvisioner(facade.admin)
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        self._schedules: list[DetectorSchedule] = []
+        self._queue: list[tuple[int, int, int, KafkaAnomaly]] = []
+        self._counter = itertools.count()
+        self._lock = threading.RLock()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        # State for /state (ref AnomalyDetectorState.java).
+        self.recent_anomalies: dict[KafkaAnomalyType, list[dict]] = {
+            t: [] for t in KafkaAnomalyType}
+        self.num_self_healing_started = 0
+        self.num_self_healing_failed = 0
+        self.ongoing_self_healing: str | None = None
+
+    # ---------------------------------------------------------- wiring
+    def register(self, detector, interval_ms: int,
+                 initial_delay_ms: int = 0) -> None:
+        """ref scheduleDetectorAtFixedRate :222."""
+        self._schedules.append(DetectorSchedule(
+            detector, interval_ms, next_run_ms=initial_delay_ms))
+
+    def set_self_healing_enabled(self, anomaly_type_name: str,
+                                 value: bool) -> None:
+        atype = KafkaAnomalyType[anomaly_type_name.upper()]
+        if isinstance(self.notifier, SelfHealingNotifier):
+            self.notifier.set_self_healing_for(atype, value)
+
+    # ------------------------------------------------------------- loop
+    def run_once(self, now_ms: int | None = None) -> dict:
+        """One detection + handling round; returns a summary for tests."""
+        now = self._now_ms() if now_ms is None else now_ms
+        detected = self._run_due_detectors(now)
+        handled = self._handle_queue(now)
+        return {"detected": detected, **handled}
+
+    def _run_due_detectors(self, now: int) -> int:
+        detected = 0
+        for sched in self._schedules:
+            if now < sched.next_run_ms:
+                continue
+            sched.next_run_ms = now + sched.interval_ms
+            try:
+                anomalies = sched.detector.detect(now)
+            except Exception:
+                continue   # a broken detector must not kill the loop
+            for a in anomalies:
+                self._enqueue(a, now)
+                detected += 1
+        return detected
+
+    def _enqueue(self, anomaly: KafkaAnomaly, ready_ms: int) -> None:
+        with self._lock:
+            # De-dup: a pending anomaly of the same type and description is
+            # the same ongoing condition re-detected — keep the earliest so
+            # the notifier's time thresholds measure from first detection.
+            for _, _, _, queued in self._queue:
+                if (queued.anomaly_type is anomaly.anomaly_type
+                        and queued.reason() == anomaly.reason()):
+                    queued.merge_from(anomaly)   # absorb fresher data
+                    return
+            heapq.heappush(self._queue,
+                           (int(anomaly.anomaly_type), ready_ms,
+                            next(self._counter), anomaly))
+            history = self.recent_anomalies[anomaly.anomaly_type]
+            history.append(anomaly.to_json())
+            del history[:-10]
+
+    def _handle_queue(self, now: int) -> dict:
+        fixed, rechecks, ignored = 0, 0, 0
+        deferred: list[tuple[int, int, int, KafkaAnomaly]] = []
+        just_fixed: set[tuple[KafkaAnomalyType, str]] = set()
+        while True:
+            with self._lock:
+                if not self._queue:
+                    break
+                prio, ready, cnt, anomaly = heapq.heappop(self._queue)
+            if (anomaly.anomaly_type, anomaly.reason()) in just_fixed:
+                ignored += 1   # stale duplicate of a condition just fixed
+                continue
+            if ready > now:
+                deferred.append((prio, ready, cnt, anomaly))
+                continue
+            if not anomaly.still_valid(self.facade):
+                ignored += 1   # condition recovered while deferred
+                continue
+            action = self.notifier.on_anomaly(anomaly, now)
+            if action.result is AnomalyNotificationResult.FIX:
+                if self.facade.executor.has_ongoing_execution():
+                    # ref :534 fixAnomalyInProgress: wait for the executor
+                    deferred.append((prio, now + 10_000, cnt, anomaly))
+                    continue
+                fixed += 1
+                just_fixed.add((anomaly.anomaly_type, anomaly.reason()))
+                self.num_self_healing_started += 1
+                self.ongoing_self_healing = anomaly.anomaly_id
+                try:
+                    ok = anomaly.fix(self.facade)
+                    if not ok:
+                        self.num_self_healing_failed += 1
+                except Exception:
+                    self.num_self_healing_failed += 1
+                finally:
+                    self.ongoing_self_healing = None
+            elif action.result is AnomalyNotificationResult.CHECK:
+                rechecks += 1
+                deferred.append((prio, now + max(action.delay_ms, 1), cnt,
+                                 anomaly))
+            else:
+                ignored += 1
+        with self._lock:
+            for item in deferred:
+                heapq.heappush(self._queue, item)
+        return {"fixed": fixed, "rechecked": rechecks, "ignored": ignored}
+
+    # ------------------------------------------------- background thread
+    def start_detection(self, tick_s: float = 5.0) -> None:
+        """ref startDetection :235."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(tick_s):
+                try:
+                    self.run_once()
+                except Exception:
+                    pass
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="anomaly-detector")
+        self._thread.start()
+
+    def stop_detection(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # ------------------------------------------------------------- state
+    def state_json(self) -> dict:
+        """ref AnomalyDetectorState.java:424."""
+        balancedness = None
+        for sched in self._schedules:
+            if hasattr(sched.detector, "last_balancedness"):
+                balancedness = sched.detector.last_balancedness
+        return {
+            "selfHealingEnabled": {
+                t.name: v for t, v in
+                self.notifier.self_healing_enabled().items()},
+            "recentAnomalies": {t.name: v for t, v in
+                                self.recent_anomalies.items() if v},
+            "numSelfHealingStarted": self.num_self_healing_started,
+            "numSelfHealingFailed": self.num_self_healing_failed,
+            "ongoingSelfHealing": self.ongoing_self_healing,
+            "balancednessScore": balancedness,
+            "numQueuedAnomalies": len(self._queue),
+        }

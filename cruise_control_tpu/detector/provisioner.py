@@ -1,0 +1,102 @@
+"""Provisioning verdicts + the rightsizing hook.
+
+Ref ``analyzer/ProvisionStatus.java`` / ``ProvisionRecommendation.java`` /
+``ProvisionResponse.java`` (the verdict objects goals attach to results)
+and ``detector/BasicProvisioner.java`` + ``PartitionProvisioner.java`` /
+``BasicBrokerProvisioner.java`` (the actuator: partition provisioning is
+concrete — expand topics; broker provisioning is a platform hook).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+
+class ProvisionStatus(enum.Enum):
+    """ref ProvisionStatus.java."""
+
+    RIGHT_SIZED = "RIGHT_SIZED"
+    UNDER_PROVISIONED = "UNDER_PROVISIONED"
+    OVER_PROVISIONED = "OVER_PROVISIONED"
+    UNDECIDED = "UNDECIDED"
+
+
+@dataclass(frozen=True)
+class ProvisionRecommendation:
+    """ref ProvisionRecommendation.java (399 LoC of builder — here a frozen
+    record): a numeric recommendation attached to a verdict."""
+
+    status: ProvisionStatus
+    num_brokers: int | None = None
+    num_partitions: int | None = None
+    topic: str | None = None
+    resource: str | None = None
+    reason: str = ""
+
+    def to_json(self) -> dict:
+        out: dict = {"status": self.status.value, "reason": self.reason}
+        if self.num_brokers is not None:
+            out["numBrokers"] = self.num_brokers
+        if self.num_partitions is not None:
+            out["numPartitions"] = self.num_partitions
+        if self.topic is not None:
+            out["topic"] = self.topic
+        if self.resource is not None:
+            out["resource"] = self.resource
+        return out
+
+
+@dataclass
+class ProvisionResponse:
+    """ref ProvisionResponse.java: aggregate of per-goal verdicts — any
+    UNDER wins over OVER wins over RIGHT_SIZED."""
+
+    status: ProvisionStatus = ProvisionStatus.UNDECIDED
+    recommendations: list[ProvisionRecommendation] = field(default_factory=list)
+
+    def aggregate(self, rec: ProvisionRecommendation) -> None:
+        self.recommendations.append(rec)
+        order = [ProvisionStatus.UNDECIDED, ProvisionStatus.RIGHT_SIZED,
+                 ProvisionStatus.OVER_PROVISIONED,
+                 ProvisionStatus.UNDER_PROVISIONED]
+        if order.index(rec.status) > order.index(self.status):
+            self.status = rec.status
+
+    def to_json(self) -> dict:
+        return {"status": self.status.value,
+                "recommendations": [r.to_json() for r in self.recommendations]}
+
+
+class Provisioner:
+    """SPI (ref Provisioner.java): act on provision recommendations."""
+
+    def rightsize(self, recommendations: list[ProvisionRecommendation],
+                  **kwargs) -> dict:
+        raise NotImplementedError
+
+
+class BasicProvisioner(Provisioner):
+    """ref BasicProvisioner.java: partition provisioning is concrete
+    (creates the missing partitions via the admin client); broker
+    provisioning returns the recommendation for the platform layer."""
+
+    def __init__(self, admin) -> None:
+        self.admin = admin
+
+    def rightsize(self, recommendations: list[ProvisionRecommendation] | None = None,
+                  **kwargs) -> dict:
+        actions = []
+        for rec in recommendations or []:
+            if (rec.status is ProvisionStatus.UNDER_PROVISIONED
+                    and rec.num_partitions and rec.topic):
+                create = getattr(self.admin, "create_partitions", None)
+                if create is not None:
+                    create(rec.topic, rec.num_partitions)
+                    actions.append({"action": "created-partitions",
+                                    **rec.to_json()})
+                    continue
+            actions.append({"action": "recommended-only", **rec.to_json()})
+        return {"provisionerState": ("COMPLETED" if actions
+                                     else "COMPLETED_WITH_NO_ACTION"),
+                "actions": actions}

@@ -41,6 +41,7 @@ class _BrokerSim:
     #: replication bandwidth available for incoming copies, MB/s
     reassignment_rate_mb_s: float = 100.0
     logdirs: tuple[str, ...] = ("logdir0",)
+    failed_logdirs: set[str] = field(default_factory=set)
     config: dict[str, str] = field(default_factory=dict)
     metrics: dict[str, float] = field(default_factory=dict)
 
@@ -92,14 +93,61 @@ class SimulatedKafkaCluster:
                               size_mb=float(p.leader_load[Resource.DISK]))
         return sim
 
+    def _elect_leader(self, info: PartitionInfo) -> None:
+        """ISR-based re-election when the leader is lost (one rule, used by
+        broker death, logdir failure, and reassignment finalization)."""
+        alive_isr = [b for b in info.replicas
+                     if b in info.isr and self._brokers[b].alive]
+        info.leader = alive_isr[0] if alive_isr else -1
+
     # ------------------------------------------------------------ faults
     def kill_broker(self, broker_id: int) -> None:
         self._brokers[broker_id].alive = False
         for info in self._partitions.values():
             info.isr.discard(broker_id)
             if info.leader == broker_id:
-                alive_isr = [b for b in info.replicas if b in info.isr]
-                info.leader = alive_isr[0] if alive_isr else -1
+                self._elect_leader(info)
+
+    def fail_logdir(self, broker_id: int, logdir: str) -> None:
+        """A disk dies: replicas on that logdir go offline (ref the
+        offline-logdir state DiskFailureDetector scans for)."""
+        broker = self._brokers[broker_id]
+        broker.failed_logdirs.add(logdir)
+        for info in self._partitions.values():
+            if info.logdirs.get(broker_id) == logdir:
+                info.isr.discard(broker_id)
+                if info.leader == broker_id:
+                    self._elect_leader(info)
+
+    def offline_logdirs(self) -> dict[int, list[str]]:
+        return {b.broker_id: sorted(b.failed_logdirs)
+                for b in self._brokers.values() if b.failed_logdirs}
+
+    def offline_replicas(self) -> set[tuple[str, int, int]]:
+        """Replicas currently offline: hosted on a dead broker or a failed
+        logdir (feeds the monitor's per-replica offline marks)."""
+        out: set[tuple[str, int, int]] = set()
+        for (t, p), info in self._partitions.items():
+            for b in info.replicas:
+                broker = self._brokers[b]
+                if (not broker.alive
+                        or info.logdirs.get(b) in broker.failed_logdirs):
+                    out.add((t, p, b))
+        return out
+
+    def create_partitions(self, topic: str, additional: int,
+                          rf: int = 2, size_mb: float = 0.0) -> None:
+        """Expand a topic (ref PartitionProvisioner's actuation path)."""
+        existing = [p for (t, p) in self._partitions if t == topic]
+        next_id = max(existing, default=-1) + 1
+        alive = sorted(b.broker_id for b in self._brokers.values() if b.alive)
+        if not alive:
+            raise RuntimeError("no alive brokers to place partitions on")
+        rf = min(rf, len(alive))   # replica lists must be duplicate-free
+        for i in range(additional):
+            offset = (next_id + i) % len(alive)
+            replicas = [alive[(offset + j) % len(alive)] for j in range(rf)]
+            self.add_partition(topic, next_id + i, replicas, size_mb=size_mb)
 
     def restart_broker(self, broker_id: int) -> None:
         self._brokers[broker_id].alive = True
@@ -141,6 +189,13 @@ class SimulatedKafkaCluster:
         for c in finished:
             self._finish_copy(c)
 
+    def _healthy_logdir(self, broker_id: int) -> str:
+        broker = self._brokers[broker_id]
+        for d in broker.logdirs:
+            if d not in broker.failed_logdirs:
+                return d
+        return broker.logdirs[0]
+
     def _finish_copy(self, c: _Copy) -> None:
         info = self._partitions[c.tp]
         if c.intra_target_logdir is not None:
@@ -148,7 +203,7 @@ class SimulatedKafkaCluster:
             return
         info.isr.add(c.dest_broker)
         info.logdirs.setdefault(c.dest_broker,
-                                self._brokers[c.dest_broker].logdirs[0])
+                                self._healthy_logdir(c.dest_broker))
         target = self._reassign.get(c.tp)
         # Reassignment completes when every adding replica is in ISR.
         if target is not None and all(b in info.isr for b in target):
@@ -163,10 +218,9 @@ class SimulatedKafkaCluster:
         for b in removed:
             info.logdirs.pop(b, None)
         for b in info.replicas:
-            info.logdirs.setdefault(b, self._brokers[b].logdirs[0])
+            info.logdirs.setdefault(b, self._healthy_logdir(b))
         if info.leader not in target or not self._brokers[info.leader].alive:
-            alive_isr = [b for b in info.replicas if b in info.isr]
-            info.leader = alive_isr[0] if alive_isr else -1
+            self._elect_leader(info)
 
     # --------------------------------------------------- admin SPI (reads)
     def describe_cluster(self) -> dict[int, bool]:

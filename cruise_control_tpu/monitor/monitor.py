@@ -206,6 +206,8 @@ class LoadMonitor:
                     f"not meet {requirements}")
 
         c = self.config
+        offline_dirs_fn = getattr(self.admin, "offline_logdirs", None)
+        offline_dirs = offline_dirs_fn() if offline_dirs_fn is not None else {}
         brokers: list[BrokerSpec] = []
         for broker_id, is_alive in sorted(alive.items()):
             rack = self.rack_by_broker.get(broker_id, f"rack-{broker_id}")
@@ -213,11 +215,16 @@ class LoadMonitor:
                 rack, f"host-{broker_id}", broker_id)
             brokers.append(BrokerSpec(
                 broker_id=broker_id, rack=rack, capacity=cap.as_vector(),
-                alive=is_alive))
+                alive=is_alive,
+                broken_disk=bool(offline_dirs.get(broker_id))))
 
         pspecs: list[PartitionSpec] = []
         windows: dict[tuple[str, int], np.ndarray] = {}
         window_times: list[int] = []
+        # Per-replica offline marks beyond dead brokers (failed logdirs) —
+        # ref Replica.isCurrentOffline covering bad-disk replicas.
+        offline_fn = getattr(self.admin, "offline_replicas", None)
+        extra_offline = offline_fn() if offline_fn is not None else set()
         for tp, info in sorted(partitions.items()):
             leader_load = (0.0, 0.0, 0.0, float(info.size_mb))
             follower_load = None
@@ -239,7 +246,9 @@ class LoadMonitor:
                     leader_load = (cpu, nw_in, nw_out, disk)
                     follower_load = (cpu * c.follower_cpu_ratio, nw_in, 0.0,
                                      disk)
-            offline = [b for b in info.replicas if not alive.get(b, False)]
+            offline = [b for b in info.replicas
+                       if not alive.get(b, False)
+                       or (tp[0], tp[1], b) in extra_offline]
             # Slot 0 of the flat model is the leader positionally; the admin
             # tracks leadership separately and it diverges from replicas[0]
             # after failover/elections — reorder leader-first.
@@ -262,12 +271,19 @@ class LoadMonitor:
             generation=self.generation)
 
     def broker_window_stats(self, now_ms: int) -> dict[int, np.ndarray]:
-        """Per-broker [num_metrics, num_windows] aggregates (feeds slow-broker
-        and metric-anomaly detection)."""
+        """Per-broker [num_metrics, num_valid_windows] aggregates (feeds
+        slow-broker and metric-anomaly detection). Invalid windows are
+        zero-filled columns in the raw aggregate — dropping them here keeps
+        a merely-missed sampling round from reading as a metric collapse."""
         try:
             result = self.broker_aggregator.aggregate(
                 0, now_ms, AggregationOptions(min_valid_windows=0))
         except NotEnoughValidWindowsError:
             return {}
-        return {entity: vae.values
-                for entity, vae in result.entity_values.items()}
+        out: dict[int, np.ndarray] = {}
+        for entity, vae in result.entity_values.items():
+            cols = [j for j, e in enumerate(vae.extrapolations)
+                    if e is not Extrapolation.NO_VALID_EXTRAPOLATION]
+            if cols:
+                out[entity] = vae.values[:, cols]
+        return out

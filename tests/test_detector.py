@@ -1,0 +1,213 @@
+"""Detector layer tests, ending in the self-healing integration scenario:
+kill a broker in the simulated cluster -> detector fires -> notifier
+threshold elapses -> fix executes -> replicas drained (the rebuild of
+AnomalyDetectorManagerTest / BrokerFailureDetectorTest / the
+BrokerFailureIntegrationTest flow)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import TpuGoalOptimizer, goals_by_name
+from cruise_control_tpu.api import KafkaCruiseControl
+from cruise_control_tpu.core.anomaly import PercentileMetricAnomalyFinder
+from cruise_control_tpu.detector import (
+    AnomalyDetectorManager, BrokerFailureDetector, DiskFailureDetector,
+    GoalViolationDetector, KafkaAnomalyType, MaintenanceEvent,
+    MaintenanceEventDetector, MaintenanceEventReader, MaintenanceEventType,
+    MetricAnomalyDetector, SelfHealingNotifier, SlowBrokerFinder,
+    TopicAnomalyDetector, ProvisionStatus)
+from cruise_control_tpu.executor import (Executor, ExecutorConfig, SimClock,
+                                         SimulatedKafkaCluster)
+from cruise_control_tpu.monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                                        MetricFetcherManager, MonitorConfig,
+                                        SyntheticWorkloadSampler)
+
+WINDOW_MS = 1000
+MIN = 60_000
+
+
+def build_stack(num_brokers=4, partitions=12, rf=2):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=100_000.0, logdirs=("d0", "d1"))
+    for p in range(partitions):
+        replicas = [(p + i) % num_brokers for i in range(rf)]
+        sim.add_partition(f"t{p % 2}", p, replicas, size_mb=10.0 + p)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                                             min_samples_per_window=1,
+                                             num_broker_windows=8,
+                                             broker_window_ms=WINDOW_MS))
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=WINDOW_MS)
+    runner.start(-1, skip_loading=True)
+    clock = SimClock(sim)
+    executor = Executor(sim, ExecutorConfig(progress_check_interval_ms=100),
+                        now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(goals=goals_by_name(
+            ["ReplicaDistributionGoal", "DiskUsageDistributionGoal"])),
+        executor=executor, now_ms=lambda: sim.now_ms)
+    return sim, monitor, runner, facade
+
+
+def sample(runner, sim, windows, start=None):
+    start = sim.now_ms if start is None else start
+    for w in range(windows):
+        sim.advance_to(start + (w + 1) * WINDOW_MS)
+        assert runner.maybe_run_sampling(sim.now_ms)
+
+
+def test_broker_failure_detector_tracks_failure_times(tmp_path):
+    sim, monitor, runner, facade = build_stack()
+    det = BrokerFailureDetector(sim, persist_path=str(tmp_path / "failed.json"))
+    assert det.detect(1000) == []
+    sim.kill_broker(3)
+    anomalies = det.detect(2000)
+    assert anomalies[0].failed_brokers == {3: 2000}
+    # failure time sticks across polls and across restarts (persisted)
+    assert det.detect(9000)[0].failed_brokers == {3: 2000}
+    det2 = BrokerFailureDetector(sim, persist_path=str(tmp_path / "failed.json"))
+    assert det2.detect(10_000)[0].failed_brokers == {3: 2000}
+    sim.restart_broker(3)
+    assert det2.detect(11_000) == []
+
+
+def test_self_healing_notifier_thresholds():
+    from cruise_control_tpu.detector.anomalies import BrokerFailures
+    n = SelfHealingNotifier()
+    a = BrokerFailures(detected_ms=0, failed_brokers={3: 0})
+    assert n.on_anomaly(a, 1000).result.value == "CHECK"          # grace
+    assert n.on_anomaly(a, 16 * MIN).result.value == "CHECK"      # alerted
+    assert any("BROKER_FAILURE" in m for m in n.alerts)
+    assert n.on_anomaly(a, 31 * MIN).result.value == "FIX"        # auto-fix
+    n2 = SelfHealingNotifier(enabled={KafkaAnomalyType.BROKER_FAILURE: False})
+    assert n2.on_anomaly(a, 31 * MIN).result.value == "IGNORE"
+
+
+def test_disk_failure_detector_and_offline_marks():
+    sim, monitor, runner, facade = build_stack()
+    det = DiskFailureDetector(sim)
+    assert det.detect(0) == []
+    sim.fail_logdir(1, "d0")
+    anomalies = det.detect(1000)
+    assert anomalies[0].failed_disks == {1: ["d0"]}
+    # monitor marks those replicas offline in the model spec
+    sample(runner, sim, 4)
+    result = monitor.cluster_model(sim.now_ms)
+    offline = [p for p in result.spec.partitions if p.offline_replicas]
+    assert offline and all(1 in p.offline_replicas for p in offline)
+
+
+def test_goal_violation_detector_balancedness():
+    sim, monitor, runner, facade = build_stack()
+    sample(runner, sim, 4)
+    det = GoalViolationDetector(monitor, facade.optimizer)
+    anomalies = det.detect(sim.now_ms)
+    # cluster built round-robin: counts balanced; disk may be slightly off
+    score_before = det.last_balancedness
+    assert 0 <= score_before <= 100
+    if anomalies:
+        assert anomalies[0].fixable_violations or \
+            anomalies[0].unfixable_violations
+
+
+def test_topic_anomaly_detector():
+    sim, *_ = build_stack(rf=2)
+    det = TopicAnomalyDetector(sim, target_rf=3)
+    anomalies = det.detect(0)
+    assert set(anomalies[0].bad_topics) == {"t0", "t1"}
+    det2 = TopicAnomalyDetector(sim, target_rf=2)
+    assert det2.detect(0) == []
+
+
+def test_metric_anomaly_and_percentile_finder():
+    finder = PercentileMetricAnomalyFinder(min_history_windows=3,
+                                           interested_metrics=[0])
+    history = {0: np.array([[10.0, 11, 9, 10, 50.0]]),   # spike in last
+               1: np.array([[10.0, 11, 9, 10, 10.5]])}
+    anomalies = finder.anomalies(history)
+    assert len(anomalies) == 1 and anomalies[0].entity == 0
+
+
+def test_slow_broker_finder():
+    sim, monitor, runner, facade = build_stack()
+    # broker 2 reports pathological log flush times
+    sampler = SyntheticWorkloadSampler(sim)
+    runner.fetcher.sampler = sampler
+    sim._brokers[2].metrics["log_flush_time_ms"] = 5000.0
+    sample(runner, sim, 4)
+    finder = SlowBrokerFinder(monitor, num_std=1.5, flush_time_floor_ms=100.0)
+    anomalies = finder.detect(sim.now_ms)
+    assert anomalies and 2 in anomalies[0].slow_brokers
+
+
+def test_maintenance_event_idempotence():
+    reader = MaintenanceEventReader()
+    e = MaintenanceEvent(detected_ms=0,
+                         event_type=MaintenanceEventType.REMOVE_BROKER,
+                         broker_ids=[2])
+    assert reader.submit(e)
+    assert not reader.submit(MaintenanceEvent(
+        detected_ms=5, event_type=MaintenanceEventType.REMOVE_BROKER,
+        broker_ids=[2]))
+    det = MaintenanceEventDetector(reader)
+    assert len(det.detect(10)) == 1
+    assert det.detect(11) == []
+
+
+def test_provision_verdict_under_provisioned():
+    """A cluster whose disk demand exceeds capacity yields an
+    UNDER_PROVISIONED recommendation."""
+    from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                               PartitionSpec, flatten_spec)
+    from cruise_control_tpu.analyzer import OptimizationOptions
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i}",
+                          capacity=(100.0, 1e6, 1e6, 100.0))
+               for i in range(3)]
+    parts = [PartitionSpec(topic="t", partition=p, replicas=[p % 3],
+                           leader_load=(0.1, 1.0, 1.0, 80.0))
+             for p in range(6)]
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
+    opt = TpuGoalOptimizer(goals=goals_by_name(["DiskCapacityGoal"]))
+    res = opt.optimize(model, md, OptimizationOptions())
+    assert res.provision_response.status is ProvisionStatus.UNDER_PROVISIONED
+    rec = res.provision_response.recommendations[0]
+    assert rec.resource == "DISK" and rec.num_brokers >= 1
+
+
+def test_self_healing_integration_broker_failure():
+    """The headline loop: broker dies -> detector fires -> thresholds pass
+    -> manager fixes via remove_brokers -> replicas drained."""
+    sim, monitor, runner, facade = build_stack(num_brokers=5, partitions=10)
+    notifier = SelfHealingNotifier()
+    mgr = AnomalyDetectorManager(facade, notifier, now_ms=lambda: sim.now_ms)
+    facade.detector = mgr
+    mgr.register(BrokerFailureDetector(sim), interval_ms=30_000)
+    sample(runner, sim, 4)
+    sim.kill_broker(4)
+    t_fail = sim.now_ms
+
+    out = mgr.run_once(sim.now_ms)
+    assert out["detected"] == 1 and out["fixed"] == 0   # grace period
+    # within alert window: still no fix
+    sim.advance_to(t_fail + 16 * MIN)
+    sample(runner, sim, 4)
+    out = mgr.run_once(sim.now_ms)
+    assert out["fixed"] == 0
+    assert any("BROKER_FAILURE" in m for m in notifier.alerts)
+    # past the self-healing threshold: fix runs and drains the broker
+    sim.advance_to(t_fail + 31 * MIN)
+    sample(runner, sim, 4)
+    out = mgr.run_once(sim.now_ms)
+    assert out["fixed"] == 1
+    assert mgr.num_self_healing_started == 1
+    assert mgr.num_self_healing_failed == 0
+    remaining = [tp for tp, info in sim.describe_partitions().items()
+                 if 4 in info.replicas]
+    assert remaining == []
+    assert 4 in facade.executor.recently_removed_brokers
+    state = mgr.state_json()
+    assert state["numSelfHealingStarted"] == 1
+    assert state["recentAnomalies"]["BROKER_FAILURE"]
