@@ -14,7 +14,8 @@ from .goals import (GOAL_REGISTRY, CapacityGoal, GoalKernel,
                     RackAwareGoal, ReplicaCapacityGoal,
                     ReplicaDistributionGoal, ResourceDistributionGoal,
                     TopicReplicaDistributionGoal, default_goals, goals_by_name)
-from .optimizer import GoalResult, OptimizerResult, TpuGoalOptimizer
+from .optimizer import (GoalResult, OptimizationFailureError,
+                        OptimizerResult, TpuGoalOptimizer)
 from .options import OptimizationOptions
 
 __all__ = [
@@ -26,4 +27,5 @@ __all__ = [
     "default_goals", "goals_by_name", "GOAL_REGISTRY",
     "TpuGoalOptimizer", "OptimizerResult", "GoalResult",
     "OptimizationOptions",
+    "OptimizationFailureError",
 ]

@@ -42,6 +42,8 @@ class BalancingConstraint:
     overprovisioned_min_brokers: int = 3
     low_utilization_threshold: Tuple[float, float, float, float] = (
         0.0, 0.0, 0.0, 0.0)
+    #: ref min.topic.leaders.per.broker (MinTopicLeadersPerBrokerGoal)
+    min_topic_leaders_per_broker: int = 1
 
     def balance_threshold(self, resource: Resource) -> float:
         return self.resource_balance_threshold[int(resource)]

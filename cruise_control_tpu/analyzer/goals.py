@@ -127,6 +127,7 @@ class GoalKernel:
     name: str = "goal"
     hard: bool = False
     uses_topic_counts: bool = False
+    uses_topic_leader_counts: bool = False
 
     def violation(self, state: SearchState, ctx: SearchContext) -> jax.Array:
         raise NotImplementedError
@@ -956,6 +957,342 @@ class TopicReplicaDistributionGoal(GoalKernel):
         return ok1 & ok2
 
 
+class MinTopicLeadersPerBrokerGoal(GoalKernel):
+    """Every alive broker must lead at least ``min_count`` partitions of
+    each *interested* topic (ref ``MinTopicLeadersPerBrokerGoal.java``, 465
+    LoC; hard). Interested topics are configured by name pattern
+    (``topics.with.min.leaders.per.broker``); with no interested topics the
+    goal is inactive (the reference default).
+    """
+
+    name = "MinTopicLeadersPerBrokerGoal"
+    hard = True
+    uses_topic_counts = True
+    uses_topic_leader_counts = True
+
+    def __init__(self, constraint: BalancingConstraint, *,
+                 interested_topics: jax.Array | None = None,
+                 min_count: int | None = None):
+        self.constraint = constraint
+        #: bool[T] — topics the minimum applies to
+        self.interested_topics = interested_topics
+        self.min_count = (min_count if min_count is not None
+                          else constraint.min_topic_leaders_per_broker)
+        # An inactive instance (no interested topics — the default-chain
+        # case) must not force the engine to build/maintain [T, B1] state.
+        self.uses_topic_counts = interested_topics is not None
+        self.uses_topic_leader_counts = interested_topics is not None
+
+    def _deficit(self, state: SearchState, ctx: SearchContext) -> jax.Array:
+        """i32[T, B1] — leaders still missing per (topic, broker) cell.
+        Only callable on an active instance (interested_topics set)."""
+        tlc = state.topic_leader_counts
+        d = jnp.maximum(self.min_count - tlc, 0)
+        d = jnp.where(ctx.broker_alive[None, :], d, 0)
+        return jnp.where(self.interested_topics[:, None], d, 0)
+
+    def violation(self, state, ctx):
+        if self.interested_topics is None:   # inactive (no [T, B1] state)
+            return jnp.zeros((), jnp.float32)
+        return self._deficit(state, ctx).sum().astype(jnp.float32)
+
+    def propose(self, state, ctx, key, cfg):
+        if self.interested_topics is None:
+            # Inactive: an all-invalid batch keeps the engine's shapes static.
+            return _top_leadership(state, ctx, key, cfg,
+                                   jnp.full(state.rb.shape, _NEG))
+        deficit = self._deficit(state, ctx)                       # [T, B1]
+        t_of_p = ctx.partition_topic
+        # Leadership transfers: slot r>0 whose broker needs a leader of this
+        # topic, from a leader whose broker has surplus.
+        tlc = state.topic_leader_counts
+        surplus_src = (tlc[t_of_p, state.rb[:, 0]]
+                       > self.min_count)[:, None]                 # [P, 1]
+        gain = deficit[t_of_p[:, None], state.rb] > 0             # [P, R]
+        prio = jnp.where(gain & surplus_src, 1.0, _NEG)
+        lead = _top_leadership(state, ctx, key, cfg, prio)
+        # Fallback: relocate leader replicas onto deficit brokers.
+        rprio = jnp.where((jnp.arange(state.rb.shape[1]) == 0)[None, :]
+                          & surplus_src, 1.0, _NEG)
+        dest_prio = _norm01(deficit.sum(axis=0).astype(jnp.float32))
+        moves = _top_replica_dest_grid(state, ctx, key, cfg, rprio, dest_prio)
+        return concat_candidates(lead, moves)
+
+    def _cell_delta(self, state, ctx, c):
+        """Signed leadership arriving at dst (+) / leaving src for the
+        candidate's primary topic, and the swap counterpart's."""
+        is_lead = c.kind == MOVE_LEADERSHIP
+        moveswap = (c.kind == MOVE_INTER_BROKER) | (c.kind == MOVE_SWAP)
+        d1 = jnp.where(is_lead | (moveswap & (c.r == 0)), 1, 0)
+        d2 = jnp.where((c.kind == MOVE_SWAP) & (c.r2 == 0), 1, 0)
+        return d1, d2
+
+    def delta(self, state, ctx, c):
+        if self.interested_topics is None:
+            return jnp.zeros(c.p.shape, jnp.float32)
+        t1 = ctx.partition_topic[c.p]
+        t2 = ctx.partition_topic[c.p2]
+        d1, d2 = self._cell_delta(state, ctx, c)
+        tlc = state.topic_leader_counts
+
+        def pen(t, b, d):
+            cell = tlc[t, b]
+            active = ctx.broker_alive[b] & self.interested_topics[t]
+            before = jnp.maximum(self.min_count - cell, 0)
+            after = jnp.maximum(self.min_count - (cell + d), 0)
+            return jnp.where(active, after - before, 0)
+        out = (pen(t1, c.src, -d1) + pen(t1, c.dst, d1)
+               + pen(t2, c.dst, -d2) + pen(t2, c.src, d2))
+        return out.astype(jnp.float32)
+
+    def accepts(self, state, ctx, c):
+        # Hard: the losing cells may not sink below the minimum.
+        if self.interested_topics is None:
+            return jnp.ones(c.p.shape, bool)
+        tlc = state.topic_leader_counts
+        t1 = ctx.partition_topic[c.p]
+        t2 = ctx.partition_topic[c.p2]
+        d1, d2 = self._cell_delta(state, ctx, c)
+
+        def ok(t, b, d):
+            interested = self.interested_topics[t] & ctx.broker_alive[b]
+            return ~interested | (d >= 0) | (tlc[t, b] + d >= self.min_count)
+        return ok(t1, c.src, -d1) & ok(t2, c.dst, -d2)
+
+    def collective_guard(self, state, ctx, c, earlier):
+        if self.interested_topics is None:
+            return jnp.ones(c.p.shape, bool)
+        # Pessimistic (outflow-only) prefix accounting on the losing cells.
+        tlc = state.topic_leader_counts
+        B1 = state.util.shape[0]
+        t1 = ctx.partition_topic[c.p]
+        t2 = ctx.partition_topic[c.p2]
+        d1, d2 = self._cell_delta(state, ctx, c)
+        cells = jnp.stack([t1 * B1 + c.src, t2 * B1 + c.dst])      # losing
+        outs = jnp.stack([d1, d2]).astype(jnp.float32)
+        e = earlier.astype(jnp.float32)
+
+        def net_out(cell_ids):
+            acc = jnp.zeros(cell_ids.shape, jnp.float32)
+            for k in range(2):
+                acc = acc + (e * (cell_ids[:, None] == cells[k][None, :])
+                             ) @ outs[k]
+            return acc
+
+        def ok(t, b, cell_ids, d):
+            interested = self.interested_topics[t] & ctx.broker_alive[b]
+            after = tlc[t, b].astype(jnp.float32) - net_out(cell_ids) - d
+            return ~interested | (d <= 0) | (after >= self.min_count)
+        return (ok(t1, c.src, cells[0], d1.astype(jnp.float32))
+                & ok(t2, c.dst, cells[1], d2.astype(jnp.float32)))
+
+
+class BrokerSetAwareGoal(GoalKernel):
+    """Replicas of a topic must stay inside the topic's broker set (ref
+    ``BrokerSetAwareGoal.java``, 331 LoC; hard). ``topic_set[T]`` comes from
+    the broker-set resolver + topic mapping policy
+    (:mod:`cruise_control_tpu.config.brokersets`); broker_set comes from the
+    model (``broker_set`` array). Topics or brokers without a set (-1) are
+    unconstrained.
+    """
+
+    name = "BrokerSetAwareGoal"
+    hard = True
+
+    def __init__(self, constraint: BalancingConstraint, *,
+                 topic_set: jax.Array | None = None):
+        self.constraint = constraint
+        self.topic_set = topic_set     # i32[T] or None
+
+    def _mismatch(self, state, ctx) -> jax.Array:
+        """bool[P, R] — replica sits outside its topic's broker set."""
+        if self.topic_set is None:
+            return jnp.zeros(state.rb.shape, bool)
+        want = self.topic_set[ctx.partition_topic]                # [P]
+        have = ctx.broker_set[state.rb]                           # [P, R]
+        valid = state.rb < ctx.num_brokers_padded
+        return valid & (want[:, None] >= 0) & (have >= 0) \
+            & (have != want[:, None])
+
+    def violation(self, state, ctx):
+        return self._mismatch(state, ctx).sum().astype(jnp.float32)
+
+    def propose(self, state, ctx, key, cfg):
+        mism = self._mismatch(state, ctx)
+        prio = jnp.where(mism, 1.0, _NEG)
+        dest_prio = _norm01(-state.replica_count.astype(jnp.float32))
+        return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
+
+    def _dst_ok(self, ctx, c):
+        if self.topic_set is None:
+            return jnp.ones(c.p.shape, bool)
+        want1 = self.topic_set[ctx.partition_topic[c.p]]
+        ok1 = ((want1 < 0) | (ctx.broker_set[c.dst] < 0)
+               | (ctx.broker_set[c.dst] == want1))
+        want2 = self.topic_set[ctx.partition_topic[c.p2]]
+        ok2 = ((want2 < 0) | (ctx.broker_set[c.src] < 0)
+               | (ctx.broker_set[c.src] == want2))
+        is_move = c.kind == MOVE_INTER_BROKER
+        is_swap = c.kind == MOVE_SWAP
+        return jnp.where(is_move, ok1,
+                         jnp.where(is_swap, ok1 & ok2, True))
+
+    def delta(self, state, ctx, c):
+        if self.topic_set is None:
+            return jnp.zeros(c.p.shape, jnp.float32)
+        mism = self._mismatch(state, ctx)
+        before1 = mism[c.p, c.r]
+        # after for primary: mismatch iff dst not in topic's set
+        want1 = self.topic_set[ctx.partition_topic[c.p]]
+        a1 = (want1 >= 0) & (ctx.broker_set[c.dst] >= 0) \
+            & (ctx.broker_set[c.dst] != want1)
+        want2 = self.topic_set[ctx.partition_topic[c.p2]]
+        b2 = mism[c.p2, c.r2]
+        a2 = (want2 >= 0) & (ctx.broker_set[c.src] >= 0) \
+            & (ctx.broker_set[c.src] != want2)
+        is_move = c.kind == MOVE_INTER_BROKER
+        is_swap = c.kind == MOVE_SWAP
+        d1 = a1.astype(jnp.float32) - before1.astype(jnp.float32)
+        d2 = a2.astype(jnp.float32) - b2.astype(jnp.float32)
+        return jnp.where(is_move, d1, jnp.where(is_swap, d1 + d2, 0.0))
+
+    def accepts(self, state, ctx, c):
+        return self._dst_ok(ctx, c)
+
+    def collective_guard(self, state, ctx, c, earlier):
+        # Set membership is a per-replica property; no collective effect.
+        return jnp.ones(c.p.shape, bool)
+
+    def receptive_dest(self, state, ctx):
+        return jnp.ones(ctx.broker_alive.shape, bool)
+
+
+class RackAwareDistributionGoal(GoalKernel):
+    """Distribute each partition's replicas across racks as evenly as
+    possible (ref ``RackAwareDistributionGoal.java``, 449 LoC; hard). The
+    relaxation of strict rack-awareness for RF > #racks: at most
+    ``ceil(RF / num_alive_racks)`` replicas of a partition per rack.
+    """
+
+    name = "RackAwareDistributionGoal"
+    hard = True
+
+    def _limit(self, state: SearchState, ctx: SearchContext) -> jax.Array:
+        B1 = ctx.broker_rack.shape[0]
+        alive_racks = jnp.where(ctx.broker_alive, ctx.broker_rack, -1)
+        num_racks = jnp.maximum(_count_distinct(alive_racks, B1), 1)
+        rf = (state.rb < ctx.num_brokers_padded).sum(axis=1)      # [P]
+        return jnp.ceil(rf / num_racks).astype(jnp.int32)         # [P]
+
+    def _row_penalty(self, racks, valid, limit):
+        """Per-partition excess: sum over racks of max(0, n_rack - limit).
+        racks [..., R]; the first slot of each rack group carries the
+        group's penalty (lower-triangle first-occurrence trick)."""
+        R = racks.shape[-1]
+        same = (racks[..., :, None] == racks[..., None, :]) \
+            & valid[..., :, None] & valid[..., None, :]
+        n = same.sum(axis=-1)                                     # [..., R]
+        earlier = jnp.tril(jnp.ones((R, R), bool), k=-1)
+        first = valid & ~(same & earlier).any(axis=-1)
+        excess = jnp.maximum(n - limit[..., None], 0)
+        return jnp.where(first, excess, 0).sum(axis=-1)
+
+    def violation(self, state, ctx):
+        racks = ctx.broker_rack[state.rb]
+        valid = state.rb < ctx.num_brokers_padded
+        limit = self._limit(state, ctx)
+        return self._row_penalty(racks, valid, limit).sum().astype(jnp.float32)
+
+    def propose(self, state, ctx, key, cfg):
+        racks = ctx.broker_rack[state.rb]
+        valid = state.rb < ctx.num_brokers_padded
+        limit = self._limit(state, ctx)
+        same = (racks[:, :, None] == racks[:, None, :]) \
+            & valid[:, :, None] & valid[:, None, :]
+        n = same.sum(axis=-1)
+        prio = jnp.where(valid & (n > limit[:, None]), 1.0, _NEG)
+        dest_prio = _norm01(-state.replica_count.astype(jnp.float32))
+        return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
+
+    def _pen_after(self, state, ctx, p, r, new_broker):
+        """Partition p's penalty after slot r relocates to new_broker."""
+        rb = state.rb[p]                                          # [N, R]
+        R = rb.shape[-1]
+        rb2 = jnp.where(jnp.arange(R)[None, :] == r[..., None],
+                        new_broker[..., None], rb)
+        racks = ctx.broker_rack[rb2]
+        valid = rb2 < ctx.num_brokers_padded
+        limit = self._limit(state, ctx)[p]
+        return self._row_penalty(racks, valid, limit)
+
+    def _side_deltas(self, state, ctx, c):
+        racks = ctx.broker_rack[state.rb[c.p]]
+        valid = state.rb[c.p] < ctx.num_brokers_padded
+        limit = self._limit(state, ctx)[c.p]
+        before1 = self._row_penalty(racks, valid, limit)
+        after1 = self._pen_after(state, ctx, c.p, c.r, c.dst)
+        d1 = (after1 - before1).astype(jnp.float32)
+        racks2 = ctx.broker_rack[state.rb[c.p2]]
+        valid2 = state.rb[c.p2] < ctx.num_brokers_padded
+        limit2 = self._limit(state, ctx)[c.p2]
+        before2 = self._row_penalty(racks2, valid2, limit2)
+        after2 = self._pen_after(state, ctx, c.p2, c.r2, c.src)
+        d2 = (after2 - before2).astype(jnp.float32)
+        return d1, d2
+
+    def delta(self, state, ctx, c):
+        d1, d2 = self._side_deltas(state, ctx, c)
+        is_move = c.kind == MOVE_INTER_BROKER
+        is_swap = c.kind == MOVE_SWAP
+        return jnp.where(is_move, d1, jnp.where(is_swap, d1 + d2, 0.0))
+
+    def accepts(self, state, ctx, c):
+        # Hard: neither side of a swap may push a rack of ITS partition
+        # above the limit — per-side, not netted (like RackAwareGoal's
+        # per-side a1/a2 check): a big improvement on p2 must not buy a new
+        # violation on p.
+        d1, d2 = self._side_deltas(state, ctx, c)
+        is_move = c.kind == MOVE_INTER_BROKER
+        is_swap = c.kind == MOVE_SWAP
+        return jnp.where(is_move, d1 <= 0,
+                         jnp.where(is_swap, (d1 <= 0) & (d2 <= 0), True))
+
+    def collective_guard(self, state, ctx, c, earlier):
+        return jnp.ones(c.p.shape, bool)   # partition-local
+
+
+def _count_distinct(values: jax.Array, size: int) -> jax.Array:
+    """Number of distinct non-negative values below ``size`` — one scatter,
+    no quadratic pairwise matrix (values are rack ids, bounded by B1)."""
+    ones = jnp.zeros((size,), jnp.int32).at[
+        jnp.clip(values, 0, size - 1)].max(
+        jnp.where(values >= 0, 1, 0))
+    return ones.sum()
+
+
+class KafkaAssignerEvenRackAwareGoal(RackAwareDistributionGoal):
+    """Kafka-assigner mode's strict even-rack placement (ref
+    ``kafkaassigner/KafkaAssignerEvenRackAwareGoal.java``, 523 LoC). Same
+    even-spread objective as RackAwareDistributionGoal; the reference's
+    position-by-position assignment procedure is replaced by the batched
+    search reaching the same invariant (<= ceil(RF/num_racks) per rack).
+    """
+
+    name = "KafkaAssignerEvenRackAwareGoal"
+    hard = True
+
+
+class KafkaAssignerDiskUsageDistributionGoal(ResourceDistributionGoal):
+    """Kafka-assigner mode's minimal-movement disk balancing (ref
+    ``kafkaassigner/KafkaAssignerDiskUsageDistributionGoal.java``, 722 LoC):
+    disk-usage balance driven primarily by count-neutral swaps.
+    """
+
+    def __init__(self, constraint: BalancingConstraint):
+        super().__init__(Resource.DISK, constraint)
+        self.name = "KafkaAssignerDiskUsageDistributionGoal"
+
+
 class PreferredLeaderElectionGoal(GoalKernel):
     """Make the original first replica the leader again (ref
     PreferredLeaderElectionGoal.java — used by DemoteBroker and the
@@ -999,6 +1336,7 @@ def default_goals(constraint: BalancingConstraint | None = None
     cst = constraint or BalancingConstraint()
     return [
         RackAwareGoal(),
+        MinTopicLeadersPerBrokerGoal(cst),   # inactive until topics configured
         ReplicaCapacityGoal(cst),
         CapacityGoal(Resource.DISK, cst),
         CapacityGoal(Resource.NW_IN, cst),
@@ -1033,7 +1371,19 @@ GOAL_REGISTRY = {
     "LeaderReplicaDistributionGoal": LeaderReplicaDistributionGoal,
     "LeaderBytesInDistributionGoal": LeaderBytesInDistributionGoal,
     "PreferredLeaderElectionGoal": lambda cst: PreferredLeaderElectionGoal(),
+    "MinTopicLeadersPerBrokerGoal": MinTopicLeadersPerBrokerGoal,
+    "BrokerSetAwareGoal": BrokerSetAwareGoal,
+    "RackAwareDistributionGoal": lambda cst: RackAwareDistributionGoal(),
+    "KafkaAssignerEvenRackAwareGoal":
+        lambda cst: KafkaAssignerEvenRackAwareGoal(),
+    "KafkaAssignerDiskUsageDistributionGoal":
+        KafkaAssignerDiskUsageDistributionGoal,
 }
+
+#: Kafka-assigner mode's minimal goal set (ref analyzer/kafkaassigner/,
+#: triggered by the kafka_assigner=true request parameter).
+KAFKA_ASSIGNER_GOALS = ["KafkaAssignerEvenRackAwareGoal",
+                        "KafkaAssignerDiskUsageDistributionGoal"]
 
 
 def goals_by_name(names: list[str],

@@ -89,6 +89,16 @@ class OptimizerResult:
                                       else self.provision_response.to_json())}
 
 
+class OptimizationFailureError(RuntimeError):
+    """A hard goal remains violated (ref OptimizationFailureException).
+    Carries the result so callers can still read the provision verdict and
+    per-goal diagnostics."""
+
+    def __init__(self, message: str, result: OptimizerResult):
+        super().__init__(message)
+        self.result = result
+
+
 class TpuGoalOptimizer:
     """Owns compiled goal chains; reusable across models with the same padded
     shapes (recompiles transparently otherwise — XLA cache keyed on shapes)."""
@@ -136,10 +146,13 @@ class TpuGoalOptimizer:
                 options.broker_mask(metadata, B,
                                     options.excluded_brokers_for_leadership)))
 
-        needs_topics = any(g.uses_topic_counts for g in self.goals)
+        needs_tlc = any(g.uses_topic_leader_counts for g in self.goals)
+        needs_topics = needs_tlc or any(g.uses_topic_counts
+                                        for g in self.goals)
         state = init_state(
             model,
-            with_topic_counts=metadata.num_topics if needs_topics else None)
+            with_topic_counts=metadata.num_topics if needs_topics else None,
+            with_topic_leader_counts=needs_tlc)
 
         key = jax.random.PRNGKey(options.seed)
 
@@ -184,11 +197,16 @@ class TpuGoalOptimizer:
 
         final = to_model(state, model)
         proposals = diff_proposals(model, final, metadata)
-        return OptimizerResult(
+        result = OptimizerResult(
             proposals=proposals, goal_results=goal_results,
             num_moves=int(jax.device_get(state.moves_applied)),
             duration_s=time.monotonic() - t0, final_model=final,
             provision_response=self._provision_verdict(final, goal_results))
+        if result.violated_hard_goals and not options.skip_hard_goal_check:
+            raise OptimizationFailureError(
+                f"hard goals still violated after optimization: "
+                f"{result.violated_hard_goals}", result)
+        return result
 
     def _provision_verdict(self, final: FlatClusterModel,
                            goal_results: list[GoalResult]):

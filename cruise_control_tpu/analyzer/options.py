@@ -27,6 +27,12 @@ class OptimizationOptions:
     destination_broker_ids: frozenset[int] = frozenset()
     fast_mode: bool = False
     seed: int = 0
+    #: When False (the default, matching the reference), an optimization
+    #: that leaves a hard goal violated raises OptimizationFailureError
+    #: instead of silently returning an unsafe plan (ref
+    #: skip_hard_goal_check request parameter; AbstractGoal throwing
+    #: OptimizationFailureException).
+    skip_hard_goal_check: bool = False
 
     def excluded_partition_mask(self, metadata: ClusterMetadata,
                                 padded_partitions: int) -> np.ndarray | None:

@@ -47,6 +47,7 @@ class SearchContext:
     partition_valid: jax.Array    # bool[P]
     broker_capacity: jax.Array    # f32[B1, 4] (sentinel row: 0)
     broker_rack: jax.Array        # i32[B1] (sentinel: -1)
+    broker_set: jax.Array         # i32[B1] (sentinel: -1; -1 = unassigned)
     broker_alive: jax.Array       # bool[B1]
     broker_valid: jax.Array       # bool[B1]
     dest_allowed: jax.Array       # bool[B1] — may receive replicas
@@ -81,6 +82,8 @@ class SearchState:
     leader_nw_in: jax.Array    # f32[B1]
     topic_counts: jax.Array | None  # i32[T, B1] or None (only when a
     #                                 topic-scoped goal is in the chain)
+    topic_leader_counts: jax.Array | None  # i32[T, B1] or None (only for
+    #                                 MinTopicLeadersPerBrokerGoal chains)
     moves_applied: jax.Array   # i32 scalar — total actions applied so far
 
 
@@ -113,8 +116,9 @@ class Candidates:
     d_lni: jax.Array        # f32[N] leader-NW_IN delta (signed for swaps)
 
 
-def init_state(model: FlatClusterModel, *, with_topic_counts: int | None = None
-               ) -> SearchState:
+def init_state(model: FlatClusterModel, *,
+               with_topic_counts: int | None = None,
+               with_topic_leader_counts: bool = False) -> SearchState:
     """Build the search state from a flat model (one full reduction; all
     subsequent updates are incremental)."""
     P, R = model.replica_broker.shape
@@ -141,14 +145,23 @@ def init_state(model: FlatClusterModel, *, with_topic_counts: int | None = None
     leader_nw_in = jnp.zeros((B1,), jnp.float32).at[rb[:, 0]].add(lni).at[B].set(0.0)
 
     topic_counts = None
+    topic_leader_counts = None
+    if with_topic_leader_counts and with_topic_counts is None:
+        raise ValueError("with_topic_leader_counts requires the topic count "
+                         "(pass with_topic_counts=num_topics)")
     if with_topic_counts is not None:
         T = with_topic_counts
         idx = model.partition_topic[:, None] * B1 + rb                # [P, R]
         tc = jnp.zeros((T * B1,), jnp.int32).at[idx.reshape(-1)].add(
             jnp.where(valid, 1, 0).reshape(-1), mode="drop")
         topic_counts = tc.reshape(T, B1).at[:, B].set(0)
+        if with_topic_leader_counts:
+            lidx = model.partition_topic * B1 + rb[:, 0]              # [P]
+            tlc = jnp.zeros((T * B1,), jnp.int32).at[lidx].add(
+                jnp.where(model.partition_valid, 1, 0), mode="drop")
+            topic_leader_counts = tlc.reshape(T, B1).at[:, B].set(0)
 
-    pos = jnp.tile(jnp.arange(R, dtype=jnp.int32)[None, :], (P, 1))
+    pos = jnp.array(model.replica_pref_pos, copy=True)
     # A replica hosted on a dead (or padding) broker is offline whether or
     # not the model builder flagged it (ref Replica.isCurrentOffline derives
     # from broker state) — offline replicas are the must-move set that
@@ -160,6 +173,7 @@ def init_state(model: FlatClusterModel, *, with_topic_counts: int | None = None
                        util=util, replica_count=counts, leader_count=leaders,
                        potential_nw_out=potential, leader_nw_in=leader_nw_in,
                        topic_counts=topic_counts,
+                       topic_leader_counts=topic_leader_counts,
                        moves_applied=jnp.zeros((), jnp.int32))
 
 
@@ -183,6 +197,7 @@ def build_context(model: FlatClusterModel, *,
     bvalid = _pad1(model.broker_valid, False)
     capacity = _pad1(model.broker_capacity, 0.0)
     rack = _pad1(model.broker_rack, -1)
+    bset = _pad1(model.broker_set, -1)
 
     # Brokers with broken disks stay alive (healthy replicas keep serving)
     # but may not RECEIVE replicas (ref ClusterModel BAD_DISKS broker state;
@@ -209,7 +224,8 @@ def build_context(model: FlatClusterModel, *,
         leader_load=model.leader_load, follower_load=model.follower_load,
         partition_topic=model.partition_topic,
         partition_valid=model.partition_valid,
-        broker_capacity=capacity, broker_rack=rack, broker_alive=alive,
+        broker_capacity=capacity, broker_rack=rack, broker_set=bset,
+        broker_alive=alive,
         broker_valid=bvalid, dest_allowed=dest,
         leader_dest_allowed=lead_dest, raw_dest_allowed=dest,
         movable=movable,
@@ -490,10 +506,28 @@ def apply_group(state: SearchState, ctx: SearchContext, c: Candidates,
                 .at[t2 * B1 + c.src].add(tc2))
         topic_counts = flat.reshape(topic_counts.shape)
 
+    topic_leader_counts = state.topic_leader_counts
+    if topic_leader_counts is not None:
+        B1 = state.util.shape[0]
+        t1 = ctx.partition_topic[p]
+        t2 = ctx.partition_topic[c.p2]
+        # Leadership of p lands on dst for: leadership transfers, and
+        # leader-replica (r == 0) moves/swaps. Swap counterparts with
+        # r2 == 0 haul p2's leadership to src.
+        d1 = jnp.where(is_lead | ((is_move | is_swap) & (r == 0)), 1, 0)
+        d2 = jnp.where(is_swap & (c.r2 == 0), 1, 0)
+        flat = topic_leader_counts.reshape(-1)
+        flat = (flat.at[t1 * B1 + c.src].add(-d1)
+                .at[t1 * B1 + c.dst].add(d1)
+                .at[t2 * B1 + c.dst].add(-d2)
+                .at[t2 * B1 + c.src].add(d2))
+        topic_leader_counts = flat.reshape(topic_leader_counts.shape)
+
     return state.replace(rb=rb, pos=pos, offline=off, util=util,
                          replica_count=counts, leader_count=leaders,
                          potential_nw_out=potential, leader_nw_in=lni,
                          topic_counts=topic_counts,
+                         topic_leader_counts=topic_leader_counts,
                          moves_applied=state.moves_applied
                          + do.sum(dtype=jnp.int32))
 

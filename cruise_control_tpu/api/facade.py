@@ -111,6 +111,13 @@ class KafkaCruiseControl:
                      and options == OptimizationOptions())
         if use_cache:
             res = self.proposal_cache.get(self._now_ms())
+            # The cache computes with skip_hard_goal_check; a rebalance
+            # keeps the reference's strict semantics.
+            if res.violated_hard_goals and not options.skip_hard_goal_check:
+                from ..analyzer import OptimizationFailureError
+                raise OptimizationFailureError(
+                    f"hard goals still violated: {res.violated_hard_goals}",
+                    res)
         else:
             res = self._optimize(progress, goals, options)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress)

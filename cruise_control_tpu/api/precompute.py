@@ -21,7 +21,12 @@ class ProposalCache:
                  options: OptimizationOptions | None = None) -> None:
         self.monitor = monitor
         self.optimizer = optimizer
-        self.options = options or OptimizationOptions()
+        # The cache is a dry-run measurement: a hard goal that cannot be
+        # satisfied is a *cacheable finding* (served with its provision
+        # verdict), not an error to re-burn compute on every refresh tick.
+        # Readers that execute re-apply strict semantics (facade.rebalance).
+        self.options = options or OptimizationOptions(
+            skip_hard_goal_check=True)
         self._lock = threading.Condition()
         self._cached = None            # OptimizerResult
         self._cached_generation: int | None = None

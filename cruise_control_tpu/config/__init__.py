@@ -2,9 +2,18 @@
 providers, and the config-constant registry (ref ``config/`` +
 ``config/constants/``)."""
 
+from .brokersets import (BrokerSetResolver, FileBrokerSetResolver,
+                         StaticBrokerSetResolver, modulo_assignment,
+                         topic_set_array, topic_set_by_name_hash)
 from .capacity import (BrokerCapacityConfigResolver, BrokerCapacityInfo,
                        DEFAULT_CAPACITY, FileCapacityResolver,
                        FixedCapacityResolver)
+from .topics import (AdminTopicConfigProvider, JsonFileTopicConfigProvider,
+                     TopicConfigProvider)
 
 __all__ = ["BrokerCapacityConfigResolver", "BrokerCapacityInfo",
-           "DEFAULT_CAPACITY", "FileCapacityResolver", "FixedCapacityResolver"]
+           "DEFAULT_CAPACITY", "FileCapacityResolver", "FixedCapacityResolver",
+           "BrokerSetResolver", "FileBrokerSetResolver",
+           "StaticBrokerSetResolver", "modulo_assignment", "topic_set_array",
+           "topic_set_by_name_hash", "AdminTopicConfigProvider",
+           "JsonFileTopicConfigProvider", "TopicConfigProvider"]

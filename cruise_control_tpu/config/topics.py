@@ -1,0 +1,52 @@
+"""Topic configuration providers (ref ``config/TopicConfigProvider`` SPI:
+``KafkaAdminTopicConfigProvider`` (AdminClient-backed),
+``JsonFileTopicConfigProvider``). Supplies per-topic configs like
+``min.insync.replicas`` to goals/strategies that need them."""
+
+from __future__ import annotations
+
+import json
+from typing import Protocol
+
+
+class TopicConfigProvider(Protocol):
+    """SPI (ref TopicConfigProvider.java)."""
+
+    def cluster_configs(self) -> dict[str, str]: ...
+
+    def topic_configs(self, topic: str) -> dict[str, str]: ...
+
+
+class JsonFileTopicConfigProvider:
+    """ref JsonFileTopicConfigProvider: a JSON document of cluster-level +
+    per-topic configs."""
+
+    def __init__(self, path: str):
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        self._cluster = {str(k): str(v)
+                         for k, v in doc.get("cluster", {}).items()}
+        self._topics = {t: {str(k): str(v) for k, v in cfg.items()}
+                        for t, cfg in doc.get("topics", {}).items()}
+
+    def cluster_configs(self) -> dict[str, str]:
+        return dict(self._cluster)
+
+    def topic_configs(self, topic: str) -> dict[str, str]:
+        out = dict(self._cluster)
+        out.update(self._topics.get(topic, {}))
+        return out
+
+
+class AdminTopicConfigProvider:
+    """ref KafkaAdminTopicConfigProvider: reads live (dynamic) topic configs
+    through the cluster admin client."""
+
+    def __init__(self, admin):
+        self.admin = admin
+
+    def cluster_configs(self) -> dict[str, str]:
+        return {}
+
+    def topic_configs(self, topic: str) -> dict[str, str]:
+        return self.admin.describe_topic_config(topic)

@@ -130,8 +130,11 @@ class GoalViolationDetector:
         except NotEnoughValidWindowsException:
             return []
         from ..analyzer import OptimizationOptions
-        res = self.optimizer.optimize(result.model, result.metadata,
-                                      OptimizationOptions())
+        # Detection is a dry-run measurement: unfixable hard goals are a
+        # *finding* here, not an error.
+        res = self.optimizer.optimize(
+            result.model, result.metadata,
+            OptimizationOptions(skip_hard_goal_check=True))
         goals = self.optimizer.goals
         total_w = sum(self._goal_weight(i, g.hard, len(goals))
                       for i, g in enumerate(goals))

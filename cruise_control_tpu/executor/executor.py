@@ -95,10 +95,18 @@ class Executor:
     def __init__(self, admin: ClusterAdminClient,
                  config: ExecutorConfig | None = None,
                  notifier: ExecutorNotifier | None = None,
+                 topic_config_provider=None,
                  now_ms=None, sleep_ms=None) -> None:
         self.admin = admin
         self.config = config or ExecutorConfig()
         self.notifier = notifier or ExecutorNotifier()
+        # Per-topic min.insync.replicas source for the min-ISR-aware
+        # strategies/adjuster (ref TopicConfigProvider SPI); defaults to
+        # reading dynamic topic configs through the admin client.
+        if topic_config_provider is None:
+            from ..config.topics import AdminTopicConfigProvider
+            topic_config_provider = AdminTopicConfigProvider(admin)
+        self.topic_config_provider = topic_config_provider
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         self._sleep_ms = sleep_ms or (lambda ms: _time.sleep(ms / 1000))
         self._lock = threading.RLock()
@@ -378,10 +386,21 @@ class Executor:
                if len(info.isr) < len(info.replicas)}
         offline = {tp for tp, info in parts.items()
                    if any(not alive.get(b, False) for b in info.replicas)}
+
+        min_isr_cache: dict[str, int] = {}
+
+        def min_isr(topic: str) -> int:
+            if topic not in min_isr_cache:
+                cfg = self.topic_config_provider.topic_configs(topic)
+                min_isr_cache[topic] = int(
+                    cfg.get("min.insync.replicas", 1))
+            return min_isr_cache[topic]
+
         return StrategyContext(
             partition_size_mb={tp: info.size_mb for tp, info in parts.items()},
             urp=urp,
             min_isr_with_offline={tp for tp in offline
-                                  if len(parts[tp].isr) <= 1},
-            one_above_min_isr_with_offline={tp for tp in offline
-                                            if len(parts[tp].isr) == 2})
+                                  if len(parts[tp].isr) <= min_isr(tp[0])},
+            one_above_min_isr_with_offline={
+                tp for tp in offline
+                if len(parts[tp].isr) == min_isr(tp[0]) + 1})

@@ -60,6 +60,10 @@ class FlatClusterModel:
     partition_topic: jax.Array     # int32[P]
     partition_valid: jax.Array     # bool[P]
     replica_offline: jax.Array     # bool[P, R]
+    #: position of each slot's broker in Kafka's *preferred* replica order
+    #: (slot 0 = current leader; pref_pos[p, 0] != 0 means leadership has
+    #: drifted from the preferred replica — PLE's target state)
+    replica_pref_pos: jax.Array    # int32[P, R]
     # --- broker axis ------------------------------------------------------
     broker_capacity: jax.Array     # float32[B, 4]
     broker_rack: jax.Array         # int32[B]

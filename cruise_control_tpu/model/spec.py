@@ -51,6 +51,11 @@ class PartitionSpec:
     leader_load: Sequence[float] = (0.0, 0.0, 0.0, 0.0)    # CPU,NW_IN,NW_OUT,DISK
     follower_load: Sequence[float] | None = None  # default derived from leader
     offline_replicas: Sequence[int] = ()          # broker ids currently offline
+    #: Kafka's *preferred* replica order (the assignment list). When the
+    #: current leader (replicas[0]) has drifted from the preferred leader
+    #: (preferred_replicas[0]), PreferredLeaderElectionGoal restores it.
+    #: None = current order is the preferred order.
+    preferred_replicas: Sequence[int] | None = None
 
     def derived_follower_load(self) -> tuple[float, ...]:
         """Follower load derived from leader load when not given explicitly.
@@ -197,6 +202,9 @@ def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
     ptopic = np.full(Ppad, -1, np.int32)
     pvalid = np.zeros(Ppad, bool)
     offline = np.zeros((Ppad, Rpad), bool)
+    # Position of each slot's broker in the preferred order; default = slot
+    # index (current order == preferred order).
+    pref_pos = np.tile(np.arange(Rpad, dtype=np.int32), (Ppad, 1))
 
     for p, part in enumerate(spec.partitions):
         key = (part.topic, part.partition)
@@ -209,11 +217,19 @@ def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
         if len(set(part.replicas)) != len(part.replicas):
             raise ValueError(f"partition {key}: duplicate replica brokers")
         offline_ids = set(part.offline_replicas)
+        pref = (list(part.preferred_replicas)
+                if part.preferred_replicas is not None else None)
+        if pref is not None and sorted(pref) != sorted(part.replicas):
+            raise ValueError(
+                f"partition {key}: preferred_replicas must be a permutation "
+                "of replicas")
         for r, bid in enumerate(part.replicas):
             if bid not in broker_index:
                 raise ValueError(f"partition {key}: unknown broker {bid}")
             rb[p, r] = broker_index[bid]
             offline[p, r] = bid in offline_ids
+            if pref is not None:
+                pref_pos[p, r] = pref.index(bid)
         lead_load[p] = np.asarray(part.leader_load, np.float32)
         foll_load[p] = np.asarray(part.derived_follower_load(), np.float32)
 
@@ -228,6 +244,7 @@ def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
         partition_topic=jnp.asarray(ptopic),
         partition_valid=jnp.asarray(pvalid),
         replica_offline=jnp.asarray(offline),
+        replica_pref_pos=jnp.asarray(pref_pos),
         broker_capacity=jnp.asarray(capacity),
         broker_rack=jnp.asarray(b_rack),
         broker_host=jnp.asarray(b_host),

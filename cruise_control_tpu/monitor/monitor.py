@@ -259,7 +259,10 @@ class LoadMonitor:
             pspecs.append(PartitionSpec(
                 topic=tp[0], partition=tp[1], replicas=replicas,
                 leader_load=leader_load, follower_load=follower_load,
-                offline_replicas=offline))
+                offline_replicas=offline,
+                # The admin's stored order IS Kafka's preferred order; when
+                # the current leader drifted from it, PLE can now see that.
+                preferred_replicas=list(info.replicas)))
 
         spec = ClusterSpec(brokers=brokers, partitions=pspecs)
         model, metadata = flatten_spec(spec)

@@ -171,7 +171,14 @@ def test_provision_verdict_under_provisioned():
              for p in range(6)]
     model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
     opt = TpuGoalOptimizer(goals=goals_by_name(["DiskCapacityGoal"]))
-    res = opt.optimize(model, md, OptimizationOptions())
+    # Strict mode (the default) raises on the unfixable hard goal, carrying
+    # the result; skip_hard_goal_check returns it directly.
+    from cruise_control_tpu.analyzer import OptimizationFailureError
+    with pytest.raises(OptimizationFailureError) as exc:
+        opt.optimize(model, md, OptimizationOptions())
+    assert exc.value.result.violated_hard_goals == ["DiskCapacityGoal"]
+    res = opt.optimize(model, md,
+                       OptimizationOptions(skip_hard_goal_check=True))
     assert res.provision_response.status is ProvisionStatus.UNDER_PROVISIONED
     rec = res.provision_response.recommendations[0]
     assert rec.resource == "DISK" and rec.num_brokers >= 1

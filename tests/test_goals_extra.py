@@ -1,0 +1,152 @@
+"""Tests for the extended goal catalog: MinTopicLeadersPerBroker,
+BrokerSetAware, RackAwareDistribution, kafka-assigner pair, non-vacuous
+PreferredLeaderElection (leadership drift), and strict hard-goal mode."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (BalancingConstraint,
+                                         OptimizationOptions,
+                                         TpuGoalOptimizer, goals_by_name)
+from cruise_control_tpu.analyzer.goals import (
+    BrokerSetAwareGoal, KAFKA_ASSIGNER_GOALS, MinTopicLeadersPerBrokerGoal,
+    RackAwareDistributionGoal)
+from cruise_control_tpu.config.brokersets import (StaticBrokerSetResolver,
+                                                  topic_set_array)
+from cruise_control_tpu.model.flat import sanity_check
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+
+
+def build(brokers, partitions):
+    return flatten_spec(ClusterSpec(brokers=brokers, partitions=partitions))
+
+
+def run(goals, model, md, seed=0, **opt):
+    optimizer = TpuGoalOptimizer(goals=goals)
+    return optimizer.optimize(model, md,
+                              OptimizationOptions(seed=seed, **opt))
+
+
+def test_preferred_leader_election_restores_drifted_leaders():
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i}") for i in range(3)]
+    # Partition 0: leadership drifted (current leader 1, preferred 0).
+    parts = [
+        PartitionSpec("t", 0, replicas=[1, 0], preferred_replicas=[0, 1],
+                      leader_load=(1.0, 5.0, 5.0, 10.0)),
+        PartitionSpec("t", 1, replicas=[1, 2],
+                      leader_load=(1.0, 5.0, 5.0, 10.0)),
+    ]
+    model, md = build(brokers, parts)
+    res = run(goals_by_name(["PreferredLeaderElectionGoal"]), model, md)
+    ple = res.goal_results[0]
+    assert ple.violation_before == 1.0 and ple.violation_after == 0.0
+    # the proposal restores broker 0 as leader of partition 0
+    assert len(res.proposals) == 1
+    assert res.proposals[0].new_leader == 0
+    assert all(v == 0 for v in sanity_check(res.final_model).values())
+
+
+def test_min_topic_leaders_per_broker():
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i}") for i in range(3)]
+    # Topic "hot": all leaders on broker 0; every broker must lead >= 1.
+    parts = [PartitionSpec("hot", p, replicas=[0, 1 + p % 2],
+                           leader_load=(1.0, 5.0, 5.0, 10.0))
+             for p in range(6)]
+    model, md = build(brokers, parts)
+    cst = BalancingConstraint()
+    interested = jnp.asarray(np.array([True]))   # topic index 0 = "hot"
+    goal = MinTopicLeadersPerBrokerGoal(cst, interested_topics=interested)
+    res = run([goal], model, md)
+    gr = res.goal_results[0]
+    assert gr.violation_before == 2.0   # brokers 1, 2 lead nothing
+    assert gr.violation_after == 0.0
+    # inactive without interested topics
+    res2 = run([MinTopicLeadersPerBrokerGoal(cst)], model, md)
+    assert res2.goal_results[0].violation_before == 0.0
+
+
+def test_broker_set_aware_goal():
+    resolver = StaticBrokerSetResolver({0: "A", 1: "A", 2: "B", 3: "B"})
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i}",
+                          broker_set=resolver.broker_set_for(i))
+               for i in range(4)]
+    # topic "a" belongs to set A but has replicas on set B brokers.
+    parts = [PartitionSpec("a", p, replicas=[p % 2, 2 + p % 2],
+                           leader_load=(1.0, 5.0, 5.0, 10.0))
+             for p in range(4)]
+    model, md = build(brokers, parts)
+    tset = topic_set_array(md.topics, md.broker_sets, explicit={"a": "A"})
+    goal = BrokerSetAwareGoal(BalancingConstraint(),
+                              topic_set=jnp.asarray(tset))
+    res = run([goal], model, md)
+    gr = res.goal_results[0]
+    assert gr.violation_before == 4.0 and gr.violation_after == 0.0
+    # all replicas now on set A brokers {0, 1}
+    rb = np.asarray(res.final_model.replica_broker)
+    valid = rb < res.final_model.broker_sentinel
+    assert set(rb[valid].tolist()) <= {0, 1}
+    assert all(v == 0 for v in sanity_check(res.final_model).values())
+
+
+def test_rack_aware_distribution_allows_rf_above_racks():
+    # 2 racks, RF 3: strict rack-awareness is unsatisfiable; the
+    # distribution flavor wants <= ceil(3/2) = 2 replicas per rack.
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 2}") for i in range(4)]
+    parts = [
+        # all three replicas on rack r0 (brokers 0, 2) + r0 again: violation
+        PartitionSpec("t", 0, replicas=[0, 2, 1],
+                      leader_load=(1.0, 5.0, 5.0, 10.0)),
+        PartitionSpec("t", 1, replicas=[0, 2, 3],
+                      leader_load=(1.0, 5.0, 5.0, 10.0)),
+    ]
+    model, md = build(brokers, parts)
+    goal = RackAwareDistributionGoal()
+    res = run([goal], model, md)
+    assert res.goal_results[0].violation_after == 0.0
+    rb = np.asarray(res.final_model.replica_broker)
+    racks = np.asarray(res.final_model.broker_rack)
+    for p in range(2):
+        row = rb[p][rb[p] < res.final_model.broker_sentinel]
+        counts = np.bincount(racks[row], minlength=2)
+        assert counts.max() <= 2
+
+
+def test_kafka_assigner_mode():
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 2}") for i in range(4)]
+    rng = np.random.default_rng(3)
+    parts = [PartitionSpec("t", p,
+                           replicas=[int(b) for b in
+                                     rng.choice(4, 2, replace=False)],
+                           leader_load=(1.0, 5.0, 5.0,
+                                        float(10 + 90 * rng.random())))
+             for p in range(40)]
+    model, md = build(brokers, parts)
+    res = run(goals_by_name(KAFKA_ASSIGNER_GOALS), model, md)
+    for gr in res.goal_results:
+        assert gr.violation_after <= gr.violation_before
+    assert all(v == 0 for v in sanity_check(res.final_model).values())
+
+
+def test_full_default_chain_with_new_goals():
+    """The complete default chain (now 16 goals) runs end to end."""
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 3}") for i in range(6)]
+    rng = np.random.default_rng(5)
+    parts = [PartitionSpec(f"t{p % 4}", p,
+                           replicas=[int(b) for b in
+                                     rng.choice(4, 2, replace=False)],
+                           leader_load=(0.5, 5.0, 8.0,
+                                        float(20 + 80 * rng.random())))
+             for p in range(60)]
+    model, md = build(brokers, parts)
+    res = run(None, model, md)   # default chain
+    names = [g.name for g in res.goal_results]
+    assert "MinTopicLeadersPerBrokerGoal" in names
+    for gr in res.goal_results:
+        assert gr.violation_after <= gr.violation_before + 1e-6
+    assert all(v == 0 for v in sanity_check(res.final_model).values())
+
+
+def run_default(model, md, **opt):
+    return TpuGoalOptimizer().optimize(model, md, OptimizationOptions(**opt))
