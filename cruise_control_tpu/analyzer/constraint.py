@@ -44,6 +44,9 @@ class BalancingConstraint:
         0.0, 0.0, 0.0, 0.0)
     #: ref min.topic.leaders.per.broker (MinTopicLeadersPerBrokerGoal)
     min_topic_leaders_per_broker: int = 1
+    #: ref topics.with.min.leaders.per.broker — fnmatch pattern of topics
+    #: the leader minimum applies to ("" = none, the reference default)
+    topics_with_min_leaders_per_broker: str = ""
 
     def balance_threshold(self, resource: Resource) -> float:
         return self.resource_balance_threshold[int(resource)]

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.resources import Resource
 from ..model.flat import MOVE_INTER_BROKER, MOVE_LEADERSHIP, MOVE_SWAP
@@ -171,6 +172,20 @@ class GoalKernel:
         shared-broker pairs as conflicts (at most one candidate per
         source/destination broker per round) — correct but serializing.
         """
+        return None
+
+    def bind(self, metadata) -> "GoalKernel":
+        """Return the kernel configured against this optimization's
+        metadata (topic names, broker sets). Pattern-configured goals
+        (MinTopicLeadersPerBroker, BrokerSetAware) resolve their name-level
+        config into index-space masks here; everything else returns self.
+        """
+        return self
+
+    def bind_signature(self):
+        """Hashable token describing the bound configuration — part of the
+        compiled-chain cache key, so a topic-set change recompiles while
+        ordinary re-optimizations reuse the cached chain."""
         return None
 
     def __repr__(self) -> str:  # pragma: no cover
@@ -972,16 +987,38 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
 
     def __init__(self, constraint: BalancingConstraint, *,
                  interested_topics: jax.Array | None = None,
+                 topic_pattern: str | None = None,
                  min_count: int | None = None):
         self.constraint = constraint
         #: bool[T] — topics the minimum applies to
         self.interested_topics = interested_topics
+        #: fnmatch pattern resolved against metadata.topics at bind() time
+        #: (ref topics.with.min.leaders.per.broker)
+        self.topic_pattern = (topic_pattern if topic_pattern is not None
+                              else constraint.topics_with_min_leaders_per_broker)
         self.min_count = (min_count if min_count is not None
                           else constraint.min_topic_leaders_per_broker)
         # An inactive instance (no interested topics — the default-chain
         # case) must not force the engine to build/maintain [T, B1] state.
         self.uses_topic_counts = interested_topics is not None
         self.uses_topic_leader_counts = interested_topics is not None
+
+    def bind(self, metadata) -> "MinTopicLeadersPerBrokerGoal":
+        if self.interested_topics is not None or not self.topic_pattern:
+            return self
+        import fnmatch
+        mask = np.array([fnmatch.fnmatch(t, self.topic_pattern)
+                         for t in metadata.topics], bool)
+        if not mask.any():
+            return self
+        return MinTopicLeadersPerBrokerGoal(
+            self.constraint, interested_topics=jnp.asarray(mask),
+            topic_pattern=self.topic_pattern, min_count=self.min_count)
+
+    def bind_signature(self):
+        if self.interested_topics is None:
+            return None
+        return bytes(np.asarray(self.interested_topics).tobytes())
 
     def _deficit(self, state: SearchState, ctx: SearchContext) -> jax.Array:
         """i32[T, B1] — leaders still missing per (topic, broker) cell.
@@ -1103,6 +1140,23 @@ class BrokerSetAwareGoal(GoalKernel):
                  topic_set: jax.Array | None = None):
         self.constraint = constraint
         self.topic_set = topic_set     # i32[T] or None
+
+    def bind(self, metadata) -> "BrokerSetAwareGoal":
+        """Resolve topic -> broker-set assignments against this model's
+        broker sets (name-hash mapping policy, ref
+        TopicNameHashBrokerSetMappingPolicy); inactive when the model
+        carries no broker sets."""
+        if self.topic_set is not None or not metadata.broker_sets:
+            return self
+        from ..config.brokersets import topic_set_array
+        tset = topic_set_array(metadata.topics, metadata.broker_sets)
+        return BrokerSetAwareGoal(self.constraint,
+                                  topic_set=jnp.asarray(tset))
+
+    def bind_signature(self):
+        if self.topic_set is None:
+            return None
+        return bytes(np.asarray(self.topic_set).tobytes())
 
     def _mismatch(self, state, ctx) -> jax.Array:
         """bool[P, R] — replica sits outside its topic's broker set."""

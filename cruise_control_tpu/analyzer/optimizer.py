@@ -111,10 +111,11 @@ class TpuGoalOptimizer:
         self.config = config or SearchConfig()
         self._chains: dict[tuple, CompiledGoalChain] = {}
 
-    def _chain_for(self, cfg: SearchConfig) -> CompiledGoalChain:
-        key = (cfg,)
+    def _chain_for(self, cfg: SearchConfig, goals: list[GoalKernel]
+                   ) -> CompiledGoalChain:
+        key = (cfg, tuple(g.bind_signature() for g in goals))
         if key not in self._chains:
-            self._chains[key] = CompiledGoalChain(self.goals, cfg)
+            self._chains[key] = CompiledGoalChain(goals, cfg)
         return self._chains[key]
 
     def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
@@ -133,7 +134,11 @@ class TpuGoalOptimizer:
                 max_iters_per_goal=max(cfg.max_iters_per_goal // 4, 16)
             ).scaled_for(max(metadata.num_partitions // 4, 8),
                          metadata.num_brokers)
-        chain = self._chain_for(cfg)
+        # Resolve pattern-configured goals against this model's metadata
+        # (topic masks, broker sets); the chain cache key carries the
+        # binding so unchanged topology reuses compiled passes.
+        goals = [g.bind(metadata) for g in self.goals]
+        chain = self._chain_for(cfg, goals)
 
         excluded_parts = options.excluded_partition_mask(metadata, P)
         ctx = build_context(
@@ -146,9 +151,9 @@ class TpuGoalOptimizer:
                 options.broker_mask(metadata, B,
                                     options.excluded_brokers_for_leadership)))
 
-        needs_tlc = any(g.uses_topic_leader_counts for g in self.goals)
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals)
         needs_topics = needs_tlc or any(g.uses_topic_counts
-                                        for g in self.goals)
+                                        for g in goals)
         state = init_state(
             model,
             with_topic_counts=metadata.num_topics if needs_topics else None,
@@ -161,7 +166,7 @@ class TpuGoalOptimizer:
         # the reference records at GoalOptimizer.java:458-497).
         goal_results: list[GoalResult] = []
         boundary = np.asarray(chain.violations(state, ctx))
-        for i, (goal, gpass) in enumerate(zip(self.goals, chain.passes)):
+        for i, (goal, gpass) in enumerate(zip(goals, chain.passes)):
             g0 = time.monotonic()
             before_i = float(boundary[i])
             state, iters = gpass(state, ctx, jax.random.fold_in(key, i))
@@ -179,10 +184,10 @@ class TpuGoalOptimizer:
         # iterations). No reference equivalent — the reference's single
         # sequential walk simply tolerates the drift.
         for rnd in range(cfg.polish_passes):
-            if boundary.sum() <= cfg.epsilon * len(self.goals):
+            if boundary.sum() <= cfg.epsilon * len(goals):
                 break
-            for i, (goal, gpass) in enumerate(zip(self.goals, chain.passes)):
-                if boundary.sum() <= cfg.epsilon * len(self.goals):
+            for i, (goal, gpass) in enumerate(zip(goals, chain.passes)):
+                if boundary.sum() <= cfg.epsilon * len(goals):
                     break
                 g0 = time.monotonic()
                 state, iters = gpass(state, ctx,

@@ -533,6 +533,10 @@ def apply_group(state: SearchState, ctx: SearchContext, c: Candidates,
 
 
 def to_model(state: SearchState, template: FlatClusterModel) -> FlatClusterModel:
-    """Re-wrap the optimized assignment as a FlatClusterModel."""
+    """Re-wrap the optimized assignment as a FlatClusterModel. ``pos`` IS
+    the per-slot preferred-order position, so writing it back keeps
+    preferred-leader drift readable from (and re-optimizable on) the final
+    model."""
     return template.replace(replica_broker=state.rb,
-                            replica_offline=state.offline)
+                            replica_offline=state.offline,
+                            replica_pref_pos=state.pos)

@@ -246,9 +246,14 @@ class KafkaCruiseControl:
     # ----------------------------------------------------------- get ops
     def proposals(self, ignore_cache: bool = False,
                   progress: OperationProgress | None = None) -> OptimizerResult:
-        """ref ProposalsRunnable / getProposals KafkaCruiseControl.java:534."""
+        """ref ProposalsRunnable / getProposals KafkaCruiseControl.java:534.
+        A proposals read is a dry-run measurement either way: unfixable hard
+        goals are a finding served with the provision verdict, like the
+        cache path."""
         if ignore_cache:
-            return self._optimize(progress, None, OptimizationOptions())
+            return self._optimize(progress, None,
+                                  OptimizationOptions(
+                                      skip_hard_goal_check=True))
         return self.proposal_cache.get(self._now_ms())
 
     def load(self) -> dict:

@@ -1,0 +1,5 @@
+"""Python client (rebuild of ``cruise-control-client``): see :mod:`.cccli`."""
+
+from .cccli import CruiseControlClient
+
+__all__ = ["CruiseControlClient"]

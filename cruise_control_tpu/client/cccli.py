@@ -1,0 +1,198 @@
+"""``cccli`` — the command-line client (rebuild of
+``cruise-control-client/cruisecontrolclient/client/cccli.py:209`` and the
+per-endpoint classes in ``client/Endpoint.py:158-575``).
+
+One subcommand per endpoint, typed flags per the reference's CCParameter
+validation, long-poll handling honoring the ``User-Task-ID`` header (ref
+``client/Responder.py`` / ``ExecutionContext.py``): an async endpoint that
+returns 202 is re-polled with the same task id until the final response.
+
+``python -m cruise_control_tpu.client.cccli -a localhost:9090 rebalance --dryrun``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
+                 "kafka_cluster_state", "user_tasks", "review_board",
+                 "permissions", "bootstrap", "train"}
+
+
+class CruiseControlClient:
+    def __init__(self, address: str, *, auth: tuple[str, str] | None = None,
+                 poll_interval_s: float = 2.0, timeout_s: float = 600.0):
+        self.base = f"http://{address}/kafkacruisecontrol"
+        self.auth = auth
+        self.poll_interval_s = poll_interval_s
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, endpoint: str, params: dict,
+                 user_task_id: str | None = None):
+        query = urllib.parse.urlencode(
+            {k: v for k, v in params.items() if v is not None})
+        url = f"{self.base}/{endpoint}"
+        data = None
+        if method == "GET":
+            url += f"?{query}" if query else ""
+        else:
+            data = query.encode()
+        req = urllib.request.Request(url, data=data, method=method)
+        if user_task_id:
+            req.add_header("User-Task-ID", user_task_id)
+        if self.auth:
+            import base64
+            raw = base64.b64encode(f"{self.auth[0]}:{self.auth[1]}".encode())
+            req.add_header("Authorization", f"Basic {raw.decode()}")
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read()), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+    def call(self, endpoint: str, params: dict | None = None) -> dict:
+        """Issue the request; keep long-polling 202s with the returned
+        User-Task-ID until the operation completes (ref Responder.py)."""
+        method = "GET" if endpoint in GET_ENDPOINTS else "POST"
+        params = dict(params or {})
+        deadline = time.monotonic() + self.timeout_s
+        status, body, headers = self._request(method, endpoint, params)
+        task_id = headers.get("User-Task-ID")
+        while status == 202 and task_id and "reviewResult" not in body:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"{endpoint} still running; "
+                                   f"User-Task-ID={task_id}")
+            time.sleep(self.poll_interval_s)
+            status, body, headers = self._request(method, endpoint, params,
+                                                  user_task_id=task_id)
+        if status >= 400:
+            raise RuntimeError(body.get("errorMessage", f"HTTP {status}"))
+        return body
+
+
+def _add_common(p: argparse.ArgumentParser, *flags: str) -> None:
+    if "dryrun" in flags:
+        p.add_argument("--dryrun", action="store_true", default=None)
+        p.add_argument("--no-dryrun", dest="dryrun", action="store_false")
+    if "goals" in flags:
+        p.add_argument("--goals", help="comma-separated goal names")
+    if "brokers" in flags:
+        p.add_argument("--brokers", required=True,
+                       help="comma-separated broker ids")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="cccli",
+                                 description="cruise-control-tpu client")
+    ap.add_argument("-a", "--address", required=True, help="host:port")
+    ap.add_argument("--user", help="basic auth user")
+    ap.add_argument("--password", help="basic auth password")
+    ap.add_argument("--json", action="store_true", help="raw JSON output")
+    sub = ap.add_subparsers(dest="endpoint", required=True)
+
+    for name in ("state", "kafka_cluster_state", "user_tasks",
+                 "review_board", "permissions", "proposals", "load", "train"):
+        sub.add_parser(name)
+    p = sub.add_parser("partition_load")
+    p.add_argument("--resource", default="DISK")
+    p.add_argument("--entries", type=int, default=20)
+    p = sub.add_parser("rebalance")
+    _add_common(p, "dryrun", "goals")
+    p.add_argument("--ignore-proposal-cache", action="store_true")
+    p.add_argument("--excluded-topics")
+    for name in ("add_broker", "remove_broker", "demote_broker"):
+        p = sub.add_parser(name)
+        _add_common(p, "dryrun", "goals", "brokers")
+    p = sub.add_parser("fix_offline_replicas")
+    _add_common(p, "dryrun", "goals")
+    p = sub.add_parser("topic_configuration")
+    _add_common(p, "dryrun")
+    p.add_argument("--topic", required=True)
+    p.add_argument("--replication-factor", type=int, required=True)
+    p = sub.add_parser("rightsize")
+    p = sub.add_parser("stop_proposal_execution")
+    for name in ("pause_sampling", "resume_sampling"):
+        p = sub.add_parser(name)
+        p.add_argument("--reason", default="")
+    p = sub.add_parser("bootstrap")
+    p.add_argument("--start", type=int, required=True)
+    p.add_argument("--end", type=int, required=True)
+    p = sub.add_parser("review")
+    p.add_argument("--approve", help="comma-separated review ids")
+    p.add_argument("--discard", help="comma-separated review ids")
+    p.add_argument("--reason", default="")
+    p = sub.add_parser("admin")
+    p.add_argument("--concurrent-partition-movements-per-broker", type=int)
+    p.add_argument("--concurrent-leader-movements", type=int)
+    p.add_argument("--disable-self-healing-for")
+    p.add_argument("--enable-self-healing-for")
+    return ap
+
+
+def _params_from_args(args: argparse.Namespace) -> dict:
+    skip = {"address", "user", "password", "json", "endpoint"}
+    params = {}
+    for k, v in vars(args).items():
+        if k in skip or v is None:
+            continue
+        key = k.replace("-", "_")
+        if key == "brokers":
+            key = "brokerid"
+        if isinstance(v, bool):
+            v = "true" if v else "false"
+        params[key] = v
+    return params
+
+
+def _summarize(endpoint: str, body: dict) -> str:
+    if endpoint == "state":
+        lines = []
+        for section, payload in body.items():
+            if section == "version":
+                continue
+            lines.append(f"{section}: "
+                         f"{json.dumps(payload, default=str)[:160]}")
+        return "\n".join(lines)
+    if endpoint in ("rebalance", "add_broker", "remove_broker",
+                    "demote_broker", "proposals", "fix_offline_replicas",
+                    "topic_configuration"):
+        s = body.get("summary", {})
+        lines = [f"proposals: {s.get('numProposals')} "
+                 f"(replica moves {s.get('numReplicaMovements')}, "
+                 f"leader moves {s.get('numLeaderMovements')})"]
+        for g in body.get("goalSummary", []):
+            lines.append(f"  {g['goal']}: {g['status']} "
+                         f"({g['violationBefore']:.1f} -> "
+                         f"{g['violationAfter']:.1f})")
+        if "executionResult" in body:
+            lines.append(f"execution: {body['executionResult']}")
+        return "\n".join(lines)
+    if endpoint == "load":
+        lines = [f"{b['Broker']:>6} {b['BrokerState']:<6} "
+                 f"replicas={b['Replicas']:<6} leaders={b['Leaders']:<6} "
+                 f"disk={b['DiskMB']:.0f}MB nwIn={b['NwInRate']:.0f} "
+                 f"nwOut={b['NwOutRate']:.0f} cpu={b['CpuPct']:.1f}"
+                 for b in body.get("brokers", [])]
+        return "BROKER STATE  LOAD\n" + "\n".join(lines)
+    return json.dumps(body, indent=2, default=str)
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    client = CruiseControlClient(
+        args.address,
+        auth=(args.user, args.password) if args.user else None)
+    body = client.call(args.endpoint, _params_from_args(args))
+    print(json.dumps(body, indent=2, default=str) if args.json
+          else _summarize(args.endpoint, body))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,340 @@
+"""The config-constant registry: every tunable of the framework as a typed
+``ConfigDef`` entry, grouped by subsystem exactly like the reference's
+``config/constants/*.java`` (MonitorConfig, AnalyzerConfig, ExecutorConfig,
+AnomalyDetectorConfig, WebServerConfig, UserTaskManagerConfig). The
+composite :func:`cruise_control_config` definition parses the reference's
+own ``cruisecontrol.properties`` format; :class:`CruiseControlConfig`
+resolves typed values and builds the subsystem config dataclasses.
+"""
+
+from __future__ import annotations
+
+from ..analyzer.constraint import BalancingConstraint, SearchConfig
+from ..core.config import (AbstractConfig, ConfigDef, ConfigType, Importance,
+                           Range)
+from ..executor.concurrency import ConcurrencyConfig
+from ..executor.executor import ExecutorConfig
+from ..monitor.monitor import MonitorConfig
+
+
+def _monitor_defs(d: ConfigDef) -> None:
+    """ref config/constants/MonitorConfig.java."""
+    d.define("num.partition.metrics.windows", ConfigType.INT, 5,
+             validator=Range.at_least(1), importance=Importance.HIGH,
+             doc="Number of partition metric windows retained")
+    d.define("partition.metrics.window.ms", ConfigType.LONG, 3_600_000,
+             validator=Range.at_least(1), importance=Importance.HIGH,
+             doc="Partition metrics window width")
+    d.define("min.samples.per.partition.metrics.window", ConfigType.INT, 1,
+             validator=Range.at_least(1), importance=Importance.HIGH,
+             doc="Samples required before a partition window is valid")
+    d.define("num.broker.metrics.windows", ConfigType.INT, 20,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Number of broker metric windows retained")
+    d.define("broker.metrics.window.ms", ConfigType.LONG, 300_000,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Broker metrics window width")
+    d.define("min.samples.per.broker.metrics.window", ConfigType.INT, 1,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Samples required before a broker window is valid")
+    d.define("max.allowed.extrapolations.per.partition", ConfigType.INT, 5,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Extrapolation budget per partition")
+    d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000,
+             validator=Range.at_least(1), importance=Importance.HIGH,
+             doc="Sampling loop interval")
+    d.define("num.metric.fetchers", ConfigType.INT, 1,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Parallel metric fetcher shards")
+    d.define("metric.sampler.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.sampler.SyntheticWorkloadSampler",
+             importance=Importance.HIGH, doc="MetricSampler plugin")
+    d.define("sample.store.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.store.NoopSampleStore",
+             importance=Importance.MEDIUM, doc="SampleStore plugin")
+    d.define("sample.store.dir", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="Directory for the file-backed sample store")
+    d.define("broker.capacity.config.resolver.class", ConfigType.CLASS,
+             "cruise_control_tpu.config.capacity.FixedCapacityResolver",
+             importance=Importance.HIGH, doc="Capacity resolver plugin")
+    d.define("capacity.config.file", ConfigType.STRING, "",
+             importance=Importance.HIGH, doc="capacity.json path")
+    d.define("broker.set.config.file", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="brokerSets.json path")
+    d.define("admin.client.class", ConfigType.STRING, "",
+             importance=Importance.HIGH,
+             doc="ClusterAdminClient plugin (empty = demo simulated cluster)")
+    d.define("monitor.state.update.interval.ms", ConfigType.LONG, 30_000,
+             importance=Importance.LOW, doc="Sensor update interval")
+    d.define("follower.cpu.ratio", ConfigType.DOUBLE, 0.5,
+             validator=Range.between(0.0, 1.0), importance=Importance.LOW,
+             doc="Follower CPU as a fraction of leader CPU")
+
+
+def _analyzer_defs(d: ConfigDef) -> None:
+    """ref config/constants/AnalyzerConfig.java (balance thresholds :58-103,
+    topic replica gaps :112-131, capacity thresholds :141-169,
+    proposal.expiration.ms :214, max.replicas.per.broker :225)."""
+    for res in ("cpu", "network.inbound", "network.outbound", "disk"):
+        d.define(f"{res}.balance.threshold", ConfigType.DOUBLE, 1.10,
+                 validator=Range.at_least(1.0), importance=Importance.HIGH,
+                 doc=f"{res} balance margin around the average")
+    d.define("cpu.capacity.threshold", ConfigType.DOUBLE, 0.7,
+             validator=Range.between(0.0, 1.0), importance=Importance.HIGH,
+             doc="Usable fraction of CPU capacity")
+    for res in ("network.inbound", "network.outbound", "disk"):
+        d.define(f"{res}.capacity.threshold", ConfigType.DOUBLE, 0.8,
+                 validator=Range.between(0.0, 1.0),
+                 importance=Importance.HIGH,
+                 doc=f"Usable fraction of {res} capacity")
+    for res in ("cpu", "network.inbound", "network.outbound", "disk"):
+        d.define(f"{res}.low.utilization.threshold", ConfigType.DOUBLE, 0.0,
+                 validator=Range.between(0.0, 1.0), importance=Importance.LOW,
+                 doc="Below this, the cluster reads as over-provisioned")
+    d.define("replica.count.balance.threshold", ConfigType.DOUBLE, 1.10,
+             validator=Range.at_least(1.0), importance=Importance.HIGH,
+             doc="Replica count balance margin")
+    d.define("leader.replica.count.balance.threshold", ConfigType.DOUBLE,
+             1.10, validator=Range.at_least(1.0), importance=Importance.HIGH,
+             doc="Leader count balance margin")
+    d.define("topic.replica.count.balance.threshold", ConfigType.DOUBLE, 3.0,
+             validator=Range.at_least(1.0), importance=Importance.MEDIUM,
+             doc="Per-topic replica balance margin")
+    d.define("topic.replica.count.balance.min.gap", ConfigType.INT, 2,
+             importance=Importance.LOW, doc="Min per-topic count gap")
+    d.define("topic.replica.count.balance.max.gap", ConfigType.INT, 40,
+             importance=Importance.LOW, doc="Max per-topic count gap")
+    d.define("max.replicas.per.broker", ConfigType.LONG, 10_000,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="ReplicaCapacityGoal ceiling")
+    d.define("min.topic.leaders.per.broker", ConfigType.INT, 1,
+             importance=Importance.LOW,
+             doc="MinTopicLeadersPerBrokerGoal minimum")
+    d.define("topics.with.min.leaders.per.broker", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Topic pattern the leader minimum applies to")
+    d.define("overprovisioned.min.brokers", ConfigType.INT, 3,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Never recommend shrinking below this")
+    d.define("proposal.expiration.ms", ConfigType.LONG, 900_000,
+             validator=Range.at_least(0), importance=Importance.MEDIUM,
+             doc="Proposal cache refresh bound")
+    d.define("num.proposal.precompute.threads", ConfigType.INT, 1,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Background proposal precompute threads")
+    d.define("default.goals", ConfigType.LIST, "",
+             importance=Importance.HIGH, doc="Goal chain (empty = built-in)")
+    d.define("hard.goals", ConfigType.LIST, "", importance=Importance.MEDIUM,
+             doc="Hard goal subset")
+    d.define("self.healing.goals", ConfigType.LIST, "",
+             importance=Importance.MEDIUM, doc="Self-healing goal subset")
+    # Batched-search hyper-parameters (no reference equivalent — the TPU
+    # replacement for the greedy loop's implicit schedule).
+    d.define("search.num.replica.candidates", ConfigType.INT, 256,
+             validator=Range.at_least(8), importance=Importance.LOW,
+             doc="Candidate replicas short-listed per iteration")
+    d.define("search.num.dest.candidates", ConfigType.INT, 16,
+             validator=Range.at_least(2), importance=Importance.LOW,
+             doc="Destination brokers short-listed per iteration")
+    d.define("search.num.swap.candidates", ConfigType.INT, 128,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Swap pairs proposed per iteration")
+    d.define("search.max.iters.per.goal", ConfigType.INT, 256,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Iteration cap per goal pass")
+
+
+def _executor_defs(d: ConfigDef) -> None:
+    """ref config/constants/ExecutorConfig.java."""
+    d.define("num.concurrent.partition.movements.per.broker", ConfigType.INT,
+             5, validator=Range.at_least(1), importance=Importance.HIGH,
+             doc="Per-broker inter-broker movement cap")
+    d.define("num.concurrent.intra.broker.partition.movements",
+             ConfigType.INT, 2, validator=Range.at_least(1),
+             importance=Importance.MEDIUM, doc="Per-broker logdir-move cap")
+    d.define("num.concurrent.leader.movements", ConfigType.INT, 1000,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Cluster-wide leadership movement cap")
+    d.define("max.num.cluster.partition.movements", ConfigType.INT, 1250,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Cluster-wide in-flight movement cap")
+    d.define("execution.progress.check.interval.ms", ConfigType.LONG, 10_000,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Progress poll interval")
+    d.define("replica.movement.timeout.ms", ConfigType.LONG, 3_600_000,
+             importance=Importance.LOW, doc="Per-task stall bound")
+    d.define("leader.movement.timeout.ms", ConfigType.LONG, 180_000,
+             importance=Importance.LOW, doc="Leadership batch bound")
+    d.define("default.replication.throttle", ConfigType.LONG, -1,
+             importance=Importance.MEDIUM,
+             doc="Replication throttle bytes/s (-1 = none)")
+    d.define("concurrency.adjuster.enabled", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW, doc="AIMD concurrency adjuster")
+    d.define("default.replica.movement.strategies", ConfigType.LIST, "",
+             importance=Importance.MEDIUM, doc="Movement strategy chain")
+
+
+def _detector_defs(d: ConfigDef) -> None:
+    """ref config/constants/AnomalyDetectorConfig.java +
+    SelfHealingNotifier defaults (:69-70)."""
+    d.define("anomaly.detection.interval.ms", ConfigType.LONG, 300_000,
+             validator=Range.at_least(1), importance=Importance.HIGH,
+             doc="Default detector scheduling interval")
+    d.define("goal.violation.detection.interval.ms", ConfigType.LONG,
+             300_000, importance=Importance.MEDIUM,
+             doc="Goal-violation detector interval")
+    d.define("broker.failure.detection.interval.ms", ConfigType.LONG, 30_000,
+             importance=Importance.MEDIUM,
+             doc="Broker-failure detector interval")
+    d.define("broker.failure.alert.threshold.ms", ConfigType.LONG,
+             900_000, importance=Importance.HIGH,
+             doc="Alert this long after a broker failure")
+    d.define("broker.failure.self.healing.threshold.ms", ConfigType.LONG,
+             1_800_000, importance=Importance.HIGH,
+             doc="Auto-fix this long after a broker failure")
+    d.define("self.healing.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.HIGH, doc="Master self-healing switch")
+    for name in ("broker.failure", "goal.violation", "disk.failure",
+                 "topic.anomaly", "metric.anomaly", "maintenance.event"):
+        d.define(f"self.healing.{name}.enabled", ConfigType.BOOLEAN, False,
+                 importance=Importance.MEDIUM,
+                 doc=f"Self-healing for {name} anomalies")
+    d.define("anomaly.notifier.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.notifier.SelfHealingNotifier",
+             importance=Importance.MEDIUM, doc="AnomalyNotifier plugin")
+    d.define("provisioner.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.provisioner.BasicProvisioner",
+             importance=Importance.LOW, doc="Provisioner plugin")
+    d.define("failed.brokers.file.path", ConfigType.STRING,
+             "failed_brokers.json", importance=Importance.LOW,
+             doc="Broker failure time persistence")
+    d.define("topic.anomaly.target.replication.factor", ConfigType.INT, 2,
+             importance=Importance.LOW, doc="Target RF for topic anomalies")
+    d.define("slow.broker.removal.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Remove (vs demote) slow brokers")
+
+
+def _webserver_defs(d: ConfigDef) -> None:
+    """ref config/constants/WebServerConfig.java +
+    UserTaskManagerConfig.java."""
+    d.define("webserver.http.address", ConfigType.STRING, "127.0.0.1",
+             importance=Importance.HIGH, doc="Bind address")
+    d.define("webserver.http.port", ConfigType.INT, 9090,
+             validator=Range.between(0, 65535), importance=Importance.HIGH,
+             doc="Bind port")
+    d.define("webserver.security.enable", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM, doc="Require authentication")
+    d.define("webserver.auth.credentials.file", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="Basic-auth credentials file (name: password,ROLE)")
+    d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM, doc="Review-before-execute flow")
+    d.define("max.active.user.tasks", ConfigType.INT, 25,
+             validator=Range.at_least(1), importance=Importance.MEDIUM,
+             doc="Concurrent async user task cap")
+    d.define("completed.user.task.retention.time.ms", ConfigType.LONG,
+             86_400_000, importance=Importance.LOW,
+             doc="How long finished tasks stay pollable")
+
+
+def cruise_control_config_def() -> ConfigDef:
+    d = ConfigDef()
+    _monitor_defs(d)
+    _analyzer_defs(d)
+    _executor_defs(d)
+    _detector_defs(d)
+    _webserver_defs(d)
+    return d
+
+
+class CruiseControlConfig(AbstractConfig):
+    """Typed view over a cruisecontrol.properties-style map (ref
+    ``config/CruiseControlConfig.java``); unknown keys are tolerated like
+    the reference (plugins read them via originals)."""
+
+    def __init__(self, props):
+        super().__init__(cruise_control_config_def(), props,
+                         allow_unknown=True)
+
+    # ---------------------------------------------------- subsystem views
+    def monitor_config(self) -> MonitorConfig:
+        return MonitorConfig(
+            num_windows=self.get_int("num.partition.metrics.windows"),
+            window_ms=self.get_int("partition.metrics.window.ms"),
+            min_samples_per_window=self.get_int(
+                "min.samples.per.partition.metrics.window"),
+            num_broker_windows=self.get_int("num.broker.metrics.windows"),
+            broker_window_ms=self.get_int("broker.metrics.window.ms"),
+            min_samples_per_broker_window=self.get_int(
+                "min.samples.per.broker.metrics.window"),
+            max_allowed_extrapolations_per_partition=self.get_int(
+                "max.allowed.extrapolations.per.partition"),
+            follower_cpu_ratio=self.get_double("follower.cpu.ratio"))
+
+    def balancing_constraint(self) -> BalancingConstraint:
+        return BalancingConstraint(
+            resource_balance_threshold=(
+                self.get_double("cpu.balance.threshold"),
+                self.get_double("network.inbound.balance.threshold"),
+                self.get_double("network.outbound.balance.threshold"),
+                self.get_double("disk.balance.threshold")),
+            replica_balance_threshold=self.get_double(
+                "replica.count.balance.threshold"),
+            leader_replica_balance_threshold=self.get_double(
+                "leader.replica.count.balance.threshold"),
+            topic_replica_balance_threshold=self.get_double(
+                "topic.replica.count.balance.threshold"),
+            topic_replica_balance_min_gap=self.get_int(
+                "topic.replica.count.balance.min.gap"),
+            topic_replica_balance_max_gap=self.get_int(
+                "topic.replica.count.balance.max.gap"),
+            capacity_threshold=(
+                self.get_double("cpu.capacity.threshold"),
+                self.get_double("network.inbound.capacity.threshold"),
+                self.get_double("network.outbound.capacity.threshold"),
+                self.get_double("disk.capacity.threshold")),
+            low_utilization_threshold=(
+                self.get_double("cpu.low.utilization.threshold"),
+                self.get_double("network.inbound.low.utilization.threshold"),
+                self.get_double("network.outbound.low.utilization.threshold"),
+                self.get_double("disk.low.utilization.threshold")),
+            max_replicas_per_broker=self.get_int("max.replicas.per.broker"),
+            min_topic_leaders_per_broker=self.get_int(
+                "min.topic.leaders.per.broker"),
+            topics_with_min_leaders_per_broker=self.get_string(
+                "topics.with.min.leaders.per.broker"),
+            overprovisioned_min_brokers=self.get_int(
+                "overprovisioned.min.brokers"))
+
+    def search_config(self) -> SearchConfig:
+        return SearchConfig(
+            num_replica_candidates=self.get_int(
+                "search.num.replica.candidates"),
+            num_dest_candidates=self.get_int("search.num.dest.candidates"),
+            num_swap_candidates=self.get_int("search.num.swap.candidates"),
+            max_iters_per_goal=self.get_int("search.max.iters.per.goal"))
+
+    def executor_config(self) -> ExecutorConfig:
+        throttle = self.get_int("default.replication.throttle")
+        return ExecutorConfig(
+            progress_check_interval_ms=self.get_int(
+                "execution.progress.check.interval.ms"),
+            replica_movement_timeout_ms=self.get_int(
+                "replica.movement.timeout.ms"),
+            leadership_movement_timeout_ms=self.get_int(
+                "leader.movement.timeout.ms"),
+            default_replication_throttle_bytes=(None if throttle < 0
+                                                else throttle),
+            concurrency=ConcurrencyConfig(
+                num_concurrent_partition_movements_per_broker=self.get_int(
+                    "num.concurrent.partition.movements.per.broker"),
+                num_concurrent_intra_broker_partition_movements=self.get_int(
+                    "num.concurrent.intra.broker.partition.movements"),
+                num_concurrent_leader_movements=self.get_int(
+                    "num.concurrent.leader.movements"),
+                max_num_cluster_partition_movements=self.get_int(
+                    "max.num.cluster.partition.movements")),
+            concurrency_adjuster_enabled=self.get_boolean(
+                "concurrency.adjuster.enabled"))

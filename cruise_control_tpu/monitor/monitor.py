@@ -96,11 +96,14 @@ class LoadMonitor:
     def __init__(self, admin, config: MonitorConfig | None = None,
                  capacity_resolver: BrokerCapacityConfigResolver | None = None,
                  rack_by_broker: dict[int, str] | None = None,
+                 broker_set_resolver=None,
                  max_concurrent_model_builds: int = 2) -> None:
         self.admin = admin
         self.config = config or MonitorConfig()
         self.capacity_resolver = capacity_resolver or FixedCapacityResolver()
         self.rack_by_broker = rack_by_broker or {}
+        #: optional BrokerSetResolver feeding BrokerSetAwareGoal
+        self.broker_set_resolver = broker_set_resolver
         c = self.config
         self.partition_aggregator = MetricSampleAggregator(
             c.num_windows, c.window_ms, c.min_samples_per_window,
@@ -213,9 +216,11 @@ class LoadMonitor:
             rack = self.rack_by_broker.get(broker_id, f"rack-{broker_id}")
             cap = self.capacity_resolver.capacity_for_broker(
                 rack, f"host-{broker_id}", broker_id)
+            broker_set = (self.broker_set_resolver.broker_set_for(broker_id)
+                          if self.broker_set_resolver is not None else None)
             brokers.append(BrokerSpec(
                 broker_id=broker_id, rack=rack, capacity=cap.as_vector(),
-                alive=is_alive,
+                alive=is_alive, broker_set=broker_set,
                 broken_disk=bool(offline_dirs.get(broker_id))))
 
         pspecs: list[PartitionSpec] = []

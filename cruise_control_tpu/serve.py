@@ -1,0 +1,206 @@
+"""Server entrypoint: build the whole stack from a properties file and run
+(ref ``KafkaCruiseControlMain.java`` + ``KafkaCruiseControlApp``).
+
+``python -m cruise_control_tpu.serve --config cruisecontrol.properties``
+
+With no real Kafka in reach, the default admin backend is a demo
+:class:`SimulatedKafkaCluster` (size via ``--demo-brokers/partitions``);
+pointing at a real cluster means providing an object implementing
+:class:`~cruise_control_tpu.executor.admin.ClusterAdminClient` via
+``admin.client.class`` (plugin-loaded, reference-style).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import time
+
+from .analyzer import TpuGoalOptimizer, goals_by_name
+from .api import CruiseControlApp, KafkaCruiseControl
+from .api.security import BasicSecurityProvider, Role
+from .config.brokersets import FileBrokerSetResolver
+from .config.capacity import FileCapacityResolver, FixedCapacityResolver
+from .config.constants import CruiseControlConfig
+from .core.config import load_class, load_properties_file
+from .detector import (AnomalyDetectorManager, BrokerFailureDetector,
+                       DiskFailureDetector, GoalViolationDetector,
+                       KafkaAnomalyType, MetricAnomalyDetector,
+                       SelfHealingNotifier, SlowBrokerFinder,
+                       TopicAnomalyDetector)
+from .executor import Executor, SimulatedKafkaCluster
+from .monitor import (FileSampleStore, LoadMonitor, LoadMonitorTaskRunner,
+                      MetricFetcherManager, NoopSampleStore,
+                      SyntheticWorkloadSampler)
+
+
+def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
+    """Constructor wiring, ref KafkaCruiseControl.java:112-129."""
+    if admin is None:
+        admin = _make_admin(config)
+    cap_file = config.get_string("capacity.config.file")
+    resolver = (FileCapacityResolver(cap_file) if cap_file
+                else FixedCapacityResolver())
+    bset_file = config.get_string("broker.set.config.file")
+    broker_set_resolver = (FileBrokerSetResolver(bset_file) if bset_file
+                           else None)
+    monitor = LoadMonitor(admin, config.monitor_config(),
+                          capacity_resolver=resolver,
+                          broker_set_resolver=broker_set_resolver)
+    store_dir = config.get_string("sample.store.dir")
+    store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
+    sampler = SyntheticWorkloadSampler(admin)
+    fetcher = MetricFetcherManager(sampler,
+                                   config.get_int("num.metric.fetchers"),
+                                   store=store)
+    runner = LoadMonitorTaskRunner(
+        monitor, fetcher,
+        sampling_interval_ms=config.get_int("metric.sampling.interval.ms"))
+    constraint = config.balancing_constraint()
+    goal_names = config.get_list("default.goals")
+    optimizer = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names, constraint) if goal_names else None,
+        constraint=constraint, config=config.search_config())
+    executor = Executor(admin, config.executor_config())
+    facade = KafkaCruiseControl(admin, monitor, task_runner=runner,
+                                optimizer=optimizer, executor=executor)
+
+    healing_on = config.get_boolean("self.healing.enabled")
+
+    def healing_for(t: KafkaAnomalyType) -> bool:
+        # An explicitly-set per-type key overrides the master switch (ref
+        # SelfHealingNotifier per-type config resolution); otherwise the
+        # master value applies.
+        key = f"self.healing.{t.name.lower().replace('_', '.')}.enabled"
+        if key in config.originals():
+            return config.get_boolean(key)
+        return healing_on
+
+    notifier = SelfHealingNotifier(
+        alert_threshold_ms=config.get_int("broker.failure.alert.threshold.ms"),
+        self_healing_threshold_ms=config.get_int(
+            "broker.failure.self.healing.threshold.ms"),
+        enabled={t: healing_for(t) for t in KafkaAnomalyType})
+    detector = AnomalyDetectorManager(facade, notifier)
+    interval = config.get_int("anomaly.detection.interval.ms")
+    detector.register(
+        BrokerFailureDetector(
+            admin, persist_path=config.get_string("failed.brokers.file.path")),
+        config.get_int("broker.failure.detection.interval.ms"))
+    detector.register(DiskFailureDetector(admin), interval)
+    detector.register(GoalViolationDetector(monitor, optimizer),
+                      config.get_int("goal.violation.detection.interval.ms"))
+    detector.register(MetricAnomalyDetector(monitor), interval)
+    detector.register(SlowBrokerFinder(
+        monitor, remove_slow_brokers=config.get_boolean(
+            "slow.broker.removal.enabled")), interval)
+    detector.register(TopicAnomalyDetector(
+        admin, target_rf=config.get_int(
+            "topic.anomaly.target.replication.factor")), interval)
+    facade.detector = detector
+
+    security = None
+    if config.get_boolean("webserver.security.enable"):
+        security = BasicSecurityProvider(_load_credentials(
+            config.get_string("webserver.auth.credentials.file")))
+    return CruiseControlApp(
+        facade,
+        host=config.get_string("webserver.http.address"),
+        port=config.get_int("webserver.http.port"),
+        security=security,
+        two_step_verification=config.get_boolean(
+            "two.step.verification.enabled"))
+
+
+def _load_credentials(path: str) -> dict[str, tuple[str, Role]]:
+    """Jetty-style auth file: ``name: password,ROLE`` per line (ref
+    BasicSecurityProvider's credentials file)."""
+    users: dict[str, tuple[str, Role]] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            name, _, rest = line.partition(":")
+            password, _, role = rest.strip().partition(",")
+            users[name.strip()] = (password.strip(),
+                                   Role[role.strip().upper() or "VIEWER"])
+    return users
+
+
+def _make_admin(config: CruiseControlConfig,
+                demo_brokers: int = 64, demo_partitions: int = 2048):
+    """Admin backend: a plugin implementing ClusterAdminClient when
+    ``admin.client.class`` is set, else the demo simulated cluster."""
+    cls_name = config.get_string("admin.client.class")
+    if cls_name:
+        cls = load_class(cls_name)
+        try:
+            return cls(config)
+        except TypeError:
+            return cls()
+    return _demo_cluster(demo_brokers, demo_partitions)
+
+
+def _demo_cluster(num_brokers: int, num_partitions: int) -> SimulatedKafkaCluster:
+    sim = SimulatedKafkaCluster(now_ms=int(time.time() * 1000))
+    for b in range(num_brokers):
+        sim.add_broker(b, logdirs=("logdir0", "logdir1"))
+    for p in range(num_partitions):
+        sim.add_partition(f"topic-{p % max(num_partitions // 32, 1)}", p,
+                          [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=50.0 + (p % 100))
+    return sim
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="cruise-control-tpu server")
+    ap.add_argument("--config", help="cruisecontrol.properties path")
+    ap.add_argument("--port", type=int, help="override webserver.http.port")
+    ap.add_argument("--demo-brokers", type=int, default=64)
+    ap.add_argument("--demo-partitions", type=int, default=2048)
+    args = ap.parse_args(argv)
+    # Fall back to CPU when the default accelerator backend is unreachable
+    # (same probe bench.py uses) — a control plane must come up regardless.
+    from .utils.platform import ensure_live_backend
+    platform = ensure_live_backend()
+    print(f"jax platform: {platform}", flush=True)
+    props = load_properties_file(args.config) if args.config else {}
+    if args.port is not None:
+        props["webserver.http.port"] = str(args.port)
+    config = CruiseControlConfig(props)
+    admin = _make_admin(config, args.demo_brokers, args.demo_partitions)
+    app = build_app(config, admin)
+    app.facade.start_up(
+        precompute_interval_s=config.get_int("proposal.expiration.ms") / 1000)
+    app.facade.detector.start_detection()
+    app.start()
+    print(f"cruise-control-tpu listening on "
+          f"http://{config.get_string('webserver.http.address')}:{app.port}"
+          f"/kafkacruisecontrol/state", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    signal.signal(signal.SIGINT, lambda *a: stop.append(1))
+    runner = app.facade.task_runner
+    try:
+        # The serving loop drives wall-clock-paced work: the demo cluster's
+        # virtual time follows real time (so executions progress), and the
+        # sampling loop fires at its configured interval (ref the reference's
+        # scheduled LoadMonitorTaskRunner).
+        while not stop:
+            time.sleep(0.5)
+            now = int(time.time() * 1000)
+            if isinstance(admin, SimulatedKafkaCluster):
+                admin.advance_to(now)
+            try:
+                runner.maybe_run_sampling(now)
+            except Exception:
+                pass   # transient sampler failure: retry next tick
+    finally:
+        app.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

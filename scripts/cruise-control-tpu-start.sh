@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# ref kafka-cruise-control-start.sh: boot the server with a properties file.
+# Usage: cruise-control-tpu-start.sh [config/cruisecontrol.properties] [port]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+CONFIG="${1:-}"
+PORT="${2:-}"
+ARGS=()
+[ -n "$CONFIG" ] && ARGS+=(--config "$CONFIG")
+[ -n "$PORT" ] && ARGS+=(--port "$PORT")
+mkdir -p logs
+nohup python -m cruise_control_tpu.serve "${ARGS[@]}" \
+  > logs/cruise-control-tpu.out 2>&1 &
+echo $! > logs/cruise-control-tpu.pid
+echo "started pid $(cat logs/cruise-control-tpu.pid) (logs/cruise-control-tpu.out)"

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# ref kafka-cruise-control-stop.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if [ -f logs/cruise-control-tpu.pid ]; then
+  kill "$(cat logs/cruise-control-tpu.pid)" 2>/dev/null || true
+  rm -f logs/cruise-control-tpu.pid
+  echo "stopped"
+else
+  echo "no pid file" >&2
+fi

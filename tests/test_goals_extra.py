@@ -65,6 +65,12 @@ def test_min_topic_leaders_per_broker():
     # inactive without interested topics
     res2 = run([MinTopicLeadersPerBrokerGoal(cst)], model, md)
     assert res2.goal_results[0].violation_before == 0.0
+    # pattern-configured activation path (bind() against metadata): the
+    # config-file route an operator actually uses
+    cst3 = BalancingConstraint(topics_with_min_leaders_per_broker="hot*")
+    res3 = run([MinTopicLeadersPerBrokerGoal(cst3)], model, md)
+    assert res3.goal_results[0].violation_before == 2.0
+    assert res3.goal_results[0].violation_after == 0.0
 
 
 def test_broker_set_aware_goal():
@@ -146,7 +152,3 @@ def test_full_default_chain_with_new_goals():
     for gr in res.goal_results:
         assert gr.violation_after <= gr.violation_before + 1e-6
     assert all(v == 0 for v in sanity_check(res.final_model).values())
-
-
-def run_default(model, md, **opt):
-    return TpuGoalOptimizer().optimize(model, md, OptimizationOptions(**opt))

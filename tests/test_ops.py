@@ -1,0 +1,114 @@
+"""Ops-surface tests: the config-constant registry parses the reference's
+properties file, serve.build_app wires the full stack from config, and the
+cccli client drives it over real HTTP (rebuild of the config + client test
+surface)."""
+
+import pytest
+
+from cruise_control_tpu.client.cccli import (CruiseControlClient,
+                                             build_parser, main as cccli_main)
+from cruise_control_tpu.config.constants import CruiseControlConfig
+from cruise_control_tpu.core.config import (ConfigException,
+                                            load_properties_file)
+
+
+def test_config_registry_defaults_and_overrides():
+    cfg = CruiseControlConfig({})
+    assert cfg.get_int("num.partition.metrics.windows") == 5
+    assert cfg.get_double("cpu.capacity.threshold") == 0.7
+    mc = cfg.monitor_config()
+    assert mc.window_ms == 3_600_000
+    cst = cfg.balancing_constraint()
+    assert cst.replica_balance_threshold == 1.10
+    ec = cfg.executor_config()
+    assert ec.concurrency.num_concurrent_partition_movements_per_broker == 5
+    assert ec.default_replication_throttle_bytes is None
+    cfg2 = CruiseControlConfig({"disk.balance.threshold": "1.25",
+                                "default.replication.throttle": "1000000",
+                                "num.concurrent.leader.movements": "50"})
+    assert cfg2.balancing_constraint().balance_threshold.__self__ \
+        .resource_balance_threshold[3] == 1.25
+    assert cfg2.executor_config().default_replication_throttle_bytes == 1000000
+
+
+def test_config_registry_validation():
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"cpu.capacity.threshold": "1.5"})   # > 1.0
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"num.partition.metrics.windows": "zero"})
+
+
+def test_reference_properties_file_parses():
+    props = load_properties_file(
+        "/root/reference/config/cruisecontrol.properties")
+    cfg = CruiseControlConfig(props)   # unknown keys tolerated
+    # values from the reference's own file flow through
+    assert cfg.get_int("num.partition.metrics.windows") == 5
+    assert cfg.get_double("cpu.balance.threshold") >= 1.0
+
+
+@pytest.fixture(scope="module")
+def served():
+    from cruise_control_tpu.serve import _demo_cluster, build_app
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": "1000",
+        "num.partition.metrics.windows": "4",
+        "broker.metrics.window.ms": "1000",
+        "metric.sampling.interval.ms": "1000",
+        "webserver.http.port": "0",
+        "default.goals": ("RackAwareGoal,ReplicaDistributionGoal,"
+                          "DiskUsageDistributionGoal"),
+        "execution.progress.check.interval.ms": "50",
+    })
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    admin = SimulatedKafkaCluster(now_ms=0)   # sim time well behind wall time
+    for b in range(6):
+        admin.add_broker(b, logdirs=("logdir0", "logdir1"))
+    for p in range(48):
+        admin.add_partition(f"topic-{p % 4}", p, [p % 6, (p + 1) % 6],
+                            size_mb=50.0 + p)
+    app = build_app(cfg, admin)
+    # warm the monitor deterministically (no background threads in tests)
+    runner = app.facade.task_runner
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        admin.advance_to((w + 1) * 1000)
+        assert runner.maybe_run_sampling(admin.now_ms)
+    app.start()
+    yield app
+    app.stop()
+
+
+def test_cccli_against_served_stack(served, capsys):
+    addr = f"127.0.0.1:{served.port}"
+    client = CruiseControlClient(addr, poll_interval_s=0.2)
+    state = client.call("state")
+    assert state["MonitorState"]["numValidWindows"] >= 3
+    load = client.call("load")
+    assert len(load["brokers"]) == 6
+    res = client.call("rebalance", {"dryrun": "true",
+                                    "get_response_timeout_s": "0.05"})
+    assert "summary" in res   # long-poll converged on the User-Task-ID
+    # the argparse CLI end-to-end (human output)
+    rc = cccli_main(["-a", addr, "state"])
+    assert rc == 0
+    assert "MonitorState" in capsys.readouterr().out
+    rc = cccli_main(["-a", addr, "load"])
+    assert rc == 0
+    assert "replicas=" in capsys.readouterr().out
+    rc = cccli_main(["-a", addr, "partition_load", "--entries", "3"])
+    assert rc == 0
+
+
+def test_cccli_parser_covers_endpoint_catalog():
+    parser = build_parser()
+    subs = parser._subparsers._group_actions[0].choices
+    for endpoint in ("state", "load", "partition_load", "proposals",
+                     "kafka_cluster_state", "user_tasks", "review_board",
+                     "permissions", "rebalance", "add_broker",
+                     "remove_broker", "demote_broker",
+                     "fix_offline_replicas", "topic_configuration",
+                     "rightsize", "stop_proposal_execution",
+                     "pause_sampling", "resume_sampling", "bootstrap",
+                     "train", "review", "admin"):
+        assert endpoint in subs, endpoint
