@@ -14,6 +14,7 @@ Diagnostics go to stderr.
 
 from __future__ import annotations
 
+import argparse
 import json
 import sys
 import time
@@ -26,6 +27,17 @@ RF = 2
 GOALS = ["ReplicaDistributionGoal", "DiskUsageDistributionGoal",
          "NetworkInboundUsageDistributionGoal",
          "NetworkOutboundUsageDistributionGoal"]
+
+#: BASELINE.md scenario table: #3 = 1K x 200K full default chain,
+#: #4 = 10K x 1M (the <30 s north-star target). Greedy at these sizes runs
+#: for hours, so the scale scenarios report vs_baseline against the 30 s
+#: target instead of a greedy run.
+SCALE_SCENARIOS = {
+    3: dict(brokers=1000, partitions=200_000, rf=2, goals=None,
+            metric="rebalance_proposal_wall_clock_1kx200k", target_s=30.0),
+    4: dict(brokers=10_000, partitions=1_000_000, rf=2, goals=GOALS,
+            metric="rebalance_proposal_wall_clock_10kx1m", target_s=30.0),
+}
 
 
 def log(*args):
@@ -123,13 +135,142 @@ def residual(util, counts, nb, threshold=1.10):
     return float(tot)
 
 
+def build_flat_direct(num_brokers: int, num_partitions: int, rf: int,
+                      seed: int = 42):
+    """Array-native model construction for the scale scenarios — no
+    per-partition Python objects (1M PartitionSpecs would dominate the
+    run). Skewed like build_spec: half the partitions crowd 20% of brokers."""
+    import jax.numpy as jnp
+    from cruise_control_tpu.model.flat import FlatClusterModel
+    from cruise_control_tpu.model.spec import ClusterMetadata, _round_up
+    rng = np.random.default_rng(seed)
+    P, B = num_partitions, num_brokers
+    Ppad, Bpad = _round_up(P, 128), _round_up(B, 8)
+    hot = B // 5
+    base = rng.integers(0, hot, size=P)
+    cold = rng.integers(0, B, size=P)
+    first = np.where(np.arange(P) % 2 == 0, base, cold).astype(np.int64)
+    # Offsets bounded so cumulative sums stay < B: every partial sum is
+    # distinct and nonzero mod B, i.e. no duplicate brokers at any rf.
+    step_cap = max((B - 1) // max(rf - 1, 1), 2)
+    offsets = rng.integers(1, step_cap, size=(P, rf - 1)).cumsum(axis=1)
+    rb = np.full((Ppad, rf), Bpad, np.int32)
+    rb[:P, 0] = first
+    rb[:P, 1:] = (first[:, None] + offsets) % B
+    lead = np.zeros((Ppad, 4), np.float32)
+    lead[:P] = np.column_stack([
+        0.02 + 0.02 * rng.random(P), 5 + 10 * rng.random(P),
+        8 + 15 * rng.random(P), 50 + 100 * rng.random(P)]).astype(np.float32)
+    foll = lead.copy()
+    foll[:, 0] *= 0.5
+    foll[:, 2] = 0.0
+    num_topics = max(P // 500, 1)
+    ptopic = np.full(Ppad, -1, np.int32)
+    ptopic[:P] = np.arange(P) % num_topics
+    model = FlatClusterModel(
+        replica_broker=jnp.asarray(rb),
+        leader_load=jnp.asarray(lead), follower_load=jnp.asarray(foll),
+        partition_topic=jnp.asarray(ptopic),
+        partition_valid=jnp.asarray(np.arange(Ppad) < P),
+        replica_offline=jnp.zeros((Ppad, rf), bool),
+        replica_pref_pos=jnp.asarray(
+            np.tile(np.arange(rf, dtype=np.int32), (Ppad, 1))),
+        broker_capacity=jnp.asarray(np.tile(
+            np.array([100.0, 1e6, 1e6, 1e8], np.float32), (Bpad, 1))),
+        broker_rack=jnp.asarray((np.arange(Bpad) % max(B // 10, 1)
+                                 ).astype(np.int32)),
+        broker_host=jnp.asarray(np.arange(Bpad, dtype=np.int32)),
+        broker_set=jnp.full((Bpad,), -1, jnp.int32),
+        broker_alive=jnp.asarray(np.arange(Bpad) < B),
+        broker_new=jnp.zeros((Bpad,), bool),
+        broker_demoted=jnp.zeros((Bpad,), bool),
+        broker_broken_disk=jnp.zeros((Bpad,), bool),
+        broker_valid=jnp.asarray(np.arange(Bpad) < B))
+    topics = [f"t{i}" for i in range(num_topics)]
+    keys = [(topics[i % num_topics], i) for i in range(P)]
+    metadata = ClusterMetadata(
+        broker_ids=list(range(B)),
+        broker_index={i: i for i in range(B)},
+        topics=topics, topic_index={t: i for i, t in enumerate(topics)},
+        partition_keys=keys,
+        partition_index={k: i for i, k in enumerate(keys)},
+        racks=[f"r{i}" for i in range(max(B // 10, 1))],
+        hosts=[f"h{i}" for i in range(B)], broker_sets=[])
+    return model, metadata
+
+
+def run_scale_scenario(n: int):
+    """Scenario #3/#4: wall-clock of a full proposal computation at scale,
+    plus the dense-ingest throughput feeding it."""
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.core.aggregator import MetricSampleAggregator
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    cfgd = SCALE_SCENARIOS[n]
+    t0 = time.monotonic()
+    model, md = build_flat_direct(cfgd["brokers"], cfgd["partitions"],
+                                  cfgd["rf"])
+    log(f"scenario {n}: build {time.monotonic() - t0:.1f}s "
+        f"({cfgd['brokers']} brokers, {cfgd['partitions']} partitions)")
+
+    # Ingest throughput: one full round of per-partition samples through the
+    # dense aggregator path (the monitor-side cost of a sampling interval).
+    mdef = partition_metric_def()
+    agg = MetricSampleAggregator(4, 60_000, 1, mdef)
+    P = cfgd["partitions"]
+    entities = md.partition_keys
+    values = np.abs(np.random.default_rng(0).normal(
+        10.0, 3.0, size=(P, mdef.size())))
+    t0 = time.monotonic()
+    agg.add_samples_dense(entities, np.full(P, 30_000, np.int64), values)
+    ingest_s = time.monotonic() - t0
+    log(f"  ingest: {P} samples x {mdef.size()} metrics in {ingest_s:.2f}s "
+        f"({P / max(ingest_s, 1e-9) / 1e6:.2f}M samples/s)")
+
+    goals = goals_by_name(cfgd["goals"]) if cfgd["goals"] else None
+    opt = TpuGoalOptimizer(
+        goals=goals,
+        config=SearchConfig(num_replica_candidates=1024,
+                            num_dest_candidates=16, apply_per_iter=1024,
+                            max_iters_per_goal=512))
+    t0 = time.monotonic()
+    res_cold = opt.optimize(model, md, OptimizationOptions(
+        seed=0, skip_hard_goal_check=True))
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    res = opt.optimize(model, md, OptimizationOptions(
+        seed=1, skip_hard_goal_check=True))
+    warm = time.monotonic() - t0
+    log(f"  search: cold {cold:.1f}s warm {warm:.1f}s "
+        f"moves={res.num_moves} proposals={len(res.proposals)}")
+    for g in res.goal_results:
+        log(f"    {g.name:42s} {g.violation_before:14.1f} -> "
+            f"{g.violation_after:12.1f} iters={g.iterations} "
+            f"({g.duration_s:.2f}s)")
+    print(json.dumps({
+        "metric": cfgd["metric"], "value": round(warm, 3), "unit": "s",
+        "vs_baseline": round(cfgd["target_s"] / warm, 3) if warm > 0
+        else None,
+    }))
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", type=int, default=2, choices=(2, 3, 4),
+                    help="BASELINE.md scenario (2 = 100x20K vs greedy, "
+                         "3 = 1Kx200K, 4 = 10Kx1M)")
+    args = ap.parse_args()
     # Probe the default backend in a subprocess first: when the TPU tunnel is
     # down, jax.devices() would otherwise hang/crash the whole bench. Falls
     # back to CPU and still emits the JSON line (platform is logged).
     from cruise_control_tpu.utils.platform import ensure_live_backend
     platform = ensure_live_backend()
     import jax
+    if args.scenario != 2:
+        log(f"platform: {platform} -> {jax.devices()[0].platform}")
+        run_scale_scenario(args.scenario)
+        return
     from cruise_control_tpu.analyzer import (OptimizationOptions, SearchConfig,
                                              TpuGoalOptimizer, goals_by_name)
     from cruise_control_tpu.model.flat import broker_utilization, broker_replica_counts

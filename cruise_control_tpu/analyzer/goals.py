@@ -65,6 +65,23 @@ def _norm01(x: jax.Array, mask: jax.Array | None = None) -> jax.Array:
     return jnp.where(finite, (x - xmin) / span * 0.99, 0.0)
 
 
+def _legal_dest_argmax(state: SearchState, ctx: SearchContext,
+                       p: jax.Array, score: jax.Array):
+    """(dst[K], ok[K]) — per-candidate best destination from a [K, B1] score,
+    masking barred destinations and brokers already hosting the partition
+    (the shared idiom behind flow-fallback re-routing and topic-aware
+    destination picking)."""
+    K, B1 = score.shape
+    row = state.rb[p]                                            # [K, R]
+    host_mask = jnp.zeros((K, B1), bool).at[
+        jnp.arange(K)[:, None], row].set(True, mode="drop")
+    masked = jnp.where(host_mask | ~ctx.dest_allowed[None, :], -jnp.inf,
+                       score)
+    dst = jnp.argmax(masked, axis=1).astype(jnp.int32)
+    ok = jnp.isfinite(jnp.max(masked, axis=1))
+    return dst, ok
+
+
 def _top_replica_dest_grid(state: SearchState, ctx: SearchContext, key,
                            cfg: SearchConfig, replica_priority: jax.Array,
                            dest_priority: jax.Array) -> Candidates:
@@ -498,16 +515,11 @@ class IntervalGoal(GoalKernel):
         # The flow matcher is partition-blind: on small clusters it often
         # lands on a broker already hosting the partition, and a mandatory
         # drain can stall on that collision forever. Re-route such
-        # candidates to their best *legal* destination (masked argmax).
-        row = state.rb[p]                                            # [K, R]
-        host_mask = jnp.zeros((K, B1), bool).at[
-            jnp.arange(K)[:, None], row].set(True, mode="drop")
-        bad = host_mask[jnp.arange(K), dst]
-        alt_score = jnp.where(host_mask | ~ctx.dest_allowed[None, :],
-                              -jnp.inf, dprio[None, :])
-        alt = jnp.argmax(alt_score, axis=1).astype(dst.dtype)
-        alt_ok = jnp.isfinite(jnp.max(alt_score, axis=1))
-        dst = jnp.where(bad & alt_ok, alt, dst)
+        # candidates to their best *legal* destination.
+        hosts_dst = (state.rb[p] == dst[:, None]).any(axis=1)
+        alt, alt_ok = _legal_dest_argmax(
+            state, ctx, p, jnp.broadcast_to(dprio[None, :], (K, B1)))
+        dst = jnp.where(hosts_dst & alt_ok, alt, dst)
         valid = sel & (covered | must) & ctx.dest_allowed[dst]
         return make_move_candidates(state, ctx, p, r, dst.astype(jnp.int32),
                                     valid)
@@ -867,13 +879,31 @@ class TopicReplicaDistributionGoal(GoalKernel):
         src_excess = excess[t_of_p[:, None], state.rb]           # [P, R]
         prio = jnp.where(src_excess > 0.0,
                          _TIER_EXCESS + _norm01(src_excess), _NEG)
+        prio = jnp.where(ctx.movable, prio, _NEG)
+        prio = jnp.where(state.offline, _TIER_OFFLINE, prio)
         deficit = jnp.where(ctx.broker_alive[None, :],
                             jnp.maximum(lower[:, None] - tc, 0.0), 0.0)
-        # Destination shortlist is topic-agnostic ([B1]); per-topic fit is
-        # resolved by delta scoring over the K x D grid.
-        dest_prio = (2.0 * _norm01(deficit.sum(axis=0))
-                     + _norm01(-state.replica_count.astype(jnp.float32)))
-        return _top_replica_dest_grid(state, ctx, key, cfg, prio, dest_prio)
+
+        # Per-candidate TOPIC-AWARE destination: each short-listed replica
+        # scores every broker by its own topic's deficit (+ general
+        # headroom), masked against brokers already hosting the partition —
+        # a topic-agnostic shortlist almost never surfaces the right
+        # destination once hundreds of topics each need a specific broker.
+        P, R = state.rb.shape
+        B1 = tc.shape[1]
+        K = min(cfg.num_replica_candidates, P * R)
+        krep, kdst = jax.random.split(key)
+        prio = prio + jnp.where(jnp.isfinite(prio),
+                                _noise(krep, prio.shape, cfg.noise_scale), 0.0)
+        vals, idx = jax.lax.top_k(prio.reshape(-1), K)
+        p, r = idx // R, idx % R
+        sel = jnp.isfinite(vals)
+        count_headroom = _norm01(-state.replica_count.astype(jnp.float32))
+        score = (2.0 * _norm01(deficit[t_of_p[p]])            # [K, B1]
+                 + count_headroom[None, :]
+                 + _noise(kdst, (K, B1), cfg.noise_scale))
+        dst, ok = _legal_dest_argmax(state, ctx, p, score)
+        return make_move_candidates(state, ctx, p, r, dst, sel & ok)
 
     def _cell_deltas(self, ctx, c):
         """Per-candidate topic-count deltas on the four (topic, broker)

@@ -1,0 +1,265 @@
+"""Intra-broker (disk) optimization: the JBOD dimension.
+
+Rebuild of the reference's disk-level machinery — ``model/Disk.java``,
+``IntraBrokerDiskCapacityGoal.java`` (hard: per-disk utilization under the
+capacity threshold) and ``IntraBrokerDiskUsageDistributionGoal.java``
+(balance utilization across the disks of each broker) — as a TPU-first
+batched kernel.
+
+The structure is friendlier than inter-broker search: logdir moves never
+leave their broker, so every broker's rebalance is independent and the
+whole cluster optimizes as one vectorized loop — per iteration, every
+broker moves its best replica from its most- to least-loaded disk
+(segment-argmax over the flattened replica axis), all brokers at once.
+``REMOVE_DISKS`` is the same kernel with the doomed disks' capacity zeroed
+so everything on them drains to the surviving disks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from ..core.resources import Resource
+from ..executor.tasks import IntraBrokerReplicaMove
+
+
+@struct.dataclass
+class DiskState:
+    """Disk-level arrays paired with a FlatClusterModel (same P/R/B padding;
+    D = padded max logdirs per broker)."""
+
+    replica_disk: jax.Array    # i32[P, R] — disk slot on the hosting broker (-1 none)
+    replica_size: jax.Array    # f32[P, R] — DISK load of the replica
+    replica_broker: jax.Array  # i32[P, R]
+    disk_capacity: jax.Array   # f32[B, D] (0 = absent or draining)
+    disk_valid: jax.Array      # bool[B, D]
+
+    @property
+    def disk_util(self) -> jax.Array:
+        """f32[B, D] — one scatter-add over all replicas."""
+        B, D = self.disk_capacity.shape
+        idx = self.replica_broker * D + self.replica_disk
+        ok = (self.replica_disk >= 0)
+        idx = jnp.where(ok, idx, B * D)
+        util = jnp.zeros((B * D + 1,), jnp.float32).at[idx.reshape(-1)].add(
+            jnp.where(ok, self.replica_size, 0.0).reshape(-1))
+        return util[:B * D].reshape(B, D)
+
+
+@dataclass
+class IntraBrokerResult:
+    moves: list[IntraBrokerReplicaMove]
+    capacity_violation_before: float
+    capacity_violation_after: float
+    balance_violation_before: float
+    balance_violation_after: float
+    iterations: int
+
+
+def build_disk_state(model, metadata, admin, capacity_resolver
+                     ) -> tuple[DiskState, list[list[str]]]:
+    """Assemble disk arrays from live logdir metadata + per-logdir capacity
+    (ref LoadMonitor populating Disk objects from describeLogDirs +
+    BrokerCapacityInfo.diskCapacityByLogDir)."""
+    logdirs_by_broker: list[list[str]] = []
+    caps: list[dict[str, float]] = []
+    for broker_id in metadata.broker_ids:
+        info = capacity_resolver.capacity_for_broker("", "", broker_id)
+        by_dir = info.disk_capacity_by_logdir
+        if by_dir is None:
+            # Single logical disk unless the admin reports real logdirs.
+            names = sorted({d for (t, p, b), d in
+                            admin.describe_replica_log_dirs().items()
+                            if b == broker_id}) or ["logdir0"]
+            total = info.capacity[Resource.DISK]
+            by_dir = {d: total / len(names) for d in names}
+        logdirs_by_broker.append(sorted(by_dir))
+        caps.append(by_dir)
+    D = max((len(d) for d in logdirs_by_broker), default=1)
+    B = model.num_brokers_padded
+    P, R = model.replica_broker.shape
+    disk_capacity = np.zeros((B, D), np.float32)
+    disk_valid = np.zeros((B, D), bool)
+    dir_index: list[dict[str, int]] = []
+    for i, dirs in enumerate(logdirs_by_broker):
+        dir_index.append({d: j for j, d in enumerate(dirs)})
+        for j, d in enumerate(dirs):
+            disk_capacity[i, j] = caps[i][d]
+            disk_valid[i, j] = True
+
+    replica_disk = np.full((P, R), -1, np.int32)
+    placement = admin.describe_replica_log_dirs()
+    rb = np.asarray(model.replica_broker)
+    for p, key in enumerate(metadata.partition_keys):
+        for r in range(R):
+            b = rb[p, r]
+            if b >= len(metadata.broker_ids):
+                continue
+            broker_id = metadata.broker_ids[b]
+            d = placement.get((key[0], key[1], broker_id))
+            if d is not None and d in dir_index[b]:
+                replica_disk[p, r] = dir_index[b][d]
+            elif dir_index[b]:
+                replica_disk[p, r] = 0
+    from ..model.flat import replica_loads
+    sizes = np.asarray(replica_loads(model))[..., Resource.DISK]
+    state = DiskState(replica_disk=jnp.asarray(replica_disk),
+                      replica_size=jnp.asarray(sizes),
+                      replica_broker=jnp.asarray(rb),
+                      disk_capacity=jnp.asarray(disk_capacity),
+                      disk_valid=jnp.asarray(disk_valid))
+    return state, logdirs_by_broker
+
+
+def _violations(state: DiskState, cap_threshold: float,
+                balance_threshold: float):
+    """(capacity_violation, balance_violation) — both scalars."""
+    util = state.disk_util
+    cap = state.disk_capacity * cap_threshold
+    over_cap = jnp.where(state.disk_valid, jnp.maximum(util - cap, 0.0), 0.0)
+    # draining disks (capacity 0) count everything as over-capacity
+    # Balance: per broker, disks within avg*threshold band (ref
+    # IntraBrokerDiskUsageDistributionGoal's balance percentage).
+    n = jnp.maximum(state.disk_valid.sum(axis=1), 1)
+    live = state.disk_valid & (state.disk_capacity > 0)
+    n_live = jnp.maximum(live.sum(axis=1), 1)
+    avg = jnp.where(live, util, 0.0).sum(axis=1) / n_live            # [B]
+    upper = avg[:, None] * balance_threshold
+    lower = avg[:, None] * (2.0 - balance_threshold)
+    bal = jnp.where(live, jnp.maximum(util - upper, 0.0)
+                    + jnp.maximum(lower - util, 0.0), 0.0)
+    return over_cap.sum(), bal.sum()
+
+
+def optimize_intra_broker(state: DiskState, *, cap_threshold: float = 0.8,
+                          balance_threshold: float = 1.10,
+                          max_iters: int = 512) -> tuple[DiskState, jax.Array]:
+    """One jitted pass: every broker simultaneously moves its heaviest
+    movable replica from its most-pressured disk to its best destination
+    disk, until no broker can improve. Returns (final state, iters)."""
+
+    B, D = state.disk_capacity.shape
+    P, R = state.replica_disk.shape
+
+    def pressure(util, capacity, valid):
+        # Draining (capacity 0) disks are infinitely pressured; otherwise
+        # pressure = utilization above the per-disk balance midpoint.
+        live = valid & (capacity > 0)
+        n_live = jnp.maximum(live.sum(axis=1, keepdims=True), 1)
+        avg = jnp.where(live, util, 0.0).sum(axis=1, keepdims=True) / n_live
+        pres = jnp.where(valid & (capacity <= 0) & (util > 0), jnp.inf,
+                         jnp.where(live, util - avg, -jnp.inf))
+        return pres, avg
+
+    def body(carry):
+        rd, it, _ = carry
+        st = state.replace(replica_disk=rd)
+        util = st.disk_util
+        pres, avg = pressure(util, state.disk_capacity, state.disk_valid)
+        src = jnp.argmax(pres, axis=1)                               # [B]
+        live = state.disk_valid & (state.disk_capacity > 0)
+        dst_score = jnp.where(live, util, jnp.inf)
+        dst = jnp.argmin(dst_score, axis=1)                          # [B]
+        gap = (util[jnp.arange(B), src] - util[jnp.arange(B), dst])
+        drain = state.disk_capacity[jnp.arange(B), src] <= 0
+
+        # Per-broker best replica on the source disk: heaviest that still
+        # fits in half the gap (so the move improves), any size when
+        # draining. Segment-argmax via scatter-max of (size, index) pairs.
+        on_src = (rd == src[st.replica_broker]) & (rd >= 0)
+        fits = (st.replica_size <= gap[st.replica_broker] * 0.5) | \
+            drain[st.replica_broker]
+        movable = on_src & fits & (st.replica_size > 0)
+        score = jnp.where(movable, st.replica_size, -jnp.inf)
+        flat = score.reshape(-1)
+        seg_best = jnp.full((B + 1,), -jnp.inf).at[
+            st.replica_broker.reshape(-1)].max(flat)
+        # winner: the first flat index achieving its broker's best score
+        is_best = (flat == seg_best[st.replica_broker.reshape(-1)]) \
+            & jnp.isfinite(flat)
+        order = jnp.where(is_best, jnp.arange(P * R), P * R)
+        first = jnp.full((B + 1,), P * R).at[
+            st.replica_broker.reshape(-1)].min(order)
+        winners = jnp.clip(first[:B], 0, P * R - 1)
+        valid_move = (first[:B] < P * R) & (dst != src)
+        new_rd = rd.reshape(-1).at[
+            jnp.where(valid_move, winners, P * R)].set(
+            dst, mode="drop").reshape(P, R)
+        moved = (new_rd != rd).any()
+        return new_rd, it + 1, moved
+
+    def cond(carry):
+        _, it, moved = carry
+        return moved & (it < max_iters)
+
+    rd, iters, _ = jax.lax.while_loop(
+        cond, body, (state.replica_disk, jnp.zeros((), jnp.int32),
+                     jnp.ones((), bool)))
+    return state.replace(replica_disk=rd), iters
+
+
+def diff_intra_moves(before: DiskState, after: DiskState, metadata,
+                     logdirs_by_broker: list[list[str]]
+                     ) -> list[IntraBrokerReplicaMove]:
+    """Materialize logdir moves from the disk-slot diff (the intra-broker
+    AnalyzerUtils.getDiff)."""
+    b0 = np.asarray(before.replica_disk)
+    b1 = np.asarray(after.replica_disk)
+    rb = np.asarray(before.replica_broker)
+    sizes = np.asarray(before.replica_size)
+    moves: list[IntraBrokerReplicaMove] = []
+    for p, r in zip(*np.nonzero(b0 != b1)):
+        if p >= len(metadata.partition_keys) or rb[p, r] >= len(
+                metadata.broker_ids):
+            continue
+        topic, partition = metadata.partition_keys[p]
+        broker = int(rb[p, r])
+        dirs = logdirs_by_broker[broker]
+        moves.append(IntraBrokerReplicaMove(
+            topic=topic, partition=partition,
+            broker_id=metadata.broker_ids[broker],
+            source_logdir=dirs[int(b0[p, r])],
+            dest_logdir=dirs[int(b1[p, r])],
+            size_mb=float(sizes[p, r])))
+    return moves
+
+
+def intra_broker_rebalance(model, metadata, admin, capacity_resolver, *,
+                           cap_threshold: float = 0.8,
+                           balance_threshold: float = 1.10,
+                           drained_disks: dict[int, list[str]] | None = None
+                           ) -> IntraBrokerResult:
+    """End-to-end: build disk state -> (optionally zero the capacity of
+    disks being removed) -> run the kernel -> emit logdir moves (the
+    REMOVE_DISKS / intra-broker rebalance entry, ref RemoveDisksRunnable +
+    the intra-broker goals)."""
+    state, logdirs_by_broker = build_disk_state(model, metadata, admin,
+                                                capacity_resolver)
+    if drained_disks:
+        cap = np.asarray(state.disk_capacity).copy()
+        bindex = {bid: i for i, bid in enumerate(metadata.broker_ids)}
+        for broker_id, dirs in drained_disks.items():
+            i = bindex.get(broker_id)
+            if i is None:
+                raise ValueError(f"unknown broker id {broker_id}")
+            for d in dirs:
+                if d in logdirs_by_broker[i]:
+                    cap[i, logdirs_by_broker[i].index(d)] = 0.0
+        state = state.replace(disk_capacity=jnp.asarray(cap))
+    cv0, bv0 = _violations(state, cap_threshold, balance_threshold)
+    final, iters = optimize_intra_broker(
+        state, cap_threshold=cap_threshold,
+        balance_threshold=balance_threshold)
+    cv1, bv1 = _violations(final, cap_threshold, balance_threshold)
+    return IntraBrokerResult(
+        moves=diff_intra_moves(state, final, metadata, logdirs_by_broker),
+        capacity_violation_before=float(cv0),
+        capacity_violation_after=float(cv1),
+        balance_violation_before=float(bv0),
+        balance_violation_after=float(bv1),
+        iterations=int(jax.device_get(iters)))

@@ -373,6 +373,41 @@ class KafkaCruiseControl:
         self.cpu_model.fit()
         return self.cpu_model.to_json()
 
+    def remove_disks(self, broker_id_logdirs: dict[int, list[str]],
+                     dryrun: bool = True, uuid: str = "",
+                     progress: OperationProgress | None = None) -> dict:
+        """Drain the given logdirs onto their brokers' surviving disks
+        (ref RemoveDisksRunnable; the intra-broker kernel with the doomed
+        disks' capacity zeroed)."""
+        from ..analyzer.intra import intra_broker_rebalance
+        result = self.monitor.cluster_model(self._now_ms())
+        res = intra_broker_rebalance(
+            result.model, result.metadata, self.admin,
+            self.monitor.capacity_resolver,
+            drained_disks=broker_id_logdirs)
+        out = {"numIntraBrokerMoves": len(res.moves),
+               "capacityViolation": {"before": res.capacity_violation_before,
+                                     "after": res.capacity_violation_after},
+               "balanceViolation": {"before": res.balance_violation_before,
+                                    "after": res.balance_violation_after},
+               "iterations": res.iterations,
+               "moves": [m.to_json() for m in res.moves]}
+        if not dryrun and res.moves:
+            if progress:
+                progress.add_step("ExecutingIntraBrokerMoves")
+            exec_res = self.executor.execute_proposals(
+                [], intra_broker_moves=res.moves, uuid=uuid)
+            out["executionResult"] = {"succeeded": exec_res.succeeded,
+                                      "numDeadTasks": exec_res.num_dead_tasks}
+        return out
+
+    def rebalance_disks(self, dryrun: bool = True, uuid: str = "",
+                        progress: OperationProgress | None = None) -> dict:
+        """Intra-broker disk balance (ref rebalance with the intra-broker
+        goal list)."""
+        return self.remove_disks({}, dryrun=dryrun, uuid=uuid,
+                                 progress=progress)
+
     def rightsize(self, **kwargs) -> dict:
         """ref RightsizeRunnable -> Provisioner; concrete provisioning is
         the detector layer's BasicProvisioner acting on the current

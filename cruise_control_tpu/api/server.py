@@ -229,9 +229,32 @@ class CruiseControlApp:
             def run(progress):
                 return facade.rightsize()
         elif endpoint == "remove_disks":
+            # brokerid_and_logdirs=0-logdirA,0-logdirB,1-logdirA (the
+            # reference's parameter format). Parsed + validated EAGERLY so
+            # bad input is a 400 at dispatch, not an opaque 500 from the
+            # async task — and an absent parameter is an error, never a
+            # silent cluster-wide disk rebalance.
+            raw = params.get("brokerid_and_logdirs", [""])[0]
+            drained: dict[int, list[str]] = {}
+            for entry in raw.split(","):
+                if not entry.strip():
+                    continue
+                broker, _, logdir = entry.partition("-")
+                if not broker.strip().isdigit() or not logdir:
+                    raise ValueError(
+                        f"bad brokerid_and_logdirs entry {entry!r} "
+                        "(want <brokerId>-<logdir>)")
+                drained.setdefault(int(broker), []).append(logdir)
+            if not drained:
+                raise ValueError("remove_disks requires brokerid_and_logdirs")
+            known = set(self.facade.admin.describe_cluster())
+            unknown = set(drained) - known
+            if unknown:
+                raise ValueError(f"unknown broker ids {sorted(unknown)}")
+
             def run(progress):
-                raise NotImplementedError(
-                    "remove_disks requires the intra-broker disk model")
+                return facade.remove_disks(drained, dryrun=dryrun,
+                                           progress=progress)
         else:  # pragma: no cover
             raise ValueError(endpoint)
         return run

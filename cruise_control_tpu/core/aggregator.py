@@ -112,42 +112,129 @@ class MetricSampleAggregationResult:
     invalid_entities: set[Hashable]
 
 
-class _RawMetricValues:
-    """Ring-buffered raw window state for one entity (ref RawMetricValues.java).
+class _RawStore:
+    """Dense raw window state for ALL entities: one array pool instead of a
+    per-entity object graph (the reference's per-entity
+    ``RawMetricValues.java`` ring buffers, flattened to ``[entities, slots,
+    metrics]`` so batch ingest is a handful of ``np.add.at`` scatters —
+    the host-side analog of the device model's struct-of-arrays layout).
+    Rows are assigned on first sight and recycled on retain/remove."""
 
-    Keeps per-window per-metric sum/count/max/latest so AVG/MAX/LATEST
-    aggregation strategies can all be served.
-    """
+    def __init__(self, num_slots: int, num_metrics: int,
+                 initial_capacity: int = 256) -> None:
+        self._num_slots = num_slots
+        self._num_metrics = num_metrics
+        self._rows: dict[Hashable, int] = {}
+        self._free: list[int] = []
+        self._alloc(initial_capacity)
 
-    __slots__ = ("sums", "counts", "maxes", "latest_values", "latest_times",
-                 "sample_counts")
+    def _alloc(self, capacity: int) -> None:
+        S, M = self._num_slots, self._num_metrics
+        self.sums = np.zeros((capacity, S, M), np.float64)
+        self.counts = np.zeros((capacity, S, M), np.int32)
+        self.maxes = np.full((capacity, S, M), -np.inf, np.float64)
+        self.latest_values = np.zeros((capacity, S, M), np.float64)
+        self.latest_times = np.full((capacity, S, M), -1, np.int64)
+        self.sample_counts = np.zeros((capacity, S), np.int32)
 
-    def __init__(self, num_slots: int, num_metrics: int) -> None:
-        self.sums = np.zeros((num_slots, num_metrics), dtype=np.float64)
-        self.counts = np.zeros((num_slots, num_metrics), dtype=np.int32)
-        self.maxes = np.full((num_slots, num_metrics), -np.inf, dtype=np.float64)
-        self.latest_values = np.zeros((num_slots, num_metrics), dtype=np.float64)
-        self.latest_times = np.full((num_slots, num_metrics), -1, dtype=np.int64)
-        self.sample_counts = np.zeros(num_slots, dtype=np.int32)
+    @property
+    def capacity(self) -> int:
+        return self.sums.shape[0]
 
-    def clear_slot(self, slot: int) -> None:
-        self.sums[slot] = 0.0
-        self.counts[slot] = 0
-        self.maxes[slot] = -np.inf
-        self.latest_values[slot] = 0.0
-        self.latest_times[slot] = -1
-        self.sample_counts[slot] = 0
+    def _grow(self, need: int) -> None:
+        old = self.capacity
+        new = max(old * 2, need)
+        for name in ("sums", "counts", "maxes", "latest_values",
+                     "latest_times", "sample_counts"):
+            arr = getattr(self, name)
+            grown = np.empty((new, *arr.shape[1:]), arr.dtype)
+            grown[:old] = arr
+            grown[old:] = (-np.inf if name == "maxes"
+                           else -1 if name == "latest_times" else 0)
+            setattr(self, name, grown)
 
-    def add(self, slot: int, time_ms: int, values: Mapping[int, float]) -> None:
+    def row_for(self, entity: Hashable) -> int:
+        row = self._rows.get(entity)
+        if row is None:
+            if self._free:
+                row = self._free.pop()
+            else:
+                row = len(self._rows)
+                if row >= self.capacity:
+                    self._grow(row + 1)
+            self._rows[entity] = row
+        return row
+
+    def rows_for(self, entities: list[Hashable]) -> np.ndarray:
+        return np.fromiter((self.row_for(e) for e in entities), np.int64,
+                           len(entities))
+
+    def get_row(self, entity: Hashable) -> int | None:
+        return self._rows.get(entity)
+
+    def entities(self) -> set[Hashable]:
+        return set(self._rows)
+
+    def drop(self, entity: Hashable) -> bool:
+        row = self._rows.pop(entity, None)
+        if row is None:
+            return False
+        self.clear_slots(np.array([row]), slice(None))
+        self._free.append(row)
+        return True
+
+    def clear_slots(self, rows, slot) -> None:
+        self.sums[rows, slot] = 0.0
+        self.counts[rows, slot] = 0
+        self.maxes[rows, slot] = -np.inf
+        self.latest_values[rows, slot] = 0.0
+        self.latest_times[rows, slot] = -1
+        self.sample_counts[rows, slot] = 0
+
+    def clear_slot_all(self, slot) -> None:
+        self.clear_slots(slice(None), slot)
+
+    # ------------------------------------------------------------- ingest
+    def add(self, row: int, slot: int, time_ms: int,
+            values: Mapping[int, float]) -> None:
         for metric_id, value in values.items():
-            self.sums[slot, metric_id] += value
-            self.counts[slot, metric_id] += 1
-            if value > self.maxes[slot, metric_id]:
-                self.maxes[slot, metric_id] = value
-            if time_ms >= self.latest_times[slot, metric_id]:
-                self.latest_times[slot, metric_id] = time_ms
-                self.latest_values[slot, metric_id] = value
-        self.sample_counts[slot] += 1
+            self.sums[row, slot, metric_id] += value
+            self.counts[row, slot, metric_id] += 1
+            if value > self.maxes[row, slot, metric_id]:
+                self.maxes[row, slot, metric_id] = value
+            if time_ms >= self.latest_times[row, slot, metric_id]:
+                self.latest_times[row, slot, metric_id] = time_ms
+                self.latest_values[row, slot, metric_id] = value
+        self.sample_counts[row, slot] += 1
+
+    def add_batch(self, rows: np.ndarray, slots: np.ndarray,
+                  times: np.ndarray, values: np.ndarray) -> None:
+        """Vectorized ingest of N samples x all metrics: ``values`` is
+        [N, num_metrics] (NaN = metric absent from the sample)."""
+        present = ~np.isnan(values)
+        vals = np.where(present, values, 0.0)
+        np.add.at(self.sums, (rows, slots), vals)
+        np.add.at(self.counts, (rows, slots), present.astype(np.int32))
+        np.maximum.at(self.maxes, (rows, slots),
+                      np.where(present, values, -np.inf))
+        np.add.at(self.sample_counts, (rows, slots), 1)
+        # Latest-wins: process in ascending time order so plain indexed
+        # assignment leaves the batch's newest value in place — then restore
+        # any pre-existing state that is newer still (late-arriving batches
+        # must not regress LATEST metrics, matching the scalar guard).
+        order = np.argsort(times, kind="stable")
+        ro, so, po = rows[order], slots[order], present[order]
+        idx_e, idx_m = np.nonzero(po)
+        tgt = (ro[idx_e], so[idx_e], idx_m)
+        prev_t = self.latest_times[tgt].copy()
+        prev_v = self.latest_values[tgt].copy()
+        self.latest_values[tgt] = values[order][idx_e, idx_m]
+        self.latest_times[tgt] = times[order][idx_e]
+        newer = prev_t > self.latest_times[tgt]
+        if newer.any():
+            keep = tuple(a[newer] for a in tgt)
+            self.latest_times[keep] = prev_t[newer]
+            self.latest_values[keep] = prev_v[newer]
 
 
 class MetricSampleAggregator:
@@ -173,7 +260,7 @@ class MetricSampleAggregator:
         self._num_metrics = metric_def.size()
         self._num_slots = num_windows + 1
         self._entity_group_fn = entity_group_fn or (lambda entity: entity)
-        self._raw: dict[Hashable, _RawMetricValues] = {}
+        self._raw = _RawStore(self._num_slots, self._num_metrics)
         self._oldest_window_index = 0        # window index of slot window_index % slots
         self._current_window_index = 0
         self._initialized = False
@@ -210,32 +297,62 @@ class MetricSampleAggregator:
                 self._roll_out_to(index)
             if index < self._oldest_window_index:
                 return False
-            raw = self._raw.get(sample.entity)
-            if raw is None:
-                raw = _RawMetricValues(self._num_slots, self._num_metrics)
-                self._raw[sample.entity] = raw
-            raw.add(index % self._num_slots, sample.sample_time_ms, sample.values)
+            row = self._raw.row_for(sample.entity)
+            self._raw.add(row, index % self._num_slots,
+                          sample.sample_time_ms, sample.values)
             return True
+
+    def add_samples_dense(self, entities: list[Hashable],
+                          times_ms: np.ndarray,
+                          values: np.ndarray) -> int:
+        """Vectorized bulk ingest: N samples as parallel arrays —
+        ``times_ms`` [N] int64, ``values`` [N, num_metrics] float64 with
+        NaN marking absent metrics. The scalable ingest path for
+        LinkedIn-scale sample volumes (the per-sample dict loop of
+        ``add_sample`` costs hours at 1M partitions x windows); windows are
+        rolled out in time order exactly as the scalar path would. Returns
+        the number of samples retained."""
+        times_ms = np.asarray(times_ms, np.int64)
+        values = np.asarray(values, np.float64)
+        with self._lock:
+            windows = times_ms // self._window_ms
+            if not self._initialized and len(windows):
+                self._initialized = True
+                start = int(windows.min())
+                self._current_window_index = start
+                self._oldest_window_index = start
+            if len(windows) and int(windows.max()) > self._current_window_index:
+                self._roll_out_to(int(windows.max()))
+            keep = windows >= self._oldest_window_index
+            if not keep.all():
+                times_ms, values = times_ms[keep], values[keep]
+                windows = windows[keep]
+                entities = [e for e, k in zip(entities, keep) if k]
+            if not len(windows):
+                return 0
+            rows = self._raw.rows_for(entities)
+            self._raw.add_batch(rows, (windows % self._num_slots).astype(
+                np.int64), times_ms, values)
+            return len(windows)
 
     def retain_entities(self, entities: set[Hashable]) -> None:
         """Drop state for entities no longer in the cluster (ref retainEntities)."""
         with self._lock:
-            removed = set(self._raw) - entities
+            removed = self._raw.entities() - entities
             for entity in removed:
-                del self._raw[entity]
+                self._raw.drop(entity)
             if removed:
                 self._generation += 1
 
     def remove_entities(self, entities: set[Hashable]) -> None:
         with self._lock:
-            for entity in entities:
-                self._raw.pop(entity, None)
-            if entities:
+            dropped = any([self._raw.drop(e) for e in entities])
+            if dropped:
                 self._generation += 1
 
     def all_entities(self) -> set[Hashable]:
         with self._lock:
-            return set(self._raw)
+            return self._raw.entities()
 
     def num_available_windows(self) -> int:
         with self._lock:
@@ -264,7 +381,8 @@ class MetricSampleAggregator:
             # MetricSampleAggregator peeks every interested entity; an
             # unmonitored partition must drag the valid-entity ratio down,
             # not silently vanish from it).
-            entities = (set(self._raw) if options.interested_entities is None
+            entities = (self._raw.entities()
+                        if options.interested_entities is None
                         else set(options.interested_entities))
             num_win = len(window_indices)
             completeness = MetricSampleCompleteness(generation=self._generation,
@@ -321,16 +439,16 @@ class MetricSampleAggregator:
         window_valid = np.zeros(num_win, dtype=bool)
         num_extrapolations = 0
 
-        raw = self._raw.get(entity)
-        if raw is None:
+        row = self._raw.get_row(entity)
+        if row is None:
             # Interested entity with no samples: every window invalid.
             extrapolations = [Extrapolation.NO_VALID_EXTRAPOLATION] * num_win
             window_times = [w * self._window_ms for w in window_indices]
             return (ValuesAndExtrapolations(values, extrapolations,
                                             window_times), window_valid)
 
-        base = self._compute_window_values(raw)
-        counts = raw.sample_counts
+        base = self._compute_window_values(row)
+        counts = self._raw.sample_counts[row]
 
         for j, w in enumerate(window_indices):
             slot = w % self._num_slots
@@ -371,16 +489,17 @@ class MetricSampleAggregator:
         window_times = [w * self._window_ms for w in window_indices]
         return ValuesAndExtrapolations(values, extrapolations, window_times), window_valid
 
-    def _compute_window_values(self, raw: _RawMetricValues) -> np.ndarray:
+    def _compute_window_values(self, row: int) -> np.ndarray:
         """Apply each metric's aggregation strategy over raw per-slot state.
 
         Returns ``[num_metrics, num_slots]``.
         """
+        raw = self._raw
         out = np.zeros((self._num_metrics, self._num_slots), dtype=np.float64)
-        safe_counts = np.maximum(raw.counts, 1)
-        avg = (raw.sums / safe_counts).T
-        maxes = np.where(np.isfinite(raw.maxes), raw.maxes, 0.0).T
-        latest = raw.latest_values.T
+        safe_counts = np.maximum(raw.counts[row], 1)
+        avg = (raw.sums[row] / safe_counts).T
+        maxes = np.where(np.isfinite(raw.maxes[row]), raw.maxes[row], 0.0).T
+        latest = raw.latest_values[row].T
         for info in self._metric_def.all_metrics():
             if info.strategy is AggregationFunction.AVG:
                 out[info.id] = avg[info.id]
@@ -424,17 +543,14 @@ class MetricSampleAggregator:
     def _roll_out_to(self, new_current: int) -> None:
         steps = new_current - self._current_window_index
         if steps >= self._num_slots:
-            for raw in self._raw.values():
-                for slot in range(self._num_slots):
-                    raw.clear_slot(slot)
+            for slot in range(self._num_slots):
+                self._raw.clear_slot_all(slot)
             self._current_window_index = new_current
             self._oldest_window_index = new_current - self._num_windows
             self._generation += 1
             return
         for w in range(self._current_window_index + 1, new_current + 1):
-            slot = w % self._num_slots
-            for raw in self._raw.values():
-                raw.clear_slot(slot)
+            self._raw.clear_slot_all(w % self._num_slots)
         self._current_window_index = new_current
         self._oldest_window_index = max(self._oldest_window_index,
                                         new_current - self._num_windows)

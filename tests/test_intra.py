@@ -1,0 +1,98 @@
+"""Intra-broker (disk) optimization tests: JBOD balance, capacity drain,
+REMOVE_DISKS end-to-end through facade + executor against the sim."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.intra import (build_disk_state,
+                                               intra_broker_rebalance,
+                                               optimize_intra_broker)
+from cruise_control_tpu.api import KafkaCruiseControl
+from cruise_control_tpu.config.capacity import (BrokerCapacityInfo,
+                                                FixedCapacityResolver)
+from cruise_control_tpu.core.resources import Resource
+from cruise_control_tpu.executor import (Executor, ExecutorConfig, SimClock,
+                                         SimulatedKafkaCluster)
+from cruise_control_tpu.monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                                        MetricFetcherManager, MonitorConfig,
+                                        SyntheticWorkloadSampler)
+
+W = 1000
+
+
+class JbodResolver:
+    """Two 1000-MB logdirs per broker."""
+
+    def capacity_for_broker(self, rack, host, broker_id):
+        return BrokerCapacityInfo(
+            capacity={Resource.CPU: 100.0, Resource.NW_IN: 1e6,
+                      Resource.NW_OUT: 1e6, Resource.DISK: 2000.0},
+            disk_capacity_by_logdir={"d0": 1000.0, "d1": 1000.0})
+
+
+def build_stack(num_brokers=3, partitions=12, skew=True):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=100_000.0, logdirs=("d0", "d1"))
+    for p in range(partitions):
+        # All replicas crowd logdir d0.
+        sim.add_partition("t", p, [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=40.0 + p,
+                          logdir_by_broker=None if not skew else {
+                              p % num_brokers: "d0",
+                              (p + 1) % num_brokers: "d0"})
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=W,
+                                             min_samples_per_window=1),
+                          capacity_resolver=JbodResolver())
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=W)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        sim.advance_to((w + 1) * W)
+        assert runner.maybe_run_sampling(sim.now_ms)
+    clock = SimClock(sim)
+    executor = Executor(sim, ExecutorConfig(progress_check_interval_ms=100),
+                        now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    facade = KafkaCruiseControl(sim, monitor, task_runner=runner,
+                                executor=executor,
+                                now_ms=lambda: sim.now_ms)
+    return sim, monitor, facade
+
+
+def test_disk_state_and_balance_kernel():
+    sim, monitor, facade = build_stack()
+    result = monitor.cluster_model(sim.now_ms)
+    state, dirs = build_disk_state(result.model, result.metadata, sim,
+                                   JbodResolver())
+    util0 = np.asarray(state.disk_util)
+    # everything sits on d0
+    assert util0[:3, 1].sum() == 0 and util0[:3, 0].sum() > 0
+    final, iters = optimize_intra_broker(state)
+    util1 = np.asarray(final.disk_util)
+    for b in range(3):
+        avg = util1[b, :2].mean()
+        assert abs(util1[b, 0] - avg) <= 1.10 * avg
+    assert int(iters) > 0
+
+
+def test_remove_disks_drains_and_executes():
+    sim, monitor, facade = build_stack()
+    out = facade.remove_disks({0: ["d0"]}, dryrun=False)
+    assert out["numIntraBrokerMoves"] > 0
+    assert out["executionResult"]["succeeded"]
+    # nothing of broker 0 lives on d0 anymore
+    left = [k for k, d in sim.describe_replica_log_dirs().items()
+            if k[2] == 0 and d == "d0"]
+    assert left == []
+
+
+def test_rebalance_disks_dryrun_reports_moves():
+    sim, monitor, facade = build_stack()
+    out = facade.rebalance_disks(dryrun=True)
+    assert out["numIntraBrokerMoves"] > 0
+    assert out["balanceViolation"]["after"] <= \
+        out["balanceViolation"]["before"]
+    # dryrun: cluster untouched
+    assert all(d == "d0" for k, d in
+               sim.describe_replica_log_dirs().items())
