@@ -68,14 +68,16 @@ def build_disk_state(model, metadata, admin, capacity_resolver
     BrokerCapacityInfo.diskCapacityByLogDir)."""
     logdirs_by_broker: list[list[str]] = []
     caps: list[dict[str, float]] = []
+    placement = admin.describe_replica_log_dirs()   # one full-cluster scan
+    dirs_by_broker: dict[int, set[str]] = {}
+    for (t, p, b), d in placement.items():
+        dirs_by_broker.setdefault(b, set()).add(d)
     for broker_id in metadata.broker_ids:
         info = capacity_resolver.capacity_for_broker("", "", broker_id)
         by_dir = info.disk_capacity_by_logdir
         if by_dir is None:
             # Single logical disk unless the admin reports real logdirs.
-            names = sorted({d for (t, p, b), d in
-                            admin.describe_replica_log_dirs().items()
-                            if b == broker_id}) or ["logdir0"]
+            names = sorted(dirs_by_broker.get(broker_id, set())) or ["logdir0"]
             total = info.capacity[Resource.DISK]
             by_dir = {d: total / len(names) for d in names}
         logdirs_by_broker.append(sorted(by_dir))
@@ -93,7 +95,6 @@ def build_disk_state(model, metadata, admin, capacity_resolver
             disk_valid[i, j] = True
 
     replica_disk = np.full((P, R), -1, np.int32)
-    placement = admin.describe_replica_log_dirs()
     rb = np.asarray(model.replica_broker)
     for p, key in enumerate(metadata.partition_keys):
         for r in range(R):
@@ -121,11 +122,10 @@ def _violations(state: DiskState, cap_threshold: float,
     """(capacity_violation, balance_violation) — both scalars."""
     util = state.disk_util
     cap = state.disk_capacity * cap_threshold
+    # Draining disks (capacity 0) count everything on them as over-capacity.
     over_cap = jnp.where(state.disk_valid, jnp.maximum(util - cap, 0.0), 0.0)
-    # draining disks (capacity 0) count everything as over-capacity
     # Balance: per broker, disks within avg*threshold band (ref
     # IntraBrokerDiskUsageDistributionGoal's balance percentage).
-    n = jnp.maximum(state.disk_valid.sum(axis=1), 1)
     live = state.disk_valid & (state.disk_capacity > 0)
     n_live = jnp.maximum(live.sum(axis=1), 1)
     avg = jnp.where(live, util, 0.0).sum(axis=1) / n_live            # [B]
@@ -174,7 +174,10 @@ def optimize_intra_broker(state: DiskState, *, cap_threshold: float = 0.8,
         on_src = (rd == src[st.replica_broker]) & (rd >= 0)
         fits = (st.replica_size <= gap[st.replica_broker] * 0.5) | \
             drain[st.replica_broker]
-        movable = on_src & fits & (st.replica_size > 0)
+        # Zero-size replicas still occupy a logdir: they matter (only) when
+        # the disk is draining — the operator is about to remove it.
+        movable = on_src & fits & ((st.replica_size > 0)
+                                   | drain[st.replica_broker])
         score = jnp.where(movable, st.replica_size, -jnp.inf)
         flat = score.reshape(-1)
         seg_best = jnp.full((B + 1,), -jnp.inf).at[
@@ -250,6 +253,10 @@ def intra_broker_rebalance(model, metadata, admin, capacity_resolver, *,
             for d in dirs:
                 if d in logdirs_by_broker[i]:
                     cap[i, logdirs_by_broker[i].index(d)] = 0.0
+            if not (cap[i] > 0).any():
+                raise ValueError(
+                    f"broker {broker_id}: cannot remove every logdir "
+                    f"({sorted(dirs)}) — no surviving disk to drain to")
         state = state.replace(disk_capacity=jnp.asarray(cap))
     cv0, bv0 = _violations(state, cap_threshold, balance_threshold)
     final, iters = optimize_intra_broker(

@@ -1,0 +1,7 @@
+"""Cross-language optimizer boundary (SURVEY §5.8): the Optimize sidecar
+server; the wire contract lives in ``sidecar/optimize.proto`` and the C++
+client shim in ``sidecar/cc_client.cc``."""
+
+from .server import OptimizerSidecar
+
+__all__ = ["OptimizerSidecar"]

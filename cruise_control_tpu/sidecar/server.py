@@ -1,0 +1,190 @@
+"""Optimizer sidecar server — the framework's cross-language boundary.
+
+Rebuild of the SURVEY §5.8 contract: external (JVM) callers keep their own
+monitor/executor and delegate only the search —
+``Optimize(FlattenedClusterModel, GoalConfig) -> MoveList`` — to this
+process sitting next to the TPU. Frames are 4-byte big-endian
+length-prefixed protobuf messages over TCP (the gRPC unary wire shape
+without the grpc runtime, which is not in this image; ``sidecar/
+optimize.proto`` is drop-in for a grpc service definition). The C++ client
+shim (``sidecar/cc_client.cc``) is the native half a JVM/broker-side
+integration links against.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+import time
+
+import numpy as np
+
+# protoc output lives in sidecar/ at the repo root
+import importlib
+import os
+import sys
+
+_SIDECAR_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "sidecar")
+if _SIDECAR_DIR not in sys.path:
+    sys.path.insert(0, _SIDECAR_DIR)
+optimize_pb2 = importlib.import_module("optimize_pb2")
+
+
+def _model_from_proto(m) -> tuple:
+    import jax.numpy as jnp
+    from ..model.flat import FlatClusterModel
+    from ..model.spec import ClusterMetadata, _round_up
+    B, P, R = m.num_brokers, m.num_partitions, m.max_replication_factor
+    Bpad, Ppad = _round_up(B, 8), _round_up(P, 128)
+    rb = np.full((Ppad, R), Bpad, np.int32)
+    raw = np.asarray(m.replica_broker, np.int32).reshape(P, R)
+    rb[:P] = np.where(raw < 0, Bpad, raw)
+    lead = np.zeros((Ppad, 4), np.float32)
+    lead[:P] = np.asarray(m.leader_load, np.float32).reshape(P, 4)
+    foll = np.zeros((Ppad, 4), np.float32)
+    foll[:P] = np.asarray(m.follower_load, np.float32).reshape(P, 4)
+    cap = np.zeros((Bpad, 4), np.float32)
+    cap[:B] = np.asarray(m.broker_capacity, np.float32).reshape(B, 4)
+    rack = np.zeros(Bpad, np.int32)
+    rack[:B] = np.asarray(m.broker_rack, np.int32)
+    alive = np.zeros(Bpad, bool)
+    alive[:B] = np.asarray(m.broker_alive, bool)
+    ptopic = np.full(Ppad, -1, np.int32)
+    ptopic[:P] = np.asarray(m.partition_topic, np.int32)
+    offline = np.zeros((Ppad, R), bool)
+    if m.replica_offline:
+        offline[:P] = np.asarray(m.replica_offline, bool).reshape(P, R)
+    model = FlatClusterModel(
+        replica_broker=jnp.asarray(rb), leader_load=jnp.asarray(lead),
+        follower_load=jnp.asarray(foll), partition_topic=jnp.asarray(ptopic),
+        partition_valid=jnp.asarray(np.arange(Ppad) < P),
+        replica_offline=jnp.asarray(offline),
+        replica_pref_pos=jnp.asarray(
+            np.tile(np.arange(R, dtype=np.int32), (Ppad, 1))),
+        broker_capacity=jnp.asarray(cap), broker_rack=jnp.asarray(rack),
+        broker_host=jnp.asarray(np.arange(Bpad, dtype=np.int32)),
+        broker_set=jnp.full((Bpad,), -1, jnp.int32),
+        broker_alive=jnp.asarray(alive),
+        broker_new=jnp.zeros((Bpad,), bool),
+        broker_demoted=jnp.zeros((Bpad,), bool),
+        broker_broken_disk=jnp.zeros((Bpad,), bool),
+        broker_valid=jnp.asarray(np.arange(Bpad) < B))
+    num_topics = max(int(ptopic[:P].max()) + 1, 1) if P else 1
+    topics = [f"t{i}" for i in range(num_topics)]
+    keys = [(topics[ptopic[i]] if ptopic[i] >= 0 else "t0", i)
+            for i in range(P)]
+    metadata = ClusterMetadata(
+        broker_ids=list(range(B)), broker_index={i: i for i in range(B)},
+        topics=topics, topic_index={t: i for i, t in enumerate(topics)},
+        partition_keys=keys, partition_index={k: i for i, k
+                                              in enumerate(keys)},
+        racks=[], hosts=[], broker_sets=[])
+    return model, metadata
+
+
+class OptimizeHandler(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            header = self._recv_exact(4)
+            if header is None:
+                return
+            (length,) = struct.unpack(">I", header)
+            payload = self._recv_exact(length)
+            if payload is None:
+                return
+            reply = self.server.app.optimize(payload)   # type: ignore
+            self.request.sendall(struct.pack(">I", len(reply)) + reply)
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            chunk = self.request.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+class OptimizerSidecar:
+    """One Optimize endpoint; reuses compiled chains across requests."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        from ..analyzer import TpuGoalOptimizer
+        self._optimizers: dict[tuple, TpuGoalOptimizer] = {}
+        self._server = socketserver.ThreadingTCPServer((host, port),
+                                                       OptimizeHandler)
+        self._server.app = self
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="sidecar")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()   # release the listening socket
+
+    def optimize(self, payload: bytes) -> bytes:
+        from ..analyzer import (OptimizationOptions, TpuGoalOptimizer,
+                                goals_by_name)
+        reply = optimize_pb2.MoveList()
+        try:
+            req = optimize_pb2.OptimizeRequest()
+            req.ParseFromString(payload)
+            t0 = time.monotonic()
+            model, metadata = _model_from_proto(req.model)
+            key = tuple(req.config.goals)
+            opt = self._optimizers.get(key)
+            if opt is None:
+                opt = TpuGoalOptimizer(
+                    goals=goals_by_name(list(req.config.goals))
+                    if req.config.goals else None)
+                self._optimizers[key] = opt
+            res = opt.optimize(model, metadata, OptimizationOptions(
+                seed=int(req.config.seed),
+                fast_mode=req.config.fast_mode,
+                excluded_topics=frozenset(req.config.excluded_topics),
+                skip_hard_goal_check=req.config.skip_hard_goal_check))
+            for p in res.proposals:
+                mv = reply.moves.add()
+                mv.partition = metadata.partition_index[(p.topic,
+                                                         p.partition)]
+                mv.old_replicas.extend(p.old_replicas)
+                mv.new_replicas.extend(p.new_replicas)
+            for g in res.goal_results:
+                st = reply.goal_stats.add()
+                st.name = g.name
+                st.violation_before = g.violation_before
+                st.violation_after = g.violation_after
+            reply.duration_s = time.monotonic() - t0
+        except Exception as e:
+            reply.error = f"{type(e).__name__}: {e}"
+        return reply.SerializeToString()
+
+
+def main(argv=None) -> int:   # pragma: no cover - thin CLI
+    import argparse
+    ap = argparse.ArgumentParser(description="tpu-cruise optimizer sidecar")
+    ap.add_argument("--port", type=int, default=9096)
+    args = ap.parse_args(argv)
+    from ..utils.platform import ensure_live_backend
+    ensure_live_backend()
+    sidecar = OptimizerSidecar(port=args.port)
+    sidecar.start()
+    print(f"sidecar listening on {sidecar.port}", flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        sidecar.stop()
+    return 0
+
+
+if __name__ == "__main__":   # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,155 @@
+// Native client shim for the optimizer sidecar (SURVEY §5.8): the C++ half
+// a JVM/broker-side integration links against (via JNI or directly). Builds
+// an OptimizeRequest from flat arrays, frames it (4-byte big-endian length
+// prefix), sends it over TCP, and parses the MoveList reply.
+//
+// Standalone smoke binary: constructs a skewed synthetic cluster, calls the
+// sidecar, verifies the reply rebalances it. Exits 0 on success.
+//
+//   g++ -std=c++17 cc_client.cc optimize.pb.cc -lprotobuf -o cc_client
+//   ./cc_client <port> [brokers] [partitions]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <iostream>
+#include <string>
+#include <vector>
+
+#include "optimize.pb.h"
+
+namespace {
+
+bool SendFrame(int fd, const std::string& payload) {
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  if (write(fd, &len, 4) != 4) return false;
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t n = write(fd, payload.data() + off, payload.size() - off);
+    if (n <= 0) return false;
+    off += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvExact(int fd, char* buf, size_t want) {
+  size_t got = 0;
+  while (got < want) {
+    ssize_t n = read(fd, buf + got, want - got);
+    if (n <= 0) return false;
+    got += static_cast<size_t>(n);
+  }
+  return true;
+}
+
+}  // namespace
+
+// The reusable client call: returns false on transport/parse failure.
+bool OptimizeViaSidecar(const std::string& host, int port,
+                        const tpu_cruise::OptimizeRequest& request,
+                        tpu_cruise::MoveList* reply) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  inet_pton(AF_INET, host.c_str(), &addr.sin_addr);
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    close(fd);
+    return false;
+  }
+  bool ok = SendFrame(fd, request.SerializeAsString());
+  uint32_t len = 0;
+  ok = ok && RecvExact(fd, reinterpret_cast<char*>(&len), 4);
+  std::string payload;
+  if (ok) {
+    payload.resize(ntohl(len));
+    ok = RecvExact(fd, payload.data(), payload.size());
+  }
+  close(fd);
+  return ok && reply->ParseFromString(payload);
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::cerr << "usage: cc_client <port> [brokers] [partitions]\n";
+    return 2;
+  }
+  const int port = std::stoi(argv[1]);
+  const int B = argc > 2 ? std::stoi(argv[2]) : 12;
+  const int P = argc > 3 ? std::stoi(argv[3]) : 240;
+  const int R = 2;
+
+  tpu_cruise::OptimizeRequest req;
+  auto* m = req.mutable_model();
+  m->set_num_brokers(B);
+  m->set_num_partitions(P);
+  m->set_max_replication_factor(R);
+  // Skewed placement: everything on the first third of the brokers.
+  const int hot = B / 3 > 0 ? B / 3 : 1;
+  for (int p = 0; p < P; ++p) {
+    m->add_replica_broker(p % hot);
+    m->add_replica_broker((p + 1) % hot);
+    m->add_leader_load(0.5f);         // CPU
+    m->add_leader_load(10.0f);        // NW_IN
+    m->add_leader_load(15.0f);        // NW_OUT
+    m->add_leader_load(100.0f + p);   // DISK
+    m->add_follower_load(0.25f);
+    m->add_follower_load(10.0f);
+    m->add_follower_load(0.0f);
+    m->add_follower_load(100.0f + p);
+    m->add_partition_topic(p % 4);
+    m->add_replica_offline(false);
+    m->add_replica_offline(false);
+  }
+  for (int b = 0; b < B; ++b) {
+    m->add_broker_capacity(100.0f);
+    m->add_broker_capacity(1e6f);
+    m->add_broker_capacity(1e6f);
+    m->add_broker_capacity(1e8f);
+    m->add_broker_rack(b % 3);
+    m->add_broker_alive(true);
+  }
+  auto* cfg = req.mutable_config();
+  cfg->add_goals("ReplicaDistributionGoal");
+  cfg->add_goals("DiskUsageDistributionGoal");
+  cfg->set_seed(7);
+
+  tpu_cruise::MoveList reply;
+  if (!OptimizeViaSidecar("127.0.0.1", port, req, &reply)) {
+    std::cerr << "transport failure\n";
+    return 1;
+  }
+  if (!reply.error().empty()) {
+    std::cerr << "sidecar error: " << reply.error() << "\n";
+    return 1;
+  }
+  // The skewed cluster must produce moves onto the cold brokers, and the
+  // replica-count goal must report converged.
+  bool cold_dest = false;
+  for (const auto& mv : reply.moves()) {
+    for (int nb : mv.new_replicas()) {
+      if (nb >= hot) cold_dest = true;
+    }
+  }
+  bool counts_fixed = false;
+  for (const auto& st : reply.goal_stats()) {
+    if (st.name() == "ReplicaDistributionGoal" &&
+        st.violation_before() > 0 && st.violation_after() == 0) {
+      counts_fixed = true;
+    }
+  }
+  std::cout << "moves=" << reply.moves_size()
+            << " goals=" << reply.goal_stats_size()
+            << " duration_s=" << reply.duration_s() << "\n";
+  if (reply.moves_size() == 0 || !cold_dest || !counts_fixed) {
+    std::cerr << "reply failed sanity checks\n";
+    return 1;
+  }
+  std::cout << "CC_CLIENT OK\n";
+  return 0;
+}

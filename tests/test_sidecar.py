@@ -1,0 +1,102 @@
+"""Sidecar tests: the cross-language Optimize boundary (SURVEY §5.8) —
+Python protobuf round-trip, and the compiled C++ client shim end-to-end
+when a toolchain is present."""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SIDECAR_DIR = os.path.join(REPO, "sidecar")
+
+
+@pytest.fixture(scope="module")
+def sidecar():
+    from cruise_control_tpu.sidecar.server import OptimizerSidecar
+    s = OptimizerSidecar(port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_python_roundtrip(sidecar):
+    sys.path.insert(0, SIDECAR_DIR)
+    import optimize_pb2
+    import socket
+    import struct
+    req = optimize_pb2.OptimizeRequest()
+    m = req.model
+    B, P, R = 6, 60, 2
+    m.num_brokers, m.num_partitions, m.max_replication_factor = B, P, R
+    for p in range(P):
+        m.replica_broker.extend([p % 2, 2 + p % 2])
+        m.leader_load.extend([0.5, 10.0, 15.0, 100.0])
+        m.follower_load.extend([0.25, 10.0, 0.0, 100.0])
+        m.partition_topic.append(p % 3)
+        m.replica_offline.extend([False, False])
+    for b in range(B):
+        m.broker_capacity.extend([100.0, 1e6, 1e6, 1e8])
+        m.broker_rack.append(b % 3)
+        m.broker_alive.append(True)
+    req.config.goals.append("ReplicaDistributionGoal")
+    payload = req.SerializeToString()
+    with socket.create_connection(("127.0.0.1", sidecar.port)) as sock:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        (n,) = struct.unpack(">I", sock.recv(4))
+        buf = b""
+        while len(buf) < n:
+            buf += sock.recv(n - len(buf))
+    reply = optimize_pb2.MoveList()
+    reply.ParseFromString(buf)
+    assert not reply.error
+    assert reply.moves   # the skew gets fixed
+    stats = {s.name: s for s in reply.goal_stats}
+    assert stats["ReplicaDistributionGoal"].violation_after == 0.0
+    # moves reference only known brokers
+    for mv in reply.moves:
+        assert all(0 <= b < B for b in mv.new_replicas)
+
+
+def test_error_reply_on_bad_request(sidecar):
+    sys.path.insert(0, SIDECAR_DIR)
+    import optimize_pb2
+    import socket
+    import struct
+    req = optimize_pb2.OptimizeRequest()
+    req.config.goals.append("NoSuchGoal")
+    req.model.num_brokers = 1
+    req.model.num_partitions = 0
+    req.model.max_replication_factor = 1
+    req.model.broker_capacity.extend([1.0, 1.0, 1.0, 1.0])
+    req.model.broker_rack.append(0)
+    req.model.broker_alive.append(True)
+    payload = req.SerializeToString()
+    with socket.create_connection(("127.0.0.1", sidecar.port)) as sock:
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+        (n,) = struct.unpack(">I", sock.recv(4))
+        buf = b""
+        while len(buf) < n:
+            buf += sock.recv(n - len(buf))
+    reply = optimize_pb2.MoveList()
+    reply.ParseFromString(buf)
+    assert "NoSuchGoal" in reply.error
+
+
+@pytest.mark.skipif(shutil.which("g++") is None or
+                    shutil.which("protoc") is None,
+                    reason="native toolchain unavailable")
+def test_cc_client_end_to_end(sidecar):
+    binary = os.path.join(SIDECAR_DIR, "cc_client")
+    if not os.path.exists(binary):
+        subprocess.run(["protoc", "--cpp_out=.", "optimize.proto"],
+                       cwd=SIDECAR_DIR, check=True)
+        subprocess.run(["g++", "-std=c++17", "-O2", "cc_client.cc",
+                        "optimize.pb.cc", "-lprotobuf", "-o", "cc_client"],
+                       cwd=SIDECAR_DIR, check=True)
+    out = subprocess.run([binary, str(sidecar.port)], capture_output=True,
+                         text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "CC_CLIENT OK" in out.stdout
