@@ -40,6 +40,12 @@ from .state import (SearchContext, SearchState, apply_group, base_legality)
 _MUST_FIRST = -1e30
 
 
+def violation_stack(goals: Sequence[GoalKernel], state, ctx) -> jax.Array:
+    """f32[num_goals] residual per goal — the single definition shared by
+    the fused per-pass readings and ``CompiledGoalChain.violations``."""
+    return jnp.stack([g.violation(state, ctx) for g in goals])
+
+
 def _chain_accepts(prev_goals: Sequence[GoalKernel], state, ctx, cands):
     ok = jnp.ones(cands.p.shape, bool)
     for g in prev_goals:
@@ -48,11 +54,16 @@ def _chain_accepts(prev_goals: Sequence[GoalKernel], state, ctx, cands):
 
 
 def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
-                   cfg: SearchConfig):
+                   cfg: SearchConfig,
+                   all_goals: Sequence[GoalKernel] | None = None):
     """Build the jittable single-goal optimization pass.
 
-    Returns ``run(state, ctx, key) -> (state, iters)``. ``prev_goals`` are
-    baked in at trace time (the goal chain is static configuration)."""
+    Returns ``run(state, ctx, key) -> (state, iters, violations)`` where
+    ``violations`` is the post-pass residual stack over ``all_goals`` —
+    computed inside the same jit so the host never pays a separate
+    dispatch for the goal-boundary readings the reference records at
+    ``GoalOptimizer.java:458-497``. ``prev_goals`` are baked in at trace
+    time (the goal chain is static configuration)."""
 
     eps = cfg.epsilon
     G = cfg.apply_groups
@@ -171,7 +182,8 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
         state, iters, _ = jax.lax.while_loop(
             cond, body, (state, jnp.zeros((), jnp.int32),
                          jnp.zeros((), jnp.int32)))
-        return state, iters
+        stack = violation_stack(all_goals or [goal], state, ctx)
+        return state, iters, stack
 
     return run
 
@@ -190,12 +202,13 @@ class CompiledGoalChain:
         self.cfg = cfg
         self.passes = []
         for i, g in enumerate(self.goals):
-            run = make_goal_pass(g, self.goals[:i], cfg)
+            run = make_goal_pass(g, self.goals[:i], cfg,
+                                 all_goals=self.goals)
             self.passes.append(jax.jit(run, donate_argnums=(0,)))
         self._violations = jax.jit(self._violations_impl)
 
     def _violations_impl(self, state, ctx):
-        return jnp.stack([g.violation(state, ctx) for g in self.goals])
+        return violation_stack(self.goals, state, ctx)
 
     def violations(self, state, ctx) -> jax.Array:
         """f32[num_goals] residual per goal."""
