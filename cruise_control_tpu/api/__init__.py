@@ -6,14 +6,16 @@ from .facade import KafkaCruiseControl
 from .precompute import ProposalCache
 from .progress import OperationProgress
 from .purgatory import Purgatory, ReviewStatus
+from .openapi import openapi_spec
 from .security import (AllowAllSecurityProvider, AuthorizationError,
-                       BasicSecurityProvider, Principal, Role,
-                       TrustedProxySecurityProvider, check_access)
+                       BasicSecurityProvider, JwtSecurityProvider, Principal,
+                       Role, TrustedProxySecurityProvider, check_access)
 from .server import CruiseControlApp
 from .tasks import TaskState, UserTaskManager
 
 __all__ = ["KafkaCruiseControl", "ProposalCache", "OperationProgress",
            "Purgatory", "ReviewStatus", "AllowAllSecurityProvider",
-           "AuthorizationError", "BasicSecurityProvider", "Principal",
-           "Role", "TrustedProxySecurityProvider", "check_access",
+           "AuthorizationError", "BasicSecurityProvider",
+           "JwtSecurityProvider", "Principal", "Role",
+           "TrustedProxySecurityProvider", "check_access", "openapi_spec",
            "CruiseControlApp", "TaskState", "UserTaskManager"]

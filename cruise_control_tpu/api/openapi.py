@@ -1,0 +1,117 @@
+"""OpenAPI 3 spec for the REST surface.
+
+The reference assembles its spec from ``src/main/resources/yaml/base.yaml``
+plus one yaml per endpoint (23 files under ``yaml/endpoints/``) and serves
+swagger-ui from ``webroot/``. Here the spec is generated from the same
+parameter tables the dispatcher uses, so it cannot drift from the server,
+and is served as JSON at ``GET /kafkacruisecontrol/openapi``.
+"""
+
+from __future__ import annotations
+
+_COMMON_ASYNC_PARAMS = [
+    ("dryrun", "boolean", "compute proposals only, do not execute"),
+    ("goals", "string", "comma-separated goal class names to run"),
+    ("kafka_assigner", "boolean",
+     "use the kafka-assigner emulation goal set"),
+    ("excluded_topics", "string", "comma-separated topics to exclude"),
+    ("fast_mode", "boolean", "reduced-effort search"),
+    ("exclude_brokers_for_leadership", "string", "comma-separated ids"),
+    ("exclude_brokers_for_replica_move", "string", "comma-separated ids"),
+    ("destination_broker_ids", "string", "comma-separated ids"),
+    ("ignore_proposal_cache", "boolean", "bypass the precompute cache"),
+    ("get_response_timeout_s", "number",
+     "long-poll timeout before a 202 progress response"),
+    ("review_id", "integer", "approved review id (two-step verification)"),
+]
+
+#: endpoint -> (method, summary, extra params)
+ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
+    "state": ("get", "Monitor/executor/analyzer/anomaly-detector state",
+              [("substates", "string", "comma-separated subset")]),
+    "load": ("get", "Per-broker load snapshot", []),
+    "partition_load": ("get", "Per-partition resource load, sorted",
+                       [("resource", "string", "CPU|NW_IN|NW_OUT|DISK"),
+                        ("start", "integer", "first entry"),
+                        ("entries", "integer", "max entries")]),
+    "proposals": ("get", "Cached or freshly computed rebalance proposals",
+                  [("ignore_proposal_cache", "boolean", "recompute")]),
+    "kafka_cluster_state": ("get", "Kafka-level partition/replica state", []),
+    "user_tasks": ("get", "Recent/active async user tasks", []),
+    "review_board": ("get", "Two-step-verification review queue", []),
+    "permissions": ("get", "Roles of the authenticated principal", []),
+    "bootstrap": ("get", "Replay historic samples into the monitor",
+                  [("start", "integer", "epoch ms"),
+                   ("end", "integer", "epoch ms")]),
+    "train": ("get", "Fit the (bytes-in, bytes-out) -> CPU regression", []),
+    "rebalance": ("post", "Compute and optionally execute a rebalance",
+                  _COMMON_ASYNC_PARAMS),
+    "add_broker": ("post", "Move load onto new brokers",
+                   [("brokerid", "string", "comma-separated ids"),
+                    *_COMMON_ASYNC_PARAMS]),
+    "remove_broker": ("post", "Drain brokers before decommission",
+                      [("brokerid", "string", "comma-separated ids"),
+                       *_COMMON_ASYNC_PARAMS]),
+    "fix_offline_replicas": ("post", "Move offline replicas to live brokers",
+                             _COMMON_ASYNC_PARAMS),
+    "demote_broker": ("post", "Move leadership off brokers",
+                      [("brokerid", "string", "comma-separated ids"),
+                       *_COMMON_ASYNC_PARAMS]),
+    "topic_configuration": ("post", "Change topic replication factor",
+                            [("topic", "string", "topic name or pattern"),
+                             ("replication_factor", "integer", "target RF"),
+                             *_COMMON_ASYNC_PARAMS]),
+    "rightsize": ("post", "Provisioner-driven cluster rightsizing", []),
+    "remove_disks": ("post", "Drain specific log dirs",
+                     [("brokerid_and_logdirs", "string",
+                       "<id>-<logdir>[,...]"), *_COMMON_ASYNC_PARAMS]),
+    "stop_proposal_execution": ("post", "Stop the ongoing execution", []),
+    "pause_sampling": ("post", "Pause metric sampling",
+                       [("reason", "string", "audit note")]),
+    "resume_sampling": ("post", "Resume metric sampling",
+                        [("reason", "string", "audit note")]),
+    "admin": ("post", "Runtime toggles (self-healing, concurrency)",
+              [("disable_self_healing_for", "string", "anomaly types"),
+               ("enable_self_healing_for", "string", "anomaly types"),
+               ("concurrent_partition_movements_per_broker", "integer", ""),
+               ("concurrent_leader_movements", "integer", "")]),
+    "review": ("post", "Approve/discard parked requests",
+               [("approve", "string", "comma-separated review ids"),
+                ("discard", "string", "comma-separated review ids")]),
+}
+
+
+def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
+    paths: dict[str, dict] = {}
+    for name, (method, summary, extra) in ENDPOINTS.items():
+        params = [{
+            "name": pname, "in": "query", "required": False,
+            "description": desc, "schema": {"type": ptype},
+        } for pname, ptype, desc in extra]
+        op = {
+            "summary": summary,
+            "operationId": name,
+            "parameters": params,
+            "responses": {
+                "200": {"description": "completed result (JSON)"},
+                "202": {"description":
+                        "accepted; poll with the User-Task-ID header"},
+            },
+        }
+        if method == "post":
+            op["responses"]["202"]["description"] += (
+                " or parked for review (two-step verification)")
+        paths[f"{base_path}/{name}"] = {method: op}
+    return {
+        "openapi": "3.0.3",
+        "info": {"title": "cruise-control-tpu",
+                 "description": "TPU-native Cruise Control REST API "
+                                "(reference parity: CruiseControlEndPoint)",
+                 "version": "2.0"},
+        "paths": paths,
+        "components": {"securitySchemes": {
+            "basicAuth": {"type": "http", "scheme": "basic"},
+            "bearerAuth": {"type": "http", "scheme": "bearer",
+                           "bearerFormat": "JWT"},
+        }},
+    }

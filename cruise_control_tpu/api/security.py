@@ -26,7 +26,7 @@ ENDPOINT_MIN_ROLE: dict[str, Role] = {
     "state": Role.VIEWER, "load": Role.VIEWER, "partition_load": Role.VIEWER,
     "proposals": Role.VIEWER, "kafka_cluster_state": Role.VIEWER,
     "user_tasks": Role.VIEWER, "review_board": Role.VIEWER,
-    "permissions": Role.VIEWER,
+    "permissions": Role.VIEWER, "openapi": Role.VIEWER,
     "rebalance": Role.USER, "add_broker": Role.USER,
     "remove_broker": Role.USER, "demote_broker": Role.USER,
     "fix_offline_replicas": Role.USER, "topic_configuration": Role.USER,
@@ -83,6 +83,91 @@ class BasicSecurityProvider:
         if entry is None or entry[0] != password:
             raise AuthorizationError("bad credentials", 401)
         return Principal(name, entry[1])
+
+
+class JwtSecurityProvider:
+    """JWT bearer-token auth (ref ``security/jwt/JwtSecurityProvider`` +
+    ``JwtAuthenticator``): HS256-signed tokens carrying the principal in
+    ``sub`` and the role in a configurable claim. The reference validates
+    RS256 tokens minted by an SSO service; with no crypto dependencies in
+    this environment the shared-secret HMAC variant keeps the same token
+    shape, expiry, and claim mapping."""
+
+    def __init__(self, secret: bytes | str, *, role_claim: str = "role",
+                 default_role: Role = Role.VIEWER,
+                 now_s: "Callable[[], float] | None" = None):
+        import time
+        self.secret = secret.encode() if isinstance(secret, str) else secret
+        self.role_claim = role_claim
+        self.default_role = default_role
+        self._now_s = now_s or time.time
+
+    @staticmethod
+    def _b64url_decode(part: str) -> bytes:
+        pad = -len(part) % 4
+        return base64.urlsafe_b64decode(part + "=" * pad)
+
+    @classmethod
+    def encode(cls, secret: bytes | str, claims: dict) -> str:
+        """Mint a token (test/ops helper — the reference relies on an
+        external issuer)."""
+        import hashlib
+        import hmac
+        import json
+        secret = secret.encode() if isinstance(secret, str) else secret
+
+        def enc(obj) -> str:
+            raw = json.dumps(obj, separators=(",", ":")).encode()
+            return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+        signing = f"{enc({'alg': 'HS256', 'typ': 'JWT'})}.{enc(claims)}"
+        sig = hmac.new(secret, signing.encode(), hashlib.sha256).digest()
+        return (signing + "."
+                + base64.urlsafe_b64encode(sig).rstrip(b"=").decode())
+
+    def authenticate(self, headers: dict[str, str]) -> Principal:
+        import hashlib
+        import hmac
+        import json
+        auth = headers.get("authorization", headers.get("Authorization", ""))
+        if not auth.startswith("Bearer "):
+            raise AuthorizationError("missing bearer token", 401)
+        token = auth[7:].strip()
+        parts = token.split(".")
+        if len(parts) != 3:
+            raise AuthorizationError("malformed JWT", 401)
+        try:
+            header = json.loads(self._b64url_decode(parts[0]))
+            claims = json.loads(self._b64url_decode(parts[1]))
+            sig = self._b64url_decode(parts[2])
+        except Exception:
+            raise AuthorizationError("malformed JWT", 401)
+        if header.get("alg") != "HS256":
+            raise AuthorizationError(
+                f"unsupported JWT alg {header.get('alg')!r}", 401)
+        expect = hmac.new(self.secret,
+                          f"{parts[0]}.{parts[1]}".encode(),
+                          hashlib.sha256).digest()
+        if not hmac.compare_digest(sig, expect):
+            raise AuthorizationError("bad JWT signature", 401)
+        exp = claims.get("exp")
+        if exp is not None:
+            try:
+                exp = float(exp)
+            except (TypeError, ValueError):
+                raise AuthorizationError("malformed JWT exp claim", 401)
+            if self._now_s() >= exp:
+                raise AuthorizationError("JWT expired", 401)
+        name = claims.get("sub")
+        if not name:
+            raise AuthorizationError("JWT missing sub claim", 401)
+        role_raw = claims.get(self.role_claim)
+        try:
+            role = (Role[role_raw.upper()] if isinstance(role_raw, str)
+                    else self.default_role)
+        except KeyError:
+            raise AuthorizationError(f"unknown role {role_raw!r}", 403)
+        return Principal(name, role)
 
 
 class TrustedProxySecurityProvider:

@@ -23,6 +23,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..analyzer import OptimizationOptions
+from ..analyzer.goals import KAFKA_ASSIGNER_GOALS
 from .facade import KafkaCruiseControl
 from .purgatory import Purgatory
 from .security import (AllowAllSecurityProvider, AuthorizationError,
@@ -31,7 +32,7 @@ from .tasks import UserTaskManager
 
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
-                 "permissions", "bootstrap", "train"}
+                 "permissions", "bootstrap", "train", "openapi"}
 POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                   "fix_offline_replicas", "demote_broker",
                   "topic_configuration", "rightsize", "remove_disks",
@@ -61,7 +62,12 @@ def _ids(params: dict, name: str) -> list[int]:
 
 def _goals(params: dict) -> list[str] | None:
     raw = params.get("goals", [""])[0]
-    return [g.strip() for g in raw.split(",") if g.strip()] or None
+    explicit = [g.strip() for g in raw.split(",") if g.strip()]
+    if explicit:
+        return explicit
+    if _flag(params, "kafka_assigner"):
+        return list(KAFKA_ASSIGNER_GOALS)
+    return None
 
 
 class CruiseControlApp:
@@ -268,6 +274,9 @@ class CruiseControlApp:
                                      else None), {}
         if endpoint == "kafka_cluster_state":
             return 200, facade.kafka_cluster_state(), {}
+        if endpoint == "openapi":
+            from .openapi import openapi_spec
+            return 200, openapi_spec(), {}
         if endpoint == "user_tasks":
             return 200, {"userTasks": [t.to_json()
                                        for t in self.tasks.all_tasks()]}, {}

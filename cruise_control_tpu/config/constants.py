@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from ..analyzer.constraint import BalancingConstraint, SearchConfig
 from ..core.config import (AbstractConfig, ConfigDef, ConfigType, Importance,
-                           Range)
+                           Range, ValidString)
 from ..executor.concurrency import ConcurrencyConfig
 from ..executor.executor import ExecutorConfig
 from ..monitor.monitor import MonitorConfig
@@ -49,6 +49,17 @@ def _monitor_defs(d: ConfigDef) -> None:
     d.define("metric.sampler.class", ConfigType.CLASS,
              "cruise_control_tpu.monitor.sampler.SyntheticWorkloadSampler",
              importance=Importance.HIGH, doc="MetricSampler plugin")
+    d.define("prometheus.server.endpoint", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="When set, sample from this Prometheus server instead of "
+                 "the default sampler (ref PrometheusMetricSampler "
+                 "PROMETHEUS_SERVER_ENDPOINT_CONFIG)")
+    d.define("prometheus.query.resolution.step.ms", ConfigType.LONG, 30_000,
+             validator=Range.at_least(1000), importance=Importance.LOW,
+             doc="Range-query step (ref PROMETHEUS_QUERY_RESOLUTION_STEP_MS)")
+    d.define("prometheus.broker.host.map.file", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="JSON {host: broker_id} mapping for the instance label")
     d.define("sample.store.class", ConfigType.CLASS,
              "cruise_control_tpu.monitor.store.NoopSampleStore",
              importance=Importance.MEDIUM, doc="SampleStore plugin")
@@ -214,6 +225,19 @@ def _detector_defs(d: ConfigDef) -> None:
     d.define("slow.broker.removal.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.LOW,
              doc="Remove (vs demote) slow brokers")
+    d.define("webhook.notifier.type", ConfigType.STRING, "",
+             validator=ValidString.in_("", "slack", "msteams", "alerta"),
+             importance=Importance.LOW,
+             doc="Post alerts to a webhook: slack|msteams|alerta "
+                 "(ref Slack/MSTeams/AlertaSelfHealingNotifier)")
+    d.define("webhook.notifier.url", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="Webhook / Alerta API URL")
+    d.define("webhook.notifier.channel", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="Slack channel override")
+    d.define("alerta.api.key", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="Alerta API key")
+    d.define("alerta.environment", ConfigType.STRING, "production",
+             importance=Importance.LOW, doc="Alerta environment tag")
 
 
 def _webserver_defs(d: ConfigDef) -> None:
@@ -229,6 +253,21 @@ def _webserver_defs(d: ConfigDef) -> None:
     d.define("webserver.auth.credentials.file", ConfigType.STRING, "",
              importance=Importance.MEDIUM,
              doc="Basic-auth credentials file (name: password,ROLE)")
+    d.define("webserver.security.provider", ConfigType.STRING, "basic",
+             validator=ValidString.in_("basic", "jwt", "trustedproxy"),
+             importance=Importance.MEDIUM,
+             doc="Which SecurityProvider gate requests when security is "
+                 "enabled (ref servlet/security/ provider set)")
+    d.define("jwt.secret", ConfigType.STRING, "", importance=Importance.LOW,
+             doc="HS256 shared secret for the jwt provider")
+    d.define("jwt.role.claim", ConfigType.STRING, "role",
+             importance=Importance.LOW, doc="JWT claim carrying the role")
+    d.define("trusted.proxy.services", ConfigType.LIST, [],
+             importance=Importance.LOW,
+             doc="Proxy principals allowed to forward requests")
+    d.define("trusted.proxy.principal.header", ConfigType.STRING, "doAs",
+             importance=Importance.LOW,
+             doc="Header carrying the acting principal")
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.MEDIUM, doc="Review-before-execute flow")
     d.define("max.active.user.tasks", ConfigType.INT, 25,

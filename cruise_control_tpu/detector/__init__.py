@@ -11,8 +11,10 @@ from .detectors import (BalancednessWeights, BrokerFailureDetector,
                         MetricAnomalyDetector, SlowBrokerFinder,
                         TopicAnomalyDetector)
 from .manager import AnomalyDetectorManager, DetectorSchedule
-from .notifier import (AnomalyNotificationResult, AnomalyNotifier,
-                       NotificationAction, SelfHealingNotifier)
+from .notifier import (AlertaSelfHealingNotifier, AnomalyNotificationResult,
+                       AnomalyNotifier, MSTeamsSelfHealingNotifier,
+                       NotificationAction, SelfHealingNotifier,
+                       SlackSelfHealingNotifier, WebhookSelfHealingNotifier)
 from .provisioner import (BasicProvisioner, Provisioner,
                           ProvisionRecommendation, ProvisionResponse,
                           ProvisionStatus)
@@ -26,6 +28,8 @@ __all__ = [
     "MaintenanceEventReader", "MetricAnomalyDetector", "SlowBrokerFinder",
     "TopicAnomalyDetector", "AnomalyDetectorManager", "DetectorSchedule",
     "AnomalyNotificationResult", "AnomalyNotifier", "NotificationAction",
-    "SelfHealingNotifier", "BasicProvisioner", "Provisioner",
+    "SelfHealingNotifier", "WebhookSelfHealingNotifier",
+    "SlackSelfHealingNotifier", "MSTeamsSelfHealingNotifier",
+    "AlertaSelfHealingNotifier", "BasicProvisioner", "Provisioner",
     "ProvisionRecommendation", "ProvisionResponse", "ProvisionStatus",
 ]

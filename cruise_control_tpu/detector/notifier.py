@@ -127,3 +127,97 @@ class SelfHealingNotifier(AnomalyNotifier):
             return NotificationAction(AnomalyNotificationResult.IGNORE)
         self._alert(f"BROKER_FAILURE: {anomaly.reason()} (auto-fix)", True)
         return NotificationAction(AnomalyNotificationResult.FIX)
+
+
+class WebhookSelfHealingNotifier(SelfHealingNotifier):
+    """SelfHealingNotifier that also posts every alert to an HTTP webhook.
+
+    Base for the Slack / MS Teams / Alerta integrations (ref
+    ``SlackSelfHealingNotifier.java``, ``MSTeamsSelfHealingNotifier.java``,
+    ``AlertaSelfHealingNotifier.java`` — all of which are exactly
+    SelfHealingNotifier plus a JSON POST per alert). ``http_post(url,
+    payload_dict)`` is injectable for tests; delivery failures are recorded,
+    never raised (an unreachable webhook must not stall the anomaly loop).
+    """
+
+    def __init__(self, webhook_url: str, *,
+                 http_post: Callable[[str, dict], None] | None = None,
+                 extra_headers: dict[str, str] | None = None,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.webhook_url = webhook_url
+        self._extra_headers = extra_headers or {}
+        self._http_post = http_post or self._default_post
+        self.delivery_errors: list[str] = []
+
+    def _alert(self, message: str, autofix: bool) -> None:
+        # Overrides (not wraps) the base hook so reassigning the public
+        # alert_sink slot can't silently detach webhook delivery.
+        super()._alert(message, autofix)
+        try:
+            self._http_post(self.webhook_url, self.payload(message, autofix))
+        except Exception as e:   # noqa: BLE001 — alerting must not stall
+            self.delivery_errors.append(f"{type(e).__name__}: {e}")
+
+    def _default_post(self, url: str, payload: dict) -> None:
+        import json
+        import urllib.request
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     **self._extra_headers})
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            resp.read()
+
+    def payload(self, message: str, autofix: bool) -> dict:
+        raise NotImplementedError
+
+
+class SlackSelfHealingNotifier(WebhookSelfHealingNotifier):
+    """ref SlackSelfHealingNotifier.java — incoming-webhook message."""
+
+    def __init__(self, webhook_url: str, *, channel: str | None = None,
+                 icon: str = ":information_source:",
+                 user: str = "cruise-control", **kwargs):
+        super().__init__(webhook_url, **kwargs)
+        self.channel = channel
+        self.icon = icon
+        self.user = user
+
+    def payload(self, message: str, autofix: bool) -> dict:
+        p = {"text": message, "icon_emoji": self.icon, "username": self.user}
+        if self.channel:
+            p["channel"] = self.channel
+        return p
+
+
+class MSTeamsSelfHealingNotifier(WebhookSelfHealingNotifier):
+    """ref MSTeamsSelfHealingNotifier.java — MessageCard payload."""
+
+    def payload(self, message: str, autofix: bool) -> dict:
+        return {"@type": "MessageCard", "@context": "https://schema.org/extensions",
+                "themeColor": "D00000" if autofix else "E8A33D",
+                "summary": "Cruise Control anomaly",
+                "text": message}
+
+
+class AlertaSelfHealingNotifier(WebhookSelfHealingNotifier):
+    """ref AlertaSelfHealingNotifier.java + AlertaMessage.java — alerta.io
+    alert API; ``api_key`` goes into the Authorization header via a custom
+    poster when set."""
+
+    def __init__(self, api_url: str, *, environment: str = "production",
+                 origin: str = "cruise-control", api_key: str | None = None,
+                 **kwargs):
+        if api_key:
+            kwargs.setdefault("extra_headers",
+                              {"Authorization": f"Key {api_key}"})
+        super().__init__(api_url.rstrip("/") + "/alert", **kwargs)
+        self.environment = environment
+        self.origin = origin
+
+    def payload(self, message: str, autofix: bool) -> dict:
+        return {"resource": "kafka-cluster", "event": message.split(":")[0],
+                "severity": "critical" if autofix else "warning",
+                "environment": self.environment, "origin": self.origin,
+                "service": ["cruise-control"], "text": message}

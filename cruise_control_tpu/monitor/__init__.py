@@ -11,6 +11,8 @@ from .fetcher import MetricFetcherManager
 from .monitor import (ClusterModelResult, LoadMonitor, LoadMonitorState,
                       MonitorConfig, NotEnoughValidWindowsException)
 from .processor import CruiseControlMetricsProcessor
+from .prometheus import (PrometheusAdapter, PrometheusMetricSampler,
+                         PrometheusResult)
 from .requirements import ModelCompletenessRequirements
 from .sampler import (AgentTopicSampler, MetricSampler, SamplerAssignment,
                       Samples, SyntheticWorkloadSampler)
@@ -22,6 +24,7 @@ __all__ = [
     "MetricFetcherManager", "ClusterModelResult", "LoadMonitor",
     "LoadMonitorState", "MonitorConfig", "NotEnoughValidWindowsException",
     "CruiseControlMetricsProcessor", "ModelCompletenessRequirements",
+    "PrometheusAdapter", "PrometheusMetricSampler", "PrometheusResult",
     "AgentTopicSampler", "MetricSampler", "SamplerAssignment", "Samples",
     "SyntheticWorkloadSampler", "BrokerMetricSample", "PartitionMetricSample",
     "FileSampleStore", "NoopSampleStore", "SampleStore",

@@ -50,7 +50,7 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                           broker_set_resolver=broker_set_resolver)
     store_dir = config.get_string("sample.store.dir")
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
-    sampler = SyntheticWorkloadSampler(admin)
+    sampler = _make_sampler(config, admin)
     fetcher = MetricFetcherManager(sampler,
                                    config.get_int("num.metric.fetchers"),
                                    store=store)
@@ -77,7 +77,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             return config.get_boolean(key)
         return healing_on
 
-    notifier = SelfHealingNotifier(
+    notifier = _make_notifier(
+        config,
         alert_threshold_ms=config.get_int("broker.failure.alert.threshold.ms"),
         self_healing_threshold_ms=config.get_int(
             "broker.failure.self.healing.threshold.ms"),
@@ -102,8 +103,7 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
 
     security = None
     if config.get_boolean("webserver.security.enable"):
-        security = BasicSecurityProvider(_load_credentials(
-            config.get_string("webserver.auth.credentials.file")))
+        security = _make_security(config)
     return CruiseControlApp(
         facade,
         host=config.get_string("webserver.http.address"),
@@ -111,6 +111,70 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         security=security,
         two_step_verification=config.get_boolean(
             "two.step.verification.enabled"))
+
+
+def _make_sampler(config: CruiseControlConfig, admin):
+    """Sampler selection: Prometheus scrape when an endpoint is configured,
+    else the default synthetic sampler (ref metric.sampler.class +
+    PrometheusMetricSampler configs)."""
+    endpoint = config.get_string("prometheus.server.endpoint")
+    if not endpoint:
+        return SyntheticWorkloadSampler(admin)
+    import json as _json
+
+    from .monitor import PrometheusAdapter, PrometheusMetricSampler
+    map_file = config.get_string("prometheus.broker.host.map.file")
+    if map_file:
+        with open(map_file, encoding="utf-8") as f:
+            host_map = {h: int(b) for h, b in _json.load(f).items()}
+    else:
+        # Default host naming b<id>, the reference's fallback of resolving
+        # instance hosts against the cluster's broker host list.
+        host_map = {f"b{b}": b for b in admin.describe_cluster()}
+    return PrometheusMetricSampler(
+        PrometheusAdapter(endpoint), host_map,
+        step_ms=config.get_int("prometheus.query.resolution.step.ms"))
+
+
+def _make_notifier(config: CruiseControlConfig, **kwargs):
+    """Notifier selection (ref anomaly.notifier.class +
+    Slack/MSTeams/Alerta notifier configs)."""
+    kind = config.get_string("webhook.notifier.type")
+    url = config.get_string("webhook.notifier.url")
+    if not kind or not url:
+        return SelfHealingNotifier(**kwargs)
+    from .detector import (AlertaSelfHealingNotifier,
+                           MSTeamsSelfHealingNotifier,
+                           SlackSelfHealingNotifier)
+    if kind == "slack":
+        channel = config.get_string("webhook.notifier.channel")
+        return SlackSelfHealingNotifier(url, channel=channel or None,
+                                        **kwargs)
+    if kind == "msteams":
+        return MSTeamsSelfHealingNotifier(url, **kwargs)
+    return AlertaSelfHealingNotifier(
+        url, environment=config.get_string("alerta.environment"),
+        api_key=config.get_string("alerta.api.key") or None, **kwargs)
+
+
+def _make_security(config: CruiseControlConfig):
+    """Provider selection (ref webserver.security.provider set)."""
+    kind = config.get_string("webserver.security.provider")
+    if kind == "jwt":
+        from .api.security import JwtSecurityProvider
+        secret = config.get_string("jwt.secret")
+        if not secret:
+            raise ValueError("jwt security requires jwt.secret")
+        return JwtSecurityProvider(
+            secret, role_claim=config.get_string("jwt.role.claim"))
+    if kind == "trustedproxy":
+        from .api.security import TrustedProxySecurityProvider
+        return TrustedProxySecurityProvider(
+            set(config.get_list("trusted.proxy.services")),
+            principal_header=config.get_string(
+                "trusted.proxy.principal.header"))
+    return BasicSecurityProvider(_load_credentials(
+        config.get_string("webserver.auth.credentials.file")))
 
 
 def _load_credentials(path: str) -> dict[str, tuple[str, Role]]:

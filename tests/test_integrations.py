@@ -1,0 +1,190 @@
+"""Tests for the pluggable integrations: Prometheus sampler, JWT security,
+webhook notifiers, kafka-assigner request mode, OpenAPI spec (the rebuild
+of PrometheusMetricSamplerTest, security/jwt tests, notifier tests and the
+yaml endpoint spec assembly)."""
+
+import json
+
+import pytest
+
+from cruise_control_tpu.api.openapi import ENDPOINTS, openapi_spec
+from cruise_control_tpu.api.security import (AuthorizationError,
+                                             JwtSecurityProvider, Role,
+                                             check_access)
+from cruise_control_tpu.api.server import KAFKA_ASSIGNER_GOALS, _goals
+from cruise_control_tpu.core.metricdef import BrokerMetric, KafkaMetric
+from cruise_control_tpu.detector.anomalies import BrokerFailures
+from cruise_control_tpu.detector.notifier import (AlertaSelfHealingNotifier,
+                                                  MSTeamsSelfHealingNotifier,
+                                                  SlackSelfHealingNotifier)
+from cruise_control_tpu.monitor.prometheus import (PrometheusAdapter,
+                                                   PrometheusMetricSampler)
+from cruise_control_tpu.monitor.sampler import SamplerAssignment
+
+
+# ---------------------------------------------------------------- prometheus
+
+def _prom_response(series):
+    return json.dumps({
+        "status": "success",
+        "data": {"result": [
+            {"metric": labels, "values": values} for labels, values in series
+        ]}})
+
+
+def _fake_http_get(url: str) -> str:
+    from urllib.parse import parse_qs, urlparse
+    q = parse_qs(urlparse(url).query)["query"][0]
+    if "node_cpu_seconds_total" in q:
+        return _prom_response([
+            ({"instance": "b0.example.com:7071"}, [[100.0, "0.4"]]),
+            ({"instance": "b1.example.com:7071"}, [[100.0, "0.2"]]),
+        ])
+    if "BytesInPerSec" in q and "topic" not in q:
+        return _prom_response([
+            ({"instance": "b0.example.com:7071"}, [[100.0, "1000"]]),
+        ])
+    if "BytesInPerSec" in q:
+        return _prom_response([
+            ({"instance": "b0.example.com:7071", "topic": "t0",
+              "partition": "0"}, [[100.0, "600"]]),
+            ({"instance": "b0.example.com:7071", "topic": "t0",
+              "partition": "1"}, [[100.0, "400"]]),
+            # unknown partition must be dropped, not crash
+            ({"instance": "b0.example.com:7071", "topic": "zz",
+              "partition": "9"}, [[100.0, "5"]]),
+        ])
+    return _prom_response([])
+
+
+def test_prometheus_sampler_maps_series_to_samples():
+    adapter = PrometheusAdapter("http://prom:9090", http_get=_fake_http_get)
+    sampler = PrometheusMetricSampler(
+        adapter, {"b0.example.com": 0, "b1.example.com": 1})
+    assert sampler.parallel_safe
+    out = sampler.get_samples(SamplerAssignment(
+        partitions=[("t0", 0), ("t0", 1)], brokers=[0, 1],
+        start_ms=0, end_ms=120_000))
+    by_broker = {s.broker_id: s for s in out.broker_samples}
+    assert by_broker[0].values[int(BrokerMetric.CPU_USAGE)] == pytest.approx(0.4)
+    assert by_broker[0].values[int(BrokerMetric.LEADER_BYTES_IN)] == 1000
+    assert by_broker[1].values[int(BrokerMetric.CPU_USAGE)] == pytest.approx(0.2)
+    by_tp = {s.entity: s for s in out.partition_samples}
+    assert set(by_tp) == {("t0", 0), ("t0", 1)}
+    assert by_tp[("t0", 0)].values[int(KafkaMetric.LEADER_BYTES_IN)] == 600
+
+
+def test_prometheus_adapter_error_status_raises():
+    adapter = PrometheusAdapter(
+        "http://prom:9090",
+        http_get=lambda url: json.dumps({"status": "error",
+                                         "error": "bad query"}))
+    with pytest.raises(IOError, match="bad query"):
+        adapter.query_range("up", 0, 1000, 1000)
+
+
+# ----------------------------------------------------------------------- jwt
+
+SECRET = "s3cret"
+
+
+def _token(**extra):
+    claims = {"sub": "alice", "role": "USER", **extra}
+    return JwtSecurityProvider.encode(SECRET, claims)
+
+
+def test_jwt_roundtrip_and_roles():
+    prov = JwtSecurityProvider(SECRET, now_s=lambda: 1000.0)
+    p = prov.authenticate({"authorization": f"Bearer {_token(exp=2000)}"})
+    assert (p.name, p.role) == ("alice", Role.USER)
+    # role gates endpoints through check_access like any other provider
+    assert check_access(prov, "rebalance",
+                        {"authorization": f"Bearer {_token()}"})
+    with pytest.raises(AuthorizationError):
+        check_access(prov, "admin", {"authorization": f"Bearer {_token()}"})
+
+
+def test_jwt_rejects_expired_tampered_and_missing():
+    prov = JwtSecurityProvider(SECRET, now_s=lambda: 5000.0)
+    with pytest.raises(AuthorizationError, match="expired"):
+        prov.authenticate({"authorization": f"Bearer {_token(exp=2000)}"})
+    tok = _token()
+    head, payload, sig = tok.split(".")
+    evil = JwtSecurityProvider.encode(SECRET, {"sub": "mallory",
+                                               "role": "ADMIN"}).split(".")[1]
+    with pytest.raises(AuthorizationError, match="signature"):
+        prov.authenticate({"authorization": f"Bearer {head}.{evil}.{sig}"})
+    with pytest.raises(AuthorizationError, match="bearer"):
+        prov.authenticate({})
+    with pytest.raises(AuthorizationError, match="signature"):
+        JwtSecurityProvider("other").authenticate(
+            {"authorization": f"Bearer {tok}"})
+
+
+# ------------------------------------------------------------------ webhooks
+
+def _failed(now_ms):
+    return BrokerFailures(detected_ms=now_ms,
+                          failed_brokers={3: now_ms - 40 * 60 * 1000})
+
+
+def test_slack_notifier_posts_payload():
+    posts = []
+    n = SlackSelfHealingNotifier(
+        "https://hooks.slack example/T/x", channel="#kafka",
+        http_post=lambda url, payload: posts.append((url, payload)))
+    act = n.on_anomaly(_failed(10**9), 10**9)
+    assert act.result.name == "FIX"
+    assert posts and posts[0][1]["channel"] == "#kafka"
+    assert "BROKER_FAILURE" in posts[0][1]["text"]
+
+
+def test_msteams_and_alerta_payload_shapes():
+    posts = []
+    n = MSTeamsSelfHealingNotifier(
+        "https://teams.example/hook",
+        http_post=lambda url, payload: posts.append(payload))
+    n.on_anomaly(_failed(10**9), 10**9)
+    assert posts[0]["@type"] == "MessageCard"
+    assert posts[0]["themeColor"] == "D00000"   # autofix == critical color
+
+    alerta = []
+    a = AlertaSelfHealingNotifier(
+        "https://alerta.example/api", environment="staging",
+        http_post=lambda url, payload: alerta.append((url, payload)))
+    a.on_anomaly(_failed(10**9), 10**9)
+    url, payload = alerta[0]
+    assert url.endswith("/alert")
+    assert payload["severity"] == "critical"
+    assert payload["environment"] == "staging"
+
+
+def test_webhook_delivery_failure_never_raises():
+    def boom(url, payload):
+        raise IOError("connection refused")
+    n = SlackSelfHealingNotifier("https://x", http_post=boom)
+    act = n.on_anomaly(_failed(10**9), 10**9)   # must not raise
+    assert act.result.name == "FIX"
+    assert n.delivery_errors and "connection refused" in n.delivery_errors[0]
+    assert n.alerts   # the in-process alert log still recorded it
+
+
+# ------------------------------------------------- kafka-assigner + openapi
+
+def test_goals_param_kafka_assigner_mode():
+    assert _goals({"kafka_assigner": ["true"]}) == KAFKA_ASSIGNER_GOALS
+    # explicit goals win over the assigner flag (reference precedence)
+    assert _goals({"kafka_assigner": ["true"],
+                   "goals": ["RackAwareGoal"]}) == ["RackAwareGoal"]
+    assert _goals({}) is None
+
+
+def test_openapi_covers_all_23_endpoints():
+    spec = openapi_spec()
+    assert len(ENDPOINTS) == 23
+    assert len(spec["paths"]) == 23
+    reb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]
+    names = {p["name"] for p in reb["parameters"]}
+    assert {"dryrun", "goals", "kafka_assigner",
+            "review_id"} <= names
+    assert "basicAuth" in spec["components"]["securitySchemes"]
