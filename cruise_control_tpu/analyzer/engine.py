@@ -198,8 +198,16 @@ class CompiledGoalChain:
     """
 
     def __init__(self, goals: Sequence[GoalKernel], cfg: SearchConfig):
+        import threading
         self.goals = list(goals)
         self.cfg = cfg
+        # Warmup bookkeeping: keyed by the (state, ctx) shape signature —
+        # one chain serves models of different padded sizes, each needing
+        # its own compile. The lock makes a background startup warmup and
+        # a concurrent request share one compilation instead of racing
+        # into two full parallel compiles.
+        self._warmed_keys: set[tuple] = set()
+        self._warm_lock = threading.Lock()
         self.passes = []
         for i, g in enumerate(self.goals):
             run = make_goal_pass(g, self.goals[:i], cfg,
@@ -209,6 +217,39 @@ class CompiledGoalChain:
 
     def _violations_impl(self, state, ctx):
         return violation_stack(self.goals, state, ctx)
+
+    @staticmethod
+    def _shape_key(*trees) -> tuple:
+        import jax
+        return tuple((tuple(getattr(x, "shape", ())),
+                      str(getattr(x, "dtype", type(x).__name__)))
+                     for x in jax.tree_util.tree_leaves(trees))
+
+    def warmup(self, state, ctx, key, max_workers: int | None = None) -> None:
+        """AOT-compile every pass concurrently (XLA compilation releases the
+        GIL, so a thread pool gets real parallelism). Ensures the persistent
+        compilation cache is on so the compiled executables land in the
+        file cache and the chain's first real run — this process or any
+        later one — skips XLA entirely. Serial cold compile of a 15-goal
+        chain costs tens of minutes on TPU; warmed-up it is the cost of
+        the slowest single pass. No-op when these shapes were already
+        warmed; concurrent callers serialize on one compilation."""
+        wkey = self._shape_key(state, ctx)
+        with self._warm_lock:
+            if wkey in self._warmed_keys:
+                return
+            # AOT executables don't feed the jit dispatch cache directly;
+            # the persistent cache is the bridge that makes the follow-up
+            # jitted call cheap. Idempotent, and falls back gracefully.
+            from ..utils.platform import enable_compilation_cache
+            enable_compilation_cache()
+            from concurrent.futures import ThreadPoolExecutor
+            jobs = [(p, (state, ctx, key)) for p in self.passes]
+            jobs.append((self._violations, (state, ctx)))
+            with ThreadPoolExecutor(max_workers
+                                    or min(len(jobs), 16)) as ex:
+                list(ex.map(lambda j: j[0].lower(*j[1]).compile(), jobs))
+            self._warmed_keys.add(wkey)
 
     def violations(self, state, ctx) -> jax.Array:
         """f32[num_goals] residual per goal."""

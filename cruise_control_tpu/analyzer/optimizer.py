@@ -118,12 +118,12 @@ class TpuGoalOptimizer:
             self._chains[key] = CompiledGoalChain(goals, cfg)
         return self._chains[key]
 
-    def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
-                 options: OptimizationOptions | None = None
-                 ) -> OptimizerResult:
-        options = options or OptimizationOptions()
-        t0 = time.monotonic()
-
+    def _prepare(self, model: FlatClusterModel, metadata: ClusterMetadata,
+                 options: OptimizationOptions):
+        """Shared optimize()/warmup() prep: scaled config, bound goals,
+        compiled-chain lookup, search context (with the request's exclusion
+        masks) and initial state — one definition so a warmed chain is
+        exactly the chain a matching optimize() will run."""
         P = model.num_partitions_padded
         B = model.num_brokers_padded
         cfg = self.config.scaled_for(metadata.num_partitions,
@@ -158,8 +158,35 @@ class TpuGoalOptimizer:
             model,
             with_topic_counts=metadata.num_topics if needs_topics else None,
             with_topic_leader_counts=needs_tlc)
+        return cfg, goals, chain, ctx, state
 
+    def warmup(self, model: FlatClusterModel, metadata: ClusterMetadata,
+               options: OptimizationOptions | None = None) -> None:
+        """Compile the goal chain for this model's shapes (and these
+        options — fast_mode compiles a different chain) ahead of time, all
+        passes in parallel (see ``CompiledGoalChain.warmup``). Safe to call
+        from a background thread at server startup; a subsequent
+        ``optimize`` with the same shapes pays no XLA compile."""
+        options = options or OptimizationOptions()
+        _cfg, _goals, chain, ctx, state = self._prepare(model, metadata,
+                                                        options)
+        chain.warmup(state, ctx, jax.random.PRNGKey(options.seed))
+
+    def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
+                 options: OptimizationOptions | None = None
+                 ) -> OptimizerResult:
+        options = options or OptimizationOptions()
+        t0 = time.monotonic()
+        cfg, goals, chain, ctx, state = self._prepare(model, metadata,
+                                                      options)
         key = jax.random.PRNGKey(options.seed)
+
+        # First use of this (shapes, goal-chain) pairing: compile all
+        # passes in parallel instead of paying serial XLA compiles one
+        # goal at a time as the chain walks (tens of minutes for a full
+        # default chain on TPU; the persistent compilation cache then
+        # makes later processes skip XLA entirely). No-op once warmed.
+        chain.warmup(state, ctx, key)
 
         # One violation stack per goal boundary: stack[i] before goal i runs
         # doubles as stack[j<i] "after" readings (matches the per-goal stats

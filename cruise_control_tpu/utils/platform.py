@@ -56,13 +56,57 @@ def probe_default_backend(timeout_s: float = 120.0) -> str | None:
     return None
 
 
+#: Repo-local persistent XLA compilation cache. A 15-goal chain costs
+#: ~20-40 min of XLA compile on TPU the first time; the cache turns every
+#: later process's cold start into a disk read. Kept inside the repo tree
+#: (gitignored) because this deployment must not write outside it.
+DEFAULT_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), ".jax_cache")
+
+
+def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    Must run before the first compilation to catch everything, but is safe
+    any time. Returns the cache directory in use, or None when no writable
+    location exists (cache disabled, never a startup crash — the package
+    dir is read-only under system installs).
+    """
+    import tempfile
+    candidates = [c for c in (
+        cache_dir, os.environ.get("JAX_COMPILATION_CACHE_DIR"),
+        DEFAULT_CACHE_DIR,
+        os.path.join(tempfile.gettempdir(), "cruise_control_tpu_xla_cache"),
+    ) if c]
+    for d in candidates:
+        try:
+            os.makedirs(d, exist_ok=True)
+            probe = os.path.join(d, ".writable")
+            with open(probe, "w", encoding="utf-8"):
+                pass
+            os.unlink(probe)
+        except OSError:
+            continue
+        import jax
+        jax.config.update("jax_compilation_cache_dir", d)
+        # Cache everything that took meaningful compile time; the default
+        # (1 s + min entry size) skips the many small passes a chain has.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        return d
+    return None
+
+
 def ensure_live_backend(timeout_s: float = 120.0) -> str:
     """Probe the default backend; fall back to CPU if it is unreachable.
 
     Must be called before the first array op. Returns the platform in use.
+    Also enables the persistent compilation cache — every entry point that
+    cares about backend health cares about cold-start latency too.
     """
     respect_env_platforms()
     import jax
+    enable_compilation_cache()
     platform = probe_default_backend(timeout_s)
     if platform is None:
         jax.config.update("jax_platforms", "cpu")

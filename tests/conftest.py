@@ -25,3 +25,10 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
+
+# Persistent compilation cache: re-runs of the suite skip XLA compilation
+# entirely (same mechanism production entry points use via
+# utils.platform.enable_compilation_cache).
+from cruise_control_tpu.utils.platform import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
