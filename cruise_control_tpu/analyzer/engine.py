@@ -188,6 +188,25 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
     return run
 
 
+def make_chain_step(goals: Sequence[GoalKernel], cfg: SearchConfig):
+    """Compose the per-goal passes into one jittable
+    ``step(state, ctx, key) -> (state, violations)`` — the whole-chain
+    building block shared by the multi-branch search, the multichip
+    dryrun, and tests (each pass still enforces acceptance by all earlier
+    goals, so the composition preserves the lexicographic chain)."""
+    passes = [make_goal_pass(g, list(goals[:i]), cfg,
+                             all_goals=list(goals))
+              for i, g in enumerate(goals)]
+
+    def step(state, ctx, key):
+        stack = None
+        for i, p in enumerate(passes):
+            state, _, stack = p(state, ctx, jax.random.fold_in(key, i))
+        return state, stack
+
+    return step
+
+
 class CompiledGoalChain:
     """Per-goal jitted passes for one (goal chain, config) pair.
 

@@ -8,8 +8,11 @@ are scatter-adds from sharded [P, R] arrays into replicated [B1, ...] rows
 (an implicit psum), and candidate top-k runs shard-local then gathers.
 """
 
+from .branches import (BRANCH_AXIS, make_branch_mesh, make_branched_search,
+                       select_best)
 from .sharding import (PARTITION_AXIS, make_mesh, model_shardings,
                        shard_model, sharded_state_shardings)
 
 __all__ = ["PARTITION_AXIS", "make_mesh", "model_shardings", "shard_model",
-           "sharded_state_shardings"]
+           "sharded_state_shardings", "BRANCH_AXIS", "make_branch_mesh",
+           "make_branched_search", "select_best"]

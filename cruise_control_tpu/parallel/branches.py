@@ -1,0 +1,99 @@
+"""Multi-slice scale-out: independent search branches over a device mesh.
+
+SURVEY §5.8(b): within a slice, the partition axis shards over ICI
+(:mod:`.sharding`); *across* slices — where DCN latency would throttle the
+per-iteration broker-aggregate all-reduces — the right decomposition is
+independent *search branches*: every slice runs the full goal-chain search
+on a replicated model with its own PRNG stream, and the best final state
+by lexicographic violation wins. This replaces the reference's
+proposal-precompute thread pool (``num.proposal.precompute.threads``,
+``GoalOptimizer.java:112-119`` — N goal-chain runs on cloned models, best
+result cached) with N device-resident branches.
+
+Implemented with ``shard_map`` over a ``branch`` mesh axis: inputs
+replicate, each branch derives its seed from ``axis_index``, and no
+collective crosses branches until the final violation comparison — so
+branch divergence (different per-branch iteration counts) is legal and
+DCN sees exactly one sync at the end.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map   # jax >= 0.8
+    _CHECK_KW = "check_vma"
+except ImportError:   # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(fn, **kwargs):
+    # axis_index-derived seeds make outputs intentionally non-replicated;
+    # the replication checker must be off (kwarg renamed across versions).
+    kwargs[_CHECK_KW] = False
+    return _shard_map(fn, **kwargs)
+
+from ..analyzer.constraint import SearchConfig
+from ..analyzer.engine import make_chain_step
+from ..analyzer.goals import GoalKernel
+
+BRANCH_AXIS = "branch"
+
+
+def make_branch_mesh(n_branches: int | None = None) -> Mesh:
+    """One mesh axis over slices/devices, one branch per entry.
+
+    On real multi-slice hardware pass the per-slice device groups; on a
+    single host this fans branches across local devices.
+    """
+    devices = jax.devices()
+    n = n_branches or len(devices)
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices for {n} branches, "
+                         f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:n]), (BRANCH_AXIS,))
+
+
+def make_branched_search(goals: Sequence[GoalKernel], cfg: SearchConfig,
+                         mesh: Mesh):
+    """Build ``run(state, ctx, key) -> (states, violations)`` where branch
+    ``i`` holds ``states[i]`` (leading branch dim) and
+    ``violations[i, g]`` its final per-goal residuals. Use
+    :func:`select_best` to pick the winner."""
+    chain = make_chain_step(goals, cfg)
+
+    def branch(state, ctx, key):
+        idx = jax.lax.axis_index(BRANCH_AXIS)
+        st, stack = chain(state, ctx, jax.random.fold_in(key, idx))
+        # Leading branch dim of size 1 per shard -> global [n_branches, ...]
+        return (jax.tree.map(lambda x: x[None], st), stack[None])
+
+    def run(state, ctx, key):
+        in_specs = (jax.tree.map(lambda _: P(), state),
+                    jax.tree.map(lambda _: P(), ctx), P())
+        out_specs = (jax.tree.map(lambda _: P(BRANCH_AXIS), state),
+                     P(BRANCH_AXIS))
+        fn = shard_map(branch, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs)
+        return fn(state, ctx, key)
+
+    return jax.jit(run)
+
+
+def select_best(states, violations):
+    """Pick the branch whose violation stack wins lexicographically
+    (earlier goals dominate — same ordering the sequential chain
+    enforces); ties break toward the lower branch index so results stay
+    deterministic."""
+    v = np.asarray(jax.device_get(violations))   # [n_branches, n_goals]
+    order = sorted(range(v.shape[0]), key=lambda i: (tuple(v[i]), i))
+    best = order[0]
+    state = jax.tree.map(lambda x: x[best], states)
+    return state, best, v[best]
