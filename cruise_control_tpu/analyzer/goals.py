@@ -892,7 +892,7 @@ class TopicReplicaDistributionGoal(GoalKernel):
         P, R = state.rb.shape
         B1 = tc.shape[1]
         K = min(cfg.num_replica_candidates, P * R)
-        krep, kdst = jax.random.split(key)
+        krep, kdst, kswap = jax.random.split(key, 3)
         prio = prio + jnp.where(jnp.isfinite(prio),
                                 _noise(krep, prio.shape, cfg.noise_scale), 0.0)
         vals, idx = jax.lax.top_k(prio.reshape(-1), K)
@@ -903,7 +903,77 @@ class TopicReplicaDistributionGoal(GoalKernel):
                  + count_headroom[None, :]
                  + _noise(kdst, (K, B1), cfg.noise_scale))
         dst, ok = _legal_dest_argmax(state, ctx, p, score)
-        return make_move_candidates(state, ctx, p, r, dst, sel & ok)
+        out = make_move_candidates(state, ctx, p, r, dst, sel & ok)
+        if cfg.num_swap_candidates > 0:
+            out = concat_candidates(
+                out, self._swap_candidates(state, ctx, kswap, cfg, upper))
+        return out
+
+    def _swap_candidates(self, state, ctx, key, cfg, upper):
+        """Heavy-for-light topic swaps with *topic-matched* pairing. Once
+        earlier resource goals have converged, a plain move of an
+        over-represented topic's replica is usually vetoed (it pushes the
+        destination's utilization over its tight bound — same bind as
+        `ResourceDistributionGoal`'s count-pinned brokers, ref
+        ResourceDistributionGoal.java:689). So: each heavy replica (cell
+        above upper) picks the destination broker where its own topic is
+        scarcest, then trades against that broker's best light replica
+        (one per broker per iteration via segment-argmax, noise-rotated so
+        partners vary across iterations). Exact cell deltas still reject
+        any non-improving pairing."""
+        tc = state.topic_counts.astype(jnp.float32)          # [T, B1]
+        t_of_p = ctx.partition_topic
+        P, R = state.rb.shape
+        B1 = tc.shape[1]
+        K = min(cfg.num_swap_candidates, P * R)
+        src_b = state.rb
+        # Raw (un-steered) mask like the resource goals' swap side: swaps
+        # are resource-neutral for earlier goals, so steering is moot.
+        swappable = ctx.movable & ~state.offline & ctx.raw_dest_allowed[src_b]
+        src_over = jnp.maximum(tc - upper[:, None], 0.0)[t_of_p[:, None],
+                                                         src_b]
+        kh, kl, kd = jax.random.split(key, 3)
+        hprio = jnp.where(swappable & (src_over > 0.0),
+                          _TIER_EXCESS + _norm01(src_over), _NEG)
+        hprio = hprio + jnp.where(jnp.isfinite(hprio),
+                                  _noise(kh, hprio.shape, cfg.noise_scale),
+                                  0.0)
+        hv, hidx = jax.lax.top_k(hprio.reshape(-1), K)
+        p1, r1 = hidx // R, hidx % R
+        t1 = t_of_p[p1]                                      # [K]
+
+        # One light partner per broker: segment-argmax of a noise-rotated
+        # score over in-bounds replicas, keyed by their broker.
+        light = (swappable & (src_over <= 0.0)).reshape(-1)
+        lraw = jnp.where(light, jax.random.uniform(kl, (P * R,)), -jnp.inf)
+        broker_of = src_b.reshape(-1)
+        best_val = jax.ops.segment_max(lraw, broker_of, num_segments=B1)
+        slots = jnp.arange(P * R, dtype=jnp.int32)
+        best_slot = jax.ops.segment_max(
+            jnp.where(jnp.isfinite(lraw) & (lraw == best_val[broker_of]),
+                      slots, -1),
+            broker_of, num_segments=B1)                      # [B1]
+        has_light = best_slot >= 0
+
+        # Destination: the broker where this heavy candidate's topic is
+        # scarcest (and that can actually offer a partner). Masked against
+        # the RAW destination filter — swaps are count/load-neutral, so a
+        # broker the steering excluded (e.g. pinned at its replica-count
+        # ceiling) is still a legitimate swap destination — and against
+        # brokers already hosting the partition.
+        row = state.rb[p1]                                   # [K, R]
+        hosting = jnp.zeros((K, B1), bool).at[
+            jnp.arange(K)[:, None], row].set(True, mode="drop")
+        scarcity = _norm01(-tc)[t1]                          # [K, B1]
+        score = jnp.where(
+            has_light[None, :] & ctx.raw_dest_allowed[None, :] & ~hosting,
+            scarcity + _noise(kd, (K, B1), cfg.noise_scale), -jnp.inf)
+        dst = jnp.argmax(score, axis=1).astype(jnp.int32)
+        ok = jnp.isfinite(jnp.max(score, axis=1))
+        partner = best_slot[dst]                             # [K]
+        p2, r2 = partner // R, partner % R
+        valid = jnp.isfinite(hv) & ok & (partner >= 0)
+        return make_swap_candidates(state, ctx, p1, r1, p2, r2, valid)
 
     def _cell_deltas(self, ctx, c):
         """Per-candidate topic-count deltas on the four (topic, broker)

@@ -81,6 +81,41 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
 }
 
 
+def api_explorer_html(base_path: str = "/kafkacruisecontrol") -> str:
+    """Self-contained HTML API explorer served at the web root (the
+    stand-in for the reference's swagger-ui ``webroot/`` — this
+    environment cannot ship swagger's JS assets, so the page renders the
+    same endpoint/parameter tables directly)."""
+    rows = []
+    for name, (method, summary, extra) in sorted(ENDPOINTS.items()):
+        params = ", ".join(p for p, _, _ in extra) or "—"
+        rows.append(
+            f"<tr><td><code>{method.upper()}</code></td>"
+            f"<td><code>{base_path}/{name}</code></td>"
+            f"<td>{summary}</td><td><small>{params}</small></td></tr>")
+    return f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>cruise-control-tpu API</title>
+<style>
+ body {{ font: 14px/1.5 system-ui, sans-serif; margin: 2rem auto;
+        max-width: 72rem; padding: 0 1rem; color: #1a1a1a; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ th, td {{ text-align: left; padding: .4rem .6rem;
+           border-bottom: 1px solid #ddd; vertical-align: top; }}
+ code {{ background: #f4f4f4; padding: 0 .25rem; border-radius: 3px; }}
+</style></head><body>
+<h1>cruise-control-tpu</h1>
+<p>TPU-native Cruise Control. Machine-readable spec:
+<a href="{base_path}/openapi">{base_path}/openapi</a> · state:
+<a href="{base_path}/state">{base_path}/state</a></p>
+<table><tr><th>Method</th><th>Path</th><th>Summary</th>
+<th>Parameters</th></tr>
+{"".join(rows)}
+</table>
+<p><small>Async POSTs return a <code>User-Task-ID</code> header; poll by
+re-issuing the request with that header. See docs/rest-api.md.</small></p>
+</body></html>"""
+
+
 def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
     paths: dict[str, dict] = {}
     for name, (method, summary, extra) in ENDPOINTS.items():

@@ -365,6 +365,25 @@ def _make_handler(app: CruiseControlApp):
         def _serve(self, method: str):
             parsed = urlparse(self.path)
             parts = [p for p in parsed.path.split("/") if p]
+            # Root: a self-contained API explorer (the stand-in for the
+            # reference's swagger-ui webroot — no external assets here).
+            # Gated by the same security provider as the endpoints it
+            # documents (VIEWER, like the openapi spec itself).
+            if method == "GET" and parts in ([], ["kafkacruisecontrol"]):
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                try:
+                    check_access(app.security, "openapi", headers)
+                except AuthorizationError as e:
+                    self._send(e.status, {"errorMessage": str(e)})
+                    return
+                from .openapi import api_explorer_html
+                body = api_explorer_html().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             # paths: /kafkacruisecontrol/<endpoint>
             if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
                 self._send(404, {"errorMessage": f"bad path {parsed.path}"})

@@ -33,18 +33,18 @@ except ImportError:   # pragma: no cover — older jax
     from jax.experimental.shard_map import shard_map as _shard_map
     _CHECK_KW = "check_rep"
 
+from ..analyzer.constraint import SearchConfig
+from ..analyzer.engine import make_chain_step
+from ..analyzer.goals import GoalKernel
+
+BRANCH_AXIS = "branch"
+
 
 def shard_map(fn, **kwargs):
     # axis_index-derived seeds make outputs intentionally non-replicated;
     # the replication checker must be off (kwarg renamed across versions).
     kwargs[_CHECK_KW] = False
     return _shard_map(fn, **kwargs)
-
-from ..analyzer.constraint import SearchConfig
-from ..analyzer.engine import make_chain_step
-from ..analyzer.goals import GoalKernel
-
-BRANCH_AXIS = "branch"
 
 
 def make_branch_mesh(n_branches: int | None = None) -> Mesh:
