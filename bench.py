@@ -34,9 +34,17 @@ GOALS = ["ReplicaDistributionGoal", "DiskUsageDistributionGoal",
 #: target instead of a greedy run.
 SCALE_SCENARIOS = {
     3: dict(brokers=1000, partitions=200_000, rf=2, goals=None,
-            metric="rebalance_proposal_wall_clock_1kx200k", target_s=30.0),
+            metric="rebalance_proposal_wall_clock_1kx200k", target_s=30.0,
+            k=1024),
+    # Candidate batch scaled with the move budget AND the platform: a
+    # 10K x 1M skew needs ~500K moves, so 1K-candidate iterations are
+    # iteration-bound (~400 iters, 78 s CPU). 4K candidates cut the
+    # iteration count ~4x, but the apply stage's [M, M] conflict/guard
+    # matmuls grow quadratically — nearly free on the MXU, dominant on
+    # CPU (measured 144 s) — so the batch is sized per backend.
     4: dict(brokers=10_000, partitions=1_000_000, rf=2, goals=GOALS,
-            metric="rebalance_proposal_wall_clock_10kx1m", target_s=30.0),
+            metric="rebalance_proposal_wall_clock_10kx1m", target_s=30.0,
+            k=1024, k_tpu=4096),
 }
 
 
@@ -229,10 +237,13 @@ def run_scale_scenario(n: int):
         f"({P / max(ingest_s, 1e-9) / 1e6:.2f}M samples/s)")
 
     goals = goals_by_name(cfgd["goals"]) if cfgd["goals"] else None
+    import jax
+    on_tpu = jax.devices()[0].platform == "tpu"
+    k = cfgd.get("k_tpu", cfgd["k"]) if on_tpu else cfgd["k"]
     opt = TpuGoalOptimizer(
         goals=goals,
-        config=SearchConfig(num_replica_candidates=1024,
-                            num_dest_candidates=16, apply_per_iter=1024,
+        config=SearchConfig(num_replica_candidates=k,
+                            num_dest_candidates=16, apply_per_iter=k,
                             max_iters_per_goal=512))
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(
