@@ -240,10 +240,14 @@ def run_scale_scenario(n: int):
     import jax
     on_tpu = jax.devices()[0].platform == "tpu"
     k = cfgd.get("k_tpu", cfgd["k"]) if on_tpu else cfgd["k"]
+    # Drain batch sized so a few rounds cover the whole expected move
+    # count (~half the replicas in the skewed build).
+    drain = max(cfgd["partitions"] // 8, 16384)
     opt = TpuGoalOptimizer(
         goals=goals,
         config=SearchConfig(num_replica_candidates=k,
                             num_dest_candidates=16, apply_per_iter=k,
+                            drain_batch=drain, drain_rounds=8,
                             max_iters_per_goal=512))
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(

@@ -77,6 +77,16 @@ class SearchConfig:
     #: count-neutral, escaping replica-count lexicographic dead-ends.
     num_swap_candidates: int = 128
     apply_per_iter: int = 256
+    #: bulk-drain prologue (interval goals with replica-move actions): each
+    #: round sheds up to this many excess replicas into receiver budgets
+    #: computed by prefix-sum — conflict-free by construction, so the whole
+    #: batch applies in one scatter without the [M, M] conflict machinery.
+    #: The budgets bound aggregate intake analytically; per-candidate
+    #: legality/acceptance still filters individually.
+    drain_batch: int = 16384
+    #: max bulk-drain rounds before the fine-grained loop takes over (the
+    #: loop also exits early once a round applies almost nothing).
+    drain_rounds: int = 12
     #: conflict-resolution rounds per iteration; candidates still blocked
     #: after this many rounds are deferred to the next iteration.
     apply_groups: int = 64
@@ -101,5 +111,7 @@ class SearchConfig:
         d = min(self.num_dest_candidates, max(2, num_brokers))
         s = min(self.num_swap_candidates, k)
         m = min(self.apply_per_iter, k + s)
+        db = min(self.drain_batch, max(8, num_partitions))
         return replace(self, num_replica_candidates=k, num_dest_candidates=d,
-                       num_swap_candidates=s, apply_per_iter=m)
+                       num_swap_candidates=s, apply_per_iter=m,
+                       drain_batch=db)

@@ -158,6 +158,38 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
     def run(state: SearchState, ctx: SearchContext, key: jax.Array):
         patience = cfg.stall_patience
 
+        if goal.supports_bulk_drain and cfg.drain_rounds > 0:
+            # Vectorized shedding prologue: each round applies up to
+            # drain_batch conflict-free moves in one scatter (sources are
+            # partition-disjoint, receiver intake bounded analytically by
+            # the budgets), so a 500K-move skew drains in a handful of
+            # rounds instead of max_iters_per_goal candidate iterations.
+            # Per-candidate legality + earlier-goal acceptance still gate
+            # each move; the fine loop below finishes the tail.
+            min_applied = max(cfg.drain_batch // 64, 8)
+
+            def dcond(carry):
+                _, r, applied = carry
+                return (r < cfg.drain_rounds) & (applied >= min_applied)
+
+            def dbody(carry):
+                state, r, _ = carry
+                # Steered context: receiver budgets only on brokers every
+                # earlier goal is willing to see gain a replica — otherwise
+                # the fill routes moves straight into acceptance vetoes
+                # (e.g. count-full brokers once ReplicaDistribution ran).
+                c = goal.bulk_drain(state, steer_ctx(state, ctx),
+                                    jax.random.fold_in(key, 70_000 + r),
+                                    cfg)
+                elig = eligibility(state, ctx, c)
+                state = apply_group(state, ctx, c, elig)
+                return state, r + 1, elig.sum(dtype=jnp.int32)
+
+            state, _, _ = jax.lax.while_loop(
+                dcond, dbody,
+                (state, jnp.zeros((), jnp.int32),
+                 jnp.full((), jnp.iinfo(jnp.int32).max, jnp.int32)))
+
         def cond(carry):
             _, it, stalls = carry
             return (stalls < patience) & (it < cfg.max_iters_per_goal)

@@ -146,6 +146,9 @@ class GoalKernel:
     hard: bool = False
     uses_topic_counts: bool = False
     uses_topic_leader_counts: bool = False
+    #: goals that implement ``bulk_drain`` (the engine's vectorized
+    #: excess-shedding prologue) set this True
+    supports_bulk_drain: bool = False
 
     def violation(self, state: SearchState, ctx: SearchContext) -> jax.Array:
         raise NotImplementedError
@@ -384,6 +387,176 @@ class IntervalGoal(GoalKernel):
         if self.metric[0] in ("count", "leaders"):
             return values + 1.0 <= up
         return values < up
+
+    # -- bulk drain ------------------------------------------------------
+    @property
+    def supports_bulk_drain(self) -> bool:
+        # Replica-move goals over additive per-replica metrics: shedding is
+        # a pure assignment problem the prefix-sum fill solves exactly.
+        return self.actions == "replica" and self.metric[0] in ("count",
+                                                                "util")
+
+    def _replica_drain_weight(self, ctx: SearchContext,
+                              rb: jax.Array) -> jax.Array:
+        """f32[P, R] — each replica's contribution to this goal's metric."""
+        which, res = self.metric
+        P, R = rb.shape
+        if which == "count":
+            return jnp.ones((P, R), jnp.float32)
+        is_leader = (jnp.arange(R) == 0)[None, :]
+        return jnp.where(is_leader, ctx.leader_load[:, int(res)][:, None],
+                         ctx.follower_load[:, int(res)][:, None])
+
+    def bulk_drain(self, state: SearchState, ctx: SearchContext, key,
+                   cfg: SearchConfig) -> Candidates:
+        """One round of vectorized excess-shedding: up to ``cfg.drain_batch``
+        partition-disjoint move candidates, sources ranked heaviest-first
+        within each over-upper (or dead) broker, destinations assigned by a
+        prefix-sum fill over receiver budgets. The budgets analytically
+        bound aggregate intake for THIS goal's metric, for the replica-
+        count ceiling, and — via the batch-max per-unit load — for every
+        capacity hard-goal. Earlier SOFT goals' balance bounds are only
+        enforced per candidate (round-start values), so a bulk round may
+        drift them within one batch; the optimizer's polish passes re-zero
+        that drift, which is the documented contract of this fast path.
+        Per-candidate legality/acceptance (the engine's eligibility) still
+        filters individually; dropped slots retry next round with fresh
+        tie-break noise.
+
+        Host-side greedy sheds one replica per step
+        (``AbstractGoal.java:98-103``); this is the same policy solved as
+        an assignment in O(P·R log) sort work per round."""
+        N = cfg.drain_batch
+        values = metric_values(state, self.metric)               # [B1]
+        lower, upper = self.bounds(state, ctx)
+        up = jnp.broadcast_to(jnp.asarray(upper, values.dtype), values.shape)
+        alive = ctx.broker_alive
+        excess = jnp.where(alive, jnp.maximum(values - up, 0.0), values)
+        if self.upper_only:
+            deficit = jnp.zeros_like(values)
+        else:
+            lo = jnp.broadcast_to(jnp.asarray(lower, values.dtype),
+                                  values.shape)
+            deficit = jnp.where(alive, jnp.maximum(lo - values, 0.0), 0.0)
+        # Shed quota per broker: the hard over-upper excess, plus — while
+        # under-lower deficits remain beyond what that excess can fill —
+        # a pro-rata share of above-average brokers' surplus (the fine
+        # loop's "deficit-assist" tier, vectorized).
+        n_alive = jnp.maximum(alive.sum(), 1)
+        avg = jnp.where(ctx.broker_valid, values, 0.0).sum() / n_alive
+        need = jnp.maximum(deficit.sum() - excess.sum(), 0.0)
+        pool = jnp.where(alive & (excess <= 0.0),
+                         jnp.maximum(values - avg, 0.0), 0.0)
+        scale = jnp.minimum(need / jnp.maximum(pool.sum(), 1e-9), 1.0)
+        quota = excess + pool * scale
+
+        P, R = state.rb.shape
+        B1 = values.shape[0]
+        src_b = state.rb
+        w = self._replica_drain_weight(ctx, state.rb)            # [P, R]
+        cand = ctx.movable & ((quota[src_b] > 0.0) | state.offline)
+
+        # Sort candidates by (broker, must-first, weight-desc-with-noise):
+        # heaviest replicas shed first, like the reference's sorted-replica
+        # walk; noise rotates ties across rounds.
+        noise = 1.0 + 0.01 * jax.random.uniform(key, (P, R))
+        flat_b = src_b.reshape(-1)
+        flat_w = w.reshape(-1)
+        flat_cand = cand.reshape(-1)
+        flat_must = state.offline.reshape(-1) & flat_cand
+        sort_w = jnp.where(flat_cand, flat_w * noise.reshape(-1), -1.0)
+        order = jnp.lexsort((-sort_w, ~flat_must, flat_b))
+        sb = flat_b[order]
+        sw = jnp.where(flat_cand[order], flat_w[order], 0.0)
+        smask = flat_cand[order]
+        smust = flat_must[order]
+
+        # Shed while the broker's cumulative shed (before this replica)
+        # is still below its quota; must-moves shed unconditionally.
+        cum = jnp.cumsum(sw)
+        per_b = jax.ops.segment_sum(sw, sb, num_segments=B1)
+        offset = jnp.cumsum(per_b) - per_b                       # [B1]
+        within_before = cum - sw - offset[sb]
+        take = smask & ((within_before < quota[sb]) | smust)
+
+        # Partition-disjoint: first taken slot per partition row only.
+        sp = (order // R).astype(jnp.int32)
+        pos = jnp.arange(P * R, dtype=jnp.int32)
+        first_pos = jnp.full((P,), P * R, jnp.int32).at[sp].min(
+            jnp.where(take, pos, P * R))
+        take = take & (first_pos[sp] == pos)
+
+        grank = (jnp.cumsum(take) - 1).astype(jnp.int32)
+        take = take & (grank < N)
+        tw = jnp.where(take, sw, 0.0)
+        total_w = jnp.maximum(tw.sum(), 1e-9)
+        n_take = jnp.maximum(take.sum().astype(jnp.float32), 1.0)
+
+        # Receiver budgets in metric units — on brokers the (possibly
+        # steered) destination mask allows — capped by (a) each resource's
+        # capacity headroom and (b) the replica-count balance ceiling, both
+        # scaled by this batch's mean per-unit load, so one bulk round
+        # cannot blow a capacity hard-goal or the count goal in aggregate.
+        budget = jnp.where(alive & ctx.dest_allowed & ctx.broker_valid,
+                           jnp.maximum(up - values, 0.0), 0.0)
+        loads = jnp.where((jnp.arange(R) == 0)[None, :, None],
+                          ctx.leader_load[:, None, :],
+                          ctx.follower_load[:, None, :])         # [P, R, 4]
+        sorted_loads = loads.reshape(-1, 4)[order]               # [P*R, 4]
+        # Per-unit load of each taken replica on every resource; the cap
+        # divides by the batch MAX (not mean): any subset with metric
+        # weight W then provably carries <= W * per_unit_max[res], so a
+        # hard CapacityGoal cannot be collectively exceeded even when
+        # this-goal-heavy replicas are correlated-heavy on another
+        # resource. Soft distribution bounds of earlier goals are NOT
+        # capped here — bounded drift there is repaired by the optimizer's
+        # polish passes (the documented drain contract).
+        ratio = sorted_loads / jnp.maximum(sw, 1e-9)[:, None]    # [P*R, 4]
+        per_unit_max = jnp.where(take[:, None], ratio, 0.0).max(axis=0)
+        cst = self.constraint
+        for res in range(4):
+            headroom = (cst.capacity_threshold[res]
+                        * ctx.broker_capacity[:, res]
+                        - state.util[:, res])
+            cap_units = jnp.maximum(headroom, 0.0) / jnp.maximum(
+                per_unit_max[res], 1e-9)
+            budget = jnp.minimum(budget, 0.9 * cap_units)
+        if self.metric[0] != "count":
+            cnt = state.replica_count.astype(jnp.float32)
+            cnt_total = jnp.where(ctx.broker_valid, cnt, 0.0).sum()
+            cnt_avg = cnt_total / n_alive
+            cnt_up = jnp.maximum(cnt_avg * cst.replica_balance_threshold,
+                                 jnp.ceil(cnt_avg))
+            mean_w = total_w / n_take
+            budget = jnp.minimum(budget,
+                                 jnp.maximum(cnt_up - cnt, 0.0) * mean_w)
+        budget = jnp.maximum(budget, 0.0)
+
+        # Prefix-sum fill over DEFICIT-FIRST receivers: under-lower brokers
+        # absorb before merely-below-upper ones (otherwise extra shed lands
+        # on whichever broker ids sort first and deficits persist). The
+        # replica with cumulative load c lands in the permuted receiver
+        # whose budget interval contains c + w/2.
+        perm = jnp.argsort(-deficit, stable=True).astype(jnp.int32)
+        cumB = jnp.cumsum(budget[perm])
+        target = jnp.cumsum(tw) - 0.5 * tw
+        pos_in_perm = jnp.searchsorted(cumB, target,
+                                       side="left").astype(jnp.int32)
+        dst = perm[jnp.minimum(pos_in_perm, B1 - 1)]
+        ok = take & (pos_in_perm < B1) & (target < cumB[B1 - 1])
+
+        # Scatter into the fixed-size candidate batch (slot = global rank;
+        # invalid rows park in the sentinel slot N).
+        slot = jnp.where(ok, grank, N)
+        p_out = jnp.zeros((N + 1,), jnp.int32).at[slot].set(sp)
+        r_out = jnp.zeros((N + 1,), jnp.int32).at[slot].set(
+            (order % R).astype(jnp.int32))
+        d_out = jnp.zeros((N + 1,), jnp.int32).at[slot].set(dst)
+        # Slot N is the discard row (only not-ok rows land there, and row N
+        # is sliced off), so v_out needs no explicit clear.
+        v_out = jnp.zeros((N + 1,), bool).at[slot].set(ok)
+        return make_move_candidates(state, ctx, p_out[:N], r_out[:N],
+                                    d_out[:N], v_out[:N])
 
     # -- candidate generation -------------------------------------------
     def propose(self, state, ctx, key, cfg):
