@@ -393,8 +393,17 @@ class IntervalGoal(GoalKernel):
     def supports_bulk_drain(self) -> bool:
         # Replica-move goals over additive per-replica metrics: shedding is
         # a pure assignment problem the prefix-sum fill solves exactly.
-        return self.actions == "replica" and self.metric[0] in ("count",
-                                                                "util")
+        # Purely leader-scoped metrics drain via bulk leadership transfers
+        # instead — count/disk-neutral, so converged earlier goals cannot
+        # veto them. "util"-metric goals with actions="both" (NW_OUT, CPU)
+        # deliberately stay on the fine loop: measured at 10Kx1M, their
+        # swap-heavy tail converges faster than a drain prologue whose
+        # transfers skew the very replica placement later polish must
+        # restore.
+        if self.actions == "replica" and self.metric[0] in ("count", "util"):
+            return True
+        return (self.actions in ("both", "leadership")
+                and self.metric[0] in ("leaders", "leader_nw_in"))
 
     def _replica_drain_weight(self, ctx: SearchContext,
                               rb: jax.Array) -> jax.Array:
@@ -407,8 +416,27 @@ class IntervalGoal(GoalKernel):
         return jnp.where(is_leader, ctx.leader_load[:, int(res)][:, None],
                          ctx.follower_load[:, int(res)][:, None])
 
+    def _leadership_drain_weight(self, ctx: SearchContext) -> jax.Array:
+        """f32[P] metric shed by transferring partition p's leadership off
+        its current leader."""
+        which, _res = self.metric
+        if which == "leaders":
+            return jnp.ones(ctx.partition_valid.shape, jnp.float32)
+        assert which == "leader_nw_in", which
+        return ctx.leader_load[:, int(Resource.NW_IN)]
+
     def bulk_drain(self, state: SearchState, ctx: SearchContext, key,
                    cfg: SearchConfig) -> Candidates:
+        """Dispatch: replica-move drain for replica-action goals,
+        leadership drain for purely leader-scoped metrics (the two
+        supports_bulk_drain arms are mutually exclusive)."""
+        if self.actions == "replica":
+            return self._replica_bulk_drain(state, ctx, key, cfg)
+        return self._leadership_bulk_drain(state, ctx, key, cfg,
+                                           self._leadership_drain_weight(ctx))
+
+    def _replica_bulk_drain(self, state: SearchState, ctx: SearchContext,
+                            key, cfg: SearchConfig) -> Candidates:
         """One round of vectorized excess-shedding: up to ``cfg.drain_batch``
         partition-disjoint move candidates, sources ranked heaviest-first
         within each over-upper (or dead) broker, destinations assigned by a
@@ -454,7 +482,11 @@ class IntervalGoal(GoalKernel):
         B1 = values.shape[0]
         src_b = state.rb
         w = self._replica_drain_weight(ctx, state.rb)            # [P, R]
-        cand = ctx.movable & ((quota[src_b] > 0.0) | state.offline)
+        # Zero-weight replicas (e.g. followers under a leader-attributed
+        # metric) can't reduce anything: taking them floods the batch with
+        # moves the delta check rejects and starves real candidates.
+        cand = (ctx.movable & ((w > 0.0) | state.offline)
+                & ((quota[src_b] > 0.0) | state.offline))
 
         # Sort candidates by (broker, must-first, weight-desc-with-noise):
         # heaviest replicas shed first, like the reference's sorted-replica
@@ -557,6 +589,100 @@ class IntervalGoal(GoalKernel):
         v_out = jnp.zeros((N + 1,), bool).at[slot].set(ok)
         return make_move_candidates(state, ctx, p_out[:N], r_out[:N],
                                     d_out[:N], v_out[:N])
+
+    def _leadership_bulk_drain(self, state: SearchState, ctx: SearchContext,
+                               key, cfg: SearchConfig,
+                               w_all: jax.Array) -> Candidates:
+        """Bulk leadership transfers off over-upper leader brokers onto
+        each partition's best-headroom follower broker, with two quota
+        passes (shed per source, intake per destination) so one round
+        cannot overshoot either side. Transfers don't move replicas, so
+        count/disk-converged earlier goals accept them freely — this is
+        what drains leader-scoped metrics (NW_OUT, CPU, leader counts)
+        once replica placement is pinned."""
+        N = cfg.drain_batch
+        values = metric_values(state, self.metric)               # [B1]
+        lower, upper = self.bounds(state, ctx)
+        up = jnp.broadcast_to(jnp.asarray(upper, values.dtype), values.shape)
+        alive = ctx.broker_alive
+        excess = jnp.where(alive, jnp.maximum(values - up, 0.0), values)
+        budget_b = jnp.where(alive & ctx.leader_dest_allowed
+                             & ctx.broker_valid,
+                             jnp.maximum(up - values, 0.0), 0.0)
+
+        P, R = state.rb.shape
+        B1 = values.shape[0]
+        src = state.rb[:, 0]                                     # [P]
+        w = jnp.maximum(w_all, 0.0)
+        # Dead-broker leaders are excluded: a transfer doesn't fix the dead
+        # replica (the replica drain / fine loop must relocate it), and
+        # such candidates' delta is 0 — they'd pass both quota passes and
+        # then be rejected wholesale, starving real transfers of budget.
+        can = (ctx.leadership_movable & ctx.partition_valid & alive[src]
+               & (excess[src] > 0.0) & (w > 0.0))
+
+        # Destination: the follower slot whose broker has the most intake
+        # headroom (receiving slot keeps the full replica; only leadership
+        # — and its metric load — moves).
+        fb = state.rb                                            # [P, R]
+        slot_ok = ((jnp.arange(R) != 0)[None, :] & (fb < B1 - 1)
+                   & alive[fb] & ctx.leader_dest_allowed[fb]
+                   & ~state.offline)
+        dscore = jnp.where(slot_ok, budget_b[fb], -jnp.inf)
+        r_sel = jnp.argmax(dscore, axis=1).astype(jnp.int32)
+        has_dst = jnp.isfinite(jnp.max(dscore, axis=1))
+        can = can & has_dst
+        dstb = fb[jnp.arange(P), r_sel]
+
+        noise = 1.0 + 0.01 * jax.random.uniform(key, (P,))
+        sort_w = jnp.where(can, w * noise, -1.0)
+
+        # Pass 1 — shed quota per source broker (heaviest transfers first).
+        o1 = jnp.lexsort((-sort_w, src))
+        sw1 = jnp.where(can[o1], w[o1], 0.0)
+        cum1 = jnp.cumsum(sw1)
+        per_src = jax.ops.segment_sum(sw1, src[o1], num_segments=B1)
+        off1 = jnp.cumsum(per_src) - per_src
+        before1 = cum1 - sw1 - off1[src[o1]]
+        t1_sorted = can[o1] & (before1 < excess[src[o1]])
+        take1 = jnp.zeros((P,), bool).at[o1].set(t1_sorted)
+
+        # Aggregate hard-capacity cap, like the replica drain: a transfer
+        # lands (leader_load - follower_load) on the destination across all
+        # resources; dividing each resource's capacity headroom by the
+        # batch-MAX per-unit delta bounds any subset's intake soundly.
+        dload = jnp.maximum(ctx.leader_load - ctx.follower_load, 0.0)  # [P,4]
+        ratio = dload / jnp.maximum(w, 1e-9)[:, None]
+        per_unit_max = jnp.where(take1[:, None], ratio, 0.0).max(axis=0)
+        cst = self.constraint
+        for res in range(4):
+            headroom = (cst.capacity_threshold[res]
+                        * ctx.broker_capacity[:, res]
+                        - state.util[:, res])
+            cap_units = jnp.maximum(headroom, 0.0) / jnp.maximum(
+                per_unit_max[res], 1e-9)
+            budget_b = jnp.minimum(budget_b, 0.9 * cap_units)
+        budget_b = jnp.maximum(budget_b, 0.0)
+
+        # Pass 2 — intake budget per destination broker.
+        sort_w2 = jnp.where(take1, w * noise, -1.0)
+        o2 = jnp.lexsort((-sort_w2, dstb))
+        sw2 = jnp.where(take1[o2], w[o2], 0.0)
+        cum2 = jnp.cumsum(sw2)
+        per_dst = jax.ops.segment_sum(sw2, dstb[o2], num_segments=B1)
+        off2 = jnp.cumsum(per_dst) - per_dst
+        before2 = cum2 - sw2 - off2[dstb[o2]]
+        t2_sorted = take1[o2] & (before2 < budget_b[dstb[o2]])
+
+        grank = (jnp.cumsum(t2_sorted) - 1).astype(jnp.int32)
+        ok = t2_sorted & (grank < N)
+        slot = jnp.where(ok, grank, N)
+        p_out = jnp.zeros((N + 1,), jnp.int32).at[slot].set(
+            o2.astype(jnp.int32))
+        r_out = jnp.zeros((N + 1,), jnp.int32).at[slot].set(r_sel[o2])
+        v_out = jnp.zeros((N + 1,), bool).at[slot].set(ok)
+        return make_leadership_candidates(state, ctx, p_out[:N], r_out[:N],
+                                          v_out[:N])
 
     # -- candidate generation -------------------------------------------
     def propose(self, state, ctx, key, cfg):
