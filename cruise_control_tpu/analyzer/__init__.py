@@ -16,7 +16,9 @@ from .goals import (GOAL_REGISTRY, CapacityGoal, GoalKernel,
                     TopicReplicaDistributionGoal, default_goals, goals_by_name)
 from .optimizer import (GoalResult, OptimizationFailureError,
                         OptimizerResult, TpuGoalOptimizer)
-from .options import OptimizationOptions
+from .options import (DefaultOptimizationOptionsGenerator,
+                      OptimizationOptions,
+                      OptimizationOptionsGenerator)
 
 __all__ = [
     "BalancingConstraint", "SearchConfig", "GoalKernel", "CapacityGoal",
@@ -26,6 +28,7 @@ __all__ = [
     "PreferredLeaderElectionGoal", "TopicReplicaDistributionGoal",
     "default_goals", "goals_by_name", "GOAL_REGISTRY",
     "TpuGoalOptimizer", "OptimizerResult", "GoalResult",
-    "OptimizationOptions",
+    "OptimizationOptions", "OptimizationOptionsGenerator",
+    "DefaultOptimizationOptionsGenerator",
     "OptimizationFailureError",
 ]

@@ -65,6 +65,34 @@ def _norm01(x: jax.Array, mask: jax.Array | None = None) -> jax.Array:
     return jnp.where(finite, (x - xmin) / span * 0.99, 0.0)
 
 
+def _segment_cum_before(weights: jax.Array, keys: jax.Array,
+                        num_segments: int) -> jax.Array:
+    """Per-element cumulative weight of EARLIER same-key elements, for
+    key-sorted inputs — the "take while cumulative-before < limit" basis
+    shared by every bulk-drain quota pass."""
+    cum = jnp.cumsum(weights)
+    per_key = jax.ops.segment_sum(weights, keys, num_segments=num_segments)
+    offset = jnp.cumsum(per_key) - per_key
+    return cum - weights - offset[keys]
+
+
+def _capacity_budget_cap(budget: jax.Array, per_unit_max: jax.Array,
+                         constraint: BalancingConstraint,
+                         broker_capacity: jax.Array,
+                         util: jax.Array) -> jax.Array:
+    """Cap per-broker intake budgets (metric units) by every resource's
+    capacity headroom divided by the batch-MAX per-unit load — any subset
+    with metric weight W then provably carries <= W * per_unit_max[res],
+    so one bulk round cannot collectively exceed a capacity hard-goal."""
+    for res in range(4):
+        headroom = (constraint.capacity_threshold[res]
+                    * broker_capacity[:, res] - util[:, res])
+        cap_units = jnp.maximum(headroom, 0.0) / jnp.maximum(
+            per_unit_max[res], 1e-9)
+        budget = jnp.minimum(budget, 0.9 * cap_units)
+    return jnp.maximum(budget, 0.0)
+
+
 def _legal_dest_argmax(state: SearchState, ctx: SearchContext,
                        p: jax.Array, score: jax.Array):
     """(dst[K], ok[K]) — per-candidate best destination from a [K, B1] score,
@@ -505,10 +533,7 @@ class IntervalGoal(GoalKernel):
 
         # Shed while the broker's cumulative shed (before this replica)
         # is still below its quota; must-moves shed unconditionally.
-        cum = jnp.cumsum(sw)
-        per_b = jax.ops.segment_sum(sw, sb, num_segments=B1)
-        offset = jnp.cumsum(per_b) - per_b                       # [B1]
-        within_before = cum - sw - offset[sb]
+        within_before = _segment_cum_before(sw, sb, B1)
         take = smask & ((within_before < quota[sb]) | smust)
 
         # Partition-disjoint: first taken slot per partition row only.
@@ -546,13 +571,8 @@ class IntervalGoal(GoalKernel):
         ratio = sorted_loads / jnp.maximum(sw, 1e-9)[:, None]    # [P*R, 4]
         per_unit_max = jnp.where(take[:, None], ratio, 0.0).max(axis=0)
         cst = self.constraint
-        for res in range(4):
-            headroom = (cst.capacity_threshold[res]
-                        * ctx.broker_capacity[:, res]
-                        - state.util[:, res])
-            cap_units = jnp.maximum(headroom, 0.0) / jnp.maximum(
-                per_unit_max[res], 1e-9)
-            budget = jnp.minimum(budget, 0.9 * cap_units)
+        budget = _capacity_budget_cap(budget, per_unit_max, cst,
+                                      ctx.broker_capacity, state.util)
         if self.metric[0] != "count":
             cnt = state.replica_count.astype(jnp.float32)
             cnt_total = jnp.where(ctx.broker_valid, cnt, 0.0).sum()
@@ -640,38 +660,25 @@ class IntervalGoal(GoalKernel):
         # Pass 1 — shed quota per source broker (heaviest transfers first).
         o1 = jnp.lexsort((-sort_w, src))
         sw1 = jnp.where(can[o1], w[o1], 0.0)
-        cum1 = jnp.cumsum(sw1)
-        per_src = jax.ops.segment_sum(sw1, src[o1], num_segments=B1)
-        off1 = jnp.cumsum(per_src) - per_src
-        before1 = cum1 - sw1 - off1[src[o1]]
+        before1 = _segment_cum_before(sw1, src[o1], B1)
         t1_sorted = can[o1] & (before1 < excess[src[o1]])
         take1 = jnp.zeros((P,), bool).at[o1].set(t1_sorted)
 
         # Aggregate hard-capacity cap, like the replica drain: a transfer
-        # lands (leader_load - follower_load) on the destination across all
-        # resources; dividing each resource's capacity headroom by the
-        # batch-MAX per-unit delta bounds any subset's intake soundly.
+        # lands (leader_load - follower_load) on the destination across
+        # all resources.
         dload = jnp.maximum(ctx.leader_load - ctx.follower_load, 0.0)  # [P,4]
         ratio = dload / jnp.maximum(w, 1e-9)[:, None]
         per_unit_max = jnp.where(take1[:, None], ratio, 0.0).max(axis=0)
-        cst = self.constraint
-        for res in range(4):
-            headroom = (cst.capacity_threshold[res]
-                        * ctx.broker_capacity[:, res]
-                        - state.util[:, res])
-            cap_units = jnp.maximum(headroom, 0.0) / jnp.maximum(
-                per_unit_max[res], 1e-9)
-            budget_b = jnp.minimum(budget_b, 0.9 * cap_units)
-        budget_b = jnp.maximum(budget_b, 0.0)
+        budget_b = _capacity_budget_cap(budget_b, per_unit_max,
+                                        self.constraint,
+                                        ctx.broker_capacity, state.util)
 
         # Pass 2 — intake budget per destination broker.
         sort_w2 = jnp.where(take1, w * noise, -1.0)
         o2 = jnp.lexsort((-sort_w2, dstb))
         sw2 = jnp.where(take1[o2], w[o2], 0.0)
-        cum2 = jnp.cumsum(sw2)
-        per_dst = jax.ops.segment_sum(sw2, dstb[o2], num_segments=B1)
-        off2 = jnp.cumsum(per_dst) - per_dst
-        before2 = cum2 - sw2 - off2[dstb[o2]]
+        before2 = _segment_cum_before(sw2, dstb[o2], B1)
         t2_sorted = take1[o2] & (before2 < budget_b[dstb[o2]])
 
         grank = (jnp.cumsum(t2_sorted) - 1).astype(jnp.int32)

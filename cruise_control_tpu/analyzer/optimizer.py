@@ -105,10 +105,16 @@ class TpuGoalOptimizer:
 
     def __init__(self, goals: list[GoalKernel] | None = None,
                  constraint: BalancingConstraint | None = None,
-                 config: SearchConfig | None = None):
+                 config: SearchConfig | None = None,
+                 options_generator=None):
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
+        #: OptimizationOptionsGenerator plugin applied to every run's
+        #: options inside _prepare — the single choke point, so the
+        #: proposal cache and the goal-violation detector (which call
+        #: optimize() directly, not through the facade) can't bypass it.
+        self.options_generator = options_generator
         self._chains: dict[tuple, CompiledGoalChain] = {}
 
     def _chain_for(self, cfg: SearchConfig, goals: list[GoalKernel]
@@ -124,6 +130,8 @@ class TpuGoalOptimizer:
         compiled-chain lookup, search context (with the request's exclusion
         masks) and initial state — one definition so a warmed chain is
         exactly the chain a matching optimize() will run."""
+        if self.options_generator is not None:
+            options = self.options_generator.generate(options, metadata)
         P = model.num_partitions_padded
         B = model.num_brokers_padded
         cfg = self.config.scaled_for(metadata.num_partitions,

@@ -78,3 +78,39 @@ class OptimizationOptions:
             inv = ~allowed
             excl = inv if excl is None else (excl | inv)
         return excl
+
+
+class OptimizationOptionsGenerator:
+    """Plugin SPI deriving per-run options from cluster state (ref
+    ``OptimizationOptionsGenerator.java`` /
+    ``DefaultOptimizationOptionsGenerator.java``): deployments override
+    this to e.g. auto-exclude system topics or newly-added brokers from
+    receiving leadership during goal-violation detection runs."""
+
+    def generate(self, base: OptimizationOptions,
+                 metadata: ClusterMetadata) -> OptimizationOptions:
+        raise NotImplementedError
+
+
+class DefaultOptimizationOptionsGenerator(OptimizationOptionsGenerator):
+    """Pass-through with an optional always-excluded topic pattern (the
+    reference's default excludes topics matching
+    ``topics.excluded.from.partition.movement``)."""
+
+    def __init__(self, excluded_topics_pattern: str | None = None):
+        self.excluded_topics_pattern = excluded_topics_pattern
+
+    def generate(self, base: OptimizationOptions,
+                 metadata: ClusterMetadata) -> OptimizationOptions:
+        if not self.excluded_topics_pattern:
+            return base
+        pattern = self.excluded_topics_pattern
+        if base.excluded_topics_pattern:
+            if pattern in base.excluded_topics_pattern:
+                return base   # already combined (idempotence)
+            # Combine: the config-level exclusion is "always excluded",
+            # it must survive a request that also excludes topics.
+            pattern = (f"(?:{base.excluded_topics_pattern})"
+                       f"|(?:{pattern})")
+        from dataclasses import replace
+        return replace(base, excluded_topics_pattern=pattern)

@@ -38,6 +38,7 @@ class KafkaCruiseControl:
                  optimizer: TpuGoalOptimizer | None = None,
                  executor: Executor | None = None,
                  detector=None,
+                 options_generator=None,
                  now_ms=None) -> None:
         self.admin = admin
         self.monitor = monitor
@@ -45,6 +46,13 @@ class KafkaCruiseControl:
         self.optimizer = optimizer or TpuGoalOptimizer()
         self.executor = executor or Executor(admin)
         self.detector = detector
+        #: OptimizationOptionsGenerator plugin (ref
+        #: DefaultOptimizationOptionsGenerator). Installed on the
+        #: optimizer itself so the proposal cache and detectors — which
+        #: call optimize() directly — go through it too.
+        self.options_generator = options_generator
+        if options_generator is not None:
+            self.optimizer.options_generator = options_generator
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         self.proposal_cache = ProposalCache(monitor, self.optimizer)
         self.cpu_model = LinearRegressionModelParameters()
@@ -85,7 +93,8 @@ class KafkaCruiseControl:
         else:
             model, metadata = result.model, result.metadata
         opt = (TpuGoalOptimizer(goals=goals_by_name(goals),
-                                config=self.optimizer.config)
+                                config=self.optimizer.config,
+                                options_generator=self.options_generator)
                if goals else self.optimizer)
         if progress:
             progress.add_step("OptimizationProposalCandidateComputation")

@@ -214,6 +214,16 @@ def _detector_defs(d: ConfigDef) -> None:
     d.define("anomaly.notifier.class", ConfigType.CLASS,
              "cruise_control_tpu.detector.notifier.SelfHealingNotifier",
              importance=Importance.MEDIUM, doc="AnomalyNotifier plugin")
+    d.define("optimization.options.generator.class", ConfigType.CLASS,
+             "cruise_control_tpu.analyzer.options."
+             "DefaultOptimizationOptionsGenerator",
+             importance=Importance.LOW,
+             doc="OptimizationOptionsGenerator plugin")
+    d.define("topics.excluded.from.partition.movement", ConfigType.STRING,
+             "", importance=Importance.MEDIUM,
+             doc="Regex of topics whose replicas never move "
+                 "(ref SELF_HEALING_EXCLUDED_TOPICS / "
+                 "DefaultOptimizationOptionsGenerator)")
     d.define("provisioner.class", ConfigType.CLASS,
              "cruise_control_tpu.detector.provisioner.BasicProvisioner",
              importance=Importance.LOW, doc="Provisioner plugin")

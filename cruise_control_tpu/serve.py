@@ -63,8 +63,23 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         goals=goals_by_name(goal_names, constraint) if goal_names else None,
         constraint=constraint, config=config.search_config())
     executor = Executor(admin, config.executor_config())
+    from .analyzer import DefaultOptimizationOptionsGenerator
+    gen_cls = load_class(config.get_string(
+        "optimization.options.generator.class"))
+    excl = config.get_string("topics.excluded.from.partition.movement")
+    if issubclass(gen_cls, DefaultOptimizationOptionsGenerator):
+        # The default (and subclasses inheriting its __init__) take the
+        # always-excluded pattern — never the config object, which its
+        # pattern parameter would silently swallow.
+        options_generator = gen_cls(excl or None)
+    else:
+        try:
+            options_generator = gen_cls(config)
+        except TypeError:
+            options_generator = gen_cls()
     facade = KafkaCruiseControl(admin, monitor, task_runner=runner,
-                                optimizer=optimizer, executor=executor)
+                                optimizer=optimizer, executor=executor,
+                                options_generator=options_generator)
 
     healing_on = config.get_boolean("self.healing.enabled")
 
