@@ -106,11 +106,14 @@ class DefaultOptimizationOptionsGenerator(OptimizationOptionsGenerator):
             return base
         pattern = self.excluded_topics_pattern
         if base.excluded_topics_pattern:
-            if pattern in base.excluded_topics_pattern:
-                return base   # already combined (idempotence)
+            # Idempotence by structure, not substring containment (a
+            # request pattern that merely CONTAINS the config text, e.g.
+            # 'mysystem-logs' vs 'sys', must still be combined).
+            suffix = f"|(?:{pattern})"
+            if base.excluded_topics_pattern.endswith(suffix):
+                return base
             # Combine: the config-level exclusion is "always excluded",
             # it must survive a request that also excludes topics.
-            pattern = (f"(?:{base.excluded_topics_pattern})"
-                       f"|(?:{pattern})")
+            pattern = f"(?:{base.excluded_topics_pattern}){suffix}"
         from dataclasses import replace
         return replace(base, excluded_topics_pattern=pattern)

@@ -39,6 +39,7 @@ class KafkaCruiseControl:
                  executor: Executor | None = None,
                  detector=None,
                  options_generator=None,
+                 cpu_model: LinearRegressionModelParameters | None = None,
                  now_ms=None) -> None:
         self.admin = admin
         self.monitor = monitor
@@ -46,16 +47,17 @@ class KafkaCruiseControl:
         self.optimizer = optimizer or TpuGoalOptimizer()
         self.executor = executor or Executor(admin)
         self.detector = detector
-        #: OptimizationOptionsGenerator plugin (ref
-        #: DefaultOptimizationOptionsGenerator). Installed on the
-        #: optimizer itself so the proposal cache and detectors — which
-        #: call optimize() directly — go through it too.
-        self.options_generator = options_generator
+        # OptimizationOptionsGenerator plugin (ref
+        # DefaultOptimizationOptionsGenerator), installed on the optimizer
+        # — the single source of truth — so the proposal cache and
+        # detectors, which call optimize() directly, go through it too.
         if options_generator is not None:
             self.optimizer.options_generator = options_generator
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         self.proposal_cache = ProposalCache(monitor, self.optimizer)
-        self.cpu_model = LinearRegressionModelParameters()
+        # Shared with the metrics processor so a TRAIN-fitted regression
+        # feeds CPU estimation for samples that lack broker CPU.
+        self.cpu_model = cpu_model or LinearRegressionModelParameters()
         self._lock = threading.RLock()
 
     # ----------------------------------------------------------- lifecycle
@@ -94,7 +96,8 @@ class KafkaCruiseControl:
             model, metadata = result.model, result.metadata
         opt = (TpuGoalOptimizer(goals=goals_by_name(goals),
                                 config=self.optimizer.config,
-                                options_generator=self.options_generator)
+                                options_generator=self.optimizer
+                                .options_generator)
                if goals else self.optimizer)
         if progress:
             progress.add_step("OptimizationProposalCandidateComputation")

@@ -49,6 +49,11 @@ def _monitor_defs(d: ConfigDef) -> None:
     d.define("metric.sampler.class", ConfigType.CLASS,
              "cruise_control_tpu.monitor.sampler.SyntheticWorkloadSampler",
              importance=Importance.HIGH, doc="MetricSampler plugin")
+    d.define("use.agent.metrics.pipeline", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Sample through the L0 reporter-agent pipeline (reporter "
+                 "-> metrics transport -> sampler -> processor) instead of "
+                 "the synthetic sampler")
     d.define("prometheus.server.endpoint", ConfigType.STRING, "",
              importance=Importance.MEDIUM,
              doc="When set, sample from this Prometheus server instead of "

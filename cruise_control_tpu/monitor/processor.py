@@ -43,15 +43,23 @@ class _BrokerLoad:
 
 
 class CruiseControlMetricsProcessor:
-    def __init__(self, metadata_source=None) -> None:
+    def __init__(self, metadata_source=None, cpu_model=None) -> None:
         """``metadata_source``: optional admin client
         (``describe_partitions``) used to attribute topic byte rates only to
         partitions the broker *leads* — the reference processor holds Kafka
         ``Cluster`` metadata for exactly this (SamplingUtils leadership
         checks). Without it, followers of a topic the broker also leads
-        would siphon off a share of the leader bytes."""
+        would siphon off a share of the leader bytes.
+
+        ``cpu_model``: optional fitted
+        :class:`~cruise_control_tpu.model.cpu_regression.LinearRegressionModelParameters`
+        (the TRAIN endpoint's output). When a broker's CPU metric is
+        missing from a round, CPU is estimated from its byte rates instead
+        of defaulting to 0 (ref ``ModelUtils.estimateLeaderCpuUtil`` with
+        ``use.linear.regression.model``)."""
         self._records: list[CruiseControlMetric] = []
         self._metadata_source = metadata_source
+        self._cpu_model = cpu_model
 
     def add_metrics(self, records: list[CruiseControlMetric]) -> None:
         self._records.extend(records)
@@ -83,6 +91,19 @@ class CruiseControlMetricsProcessor:
         bsamples: list[BrokerMetricSample] = []
         for broker_id, bl in loads.items():
             t = times[broker_id]
+            # Missing broker CPU: estimate from byte rates via the trained
+            # regression (TRAIN endpoint) rather than defaulting to 0 —
+            # both the broker sample and the per-partition CPU attribution
+            # then read the estimate (ref ModelUtils.estimateLeaderCpuUtil).
+            if (RawMetricType.BROKER_CPU_UTIL not in bl.broker_metrics
+                    and self._cpu_model is not None):
+                est = self._cpu_model.estimate(
+                    bl.broker_metrics.get(RawMetricType.ALL_TOPIC_BYTES_IN,
+                                          0.0),
+                    bl.broker_metrics.get(RawMetricType.ALL_TOPIC_BYTES_OUT,
+                                          0.0))
+                if est is not None:
+                    bl.broker_metrics[RawMetricType.BROKER_CPU_UTIL] = est
             bsamples.append(self._broker_sample(broker_id, t, bl))
             psamples.extend(self._partition_samples(broker_id, t, bl, wanted,
                                                     leader_of))

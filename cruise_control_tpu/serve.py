@@ -24,6 +24,7 @@ from .config.brokersets import FileBrokerSetResolver
 from .config.capacity import FileCapacityResolver, FixedCapacityResolver
 from .config.constants import CruiseControlConfig
 from .core.config import load_class, load_properties_file
+from .model.cpu_regression import LinearRegressionModelParameters
 from .detector import (AnomalyDetectorManager, BrokerFailureDetector,
                        DiskFailureDetector, GoalViolationDetector,
                        KafkaAnomalyType, MetricAnomalyDetector,
@@ -50,7 +51,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                           broker_set_resolver=broker_set_resolver)
     store_dir = config.get_string("sample.store.dir")
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
-    sampler = _make_sampler(config, admin)
+    cpu_model = LinearRegressionModelParameters()
+    sampler = _make_sampler(config, admin, cpu_model)
     fetcher = MetricFetcherManager(sampler,
                                    config.get_int("num.metric.fetchers"),
                                    store=store)
@@ -73,13 +75,15 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         # pattern parameter would silently swallow.
         options_generator = gen_cls(excl or None)
     else:
-        try:
-            options_generator = gen_cls(config)
-        except TypeError:
-            options_generator = gen_cls()
+        # Signature-based dispatch: a try/except TypeError would mask
+        # genuine TypeErrors raised inside a plugin's constructor body.
+        import inspect
+        params = inspect.signature(gen_cls).parameters
+        options_generator = gen_cls(config) if params else gen_cls()
     facade = KafkaCruiseControl(admin, monitor, task_runner=runner,
                                 optimizer=optimizer, executor=executor,
-                                options_generator=options_generator)
+                                options_generator=options_generator,
+                                cpu_model=cpu_model)
 
     healing_on = config.get_boolean("self.healing.enabled")
 
@@ -128,11 +132,48 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             "two.step.verification.enabled"))
 
 
-def _make_sampler(config: CruiseControlConfig, admin):
+class _AgentPipelineSampler:
+    """Drive the L0 reporter agents then consume their records — the demo
+    wiring of the full reporter -> metrics-topic -> sampler -> processor
+    path (a real deployment's agents run inside the brokers; here the
+    sampling tick doubles as the reporting tick)."""
+
+    def __init__(self, agents, inner):
+        self.agents = agents
+        self.inner = inner
+
+    def get_samples(self, assignment):
+        for a in self.agents:
+            # end_ms is exclusive in the processor's window filter; stamp
+            # the records just inside it.
+            a.maybe_report(assignment.end_ms - 1)
+        return self.inner.get_samples(assignment)
+
+
+def _make_sampler(config: CruiseControlConfig, admin, cpu_model=None):
     """Sampler selection: Prometheus scrape when an endpoint is configured,
-    else the default synthetic sampler (ref metric.sampler.class +
-    PrometheusMetricSampler configs)."""
+    the agent metrics pipeline when enabled, else the default synthetic
+    sampler (ref metric.sampler.class + PrometheusMetricSampler configs)."""
     endpoint = config.get_string("prometheus.server.endpoint")
+    if not endpoint and config.get_boolean("use.agent.metrics.pipeline"):
+        import zlib
+
+        from .monitor import AgentTopicSampler, CruiseControlMetricsProcessor
+        from .reporter import (MetricsReporterAgent, MetricsTransport,
+                               SimClusterMetricsSource)
+        rates = {tp: (25.0 + 75.0 * (zlib.crc32(repr(tp).encode()) % 1000)
+                      / 1000.0, 40.0)
+                 for tp in admin.describe_partitions()}
+        transport = MetricsTransport()
+        source = SimClusterMetricsSource(admin, rates)
+        interval = config.get_int("metric.sampling.interval.ms")
+        agents = [MetricsReporterAgent(b, source, transport,
+                                       reporting_interval_ms=interval)
+                  for b in sorted(admin.describe_cluster())]
+        processor = CruiseControlMetricsProcessor(admin,
+                                                  cpu_model=cpu_model)
+        return _AgentPipelineSampler(agents,
+                                     AgentTopicSampler(transport, processor))
     if not endpoint:
         return SyntheticWorkloadSampler(admin)
     import json as _json

@@ -236,3 +236,41 @@ def test_retain_current_topology_drops_stale_entities():
     assert ("gone", 0) in monitor.partition_aggregator.all_entities()
     monitor.retain_current_topology()
     assert ("gone", 0) not in monitor.partition_aggregator.all_entities()
+
+
+def test_processor_estimates_missing_cpu_via_regression():
+    """A TRAIN-fitted regression fills in missing broker CPU from byte
+    rates (ref ModelUtils.estimateLeaderCpuUtil + use.linear.regression)."""
+    from cruise_control_tpu.model.cpu_regression import (
+        LinearRegressionModelParameters)
+    cpu_model = LinearRegressionModelParameters()
+    # CPU = 0.1*in + 0.2*out exactly.
+    for i in range(1, 15):
+        cpu_model.add_observation(10.0 * i, 5.0 * i, 1.0 * i + 1.0 * i)
+    assert cpu_model.fit()
+    proc = CruiseControlMetricsProcessor(cpu_model=cpu_model)
+    records = [
+        CruiseControlMetric(RawMetricType.ALL_TOPIC_BYTES_IN, 100, 0, 40.0),
+        CruiseControlMetric(RawMetricType.ALL_TOPIC_BYTES_OUT, 100, 0, 20.0),
+        CruiseControlMetric(RawMetricType.TOPIC_BYTES_IN, 100, 0, 40.0,
+                            topic="t"),
+        CruiseControlMetric(RawMetricType.TOPIC_BYTES_OUT, 100, 0, 20.0,
+                            topic="t"),
+        CruiseControlMetric(RawMetricType.PARTITION_SIZE, 100, 0, 10.0,
+                            topic="t", partition=0),
+    ]
+    proc.add_metrics(records)
+    samples = proc.process(SamplerAssignment(
+        partitions=[("t", 0)], brokers=[0], start_ms=0, end_ms=200))
+    bs = {s.entity: s for s in samples.broker_samples}
+    est = bs[0].values[BrokerMetric.CPU_USAGE]
+    expected = cpu_model.estimate(40.0, 20.0)
+    assert expected is not None and est == pytest.approx(expected)
+    assert est > 0
+    # Without the model the same round records 0 CPU.
+    proc0 = CruiseControlMetricsProcessor()
+    proc0.add_metrics(records)
+    s0 = proc0.process(SamplerAssignment(
+        partitions=[("t", 0)], brokers=[0], start_ms=0, end_ms=200))
+    assert {s.entity: s for s in s0.broker_samples}[0].values.get(
+        int(BrokerMetric.CPU_USAGE), 0.0) == 0.0
