@@ -181,8 +181,11 @@ class TpuGoalOptimizer:
         chain.warmup(state, ctx, jax.random.PRNGKey(options.seed))
 
     def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
-                 options: OptimizationOptions | None = None
-                 ) -> OptimizerResult:
+                 options: OptimizationOptions | None = None,
+                 on_goal_start=None) -> OptimizerResult:
+        """``on_goal_start(goal_name)``: optional progress hook invoked as
+        each goal pass begins (the facade feeds OperationProgress with it —
+        ref the ``OptimizationForGoal`` steps in /user_tasks)."""
         options = options or OptimizationOptions()
         t0 = time.monotonic()
         cfg, goals, chain, ctx, state = self._prepare(model, metadata,
@@ -202,6 +205,8 @@ class TpuGoalOptimizer:
         goal_results: list[GoalResult] = []
         boundary = np.asarray(chain.violations(state, ctx))
         for i, (goal, gpass) in enumerate(zip(goals, chain.passes)):
+            if on_goal_start is not None:
+                on_goal_start(goal.name)
             g0 = time.monotonic()
             before_i = float(boundary[i])
             state, iters, stack = gpass(state, ctx,

@@ -94,14 +94,23 @@ class KafkaCruiseControl:
             model, metadata = flatten_spec(spec)
         else:
             model, metadata = result.model, result.metadata
-        opt = (TpuGoalOptimizer(goals=goals_by_name(goals),
+        # Goal-scoped requests inherit the server's balancing constraint —
+        # a request naming goals must not silently optimize against
+        # default thresholds (ref goalsByPriority resolution reusing the
+        # configured BalancingConstraint).
+        opt = (TpuGoalOptimizer(goals=goals_by_name(
+                                    goals, self.optimizer.constraint),
+                                constraint=self.optimizer.constraint,
                                 config=self.optimizer.config,
                                 options_generator=self.optimizer
                                 .options_generator)
                if goals else self.optimizer)
         if progress:
             progress.add_step("OptimizationProposalCandidateComputation")
-        return opt.optimize(model, metadata, options)
+        on_goal = ((lambda name: progress.add_step(f"OptimizationForGoal-"
+                                                   f"{name}"))
+                   if progress else None)
+        return opt.optimize(model, metadata, options, on_goal_start=on_goal)
 
     def _maybe_execute(self, res: OptimizerResult, dryrun: bool,
                        uuid: str, progress: OperationProgress | None,

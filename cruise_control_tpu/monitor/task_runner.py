@@ -87,7 +87,12 @@ class LoadMonitorTaskRunner:
                 return False
             self._state = RunnerState.SAMPLING
         try:
-            start = (now_ms if self._last_sample_ms is None
+            # First round covers one interval back: a [now, now) window
+            # would be empty, so window-filtered samplers (the agent
+            # pipeline, Prometheus range queries) could never deliver
+            # their first records.
+            start = (max(now_ms - self.sampling_interval_ms, 0)
+                     if self._last_sample_ms is None
                      else self._last_sample_ms)
             partitions = sorted(self.monitor.admin.describe_partitions())
             brokers = sorted(self.monitor.admin.describe_cluster())

@@ -151,9 +151,19 @@ class _AgentPipelineSampler:
 
 
 def _make_sampler(config: CruiseControlConfig, admin, cpu_model=None):
-    """Sampler selection: Prometheus scrape when an endpoint is configured,
-    the agent metrics pipeline when enabled, else the default synthetic
-    sampler (ref metric.sampler.class + PrometheusMetricSampler configs)."""
+    """Sampler selection, in precedence order: an explicit
+    ``metric.sampler.class`` plugin, a Prometheus scrape when
+    ``prometheus.server.endpoint`` is set, the agent metrics pipeline when
+    enabled, else the default synthetic sampler."""
+    cls_name = config.get_string("metric.sampler.class")
+    default_cls = "cruise_control_tpu.monitor.sampler.SyntheticWorkloadSampler"
+    if cls_name and cls_name != default_cls:
+        cls = load_class(cls_name)
+        import inspect
+        params = list(inspect.signature(cls).parameters)
+        if params[:1] == ["cluster"]:
+            return cls(admin)
+        return cls(config) if params else cls()
     endpoint = config.get_string("prometheus.server.endpoint")
     if not endpoint and config.get_boolean("use.agent.metrics.pipeline"):
         import zlib
