@@ -166,8 +166,21 @@ class _RawStore:
         return row
 
     def rows_for(self, entities: list[Hashable]) -> np.ndarray:
-        return np.fromiter((self.row_for(e) for e in entities), np.int64,
-                           len(entities))
+        # Steady state (every entity already known — the every-round case
+        # at LinkedIn scale) is one bulk dict.get per entity; only misses
+        # take the allocating slow path, after one up-front grow.
+        get = self._rows.get
+        out = np.fromiter((get(e, -1) for e in entities), np.int64,
+                          len(entities))
+        missing = out < 0
+        if missing.any():
+            idxs = np.nonzero(missing)[0]
+            need = len(self._rows) + len(idxs) - len(self._free)
+            if need > self.capacity:
+                self._grow(need)
+            for i in idxs:
+                out[i] = self.row_for(entities[i])
+        return out
 
     def get_row(self, entity: Hashable) -> int | None:
         return self._rows.get(entity)
@@ -213,15 +226,38 @@ class _RawStore:
         [N, num_metrics] (NaN = metric absent from the sample)."""
         present = ~np.isnan(values)
         vals = np.where(present, values, 0.0)
-        np.add.at(self.sums, (rows, slots), vals)
-        np.add.at(self.counts, (rows, slots), present.astype(np.int32))
-        np.maximum.at(self.maxes, (rows, slots),
-                      np.where(present, values, -np.inf))
-        np.add.at(self.sample_counts, (rows, slots), 1)
-        # Latest-wins: process in ascending time order so plain indexed
-        # assignment leaves the batch's newest value in place — then restore
-        # any pre-existing state that is newer still (late-arriving batches
-        # must not regress LATEST metrics, matching the scalar guard).
+        # One sample per (row, slot) — the every-round case — allows plain
+        # fancy-indexed accumulation, ~10x faster than the unbuffered
+        # np.ufunc.at scatter; duplicates fall back to the exact scatter.
+        S = self._num_slots
+        unique_targets = (len(np.unique(rows * S + slots)) == len(rows))
+        if unique_targets:
+            tgt2 = (rows, slots)
+            self.sums[tgt2] += vals
+            self.counts[tgt2] += present.astype(np.int32)
+            self.maxes[tgt2] = np.maximum(self.maxes[tgt2],
+                                          np.where(present, values, -np.inf))
+            self.sample_counts[tgt2] += 1
+        else:
+            np.add.at(self.sums, (rows, slots), vals)
+            np.add.at(self.counts, (rows, slots), present.astype(np.int32))
+            np.maximum.at(self.maxes, (rows, slots),
+                          np.where(present, values, -np.inf))
+            np.add.at(self.sample_counts, (rows, slots), 1)
+        # Latest-wins. Unique targets: one sample per cell, so a direct
+        # where() against the stored timestamps suffices (no ordering
+        # needed). Duplicates: process in ascending time order so plain
+        # indexed assignment leaves the batch's newest value in place —
+        # then restore any pre-existing state that is newer still
+        # (late-arriving batches must not regress LATEST metrics, matching
+        # the scalar guard).
+        if unique_targets:
+            lt = self.latest_times[tgt2]                     # [N, M]
+            upd = present & (times[:, None] >= lt)
+            self.latest_values[tgt2] = np.where(
+                upd, values, self.latest_values[tgt2])
+            self.latest_times[tgt2] = np.where(upd, times[:, None], lt)
+            return
         order = np.argsort(times, kind="stable")
         ro, so, po = rows[order], slots[order], present[order]
         idx_e, idx_m = np.nonzero(po)

@@ -60,7 +60,10 @@ class LoadMonitorTaskRunner:
                 replayed = (len(samples.partition_samples)
                             + len(samples.broker_samples))
             self._state = RunnerState.RUNNING
-            self._last_sample_ms = now_ms
+            # Leave unset: the first maybe_run_sampling is immediately due
+            # (the reference's sampling loop fetches right at startup) and
+            # covers one interval back.
+            self._last_sample_ms = None
             return replayed
 
     def pause(self, reason: str = "") -> None:

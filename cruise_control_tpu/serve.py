@@ -155,15 +155,33 @@ def _make_sampler(config: CruiseControlConfig, admin, cpu_model=None):
     ``metric.sampler.class`` plugin, a Prometheus scrape when
     ``prometheus.server.endpoint`` is set, the agent metrics pipeline when
     enabled, else the default synthetic sampler."""
-    cls_name = config.get_string("metric.sampler.class")
+    raw_cls = config.get_string("metric.sampler.class")
     default_cls = "cruise_control_tpu.monitor.sampler.SyntheticWorkloadSampler"
-    if cls_name and cls_name != default_cls:
-        cls = load_class(cls_name)
-        import inspect
-        params = list(inspect.signature(cls).parameters)
-        if params[:1] == ["cluster"]:
-            return cls(admin)
-        return cls(config) if params else cls()
+    if raw_cls and raw_cls != default_cls:
+        # CLASS-typed configs may carry an actual type, not just a path.
+        cls = raw_cls if isinstance(raw_cls, type) else load_class(raw_cls)
+        from .monitor import PrometheusMetricSampler
+        if cls is PrometheusMetricSampler:
+            # The canonical plugin spelling routes to the full Prometheus
+            # wiring (adapter + host map) below.
+            endpoint = config.get_string("prometheus.server.endpoint")
+            if not endpoint:
+                raise ValueError(
+                    "PrometheusMetricSampler requires "
+                    "prometheus.server.endpoint")
+        else:
+            import inspect
+            params = list(inspect.signature(cls).parameters)
+            if params[:1] in (["cluster"], ["admin"]):
+                return cls(admin)
+            if params[:1] == ["config"]:
+                return cls(config)
+            if not params:
+                return cls()
+            raise ValueError(
+                f"metric.sampler.class {cls.__name__}: unsupported "
+                f"constructor signature {params} — expected (cluster|admin),"
+                " (config), or ()")
     endpoint = config.get_string("prometheus.server.endpoint")
     if not endpoint and config.get_boolean("use.agent.metrics.pipeline"):
         import zlib

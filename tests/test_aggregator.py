@@ -248,3 +248,39 @@ def test_dense_batch_ingest_matches_scalar_path():
     # entity-row recycling keeps dense state coherent after removal
     a2.remove_entities({("t", 0)})
     assert ("t", 0) not in a2.all_entities()
+
+
+def test_dense_ingest_duplicate_targets_match_scalar_semantics():
+    """The unique-target fast path and the scatter fallback must agree:
+    duplicate (entity, window) samples in one batch accumulate exactly like
+    sequential scalar add_sample calls (sums, counts, maxes, latest-wins)."""
+    import numpy as np
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    mdef = partition_metric_def()
+    M = mdef.size()
+    agg_dense = MetricSampleAggregator(4, 1000, 1, mdef)
+    agg_scalar = MetricSampleAggregator(4, 1000, 1, mdef)
+    entities = [("t", 0), ("t", 1), ("t", 0), ("t", 0)]   # dup entity 0
+    times = np.array([500, 500, 700, 600], np.int64)      # out of order
+    vals = np.full((4, M), np.nan)
+    vals[0, 0], vals[1, 0], vals[2, 0], vals[3, 0] = 1.0, 5.0, 3.0, 9.0
+    vals[0, 1] = 2.0
+    agg_dense.add_samples_dense(entities, times, vals)
+    for e, t, v in zip(entities, times, vals):
+        agg_scalar.add_sample(MetricSample(
+            e, int(t), {m: float(x) for m, x in enumerate(v)
+                        if not np.isnan(x)}))
+    for agg in (agg_dense, agg_scalar):
+        agg.add_samples_dense([("t", 9)], np.array([1500], np.int64),
+                              np.full((1, M), np.nan))   # roll the window
+    r_d = agg_dense._raw
+    r_s = agg_scalar._raw
+    row_d = r_d.get_row(("t", 0))
+    row_s = r_s.get_row(("t", 0))
+    np.testing.assert_allclose(r_d.sums[row_d], r_s.sums[row_s])
+    np.testing.assert_array_equal(r_d.counts[row_d], r_s.counts[row_s])
+    np.testing.assert_allclose(r_d.maxes[row_d], r_s.maxes[row_s])
+    np.testing.assert_allclose(r_d.latest_values[row_d],
+                               r_s.latest_values[row_s])
+    # latest-wins at metric 0: the t=700 sample (value 3.0) beats t=600.
+    assert r_d.latest_values[row_d, 0, 0] == 3.0
