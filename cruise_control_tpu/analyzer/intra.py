@@ -60,6 +60,23 @@ class IntraBrokerResult:
     balance_violation_after: float
     iterations: int
 
+    def goal_summary(self) -> list[dict]:
+        """Per-goal entries in the same shape as the inter-broker
+        ``goalSummary`` (ref OptimizerResult), naming the two goal facets
+        of the fused kernel (single source: the facet classes below)."""
+        rows = [(IntraBrokerDiskCapacityGoal,
+                 self.capacity_violation_before,
+                 self.capacity_violation_after),
+                (IntraBrokerDiskUsageDistributionGoal,
+                 self.balance_violation_before,
+                 self.balance_violation_after)]
+        return [
+            {"goal": goal.name, "hard": goal.hard,
+             "violationBefore": before, "violationAfter": after,
+             "status": "NO-ACTION" if before <= 1e-6
+             else ("FIXED" if after <= 1e-6 else "VIOLATED")}
+            for goal, before, after in rows]
+
 
 def build_disk_state(model, metadata, admin, capacity_resolver
                      ) -> tuple[DiskState, list[list[str]]]:
@@ -134,6 +151,38 @@ def _violations(state: DiskState, cap_threshold: float,
     bal = jnp.where(live, jnp.maximum(util - upper, 0.0)
                     + jnp.maximum(lower - util, 0.0), 0.0)
     return over_cap.sum(), bal.sum()
+
+
+class IntraBrokerDiskCapacityGoal:
+    """Named facet of the fused intra-broker kernel (ref
+    ``IntraBrokerDiskCapacityGoal.java``): no disk above
+    ``capacity * cap_threshold``; draining disks (capacity 0) must empty
+    completely. Hard goal — its residual gates rebalance_disks results."""
+
+    name = "IntraBrokerDiskCapacityGoal"
+    hard = True
+
+    @staticmethod
+    def violation(state: DiskState, cap_threshold: float = 0.8,
+                  balance_threshold: float = 1.10) -> float:
+        cap, _bal = _violations(state, cap_threshold, balance_threshold)
+        return float(cap)
+
+
+class IntraBrokerDiskUsageDistributionGoal:
+    """Named facet of the fused intra-broker kernel (ref
+    ``IntraBrokerDiskUsageDistributionGoal.java``): each broker's disks
+    within ``avg * balance_threshold`` of the broker's mean disk
+    utilization. Soft goal."""
+
+    name = "IntraBrokerDiskUsageDistributionGoal"
+    hard = False
+
+    @staticmethod
+    def violation(state: DiskState, cap_threshold: float = 0.8,
+                  balance_threshold: float = 1.10) -> float:
+        _cap, bal = _violations(state, cap_threshold, balance_threshold)
+        return float(bal)
 
 
 def optimize_intra_broker(state: DiskState, *, cap_threshold: float = 0.8,

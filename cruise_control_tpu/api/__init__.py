@@ -9,7 +9,8 @@ from .purgatory import Purgatory, ReviewStatus
 from .openapi import openapi_spec
 from .security import (AllowAllSecurityProvider, AuthorizationError,
                        BasicSecurityProvider, JwtSecurityProvider, Principal,
-                       Role, TrustedProxySecurityProvider, check_access)
+                       Role, SpnegoSecurityProvider,
+                       TrustedProxySecurityProvider, check_access)
 from .server import CruiseControlApp
 from .tasks import TaskState, UserTaskManager
 
@@ -17,5 +18,6 @@ __all__ = ["KafkaCruiseControl", "ProposalCache", "OperationProgress",
            "Purgatory", "ReviewStatus", "AllowAllSecurityProvider",
            "AuthorizationError", "BasicSecurityProvider",
            "JwtSecurityProvider", "Principal", "Role",
+           "SpnegoSecurityProvider",
            "TrustedProxySecurityProvider", "check_access", "openapi_spec",
            "CruiseControlApp", "TaskState", "UserTaskManager"]

@@ -407,6 +407,7 @@ class KafkaCruiseControl:
             self.monitor.capacity_resolver,
             drained_disks=broker_id_logdirs)
         out = {"numIntraBrokerMoves": len(res.moves),
+               "goalSummary": res.goal_summary(),
                "capacityViolation": {"before": res.capacity_violation_before,
                                      "after": res.capacity_violation_after},
                "balanceViolation": {"before": res.balance_violation_before,

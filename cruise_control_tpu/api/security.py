@@ -170,6 +170,53 @@ class JwtSecurityProvider:
         return Principal(name, role)
 
 
+class SpnegoSecurityProvider:
+    """SPNEGO/Kerberos auth (ref ``security/spnego/``). Requires a GSSAPI
+    implementation; this environment ships none, so construction is gated
+    with a clear error instead of failing deep inside a request. When a
+    ``gssapi`` module is available, tokens from the ``Authorization:
+    Negotiate <token>`` header are accepted for the configured service
+    principal."""
+
+    def __init__(self, service_principal: str,
+                 role: Role = Role.USER):
+        try:
+            import gssapi  # noqa: F401 — probe only
+        except ImportError as e:
+            raise RuntimeError(
+                "SpnegoSecurityProvider requires the 'gssapi' package "
+                "(Kerberos); install it or use webserver.security.provider="
+                "basic|jwt|trustedproxy") from e
+        self.service_principal = service_principal
+        self.role = role
+
+    def authenticate(self, headers: dict[str, str]) -> Principal:
+        import base64 as _b64
+
+        import gssapi
+        auth = headers.get("authorization", "")
+        if not auth.startswith("Negotiate "):
+            raise AuthorizationError("missing Negotiate token", 401)
+        # Decode/handshake failures are authentication failures (401),
+        # like every other provider — not 400/500 leaks of raw errors.
+        try:
+            token = _b64.b64decode(auth[10:])
+            server_name = gssapi.Name(
+                self.service_principal,
+                name_type=gssapi.NameType.hostbased_service)
+            ctx = gssapi.SecurityContext(creds=gssapi.Credentials(
+                usage="accept", name=server_name), usage="accept")
+            ctx.step(token)
+            if not ctx.complete:
+                raise AuthorizationError("incomplete SPNEGO handshake", 401)
+            return Principal(str(ctx.initiator_name), self.role)
+        except AuthorizationError:
+            raise
+        except Exception as e:
+            raise AuthorizationError(f"SPNEGO authentication failed: "
+                                     f"{type(e).__name__}", 401)
+
+
 class TrustedProxySecurityProvider:
     """Trusted-proxy auth: requests from listed proxies carry the acting
     principal in a header (ref security/trustedproxy/)."""

@@ -269,7 +269,8 @@ def _webserver_defs(d: ConfigDef) -> None:
              importance=Importance.MEDIUM,
              doc="Basic-auth credentials file (name: password,ROLE)")
     d.define("webserver.security.provider", ConfigType.STRING, "basic",
-             validator=ValidString.in_("basic", "jwt", "trustedproxy"),
+             validator=ValidString.in_("basic", "jwt", "trustedproxy",
+                                       "spnego"),
              importance=Importance.MEDIUM,
              doc="Which SecurityProvider gate requests when security is "
                  "enabled (ref servlet/security/ provider set)")
@@ -283,6 +284,10 @@ def _webserver_defs(d: ConfigDef) -> None:
     d.define("trusted.proxy.principal.header", ConfigType.STRING, "doAs",
              importance=Importance.LOW,
              doc="Header carrying the acting principal")
+    d.define("spnego.principal", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Service principal for the spnego provider "
+                 "(e.g. HTTP@cruisecontrol.example.com)")
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.MEDIUM, doc="Review-before-execute flow")
     d.define("max.active.user.tasks", ConfigType.INT, 25,

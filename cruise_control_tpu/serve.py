@@ -257,6 +257,12 @@ def _make_security(config: CruiseControlConfig):
             set(config.get_list("trusted.proxy.services")),
             principal_header=config.get_string(
                 "trusted.proxy.principal.header"))
+    if kind == "spnego":
+        from .api.security import SpnegoSecurityProvider
+        principal = config.get_string("spnego.principal")
+        if not principal:
+            raise ValueError("spnego security requires spnego.principal")
+        return SpnegoSecurityProvider(principal)
     return BasicSecurityProvider(_load_credentials(
         config.get_string("webserver.auth.credentials.file")))
 
