@@ -312,10 +312,61 @@ def run_replan_scenario(num_requests: int = 30):
     }))
 
 
+def run_demo_scenario():
+    """Scenario #1: the 3-broker demo with config/capacity.json through the
+    stock served path — monitor samples in, default goal chain, proposals
+    out. The parity baseline row of BASELINE.md."""
+    from cruise_control_tpu.analyzer import OptimizationOptions
+    from cruise_control_tpu.config.capacity import FileCapacityResolver
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import (LoadMonitor,
+                                            LoadMonitorTaskRunner,
+                                            MetricFetcherManager,
+                                            MonitorConfig,
+                                            SyntheticWorkloadSampler)
+    from cruise_control_tpu.api import KafkaCruiseControl
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    # Skewed demo: everything leads on brokers 0/1.
+    for p in range(64):
+        sim.add_partition(f"demo-{p % 4}", p, [p % 2, 2],
+                          size_mb=100.0 + p)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=1000,
+                                             min_samples_per_window=1),
+                          capacity_resolver=FileCapacityResolver(
+                              "config/capacity.json"))
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=1000)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        runner.maybe_run_sampling((w + 1) * 1000 - 1)
+    facade = KafkaCruiseControl(sim, monitor, task_runner=runner,
+                                now_ms=lambda: 4000)
+    t0 = time.monotonic()
+    facade.rebalance(dryrun=True, options=OptimizationOptions(seed=0),
+                     ignore_proposal_cache=True)
+    cold = time.monotonic() - t0
+    t0 = time.monotonic()
+    res, _ = facade.rebalance(dryrun=True,
+                              options=OptimizationOptions(seed=1),
+                              ignore_proposal_cache=True)
+    dur = time.monotonic() - t0
+    log(f"scenario 1: 3-broker demo, cold {cold:.1f}s warm {dur:.2f}s, "
+        f"{len(res.proposals)} proposals, "
+        f"violated after: {res.violated_goals_after}")
+    print(json.dumps({"metric": "rebalance_proposal_wall_clock_3broker_demo",
+                      "value": round(dur, 3), "unit": "s",
+                      "vs_baseline": None}))
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--scenario", type=int, default=2, choices=(2, 3, 4, 5),
-                    help="BASELINE.md scenario (2 = 100x20K vs greedy, "
+    ap.add_argument("--scenario", type=int, default=2,
+                    choices=(1, 2, 3, 4, 5),
+                    help="BASELINE.md scenario (1 = 3-broker demo, "
+                         "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99)")
     args = ap.parse_args()
     # Probe the default backend in a subprocess first: when the TPU tunnel is
@@ -326,7 +377,9 @@ def main():
     import jax
     if args.scenario != 2:
         log(f"platform: {platform} -> {jax.devices()[0].platform}")
-        if args.scenario == 5:
+        if args.scenario == 1:
+            run_demo_scenario()
+        elif args.scenario == 5:
             run_replan_scenario()
         else:
             run_scale_scenario(args.scenario)

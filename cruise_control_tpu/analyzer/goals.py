@@ -626,6 +626,23 @@ class IntervalGoal(GoalKernel):
         up = jnp.broadcast_to(jnp.asarray(upper, values.dtype), values.shape)
         alive = ctx.broker_alive
         excess = jnp.where(alive, jnp.maximum(values - up, 0.0), values)
+        if self.upper_only:
+            deficit = jnp.zeros_like(values)
+        else:
+            lo = jnp.broadcast_to(jnp.asarray(lower, values.dtype),
+                                  values.shape)
+            deficit = jnp.where(alive, jnp.maximum(lo - values, 0.0), 0.0)
+        # Shed quota mirrors the replica drain: over-upper excess, plus a
+        # pro-rata share of above-average sources while deficits remain
+        # (transfers toward a starving broker usually come from sources
+        # within their own bounds).
+        n_alive = jnp.maximum(alive.sum(), 1)
+        avg = jnp.where(ctx.broker_valid, values, 0.0).sum() / n_alive
+        need = jnp.maximum(deficit.sum() - excess.sum(), 0.0)
+        pool = jnp.where(alive & (excess <= 0.0),
+                         jnp.maximum(values - avg, 0.0), 0.0)
+        scale = jnp.minimum(need / jnp.maximum(pool.sum(), 1e-9), 1.0)
+        quota = excess + pool * scale
         budget_b = jnp.where(alive & ctx.leader_dest_allowed
                              & ctx.broker_valid,
                              jnp.maximum(up - values, 0.0), 0.0)
@@ -639,7 +656,7 @@ class IntervalGoal(GoalKernel):
         # such candidates' delta is 0 — they'd pass both quota passes and
         # then be rejected wholesale, starving real transfers of budget.
         can = (ctx.leadership_movable & ctx.partition_valid & alive[src]
-               & (excess[src] > 0.0) & (w > 0.0))
+               & (quota[src] > 0.0) & (w > 0.0))
 
         # Destination: the follower slot whose broker has the most intake
         # headroom (receiving slot keeps the full replica; only leadership
@@ -661,7 +678,7 @@ class IntervalGoal(GoalKernel):
         o1 = jnp.lexsort((-sort_w, src))
         sw1 = jnp.where(can[o1], w[o1], 0.0)
         before1 = _segment_cum_before(sw1, src[o1], B1)
-        t1_sorted = can[o1] & (before1 < excess[src[o1]])
+        t1_sorted = can[o1] & (before1 < quota[src[o1]])
         take1 = jnp.zeros((P,), bool).at[o1].set(t1_sorted)
 
         # Aggregate hard-capacity cap, like the replica drain: a transfer
@@ -716,11 +733,16 @@ class IntervalGoal(GoalKernel):
                                                    values, lo, up, excess,
                                                    deficit))
         if self.actions in ("leadership", "both"):
-            # moving leadership off slot-0's broker to the slot's broker
+            # moving leadership off slot-0's broker to the slot's broker —
+            # proposed when EITHER side needs it: the source is over upper,
+            # or the destination is starving below lower (a deficit
+            # destination's sources are usually within their own bounds;
+            # the delta check still keeps only improving transfers).
             src_b = state.rb[:, 0:1]                                # [P, 1]
             dst_b = state.rb                                        # [P, R]
             gain = _norm01(excess)[src_b] + _norm01(deficit)[dst_b]
-            prio = jnp.where(excess[src_b] > 0.0, gain, _NEG)
+            prio = jnp.where((excess[src_b] > 0.0) | (deficit[dst_b] > 0.0),
+                             gain, _NEG)
             kl, key = jax.random.split(key)
             parts.append(_top_leadership(state, ctx, kl, cfg, prio))
         out = parts[0]

@@ -162,12 +162,6 @@ class IntraBrokerDiskCapacityGoal:
     name = "IntraBrokerDiskCapacityGoal"
     hard = True
 
-    @staticmethod
-    def violation(state: DiskState, cap_threshold: float = 0.8,
-                  balance_threshold: float = 1.10) -> float:
-        cap, _bal = _violations(state, cap_threshold, balance_threshold)
-        return float(cap)
-
 
 class IntraBrokerDiskUsageDistributionGoal:
     """Named facet of the fused intra-broker kernel (ref
@@ -177,12 +171,6 @@ class IntraBrokerDiskUsageDistributionGoal:
 
     name = "IntraBrokerDiskUsageDistributionGoal"
     hard = False
-
-    @staticmethod
-    def violation(state: DiskState, cap_threshold: float = 0.8,
-                  balance_threshold: float = 1.10) -> float:
-        _cap, bal = _violations(state, cap_threshold, balance_threshold)
-        return float(bal)
 
 
 def optimize_intra_broker(state: DiskState, *, cap_threshold: float = 0.8,

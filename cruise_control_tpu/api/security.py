@@ -38,9 +38,14 @@ ENDPOINT_MIN_ROLE: dict[str, Role] = {
 
 
 class AuthorizationError(PermissionError):
-    def __init__(self, message: str, status: int = 401):
+    def __init__(self, message: str, status: int = 401,
+                 challenge: str | None = None):
         super().__init__(message)
         self.status = status
+        #: WWW-Authenticate challenge the 401 response should carry so
+        #: conforming clients (curl --negotiate, browsers) retry with
+        #: credentials.
+        self.challenge = challenge
 
 
 @dataclass
@@ -73,7 +78,8 @@ class BasicSecurityProvider:
     def authenticate(self, headers: dict[str, str]) -> Principal:
         auth = headers.get("authorization", headers.get("Authorization", ""))
         if not auth.startswith("Basic "):
-            raise AuthorizationError("missing basic auth credentials", 401)
+            raise AuthorizationError("missing basic auth credentials", 401,
+                                     challenge='Basic realm="cruisecontrol"')
         try:
             raw = base64.b64decode(auth[6:]).decode()
             name, _, password = raw.partition(":")
@@ -131,7 +137,8 @@ class JwtSecurityProvider:
         import json
         auth = headers.get("authorization", headers.get("Authorization", ""))
         if not auth.startswith("Bearer "):
-            raise AuthorizationError("missing bearer token", 401)
+            raise AuthorizationError("missing bearer token", 401,
+                                     challenge="Bearer")
         token = auth[7:].strip()
         parts = token.split(".")
         if len(parts) != 3:
@@ -181,7 +188,7 @@ class SpnegoSecurityProvider:
     def __init__(self, service_principal: str,
                  role: Role = Role.USER):
         try:
-            import gssapi  # noqa: F401 — probe only
+            import gssapi
         except ImportError as e:
             raise RuntimeError(
                 "SpnegoSecurityProvider requires the 'gssapi' package "
@@ -189,6 +196,13 @@ class SpnegoSecurityProvider:
                 "basic|jwt|trustedproxy") from e
         self.service_principal = service_principal
         self.role = role
+        # Acquire acceptor credentials once: resolves the principal and
+        # reads the keytab at startup (bad configs fail loudly here, not
+        # as per-request 401s).
+        self._server_name = gssapi.Name(
+            service_principal, name_type=gssapi.NameType.hostbased_service)
+        self._creds = gssapi.Credentials(usage="accept",
+                                         name=self._server_name)
 
     def authenticate(self, headers: dict[str, str]) -> Principal:
         import base64 as _b64
@@ -196,25 +210,24 @@ class SpnegoSecurityProvider:
         import gssapi
         auth = headers.get("authorization", "")
         if not auth.startswith("Negotiate "):
-            raise AuthorizationError("missing Negotiate token", 401)
+            raise AuthorizationError("missing Negotiate token", 401,
+                                     challenge="Negotiate")
         # Decode/handshake failures are authentication failures (401),
         # like every other provider — not 400/500 leaks of raw errors.
         try:
             token = _b64.b64decode(auth[10:])
-            server_name = gssapi.Name(
-                self.service_principal,
-                name_type=gssapi.NameType.hostbased_service)
-            ctx = gssapi.SecurityContext(creds=gssapi.Credentials(
-                usage="accept", name=server_name), usage="accept")
+            ctx = gssapi.SecurityContext(creds=self._creds, usage="accept")
             ctx.step(token)
             if not ctx.complete:
-                raise AuthorizationError("incomplete SPNEGO handshake", 401)
+                raise AuthorizationError("incomplete SPNEGO handshake", 401,
+                                         challenge="Negotiate")
             return Principal(str(ctx.initiator_name), self.role)
         except AuthorizationError:
             raise
         except Exception as e:
             raise AuthorizationError(f"SPNEGO authentication failed: "
-                                     f"{type(e).__name__}", 401)
+                                     f"{type(e).__name__}", 401,
+                                     challenge="Negotiate")
 
 
 class TrustedProxySecurityProvider:

@@ -374,7 +374,9 @@ def _make_handler(app: CruiseControlApp):
                 try:
                     check_access(app.security, "openapi", headers)
                 except AuthorizationError as e:
-                    self._send(e.status, {"errorMessage": str(e)})
+                    self._send(e.status, {"errorMessage": str(e)},
+                               {"WWW-Authenticate": e.challenge}
+                               if e.challenge else {})
                     return
                 from .openapi import api_explorer_html
                 body = api_explorer_html().encode()
@@ -401,7 +403,9 @@ def _make_handler(app: CruiseControlApp):
                 status, payload, extra = app.handle(method, endpoint, params,
                                                     headers)
             except AuthorizationError as e:
-                status, payload, extra = e.status, {"errorMessage": str(e)}, {}
+                status, payload = e.status, {"errorMessage": str(e)}
+                extra = ({"WWW-Authenticate": e.challenge} if e.challenge
+                         else {})
             except (KeyError, ValueError) as e:
                 status, payload, extra = 400, {"errorMessage": str(e)}, {}
             except Exception as e:
