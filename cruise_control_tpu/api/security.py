@@ -71,6 +71,9 @@ class BasicSecurityProvider:
     """HTTP Basic auth against a static credentials map (ref
     BasicSecurityProvider.java + the auth-file format)."""
 
+    #: challenge attached to every 401 from this provider (RFC 7235)
+    default_challenge = 'Basic realm="cruisecontrol"'
+
     def __init__(self, users: dict[str, tuple[str, Role]]):
         """``users``: name -> (password, role)."""
         self.users = users
@@ -98,6 +101,9 @@ class JwtSecurityProvider:
     RS256 tokens minted by an SSO service; with no crypto dependencies in
     this environment the shared-secret HMAC variant keeps the same token
     shape, expiry, and claim mapping."""
+
+    #: challenge attached to every 401 from this provider (RFC 7235)
+    default_challenge = "Bearer"
 
     def __init__(self, secret: bytes | str, *, role_claim: str = "role",
                  default_role: Role = Role.VIEWER,
@@ -184,6 +190,9 @@ class SpnegoSecurityProvider:
     ``gssapi`` module is available, tokens from the ``Authorization:
     Negotiate <token>`` header are accepted for the configured service
     principal."""
+
+    #: challenge attached to every 401 from this provider (RFC 7235)
+    default_challenge = "Negotiate"
 
     def __init__(self, service_principal: str,
                  role: Role = Role.USER):

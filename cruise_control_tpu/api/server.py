@@ -60,6 +60,16 @@ def _ids(params: dict, name: str) -> list[int]:
     return [int(x) for x in raw.split(",") if x.strip()]
 
 
+def _auth_headers(e: AuthorizationError, provider) -> dict:
+    """RFC 7235: every 401 carries a WWW-Authenticate challenge —
+    the error's own, or the provider's default (wrong-password retries
+    need the challenge just as much as missing-credential ones)."""
+    challenge = e.challenge
+    if challenge is None and e.status == 401:
+        challenge = getattr(provider, "default_challenge", None)
+    return {"WWW-Authenticate": challenge} if challenge else {}
+
+
 def _goals(params: dict) -> list[str] | None:
     raw = params.get("goals", [""])[0]
     explicit = [g.strip() for g in raw.split(",") if g.strip()]
@@ -375,8 +385,7 @@ def _make_handler(app: CruiseControlApp):
                     check_access(app.security, "openapi", headers)
                 except AuthorizationError as e:
                     self._send(e.status, {"errorMessage": str(e)},
-                               {"WWW-Authenticate": e.challenge}
-                               if e.challenge else {})
+                               _auth_headers(e, app.security))
                     return
                 from .openapi import api_explorer_html
                 body = api_explorer_html().encode()
@@ -404,8 +413,7 @@ def _make_handler(app: CruiseControlApp):
                                                     headers)
             except AuthorizationError as e:
                 status, payload = e.status, {"errorMessage": str(e)}
-                extra = ({"WWW-Authenticate": e.challenge} if e.challenge
-                         else {})
+                extra = _auth_headers(e, app.security)
             except (KeyError, ValueError) as e:
                 status, payload, extra = 400, {"errorMessage": str(e)}, {}
             except Exception as e:
