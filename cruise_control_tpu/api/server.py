@@ -87,10 +87,22 @@ class CruiseControlApp:
     def __init__(self, facade: KafkaCruiseControl, host: str = "127.0.0.1",
                  port: int = 9090,
                  security: SecurityProvider | None = None,
-                 two_step_verification: bool = False) -> None:
+                 two_step_verification: bool = False,
+                 max_active_tasks: int | None = None,
+                 completed_task_retention_ms: int | None = None,
+                 purgatory_retention_ms: int | None = None) -> None:
+        # None = use the component's own default (single source of truth
+        # in tasks.py / purgatory.py); values are forwarded only when set.
         self.facade = facade
-        self.tasks = UserTaskManager()
-        self.purgatory = Purgatory() if two_step_verification else None
+        task_kwargs = {k: v for k, v in (
+            ("max_active_tasks", max_active_tasks),
+            ("completed_task_retention_ms", completed_task_retention_ms),
+        ) if v is not None}
+        self.tasks = UserTaskManager(**task_kwargs)
+        purgatory_kwargs = ({"retention_ms": purgatory_retention_ms}
+                            if purgatory_retention_ms is not None else {})
+        self.purgatory = (Purgatory(**purgatory_kwargs)
+                          if two_step_verification else None)
         self.security = security or AllowAllSecurityProvider()
         handler = _make_handler(self)
         self.server = ThreadingHTTPServer((host, port), handler)

@@ -290,6 +290,9 @@ def _webserver_defs(d: ConfigDef) -> None:
                  "(e.g. HTTP@cruisecontrol.example.com)")
     d.define("two.step.verification.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.MEDIUM, doc="Review-before-execute flow")
+    d.define("two.step.purgatory.retention.time.ms", ConfigType.LONG,
+             7 * 24 * 3600 * 1000, importance=Importance.LOW,
+             doc="How long un-reviewed requests stay in the purgatory")
     d.define("max.active.user.tasks", ConfigType.INT, 25,
              validator=Range.at_least(1), importance=Importance.MEDIUM,
              doc="Concurrent async user task cap")

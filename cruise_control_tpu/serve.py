@@ -129,7 +129,12 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         port=config.get_int("webserver.http.port"),
         security=security,
         two_step_verification=config.get_boolean(
-            "two.step.verification.enabled"))
+            "two.step.verification.enabled"),
+        max_active_tasks=config.get_int("max.active.user.tasks"),
+        completed_task_retention_ms=config.get_int(
+            "completed.user.task.retention.time.ms"),
+        purgatory_retention_ms=config.get_int(
+            "two.step.purgatory.retention.time.ms"))
 
 
 class _AgentPipelineSampler:
