@@ -244,3 +244,43 @@ def test_admin_endpoint(stack):
     assert status == 200
     assert facade.executor.config.concurrency.\
         num_concurrent_partition_movements_per_broker == 9
+
+
+def test_infeasible_hard_goal_surfaces_as_error():
+    """Strict reference semantics (OptimizationFailureException): a cluster
+    whose demand cannot fit under a hard capacity goal must fail the
+    rebalance loudly, not return an unsafe plan."""
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    # Total disk demand (~30GB) far exceeds 3 x 1MB usable capacity.
+    for p in range(16):
+        sim.add_partition("big", p, [p % 3, (p + 1) % 3], size_mb=1000.0)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                                             min_samples_per_window=1))
+    from cruise_control_tpu.config.capacity import FixedCapacityResolver
+    from cruise_control_tpu.core.resources import Resource
+    monitor.capacity_resolver = FixedCapacityResolver(
+        capacity={Resource.CPU: 100.0, Resource.NW_IN: 1e6,
+                  Resource.NW_OUT: 1e6, Resource.DISK: 1.0})
+    fetcher = MetricFetcherManager(SyntheticWorkloadSampler(sim))
+    runner = LoadMonitorTaskRunner(monitor, fetcher,
+                                   sampling_interval_ms=WINDOW_MS)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        runner.maybe_run_sampling((w + 1) * WINDOW_MS - 1)
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(goals=goals_by_name(["DiskCapacityGoal"])),
+        now_ms=lambda: 4 * WINDOW_MS)
+    app = CruiseControlApp(facade, port=0)
+    app.start()
+    try:
+        _status, body, _hdrs = call(
+            app, "POST", "rebalance",
+            "dryrun=true&ignore_proposal_cache=true"
+            "&get_response_timeout_s=120", expect=500)
+        assert "hard goals still violated" in body["errorMessage"], body
+        assert "DiskCapacityGoal" in body["errorMessage"]
+    finally:
+        app.stop()
