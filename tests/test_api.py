@@ -24,17 +24,22 @@ GOALS = ["RackAwareGoal", "ReplicaDistributionGoal",
          "DiskUsageDistributionGoal"]
 
 
-def build_stack(num_brokers=4, partitions=16, two_step=False, security=None):
+def build_stack(num_brokers=4, partitions=16, two_step=False, security=None,
+                goals=None, capacity_resolver=None, partition_size_mb=None):
     sim = SimulatedKafkaCluster()
     for b in range(num_brokers):
         sim.add_broker(b, rate_mb_s=10_000.0)
     # Skewed on purpose: brokers 0-2 carry everything, broker 3 is empty, so
     # a rebalance always has work to do.
     for p in range(partitions):
+        size = (partition_size_mb if partition_size_mb is not None
+                else 10.0 + p)
         sim.add_partition(f"t{p % 3}", p, [p % 2, 1 + (p % 2)],
-                          size_mb=10.0 + p)
+                          size_mb=size)
     monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
                                              min_samples_per_window=1))
+    if capacity_resolver is not None:
+        monitor.capacity_resolver = capacity_resolver
     fetcher = MetricFetcherManager(SyntheticWorkloadSampler(sim))
     runner = LoadMonitorTaskRunner(monitor, fetcher,
                                    sampling_interval_ms=WINDOW_MS)
@@ -46,7 +51,7 @@ def build_stack(num_brokers=4, partitions=16, two_step=False, security=None):
                         now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
     facade = KafkaCruiseControl(
         sim, monitor, task_runner=runner,
-        optimizer=TpuGoalOptimizer(goals=goals_by_name(GOALS)),
+        optimizer=TpuGoalOptimizer(goals=goals_by_name(goals or GOALS)),
         executor=executor, now_ms=lambda: 4 * WINDOW_MS)
     app = CruiseControlApp(facade, port=0, two_step_verification=two_step,
                            security=security)
@@ -250,31 +255,15 @@ def test_infeasible_hard_goal_surfaces_as_error():
     """Strict reference semantics (OptimizationFailureException): a cluster
     whose demand cannot fit under a hard capacity goal must fail the
     rebalance loudly, not return an unsafe plan."""
-    sim = SimulatedKafkaCluster()
-    for b in range(3):
-        sim.add_broker(b, rate_mb_s=10_000.0)
-    # Total disk demand (~30GB) far exceeds 3 x 1MB usable capacity.
-    for p in range(16):
-        sim.add_partition("big", p, [p % 3, (p + 1) % 3], size_mb=1000.0)
-    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
-                                             min_samples_per_window=1))
     from cruise_control_tpu.config.capacity import FixedCapacityResolver
     from cruise_control_tpu.core.resources import Resource
-    monitor.capacity_resolver = FixedCapacityResolver(
-        capacity={Resource.CPU: 100.0, Resource.NW_IN: 1e6,
-                  Resource.NW_OUT: 1e6, Resource.DISK: 1.0})
-    fetcher = MetricFetcherManager(SyntheticWorkloadSampler(sim))
-    runner = LoadMonitorTaskRunner(monitor, fetcher,
-                                   sampling_interval_ms=WINDOW_MS)
-    runner.start(-1, skip_loading=True)
-    for w in range(4):
-        runner.maybe_run_sampling((w + 1) * WINDOW_MS - 1)
-    facade = KafkaCruiseControl(
-        sim, monitor, task_runner=runner,
-        optimizer=TpuGoalOptimizer(goals=goals_by_name(["DiskCapacityGoal"])),
-        now_ms=lambda: 4 * WINDOW_MS)
-    app = CruiseControlApp(facade, port=0)
-    app.start()
+    # Total disk demand (~16GB) far exceeds the 1MB-per-broker capacity.
+    _sim, _facade, app = build_stack(
+        num_brokers=3, partitions=16, goals=["DiskCapacityGoal"],
+        partition_size_mb=1000.0,
+        capacity_resolver=FixedCapacityResolver(
+            capacity={Resource.CPU: 100.0, Resource.NW_IN: 1e6,
+                      Resource.NW_OUT: 1e6, Resource.DISK: 1.0}))
     try:
         _status, body, _hdrs = call(
             app, "POST", "rebalance",
