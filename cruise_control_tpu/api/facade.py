@@ -88,7 +88,15 @@ class KafkaCruiseControl:
             progress.add_step("WaitingForClusterModel")
         result = self.monitor.cluster_model(self._now_ms(), requirements)
         spec = result.spec
+        original_placement = None
         if spec_mutator is not None:
+            # Proposals must capture the full live->final change, so
+            # remember the LIVE placement before the mutator rewrites the
+            # spec (an RF change adds/drops replicas pre-optimization; a
+            # diff against the mutated model would silently drop the RF
+            # change for partitions the optimizer leaves in place).
+            original_placement = {(p.topic, p.partition): list(p.replicas)
+                                  for p in spec.partitions}
             spec = spec_mutator(spec)
             from ..model.spec import flatten_spec
             model, metadata = flatten_spec(spec)
@@ -110,7 +118,18 @@ class KafkaCruiseControl:
         on_goal = ((lambda name: progress.add_step(f"OptimizationForGoal-"
                                                    f"{name}"))
                    if progress else None)
-        return opt.optimize(model, metadata, options, on_goal_start=on_goal)
+        res = opt.optimize(model, metadata, options, on_goal_start=on_goal)
+        if original_placement is not None:
+            from dataclasses import replace as _dc_replace
+
+            from ..model.proposals import diff_proposals_vs_placement
+            mutated_keys = {(p.topic, p.partition) for p in spec.partitions
+                            if list(p.replicas) != original_placement.get(
+                                (p.topic, p.partition))}
+            res = _dc_replace(res, proposals=diff_proposals_vs_placement(
+                original_placement, model, res.final_model, metadata,
+                mutated_keys))
+        return res
 
     def _maybe_execute(self, res: OptimizerResult, dryrun: bool,
                        uuid: str, progress: OperationProgress | None,
@@ -224,6 +243,10 @@ class KafkaCruiseControl:
         """Replication-factor change (ref UpdateTopicConfigurationRunnable +
         ClusterModel.createOrDeleteReplicas :962): adjust each matched
         partition's replica list rack-aware, then rebalance."""
+        if target_rf < 1:
+            raise ValueError(
+                f"replication_factor must be >= 1, got {target_rf}")
+
         def change_rf(spec):
             by_broker = {b.broker_id: b for b in spec.brokers}
             alive = [b for b in spec.brokers if b.alive]
@@ -258,6 +281,14 @@ class KafkaCruiseControl:
                     counts[pick.broker_id] += 1
                     racks_used.add(pick.rack)
                 p.replicas = replicas
+                # The preferred order must stay a permutation of the new
+                # replica set: keep surviving entries' relative order,
+                # append additions at the end (Kafka's semantics when the
+                # assignment list changes).
+                if p.preferred_replicas is not None:
+                    kept = [r for r in p.preferred_replicas if r in replicas]
+                    kept.extend(r for r in replicas if r not in kept)
+                    p.preferred_replicas = kept
             return spec
         res = self._optimize(progress, None, OptimizationOptions(),
                              spec_mutator=change_rf)

@@ -55,6 +55,19 @@ class ExecutionProposal:
                 "newReplicas": list(self.new_replicas)}
 
 
+def _padded_broker_ids(metadata: ClusterMetadata,
+                       sentinel: int) -> np.ndarray:
+    """Padded index -> external broker id lookup (sentinel row = -1)."""
+    return np.asarray(metadata.broker_ids
+                      + [-1] * (sentinel + 1 - len(metadata.broker_ids)))
+
+
+def _row_ids(row: np.ndarray, broker_ids: np.ndarray,
+             sentinel: int) -> tuple[int, ...]:
+    """One padded replica row -> leader-first external broker id tuple."""
+    return tuple(int(broker_ids[b]) for b in row if b < sentinel)
+
+
 def diff_proposals(initial: FlatClusterModel, final: FlatClusterModel,
                    metadata: ClusterMetadata) -> list[ExecutionProposal]:
     """Diff two models sharing one metadata/padding layout into proposals."""
@@ -64,19 +77,61 @@ def diff_proposals(initial: FlatClusterModel, final: FlatClusterModel,
         raise ValueError("models have different padded shapes")
     sentinel = initial.broker_sentinel
     changed = np.nonzero((rb0 != rb1).any(axis=1))[0]
-    broker_ids = np.asarray(metadata.broker_ids + [-1] * (sentinel + 1 - len(metadata.broker_ids)))
+    broker_ids = _padded_broker_ids(metadata, sentinel)
     proposals: list[ExecutionProposal] = []
     for p in changed:
         if p >= len(metadata.partition_keys):
             continue
         topic, partition = metadata.partition_keys[p]
-        old = tuple(int(broker_ids[b]) for b in rb0[p] if b < sentinel)
-        new = tuple(int(broker_ids[b]) for b in rb1[p] if b < sentinel)
+        old = _row_ids(rb0[p], broker_ids, sentinel)
+        new = _row_ids(rb1[p], broker_ids, sentinel)
         if old == new:
             continue
         proposals.append(ExecutionProposal(topic=topic, partition=partition,
                                            old_leader=old[0] if old else -1,
                                            old_replicas=old, new_replicas=new))
+    return proposals
+
+
+def diff_proposals_vs_placement(placement: dict[tuple, list[int]],
+                                initial: FlatClusterModel,
+                                final: FlatClusterModel,
+                                metadata: ClusterMetadata,
+                                mutated_keys: set[tuple]
+                                ) -> list[ExecutionProposal]:
+    """Diff the final model against an explicit prior (live) placement
+    ({(topic, partition) -> leader-first broker ids}). Used by flows whose
+    optimization *input* already differs from the live cluster (e.g. a
+    replication-factor change mutates the spec before optimizing): the
+    executable proposals must capture the full live->final change, not
+    just the optimizer's own moves — and the two sides may have different
+    replication factors, which the padded-model diff cannot express.
+
+    A row can differ from the live placement only if the optimizer moved
+    it (vectorized initial-vs-final mask) or the mutator touched it
+    (``mutated_keys``, computed cheaply in spec space by the caller) — so
+    only that union pays Python-level tuple construction."""
+    rb0 = np.asarray(initial.replica_broker)
+    rb1 = np.asarray(final.replica_broker)
+    sentinel = final.broker_sentinel
+    broker_ids = _padded_broker_ids(metadata, sentinel)
+    changed = (rb0 != rb1).any(axis=1)
+    idx = {key: i for i, key in enumerate(metadata.partition_keys)}
+    candidates = set(np.nonzero(changed)[0].tolist())
+    candidates.update(idx[k] for k in mutated_keys if k in idx)
+    proposals: list[ExecutionProposal] = []
+    for p_idx in sorted(candidates):
+        if p_idx >= len(metadata.partition_keys):
+            continue
+        key = metadata.partition_keys[p_idx]
+        new = _row_ids(rb1[p_idx], broker_ids, sentinel)
+        old = tuple(placement.get(key, new))
+        if old == new:
+            continue
+        proposals.append(ExecutionProposal(topic=key[0], partition=key[1],
+                                           old_leader=old[0] if old else -1,
+                                           old_replicas=old,
+                                           new_replicas=new))
     return proposals
 
 

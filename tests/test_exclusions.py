@@ -1,0 +1,111 @@
+"""Per-goal exclusion semantics + replication-factor change (the rebuild of
+ExcludedBrokersForLeadershipTest / ExcludedBrokersForReplicaMoveTest /
+ReplicationFactorChangeTest from SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (OptimizationOptions, SearchConfig,
+                                         TpuGoalOptimizer, goals_by_name)
+from cruise_control_tpu.model.flat import sanity_check
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+
+CFG = SearchConfig(num_replica_candidates=128, num_dest_candidates=8,
+                   apply_per_iter=128, max_iters_per_goal=96,
+                   drain_batch=1024, drain_rounds=4)
+
+
+def _skewed(num_brokers=8, partitions=256):
+    brokers = [BrokerSpec(broker_id=b, rack=f"r{b % 4}",
+                          capacity=(100.0, 1e6, 1e6, 1e8))
+               for b in range(num_brokers)]
+    parts = [PartitionSpec(topic=f"t{p % 6}", partition=p,
+                           replicas=[p % 3, 3 + p % 3],
+                           leader_load=(0.02, 5.0, 6.0, 40.0 + p % 11))
+             for p in range(partitions)]
+    return flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
+
+
+def _run(model, md, names, **kw):
+    opt = TpuGoalOptimizer(goals=goals_by_name(names), config=CFG)
+    return opt.optimize(model, md, OptimizationOptions(
+        seed=0, skip_hard_goal_check=True, **kw))
+
+
+def test_excluded_brokers_receive_no_replicas():
+    """ref ExcludedBrokersForReplicaMoveTest: brokers excluded from replica
+    movement must not GAIN replicas (their existing replicas may leave)."""
+    model, md = _skewed()
+    excluded = frozenset({6, 7})
+    res = _run(model, md, ["ReplicaDistributionGoal",
+                           "DiskUsageDistributionGoal"],
+               excluded_brokers_for_replica_move=excluded)
+    for prop in res.proposals:
+        gained = set(prop.new_replicas) - set(prop.old_replicas)
+        assert not (gained & excluded), (prop.to_json(), gained)
+    assert all(int(v) == 0 for v in np.asarray(
+        list(sanity_check(res.final_model).values())))
+
+
+def test_excluded_brokers_receive_no_leadership():
+    """ref ExcludedBrokersForLeadershipTest: excluded brokers must not
+    BECOME leaders of any partition they weren't already leading."""
+    model, md = _skewed()
+    excluded = frozenset({0, 1})
+    res = _run(model, md, ["LeaderReplicaDistributionGoal",
+                           "NetworkOutboundUsageDistributionGoal"],
+               excluded_brokers_for_leadership=excluded)
+    rb0 = np.asarray(model.replica_broker)
+    rbF = np.asarray(res.final_model.replica_broker)
+    for p in range(md.num_partitions):
+        new_leader = int(rbF[p, 0])
+        if new_leader in excluded:
+            assert int(rb0[p, 0]) == new_leader, \
+                f"partition {p}: leadership moved ONTO excluded broker"
+
+
+@pytest.mark.parametrize("target_rf", [3, 1])
+def test_replication_factor_change(target_rf):
+    """ref ReplicationFactorChangeTest: RF up adds rack-diverse replicas,
+    RF down drops non-leaders; untouched topics keep their RF."""
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import (LoadMonitor,
+                                            LoadMonitorTaskRunner,
+                                            MetricFetcherManager,
+                                            MonitorConfig,
+                                            SyntheticWorkloadSampler)
+    from cruise_control_tpu.api import KafkaCruiseControl
+    sim = SimulatedKafkaCluster()
+    for b in range(6):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    for p in range(24):
+        sim.add_partition(f"t{p % 2}", p, [p % 3, 3 + p % 3], size_mb=10.0)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=1000,
+                                             min_samples_per_window=1))
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=1000)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        runner.maybe_run_sampling((w + 1) * 1000 - 1)
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(
+            goals=goals_by_name(["RackAwareGoal",
+                                 "ReplicaDistributionGoal"]), config=CFG),
+        now_ms=lambda: 4000)
+    res, _ = facade.update_topic_configuration("t0", target_rf, dryrun=True)
+    # The proposals' new replica sets carry the authoritative outcome
+    # (diffed against the LIVE pre-mutation placement).
+    changed = {(pr.topic, pr.partition): pr for pr in res.proposals}
+    for (topic, num), pr in changed.items():
+        if topic == "t0":
+            assert len(set(pr.new_replicas)) == target_rf, pr.to_json()
+        else:
+            assert len(set(pr.new_replicas)) == 2, pr.to_json()
+    # Every t0 partition not in proposals already had the target RF.
+    infos = sim.describe_partitions()
+    for (topic, num), info in infos.items():
+        if topic == "t0" and (topic, num) not in changed:
+            assert len(set(info.replicas)) == target_rf
