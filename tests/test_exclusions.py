@@ -109,3 +109,43 @@ def test_replication_factor_change(target_rf):
     for (topic, num), info in infos.items():
         if topic == "t0" and (topic, num) not in changed:
             assert len(set(info.replicas)) == target_rf
+
+
+def test_demote_broker_moves_all_leadership_off():
+    """ref DemoteBrokerRunnable + PreferredLeaderElectionGoalTest: after a
+    demote, the broker leads nothing (it keeps its replicas) and the
+    preferred order no longer names it first anywhere."""
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import (LoadMonitor,
+                                            LoadMonitorTaskRunner,
+                                            MetricFetcherManager,
+                                            MonitorConfig,
+                                            SyntheticWorkloadSampler)
+    from cruise_control_tpu.api import KafkaCruiseControl
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    for p in range(24):
+        sim.add_partition(f"t{p % 2}", p, [p % 4, (p + 1) % 4], size_mb=10.0)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=1000,
+                                             min_samples_per_window=1))
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=1000)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        runner.maybe_run_sampling((w + 1) * 1000 - 1)
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(config=CFG), now_ms=lambda: 4000)
+    res, _ = facade.demote_brokers([0], dryrun=True)
+    rbF = np.asarray(res.final_model.replica_broker)
+    # Broker 0 led some partitions before; it must lead none after...
+    leaders_after = set(int(b) for b in rbF[:24, 0])
+    assert 0 not in leaders_after, "demoted broker still leads partitions"
+    # ...but it keeps its replicas (a demote is not a drain).
+    still_hosts = (rbF[:24] == 0).any()
+    assert still_hosts, "demote must not remove the broker's replicas"
+    # And the proposals' new preferred order never names it first.
+    for prop in res.proposals:
+        assert prop.new_replicas[0] != 0, prop.to_json()
