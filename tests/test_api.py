@@ -162,6 +162,23 @@ def test_proposals_served_from_cache(stack):
     assert "goalSummary" in body
 
 
+def test_proposal_cache_invalidated_by_new_generation(stack):
+    """ref GoalOptimizer cache validity :232-239: a model-generation bump
+    (new sampling round) invalidates the cached proposals."""
+    _, facade, app = stack
+    call(app, "GET", "proposals")
+    n = facade.proposal_cache.num_computations
+    assert facade.proposal_cache.valid()
+    # A new sampling round rolls the aggregation window -> generation bump.
+    last = facade.task_runner._last_sample_ms or 0
+    assert facade.task_runner.maybe_run_sampling(last + WINDOW_MS)
+    assert not facade.proposal_cache.valid()
+    status, _body, _ = call(app, "GET", "proposals")
+    assert status == 200
+    assert facade.proposal_cache.num_computations == n + 1  # recomputed
+    assert facade.proposal_cache.valid()
+
+
 def test_pause_resume_sampling(stack):
     _, facade, app = stack
     call(app, "POST", "pause_sampling", "reason=maintenance")
