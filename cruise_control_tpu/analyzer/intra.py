@@ -89,6 +89,12 @@ def build_disk_state(model, metadata, admin, capacity_resolver
     dirs_by_broker: dict[int, set[str]] = {}
     for (t, p, b), d in placement.items():
         dirs_by_broker.setdefault(b, set()).add(d)
+    # Configured-but-empty logdirs are valid drain destinations the
+    # placement scan can't reveal (ref AdminClient.describeLogDirs).
+    conf_fn = getattr(admin, "describe_logdirs", None)
+    if conf_fn is not None:
+        for b, dirs in conf_fn().items():
+            dirs_by_broker.setdefault(b, set()).update(dirs)
     for broker_id in metadata.broker_ids:
         info = capacity_resolver.capacity_for_broker("", "", broker_id)
         by_dir = info.disk_capacity_by_logdir

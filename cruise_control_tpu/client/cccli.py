@@ -22,7 +22,7 @@ import urllib.request
 
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
-                 "permissions", "bootstrap", "train"}
+                 "permissions", "bootstrap", "train", "openapi"}
 
 
 class CruiseControlClient:
@@ -97,7 +97,8 @@ def build_parser() -> argparse.ArgumentParser:
     sub = ap.add_subparsers(dest="endpoint", required=True)
 
     for name in ("state", "kafka_cluster_state", "user_tasks",
-                 "review_board", "permissions", "proposals", "load", "train"):
+                 "review_board", "permissions", "proposals", "load", "train",
+                 "openapi"):
         sub.add_parser(name)
     p = sub.add_parser("partition_load")
     p.add_argument("--resource", default="DISK")
@@ -116,6 +117,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topic", required=True)
     p.add_argument("--replication-factor", type=int, required=True)
     p = sub.add_parser("rightsize")
+    p = sub.add_parser("remove_disks")
+    _add_common(p, "dryrun")
+    p.add_argument("--brokerid-and-logdirs", required=True,
+                   help="<id>-<logdir>[,<id>-<logdir>...]")
     p = sub.add_parser("stop_proposal_execution")
     for name in ("pause_sampling", "resume_sampling"):
         p = sub.add_parser(name)

@@ -75,6 +75,12 @@ class ClusterAdminClient(Protocol):
     def describe_replica_log_dirs(self) -> dict[tuple[str, int, int], str]:
         ...
 
+    # Optional (not part of the required Protocol surface):
+    # ``describe_logdirs() -> dict[int, list[str]]`` — all LIVE configured
+    # logdirs per broker, including empty ones (ref
+    # AdminClient.describeLogDirs, which omits offline dirs). Callers fall
+    # back to the dirs observed in replica placement when absent.
+
     def alter_broker_config(self, broker_id: int, config: dict[str, str | None]
                             ) -> None:
         """Set (or delete, value None) dynamic broker configs (throttles)."""

@@ -123,6 +123,14 @@ class SimulatedKafkaCluster:
         return {b.broker_id: sorted(b.failed_logdirs)
                 for b in self._brokers.values() if b.failed_logdirs}
 
+    def describe_logdirs(self) -> dict[int, list[str]]:
+        """All LIVE configured logdirs per broker, including empty ones
+        (ref AdminClient.describeLogDirs, which omits offline dirs) —
+        empty disks are valid drain destinations the replica placement
+        alone can't reveal; failed ones are not."""
+        return {b.broker_id: sorted(set(b.logdirs) - b.failed_logdirs)
+                for b in self._brokers.values()}
+
     def offline_replicas(self) -> set[tuple[str, int, int]]:
         """Replicas currently offline: hosted on a dead broker or a failed
         logdir (feeds the monitor's per-replica offline marks)."""
