@@ -218,3 +218,31 @@ def test_self_healing_integration_broker_failure():
     state = mgr.state_json()
     assert state["numSelfHealingStarted"] == 1
     assert state["recentAnomalies"]["BROKER_FAILURE"]
+
+
+def test_balancedness_score_in_state_endpoint():
+    """The balancedness gauge [0,100] (ref GoalViolationDetector.
+    balancednessScore) surfaces under /state?substates=anomaly_detector,
+    and the substates filter narrows the payload."""
+    import json
+    import urllib.request
+
+    import sys
+    sys.path.insert(0, "tests")
+    from test_api import build_stack
+    sim, facade, app = build_stack()
+    try:
+        det = AnomalyDetectorManager(facade, SelfHealingNotifier())
+        det.register(GoalViolationDetector(facade.monitor, facade.optimizer),
+                     60_000)
+        facade.detector = det
+        det.run_once()
+        st = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/kafkacruisecontrol/state"
+            f"?substates=anomaly_detector"))
+        ad = st.get("AnomalyDetectorState", {})
+        assert ad.get("balancednessScore") is not None
+        assert 0.0 <= ad["balancednessScore"] <= 100.0
+        assert "MonitorState" not in st      # substates filter applied
+    finally:
+        app.stop()
