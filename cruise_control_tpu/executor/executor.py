@@ -22,6 +22,8 @@ part of the reference that stays off the TPU.
 from __future__ import annotations
 
 import enum
+import logging
+import sys
 import threading
 import time as _time
 from dataclasses import dataclass, field
@@ -89,6 +91,12 @@ class ExecutionResult:
 
 class OngoingExecutionError(RuntimeError):
     """ref OngoingExecutionException."""
+
+
+#: Audit trail of execution lifecycle events (ref the reference's
+#: dedicated OPERATION_LOG logger, ``Executor.java`` notifyExecutionFinished
+#: / operation log appender in config/log4j.properties).
+OPERATION_LOG = logging.getLogger("cruise_control_tpu.operation")
 
 
 class Executor:
@@ -162,6 +170,7 @@ class Executor:
             self._task_manager = ExecutionTaskManager()
             self._current_uuid = uuid
         started = self._now_ms()
+        uid = uuid or "(no-uuid)"
         tm = self._task_manager
         throttler = ReplicationThrottleHelper(
             self.admin, throttle_bytes
@@ -184,13 +193,25 @@ class Executor:
                      if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
             throttler.set_throttles(inter)
             self.notifier.on_execution_started(uuid)
+            OPERATION_LOG.info(
+                "Execution %s started: %d inter-broker, %d intra-broker, "
+                "%d leadership tasks", uid, len(inter),
+                len(intra_broker_moves or []),
+                sum(1 for t in tasks
+                    if t.task_type is TaskType.LEADER_ACTION))
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
             self._run_inter_broker_phase(planner, concurrency, adjuster,
                                          strategy_context)
+            if not self._stop_requested.is_set():
+                OPERATION_LOG.info(
+                    "Execution %s: inter-broker phase complete", uid)
             self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
             self._run_intra_broker_phase(planner, concurrency)
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
             self._run_leadership_phase(planner, concurrency)
+            if not self._stop_requested.is_set():
+                OPERATION_LOG.info(
+                    "Execution %s: leadership phase complete", uid)
         finally:
             stopped = self._stop_requested.is_set()
             if stopped:
@@ -207,6 +228,15 @@ class Executor:
                 started_ms=started, finished_ms=self._now_ms(),
                 stopped=stopped, num_dead_tasks=dead)
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
+            # An in-flight exception must not be recorded as a success.
+            exc = sys.exc_info()[1]
+            outcome = ("STOPPED" if stopped
+                       else f"FAILED ({type(exc).__name__})" if exc
+                       else "finished")
+            OPERATION_LOG.info(
+                "Execution %s %s: %s (%d dead tasks, %.1fs)", uid, outcome,
+                result.state_counts, dead,
+                (result.finished_ms - result.started_ms) / 1000.0)
             self.notifier.on_execution_finished(result)
         return result
 

@@ -320,6 +320,12 @@ def main(argv=None) -> int:
     ap.add_argument("--demo-brokers", type=int, default=64)
     ap.add_argument("--demo-partitions", type=int, default=2048)
     args = ap.parse_args(argv)
+    # Server logging (ref config/log4j.properties): INFO to stdout so the
+    # OPERATION_LOG audit trail and component logs actually appear.
+    import logging
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     # Fall back to CPU when the default accelerator backend is unreachable
     # (same probe bench.py uses) — a control plane must come up regardless.
     from .utils.platform import ensure_live_backend
