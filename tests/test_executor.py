@@ -251,3 +251,48 @@ def test_task_state_machine_rejects_illegal_transitions():
     t.transition(TaskState.IN_PROGRESS, 1)
     t.transition(TaskState.COMPLETED, 2)
     assert t.done and t.end_time_ms == 2
+
+
+def test_operation_log_audit_trail(caplog):
+    """Execution lifecycle lands in the OPERATION_LOG audit logger (ref
+    the reference's dedicated operation-log appender), with failures
+    recorded as FAILED rather than finished."""
+    import logging
+
+    sim = make_cluster()
+    clock = SimClock(sim)
+    ex = Executor(sim, ExecutorConfig(progress_check_interval_ms=100),
+                  now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    props = [ExecutionProposal(topic="t", partition=0, old_leader=0,
+                               old_replicas=(0, 1), new_replicas=(0, 2))]
+    with caplog.at_level(logging.INFO, logger="cruise_control_tpu.operation"):
+        res = ex.execute_proposals(props, uuid="audit-1")
+    assert res.succeeded
+    msgs = [r.getMessage() for r in caplog.records
+            if r.name == "cruise_control_tpu.operation"]
+    assert any("audit-1 started" in m for m in msgs), msgs
+    assert any("audit-1 finished" in m for m in msgs), msgs
+
+    class BoomAdmin:
+        def __getattr__(self, name):
+            return getattr(sim, name)
+
+        def alter_partition_reassignments(self, targets):
+            raise IOError("boom")
+
+    ex2 = Executor(BoomAdmin(), ExecutorConfig(progress_check_interval_ms=100),
+                   now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    caplog.clear()
+    with caplog.at_level(logging.INFO,
+                         logger="cruise_control_tpu.operation"):
+        try:
+            ex2.execute_proposals(
+                [ExecutionProposal(topic="t", partition=1, old_leader=1,
+                                   old_replicas=(1, 2), new_replicas=(1, 0))],
+                uuid="audit-2")
+        except IOError:
+            pass
+    msgs = [r.getMessage() for r in caplog.records
+            if r.name == "cruise_control_tpu.operation"]
+    assert any("audit-2 FAILED (OSError)" in m for m in msgs), msgs
+    assert not any("audit-2 finished" in m for m in msgs), msgs
