@@ -254,10 +254,11 @@ class CompiledGoalChain:
         self.cfg = cfg
         # Warmup bookkeeping: keyed by the (state, ctx) shape signature —
         # one chain serves models of different padded sizes, each needing
-        # its own compile. The lock makes a background startup warmup and
-        # a concurrent request share one compilation instead of racing
+        # its own compile. Per-key events let distinct shape signatures
+        # compile concurrently (their compiles are independent) while
+        # duplicate keys coalesce onto one compilation instead of racing
         # into two full parallel compiles.
-        self._warmed_keys: set[tuple] = set()
+        self._warm_events: dict[tuple, threading.Event] = {}
         self._warm_lock = threading.Lock()
         self.passes = []
         for i, g in enumerate(self.goals):
@@ -285,22 +286,49 @@ class CompiledGoalChain:
         chain costs tens of minutes on TPU; warmed-up it is the cost of
         the slowest single pass. No-op when these shapes were already
         warmed; concurrent callers serialize on one compilation."""
+        import threading
         wkey = self._shape_key(state, ctx)
-        with self._warm_lock:
-            if wkey in self._warmed_keys:
-                return
-            # AOT executables don't feed the jit dispatch cache directly;
-            # the persistent cache is the bridge that makes the follow-up
-            # jitted call cheap. Idempotent, and falls back gracefully.
-            from ..utils.platform import enable_compilation_cache
-            enable_compilation_cache()
-            from concurrent.futures import ThreadPoolExecutor
-            jobs = [(p, (state, ctx, key)) for p in self.passes]
-            jobs.append((self._violations, (state, ctx)))
-            with ThreadPoolExecutor(max_workers
-                                    or min(len(jobs), 16)) as ex:
-                list(ex.map(lambda j: j[0].lower(*j[1]).compile(), jobs))
-            self._warmed_keys.add(wkey)
+        while True:
+            with self._warm_lock:
+                event = self._warm_events.get(wkey)
+                if event is None:
+                    event = threading.Event()
+                    self._warm_events[wkey] = event
+                    owner = True
+                else:
+                    owner = False
+            if not owner:
+                # Another thread is (or finished) compiling this exact
+                # shape — wait it out; a *different* shape key never
+                # blocks here.
+                event.wait()
+                with self._warm_lock:
+                    if self._warm_events.get(wkey) is event:
+                        return          # owner succeeded
+                continue   # owner failed and popped the key: retry as owner
+            try:
+                # AOT executables don't feed the jit dispatch cache
+                # directly; the persistent cache is the bridge that makes
+                # the follow-up jitted call cheap. Idempotent, and falls
+                # back gracefully.
+                from ..utils.platform import enable_compilation_cache
+                enable_compilation_cache()
+                from concurrent.futures import ThreadPoolExecutor
+                jobs = [(p, (state, ctx, key)) for p in self.passes]
+                jobs.append((self._violations, (state, ctx)))
+                with ThreadPoolExecutor(max_workers
+                                        or min(len(jobs), 16)) as ex:
+                    list(ex.map(lambda j: j[0].lower(*j[1]).compile(), jobs))
+            except BaseException:
+                # Failed warmups must not poison the key: drop the event so
+                # waiters and later calls retry the compile instead of
+                # returning instantly as if warmed.
+                with self._warm_lock:
+                    self._warm_events.pop(wkey, None)
+                event.set()
+                raise
+            event.set()
+            return
 
     def violations(self, state, ctx) -> jax.Array:
         """f32[num_goals] residual per goal."""

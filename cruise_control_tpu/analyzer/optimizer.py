@@ -106,7 +106,9 @@ class TpuGoalOptimizer:
     def __init__(self, goals: list[GoalKernel] | None = None,
                  constraint: BalancingConstraint | None = None,
                  config: SearchConfig | None = None,
-                 options_generator=None):
+                 options_generator=None,
+                 registry=None):
+        from ..core.sensors import (GOAL_OPTIMIZER_SENSOR, MetricRegistry)
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
@@ -115,14 +117,26 @@ class TpuGoalOptimizer:
         #: proposal cache and the goal-violation detector (which call
         #: optimize() directly, not through the facade) can't bypass it.
         self.options_generator = options_generator
+        import threading
         self._chains: dict[tuple, CompiledGoalChain] = {}
+        self._chains_lock = threading.Lock()
+        self.registry = registry or MetricRegistry()
+        # ref GoalOptimizer.java:128 proposal-computation-timer.
+        self._proposal_timer = self.registry.timer(MetricRegistry.name(
+            GOAL_OPTIMIZER_SENSOR, "proposal-computation-timer"))
 
     def _chain_for(self, cfg: SearchConfig, goals: list[GoalKernel]
                    ) -> CompiledGoalChain:
         key = (cfg, tuple(g.bind_signature() for g in goals))
-        if key not in self._chains:
-            self._chains[key] = CompiledGoalChain(goals, cfg)
-        return self._chains[key]
+        # Locked get-or-create: optimizers are shared across request threads
+        # (facade memoization), and two racing first requests must converge
+        # on ONE chain object — CompiledGoalChain.warmup coalesces compiles
+        # per instance, so distinct instances would each pay the full
+        # parallel XLA compile.
+        with self._chains_lock:
+            if key not in self._chains:
+                self._chains[key] = CompiledGoalChain(goals, cfg)
+            return self._chains[key]
 
     def _prepare(self, model: FlatClusterModel, metadata: ClusterMetadata,
                  options: OptimizationOptions):
@@ -252,10 +266,13 @@ class TpuGoalOptimizer:
 
         final = to_model(state, model)
         proposals = diff_proposals(model, final, metadata)
+        duration_s = time.monotonic() - t0
+        # ref GoalOptimizer.java:183 _proposalComputationTimer.update.
+        self._proposal_timer.update(duration_s)
         result = OptimizerResult(
             proposals=proposals, goal_results=goal_results,
             num_moves=int(jax.device_get(state.moves_applied)),
-            duration_s=time.monotonic() - t0, final_model=final,
+            duration_s=duration_s, final_model=final,
             provision_response=self._provision_verdict(final, goal_results))
         if result.violated_hard_goals and not options.skip_hard_goal_check:
             raise OptimizationFailureError(

@@ -59,6 +59,26 @@ class KafkaCruiseControl:
         # feeds CPU estimation for samples that lack broker CPU.
         self.cpu_model = cpu_model or LinearRegressionModelParameters()
         self._lock = threading.RLock()
+        #: goal-name tuple -> memoized goal-scoped optimizer (see
+        #: :meth:`_optimizer_for`); insertion-ordered for LRU eviction.
+        self._goal_optimizers: dict[tuple, TpuGoalOptimizer] = {}
+        #: merged self-metric view over the wired subsystems (each owns a
+        #: private registry so independent stacks in one process never share
+        #: sensor state — ref KafkaCruiseControl.java:112 threading one
+        #: dropwizardMetricRegistry through every constructor; here the
+        #: facade is the aggregation point instead). Resolved at scrape
+        #: time so a detector attached after construction is included.
+        from ..core.sensors import CompositeRegistry
+
+        def _registries():
+            regs = [self.optimizer.registry, self.monitor.registry,
+                    self.executor.registry]
+            if self.detector is not None and hasattr(self.detector,
+                                                     "registry"):
+                regs.append(self.detector.registry)
+            return regs
+
+        self.registry = CompositeRegistry(_registries)
 
     # ----------------------------------------------------------- lifecycle
     def start_up(self, precompute_interval_s: float = 30.0,
@@ -79,6 +99,36 @@ class KafkaCruiseControl:
             self.detector.stop_detection()
 
     # ------------------------------------------------------ goal-based ops
+    #: LRU bound on memoized goal-scoped optimizers — goal lists come from
+    #: request parameters, so without a cap a client cycling goal subsets
+    #: would accumulate compiled XLA chains without limit.
+    MAX_GOAL_OPTIMIZERS = 16
+
+    def _optimizer_for(self, goals: list[str] | None) -> "TpuGoalOptimizer":
+        """Memoize goal-scoped optimizers by goal-name tuple so repeated
+        requests naming the same custom goals reuse one compiled-chain
+        cache instead of paying a fresh XLA compile per request (the
+        persistent disk cache only softens that; the in-process jit
+        dispatch cache is per-optimizer). Shares the server optimizer's
+        registry so goal-scoped proposal timings surface on /metrics."""
+        if not goals:
+            return self.optimizer
+        key = tuple(goals)
+        with self._lock:
+            opt = self._goal_optimizers.pop(key, None)
+            if opt is None:
+                opt = TpuGoalOptimizer(
+                    goals=goals_by_name(goals, self.optimizer.constraint),
+                    constraint=self.optimizer.constraint,
+                    config=self.optimizer.config,
+                    options_generator=self.optimizer.options_generator,
+                    registry=self.optimizer.registry)
+            self._goal_optimizers[key] = opt   # re-insert = most recent
+            while len(self._goal_optimizers) > self.MAX_GOAL_OPTIMIZERS:
+                self._goal_optimizers.pop(
+                    next(iter(self._goal_optimizers)))
+            return opt
+
     def _optimize(self, progress: OperationProgress | None,
                   goals: list[str] | None,
                   options: OptimizationOptions,
@@ -106,13 +156,7 @@ class KafkaCruiseControl:
         # a request naming goals must not silently optimize against
         # default thresholds (ref goalsByPriority resolution reusing the
         # configured BalancingConstraint).
-        opt = (TpuGoalOptimizer(goals=goals_by_name(
-                                    goals, self.optimizer.constraint),
-                                constraint=self.optimizer.constraint,
-                                config=self.optimizer.config,
-                                options_generator=self.optimizer
-                                .options_generator)
-               if goals else self.optimizer)
+        opt = self._optimizer_for(goals)
         if progress:
             progress.add_step("OptimizationProposalCandidateComputation")
         on_goal = ((lambda name: progress.add_step(f"OptimizationForGoal-"
@@ -378,6 +422,10 @@ class KafkaCruiseControl:
                                       ["monitor", "executor", "analyzer",
                                        "anomaly_detector"])}
         out: dict = {}
+        # Numeric self-metrics snapshot (ref the JMX-exposed Dropwizard
+        # registry; substates=sensors scopes a response to just these).
+        if "sensors" in wanted:
+            out["Sensors"] = self.registry.to_json()
         if "monitor" in wanted:
             mon = self.monitor.state(self._now_ms()).to_json()
             if self.task_runner is not None:

@@ -107,12 +107,16 @@ class JwtSecurityProvider:
 
     def __init__(self, secret: bytes | str, *, role_claim: str = "role",
                  default_role: Role = Role.VIEWER,
-                 now_s: "Callable[[], float] | None" = None):
+                 now_s: "Callable[[], float] | None" = None,
+                 max_token_age_s: float | None = None):
         import time
         self.secret = secret.encode() if isinstance(secret, str) else secret
         self.role_claim = role_claim
         self.default_role = default_role
         self._now_s = now_s or time.time
+        #: hard cap on token lifetime from ``iat``; tokens older than this
+        #: are rejected even if their ``exp`` lies further out.
+        self.max_token_age_s = max_token_age_s
 
     @staticmethod
     def _b64url_decode(part: str) -> bytes:
@@ -163,14 +167,32 @@ class JwtSecurityProvider:
                           hashlib.sha256).digest()
         if not hmac.compare_digest(sig, expect):
             raise AuthorizationError("bad JWT signature", 401)
-        exp = claims.get("exp")
-        if exp is not None:
+        now = self._now_s()
+
+        def _ts(claim: str, required: bool) -> float | None:
+            v = claims.get(claim)
+            if v is None:
+                if required:
+                    raise AuthorizationError(
+                        f"JWT missing required {claim} claim", 401)
+                return None
             try:
-                exp = float(exp)
+                return float(v)
             except (TypeError, ValueError):
-                raise AuthorizationError("malformed JWT exp claim", 401)
-            if self._now_s() >= exp:
-                raise AuthorizationError("JWT expired", 401)
+                raise AuthorizationError(f"malformed JWT {claim} claim", 401)
+
+        # A token without exp would be valid forever (irrevocable if the
+        # shared secret leaks), so exp is mandatory here even though RFC 7519
+        # makes it optional.
+        if now >= _ts("exp", required=True):
+            raise AuthorizationError("JWT expired", 401)
+        nbf = _ts("nbf", required=False)
+        if nbf is not None and now < nbf:
+            raise AuthorizationError("JWT not yet valid (nbf)", 401)
+        if self.max_token_age_s is not None:
+            iat = _ts("iat", required=True)
+            if now - iat > self.max_token_age_s:
+                raise AuthorizationError("JWT exceeds max token age", 401)
         name = claims.get("sub")
         if not name:
             raise AuthorizationError("JWT missing sub claim", 401)

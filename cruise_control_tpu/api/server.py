@@ -407,6 +407,26 @@ def _make_handler(app: CruiseControlApp):
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            # /metrics: Prometheus text exposition of the self-metric
+            # sensors (the HTTP stand-in for the reference's JMX-exposed
+            # Dropwizard registry). Viewer-gated like /state.
+            if method == "GET" and parts in (
+                    ["metrics"], ["kafkacruisecontrol", "metrics"]):
+                headers = {k.lower(): v for k, v in self.headers.items()}
+                try:
+                    check_access(app.security, "state", headers)
+                except AuthorizationError as e:
+                    self._send(e.status, {"errorMessage": str(e)},
+                               _auth_headers(e, app.security))
+                    return
+                body = app.facade.registry.expose_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             # paths: /kafkacruisecontrol/<endpoint>
             if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
                 self._send(404, {"errorMessage": f"bad path {parsed.path}"})

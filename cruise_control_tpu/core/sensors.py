@@ -1,0 +1,323 @@
+"""Self-metric sensors: the framework's own observability registry.
+
+Rebuild of the reference's Dropwizard ``MetricRegistry`` usage — a registry
+threaded through every subsystem constructor (ref
+``KafkaCruiseControl.java:112``, ``GoalOptimizer.java:128``
+``proposal-computation-timer``, ``LoadMonitor.java:101``
+``cluster-model-creation-timer``, ``Executor.java:256-420`` execution
+gauges/timers, ``AnomalyDetectorManager.java:183-216`` balancedness and
+self-healing sensors, ``ExecutionTaskTracker.java:103-122`` per-state task
+gauges) — exposed over HTTP instead of JMX: ``/metrics`` serves a
+Prometheus-style text exposition and ``/state`` embeds the JSON snapshot.
+
+Sensor types mirror the Dropwizard quartet:
+
+- :class:`Counter` — monotonically increasing count.
+- :class:`Meter` — count + event rate over a sliding window (ref Dropwizard
+  ``Meter``'s one-minute rate; here an exact sliding-window rate, not an
+  EWMA — simpler, and exact for the test clock).
+- :class:`Timer` — durations with count/mean/max and streaming quantiles
+  over a bounded reservoir.
+- :class:`Gauge` — a callable read at scrape time (ref dropwizard
+  ``Gauge<T>`` lambdas registered at constructor time).
+
+All sensors are thread-safe; reads never block writers for long.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Callable
+
+
+class Counter:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self) -> None:
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def count(self) -> int:
+        return self._value
+
+    def to_json(self) -> dict:
+        return {"type": "counter", "count": self._value}
+
+
+class Meter:
+    """Count + sliding-window rate (events/s over the last ``window_s``)."""
+
+    __slots__ = ("_count", "_events", "_window_s", "_lock", "_now")
+
+    def __init__(self, window_s: float = 60.0,
+                 now: Callable[[], float] | None = None) -> None:
+        self._count = 0
+        self._events: list[tuple[float, int]] = []
+        self._window_s = window_s
+        self._lock = threading.Lock()
+        self._now = now or time.monotonic
+
+    def mark(self, n: int = 1) -> None:
+        now = self._now()
+        with self._lock:
+            self._count += n
+            self._events.append((now, n))
+            cutoff = now - self._window_s
+            while self._events and self._events[0][0] < cutoff:
+                self._events.pop(0)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def rate(self) -> float:
+        now = self._now()
+        cutoff = now - self._window_s
+        with self._lock:
+            total = sum(n for t, n in self._events if t >= cutoff)
+        return total / self._window_s
+
+    def to_json(self) -> dict:
+        return {"type": "meter", "count": self._count,
+                "rate_per_s": round(self.rate(), 6)}
+
+
+class Timer:
+    """Duration sensor: count / mean / max / quantiles over a bounded
+    reservoir (most recent ``reservoir`` observations)."""
+
+    __slots__ = ("_count", "_sum", "_max", "_reservoir", "_cap", "_lock")
+
+    def __init__(self, reservoir: int = 1024) -> None:
+        self._count = 0
+        self._sum = 0.0
+        self._max = 0.0
+        self._reservoir: list[float] = []
+        self._cap = reservoir
+        self._lock = threading.Lock()
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._sum += seconds
+            self._max = max(self._max, seconds)
+            if len(self._reservoir) >= self._cap:
+                self._reservoir.pop(0)
+            self._reservoir.append(seconds)
+
+    def time(self):
+        """Context manager: ``with timer.time(): ...``"""
+        return _TimerContext(self)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean_s(self) -> float:
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self._reservoir:
+                return 0.0
+            data = sorted(self._reservoir)
+        idx = min(int(q * len(data)), len(data) - 1)
+        return data[idx]
+
+    def to_json(self) -> dict:
+        return {"type": "timer", "count": self._count,
+                "mean_s": round(self.mean_s, 6),
+                "max_s": round(self._max, 6),
+                "p50_s": round(self.quantile(0.50), 6),
+                "p95_s": round(self.quantile(0.95), 6),
+                "p99_s": round(self.quantile(0.99), 6)}
+
+
+class _TimerContext:
+    __slots__ = ("_timer", "_start")
+
+    def __init__(self, timer: Timer) -> None:
+        self._timer = timer
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._timer.update(time.perf_counter() - self._start)
+        return False
+
+
+class Gauge:
+    """Callable read at scrape time (ref Dropwizard ``Gauge<T>``).
+    Scrape errors surface as None rather than failing the whole report."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    def value(self):
+        try:
+            return self._fn()
+        except Exception:
+            return None
+
+    def to_json(self) -> dict:
+        return {"type": "gauge", "value": self.value()}
+
+
+class MetricRegistry:
+    """Named sensor registry (ref ``com.codahale.metrics.MetricRegistry``).
+
+    Names follow the reference's dotted ``<group>.<sensor>`` convention,
+    e.g. ``GoalOptimizer.proposal-computation-timer``. ``timer``/``meter``/
+    ``counter`` are get-or-create (idempotent); ``gauge`` re-registration
+    replaces the callable (matching ``register``'s last-wins usage for
+    refreshed lambdas).
+    """
+
+    def __init__(self) -> None:
+        self._sensors: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def name(group: str, sensor: str) -> str:
+        return f"{group}.{sensor}"
+
+    def _get_or_create(self, name: str, factory, kind) -> object:
+        with self._lock:
+            s = self._sensors.get(name)
+            if s is None:
+                s = factory()
+                self._sensors[name] = s
+            elif not isinstance(s, kind):
+                raise TypeError(
+                    f"sensor {name!r} already registered as "
+                    f"{type(s).__name__}")
+            return s
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter, Counter)
+
+    def meter(self, name: str, window_s: float = 60.0,
+              now: Callable[[], float] | None = None) -> Meter:
+        return self._get_or_create(
+            name, lambda: Meter(window_s, now), Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get_or_create(name, Timer, Timer)
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> Gauge:
+        with self._lock:
+            g = Gauge(fn)
+            self._sensors[name] = g
+            return g
+
+    def get(self, name: str):
+        return self._sensors.get(name)
+
+    def names(self) -> list[str]:
+        return sorted(self._sensors)
+
+    # -------------------------------------------------------------- export
+    def to_json(self) -> dict:
+        """{name: sensor-json} snapshot for ``/state``."""
+        with self._lock:
+            items = list(self._sensors.items())
+        return {name: s.to_json() for name, s in sorted(items)}
+
+    def expose_text(self) -> str:
+        """Prometheus-style text exposition for ``/metrics``.
+
+        Sensor names are flattened to ``cc_<group>_<sensor>`` with
+        dots/dashes mapped to underscores; timers emit ``_count``,
+        ``_mean_seconds``, quantile series, meters ``_total`` and
+        ``_rate``, counters ``_total``, gauges the bare name.
+        """
+        def flat(name: str) -> str:
+            out = []
+            for ch in name:
+                out.append(ch if (ch.isalnum() or ch == "_") else "_")
+            return "cc_" + "".join(out)
+
+        lines: list[str] = []
+        with self._lock:
+            items = sorted(self._sensors.items())
+        for name, s in items:
+            base = flat(name)
+            if isinstance(s, Counter):
+                lines.append(f"# TYPE {base}_total counter")
+                lines.append(f"{base}_total {s.count}")
+            elif isinstance(s, Meter):
+                lines.append(f"# TYPE {base}_total counter")
+                lines.append(f"{base}_total {s.count}")
+                lines.append(f"# TYPE {base}_rate gauge")
+                lines.append(f"{base}_rate {s.rate():.6f}")
+            elif isinstance(s, Timer):
+                lines.append(f"# TYPE {base}_seconds summary")
+                for q in (0.5, 0.95, 0.99):
+                    lines.append(f"{base}_seconds{{quantile=\"{q}\"}} "
+                                 f"{s.quantile(q):.6f}")
+                lines.append(f"{base}_seconds_count {s.count}")
+                lines.append(f"{base}_seconds_sum {s._sum:.6f}")
+            elif isinstance(s, Gauge):
+                v = s.value()
+                if v is None:
+                    continue
+                lines.append(f"# TYPE {base} gauge")
+                try:
+                    lines.append(f"{base} {float(v):.6f}")
+                except (TypeError, ValueError):
+                    lines.pop()   # drop the TYPE line for non-numeric gauges
+        return "\n".join(lines) + "\n"
+
+
+class CompositeRegistry:
+    """Read-only merged view over several registries, resolved at scrape
+    time. The facade exposes one of these spanning its wired subsystems, so
+    two independently constructed stacks in one process never share sensor
+    state (each subsystem defaults to its own private registry) while
+    ``/metrics`` and ``/state?substates=sensors`` still see everything.
+    Subsystem sensor names are group-prefixed, so merges cannot collide."""
+
+    def __init__(self, sources: Callable[[], list[MetricRegistry]]) -> None:
+        self._sources = sources
+
+    def get(self, name: str):
+        for reg in self._sources():
+            s = reg.get(name)
+            if s is not None:
+                return s
+        return None
+
+    def names(self) -> list[str]:
+        out: set[str] = set()
+        for reg in self._sources():
+            out.update(reg.names())
+        return sorted(out)
+
+    def to_json(self) -> dict:
+        out: dict = {}
+        for reg in self._sources():
+            out.update(reg.to_json())
+        return dict(sorted(out.items()))
+
+    def expose_text(self) -> str:
+        return "".join(reg.expose_text() for reg in self._sources())
+
+
+#: Sensor group names (ref CruiseControlMetrics sensor name constants).
+GOAL_OPTIMIZER_SENSOR = "GoalOptimizer"
+LOAD_MONITOR_SENSOR = "LoadMonitor"
+EXECUTOR_SENSOR = "Executor"
+ANOMALY_DETECTOR_SENSOR = "AnomalyDetector"
+USER_TASKS_SENSOR = "UserTaskManager"

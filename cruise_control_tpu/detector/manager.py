@@ -36,7 +36,8 @@ class DetectorSchedule:
 class AnomalyDetectorManager:
     def __init__(self, facade, notifier: AnomalyNotifier | None = None,
                  provisioner: Provisioner | None = None,
-                 now_ms=None) -> None:
+                 now_ms=None, registry=None) -> None:
+        from ..core.sensors import (ANOMALY_DETECTOR_SENSOR, MetricRegistry)
         self.facade = facade
         self.notifier = notifier or SelfHealingNotifier()
         self.provisioner = provisioner or BasicProvisioner(facade.admin)
@@ -53,6 +54,36 @@ class AnomalyDetectorManager:
         self.num_self_healing_started = 0
         self.num_self_healing_failed = 0
         self.ongoing_self_healing: str | None = None
+        # Anomaly sensors (ref AnomalyDetectorManager.java:183-216
+        # balancedness-score gauge + per-type anomaly-rate meters,
+        # AnomalyDetectorState.java:116-118 self-healing counts and
+        # mean-time-to-start-fix).
+        self.registry = registry or MetricRegistry()
+        _n = MetricRegistry.name
+        self.registry.gauge(
+            _n(ANOMALY_DETECTOR_SENSOR, "balancedness-score"),
+            self._balancedness)
+        self.registry.gauge(
+            _n(ANOMALY_DETECTOR_SENSOR, "number-of-self-healing-started"),
+            lambda: self.num_self_healing_started)
+        self.registry.gauge(
+            _n(ANOMALY_DETECTOR_SENSOR, "number-of-self-healing-failed"),
+            lambda: self.num_self_healing_failed)
+        self.registry.gauge(
+            _n(ANOMALY_DETECTOR_SENSOR, "num-queued-anomalies"),
+            lambda: len(self._queue))
+        self._anomaly_meters = {
+            t: self.registry.meter(_n(ANOMALY_DETECTOR_SENSOR,
+                                      f"{t.name.lower()}-rate"))
+            for t in KafkaAnomalyType}
+        self._time_to_start_fix = self.registry.timer(
+            _n(ANOMALY_DETECTOR_SENSOR, "time-to-start-fix"))
+
+    def _balancedness(self):
+        for sched in self._schedules:
+            if hasattr(sched.detector, "last_balancedness"):
+                return sched.detector.last_balancedness
+        return None
 
     # ---------------------------------------------------------- wiring
     def register(self, detector, interval_ms: int,
@@ -103,6 +134,7 @@ class AnomalyDetectorManager:
             heapq.heappush(self._queue,
                            (int(anomaly.anomaly_type), ready_ms,
                             next(self._counter), anomaly))
+            self._anomaly_meters[anomaly.anomaly_type].mark()
             history = self.recent_anomalies[anomaly.anomaly_type]
             history.append(anomaly.to_json())
             del history[:-10]
@@ -134,6 +166,9 @@ class AnomalyDetectorManager:
                 fixed += 1
                 just_fixed.add((anomaly.anomaly_type, anomaly.reason()))
                 self.num_self_healing_started += 1
+                # ref AnomalyDetectorState mean-time-to-start-fix-ms.
+                self._time_to_start_fix.update(
+                    max(now - anomaly.detected_ms, 0) / 1000.0)
                 self.ongoing_self_healing = anomaly.anomaly_id
                 try:
                     ok = anomaly.fix(self.facade)

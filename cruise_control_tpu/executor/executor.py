@@ -104,7 +104,8 @@ class Executor:
                  config: ExecutorConfig | None = None,
                  notifier: ExecutorNotifier | None = None,
                  topic_config_provider=None,
-                 now_ms=None, sleep_ms=None) -> None:
+                 now_ms=None, sleep_ms=None, registry=None) -> None:
+        from ..core.sensors import (EXECUTOR_SENSOR, MetricRegistry)
         self.admin = admin
         self.config = config or ExecutorConfig()
         self.notifier = notifier or ExecutorNotifier()
@@ -125,6 +126,24 @@ class Executor:
         #: brokers removed/demoted by recent executions (ref Executor.java:426-434)
         self.recently_removed_brokers: set[int] = set()
         self.recently_demoted_brokers: set[int] = set()
+        # Execution sensors (ref Executor.java:256-266
+        # proposal-execution-timer, ExecutionTaskTracker.java:121-122
+        # movement-rate meters, Executor.java:348-360 ongoing gauges).
+        self.registry = registry or MetricRegistry()
+        _n = MetricRegistry.name
+        self._execution_timer = self.registry.timer(
+            _n(EXECUTOR_SENSOR, "proposal-execution-timer"))
+        self._partition_move_meter = self.registry.meter(
+            _n(EXECUTOR_SENSOR, "partition-movement-rate"))
+        self._leadership_move_meter = self.registry.meter(
+            _n(EXECUTOR_SENSOR, "leadership-movement-rate"))
+        self._executions_started = self.registry.counter(
+            _n(EXECUTOR_SENSOR, "executions-started"))
+        self._executions_stopped = self.registry.counter(
+            _n(EXECUTOR_SENSOR, "executions-stopped"))
+        self.registry.gauge(
+            _n(EXECUTOR_SENSOR, "has-ongoing-execution"),
+            lambda: int(self.has_ongoing_execution()))
 
     # ------------------------------------------------------------- state
     @property
@@ -170,6 +189,7 @@ class Executor:
             self._task_manager = ExecutionTaskManager()
             self._current_uuid = uuid
         started = self._now_ms()
+        self._executions_started.inc()
         uid = uuid or "(no-uuid)"
         tm = self._task_manager
         throttler = ReplicationThrottleHelper(
@@ -227,6 +247,10 @@ class Executor:
                 uuid=uuid, state_counts=tm.tracker.summary(),
                 started_ms=started, finished_ms=self._now_ms(),
                 stopped=stopped, num_dead_tasks=dead)
+            self._execution_timer.update(
+                (result.finished_ms - result.started_ms) / 1000.0)
+            if stopped:
+                self._executions_stopped.inc()
             self._state = ExecutorState.NO_TASK_IN_PROGRESS
             # An in-flight exception must not be recorded as a success.
             exc = sys.exc_info()[1]
@@ -310,6 +334,7 @@ class Executor:
             tp = t.topic_partition
             if tp not in ongoing:
                 tm.tracker.transition(t, TaskState.COMPLETED, now)
+                self._partition_move_meter.mark()
                 continue
             # Dead destination => the copy can never finish (ref
             # ExecutionUtils.maybeMarkTaskAsDead): cancel + DEAD.
@@ -386,10 +411,11 @@ class Executor:
             now = self._now_ms()
             for t in batch:
                 tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                ok = errors.get(t.topic_partition) is None
                 tm.tracker.transition(
-                    t,
-                    TaskState.COMPLETED if errors.get(t.topic_partition) is None
-                    else TaskState.DEAD, now)
+                    t, TaskState.COMPLETED if ok else TaskState.DEAD, now)
+                if ok:
+                    self._leadership_move_meter.mark()
             if tm.tracker.num_remaining(tt) > 0:
                 self._sleep_ms(self.config.progress_check_interval_ms)
 

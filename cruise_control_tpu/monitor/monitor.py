@@ -97,7 +97,9 @@ class LoadMonitor:
                  capacity_resolver: BrokerCapacityConfigResolver | None = None,
                  rack_by_broker: dict[int, str] | None = None,
                  broker_set_resolver=None,
-                 max_concurrent_model_builds: int = 2) -> None:
+                 max_concurrent_model_builds: int = 2,
+                 registry=None) -> None:
+        from ..core.sensors import (LOAD_MONITOR_SENSOR, MetricRegistry)
         self.admin = admin
         self.config = config or MonitorConfig()
         self.capacity_resolver = capacity_resolver or FixedCapacityResolver()
@@ -115,6 +117,20 @@ class LoadMonitor:
         #: semaphore LoadMonitor.java:94,396); thread-safety of ingest lives
         #: inside MetricSampleAggregator's own lock.
         self._model_semaphore = threading.Semaphore(max_concurrent_model_builds)
+        self.registry = registry or MetricRegistry()
+        # ref LoadMonitor.java:101 cluster-model-creation-timer; the
+        # valid-windows / monitored-partitions gauges mirror
+        # LoadMonitor.java:104-110 sensor registrations.
+        self._model_timer = self.registry.timer(MetricRegistry.name(
+            LOAD_MONITOR_SENSOR, "cluster-model-creation-timer"))
+        self.registry.gauge(
+            MetricRegistry.name(LOAD_MONITOR_SENSOR,
+                                "total-monitored-windows"),
+            self.partition_aggregator.num_available_windows)
+        self.registry.gauge(
+            MetricRegistry.name(LOAD_MONITOR_SENSOR,
+                                "num-monitored-partitions"),
+            lambda: len(self.partition_aggregator.all_entities()))
 
     # -------------------------------------------------------------- ingest
     def add_samples(self, samples: Samples) -> None:
@@ -189,7 +205,7 @@ class LoadMonitor:
         :439). Raises NotEnoughValidWindowsError when the sample history
         cannot satisfy ``requirements``."""
         requirements = requirements or ModelCompletenessRequirements()
-        with self._model_semaphore:
+        with self._model_semaphore, self._model_timer.time():
             return self._build_model(now_ms, requirements,
                                      populate_replica_placement_only)
 

@@ -134,7 +134,18 @@ class PrometheusMetricSampler:
         return self.broker_id_by_host.get(host)
 
     def get_samples(self, assignment: SamplerAssignment) -> Samples:
-        bsamples: dict[int, BrokerMetricSample] = {}
+        # One sample per (entity, resolution step), like the reference: the
+        # PrometheusMetricSampler iterates every (timestamp, value) pair of
+        # each range-query series and emits a sample per step, so a window
+        # accumulates windows/step samples rather than one per round.
+        # The assignment window is treated as half-open (start, end]:
+        # Prometheus query_range includes both endpoints, and consecutive
+        # sampling rounds share a boundary (round N's end is round N+1's
+        # start), so keeping an inclusive start would double-ingest every
+        # boundary point into the aggregator (sums/counts skew).
+        start_ms = assignment.start_ms
+        boundary_skipped = 0
+        bsamples: dict[tuple[int, int], BrokerMetricSample] = {}
         wanted_brokers = set(assignment.brokers)
         series_seen = 0
         unresolved_hosts: set[str] = set()
@@ -150,17 +161,17 @@ class PrometheusMetricSampler:
                     continue
                 if broker not in wanted_brokers:
                     continue
-                if not series.values:
-                    continue
-                # Latest value in the window, like the reference records one
-                # sample per scrape round.
-                _, value = series.values[-1]
-                s = bsamples.setdefault(
-                    broker, BrokerMetricSample(broker, assignment.end_ms))
-                s.record(metric, value)
+                for ts_s, value in series.values:
+                    ts_ms = int(ts_s * 1000)
+                    if ts_ms <= start_ms:
+                        boundary_skipped += 1
+                        continue
+                    s = bsamples.setdefault(
+                        (broker, ts_ms), BrokerMetricSample(broker, ts_ms))
+                    s.record(metric, value)
 
         wanted = set(assignment.partitions)
-        psamples: dict[tuple[str, int], PartitionMetricSample] = {}
+        psamples: dict[tuple[str, int, int], PartitionMetricSample] = {}
         for metric, query in self.partition_queries.items():
             for series in self.adapter.query_range(
                     query, assignment.start_ms, assignment.end_ms,
@@ -172,21 +183,29 @@ class PrometheusMetricSampler:
                 tp = (topic, int(part))
                 if tp not in wanted:
                     continue
-                _, value = series.values[-1]
-                s = psamples.setdefault(
-                    tp, PartitionMetricSample(tp[0], tp[1],
-                                              assignment.end_ms))
-                s.record(metric, value)
-        # A scrape that returns series but resolves none of them to brokers
-        # is a host-map misconfiguration, not an empty cluster — fail loudly
-        # here instead of starving the monitor into
-        # NotEnoughValidWindowsException with no cause attached.
-        if series_seen and not bsamples and not psamples:
+                for ts_s, value in series.values:
+                    ts_ms = int(ts_s * 1000)
+                    if ts_ms <= start_ms:
+                        boundary_skipped += 1
+                        continue
+                    s = psamples.setdefault(
+                        (tp[0], tp[1], ts_ms),
+                        PartitionMetricSample(tp[0], tp[1], ts_ms))
+                    s.record(metric, value)
+        # A scrape that returns series but records no sample at all is a
+        # host-map misconfiguration (unresolved hosts, or hosts resolving
+        # to broker ids outside the cluster), not an empty cluster — fail
+        # loudly here instead of starving the monitor into
+        # NotEnoughValidWindowsException with no cause attached. Points
+        # dropped only by the half-open start boundary are legitimate.
+        if (series_seen and not bsamples and not psamples
+                and not boundary_skipped):
             raise IOError(
-                f"prometheus returned {series_seen} series but no instance "
-                f"host resolved to a broker id; unresolved hosts "
-                f"{sorted(unresolved_hosts)[:5]} vs configured "
-                f"{sorted(self.broker_id_by_host)[:5]} — check "
+                f"prometheus returned {series_seen} series but none "
+                f"resolved to a wanted broker id; unresolved hosts "
+                f"{sorted(unresolved_hosts)[:5]}, configured host map "
+                f"{sorted(self.broker_id_by_host)[:5]}, wanted brokers "
+                f"{sorted(wanted_brokers)[:5]} — check "
                 "prometheus.broker.host.map.file")
         # CPU attribution: the reference estimates partition CPU from broker
         # CPU x the partition's share of broker bytes

@@ -60,10 +60,30 @@ class LoadMonitorTaskRunner:
                 replayed = (len(samples.partition_samples)
                             + len(samples.broker_samples))
             self._state = RunnerState.RUNNING
-            # Leave unset: the first maybe_run_sampling is immediately due
-            # (the reference's sampling loop fetches right at startup) and
-            # covers one interval back.
-            self._last_sample_ms = None
+            if replayed:
+                # Seed from the newest replayed sample so the first live
+                # round starts where the store left off — otherwise it
+                # re-covers [now-interval, now) and double-ingests samples
+                # just replayed from that window (sample_counts inflate).
+                # Clamped into [now - aggregator retention, now]: after a
+                # long downtime the catch-up fetch is bounded by what the
+                # windows can retain anyway (an uncapped range would be one
+                # giant query — Prometheus rejects >11K points/series — and
+                # a future timestamp from clock skew would stall sampling).
+                newest = max(s.time_ms
+                             for s in (samples.partition_samples
+                                       + samples.broker_samples))
+                c = self.monitor.config
+                retention_ms = max(
+                    c.num_windows * c.window_ms,
+                    c.num_broker_windows * c.broker_window_ms)
+                self._last_sample_ms = min(
+                    max(newest, now_ms - retention_ms), now_ms)
+            else:
+                # Leave unset: the first maybe_run_sampling is immediately
+                # due (the reference's sampling loop fetches right at
+                # startup) and covers one interval back.
+                self._last_sample_ms = None
             return replayed
 
     def pause(self, reason: str = "") -> None:

@@ -186,3 +186,62 @@ def test_leadership_distribution():
     leaders = np.asarray(res.final_model.replica_broker[:, 0][:12])
     counts = np.bincount(leaders, minlength=4)[:4]
     assert counts.max() <= 5, f"leaders still skewed: {counts}"
+
+
+def test_warmup_waiter_retries_after_owner_failure(monkeypatch):
+    # Two threads warm the same shape key; the owner's compile fails. The
+    # waiter must not return as if warmed — it retries and succeeds.
+    import threading
+
+    from cruise_control_tpu.analyzer.engine import CompiledGoalChain
+    from cruise_control_tpu.analyzer.goals import goals_by_name as _gbn
+    from cruise_control_tpu.analyzer.state import build_context, init_state
+    import cruise_control_tpu.utils.platform as platform_mod
+    import jax
+
+    model, md = flatten_spec(
+        make_cluster(num_brokers=2, topics=1, parts_per_topic=4))
+    chain = CompiledGoalChain(_gbn(["ReplicaDistributionGoal"]), CFG)
+    ctx = build_context(model)
+    state = init_state(model)
+    key = jax.random.PRNGKey(0)
+
+    calls = {"n": 0}
+    real = platform_mod.enable_compilation_cache
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient compile-service failure")
+        return real()
+
+    monkeypatch.setattr(platform_mod, "enable_compilation_cache", flaky)
+
+    owner_err: list = []
+
+    def owner():
+        try:
+            chain.warmup(state, ctx, key)
+        except RuntimeError as e:
+            owner_err.append(e)
+
+    t = threading.Thread(target=owner)
+    t.start()
+    # Wait until the spawned thread has actually entered warmup as the
+    # first owner (its first act inside the try is the flaky call) so the
+    # injected failure deterministically lands on it, not on this thread.
+    import time as _t
+    deadline = _t.time() + 10
+    while calls["n"] == 0 and _t.time() < deadline:
+        _t.sleep(0.001)
+    assert calls["n"] >= 1, "owner thread never reached warmup"
+    # This thread arrives second: either it waits on the owner's event and
+    # retries after the failure, or (if the owner already failed and popped
+    # the key) it becomes the new owner. Both paths must end warmed — never
+    # a silent not-warmed return.
+    chain.warmup(state, ctx, key)
+    t.join()
+    assert owner_err, "the owner's failure must propagate to its caller"
+    wkey = chain._shape_key(state, ctx)
+    assert chain._warm_events[wkey].is_set()
+    assert calls["n"] >= 2

@@ -74,6 +74,53 @@ def test_prometheus_sampler_maps_series_to_samples():
     assert by_tp[("t0", 0)].values[int(KafkaMetric.LEADER_BYTES_IN)] == 600
 
 
+def test_prometheus_sampler_one_sample_per_resolution_step():
+    # The reference sampler emits one sample per (timestamp, value) pair of
+    # each range-query series — a scrape over N steps must yield N samples
+    # per entity, not just the latest point.
+    def multi_step(url: str) -> str:
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(url).query)["query"][0]
+        if "node_cpu_seconds_total" in q:
+            return _prom_response([
+                ({"instance": "b0.example.com:7071"},
+                 [[30.0, "0.1"], [60.0, "0.2"], [90.0, "0.3"]]),
+            ])
+        return _prom_response([])
+
+    adapter = PrometheusAdapter("http://prom:9090", http_get=multi_step)
+    sampler = PrometheusMetricSampler(adapter, {"b0.example.com": 0})
+    out = sampler.get_samples(SamplerAssignment(
+        partitions=[], brokers=[0], start_ms=0, end_ms=120_000))
+    cpu = int(BrokerMetric.CPU_USAGE)
+    got = sorted((s.time_ms, s.values[cpu]) for s in out.broker_samples)
+    assert got == [(30_000, pytest.approx(0.1)),
+                   (60_000, pytest.approx(0.2)),
+                   (90_000, pytest.approx(0.3))]
+
+
+def test_prometheus_sampler_excludes_start_boundary_point():
+    # query_range includes both endpoints and consecutive rounds share a
+    # boundary (round N's end == round N+1's start), so the window must be
+    # half-open (start, end] or every boundary point is ingested twice.
+    def series(url: str) -> str:
+        from urllib.parse import parse_qs, urlparse
+        q = parse_qs(urlparse(url).query)["query"][0]
+        if "node_cpu_seconds_total" in q:
+            return _prom_response([
+                ({"instance": "b0.example.com:7071"},
+                 [[60.0, "0.1"], [90.0, "0.2"], [120.0, "0.3"]]),
+            ])
+        return _prom_response([])
+
+    adapter = PrometheusAdapter("http://prom:9090", http_get=series)
+    sampler = PrometheusMetricSampler(adapter, {"b0.example.com": 0})
+    out = sampler.get_samples(SamplerAssignment(
+        partitions=[], brokers=[0], start_ms=60_000, end_ms=120_000))
+    got = sorted(s.time_ms for s in out.broker_samples)
+    assert got == [90_000, 120_000]     # the 60s boundary point is skipped
+
+
 def test_prometheus_adapter_error_status_raises():
     adapter = PrometheusAdapter(
         "http://prom:9090",
@@ -89,7 +136,7 @@ SECRET = "s3cret"
 
 
 def _token(**extra):
-    claims = {"sub": "alice", "role": "USER", **extra}
+    claims = {"sub": "alice", "role": "USER", "exp": 10_000.0, **extra}
     return JwtSecurityProvider.encode(SECRET, claims)
 
 
@@ -110,8 +157,9 @@ def test_jwt_rejects_expired_tampered_and_missing():
         prov.authenticate({"authorization": f"Bearer {_token(exp=2000)}"})
     tok = _token()
     head, payload, sig = tok.split(".")
-    evil = JwtSecurityProvider.encode(SECRET, {"sub": "mallory",
-                                               "role": "ADMIN"}).split(".")[1]
+    evil = JwtSecurityProvider.encode(
+        SECRET, {"sub": "mallory", "role": "ADMIN",
+                 "exp": 10_000.0}).split(".")[1]
     with pytest.raises(AuthorizationError, match="signature"):
         prov.authenticate({"authorization": f"Bearer {head}.{evil}.{sig}"})
     with pytest.raises(AuthorizationError, match="bearer"):
@@ -119,6 +167,27 @@ def test_jwt_rejects_expired_tampered_and_missing():
     with pytest.raises(AuthorizationError, match="signature"):
         JwtSecurityProvider("other").authenticate(
             {"authorization": f"Bearer {tok}"})
+
+
+def test_jwt_requires_exp_checks_nbf_and_max_age():
+    prov = JwtSecurityProvider(SECRET, now_s=lambda: 5000.0)
+    # No-exp tokens would be valid forever — rejected outright.
+    noexp = JwtSecurityProvider.encode(SECRET, {"sub": "alice",
+                                               "role": "USER"})
+    with pytest.raises(AuthorizationError, match="exp"):
+        prov.authenticate({"authorization": f"Bearer {noexp}"})
+    # Not valid before nbf.
+    with pytest.raises(AuthorizationError, match="nbf"):
+        prov.authenticate({"authorization": f"Bearer {_token(nbf=6000)}"})
+    assert prov.authenticate(
+        {"authorization": f"Bearer {_token(nbf=4000)}"}).name == "alice"
+    # Max token age caps lifetime from iat even when exp lies further out.
+    capped = JwtSecurityProvider(SECRET, now_s=lambda: 5000.0,
+                                 max_token_age_s=600)
+    with pytest.raises(AuthorizationError, match="age"):
+        capped.authenticate({"authorization": f"Bearer {_token(iat=1000)}"})
+    assert capped.authenticate(
+        {"authorization": f"Bearer {_token(iat=4800)}"}).name == "alice"
 
 
 # ------------------------------------------------------------------ webhooks

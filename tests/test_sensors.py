@@ -1,0 +1,132 @@
+"""Self-metric sensor tests: the MetricRegistry quartet, subsystem wiring
+(proposal-computation-timer, cluster-model-creation-timer, executor and
+anomaly-detector sensors) and the /metrics + /state?substates=sensors HTTP
+surface (the rebuild of the reference's Dropwizard sensor assertions, e.g.
+ExecutorTest/LoadMonitorTest constructing a MetricRegistry and asserting
+registered sensor updates)."""
+
+import urllib.request
+
+import pytest
+
+from cruise_control_tpu.core.sensors import (Counter, Gauge, Meter,
+                                             MetricRegistry, Timer)
+
+from test_api import build_stack, call
+
+
+# ------------------------------------------------------------- unit tests
+
+def test_counter_and_meter():
+    c = Counter()
+    c.inc()
+    c.inc(4)
+    assert c.count == 5
+    t = [0.0]
+    m = Meter(window_s=10.0, now=lambda: t[0])
+    m.mark(5)
+    t[0] = 5.0
+    m.mark(5)
+    assert m.count == 10
+    assert m.rate() == pytest.approx(1.0)      # 10 events over 10 s window
+    t[0] = 14.0                                 # first burst out of window
+    assert m.rate() == pytest.approx(0.5)
+
+
+def test_timer_quantiles_and_context_manager():
+    t = Timer()
+    for ms in range(1, 101):
+        t.update(ms / 1000.0)
+    assert t.count == 100
+    assert t.mean_s == pytest.approx(0.0505)
+    assert t.quantile(0.5) == pytest.approx(0.051)
+    assert t.quantile(0.99) == pytest.approx(0.1)
+    with t.time():
+        pass
+    assert t.count == 101
+
+
+def test_gauge_swallows_scrape_errors():
+    g = Gauge(lambda: 1 / 0)
+    assert g.value() is None
+    assert g.to_json() == {"type": "gauge", "value": None}
+
+
+def test_registry_get_or_create_and_type_conflict():
+    r = MetricRegistry()
+    name = MetricRegistry.name("G", "s")
+    assert name == "G.s"
+    assert r.timer(name) is r.timer(name)
+    with pytest.raises(TypeError):
+        r.counter(name)
+    r.gauge("G.g", lambda: 1.0)
+    r.gauge("G.g", lambda: 2.0)     # re-register replaces (last wins)
+    assert r.get("G.g").value() == 2.0
+
+
+def test_expose_text_prometheus_format():
+    r = MetricRegistry()
+    r.counter("Exec.runs-total").inc(3)
+    r.timer("Opt.proposal-computation-timer").update(0.5)
+    r.gauge("Det.balancedness-score", lambda: 87.5)
+    r.gauge("Det.none-gauge", lambda: None)
+    text = r.expose_text()
+    assert "cc_Exec_runs_total_total 3" in text
+    assert 'cc_Opt_proposal_computation_timer_seconds{quantile="0.5"} ' \
+           "0.500000" in text
+    assert "cc_Opt_proposal_computation_timer_seconds_count 1" in text
+    assert "cc_Det_balancedness_score 87.500000" in text
+    assert "none_gauge" not in text     # non-numeric gauges are dropped
+
+
+# ------------------------------------------------------ subsystem wiring
+
+@pytest.fixture(scope="module")
+def stack():
+    sim, facade, app = build_stack()
+    yield sim, facade, app
+    app.stop()
+
+
+def test_sensors_populated_through_the_stack(stack):
+    _, facade, app = stack
+    # Exercise the path: a proposals run times the optimizer + monitor.
+    status, _, _ = call(app, "GET", "proposals")
+    assert status == 200
+    reg = facade.registry
+    assert reg.get(
+        "GoalOptimizer.proposal-computation-timer").count >= 1
+    assert reg.get(
+        "LoadMonitor.cluster-model-creation-timer").count >= 1
+    assert reg.get("LoadMonitor.total-monitored-windows").value() >= 1
+    assert reg.get("Executor.has-ongoing-execution").value() == 0
+
+
+def test_state_sensors_substate_and_metrics_endpoint(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "state", "substates=sensors")
+    assert status == 200
+    assert "MonitorState" not in body
+    sensors = body["Sensors"]
+    assert sensors["GoalOptimizer.proposal-computation-timer"]["count"] >= 1
+    # /metrics text exposition
+    url = f"http://127.0.0.1:{app.port}/metrics"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "cc_GoalOptimizer_proposal_computation_timer_seconds_count" in text
+    assert "cc_LoadMonitor_cluster_model_creation_timer_seconds" in text
+
+
+def test_executor_sensors_after_execution(stack):
+    sim, facade, app = stack
+    status, body, _ = call(app, "POST", "rebalance",
+                           "dryrun=false&get_response_timeout_s=120")
+    assert status == 200, body
+    reg = facade.registry
+    assert reg.get("Executor.proposal-execution-timer").count >= 1
+    assert reg.get("Executor.executions-started").count >= 1
+    moved = (reg.get("Executor.partition-movement-rate").count
+             + reg.get("Executor.leadership-movement-rate").count)
+    assert moved > 0
