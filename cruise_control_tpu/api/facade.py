@@ -391,8 +391,10 @@ class KafkaCruiseControl:
         rows.sort(key=lambda r: -r[Resource(res_idx).name])
         return rows[start:start + max_entries]
 
-    def kafka_cluster_state(self) -> dict:
-        """ref KafkaClusterStateRequest: topology + replica health."""
+    def kafka_cluster_state(self, verbose: bool = False) -> dict:
+        """ref KafkaClusterStateRequest: topology + replica health.
+        ``verbose`` adds per-partition leader/replicas/ISR detail (ref
+        KafkaClusterState.writeKafkaClusterState verbose sections)."""
         parts = self.admin.describe_partitions()
         alive = self.admin.describe_cluster()
         under_replicated = [list(tp) for tp, i in parts.items()
@@ -414,7 +416,15 @@ class KafkaCruiseControl:
                 "KafkaPartitionState": {
                     "UnderReplicatedPartitions": under_replicated,
                     "OfflinePartitions": offline,
-                    "TotalPartitions": len(parts)}}
+                    "TotalPartitions": len(parts),
+                    **({"Partitions": [
+                        {"topic": i.topic, "partition": i.partition,
+                         "leader": i.leader, "replicas": list(i.replicas),
+                         "in-sync": sorted(i.isr),
+                         "size-MB": round(i.size_mb, 3)}
+                        for i in sorted(parts.values(),
+                                        key=lambda i: (i.topic, i.partition))
+                    ]} if verbose else {})}}
 
     def state(self, substates: list[str] | None = None) -> dict:
         """ref GetStateRunnable -> CruiseControlState with substates."""

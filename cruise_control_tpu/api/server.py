@@ -295,7 +295,8 @@ class CruiseControlApp:
             return 200, facade.state(substates.split(",") if substates
                                      else None), {}
         if endpoint == "kafka_cluster_state":
-            return 200, facade.kafka_cluster_state(), {}
+            return 200, facade.kafka_cluster_state(
+                verbose=_flag(params, "verbose")), {}
         if endpoint == "openapi":
             from .openapi import openapi_spec
             return 200, openapi_spec(), {}

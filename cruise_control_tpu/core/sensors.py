@@ -26,7 +26,6 @@ All sensors are thread-safe; reads never block writers for long.
 
 from __future__ import annotations
 
-import bisect
 import threading
 import time
 from typing import Callable
@@ -290,7 +289,17 @@ class CompositeRegistry:
     Subsystem sensor names are group-prefixed, so merges cannot collide."""
 
     def __init__(self, sources: Callable[[], list[MetricRegistry]]) -> None:
-        self._sources = sources
+        self._raw_sources = sources
+
+    def _sources(self) -> list[MetricRegistry]:
+        # Dedupe by identity: subsystems wired with ONE shared registry
+        # (the reference's single-registry pattern) must not emit every
+        # series once per subsystem.
+        out: list[MetricRegistry] = []
+        for reg in self._raw_sources():
+            if all(reg is not seen for seen in out):
+                out.append(reg)
+        return out
 
     def get(self, name: str):
         for reg in self._sources():

@@ -8,6 +8,10 @@ double used by tests and demos.
 """
 
 from .admin import ClusterAdminClient, PartitionInfo, ReassignmentInfo
+from .kafka_admin import (AdminAuthorizationError, AdminOperationError,
+                          AdminTimeoutError, KafkaAdminClusterClient,
+                          KafkaAdminWire, KafkaWireError,
+                          MockKafkaAdminWire)
 from .concurrency import (ConcurrencyAdjuster, ConcurrencyConfig,
                           ConcurrencyType, ExecutionConcurrencyManager)
 from .executor import (ExecutionResult, Executor, ExecutorConfig,
@@ -21,6 +25,9 @@ from .tasks import (ExecutionTask, ExecutionTaskManager, ExecutionTaskTracker,
 
 __all__ = [
     "ClusterAdminClient", "PartitionInfo", "ReassignmentInfo",
+    "AdminAuthorizationError", "AdminOperationError", "AdminTimeoutError",
+    "KafkaAdminClusterClient", "KafkaAdminWire", "KafkaWireError",
+    "MockKafkaAdminWire",
     "ConcurrencyAdjuster", "ConcurrencyConfig", "ConcurrencyType",
     "ExecutionConcurrencyManager", "ExecutionResult", "Executor",
     "ExecutorConfig", "ExecutorNotifier", "ExecutorState",

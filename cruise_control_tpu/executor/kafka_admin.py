@@ -1,0 +1,485 @@
+"""Real-cluster admin adapter: ``ClusterAdminClient`` over a Kafka admin
+wire client.
+
+The reference executor's bottom half drives Kafka through ``AdminClient``
+(``ExecutionUtils.java:446`` ``submitReplicaReassignmentTasks`` →
+``alterPartitionReassignments``, ``:407`` ``submitPreferredLeaderElection``
+→ ``electLeaders``, ``ExecutorAdminUtils`` logdir ops) and classifies
+per-partition failures from the returned futures
+(``processAlterPartitionReassignmentsResult`` ``ExecutionUtils.java:561``,
+``processElectLeadersResult`` ``:611``). This module is the TPU framework's
+equivalent: :class:`KafkaAdminClusterClient` implements the
+:class:`~cruise_control_tpu.executor.admin.ClusterAdminClient` protocol the
+executor consumes, on top of a narrow :class:`KafkaAdminWire` protocol
+shaped like ``confluent_kafka.admin.AdminClient`` (methods returning
+per-key futures). In production the wire is a ~50-line binding to
+confluent-kafka (not bundled in this environment); in tests it is
+:class:`MockKafkaAdminWire`, which reproduces broker-side error codes so
+the classification logic is contract-tested without a cluster.
+
+Error-code classification parity (reference lines in brackets):
+
+=============================  =============================================
+Kafka error                    adapter behavior
+=============================  =============================================
+INVALID_REPLICA_ASSIGNMENT     reassignment error "dead destination
+                               broker(s)" → executor marks the task DEAD
+                               [ExecutionUtils.java:574-576]
+UNKNOWN_TOPIC_OR_PARTITION     treated as deleted: reassignment/election
+                               reports an error mentioning "deleted"
+                               [:577-579, :630-633]
+NO_REASSIGNMENT_IN_PROGRESS    cancel of a non-ongoing reassignment —
+                               success (nothing to cancel) [:580-583]
+REQUEST_TIMED_OUT              raises :class:`AdminTimeoutError` — a
+                               cluster/controller-side issue, retryable at
+                               a higher level [:584-589, :654-658]
+ELECTION_NOT_NEEDED            election success (leader already preferred)
+                               [:625-627]
+PREFERRED_LEADER_NOT_AVAILABLE error (target offline); the executor's
+                               dead-task detection handles it [:634-636]
+CLUSTER_AUTHORIZATION_FAILED   raises :class:`AdminAuthorizationError`
+                               [:659-661]
+other                          raises :class:`AdminOperationError`
+                               (unexpected — surface loudly) [:590-592]
+=============================  =============================================
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from .admin import PartitionInfo, ReassignmentInfo
+
+
+class KafkaWireError(Exception):
+    """A broker-side error for one key of an admin request. ``code`` is the
+    Kafka protocol error name (``Errors`` enum name in the Java client)."""
+
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(f"{code}: {message}" if message else code)
+        self.code = code
+
+
+class AdminTimeoutError(RuntimeError):
+    """REQUEST_TIMED_OUT — check broker/controller health, consider raising
+    ``admin.client.request.timeout.ms`` (ref ExecutionUtils.java:584)."""
+
+
+class AdminAuthorizationError(RuntimeError):
+    """CLUSTER_AUTHORIZATION_FAILED (ref ExecutionUtils.java:659)."""
+
+
+class AdminOperationError(RuntimeError):
+    """An unclassified broker error (ref ExecutionUtils.java:590)."""
+
+
+class _Future(Protocol):
+    def result(self, timeout: float | None = None): ...
+
+
+class KafkaAdminWire(Protocol):
+    """The thin wire surface a production binding must provide — method
+    shapes mirror ``confluent_kafka.admin.AdminClient`` so the binding is
+    mechanical. Futures resolve to None (or a value) or raise
+    :class:`KafkaWireError` with the broker's error code."""
+
+    def describe_cluster(self) -> dict[int, dict]:
+        """broker id -> {"host": ..., "rack": ...} for LIVE brokers."""
+        ...
+
+    def list_topics(self) -> dict[tuple[str, int], dict]:
+        """(topic, partition) -> {"replicas": [...], "leader": int,
+        "isr": [...]}."""
+        ...
+
+    def alter_partition_reassignments(
+            self, targets: dict[tuple[str, int], list[int] | None]
+    ) -> dict[tuple[str, int], _Future]: ...
+
+    def list_partition_reassignments(
+            self) -> dict[tuple[str, int], dict]:
+        """tp -> {"target": [...], "adding": [...], "removing": [...]}."""
+        ...
+
+    def elect_leaders(self, tps: list[tuple[str, int]]
+                      ) -> dict[tuple[str, int], _Future]: ...
+
+    def describe_log_dirs(self) -> dict[int, dict[str, dict]]:
+        """broker -> logdir -> {"replicas": {(topic, part): size_bytes}}."""
+        ...
+
+    def alter_replica_log_dirs(
+            self, moves: dict[tuple[str, int, int], str]
+    ) -> dict[tuple[str, int, int], _Future]: ...
+
+    def describe_configs(self, resource_type: str, name: str
+                         ) -> dict[str, str]: ...
+
+    def incremental_alter_configs(
+            self, resource_type: str, name: str,
+            ops: dict[str, str | None]) -> _Future: ...
+
+
+class KafkaAdminClusterClient:
+    """``ClusterAdminClient`` adapter over a :class:`KafkaAdminWire`.
+
+    Stateless between calls; safe to share across executor phases. Broker
+    liveness is metadata-derived (a broker present in describe_cluster is
+    live — the reference does the same via ``Cluster.aliveBrokers``), so
+    ``known_brokers`` remembers every broker ever seen to report dead ones
+    as ``False`` rather than omitting them.
+    """
+
+    def __init__(self, wire: KafkaAdminWire,
+                 metrics_source=None) -> None:
+        self.wire = wire
+        #: optional callable broker_id -> {metric: value} feeding the
+        #: concurrency adjuster (the reference queries broker JMX through
+        #: its metric sampler; a Prometheus-backed source slots in here).
+        self.metrics_source = metrics_source
+        self.known_brokers: set[int] = set()
+
+    # ------------------------------------------------------------ topology
+    def describe_cluster(self) -> dict[int, bool]:
+        live = set(self.wire.describe_cluster())
+        self.known_brokers |= live
+        return {b: (b in live) for b in sorted(self.known_brokers)}
+
+    def describe_partitions(self) -> dict[tuple[str, int], PartitionInfo]:
+        # Index the logdir map per partition once: at real-cluster scale
+        # (10^5 replica entries) a per-partition rescan would make every
+        # executor progress poll and sampling round O(P x replicas).
+        by_tp: dict[tuple[str, int], list[tuple[int, str, float]]] = {}
+        for (t, p, b), (d, sz) in self._replica_logdirs_and_sizes().items():
+            by_tp.setdefault((t, p), []).append((b, d, sz))
+        out: dict[tuple[str, int], PartitionInfo] = {}
+        for (topic, part), meta in self.wire.list_topics().items():
+            entries = by_tp.get((topic, part), [])
+            out[(topic, part)] = PartitionInfo(
+                topic=topic, partition=part,
+                replicas=list(meta["replicas"]),
+                leader=int(meta.get("leader", -1)),
+                isr=set(meta.get("isr", ())),
+                size_mb=max((sz / 1e6 for _b, _d, sz in entries),
+                            default=0.0),
+                logdirs={b: d for b, d, _sz in entries})
+        return out
+
+    # ------------------------------------------------------- reassignments
+    def alter_partition_reassignments(
+            self, targets: dict[tuple[str, int], list[int] | None]
+    ) -> dict[tuple[str, int], str | None]:
+        """ref ExecutionUtils.submitReplicaReassignmentTasks (:446) +
+        processAlterPartitionReassignmentsResult (:561)."""
+        if not targets:
+            return {}
+        futures = self.wire.alter_partition_reassignments(targets)
+        errors: dict[tuple[str, int], str | None] = {}
+        for tp, fut in futures.items():
+            try:
+                fut.result()
+                errors[tp] = None
+            except KafkaWireError as e:
+                errors[tp] = self._classify_reassignment_error(
+                    tp, e, cancel=targets.get(tp) is None)
+        return errors
+
+    def _classify_reassignment_error(self, tp, e: KafkaWireError,
+                                     cancel: bool) -> str | None:
+        if e.code == "INVALID_REPLICA_ASSIGNMENT":
+            # Dead destination broker(s) — the executor marks the task DEAD
+            # (ref :574-576 deadTopicPartitions).
+            return "dead destination broker(s): INVALID_REPLICA_ASSIGNMENT"
+        if e.code == "UNKNOWN_TOPIC_OR_PARTITION":
+            # Topic deleted mid-execution (ref :577-579). A cancel for a
+            # deleted partition is a success (nothing left to move).
+            return None if cancel else "topic or partition deleted"
+        if e.code == "NO_REASSIGNMENT_IN_PROGRESS":
+            # Cancelling something that already finished (ref :580-583).
+            return None
+        if e.code == "REQUEST_TIMED_OUT":
+            raise AdminTimeoutError(
+                f"alterPartitionReassignments timed out for {tp}; check "
+                "broker/controller health and consider increasing "
+                "admin.client.request.timeout.ms") from e
+        raise AdminOperationError(
+            f"unexpected error for {tp}: {e.code}") from e
+
+    def list_partition_reassignments(
+            self) -> dict[tuple[str, int], ReassignmentInfo]:
+        return {tp: ReassignmentInfo(target=list(d.get("target", ())),
+                                     adding=list(d.get("adding", ())),
+                                     removing=list(d.get("removing", ())))
+                for tp, d in self.wire.list_partition_reassignments().items()}
+
+    # ----------------------------------------------------------- elections
+    def elect_preferred_leaders(self, tps: list[tuple[str, int]]
+                                ) -> dict[tuple[str, int], str | None]:
+        """ref ExecutionUtils.submitPreferredLeaderElection (:407) +
+        processElectLeadersResult (:611)."""
+        if not tps:
+            return {}
+        futures = self.wire.elect_leaders(list(tps))
+        errors: dict[tuple[str, int], str | None] = {}
+        for tp, fut in futures.items():
+            try:
+                fut.result()
+                errors[tp] = None
+            except KafkaWireError as e:
+                errors[tp] = self._classify_election_error(tp, e)
+        return errors
+
+    def _classify_election_error(self, tp, e: KafkaWireError) -> str | None:
+        if e.code == "ELECTION_NOT_NEEDED":
+            # Leader is already the preferred replica (ref :625-627).
+            return None
+        if e.code in ("UNKNOWN_TOPIC_OR_PARTITION", "INVALID_TOPIC_EXCEPTION"):
+            return "topic or partition deleted"
+        if e.code == "PREFERRED_LEADER_NOT_AVAILABLE":
+            # Preferred replica offline (ref :634-636): reported as an
+            # error so the executor's dead-task handling reacts; a later
+            # run re-elects once the broker returns.
+            return "preferred leader not available"
+        if e.code == "REQUEST_TIMED_OUT":
+            raise AdminTimeoutError(
+                f"electLeaders timed out for {tp}; check broker/controller "
+                "health and consider increasing "
+                "admin.client.request.timeout.ms") from e
+        if e.code == "CLUSTER_AUTHORIZATION_FAILED":
+            raise AdminAuthorizationError(
+                "not authorized to trigger leader election") from e
+        # NOT_CONTROLLER etc: the Java client drops the election on
+        # controller change; a follow-up execution re-elects (ref :637-641
+        # maybeReexecuteLeadershipTasks). Reported, not raised.
+        return f"election failed: {e.code}"
+
+    # -------------------------------------------------------------- logdirs
+    def _replica_logdirs_and_sizes(
+            self) -> dict[tuple[str, int, int], tuple[str, float]]:
+        out: dict[tuple[str, int, int], tuple[str, float]] = {}
+        for broker, dirs in self.wire.describe_log_dirs().items():
+            for logdir, info in dirs.items():
+                for (topic, part), size in info.get("replicas", {}).items():
+                    out[(topic, part, broker)] = (logdir, float(size))
+        return out
+
+    def describe_replica_log_dirs(self) -> dict[tuple[str, int, int], str]:
+        return {k: d for k, (d, _sz)
+                in self._replica_logdirs_and_sizes().items()}
+
+    def describe_logdirs(self) -> dict[int, list[str]]:
+        """All LIVE configured logdirs per broker, incl. empty ones (ref
+        AdminClient.describeLogDirs omitting offline dirs)."""
+        return {b: sorted(dirs)
+                for b, dirs in self.wire.describe_log_dirs().items()}
+
+    def alter_replica_log_dirs(self, moves: dict[tuple[str, int, int], str]
+                               ) -> dict[tuple[str, int, int], str | None]:
+        if not moves:
+            return {}
+        futures = self.wire.alter_replica_log_dirs(moves)
+        errors: dict[tuple[str, int, int], str | None] = {}
+        for key, fut in futures.items():
+            try:
+                fut.result()
+                errors[key] = None
+            except KafkaWireError as e:
+                if e.code == "REQUEST_TIMED_OUT":
+                    raise AdminTimeoutError(
+                        f"alterReplicaLogDirs timed out for {key}") from e
+                errors[key] = f"logdir move failed: {e.code}"
+        return errors
+
+    # -------------------------------------------------------------- configs
+    def _config_result(self, what: str, fut: _Future) -> None:
+        """Classify config-op failures like every other admin path — the
+        throttle helper calls alter_broker_config inside execute_proposals'
+        finally block, so a raw wire error would mask the original
+        in-flight exception and dodge AdminTimeoutError-based retries."""
+        try:
+            fut.result()
+        except KafkaWireError as e:
+            if e.code == "REQUEST_TIMED_OUT":
+                raise AdminTimeoutError(f"{what} timed out") from e
+            if e.code == "CLUSTER_AUTHORIZATION_FAILED":
+                raise AdminAuthorizationError(
+                    f"not authorized for {what}") from e
+            raise AdminOperationError(f"{what} failed: {e.code}") from e
+
+    def alter_broker_config(self, broker_id: int,
+                            config: dict[str, str | None]) -> None:
+        self._config_result(
+            f"alterConfigs(broker {broker_id})",
+            self.wire.incremental_alter_configs(
+                "broker", str(broker_id), config))
+
+    def describe_broker_config(self, broker_id: int) -> dict[str, str]:
+        return dict(self.wire.describe_configs("broker", str(broker_id)))
+
+    def alter_topic_config(self, topic: str,
+                           config: dict[str, str | None]) -> None:
+        self._config_result(
+            f"alterConfigs(topic {topic})",
+            self.wire.incremental_alter_configs("topic", topic, config))
+
+    def describe_topic_config(self, topic: str) -> dict[str, str]:
+        return dict(self.wire.describe_configs("topic", topic))
+
+    # -------------------------------------------------------------- metrics
+    def broker_metrics(self, broker_id: int) -> dict[str, float]:
+        if self.metrics_source is None:
+            return {}
+        return dict(self.metrics_source(broker_id))
+
+
+# --------------------------------------------------------------------------
+# Mock wire: broker-side behavior for contract tests (and a template for
+# what a confluent-kafka binding must surface).
+# --------------------------------------------------------------------------
+
+class _ImmediateFuture:
+    __slots__ = ("_exc", "_value")
+
+    def __init__(self, value=None, exc: Exception | None = None):
+        self._value = value
+        self._exc = exc
+
+    def result(self, timeout: float | None = None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+@dataclass
+class MockKafkaAdminWire:
+    """In-memory Kafka admin wire with reference broker error semantics:
+    unknown topics answer UNKNOWN_TOPIC_OR_PARTITION, reassignments to
+    non-live brokers answer INVALID_REPLICA_ASSIGNMENT, cancelling a
+    non-ongoing reassignment answers NO_REASSIGNMENT_IN_PROGRESS, electing
+    an already-preferred leader answers ELECTION_NOT_NEEDED, and electing
+    an offline preferred replica answers PREFERRED_LEADER_NOT_AVAILABLE.
+    ``fail_with`` injects one-shot arbitrary codes per key for timeout /
+    authorization paths."""
+
+    brokers: dict[int, dict] = field(default_factory=dict)
+    #: (topic, partition) -> {"replicas": [...], "leader": int, "isr": [...]}
+    partitions: dict[tuple[str, int], dict] = field(default_factory=dict)
+    logdirs: dict[int, dict[str, dict]] = field(default_factory=dict)
+    configs: dict[tuple[str, str], dict] = field(default_factory=dict)
+    ongoing: dict[tuple[str, int], dict] = field(default_factory=dict)
+    #: one-shot injected error codes: key -> code (popped on use)
+    fail_with: dict = field(default_factory=dict)
+
+    def _injected(self, key):
+        code = self.fail_with.pop(key, None)
+        return KafkaWireError(code) if code else None
+
+    def describe_cluster(self) -> dict[int, dict]:
+        return dict(self.brokers)
+
+    def list_topics(self) -> dict[tuple[str, int], dict]:
+        return {tp: dict(meta) for tp, meta in self.partitions.items()}
+
+    def alter_partition_reassignments(self, targets):
+        futures = {}
+        for tp, target in targets.items():
+            exc = self._injected(tp)
+            if exc is not None:
+                futures[tp] = _ImmediateFuture(exc=exc)
+            elif tp not in self.partitions:
+                futures[tp] = _ImmediateFuture(
+                    exc=KafkaWireError("UNKNOWN_TOPIC_OR_PARTITION"))
+            elif target is None:
+                if tp in self.ongoing:
+                    del self.ongoing[tp]
+                    futures[tp] = _ImmediateFuture()
+                else:
+                    futures[tp] = _ImmediateFuture(
+                        exc=KafkaWireError("NO_REASSIGNMENT_IN_PROGRESS"))
+            elif any(b not in self.brokers for b in target):
+                futures[tp] = _ImmediateFuture(
+                    exc=KafkaWireError("INVALID_REPLICA_ASSIGNMENT"))
+            else:
+                current = self.partitions[tp]["replicas"]
+                if set(target) == set(current):
+                    # Same-set reorder: metadata-only, Kafka applies it
+                    # instantly (no data copy, nothing to list as ongoing).
+                    self.partitions[tp]["replicas"] = list(target)
+                else:
+                    self.ongoing[tp] = {
+                        "target": list(target),
+                        "adding": [b for b in target if b not in current],
+                        "removing": [b for b in current if b not in target]}
+                futures[tp] = _ImmediateFuture()
+        return futures
+
+    def complete_reassignment(self, tp) -> None:
+        """Test hook: finish an in-flight reassignment broker-side."""
+        info = self.ongoing.pop(tp)
+        meta = self.partitions[tp]
+        meta["replicas"] = list(info["target"])
+        meta["isr"] = list(info["target"])
+
+    def list_partition_reassignments(self):
+        return {tp: dict(d) for tp, d in self.ongoing.items()}
+
+    def elect_leaders(self, tps):
+        futures = {}
+        for tp in tps:
+            exc = self._injected(tp)
+            if exc is not None:
+                futures[tp] = _ImmediateFuture(exc=exc)
+                continue
+            meta = self.partitions.get(tp)
+            if meta is None:
+                futures[tp] = _ImmediateFuture(
+                    exc=KafkaWireError("UNKNOWN_TOPIC_OR_PARTITION"))
+                continue
+            preferred = meta["replicas"][0]
+            if meta.get("leader") == preferred:
+                futures[tp] = _ImmediateFuture(
+                    exc=KafkaWireError("ELECTION_NOT_NEEDED"))
+            elif preferred not in self.brokers:
+                futures[tp] = _ImmediateFuture(
+                    exc=KafkaWireError("PREFERRED_LEADER_NOT_AVAILABLE"))
+            else:
+                meta["leader"] = preferred
+                futures[tp] = _ImmediateFuture()
+        return futures
+
+    def describe_log_dirs(self):
+        return {b: {d: {"replicas": dict(info.get("replicas", {}))}
+                    for d, info in dirs.items()}
+                for b, dirs in self.logdirs.items()}
+
+    def alter_replica_log_dirs(self, moves):
+        futures = {}
+        for (topic, part, broker), dest in moves.items():
+            exc = self._injected((topic, part, broker))
+            if exc is not None:
+                futures[(topic, part, broker)] = _ImmediateFuture(exc=exc)
+                continue
+            dirs = self.logdirs.get(broker, {})
+            if dest not in dirs:
+                futures[(topic, part, broker)] = _ImmediateFuture(
+                    exc=KafkaWireError("LOG_DIR_NOT_FOUND"))
+                continue
+            for d, info in dirs.items():
+                size = info.get("replicas", {}).pop((topic, part), None)
+                if size is not None:
+                    dirs[dest].setdefault("replicas", {})[(topic, part)] = size
+            futures[(topic, part, broker)] = _ImmediateFuture()
+        return futures
+
+    def describe_configs(self, resource_type, name):
+        return dict(self.configs.get((resource_type, name), {}))
+
+    def incremental_alter_configs(self, resource_type, name, ops):
+        cfg = self.configs.setdefault((resource_type, name), {})
+        for k, v in ops.items():
+            if v is None:
+                cfg.pop(k, None)
+            else:
+                cfg[k] = v
+        return _ImmediateFuture()

@@ -1,0 +1,197 @@
+"""Contract tests for the real-cluster admin adapter: error-code
+classification parity with ExecutionUtils result processing
+(processAlterPartitionReassignmentsResult ExecutionUtils.java:561,
+processElectLeadersResult :611), logdir/config ops, and a full Executor
+run driven through the adapter + mock wire instead of the simulator."""
+
+import pytest
+
+from cruise_control_tpu.executor import Executor, ExecutorConfig
+from cruise_control_tpu.executor.kafka_admin import (AdminAuthorizationError,
+                                                     AdminOperationError,
+                                                     AdminTimeoutError,
+                                                     KafkaAdminClusterClient,
+                                                     MockKafkaAdminWire)
+from cruise_control_tpu.model.proposals import ExecutionProposal
+
+
+def make_wire(num_brokers=3, parts=4):
+    wire = MockKafkaAdminWire()
+    for b in range(num_brokers):
+        wire.brokers[b] = {"host": f"b{b}", "rack": f"r{b % 2}"}
+        wire.logdirs[b] = {"/d0": {"replicas": {}}, "/d1": {"replicas": {}}}
+    for p in range(parts):
+        replicas = [p % num_brokers, (p + 1) % num_brokers]
+        wire.partitions[("t", p)] = {"replicas": replicas,
+                                     "leader": replicas[0],
+                                     "isr": list(replicas)}
+        for b in replicas:
+            wire.logdirs[b]["/d0"]["replicas"][("t", p)] = 1_000_000
+    return wire
+
+
+def test_describe_cluster_remembers_dead_brokers():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    assert admin.describe_cluster() == {0: True, 1: True, 2: True}
+    del wire.brokers[2]
+    assert admin.describe_cluster() == {0: True, 1: True, 2: False}
+
+
+def test_describe_partitions_merges_metadata_and_logdirs():
+    admin = KafkaAdminClusterClient(make_wire())
+    parts = admin.describe_partitions()
+    info = parts[("t", 0)]
+    assert info.replicas == [0, 1] and info.leader == 0
+    assert info.isr == {0, 1}
+    assert info.logdirs == {0: "/d0", 1: "/d0"}
+    assert info.size_mb == pytest.approx(1.0)
+
+
+def test_reassignment_error_classification():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    errors = admin.alter_partition_reassignments({
+        ("t", 0): [1, 2],            # fine
+        ("gone", 9): [0, 1],         # deleted topic
+        ("t", 1): [0, 99],           # dead destination broker
+    })
+    assert errors[("t", 0)] is None
+    assert "deleted" in errors[("gone", 9)]
+    assert "dead destination" in errors[("t", 1)]
+    # accepted reassignment is listed as ongoing with adding/removing sets
+    ongoing = admin.list_partition_reassignments()
+    assert ongoing[("t", 0)].target == [1, 2]
+    assert ongoing[("t", 0)].adding == [2]
+    assert ongoing[("t", 0)].removing == [0]
+
+
+def test_cancel_semantics():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    admin.alter_partition_reassignments({("t", 0): [1, 2]})
+    # cancel of an ongoing reassignment succeeds; cancel of nothing is a
+    # success too (NO_REASSIGNMENT_IN_PROGRESS, ref :580-583), as is a
+    # cancel for a deleted topic.
+    errors = admin.alter_partition_reassignments({
+        ("t", 0): None, ("t", 1): None, ("gone", 9): None})
+    assert errors == {("t", 0): None, ("t", 1): None, ("gone", 9): None}
+    assert admin.list_partition_reassignments() == {}
+
+
+def test_timeout_and_unknown_errors_raise():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    wire.fail_with[("t", 0)] = "REQUEST_TIMED_OUT"
+    with pytest.raises(AdminTimeoutError, match="timed out"):
+        admin.alter_partition_reassignments({("t", 0): [1, 2]})
+    wire.fail_with[("t", 0)] = "SOME_NEW_ERROR"
+    with pytest.raises(AdminOperationError, match="SOME_NEW_ERROR"):
+        admin.alter_partition_reassignments({("t", 0): [1, 2]})
+
+
+def test_election_classification():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    # ("t", 0): leader 0 == preferred -> broker answers ELECTION_NOT_NEEDED
+    # which is success (ref :625-627).
+    wire.partitions[("t", 1)]["leader"] = 2      # preferred is 1
+    wire.partitions[("t", 2)]["replicas"] = [99, 0]   # preferred offline
+    errors = admin.elect_preferred_leaders(
+        [("t", 0), ("t", 1), ("t", 2), ("gone", 9)])
+    assert errors[("t", 0)] is None
+    assert errors[("t", 1)] is None
+    assert wire.partitions[("t", 1)]["leader"] == 1
+    assert "preferred leader not available" in errors[("t", 2)]
+    assert "deleted" in errors[("gone", 9)]
+
+
+def test_election_authorization_and_controller_change():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    wire.fail_with[("t", 0)] = "CLUSTER_AUTHORIZATION_FAILED"
+    with pytest.raises(AdminAuthorizationError):
+        admin.elect_preferred_leaders([("t", 0)])
+    # NOT_CONTROLLER is reported, not raised: a follow-up execution
+    # re-elects (ref :637-641 maybeReexecuteLeadershipTasks).
+    wire.fail_with[("t", 0)] = "NOT_CONTROLLER"
+    errors = admin.elect_preferred_leaders([("t", 0)])
+    assert "NOT_CONTROLLER" in errors[("t", 0)]
+
+
+def test_logdir_moves_and_configs():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    assert admin.describe_replica_log_dirs()[("t", 0, 0)] == "/d0"
+    assert admin.describe_logdirs()[0] == ["/d0", "/d1"]
+    errors = admin.alter_replica_log_dirs({("t", 0, 0): "/d1",
+                                           ("t", 0, 1): "/nope"})
+    assert errors[("t", 0, 0)] is None
+    assert "LOG_DIR_NOT_FOUND" in errors[("t", 0, 1)]
+    assert admin.describe_replica_log_dirs()[("t", 0, 0)] == "/d1"
+    admin.alter_broker_config(0, {"leader.replication.throttled.rate": "1000"})
+    assert admin.describe_broker_config(0) == {
+        "leader.replication.throttled.rate": "1000"}
+    admin.alter_broker_config(0, {"leader.replication.throttled.rate": None})
+    assert admin.describe_broker_config(0) == {}
+    admin.alter_topic_config("t", {"min.insync.replicas": "2"})
+    assert admin.describe_topic_config("t")["min.insync.replicas"] == "2"
+
+
+def test_config_ops_classify_wire_errors():
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+
+    class _FailingFuture:
+        def __init__(self, code):
+            self._code = code
+
+        def result(self, timeout=None):
+            from cruise_control_tpu.executor.kafka_admin import KafkaWireError
+            raise KafkaWireError(self._code)
+
+    wire.incremental_alter_configs = (
+        lambda *a, **k: _FailingFuture("REQUEST_TIMED_OUT"))
+    with pytest.raises(AdminTimeoutError):
+        admin.alter_broker_config(0, {"x": "1"})
+    wire.incremental_alter_configs = (
+        lambda *a, **k: _FailingFuture("CLUSTER_AUTHORIZATION_FAILED"))
+    with pytest.raises(AdminAuthorizationError):
+        admin.alter_topic_config("t", {"x": "1"})
+    wire.incremental_alter_configs = (
+        lambda *a, **k: _FailingFuture("SOMETHING_ELSE"))
+    with pytest.raises(AdminOperationError, match="SOMETHING_ELSE"):
+        admin.alter_broker_config(0, {"x": "1"})
+
+
+def test_executor_runs_against_adapter_end_to_end():
+    """The full executor (phases, planner, polling, elections) drives the
+    adapter exactly as it drives the simulator — the swap the adapter
+    exists for. Broker-side completion is simulated on each progress-poll
+    sleep."""
+    wire = make_wire(num_brokers=3, parts=4)
+    admin = KafkaAdminClusterClient(wire)
+    now = [0]
+
+    def sleep_ms(ms):
+        now[0] += ms
+        for tp in list(wire.ongoing):
+            wire.complete_reassignment(tp)
+
+    executor = Executor(admin, ExecutorConfig(progress_check_interval_ms=100,
+                                              concurrency_adjuster_enabled=False),
+                        now_ms=lambda: now[0], sleep_ms=sleep_ms)
+    # Move t/0 (replicas [0,1] -> [1,2], new leader 1) + leadership-only
+    # t/1 ([1,2] with leader 1 stays, elect preferred after reorder).
+    proposals = [
+        ExecutionProposal(topic="t", partition=0, old_leader=0,
+                          old_replicas=(0, 1), new_replicas=(1, 2)),
+        ExecutionProposal(topic="t", partition=2, old_leader=2,
+                          old_replicas=(2, 0), new_replicas=(0, 2)),
+    ]
+    result = executor.execute_proposals(proposals, uuid="adapter-e2e")
+    assert result.succeeded, result.state_counts
+    parts = admin.describe_partitions()
+    assert parts[("t", 0)].replicas == [1, 2]
+    assert parts[("t", 2)].replicas == [0, 2]
+    assert parts[("t", 2)].leader == 0

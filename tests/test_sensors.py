@@ -79,6 +79,15 @@ def test_expose_text_prometheus_format():
     assert "none_gauge" not in text     # non-numeric gauges are dropped
 
 
+def test_composite_registry_dedupes_shared_registries():
+    from cruise_control_tpu.core.sensors import CompositeRegistry
+    shared = MetricRegistry()
+    shared.counter("G.c").inc(2)
+    view = CompositeRegistry(lambda: [shared, shared, shared])
+    assert view.to_json() == {"G.c": {"type": "counter", "count": 2}}
+    assert view.expose_text().count("cc_G_c_total 2") == 1
+
+
 # ------------------------------------------------------ subsystem wiring
 
 @pytest.fixture(scope="module")
