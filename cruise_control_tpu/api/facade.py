@@ -188,7 +188,7 @@ class KafkaCruiseControl:
     def rebalance(self, goals: list[str] | None = None, dryrun: bool = True,
                   options: OptimizationOptions | None = None, uuid: str = "",
                   progress: OperationProgress | None = None,
-                  ignore_proposal_cache: bool = False):
+                  ignore_proposal_cache: bool = False, **executor_kwargs):
         """ref RebalanceRunnable.java:30 (cache path :92-121)."""
         options = options or OptimizationOptions()
         use_cache = (not ignore_proposal_cache and goals is None
@@ -204,12 +204,14 @@ class KafkaCruiseControl:
                     res)
         else:
             res = self._optimize(progress, goals, options)
-        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress,
+                                       **executor_kwargs)
         return res, exec_res
 
     def add_brokers(self, broker_ids: list[int], dryrun: bool = True,
                     goals: list[str] | None = None, uuid: str = "",
-                    progress: OperationProgress | None = None):
+                    progress: OperationProgress | None = None,
+                    **executor_kwargs):
         """Move load onto the new brokers (ref AddBrokersRunnable; new
         brokers become the only allowed destinations)."""
         def mark_new(spec):
@@ -220,14 +222,19 @@ class KafkaCruiseControl:
         options = OptimizationOptions(
             destination_broker_ids=frozenset(broker_ids))
         res = self._optimize(progress, goals, options, spec_mutator=mark_new)
-        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress,
+                                       **executor_kwargs)
         return res, exec_res
 
     def remove_brokers(self, broker_ids: list[int], dryrun: bool = True,
                        goals: list[str] | None = None, uuid: str = "",
-                       progress: OperationProgress | None = None):
+                       progress: OperationProgress | None = None,
+                       destination_broker_ids: frozenset[int] | None = None,
+                       **executor_kwargs):
         """Drain the given brokers (ref RemoveBrokersRunnable: demoted to
-        dead state so every replica becomes a must-move)."""
+        dead state so every replica becomes a must-move;
+        ``destination_broker_ids`` restricts where drained replicas may
+        land, ref DESTINATION_BROKER_IDS_PARAM)."""
         removed = set(broker_ids)
 
         def mark_dead(spec):
@@ -235,15 +242,19 @@ class KafkaCruiseControl:
                 if b.broker_id in removed:
                     b.alive = False
             return spec
-        res = self._optimize(progress, goals, OptimizationOptions(),
+        options = OptimizationOptions(
+            destination_broker_ids=frozenset(destination_broker_ids or ()))
+        res = self._optimize(progress, goals, options,
                              spec_mutator=mark_dead)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
-                                       removed_brokers=removed)
+                                       removed_brokers=removed,
+                                       **executor_kwargs)
         return res, exec_res
 
     def demote_brokers(self, broker_ids: list[int], dryrun: bool = True,
                        uuid: str = "",
-                       progress: OperationProgress | None = None):
+                       progress: OperationProgress | None = None,
+                       **executor_kwargs):
         """Move leadership (and preferred-leader order) off the brokers
         (ref DemoteBrokerRunnable + PreferredLeaderElectionGoal)."""
         demoted = set(broker_ids)
@@ -269,21 +280,25 @@ class KafkaCruiseControl:
                                  frozenset(broker_ids)),
                              spec_mutator=mark_demoted)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
-                                       demoted_brokers=demoted)
+                                       demoted_brokers=demoted,
+                                       **executor_kwargs)
         return res, exec_res
 
     def fix_offline_replicas(self, dryrun: bool = True, uuid: str = "",
                              goals: list[str] | None = None,
-                             progress: OperationProgress | None = None):
+                             progress: OperationProgress | None = None,
+                             **executor_kwargs):
         """ref FixOfflineReplicasRunnable: offline replicas are must-moves
         in the analyzer already; this runs the chain and executes."""
         res = self._optimize(progress, goals, OptimizationOptions())
-        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress,
+                                       **executor_kwargs)
         return res, exec_res
 
     def update_topic_configuration(self, topic_pattern: str, target_rf: int,
                                    dryrun: bool = True, uuid: str = "",
-                                   progress: OperationProgress | None = None):
+                                   progress: OperationProgress | None = None,
+                                   **executor_kwargs):
         """Replication-factor change (ref UpdateTopicConfigurationRunnable +
         ClusterModel.createOrDeleteReplicas :962): adjust each matched
         partition's replica list rack-aware, then rebalance."""
@@ -336,66 +351,121 @@ class KafkaCruiseControl:
             return spec
         res = self._optimize(progress, None, OptimizationOptions(),
                              spec_mutator=change_rf)
-        exec_res = self._maybe_execute(res, dryrun, uuid, progress)
+        exec_res = self._maybe_execute(res, dryrun, uuid, progress,
+                                       **executor_kwargs)
         return res, exec_res
 
     # ----------------------------------------------------------- get ops
     def proposals(self, ignore_cache: bool = False,
+                  goals: list[str] | None = None,
                   progress: OperationProgress | None = None) -> OptimizerResult:
         """ref ProposalsRunnable / getProposals KafkaCruiseControl.java:534.
         A proposals read is a dry-run measurement either way: unfixable hard
         goals are a finding served with the provision verdict, like the
-        cache path."""
-        if ignore_cache:
-            return self._optimize(progress, None,
+        cache path. A request naming ``goals`` always computes fresh — the
+        cache only holds default-chain results."""
+        if ignore_cache or goals:
+            return self._optimize(progress, goals,
                                   OptimizationOptions(
                                       skip_hard_goal_check=True))
         return self.proposal_cache.get(self._now_ms())
 
-    def load(self) -> dict:
-        """Broker-level load stats (ref LoadRunnable -> BrokerStats)."""
-        result = self.monitor.cluster_model(self._now_ms())
+    def load(self, populate_disk_info: bool = False,
+             capacity_only: bool = False) -> dict:
+        """Broker-level load stats (ref LoadRunnable -> BrokerStats).
+        ``populate_disk_info`` adds per-logdir disk usage (ref
+        POPULATE_DISK_INFO_PARAM); ``capacity_only`` reports capacities
+        without requiring load data (ref CAPACITY_ONLY_PARAM)."""
+        result = self.monitor.cluster_model(
+            self._now_ms(),
+            populate_replica_placement_only=capacity_only)
         model = result.model
-        util = np.asarray(broker_utilization(model))
         counts = np.asarray(broker_replica_counts(model))
         leaders = np.asarray(broker_leader_counts(model))
+        caps = np.asarray(model.broker_capacity)
+        util = (None if capacity_only
+                else np.asarray(broker_utilization(model)))
+        disk_by_broker: dict[int, dict[str, float]] = {}
+        if populate_disk_info:
+            sizes = {tp: i.size_mb
+                     for tp, i in self.admin.describe_partitions().items()}
+            for (t, p, b), d in self.admin.describe_replica_log_dirs(
+                    ).items():
+                disk_by_broker.setdefault(b, {})
+                disk_by_broker[b][d] = (disk_by_broker[b].get(d, 0.0)
+                                        + sizes.get((t, p), 0.0))
         hosts = result.spec.brokers
         brokers = []
         for i, b in enumerate(hosts):
-            brokers.append({
+            row = {
                 "Broker": b.broker_id, "Rack": b.rack,
                 "BrokerState": "ALIVE" if b.alive else "DEAD",
-                "CpuPct": float(util[i, Resource.CPU]),
-                "NwInRate": float(util[i, Resource.NW_IN]),
-                "NwOutRate": float(util[i, Resource.NW_OUT]),
-                "DiskMB": float(util[i, Resource.DISK]),
                 "Replicas": int(counts[i]), "Leaders": int(leaders[i]),
-            })
-        return {"brokers": brokers, "summary": stats_summary(model),
+                "Capacity": {r.name: float(caps[i, int(r)])
+                             for r in Resource},
+            }
+            if util is not None:
+                row.update({
+                    "CpuPct": float(util[i, Resource.CPU]),
+                    "NwInRate": float(util[i, Resource.NW_IN]),
+                    "NwOutRate": float(util[i, Resource.NW_OUT]),
+                    "DiskMB": float(util[i, Resource.DISK])})
+            if populate_disk_info:
+                row["DiskState"] = {
+                    d: round(mb, 3) for d, mb in sorted(
+                        disk_by_broker.get(b.broker_id, {}).items())}
+            brokers.append(row)
+        return {"brokers": brokers,
+                "summary": (None if capacity_only
+                            else stats_summary(model)),
                 "generation": result.generation}
 
     def partition_load(self, resource: str = "DISK", start: int = 0,
-                       max_entries: int = 2**31) -> list[dict]:
-        """ref PartitionLoadRunnable: partitions sorted by a resource."""
+                       max_entries: int = 2**31,
+                       topic_pattern: str | None = None,
+                       broker_ids: list[int] | None = None,
+                       max_load: bool = False) -> list[dict]:
+        """ref PartitionLoadRunnable: partitions sorted by a resource.
+        ``topic_pattern`` / ``broker_ids`` filter rows (ref TOPIC_PARAM,
+        BROKER_ID_PARAM); ``max_load`` scores each partition by its
+        max-window load instead of the window average (ref MAX_LOAD_PARAM
+        -> Load.expectedUtilizationFor(max))."""
         result = self.monitor.cluster_model(self._now_ms())
         res_idx = int(Resource[resource.upper()])
+        wanted_brokers = set(broker_ids or ())
         rows = []
         for p in result.spec.partitions:
+            if topic_pattern and not fnmatch.fnmatch(p.topic, topic_pattern):
+                continue
+            if wanted_brokers and not (wanted_brokers & set(p.replicas)):
+                continue
+            load = list(p.leader_load)
+            if max_load:
+                windows = result.partition_windows.get(
+                    (p.topic, p.partition))
+                if windows is not None and windows.size:
+                    # KafkaMetric 0-3 line up with the Resource axis.
+                    load = [float(np.max(windows[r])) for r in range(4)]
             rows.append({
                 "topic": p.topic, "partition": p.partition,
                 "leader": p.replicas[0] if p.replicas else -1,
                 "followers": list(p.replicas[1:]),
-                "CPU": p.leader_load[0], "NW_IN": p.leader_load[1],
-                "NW_OUT": p.leader_load[2], "DISK": p.leader_load[3],
+                "CPU": load[0], "NW_IN": load[1],
+                "NW_OUT": load[2], "DISK": load[3],
             })
         rows.sort(key=lambda r: -r[Resource(res_idx).name])
         return rows[start:start + max_entries]
 
-    def kafka_cluster_state(self, verbose: bool = False) -> dict:
+    def kafka_cluster_state(self, verbose: bool = False,
+                            topic_pattern: str | None = None) -> dict:
         """ref KafkaClusterStateRequest: topology + replica health.
         ``verbose`` adds per-partition leader/replicas/ISR detail (ref
-        KafkaClusterState.writeKafkaClusterState verbose sections)."""
+        KafkaClusterState.writeKafkaClusterState verbose sections);
+        ``topic_pattern`` scopes the partition view (ref TOPIC_PARAM)."""
         parts = self.admin.describe_partitions()
+        if topic_pattern:
+            parts = {tp: i for tp, i in parts.items()
+                     if fnmatch.fnmatch(tp[0], topic_pattern)}
         alive = self.admin.describe_cluster()
         under_replicated = [list(tp) for tp, i in parts.items()
                             if len(i.isr) < len(i.replicas)]
@@ -452,8 +522,10 @@ class KafkaCruiseControl:
         return out
 
     # ------------------------------------------------------- admin-ish ops
-    def stop_proposal_execution(self) -> None:
-        self.executor.stop_execution()
+    def stop_proposal_execution(self, force: bool = False,
+                                stop_external_agent: bool = False) -> None:
+        self.executor.stop_execution(force=force,
+                                     stop_external_agent=stop_external_agent)
 
     def pause_sampling(self, reason: str = "") -> None:
         if self.task_runner is None:
@@ -485,7 +557,8 @@ class KafkaCruiseControl:
 
     def remove_disks(self, broker_id_logdirs: dict[int, list[str]],
                      dryrun: bool = True, uuid: str = "",
-                     progress: OperationProgress | None = None) -> dict:
+                     progress: OperationProgress | None = None,
+                     **executor_kwargs) -> dict:
         """Drain the given logdirs onto their brokers' surviving disks
         (ref RemoveDisksRunnable; the intra-broker kernel with the doomed
         disks' capacity zeroed)."""
@@ -507,17 +580,19 @@ class KafkaCruiseControl:
             if progress:
                 progress.add_step("ExecutingIntraBrokerMoves")
             exec_res = self.executor.execute_proposals(
-                [], intra_broker_moves=res.moves, uuid=uuid)
+                [], intra_broker_moves=res.moves, uuid=uuid,
+                **executor_kwargs)
             out["executionResult"] = {"succeeded": exec_res.succeeded,
                                       "numDeadTasks": exec_res.num_dead_tasks}
         return out
 
     def rebalance_disks(self, dryrun: bool = True, uuid: str = "",
-                        progress: OperationProgress | None = None) -> dict:
+                        progress: OperationProgress | None = None,
+                        **executor_kwargs) -> dict:
         """Intra-broker disk balance (ref rebalance with the intra-broker
         goal list)."""
         return self.remove_disks({}, dryrun=dryrun, uuid=uuid,
-                                 progress=progress)
+                                 progress=progress, **executor_kwargs)
 
     def rightsize(self, **kwargs) -> dict:
         """ref RightsizeRunnable -> Provisioner; concrete provisioning is

@@ -1,0 +1,535 @@
+"""Typed per-endpoint request parameters.
+
+Rebuild of ``servlet/parameters/`` (``AbstractParameters.java``,
+``ParameterUtils.java`` and the ~30 per-endpoint classes, ~4,400 LoC):
+every endpoint declares the exact parameter set it accepts, each parameter
+is parsed to its type with validation, unknown parameters are rejected
+with a 400 (ref ``UserTaskManager``'s unrecognized-parameter handling),
+required parameters and forbidden combinations are enforced before any
+work runs.
+
+The registry at the bottom (:data:`ENDPOINT_PARAMETERS`) maps endpoint
+name -> parameter class; the HTTP layer parses once and hands the typed
+:class:`ParsedParams` to the facade dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+
+class ParameterError(ValueError):
+    """Maps to HTTP 400 (ref UserRequestException)."""
+
+
+_TRUE = ("true", "1", "yes")
+_FALSE = ("false", "0", "no")
+
+
+@dataclass(frozen=True)
+class Param:
+    """One declared parameter (ref ParameterUtils *_PARAM constants)."""
+
+    name: str
+    kind: str                    # bool | int | double | string | csv_str |
+                                 # csv_int | enum
+    choices: tuple = ()          # for enum (case-insensitive)
+    required: bool = False
+    default: object = None
+    min_value: float | None = None
+
+    def parse(self, raw: str):
+        if self.kind == "bool":
+            low = raw.strip().lower()
+            if low in _TRUE:
+                return True
+            if low in _FALSE:
+                return False
+            raise ParameterError(
+                f"parameter {self.name}: {raw!r} is not a boolean")
+        if self.kind == "int":
+            try:
+                value = int(raw)
+            except ValueError:
+                raise ParameterError(
+                    f"parameter {self.name}: {raw!r} is not an integer")
+            self._check_min(value)
+            return value
+        if self.kind == "double":
+            try:
+                value = float(raw)
+            except ValueError:
+                raise ParameterError(
+                    f"parameter {self.name}: {raw!r} is not a number")
+            self._check_min(value)
+            return value
+        if self.kind == "csv_str":
+            return [x.strip() for x in raw.split(",") if x.strip()]
+        if self.kind == "csv_int":
+            try:
+                return [int(x) for x in raw.split(",") if x.strip()]
+            except ValueError:
+                raise ParameterError(
+                    f"parameter {self.name}: {raw!r} is not a "
+                    "comma-separated integer list")
+        if self.kind == "enum":
+            value = raw.strip().upper()
+            if value not in self.choices:
+                raise ParameterError(
+                    f"parameter {self.name}: {raw!r} not in "
+                    f"{sorted(self.choices)}")
+            return value
+        return raw              # string
+
+    def _check_min(self, value):
+        if self.min_value is not None and value < self.min_value:
+            raise ParameterError(
+                f"parameter {self.name}: {value} < minimum "
+                f"{self.min_value}")
+
+
+#: parameters every endpoint accepts (ref AbstractParameters: json,
+#: get_response_schema, doAs; reason is recorded for audit on POSTs;
+#: user_task_id/get_response_timeout_s drive the async task protocol).
+COMMON_PARAMS = (
+    Param("json", "bool", default=True),
+    Param("get_response_schema", "bool", default=False),
+    Param("doas", "string"),
+    Param("reason", "string"),
+    Param("user_task_id", "string"),
+    Param("get_response_timeout_s", "double", default=10.0, min_value=0),
+    Param("review_id", "int", min_value=0),
+)
+
+#: shared goal-based optimization surface (ref
+#: GoalBasedOptimizationParameters.java)
+_GOAL_PARAMS = (
+    Param("goals", "csv_str"),
+    Param("kafka_assigner", "bool", default=False),
+    Param("allow_capacity_estimation", "bool", default=True),
+    Param("excluded_topics", "string"),
+    Param("use_ready_default_goals", "bool", default=False),
+    Param("exclude_recently_demoted_brokers", "bool", default=False),
+    Param("exclude_recently_removed_brokers", "bool", default=False),
+    Param("skip_hard_goal_check", "bool", default=False),
+    Param("fast_mode", "bool", default=False),
+    Param("verbose", "bool", default=False),
+    # Framework extension: explicit per-request broker exclusion masks
+    # (the reference only excludes recently removed/demoted brokers).
+    Param("exclude_brokers_for_leadership", "csv_int"),
+    Param("exclude_brokers_for_replica_move", "csv_int"),
+)
+
+#: shared execution knobs (ref the runnables reading per-request
+#: concurrency/strategy/throttle overrides)
+_EXECUTION_PARAMS = (
+    Param("dryrun", "bool", default=True),
+    Param("concurrent_partition_movements_per_broker", "int", min_value=1),
+    Param("max_partition_movements_in_cluster", "int", min_value=1),
+    Param("concurrent_intra_broker_partition_movements", "int", min_value=1),
+    Param("concurrent_leader_movements", "int", min_value=1),
+    Param("broker_concurrent_leader_movements", "int", min_value=1),
+    Param("execution_progress_check_interval_ms", "int", min_value=5),
+    Param("replica_movement_strategies", "csv_str"),
+    Param("replication_throttle", "int", min_value=0),
+    Param("stop_ongoing_execution", "bool", default=False),
+)
+
+
+class ParsedParams:
+    """Typed view of one request's parameters."""
+
+    def __init__(self, endpoint: str, values: dict):
+        self.endpoint = endpoint
+        self._values = values
+
+    def get(self, name: str, default=None):
+        v = self._values.get(name)
+        return default if v is None else v
+
+    def __getitem__(self, name: str):
+        return self._values[name]
+
+    def __contains__(self, name: str) -> bool:
+        return self._values.get(name) is not None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in self._values.items() if v is not None}
+
+    # -------------------------------------------------- derived conveniences
+    def goal_list(self) -> list[str] | None:
+        """Explicit goals, or the kafka-assigner chain, or None (defaults).
+        ref ParameterUtils.getGoals + kafka_assigner mode resolution."""
+        goals = self.get("goals")
+        if goals:
+            return list(goals)
+        if self.get("kafka_assigner"):
+            from ..analyzer.goals import KAFKA_ASSIGNER_GOALS
+            return list(KAFKA_ASSIGNER_GOALS)
+        return None
+
+    def execution_kwargs(self) -> dict:
+        """Executor overrides for facade execute calls."""
+        out: dict = {}
+        if "replica_movement_strategies" in self:
+            out["strategy_names"] = list(self["replica_movement_strategies"])
+        if "replication_throttle" in self:
+            out["throttle_bytes"] = self["replication_throttle"]
+        overrides = {}
+        for pname, field in (
+                ("concurrent_partition_movements_per_broker",
+                 "num_concurrent_partition_movements_per_broker"),
+                ("max_partition_movements_in_cluster",
+                 "max_num_cluster_partition_movements"),
+                ("concurrent_intra_broker_partition_movements",
+                 "num_concurrent_intra_broker_partition_movements"),
+                ("concurrent_leader_movements",
+                 "num_concurrent_leader_movements"),
+                ("broker_concurrent_leader_movements",
+                 "num_concurrent_leader_movements_per_broker")):
+            if pname in self:
+                overrides[field] = self[pname]
+        if overrides:
+            out["concurrency_overrides"] = overrides
+        if "execution_progress_check_interval_ms" in self:
+            out["progress_check_interval_ms"] = self[
+                "execution_progress_check_interval_ms"]
+        return out
+
+
+class EndpointParameters:
+    """Base per-endpoint declaration (ref AbstractParameters.java)."""
+
+    #: endpoint-specific parameters, on top of COMMON_PARAMS
+    PARAMS: tuple[Param, ...] = ()
+    #: extra validation hook: receives the parsed value dict
+    validators: tuple[Callable[[dict], None], ...] = ()
+
+    @classmethod
+    def specs(cls) -> dict[str, Param]:
+        out = {}
+        for p in (*COMMON_PARAMS, *cls.PARAMS):
+            out[p.name] = p
+        return out
+
+    @classmethod
+    def parse(cls, endpoint: str, query: dict[str, list[str]]
+              ) -> ParsedParams:
+        specs = cls.specs()
+        unknown = [k for k in query if k.lower() not in specs]
+        if unknown:
+            raise ParameterError(
+                f"unrecognized parameter(s) {sorted(unknown)} for endpoint "
+                f"{endpoint}; supported: {sorted(specs)}")
+        values: dict = {}
+        for name, spec in specs.items():
+            raw_list = query.get(name)
+            if raw_list is None:
+                # exact-case miss: query keys were lowercased by the
+                # handler, so this is just the default path
+                values[name] = spec.default
+                if spec.required:
+                    raise ParameterError(
+                        f"missing required parameter {name!r} for "
+                        f"endpoint {endpoint}")
+                continue
+            if len(raw_list) > 1:
+                raise ParameterError(
+                    f"parameter {name} given {len(raw_list)} times")
+            values[name] = spec.parse(raw_list[0])
+        for validate in cls.validators:
+            validate(values)
+        return ParsedParams(endpoint, values)
+
+
+def _forbid(a: str, b: str) -> Callable[[dict], None]:
+    def check(values: dict) -> None:
+        if values.get(a) and values.get(b):
+            raise ParameterError(
+                f"parameters {a!r} and {b!r} are mutually exclusive")
+    return check
+
+
+# ----------------------------------------------------------- GET endpoints
+
+class StateParameters(EndpointParameters):
+    """ref CruiseControlStateParameters.java."""
+
+    PARAMS = (Param("substates", "csv_str"),
+              Param("verbose", "bool", default=False),
+              Param("super_verbose", "bool", default=False))
+
+
+class LoadParameters(EndpointParameters):
+    """ref ClusterLoadParameters.java."""
+
+    PARAMS = (Param("time", "int", min_value=0),
+              Param("start", "int", min_value=0),
+              Param("end", "int", min_value=0),
+              Param("allow_capacity_estimation", "bool", default=True),
+              Param("populate_disk_info", "bool", default=False),
+              Param("capacity_only", "bool", default=False))
+
+
+class PartitionLoadParameters(EndpointParameters):
+    """ref PartitionLoadParameters.java."""
+
+    PARAMS = (Param("resource", "enum",
+                    choices=("CPU", "NW_IN", "NW_OUT", "DISK"),
+                    default="DISK"),
+              Param("start", "int", default=0, min_value=0),
+              Param("end", "int", min_value=0),
+              Param("entries", "int", default=2**31, min_value=1),
+              Param("topic", "string"),
+              Param("partition", "string"),
+              Param("min_valid_partition_ratio", "double", min_value=0),
+              Param("allow_capacity_estimation", "bool", default=True),
+              Param("max_load", "bool", default=False),
+              Param("avg_load", "bool", default=False),
+              Param("brokerid", "csv_int"))
+    validators = (_forbid("max_load", "avg_load"),)
+
+
+class ProposalsParameters(EndpointParameters):
+    """ref ProposalsParameters.java."""
+
+    PARAMS = (*_GOAL_PARAMS,
+              Param("ignore_proposal_cache", "bool", default=False),
+              Param("data_from", "enum",
+                    choices=("VALID_WINDOWS", "VALID_PARTITIONS"),
+                    default="VALID_WINDOWS"))
+
+
+class KafkaClusterStateParameters(EndpointParameters):
+    """ref KafkaClusterStateParameters.java."""
+
+    PARAMS = (Param("topic", "string"),
+              Param("verbose", "bool", default=False))
+
+
+class UserTasksParameters(EndpointParameters):
+    """ref UserTasksParameters.java."""
+
+    PARAMS = (Param("user_task_ids", "csv_str"),
+              Param("client_ids", "csv_str"),
+              Param("endpoints", "csv_str"),
+              Param("types", "csv_str"),
+              Param("entries", "int", min_value=1),
+              Param("fetch_completed_task", "bool", default=False))
+
+
+class BootstrapParameters(EndpointParameters):
+    """ref BootstrapParameters.java."""
+
+    PARAMS = (Param("start", "int", default=0, min_value=0),
+              Param("end", "int", default=0, min_value=0),
+              Param("clear_metrics", "bool", default=False))
+
+    @staticmethod
+    def _range(values: dict) -> None:
+        if values.get("end") and values.get("start", 0) > values["end"]:
+            raise ParameterError("bootstrap start must be <= end")
+    validators = (_range,)
+
+
+class TrainParameters(EndpointParameters):
+    """ref TrainParameters.java."""
+
+    PARAMS = (Param("start", "int", default=0, min_value=0),
+              Param("end", "int", default=0, min_value=0))
+
+
+class ReviewBoardParameters(EndpointParameters):
+    """ref ReviewBoardParameters.java."""
+
+    PARAMS = (Param("review_ids", "csv_int"),)
+
+
+class PermissionsParameters(EndpointParameters):
+    """ref UserPermissionsParameters.java."""
+
+
+class OpenApiParameters(EndpointParameters):
+    pass
+
+
+# ---------------------------------------------------------- POST endpoints
+
+class RebalanceParameters(EndpointParameters):
+    """ref RebalanceParameters.java."""
+
+    PARAMS = (*_GOAL_PARAMS, *_EXECUTION_PARAMS,
+              Param("ignore_proposal_cache", "bool", default=False),
+              Param("destination_broker_ids", "csv_int"),
+              Param("rebalance_disk", "bool", default=False))
+    validators = (_forbid("rebalance_disk", "destination_broker_ids"),)
+
+
+class AddBrokerParameters(EndpointParameters):
+    """ref AddBrokerParameters.java (AddedOrRemovedBrokerParameters)."""
+
+    PARAMS = (*_GOAL_PARAMS, *_EXECUTION_PARAMS,
+              Param("brokerid", "csv_int", required=True),
+              Param("throttle_added_broker", "bool", default=True))
+
+
+class RemoveBrokerParameters(EndpointParameters):
+    """ref RemoveBrokerParameters.java."""
+
+    PARAMS = (*_GOAL_PARAMS, *_EXECUTION_PARAMS,
+              Param("brokerid", "csv_int", required=True),
+              Param("throttle_removed_broker", "bool", default=True),
+              Param("destination_broker_ids", "csv_int"))
+
+    @staticmethod
+    def _no_overlap(values: dict) -> None:
+        dests = set(values.get("destination_broker_ids") or ())
+        removed = set(values.get("brokerid") or ())
+        if dests & removed:
+            raise ParameterError(
+                f"brokers {sorted(dests & removed)} cannot be both removed "
+                "and destinations")
+    validators = (_no_overlap,)
+
+
+class DemoteBrokerParameters(EndpointParameters):
+    """ref DemoteBrokerParameters.java."""
+
+    PARAMS = (*_EXECUTION_PARAMS,
+              Param("brokerid", "csv_int", required=True),
+              Param("skip_urp_demotion", "bool", default=True),
+              Param("exclude_follower_demotion", "bool", default=True),
+              Param("exclude_recently_demoted_brokers", "bool",
+                    default=False),
+              Param("verbose", "bool", default=False))
+
+
+class FixOfflineReplicasParameters(EndpointParameters):
+    """ref FixOfflineReplicasParameters.java."""
+
+    PARAMS = (*_GOAL_PARAMS, *_EXECUTION_PARAMS)
+
+
+class TopicConfigurationParameters(EndpointParameters):
+    """ref TopicConfigurationParameters.java +
+    TopicReplicationFactorChangeParameters.java."""
+
+    PARAMS = (*_GOAL_PARAMS, *_EXECUTION_PARAMS,
+              Param("topic", "string", required=True),
+              Param("replication_factor", "int", required=True, min_value=1),
+              Param("skip_rack_awareness_check", "bool", default=False))
+
+
+class RemoveDisksParameters(EndpointParameters):
+    """ref RemoveDisksParameters.java."""
+
+    PARAMS = (*_EXECUTION_PARAMS,
+              Param("brokerid_and_logdirs", "string", required=True))
+
+
+class RightsizeParameters(EndpointParameters):
+    """ref RightsizeParameters.java."""
+
+    PARAMS = (Param("num_brokers_to_add", "int", min_value=1),
+              Param("partition_count", "int", min_value=1),
+              Param("brokerid", "csv_int"))
+
+
+class AdminParameters(EndpointParameters):
+    """ref AdminParameters.java + UpdateSelfHealingParameters +
+    ChangeExecutionConcurrencyParameters + DropRecentBrokersParameters +
+    UpdateConcurrencyAdjusterParameters."""
+
+    PARAMS = (Param("disable_self_healing_for", "csv_str"),
+              Param("enable_self_healing_for", "csv_str"),
+              Param("concurrent_partition_movements_per_broker", "int",
+                    min_value=1),
+              Param("concurrent_intra_broker_partition_movements", "int",
+                    min_value=1),
+              Param("concurrent_leader_movements", "int", min_value=1),
+              Param("drop_recently_removed_brokers", "bool", default=False),
+              Param("drop_recently_demoted_brokers", "bool", default=False),
+              Param("disable_concurrency_adjuster_for", "csv_str"),
+              Param("enable_concurrency_adjuster_for", "csv_str"),
+              Param("min_isr_based_concurrency_adjustment", "bool"))
+
+    @staticmethod
+    def _not_both(values: dict) -> None:
+        both = (set(values.get("enable_self_healing_for") or ())
+                & set(values.get("disable_self_healing_for") or ()))
+        if both:
+            raise ParameterError(
+                f"anomaly types {sorted(both)} cannot be both enabled and "
+                "disabled")
+    validators = (_not_both,)
+
+
+class ReviewParameters(EndpointParameters):
+    """ref ReviewParameters.java."""
+
+    PARAMS = (Param("approve", "csv_int"),
+              Param("discard", "csv_int"))
+
+    @staticmethod
+    def _some_action(values: dict) -> None:
+        if not values.get("approve") and not values.get("discard"):
+            raise ParameterError("review requires approve= and/or discard=")
+        both = set(values.get("approve") or ()) & set(
+            values.get("discard") or ())
+        if both:
+            raise ParameterError(
+                f"review ids {sorted(both)} cannot be both approved and "
+                "discarded")
+    validators = (_some_action,)
+
+
+class StopProposalParameters(EndpointParameters):
+    """ref StopProposalParameters.java."""
+
+    PARAMS = (Param("force_stop", "bool", default=False),
+              Param("stop_external_agent", "bool", default=True))
+
+
+class PauseResumeParameters(EndpointParameters):
+    """ref PauseResumeParameters.java (reason is in COMMON_PARAMS)."""
+
+
+#: endpoint -> parameter class (ref CruiseControlEndPoint -> Parameters
+#: wiring in KafkaCruiseControlServlet)
+ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
+    "state": StateParameters,
+    "load": LoadParameters,
+    "partition_load": PartitionLoadParameters,
+    "proposals": ProposalsParameters,
+    "kafka_cluster_state": KafkaClusterStateParameters,
+    "user_tasks": UserTasksParameters,
+    "bootstrap": BootstrapParameters,
+    "train": TrainParameters,
+    "review_board": ReviewBoardParameters,
+    "permissions": PermissionsParameters,
+    "openapi": OpenApiParameters,
+    "rebalance": RebalanceParameters,
+    "add_broker": AddBrokerParameters,
+    "remove_broker": RemoveBrokerParameters,
+    "demote_broker": DemoteBrokerParameters,
+    "fix_offline_replicas": FixOfflineReplicasParameters,
+    "topic_configuration": TopicConfigurationParameters,
+    "remove_disks": RemoveDisksParameters,
+    "rightsize": RightsizeParameters,
+    "admin": AdminParameters,
+    "review": ReviewParameters,
+    "stop_proposal_execution": StopProposalParameters,
+    "pause_sampling": PauseResumeParameters,
+    "resume_sampling": PauseResumeParameters,
+}
+
+
+def parse_endpoint_params(endpoint: str, query: dict[str, list[str]]
+                          ) -> ParsedParams:
+    """Parse + validate one request's query params for ``endpoint``.
+    Raises :class:`ParameterError` (HTTP 400) on unknown/invalid input."""
+    cls = ENDPOINT_PARAMETERS.get(endpoint)
+    if cls is None:
+        raise ParameterError(f"unknown endpoint {endpoint}")
+    return cls.parse(endpoint, query)

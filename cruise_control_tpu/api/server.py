@@ -23,8 +23,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..analyzer import OptimizationOptions
-from ..analyzer.goals import KAFKA_ASSIGNER_GOALS
 from .facade import KafkaCruiseControl
+from .parameters import ParsedParams, parse_endpoint_params
 from .purgatory import Purgatory
 from .security import (AllowAllSecurityProvider, AuthorizationError,
                        SecurityProvider, check_access, ENDPOINT_MIN_ROLE)
@@ -48,18 +48,6 @@ ASYNC_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                    "partition_load", "bootstrap", "train", "remove_disks"}
 
 
-def _flag(params: dict, name: str, default: bool = False) -> bool:
-    v = params.get(name, [None])[0]
-    if v is None:
-        return default
-    return str(v).lower() in ("true", "1", "yes")
-
-
-def _ids(params: dict, name: str) -> list[int]:
-    raw = params.get(name, [""])[0]
-    return [int(x) for x in raw.split(",") if x.strip()]
-
-
 def _auth_headers(e: AuthorizationError, provider) -> dict:
     """RFC 7235: every 401 carries a WWW-Authenticate challenge —
     the error's own, or the provider's default (wrong-password retries
@@ -68,16 +56,6 @@ def _auth_headers(e: AuthorizationError, provider) -> dict:
     if challenge is None and e.status == 401:
         challenge = getattr(provider, "default_challenge", None)
     return {"WWW-Authenticate": challenge} if challenge else {}
-
-
-def _goals(params: dict) -> list[str] | None:
-    raw = params.get("goals", [""])[0]
-    explicit = [g.strip() for g in raw.split(",") if g.strip()]
-    if explicit:
-        return explicit
-    if _flag(params, "kafka_assigner"):
-        return list(KAFKA_ASSIGNER_GOALS)
-    return None
 
 
 class CruiseControlApp:
@@ -137,6 +115,10 @@ class CruiseControlApp:
                 and endpoint not in NO_REVIEW_REQUIRED):
             review_id = params.get("review_id", [None])[0]
             if review_id is None:
+                # Validate eagerly: malformed requests must not park in the
+                # purgatory and fail only at approval time.
+                parse_endpoint_params(
+                    endpoint, {k.lower(): v for k, v in params.items()})
                 info = self.purgatory.add(endpoint, {k: v[0] for k, v
                                                      in params.items()},
                                           principal.name)
@@ -146,21 +128,26 @@ class CruiseControlApp:
             merged.update(params)
             params = merged
 
-        if endpoint in ASYNC_ENDPOINTS:
-            return self._handle_async(endpoint, params, headers)
-        return self._handle_sync(endpoint, params, principal)
+        # Typed parse + validation (ref servlet/parameters/*): unknown
+        # parameters, bad types, missing required params and forbidden
+        # combinations are a 400 before any work is scheduled.
+        parsed = parse_endpoint_params(
+            endpoint, {k.lower(): v for k, v in params.items()})
 
-    def _handle_async(self, endpoint: str, params: dict,
+        if endpoint in ASYNC_ENDPOINTS:
+            return self._handle_async(endpoint, parsed, headers)
+        return self._handle_sync(endpoint, parsed, principal)
+
+    def _handle_async(self, endpoint: str, params: ParsedParams,
                       headers: dict) -> tuple[int, dict, dict]:
-        uuid = headers.get("user-task-id") or params.get(
-            "user_task_id", [None])[0]
+        uuid = headers.get("user-task-id") or params.get("user_task_id")
         existing = self.tasks.get(uuid) if uuid else None
         if existing is None:
             fn = self._operation(endpoint, params)
             existing = self.tasks.submit(endpoint, endpoint, fn,
                                          user_task_id=uuid)
         hdrs = {"User-Task-ID": existing.user_task_id}
-        timeout = float(params.get("get_response_timeout_s", ["10"])[0])
+        timeout = float(params.get("get_response_timeout_s", 10.0))
         try:
             result = existing.future.result(timeout=timeout)
             return 200, result, hdrs
@@ -171,84 +158,109 @@ class CruiseControlApp:
             return 500, {"errorMessage": str(e),
                          "userTaskId": existing.user_task_id}, hdrs
 
-    def _operation(self, endpoint: str, params: dict):
+    def _operation(self, endpoint: str, params: ParsedParams):
         """Build the callable a user task runs (ref the Runnable classes in
         servlet/handler/async/runnable/)."""
         facade = self.facade
-        dryrun = _flag(params, "dryrun", True)
-        goals = _goals(params)
+        dryrun = params.get("dryrun", True)
+        goals = params.goal_list() if endpoint not in (
+            "load", "partition_load", "bootstrap", "train",
+            "rightsize") else None
+        exec_kwargs = params.execution_kwargs()
 
-        def options_from(params) -> OptimizationOptions:
+        def options_from(params: ParsedParams) -> OptimizationOptions:
+            pattern = params.get("excluded_topics") or ""
             return OptimizationOptions(
                 excluded_topics=frozenset(
-                    t for t in params.get("excluded_topics", [""])[0].split(",")
-                    if t),
-                fast_mode=_flag(params, "fast_mode"),
+                    t for t in pattern.split(",") if t),
+                fast_mode=params.get("fast_mode", False),
+                skip_hard_goal_check=params.get("skip_hard_goal_check",
+                                                False),
                 excluded_brokers_for_leadership=frozenset(
-                    _ids(params, "exclude_brokers_for_leadership")),
+                    params.get("exclude_brokers_for_leadership") or ()),
                 excluded_brokers_for_replica_move=frozenset(
-                    _ids(params, "exclude_brokers_for_replica_move")),
+                    params.get("exclude_brokers_for_replica_move") or ()),
                 destination_broker_ids=frozenset(
-                    _ids(params, "destination_broker_ids")))
+                    params.get("destination_broker_ids") or ()))
 
         if endpoint == "rebalance":
-            def run(progress):
-                res, exec_res = facade.rebalance(
-                    goals=goals, dryrun=dryrun, options=options_from(params),
-                    progress=progress,
-                    ignore_proposal_cache=_flag(params,
-                                                "ignore_proposal_cache"))
-                return _optimization_response(res, exec_res)
+            if params.get("rebalance_disk"):
+                # Disk-only mode: intra-broker moves, never cross-broker
+                # (ref REBALANCE_DISK_MODE_PARAM -> intra-broker goals).
+                def run(progress):
+                    return facade.rebalance_disks(dryrun=dryrun,
+                                                  progress=progress,
+                                                  **exec_kwargs)
+            else:
+                def run(progress):
+                    res, exec_res = facade.rebalance(
+                        goals=goals, dryrun=dryrun,
+                        options=options_from(params),
+                        progress=progress,
+                        ignore_proposal_cache=params.get(
+                            "ignore_proposal_cache", False),
+                        **exec_kwargs)
+                    return _optimization_response(
+                        res, exec_res, verbose=params.get("verbose", False))
         elif endpoint == "add_broker":
             def run(progress):
                 res, exec_res = facade.add_brokers(
-                    _ids(params, "brokerid"), dryrun=dryrun, goals=goals,
-                    progress=progress)
+                    params["brokerid"], dryrun=dryrun, goals=goals,
+                    progress=progress, **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "remove_broker":
             def run(progress):
                 res, exec_res = facade.remove_brokers(
-                    _ids(params, "brokerid"), dryrun=dryrun, goals=goals,
-                    progress=progress)
+                    params["brokerid"], dryrun=dryrun, goals=goals,
+                    progress=progress,
+                    destination_broker_ids=frozenset(
+                        params.get("destination_broker_ids") or ()),
+                    **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "demote_broker":
             def run(progress):
                 res, exec_res = facade.demote_brokers(
-                    _ids(params, "brokerid"), dryrun=dryrun,
-                    progress=progress)
+                    params["brokerid"], dryrun=dryrun,
+                    progress=progress, **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "fix_offline_replicas":
             def run(progress):
                 res, exec_res = facade.fix_offline_replicas(
-                    dryrun=dryrun, goals=goals, progress=progress)
+                    dryrun=dryrun, goals=goals, progress=progress,
+                    **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "topic_configuration":
             def run(progress):
                 res, exec_res = facade.update_topic_configuration(
-                    params.get("topic", ["*"])[0],
-                    int(params.get("replication_factor", ["2"])[0]),
-                    dryrun=dryrun, progress=progress)
+                    params["topic"], params["replication_factor"],
+                    dryrun=dryrun, progress=progress, **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "proposals":
             def run(progress):
                 res = facade.proposals(
-                    ignore_cache=_flag(params, "ignore_proposal_cache"),
-                    progress=progress)
-                return _optimization_response(res, None)
+                    ignore_cache=params.get("ignore_proposal_cache", False),
+                    goals=goals, progress=progress)
+                return _optimization_response(
+                    res, None, verbose=params.get("verbose", False))
         elif endpoint == "load":
             def run(progress):
-                return facade.load()
+                return facade.load(
+                    populate_disk_info=params.get("populate_disk_info",
+                                                  False),
+                    capacity_only=params.get("capacity_only", False))
         elif endpoint == "partition_load":
             def run(progress):
                 return {"records": facade.partition_load(
-                    resource=params.get("resource", ["DISK"])[0],
-                    start=int(params.get("start", ["0"])[0]),
-                    max_entries=int(params.get("entries", [str(2**31)])[0]))}
+                    resource=params.get("resource", "DISK"),
+                    start=params.get("start", 0),
+                    max_entries=params.get("entries", 2**31),
+                    topic_pattern=params.get("topic"),
+                    broker_ids=params.get("brokerid"),
+                    max_load=params.get("max_load", False))}
         elif endpoint == "bootstrap":
             def run(progress):
-                rounds = facade.bootstrap(
-                    int(params.get("start", ["0"])[0]),
-                    int(params.get("end", ["0"])[0]))
+                rounds = facade.bootstrap(params.get("start", 0),
+                                          params.get("end", 0))
                 return {"message": f"bootstrapped {rounds} rounds"}
         elif endpoint == "train":
             def run(progress):
@@ -260,9 +272,8 @@ class CruiseControlApp:
             # brokerid_and_logdirs=0-logdirA,0-logdirB,1-logdirA (the
             # reference's parameter format). Parsed + validated EAGERLY so
             # bad input is a 400 at dispatch, not an opaque 500 from the
-            # async task — and an absent parameter is an error, never a
-            # silent cluster-wide disk rebalance.
-            raw = params.get("brokerid_and_logdirs", [""])[0]
+            # async task.
+            raw = params["brokerid_and_logdirs"]
             drained: dict[int, list[str]] = {}
             for entry in raw.split(","):
                 if not entry.strip():
@@ -282,27 +293,43 @@ class CruiseControlApp:
 
             def run(progress):
                 return facade.remove_disks(drained, dryrun=dryrun,
-                                           progress=progress)
+                                           progress=progress, **exec_kwargs)
         else:  # pragma: no cover
             raise ValueError(endpoint)
         return run
 
-    def _handle_sync(self, endpoint: str, params: dict,
+    def _handle_sync(self, endpoint: str, params: ParsedParams,
                      principal) -> tuple[int, dict, dict]:
         facade = self.facade
         if endpoint == "state":
-            substates = params.get("substates", [None])[0]
-            return 200, facade.state(substates.split(",") if substates
-                                     else None), {}
+            return 200, facade.state(params.get("substates")), {}
         if endpoint == "kafka_cluster_state":
             return 200, facade.kafka_cluster_state(
-                verbose=_flag(params, "verbose")), {}
+                verbose=params.get("verbose", False),
+                topic_pattern=params.get("topic")), {}
         if endpoint == "openapi":
             from .openapi import openapi_spec
             return 200, openapi_spec(), {}
         if endpoint == "user_tasks":
-            return 200, {"userTasks": [t.to_json()
-                                       for t in self.tasks.all_tasks()]}, {}
+            tasks = self.tasks.all_tasks()
+            # ref UserTasksParameters filters: by task id / endpoint / type.
+            ids = params.get("user_task_ids")
+            if ids:
+                wanted = set(ids)
+                tasks = [t for t in tasks if t.user_task_id in wanted]
+            endpoints = params.get("endpoints")
+            if endpoints:
+                wanted = {e.lower() for e in endpoints}
+                tasks = [t for t in tasks if t.endpoint.lower() in wanted]
+            types = params.get("types")
+            if types:
+                wanted = {s.upper() for s in types}
+                tasks = [t for t in tasks
+                         if t.state.value.upper() in wanted]
+            entries = params.get("entries")
+            if entries:
+                tasks = tasks[:entries]
+            return 200, {"userTasks": [t.to_json() for t in tasks]}, {}
         if endpoint == "permissions":
             return 200, {"principal": principal.name,
                          "role": principal.role.name,
@@ -313,65 +340,100 @@ class CruiseControlApp:
             if self.purgatory is None:
                 return 400, {"errorMessage":
                              "two-step verification is disabled"}, {}
-            return 200, {"requestInfo": [
-                r.to_json() for r in self.purgatory.review_board()]}, {}
+            rows = self.purgatory.review_board()
+            ids = params.get("review_ids")
+            if ids:
+                wanted = set(ids)
+                rows = [r for r in rows if r.review_id in wanted]
+            return 200, {"requestInfo": [r.to_json() for r in rows]}, {}
         if endpoint == "review":
             if self.purgatory is None:
                 return 400, {"errorMessage":
                              "two-step verification is disabled"}, {}
             touched = self.purgatory.apply_review(
-                set(_ids(params, "approve")), set(_ids(params, "discard")),
-                params.get("reason", [""])[0])
+                set(params.get("approve") or ()),
+                set(params.get("discard") or ()),
+                params.get("reason") or "")
             return 200, {"requestInfo": [r.to_json()
                                          for r in touched.values()]}, {}
         if endpoint == "stop_proposal_execution":
-            facade.stop_proposal_execution()
+            facade.stop_proposal_execution(
+                force=params.get("force_stop", False),
+                stop_external_agent=params.get("stop_external_agent",
+                                               False))
             return 200, {"message": "Execution stop requested."}, {}
         if endpoint == "pause_sampling":
-            facade.pause_sampling(params.get("reason", [""])[0])
+            facade.pause_sampling(params.get("reason") or "")
             return 200, {"message": "Sampling paused."}, {}
         if endpoint == "resume_sampling":
-            facade.resume_sampling(params.get("reason", [""])[0])
+            facade.resume_sampling(params.get("reason") or "")
             return 200, {"message": "Sampling resumed."}, {}
         if endpoint == "admin":
             return 200, self._admin(params), {}
         return 404, {"errorMessage": f"unknown endpoint {endpoint}"}, {}
 
-    def _admin(self, params: dict) -> dict:
+    def _admin(self, params: ParsedParams) -> dict:
         """ref AdminParameters: runtime toggles."""
         out: dict = {}
         if "concurrent_partition_movements_per_broker" in params:
-            cap = int(params["concurrent_partition_movements_per_broker"][0])
+            cap = params["concurrent_partition_movements_per_broker"]
             self.facade.executor.config.concurrency.\
                 num_concurrent_partition_movements_per_broker = cap
             out["concurrencyPerBroker"] = cap
+        if "concurrent_intra_broker_partition_movements" in params:
+            cap = params["concurrent_intra_broker_partition_movements"]
+            self.facade.executor.config.concurrency.\
+                num_concurrent_intra_broker_partition_movements = cap
+            out["concurrencyIntraBroker"] = cap
         if "concurrent_leader_movements" in params:
-            cap = int(params["concurrent_leader_movements"][0])
+            cap = params["concurrent_leader_movements"]
             self.facade.executor.config.concurrency.\
                 num_concurrent_leader_movements = cap
             out["concurrencyLeader"] = cap
-        if _flag(params, "drop_recently_removed_brokers"):
+        if params.get("drop_recently_removed_brokers"):
             self.facade.executor.recently_removed_brokers.clear()
             out["droppedRecentlyRemovedBrokers"] = True
-        if _flag(params, "drop_recently_demoted_brokers"):
+        if params.get("drop_recently_demoted_brokers"):
             self.facade.executor.recently_demoted_brokers.clear()
             out["droppedRecentlyDemotedBrokers"] = True
+        if "min_isr_based_concurrency_adjustment" in params:
+            self.facade.executor.config.concurrency_adjuster_enabled = \
+                params["min_isr_based_concurrency_adjustment"]
+            out["minIsrBasedConcurrencyAdjustment"] = params[
+                "min_isr_based_concurrency_adjustment"]
+        if "disable_concurrency_adjuster_for" in params:
+            for t in params["disable_concurrency_adjuster_for"]:
+                self.facade.executor.adjuster_disabled_types.add(
+                    t.strip().lower())
+            out["disabledConcurrencyAdjuster"] = params[
+                "disable_concurrency_adjuster_for"]
+        if "enable_concurrency_adjuster_for" in params:
+            for t in params["enable_concurrency_adjuster_for"]:
+                self.facade.executor.adjuster_disabled_types.discard(
+                    t.strip().lower())
+            out["enabledConcurrencyAdjuster"] = params[
+                "enable_concurrency_adjuster_for"]
         detector = self.facade.detector
         if detector is not None:
             if "disable_self_healing_for" in params:
-                for name in params["disable_self_healing_for"][0].split(","):
-                    detector.set_self_healing_enabled(name.strip(), False)
+                for name in params["disable_self_healing_for"]:
+                    detector.set_self_healing_enabled(name, False)
                 out["disabledSelfHealing"] = params[
-                    "disable_self_healing_for"][0]
+                    "disable_self_healing_for"]
             if "enable_self_healing_for" in params:
-                for name in params["enable_self_healing_for"][0].split(","):
-                    detector.set_self_healing_enabled(name.strip(), True)
-                out["enabledSelfHealing"] = params["enable_self_healing_for"][0]
+                for name in params["enable_self_healing_for"]:
+                    detector.set_self_healing_enabled(name, True)
+                out["enabledSelfHealing"] = params["enable_self_healing_for"]
         return out or {"message": "no-op"}
 
 
-def _optimization_response(res, exec_res) -> dict:
+def _optimization_response(res, exec_res, verbose: bool = False) -> dict:
     out = res.to_json()
+    if verbose:
+        # ref verbose proposals responses carrying the optimized load
+        # (ProposalsRunnable verbose -> broker stats after optimization).
+        from ..model.stats import stats_summary
+        out["loadAfterOptimization"] = stats_summary(res.final_model)
     if exec_res is not None:
         out["executionResult"] = {
             "succeeded": exec_res.succeeded, "stopped": exec_res.stopped,

@@ -131,27 +131,42 @@ class ConcurrencyAdjuster:
 
     def __init__(self, manager: ExecutionConcurrencyManager) -> None:
         self.manager = manager
+        #: concurrency types the adjuster must leave alone (ref
+        #: (DISABLE|ENABLE)_CONCURRENCY_ADJUSTER_FOR_PARAM; values from
+        #: {"inter_broker_replica", "leadership"}).
+        self.disabled_types: set[str] = set()
+
+    def set_enabled_for(self, concurrency_type: str, enabled: bool) -> None:
+        key = concurrency_type.strip().lower()
+        if key not in ("inter_broker_replica", "leadership"):
+            raise ValueError(
+                f"unknown concurrency type {concurrency_type!r} "
+                "(want inter_broker_replica or leadership)")
+        (self.disabled_types.discard if enabled
+         else self.disabled_types.add)(key)
 
     def refresh(self, broker_metrics: dict[int, dict[str, float]],
                 num_min_isr_partitions: int = 0) -> dict[int, int]:
         cfg = self.manager.config
         new_caps: dict[int, int] = {}
         cluster_stressed = num_min_isr_partitions > 0
-        for broker_id, metrics in broker_metrics.items():
-            cap = self.manager.inter_broker_cap(broker_id)
-            stressed = (
-                cluster_stressed
-                or metrics.get("request_queue_size", 0.0)
-                > cfg.limit_request_queue_size
-                or metrics.get("log_flush_time_ms", 0.0)
-                > cfg.limit_log_flush_time_ms)
-            cap = max(cfg.min_partition_movements_per_broker, cap // 2) \
-                if stressed else cap + 1
-            self.manager.set_inter_broker_cap(broker_id, cap)
-            new_caps[broker_id] = self.manager.inter_broker_cap(broker_id)
+        if "inter_broker_replica" not in self.disabled_types:
+            for broker_id, metrics in broker_metrics.items():
+                cap = self.manager.inter_broker_cap(broker_id)
+                stressed = (
+                    cluster_stressed
+                    or metrics.get("request_queue_size", 0.0)
+                    > cfg.limit_request_queue_size
+                    or metrics.get("log_flush_time_ms", 0.0)
+                    > cfg.limit_log_flush_time_ms)
+                cap = max(cfg.min_partition_movements_per_broker, cap // 2) \
+                    if stressed else cap + 1
+                self.manager.set_inter_broker_cap(broker_id, cap)
+                new_caps[broker_id] = self.manager.inter_broker_cap(broker_id)
         # Leadership cap follows the same cluster-level signal (ref :614-onw).
-        lead = self.manager.leadership_cluster_cap
-        self.manager.set_cluster_leadership_cap(
-            max(cfg.min_leader_movements, lead // 2) if cluster_stressed
-            else lead + max(1, lead // 10))
+        if "leadership" not in self.disabled_types:
+            lead = self.manager.leadership_cluster_cap
+            self.manager.set_cluster_leadership_cap(
+                max(cfg.min_leader_movements, lead // 2) if cluster_stressed
+                else lead + max(1, lead // 10))
         return new_caps

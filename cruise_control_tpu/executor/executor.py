@@ -122,10 +122,15 @@ class Executor:
         self._state = ExecutorState.NO_TASK_IN_PROGRESS
         self._stop_requested = threading.Event()
         self._task_manager: ExecutionTaskManager | None = None
+        self._progress_interval_ms = self.config.progress_check_interval_ms
         self._current_uuid: str | None = None
         #: brokers removed/demoted by recent executions (ref Executor.java:426-434)
         self.recently_removed_brokers: set[int] = set()
         self.recently_demoted_brokers: set[int] = set()
+        #: adjuster types disabled at runtime via /admin (seeded into each
+        #: execution's ConcurrencyAdjuster; ref
+        #: DISABLE_CONCURRENCY_ADJUSTER_FOR_PARAM)
+        self.adjuster_disabled_types: set[str] = set()
         # Execution sensors (ref Executor.java:256-266
         # proposal-execution-timer, ExecutionTaskTracker.java:121-122
         # movement-rate meters, Executor.java:348-360 ongoing gauges).
@@ -162,10 +167,32 @@ class Executor:
             out["triggeredUserTaskId"] = self._current_uuid
         return out
 
-    def stop_execution(self) -> None:
-        """User-triggered stop (ref userTriggeredStopExecution :1145)."""
+    def stop_execution(self, force: bool = False,
+                       stop_external_agent: bool = False) -> None:
+        """User-triggered stop (ref userTriggeredStopExecution :1145).
+
+        ``force`` cancels the cluster's in-flight reassignments NOW
+        instead of waiting for the run loop's next poll to observe the
+        stop flag (ref FORCE_STOP_PARAM / maybeStopPartitionReassignment);
+        with ``stop_external_agent`` the cancellation covers every ongoing
+        reassignment — including ones started outside this executor (ref
+        STOP_EXTERNAL_AGENT_PARAM)."""
         if self.has_ongoing_execution():
             self._stop_requested.set()
+        elif not (force and stop_external_agent):
+            return
+        if force:
+            ongoing = self.admin.list_partition_reassignments()
+            if not stop_external_agent:
+                tm = self._task_manager
+                ours = ({t.topic_partition for tt in TaskType
+                         for t in tm.tracker.tasks_in(
+                             tt, TaskState.IN_PROGRESS)}
+                        if tm is not None else set())
+                ongoing = {tp: v for tp, v in ongoing.items() if tp in ours}
+            if ongoing:
+                self.admin.alter_partition_reassignments(
+                    {tp: None for tp in ongoing})
 
     # ----------------------------------------------------------- execute
     def execute_proposals(self, proposals: list[ExecutionProposal],
@@ -176,10 +203,18 @@ class Executor:
                           throttle_bytes: int | None = None,
                           removed_brokers: set[int] | None = None,
                           demoted_brokers: set[int] | None = None,
+                          concurrency_overrides: dict | None = None,
+                          progress_check_interval_ms: int | None = None,
                           ) -> ExecutionResult:
         """Apply proposals to the cluster; blocks until done/stopped (ref
         ``executeProposals`` ``Executor.java:810`` + ProposalExecutionRunnable).
-        Call from a worker thread for async semantics (the API layer does)."""
+        Call from a worker thread for async semantics (the API layer does).
+
+        ``concurrency_overrides`` maps :class:`ConcurrencyConfig` field
+        names to per-request values and ``progress_check_interval_ms``
+        overrides the poll cadence for THIS execution only (ref the
+        per-request concurrency/interval parameters the runnables read,
+        e.g. ``RebalanceParameters`` CONCURRENT_*_PARAM)."""
         with self._lock:
             if self.has_ongoing_execution():
                 raise OngoingExecutionError(
@@ -205,10 +240,20 @@ class Executor:
             if intra_broker_moves:
                 tm.add_intra_broker_tasks(intra_broker_moves)
             planner = ExecutionTaskPlanner(strategy_chain(strategy_names))
+            cc = self.config.concurrency
+            if concurrency_overrides:
+                from dataclasses import replace as _dc_replace
+                cc = _dc_replace(cc, **concurrency_overrides)
+            self._progress_interval_ms = (
+                progress_check_interval_ms
+                if progress_check_interval_ms is not None
+                else self.config.progress_check_interval_ms)
             concurrency = ExecutionConcurrencyManager(
-                self.config.concurrency, list(self.admin.describe_cluster()))
+                cc, list(self.admin.describe_cluster()))
             adjuster = (ConcurrencyAdjuster(concurrency)
                         if self.config.concurrency_adjuster_enabled else None)
+            if adjuster is not None:
+                adjuster.disabled_types |= self.adjuster_disabled_types
             inter = [t for t in tasks
                      if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
             throttler.set_throttles(inter)
@@ -297,7 +342,7 @@ class Executor:
                     tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
                     tm.tracker.transition(t, TaskState.DEAD, now)
                 break
-            self._sleep_ms(self.config.progress_check_interval_ms)
+            self._sleep_ms(self._progress_interval_ms)
             self._poll_inter_broker_progress()
             if adjuster is not None:
                 alive = self.admin.describe_cluster()
@@ -372,7 +417,7 @@ class Executor:
                         tm.tracker.transition(t, TaskState.DEAD, now)
             elif not in_progress:
                 break
-            self._sleep_ms(self.config.progress_check_interval_ms)
+            self._sleep_ms(self._progress_interval_ms)
             dirs = self.admin.describe_replica_log_dirs()
             alive = self.admin.describe_cluster()
             now = self._now_ms()
@@ -417,7 +462,7 @@ class Executor:
                 if ok:
                     self._leadership_move_meter.mark()
             if tm.tracker.num_remaining(tt) > 0:
-                self._sleep_ms(self.config.progress_check_interval_ms)
+                self._sleep_ms(self._progress_interval_ms)
 
     # ------------------------------------------------------------ helpers
     def _abort_in_flight(self) -> None:

@@ -203,6 +203,9 @@ class KafkaAdminClusterClient:
                 f"alterPartitionReassignments timed out for {tp}; check "
                 "broker/controller health and consider increasing "
                 "admin.client.request.timeout.ms") from e
+        if e.code == "CLUSTER_AUTHORIZATION_FAILED":
+            raise AdminAuthorizationError(
+                "not authorized to alter partition reassignments") from e
         raise AdminOperationError(
             f"unexpected error for {tp}: {e.code}") from e
 
@@ -288,6 +291,9 @@ class KafkaAdminClusterClient:
                 if e.code == "REQUEST_TIMED_OUT":
                     raise AdminTimeoutError(
                         f"alterReplicaLogDirs timed out for {key}") from e
+                if e.code == "CLUSTER_AUTHORIZATION_FAILED":
+                    raise AdminAuthorizationError(
+                        "not authorized to alter replica log dirs") from e
                 errors[key] = f"logdir move failed: {e.code}"
         return errors
 

@@ -11,7 +11,8 @@ from cruise_control_tpu.api.openapi import ENDPOINTS, openapi_spec
 from cruise_control_tpu.api.security import (AuthorizationError,
                                              JwtSecurityProvider, Role,
                                              check_access)
-from cruise_control_tpu.api.server import KAFKA_ASSIGNER_GOALS, _goals
+from cruise_control_tpu.analyzer.goals import KAFKA_ASSIGNER_GOALS
+from cruise_control_tpu.api.parameters import parse_endpoint_params
 from cruise_control_tpu.core.metricdef import BrokerMetric, KafkaMetric
 from cruise_control_tpu.detector.anomalies import BrokerFailures
 from cruise_control_tpu.detector.notifier import (AlertaSelfHealingNotifier,
@@ -241,11 +242,14 @@ def test_webhook_delivery_failure_never_raises():
 # ------------------------------------------------- kafka-assigner + openapi
 
 def test_goals_param_kafka_assigner_mode():
-    assert _goals({"kafka_assigner": ["true"]}) == KAFKA_ASSIGNER_GOALS
+    def goals_of(query):
+        return parse_endpoint_params("rebalance", query).goal_list()
+    assert goals_of({"kafka_assigner": ["true"]}) == list(
+        KAFKA_ASSIGNER_GOALS)
     # explicit goals win over the assigner flag (reference precedence)
-    assert _goals({"kafka_assigner": ["true"],
-                   "goals": ["RackAwareGoal"]}) == ["RackAwareGoal"]
-    assert _goals({}) is None
+    assert goals_of({"kafka_assigner": ["true"],
+                     "goals": ["RackAwareGoal"]}) == ["RackAwareGoal"]
+    assert goals_of({}) is None
 
 
 def test_openapi_covers_all_23_endpoints():

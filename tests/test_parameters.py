@@ -1,0 +1,200 @@
+"""Typed parameter layer tests (the rebuild of servlet/parameters/*Test):
+per-endpoint validation — unknown params, bad types, missing required
+params, forbidden combinations — plus HTTP-level 400s through the served
+stack and per-request execution knobs reaching the executor."""
+
+import pytest
+
+from cruise_control_tpu.api.parameters import (ParameterError,
+                                               parse_endpoint_params)
+
+from test_api import build_stack, call
+
+
+def parse(endpoint, **kv):
+    return parse_endpoint_params(
+        endpoint, {k: [v] for k, v in kv.items()})
+
+
+# ------------------------------------------------------------ unit parsing
+
+def test_typed_parsing_and_defaults():
+    p = parse("rebalance", dryrun="false", goals="RackAwareGoal,DiskCapacityGoal",
+              concurrent_leader_movements="250",
+              replication_throttle="100000")
+    assert p["dryrun"] is False
+    assert p["goals"] == ["RackAwareGoal", "DiskCapacityGoal"]
+    assert p.goal_list() == ["RackAwareGoal", "DiskCapacityGoal"]
+    assert p["concurrent_leader_movements"] == 250
+    assert p.get("skip_hard_goal_check") is False      # default
+    kw = p.execution_kwargs()
+    assert kw["throttle_bytes"] == 100_000
+    assert kw["concurrency_overrides"] == {
+        "num_concurrent_leader_movements": 250}
+
+
+def test_unknown_parameter_rejected():
+    with pytest.raises(ParameterError, match="unrecognized"):
+        parse("rebalance", graels="RackAwareGoal")
+    with pytest.raises(ParameterError, match="unrecognized"):
+        parse("state", dryrun="true")     # dryrun is not a state param
+
+
+def test_bad_types_rejected():
+    with pytest.raises(ParameterError, match="not a boolean"):
+        parse("rebalance", dryrun="maybe")
+    with pytest.raises(ParameterError, match="not an integer"):
+        parse("add_broker", brokerid="1", concurrent_leader_movements="ten")
+    with pytest.raises(ParameterError, match="minimum"):
+        parse("rebalance", concurrent_leader_movements="0")
+    with pytest.raises(ParameterError, match="not in"):
+        parse("partition_load", resource="GPU")
+
+
+def test_required_parameters():
+    with pytest.raises(ParameterError, match="brokerid"):
+        parse("add_broker")
+    with pytest.raises(ParameterError, match="replication_factor"):
+        parse("topic_configuration", topic="t0")
+    with pytest.raises(ParameterError, match="brokerid_and_logdirs"):
+        parse("remove_disks")
+    assert parse("add_broker", brokerid="1,2")["brokerid"] == [1, 2]
+
+
+def test_forbidden_combinations():
+    with pytest.raises(ParameterError, match="mutually exclusive"):
+        parse("partition_load", max_load="true", avg_load="true")
+    with pytest.raises(ParameterError, match="mutually exclusive"):
+        parse("rebalance", rebalance_disk="true",
+              destination_broker_ids="1")
+    with pytest.raises(ParameterError, match="both removed and dest"):
+        parse("remove_broker", brokerid="1,2", destination_broker_ids="2,3")
+    with pytest.raises(ParameterError, match="enabled and"):
+        parse("admin", enable_self_healing_for="broker_failure",
+              disable_self_healing_for="broker_failure")
+    with pytest.raises(ParameterError, match="approve"):
+        parse("review")
+
+
+def test_kafka_assigner_goal_resolution():
+    p = parse("rebalance", kafka_assigner="true")
+    goals = p.goal_list()
+    assert goals and all(isinstance(g, str) for g in goals)
+    # explicit goals win over the assigner chain
+    p = parse("rebalance", kafka_assigner="true", goals="RackAwareGoal")
+    assert p.goal_list() == ["RackAwareGoal"]
+
+
+def test_duplicate_parameter_rejected():
+    with pytest.raises(ParameterError, match="2 times"):
+        parse_endpoint_params("rebalance", {"dryrun": ["true", "false"]})
+
+
+# --------------------------------------------------------------- over HTTP
+
+@pytest.fixture(scope="module")
+def stack():
+    sim, facade, app = build_stack()
+    yield sim, facade, app
+    app.stop()
+
+
+def test_http_rejects_malformed_input(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "POST", "rebalance",
+                           "dryrun=perhaps", expect=400)
+    assert "boolean" in body["errorMessage"]
+    status, body, _ = call(app, "POST", "rebalance",
+                           "bogus_param=1", expect=400)
+    assert "unrecognized" in body["errorMessage"]
+    status, body, _ = call(app, "POST", "add_broker", "dryrun=true",
+                           expect=400)
+    assert "brokerid" in body["errorMessage"]
+    status, body, _ = call(app, "GET", "partition_load",
+                           "resource=FLOPS", expect=400)
+    assert "resource" in body["errorMessage"]
+
+
+def test_http_per_request_execution_knobs(stack):
+    _, facade, app = stack
+    # A dryrun carries the overrides harmlessly; a real run applies them.
+    status, body, _ = call(
+        app, "POST", "rebalance",
+        "dryrun=false&concurrent_partition_movements_per_broker=2"
+        "&execution_progress_check_interval_ms=50"
+        "&get_response_timeout_s=120")
+    assert status == 200, body
+    # The per-request interval drove this execution's polling...
+    assert facade.executor._progress_interval_ms == 50
+    # ...but the server-wide config was not mutated.
+    assert facade.executor.config.progress_check_interval_ms != 50
+    assert facade.executor.config.concurrency.\
+        num_concurrent_partition_movements_per_broker == 5
+
+
+def test_http_partition_load_filters(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "partition_load",
+                           "topic=t1&entries=100")
+    assert status == 200
+    assert body["records"] and all(r["topic"] == "t1"
+                                   for r in body["records"])
+    status, body, _ = call(app, "GET", "partition_load",
+                           "brokerid=3&entries=100")
+    rows = body["records"]
+    assert all(3 in [r["leader"], *r["followers"]] for r in rows)
+    status, body, _ = call(app, "GET", "partition_load",
+                           "max_load=true&entries=5")
+    assert status == 200 and len(body["records"]) == 5
+
+
+def test_http_kafka_cluster_state_topic_filter(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "kafka_cluster_state",
+                           "verbose=true&topic=t1")
+    assert status == 200
+    parts = body["KafkaPartitionState"]["Partitions"]
+    assert parts and all(p["topic"] == "t1" for p in parts)
+
+
+def test_http_rebalance_disk_routes_to_intra_broker(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "POST", "rebalance",
+                           "rebalance_disk=true&dryrun=true"
+                           "&get_response_timeout_s=120")
+    assert status == 200, body
+    # The intra-broker response shape, not the inter-broker proposal shape.
+    assert "numIntraBrokerMoves" in body
+    assert "proposals" not in body
+
+
+def test_http_remove_broker_destinations_honored(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "POST", "remove_broker",
+                           "brokerid=3&destination_broker_ids=0"
+                           "&dryrun=true&get_response_timeout_s=120")
+    assert status == 200, body
+    for p in body["proposals"]:
+        added = set(p["newReplicas"]) - set(p["oldReplicas"])
+        assert added <= {0}, p
+
+
+def test_http_proposals_with_goals(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "proposals",
+                           "goals=ReplicaDistributionGoal"
+                           "&get_response_timeout_s=120")
+    assert status == 200, body
+    names = [g["goal"] for g in body["goalSummary"]]
+    assert names == ["ReplicaDistributionGoal"]
+
+
+def test_http_load_capacity_and_disk_info(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "load", "capacity_only=true")
+    assert status == 200
+    b0 = body["brokers"][0]
+    assert "Capacity" in b0 and "CpuPct" not in b0
+    status, body, _ = call(app, "GET", "load", "populate_disk_info=true")
+    assert status == 200
+    assert "DiskState" in body["brokers"][0]
