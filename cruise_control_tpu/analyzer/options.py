@@ -20,6 +20,9 @@ from ..model.spec import ClusterMetadata
 class OptimizationOptions:
     excluded_topics: frozenset[str] = frozenset()
     excluded_topics_pattern: str | None = None
+    #: individual partitions pinned in place (framework extension used by
+    #: skip_urp_demotion: URPs must not move during a demote)
+    excluded_partitions: frozenset[tuple] = frozenset()
     excluded_brokers_for_leadership: frozenset[int] = frozenset()
     excluded_brokers_for_replica_move: frozenset[int] = frozenset()
     # When non-empty, only these brokers may receive replicas
@@ -38,7 +41,8 @@ class OptimizationOptions:
                                 padded_partitions: int) -> np.ndarray | None:
         pattern = (re.compile(self.excluded_topics_pattern)
                    if self.excluded_topics_pattern else None)
-        if not self.excluded_topics and pattern is None:
+        if (not self.excluded_topics and pattern is None
+                and not self.excluded_partitions):
             return None
         excluded_topic_ids = {
             metadata.topic_index[t] for t in self.excluded_topics
@@ -47,11 +51,12 @@ class OptimizationOptions:
             for t, i in metadata.topic_index.items():
                 if pattern.fullmatch(t):
                     excluded_topic_ids.add(i)
-        if not excluded_topic_ids:
+        if not excluded_topic_ids and not self.excluded_partitions:
             return None
         mask = np.zeros(padded_partitions, bool)
-        for p, (topic, _) in enumerate(metadata.partition_keys):
-            if metadata.topic_index[topic] in excluded_topic_ids:
+        for p, (topic, part) in enumerate(metadata.partition_keys):
+            if (metadata.topic_index[topic] in excluded_topic_ids
+                    or (topic, part) in self.excluded_partitions):
                 mask[p] = True
         return mask
 

@@ -82,11 +82,14 @@ class KafkaCruiseControl:
 
     # ----------------------------------------------------------- lifecycle
     def start_up(self, precompute_interval_s: float = 30.0,
-                 start_precompute: bool = True) -> None:
-        """ref startUp() KafkaCruiseControl.java:221-227."""
+                 start_precompute: bool = True,
+                 skip_loading: bool = False) -> None:
+        """ref startUp() KafkaCruiseControl.java:221-227.
+        ``skip_loading`` bypasses sample-store replay (ref
+        skip.loading.samples)."""
         if self.task_runner is not None and \
                 self.task_runner.state.value == "NOT_STARTED":
-            self.task_runner.start(self._now_ms())
+            self.task_runner.start(self._now_ms(), skip_loading=skip_loading)
         if start_precompute:
             self.proposal_cache.start_refresher(precompute_interval_s,
                                                 self._now_ms)
@@ -211,16 +214,21 @@ class KafkaCruiseControl:
     def add_brokers(self, broker_ids: list[int], dryrun: bool = True,
                     goals: list[str] | None = None, uuid: str = "",
                     progress: OperationProgress | None = None,
+                    options: OptimizationOptions | None = None,
                     **executor_kwargs):
         """Move load onto the new brokers (ref AddBrokersRunnable; new
-        brokers become the only allowed destinations)."""
+        brokers become the only allowed destinations). ``options`` carries
+        the request's goal options; the destination restriction is imposed
+        on top."""
+        from dataclasses import replace as _dc_replace
+
         def mark_new(spec):
             for b in spec.brokers:
                 if b.broker_id in set(broker_ids):
                     b.new = True
             return spec
-        options = OptimizationOptions(
-            destination_broker_ids=frozenset(broker_ids))
+        options = _dc_replace(options or OptimizationOptions(),
+                              destination_broker_ids=frozenset(broker_ids))
         res = self._optimize(progress, goals, options, spec_mutator=mark_new)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
                                        **executor_kwargs)
@@ -230,11 +238,13 @@ class KafkaCruiseControl:
                        goals: list[str] | None = None, uuid: str = "",
                        progress: OperationProgress | None = None,
                        destination_broker_ids: frozenset[int] | None = None,
+                       options: OptimizationOptions | None = None,
                        **executor_kwargs):
         """Drain the given brokers (ref RemoveBrokersRunnable: demoted to
         dead state so every replica becomes a must-move;
         ``destination_broker_ids`` restricts where drained replicas may
         land, ref DESTINATION_BROKER_IDS_PARAM)."""
+        from dataclasses import replace as _dc_replace
         removed = set(broker_ids)
 
         def mark_dead(spec):
@@ -242,8 +252,11 @@ class KafkaCruiseControl:
                 if b.broker_id in removed:
                     b.alive = False
             return spec
-        options = OptimizationOptions(
-            destination_broker_ids=frozenset(destination_broker_ids or ()))
+        options = options or OptimizationOptions()
+        if destination_broker_ids:
+            options = _dc_replace(
+                options,
+                destination_broker_ids=frozenset(destination_broker_ids))
         res = self._optimize(progress, goals, options,
                              spec_mutator=mark_dead)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
@@ -254,9 +267,20 @@ class KafkaCruiseControl:
     def demote_brokers(self, broker_ids: list[int], dryrun: bool = True,
                        uuid: str = "",
                        progress: OperationProgress | None = None,
+                       options: OptimizationOptions | None = None,
+                       skip_urp_demotion: bool = True,
+                       exclude_follower_demotion: bool = True,
                        **executor_kwargs):
         """Move leadership (and preferred-leader order) off the brokers
-        (ref DemoteBrokerRunnable + PreferredLeaderElectionGoal)."""
+        (ref DemoteBrokerRunnable + PreferredLeaderElectionGoal).
+
+        ``skip_urp_demotion`` (ref SKIP_URP_DEMOTION_PARAM, default true)
+        pins under-replicated partitions in place — shuffling leadership
+        of a partition already missing replicas risks unavailability.
+        ``exclude_follower_demotion`` (ref EXCLUDE_FOLLOWER_DEMOTION_PARAM,
+        default true) keeps follower replicas' preferred order; when false
+        the demoted brokers also sink to the end of every replica list."""
+        from dataclasses import replace as _dc_replace
         demoted = set(broker_ids)
 
         def mark_demoted(spec):
@@ -272,12 +296,29 @@ class KafkaCruiseControl:
                         head = alive[0]
                         rest = [r for r in p.replicas if r != head]
                         p.replicas = [head, *rest]
+                if not exclude_follower_demotion and p.replicas:
+                    # Follower demotion: demoted brokers sink to the tail
+                    # of the preferred order (relative order preserved).
+                    p.replicas = ([r for r in p.replicas
+                                   if r not in demoted]
+                                  + [r for r in p.replicas if r in demoted])
             return spec
+
+        options = options or OptimizationOptions()
+        excluded_parts = set(options.excluded_partitions)
+        if skip_urp_demotion:
+            excluded_parts |= {
+                tp for tp, info in self.admin.describe_partitions().items()
+                if len(info.isr) < len(info.replicas)}
+        options = _dc_replace(
+            options,
+            excluded_brokers_for_leadership=(
+                options.excluded_brokers_for_leadership
+                | frozenset(broker_ids)),
+            excluded_partitions=frozenset(excluded_parts))
         res = self._optimize(progress,
                              ["PreferredLeaderElectionGoal"],
-                             OptimizationOptions(
-                                 excluded_brokers_for_leadership=
-                                 frozenset(broker_ids)),
+                             options,
                              spec_mutator=mark_demoted)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
                                        demoted_brokers=demoted,
@@ -287,10 +328,12 @@ class KafkaCruiseControl:
     def fix_offline_replicas(self, dryrun: bool = True, uuid: str = "",
                              goals: list[str] | None = None,
                              progress: OperationProgress | None = None,
+                             options: OptimizationOptions | None = None,
                              **executor_kwargs):
         """ref FixOfflineReplicasRunnable: offline replicas are must-moves
         in the analyzer already; this runs the chain and executes."""
-        res = self._optimize(progress, goals, OptimizationOptions())
+        res = self._optimize(progress, goals,
+                             options or OptimizationOptions())
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
                                        **executor_kwargs)
         return res, exec_res
@@ -298,6 +341,7 @@ class KafkaCruiseControl:
     def update_topic_configuration(self, topic_pattern: str, target_rf: int,
                                    dryrun: bool = True, uuid: str = "",
                                    progress: OperationProgress | None = None,
+                                   options: OptimizationOptions | None = None,
                                    **executor_kwargs):
         """Replication-factor change (ref UpdateTopicConfigurationRunnable +
         ClusterModel.createOrDeleteReplicas :962): adjust each matched
@@ -349,7 +393,8 @@ class KafkaCruiseControl:
                     kept.extend(r for r in replicas if r not in kept)
                     p.preferred_replicas = kept
             return spec
-        res = self._optimize(progress, None, OptimizationOptions(),
+        res = self._optimize(progress, None,
+                             options or OptimizationOptions(),
                              spec_mutator=change_rf)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
                                        **executor_kwargs)

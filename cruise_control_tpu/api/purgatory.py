@@ -50,15 +50,25 @@ class RequestInfo:
 
 
 class Purgatory:
-    def __init__(self, retention_ms: int = 7 * 24 * 3600 * 1000) -> None:
+    def __init__(self, retention_ms: int = 7 * 24 * 3600 * 1000,
+                 max_requests: int = 25) -> None:
         self._requests: dict[int, RequestInfo] = {}
         self._ids = itertools.count()
         self._lock = threading.RLock()
         self.retention_ms = retention_ms
+        #: pending-request cap (ref two.step.purgatory.max.requests)
+        self.max_requests = max_requests
 
     def add(self, endpoint: str, params: dict, submitter: str) -> RequestInfo:
         """ref maybeAddToPurgatory :115."""
         with self._lock:
+            pending = sum(1 for r in self._requests.values()
+                          if r.status is ReviewStatus.PENDING_REVIEW)
+            if pending >= self.max_requests:
+                raise ValueError(
+                    f"purgatory is full ({pending} pending requests >= "
+                    f"two.step.purgatory.max.requests={self.max_requests}); "
+                    "review or discard pending requests first")
             info = RequestInfo(next(self._ids), endpoint, params, submitter)
             self._requests[info.review_id] = info
             return info
@@ -83,9 +93,10 @@ class Purgatory:
                 touched[rid] = info
             return touched
 
-    def submit(self, review_id: int) -> RequestInfo:
-        """Mark an approved request submitted, returning it for execution
-        (ref submit :169)."""
+    def get(self, review_id: int) -> RequestInfo:
+        """Read an approved request WITHOUT consuming it — callers validate
+        the replayed request first, then :meth:`submit` (a replay typo
+        must not burn the approval)."""
         with self._lock:
             info = self._requests.get(review_id)
             if info is None:
@@ -93,6 +104,13 @@ class Purgatory:
             if ReviewStatus.SUBMITTED not in _VALID[info.status]:
                 raise ValueError(
                     f"request {review_id} is {info.status.value}, not APPROVED")
+            return info
+
+    def submit(self, review_id: int) -> RequestInfo:
+        """Mark an approved request submitted, returning it for execution
+        (ref submit :169)."""
+        with self._lock:
+            info = self.get(review_id)
             info.status = ReviewStatus.SUBMITTED
             return info
 

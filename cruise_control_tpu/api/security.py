@@ -108,7 +108,9 @@ class JwtSecurityProvider:
     def __init__(self, secret: bytes | str, *, role_claim: str = "role",
                  default_role: Role = Role.VIEWER,
                  now_s: "Callable[[], float] | None" = None,
-                 max_token_age_s: float | None = None):
+                 max_token_age_s: float | None = None,
+                 expected_audiences: "list[str] | None" = None,
+                 cookie_name: str | None = None):
         import time
         self.secret = secret.encode() if isinstance(secret, str) else secret
         self.role_claim = role_claim
@@ -117,6 +119,11 @@ class JwtSecurityProvider:
         #: hard cap on token lifetime from ``iat``; tokens older than this
         #: are rejected even if their ``exp`` lies further out.
         self.max_token_age_s = max_token_age_s
+        #: accepted aud values (ref jwt.expected.audiences; empty = any)
+        self.expected_audiences = list(expected_audiences or ())
+        #: cookie carrying the token besides the Bearer header (ref
+        #: jwt.cookie.name / JwtAuthenticator cookie extraction)
+        self.cookie_name = cookie_name
 
     @staticmethod
     def _b64url_decode(part: str) -> bytes:
@@ -146,10 +153,17 @@ class JwtSecurityProvider:
         import hmac
         import json
         auth = headers.get("authorization", headers.get("Authorization", ""))
-        if not auth.startswith("Bearer "):
+        token = auth[7:].strip() if auth.startswith("Bearer ") else ""
+        if not token and self.cookie_name:
+            # ref JwtAuthenticator: the token may arrive in a cookie.
+            for part in headers.get("cookie", "").split(";"):
+                name, _, value = part.strip().partition("=")
+                if name == self.cookie_name and value:
+                    token = value
+                    break
+        if not token:
             raise AuthorizationError("missing bearer token", 401,
                                      challenge="Bearer")
-        token = auth[7:].strip()
         parts = token.split(".")
         if len(parts) != 3:
             raise AuthorizationError("malformed JWT", 401)
@@ -193,6 +207,13 @@ class JwtSecurityProvider:
             iat = _ts("iat", required=True)
             if now - iat > self.max_token_age_s:
                 raise AuthorizationError("JWT exceeds max token age", 401)
+        if self.expected_audiences:
+            aud = claims.get("aud")
+            auds = set(aud if isinstance(aud, list) else [aud]
+                       if aud is not None else [])
+            if not auds & set(self.expected_audiences):
+                raise AuthorizationError(
+                    "JWT aud claim matches no expected audience", 401)
         name = claims.get("sub")
         if not name:
             raise AuthorizationError("JWT missing sub claim", 401)
@@ -267,16 +288,29 @@ class TrustedProxySecurityProvider:
 
     def __init__(self, trusted_proxies: set[str],
                  principal_header: str = "doAs",
-                 role: Role = Role.USER):
+                 role: Role = Role.USER,
+                 ip_regex: str | None = None):
+        import re
         self.trusted_proxies = trusted_proxies
         # The HTTP layer lowercases header names before dispatch.
         self.principal_header = principal_header.lower()
         self.role = role
+        #: source-address gate (ref trusted.proxy.services.ip.regex): the
+        #: proxy must ALSO connect from a matching address when set.
+        self.ip_pattern = re.compile(ip_regex) if ip_regex else None
 
     def authenticate(self, headers: dict[str, str]) -> Principal:
         proxy = headers.get("x-forwarded-by", "")
         if proxy not in self.trusted_proxies:
             raise AuthorizationError(f"untrusted proxy {proxy!r}", 403)
+        if self.ip_pattern is not None:
+            # The HTTP layer records the peer address under this pseudo
+            # header (never forwarded — set from the socket).
+            addr = headers.get("x-cc-peer-address", "")
+            if not self.ip_pattern.fullmatch(addr):
+                raise AuthorizationError(
+                    f"proxy address {addr!r} not allowed by "
+                    "trusted.proxy.services.ip.regex", 403)
         name = headers.get(self.principal_header, "")
         if not name:
             raise AuthorizationError("missing doAs principal", 401)

@@ -16,6 +16,7 @@ POST : rebalance, add_broker, remove_broker, fix_offline_replicas,
 from __future__ import annotations
 
 import json
+import logging
 import threading
 # Distinct from builtin TimeoutError before Python 3.11.
 from concurrent.futures import TimeoutError as _FuturesTimeout
@@ -41,6 +42,10 @@ POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
 #: POSTs that execute immediately even with two-step verification on
 #: (ref Purgatory: REVIEW itself and flow-control endpoints skip review).
 NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution"}
+
+#: per-request access log (ref webserver.accesslog.enabled; the reference
+#: writes an NCSA access log through Jetty)
+_ACCESS_LOG = logging.getLogger("cruise_control_tpu.access")
 #: endpoints whose work runs async behind a User-Task-ID
 ASYNC_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                    "fix_offline_replicas", "demote_broker",
@@ -68,7 +73,13 @@ class CruiseControlApp:
                  two_step_verification: bool = False,
                  max_active_tasks: int | None = None,
                  completed_task_retention_ms: int | None = None,
-                 purgatory_retention_ms: int | None = None) -> None:
+                 purgatory_retention_ms: int | None = None,
+                 purgatory_max_requests: int | None = None,
+                 reason_required: bool = False,
+                 cors: dict | None = None,
+                 accesslog: bool = False,
+                 ssl_context=None,
+                 parameter_overrides: dict | None = None) -> None:
         # None = use the component's own default (single source of truth
         # in tasks.py / purgatory.py); values are forwarded only when set.
         self.facade = facade
@@ -77,14 +88,34 @@ class CruiseControlApp:
             ("completed_task_retention_ms", completed_task_retention_ms),
         ) if v is not None}
         self.tasks = UserTaskManager(**task_kwargs)
-        purgatory_kwargs = ({"retention_ms": purgatory_retention_ms}
-                            if purgatory_retention_ms is not None else {})
+        purgatory_kwargs = {k: v for k, v in (
+            ("retention_ms", purgatory_retention_ms),
+            ("max_requests", purgatory_max_requests)) if v is not None}
         self.purgatory = (Purgatory(**purgatory_kwargs)
                           if two_step_verification else None)
         self.security = security or AllowAllSecurityProvider()
+        #: POSTs must carry reason= (ref request.reason.required)
+        self.reason_required = reason_required
+        #: CORS header map sent on every response when configured (ref
+        #: webserver.http.cors.*)
+        self.cors = cors or {}
+        self.accesslog = accesslog
+        #: endpoint -> EndpointParameters subclass overriding the built-in
+        #: (ref CruiseControlParametersConfig pluggable parameter classes)
+        self.parameter_overrides = parameter_overrides or {}
         handler = _make_handler(self)
         self.server = ThreadingHTTPServer((host, port), handler)
+        if ssl_context is not None:
+            # ref webserver.ssl.*: TLS termination on the same listener.
+            self.server.socket = ssl_context.wrap_socket(
+                self.server.socket, server_side=True)
         self._thread: threading.Thread | None = None
+
+    def _parse(self, endpoint: str, query: dict) -> "ParsedParams":
+        cls = self.parameter_overrides.get(endpoint)
+        if cls is not None:
+            return cls.parse(endpoint, query)
+        return parse_endpoint_params(endpoint, query)
 
     @property
     def port(self) -> int:
@@ -105,10 +136,23 @@ class CruiseControlApp:
                headers: dict) -> tuple[int, dict, dict]:
         """Returns (status, response_json, extra_headers)."""
         principal = check_access(self.security, endpoint, headers)
+        # Parameter names are case-insensitive (the typed layer lowercases
+        # on parse); normalize once so the raw reads below (reason,
+        # review_id) agree with the parser.
+        params = {k.lower(): v for k, v in params.items()}
         if method == "GET" and endpoint not in GET_ENDPOINTS:
             return 405, {"errorMessage": f"{endpoint} is not a GET endpoint"}, {}
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             return 405, {"errorMessage": f"{endpoint} is not a POST endpoint"}, {}
+
+        # ref request.reason.required: mutating requests must say why
+        # (recorded in the access/audit logs).
+        if (method == "POST" and self.reason_required
+                and endpoint not in NO_REVIEW_REQUIRED
+                and not params.get("reason", [None])[0]):
+            return 400, {"errorMessage":
+                         "a reason parameter is required "
+                         "(request.reason.required=true)"}, {}
 
         # Two-step verification: un-reviewed POSTs park in the purgatory.
         if (method == "POST" and self.purgatory is not None
@@ -117,22 +161,25 @@ class CruiseControlApp:
             if review_id is None:
                 # Validate eagerly: malformed requests must not park in the
                 # purgatory and fail only at approval time.
-                parse_endpoint_params(
-                    endpoint, {k.lower(): v for k, v in params.items()})
+                self._parse(endpoint, params)
                 info = self.purgatory.add(endpoint, {k: v[0] for k, v
                                                      in params.items()},
                                           principal.name)
                 return 202, {"reviewResult": info.to_json()}, {}
-            submitted = self.purgatory.submit(int(review_id))
-            merged = {k: [v] for k, v in submitted.params.items()}
+            # Validate the merged request BEFORE submit(): submit
+            # irreversibly burns the approval, so a typo in the replay
+            # must not consume the reviewed request.
+            pending = self.purgatory.get(int(review_id))
+            merged = {k.lower(): [v] for k, v in pending.params.items()}
             merged.update(params)
+            self._parse(endpoint, merged)
+            self.purgatory.submit(int(review_id))
             params = merged
 
         # Typed parse + validation (ref servlet/parameters/*): unknown
         # parameters, bad types, missing required params and forbidden
         # combinations are a 400 before any work is scheduled.
-        parsed = parse_endpoint_params(
-            endpoint, {k.lower(): v for k, v in params.items()})
+        parsed = self._parse(endpoint, params)
 
         if endpoint in ASYNC_ENDPOINTS:
             return self._handle_async(endpoint, parsed, headers)
@@ -168,18 +215,40 @@ class CruiseControlApp:
             "rightsize") else None
         exec_kwargs = params.execution_kwargs()
 
+        def maybe_stop_ongoing():
+            """ref STOP_ONGOING_EXECUTION_PARAM: preempt the in-flight
+            execution so this request's (non-dryrun) plan replaces it."""
+            if dryrun or not params.get("stop_ongoing_execution"):
+                return
+            if facade.executor.has_ongoing_execution():
+                facade.stop_proposal_execution()
+                import time as _t
+                deadline = _t.monotonic() + 60
+                while (facade.executor.has_ongoing_execution()
+                       and _t.monotonic() < deadline):
+                    _t.sleep(0.05)
+
         def options_from(params: ParsedParams) -> OptimizationOptions:
             pattern = params.get("excluded_topics") or ""
+            no_leadership = set(
+                params.get("exclude_brokers_for_leadership") or ())
+            no_replicas = set(
+                params.get("exclude_brokers_for_replica_move") or ())
+            # ref EXCLUDE_RECENTLY_(DEMOTED|REMOVED)_BROKERS_PARAM: fold the
+            # executor's expiring history into the request's exclusions.
+            if params.get("exclude_recently_demoted_brokers"):
+                no_leadership |= set(
+                    facade.executor.recently_demoted_brokers)
+            if params.get("exclude_recently_removed_brokers"):
+                no_replicas |= set(facade.executor.recently_removed_brokers)
             return OptimizationOptions(
                 excluded_topics=frozenset(
                     t for t in pattern.split(",") if t),
                 fast_mode=params.get("fast_mode", False),
                 skip_hard_goal_check=params.get("skip_hard_goal_check",
                                                 False),
-                excluded_brokers_for_leadership=frozenset(
-                    params.get("exclude_brokers_for_leadership") or ()),
-                excluded_brokers_for_replica_move=frozenset(
-                    params.get("exclude_brokers_for_replica_move") or ()),
+                excluded_brokers_for_leadership=frozenset(no_leadership),
+                excluded_brokers_for_replica_move=frozenset(no_replicas),
                 destination_broker_ids=frozenset(
                     params.get("destination_broker_ids") or ()))
 
@@ -193,6 +262,7 @@ class CruiseControlApp:
                                                   **exec_kwargs)
             else:
                 def run(progress):
+                    maybe_stop_ongoing()
                     res, exec_res = facade.rebalance(
                         goals=goals, dryrun=dryrun,
                         options=options_from(params),
@@ -204,36 +274,55 @@ class CruiseControlApp:
                         res, exec_res, verbose=params.get("verbose", False))
         elif endpoint == "add_broker":
             def run(progress):
+                maybe_stop_ongoing()
+                kwargs = dict(exec_kwargs)
+                if not params.get("throttle_added_broker", True):
+                    kwargs["throttle_excluded_brokers"] = set(
+                        params["brokerid"])
                 res, exec_res = facade.add_brokers(
                     params["brokerid"], dryrun=dryrun, goals=goals,
-                    progress=progress, **exec_kwargs)
+                    progress=progress, options=options_from(params),
+                    **kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "remove_broker":
             def run(progress):
+                maybe_stop_ongoing()
+                kwargs = dict(exec_kwargs)
+                if not params.get("throttle_removed_broker", True):
+                    kwargs["throttle_excluded_brokers"] = set(
+                        params["brokerid"])
                 res, exec_res = facade.remove_brokers(
                     params["brokerid"], dryrun=dryrun, goals=goals,
                     progress=progress,
                     destination_broker_ids=frozenset(
                         params.get("destination_broker_ids") or ()),
-                    **exec_kwargs)
+                    options=options_from(params), **kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "demote_broker":
             def run(progress):
+                maybe_stop_ongoing()
                 res, exec_res = facade.demote_brokers(
                     params["brokerid"], dryrun=dryrun,
-                    progress=progress, **exec_kwargs)
+                    progress=progress, options=options_from(params),
+                    skip_urp_demotion=params.get("skip_urp_demotion", True),
+                    exclude_follower_demotion=params.get(
+                        "exclude_follower_demotion", True),
+                    **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "fix_offline_replicas":
             def run(progress):
+                maybe_stop_ongoing()
                 res, exec_res = facade.fix_offline_replicas(
                     dryrun=dryrun, goals=goals, progress=progress,
-                    **exec_kwargs)
+                    options=options_from(params), **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "topic_configuration":
             def run(progress):
+                maybe_stop_ongoing()
                 res, exec_res = facade.update_topic_configuration(
                     params["topic"], params["replication_factor"],
-                    dryrun=dryrun, progress=progress, **exec_kwargs)
+                    dryrun=dryrun, progress=progress,
+                    options=options_from(params), **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "proposals":
             def run(progress):
@@ -401,16 +490,27 @@ class CruiseControlApp:
                 params["min_isr_based_concurrency_adjustment"]
             out["minIsrBasedConcurrencyAdjustment"] = params[
                 "min_isr_based_concurrency_adjustment"]
+        from ..executor.concurrency import VALID_ADJUSTER_TYPES
+
+        def _adjuster_types(raw: list) -> list[str]:
+            types = [t.strip().lower() for t in raw]
+            bad = [t for t in types if t not in VALID_ADJUSTER_TYPES]
+            if bad:
+                raise ValueError(
+                    f"unknown concurrency type(s) {bad}; valid: "
+                    f"{sorted(VALID_ADJUSTER_TYPES)}")
+            return types
+
         if "disable_concurrency_adjuster_for" in params:
-            for t in params["disable_concurrency_adjuster_for"]:
-                self.facade.executor.adjuster_disabled_types.add(
-                    t.strip().lower())
+            for t in _adjuster_types(
+                    params["disable_concurrency_adjuster_for"]):
+                self.facade.executor.adjuster_disabled_types.add(t)
             out["disabledConcurrencyAdjuster"] = params[
                 "disable_concurrency_adjuster_for"]
         if "enable_concurrency_adjuster_for" in params:
-            for t in params["enable_concurrency_adjuster_for"]:
-                self.facade.executor.adjuster_disabled_types.discard(
-                    t.strip().lower())
+            for t in _adjuster_types(
+                    params["enable_concurrency_adjuster_for"]):
+                self.facade.executor.adjuster_disabled_types.discard(t)
             out["enabledConcurrencyAdjuster"] = params[
                 "enable_concurrency_adjuster_for"]
         detector = self.facade.detector
@@ -503,6 +603,9 @@ def _make_handler(app: CruiseControlApp):
                     for k, v in parse_qs(body).items():
                         params.setdefault(k, v)
             headers = {k.lower(): v for k, v in self.headers.items()}
+            # Socket-derived peer address for source-gated providers
+            # (never trusted from the wire — overwritten here).
+            headers["x-cc-peer-address"] = self.client_address[0]
             try:
                 status, payload, extra = app.handle(method, endpoint, params,
                                                     headers)
@@ -521,15 +624,27 @@ def _make_handler(app: CruiseControlApp):
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
-            for k, v in (extra or {}).items():
+            for k, v in {**app.cors, **(extra or {})}.items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
+            if app.accesslog:
+                _ACCESS_LOG.info("%s %s %s -> %d",
+                                 self.client_address[0], self.command,
+                                 self.path, status)
 
         def do_GET(self):
             self._serve("GET")
 
         def do_POST(self):
             self._serve("POST")
+
+        def do_OPTIONS(self):
+            # CORS preflight (ref webserver.http.cors.*).
+            self.send_response(200 if app.cors else 405)
+            for k, v in app.cors.items():
+                self.send_header(k, v)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
 
     return Handler

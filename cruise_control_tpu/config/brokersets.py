@@ -81,3 +81,21 @@ def topic_set_array(topics: list[str], set_names: list[str],
         if name is not None and name in index:
             out[t_i] = index[name]
     return out
+
+
+class ModuloAssignmentPolicy:
+    """Pluggable form of :func:`modulo_assignment` (ref
+    ModuloBasedBrokerSetAssignmentPolicy — the
+    broker.set.assignment.policy.class default)."""
+
+    def assign(self, broker_id: int, sets: list[str]) -> str:
+        return modulo_assignment(broker_id, sets)
+
+
+class TopicHashAssignmentPolicy:
+    """Pluggable form of :func:`topic_set_by_name_hash` (ref
+    TopicNameHashBrokerSetMappingPolicy — the
+    replica.to.broker.set.mapping.policy.class default)."""
+
+    def map_topic(self, topic: str, sets: list[str]) -> str:
+        return topic_set_by_name_hash(topic, sets)

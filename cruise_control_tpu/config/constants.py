@@ -86,6 +86,63 @@ def _monitor_defs(d: ConfigDef) -> None:
     d.define("follower.cpu.ratio", ConfigType.DOUBLE, 0.5,
              validator=Range.between(0.0, 1.0), importance=Importance.LOW,
              doc="Follower CPU as a fraction of leader CPU")
+    d.define("max.allowed.extrapolations.per.broker", ConfigType.INT, 5,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Extrapolation budget per broker")
+    d.define("min.valid.partition.ratio", ConfigType.DOUBLE, 0.95,
+             validator=Range.between(0.0, 1.0), importance=Importance.HIGH,
+             doc="Monitored-partition ratio required for a valid model")
+    d.define("skip.loading.samples", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Skip sample-store replay at startup")
+    d.define("fetch.metric.samples.max.retry.count", ConfigType.INT, 5,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Retries per sampling round before giving up")
+    d.define("sampling.allow.cpu.capacity.estimation", ConfigType.BOOLEAN,
+             True, importance=Importance.LOW,
+             doc="Estimate missing broker CPU capacity during sampling")
+    d.define("use.linear.regression.model", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Estimate partition CPU via the trained linear regression "
+                 "instead of share-of-bytes attribution")
+    d.define("linear.regression.model.cpu.util.bucket.size", ConfigType.INT,
+             5, validator=Range.between(1, 100), importance=Importance.LOW,
+             doc="CPU-utilization bucket width (%) for regression training")
+    d.define("leader.network.inbound.weight.for.cpu.util", ConfigType.DOUBLE,
+             0.6, importance=Importance.LOW,
+             doc="Leader bytes-in weight in CPU attribution")
+    d.define("leader.network.outbound.weight.for.cpu.util",
+             ConfigType.DOUBLE, 0.1, importance=Importance.LOW,
+             doc="Leader bytes-out weight in CPU attribution")
+    d.define("follower.network.inbound.weight.for.cpu.util",
+             ConfigType.DOUBLE, 0.3, importance=Importance.LOW,
+             doc="Follower bytes-in weight in CPU attribution")
+    d.define("metric.sampler.partition.assignor.class", ConfigType.CLASS,
+             "cruise_control_tpu.monitor.fetcher.DefaultPartitionAssignor",
+             importance=Importance.LOW,
+             doc="Splits the partition universe across fetcher shards")
+    d.define("sample.partition.metric.store.on.execution.class",
+             ConfigType.STRING, "", importance=Importance.LOW,
+             doc="Extra store receiving partition samples during an "
+                 "ongoing execution (empty = disabled)")
+    d.define("broker.set.resolver.class", ConfigType.CLASS,
+             "cruise_control_tpu.config.brokersets.FileBrokerSetResolver",
+             importance=Importance.LOW, doc="BrokerSetResolver plugin")
+    d.define("broker.set.assignment.policy.class", ConfigType.CLASS,
+             "cruise_control_tpu.config.brokersets.ModuloAssignmentPolicy",
+             importance=Importance.LOW,
+             doc="Policy assigning unmapped brokers to broker sets")
+    d.define("replica.to.broker.set.mapping.policy.class", ConfigType.CLASS,
+             "cruise_control_tpu.config.brokersets.TopicHashAssignmentPolicy",
+             importance=Importance.LOW,
+             doc="Policy mapping replicas to broker sets")
+    d.define("topic.config.provider.class", ConfigType.CLASS,
+             "cruise_control_tpu.config.topics.AdminTopicConfigProvider",
+             importance=Importance.LOW, doc="TopicConfigProvider plugin")
+    d.define("network.client.provider.class", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Network client factory for samplers needing raw Kafka "
+                 "connections (unused by the built-in samplers)")
 
 
 def _analyzer_defs(d: ConfigDef) -> None:
@@ -159,6 +216,50 @@ def _analyzer_defs(d: ConfigDef) -> None:
     d.define("search.max.iters.per.goal", ConfigType.INT, 256,
              validator=Range.at_least(1), importance=Importance.LOW,
              doc="Iteration cap per goal pass")
+    d.define("goals", ConfigType.LIST, "", importance=Importance.HIGH,
+             doc="Full supported goal list (reference key; default.goals "
+                 "is the active chain — empty inherits the built-in order)")
+    d.define("intra.broker.goals", ConfigType.LIST, "",
+             importance=Importance.MEDIUM,
+             doc="Goal chain for rebalance_disk / remove_disks (empty = "
+                 "built-in intra-broker pair)")
+    d.define("anomaly.detection.goals", ConfigType.LIST, "",
+             importance=Importance.MEDIUM,
+             doc="Goals the goal-violation detector checks (empty = "
+                 "default chain)")
+    d.define("goal.balancedness.priority.weight", ConfigType.DOUBLE, 1.1,
+             validator=Range.at_least(1.0), importance=Importance.LOW,
+             doc="Balancedness score: weight ratio between consecutive "
+                 "goal priorities")
+    d.define("goal.balancedness.strictness.weight", ConfigType.DOUBLE, 1.5,
+             validator=Range.at_least(1.0), importance=Importance.LOW,
+             doc="Balancedness score: hard-goal weight multiplier")
+    d.define("goal.violation.distribution.threshold.multiplier",
+             ConfigType.DOUBLE, 1.0, validator=Range.at_least(1.0),
+             importance=Importance.LOW,
+             doc="Relaxes distribution-goal thresholds during violation "
+                 "detection")
+    d.define("allow.capacity.estimation.on.proposal.precompute",
+             ConfigType.BOOLEAN, True, importance=Importance.LOW,
+             doc="Let the precompute loop estimate missing capacities")
+    d.define("metadata.factor.exponent", ConfigType.DOUBLE, 1.0,
+             validator=Range.at_least(1.0), importance=Importance.LOW,
+             doc="Exponent scaling cluster-metadata cost in provision "
+                 "recommendations")
+    d.define("overprovisioned.max.replicas.per.broker", ConfigType.LONG,
+             1500, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Over-provisioning requires brokers under this replica "
+                 "count")
+    d.define("overprovisioned.min.extra.racks", ConfigType.INT, 2,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Extra racks beyond max RF required before shrinking")
+    d.define("rack.aware.goal.rack.id.mapper.class", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Maps raw rack ids before rack-aware goals (empty = "
+                 "identity)")
+    d.define("fast.mode.per.broker.move.timeout.ms", ConfigType.LONG, 500,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="fast_mode per-broker optimization budget")
 
 
 def _executor_defs(d: ConfigDef) -> None:
@@ -189,6 +290,93 @@ def _executor_defs(d: ConfigDef) -> None:
              importance=Importance.LOW, doc="AIMD concurrency adjuster")
     d.define("default.replica.movement.strategies", ConfigType.LIST, "",
              importance=Importance.MEDIUM, doc="Movement strategy chain")
+    d.define("replica.movement.strategies", ConfigType.LIST, "",
+             importance=Importance.LOW,
+             doc="Available strategy classes (reference key; the built-in "
+                 "registry serves when empty)")
+    d.define("num.concurrent.leader.movements.per.broker", ConfigType.INT,
+             1000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Per-broker leadership movement cap")
+    d.define("max.num.cluster.movements", ConfigType.INT, 1250,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Cluster-wide cap across movement types (alias surface "
+                 "of max.num.cluster.partition.movements)")
+    d.define("min.execution.progress.check.interval.ms", ConfigType.LONG,
+             5_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Floor for per-request progress-check intervals")
+    d.define("concurrency.adjuster.interval.ms", ConfigType.LONG, 1_800_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="How often the adjuster re-evaluates caps")
+    d.define("concurrency.adjuster.inter.broker.replica.enabled",
+             ConfigType.BOOLEAN, True, importance=Importance.LOW,
+             doc="Adjust inter-broker replica movement concurrency")
+    d.define("concurrency.adjuster.leadership.enabled", ConfigType.BOOLEAN,
+             True, importance=Importance.LOW,
+             doc="Adjust leadership movement concurrency")
+    d.define("concurrency.adjuster.limit.request.queue.size",
+             ConfigType.DOUBLE, 1000.0, importance=Importance.LOW,
+             doc="Request-queue size above which a broker reads stressed")
+    d.define("concurrency.adjuster.limit.log.flush.time.ms",
+             ConfigType.DOUBLE, 1000.0, importance=Importance.LOW,
+             doc="Log-flush time above which a broker reads stressed")
+    d.define("concurrency.adjuster.limit.produce.local.time.ms",
+             ConfigType.DOUBLE, 1000.0, importance=Importance.LOW,
+             doc="Produce local time above which a broker reads stressed")
+    d.define("concurrency.adjuster.min.leadership.movements",
+             ConfigType.INT, 100, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="Adjuster floor for cluster leadership concurrency")
+    d.define("concurrency.adjuster.max.leadership.movements",
+             ConfigType.INT, 1000, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="Adjuster ceiling for cluster leadership concurrency")
+    d.define("concurrency.adjuster.min.isr.check.enabled",
+             ConfigType.BOOLEAN, True, importance=Importance.LOW,
+             doc="Brake concurrency on (at/under) min-ISR partitions")
+    d.define("concurrency.adjuster.num.min.isr.check", ConfigType.INT, 100,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Partitions sampled per min-ISR check round")
+    d.define("concurrency.adjuster.min.isr.cache.size", ConfigType.INT,
+             5_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Cached topic min.insync.replicas entries")
+    d.define("concurrency.adjuster.min.isr.retention.ms", ConfigType.LONG,
+             43_200_000, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="Min-ISR cache entry retention")
+    d.define("admin.client.request.timeout.ms", ConfigType.LONG, 30_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Admin request timeout (reassignments, elections)")
+    d.define("list.partition.reassignment.timeout.ms", ConfigType.LONG,
+             60_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="listPartitionReassignments timeout")
+    d.define("list.partition.reassignment.max.attempts", ConfigType.INT, 3,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="listPartitionReassignments retries (backoff doubles)")
+    d.define("logdir.response.timeout.ms", ConfigType.LONG, 10_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="describeLogDirs timeout")
+    d.define("demotion.history.retention.time.ms", ConfigType.LONG,
+             86_400_000, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="How long demoted brokers stay excluded as recently "
+                 "demoted")
+    d.define("removal.history.retention.time.ms", ConfigType.LONG,
+             86_400_000, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="How long removed brokers stay excluded as recently "
+                 "removed")
+    d.define("executor.notifier.class", ConfigType.CLASS,
+             "cruise_control_tpu.executor.executor.ExecutorNotifier",
+             importance=Importance.LOW, doc="ExecutorNotifier plugin")
+    d.define("task.execution.alerting.threshold.ms", ConfigType.LONG,
+             90_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Tasks in-flight longer than this are logged as slow")
+    d.define("slow.task.alerting.backoff.ms", ConfigType.LONG, 60_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Backoff between slow-task alerts")
+    d.define("auto.stop.external.agent", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Cancel externally-started reassignments before executing")
 
 
 def _detector_defs(d: ConfigDef) -> None:
@@ -253,6 +441,92 @@ def _detector_defs(d: ConfigDef) -> None:
              importance=Importance.LOW, doc="Alerta API key")
     d.define("alerta.environment", ConfigType.STRING, "production",
              importance=Importance.LOW, doc="Alerta environment tag")
+    d.define("metric.anomaly.detection.interval.ms", ConfigType.LONG,
+             300_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Metric-anomaly detector interval")
+    d.define("topic.anomaly.detection.interval.ms", ConfigType.LONG,
+             300_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Topic-anomaly detector interval")
+    d.define("disk.failure.detection.interval.ms", ConfigType.LONG,
+             300_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Disk-failure detector interval")
+    d.define("broker.failure.detection.backoff.ms", ConfigType.LONG,
+             300_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Backoff after a failed broker-failure detection round")
+    d.define("kafka.broker.failure.detection.enable", ConfigType.BOOLEAN,
+             False, importance=Importance.LOW,
+             doc="Use metadata-polling broker failure detection (the "
+                 "built-in detector here; the reference's ZK watcher is "
+                 "the alternative)")
+    d.define("fixable.failed.broker.count.threshold", ConfigType.INT, 10,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="More simultaneous broker failures than this are not "
+                 "auto-fixed")
+    d.define("fixable.failed.broker.percentage.threshold",
+             ConfigType.DOUBLE, 0.4, validator=Range.between(0.0, 1.0),
+             importance=Importance.LOW,
+             doc="Failure ratio above which self-healing refuses to act")
+    d.define("num.cached.recent.anomaly.states", ConfigType.INT, 10,
+             validator=Range.between(1, 100), importance=Importance.LOW,
+             doc="Recent anomalies kept per type for /state")
+    d.define("self.healing.exclude.recently.demoted.brokers",
+             ConfigType.BOOLEAN, True, importance=Importance.LOW,
+             doc="Self-healing avoids recently demoted brokers")
+    d.define("self.healing.exclude.recently.removed.brokers",
+             ConfigType.BOOLEAN, True, importance=Importance.LOW,
+             doc="Self-healing avoids recently removed brokers")
+    d.define("anomaly.detection.allow.capacity.estimation",
+             ConfigType.BOOLEAN, True, importance=Importance.LOW,
+             doc="Let detectors estimate missing broker capacities")
+    d.define("replication.factor.self.healing.skip.rack.awareness.check",
+             ConfigType.BOOLEAN, False, importance=Importance.LOW,
+             doc="Skip rack-awareness sanity during RF self-healing")
+    d.define("broker.failures.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.anomalies.BrokerFailures",
+             importance=Importance.LOW, doc="BrokerFailures anomaly class")
+    d.define("goal.violations.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.anomalies.GoalViolations",
+             importance=Importance.LOW, doc="GoalViolations anomaly class")
+    d.define("disk.failures.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.anomalies.DiskFailures",
+             importance=Importance.LOW, doc="DiskFailures anomaly class")
+    d.define("metric.anomaly.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.anomalies.KafkaMetricAnomaly",
+             importance=Importance.LOW, doc="Metric anomaly class")
+    d.define("metric.anomaly.finder.class", ConfigType.CLASS,
+             "cruise_control_tpu.core.anomaly.PercentileMetricAnomalyFinder",
+             importance=Importance.LOW, doc="MetricAnomalyFinder plugin")
+    d.define("topic.anomaly.finder.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.detectors.TopicAnomalyDetector",
+             importance=Importance.LOW, doc="TopicAnomalyFinder plugin")
+    d.define("maintenance.event.class", ConfigType.CLASS,
+             "cruise_control_tpu.detector.anomalies.MaintenanceEvent",
+             importance=Importance.LOW, doc="MaintenanceEvent class")
+    d.define("maintenance.event.reader.class", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="MaintenanceEventReader plugin (empty = disabled)")
+    d.define("maintenance.event.enable.idempotence", ConfigType.BOOLEAN,
+             True, importance=Importance.LOW,
+             doc="De-duplicate equivalent maintenance events")
+    d.define("maintenance.event.idempotence.retention.ms", ConfigType.LONG,
+             180_000, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="How long an event blocks duplicates")
+    d.define("maintenance.event.max.idempotence.cache.size", ConfigType.INT,
+             25, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Idempotence cache capacity")
+    d.define("maintenance.event.stop.ongoing.execution", ConfigType.BOOLEAN,
+             False, importance=Importance.LOW,
+             doc="Maintenance events stop an in-flight execution")
+    d.define("provisioner.enable", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Act on provision recommendations via the provisioner")
+    d.define("failed.brokers.zk.path", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="ZooKeeper path for failure times (unused — this build "
+                 "persists to failed.brokers.file.path; no ZK in scope)")
+    d.define("zookeeper.security.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="ZK ACL mode (unused — no ZK in scope)")
 
 
 def _webserver_defs(d: ConfigDef) -> None:
@@ -299,6 +573,125 @@ def _webserver_defs(d: ConfigDef) -> None:
     d.define("completed.user.task.retention.time.ms", ConfigType.LONG,
              86_400_000, importance=Importance.LOW,
              doc="How long finished tasks stay pollable")
+    d.define("max.cached.completed.user.tasks", ConfigType.INT, 100,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Completed tasks retained for polling")
+    d.define("max.cached.completed.kafka.monitor.user.tasks",
+             ConfigType.INT, 20, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="Completed monitor-scope tasks retained")
+    d.define("max.cached.completed.kafka.admin.user.tasks", ConfigType.INT,
+             30, validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Completed admin-scope tasks retained")
+    d.define("two.step.purgatory.max.requests", ConfigType.INT, 25,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Pending un-reviewed request cap")
+    d.define("request.reason.required", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="POSTs must carry a reason parameter")
+    d.define("webserver.api.urlprefix", ConfigType.STRING,
+             "/kafkacruisecontrol/*", importance=Importance.LOW,
+             doc="API URL prefix")
+    d.define("webserver.ui.urlprefix", ConfigType.STRING, "/*",
+             importance=Importance.LOW, doc="UI URL prefix")
+    d.define("webserver.ui.diskpath", ConfigType.STRING, "./cruise-control-ui/",
+             importance=Importance.LOW,
+             doc="UI asset path (the built-in API explorer serves when "
+                 "absent)")
+    d.define("webserver.session.path", ConfigType.STRING, "/",
+             importance=Importance.LOW, doc="Session cookie path")
+    d.define("webserver.accesslog.enabled", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW, doc="Per-request access logging")
+    d.define("webserver.http.cors.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW, doc="Send CORS headers")
+    d.define("webserver.http.cors.origin", ConfigType.STRING, "*",
+             importance=Importance.LOW, doc="Access-Control-Allow-Origin")
+    d.define("webserver.http.cors.allowmethods", ConfigType.STRING,
+             "OPTIONS, GET, POST", importance=Importance.LOW,
+             doc="Access-Control-Allow-Methods")
+    d.define("webserver.http.cors.exposeheaders", ConfigType.STRING,
+             "User-Task-ID", importance=Importance.LOW,
+             doc="Access-Control-Expose-Headers")
+    d.define("webserver.ssl.enable", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM, doc="Serve HTTPS")
+    d.define("webserver.ssl.keystore.location", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="PEM file with certificate (+ key when no separate key "
+                 "password store is used)")
+    d.define("webserver.ssl.keystore.password", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="Keystore password")
+    d.define("webserver.ssl.key.password", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="Private-key password")
+    d.define("webserver.ssl.keystore.type", ConfigType.STRING, "PEM",
+             importance=Importance.LOW,
+             doc="Keystore format (PEM here; the reference uses JKS)")
+    d.define("webserver.ssl.protocol", ConfigType.STRING, "TLS",
+             importance=Importance.LOW, doc="TLS protocol")
+    d.define("webserver.ssl.include.ciphers", ConfigType.LIST, "",
+             importance=Importance.LOW, doc="Cipher allowlist")
+    d.define("webserver.ssl.exclude.ciphers", ConfigType.LIST, "",
+             importance=Importance.LOW, doc="Cipher blocklist")
+    d.define("webserver.ssl.include.protocols", ConfigType.LIST, "",
+             importance=Importance.LOW, doc="Protocol allowlist")
+    d.define("webserver.ssl.exclude.protocols", ConfigType.LIST, "",
+             importance=Importance.LOW, doc="Protocol blocklist")
+    d.define("vertx.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Second web engine toggle (single stdlib server here; "
+                 "kept for config parity)")
+    d.define("jwt.authentication.provider.url", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="SSO login redirect URL (RS256 SSO flow; the HS256 "
+                 "shared-secret variant needs none)")
+    d.define("jwt.auth.certificate.location", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="RS256 public-key certificate (unused by the HS256 "
+                 "variant)")
+    d.define("jwt.cookie.name", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Cookie carrying the JWT (besides the Bearer header)")
+    d.define("jwt.expected.audiences", ConfigType.LIST, "",
+             importance=Importance.LOW,
+             doc="Accepted aud claim values (empty = any)")
+    d.define("spnego.keytab.file", ConfigType.STRING, "",
+             importance=Importance.LOW, doc="Keytab for the spnego provider")
+    d.define("trusted.proxy.services.ip.regex", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Regex of proxy source addresses allowed to forward")
+    d.define("trusted.proxy.spnego.fallback.enabled", ConfigType.BOOLEAN,
+             False, importance=Importance.LOW,
+             doc="Fall back to SPNEGO when the proxy header is absent")
+
+
+#: endpoints with per-endpoint parameter/request plugin keys (ref
+#: CruiseControlParametersConfig.java + CruiseControlRequestConfig.java —
+#: every endpoint's Parameters and Request classes are pluggable).
+_PLUGGABLE_ENDPOINTS = (
+    "state", "load", "partition.load", "proposals", "kafka.cluster.state",
+    "user.tasks", "bootstrap", "train", "review.board", "permissions",
+    "rebalance", "add.broker", "remove.broker", "demote.broker",
+    "fix.offline.replicas", "topic.configuration", "remove.disks",
+    "rightsize", "admin", "review", "stop.proposal", "pause.sampling",
+    "resume.sampling")
+
+
+def _pluggable_defs(d: ConfigDef) -> None:
+    """ref config/constants/CruiseControlParametersConfig.java /
+    CruiseControlRequestConfig.java: one <endpoint>.parameters.class and
+    <endpoint>.request.class per endpoint. The parameters classes are
+    honored by the HTTP layer (see api/server.py resolving overrides);
+    request classes name the handler and exist for config parity."""
+    for ep in _PLUGGABLE_ENDPOINTS:
+        under = ep.replace(".", "_")
+        d.define(f"{ep}.parameters.class", ConfigType.STRING,
+                 f"cruise_control_tpu.api.parameters:{under}",
+                 importance=Importance.LOW,
+                 doc=f"Parameters class for {under} (module:endpoint or "
+                     "a dotted class path)")
+        d.define(f"{ep}.request.class", ConfigType.STRING,
+                 f"cruise_control_tpu.api.server:{under}",
+                 importance=Importance.LOW,
+                 doc=f"Request handler id for {under} (informational)")
 
 
 def cruise_control_config_def() -> ConfigDef:
@@ -308,6 +701,7 @@ def cruise_control_config_def() -> ConfigDef:
     _executor_defs(d)
     _detector_defs(d)
     _webserver_defs(d)
+    _pluggable_defs(d)
     return d
 
 
@@ -333,6 +727,8 @@ class CruiseControlConfig(AbstractConfig):
                 "min.samples.per.broker.metrics.window"),
             max_allowed_extrapolations_per_partition=self.get_int(
                 "max.allowed.extrapolations.per.partition"),
+            max_allowed_extrapolations_per_broker=self.get_int(
+                "max.allowed.extrapolations.per.broker"),
             follower_cpu_ratio=self.get_double("follower.cpu.ratio"))
 
     def balancing_constraint(self) -> BalancingConstraint:
@@ -383,6 +779,8 @@ class CruiseControlConfig(AbstractConfig):
         return ExecutorConfig(
             progress_check_interval_ms=self.get_int(
                 "execution.progress.check.interval.ms"),
+            min_progress_check_interval_ms=self.get_int(
+                "min.execution.progress.check.interval.ms"),
             replica_movement_timeout_ms=self.get_int(
                 "replica.movement.timeout.ms"),
             leadership_movement_timeout_ms=self.get_int(
@@ -396,7 +794,35 @@ class CruiseControlConfig(AbstractConfig):
                     "num.concurrent.intra.broker.partition.movements"),
                 num_concurrent_leader_movements=self.get_int(
                     "num.concurrent.leader.movements"),
+                num_concurrent_leader_movements_per_broker=self.get_int(
+                    "num.concurrent.leader.movements.per.broker"),
                 max_num_cluster_partition_movements=self.get_int(
-                    "max.num.cluster.partition.movements")),
+                    "max.num.cluster.partition.movements"),
+                min_leader_movements=self.get_int(
+                    "concurrency.adjuster.min.leadership.movements"),
+                max_leader_movements=self.get_int(
+                    "concurrency.adjuster.max.leadership.movements"),
+                limit_request_queue_size=self.get_double(
+                    "concurrency.adjuster.limit.request.queue.size"),
+                limit_log_flush_time_ms=self.get_double(
+                    "concurrency.adjuster.limit.log.flush.time.ms"),
+                limit_produce_local_time_ms=self.get_double(
+                    "concurrency.adjuster.limit.produce.local.time.ms")),
             concurrency_adjuster_enabled=self.get_boolean(
-                "concurrency.adjuster.enabled"))
+                "concurrency.adjuster.enabled"),
+            concurrency_adjuster_interval_ms=self.get_int(
+                "concurrency.adjuster.interval.ms"),
+            adjuster_inter_broker_enabled=self.get_boolean(
+                "concurrency.adjuster.inter.broker.replica.enabled"),
+            adjuster_leadership_enabled=self.get_boolean(
+                "concurrency.adjuster.leadership.enabled"),
+            removal_history_retention_ms=self.get_int(
+                "removal.history.retention.time.ms"),
+            demotion_history_retention_ms=self.get_int(
+                "demotion.history.retention.time.ms"),
+            slow_task_alerting_threshold_ms=self.get_int(
+                "task.execution.alerting.threshold.ms"),
+            slow_task_alerting_backoff_ms=self.get_int(
+                "slow.task.alerting.backoff.ms"),
+            default_strategy_names=tuple(self.get_list(
+                "default.replica.movement.strategies")))

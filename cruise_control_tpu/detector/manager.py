@@ -36,9 +36,20 @@ class DetectorSchedule:
 class AnomalyDetectorManager:
     def __init__(self, facade, notifier: AnomalyNotifier | None = None,
                  provisioner: Provisioner | None = None,
-                 now_ms=None, registry=None) -> None:
+                 now_ms=None, registry=None,
+                 fixable_broker_count_threshold: int = 10,
+                 fixable_broker_pct_threshold: float = 0.4,
+                 num_cached_recent_anomalies: int = 10) -> None:
         from ..core.sensors import (ANOMALY_DETECTOR_SENSOR, MetricRegistry)
         self.facade = facade
+        #: self-healing refuses to act past these simultaneous-failure
+        #: bounds (ref fixable.failed.broker.count/percentage.threshold —
+        #: mass failures need a human, not an automatic drain)
+        self.fixable_broker_count_threshold = fixable_broker_count_threshold
+        self.fixable_broker_pct_threshold = fixable_broker_pct_threshold
+        #: recent anomalies kept per type for /state (ref
+        #: num.cached.recent.anomaly.states)
+        self.num_cached_recent_anomalies = num_cached_recent_anomalies
         self.notifier = notifier or SelfHealingNotifier()
         self.provisioner = provisioner or BasicProvisioner(facade.admin)
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
@@ -78,6 +89,19 @@ class AnomalyDetectorManager:
             for t in KafkaAnomalyType}
         self._time_to_start_fix = self.registry.timer(
             _n(ANOMALY_DETECTOR_SENSOR, "time-to-start-fix"))
+
+    def _fixable(self, anomaly) -> bool:
+        """Broker-failure anomalies stop being auto-fixable past the
+        simultaneous-failure thresholds; all other anomaly types are
+        unaffected (ref AnomalyDetectorUtils / SelfHealingNotifier
+        hasFixableBrokerFailures)."""
+        failed = getattr(anomaly, "failed_brokers", None)
+        if not failed:
+            return True
+        if len(failed) > self.fixable_broker_count_threshold:
+            return False
+        total = max(len(self.facade.admin.describe_cluster()), 1)
+        return len(failed) / total <= self.fixable_broker_pct_threshold
 
     def _balancedness(self):
         for sched in self._schedules:
@@ -137,7 +161,7 @@ class AnomalyDetectorManager:
             self._anomaly_meters[anomaly.anomaly_type].mark()
             history = self.recent_anomalies[anomaly.anomaly_type]
             history.append(anomaly.to_json())
-            del history[:-10]
+            del history[:-self.num_cached_recent_anomalies]
 
     def _handle_queue(self, now: int) -> dict:
         fixed, rechecks, ignored = 0, 0, 0
@@ -158,6 +182,13 @@ class AnomalyDetectorManager:
                 ignored += 1   # condition recovered while deferred
                 continue
             action = self.notifier.on_anomaly(anomaly, now)
+            if (action.result is AnomalyNotificationResult.FIX
+                    and not self._fixable(anomaly)):
+                # Mass failure: refuse the automatic drain (ref
+                # fixable.failed.broker.*.threshold — reassigning most of a
+                # cluster away is worse than waiting for a human).
+                ignored += 1
+                continue
             if action.result is AnomalyNotificationResult.FIX:
                 if self.facade.executor.has_ongoing_execution():
                     # ref :534 fixAnomalyInProgress: wait for the executor

@@ -47,6 +47,7 @@ class ConcurrencyConfig:
     # concurrency.adjuster.* configs: request-queue size, log-flush time...).
     limit_request_queue_size: float = 1000.0
     limit_log_flush_time_ms: float = 1000.0
+    limit_produce_local_time_ms: float = 1000.0
 
 
 class ExecutionConcurrencyManager:
@@ -115,6 +116,11 @@ class ExecutionConcurrencyManager:
             }
 
 
+#: adjuster-controllable concurrency types (ref
+#: (DISABLE|ENABLE)_CONCURRENCY_ADJUSTER_FOR_PARAM value set)
+VALID_ADJUSTER_TYPES = frozenset({"inter_broker_replica", "leadership"})
+
+
 class ConcurrencyAdjuster:
     """Auto-scales movement concurrency from broker health metrics (ref
     ``Executor.ConcurrencyAdjuster`` ``Executor.java:493-644``).
@@ -138,10 +144,10 @@ class ConcurrencyAdjuster:
 
     def set_enabled_for(self, concurrency_type: str, enabled: bool) -> None:
         key = concurrency_type.strip().lower()
-        if key not in ("inter_broker_replica", "leadership"):
+        if key not in VALID_ADJUSTER_TYPES:
             raise ValueError(
                 f"unknown concurrency type {concurrency_type!r} "
-                "(want inter_broker_replica or leadership)")
+                f"(want one of {sorted(VALID_ADJUSTER_TYPES)})")
         (self.disabled_types.discard if enabled
          else self.disabled_types.add)(key)
 
@@ -158,7 +164,9 @@ class ConcurrencyAdjuster:
                     or metrics.get("request_queue_size", 0.0)
                     > cfg.limit_request_queue_size
                     or metrics.get("log_flush_time_ms", 0.0)
-                    > cfg.limit_log_flush_time_ms)
+                    > cfg.limit_log_flush_time_ms
+                    or metrics.get("produce_local_time_ms", 0.0)
+                    > cfg.limit_produce_local_time_ms)
                 cap = max(cfg.min_partition_movements_per_broker, cap // 2) \
                     if stressed else cap + 1
                 self.manager.set_inter_broker_cap(broker_id, cap)

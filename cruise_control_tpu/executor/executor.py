@@ -67,12 +67,34 @@ class ExecutorConfig:
     """Subset of ExecutorConfig constants (ref config/constants/ExecutorConfig)."""
 
     progress_check_interval_ms: int = 10_000
+    #: floor for per-request progress-check overrides (ref
+    #: min.execution.progress.check.interval.ms)
+    min_progress_check_interval_ms: int = 5_000
     #: per-task stall bound before it is declared DEAD
     replica_movement_timeout_ms: int = 3_600_000
     leadership_movement_timeout_ms: int = 180_000
     default_replication_throttle_bytes: int | None = None
     concurrency: ConcurrencyConfig = field(default_factory=ConcurrencyConfig)
     concurrency_adjuster_enabled: bool = True
+    #: how often the adjuster re-evaluates caps (ref
+    #: concurrency.adjuster.interval.ms); progress polls in between skip
+    #: the refresh
+    concurrency_adjuster_interval_ms: int = 1_800_000
+    #: adjuster per-type enables (ref concurrency.adjuster.
+    #: inter.broker.replica.enabled / leadership.enabled)
+    adjuster_inter_broker_enabled: bool = True
+    adjuster_leadership_enabled: bool = True
+    #: recently removed/demoted broker exclusion windows (ref
+    #: removal/demotion.history.retention.time.ms)
+    removal_history_retention_ms: int = 86_400_000
+    demotion_history_retention_ms: int = 86_400_000
+    #: in-flight tasks older than this are logged as slow (ref
+    #: task.execution.alerting.threshold.ms), at most once per backoff
+    slow_task_alerting_threshold_ms: int = 90_000
+    slow_task_alerting_backoff_ms: int = 60_000
+    #: strategy chain applied when a request names none (ref
+    #: default.replica.movement.strategies)
+    default_strategy_names: tuple = ()
 
 
 @dataclass
@@ -91,6 +113,47 @@ class ExecutionResult:
 
 class OngoingExecutionError(RuntimeError):
     """ref OngoingExecutionException."""
+
+
+class RecentBrokers:
+    """Set of broker ids with per-entry timestamps and a retention window
+    (ref Executor.java:426-434 recently removed/demoted broker history +
+    removal/demotion.history.retention.time.ms expiry). Set-like enough
+    for the existing call sites: ``|=``, ``in``, iteration, ``clear``."""
+
+    def __init__(self, retention_ms: int, now_ms) -> None:
+        self._stamps: dict[int, int] = {}
+        self.retention_ms = retention_ms
+        self._now_ms = now_ms
+
+    def _prune(self) -> None:
+        cutoff = self._now_ms() - self.retention_ms
+        for b in [b for b, t in self._stamps.items() if t < cutoff]:
+            del self._stamps[b]
+
+    def __ior__(self, brokers) -> "RecentBrokers":
+        now = self._now_ms()
+        for b in brokers:
+            self._stamps[b] = now
+        return self
+
+    def __contains__(self, broker: int) -> bool:
+        self._prune()
+        return broker in self._stamps
+
+    def __iter__(self):
+        self._prune()
+        return iter(sorted(self._stamps))
+
+    def __len__(self) -> int:
+        self._prune()
+        return len(self._stamps)
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def clear(self) -> None:
+        self._stamps.clear()
 
 
 #: Audit trail of execution lifecycle events (ref the reference's
@@ -123,10 +186,15 @@ class Executor:
         self._stop_requested = threading.Event()
         self._task_manager: ExecutionTaskManager | None = None
         self._progress_interval_ms = self.config.progress_check_interval_ms
+        self._last_adjust_ms = 0
+        self._last_slow_alert_ms = 0
         self._current_uuid: str | None = None
-        #: brokers removed/demoted by recent executions (ref Executor.java:426-434)
-        self.recently_removed_brokers: set[int] = set()
-        self.recently_demoted_brokers: set[int] = set()
+        #: brokers removed/demoted by recent executions (ref
+        #: Executor.java:426-434), expiring per the history retention
+        self.recently_removed_brokers = RecentBrokers(
+            self.config.removal_history_retention_ms, self._now_ms)
+        self.recently_demoted_brokers = RecentBrokers(
+            self.config.demotion_history_retention_ms, self._now_ms)
         #: adjuster types disabled at runtime via /admin (seeded into each
         #: execution's ConcurrencyAdjuster; ref
         #: DISABLE_CONCURRENCY_ADJUSTER_FOR_PARAM)
@@ -205,6 +273,7 @@ class Executor:
                           demoted_brokers: set[int] | None = None,
                           concurrency_overrides: dict | None = None,
                           progress_check_interval_ms: int | None = None,
+                          throttle_excluded_brokers: set[int] | None = None,
                           ) -> ExecutionResult:
         """Apply proposals to the cluster; blocks until done/stopped (ref
         ``executeProposals`` ``Executor.java:810`` + ProposalExecutionRunnable).
@@ -239,24 +308,37 @@ class Executor:
             tasks = tm.add_execution_proposals(proposals)
             if intra_broker_moves:
                 tm.add_intra_broker_tasks(intra_broker_moves)
-            planner = ExecutionTaskPlanner(strategy_chain(strategy_names))
+            planner = ExecutionTaskPlanner(strategy_chain(
+                strategy_names
+                if strategy_names is not None
+                else list(self.config.default_strategy_names) or None))
             cc = self.config.concurrency
             if concurrency_overrides:
                 from dataclasses import replace as _dc_replace
                 cc = _dc_replace(cc, **concurrency_overrides)
-            self._progress_interval_ms = (
+            # Per-request interval floor-clamped (ref
+            # min.execution.progress.check.interval.ms).
+            self._progress_interval_ms = max(
                 progress_check_interval_ms
                 if progress_check_interval_ms is not None
-                else self.config.progress_check_interval_ms)
+                else self.config.progress_check_interval_ms,
+                self.config.min_progress_check_interval_ms)
             concurrency = ExecutionConcurrencyManager(
                 cc, list(self.admin.describe_cluster()))
             adjuster = (ConcurrencyAdjuster(concurrency)
                         if self.config.concurrency_adjuster_enabled else None)
             if adjuster is not None:
                 adjuster.disabled_types |= self.adjuster_disabled_types
+                if not self.config.adjuster_inter_broker_enabled:
+                    adjuster.disabled_types.add("inter_broker_replica")
+                if not self.config.adjuster_leadership_enabled:
+                    adjuster.disabled_types.add("leadership")
+            self._last_adjust_ms = self._now_ms()
+            self._last_slow_alert_ms = 0
             inter = [t for t in tasks
                      if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
-            throttler.set_throttles(inter)
+            throttler.set_throttles(
+                inter, excluded_brokers=throttle_excluded_brokers)
             self.notifier.on_execution_started(uuid)
             OPERATION_LOG.info(
                 "Execution %s started: %d inter-broker, %d intra-broker, "
@@ -344,7 +426,12 @@ class Executor:
                 break
             self._sleep_ms(self._progress_interval_ms)
             self._poll_inter_broker_progress()
-            if adjuster is not None:
+            self._maybe_alert_slow_tasks()
+            now = self._now_ms()
+            if (adjuster is not None
+                    and now - self._last_adjust_ms
+                    >= self.config.concurrency_adjuster_interval_ms):
+                self._last_adjust_ms = now
                 alive = self.admin.describe_cluster()
                 metrics = {b: self.admin.broker_metrics(b)
                            for b, up in alive.items() if up}
@@ -364,6 +451,28 @@ class Executor:
             if t.proposal.has_leader_action]
         if needs_election and not self._stop_requested.is_set():
             self.admin.elect_preferred_leaders(needs_election)
+
+    def _maybe_alert_slow_tasks(self) -> None:
+        """Log tasks in flight past the alerting threshold, at most once
+        per backoff window (ref Executor.java slow-task alerting via
+        task.execution.alerting.threshold.ms /
+        slow.task.alerting.backoff.ms)."""
+        now = self._now_ms()
+        if now - self._last_slow_alert_ms \
+                < self.config.slow_task_alerting_backoff_ms:
+            return
+        tm = self._task_manager
+        slow = [t for tt in TaskType
+                for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
+                if t.start_time_ms is not None
+                and now - t.start_time_ms
+                > self.config.slow_task_alerting_threshold_ms]
+        if slow:
+            self._last_slow_alert_ms = now
+            OPERATION_LOG.warning(
+                "Slow tasks (> %d ms in flight): %s",
+                self.config.slow_task_alerting_threshold_ms,
+                [t.topic_partition for t in slow[:20]])
 
     def _poll_inter_broker_progress(self) -> None:
         tm = self._task_manager

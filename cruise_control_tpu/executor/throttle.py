@@ -25,9 +25,14 @@ class ReplicationThrottleHelper:
         #: topic -> key -> replica entries ("partition:broker") we added
         self._touched_topics: dict[str, dict[str, set[str]]] = {}
 
-    def set_throttles(self, tasks: list[ExecutionTask]) -> None:
+    def set_throttles(self, tasks: list[ExecutionTask],
+                      excluded_brokers: set[int] | None = None) -> None:
+        """``excluded_brokers`` never receive throttle configs or replica
+        entries (ref THROTTLE_ADDED/REMOVED_BROKER_PARAM=false: copies to
+        a fresh broker / off a draining broker run at full speed)."""
         if self.rate is None:
             return
+        skip = excluded_brokers or set()
         brokers: set[int] = set()
         by_topic: dict[str, dict[str, set[str]]] = {}
         for t in tasks:
@@ -38,15 +43,20 @@ class ReplicationThrottleHelper:
             # follower in the follower list would throttle its ordinary
             # replication fetches and risk dropping it out of ISR.
             for b in (*p.old_replicas, *p.replicas_to_add):
-                brokers.add(b)
+                if b not in skip:
+                    brokers.add(b)
             lists = by_topic.setdefault(
                 p.topic, {LEADER_THROTTLED_REPLICAS: set(),
                           FOLLOWER_THROTTLED_REPLICAS: set()})
             # Kafka's "partition:broker" entry format.
             for b in p.old_replicas:
-                lists[LEADER_THROTTLED_REPLICAS].add(f"{p.partition}:{b}")
+                if b not in skip:
+                    lists[LEADER_THROTTLED_REPLICAS].add(
+                        f"{p.partition}:{b}")
             for b in p.replicas_to_add:
-                lists[FOLLOWER_THROTTLED_REPLICAS].add(f"{p.partition}:{b}")
+                if b not in skip:
+                    lists[FOLLOWER_THROTTLED_REPLICAS].add(
+                        f"{p.partition}:{b}")
         for b in brokers:
             existing = self.admin.describe_broker_config(b)
             cfg: dict[str, str | None] = {}

@@ -15,12 +15,25 @@ from .sampler import MetricSampler, SamplerAssignment, Samples
 from .store import NoopSampleStore, SampleStore
 
 
+class DefaultPartitionAssignor:
+    """Splits the partition universe across fetcher shards (ref
+    DefaultMetricSamplerPartitionAssignor — round-robin so every shard
+    carries a representative topic mix). Pluggable via
+    metric.sampler.partition.assignor.class."""
+
+    def assign(self, partitions: list[tuple[str, int]],
+               num_shards: int) -> list[list[tuple[str, int]]]:
+        return [partitions[i::num_shards] for i in range(num_shards)]
+
+
 class MetricFetcherManager:
     def __init__(self, sampler: MetricSampler, num_fetchers: int = 1,
-                 store: SampleStore | None = None) -> None:
+                 store: SampleStore | None = None,
+                 assignor: DefaultPartitionAssignor | None = None) -> None:
         self.sampler = sampler
         self.num_fetchers = max(1, num_fetchers)
         self.store = store or NoopSampleStore()
+        self.assignor = assignor or DefaultPartitionAssignor()
 
     def fetch(self, partitions: list[tuple[str, int]], brokers: list[int],
               start_ms: int, end_ms: int) -> Samples:
@@ -34,7 +47,8 @@ class MetricFetcherManager:
         """
         parallel_safe = getattr(self.sampler, "parallel_safe", False)
         n = self.num_fetchers if parallel_safe else 1
-        shards = [SamplerAssignment(partitions=partitions[i::n],
+        shard_parts = self.assignor.assign(partitions, n)
+        shards = [SamplerAssignment(partitions=shard_parts[i],
                                     brokers=(brokers if i == 0 else []),
                                     start_ms=start_ms, end_ms=end_ms)
                   for i in range(n)]

@@ -51,6 +51,7 @@ class MonitorConfig:
     broker_window_ms: int = 300_000
     min_samples_per_broker_window: int = 1
     max_allowed_extrapolations_per_partition: int = 5
+    max_allowed_extrapolations_per_broker: int = 5
     #: follower CPU as a fraction of the leader's attributed CPU (ref
     #: ModelUtils leader/follower CPU estimation).
     follower_cpu_ratio: float = 0.5

@@ -53,9 +53,10 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
     cpu_model = LinearRegressionModelParameters()
     sampler = _make_sampler(config, admin, cpu_model)
-    fetcher = MetricFetcherManager(sampler,
-                                   config.get_int("num.metric.fetchers"),
-                                   store=store)
+    fetcher = MetricFetcherManager(
+        sampler, config.get_int("num.metric.fetchers"), store=store,
+        assignor=load_class(config.get_string(
+            "metric.sampler.partition.assignor.class"))())
     runner = LoadMonitorTaskRunner(
         monitor, fetcher,
         sampling_interval_ms=config.get_int("metric.sampling.interval.ms"))
@@ -102,27 +103,73 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         self_healing_threshold_ms=config.get_int(
             "broker.failure.self.healing.threshold.ms"),
         enabled={t: healing_for(t) for t in KafkaAnomalyType})
-    detector = AnomalyDetectorManager(facade, notifier)
+    detector = AnomalyDetectorManager(
+        facade, notifier,
+        fixable_broker_count_threshold=config.get_int(
+            "fixable.failed.broker.count.threshold"),
+        fixable_broker_pct_threshold=config.get_double(
+            "fixable.failed.broker.percentage.threshold"),
+        num_cached_recent_anomalies=config.get_int(
+            "num.cached.recent.anomaly.states"))
     interval = config.get_int("anomaly.detection.interval.ms")
     detector.register(
         BrokerFailureDetector(
             admin, persist_path=config.get_string("failed.brokers.file.path")),
         config.get_int("broker.failure.detection.interval.ms"))
-    detector.register(DiskFailureDetector(admin), interval)
+    detector.register(DiskFailureDetector(admin),
+                      config.get_int("disk.failure.detection.interval.ms"))
     detector.register(GoalViolationDetector(monitor, optimizer),
                       config.get_int("goal.violation.detection.interval.ms"))
-    detector.register(MetricAnomalyDetector(monitor), interval)
+    detector.register(MetricAnomalyDetector(monitor),
+                      config.get_int("metric.anomaly.detection.interval.ms"))
     detector.register(SlowBrokerFinder(
         monitor, remove_slow_brokers=config.get_boolean(
             "slow.broker.removal.enabled")), interval)
     detector.register(TopicAnomalyDetector(
         admin, target_rf=config.get_int(
-            "topic.anomaly.target.replication.factor")), interval)
+            "topic.anomaly.target.replication.factor")),
+        config.get_int("topic.anomaly.detection.interval.ms"))
     facade.detector = detector
 
     security = None
     if config.get_boolean("webserver.security.enable"):
         security = _make_security(config)
+    cors = None
+    if config.get_boolean("webserver.http.cors.enabled"):
+        cors = {
+            "Access-Control-Allow-Origin":
+                config.get_string("webserver.http.cors.origin"),
+            "Access-Control-Allow-Methods":
+                config.get_string("webserver.http.cors.allowmethods"),
+            "Access-Control-Expose-Headers":
+                config.get_string("webserver.http.cors.exposeheaders")}
+    ssl_context = None
+    if config.get_boolean("webserver.ssl.enable"):
+        import ssl
+        ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(
+            config.get_string("webserver.ssl.keystore.location"),
+            password=config.get_string("webserver.ssl.key.password") or None)
+    # ref CruiseControlParametersConfig: a non-default
+    # <endpoint>.parameters.class plugin replaces the built-in parameter
+    # class for that endpoint.
+    parameter_overrides = {}
+    from .config.constants import _PLUGGABLE_ENDPOINTS
+    from .api.parameters import ENDPOINT_PARAMETERS
+    for key_ep in _PLUGGABLE_ENDPOINTS:
+        raw = config.get_string(f"{key_ep}.parameters.class")
+        # config keys use dots; "stop.proposal" maps onto the
+        # stop_proposal_execution endpoint (reference naming).
+        endpoint = {"stop.proposal": "stop_proposal_execution"}.get(
+            key_ep, key_ep.replace(".", "_"))
+        # The built-in default is the "module:endpoint" sentinel; anything
+        # else is a dotted plugin class path.
+        if raw and ":" not in raw:
+            if endpoint not in ENDPOINT_PARAMETERS:
+                raise ValueError(
+                    f"{key_ep}.parameters.class set for unknown endpoint "
+                    f"{endpoint}")
+            parameter_overrides[endpoint] = load_class(raw)
     return CruiseControlApp(
         facade,
         host=config.get_string("webserver.http.address"),
@@ -134,7 +181,14 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         completed_task_retention_ms=config.get_int(
             "completed.user.task.retention.time.ms"),
         purgatory_retention_ms=config.get_int(
-            "two.step.purgatory.retention.time.ms"))
+            "two.step.purgatory.retention.time.ms"),
+        purgatory_max_requests=config.get_int(
+            "two.step.purgatory.max.requests"),
+        reason_required=config.get_boolean("request.reason.required"),
+        cors=cors,
+        accesslog=config.get_boolean("webserver.accesslog.enabled"),
+        ssl_context=ssl_context,
+        parameter_overrides=parameter_overrides)
 
 
 class _AgentPipelineSampler:
@@ -255,13 +309,17 @@ def _make_security(config: CruiseControlConfig):
         if not secret:
             raise ValueError("jwt security requires jwt.secret")
         return JwtSecurityProvider(
-            secret, role_claim=config.get_string("jwt.role.claim"))
+            secret, role_claim=config.get_string("jwt.role.claim"),
+            expected_audiences=config.get_list("jwt.expected.audiences"),
+            cookie_name=config.get_string("jwt.cookie.name") or None)
     if kind == "trustedproxy":
         from .api.security import TrustedProxySecurityProvider
         return TrustedProxySecurityProvider(
             set(config.get_list("trusted.proxy.services")),
             principal_header=config.get_string(
-                "trusted.proxy.principal.header"))
+                "trusted.proxy.principal.header"),
+            ip_regex=config.get_string(
+                "trusted.proxy.services.ip.regex") or None)
     if kind == "spnego":
         from .api.security import SpnegoSecurityProvider
         principal = config.get_string("spnego.principal")
@@ -338,7 +396,8 @@ def main(argv=None) -> int:
     admin = _make_admin(config, args.demo_brokers, args.demo_partitions)
     app = build_app(config, admin)
     app.facade.start_up(
-        precompute_interval_s=config.get_int("proposal.expiration.ms") / 1000)
+        precompute_interval_s=config.get_int("proposal.expiration.ms") / 1000,
+        skip_loading=config.get_boolean("skip.loading.samples"))
     app.facade.detector.start_detection()
     app.start()
     print(f"cruise-control-tpu listening on "

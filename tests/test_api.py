@@ -47,7 +47,8 @@ def build_stack(num_brokers=4, partitions=16, two_step=False, security=None,
     for w in range(4):
         assert runner.maybe_run_sampling((w + 1) * WINDOW_MS - 1)
     clock = SimClock(sim)
-    executor = Executor(sim, ExecutorConfig(progress_check_interval_ms=100),
+    executor = Executor(sim, ExecutorConfig(progress_check_interval_ms=100,
+                                            min_progress_check_interval_ms=10),
                         now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
     facade = KafkaCruiseControl(
         sim, monitor, task_runner=runner,

@@ -198,3 +198,65 @@ def test_http_load_capacity_and_disk_info(stack):
     status, body, _ = call(app, "GET", "load", "populate_disk_info=true")
     assert status == 200
     assert "DiskState" in body["brokers"][0]
+
+
+def test_http_mixed_case_parameter_names(stack):
+    _, _, app = stack
+    # Parameter names are case-insensitive end to end.
+    status, body, _ = call(app, "POST", "rebalance",
+                           "DryRun=true&Goals=ReplicaDistributionGoal"
+                           "&Get_Response_Timeout_S=120")
+    assert status == 200, body
+
+
+def test_http_admin_adjuster_type_validation(stack):
+    _, facade, app = stack
+    status, body, _ = call(app, "POST", "admin",
+                           "disable_concurrency_adjuster_for="
+                           "inter-broker-replica", expect=400)
+    assert "unknown concurrency type" in body["errorMessage"]
+    status, body, _ = call(app, "POST", "admin",
+                           "disable_concurrency_adjuster_for=leadership")
+    assert status == 200
+    assert "leadership" in facade.executor.adjuster_disabled_types
+    call(app, "POST", "admin", "enable_concurrency_adjuster_for=leadership")
+    assert "leadership" not in facade.executor.adjuster_disabled_types
+
+
+def test_http_goal_options_reach_remove_broker():
+    # Own stack: the shared module stack's earlier real executions place
+    # replicas on broker 3, turning them into must-moves that (correctly)
+    # override the exclusion. On a fresh stack broker 3 is empty, so
+    # excluded t1 partitions must not move at all.
+    sim, facade, app = build_stack()
+    try:
+        status, body, _ = call(app, "POST", "remove_broker",
+                               "brokerid=3&excluded_topics=t1&dryrun=true"
+                               "&get_response_timeout_s=120")
+        assert status == 200, body
+        moved_topics = {p["topicPartition"]["topic"]
+                        for p in body["proposals"]}
+        assert "t1" not in moved_topics
+    finally:
+        app.stop()
+
+
+def test_purgatory_replay_typo_does_not_burn_approval():
+    sim, facade, app = build_stack(two_step=True)
+    try:
+        status, body, _ = call(app, "POST", "rebalance", "dryrun=true")
+        assert status == 202
+        rid = body["reviewResult"]["Id"]
+        call(app, "POST", "review", f"approve={rid}")
+        # A replay with a malformed extra param must 400 WITHOUT consuming
+        # the approved request...
+        status, body, _ = call(app, "POST", "rebalance",
+                               f"review_id={rid}&dryrun=maybe", expect=400)
+        assert "boolean" in body["errorMessage"]
+        # ...so the corrected replay still executes.
+        status, body, _ = call(app, "POST", "rebalance",
+                               f"review_id={rid}&dryrun=true"
+                               "&get_response_timeout_s=120")
+        assert status == 200, body
+    finally:
+        app.stop()
