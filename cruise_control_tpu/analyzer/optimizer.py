@@ -226,10 +226,22 @@ class TpuGoalOptimizer:
             state, iters, stack = gpass(state, ctx,
                                         jax.random.fold_in(key, i))
             boundary = np.asarray(stack)
+            after_i = float(boundary[i])
+            # Self-check (ref AbstractGoal.java:110-119: the optimization
+            # "stats should not be worse" assertion): a goal pass may never
+            # worsen its OWN violation — lexicographic acceptance makes
+            # that structurally impossible, so a breach means a broken
+            # goal kernel, and silently serving its plan would hand the
+            # executor a regression.
+            if after_i > before_i * (1 + 1e-6) + 1e-6:
+                raise RuntimeError(
+                    f"optimization self-check failed: goal {goal.name} "
+                    f"worsened its own violation {before_i:.6g} -> "
+                    f"{after_i:.6g}")
             goal_results.append(GoalResult(
                 name=goal.name, hard=goal.hard,
                 violation_before=before_i,
-                violation_after=float(boundary[i]),
+                violation_after=after_i,
                 duration_s=time.monotonic() - g0,
                 iterations=int(jax.device_get(iters))))
 

@@ -589,15 +589,22 @@ class KafkaCruiseControl:
 
     def train(self, now_ms: int | None = None) -> dict:
         """Feed broker (bytes-in, bytes-out) -> CPU observations into the
-        linear regression (ref TrainRunnable + LinearRegressionModelParameters)."""
-        stats = self.monitor.broker_window_stats(now_ms or self._now_ms())
-        for _, values in stats.items():
-            for w in range(values.shape[1]):
-                self.cpu_model.add_observation(
-                    values[BrokerMetric.LEADER_BYTES_IN, w],
-                    values[BrokerMetric.LEADER_BYTES_OUT, w],
-                    values[BrokerMetric.CPU_USAGE, w])
-        self.cpu_model.fit()
+        linear regression (ref TrainRunnable + LinearRegressionModelParameters).
+        Runs under the task runner's TRAINING state when a runner is wired
+        (ref LoadMonitorTaskRunner.java:57-58)."""
+        import contextlib
+        guard = (self.task_runner.training() if self.task_runner is not None
+                 else contextlib.nullcontext())
+        with guard:
+            stats = self.monitor.broker_window_stats(
+                now_ms or self._now_ms())
+            for _, values in stats.items():
+                for w in range(values.shape[1]):
+                    self.cpu_model.add_observation(
+                        values[BrokerMetric.LEADER_BYTES_IN, w],
+                        values[BrokerMetric.LEADER_BYTES_OUT, w],
+                        values[BrokerMetric.CPU_USAGE, w])
+            self.cpu_model.fit()
         return self.cpu_model.to_json()
 
     def remove_disks(self, broker_id_logdirs: dict[int, list[str]],

@@ -236,21 +236,83 @@ class TopicAnomalyDetector:
             detected_ms=now_ms, bad_topics=bad, target_rf=self.target_rf)]
 
 
+class IdempotenceCache:
+    """Durable de-dup of equivalent maintenance events (ref
+    ``detector/IdempotenceCache.java:106``): an event key blocks duplicates
+    for ``retention_ms``, the cache holds at most ``max_size`` keys
+    (oldest evicted first), and the key->time map persists to a JSON file
+    so a restart cannot re-execute a plan it already accepted."""
+
+    def __init__(self, *, retention_ms: int = 180_000, max_size: int = 25,
+                 persist_path: str | None = None, now_ms=None) -> None:
+        import time as _t
+        self.retention_ms = retention_ms
+        self.max_size = max_size
+        self.persist_path = persist_path
+        self._now_ms = now_ms or (lambda: int(_t.time() * 1000))
+        self._seen: dict[str, int] = {}
+        if persist_path:
+            try:
+                with open(persist_path, encoding="utf-8") as f:
+                    self._seen = {k: int(v)
+                                  for k, v in json.load(f).items()}
+            except (FileNotFoundError, ValueError):
+                pass
+
+    def _persist(self) -> None:
+        if self.persist_path:
+            with open(self.persist_path, "w", encoding="utf-8") as f:
+                json.dump(self._seen, f)
+
+    def _prune(self, now: int) -> None:
+        cutoff = now - self.retention_ms
+        for k in [k for k, t in self._seen.items() if t < cutoff]:
+            del self._seen[k]
+        while len(self._seen) > self.max_size:
+            self._seen.pop(min(self._seen, key=self._seen.get))
+
+    def check_and_add(self, key: str) -> bool:
+        """True when the key is fresh (and is now recorded); False for a
+        duplicate inside the retention window."""
+        now = self._now_ms()
+        self._prune(now)
+        if key in self._seen:
+            return False
+        self._seen[key] = now
+        self._prune(now)
+        self._persist()
+        return True
+
+
 class MaintenanceEventReader:
     """In-memory maintenance-plan source with idempotence de-dup (ref
     MaintenanceEventTopicReader.java:350 + IdempotenceCache.java; the
-    reference reads serialized plans from a Kafka topic)."""
+    reference reads serialized plans from a Kafka topic).
 
-    def __init__(self) -> None:
+    ``enable_idempotence`` / cache sizing mirror
+    maintenance.event.enable.idempotence / .idempotence.retention.ms /
+    .max.idempotence.cache.size; ``persist_path`` makes accepted plans
+    survive a restart."""
+
+    def __init__(self, *, enable_idempotence: bool = True,
+                 idempotence_retention_ms: int = 180_000,
+                 max_idempotence_cache_size: int = 25,
+                 persist_path: str | None = None, now_ms=None) -> None:
         self._plans: list[MaintenanceEvent] = []
-        self._seen: set[tuple] = set()
+        self.enable_idempotence = enable_idempotence
+        self._cache = IdempotenceCache(
+            retention_ms=idempotence_retention_ms,
+            max_size=max_idempotence_cache_size,
+            persist_path=persist_path, now_ms=now_ms)
 
     def submit(self, event: MaintenanceEvent) -> bool:
-        key = (event.event_type, tuple(event.broker_ids),
-               event.topic_pattern, event.target_rf)
-        if key in self._seen:
-            return False
-        self._seen.add(key)
+        if self.enable_idempotence:
+            key = "|".join(map(str, (event.event_type.value,
+                                     sorted(event.broker_ids),
+                                     event.topic_pattern,
+                                     event.target_rf)))
+            if not self._cache.check_and_add(key):
+                return False
         self._plans.append(event)
         return True
 

@@ -29,11 +29,15 @@ class DefaultPartitionAssignor:
 class MetricFetcherManager:
     def __init__(self, sampler: MetricSampler, num_fetchers: int = 1,
                  store: SampleStore | None = None,
-                 assignor: DefaultPartitionAssignor | None = None) -> None:
+                 assignor: DefaultPartitionAssignor | None = None,
+                 on_execution_store: SampleStore | None = None) -> None:
         self.sampler = sampler
         self.num_fetchers = max(1, num_fetchers)
         self.store = store or NoopSampleStore()
         self.assignor = assignor or DefaultPartitionAssignor()
+        #: optional secondary store for samples taken during an ongoing
+        #: execution (ref KafkaPartitionMetricSampleOnExecutionStore)
+        self.on_execution_store = on_execution_store
 
     def fetch(self, partitions: list[tuple[str, int]], brokers: list[int],
               start_ms: int, end_ms: int) -> Samples:
@@ -62,4 +66,6 @@ class MetricFetcherManager:
             merged.partition_samples.extend(r.partition_samples)
             merged.broker_samples.extend(r.broker_samples)
         self.store.store_samples(merged)
+        if self.on_execution_store is not None:
+            self.on_execution_store.store_samples(merged)
         return merged

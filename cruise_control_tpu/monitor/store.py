@@ -102,3 +102,30 @@ class FileSampleStore:
         with self._lock:
             self._pfile.close()
             self._bfile.close()
+
+
+class OnExecutionSampleStore:
+    """Secondary store capturing partition samples taken WHILE an
+    execution is in flight (ref
+    ``KafkaPartitionMetricSampleOnExecutionStore.java:106`` — the
+    reference writes them to a dedicated topic so the load impact of an
+    execution can be audited separately from steady-state history).
+
+    Wraps any :class:`SampleStore`; ``has_ongoing_execution`` is the
+    executor probe — samples arriving outside an execution are dropped.
+    """
+
+    def __init__(self, inner: SampleStore, has_ongoing_execution) -> None:
+        self.inner = inner
+        self.has_ongoing_execution = has_ongoing_execution
+
+    def store_samples(self, samples: Samples) -> None:
+        if self.has_ongoing_execution():
+            self.inner.store_samples(
+                Samples(samples.partition_samples, []))
+
+    def load_samples(self) -> Samples:
+        return self.inner.load_samples()
+
+    def close(self) -> None:
+        self.inner.close()

@@ -27,6 +27,7 @@ class RunnerState(enum.Enum):
     SAMPLING = "SAMPLING"
     PAUSED = "PAUSED"
     BOOTSTRAPPING = "BOOTSTRAPPING"
+    TRAINING = "TRAINING"
 
 
 class LoadMonitorTaskRunner:
@@ -160,6 +161,31 @@ class LoadMonitorTaskRunner:
             with self._lock:
                 if self._state is RunnerState.BOOTSTRAPPING:
                     self._state = prev
+
+    def training(self):
+        """Context manager marking a TRAIN run in the state machine (ref
+        LoadMonitorTaskRunner.java:57-58 TRAINING state — sampling pauses
+        while the regression trains, and resumes after)."""
+        runner = self
+
+        class _Training:
+            def __enter__(self):
+                with runner._lock:
+                    self._prev = runner._state
+                    if self._prev not in (RunnerState.RUNNING,
+                                          RunnerState.PAUSED):
+                        raise RuntimeError(
+                            f"cannot train while {self._prev.value}")
+                    runner._state = RunnerState.TRAINING
+                return self
+
+            def __exit__(self, *exc):
+                with runner._lock:
+                    if runner._state is RunnerState.TRAINING:
+                        runner._state = self._prev
+                return False
+
+        return _Training()
 
     def state_json(self) -> dict:
         return {"state": self._state.value,

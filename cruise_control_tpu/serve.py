@@ -53,6 +53,12 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
     cpu_model = LinearRegressionModelParameters()
     sampler = _make_sampler(config, admin, cpu_model)
+    on_exec_store = None
+    if config.get_string("sample.partition.metric.store.on.execution.class"):
+        # ref KafkaPartitionMetricSampleOnExecutionStore: keep execution-
+        # window samples separately (file-backed beside the main store).
+        import os as _os
+        on_exec_dir = _os.path.join(store_dir or ".", "on_execution")
     fetcher = MetricFetcherManager(
         sampler, config.get_int("num.metric.fetchers"), store=store,
         assignor=load_class(config.get_string(
@@ -81,6 +87,10 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         import inspect
         params = inspect.signature(gen_cls).parameters
         options_generator = gen_cls(config) if params else gen_cls()
+    if config.get_string("sample.partition.metric.store.on.execution.class"):
+        from .monitor.store import OnExecutionSampleStore
+        fetcher.on_execution_store = OnExecutionSampleStore(
+            FileSampleStore(on_exec_dir), executor.has_ongoing_execution)
     facade = KafkaCruiseControl(admin, monitor, task_runner=runner,
                                 optimizer=optimizer, executor=executor,
                                 options_generator=options_generator,

@@ -246,3 +246,48 @@ def test_balancedness_score_in_state_endpoint():
         assert "MonitorState" not in st      # substates filter applied
     finally:
         app.stop()
+
+
+def test_idempotence_cache_retention_size_and_persistence(tmp_path):
+    from cruise_control_tpu.detector.detectors import IdempotenceCache
+    now = [0]
+    path = str(tmp_path / "idem.json")
+    cache = IdempotenceCache(retention_ms=1000, max_size=2,
+                             persist_path=path, now_ms=lambda: now[0])
+    assert cache.check_and_add("a")
+    assert not cache.check_and_add("a")          # duplicate blocked
+    now[0] = 500
+    assert cache.check_and_add("b")
+    assert cache.check_and_add("c")              # evicts oldest ("a")
+    assert cache.check_and_add("a")              # "a" evicted -> fresh
+    now[0] = 5000
+    assert cache.check_and_add("c")              # retention expired
+    # durability: a new cache over the same file remembers accepted keys
+    reloaded = IdempotenceCache(retention_ms=10_000, max_size=10,
+                                persist_path=path, now_ms=lambda: now[0])
+    assert not reloaded.check_and_add("c")
+
+
+def test_maintenance_reader_idempotence_survives_restart(tmp_path):
+    from cruise_control_tpu.detector import (MaintenanceEvent,
+                                             MaintenanceEventReader,
+                                             MaintenanceEventType)
+    path = str(tmp_path / "maint.json")
+    now = [0]
+    reader = MaintenanceEventReader(persist_path=path, now_ms=lambda: now[0])
+    ev = MaintenanceEvent(detected_ms=0,
+                          event_type=MaintenanceEventType.REMOVE_BROKER,
+                          broker_ids=[3])
+    assert reader.submit(ev)
+    assert not reader.submit(MaintenanceEvent(
+        detected_ms=1, event_type=MaintenanceEventType.REMOVE_BROKER,
+        broker_ids=[3]))
+    # A restarted reader (fresh process) must still refuse the duplicate.
+    reader2 = MaintenanceEventReader(persist_path=path,
+                                     now_ms=lambda: now[0])
+    assert not reader2.submit(MaintenanceEvent(
+        detected_ms=2, event_type=MaintenanceEventType.REMOVE_BROKER,
+        broker_ids=[3]))
+    # Idempotence off: duplicates flow through.
+    reader3 = MaintenanceEventReader(enable_idempotence=False)
+    assert reader3.submit(ev) and reader3.submit(ev)

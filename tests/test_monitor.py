@@ -274,3 +274,40 @@ def test_processor_estimates_missing_cpu_via_regression():
         partitions=[("t", 0)], brokers=[0], start_ms=0, end_ms=200))
     assert {s.entity: s for s in s0.broker_samples}[0].values.get(
         int(BrokerMetric.CPU_USAGE), 0.0) == 0.0
+
+
+def test_runner_training_state():
+    sim = make_cluster()
+    monitor = make_monitor(sim)
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=WINDOW_MS)
+    runner.start(0, skip_loading=True)
+    with runner.training():
+        assert runner.state is RunnerState.TRAINING
+        # No sampling while training.
+        assert not runner.maybe_run_sampling(10_000_000)
+    assert runner.state is RunnerState.RUNNING
+    import pytest as _pytest
+    with runner.training():
+        with _pytest.raises(RuntimeError, match="cannot train"):
+            with runner.training():
+                pass
+
+
+def test_on_execution_sample_store_gates_on_executor(tmp_path):
+    from cruise_control_tpu.monitor.store import (FileSampleStore,
+                                                  OnExecutionSampleStore)
+    from cruise_control_tpu.monitor.sampler import Samples
+    from cruise_control_tpu.monitor.samples import PartitionMetricSample
+    ongoing = [False]
+    store = OnExecutionSampleStore(FileSampleStore(str(tmp_path)),
+                                   lambda: ongoing[0])
+    s = Samples([PartitionMetricSample("t", 0, 123,
+                                       values={0: 1.0})], [])
+    store.store_samples(s)                       # idle: dropped
+    assert store.load_samples().partition_samples == []
+    ongoing[0] = True
+    store.store_samples(s)                       # executing: captured
+    got = store.load_samples().partition_samples
+    assert len(got) == 1 and got[0].time_ms == 123
