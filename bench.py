@@ -52,6 +52,18 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
+def emit(metric: str, value, unit: str, vs_baseline) -> None:
+    """The one JSON line the driver records. ``platform`` self-certifies
+    where the number was measured (tpu vs cpu fallback) so a BENCH artifact
+    can never silently pass off a fallback run as a TPU result."""
+    import jax
+    print(json.dumps({
+        "metric": metric, "value": value, "unit": unit,
+        "vs_baseline": vs_baseline,
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+
 def build_spec():
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
                                                PartitionSpec)
@@ -250,12 +262,10 @@ def run_scale_scenario(n: int):
                             drain_batch=drain, drain_rounds=8,
                             max_iters_per_goal=512))
     t0 = time.monotonic()
-    res_cold = opt.optimize(model, md, OptimizationOptions(
-        seed=0, skip_hard_goal_check=True))
+    res_cold = opt.optimize(model, md, OptimizationOptions(seed=0))
     cold = time.monotonic() - t0
     t0 = time.monotonic()
-    res = opt.optimize(model, md, OptimizationOptions(
-        seed=1, skip_hard_goal_check=True))
+    res = opt.optimize(model, md, OptimizationOptions(seed=1))
     warm = time.monotonic() - t0
     log(f"  search: cold {cold:.1f}s warm {warm:.1f}s "
         f"moves={res.num_moves} proposals={len(res.proposals)}")
@@ -263,11 +273,8 @@ def run_scale_scenario(n: int):
         log(f"    {g.name:42s} {g.violation_before:14.1f} -> "
             f"{g.violation_after:12.1f} iters={g.iterations} "
             f"({g.duration_s:.2f}s)")
-    print(json.dumps({
-        "metric": cfgd["metric"], "value": round(warm, 3), "unit": "s",
-        "vs_baseline": round(cfgd["target_s"] / warm, 3) if warm > 0
-        else None,
-    }))
+    emit(cfgd["metric"], round(warm, 3), "s",
+         round(cfgd["target_s"] / warm, 3) if warm > 0 else None)
 
 
 def run_replan_scenario(num_requests: int = 30):
@@ -305,11 +312,8 @@ def run_replan_scenario(num_requests: int = 30):
                                            len(lat) - 1)]
     log(f"scenario 5: {num_requests} broker-failure replans "
         f"p50={p50:.2f}s p99={p99:.2f}s (last proposals={len(res.proposals)})")
-    print(json.dumps({
-        "metric": "broker_failure_replan_p99_100x20k",
-        "value": round(float(p99), 3), "unit": "s",
-        "vs_baseline": round(1.0 / float(p99), 3) if p99 > 0 else None,
-    }))
+    emit("broker_failure_replan_p99_100x20k", round(float(p99), 3),
+         "s", round(1.0 / float(p99), 3) if p99 > 0 else None)
 
 
 def run_demo_scenario():
@@ -356,9 +360,8 @@ def run_demo_scenario():
     log(f"scenario 1: 3-broker demo, cold {cold:.1f}s warm {dur:.2f}s, "
         f"{len(res.proposals)} proposals, "
         f"violated after: {res.violated_goals_after}")
-    print(json.dumps({"metric": "rebalance_proposal_wall_clock_3broker_demo",
-                      "value": round(dur, 3), "unit": "s",
-                      "vs_baseline": None}))
+    emit("rebalance_proposal_wall_clock_3broker_demo", round(dur, 3),
+         "s", None)
 
 
 def main():
@@ -432,12 +435,8 @@ def main():
             f"quality regression: tpu residual {our_res:.1f} > "
             f"greedy {g_res:.1f} x1.05 + {EPS}")
 
-    print(json.dumps({
-        "metric": "rebalance_proposal_wall_clock_100x20k",
-        "value": round(warm, 3),
-        "unit": "s",
-        "vs_baseline": round(g_dur / warm, 3) if warm > 0 else None,
-    }))
+    emit("rebalance_proposal_wall_clock_100x20k", round(warm, 3), "s",
+         round(g_dur / warm, 3) if warm > 0 else None)
 
 
 if __name__ == "__main__":

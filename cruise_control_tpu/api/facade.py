@@ -283,11 +283,27 @@ class KafkaCruiseControl:
         from dataclasses import replace as _dc_replace
         demoted = set(broker_ids)
 
+        # The URP exclusion must gate the *spec mutation*, not just the
+        # optimizer: excluded_partitions only stops the search engine from
+        # proposing moves, but every partition whose preferred order the
+        # mutator rewrites is force-diffed against the live placement and
+        # executed. Compute the pinned set first so the mutator leaves
+        # under-replicated partitions entirely alone (ref
+        # DemotionHelper / SKIP_URP_DEMOTION semantics).
+        options = options or OptimizationOptions()
+        excluded_parts = set(options.excluded_partitions)
+        if skip_urp_demotion:
+            excluded_parts |= {
+                tp for tp, info in self.admin.describe_partitions().items()
+                if len(info.isr) < len(info.replicas)}
+
         def mark_demoted(spec):
             for b in spec.brokers:
                 if b.broker_id in demoted:
                     b.demoted = True
             for p in spec.partitions:
+                if (p.topic, p.partition) in excluded_parts:
+                    continue  # pinned (URP or caller-excluded): no rewrite
                 # Demoted brokers also lose *preferred* leadership: rotate
                 # them out of the head of the replica list.
                 if p.replicas and p.replicas[0] in demoted:
@@ -304,12 +320,6 @@ class KafkaCruiseControl:
                                   + [r for r in p.replicas if r in demoted])
             return spec
 
-        options = options or OptimizationOptions()
-        excluded_parts = set(options.excluded_partitions)
-        if skip_urp_demotion:
-            excluded_parts |= {
-                tp for tp, info in self.admin.describe_partitions().items()
-                if len(info.isr) < len(info.replicas)}
         options = _dc_replace(
             options,
             excluded_brokers_for_leadership=(

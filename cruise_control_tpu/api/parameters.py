@@ -217,7 +217,18 @@ class EndpointParameters:
     def parse(cls, endpoint: str, query: dict[str, list[str]]
               ) -> ParsedParams:
         specs = cls.specs()
-        unknown = [k for k in query if k.lower() not in specs]
+        # Parameter names are case-insensitive (ref ParameterUtils — the
+        # servlet lowercases). Normalize here so non-HTTP callers (plugins,
+        # tests, programmatic use) get the same contract instead of a
+        # silently applied default on a mixed-case key.
+        lowered: dict[str, list[str]] = {}
+        for k, v in query.items():
+            # Merge case-variant spellings of one name so the duplicate
+            # check below still fires (?DryRun=x&dryrun=y is the same
+            # parameter given twice, not a silent overwrite).
+            lowered.setdefault(k.lower(), []).extend(v)
+        query = lowered
+        unknown = [k for k in query if k not in specs]
         if unknown:
             raise ParameterError(
                 f"unrecognized parameter(s) {sorted(unknown)} for endpoint "
@@ -226,8 +237,6 @@ class EndpointParameters:
         for name, spec in specs.items():
             raw_list = query.get(name)
             if raw_list is None:
-                # exact-case miss: query keys were lowercased by the
-                # handler, so this is just the default path
                 values[name] = spec.default
                 if spec.required:
                     raise ParameterError(

@@ -93,10 +93,15 @@ class Purgatory:
                 touched[rid] = info
             return touched
 
-    def get(self, review_id: int) -> RequestInfo:
+    def get(self, review_id: int, endpoint: str | None = None) -> RequestInfo:
         """Read an approved request WITHOUT consuming it — callers validate
         the replayed request first, then :meth:`submit` (a replay typo
-        must not burn the approval)."""
+        must not burn the approval).
+
+        ``endpoint``, when given, must match the endpoint the request was
+        reviewed for (ref Purgatory.java:179-184: a review id is bound to
+        one endpoint; replaying it against another would execute an action
+        that was never reviewed)."""
         with self._lock:
             info = self._requests.get(review_id)
             if info is None:
@@ -104,13 +109,18 @@ class Purgatory:
             if ReviewStatus.SUBMITTED not in _VALID[info.status]:
                 raise ValueError(
                     f"request {review_id} is {info.status.value}, not APPROVED")
+            if endpoint is not None and info.endpoint != endpoint:
+                raise ValueError(
+                    f"request {review_id} was reviewed for endpoint "
+                    f"{info.endpoint}, not {endpoint}")
             return info
 
-    def submit(self, review_id: int) -> RequestInfo:
+    def submit(self, review_id: int,
+               endpoint: str | None = None) -> RequestInfo:
         """Mark an approved request submitted, returning it for execution
         (ref submit :169)."""
         with self._lock:
-            info = self.get(review_id)
+            info = self.get(review_id, endpoint)
             info.status = ReviewStatus.SUBMITTED
             return info
 

@@ -169,11 +169,11 @@ class CruiseControlApp:
             # Validate the merged request BEFORE submit(): submit
             # irreversibly burns the approval, so a typo in the replay
             # must not consume the reviewed request.
-            pending = self.purgatory.get(int(review_id))
+            pending = self.purgatory.get(int(review_id), endpoint)
             merged = {k.lower(): [v] for k, v in pending.params.items()}
             merged.update(params)
             self._parse(endpoint, merged)
-            self.purgatory.submit(int(review_id))
+            self.purgatory.submit(int(review_id), endpoint)
             params = merged
 
         # Typed parse + validation (ref servlet/parameters/*): unknown

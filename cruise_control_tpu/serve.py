@@ -152,7 +152,13 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             "Access-Control-Allow-Methods":
                 config.get_string("webserver.http.cors.allowmethods"),
             "Access-Control-Expose-Headers":
-                config.get_string("webserver.http.cors.exposeheaders")}
+                config.get_string("webserver.http.cors.exposeheaders"),
+            # Request headers the async protocol needs on preflight —
+            # without this a browser POST carrying User-Task-ID fails
+            # CORS even with cors.enabled (exposeheaders only covers
+            # response headers).
+            "Access-Control-Allow-Headers":
+                "User-Task-ID, Content-Type, Authorization"}
     ssl_context = None
     if config.get_boolean("webserver.ssl.enable"):
         import ssl

@@ -240,6 +240,31 @@ def test_two_step_verification_flow():
         app.stop()
 
 
+def test_two_step_review_id_bound_to_endpoint():
+    """ref Purgatory.java:179-184: a review id approves ONE endpoint; a
+    replay against a different endpoint must be rejected AND must not
+    burn the approval (else two-step verification is defeated by
+    replaying an approved rebalance as e.g. remove_broker)."""
+    sim, facade, app = build_stack(two_step=True)
+    try:
+        status, body, _ = call(app, "POST", "rebalance", "dryrun=true")
+        assert status == 202
+        rid = body["reviewResult"]["Id"]
+        call(app, "POST", "review", f"approve={rid}")
+        # Replay through a DIFFERENT endpoint: rejected, nothing executed.
+        status, body, _ = call(app, "POST", "remove_broker",
+                               f"review_id={rid}&brokerid=3&dryrun=true",
+                               expect=400)
+        assert "rebalance" in body["errorMessage"]
+        # The approval was NOT consumed: the reviewed endpoint still works.
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            f"review_id={rid}&dryrun=true&get_response_timeout_s=120")
+        assert status == 200
+    finally:
+        app.stop()
+
+
 def test_basic_security_roles():
     users = {"alice": ("pw", Role.ADMIN), "bob": ("pw", Role.VIEWER)}
     sim, facade, app = build_stack(security=BasicSecurityProvider(users))

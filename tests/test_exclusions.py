@@ -151,6 +151,53 @@ def test_demote_broker_moves_all_leadership_off():
         assert prop.new_replicas[0] != 0, prop.to_json()
 
 
+def test_demote_skip_urp_pins_under_replicated_partitions():
+    """ref SKIP_URP_DEMOTION (default true): an under-replicated partition
+    led by a demoted broker must be left ENTIRELY alone — the spec
+    mutation may not rewrite its preferred order, and no leadership-move
+    proposal for it may be emitted (shuffling leadership of a partition
+    already missing replicas risks unavailability)."""
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import (LoadMonitor,
+                                            LoadMonitorTaskRunner,
+                                            MetricFetcherManager,
+                                            MonitorConfig,
+                                            SyntheticWorkloadSampler)
+    from cruise_control_tpu.api import KafkaCruiseControl
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    for p in range(24):
+        sim.add_partition(f"t{p % 2}", p, [p % 4, (p + 1) % 4], size_mb=10.0)
+    # Partition t0/0 is led by broker 0 and under-replicated (follower
+    # fell out of the ISR).
+    urp = sim.describe_partitions()[("t0", 0)]
+    assert urp.replicas[0] == 0
+    urp.isr.discard(urp.replicas[1])
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=1000,
+                                             min_samples_per_window=1))
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=1000)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        runner.maybe_run_sampling((w + 1) * 1000 - 1)
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(config=CFG), now_ms=lambda: 4000)
+    res, _ = facade.demote_brokers([0], dryrun=True, skip_urp_demotion=True)
+    # No proposal may touch the pinned URP.
+    touched = {(p.topic, p.partition) for p in res.proposals}
+    assert ("t0", 0) not in touched, "URP was demoted despite skip_urp"
+    # Its preferred order still names the demoted broker first (model
+    # partition order == sim insertion order: index p holds (t{p%2}, p)).
+    rbF = np.asarray(res.final_model.replica_broker)
+    assert rbF[0, 0] == 0, "pinned URP's leader was rewritten"
+    # Healthy partitions led by broker 0 (p % 4 == 0, p > 0) still demoted.
+    for i in (4, 8, 12, 16, 20):
+        assert rbF[i, 0] != 0, f"healthy partition {i} not demoted"
+
+
 def test_kafka_assigner_mode_fixes_racks_with_minimal_movement():
     """ref analyzer/kafkaassigner/: the assigner pair fixes rack violations
     and disk imbalance while moving far fewer replicas than a full default

@@ -260,3 +260,24 @@ def test_purgatory_replay_typo_does_not_burn_approval():
         assert status == 200, body
     finally:
         app.stop()
+
+
+def test_parse_normalizes_mixed_case_keys():
+    """Parameter names are case-insensitive for ALL callers, not only the
+    HTTP handler's pre-lowercased path: a mixed-case key must parse (not
+    silently fall back to the default)."""
+    from cruise_control_tpu.api.parameters import parse_endpoint_params
+    parsed = parse_endpoint_params("rebalance", {"DryRun": ["false"],
+                                                 "Verbose": ["true"]})
+    assert parsed["dryrun"] is False
+    assert parsed["verbose"] is True
+
+
+def test_parse_case_variant_duplicate_is_an_error():
+    """?DryRun=true&dryrun=false is the same parameter given twice — it
+    must raise, never silently pick one spelling."""
+    from cruise_control_tpu.api.parameters import (ParameterError,
+                                                   parse_endpoint_params)
+    with pytest.raises(ParameterError, match="2 times"):
+        parse_endpoint_params("rebalance", {"DryRun": ["true"],
+                                            "dryrun": ["false"]})
