@@ -19,7 +19,15 @@ B = padded broker count, 4 = resources CPU/NW_IN/NW_OUT/DISK):
 - ``leader_load / follower_load  float32[P, 4]`` — per-partition resource
   load when hosting the leader vs a follower (ref ``Load.java``: leader
   carries CPU(leader), NW_IN, NW_OUT, DISK; followers carry CPU(follower),
-  replication NW_IN, zero NW_OUT, DISK).
+  replication NW_IN, zero NW_OUT, DISK). Each entry is the reference's
+  *representative* windowed value per ``KafkaMetricDef``'s
+  ValueComputingStrategy (``ModelUtils.java:162`` /
+  ``KafkaMetricDef.java:43-46``): AVG over valid windows for CPU/NW_IN/
+  NW_OUT, LATEST valid window for DISK — so goal kernels score exactly
+  what ``Load.expectedUtilizationFor(resource)`` returns. The full
+  ``[entity, metric, window]`` grid stays host-side on
+  ``ClusterModelResult.partition_windows`` for the max/latest-window
+  consumers (``/partition_load?max_load``, anomaly detectors).
 - ``partition_topic int32[P]``, ``partition_valid bool[P]``.
 - ``replica_offline bool[P, R]`` — replica currently on a dead broker or bad
   disk (ref ``Replica.isCurrentOffline``); these MUST move.

@@ -258,13 +258,21 @@ class LoadMonitor:
                 if vae is not None and valid_cols:
                     windows[tp] = vae.values
                     window_times = vae.window_times_ms
-                    # Mean over *valid* windows only — invalid windows are
-                    # zero-filled columns that would silently dilute the load.
+                    # Per-metric ValueComputingStrategy (ref
+                    # KafkaMetricDef.java:43-46 + ModelUtils.java:162
+                    # expectedUtilizationFor): CPU/NW_IN/NW_OUT are the AVG
+                    # over valid windows; DISK is the LATEST valid window —
+                    # disk usage is a level, not a rate, so averaging old
+                    # windows would understate a growing partition and hide
+                    # a burst from the capacity goals. Valid windows only —
+                    # invalid windows are zero-filled columns that would
+                    # silently dilute the load.
                     mean = vae.values[:, valid_cols].mean(axis=1)
+                    latest = vae.values[:, valid_cols[-1]]
                     cpu = float(mean[KafkaMetric.CPU_USAGE])
                     nw_in = float(mean[KafkaMetric.LEADER_BYTES_IN])
                     nw_out = float(mean[KafkaMetric.LEADER_BYTES_OUT])
-                    disk = float(mean[KafkaMetric.DISK_USAGE])
+                    disk = float(latest[KafkaMetric.DISK_USAGE])
                     leader_load = (cpu, nw_in, nw_out, disk)
                     follower_load = (cpu * c.follower_cpu_ratio, nw_in, 0.0,
                                      disk)

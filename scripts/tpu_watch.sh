@@ -43,12 +43,16 @@ while true; do
   if probe; then
     echo "[tpu_watch] $(date -u +%FT%TZ) tunnel UP — capturing" >> bench_tpu/watch.log
     # Cheapest first so a short tunnel window still yields evidence;
-    # scenario 2 doubles as the TPU compile-cache warmer.
-    capture 2 3600 && \
-    capture 1 1800 && \
-    capture 5 2400 && \
-    capture 3 5400 && \
-    capture 4 5400
+    # scenario 2 doubles as the TPU compile-cache warmer. Each capture is
+    # independent (a scenario-specific failure must not starve the rest),
+    # but re-probe between them so a dead tunnel short-circuits the ladder.
+    for n in 2 1 5 3 4; do
+      probe || break
+      case "$n" in
+        2) tmo=3600 ;; 1) tmo=1800 ;; 5) tmo=2400 ;; *) tmo=5400 ;;
+      esac
+      capture "$n" "$tmo"
+    done
     # Tunnel still up? Re-run the headline scenarios warm (cache now hot).
     if probe; then
       capture 2 1200
