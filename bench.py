@@ -219,7 +219,23 @@ def build_flat_direct(num_brokers: int, num_partitions: int, rf: int,
     return model, metadata
 
 
-def run_scale_scenario(n: int):
+def _make_mesh(n: int):
+    """Build an n-device mesh for the optimizer (0/absent -> no mesh).
+    On the single real TPU chip this is a 1-device mesh (a no-op layout);
+    correctness of the >1-device path is covered on the virtual 8-CPU mesh
+    (tests/test_parallel.py + dryrun_multichip)."""
+    if not n:
+        return None
+    import jax
+    from cruise_control_tpu.parallel import make_mesh
+    n = min(n, len(jax.devices()))
+    mesh = make_mesh(n)
+    log(f"  mesh: {dict(mesh.shape)} over {mesh.devices.size} "
+        f"{jax.devices()[0].platform} device(s)")
+    return mesh
+
+
+def run_scale_scenario(n: int, mesh_devices: int = 0):
     """Scenario #3/#4: wall-clock of a full proposal computation at scale,
     plus the dense-ingest throughput feeding it."""
     from cruise_control_tpu.analyzer import (OptimizationOptions,
@@ -260,7 +276,8 @@ def run_scale_scenario(n: int):
         config=SearchConfig(num_replica_candidates=k,
                             num_dest_candidates=16, apply_per_iter=k,
                             drain_batch=drain, drain_rounds=8,
-                            max_iters_per_goal=512))
+                            max_iters_per_goal=512),
+        mesh=_make_mesh(mesh_devices))
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(seed=0))
     cold = time.monotonic() - t0
@@ -277,7 +294,7 @@ def run_scale_scenario(n: int):
          round(cfgd["target_s"] / warm, 3) if warm > 0 else None)
 
 
-def run_replan_scenario(num_requests: int = 30):
+def run_replan_scenario(num_requests: int = 30, mesh_devices: int = 0):
     """Scenario #5: self-healing replans at 1 req/s — each request marks a
     random broker dead and recomputes proposals (fast mode, the
     self-healing path); reports p99 latency against the 1 s sustainable-
@@ -291,7 +308,8 @@ def run_replan_scenario(num_requests: int = 30):
         goals=goals_by_name(GOALS),
         config=SearchConfig(num_replica_candidates=512,
                             num_dest_candidates=16, apply_per_iter=512,
-                            max_iters_per_goal=256))
+                            max_iters_per_goal=256),
+        mesh=_make_mesh(mesh_devices))
     # Warm the compiled chain once (a live server has it warm already).
     opt.optimize(model, md, OptimizationOptions(seed=0, fast_mode=True,
                                                 skip_hard_goal_check=True))
@@ -371,6 +389,9 @@ def main():
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99)")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard the optimizer over an N-device mesh "
+                         "(clamped to available devices; 0 = unsharded)")
     args = ap.parse_args()
     # Probe the default backend in a subprocess first: when the TPU tunnel is
     # down, jax.devices() would otherwise hang/crash the whole bench. Falls
@@ -381,11 +402,14 @@ def main():
     if args.scenario != 2:
         log(f"platform: {platform} -> {jax.devices()[0].platform}")
         if args.scenario == 1:
+            if args.mesh:
+                log("--mesh is ignored for scenario 1: the demo drives the "
+                    "stock served path (facade-owned optimizer)")
             run_demo_scenario()
         elif args.scenario == 5:
-            run_replan_scenario()
+            run_replan_scenario(mesh_devices=args.mesh)
         else:
-            run_scale_scenario(args.scenario)
+            run_scale_scenario(args.scenario, mesh_devices=args.mesh)
         return
     from cruise_control_tpu.analyzer import (OptimizationOptions, SearchConfig,
                                              TpuGoalOptimizer, goals_by_name)
@@ -402,7 +426,8 @@ def main():
     opt = TpuGoalOptimizer(
         goals=goals_by_name(GOALS),
         config=SearchConfig(num_replica_candidates=512, num_dest_candidates=16,
-                            apply_per_iter=512, max_iters_per_goal=512))
+                            apply_per_iter=512, max_iters_per_goal=512),
+        mesh=_make_mesh(args.mesh))
 
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(seed=0))

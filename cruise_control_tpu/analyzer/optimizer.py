@@ -108,11 +108,19 @@ class TpuGoalOptimizer:
                  constraint: BalancingConstraint | None = None,
                  config: SearchConfig | None = None,
                  options_generator=None,
-                 registry=None):
+                 registry=None,
+                 mesh=None):
         from ..core.sensors import (GOAL_OPTIMIZER_SENSOR, MetricRegistry)
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
+        #: optional jax.sharding.Mesh: when set, every optimize()/warmup()
+        #: places the model on the mesh (partition axis sharded, broker
+        #: axis replicated — parallel/sharding.py layout) and the jitted
+        #: goal passes partition via GSPMD, with the per-iteration broker
+        #: aggregate riding an ICI all-reduce. Single-device meshes are a
+        #: no-op, so the served path can always be constructed with one.
+        self.mesh = mesh
         #: OptimizationOptionsGenerator plugin applied to every run's
         #: options inside _prepare — the single choke point, so the
         #: proposal cache and the goal-violation detector (which call
@@ -128,7 +136,12 @@ class TpuGoalOptimizer:
 
     def _chain_for(self, cfg: SearchConfig, goals: list[GoalKernel]
                    ) -> CompiledGoalChain:
-        key = (cfg, tuple(g.bind_signature() for g in goals))
+        # Mesh identity in the key: the same chain object jit-caches per
+        # input sharding, but warmup events are keyed by *shape* signature
+        # — a chain warmed unsharded must not satisfy a sharded warmup.
+        mesh_key = (None if self.mesh is None
+                    else tuple(str(d) for d in self.mesh.devices.flat))
+        key = (cfg, tuple(g.bind_signature() for g in goals), mesh_key)
         # Locked get-or-create: optimizers are shared across request threads
         # (facade memoization), and two racing first requests must converge
         # on ONE chain object — CompiledGoalChain.warmup coalesces compiles
@@ -147,6 +160,12 @@ class TpuGoalOptimizer:
         exactly the chain a matching optimize() will run."""
         if self.options_generator is not None:
             options = self.options_generator.generate(options, metadata)
+        if self.mesh is not None:
+            # Compute follows data: sharding the model here is all GSPMD
+            # needs — ctx/state derive from model arrays and inherit the
+            # layout; the jitted passes partition automatically.
+            from ..parallel.sharding import shard_model
+            model = shard_model(model, self.mesh)
         P = model.num_partitions_padded
         B = model.num_brokers_padded
         cfg = self.config.scaled_for(metadata.num_partitions,

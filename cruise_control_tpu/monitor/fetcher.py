@@ -51,6 +51,12 @@ class MetricFetcherManager:
         """
         parallel_safe = getattr(self.sampler, "parallel_safe", False)
         n = self.num_fetchers if parallel_safe else 1
+        # Two-phase samplers (the agent-topic path) isolate their
+        # cross-partition state once per round so the per-shard calls
+        # below are pure reads.
+        prepare = getattr(self.sampler, "prepare_round", None)
+        if prepare is not None:
+            prepare(start_ms, end_ms)
         shard_parts = self.assignor.assign(partitions, n)
         shards = [SamplerAssignment(partitions=shard_parts[i],
                                     brokers=(brokers if i == 0 else []),

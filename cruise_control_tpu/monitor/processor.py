@@ -42,6 +42,39 @@ class _BrokerLoad:
     partition_sizes: dict[tuple[str, int], float] = field(default_factory=dict)
 
 
+@dataclass
+class _TopicGroup:
+    """One (broker, topic) attribution group: everything emit() needs to
+    score one partition in O(1) (sizes/topic totals/CPU denominators,
+    computed once per round in prepare())."""
+
+    time_ms: int
+    sizes: dict[tuple[str, int], float]
+    total_size: float
+    num_tps: int
+    t_in: float
+    t_out: float
+    t_msg: float
+    broker_cpu: float
+    denom: float
+    disk_by_tp: dict[tuple[str, int], float]
+
+
+@dataclass
+class PreparedRound:
+    """One sampling round's folded per-broker state (output of
+    :meth:`CruiseControlMetricsProcessor.prepare`): immutable by contract —
+    fetcher shards read it concurrently. ``tp_group`` indexes every
+    attributable partition to its (broker, topic) group so per-shard
+    emission is O(shard size), not O(cluster size)."""
+
+    loads: dict[int, _BrokerLoad]
+    times: dict[int, int]
+    leader_of: dict[tuple[str, int], int] | None
+    groups: dict[tuple[int, str], _TopicGroup]
+    tp_group: dict[tuple[str, int], tuple[int, str]]
+
+
 class CruiseControlMetricsProcessor:
     def __init__(self, metadata_source=None, cpu_model=None) -> None:
         """``metadata_source``: optional admin client
@@ -64,13 +97,17 @@ class CruiseControlMetricsProcessor:
     def add_metrics(self, records: list[CruiseControlMetric]) -> None:
         self._records.extend(records)
 
-    def process(self, assignment: SamplerAssignment) -> Samples:
-        """Convert buffered records into samples for the assignment window
-        (ref CruiseControlMetricsProcessor.process). Clears the buffer."""
+    def prepare(self, start_ms: int, end_ms: int) -> "PreparedRound":
+        """Fold buffered records into per-broker loads for one window —
+        the cross-partition/cross-broker half of processing, done ONCE per
+        sampling round so :meth:`emit` can fan out over partition shards
+        (ref ``MetricFetcherManager.java:37``: the reference parallelizes
+        the sampler fetch; here the shared state is isolated first so the
+        per-shard attribution is a pure read). Clears the buffer."""
         loads: dict[int, _BrokerLoad] = defaultdict(_BrokerLoad)
         times: dict[int, int] = {}
         for r in self._records:
-            if not (assignment.start_ms <= r.time_ms < assignment.end_ms):
+            if not (start_ms <= r.time_ms < end_ms):
                 continue
             bl = loads[r.broker_id]
             times[r.broker_id] = max(times.get(r.broker_id, 0), r.time_ms)
@@ -82,15 +119,11 @@ class CruiseControlMetricsProcessor:
                 bl.partition_sizes[(r.topic, r.partition)] = r.value
         self._records.clear()
 
-        wanted = set(assignment.partitions)
         leader_of: dict[tuple[str, int], int] | None = None
         if self._metadata_source is not None:
             leader_of = {tp: info.leader for tp, info in
                          self._metadata_source.describe_partitions().items()}
-        psamples: list[PartitionMetricSample] = []
-        bsamples: list[BrokerMetricSample] = []
-        for broker_id, bl in loads.items():
-            t = times[broker_id]
+        for bl in loads.values():
             # Missing broker CPU: estimate from byte rates via the trained
             # regression (TRAIN endpoint) rather than defaulting to 0 —
             # both the broker sample and the per-partition CPU attribution
@@ -104,10 +137,102 @@ class CruiseControlMetricsProcessor:
                                           0.0))
                 if est is not None:
                     bl.broker_metrics[RawMetricType.BROKER_CPU_UTIL] = est
-            bsamples.append(self._broker_sample(broker_id, t, bl))
-            psamples.extend(self._partition_samples(broker_id, t, bl, wanted,
-                                                    leader_of))
+
+        # Per-(broker, topic) attribution groups — the cross-partition
+        # half of partition-sample attribution, done once per round so emit() costs
+        # O(shard) regardless of fan-out width.
+        groups: dict[tuple[int, str], _TopicGroup] = {}
+        tp_group: dict[tuple[str, int], tuple[int, str]] = {}
+        for broker_id, bl in loads.items():
+            t = times[broker_id]
+            broker_cpu = bl.broker_metrics.get(
+                RawMetricType.BROKER_CPU_UTIL, DEFAULT_CPU_UTIL_FOR_MISSING)
+            tot_in = bl.broker_metrics.get(
+                RawMetricType.ALL_TOPIC_BYTES_IN, 0.0)
+            tot_out = bl.broker_metrics.get(
+                RawMetricType.ALL_TOPIC_BYTES_OUT, 0.0)
+            by_topic: dict[str, list[tuple[str, int]]] = defaultdict(list)
+            for tp in bl.partition_sizes:
+                if leader_of is not None and leader_of.get(tp) != broker_id:
+                    continue
+                by_topic[tp[0]].append(tp)
+            for topic, tms in bl.topic_metrics.items():
+                tps = by_topic.get(topic, [])
+                if not tps:
+                    continue
+                sizes = {tp: max(bl.partition_sizes.get(tp, 0.0), 0.0)
+                         for tp in tps}
+                g = _TopicGroup(
+                    time_ms=t, sizes=sizes,
+                    total_size=sum(sizes.values()), num_tps=len(tps),
+                    t_in=tms.get(RawMetricType.TOPIC_BYTES_IN, 0.0),
+                    t_out=tms.get(RawMetricType.TOPIC_BYTES_OUT, 0.0),
+                    t_msg=tms.get(RawMetricType.TOPIC_MESSAGES_IN_PER_SEC,
+                                  0.0),
+                    broker_cpu=broker_cpu, denom=tot_in + tot_out,
+                    disk_by_tp={tp: bl.partition_sizes.get(tp, 0.0)
+                                for tp in tps})
+                groups[(broker_id, topic)] = g
+                for tp in tps:
+                    tp_group[tp] = (broker_id, topic)
+        return PreparedRound(loads=loads, times=times, leader_of=leader_of,
+                             groups=groups, tp_group=tp_group)
+
+    def emit(self, prepared: "PreparedRound",
+             assignment: SamplerAssignment, *,
+             include_brokers: bool | None = None,
+             empty_assignment_means_all: bool = False) -> Samples:
+        """Samples for one shard of a prepared round. Pure read of
+        ``prepared`` — safe to call concurrently from fetcher threads on
+        disjoint partition shards, and O(shard size): each wanted
+        partition is an index lookup into the prepared attribution groups.
+        Broker samples are emitted only for the shard that carries the
+        broker assignment (exactly one per round), unless
+        ``include_brokers`` forces it. An EMPTY shard emits nothing
+        (``empty_assignment_means_all`` restores the single-shot
+        "no filter = everything" contract for :meth:`process`)."""
+        if include_brokers is None:
+            include_brokers = bool(assignment.brokers)
+        if assignment.partitions:
+            wanted = assignment.partitions
+        elif empty_assignment_means_all:
+            wanted = list(prepared.tp_group)
+        else:
+            wanted = []
+        psamples: list[PartitionMetricSample] = []
+        bsamples: list[BrokerMetricSample] = []
+        if include_brokers:
+            for broker_id, bl in prepared.loads.items():
+                bsamples.append(self._broker_sample(
+                    broker_id, prepared.times[broker_id], bl))
+        for tp in wanted:
+            gkey = prepared.tp_group.get(tp)
+            if gkey is None:
+                continue
+            g = prepared.groups[gkey]
+            share = (g.sizes[tp] / g.total_size if g.total_size > 0
+                     else 1.0 / g.num_tps)
+            p_in = g.t_in * share
+            p_out = g.t_out * share
+            s = PartitionMetricSample(tp[0], tp[1], g.time_ms)
+            s.record(KafkaMetric.LEADER_BYTES_IN, p_in)
+            s.record(KafkaMetric.LEADER_BYTES_OUT, p_out)
+            s.record(KafkaMetric.DISK_USAGE, g.disk_by_tp.get(tp, 0.0))
+            s.record(KafkaMetric.MESSAGE_IN_RATE, g.t_msg * share)
+            # CPU attribution: broker CPU x partition share of broker
+            # leader bytes (ref ModelUtils.estimateLeaderCpuUtil).
+            cpu_share = (p_in + p_out) / g.denom if g.denom > 0 else 0.0
+            s.record(KafkaMetric.CPU_USAGE, g.broker_cpu * cpu_share)
+            psamples.append(s)
         return Samples(psamples, bsamples)
+
+    def process(self, assignment: SamplerAssignment) -> Samples:
+        """Convert buffered records into samples for the assignment window
+        (ref CruiseControlMetricsProcessor.process). Clears the buffer.
+        Single-shot equivalent of :meth:`prepare` + :meth:`emit`."""
+        prepared = self.prepare(assignment.start_ms, assignment.end_ms)
+        return self.emit(prepared, assignment, include_brokers=True,
+                         empty_assignment_means_all=True)
 
     def _broker_sample(self, broker_id: int, t: int,
                        bl: _BrokerLoad) -> BrokerMetricSample:
@@ -139,53 +264,3 @@ class CruiseControlMetricsProcessor:
         s.record(BrokerMetric.DISK_USAGE, sum(bl.partition_sizes.values()))
         return s
 
-    def _partition_samples(self, broker_id: int, t: int, bl: _BrokerLoad,
-                           wanted: set[tuple[str, int]],
-                           leader_of: dict[tuple[str, int], int] | None
-                           ) -> list[PartitionMetricSample]:
-        """Per-leader-partition samples with CPU attribution (ref
-        SamplingUtils.estimateLeaderCpuUtilPerCore)."""
-        broker_cpu = bl.broker_metrics.get(RawMetricType.BROKER_CPU_UTIL,
-                                           DEFAULT_CPU_UTIL_FOR_MISSING)
-        tot_in = bl.broker_metrics.get(RawMetricType.ALL_TOPIC_BYTES_IN, 0.0)
-        tot_out = bl.broker_metrics.get(RawMetricType.ALL_TOPIC_BYTES_OUT, 0.0)
-        denom = tot_in + tot_out
-
-        # Partition share of its topic's (per-broker) bytes: by size when
-        # known, else uniform — across the topic's partitions this broker
-        # LEADS (when metadata is available); the topic byte metrics only
-        # cover led partitions, so followers must not dilute the split.
-        by_topic: dict[str, list[tuple[str, int]]] = defaultdict(list)
-        for tp in bl.partition_sizes:
-            if leader_of is not None and leader_of.get(tp) != broker_id:
-                continue
-            by_topic[tp[0]].append(tp)
-        out: list[PartitionMetricSample] = []
-        for topic, tms in bl.topic_metrics.items():
-            tps = by_topic.get(topic, [])
-            if not tps:
-                continue
-            sizes = {tp: max(bl.partition_sizes.get(tp, 0.0), 0.0)
-                     for tp in tps}
-            total_size = sum(sizes.values())
-            t_in = tms.get(RawMetricType.TOPIC_BYTES_IN, 0.0)
-            t_out = tms.get(RawMetricType.TOPIC_BYTES_OUT, 0.0)
-            t_msg = tms.get(RawMetricType.TOPIC_MESSAGES_IN_PER_SEC, 0.0)
-            for tp in tps:
-                if wanted and tp not in wanted:
-                    continue
-                share = (sizes[tp] / total_size if total_size > 0
-                         else 1.0 / len(tps))
-                p_in = t_in * share
-                p_out = t_out * share
-                s = PartitionMetricSample(tp[0], tp[1], t)
-                s.record(KafkaMetric.LEADER_BYTES_IN, p_in)
-                s.record(KafkaMetric.LEADER_BYTES_OUT, p_out)
-                s.record(KafkaMetric.DISK_USAGE, bl.partition_sizes.get(tp, 0.0))
-                s.record(KafkaMetric.MESSAGE_IN_RATE, t_msg * share)
-                # CPU attribution: broker CPU x partition share of broker
-                # leader bytes (ref ModelUtils.estimateLeaderCpuUtil).
-                cpu_share = (p_in + p_out) / denom if denom > 0 else 0.0
-                s.record(KafkaMetric.CPU_USAGE, broker_cpu * cpu_share)
-                out.append(s)
-        return out

@@ -149,13 +149,39 @@ class SyntheticWorkloadSampler:
 class AgentTopicSampler:
     """Consume the L0 reporter agent's raw metric records and convert them to
     samples via the processor (ref CruiseControlMetricsReporterSampler.java:35
-    polling the ``__CruiseControlMetrics`` topic at ``:93``)."""
+    polling the ``__CruiseControlMetrics`` topic at ``:93``).
+
+    Parallel-safe via the two-phase protocol (the flagship ingestion path
+    must fan out like the reference's fetcher threads,
+    ``MetricFetcherManager.java:37``): the fetcher manager calls
+    :meth:`prepare_round` once per round — one transport poll, one
+    cross-broker fold in the processor — then ``get_samples`` per shard is
+    a pure read over the prepared state, so N fetchers attribute N
+    disjoint partition shards concurrently without double-counting broker
+    or topic aggregates."""
+
+    parallel_safe = True
 
     def __init__(self, transport, processor):
         self.transport = transport
         self.processor = processor
+        self._round = None
+        self._round_window: tuple[int, int] | None = None
+
+    def prepare_round(self, start_ms: int, end_ms: int) -> None:
+        records = self.transport.poll(start_ms, end_ms)
+        self.processor.add_metrics(records)
+        self._round = self.processor.prepare(start_ms, end_ms)
+        self._round_window = (start_ms, end_ms)
 
     def get_samples(self, assignment: SamplerAssignment) -> Samples:
-        records = self.transport.poll(assignment.start_ms, assignment.end_ms)
-        self.processor.add_metrics(records)
-        return self.processor.process(assignment)
+        window = (assignment.start_ms, assignment.end_ms)
+        if self._round is None or self._round_window != window:
+            # Direct (manager-less) use, or a window the manager never
+            # prepared: single-shot serial processing — never serve a
+            # stale round's samples for a different window.
+            records = self.transport.poll(assignment.start_ms,
+                                          assignment.end_ms)
+            self.processor.add_metrics(records)
+            return self.processor.process(assignment)
+        return self.processor.emit(self._round, assignment)

@@ -213,15 +213,32 @@ class _AgentPipelineSampler:
     path (a real deployment's agents run inside the brokers; here the
     sampling tick doubles as the reporting tick)."""
 
+    #: forwards the inner AgentTopicSampler's two-phase protocol so the
+    #: fetcher manager's shard fan-out applies to the served path too.
+    parallel_safe = True
+
     def __init__(self, agents, inner):
         self.agents = agents
         self.inner = inner
+        self._prepared_window: tuple[int, int] | None = None
 
-    def get_samples(self, assignment):
+    def prepare_round(self, start_ms: int, end_ms: int) -> None:
         for a in self.agents:
             # end_ms is exclusive in the processor's window filter; stamp
-            # the records just inside it.
-            a.maybe_report(assignment.end_ms - 1)
+            # the records just inside it. Reporting happens once per ROUND
+            # (here), never per shard — per-shard reporting would duplicate
+            # every record under fan-out.
+            a.maybe_report(end_ms - 1)
+        self.inner.prepare_round(start_ms, end_ms)
+        self._prepared_window = (start_ms, end_ms)
+
+    def get_samples(self, assignment):
+        if self._prepared_window != (assignment.start_ms,
+                                     assignment.end_ms):
+            # Direct (manager-less) call: reporting still has to happen
+            # before the inner sampler's serial fallback polls.
+            for a in self.agents:
+                a.maybe_report(assignment.end_ms - 1)
         return self.inner.get_samples(assignment)
 
 

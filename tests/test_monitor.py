@@ -385,3 +385,97 @@ def test_disk_scores_latest_window_not_average():
     res = opt.optimize(result.model, result.metadata,
                        OptimizationOptions(skip_hard_goal_check=True))
     assert res.goal_results[0].violation_before > 0.0
+
+
+def _agent_stack(num_brokers=3, partitions=12):
+    sim = make_cluster(num_brokers=num_brokers, partitions=partitions)
+    rates = {tp: (100.0 * (tp[1] + 1), 50.0)
+             for tp in sim.describe_partitions()}
+    source = SimClusterMetricsSource(sim, rates)
+    transport = MetricsTransport()
+    agents = [MetricsReporterAgent(b, source, transport,
+                                   reporting_interval_ms=WINDOW_MS)
+              for b in sorted(sim.describe_cluster())]
+    return sim, transport, agents
+
+
+def _sample_key(s):
+    return (s.topic, s.partition, s.time_ms,
+            tuple(sorted((k, round(v, 9)) for k, v in s.values.items())))
+
+
+def test_agent_sampler_parallel_fanout_matches_serial():
+    """The flagship agent-topic sampler is parallel_safe (VERDICT r3 #7 /
+    MetricFetcherManager.java:37): N fetcher shards must produce exactly
+    the serial sample set — no double-counted broker/topic aggregates, no
+    duplicated broker samples, no dropped partitions."""
+    sim, transport, agents = _agent_stack()
+    partitions = sorted(sim.describe_partitions())
+    brokers = sorted(sim.describe_cluster())
+    t = WINDOW_MS - 2
+    for a in agents:
+        a.maybe_report(t)
+
+    def run(num_fetchers):
+        sampler = AgentTopicSampler(transport,
+                                    CruiseControlMetricsProcessor(sim))
+        fetcher = MetricFetcherManager(sampler, num_fetchers=num_fetchers)
+        return fetcher.fetch(partitions, brokers, t - 1, t + 1)
+
+    serial, fanned = run(1), run(4)
+    assert sorted(map(_sample_key, fanned.partition_samples)) == \
+        sorted(map(_sample_key, serial.partition_samples))
+    assert len(serial.partition_samples) > 0
+    # Exactly one broker sample per broker either way.
+    for got in (serial, fanned):
+        ids = [b.broker_id for b in got.broker_samples]
+        assert sorted(ids) == brokers
+
+
+def test_agent_sampler_fanout_scales_with_num_fetchers():
+    """Ingest wall-clock scales with num.metric.fetchers when the
+    per-shard attribution blocks (remote metadata / store I/O — the
+    regime the reference's fetcher pool exists for): 4 fetchers over a
+    4-shard round must beat the serial sum by ~the shard count."""
+    import time as _time
+    sim, transport, agents = _agent_stack()
+    partitions = sorted(sim.describe_partitions())
+    brokers = sorted(sim.describe_cluster())
+    t = WINDOW_MS - 2
+    for a in agents:
+        a.maybe_report(t)
+
+    class BlockingEmitProcessor(CruiseControlMetricsProcessor):
+        def emit(self, prepared, assignment, **kw):
+            _time.sleep(0.15)     # stand-in for per-shard blocking I/O
+            return super().emit(prepared, assignment, **kw)
+
+    def timed(num_fetchers):
+        sampler = AgentTopicSampler(transport, BlockingEmitProcessor(sim))
+        fetcher = MetricFetcherManager(sampler, num_fetchers=num_fetchers)
+        t0 = _time.monotonic()
+        fetcher.fetch(partitions, brokers, t - 1, t + 1)
+        return _time.monotonic() - t0
+
+    serial_4_rounds = 4 * 0.15
+    fanned = timed(4)
+    assert fanned < serial_4_rounds * 0.67, (
+        f"4-way fan-out took {fanned:.2f}s vs serial ~{serial_4_rounds}s")
+
+
+def test_agent_sampler_more_fetchers_than_partitions_no_duplicates():
+    """An empty fetcher shard must emit NOTHING — more fetchers than
+    partitions must not duplicate samples (empty 'wanted' previously meant
+    'everything' in the single-shot path)."""
+    sim, transport, agents = _agent_stack(num_brokers=3, partitions=3)
+    partitions = sorted(sim.describe_partitions())
+    brokers = sorted(sim.describe_cluster())
+    t = WINDOW_MS - 2
+    for a in agents:
+        a.maybe_report(t)
+    sampler = AgentTopicSampler(transport, CruiseControlMetricsProcessor(sim))
+    fetcher = MetricFetcherManager(sampler, num_fetchers=8)
+    got = fetcher.fetch(partitions, brokers, t - 1, t + 1)
+    keys = [(s.topic, s.partition) for s in got.partition_samples]
+    assert len(keys) == len(set(keys)), f"duplicated samples: {sorted(keys)}"
+    assert sorted(b.broker_id for b in got.broker_samples) == brokers

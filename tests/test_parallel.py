@@ -94,3 +94,25 @@ def test_branched_search_selects_best_and_is_deterministic():
     final = to_model(best_state, model)
     assert all(int(x) == 0 for x in np.asarray(
         list(sanity_check(final).values())))
+
+
+def test_meshed_optimizer_full_loop_residual_parity():
+    """TpuGoalOptimizer(mesh=...) — the served/bench path with a real mesh:
+    the FULL optimize loop (convergence, polish passes, proposals) on the
+    8-device CPU mesh must converge to the same residual as the
+    single-device optimizer and produce a consistent model."""
+    from cruise_control_tpu.analyzer import TpuGoalOptimizer
+    model, md = _model(partitions=512, brokers=8)
+    goals = goals_by_name(GOALS)
+    single = TpuGoalOptimizer(goals=goals, config=CFG).optimize(model, md)
+    mesh = make_mesh(8)
+    meshed = TpuGoalOptimizer(goals=goals, config=CFG, mesh=mesh
+                              ).optimize(model, md)
+    assert meshed.num_moves > 0
+    assert all(v == 0 for v in sanity_check(meshed.final_model).values())
+    for g_single, g_mesh in zip(single.goal_results, meshed.goal_results):
+        assert g_mesh.violation_after <= (
+            g_single.violation_after * 1.05 + 1e-6), (
+            g_mesh.name, g_mesh.violation_after, g_single.violation_after)
+    # Proposals from the sharded run round-trip like any other result.
+    assert len(meshed.proposals) > 0
