@@ -156,27 +156,31 @@ def residual(util, counts, nb, threshold=1.10):
 
 
 def build_flat_direct(num_brokers: int, num_partitions: int, rf: int,
-                      seed: int = 42):
+                      seed: int = 42, place_on: int | None = None):
     """Array-native model construction for the scale scenarios — no
     per-partition Python objects (1M PartitionSpecs would dominate the
-    run). Skewed like build_spec: half the partitions crowd 20% of brokers."""
+    run). Skewed like build_spec: half the partitions crowd 20% of brokers.
+    ``place_on`` restricts the initial placement to the first N brokers
+    (the add-brokers variant: the rest exist empty and NEW)."""
     import jax.numpy as jnp
     from cruise_control_tpu.model.flat import FlatClusterModel
     from cruise_control_tpu.model.spec import ClusterMetadata, _round_up
     rng = np.random.default_rng(seed)
     P, B = num_partitions, num_brokers
+    placeB = min(place_on or B, B)
     Ppad, Bpad = _round_up(P, 128), _round_up(B, 8)
-    hot = B // 5
+    hot = placeB // 5
     base = rng.integers(0, hot, size=P)
-    cold = rng.integers(0, B, size=P)
+    cold = rng.integers(0, placeB, size=P)
     first = np.where(np.arange(P) % 2 == 0, base, cold).astype(np.int64)
-    # Offsets bounded so cumulative sums stay < B: every partial sum is
-    # distinct and nonzero mod B, i.e. no duplicate brokers at any rf.
-    step_cap = max((B - 1) // max(rf - 1, 1), 2)
+    # Offsets bounded so cumulative sums stay < placeB: every partial sum
+    # is distinct and nonzero mod placeB, i.e. no duplicate brokers at
+    # any rf.
+    step_cap = max((placeB - 1) // max(rf - 1, 1), 2)
     offsets = rng.integers(1, step_cap, size=(P, rf - 1)).cumsum(axis=1)
     rb = np.full((Ppad, rf), Bpad, np.int32)
     rb[:P, 0] = first
-    rb[:P, 1:] = (first[:, None] + offsets) % B
+    rb[:P, 1:] = (first[:, None] + offsets) % placeB
     lead = np.zeros((Ppad, 4), np.float32)
     lead[:P] = np.column_stack([
         0.02 + 0.02 * rng.random(P), 5 + 10 * rng.random(P),
@@ -235,9 +239,21 @@ def _make_mesh(n: int):
     return mesh
 
 
-def run_scale_scenario(n: int, mesh_devices: int = 0):
+def run_scale_scenario(n: int, mesh_devices: int = 0,
+                       variant: str = "rebalance"):
     """Scenario #3/#4: wall-clock of a full proposal computation at scale,
-    plus the dense-ingest throughput feeding it."""
+    plus the dense-ingest throughput feeding it.
+
+    ``variant`` (BASELINE.md row 4 names the add/remove-broker scenarios):
+
+    - ``rebalance`` — skewed placement, steady-state rebalance;
+    - ``add_brokers`` — placement crowds the first 95% of brokers, the
+      last 5% join empty and NEW (ref AddBrokerRunnable: proposals flow
+      onto the new capacity);
+    - ``remove_brokers`` — 1% of brokers marked dead: every replica they
+      host is a must-move (ref RemoveBrokerRunnable / broker-failure
+      self-healing drain).
+    """
     from cruise_control_tpu.analyzer import (OptimizationOptions,
                                              SearchConfig, TpuGoalOptimizer,
                                              goals_by_name)
@@ -245,10 +261,26 @@ def run_scale_scenario(n: int, mesh_devices: int = 0):
     from cruise_control_tpu.core.metricdef import partition_metric_def
     cfgd = SCALE_SCENARIOS[n]
     t0 = time.monotonic()
-    model, md = build_flat_direct(cfgd["brokers"], cfgd["partitions"],
-                                  cfgd["rf"])
-    log(f"scenario {n}: build {time.monotonic() - t0:.1f}s "
-        f"({cfgd['brokers']} brokers, {cfgd['partitions']} partitions)")
+    B = cfgd["brokers"]
+    n_new = max(B // 20, 1) if variant == "add_brokers" else 0
+    model, md = build_flat_direct(B, cfgd["partitions"], cfgd["rf"],
+                                  place_on=(B - n_new) or None)
+    if variant == "add_brokers":
+        import jax.numpy as jnp
+        new_mask = np.zeros(model.num_brokers_padded, bool)
+        new_mask[B - n_new:B] = True
+        model = model.replace(broker_new=jnp.asarray(new_mask))
+    elif variant == "remove_brokers":
+        import jax.numpy as jnp
+        alive = np.asarray(model.broker_alive).copy()
+        dead = np.random.default_rng(7).choice(B, size=max(B // 100, 1),
+                                               replace=False)
+        alive[dead] = False
+        model = model.replace(broker_alive=jnp.asarray(alive))
+    log(f"scenario {n} [{variant}]: build {time.monotonic() - t0:.1f}s "
+        f"({B} brokers, {cfgd['partitions']} partitions"
+        + (f", +{n_new} new" if variant == "add_brokers" else "")
+        + (", 1% dead" if variant == "remove_brokers" else "") + ")")
 
     # Ingest throughput: one full round of per-partition samples through the
     # dense aggregator path (the monitor-side cost of a sampling interval).
@@ -290,7 +322,9 @@ def run_scale_scenario(n: int, mesh_devices: int = 0):
         log(f"    {g.name:42s} {g.violation_before:14.1f} -> "
             f"{g.violation_after:12.1f} iters={g.iterations} "
             f"({g.duration_s:.2f}s)")
-    emit(cfgd["metric"], round(warm, 3), "s",
+    metric = cfgd["metric"] + ("" if variant == "rebalance"
+                               else f"_{variant}")
+    emit(metric, round(warm, 3), "s",
          round(cfgd["target_s"] / warm, 3) if warm > 0 else None)
 
 
@@ -392,7 +426,13 @@ def main():
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded)")
+    ap.add_argument("--variant", default="rebalance",
+                    choices=("rebalance", "add_brokers", "remove_brokers"),
+                    help="scale-scenario variant (scenarios 3/4; "
+                         "BASELINE.md row 4 add/remove-broker scenarios)")
     args = ap.parse_args()
+    if args.variant != "rebalance" and args.scenario == 2:
+        log(f"--variant {args.variant} is ignored for scenario 2")
     # Probe the default backend in a subprocess first: when the TPU tunnel is
     # down, jax.devices() would otherwise hang/crash the whole bench. Falls
     # back to CPU and still emits the JSON line (platform is logged).
@@ -401,6 +441,10 @@ def main():
     import jax
     if args.scenario != 2:
         log(f"platform: {platform} -> {jax.devices()[0].platform}")
+        if args.variant != "rebalance" and args.scenario not in (3, 4):
+            log(f"--variant {args.variant} is ignored for scenario "
+                f"{args.scenario}: variants exist for the scale "
+                "scenarios (3/4) only")
         if args.scenario == 1:
             if args.mesh:
                 log("--mesh is ignored for scenario 1: the demo drives the "
@@ -409,7 +453,8 @@ def main():
         elif args.scenario == 5:
             run_replan_scenario(mesh_devices=args.mesh)
         else:
-            run_scale_scenario(args.scenario, mesh_devices=args.mesh)
+            run_scale_scenario(args.scenario, mesh_devices=args.mesh,
+                               variant=args.variant)
         return
     from cruise_control_tpu.analyzer import (OptimizationOptions, SearchConfig,
                                              TpuGoalOptimizer, goals_by_name)

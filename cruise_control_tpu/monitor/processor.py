@@ -57,7 +57,6 @@ class _TopicGroup:
     t_msg: float
     broker_cpu: float
     denom: float
-    disk_by_tp: dict[tuple[str, int], float]
 
 
 @dataclass
@@ -72,7 +71,12 @@ class PreparedRound:
     times: dict[int, int]
     leader_of: dict[tuple[str, int], int] | None
     groups: dict[tuple[int, str], _TopicGroup]
-    tp_group: dict[tuple[str, int], tuple[int, str]]
+    #: tp -> every (broker, topic) group that attributes it. With
+    #: leadership metadata this is exactly the leader's group; without it,
+    #: one group per hosting broker that reported topic metrics (the
+    #: pre-fan-out behavior: each hosting broker's view lands in the
+    #: aggregator and is averaged within the window).
+    tp_groups: dict[tuple[str, int], list[tuple[int, str]]]
 
 
 class CruiseControlMetricsProcessor:
@@ -142,7 +146,7 @@ class CruiseControlMetricsProcessor:
         # half of partition-sample attribution, done once per round so emit() costs
         # O(shard) regardless of fan-out width.
         groups: dict[tuple[int, str], _TopicGroup] = {}
-        tp_group: dict[tuple[str, int], tuple[int, str]] = {}
+        tp_groups: dict[tuple[str, int], list[tuple[int, str]]] = {}
         for broker_id, bl in loads.items():
             t = times[broker_id]
             broker_cpu = bl.broker_metrics.get(
@@ -169,14 +173,12 @@ class CruiseControlMetricsProcessor:
                     t_out=tms.get(RawMetricType.TOPIC_BYTES_OUT, 0.0),
                     t_msg=tms.get(RawMetricType.TOPIC_MESSAGES_IN_PER_SEC,
                                   0.0),
-                    broker_cpu=broker_cpu, denom=tot_in + tot_out,
-                    disk_by_tp={tp: bl.partition_sizes.get(tp, 0.0)
-                                for tp in tps})
+                    broker_cpu=broker_cpu, denom=tot_in + tot_out)
                 groups[(broker_id, topic)] = g
                 for tp in tps:
-                    tp_group[tp] = (broker_id, topic)
+                    tp_groups.setdefault(tp, []).append((broker_id, topic))
         return PreparedRound(loads=loads, times=times, leader_of=leader_of,
-                             groups=groups, tp_group=tp_group)
+                             groups=groups, tp_groups=tp_groups)
 
     def emit(self, prepared: "PreparedRound",
              assignment: SamplerAssignment, *,
@@ -196,7 +198,7 @@ class CruiseControlMetricsProcessor:
         if assignment.partitions:
             wanted = assignment.partitions
         elif empty_assignment_means_all:
-            wanted = list(prepared.tp_group)
+            wanted = list(prepared.tp_groups)
         else:
             wanted = []
         psamples: list[PartitionMetricSample] = []
@@ -206,24 +208,22 @@ class CruiseControlMetricsProcessor:
                 bsamples.append(self._broker_sample(
                     broker_id, prepared.times[broker_id], bl))
         for tp in wanted:
-            gkey = prepared.tp_group.get(tp)
-            if gkey is None:
-                continue
-            g = prepared.groups[gkey]
-            share = (g.sizes[tp] / g.total_size if g.total_size > 0
-                     else 1.0 / g.num_tps)
-            p_in = g.t_in * share
-            p_out = g.t_out * share
-            s = PartitionMetricSample(tp[0], tp[1], g.time_ms)
-            s.record(KafkaMetric.LEADER_BYTES_IN, p_in)
-            s.record(KafkaMetric.LEADER_BYTES_OUT, p_out)
-            s.record(KafkaMetric.DISK_USAGE, g.disk_by_tp.get(tp, 0.0))
-            s.record(KafkaMetric.MESSAGE_IN_RATE, g.t_msg * share)
-            # CPU attribution: broker CPU x partition share of broker
-            # leader bytes (ref ModelUtils.estimateLeaderCpuUtil).
-            cpu_share = (p_in + p_out) / g.denom if g.denom > 0 else 0.0
-            s.record(KafkaMetric.CPU_USAGE, g.broker_cpu * cpu_share)
-            psamples.append(s)
+            for gkey in prepared.tp_groups.get(tp, ()):
+                g = prepared.groups[gkey]
+                share = (g.sizes[tp] / g.total_size if g.total_size > 0
+                         else 1.0 / g.num_tps)
+                p_in = g.t_in * share
+                p_out = g.t_out * share
+                s = PartitionMetricSample(tp[0], tp[1], g.time_ms)
+                s.record(KafkaMetric.LEADER_BYTES_IN, p_in)
+                s.record(KafkaMetric.LEADER_BYTES_OUT, p_out)
+                s.record(KafkaMetric.DISK_USAGE, g.sizes.get(tp, 0.0))
+                s.record(KafkaMetric.MESSAGE_IN_RATE, g.t_msg * share)
+                # CPU attribution: broker CPU x partition share of broker
+                # leader bytes (ref ModelUtils.estimateLeaderCpuUtil).
+                cpu_share = (p_in + p_out) / g.denom if g.denom > 0 else 0.0
+                s.record(KafkaMetric.CPU_USAGE, g.broker_cpu * cpu_share)
+                psamples.append(s)
         return Samples(psamples, bsamples)
 
     def process(self, assignment: SamplerAssignment) -> Samples:

@@ -178,10 +178,11 @@ class AgentTopicSampler:
         window = (assignment.start_ms, assignment.end_ms)
         if self._round is None or self._round_window != window:
             # Direct (manager-less) use, or a window the manager never
-            # prepared: single-shot serial processing — never serve a
-            # stale round's samples for a different window.
-            records = self.transport.poll(assignment.start_ms,
-                                          assignment.end_ms)
-            self.processor.add_metrics(records)
-            return self.processor.process(assignment)
+            # prepared: ingest the window now (never serve a stale
+            # round's samples) and emit with the single-shot contract
+            # (all brokers; empty partition filter = everything).
+            self.prepare_round(assignment.start_ms, assignment.end_ms)
+            return self.processor.emit(self._round, assignment,
+                                       include_brokers=True,
+                                       empty_assignment_means_all=True)
         return self.processor.emit(self._round, assignment)

@@ -12,6 +12,7 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_tpu
+echo "[tpu_watch] $(date -u +%FT%TZ) watcher started pid $$" >> bench_tpu/watch.log
 
 probe() {
   timeout 140 python -c "

@@ -316,3 +316,47 @@ def test_infeasible_hard_goal_surfaces_as_error():
         assert "DiskCapacityGoal" in body["errorMessage"]
     finally:
         app.stop()
+
+
+def test_tls_listener_serves_https(tmp_path):
+    """ref webserver.ssl.*: the listener terminates TLS — a request over
+    https with the self-signed cert pinned must round-trip; plain http
+    against the TLS port must fail."""
+    import ssl
+    import subprocess
+    cert = tmp_path / "cert.pem"
+    key = tmp_path / "key.pem"
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-batch", "-days", "1", "-subj", "/CN=localhost",
+             "-keyout", str(key), "-out", str(cert)],
+            check=True, capture_output=True, timeout=60)
+    except (FileNotFoundError, subprocess.CalledProcessError):
+        pytest.skip("openssl unavailable")
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(str(cert), str(key))
+
+    sim, facade, app = build_stack()
+    app.stop()
+    app = CruiseControlApp(facade, port=0, ssl_context=server_ctx)
+    app.start()
+    try:
+        client_ctx = ssl.create_default_context(cafile=str(cert))
+        client_ctx.check_hostname = False
+        url = f"https://127.0.0.1:{app.port}/kafkacruisecontrol/state"
+        with urllib.request.urlopen(
+                urllib.request.Request(url), timeout=60,
+                context=client_ctx) as resp:
+            body = json.loads(resp.read())
+        assert resp.status == 200
+        assert "MonitorState" in body
+        # Plain http against the TLS listener is refused (URLError or a
+        # bare ConnectionResetError depending on where the reset lands —
+        # both are OSError).
+        with pytest.raises(OSError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/kafkacruisecontrol/state",
+                timeout=10)
+    finally:
+        app.stop()

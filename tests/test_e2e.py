@@ -221,3 +221,36 @@ def test_under_replication_heals_through_served_stack():
         stack.poll_until(healed, what="RF repair to 2")
     finally:
         stack.close()
+
+
+def test_miniature_scale_rebalance_through_served_stack():
+    """A scale scenario in miniature through serve.build_app's FULL config
+    wiring (Weak #6 round 3): 100 brokers x 2048 partitions, skewed onto
+    20% of the brokers, rebalanced over real HTTP with the configured goal
+    chain — the served analog of bench.py's scale scenarios."""
+    sim = SimulatedKafkaCluster()
+    for b in range(100):
+        sim.add_broker(b, rate_mb_s=100_000.0)
+    for p in range(2048):
+        # Skew: everything crowds the first 20 brokers.
+        reps = [p % 20, (p + 7) % 20]
+        sim.add_partition(f"t{p % 16}", p, reps, size_mb=10.0 + p % 13)
+    stack = Stack(sim)
+    try:
+        stack.wait_model_ready(timeout=60)
+        url = (f"{stack.base}/kafkacruisecontrol/rebalance"
+               "?dryrun=true&get_response_timeout_s=300")
+        req = urllib.request.Request(url, data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=310) as r:
+            body = json.loads(r.read())
+        assert body["summary"]["numProposals"] > 0
+        # The skew means real movement onto the empty 80 brokers; nothing
+        # lands on an unknown broker.
+        assert body["summary"]["numReplicaMovements"] > 100
+        live = set(range(100))
+        for pr in body["proposals"][:200]:
+            assert set(pr["newReplicas"]) <= live
+        dests = {b for pr in body["proposals"] for b in pr["newReplicas"]}
+        assert dests - set(range(20)), "no replicas moved onto empty brokers"
+    finally:
+        stack.close()
