@@ -181,6 +181,17 @@ class GoalKernel:
     def violation(self, state: SearchState, ctx: SearchContext) -> jax.Array:
         raise NotImplementedError
 
+    def violation_scale(self, state: SearchState,
+                        ctx: SearchContext) -> jax.Array:
+        """Magnitude the violation's float32 rounding error scales with —
+        the total absolute value the penalty sums reduce over. Count-based
+        goals return 0: integer arithmetic is exact in float32 well past
+        any real cluster size, so their residuals deserve a zero-tolerance
+        cutoff. ``GoalResult.satisfied`` turns this into a ulp-aware
+        epsilon (a broker landing exactly on a capacity limit must not
+        read as VIOLATED by one float32 ulp of a 10^12-byte sum)."""
+        return jnp.asarray(0.0)
+
     def propose(self, state: SearchState, ctx: SearchContext, key,
                 cfg: SearchConfig) -> Candidates:
         raise NotImplementedError
@@ -343,6 +354,13 @@ class IntervalGoal(GoalKernel):
         values = metric_values(state, self.metric)
         lower, upper = self.bounds(state, ctx)
         return self._penalty(values, lower, upper, ctx.broker_alive).sum()
+
+    def violation_scale(self, state, ctx):
+        which, _res = self.metric
+        if which in ("count", "leaders"):
+            return jnp.asarray(0.0)     # integer metrics: exact in f32
+        values = metric_values(state, self.metric)
+        return jnp.where(ctx.broker_valid, jnp.abs(values), 0.0).sum()
 
     def delta(self, state, ctx, c):
         values = metric_values(state, self.metric)

@@ -37,10 +37,19 @@ class GoalResult:
     violation_after: float
     duration_s: float
     iterations: int
+    #: magnitude the goal's float32 penalty sums reduce over (0 for
+    #: integer-count goals, whose arithmetic is exact) — see
+    #: GoalKernel.violation_scale
+    scale: float = 0.0
 
     @property
     def satisfied(self) -> bool:
-        return self.violation_after <= 1e-6
+        # Ulp-aware cutoff: a float32 reduction over ``scale`` units of
+        # load carries ~1e-7 relative rounding error, so a broker landing
+        # exactly on its capacity limit can read as over by ~scale ulps.
+        # 1e-6 * scale allows a handful of ulps; the absolute 1e-6 floor
+        # covers scale == 0 (integer goals, exact arithmetic).
+        return self.violation_after <= 1e-6 + 1e-6 * self.scale
 
     def to_json(self) -> dict:
         return {"goal": self.name, "hard": self.hard,
@@ -243,6 +252,10 @@ class TpuGoalOptimizer:
         # bypass the per-candidate improvement test and may legitimately
         # worsen a goal's own residual while healing the cluster.
         has_broken = bool(jax.device_get(state.offline.any()))
+        # Per-goal rounding scale for the satisfied cutoff (one tiny [B]
+        # reduction per goal, done once per optimize).
+        scales = [float(jax.device_get(g.violation_scale(state, ctx)))
+                  for g in goals]
         boundary = np.asarray(chain.violations(state, ctx))
         for i, (goal, gpass) in enumerate(zip(goals, chain.passes)):
             if on_goal_start is not None:
@@ -276,7 +289,8 @@ class TpuGoalOptimizer:
                 violation_before=before_i,
                 violation_after=after_i,
                 duration_s=time.monotonic() - g0,
-                iterations=int(jax.device_get(iters))))
+                iterations=int(jax.device_get(iters)),
+                scale=scales[i]))
 
         # Polish passes: later goals' accepted actions may have drifted
         # earlier goals within the acceptance tolerances; re-running the
@@ -284,9 +298,11 @@ class TpuGoalOptimizer:
         # residual is already ≤ ε on the fused post-pass stack). No
         # reference equivalent — the reference's single sequential walk
         # simply tolerates the drift.
-        # Per-goal convergence threshold: the stricter of the search epsilon
-        # and the satisfied/hard-goal cutoff (GoalResult.satisfied, 1e-6) so
-        # a goal can never be skipped as converged yet reported VIOLATED.
+        # Per-goal convergence threshold: stricter than (or equal to) the
+        # satisfied/hard-goal cutoff — GoalResult.satisfied tolerates
+        # 1e-6 + 1e-6*scale, polish skips only below min(epsilon, 1e-6) —
+        # so a goal can never be skipped as converged yet reported
+        # VIOLATED.
         polish_eps = min(cfg.epsilon, 1e-6)
         for rnd in range(cfg.polish_passes):
             if (boundary <= polish_eps).all():
