@@ -1,0 +1,169 @@
+"""Event-loop web engine — the second server implementation (ref the
+reference's ``KafkaCruiseControlVertxApp`` next to the Jetty servlet app;
+both engines there share one request-handling layer, as both engines here
+share :func:`~cruise_control_tpu.api.server.route_request`).
+
+Architecture mirrors the Vert.x model on asyncio: a single event loop
+accepts connections and parses HTTP/1.1; the blocking application work
+(goal optimization, monitor reads) is handed to a worker thread pool
+(``run_in_executor`` — Vert.x's ``executeBlocking``) so a long rebalance
+never stalls the accept loop. The loop runs in a daemon thread so the
+engine exposes the same synchronous ``start()/stop()/port`` surface as the
+threading engine and the two are drop-in interchangeable behind
+``webserver.engine``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+MAX_HEADER_BYTES = 64 * 1024
+MAX_BODY_BYTES = 16 * 1024 * 1024
+
+
+class AsyncHttpEngine:
+    def __init__(self, app, host: str = "127.0.0.1", port: int = 0,
+                 ssl_context=None):
+        self.app = app
+        self.host = host
+        self._requested_port = port
+        self._ssl = ssl_context
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._stop: asyncio.Event | None = None
+        self._thread: threading.Thread | None = None
+        self._bound = threading.Event()
+        self._port: int | None = None
+        self._bind_error: BaseException | None = None
+        # Own worker pool (not the loop's default executor): asyncio.run's
+        # shutdown would otherwise block on in-flight blocking requests,
+        # hanging stop() behind a long rebalance. shutdown(wait=False)
+        # gives the same semantics as the threading engine's shutdown —
+        # in-flight handlers finish on daemon threads.
+        self._pool = ThreadPoolExecutor(max_workers=32,
+                                        thread_name_prefix="cc-aio-worker")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cc-http-aio")
+        self._thread.start()
+        if not self._bound.wait(timeout=30):
+            raise RuntimeError("asyncio web engine failed to bind")
+        if self._bind_error is not None:
+            raise self._bind_error
+
+    def stop(self) -> None:
+        if self._loop is not None and self._stop is not None:
+            self._loop.call_soon_threadsafe(self._stop.set)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    @property
+    def port(self) -> int:
+        self._bound.wait(timeout=30)
+        if self._port is None:
+            raise RuntimeError("asyncio web engine is not bound")
+        return self._port
+
+    # ------------------------------------------------------------ internals
+    def _run(self) -> None:
+        asyncio.run(self._main())
+
+    async def _main(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        try:
+            server = await asyncio.start_server(
+                self._serve_client, self.host, self._requested_port,
+                ssl=self._ssl)
+        except BaseException as e:
+            # Surface EADDRINUSE etc. from start() instead of a silent
+            # daemon-thread death + 30 s timeout.
+            self._bind_error = e
+            self._bound.set()
+            raise
+        self._port = server.sockets[0].getsockname()[1]
+        self._bound.set()
+        async with server:
+            await self._stop.wait()
+
+    async def _serve_client(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
+        from .server import route_request
+        peer = (writer.get_extra_info("peername") or ("?",))[0]
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return
+                parts = request_line.decode("latin-1").split()
+                if len(parts) < 3:
+                    return
+                method, raw_path = parts[0].upper(), parts[1]
+                headers: dict[str, str] = {}
+                total = 0
+                while True:
+                    line = await reader.readline()
+                    total += len(line)
+                    if total > MAX_HEADER_BYTES:
+                        return
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    name, _, value = line.decode("latin-1").partition(":")
+                    headers[name.strip().lower()] = value.strip()
+                error = None
+                try:
+                    length = int(headers.get("content-length", 0) or 0)
+                except ValueError:
+                    error = (400, b'{"version": 1, "errorMessage": '
+                                  b'"bad Content-Length"}')
+                    length = 0
+                if error is None and "chunked" in headers.get(
+                        "transfer-encoding", "").lower():
+                    # No chunked decoding: mis-reading the body would
+                    # corrupt the keep-alive stream — refuse loudly.
+                    error = (411, b'{"version": 1, "errorMessage": '
+                                  b'"Length Required (chunked transfer '
+                                  b'encoding is not supported)"}')
+                if length > MAX_BODY_BYTES:
+                    return
+                body = await reader.readexactly(length) if length else b""
+                if error is not None:
+                    status, data = error
+                    ctype, extra = "application/json", {}
+                elif method not in ("GET", "POST", "OPTIONS"):
+                    status, ctype, data, extra = 405, "application/json", \
+                        b'{"version": 1, "errorMessage": "bad method"}', {}
+                else:
+                    # Blocking application work off the event loop
+                    # (Vert.x executeBlocking analog).
+                    status, ctype, data, extra = \
+                        await asyncio.get_running_loop().run_in_executor(
+                            self._pool, route_request, self.app, method,
+                            raw_path, headers, body, peer)
+                hdrs = [f"HTTP/1.1 {status} CC",
+                        f"Content-Type: {ctype}",
+                        f"Content-Length: {len(data)}"]
+                hdrs += [f"{k}: {v}" for k, v in extra.items()]
+                keep = headers.get("connection", "keep-alive").lower()
+                hdrs.append(f"Connection: {keep}")
+                writer.write(("\r\n".join(hdrs) + "\r\n\r\n").encode("latin-1"))
+                writer.write(data)
+                await writer.drain()
+                if self.app.accesslog:
+                    from .server import _ACCESS_LOG
+                    _ACCESS_LOG.info("%s %s %s -> %d", peer, method,
+                                     raw_path, status)
+                if keep == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass

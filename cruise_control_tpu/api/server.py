@@ -79,7 +79,8 @@ class CruiseControlApp:
                  cors: dict | None = None,
                  accesslog: bool = False,
                  ssl_context=None,
-                 parameter_overrides: dict | None = None) -> None:
+                 parameter_overrides: dict | None = None,
+                 engine: str = "threading") -> None:
         # None = use the component's own default (single source of truth
         # in tasks.py / purgatory.py); values are forwarded only when set.
         self.facade = facade
@@ -103,12 +104,24 @@ class CruiseControlApp:
         #: endpoint -> EndpointParameters subclass overriding the built-in
         #: (ref CruiseControlParametersConfig pluggable parameter classes)
         self.parameter_overrides = parameter_overrides or {}
-        handler = _make_handler(self)
-        self.server = ThreadingHTTPServer((host, port), handler)
-        if ssl_context is not None:
-            # ref webserver.ssl.*: TLS termination on the same listener.
-            self.server.socket = ssl_context.wrap_socket(
-                self.server.socket, server_side=True)
+        #: "threading" (stdlib ThreadingHTTPServer, the Jetty analog) or
+        #: "asyncio" (event-loop engine, the Vert.x analog) — ref the
+        #: reference's dual web-server engines (webserver.* configs apply
+        #: to both).
+        self.engine = engine
+        self._aio = None
+        self.server = None
+        if engine == "asyncio":
+            from .aioserver import AsyncHttpEngine
+            self._aio = AsyncHttpEngine(self, host=host, port=port,
+                                        ssl_context=ssl_context)
+        else:
+            handler = _make_handler(self)
+            self.server = ThreadingHTTPServer((host, port), handler)
+            if ssl_context is not None:
+                # ref webserver.ssl.*: TLS termination on the same listener.
+                self.server.socket = ssl_context.wrap_socket(
+                    self.server.socket, server_side=True)
         self._thread: threading.Thread | None = None
 
     def _parse(self, endpoint: str, query: dict) -> "ParsedParams":
@@ -119,15 +132,23 @@ class CruiseControlApp:
 
     @property
     def port(self) -> int:
+        if self._aio is not None:
+            return self._aio.port
         return self.server.server_address[1]
 
     def start(self) -> None:
+        if self._aio is not None:
+            self._aio.start()
+            return
         self._thread = threading.Thread(target=self.server.serve_forever,
                                         daemon=True, name="cc-http")
         self._thread.start()
 
     def stop(self) -> None:
-        self.server.shutdown()
+        if self._aio is not None:
+            self._aio.stop()
+        else:
+            self.server.shutdown()
         self.tasks.shutdown()
         self.facade.shutdown()
 
@@ -542,95 +563,101 @@ def _optimization_response(res, exec_res, verbose: bool = False) -> dict:
     return out
 
 
+def route_request(app: "CruiseControlApp", method: str, raw_path: str,
+                  headers: dict, body: bytes, peer: str
+                  ) -> tuple[int, str, bytes, dict]:
+    """Transport-neutral request router shared by BOTH web engines (the
+    stdlib threading server and the asyncio engine — ref the reference's
+    Jetty/Vert.x duality sharing one servlet layer). Returns
+    ``(status, content_type, body_bytes, headers)``."""
+
+    def json_resp(status: int, payload: dict, extra: dict | None = None):
+        data = json.dumps({"version": 1, **payload}).encode()
+        return status, "application/json", data, {**app.cors, **(extra or {})}
+
+    parsed = urlparse(raw_path)
+    parts = [p for p in parsed.path.split("/") if p]
+    headers = {k.lower(): v for k, v in headers.items()}
+    # Socket-derived peer address for source-gated providers (never
+    # trusted from the wire — overwritten here).
+    headers["x-cc-peer-address"] = peer
+
+    if method == "OPTIONS":
+        # CORS preflight (ref webserver.http.cors.*).
+        return ((200 if app.cors else 405), "application/json", b"",
+                dict(app.cors))
+    # Root: a self-contained API explorer (the stand-in for the
+    # reference's swagger-ui webroot). Gated by the same security
+    # provider as the endpoints it documents (VIEWER, like openapi).
+    if method == "GET" and parts in ([], ["kafkacruisecontrol"]):
+        try:
+            check_access(app.security, "openapi", headers)
+        except AuthorizationError as e:
+            return json_resp(e.status, {"errorMessage": str(e)},
+                             _auth_headers(e, app.security))
+        from .openapi import api_explorer_html
+        return 200, "text/html; charset=utf-8", api_explorer_html().encode(), {}
+    # /metrics: Prometheus text exposition of the self-metric sensors
+    # (the HTTP stand-in for the reference's JMX-exposed Dropwizard
+    # registry). Viewer-gated like /state.
+    if method == "GET" and parts in (["metrics"],
+                                     ["kafkacruisecontrol", "metrics"]):
+        try:
+            check_access(app.security, "state", headers)
+        except AuthorizationError as e:
+            return json_resp(e.status, {"errorMessage": str(e)},
+                             _auth_headers(e, app.security))
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                app.facade.registry.expose_text().encode(), {})
+    if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
+        return json_resp(404, {"errorMessage": f"bad path {parsed.path}"})
+    endpoint = parts[1].lower()
+    params = parse_qs(parsed.query)
+    if method == "POST" and body:
+        try:
+            decoded = body.decode()
+        except UnicodeDecodeError:
+            return json_resp(400, {"errorMessage":
+                                   "request body is not valid UTF-8"})
+        for k, v in parse_qs(decoded).items():
+            params.setdefault(k, v)
+    try:
+        status, payload, extra = app.handle(method, endpoint, params,
+                                            headers)
+    except AuthorizationError as e:
+        status, payload = e.status, {"errorMessage": str(e)}
+        extra = _auth_headers(e, app.security)
+    except (KeyError, ValueError) as e:
+        status, payload, extra = 400, {"errorMessage": str(e)}, {}
+    except Exception as e:
+        status, payload, extra = 500, {"errorMessage": str(e)}, {}
+    return json_resp(status, payload, extra)
+
+
 def _make_handler(app: CruiseControlApp):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):  # quiet
             pass
 
         def _serve(self, method: str):
-            parsed = urlparse(self.path)
-            parts = [p for p in parsed.path.split("/") if p]
-            # Root: a self-contained API explorer (the stand-in for the
-            # reference's swagger-ui webroot — no external assets here).
-            # Gated by the same security provider as the endpoints it
-            # documents (VIEWER, like the openapi spec itself).
-            if method == "GET" and parts in ([], ["kafkacruisecontrol"]):
-                headers = {k.lower(): v for k, v in self.headers.items()}
-                try:
-                    check_access(app.security, "openapi", headers)
-                except AuthorizationError as e:
-                    self._send(e.status, {"errorMessage": str(e)},
-                               _auth_headers(e, app.security))
-                    return
-                from .openapi import api_explorer_html
-                body = api_explorer_html().encode()
-                self.send_response(200)
-                self.send_header("Content-Type", "text/html; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            # /metrics: Prometheus text exposition of the self-metric
-            # sensors (the HTTP stand-in for the reference's JMX-exposed
-            # Dropwizard registry). Viewer-gated like /state.
-            if method == "GET" and parts in (
-                    ["metrics"], ["kafkacruisecontrol", "metrics"]):
-                headers = {k.lower(): v for k, v in self.headers.items()}
-                try:
-                    check_access(app.security, "state", headers)
-                except AuthorizationError as e:
-                    self._send(e.status, {"errorMessage": str(e)},
-                               _auth_headers(e, app.security))
-                    return
-                body = app.facade.registry.expose_text().encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4; charset=utf-8")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            # paths: /kafkacruisecontrol/<endpoint>
-            if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
-                self._send(404, {"errorMessage": f"bad path {parsed.path}"})
-                return
-            endpoint = parts[1].lower()
-            params = parse_qs(parsed.query)
+            body = b""
             if method == "POST":
                 length = int(self.headers.get("Content-Length", 0))
                 if length:
-                    body = self.rfile.read(length).decode()
-                    for k, v in parse_qs(body).items():
-                        params.setdefault(k, v)
-            headers = {k.lower(): v for k, v in self.headers.items()}
-            # Socket-derived peer address for source-gated providers
-            # (never trusted from the wire — overwritten here).
-            headers["x-cc-peer-address"] = self.client_address[0]
-            try:
-                status, payload, extra = app.handle(method, endpoint, params,
-                                                    headers)
-            except AuthorizationError as e:
-                status, payload = e.status, {"errorMessage": str(e)}
-                extra = _auth_headers(e, app.security)
-            except (KeyError, ValueError) as e:
-                status, payload, extra = 400, {"errorMessage": str(e)}, {}
-            except Exception as e:
-                status, payload, extra = 500, {"errorMessage": str(e)}, {}
-            self._send(status, payload, extra)
-
-        def _send(self, status: int, payload: dict,
-                  extra: dict | None = None):
-            body = json.dumps({"version": 1, **payload}).encode()
+                    body = self.rfile.read(length)
+            status, ctype, data, hdrs = route_request(
+                app, method, self.path, dict(self.headers), body,
+                self.client_address[0])
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            for k, v in {**app.cors, **(extra or {})}.items():
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            for k, v in hdrs.items():
                 self.send_header(k, v)
             self.end_headers()
-            self.wfile.write(body)
+            self.wfile.write(data)
             if app.accesslog:
                 _ACCESS_LOG.info("%s %s %s -> %d",
-                                 self.client_address[0], self.command,
+                                 self.client_address[0], method,
                                  self.path, status)
 
         def do_GET(self):
@@ -640,11 +667,6 @@ def _make_handler(app: CruiseControlApp):
             self._serve("POST")
 
         def do_OPTIONS(self):
-            # CORS preflight (ref webserver.http.cors.*).
-            self.send_response(200 if app.cors else 405)
-            for k, v in app.cors.items():
-                self.send_header(k, v)
-            self.send_header("Content-Length", "0")
-            self.end_headers()
+            self._serve("OPTIONS")
 
     return Handler

@@ -216,6 +216,13 @@ def _analyzer_defs(d: ConfigDef) -> None:
     d.define("search.max.iters.per.goal", ConfigType.INT, 256,
              validator=Range.at_least(1), importance=Importance.LOW,
              doc="Iteration cap per goal pass")
+    d.define("search.mesh.devices", ConfigType.INT, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Shard the optimizer over an N-device jax.sharding.Mesh "
+                 "(partition axis sharded, broker axis replicated). 0 = "
+                 "unsharded; N is clamped to the devices jax exposes. On "
+                 "multi-chip TPU hosts this puts the goal search's "
+                 "per-iteration broker aggregates on ICI all-reduces.")
     d.define("goals", ConfigType.LIST, "", importance=Importance.HIGH,
              doc="Full supported goal list (reference key; default.goals "
                  "is the active chain — empty inherits the built-in order)")
@@ -534,6 +541,13 @@ def _webserver_defs(d: ConfigDef) -> None:
     UserTaskManagerConfig.java."""
     d.define("webserver.http.address", ConfigType.STRING, "127.0.0.1",
              importance=Importance.HIGH, doc="Bind address")
+    d.define("webserver.engine", ConfigType.STRING, "threading",
+             validator=ValidString.in_("threading", "asyncio"),
+             importance=Importance.LOW,
+             doc="Web engine: 'threading' (stdlib thread-per-request, the "
+                 "Jetty servlet analog) or 'asyncio' (event loop with "
+                 "blocking work offloaded, the Vert.x analog). Both share "
+                 "one request-handling layer.")
     d.define("webserver.http.port", ConfigType.INT, 9090,
              validator=Range.between(0, 65535), importance=Importance.HIGH,
              doc="Bind port")

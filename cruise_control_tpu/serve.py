@@ -68,9 +68,16 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         sampling_interval_ms=config.get_int("metric.sampling.interval.ms"))
     constraint = config.balancing_constraint()
     goal_names = config.get_list("default.goals")
+    mesh = None
+    mesh_devices = config.get_int("search.mesh.devices")
+    if mesh_devices:
+        import jax
+
+        from .parallel import make_mesh
+        mesh = make_mesh(min(mesh_devices, len(jax.devices())))
     optimizer = TpuGoalOptimizer(
         goals=goals_by_name(goal_names, constraint) if goal_names else None,
-        constraint=constraint, config=config.search_config())
+        constraint=constraint, config=config.search_config(), mesh=mesh)
     executor = Executor(admin, config.executor_config())
     from .analyzer import DefaultOptimizationOptionsGenerator
     gen_cls = load_class(config.get_string(
@@ -204,7 +211,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         cors=cors,
         accesslog=config.get_boolean("webserver.accesslog.enabled"),
         ssl_context=ssl_context,
-        parameter_overrides=parameter_overrides)
+        parameter_overrides=parameter_overrides,
+        engine=config.get_string("webserver.engine"))
 
 
 class _AgentPipelineSampler:

@@ -360,3 +360,44 @@ def test_tls_listener_serves_https(tmp_path):
                 timeout=10)
     finally:
         app.stop()
+
+
+def test_asyncio_engine_serves_full_api():
+    """The second web engine (webserver.engine=asyncio, the Vert.x analog)
+    serves the same API through the shared router: state, preflight,
+    rebalance with User-Task-ID async semantics, /metrics text."""
+    sim, facade, app = build_stack()
+    app.stop()
+    app = CruiseControlApp(facade, port=0, engine="asyncio",
+                           cors={"Access-Control-Allow-Origin": "*"})
+    app.start()
+    try:
+        status, body, _ = call(app, "GET", "state")
+        assert status == 200 and body["MonitorState"]["numValidWindows"] == 3
+        # CORS preflight through the aio engine.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.port}/kafkacruisecontrol/rebalance",
+            method="OPTIONS")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers["Access-Control-Allow-Origin"] == "*"
+        # Async rebalance with task-id polling.
+        status, body, headers = call(
+            app, "POST", "rebalance",
+            "dryrun=true&get_response_timeout_s=120")
+        assert status == 200 and body["summary"]["numProposals"] > 0
+        assert headers["User-Task-ID"]
+        # /metrics exposition.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/metrics", timeout=30) as r:
+            text = r.read().decode()
+        assert "cc_" in text and "# TYPE" in text
+        # Unknown endpoint name under /kafkacruisecontrol -> 405 (the
+        # endpoint router knows the name sets); an unroutable PATH is 404.
+        call(app, "GET", "nonsense", expect=405)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{app.port}/not/a/route")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 404
+    finally:
+        app.stop()

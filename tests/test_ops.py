@@ -112,3 +112,37 @@ def test_cccli_parser_covers_endpoint_catalog():
                      "pause_sampling", "resume_sampling", "bootstrap",
                      "train", "review", "admin"):
         assert endpoint in subs, endpoint
+
+
+def test_mesh_config_wires_sharded_optimizer_into_served_stack():
+    """search.mesh.devices shards the SERVED optimizer (the config path a
+    multi-chip TPU host uses): rebalance through build_app converges with
+    the 8-device virtual mesh and produces a consistent plan."""
+    from cruise_control_tpu.serve import build_app
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    cfg = CruiseControlConfig({
+        "partition.metrics.window.ms": "1000",
+        "num.partition.metrics.windows": "4",
+        "broker.metrics.window.ms": "1000",
+        "metric.sampling.interval.ms": "1000",
+        "webserver.http.port": "0",
+        "default.goals": "ReplicaDistributionGoal,DiskUsageDistributionGoal",
+        "search.mesh.devices": "8",
+    })
+    admin = SimulatedKafkaCluster(now_ms=0)
+    for b in range(6):
+        admin.add_broker(b)
+    for p in range(64):
+        admin.add_partition(f"t{p % 4}", p, [p % 2, 2 + p % 2],
+                            size_mb=20.0 + p % 7)
+    app = build_app(cfg, admin)
+    assert app.facade.optimizer.mesh is not None
+    assert app.facade.optimizer.mesh.devices.size == 8
+    runner = app.facade.task_runner
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        admin.advance_to((w + 1) * 1000)
+        assert runner.maybe_run_sampling(admin.now_ms)
+    res, _ = app.facade.rebalance(dryrun=True)
+    assert len(res.proposals) > 0
+    assert not res.violated_goals_after
