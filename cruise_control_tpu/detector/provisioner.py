@@ -92,9 +92,21 @@ class BasicProvisioner(Provisioner):
                     and rec.num_partitions and rec.topic):
                 create = getattr(self.admin, "create_partitions", None)
                 if create is not None:
-                    create(rec.topic, rec.num_partitions)
-                    actions.append({"action": "created-partitions",
-                                    **rec.to_json()})
+                    # ref ProvisionerUtils.increasePartitionCount:
+                    # num_partitions is the DESIRED TOTAL — partitions are
+                    # added only if the topic currently has fewer; a topic
+                    # already at/above the target is ignored, not doubled.
+                    current = sum(1 for (t, _p)
+                                  in self.admin.describe_partitions()
+                                  if t == rec.topic)
+                    missing = rec.num_partitions - current
+                    if missing > 0:
+                        create(rec.topic, missing)
+                        actions.append({"action": "created-partitions",
+                                        **rec.to_json()})
+                    else:
+                        actions.append({"action": "ignored-at-target",
+                                        **rec.to_json()})
                     continue
             actions.append({"action": "recommended-only", **rec.to_json()})
         return {"provisionerState": ("COMPLETED" if actions

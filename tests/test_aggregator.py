@@ -284,3 +284,48 @@ def test_dense_ingest_duplicate_targets_match_scalar_semantics():
                                r_s.latest_values[row_s])
     # latest-wins at metric 0: the t=700 sample (value 3.0) beats t=600.
     assert r_d.latest_values[row_d, 0, 0] == 3.0
+
+
+def test_forced_insufficient_extrapolation():
+    """ref RawMetricValues FORCED_INSUFFICIENT: a window with SOME samples
+    (but under half of min) and no qualifying neighbors is force-used as
+    is — valid, budget-consuming, flagged so completeness can discount."""
+    agg = _agg(min_samples=4)   # half-min = 2 -> 1 sample is insufficient
+    e = ("t1", 0)
+    agg.add_sample(_sample(e, 500, 30.0))     # window 0: one sample only
+    agg.add_sample(_sample(e, 1100, 1.0))     # window 1: also one sample
+    # roll windows 0-1 out of the in-flight slot
+    agg.add_sample(_sample(e, 2100, 1.0))
+    res = agg.aggregate(0, 2000)
+    vae = res.entity_values[e]
+    assert vae.extrapolations[0] is Extrapolation.FORCED_INSUFFICIENT
+    assert vae.values[0, 0] == 30.0           # the insufficient value used
+    # A window with ZERO samples stays NO_VALID_EXTRAPOLATION even with
+    # budget left (another entity pins window 0 into retention; ``e``
+    # itself has nothing there).
+    agg2 = _agg(min_samples=4)
+    agg2.add_sample(_sample(("t2", 9), 500, 2.0))   # window 0 exists
+    agg2.add_sample(_sample(e, 1100, 1.0))          # e: window 1 only
+    agg2.add_sample(_sample(e, 2100, 1.0))          # roll 0-1 out
+    res2 = agg2.aggregate(0, 2000)
+    vae2 = res2.entity_values[e]
+    assert vae2.extrapolations[0] is Extrapolation.NO_VALID_EXTRAPOLATION
+
+
+def test_extrapolation_budget_not_burned_by_hopeless_windows():
+    """Windows that end NO_VALID_EXTRAPOLATION never consume the
+    extrapolation budget — a later salvageable window must still get its
+    extrapolation (ref maxAllowedExtrapolationsPerEntity accounting)."""
+    agg = _agg(min_samples=2)
+    e = ("t1", 0)
+    # Another entity pins windows 0-3 into retention; for ``e`` windows
+    # 0-2 are empty and window 3 has one sample (half-min qualifies).
+    agg.add_sample(_sample(("t2", 9), 500, 2.0))
+    agg.add_sample(_sample(e, 3100, 9.0))
+    agg.add_sample(_sample(e, 4100, 1.0))     # roll 3 out
+    res = agg.aggregate(
+        0, 4000, AggregationOptions(max_allowed_extrapolations_per_entity=1))
+    vae = res.entity_values[e]
+    assert vae.extrapolations[3] is Extrapolation.AVG_AVAILABLE
+    assert all(x is Extrapolation.NO_VALID_EXTRAPOLATION
+               for x in vae.extrapolations[:3])

@@ -401,3 +401,38 @@ def test_asyncio_engine_serves_full_api():
         assert ei.value.code == 404
     finally:
         app.stop()
+
+
+def test_user_task_capacity_and_retention():
+    """ref UserTaskManagerTest: the active-task cap rejects new
+    submissions (ACTIVE tasks only — completed ones don't count), and
+    completed tasks expire after the retention window."""
+    import threading as _threading
+    from cruise_control_tpu.api.tasks import TaskState, UserTaskManager
+    mgr = UserTaskManager(max_active_tasks=2,
+                          completed_task_retention_ms=50)
+    gate = _threading.Event()
+
+    def blocked(progress):
+        gate.wait(30)
+        return "done"
+
+    t1 = mgr.submit("rebalance", "u1", blocked)
+    t2 = mgr.submit("rebalance", "u2", blocked)
+    with pytest.raises(RuntimeError, match="too many active"):
+        mgr.submit("rebalance", "u3", blocked)
+    # Reattaching to an existing id is NOT a new submission.
+    assert mgr.submit("rebalance", "u1", blocked,
+                      user_task_id=t1.user_task_id) is t1
+    gate.set()
+    t1.future.result(timeout=30)
+    t2.future.result(timeout=30)
+    # Completed tasks free capacity immediately...
+    t3 = mgr.submit("rebalance", "u3", lambda p: "quick")
+    t3.future.result(timeout=30)
+    assert t3.state is TaskState.COMPLETED
+    # ...and fall out of /user_tasks after retention.
+    time.sleep(0.1)
+    remaining = {t.user_task_id for t in mgr.all_tasks()}
+    assert t1.user_task_id not in remaining
+    mgr.shutdown()

@@ -291,3 +291,41 @@ def test_maintenance_reader_idempotence_survives_restart(tmp_path):
     # Idempotence off: duplicates flow through.
     reader3 = MaintenanceEventReader(enable_idempotence=False)
     assert reader3.submit(ev) and reader3.submit(ev)
+
+
+def test_basic_provisioner_rightsize_creates_partitions():
+    """ref BasicProvisioner.java: an UNDER_PROVISIONED partition
+    recommendation is acted on concretely (partitions created via the
+    admin client); broker recommendations are returned for the platform
+    layer; no recommendations -> COMPLETED_WITH_NO_ACTION."""
+    from cruise_control_tpu.detector.provisioner import (
+        BasicProvisioner, ProvisionRecommendation, ProvisionStatus)
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    sim.add_partition("t0", 0, [0, 1])
+    prov = BasicProvisioner(sim)
+
+    out = prov.rightsize(recommendations=[])
+    assert out["provisionerState"] == "COMPLETED_WITH_NO_ACTION"
+
+    out = prov.rightsize(recommendations=[
+        ProvisionRecommendation(ProvisionStatus.UNDER_PROVISIONED,
+                                num_partitions=3, topic="t0"),
+        ProvisionRecommendation(ProvisionStatus.UNDER_PROVISIONED,
+                                num_brokers=2, resource="DISK")])
+    assert out["provisionerState"] == "COMPLETED"
+    actions = {a["action"] for a in out["actions"]}
+    assert actions == {"created-partitions", "recommended-only"}
+    # num_partitions is the desired TOTAL (ref ProvisionerUtils.
+    # increasePartitionCount): topic had 1 partition, target 3 -> exactly
+    # 3 after, never current + target.
+    after = sum(1 for tp in sim.describe_partitions() if tp[0] == "t0")
+    assert after == 3
+    # A topic already at the target is ignored, not expanded again.
+    out = prov.rightsize(recommendations=[
+        ProvisionRecommendation(ProvisionStatus.UNDER_PROVISIONED,
+                                num_partitions=3, topic="t0")])
+    assert {a["action"] for a in out["actions"]} == {"ignored-at-target"}
+    assert sum(1 for tp in sim.describe_partitions() if tp[0] == "t0") == 3
