@@ -10,6 +10,8 @@ from cruise_control_tpu.executor import (
     IntraBrokerReplicaMove, OngoingExecutionError, SimClock,
     SimulatedKafkaCluster, TaskState, TaskType, strategy_chain)
 from cruise_control_tpu.executor.simulated import (FOLLOWER_THROTTLED_RATE,
+                                                    FOLLOWER_THROTTLED_REPLICAS,
+                                                    LEADER_THROTTLED_REPLICAS,
                                                    LEADER_THROTTLED_RATE)
 from cruise_control_tpu.executor.strategy import (
     PrioritizeSmallReplicaMovementStrategy, StrategyContext)
@@ -296,3 +298,46 @@ def test_operation_log_audit_trail(caplog):
             if r.name == "cruise_control_tpu.operation"]
     assert any("audit-2 FAILED (OSError)" in m for m in msgs), msgs
     assert not any("audit-2 finished" in m for m in msgs), msgs
+
+
+def test_throttle_merges_with_operator_replica_lists():
+    """ref ReplicationThrottleHelperTest: pre-existing operator-set
+    throttled-replica entries are merged with (never clobbered by) the
+    helper's entries, and clear_throttles removes exactly what the helper
+    added — the operator's entries survive the full cycle."""
+    from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+    from cruise_control_tpu.executor.tasks import ExecutionTask, TaskType
+    sim = make_cluster(size_mb=10.0)
+    sim.alter_topic_config("t", {LEADER_THROTTLED_REPLICAS: "7:1"})
+    helper = ReplicationThrottleHelper(sim, 1_000_000)
+    task = ExecutionTask(0, ExecutionProposal(
+        "t", 0, old_leader=0, old_replicas=(0, 1), new_replicas=(0, 2)),
+        TaskType.INTER_BROKER_REPLICA_ACTION)
+    helper.set_throttles([task])
+    merged = sim.describe_topic_config("t")[LEADER_THROTTLED_REPLICAS]
+    assert set(merged.split(",")) == {"7:1", "0:0", "0:1"}
+    helper.clear_throttles()
+    assert sim.describe_topic_config("t")[LEADER_THROTTLED_REPLICAS] == "7:1"
+    # Broker rates the helper wrote are gone.
+    assert LEADER_THROTTLED_RATE not in sim.describe_broker_config(0)
+
+
+def test_throttle_excluded_brokers_run_unthrottled():
+    """ref THROTTLE_ADDED_BROKER_PARAM=false: excluded brokers (fresh
+    capacity joining / a drain source) get neither rate configs nor
+    replica-list entries."""
+    from cruise_control_tpu.executor.throttle import ReplicationThrottleHelper
+    from cruise_control_tpu.executor.tasks import ExecutionTask, TaskType
+    sim = make_cluster(size_mb=10.0)
+    helper = ReplicationThrottleHelper(sim, 1_000_000)
+    task = ExecutionTask(0, ExecutionProposal(
+        "t", 0, old_leader=0, old_replicas=(0, 1), new_replicas=(0, 2)),
+        TaskType.INTER_BROKER_REPLICA_ACTION)
+    helper.set_throttles([task], excluded_brokers={2})
+    assert LEADER_THROTTLED_RATE not in sim.describe_broker_config(2)
+    assert FOLLOWER_THROTTLED_RATE not in sim.describe_broker_config(2)
+    topic_cfg = sim.describe_topic_config("t")
+    assert "0:2" not in topic_cfg.get(FOLLOWER_THROTTLED_REPLICAS, "")
+    # Non-excluded participants are still throttled.
+    assert LEADER_THROTTLED_RATE in sim.describe_broker_config(0)
+    helper.clear_throttles()
