@@ -329,3 +329,97 @@ def test_basic_provisioner_rightsize_creates_partitions():
                                 num_partitions=3, topic="t0")])
     assert {a["action"] for a in out["actions"]} == {"ignored-at-target"}
     assert sum(1 for tp in sim.describe_partitions() if tp[0] == "t0") == 3
+
+
+class _StubExecutor:
+    def has_ongoing_execution(self):
+        return False
+
+
+class _StubFacade:
+    """The minimal facade surface AnomalyDetectorManager touches."""
+
+    executor = _StubExecutor()
+
+    class admin:
+        @staticmethod
+        def describe_cluster():
+            # 1 of 4 failed: under the 40% mass-failure refusal.
+            return {0: False, 1: True, 2: True, 3: True}
+
+
+class _ScriptedNotifier:
+    """Scripted AnomalyNotifier: records handling order, returns a
+    per-type scripted action (ref the EasyMock'd notifiers in
+    AnomalyDetectorManagerTest)."""
+
+    def __init__(self, script):
+        from cruise_control_tpu.detector.notifier import (
+            AnomalyNotificationResult, NotificationAction)
+        self.script = script
+        self.handled = []
+        self._fix = NotificationAction(AnomalyNotificationResult.FIX)
+
+    def on_anomaly(self, anomaly, now_ms):
+        self.handled.append(anomaly)
+        return self.script.get(anomaly.anomaly_type, self._fix)
+
+
+def test_anomaly_queue_priority_and_dedup():
+    """ref AnomalyDetectorManager:74 — the queue drains in anomaly-type
+    priority order (BROKER_FAILURE before GOAL_VIOLATION regardless of
+    enqueue order), and a re-detected identical condition merges into the
+    pending entry instead of queueing twice."""
+    from cruise_control_tpu.detector.anomalies import (BrokerFailures,
+                                                       GoalViolations)
+    notifier = _ScriptedNotifier({})
+    mgr = AnomalyDetectorManager(_StubFacade(), notifier)
+
+    gv = GoalViolations(detected_ms=1000)
+    gv.fix = lambda facade: True
+    bf = BrokerFailures(detected_ms=2000, failed_brokers={0: 2000})
+    bf.fix = lambda facade: True
+    # Enqueue LOW priority first; the broker failure must still be
+    # handled first.
+    mgr._enqueue(gv, ready_ms=0)
+    mgr._enqueue(bf, ready_ms=0)
+    # Duplicate re-detection merges (earliest entry kept, data absorbed).
+    bf2 = BrokerFailures(detected_ms=5000, failed_brokers={0: 1500})
+    mgr._enqueue(bf2, ready_ms=0)
+    assert len(mgr._queue) == 2
+    assert bf.failed_brokers[0] == 1500   # merged earliest failure time
+
+    out = mgr._handle_queue(now=10_000)
+    assert out["fixed"] == 2
+    assert [a.anomaly_type for a in notifier.handled] == [
+        KafkaAnomalyType.BROKER_FAILURE, KafkaAnomalyType.GOAL_VIOLATION]
+
+
+def test_anomaly_check_defers_then_fires():
+    """A CHECK action re-queues the anomaly with the requested delay; it
+    fires once the delay elapses and the condition still holds (ref
+    AnomalyNotificationResult.CHECK handling + still_valid gate)."""
+    from cruise_control_tpu.detector.anomalies import BrokerFailures
+    from cruise_control_tpu.detector.notifier import (
+        AnomalyNotificationResult, NotificationAction)
+
+    notifier = _ScriptedNotifier({
+        KafkaAnomalyType.BROKER_FAILURE: NotificationAction(
+            AnomalyNotificationResult.CHECK, delay_ms=5_000)})
+    mgr = AnomalyDetectorManager(_StubFacade(), notifier)
+    bf = BrokerFailures(detected_ms=0, failed_brokers={0: 0})
+    fixed_calls = []
+    bf.fix = lambda facade: fixed_calls.append(1) or True
+    mgr._enqueue(bf, ready_ms=0)
+
+    out = mgr._handle_queue(now=1_000)
+    assert out == {"fixed": 0, "rechecked": 1, "ignored": 0}
+    assert not fixed_calls
+    # Before the delay elapses nothing happens; after it, the FIX script
+    # takes over and the fix runs.
+    notifier.script[KafkaAnomalyType.BROKER_FAILURE] = NotificationAction(
+        AnomalyNotificationResult.FIX)
+    out = mgr._handle_queue(now=2_000)
+    assert out["fixed"] == 0 and not fixed_calls
+    out = mgr._handle_queue(now=7_000)
+    assert out["fixed"] == 1 and fixed_calls
