@@ -1,0 +1,101 @@
+"""ctypes binding for the native sample-store loader
+(``sidecar/sample_loader.cc`` → ``libsample_loader.so``).
+
+Checkpoint replay (the monitor's LOADING state, ref
+``KafkaSampleStore.java:93`` loadSamples) parses the whole retained
+sample history before serving; at scale that is tens of millions of JSONL
+lines, where Python ``json`` is the cold-start bottleneck. The native
+scanner reads the exact format ``FileSampleStore`` writes into columnar
+arrays ready for ``MetricSampleAggregator.add_samples_dense``.
+
+Entirely optional: :func:`load_partition_samples_dense` returns ``None``
+when the library isn't built or reports parse errors (foreign or
+hand-edited files), and callers fall back to the Python path — behavior
+never changes, only speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_LIB_PATHS = (
+    os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "sidecar", "libsample_loader.so"),
+    "libsample_loader.so",
+)
+
+_lib = None
+
+
+def _load_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    for path in _LIB_PATHS:
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        lib.csl_load.restype = ctypes.c_void_p
+        lib.csl_load.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        for fn in (lib.csl_count, lib.csl_errors, lib.csl_topic_bytes):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p]
+        lib.csl_fill.restype = ctypes.c_int
+        lib.csl_fill.argtypes = [ctypes.c_void_p] + [ctypes.c_void_p] * 5
+        lib.csl_free.restype = None
+        lib.csl_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+    return None
+
+
+def available() -> bool:
+    return _load_lib() is not None
+
+
+def load_partition_samples_dense(path: str, num_metrics: int):
+    """Parse a partition_samples.jsonl natively.
+
+    Returns ``(entities, times_ms, values)`` matching
+    ``add_samples_dense``'s signature — ``entities`` a list of
+    ``(topic, partition)`` tuples, ``times_ms`` int64 [N], ``values``
+    float64 [N, num_metrics] with NaN for absent metrics — or ``None``
+    when the native library is unavailable, the file can't be read, or
+    any line failed the strict scanner (callers then use the Python
+    json fallback, which accepts anything).
+    """
+    lib = _load_lib()
+    if lib is None or not os.path.exists(path):
+        return None
+    handle = lib.csl_load(path.encode(), num_metrics)
+    if not handle:
+        return None
+    try:
+        if lib.csl_errors(handle):
+            return None
+        n = lib.csl_count(handle)
+        times = np.empty(n, np.int64)
+        values = np.empty((n, num_metrics), np.float64)
+        partitions = np.empty(n, np.int32)
+        offsets = np.empty(n + 1, np.int64)
+        topic_data = ctypes.create_string_buffer(
+            max(int(lib.csl_topic_bytes(handle)), 1))
+        rc = lib.csl_fill(
+            handle,
+            times.ctypes.data_as(ctypes.c_void_p),
+            values.ctypes.data_as(ctypes.c_void_p),
+            partitions.ctypes.data_as(ctypes.c_void_p),
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            ctypes.cast(topic_data, ctypes.c_void_p))
+        if rc != 0:
+            return None
+        raw = topic_data.raw
+        entities = [(raw[offsets[i]:offsets[i + 1]].decode(),
+                     int(partitions[i])) for i in range(n)]
+        return entities, times, values
+    finally:
+        lib.csl_free(handle)

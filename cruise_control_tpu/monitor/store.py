@@ -67,6 +67,38 @@ class FileSampleStore:
             self._pfile.flush()
             self._bfile.flush()
 
+    def load_samples_dense(self):
+        """Columnar replay: the partition side parsed by the native
+        scanner (sidecar/libsample_loader.so) straight into
+        ``add_samples_dense``-shaped arrays; broker samples (small) stay
+        object-parsed. Returns ``((entities, times, values),
+        broker_samples)`` or ``None`` when the native loader is
+        unavailable or refuses the file — callers then use
+        :meth:`load_samples`."""
+        from ..core.metricdef import partition_metric_def
+        from . import native_loader
+        with self._lock:
+            self._pfile.flush()
+            self._bfile.flush()
+            block = native_loader.load_partition_samples_dense(
+                os.path.join(self._dir, "partition_samples.jsonl"),
+                partition_metric_def().size())
+            if block is None:
+                return None
+            bsamples = self._read(
+                os.path.join(self._dir, "broker_samples.jsonl"),
+                BrokerMetricSample.from_json)
+        entities, times, values = block
+        latest = max(int(times.max()) if len(times) else 0,
+                     max((s.time_ms for s in bsamples), default=0))
+        if self._retention_ms is not None:
+            horizon = latest - self._retention_ms
+            keep = times >= horizon
+            entities = [e for e, k in zip(entities, keep) if k]
+            times, values = times[keep], values[keep]
+            bsamples = [s for s in bsamples if s.time_ms >= horizon]
+        return (entities, times, values), bsamples, latest
+
     def load_samples(self) -> Samples:
         """Replay everything retained (ref KafkaSampleStore loadSamples -> the
         LOADING monitor state)."""

@@ -54,12 +54,34 @@ class LoadMonitorTaskRunner:
             if self._state is not RunnerState.NOT_STARTED:
                 raise RuntimeError(f"already started ({self._state})")
             replayed = 0
+            newest = 0
             if not skip_loading:
                 self._state = RunnerState.LOADING
-                samples = self.fetcher.store.load_samples()
-                self.monitor.add_samples(samples)
-                replayed = (len(samples.partition_samples)
-                            + len(samples.broker_samples))
+                dense_fn = getattr(self.fetcher.store,
+                                   "load_samples_dense", None)
+                dense = dense_fn() if dense_fn is not None else None
+                if dense is not None:
+                    # Native columnar replay (store.py load_samples_dense):
+                    # the partition history ingests in one vectorized call;
+                    # newest comes from the store (computed once there for
+                    # retention).
+                    (entities, times, values), bsamples, newest = dense
+                    self.monitor.partition_aggregator.add_samples_dense(
+                        entities, times, values)
+                    for s in bsamples:
+                        self.monitor.broker_aggregator.add_sample(
+                            s.to_aggregator_sample())
+                    replayed = len(entities) + len(bsamples)
+                else:
+                    samples = self.fetcher.store.load_samples()
+                    self.monitor.add_samples(samples)
+                    replayed = (len(samples.partition_samples)
+                                + len(samples.broker_samples))
+                    if replayed:
+                        newest = max(
+                            s.time_ms
+                            for s in (samples.partition_samples
+                                      + samples.broker_samples))
             self._state = RunnerState.RUNNING
             if replayed:
                 # Seed from the newest replayed sample so the first live
@@ -71,9 +93,6 @@ class LoadMonitorTaskRunner:
                 # windows can retain anyway (an uncapped range would be one
                 # giant query — Prometheus rejects >11K points/series — and
                 # a future timestamp from clock skew would stall sampling).
-                newest = max(s.time_ms
-                             for s in (samples.partition_samples
-                                       + samples.broker_samples))
                 c = self.monitor.config
                 retention_ms = max(
                     c.num_windows * c.window_ms,

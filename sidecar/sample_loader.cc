@@ -1,0 +1,181 @@
+// Native sample-store loader: parse the FileSampleStore's
+// partition_samples.jsonl into dense columnar arrays for
+// MetricSampleAggregator.add_samples_dense — the checkpoint-replay
+// (LOADING state) equivalent of the reference's KafkaSampleStore
+// loadSamples consumers (KafkaSampleStore.java:93), built native because
+// at 10K-broker scale replay parses tens of millions of lines and the
+// Python json loop dominates cold-start.
+//
+// The scanner is FORMAT-SPECIFIC by design: it reads exactly what
+// FileSampleStore.store_samples writes —
+//   {"topic": "<str>", "partition": <int>, "timeMs": <int>,
+//    "values": {"<metric-id>": <float>, ...}}
+// one object per line, keys in that order. Any line that deviates
+// increments the error counter; the Python binding falls back to the
+// general json path when errors are reported, so hand-written or foreign
+// files still load (slowly) rather than silently dropping samples.
+//
+// C ABI (ctypes-consumed, see cruise_control_tpu/monitor/native_loader.py):
+//   csl_load(path, num_metrics) -> handle (NULL on IO error)
+//   csl_count(h)        -> number of parsed samples
+//   csl_errors(h)       -> number of unparseable lines
+//   csl_topic_bytes(h)  -> total bytes of the concatenated topic column
+//   csl_fill(h, times[n], values[n*num_metrics], partitions[n],
+//            topic_offsets[n+1], topic_data[topic_bytes]) -> 0/-1
+//   csl_free(h)
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Loaded {
+  int num_metrics = 0;
+  std::vector<int64_t> times;
+  std::vector<double> values;      // n * num_metrics, NaN = absent
+  std::vector<int32_t> partitions;
+  std::vector<int64_t> topic_offsets;  // n + 1 prefix offsets
+  std::string topic_data;              // concatenated topic bytes
+  int64_t errors = 0;
+};
+
+// Advance *p past `expect`; return false if the text differs.
+bool eat(const char** p, const char* expect) {
+  size_t n = std::strlen(expect);
+  if (std::strncmp(*p, expect, n) != 0) return false;
+  *p += n;
+  return true;
+}
+
+bool parse_line(const char* p, const char* end, Loaded* out) {
+  if (!eat(&p, "{\"topic\": \"")) return false;
+  // Topic string: stored topics never contain escapes (Kafka topic names
+  // are [a-zA-Z0-9._-]); treat a backslash as a parse failure so exotic
+  // hand-edited files take the safe fallback path.
+  const char* start = p;
+  while (p < end && *p != '"' && *p != '\\') p++;
+  if (p >= end || *p != '"') return false;
+  size_t topic_len = static_cast<size_t>(p - start);
+  p++;  // closing quote
+
+  if (!eat(&p, ", \"partition\": ")) return false;
+  char* after = nullptr;
+  long partition = std::strtol(p, &after, 10);
+  if (after == p) return false;
+  p = after;
+
+  if (!eat(&p, ", \"timeMs\": ")) return false;
+  long long time_ms = std::strtoll(p, &after, 10);
+  if (after == p) return false;
+  p = after;
+
+  if (!eat(&p, ", \"values\": {")) return false;
+
+  size_t row = out->values.size();
+  out->values.resize(row + static_cast<size_t>(out->num_metrics),
+                     std::nan(""));
+  if (*p != '}') {
+    for (;;) {
+      if (*p != '"') return false;
+      p++;
+      long metric_id = std::strtol(p, &after, 10);
+      if (after == p) return false;
+      p = after;
+      if (!eat(&p, "\": ")) return false;
+      double v = std::strtod(p, &after);
+      if (after == p) return false;
+      p = after;
+      if (metric_id >= 0 && metric_id < out->num_metrics)
+        out->values[row + static_cast<size_t>(metric_id)] = v;
+      if (*p == ',') {
+        if (!eat(&p, ", ")) return false;
+        continue;
+      }
+      break;
+    }
+  }
+  if (!eat(&p, "}}")) return false;
+
+  out->times.push_back(static_cast<int64_t>(time_ms));
+  out->partitions.push_back(static_cast<int32_t>(partition));
+  out->topic_data.append(start, topic_len);
+  out->topic_offsets.push_back(
+      static_cast<int64_t>(out->topic_data.size()));
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* csl_load(const char* path, int num_metrics) {
+  FILE* f = std::fopen(path, "rb");
+  if (f == nullptr) return nullptr;
+  auto* out = new Loaded();
+  out->num_metrics = num_metrics;
+  out->topic_offsets.push_back(0);
+
+  auto flush_line = [&](std::string& line) {
+    while (!line.empty() &&
+           (line.back() == '\n' || line.back() == '\r'))
+      line.pop_back();
+    if (!line.empty()) {
+      size_t rows_before = out->values.size();
+      if (!parse_line(line.c_str(), line.c_str() + line.size(), out)) {
+        out->errors++;
+        out->values.resize(rows_before);  // drop a half-parsed row
+      }
+    }
+    line.clear();
+  };
+
+  std::string line;
+  char buf[1 << 16];
+  while (std::fgets(buf, sizeof(buf), f) != nullptr) {
+    line.append(buf);
+    if (!line.empty() && line.back() != '\n' && !std::feof(f))
+      continue;  // long line: keep accumulating
+    flush_line(line);
+  }
+  // A final line without a trailing newline can land exactly on a chunk
+  // boundary and survive the loop — flush it, never drop it silently.
+  flush_line(line);
+  std::fclose(f);
+  return out;
+}
+
+int64_t csl_count(void* h) {
+  return static_cast<int64_t>(static_cast<Loaded*>(h)->times.size());
+}
+
+int64_t csl_errors(void* h) {
+  return static_cast<Loaded*>(h)->errors;
+}
+
+int64_t csl_topic_bytes(void* h) {
+  return static_cast<int64_t>(static_cast<Loaded*>(h)->topic_data.size());
+}
+
+int csl_fill(void* h, int64_t* times, double* values, int32_t* partitions,
+             int64_t* topic_offsets, char* topic_data) {
+  auto* in = static_cast<Loaded*>(h);
+  size_t n = in->times.size();
+  if (in->topic_offsets.size() != n + 1) return -1;
+  std::memcpy(times, in->times.data(), n * sizeof(int64_t));
+  std::memcpy(values, in->values.data(),
+              n * static_cast<size_t>(in->num_metrics) * sizeof(double));
+  std::memcpy(partitions, in->partitions.data(), n * sizeof(int32_t));
+  std::memcpy(topic_offsets, in->topic_offsets.data(),
+              (n + 1) * sizeof(int64_t));
+  std::memcpy(topic_data, in->topic_data.data(), in->topic_data.size());
+  return 0;
+}
+
+void csl_free(void* h) { delete static_cast<Loaded*>(h); }
+
+}  // extern "C"

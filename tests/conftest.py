@@ -32,3 +32,20 @@ jax.config.update("jax_enable_x64", False)
 from cruise_control_tpu.utils.platform import enable_compilation_cache  # noqa: E402
 
 enable_compilation_cache()
+
+# Build the optional native sample loader when a toolchain is present so
+# its parity tests run instead of skipping (best-effort: failures leave
+# the Python fallback in charge and the tests skip as designed).
+import pathlib
+import subprocess
+
+_sidecar = pathlib.Path(__file__).resolve().parent.parent / "sidecar"
+_lib = _sidecar / "libsample_loader.so"
+_src = _sidecar / "sample_loader.cc"
+if _src.exists() and (not _lib.exists()
+                      or _src.stat().st_mtime > _lib.stat().st_mtime):
+    try:
+        subprocess.run(["make", "-C", str(_sidecar), "libsample_loader.so"],
+                       capture_output=True, timeout=120, check=False)
+    except (OSError, subprocess.TimeoutExpired):
+        pass

@@ -479,3 +479,86 @@ def test_agent_sampler_more_fetchers_than_partitions_no_duplicates():
     keys = [(s.topic, s.partition) for s in got.partition_samples]
     assert len(keys) == len(set(keys)), f"duplicated samples: {sorted(keys)}"
     assert sorted(b.broker_id for b in got.broker_samples) == brokers
+
+
+def test_native_sample_loader_matches_python_parse(tmp_path):
+    """The native columnar loader (sidecar/libsample_loader.so) parses
+    exactly what FileSampleStore wrote, matching the Python json path
+    value for value; foreign lines make it refuse (fallback contract)."""
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    from cruise_control_tpu.monitor import native_loader
+    from cruise_control_tpu.monitor.sampler import Samples
+    from cruise_control_tpu.monitor.samples import PartitionMetricSample
+    if not native_loader.available():
+        pytest.skip("libsample_loader.so not built")
+    store = FileSampleStore(str(tmp_path))
+    psamples = []
+    for i in range(500):
+        s = PartitionMetricSample(f"topic-{i % 7}", i, 1000 + i)
+        s.record(KafkaMetric.CPU_USAGE, 0.125 * i)
+        s.record(KafkaMetric.DISK_USAGE, 3.5 * i)
+        if i % 3 == 0:
+            s.record(KafkaMetric.LEADER_BYTES_IN, -1.25e6 + i)
+        psamples.append(s)
+    store.store_samples(Samples(psamples, []))
+
+    M = partition_metric_def().size()
+    block = native_loader.load_partition_samples_dense(
+        str(tmp_path / "partition_samples.jsonl"), M)
+    assert block is not None
+    entities, times, values = block
+    assert len(entities) == 500
+    assert entities[13] == ("topic-6", 13)
+    assert times[13] == 1013
+    assert values[13, int(KafkaMetric.CPU_USAGE)] == 0.125 * 13
+    assert values[13, int(KafkaMetric.DISK_USAGE)] == 3.5 * 13
+    assert np.isnan(values[13, int(KafkaMetric.LEADER_BYTES_IN)])
+    assert values[12, int(KafkaMetric.LEADER_BYTES_IN)] == -1.25e6 + 12
+    # A foreign line -> the strict scanner refuses the whole file.
+    with open(tmp_path / "partition_samples.jsonl", "a") as f:
+        f.write('{"partition": 1, "topic": "reordered"}\n')
+    assert native_loader.load_partition_samples_dense(
+        str(tmp_path / "partition_samples.jsonl"), M) is None
+
+
+def test_replay_uses_dense_path_and_matches_scalar(tmp_path):
+    """LOADING replay through the native dense path produces the same
+    model as the per-sample path (same windows, same loads), and the
+    runner seeds its next sampling round identically."""
+    from cruise_control_tpu.monitor import native_loader
+    if not native_loader.available():
+        pytest.skip("libsample_loader.so not built")
+
+    def build(store_dir, force_python):
+        sim = make_cluster()
+        monitor = make_monitor(sim)
+        store = FileSampleStore(str(store_dir))
+        if force_python:
+            store.load_samples_dense = lambda: None
+        sampler = SyntheticWorkloadSampler(sim)
+        fetcher = MetricFetcherManager(sampler, store=store)
+        return sim, monitor, store, fetcher
+
+    # Round 1: record samples into the store.
+    sim, monitor, store, fetcher = build(tmp_path, force_python=False)
+    runner = LoadMonitorTaskRunner(monitor, fetcher,
+                                   sampling_interval_ms=WINDOW_MS)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        runner.maybe_run_sampling((w + 1) * WINDOW_MS - 1)
+
+    results = {}
+    for mode in ("native", "python"):
+        sim2, monitor2, store2, fetcher2 = build(
+            tmp_path, force_python=(mode == "python"))
+        runner2 = LoadMonitorTaskRunner(monitor2, fetcher2,
+                                        sampling_interval_ms=WINDOW_MS)
+        replayed = runner2.start(4 * WINDOW_MS)
+        assert replayed > 0
+        res = monitor2.cluster_model(4 * WINDOW_MS)
+        results[mode] = (replayed, runner2._last_sample_ms,
+                         np.asarray(res.model.leader_load))
+    assert results["native"][0] == results["python"][0]
+    assert results["native"][1] == results["python"][1]
+    np.testing.assert_allclose(results["native"][2], results["python"][2],
+                               rtol=1e-6)
