@@ -288,18 +288,36 @@ def intra_broker_rebalance(model, metadata, admin, capacity_resolver, *,
                                                 capacity_resolver)
     if drained_disks:
         cap = np.asarray(state.disk_capacity).copy()
+        util = np.asarray(state.disk_util)
         bindex = {bid: i for i, bid in enumerate(metadata.broker_ids)}
         for broker_id, dirs in drained_disks.items():
             i = bindex.get(broker_id)
             if i is None:
                 raise ValueError(f"unknown broker id {broker_id}")
             for d in dirs:
-                if d in logdirs_by_broker[i]:
-                    cap[i, logdirs_by_broker[i].index(d)] = 0.0
+                if d not in logdirs_by_broker[i]:
+                    # A typo'd logdir must fail the request, not silently
+                    # leave the disk it named untouched while unrelated
+                    # balance moves execute and report success.
+                    raise ValueError(
+                        f"broker {broker_id} has no logdir {d!r} "
+                        f"(knows {sorted(logdirs_by_broker[i])})")
+                cap[i, logdirs_by_broker[i].index(d)] = 0.0
             if not (cap[i] > 0).any():
                 raise ValueError(
                     f"broker {broker_id}: cannot remove every logdir "
                     f"({sorted(dirs)}) — no surviving disk to drain to")
+            # ref RemoveDisksRunnable.java:156-158: the broker's FULL disk
+            # usage must fit under the surviving disks' capacity x
+            # threshold, or the drain is refused up front (half-moving
+            # replicas off a disk being removed is worse than failing).
+            future_usage = float(util[i].sum())
+            remaining = float(cap[i].sum())
+            if future_usage > remaining * cap_threshold:
+                raise ValueError(
+                    f"Not enough remaining capacity to move replicas to "
+                    f"for broker {broker_id}: {future_usage:.1f} MB used "
+                    f"vs {remaining:.1f} MB x {cap_threshold} surviving")
         state = state.replace(disk_capacity=jnp.asarray(cap))
     cv0, bv0 = _violations(state, cap_threshold, balance_threshold)
     final, iters = optimize_intra_broker(

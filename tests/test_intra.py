@@ -96,3 +96,65 @@ def test_rebalance_disks_dryrun_reports_moves():
     # dryrun: cluster untouched
     assert all(d == "d0" for k, d in
                sim.describe_replica_log_dirs().items())
+
+
+def test_intra_capacity_goal_respects_disk_limits():
+    """ref IntraBrokerDiskCapacityGoal: a logdir over capacity x threshold
+    sheds replicas onto its sibling disks until under the limit — and no
+    move OVERSHOOTS a destination disk past the limit."""
+    sim = SimulatedKafkaCluster()
+    sim.add_broker(0, rate_mb_s=100_000.0, logdirs=("d0", "d1", "d2"))
+    # d0 holds 900 MB (over 1000 * 0.8); siblings empty.
+    for p in range(9):
+        sim.add_partition("t", p, [0], size_mb=100.0,
+                          logdir_by_broker={0: "d0"})
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=2, window_ms=W,
+                                             min_samples_per_window=1))
+    fetcher = MetricFetcherManager(SyntheticWorkloadSampler(sim))
+    runner = LoadMonitorTaskRunner(monitor, fetcher, sampling_interval_ms=W)
+    runner.start(-1, skip_loading=True)
+    for w in range(2):
+        runner.maybe_run_sampling((w + 1) * W - 1)
+    result = monitor.cluster_model(2 * W)
+
+    class ThreeDisk:
+        def capacity_for_broker(self, rack, host, broker_id):
+            return BrokerCapacityInfo(
+                capacity={Resource.CPU: 100.0, Resource.NW_IN: 1e6,
+                          Resource.NW_OUT: 1e6, Resource.DISK: 3000.0},
+                disk_capacity_by_logdir={"d0": 1000.0, "d1": 1000.0,
+                                         "d2": 1000.0})
+
+    state, dirs = build_disk_state(result.model, result.metadata, sim,
+                                   ThreeDisk())
+    final, iters = optimize_intra_broker(state, cap_threshold=0.8)
+    util = np.asarray(final.disk_util)[0, :3]
+    assert (util <= 1000.0 * 0.8 + 1e-3).all(), util
+    assert abs(util.sum() - 900.0) < 1e-3   # nothing lost
+
+
+def test_remove_disks_rejects_when_no_room():
+    """ref RemoveDisksRunnable's capacity sanity check: draining a disk
+    whose bytes cannot fit on the broker's remaining disks must fail
+    loudly, not silently half-move."""
+    sim, monitor, facade = build_stack(partitions=24)
+    # d0 across brokers holds far more than d1 can absorb (24 partitions
+    # x 2 replicas x ~50 MB avg over 3 brokers ~ 840 MB on d0 per broker;
+    # d1 capacity 1000 MB... so use a tighter resolver).
+    class TinySibling:
+        def capacity_for_broker(self, rack, host, broker_id):
+            return BrokerCapacityInfo(
+                capacity={Resource.CPU: 100.0, Resource.NW_IN: 1e6,
+                          Resource.NW_OUT: 1e6, Resource.DISK: 1100.0},
+                disk_capacity_by_logdir={"d0": 1000.0, "d1": 100.0})
+    monitor.capacity_resolver = TinySibling()
+    with pytest.raises(ValueError, match="Not enough remaining capacity"):
+        facade.remove_disks({0: ["d0"]}, dryrun=True)
+
+
+def test_remove_disks_rejects_unknown_logdir():
+    """A typo'd logdir fails the request instead of silently running
+    unrelated balance moves and reporting success."""
+    sim, monitor, facade = build_stack()
+    with pytest.raises(ValueError, match="no logdir 'bogus'"):
+        facade.remove_disks({0: ["bogus"]}, dryrun=True)
