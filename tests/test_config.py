@@ -161,3 +161,56 @@ def test_recent_brokers_expire_with_retention():
     assert 1 not in recents
     recents.clear()
     assert not recents
+
+
+def test_file_broker_set_resolver_reads_reference_format(tmp_path):
+    """ref BrokerSetFileResolver: brokerSets.json (the reference's own
+    schema) resolves ids to sets; unknown brokers fall to the assignment
+    policy; the topic name-hash policy is process-stable."""
+    from cruise_control_tpu.config.brokersets import (
+        FileBrokerSetResolver, modulo_assignment, topic_set_array,
+        topic_set_by_name_hash)
+    resolver = FileBrokerSetResolver("config/brokerSets.json")
+    assert resolver.broker_set_for(0) == "set-a"
+    assert resolver.broker_set_for(2) == "set-b"
+    assert resolver.broker_set_for(99) is None
+    assert resolver.all_sets() == ["set-a", "set-b"]
+    # Unknown brokers get a deterministic modulo placement.
+    assert modulo_assignment(99, resolver.all_sets()) == "set-b"
+    assert modulo_assignment(100, resolver.all_sets()) == "set-a"
+    # Topic policy: crc32-stable (NOT Python's salted hash), explicit
+    # mapping wins.
+    a = topic_set_by_name_hash("payments", ["set-a", "set-b"])
+    assert a == topic_set_by_name_hash("payments", ["set-a", "set-b"])
+    arr = topic_set_array(["payments", "logs"], ["set-a", "set-b"],
+                          explicit={"logs": "set-a"})
+    assert arr[1] == 0
+    assert arr[0] == ["set-a", "set-b"].index(a)
+
+
+def test_topic_config_providers(tmp_path):
+    """ref JsonFileTopicConfigProvider / KafkaAdminTopicConfigProvider:
+    per-topic configs overlay cluster-level defaults; the admin-backed
+    provider reads live dynamic configs."""
+    import json as _json
+    from cruise_control_tpu.config.topics import (
+        AdminTopicConfigProvider, JsonFileTopicConfigProvider)
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    doc = {"cluster": {"min.insync.replicas": "2"},
+           "topics": {"payments": {"min.insync.replicas": "3",
+                                   "retention.ms": "86400000"}}}
+    path = tmp_path / "topics.json"
+    path.write_text(_json.dumps(doc))
+    p = JsonFileTopicConfigProvider(str(path))
+    assert p.cluster_configs() == {"min.insync.replicas": "2"}
+    assert p.topic_configs("payments")["min.insync.replicas"] == "3"
+    assert p.topic_configs("payments")["retention.ms"] == "86400000"
+    assert p.topic_configs("other")["min.insync.replicas"] == "2"
+
+    sim = SimulatedKafkaCluster()
+    sim.add_broker(0)
+    sim.add_partition("t0", 0, [0])
+    sim.alter_topic_config("t0", {"min.insync.replicas": "2"})
+    ap = AdminTopicConfigProvider(sim)
+    assert ap.topic_configs("t0")["min.insync.replicas"] == "2"
+    assert ap.topic_configs("missing") == {}
