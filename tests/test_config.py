@@ -163,14 +163,17 @@ def test_recent_brokers_expire_with_retention():
     assert not recents
 
 
-def test_file_broker_set_resolver_reads_reference_format(tmp_path):
+def test_file_broker_set_resolver_reads_reference_format():
     """ref BrokerSetFileResolver: brokerSets.json (the reference's own
     schema) resolves ids to sets; unknown brokers fall to the assignment
     policy; the topic name-hash policy is process-stable."""
     from cruise_control_tpu.config.brokersets import (
         FileBrokerSetResolver, modulo_assignment, topic_set_array,
         topic_set_by_name_hash)
-    resolver = FileBrokerSetResolver("config/brokerSets.json")
+    import pathlib
+    resolver = FileBrokerSetResolver(str(
+        pathlib.Path(__file__).resolve().parent.parent
+        / "config" / "brokerSets.json"))
     assert resolver.broker_set_for(0) == "set-a"
     assert resolver.broker_set_for(2) == "set-b"
     assert resolver.broker_set_for(99) is None
@@ -178,13 +181,18 @@ def test_file_broker_set_resolver_reads_reference_format(tmp_path):
     # Unknown brokers get a deterministic modulo placement.
     assert modulo_assignment(99, resolver.all_sets()) == "set-b"
     assert modulo_assignment(100, resolver.all_sets()) == "set-a"
-    # Topic policy: crc32-stable (NOT Python's salted hash), explicit
-    # mapping wins.
+    # Topic policy: crc32-stable — pin the concrete digest so a switch
+    # to Python's per-process-salted hash() fails cross-process.
+    import zlib
     a = topic_set_by_name_hash("payments", ["set-a", "set-b"])
-    assert a == topic_set_by_name_hash("payments", ["set-a", "set-b"])
+    assert a == ["set-a", "set-b"][zlib.crc32(b"payments") % 2]
+    # Explicit mapping wins over the hash: pick the OPPOSITE of what the
+    # hash would choose for "logs" so the override is actually exercised.
+    hashed = topic_set_by_name_hash("logs", ["set-a", "set-b"])
+    other = "set-b" if hashed == "set-a" else "set-a"
     arr = topic_set_array(["payments", "logs"], ["set-a", "set-b"],
-                          explicit={"logs": "set-a"})
-    assert arr[1] == 0
+                          explicit={"logs": other})
+    assert arr[1] == ["set-a", "set-b"].index(other)
     assert arr[0] == ["set-a", "set-b"].index(a)
 
 
