@@ -146,3 +146,32 @@ def test_mesh_config_wires_sharded_optimizer_into_served_stack():
     res, _ = app.facade.rebalance(dryrun=True)
     assert len(res.proposals) > 0
     assert not res.violated_goals_after
+
+
+def test_cccli_auth_and_error_mapping():
+    """Client round-trips Basic credentials and surfaces server error
+    messages: wrong password -> RuntimeError with the auth message,
+    VIEWER role refused on a mutating endpoint, bad parameter -> the
+    server's 400 errorMessage verbatim."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_api import build_stack
+    from cruise_control_tpu.api import BasicSecurityProvider, Role
+    users = {"admin": ("pw", Role.ADMIN), "ro": ("pw", Role.VIEWER)}
+    sim, facade, app = build_stack(security=BasicSecurityProvider(users))
+    try:
+        addr = f"127.0.0.1:{app.port}"
+        ok = CruiseControlClient(addr, auth=("admin", "pw"),
+                                 poll_interval_s=0.2)
+        assert "MonitorState" in ok.call("state")
+        with pytest.raises(RuntimeError):
+            CruiseControlClient(addr, auth=("admin", "WRONG"),
+                                poll_interval_s=0.2).call("state")
+        with pytest.raises(RuntimeError, match="lacks"):
+            CruiseControlClient(addr, auth=("ro", "pw"),
+                                poll_interval_s=0.2).call(
+                "rebalance", {"dryrun": "true"})
+        with pytest.raises(RuntimeError, match="boolean"):
+            ok.call("rebalance", {"dryrun": "maybe"})
+    finally:
+        app.stop()
