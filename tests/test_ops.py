@@ -153,8 +153,6 @@ def test_cccli_auth_and_error_mapping():
     messages: wrong password -> RuntimeError with the auth message,
     VIEWER role refused on a mutating endpoint, bad parameter -> the
     server's 400 errorMessage verbatim."""
-    import sys
-    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
     from test_api import build_stack
     from cruise_control_tpu.api import BasicSecurityProvider, Role
     users = {"admin": ("pw", Role.ADMIN), "ro": ("pw", Role.VIEWER)}
@@ -164,7 +162,7 @@ def test_cccli_auth_and_error_mapping():
         ok = CruiseControlClient(addr, auth=("admin", "pw"),
                                  poll_interval_s=0.2)
         assert "MonitorState" in ok.call("state")
-        with pytest.raises(RuntimeError):
+        with pytest.raises(RuntimeError, match="credentials"):
             CruiseControlClient(addr, auth=("admin", "WRONG"),
                                 poll_interval_s=0.2).call("state")
         with pytest.raises(RuntimeError, match="lacks"):
