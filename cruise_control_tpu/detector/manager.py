@@ -258,6 +258,11 @@ class AnomalyDetectorManager:
                                 self.recent_anomalies.items() if v},
             "numSelfHealingStarted": self.num_self_healing_started,
             "numSelfHealingFailed": self.num_self_healing_failed,
+            # Alerts fire on their own threshold even when self-healing is
+            # disabled (ref SelfHealingNotifier alert-vs-fix thresholds);
+            # surfacing the count lets operators (and tests) distinguish
+            # "nothing detected" from "detected but healing is off".
+            "numAlertsFired": len(getattr(self.notifier, "alerts", ())),
             "ongoingSelfHealing": self.ongoing_self_healing,
             "balancednessScore": balancedness,
             "numQueuedAnomalies": len(self._queue),

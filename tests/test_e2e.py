@@ -125,6 +125,24 @@ def oplog():
     OPERATION_LOG.removeHandler(handler)
 
 
+def _broker_failure_detected(stack):
+    st = stack.get("state", "substates=anomaly_detector")
+    return "BROKER_FAILURE" in st["AnomalyDetectorState"]["recentAnomalies"]
+
+
+def _broker_drained(stack, broker_id):
+    st = stack.get("state", "substates=anomaly_detector,executor")
+    ad = st["AnomalyDetectorState"]
+    if ad["numSelfHealingStarted"] < 1:
+        return False
+    if st["ExecutorState"]["state"] != "NO_TASK_IN_PROGRESS":
+        return False
+    ks = stack.get("kafka_cluster_state", "verbose=true")
+    on_dead = [p for p in ks["KafkaPartitionState"]["Partitions"]
+               if broker_id in p["replicas"]]
+    return not on_dead and ad["ongoingSelfHealing"] is None
+
+
 def test_broker_death_heals_through_served_stack(tmp_path, oplog):
     sim = make_sim()
     stack = Stack(sim, {"failed.brokers.file.path":
@@ -134,26 +152,13 @@ def test_broker_death_heals_through_served_stack(tmp_path, oplog):
         sim.kill_broker(3)
 
         # 1. Detection: the broker-failure anomaly appears over REST.
-        def detected():
-            st = stack.get("state", "substates=anomaly_detector")
-            recent = st["AnomalyDetectorState"]["recentAnomalies"]
-            return "BROKER_FAILURE" in recent
-        stack.poll_until(detected, what="broker-failure detection")
+        stack.poll_until(lambda: _broker_failure_detected(stack),
+                         what="broker-failure detection")
 
         # 2. Healing: self-healing fires (past the 600 ms threshold) and
         #    the executor drains broker 3 completely.
-        def healed():
-            st = stack.get("state", "substates=anomaly_detector,executor")
-            ad = st["AnomalyDetectorState"]
-            if ad["numSelfHealingStarted"] < 1:
-                return False
-            if st["ExecutorState"]["state"] != "NO_TASK_IN_PROGRESS":
-                return False
-            ks = stack.get("kafka_cluster_state", "verbose=true")
-            on_dead = [p for p in ks["KafkaPartitionState"]["Partitions"]
-                       if 3 in p["replicas"]]
-            return not on_dead and ad["ongoingSelfHealing"] is None
-        stack.poll_until(healed, what="broker-3 drain")
+        stack.poll_until(lambda: _broker_drained(stack, 3),
+                         what="broker-3 drain")
 
         # 3. Audit trail: the OPERATION_LOG recorded the execution
         #    lifecycle for the healing run.
@@ -274,5 +279,54 @@ def test_rightsize_endpoint_through_served_stack():
         # and a right-sized cluster takes no provisioning action.
         assert body["provisionerState"] == "COMPLETED_WITH_NO_ACTION"
         assert not body.get("actions")
+    finally:
+        stack.close()
+
+
+def test_admin_disable_self_healing_gates_the_fix():
+    """POST /admin?disable_self_healing_for=broker_failure must stop the
+    automatic drain (alerts still fire); re-enabling lets the deferred
+    fix proceed (ref AdminParameters self-healing toggles +
+    SelfHealingNotifier per-type switches)."""
+    sim = make_sim()
+    stack = Stack(sim)
+
+    def admin(query):
+        req = urllib.request.Request(
+            f"{stack.base}/kafkacruisecontrol/admin?{query}",
+            data=b"", method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    try:
+        stack.wait_model_ready()
+        out = admin("disable_self_healing_for=broker_failure")
+        assert out["disabledSelfHealing"] == ["broker_failure"]
+        sim.kill_broker(3)
+
+        stack.poll_until(lambda: _broker_failure_detected(stack),
+                         what="broker-failure detection")
+        # The toggle is visibly off before the negative check, and the
+        # notifier must have EVALUATED the past-threshold anomaly (alerts
+        # fire even when healing is disabled) — so the == 0 below can't
+        # pass vacuously on a stalled detector tick.
+        st = stack.get("state", "substates=anomaly_detector")
+        assert st["AnomalyDetectorState"]["selfHealingEnabled"][
+            "BROKER_FAILURE"] is False
+        stack.poll_until(
+            lambda: stack.get("state", "substates=anomaly_detector")
+            ["AnomalyDetectorState"]["numAlertsFired"] >= 1,
+            what="alert despite disabled healing")
+        st = stack.get("state", "substates=anomaly_detector")
+        assert st["AnomalyDetectorState"]["numSelfHealingStarted"] == 0
+        ks = stack.get("kafka_cluster_state", "verbose=true")
+        assert any(3 in p["replicas"]
+                   for p in ks["KafkaPartitionState"]["Partitions"])
+
+        out = admin("enable_self_healing_for=broker_failure")
+        assert out["enabledSelfHealing"] == ["broker_failure"]
+
+        stack.poll_until(lambda: _broker_drained(stack, 3),
+                         what="post-enable drain")
     finally:
         stack.close()
