@@ -40,6 +40,7 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
     "user_tasks": ("get", "Recent/active async user tasks", []),
     "review_board": ("get", "Two-step-verification review queue", []),
     "permissions": ("get", "Roles of the authenticated principal", []),
+    "openapi": ("get", "This OpenAPI 3 document", []),
     "bootstrap": ("get", "Replay historic samples into the monitor",
                   [("start", "integer", "epoch ms"),
                    ("end", "integer", "epoch ms")]),
@@ -86,9 +87,12 @@ def api_explorer_html(base_path: str = "/kafkacruisecontrol") -> str:
     stand-in for the reference's swagger-ui ``webroot/`` — this
     environment cannot ship swagger's JS assets, so the page renders the
     same endpoint/parameter tables directly)."""
+    from .parameters import ENDPOINT_PARAMETERS
     rows = []
     for name, (method, summary, extra) in sorted(ENDPOINTS.items()):
-        params = ", ".join(p for p, _, _ in extra) or "—"
+        cls = ENDPOINT_PARAMETERS.get(name)
+        declared = sorted(cls.specs()) if cls is not None else []
+        params = ", ".join(declared) or "—"
         rows.append(
             f"<tr><td><code>{method.upper()}</code></td>"
             f"<td><code>{base_path}/{name}</code></td>"
@@ -116,26 +120,162 @@ re-issuing the request with that header. See docs/rest-api.md.</small></p>
 </body></html>"""
 
 
+#: Param.kind -> OpenAPI schema (csv kinds are comma-separated strings
+#: on the wire).
+_KIND_SCHEMA = {"bool": "boolean", "int": "integer", "double": "number",
+                "string": "string", "csv_str": "string",
+                "csv_int": "string"}
+
+
+def _declared_params(endpoint: str, descriptions: dict[str, str]
+                     ) -> list[dict]:
+    """Parameter objects generated from the SAME typed specs the
+    dispatcher validates with (api/parameters.py) — names, types, enum
+    choices, defaults, required flags and minimums cannot drift from the
+    server's actual contract."""
+    from .parameters import ENDPOINT_PARAMETERS
+    cls = ENDPOINT_PARAMETERS.get(endpoint)
+    if cls is None:
+        return []
+    out = []
+    for p in cls.specs().values():
+        if p.kind == "enum":
+            schema: dict = {"type": "string",
+                            "enum": [str(c) for c in p.choices]}
+        else:
+            schema = {"type": _KIND_SCHEMA.get(p.kind, "string")}
+        if p.kind in ("csv_str", "csv_int"):
+            schema["description"] = "comma-separated list"
+        if p.default is not None:
+            schema["default"] = p.default
+        if p.min_value is not None:
+            schema["minimum"] = p.min_value
+        out.append({"name": p.name, "in": "query",
+                    "required": bool(p.required),
+                    "description": descriptions.get(p.name, ""),
+                    "schema": schema})
+    return out
+
+
+#: Response body schemas for the main result shapes (ref the response
+#: classes under servlet/response/). version=1 wraps every JSON body.
+_SCHEMAS = {
+    "OptimizationResult": {
+        "type": "object",
+        "properties": {
+            "version": {"type": "integer"},
+            "summary": {"type": "object",
+                        "description": "proposal counts by action type"},
+            "goalSummary": {"type": "array", "items": {
+                "type": "object", "properties": {
+                    "goal": {"type": "string"},
+                    "hard": {"type": "boolean"},
+                    "violationBefore": {"type": "number"},
+                    "violationAfter": {"type": "number"},
+                    "optimizationDurationMs": {"type": "number"},
+                    "status": {"type": "string",
+                               "enum": ["NO-ACTION", "FIXED", "VIOLATED"]},
+                }}},
+            "violatedGoalsBefore": {"type": "array",
+                                    "items": {"type": "string"}},
+            "violatedGoalsAfter": {"type": "array",
+                                   "items": {"type": "string"}},
+            "proposals": {"type": "array", "items": {
+                "type": "object", "properties": {
+                    "topic": {"type": "string"},
+                    "partition": {"type": "integer"},
+                    "oldLeader": {"type": "integer"},
+                    "oldReplicas": {"type": "array",
+                                    "items": {"type": "integer"}},
+                    "newReplicas": {"type": "array",
+                                    "items": {"type": "integer"}},
+                }}},
+            "provisionResponse": {"type": "object", "nullable": True},
+        }},
+    "ProgressResponse": {
+        "type": "object",
+        "properties": {
+            "version": {"type": "integer"},
+            "progress": {"type": "array", "items": {"type": "object"}},
+            "userTaskId": {"type": "string"},
+        }},
+    "ErrorResponse": {
+        "type": "object",
+        "properties": {
+            "version": {"type": "integer"},
+            "errorMessage": {"type": "string"},
+        }},
+    "ReviewResult": {
+        "type": "object",
+        "description": "request parked for two-step review",
+        "properties": {
+            "version": {"type": "integer"},
+            "reviewResult": {"type": "object", "properties": {
+                "Id": {"type": "integer"},
+                "EndPoint": {"type": "string"},
+                "Status": {"type": "string"},
+                "Reason": {"type": "string"},
+                "SubmitterAddress": {"type": "string"},
+                "SubmissionTimeMs": {"type": "integer"},
+            }}}},
+}
+
+_OPTIMIZATION_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
+                           "fix_offline_replicas", "demote_broker",
+                           "topic_configuration", "proposals"}
+
+
 def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
+    # Imported here (not at module top) to keep this module importable
+    # standalone; server.py only loads openapi lazily, so no cycle either
+    # way — but the endpoint behavior sets live in server.py.
+    from .server import ASYNC_ENDPOINTS, NO_REVIEW_REQUIRED
+
+    def _ref(name: str) -> dict:
+        return {"content": {"application/json": {"schema": {
+            "$ref": f"#/components/schemas/{name}"}}}}
+
     paths: dict[str, dict] = {}
     for name, (method, summary, extra) in ENDPOINTS.items():
-        params = [{
-            "name": pname, "in": "query", "required": False,
-            "description": desc, "schema": {"type": ptype},
-        } for pname, ptype, desc in extra]
+        descriptions = {pname: desc for pname, _ptype, desc in extra}
+        params = _declared_params(name, descriptions)
+        ok: dict = {"description": "completed result (JSON)"}
+        if name in _OPTIMIZATION_ENDPOINTS:
+            ok.update(_ref("OptimizationResult"))
+        responses = {
+            "200": ok,
+            "400": {"description": "invalid parameters",
+                    **_ref("ErrorResponse")},
+        }
+        # 202 only where it can actually happen, with the body it
+        # actually carries: async endpoints long-poll (ProgressResponse);
+        # reviewable POSTs may park (ReviewResult); sync GETs never 202.
+        is_async = name in ASYNC_ENDPOINTS
+        reviewable = method == "post" and name not in NO_REVIEW_REQUIRED
+        if is_async and reviewable:
+            responses["202"] = {
+                "description": "accepted (poll with the User-Task-ID "
+                               "header) or parked for review (two-step "
+                               "verification)",
+                "content": {"application/json": {"schema": {"oneOf": [
+                    {"$ref": "#/components/schemas/ProgressResponse"},
+                    {"$ref": "#/components/schemas/ReviewResult"}]}}}}
+        elif is_async:
+            responses["202"] = {
+                "description": "accepted; poll with the User-Task-ID "
+                               "header",
+                **_ref("ProgressResponse")}
+        elif reviewable:
+            responses["202"] = {
+                "description": "parked for review (two-step "
+                               "verification)",
+                **_ref("ReviewResult")}
         op = {
             "summary": summary,
             "operationId": name,
             "parameters": params,
-            "responses": {
-                "200": {"description": "completed result (JSON)"},
-                "202": {"description":
-                        "accepted; poll with the User-Task-ID header"},
-            },
+            "responses": responses,
         }
-        if method == "post":
-            op["responses"]["202"]["description"] += (
-                " or parked for review (two-step verification)")
         paths[f"{base_path}/{name}"] = {method: op}
     return {
         "openapi": "3.0.3",
@@ -144,9 +284,11 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
                                 "(reference parity: CruiseControlEndPoint)",
                  "version": "2.0"},
         "paths": paths,
-        "components": {"securitySchemes": {
-            "basicAuth": {"type": "http", "scheme": "basic"},
-            "bearerAuth": {"type": "http", "scheme": "bearer",
-                           "bearerFormat": "JWT"},
-        }},
+        "components": {
+            "schemas": _SCHEMAS,
+            "securitySchemes": {
+                "basicAuth": {"type": "http", "scheme": "basic"},
+                "bearerAuth": {"type": "http", "scheme": "bearer",
+                               "bearerFormat": "JWT"},
+            }},
     }

@@ -436,3 +436,31 @@ def test_user_task_capacity_and_retention():
     remaining = {t.user_task_id for t in mgr.all_tasks()}
     assert t1.user_task_id not in remaining
     mgr.shutdown()
+
+
+def test_openapi_parameters_generated_from_typed_specs(stack):
+    """The OpenAPI spec derives parameters from the SAME typed classes the
+    dispatcher validates with — every declared param of every endpoint
+    appears with its type/enum/default, so the spec cannot drift."""
+    from cruise_control_tpu.api.parameters import ENDPOINT_PARAMETERS
+    _, _, app = stack
+    status, spec, _ = call(app, "GET", "openapi")
+    assert status == 200
+    for endpoint, cls in ENDPOINT_PARAMETERS.items():
+        path = f"/kafkacruisecontrol/{endpoint}"
+        assert path in spec["paths"], endpoint
+        op = next(iter(spec["paths"][path].values()))
+        declared = {p["name"]: p for p in op["parameters"]}
+        for pname, pspec in cls.specs().items():
+            assert pname in declared, (endpoint, pname)
+            if pspec.kind == "enum":
+                assert set(declared[pname]["schema"]["enum"]) == {
+                    str(c) for c in pspec.choices}
+            elif pspec.kind == "bool":
+                assert declared[pname]["schema"]["type"] == "boolean"
+    # Response schemas resolve.
+    schemas = spec["components"]["schemas"]
+    reb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]
+    ref = reb["responses"]["200"]["content"]["application/json"][
+        "schema"]["$ref"]
+    assert ref.rsplit("/", 1)[1] in schemas
