@@ -37,6 +37,15 @@ capture() {  # capture <scenario> <timeout_s>
     return 1
   fi
   echo "[tpu_watch]   scenario $n OK: $(cat "$out")" >> bench_tpu/watch.log
+  # Tee into the TRACKED results file (bench_tpu/ is gitignored; the
+  # driver commits uncommitted work at round end, so on-chip numbers
+  # captured after the last interactive turn still reach the repo).
+  {
+    echo "$(date -u +%FT%TZ) scenario $n:"
+    echo '```json'
+    cat "$out"
+    echo '```'
+  } >> TPU_RESULTS.md
   return 0
 }
 
