@@ -217,6 +217,29 @@ class Executor:
         self.registry.gauge(
             _n(EXECUTOR_SENSOR, "has-ongoing-execution"),
             lambda: int(self.has_ongoing_execution()))
+        # Per-(action, state) gauges over the current execution's task
+        # tracker (ref the documented Executor sensor catalog,
+        # docs/wiki "Sensors.md": Executor.replica-action-in-progress,
+        # leadership-action-pending, ...-aborting/aborted/dead).
+        def _tracked(task_types, state):
+            def read():
+                tm = self._task_manager
+                if tm is None:
+                    return 0
+                return sum(tm.tracker.num_in(t, state) for t in task_types)
+            return read
+        _replica = (TaskType.INTER_BROKER_REPLICA_ACTION,
+                    TaskType.INTRA_BROKER_REPLICA_ACTION)
+        _leader = (TaskType.LEADER_ACTION,)
+        for action, types in (("replica", _replica),
+                              ("leadership", _leader)):
+            for state in (TaskState.PENDING, TaskState.IN_PROGRESS,
+                          TaskState.ABORTING, TaskState.ABORTED,
+                          TaskState.DEAD):
+                name = state.value.lower().replace("_", "-")
+                self.registry.gauge(
+                    _n(EXECUTOR_SENSOR, f"{action}-action-{name}"),
+                    _tracked(types, state))
 
     # ------------------------------------------------------------- state
     @property

@@ -139,3 +139,25 @@ def test_executor_sensors_after_execution(stack):
     moved = (reg.get("Executor.partition-movement-rate").count
              + reg.get("Executor.leadership-movement-rate").count)
     assert moved > 0
+
+
+def test_executor_per_action_state_gauges():
+    """ref the documented Executor sensor catalog (Sensors.md):
+    replica/leadership action gauges by task state exist, read 0 with no
+    execution, and surface through /metrics text exposition."""
+    from cruise_control_tpu.executor import (Executor, ExecutorConfig,
+                                             SimulatedKafkaCluster)
+    sim = SimulatedKafkaCluster()
+    for b in range(2):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1])
+    ex = Executor(sim, ExecutorConfig())
+    names = ex.registry.names()
+    for action in ("replica", "leadership"):
+        for state in ("pending", "in-progress", "aborting", "aborted",
+                      "dead"):
+            key = f"Executor.{action}-action-{state}"
+            assert key in names, key
+            assert ex.registry.get(key).value() == 0
+    text = ex.registry.expose_text()
+    assert "cc_Executor_replica_action_in_progress" in text
