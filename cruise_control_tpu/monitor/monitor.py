@@ -132,6 +132,42 @@ class LoadMonitor:
             MetricRegistry.name(LOAD_MONITOR_SENSOR,
                                 "num-monitored-partitions"),
             lambda: len(self.partition_aggregator.all_entities()))
+        # Remaining rows of the documented LoadMonitor sensor catalog
+        # (Sensors.md): topology health derived from ONE short-TTL admin
+        # snapshot per scrape — describe_partitions is O(P x replicas)
+        # against a real cluster, and a /metrics read hits all four
+        # gauges back-to-back.
+        self._topology_cache: tuple[float, dict] | None = None
+        for sensor in ("num-topics", "brokers-with-replicas",
+                       "dead-brokers-with-replicas",
+                       "has-partitions-with-isr-greater-than-replicas"):
+            self.registry.gauge(
+                MetricRegistry.name(LOAD_MONITOR_SENSOR, sensor),
+                (lambda key=sensor: self._topology_snapshot()[key]))
+
+    def _topology_snapshot(self, ttl_s: float = 5.0) -> dict:
+        import time as _time
+        now = _time.monotonic()
+        if self._topology_cache is not None:
+            stamp, snap = self._topology_cache
+            if now - stamp < ttl_s:
+                return snap
+        parts = self.admin.describe_partitions()
+        alive = self.admin.describe_cluster()
+        hosting = {b for info in parts.values() for b in info.replicas}
+        snap = {
+            "num-topics": len({t for t, _p in parts}),
+            "brokers-with-replicas": len(hosting),
+            "dead-brokers-with-replicas": sum(
+                1 for b in hosting if not alive.get(b, False)),
+            # The documented semantics: MORE ISR entries than replicas
+            # (a metadata anomaly), not "ISR outside the replica list".
+            "has-partitions-with-isr-greater-than-replicas": int(any(
+                len(info.isr) > len(info.replicas)
+                for info in parts.values())),
+        }
+        self._topology_cache = (now, snap)
+        return snap
 
     # -------------------------------------------------------------- ingest
     def add_samples(self, samples: Samples) -> None:

@@ -161,3 +161,39 @@ def test_executor_per_action_state_gauges():
             assert ex.registry.get(key).value() == 0
     text = ex.registry.expose_text()
     assert "cc_Executor_replica_action_in_progress" in text
+
+
+def test_load_monitor_topology_gauges():
+    """ref the LoadMonitor sensor catalog (Sensors.md): topology-health
+    gauges read live cluster state — topics, brokers with replicas, dead
+    brokers still hosting replicas, ISR>replicas flag."""
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b)
+    for p in range(6):
+        sim.add_partition(f"t{p % 2}", p, [p % 3, (p + 1) % 3])
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=2, window_ms=1000))
+
+    def read(name):
+        return monitor.registry.get(f"LoadMonitor.{name}").value()
+
+    assert read("num-topics") == 2
+    assert read("brokers-with-replicas") == 3      # broker 3 hosts nothing
+    assert read("dead-brokers-with-replicas") == 0
+    assert read("has-partitions-with-isr-greater-than-replicas") == 0
+    # Snapshot is TTL-cached (one admin describe per scrape, not four):
+    # expire it manually after mutating the cluster.
+    sim.kill_broker(2)
+    monitor._topology_cache = None
+    assert read("dead-brokers-with-replicas") == 1
+    # The gauge fires on |ISR| > |replicas| (metadata anomaly), not on
+    # ISR members outside the replica list.
+    info = sim.describe_partitions()[("t0", 0)]
+    info.isr.add(99)
+    info.isr.add(98)
+    while len(info.isr) <= len(info.replicas):
+        info.isr.add(90 + len(info.isr))
+    monitor._topology_cache = None
+    assert read("has-partitions-with-isr-greater-than-replicas") == 1
