@@ -70,13 +70,17 @@ class KafkaCruiseControl:
         #: time so a detector attached after construction is included.
         from ..core.sensors import CompositeRegistry
 
+        #: extra per-layer registries merged into the scrape view (the
+        #: web app appends its servlet-request sensors here).
+        self.extra_registries: list = []
+
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
                     self.executor.registry]
             if self.detector is not None and hasattr(self.detector,
                                                      "registry"):
                 regs.append(self.detector.registry)
-            return regs
+            return regs + list(self.extra_registries)
 
         self.registry = CompositeRegistry(_registries)
 

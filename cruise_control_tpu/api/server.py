@@ -109,6 +109,14 @@ class CruiseControlApp:
         #: reference's dual web-server engines (webserver.* configs apply
         #: to both).
         self.engine = engine
+        # Per-endpoint request sensors (ref the KafkaCruiseControlServlet
+        # sensor table: <endpoint>-request-rate and
+        # <endpoint>-successful-request-execution-timer), merged into the
+        # facade's scrape view.
+        from ..core.sensors import MetricRegistry as _MR
+        self.registry = _MR()
+        if hasattr(facade, "extra_registries"):
+            facade.extra_registries.append(self.registry)
         self._aio = None
         self.server = None
         if engine == "asyncio":
@@ -150,12 +158,35 @@ class CruiseControlApp:
         else:
             self.server.shutdown()
         self.tasks.shutdown()
+        # Detach our sensors: a new app over the same facade must not
+        # leave duplicate KafkaCruiseControlServlet.* series behind.
+        extra = getattr(self.facade, "extra_registries", None)
+        if extra is not None and self.registry in extra:
+            extra.remove(self.registry)
         self.facade.shutdown()
 
     # ------------------------------------------------------------ dispatch
     def handle(self, method: str, endpoint: str, params: dict,
                headers: dict) -> tuple[int, dict, dict]:
         """Returns (status, response_json, extra_headers)."""
+        import time as _time
+        # Sensors only for the fixed endpoint catalog (the reference keys
+        # them by the CruiseControlEndPoint enum): arbitrary path strings
+        # must not mint attacker-chosen series or grow the registry.
+        known = endpoint in GET_ENDPOINTS or endpoint in POST_ENDPOINTS
+        if known:
+            self.registry.meter(f"KafkaCruiseControlServlet."
+                                f"{endpoint}-request-rate").mark()
+        t0 = _time.monotonic()
+        out = self._handle(method, endpoint, params, headers)
+        if known and out[0] < 400:
+            self.registry.timer(
+                f"KafkaCruiseControlServlet.{endpoint}-successful-"
+                f"request-execution-timer").update(_time.monotonic() - t0)
+        return out
+
+    def _handle(self, method: str, endpoint: str, params: dict,
+                headers: dict) -> tuple[int, dict, dict]:
         principal = check_access(self.security, endpoint, headers)
         # Parameter names are case-insensitive (the typed layer lowercases
         # on parse); normalize once so the raw reads below (reason,

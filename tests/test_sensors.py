@@ -197,3 +197,28 @@ def test_load_monitor_topology_gauges():
         info.isr.add(90 + len(info.isr))
     monitor._topology_cache = None
     assert read("has-partitions-with-isr-greater-than-replicas") == 1
+
+
+def test_servlet_request_sensors(stack):
+    """ref the KafkaCruiseControlServlet sensor table: per-endpoint
+    request-rate meters and successful-request timers register on the
+    app's registry and surface through /metrics."""
+    import urllib.request
+    _, facade, app = stack
+    call(app, "GET", "state")
+    names = app.registry.names()
+    assert "KafkaCruiseControlServlet.state-request-rate" in names
+    assert ("KafkaCruiseControlServlet.state-successful-request-"
+            "execution-timer") in names
+    # A 4xx marks the rate but not the success timer.
+    call(app, "GET", "state", "nonsense_param=1", expect=400)
+    rate = app.registry.get(
+        "KafkaCruiseControlServlet.state-request-rate").count
+    timer = app.registry.get(
+        "KafkaCruiseControlServlet.state-successful-request-"
+        "execution-timer").count
+    assert rate > timer
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    assert "cc_KafkaCruiseControlServlet_state_request_rate_total" in text
