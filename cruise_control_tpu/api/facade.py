@@ -80,6 +80,9 @@ class KafkaCruiseControl:
             if self.detector is not None and hasattr(self.detector,
                                                      "registry"):
                 regs.append(self.detector.registry)
+            fetcher = getattr(self.task_runner, "fetcher", None)
+            if fetcher is not None and hasattr(fetcher, "registry"):
+                regs.append(fetcher.registry)
             return regs + list(self.extra_registries)
 
         self.registry = CompositeRegistry(_registries)

@@ -36,6 +36,12 @@ class ProposalCache:
         self.num_computations = 0
 
     # ------------------------------------------------------------- reads
+    def peek(self):
+        """The cached OptimizerResult without blocking or recompute (may
+        be stale or None) — for gauges that must never trigger work."""
+        with self._lock:
+            return self._cached
+
     def valid(self) -> bool:
         """ref validCachedProposal GoalOptimizer.java:232-239."""
         with self._lock:

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 # Distinct from builtin TimeoutError before Python 3.11.
 from concurrent.futures import TimeoutError as _FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -117,6 +118,20 @@ class CruiseControlApp:
         self.registry = _MR()
         if hasattr(facade, "extra_registries"):
             facade.extra_registries.append(self.registry)
+        # Pre-built enum-keyed sensor maps (the reference keys its servlet
+        # sensors by the CruiseControlEndPoint enum): no per-request
+        # registry lookups or name formatting on the dispatch path.
+        self._request_meters = {
+            (m, e): self.registry.meter(
+                f"KafkaCruiseControlServlet.{e}-request-rate")
+            for m, eps in (("GET", GET_ENDPOINTS), ("POST", POST_ENDPOINTS))
+            for e in eps}
+        self._success_timers = {
+            (m, e): self.registry.timer(
+                f"KafkaCruiseControlServlet.{e}-successful-"
+                f"request-execution-timer")
+            for m, eps in (("GET", GET_ENDPOINTS), ("POST", POST_ENDPOINTS))
+            for e in eps}
         self._aio = None
         self.server = None
         if engine == "asyncio":
@@ -169,20 +184,27 @@ class CruiseControlApp:
     def handle(self, method: str, endpoint: str, params: dict,
                headers: dict) -> tuple[int, dict, dict]:
         """Returns (status, response_json, extra_headers)."""
-        import time as _time
-        # Sensors only for the fixed endpoint catalog (the reference keys
-        # them by the CruiseControlEndPoint enum): arbitrary path strings
-        # must not mint attacker-chosen series or grow the registry.
-        known = endpoint in GET_ENDPOINTS or endpoint in POST_ENDPOINTS
-        if known:
-            self.registry.meter(f"KafkaCruiseControlServlet."
-                                f"{endpoint}-request-rate").mark()
-        t0 = _time.monotonic()
-        out = self._handle(method, endpoint, params, headers)
-        if known and out[0] < 400:
-            self.registry.timer(
-                f"KafkaCruiseControlServlet.{endpoint}-successful-"
-                f"request-execution-timer").update(_time.monotonic() - t0)
+        # Method-resolved sensors only (the reference meters requests the
+        # servlet actually dispatches): a GET probe of a POST endpoint, an
+        # unknown path, or an auth rejection never marks a rate; a
+        # dispatched request that fails (parse error, operation failure)
+        # still counts as a request, but only successes feed the timer.
+        meter = self._request_meters.get((method, endpoint))
+        timer = self._success_timers.get((method, endpoint))
+        t0 = time.monotonic()
+        try:
+            out = self._handle(method, endpoint, params, headers)
+        except AuthorizationError:
+            raise
+        except Exception:
+            if meter is not None:
+                meter.mark()
+            raise
+        status = out[0]
+        if meter is not None and status not in (401, 403, 405):
+            meter.mark()
+        if timer is not None and status < 400:
+            timer.update(time.monotonic() - t0)
         return out
 
     def _handle(self, method: str, endpoint: str, params: dict,

@@ -89,6 +89,32 @@ class AnomalyDetectorManager:
             for t in KafkaAnomalyType}
         self._time_to_start_fix = self.registry.timer(
             _n(ANOMALY_DETECTOR_SENSOR, "time-to-start-fix"))
+        # Per-type self-healing switches + provision verdict (remaining
+        # rows of the documented AnomalyDetector sensor table:
+        # <type>-self-healing-enabled, under/over-provisioned,
+        # right-sized).
+        for t in KafkaAnomalyType:
+            self.registry.gauge(
+                _n(ANOMALY_DETECTOR_SENSOR,
+                   f"{t.name.lower()}-self-healing-enabled"),
+                (lambda t=t: int(
+                    self.notifier.self_healing_enabled().get(t, False))))
+        for status in ("UNDER_PROVISIONED", "OVER_PROVISIONED",
+                       "RIGHT_SIZED"):
+            name = status.lower().replace("_provisioned", "-provisioned"
+                                          ).replace("_sized", "-sized")
+            self.registry.gauge(
+                _n(ANOMALY_DETECTOR_SENSOR, name),
+                (lambda s=status:
+                 int(self._provision_status() == s)))
+
+    def _provision_status(self) -> str | None:
+        """Status of the latest cached optimization's provision verdict
+        (ref the provision-state gauges fed by GoalViolationDetector)."""
+        cache = getattr(self.facade, "proposal_cache", None)
+        cached = cache.peek() if cache is not None else None
+        resp = getattr(cached, "provision_response", None)
+        return resp.status.value if resp is not None else None
 
     def _fixable(self, anomaly) -> bool:
         """Broker-failure anomalies stop being auto-fixable past the

@@ -30,7 +30,9 @@ class MetricFetcherManager:
     def __init__(self, sampler: MetricSampler, num_fetchers: int = 1,
                  store: SampleStore | None = None,
                  assignor: DefaultPartitionAssignor | None = None,
-                 on_execution_store: SampleStore | None = None) -> None:
+                 on_execution_store: SampleStore | None = None,
+                 registry=None) -> None:
+        from ..core.sensors import MetricRegistry
         self.sampler = sampler
         self.num_fetchers = max(1, num_fetchers)
         self.store = store or NoopSampleStore()
@@ -38,6 +40,13 @@ class MetricFetcherManager:
         #: optional secondary store for samples taken during an ongoing
         #: execution (ref KafkaPartitionMetricSampleOnExecutionStore)
         self.on_execution_store = on_execution_store
+        # ref the MetricFetcherManager sensor table (Sensors.md):
+        # per-round fetch timer + failure rate.
+        self.registry = registry or MetricRegistry()
+        self._fetch_timer = self.registry.timer(
+            "MetricFetcherManager.partition-samples-fetcher-timer")
+        self._fetch_failures = self.registry.meter(
+            "MetricFetcherManager.partition-samples-fetcher-failure-rate")
 
     def fetch(self, partitions: list[tuple[str, int]], brokers: list[int],
               start_ms: int, end_ms: int) -> Samples:
@@ -49,6 +58,15 @@ class MetricFetcherManager:
         processor buffer, the synthetic sampler's per-broker sums) must see
         the whole assignment in one call or they would race / double-count.
         """
+        try:
+            with self._fetch_timer.time():
+                return self._fetch(partitions, brokers, start_ms, end_ms)
+        except Exception:
+            self._fetch_failures.mark()
+            raise
+
+    def _fetch(self, partitions: list[tuple[str, int]], brokers: list[int],
+               start_ms: int, end_ms: int) -> Samples:
         parallel_safe = getattr(self.sampler, "parallel_safe", False)
         n = self.num_fetchers if parallel_safe else 1
         # Two-phase samplers (the agent-topic path) isolate their

@@ -222,3 +222,39 @@ def test_servlet_request_sensors(stack):
             f"http://127.0.0.1:{app.port}/metrics", timeout=30) as r:
         text = r.read().decode()
     assert "cc_KafkaCruiseControlServlet_state_request_rate_total" in text
+
+
+def test_fetcher_and_detector_catalog_sensors(stack):
+    """Remaining documented sensor rows: fetcher round timer/failure
+    rate, per-type self-healing-enabled switches, provision-state
+    gauges — all visible through the facade's merged scrape view."""
+    _, facade, app = stack
+    names = facade.registry.names()
+    assert "MetricFetcherManager.partition-samples-fetcher-timer" in names
+    assert ("MetricFetcherManager.partition-samples-fetcher-failure-rate"
+            in names)
+    # The stack sampled during build: the round timer recorded fetches.
+    timer = facade.registry.get(
+        "MetricFetcherManager.partition-samples-fetcher-timer")
+    assert timer.count >= 4
+    # Per-type switches + provision-state gauges read real values
+    # (detector built over the same facade).
+    from cruise_control_tpu.detector import (AnomalyDetectorManager,
+                                             SelfHealingNotifier)
+    detector = AnomalyDetectorManager(facade, SelfHealingNotifier())
+    det_names = detector.registry.names()
+    for t in ("broker_failure", "goal_violation", "disk_failure"):
+        key = f"AnomalyDetector.{t}-self-healing-enabled"
+        assert key in det_names, key
+        assert detector.registry.get(key).value() in (0, 1)
+    # Provision-state gauges are mutually exclusive booleans driven by
+    # the facade's cached optimization (the shared stack may or may not
+    # have one by now).
+    values = []
+    for g in ("under-provisioned", "over-provisioned", "right-sized"):
+        key = f"AnomalyDetector.{g}"
+        assert key in det_names, key
+        v = detector.registry.get(key).value()
+        assert v in (0, 1), (key, v)
+        values.append(v)
+    assert sum(values) <= 1
