@@ -341,3 +341,30 @@ def test_throttle_excluded_brokers_run_unthrottled():
     # Non-excluded participants are still throttled.
     assert LEADER_THROTTLED_RATE in sim.describe_broker_config(0)
     helper.clear_throttles()
+
+
+def test_strategy_chaining_tiebreaks_in_declared_order():
+    """ref ReplicaMovementStrategy.chain: the first strategy dominates,
+    later strategies break its ties, and every chain ends at the
+    deterministic base ordering (execution id)."""
+    ctx = StrategyContext(
+        partition_size_mb={("t", 0): 50.0, ("t", 1): 50.0, ("t", 2): 1.0},
+        urp={("t", 0)},
+        min_isr_with_offline={("t", 1)})
+    tasks = [ExecutionTask(i, ExecutionProposal("t", i, 0, (0, 1), (0, 2)),
+                           TaskType.INTER_BROKER_REPLICA_ACTION)
+             for i in range(3)]
+    # URP postponement dominates; among non-URP, min-ISR-with-offline
+    # urgency wins; ids break remaining ties.
+    chain = strategy_chain(["PostponeUrpReplicaMovementStrategy",
+                            "PrioritizeMinIsrWithOfflineReplicasStrategy"])
+    ordered = sorted(tasks, key=lambda t: chain.key(t, ctx))
+    assert [t.proposal.partition for t in ordered] == [1, 2, 0]
+    # Flipping the chain flips the dominance.
+    chain2 = strategy_chain(["PrioritizeMinIsrWithOfflineReplicasStrategy",
+                             "PostponeUrpReplicaMovementStrategy"])
+    ordered2 = sorted(tasks, key=lambda t: chain2.key(t, ctx))
+    assert ordered2[0].proposal.partition == 1
+    # Unknown strategy names fail loudly.
+    with pytest.raises(Exception):
+        strategy_chain(["NoSuchStrategy"])
