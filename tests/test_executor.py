@@ -347,24 +347,29 @@ def test_strategy_chaining_tiebreaks_in_declared_order():
     """ref ReplicaMovementStrategy.chain: the first strategy dominates,
     later strategies break its ties, and every chain ends at the
     deterministic base ordering (execution id)."""
+    # Partition 1 is in BOTH sets, so the two chain orders genuinely
+    # disagree about it: URP-first postpones it, min-ISR-first leads
+    # with it.
     ctx = StrategyContext(
         partition_size_mb={("t", 0): 50.0, ("t", 1): 50.0, ("t", 2): 1.0},
-        urp={("t", 0)},
+        urp={("t", 0), ("t", 1)},
         min_isr_with_offline={("t", 1)})
     tasks = [ExecutionTask(i, ExecutionProposal("t", i, 0, (0, 1), (0, 2)),
                            TaskType.INTER_BROKER_REPLICA_ACTION)
              for i in range(3)]
-    # URP postponement dominates; among non-URP, min-ISR-with-offline
-    # urgency wins; ids break remaining ties.
+    # URP postponement dominates: the urgent-but-URP partition 1 sinks
+    # behind healthy partition 2; ids break remaining ties (0 before 1
+    # in the postponed group... 0 and 1 are both URP -> min-ISR breaks).
     chain = strategy_chain(["PostponeUrpReplicaMovementStrategy",
                             "PrioritizeMinIsrWithOfflineReplicasStrategy"])
     ordered = sorted(tasks, key=lambda t: chain.key(t, ctx))
-    assert [t.proposal.partition for t in ordered] == [1, 2, 0]
-    # Flipping the chain flips the dominance.
+    assert [t.proposal.partition for t in ordered] == [2, 1, 0]
+    # Flipping the chain flips the dominance: min-ISR urgency now leads
+    # with partition 1 despite its URP status.
     chain2 = strategy_chain(["PrioritizeMinIsrWithOfflineReplicasStrategy",
                              "PostponeUrpReplicaMovementStrategy"])
     ordered2 = sorted(tasks, key=lambda t: chain2.key(t, ctx))
-    assert ordered2[0].proposal.partition == 1
+    assert [t.proposal.partition for t in ordered2] == [1, 2, 0]
     # Unknown strategy names fail loudly.
-    with pytest.raises(Exception):
+    with pytest.raises(KeyError, match="NoSuchStrategy"):
         strategy_chain(["NoSuchStrategy"])
