@@ -416,6 +416,12 @@ def run_demo_scenario():
          "s", None)
 
 
+#: set by main() once the backend probe resolves; read by the crash
+#: handler below WITHOUT touching jax (a device query on a dead tunnel
+#: hangs — the very failure the handler recovers from).
+_RESOLVED_PLATFORM: str | None = None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
@@ -438,6 +444,8 @@ def main():
     # back to CPU and still emits the JSON line (platform is logged).
     from cruise_control_tpu.utils.platform import ensure_live_backend
     platform = ensure_live_backend()
+    global _RESOLVED_PLATFORM
+    _RESOLVED_PLATFORM = platform
     import jax
     if args.scenario != 2:
         log(f"platform: {platform} -> {jax.devices()[0].platform}")
@@ -510,4 +518,28 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception:
+        # The axon tunnel can die MID-RUN (after the health probe passed):
+        # every device op then raises UNAVAILABLE and the bench would exit
+        # with no JSON line at all. One retry, pinned to CPU — an honest
+        # platform:"cpu" row beats an empty artifact. The guard env stops
+        # a loop; a CPU-pinned failure is a real bug and propagates.
+        import os
+        import sys
+        import traceback
+        if os.environ.get("CC_BENCH_RETRIED"):
+            raise
+        # Derive the platform WITHOUT a device query (jax.devices() on a
+        # dead tunnel hangs in backend init). _RESOLVED_PLATFORM is None
+        # when the crash predates the probe — retry on CPU then too.
+        resolved = _RESOLVED_PLATFORM or ""
+        if resolved.startswith("cpu"):
+            raise
+        traceback.print_exc()
+        log("bench failed on the non-CPU backend (tunnel died mid-run?); "
+            "re-running pinned to CPU")
+        os.execvpe(sys.executable, [sys.executable, *sys.argv],
+                   {**os.environ, "JAX_PLATFORMS": "cpu",
+                    "CC_BENCH_RETRIED": "1"})
