@@ -265,10 +265,17 @@ class CompiledGoalChain:
             run = make_goal_pass(g, self.goals[:i], cfg,
                                  all_goals=self.goals)
             self.passes.append(jax.jit(run, donate_argnums=(0,)))
-        self._violations = jax.jit(self._violations_impl)
+        self._aux = jax.jit(self._aux_impl)
 
-    def _violations_impl(self, state, ctx):
-        return violation_stack(self.goals, state, ctx)
+    def _aux_impl(self, state, ctx):
+        """Everything the host loop reads *before* the goal passes, fused
+        into one dispatch: (offline.any() — the broken-broker self-check
+        exemption, f32[G] per-goal rounding scales, f32[G] initial
+        violation stack). One tunnel round trip instead of G + 2."""
+        return (state.offline.any(),
+                jnp.stack([g.violation_scale(state, ctx)
+                           for g in self.goals]),
+                violation_stack(self.goals, state, ctx))
 
     @staticmethod
     def _shape_key(*trees) -> tuple:
@@ -315,7 +322,7 @@ class CompiledGoalChain:
                 enable_compilation_cache()
                 from concurrent.futures import ThreadPoolExecutor
                 jobs = [(p, (state, ctx, key)) for p in self.passes]
-                jobs.append((self._violations, (state, ctx)))
+                jobs.append((self._aux, (state, ctx)))
                 with ThreadPoolExecutor(max_workers
                                         or min(len(jobs), 16)) as ex:
                     list(ex.map(lambda j: j[0].lower(*j[1]).compile(), jobs))
@@ -331,5 +338,11 @@ class CompiledGoalChain:
             return
 
     def violations(self, state, ctx) -> jax.Array:
-        """f32[num_goals] residual per goal."""
-        return self._violations(state, ctx)
+        """f32[num_goals] residual per goal (aux's third element — one
+        compiled program serves both readings)."""
+        return self._aux(state, ctx)[2]
+
+    def aux(self, state, ctx):
+        """(offline.any(), f32[G] violation scales, f32[G] violations) in
+        one dispatch — the host loop's pre-pass readings."""
+        return self._aux(state, ctx)

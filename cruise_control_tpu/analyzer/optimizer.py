@@ -109,6 +109,37 @@ class OptimizationFailureError(RuntimeError):
         self.result = result
 
 
+def _walk_passes(chain, idxs, state, ctx, keys, on_start=None):
+    """Run ``chain.passes[i] for i in idxs`` back-to-back with NO host
+    read in between: every pass is dispatched before any result is
+    fetched, so the device (and, under axon, the tunnel) pipelines the
+    walk with one sync at the end instead of two per pass — per-pass host
+    reads dominate wall-clock for small models behind a high-latency
+    transport.
+
+    Passes execute in dispatch order (each consumes its predecessor's
+    donated state), so blocking on each stack in turn yields completion
+    timestamps and hence per-pass durations; the first pass's reading
+    absorbs the dispatch loop itself. ``on_start(j)`` fires at execution
+    (not dispatch) order so OperationProgress tracks the pass actually
+    running. Returns ``(state, [(iters, stack), ...] fetched to host,
+    [duration_s, ...])``."""
+    dispatched = []
+    for i, k in zip(idxs, keys):
+        state, iters, stack = chain.passes[i](state, ctx, k)
+        dispatched.append((iters, stack))
+    t0 = time.monotonic()
+    times = []
+    for j, (_, stack) in enumerate(dispatched):
+        if on_start is not None:
+            on_start(j)
+        jax.block_until_ready(stack)
+        times.append(time.monotonic())
+    durations = [times[j] - (times[j - 1] if j else t0)
+                 for j in range(len(times))]
+    return state, jax.device_get(dispatched), durations
+
+
 class TpuGoalOptimizer:
     """Owns compiled goal chains; reusable across models with the same padded
     shapes (recompiles transparently otherwise — XLA cache keyed on shapes)."""
@@ -245,25 +276,32 @@ class TpuGoalOptimizer:
         # One violation stack per goal boundary: stack[i] before goal i runs
         # doubles as stack[j<i] "after" readings (matches the per-goal stats
         # the reference records at GoalOptimizer.java:458-497).
-        goal_results: list[GoalResult] = []
+        #
+        # The chain walk is fully async: every goal pass is dispatched
+        # before any result is read, so the device (and, under axon, the
+        # tunnel) pipelines the whole chain with ONE host sync at the end
+        # instead of two per goal — per-goal host reads dominate wall-clock
+        # for small models behind a high-latency transport. Pre-pass
+        # readings (broken-broker flag, per-goal rounding scales, initial
+        # violation stack) ride one fused aux dispatch for the same reason.
+        aux = chain.aux(state, ctx)
+        state, fetched, durations = _walk_passes(
+            chain, range(len(goals)), state, ctx,
+            [jax.random.fold_in(key, i) for i in range(len(goals))],
+            on_start=(None if on_goal_start is None
+                      else lambda j: on_goal_start(goals[j].name)))
         # ref AbstractGoal.java:110-119: the "never worsen" assertion only
         # runs when brokenBrokers.isEmpty() — a dead-broker drain's
         # must-moves (remove_brokers, fix_offline_replicas, self-healing)
         # bypass the per-candidate improvement test and may legitimately
         # worsen a goal's own residual while healing the cluster.
-        has_broken = bool(jax.device_get(state.offline.any()))
-        # Per-goal rounding scale for the satisfied cutoff (one tiny [B]
-        # reduction per goal, done once per optimize).
-        scales = [float(jax.device_get(g.violation_scale(state, ctx)))
-                  for g in goals]
-        boundary = np.asarray(chain.violations(state, ctx))
-        for i, (goal, gpass) in enumerate(zip(goals, chain.passes)):
-            if on_goal_start is not None:
-                on_goal_start(goal.name)
-            g0 = time.monotonic()
+        has_broken_raw, scales_arr, v0 = jax.device_get(aux)
+        has_broken = bool(has_broken_raw)
+        scales = [float(s) for s in scales_arr]
+        goal_results: list[GoalResult] = []
+        boundary = np.asarray(v0)
+        for i, (goal, (iters, stack)) in enumerate(zip(goals, fetched)):
             before_i = float(boundary[i])
-            state, iters, stack = gpass(state, ctx,
-                                        jax.random.fold_in(key, i))
             boundary = np.asarray(stack)
             after_i = float(boundary[i])
             # Self-check (ref AbstractGoal.java:110-119: the optimization
@@ -288,8 +326,8 @@ class TpuGoalOptimizer:
                 name=goal.name, hard=goal.hard,
                 violation_before=before_i,
                 violation_after=after_i,
-                duration_s=time.monotonic() - g0,
-                iterations=int(jax.device_get(iters)),
+                duration_s=durations[i],
+                iterations=int(iters),
                 scale=scales[i]))
 
         # Polish passes: later goals' accepted actions may have drifted
@@ -304,21 +342,30 @@ class TpuGoalOptimizer:
         # so a goal can never be skipped as converged yet reported
         # VIOLATED.
         polish_eps = min(cfg.epsilon, 1e-6)
-        for rnd in range(cfg.polish_passes):
+        # +1: skip decisions use each round's *starting* boundary (so the
+        # whole round dispatches async with one fetch — a per-goal host
+        # sync is what the async walk exists to avoid), which means drift
+        # created by a pass onto an already-converged goal inside the LAST
+        # budgeted round would go unseen; the extra round is the catch-up
+        # sweep for exactly that case and is skipped whenever the previous
+        # round ended clean. ``not (<=)`` keeps NaN residuals (broken goal
+        # kernel) in the todo set rather than silently converged.
+        for rnd in range(cfg.polish_passes + 1 if cfg.polish_passes else 0):
             if (boundary <= polish_eps).all():
                 break
-            for i, (goal, gpass) in enumerate(zip(goals, chain.passes)):
-                if boundary[i] <= polish_eps:
-                    continue
-                g0 = time.monotonic()
-                state, iters, stack = gpass(
-                    state, ctx, jax.random.fold_in(key, 1000 * (rnd + 1) + i))
+            todo = [i for i in range(len(goals))
+                    if not (boundary[i] <= polish_eps)]
+            state, fetched, durations = _walk_passes(
+                chain, todo, state, ctx,
+                [jax.random.fold_in(key, 1000 * (rnd + 1) + i)
+                 for i in todo])
+            for j, (i, (iters, stack)) in enumerate(zip(todo, fetched)):
                 boundary = np.asarray(stack)
                 gr = goal_results[i]
                 goal_results[i] = replace(
                     gr, violation_after=float(boundary[i]),
-                    duration_s=gr.duration_s + time.monotonic() - g0,
-                    iterations=gr.iterations + int(jax.device_get(iters)))
+                    duration_s=gr.duration_s + durations[j],
+                    iterations=gr.iterations + int(iters))
 
         # The boundary stack is the ground truth for final residuals; a
         # goal's stored reading can be stale if a later pass moved it.

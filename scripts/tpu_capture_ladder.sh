@@ -1,0 +1,20 @@
+#!/bin/bash
+# Sequential on-chip capture of the full scenario ladder (run while the
+# axon tunnel is up). Appends every platform:"tpu" JSON line to
+# TPU_RESULTS.md and drops raw outputs in bench_tpu/.
+cd /root/repo
+mkdir -p bench_tpu
+for run in "1:" "2:" "5:" "3:" "4:" "4:add_brokers" "4:remove_brokers"; do
+  s="${run%%:*}"; v="${run#*:}"
+  tag="s${s}${v:+_$v}"
+  args=(--scenario "$s"); [ -n "$v" ] && args+=(--variant "$v")
+  echo "=== $tag $(date -u +%H:%M:%S) ===" >> bench_tpu/ladder.log
+  timeout 3600 python bench.py "${args[@]}" > "bench_tpu/$tag.json" 2> "bench_tpu/$tag.err"
+  rc=$?
+  echo "rc=$rc" >> bench_tpu/ladder.log
+  if grep -q '"platform": "tpu"' "bench_tpu/$tag.json" 2>/dev/null; then
+    { echo; echo "## $tag ($(date -u +%Y-%m-%dT%H:%MZ))"; echo '```json'
+      cat "bench_tpu/$tag.json"; echo '```'; } >> TPU_RESULTS.md
+  fi
+done
+echo "LADDER DONE $(date -u +%H:%M:%S)" >> bench_tpu/ladder.log
