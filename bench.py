@@ -521,10 +521,10 @@ def _is_transport_death(exc: BaseException) -> bool:
     """Only backend/tunnel deaths qualify for the CPU-pinned retry — a
     deterministic failure (quality gate, hard-goal check) must stay a
     loud TPU failure, not quietly become a clean CPU row."""
-    msg = str(exc)
+    msg = str(exc).lower()
     return any(tok in msg for tok in (
-        "UNAVAILABLE", "DEADLINE_EXCEEDED",
-        "Socket closed", "connection", "failed to connect",
+        "unavailable", "deadline_exceeded",
+        "socket closed", "connection", "failed to connect",
         "device is in an invalid state"))
 
 

@@ -245,3 +245,74 @@ def test_warmup_waiter_retries_after_owner_failure(monkeypatch):
     wkey = chain._shape_key(state, ctx)
     assert chain._warm_events[wkey].is_set()
     assert calls["n"] >= 2
+
+
+# --- async chain walk (optimizer._walk_passes) -------------------------------
+
+def test_walk_passes_order_durations_and_fetch():
+    """The pipelined walk must preserve pass order (each pass consumes its
+    predecessor's state), fire on_start in execution order, and fetch every
+    pass's (iters, stack) with per-pass durations."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.optimizer import _walk_passes
+
+    class FakeChain:
+        def __init__(self):
+            self.passes = [self._make(i) for i in range(4)]
+
+        @staticmethod
+        def _make(i):
+            def run(state, ctx, key):
+                state = state + (i + 1)
+                return (state, jnp.asarray(i, jnp.int32),
+                        state * jnp.ones((2,), jnp.float32))
+            return run
+
+    chain = FakeChain()
+    order = []
+    state, fetched, durs = _walk_passes(
+        chain, [0, 1, 2, 3], jnp.zeros(()), None, [None] * 4,
+        on_start=order.append)
+    assert order == [0, 1, 2, 3]
+    assert float(state) == 10.0              # 1+2+3+4 applied in order
+    assert [int(it) for it, _ in fetched] == [0, 1, 2, 3]
+    assert np.allclose([float(s[0]) for _, s in fetched], [1, 3, 6, 10])
+    assert len(durs) == 4 and all(d >= 0 for d in durs)
+
+
+def test_on_goal_start_follows_chain_order(balance_optimizer):
+    """The progress hook fires once per goal, in chain order, even though
+    every pass is dispatched before any result is read."""
+    model, md = flatten_spec(make_cluster())
+    seen = []
+    res = balance_optimizer.optimize(model, md, OptimizationOptions(seed=5),
+                                     on_goal_start=seen.append)
+    assert seen == BALANCE_GOALS
+    assert all(g.duration_s >= 0 for g in res.goal_results)
+    # Completion-timestamp durations partition the walk's wall-clock, so
+    # their sum stays within the whole optimize duration.
+    assert sum(g.duration_s for g in res.goal_results) <= res.duration_s + 0.5
+
+
+def test_polish_disabled_with_zero_passes(monkeypatch):
+    """polish_passes=0 must disable polishing entirely (the catch-up sweep
+    only exists to cover drift created inside budgeted rounds): the walk
+    helper runs exactly once — the main chain walk, no polish rounds."""
+    from cruise_control_tpu.analyzer import optimizer as om
+    calls = []
+    real = om._walk_passes
+    monkeypatch.setattr(om, "_walk_passes",
+                        lambda *a, **k: calls.append(1) or real(*a, **k))
+    cfg = SearchConfig(num_replica_candidates=64, num_dest_candidates=8,
+                       apply_per_iter=16, max_iters_per_goal=64,
+                       polish_passes=0)
+    opt = TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS), config=cfg)
+    model, md = flatten_spec(make_cluster())
+    res = opt.optimize(model, md, OptimizationOptions(
+        seed=1, skip_hard_goal_check=True))
+    assert len(calls) == 1, "polish rounds ran despite polish_passes=0"
+    assert sanity_check(res.final_model)["duplicate_replica_brokers"] == 0
+    by_name = {g.name: g for g in res.goal_results}
+    assert by_name["ReplicaDistributionGoal"].violation_after \
+        <= by_name["ReplicaDistributionGoal"].violation_before + 1e-6
