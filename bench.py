@@ -340,9 +340,12 @@ def run_replan_scenario(num_requests: int = 30, mesh_devices: int = 0):
     model, md = build_flat_direct(NUM_BROKERS, NUM_PARTITIONS, RF)
     opt = TpuGoalOptimizer(
         goals=goals_by_name(GOALS),
+        # fused_chain: the replan path is latency-bound (one model, small
+        # passes, 1 req/s budget) — a single dispatch + sync per request
+        # beats per-goal dispatches behind the tunnel's round-trip time.
         config=SearchConfig(num_replica_candidates=512,
                             num_dest_candidates=16, apply_per_iter=512,
-                            max_iters_per_goal=256),
+                            max_iters_per_goal=256, fused_chain=True),
         mesh=_make_mesh(mesh_devices))
     # Warm the compiled chain once (a live server has it warm already).
     opt.optimize(model, md, OptimizationOptions(seed=0, fast_mode=True,

@@ -100,6 +100,15 @@ class SearchConfig:
     #: regressions, ref ResourceDistributionGoal.actionAcceptance), and a
     #: converged goal re-exits in ~stall_patience cheap iterations.
     polish_passes: int = 2
+    #: run the whole goal chain as ONE jitted program (single device
+    #: dispatch + single host sync per optimize) instead of one jit per
+    #: goal. Worth it when per-dispatch transport latency dominates pass
+    #: compute — small models served over a tunneled device (the 3-broker
+    #: demo, 1 req/s self-healing replans). Trade-offs: one big XLA
+    #: compile instead of parallel per-pass compiles, and per-goal
+    #: wall-clock is no longer observable (durations are attributed
+    #: proportionally to iteration counts).
+    fused_chain: bool = False
     epsilon: float = 1e-6
     # Tie-break noise magnitude relative to priority scale (deterministic,
     # PRNG-keyed; keeps tests reproducible while diversifying candidates).

@@ -261,11 +261,17 @@ class CompiledGoalChain:
         self._warm_events: dict[tuple, threading.Event] = {}
         self._warm_lock = threading.Lock()
         self.passes = []
+        self._pass_fns = []
         for i, g in enumerate(self.goals):
             run = make_goal_pass(g, self.goals[:i], cfg,
                                  all_goals=self.goals)
+            self._pass_fns.append(run)
             self.passes.append(jax.jit(run, donate_argnums=(0,)))
         self._aux = jax.jit(self._aux_impl)
+        #: single-program whole-chain walk (cfg.fused_chain): one dispatch
+        #: + one sync per optimize. Compiled lazily on first use so the
+        #: default per-goal path never pays its (serial) XLA compile.
+        self._fused = jax.jit(self._fused_impl, donate_argnums=(0,))
 
     def _aux_impl(self, state, ctx):
         """Everything the host loop reads *before* the goal passes, fused
@@ -276,6 +282,22 @@ class CompiledGoalChain:
                 jnp.stack([g.violation_scale(state, ctx)
                            for g in self.goals]),
                 violation_stack(self.goals, state, ctx))
+
+    def _fused_impl(self, state, ctx, key):
+        """The whole lexicographic chain in one traced program: every
+        per-goal pass body inlined back-to-back, plus the aux readings —
+        so one dispatch and one host fetch cover what the per-goal path
+        spreads over G dispatches. Key folding matches the per-goal walk
+        exactly (fold_in(key, i)), so both paths produce identical moves.
+        Returns (state, aux, i32[G] per-goal iters, f32[G, G] boundary
+        stacks — row i is the violation stack after goal i)."""
+        aux = self._aux_impl(state, ctx)
+        iters, bounds = [], []
+        for i, run in enumerate(self._pass_fns):
+            state, it, stack = run(state, ctx, jax.random.fold_in(key, i))
+            iters.append(it)
+            bounds.append(stack)
+        return state, aux, jnp.stack(iters), jnp.stack(bounds)
 
     @staticmethod
     def _shape_key(*trees) -> tuple:
@@ -321,8 +343,16 @@ class CompiledGoalChain:
                 from ..utils.platform import enable_compilation_cache
                 enable_compilation_cache()
                 from concurrent.futures import ThreadPoolExecutor
-                jobs = [(p, (state, ctx, key)) for p in self.passes]
-                jobs.append((self._aux, (state, ctx)))
+                if self.cfg.fused_chain:
+                    # The fused program is the ONLY program this mode
+                    # runs — its output carries the aux readings, and
+                    # polish rounds are further fused dispatches (the
+                    # optimizer's fused polish branch never touches the
+                    # per-goal passes), so nothing else needs compiling.
+                    jobs = [(self._fused, (state, ctx, key))]
+                else:
+                    jobs = [(p, (state, ctx, key)) for p in self.passes]
+                    jobs.append((self._aux, (state, ctx)))
                 with ThreadPoolExecutor(max_workers
                                         or min(len(jobs), 16)) as ex:
                     list(ex.map(lambda j: j[0].lower(*j[1]).compile(), jobs))
@@ -346,3 +376,7 @@ class CompiledGoalChain:
         """(offline.any(), f32[G] violation scales, f32[G] violations) in
         one dispatch — the host loop's pre-pass readings."""
         return self._aux(state, ctx)
+
+    def fused(self, state, ctx, key):
+        """One-dispatch whole-chain walk (see ``_fused_impl``)."""
+        return self._fused(state, ctx, key)

@@ -284,18 +284,42 @@ class TpuGoalOptimizer:
         # for small models behind a high-latency transport. Pre-pass
         # readings (broken-broker flag, per-goal rounding scales, initial
         # violation stack) ride one fused aux dispatch for the same reason.
-        aux = chain.aux(state, ctx)
-        state, fetched, durations = _walk_passes(
-            chain, range(len(goals)), state, ctx,
-            [jax.random.fold_in(key, i) for i in range(len(goals))],
-            on_start=(None if on_goal_start is None
-                      else lambda j: on_goal_start(goals[j].name)))
+        if cfg.fused_chain:
+            # One device dispatch + one host fetch for the entire chain
+            # (latency-bound serving: demo clusters, self-healing replans
+            # over a tunneled device). Key folding inside the fused
+            # program matches the per-goal walk, so the MAIN walk's moves
+            # are identical across modes; if residuals survive into
+            # polish, the modes diverge there (fused polish re-runs the
+            # whole chain under a distinct PRNG stream, per-goal polish
+            # re-runs only the unconverged subset) — both land on valid
+            # converged plans, just not bit-identical ones.
+            if on_goal_start is not None:
+                for g in goals:
+                    on_goal_start(g.name)
+            t_walk = time.monotonic()
+            state, aux, iters_arr, bounds = chain.fused(state, ctx, key)
+            (has_broken_raw, scales_arr, v0), iters_np, bounds_np = \
+                jax.device_get((aux, iters_arr, bounds))
+            walk_s = time.monotonic() - t_walk
+            # Per-goal wall-clock is unobservable inside one program;
+            # attribute the fused walk proportionally to iteration counts.
+            total_iters = max(int(iters_np.sum()), 1)
+            durations = [walk_s * int(it) / total_iters for it in iters_np]
+            fetched = list(zip(iters_np, bounds_np))
+        else:
+            aux = chain.aux(state, ctx)
+            state, fetched, durations = _walk_passes(
+                chain, range(len(goals)), state, ctx,
+                [jax.random.fold_in(key, i) for i in range(len(goals))],
+                on_start=(None if on_goal_start is None
+                          else lambda j: on_goal_start(goals[j].name)))
+            has_broken_raw, scales_arr, v0 = jax.device_get(aux)
         # ref AbstractGoal.java:110-119: the "never worsen" assertion only
         # runs when brokenBrokers.isEmpty() — a dead-broker drain's
         # must-moves (remove_brokers, fix_offline_replicas, self-healing)
         # bypass the per-candidate improvement test and may legitimately
         # worsen a goal's own residual while healing the cluster.
-        has_broken_raw, scales_arr, v0 = jax.device_get(aux)
         has_broken = bool(has_broken_raw)
         scales = [float(s) for s in scales_arr]
         goal_results: list[GoalResult] = []
@@ -353,6 +377,25 @@ class TpuGoalOptimizer:
         for rnd in range(cfg.polish_passes + 1 if cfg.polish_passes else 0):
             if (boundary <= polish_eps).all():
                 break
+            if cfg.fused_chain:
+                # Fused mode never touches the per-goal programs (they
+                # would each pay an XLA compile on first use — a latency
+                # spike on exactly the latency-bound path fused serves):
+                # a polish round is one more fused whole-chain dispatch;
+                # converged goals exit in ~stall_patience cheap
+                # iterations.
+                tp0 = time.monotonic()
+                state, _aux2, it2, b2 = chain.fused(
+                    state, ctx, jax.random.fold_in(key, 50_000 + rnd))
+                it2, b2 = jax.device_get((it2, b2))
+                w = time.monotonic() - tp0
+                tot = max(int(it2.sum()), 1)
+                boundary = np.asarray(b2[-1])
+                for i, gr in enumerate(goal_results):
+                    goal_results[i] = replace(
+                        gr, duration_s=gr.duration_s + w * int(it2[i]) / tot,
+                        iterations=gr.iterations + int(it2[i]))
+                continue
             todo = [i for i in range(len(goals))
                     if not (boundary[i] <= polish_eps)]
             state, fetched, durations = _walk_passes(

@@ -223,6 +223,14 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "unsharded; N is clamped to the devices jax exposes. On "
                  "multi-chip TPU hosts this puts the goal search's "
                  "per-iteration broker aggregates on ICI all-reduces.")
+    d.define("search.fused.chain", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Run the whole goal chain as one jitted program (single "
+                 "device dispatch + single host sync per optimize). Wins "
+                 "when per-dispatch transport latency dominates pass "
+                 "compute — small models served over a tunneled device; "
+                 "per-goal wall-clock is then attributed by iteration "
+                 "share instead of measured.")
     d.define("goals", ConfigType.LIST, "", importance=Importance.HIGH,
              doc="Full supported goal list (reference key; default.goals "
                  "is the active chain — empty inherits the built-in order)")
@@ -786,7 +794,8 @@ class CruiseControlConfig(AbstractConfig):
                 "search.num.replica.candidates"),
             num_dest_candidates=self.get_int("search.num.dest.candidates"),
             num_swap_candidates=self.get_int("search.num.swap.candidates"),
-            max_iters_per_goal=self.get_int("search.max.iters.per.goal"))
+            max_iters_per_goal=self.get_int("search.max.iters.per.goal"),
+            fused_chain=self.get_boolean("search.fused.chain"))
 
     def executor_config(self) -> ExecutorConfig:
         throttle = self.get_int("default.replication.throttle")

@@ -316,3 +316,31 @@ def test_polish_disabled_with_zero_passes(monkeypatch):
     by_name = {g.name: g for g in res.goal_results}
     assert by_name["ReplicaDistributionGoal"].violation_after \
         <= by_name["ReplicaDistributionGoal"].violation_before + 1e-6
+
+
+def test_fused_chain_matches_per_goal_walk():
+    """cfg.fused_chain runs the whole chain as one jitted program; key
+    folding inside it matches the per-goal walk, so the MAIN walk's moves
+    are identical. Exact equality holds only when no polish round fires
+    (polish streams differ by design) — the zero-residual assert below
+    makes that precondition explicit rather than luck."""
+    model, md = flatten_spec(make_cluster())
+    base = dict(num_replica_candidates=64, num_dest_candidates=8,
+                apply_per_iter=16, max_iters_per_goal=64)
+    res_a = TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS),
+                             config=SearchConfig(**base)).optimize(
+        model, md, OptimizationOptions(seed=7))
+    # Precondition for exact cross-mode equality: the main walk converges
+    # every goal, so neither mode runs polish.
+    assert all(g.violation_after <= 1e-6 for g in res_a.goal_results)
+    res_b = TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS),
+                             config=SearchConfig(**base, fused_chain=True)
+                             ).optimize(model, md, OptimizationOptions(seed=7))
+    assert np.array_equal(np.asarray(res_a.final_model.replica_broker),
+                          np.asarray(res_b.final_model.replica_broker))
+    assert res_a.proposals == res_b.proposals
+    for ga, gb in zip(res_a.goal_results, res_b.goal_results):
+        assert ga.name == gb.name
+        assert abs(ga.violation_after - gb.violation_after) <= 1e-6
+        assert ga.iterations == gb.iterations
+        assert gb.duration_s >= 0
