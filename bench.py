@@ -401,8 +401,14 @@ def run_demo_scenario():
     runner.start(-1, skip_loading=True)
     for w in range(4):
         runner.maybe_run_sampling((w + 1) * 1000 - 1)
-    facade = KafkaCruiseControl(sim, monitor, task_runner=runner,
-                                now_ms=lambda: 4000)
+    # fused_chain (the search.fused.chain server config): a 3-broker model
+    # through a 15-goal chain is pure dispatch latency — one fused
+    # dispatch per proposal run instead of one per goal.
+    from cruise_control_tpu.analyzer import SearchConfig, TpuGoalOptimizer
+    facade = KafkaCruiseControl(
+        sim, monitor, task_runner=runner,
+        optimizer=TpuGoalOptimizer(config=SearchConfig(fused_chain=True)),
+        now_ms=lambda: 4000)
     t0 = time.monotonic()
     facade.rebalance(dryrun=True, options=OptimizationOptions(seed=0),
                      ignore_proposal_cache=True)

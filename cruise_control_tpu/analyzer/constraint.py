@@ -98,7 +98,8 @@ class SearchConfig:
     #: violations remain — later goals' accepted actions may drift earlier
     #: goals slightly (the acceptance escape clauses allow bounded
     #: regressions, ref ResourceDistributionGoal.actionAcceptance), and a
-    #: converged goal re-exits in ~stall_patience cheap iterations.
+    #: converged goal costs one violation read (the engine's lax.cond
+    #: early exit skips its candidate loop entirely).
     polish_passes: int = 2
     #: run the whole goal chain as ONE jitted program (single device
     #: dispatch + single host sync per optimize) instead of one jit per

@@ -382,8 +382,8 @@ class TpuGoalOptimizer:
                 # would each pay an XLA compile on first use — a latency
                 # spike on exactly the latency-bound path fused serves):
                 # a polish round is one more fused whole-chain dispatch;
-                # converged goals exit in ~stall_patience cheap
-                # iterations.
+                # converged goals cost one violation read each (the
+                # engine's lax.cond early exit).
                 tp0 = time.monotonic()
                 state, _aux2, it2, b2 = chain.fused(
                     state, ctx, jax.random.fold_in(key, 50_000 + rnd))
