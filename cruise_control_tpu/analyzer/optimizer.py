@@ -295,8 +295,11 @@ class TpuGoalOptimizer:
             # re-runs only the unconverged subset) — both land on valid
             # converged plans, just not bit-identical ones.
             if on_goal_start is not None:
-                for g in goals:
-                    on_goal_start(g.name)
+                # One program = no observable per-goal boundaries: report
+                # ONE truthful step for the whole fused walk instead of
+                # pretending every goal started at t=0 (the per-goal path
+                # reports steps at real execution boundaries).
+                on_goal_start(f"FusedChain[{len(goals)}]")
             t_walk = time.monotonic()
             state, aux, iters_arr, bounds = chain.fused(state, ctx, key)
             (has_broken_raw, scales_arr, v0), iters_np, bounds_np = \

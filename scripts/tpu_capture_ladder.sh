@@ -2,7 +2,7 @@
 # Sequential on-chip capture of the full scenario ladder (run while the
 # axon tunnel is up). Appends every platform:"tpu" JSON line to
 # TPU_RESULTS.md and drops raw outputs in bench_tpu/.
-cd /root/repo
+cd "$(dirname "$0")/.." || exit 1
 mkdir -p bench_tpu
 for run in "1:" "2:" "5:" "3:" "4:" "4:add_brokers" "4:remove_brokers"; do
   s="${run%%:*}"; v="${run#*:}"

@@ -155,7 +155,16 @@ def test_rebalance_execute_moves_cluster(stack):
 
 def test_proposals_served_from_cache(stack):
     _, facade, app = stack
-    call(app, "GET", "proposals")
+    # The first read may answer 202 while the async computation still
+    # runs (cold compile) — poll it to completion so num_computations is
+    # settled before the cache-hit assertion below reads it.
+    deadline = time.time() + 120
+    while True:
+        status, _body, _ = call(app, "GET", "proposals")
+        if status == 200 or time.time() > deadline:
+            break
+        time.sleep(0.3)
+    assert status == 200
     n = facade.proposal_cache.num_computations
     status, body, _ = call(app, "GET", "proposals")
     assert status == 200

@@ -1,0 +1,21 @@
+"""bench.py's crash handling: only transport/tunnel deaths may fall back
+to the CPU-pinned retry — deterministic failures (quality gate, hard-goal
+check) must stay loud TPU failures (BENCH artifact honesty)."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+
+def test_transport_death_gate():
+    import bench
+    for msg in ("UNAVAILABLE: Socket closed",
+                "Connection reset by peer",
+                "failed to connect to all addresses",
+                "DEADLINE_EXCEEDED: timed out",
+                "device is in an invalid state"):
+        assert bench._is_transport_death(Exception(msg)), msg
+    for msg in ("quality regression: tpu residual 5.0 > greedy 1.0",
+                "hard goals still violated after optimization: DiskCapacityGoal",
+                "optimization self-check failed: goal X worsened"):
+        assert not bench._is_transport_death(RuntimeError(msg)), msg
