@@ -167,19 +167,24 @@ class _RawStore:
 
     def rows_for(self, entities: list[Hashable]) -> np.ndarray:
         # Steady state (every entity already known — the every-round case
-        # at LinkedIn scale) is one bulk dict.get per entity; only misses
-        # take the allocating slow path, after one up-front grow.
-        get = self._rows.get
+        # at LinkedIn scale): a plain list-comp over dict __getitem__ is
+        # ~2x faster than fromiter over .get-with-default at 1M tuple
+        # keys; only a miss (KeyError) drops to the allocating path.
+        m = self._rows
+        try:
+            return np.asarray([m[e] for e in entities], np.int64)
+        except KeyError:
+            pass
+        get = m.get
         out = np.fromiter((get(e, -1) for e in entities), np.int64,
                           len(entities))
         missing = out < 0
-        if missing.any():
-            idxs = np.nonzero(missing)[0]
-            need = len(self._rows) + len(idxs) - len(self._free)
-            if need > self.capacity:
-                self._grow(need)
-            for i in idxs:
-                out[i] = self.row_for(entities[i])
+        idxs = np.nonzero(missing)[0]
+        need = len(m) + len(idxs) - len(self._free)
+        if need > self.capacity:
+            self._grow(need)
+        for i in idxs:
+            out[i] = self.row_for(entities[i])
         return out
 
     def get_row(self, entity: Hashable) -> int | None:
