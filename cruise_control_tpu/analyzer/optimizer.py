@@ -149,11 +149,26 @@ class TpuGoalOptimizer:
                  config: SearchConfig | None = None,
                  options_generator=None,
                  registry=None,
-                 mesh=None):
+                 mesh=None,
+                 branches: int = 0):
         from ..core.sensors import (GOAL_OPTIMIZER_SENSOR, MetricRegistry)
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
+        #: best-of-N independent search branches (``search.branches``
+        #: server config; parallel/branches.py): each device runs the
+        #: full chain under its own PRNG stream via shard_map, the
+        #: lexicographically best final state wins — the device-resident
+        #: replacement for the reference's proposal-precompute thread
+        #: pool (GoalOptimizer.java:112-119, N chain runs on cloned
+        #: models, best cached). 0/1 = single-branch (this machinery
+        #: entirely bypassed). Mutually exclusive with ``mesh``.
+        self.branches = int(branches or 0)
+        self._branched_runs: dict = {}
+        if self.branches > 1 and mesh is not None:
+            raise ValueError("search.branches and search.mesh.devices are "
+                             "mutually exclusive: branches replicate the "
+                             "model per device, the mesh shards it")
         #: optional jax.sharding.Mesh: when set, every optimize()/warmup()
         #: places the model on the mesh (partition axis sharded, broker
         #: axis replicated — parallel/sharding.py layout) and the jitted
@@ -250,9 +265,24 @@ class TpuGoalOptimizer:
         from a background thread at server startup; a subsequent
         ``optimize`` with the same shapes pays no XLA compile."""
         options = options or OptimizationOptions()
-        _cfg, _goals, chain, ctx, state = self._prepare(model, metadata,
-                                                        options)
-        chain.warmup(state, ctx, jax.random.PRNGKey(options.seed))
+        cfg, goals, chain, ctx, state = self._prepare(model, metadata,
+                                                      options)
+        key = jax.random.PRNGKey(options.seed)
+        if self.branches > 1:
+            # The branched path never runs the per-goal passes — warm the
+            # shard_map program it actually serves instead.
+            from ..parallel.branches import (make_branch_mesh,
+                                             make_branched_search)
+            bkey = (cfg, tuple(g.bind_signature() for g in goals),
+                    self.branches)
+            run = self._branched_runs.get(bkey)
+            if run is None:
+                run = self._branched_runs.setdefault(
+                    bkey, make_branched_search(
+                        goals, cfg, make_branch_mesh(self.branches)))
+            run.lower(state, ctx, key).compile()
+            return
+        chain.warmup(state, ctx, key)
 
     def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
                  options: OptimizationOptions | None = None,
@@ -271,6 +301,12 @@ class TpuGoalOptimizer:
         # goal at a time as the chain walks (tens of minutes for a full
         # default chain on TPU; the persistent compilation cache then
         # makes later processes skip XLA entirely). No-op once warmed.
+        # (The branched path compiles its own shard_map program instead —
+        # it never runs the per-goal passes.)
+        if self.branches > 1:
+            return self._optimize_branched(model, metadata, options, cfg,
+                                           goals, chain, ctx, state, key,
+                                           t0, on_goal_start)
         chain.warmup(state, ctx, key)
 
         # One violation stack per goal boundary: stack[i] before goal i runs
@@ -417,7 +453,63 @@ class TpuGoalOptimizer:
         # goal's stored reading can be stale if a later pass moved it.
         goal_results = [replace(gr, violation_after=float(boundary[i]))
                         for i, gr in enumerate(goal_results)]
+        return self._finish(model, metadata, options, state, goal_results,
+                            t0)
 
+    def _optimize_branched(self, model, metadata, options, cfg, goals,
+                           chain, ctx, state, key, t0, on_goal_start):
+        """Best-of-N independent search branches (parallel/branches.py):
+        every device runs the FULL goal chain on a replicated model under
+        its own PRNG stream via shard_map, and the lexicographically best
+        final state is served — the device-resident replacement for the
+        reference's proposal-precompute thread pool
+        (GoalOptimizer.java:112-119: N chain runs on cloned models, best
+        result cached). Per-goal iteration counts are not observable
+        inside the shard_map program (reported as 0) and polish is
+        skipped — branch diversity plays its role; the winning boundary
+        still feeds the same self-check and hard-goal gate."""
+        from ..parallel.branches import (make_branch_mesh,
+                                         make_branched_search, select_best)
+        if on_goal_start is not None:
+            on_goal_start(f"BranchedChain[{len(goals)}x{self.branches}]")
+        aux = chain.aux(state, ctx)
+        bkey = (cfg, tuple(g.bind_signature() for g in goals),
+                self.branches)
+        run = self._branched_runs.get(bkey)
+        if run is None:
+            run = self._branched_runs.setdefault(
+                bkey, make_branched_search(
+                    goals, cfg, make_branch_mesh(self.branches)))
+        t_walk = time.monotonic()
+        states, viols = run(state, ctx, key)
+        state, best_idx, vbest = select_best(states, viols)
+        walk_s = time.monotonic() - t_walk
+        _has_broken, scales_arr, v0 = jax.device_get(aux)
+        v0 = np.asarray(v0)
+        logger = logging.getLogger(__name__)
+        logger.info("branched search: %d branches, winner %d, %.2fs",
+                    self.branches, best_idx, walk_s)
+        goal_results: list[GoalResult] = []
+        per = walk_s / max(len(goals), 1)
+        # No per-goal self-check here: the sequential walk's "never worsen
+        # your own violation" assertion reads the stack at each goal's OWN
+        # pass boundary, which a single shard_map program cannot expose —
+        # comparing the initial stack against the post-CHAIN stack would
+        # false-positive on legal later-goal drift (the <= epsilon
+        # regressions acceptance tolerates, the very drift polish exists
+        # for). Each branch still enforces per-pass non-worsening
+        # internally through lexicographic acceptance, and the winning
+        # boundary feeds the same hard-goal gate below.
+        for i, goal in enumerate(goals):
+            goal_results.append(GoalResult(
+                name=goal.name, hard=goal.hard,
+                violation_before=float(v0[i]),
+                violation_after=float(vbest[i]), duration_s=per,
+                iterations=0, scale=float(scales_arr[i])))
+        return self._finish(model, metadata, options, state, goal_results,
+                            t0)
+
+    def _finish(self, model, metadata, options, state, goal_results, t0):
         final = to_model(state, model)
         proposals = diff_proposals(model, final, metadata)
         duration_s = time.monotonic() - t0

@@ -132,7 +132,9 @@ class KafkaCruiseControl:
                     constraint=self.optimizer.constraint,
                     config=self.optimizer.config,
                     options_generator=self.optimizer.options_generator,
-                    registry=self.optimizer.registry)
+                    registry=self.optimizer.registry,
+                    mesh=self.optimizer.mesh,
+                    branches=self.optimizer.branches)
             self._goal_optimizers[key] = opt   # re-insert = most recent
             while len(self._goal_optimizers) > self.MAX_GOAL_OPTIMIZERS:
                 self._goal_optimizers.pop(

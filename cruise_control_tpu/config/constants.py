@@ -223,6 +223,16 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "unsharded; N is clamped to the devices jax exposes. On "
                  "multi-chip TPU hosts this puts the goal search's "
                  "per-iteration broker aggregates on ICI all-reduces.")
+    d.define("search.branches", ConfigType.INT, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Best-of-N independent search branches over the local "
+                 "devices (shard_map; parallel/branches.py): each branch "
+                 "runs the full goal chain under its own PRNG stream and "
+                 "the lexicographically best plan is served — the "
+                 "device-resident analog of the reference's "
+                 "num.proposal.precompute.threads pool. 0/1 = off; "
+                 "clamped to the devices jax exposes; mutually exclusive "
+                 "with search.mesh.devices.")
     d.define("search.fused.chain", ConfigType.BOOLEAN, False,
              importance=Importance.LOW,
              doc="Run the whole goal chain as one jitted program (single "

@@ -75,9 +75,14 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
 
         from .parallel import make_mesh
         mesh = make_mesh(min(mesh_devices, len(jax.devices())))
+    branches = config.get_int("search.branches")
+    if branches > 1:
+        import jax
+        branches = min(branches, len(jax.devices()))
     optimizer = TpuGoalOptimizer(
         goals=goals_by_name(goal_names, constraint) if goal_names else None,
-        constraint=constraint, config=config.search_config(), mesh=mesh)
+        constraint=constraint, config=config.search_config(), mesh=mesh,
+        branches=branches)
     executor = Executor(admin, config.executor_config())
     from .analyzer import DefaultOptimizationOptionsGenerator
     gen_cls = load_class(config.get_string(

@@ -344,3 +344,32 @@ def test_fused_chain_matches_per_goal_walk():
         assert abs(ga.violation_after - gb.violation_after) <= 1e-6
         assert ga.iterations == gb.iterations
         assert gb.duration_s >= 0
+
+
+def test_branched_optimizer_end_to_end():
+    """search.branches: best-of-N independent chains via shard_map on the
+    virtual 8-device CPU mesh, winner served through the normal result
+    path (sanity, residuals, proposals, hard-goal gate)."""
+    model, md = flatten_spec(make_cluster())
+    opt = TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS), config=CFG,
+                           branches=4)
+    res = opt.optimize(model, md, OptimizationOptions(seed=3))
+    assert sanity_check(res.final_model)["duplicate_replica_brokers"] == 0
+    by_name = {g.name: g for g in res.goal_results}
+    assert by_name["ReplicaDistributionGoal"].violation_after <= 1e-6
+    for g in res.goal_results:
+        assert g.violation_after <= g.violation_before + 1e-6
+        assert g.iterations == 0          # documented: unobservable
+    assert len(res.proposals) > 0
+    # Deterministic: same seed, same winner, same plan.
+    res2 = opt.optimize(model, md, OptimizationOptions(seed=3))
+    assert res.proposals == res2.proposals
+
+
+def test_branches_and_mesh_mutually_exclusive():
+    import jax
+    from cruise_control_tpu.parallel import make_mesh
+    with pytest.raises(ValueError):
+        TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS), config=CFG,
+                         mesh=make_mesh(min(2, len(jax.devices()))),
+                         branches=2)
