@@ -271,18 +271,24 @@ class TpuGoalOptimizer:
         if self.branches > 1:
             # The branched path never runs the per-goal passes — warm the
             # shard_map program it actually serves instead.
-            from ..parallel.branches import (make_branch_mesh,
-                                             make_branched_search)
-            bkey = (cfg, tuple(g.bind_signature() for g in goals),
-                    self.branches)
-            run = self._branched_runs.get(bkey)
-            if run is None:
-                run = self._branched_runs.setdefault(
-                    bkey, make_branched_search(
-                        goals, cfg, make_branch_mesh(self.branches)))
-            run.lower(state, ctx, key).compile()
+            self._branched_run_for(cfg, goals).lower(state, ctx,
+                                                     key).compile()
             return
         chain.warmup(state, ctx, key)
+
+    def _branched_run_for(self, cfg: SearchConfig, goals):
+        """Get-or-build the jitted shard_map program for this (cfg, goal
+        binding, branch count) — ONE definition so warmup pre-compiles
+        exactly the program optimize serves (the warm/serve-mismatch
+        hazard _chain_for's mesh key guards against)."""
+        from ..parallel.branches import make_branch_mesh, make_branched_search
+        bkey = (cfg, tuple(g.bind_signature() for g in goals), self.branches)
+        run = self._branched_runs.get(bkey)
+        if run is None:
+            run = self._branched_runs.setdefault(
+                bkey, make_branched_search(
+                    goals, cfg, make_branch_mesh(self.branches)))
+        return run
 
     def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
                  options: OptimizationOptions | None = None,
@@ -467,19 +473,14 @@ class TpuGoalOptimizer:
         result cached). Per-goal iteration counts are not observable
         inside the shard_map program (reported as 0) and polish is
         skipped — branch diversity plays its role; the winning boundary
-        still feeds the same self-check and hard-goal gate."""
-        from ..parallel.branches import (make_branch_mesh,
-                                         make_branched_search, select_best)
+        still feeds the same hard-goal gate, and select_best fails loudly
+        on NaN residuals (the broken-kernel case the sequential
+        self-check catches)."""
+        from ..parallel.branches import select_best
         if on_goal_start is not None:
             on_goal_start(f"BranchedChain[{len(goals)}x{self.branches}]")
         aux = chain.aux(state, ctx)
-        bkey = (cfg, tuple(g.bind_signature() for g in goals),
-                self.branches)
-        run = self._branched_runs.get(bkey)
-        if run is None:
-            run = self._branched_runs.setdefault(
-                bkey, make_branched_search(
-                    goals, cfg, make_branch_mesh(self.branches)))
+        run = self._branched_run_for(cfg, goals)
         t_walk = time.monotonic()
         states, viols = run(state, ctx, key)
         state, best_idx, vbest = select_best(states, viols)

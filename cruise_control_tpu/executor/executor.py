@@ -422,6 +422,9 @@ class Executor:
         tm = self._task_manager
         tt = TaskType.INTER_BROKER_REPLICA_ACTION
         ctx = strategy_context or self._build_strategy_context()
+        # Strategy-chain sort happens ONCE per phase (ref TreeSet ordering
+        # at plan time); per-round batches walk the cached order.
+        planner.begin_phase(tm.tracker.tasks_in(tt, TaskState.PENDING), ctx)
         while (tm.tracker.num_remaining(tt) > 0
                and not self._stop_requested.is_set()):
             pending = tm.tracker.tasks_in(tt, TaskState.PENDING)

@@ -16,6 +16,30 @@ from .tasks import ExecutionTask, TaskType
 class ExecutionTaskPlanner:
     def __init__(self, strategy: ReplicaMovementStrategy | None = None):
         self.strategy = strategy or strategy_chain(None)
+        self._ordered: list[ExecutionTask] | None = None
+
+    def begin_phase(self, tasks: list[ExecutionTask],
+                    ctx: StrategyContext | None = None) -> None:
+        """Sort the phase's tasks by the strategy chain ONCE (ref
+        ``ExecutionTaskPlanner.addExecutionProposals`` sorting into a
+        TreeSet at plan time): at LinkedIn scale a rebalance carries
+        ~500K movement tasks, and re-evaluating the Python strategy key
+        inside a per-round sort (thousands of rounds per execution) is
+        hours of pure ordering overhead. Per-round batch calls then walk
+        this order, filtering by live task state — O(N) with no key
+        calls."""
+        ctx = ctx or StrategyContext()
+        self._ordered = sorted(tasks,
+                               key=lambda t: self.strategy.key(t, ctx))
+
+    def _in_order(self, pending: list[ExecutionTask],
+                  ctx: StrategyContext) -> list[ExecutionTask]:
+        if self._ordered is None:
+            return sorted(pending, key=lambda t: self.strategy.key(t, ctx))
+        if len(self._ordered) == len(pending):
+            return self._ordered
+        live = {id(t) for t in pending}
+        return [t for t in self._ordered if id(t) in live]
 
     def inter_broker_batch(self, pending: list[ExecutionTask],
                            in_progress: list[ExecutionTask],
@@ -36,7 +60,7 @@ class ExecutionTaskPlanner:
                 slots[b] = slots.get(b, 0) + 1
         budget = concurrency.cluster_movement_cap - len(in_progress)
         batch: list[ExecutionTask] = []
-        for task in sorted(pending, key=lambda t: self.strategy.key(t, ctx)):
+        for task in self._in_order(pending, ctx):
             if budget <= 0:
                 break
             brokers = (*task.proposal.replicas_to_add,

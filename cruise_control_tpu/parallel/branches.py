@@ -93,6 +93,14 @@ def select_best(states, violations):
     enforces); ties break toward the lower branch index so results stay
     deterministic."""
     v = np.asarray(jax.device_get(violations))   # [n_branches, n_goals]
+    if np.isnan(v).any():
+        # A NaN residual means a broken goal kernel, and NaN compares
+        # False both ways so the lexicographic sort below could silently
+        # serve the broken branch — fail as loudly as the sequential
+        # walk's self-check does.
+        bad = sorted(set(np.nonzero(np.isnan(v))[0].tolist()))
+        raise RuntimeError(
+            f"branched search produced NaN violations on branches {bad}")
     order = sorted(range(v.shape[0]), key=lambda i: (tuple(v[i]), i))
     best = order[0]
     state = jax.tree.map(lambda x: x[best], states)

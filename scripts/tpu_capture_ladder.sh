@@ -4,7 +4,10 @@
 # TPU_RESULTS.md and drops raw outputs in bench_tpu/.
 cd "$(dirname "$0")/.." || exit 1
 mkdir -p bench_tpu
-for run in "1:" "2:" "5:" "3:" "4:" "4:add_brokers" "4:remove_brokers"; do
+# Order: headline metric first, demo last — scenario 1's fused 15-goal
+# serial compile is the longest cold cost for the least fresh value, so
+# it must not eat a short tunnel window before the scale rows re-capture.
+for run in "2:" "5:" "4:" "3:" "4:add_brokers" "4:remove_brokers" "1:"; do
   s="${run%%:*}"; v="${run#*:}"
   tag="s${s}${v:+_$v}"
   args=(--scenario "$s"); [ -n "$v" ] && args+=(--variant "$v")

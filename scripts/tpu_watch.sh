@@ -56,10 +56,12 @@ while true; do
     # scenario 2 doubles as the TPU compile-cache warmer. Each capture is
     # independent (a scenario-specific failure must not starve the rest),
     # but re-probe between them so a dead tunnel short-circuits the ladder.
-    for n in 2 1 5 3 4; do
+    # Demo (1) last: its fused 15-goal serial compile is the longest
+    # cold cost for the least fresh value in a short tunnel window.
+    for n in 2 5 4 3 1; do
       probe || break
       case "$n" in
-        2) tmo=3600 ;; 1) tmo=1800 ;; 5) tmo=2400 ;; *) tmo=5400 ;;
+        2) tmo=3600 ;; 1) tmo=3600 ;; 5) tmo=2400 ;; *) tmo=5400 ;;
       esac
       capture "$n" "$tmo"
     done

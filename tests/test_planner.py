@@ -1,0 +1,66 @@
+"""ExecutionTaskPlanner batches (ref ExecutionTaskPlanner.java:302-420):
+strategy-chain ordering is computed once per phase (begin_phase — the
+TreeSet-at-plan-time analog), per-round batches honor per-broker and
+cluster caps, and completed tasks drop out of the cached order."""
+
+from cruise_control_tpu.executor.concurrency import ExecutionConcurrencyManager
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.tasks import (ExecutionTask, TaskState,
+                                               TaskType)
+from cruise_control_tpu.model.proposals import ExecutionProposal
+
+
+def _task(i, src, dst):
+    return ExecutionTask(
+        i, ExecutionProposal("t", i, src, (src,), (dst,)),
+        TaskType.INTER_BROKER_REPLICA_ACTION)
+
+
+def _ctx(tasks):
+    from cruise_control_tpu.executor.strategy import StrategyContext
+    return StrategyContext(partition_size_mb={
+        t.topic_partition: float((t.execution_id * 37) % 101)
+        for t in tasks})
+
+
+def test_begin_phase_order_matches_per_round_sort():
+    conc = ExecutionConcurrencyManager()
+    # Distinct sizes so the default chain (prioritizes small movements
+    # among its tiebreaks) produces a non-trivial deterministic order.
+    tasks = [_task(i, i % 7, (i + 1) % 7) for i in range(300)]
+    ctx = _ctx(tasks)
+    fresh = ExecutionTaskPlanner()
+    per_round = fresh.inter_broker_batch(tasks, [], conc, ctx)
+    cached = ExecutionTaskPlanner()
+    cached.begin_phase(tasks, ctx)
+    assert cached.inter_broker_batch(tasks, [], conc, ctx) == per_round
+
+
+def test_cached_order_drops_finished_tasks():
+    conc = ExecutionConcurrencyManager()
+    tasks = [_task(i, 0, 1) for i in range(10)]
+    planner = ExecutionTaskPlanner()
+    planner.begin_phase(tasks)
+    first = planner.inter_broker_batch(tasks, [], conc)
+    assert first
+    done = {id(t) for t in first[:2]}
+    remaining = [t for t in tasks if id(t) not in done]
+    batch = planner.inter_broker_batch(remaining, [], conc)
+    assert not ({id(t) for t in batch} & done)
+    rem_ids = {id(t) for t in remaining}
+    assert all(id(t) in rem_ids for t in batch)
+
+
+def test_caps_respected_with_cached_order():
+    conc = ExecutionConcurrencyManager()
+    tasks = [_task(i, 0, 1) for i in range(5000)]
+    planner = ExecutionTaskPlanner()
+    planner.begin_phase(tasks)
+    batch = planner.inter_broker_batch(tasks, [], conc)
+    # Every task touches brokers 0 and 1, so the per-broker cap binds.
+    assert len(batch) <= conc.inter_broker_cap(0)
+    slots = {}
+    for t in batch:
+        for b in (*t.proposal.replicas_to_add, *t.proposal.replicas_to_remove):
+            slots[b] = slots.get(b, 0) + 1
+    assert all(v <= conc.inter_broker_cap(b) for b, v in slots.items())
