@@ -270,6 +270,14 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
                 "description": "parked for review (two-step "
                                "verification)",
                 **_ref("ReviewResult")}
+        if is_async:
+            # Task-capacity pushback (UserTaskManager overflow): back
+            # off and retry. Async endpoints only — sync requests never
+            # enter the task manager.
+            responses["429"] = {
+                "description": "too many active user tasks; back off "
+                               "and retry",
+                **_ref("ErrorResponse")}
         op = {
             "summary": summary,
             "operationId": name,

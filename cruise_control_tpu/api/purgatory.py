@@ -124,6 +124,18 @@ class Purgatory:
             info.status = ReviewStatus.SUBMITTED
             return info
 
+    def restore_approval(self, review_id: int) -> None:
+        """Roll a just-submitted request back to APPROVED. ONLY for the
+        dispatcher's scheduling failure path: when the task manager
+        rejects the execution (capacity 429) after submit() already
+        consumed the approval, the "back off and retry" contract requires
+        the approval to survive — the request never actually ran. No
+        reference equivalent (the reference 500s before this can arise)."""
+        with self._lock:
+            info = self._requests.get(review_id)
+            if info is not None and info.status is ReviewStatus.SUBMITTED:
+                info.status = ReviewStatus.APPROVED
+
     def review_board(self) -> list[RequestInfo]:
         with self._lock:
             now = int(time.time() * 1000)

@@ -30,7 +30,7 @@ from .parameters import ParsedParams, parse_endpoint_params
 from .purgatory import Purgatory
 from .security import (AllowAllSecurityProvider, AuthorizationError,
                        SecurityProvider, check_access, ENDPOINT_MIN_ROLE)
-from .tasks import UserTaskManager
+from .tasks import TooManyUserTasksError, UserTaskManager
 
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
@@ -229,6 +229,7 @@ class CruiseControlApp:
                          "(request.reason.required=true)"}, {}
 
         # Two-step verification: un-reviewed POSTs park in the purgatory.
+        consumed_review: int | None = None
         if (method == "POST" and self.purgatory is not None
                 and endpoint not in NO_REVIEW_REQUIRED):
             review_id = params.get("review_id", [None])[0]
@@ -242,12 +243,20 @@ class CruiseControlApp:
                 return 202, {"reviewResult": info.to_json()}, {}
             # Validate the merged request BEFORE submit(): submit
             # irreversibly burns the approval, so a typo in the replay
-            # must not consume the reviewed request.
+            # must not consume the reviewed request. Same for task
+            # capacity — a 429 is "back off and retry", which is a lie if
+            # the approval was already consumed (the retry would 400 on
+            # a Submitted review).
             pending = self.purgatory.get(int(review_id), endpoint)
             merged = {k.lower(): [v] for k, v in pending.params.items()}
             merged.update(params)
             self._parse(endpoint, merged)
+            if endpoint in ASYNC_ENDPOINTS:
+                # Pre-check narrows the 429-after-burn window; the
+                # restore below closes it.
+                self.tasks.ensure_capacity()
             self.purgatory.submit(int(review_id), endpoint)
+            consumed_review = int(review_id)
             params = merged
 
         # Typed parse + validation (ref servlet/parameters/*): unknown
@@ -256,7 +265,16 @@ class CruiseControlApp:
         parsed = self._parse(endpoint, params)
 
         if endpoint in ASYNC_ENDPOINTS:
-            return self._handle_async(endpoint, parsed, headers)
+            try:
+                return self._handle_async(endpoint, parsed, headers)
+            except TooManyUserTasksError:
+                # A concurrent submission can still steal the last slot
+                # between ensure_capacity() and tasks.submit(): a 429
+                # promises "retry works", so the consumed approval must
+                # be restored before it propagates.
+                if consumed_review is not None:
+                    self.purgatory.restore_approval(consumed_review)
+                raise
         return self._handle_sync(endpoint, parsed, principal)
 
     def _handle_async(self, endpoint: str, params: ParsedParams,
@@ -682,6 +700,11 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         extra = _auth_headers(e, app.security)
     except (KeyError, ValueError) as e:
         status, payload, extra = 400, {"errorMessage": str(e)}, {}
+    except TooManyUserTasksError as e:
+        # Capacity pushback is the client's signal to back off, not a
+        # server fault (deviation from the reference, which 500s here —
+        # see TooManyUserTasksError).
+        status, payload, extra = 429, {"errorMessage": str(e)}, {}
     except Exception as e:
         status, payload, extra = 500, {"errorMessage": str(e)}, {}
     return json_resp(status, payload, extra)

@@ -20,6 +20,14 @@ from typing import Any, Callable
 from .progress import OperationProgress
 
 
+class TooManyUserTasksError(RuntimeError):
+    """Active-user-task capacity exhausted. The server maps this to HTTP
+    429 — a deliberate improvement over the reference, whose equivalent
+    RuntimeException (``UserTaskManager.java:496``) surfaces as a 500;
+    429 tells clients to back off and retry rather than report a server
+    fault."""
+
+
 class TaskState(enum.Enum):
     """ref UserTaskManager.TaskState."""
 
@@ -63,6 +71,22 @@ class UserTaskManager:
         self.max_active_tasks = max_active_tasks
         self.retention_ms = completed_task_retention_ms
 
+    def _ensure_capacity_locked(self) -> None:
+        active = sum(1 for t in self._tasks.values()
+                     if t.state is TaskState.ACTIVE)
+        if active >= self.max_active_tasks:
+            raise TooManyUserTasksError(
+                f"too many active user tasks ({active})")
+
+    def ensure_capacity(self) -> None:
+        """Raise TooManyUserTasksError if a new submission would be
+        rejected right now. For callers that must fail BEFORE consuming
+        a one-shot resource (a two-step approval): the pre-check narrows
+        the window, and submit() re-checks authoritatively."""
+        with self._lock:
+            self._expire_completed()
+            self._ensure_capacity_locked()
+
     def submit(self, endpoint: str, request_url: str,
                fn: Callable[[OperationProgress], Any],
                user_task_id: str | None = None) -> UserTaskInfo:
@@ -73,11 +97,7 @@ class UserTaskManager:
             self._expire_completed()
             if user_task_id and user_task_id in self._tasks:
                 return self._tasks[user_task_id]
-            active = sum(1 for t in self._tasks.values()
-                         if t.state is TaskState.ACTIVE)
-            if active >= self.max_active_tasks:
-                raise RuntimeError(
-                    f"too many active user tasks ({active})")
+            self._ensure_capacity_locked()
             tid = user_task_id or str(uuidlib.uuid4())
             progress = OperationProgress()
             future = self._pool.submit(fn, progress)

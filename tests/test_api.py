@@ -473,3 +473,165 @@ def test_openapi_parameters_generated_from_typed_specs(stack):
     ref = reb["responses"]["200"]["content"]["application/json"][
         "schema"]["$ref"]
     assert ref.rsplit("/", 1)[1] in schemas
+
+
+def test_concurrent_mixed_requests_no_errors(stack):
+    """Hammer the served stack with concurrent mixed GET/POST traffic
+    (ref UserTaskManagerTest / servlet concurrency): every response must
+    be a well-formed 200/202/429 — never a 5xx — and async rebalances
+    must resolve to results via their User-Task-ID."""
+    import threading
+
+    _, _facade, app = stack
+    errors: list = []
+    task_ids: list = []
+    lock = threading.Lock()
+
+    def hit_get(endpoint, params=""):
+        try:
+            status, _body, _ = call(app, "GET", endpoint, params)
+            assert status in (200, 202), (endpoint, status)
+        except AssertionError as e:
+            with lock:
+                errors.append(e)
+        except Exception as e:                      # noqa: BLE001
+            with lock:
+                errors.append((endpoint, e))
+
+    def hit_rebalance(i):
+        try:
+            # call() raises on any error status other than the expected
+            # 429 (capacity pushback, UserTaskManager overflow -> 429);
+            # anything else lands in ``errors``.
+            status, _body, hdrs = call(
+                app, "POST", "rebalance",
+                f"dryrun=true&json=true&verbose={'true' if i % 2 else 'false'}",
+                expect=429)
+            if status in (200, 202):
+                tid = hdrs.get("User-Task-ID")
+                with lock:
+                    task_ids.append(tid)
+        except Exception as e:                      # noqa: BLE001
+            with lock:
+                errors.append(("rebalance", e))
+
+    threads = []
+    for i in range(4):
+        threads += [
+            threading.Thread(target=hit_get, args=("state",)),
+            threading.Thread(target=hit_get, args=("load",)),
+            threading.Thread(target=hit_get, args=("kafka_cluster_state",)),
+            threading.Thread(target=hit_get,
+                             args=("state", "substates=monitor")),
+            threading.Thread(target=hit_rebalance, args=(i,)),
+        ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in threads), "hung request thread"
+    assert not errors, errors[:3]
+    # Every issued rebalance eventually resolves through its task id.
+    deadline = time.time() + 120
+    for tid in task_ids:
+        assert tid
+        while True:
+            status, body, _ = call(app, "POST", "rebalance",
+                                   "dryrun=true&json=true",
+                                   headers={"User-Task-ID": tid})
+            if status == 200:
+                assert "goalSummary" in body
+                break
+            assert time.time() < deadline, "task never completed"
+            time.sleep(0.2)
+
+
+def test_task_capacity_overflow_returns_429():
+    """Active-task overflow answers 429 (back off), not 500 — a
+    deliberate deviation from the reference, whose RuntimeException at
+    UserTaskManager.java:496 surfaces as a server fault."""
+    import threading
+
+    sim, facade, app = build_stack()
+    try:
+        gate = threading.Event()
+        # Fill the task manager to capacity with blocked tasks.
+        app.tasks.max_active_tasks = 1
+        blocked = app.tasks.submit("rebalance", "http://t/1",
+                                   lambda p: gate.wait(30))
+        status, body, _ = call(app, "POST", "rebalance",
+                               "dryrun=true&json=true", expect=429)
+        assert status == 429
+        assert "too many active user tasks" in body["errorMessage"]
+        gate.set()
+        blocked.future.result(timeout=30)
+    finally:
+        app.stop()
+
+
+def test_capacity_429_does_not_burn_approval():
+    """A 429 (capacity pushback) on an approved-request replay must leave
+    the approval intact — "back off and retry" is a lie if the retry can
+    only 400 on a burned review (capacity is checked BEFORE
+    purgatory.submit consumes the approval)."""
+    import threading
+
+    sim, facade, app = build_stack(two_step=True)
+    try:
+        status, body, _ = call(app, "POST", "rebalance", "dryrun=true")
+        rid = body["reviewResult"]["Id"]
+        call(app, "POST", "review", f"approve={rid}")
+        # Exhaust task capacity with a blocked task.
+        gate = threading.Event()
+        app.tasks.max_active_tasks = 1
+        blocked = app.tasks.submit("rebalance", "http://t/1",
+                                   lambda p: gate.wait(30))
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            f"review_id={rid}&dryrun=true", expect=429)
+        assert status == 429
+        # Free capacity: the SAME approval must still be replayable.
+        gate.set()
+        blocked.future.result(timeout=30)
+        app.tasks.max_active_tasks = 25
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            f"review_id={rid}&dryrun=true&get_response_timeout_s=120")
+        assert status in (200, 202)
+    finally:
+        app.stop()
+
+
+def test_capacity_race_restores_approval(monkeypatch):
+    """Even when the capacity pre-check passes and tasks.submit itself
+    raises (a concurrent request stole the last slot), the consumed
+    approval is rolled back to APPROVED so the 429 retry can succeed."""
+    import threading
+
+    sim, facade, app = build_stack(two_step=True)
+    try:
+        status, body, _ = call(app, "POST", "rebalance", "dryrun=true")
+        rid = body["reviewResult"]["Id"]
+        call(app, "POST", "review", f"approve={rid}")
+        gate = threading.Event()
+        app.tasks.max_active_tasks = 1
+        blocked = app.tasks.submit("rebalance", "http://t/1",
+                                   lambda p: gate.wait(30))
+        # Simulate the TOCTOU race: the pre-check sees capacity, the
+        # authoritative submit() does not.
+        monkeypatch.setattr(app.tasks, "ensure_capacity", lambda: None)
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            f"review_id={rid}&dryrun=true", expect=429)
+        assert status == 429
+        from cruise_control_tpu.api.purgatory import ReviewStatus
+        assert app.purgatory.get(rid).status is ReviewStatus.APPROVED
+        gate.set()
+        blocked.future.result(timeout=30)
+        app.tasks.max_active_tasks = 25
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            f"review_id={rid}&dryrun=true&get_response_timeout_s=120")
+        assert status in (200, 202)
+    finally:
+        app.stop()
