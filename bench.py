@@ -305,8 +305,13 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
     drain = max(cfgd["partitions"] // 8, 16384)
     opt = TpuGoalOptimizer(
         goals=goals,
+        # num_swap_candidates scales with the model: at 1Kx200K the
+        # swap-converging tail goals (TopicReplicaDistribution) drop from
+        # 56 to 38 iterations with a 512-pair batch — 26% off the full
+        # 15-goal warm walk (A/B measured, residual 0 both ways).
         config=SearchConfig(num_replica_candidates=k,
                             num_dest_candidates=16, apply_per_iter=k,
+                            num_swap_candidates=512,
                             drain_batch=drain, drain_rounds=8,
                             max_iters_per_goal=512),
         mesh=_make_mesh(mesh_devices))

@@ -239,9 +239,15 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
     for name, (method, summary, extra) in ENDPOINTS.items():
         descriptions = {pname: desc for pname, _ptype, desc in extra}
         params = _declared_params(name, descriptions)
-        ok: dict = {"description": "completed result (JSON)"}
+        ok: dict = {"description": "completed result (JSON; with "
+                                   "json=false, a text/plain fixed-width "
+                                   "table instead)"}
         if name in _OPTIMIZATION_ENDPOINTS:
             ok.update(_ref("OptimizationResult"))
+        # json=false renders a plaintext table for the same 200 (ref the
+        # response classes' writeOutputStream path).
+        ok.setdefault("content", {})["text/plain"] = {
+            "schema": {"type": "string"}}
         responses = {
             "200": ok,
             "400": {"description": "invalid parameters",

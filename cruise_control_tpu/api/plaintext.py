@@ -1,0 +1,138 @@
+"""Plaintext response rendering (``json=false``).
+
+The reference's servlet renders fixed-width text tables when ``json``
+is absent/false (each response class's ``writeOutputStream`` — e.g.
+``servlet/response/BrokerStats.java``, the original curl-friendly UX);
+this module is that renderer for the rebuild. JSON stays the default
+here (``json`` parameter defaults true — a documented deviation; every
+modern client asks for JSON), so plaintext is opt-in via ``json=false``.
+
+One entry point: :func:`render` maps the endpoint's JSON payload to a
+text document; endpoints without a bespoke table fall back to pretty-
+printed JSON, so ``json=false`` never errors.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+def _table(headers: list[str], rows: list[list[Any]]) -> str:
+    """Fixed-width columns, left-aligned text / right-aligned numbers."""
+    cells = [[str(c) for c in row] for row in rows]
+    widths = [max(len(h), *(len(r[i]) for r in cells)) if cells else len(h)
+              for i, h in enumerate(headers)]
+
+    def fmt(row, src=None):
+        out = []
+        for i, c in enumerate(row):
+            num = src is not None and isinstance(src[i], (int, float)) \
+                and not isinstance(src[i], bool)
+            out.append(c.rjust(widths[i]) if num else c.ljust(widths[i]))
+        return "  ".join(out).rstrip()
+
+    lines = [fmt(headers)]
+    for raw, row in zip(rows, cells):
+        lines.append(fmt(row, raw))
+    return "\n".join(lines)
+
+
+def _num(v, nd=3):
+    return round(v, nd) if isinstance(v, float) else v
+
+
+def _render_load(payload: dict) -> str:
+    rows = [[b.get("Broker"), b.get("BrokerState", ""),
+             _num(b.get("CpuPct", b.get("CPU", 0.0))),
+             _num(b.get("NwInRate", 0.0)), _num(b.get("NwOutRate", 0.0)),
+             _num(b.get("DiskMB", 0.0)), b.get("Replicas", 0),
+             b.get("Leaders", 0)]
+            for b in payload.get("brokers", [])]
+    text = _table(["BROKER", "STATE", "CPU", "NW_IN", "NW_OUT", "DISK",
+                   "REPLICAS", "LEADERS"], rows)
+    summary = payload.get("summary")
+    if summary:
+        text += "\n\n" + "\n".join(f"{k}: {_num(v)}"
+                                   for k, v in sorted(summary.items()))
+    return text
+
+
+def _render_partition_load(payload: dict) -> str:
+    recs = payload.get("records", [])
+    if not recs:
+        return "(no records)"
+    keys = list(recs[0].keys())
+    return _table([k.upper() for k in keys],
+                  [[_num(r.get(k, "")) for k in keys] for r in recs])
+
+
+def _render_proposals(payload: dict) -> str:
+    parts = []
+    summary = payload.get("summary")
+    if summary:
+        parts.append("\n".join(f"{k}: {_num(v)}"
+                               for k, v in sorted(summary.items())))
+    goals = payload.get("goalSummary", [])
+    if goals:
+        parts.append(_table(
+            ["GOAL", "STATUS", "BEFORE", "AFTER"],
+            [[g.get("goal"), g.get("status", ""),
+              _num(g.get("violationBefore", g.get("before", ""))),
+              _num(g.get("violationAfter", g.get("after", "")))]
+             for g in goals]))
+    return "\n\n".join(parts) or _pretty(payload)
+
+
+def _render_state(payload: dict) -> str:
+    parts = []
+    for section, body in payload.items():
+        if section == "version" or not isinstance(body, dict):
+            continue
+        lines = [f"[{section}]"]
+        for k, v in body.items():
+            if isinstance(v, (dict, list)):
+                v = json.dumps(v, sort_keys=True)
+            lines.append(f"  {k}: {v}")
+        parts.append("\n".join(lines))
+    return "\n\n".join(parts) or _pretty(payload)
+
+
+def _render_kafka_cluster_state(payload: dict) -> str:
+    return _render_state(payload)
+
+
+def _render_user_tasks(payload: dict) -> str:
+    rows = [[t.get("UserTaskId"), t.get("RequestURL", t.get("endpoint", "")),
+             t.get("Status"), t.get("StartMs", "")]
+            for t in payload.get("userTasks", [])]
+    return _table(["USER TASK ID", "REQUEST", "STATUS", "START"], rows)
+
+
+def _pretty(payload: dict) -> str:
+    return json.dumps(payload, indent=2, sort_keys=True)
+
+
+_RENDERERS = {
+    "load": _render_load,
+    "partition_load": _render_partition_load,
+    "proposals": _render_proposals,
+    "rebalance": _render_proposals,
+    "add_broker": _render_proposals,
+    "remove_broker": _render_proposals,
+    "state": _render_state,
+    "kafka_cluster_state": _render_kafka_cluster_state,
+    "user_tasks": _render_user_tasks,
+}
+
+
+def render(endpoint: str, payload: dict) -> str:
+    """Plaintext document for a 200 payload; pretty JSON when the
+    endpoint has no bespoke table (so ``json=false`` always works)."""
+    renderer = _RENDERERS.get(endpoint, _pretty)
+    try:
+        return renderer(payload)
+    except Exception:
+        # A malformed/partial payload must not turn a good response into
+        # a 500 — fall back to the lossless form.
+        return _pretty(payload)

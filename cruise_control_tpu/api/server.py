@@ -32,6 +32,11 @@ from .security import (AllowAllSecurityProvider, AuthorizationError,
                        SecurityProvider, check_access, ENDPOINT_MIN_ROLE)
 from .tasks import TooManyUserTasksError, UserTaskManager
 
+#: private handle()->router marker: "render this 200 as plaintext"
+#: (json=false resolved by the typed parameter layer). Popped by
+#: route_request before the response leaves the process.
+_PLAINTEXT_MARKER = "x-cc-render-plaintext"
+
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
                  "permissions", "bootstrap", "train", "openapi"}
@@ -266,7 +271,7 @@ class CruiseControlApp:
 
         if endpoint in ASYNC_ENDPOINTS:
             try:
-                return self._handle_async(endpoint, parsed, headers)
+                result = self._handle_async(endpoint, parsed, headers)
             except TooManyUserTasksError:
                 # A concurrent submission can still steal the last slot
                 # between ensure_capacity() and tasks.submit(): a 429
@@ -275,7 +280,17 @@ class CruiseControlApp:
                 if consumed_review is not None:
                     self.purgatory.restore_approval(consumed_review)
                 raise
-        return self._handle_sync(endpoint, parsed, principal)
+        else:
+            result = self._handle_sync(endpoint, parsed, principal)
+        if parsed.get("json") is False:
+            # The plaintext decision belongs HERE, where the TYPED value
+            # is known (case-insensitive parse; purgatory-merged replay
+            # params included) — the transport layer only sees the raw
+            # query. Signalled via a private marker header the router
+            # pops before the response leaves the process.
+            status, payload, extra = result
+            result = status, payload, {**extra, _PLAINTEXT_MARKER: "1"}
+        return result
 
     def _handle_async(self, endpoint: str, params: ParsedParams,
                       headers: dict) -> tuple[int, dict, dict]:
@@ -707,6 +722,18 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         status, payload, extra = 429, {"errorMessage": str(e)}, {}
     except Exception as e:
         status, payload, extra = 500, {"errorMessage": str(e)}, {}
+    # json=false: fixed-width text tables (ref the response classes'
+    # writeOutputStream plaintext path). The flag is resolved by the
+    # TYPED parameter layer inside handle() (case-insensitive, purgatory
+    # merge included) and signalled via a private marker header. Only
+    # successful bodies — errors and async-progress replies stay JSON so
+    # clients parse them uniformly.
+    wants_text = bool(extra.pop(_PLAINTEXT_MARKER, None)) if extra else False
+    if wants_text and status == 200:
+        from .plaintext import render
+        return (200, "text/plain; charset=utf-8",
+                (render(endpoint, payload) + "\n").encode(),
+                {**app.cors, **(extra or {})})
     return json_resp(status, payload, extra)
 
 

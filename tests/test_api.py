@@ -635,3 +635,29 @@ def test_capacity_race_restores_approval(monkeypatch):
         assert status in (200, 202)
     finally:
         app.stop()
+
+
+def test_json_false_renders_plaintext(stack):
+    """json=false answers fixed-width text (ref the response classes'
+    writeOutputStream plaintext path), JSON stays the default."""
+    import urllib.request
+
+    _, _, app = stack
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/load?json=false"
+    with urllib.request.urlopen(url, timeout=60) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "BROKER" in text and "REPLICAS" in text
+    assert not text.lstrip().startswith("{")
+    # Case-insensitive: the TYPED parameter layer decides, not the raw query.
+    url2 = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/load?JSON=false"
+    with urllib.request.urlopen(url2, timeout=60) as r2:
+        assert r2.headers["Content-Type"].startswith("text/plain")
+    # Errors stay JSON even with json=false (clients parse them uniformly).
+    status, body, _ = call(app, "GET", "partition_load",
+                           "json=false&resource=BOGUS", expect=400)
+    assert status == 400 and "errorMessage" in body
+    # And the JSON default is unchanged.
+    status, body, _ = call(app, "GET", "load")
+    assert status == 200 and "brokers" in body
