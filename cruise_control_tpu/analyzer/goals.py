@@ -442,10 +442,12 @@ class IntervalGoal(GoalKernel):
         # Purely leader-scoped metrics drain via bulk leadership transfers
         # instead — count/disk-neutral, so converged earlier goals cannot
         # veto them. "util"-metric goals with actions="both" (NW_OUT, CPU)
-        # deliberately stay on the fine loop: measured at 10Kx1M, their
-        # swap-heavy tail converges faster than a drain prologue whose
-        # transfers skew the very replica placement later polish must
-        # restore.
+        # deliberately stay on the fine loop: BOTH drain variants measured
+        # slower at 10Kx1M — the replica-move drain skews the placement
+        # later polish must restore, and the leadership-only drain
+        # (placement-neutral, tried round 4) overshoots leadership
+        # balance so badly the fine loop doubles its iterations (38 -> 78,
+        # warm 56 s -> 86 s) unwinding it. The swap-heavy fine tail wins.
         if self.actions == "replica" and self.metric[0] in ("count", "util"):
             return True
         return (self.actions in ("both", "leadership")
