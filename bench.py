@@ -33,9 +33,14 @@ GOALS = ["ReplicaDistributionGoal", "DiskUsageDistributionGoal",
 #: for hours, so the scale scenarios report vs_baseline against the 30 s
 #: target instead of a greedy run.
 SCALE_SCENARIOS = {
+    #: swaps: per-scenario swap-candidate batch — 512 cuts scenario 3's
+    #: topic-matched swap tail (TopicReplicaDistribution 56 -> 38 iters,
+    #: -26% warm), but CROWDS OUT leadership candidates in scenario 4's
+    #: leader-driven NW_OUT pass (38 -> 128 iters measured), so #4 keeps
+    #: the default batch.
     3: dict(brokers=1000, partitions=200_000, rf=2, goals=None,
             metric="rebalance_proposal_wall_clock_1kx200k", target_s=30.0,
-            k=1024),
+            k=1024, swaps=512),
     # Candidate batch scaled with the move budget AND the platform: a
     # 10K x 1M skew needs ~500K moves, so 1K-candidate iterations are
     # iteration-bound (~400 iters, 78 s CPU). 4K candidates cut the
@@ -303,18 +308,14 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
     # Drain batch sized so a few rounds cover the whole expected move
     # count (~half the replicas in the skewed build).
     drain = max(cfgd["partitions"] // 8, 16384)
-    opt = TpuGoalOptimizer(
-        goals=goals,
-        # num_swap_candidates scales with the model: at 1Kx200K the
-        # swap-converging tail goals (TopicReplicaDistribution) drop from
-        # 56 to 38 iterations with a 512-pair batch — 26% off the full
-        # 15-goal warm walk (A/B measured, residual 0 both ways).
-        config=SearchConfig(num_replica_candidates=k,
-                            num_dest_candidates=16, apply_per_iter=k,
-                            num_swap_candidates=512,
-                            drain_batch=drain, drain_rounds=8,
-                            max_iters_per_goal=512),
-        mesh=_make_mesh(mesh_devices))
+    cfg_kw = dict(num_replica_candidates=k, num_dest_candidates=16,
+                  apply_per_iter=k, drain_batch=drain, drain_rounds=8,
+                  max_iters_per_goal=512)
+    if "swaps" in cfgd:
+        # Scenario-specific override; absent = SearchConfig's default.
+        cfg_kw["num_swap_candidates"] = cfgd["swaps"]
+    opt = TpuGoalOptimizer(goals=goals, config=SearchConfig(**cfg_kw),
+                           mesh=_make_mesh(mesh_devices))
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(seed=0))
     cold = time.monotonic() - t0
