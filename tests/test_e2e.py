@@ -330,3 +330,42 @@ def test_admin_disable_self_healing_gates_the_fix():
                          what="post-enable drain")
     finally:
         stack.close()
+
+
+def test_server_restart_replays_sample_store(tmp_path):
+    """Checkpoint/resume through the SERVED stack (SURVEY §5.4, ref
+    KafkaSampleStore LOADING replay): a restarted server regains its
+    metric window history from sample.store.dir and can answer /state and
+    a dryrun rebalance from replayed data alone — before any fresh
+    sampling round runs."""
+    store = str(tmp_path / "samples")
+    cfg = {"sample.store.dir": store,
+           # Long sampling interval: the restarted server must be ready
+           # BEFORE its first live round, proving replay did the work.
+           "metric.sampling.interval.ms": "3600000"}
+    first = Stack(make_sim(num_brokers=4, partitions=16, rf=2),
+                  extra_config={"sample.store.dir": store})
+    try:
+        first.wait_model_ready()
+        n1 = first.get("state", "substates=monitor")[
+            "MonitorState"]["numValidWindows"]
+        assert n1 >= 1
+    finally:
+        first.close()
+
+    second = Stack(make_sim(num_brokers=4, partitions=16, rf=2),
+                   extra_config=cfg, tick_s=3600.0)
+    try:
+        st = second.get("state", "substates=monitor")["MonitorState"]
+        assert st["numValidWindows"] >= 1, (
+            "restarted server has no replayed windows")
+        req = urllib.request.Request(
+            second.base + "/kafkacruisecontrol/rebalance"
+                          "?dryrun=true&json=true&get_response_timeout_s=120",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=150) as r:
+            assert r.status == 200
+            payload = json.loads(r.read())
+        assert "goalSummary" in payload
+    finally:
+        second.close()
