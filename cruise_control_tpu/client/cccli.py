@@ -52,13 +52,32 @@ class CruiseControlClient:
             req.add_header("Authorization", f"Basic {raw.decode()}")
         try:
             with urllib.request.urlopen(req, timeout=120) as resp:
-                return resp.status, json.loads(resp.read()), dict(resp.headers)
+                raw = resp.read()
+                # json=false answers server-rendered text/plain tables;
+                # everything else (including every error and 202) is JSON.
+                if resp.headers.get("Content-Type",
+                                    "").startswith("text/plain"):
+                    return resp.status, raw.decode(), dict(resp.headers)
+                return resp.status, json.loads(raw), dict(resp.headers)
         except urllib.error.HTTPError as e:
-            return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+            raw = e.read() or b"{}"
+            # Mirror the success path: a reference-compatible server (or
+            # an intermediary) may render errors as text/HTML.
+            if not e.headers.get("Content-Type",
+                                 "").startswith("application/json"):
+                return e.code, {"errorMessage": raw.decode(errors="replace")
+                                }, dict(e.headers)
+            try:
+                body = json.loads(raw)
+            except json.JSONDecodeError:
+                body = {"errorMessage": raw.decode(errors="replace")}
+            return e.code, body, dict(e.headers)
 
-    def call(self, endpoint: str, params: dict | None = None) -> dict:
+    def call(self, endpoint: str, params: dict | None = None) -> dict | str:
         """Issue the request; keep long-polling 202s with the returned
-        User-Task-ID until the operation completes (ref Responder.py)."""
+        User-Task-ID until the operation completes (ref Responder.py).
+        Returns the parsed JSON dict — or the raw text document when the
+        request asked for ``json=false`` (server-rendered plaintext)."""
         method = "GET" if endpoint in GET_ENDPOINTS else "POST"
         params = dict(params or {})
         deadline = time.monotonic() + self.timeout_s
@@ -93,7 +112,11 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-a", "--address", required=True, help="host:port")
     ap.add_argument("--user", help="basic auth user")
     ap.add_argument("--password", help="basic auth password")
-    ap.add_argument("--json", action="store_true", help="raw JSON output")
+    fmt = ap.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true", help="raw JSON output")
+    fmt.add_argument("--plaintext", action="store_true",
+                     help="server-rendered fixed-width tables (json=false, "
+                          "the reference's plaintext response UX)")
     sub = ap.add_subparsers(dest="endpoint", required=True)
 
     for name in ("state", "kafka_cluster_state", "user_tasks",
@@ -141,7 +164,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _params_from_args(args: argparse.Namespace) -> dict:
-    skip = {"address", "user", "password", "json", "endpoint"}
+    skip = {"address", "user", "password", "json", "endpoint",
+            "plaintext"}
     params = {}
     for k, v in vars(args).items():
         if k in skip or v is None:
@@ -193,9 +217,15 @@ def main(argv=None) -> int:
     client = CruiseControlClient(
         args.address,
         auth=(args.user, args.password) if args.user else None)
-    body = client.call(args.endpoint, _params_from_args(args))
-    print(json.dumps(body, indent=2, default=str) if args.json
-          else _summarize(args.endpoint, body))
+    params = _params_from_args(args)
+    if args.plaintext:
+        params["json"] = "false"
+    body = client.call(args.endpoint, params)
+    if isinstance(body, str):             # server-rendered plaintext table
+        print(body, end="" if body.endswith("\n") else "\n")
+    else:
+        print(json.dumps(body, indent=2, default=str) if args.json
+              else _summarize(args.endpoint, body))
     return 0
 
 

@@ -98,6 +98,16 @@ def test_cccli_against_served_stack(served, capsys):
     assert "replicas=" in capsys.readouterr().out
     rc = cccli_main(["-a", addr, "partition_load", "--entries", "3"])
     assert rc == 0
+    capsys.readouterr()
+    # --plaintext: server-rendered fixed-width tables (json=false).
+    rc = cccli_main(["-a", addr, "--plaintext", "load"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # Server-rendered table headers (the client's own summary says
+    # "nwIn=", the server table says "NW_IN") — pins that json=false
+    # reached the server and the text body passed through unparsed.
+    assert "NW_IN" in out and "REPLICAS" in out
+    assert not out.lstrip().startswith("{")
 
 
 def test_cccli_parser_covers_endpoint_catalog():
