@@ -373,3 +373,23 @@ def test_branches_and_mesh_mutually_exclusive():
         TpuGoalOptimizer(goals=goals_by_name(BALANCE_GOALS), config=CFG,
                          mesh=make_mesh(min(2, len(jax.devices()))),
                          branches=2)
+
+
+def test_branches_take_precedence_over_fused_chain():
+    """branches>1 with fused_chain=True must run the branched path and
+    the flag must be MOOT there (the branched program is already
+    whole-chain-fused inside shard_map): identical plans with the flag
+    on or off."""
+    from dataclasses import replace as _replace
+    model, md = flatten_spec(make_cluster())
+    res = TpuGoalOptimizer(
+        goals=goals_by_name(BALANCE_GOALS),
+        config=_replace(CFG, fused_chain=True),
+        branches=2).optimize(model, md, OptimizationOptions(seed=4))
+    assert sanity_check(res.final_model)["duplicate_replica_brokers"] == 0
+    by_name = {g.name: g for g in res.goal_results}
+    assert by_name["ReplicaDistributionGoal"].violation_after <= 1e-6
+    res_off = TpuGoalOptimizer(
+        goals=goals_by_name(BALANCE_GOALS), config=CFG,
+        branches=2).optimize(model, md, OptimizationOptions(seed=4))
+    assert res.proposals == res_off.proposals
