@@ -104,9 +104,16 @@ def ensure_live_backend(timeout_s: float = 120.0) -> str:
     Also enables the persistent compilation cache — every entry point that
     cares about backend health cares about cold-start latency too.
     """
-    respect_env_platforms()
+    want = respect_env_platforms()
     import jax
     enable_compilation_cache()
+    if want and want.split(",")[0].strip() == "cpu":
+        # Operator explicitly pinned CPU: probing the default backend
+        # would only measure the dead-tunnel import hang (the axon PJRT
+        # plugin blocks at discovery even when it will never be
+        # selected) — 120 s of startup latency for an answer the env
+        # already gave. Normalized: callers prefix-match on "cpu".
+        return "cpu"
     platform = probe_default_backend(timeout_s)
     if platform is None:
         jax.config.update("jax_platforms", "cpu")
