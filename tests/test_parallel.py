@@ -116,3 +116,40 @@ def test_meshed_optimizer_full_loop_residual_parity():
             g_mesh.name, g_mesh.violation_after, g_single.violation_after)
     # Proposals from the sharded run round-trip like any other result.
     assert len(meshed.proposals) > 0
+
+
+def test_branched_optimizer_mid_scale_converges():
+    """Branched best-of-N through the FULL TpuGoalOptimizer at a
+    non-toy size (60 brokers x 3K partitions, skewed): the winning plan
+    converges every goal — incl. a HARD capacity goal, so the branched
+    boundary feeds the hard-goal gate — the branched analog of the
+    dryrun's converged sharded optimization."""
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             TpuGoalOptimizer)
+    from cruise_control_tpu.model.spec import BrokerSpec, PartitionSpec
+    rng = np.random.default_rng(5)
+    brokers = [BrokerSpec(broker_id=b, rack=f"r{b % 5}",
+                          capacity=(100.0, 1e6, 1e6, 1e8))
+               for b in range(60)]
+    hot = np.arange(12)
+    parts = []
+    for p in range(3000):
+        pool = hot if p % 2 == 0 else np.arange(60)
+        reps = rng.choice(pool, size=2, replace=False)
+        parts.append(PartitionSpec(
+            topic=f"t{p % 40}", partition=p,
+            replicas=[int(x) for x in reps],
+            leader_load=(0.05, 8.0, 12.0, 120.0)))
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(["DiskCapacityGoal", "ReplicaDistributionGoal",
+                             "DiskUsageDistributionGoal"]),
+        config=SearchConfig(num_replica_candidates=256,
+                            num_dest_candidates=16, apply_per_iter=256,
+                            max_iters_per_goal=256),
+        branches=4)
+    res = opt.optimize(model, md, OptimizationOptions(seed=9))
+    assert sanity_check(res.final_model)["duplicate_replica_brokers"] == 0
+    for g in res.goal_results:
+        assert g.violation_after <= 1e-6, (g.name, g.violation_after)
+    assert res.num_moves > 500     # the skew genuinely required work
