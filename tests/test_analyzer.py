@@ -393,3 +393,22 @@ def test_branches_take_precedence_over_fused_chain():
         goals=goals_by_name(BALANCE_GOALS), config=CFG,
         branches=2).optimize(model, md, OptimizationOptions(seed=4))
     assert res.proposals == res_off.proposals
+
+
+def test_reoptimizing_a_converged_model_is_a_noop(balance_optimizer):
+    """Proposal stability: optimizing the already-optimized model again
+    must produce no further movement (the reference's converged
+    GoalOptimizer yields an empty diff; flapping plans would churn the
+    cluster every proposal-cache refresh)."""
+    model, md = flatten_spec(make_cluster())
+    first = balance_optimizer.optimize(model, md, OptimizationOptions(seed=6))
+    assert first.proposals
+    second = balance_optimizer.optimize(first.final_model, md,
+                                        OptimizationOptions(seed=6))
+    assert second.proposals == []
+    assert second.num_moves == 0
+    # And with a different seed — stability must not depend on tie-break
+    # noise repeating.
+    third = balance_optimizer.optimize(first.final_model, md,
+                                       OptimizationOptions(seed=60))
+    assert third.proposals == []
