@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import logging
 import time
-from dataclasses import dataclass, replace
+from dataclasses import dataclass, field, replace
 
 import jax
 import numpy as np
@@ -72,6 +72,14 @@ class OptimizerResult:
     duration_s: float
     final_model: FlatClusterModel
     provision_response: object | None = None   # detector.ProvisionResponse
+    #: Post-optimization audit of registered hard goals NOT in the chain
+    #: (ref GoalOptimizer.java:458-497 — the reference runs its configured
+    #: hard goals on every proposal computation, so a chain can never
+    #: silently omit them; GoalViolationDetector.java:56 audits the same
+    #: set continuously). Empty when the chain already contains every
+    #: registered hard goal, when the audit is skipped
+    #: (skip_hard_goal_check) or per-goal waived (waived_hard_goals).
+    hard_goal_audit: list[GoalResult] = field(default_factory=list)
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -83,14 +91,21 @@ class OptimizerResult:
 
     @property
     def violated_hard_goals(self) -> list[str]:
-        return [g.name for g in self.goal_results
-                if g.hard and not g.satisfied]
+        """Hard goals left violated — chain members AND audited off-chain
+        hard goals, so a soft-goal-only chain cannot make the gate
+        vacuous."""
+        return ([g.name for g in self.goal_results
+                 if g.hard and not g.satisfied]
+                + [g.name for g in self.hard_goal_audit
+                   if not g.satisfied])
 
     def to_json(self) -> dict:
         summary = proposal_summary(self.proposals)
         summary["numActions"] = self.num_moves
         return {"summary": summary,
                 "goalSummary": [g.to_json() for g in self.goal_results],
+                "hardGoalAudit": [g.to_json()
+                                  for g in self.hard_goal_audit],
                 "violatedGoalsBefore": self.violated_goals_before,
                 "violatedGoalsAfter": self.violated_goals_after,
                 "proposals": [p.to_json() for p in self.proposals],
@@ -150,11 +165,18 @@ class TpuGoalOptimizer:
                  options_generator=None,
                  registry=None,
                  mesh=None,
-                 branches: int = 0):
+                 branches: int = 0,
+                 hard_goal_names: list[str] | None = None):
         from ..core.sensors import (GOAL_OPTIMIZER_SENSOR, MetricRegistry)
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
+        #: the REGISTERED hard-goal set for the post-optimization audit
+        #: (ref the ``hard.goals`` server config consumed by
+        #: sanityCheckHardGoalPresence and GoalViolationDetector): None =
+        #: the default catalog's hard members. Chain membership still
+        #: exempts a goal from re-audit.
+        self.hard_goal_names = hard_goal_names
         #: best-of-N independent search branches (``search.branches``
         #: server config; parallel/branches.py): each device runs the
         #: full chain under its own PRNG stream via shard_map, the
@@ -184,6 +206,7 @@ class TpuGoalOptimizer:
         import threading
         self._chains: dict[tuple, CompiledGoalChain] = {}
         self._chains_lock = threading.Lock()
+        self._audit_fns: dict[tuple, object] = {}
         self.registry = registry or MetricRegistry()
         # ref GoalOptimizer.java:128 proposal-computation-timer.
         self._proposal_timer = self.registry.timer(MetricRegistry.name(
@@ -236,6 +259,7 @@ class TpuGoalOptimizer:
         # binding so unchanged topology reuses compiled passes.
         goals = [g.bind(metadata) for g in self.goals]
         chain = self._chain_for(cfg, goals)
+        audit = self._audit_goals_for(goals, metadata, options)
 
         excluded_parts = options.excluded_partition_mask(metadata, P)
         ctx = build_context(
@@ -248,14 +272,64 @@ class TpuGoalOptimizer:
                 options.broker_mask(metadata, B,
                                     options.excluded_brokers_for_leadership)))
 
-        needs_tlc = any(g.uses_topic_leader_counts for g in goals)
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals + audit)
         needs_topics = needs_tlc or any(g.uses_topic_counts
-                                        for g in goals)
+                                        for g in goals + audit)
         state = init_state(
             model,
             with_topic_counts=metadata.num_topics if needs_topics else None,
             with_topic_leader_counts=needs_tlc)
-        return cfg, goals, chain, ctx, state
+        return cfg, goals, chain, ctx, state, audit
+
+    def _audit_goals_for(self, chain_goals, metadata,
+                         options: OptimizationOptions):
+        """Registered hard goals NOT in the chain, bound to this model —
+        the post-optimization audit set (ref GoalOptimizer.java:458-497:
+        the reference's proposal computation always runs its configured
+        hard goals; GoalViolationDetector.java:56 audits the same set).
+        Without this, a request naming only soft goals would make the
+        hard-goal gate vacuous. Empty when skipped or fully waived."""
+        if options.skip_hard_goal_check:
+            return []
+        in_chain = {g.name for g in chain_goals}
+        # A chain carrying a documented relaxation of a registered hard
+        # goal (RackAwareDistributionGoal relaxes strict one-replica-per-
+        # rack to ceil(RF/num_racks) — RackAwareDistributionGoal.java;
+        # the kafka-assigner rack goal likewise supersedes it) signals the
+        # operator chose the alternative: auditing the strict form would
+        # fail every RF > num_racks cluster the relaxation exists for.
+        alternatives = {"RackAwareGoal": ("RackAwareDistributionGoal",
+                                          "KafkaAssignerEvenRackAwareGoal")}
+        if self.hard_goal_names is not None:
+            from .goals import goals_by_name
+            registered = goals_by_name(self.hard_goal_names,
+                                       self.constraint)
+        else:
+            registered = [g for g in default_goals(self.constraint)
+                          if g.hard]
+        return [g.bind(metadata) for g in registered
+                if g.name not in in_chain
+                and g.name not in options.waived_hard_goals
+                and not any(a in in_chain
+                            for a in alternatives.get(g.name, ()))]
+
+    def _audit_fn_for(self, audit):
+        """Jitted ``(state, ctx) -> (f32[A] violations, f32[A] scales)``
+        over the audit goals — one dispatch each on the initial and final
+        states; cached per goal binding (jit itself re-specializes per
+        input shapes/shardings)."""
+        key = tuple((g.name, g.bind_signature()) for g in audit)
+        fn = self._audit_fns.get(key)
+        if fn is None:
+            from .engine import violation_stack
+
+            def _audit(state, ctx, _goals=tuple(audit)):
+                import jax.numpy as jnp
+                return (violation_stack(_goals, state, ctx),
+                        jnp.stack([g.violation_scale(state, ctx)
+                                   for g in _goals]))
+            fn = self._audit_fns.setdefault(key, jax.jit(_audit))
+        return fn
 
     def warmup(self, model: FlatClusterModel, metadata: ClusterMetadata,
                options: OptimizationOptions | None = None) -> None:
@@ -265,12 +339,22 @@ class TpuGoalOptimizer:
         from a background thread at server startup; a subsequent
         ``optimize`` with the same shapes pays no XLA compile."""
         options = options or OptimizationOptions()
-        cfg, goals, chain, ctx, state = self._prepare(model, metadata,
-                                                      options)
+        cfg, goals, chain, ctx, state, audit = self._prepare(model, metadata,
+                                                             options)
         key = jax.random.PRNGKey(options.seed)
+        if audit:
+            # The off-chain hard-goal audit runs on the request path too —
+            # pre-compile its (tiny) violation-stack program alongside the
+            # chain so the first optimize pays no XLA at all.
+            self._audit_fn_for(audit).lower(state, ctx).compile()
         if self.branches > 1:
             # The branched path never runs the per-goal passes — warm the
-            # shard_map program it actually serves instead.
+            # shard_map program it actually serves instead. AOT compiles
+            # don't seed the jit dispatch cache; the persistent file
+            # cache is the bridge that makes the first real optimize
+            # skip XLA (mirrors CompiledGoalChain.warmup).
+            from ..utils.platform import enable_compilation_cache
+            enable_compilation_cache()
             self._branched_run_for(cfg, goals).lower(state, ctx,
                                                      key).compile()
             return
@@ -298,9 +382,15 @@ class TpuGoalOptimizer:
         ref the ``OptimizationForGoal`` steps in /user_tasks)."""
         options = options or OptimizationOptions()
         t0 = time.monotonic()
-        cfg, goals, chain, ctx, state = self._prepare(model, metadata,
-                                                      options)
+        cfg, goals, chain, ctx, state, audit = self._prepare(model, metadata,
+                                                             options)
         key = jax.random.PRNGKey(options.seed)
+        # Off-chain hard-goal audit, initial reading: dispatched before any
+        # donating pass touches the state buffer (same ordering argument as
+        # chain.aux below — device execution follows dispatch order).
+        audit_fn = self._audit_fn_for(audit) if audit else None
+        audit_before = (audit_fn(state, ctx) if audit_fn is not None
+                        else None)
 
         # First use of this (shapes, goal-chain) pairing: compile all
         # passes in parallel instead of paying serial XLA compiles one
@@ -312,7 +402,8 @@ class TpuGoalOptimizer:
         if self.branches > 1:
             return self._optimize_branched(model, metadata, options, cfg,
                                            goals, chain, ctx, state, key,
-                                           t0, on_goal_start)
+                                           t0, on_goal_start,
+                                           audit, audit_fn, audit_before)
         chain.warmup(state, ctx, key)
 
         # One violation stack per goal boundary: stack[i] before goal i runs
@@ -460,10 +551,11 @@ class TpuGoalOptimizer:
         goal_results = [replace(gr, violation_after=float(boundary[i]))
                         for i, gr in enumerate(goal_results)]
         return self._finish(model, metadata, options, state, goal_results,
-                            t0)
+                            t0, ctx, audit, audit_fn, audit_before)
 
     def _optimize_branched(self, model, metadata, options, cfg, goals,
-                           chain, ctx, state, key, t0, on_goal_start):
+                           chain, ctx, state, key, t0, on_goal_start,
+                           audit=(), audit_fn=None, audit_before=None):
         """Best-of-N independent search branches (parallel/branches.py):
         every device runs the FULL goal chain on a replicated model under
         its own PRNG stream via shard_map, and the lexicographically best
@@ -508,9 +600,23 @@ class TpuGoalOptimizer:
                 violation_after=float(vbest[i]), duration_s=per,
                 iterations=0, scale=float(scales_arr[i])))
         return self._finish(model, metadata, options, state, goal_results,
-                            t0)
+                            t0, ctx, audit, audit_fn, audit_before)
 
-    def _finish(self, model, metadata, options, state, goal_results, t0):
+    def _finish(self, model, metadata, options, state, goal_results, t0,
+                ctx=None, audit=(), audit_fn=None, audit_before=None):
+        audit_results: list[GoalResult] = []
+        if audit_fn is not None:
+            t_a = time.monotonic()
+            (v_after, scales), (v_before, _) = jax.device_get(
+                (audit_fn(state, ctx), audit_before))
+            audit_s = (time.monotonic() - t_a) / max(len(audit), 1)
+            audit_results = [
+                GoalResult(name=g.name, hard=True,
+                           violation_before=float(v_before[i]),
+                           violation_after=float(v_after[i]),
+                           duration_s=audit_s, iterations=0,
+                           scale=float(scales[i]))
+                for i, g in enumerate(audit)]
         final = to_model(state, model)
         proposals = diff_proposals(model, final, metadata)
         duration_s = time.monotonic() - t0
@@ -520,11 +626,18 @@ class TpuGoalOptimizer:
             proposals=proposals, goal_results=goal_results,
             num_moves=int(jax.device_get(state.moves_applied)),
             duration_s=duration_s, final_model=final,
-            provision_response=self._provision_verdict(final, goal_results))
+            provision_response=self._provision_verdict(final, goal_results),
+            hard_goal_audit=audit_results)
         if result.violated_hard_goals and not options.skip_hard_goal_check:
+            in_chain = {g.name for g in goal_results
+                        if g.hard and not g.satisfied}
+            audited = [n for n in result.violated_hard_goals
+                       if n not in in_chain]
+            detail = (f" (off-chain, caught by the registered-hard-goal "
+                      f"audit: {audited})" if audited else "")
             raise OptimizationFailureError(
                 f"hard goals still violated after optimization: "
-                f"{result.violated_hard_goals}", result)
+                f"{result.violated_hard_goals}{detail}", result)
         return result
 
     def _provision_verdict(self, final: FlatClusterModel,

@@ -34,8 +34,18 @@ class OptimizationOptions:
     #: that leaves a hard goal violated raises OptimizationFailureError
     #: instead of silently returning an unsafe plan (ref
     #: skip_hard_goal_check request parameter; AbstractGoal throwing
-    #: OptimizationFailureException).
+    #: OptimizationFailureException). Skipping also disables the
+    #: off-chain hard-goal audit below.
     skip_hard_goal_check: bool = False
+    #: Named hard goals exempted from the post-optimization audit of
+    #: registered hard goals NOT in the chain (the reference enforces its
+    #: configured hard goals on every run — GoalOptimizer.java:458-497 —
+    #: and audits them continuously, GoalViolationDetector.java:56; a
+    #: soft-goal-only chain here is still gated on the remaining hard
+    #: goals). Waive a goal only when the chain deliberately cannot
+    #: preserve it (e.g. a distribution-only chain vs rack-awareness) and
+    #: a full-chain run covers it elsewhere.
+    waived_hard_goals: frozenset[str] = frozenset()
 
     def excluded_partition_mask(self, metadata: ClusterMetadata,
                                 padded_partitions: int) -> np.ndarray | None:

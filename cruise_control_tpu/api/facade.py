@@ -134,7 +134,8 @@ class KafkaCruiseControl:
                     options_generator=self.optimizer.options_generator,
                     registry=self.optimizer.registry,
                     mesh=self.optimizer.mesh,
-                    branches=self.optimizer.branches)
+                    branches=self.optimizer.branches,
+                    hard_goal_names=self.optimizer.hard_goal_names)
             self._goal_optimizers[key] = opt   # re-insert = most recent
             while len(self._goal_optimizers) > self.MAX_GOAL_OPTIMIZERS:
                 self._goal_optimizers.pop(

@@ -244,10 +244,14 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
                                    "table instead)"}
         if name in _OPTIMIZATION_ENDPOINTS:
             ok.update(_ref("OptimizationResult"))
+        # JSON is the documented default body (json defaults true): every
+        # 200 advertises application/json — a typed $ref where one
+        # exists, a generic object otherwise.
+        ok.setdefault("content", {}).setdefault(
+            "application/json", {"schema": {"type": "object"}})
         # json=false renders a plaintext table for the same 200 (ref the
         # response classes' writeOutputStream path).
-        ok.setdefault("content", {})["text/plain"] = {
-            "schema": {"type": "string"}}
+        ok["content"]["text/plain"] = {"schema": {"type": "string"}}
         responses = {
             "200": ok,
             "400": {"description": "invalid parameters",

@@ -86,7 +86,8 @@ class CruiseControlApp:
                  accesslog: bool = False,
                  ssl_context=None,
                  parameter_overrides: dict | None = None,
-                 engine: str = "threading") -> None:
+                 engine: str = "threading",
+                 max_block_time_ms: int | None = None) -> None:
         # None = use the component's own default (single source of truth
         # in tasks.py / purgatory.py); values are forwarded only when set.
         self.facade = facade
@@ -110,6 +111,11 @@ class CruiseControlApp:
         #: endpoint -> EndpointParameters subclass overriding the built-in
         #: (ref CruiseControlParametersConfig pluggable parameter classes)
         self.parameter_overrides = parameter_overrides or {}
+        #: cap on how long one request may block awaiting an async result
+        #: (ref webserver.request.maxBlockTimeMs): a larger
+        #: get_response_timeout_s is clamped here and the client re-polls
+        #: by User-Task-ID. None = unclamped (direct construction).
+        self.max_block_time_ms = max_block_time_ms
         #: "threading" (stdlib ThreadingHTTPServer, the Jetty analog) or
         #: "asyncio" (event-loop engine, the Vert.x analog) — ref the
         #: reference's dual web-server engines (webserver.* configs apply
@@ -302,6 +308,8 @@ class CruiseControlApp:
                                          user_task_id=uuid)
         hdrs = {"User-Task-ID": existing.user_task_id}
         timeout = float(params.get("get_response_timeout_s", 10.0))
+        if self.max_block_time_ms is not None:
+            timeout = min(timeout, self.max_block_time_ms / 1000.0)
         try:
             result = existing.future.result(timeout=timeout)
             return 200, result, hdrs
@@ -348,12 +356,29 @@ class CruiseControlApp:
                     facade.executor.recently_demoted_brokers)
             if params.get("exclude_recently_removed_brokers"):
                 no_replicas |= set(facade.executor.recently_removed_brokers)
+            # Kafka-assigner mode replaces the whole chain with the
+            # assigner goals and the reference waives its hard-goal
+            # presence check there (ParameterUtils sanity check skips when
+            # isKafkaAssignerMode) — waive the off-chain audit to match;
+            # the assigner's own hard rack goal still gates in-chain.
+            waived = frozenset()
+            if params.get("kafka_assigner"):
+                # Waive the server's REGISTERED hard-goal set (hard.goals
+                # config when set, default catalog otherwise) — waiving
+                # only default names would leave a custom registered goal
+                # gating assigner mode.
+                names = facade.optimizer.hard_goal_names
+                if names is None:
+                    from ..analyzer.goals import default_goals
+                    names = [g.name for g in default_goals() if g.hard]
+                waived = frozenset(names)
             return OptimizationOptions(
                 excluded_topics=frozenset(
                     t for t in pattern.split(",") if t),
                 fast_mode=params.get("fast_mode", False),
                 skip_hard_goal_check=params.get("skip_hard_goal_check",
                                                 False),
+                waived_hard_goals=waived,
                 excluded_brokers_for_leadership=frozenset(no_leadership),
                 excluded_brokers_for_replica_move=frozenset(no_replicas),
                 destination_broker_ids=frozenset(

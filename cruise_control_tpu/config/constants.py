@@ -199,7 +199,12 @@ def _analyzer_defs(d: ConfigDef) -> None:
     d.define("default.goals", ConfigType.LIST, "",
              importance=Importance.HIGH, doc="Goal chain (empty = built-in)")
     d.define("hard.goals", ConfigType.LIST, "", importance=Importance.MEDIUM,
-             doc="Hard goal subset")
+             doc="The REGISTERED hard goals: every optimization is audited "
+                 "against this set post-run even when the request's chain "
+                 "omits them (ref sanityCheckHardGoalPresence + "
+                 "GoalViolationDetector). Empty = the default catalog's "
+                 "hard goals (RackAware, MinTopicLeadersPerBroker, "
+                 "ReplicaCapacity and the four capacity goals).")
     d.define("self.healing.goals", ConfigType.LIST, "",
              importance=Importance.MEDIUM, doc="Self-healing goal subset")
     # Batched-search hyper-parameters (no reference equivalent — the TPU
@@ -632,6 +637,21 @@ def _webserver_defs(d: ConfigDef) -> None:
                  "absent)")
     d.define("webserver.session.path", ConfigType.STRING, "/",
              importance=Importance.LOW, doc="Session cookie path")
+    d.define("webserver.request.maxBlockTimeMs", ConfigType.LONG, 10_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Cap on how long a request may block awaiting an async "
+                 "result before returning 202 (the get_response_timeout_s "
+                 "parameter is clamped to this; ref WebServerConfig.java "
+                 "webserver.request.maxBlockTimeMs)")
+    d.define("webserver.session.maxExpiryTimeMs", ConfigType.LONG, 60_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Accepted for config parity (ref WebServerConfig.java "
+                 "webserver.session.maxExpiryTimeMs): the reference "
+                 "expires its servlet session objects; this server is "
+                 "sessionless — async requests resume via the "
+                 "User-Task-ID header, whose retention is governed by "
+                 "completed.user.task.retention.time.ms — so the key has "
+                 "no behavior here (see docs/deviations.md)")
     d.define("webserver.accesslog.enabled", ConfigType.BOOLEAN, True,
              importance=Importance.LOW, doc="Per-request access logging")
     d.define("webserver.http.cors.enabled", ConfigType.BOOLEAN, False,

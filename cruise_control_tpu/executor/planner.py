@@ -36,10 +36,21 @@ class ExecutionTaskPlanner:
                   ctx: StrategyContext) -> list[ExecutionTask]:
         if self._ordered is None:
             return sorted(pending, key=lambda t: self.strategy.key(t, ctx))
-        if len(self._ordered) == len(pending):
-            return self._ordered
         live = {id(t) for t in pending}
-        return [t for t in self._ordered if id(t) in live]
+        if len(self._ordered) == len(pending):
+            # Cheap identity check before trusting the cached order:
+            # equal length alone would silently return stale tasks for a
+            # caller passing a same-length but different list.
+            if all(id(t) in live for t in self._ordered):
+                return self._ordered
+        covered = [t for t in self._ordered if id(t) in live]
+        if len(covered) == len(pending):
+            return covered
+        # Pending tasks the cached phase order has never seen (caller
+        # skipped begin_phase for them): the cache can't order what it
+        # doesn't contain — sort the actual list rather than silently
+        # dropping the uncovered tasks from every batch.
+        return sorted(pending, key=lambda t: self.strategy.key(t, ctx))
 
     def inter_broker_batch(self, pending: list[ExecutionTask],
                            in_progress: list[ExecutionTask],

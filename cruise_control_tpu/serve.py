@@ -82,7 +82,10 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     optimizer = TpuGoalOptimizer(
         goals=goals_by_name(goal_names, constraint) if goal_names else None,
         constraint=constraint, config=config.search_config(), mesh=mesh,
-        branches=branches)
+        branches=branches,
+        # ref hard.goals: the registered hard-goal set every optimization
+        # is audited against post-run regardless of chain membership.
+        hard_goal_names=config.get_list("hard.goals") or None)
     executor = Executor(admin, config.executor_config())
     from .analyzer import DefaultOptimizationOptionsGenerator
     gen_cls = load_class(config.get_string(
@@ -217,7 +220,9 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         accesslog=config.get_boolean("webserver.accesslog.enabled"),
         ssl_context=ssl_context,
         parameter_overrides=parameter_overrides,
-        engine=config.get_string("webserver.engine"))
+        engine=config.get_string("webserver.engine"),
+        max_block_time_ms=config.get_long(
+            "webserver.request.maxBlockTimeMs"))
 
 
 class _AgentPipelineSampler:

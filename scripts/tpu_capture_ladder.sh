@@ -7,7 +7,11 @@ mkdir -p bench_tpu
 # Order: headline metric first, demo last — scenario 1's fused 15-goal
 # serial compile is the longest cold cost for the least fresh value, so
 # it must not eat a short tunnel window before the scale rows re-capture.
-for run in "2:" "5:" "4:" "3:" "4:add_brokers" "4:remove_brokers" "1:"; do
+# 4:fullchain (the 15-goal default chain at 10Kx1M, hard goals gating,
+# nothing waived — round-5 north-star row) runs right after the 4-goal
+# headline so a short window still captures both.
+for run in "2:" "5:" "4:" "4:fullchain" "3:" "4:add_brokers" \
+           "4:remove_brokers" "1:"; do
   s="${run%%:*}"; v="${run#*:}"
   tag="s${s}${v:+_$v}"
   args=(--scenario "$s"); [ -n "$v" ] && args+=(--variant "$v")

@@ -6,9 +6,10 @@
 # recovery, keep re-running while the tunnel stays up so the freshest
 # (warmest-cache) numbers win.
 #
-# Output: bench_tpu/s<N>_<epoch>.json (the JSON line) + .log (stderr).
-# A scenario run that falls back to CPU (tunnel died mid-probe) writes
-# platform:"cpu" JSON, which capture() discards — only TPU rows are kept.
+# Output: bench_tpu/s<N>[_<variant>]_<epoch>.json (the JSON line) + .log
+# (stderr). A scenario run that falls back to CPU (tunnel died mid-probe)
+# writes platform:"cpu" JSON, which capture() discards — only TPU rows
+# are kept.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p bench_tpu
@@ -23,25 +24,28 @@ print(p)
 sys.exit(0 if p == 'tpu' else 1)" >/dev/null 2>&1
 }
 
-capture() {  # capture <scenario> <timeout_s>
-  local n="$1" tmo="$2" ts out log
+capture() {  # capture <scenario[:variant]> <timeout_s>
+  local spec="$1" tmo="$2" n v tag ts out log
+  n="${spec%%:*}"; v="${spec#*:}"; [ "$v" = "$spec" ] && v=""
+  tag="s${n}${v:+_$v}"
   ts=$(date +%s)
-  out="bench_tpu/s${n}_${ts}.json"
-  log="bench_tpu/s${n}_${ts}.log"
-  echo "[tpu_watch] $(date -u +%FT%TZ) scenario $n (timeout ${tmo}s)" >> bench_tpu/watch.log
-  timeout "$tmo" python bench.py --scenario "$n" > "$out" 2> "$log"
+  out="bench_tpu/${tag}_${ts}.json"
+  log="bench_tpu/${tag}_${ts}.log"
+  local args=(--scenario "$n"); [ -n "$v" ] && args+=(--variant "$v")
+  echo "[tpu_watch] $(date -u +%FT%TZ) $tag (timeout ${tmo}s)" >> bench_tpu/watch.log
+  timeout "$tmo" python bench.py "${args[@]}" > "$out" 2> "$log"
   local rc=$?
   if [ $rc -ne 0 ] || ! grep -q '"platform": "tpu"' "$out"; then
-    echo "[tpu_watch]   scenario $n: rc=$rc platform=$(grep -o '"platform": "[a-z]*"' "$out" | head -1) — discarded" >> bench_tpu/watch.log
+    echo "[tpu_watch]   $tag: rc=$rc platform=$(grep -o '"platform": "[a-z]*"' "$out" | head -1) — discarded" >> bench_tpu/watch.log
     rm -f "$out"
     return 1
   fi
-  echo "[tpu_watch]   scenario $n OK: $(cat "$out")" >> bench_tpu/watch.log
+  echo "[tpu_watch]   $tag OK: $(cat "$out")" >> bench_tpu/watch.log
   # Tee into the TRACKED results file (bench_tpu/ is gitignored; the
   # driver commits uncommitted work at round end, so on-chip numbers
   # captured after the last interactive turn still reach the repo).
   {
-    echo "$(date -u +%FT%TZ) scenario $n:"
+    echo "$(date -u +%FT%TZ) $tag:"
     echo '```json'
     cat "$out"
     echo '```'
@@ -53,22 +57,26 @@ while true; do
   if probe; then
     echo "[tpu_watch] $(date -u +%FT%TZ) tunnel UP — capturing" >> bench_tpu/watch.log
     # Cheapest first so a short tunnel window still yields evidence;
-    # scenario 2 doubles as the TPU compile-cache warmer. Each capture is
-    # independent (a scenario-specific failure must not starve the rest),
-    # but re-probe between them so a dead tunnel short-circuits the ladder.
-    # Demo (1) last: its fused 15-goal serial compile is the longest
-    # cold cost for the least fresh value in a short tunnel window.
-    for n in 2 5 4 3 1; do
+    # scenario 2 doubles as the TPU compile-cache warmer. 4:fullchain
+    # (15-goal default chain at 10Kx1M, hard goals gating — the round-5
+    # north-star row) runs right after the 4-goal headline. Each capture
+    # is independent (a scenario-specific failure must not starve the
+    # rest), but re-probe between them so a dead tunnel short-circuits
+    # the ladder. Demo (1) last: its fused 15-goal serial compile is the
+    # longest cold cost for the least fresh value in a short window.
+    for spec in 2 5 4 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
-      case "$n" in
-        2) tmo=3600 ;; 1) tmo=3600 ;; 5) tmo=2400 ;; *) tmo=5400 ;;
+      case "$spec" in
+        2|1) tmo=3600 ;; 5) tmo=2400 ;; 4:fullchain) tmo=7200 ;;
+        *) tmo=5400 ;;
       esac
-      capture "$n" "$tmo"
+      capture "$spec" "$tmo"
     done
     # Tunnel still up? Re-run the headline scenarios warm (cache now hot).
     if probe; then
       capture 2 1200
       capture 4 3600
+      capture 4:fullchain 5400
     fi
   else
     echo "[tpu_watch] $(date -u +%FT%TZ) tunnel down" >> bench_tpu/watch.log

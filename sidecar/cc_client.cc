@@ -117,6 +117,9 @@ int main(int argc, char** argv) {
   auto* cfg = req.mutable_config();
   cfg->add_goals("ReplicaDistributionGoal");
   cfg->add_goals("DiskUsageDistributionGoal");
+  // Goal-subset request: chains missing hard goals require the skip flag
+  // (the serving side audits all registered hard goals otherwise).
+  cfg->set_skip_hard_goal_check(true);
   cfg->set_seed(7);
 
   tpu_cruise::MoveList reply;

@@ -81,7 +81,12 @@ def test_balances_skewed_cluster(balance_optimizer):
 def test_self_healing_dead_broker(balance_optimizer):
     spec = make_cluster(skew=False, dead=(2,))
     model, md = flatten_spec(spec)
-    res = balance_optimizer.optimize(model, md, OptimizationOptions(seed=0))
+    # Self-healing runs skip the hard-goal gate (the production fix path
+    # does too, detector/detectors.py): with a quarter of the capacity
+    # gone, the CPU-goal-free BALANCE_GOALS chain can land a broker over
+    # the CPU ceiling — the drain itself is what this test pins.
+    res = balance_optimizer.optimize(model, md, OptimizationOptions(
+        seed=0, skip_hard_goal_check=True))
     rb = np.asarray(res.final_model.replica_broker)
     dead_row = md.broker_index[2]
     assert not (rb == dead_row).any(), "replicas remain on dead broker"
@@ -182,7 +187,12 @@ def test_leadership_distribution():
     model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=partitions))
     opt = TpuGoalOptimizer(
         goals=goals_by_name(["LeaderReplicaDistributionGoal"]), config=CFG)
-    res = opt.optimize(model, md, OptimizationOptions(seed=0))
+    # Kernel isolation: the fixture's replica placement (brokers 0 and 2
+    # share rack r0) violates strict rack-awareness before and after —
+    # leadership moves can't touch placement, so the off-chain audit is
+    # skipped as the reference's goal-subset sanity check requires.
+    res = opt.optimize(model, md, OptimizationOptions(
+        seed=0, skip_hard_goal_check=True))
     leaders = np.asarray(res.final_model.replica_broker[:, 0][:12])
     counts = np.bincount(leaders, minlength=4)[:4]
     assert counts.max() <= 5, f"leaders still skewed: {counts}"
@@ -412,3 +422,95 @@ def test_reoptimizing_a_converged_model_is_a_noop(balance_optimizer):
     third = balance_optimizer.optimize(first.final_model, md,
                                        OptimizationOptions(seed=60))
     assert third.proposals == []
+
+
+# --------------------------------------------------------------------------
+# Off-chain hard-goal audit (ref GoalOptimizer.java:458-497 — the reference
+# runs its configured hard goals on every proposal computation;
+# GoalViolationDetector.java:56 audits the same set): a chain naming only
+# soft goals must not make the hard-goal gate vacuous.
+
+def _cpu_hot_cluster():
+    """Replica COUNTS perfectly balanced (so ReplicaDistributionGoal is a
+    no-op) but broker 0 carries CPU far over its capacity threshold —
+    only the off-chain CpuCapacityGoal audit can see it. rf=1 and one
+    rack per broker keep every other audited hard goal satisfied."""
+    brokers = [BrokerSpec(broker_id=b, rack=f"r{b}",
+                          capacity=(10.0, 1e6, 1e6, 1e8))
+               for b in range(4)]
+    parts = [PartitionSpec(topic="t", partition=p, replicas=[p % 4],
+                           leader_load=(6.0 if p % 4 == 0 else 0.1,
+                                        1.0, 1.0, 10.0))
+             for p in range(8)]
+    return flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
+
+
+def test_soft_goal_chain_gated_by_off_chain_hard_goal_audit():
+    from cruise_control_tpu.analyzer import OptimizationFailureError
+    model, md = _cpu_hot_cluster()
+    opt = TpuGoalOptimizer(goals=goals_by_name(["ReplicaDistributionGoal"]),
+                           config=CFG)
+    with pytest.raises(OptimizationFailureError) as ei:
+        opt.optimize(model, md, OptimizationOptions(seed=0))
+    assert "CpuCapacityGoal" in str(ei.value)
+    res = ei.value.result
+    assert "CpuCapacityGoal" in res.violated_hard_goals
+    audited = {g.name: g for g in res.hard_goal_audit}
+    assert not audited["CpuCapacityGoal"].satisfied
+    assert audited["CpuCapacityGoal"].violation_before > 0
+    # The other registered hard goals were audited too — and pass.
+    assert audited["RackAwareGoal"].satisfied
+    assert audited["DiskCapacityGoal"].satisfied
+    # The chain goal itself converged: the failure is purely off-chain.
+    assert res.goal_results[0].satisfied
+    # The audit surfaces in the JSON response shape.
+    assert any(g["goal"] == "CpuCapacityGoal"
+               for g in res.to_json()["hardGoalAudit"])
+
+
+def test_hard_goal_audit_waiver_and_skip():
+    model, md = _cpu_hot_cluster()
+    opt = TpuGoalOptimizer(goals=goals_by_name(["ReplicaDistributionGoal"]),
+                           config=CFG)
+    # Per-goal waiver: the named goal is exempt, the rest stay audited.
+    res = opt.optimize(model, md, OptimizationOptions(
+        seed=0, waived_hard_goals=frozenset({"CpuCapacityGoal"})))
+    names = {g.name for g in res.hard_goal_audit}
+    assert "CpuCapacityGoal" not in names
+    assert "RackAwareGoal" in names
+    assert res.violated_hard_goals == []
+    # skip_hard_goal_check disables the audit wholesale (the reference's
+    # goal-subset escape hatch).
+    res2 = opt.optimize(model, md, OptimizationOptions(
+        seed=0, skip_hard_goal_check=True))
+    assert res2.hard_goal_audit == []
+
+
+def test_default_chain_has_empty_audit(balance_optimizer):
+    """A chain already containing a hard goal never re-audits it; the
+    default full chain audits only the hard goals it omits."""
+    from cruise_control_tpu.analyzer.goals import default_goals
+    model, md = flatten_spec(make_cluster())
+    full = TpuGoalOptimizer(config=CFG)
+    res = full.optimize(model, md, OptimizationOptions(seed=0))
+    assert res.hard_goal_audit == []
+    # The 5-goal balance chain omits CPU/NW capacity: exactly those (and
+    # only those) appear in its audit.
+    res5 = balance_optimizer.optimize(model, md, OptimizationOptions(seed=2))
+    expect = {g.name for g in default_goals() if g.hard} - {
+        "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal"}
+    assert {g.name for g in res5.hard_goal_audit} == expect
+
+
+def test_hard_goal_names_config_scopes_the_audit():
+    """``hard.goals`` (serve config) replaces the default catalog as the
+    registered-hard-goal set: only the named goals are audited."""
+    model, md = _cpu_hot_cluster()
+    opt = TpuGoalOptimizer(goals=goals_by_name(["ReplicaDistributionGoal"]),
+                           config=CFG,
+                           hard_goal_names=["DiskCapacityGoal",
+                                            "RackAwareGoal"])
+    res = opt.optimize(model, md, OptimizationOptions(seed=0))
+    assert {g.name for g in res.hard_goal_audit} == {
+        "DiskCapacityGoal", "RackAwareGoal"}
+    assert res.violated_hard_goals == []   # CPU hot spot is NOT registered

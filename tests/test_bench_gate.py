@@ -17,5 +17,10 @@ def test_transport_death_gate():
         assert bench._is_transport_death(Exception(msg)), msg
     for msg in ("quality regression: tpu residual 5.0 > greedy 1.0",
                 "hard goals still violated after optimization: DiskCapacityGoal",
-                "optimization self-check failed: goal X worsened"):
+                "optimization self-check failed: goal X worsened",
+                # Deterministic errors that merely MENTION a connection
+                # must not ride the CPU retry (the old bare-substring
+                # match classified these as transport deaths).
+                "bad sampler config: connection pool size must be > 0",
+                "invalid connection string in properties file"):
         assert not bench._is_transport_death(RuntimeError(msg)), msg

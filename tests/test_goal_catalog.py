@@ -40,6 +40,13 @@ def _cluster(loads, num_brokers=6, partitions=96, rf=2, racks=3,
 
 def _run(model, md, goal_name, **opts):
     opt = TpuGoalOptimizer(goals=goals_by_name([goal_name]), config=CFG)
+    # Kernel-isolation runs: a single-goal chain cannot (and need not)
+    # preserve the other registered hard goals, so the off-chain audit is
+    # skipped exactly as the reference requires for goal-subset requests
+    # (ParameterUtils hard-goal presence sanity check forces
+    # skip_hard_goal_check for chains missing hard goals). The assertions
+    # below check residuals directly, so nothing is weakened.
+    opts.setdefault("skip_hard_goal_check", True)
     res = opt.optimize(model, md, OptimizationOptions(seed=0, **opts))
     checks = sanity_check(res.final_model)
     assert all(v == 0 for v in checks.values()), checks
@@ -135,7 +142,8 @@ def test_replica_capacity_goal_enforces_max_replicas():
     model, md = _cluster(lambda p: (0.1, 1.0, 1.0, 10.0))
     opt = TpuGoalOptimizer(goals=goals_by_name(["ReplicaCapacityGoal"], cst),
                           config=CFG)
-    res = opt.optimize(model, md, OptimizationOptions(seed=0))
+    res = opt.optimize(model, md, OptimizationOptions(
+        seed=0, skip_hard_goal_check=True))
     counts = np.asarray(broker_replica_counts(res.final_model))[:6]
     assert (counts <= 40).all(), counts
     assert counts.sum() == 192  # nothing lost (96 partitions x rf 2)

@@ -42,6 +42,11 @@ def test_python_roundtrip(sidecar):
         m.broker_rack.append(b % 3)
         m.broker_alive.append(True)
     req.config.goals.append("ReplicaDistributionGoal")
+    # Goal-subset request: the reference requires skip_hard_goal_check
+    # for chains missing hard goals, and the fixture's placement (brokers
+    # p%2 / 2+p%2 share racks mod 3) can't stay strictly rack-aware under
+    # count-only moves.
+    req.config.skip_hard_goal_check = True
     payload = req.SerializeToString()
     with socket.create_connection(("127.0.0.1", sidecar.port)) as sock:
         sock.sendall(struct.pack(">I", len(payload)) + payload)
