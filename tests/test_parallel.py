@@ -164,3 +164,49 @@ def test_branched_optimizer_mid_scale_converges():
     for g in res.goal_results:
         assert g.violation_after <= 1e-6, (g.name, g.violation_after)
     assert res.num_moves > 500     # the skew genuinely required work
+
+
+def test_branched_search_beats_single_on_constrained_budget():
+    """Branch-quality A/B (VERDICT r4 #6): under a constrained per-goal
+    iteration budget on a rugged (heavy-tailed disk, tight capacity)
+    landscape, best-of-4 independent branches lands a strictly better
+    final residual than the single-branch walk — the measured margin that
+    justifies `search.branches` (full sweep in BASELINE.md: branches=1
+    residuals {48612, 47971, 48823} over seeds 0-2 vs branches=4
+    {47224, 47757, 47722}; worst branched beats best single)."""
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             TpuGoalOptimizer)
+    from cruise_control_tpu.model.spec import BrokerSpec, PartitionSpec
+    rng = np.random.default_rng(5)
+    brokers = [BrokerSpec(broker_id=b, rack=f"r{b % 5}",
+                          capacity=(100.0, 1e6, 1e6, 6.5e5))
+               for b in range(60)]
+    hot = np.arange(12)
+    parts = []
+    for p in range(3000):
+        pool = hot if p % 2 == 0 else np.arange(60)
+        reps = rng.choice(pool, size=2, replace=False)
+        disk = float(rng.pareto(1.5) * 60 + 40)
+        parts.append(PartitionSpec(
+            topic=f"t{p % 40}", partition=p,
+            replicas=[int(x) for x in reps],
+            leader_load=(0.05, 8.0, 12.0, disk)))
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
+    cfg = SearchConfig(num_replica_candidates=128, num_dest_candidates=8,
+                       apply_per_iter=128, max_iters_per_goal=20,
+                       polish_passes=0)
+    goal_names = ["DiskCapacityGoal", "ReplicaDistributionGoal",
+                  "DiskUsageDistributionGoal"]
+    opts = OptimizationOptions(seed=0, skip_hard_goal_check=True)
+
+    def run(branches):
+        opt = TpuGoalOptimizer(goals=goals_by_name(goal_names), config=cfg,
+                               branches=branches)
+        res = opt.optimize(model, md, opts)
+        return res.goal_results[-1].violation_after
+
+    single = run(0)
+    branched = run(4)
+    # Strictly better, by a real margin (measured ~2.9% on this fixture;
+    # asserted at 0.5% so float noise across BLAS builds can't flake it).
+    assert branched < single * 0.995, (branched, single)
