@@ -1,0 +1,92 @@
+"""Stage-level profile of the hot goal passes (BASELINE.md "Warm-loop
+stage profile"): propose (candidate gen) vs delta+acceptance scoring vs
+full-pass per-iteration cost (apply + collective guards = remainder),
+measured by jitting each stage in isolation on the same mid-chain state.
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=. python scripts/stage_profile.py
+(or on the chip with the default backend).
+"""
+import time
+
+import jax
+import numpy as np
+
+from bench import build_flat_direct
+from cruise_control_tpu.analyzer import SearchConfig
+from cruise_control_tpu.analyzer.engine import (make_goal_pass,
+                                                violation_stack)
+from cruise_control_tpu.analyzer.goals import default_goals
+from cruise_control_tpu.analyzer.state import build_context, init_state
+
+HOT = ("TopicReplicaDistributionGoal",
+       "NetworkOutboundUsageDistributionGoal")
+
+
+def time_fn(fn, *args, reps=3):
+    jax.block_until_ready(fn(*args))       # compile + settle
+    t0 = time.monotonic()
+    out = None
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / reps
+
+
+def main(brokers=1000, partitions=200_000):
+    model, md = build_flat_direct(brokers, partitions, 2)
+    cfg = SearchConfig(num_replica_candidates=1024, num_dest_candidates=16,
+                       apply_per_iter=1024,
+                       drain_batch=max(partitions // 8, 16384),
+                       drain_rounds=8, max_iters_per_goal=512,
+                       num_swap_candidates=512)
+    goals = [g.bind(md) for g in default_goals()]
+    ctx = build_context(model)
+    st = init_state(model, with_topic_counts=md.num_topics,
+                    with_topic_leader_counts=True)
+    key = jax.random.PRNGKey(0)
+    passes = [jax.jit(make_goal_pass(g, goals[:i], cfg, all_goals=goals))
+              for i, g in enumerate(goals)]
+
+    for i, g in enumerate(goals):
+        if g.name in HOT:
+            prev = tuple(goals[:i])
+            f_prop = jax.jit(lambda s, k, _g=g: _g.propose(s, ctx, k, cfg))
+            t_prop = time_fn(f_prop, st, key)
+
+            def f_score_impl(s, k, _g=g, _prev=prev):
+                c = _g.propose(s, ctx, k, cfg)
+                d = _g.delta(s, ctx, c)
+                ok = _g.accepts(s, ctx, c)
+                for p in _prev:
+                    ok = ok & p.accepts(s, ctx, c)
+                return d, ok
+            t_score = time_fn(jax.jit(f_score_impl), st, key)
+            t_viol = time_fn(jax.jit(lambda s, _g=g: _g.violation(s, ctx)),
+                             st, reps=5)
+            from dataclasses import replace
+            cfg1 = replace(cfg, max_iters_per_goal=8, drain_rounds=0)
+            p1 = jax.jit(make_goal_pass(g, list(prev), cfg1,
+                                        all_goals=goals))
+            s2, iters, _ = p1(st, ctx, key)
+            jax.block_until_ready(s2)
+            t0 = time.monotonic()
+            s2, iters, _ = p1(st, ctx, key)
+            jax.block_until_ready(s2)
+            t_pass = time.monotonic() - t0
+            it = max(int(jax.device_get(iters)), 1)
+            per = t_pass / it
+            print(f"{g.name}: propose {t_prop * 1e3:.0f}ms  "
+                  f"propose+score {t_score * 1e3:.0f}ms  "
+                  f"violation {t_viol * 1e3:.0f}ms  "
+                  f"pass/iter {per * 1e3:.0f}ms over {it} iters "
+                  f"(apply+guards ~ {max(per - t_score, 0) * 1e3:.0f}ms)")
+        st, _, _ = passes[i](st, ctx, jax.random.fold_in(key, i))
+    jax.block_until_ready(st)
+    print("final residuals:", np.round(np.asarray(jax.device_get(
+        jax.jit(lambda s: violation_stack(goals, s, ctx))(st))), 1))
+
+
+if __name__ == "__main__":
+    from cruise_control_tpu.utils.platform import ensure_live_backend
+    ensure_live_backend()
+    main()
