@@ -79,6 +79,7 @@ class CruiseControlApp:
                  two_step_verification: bool = False,
                  max_active_tasks: int | None = None,
                  completed_task_retention_ms: int | None = None,
+                 max_cached_completed_tasks: int | None = None,
                  purgatory_retention_ms: int | None = None,
                  purgatory_max_requests: int | None = None,
                  reason_required: bool = False,
@@ -94,6 +95,7 @@ class CruiseControlApp:
         task_kwargs = {k: v for k, v in (
             ("max_active_tasks", max_active_tasks),
             ("completed_task_retention_ms", completed_task_retention_ms),
+            ("max_cached_completed", max_cached_completed_tasks),
         ) if v is not None}
         self.tasks = UserTaskManager(**task_kwargs)
         purgatory_kwargs = {k: v for k, v in (

@@ -63,13 +63,20 @@ class UserTaskInfo:
 class UserTaskManager:
     def __init__(self, max_active_tasks: int = 25,
                  completed_task_retention_ms: int = 24 * 3600 * 1000,
-                 num_threads: int = 8) -> None:
+                 num_threads: int = 8,
+                 max_cached_completed: int = 100) -> None:
         self._tasks: dict[str, UserTaskInfo] = {}
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
                                         thread_name_prefix="user-task")
         self.max_active_tasks = max_active_tasks
         self.retention_ms = completed_task_retention_ms
+        #: count cap on retained completed tasks (ref
+        #: max.cached.completed.user.tasks): oldest evicted first, on top
+        #: of the time-based retention. One pool here — the reference's
+        #: per-scope monitor/admin caches are a deliberate deviation
+        #: (docs/deviations.md §8).
+        self.max_cached_completed = max_cached_completed
 
     def _ensure_capacity_locked(self) -> None:
         active = sum(1 for t in self._tasks.values()
@@ -124,6 +131,12 @@ class UserTaskManager:
                  and now - t.start_ms > self.retention_ms]
         for tid in stale:
             del self._tasks[tid]
+        done = [(t.start_ms, tid) for tid, t in self._tasks.items()
+                if t.state is not TaskState.ACTIVE]
+        if len(done) > self.max_cached_completed:
+            for _, tid in sorted(done)[:len(done)
+                                       - self.max_cached_completed]:
+                del self._tasks[tid]
 
     def shutdown(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)

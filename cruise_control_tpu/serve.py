@@ -25,11 +25,11 @@ from .config.capacity import FileCapacityResolver, FixedCapacityResolver
 from .config.constants import CruiseControlConfig
 from .core.config import load_class, load_properties_file
 from .model.cpu_regression import LinearRegressionModelParameters
-from .detector import (AnomalyDetectorManager, BrokerFailureDetector,
-                       DiskFailureDetector, GoalViolationDetector,
-                       KafkaAnomalyType, MetricAnomalyDetector,
-                       SelfHealingNotifier, SlowBrokerFinder,
-                       TopicAnomalyDetector)
+from .detector import (AnomalyDetectorManager, BalancednessWeights,
+                       BrokerFailureDetector, DiskFailureDetector,
+                       GoalViolationDetector, KafkaAnomalyType,
+                       MetricAnomalyDetector, SelfHealingNotifier,
+                       SlowBrokerFinder, TopicAnomalyDetector)
 from .executor import Executor, SimulatedKafkaCluster
 from .monitor import (FileSampleStore, LoadMonitor, LoadMonitorTaskRunner,
                       MetricFetcherManager, NoopSampleStore,
@@ -143,8 +143,13 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         config.get_int("broker.failure.detection.interval.ms"))
     detector.register(DiskFailureDetector(admin),
                       config.get_int("disk.failure.detection.interval.ms"))
-    detector.register(GoalViolationDetector(monitor, optimizer),
-                      config.get_int("goal.violation.detection.interval.ms"))
+    detector.register(
+        GoalViolationDetector(monitor, optimizer, weights=BalancednessWeights(
+            priority_weight=config.get_double(
+                "goal.balancedness.priority.weight"),
+            strictness_weight=config.get_double(
+                "goal.balancedness.strictness.weight"))),
+        config.get_int("goal.violation.detection.interval.ms"))
     detector.register(MetricAnomalyDetector(monitor),
                       config.get_int("metric.anomaly.detection.interval.ms"))
     detector.register(SlowBrokerFinder(
@@ -211,6 +216,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         max_active_tasks=config.get_int("max.active.user.tasks"),
         completed_task_retention_ms=config.get_int(
             "completed.user.task.retention.time.ms"),
+        max_cached_completed_tasks=config.get_int(
+            "max.cached.completed.user.tasks"),
         purgatory_retention_ms=config.get_int(
             "two.step.purgatory.retention.time.ms"),
         purgatory_max_requests=config.get_int(

@@ -661,3 +661,23 @@ def test_json_false_renders_plaintext(stack):
     # And the JSON default is unchanged.
     status, body, _ = call(app, "GET", "load")
     assert status == 200 and "brokers" in body
+
+
+def test_completed_task_count_cap_evicts_oldest():
+    """max.cached.completed.user.tasks: completed tasks beyond the count
+    cap are evicted oldest-first even inside the time retention window."""
+    import time as _time
+    from cruise_control_tpu.api.tasks import TaskState, UserTaskManager
+    mgr = UserTaskManager(max_cached_completed=3)
+    ids = []
+    for i in range(5):
+        info = mgr.submit(f"ep{i}", f"/ep{i}", lambda progress: i)
+        info.future.result(timeout=10)
+        ids.append(info.user_task_id)
+        _time.sleep(0.01)     # distinct start_ms ordering
+    # Trigger the sweep (submit/ensure paths run it under the lock).
+    mgr.ensure_capacity()
+    retained = [t for t in ids if mgr.get(t) is not None]
+    assert len(retained) == 3
+    assert retained == ids[2:], "eviction must drop the OLDEST completed"
+    mgr.shutdown()
