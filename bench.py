@@ -54,9 +54,19 @@ SCALE_SCENARIOS = {
     # 4 resource capacities) is audited post-optimization and GATES the
     # row; the ``fullchain`` variant runs the entire default chain with
     # nothing waived.
+    #
+    # fullchain_swaps: the FULL default chain's swap-heavy passes
+    # (TopicReplicaDistribution, the leadership tails) dominate at
+    # 10K x 1M — swaps=512 halves the warm CPU row to 113.6 s
+    # (226.1 s default batch; Topic 28 -> 19 iters, LeaderBytesIn
+    # 51 -> 34 — BASELINE.md round-5 section). The 4-goal variant
+    # KEEPS the default batch: its leader-driven NW_OUT pass
+    # measurably regresses under a large swap batch (round-4 A/B,
+    # 38 -> 128 iters).
     4: dict(brokers=10_000, partitions=1_000_000, rf=2, goals=GOALS,
             metric="rebalance_proposal_wall_clock_10kx1m", target_s=30.0,
-            k=1024, k_tpu=4096, waive=("RackAwareGoal",)),
+            k=1024, k_tpu=4096, waive=("RackAwareGoal",),
+            fullchain_swaps=512),
 }
 
 
@@ -348,6 +358,8 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
     if "swaps" in cfgd:
         # Scenario-specific override; absent = SearchConfig's default.
         cfg_kw["num_swap_candidates"] = cfgd["swaps"]
+    if variant == "fullchain" and "fullchain_swaps" in cfgd:
+        cfg_kw["num_swap_candidates"] = cfgd["fullchain_swaps"]
     opt = TpuGoalOptimizer(goals=goals, config=SearchConfig(**cfg_kw),
                            mesh=_make_mesh(mesh_devices))
     t0 = time.monotonic()

@@ -53,6 +53,12 @@ class KafkaCruiseControl:
         # detectors, which call optimize() directly, go through it too.
         if options_generator is not None:
             self.optimizer.options_generator = options_generator
+        #: goal names the self-healing fix paths optimize with (ref
+        #: self.healing.goals; None/empty = the default chain). The
+        #: anomaly fix() methods read this; serve.py wires it from config
+        #: after validating it covers the registered hard goals (the
+        #: reference's startup sanity check).
+        self.self_healing_goals: list[str] | None = None
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         self.proposal_cache = ProposalCache(monitor, self.optimizer)
         # Shared with the metrics processor so a TRAIN-fitted regression

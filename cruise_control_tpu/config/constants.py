@@ -206,7 +206,11 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "hard goals (RackAware, MinTopicLeadersPerBroker, "
                  "ReplicaCapacity and the four capacity goals).")
     d.define("self.healing.goals", ConfigType.LIST, "",
-             importance=Importance.MEDIUM, doc="Self-healing goal subset")
+             importance=Importance.MEDIUM,
+             doc="Goal chain used by self-healing fixes (empty = the "
+                 "default chain). When set it must include every "
+                 "registered hard goal — validated at startup, ref "
+                 "KafkaCruiseControlConfig sanityCheckGoalNames")
     # Batched-search hyper-parameters (no reference equivalent — the TPU
     # replacement for the greedy loop's implicit schedule).
     d.define("search.num.replica.candidates", ConfigType.INT, 256,
@@ -253,10 +257,13 @@ def _analyzer_defs(d: ConfigDef) -> None:
              importance=Importance.MEDIUM,
              doc="Goal chain for rebalance_disk / remove_disks (empty = "
                  "built-in intra-broker pair)")
-    d.define("anomaly.detection.goals", ConfigType.LIST, "",
+    d.define("anomaly.detection.goals", ConfigType.LIST,
+             "RackAwareGoal,MinTopicLeadersPerBrokerGoal,"
+             "ReplicaCapacityGoal,DiskCapacityGoal",
              importance=Importance.MEDIUM,
-             doc="Goals the goal-violation detector checks (empty = "
-                 "default chain)")
+             doc="Goals the goal-violation detector dry-runs (ref "
+                 "AnomalyDetectorConfig.java:101 default: the four "
+                 "leading hard goals; empty = the full default chain)")
     d.define("goal.balancedness.priority.weight", ConfigType.DOUBLE, 1.1,
              validator=Range.at_least(1.0), importance=Importance.LOW,
              doc="Balancedness score: weight ratio between consecutive "

@@ -93,7 +93,8 @@ class BrokerFailures(KafkaAnomaly):
     def fix(self, facade) -> bool:
         res, exec_res = facade.remove_brokers(
             sorted(self.failed_brokers), dryrun=False,
-            uuid=self.anomaly_id)
+            uuid=self.anomaly_id,
+            goals=getattr(facade, "self_healing_goals", None))
         # No proposals == nothing left to move (already healed): success.
         return exec_res is None or exec_res.succeeded
 
@@ -109,8 +110,9 @@ class DiskFailures(KafkaAnomaly):
         return f"Disks failed: {self.failed_disks}"
 
     def fix(self, facade) -> bool:
-        res, exec_res = facade.fix_offline_replicas(dryrun=False,
-                                                    uuid=self.anomaly_id)
+        res, exec_res = facade.fix_offline_replicas(
+            dryrun=False, uuid=self.anomaly_id,
+            goals=getattr(facade, "self_healing_goals", None))
         return exec_res is None or exec_res.succeeded
 
 
@@ -129,8 +131,12 @@ class GoalViolations(KafkaAnomaly):
     def fix(self, facade) -> bool:
         if not self.fixable_violations:
             return False
-        res, exec_res = facade.rebalance(dryrun=False, uuid=self.anomaly_id,
-                                         ignore_proposal_cache=True)
+        # ref self.healing.goals: when configured, self-healing optimizes
+        # with that chain instead of the default (serve.py validates it
+        # covers the registered hard goals at startup).
+        res, exec_res = facade.rebalance(
+            dryrun=False, uuid=self.anomaly_id, ignore_proposal_cache=True,
+            goals=getattr(facade, "self_healing_goals", None))
         return exec_res is None or exec_res.succeeded
 
 

@@ -111,6 +111,29 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                                 options_generator=options_generator,
                                 cpu_model=cpu_model)
 
+    # ref self.healing.goals + the reference's startup sanity check
+    # (KafkaCruiseControlConfig sanityCheckGoalNames): a configured
+    # self-healing chain must cover every registered hard goal, or fixes
+    # would fail the hard-goal gate at 3am instead of failing the config
+    # at deploy time.
+    healing_goals = [n.rsplit(".", 1)[-1]
+                     for n in config.get_list("self.healing.goals")]
+    if healing_goals:
+        # Resolve the names NOW: an unknown/misspelled healing goal must
+        # fail the deploy, not the first 3am fix() call.
+        goals_by_name(healing_goals, constraint)
+        from .analyzer.goals import default_goals as _default_goals
+        hard_names = {n.rsplit(".", 1)[-1]
+                      for n in (optimizer.hard_goal_names
+                                or [g.name for g in _default_goals()
+                                    if g.hard])}
+        missing = hard_names - set(healing_goals)
+        if missing:
+            raise ValueError(
+                f"self.healing.goals must include every registered hard "
+                f"goal (hard.goals); missing: {sorted(missing)}")
+        facade.self_healing_goals = healing_goals
+
     healing_on = config.get_boolean("self.healing.enabled")
 
     def healing_for(t: KafkaAnomalyType) -> bool:
@@ -143,8 +166,16 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         config.get_int("broker.failure.detection.interval.ms"))
     detector.register(DiskFailureDetector(admin),
                       config.get_int("disk.failure.detection.interval.ms"))
+    # ref anomaly.detection.goals (default: the 4 leading hard goals,
+    # AnomalyDetectorConfig.java:101): the violation detector dry-runs
+    # THIS chain — a goal-scoped optimizer memoized on the facade so the
+    # compiled passes are shared with same-goal user requests.
+    det_goals = config.get_list("anomaly.detection.goals")
+    det_optimizer = (facade._optimizer_for(det_goals) if det_goals
+                     else optimizer)
     detector.register(
-        GoalViolationDetector(monitor, optimizer, weights=BalancednessWeights(
+        GoalViolationDetector(monitor, det_optimizer,
+                              weights=BalancednessWeights(
             priority_weight=config.get_double(
                 "goal.balancedness.priority.weight"),
             strictness_weight=config.get_double(

@@ -423,3 +423,56 @@ def test_anomaly_check_defers_then_fires():
     assert out["fixed"] == 0 and not fixed_calls
     out = mgr._handle_queue(now=7_000)
     assert out["fixed"] == 1 and fixed_calls
+
+
+def test_self_healing_goals_config_wiring_and_startup_validation():
+    """self.healing.goals reaches the facade (the anomaly fix() paths
+    optimize with it) and is validated at deploy time: it must resolve
+    and must cover every registered hard goal (ref
+    KafkaCruiseControlConfig sanityCheckGoalNames)."""
+    import pytest
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.serve import build_app
+
+    def app_for(healing, hard="DiskCapacityGoal,RackAwareGoal"):
+        sim = SimulatedKafkaCluster()
+        for b in range(3):
+            sim.add_broker(b)
+        sim.add_partition("t", 0, [0, 1], size_mb=10.0)
+        return build_app(CruiseControlConfig({
+            "webserver.http.port": "0",
+            "hard.goals": hard,
+            "self.healing.goals": healing}), admin=sim)
+
+    app = app_for("RackAwareGoal,DiskCapacityGoal,ReplicaDistributionGoal")
+    assert app.facade.self_healing_goals == [
+        "RackAwareGoal", "DiskCapacityGoal", "ReplicaDistributionGoal"]
+    # Missing a registered hard goal -> deploy-time failure.
+    with pytest.raises(ValueError, match="RackAwareGoal"):
+        app_for("DiskCapacityGoal,ReplicaDistributionGoal")
+    # Unknown goal name -> deploy-time failure, not a 3am fix() crash.
+    with pytest.raises(ValueError, match="unknown goal"):
+        app_for("RackAwareGoal,DiskCapacityGoal,ReplicaDistributonGoal")
+    # Empty = default chain: no restriction recorded.
+    assert app_for("").facade.self_healing_goals is None
+
+
+def test_detection_goals_scope_the_violation_detector():
+    """anomaly.detection.goals selects the chain the violation detector
+    dry-runs (default: the reference's 4 leading hard goals)."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.serve import build_app
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1], size_mb=10.0)
+    app = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
+                    admin=sim)
+    gv = [s.detector for s in app.facade.detector._schedules
+          if type(s.detector).__name__ == "GoalViolationDetector"]
+    assert gv, "GoalViolationDetector not registered"
+    assert [g.name for g in gv[0].optimizer.goals] == [
+        "RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
+        "ReplicaCapacityGoal", "DiskCapacityGoal"]

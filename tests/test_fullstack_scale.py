@@ -94,7 +94,7 @@ class _Served:
         with urllib.request.urlopen(req, timeout=310) as r:
             return json.loads(r.read())
 
-    def wait_model_ready(self, timeout=60):
+    def wait_model_ready(self, timeout=120):
         deadline = time.time() + timeout
         while time.time() < deadline:
             st = self.get("state", "substates=monitor")
