@@ -81,6 +81,15 @@ def _render_proposals(payload: dict) -> str:
               _num(g.get("violationBefore", g.get("before", ""))),
               _num(g.get("violationAfter", g.get("after", "")))]
              for g in goals]))
+    audit = payload.get("hardGoalAudit", [])
+    if audit:
+        parts.append("Hard-goal audit (registered hard goals not in the "
+                     "chain):\n" + _table(
+                         ["GOAL", "STATUS", "BEFORE", "AFTER"],
+                         [[g.get("goal"), g.get("status", ""),
+                           _num(g.get("violationBefore", "")),
+                           _num(g.get("violationAfter", ""))]
+                          for g in audit]))
     return "\n\n".join(parts) or _pretty(payload)
 
 

@@ -199,6 +199,10 @@ def _summarize(endpoint: str, body: dict) -> str:
             lines.append(f"  {g['goal']}: {g['status']} "
                          f"({g['violationBefore']:.1f} -> "
                          f"{g['violationAfter']:.1f})")
+        for g in body.get("hardGoalAudit", []):
+            lines.append(f"  [audit] {g['goal']}: {g['status']} "
+                         f"({g['violationBefore']:.1f} -> "
+                         f"{g['violationAfter']:.1f})")
         if "executionResult" in body:
             lines.append(f"execution: {body['executionResult']}")
         return "\n".join(lines)

@@ -681,3 +681,20 @@ def test_completed_task_count_cap_evicts_oldest():
     assert len(retained) == 3
     assert retained == ids[2:], "eviction must drop the OLDEST completed"
     mgr.shutdown()
+
+
+def test_plaintext_renders_hard_goal_audit_table():
+    """json=false optimization responses surface the off-chain hard-goal
+    audit as its own table (api/plaintext.py _render_proposals)."""
+    from cruise_control_tpu.api.plaintext import render
+    payload = {
+        "summary": {"numProposals": 2},
+        "goalSummary": [{"goal": "ReplicaDistributionGoal",
+                         "status": "FIXED", "violationBefore": 9.0,
+                         "violationAfter": 0.0}],
+        "hardGoalAudit": [{"goal": "CpuCapacityGoal", "status": "VIOLATED",
+                           "violationBefore": 4.0, "violationAfter": 4.0}],
+    }
+    text = render("rebalance", payload)
+    assert "Hard-goal audit" in text
+    assert "CpuCapacityGoal" in text and "VIOLATED" in text
