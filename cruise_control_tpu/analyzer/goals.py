@@ -1888,13 +1888,20 @@ KAFKA_ASSIGNER_GOALS = ["KafkaAssignerEvenRackAwareGoal",
                         "KafkaAssignerDiskUsageDistributionGoal"]
 
 
+def short_goal_name(name: str) -> str:
+    """Canonical short form of a goal name: the reference accepts both
+    fully-qualified class names and simple names everywhere
+    (ParameterUtils.getGoals) — normalize once, here."""
+    return name.rsplit(".", 1)[-1]
+
+
 def goals_by_name(names: list[str],
                   constraint: BalancingConstraint | None = None
                   ) -> list[GoalKernel]:
     cst = constraint or BalancingConstraint()
     out = []
     for n in names:
-        short = n.rsplit(".", 1)[-1]
+        short = short_goal_name(n)
         if short not in GOAL_REGISTRY:
             raise ValueError(f"unknown goal {n!r}")
         out.append(GOAL_REGISTRY[short](cst))

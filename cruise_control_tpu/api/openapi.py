@@ -15,6 +15,9 @@ _COMMON_ASYNC_PARAMS = [
     ("kafka_assigner", "boolean",
      "use the kafka-assigner emulation goal set"),
     ("excluded_topics", "string", "comma-separated topics to exclude"),
+    ("waived_hard_goals", "string",
+     "named hard goals exempted from the off-chain audit "
+     "(framework extension; in-chain hard goals still gate)"),
     ("fast_mode", "boolean", "reduced-effort search"),
     ("exclude_brokers_for_leadership", "string", "comma-separated ids"),
     ("exclude_brokers_for_replica_move", "string", "comma-separated ids"),

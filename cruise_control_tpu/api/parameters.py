@@ -113,6 +113,10 @@ _GOAL_PARAMS = (
     Param("exclude_recently_demoted_brokers", "bool", default=False),
     Param("exclude_recently_removed_brokers", "bool", default=False),
     Param("skip_hard_goal_check", "bool", default=False),
+    # Framework extension: exempt NAMED goals from the off-chain
+    # registered-hard-goal audit instead of the all-or-nothing
+    # skip_hard_goal_check (in-chain hard goals still gate).
+    Param("waived_hard_goals", "csv_str"),
     Param("fast_mode", "bool", default=False),
     Param("verbose", "bool", default=False),
     # Framework extension: explicit per-request broker exclusion masks
@@ -249,6 +253,19 @@ class EndpointParameters:
             values[name] = spec.parse(raw_list[0])
         for validate in cls.validators:
             validate(values)
+        # Goal NAMES are validated eagerly (ref ParameterUtils: unknown
+        # goals are a 400 at dispatch, not an opaque failure from the
+        # async operation): both the chain list and the audit waivers
+        # must name registered goals, FQN or short form.
+        for pname in ("goals", "waived_hard_goals"):
+            names = values.get(pname)
+            if names:
+                from ..analyzer.goals import GOAL_REGISTRY, short_goal_name
+                bad = sorted(n for n in names
+                             if short_goal_name(n) not in GOAL_REGISTRY)
+                if bad:
+                    raise ParameterError(
+                        f"unknown goal(s) {bad} in parameter {pname!r}")
         return ParsedParams(endpoint, values)
 
 

@@ -363,7 +363,12 @@ class CruiseControlApp:
             # presence check there (ParameterUtils sanity check skips when
             # isKafkaAssignerMode) — waive the off-chain audit to match;
             # the assigner's own hard rack goal still gates in-chain.
-            waived = frozenset()
+            # Framework extension: per-request audit waivers (named goals
+            # only — in-chain hard goals still gate). Names were
+            # registry-validated at parse time (400 on a typo).
+            from ..analyzer.goals import short_goal_name
+            waived = frozenset(short_goal_name(n) for n in
+                               (params.get("waived_hard_goals") or ()))
             if params.get("kafka_assigner"):
                 # Waive the server's REGISTERED hard-goal set (hard.goals
                 # config when set, default catalog otherwise) — waiving
@@ -373,7 +378,8 @@ class CruiseControlApp:
                 if names is None:
                     from ..analyzer.goals import default_goals
                     names = [g.name for g in default_goals() if g.hard]
-                waived = frozenset(names)
+                waived = waived | frozenset(short_goal_name(n)
+                                            for n in names)
             return OptimizationOptions(
                 excluded_topics=frozenset(
                     t for t in pattern.split(",") if t),

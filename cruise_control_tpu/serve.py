@@ -116,14 +116,15 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     # self-healing chain must cover every registered hard goal, or fixes
     # would fail the hard-goal gate at 3am instead of failing the config
     # at deploy time.
-    healing_goals = [n.rsplit(".", 1)[-1]
+    from .analyzer.goals import short_goal_name
+    healing_goals = [short_goal_name(n)
                      for n in config.get_list("self.healing.goals")]
     if healing_goals:
         # Resolve the names NOW: an unknown/misspelled healing goal must
         # fail the deploy, not the first 3am fix() call.
         goals_by_name(healing_goals, constraint)
         from .analyzer.goals import default_goals as _default_goals
-        hard_names = {n.rsplit(".", 1)[-1]
+        hard_names = {short_goal_name(n)
                       for n in (optimizer.hard_goal_names
                                 or [g.name for g in _default_goals()
                                     if g.hard])}

@@ -698,3 +698,47 @@ def test_plaintext_renders_hard_goal_audit_table():
     text = render("rebalance", payload)
     assert "Hard-goal audit" in text
     assert "CpuCapacityGoal" in text and "VIOLATED" in text
+
+
+def test_waived_hard_goals_request_parameter(stack):
+    """waived_hard_goals (framework extension) exempts only the NAMED
+    goals from the off-chain hard-goal audit: the response's
+    hardGoalAudit drops them while the rest stay audited."""
+    _sim, _facade, app = stack
+    status, body, _ = call(
+        app, "POST", "rebalance",
+        "dryrun=true&goals=ReplicaDistributionGoal"
+        "&ignore_proposal_cache=true"
+        "&waived_hard_goals=CpuCapacityGoal,RackAwareGoal"
+        "&get_response_timeout_s=120")
+    assert status == 200
+    audited = {g["goal"] for g in body["hardGoalAudit"]}
+    assert "CpuCapacityGoal" not in audited
+    assert "RackAwareGoal" not in audited
+    assert "DiskCapacityGoal" in audited
+
+
+def test_unknown_goal_names_are_400_at_dispatch(stack):
+    """Unknown goals in goals= or waived_hard_goals= are a 400 with the
+    bad names listed — never an opaque async failure (ref ParameterUtils
+    eager goal validation)."""
+    _sim, _facade, app = stack
+    status, body, _ = call(app, "POST", "rebalance",
+                           "dryrun=true&goals=NoSuchGoal", expect=400)
+    assert "NoSuchGoal" in body["errorMessage"]
+    status, body, _ = call(
+        app, "POST", "rebalance",
+        "dryrun=true&goals=ReplicaDistributionGoal"
+        "&waived_hard_goals=CpuCapcityGoal", expect=400)
+    assert "CpuCapcityGoal" in body["errorMessage"]
+    # FQN forms resolve (the reference accepts both spellings).
+    status, body, _ = call(
+        app, "POST", "rebalance",
+        "dryrun=true&ignore_proposal_cache=true"
+        "&goals=com.linkedin.kafka.cruisecontrol.analyzer.goals."
+        "ReplicaDistributionGoal"
+        "&waived_hard_goals=com.linkedin.kafka.cruisecontrol.analyzer."
+        "goals.RackAwareGoal,CpuCapacityGoal&get_response_timeout_s=120")
+    assert status == 200
+    audited = {g["goal"] for g in body["hardGoalAudit"]}
+    assert not audited & {"RackAwareGoal", "CpuCapacityGoal"}

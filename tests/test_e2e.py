@@ -89,6 +89,26 @@ class Stack:
         with urllib.request.urlopen(url, timeout=60) as r:
             return json.loads(r.read())
 
+    def post_result(self, endpoint, params, timeout=300):
+        """POST and long-poll to completion: each request blocks at most
+        webserver.request.maxBlockTimeMs (reference default 10 s) before
+        answering 202 + User-Task-ID; real clients re-poll with the id —
+        so do we."""
+        uuid = None
+        deadline = time.time() + timeout
+        while True:
+            qs = params + (f"&user_task_id={uuid}" if uuid else "")
+            req = urllib.request.Request(
+                f"{self.base}/kafkacruisecontrol/{endpoint}?{qs}",
+                data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                body = json.loads(r.read())
+                uuid = r.headers.get("User-Task-ID", uuid)
+                if r.status != 202:
+                    return body
+            assert time.time() < deadline, f"{endpoint} never completed"
+            time.sleep(0.3)
+
     def wait_model_ready(self, timeout=30):
         deadline = time.time() + timeout
         while time.time() < deadline:
@@ -243,11 +263,8 @@ def test_miniature_scale_rebalance_through_served_stack():
     stack = Stack(sim)
     try:
         stack.wait_model_ready(timeout=60)
-        url = (f"{stack.base}/kafkacruisecontrol/rebalance"
-               "?dryrun=true&get_response_timeout_s=300")
-        req = urllib.request.Request(url, data=b"", method="POST")
-        with urllib.request.urlopen(req, timeout=310) as r:
-            body = json.loads(r.read())
+        body = stack.post_result(
+            "rebalance", "dryrun=true&get_response_timeout_s=300")
         assert body["summary"]["numProposals"] > 0
         # The skew means real movement onto the empty 80 brokers; nothing
         # lands on an unknown broker.
@@ -269,11 +286,8 @@ def test_rightsize_endpoint_through_served_stack():
     stack = Stack(sim)
     try:
         stack.wait_model_ready(timeout=60)
-        url = (f"{stack.base}/kafkacruisecontrol/rightsize"
-               "?get_response_timeout_s=240")
-        req = urllib.request.Request(url, data=b"", method="POST")
-        with urllib.request.urlopen(req, timeout=250) as r:
-            body = json.loads(r.read())
+        body = stack.post_result("rightsize",
+                                 "get_response_timeout_s=240")
         # wait_model_ready ran, so the proposal-cache path MUST execute
         # (NOT_READY would mean the endpoint path was never exercised),
         # and a right-sized cluster takes no provisioning action.
@@ -359,13 +373,8 @@ def test_server_restart_replays_sample_store(tmp_path):
         st = second.get("state", "substates=monitor")["MonitorState"]
         assert st["numValidWindows"] >= 1, (
             "restarted server has no replayed windows")
-        req = urllib.request.Request(
-            second.base + "/kafkacruisecontrol/rebalance"
-                          "?dryrun=true&json=true&get_response_timeout_s=120",
-            method="POST")
-        with urllib.request.urlopen(req, timeout=150) as r:
-            assert r.status == 200
-            payload = json.loads(r.read())
+        payload = second.post_result(
+            "rebalance", "dryrun=true&json=true&get_response_timeout_s=120")
         assert "goalSummary" in payload
     finally:
         second.close()

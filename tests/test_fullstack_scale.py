@@ -88,6 +88,23 @@ class _Served:
         with urllib.request.urlopen(url, timeout=120) as r:
             return json.loads(r.read())
 
+    def get_result(self, endpoint, params, timeout=300):
+        """GET with async long-poll semantics: re-poll by User-Task-ID on
+        202 (each request blocks at most maxBlockTimeMs) — re-polling
+        with the id reattaches instead of piling up new user tasks."""
+        uuid = None
+        deadline = time.time() + timeout
+        while True:
+            qs = params + (f"&user_task_id={uuid}" if uuid else "")
+            with urllib.request.urlopen(f"{self.base}/{endpoint}?{qs}",
+                                        timeout=120) as r:
+                body = json.loads(r.read())
+                uuid = r.headers.get("User-Task-ID", uuid)
+                if r.status != 202:
+                    return body
+            assert time.time() < deadline, f"{endpoint} never completed"
+            time.sleep(0.3)
+
     def post(self, endpoint, params):
         req = urllib.request.Request(f"{self.base}/{endpoint}?{params}",
                                      data=b"", method="POST")
@@ -128,13 +145,9 @@ def test_meshed_precompute_proposal_fetch_through_properties_file(tmp_path):
         assert served.app.facade.optimizer.mesh is not None
         assert served.app.facade.optimizer.mesh.devices.size == 8
         served.wait_model_ready()
-        # GET /proposals long-polls the precompute cache (202 -> poll).
-        deadline = time.time() + 300
-        while True:
-            body = served.get("proposals", "get_response_timeout_s=60")
-            if "summary" in body:
-                break
-            assert time.time() < deadline, body
+        # GET /proposals long-polls the precompute cache (202 -> re-poll
+        # by User-Task-ID).
+        body = served.get_result("proposals", "get_response_timeout_s=60")
         _assert_scale_proposals(body, sim)
     finally:
         served.close()
