@@ -57,6 +57,28 @@ class BalancingConstraint:
     def with_overrides(self, **kwargs) -> "BalancingConstraint":
         return replace(self, **kwargs)
 
+    def for_goal_violation_detection(self, multiplier: float
+                                     ) -> "BalancingConstraint":
+        """Distribution thresholds relaxed for violation DETECTION (ref
+        goal.violation.distribution.threshold.multiplier;
+        ReplicaDistributionAbstractGoal.adjustedBalancePercentage:
+        ``balancePercentage * multiplier`` when the run is triggered by
+        the goal-violation detector) — detection fires only beyond the
+        relaxed band, so a cluster balanced to just-inside the serving
+        threshold doesn't flap between violated and fixed."""
+        if multiplier == 1.0:
+            return self
+        m = multiplier
+        return replace(
+            self,
+            resource_balance_threshold=tuple(
+                t * m for t in self.resource_balance_threshold),
+            replica_balance_threshold=self.replica_balance_threshold * m,
+            leader_replica_balance_threshold=(
+                self.leader_replica_balance_threshold * m),
+            topic_replica_balance_threshold=(
+                self.topic_replica_balance_threshold * m))
+
 
 @dataclass(frozen=True)
 class SearchConfig:

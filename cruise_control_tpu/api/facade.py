@@ -120,22 +120,29 @@ class KafkaCruiseControl:
     #: would accumulate compiled XLA chains without limit.
     MAX_GOAL_OPTIMIZERS = 16
 
-    def _optimizer_for(self, goals: list[str] | None) -> "TpuGoalOptimizer":
+    def _optimizer_for(self, goals: list[str] | None,
+                       constraint=None) -> "TpuGoalOptimizer":
         """Memoize goal-scoped optimizers by goal-name tuple so repeated
         requests naming the same custom goals reuse one compiled-chain
         cache instead of paying a fresh XLA compile per request (the
         persistent disk cache only softens that; the in-process jit
         dispatch cache is per-optimizer). Shares the server optimizer's
-        registry so goal-scoped proposal timings surface on /metrics."""
-        if not goals:
+        registry so goal-scoped proposal timings surface on /metrics.
+
+        ``constraint`` overrides the balancing constraint (the
+        goal-violation detector's relaxed-threshold chain); everything
+        else — options generator, mesh, branches, registered hard
+        goals — is inherited from the server optimizer either way."""
+        if not goals and constraint is None:
             return self.optimizer
-        key = tuple(goals)
+        cst = constraint or self.optimizer.constraint
+        key = (tuple(goals or ()), cst)
         with self._lock:
             opt = self._goal_optimizers.pop(key, None)
             if opt is None:
                 opt = TpuGoalOptimizer(
-                    goals=goals_by_name(goals, self.optimizer.constraint),
-                    constraint=self.optimizer.constraint,
+                    goals=(goals_by_name(goals, cst) if goals else None),
+                    constraint=cst,
                     config=self.optimizer.config,
                     options_generator=self.optimizer.options_generator,
                     registry=self.optimizer.registry,

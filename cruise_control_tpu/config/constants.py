@@ -788,7 +788,9 @@ class CruiseControlConfig(AbstractConfig):
                 "max.allowed.extrapolations.per.partition"),
             max_allowed_extrapolations_per_broker=self.get_int(
                 "max.allowed.extrapolations.per.broker"),
-            follower_cpu_ratio=self.get_double("follower.cpu.ratio"))
+            follower_cpu_ratio=self.get_double("follower.cpu.ratio"),
+            min_valid_partition_ratio=self.get_double(
+                "min.valid.partition.ratio"))
 
     def balancing_constraint(self) -> BalancingConstraint:
         return BalancingConstraint(

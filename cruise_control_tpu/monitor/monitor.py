@@ -55,6 +55,11 @@ class MonitorConfig:
     #: follower CPU as a fraction of the leader's attributed CPU (ref
     #: ModelUtils leader/follower CPU estimation).
     follower_cpu_ratio: float = 0.5
+    #: default completeness floor for cluster_model() calls that pass no
+    #: explicit requirements (ref min.valid.partition.ratio; the served
+    #: path wires the config value — 0.95 — while direct library
+    #: construction keeps 0.0 so toy models stay buildable).
+    min_valid_partition_ratio: float = 0.0
 
 
 @dataclass
@@ -241,7 +246,9 @@ class LoadMonitor:
         """Build the flattened cluster model (ref LoadMonitor.clusterModel
         :439). Raises NotEnoughValidWindowsError when the sample history
         cannot satisfy ``requirements``."""
-        requirements = requirements or ModelCompletenessRequirements()
+        requirements = requirements or ModelCompletenessRequirements(
+            min_monitored_partitions_percentage=(
+                self.config.min_valid_partition_ratio))
         with self._model_semaphore, self._model_timer.time():
             return self._build_model(now_ms, requirements,
                                      populate_replica_placement_only)

@@ -169,11 +169,28 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                       config.get_int("disk.failure.detection.interval.ms"))
     # ref anomaly.detection.goals (default: the 4 leading hard goals,
     # AnomalyDetectorConfig.java:101): the violation detector dry-runs
-    # THIS chain — a goal-scoped optimizer memoized on the facade so the
-    # compiled passes are shared with same-goal user requests.
+    # THIS chain. With a distribution-threshold multiplier != 1 (ref
+    # goal.violation.distribution.threshold.multiplier) the detection
+    # optimizer gets its own RELAXED constraint so detection only fires
+    # beyond the relaxed band (anti-flap); otherwise the goal-scoped
+    # optimizer is memoized on the facade so compiled passes are shared
+    # with same-goal user requests.
     det_goals = config.get_list("anomaly.detection.goals")
-    det_optimizer = (facade._optimizer_for(det_goals) if det_goals
-                     else optimizer)
+    det_mult = config.get_double(
+        "goal.violation.distribution.threshold.multiplier")
+    if det_mult != 1.0:
+        # Routed through the facade's memoized builder so the detection
+        # optimizer inherits the options generator (topic exclusions must
+        # bind detection too), mesh, branches, and registered hard goals;
+        # an empty detection-goal list falls back to the SERVING chain
+        # (relaxed), exactly like the multiplier-free branch below.
+        det_optimizer = facade._optimizer_for(
+            det_goals or goal_names or None,
+            constraint=constraint.for_goal_violation_detection(det_mult))
+    elif det_goals:
+        det_optimizer = facade._optimizer_for(det_goals)
+    else:
+        det_optimizer = optimizer
     detector.register(
         GoalViolationDetector(monitor, det_optimizer,
                               weights=BalancednessWeights(

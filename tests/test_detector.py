@@ -476,3 +476,50 @@ def test_detection_goals_scope_the_violation_detector():
     assert [g.name for g in gv[0].optimizer.goals] == [
         "RackAwareGoal", "MinTopicLeadersPerBrokerGoal",
         "ReplicaCapacityGoal", "DiskCapacityGoal"]
+
+
+def test_distribution_threshold_multiplier_relaxes_detection():
+    """goal.violation.distribution.threshold.multiplier: the violation
+    detector's optimizer runs with RELAXED distribution thresholds
+    (anti-flap, ref ReplicaDistributionAbstractGoal
+    adjustedBalancePercentage) while the serving optimizer keeps the
+    configured thresholds."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.serve import build_app
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1], size_mb=10.0)
+    app = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "goal.violation.distribution.threshold.multiplier": "2.0",
+        "anomaly.detection.goals": "ReplicaDistributionGoal,"
+                                   "DiskUsageDistributionGoal"}), admin=sim)
+    gv = [s.detector for s in app.facade.detector._schedules
+          if type(s.detector).__name__ == "GoalViolationDetector"]
+    assert gv
+    det_cst = gv[0].optimizer.constraint
+    srv_cst = app.facade.optimizer.constraint
+    assert det_cst.replica_balance_threshold == (
+        srv_cst.replica_balance_threshold * 2.0)
+    assert det_cst.resource_balance_threshold == tuple(
+        t * 2.0 for t in srv_cst.resource_balance_threshold)
+    # Capacity thresholds are NOT relaxed (hard-goal semantics).
+    assert det_cst.capacity_threshold == srv_cst.capacity_threshold
+    # The relaxed optimizer inherits the serving choke points: options
+    # generator (topic exclusions bind detection too), mesh/branches,
+    # registered hard goals (review r5: the hand-built path dropped all
+    # of these).
+    assert gv[0].optimizer.options_generator is (
+        app.facade.optimizer.options_generator)
+    assert gv[0].optimizer.mesh is app.facade.optimizer.mesh
+    assert gv[0].optimizer.branches == app.facade.optimizer.branches
+    assert gv[0].optimizer.hard_goal_names == (
+        app.facade.optimizer.hard_goal_names)
+    # Multiplier 1.0 (default) keeps one shared optimizer path.
+    app2 = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
+                     admin=sim)
+    gv2 = [s.detector for s in app2.facade.detector._schedules
+           if type(s.detector).__name__ == "GoalViolationDetector"]
+    assert gv2[0].optimizer.constraint is app2.facade.optimizer.constraint

@@ -562,3 +562,51 @@ def test_replay_uses_dense_path_and_matches_scalar(tmp_path):
     assert results["native"][1] == results["python"][1]
     np.testing.assert_allclose(results["native"][2], results["python"][2],
                                rtol=1e-6)
+
+
+def test_min_valid_partition_ratio_gates_default_model_builds():
+    """min.valid.partition.ratio (wired through MonitorConfig) is the
+    default completeness floor for cluster_model() calls without
+    explicit requirements: a history covering too few partitions is
+    rejected, an explicit weaker requirement still overrides."""
+    import pytest
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import (LoadMonitor, MonitorConfig,
+                                            NotEnoughValidWindowsException)
+    from cruise_control_tpu.monitor.requirements import (
+        ModelCompletenessRequirements)
+    sim = SimulatedKafkaCluster()
+    for b in range(2):
+        sim.add_broker(b)
+    for p in range(10):
+        sim.add_partition("t", p, [p % 2, (p + 1) % 2], size_mb=10.0)
+    monitor = LoadMonitor(sim, MonitorConfig(
+        num_windows=2, window_ms=1000, min_samples_per_window=1,
+        min_valid_partition_ratio=0.95))
+    # Sample only 5 of 10 partitions -> 50% < 95%.
+    from cruise_control_tpu.monitor.sampler import Samples
+    from cruise_control_tpu.monitor.samples import PartitionMetricSample
+    batch = []
+    for p in range(5):
+        s = PartitionMetricSample("t", p, 500)
+        s.record(KafkaMetric.CPU_USAGE, 1.0)
+        s.record(KafkaMetric.LEADER_BYTES_IN, 1.0)
+        s.record(KafkaMetric.LEADER_BYTES_OUT, 1.0)
+        s.record(KafkaMetric.DISK_USAGE, 10.0)
+        batch.append(s)
+    # Roll window 0 out with one sample in the next window (windows
+    # become countable once a newer window has data).
+    roll = PartitionMetricSample("t", 0, 1500)
+    for m, v in ((KafkaMetric.CPU_USAGE, 1.0),
+                 (KafkaMetric.LEADER_BYTES_IN, 1.0),
+                 (KafkaMetric.LEADER_BYTES_OUT, 1.0),
+                 (KafkaMetric.DISK_USAGE, 10.0)):
+        roll.record(m, v)
+    batch.append(roll)
+    monitor.add_samples(Samples(batch, []))
+    with pytest.raises(NotEnoughValidWindowsException):
+        monitor.cluster_model(1800)
+    # Explicit weaker requirements still work (caller knows best).
+    res = monitor.cluster_model(1800, ModelCompletenessRequirements(
+        min_monitored_partitions_percentage=0.3))
+    assert res.model is not None
