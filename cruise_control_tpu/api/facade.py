@@ -59,6 +59,10 @@ class KafkaCruiseControl:
         #: after validating it covers the registered hard goals (the
         #: reference's startup sanity check).
         self.self_healing_goals: list[str] | None = None
+        #: ref replication.factor.self.healing.skip.rack.awareness.check:
+        #: RF self-healing waives the rack-awareness audit when set
+        #: (clusters without reliable rack metadata).
+        self.rf_self_healing_skip_rack_check: bool = False
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         self.proposal_cache = ProposalCache(monitor, self.optimizer)
         # Shared with the metrics processor so a TRAIN-fitted regression
@@ -375,6 +379,7 @@ class KafkaCruiseControl:
                                    dryrun: bool = True, uuid: str = "",
                                    progress: OperationProgress | None = None,
                                    options: OptimizationOptions | None = None,
+                                   goals: list[str] | None = None,
                                    **executor_kwargs):
         """Replication-factor change (ref UpdateTopicConfigurationRunnable +
         ClusterModel.createOrDeleteReplicas :962): adjust each matched
@@ -426,7 +431,7 @@ class KafkaCruiseControl:
                     kept.extend(r for r in replicas if r not in kept)
                     p.preferred_replicas = kept
             return spec
-        res = self._optimize(progress, None,
+        res = self._optimize(progress, goals,
                              options or OptimizationOptions(),
                              spec_mutator=change_rf)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
@@ -683,7 +688,8 @@ class KafkaCruiseControl:
         """ref RightsizeRunnable -> Provisioner; concrete provisioning is
         the detector layer's BasicProvisioner acting on the current
         optimization's provision verdict."""
-        if self.detector is None or not hasattr(self.detector, "provisioner"):
+        if (self.detector is None
+                or getattr(self.detector, "provisioner", None) is None):
             return {"provisionerState": "No provisioner configured"}
         from ..monitor import NotEnoughValidWindowsException
         try:

@@ -461,7 +461,7 @@ class CruiseControlApp:
                 maybe_stop_ongoing()
                 res, exec_res = facade.update_topic_configuration(
                     params["topic"], params["replication_factor"],
-                    dryrun=dryrun, progress=progress,
+                    dryrun=dryrun, progress=progress, goals=goals,
                     options=options_from(params), **exec_kwargs)
                 return _optimization_response(res, exec_res)
         elif endpoint == "proposals":

@@ -178,6 +178,30 @@ class SlowBrokers(KafkaAnomaly):
         return exec_res is None or exec_res.succeeded
 
 
+def _rf_change_kwargs(facade) -> dict:
+    """Shared goal-chain plumbing for self-healing RF changes (the
+    RF-anomaly fix and the RF maintenance event take the same action).
+
+    ref replication.factor.self.healing.skip.rack.awareness.check:
+    clusters without reliable rack metadata skip rack-awareness for RF
+    self-healing. An in-chain hard goal gates regardless of audit
+    waivers, so the rack goals must leave the CHAIN (healing chain or
+    default, minus the rack goals) AND be waived from the off-chain
+    audit — the change_rf placement itself still prefers fresh racks
+    when it can."""
+    goals = getattr(facade, "self_healing_goals", None)
+    kwargs: dict = {"goals": goals}
+    if getattr(facade, "rf_self_healing_skip_rack_check", False):
+        from ..analyzer import OptimizationOptions
+        from ..analyzer.goals import default_goals
+        rack = {"RackAwareGoal", "RackAwareDistributionGoal"}
+        names = goals or [g.name for g in default_goals()]
+        kwargs["goals"] = [n for n in names if n not in rack]
+        kwargs["options"] = OptimizationOptions(
+            waived_hard_goals=frozenset(rack))
+    return kwargs
+
+
 @dataclass
 class TopicReplicationFactorAnomaly(KafkaAnomaly):
     """ref TopicReplicationFactorAnomaly.java: topics whose RF deviates from
@@ -195,7 +219,8 @@ class TopicReplicationFactorAnomaly(KafkaAnomaly):
         ok = True
         for topic in sorted(self.bad_topics):
             _, exec_res = facade.update_topic_configuration(
-                topic, self.target_rf, dryrun=False, uuid=self.anomaly_id)
+                topic, self.target_rf, dryrun=False, uuid=self.anomaly_id,
+                **_rf_change_kwargs(facade))
             ok &= exec_res is None or exec_res.succeeded
         return ok
 
@@ -242,7 +267,8 @@ class MaintenanceEvent(KafkaAnomaly):
         elif t is MaintenanceEventType.TOPIC_REPLICATION_FACTOR:
             _, ex = facade.update_topic_configuration(
                 self.topic_pattern or "*", self.target_rf or 3,
-                dryrun=False, uuid=self.anomaly_id)
+                dryrun=False, uuid=self.anomaly_id,
+                **_rf_change_kwargs(facade))
         else:
             _, ex = facade.rebalance(dryrun=False, uuid=self.anomaly_id,
                                      ignore_proposal_cache=True)

@@ -39,7 +39,8 @@ class AnomalyDetectorManager:
                  now_ms=None, registry=None,
                  fixable_broker_count_threshold: int = 10,
                  fixable_broker_pct_threshold: float = 0.4,
-                 num_cached_recent_anomalies: int = 10) -> None:
+                 num_cached_recent_anomalies: int = 10,
+                 provisioner_enabled: bool = True) -> None:
         from ..core.sensors import (ANOMALY_DETECTOR_SENSOR, MetricRegistry)
         self.facade = facade
         #: self-healing refuses to act past these simultaneous-failure
@@ -51,7 +52,12 @@ class AnomalyDetectorManager:
         #: num.cached.recent.anomaly.states)
         self.num_cached_recent_anomalies = num_cached_recent_anomalies
         self.notifier = notifier or SelfHealingNotifier()
-        self.provisioner = provisioner or BasicProvisioner(facade.admin)
+        #: ref provisioner.enable: False = no provisioning actions —
+        #: /rightsize reports no provisioner and under/over-provision
+        #: verdicts stay informational.
+        self.provisioner = (None if not provisioner_enabled
+                            else provisioner
+                            or BasicProvisioner(facade.admin))
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         self._schedules: list[DetectorSchedule] = []
         self._queue: list[tuple[int, int, int, KafkaAnomaly]] = []

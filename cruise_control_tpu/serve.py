@@ -134,6 +134,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                 f"self.healing.goals must include every registered hard "
                 f"goal (hard.goals); missing: {sorted(missing)}")
         facade.self_healing_goals = healing_goals
+    facade.rf_self_healing_skip_rack_check = config.get_boolean(
+        "replication.factor.self.healing.skip.rack.awareness.check")
 
     healing_on = config.get_boolean("self.healing.enabled")
 
@@ -159,7 +161,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         fixable_broker_pct_threshold=config.get_double(
             "fixable.failed.broker.percentage.threshold"),
         num_cached_recent_anomalies=config.get_int(
-            "num.cached.recent.anomaly.states"))
+            "num.cached.recent.anomaly.states"),
+        provisioner_enabled=config.get_boolean("provisioner.enable"))
     interval = config.get_int("anomaly.detection.interval.ms")
     detector.register(
         BrokerFailureDetector(

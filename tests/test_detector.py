@@ -523,3 +523,58 @@ def test_distribution_threshold_multiplier_relaxes_detection():
     gv2 = [s.detector for s in app2.facade.detector._schedules
            if type(s.detector).__name__ == "GoalViolationDetector"]
     assert gv2[0].optimizer.constraint is app2.facade.optimizer.constraint
+
+
+def test_provisioner_enable_and_rf_rack_skip_wiring():
+    """provisioner.enable=false -> /rightsize reports no provisioner;
+    replication.factor.self.healing.skip.rack.awareness.check wires the
+    RF-fix rack waiver onto the facade."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.serve import build_app
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1], size_mb=10.0)
+    app = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "provisioner.enable": "false",
+        "replication.factor.self.healing.skip.rack.awareness.check":
+            "true"}), admin=sim)
+    assert app.facade.detector.provisioner is None
+    assert app.facade.rightsize() == {
+        "provisionerState": "No provisioner configured"}
+    assert app.facade.rf_self_healing_skip_rack_check is True
+    # Default: provisioner present, rack check enforced.
+    app2 = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
+                     admin=sim)
+    assert app2.facade.detector.provisioner is not None
+    assert app2.facade.rf_self_healing_skip_rack_check is False
+
+
+def test_rf_anomaly_fix_waives_rack_audit_when_configured():
+    """The RF self-healing fix passes the rack waiver (and the healing
+    chain) through to update_topic_configuration when configured."""
+    from cruise_control_tpu.detector.anomalies import (
+        TopicReplicationFactorAnomaly)
+
+    calls = []
+
+    class FakeFacade:
+        self_healing_goals = ["RackAwareGoal", "ReplicaDistributionGoal"]
+        rf_self_healing_skip_rack_check = True
+
+        def update_topic_configuration(self, topic, rf, **kw):
+            calls.append((topic, rf, kw))
+            return None, None
+
+    anomaly = TopicReplicationFactorAnomaly(
+        detected_ms=0, bad_topics={"t1": 2}, target_rf=3)
+    anomaly.fix(FakeFacade())
+    (topic, rf, kw), = calls
+    assert (topic, rf) == ("t1", 3)
+    # The rack goals leave the CHAIN (an in-chain hard goal gates
+    # regardless of audit waivers) and are waived from the audit.
+    assert kw["goals"] == ["ReplicaDistributionGoal"]
+    assert kw["options"].waived_hard_goals == frozenset(
+        {"RackAwareGoal", "RackAwareDistributionGoal"})
