@@ -40,6 +40,12 @@ class BalancingConstraint:
     # ResourceDistributionGoal's low.utilization.threshold — 0.0 disables
     # over-provisioning detection, the reference default).
     overprovisioned_min_brokers: int = 3
+    #: ref overprovisioned.max.replicas.per.broker: a shrink verdict may
+    #: not leave any broker above this replica count.
+    overprovisioned_max_replicas_per_broker: int = 1500
+    #: ref overprovisioned.min.extra.racks: keep enough brokers to span
+    #: max-RF + this many racks (rack-aware placement headroom).
+    overprovisioned_min_extra_racks: int = 2
     low_utilization_threshold: Tuple[float, float, float, float] = (
         0.0, 0.0, 0.0, 0.0)
     #: ref min.topic.leaders.per.broker (MinTopicLeadersPerBrokerGoal)

@@ -692,8 +692,28 @@ class TpuGoalOptimizer:
                     reason=f"{name} demand {total:.0f} exceeds usable "
                            f"capacity of {n_alive} brokers"))
         if response.status is not ProvisionStatus.UNDER_PROVISIONED:
-            min_needed = max([*needed_by_resource.values(),
-                              cst.overprovisioned_min_brokers])
+            # Shrink floors beyond resource demand (ref ProvisionerUtils):
+            # replica density must stay under
+            # overprovisioned.max.replicas.per.broker, and the cluster
+            # must SPAN at least max-RF + overprovisioned.min.extra.racks
+            # racks (rack-aware placement headroom) — a rack count, not a
+            # broker count: when the alive brokers don't cover that many
+            # racks, no shrink is recommended at all.
+            rb = np.asarray(jax.device_get(final.replica_broker))
+            valid_rb = rb < final.num_brokers_padded
+            total_replicas = int(valid_rb.sum())
+            max_rf = int(valid_rb.sum(axis=1).max()) if rb.size else 0
+            racks = np.asarray(jax.device_get(final.broker_rack))
+            num_alive_racks = len(set(racks[alive].tolist()))
+            if num_alive_racks < max_rf + cst.overprovisioned_min_extra_racks:
+                if not response.recommendations:
+                    response.status = ProvisionStatus.RIGHT_SIZED
+                return response
+            min_needed = max(
+                *needed_by_resource.values(),
+                cst.overprovisioned_min_brokers,
+                int(np.ceil(total_replicas
+                            / cst.overprovisioned_max_replicas_per_broker)))
             for r, low in zip(Resource, cst.low_utilization_threshold):
                 if low <= 0 or r not in needed_by_resource:
                     continue

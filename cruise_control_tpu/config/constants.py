@@ -336,8 +336,10 @@ def _executor_defs(d: ConfigDef) -> None:
              doc="Per-broker leadership movement cap")
     d.define("max.num.cluster.movements", ConfigType.INT, 1250,
              validator=Range.at_least(1), importance=Importance.LOW,
-             doc="Cluster-wide cap across movement types (alias surface "
-                 "of max.num.cluster.partition.movements)")
+             doc="Ceiling on any movement-type concurrency (partition, "
+                 "leadership, intra-broker) a request or the adjuster may "
+                 "use — bounds in-flight task bookkeeping; submissions "
+                 "asking for more are rejected")
     d.define("min.execution.progress.check.interval.ms", ConfigType.LONG,
              5_000, validator=Range.at_least(1), importance=Importance.LOW,
              doc="Floor for per-request progress-check intervals")
@@ -825,7 +827,11 @@ class CruiseControlConfig(AbstractConfig):
             topics_with_min_leaders_per_broker=self.get_string(
                 "topics.with.min.leaders.per.broker"),
             overprovisioned_min_brokers=self.get_int(
-                "overprovisioned.min.brokers"))
+                "overprovisioned.min.brokers"),
+            overprovisioned_max_replicas_per_broker=self.get_int(
+                "overprovisioned.max.replicas.per.broker"),
+            overprovisioned_min_extra_racks=self.get_int(
+                "overprovisioned.min.extra.racks"))
 
     def search_config(self) -> SearchConfig:
         return SearchConfig(
@@ -849,6 +855,8 @@ class CruiseControlConfig(AbstractConfig):
                 "leader.movement.timeout.ms"),
             default_replication_throttle_bytes=(None if throttle < 0
                                                 else throttle),
+            max_num_cluster_movements=self.get_int(
+                "max.num.cluster.movements"),
             concurrency=ConcurrencyConfig(
                 num_concurrent_partition_movements_per_broker=self.get_int(
                     "num.concurrent.partition.movements.per.broker"),

@@ -95,6 +95,12 @@ class ExecutorConfig:
     #: strategy chain applied when a request names none (ref
     #: default.replica.movement.strategies)
     default_strategy_names: tuple = ()
+    #: ref max.num.cluster.movements: ceiling on the concurrency any
+    #: request (or the adjuster) may ask for across movement types —
+    #: bounds the executor's in-flight bookkeeping. Requests exceeding
+    #: it are rejected at submission (the reference throws on the
+    #: equivalent setters).
+    max_num_cluster_movements: int = 1250
 
 
 @dataclass
@@ -171,6 +177,20 @@ class Executor:
         from ..core.sensors import (EXECUTOR_SENSOR, MetricRegistry)
         self.admin = admin
         self.config = config or ExecutorConfig()
+        # ref max.num.cluster.movements: validate the STATIC config
+        # relationship at construction (server startup) so a
+        # misconfiguration fails the deploy, not every later execution
+        # (incl. silent self-healing failures); and clamp the adjuster's
+        # upper bounds so additive increase can never climb past the
+        # ceiling either.
+        self._check_movement_cap(self.config.concurrency)
+        cap = self.config.max_num_cluster_movements
+        cc0 = self.config.concurrency
+        if cc0.max_leader_movements > cap:
+            from dataclasses import replace as _dc_replace
+            self.config = _dc_replace(
+                self.config, concurrency=_dc_replace(
+                    cc0, max_leader_movements=cap))
         self.notifier = notifier or ExecutorNotifier()
         # Per-topic min.insync.replicas source for the min-ISR-aware
         # strategies/adjuster (ref TopicConfigProvider SPI); defaults to
@@ -246,6 +266,21 @@ class Executor:
     def state(self) -> ExecutorState:
         return self._state
 
+    def _check_movement_cap(self, cc) -> None:
+        """ref max.num.cluster.movements: no movement-type concurrency may
+        exceed the cluster-wide ceiling (Executor.java throws on the
+        equivalent setters — a runaway per-request override must not
+        balloon in-flight bookkeeping)."""
+        cap = self.config.max_num_cluster_movements
+        for fname in ("max_num_cluster_partition_movements",
+                      "num_concurrent_leader_movements",
+                      "num_concurrent_intra_broker_partition_movements"):
+            val = getattr(cc, fname)
+            if val > cap:
+                raise ValueError(
+                    f"{fname}={val} exceeds max.num.cluster.movements"
+                    f"={cap}")
+
     def has_ongoing_execution(self) -> bool:
         return self._state is not ExecutorState.NO_TASK_IN_PROGRESS
 
@@ -307,6 +342,14 @@ class Executor:
         overrides the poll cadence for THIS execution only (ref the
         per-request concurrency/interval parameters the runnables read,
         e.g. ``RebalanceParameters`` CONCURRENT_*_PARAM)."""
+        # Pure parameter validation BEFORE the single-execution
+        # reservation: a rejected request must not consume the slot, emit
+        # an orphan on_execution_finished, or count as an execution.
+        cc = self.config.concurrency
+        if concurrency_overrides:
+            from dataclasses import replace as _dc_replace
+            cc = _dc_replace(cc, **concurrency_overrides)
+        self._check_movement_cap(cc)
         with self._lock:
             if self.has_ongoing_execution():
                 raise OngoingExecutionError(
@@ -335,10 +378,6 @@ class Executor:
                 strategy_names
                 if strategy_names is not None
                 else list(self.config.default_strategy_names) or None))
-            cc = self.config.concurrency
-            if concurrency_overrides:
-                from dataclasses import replace as _dc_replace
-                cc = _dc_replace(cc, **concurrency_overrides)
             # Per-request interval floor-clamped (ref
             # min.execution.progress.check.interval.ms).
             self._progress_interval_ms = max(

@@ -578,3 +578,51 @@ def test_rf_anomaly_fix_waives_rack_audit_when_configured():
     assert kw["goals"] == ["ReplicaDistributionGoal"]
     assert kw["options"].waived_hard_goals == frozenset(
         {"RackAwareGoal", "RackAwareDistributionGoal"})
+
+
+def test_provision_verdict_shrink_floors():
+    """Over-provisioning shrink respects the replica-density ceiling and
+    the rack headroom floor (ref overprovisioned.max.replicas.per.broker
+    / overprovisioned.min.extra.racks): a low-utilization 10-broker
+    cluster shrinks only to max(resource need, min brokers, replica
+    density, max-RF + extra racks)."""
+    from dataclasses import replace as _dc_replace
+    from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                               PartitionSpec, flatten_spec)
+    from cruise_control_tpu.analyzer import (BalancingConstraint,
+                                             OptimizationOptions)
+    brokers = [BrokerSpec(broker_id=i, rack=f"r{i}",
+                          capacity=(100.0, 1e6, 1e6, 1e6))
+               for i in range(10)]
+    # 24 rf-2 partitions, tiny load: utterly over-provisioned.
+    parts = [PartitionSpec(topic="t", partition=p,
+                           replicas=[p % 10, (p + 1) % 10],
+                           leader_load=(0.01, 1.0, 1.0, 5.0))
+             for p in range(24)]
+    model, md = flatten_spec(ClusterSpec(brokers=brokers, partitions=parts))
+    cst = _dc_replace(BalancingConstraint(),
+                      low_utilization_threshold=(0.2, 0.2, 0.2, 0.2),
+                      overprovisioned_min_brokers=2,
+                      overprovisioned_max_replicas_per_broker=8,
+                      overprovisioned_min_extra_racks=3)
+    opt = TpuGoalOptimizer(goals=goals_by_name(["DiskCapacityGoal"], cst),
+                           constraint=cst)
+    res = opt.optimize(model, md, OptimizationOptions(
+        skip_hard_goal_check=True))
+    assert res.provision_response.status is ProvisionStatus.OVER_PROVISIONED
+    rec = res.provision_response.recommendations[0]
+    # Rack gate: 10 racks >= max RF 2 + 3 extra -> shrink allowed.
+    # Floor: 48 replicas / 8 per broker = 6 > min brokers 2 > resource
+    # need ~1 -> shrink by 10-6=4.
+    assert rec.num_brokers == 4, rec.to_json()
+
+    # A 2-rack layout cannot deliver max-RF + 3 racks of headroom: no
+    # shrink is recommended at all (rack COUNT, not broker count).
+    brokers2 = [BrokerSpec(broker_id=i, rack=f"r{i % 2}",
+                           capacity=(100.0, 1e6, 1e6, 1e6))
+                for i in range(10)]
+    model2, md2 = flatten_spec(ClusterSpec(brokers=brokers2, partitions=parts))
+    res2 = TpuGoalOptimizer(
+        goals=goals_by_name(["DiskCapacityGoal"], cst), constraint=cst
+    ).optimize(model2, md2, OptimizationOptions(skip_hard_goal_check=True))
+    assert res2.provision_response.status is ProvisionStatus.RIGHT_SIZED

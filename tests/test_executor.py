@@ -373,3 +373,29 @@ def test_strategy_chaining_tiebreaks_in_declared_order():
     # Unknown strategy names fail loudly.
     with pytest.raises(KeyError, match="NoSuchStrategy"):
         strategy_chain(["NoSuchStrategy"])
+
+
+def test_max_num_cluster_movements_caps_requested_concurrency():
+    """max.num.cluster.movements bounds every movement-type concurrency a
+    request may ask for (ref Executor.java throwing on the setters)."""
+    import pytest
+    from cruise_control_tpu.executor import (ExecutorConfig,
+                                             SimulatedKafkaCluster)
+    from cruise_control_tpu.executor.executor import Executor
+    sim = SimulatedKafkaCluster()
+    for b in range(2):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1], size_mb=1.0)
+    from cruise_control_tpu.executor.concurrency import ConcurrencyConfig
+    ex = Executor(sim, ExecutorConfig(
+        max_num_cluster_movements=100,
+        concurrency=ConcurrencyConfig(
+            max_num_cluster_partition_movements=100,
+            num_concurrent_leader_movements=100)))
+    with pytest.raises(ValueError, match="max.num.cluster.movements"):
+        ex.execute_proposals([], concurrency_overrides={
+            "num_concurrent_leader_movements": 101})
+    # The reservation is released on rejection: a valid run still works.
+    res = ex.execute_proposals([], concurrency_overrides={
+        "num_concurrent_leader_movements": 100})
+    assert res.succeeded
