@@ -610,6 +610,20 @@ class KafkaCruiseControl:
         self.executor.stop_execution(force=force,
                                      stop_external_agent=stop_external_agent)
 
+    def stop_ongoing_and_wait(self, timeout_s: float = 60.0) -> bool:
+        """Preempt the in-flight execution and wait for the executor to
+        release (the shared stop-then-wait used by
+        stop_ongoing_execution requests and maintenance-event
+        preemption). Returns True when the executor is idle."""
+        import time as _t
+        if self.executor.has_ongoing_execution():
+            self.stop_proposal_execution()
+            deadline = _t.monotonic() + timeout_s
+            while (self.executor.has_ongoing_execution()
+                   and _t.monotonic() < deadline):
+                _t.sleep(0.05)
+        return not self.executor.has_ongoing_execution()
+
     def pause_sampling(self, reason: str = "") -> None:
         if self.task_runner is None:
             raise RuntimeError("no sampling task runner configured")

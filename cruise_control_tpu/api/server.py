@@ -337,13 +337,7 @@ class CruiseControlApp:
             execution so this request's (non-dryrun) plan replaces it."""
             if dryrun or not params.get("stop_ongoing_execution"):
                 return
-            if facade.executor.has_ongoing_execution():
-                facade.stop_proposal_execution()
-                import time as _t
-                deadline = _t.monotonic() + 60
-                while (facade.executor.has_ongoing_execution()
-                       and _t.monotonic() < deadline):
-                    _t.sleep(0.05)
+            facade.stop_ongoing_and_wait()
 
         def options_from(params: ParsedParams) -> OptimizationOptions:
             pattern = params.get("excluded_topics") or ""

@@ -251,6 +251,10 @@ class MaintenanceEvent(KafkaAnomaly):
         return f"Maintenance: {self.event_type.value} {self.broker_ids}"
 
     def fix(self, facade) -> bool:
+        # Preemption of an ongoing execution (ref
+        # maintenance.event.stop.ongoing.execution) happens in the
+        # manager's deferral gate — by the time fix() runs the executor
+        # is idle.
         t = self.event_type
         if t is MaintenanceEventType.ADD_BROKER:
             _, ex = facade.add_brokers(self.broker_ids, dryrun=False,

@@ -223,9 +223,18 @@ class AnomalyDetectorManager:
                 continue
             if action.result is AnomalyNotificationResult.FIX:
                 if self.facade.executor.has_ongoing_execution():
-                    # ref :534 fixAnomalyInProgress: wait for the executor
-                    deferred.append((prio, now + 10_000, cnt, anomaly))
-                    continue
+                    # ref maintenance.event.stop.ongoing.execution: an
+                    # operator-announced maintenance plan PREEMPTS the
+                    # running execution instead of queueing behind it.
+                    from .anomalies import MaintenanceEvent
+                    if (isinstance(anomaly, MaintenanceEvent)
+                            and getattr(self.facade,
+                                        "maintenance_stop_ongoing", False)):
+                        self.facade.stop_ongoing_and_wait()
+                    if self.facade.executor.has_ongoing_execution():
+                        # ref :534 fixAnomalyInProgress: wait it out
+                        deferred.append((prio, now + 10_000, cnt, anomaly))
+                        continue
                 fixed += 1
                 just_fixed.add((anomaly.anomaly_type, anomaly.reason()))
                 self.num_self_healing_started += 1

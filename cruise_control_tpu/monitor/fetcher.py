@@ -31,10 +31,14 @@ class MetricFetcherManager:
                  store: SampleStore | None = None,
                  assignor: DefaultPartitionAssignor | None = None,
                  on_execution_store: SampleStore | None = None,
-                 registry=None) -> None:
+                 registry=None, max_retries: int = 0) -> None:
         from ..core.sensors import MetricRegistry
         self.sampler = sampler
         self.num_fetchers = max(1, num_fetchers)
+        #: ref fetch.metric.samples.max.retry.count: transient sampler
+        #: failures are retried this many times within one round before
+        #: the round fails (each attempt still marks the failure meter).
+        self.max_retries = max(0, max_retries)
         self.store = store or NoopSampleStore()
         self.assignor = assignor or DefaultPartitionAssignor()
         #: optional secondary store for samples taken during an ongoing
@@ -58,12 +62,23 @@ class MetricFetcherManager:
         processor buffer, the synthetic sampler's per-broker sums) must see
         the whole assignment in one call or they would race / double-count.
         """
-        try:
-            with self._fetch_timer.time():
-                return self._fetch(partitions, brokers, start_ms, end_ms)
-        except Exception:
-            self._fetch_failures.mark()
-            raise
+        with self._fetch_timer.time():
+            for attempt in range(self.max_retries + 1):
+                try:
+                    merged = self._fetch(partitions, brokers, start_ms,
+                                         end_ms)
+                    break
+                except Exception:
+                    self._fetch_failures.mark()
+                    if attempt == self.max_retries:
+                        raise
+            # Persistence sits OUTSIDE the retried section: a store
+            # failure after a successful write must not re-store the
+            # round (replay would double-count the window's load).
+            self.store.store_samples(merged)
+            if self.on_execution_store is not None:
+                self.on_execution_store.store_samples(merged)
+            return merged
 
     def _fetch(self, partitions: list[tuple[str, int]], brokers: list[int],
                start_ms: int, end_ms: int) -> Samples:
@@ -89,7 +104,4 @@ class MetricFetcherManager:
         for r in results:
             merged.partition_samples.extend(r.partition_samples)
             merged.broker_samples.extend(r.broker_samples)
-        self.store.store_samples(merged)
-        if self.on_execution_store is not None:
-            self.on_execution_store.store_samples(merged)
         return merged

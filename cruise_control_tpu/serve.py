@@ -28,8 +28,9 @@ from .model.cpu_regression import LinearRegressionModelParameters
 from .detector import (AnomalyDetectorManager, BalancednessWeights,
                        BrokerFailureDetector, DiskFailureDetector,
                        GoalViolationDetector, KafkaAnomalyType,
-                       MetricAnomalyDetector, SelfHealingNotifier,
-                       SlowBrokerFinder, TopicAnomalyDetector)
+                       MaintenanceEventDetector, MetricAnomalyDetector,
+                       SelfHealingNotifier, SlowBrokerFinder,
+                       TopicAnomalyDetector)
 from .executor import Executor, SimulatedKafkaCluster
 from .monitor import (FileSampleStore, LoadMonitor, LoadMonitorTaskRunner,
                       MetricFetcherManager, NoopSampleStore,
@@ -62,7 +63,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     fetcher = MetricFetcherManager(
         sampler, config.get_int("num.metric.fetchers"), store=store,
         assignor=load_class(config.get_string(
-            "metric.sampler.partition.assignor.class"))())
+            "metric.sampler.partition.assignor.class"))(),
+        max_retries=config.get_int("fetch.metric.samples.max.retry.count"))
     runner = LoadMonitorTaskRunner(
         monitor, fetcher,
         sampling_interval_ms=config.get_int("metric.sampling.interval.ms"))
@@ -211,6 +213,34 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         admin, target_rf=config.get_int(
             "topic.anomaly.target.replication.factor")),
         config.get_int("topic.anomaly.detection.interval.ms"))
+    # ref maintenance.event.reader.class (empty = maintenance events
+    # disabled, the reference default): the reader drains operator-
+    # announced plans with idempotence de-dup; MaintenanceEvent.fix reads
+    # facade.maintenance_stop_ongoing for the stop-then-execute option.
+    reader_cls_name = config.get_string("maintenance.event.reader.class")
+    if reader_cls_name:
+        reader_cls = load_class(reader_cls_name)
+        # Signature-based dispatch, like the options-generator plugin
+        # above: a try/except TypeError would mask genuine TypeErrors
+        # raised inside a plugin's constructor body.
+        import inspect
+        sig = inspect.signature(reader_cls)
+        if "enable_idempotence" in sig.parameters:
+            reader = reader_cls(
+                enable_idempotence=config.get_boolean(
+                    "maintenance.event.enable.idempotence"),
+                idempotence_retention_ms=config.get_int(
+                    "maintenance.event.idempotence.retention.ms"),
+                max_idempotence_cache_size=config.get_int(
+                    "maintenance.event.max.idempotence.cache.size"))
+        elif sig.parameters:
+            reader = reader_cls(config)
+        else:
+            reader = reader_cls()
+        facade.maintenance_event_reader = reader
+        detector.register(MaintenanceEventDetector(reader), interval)
+    facade.maintenance_stop_ongoing = config.get_boolean(
+        "maintenance.event.stop.ongoing.execution")
     facade.detector = detector
 
     security = None

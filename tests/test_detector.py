@@ -626,3 +626,44 @@ def test_provision_verdict_shrink_floors():
         goals=goals_by_name(["DiskCapacityGoal"], cst), constraint=cst
     ).optimize(model2, md2, OptimizationOptions(skip_hard_goal_check=True))
     assert res2.provision_response.status is ProvisionStatus.RIGHT_SIZED
+
+
+def test_maintenance_reader_served_wiring():
+    """maintenance.event.reader.class registers the maintenance detector
+    with the idempotence config; the stop-ongoing flag reaches the
+    facade. Empty (the default) leaves maintenance disabled."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.serve import build_app
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1], size_mb=10.0)
+    app = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "maintenance.event.reader.class":
+            "cruise_control_tpu.detector.MaintenanceEventReader",
+        "maintenance.event.enable.idempotence": "true",
+        "maintenance.event.max.idempotence.cache.size": "7",
+        "maintenance.event.stop.ongoing.execution": "true"}), admin=sim)
+    med = [s.detector for s in app.facade.detector._schedules
+           if type(s.detector).__name__ == "MaintenanceEventDetector"]
+    assert med, "maintenance detector not registered"
+    reader = med[0].reader
+    assert reader.enable_idempotence is True
+    assert reader._cache.max_size == 7
+    assert app.facade.maintenance_stop_ongoing is True
+    # Idempotence live: duplicate plans de-dup through the served reader.
+    from cruise_control_tpu.detector.anomalies import (MaintenanceEvent,
+                                                       MaintenanceEventType)
+    ev = MaintenanceEvent(detected_ms=0,
+                          event_type=MaintenanceEventType.REBALANCE)
+    assert reader.submit(ev) is True
+    assert reader.submit(MaintenanceEvent(
+        detected_ms=1, event_type=MaintenanceEventType.REBALANCE)) is False
+    assert len(med[0].detect(0)) == 1
+    # Default: disabled.
+    app2 = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
+                     admin=sim)
+    assert not [s for s in app2.facade.detector._schedules
+                if type(s.detector).__name__ == "MaintenanceEventDetector"]

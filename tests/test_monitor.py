@@ -610,3 +610,33 @@ def test_min_valid_partition_ratio_gates_default_model_builds():
     res = monitor.cluster_model(1800, ModelCompletenessRequirements(
         min_monitored_partitions_percentage=0.3))
     assert res.model is not None
+
+
+def test_fetcher_retries_transient_sampler_failures():
+    """fetch.metric.samples.max.retry.count: a sampler that fails twice
+    then succeeds completes the round with max_retries=2 (each attempt
+    marks the failure meter); with retries exhausted the round raises."""
+    import pytest
+    from cruise_control_tpu.monitor import MetricFetcherManager
+    from cruise_control_tpu.monitor.sampler import Samples
+
+    class Flaky:
+        def __init__(self, fail_times):
+            self.fail_times = fail_times
+            self.calls = 0
+
+        def get_samples(self, assignment):
+            self.calls += 1
+            if self.calls <= self.fail_times:
+                raise RuntimeError("transient broker hiccup")
+            return Samples([], [])
+
+    f = MetricFetcherManager(Flaky(2), max_retries=2)
+    out = f.fetch([("t", 0)], [0], 0, 1000)
+    assert out.partition_samples == []
+    assert f.registry.meter(
+        "MetricFetcherManager.partition-samples-fetcher-failure-rate"
+    ).count == 2
+    f2 = MetricFetcherManager(Flaky(3), max_retries=2)
+    with pytest.raises(RuntimeError, match="transient"):
+        f2.fetch([("t", 0)], [0], 0, 1000)
