@@ -1887,6 +1887,17 @@ GOAL_REGISTRY = {
 KAFKA_ASSIGNER_GOALS = ["KafkaAssignerEvenRackAwareGoal",
                         "KafkaAssignerDiskUsageDistributionGoal"]
 
+#: Documented relaxations of registered hard goals: a chain carrying one
+#: of the alternatives satisfies the requirement for the strict form
+#: (RackAwareDistributionGoal relaxes one-replica-per-rack to
+#: ceil(RF/num_racks) — RackAwareDistributionGoal.java; the
+#: kafka-assigner rack goal likewise supersedes it). Consumed by the
+#: off-chain hard-goal audit and the self.healing.goals startup check.
+HARD_GOAL_ALTERNATIVES = {
+    "RackAwareGoal": ("RackAwareDistributionGoal",
+                      "KafkaAssignerEvenRackAwareGoal"),
+}
+
 
 def short_goal_name(name: str) -> str:
     """Canonical short form of a goal name: the reference accepts both

@@ -293,13 +293,10 @@ class TpuGoalOptimizer:
             return []
         in_chain = {g.name for g in chain_goals}
         # A chain carrying a documented relaxation of a registered hard
-        # goal (RackAwareDistributionGoal relaxes strict one-replica-per-
-        # rack to ceil(RF/num_racks) — RackAwareDistributionGoal.java;
-        # the kafka-assigner rack goal likewise supersedes it) signals the
-        # operator chose the alternative: auditing the strict form would
-        # fail every RF > num_racks cluster the relaxation exists for.
-        alternatives = {"RackAwareGoal": ("RackAwareDistributionGoal",
-                                          "KafkaAssignerEvenRackAwareGoal")}
+        # goal signals the operator chose the alternative: auditing the
+        # strict form would fail every RF > num_racks cluster the
+        # relaxation exists for.
+        from .goals import HARD_GOAL_ALTERNATIVES as alternatives
         if self.hard_goal_names is not None:
             from .goals import goals_by_name
             registered = goals_by_name(self.hard_goal_names,
@@ -329,6 +326,11 @@ class TpuGoalOptimizer:
                         jnp.stack([g.violation_scale(state, ctx)
                                    for g in _goals]))
             fn = self._audit_fns.setdefault(key, jax.jit(_audit))
+            # Bounded like the facade's goal-optimizer LRU: bind
+            # signatures carry per-topic masks, so an evolving topic set
+            # would otherwise accumulate compiled audit programs forever.
+            while len(self._audit_fns) > 16:
+                self._audit_fns.pop(next(iter(self._audit_fns)))
         return fn
 
     def warmup(self, model: FlatClusterModel, metadata: ClusterMetadata,
@@ -568,14 +570,21 @@ class TpuGoalOptimizer:
         still feeds the same hard-goal gate, and select_best fails loudly
         on NaN residuals (the broken-kernel case the sequential
         self-check catches)."""
-        from ..parallel.branches import select_best
+        from ..parallel.branches import select_best, select_best_audited
         if on_goal_start is not None:
             on_goal_start(f"BranchedChain[{len(goals)}x{self.branches}]")
         aux = chain.aux(state, ctx)
         run = self._branched_run_for(cfg, goals)
         t_walk = time.monotonic()
         states, viols = run(state, ctx, key)
-        state, best_idx, vbest = select_best(states, viols)
+        if audit_fn is not None:
+            # The off-chain hard-goal audit dominates branch selection:
+            # without this, the chain-lexicographic winner could fail the
+            # gate while an audit-passing plan existed in the same run.
+            state, best_idx, vbest = select_best_audited(
+                states, viols, lambda s: audit_fn(s, ctx))
+        else:
+            state, best_idx, vbest = select_best(states, viols)
         walk_s = time.monotonic() - t_walk
         _has_broken, scales_arr, v0 = jax.device_get(aux)
         v0 = np.asarray(v0)

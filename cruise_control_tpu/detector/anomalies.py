@@ -188,7 +188,13 @@ def _rf_change_kwargs(facade) -> dict:
     waivers, so the rack goals must leave the CHAIN (healing chain or
     default, minus the rack goals) AND be waived from the off-chain
     audit — the change_rf placement itself still prefers fresh racks
-    when it can."""
+    when it can.
+
+    Cost note: a rack-less chain is a DIFFERENT goal set, so the first
+    fix pays its XLA compile (then the facade's goal-optimizer LRU keeps
+    it warm). Deployments using this flag should set self.healing.goals
+    explicitly — the deploy-time validation then covers the exact chain
+    the 3am fix will run."""
     goals = getattr(facade, "self_healing_goals", None)
     kwargs: dict = {"goals": goals}
     if getattr(facade, "rf_self_healing_skip_rack_check", False):

@@ -186,11 +186,20 @@ class Executor:
         self._check_movement_cap(self.config.concurrency)
         cap = self.config.max_num_cluster_movements
         cc0 = self.config.concurrency
-        if cc0.max_leader_movements > cap:
+        if (cc0.max_leader_movements > cap
+                or cc0.min_leader_movements > cap):
+            # BOTH adjuster bounds clamp to the ceiling: the manager
+            # computes max(min_bound, min(value, max_bound)), so an
+            # unclamped min FLOOR would re-raise leadership concurrency
+            # above the ceiling after any adjuster write.
             from dataclasses import replace as _dc_replace
             self.config = _dc_replace(
                 self.config, concurrency=_dc_replace(
-                    cc0, max_leader_movements=cap))
+                    cc0,
+                    max_leader_movements=min(cc0.max_leader_movements,
+                                             cap),
+                    min_leader_movements=min(cc0.min_leader_movements,
+                                             cap)))
         self.notifier = notifier or ExecutorNotifier()
         # Per-topic min.insync.replicas source for the min-ISR-aware
         # strategies/adjuster (ref TopicConfigProvider SPI); defaults to

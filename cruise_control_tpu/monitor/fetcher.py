@@ -74,10 +74,15 @@ class MetricFetcherManager:
                         raise
             # Persistence sits OUTSIDE the retried section: a store
             # failure after a successful write must not re-store the
-            # round (replay would double-count the window's load).
-            self.store.store_samples(merged)
-            if self.on_execution_store is not None:
-                self.on_execution_store.store_samples(merged)
+            # round (replay would double-count the window's load) — but
+            # it still marks the failure meter (round failed either way).
+            try:
+                self.store.store_samples(merged)
+                if self.on_execution_store is not None:
+                    self.on_execution_store.store_samples(merged)
+            except Exception:
+                self._fetch_failures.mark()
+                raise
             return merged
 
     def _fetch(self, partitions: list[tuple[str, int]], brokers: list[int],

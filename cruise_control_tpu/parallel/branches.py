@@ -87,11 +87,7 @@ def make_branched_search(goals: Sequence[GoalKernel], cfg: SearchConfig,
     return jax.jit(run)
 
 
-def select_best(states, violations):
-    """Pick the branch whose violation stack wins lexicographically
-    (earlier goals dominate — same ordering the sequential chain
-    enforces); ties break toward the lower branch index so results stay
-    deterministic."""
+def _checked_violations(violations) -> np.ndarray:
     v = np.asarray(jax.device_get(violations))   # [n_branches, n_goals]
     if np.isnan(v).any():
         # A NaN residual means a broken goal kernel, and NaN compares
@@ -101,7 +97,42 @@ def select_best(states, violations):
         bad = sorted(set(np.nonzero(np.isnan(v))[0].tolist()))
         raise RuntimeError(
             f"branched search produced NaN violations on branches {bad}")
+    return v
+
+
+def select_best(states, violations):
+    """Pick the branch whose violation stack wins lexicographically
+    (earlier goals dominate — same ordering the sequential chain
+    enforces); ties break toward the lower branch index so results stay
+    deterministic."""
+    v = _checked_violations(violations)
     order = sorted(range(v.shape[0]), key=lambda i: (tuple(v[i]), i))
     best = order[0]
+    state = jax.tree.map(lambda x: x[best], states)
+    return state, best, v[best]
+
+
+def select_best_audited(states, violations, audit_eval):
+    """Like :func:`select_best`, but the off-chain hard-goal audit
+    DOMINATES the ordering: a branch with fewer audit-violated hard
+    goals wins even when another branch edges it lexicographically on
+    chain residuals — otherwise the winner could fail the hard-goal gate
+    while a passing plan existed in the same shard_map run.
+
+    ``audit_eval(branch_state) -> (f32[A] violations, f32[A] scales)``
+    (the optimizer's jitted audit program); evaluated per branch on the
+    host side — branch counts are device counts, so this is a handful of
+    tiny dispatches."""
+    v = _checked_violations(violations)
+    keys = []
+    for i in range(v.shape[0]):
+        bstate = jax.tree.map(lambda x, _i=i: x[_i], states)
+        av, scales = jax.device_get(audit_eval(bstate))
+        av = np.asarray(av, dtype=np.float64)
+        tol = 1e-6 + 1e-6 * np.asarray(scales, dtype=np.float64)
+        # Same satisfied-rule as GoalResult: ulp-aware per-goal cutoff.
+        num_bad = int((av > tol).sum())
+        keys.append((num_bad, tuple(v[i]), i))
+    best = min(keys)[-1]
     state = jax.tree.map(lambda x: x[best], states)
     return state, best, v[best]

@@ -125,12 +125,18 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         # Resolve the names NOW: an unknown/misspelled healing goal must
         # fail the deploy, not the first 3am fix() call.
         goals_by_name(healing_goals, constraint)
+        from .analyzer.goals import HARD_GOAL_ALTERNATIVES
         from .analyzer.goals import default_goals as _default_goals
         hard_names = {short_goal_name(n)
                       for n in (optimizer.hard_goal_names
                                 or [g.name for g in _default_goals()
                                     if g.hard])}
-        missing = hard_names - set(healing_goals)
+        present = set(healing_goals)
+        missing = {n for n in hard_names - present
+                   # A documented relaxation in the chain satisfies the
+                   # strict form (same rule the hard-goal audit applies).
+                   if not any(a in present
+                              for a in HARD_GOAL_ALTERNATIVES.get(n, ()))}
         if missing:
             raise ValueError(
                 f"self.healing.goals must include every registered hard "

@@ -667,3 +667,24 @@ def test_maintenance_reader_served_wiring():
                      admin=sim)
     assert not [s for s in app2.facade.detector._schedules
                 if type(s.detector).__name__ == "MaintenanceEventDetector"]
+
+
+def test_healing_goals_validation_accepts_rack_alternative():
+    """self.healing.goals carrying RackAwareDistributionGoal (the
+    documented relaxation) satisfies the RackAwareGoal requirement —
+    same rule the hard-goal audit applies."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.serve import build_app
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b)
+    sim.add_partition("t", 0, [0, 1], size_mb=10.0)
+    app = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "hard.goals": "RackAwareGoal,DiskCapacityGoal",
+        "self.healing.goals": "RackAwareDistributionGoal,DiskCapacityGoal,"
+                              "ReplicaDistributionGoal"}), admin=sim)
+    assert app.facade.self_healing_goals == [
+        "RackAwareDistributionGoal", "DiskCapacityGoal",
+        "ReplicaDistributionGoal"]

@@ -399,3 +399,24 @@ def test_max_num_cluster_movements_caps_requested_concurrency():
     res = ex.execute_proposals([], concurrency_overrides={
         "num_concurrent_leader_movements": 100})
     assert res.succeeded
+
+
+def test_movement_cap_clamps_both_adjuster_bounds():
+    """The ceiling clamps the adjuster's min FLOOR too: the manager
+    computes max(min_bound, min(value, max_bound)), so an unclamped
+    floor would re-raise leadership concurrency above the ceiling."""
+    from cruise_control_tpu.executor import (ExecutorConfig,
+                                             SimulatedKafkaCluster)
+    from cruise_control_tpu.executor.concurrency import ConcurrencyConfig
+    from cruise_control_tpu.executor.executor import Executor
+    sim = SimulatedKafkaCluster()
+    sim.add_broker(0)
+    ex = Executor(sim, ExecutorConfig(
+        max_num_cluster_movements=80,
+        concurrency=ConcurrencyConfig(
+            max_num_cluster_partition_movements=80,
+            num_concurrent_leader_movements=50,
+            num_concurrent_intra_broker_partition_movements=2)))
+    cc = ex.config.concurrency
+    assert cc.max_leader_movements <= 80
+    assert cc.min_leader_movements <= 80

@@ -210,3 +210,31 @@ def test_branched_search_beats_single_on_constrained_budget():
     # Strictly better, by a real margin (measured ~2.9% on this fixture;
     # asserted at 0.5% so float noise across BLAS builds can't flake it).
     assert branched < single * 0.995, (branched, single)
+
+
+def test_audited_branch_selection_prefers_gate_passing_branch():
+    """select_best_audited: a branch that satisfies the audited hard
+    goals beats a chain-lexicographically better branch that violates
+    them (the winner must be able to pass the hard-goal gate)."""
+    import jax
+    from cruise_control_tpu.parallel.branches import (select_best,
+                                                      select_best_audited)
+    # Two fake branches: branch 0 wins on chain residuals but fails the
+    # audit; branch 1 passes the audit.
+    states = {"x": jax.numpy.asarray([[0.0], [1.0]])}
+    viols = jax.numpy.asarray([[0.0, 1.0], [0.0, 2.0]])
+    audit_by_branch = {0.0: ([5.0], [0.0]),   # keyed on state leaf value
+                       1.0: ([0.0], [0.0])}
+
+    def audit_eval(bstate):
+        key = float(bstate["x"][0])
+        av, sc = audit_by_branch[key]
+        return jax.numpy.asarray(av), jax.numpy.asarray(sc)
+
+    _, best_plain, _ = select_best(states, viols)
+    assert best_plain == 0
+    picked, best_audited, v = select_best_audited(states, viols,
+                                                  audit_eval)
+    assert best_audited == 1
+    assert float(picked["x"][0]) == 1.0
+    assert tuple(v) == (0.0, 2.0)
