@@ -100,6 +100,86 @@ def emit(metric: str, value, unit: str, vs_baseline, *, vs_target=None,
     print(json.dumps(row), flush=True)
 
 
+#: windows ingested for the monitor→model stage bench.
+MODEL_BUILD_WINDOWS = 4
+
+
+def run_model_build_bench(num_brokers: int = NUM_BROKERS,
+                          num_partitions: int = NUM_PARTITIONS, *,
+                          emit_row: bool = True, repeats: int = 2) -> dict:
+    """Monitor→model stage wall-clock: aggregate + ``cluster_model``
+    through the dense whole-pool pipeline vs the retained per-entity
+    reference path, on the same ingested sample history. Model parity is
+    asserted before any number is reported — a wrong fast model must fail
+    loudly, not win the row. Emits the ``model_build_wall_clock`` JSON
+    line (value = dense seconds, vs_baseline = legacy/dense speedup)."""
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+
+    window_ms = 1000
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b)
+    num_topics = max(num_partitions // 100, 1)
+    for p in range(num_partitions):
+        sim.add_partition(f"t{p % num_topics}", p,
+                          [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=50.0 + (p % 100))
+    monitors = {
+        mode: LoadMonitor(sim, MonitorConfig(
+            num_windows=MODEL_BUILD_WINDOWS, window_ms=window_ms,
+            min_samples_per_window=1, dense_pipeline=dense))
+        for mode, dense in (("dense", True), ("legacy", False))}
+    mdef = partition_metric_def()
+    keys = sorted(sim.describe_partitions())
+    P = len(keys)
+    rng = np.random.default_rng(11)
+    for w in range(MODEL_BUILD_WINDOWS + 1):
+        vals = np.abs(rng.normal(10.0, 3.0, size=(P, mdef.size())))
+        # Sparsity: every 7th partition is only sampled every third
+        # window, so the extrapolation ladder (AVG_ADJACENT /
+        # NO_VALID_EXTRAPOLATION) is on the measured path.
+        keep = np.ones(P, bool)
+        keep[::7] = (w % 3 == 0)
+        ents = [k for k, kp in zip(keys, keep) if kp]
+        times = np.full(len(ents), w * window_ms + 100, np.int64)
+        for m in monitors.values():
+            m.partition_aggregator.add_samples_dense(ents, times,
+                                                     vals[keep])
+    now_ms = (MODEL_BUILD_WINDOWS + 1) * window_ms
+
+    def timed(monitor):
+        best, res = float("inf"), None
+        for _ in range(repeats):
+            t0 = time.monotonic()
+            res = monitor.cluster_model(now_ms)
+            best = min(best, time.monotonic() - t0)
+        return best, res
+
+    legacy_s, res_l = timed(monitors["legacy"])
+    dense_s, res_d = timed(monitors["dense"])
+    for name in ("replica_broker", "leader_load", "follower_load",
+                 "partition_topic", "partition_valid", "replica_offline",
+                 "replica_pref_pos"):
+        a = np.asarray(getattr(res_d.model, name))
+        b = np.asarray(getattr(res_l.model, name))
+        if not np.array_equal(a, b):
+            raise RuntimeError(
+                f"dense/legacy monitor pipeline mismatch in model.{name}")
+    if res_d.metadata.partition_keys != res_l.metadata.partition_keys:
+        raise RuntimeError("dense/legacy monitor metadata mismatch")
+    speedup = legacy_s / dense_s if dense_s > 0 else None
+    log(f"model build ({num_brokers}x{num_partitions}): dense {dense_s:.3f}s"
+        f" legacy {legacy_s:.3f}s speedup "
+        + (f"{speedup:.1f}x" if speedup is not None else "n/a"))
+    if emit_row:
+        emit("model_build_wall_clock", round(dense_s, 3), "s",
+             round(speedup, 3) if speedup else None)
+    return {"dense_s": dense_s, "legacy_s": legacy_s, "speedup": speedup,
+            "partitions": P}
+
+
 def build_spec():
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
                                                PartitionSpec)
@@ -543,6 +623,9 @@ def main():
     from cruise_control_tpu.model.spec import flatten_spec
 
     log(f"platform: {platform} -> {jax.devices()[0].platform} ({jax.devices()[0]})")
+    # Host-side monitor→model stage: dense whole-pool pipeline vs the
+    # per-entity reference path, emitted alongside the search metric.
+    run_model_build_bench()
     t0 = time.monotonic()
     spec = build_spec()
     model, md = flatten_spec(spec)

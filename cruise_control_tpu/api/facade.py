@@ -167,9 +167,12 @@ class KafkaCruiseControl:
         if progress:
             progress.add_step("WaitingForClusterModel")
         result = self.monitor.cluster_model(self._now_ms(), requirements)
-        spec = result.spec
         original_placement = None
         if spec_mutator is not None:
+            # Only mutator flows materialize the (lazy) spec object graph;
+            # plain rebalance/proposals ride the flat arrays straight from
+            # the dense pipeline.
+            spec = result.spec
             # Proposals must capture the full live->final change, so
             # remember the LIVE placement before the mutator rewrites the
             # spec (an RF change adds/drops replicas pre-optimization; a

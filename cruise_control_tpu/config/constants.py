@@ -40,6 +40,12 @@ def _monitor_defs(d: ConfigDef) -> None:
     d.define("max.allowed.extrapolations.per.partition", ConfigType.INT, 5,
              validator=Range.at_least(0), importance=Importance.LOW,
              doc="Extrapolation budget per partition")
+    d.define("monitor.dense.pipeline", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Build cluster models through the dense whole-pool "
+                 "monitor pipeline (one [E, M, W] aggregation + "
+                 "whole-array flat-model gathers); false selects the "
+                 "per-entity reference path")
     d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000,
              validator=Range.at_least(1), importance=Importance.HIGH,
              doc="Sampling loop interval")
@@ -792,7 +798,8 @@ class CruiseControlConfig(AbstractConfig):
                 "max.allowed.extrapolations.per.broker"),
             follower_cpu_ratio=self.get_double("follower.cpu.ratio"),
             min_valid_partition_ratio=self.get_double(
-                "min.valid.partition.ratio"))
+                "min.valid.partition.ratio"),
+            dense_pipeline=self.get_boolean("monitor.dense.pipeline"))
 
     def balancing_constraint(self) -> BalancingConstraint:
         return BalancingConstraint(

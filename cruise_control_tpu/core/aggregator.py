@@ -18,7 +18,8 @@ from __future__ import annotations
 import enum
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Hashable, Mapping
+from collections.abc import Mapping
+from typing import Callable, Hashable
 
 import numpy as np
 
@@ -38,6 +39,11 @@ class Extrapolation(enum.Enum):
     AVG_ADJACENT = 2
     FORCED_INSUFFICIENT = 3
     NO_VALID_EXTRAPOLATION = 4
+
+
+#: Extrapolation decoded by its integer code (the dense path stores codes
+#: in an ``int8[E, W]`` matrix; views decode lazily through this table).
+EXTRAPOLATION_BY_CODE = tuple(Extrapolation)
 
 
 class NotEnoughValidWindowsError(RuntimeError):
@@ -104,12 +110,67 @@ class ValuesAndExtrapolations:
 
 
 @dataclass
+class DenseAggregate:
+    """The whole-pool aggregation result as dense arrays.
+
+    One ``[num_entities, num_metrics, num_windows]`` value cube plus a
+    per-window extrapolation-code matrix, in a single stable entity order
+    (``entities[i]`` owns row ``i``; ``row_index`` inverts that). Downstream
+    model construction gathers straight out of these arrays — the
+    ``entity_values`` dict API on :class:`MetricSampleAggregationResult`
+    is a lazy per-entity view over the same memory.
+    """
+
+    entities: list[Hashable]
+    row_index: dict[Hashable, int]
+    values: np.ndarray          # float64[E, M, W]
+    extrapolations: np.ndarray  # int8[E, W], Extrapolation codes
+    window_valid: np.ndarray    # bool[E, W] (pre-demotion validity)
+    window_indices: list[int]
+    window_times_ms: list[int]
+
+
+class _LazyEntityValues(Mapping):
+    """``entity -> ValuesAndExtrapolations`` view over a DenseAggregate.
+
+    Keeps the dict API every existing caller uses (``get``/``[]``/
+    iteration/``len``) without materializing E per-entity objects: each
+    access builds one lightweight wrapper whose ``values`` is a row view
+    into the dense cube."""
+
+    __slots__ = ("_dense",)
+
+    def __init__(self, dense: DenseAggregate) -> None:
+        self._dense = dense
+
+    def __getitem__(self, entity: Hashable) -> ValuesAndExtrapolations:
+        row = self._dense.row_index[entity]
+        return ValuesAndExtrapolations(
+            values=self._dense.values[row],
+            extrapolations=[EXTRAPOLATION_BY_CODE[c]
+                            for c in self._dense.extrapolations[row]],
+            window_times_ms=self._dense.window_times_ms)
+
+    def __iter__(self):
+        return iter(self._dense.entities)
+
+    def __len__(self) -> int:
+        return len(self._dense.entities)
+
+    def __contains__(self, entity: Hashable) -> bool:
+        return entity in self._dense.row_index
+
+
+@dataclass
 class MetricSampleAggregationResult:
     generation: int
     valid_windows: list[int]
-    entity_values: dict[Hashable, ValuesAndExtrapolations]
+    entity_values: Mapping[Hashable, ValuesAndExtrapolations]
     completeness: MetricSampleCompleteness
     invalid_entities: set[Hashable]
+    #: dense array view of the same aggregation (None on the retained
+    #: per-entity reference path and on empty-window results)
+    dense: DenseAggregate | None = None
 
 
 class _RawStore:
@@ -189,6 +250,13 @@ class _RawStore:
 
     def get_row(self, entity: Hashable) -> int | None:
         return self._rows.get(entity)
+
+    def lookup_rows(self, entities: list[Hashable]) -> np.ndarray:
+        """Row index per entity, ``-1`` for entities with no state.
+        Read-only counterpart of :meth:`rows_for` (never allocates rows)."""
+        get = self._rows.get
+        return np.fromiter((get(e, -1) for e in entities), np.int64,
+                           len(entities))
 
     def entities(self) -> set[Hashable]:
         return set(self._rows)
@@ -322,6 +390,10 @@ class MetricSampleAggregator:
     def num_windows(self) -> int:
         return self._num_windows
 
+    @property
+    def num_metrics(self) -> int:
+        return self._num_metrics
+
     def window_index(self, time_ms: int) -> int:
         return time_ms // self._window_ms
 
@@ -387,7 +459,13 @@ class MetricSampleAggregator:
 
     def remove_entities(self, entities: set[Hashable]) -> None:
         with self._lock:
-            dropped = any([self._raw.drop(e) for e in entities])
+            # Every entity must be dropped; an ``any(gen)`` would stop at
+            # the first True and leave the rest of the pool populated, so
+            # the no-short-circuit contract is structural here.
+            dropped = False
+            for entity in entities:
+                if self._raw.drop(entity):
+                    dropped = True
             if dropped:
                 self._generation += 1
 
@@ -407,10 +485,30 @@ class MetricSampleAggregator:
                     for w in range(self._oldest_window_index, self._current_window_index)]
 
     # ------------------------------------------------------------ aggregate
+    @staticmethod
+    def _sorted_entities(entities: set[Hashable]) -> list[Hashable]:
+        # Entities are homogeneous per aggregator ((topic, partition)
+        # tuples or int broker ids), so a plain sort works; ``key=repr``
+        # would allocate a string per entity — a million strings per
+        # aggregation round at LinkedIn scale. The fallback only exists
+        # for exotic mixed-type entity spaces.
+        try:
+            return sorted(entities)
+        except TypeError:
+            return sorted(entities, key=repr)
+
     def aggregate(self, from_ms: int, to_ms: int,
-                  options: AggregationOptions | None = None) -> MetricSampleAggregationResult:
+                  options: AggregationOptions | None = None, *,
+                  use_dense: bool = True) -> MetricSampleAggregationResult:
         """Aggregate rolled-out windows overlapping [from_ms, to_ms]
-        (ref aggregate MetricSampleAggregator.java:193)."""
+        (ref aggregate MetricSampleAggregator.java:193).
+
+        ``use_dense=True`` (the default) computes the whole entity pool as
+        one ``[E, M, W]`` array program; ``use_dense=False`` runs the
+        retained per-entity reference implementation (kept for the
+        dense/legacy parity property tests and as executable
+        documentation of the ladder). Both produce identical results —
+        bit-identical values, codes, and completeness."""
         options = options or AggregationOptions()
         with self._lock:
             window_indices = [w for w in range(self._oldest_window_index,
@@ -438,8 +536,13 @@ class MetricSampleAggregator:
                 return MetricSampleAggregationResult(self._generation, [], {},
                                                      completeness, entities)
 
+            entity_list = self._sorted_entities(entities)
+            if use_dense:
+                return self._aggregate_dense(entity_list, window_indices,
+                                             options, completeness,
+                                             from_ms, to_ms)
+
             valid_matrix = np.zeros((len(entities), num_win), dtype=bool)
-            entity_list = sorted(entities, key=repr)
             for i, entity in enumerate(entity_list):
                 vae, window_valid = self._aggregate_entity(entity, window_indices, options)
                 entity_values[entity] = vae
@@ -470,6 +573,159 @@ class MetricSampleAggregator:
                                                  completeness.valid_windows,
                                                  entity_values, completeness,
                                                  invalid_entities)
+
+    def _aggregate_dense(self, entity_list: list[Hashable],
+                         window_indices: list[int],
+                         options: AggregationOptions,
+                         completeness: MetricSampleCompleteness,
+                         from_ms: int, to_ms: int
+                         ) -> MetricSampleAggregationResult:
+        """The dense whole-pool aggregation: one ``[E, M, W]`` program.
+
+        Replaces E invocations of :meth:`_aggregate_entity` with masked
+        array selects over the ``_RawStore`` pool — window validity is one
+        boolean matrix, the extrapolation ladder is four masks, and the
+        per-entity extrapolation budget is a cumulative count along the
+        window axis. Bit-identical to the reference path: the same
+        elementwise operations run in the same order, just batched."""
+        E, W = len(entity_list), len(window_indices)
+        M, S = self._num_metrics, self._num_slots
+        raw = self._raw
+        rows = raw.lookup_rows(entity_list)
+        present = rows >= 0
+        rs = np.where(present, rows, 0)
+
+        win = np.asarray(window_indices, np.int64)   # contiguous span
+        slots = win % S
+
+        # --- window values for every (entity, slot): [E, S, M] ----------
+        # AVG everywhere first (one fused gather+divide), then the MAX /
+        # LATEST metric columns are overwritten via np.ix_ open-mesh
+        # gathers so only the needed columns are materialized.
+        base = raw.sums[rs] / np.maximum(raw.counts[rs], 1)
+        max_ids = [info.id for info in self._metric_def.all_metrics()
+                   if info.strategy is AggregationFunction.MAX]
+        latest_ids = [info.id for info in self._metric_def.all_metrics()
+                      if info.strategy is AggregationFunction.LATEST]
+        slot_range = np.arange(S)
+        if max_ids:
+            gm = raw.maxes[np.ix_(rs, slot_range, np.asarray(max_ids))]
+            base[:, :, max_ids] = np.where(np.isfinite(gm), gm, 0.0)
+        if latest_ids:
+            base[:, :, latest_ids] = raw.latest_values[
+                np.ix_(rs, slot_range, np.asarray(latest_ids))]
+
+        # --- validity + the extrapolation ladder as masks ----------------
+        sc_all = np.where(present[:, None], raw.sample_counts[rs], 0)
+        scnt = sc_all[:, slots]
+        valid0 = scnt >= self._min_samples                        # NONE
+        half_min = max(1, self._min_samples // 2)
+        avail = ~valid0 & (scnt >= half_min)                      # AVG_AVAILABLE
+
+        # Neighbor qualification over the extended range [w0-1, wN+1]:
+        # a neighbor must be inside retention AND fully sampled.
+        ext_win = np.arange(win[0] - 1, win[-1] + 2)
+        in_ret = ((ext_win >= self._oldest_window_index)
+                  & (ext_win < self._current_window_index))
+        ext_slots = ext_win % S
+        nfull = (sc_all[:, ext_slots] >= self._min_samples) & in_ret[None, :]
+        left_ok, right_ok = nfull[:, :W], nfull[:, 2:]
+        adj = ~valid0 & ~avail & (left_ok | right_ok)             # AVG_ADJACENT
+        forced = ~valid0 & ~avail & ~adj & (scnt > 0)             # FORCED_INSUFFICIENT
+
+        # Budget: only windows where an extrapolation actually applies
+        # burn it (ref maxAllowedExtrapolationsPerEntity accounting —
+        # hopeless windows never consume budget). The reference's running
+        # counter is an exclusive cumulative count along the window axis.
+        burn = avail | adj | forced
+        prior_burns = np.cumsum(burn, axis=1, dtype=np.int64) - burn
+        allowed = prior_burns < options.max_allowed_extrapolations_per_entity
+        window_valid = valid0 | (burn & allowed)
+
+        codes = np.full((E, W), Extrapolation.NO_VALID_EXTRAPOLATION.value,
+                        np.int8)
+        codes[valid0] = Extrapolation.NONE.value
+        codes[avail & allowed] = Extrapolation.AVG_AVAILABLE.value
+        codes[adj & allowed] = Extrapolation.AVG_ADJACENT.value
+        codes[forced & allowed] = Extrapolation.FORCED_INSUFFICIENT.value
+
+        # --- values: own slot for NONE/AVAILABLE/FORCED, neighbor mean
+        # for ADJACENT, zero for invalid windows -------------------------
+        own = base[:, slots, :]                                   # [E, W, M]
+        nmean_den = np.maximum(
+            left_ok.astype(np.float64) + right_ok, 1.0)[:, :, None]
+        adj_val = (base[:, ext_slots[:W], :] * left_ok[:, :, None]
+                   + base[:, ext_slots[2:], :] * right_ok[:, :, None]
+                   ) / nmean_den
+        vals = np.where((codes == Extrapolation.AVG_ADJACENT.value)[:, :, None],
+                        adj_val, own)
+        vals = np.where(window_valid[:, :, None], vals, 0.0)
+        values = np.ascontiguousarray(vals.transpose(0, 2, 1))    # [E, M, W]
+
+        # --- entity/group validity + demotion ----------------------------
+        entity_valid = window_valid.all(axis=1)
+        gid_map: dict[Hashable, int] = {}
+        group_fn = self._entity_group_fn
+        gids = np.fromiter(
+            (gid_map.setdefault(group_fn(e), len(gid_map))
+             for e in entity_list), np.int64, E)
+        G = len(gid_map)
+        group_has_invalid = (np.bincount(gids[~entity_valid], minlength=G)
+                             > 0) if G else np.zeros(0, bool)
+        post_valid = entity_valid
+        if options.granularity is AggregationGranularity.ENTITY_GROUP and E:
+            # One invalid entity invalidates its whole group (ref
+            # AggregationOptions.Granularity.ENTITY_GROUP).
+            post_valid = entity_valid & ~group_has_invalid[gids]
+        valid_rows = np.nonzero(post_valid)[0]
+        invalid_rows = np.nonzero(~post_valid)[0]
+        completeness.valid_entities = {entity_list[i] for i in valid_rows}
+        invalid_entities = {entity_list[i] for i in invalid_rows}
+
+        # --- completeness (vectorized _fill_completeness) ----------------
+        num_entities = max(1, E)
+        valid_per_window = window_valid.sum(axis=0)
+        any_valid = window_valid.any(axis=0)
+        if G:
+            inv_per_gw = np.zeros((G, W), np.int64)
+            np.add.at(inv_per_gw, gids, (~window_valid).astype(np.int64))
+            inv_groups_per_window = (inv_per_gw > 0).sum(axis=0)
+        else:
+            inv_groups_per_window = np.zeros(W, np.int64)
+        for j, w in enumerate(window_indices):
+            ratio = float(valid_per_window[j]) / num_entities
+            completeness.valid_entity_ratio_by_window[w] = ratio
+            group_ratio = (1.0 - int(inv_groups_per_window[j]) / G
+                           if G else 0.0)
+            completeness.valid_entity_group_ratio_by_window[w] = group_ratio
+            # A window with zero valid entities is never valid, even when
+            # the configured ratio floor is 0.0.
+            meets = (ratio >= options.min_valid_entity_ratio
+                     and bool(any_valid[j]))
+            if options.granularity is AggregationGranularity.ENTITY_GROUP:
+                meets = meets and (group_ratio
+                                   >= options.min_valid_entity_group_ratio)
+            if meets:
+                completeness.valid_windows.append(w)
+        if G:
+            completeness.valid_entity_groups = {
+                g for g, i in gid_map.items() if not group_has_invalid[i]}
+
+        if len(completeness.valid_windows) < options.min_valid_windows:
+            raise NotEnoughValidWindowsError(
+                f"{len(completeness.valid_windows)} valid windows, "
+                f"{options.min_valid_windows} required "
+                f"(in range [{from_ms}, {to_ms}])")
+        dense = DenseAggregate(
+            entities=entity_list,
+            row_index={e: i for i, e in enumerate(entity_list)},
+            values=values, extrapolations=codes, window_valid=window_valid,
+            window_indices=list(window_indices),
+            window_times_ms=[w * self._window_ms for w in window_indices])
+        return MetricSampleAggregationResult(
+            self._generation, completeness.valid_windows,
+            _LazyEntityValues(dense), completeness, invalid_entities,
+            dense=dense)
 
     def _aggregate_entity(self, entity: Hashable, window_indices: list[int],
                           options: AggregationOptions

@@ -84,6 +84,14 @@ class FlatClusterModel:
     broker_valid: jax.Array        # bool[B]  (padding mask)
 
     # ------------------------------------------------------------ properties
+    @classmethod
+    def from_numpy(cls, **arrays) -> "FlatClusterModel":
+        """Build from host-side numpy arrays (one ``jnp.asarray`` per
+        field). The assembly point for every array-native construction
+        path — ``flatten_spec``, the monitor's dense pipeline, bench's
+        direct builders."""
+        return cls(**{name: jnp.asarray(a) for name, a in arrays.items()})
+
     @property
     def num_partitions_padded(self) -> int:
         return self.replica_broker.shape[0]

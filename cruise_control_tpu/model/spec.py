@@ -120,21 +120,38 @@ class ClusterMetadata:
         return len(self.topics)
 
 
-def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
-                 pad_brokers_to: int | None = None,
-                 pad_rf_to: int | None = None,
-                 partition_pad_multiple: int = 128,
-                 broker_pad_multiple: int = 8):
-    """Flatten a ``ClusterSpec`` into (FlatClusterModel, ClusterMetadata).
+@dataclass
+class BrokerArrays:
+    """The broker half of a flattened model: padded numpy arrays plus the
+    id/name lookup tables. Shared by :func:`flatten_spec` and the
+    monitor's dense pipeline (which builds partition arrays by whole-array
+    gathers and only needs the broker axis flattened once)."""
 
-    Shapes are padded (partitions to a multiple of ``partition_pad_multiple``,
-    brokers to ``broker_pad_multiple``) so repeated model builds for a slowly
-    growing cluster hit the same compiled analyzer kernels.
-    """
-    import jax.numpy as jnp
-    from .flat import FlatClusterModel
+    broker_ids: list[int]
+    broker_index: dict[int, int]
+    racks: list[str]
+    hosts: list[str]
+    broker_sets: list[str]
+    capacity: np.ndarray   # float32[Bpad, 4]
+    rack: np.ndarray       # int32[Bpad]
+    host: np.ndarray       # int32[Bpad]
+    broker_set: np.ndarray  # int32[Bpad]
+    alive: np.ndarray      # bool[Bpad]
+    new: np.ndarray        # bool[Bpad]
+    demoted: np.ndarray    # bool[Bpad]
+    broken: np.ndarray     # bool[Bpad]
+    valid: np.ndarray      # bool[Bpad]
 
-    broker_ids = [b.broker_id for b in spec.brokers]
+    @property
+    def padded(self) -> int:
+        return self.capacity.shape[0]
+
+
+def flatten_brokers(brokers: list[BrokerSpec], *,
+                    pad_brokers_to: int | None = None,
+                    broker_pad_multiple: int = 8) -> BrokerArrays:
+    """Flatten the broker axis of a model into :class:`BrokerArrays`."""
+    broker_ids = [b.broker_id for b in brokers]
     broker_index = {bid: i for i, bid in enumerate(broker_ids)}
     if len(broker_index) != len(broker_ids):
         raise ValueError("duplicate broker ids in spec")
@@ -151,37 +168,60 @@ def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
     if Bpad < B:
         raise ValueError("pad_brokers_to smaller than broker count")
 
-    capacity = np.zeros((Bpad, NUM_RESOURCES), np.float32)
-    b_rack = np.zeros(Bpad, np.int32)
-    b_host = np.zeros(Bpad, np.int32)
-    b_set = np.full(Bpad, -1, np.int32)
-    alive = np.zeros(Bpad, bool)
-    new = np.zeros(Bpad, bool)
-    demoted = np.zeros(Bpad, bool)
-    broken = np.zeros(Bpad, bool)
-    bvalid = np.zeros(Bpad, bool)
+    out = BrokerArrays(
+        broker_ids=broker_ids, broker_index=broker_index,
+        racks=racks, hosts=hosts, broker_sets=broker_sets,
+        capacity=np.zeros((Bpad, NUM_RESOURCES), np.float32),
+        rack=np.zeros(Bpad, np.int32),
+        host=np.zeros(Bpad, np.int32),
+        broker_set=np.full(Bpad, -1, np.int32),
+        alive=np.zeros(Bpad, bool),
+        new=np.zeros(Bpad, bool),
+        demoted=np.zeros(Bpad, bool),
+        broken=np.zeros(Bpad, bool),
+        valid=np.zeros(Bpad, bool))
 
-    for i, b in enumerate(spec.brokers):
-        capacity[i] = np.asarray(b.capacity, np.float32)
+    for i, b in enumerate(brokers):
+        out.capacity[i] = np.asarray(b.capacity, np.float32)
         if b.rack not in rack_index:
             rack_index[b.rack] = len(racks)
             racks.append(b.rack)
-        b_rack[i] = rack_index[b.rack]
+        out.rack[i] = rack_index[b.rack]
         host = b.host if b.host is not None else f"host-{b.broker_id}"
         if host not in host_index:
             host_index[host] = len(hosts)
             hosts.append(host)
-        b_host[i] = host_index[host]
+        out.host[i] = host_index[host]
         if b.broker_set is not None:
             if b.broker_set not in broker_set_index:
                 broker_set_index[b.broker_set] = len(broker_sets)
                 broker_sets.append(b.broker_set)
-            b_set[i] = broker_set_index[b.broker_set]
-        alive[i] = b.alive
-        new[i] = b.new
-        demoted[i] = b.demoted
-        broken[i] = b.broken_disk
-        bvalid[i] = True
+            out.broker_set[i] = broker_set_index[b.broker_set]
+        out.alive[i] = b.alive
+        out.new[i] = b.new
+        out.demoted[i] = b.demoted
+        out.broken[i] = b.broken_disk
+        out.valid[i] = True
+    return out
+
+
+def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
+                 pad_brokers_to: int | None = None,
+                 pad_rf_to: int | None = None,
+                 partition_pad_multiple: int = 128,
+                 broker_pad_multiple: int = 8):
+    """Flatten a ``ClusterSpec`` into (FlatClusterModel, ClusterMetadata).
+
+    Shapes are padded (partitions to a multiple of ``partition_pad_multiple``,
+    brokers to ``broker_pad_multiple``) so repeated model builds for a slowly
+    growing cluster hit the same compiled analyzer kernels.
+    """
+    from .flat import FlatClusterModel
+
+    ba = flatten_brokers(spec.brokers, pad_brokers_to=pad_brokers_to,
+                         broker_pad_multiple=broker_pad_multiple)
+    broker_ids, broker_index = ba.broker_ids, ba.broker_index
+    Bpad = ba.padded
 
     topics = []
     topic_index: dict[str, int] = {}
@@ -237,23 +277,23 @@ def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
     if len(partition_index) != len(partition_keys):
         raise ValueError("duplicate (topic, partition) in spec")
 
-    model = FlatClusterModel(
-        replica_broker=jnp.asarray(rb),
-        leader_load=jnp.asarray(lead_load),
-        follower_load=jnp.asarray(foll_load),
-        partition_topic=jnp.asarray(ptopic),
-        partition_valid=jnp.asarray(pvalid),
-        replica_offline=jnp.asarray(offline),
-        replica_pref_pos=jnp.asarray(pref_pos),
-        broker_capacity=jnp.asarray(capacity),
-        broker_rack=jnp.asarray(b_rack),
-        broker_host=jnp.asarray(b_host),
-        broker_set=jnp.asarray(b_set),
-        broker_alive=jnp.asarray(alive),
-        broker_new=jnp.asarray(new),
-        broker_demoted=jnp.asarray(demoted),
-        broker_broken_disk=jnp.asarray(broken),
-        broker_valid=jnp.asarray(bvalid),
+    model = FlatClusterModel.from_numpy(
+        replica_broker=rb,
+        leader_load=lead_load,
+        follower_load=foll_load,
+        partition_topic=ptopic,
+        partition_valid=pvalid,
+        replica_offline=offline,
+        replica_pref_pos=pref_pos,
+        broker_capacity=ba.capacity,
+        broker_rack=ba.rack,
+        broker_host=ba.host,
+        broker_set=ba.broker_set,
+        broker_alive=ba.alive,
+        broker_new=ba.new,
+        broker_demoted=ba.demoted,
+        broker_broken_disk=ba.broken,
+        broker_valid=ba.valid,
     )
     metadata = ClusterMetadata(
         broker_ids=broker_ids,
@@ -262,8 +302,8 @@ def flatten_spec(spec: ClusterSpec, *, pad_partitions_to: int | None = None,
         topic_index=topic_index,
         partition_keys=partition_keys,
         partition_index=partition_index,
-        racks=racks,
-        hosts=hosts,
-        broker_sets=broker_sets,
+        racks=ba.racks,
+        hosts=ba.hosts,
+        broker_sets=ba.broker_sets,
     )
     return model, metadata

@@ -60,6 +60,11 @@ class MonitorConfig:
     #: path wires the config value — 0.95 — while direct library
     #: construction keeps 0.0 so toy models stay buildable).
     min_valid_partition_ratio: float = 0.0
+    #: monitor.dense.pipeline: build the cluster model through the dense
+    #: whole-pool path (one [E, M, W] aggregation + whole-array flat-model
+    #: gathers). False selects the retained per-entity reference path —
+    #: kept for parity testing, not for production scale.
+    dense_pipeline: bool = True
 
 
 @dataclass
@@ -82,18 +87,43 @@ class LoadMonitorState:
                 "generation": self.generation}
 
 
-@dataclass
 class ClusterModelResult:
-    """A flattened model + everything the API layers want alongside it."""
+    """A flattened model + everything the API layers want alongside it.
 
-    model: object               # FlatClusterModel
-    metadata: object            # ClusterMetadata
-    spec: ClusterSpec
-    completeness: MetricSampleCompleteness
-    #: (topic, partition) -> [num_metrics, num_windows] window values
-    partition_windows: dict[tuple[str, int], np.ndarray]
-    window_times_ms: list[int]
-    generation: int
+    On the dense pipeline, ``spec`` (the per-partition object graph) and
+    ``partition_windows`` are built lazily on first access: the serving
+    path (optimizer) consumes only the flat arrays, while the object
+    consumers (/partition_load, spec mutators, tests) pay the O(P) Python
+    cost only when they actually ask.
+    """
+
+    def __init__(self, model, metadata, completeness, window_times_ms,
+                 generation, *, spec: ClusterSpec | None = None,
+                 spec_factory=None,
+                 partition_windows: dict | None = None,
+                 partition_windows_factory=None):
+        self.model = model                  # FlatClusterModel
+        self.metadata = metadata            # ClusterMetadata
+        self.completeness = completeness
+        self.window_times_ms = window_times_ms
+        self.generation = generation
+        self._spec = spec
+        self._spec_factory = spec_factory
+        self._partition_windows = partition_windows
+        self._partition_windows_factory = partition_windows_factory
+
+    @property
+    def spec(self) -> ClusterSpec:
+        if self._spec is None:
+            self._spec = self._spec_factory()
+        return self._spec
+
+    @property
+    def partition_windows(self) -> dict[tuple[str, int], np.ndarray]:
+        """(topic, partition) -> [num_metrics, num_windows] window values."""
+        if self._partition_windows is None:
+            self._partition_windows = self._partition_windows_factory()
+        return self._partition_windows
 
 
 class LoadMonitor:
@@ -175,11 +205,31 @@ class LoadMonitor:
         return snap
 
     # -------------------------------------------------------------- ingest
+    @staticmethod
+    def _ingest_batch(aggregator: MetricSampleAggregator, samples) -> None:
+        """One vectorized ingest per batch: one lock acquisition and one
+        scatter instead of a per-sample add loop (the dense path of
+        ``add_samples_dense``, bit-identical to scalar ingest)."""
+        if not samples:
+            return
+        if len(samples) == 1:
+            aggregator.add_sample(samples[0].to_aggregator_sample())
+            return
+        num_metrics = aggregator.num_metrics
+        values = np.full((len(samples), num_metrics), np.nan)
+        times = np.empty(len(samples), np.int64)
+        entities = []
+        for i, s in enumerate(samples):
+            entities.append(s.entity)
+            times[i] = s.time_ms
+            for metric_id, value in s.values.items():
+                values[i, metric_id] = value
+        aggregator.add_samples_dense(entities, times, values)
+
     def add_samples(self, samples: Samples) -> None:
-        for s in samples.partition_samples:
-            self.partition_aggregator.add_sample(s.to_aggregator_sample())
-        for s in samples.broker_samples:
-            self.broker_aggregator.add_sample(s.to_aggregator_sample())
+        self._ingest_batch(self.partition_aggregator,
+                           samples.partition_samples)
+        self._ingest_batch(self.broker_aggregator, samples.broker_samples)
 
     @property
     def generation(self) -> int:
@@ -237,7 +287,8 @@ class LoadMonitor:
                          if requirements.include_all_topics
                          else AggregationGranularity.ENTITY),
             interested_entities=interested)
-        return self.partition_aggregator.aggregate(0, now_ms, options)
+        return self.partition_aggregator.aggregate(
+            0, now_ms, options, use_dense=self.config.dense_pipeline)
 
     def cluster_model(self, now_ms: int,
                       requirements: ModelCompletenessRequirements | None = None,
@@ -268,7 +319,6 @@ class LoadMonitor:
                     f"/ {len(result.completeness.valid_windows)} windows does "
                     f"not meet {requirements}")
 
-        c = self.config
         offline_dirs_fn = getattr(self.admin, "offline_logdirs", None)
         offline_dirs = offline_dirs_fn() if offline_dirs_fn is not None else {}
         brokers: list[BrokerSpec] = []
@@ -283,13 +333,41 @@ class LoadMonitor:
                 alive=is_alive, broker_set=broker_set,
                 broken_disk=bool(offline_dirs.get(broker_id))))
 
-        pspecs: list[PartitionSpec] = []
-        windows: dict[tuple[str, int], np.ndarray] = {}
-        window_times: list[int] = []
         # Per-replica offline marks beyond dead brokers (failed logdirs) —
         # ref Replica.isCurrentOffline covering bad-disk replicas.
         offline_fn = getattr(self.admin, "offline_replicas", None)
         extra_offline = offline_fn() if offline_fn is not None else set()
+        if self.config.dense_pipeline and (result is None
+                                           or result.dense is not None):
+            return self._assemble_dense(partitions, alive, brokers, result,
+                                        extra_offline)
+        return self._assemble_reference(partitions, alive, brokers, result,
+                                        extra_offline)
+
+    def _assemble_reference(self, partitions, alive, brokers, result,
+                            extra_offline) -> ClusterModelResult:
+        """The retained per-partition reference assembler (spec objects +
+        flatten_spec), used when ``dense_pipeline`` is off and by the
+        dense result's lazy ``spec`` property."""
+        pspecs, windows, window_times = self._partition_specs(
+            partitions, alive, result, extra_offline)
+        spec = ClusterSpec(brokers=brokers, partitions=pspecs)
+        model, metadata = flatten_spec(spec)
+        return ClusterModelResult(
+            model=model, metadata=metadata,
+            completeness=(result.completeness if result is not None
+                          else MetricSampleCompleteness(
+                              generation=self.generation)),
+            window_times_ms=window_times, generation=self.generation,
+            spec=spec, partition_windows=windows)
+
+    def _partition_specs(self, partitions, alive, result, extra_offline):
+        """Per-partition object-graph population (ref LoadMonitor
+        clusterModel's createReplica/setReplicaLoad walk)."""
+        c = self.config
+        pspecs: list[PartitionSpec] = []
+        windows: dict[tuple[str, int], np.ndarray] = {}
+        window_times: list[int] = []
         for tp, info in sorted(partitions.items()):
             leader_load = (0.0, 0.0, 0.0, float(info.size_mb))
             follower_load = None
@@ -336,15 +414,180 @@ class LoadMonitor:
                 # The admin's stored order IS Kafka's preferred order; when
                 # the current leader drifted from it, PLE can now see that.
                 preferred_replicas=list(info.replicas)))
+        return pspecs, windows, window_times
 
-        spec = ClusterSpec(brokers=brokers, partitions=pspecs)
-        model, metadata = flatten_spec(spec)
+    def _assemble_dense(self, partitions, alive, brokers, result,
+                        extra_offline) -> ClusterModelResult:
+        """Whole-array flat-model construction (the dense pipeline).
+
+        One fused pass extracts partition attributes from the admin's
+        object graph into flat arrays; replica placement, leader-first
+        rotation, offline marks, and expected-utilization loads are then
+        whole-array operations — the loads gathered straight from the
+        ``DenseAggregate`` cube instead of E ``entity_values`` lookups.
+        ``spec`` / ``partition_windows`` stay available as lazy views.
+        """
+        from ..model.flat import FlatClusterModel
+        from ..model.spec import _round_up, flatten_brokers
+
+        c = self.config
+        ba = flatten_brokers(brokers)
+        bindex = ba.broker_index
+        Bpad = ba.padded
+        keys = sorted(partitions)
+        P = len(keys)
+        infos = [partitions[k] for k in keys]
+
+        rep_counts = np.fromiter((len(i.replicas) for i in infos),
+                                 np.int64, P)
+        total = int(rep_counts.sum())
+        try:
+            rep_idx = np.fromiter((bindex[b] for i in infos
+                                   for b in i.replicas), np.int64, total)
+        except KeyError as e:
+            raise ValueError(
+                f"partition references unknown broker {e.args[0]}"
+            ) from None
+        leader_idx = np.fromiter((bindex.get(i.leader, -1) for i in infos),
+                                 np.int64, P)
+        sizes = np.fromiter((i.size_mb for i in infos), np.float64, P)
+        topic_index: dict[str, int] = {}
+        ptopic_real = np.fromiter(
+            (topic_index.setdefault(t, len(topic_index)) for t, _ in keys),
+            np.int64, P)
+        partition_index = {k: i for i, k in enumerate(keys)}
+
+        R = max(int(rep_counts.max()) if P else 1, 1)
+        Ppad = _round_up(P, 128)
+        sentinel = Bpad
+        rb = np.full((Ppad, R), sentinel, np.int32)
+        if total:
+            rep_rows = np.repeat(np.arange(P), rep_counts)
+            starts = np.concatenate(([0], np.cumsum(rep_counts)[:-1]))
+            rep_cols = np.arange(total) - np.repeat(starts, rep_counts)
+            rb[rep_rows, rep_cols] = rep_idx
+            srt = np.sort(rb[:P], axis=1)
+            dup = (srt[:, 1:] == srt[:, :-1]) & (srt[:, 1:] < sentinel)
+            bad = np.nonzero(dup.any(axis=1))[0]
+            if bad.size:
+                raise ValueError(
+                    f"partition {keys[int(bad[0])]}: duplicate replica "
+                    "brokers")
+
+        # Slot 0 is the leader positionally; leadership diverges from
+        # replicas[0] after failover — rotate leader-first, preserving
+        # the followers' relative (= preferred) order.
+        pref_pos = np.tile(np.arange(R, dtype=np.int32), (Ppad, 1))
+        if P:
+            is_lead = rb[:P] == leader_idx[:, None]
+            pos = is_lead.argmax(axis=1)
+            rot = is_lead.any(axis=1) & (pos != 0)
+            rrows = np.nonzero(rot)[0]
+            if rrows.size:
+                idx = np.arange(R)[None, :]
+                src = np.where((idx >= 1) & (idx <= pos[rrows, None]),
+                               idx - 1, idx)
+                rb[rrows] = np.take_along_axis(rb[rrows], src, axis=1)
+                rb[rrows, 0] = leader_idx[rrows]
+                psrc = src.astype(np.int32)
+                psrc[:, 0] = pos[rrows]
+                pref_pos[rrows] = psrc
+
+        alive_ext = np.append(ba.alive, True)    # sentinel slot never offline
+        offline = np.zeros((Ppad, R), bool)
+        if P:
+            offline[:P] = (rb[:P] < sentinel) & ~alive_ext[rb[:P]]
+        for (t, pi, b) in extra_offline:
+            row = partition_index.get((t, pi))
+            bi = bindex.get(b)
+            if row is None or bi is None:
+                continue
+            offline[row, rb[row] == bi] = True
+
+        # Loads: expected utilization per partition by whole-array gathers
+        # from the dense aggregate (AVG over valid windows for CPU/NW,
+        # LATEST valid window for DISK — see _partition_specs for the
+        # per-metric ValueComputingStrategy rationale).
+        lead_np = np.zeros((P, 4))
+        lead_np[:, 3] = sizes
+        foll_np = None
+        window_times: list[int] = []
+        d = result.dense if result is not None else None
+        if d is not None and d.window_times_ms and P:
+            no_valid = Extrapolation.NO_VALID_EXTRAPOLATION.value
+            hv = (d.extrapolations != no_valid).any(axis=1)
+            erow = np.fromiter((d.row_index.get(k, -1) for k in keys),
+                               np.int64, P)
+            er = np.where(erow >= 0, erow, 0)
+            validw = (d.extrapolations[er] != no_valid) & (erow >= 0)[:, None]
+            nval = validw.sum(axis=1)
+            has = nval > 0
+            vals = d.values[er]                               # [P, M, W]
+            mean = ((vals * validw[:, None, :]).sum(axis=2)
+                    / np.maximum(nval, 1)[:, None])
+            Wn = d.extrapolations.shape[1]
+            last = Wn - 1 - np.argmax(validw[:, ::-1], axis=1)
+            latest = np.take_along_axis(
+                vals, last[:, None, None], axis=2)[:, :, 0]
+            cpu = np.where(has, mean[:, KafkaMetric.CPU_USAGE], 0.0)
+            nw_in = np.where(has, mean[:, KafkaMetric.LEADER_BYTES_IN], 0.0)
+            nw_out = np.where(has, mean[:, KafkaMetric.LEADER_BYTES_OUT],
+                              0.0)
+            disk = np.where(has, latest[:, KafkaMetric.DISK_USAGE], sizes)
+            lead_np = np.column_stack([cpu, nw_in, nw_out, disk])
+            foll_np = np.column_stack([cpu * c.follower_cpu_ratio, nw_in,
+                                       np.zeros(P), disk])
+            if hv.any():
+                window_times = d.window_times_ms
+        if foll_np is None:
+            foll_np = lead_np.copy()
+            foll_np[:, 0] *= c.follower_cpu_ratio
+            foll_np[:, 2] = 0.0
+
+        lead_load = np.zeros((Ppad, 4), np.float32)
+        foll_load = np.zeros((Ppad, 4), np.float32)
+        lead_load[:P] = lead_np
+        foll_load[:P] = foll_np
+        ptopic = np.full(Ppad, -1, np.int32)
+        ptopic[:P] = ptopic_real
+        pvalid = np.zeros(Ppad, bool)
+        pvalid[:P] = True
+
+        model = FlatClusterModel.from_numpy(
+            replica_broker=rb, leader_load=lead_load,
+            follower_load=foll_load, partition_topic=ptopic,
+            partition_valid=pvalid, replica_offline=offline,
+            replica_pref_pos=pref_pos, broker_capacity=ba.capacity,
+            broker_rack=ba.rack, broker_host=ba.host,
+            broker_set=ba.broker_set, broker_alive=ba.alive,
+            broker_new=ba.new, broker_demoted=ba.demoted,
+            broker_broken_disk=ba.broken, broker_valid=ba.valid)
+        from ..model.spec import ClusterMetadata
+        metadata = ClusterMetadata(
+            broker_ids=ba.broker_ids, broker_index=bindex,
+            topics=list(topic_index), topic_index=topic_index,
+            partition_keys=keys, partition_index=partition_index,
+            racks=ba.racks, hosts=ba.hosts, broker_sets=ba.broker_sets)
+
+        def spec_factory():
+            pspecs, _w, _t = self._partition_specs(partitions, alive,
+                                                   result, extra_offline)
+            return ClusterSpec(brokers=brokers, partitions=pspecs)
+
+        def pw_factory():
+            if d is None or not d.window_times_ms or not P:
+                return {}
+            return {k: d.values[r] for k, r in zip(keys, erow)
+                    if r >= 0 and hv[r]}
+
         return ClusterModelResult(
-            model=model, metadata=metadata, spec=spec,
+            model=model, metadata=metadata,
             completeness=(result.completeness if result is not None
-                          else MetricSampleCompleteness(generation=self.generation)),
-            partition_windows=windows, window_times_ms=window_times,
-            generation=self.generation)
+                          else MetricSampleCompleteness(
+                              generation=self.generation)),
+            window_times_ms=window_times, generation=self.generation,
+            spec_factory=spec_factory,
+            partition_windows_factory=pw_factory)
 
     def broker_window_stats(self, now_ms: int) -> dict[int, np.ndarray]:
         """Per-broker [num_metrics, num_valid_windows] aggregates (feeds
@@ -353,10 +596,18 @@ class LoadMonitor:
         a merely-missed sampling round from reading as a metric collapse."""
         try:
             result = self.broker_aggregator.aggregate(
-                0, now_ms, AggregationOptions(min_valid_windows=0))
+                0, now_ms, AggregationOptions(min_valid_windows=0),
+                use_dense=self.config.dense_pipeline)
         except NotEnoughValidWindowsError:
             return {}
         out: dict[int, np.ndarray] = {}
+        if result.dense is not None:
+            valid = (result.dense.extrapolations
+                     != Extrapolation.NO_VALID_EXTRAPOLATION.value)
+            for i, entity in enumerate(result.dense.entities):
+                if valid[i].any():
+                    out[entity] = result.dense.values[i][:, valid[i]]
+            return out
         for entity, vae in result.entity_values.items():
             cols = [j for j, e in enumerate(vae.extrapolations)
                     if e is not Extrapolation.NO_VALID_EXTRAPOLATION]

@@ -226,6 +226,71 @@ class CruiseControlMetricsProcessor:
                 psamples.append(s)
         return Samples(psamples, bsamples)
 
+    def emit_dense(self, prepared: "PreparedRound",
+                   assignment: SamplerAssignment, *,
+                   empty_assignment_means_all: bool = False):
+        """Array-native variant of :meth:`emit` for the dense ingest path.
+
+        Returns ``(entities, times_ms, values)`` parallel arrays ready for
+        ``MetricSampleAggregator.add_samples_dense`` (``values`` is
+        ``[N, num_metrics]`` with NaN marking unset metrics) — the same
+        attribution math as :meth:`emit`, computed as whole-array
+        operations over the prepared groups with no per-sample holder
+        objects. Broker samples stay on the object path (:meth:`emit`);
+        the broker axis is orders of magnitude smaller than the partition
+        axis.
+
+        The default serving path still routes through :meth:`emit`
+        because the sample-store persistence contract consumes
+        ``PartitionMetricSample`` objects; this is the seam for a
+        store-side dense writer to plug into. Attribution parity with
+        :meth:`emit` is pinned by
+        tests/test_monitor.py::test_processor_emit_dense_matches_emit,
+        so the two cannot silently drift."""
+        import numpy as np
+
+        from ..core.metricdef import partition_metric_def
+        if assignment.partitions:
+            wanted = assignment.partitions
+        elif empty_assignment_means_all:
+            wanted = list(prepared.tp_groups)
+        else:
+            wanted = []
+        pairs = [(tp, gkey) for tp in wanted
+                 for gkey in prepared.tp_groups.get(tp, ())]
+        N = len(pairs)
+        M = partition_metric_def().size()
+        values = np.full((N, M), np.nan)
+        if not N:
+            return [], np.empty(0, np.int64), values
+        groups = prepared.groups
+        gid = {gkey: i for i, gkey in enumerate(groups)}
+        garr = np.array([[g.t_in, g.t_out, g.t_msg, g.broker_cpu, g.denom,
+                          g.total_size, g.num_tps, g.time_ms]
+                         for g in groups.values()])
+        pg = np.fromiter((gid[gkey] for _tp, gkey in pairs), np.int64, N)
+        sizes = np.fromiter((groups[gkey].sizes[tp] for tp, gkey in pairs),
+                            np.float64, N)
+        entities = [tp for tp, _gkey in pairs]
+        g = garr[pg]
+        total, num_tps, denom = g[:, 5], g[:, 6], g[:, 4]
+        share = np.where(total > 0,
+                         sizes / np.where(total > 0, total, 1.0),
+                         1.0 / num_tps)
+        p_in = g[:, 0] * share
+        p_out = g[:, 1] * share
+        cpu_share = np.where(denom > 0,
+                             (p_in + p_out) / np.where(denom > 0, denom, 1.0),
+                             0.0)
+        values[:, KafkaMetric.LEADER_BYTES_IN] = p_in
+        values[:, KafkaMetric.LEADER_BYTES_OUT] = p_out
+        values[:, KafkaMetric.DISK_USAGE] = sizes
+        values[:, KafkaMetric.MESSAGE_IN_RATE] = g[:, 2] * share
+        # CPU attribution: broker CPU x partition share of broker leader
+        # bytes (ref ModelUtils.estimateLeaderCpuUtil), as in emit().
+        values[:, KafkaMetric.CPU_USAGE] = g[:, 3] * cpu_share
+        return entities, g[:, 7].astype(np.int64), values
+
     def process(self, assignment: SamplerAssignment) -> Samples:
         """Convert buffered records into samples for the assignment window
         (ref CruiseControlMetricsProcessor.process). Clears the buffer.

@@ -312,6 +312,103 @@ def test_forced_insufficient_extrapolation():
     assert vae2.extrapolations[0] is Extrapolation.NO_VALID_EXTRAPOLATION
 
 
+def test_remove_entities_drops_all_even_after_first_true():
+    """Regression for the remove_entities short-circuit hazard: every
+    entity must be dropped even though the FIRST drop already returns
+    True (an ``any(generator)`` would stop there and leave the rest of
+    the pool populated)."""
+    agg = _agg()
+    entities = [("t1", 0), ("t1", 1), ("t2", 0), ("t2", 1)]
+    for e in entities:
+        agg.add_sample(_sample(e, 100, 1.0))
+    g0 = agg.generation
+    # Ordered set-like input so the first drop succeeds deterministically.
+    agg.remove_entities(dict.fromkeys(entities).keys())
+    assert agg.all_entities() == set()
+    assert agg.generation > g0
+    # Removing nothing (all unknown) must not bump the generation.
+    g1 = agg.generation
+    agg.remove_entities({("nope", 9)})
+    assert agg.generation == g1
+
+
+def _random_aggregator(rng, num_entities, num_windows, min_samples,
+                       sparsity):
+    """Ingest a randomized sample history across sparsity regimes:
+    dense entities, sparse entities (exercising the whole extrapolation
+    ladder), and never-sampled interested entities."""
+    mdef = _metric_def()
+    agg = MetricSampleAggregator(num_windows, WINDOW_MS, min_samples, mdef,
+                                 entity_group_fn=lambda e: e[0])
+    entities = [(f"t{i % 4}", i) for i in range(num_entities)]
+    for w in range(num_windows + 1):
+        for e in entities:
+            # Per-(entity, window) sample count: 0..min_samples+1, biased
+            # down by the sparsity knob.
+            n = int(rng.integers(0, min_samples + 2))
+            if rng.random() < sparsity:
+                n = 0
+            for k in range(n):
+                t = w * WINDOW_MS + 10 + 7 * k
+                agg.add_sample(MetricSample(
+                    entity=e, sample_time_ms=t,
+                    values={m: float(rng.normal(10.0, 4.0))
+                            for m in range(mdef.size())
+                            if rng.random() > 0.1}))
+    # Roll the last stable window out of the in-flight slot.
+    agg.add_sample(_sample(("roll", 0), (num_windows + 1) * WINDOW_MS + 1,
+                           1.0))
+    return agg, entities
+
+
+def test_dense_aggregation_matches_reference_property():
+    """The dense [E, M, W] path must be bit-identical to the retained
+    per-entity reference implementation: values, extrapolation codes,
+    completeness (ratios, valid windows, entity/group sets) and
+    ENTITY_GROUP demotion, across sample-sparsity regimes."""
+    rng = np.random.default_rng(1234)
+    for trial in range(6):
+        min_samples = int(rng.integers(1, 5))
+        sparsity = float(rng.choice([0.0, 0.3, 0.7, 0.95]))
+        agg, entities = _random_aggregator(
+            rng, num_entities=int(rng.integers(5, 25)),
+            num_windows=int(rng.integers(2, 7)),
+            min_samples=min_samples, sparsity=sparsity)
+        for granularity in (AggregationGranularity.ENTITY,
+                            AggregationGranularity.ENTITY_GROUP):
+            opts = AggregationOptions(
+                min_valid_entity_ratio=float(rng.choice([0.0, 0.4, 0.9])),
+                min_valid_entity_group_ratio=float(rng.choice([0.0, 0.5])),
+                min_valid_windows=0,
+                max_allowed_extrapolations_per_entity=int(
+                    rng.integers(0, 4)),
+                granularity=granularity,
+                # Interested set includes a never-sampled entity.
+                interested_entities=set(entities) | {("ghost", 99)})
+            ref = agg.aggregate(0, 10**9, opts, use_dense=False)
+            dense = agg.aggregate(0, 10**9, opts, use_dense=True)
+            ctx = f"trial={trial} gran={granularity} min={min_samples}"
+            assert dense.dense is not None, ctx
+            assert dense.valid_windows == ref.valid_windows, ctx
+            assert dense.invalid_entities == ref.invalid_entities, ctx
+            assert set(dense.entity_values) == set(ref.entity_values), ctx
+            for e in ref.entity_values:
+                rv, dv = ref.entity_values[e], dense.entity_values[e]
+                np.testing.assert_array_equal(dv.values, rv.values,
+                                              err_msg=f"{ctx} entity={e}")
+                assert dv.extrapolations == rv.extrapolations, (ctx, e)
+                assert dv.window_times_ms == rv.window_times_ms, (ctx, e)
+            rc, dc = ref.completeness, dense.completeness
+            assert dc.valid_windows == rc.valid_windows, ctx
+            assert dc.valid_entity_ratio_by_window == \
+                rc.valid_entity_ratio_by_window, ctx
+            assert dc.valid_entity_group_ratio_by_window == \
+                rc.valid_entity_group_ratio_by_window, ctx
+            assert dc.valid_entities == rc.valid_entities, ctx
+            assert dc.valid_entity_groups == rc.valid_entity_groups, ctx
+            assert dc.num_total_entities == rc.num_total_entities, ctx
+
+
 def test_extrapolation_budget_not_burned_by_hopeless_windows():
     """Windows that end NO_VALID_EXTRAPOLATION never consume the
     extrapolation budget — a later salvageable window must still get its

@@ -1,6 +1,7 @@
-"""bench.py's crash handling: only transport/tunnel deaths may fall back
-to the CPU-pinned retry — deterministic failures (quality gate, hard-goal
-check) must stay loud TPU failures (BENCH artifact honesty)."""
+"""bench.py gates: crash-handling honesty (only transport/tunnel deaths
+may fall back to the CPU-pinned retry — deterministic failures like the
+quality gate must stay loud TPU failures) and a tier-1-safe smoke run of
+the dense monitor→model pipeline bench."""
 
 import sys
 
@@ -24,3 +25,17 @@ def test_transport_death_gate():
                 "bad sampler config: connection pool size must be > 0",
                 "invalid connection string in properties file"):
         assert not bench._is_transport_death(RuntimeError(msg)), msg
+
+
+def test_model_build_bench_smoke_gate():
+    """run_model_build_bench on a small cluster: exercises the dense
+    monitor→model path end-to-end and its built-in dense/legacy parity
+    gate (a model mismatch raises inside the helper). Tier-1 safe: no
+    wall-clock assertion — the ≥5x acceptance bar is judged at bench
+    scale (100x20k), not on a 4-broker toy."""
+    import bench
+    out = bench.run_model_build_bench(num_brokers=4, num_partitions=96,
+                                      emit_row=False, repeats=1)
+    assert out["partitions"] == 96
+    assert out["dense_s"] > 0 and out["legacy_s"] > 0
+    assert out["speedup"] is not None

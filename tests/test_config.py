@@ -125,6 +125,16 @@ def test_reference_constant_coverage():
         assert key in names, key
 
 
+def test_monitor_dense_pipeline_config_wiring():
+    """monitor.dense.pipeline selects the dense whole-pool monitor→model
+    path (default) vs the retained per-entity reference path."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    assert CruiseControlConfig({}).monitor_config().dense_pipeline is True
+    assert CruiseControlConfig(
+        {"monitor.dense.pipeline": "false"}
+    ).monitor_config().dense_pipeline is False
+
+
 def test_executor_config_wiring():
     from cruise_control_tpu.config.constants import CruiseControlConfig
     cfg = CruiseControlConfig({

@@ -612,6 +612,105 @@ def test_min_valid_partition_ratio_gates_default_model_builds():
     assert res.model is not None
 
 
+def _monitor_pair(sim):
+    """Two monitors over the same cluster: dense pipeline vs the retained
+    per-entity reference path."""
+    mk = lambda dense: LoadMonitor(sim, MonitorConfig(
+        num_windows=4, window_ms=WINDOW_MS, min_samples_per_window=1,
+        num_broker_windows=4, broker_window_ms=WINDOW_MS,
+        dense_pipeline=dense))
+    return mk(True), mk(False)
+
+
+def test_dense_pipeline_matches_reference_model():
+    """The dense monitor→model path (whole-array gathers from the dense
+    aggregate) must produce the same flat model, metadata, windows and
+    spec as the per-partition reference path — including leader-first
+    rotation after failover and offline marks from a dead broker."""
+    sim = make_cluster(num_brokers=4, partitions=16)
+    dense_m, legacy_m = _monitor_pair(sim)
+    for m in (dense_m, legacy_m):
+        sample_windows(m, sim, 4)
+    # Failover: killing broker 0 re-elects leaders away from replicas[0]
+    # for the partitions it led — exercising the rotation path — and
+    # marks its replicas offline.
+    sim.kill_broker(0)
+    dense = dense_m.cluster_model(4 * WINDOW_MS)
+    legacy = legacy_m.cluster_model(4 * WINDOW_MS)
+    for name in ("replica_broker", "leader_load", "follower_load",
+                 "partition_topic", "partition_valid", "replica_offline",
+                 "replica_pref_pos", "broker_capacity", "broker_rack",
+                 "broker_host", "broker_set", "broker_alive",
+                 "broker_valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(dense.model, name)),
+            np.asarray(getattr(legacy.model, name)), err_msg=name)
+    assert dense.metadata.partition_keys == legacy.metadata.partition_keys
+    assert dense.metadata.topics == legacy.metadata.topics
+    assert dense.metadata.broker_ids == legacy.metadata.broker_ids
+    # Rotation actually happened somewhere (a failed-over leader).
+    assert (np.asarray(dense.model.replica_pref_pos)[
+        np.asarray(dense.model.partition_valid)] != 0).any()
+    # Window views and completeness match.
+    assert set(dense.partition_windows) == set(legacy.partition_windows)
+    for tp in legacy.partition_windows:
+        np.testing.assert_array_equal(dense.partition_windows[tp],
+                                      legacy.partition_windows[tp])
+    assert dense.window_times_ms == legacy.window_times_ms
+    assert (dense.completeness.valid_entities
+            == legacy.completeness.valid_entities)
+
+
+def test_dense_pipeline_lazy_spec_matches_reference():
+    """result.spec on the dense pipeline is built lazily but must be
+    equivalent to the eagerly-built reference spec."""
+    sim = make_cluster()
+    dense_m, legacy_m = _monitor_pair(sim)
+    for m in (dense_m, legacy_m):
+        sample_windows(m, sim, 4)
+    dense = dense_m.cluster_model(4 * WINDOW_MS)
+    legacy = legacy_m.cluster_model(4 * WINDOW_MS)
+    assert dense._spec is None          # not built until asked
+    ds = {(p.topic, p.partition): p for p in dense.spec.partitions}
+    ls = {(p.topic, p.partition): p for p in legacy.spec.partitions}
+    assert set(ds) == set(ls)
+    for k in ls:
+        assert list(ds[k].replicas) == list(ls[k].replicas), k
+        assert tuple(ds[k].leader_load) == tuple(ls[k].leader_load), k
+        assert list(ds[k].offline_replicas) == list(ls[k].offline_replicas)
+    assert [b.broker_id for b in dense.spec.brokers] == \
+        [b.broker_id for b in legacy.spec.brokers]
+
+
+def test_processor_emit_dense_matches_emit():
+    """emit_dense (the array-native shard emission) must attribute
+    exactly what emit() puts into PartitionMetricSample objects — same
+    entities, times, and values, NaN where a metric is unset."""
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    sim, transport, agents = _agent_stack()
+    t = WINDOW_MS - 2
+    for a in agents:
+        a.maybe_report(t)
+    proc = CruiseControlMetricsProcessor(sim)
+    proc.add_metrics(transport.poll(t - 1, t + 1))
+    prepared = proc.prepare(t - 1, t + 1)
+    assignment = SamplerAssignment(
+        partitions=sorted(sim.describe_partitions()), brokers=[],
+        start_ms=t - 1, end_ms=t + 1)
+    obj = proc.emit(prepared, assignment, include_brokers=False)
+    entities, times, values = proc.emit_dense(prepared, assignment)
+    assert entities == [s.entity for s in obj.partition_samples]
+    assert times.tolist() == [s.time_ms for s in obj.partition_samples]
+    M = partition_metric_def().size()
+    for i, s in enumerate(obj.partition_samples):
+        for m in range(M):
+            if m in s.values:
+                assert values[i, m] == s.values[m], (s.entity, m)
+            else:
+                assert np.isnan(values[i, m]), (s.entity, m)
+    assert len(entities) == len(assignment.partitions)
+
+
 def test_fetcher_retries_transient_sampler_failures():
     """fetch.metric.samples.max.retry.count: a sampler that fails twice
     then succeeds completes the round with max_retries=2 (each attempt
