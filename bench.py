@@ -180,6 +180,60 @@ def run_model_build_bench(num_brokers: int = NUM_BROKERS,
             "partitions": P}
 
 
+def run_tracer_overhead_bench(num_brokers: int = 50,
+                              num_partitions: int = 5_000, *,
+                              goal_names: list | None = None,
+                              repeats: int = 5, emit_row: bool = True,
+                              gate: bool = True) -> dict:
+    """Span-tracer overhead on the warm propose path: optimize wall-clock
+    with the tracer enabled vs disabled (disabled = the PR-2 pipeline
+    shape). Best-of-``repeats`` per mode to shed scheduler noise. Gate:
+    enabled must stay within 2% of disabled — tracing that taxes the hot
+    path defeats its purpose and fails the bench loudly."""
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.core.tracing import default_tracer
+    model, md = build_flat_direct(num_brokers, num_partitions, RF)
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names or GOALS),
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=256))
+    run_opts = dict(skip_hard_goal_check=True)
+    opt.optimize(model, md, OptimizationOptions(seed=0, **run_opts))  # warm
+    tracer = default_tracer()
+
+    def best_of(enabled: bool) -> float:
+        tracer.enabled = enabled
+        t_best = float("inf")
+        for _ in range(repeats):
+            t0 = time.monotonic()
+            opt.optimize(model, md, OptimizationOptions(seed=1, **run_opts))
+            t_best = min(t_best, time.monotonic() - t0)
+        return t_best
+
+    try:
+        disabled_s = best_of(False)
+        enabled_s = best_of(True)
+    finally:
+        tracer.enabled = True
+    overhead_pct = ((enabled_s - disabled_s) / disabled_s * 100.0
+                    if disabled_s > 0 else 0.0)
+    log(f"tracer overhead ({num_brokers}x{num_partitions}): enabled "
+        f"{enabled_s:.3f}s disabled {disabled_s:.3f}s "
+        f"({overhead_pct:+.2f}%)")
+    if gate and overhead_pct > 2.0:
+        raise RuntimeError(
+            f"tracer overhead gate: {overhead_pct:.2f}% > 2% "
+            f"(enabled {enabled_s:.3f}s vs disabled {disabled_s:.3f}s)")
+    if emit_row:
+        emit("tracer_overhead_propose_path_pct",
+             round(max(overhead_pct, 0.0), 3), "%", None)
+    return {"enabled_s": enabled_s, "disabled_s": disabled_s,
+            "overhead_pct": overhead_pct}
+
+
 def build_spec():
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
                                                PartitionSpec)
@@ -626,6 +680,8 @@ def main():
     # Host-side monitor→model stage: dense whole-pool pipeline vs the
     # per-entity reference path, emitted alongside the search metric.
     run_model_build_bench()
+    # Observability tax: the span tracer must be ~free on the propose path.
+    run_tracer_overhead_bench()
     t0 = time.monotonic()
     spec = build_spec()
     model, md = flatten_spec(spec)

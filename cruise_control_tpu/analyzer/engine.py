@@ -58,12 +58,15 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
                    all_goals: Sequence[GoalKernel] | None = None):
     """Build the jittable single-goal optimization pass.
 
-    Returns ``run(state, ctx, key) -> (state, iters, violations)`` where
-    ``violations`` is the post-pass residual stack over ``all_goals`` —
-    computed inside the same jit so the host never pays a separate
-    dispatch for the goal-boundary readings the reference records at
-    ``GoalOptimizer.java:458-497``. ``prev_goals`` are baked in at trace
-    time (the goal chain is static configuration)."""
+    Returns ``run(state, ctx, key) -> (state, iters, violations, moves)``
+    where ``violations`` is the post-pass residual stack over
+    ``all_goals`` and ``moves`` the cumulative ``state.moves_applied``
+    boundary — both computed inside the same jit so the host never pays a
+    separate dispatch for the goal-boundary readings the reference
+    records at ``GoalOptimizer.java:458-497`` (the moves boundary is what
+    lets per-goal candidate-acceptance telemetry ride the existing
+    end-of-chain fetch with zero extra syncs). ``prev_goals`` are baked
+    in at trace time (the goal chain is static configuration)."""
 
     eps = cfg.epsilon
     G = cfg.apply_groups
@@ -174,7 +177,7 @@ def make_goal_pass(goal: GoalKernel, prev_goals: Sequence[GoalKernel],
 
         state, iters = jax.lax.cond(active, _optimize, _skip, state)
         stack = violation_stack(all_goals or [goal], state, ctx)
-        return state, iters, stack
+        return state, iters, stack, state.moves_applied
 
     def _run_active(state: SearchState, ctx: SearchContext, key: jax.Array):
         patience = cfg.stall_patience
@@ -253,7 +256,7 @@ def make_chain_step(goals: Sequence[GoalKernel], cfg: SearchConfig):
     def step(state, ctx, key):
         stack = None
         for i, p in enumerate(passes):
-            state, _, stack = p(state, ctx, jax.random.fold_in(key, i))
+            state, _, stack, _ = p(state, ctx, jax.random.fold_in(key, i))
         return state, stack
 
     return step
@@ -310,14 +313,17 @@ class CompiledGoalChain:
         spreads over G dispatches. Key folding matches the per-goal walk
         exactly (fold_in(key, i)), so both paths produce identical moves.
         Returns (state, aux, i32[G] per-goal iters, f32[G, G] boundary
-        stacks — row i is the violation stack after goal i)."""
+        stacks — row i is the violation stack after goal i, i32[G]
+        cumulative moves-applied boundaries)."""
         aux = self._aux_impl(state, ctx)
-        iters, bounds = [], []
+        iters, bounds, moves = [], [], []
         for i, run in enumerate(self._pass_fns):
-            state, it, stack = run(state, ctx, jax.random.fold_in(key, i))
+            state, it, stack, m = run(state, ctx, jax.random.fold_in(key, i))
             iters.append(it)
             bounds.append(stack)
-        return state, aux, jnp.stack(iters), jnp.stack(bounds)
+            moves.append(m)
+        return state, aux, jnp.stack(iters), jnp.stack(bounds), \
+            jnp.stack(moves)
 
     @staticmethod
     def _shape_key(*trees) -> tuple:

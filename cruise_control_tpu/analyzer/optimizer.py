@@ -41,6 +41,10 @@ class GoalResult:
     #: integer-count goals, whose arithmetic is exact) — see
     #: GoalKernel.violation_scale
     scale: float = 0.0
+    #: candidate actions this goal's pass actually applied (the
+    #: moves_applied delta at the goal boundary, riding the end-of-chain
+    #: fetch; 0 on the branched path where boundaries are unobservable)
+    accepted: int = 0
 
     @property
     def satisfied(self) -> bool:
@@ -57,6 +61,7 @@ class GoalResult:
                 "violationAfter": self.violation_after,
                 "optimizationDurationMs": round(self.duration_s * 1e3, 3),
                 "iterations": self.iterations,
+                "acceptedMoves": self.accepted,
                 "status": "NO-ACTION" if self.violation_before <= 1e-6
                 else ("FIXED" if self.satisfied else "VIOLATED")}
 
@@ -80,6 +85,11 @@ class OptimizerResult:
     #: registered hard goal, when the audit is skipped
     #: (skip_hard_goal_check) or per-goal waived (waived_hard_goals).
     hard_goal_audit: list[GoalResult] = field(default_factory=list)
+    #: device-side search telemetry collected from the SearchState
+    #: boundaries riding the end-of-chain host fetch (no extra syncs):
+    #: per-goal iteration/acceptance counts and the whole-chain violation
+    #: trajectory. None on paths that cannot observe boundaries (branched).
+    telemetry: dict | None = None
 
     @property
     def violated_goals_before(self) -> list[str]:
@@ -110,6 +120,7 @@ class OptimizerResult:
                 "violatedGoalsAfter": self.violated_goals_after,
                 "proposals": [p.to_json() for p in self.proposals],
                 "optimizationDurationMs": round(self.duration_s * 1e3, 3),
+                "searchTelemetry": self.telemetry,
                 "provisionResponse": (None if self.provision_response is None
                                       else self.provision_response.to_json())}
 
@@ -137,15 +148,16 @@ def _walk_passes(chain, idxs, state, ctx, keys, on_start=None):
     timestamps and hence per-pass durations; the first pass's reading
     absorbs the dispatch loop itself. ``on_start(j)`` fires at execution
     (not dispatch) order so OperationProgress tracks the pass actually
-    running. Returns ``(state, [(iters, stack), ...] fetched to host,
-    [duration_s, ...])``."""
+    running. Returns ``(state, [(iters, stack, moves), ...] fetched to
+    host, [duration_s, ...])`` — ``moves`` is the cumulative
+    moves_applied boundary feeding per-goal acceptance telemetry."""
     dispatched = []
     for i, k in zip(idxs, keys):
-        state, iters, stack = chain.passes[i](state, ctx, k)
-        dispatched.append((iters, stack))
+        state, iters, stack, moves = chain.passes[i](state, ctx, k)
+        dispatched.append((iters, stack, moves))
     t0 = time.monotonic()
     times = []
-    for j, (_, stack) in enumerate(dispatched):
+    for j, (_, stack, _) in enumerate(dispatched):
         if on_start is not None:
             on_start(j)
         jax.block_until_ready(stack)
@@ -166,8 +178,10 @@ class TpuGoalOptimizer:
                  registry=None,
                  mesh=None,
                  branches: int = 0,
-                 hard_goal_names: list[str] | None = None):
+                 hard_goal_names: list[str] | None = None,
+                 tracer=None):
         from ..core.sensors import (GOAL_OPTIMIZER_SENSOR, MetricRegistry)
+        from ..core.tracing import default_tracer
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
@@ -208,6 +222,9 @@ class TpuGoalOptimizer:
         self._chains_lock = threading.Lock()
         self._audit_fns: dict[tuple, object] = {}
         self.registry = registry or MetricRegistry()
+        #: span tracer threading the whole pipeline (None = the shared
+        #: process-wide default, like the reference's single registry)
+        self.tracer = tracer or default_tracer()
         # ref GoalOptimizer.java:128 proposal-computation-timer.
         self._proposal_timer = self.registry.timer(MetricRegistry.name(
             GOAL_OPTIMIZER_SENSOR, "proposal-computation-timer"))
@@ -383,9 +400,22 @@ class TpuGoalOptimizer:
         each goal pass begins (the facade feeds OperationProgress with it —
         ref the ``OptimizationForGoal`` steps in /user_tasks)."""
         options = options or OptimizationOptions()
+        with self.tracer.span("optimizer.optimize",
+                              brokers=metadata.num_brokers,
+                              partitions=metadata.num_partitions) as root:
+            result = self._optimize_impl(model, metadata, options,
+                                         on_goal_start)
+            root.set(moves=result.num_moves, proposals=len(result.proposals))
+            return result
+
+    def _optimize_impl(self, model: FlatClusterModel,
+                       metadata: ClusterMetadata,
+                       options: OptimizationOptions,
+                       on_goal_start) -> OptimizerResult:
         t0 = time.monotonic()
-        cfg, goals, chain, ctx, state, audit = self._prepare(model, metadata,
-                                                             options)
+        with self.tracer.span("optimizer.prepare"):
+            cfg, goals, chain, ctx, state, audit = self._prepare(
+                model, metadata, options)
         key = jax.random.PRNGKey(options.seed)
         # Off-chain hard-goal audit, initial reading: dispatched before any
         # donating pass touches the state buffer (same ordering argument as
@@ -406,7 +436,8 @@ class TpuGoalOptimizer:
                                            goals, chain, ctx, state, key,
                                            t0, on_goal_start,
                                            audit, audit_fn, audit_before)
-        chain.warmup(state, ctx, key)
+        with self.tracer.span("optimizer.warmup"):
+            chain.warmup(state, ctx, key)
 
         # One violation stack per goal boundary: stack[i] before goal i runs
         # doubles as stack[j<i] "after" readings (matches the per-goal stats
@@ -419,40 +450,50 @@ class TpuGoalOptimizer:
         # for small models behind a high-latency transport. Pre-pass
         # readings (broken-broker flag, per-goal rounding scales, initial
         # violation stack) ride one fused aux dispatch for the same reason.
-        if cfg.fused_chain:
-            # One device dispatch + one host fetch for the entire chain
-            # (latency-bound serving: demo clusters, self-healing replans
-            # over a tunneled device). Key folding inside the fused
-            # program matches the per-goal walk, so the MAIN walk's moves
-            # are identical across modes; if residuals survive into
-            # polish, the modes diverge there (fused polish re-runs the
-            # whole chain under a distinct PRNG stream, per-goal polish
-            # re-runs only the unconverged subset) — both land on valid
-            # converged plans, just not bit-identical ones.
-            if on_goal_start is not None:
-                # One program = no observable per-goal boundaries: report
-                # ONE truthful step for the whole fused walk instead of
-                # pretending every goal started at t=0 (the per-goal path
-                # reports steps at real execution boundaries).
-                on_goal_start(f"FusedChain[{len(goals)}]")
-            t_walk = time.monotonic()
-            state, aux, iters_arr, bounds = chain.fused(state, ctx, key)
-            (has_broken_raw, scales_arr, v0), iters_np, bounds_np = \
-                jax.device_get((aux, iters_arr, bounds))
-            walk_s = time.monotonic() - t_walk
-            # Per-goal wall-clock is unobservable inside one program;
-            # attribute the fused walk proportionally to iteration counts.
-            total_iters = max(int(iters_np.sum()), 1)
-            durations = [walk_s * int(it) / total_iters for it in iters_np]
-            fetched = list(zip(iters_np, bounds_np))
-        else:
-            aux = chain.aux(state, ctx)
-            state, fetched, durations = _walk_passes(
-                chain, range(len(goals)), state, ctx,
-                [jax.random.fold_in(key, i) for i in range(len(goals))],
-                on_start=(None if on_goal_start is None
-                          else lambda j: on_goal_start(goals[j].name)))
-            has_broken_raw, scales_arr, v0 = jax.device_get(aux)
+        walk_span = self.tracer.span(
+            "optimizer.walk", mode="fused" if cfg.fused_chain else "per-goal",
+            goals=len(goals))
+        with walk_span:
+            if cfg.fused_chain:
+                # One device dispatch + one host fetch for the entire chain
+                # (latency-bound serving: demo clusters, self-healing
+                # replans over a tunneled device). Key folding inside the
+                # fused program matches the per-goal walk, so the MAIN
+                # walk's moves are identical across modes; if residuals
+                # survive into polish, the modes diverge there (fused
+                # polish re-runs the whole chain under a distinct PRNG
+                # stream, per-goal polish re-runs only the unconverged
+                # subset) — both land on valid converged plans, just not
+                # bit-identical ones.
+                if on_goal_start is not None:
+                    # One program = no observable per-goal boundaries:
+                    # report ONE truthful step for the whole fused walk
+                    # instead of pretending every goal started at t=0 (the
+                    # per-goal path reports steps at real execution
+                    # boundaries).
+                    on_goal_start(f"FusedChain[{len(goals)}]")
+                t_walk = time.monotonic()
+                state, aux, iters_arr, bounds, moves_arr = chain.fused(
+                    state, ctx, key)
+                (has_broken_raw, scales_arr, v0), iters_np, bounds_np, \
+                    moves_np = jax.device_get((aux, iters_arr, bounds,
+                                               moves_arr))
+                walk_s = time.monotonic() - t_walk
+                # Per-goal wall-clock is unobservable inside one program;
+                # attribute the fused walk proportionally to iteration
+                # counts.
+                total_iters = max(int(iters_np.sum()), 1)
+                durations = [walk_s * int(it) / total_iters
+                             for it in iters_np]
+                fetched = list(zip(iters_np, bounds_np, moves_np))
+            else:
+                aux = chain.aux(state, ctx)
+                state, fetched, durations = _walk_passes(
+                    chain, range(len(goals)), state, ctx,
+                    [jax.random.fold_in(key, i) for i in range(len(goals))],
+                    on_start=(None if on_goal_start is None
+                              else lambda j: on_goal_start(goals[j].name)))
+                has_broken_raw, scales_arr, v0 = jax.device_get(aux)
         # ref AbstractGoal.java:110-119: the "never worsen" assertion only
         # runs when brokenBrokers.isEmpty() — a dead-broker drain's
         # must-moves (remove_brokers, fix_offline_replicas, self-healing)
@@ -462,9 +503,18 @@ class TpuGoalOptimizer:
         scales = [float(s) for s in scales_arr]
         goal_results: list[GoalResult] = []
         boundary = np.asarray(v0)
-        for i, (goal, (iters, stack)) in enumerate(zip(goals, fetched)):
+        #: whole-chain violation trajectory — row 0 is the initial stack,
+        #: row i+1 the stack after goal i's pass (all fetched with the
+        #: walk; polish rounds append further rows below).
+        trajectory: list[list[float]] = [[float(x) for x in boundary]]
+        prev_moves = 0
+        for i, (goal, (iters, stack, moves)) in enumerate(zip(goals,
+                                                              fetched)):
             before_i = float(boundary[i])
             boundary = np.asarray(stack)
+            trajectory.append([float(x) for x in boundary])
+            accepted_i = int(moves) - prev_moves
+            prev_moves = int(moves)
             after_i = float(boundary[i])
             # Self-check (ref AbstractGoal.java:110-119: the optimization
             # "stats should not be worse" assertion): a goal pass may never
@@ -490,7 +540,22 @@ class TpuGoalOptimizer:
                 violation_after=after_i,
                 duration_s=durations[i],
                 iterations=int(iters),
-                scale=scales[i]))
+                scale=scales[i],
+                accepted=accepted_i))
+
+        # Per-goal child spans of the walk, reconstructed from the
+        # single-sync duration list (fused mode: proportional attribution
+        # by iteration count) — no extra device reads, just bookkeeping.
+        off = walk_span.start_s
+        for gr in goal_results:
+            self.tracer.record(
+                f"goal.{gr.name}", gr.duration_s, start_s=off,
+                parent_id=walk_span.span_id,
+                attrs={"iterations": gr.iterations,
+                       "accepted": gr.accepted,
+                       "violationBefore": round(gr.violation_before, 6),
+                       "violationAfter": round(gr.violation_after, 6)})
+            off += gr.duration_s
 
         # Polish passes: later goals' accepted actions may have drifted
         # earlier goals within the acceptance tolerances; re-running the
@@ -504,6 +569,7 @@ class TpuGoalOptimizer:
         # so a goal can never be skipped as converged yet reported
         # VIOLATED.
         polish_eps = min(cfg.epsilon, 1e-6)
+        moves_total = prev_moves
         # +1: skip decisions use each round's *starting* boundary (so the
         # whole round dispatches async with one fetch — a per-goal host
         # sync is what the async walk exists to avoid), which means drift
@@ -515,45 +581,60 @@ class TpuGoalOptimizer:
         for rnd in range(cfg.polish_passes + 1 if cfg.polish_passes else 0):
             if (boundary <= polish_eps).all():
                 break
-            if cfg.fused_chain:
-                # Fused mode never touches the per-goal programs (they
-                # would each pay an XLA compile on first use — a latency
-                # spike on exactly the latency-bound path fused serves):
-                # a polish round is one more fused whole-chain dispatch;
-                # converged goals cost one violation read each (the
-                # engine's lax.cond early exit).
-                tp0 = time.monotonic()
-                state, _aux2, it2, b2 = chain.fused(
-                    state, ctx, jax.random.fold_in(key, 50_000 + rnd))
-                it2, b2 = jax.device_get((it2, b2))
-                w = time.monotonic() - tp0
-                tot = max(int(it2.sum()), 1)
-                boundary = np.asarray(b2[-1])
-                for i, gr in enumerate(goal_results):
+            with self.tracer.span("optimizer.polish", round=rnd):
+                if cfg.fused_chain:
+                    # Fused mode never touches the per-goal programs (they
+                    # would each pay an XLA compile on first use — a
+                    # latency spike on exactly the latency-bound path
+                    # fused serves): a polish round is one more fused
+                    # whole-chain dispatch; converged goals cost one
+                    # violation read each (the engine's lax.cond early
+                    # exit).
+                    tp0 = time.monotonic()
+                    state, _aux2, it2, b2, m2 = chain.fused(
+                        state, ctx, jax.random.fold_in(key, 50_000 + rnd))
+                    it2, b2, m2 = jax.device_get((it2, b2, m2))
+                    w = time.monotonic() - tp0
+                    tot = max(int(it2.sum()), 1)
+                    boundary = np.asarray(b2[-1])
+                    trajectory.append([float(x) for x in boundary])
+                    prev = moves_total
+                    for i, gr in enumerate(goal_results):
+                        acc = int(m2[i]) - prev
+                        prev = int(m2[i])
+                        goal_results[i] = replace(
+                            gr,
+                            duration_s=gr.duration_s + w * int(it2[i]) / tot,
+                            iterations=gr.iterations + int(it2[i]),
+                            accepted=gr.accepted + acc)
+                    moves_total = prev
+                    continue
+                todo = [i for i in range(len(goals))
+                        if not (boundary[i] <= polish_eps)]
+                state, fetched, durations = _walk_passes(
+                    chain, todo, state, ctx,
+                    [jax.random.fold_in(key, 1000 * (rnd + 1) + i)
+                     for i in todo])
+                for j, (i, (iters, stack, moves)) in enumerate(zip(todo,
+                                                                   fetched)):
+                    boundary = np.asarray(stack)
+                    gr = goal_results[i]
+                    acc = int(moves) - moves_total
+                    moves_total = int(moves)
                     goal_results[i] = replace(
-                        gr, duration_s=gr.duration_s + w * int(it2[i]) / tot,
-                        iterations=gr.iterations + int(it2[i]))
-                continue
-            todo = [i for i in range(len(goals))
-                    if not (boundary[i] <= polish_eps)]
-            state, fetched, durations = _walk_passes(
-                chain, todo, state, ctx,
-                [jax.random.fold_in(key, 1000 * (rnd + 1) + i)
-                 for i in todo])
-            for j, (i, (iters, stack)) in enumerate(zip(todo, fetched)):
-                boundary = np.asarray(stack)
-                gr = goal_results[i]
-                goal_results[i] = replace(
-                    gr, violation_after=float(boundary[i]),
-                    duration_s=gr.duration_s + durations[j],
-                    iterations=gr.iterations + int(iters))
+                        gr, violation_after=float(boundary[i]),
+                        duration_s=gr.duration_s + durations[j],
+                        iterations=gr.iterations + int(iters),
+                        accepted=gr.accepted + acc)
+                trajectory.append([float(x) for x in boundary])
 
         # The boundary stack is the ground truth for final residuals; a
         # goal's stored reading can be stale if a later pass moved it.
         goal_results = [replace(gr, violation_after=float(boundary[i]))
                         for i, gr in enumerate(goal_results)]
         return self._finish(model, metadata, options, state, goal_results,
-                            t0, ctx, audit, audit_fn, audit_before)
+                            t0, ctx, audit, audit_fn, audit_before,
+                            trajectory=trajectory)
 
     def _optimize_branched(self, model, metadata, options, cfg, goals,
                            chain, ctx, state, key, t0, on_goal_start,
@@ -575,16 +656,21 @@ class TpuGoalOptimizer:
             on_goal_start(f"BranchedChain[{len(goals)}x{self.branches}]")
         aux = chain.aux(state, ctx)
         run = self._branched_run_for(cfg, goals)
-        t_walk = time.monotonic()
-        states, viols = run(state, ctx, key)
-        if audit_fn is not None:
-            # The off-chain hard-goal audit dominates branch selection:
-            # without this, the chain-lexicographic winner could fail the
-            # gate while an audit-passing plan existed in the same run.
-            state, best_idx, vbest = select_best_audited(
-                states, viols, lambda s: audit_fn(s, ctx))
-        else:
-            state, best_idx, vbest = select_best(states, viols)
+        with self.tracer.span("optimizer.walk", mode="branched",
+                              branches=self.branches,
+                              goals=len(goals)) as walk_span:
+            t_walk = time.monotonic()
+            states, viols = run(state, ctx, key)
+            if audit_fn is not None:
+                # The off-chain hard-goal audit dominates branch selection:
+                # without this, the chain-lexicographic winner could fail
+                # the gate while an audit-passing plan existed in the same
+                # run.
+                state, best_idx, vbest = select_best_audited(
+                    states, viols, lambda s: audit_fn(s, ctx))
+            else:
+                state, best_idx, vbest = select_best(states, viols)
+            walk_span.set(winner=int(best_idx))
         walk_s = time.monotonic() - t_walk
         _has_broken, scales_arr, v0 = jax.device_get(aux)
         v0 = np.asarray(v0)
@@ -612,31 +698,37 @@ class TpuGoalOptimizer:
                             t0, ctx, audit, audit_fn, audit_before)
 
     def _finish(self, model, metadata, options, state, goal_results, t0,
-                ctx=None, audit=(), audit_fn=None, audit_before=None):
-        audit_results: list[GoalResult] = []
-        if audit_fn is not None:
-            t_a = time.monotonic()
-            (v_after, scales), (v_before, _) = jax.device_get(
-                (audit_fn(state, ctx), audit_before))
-            audit_s = (time.monotonic() - t_a) / max(len(audit), 1)
-            audit_results = [
-                GoalResult(name=g.name, hard=True,
-                           violation_before=float(v_before[i]),
-                           violation_after=float(v_after[i]),
-                           duration_s=audit_s, iterations=0,
-                           scale=float(scales[i]))
-                for i, g in enumerate(audit)]
-        final = to_model(state, model)
-        proposals = diff_proposals(model, final, metadata)
+                ctx=None, audit=(), audit_fn=None, audit_before=None,
+                trajectory=None):
+        with self.tracer.span("optimizer.finish") as fin:
+            audit_results: list[GoalResult] = []
+            if audit_fn is not None:
+                t_a = time.monotonic()
+                (v_after, scales), (v_before, _) = jax.device_get(
+                    (audit_fn(state, ctx), audit_before))
+                audit_s = (time.monotonic() - t_a) / max(len(audit), 1)
+                audit_results = [
+                    GoalResult(name=g.name, hard=True,
+                               violation_before=float(v_before[i]),
+                               violation_after=float(v_after[i]),
+                               duration_s=audit_s, iterations=0,
+                               scale=float(scales[i]))
+                    for i, g in enumerate(audit)]
+            final = to_model(state, model)
+            proposals = diff_proposals(model, final, metadata)
+            num_moves = int(jax.device_get(state.moves_applied))
+            fin.set(proposals=len(proposals), moves=num_moves)
         duration_s = time.monotonic() - t0
         # ref GoalOptimizer.java:183 _proposalComputationTimer.update.
         self._proposal_timer.update(duration_s)
         result = OptimizerResult(
             proposals=proposals, goal_results=goal_results,
-            num_moves=int(jax.device_get(state.moves_applied)),
+            num_moves=num_moves,
             duration_s=duration_s, final_model=final,
             provision_response=self._provision_verdict(final, goal_results),
-            hard_goal_audit=audit_results)
+            hard_goal_audit=audit_results,
+            telemetry=self._record_goal_telemetry(goal_results, trajectory,
+                                                  num_moves))
         if result.violated_hard_goals and not options.skip_hard_goal_check:
             in_chain = {g.name for g in goal_results
                         if g.hard and not g.satisfied}
@@ -648,6 +740,52 @@ class TpuGoalOptimizer:
                 f"hard goals still violated after optimization: "
                 f"{result.violated_hard_goals}{detail}", result)
         return result
+
+    def _record_goal_telemetry(self, goal_results, trajectory,
+                               num_moves) -> dict | None:
+        """Surface the device-side search telemetry: per-goal Prometheus
+        series on the optimizer registry (a summary for durations, plain
+        counters for iteration/acceptance totals) and the structured
+        ``OptimizerResult.telemetry`` payload. Every number here came off
+        the device with the chain walk's existing end-of-chain fetch —
+        this method touches no device arrays.
+
+        ``trajectory is None`` marks a path whose goal boundaries are
+        structurally unobservable (the branched shard_map walk): the
+        duration summaries still update (wall-clock attribution is real),
+        but no telemetry payload is returned and the zero-valued
+        iteration/acceptance counters are left untouched — a dict full of
+        zeros would silently break the ``sum(accepted) == totalMoves``
+        invariant consumers rely on."""
+        from ..core.sensors import GOAL_OPTIMIZER_SENSOR, MetricRegistry
+        observable = trajectory is not None
+        for g in goal_results:
+            base = MetricRegistry.name(GOAL_OPTIMIZER_SENSOR,
+                                       f"goal-{g.name}")
+            self.registry.timer(
+                f"{base}-optimization-timer").update(g.duration_s)
+            if observable:
+                self.registry.counter(
+                    f"{base}-iterations").inc(g.iterations)
+                self.registry.counter(
+                    f"{base}-accepted-moves").inc(g.accepted)
+        if not observable:
+            return None
+        return {
+            "perGoal": [{"goal": g.name,
+                         "iterations": g.iterations,
+                         "accepted": g.accepted,
+                         "violationBefore": g.violation_before,
+                         "violationAfter": g.violation_after,
+                         "durationMs": round(g.duration_s * 1e3, 3)}
+                        for g in goal_results],
+            # Row 0 = initial stack, row i+1 = stack after pass i (polish
+            # rounds append further rows); column g tracks goal g's score
+            # across the whole walk.
+            "violationTrajectory": [[round(x, 6) for x in row]
+                                    for row in trajectory],
+            "totalMoves": num_moves,
+        }
 
     def _provision_verdict(self, final: FlatClusterModel,
                            goal_results: list[GoalResult]):

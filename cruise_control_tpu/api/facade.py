@@ -84,6 +84,15 @@ class KafkaCruiseControl:
         #: web app appends its servlet-request sensors here).
         self.extra_registries: list = []
 
+        #: span tracer serving /trace and /state?substates=tracing — the
+        #: optimizer's tracer (the process default unless overridden), so
+        #: every subsystem wired with the default shares one buffer and a
+        #: single dump covers the whole monitor→model→optimize→execute
+        #: loop. Its Span.* timers join the scrape view (CompositeRegistry
+        #: dedupes by identity, so shared tracers emit once).
+        self.tracer = self.optimizer.tracer
+        self.extra_registries.append(self.tracer.registry)
+
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
                     self.executor.registry]
@@ -592,6 +601,10 @@ class KafkaCruiseControl:
         # registry; substates=sensors scopes a response to just these).
         if "sensors" in wanted:
             out["Sensors"] = self.registry.to_json()
+        # Recent-span snapshot (the /trace ring buffer, span-record form;
+        # the Chrome trace-event export lives on /trace itself).
+        if "tracing" in wanted:
+            out["Tracing"] = self.tracer.to_json()
         if "monitor" in wanted:
             mon = self.monitor.state(self._now_ms()).to_json()
             if self.task_runner is not None:

@@ -15,6 +15,7 @@ POST : rebalance, add_broker, remove_broker, fix_offline_replicas,
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import threading
@@ -48,6 +49,10 @@ POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
 #: POSTs that execute immediately even with two-step verification on
 #: (ref Purgatory: REVIEW itself and flow-control endpoints skip review).
 NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution"}
+#: bare GET handlers outside the servlet endpoint table (observability
+#: surfaces + the API explorer) — instrumented through the same shared
+#: request-timing wrapper as every dispatched endpoint.
+AUX_GET_ENDPOINTS = {"metrics", "trace", "explorer"}
 
 #: per-request access log (ref webserver.accesslog.enabled; the reference
 #: writes an NCSA access log through Jetty)
@@ -134,17 +139,17 @@ class CruiseControlApp:
         # Pre-built enum-keyed sensor maps (the reference keys its servlet
         # sensors by the CruiseControlEndPoint enum): no per-request
         # registry lookups or name formatting on the dispatch path.
+        _sensor_eps = (("GET", GET_ENDPOINTS | AUX_GET_ENDPOINTS),
+                       ("POST", POST_ENDPOINTS))
         self._request_meters = {
             (m, e): self.registry.meter(
                 f"KafkaCruiseControlServlet.{e}-request-rate")
-            for m, eps in (("GET", GET_ENDPOINTS), ("POST", POST_ENDPOINTS))
-            for e in eps}
+            for m, eps in _sensor_eps for e in eps}
         self._success_timers = {
             (m, e): self.registry.timer(
                 f"KafkaCruiseControlServlet.{e}-successful-"
                 f"request-execution-timer")
-            for m, eps in (("GET", GET_ENDPOINTS), ("POST", POST_ENDPOINTS))
-            for e in eps}
+            for m, eps in _sensor_eps for e in eps}
         self._aio = None
         self.server = None
         if engine == "asyncio":
@@ -194,30 +199,54 @@ class CruiseControlApp:
         self.facade.shutdown()
 
     # ------------------------------------------------------------ dispatch
-    def handle(self, method: str, endpoint: str, params: dict,
-               headers: dict) -> tuple[int, dict, dict]:
-        """Returns (status, response_json, extra_headers)."""
-        # Method-resolved sensors only (the reference meters requests the
-        # servlet actually dispatches): a GET probe of a POST endpoint, an
-        # unknown path, or an auth rejection never marks a rate; a
-        # dispatched request that fails (parse error, operation failure)
-        # still counts as a request, but only successes feed the timer.
+    @contextlib.contextmanager
+    def request_timing(self, method: str, endpoint: str):
+        """The ONE per-request instrumentation wrapper shared by every
+        handler — servlet endpoints (sync and aio engines both dispatch
+        through :meth:`handle`) AND the bare handlers (/metrics, /trace,
+        the API explorer) that used to bypass the sensors entirely.
+
+        Method-resolved sensors only (the reference meters requests the
+        servlet actually dispatches): a GET probe of a POST endpoint, an
+        unknown path, or an auth rejection never marks a rate; a
+        dispatched request that fails (parse error, operation failure)
+        still counts as a request, but only successes feed the timer.
+        Every request also gets an ``api.<endpoint>`` root span.
+
+        Yields a dict; the caller sets ``["status"]`` before the block
+        exits (unset = treated as a 500)."""
         meter = self._request_meters.get((method, endpoint))
         timer = self._success_timers.get((method, endpoint))
         t0 = time.monotonic()
-        try:
-            out = self._handle(method, endpoint, params, headers)
-        except AuthorizationError:
-            raise
-        except Exception:
-            if meter is not None:
+        outcome = {"status": 500}
+        # Span names must stay low-cardinality: the endpoint is
+        # client-controlled path input, so unknown ones share one name
+        # (the real endpoint table is finite and keyed by the sensor map).
+        span_name = (f"api.{endpoint}" if meter is not None
+                     else "api.unknown")
+        with self.facade.tracer.span(span_name, method=method,
+                                     endpoint=endpoint) as sp:
+            try:
+                yield outcome
+            except AuthorizationError:
+                raise
+            except Exception:
+                if meter is not None:
+                    meter.mark()
+                raise
+            status = outcome["status"]
+            sp.set(status=status)
+            if meter is not None and status not in (401, 403, 405):
                 meter.mark()
-            raise
-        status = out[0]
-        if meter is not None and status not in (401, 403, 405):
-            meter.mark()
-        if timer is not None and status < 400:
-            timer.update(time.monotonic() - t0)
+            if timer is not None and status < 400:
+                timer.update(time.monotonic() - t0)
+
+    def handle(self, method: str, endpoint: str, params: dict,
+               headers: dict) -> tuple[int, dict, dict]:
+        """Returns (status, response_json, extra_headers)."""
+        with self.request_timing(method, endpoint) as outcome:
+            out = self._handle(method, endpoint, params, headers)
+            outcome["status"] = out[0]
         return out
 
     def _handle(self, method: str, endpoint: str, params: dict,
@@ -306,7 +335,17 @@ class CruiseControlApp:
         existing = self.tasks.get(uuid) if uuid else None
         if existing is None:
             fn = self._operation(endpoint, params)
-            existing = self.tasks.submit(endpoint, endpoint, fn,
+            # Root span for the async work: it runs on a user-task worker
+            # thread, so the request's api.<endpoint> span (this thread)
+            # cannot parent it — the task span is the thread-local root
+            # the facade/monitor/optimizer/executor spans nest under.
+            tracer = self.facade.tracer
+
+            def traced_fn(progress, _fn=fn, _ep=endpoint):
+                with tracer.span(f"task.{_ep}"):
+                    return _fn(progress)
+
+            existing = self.tasks.submit(endpoint, endpoint, traced_fn,
                                          user_task_id=uuid)
         hdrs = {"User-Task-ID": existing.user_task_id}
         timeout = float(params.get("get_response_timeout_s", 10.0))
@@ -709,7 +748,10 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
             return json_resp(e.status, {"errorMessage": str(e)},
                              _auth_headers(e, app.security))
         from .openapi import api_explorer_html
-        return 200, "text/html; charset=utf-8", api_explorer_html().encode(), {}
+        with app.request_timing("GET", "explorer") as outcome:
+            body = api_explorer_html().encode()
+            outcome["status"] = 200
+        return 200, "text/html; charset=utf-8", body, {}
     # /metrics: Prometheus text exposition of the self-metric sensors
     # (the HTTP stand-in for the reference's JMX-exposed Dropwizard
     # registry). Viewer-gated like /state.
@@ -720,8 +762,23 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         except AuthorizationError as e:
             return json_resp(e.status, {"errorMessage": str(e)},
                              _auth_headers(e, app.security))
-        return (200, "text/plain; version=0.0.4; charset=utf-8",
-                app.facade.registry.expose_text().encode(), {})
+        with app.request_timing("GET", "metrics") as outcome:
+            body = app.facade.registry.expose_text().encode()
+            outcome["status"] = 200
+        return (200, "text/plain; version=0.0.4; charset=utf-8", body, {})
+    # /trace: Chrome trace-event JSON export of the span ring buffer
+    # (loadable in Perfetto / chrome://tracing). Viewer-gated like /state.
+    if method == "GET" and parts in (["trace"],
+                                     ["kafkacruisecontrol", "trace"]):
+        try:
+            check_access(app.security, "state", headers)
+        except AuthorizationError as e:
+            return json_resp(e.status, {"errorMessage": str(e)},
+                             _auth_headers(e, app.security))
+        with app.request_timing("GET", "trace") as outcome:
+            body = json.dumps(app.facade.tracer.to_chrome_trace()).encode()
+            outcome["status"] = 200
+        return 200, "application/json", body, {}
     if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
         return json_resp(404, {"errorMessage": f"bad path {parsed.path}"})
     endpoint = parts[1].lower()

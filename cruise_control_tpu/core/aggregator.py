@@ -359,9 +359,15 @@ class MetricSampleAggregator:
 
     def __init__(self, num_windows: int, window_ms: int, min_samples_per_window: int,
                  metric_def: MetricDef,
-                 entity_group_fn: Callable[[Hashable], Hashable] | None = None) -> None:
+                 entity_group_fn: Callable[[Hashable], Hashable] | None = None,
+                 tracer=None) -> None:
         if num_windows <= 0 or window_ms <= 0 or min_samples_per_window <= 0:
             raise ValueError("num_windows, window_ms, min_samples_per_window must be > 0")
+        from .tracing import default_tracer
+        #: span tracer: every aggregate() emits an ``aggregator.aggregate``
+        #: span so model-build latency attributes between aggregation and
+        #: flat-model assembly.
+        self._tracer = tracer or default_tracer()
         self._num_windows = num_windows
         self._window_ms = window_ms
         self._min_samples = min_samples_per_window
@@ -510,7 +516,8 @@ class MetricSampleAggregator:
         documentation of the ladder). Both produce identical results —
         bit-identical values, codes, and completeness."""
         options = options or AggregationOptions()
-        with self._lock:
+        with self._tracer.span("aggregator.aggregate",
+                               dense=use_dense), self._lock:
             window_indices = [w for w in range(self._oldest_window_index,
                                                self._current_window_index)
                               if w * self._window_ms <= to_ms

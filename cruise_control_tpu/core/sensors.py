@@ -174,6 +174,84 @@ class Gauge:
         return {"type": "gauge", "value": self.value()}
 
 
+def _family_names(base: str, s: object) -> tuple[str, ...]:
+    """Rendered Prometheus family names for a sensor at a given base."""
+    if isinstance(s, Counter):
+        return (f"{base}_total",)
+    if isinstance(s, Meter):
+        return (f"{base}_total", f"{base}_rate")
+    if isinstance(s, Timer):
+        return (f"{base}_seconds",)
+    return (base,)
+
+
+def _flatten_names(items: list[tuple[str, object]]) -> dict[str, str]:
+    """Dotted sensor name -> unique ``cc_`` series base.
+
+    Flattening maps every non-alphanumeric to ``_``, so distinct dotted
+    names can collide (``A.b-c`` and ``A.b.c`` both flatten to
+    ``cc_A_b_c``) — which used to emit duplicate ``# TYPE`` blocks, an
+    exposition-format violation. Uniqueness is enforced on the RENDERED
+    family names (kind suffixes included: a Counter ``A.b`` and a Gauge
+    ``A.b.total`` both render family ``cc_A_b_total``), disambiguated
+    deterministically (sorted input order) with a numeric suffix."""
+    assigned: set[str] = set()
+    out: dict[str, str] = {}
+    for name, s in items:
+        base = "cc_" + "".join(ch if (ch.isalnum() or ch == "_") else "_"
+                               for ch in name)
+        candidate, i = base, 1
+        while any(f in assigned for f in _family_names(candidate, s)):
+            i += 1
+            candidate = f"{base}_{i}"
+        assigned.update(_family_names(candidate, s))
+        out[name] = candidate
+    return out
+
+
+def _render_exposition(items: list[tuple[str, object]]) -> str:
+    """Prometheus text exposition over sorted (dotted name, sensor) pairs —
+    the ONE renderer behind both ``MetricRegistry.expose_text`` and the
+    composite view (so merged registries cannot emit duplicate ``# TYPE``
+    blocks either). Every series family carries a ``# HELP`` line naming
+    the original dotted sensor."""
+    flat = _flatten_names(items)
+    lines: list[str] = []
+
+    def family(series: str, dotted: str, kind: str) -> None:
+        lines.append(f"# HELP {series} sensor {dotted}")
+        lines.append(f"# TYPE {series} {kind}")
+
+    for name, s in items:
+        base = flat[name]
+        if isinstance(s, Counter):
+            family(f"{base}_total", name, "counter")
+            lines.append(f"{base}_total {s.count}")
+        elif isinstance(s, Meter):
+            family(f"{base}_total", name, "counter")
+            lines.append(f"{base}_total {s.count}")
+            family(f"{base}_rate", name, "gauge")
+            lines.append(f"{base}_rate {s.rate():.6f}")
+        elif isinstance(s, Timer):
+            family(f"{base}_seconds", name, "summary")
+            for q in (0.5, 0.95, 0.99):
+                lines.append(f"{base}_seconds{{quantile=\"{q}\"}} "
+                             f"{s.quantile(q):.6f}")
+            lines.append(f"{base}_seconds_count {s.count}")
+            lines.append(f"{base}_seconds_sum {s._sum:.6f}")
+        elif isinstance(s, Gauge):
+            v = s.value()
+            if v is None:
+                continue
+            try:
+                rendered = f"{base} {float(v):.6f}"
+            except (TypeError, ValueError):
+                continue        # non-numeric gauges are dropped
+            family(base, name, "gauge")
+            lines.append(rendered)
+    return "\n".join(lines) + "\n"
+
+
 class MetricRegistry:
     """Named sensor registry (ref ``com.codahale.metrics.MetricRegistry``).
 
@@ -227,6 +305,12 @@ class MetricRegistry:
     def names(self) -> list[str]:
         return sorted(self._sensors)
 
+    def snapshot(self) -> list[tuple[str, object]]:
+        """Locked point-in-time (dotted name, sensor) list — the public
+        merge surface the composite view renders from."""
+        with self._lock:
+            return sorted(self._sensors.items())
+
     # -------------------------------------------------------------- export
     def to_json(self) -> dict:
         """{name: sensor-json} snapshot for ``/state``."""
@@ -237,47 +321,13 @@ class MetricRegistry:
     def expose_text(self) -> str:
         """Prometheus-style text exposition for ``/metrics``.
 
-        Sensor names are flattened to ``cc_<group>_<sensor>`` with
-        dots/dashes mapped to underscores; timers emit ``_count``,
-        ``_mean_seconds``, quantile series, meters ``_total`` and
-        ``_rate``, counters ``_total``, gauges the bare name.
+        Sensor names are flattened to ``cc_<group>_<sensor>`` (collisions
+        disambiguated — see :func:`_flatten_names`); timers emit
+        ``_count``/``_sum`` and quantile series (a summary), meters
+        ``_total`` and ``_rate``, counters ``_total``, gauges the bare
+        name. Every family carries ``# HELP`` and exactly one ``# TYPE``.
         """
-        def flat(name: str) -> str:
-            out = []
-            for ch in name:
-                out.append(ch if (ch.isalnum() or ch == "_") else "_")
-            return "cc_" + "".join(out)
-
-        lines: list[str] = []
-        with self._lock:
-            items = sorted(self._sensors.items())
-        for name, s in items:
-            base = flat(name)
-            if isinstance(s, Counter):
-                lines.append(f"# TYPE {base}_total counter")
-                lines.append(f"{base}_total {s.count}")
-            elif isinstance(s, Meter):
-                lines.append(f"# TYPE {base}_total counter")
-                lines.append(f"{base}_total {s.count}")
-                lines.append(f"# TYPE {base}_rate gauge")
-                lines.append(f"{base}_rate {s.rate():.6f}")
-            elif isinstance(s, Timer):
-                lines.append(f"# TYPE {base}_seconds summary")
-                for q in (0.5, 0.95, 0.99):
-                    lines.append(f"{base}_seconds{{quantile=\"{q}\"}} "
-                                 f"{s.quantile(q):.6f}")
-                lines.append(f"{base}_seconds_count {s.count}")
-                lines.append(f"{base}_seconds_sum {s._sum:.6f}")
-            elif isinstance(s, Gauge):
-                v = s.value()
-                if v is None:
-                    continue
-                lines.append(f"# TYPE {base} gauge")
-                try:
-                    lines.append(f"{base} {float(v):.6f}")
-                except (TypeError, ValueError):
-                    lines.pop()   # drop the TYPE line for non-numeric gauges
-        return "\n".join(lines) + "\n"
+        return _render_exposition(self.snapshot())
 
 
 class CompositeRegistry:
@@ -321,7 +371,22 @@ class CompositeRegistry:
         return dict(sorted(out.items()))
 
     def expose_text(self) -> str:
-        return "".join(reg.expose_text() for reg in self._sources())
+        # Merge THEN render once: per-registry concatenation would emit a
+        # second ``# TYPE`` block whenever two registries carry the same
+        # sensor name (first writer wins, matching get()). Duck-typed
+        # registries without the snapshot() merge surface (a nested
+        # composite, a custom extra_registries entry) keep the old
+        # concatenation behavior rather than breaking the scrape.
+        merged: dict[str, object] = {}
+        foreign: list[str] = []
+        for reg in self._sources():
+            snap = getattr(reg, "snapshot", None)
+            if snap is None:
+                foreign.append(reg.expose_text())
+                continue
+            for name, s in snap():
+                merged.setdefault(name, s)
+        return _render_exposition(sorted(merged.items())) + "".join(foreign)
 
 
 #: Sensor group names (ref CruiseControlMetrics sensor name constants).

@@ -40,8 +40,13 @@ class AnomalyDetectorManager:
                  fixable_broker_count_threshold: int = 10,
                  fixable_broker_pct_threshold: float = 0.4,
                  num_cached_recent_anomalies: int = 10,
-                 provisioner_enabled: bool = True) -> None:
+                 provisioner_enabled: bool = True, tracer=None) -> None:
         from ..core.sensors import (ANOMALY_DETECTOR_SENSOR, MetricRegistry)
+        from ..core.tracing import default_tracer
+        #: span tracer: detection rounds emit detector.detect spans, fixes
+        #: detector.heal spans (nesting the facade/optimizer/executor work
+        #: the fix runs)
+        self.tracer = tracer or default_tracer()
         self.facade = facade
         #: self-healing refuses to act past these simultaneous-failure
         #: bounds (ref fixable.failed.broker.count/percentage.threshold —
@@ -169,7 +174,11 @@ class AnomalyDetectorManager:
                 continue
             sched.next_run_ms = now + sched.interval_ms
             try:
-                anomalies = sched.detector.detect(now)
+                with self.tracer.span(
+                        "detector.detect",
+                        detector=type(sched.detector).__name__) as sp:
+                    anomalies = sched.detector.detect(now)
+                    sp.set(anomalies=len(anomalies))
             except Exception:
                 continue   # a broken detector must not kill the loop
             for a in anomalies:
@@ -243,7 +252,12 @@ class AnomalyDetectorManager:
                     max(now - anomaly.detected_ms, 0) / 1000.0)
                 self.ongoing_self_healing = anomaly.anomaly_id
                 try:
-                    ok = anomaly.fix(self.facade)
+                    with self.tracer.span(
+                            "detector.heal",
+                            anomalyType=anomaly.anomaly_type.name,
+                            anomalyId=anomaly.anomaly_id) as sp:
+                        ok = anomaly.fix(self.facade)
+                        sp.set(fixed=bool(ok))
                     if not ok:
                         self.num_self_healing_failed += 1
                 except Exception:

@@ -173,8 +173,13 @@ class Executor:
                  config: ExecutorConfig | None = None,
                  notifier: ExecutorNotifier | None = None,
                  topic_config_provider=None,
-                 now_ms=None, sleep_ms=None, registry=None) -> None:
+                 now_ms=None, sleep_ms=None, registry=None,
+                 tracer=None) -> None:
         from ..core.sensors import (EXECUTOR_SENSOR, MetricRegistry)
+        from ..core.tracing import default_tracer
+        #: span tracer: executions emit executor.execute → per-phase →
+        #: per-task lifecycle spans (tasks via the tracker)
+        self.tracer = tracer or default_tracer()
         self.admin = admin
         self.config = config or ExecutorConfig()
         # ref max.num.cluster.movements: validate the STATIC config
@@ -365,7 +370,7 @@ class Executor:
                     "an execution is already in progress")
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested.clear()
-            self._task_manager = ExecutionTaskManager()
+            self._task_manager = ExecutionTaskManager(tracer=self.tracer)
             self._current_uuid = uuid
         started = self._now_ms()
         self._executions_started.inc()
@@ -375,6 +380,13 @@ class Executor:
             self.admin, throttle_bytes
             if throttle_bytes is not None
             else self.config.default_replication_throttle_bytes)
+        # Root execution span, closed in the finally below (an ExitStack
+        # keeps the existing try/finally shape — the span must cover the
+        # whole run including the abort/cleanup path).
+        import contextlib
+        _span_stack = contextlib.ExitStack()
+        exec_span = _span_stack.enter_context(self.tracer.span(
+            "executor.execute", uuid=uid, proposals=len(proposals)))
         # Everything after the reservation sits inside try/finally: a
         # transient admin failure during setup must release the
         # single-execution reservation, or the executor is wedged in
@@ -418,47 +430,59 @@ class Executor:
                 sum(1 for t in tasks
                     if t.task_type is TaskType.LEADER_ACTION))
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-            self._run_inter_broker_phase(planner, concurrency, adjuster,
-                                         strategy_context)
+            with self.tracer.span("executor.inter-broker-phase"):
+                self._run_inter_broker_phase(planner, concurrency, adjuster,
+                                             strategy_context)
             if not self._stop_requested.is_set():
                 OPERATION_LOG.info(
                     "Execution %s: inter-broker phase complete", uid)
             self._state = ExecutorState.INTRA_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-            self._run_intra_broker_phase(planner, concurrency)
+            with self.tracer.span("executor.intra-broker-phase"):
+                self._run_intra_broker_phase(planner, concurrency)
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
-            self._run_leadership_phase(planner, concurrency)
+            with self.tracer.span("executor.leadership-phase"):
+                self._run_leadership_phase(planner, concurrency)
             if not self._stop_requested.is_set():
                 OPERATION_LOG.info(
                     "Execution %s: leadership phase complete", uid)
         finally:
-            stopped = self._stop_requested.is_set()
-            if stopped:
-                self._state = ExecutorState.STOPPING_EXECUTION
-                self._abort_in_flight()
-            throttler.clear_throttles()
-            if removed_brokers:
-                self.recently_removed_brokers |= removed_brokers
-            if demoted_brokers:
-                self.recently_demoted_brokers |= demoted_brokers
-            dead = sum(tm.tracker.num_in(t, TaskState.DEAD) for t in TaskType)
-            result = ExecutionResult(
-                uuid=uuid, state_counts=tm.tracker.summary(),
-                started_ms=started, finished_ms=self._now_ms(),
-                stopped=stopped, num_dead_tasks=dead)
-            self._execution_timer.update(
-                (result.finished_ms - result.started_ms) / 1000.0)
-            if stopped:
-                self._executions_stopped.inc()
-            self._state = ExecutorState.NO_TASK_IN_PROGRESS
-            # An in-flight exception must not be recorded as a success.
-            exc = sys.exc_info()[1]
-            outcome = ("STOPPED" if stopped
-                       else f"FAILED ({type(exc).__name__})" if exc
-                       else "finished")
-            OPERATION_LOG.info(
-                "Execution %s %s: %s (%d dead tasks, %.1fs)", uid, outcome,
-                result.state_counts, dead,
-                (result.finished_ms - result.started_ms) / 1000.0)
+            try:
+                stopped = self._stop_requested.is_set()
+                if stopped:
+                    self._state = ExecutorState.STOPPING_EXECUTION
+                    self._abort_in_flight()
+                throttler.clear_throttles()
+                if removed_brokers:
+                    self.recently_removed_brokers |= removed_brokers
+                if demoted_brokers:
+                    self.recently_demoted_brokers |= demoted_brokers
+                dead = sum(tm.tracker.num_in(t, TaskState.DEAD)
+                           for t in TaskType)
+                result = ExecutionResult(
+                    uuid=uuid, state_counts=tm.tracker.summary(),
+                    started_ms=started, finished_ms=self._now_ms(),
+                    stopped=stopped, num_dead_tasks=dead)
+                self._execution_timer.update(
+                    (result.finished_ms - result.started_ms) / 1000.0)
+                if stopped:
+                    self._executions_stopped.inc()
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                # An in-flight exception must not be recorded as a success.
+                exc = sys.exc_info()[1]
+                outcome = ("STOPPED" if stopped
+                           else f"FAILED ({type(exc).__name__})" if exc
+                           else "finished")
+                OPERATION_LOG.info(
+                    "Execution %s %s: %s (%d dead tasks, %.1fs)", uid,
+                    outcome, result.state_counts, dead,
+                    (result.finished_ms - result.started_ms) / 1000.0)
+                exec_span.set(stopped=stopped, deadTasks=dead,
+                              outcome=outcome)
+            finally:
+                # The span must close even when cleanup itself raises: a
+                # leaked active span would mis-parent every later span
+                # recorded on this pooled worker thread.
+                _span_stack.close()
             self.notifier.on_execution_finished(result)
         return result
 

@@ -125,10 +125,17 @@ class ExecutionTaskTracker:
     for ``/state``.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, tracer=None) -> None:
         self._tasks: dict[TaskType, dict[TaskState, dict[int, ExecutionTask]]] = {
             t: {s: {} for s in TaskState} for t in TaskType}
         self._lock = threading.RLock()
+        #: span tracer: a task reaching a terminal state records an
+        #: ``executor.task`` lifecycle span (duration = its
+        #: IN_PROGRESS→terminal window on the executor's clock)
+        if tracer is None:
+            from ..core.tracing import default_tracer
+            tracer = default_tracer()
+        self._tracer = tracer
 
     def add(self, task: ExecutionTask) -> None:
         with self._lock:
@@ -140,6 +147,21 @@ class ExecutionTaskTracker:
             del self._tasks[task.task_type][task.state][task.execution_id]
             task.transition(new_state, now_ms)
             self._tasks[task.task_type][new_state][task.execution_id] = task
+        if task.done and task.start_time_ms is not None:
+            # Reconstructed lifecycle span (the executor's now_ms clock may
+            # be simulated; only the duration is trusted — the span ends
+            # "now" on the tracer's clock). Parent = whatever phase span
+            # the executing thread currently holds.
+            proposal = task.proposal
+            self._tracer.record(
+                "executor.task",
+                max((task.end_time_ms or now_ms) - task.start_time_ms, 0)
+                / 1000.0,
+                attrs={"type": task.task_type.value,
+                       "state": task.state.value,
+                       "topic": getattr(proposal, "topic", None),
+                       "partition": getattr(proposal, "partition", None),
+                       "executionId": task.execution_id})
 
     def tasks_in(self, task_type: TaskType,
                  state: TaskState) -> list[ExecutionTask]:
@@ -174,9 +196,9 @@ class ExecutionTaskManager:
     """Creates tasks from proposals and hands them to the planner/tracker
     (ref ExecutionTaskManager.java)."""
 
-    def __init__(self) -> None:
+    def __init__(self, tracer=None) -> None:
         self._id_gen = itertools.count()
-        self.tracker = ExecutionTaskTracker()
+        self.tracker = ExecutionTaskTracker(tracer=tracer)
 
     def add_execution_proposals(self, proposals: list[ExecutionProposal]
                                 ) -> list[ExecutionTask]:

@@ -31,8 +31,10 @@ class MetricFetcherManager:
                  store: SampleStore | None = None,
                  assignor: DefaultPartitionAssignor | None = None,
                  on_execution_store: SampleStore | None = None,
-                 registry=None, max_retries: int = 0) -> None:
+                 registry=None, max_retries: int = 0, tracer=None) -> None:
         from ..core.sensors import MetricRegistry
+        from ..core.tracing import default_tracer
+        self.tracer = tracer or default_tracer()
         self.sampler = sampler
         self.num_fetchers = max(1, num_fetchers)
         #: ref fetch.metric.samples.max.retry.count: transient sampler
@@ -62,7 +64,10 @@ class MetricFetcherManager:
         processor buffer, the synthetic sampler's per-broker sums) must see
         the whole assignment in one call or they would race / double-count.
         """
-        with self._fetch_timer.time():
+        with self._fetch_timer.time(), \
+                self.tracer.span("monitor.fetch-samples",
+                                 partitions=len(partitions),
+                                 brokers=len(brokers)):
             for attempt in range(self.max_retries + 1):
                 try:
                     merged = self._fetch(partitions, brokers, start_ms,

@@ -134,21 +134,28 @@ class LoadMonitor:
                  rack_by_broker: dict[int, str] | None = None,
                  broker_set_resolver=None,
                  max_concurrent_model_builds: int = 2,
-                 registry=None) -> None:
+                 registry=None, tracer=None) -> None:
         from ..core.sensors import (LOAD_MONITOR_SENSOR, MetricRegistry)
+        from ..core.tracing import default_tracer
         self.admin = admin
         self.config = config or MonitorConfig()
         self.capacity_resolver = capacity_resolver or FixedCapacityResolver()
         self.rack_by_broker = rack_by_broker or {}
         #: optional BrokerSetResolver feeding BrokerSetAwareGoal
         self.broker_set_resolver = broker_set_resolver
+        #: span tracer (None = process default): cluster_model() emits
+        #: nested monitor.cluster-model → monitor.aggregate →
+        #: monitor.model-build spans
+        self.tracer = tracer or default_tracer()
         c = self.config
         self.partition_aggregator = MetricSampleAggregator(
             c.num_windows, c.window_ms, c.min_samples_per_window,
-            partition_metric_def(), entity_group_fn=lambda tp: tp[0])
+            partition_metric_def(), entity_group_fn=lambda tp: tp[0],
+            tracer=self.tracer)
         self.broker_aggregator = MetricSampleAggregator(
             c.num_broker_windows, c.broker_window_ms,
-            c.min_samples_per_broker_window, broker_metric_def())
+            c.min_samples_per_broker_window, broker_metric_def(),
+            tracer=self.tracer)
         #: bounds concurrent model builds (ref the model-generation
         #: semaphore LoadMonitor.java:94,396); thread-safety of ingest lives
         #: inside MetricSampleAggregator's own lock.
@@ -300,19 +307,25 @@ class LoadMonitor:
         requirements = requirements or ModelCompletenessRequirements(
             min_monitored_partitions_percentage=(
                 self.config.min_valid_partition_ratio))
-        with self._model_semaphore, self._model_timer.time():
-            return self._build_model(now_ms, requirements,
-                                     populate_replica_placement_only)
+        with self._model_semaphore, self._model_timer.time(), \
+                self.tracer.span("monitor.cluster-model") as sp:
+            result = self._build_model(now_ms, requirements,
+                                       populate_replica_placement_only)
+            sp.set(partitions=len(result.metadata.partition_keys),
+                   generation=result.generation)
+            return result
 
     def _build_model(self, now_ms, requirements, placement_only):
         partitions = self.admin.describe_partitions()
         alive = self.admin.describe_cluster()
         result = None
         if not placement_only:
-            try:
-                result = self._aggregate(now_ms, requirements, partitions)
-            except NotEnoughValidWindowsError as e:
-                raise NotEnoughValidWindowsException(str(e)) from None
+            with self.tracer.span("monitor.aggregate"):
+                try:
+                    result = self._aggregate(now_ms, requirements,
+                                             partitions)
+                except NotEnoughValidWindowsError as e:
+                    raise NotEnoughValidWindowsException(str(e)) from None
             if not requirements.met_by(result.completeness):
                 raise NotEnoughValidWindowsException(
                     f"completeness {result.completeness.valid_entity_ratio:.2f} "
@@ -337,12 +350,14 @@ class LoadMonitor:
         # ref Replica.isCurrentOffline covering bad-disk replicas.
         offline_fn = getattr(self.admin, "offline_replicas", None)
         extra_offline = offline_fn() if offline_fn is not None else set()
-        if self.config.dense_pipeline and (result is None
-                                           or result.dense is not None):
-            return self._assemble_dense(partitions, alive, brokers, result,
-                                        extra_offline)
-        return self._assemble_reference(partitions, alive, brokers, result,
-                                        extra_offline)
+        dense = self.config.dense_pipeline and (result is None
+                                                or result.dense is not None)
+        with self.tracer.span("monitor.model-build", dense=dense):
+            if dense:
+                return self._assemble_dense(partitions, alive, brokers,
+                                            result, extra_offline)
+            return self._assemble_reference(partitions, alive, brokers,
+                                            result, extra_offline)
 
     def _assemble_reference(self, partitions, alive, brokers, result,
                             extra_offline) -> ClusterModelResult:

@@ -67,10 +67,10 @@ def main(brokers=1000, partitions=200_000):
             cfg1 = replace(cfg, max_iters_per_goal=8, drain_rounds=0)
             p1 = jax.jit(make_goal_pass(g, list(prev), cfg1,
                                         all_goals=goals))
-            s2, iters, _ = p1(st, ctx, key)
+            s2, iters, *_ = p1(st, ctx, key)
             jax.block_until_ready(s2)
             t0 = time.monotonic()
-            s2, iters, _ = p1(st, ctx, key)
+            s2, iters, *_ = p1(st, ctx, key)
             jax.block_until_ready(s2)
             t_pass = time.monotonic() - t0
             it = max(int(jax.device_get(iters)), 1)
@@ -80,7 +80,7 @@ def main(brokers=1000, partitions=200_000):
                   f"violation {t_viol * 1e3:.0f}ms  "
                   f"pass/iter {per * 1e3:.0f}ms over {it} iters "
                   f"(apply+guards ~ {max(per - t_score, 0) * 1e3:.0f}ms)")
-        st, _, _ = passes[i](st, ctx, jax.random.fold_in(key, i))
+        st, _, _, _ = passes[i](st, ctx, jax.random.fold_in(key, i))
     jax.block_until_ready(st)
     print("final residuals:", np.round(np.asarray(jax.device_get(
         jax.jit(lambda s: violation_stack(goals, s, ctx))(st))), 1))

@@ -1,0 +1,40 @@
+"""Shared Prometheus text-exposition lint for tests — kept free of any
+jax / API-stack imports so the pure-datastructure sensor tests can use it
+without dragging the full serving stack in at import time."""
+
+import re
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def lint_prometheus_exposition(text: str) -> None:
+    """Minimal text-format lint: unique # TYPE per series family, a HELP
+    line per declared family, legal sample names, float-parsable values,
+    and every sample belonging to a declared family."""
+    typed: set[str] = set()
+    helped: set[str] = set()
+    sample_names: set[str] = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            fam, kind = line.split()[2], line.split()[3]
+            assert fam not in typed, f"duplicate # TYPE for {fam}"
+            assert kind in ("counter", "gauge", "summary", "histogram")
+            typed.add(fam)
+            continue
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        sample, _, value = line.rpartition(" ")
+        name = sample.split("{")[0]
+        assert _NAME_RE.match(name), f"bad series name {name!r}"
+        float(value)   # must parse
+        sample_names.add(name)
+    assert typed, "no # TYPE lines at all"
+    assert typed <= helped, f"TYPE without HELP: {sorted(typed - helped)}"
+    for name in sample_names:
+        fam_candidates = {name, name.removesuffix("_count"),
+                          name.removesuffix("_sum")}
+        assert fam_candidates & typed, f"sample {name} has no # TYPE family"

@@ -262,7 +262,7 @@ def test_warmup_waiter_retries_after_owner_failure(monkeypatch):
 def test_walk_passes_order_durations_and_fetch():
     """The pipelined walk must preserve pass order (each pass consumes its
     predecessor's state), fire on_start in execution order, and fetch every
-    pass's (iters, stack) with per-pass durations."""
+    pass's (iters, stack, moves boundary) with per-pass durations."""
     import jax.numpy as jnp
 
     from cruise_control_tpu.analyzer.optimizer import _walk_passes
@@ -276,7 +276,8 @@ def test_walk_passes_order_durations_and_fetch():
             def run(state, ctx, key):
                 state = state + (i + 1)
                 return (state, jnp.asarray(i, jnp.int32),
-                        state * jnp.ones((2,), jnp.float32))
+                        state * jnp.ones((2,), jnp.float32),
+                        jnp.asarray(10 * (i + 1), jnp.int32))
             return run
 
     chain = FakeChain()
@@ -286,8 +287,9 @@ def test_walk_passes_order_durations_and_fetch():
         on_start=order.append)
     assert order == [0, 1, 2, 3]
     assert float(state) == 10.0              # 1+2+3+4 applied in order
-    assert [int(it) for it, _ in fetched] == [0, 1, 2, 3]
-    assert np.allclose([float(s[0]) for _, s in fetched], [1, 3, 6, 10])
+    assert [int(it) for it, _, _ in fetched] == [0, 1, 2, 3]
+    assert np.allclose([float(s[0]) for _, s, _ in fetched], [1, 3, 6, 10])
+    assert [int(m) for _, _, m in fetched] == [10, 20, 30, 40]
     assert len(durs) == 4 and all(d >= 0 for d in durs)
 
 

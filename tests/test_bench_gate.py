@@ -27,6 +27,22 @@ def test_transport_death_gate():
         assert not bench._is_transport_death(RuntimeError(msg)), msg
 
 
+def test_tracer_overhead_bench_smoke_gate():
+    """run_tracer_overhead_bench on a toy cluster: exercises the tracer
+    A/B harness end-to-end (disable → enable → restore). Tier-1 safe: no
+    wall-clock gate at toy scale — the <2% bar is judged at bench scale,
+    where best-of-N repeats shed the noise that would dominate here."""
+    import bench
+    from cruise_control_tpu.core.tracing import default_tracer
+    out = bench.run_tracer_overhead_bench(
+        num_brokers=8, num_partitions=64,
+        goal_names=["ReplicaDistributionGoal"],
+        repeats=1, emit_row=False, gate=False)
+    assert out["enabled_s"] > 0 and out["disabled_s"] > 0
+    assert "overhead_pct" in out
+    assert default_tracer().enabled   # the harness must restore the switch
+
+
 def test_model_build_bench_smoke_gate():
     """run_model_build_bench on a small cluster: exercises the dense
     monitor→model path end-to-end and its built-in dense/legacy parity

@@ -79,6 +79,156 @@ def test_expose_text_prometheus_format():
     assert "none_gauge" not in text     # non-numeric gauges are dropped
 
 
+def test_expose_text_collision_disambiguation_and_help():
+    """Satellite: two dotted names flattening to the same cc_ series
+    (``A.b-c`` vs ``A.b.c``) must not emit duplicate # TYPE blocks — the
+    second gets a deterministic numeric suffix — and every family carries
+    a # HELP line naming the original dotted sensor."""
+    r = MetricRegistry()
+    r.counter("A.b-c").inc(1)
+    r.counter("A.b.c").inc(2)
+    text = r.expose_text()
+    assert text.count("# TYPE cc_A_b_c_total counter") == 1
+    assert text.count("# TYPE cc_A_b_c_2_total counter") == 1
+    # Sorted input: "A.b-c" < "A.b.c", so the dotted name gets the suffix.
+    assert "# HELP cc_A_b_c_total sensor A.b-c" in text
+    assert "# HELP cc_A_b_c_2_total sensor A.b.c" in text
+    assert "cc_A_b_c_total 1" in text
+    assert "cc_A_b_c_2_total 2" in text
+    # HELP everywhere, not just on collisions.
+    r2 = MetricRegistry()
+    r2.timer("G.t").update(0.1)
+    t2 = r2.expose_text()
+    assert "# HELP cc_G_t_seconds sensor G.t" in t2
+    assert t2.index("# HELP cc_G_t_seconds") < t2.index("# TYPE cc_G_t_seconds")
+
+
+def test_expose_text_kind_suffix_collision():
+    """Collisions are resolved on RENDERED family names, not raw bases: a
+    Counter ``A.b`` renders family ``cc_A_b_total``, which a Gauge named
+    ``A.b.total`` would collide with even though their bases differ."""
+    from prom_lint import lint_prometheus_exposition
+    r = MetricRegistry()
+    r.counter("A.b").inc(1)
+    r.gauge("A.b.total", lambda: 9.0)
+    text = r.expose_text()
+    assert text.count("# TYPE cc_A_b_total ") == 1
+    lint_prometheus_exposition(text)
+    assert "cc_A_b_total 1" in text               # the counter keeps the base
+    assert "cc_A_b_total_2 9.000000" in text      # the gauge is disambiguated
+
+
+def test_composite_expose_text_no_duplicate_type_across_registries():
+    """Two independent registries carrying the SAME sensor name must not
+    render duplicate # TYPE blocks through the composite view (merged
+    then rendered once; first registry wins, matching get())."""
+    from cruise_control_tpu.core.sensors import CompositeRegistry
+    a, b = MetricRegistry(), MetricRegistry()
+    a.counter("G.c").inc(1)
+    b.counter("G.c").inc(99)
+    b.counter("G.other").inc(5)
+    text = CompositeRegistry(lambda: [a, b]).expose_text()
+    assert text.count("# TYPE cc_G_c_total counter") == 1
+    assert "cc_G_c_total 1" in text          # first registry wins
+    assert "cc_G_other_total 5" in text
+
+
+def test_sensor_thread_safety_under_scrape():
+    """Satellite: concurrent Counter/Meter/Timer updates from many threads
+    while a scraper loops expose_text()/to_json() — totals must come out
+    exact (no lost updates) and scrapes must never raise."""
+    import threading
+    r = MetricRegistry()
+    c = r.counter("T.c")
+    m = r.meter("T.m", window_s=3600.0)
+    t = r.timer("T.t")
+    r.gauge("T.g", lambda: 42.0)
+    stop = threading.Event()
+    scrape_errors = []
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                r.expose_text()
+                r.to_json()
+            except Exception as e:   # pragma: no cover
+                scrape_errors.append(e)
+                return
+
+    def writer():
+        for i in range(2000):
+            c.inc()
+            m.mark()
+            t.update(0.001 * (i % 10))
+
+    scr = threading.Thread(target=scraper)
+    scr.start()
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    stop.set()
+    scr.join()
+    assert not scrape_errors
+    assert c.count == 8000
+    assert m.count == 8000
+    assert t.count == 8000
+    assert t.quantile(0.5) <= 0.009 + 1e-9
+
+
+def test_meter_exact_window_with_fake_clock():
+    """The Meter rate is an EXACT sliding window (not an EWMA): events
+    leaving the window drop out of the rate precisely at the cutoff."""
+    now = [0.0]
+    m = Meter(window_s=10.0, now=lambda: now[0])
+    m.mark(10)                       # t=0
+    now[0] = 4.0
+    m.mark(20)                       # t=4
+    assert m.rate() == pytest.approx(3.0)       # 30 events / 10 s
+    now[0] = 9.999
+    assert m.rate() == pytest.approx(3.0)       # both bursts still inside
+    now[0] = 10.5
+    assert m.rate() == pytest.approx(2.0)       # t=0 burst aged out
+    now[0] = 13.5
+    m.mark(5)
+    assert m.rate() == pytest.approx(2.5)       # t=4 burst + 5 inside
+    now[0] = 25.0
+    assert m.rate() == 0.0                      # everything aged out
+    assert m.count == 35                        # count is monotonic
+
+
+def test_timer_reservoir_bounds():
+    """The quantile reservoir keeps only the most recent ``reservoir``
+    observations: quantiles reflect the recent window while count/mean/max
+    stay whole-history."""
+    t = Timer(reservoir=16)
+    for _ in range(100):
+        t.update(100.0)              # old regime
+    for _ in range(16):
+        t.update(1.0)                # recent regime fills the reservoir
+    assert t.count == 116
+    assert len(t._reservoir) == 16
+    assert t.quantile(0.0) == 1.0
+    assert t.quantile(0.99) == 1.0   # old observations fully evicted
+    assert t._max == 100.0           # max is whole-history
+    assert t.mean_s == pytest.approx((100 * 100 + 16) / 116)
+
+
+def test_expose_text_passes_format_lint():
+    """Prometheus text-format lint over a registry carrying all four
+    sensor kinds (incl. a colliding pair)."""
+    from prom_lint import lint_prometheus_exposition
+    r = MetricRegistry()
+    r.counter("A.b-c").inc(1)
+    r.counter("A.b.c").inc(2)
+    r.meter("G.m").mark(3)
+    r.timer("G.t").update(0.5)
+    r.gauge("G.g", lambda: 1.5)
+    r.gauge("G.bad", lambda: "not-a-number")
+    lint_prometheus_exposition(r.expose_text())
+
+
 def test_composite_registry_dedupes_shared_registries():
     from cruise_control_tpu.core.sensors import CompositeRegistry
     shared = MetricRegistry()

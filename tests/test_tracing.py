@@ -1,0 +1,342 @@
+"""Span-tracer tests: unit behavior of core/tracing.py (nesting, ring
+bound, Chrome-trace export, registry feeding), the end-to-end propose→
+execute smoke (tier-1 gate for the /trace + /metrics surfaces: one cycle
+must yield a valid nested trace whose spans cover the request and carry
+per-goal search telemetry), and the zero-extra-syncs invariant (tracing
+adds no device fetches to the optimize path)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.sensors import MetricRegistry
+from cruise_control_tpu.core.tracing import SpanTracer, default_tracer
+
+from prom_lint import lint_prometheus_exposition
+from test_api import build_stack, call
+
+
+# ------------------------------------------------------------- unit tests
+
+def test_span_nesting_and_registry_feed():
+    t = SpanTracer()
+    with t.span("outer", kind="root") as outer:
+        assert t.current_span_id() == outer.span_id
+        with t.span("inner") as inner:
+            pass
+    spans = {s.name: s for s in t.spans()}
+    assert spans["inner"].parent_id == spans["outer"].span_id
+    assert spans["outer"].parent_id is None
+    assert spans["outer"].attrs["kind"] == "root"
+    # Chronology: the inner span finished first but both are buffered, and
+    # the outer's window covers the inner's.
+    assert spans["outer"].start_s <= spans["inner"].start_s
+    assert spans["inner"].end_s <= spans["outer"].end_s + 1e-9
+    # Every finished span feeds a Span.<name> timer.
+    assert t.registry.get(MetricRegistry.name("Span", "outer")).count == 1
+    assert t.registry.get(MetricRegistry.name("Span", "inner")).count == 1
+
+
+def test_span_records_error_attribute():
+    t = SpanTracer()
+    with pytest.raises(ValueError):
+        with t.span("boom"):
+            raise ValueError("x")
+    (s,) = t.spans()
+    assert s.attrs["error"] == "ValueError"
+
+
+def test_ring_buffer_bound_and_clear():
+    t = SpanTracer(capacity=8)
+    for i in range(20):
+        with t.span(f"s{i}"):
+            pass
+    spans = t.spans()
+    assert len(spans) == 8
+    assert t.dropped_spans == 12
+    assert spans[-1].name == "s19"
+    t.clear()
+    assert t.spans() == [] and t.dropped_spans == 0
+
+
+def test_record_reconstructed_child_spans():
+    """record() is how per-goal children of a fused device walk are
+    rebuilt: explicit start, duration and parent, no context manager."""
+    t = SpanTracer()
+    with t.span("walk") as walk:
+        pass
+    base = walk.start_s
+    t.record("goal.A", 0.25, start_s=base, parent_id=walk.span_id,
+             attrs={"iterations": 3})
+    t.record("goal.B", 0.75, start_s=base + 0.25, parent_id=walk.span_id)
+    spans = {s.name: s for s in t.spans()}
+    assert spans["goal.A"].parent_id == walk.span_id
+    assert spans["goal.B"].start_s == pytest.approx(base + 0.25)
+    assert spans["goal.A"].attrs["iterations"] == 3
+    # default parent = the current active span
+    with t.span("outer") as outer:
+        t.record("child", 0.01)
+    spans = {s.name: s for s in t.spans()}
+    assert spans["child"].parent_id == outer.span_id
+
+
+def test_disabled_tracer_is_a_noop():
+    t = SpanTracer()
+    t.enabled = False
+    with t.span("x") as sp:
+        sp.set(a=1)
+    t.record("y", 0.1)
+    assert t.spans() == []
+    t.enabled = True
+
+
+def test_traced_decorator():
+    t = SpanTracer()
+
+    @t.traced("my.op")
+    def op(a, b):
+        return a + b
+
+    assert op(2, 3) == 5
+    assert [s.name for s in t.spans()] == ["my.op"]
+
+
+def test_chrome_trace_export_shape():
+    t = SpanTracer()
+    with t.span("parent"):
+        with t.span("child", detail=7):
+            pass
+    trace = t.to_chrome_trace()
+    assert trace["displayTimeUnit"] == "ms"
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    xs = [e for e in events if e["ph"] == "X"]
+    assert meta and meta[0]["name"] == "thread_name"
+    assert {e["name"] for e in xs} == {"parent", "child"}
+    by_name = {e["name"]: e for e in xs}
+    child, parent = by_name["child"], by_name["parent"]
+    assert child["args"]["parentId"] == parent["args"]["spanId"]
+    assert child["args"]["detail"] == 7
+    # Nesting holds in exported microsecond timestamps too.
+    assert parent["ts"] <= child["ts"]
+    assert child["ts"] + child["dur"] <= parent["ts"] + parent["dur"] + 1.0
+    # The whole payload is JSON-serializable as-is.
+    json.loads(json.dumps(trace))
+
+
+def test_threads_get_independent_span_stacks():
+    import threading
+    t = SpanTracer()
+    done = threading.Event()
+
+    def worker():
+        with t.span("worker-root"):
+            done.set()
+
+    with t.span("main-root"):
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    spans = {s.name: s for s in t.spans()}
+    # The worker's root must NOT be parented under the main thread's span.
+    assert spans["worker-root"].parent_id is None
+    assert spans["main-root"].parent_id is None
+    assert done.is_set()
+
+
+# --------------------------------------------------- end-to-end smoke gate
+
+def _span_index(spans):
+    by_id, children = {}, {}
+    for s in spans:
+        by_id[s["spanId"]] = s
+        children.setdefault(s["parentId"], []).append(s)
+    return by_id, children
+
+
+@pytest.fixture(scope="module")
+def stack():
+    sim, facade, app = build_stack()
+    yield sim, facade, app
+    app.stop()
+
+
+def test_e2e_propose_cycle_trace_and_metrics(stack):
+    """Tier-1 smoke for the whole observability surface: one propose→
+    execute cycle yields (a) a /trace dump of valid, correctly nested
+    Chrome trace-event JSON whose spans cover the request wall-clock, (b)
+    per-goal acceptance/iteration telemetry in the response, (c) a
+    /metrics exposition that scrapes cleanly."""
+    _, facade, app = stack
+    facade.tracer.clear()
+    status, body, _ = call(
+        app, "POST", "rebalance",
+        "dryrun=false&ignore_proposal_cache=true&get_response_timeout_s=300")
+    assert status == 200, body
+
+    # (b) device-side search telemetry rode the existing end-of-chain
+    # fetch into the response.
+    tel = body["searchTelemetry"]
+    assert tel["totalMoves"] == body["summary"]["numActions"]
+    per_goal = {g["goal"]: g for g in tel["perGoal"]}
+    assert per_goal and all("accepted" in g and "iterations" in g
+                            for g in per_goal.values())
+    assert sum(g["accepted"] for g in per_goal.values()) == tel["totalMoves"]
+    traj = np.asarray(tel["violationTrajectory"])
+    assert traj.ndim == 2 and traj.shape[0] >= len(per_goal) + 1
+    assert traj.shape[1] == len(per_goal)
+
+    # (a) /trace over real HTTP: valid JSON, spans nest, durations cover
+    # the operation.
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/trace", timeout=60) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"] == "application/json"
+        trace = json.loads(resp.read())
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    names = {e["name"] for e in xs}
+    for expected in ("api.rebalance", "task.rebalance",
+                     "monitor.cluster-model", "monitor.aggregate",
+                     "monitor.model-build", "aggregator.aggregate",
+                     "optimizer.optimize", "optimizer.prepare",
+                     "optimizer.walk", "optimizer.finish",
+                     "executor.execute", "executor.task"):
+        assert expected in names, f"missing span {expected}: {sorted(names)}"
+    goal_spans = [e for e in xs if e["name"].startswith("goal.")]
+    assert len(goal_spans) >= len(per_goal)
+    assert all("iterations" in e["args"] and "accepted" in e["args"]
+               for e in goal_spans)
+
+    args = {e["args"]["spanId"]: e for e in xs}
+
+    def parent_of(ev):
+        return args.get(ev["args"]["parentId"])
+
+    # Nesting: every per-goal span sits inside the optimizer walk, which
+    # sits inside optimizer.optimize, which roots at task.rebalance.
+    walk = next(e for e in xs if e["name"] == "optimizer.walk")
+    for e in goal_spans:
+        assert parent_of(e)["name"] == "optimizer.walk"
+        assert e["ts"] >= walk["ts"] - 1.0
+        assert e["ts"] + e["dur"] <= walk["ts"] + walk["dur"] + 1e3
+    opt = parent_of(walk)
+    assert opt["name"] == "optimizer.optimize"
+    root = next(e for e in xs if e["name"] == "task.rebalance")
+    # The pipeline stages' durations sum to ~the request task's timer:
+    # monitor + optimize + execute are (essentially) the whole operation.
+    stage_us = sum(e["dur"] for e in xs
+                   if e["name"] in ("monitor.cluster-model",
+                                    "optimizer.optimize",
+                                    "executor.execute")
+                   and args.get(e["args"]["parentId"]) is not None
+                   and _rooted_at(args, e, root["args"]["spanId"]))
+    assert stage_us <= root["dur"] * 1.05 + 1e4
+    assert stage_us >= root["dur"] * 0.5
+
+    # (c) /metrics scrapes cleanly and carries the per-goal series.
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/metrics", timeout=60) as resp:
+        text = resp.read().decode()
+    lint_prometheus_exposition(text)
+    assert "cc_GoalOptimizer_goal_" in text
+    assert "cc_Span_optimizer_optimize_seconds_count" in text
+
+    # /state embeds the span snapshot on request.
+    status, body, _ = call(app, "GET", "state", "substates=tracing")
+    assert status == 200
+    assert body["Tracing"]["numSpans"] > 0
+    assert any(s["name"] == "optimizer.optimize"
+               for s in body["Tracing"]["spans"])
+
+
+def _rooted_at(by_id, ev, root_id):
+    seen = set()
+    cur = ev
+    while cur is not None and cur["args"]["spanId"] not in seen:
+        if cur["args"]["spanId"] == root_id:
+            return True
+        seen.add(cur["args"]["spanId"])
+        cur = by_id.get(cur["args"]["parentId"])
+    return False
+
+
+def test_trace_endpoint_registers_request_sensors(stack):
+    """Satellite: the bare handlers route through the shared timing
+    wrapper — /metrics and /trace mark request meters and success timers
+    like any dispatched endpoint."""
+    _, _, app = stack
+    for ep in ("metrics", "trace"):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/{ep}", timeout=60):
+            pass
+        assert app.registry.get(
+            f"KafkaCruiseControlServlet.{ep}-request-rate").count >= 1
+        assert app.registry.get(
+            f"KafkaCruiseControlServlet.{ep}-successful-"
+            "request-execution-timer").count >= 1
+
+
+def test_branched_path_returns_no_telemetry_payload():
+    """An unobservable-boundaries walk (trajectory=None, the branched
+    shard_map path) must yield telemetry=None — not a dict of zeros that
+    breaks the sum(accepted) == totalMoves invariant."""
+    from cruise_control_tpu.analyzer import TpuGoalOptimizer
+    from cruise_control_tpu.analyzer.optimizer import GoalResult
+    opt = TpuGoalOptimizer()
+    grs = [GoalResult(name="X", hard=False, violation_before=1.0,
+                      violation_after=0.0, duration_s=0.5, iterations=0)]
+    assert opt._record_goal_telemetry(grs, None, 7) is None
+    tel = opt._record_goal_telemetry(grs, [[1.0], [0.0]], 7)
+    assert tel["totalMoves"] == 7 and tel["violationTrajectory"] == [
+        [1.0], [0.0]]
+
+
+# ------------------------------------------------------- zero extra syncs
+
+def test_tracing_adds_zero_device_syncs(stack, monkeypatch):
+    """Acceptance gate: the tracer and its telemetry must ride existing
+    fetches — optimize() performs exactly as many host fetches with
+    tracing enabled as with it disabled. Reuses the module stack's
+    already-compiled optimizer (the e2e test warmed it) so this costs
+    optimize runs, not fresh XLA compiles."""
+    import jax
+
+    from cruise_control_tpu.analyzer import OptimizationOptions
+    _, facade, _ = stack
+    result = facade.monitor.cluster_model(4000)
+    model, md = result.model, result.metadata
+    opt = facade.optimizer
+    run_opts = OptimizationOptions(seed=3, skip_hard_goal_check=True)
+    opt.optimize(model, md, run_opts)    # ensure the chain is warm
+
+    counts = {"device_get": 0, "block": 0}
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def counting_get(x):
+        counts["device_get"] += 1
+        return real_get(x)
+
+    def counting_block(x):
+        counts["block"] += 1
+        return real_block(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    monkeypatch.setattr(jax, "block_until_ready", counting_block)
+    tracer = opt.tracer
+
+    def run_counted(enabled: bool) -> dict:
+        tracer.enabled = enabled
+        counts.update(device_get=0, block=0)
+        res = opt.optimize(model, md, run_opts)
+        assert sum(g.accepted for g in res.goal_results) == res.num_moves
+        return dict(counts)
+
+    try:
+        with_tracing = run_counted(True)
+        without = run_counted(False)
+    finally:
+        tracer.enabled = True
+    assert with_tracing == without, (
+        f"tracing changed host-fetch counts: {with_tracing} vs {without}")
