@@ -234,6 +234,41 @@ def run_tracer_overhead_bench(num_brokers: int = 50,
             "overhead_pct": overhead_pct}
 
 
+def run_chaos_recovery_bench(*, seed: int = 11, emit_row: bool = True,
+                             max_steps: int = 200) -> dict:
+    """Recovery time under the canonical chaos scenario: a broker dies
+    mid-run and the detector→optimizer→executor loop drains and restores
+    it. Value = simulated steps from the observed crash to restored
+    balancedness (healthy, fully-replicated, executor idle) — tracked so
+    a regression in the heal path (slower detection, stuck teardown,
+    extra execution rounds) fails review like a perf regression. Fully
+    deterministic in ``seed``; invariants gate the row (a recovery that
+    loses replicas must fail the bench, not report a fast number)."""
+    from cruise_control_tpu.chaos import (ChaosHarness, check_invariants,
+                                          snapshot_topology)
+    h = ChaosHarness(seed=seed)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    s0 = h.engine.step
+    h.engine.schedule(s0 + 2, "kill_broker", broker=1)
+    h.engine.schedule(s0 + 9, "restart_broker", broker=1)
+    h.steps_until(lambda: not h.sim.describe_cluster().get(1, True), 20,
+                  what="scheduled broker kill")
+    t0 = time.monotonic()
+    steps = h.steps_until(h.healed, max_steps, what="post-crash recovery")
+    wall_s = time.monotonic() - t0
+    problems = check_invariants(h.sim, base, h.executor)
+    if problems:
+        raise RuntimeError("chaos recovery bench violated invariants "
+                           f"(seed={seed}): " + "; ".join(problems))
+    log(f"chaos recovery (seed={seed}): {steps} steps crash->balanced "
+        f"({wall_s:.1f}s wall, {h.detector.num_self_healing_started} "
+        "fixes)")
+    if emit_row:
+        emit("chaos_recovery_steps", steps, "steps", None)
+    return {"steps": steps, "seed": seed, "wall_s": wall_s}
+
+
 def build_spec():
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
                                                PartitionSpec)
@@ -682,6 +717,9 @@ def main():
     run_model_build_bench()
     # Observability tax: the span tracer must be ~free on the propose path.
     run_tracer_overhead_bench()
+    # Robustness: steps from injected broker crash to restored
+    # balancedness through the full heal loop.
+    run_chaos_recovery_bench()
     t0 = time.monotonic()
     spec = build_spec()
     model, md = flatten_spec(spec)

@@ -90,6 +90,11 @@ class OptimizerResult:
     #: per-goal iteration/acceptance counts and the whole-chain violation
     #: trajectory. None on paths that cannot observe boundaries (branched).
     telemetry: dict | None = None
+    #: True when the cluster model these proposals were computed from was
+    #: stale-served (monitor degradation under sample dropouts) — the
+    #: facade's execution gate refuses to act on such results unless the
+    #: operator opted in (see monitor.StaleClusterModelError).
+    stale_model: bool = False
 
     @property
     def violated_goals_before(self) -> list[str]:

@@ -9,6 +9,7 @@ only parses parameters and serializes results.
 from __future__ import annotations
 
 import fnmatch
+import logging
 import threading
 import time as _time
 
@@ -26,8 +27,12 @@ from ..monitor import (LoadMonitor, LoadMonitorTaskRunner,
                        ModelCompletenessRequirements)
 from ..core.metricdef import BrokerMetric
 from ..core.resources import Resource
+from ..core.retry import RetryPolicy
+from ..executor.kafka_admin import RETRYABLE_ADMIN_ERRORS
 from .precompute import ProposalCache
 from .progress import OperationProgress
+
+LOG = logging.getLogger(__name__)
 
 
 class KafkaCruiseControl:
@@ -40,7 +45,8 @@ class KafkaCruiseControl:
                  detector=None,
                  options_generator=None,
                  cpu_model: LinearRegressionModelParameters | None = None,
-                 now_ms=None) -> None:
+                 now_ms=None, admin_retry: RetryPolicy | None = None,
+                 sleep_ms=None) -> None:
         self.admin = admin
         self.monitor = monitor
         self.task_runner = task_runner
@@ -64,6 +70,19 @@ class KafkaCruiseControl:
         #: (clusters without reliable rack metadata).
         self.rf_self_healing_skip_rack_check: bool = False
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        #: shared backoff policy for the facade's direct admin reads —
+        #: one transient AdminTimeoutError must not fail a whole REST
+        #: request (the executor carries its own copy for write paths).
+        #: serve.py wires it from the admin.retry.* keys; the chaos
+        #: harness passes the engine's sleep so retries stay on the
+        #: simulated clock.
+        self.admin_retry = admin_retry or RetryPolicy()
+        self._admin_sleep_ms = sleep_ms
+        #: opt-out for the stale-model execution gate: when True,
+        #: non-dryrun operations may act on stale-served models (see
+        #: StaleClusterModelError; operators who prefer availability
+        #: over topology freshness during sample outages)
+        self.allow_stale_execution = False
         self.proposal_cache = ProposalCache(monitor, self.optimizer)
         # Shared with the metrics processor so a TRAIN-fitted regression
         # feeds CPU estimation for samples that lack broker CPU.
@@ -78,11 +97,19 @@ class KafkaCruiseControl:
         #: dropwizardMetricRegistry through every constructor; here the
         #: facade is the aggregation point instead). Resolved at scrape
         #: time so a detector attached after construction is included.
-        from ..core.sensors import CompositeRegistry
+        from ..core.sensors import CompositeRegistry, MetricRegistry
 
         #: extra per-layer registries merged into the scrape view (the
         #: web app appends its servlet-request sensors here).
         self.extra_registries: list = []
+
+        # Facade-owned sensors: the retried-admin-read meter must be
+        # scrape-visible like the executor's (silent degradation is the
+        # failure mode the robustness layer exists to prevent).
+        self._own_registry = MetricRegistry()
+        self._admin_retries = self._own_registry.meter(
+            MetricRegistry.name("KafkaCruiseControl", "admin-retry-rate"))
+        self.extra_registries.append(self._own_registry)
 
         #: span tracer serving /trace and /state?substates=tracing — the
         #: optimizer's tracer (the process default unless overridden), so
@@ -105,6 +132,23 @@ class KafkaCruiseControl:
             return regs + list(self.extra_registries)
 
         self.registry = CompositeRegistry(_registries)
+
+    def _admin_read(self, fn, *args):
+        """Run a read-only admin RPC under the shared retry policy:
+        transient timeouts back off and re-attempt (metered on the
+        facade's `admin-retry-rate` and logged — silent degradation is
+        the failure mode this PR exists to prevent), fatal errors surface
+        on the first try."""
+        def on_retry(attempt, delay_ms, exc):
+            self._admin_retries.mark()
+            LOG.warning(
+                "facade admin read %s failed transiently (%s: %s); retry "
+                "%d in %d ms", getattr(fn, "__name__", fn),
+                type(exc).__name__, exc, attempt + 1, delay_ms)
+        return self.admin_retry.call(fn, *args,
+                                     retry_on=RETRYABLE_ADMIN_ERRORS,
+                                     sleep_ms=self._admin_sleep_ms,
+                                     on_retry=on_retry)
 
     # ----------------------------------------------------------- lifecycle
     def start_up(self, precompute_interval_s: float = 30.0,
@@ -215,13 +259,38 @@ class KafkaCruiseControl:
             res = _dc_replace(res, proposals=diff_proposals_vs_placement(
                 original_placement, model, res.final_model, metadata,
                 mutated_keys))
+        if result.stale:
+            from dataclasses import replace as _dc_replace
+            res = _dc_replace(res, stale_model=True)
         return res
+
+    def _refuse_stale_execution(self, source_stale: bool) -> None:
+        """The stale-model execution gate, shared by EVERY non-dryrun
+        path (inter-broker via _maybe_execute, intra-broker via
+        remove_disks): stale models are fine to LOOK at (dryrun, /load,
+        proposals) but not to ACT on — their topology predates the
+        dropout, so executing moves computed from them can target dead
+        brokers/disks or undo post-cache changes. Checked two ways: the
+        caller says whether ITS source model was stale-served, and the
+        monitor is asked whether live sample flow is broken RIGHT NOW (a
+        total dropout freezes the model generation, so cached proposals
+        can stay "valid" without any model build flagging staleness)."""
+        if not self.allow_stale_execution and (
+                source_stale
+                or self.monitor.history_stale(self._now_ms())):
+            from ..monitor import StaleClusterModelError
+            raise StaleClusterModelError(
+                "refusing non-dryrun execution against a stale cluster "
+                "model (source model stale-served: "
+                f"{source_stale}); wait for sample history to recover "
+                "or set allow_stale_execution")
 
     def _maybe_execute(self, res: OptimizerResult, dryrun: bool,
                        uuid: str, progress: OperationProgress | None,
                        **executor_kwargs):
         if dryrun or not res.proposals:
             return None
+        self._refuse_stale_execution(res.stale_model)
         if progress:
             progress.add_step("ExecutingProposals")
         return self.executor.execute_proposals(res.proposals, uuid=uuid,
@@ -268,7 +337,8 @@ class KafkaCruiseControl:
             return spec
         options = _dc_replace(options or OptimizationOptions(),
                               destination_broker_ids=frozenset(broker_ids))
-        res = self._optimize(progress, goals, options, spec_mutator=mark_new)
+        res = self._optimize(progress, goals, options,
+                             spec_mutator=mark_new)
         exec_res = self._maybe_execute(res, dryrun, uuid, progress,
                                        **executor_kwargs)
         return res, exec_res
@@ -333,7 +403,8 @@ class KafkaCruiseControl:
         excluded_parts = set(options.excluded_partitions)
         if skip_urp_demotion:
             excluded_parts |= {
-                tp for tp, info in self.admin.describe_partitions().items()
+                tp for tp, info in self._admin_read(
+                    self.admin.describe_partitions).items()
                 if len(info.isr) < len(info.replicas)}
 
         def mark_demoted(spec):
@@ -483,9 +554,10 @@ class KafkaCruiseControl:
         disk_by_broker: dict[int, dict[str, float]] = {}
         if populate_disk_info:
             sizes = {tp: i.size_mb
-                     for tp, i in self.admin.describe_partitions().items()}
-            for (t, p, b), d in self.admin.describe_replica_log_dirs(
-                    ).items():
+                     for tp, i in self._admin_read(
+                         self.admin.describe_partitions).items()}
+            for (t, p, b), d in self._admin_read(
+                    self.admin.describe_replica_log_dirs).items():
                 disk_by_broker.setdefault(b, {})
                 disk_by_broker[b][d] = (disk_by_broker[b].get(d, 0.0)
                                         + sizes.get((t, p), 0.0))
@@ -557,11 +629,11 @@ class KafkaCruiseControl:
         ``verbose`` adds per-partition leader/replicas/ISR detail (ref
         KafkaClusterState.writeKafkaClusterState verbose sections);
         ``topic_pattern`` scopes the partition view (ref TOPIC_PARAM)."""
-        parts = self.admin.describe_partitions()
+        parts = self._admin_read(self.admin.describe_partitions)
         if topic_pattern:
             parts = {tp: i for tp, i in parts.items()
                      if fnmatch.fnmatch(tp[0], topic_pattern)}
-        alive = self.admin.describe_cluster()
+        alive = self._admin_read(self.admin.describe_cluster)
         under_replicated = [list(tp) for tp, i in parts.items()
                             if len(i.isr) < len(i.replicas)]
         offline = [list(tp) for tp, i in parts.items()
@@ -697,6 +769,7 @@ class KafkaCruiseControl:
                "iterations": res.iterations,
                "moves": [m.to_json() for m in res.moves]}
         if not dryrun and res.moves:
+            self._refuse_stale_execution(result.stale)
             if progress:
                 progress.add_step("ExecutingIntraBrokerMoves")
             exec_res = self.executor.execute_proposals(

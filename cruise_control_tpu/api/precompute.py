@@ -80,6 +80,11 @@ class ProposalCache:
         model_result = self.monitor.cluster_model(now_ms)
         result = self.optimizer.optimize(model_result.model,
                                          model_result.metadata, self.options)
+        if model_result.stale:
+            # Carried to the facade's execution gate: cached proposals
+            # computed from a stale-served model must not execute.
+            from dataclasses import replace
+            result = replace(result, stale_model=True)
         with self._lock:
             self._cached = result
             self._cached_generation = gen

@@ -1,0 +1,33 @@
+"""Deterministic chaos engine for the monitor→optimize→execute→heal loop.
+
+The reference validates its failure paths with embedded-broker integration
+tests plus ad-hoc fault injection; this package is the systematic
+equivalent for the simulated control plane: a **seeded, step-keyed fault
+scheduler** (:class:`ChaosEngine`) that drives scripted and randomized
+fault schedules — broker crash/recovery, logdir failure, sustained and
+burst admin RPC errors, stalled reassignments, metric-sample dropouts,
+clock jumps — through the full stack, with an invariant checker
+(:mod:`~cruise_control_tpu.chaos.invariants`) and a ready-wired
+full-stack harness (:class:`ChaosHarness`) shared by the chaos test
+suite and the ``chaos_recovery_steps`` bench row.
+
+Every fault decision derives from ``(seed, step/call counter)`` — never
+wall clock or global RNG — so any failing run replays exactly from its
+seed (see docs/robustness.md, "Replaying a failing seed").
+"""
+
+from .engine import ChaosAdminClient, ChaosEngine, ChaosSampler, FaultEvent
+from .harness import ChaosHarness, build_sim, default_optimizer
+from .invariants import check_invariants, snapshot_topology
+
+__all__ = [
+    "ChaosAdminClient",
+    "ChaosEngine",
+    "ChaosHarness",
+    "ChaosSampler",
+    "FaultEvent",
+    "build_sim",
+    "check_invariants",
+    "default_optimizer",
+    "snapshot_topology",
+]

@@ -1,0 +1,354 @@
+"""The chaos engine: seeded, step-keyed fault injection over the simulated
+cluster.
+
+Three cooperating pieces:
+
+- :class:`ChaosEngine` owns the fault schedule (scripted
+  :class:`FaultEvent` list) and the simulated clock. Time advances only
+  through :meth:`ChaosEngine.tick` / :meth:`ChaosEngine.sleep_ms` (the
+  executor's sleep is wired to the latter), and due events apply **in
+  schedule order at their exact simulated timestamps** — so a broker
+  crash scheduled for step 7 lands mid-execution if the executor happens
+  to be sleeping across step 7, exactly the same way on every replay.
+- :class:`ChaosAdminClient` wraps a
+  :class:`~cruise_control_tpu.executor.admin.ClusterAdminClient` and
+  consults the engine before every RPC: sustained error *rates* (a
+  deterministic per-call draw keyed off ``(seed, method, call#)``) and
+  finite *bursts* raise classified admin errors
+  (:class:`~cruise_control_tpu.executor.kafka_admin.AdminTimeoutError`
+  for retryable codes) — the generalization of the mock wire's
+  ``fail_with`` hook to rates.
+- :class:`ChaosSampler` wraps a
+  :class:`~cruise_control_tpu.monitor.sampler.MetricSampler` and drops
+  whole sampling rounds at the scheduled rate — the metric-dropout fault
+  the monitor's stale-model degradation defends against.
+
+Nothing here touches ``time.time``/``random`` module state: the same
+``(schedule, seed)`` pair always produces the same run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..core.retry import deterministic_uniform as _draw
+from ..executor.kafka_admin import (AdminOperationError, AdminTimeoutError,
+                                    consume_injection)
+from ..monitor.sampler import Samples
+
+
+@dataclass
+class FaultEvent:
+    """One scheduled fault: ``action`` (an :data:`ChaosEngine.ACTIONS`
+    name) applied when the engine's clock reaches ``step``."""
+
+    step: int
+    action: str
+    kwargs: dict = field(default_factory=dict)
+
+    def describe(self) -> str:
+        args = ", ".join(f"{k}={v!r}" for k, v in self.kwargs.items())
+        return f"step {self.step}: {self.action}({args})"
+
+
+class ChaosAdminClient:
+    """Admin-SPI wrapper injecting rate/burst errors before delegation.
+
+    Only the mutating + polling RPCs the executor and facade issue are
+    interception points; everything else (test hooks, ``offline_logdirs``,
+    ``broker_metrics``) passes through untouched via ``__getattr__``.
+    """
+
+    #: kept in lockstep with the explicit delegation methods below by
+    #: test_chaos_admin_client_intercepts_every_declared_rpc
+    INTERCEPTED = (
+        "describe_cluster", "describe_partitions",
+        "alter_partition_reassignments", "list_partition_reassignments",
+        "elect_preferred_leaders", "alter_replica_log_dirs",
+        "describe_replica_log_dirs", "alter_broker_config",
+        "describe_broker_config", "alter_topic_config",
+        "describe_topic_config",
+    )
+
+    def __init__(self, inner, engine: "ChaosEngine") -> None:
+        self.inner = inner
+        self.engine = engine
+
+    def _call(self, name, *args, **kwargs):
+        self.engine.maybe_fail_admin(name)
+        return getattr(self.inner, name)(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    # Explicit delegations so the wrapper satisfies the ClusterAdminClient
+    # protocol statically (and every RPC is one grep away).
+    def describe_cluster(self):
+        return self._call("describe_cluster")
+
+    def describe_partitions(self):
+        return self._call("describe_partitions")
+
+    def alter_partition_reassignments(self, targets):
+        return self._call("alter_partition_reassignments", targets)
+
+    def list_partition_reassignments(self):
+        return self._call("list_partition_reassignments")
+
+    def elect_preferred_leaders(self, tps):
+        return self._call("elect_preferred_leaders", tps)
+
+    def alter_replica_log_dirs(self, moves):
+        return self._call("alter_replica_log_dirs", moves)
+
+    def describe_replica_log_dirs(self):
+        return self._call("describe_replica_log_dirs")
+
+    def alter_broker_config(self, broker_id, config):
+        return self._call("alter_broker_config", broker_id, config)
+
+    def describe_broker_config(self, broker_id):
+        return self._call("describe_broker_config", broker_id)
+
+    def alter_topic_config(self, topic, config):
+        return self._call("alter_topic_config", topic, config)
+
+    def describe_topic_config(self, topic):
+        return self._call("describe_topic_config", topic)
+
+
+class ChaosSampler:
+    """MetricSampler wrapper dropping whole rounds at the engine's
+    scheduled ``sample_drop_rate`` (deterministic per-round draw)."""
+
+    parallel_safe = False
+
+    def __init__(self, inner, engine: "ChaosEngine") -> None:
+        self.inner = inner
+        self.engine = engine
+        self._rounds = 0
+
+    def get_samples(self, assignment):
+        self._rounds += 1
+        rate = self.engine.sample_drop_rate
+        if rate and _draw(self.engine.seed, "sampler", self._rounds) < rate:
+            self.engine.note("sampler", "dropped round "
+                             f"[{assignment.start_ms}, {assignment.end_ms})")
+            return Samples([], [])
+        return self.inner.get_samples(assignment)
+
+
+class ChaosEngine:
+    """Seeded fault scheduler + deterministic clock for one simulated
+    cluster (`sim` is a
+    :class:`~cruise_control_tpu.executor.simulated.SimulatedKafkaCluster`).
+
+    The step counter is the schedule key: step ``k`` corresponds to
+    simulated time ``k * step_ms``. :meth:`tick` advances one step;
+    :meth:`sleep_ms` (handed to the executor as its sleep) advances
+    arbitrary spans — both apply due events at their exact timestamps on
+    the way, so faults land mid-execution deterministically.
+    """
+
+    #: action name -> handler(self, **kwargs); the schedule vocabulary
+    ACTIONS = ("kill_broker", "restart_broker", "fail_logdir",
+               "stall_broker", "unstall_broker", "admin_error_rate",
+               "admin_burst", "drop_samples", "clock_jump")
+
+    def __init__(self, sim, *, seed: int = 0, step_ms: int = 1000,
+                 events: list[FaultEvent] | None = None) -> None:
+        self.sim = sim
+        self.seed = seed
+        self.step_ms = step_ms
+        self.admin = ChaosAdminClient(sim, self)
+        #: pending schedule, kept sorted by (step, insertion order)
+        self._pending: list[tuple[int, int, FaultEvent]] = []
+        self._order = 0
+        for e in events or ():
+            self.schedule(e.step, e.action, **e.kwargs)
+        #: replay/diagnosis log of everything the engine did
+        self.applied: list[str] = []
+        #: method -> (rate in [0,1], error code) sustained injections
+        self.admin_error_rates: dict[str, tuple[float, str]] = {}
+        #: method -> (error code, remaining count) burst injections
+        self.admin_bursts: dict[str, tuple[str, int]] = {}
+        #: probability a sampling round is dropped wholesale
+        self.sample_drop_rate = 0.0
+        self._admin_counters: dict[str, int] = {}
+        self._saved_rates: dict[int, float] = {}
+        #: clock offset applied on top of sim time (clock_jump faults)
+        self._jumped_ms = 0
+
+    # ------------------------------------------------------------- clock
+    @property
+    def step(self) -> int:
+        return self.sim.now_ms // self.step_ms
+
+    def now_ms(self) -> int:
+        return self.sim.now_ms
+
+    def schedule(self, step: int, action: str, **kwargs) -> None:
+        if action not in self.ACTIONS:
+            raise ValueError(f"unknown chaos action {action!r}; "
+                             f"expected one of {self.ACTIONS}")
+        self._pending.append((step, self._order,
+                              FaultEvent(step, action, kwargs)))
+        self._order += 1
+        self._pending.sort(key=lambda t: (t[0], t[1]))
+
+    def note(self, source: str, what: str) -> None:
+        self.applied.append(f"[{self.sim.now_ms}ms] {source}: {what}")
+
+    def sleep_ms(self, ms: int) -> None:
+        """Advance simulated time, applying due events at their exact
+        timestamps — the executor's sleep, so scheduled faults interleave
+        with execution progress deterministically."""
+        target = self.sim.now_ms + ms
+        while self._pending and self._pending[0][0] * self.step_ms <= target:
+            step, _, event = self._pending.pop(0)
+            at = max(step * self.step_ms, self.sim.now_ms)
+            self.sim.advance_to(at)
+            self._apply(event)
+        # A clock_jump applied above may have leapt past the original
+        # target — never rewind the simulated clock to pre-jump time.
+        self.sim.advance_to(max(target, self.sim.now_ms))
+
+    def tick(self, steps: int = 1) -> None:
+        for _ in range(steps):
+            self.sleep_ms(self.step_ms)
+
+    # ------------------------------------------------------------ faults
+    def _apply(self, event: FaultEvent) -> None:
+        self.note("schedule", event.describe())
+        getattr(self, f"_do_{event.action}")(**event.kwargs)
+
+    def _do_kill_broker(self, broker: int) -> None:
+        self.sim.kill_broker(broker)
+
+    def _do_restart_broker(self, broker: int) -> None:
+        self.sim.restart_broker(broker)
+
+    def _do_fail_logdir(self, broker: int, logdir: str | None = None) -> None:
+        self.sim.fail_logdir(broker,
+                             logdir or self.sim._healthy_logdir(broker))
+
+    def _do_stall_broker(self, broker: int) -> None:
+        """Stalled reassignment: incoming-copy bandwidth collapses to ~0
+        (the broker stays alive, so dead-task detection does NOT fire —
+        only the movement timeout or the watchdog can unwedge it)."""
+        b = self.sim._brokers[broker]
+        self._saved_rates.setdefault(broker, b.reassignment_rate_mb_s)
+        b.reassignment_rate_mb_s = 1e-9
+
+    def _do_unstall_broker(self, broker: int) -> None:
+        saved = self._saved_rates.pop(broker, None)
+        if saved is not None:
+            self.sim._brokers[broker].reassignment_rate_mb_s = saved
+
+    def _do_admin_error_rate(self, method: str, rate: float,
+                             code: str = "REQUEST_TIMED_OUT") -> None:
+        if rate <= 0:
+            self.admin_error_rates.pop(method, None)
+        else:
+            self.admin_error_rates[method] = (min(rate, 1.0), code)
+
+    def _do_admin_burst(self, method: str, count: int,
+                        code: str = "REQUEST_TIMED_OUT") -> None:
+        self.admin_bursts[method] = (code, count)
+
+    def _do_drop_samples(self, rate: float) -> None:
+        self.sample_drop_rate = min(max(rate, 0.0), 1.0)
+
+    def _do_clock_jump(self, ms: int) -> None:
+        """Forward clock jump: simulated time leaps (windows roll, time
+        thresholds trip early). In-flight copies see the elapsed time too
+        — a wall-clock jump on a live cluster does the same."""
+        self._jumped_ms += ms
+        self.sim.advance_to(self.sim.now_ms + ms)
+
+    # ------------------------------------------------------- admin faults
+    def maybe_fail_admin(self, method: str) -> None:
+        """Raise the scheduled classified admin error for this call, if
+        any. Burst injections take precedence over sustained rates."""
+        n = self._admin_counters[method] = (
+            self._admin_counters.get(method, 0) + 1)
+        burst = self.admin_bursts.get(method)
+        if burst is not None:
+            fire, nxt = consume_injection(*burst)
+            if nxt is None:
+                self.admin_bursts.pop(method)
+            else:
+                self.admin_bursts[method] = nxt
+            if fire:
+                self._raise(method, fire)
+        entry = self.admin_error_rates.get(method)
+        if entry is not None:
+            rate, code = entry
+            if _draw(self.seed, method, n) < rate:
+                self._raise(method, code)
+
+    def _raise(self, method: str, code: str) -> None:
+        self.note("admin", f"injected {code} on {method} "
+                  f"(call #{self._admin_counters[method]})")
+        if code == "REQUEST_TIMED_OUT":
+            raise AdminTimeoutError(
+                f"chaos: {method} timed out (injected, seed={self.seed})")
+        raise AdminOperationError(
+            f"chaos: {method} failed with {code} (injected, "
+            f"seed={self.seed})")
+
+    # -------------------------------------------------- random schedules
+    def schedule_random_soak(self, steps: int, *,
+                             recover_margin: int = None) -> None:
+        """Generate a recoverable randomized fault schedule from the seed.
+
+        Deterministic in ``(seed, steps, cluster broker set)``. Every
+        destructive fault schedules its own recovery inside the first
+        ``steps - recover_margin`` steps, so the post-schedule heal phase
+        can always restore a healthy cluster — the soak asserts recovery,
+        not mere survival.
+        """
+        import random
+        rng = random.Random(self.seed)
+        brokers = sorted(self.sim.describe_cluster())
+        margin = (steps // 3 if recover_margin is None else recover_margin)
+        horizon = max(steps - margin, 1)
+
+        # One broker crash + recovery (never more than one dead at once:
+        # rf-2 test topologies cannot survive correlated double failures).
+        victim = rng.choice(brokers)
+        down = rng.randint(1, max(horizon // 3, 1))
+        at = rng.randint(0, max(horizon - down, 0))
+        self.schedule(at, "kill_broker", broker=victim)
+        self.schedule(at + down, "restart_broker", broker=victim)
+
+        # A sustained admin-timeout window on a random executor RPC.
+        method = rng.choice(["alter_partition_reassignments",
+                             "list_partition_reassignments",
+                             "describe_cluster",
+                             "elect_preferred_leaders"])
+        w0 = rng.randint(0, horizon)
+        self.schedule(w0, "admin_error_rate", method=method,
+                      rate=rng.uniform(0.1, 0.5))
+        self.schedule(min(w0 + rng.randint(1, max(horizon // 2, 1)), steps),
+                      "admin_error_rate", method=method, rate=0.0)
+
+        # A metric-dropout window.
+        d0 = rng.randint(0, horizon)
+        self.schedule(d0, "drop_samples", rate=rng.uniform(0.3, 0.9))
+        self.schedule(min(d0 + rng.randint(1, max(horizon // 2, 1)), steps),
+                      "drop_samples", rate=0.0)
+
+        # Optionally: a stall window on a (possibly different) broker.
+        if rng.random() < 0.5:
+            stall = rng.choice(brokers)
+            s0 = rng.randint(0, horizon)
+            self.schedule(s0, "stall_broker", broker=stall)
+            self.schedule(
+                min(s0 + rng.randint(1, max(horizon // 3, 1)), steps),
+                "unstall_broker", broker=stall)
+
+        # Optionally: a forward clock jump of a few windows.
+        if rng.random() < 0.5:
+            self.schedule(rng.randint(0, steps), "clock_jump",
+                          ms=self.step_ms * rng.randint(2, 8))

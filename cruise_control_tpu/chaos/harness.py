@@ -1,0 +1,206 @@
+"""Full-stack chaos harness: one object wiring sim → monitor → optimizer
+→ executor → detector around a :class:`~cruise_control_tpu.chaos.engine.
+ChaosEngine`, driven step-by-step with zero wall-clock sleeps.
+
+Shared by the chaos soak suite (tests/test_chaos.py) and the
+``chaos_recovery_steps`` bench row, so "time from broker crash to
+restored balancedness" means the same thing in both places.
+
+The loop is single-threaded and clock-driven: each :meth:`step` advances
+the engine one step (applying due faults), runs a sampling round if due,
+and runs one detector round — the serve.py serving loop, minus threads.
+Healing fixes run synchronously inside the detector round; the executor's
+sleeps advance the same simulated clock, so scheduled faults land
+mid-execution deterministically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..analyzer import SearchConfig, TpuGoalOptimizer, goals_by_name
+from ..api.facade import KafkaCruiseControl
+from ..core.retry import RetryPolicy
+from ..detector import (AnomalyDetectorManager, BrokerFailureDetector,
+                        DiskFailureDetector, SelfHealingNotifier)
+from ..executor import Executor, ExecutorConfig, SimulatedKafkaCluster
+from ..monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                       MetricFetcherManager, MonitorConfig)
+from ..monitor.sampler import SyntheticWorkloadSampler
+from .engine import ChaosEngine, ChaosSampler
+
+#: Small goal chain shared with tests/test_e2e.py and tests/test_api.py so
+#: compiled XLA shapes are reused across suites.
+DEFAULT_GOALS = ["RackAwareGoal", "ReplicaDistributionGoal",
+                 "DiskUsageDistributionGoal"]
+
+
+@functools.lru_cache(maxsize=4)
+def _cached_optimizer(goals: tuple) -> TpuGoalOptimizer:
+    return TpuGoalOptimizer(
+        goals=goals_by_name(list(goals)),
+        config=SearchConfig(num_replica_candidates=128,
+                            num_dest_candidates=8,
+                            apply_per_iter=128,
+                            max_iters_per_goal=96))
+
+
+def default_optimizer(goals: list[str] | None = None) -> TpuGoalOptimizer:
+    """The chaos-scale optimizer (small candidate pools, bounded iters).
+    Cached per goal chain: every harness in a process shares one
+    instance, so its jitted search shapes trace and compile ONCE no
+    matter how many scenarios run."""
+    return _cached_optimizer(tuple(goals or DEFAULT_GOALS))
+
+
+def build_sim(num_brokers: int = 4, partitions: int = 16, rf: int = 2,
+              *, rate_mb_s: float = 10_000.0,
+              logdirs: tuple[str, ...] = ("logdir0", "logdir1"),
+              size_mb: float = 10.0) -> SimulatedKafkaCluster:
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=rate_mb_s, logdirs=logdirs)
+    for p in range(partitions):
+        reps = [(p + k) % num_brokers for k in range(rf)]
+        sim.add_partition(f"t{p % 3}", p, reps, size_mb=size_mb + p)
+    return sim
+
+
+class ChaosHarness:
+    """The wired stack. All tunables default to chaos-test scale: short
+    windows, aggressive healing thresholds, retries + watchdog on."""
+
+    def __init__(self, sim: SimulatedKafkaCluster | None = None, *,
+                 seed: int = 0, step_ms: int = 1000,
+                 goals: list[str] | None = None,
+                 self_healing_threshold_steps: int = 3,
+                 replica_movement_timeout_ms: int | None = None,
+                 stuck_execution_timeout_ms: int = 0,
+                 admin_retry: RetryPolicy | None = None,
+                 serve_stale_on_incomplete: bool = True,
+                 fetch_max_retries: int = 1,
+                 optimizer: TpuGoalOptimizer | None = None) -> None:
+        self.sim = sim or build_sim()
+        self.engine = ChaosEngine(self.sim, seed=seed, step_ms=step_ms)
+        admin = self.engine.admin
+        goals = goals or list(DEFAULT_GOALS)
+
+        admin_retry = admin_retry or RetryPolicy(
+            max_attempts=4, backoff_ms=50, max_backoff_ms=4 * step_ms)
+        self.monitor = LoadMonitor(admin, MonitorConfig(
+            num_windows=4, window_ms=2 * step_ms,
+            min_samples_per_window=1,
+            num_broker_windows=4, broker_window_ms=2 * step_ms,
+            serve_stale_on_incomplete=serve_stale_on_incomplete),
+            admin_retry=admin_retry, sleep_ms=self.engine.sleep_ms)
+        self.sampler = ChaosSampler(SyntheticWorkloadSampler(admin),
+                                    self.engine)
+        self.fetcher = MetricFetcherManager(self.sampler,
+                                            max_retries=fetch_max_retries)
+        self.runner = LoadMonitorTaskRunner(
+            self.monitor, self.fetcher, sampling_interval_ms=step_ms)
+        self.executor = Executor(
+            admin,
+            ExecutorConfig(
+                progress_check_interval_ms=step_ms,
+                min_progress_check_interval_ms=step_ms,
+                replica_movement_timeout_ms=(
+                    replica_movement_timeout_ms
+                    if replica_movement_timeout_ms is not None
+                    else 600 * step_ms),
+                stuck_execution_timeout_ms=stuck_execution_timeout_ms,
+                admin_retry=admin_retry,
+                concurrency_adjuster_enabled=False),
+            now_ms=self.engine.now_ms, sleep_ms=self.engine.sleep_ms)
+        # Scenario suites pass ONE shared optimizer: its jit caches are
+        # keyed per instance, so sharing turns N scenario compiles into 1.
+        optimizer = optimizer or default_optimizer(goals)
+        self.facade = KafkaCruiseControl(
+            admin, self.monitor, task_runner=self.runner,
+            optimizer=optimizer, executor=self.executor,
+            now_ms=self.engine.now_ms,
+            admin_retry=self.executor.config.admin_retry,
+            sleep_ms=self.engine.sleep_ms)
+        self.facade.self_healing_goals = goals
+        self.notifier = SelfHealingNotifier(
+            alert_threshold_ms=step_ms,
+            self_healing_threshold_ms=self_healing_threshold_steps * step_ms)
+        self.detector = AnomalyDetectorManager(
+            self.facade, self.notifier, now_ms=self.engine.now_ms,
+            provisioner_enabled=False)
+        self.detector.register(BrokerFailureDetector(admin), step_ms)
+        self.detector.register(DiskFailureDetector(admin), step_ms)
+        self.facade.detector = self.detector
+        #: sampling rounds that raised (chaos-injected; retried next tick)
+        self.sampling_failures = 0
+        #: detector rounds that raised clear through run_once (the
+        #: background loop would log+meter these; the harness counts them)
+        self.detector_round_failures = 0
+        self.runner.start(self.engine.now_ms(), skip_loading=True)
+
+    # -------------------------------------------------------------- loop
+    def step(self, *, detect: bool = True) -> None:
+        """One serving-loop iteration: advance time one step (applying due
+        faults), sample if due, run one detection+healing round."""
+        self.engine.tick()
+        now = self.engine.now_ms()
+        try:
+            self.runner.maybe_run_sampling(now)
+        except Exception:
+            self.sampling_failures += 1
+        if detect:
+            try:
+                self.detector.run_once(now)
+            except Exception:
+                self.detector_round_failures += 1
+
+    def run(self, steps: int, *, detect: bool = True) -> None:
+        for _ in range(steps):
+            self.step(detect=detect)
+
+    def warmup(self, max_steps: int = 12) -> None:
+        """Sampling-only ticks until the monitor can build a model (the
+        pre-fault baseline every scenario starts from)."""
+        from ..monitor import NotEnoughValidWindowsException
+        for _ in range(max_steps):
+            self.step(detect=False)
+            try:
+                self.monitor.cluster_model(self.engine.now_ms())
+                return
+            except NotEnoughValidWindowsException:
+                continue
+        raise AssertionError(
+            f"monitor never reached a valid window in {max_steps} steps")
+
+    def steps_until(self, predicate, max_steps: int, *,
+                    what: str = "condition") -> int:
+        """Drive the loop until ``predicate()`` holds; returns the number
+        of steps taken. Raises with the engine's applied-fault log when
+        the budget runs out — bounded termination is itself an invariant."""
+        for i in range(max_steps):
+            if predicate():
+                return i
+            self.step()
+        raise AssertionError(
+            f"{what} not reached within {max_steps} steps "
+            f"(seed={self.engine.seed}); chaos log:\n  "
+            + "\n  ".join(self.engine.applied[-20:]))
+
+    # --------------------------------------------------------- predicates
+    def healed(self) -> bool:
+        """Cluster healthy + executor idle: no offline replicas, nothing
+        on dead brokers, every partition fully replicated, no ongoing or
+        queued healing work."""
+        if self.executor.has_ongoing_execution():
+            return False
+        if self.detector.ongoing_self_healing is not None:
+            return False
+        alive = self.sim.describe_cluster()
+        if self.sim.offline_replicas():
+            return False
+        for info in self.sim.describe_partitions().values():
+            if any(not alive.get(b, False) for b in info.replicas):
+                return False
+            if any(b not in info.isr for b in info.replicas):
+                return False
+        return True

@@ -1,0 +1,91 @@
+"""The chaos invariant set: what must hold after every fault scenario.
+
+Mirrors the guarantees the reference's executor/detector stack is
+documented to keep under failure (no replica loss outside explicit
+drains, bounded termination, single-execution reservation hygiene,
+post-fault convergence). ``check_invariants`` returns a list of violation
+strings — empty means the run upheld the contract — so callers can attach
+seed/replay context to the assertion message themselves.
+"""
+
+from __future__ import annotations
+
+
+def snapshot_topology(admin) -> dict[tuple[str, int], dict]:
+    """Pre-chaos baseline: per-partition replication factor + replica set
+    (taken on the raw admin so snapshotting never trips injected errors)."""
+    return {tp: {"rf": len(info.replicas), "replicas": set(info.replicas)}
+            for tp, info in admin.describe_partitions().items()}
+
+
+def check_invariants(sim, baseline: dict, executor=None, *,
+                     require_healthy: bool = True,
+                     drained_brokers: set[int] | None = None) -> list[str]:
+    """Audit the cluster (and executor) against the chaos contract.
+
+    - **No partition loses replicas**: every baseline partition still
+      exists with replication factor >= its baseline RF (replica sets may
+      legitimately move; shrinking is loss).
+    - **Structural sanity**: no duplicate replicas; every replica is a
+      known broker; a live leader is a member of its replica set.
+    - **Reservation released / bounded termination**: the executor is
+      idle (``NO_TASK_IN_PROGRESS``) — every execution either completed
+      or aborted cleanly within the scenario's step budget.
+    - With ``require_healthy`` (after the heal phase): no replica sits on
+      a dead broker or failed logdir, and every partition is fully
+      replicated (ISR covers the replica set) — self-healing restored
+      balancedness after the transient failure.
+
+    ``drained_brokers``: brokers the scenario removed on purpose —
+    replicas are *expected* to have left them.
+    """
+    problems: list[str] = []
+    parts = sim.describe_partitions()
+    alive = sim.describe_cluster()
+    known = set(alive)
+
+    for tp, base in baseline.items():
+        info = parts.get(tp)
+        if info is None:
+            problems.append(f"{tp}: partition disappeared")
+            continue
+        if len(info.replicas) < base["rf"]:
+            problems.append(
+                f"{tp}: replication factor shrank {base['rf']} -> "
+                f"{len(info.replicas)} (replica loss)")
+        if len(set(info.replicas)) != len(info.replicas):
+            problems.append(f"{tp}: duplicate replicas {info.replicas}")
+        unknown = [b for b in info.replicas if b not in known]
+        if unknown:
+            problems.append(f"{tp}: replicas on unknown brokers {unknown}")
+        if info.leader != -1 and info.leader not in info.replicas:
+            problems.append(
+                f"{tp}: leader {info.leader} outside replica set "
+                f"{info.replicas}")
+
+    if executor is not None and executor.has_ongoing_execution():
+        problems.append(
+            f"executor reservation not released: state "
+            f"{executor.state.value}")
+
+    if require_healthy:
+        offline_fn = getattr(sim, "offline_replicas", None)
+        offline = offline_fn() if offline_fn is not None else set()
+        drained = drained_brokers or set()
+        for tp, info in parts.items():
+            on_dead = [b for b in info.replicas if not alive.get(b, False)]
+            if on_dead:
+                problems.append(f"{tp}: replicas on dead brokers {on_dead}")
+            on_drained = [b for b in info.replicas if b in drained]
+            if on_drained:
+                problems.append(
+                    f"{tp}: replicas remain on drained brokers "
+                    f"{on_drained}")
+            missing_isr = [b for b in info.replicas if b not in info.isr]
+            if missing_isr:
+                problems.append(
+                    f"{tp}: under-replicated, ISR missing {missing_isr}")
+        bad_offline = {(t, p, b) for (t, p, b) in offline}
+        if bad_offline:
+            problems.append(f"offline replicas remain: {sorted(bad_offline)}")
+    return problems

@@ -9,9 +9,12 @@ resolves typed values and builds the subsystem config dataclasses.
 
 from __future__ import annotations
 
+import os
+
 from ..analyzer.constraint import BalancingConstraint, SearchConfig
 from ..core.config import (AbstractConfig, ConfigDef, ConfigType, Importance,
                            Range, ValidString)
+from ..core.retry import RetryPolicy
 from ..executor.concurrency import ConcurrencyConfig
 from ..executor.executor import ExecutorConfig
 from ..monitor.monitor import MonitorConfig
@@ -46,6 +49,16 @@ def _monitor_defs(d: ConfigDef) -> None:
                  "monitor pipeline (one [E, M, W] aggregation + "
                  "whole-array flat-model gathers); false selects the "
                  "per-entity reference path")
+    d.define("monitor.serve.stale.on.incomplete", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="When sample dropouts push the window history below "
+                 "completeness, serve the last good cluster model "
+                 "(flagged stale + metered) instead of failing proposal "
+                 "paths")
+    d.define("monitor.max.stale.model.age.ms", ConfigType.LONG, 3_600_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Oldest a cached model may get before stale-serving "
+                 "gives up and the completeness error propagates")
     d.define("metric.sampling.interval.ms", ConfigType.LONG, 120_000,
              validator=Range.at_least(1), importance=Importance.HIGH,
              doc="Sampling loop interval")
@@ -422,6 +435,23 @@ def _executor_defs(d: ConfigDef) -> None:
     d.define("auto.stop.external.agent", ConfigType.BOOLEAN, True,
              importance=Importance.LOW,
              doc="Cancel externally-started reassignments before executing")
+    d.define("admin.retry.max.attempts", ConfigType.INT, 3,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Attempts per retryable admin RPC (timeouts) on the "
+                 "executor's setup/poll/abort paths; 1 disables retries")
+    d.define("admin.retry.backoff.ms", ConfigType.LONG, 100,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Base backoff before the first admin retry (doubles per "
+                 "attempt, jittered)")
+    d.define("admin.retry.max.backoff.ms", ConfigType.LONG, 10_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Backoff ceiling for admin retries")
+    d.define("execution.stuck.watchdog.timeout.ms", ConfigType.LONG,
+             21_600_000, validator=Range.at_least(0),
+             importance=Importance.LOW,
+             doc="Force-abort an execution (and release the "
+                 "single-execution reservation) still in flight past "
+                 "this deadline; 0 disables the watchdog")
 
 
 def _detector_defs(d: ConfigDef) -> None:
@@ -799,7 +829,11 @@ class CruiseControlConfig(AbstractConfig):
             follower_cpu_ratio=self.get_double("follower.cpu.ratio"),
             min_valid_partition_ratio=self.get_double(
                 "min.valid.partition.ratio"),
-            dense_pipeline=self.get_boolean("monitor.dense.pipeline"))
+            dense_pipeline=self.get_boolean("monitor.dense.pipeline"),
+            serve_stale_on_incomplete=self.get_boolean(
+                "monitor.serve.stale.on.incomplete"),
+            max_stale_model_age_ms=self.get_int(
+                "monitor.max.stale.model.age.ms"))
 
     def balancing_constraint(self) -> BalancingConstraint:
         return BalancingConstraint(
@@ -902,4 +936,17 @@ class CruiseControlConfig(AbstractConfig):
             slow_task_alerting_backoff_ms=self.get_int(
                 "slow.task.alerting.backoff.ms"),
             default_strategy_names=tuple(self.get_list(
-                "default.replica.movement.strategies")))
+                "default.replica.movement.strategies")),
+            admin_retry=RetryPolicy(
+                max_attempts=self.get_int("admin.retry.max.attempts"),
+                backoff_ms=self.get_int("admin.retry.backoff.ms"),
+                max_backoff_ms=self.get_int("admin.retry.max.backoff.ms"),
+                # Per-process random jitter seed: fleet instances must
+                # not back off in lockstep after a shared controller
+                # hiccup (pid would read 1 in every container, so it
+                # cannot serve as the seed). Simulated/chaos stacks build
+                # their policies directly (seed=0) so replays stay
+                # byte-identical.
+                seed=int.from_bytes(os.urandom(4), "little")),
+            stuck_execution_timeout_ms=self.get_int(
+                "execution.stuck.watchdog.timeout.ms"))

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import heapq
 import itertools
+import logging
 import threading
 import time as _time
 from dataclasses import dataclass, field
@@ -24,6 +25,8 @@ from .anomalies import KafkaAnomaly, KafkaAnomalyType
 from .notifier import (AnomalyNotificationResult, AnomalyNotifier,
                        SelfHealingNotifier)
 from .provisioner import BasicProvisioner, Provisioner
+
+LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -100,6 +103,11 @@ class AnomalyDetectorManager:
             for t in KafkaAnomalyType}
         self._time_to_start_fix = self.registry.timer(
             _n(ANOMALY_DETECTOR_SENSOR, "time-to-start-fix"))
+        #: every exception swallowed by the scheduling loop is logged AND
+        #: marked here — a permanently-broken detector must be visible on
+        #: /metrics, not silently absent from the anomaly stream
+        self._detector_failures = self.registry.meter(
+            _n(ANOMALY_DETECTOR_SENSOR, "detector-failure-rate"))
         # Per-type self-healing switches + provision verdict (remaining
         # rows of the documented AnomalyDetector sensor table:
         # <type>-self-healing-enabled, under/over-provisioned,
@@ -180,7 +188,13 @@ class AnomalyDetectorManager:
                     anomalies = sched.detector.detect(now)
                     sp.set(anomalies=len(anomalies))
             except Exception:
-                continue   # a broken detector must not kill the loop
+                # A broken detector must not kill the loop — but it must
+                # be LOUD: logged with traceback and counted on the
+                # detector-failure-rate meter (/metrics).
+                self._detector_failures.mark()
+                LOG.exception("detector %s failed in detect(); continuing",
+                              type(sched.detector).__name__)
+                continue
             for a in anomalies:
                 self._enqueue(a, now)
                 detected += 1
@@ -262,6 +276,9 @@ class AnomalyDetectorManager:
                         self.num_self_healing_failed += 1
                 except Exception:
                     self.num_self_healing_failed += 1
+                    LOG.exception("self-healing fix for %s (%s) failed",
+                                  anomaly.anomaly_id,
+                                  anomaly.anomaly_type.name)
                 finally:
                     self.ongoing_self_healing = None
             elif action.result is AnomalyNotificationResult.CHECK:
@@ -286,7 +303,11 @@ class AnomalyDetectorManager:
                 try:
                     self.run_once()
                 except Exception:
-                    pass
+                    # The background loop must survive any round failure,
+                    # visibly: log + meter instead of a silent swallow.
+                    self._detector_failures.mark()
+                    LOG.exception(
+                        "anomaly detection round failed; loop continues")
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="anomaly-detector")

@@ -28,10 +28,12 @@ import threading
 import time as _time
 from dataclasses import dataclass, field
 
+from ..core.retry import RetryPolicy
 from ..model.proposals import ExecutionProposal
 from .admin import ClusterAdminClient
 from .concurrency import (ConcurrencyAdjuster, ConcurrencyConfig,
                           ExecutionConcurrencyManager)
+from .kafka_admin import RETRYABLE_ADMIN_ERRORS
 from .planner import ExecutionTaskPlanner
 from .strategy import StrategyContext, strategy_chain
 from .tasks import (ExecutionTask, ExecutionTaskManager, IntraBrokerReplicaMove,
@@ -101,6 +103,14 @@ class ExecutorConfig:
     #: it are rejected at submission (the reference throws on the
     #: equivalent setters).
     max_num_cluster_movements: int = 1250
+    #: shared backoff+jitter policy for retryable admin failures
+    #: (AdminTimeoutError) on the setup/poll/abort paths (ref
+    #: admin.retry.* config keys)
+    admin_retry: RetryPolicy = field(default_factory=RetryPolicy)
+    #: stuck-execution watchdog: an execution still in flight past this
+    #: deadline is force-aborted and the single-execution reservation
+    #: released (0 = disabled; ref execution.stuck.watchdog.timeout.ms)
+    stuck_execution_timeout_ms: int = 0
 
 
 @dataclass
@@ -248,6 +258,17 @@ class Executor:
             _n(EXECUTOR_SENSOR, "executions-started"))
         self._executions_stopped = self.registry.counter(
             _n(EXECUTOR_SENSOR, "executions-stopped"))
+        # Robustness sensors: retried admin calls, swallowed-but-logged
+        # teardown failures, and watchdog-forced aborts must all be
+        # visible on /metrics — a silently-degrading executor is the
+        # failure mode the chaos suite exists to prevent.
+        self._admin_retries = self.registry.meter(
+            _n(EXECUTOR_SENSOR, "admin-retry-rate"))
+        self._teardown_failures = self.registry.meter(
+            _n(EXECUTOR_SENSOR, "teardown-failure-rate"))
+        self._watchdog_aborts = self.registry.counter(
+            _n(EXECUTOR_SENSOR, "watchdog-forced-aborts"))
+        self._exec_started_ms = 0
         self.registry.gauge(
             _n(EXECUTOR_SENSOR, "has-ongoing-execution"),
             lambda: int(self.has_ongoing_execution()))
@@ -298,6 +319,54 @@ class Executor:
     def has_ongoing_execution(self) -> bool:
         return self._state is not ExecutorState.NO_TASK_IN_PROGRESS
 
+    # -------------------------------------------------- recovery plumbing
+    def _admin_call(self, what: str, fn, *args, **kwargs):
+        """Run a retryable admin RPC under the shared backoff policy: a
+        transient AdminTimeoutError is retried with exponential backoff +
+        jitter (on the execution clock, so chaos replays are exact);
+        fatal errors propagate on the first attempt."""
+        def on_retry(attempt, delay_ms, exc):
+            self._admin_retries.mark()
+            OPERATION_LOG.warning(
+                "Admin call %s failed transiently (%s: %s); retry %d in "
+                "%d ms", what, type(exc).__name__, exc, attempt + 1,
+                delay_ms)
+        return self.config.admin_retry.call(
+            fn, *args, retry_on=RETRYABLE_ADMIN_ERRORS,
+            sleep_ms=self._sleep_ms, on_retry=on_retry, **kwargs)
+
+    def _teardown_call(self, what: str, fn, *args, **kwargs):
+        """Teardown-path variant of :meth:`_admin_call`: retries like the
+        main path, but an exhausted retry budget is LOGGED AND METERED
+        instead of raised — a cleanup failure must never strand the
+        executor mid-teardown holding the single-execution reservation.
+        Returns None when the call ultimately failed."""
+        try:
+            return self._admin_call(what, fn, *args, **kwargs)
+        except Exception as exc:   # noqa: BLE001 — teardown must proceed
+            self._teardown_failures.mark()
+            OPERATION_LOG.error(
+                "Teardown call %s failed after retries (%s: %s); "
+                "continuing teardown", what, type(exc).__name__, exc)
+            return None
+
+    def _watchdog_check(self) -> None:
+        """Stuck-execution watchdog (execution.stuck.watchdog.timeout.ms):
+        an execution past its deadline is force-aborted through the normal
+        stop path, which releases the reservation and aborts in-flight
+        tasks — a wedged execution must not hold the executor forever."""
+        deadline = self.config.stuck_execution_timeout_ms
+        if not deadline or self._stop_requested.is_set():
+            return
+        elapsed = self._now_ms() - self._exec_started_ms
+        if elapsed > deadline:
+            self._watchdog_aborts.inc()
+            OPERATION_LOG.error(
+                "Execution %s stuck: %d ms in flight exceeds the "
+                "stuck-execution watchdog deadline (%d ms); force-aborting",
+                self._current_uuid or "(no-uuid)", elapsed, deadline)
+            self._stop_requested.set()
+
     def state_json(self) -> dict:
         """Serialized for the /state endpoint (ref ExecutorState.java)."""
         out = {"state": self._state.value}
@@ -322,7 +391,9 @@ class Executor:
         elif not (force and stop_external_agent):
             return
         if force:
-            ongoing = self.admin.list_partition_reassignments()
+            ongoing = self._admin_call(
+                "listPartitionReassignments",
+                self.admin.list_partition_reassignments)
             if not stop_external_agent:
                 tm = self._task_manager
                 ours = ({t.topic_partition for tt in TaskType
@@ -331,8 +402,9 @@ class Executor:
                         if tm is not None else set())
                 ongoing = {tp: v for tp, v in ongoing.items() if tp in ours}
             if ongoing:
-                self.admin.alter_partition_reassignments(
-                    {tp: None for tp in ongoing})
+                self._admin_call("forceCancelReassignments",
+                                 self.admin.alter_partition_reassignments,
+                                 {tp: None for tp in ongoing})
 
     # ----------------------------------------------------------- execute
     def execute_proposals(self, proposals: list[ExecutionProposal],
@@ -373,6 +445,7 @@ class Executor:
             self._task_manager = ExecutionTaskManager(tracer=self.tracer)
             self._current_uuid = uuid
         started = self._now_ms()
+        self._exec_started_ms = started
         self._executions_started.inc()
         uid = uuid or "(no-uuid)"
         tm = self._task_manager
@@ -407,7 +480,8 @@ class Executor:
                 else self.config.progress_check_interval_ms,
                 self.config.min_progress_check_interval_ms)
             concurrency = ExecutionConcurrencyManager(
-                cc, list(self.admin.describe_cluster()))
+                cc, list(self._admin_call("describeCluster",
+                                          self.admin.describe_cluster)))
             adjuster = (ConcurrencyAdjuster(concurrency)
                         if self.config.concurrency_adjuster_enabled else None)
             if adjuster is not None:
@@ -420,8 +494,8 @@ class Executor:
             self._last_slow_alert_ms = 0
             inter = [t for t in tasks
                      if t.task_type is TaskType.INTER_BROKER_REPLICA_ACTION]
-            throttler.set_throttles(
-                inter, excluded_brokers=throttle_excluded_brokers)
+            self._admin_call("setThrottles", throttler.set_throttles,
+                             inter, excluded_brokers=throttle_excluded_brokers)
             self.notifier.on_execution_started(uuid)
             OPERATION_LOG.info(
                 "Execution %s started: %d inter-broker, %d intra-broker, "
@@ -451,7 +525,8 @@ class Executor:
                 if stopped:
                     self._state = ExecutorState.STOPPING_EXECUTION
                     self._abort_in_flight()
-                throttler.clear_throttles()
+                self._teardown_call("clearThrottles",
+                                    throttler.clear_throttles)
                 if removed_brokers:
                     self.recently_removed_brokers |= removed_brokers
                 if demoted_brokers:
@@ -466,7 +541,6 @@ class Executor:
                     (result.finished_ms - result.started_ms) / 1000.0)
                 if stopped:
                     self._executions_stopped.inc()
-                self._state = ExecutorState.NO_TASK_IN_PROGRESS
                 # An in-flight exception must not be recorded as a success.
                 exc = sys.exc_info()[1]
                 outcome = ("STOPPED" if stopped
@@ -479,6 +553,11 @@ class Executor:
                 exec_span.set(stopped=stopped, deadTasks=dead,
                               outcome=outcome)
             finally:
+                # Cleanup itself raising must STILL release the
+                # single-execution reservation — a wedged
+                # STOPPING_EXECUTION state would refuse every later
+                # execution (including self-healing fixes) forever.
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
                 # The span must close even when cleanup itself raises: a
                 # leaked active span would mis-parent every later span
                 # recorded on this pooled worker thread.
@@ -506,7 +585,9 @@ class Executor:
             if batch:
                 targets = {t.topic_partition: list(t.proposal.new_replicas)
                            for t in batch}
-                errors = self.admin.alter_partition_reassignments(targets)
+                errors = self._admin_call(
+                    "alterPartitionReassignments",
+                    self.admin.alter_partition_reassignments, targets)
                 now = self._now_ms()
                 for t in batch:
                     if errors.get(t.topic_partition) is None:
@@ -523,6 +604,7 @@ class Executor:
                     tm.tracker.transition(t, TaskState.DEAD, now)
                 break
             self._sleep_ms(self._progress_interval_ms)
+            self._watchdog_check()
             self._poll_inter_broker_progress()
             self._maybe_alert_slow_tasks()
             now = self._now_ms()
@@ -530,13 +612,16 @@ class Executor:
                     and now - self._last_adjust_ms
                     >= self.config.concurrency_adjuster_interval_ms):
                 self._last_adjust_ms = now
-                alive = self.admin.describe_cluster()
+                alive = self._admin_call("describeCluster",
+                                         self.admin.describe_cluster)
                 metrics = {b: self.admin.broker_metrics(b)
                            for b, up in alive.items() if up}
                 # Partitions at/below min-ISR are the cluster-wide brake
                 # (ref Executor.java:560-584 min-ISR based adjustment).
                 num_min_isr = sum(
-                    1 for info in self.admin.describe_partitions().values()
+                    1 for info in self._admin_call(
+                        "describePartitions",
+                        self.admin.describe_partitions).values()
                     if len(info.isr) <= 1 and len(info.replicas) > 1)
                 adjuster.refresh(metrics, num_min_isr_partitions=num_min_isr)
         # A completed reassignment leaves the old leader in charge when it
@@ -548,7 +633,9 @@ class Executor:
             for t in tm.tracker.tasks_in(tt, TaskState.COMPLETED)
             if t.proposal.has_leader_action]
         if needs_election and not self._stop_requested.is_set():
-            self.admin.elect_preferred_leaders(needs_election)
+            self._admin_call("electPreferredLeaders",
+                             self.admin.elect_preferred_leaders,
+                             needs_election)
 
     def _maybe_alert_slow_tasks(self) -> None:
         """Log tasks in flight past the alerting threshold, at most once
@@ -578,8 +665,10 @@ class Executor:
         in_flight = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
         if not in_flight:
             return
-        ongoing = self.admin.list_partition_reassignments()
-        alive = self.admin.describe_cluster()
+        ongoing = self._admin_call("listPartitionReassignments",
+                                   self.admin.list_partition_reassignments)
+        alive = self._admin_call("describeCluster",
+                                 self.admin.describe_cluster)
         now = self._now_ms()
         cancels: dict[tuple[str, int], None] = {}
         for t in in_flight:
@@ -599,7 +688,9 @@ class Executor:
                 cancels[tp] = None
                 tm.tracker.transition(t, TaskState.DEAD, now)
         if cancels:
-            self.admin.alter_partition_reassignments(cancels)
+            self._admin_call("cancelDeadReassignments",
+                             self.admin.alter_partition_reassignments,
+                             cancels)
 
     def _run_intra_broker_phase(self, planner, concurrency) -> None:
         """ref intraBrokerMoveReplicas Executor.java:1679 (logdir moves)."""
@@ -614,7 +705,9 @@ class Executor:
                 moves = {(t.proposal.topic, t.proposal.partition,
                           t.proposal.broker_id): t.proposal.dest_logdir
                          for t in batch}
-                errors = self.admin.alter_replica_log_dirs(moves)
+                errors = self._admin_call(
+                    "alterReplicaLogDirs",
+                    self.admin.alter_replica_log_dirs, moves)
                 now = self._now_ms()
                 for t in batch:
                     key = (t.proposal.topic, t.proposal.partition,
@@ -625,8 +718,11 @@ class Executor:
             elif not in_progress:
                 break
             self._sleep_ms(self._progress_interval_ms)
-            dirs = self.admin.describe_replica_log_dirs()
-            alive = self.admin.describe_cluster()
+            self._watchdog_check()
+            dirs = self._admin_call("describeReplicaLogDirs",
+                                    self.admin.describe_replica_log_dirs)
+            alive = self._admin_call("describeCluster",
+                                     self.admin.describe_cluster)
             now = self._now_ms()
             for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
                 key = (t.proposal.topic, t.proposal.partition,
@@ -650,15 +746,20 @@ class Executor:
             # replica (a metadata-only reorder reassignment), then elect it
             # (ref ExecutionUtils.java:435 electLeaders; Kafka applies
             # same-set reassignments instantly).
-            current = self.admin.describe_partitions()
+            current = self._admin_call("describePartitions",
+                                       self.admin.describe_partitions)
             reorders = {
                 t.topic_partition: list(t.proposal.new_replicas)
                 for t in batch
                 if (info := current.get(t.topic_partition)) is not None
                 and info.replicas != list(t.proposal.new_replicas)}
             if reorders:
-                self.admin.alter_partition_reassignments(reorders)
-            errors = self.admin.elect_preferred_leaders(
+                self._admin_call("alterPartitionReassignments",
+                                 self.admin.alter_partition_reassignments,
+                                 reorders)
+            errors = self._admin_call(
+                "electPreferredLeaders",
+                self.admin.elect_preferred_leaders,
                 [t.topic_partition for t in batch])
             now = self._now_ms()
             for t in batch:
@@ -670,26 +771,41 @@ class Executor:
                     self._leadership_move_meter.mark()
             if tm.tracker.num_remaining(tt) > 0:
                 self._sleep_ms(self._progress_interval_ms)
+                self._watchdog_check()
 
     # ------------------------------------------------------------ helpers
     def _abort_in_flight(self) -> None:
         """On stop: cancel reassignments and mark tasks aborted (ref
-        stopExecution's ABORTING/ABORTED path)."""
+        stopExecution's ABORTING/ABORTED path).
+
+        The cancel RPC rides the teardown retry wrapper: a transient
+        AdminTimeoutError mid-cancellation is retried with backoff, and an
+        exhausted budget is logged + metered instead of raised — tasks
+        transition ABORTING → ABORTED either way, so a flaky admin can't
+        strand the tracker (or the reservation) in ABORTING."""
         tm = self._task_manager
         now = self._now_ms()
         cancels = {}
+        aborting = []
         for tt in TaskType:
             for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
                 if tt is TaskType.INTER_BROKER_REPLICA_ACTION:
                     cancels[t.topic_partition] = None
                 tm.tracker.transition(t, TaskState.ABORTING, now)
-                tm.tracker.transition(t, TaskState.ABORTED, now)
+                aborting.append(t)
         if cancels:
-            self.admin.alter_partition_reassignments(cancels)
+            self._teardown_call("cancelInFlightReassignments",
+                                self.admin.alter_partition_reassignments,
+                                cancels)
+        now = self._now_ms()
+        for t in aborting:
+            tm.tracker.transition(t, TaskState.ABORTED, now)
 
     def _build_strategy_context(self) -> StrategyContext:
-        parts = self.admin.describe_partitions()
-        alive = self.admin.describe_cluster()
+        parts = self._admin_call("describePartitions",
+                                 self.admin.describe_partitions)
+        alive = self._admin_call("describeCluster",
+                                 self.admin.describe_cluster)
         urp = {tp for tp, info in parts.items()
                if len(info.isr) < len(info.replicas)}
         offline = {tp for tp, info in parts.items()

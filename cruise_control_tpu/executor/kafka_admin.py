@@ -74,6 +74,32 @@ class AdminOperationError(RuntimeError):
     """An unclassified broker error (ref ExecutionUtils.java:590)."""
 
 
+#: The canonical retryable/fatal split for raised admin errors: timeouts
+#: are cluster/controller-side transients the shared
+#: :class:`~cruise_control_tpu.core.retry.RetryPolicy` may re-attempt;
+#: authorization and unclassified operation errors are fatal — retrying
+#: them can only repeat the failure (ref ExecutionUtils.java:584 vs :659).
+RETRYABLE_ADMIN_ERRORS: tuple = (AdminTimeoutError,)
+FATAL_ADMIN_ERRORS: tuple = (AdminAuthorizationError, AdminOperationError)
+
+
+def consume_injection(code: str, remaining):
+    """Advance a ``(code, remaining)`` fault-injection entry one call.
+
+    The one decrement/pop state machine `MockKafkaAdminWire.fail_with`
+    and the chaos engine's `admin_burst` schedules share, so the two
+    cannot drift on the edge cases: ``remaining=None`` is sustained
+    (fires forever), ``remaining<=0`` fires nothing, ``remaining=n``
+    fires the next ``n`` calls. Returns ``(fire, next_entry)`` — the
+    code to raise for THIS call (or None) and the replacement entry
+    (or None when the schedule is spent)."""
+    if remaining is None:
+        return code, (code, None)
+    if remaining <= 0:
+        return None, None
+    return code, ((code, remaining - 1) if remaining > 1 else None)
+
+
 class _Future(Protocol):
     def result(self, timeout: float | None = None): ...
 
@@ -365,8 +391,11 @@ class MockKafkaAdminWire:
     non-ongoing reassignment answers NO_REASSIGNMENT_IN_PROGRESS, electing
     an already-preferred leader answers ELECTION_NOT_NEEDED, and electing
     an offline preferred replica answers PREFERRED_LEADER_NOT_AVAILABLE.
-    ``fail_with`` injects one-shot arbitrary codes per key for timeout /
-    authorization paths."""
+    ``fail_with`` injects arbitrary codes per key for timeout /
+    authorization paths: a bare code string is one-shot (popped on use);
+    a ``(code, n)`` tuple fails the next ``n`` calls touching the key; a
+    ``(code, None)`` tuple fails every call until cleared — the sustained
+    form chaos schedules use."""
 
     brokers: dict[int, dict] = field(default_factory=dict)
     #: (topic, partition) -> {"replicas": [...], "leader": int, "isr": [...]}
@@ -374,12 +403,23 @@ class MockKafkaAdminWire:
     logdirs: dict[int, dict[str, dict]] = field(default_factory=dict)
     configs: dict[tuple[str, str], dict] = field(default_factory=dict)
     ongoing: dict[tuple[str, int], dict] = field(default_factory=dict)
-    #: one-shot injected error codes: key -> code (popped on use)
+    #: injected error codes: key -> code (one-shot) | (code, n) | (code,
+    #: None) — see the class docstring
     fail_with: dict = field(default_factory=dict)
 
     def _injected(self, key):
-        code = self.fail_with.pop(key, None)
-        return KafkaWireError(code) if code else None
+        entry = self.fail_with.get(key)
+        if entry is None:
+            return None
+        if isinstance(entry, str):
+            self.fail_with.pop(key)
+            return KafkaWireError(entry)
+        fire, nxt = consume_injection(*entry)
+        if nxt is None:
+            self.fail_with.pop(key)
+        else:
+            self.fail_with[key] = nxt
+        return KafkaWireError(fire) if fire else None
 
     def describe_cluster(self) -> dict[int, dict]:
         return dict(self.brokers)

@@ -9,7 +9,8 @@ completeness gating (:mod:`.requirements`), the load monitor itself
 
 from .fetcher import MetricFetcherManager
 from .monitor import (ClusterModelResult, LoadMonitor, LoadMonitorState,
-                      MonitorConfig, NotEnoughValidWindowsException)
+                      MonitorConfig, NotEnoughValidWindowsException,
+                      StaleClusterModelError)
 from .processor import CruiseControlMetricsProcessor
 from .prometheus import (PrometheusAdapter, PrometheusMetricSampler,
                          PrometheusResult)
@@ -23,6 +24,7 @@ from .task_runner import LoadMonitorTaskRunner, RunnerState
 __all__ = [
     "MetricFetcherManager", "ClusterModelResult", "LoadMonitor",
     "LoadMonitorState", "MonitorConfig", "NotEnoughValidWindowsException",
+    "StaleClusterModelError",
     "CruiseControlMetricsProcessor", "ModelCompletenessRequirements",
     "PrometheusAdapter", "PrometheusMetricSampler", "PrometheusResult",
     "AgentTopicSampler", "MetricSampler", "SamplerAssignment", "Samples",

@@ -16,6 +16,8 @@ and for anomaly detection percentiles.
 
 from __future__ import annotations
 
+import copy
+import logging
 import threading
 from dataclasses import dataclass
 
@@ -33,9 +35,20 @@ from ..model.spec import BrokerSpec, ClusterSpec, PartitionSpec, flatten_spec
 from .requirements import ModelCompletenessRequirements
 from .sampler import Samples
 
+LOG = logging.getLogger(__name__)
+
 
 class NotEnoughValidWindowsException(NotEnoughValidWindowsError):
     """Alias with the reference's exception name."""
+
+
+class StaleClusterModelError(NotEnoughValidWindowsException):
+    """An executing (non-dryrun) operation would act on a stale-served
+    model: the topology it describes predates the sample dropout, so
+    reassignments computed from it may target dead brokers or undo
+    post-cache changes. Dryrun/read paths serve stale models flagged;
+    execution refuses them (``KafkaCruiseControl.allow_stale_execution``
+    overrides)."""
 
 
 @dataclass
@@ -65,6 +78,17 @@ class MonitorConfig:
     #: gathers). False selects the retained per-entity reference path —
     #: kept for parity testing, not for production scale.
     dense_pipeline: bool = True
+    #: graceful degradation under sample dropouts (ref
+    #: monitor.serve.stale.on.incomplete): when the live window history
+    #: no longer meets completeness, serve the last good model — flagged
+    #: ``stale`` and metered — instead of failing every proposal path.
+    #: Library default False (toy models should fail loudly); the served
+    #: stack wires the config key (default True).
+    serve_stale_on_incomplete: bool = False
+    #: how old a cached model may get before stale-serving gives up and
+    #: the completeness error propagates after all (ref
+    #: monitor.max.stale.model.age.ms)
+    max_stale_model_age_ms: int = 3_600_000
 
 
 @dataclass
@@ -102,6 +126,10 @@ class ClusterModelResult:
                  spec_factory=None,
                  partition_windows: dict | None = None,
                  partition_windows_factory=None):
+        #: True when this result was served from the monitor's last-good
+        #: cache because the live history missed completeness (sample
+        #: dropouts) — consumers may act on it but should surface the flag
+        self.stale = False
         self.model = model                  # FlatClusterModel
         self.metadata = metadata            # ClusterMetadata
         self.completeness = completeness
@@ -134,7 +162,8 @@ class LoadMonitor:
                  rack_by_broker: dict[int, str] | None = None,
                  broker_set_resolver=None,
                  max_concurrent_model_builds: int = 2,
-                 registry=None, tracer=None) -> None:
+                 registry=None, tracer=None,
+                 admin_retry=None, sleep_ms=None) -> None:
         from ..core.sensors import (LOAD_MONITOR_SENSOR, MetricRegistry)
         from ..core.tracing import default_tracer
         self.admin = admin
@@ -160,6 +189,12 @@ class LoadMonitor:
         #: semaphore LoadMonitor.java:94,396); thread-safety of ingest lives
         #: inside MetricSampleAggregator's own lock.
         self._model_semaphore = threading.Semaphore(max_concurrent_model_builds)
+        #: optional shared RetryPolicy for the admin reads inside model
+        #: builds (serve.py wires the admin.retry.* policy; the chaos
+        #: harness passes its engine clock) — None = single attempt, the
+        #: library default, so toy stacks keep exact-call semantics.
+        self._admin_retry = admin_retry
+        self._admin_sleep_ms = sleep_ms
         self.registry = registry or MetricRegistry()
         # ref LoadMonitor.java:101 cluster-model-creation-timer; the
         # valid-windows / monitored-partitions gauges mirror
@@ -174,6 +209,17 @@ class LoadMonitor:
             MetricRegistry.name(LOAD_MONITOR_SENSOR,
                                 "num-monitored-partitions"),
             lambda: len(self.partition_aggregator.all_entities()))
+        # Stale-model degradation bookkeeping: the last successfully-built
+        # result (timestamped) + visibility for served-stale events.
+        self._last_good: tuple[int, ClusterModelResult] | None = None
+        self._last_model_stale = False
+        self._stale_served = self.registry.meter(MetricRegistry.name(
+            LOAD_MONITOR_SENSOR, "stale-models-served"))
+        self._admin_retries = self.registry.meter(MetricRegistry.name(
+            LOAD_MONITOR_SENSOR, "admin-retry-rate"))
+        self.registry.gauge(
+            MetricRegistry.name(LOAD_MONITOR_SENSOR, "last-model-stale"),
+            lambda: int(self._last_model_stale))
         # Remaining rows of the documented LoadMonitor sensor catalog
         # (Sensors.md): topology health derived from ONE short-TTL admin
         # snapshot per scrape — describe_partitions is O(P x replicas)
@@ -186,6 +232,26 @@ class LoadMonitor:
             self.registry.gauge(
                 MetricRegistry.name(LOAD_MONITOR_SENSOR, sensor),
                 (lambda key=sensor: self._topology_snapshot()[key]))
+
+    def _admin_read(self, fn):
+        """Admin reads inside model builds ride the shared retry policy
+        when one is wired (serve.py / chaos harness): a transient timeout
+        on describe_partitions must not fail a whole proposal path.
+        Retries are metered (`admin-retry-rate`) and logged; without a
+        policy the call is a plain single attempt."""
+        if self._admin_retry is None:
+            return fn()
+        from ..executor.kafka_admin import RETRYABLE_ADMIN_ERRORS
+
+        def on_retry(attempt, delay_ms, exc):
+            self._admin_retries.mark()
+            LOG.warning(
+                "monitor admin read %s failed transiently (%s: %s); "
+                "retry %d in %d ms", fn.__name__, type(exc).__name__, exc,
+                attempt + 1, delay_ms)
+        return self._admin_retry.call(fn, retry_on=RETRYABLE_ADMIN_ERRORS,
+                                      sleep_ms=self._admin_sleep_ms,
+                                      on_retry=on_retry)
 
     def _topology_snapshot(self, ttl_s: float = 5.0) -> dict:
         import time as _time
@@ -309,15 +375,98 @@ class LoadMonitor:
                 self.config.min_valid_partition_ratio))
         with self._model_semaphore, self._model_timer.time(), \
                 self.tracer.span("monitor.cluster-model") as sp:
-            result = self._build_model(now_ms, requirements,
-                                       populate_replica_placement_only)
+            try:
+                result = self._build_model(now_ms, requirements,
+                                           populate_replica_placement_only)
+            except NotEnoughValidWindowsException:
+                stale = self._serve_stale(now_ms, requirements)
+                if stale is None:
+                    raise
+                sp.set(stale=True,
+                       generation=stale.generation)
+                return stale
+            # Window contents stay "valid" no matter how old they are (the
+            # aggregator only rolls on ingest), so completeness alone
+            # cannot see a total sample dropout — age the history against
+            # the clock as well.
+            result.stale = self._history_is_stale(now_ms)
+            self._last_model_stale = result.stale
+            if result.stale:
+                self._stale_served.mark()
+                LOG.warning(
+                    "sample history has fallen behind the clock at t=%d "
+                    "(newest window end %s); serving stale-flagged model",
+                    now_ms, self._newest_window_end_ms())
+            elif not populate_replica_placement_only:
+                # Placement-only models skip load data; caching one would
+                # degrade a later stale serve to zero loads silently.
+                self._last_good = (now_ms, result)
             sp.set(partitions=len(result.metadata.partition_keys),
                    generation=result.generation)
             return result
 
+    @property
+    def last_model_stale(self) -> bool:
+        """Whether the most recently served model was stale-flagged (the
+        ``last-model-stale`` gauge)."""
+        return self._last_model_stale
+
+    def history_stale(self, now_ms: int) -> bool:
+        """Whether live sample flow is broken right now (newest completed
+        window ended more than two windows ago). The facade's execution
+        gate asks this at execution time: a total dropout freezes the
+        model generation, so cached proposals can stay generation-valid
+        without any model build ever flagging staleness."""
+        return self._history_is_stale(now_ms)
+
+    def _newest_window_end_ms(self) -> int | None:
+        times = self.partition_aggregator.available_window_times()
+        return max(times) + self.config.window_ms if times else None
+
+    def _history_is_stale(self, now_ms: int) -> bool:
+        """True when the newest completed partition window ended more than
+        TWO full windows before ``now_ms`` — i.e. at least two whole
+        windows of samples never arrived. One missed window is scheduling
+        jitter (a slow sampling round, a compile pause); two is a real
+        dropout/fetcher outage."""
+        newest_end = self._newest_window_end_ms()
+        return (newest_end is not None
+                and now_ms - newest_end > 2 * self.config.window_ms)
+
+    def _serve_stale(self, now_ms: int,
+                     requirements: ModelCompletenessRequirements,
+                     ) -> ClusterModelResult | None:
+        """Graceful degradation on sample dropouts: hand back the last
+        good model — flagged ``stale``, metered, logged — instead of
+        failing the caller, for as long as the cache stays inside
+        ``max_stale_model_age_ms`` AND the cached model satisfies the
+        caller's completeness requirements (a strict-requirements request
+        must not be answered by a cache built under weaker ones). Returns
+        None otherwise (the completeness error then propagates as
+        before)."""
+        if not self.config.serve_stale_on_incomplete \
+                or self._last_good is None:
+            return None
+        built_ms, result = self._last_good
+        if now_ms - built_ms > self.config.max_stale_model_age_ms:
+            return None
+        if not requirements.met_by(result.completeness):
+            return None
+        LOG.warning(
+            "sample history below completeness at t=%d; serving stale "
+            "model built at t=%d (age %d ms, generation %d)", now_ms,
+            built_ms, now_ms - built_ms, result.generation)
+        self._stale_served.mark()
+        self._last_model_stale = True
+        # Flag a shallow copy: the cached object may still be held by a
+        # caller who received it fresh — never flip .stale under them.
+        result = copy.copy(result)
+        result.stale = True
+        return result
+
     def _build_model(self, now_ms, requirements, placement_only):
-        partitions = self.admin.describe_partitions()
-        alive = self.admin.describe_cluster()
+        partitions = self._admin_read(self.admin.describe_partitions)
+        alive = self._admin_read(self.admin.describe_cluster)
         result = None
         if not placement_only:
             with self.tracer.span("monitor.aggregate"):

@@ -49,7 +49,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                            else None)
     monitor = LoadMonitor(admin, config.monitor_config(),
                           capacity_resolver=resolver,
-                          broker_set_resolver=broker_set_resolver)
+                          broker_set_resolver=broker_set_resolver,
+                          admin_retry=config.executor_config().admin_retry)
     store_dir = config.get_string("sample.store.dir")
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
     cpu_model = LinearRegressionModelParameters()
@@ -111,7 +112,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     facade = KafkaCruiseControl(admin, monitor, task_runner=runner,
                                 optimizer=optimizer, executor=executor,
                                 options_generator=options_generator,
-                                cpu_model=cpu_model)
+                                cpu_model=cpu_model,
+                                admin_retry=executor.config.admin_retry)
 
     # ref self.healing.goals + the reference's startup sanity check
     # (KafkaCruiseControlConfig sanityCheckGoalNames): a configured

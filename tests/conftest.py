@@ -33,6 +33,23 @@ from cruise_control_tpu.utils.platform import enable_compilation_cache  # noqa: 
 
 enable_compilation_cache()
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--chaos-seed", type=int, default=None,
+        help="Replay chaos scenarios with this engine seed (overrides "
+             "each scenario's default/parametrized seed). A failing "
+             "chaos test prints the exact --chaos-seed repro command.")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection scenario over "
+                   "the full monitor→optimize→execute→heal loop")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate "
+                   "(-m 'not slow'); run explicitly or in nightly soaks")
+
+
 # Build the optional native sample loader when a toolchain is present so
 # its parity tests run instead of skipping (best-effort: failures leave
 # the Python fallback in charge and the tests skip as designed).

@@ -43,6 +43,17 @@ def test_tracer_overhead_bench_smoke_gate():
     assert default_tracer().enabled   # the harness must restore the switch
 
 
+def test_chaos_recovery_bench_smoke_gate():
+    """run_chaos_recovery_bench end-to-end: the scripted crash must heal
+    within the step budget with clean invariants (the helper raises on
+    violation). No wall-clock assertion; the step count is the tracked
+    number and it is deterministic in the seed."""
+    import bench
+    out = bench.run_chaos_recovery_bench(emit_row=False)
+    assert 0 < out["steps"] <= 200
+    assert out["seed"] == 11
+
+
 def test_model_build_bench_smoke_gate():
     """run_model_build_bench on a small cluster: exercises the dense
     monitor→model path end-to-end and its built-in dense/legacy parity

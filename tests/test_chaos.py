@@ -1,0 +1,478 @@
+"""Chaos soak suite: scripted + randomized fault schedules driven through
+the full monitor→model→optimize→execute→heal loop, with the invariant set
+(no replica loss, RF preserved, bounded termination, reservation released,
+post-fault rebalance) asserted after every scenario.
+
+Every scenario is deterministic in its engine seed. A failing run prints
+the seed and a one-line repro command; replay any scenario with an
+explicit seed via ``pytest tests/test_chaos.py -k <name> --chaos-seed=N``.
+
+Markers: everything here is ``chaos``; the randomized soak is additionally
+``slow`` (excluded from the tier-1 gate — the scripted scenarios are the
+fast tier-1 subset).
+"""
+
+import pytest
+
+from cruise_control_tpu.analyzer import OptimizationOptions
+from cruise_control_tpu.chaos import (ChaosHarness, build_sim,
+                                      check_invariants, default_optimizer,
+                                      snapshot_topology)
+from cruise_control_tpu.executor import SimulatedKafkaCluster
+from cruise_control_tpu.executor.kafka_admin import AdminTimeoutError
+
+pytestmark = pytest.mark.chaos
+
+#: randomized soak coverage (tier-2): one full fault schedule per seed
+SOAK_SEEDS = list(range(20))
+
+
+@pytest.fixture(scope="module")
+def optimizer():
+    """ONE optimizer for the whole module: scenario harnesses share its
+    compiled search shapes, so the suite pays XLA compilation once."""
+    return default_optimizer()
+
+
+@pytest.fixture
+def chaos_seed(request):
+    return request.config.getoption("--chaos-seed")
+
+
+def _pick(chaos_seed, default):
+    """User-supplied --chaos-seed wins, including seed 0 (falsy)."""
+    return default if chaos_seed is None else chaos_seed
+
+
+def make_harness(optimizer, seed, *, skewed=False, **kwargs):
+    """Default or load-skewed 4-broker topology. Skewed packs every
+    partition onto brokers {0, 1} so a non-dryrun rebalance always has
+    real data moves in flight for faults to land on."""
+    sim = None
+    if skewed:
+        sim = SimulatedKafkaCluster()
+        for b in range(4):
+            sim.add_broker(b, rate_mb_s=10_000.0,
+                           logdirs=("logdir0", "logdir1"))
+        for p in range(16):
+            sim.add_partition(f"t{p % 3}", p, [p % 2, (p + 1) % 2],
+                              size_mb=10.0 + p)
+    return ChaosHarness(sim, seed=seed, optimizer=optimizer, **kwargs)
+
+
+def _repro(test_name: str, seed: int) -> str:
+    return (f"replay: pytest tests/test_chaos.py -k {test_name} "
+            f"--chaos-seed={seed}")
+
+
+def assert_invariants(h: ChaosHarness, baseline: dict, test_name: str, *,
+                      require_healthy: bool = True) -> None:
+    problems = check_invariants(h.sim, baseline, h.executor,
+                                require_healthy=require_healthy)
+    assert not problems, (
+        f"chaos invariants violated (seed={h.engine.seed}):\n  "
+        + "\n  ".join(problems)
+        + f"\n{_repro(test_name, h.engine.seed)}"
+        + "\nchaos log:\n  " + "\n  ".join(h.engine.applied[-20:]))
+
+
+def drive_to_health(h: ChaosHarness, baseline: dict, test_name: str, *,
+                    budget: int) -> int:
+    """Run the loop until the cluster heals (bounded — termination is an
+    invariant), then audit the full invariant set."""
+    try:
+        steps = h.steps_until(h.healed, budget, what="post-fault recovery")
+    except AssertionError as exc:
+        raise AssertionError(f"{exc}\n{_repro(test_name, h.engine.seed)}"
+                             ) from None
+    assert_invariants(h, baseline, test_name)
+    return steps
+
+
+# ------------------------------------------------- scripted scenarios
+
+def test_broker_crash_recovers_via_self_healing(optimizer, chaos_seed):
+    """Transient broker death: detector waits out the threshold, then a
+    self-healing fix drains the dead broker; the restart rejoins it."""
+    h = make_harness(optimizer, _pick(chaos_seed, 11))
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    s0 = h.engine.step
+    h.engine.schedule(s0 + 2, "kill_broker", broker=1)
+    h.engine.schedule(s0 + 9, "restart_broker", broker=1)
+    h.steps_until(lambda: not h.sim.describe_cluster().get(1, True), 20,
+                  what="scheduled broker kill")
+    drive_to_health(h, base, "test_broker_crash_recovers_via_self_healing",
+                    budget=120)
+    assert h.detector.num_self_healing_started >= 1
+
+
+def test_broker_crash_mid_execution(optimizer, chaos_seed):
+    """A destination broker dies while its copies are in flight: dead-task
+    detection cancels them, the execution terminates (not stranded), the
+    reservation is released, and healing restores the cluster."""
+    h = make_harness(optimizer, _pick(chaos_seed, 7), skewed=True)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    h.engine.schedule(h.engine.step + 1, "kill_broker", broker=3)
+    res, exec_res = h.facade.rebalance(
+        dryrun=False, options=OptimizationOptions(seed=0),
+        ignore_proposal_cache=True)
+    assert exec_res is not None
+    dead = exec_res.state_counts["INTER_BROKER_REPLICA_ACTION"].get("DEAD", 0)
+    assert dead > 0, "the scheduled kill must land mid-execution"
+    assert not h.executor.has_ongoing_execution()
+    h.engine.schedule(h.engine.step + 1, "restart_broker", broker=3)
+    drive_to_health(h, base, "test_broker_crash_mid_execution", budget=120)
+
+
+def test_logdir_failure_heals(optimizer, chaos_seed):
+    """A disk dies: its replicas go offline, DiskFailureDetector triggers
+    a fix that moves them to healthy storage."""
+    h = make_harness(optimizer, _pick(chaos_seed, 3))
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    h.engine.schedule(h.engine.step + 1, "fail_logdir", broker=0)
+    # The fix can complete inside the same step the fault lands (the sim
+    # copies fast), so key off the failed-dir set, not the transient
+    # offline window.
+    h.steps_until(lambda: bool(h.sim._brokers[0].failed_logdirs), 20,
+                  what="scheduled logdir failure")
+    drive_to_health(h, base, "test_logdir_failure_heals", budget=120)
+    assert h.detector.num_self_healing_started >= 1
+    failed = h.sim._brokers[0].failed_logdirs
+    for info in h.sim.describe_partitions().values():
+        assert info.logdirs.get(0) not in failed, (
+            "a replica remains on the failed logdir")
+
+
+def test_admin_timeout_burst_is_retried(optimizer, chaos_seed):
+    """A finite burst of REQUEST_TIMED_OUT on the submission RPC: the
+    executor's shared retry policy rides it out and the execution
+    completes as if nothing happened."""
+    h = make_harness(optimizer, _pick(chaos_seed, 5), skewed=True)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    h.engine.schedule(h.engine.step, "admin_burst",
+                      method="alter_partition_reassignments", count=2)
+    res, exec_res = h.facade.rebalance(
+        dryrun=False, options=OptimizationOptions(seed=0),
+        ignore_proposal_cache=True)
+    assert exec_res is not None and exec_res.succeeded, (
+        f"burst within the retry budget must not fail the execution "
+        f"({exec_res and exec_res.state_counts}); "
+        + _repro("test_admin_timeout_burst_is_retried", h.engine.seed))
+    assert h.executor._admin_retries.count > 0
+    assert_invariants(h, base, "test_admin_timeout_burst_is_retried")
+
+
+def test_sustained_admin_errors_during_heal(optimizer, chaos_seed):
+    """A sustained 35% timeout rate on the executor's poll RPC while a
+    broker failure is being healed: retries + the detector's round
+    isolation keep the loop converging."""
+    h = make_harness(optimizer, _pick(chaos_seed, 13))
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    s0 = h.engine.step
+    h.engine.schedule(s0 + 1, "admin_error_rate",
+                      method="list_partition_reassignments", rate=0.35)
+    h.engine.schedule(s0 + 2, "kill_broker", broker=2)
+    h.engine.schedule(s0 + 8, "restart_broker", broker=2)
+    h.engine.schedule(s0 + 40, "admin_error_rate",
+                      method="list_partition_reassignments", rate=0.0)
+    h.steps_until(lambda: not h.sim.describe_cluster().get(2, True), 20,
+                  what="scheduled broker kill")
+    drive_to_health(h, base, "test_sustained_admin_errors_during_heal",
+                    budget=150)
+
+
+def test_sample_dropout_serves_stale_model(optimizer, chaos_seed):
+    """Total metric-sample dropout ages out the window history: the
+    monitor degrades to the last good model — flagged stale and metered —
+    instead of failing proposal paths, and recovers to fresh models once
+    samples flow again."""
+    # Skewed topology: the stale model must yield REAL proposals, so the
+    # non-dryrun gate below is actually exercised (an empty proposal set
+    # is a successful no-op that never reaches the gate).
+    h = make_harness(optimizer, _pick(chaos_seed, 17), skewed=True)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    fresh = h.monitor.cluster_model(h.engine.now_ms())
+    assert not fresh.stale
+    h.engine.schedule(h.engine.step, "drop_samples", rate=1.0)
+    h.run(12)   # > num_windows * window_ms: live history is gone
+    served = h.monitor.cluster_model(h.engine.now_ms())
+    assert served.stale, "dropout must degrade to the stale cache"
+    assert h.monitor._stale_served.count > 0
+    # A caller with stricter completeness requirements than the cached
+    # model satisfies must get the completeness error, not the cache.
+    from cruise_control_tpu.monitor import (ModelCompletenessRequirements,
+                                            NotEnoughValidWindowsException)
+    with pytest.raises(NotEnoughValidWindowsException):
+        h.monitor.cluster_model(
+            h.engine.now_ms(),
+            ModelCompletenessRequirements(min_required_num_windows=99))
+    # Proposal paths keep working on the flagged model.
+    res, _ = h.facade.rebalance(dryrun=True,
+                                options=OptimizationOptions(seed=0),
+                                ignore_proposal_cache=True)
+    assert res is not None
+    assert res.proposals, "the skewed topology must produce proposals"
+    # ...but EXECUTING against the stale (pre-dropout) topology is
+    # refused: it could target brokers that died after the cache was
+    # built. allow_stale_execution opts out of the gate.
+    from cruise_control_tpu.monitor import StaleClusterModelError
+    with pytest.raises(StaleClusterModelError):
+        h.facade.rebalance(dryrun=False, options=OptimizationOptions(seed=0),
+                           ignore_proposal_cache=True)
+    assert not h.executor.has_ongoing_execution()
+    h.facade.allow_stale_execution = True
+    try:
+        res2, _ = h.facade.rebalance(dryrun=False,
+                                     options=OptimizationOptions(seed=0),
+                                     ignore_proposal_cache=True)
+        assert res2 is not None
+    finally:
+        h.facade.allow_stale_execution = False
+    h.engine.schedule(h.engine.step, "drop_samples", rate=0.0)
+    h.steps_until(
+        lambda: not h.monitor.cluster_model(h.engine.now_ms()).stale,
+        40, what="fresh model after sampling resumes")
+    assert_invariants(h, base, "test_sample_dropout_serves_stale_model")
+
+
+def test_stuck_execution_watchdog_force_aborts(optimizer, chaos_seed):
+    """Destination brokers stall (alive, ~zero copy bandwidth): neither
+    dead-task detection nor the movement timeout fires, so only the
+    stuck-execution watchdog can unwedge the executor — it force-aborts,
+    releases the reservation, and the cluster heals after the unstall."""
+    h = make_harness(optimizer, _pick(chaos_seed, 19), skewed=True,
+                     stuck_execution_timeout_ms=10_000)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    s0 = h.engine.step
+    h.engine.schedule(s0, "stall_broker", broker=2)
+    h.engine.schedule(s0, "stall_broker", broker=3)
+    h.engine.schedule(s0 + 30, "unstall_broker", broker=2)
+    h.engine.schedule(s0 + 30, "unstall_broker", broker=3)
+    res, exec_res = h.facade.rebalance(
+        dryrun=False, options=OptimizationOptions(seed=0),
+        ignore_proposal_cache=True)
+    assert exec_res is not None and not exec_res.succeeded
+    assert h.executor._watchdog_aborts.count >= 1, (
+        "the watchdog, not a timeout, must have ended this execution")
+    assert not h.executor.has_ongoing_execution()
+    drive_to_health(h, base, "test_stuck_execution_watchdog_force_aborts",
+                    budget=150)
+
+
+def test_abort_path_survives_flaky_admin(optimizer, chaos_seed):
+    """The worst teardown case: the watchdog aborts a stalled execution
+    while the cancel RPC itself fails every attempt. The teardown wrapper
+    logs + meters the exhausted retries and STILL transitions tasks to
+    ABORTED and releases the reservation — nothing is stranded in
+    ABORTING."""
+    h = make_harness(optimizer, _pick(chaos_seed, 23), skewed=True,
+                     stuck_execution_timeout_ms=10_000)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    s0 = h.engine.step
+    h.engine.schedule(s0, "stall_broker", broker=2)
+    h.engine.schedule(s0, "stall_broker", broker=3)
+    # After submission (step s0..s0+1), every reassignment RPC times out —
+    # including the watchdog's cancellation.
+    h.engine.schedule(s0 + 3, "admin_error_rate",
+                      method="alter_partition_reassignments", rate=1.0)
+    h.engine.schedule(s0 + 25, "admin_error_rate",
+                      method="alter_partition_reassignments", rate=0.0)
+    h.engine.schedule(s0 + 30, "unstall_broker", broker=2)
+    h.engine.schedule(s0 + 30, "unstall_broker", broker=3)
+    res, exec_res = h.facade.rebalance(
+        dryrun=False, options=OptimizationOptions(seed=0),
+        ignore_proposal_cache=True)
+    assert exec_res is not None
+    counts = exec_res.state_counts["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("ABORTING", 0) == 0, (
+        f"tasks stranded in ABORTING: {counts}; "
+        + _repro("test_abort_path_survives_flaky_admin", h.engine.seed))
+    assert counts.get("ABORTED", 0) > 0
+    assert not h.executor.has_ongoing_execution()
+    assert h.executor._teardown_failures.count > 0, (
+        "the failed cancellation must be metered, not silent")
+    drive_to_health(h, base, "test_abort_path_survives_flaky_admin",
+                    budget=200)
+
+
+def test_clock_jump_does_not_wedge_the_loop(optimizer, chaos_seed):
+    """A forward clock jump of several windows invalidates the live
+    sample history mid-run; the loop keeps serving (stale fallback) and
+    returns to fresh models within bounded steps."""
+    h = make_harness(optimizer, _pick(chaos_seed, 29))
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    h.engine.schedule(h.engine.step + 1, "clock_jump",
+                      ms=8 * h.engine.step_ms)
+    h.run(3)
+    h.steps_until(
+        lambda: not h.monitor.cluster_model(h.engine.now_ms()).stale,
+        40, what="fresh model after clock jump")
+    drive_to_health(h, base, "test_clock_jump_does_not_wedge_the_loop",
+                    budget=60)
+
+
+def test_remove_disks_respects_stale_gate(optimizer, chaos_seed):
+    """The intra-broker drain path (remove_disks / rebalance_disks) goes
+    through the SAME stale-model execution gate as inter-broker paths: a
+    sample dropout lets dryrun serve the flagged cache but refuses the
+    non-dryrun drain until the operator opts in."""
+    from cruise_control_tpu.monitor import StaleClusterModelError
+    h = make_harness(optimizer, _pick(chaos_seed, 31))
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    assert h.facade.remove_disks({0: ["logdir0"]},
+                                 dryrun=True)["numIntraBrokerMoves"] > 0
+    h.engine.schedule(h.engine.step, "drop_samples", rate=1.0)
+    h.run(8)
+    with pytest.raises(StaleClusterModelError):
+        h.facade.remove_disks({0: ["logdir0"]}, dryrun=False)
+    assert h.facade.remove_disks({0: ["logdir0"]},
+                                 dryrun=True)["numIntraBrokerMoves"] > 0
+    h.facade.allow_stale_execution = True
+    try:
+        out = h.facade.remove_disks({0: ["logdir0"]}, dryrun=False)
+        assert out["executionResult"]["succeeded"]
+    finally:
+        h.facade.allow_stale_execution = False
+    assert_invariants(h, base, "test_remove_disks_respects_stale_gate",
+                      require_healthy=False)
+
+
+# ------------------------------------------------ hardening unit layer
+
+def test_detector_failures_are_logged_and_metered(caplog):
+    """Satellite: the scheduling loop's exception swallows are now loud —
+    logged with traceback and marked on detector-failure-rate — and a
+    broken detector still doesn't take down its neighbors."""
+    import logging
+
+    from cruise_control_tpu.detector import (AnomalyDetectorManager,
+                                             SelfHealingNotifier)
+
+    class Broken:
+        def detect(self, now_ms):
+            raise RuntimeError("detector exploded")
+
+    class Working:
+        calls = 0
+
+        def detect(self, now_ms):
+            Working.calls += 1
+            return []
+
+    class FacadeStub:
+        admin = None
+
+    mgr = AnomalyDetectorManager(FacadeStub(), SelfHealingNotifier(),
+                                 now_ms=lambda: 1000,
+                                 provisioner_enabled=False)
+    mgr.register(Broken(), 100)
+    mgr.register(Working(), 100)
+    with caplog.at_level(logging.ERROR):
+        mgr.run_once(2000)
+    assert mgr._detector_failures.count == 1
+    assert Working.calls == 1, "one broken detector must not starve others"
+    assert any("Broken" in r.message and r.exc_info
+               for r in caplog.records), (
+        "the swallowed exception must be logged with traceback")
+
+
+def test_chaos_admin_client_intercepts_every_declared_rpc():
+    """INTERCEPTED drift guard: every RPC the tuple declares has an
+    explicit delegation method routing through the engine, and every
+    delegation method is declared — adding an RPC to one side without
+    the other would let chaos schedules silently never fire."""
+    from cruise_control_tpu.chaos.engine import ChaosAdminClient
+    defined = {name for name, member in vars(ChaosAdminClient).items()
+               if callable(member) and not name.startswith("_")}
+    assert defined == set(ChaosAdminClient.INTERCEPTED)
+
+
+def test_mock_wire_sustained_fail_with():
+    """The generalized fail_with forms behind chaos schedules: (code, n)
+    fails the next n calls, (code, None) fails until cleared, a bare
+    string stays one-shot."""
+    from cruise_control_tpu.executor.kafka_admin import (
+        KafkaAdminClusterClient, MockKafkaAdminWire)
+
+    wire = MockKafkaAdminWire()
+    for b in range(3):
+        wire.brokers[b] = {"host": f"b{b}", "rack": "r0"}
+        wire.logdirs[b] = {"/d0": {"replicas": {}}}
+    wire.partitions[("t", 0)] = {"replicas": [0, 1], "leader": 0,
+                                 "isr": [0, 1]}
+    admin = KafkaAdminClusterClient(wire)
+
+    wire.fail_with[("t", 0)] = ("REQUEST_TIMED_OUT", 2)
+    for _ in range(2):
+        with pytest.raises(AdminTimeoutError):
+            admin.alter_partition_reassignments({("t", 0): [1, 2]})
+    assert admin.alter_partition_reassignments(
+        {("t", 0): [1, 2]})[("t", 0)] is None
+
+    wire.fail_with[("t", 0)] = ("REQUEST_TIMED_OUT", None)
+    for _ in range(3):
+        with pytest.raises(AdminTimeoutError):
+            admin.alter_partition_reassignments({("t", 0): None})
+    del wire.fail_with[("t", 0)]
+    assert admin.alter_partition_reassignments(
+        {("t", 0): None})[("t", 0)] is None
+
+
+@pytest.mark.slow
+def test_engine_replays_identically(optimizer):
+    """Determinism contract: the same (schedule, seed) pair produces the
+    same fault log and the same end state, run after run. Marked slow
+    (it drives three full scenarios) — rides the chaos-soak CI step with
+    the randomized seeds, keeping tier-1 inside its time budget."""
+    def run(seed):
+        h = make_harness(optimizer, seed)
+        base = snapshot_topology(h.sim)
+        h.warmup()
+        s0 = h.engine.step
+        h.engine.schedule(s0 + 2, "kill_broker", broker=1)
+        h.engine.schedule(s0 + 3, "admin_error_rate",
+                          method="list_partition_reassignments", rate=0.5)
+        h.engine.schedule(s0 + 7, "restart_broker", broker=1)
+        h.engine.schedule(s0 + 9, "admin_error_rate",
+                          method="list_partition_reassignments", rate=0.0)
+        h.run(14)
+        topo = {tp: tuple(info.replicas)
+                for tp, info in h.sim.describe_partitions().items()}
+        return h.engine.applied, topo
+
+    log_a, topo_a = run(42)
+    log_b, topo_b = run(42)
+    assert log_a == log_b
+    assert topo_a == topo_b
+    log_c, _ = run(43)
+    assert log_a != log_c, ("different seeds must draw different "
+                            "injection points")
+
+
+# --------------------------------------------------- randomized soak
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", SOAK_SEEDS)
+def test_randomized_soak(optimizer, chaos_seed, seed):
+    """One recoverable randomized fault schedule per seed (broker crash +
+    recovery, admin-error window, sample-dropout window, optional stall
+    and clock jump), soaked through the loop, then driven to health and
+    audited against the full invariant set."""
+    seed = chaos_seed if chaos_seed is not None else seed
+    h = ChaosHarness(seed=seed, optimizer=optimizer,
+                     stuck_execution_timeout_ms=120_000)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    h.engine.schedule_random_soak(steps=24)
+    h.run(24)
+    drive_to_health(h, base, "test_randomized_soak", budget=200)

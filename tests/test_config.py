@@ -75,7 +75,11 @@ def test_properties_file_java_semantics(tmp_path):
 
 
 def test_reference_properties_parse():
+    import os
+
     from cruise_control_tpu.core.config import load_properties_file
+    if not os.path.exists("/root/reference/config/cruisecontrol.properties"):
+        pytest.skip("reference checkout not present in this environment")
     props = load_properties_file("/root/reference/config/cruisecontrol.properties")
     assert props["proposal.expiration.ms"] == "60000"
     assert props["cpu.balance.threshold"] == "1.1"

@@ -197,6 +197,82 @@ def test_executor_runs_against_adapter_end_to_end():
     assert parts[("t", 2)].leader == 0
 
 
+# ----------------------------------------- error-classification table
+# One parametrized case per documented row of the module-docstring table
+# (plus the retryable-vs-fatal split the shared retry policy consumes).
+
+REASSIGNMENT_TABLE = [
+    # (code, expectation, reported-fragment)
+    ("INVALID_REPLICA_ASSIGNMENT", "reported", "dead destination"),
+    ("UNKNOWN_TOPIC_OR_PARTITION", "reported", "deleted"),
+    ("NO_REASSIGNMENT_IN_PROGRESS", "success", None),
+    ("REQUEST_TIMED_OUT", AdminTimeoutError, None),
+    ("CLUSTER_AUTHORIZATION_FAILED", AdminAuthorizationError, None),
+    ("SOME_UNDOCUMENTED_ERROR", AdminOperationError, None),
+]
+
+
+@pytest.mark.parametrize("code,expect,fragment", REASSIGNMENT_TABLE,
+                         ids=[row[0] for row in REASSIGNMENT_TABLE])
+def test_reassignment_classification_table(code, expect, fragment):
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    wire.fail_with[("t", 0)] = code
+    # NO_REASSIGNMENT_IN_PROGRESS only arises on cancels.
+    target = {("t", 0): (None if code == "NO_REASSIGNMENT_IN_PROGRESS"
+                         else [1, 2])}
+    if isinstance(expect, type):
+        with pytest.raises(expect):
+            admin.alter_partition_reassignments(target)
+    else:
+        errors = admin.alter_partition_reassignments(target)
+        if expect == "success":
+            assert errors[("t", 0)] is None
+        else:
+            assert fragment in errors[("t", 0)]
+
+
+ELECTION_TABLE = [
+    ("ELECTION_NOT_NEEDED", "success", None),
+    ("PREFERRED_LEADER_NOT_AVAILABLE", "reported",
+     "preferred leader not available"),
+    ("UNKNOWN_TOPIC_OR_PARTITION", "reported", "deleted"),
+    ("INVALID_TOPIC_EXCEPTION", "reported", "deleted"),
+    ("REQUEST_TIMED_OUT", AdminTimeoutError, None),
+    ("CLUSTER_AUTHORIZATION_FAILED", AdminAuthorizationError, None),
+    ("NOT_CONTROLLER", "reported", "NOT_CONTROLLER"),
+]
+
+
+@pytest.mark.parametrize("code,expect,fragment", ELECTION_TABLE,
+                         ids=[row[0] for row in ELECTION_TABLE])
+def test_election_classification_table(code, expect, fragment):
+    wire = make_wire()
+    admin = KafkaAdminClusterClient(wire)
+    wire.fail_with[("t", 0)] = code
+    if isinstance(expect, type):
+        with pytest.raises(expect):
+            admin.elect_preferred_leaders([("t", 0)])
+    else:
+        errors = admin.elect_preferred_leaders([("t", 0)])
+        if expect == "success":
+            assert errors[("t", 0)] is None
+        else:
+            assert fragment in errors[("t", 0)]
+
+
+def test_retryable_vs_fatal_split_matches_docstring():
+    """The tuples the shared RetryPolicy consumes: timeouts are the ONLY
+    retryable raise; authorization and unclassified operation errors are
+    fatal — and no error type is both."""
+    from cruise_control_tpu.executor.kafka_admin import (
+        FATAL_ADMIN_ERRORS, RETRYABLE_ADMIN_ERRORS)
+    assert RETRYABLE_ADMIN_ERRORS == (AdminTimeoutError,)
+    assert set(FATAL_ADMIN_ERRORS) == {AdminAuthorizationError,
+                                       AdminOperationError}
+    assert not set(RETRYABLE_ADMIN_ERRORS) & set(FATAL_ADMIN_ERRORS)
+
+
 # ----------------------------------------------------- production binding
 
 def test_confluent_binding_import_guarded():

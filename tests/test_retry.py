@@ -1,0 +1,88 @@
+"""Unit contract for the shared retry policy (core/retry.py): the one
+backoff+jitter implementation the executor's setup/poll/abort paths and
+the facade's admin reads ride. Determinism matters as much as correctness
+— chaos replays depend on identical retry schedules per seed."""
+
+import pytest
+
+from cruise_control_tpu.core.retry import NO_RETRY, RetryPolicy
+
+
+class Flaky:
+    """Fails the first ``n`` calls with ``exc_type``, then succeeds."""
+
+    def __init__(self, n, exc_type=TimeoutError):
+        self.n = n
+        self.exc_type = exc_type
+        self.calls = 0
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls <= self.n:
+            raise self.exc_type(f"transient #{self.calls}")
+        return (args, kwargs)
+
+
+def test_retries_then_succeeds_with_backoff_on_injected_clock():
+    policy = RetryPolicy(max_attempts=4, backoff_ms=100, jitter=0.0)
+    sleeps = []
+    fn = Flaky(2)
+    out = policy.call(fn, 1, retry_on=(TimeoutError,),
+                      sleep_ms=sleeps.append, kw="x")
+    assert out == ((1,), {"kw": "x"})
+    assert fn.calls == 3
+    assert sleeps == [100, 200]   # exponential, no jitter
+
+
+def test_exhausted_budget_raises_last_exception():
+    policy = RetryPolicy(max_attempts=3, backoff_ms=1, jitter=0.0)
+    fn = Flaky(99)
+    with pytest.raises(TimeoutError, match="transient #3"):
+        policy.call(fn, retry_on=(TimeoutError,), sleep_ms=lambda ms: None)
+    assert fn.calls == 3
+
+
+def test_non_retryable_propagates_immediately():
+    policy = RetryPolicy(max_attempts=5, backoff_ms=1)
+    fn = Flaky(99, exc_type=ValueError)
+    with pytest.raises(ValueError):
+        policy.call(fn, retry_on=(TimeoutError,), sleep_ms=lambda ms: None)
+    assert fn.calls == 1, "a fatal error must not burn retry attempts"
+
+
+def test_no_retry_policy_is_single_attempt():
+    fn = Flaky(1)
+    with pytest.raises(TimeoutError):
+        NO_RETRY.call(fn, retry_on=(TimeoutError,),
+                      sleep_ms=lambda ms: None)
+    assert fn.calls == 1
+
+
+def test_backoff_caps_at_max():
+    policy = RetryPolicy(max_attempts=10, backoff_ms=100,
+                         max_backoff_ms=400, jitter=0.0)
+    assert [policy.delay_ms(i) for i in range(5)] == [100, 200, 400,
+                                                      400, 400]
+
+
+def test_jitter_is_bounded_and_deterministic():
+    policy = RetryPolicy(max_attempts=3, backoff_ms=1000, jitter=0.2)
+    for attempt in range(6):
+        for seed in range(20):
+            d = policy.delay_ms(attempt, seed)
+            base = min(1000 * 2 ** attempt, policy.max_backoff_ms)
+            assert base * 0.8 <= d <= base * 1.2
+            # Same (seed, attempt) -> same delay, every time.
+            assert d == policy.delay_ms(attempt, seed)
+    # Different seeds actually spread across the band.
+    spread = {policy.delay_ms(0, s) for s in range(50)}
+    assert len(spread) > 10
+
+
+def test_on_retry_hook_sees_attempt_delay_and_exception():
+    policy = RetryPolicy(max_attempts=3, backoff_ms=50, jitter=0.0)
+    seen = []
+    fn = Flaky(2)
+    policy.call(fn, retry_on=(TimeoutError,), sleep_ms=lambda ms: None,
+                on_retry=lambda a, d, e: seen.append((a, d, str(e))))
+    assert seen == [(0, 50, "transient #1"), (1, 100, "transient #2")]
