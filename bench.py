@@ -180,6 +180,104 @@ def run_model_build_bench(num_brokers: int = NUM_BROKERS,
             "partitions": P}
 
 
+def run_whatif_n1_bench(num_brokers: int = NUM_BROKERS,
+                        num_partitions: int = NUM_PARTITIONS, *,
+                        goal_names: list | None = None, repeats: int = 3,
+                        rebuild_samples: int = 3,
+                        single_samples: int = 20,
+                        emit_row: bool = True, gate: bool = True) -> dict:
+    """What-if N-1 sweep wall-clock: every single-broker loss scored by
+    the full goal stack in ONE vmapped device program, vs evaluating the
+    same scenarios one at a time the pre-whatif way — per scenario,
+    rebuild the hypothetical model host-side (spec mutation +
+    flatten_spec, exactly how the facade's add/remove/demote dry-runs
+    construct hypothetical topologies) and score it with one device
+    dispatch. ``rebuild_samples`` rebuilds are timed and extrapolated to
+    the full sweep (per-scenario rebuild cost is constant).
+
+    The gate requires warm-batch >= 5x over N x rebuild-and-score. The
+    log also reports the batched-vs-single-DISPATCH ratio (same engine,
+    unpadded S=1 program, model already flat): on CPU the sweep is
+    compute-bound so that ratio hovers near 1; the batch's win there is
+    eliminating N rebuild+dispatch round-trips, and on TPU the scenario
+    axis rides the vector units.
+    """
+    from cruise_control_tpu.analyzer import goals_by_name
+    from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                               flatten_spec)
+    from cruise_control_tpu.whatif import LoadScale, WhatIfEngine, n1_sweep
+    goals = goals_by_name(goal_names or GOALS)
+    # Spec-based build: the rebuild baseline needs the spec path, and the
+    # batched engine gets the identical flattened model.
+    spec = build_spec(num_brokers=num_brokers,
+                      num_partitions=num_partitions)
+    model, md = flatten_spec(spec)
+    eng = WhatIfEngine(goals=goals)
+    scenarios = n1_sweep(md.broker_ids)
+    S = len(scenarios)
+    t0 = time.monotonic()
+    report = eng.sweep(model, md, scenarios)
+    cold_s = time.monotonic() - t0
+    warm_s = float("inf")
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        report = eng.sweep(model, md, scenarios)
+        warm_s = min(warm_s, time.monotonic() - t0)
+    assert report.num_scenarios == S
+
+    # Single-dispatch baseline: same engine, scenario axis unpadded, one
+    # device program per scenario on the already-flat model.
+    eng1 = WhatIfEngine(goals=goals, scenario_pad_multiple=1)
+    sub = scenarios[:single_samples] if single_samples else scenarios
+    eng1.sweep(model, md, [scenarios[0]])        # compile the S=1 program
+    t0 = time.monotonic()
+    singles = [eng1.sweep(model, md, [s]).outcomes[0] for s in sub]
+    dispatch_s = (time.monotonic() - t0) * (S / len(sub))
+    # Parity: the batch and the singles must agree on what is violated —
+    # a fast sweep that scores differently is worthless.
+    for got, single in zip(report.outcomes, singles):
+        if got.violated_goals != single.violated_goals:
+            raise RuntimeError(
+                f"whatif batched/single mismatch on {got.scenario.name}: "
+                f"{got.violated_goals} vs {single.violated_goals}")
+
+    # Rebuild baseline: host-side model rebuild per scenario + one
+    # scoring dispatch (the status-quo hypothetical-evaluation path).
+    t0 = time.monotonic()
+    for scn in scenarios[:rebuild_samples]:
+        dead = set(scn.brokers)
+        spec_s = ClusterSpec(
+            brokers=[BrokerSpec(b.broker_id, rack=b.rack, host=b.host,
+                                capacity=b.capacity,
+                                alive=b.broker_id not in dead)
+                     for b in spec.brokers],
+            partitions=spec.partitions)
+        model_s, md_s = flatten_spec(spec_s)
+        eng1.sweep(model_s, md_s, [LoadScale(1.0)])
+    rebuild_s = (time.monotonic() - t0) * (S / rebuild_samples)
+
+    speedup = rebuild_s / warm_s if warm_s > 0 else None
+    vs_dispatch = dispatch_s / warm_s if warm_s > 0 else None
+    scn_per_s = S / warm_s if warm_s > 0 else 0.0
+    log(f"whatif N-1 sweep ({num_brokers}x{num_partitions}, {S} scenarios,"
+        f" {len(goals)} goals): cold {cold_s:.2f}s warm {warm_s:.3f}s "
+        f"({scn_per_s:.0f} scenarios/s); sequential rebuild+score "
+        f"{rebuild_s:.1f}s ({speedup:.1f}x), single-dispatch "
+        f"{dispatch_s:.2f}s ({vs_dispatch:.2f}x)")
+    if gate and (speedup is None or speedup < 5.0):
+        raise RuntimeError(
+            f"whatif batching gate: batched sweep only "
+            f"{speedup if speedup is None else round(speedup, 2)}x faster "
+            f"than {S} sequential rebuild+score evaluations (need >= 5x)")
+    if emit_row:
+        emit("whatif_n1_sweep_wall_clock", round(warm_s, 3), "s",
+             round(speedup, 3) if speedup else None)
+    return {"cold_s": cold_s, "warm_s": warm_s, "rebuild_s": rebuild_s,
+            "dispatch_s": dispatch_s, "speedup": speedup,
+            "vs_dispatch": vs_dispatch, "scenarios": S,
+            "scenarios_per_s": scn_per_s}
+
+
 def run_tracer_overhead_bench(num_brokers: int = 50,
                               num_partitions: int = 5_000, *,
                               goal_names: list | None = None,
@@ -269,21 +367,22 @@ def run_chaos_recovery_bench(*, seed: int = 11, emit_row: bool = True,
     return {"steps": steps, "seed": seed, "wall_s": wall_s}
 
 
-def build_spec():
+def build_spec(num_brokers: int = NUM_BROKERS,
+               num_partitions: int = NUM_PARTITIONS):
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
                                                PartitionSpec)
     rng = np.random.default_rng(42)
     brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 10}",
                           capacity=(100.0, 1e6, 1e6, 1e8))
-               for i in range(NUM_BROKERS)]
+               for i in range(num_brokers)]
     # Skewed placement: half the partitions crowd onto 20% of brokers.
-    hot = np.arange(NUM_BROKERS // 5)
+    hot = np.arange(num_brokers // 5)
     parts = []
-    for p in range(NUM_PARTITIONS):
+    for p in range(num_partitions):
         if p % 2 == 0:
             pool = hot
         else:
-            pool = np.arange(NUM_BROKERS)
+            pool = np.arange(num_brokers)
         reps = rng.choice(pool, size=RF, replace=False).tolist()
         load = (0.02 + 0.02 * rng.random(), 5 + 10 * rng.random(),
                 8 + 15 * rng.random(), 50 + 100 * rng.random())
@@ -720,6 +819,9 @@ def main():
     # Robustness: steps from injected broker crash to restored
     # balancedness through the full heal loop.
     run_chaos_recovery_bench()
+    # What-if engine: batched N-1 sweep vs sequential single-scenario
+    # evaluation (>= 5x gate).
+    run_whatif_n1_bench()
     t0 = time.monotonic()
     spec = build_spec()
     model, md = flatten_spec(spec)

@@ -811,6 +811,15 @@ class TpuGoalOptimizer:
         from ..model.flat import broker_utilization
         cst = self.constraint
         response = ProvisionResponse()
+
+        def _headroom(total: float, usable_total: float) -> dict:
+            """The numbers that motivated a verdict, attached to its
+            recommendation (ProvisionRecommendation.headroom)."""
+            return {"demand": round(total, 3),
+                    "usableCapacity": round(usable_total, 3),
+                    "headroomPct": round(
+                        100.0 * (1.0 - total / max(usable_total, 1e-9)),
+                        2)}
         util = np.asarray(jax.device_get(broker_utilization(final)))
         alive = np.asarray(jax.device_get(final.broker_alive
                                           & final.broker_valid))
@@ -842,7 +851,8 @@ class TpuGoalOptimizer:
                     num_brokers=max(needed_by_resource[r] - n_alive, 1),
                     resource=name,
                     reason=f"{name} demand {total:.0f} exceeds usable "
-                           f"capacity of {n_alive} brokers"))
+                           f"capacity of {n_alive} brokers",
+                    headroom=_headroom(total, usable_per_broker * n_alive)))
         if response.status is not ProvisionStatus.UNDER_PROVISIONED:
             # Shrink floors beyond resource demand (ref ProvisionerUtils):
             # replica density must stay under
@@ -882,7 +892,9 @@ class TpuGoalOptimizer:
                         reason=f"{RESOURCE_NAMES[int(r)]} utilization below "
                                f"{low:.0%} of usable capacity (cluster still "
                                f"needs {min_needed} brokers for its most "
-                               "demanding resource)"))
+                               "demanding resource)",
+                        headroom=_headroom(total,
+                                           usable_per_broker * n_alive)))
         if not response.recommendations:
             response.status = ProvisionStatus.RIGHT_SIZED
         return response

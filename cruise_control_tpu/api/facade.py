@@ -84,6 +84,13 @@ class KafkaCruiseControl:
         #: over topology freshness during sample outages)
         self.allow_stale_execution = False
         self.proposal_cache = ProposalCache(monitor, self.optimizer)
+        #: what-if scenario engine scoring hypothetical topologies with
+        #: the SAME goal chain the optimizer serves — /simulate and the
+        #: resilience detector share its compiled sweep programs.
+        from ..whatif import WhatIfEngine
+        self.whatif = WhatIfEngine(goals=self.optimizer.goals,
+                                   constraint=self.optimizer.constraint,
+                                   tracer=self.optimizer.tracer)
         # Shared with the metrics processor so a TRAIN-fitted regression
         # feeds CPU estimation for samples that lack broker CPU.
         self.cpu_model = cpu_model or LinearRegressionModelParameters()
@@ -122,7 +129,7 @@ class KafkaCruiseControl:
 
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
-                    self.executor.registry]
+                    self.executor.registry, self.whatif.registry]
             if self.detector is not None and hasattr(self.detector,
                                                      "registry"):
                 regs.append(self.detector.registry)
@@ -535,6 +542,22 @@ class KafkaCruiseControl:
                                   OptimizationOptions(
                                       skip_hard_goal_check=True))
         return self.proposal_cache.get(self._now_ms())
+
+    def simulate(self, payload: dict) -> dict:
+        """What-if scenario sweep over the live cluster model (the
+        ``/simulate`` endpoint). ``payload`` is the declarative spec —
+        ``{"sweep": "N1"|"N2"}`` or ``{"scenarios": [...]}`` — parsed and
+        validated before any device work. Purely a read: the hypothetical
+        models exist only inside the sweep's device program, and the
+        proposal cache is never touched (its scenario guard enforces
+        this, see ProposalCache.store)."""
+        from ..whatif import alive_broker_ids, parse_scenarios
+        result = self.monitor.cluster_model(self._now_ms())
+        scenarios = parse_scenarios(
+            payload, alive_broker_ids(result.model, result.metadata))
+        report = self.whatif.sweep(result.model, result.metadata,
+                                   scenarios, stale_model=result.stale)
+        return report.to_json()
 
     def load(self, populate_disk_info: bool = False,
              capacity_only: bool = False) -> dict:

@@ -82,6 +82,16 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
     "review": ("post", "Approve/discard parked requests",
                [("approve", "string", "comma-separated review ids"),
                 ("discard", "string", "comma-separated review ids")]),
+    "simulate": ("post", "What-if scenario sweep: score hypothetical "
+                         "failures, growth and capacity changes",
+                 [("sweep", "string", "N1|N2 broker-loss sweep over "
+                                      "alive brokers"),
+                  ("scenarios", "string",
+                   "JSON list of scenario objects (broker_loss, "
+                   "broker_add, capacity_resize, load_scale, "
+                   "topic_add); also accepted as a JSON request body")]),
+    "trace": ("get", "Chrome trace-event JSON of the span ring buffer "
+                     "(Perfetto-loadable)", []),
 }
 
 
@@ -221,6 +231,48 @@ _SCHEMAS = {
                 "SubmitterAddress": {"type": "string"},
                 "SubmissionTimeMs": {"type": "integer"},
             }}}},
+    "WhatIfReport": {
+        "type": "object",
+        "description": "per-scenario what-if scorecards "
+                       "(whatif/engine.py WhatIfReport)",
+        "properties": {
+            "version": {"type": "integer"},
+            "numScenarios": {"type": "integer"},
+            "goals": {"type": "array", "items": {"type": "string"}},
+            "durationMs": {"type": "number"},
+            "staleModel": {"type": "boolean"},
+            "riskiest": {"type": "string", "nullable": True},
+            "maxRisk": {"type": "number"},
+            "scenarios": {"type": "array", "items": {
+                "type": "object", "properties": {
+                    "scenario": {"type": "object",
+                                 "description": "the declarative spec "
+                                                "echoed back"},
+                    "name": {"type": "string"},
+                    "risk": {"type": "number",
+                             "description": "[0, 1] composite risk"},
+                    "violatedGoals": {"type": "array",
+                                      "items": {"type": "string"}},
+                    "violatedHardGoals": {"type": "array",
+                                          "items": {"type": "string"}},
+                    "capacityPressure": {"type": "number"},
+                    "unavailablePartitions": {"type": "integer"},
+                    "offlineReplicas": {"type": "integer"},
+                    "headroom": {"type": "object",
+                                 "description": "per-resource remaining "
+                                                "usable capacity + worst "
+                                                "broker fraction"},
+                    "worstBroker": {},
+                }}},
+        }},
+    "TraceEvents": {
+        "type": "object",
+        "description": "Chrome trace-event JSON (chrome://tracing / "
+                       "Perfetto); spans from the process ring buffer",
+        "properties": {
+            "traceEvents": {"type": "array", "items": {"type": "object"}},
+            "displayTimeUnit": {"type": "string"},
+        }},
 }
 
 _OPTIMIZATION_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
@@ -247,6 +299,10 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
                                    "table instead)"}
         if name in _OPTIMIZATION_ENDPOINTS:
             ok.update(_ref("OptimizationResult"))
+        elif name == "simulate":
+            ok.update(_ref("WhatIfReport"))
+        elif name == "trace":
+            ok.update(_ref("TraceEvents"))
         # JSON is the documented default body (json defaults true): every
         # 200 advertises application/json — a typed $ref where one
         # exists, a generic object otherwise.

@@ -517,6 +517,25 @@ class StopProposalParameters(EndpointParameters):
               Param("stop_external_agent", "bool", default=True))
 
 
+class SimulateParameters(EndpointParameters):
+    """What-if scenario sweep (no reference analog — this build's
+    /simulate endpoint). Exactly one of ``sweep`` (expanded over alive
+    brokers) or ``scenarios`` (a JSON list of scenario objects; see
+    whatif/spec.py) must be given. Scenario-body validation happens in
+    the whatif layer — this class only gates the transport shape."""
+
+    PARAMS = (Param("sweep", "enum", choices=("N1", "N2")),
+              Param("scenarios", "string"))
+
+    @staticmethod
+    def _exactly_one(values: dict) -> None:
+        if bool(values.get("sweep")) == bool(values.get("scenarios")):
+            raise ParameterError(
+                "simulate requires exactly one of 'sweep' (N1|N2) or "
+                "'scenarios' (JSON list)")
+    validators = (_exactly_one,)
+
+
 class PauseResumeParameters(EndpointParameters):
     """ref PauseResumeParameters.java (reason is in COMMON_PARAMS)."""
 
@@ -548,6 +567,7 @@ ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
     "stop_proposal_execution": StopProposalParameters,
     "pause_sampling": PauseResumeParameters,
     "resume_sampling": PauseResumeParameters,
+    "simulate": SimulateParameters,
 }
 
 

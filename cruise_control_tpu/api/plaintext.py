@@ -122,8 +122,27 @@ def _pretty(payload: dict) -> str:
     return json.dumps(payload, indent=2, sort_keys=True)
 
 
+def _render_simulate(payload: dict) -> str:
+    rows = [[s.get("name"), _num(float(s.get("risk", 0.0))),
+             _num(float(s.get("capacityPressure", 0.0))),
+             s.get("unavailablePartitions", 0),
+             s.get("offlineReplicas", 0),
+             ",".join(s.get("violatedHardGoals", [])) or "-",
+             ",".join(g for g in s.get("violatedGoals", [])
+                      if g not in s.get("violatedHardGoals", [])) or "-"]
+            for s in payload.get("scenarios", [])]
+    text = _table(["SCENARIO", "RISK", "PRESSURE", "UNAVAIL", "OFFLINE",
+                   "HARD_VIOLATIONS", "SOFT_VIOLATIONS"], rows)
+    worst = payload.get("riskiest")
+    if worst is not None:
+        text += (f"\n\nriskiest: {worst} (maxRisk "
+                 f"{_num(float(payload.get('maxRisk', 0.0)))})")
+    return text
+
+
 _RENDERERS = {
     "load": _render_load,
+    "simulate": _render_simulate,
     "partition_load": _render_partition_load,
     "proposals": _render_proposals,
     "rebalance": _render_proposals,

@@ -78,6 +78,15 @@ class ProposalCache:
     def _compute(self, now_ms: int):
         gen = self.monitor.generation
         model_result = self.monitor.cluster_model(now_ms)
+        # Belt-and-braces: the monitor only emits live results, but a
+        # plugged monitor (or future refactor) handing a what-if scenario
+        # transform here would poison every default-chain read until the
+        # next generation bump — refuse outright.
+        label = getattr(model_result, "scenario_label", None)
+        if label:
+            raise ValueError(
+                f"proposal cache refuses scenario-modified model "
+                f"{label!r}: only live monitor models may seed the cache")
         result = self.optimizer.optimize(model_result.model,
                                          model_result.metadata, self.options)
         if model_result.stale:
@@ -91,6 +100,34 @@ class ProposalCache:
             self.num_computations += 1
             self._lock.notify_all()
         return result
+
+    def store(self, result, *, generation: int,
+              scenario_label: str | None = None) -> bool:
+        """Offer an externally computed OptimizerResult to the cache.
+
+        The ONLY write path besides :meth:`_compute`, with two guards:
+
+        - **scenario rejection** (hard error): results computed from a
+          what-if scenario transform carry the scenario label and are
+          refused outright — ``/simulate`` and the resilience detector's
+          proactive sweeps can never poison the live-cluster cache.
+        - **generation keying** (soft reject): a result computed against
+          any generation other than the monitor's CURRENT one is dropped
+          (returns False) — by the time it arrives it describes a
+          cluster that no longer exists.
+        """
+        if scenario_label:
+            raise ValueError(
+                f"proposal cache refuses scenario-modified result "
+                f"{scenario_label!r}: only live-cluster optimizations "
+                "may be cached")
+        with self._lock:
+            if generation != self.monitor.generation:
+                return False
+            self._cached = result
+            self._cached_generation = generation
+            self._lock.notify_all()
+            return True
 
     def invalidate(self) -> None:
         with self._lock:

@@ -27,6 +27,9 @@ ENDPOINT_MIN_ROLE: dict[str, Role] = {
     "proposals": Role.VIEWER, "kafka_cluster_state": Role.VIEWER,
     "user_tasks": Role.VIEWER, "review_board": Role.VIEWER,
     "permissions": Role.VIEWER, "openapi": Role.VIEWER,
+    # simulate is a pure read (dry-run what-if analysis), VIEWER like
+    # proposals despite being a POST.
+    "simulate": Role.VIEWER,
     "rebalance": Role.USER, "add_broker": Role.USER,
     "remove_broker": Role.USER, "demote_broker": Role.USER,
     "fix_offline_replicas": Role.USER, "topic_configuration": Role.USER,

@@ -45,10 +45,12 @@ POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                   "fix_offline_replicas", "demote_broker",
                   "topic_configuration", "rightsize", "remove_disks",
                   "stop_proposal_execution", "pause_sampling",
-                  "resume_sampling", "admin", "review"}
+                  "resume_sampling", "admin", "review", "simulate"}
 #: POSTs that execute immediately even with two-step verification on
-#: (ref Purgatory: REVIEW itself and flow-control endpoints skip review).
-NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution"}
+#: (ref Purgatory: REVIEW itself and flow-control endpoints skip review;
+#: simulate is a pure read — a what-if sweep mutates nothing, so parking
+#: it for review would only delay the answer).
+NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution", "simulate"}
 #: bare GET handlers outside the servlet endpoint table (observability
 #: surfaces + the API explorer) — instrumented through the same shared
 #: request-timing wrapper as every dispatched endpoint.
@@ -632,6 +634,18 @@ class CruiseControlApp:
             return 200, {"message": "Sampling resumed."}, {}
         if endpoint == "admin":
             return 200, self._admin(params), {}
+        if endpoint == "simulate":
+            payload: dict = {}
+            if params.get("sweep"):
+                payload["sweep"] = params["sweep"]
+            raw = params.get("scenarios")
+            if raw:
+                try:
+                    payload["scenarios"] = json.loads(raw)
+                except json.JSONDecodeError as e:
+                    raise ValueError(
+                        f"parameter scenarios is not valid JSON: {e}")
+            return 200, facade.simulate(payload), {}
         return 404, {"errorMessage": f"unknown endpoint {endpoint}"}, {}
 
     def _admin(self, params: ParsedParams) -> dict:
@@ -789,8 +803,27 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         except UnicodeDecodeError:
             return json_resp(400, {"errorMessage":
                                    "request body is not valid UTF-8"})
-        for k, v in parse_qs(decoded).items():
-            params.setdefault(k, v)
+        if "application/json" in headers.get("content-type", ""):
+            # JSON request bodies: top-level keys become parameters
+            # (scalars verbatim, nested values re-serialized — exactly
+            # what the typed layer's JSON-string parameters, e.g.
+            # simulate's ``scenarios``, expect).
+            try:
+                obj = json.loads(decoded)
+            except json.JSONDecodeError as e:
+                return json_resp(400, {"errorMessage":
+                                       f"request body is not valid "
+                                       f"JSON: {e}"})
+            if not isinstance(obj, dict):
+                return json_resp(400, {"errorMessage":
+                                       "JSON request body must be an "
+                                       "object"})
+            for k, v in obj.items():
+                params.setdefault(
+                    str(k), [v if isinstance(v, str) else json.dumps(v)])
+        else:
+            for k, v in parse_qs(decoded).items():
+                params.setdefault(k, v)
     try:
         status, payload, extra = app.handle(method, endpoint, params,
                                             headers)

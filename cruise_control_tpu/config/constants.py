@@ -475,7 +475,8 @@ def _detector_defs(d: ConfigDef) -> None:
     d.define("self.healing.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.HIGH, doc="Master self-healing switch")
     for name in ("broker.failure", "goal.violation", "disk.failure",
-                 "topic.anomaly", "metric.anomaly", "maintenance.event"):
+                 "topic.anomaly", "metric.anomaly", "maintenance.event",
+                 "broker.risk"):
         d.define(f"self.healing.{name}.enabled", ConfigType.BOOLEAN, False,
                  importance=Importance.MEDIUM,
                  doc=f"Self-healing for {name} anomalies")
@@ -528,6 +529,19 @@ def _detector_defs(d: ConfigDef) -> None:
     d.define("broker.failure.detection.backoff.ms", ConfigType.LONG,
              300_000, validator=Range.at_least(1), importance=Importance.LOW,
              doc="Backoff after a failed broker-failure detection round")
+    d.define("resilience.detection.interval.ms", ConfigType.LONG,
+             1_800_000, validator=Range.at_least(0),
+             importance=Importance.LOW,
+             doc="Interval of the proactive N-1 what-if sweep raising "
+                 "BROKER_RISK anomalies (whatif/engine.py); 0 disables "
+                 "the resilience detector")
+    d.define("whatif.max.scenarios", ConfigType.INT, 8192,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Cap on scenarios per /simulate or resilience sweep "
+                 "(one vmapped device program evaluates the whole batch; "
+                 "the default covers an N-2 pairwise sweep up to 128 "
+                 "brokers — lower it to bound device memory on very "
+                 "large partition counts)")
     d.define("kafka.broker.failure.detection.enable", ConfigType.BOOLEAN,
              False, importance=Importance.LOW,
              doc="Use metadata-polling broker failure detection (the "

@@ -1,9 +1,10 @@
 """Detector layer (L5): anomaly detection + self-healing (ref
 ``cruise-control/.../detector/``)."""
 
-from .anomalies import (BrokerFailures, DiskFailures, GoalViolations,
-                        KafkaAnomaly, KafkaAnomalyType, KafkaMetricAnomaly,
-                        MaintenanceEvent, MaintenanceEventType, SlowBrokers,
+from .anomalies import (BrokerFailures, BrokerRisk, DiskFailures,
+                        GoalViolations, KafkaAnomaly, KafkaAnomalyType,
+                        KafkaMetricAnomaly, MaintenanceEvent,
+                        MaintenanceEventType, SlowBrokers,
                         TopicReplicationFactorAnomaly)
 from .detectors import (BalancednessWeights, BrokerFailureDetector,
                         DiskFailureDetector, GoalViolationDetector,
@@ -11,6 +12,7 @@ from .detectors import (BalancednessWeights, BrokerFailureDetector,
                         MetricAnomalyDetector, SlowBrokerFinder,
                         TopicAnomalyDetector)
 from .manager import AnomalyDetectorManager, DetectorSchedule
+from .resilience import ResilienceDetector
 from .notifier import (AlertaSelfHealingNotifier, AnomalyNotificationResult,
                        AnomalyNotifier, MSTeamsSelfHealingNotifier,
                        NotificationAction, SelfHealingNotifier,
@@ -20,7 +22,8 @@ from .provisioner import (BasicProvisioner, Provisioner,
                           ProvisionStatus)
 
 __all__ = [
-    "BrokerFailures", "DiskFailures", "GoalViolations", "KafkaAnomaly",
+    "BrokerFailures", "BrokerRisk", "ResilienceDetector",
+    "DiskFailures", "GoalViolations", "KafkaAnomaly",
     "KafkaAnomalyType", "KafkaMetricAnomaly", "MaintenanceEvent",
     "MaintenanceEventType", "SlowBrokers", "TopicReplicationFactorAnomaly",
     "BalancednessWeights", "BrokerFailureDetector", "DiskFailureDetector",

@@ -25,6 +25,9 @@ class KafkaAnomalyType(enum.IntEnum):
     METRIC_ANOMALY = 3
     TOPIC_ANOMALY = 4
     GOAL_VIOLATION = 5
+    #: predicted (what-if) risk, not a live fault — lowest priority:
+    #: every realized anomaly outranks a forecast
+    BROKER_RISK = 6
 
 
 _ids = itertools.count()
@@ -229,6 +232,52 @@ class TopicReplicationFactorAnomaly(KafkaAnomaly):
                 **_rf_change_kwargs(facade))
             ok &= exec_res is None or exec_res.succeeded
         return ok
+
+
+@dataclass
+class BrokerRisk(KafkaAnomaly):
+    """Predicted single-broker-loss risk from the resilience detector's
+    N-1 what-if sweep: losing any broker in ``at_risk`` would violate the
+    listed hard goals (no reference analog — the reference only reacts to
+    realized failures).
+
+    The 'fix' is provisioning evidence, not a rebalance: the anomaly
+    carries an UNDER_PROVISIONED recommendation (with the headroom
+    numbers that motivated it) and feeds it to the configured
+    Provisioner — acting ahead of the failure is the platform layer's
+    call, not an automatic drain of a healthy cluster.
+    """
+
+    #: broker id -> hard goals its loss would violate
+    at_risk: dict[int, list[str]] = field(default_factory=dict)
+    #: provisioner.ProvisionRecommendation (UNDER_PROVISIONED evidence)
+    recommendation: object | None = None
+    #: the sweep's max composite risk score [0, 1]
+    max_risk: float = 0.0
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.BROKER_RISK
+
+    def reason(self) -> str:
+        detail = "; ".join(
+            f"broker {b}: {', '.join(goals)}"
+            for b, goals in sorted(self.at_risk.items()))
+        return f"N-1 risk ({detail})"
+
+    def fix(self, facade) -> bool:
+        detector = getattr(facade, "detector", None)
+        provisioner = getattr(detector, "provisioner", None)
+        if provisioner is None or self.recommendation is None:
+            return False
+        provisioner.rightsize(recommendations=[self.recommendation])
+        return True
+
+    def to_json(self) -> dict:
+        out = super().to_json()
+        out["atRiskBrokers"] = {str(b): goals
+                                for b, goals in sorted(self.at_risk.items())}
+        out["maxRisk"] = round(self.max_risk, 4)
+        if self.recommendation is not None:
+            out["recommendation"] = self.recommendation.to_json()
+        return out
 
 
 class MaintenanceEventType(enum.Enum):

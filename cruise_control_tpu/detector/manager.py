@@ -323,10 +323,16 @@ class AnomalyDetectorManager:
     def state_json(self) -> dict:
         """ref AnomalyDetectorState.java:424."""
         balancedness = None
+        resilience = None
         for sched in self._schedules:
             if hasattr(sched.detector, "last_balancedness"):
                 balancedness = sched.detector.last_balancedness
+            if hasattr(sched.detector, "last_resilience"):
+                resilience = sched.detector.last_resilience
         return {
+            # 100 = the last N-1 sweep found every single-broker loss
+            # survivable (resilience detector; None = not registered/run)
+            "resilienceScore": resilience,
             "selfHealingEnabled": {
                 t.name: v for t, v in
                 self.notifier.self_healing_enabled().items()},

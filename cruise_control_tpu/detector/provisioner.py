@@ -33,6 +33,12 @@ class ProvisionRecommendation:
     topic: str | None = None
     resource: str | None = None
     reason: str = ""
+    #: the resource headroom numbers that motivated the verdict (e.g.
+    #: ``{"demand": ..., "usableCapacity": ..., "headroomPct": ...}``
+    #: from the optimizer's capacity math, or the post-N-1 remaining
+    #: headroom from the resilience sweep). Excluded from hash/eq so the
+    #: frozen record stays hashable despite the dict payload.
+    headroom: dict | None = field(default=None, hash=False, compare=False)
 
     def to_json(self) -> dict:
         out: dict = {"status": self.status.value, "reason": self.reason}
@@ -44,6 +50,8 @@ class ProvisionRecommendation:
             out["topic"] = self.topic
         if self.resource is not None:
             out["resource"] = self.resource
+        if self.headroom is not None:
+            out["headroom"] = self.headroom
         return out
 
 

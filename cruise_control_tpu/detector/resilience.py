@@ -1,0 +1,108 @@
+"""Resilience detector: proactive N-1 risk from the what-if engine.
+
+No reference analog — the reference's detectors only react to *realized*
+anomalies. This one runs the whole single-broker-loss sweep as one
+batched device program (whatif/engine.py) on the live model and raises a
+``BROKER_RISK`` anomaly when losing any single broker would violate a
+hard goal, carrying the UNDER_PROVISIONED evidence (post-failure
+headroom numbers) the Provisioner acts on. The forecast that "this
+cluster does not survive its next broker failure" is exactly the
+UNDER_PROVISIONED signal — it just arrives *before* the outage.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..whatif import alive_broker_ids, n1_sweep
+from ..whatif.spec import RESOURCE_KEYS
+from .anomalies import BrokerRisk
+from .provisioner import ProvisionRecommendation, ProvisionStatus
+
+LOG = logging.getLogger(__name__)
+
+
+class ResilienceDetector:
+    """Scheduled N-1 what-if sweep over the live cluster model.
+
+    Skips rounds while the cluster has realized failures (dead brokers /
+    offline replicas are BrokerFailure/DiskFailure territory — a sweep on
+    a degraded cluster would double-report the live anomaly as risk) and
+    while the monitor has no valid model. Exposes the last sweep for
+    /state consumers and a ``resilience-score`` gauge (100 = every
+    single-broker loss keeps all hard goals satisfied).
+    """
+
+    def __init__(self, monitor, whatif, *, registry=None) -> None:
+        self.monitor = monitor
+        self.whatif = whatif
+        #: last completed sweep's WhatIfReport (None until the first run)
+        self.last_report = None
+        #: 100 * (1 - max N-1 risk) of the last completed sweep. None
+        #: until a sweep actually ran — a detector stuck behind an
+        #: unready monitor or a degraded cluster must NOT report a
+        #: fabricated all-clear (the gauge and /state surface None).
+        self.last_resilience: float | None = None
+        if registry is not None:
+            from ..core.sensors import MetricRegistry
+            registry.gauge(
+                MetricRegistry.name("AnomalyDetector", "resilience-score"),
+                lambda: self.last_resilience)
+
+    def detect(self, now_ms: int) -> list[BrokerRisk]:
+        from ..monitor import NotEnoughValidWindowsException
+        alive = self.monitor.admin.describe_cluster()
+        if not all(alive.values()):
+            # A realized failure makes the last healthy-cluster forecast
+            # meaningless — surface "unknown", not a stale all-clear.
+            self.last_resilience = None
+            return []
+        offline_fn = getattr(self.monitor.admin, "offline_replicas", None)
+        if offline_fn is not None and offline_fn():
+            self.last_resilience = None
+            return []
+        try:
+            result = self.monitor.cluster_model(now_ms)
+        except NotEnoughValidWindowsException:
+            self.last_resilience = None
+            return []
+        ids = alive_broker_ids(result.model, result.metadata)
+        if len(ids) < 2:
+            return []     # losing the only broker is not a plannable event
+        report = self.whatif.sweep(result.model, result.metadata,
+                                   n1_sweep(ids),
+                                   stale_model=result.stale)
+        self.last_report = report
+        worst = report.riskiest()
+        self.last_resilience = round(100.0 * (1.0 - worst.risk), 2)
+        at_risk = {o.scenario.brokers[0]: o.violated_hard_goals
+                   for o in report.outcomes if o.violated_hard_goals}
+        if not at_risk:
+            return []
+        # UNDER_PROVISIONED evidence from the riskiest loss: the resource
+        # with the least post-failure headroom motivates the verdict.
+        risky = max((o for o in report.outcomes if o.violated_hard_goals),
+                    key=lambda o: o.risk)
+        tightest = min(
+            (k for k in RESOURCE_KEYS
+             if risky.headroom.get(k, {}).get("minBrokerFrac") is not None),
+            key=lambda k: risky.headroom[k]["minBrokerFrac"],
+            default=None)
+        rec = ProvisionRecommendation(
+            ProvisionStatus.UNDER_PROVISIONED,
+            num_brokers=1,
+            resource=tightest,
+            reason=(f"N-1 sweep: losing broker "
+                    f"{risky.scenario.brokers[0]} violates "
+                    f"{risky.violated_hard_goals} "
+                    f"(risk {risky.risk:.2f})"),
+            headroom={
+                "scenario": risky.scenario.name,
+                "capacityPressure": round(risky.capacity_pressure, 4),
+                "perResource": risky.headroom,
+            })
+        LOG.warning("resilience sweep: %d/%d single-broker losses violate "
+                    "hard goals (worst: %s, risk %.2f)",
+                    len(at_risk), len(ids), risky.scenario.name, risky.risk)
+        return [BrokerRisk(detected_ms=now_ms, at_risk=at_risk,
+                           recommendation=rec, max_risk=worst.risk)]

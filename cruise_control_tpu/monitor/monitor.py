@@ -130,6 +130,12 @@ class ClusterModelResult:
         #: cache because the live history missed completeness (sample
         #: dropouts) — consumers may act on it but should surface the flag
         self.stale = False
+        #: non-None marks a HYPOTHETICAL result (a what-if scenario
+        #: transform of the live model, labeled with the scenario name).
+        #: Scenario results must never reach live-cluster consumers: the
+        #: proposal cache rejects them outright (ProposalCache.store /
+        #: _compute). The monitor itself always emits None here.
+        self.scenario_label: str | None = None
         self.model = model                  # FlatClusterModel
         self.metadata = metadata            # ClusterMetadata
         self.completeness = completeness

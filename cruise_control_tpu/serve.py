@@ -29,6 +29,7 @@ from .detector import (AnomalyDetectorManager, BalancednessWeights,
                        BrokerFailureDetector, DiskFailureDetector,
                        GoalViolationDetector, KafkaAnomalyType,
                        MaintenanceEventDetector, MetricAnomalyDetector,
+                       ResilienceDetector,
                        SelfHealingNotifier, SlowBrokerFinder,
                        TopicAnomalyDetector)
 from .executor import Executor, SimulatedKafkaCluster
@@ -221,6 +222,18 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         admin, target_rf=config.get_int(
             "topic.anomaly.target.replication.factor")),
         config.get_int("topic.anomaly.detection.interval.ms"))
+    # Proactive N-1 resilience sweep (whatif engine, shared with
+    # /simulate so the compiled sweep program is paid for once). 0
+    # disables it.
+    # The scenario cap guards /simulate too, so it applies regardless of
+    # whether the resilience detector is enabled.
+    facade.whatif.max_scenarios = config.get_int("whatif.max.scenarios")
+    resilience_interval = config.get_int("resilience.detection.interval.ms")
+    if resilience_interval > 0:
+        detector.register(
+            ResilienceDetector(monitor, facade.whatif,
+                               registry=detector.registry),
+            resilience_interval)
     # ref maintenance.event.reader.class (empty = maintenance events
     # disabled, the reference default): the reader drains operator-
     # announced plans with idempotence de-dup; MaintenanceEvent.fix reads

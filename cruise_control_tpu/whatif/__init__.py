@@ -1,0 +1,20 @@
+"""What-if scenario layer (L-whatif): batched device-side simulation of
+hypothetical topologies — failures, growth, capacity changes — scored by
+the same goal kernels that drive optimization.
+
+The whole point of flattening ``ClusterModel`` into arrays is that a
+hypothetical topology is just an array transform: a 100-broker N-1 sweep
+is ONE vmapped device program over a ``[S, ...]`` scenario axis, not 100
+sequential model rebuilds.
+"""
+
+from .spec import (BrokerAdd, BrokerLoss, CapacityResize, LoadScale,
+                   Scenario, TopicAdd, alive_broker_ids, n1_sweep, n2_sweep,
+                   parse_scenarios)
+from .engine import ScenarioOutcome, WhatIfEngine, WhatIfReport
+
+__all__ = [
+    "Scenario", "BrokerLoss", "BrokerAdd", "CapacityResize", "LoadScale",
+    "TopicAdd", "n1_sweep", "n2_sweep", "alive_broker_ids",
+    "parse_scenarios", "WhatIfEngine", "WhatIfReport", "ScenarioOutcome",
+]

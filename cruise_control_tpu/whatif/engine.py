@@ -1,0 +1,638 @@
+"""What-if engine: materialize scenario batches and score them on device.
+
+A scenario batch becomes five per-scenario parameter arrays stacked on a
+leading ``S`` axis (dead-broker mask, added-broker mask, capacity scale,
+partition load scale, partition enable mask). ONE jitted program then
+vmaps the whole pipeline per scenario:
+
+    transform (kill/add/resize/scale/enable + leadership failover)
+      -> init_state / build_context        (analyzer/state.py, unchanged)
+      -> violation_stack over the goal chain (analyzer/goals.py, unchanged)
+      -> headroom / pressure / availability reductions
+
+so a 100-broker N-1 sweep scores every goal for every scenario in a
+single device dispatch — no per-scenario Python loop, no model rebuilds.
+The scenario axis is padded to a bucket multiple so sweeps of nearby
+sizes reuse one compiled program.
+
+Leadership failover inside the transform mirrors Kafka's election: the
+alive, non-offline replica with the lowest *preferred-order* position
+(``replica_pref_pos``) becomes the leader; partitions with no electable
+replica are counted unavailable. Dead brokers keep their (now invisible
+to the alive-masked goal reductions) residual load — the scored state is
+the cluster *immediately after failover*, before any self-healing moves.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analyzer.constraint import BalancingConstraint
+from ..analyzer.engine import violation_stack
+from ..analyzer.state import build_context, init_state
+from ..core.resources import NUM_RESOURCES
+from ..model.flat import FlatClusterModel
+from .spec import (BrokerAdd, BrokerLoss, CapacityResize, LoadScale,
+                   RESOURCE_KEYS, Scenario, TopicAdd)
+
+#: risk-score shape constants (documented in docs/whatif.md): the four
+#: component terms combine as 1 - prod(1 - term), each term in [0, 1].
+_RISK_HARD_W = 0.9     # any violated hard goal dominates
+_RISK_SOFT_W = 0.3     # soft violations alone cap at moderate risk
+_RISK_PRESSURE_W = 0.7  # capacity pressure ramps 70% -> 130% of usable
+_RISK_PRESSURE_LO = 0.7
+_RISK_PRESSURE_SPAN = 0.6
+
+
+def _round_up(n: int, multiple: int) -> int:
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+@dataclass
+class ScenarioOutcome:
+    """One scenario's scorecard (everything host-side numbers)."""
+
+    scenario: Scenario
+    risk: float
+    violated_goals: list[str]
+    violated_hard_goals: list[str]
+    capacity_pressure: float          # max alive util / usable capacity
+    unavailable_partitions: int       # no electable replica post-failover
+    offline_replicas: int
+    #: per-resource post-scenario headroom: remaining usable capacity
+    #: (absolute, summed over alive brokers, floored at 0) and the worst
+    #: single broker's headroom fraction
+    headroom: dict = field(default_factory=dict)
+    #: broker id (or "new-<row>") with the least headroom fraction
+    worst_broker: object = None
+
+    def to_json(self) -> dict:
+        return {"scenario": self.scenario.to_json(),
+                "name": self.scenario.name,
+                "risk": round(self.risk, 4),
+                "violatedGoals": self.violated_goals,
+                "violatedHardGoals": self.violated_hard_goals,
+                "capacityPressure": round(self.capacity_pressure, 4),
+                "unavailablePartitions": self.unavailable_partitions,
+                "offlineReplicas": self.offline_replicas,
+                "headroom": self.headroom,
+                "worstBroker": self.worst_broker}
+
+
+@dataclass
+class WhatIfReport:
+    outcomes: list[ScenarioOutcome]
+    goals: list[str]
+    duration_s: float
+    stale_model: bool = False
+
+    @property
+    def num_scenarios(self) -> int:
+        return len(self.outcomes)
+
+    def riskiest(self) -> ScenarioOutcome | None:
+        return max(self.outcomes, key=lambda o: o.risk, default=None)
+
+    def to_json(self) -> dict:
+        worst = self.riskiest()
+        return {"numScenarios": self.num_scenarios,
+                "goals": self.goals,
+                "durationMs": round(self.duration_s * 1e3, 3),
+                "staleModel": self.stale_model,
+                "riskiest": None if worst is None else worst.scenario.name,
+                "maxRisk": 0.0 if worst is None else round(worst.risk, 4),
+                "scenarios": [o.to_json() for o in self.outcomes]}
+
+
+@dataclass
+class _Batch:
+    """Materialized scenario batch: a staged template model (added-broker
+    rows and projected-topic rows pre-written into padding) plus the
+    per-scenario parameter arrays, padded to ``S_pad``."""
+
+    template: FlatClusterModel
+    dead: np.ndarray        # bool[S_pad, B]
+    add: np.ndarray         # bool[S_pad, B]
+    cap_scale: np.ndarray   # f32[S_pad, B, 4]
+    pscale: np.ndarray      # f32[S_pad, P]
+    pvalid: np.ndarray      # bool[S_pad, P]
+    num_real: int
+    new_broker_rows: dict[int, int]   # padding row -> scenario index
+    #: distinct staged (TopicAdd) topics, ids metadata.num_topics + k
+    num_staged_topics: int = 0
+
+
+class WhatIfEngine:
+    """Batched hypothetical-topology scorer.
+
+    ``goals`` default to the analyzer's default chain; the engine binds
+    them per metadata exactly like the optimizer, and caches one jitted
+    program per (shape, scenario-bucket, goal-binding) signature so
+    repeated sweeps — the resilience detector's steady state — pay XLA
+    once.
+    """
+
+    def __init__(self, goals=None, constraint: BalancingConstraint | None = None,
+                 *, registry=None, tracer=None,
+                 scenario_pad_multiple: int = 8,
+                 # Covers a full N-2 pairwise sweep up to 128 brokers
+                 # (128*127/2 = 8128); per-scenario [S, P] parameter
+                 # arrays scale the footprint, so operators with huge
+                 # partition counts can lower it (whatif.max.scenarios).
+                 max_scenarios: int = 8192,
+                 program_cache_size: int = 8) -> None:
+        from ..analyzer.goals import default_goals
+        from ..core.sensors import MetricRegistry
+        from ..core.tracing import default_tracer
+        self.constraint = constraint or BalancingConstraint()
+        self.goals = (goals if goals is not None
+                      else default_goals(self.constraint))
+        import threading
+        self.scenario_pad_multiple = scenario_pad_multiple
+        self.max_scenarios = max_scenarios
+        self.program_cache_size = program_cache_size
+        # The engine is shared between HTTP request threads (/simulate)
+        # and the detector background thread — get-or-create under a
+        # lock, like the optimizer's _chains (two racing first sweeps
+        # must converge on ONE program object, and eviction must not
+        # iterate a dict another thread is inserting into).
+        self._programs: dict = {}
+        self._programs_lock = threading.Lock()
+        self.registry = registry or MetricRegistry()
+        self.tracer = tracer or default_tracer()
+        name = MetricRegistry.name
+        self._sweep_timer = self.registry.timer(
+            name("WhatIfEngine", "sweep-timer"))
+        self._sweep_meter = self.registry.meter(
+            name("WhatIfEngine", "sweep-rate"))
+        self._scenario_counter = self.registry.counter(
+            name("WhatIfEngine", "scenarios-evaluated"))
+
+    # ------------------------------------------------------------- public
+    def sweep(self, model: FlatClusterModel, metadata, scenarios,
+              *, stale_model: bool = False) -> WhatIfReport:
+        """Score ``scenarios`` against the live model; returns the report.
+
+        The input model is never mutated (everything is functional); the
+        hypothetical models never leave the device, so they cannot leak
+        into any live-cluster consumer (see ProposalCache's scenario
+        guard for the belt-and-braces host side).
+        """
+        if not scenarios:
+            raise ValueError("sweep requires at least one scenario")
+        if len(scenarios) > self.max_scenarios:
+            raise ValueError(
+                f"{len(scenarios)} scenarios exceed the engine cap of "
+                f"{self.max_scenarios} (raise max_scenarios or split the "
+                "sweep)")
+        t0 = time.monotonic()
+        with self.tracer.span("whatif.sweep",
+                              scenarios=len(scenarios)) as sp:
+            batch = self._materialize(model, metadata, scenarios)
+            goals = [g.bind(metadata) for g in self.goals]
+            program = self._program_for(batch, goals, metadata)
+            out = program(batch.template,
+                          jnp.asarray(batch.dead), jnp.asarray(batch.add),
+                          jnp.asarray(batch.cap_scale),
+                          jnp.asarray(batch.pscale),
+                          jnp.asarray(batch.pvalid))
+            (viol, vscale, headroom, hfrac, pressure, unavailable,
+             n_offline) = (np.asarray(a) for a in jax.device_get(out))
+            report = self._build_report(
+                scenarios, goals, metadata, batch,
+                viol, vscale, headroom, hfrac, pressure, unavailable,
+                n_offline, t0, stale_model)
+            worst = report.riskiest()
+            sp.set(maxRisk=round(worst.risk, 4),
+                   riskiest=worst.scenario.name)
+        self._sweep_timer.update(report.duration_s)
+        self._sweep_meter.mark()
+        self._scenario_counter.inc(len(scenarios))
+        return report
+
+    def warmup(self, model: FlatClusterModel, metadata,
+               num_scenarios: int = 1) -> None:
+        """Pre-compile the sweep program for this model's shapes and a
+        scenario bucket covering ``num_scenarios`` (no-op scenarios)."""
+        self.sweep(model, metadata,
+                   [LoadScale(factor=1.0)] * max(num_scenarios, 1))
+
+    def transformed(self, model: FlatClusterModel, metadata, scenarios
+                    ) -> list[FlatClusterModel]:
+        """The post-transform hypothetical models, unstacked to host —
+        debug/test surface (the sweep itself never materializes these
+        outside the device program)."""
+        batch = self._materialize(model, metadata, scenarios)
+        key = ("transform",) + self._shape_key(batch)
+        with self._programs_lock:
+            program = self._programs.get(key)
+            if program is None:
+                program = self._cache_program(key, jax.jit(jax.vmap(
+                    self._transform_fn(), in_axes=(None, 0, 0, 0, 0, 0))))
+        stacked, _has_alive = program(
+            batch.template,
+            jnp.asarray(batch.dead), jnp.asarray(batch.add),
+            jnp.asarray(batch.cap_scale), jnp.asarray(batch.pscale),
+            jnp.asarray(batch.pvalid))
+        return [jax.tree.map(lambda a, i=i: a[i], stacked)
+                for i in range(batch.num_real)]
+
+    # -------------------------------------------------------- device side
+    @staticmethod
+    def _transform_fn():
+        """(model, dead, add, cap_scale, pscale, pvalid) -> (model',
+        has_alive[P]) — the pure per-scenario topology edit."""
+
+        def transform(model: FlatClusterModel, dead, add, cap_scale,
+                      pscale, pvalid):
+            B = model.num_brokers_padded
+            valid_b = model.broker_valid | add
+            alive_b = (model.broker_alive | add) & ~dead
+            capacity = model.broker_capacity * cap_scale
+            leader_load = model.leader_load * pscale[:, None]
+            follower_load = model.follower_load * pscale[:, None]
+            # Disabled partition rows (template padding this scenario does
+            # not enable) must stay empty: route their replicas to the
+            # sentinel so no scatter ever sees them.
+            rb = jnp.where(pvalid[:, None], model.replica_broker, B)
+            off = model.replica_offline & pvalid[:, None]
+            pref = model.replica_pref_pos
+
+            # Leadership failover: the alive, non-offline replica with the
+            # lowest preferred-order position takes over (Kafka elects from
+            # the ISR in assignment order; pref_pos IS that order).
+            P, R = rb.shape
+            alive1 = jnp.concatenate([alive_b & valid_b,
+                                      jnp.zeros((1,), bool)])
+            slot_valid = rb < B
+            electable = slot_valid & alive1[rb] & ~off
+            score = jnp.where(electable, pref, R + 1)
+            j = jnp.argmin(score, axis=1).astype(jnp.int32)
+            has_alive = electable.any(axis=1)
+            need = has_alive & ~electable[:, 0] & pvalid
+            rows = jnp.arange(P)
+            # Swap slot j <-> slot 0 (broker, preferred position, offline
+            # flag travel together); non-failover rows route the column
+            # write out of bounds (dropped). j > 0 whenever need holds:
+            # slot 0 scores R+1 then, strictly above any electable slot.
+            jw = jnp.where(need, j, R)
+            lead_j, lead_0 = rb[rows, j], rb[:, 0]
+            rb = rb.at[rows, jw].set(lead_0, mode="drop")
+            rb = rb.at[:, 0].set(jnp.where(need, lead_j, lead_0))
+            pref_j, pref_0 = pref[rows, j], pref[:, 0]
+            pref = pref.at[rows, jw].set(pref_0, mode="drop")
+            pref = pref.at[:, 0].set(jnp.where(need, pref_j, pref_0))
+            off_j, off_0 = off[rows, j], off[:, 0]
+            off = off.at[rows, jw].set(off_0, mode="drop")
+            off = off.at[:, 0].set(jnp.where(need, off_j, off_0))
+            # Every replica stranded on a dead/invalid broker is offline.
+            off = off | ((rb < B) & ~alive1[rb])
+
+            m = model.replace(
+                replica_broker=rb, replica_offline=off,
+                replica_pref_pos=pref,
+                leader_load=leader_load, follower_load=follower_load,
+                partition_valid=pvalid,
+                broker_capacity=capacity,
+                broker_alive=alive_b, broker_valid=valid_b,
+                broker_new=model.broker_new | add)
+            return m, has_alive
+
+        return transform
+
+    def _program_for(self, batch: _Batch, goals, metadata):
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals)
+        needs_topics = needs_tlc or any(g.uses_topic_counts for g in goals)
+        # Staged (TopicAdd) topics get ids beyond metadata.num_topics —
+        # the topic-count arrays must cover them or topic-scoped goals
+        # would silently drop the simulated topic's replicas.
+        num_topics = metadata.num_topics + batch.num_staged_topics
+        key = (("sweep",) + self._shape_key(batch)
+               + (tuple((g.name, g.bind_signature()) for g in goals),
+                  num_topics if needs_topics else None, needs_tlc))
+        with self._programs_lock:
+            program = self._programs.get(key)
+            if program is not None:
+                return program
+            return self._build_sweep_program(key, goals, num_topics,
+                                             needs_topics, needs_tlc)
+
+    def _build_sweep_program(self, key, goals, num_topics, needs_topics,
+                             needs_tlc):
+        transform = self._transform_fn()
+        cap_thr = jnp.asarray(self.constraint.capacity_threshold,
+                              jnp.float32)
+        goals = tuple(goals)
+
+        def one(model, dead, add, cap_scale, pscale, pvalid):
+            m, has_alive = transform(model, dead, add, cap_scale, pscale,
+                                     pvalid)
+            state = init_state(
+                m,
+                with_topic_counts=num_topics if needs_topics else None,
+                with_topic_leader_counts=needs_tlc)
+            ctx = build_context(m)
+            viol = violation_stack(goals, state, ctx)
+            vscale = jnp.stack([g.violation_scale(state, ctx)
+                                for g in goals])
+            B = m.num_brokers_padded
+            util = state.util[:B]
+            usable = m.broker_capacity * cap_thr[None, :]
+            alive = m.broker_alive & m.broker_valid
+            headroom = jnp.where(alive[:, None], usable - util, 0.0)
+            hfrac = jnp.where(
+                alive[:, None],
+                1.0 - util / jnp.maximum(usable, 1e-9), jnp.inf)
+            pressure = jnp.where(alive[:, None],
+                                 util / jnp.maximum(usable, 1e-9),
+                                 0.0).max()
+            unavailable = (m.partition_valid & ~has_alive).sum()
+            n_offline = (m.replica_offline & (m.replica_broker < B)).sum()
+            return viol, vscale, headroom, hfrac, pressure, unavailable, \
+                n_offline
+
+        return self._cache_program(
+            key, jax.jit(jax.vmap(one, in_axes=(None, 0, 0, 0, 0, 0))))
+
+    def _cache_program(self, key, program):
+        self._programs[key] = program
+        # Bounded like the optimizer's audit-fn cache: bind signatures can
+        # carry per-topic masks, so an evolving topic set must not
+        # accumulate compiled programs forever.
+        while len(self._programs) > self.program_cache_size:
+            self._programs.pop(next(iter(self._programs)))
+        return program
+
+    @staticmethod
+    def _shape_key(batch: _Batch):
+        t = batch.template
+        return (batch.dead.shape[0], t.replica_broker.shape,
+                t.broker_capacity.shape)
+
+    # ---------------------------------------------------------- host side
+    def _materialize(self, model: FlatClusterModel, metadata,
+                     scenarios) -> _Batch:
+        """Expand scenario specs into the staged template + per-scenario
+        parameter arrays (all host-side numpy; one device upload each)."""
+        S = len(scenarios)
+        S_pad = _round_up(S, self.scenario_pad_multiple)
+
+        bvalid = np.asarray(model.broker_valid)
+        balive = np.asarray(model.broker_alive)
+        pvalid0 = np.asarray(model.partition_valid)
+        adds = [s for s in scenarios if isinstance(s, BrokerAdd)]
+        topic_adds = [s for s in scenarios if isinstance(s, TopicAdd)]
+        need_b = sum(s.count for s in adds)
+        need_p = sum(s.partitions for s in topic_adds)
+        need_r = max([s.rf for s in topic_adds], default=0)
+        model = _ensure_padding(model, int((~bvalid).sum()), need_b,
+                                int((~pvalid0).sum()), need_p, need_r)
+        bvalid = np.asarray(model.broker_valid)
+        balive = np.asarray(model.broker_alive)
+        pvalid0 = np.asarray(model.partition_valid)
+        B = model.num_brokers_padded
+        P, R = model.replica_broker.shape
+        free_b = list(np.nonzero(~bvalid)[0])
+        free_p = list(np.nonzero(~pvalid0)[0])
+        alive_rows = np.nonzero(bvalid & balive)[0]
+
+        dead = np.zeros((S_pad, B), bool)
+        add = np.zeros((S_pad, B), bool)
+        cap_scale = np.ones((S_pad, B, NUM_RESOURCES), np.float32)
+        pscale = np.ones((S_pad, P), np.float32)
+        pvalid = np.tile(pvalid0, (S_pad, 1))
+
+        # Staged template arrays (copies only when something needs
+        # staging).
+        capacity = rack = host = rb = ll = fl = ptopic = None
+        new_broker_rows: dict[int, int] = {}
+        if adds:
+            capacity = np.array(model.broker_capacity)
+            rack = np.array(model.broker_rack)
+            host = np.array(model.broker_host)
+            mean_cap = capacity[alive_rows].mean(axis=0) if len(alive_rows) \
+                else np.zeros(NUM_RESOURCES, np.float32)
+            next_rack = int(rack[bvalid].max(initial=-1)) + 1
+            next_host = int(host[bvalid].max(initial=-1)) + 1
+        if topic_adds:
+            rb = np.array(model.replica_broker)
+            ll = np.array(model.leader_load)
+            fl = np.array(model.follower_load)
+            ptopic = np.array(model.partition_topic)
+
+        topic_add_idx = 0
+        for s_i, scn in enumerate(scenarios):
+            if isinstance(scn, BrokerLoss):
+                for bid in scn.brokers:
+                    row = metadata.broker_index.get(bid)
+                    if row is None:
+                        raise ValueError(
+                            f"broker_loss: unknown broker id {bid}")
+                    dead[s_i, row] = True
+            elif isinstance(scn, BrokerAdd):
+                for _ in range(scn.count):
+                    row = free_b.pop(0)
+                    add[s_i, row] = True
+                    new_broker_rows[row] = s_i
+                    capacity[row] = np.asarray(
+                        scn.capacity if scn.capacity is not None
+                        else mean_cap, np.float32)
+                    rack[row] = next_rack
+                    host[row] = next_host
+                    next_rack += 1
+                    next_host += 1
+            elif isinstance(scn, CapacityResize):
+                rows = (slice(None) if scn.brokers is None else
+                        [self._broker_row(metadata, b, "capacity_resize")
+                         for b in scn.brokers])
+                if scn.resource is None:
+                    cap_scale[s_i, rows, :] *= scn.factor
+                else:
+                    cap_scale[s_i, rows,
+                              RESOURCE_KEYS.index(scn.resource)] *= \
+                        scn.factor
+            elif isinstance(scn, LoadScale):
+                if scn.topics is None:
+                    pscale[s_i, :] *= scn.factor
+                else:
+                    tids = []
+                    for t in scn.topics:
+                        tid = metadata.topic_index.get(t)
+                        if tid is None:
+                            raise ValueError(
+                                f"load_scale: unknown topic {t!r}")
+                        tids.append(tid)
+                    sel = np.isin(np.asarray(model.partition_topic), tids)
+                    pscale[s_i, sel] *= scn.factor
+            elif isinstance(scn, TopicAdd):
+                if scn.rf > len(alive_rows):
+                    raise ValueError(
+                        f"topic_add: rf {scn.rf} exceeds the "
+                        f"{len(alive_rows)} alive brokers")
+                lead = np.asarray(scn.leader_load, np.float32)
+                foll = np.asarray(scn.derived_follower_load(), np.float32)
+                tid = metadata.num_topics + topic_add_idx
+                topic_add_idx += 1
+                for k in range(scn.partitions):
+                    row = free_p.pop(0)
+                    pvalid[s_i, row] = True
+                    rb[row, :] = B
+                    for r in range(scn.rf):
+                        rb[row, r] = alive_rows[(k + r) % len(alive_rows)]
+                    ll[row] = lead
+                    fl[row] = foll
+                    ptopic[row] = tid
+            else:
+                raise ValueError(f"unknown scenario type {type(scn)}")
+
+        replaced = {}
+        if adds:
+            replaced.update(broker_capacity=jnp.asarray(capacity),
+                            broker_rack=jnp.asarray(rack),
+                            broker_host=jnp.asarray(host))
+        if topic_adds:
+            replaced.update(replica_broker=jnp.asarray(rb),
+                            leader_load=jnp.asarray(ll),
+                            follower_load=jnp.asarray(fl),
+                            partition_topic=jnp.asarray(ptopic))
+        template = model.replace(**replaced) if replaced else model
+        return _Batch(template=template, dead=dead, add=add,
+                      cap_scale=cap_scale, pscale=pscale, pvalid=pvalid,
+                      num_real=S, new_broker_rows=new_broker_rows,
+                      num_staged_topics=len(topic_adds))
+
+    @staticmethod
+    def _broker_row(metadata, bid: int, what: str) -> int:
+        row = metadata.broker_index.get(bid)
+        if row is None:
+            raise ValueError(f"{what}: unknown broker id {bid}")
+        return row
+
+    def _build_report(self, scenarios, goals, metadata, batch,
+                      viol, vscale, headroom, hfrac, pressure, unavailable,
+                      n_offline, t0, stale_model) -> WhatIfReport:
+        S = len(scenarios)
+        hard = np.array([g.hard for g in goals], bool)
+        # Same ulp-aware cutoff as GoalResult.satisfied: a broker landing
+        # exactly on a float32-summed capacity limit is not a violation.
+        violated = viol[:S] > (1e-6 + 1e-6 * vscale[:S])
+        n_hard = max(int(hard.sum()), 1)
+        n_soft = max(int((~hard).sum()), 1)
+        hard_frac = violated[:, hard].sum(axis=1) / n_hard
+        soft_frac = violated[:, ~hard].sum(axis=1) / n_soft
+        pressure = pressure[:S]
+        unavailable = unavailable[:S].astype(int)
+        valid_parts = batch.pvalid[:S].sum(axis=1).clip(min=1)
+        pressure_term = np.clip(
+            (pressure - _RISK_PRESSURE_LO) / _RISK_PRESSURE_SPAN, 0.0, 1.0)
+        avail_term = np.where(
+            unavailable > 0,
+            np.minimum(0.9 + 0.1 * unavailable / valid_parts, 1.0), 0.0)
+        risk = 1.0 - ((1.0 - _RISK_HARD_W * hard_frac)
+                      * (1.0 - _RISK_SOFT_W * soft_frac)
+                      * (1.0 - _RISK_PRESSURE_W * pressure_term)
+                      * (1.0 - avail_term))
+
+        def broker_label(row: int):
+            if row in batch.new_broker_rows:
+                return f"new-{row}"
+            if row < len(metadata.broker_ids):
+                return metadata.broker_ids[row]
+            return int(row)
+
+        outcomes = []
+        for i, scn in enumerate(scenarios):
+            names = [g.name for g, v in zip(goals, violated[i]) if v]
+            hard_names = [g.name for g, v, h in zip(goals, violated[i],
+                                                    hard) if v and h]
+            hf = hfrac[i]                       # [B, 4], inf on non-alive
+            per_res = {}
+            for r, key in enumerate(RESOURCE_KEYS):
+                col = hf[:, r]
+                finite = np.isfinite(col)
+                per_res[key] = {
+                    "remaining": round(
+                        float(np.clip(headroom[i, :, r], 0.0, None).sum()),
+                        3),
+                    "minBrokerFrac": round(float(col[finite].min()), 4)
+                    if finite.any() else None}
+            min_per_broker = hf.min(axis=1)
+            worst_row = int(np.argmin(
+                np.where(np.isfinite(min_per_broker), min_per_broker,
+                         np.inf)))
+            outcomes.append(ScenarioOutcome(
+                scenario=scn,
+                risk=float(risk[i]),
+                violated_goals=names,
+                violated_hard_goals=hard_names,
+                capacity_pressure=float(pressure[i]),
+                unavailable_partitions=int(unavailable[i]),
+                offline_replicas=int(n_offline[i]),
+                headroom=per_res,
+                worst_broker=broker_label(worst_row)))
+        return WhatIfReport(outcomes=outcomes,
+                            goals=[g.name for g in goals],
+                            duration_s=time.monotonic() - t0,
+                            stale_model=stale_model)
+
+
+def _ensure_padding(model: FlatClusterModel, spare_b: int, need_b: int,
+                    spare_p: int, need_p: int, need_r: int
+                    ) -> FlatClusterModel:
+    """Re-pad the model (host-side) when the scenario batch needs more
+    padding broker rows / partition rows / replica slots than the live
+    model carries. Rare (BrokerAdd / TopicAdd beyond the pad slack) —
+    costs one numpy round-trip and a fresh program compile for the new
+    shapes."""
+    B = model.num_brokers_padded
+    P, R = model.replica_broker.shape
+    new_B = B if need_b <= spare_b else _round_up(B + need_b - spare_b, 8)
+    new_P = P if need_p <= spare_p else _round_up(P + need_p - spare_p, 128)
+    new_R = max(R, need_r)
+    if (new_B, new_P, new_R) == (B, P, R):
+        return model
+
+    rb = np.asarray(model.replica_broker)
+    out_rb = np.full((new_P, new_R), new_B, np.int32)
+    out_rb[:P, :R] = np.where(rb == B, new_B, rb)
+
+    def pad_p(arr, fill):
+        arr = np.asarray(arr)
+        out = np.full((new_P,) + arr.shape[1:], fill, arr.dtype)
+        out[:P] = arr
+        return out
+
+    def pad_b(arr, fill):
+        arr = np.asarray(arr)
+        out = np.full((new_B,) + arr.shape[1:], fill, arr.dtype)
+        out[:B] = arr
+        return out
+
+    pref = np.tile(np.arange(new_R, dtype=np.int32), (new_P, 1))
+    pref[:P, :R] = np.asarray(model.replica_pref_pos)
+    offline = np.zeros((new_P, new_R), bool)
+    offline[:P, :R] = np.asarray(model.replica_offline)
+    return FlatClusterModel.from_numpy(
+        replica_broker=out_rb,
+        leader_load=pad_p(model.leader_load, 0.0),
+        follower_load=pad_p(model.follower_load, 0.0),
+        partition_topic=pad_p(model.partition_topic, -1),
+        partition_valid=pad_p(model.partition_valid, False),
+        replica_offline=offline,
+        replica_pref_pos=pref,
+        broker_capacity=pad_b(model.broker_capacity, 0.0),
+        broker_rack=pad_b(model.broker_rack, 0),
+        broker_host=pad_b(model.broker_host, 0),
+        broker_set=pad_b(model.broker_set, -1),
+        broker_alive=pad_b(model.broker_alive, False),
+        broker_new=pad_b(model.broker_new, False),
+        broker_demoted=pad_b(model.broker_demoted, False),
+        broker_broken_disk=pad_b(model.broker_broken_disk, False),
+        broker_valid=pad_b(model.broker_valid, False))

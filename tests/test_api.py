@@ -742,3 +742,109 @@ def test_unknown_goal_names_are_400_at_dispatch(stack):
     assert status == 200
     audited = {g["goal"] for g in body["hardGoalAudit"]}
     assert not audited & {"RackAwareGoal", "CpuCapacityGoal"}
+
+
+def test_simulate_endpoint_sweep_and_json_body(stack):
+    """POST /simulate: form-encoded sweep and raw-JSON scenario body both
+    produce the per-scenario report; the live proposal cache is never
+    touched by a what-if sweep."""
+    _sim, facade, app = stack
+    facade.proposal_cache.invalidate()
+    status, body, _ = call(app, "POST", "simulate", "sweep=N1")
+    assert status == 200
+    assert body["numScenarios"] == 4
+    assert body["goals"] == GOALS
+    names = {s["name"] for s in body["scenarios"]}
+    assert names == {f"loss:{b}" for b in range(4)}
+    for s in body["scenarios"]:
+        assert 0.0 <= s["risk"] <= 1.0
+        assert set(s["headroom"]) == {"cpu", "nwIn", "nwOut", "disk"}
+    # the sweep is a pure read: no cache entry appeared
+    assert facade.proposal_cache.peek() is None
+
+    payload = {"scenarios": [
+        {"type": "broker_loss", "brokers": [1, 2]},
+        {"type": "load_scale", "factor": 2.0},
+        {"type": "topic_add", "topic": "proj", "partitions": 3, "rf": 2,
+         "leaderLoad": [1, 1, 1, 1]}]}
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/simulate"
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        body = json.loads(resp.read())
+    assert body["numScenarios"] == 3
+    assert [s["name"] for s in body["scenarios"]] == [
+        "loss:1,2", "load:all:2", "topic:proj:3x2"]
+    assert body["scenarios"][0]["offlineReplicas"] > 0
+
+
+def test_simulate_endpoint_validation(stack):
+    _, _, app = stack
+    status, body, _ = call(app, "POST", "simulate", expect=400)
+    assert "exactly one" in body["errorMessage"]
+    status, body, _ = call(app, "POST", "simulate", "sweep=N3", expect=400)
+    assert "N1" in body["errorMessage"]
+    status, body, _ = call(app, "POST", "simulate",
+                           "sweep=N1&scenarios=[]", expect=400)
+    assert "exactly one" in body["errorMessage"]
+    status, body, _ = call(app, "POST", "simulate",
+                           "scenarios=not-json", expect=400)
+    assert "JSON" in body["errorMessage"]
+    status, body, _ = call(
+        app, "POST", "simulate",
+        'scenarios=[{"type":"broker_loss","brokers":[99]}]', expect=400)
+    assert "unknown broker id 99" in body["errorMessage"]
+    # GET probing a POST endpoint
+    status, body, _ = call(app, "GET", "simulate", expect=405)
+
+
+def test_simulate_request_sensors_and_span(stack):
+    _, facade, app = stack
+    call(app, "POST", "simulate", "sweep=N1")
+    text = facade.registry.expose_text()
+    assert "simulate_request_rate" in text.replace("-", "_")
+    assert "WhatIfEngine" in text
+    status, trace, _ = call(app, "GET", "trace")
+    names = {e.get("name") for e in trace["traceEvents"]}
+    assert "api.simulate" in names
+    assert "whatif.sweep" in names
+
+
+def test_openapi_simulate_and_trace_round_trip(stack):
+    """Satellite: /simulate and /trace are in the generated spec, every
+    $ref in the document resolves into components, and the spec
+    round-trips through JSON unchanged (it is served as JSON)."""
+    _, _, app = stack
+    status, spec, _ = call(app, "GET", "openapi")
+    assert status == 200
+    spec = json.loads(json.dumps(spec))      # wire round-trip
+    paths = spec["paths"]
+    sim = paths["/kafkacruisecontrol/simulate"]["post"]
+    assert sim["responses"]["200"]["content"]["application/json"][
+        "schema"]["$ref"].endswith("WhatIfReport")
+    # simulate is read-only: no review parking, so no 202/429
+    assert "202" not in sim["responses"]
+    assert "429" not in sim["responses"]
+    declared = {p["name"] for p in sim["parameters"]}
+    assert {"sweep", "scenarios"} <= declared
+    trace = paths["/kafkacruisecontrol/trace"]["get"]
+    assert trace["responses"]["200"]["content"]["application/json"][
+        "schema"]["$ref"].endswith("TraceEvents")
+    schemas = spec["components"]["schemas"]
+    assert {"WhatIfReport", "TraceEvents"} <= set(schemas)
+
+    def refs(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "$ref":
+                    yield v
+                else:
+                    yield from refs(v)
+        elif isinstance(node, list):
+            for item in node:
+                yield from refs(item)
+
+    for ref in refs(spec):
+        assert ref.startswith("#/components/schemas/"), ref
+        assert ref.rsplit("/", 1)[1] in schemas, ref

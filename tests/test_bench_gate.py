@@ -66,3 +66,20 @@ def test_model_build_bench_smoke_gate():
     assert out["partitions"] == 96
     assert out["dense_s"] > 0 and out["legacy_s"] > 0
     assert out["speedup"] is not None
+
+
+def test_whatif_bench_smoke_gate():
+    """run_whatif_n1_bench on a toy cluster: exercises the batched sweep,
+    the sequential rebuild baseline and the built-in batched/single
+    violation-parity check end-to-end (a scoring mismatch raises inside
+    the helper). Tier-1 safe: no speedup gate at toy scale — the >= 5x
+    bar is judged at bench scale (100x20k), where the rebuild cost is
+    real."""
+    import bench
+    out = bench.run_whatif_n1_bench(num_brokers=10, num_partitions=96,
+                                    repeats=1, rebuild_samples=2,
+                                    single_samples=4,
+                                    emit_row=False, gate=False)
+    assert out["scenarios"] == 10
+    assert out["warm_s"] > 0 and out["rebuild_s"] > 0
+    assert out["speedup"] is not None and out["vs_dispatch"] is not None

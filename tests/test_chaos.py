@@ -476,3 +476,86 @@ def test_randomized_soak(optimizer, chaos_seed, seed):
     h.engine.schedule_random_soak(steps=24)
     h.run(24)
     drive_to_health(h, base, "test_randomized_soak", budget=200)
+
+
+def test_whatif_prediction_matches_post_kill_reality(optimizer, chaos_seed):
+    """What-if cross-check: run the N-1 sweep on the live model, kill the
+    broker the simulator flagged riskiest, and assert the PREDICTED
+    post-failover state (leaders, offline replicas, violated goals)
+    matches the chaos engine's observed post-kill reality — then let
+    self-healing run and audit the full invariant set."""
+    from cruise_control_tpu.whatif import (LoadScale, WhatIfEngine,
+                                           alive_broker_ids, n1_sweep)
+    seed = _pick(chaos_seed, 23)
+    h = make_harness(optimizer, seed, skewed=True)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    mr = h.monitor.cluster_model(h.engine.now_ms())
+    assert not mr.stale
+
+    eng = WhatIfEngine(goals=optimizer.goals,
+                       constraint=optimizer.constraint)
+    report = eng.sweep(mr.model, mr.metadata,
+                       n1_sweep(alive_broker_ids(mr.model, mr.metadata)))
+    worst = report.riskiest()
+    victim = worst.scenario.brokers[0]
+    # The skewed topology packs everything on brokers {0, 1}: losing one
+    # of them must rank above losing an empty broker.
+    assert victim in (0, 1), (victim, [
+        (o.scenario.name, o.risk) for o in report.outcomes])
+    predicted = eng.transformed(mr.model, mr.metadata,
+                                [worst.scenario])[0]
+    pred_rb = __import__("numpy").asarray(predicted.replica_broker)
+    pred_off = __import__("numpy").asarray(predicted.replica_offline)
+    B = predicted.num_brokers_padded
+
+    # Kill the flagged broker; advance sampling only (healing comes
+    # later — the comparison is against the UNHEALED post-kill state).
+    h.engine.schedule(h.engine.step + 1, "kill_broker", broker=victim)
+    for _ in range(4):
+        h.step(detect=False)
+    assert not h.sim.describe_cluster()[victim]
+
+    # Structural parity: predicted failover leaders == the sim's elected
+    # leaders, per partition; predicted offline set == replicas stranded
+    # on the dead broker.
+    parts = h.sim.describe_partitions()
+    md = mr.metadata
+    for (topic, p), info in parts.items():
+        row = md.partition_index[(topic, p)]
+        pred_leader_row = pred_rb[row, 0]
+        assert pred_leader_row < B, (topic, p)
+        assert md.broker_ids[pred_leader_row] == info.leader, (
+            f"{topic}-{p}: predicted leader "
+            f"{md.broker_ids[pred_leader_row]}, observed {info.leader}\n"
+            + _repro("test_whatif_prediction_matches_post_kill_reality",
+                     seed))
+    observed_offline = sum(1 for info in parts.values()
+                           if victim in info.replicas)
+    assert int(pred_off.sum()) == observed_offline
+
+    # Violation parity: rebuild the model from the live (now degraded)
+    # cluster and score it with the same chain — the predicted
+    # violated-goal set must match what the monitor actually sees.
+    post = h.monitor.cluster_model(h.engine.now_ms())
+    assert not post.stale
+    observed = eng.sweep(post.model, post.metadata,
+                         [LoadScale(1.0)]).outcomes[0]
+    assert set(observed.violated_goals) == set(worst.violated_goals), (
+        f"predicted {worst.violated_goals}, observed "
+        f"{observed.violated_goals}\n"
+        + _repro("test_whatif_prediction_matches_post_kill_reality", seed))
+    assert observed.offline_replicas == worst.offline_replicas
+
+    # Pre-heal reality also upholds the no-loss invariants.
+    assert_invariants(h, base,
+                      "test_whatif_prediction_matches_post_kill_reality",
+                      require_healthy=False)
+
+    # Now let the detector loop heal it; the healed cluster passes the
+    # full invariant set and a fresh sweep no longer flags the (drained,
+    # restarted) victim as a hard-goal risk.
+    h.engine.schedule(h.engine.step + 1, "restart_broker", broker=victim)
+    drive_to_health(h, base,
+                    "test_whatif_prediction_matches_post_kill_reality",
+                    budget=150)

@@ -253,10 +253,11 @@ def test_goals_param_kafka_assigner_mode():
 
 
 def test_openapi_covers_all_endpoints():
-    # 23 functional endpoints + the openapi document itself.
+    # 23 reference endpoints + the openapi document itself + this
+    # build's simulate (what-if sweeps) and trace (span export).
     spec = openapi_spec()
-    assert len(ENDPOINTS) == 24
-    assert len(spec["paths"]) == 24
+    assert len(ENDPOINTS) == 26
+    assert len(spec["paths"]) == 26
     reb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]
     names = {p["name"] for p in reb["parameters"]}
     assert {"dryrun", "goals", "kafka_assigner",

@@ -1,0 +1,450 @@
+"""What-if engine tests: per-scenario-type transforms against hand-built
+expected flat models, batched-vs-single parity, risk semantics, the
+resilience detector, and the proposal-cache scenario guards.
+
+One module-scoped engine per goal chain so every test shares the
+compiled sweep programs (shapes are identical across tests by
+construction: flatten_spec pads to the same buckets)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.constraint import BalancingConstraint
+from cruise_control_tpu.analyzer.goals import goals_by_name
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+from cruise_control_tpu.whatif import (BrokerAdd, BrokerLoss,
+                                       CapacityResize, LoadScale, TopicAdd,
+                                       WhatIfEngine, alive_broker_ids,
+                                       n1_sweep, n2_sweep, parse_scenarios)
+
+GOALS = ["NetworkOutboundCapacityGoal", "ReplicaDistributionGoal",
+         "DiskUsageDistributionGoal"]
+
+
+def make_spec(num_brokers=4, partitions=8, rf=2, nw_out=3.0,
+              nw_out_cap=1000.0):
+    return ClusterSpec(
+        brokers=[BrokerSpec(b, rack=f"r{b}",
+                            capacity=(1000.0, 1000.0, nw_out_cap, 100.0))
+                 for b in range(num_brokers)],
+        partitions=[PartitionSpec(
+            f"t{p % 2}", p, [p % num_brokers, (p + 1) % num_brokers],
+            leader_load=(1.0, 2.0, nw_out, 4.0)) for p in range(partitions)])
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return WhatIfEngine(goals=goals_by_name(GOALS))
+
+
+@pytest.fixture(scope="module")
+def flat():
+    return flatten_spec(make_spec())
+
+
+# ------------------------------------------------------------ transforms
+
+def test_broker_loss_transform_matches_hand_built(engine, flat):
+    """Killing broker 2 must equal the hand-built post-failover spec:
+    broker 2 dead, its leaderships moved to the next preferred replica,
+    its follower replicas offline (preferred order preserved)."""
+    model, md = flat
+    (got,) = engine.transformed(model, md, [BrokerLoss((2,))])
+
+    spec = make_spec()
+    for b in spec.brokers:
+        if b.broker_id == 2:
+            b.alive = False
+    for p in spec.partitions:
+        reps = list(p.replicas)
+        if reps[0] == 2:                      # leader died: failover
+            p.replicas = [reps[1], reps[0]]
+            p.preferred_replicas = reps       # preferred order unchanged
+        if 2 in reps:
+            p.offline_replicas = [2]
+    expected, _ = flatten_spec(spec)
+
+    for name in ("replica_broker", "replica_offline", "replica_pref_pos",
+                 "partition_valid", "broker_alive", "broker_valid"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(got, name)),
+            np.asarray(getattr(expected, name)), err_msg=name)
+
+
+def test_load_scale_and_capacity_resize_transforms(engine, flat):
+    model, md = flat
+    scaled, resized, topic_scaled = engine.transformed(
+        model, md, [LoadScale(1.5),
+                    CapacityResize(0.5, brokers=(1,), resource="disk"),
+                    LoadScale(2.0, topics=("t1",))])
+    np.testing.assert_allclose(np.asarray(scaled.leader_load),
+                               np.asarray(model.leader_load) * 1.5,
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(scaled.follower_load),
+                               np.asarray(model.follower_load) * 1.5,
+                               rtol=1e-6)
+    cap = np.asarray(resized.broker_capacity)
+    base = np.asarray(model.broker_capacity)
+    assert cap[1, 3] == pytest.approx(base[1, 3] * 0.5)
+    assert cap[1, 0] == pytest.approx(base[1, 0])          # other resource
+    assert cap[0, 3] == pytest.approx(base[0, 3])          # other broker
+    # per-topic scaling touches only t1's partitions
+    topics = np.asarray(model.partition_topic)
+    t1 = topics == md.topic_index["t1"]
+    ll = np.asarray(topic_scaled.leader_load)
+    base_ll = np.asarray(model.leader_load)
+    np.testing.assert_allclose(ll[t1], base_ll[t1] * 2.0, rtol=1e-6)
+    np.testing.assert_allclose(ll[~t1], base_ll[~t1], rtol=1e-6)
+
+
+def test_broker_add_transform(engine, flat):
+    model, md = flat
+    (got,) = engine.transformed(model, md, [BrokerAdd(2)])
+    valid = np.asarray(got.broker_valid)
+    alive = np.asarray(got.broker_alive)
+    assert valid.sum() == 6 and alive.sum() == 6
+    new_rows = np.nonzero(valid & ~np.asarray(model.broker_valid))[0]
+    assert len(new_rows) == 2
+    cap = np.asarray(got.broker_capacity)
+    mean_cap = np.asarray(model.broker_capacity)[:4].mean(axis=0)
+    np.testing.assert_allclose(cap[new_rows], [mean_cap, mean_cap],
+                               rtol=1e-6)
+    # fresh racks: beyond every existing rack id, and distinct
+    racks = np.asarray(got.broker_rack)
+    assert racks[new_rows].min() > racks[:4].max()
+    assert racks[new_rows[0]] != racks[new_rows[1]]
+    assert np.asarray(got.broker_new)[new_rows].all()
+
+
+def test_topic_add_transform(engine, flat):
+    model, md = flat
+    (got,) = engine.transformed(
+        model, md, [TopicAdd("proj", partitions=4, rf=2,
+                             leader_load=(1.0, 2.0, 3.0, 4.0))])
+    pvalid = np.asarray(got.partition_valid)
+    new_rows = np.nonzero(pvalid & ~np.asarray(model.partition_valid))[0]
+    assert len(new_rows) == 4
+    rb = np.asarray(got.replica_broker)[new_rows]
+    B = got.num_brokers_padded
+    assert ((rb[:, :2] < 4).all())            # placed on real brokers
+    assert (rb[:, 2:] == B).all() if rb.shape[1] > 2 else True
+    assert all(len(set(row[row < B].tolist())) == 2 for row in rb)
+    assert (np.asarray(got.partition_topic)[new_rows]
+            == md.num_topics).all()
+    np.testing.assert_allclose(np.asarray(got.leader_load)[new_rows],
+                               np.tile([1.0, 2.0, 3.0, 4.0], (4, 1)))
+    # derived follower load: half CPU, full NW_IN, zero NW_OUT, same DISK
+    np.testing.assert_allclose(np.asarray(got.follower_load)[new_rows],
+                               np.tile([0.5, 2.0, 0.0, 4.0], (4, 1)))
+
+
+def test_unelectable_partition_counts_unavailable(engine):
+    """An RF-1 partition on the killed broker has no electable replica:
+    it must be counted unavailable and push risk near the ceiling."""
+    spec = make_spec()
+    spec.partitions.append(PartitionSpec("t0", 99, [2],
+                                         leader_load=(1.0, 1.0, 1.0, 1.0)))
+    model, md = flatten_spec(spec)
+    rep = engine.sweep(model, md, [BrokerLoss((2,)), BrokerLoss((3,))])
+    lost2, lost3 = rep.outcomes
+    assert lost2.unavailable_partitions == 1
+    assert lost3.unavailable_partitions == 0
+    assert lost2.risk > lost3.risk
+    assert lost2.risk >= 0.9
+    assert rep.riskiest() is lost2
+
+
+# ------------------------------------------------------- sweep semantics
+
+def test_n1_sweep_flags_hard_capacity_violation(engine):
+    """NW_OUT sized so the baseline fits but any single loss overloads
+    the failover target — every N-1 scenario must flag the hard goal."""
+    model, md = flatten_spec(make_spec(nw_out=15.0, nw_out_cap=100.0,
+                                       partitions=16))
+    rep = engine.sweep(model, md, n1_sweep(md.broker_ids))
+    assert rep.num_scenarios == 4
+    for o in rep.outcomes:
+        assert o.violated_hard_goals == ["NetworkOutboundCapacityGoal"]
+        assert o.capacity_pressure > 1.0
+        assert o.risk > 0.8
+        assert o.headroom["nwOut"]["minBrokerFrac"] < 0.0
+    # the baseline (no-op) scenario stays green
+    base = engine.sweep(model, md, [LoadScale(1.0)]).outcomes[0]
+    assert base.violated_hard_goals == []
+    assert base.capacity_pressure <= 1.0
+
+
+def test_batched_sweep_matches_single_scenario_runs(engine, flat):
+    """Property test: a mixed batch scored together must agree with each
+    scenario scored alone — batch composition cannot leak between
+    scenarios (the vmapped program is per-scenario pure)."""
+    model, md = flatten_spec(make_spec(nw_out=9.0, nw_out_cap=60.0,
+                                       partitions=12))
+    scenarios = [BrokerLoss((0,)), BrokerLoss((1,)), LoadScale(1.7),
+                 CapacityResize(0.6), BrokerLoss((2, 3)),
+                 LoadScale(3.0, topics=("t0",))]
+    batched = engine.sweep(model, md, scenarios)
+    for i, scn in enumerate(scenarios):
+        single = engine.sweep(model, md, [scn]).outcomes[0]
+        got = batched.outcomes[i]
+        assert got.violated_goals == single.violated_goals, scn.name
+        assert got.unavailable_partitions == single.unavailable_partitions
+        assert got.offline_replicas == single.offline_replicas
+        assert got.risk == pytest.approx(single.risk, abs=1e-6), scn.name
+        assert got.capacity_pressure == pytest.approx(
+            single.capacity_pressure, rel=1e-6)
+
+
+@pytest.mark.slow
+def test_n2_pairwise_sweep(engine):
+    """Full N-2 pairwise sweep at a size where the batch matters (12
+    brokers -> 66 scenarios in one program). Pairwise loss must rank at
+    or above the worst single loss on the same cluster."""
+    model, md = flatten_spec(make_spec(num_brokers=12, partitions=48,
+                                       nw_out=10.0, nw_out_cap=150.0))
+    pairs = n2_sweep(md.broker_ids)
+    assert len(pairs) == 66
+    rep2 = engine.sweep(model, md, pairs)
+    rep1 = engine.sweep(model, md, n1_sweep(md.broker_ids))
+    assert rep2.num_scenarios == 66
+    assert rep2.riskiest().risk >= rep1.riskiest().risk - 1e-9
+    # every pair's offline replica count >= the max of its two singles
+    singles = {o.scenario.brokers[0]: o for o in rep1.outcomes}
+    for o in rep2.outcomes:
+        a, b = o.scenario.brokers
+        assert o.offline_replicas >= max(singles[a].offline_replicas,
+                                         singles[b].offline_replicas)
+
+
+def test_topic_add_visible_to_topic_scoped_goals():
+    """A staged topic's id lies beyond metadata.num_topics — the sweep
+    must size its topic-count arrays to cover it, or topic-scoped goals
+    would silently drop the simulated topic. Equivalence check: scoring
+    the TopicAdd scenario must equal scoring a cluster where the topic
+    was genuinely added with the same round-robin placement."""
+    chain = ["TopicReplicaDistributionGoal", "ReplicaDistributionGoal"]
+    eng = WhatIfEngine(goals=goals_by_name(chain))
+    spec = make_spec()
+    model, md = flatten_spec(spec)
+    scn = TopicAdd("proj", partitions=5, rf=1,
+                   leader_load=(1.0, 1.0, 1.0, 1.0))
+    got = eng.sweep(model, md, [scn]).outcomes[0]
+
+    expected_spec = make_spec()
+    for k in range(5):
+        expected_spec.partitions.append(PartitionSpec(
+            "proj", k, [k % 4], leader_load=(1.0, 1.0, 1.0, 1.0)))
+    emodel, emd = flatten_spec(expected_spec)
+    want = eng.sweep(emodel, emd, [LoadScale(1.0)]).outcomes[0]
+    assert got.violated_goals == want.violated_goals
+    assert got.risk == pytest.approx(want.risk, abs=1e-6)
+    assert got.capacity_pressure == pytest.approx(want.capacity_pressure,
+                                                  rel=1e-6)
+
+
+# ------------------------------------------------------------ spec layer
+
+def test_parse_scenarios_validation():
+    ids = [0, 1, 2]
+    assert len(parse_scenarios({"sweep": "n1"}, ids)) == 3
+    assert len(parse_scenarios({"sweep": "N2"}, ids)) == 3
+    got = parse_scenarios(
+        {"scenarios": [{"type": "broker_loss", "brokers": [1]},
+                       {"type": "load_scale", "factor": 2},
+                       {"type": "topic_add", "partitions": 2, "rf": 1,
+                        "leaderLoad": [1, 1, 1, 1]}]}, ids)
+    assert [type(s).__name__ for s in got] == ["BrokerLoss", "LoadScale",
+                                               "TopicAdd"]
+    for bad in ({}, {"sweep": "N1", "scenarios": []},
+                {"sweep": "N3"}, {"scenarios": []},
+                {"scenarios": [{"type": "nope"}]},
+                {"scenarios": [{"type": "broker_loss", "brokers": []}]},
+                {"scenarios": [{"type": "load_scale", "factor": -1}]},
+                {"scenarios": [{"type": "capacity_resize", "factor": 2,
+                                "resource": "ssd"}]}):
+        with pytest.raises(ValueError):
+            parse_scenarios(bad, ids)
+
+
+def test_sweep_rejects_unknown_ids_and_oversize(engine, flat):
+    model, md = flat
+    with pytest.raises(ValueError, match="unknown broker id"):
+        engine.sweep(model, md, [BrokerLoss((99,))])
+    with pytest.raises(ValueError, match="unknown topic"):
+        engine.sweep(model, md, [LoadScale(2.0, topics=("absent",))])
+    small = WhatIfEngine(goals=goals_by_name(GOALS), max_scenarios=2)
+    with pytest.raises(ValueError, match="exceed"):
+        small.sweep(model, md, [LoadScale(1.0)] * 3)
+
+
+def test_report_json_round_trip(engine, flat):
+    model, md = flat
+    rep = engine.sweep(model, md, [BrokerLoss((0,)), BrokerAdd(1)])
+    out = json.loads(json.dumps(rep.to_json()))
+    assert out["numScenarios"] == 2
+    assert out["goals"] == GOALS
+    assert {s["name"] for s in out["scenarios"]} == {"loss:0", "add:1"}
+    for s in out["scenarios"]:
+        assert set(s["headroom"]) == {"cpu", "nwIn", "nwOut", "disk"}
+        assert 0.0 <= s["risk"] <= 1.0
+
+
+# ------------------------------------------------- proposal-cache guards
+
+class _StubMonitor:
+    def __init__(self, model, md, generation=7):
+        self.generation = generation
+        self._result = _StubModelResult(model, md)
+
+    def cluster_model(self, now_ms, *a, **k):
+        return self._result
+
+
+class _StubModelResult:
+    def __init__(self, model, md):
+        self.model = model
+        self.metadata = md
+        self.stale = False
+        self.scenario_label = None
+
+
+def test_proposal_cache_rejects_scenario_results(flat):
+    from cruise_control_tpu.api.precompute import ProposalCache
+    model, md = flat
+    monitor = _StubMonitor(model, md)
+    cache = ProposalCache(monitor, optimizer=None)
+    with pytest.raises(ValueError, match="scenario"):
+        cache.store(object(), generation=monitor.generation,
+                    scenario_label="loss:2")
+    assert cache.peek() is None
+    # stale generation: silently dropped, live generation: cached
+    assert cache.store("result", generation=monitor.generation - 1) is False
+    assert cache.peek() is None
+    assert cache.store("result", generation=monitor.generation) is True
+    assert cache.peek() == "result" and cache.valid()
+
+
+def test_proposal_cache_compute_refuses_scenario_model(flat):
+    from cruise_control_tpu.api.precompute import ProposalCache
+    model, md = flat
+    monitor = _StubMonitor(model, md)
+    monitor._result.scenario_label = "loss:0"
+    cache = ProposalCache(monitor, optimizer=None)
+    with pytest.raises(ValueError, match="scenario-modified"):
+        cache.get(now_ms=0)
+    assert cache.peek() is None
+
+
+# --------------------------------------------------- resilience detector
+
+class _StubAdmin:
+    def __init__(self, n):
+        self._n = n
+
+    def describe_cluster(self):
+        return {b: True for b in range(self._n)}
+
+    def offline_replicas(self):
+        return set()
+
+
+def test_resilience_detector_raises_broker_risk():
+    from cruise_control_tpu.core.sensors import MetricRegistry
+    from cruise_control_tpu.detector import (KafkaAnomalyType,
+                                             ResilienceDetector)
+    from cruise_control_tpu.detector.provisioner import ProvisionStatus
+    model, md = flatten_spec(make_spec(nw_out=15.0, nw_out_cap=100.0,
+                                       partitions=16))
+    monitor = _StubMonitor(model, md)
+    monitor.admin = _StubAdmin(4)
+    registry = MetricRegistry()
+    engine = WhatIfEngine(goals=goals_by_name(GOALS))
+    det = ResilienceDetector(monitor, engine, registry=registry)
+    assert det.last_resilience is None     # no fabricated all-clear
+    anomalies = det.detect(1000)
+    assert len(anomalies) == 1
+    a = anomalies[0]
+    assert a.anomaly_type is KafkaAnomalyType.BROKER_RISK
+    assert set(a.at_risk) == {0, 1, 2, 3}
+    assert all(g == ["NetworkOutboundCapacityGoal"]
+               for g in a.at_risk.values())
+    rec = a.recommendation
+    assert rec.status is ProvisionStatus.UNDER_PROVISIONED
+    assert rec.num_brokers == 1
+    assert rec.headroom["perResource"]["nwOut"]["minBrokerFrac"] < 0
+    assert "headroom" in rec.to_json()
+    assert det.last_resilience < 100.0
+    # healthy cluster: no anomaly, score restored
+    calm_model, calm_md = flatten_spec(make_spec())
+    monitor._result = _StubModelResult(calm_model, calm_md)
+    assert det.detect(2000) == []
+    assert det.last_resilience > 50.0
+    # a realized broker failure voids the forecast: the score must go
+    # unknown, not keep asserting the pre-outage all-clear
+
+    class _DeadAdmin(_StubAdmin):
+        def describe_cluster(self):
+            out = super().describe_cluster()
+            out[1] = False
+            return out
+
+    monitor.admin = _DeadAdmin(4)
+    assert det.detect(3000) == []
+    assert det.last_resilience is None
+    # the gauge landed on the registry
+    assert any("resilience-score" in name for name in registry.names())
+
+
+def test_resilience_detector_skips_degraded_cluster():
+    from cruise_control_tpu.detector import ResilienceDetector
+    model, md = flatten_spec(make_spec())
+    monitor = _StubMonitor(model, md)
+
+    class DeadAdmin(_StubAdmin):
+        def describe_cluster(self):
+            out = super().describe_cluster()
+            out[2] = False
+            return out
+
+    monitor.admin = DeadAdmin(4)
+    det = ResilienceDetector(monitor, WhatIfEngine(
+        goals=goals_by_name(GOALS)))
+    assert det.detect(1000) == []
+    assert det.last_report is None
+    assert det.last_resilience is None
+
+
+def test_broker_risk_fix_feeds_provisioner():
+    from cruise_control_tpu.detector import BrokerRisk
+    from cruise_control_tpu.detector.provisioner import (
+        ProvisionRecommendation, ProvisionStatus)
+
+    fed = []
+
+    class Prov:
+        def rightsize(self, recommendations=None, **kw):
+            fed.extend(recommendations or [])
+            return {"provisionerState": "COMPLETED"}
+
+    class Det:
+        provisioner = Prov()
+
+    class Facade:
+        detector = Det()
+
+    rec = ProvisionRecommendation(ProvisionStatus.UNDER_PROVISIONED,
+                                  num_brokers=1, resource="nwOut",
+                                  headroom={"x": 1})
+    a = BrokerRisk(detected_ms=0, at_risk={1: ["NetworkOutboundCapacityGoal"]},
+                   recommendation=rec, max_risk=0.9)
+    assert a.fix(Facade()) is True
+    assert fed == [rec]
+    assert a.to_json()["atRiskBrokers"] == {
+        "1": ["NetworkOutboundCapacityGoal"]}
+    # no provisioner configured -> nothing to feed
+    class Bare:
+        detector = None
+    assert a.fix(Bare()) is False
