@@ -332,6 +332,96 @@ def run_tracer_overhead_bench(num_brokers: int = 50,
             "overhead_pct": overhead_pct}
 
 
+def run_device_stats_bench(num_brokers: int = NUM_BROKERS,
+                           num_partitions: int = NUM_PARTITIONS, *,
+                           goal_names: list | None = None, cycles: int = 3,
+                           repeats: int = 3, emit_row: bool = True,
+                           gate: bool = True) -> dict:
+    """Device-runtime observability rows on the warm propose path.
+
+    Three numbers, all read off the DeviceStatsCollector:
+
+    - ``warm_recompile_count`` — compile events across ``cycles`` warm
+      propose cycles AFTER one warmup optimize. ALWAYS gated == 0 (every
+      scale): a warm cycle that still compiles is exactly the silent
+      recompile storm this instrumentation exists to catch.
+    - ``transfer_bytes_per_cycle`` — h2d+d2h bytes of one warm cycle
+      (min over cycles; the model is device-resident, so this is the
+      walk's result fetches + the proposal diff's host reads).
+    - ``padding_waste_pct`` — partition-axis padding waste of the bench
+      model (the shape-bucket tax item 5 of the roadmap pays at 10Kx1M).
+
+    Plus the same <2% overhead A/B bar the tracer bench set: collector
+    enabled vs disabled on the warm path (``gate`` controls only this
+    wall-clock gate — it is noise-bound at toy scale)."""
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    model, md = build_flat_direct(num_brokers, num_partitions, RF)
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names or GOALS),
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=256))
+    collector = default_collector()
+    run_opts = dict(skip_hard_goal_check=True)
+    opt.optimize(model, md, OptimizationOptions(seed=0, **run_opts))  # warm
+    snap = collector.snapshot()
+    per_cycle_bytes = []
+    for i in range(cycles):
+        opt.optimize(model, md, OptimizationOptions(seed=1 + i, **run_opts))
+        per_cycle_bytes.append(collector.last_cycle["transferBytes"])
+    after = collector.snapshot()
+    recompiles = ((after["compileEvents"] + after["aotCompileEvents"])
+                  - (snap["compileEvents"] + snap["aotCompileEvents"]))
+    transfer_bytes = min(per_cycle_bytes)
+    padding = collector.padding_from_model(model)
+
+    def best_of(enabled: bool) -> float:
+        collector.enabled = enabled
+        t_best = float("inf")
+        for r in range(repeats):
+            t0 = time.monotonic()
+            opt.optimize(model, md,
+                         OptimizationOptions(seed=100 + r, **run_opts))
+            t_best = min(t_best, time.monotonic() - t0)
+        return t_best
+
+    try:
+        disabled_s = best_of(False)
+        enabled_s = best_of(True)
+    finally:
+        collector.enabled = True
+    overhead_pct = ((enabled_s - disabled_s) / disabled_s * 100.0
+                    if disabled_s > 0 else 0.0)
+    log(f"device stats ({num_brokers}x{num_partitions}): "
+        f"{recompiles} recompiles over {cycles} warm cycles, "
+        f"{transfer_bytes} transfer bytes/cycle, padding waste "
+        f"{padding['partitionWastePct']}% partitions / "
+        f"{padding['brokerWastePct']}% brokers; collector overhead "
+        f"{overhead_pct:+.2f}% (enabled {enabled_s:.3f}s / disabled "
+        f"{disabled_s:.3f}s)")
+    if recompiles != 0:
+        raise RuntimeError(
+            f"warm-recompile gate: {recompiles} compile events across "
+            f"{cycles} warm propose cycles (want 0) — a warm path that "
+            "recompiles is the failure mode this collector exists to "
+            "catch; see /devicestats recentEvents for the programs")
+    if gate and overhead_pct > 2.0:
+        raise RuntimeError(
+            f"device-stats collector overhead gate: {overhead_pct:.2f}% "
+            f"> 2% (enabled {enabled_s:.3f}s vs disabled "
+            f"{disabled_s:.3f}s)")
+    if emit_row:
+        emit("warm_recompile_count", recompiles, "compiles", None)
+        emit("transfer_bytes_per_cycle", transfer_bytes, "bytes", None)
+        emit("padding_waste_pct", padding["partitionWastePct"], "%", None)
+    return {"recompiles": recompiles, "transfer_bytes": transfer_bytes,
+            "padding": padding, "overhead_pct": overhead_pct,
+            "enabled_s": enabled_s, "disabled_s": disabled_s}
+
+
 def run_chaos_recovery_bench(*, seed: int = 11, emit_row: bool = True,
                              max_steps: int = 200) -> dict:
     """Recovery time under the canonical chaos scenario: a broker dies
@@ -816,6 +906,9 @@ def main():
     run_model_build_bench()
     # Observability tax: the span tracer must be ~free on the propose path.
     run_tracer_overhead_bench()
+    # Device-runtime rows: zero warm recompiles, transfer bytes per warm
+    # cycle, padding waste — and the collector's own <2% overhead A/B.
+    run_device_stats_bench()
     # Robustness: steps from injected broker crash to restored
     # balancedness through the full heal loop.
     run_chaos_recovery_bench()

@@ -271,10 +271,17 @@ class CompiledGoalChain:
     gives per-goal wall-clock numbers for ``OptimizerResult``.
     """
 
-    def __init__(self, goals: Sequence[GoalKernel], cfg: SearchConfig):
+    def __init__(self, goals: Sequence[GoalKernel], cfg: SearchConfig,
+                 collector=None):
         import threading
+
+        from ..core.runtime_obs import default_collector
         self.goals = list(goals)
         self.cfg = cfg
+        #: device-runtime ledger (None = the process default): every
+        #: program below is a TrackedProgram, so dispatches, compiles and
+        #: AOT warmups land on /devicestats and as compile.<name> spans.
+        self.collector = collector or default_collector()
         # Warmup bookkeeping: keyed by the (state, ctx) shape signature —
         # one chain serves models of different padded sizes, each needing
         # its own compile. Per-key events let distinct shape signatures
@@ -289,12 +296,15 @@ class CompiledGoalChain:
             run = make_goal_pass(g, self.goals[:i], cfg,
                                  all_goals=self.goals)
             self._pass_fns.append(run)
-            self.passes.append(jax.jit(run, donate_argnums=(0,)))
-        self._aux = jax.jit(self._aux_impl)
+            self.passes.append(self.collector.track(
+                f"pass.{g.name}", jax.jit(run, donate_argnums=(0,))))
+        self._aux = self.collector.track("chain-aux",
+                                         jax.jit(self._aux_impl))
         #: single-program whole-chain walk (cfg.fused_chain): one dispatch
         #: + one sync per optimize. Compiled lazily on first use so the
         #: default per-goal path never pays its (serial) XLA compile.
-        self._fused = jax.jit(self._fused_impl, donate_argnums=(0,))
+        self._fused = self.collector.track(
+            "fused-chain", jax.jit(self._fused_impl, donate_argnums=(0,)))
 
     def _aux_impl(self, state, ctx):
         """Everything the host loop reads *before* the goal passes, fused
@@ -327,10 +337,11 @@ class CompiledGoalChain:
 
     @staticmethod
     def _shape_key(*trees) -> tuple:
-        import jax
-        return tuple((tuple(getattr(x, "shape", ())),
-                      str(getattr(x, "dtype", type(x).__name__)))
-                     for x in jax.tree_util.tree_leaves(trees))
+        # ONE bucket definition shared with the collector's recompile
+        # classification — warmup keying and /devicestats shape buckets
+        # must never drift apart.
+        from ..core.runtime_obs import shape_key
+        return shape_key(*trees)
 
     def warmup(self, state, ctx, key, max_workers: int | None = None) -> None:
         """AOT-compile every pass concurrently (XLA compilation releases the
@@ -379,9 +390,20 @@ class CompiledGoalChain:
                 else:
                     jobs = [(p, (state, ctx, key)) for p in self.passes]
                     jobs.append((self._aux, (state, ctx)))
+                # Pool workers have no active span (thread-local nesting),
+                # so each AOT job records its compile.<program> span with
+                # the warming thread's span as explicit parent — the
+                # concurrent compiles render under optimizer.warmup in
+                # /trace instead of vanishing.
+                parent = self.collector.tracer.current_span_id()
+
+                def _aot(job, _parent=parent):
+                    program, args = job
+                    program.aot_compile(args, parent_id=_parent)
+
                 with ThreadPoolExecutor(max_workers
                                         or min(len(jobs), 16)) as ex:
-                    list(ex.map(lambda j: j[0].lower(*j[1]).compile(), jobs))
+                    list(ex.map(_aot, jobs))
             except BaseException:
                 # Failed warmups must not poison the key: drop the event so
                 # waiters and later calls retry the compile instead of

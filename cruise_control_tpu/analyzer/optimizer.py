@@ -140,7 +140,8 @@ class OptimizationFailureError(RuntimeError):
         self.result = result
 
 
-def _walk_passes(chain, idxs, state, ctx, keys, on_start=None):
+def _walk_passes(chain, idxs, state, ctx, keys, on_start=None,
+                 collector=None):
     """Run ``chain.passes[i] for i in idxs`` back-to-back with NO host
     read in between: every pass is dispatched before any result is
     fetched, so the device (and, under axon, the tunnel) pipelines the
@@ -169,7 +170,13 @@ def _walk_passes(chain, idxs, state, ctx, keys, on_start=None):
         times.append(time.monotonic())
     durations = [times[j] - (times[j - 1] if j else t0)
                  for j in range(len(times))]
-    return state, jax.device_get(dispatched), durations
+    fetched = jax.device_get(dispatched)
+    if collector is not None:
+        # Transfer accounting rides the fetch that already happened: byte
+        # counts come off the host-side result (metadata only, no extra
+        # syncs — the zero-syncs tracing gate covers this path too).
+        collector.record_d2h(collector.tree_bytes(fetched))
+    return state, fetched, durations
 
 
 class TpuGoalOptimizer:
@@ -184,7 +191,8 @@ class TpuGoalOptimizer:
                  mesh=None,
                  branches: int = 0,
                  hard_goal_names: list[str] | None = None,
-                 tracer=None):
+                 tracer=None, collector=None):
+        from ..core.runtime_obs import default_collector
         from ..core.sensors import (GOAL_OPTIMIZER_SENSOR, MetricRegistry)
         from ..core.tracing import default_tracer
         self.constraint = constraint or BalancingConstraint()
@@ -230,6 +238,12 @@ class TpuGoalOptimizer:
         #: span tracer threading the whole pipeline (None = the shared
         #: process-wide default, like the reference's single registry)
         self.tracer = tracer or default_tracer()
+        #: device-runtime ledger (None = process default): compiled
+        #: chains, audit fns and the branched shard_map program all
+        #: register as TrackedPrograms; optimize() brackets itself in a
+        #: collector cycle so /devicestats reports per-cycle compile and
+        #: transfer deltas.
+        self.collector = collector or default_collector()
         # ref GoalOptimizer.java:128 proposal-computation-timer.
         self._proposal_timer = self.registry.timer(MetricRegistry.name(
             GOAL_OPTIMIZER_SENSOR, "proposal-computation-timer"))
@@ -249,7 +263,8 @@ class TpuGoalOptimizer:
         # parallel XLA compile.
         with self._chains_lock:
             if key not in self._chains:
-                self._chains[key] = CompiledGoalChain(goals, cfg)
+                self._chains[key] = CompiledGoalChain(
+                    goals, cfg, collector=self.collector)
             return self._chains[key]
 
     def _prepare(self, model: FlatClusterModel, metadata: ClusterMetadata,
@@ -347,7 +362,9 @@ class TpuGoalOptimizer:
                 return (violation_stack(_goals, state, ctx),
                         jnp.stack([g.violation_scale(state, ctx)
                                    for g in _goals]))
-            fn = self._audit_fns.setdefault(key, jax.jit(_audit))
+            fn = self._audit_fns.setdefault(
+                key, self.collector.track("hard-goal-audit",
+                                          jax.jit(_audit)))
             # Bounded like the facade's goal-optimizer LRU: bind
             # signatures carry per-topic masks, so an evolving topic set
             # would otherwise accumulate compiled audit programs forever.
@@ -363,26 +380,30 @@ class TpuGoalOptimizer:
         from a background thread at server startup; a subsequent
         ``optimize`` with the same shapes pays no XLA compile."""
         options = options or OptimizationOptions()
-        cfg, goals, chain, ctx, state, audit = self._prepare(model, metadata,
-                                                             options)
-        key = jax.random.PRNGKey(options.seed)
-        if audit:
-            # The off-chain hard-goal audit runs on the request path too —
-            # pre-compile its (tiny) violation-stack program alongside the
-            # chain so the first optimize pays no XLA at all.
-            self._audit_fn_for(audit).lower(state, ctx).compile()
-        if self.branches > 1:
-            # The branched path never runs the per-goal passes — warm the
-            # shard_map program it actually serves instead. AOT compiles
-            # don't seed the jit dispatch cache; the persistent file
-            # cache is the bridge that makes the first real optimize
-            # skip XLA (mirrors CompiledGoalChain.warmup).
-            from ..utils.platform import enable_compilation_cache
-            enable_compilation_cache()
-            self._branched_run_for(cfg, goals).lower(state, ctx,
-                                                     key).compile()
-            return
-        chain.warmup(state, ctx, key)
+        with self.tracer.span("optimizer.warmup"):
+            cfg, goals, chain, ctx, state, audit = self._prepare(
+                model, metadata, options)
+            key = jax.random.PRNGKey(options.seed)
+            if audit:
+                # The off-chain hard-goal audit runs on the request path
+                # too — pre-compile its (tiny) violation-stack program
+                # alongside the chain so the first optimize pays no XLA
+                # at all. (aot_compile: the compile lands on /devicestats
+                # and as a compile.hard-goal-audit span.)
+                self._audit_fn_for(audit).aot_compile((state, ctx))
+            if self.branches > 1:
+                # The branched path never runs the per-goal passes — warm
+                # the shard_map program it actually serves instead. AOT
+                # compiles don't seed the jit dispatch cache; the
+                # persistent file cache is the bridge that makes the
+                # first real optimize skip XLA (mirrors
+                # CompiledGoalChain.warmup).
+                from ..utils.platform import enable_compilation_cache
+                enable_compilation_cache()
+                self._branched_run_for(cfg, goals).aot_compile(
+                    (state, ctx, key))
+                return
+            chain.warmup(state, ctx, key)
 
     def _branched_run_for(self, cfg: SearchConfig, goals):
         """Get-or-build the jitted shard_map program for this (cfg, goal
@@ -395,7 +416,8 @@ class TpuGoalOptimizer:
         if run is None:
             run = self._branched_runs.setdefault(
                 bkey, make_branched_search(
-                    goals, cfg, make_branch_mesh(self.branches)))
+                    goals, cfg, make_branch_mesh(self.branches),
+                    collector=self.collector))
         return run
 
     def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
@@ -405,9 +427,14 @@ class TpuGoalOptimizer:
         each goal pass begins (the facade feeds OperationProgress with it —
         ref the ``OptimizationForGoal`` steps in /user_tasks)."""
         options = options or OptimizationOptions()
-        with self.tracer.span("optimizer.optimize",
-                              brokers=metadata.num_brokers,
-                              partitions=metadata.num_partitions) as root:
+        # The collector cycle brackets the whole computation: on exit the
+        # h2d/d2h/compile deltas become /devicestats' lastCycle (outermost
+        # wins, so a facade-level cycle spanning monitor+optimize absorbs
+        # this one).
+        with self.collector.cycle("propose"), \
+                self.tracer.span("optimizer.optimize",
+                                 brokers=metadata.num_brokers,
+                                 partitions=metadata.num_partitions) as root:
             result = self._optimize_impl(model, metadata, options,
                                          on_goal_start)
             root.set(moves=result.num_moves, proposals=len(result.proposals))
@@ -480,9 +507,12 @@ class TpuGoalOptimizer:
                 t_walk = time.monotonic()
                 state, aux, iters_arr, bounds, moves_arr = chain.fused(
                     state, ctx, key)
-                (has_broken_raw, scales_arr, v0), iters_np, bounds_np, \
-                    moves_np = jax.device_get((aux, iters_arr, bounds,
+                fetched_host = jax.device_get((aux, iters_arr, bounds,
                                                moves_arr))
+                self.collector.record_d2h(
+                    self.collector.tree_bytes(fetched_host))
+                (has_broken_raw, scales_arr, v0), iters_np, bounds_np, \
+                    moves_np = fetched_host
                 walk_s = time.monotonic() - t_walk
                 # Per-goal wall-clock is unobservable inside one program;
                 # attribute the fused walk proportionally to iteration
@@ -497,8 +527,11 @@ class TpuGoalOptimizer:
                     chain, range(len(goals)), state, ctx,
                     [jax.random.fold_in(key, i) for i in range(len(goals))],
                     on_start=(None if on_goal_start is None
-                              else lambda j: on_goal_start(goals[j].name)))
+                              else lambda j: on_goal_start(goals[j].name)),
+                    collector=self.collector)
                 has_broken_raw, scales_arr, v0 = jax.device_get(aux)
+                self.collector.record_d2h(self.collector.tree_bytes(
+                    (has_broken_raw, scales_arr, v0)))
         # ref AbstractGoal.java:110-119: the "never worsen" assertion only
         # runs when brokenBrokers.isEmpty() — a dead-broker drain's
         # must-moves (remove_brokers, fix_offline_replicas, self-healing)
@@ -599,6 +632,8 @@ class TpuGoalOptimizer:
                     state, _aux2, it2, b2, m2 = chain.fused(
                         state, ctx, jax.random.fold_in(key, 50_000 + rnd))
                     it2, b2, m2 = jax.device_get((it2, b2, m2))
+                    self.collector.record_d2h(
+                        self.collector.tree_bytes((it2, b2, m2)))
                     w = time.monotonic() - tp0
                     tot = max(int(it2.sum()), 1)
                     boundary = np.asarray(b2[-1])
@@ -619,7 +654,7 @@ class TpuGoalOptimizer:
                 state, fetched, durations = _walk_passes(
                     chain, todo, state, ctx,
                     [jax.random.fold_in(key, 1000 * (rnd + 1) + i)
-                     for i in todo])
+                     for i in todo], collector=self.collector)
                 for j, (i, (iters, stack, moves)) in enumerate(zip(todo,
                                                                    fetched)):
                     boundary = np.asarray(stack)
@@ -678,6 +713,8 @@ class TpuGoalOptimizer:
             walk_span.set(winner=int(best_idx))
         walk_s = time.monotonic() - t_walk
         _has_broken, scales_arr, v0 = jax.device_get(aux)
+        self.collector.record_d2h(self.collector.tree_bytes(
+            (_has_broken, scales_arr, v0)))
         v0 = np.asarray(v0)
         logger = logging.getLogger(__name__)
         logger.info("branched search: %d branches, winner %d, %.2fs",
@@ -711,6 +748,8 @@ class TpuGoalOptimizer:
                 t_a = time.monotonic()
                 (v_after, scales), (v_before, _) = jax.device_get(
                     (audit_fn(state, ctx), audit_before))
+                self.collector.record_d2h(self.collector.tree_bytes(
+                    ((v_after, scales), (v_before, None))))
                 audit_s = (time.monotonic() - t_a) / max(len(audit), 1)
                 audit_results = [
                     GoalResult(name=g.name, hard=True,

@@ -90,7 +90,8 @@ class KafkaCruiseControl:
         from ..whatif import WhatIfEngine
         self.whatif = WhatIfEngine(goals=self.optimizer.goals,
                                    constraint=self.optimizer.constraint,
-                                   tracer=self.optimizer.tracer)
+                                   tracer=self.optimizer.tracer,
+                                   collector=self.optimizer.collector)
         # Shared with the metrics processor so a TRAIN-fitted regression
         # feeds CPU estimation for samples that lack broker CPU.
         self.cpu_model = cpu_model or LinearRegressionModelParameters()
@@ -126,6 +127,15 @@ class KafkaCruiseControl:
         #: dedupes by identity, so shared tracers emit once).
         self.tracer = self.optimizer.tracer
         self.extra_registries.append(self.tracer.registry)
+
+        #: device-runtime ledger serving /devicestats and the DeviceStats
+        #: substate of /state — the optimizer's collector (the process
+        #: default unless overridden), shared by every subsystem wired
+        #: with the default, so one dump covers all compiled programs.
+        #: Its DeviceRuntime.* sensors join the scrape view (identity-
+        #: deduped like the tracer's).
+        self.device_stats = self.optimizer.collector
+        self.extra_registries.append(self.device_stats.registry)
 
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
@@ -224,6 +234,16 @@ class KafkaCruiseControl:
                   options: OptimizationOptions,
                   requirements: ModelCompletenessRequirements | None = None,
                   spec_mutator=None) -> OptimizerResult:
+        with self.device_stats.cycle("propose"):
+            return self._optimize_cycle(progress, goals, options,
+                                        requirements, spec_mutator)
+
+    def _optimize_cycle(self, progress, goals, options, requirements,
+                        spec_mutator) -> OptimizerResult:
+        """Body of :meth:`_optimize`, bracketed by a device-stats cycle so
+        /devicestats' lastCycle covers the FULL propose cycle — the model
+        build's host->device upload included, not just the optimizer's own
+        dispatches (the optimizer's inner cycle no-ops under this one)."""
         if progress:
             progress.add_step("WaitingForClusterModel")
         result = self.monitor.cluster_model(self._now_ms(), requirements)
@@ -700,6 +720,11 @@ class KafkaCruiseControl:
         # the Chrome trace-event export lives on /trace itself).
         if "tracing" in wanted:
             out["Tracing"] = self.tracer.to_json()
+        # Device-runtime ledger: compile lifecycle, transfers, memory,
+        # padding (the /devicestats payload, embedded for one-call
+        # dashboards).
+        if "device_stats" in wanted or "devicestats" in wanted:
+            out["DeviceStats"] = self.device_stats.to_json()
         if "monitor" in wanted:
             mon = self.monitor.state(self._now_ms()).to_json()
             if self.task_runner is not None:

@@ -92,6 +92,11 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
                    "topic_add); also accepted as a JSON request body")]),
     "trace": ("get", "Chrome trace-event JSON of the span ring buffer "
                      "(Perfetto-loadable)", []),
+    "devicestats": ("get", "Device runtime stats: compile lifecycle, "
+                           "host<->device transfer bytes, device memory "
+                           "and padding waste",
+                    [("json", "boolean",
+                      "false renders the fixed-width text table")]),
 }
 
 
@@ -273,6 +278,39 @@ _SCHEMAS = {
             "traceEvents": {"type": "array", "items": {"type": "object"}},
             "displayTimeUnit": {"type": "string"},
         }},
+    "DeviceStats": {
+        "type": "object",
+        "description": "device-runtime ledger "
+                       "(core/runtime_obs.py DeviceStatsCollector)",
+        "properties": {
+            "version": {"type": "integer"},
+            "enabled": {"type": "boolean"},
+            "compile": {"type": "object", "properties": {
+                "totalEvents": {"type": "integer"},
+                "aotEvents": {"type": "integer"},
+                "recompileEvents": {
+                    "type": "integer",
+                    "description": "compiles for already-compiled shape "
+                                   "buckets — nonzero on a warm path "
+                                   "means a pass-signature change"},
+                "byProgram": {"type": "object",
+                              "description": "per tracked program: "
+                                             "compiles, aotCompiles, "
+                                             "dispatches, shapeBuckets"},
+                "recentEvents": {"type": "array",
+                                 "items": {"type": "object"}},
+            }},
+            "transfers": {"type": "object", "properties": {
+                "h2dBytesTotal": {"type": "integer"},
+                "d2hBytesTotal": {"type": "integer"},
+                "lastCycle": {"type": "object", "nullable": True},
+            }},
+            "memory": {"type": "object",
+                       "description": "live/peak bytes; source names the "
+                                      "backend path (device_memory_stats "
+                                      "on TPU/GPU, live_arrays on CPU)"},
+            "padding": {"type": "object", "nullable": True},
+        }},
 }
 
 _OPTIMIZATION_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
@@ -303,6 +341,8 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
             ok.update(_ref("WhatIfReport"))
         elif name == "trace":
             ok.update(_ref("TraceEvents"))
+        elif name == "devicestats":
+            ok.update(_ref("DeviceStats"))
         # JSON is the documented default body (json defaults true): every
         # 200 advertises application/json — a typed $ref where one
         # exists, a generic object otherwise.

@@ -140,9 +140,53 @@ def _render_simulate(payload: dict) -> str:
     return text
 
 
+def _render_devicestats(payload: dict) -> str:
+    compile_ = payload.get("compile", {})
+    rows = [[name, st.get("compiles", 0), st.get("aotCompiles", 0),
+             st.get("dispatches", 0), st.get("shapeBuckets", 0)]
+            for name, st in sorted(compile_.get("byProgram", {}).items())]
+    text = _table(["PROGRAM", "COMPILES", "AOT", "DISPATCHES", "BUCKETS"],
+                  rows)
+    text += (f"\n\ncompile events: {compile_.get('totalEvents', 0)} "
+             f"(+{compile_.get('aotEvents', 0)} aot), recompiles: "
+             f"{compile_.get('recompileEvents', 0)}")
+    recent = [e for e in compile_.get("recentEvents", [])
+              if e.get("trigger") == "signature-change"]
+    if recent:
+        text += "\nrecent recompiles:\n" + _table(
+            ["PROGRAM", "BUCKET", "CACHE", "MS"],
+            [[e.get("program"), e.get("shapeBucket"), e.get("cache"),
+              _num(float(e.get("durationMs", 0.0)))] for e in recent])
+    transfers = payload.get("transfers", {})
+    text += (f"\nh2d bytes: {transfers.get('h2dBytesTotal', 0)}  "
+             f"d2h bytes: {transfers.get('d2hBytesTotal', 0)}")
+    cycle = transfers.get("lastCycle")
+    if cycle:
+        text += (f"\nlast cycle [{cycle.get('label')}]: "
+                 f"h2d {cycle.get('h2dBytes', 0)}  "
+                 f"d2h {cycle.get('d2hBytes', 0)}  "
+                 f"compiles {cycle.get('compileEvents', 0)}  "
+                 f"{_num(float(cycle.get('durationMs', 0.0)))} ms")
+    memory = payload.get("memory", {})
+    text += (f"\nmemory [{memory.get('source')}]: live "
+             f"{memory.get('liveBytes')} (peak "
+             f"{memory.get('peakLiveBytes')}), allocator "
+             f"{memory.get('allocatorBytesInUse')}")
+    padding = payload.get("padding")
+    if padding:
+        text += (f"\npadding waste: partitions "
+                 f"{padding.get('partitionWastePct')}% "
+                 f"({padding.get('partitions')}/"
+                 f"{padding.get('partitionsPadded')}), brokers "
+                 f"{padding.get('brokerWastePct')}%, replica slots "
+                 f"{padding.get('replicaSlotWastePct', '-')}%")
+    return text
+
+
 _RENDERERS = {
     "load": _render_load,
     "simulate": _render_simulate,
+    "devicestats": _render_devicestats,
     "partition_load": _render_partition_load,
     "proposals": _render_proposals,
     "rebalance": _render_proposals,

@@ -54,7 +54,7 @@ NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution", "simulate"}
 #: bare GET handlers outside the servlet endpoint table (observability
 #: surfaces + the API explorer) — instrumented through the same shared
 #: request-timing wrapper as every dispatched endpoint.
-AUX_GET_ENDPOINTS = {"metrics", "trace", "explorer"}
+AUX_GET_ENDPOINTS = {"metrics", "trace", "devicestats", "explorer"}
 
 #: per-request access log (ref webserver.accesslog.enabled; the reference
 #: writes an NCSA access log through Jetty)
@@ -793,6 +793,28 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
             body = json.dumps(app.facade.tracer.to_chrome_trace()).encode()
             outcome["status"] = 200
         return 200, "application/json", body, {}
+    # /devicestats: the device-runtime ledger (compile lifecycle,
+    # host<->device transfers, memory, padding waste). Viewer-gated like
+    # /state; json=false renders the fixed-width table (this is a bare
+    # handler, so the flag is read from the raw query — no typed layer to
+    # resolve it).
+    if method == "GET" and parts in (["devicestats"],
+                                     ["kafkacruisecontrol", "devicestats"]):
+        try:
+            check_access(app.security, "state", headers)
+        except AuthorizationError as e:
+            return json_resp(e.status, {"errorMessage": str(e)},
+                             _auth_headers(e, app.security))
+        with app.request_timing("GET", "devicestats") as outcome:
+            payload = app.facade.device_stats.to_json()
+            outcome["status"] = 200
+        raw_json = parse_qs(parsed.query).get("json", ["true"])[0]
+        if raw_json.strip().lower() in ("false", "0", "no"):
+            from .plaintext import render
+            return (200, "text/plain; charset=utf-8",
+                    (render("devicestats", payload) + "\n").encode(),
+                    dict(app.cors))
+        return json_resp(200, payload)
     if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
         return json_resp(404, {"errorMessage": f"bad path {parsed.path}"})
     endpoint = parts[1].lower()

@@ -1,0 +1,667 @@
+"""Device runtime observability: compile lifecycle, memory/padding, and
+host<->device transfer accounting.
+
+The span tracer (:mod:`core.tracing`) answers "where did this request's
+latency go" and the sensors answer "how long do proposals take" — but the
+JAX runtime underneath both stayed a black box: a pass-signature change
+silently invalidates every persistent-cache entry (the PR 3 incident), a
+shape drift quietly recompiles a 15-goal chain, and nobody can say how
+many bytes a propose cycle ships across the host<->device boundary. This
+module makes those costs first-class observables:
+
+- **Compile lifecycle.** Every jit/AOT program in the repo is wrapped in
+  a :class:`TrackedProgram` (the optimizer pass chain, the fused/aux
+  programs, hard-goal audit fns, the branched shard_map search, the
+  what-if sweep programs). Each dispatch checks the program's in-process
+  jit cache size before/after the call — growth means XLA specialized a
+  new executable — and records a :class:`CompileEvent` carrying the
+  shape-bucket key, wall time, the *trigger* (``cold`` = first compile
+  for that bucket, ``aot-warmup`` = an ahead-of-time warmup compile or
+  its follow-up dispatch-cache fill, ``signature-change`` = a RECOMPILE
+  of a bucket this process had already compiled — the alarming one), and
+  whether the persistent compilation cache answered (``persistent-hit``
+  vs ``miss``, read from ``jax.monitoring`` events when available). Every
+  event also lands as a ``compile.<program>`` span in the tracer, so
+  recompile storms are visible in /trace next to the work they stall.
+- **Transfer accounting.** ``record_h2d``/``record_d2h`` counters fed by
+  the known boundary crossings (``FlatClusterModel.from_numpy`` uploads,
+  the optimizer's end-of-chain fetches, the proposal diff's host reads,
+  the what-if batch upload + result fetch). :meth:`DeviceStatsCollector.cycle`
+  brackets one propose cycle and snapshots the per-cycle deltas.
+- **Device memory.** ``memory_snapshot`` reads the backend allocator's
+  ``memory_stats()`` (bytes_in_use / peak_bytes_in_use on TPU/GPU).
+  **CPU fallback:** the CPU PJRT client reports no allocator stats
+  (``memory_stats() is None``), so live bytes are summed over
+  ``jax.live_arrays()`` — logical array bytes, which miss XLA scratch
+  but track model/state residency faithfully; ``source`` names which
+  path produced the numbers.
+- **Padding waste.** The flat model is padded to static shape buckets;
+  :meth:`observe_padding` (fed host-side by the monitor's assemblers,
+  zero device syncs) and :meth:`padding_from_model` (reads the valid
+  masks — a device fetch, debug/test surface) record what fraction of
+  the partition/broker/replica-slot axes is padding.
+
+Surfaced four ways: ``DeviceRuntime.*`` Prometheus families on
+``/metrics``, ``compile.<program>`` spans in /trace, the ``/devicestats``
+endpoint (JSON + plaintext), and the ``DeviceStats`` substate of
+``/state``. One process-wide default collector (:func:`default_collector`)
+keeps wiring optional, exactly like :func:`~.tracing.default_tracer`.
+
+Design constraints (same bar as the tracer): **zero extra device syncs**
+on the hot path — shape keys come from ``.shape``/``.dtype`` metadata,
+transfer bytes from ``nbytes`` of already-fetched host arrays, and the
+memory gauges only run at scrape time; overhead on the warm propose path
+is gated <2% by ``bench.py`` (``run_device_stats_bench``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+from collections import deque
+
+from .sensors import MetricRegistry
+
+LOG = logging.getLogger(__name__)
+
+#: sensor group for every collector-owned series (``DeviceRuntime.*``).
+DEVICE_RUNTIME_SENSOR = "DeviceRuntime"
+
+#: compile-event triggers (the taxonomy /devicestats reports).
+TRIGGER_COLD = "cold"
+TRIGGER_AOT = "aot-warmup"
+TRIGGER_SIGNATURE = "signature-change"
+
+# --------------------------------------------------------------------------
+# jax.monitoring capture: compile events fire on the thread doing the
+# compile, so a thread-local capture record (installed around every
+# tracked call) attributes backend-compile durations and persistent-cache
+# hit/miss counters to the program that triggered them. The listeners are
+# registered once per process and are inert (one attribute read) when no
+# tracked program is active on the thread.
+# --------------------------------------------------------------------------
+
+_tls = threading.local()
+_listeners_installed = False
+_install_lock = threading.Lock()
+
+
+def _active_capture():
+    return getattr(_tls, "capture", None)
+
+
+def _begin_capture():
+    prev = getattr(_tls, "capture", None)
+    rec = {"hits": 0, "misses": 0, "backend_s": 0.0}
+    _tls.capture = rec
+    return rec, prev
+
+
+def _end_capture(prev) -> None:
+    _tls.capture = prev
+
+
+def _event_listener(name, *args, **kwargs):
+    rec = _active_capture()
+    if rec is None:
+        return
+    if name.endswith("cache_hits"):
+        rec["hits"] += 1
+    elif name.endswith("cache_misses"):
+        rec["misses"] += 1
+
+
+def _duration_listener(name, duration, *args, **kwargs):
+    rec = _active_capture()
+    if rec is None:
+        return
+    if name.endswith("backend_compile_duration"):
+        rec["backend_s"] += float(duration)
+
+
+def _install_listeners() -> None:
+    global _listeners_installed
+    with _install_lock:
+        if _listeners_installed:
+            return
+        try:
+            import jax.monitoring as monitoring
+            monitoring.register_event_listener(_event_listener)
+            monitoring.register_event_duration_secs_listener(
+                _duration_listener)
+        except Exception:  # pragma: no cover — monitoring API drift
+            LOG.debug("jax.monitoring unavailable; compile cache hit/miss "
+                      "classification degraded to 'unknown'", exc_info=True)
+        _listeners_installed = True
+
+
+# --------------------------------------------------------------------------
+# shape buckets
+# --------------------------------------------------------------------------
+
+def shape_key(*trees) -> tuple:
+    """Hashable (shape, dtype) signature over the pytree leaves — the same
+    bucket notion the engine's warmup events key on. Metadata only: never
+    touches device buffers."""
+    import jax
+    return tuple((tuple(getattr(x, "shape", ())),
+                  str(getattr(x, "dtype", type(x).__name__)))
+                 for x in jax.tree_util.tree_leaves(trees))
+
+
+def bucket_label(key: tuple) -> str:
+    """Compact stable label for a shape bucket (full keys are dozens of
+    leaves): leaf count + a hash. Humans correlate events by equality, not
+    by reading the shapes back."""
+    return f"leaves{len(key)}-{abs(hash(key)) % 0xFFFFFF:06x}"
+
+
+def tree_bytes(tree) -> int:
+    """Total ``nbytes`` over the pytree leaves (host numpy or device
+    arrays; metadata read, no sync)."""
+    import jax
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+class CompileEvent:
+    """One observed compilation (or AOT warmup compile)."""
+
+    __slots__ = ("program", "bucket", "trigger", "cache", "duration_s",
+                 "backend_compile_s", "time_s", "thread_name")
+
+    def __init__(self, program: str, bucket: str, trigger: str, cache: str,
+                 duration_s: float, backend_compile_s: float,
+                 time_s: float, thread_name: str) -> None:
+        self.program = program
+        self.bucket = bucket
+        self.trigger = trigger
+        self.cache = cache
+        self.duration_s = duration_s
+        self.backend_compile_s = backend_compile_s
+        self.time_s = time_s
+        self.thread_name = thread_name
+
+    def to_json(self) -> dict:
+        return {"program": self.program, "shapeBucket": self.bucket,
+                "trigger": self.trigger, "cache": self.cache,
+                "durationMs": round(self.duration_s * 1e3, 3),
+                "backendCompileMs": round(self.backend_compile_s * 1e3, 3),
+                "thread": self.thread_name}
+
+
+class _ProgramStats:
+    __slots__ = ("name", "compiles", "aot_compiles", "dispatches",
+                 "buckets")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.compiles = 0
+        self.aot_compiles = 0
+        self.dispatches = 0
+        #: distinct shape buckets observed under this name (display only;
+        #: recompile classification is per TrackedProgram INSTANCE — two
+        #: chains built with different configs legitimately share a
+        #: program name, and the second's first compile is cold, not a
+        #: signature change).
+        self.buckets: set = set()
+
+    def to_json(self) -> dict:
+        return {"compiles": self.compiles, "aotCompiles": self.aot_compiles,
+                "dispatches": self.dispatches,
+                "shapeBuckets": len(self.buckets)}
+
+
+class _TrackedLowered:
+    """``TrackedProgram.lower(...)`` result: ``.compile()`` records the
+    AOT compile event (kept for callers that use the lower/compile idiom
+    directly; :meth:`TrackedProgram.aot_compile` is the ergonomic form)."""
+
+    __slots__ = ("_program", "_lowered", "_key", "_parent_id")
+
+    def __init__(self, program: "TrackedProgram", lowered, key,
+                 parent_id) -> None:
+        self._program = program
+        self._lowered = lowered
+        self._key = key
+        self._parent_id = parent_id
+
+    def compile(self, *args, **kwargs):
+        p = self._program
+        rec, prev = _begin_capture()
+        t0 = time.perf_counter()
+        try:
+            out = self._lowered.compile(*args, **kwargs)
+        finally:
+            _end_capture(prev)
+        with p.collector._lock:
+            p.aot_seen.add(self._key)
+        p.collector._on_compile(p.name, self._key,
+                                time.perf_counter() - t0, rec,
+                                trigger=TRIGGER_AOT,
+                                parent_id=self._parent_id)
+        return out
+
+
+class TrackedProgram:
+    """Wrapper around one jitted callable: counts dispatches, detects
+    compiles via the program's in-process jit cache size (``_cache_size``
+    where available, first-seen shape buckets otherwise), and forwards
+    ``lower``/AOT compiles with the same bookkeeping. Transparent: args,
+    donation, and outputs pass straight through; a disabled collector
+    reduces a call to one attribute check.
+
+    The seen/aot-warmed bucket sets live HERE, not on the name-keyed
+    stats: recompile classification must match the cache the delta was
+    measured on (this instance's), or two chains sharing a program name
+    would flag each other's cold compiles as signature changes."""
+
+    __slots__ = ("collector", "name", "fn", "seen", "aot_seen")
+
+    def __init__(self, collector: "DeviceStatsCollector", name: str,
+                 fn) -> None:
+        self.collector = collector
+        self.name = name
+        self.fn = fn
+        #: buckets whose executable THIS wrapper's jit cache already
+        #: holds — a compile for a member is a genuine recompile.
+        self.seen: set = set()
+        #: buckets warmed ahead of time (AOT executables bypass the jit
+        #: dispatch cache, so the first dispatch still "compiles" — that
+        #: fill is warmup, not a recompile).
+        self.aot_seen: set = set()
+
+    def _cache_size(self):
+        try:
+            return self.fn._cache_size()
+        except Exception:
+            return None
+
+    def __call__(self, *args):
+        c = self.collector
+        if not c.enabled:
+            return self.fn(*args)
+        key = shape_key(args)
+        before = self._cache_size()
+        rec, prev = _begin_capture()
+        t0 = time.perf_counter()
+        try:
+            out = self.fn(*args)
+        finally:
+            _end_capture(prev)
+        duration = time.perf_counter() - t0
+        after = self._cache_size()
+        c._on_dispatch(self, key, before, after, duration, rec)
+        return out
+
+    def lower(self, *args, parent_id="current", **kwargs):
+        """AOT entry: the returned handle's ``.compile()`` records an
+        ``aot-warmup`` compile event (and a ``compile.<program>`` span,
+        parented at ``parent_id`` — warmup pool workers have no active
+        span of their own)."""
+        if not self.collector.enabled:
+            return self.fn.lower(*args, **kwargs)
+        return _TrackedLowered(self, self.fn.lower(*args, **kwargs),
+                               shape_key(args), parent_id)
+
+    def aot_compile(self, args: tuple, parent_id="current") -> None:
+        """``lower(*args).compile()`` with AOT bookkeeping — the warmup
+        pools' per-job entry point."""
+        self.lower(*args, parent_id=parent_id).compile()
+
+
+class DeviceStatsCollector:
+    """The process's device-runtime ledger (see module docstring).
+
+    Thread-safe; ``enabled = False`` turns every hook into a no-op (the
+    bench's overhead A/B switch, mirroring ``SpanTracer.enabled``).
+    """
+
+    def __init__(self, registry: MetricRegistry | None = None,
+                 tracer=None, max_events: int = 256) -> None:
+        from .tracing import default_tracer
+        _install_listeners()
+        self.registry = registry or MetricRegistry()
+        self.tracer = tracer or default_tracer()
+        self.enabled = True
+        self._lock = threading.Lock()
+        self._programs: dict[str, _ProgramStats] = {}
+        self._events: deque[CompileEvent] = deque(maxlen=max_events)
+        self._epoch = time.perf_counter()
+        self._h2d_bytes = 0
+        self._d2h_bytes = 0
+        self._last_cycle: dict | None = None
+        self._padding: dict | None = None
+        self._peak_live_bytes = 0
+        name = MetricRegistry.name
+        g = DEVICE_RUNTIME_SENSOR
+        self._compile_counter = self.registry.counter(
+            name(g, "compile-events"))
+        self._recompile_counter = self.registry.counter(
+            name(g, "recompile-events"))
+        self._aot_counter = self.registry.counter(
+            name(g, "aot-compile-events"))
+        self._compile_timer = self.registry.timer(name(g, "compile-timer"))
+        self._h2d_counter = self.registry.counter(
+            name(g, "h2d-transfer-bytes"))
+        self._d2h_counter = self.registry.counter(
+            name(g, "d2h-transfer-bytes"))
+        self.registry.gauge(name(g, "last-cycle-h2d-bytes"),
+                            lambda: (self._last_cycle or {}).get("h2dBytes"))
+        self.registry.gauge(name(g, "last-cycle-d2h-bytes"),
+                            lambda: (self._last_cycle or {}).get("d2hBytes"))
+        self.registry.gauge(
+            name(g, "last-cycle-compile-events"),
+            lambda: (self._last_cycle or {}).get("compileEvents"))
+        self.registry.gauge(name(g, "device-live-bytes"),
+                            lambda: self.memory_snapshot()["liveBytes"])
+        # Cached read only: the live gauge above (rendered first — sorted
+        # name order) already refreshed the peak; re-running a full
+        # snapshot here would enumerate jax.live_arrays() twice per
+        # scrape.
+        self.registry.gauge(name(g, "device-peak-live-bytes"),
+                            lambda: self._peak_live_bytes or None)
+        self.registry.gauge(
+            name(g, "padding-waste-partition-pct"),
+            lambda: (self._padding or {}).get("partitionWastePct"))
+        self.registry.gauge(
+            name(g, "padding-waste-broker-pct"),
+            lambda: (self._padding or {}).get("brokerWastePct"))
+        self.registry.gauge(
+            name(g, "padding-waste-replica-slot-pct"),
+            lambda: (self._padding or {}).get("replicaSlotWastePct"))
+
+    # -------------------------------------------------------- programs
+    def track(self, name: str, fn) -> TrackedProgram:
+        """Wrap a jitted callable under ``name``. Stats are keyed by name,
+        so re-built chains (new config, same programs) accumulate into one
+        ledger row; the wrapper itself is stateless."""
+        with self._lock:
+            self._programs.setdefault(name, _ProgramStats(name))
+        return TrackedProgram(self, name, fn)
+
+    def _stats(self, name: str) -> _ProgramStats:
+        with self._lock:
+            st = self._programs.get(name)
+            if st is None:
+                st = self._programs[name] = _ProgramStats(name)
+            return st
+
+    def _dispatch_counter_for(self, name: str):
+        return self.registry.counter(MetricRegistry.name(
+            DEVICE_RUNTIME_SENSOR, f"program-{name}-dispatch-count"))
+
+    def _compile_counter_for(self, name: str):
+        return self.registry.counter(MetricRegistry.name(
+            DEVICE_RUNTIME_SENSOR, f"program-{name}-compile-count"))
+
+    def _on_dispatch(self, program: "TrackedProgram", key, cache_before,
+                     cache_after, duration_s: float, rec: dict) -> None:
+        st = self._stats(program.name)
+        if cache_before is not None and cache_after is not None:
+            compiled = cache_after > cache_before
+        else:
+            # Fallback when the jit wrapper exposes no cache introspection
+            # (API drift): first sight of a bucket = compile. Misses
+            # same-bucket recompiles — documented degradation.
+            with self._lock:
+                compiled = (key not in program.seen
+                            and key not in program.aot_seen)
+        with self._lock:
+            st.dispatches += 1
+            st.buckets.add(key)
+        self._dispatch_counter_for(program.name).inc()
+        if compiled:
+            with self._lock:
+                if key in program.seen:
+                    trigger = TRIGGER_SIGNATURE
+                elif key in program.aot_seen:
+                    # Dispatch-cache fill after an AOT warmup: the
+                    # executable was compiled ahead of time, this dispatch
+                    # re-specializes into the jit cache (persistent cache
+                    # makes it a deserialize) — warmup, not a recompile.
+                    trigger = TRIGGER_AOT
+                else:
+                    trigger = TRIGGER_COLD
+            self._on_compile(program.name, key, duration_s, rec,
+                             trigger=trigger, parent_id="current",
+                             aot=False)
+        with self._lock:
+            program.seen.add(key)
+
+    def _on_compile(self, name: str, key, duration_s: float, rec: dict,
+                    *, trigger: str, parent_id=None, aot=None) -> None:
+        """Record one compile event (dispatch-detected or AOT)."""
+        aot = trigger == TRIGGER_AOT if aot is None else aot
+        if rec["misses"]:
+            cache = "miss"
+        elif rec["hits"]:
+            cache = "persistent-hit"
+        elif rec["backend_s"] > 0:
+            cache = "miss"          # compiled with no persistent cache on
+        else:
+            cache = "unknown"
+        event = CompileEvent(
+            program=name, bucket=bucket_label(key), trigger=trigger,
+            cache=cache, duration_s=duration_s,
+            backend_compile_s=rec["backend_s"],
+            time_s=time.perf_counter() - self._epoch,
+            thread_name=threading.current_thread().name)
+        st = self._stats(name)
+        with self._lock:
+            self._events.append(event)
+            st.buckets.add(key)
+            if aot:
+                st.aot_compiles += 1
+            else:
+                st.compiles += 1
+        (self._aot_counter if aot else self._compile_counter).inc()
+        if trigger == TRIGGER_SIGNATURE:
+            self._recompile_counter.inc()
+            LOG.warning(
+                "program %s RECOMPILED for an already-compiled shape "
+                "bucket %s (%.2fs, cache=%s) — pass-signature change?",
+                name, event.bucket, duration_s, cache)
+        self._compile_counter_for(name).inc()
+        self._compile_timer.update(duration_s)
+        # Visible next to the work it stalled: a compile.<program> span.
+        self.tracer.record(f"compile.{name}", duration_s,
+                           parent_id=parent_id,
+                           attrs={"trigger": trigger, "cache": cache,
+                                  "shapeBucket": event.bucket})
+
+    # -------------------------------------------------------- transfers
+    def record_h2d(self, nbytes: int) -> None:
+        if not self.enabled or not nbytes:
+            return
+        with self._lock:
+            self._h2d_bytes += int(nbytes)
+        self._h2d_counter.inc(int(nbytes))
+
+    def record_d2h(self, nbytes: int) -> None:
+        if not self.enabled or not nbytes:
+            return
+        with self._lock:
+            self._d2h_bytes += int(nbytes)
+        self._d2h_counter.inc(int(nbytes))
+
+    #: staticmethod re-export so call sites need only the collector.
+    tree_bytes = staticmethod(tree_bytes)
+
+    @contextlib.contextmanager
+    def cycle(self, label: str = "propose"):
+        """Bracket one logical cycle (a propose, a sweep): on exit the
+        h2d/d2h/compile deltas land in ``last_cycle`` (and its gauges).
+        Reentrant per thread — only the outermost cycle records, so the
+        facade can wrap monitor+optimize while the optimizer wraps
+        itself. Concurrent cycles on different threads share the global
+        counters; attribution is last-writer-wins (documented)."""
+        if not self.enabled:
+            yield
+            return
+        depth = getattr(_tls, "cycle_depth", 0)
+        _tls.cycle_depth = depth + 1
+        if depth:
+            try:
+                yield
+            finally:
+                _tls.cycle_depth = depth
+            return
+        with self._lock:
+            h2d0, d2h0 = self._h2d_bytes, self._d2h_bytes
+        compiles0 = self.compile_count() + self.aot_compile_count()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            _tls.cycle_depth = depth
+            with self._lock:
+                h2d, d2h = self._h2d_bytes - h2d0, self._d2h_bytes - d2h0
+            self._last_cycle = {
+                "label": label,
+                "h2dBytes": h2d, "d2hBytes": d2h,
+                "transferBytes": h2d + d2h,
+                "compileEvents": (self.compile_count()
+                                  + self.aot_compile_count() - compiles0),
+                "durationMs": round((time.perf_counter() - t0) * 1e3, 3)}
+
+    @property
+    def last_cycle(self) -> dict | None:
+        return self._last_cycle
+
+    # ----------------------------------------------------------- memory
+    def memory_snapshot(self) -> dict:
+        """Live/peak device memory. Backend allocator stats where the
+        platform provides them (TPU/GPU ``memory_stats()``); CPU fallback
+        sums ``jax.live_arrays()`` (see module docstring)."""
+        live = peak_alloc = in_use = None
+        source = "unavailable"
+        num_live = None
+        try:
+            import jax
+            arrays = jax.live_arrays()
+            num_live = len(arrays)
+            live = sum(int(a.nbytes) for a in arrays)
+            source = "live_arrays"
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                in_use = stats.get("bytes_in_use")
+                peak_alloc = stats.get("peak_bytes_in_use")
+                source = "device_memory_stats"
+        except Exception:  # pragma: no cover — backend quirks
+            pass
+        if live is not None:
+            with self._lock:
+                self._peak_live_bytes = max(self._peak_live_bytes, live)
+        return {"liveBytes": live, "numLiveArrays": num_live,
+                "peakLiveBytes": self._peak_live_bytes or None,
+                "allocatorBytesInUse": in_use,
+                "allocatorPeakBytes": peak_alloc,
+                "source": source}
+
+    # ---------------------------------------------------------- padding
+    def observe_padding(self, *, partitions: int, partitions_padded: int,
+                        brokers: int, brokers_padded: int,
+                        replica_slots_used: int | None = None,
+                        replica_slots_total: int | None = None) -> dict:
+        """Record padding-waste ratios from host-side counts (the
+        monitor's assemblers know them before any device upload — zero
+        syncs)."""
+        def waste(real, padded):
+            if not padded:
+                return 0.0
+            return round(100.0 * (1.0 - real / padded), 3)
+        padding = {
+            "partitions": partitions, "partitionsPadded": partitions_padded,
+            "partitionWastePct": waste(partitions, partitions_padded),
+            "brokers": brokers, "brokersPadded": brokers_padded,
+            "brokerWastePct": waste(brokers, brokers_padded),
+        }
+        if replica_slots_total:
+            padding.update(
+                replicaSlotsUsed=replica_slots_used,
+                replicaSlotsTotal=replica_slots_total,
+                replicaSlotWastePct=waste(replica_slots_used,
+                                          replica_slots_total))
+        self._padding = padding
+        return padding
+
+    def padding_from_model(self, model) -> dict:
+        """Padding waste straight from a ``FlatClusterModel``'s valid
+        masks. Fetches the masks to host (a device sync) — debug/test/
+        bench surface; the serving path feeds counts via
+        :meth:`observe_padding` instead."""
+        import numpy as np
+        pvalid = np.asarray(model.partition_valid)
+        bvalid = np.asarray(model.broker_valid)
+        rvalid = np.asarray(model.replica_valid)
+        return self.observe_padding(
+            partitions=int(pvalid.sum()), partitions_padded=pvalid.size,
+            brokers=int(bvalid.sum()), brokers_padded=bvalid.size,
+            replica_slots_used=int(rvalid.sum()),
+            replica_slots_total=int(rvalid.size))
+
+    # ------------------------------------------------------------ reads
+    def compile_count(self) -> int:
+        return self._compile_counter.count
+
+    def recompile_count(self) -> int:
+        return self._recompile_counter.count
+
+    def aot_compile_count(self) -> int:
+        return self._aot_counter.count
+
+    def events(self) -> list[CompileEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def snapshot(self) -> dict:
+        """Cheap counter snapshot for before/after diffing (the
+        zero-recompile gate's surface)."""
+        with self._lock:
+            h2d, d2h = self._h2d_bytes, self._d2h_bytes
+        return {"compileEvents": self.compile_count(),
+                "aotCompileEvents": self.aot_compile_count(),
+                "recompileEvents": self.recompile_count(),
+                "h2dBytes": h2d, "d2hBytes": d2h}
+
+    def to_json(self, recent_events: int = 64) -> dict:
+        """The /devicestats payload."""
+        with self._lock:
+            programs = {name: st.to_json()
+                        for name, st in sorted(self._programs.items())}
+            events = list(self._events)[-recent_events:]
+            h2d, d2h = self._h2d_bytes, self._d2h_bytes
+        return {
+            "enabled": self.enabled,
+            "compile": {
+                "totalEvents": self.compile_count(),
+                "aotEvents": self.aot_compile_count(),
+                "recompileEvents": self.recompile_count(),
+                "byProgram": programs,
+                "recentEvents": [e.to_json() for e in events],
+            },
+            "transfers": {
+                "h2dBytesTotal": h2d,
+                "d2hBytesTotal": d2h,
+                "lastCycle": self._last_cycle,
+            },
+            "memory": self.memory_snapshot(),
+            "padding": self._padding,
+        }
+
+
+#: process-wide default (the analog of default_tracer): subsystems built
+#: with ``collector=None`` share it, so one /devicestats dump covers the
+#: whole pipeline.
+_DEFAULT = DeviceStatsCollector()
+
+
+def default_collector() -> DeviceStatsCollector:
+    return _DEFAULT

@@ -89,7 +89,18 @@ class FlatClusterModel:
         """Build from host-side numpy arrays (one ``jnp.asarray`` per
         field). The assembly point for every array-native construction
         path — ``flatten_spec``, the monitor's dense pipeline, bench's
-        direct builders."""
+        direct builders — which also makes it the ONE choke point for
+        host->device transfer accounting: every model upload is metered
+        on the PROCESS-DEFAULT device-runtime collector (nbytes metadata
+        only, no sync). Deliberately the default, not an injected
+        collector: a classmethod constructor has no wiring surface, and
+        every production path runs on the default ledger — stacks built
+        with a private collector miss these bytes (documented
+        tradeoff)."""
+        from ..core.runtime_obs import default_collector
+        default_collector().record_h2d(
+            sum(int(a.nbytes) for a in arrays.values()
+                if isinstance(a, np.ndarray)))
         return cls(**{name: jnp.asarray(a) for name, a in arrays.items()})
 
     @property
@@ -298,28 +309,45 @@ def apply_moves(model: FlatClusterModel, moves: Moves) -> FlatClusterModel:
     return model.replace(replica_broker=rb, replica_offline=off)
 
 
-def sanity_check(model: FlatClusterModel) -> dict[str, Any]:
-    """Host-side invariant checks (ref ClusterModel.sanityCheck :1147).
-
-    Returns a dict of violation counts; all zeros means healthy. NumPy-side —
-    not jitted — because it is a test/debug utility.
-    """
-    rb = np.asarray(model.replica_broker)
-    valid = rb < model.broker_sentinel
-    pvalid = np.asarray(model.partition_valid)
-    issues = {}
+def validation_issue_counts(replica_broker: np.ndarray,
+                            partition_valid: np.ndarray,
+                            broker_valid: np.ndarray) -> dict[str, int]:
+    """Vectorized structural checks over host-side arrays — the shared
+    math behind :func:`sanity_check` AND the monitor's
+    ``flat-model-validation-issues`` meter (the monitor calls this on the
+    numpy arrays it just assembled, BEFORE the device upload, so metering
+    every model build costs no device sync and no Python-per-partition
+    loop). All zeros means healthy."""
+    rb = np.asarray(replica_broker)
+    pvalid = np.asarray(partition_valid)
+    bvalid = np.asarray(broker_valid)
+    sentinel = bvalid.shape[0]
+    valid = rb < sentinel
+    issues: dict[str, int] = {}
     # Valid partitions must have a leader in slot 0.
     issues["partitions_without_leader"] = int((pvalid & ~valid[:, 0]).sum())
-    # No two replicas of one partition on the same broker.
-    dup = 0
-    for p in np.nonzero(pvalid)[0]:
-        brokers = rb[p][valid[p]]
-        dup += len(brokers) - len(set(brokers.tolist()))
-    issues["duplicate_replica_brokers"] = dup
+    # No two replicas of one partition on the same broker: per sorted row,
+    # each adjacent equal pair below the sentinel is one duplicate (the
+    # count equals len(brokers) - len(set(brokers)) of the old per-row
+    # loop).
+    srt = np.sort(np.where(valid, rb, sentinel), axis=1)
+    dup = (srt[:, 1:] == srt[:, :-1]) & (srt[:, 1:] < sentinel)
+    issues["duplicate_replica_brokers"] = int(dup[pvalid].sum())
     # Replicas must sit on valid broker rows.
-    bvalid = np.asarray(model.broker_valid)
     on_invalid = valid & ~np.pad(bvalid, (0, 1))[rb]
     issues["replicas_on_invalid_brokers"] = int(on_invalid.sum())
     # Padding partitions must be fully empty.
     issues["padding_with_replicas"] = int((~pvalid[:, None] & valid).sum())
     return issues
+
+
+def sanity_check(model: FlatClusterModel) -> dict[str, Any]:
+    """Host-side invariant checks (ref ClusterModel.sanityCheck :1147).
+
+    Returns a dict of violation counts; all zeros means healthy. NumPy-side —
+    not jitted — because it is a test/debug utility (the three
+    ``np.asarray`` reads below each fetch a device array).
+    """
+    return validation_issue_counts(np.asarray(model.replica_broker),
+                                   np.asarray(model.partition_valid),
+                                   np.asarray(model.broker_valid))

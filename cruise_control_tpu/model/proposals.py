@@ -82,6 +82,11 @@ def diff_proposals(initial: FlatClusterModel, final: FlatClusterModel,
     element ``np`` indexing in a 500K-row loop costs seconds."""
     rb0 = np.asarray(initial.replica_broker)
     rb1 = np.asarray(final.replica_broker)
+    # The two placement fetches above are the proposal diff's real
+    # device->host cost at scale ([P, R] int32 x 2) — metered on the
+    # device-runtime ledger like the optimizer's own fetches.
+    from ..core.runtime_obs import default_collector
+    default_collector().record_d2h(rb0.nbytes + rb1.nbytes)
     if rb0.shape != rb1.shape:
         raise ValueError("models have different padded shapes")
     sentinel = initial.broker_sentinel

@@ -168,8 +168,9 @@ class LoadMonitor:
                  rack_by_broker: dict[int, str] | None = None,
                  broker_set_resolver=None,
                  max_concurrent_model_builds: int = 2,
-                 registry=None, tracer=None,
+                 registry=None, tracer=None, collector=None,
                  admin_retry=None, sleep_ms=None) -> None:
+        from ..core.runtime_obs import default_collector
         from ..core.sensors import (LOAD_MONITOR_SENSOR, MetricRegistry)
         from ..core.tracing import default_tracer
         self.admin = admin
@@ -182,6 +183,11 @@ class LoadMonitor:
         #: nested monitor.cluster-model → monitor.aggregate →
         #: monitor.model-build spans
         self.tracer = tracer or default_tracer()
+        #: device-runtime ledger (None = process default): every dense
+        #: model build feeds padding-waste ratios host-side (zero device
+        #: syncs — the counts are known before the upload), and the model
+        #: upload itself is metered in FlatClusterModel.from_numpy.
+        self.collector = collector or default_collector()
         c = self.config
         self.partition_aggregator = MetricSampleAggregator(
             c.num_windows, c.window_ms, c.min_samples_per_window,
@@ -223,6 +229,12 @@ class LoadMonitor:
             LOAD_MONITOR_SENSOR, "stale-models-served"))
         self._admin_retries = self.registry.meter(MetricRegistry.name(
             LOAD_MONITOR_SENSOR, "admin-retry-rate"))
+        #: structural model-validation issues observed at build time
+        #: (model.flat.validation_issue_counts over the pre-upload numpy
+        #: arrays) — marked per issue so a corrupted admin snapshot shows
+        #: on /metrics instead of living in a dict only tests read.
+        self._validation_issues = self.registry.meter(MetricRegistry.name(
+            LOAD_MONITOR_SENSOR, "flat-model-validation-issues"))
         self.registry.gauge(
             MetricRegistry.name(LOAD_MONITOR_SENSOR, "last-model-stale"),
             lambda: int(self._last_model_stale))
@@ -523,6 +535,18 @@ class LoadMonitor:
             partitions, alive, result, extra_offline)
         spec = ClusterSpec(brokers=brokers, partitions=pspecs)
         model, metadata = flatten_spec(spec)
+        # Padding accounting from shape metadata + the spec (no device
+        # read); the structural-issue meter lives on the dense path only —
+        # checking here would cost a device fetch of the just-uploaded
+        # arrays, and this assembler exists for parity testing.
+        self.collector.observe_padding(
+            partitions=len(metadata.partition_keys),
+            partitions_padded=model.num_partitions_padded,
+            brokers=len(metadata.broker_ids),
+            brokers_padded=model.num_brokers_padded,
+            replica_slots_used=sum(len(p.replicas) for p in pspecs),
+            replica_slots_total=(model.num_partitions_padded
+                                 * model.max_replication_factor))
         return ClusterModelResult(
             model=model, metadata=metadata,
             completeness=(result.completeness if result is not None
@@ -722,6 +746,21 @@ class LoadMonitor:
         ptopic[:P] = ptopic_real
         pvalid = np.zeros(Ppad, bool)
         pvalid[:P] = True
+
+        # Structural validation + padding accounting on the PRE-UPLOAD
+        # numpy arrays: metering every build costs vectorized host math
+        # only — no device sync, no per-partition Python loop.
+        from ..model.flat import validation_issue_counts
+        issues = validation_issue_counts(rb, pvalid, ba.valid)
+        num_issues = sum(issues.values())
+        if num_issues:
+            self._validation_issues.mark(num_issues)
+            LOG.warning("flat-model validation issues at build: %s",
+                        {k: v for k, v in issues.items() if v})
+        self.collector.observe_padding(
+            partitions=P, partitions_padded=Ppad,
+            brokers=len(ba.broker_ids), brokers_padded=Bpad,
+            replica_slots_used=total, replica_slots_total=Ppad * R)
 
         model = FlatClusterModel.from_numpy(
             replica_broker=rb, leader_load=lead_load,

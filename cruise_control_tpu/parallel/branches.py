@@ -62,11 +62,16 @@ def make_branch_mesh(n_branches: int | None = None) -> Mesh:
 
 
 def make_branched_search(goals: Sequence[GoalKernel], cfg: SearchConfig,
-                         mesh: Mesh):
+                         mesh: Mesh, collector=None):
     """Build ``run(state, ctx, key) -> (states, violations)`` where branch
     ``i`` holds ``states[i]`` (leading branch dim) and
     ``violations[i, g]`` its final per-goal residuals. Use
-    :func:`select_best` to pick the winner."""
+    :func:`select_best` to pick the winner.
+
+    The jitted program registers with the device-runtime collector
+    (``collector=None`` = the process default) as ``branched-search``, so
+    its compiles and dispatches show on /devicestats like every other
+    program in the repo."""
     chain = make_chain_step(goals, cfg)
 
     def branch(state, ctx, key):
@@ -84,7 +89,9 @@ def make_branched_search(goals: Sequence[GoalKernel], cfg: SearchConfig,
                        out_specs=out_specs)
         return fn(state, ctx, key)
 
-    return jax.jit(run)
+    from ..core.runtime_obs import default_collector
+    return (collector or default_collector()).track(
+        f"branched-search-x{mesh.devices.size}", jax.jit(run))
 
 
 def _checked_violations(violations) -> np.ndarray:

@@ -12,7 +12,32 @@ call it before the first array op in any entry-point script.
 
 from __future__ import annotations
 
+import logging
 import os
+
+LOG = logging.getLogger(__name__)
+
+#: Version stamp keying the persistent-compilation-cache directory.
+#:
+#: XLA's cache key covers input shapes and the traced computation, but a
+#: repo-level *pass-signature* change (a new output in every goal pass, a
+#: donation change, a jax upgrade quirk) leaves thousands of stale
+#: entries in place and silently recompiles everything exactly once per
+#: shape — unpredictably, mid-serving (the PR 3 incident: the
+#: ``(state, iters, stack, moves)`` signature change invalidated every
+#: pre-PR3 entry). Keying the directory by a repo-owned version makes
+#: that cost explicit and predictable: bump this constant in any PR that
+#: changes a jitted program's signature, and the repayment happens in
+#: one planned warmup instead of mixing stale and fresh entries.
+#:
+#: v2: this PR (device-runtime observability) — the collector changes no
+#: program signatures, but the versioning scheme itself starts here, so
+#: pre-existing unversioned entries are left behind in the old root.
+JIT_CACHE_VERSION = 2
+
+#: log the resolved cache dir exactly once per process (every entry
+#: point funnels through enable_compilation_cache, often repeatedly).
+_CACHE_LOGGED = False
 
 
 def respect_env_platforms() -> str | None:
@@ -71,6 +96,11 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
     any time. Returns the cache directory in use, or None when no writable
     location exists (cache disabled, never a startup crash — the package
     dir is read-only under system installs).
+
+    The resolved root is suffixed ``v<JIT_CACHE_VERSION>`` so a
+    pass-signature change repays its compiles predictably (one planned
+    warmup into a fresh directory) instead of mixing stale entries with
+    fresh ones; the resolved dir + version is logged once per process.
     """
     import tempfile
     candidates = [c for c in (
@@ -78,7 +108,8 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         DEFAULT_CACHE_DIR,
         os.path.join(tempfile.gettempdir(), "cruise_control_tpu_xla_cache"),
     ) if c]
-    for d in candidates:
+    for root in candidates:
+        d = os.path.join(root, f"v{JIT_CACHE_VERSION}")
         try:
             os.makedirs(d, exist_ok=True)
             probe = os.path.join(d, ".writable")
@@ -93,6 +124,11 @@ def enable_compilation_cache(cache_dir: str | None = None) -> str | None:
         # (1 s + min entry size) skips the many small passes a chain has.
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        global _CACHE_LOGGED
+        if not _CACHE_LOGGED:
+            _CACHE_LOGGED = True
+            LOG.info("persistent XLA compilation cache: %s "
+                     "(JIT_CACHE_VERSION=%d)", d, JIT_CACHE_VERSION)
         return d
     return None
 

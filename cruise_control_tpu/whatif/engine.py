@@ -140,7 +140,7 @@ class WhatIfEngine:
     """
 
     def __init__(self, goals=None, constraint: BalancingConstraint | None = None,
-                 *, registry=None, tracer=None,
+                 *, registry=None, tracer=None, collector=None,
                  scenario_pad_multiple: int = 8,
                  # Covers a full N-2 pairwise sweep up to 128 brokers
                  # (128*127/2 = 8128); per-scenario [S, P] parameter
@@ -149,9 +149,15 @@ class WhatIfEngine:
                  max_scenarios: int = 8192,
                  program_cache_size: int = 8) -> None:
         from ..analyzer.goals import default_goals
+        from ..core.runtime_obs import default_collector
         from ..core.sensors import MetricRegistry
         from ..core.tracing import default_tracer
         self.constraint = constraint or BalancingConstraint()
+        #: device-runtime ledger: the vmapped sweep/transform programs
+        #: register as TrackedPrograms (compile events + dispatch counts
+        #: on /devicestats), and sweep() meters its batch upload + result
+        #: fetch bytes.
+        self.collector = collector or default_collector()
         self.goals = (goals if goals is not None
                       else default_goals(self.constraint))
         import threading
@@ -198,13 +204,21 @@ class WhatIfEngine:
             batch = self._materialize(model, metadata, scenarios)
             goals = [g.bind(metadata) for g in self.goals]
             program = self._program_for(batch, goals, metadata)
+            # Per-scenario parameter upload: the sweep's host->device
+            # cost (the template model is already resident).
+            self.collector.record_h2d(
+                batch.dead.nbytes + batch.add.nbytes
+                + batch.cap_scale.nbytes + batch.pscale.nbytes
+                + batch.pvalid.nbytes)
             out = program(batch.template,
                           jnp.asarray(batch.dead), jnp.asarray(batch.add),
                           jnp.asarray(batch.cap_scale),
                           jnp.asarray(batch.pscale),
                           jnp.asarray(batch.pvalid))
+            fetched = jax.device_get(out)
+            self.collector.record_d2h(self.collector.tree_bytes(fetched))
             (viol, vscale, headroom, hfrac, pressure, unavailable,
-             n_offline) = (np.asarray(a) for a in jax.device_get(out))
+             n_offline) = (np.asarray(a) for a in fetched)
             report = self._build_report(
                 scenarios, goals, metadata, batch,
                 viol, vscale, headroom, hfrac, pressure, unavailable,
@@ -234,8 +248,11 @@ class WhatIfEngine:
         with self._programs_lock:
             program = self._programs.get(key)
             if program is None:
-                program = self._cache_program(key, jax.jit(jax.vmap(
-                    self._transform_fn(), in_axes=(None, 0, 0, 0, 0, 0))))
+                program = self._cache_program(
+                    key, self.collector.track(
+                        "whatif.transform",
+                        jax.jit(jax.vmap(self._transform_fn(),
+                                         in_axes=(None, 0, 0, 0, 0, 0)))))
         stacked, _has_alive = program(
             batch.template,
             jnp.asarray(batch.dead), jnp.asarray(batch.add),
@@ -359,7 +376,9 @@ class WhatIfEngine:
                 n_offline
 
         return self._cache_program(
-            key, jax.jit(jax.vmap(one, in_axes=(None, 0, 0, 0, 0, 0))))
+            key, self.collector.track(
+                "whatif.sweep",
+                jax.jit(jax.vmap(one, in_axes=(None, 0, 0, 0, 0, 0)))))
 
     def _cache_program(self, key, program):
         self._programs[key] = program

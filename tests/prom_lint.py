@@ -7,10 +7,16 @@ import re
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 
-def lint_prometheus_exposition(text: str) -> None:
+def lint_prometheus_exposition(text: str,
+                               expect_families: tuple = ()) -> None:
     """Minimal text-format lint: unique # TYPE per series family, a HELP
     line per declared family, legal sample names, float-parsable values,
-    and every sample belonging to a declared family."""
+    and every sample belonging to a declared family.
+
+    ``expect_families`` additionally asserts each named family is
+    DECLARED in the exposition (how the device-runtime/tracing tests pin
+    their gauge/counter families to the scrape surface — a renamed or
+    dropped family fails here, not in a dashboard)."""
     typed: set[str] = set()
     helped: set[str] = set()
     sample_names: set[str] = set()
@@ -38,3 +44,7 @@ def lint_prometheus_exposition(text: str) -> None:
         fam_candidates = {name, name.removesuffix("_count"),
                           name.removesuffix("_sum")}
         assert fam_candidates & typed, f"sample {name} has no # TYPE family"
+    missing = [f for f in expect_families if f not in typed]
+    assert not missing, (
+        f"expected families missing from exposition: {missing}; "
+        f"have {sorted(typed)[:40]}...")

@@ -290,8 +290,57 @@ def test_basic_security_roles():
         status, body, _ = call(app, "GET", "permissions",
                                headers=auth("alice"))
         assert body["role"] == "ADMIN"
+        # /devicestats is viewer-gated like /state: anonymous 401 (with a
+        # challenge), viewer 200.
+        base = f"http://127.0.0.1:{app.port}/devicestats"
+        try:
+            urllib.request.urlopen(base, timeout=60)
+            raise AssertionError("anonymous /devicestats must 401")
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+            assert e.headers.get("WWW-Authenticate")
+        req = urllib.request.Request(base, headers=auth("bob"))
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["compile"] is not None
     finally:
         app.stop()
+
+
+def test_devicestats_endpoint_formats(stack):
+    """/devicestats serves the device-runtime ledger as JSON (versioned
+    envelope, both path forms) and as a fixed-width table with
+    json=false; requests mark the shared servlet sensors like every
+    other endpoint."""
+    _, facade, app = stack
+    for path in ("devicestats", "kafkacruisecontrol/devicestats"):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/{path}", timeout=60) as resp:
+            assert resp.status == 200
+            assert "application/json" in resp.headers["Content-Type"]
+            body = json.loads(resp.read())
+        assert body["version"] == 1
+        for section in ("compile", "transfers", "memory"):
+            assert section in body, body.keys()
+        assert body["compile"]["totalEvents"] >= 0
+        assert isinstance(body["compile"]["byProgram"], dict)
+        assert body["memory"]["source"] in ("live_arrays",
+                                            "device_memory_stats",
+                                            "unavailable")
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/devicestats?json=false",
+            timeout=60) as resp:
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+    assert "PROGRAM" in text and "compile events:" in text
+    assert app.registry.get(
+        "KafkaCruiseControlServlet.devicestats-request-rate").count >= 1
+    # The same payload embeds as the DeviceStats substate of /state.
+    status, body, _ = call(app, "GET", "state", "substates=device_stats")
+    assert status == 200
+    assert "DeviceStats" in body and "MonitorState" not in body
+    assert body["DeviceStats"]["compile"]["totalEvents"] >= 0
 
 
 def test_admin_endpoint(stack):

@@ -83,3 +83,21 @@ def test_whatif_bench_smoke_gate():
     assert out["scenarios"] == 10
     assert out["warm_s"] > 0 and out["rebuild_s"] > 0
     assert out["speedup"] is not None and out["vs_dispatch"] is not None
+
+
+def test_device_stats_bench_smoke_gate():
+    """run_device_stats_bench on a toy cluster. The warm-recompile gate
+    is ALWAYS on (deterministic at any scale: after one warmup optimize,
+    further same-shape cycles must compile nothing — the helper raises
+    otherwise); the <2% collector-overhead wall-clock gate is judged at
+    bench scale only (gate=False here — noise-bound on a toy)."""
+    import bench
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    out = bench.run_device_stats_bench(
+        num_brokers=8, num_partitions=64,
+        goal_names=["ReplicaDistributionGoal"],
+        cycles=2, repeats=1, emit_row=False, gate=False)
+    assert out["recompiles"] == 0
+    assert out["transfer_bytes"] > 0
+    assert 0.0 <= out["padding"]["partitionWastePct"] < 100.0
+    assert default_collector().enabled   # A/B harness must restore

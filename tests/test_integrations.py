@@ -254,10 +254,12 @@ def test_goals_param_kafka_assigner_mode():
 
 def test_openapi_covers_all_endpoints():
     # 23 reference endpoints + the openapi document itself + this
-    # build's simulate (what-if sweeps) and trace (span export).
+    # build's simulate (what-if sweeps), trace (span export) and
+    # devicestats (device-runtime ledger).
     spec = openapi_spec()
-    assert len(ENDPOINTS) == 26
-    assert len(spec["paths"]) == 26
+    assert len(ENDPOINTS) == 27
+    assert len(spec["paths"]) == 27
+    assert "get" in spec["paths"]["/kafkacruisecontrol/devicestats"]
     reb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]
     names = {p["name"] for p in reb["parameters"]}
     assert {"dryrun", "goals", "kafka_assigner",

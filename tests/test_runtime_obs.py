@@ -1,0 +1,340 @@
+"""Device-runtime observability tests: DeviceStatsCollector unit behavior
+(compile detection + shape-bucket dedup, trigger taxonomy, AOT warmup
+spans, transfer/cycle accounting, padding math) and the tier-1
+zero-recompile warm-cycle gate over the real HTTP stack — the first
+first-class "did we recompile?" assertion in the repo."""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.runtime_obs import (DeviceStatsCollector,
+                                                 TRIGGER_AOT, TRIGGER_COLD,
+                                                 TRIGGER_SIGNATURE,
+                                                 default_collector,
+                                                 tree_bytes)
+from cruise_control_tpu.core.tracing import SpanTracer
+
+from prom_lint import lint_prometheus_exposition
+from test_api import build_stack, call
+
+
+def _collector():
+    """Private collector + private tracer: unit tests must not leak
+    events into the process default the e2e gate diffs."""
+    return DeviceStatsCollector(tracer=SpanTracer())
+
+
+# ------------------------------------------------------------- unit tests
+
+def test_compile_event_dedup_across_shape_buckets():
+    """First call per (program, shape bucket) is ONE cold compile; warm
+    calls add dispatches only; a new bucket compiles once more."""
+    c = _collector()
+    f = c.track("prog", jax.jit(lambda x: x + 1))
+    x4, x8 = jnp.ones((4,)), jnp.ones((8,))
+    f(x4)
+    assert c.compile_count() == 1
+    f(x4)
+    f(x4)
+    assert c.compile_count() == 1          # dedup: warm bucket, no event
+    f(x8)
+    assert c.compile_count() == 2          # new bucket compiles once
+    f(x8)
+    assert c.compile_count() == 2
+    events = c.events()
+    assert [e.trigger for e in events] == [TRIGGER_COLD, TRIGGER_COLD]
+    assert len({e.bucket for e in events}) == 2
+    stats = c.to_json()["compile"]["byProgram"]["prog"]
+    assert stats == {"compiles": 2, "aotCompiles": 0, "dispatches": 5,
+                     "shapeBuckets": 2}
+    assert c.recompile_count() == 0
+
+
+def test_recompile_classified_as_signature_change():
+    """A compile for a bucket THIS program instance already compiled is
+    the alarming case: same program, same shapes, yet XLA specialized
+    again (simulated by clearing the jit caches under it — the same
+    observable a donation/sharding/pass-signature change produces)."""
+    c = _collector()
+    x = jnp.ones((4,))
+    f = c.track("p", jax.jit(lambda x: x + 1))
+    f(x)
+    jax.clear_caches()
+    f(x)                                          # same bucket, recompiled
+    assert c.compile_count() == 2
+    assert c.recompile_count() == 1
+    assert c.events()[-1].trigger == TRIGGER_SIGNATURE
+
+
+def test_same_name_fresh_program_is_cold_not_recompile():
+    """Two chains built with different configs legitimately share a
+    program name: the second instance's first compile must classify
+    cold — recompile detection is per instance, matching the cache the
+    delta was measured on."""
+    c = _collector()
+    x = jnp.ones((4,))
+    c.track("p2", jax.jit(lambda x: x + 1))(x)
+    c.track("p2", jax.jit(lambda x: x + 2))(x)    # same name, new instance
+    assert c.compile_count() == 2
+    assert c.recompile_count() == 0
+    assert [e.trigger for e in c.events()] == [TRIGGER_COLD, TRIGGER_COLD]
+    assert c.to_json()["compile"]["byProgram"]["p2"]["dispatches"] == 2
+
+
+def test_aot_warmup_records_event_and_span():
+    """aot_compile: the warmup-pool path — an aot-warmup event plus a
+    compile.<program> span (recorded from whatever thread compiles, with
+    an explicit parent); the follow-up dispatch-cache fill is classified
+    aot-warmup too, never signature-change."""
+    c = _collector()
+    g = c.track("aot-prog", jax.jit(lambda x: x * 3))
+    x = jnp.ones((6,))
+    with c.tracer.span("warmup-root") as root:
+        g.aot_compile((x,), parent_id=root.span_id)
+    assert c.aot_compile_count() == 1
+    assert c.compile_count() == 0                  # AOT ledger is separate
+    g(x)                                           # dispatch-cache fill
+    g(x)
+    events = [e for e in c.events() if e.program == "aot-prog"]
+    assert [e.trigger for e in events] == [TRIGGER_AOT, TRIGGER_AOT]
+    assert c.recompile_count() == 0
+    spans = c.tracer.spans()
+    root_span = next(s for s in spans if s.name == "warmup-root")
+    compile_spans = [s for s in spans if s.name == "compile.aot-prog"]
+    assert len(compile_spans) == 2          # the AOT compile + the fill
+    assert compile_spans[0].parent_id == root_span.span_id
+    assert all(s.attrs["trigger"] == TRIGGER_AOT for s in compile_spans)
+
+
+def test_transfer_accounting_and_cycle():
+    c = _collector()
+    a = np.zeros((10, 4), np.float32)
+    assert tree_bytes({"x": a, "y": np.zeros(3, np.int64)}) == 160 + 24
+    with c.cycle("outer"):
+        c.record_h2d(100)
+        with c.cycle("inner"):                     # reentrant: no-op
+            c.record_d2h(40)
+        c.record_d2h(10)
+    last = c.last_cycle
+    assert last["label"] == "outer"
+    assert last["h2dBytes"] == 100 and last["d2hBytes"] == 50
+    assert last["transferBytes"] == 150
+    assert last["compileEvents"] == 0
+    snap = c.snapshot()
+    assert snap["h2dBytes"] == 100 and snap["d2hBytes"] == 50
+
+
+def test_model_upload_meters_h2d():
+    """FlatClusterModel.from_numpy is the one upload choke point: the
+    process-default collector's h2d counter grows by the model's bytes."""
+    from cruise_control_tpu.model.flat import FlatClusterModel
+    c = default_collector()
+    arrays = dict(
+        replica_broker=np.full((4, 2), 2, np.int32),
+        leader_load=np.zeros((4, 4), np.float32),
+        follower_load=np.zeros((4, 4), np.float32),
+        partition_topic=np.zeros(4, np.int32),
+        partition_valid=np.ones(4, bool),
+        replica_offline=np.zeros((4, 2), bool),
+        replica_pref_pos=np.zeros((4, 2), np.int32),
+        broker_capacity=np.ones((2, 4), np.float32),
+        broker_rack=np.zeros(2, np.int32),
+        broker_host=np.zeros(2, np.int32),
+        broker_set=np.zeros(2, np.int32),
+        broker_alive=np.ones(2, bool),
+        broker_new=np.zeros(2, bool),
+        broker_demoted=np.zeros(2, bool),
+        broker_broken_disk=np.zeros(2, bool),
+        broker_valid=np.ones(2, bool))
+    expected = sum(a.nbytes for a in arrays.values())
+    before = c.snapshot()["h2dBytes"]
+    FlatClusterModel.from_numpy(**arrays)
+    assert c.snapshot()["h2dBytes"] - before == expected
+
+
+def test_padding_waste_math_vs_hand_built_model():
+    """padding_from_model vs a hand-built model with known masks: 5 of 8
+    partition rows valid (37.5% waste), 3 of 4 broker rows (25%), 8 of 16
+    replica slots (50%)."""
+    from cruise_control_tpu.model.flat import FlatClusterModel
+    sentinel = 4
+    rb = np.full((8, 2), sentinel, np.int32)
+    rb[0] = [0, 1]
+    rb[1] = [1, 2]
+    rb[2] = [2, 0]
+    rb[3, 0] = 0
+    rb[4, 0] = 1                                  # 8 used slots total
+    pvalid = np.array([1, 1, 1, 1, 1, 0, 0, 0], bool)
+    model = FlatClusterModel.from_numpy(
+        replica_broker=rb,
+        leader_load=np.zeros((8, 4), np.float32),
+        follower_load=np.zeros((8, 4), np.float32),
+        partition_topic=np.zeros(8, np.int32),
+        partition_valid=pvalid,
+        replica_offline=np.zeros((8, 2), bool),
+        replica_pref_pos=np.zeros((8, 2), np.int32),
+        broker_capacity=np.ones((4, 4), np.float32),
+        broker_rack=np.zeros(4, np.int32),
+        broker_host=np.zeros(4, np.int32),
+        broker_set=np.zeros(4, np.int32),
+        broker_alive=np.array([1, 1, 1, 0], bool),
+        broker_new=np.zeros(4, bool),
+        broker_demoted=np.zeros(4, bool),
+        broker_broken_disk=np.zeros(4, bool),
+        broker_valid=np.array([1, 1, 1, 0], bool))
+    c = _collector()
+    padding = c.padding_from_model(model)
+    assert padding["partitionWastePct"] == pytest.approx(37.5)
+    assert padding["brokerWastePct"] == pytest.approx(25.0)
+    assert padding["replicaSlotWastePct"] == pytest.approx(50.0)
+    assert padding["partitions"] == 5 and padding["partitionsPadded"] == 8
+    # The gauges read the same numbers on a scrape.
+    text = c.registry.expose_text()
+    assert "cc_DeviceRuntime_padding_waste_partition_pct 37.5" in text
+
+
+def test_validation_issue_counts_vectorized_matches_sanity_check():
+    """The monitor's meter math IS sanity_check's math (one vectorized
+    definition): seed known defects and check both agree."""
+    from cruise_control_tpu.model.flat import validation_issue_counts
+    sentinel = 3
+    rb = np.full((4, 3), sentinel, np.int32)
+    rb[0] = [0, 1, 2]            # healthy
+    rb[1] = [1, 1, sentinel]     # duplicate broker
+    rb[2, 0] = sentinel          # valid partition without leader
+    rb[2, 1] = 0
+    rb[3, 0] = 2                 # padding row with a replica
+    pvalid = np.array([1, 1, 1, 0], bool)
+    bvalid = np.array([1, 1, 0], bool)   # broker 2 row invalid
+    issues = validation_issue_counts(rb, pvalid, bvalid)
+    assert issues == {"partitions_without_leader": 1,
+                      "duplicate_replica_brokers": 1,
+                      "replicas_on_invalid_brokers": 2,
+                      "padding_with_replicas": 1}
+
+
+def test_disabled_collector_is_a_noop():
+    c = _collector()
+    c.enabled = False
+    f = c.track("quiet", jax.jit(lambda x: x - 1))
+    f(jnp.ones((3,)))
+    c.record_h2d(10)
+    c.record_d2h(10)
+    with c.cycle():
+        pass
+    assert c.compile_count() == 0
+    assert c.snapshot()["h2dBytes"] == 0
+    assert c.last_cycle is None
+    assert c.events() == []
+
+
+# --------------------------------------------- tier-1 zero-recompile gate
+
+@pytest.fixture(scope="module")
+def stack():
+    sim, facade, app = build_stack()
+    yield sim, facade, app
+    app.stop()
+
+
+def _ensure_proposed(facade, app) -> None:
+    """Run one warm propose if none has happened on this stack yet, so
+    every test here holds standalone (cycle gauges, per-program
+    counters, and compile spans exist regardless of which test of this
+    module runs first or alone)."""
+    if facade.device_stats.last_cycle is None:
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            "dryrun=true&ignore_proposal_cache=true"
+            "&get_response_timeout_s=300")
+        assert status == 200, body
+
+
+def test_warm_propose_cycles_report_zero_compiles(stack):
+    """THE acceptance gate: after one warm rebalance, >=3 consecutive
+    warm ``POST /rebalance?dryrun=true`` cycles must report 0 compile
+    events on /devicestats — the collector makes "did we recompile?" a
+    first-class assertion. Any nonzero here means shape drift or a
+    pass-signature change is silently eating warm-path latency."""
+    _, facade, app = stack
+    collector = facade.device_stats
+    assert collector is default_collector()   # one ledger, whole process
+
+    def propose():
+        status, body, _ = call(
+            app, "POST", "rebalance",
+            "dryrun=true&ignore_proposal_cache=true"
+            "&get_response_timeout_s=300")
+        assert status == 200, body
+        return body
+
+    propose()                                  # warmup (may compile)
+    snap = collector.snapshot()
+    for cycle in range(3):
+        propose()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{app.port}/devicestats",
+                timeout=60) as resp:
+            stats = json.loads(resp.read())
+        last = stats["transfers"]["lastCycle"]
+        assert last is not None
+        assert last["compileEvents"] == 0, (
+            f"warm cycle {cycle} compiled: "
+            f"{stats['compile']['recentEvents'][-5:]}")
+        # The full cycle moved real bytes across the boundary (model
+        # upload + result fetches) — the accounting is alive, not a
+        # vacuous zero.
+        assert last["transferBytes"] > 0
+    after = collector.snapshot()
+    assert after["compileEvents"] == snap["compileEvents"], (
+        "warm cycles added compile events: "
+        f"{[e.to_json() for e in collector.events()][-5:]}")
+    assert after["aotCompileEvents"] == snap["aotCompileEvents"]
+    # Padding for the 4x16 toy stack: assembled host-side by the monitor
+    # during the cycles above (partitions pad 16 -> 128).
+    assert stats["padding"] is not None
+    assert stats["padding"]["partitions"] == 16
+
+
+def test_device_runtime_metric_families_on_scrape(stack):
+    """Satellite: the new gauge/counter families lint cleanly and are
+    pinned to the /metrics surface (prom_lint expect_families)."""
+    _, facade, app = stack
+    _ensure_proposed(facade, app)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/metrics", timeout=60) as resp:
+        text = resp.read().decode()
+    lint_prometheus_exposition(text, expect_families=(
+        "cc_DeviceRuntime_compile_events_total",
+        "cc_DeviceRuntime_recompile_events_total",
+        "cc_DeviceRuntime_aot_compile_events_total",
+        "cc_DeviceRuntime_compile_timer_seconds",
+        "cc_DeviceRuntime_h2d_transfer_bytes_total",
+        "cc_DeviceRuntime_d2h_transfer_bytes_total",
+        "cc_DeviceRuntime_last_cycle_compile_events",
+        "cc_DeviceRuntime_device_live_bytes",
+        "cc_DeviceRuntime_padding_waste_partition_pct",
+        "cc_LoadMonitor_flat_model_validation_issues_total",
+    ))
+    # Per-program ledger rows made it to the scrape too.
+    assert "cc_DeviceRuntime_program_pass_" in text
+
+
+def test_compile_spans_visible_on_trace(stack):
+    """Compile events render as compile.<program> spans in the same
+    /trace dump as the work they stall (the warmup pool's concurrent AOT
+    compiles included, via explicit parenting)."""
+    _, facade, app = stack
+    _ensure_proposed(facade, app)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/trace", timeout=60) as resp:
+        trace = json.loads(resp.read())
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    compile_spans = {n for n in names if n.startswith("compile.")}
+    assert any(n.startswith("compile.pass.") for n in compile_spans), (
+        f"no per-pass compile spans in {sorted(compile_spans)}")

@@ -250,7 +250,12 @@ def stack():
 def test_sensors_populated_through_the_stack(stack):
     _, facade, app = stack
     # Exercise the path: a proposals run times the optimizer + monitor.
-    status, _, _ = call(app, "GET", "proposals")
+    # Explicit long-poll budget: the first proposals computation traces
+    # and fills the jit caches (~12s on a loaded CPU box even with the
+    # persistent cache warm — lowering isn't cached), so the 10s default
+    # long-poll would flake a 202 here.
+    status, _, _ = call(app, "GET", "proposals",
+                        "get_response_timeout_s=300")
     assert status == 200
     reg = facade.registry
     assert reg.get(
