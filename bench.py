@@ -422,6 +422,138 @@ def run_device_stats_bench(num_brokers: int = NUM_BROKERS,
             "enabled_s": enabled_s, "disabled_s": disabled_s}
 
 
+def run_resident_delta_bench(num_brokers: int = NUM_BROKERS,
+                             num_partitions: int = NUM_PARTITIONS, *,
+                             churn_pct: float = 1.0, cycles: int = 3,
+                             emit_row: bool = True, gate: bool = True
+                             ) -> dict:
+    """Resident-state rows: metric-only delta cycles vs the full-rebuild
+    upload on the monitor→model path.
+
+    A monitor with the resident state on ingests a stable synthetic
+    workload; each warm cycle then changes ``churn_pct`` of partitions
+    (the "sliver of metric windows" case the resident path exists for)
+    and rebuilds. Reported:
+
+    - ``resident_delta_cycle_wall_clock`` — best metric-only cycle
+      (aggregate + assembly + delta scatter), vs_baseline = the full
+      rebuild+upload cycle over it.
+    - ``resident_delta_h2d_bytes_per_cycle`` — the delta payload bytes,
+      vs_baseline = full-model upload bytes over it. **Gated >= 10x at
+      bench scale** (the acceptance bar; delta-bucket padding makes the
+      ratio meaningless on toy shapes, so the smoke gate passes
+      gate=False).
+
+    Always asserted, every scale: delta cycles touch EXACTLY the churned
+    rows (exact-diff parity), bump no epoch, and — after one
+    ``resident.warmup()`` — compile nothing.
+    """
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+
+    window_ms = 1000
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b)
+    num_topics = max(num_partitions // 100, 1)
+    for p in range(num_partitions):
+        sim.add_partition(f"t{p % num_topics}", p,
+                          [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=50.0 + (p % 100))
+    monitor = LoadMonitor(sim, MonitorConfig(
+        num_windows=MODEL_BUILD_WINDOWS, window_ms=window_ms,
+        min_samples_per_window=1))
+    resident = monitor.resident
+    assert resident is not None
+    mdef = partition_metric_def()
+    keys = sorted(sim.describe_partitions())
+    P = len(keys)
+    # Integer values: window means over identical values are exact, so
+    # only the churned rows ever produce a changed load row.
+    vals = ((np.arange(P * mdef.size(), dtype=np.float64)
+             .reshape(P, mdef.size()) % 97) + 1.0)
+    next_w = 0
+
+    def ingest(v, windows=1):
+        nonlocal next_w
+        for _ in range(windows):
+            times = np.full(P, next_w * window_ms + 100, np.int64)
+            monitor.partition_aggregator.add_samples_dense(keys, times, v)
+            next_w += 1
+
+    ingest(vals, windows=MODEL_BUILD_WINDOWS + 1)
+    t0 = time.monotonic()
+    monitor.cluster_model(next_w * window_ms)
+    full_s = time.monotonic() - t0
+    assert resident.last_update == "full" and resident.epoch == 1
+    full_bytes = resident.last_full_bytes
+    resident.warmup()                  # pre-compile the delta bucket
+
+    churn_n = max(int(P * churn_pct / 100.0), 1)
+    churn_rows = np.arange(churn_n)
+    collector = default_collector()
+    snap = collector.snapshot()
+    delta_s, delta_bytes_per_cycle = float("inf"), []
+    for c in range(cycles):
+        vals = vals.copy()
+        vals[churn_rows] += 1.0 + c
+        # Two windows so the changed window rolls out of the in-flight
+        # slot (the aggregator never serves the current window).
+        ingest(vals, windows=2)
+        t0 = time.monotonic()
+        monitor.cluster_model(next_w * window_ms)
+        delta_s = min(delta_s, time.monotonic() - t0)
+        if resident.last_update != "delta" or resident.epoch != 1:
+            raise RuntimeError(
+                f"metric-only cycle {c} left the delta path: "
+                f"update={resident.last_update} epoch={resident.epoch}")
+        if resident.last_delta_rows != churn_n:
+            raise RuntimeError(
+                f"delta touched {resident.last_delta_rows} rows, expected "
+                f"exactly the {churn_n} churned rows — the exact-diff "
+                "parity contract is broken")
+        delta_bytes_per_cycle.append(resident.last_delta_bytes)
+    after = collector.snapshot()
+    recompiles = ((after["compileEvents"] + after["aotCompileEvents"])
+                  - (snap["compileEvents"] + snap["aotCompileEvents"]))
+    if recompiles != 0:
+        raise RuntimeError(
+            f"resident delta cycles compiled {recompiles} programs after "
+            "warmup (want 0) — see /devicestats recentEvents")
+    epoch_after_deltas = resident.epoch
+    # WARM full-rebuild baseline: the first build above was cold
+    # (first-touch aggregation + allocation); re-measure the full
+    # rebuild+upload cycle warm so the wall-clock comparison is
+    # like-for-like with the warm delta cycles.
+    resident.invalidate()
+    t0 = time.monotonic()
+    monitor.cluster_model(next_w * window_ms)
+    full_s = min(full_s, time.monotonic() - t0)
+    assert resident.last_update == "full"
+    delta_bytes = min(delta_bytes_per_cycle)
+    ratio = full_bytes / delta_bytes if delta_bytes else None
+    log(f"resident delta ({num_brokers}x{num_partitions}, "
+        f"{churn_n} rows/cycle churn): delta cycle {delta_s:.3f}s vs full "
+        f"{full_s:.3f}s; h2d {delta_bytes} bytes/cycle vs full upload "
+        f"{full_bytes} bytes ({ratio:.1f}x smaller)")
+    if gate and (ratio is None or ratio < 10.0):
+        raise RuntimeError(
+            f"resident h2d gate: delta payload {delta_bytes} bytes is only "
+            f"{ratio:.1f}x smaller than the {full_bytes}-byte full upload "
+            "(want >= 10x)")
+    if emit_row:
+        emit("resident_delta_cycle_wall_clock", round(delta_s, 3), "s",
+             round(full_s / delta_s, 3) if delta_s > 0 else None)
+        emit("resident_delta_h2d_bytes_per_cycle", delta_bytes, "bytes",
+             round(ratio, 1) if ratio else None)
+    return {"full_s": full_s, "delta_s": delta_s,
+            "full_bytes": full_bytes, "delta_bytes": delta_bytes,
+            "rows_per_cycle": churn_n, "ratio": ratio,
+            "recompiles": recompiles, "epoch": epoch_after_deltas}
+
+
 def run_chaos_recovery_bench(*, seed: int = 11, emit_row: bool = True,
                              max_steps: int = 200) -> dict:
     """Recovery time under the canonical chaos scenario: a broker dies
@@ -909,6 +1041,10 @@ def main():
     # Device-runtime rows: zero warm recompiles, transfer bytes per warm
     # cycle, padding waste — and the collector's own <2% overhead A/B.
     run_device_stats_bench()
+    # Resident-state rows: metric-only delta cycles must ship >=10x fewer
+    # h2d bytes than the full-rebuild upload, compile nothing warm, and
+    # touch exactly the churned rows.
+    run_resident_delta_bench()
     # Robustness: steps from injected broker crash to restored
     # balancedness through the full heal loop.
     run_chaos_recovery_bench()

@@ -1464,9 +1464,14 @@ class MinTopicLeadersPerBrokerGoal(GoalKernel):
             topic_pattern=self.topic_pattern, min_count=self.min_count)
 
     def bind_signature(self):
-        if self.interested_topics is None:
-            return None
-        return bytes(np.asarray(self.interested_topics).tobytes())
+        # min_count and topic_pattern are traced into the compiled pass
+        # but are NOT derivable from (class, constraint) when passed as
+        # explicit overrides — they must be part of the compiled-chain
+        # cache identity (the process-wide registry shares chains across
+        # optimizer instances on exactly this signature).
+        mask = (None if self.interested_topics is None
+                else bytes(np.asarray(self.interested_topics).tobytes()))
+        return (self.min_count, self.topic_pattern, mask)
 
     def _deficit(self, state: SearchState, ctx: SearchContext) -> jax.Array:
         """i32[T, B1] — leaders still missing per (topic, broker) cell.

@@ -13,6 +13,7 @@ wall-clock durations, and materializes the proposal diff at the end.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass, field, replace
 
@@ -179,9 +180,42 @@ def _walk_passes(chain, idxs, state, ctx, keys, on_start=None,
     return state, fetched, durations
 
 
+#: Process-wide compiled-chain registry. Chains were cached per
+#: TpuGoalOptimizer instance, so every fresh optimizer built for the same
+#: goal chain — facade memoization misses, goal-scoped healing optimizers,
+#: detector optimizers, per-stack test fixtures — re-traced and re-compiled
+#: identical XLA programs (the persistent cache softens the XLA half but
+#: not tracing, and the in-process jit dispatch caches never shared).
+#: A chain's compiled identity is exactly (search config, per-goal
+#: (class, hard, constraint, bind signature), mesh): goal kernels are
+#: stateless beyond their constraint (frozen dataclass of trace-time
+#: constants) and bind-time masks (hashed by ``bind_signature``) — a goal
+#: subclass carrying any OTHER config must fold it into its
+#: ``bind_signature`` (the same contract the per-instance cache already
+#: relied on for rebinding). FIFO-bounded: an evicted chain still in use
+#: keeps working through its holder's reference; it just recompiles for
+#: the next requester.
+_SHARED_CHAINS: dict = {}
+_SHARED_CHAINS_MAX = 64
+_SHARED_CHAINS_LOCK = threading.Lock()
+
+
+def _shared_chain_key(cfg: SearchConfig, goals, mesh_key):
+    # name AND class: one class serves several catalog entries (the four
+    # resource variants of CapacityGoal/UsageDistributionGoal differ only
+    # in name + resource), and a subclass may reuse its parent's name.
+    return (cfg,
+            tuple((type(g), g.name, g.hard, getattr(g, "constraint", None),
+                   g.bind_signature()) for g in goals),
+            mesh_key)
+
+
 class TpuGoalOptimizer:
     """Owns compiled goal chains; reusable across models with the same padded
-    shapes (recompiles transparently otherwise — XLA cache keyed on shapes)."""
+    shapes (recompiles transparently otherwise — XLA cache keyed on shapes).
+    Compiled chains are shared PROCESS-WIDE across optimizer instances (see
+    ``_SHARED_CHAINS``): two optimizers configured for the same chain reuse
+    one set of compiled passes and one warmup."""
 
     def __init__(self, goals: list[GoalKernel] | None = None,
                  constraint: BalancingConstraint | None = None,
@@ -230,9 +264,6 @@ class TpuGoalOptimizer:
         #: proposal cache and the goal-violation detector (which call
         #: optimize() directly, not through the facade) can't bypass it.
         self.options_generator = options_generator
-        import threading
-        self._chains: dict[tuple, CompiledGoalChain] = {}
-        self._chains_lock = threading.Lock()
         self._audit_fns: dict[tuple, object] = {}
         self.registry = registry or MetricRegistry()
         #: span tracer threading the whole pipeline (None = the shared
@@ -255,17 +286,25 @@ class TpuGoalOptimizer:
         # — a chain warmed unsharded must not satisfy a sharded warmup.
         mesh_key = (None if self.mesh is None
                     else tuple(str(d) for d in self.mesh.devices.flat))
-        key = (cfg, tuple(g.bind_signature() for g in goals), mesh_key)
-        # Locked get-or-create: optimizers are shared across request threads
-        # (facade memoization), and two racing first requests must converge
-        # on ONE chain object — CompiledGoalChain.warmup coalesces compiles
-        # per instance, so distinct instances would each pay the full
-        # parallel XLA compile.
-        with self._chains_lock:
-            if key not in self._chains:
-                self._chains[key] = CompiledGoalChain(
-                    goals, cfg, collector=self.collector)
-            return self._chains[key]
+        key = _shared_chain_key(cfg, goals, mesh_key)
+        # Locked get-or-create against the PROCESS-WIDE registry:
+        # optimizers are shared across request threads (facade
+        # memoization) and chains across optimizer instances, so every
+        # racing first request must converge on ONE chain object —
+        # CompiledGoalChain.warmup coalesces compiles per instance, and
+        # distinct instances would each pay the full parallel XLA
+        # compile. The chain's TrackedPrograms land on the FIRST
+        # requester's collector (in practice everyone shares the process
+        # default).
+        with _SHARED_CHAINS_LOCK:
+            chain = _SHARED_CHAINS.pop(key, None)
+            if chain is None:
+                chain = CompiledGoalChain(goals, cfg,
+                                          collector=self.collector)
+            _SHARED_CHAINS[key] = chain       # re-insert = most recent
+            while len(_SHARED_CHAINS) > _SHARED_CHAINS_MAX:
+                _SHARED_CHAINS.pop(next(iter(_SHARED_CHAINS)))
+            return chain
 
     def _prepare(self, model: FlatClusterModel, metadata: ClusterMetadata,
                  options: OptimizationOptions):

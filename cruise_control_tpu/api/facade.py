@@ -83,7 +83,8 @@ class KafkaCruiseControl:
         #: StaleClusterModelError; operators who prefer availability
         #: over topology freshness during sample outages)
         self.allow_stale_execution = False
-        self.proposal_cache = ProposalCache(monitor, self.optimizer)
+        self.proposal_cache = ProposalCache(monitor, self.optimizer,
+                                            now_ms=self._now_ms)
         #: what-if scenario engine scoring hypothetical topologies with
         #: the SAME goal chain the optimizer serves — /simulate and the
         #: resilience detector share its compiled sweep programs.
@@ -137,6 +138,14 @@ class KafkaCruiseControl:
         self.device_stats = self.optimizer.collector
         self.extra_registries.append(self.device_stats.registry)
 
+        #: proposal-freshness sensors (ProposalCache.freshness-*-ms
+        #: gauges + the SLO-breach meter) join the scrape view.
+        self.extra_registries.append(self.proposal_cache.registry)
+
+        #: startup pre-warm thread (see :meth:`start_prewarm`).
+        self._prewarm_thread: threading.Thread | None = None
+        self._prewarm_stop = threading.Event()
+
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
                     self.executor.registry, self.whatif.registry]
@@ -170,23 +179,100 @@ class KafkaCruiseControl:
     # ----------------------------------------------------------- lifecycle
     def start_up(self, precompute_interval_s: float = 30.0,
                  start_precompute: bool = True,
-                 skip_loading: bool = False) -> None:
+                 skip_loading: bool = False,
+                 freshness_target_ms: int = 0,
+                 start_prewarm: bool = False) -> None:
         """ref startUp() KafkaCruiseControl.java:221-227.
         ``skip_loading`` bypasses sample-store replay (ref
-        skip.loading.samples)."""
+        skip.loading.samples). ``freshness_target_ms`` arms the proposal
+        freshness SLO (proposals.freshness.target.ms; 0 = plain interval
+        refresher); ``start_prewarm`` launches the background startup
+        pre-warm (prewarm.on.start)."""
         if self.task_runner is not None and \
                 self.task_runner.state.value == "NOT_STARTED":
             self.task_runner.start(self._now_ms(), skip_loading=skip_loading)
         if start_precompute:
-            self.proposal_cache.start_refresher(precompute_interval_s,
-                                                self._now_ms)
+            self.proposal_cache.start_refresher(
+                precompute_interval_s, self._now_ms,
+                freshness_target_ms=freshness_target_ms)
+        if start_prewarm:
+            self.start_prewarm()
         if self.detector is not None:
             self.detector.start_detection()
 
     def shutdown(self) -> None:
         self.proposal_cache.stop()
+        self._prewarm_stop.set()
+        if self._prewarm_thread is not None:
+            self._prewarm_thread.join(timeout=5)
+            self._prewarm_thread = None
         if self.detector is not None:
             self.detector.stop_detection()
+
+    def prewarm(self) -> dict:
+        """Pre-warm the serving path's compiled programs: build one
+        cluster model (the resident path's first full upload), compile
+        the resident delta-ingest bucket, and AOT-compile the default
+        goal chain for the model's shapes — all landing in the versioned
+        persistent compilation cache (``.jax_cache/v<N>``), so
+        steady-state metric-only cycles dispatch with ZERO compiles.
+        Raises (NotEnoughValidWindows) while the monitor lacks sample
+        history; :meth:`start_prewarm` retries in the background."""
+        from ..utils.platform import enable_compilation_cache
+        enable_compilation_cache()
+        result = self.monitor.cluster_model(self._now_ms())
+        resident = getattr(self.monitor, "resident", None)
+        if resident is not None:
+            resident.warmup()
+        # The proposal cache's options select the chain the steady-state
+        # refresher actually serves.
+        self.optimizer.warmup(result.model, result.metadata,
+                              self.proposal_cache.options)
+        return {"status": "warmed", "generation": result.generation}
+
+    def start_prewarm(self, poll_interval_s: float = 2.0) -> None:
+        """Background startup pre-warm: retry :meth:`prewarm` until the
+        monitor has enough sample history, then exit. Daemon thread;
+        stopped by :meth:`shutdown`."""
+        if self._prewarm_thread is not None and \
+                self._prewarm_thread.is_alive():
+            return
+        # Fresh stop event per start: an orphan loop from a previous
+        # start (shutdown's join timed out mid-prewarm) still holds its
+        # own — already set — event and exits at its next wait, so a
+        # restart can never leave two loops compiling concurrently.
+        stop = threading.Event()
+        self._prewarm_stop = stop
+
+        def loop():
+            from ..monitor import NotEnoughValidWindowsException
+            logged_unexpected = False
+            # Every failed attempt pays the model build's admin describe
+            # sweeps before it can raise — back off exponentially (cap
+            # 60s) so an hours-long warm-in (1h windows) doesn't hammer
+            # the cluster admin endpoints every 2s.
+            delay = poll_interval_s
+            while not stop.wait(delay):
+                try:
+                    self.prewarm()
+                    LOG.info("startup pre-warm complete: serving path "
+                             "compiled ahead of first request")
+                    return
+                except NotEnoughValidWindowsException:
+                    pass       # monitor still warming in: retry, backed off
+                except Exception:
+                    # Non-transient failures must be visible (a silently
+                    # cold serving path defeats prewarm.on.start); log the
+                    # first with traceback, keep retrying quietly.
+                    if not logged_unexpected:
+                        logged_unexpected = True
+                        LOG.warning("startup pre-warm failed (will keep "
+                                    "retrying, backed off)", exc_info=True)
+                delay = min(delay * 2, 60.0)
+
+        self._prewarm_thread = threading.Thread(target=loop, daemon=True,
+                                                name="startup-prewarm")
+        self._prewarm_thread.start()
 
     # ------------------------------------------------------ goal-based ops
     #: LRU bound on memoized goal-scoped optimizers — goal lists come from
@@ -706,6 +792,20 @@ class KafkaCruiseControl:
                                         key=lambda i: (i.topic, i.partition))
                     ]} if verbose else {})}}
 
+    def device_stats_json(self) -> dict:
+        """The full ``/devicestats`` payload: the device-runtime ledger
+        plus the resident-state section (epoch, last delta rows/bytes)
+        and the proposal-freshness readout — one dump answering "what is
+        resident, how fresh are the proposals, what did the runtime
+        pay"."""
+        payload = self.device_stats.to_json()
+        resident = getattr(self.monitor, "resident", None)
+        payload["resident"] = (resident.to_json()
+                               if resident is not None else None)
+        payload["proposalFreshness"] = self.proposal_cache.freshness_json(
+            self._now_ms())
+        return payload
+
     def state(self, substates: list[str] | None = None) -> dict:
         """ref GetStateRunnable -> CruiseControlState with substates."""
         wanted = {s.lower() for s in (substates or
@@ -724,7 +824,7 @@ class KafkaCruiseControl:
         # padding (the /devicestats payload, embedded for one-call
         # dashboards).
         if "device_stats" in wanted or "devicestats" in wanted:
-            out["DeviceStats"] = self.device_stats.to_json()
+            out["DeviceStats"] = self.device_stats_json()
         if "monitor" in wanted:
             mon = self.monitor.state(self._now_ms()).to_json()
             if self.task_runner is not None:
@@ -733,9 +833,14 @@ class KafkaCruiseControl:
         if "executor" in wanted:
             out["ExecutorState"] = self.executor.state_json()
         if "analyzer" in wanted:
+            now = self._now_ms()
             out["AnalyzerState"] = {
                 "isProposalReady": self.proposal_cache.valid(),
-                "readyGoals": [g.name for g in self.optimizer.goals]}
+                "readyGoals": [g.name for g in self.optimizer.goals],
+                "proposalFreshnessAgeMs":
+                    self.proposal_cache.freshness_age_ms(now),
+                "proposalFreshnessLagMs":
+                    self.proposal_cache.freshness_lag_ms(now)}
         if "anomaly_detector" in wanted and self.detector is not None:
             out["AnomalyDetectorState"] = self.detector.state_json()
         return out

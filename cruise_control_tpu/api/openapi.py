@@ -310,6 +310,39 @@ _SCHEMAS = {
                                       "backend path (device_memory_stats "
                                       "on TPU/GPU, live_arrays on CPU)"},
             "padding": {"type": "object", "nullable": True},
+            "resident": {
+                "type": "object", "nullable": True,
+                "description": "device-resident model state "
+                               "(model/resident.py): epoch bumps on "
+                               "structural full rebuilds; metric-only "
+                               "cycles report lastUpdate=delta with "
+                               "lastDeltaRows/lastDeltaBytes",
+                "properties": {
+                    "epoch": {"type": "integer"},
+                    "fullRebuilds": {"type": "integer"},
+                    "deltaCycles": {"type": "integer"},
+                    "noopCycles": {"type": "integer"},
+                    "lastUpdate": {"type": "string", "nullable": True},
+                    "lastDeltaRows": {"type": "integer"},
+                    "lastDeltaBytes": {"type": "integer"},
+                    "lastFullBytes": {"type": "integer"},
+                    "shapes": {"type": "object"},
+                }},
+            "proposalFreshness": {
+                "type": "object",
+                "description": "proposal-cache freshness vs the "
+                               "proposals.freshness.target.ms SLO: lagMs "
+                               "is how long the current model generation "
+                               "has gone unanswered (0 = cache valid), "
+                               "ageMs how old the cached result is",
+                "properties": {
+                    "valid": {"type": "boolean"},
+                    "ageMs": {"type": "integer", "nullable": True},
+                    "lagMs": {"type": "integer", "nullable": True},
+                    "targetMs": {"type": "integer", "nullable": True},
+                    "computations": {"type": "integer"},
+                    "breaches": {"type": "integer"},
+                }},
         }},
 }
 

@@ -180,6 +180,22 @@ def _render_devicestats(payload: dict) -> str:
                  f"{padding.get('partitionsPadded')}), brokers "
                  f"{padding.get('brokerWastePct')}%, replica slots "
                  f"{padding.get('replicaSlotWastePct', '-')}%")
+    resident = payload.get("resident")
+    if resident:
+        text += (f"\nresident state: epoch {resident.get('epoch')} "
+                 f"[last {resident.get('lastUpdate')}], "
+                 f"{resident.get('deltaCycles')} delta / "
+                 f"{resident.get('noopCycles')} noop / "
+                 f"{resident.get('fullRebuilds')} full cycles, last delta "
+                 f"{resident.get('lastDeltaRows')} rows "
+                 f"({resident.get('lastDeltaBytes')} bytes)")
+    fresh = payload.get("proposalFreshness")
+    if fresh:
+        text += (f"\nproposal freshness: age {fresh.get('ageMs')} ms, "
+                 f"lag {fresh.get('lagMs')} ms (target "
+                 f"{fresh.get('targetMs')} ms), "
+                 f"{fresh.get('computations')} computations, "
+                 f"{fresh.get('breaches')} SLO breaches")
     return text
 
 

@@ -1,4 +1,4 @@
-"""Proposal precompute + generation-keyed cache.
+"""Proposal precompute + generation-keyed cache + freshness SLO loop.
 
 Rebuild of the reference's background "train loop"
 (``GoalOptimizer.run()`` ``GoalOptimizer.java:152-203``): a cached
@@ -7,18 +7,37 @@ rebalances instantly; the cache is valid while the monitor's model
 generation is unchanged (``:232-239``); readers either take the cache, or
 block until the in-flight computation lands (``:304-352``), or force a
 fresh computation (``ignore_proposal_cache``).
+
+On top of generation keying, the cache tracks a **proposal-freshness
+SLO** (``proposals.freshness.target.ms``): *lag* is how long the current
+monitor generation has gone unanswered by the cache (0 while the cache is
+generation-valid), *age* is how old the cached result itself is. The
+background refresher ticks fast enough to keep lag under the target
+(``min(interval, target/4)``) and recomputes the moment the generation
+moves, so ``GET /proposals`` under concurrent traffic stays a
+generation-checked cache read with bounded staleness; a recompute that
+lands later than the target after the generation moved marks the
+``ProposalCache.freshness-slo-breaches`` meter (and logs) — the signal
+operators alert on. ``freshness-age-ms`` / ``freshness-lag-ms`` gauges
+join the facade's scrape view.
 """
 
 from __future__ import annotations
 
+import logging
 import threading
+import time as _time
 
 from ..analyzer import OptimizationOptions
+
+LOG = logging.getLogger(__name__)
 
 
 class ProposalCache:
     def __init__(self, monitor, optimizer, *,
-                 options: OptimizationOptions | None = None) -> None:
+                 options: OptimizationOptions | None = None,
+                 registry=None, now_ms=None) -> None:
+        from ..core.sensors import MetricRegistry
         self.monitor = monitor
         self.optimizer = optimizer
         # The cache is a dry-run measurement: a hard goal that cannot be
@@ -34,6 +53,30 @@ class ProposalCache:
         self._refresher: threading.Thread | None = None
         self._stop = threading.Event()
         self.num_computations = 0
+        # ---- freshness SLO bookkeeping -------------------------------
+        self._now_ms_fn = now_ms or (lambda: int(_time.time() * 1000))
+        #: 0 disables the SLO (plain interval refresher, no breach
+        #: accounting); serve.py wires proposals.freshness.target.ms.
+        self.freshness_target_ms = 0
+        self._cached_at_ms: int | None = None
+        self._gen_seen: int | None = None
+        self._gen_changed_at_ms: int | None = None
+        #: high-water generation a breach was already marked for — one
+        #: breach per unanswered generation, whether detected by a
+        #: late-landing recompute or by the tick watching lag grow past
+        #: the target (monotonic so a slow compute for an OLD generation
+        #: landing after a newer one was marked cannot double-count)
+        self._breach_marked_gen: int | None = None
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        self._breaches = self.registry.meter(
+            name("ProposalCache", "freshness-slo-breaches"))
+        self.registry.gauge(name("ProposalCache", "freshness-age-ms"),
+                            self.freshness_age_ms)
+        self.registry.gauge(name("ProposalCache", "freshness-lag-ms"),
+                            self.freshness_lag_ms)
+        self.registry.gauge(name("ProposalCache", "freshness-target-ms"),
+                            lambda: self.freshness_target_ms or None)
 
     # ------------------------------------------------------------- reads
     def peek(self):
@@ -48,19 +91,63 @@ class ProposalCache:
             return (self._cached is not None
                     and self._cached_generation == self.monitor.generation)
 
+    def observe_generation(self, now_ms: int | None = None) -> None:
+        """Stamp when the monitor's generation last moved — the anchor
+        freshness lag is measured from. Called on every refresher tick
+        and on freshness reads, so observation granularity is the tick."""
+        gen = self.monitor.generation
+        now = now_ms if now_ms is not None else self._now_ms_fn()
+        with self._lock:
+            if gen != self._gen_seen:
+                self._gen_seen = gen
+                self._gen_changed_at_ms = now
+
+    def freshness_age_ms(self, now_ms: int | None = None) -> int | None:
+        """Age of the cached result (None when empty) — how old the
+        proposals a cache read would serve actually are."""
+        now = now_ms if now_ms is not None else self._now_ms_fn()
+        with self._lock:
+            if self._cached is None or self._cached_at_ms is None:
+                return None
+            return max(int(now - self._cached_at_ms), 0)
+
+    def freshness_lag_ms(self, now_ms: int | None = None) -> int | None:
+        """How long the CURRENT generation has gone unanswered: 0 while
+        the cache is generation-valid, else ms since the generation was
+        observed to move (None before anything was ever observed). This
+        is the number the SLO bounds."""
+        now = now_ms if now_ms is not None else self._now_ms_fn()
+        self.observe_generation(now)
+        with self._lock:
+            if (self._cached is not None
+                    and self._cached_generation == self.monitor.generation):
+                return 0
+            if self._gen_changed_at_ms is None:
+                return None
+            return max(int(now - self._gen_changed_at_ms), 0)
+
+    def freshness_json(self, now_ms: int | None = None) -> dict:
+        """The ``proposalFreshness`` section of ``/devicestats``."""
+        now = now_ms if now_ms is not None else self._now_ms_fn()
+        return {"valid": self.valid(),
+                "ageMs": self.freshness_age_ms(now),
+                "lagMs": self.freshness_lag_ms(now),
+                "targetMs": self.freshness_target_ms or None,
+                "computations": self.num_computations,
+                "breaches": self._breaches.count}
+
     def get(self, now_ms: int, timeout_s: float = 60.0):
         """Serve the cached result, computing (or waiting on the in-flight
         computation) when stale (ref blocking read :304-352). A waiter whose
         in-flight computation fails takes over the computation itself (so
         the original error surfaces rather than a bogus timeout)."""
-        import time as _t
-        deadline = _t.monotonic() + timeout_s
+        deadline = _time.monotonic() + timeout_s
         while True:
             with self._lock:
                 if self.valid():
                     return self._cached
                 if self._computing:
-                    remaining = deadline - _t.monotonic()
+                    remaining = deadline - _time.monotonic()
                     if remaining <= 0 or not self._lock.wait_for(
                             lambda: self.valid() or not self._computing,
                             timeout=remaining):
@@ -76,7 +163,12 @@ class ProposalCache:
                     self._lock.notify_all()
 
     def _compute(self, now_ms: int):
+        self.observe_generation(now_ms)
         gen = self.monitor.generation
+        # Anchor breach lag to the generation THIS compute answers: the
+        # generation (and its change stamp) may move again mid-compute.
+        with self._lock:
+            gen_changed0 = self._gen_changed_at_ms
         model_result = self.monitor.cluster_model(now_ms)
         # Belt-and-braces: the monitor only emits live results, but a
         # plugged monitor (or future refactor) handing a what-if scenario
@@ -94,12 +186,38 @@ class ProposalCache:
             # computed from a stale-served model must not execute.
             from dataclasses import replace
             result = replace(result, stale_model=True)
+        done_ms = self._now_ms_fn()
         with self._lock:
+            had_cache = self._cached is not None
             self._cached = result
             self._cached_generation = gen
+            self._cached_at_ms = done_ms
             self.num_computations += 1
             self._lock.notify_all()
+            catch_up = (done_ms - gen_changed0
+                        if gen_changed0 is not None else None)
+        # Breach accounting: a previously-warm cache that took longer
+        # than the target to catch the moved generation back up. The
+        # first-ever fill (startup warm-in) is exempt — that cost is what
+        # the startup pre-warm exists to hide. One breach per generation
+        # (the tick path below may have marked this one already).
+        if (self.freshness_target_ms and had_cache
+                and catch_up is not None
+                and catch_up > self.freshness_target_ms):
+            self._mark_breach(gen, catch_up)
         return result
+
+    def _mark_breach(self, gen: int, lag_ms: int) -> None:
+        with self._lock:
+            if (self._breach_marked_gen is not None
+                    and gen <= self._breach_marked_gen):
+                return
+            self._breach_marked_gen = gen
+        self._breaches.mark()
+        LOG.warning(
+            "proposal freshness SLO breach: generation %s unanswered "
+            "%d ms after it appeared (target %d ms)", gen, lag_ms,
+            self.freshness_target_ms)
 
     def store(self, result, *, generation: int,
               scenario_label: str | None = None) -> bool:
@@ -126,6 +244,7 @@ class ProposalCache:
                 return False
             self._cached = result
             self._cached_generation = generation
+            self._cached_at_ms = self._now_ms_fn()
             self._lock.notify_all()
             return True
 
@@ -133,23 +252,74 @@ class ProposalCache:
         with self._lock:
             self._cached = None
             self._cached_generation = None
+            self._cached_at_ms = None
 
     # ------------------------------------------- background refresh loop
-    def start_refresher(self, interval_s: float, now_ms_fn) -> None:
+    def refresh_once(self, now_ms_fn=None) -> bool:
+        """One freshness tick: observe the generation, recompute when the
+        cache no longer answers it. Returns True when a recompute ran
+        (False on cache-valid ticks and on compute failures — monitor
+        not ready / transient errors retry next tick, ref :160-167 skip
+        states)."""
+        fn = now_ms_fn or self._now_ms_fn
+        now = fn()
+        self.observe_generation(now)
+        if self.valid():
+            return False
+        # A persistent compute failure is the WORST freshness outage:
+        # mark the breach from the tick itself (once per generation) the
+        # moment a previously-warm cache's lag exceeds the target — a
+        # recompute that never lands must not keep the alerting meter
+        # flat. Startup warm-in (nothing cached yet) stays exempt.
+        if self.freshness_target_ms:
+            lag = self.freshness_lag_ms(now)
+            with self._lock:
+                gen = self._gen_seen
+                had_cache = self._cached is not None
+            if (had_cache and gen is not None and lag is not None
+                    and lag > self.freshness_target_ms):
+                self._mark_breach(gen, lag)
+        try:
+            self.get(fn())
+            return True
+        except Exception:
+            return False
+
+    def start_refresher(self, interval_s: float, now_ms_fn, *,
+                        freshness_target_ms: int = 0) -> None:
         """ref the precompute thread started by KafkaCruiseControl.startUp
-        (KafkaCruiseControl.java:225)."""
+        (KafkaCruiseControl.java:225). With a freshness target the tick
+        tightens to ``min(interval, target/4)`` so a generation bump is
+        noticed (and recomputed) well inside the SLO window."""
         if self._refresher is not None:
             return
+        # Fresh stop event per start (stop() leaves the old one set):
+        # a cache restarted after stop() must actually refresh again,
+        # and an orphan loop from a timed-out join exits on its own
+        # event at its next wait.
+        stop = threading.Event()
+        self._stop = stop
+        self._now_ms_fn = now_ms_fn
+        self.freshness_target_ms = int(freshness_target_ms or 0)
+        tick = interval_s
+        if self.freshness_target_ms > 0:
+            tick = min(interval_s,
+                       max(self.freshness_target_ms / 4000.0, 0.05))
 
         def loop():
-            while not self._stop.wait(interval_s):
-                try:
-                    if not self.valid():
-                        self.get(now_ms_fn())
-                except Exception:
-                    # Monitor not ready (NotEnoughValidWindows) or transient
-                    # failure: retry next tick (ref :160-167 skip states).
-                    pass
+            # Failure backoff: a compute that cannot land (monitor warming
+            # in after restart — hours on 1h windows) must not be retried
+            # at the tightened freshness tick; every attempt pays admin
+            # describe sweeps before it can raise. Doubling up to the
+            # plain interval restores the pre-SLO cadence under
+            # persistent failure; any success (or a valid cache) snaps
+            # back to the fast tick.
+            delay = tick
+            while not stop.wait(delay):
+                if self.refresh_once(now_ms_fn) or self.valid():
+                    delay = tick
+                else:
+                    delay = min(max(delay * 2, tick), interval_s)
 
         self._refresher = threading.Thread(target=loop, daemon=True,
                                            name="proposal-precompute")

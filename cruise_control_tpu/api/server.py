@@ -806,7 +806,7 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
             return json_resp(e.status, {"errorMessage": str(e)},
                              _auth_headers(e, app.security))
         with app.request_timing("GET", "devicestats") as outcome:
-            payload = app.facade.device_stats.to_json()
+            payload = app.facade.device_stats_json()
             outcome["status"] = 200
         raw_json = parse_qs(parsed.query).get("json", ["true"])[0]
         if raw_json.strip().lower() in ("false", "0", "no"):

@@ -49,6 +49,13 @@ def _monitor_defs(d: ConfigDef) -> None:
                  "monitor pipeline (one [E, M, W] aggregation + "
                  "whole-array flat-model gathers); false selects the "
                  "per-entity reference path")
+    d.define("monitor.resident.state", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Keep the canonical cluster model resident on device and "
+                 "apply metric-only build cycles as compact delta "
+                 "scatters (model/resident.py); structural changes bump "
+                 "the resident epoch and fall back to a full "
+                 "rebuild+upload. Requires monitor.dense.pipeline.")
     d.define("monitor.serve.stale.on.incomplete", ConfigType.BOOLEAN, True,
              importance=Importance.LOW,
              doc="When sample dropouts push the window history below "
@@ -215,6 +222,21 @@ def _analyzer_defs(d: ConfigDef) -> None:
     d.define("num.proposal.precompute.threads", ConfigType.INT, 1,
              validator=Range.at_least(0), importance=Importance.LOW,
              doc="Background proposal precompute threads")
+    d.define("proposals.freshness.target.ms", ConfigType.LONG, 30_000,
+             validator=Range.at_least(0), importance=Importance.MEDIUM,
+             doc="Proposal-freshness SLO: the background refresher keeps "
+                 "the ProposalCache's lag behind the monitor's model "
+                 "generation under this bound (tick = min(interval, "
+                 "target/4)); a recompute landing later marks "
+                 "ProposalCache.freshness-slo-breaches. 0 disables the "
+                 "SLO (plain interval refresher).")
+    d.define("prewarm.on.start", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Pre-warm the serving path at startup (background "
+                 "thread): first model build + resident delta-ingest "
+                 "bucket + AOT goal-chain compile into the versioned "
+                 ".jax_cache/v<N> directory, so steady-state cycles "
+                 "dispatch with zero compiles.")
     d.define("default.goals", ConfigType.LIST, "",
              importance=Importance.HIGH, doc="Goal chain (empty = built-in)")
     d.define("hard.goals", ConfigType.LIST, "", importance=Importance.MEDIUM,
@@ -847,7 +869,8 @@ class CruiseControlConfig(AbstractConfig):
             serve_stale_on_incomplete=self.get_boolean(
                 "monitor.serve.stale.on.incomplete"),
             max_stale_model_age_ms=self.get_int(
-                "monitor.max.stale.model.age.ms"))
+                "monitor.max.stale.model.age.ms"),
+            resident_state=self.get_boolean("monitor.resident.state"))
 
     def balancing_constraint(self) -> BalancingConstraint:
         return BalancingConstraint(

@@ -89,6 +89,12 @@ class MonitorConfig:
     #: the completeness error propagates after all (ref
     #: monitor.max.stale.model.age.ms)
     max_stale_model_age_ms: int = 3_600_000
+    #: monitor.resident.state: keep the canonical cluster model resident
+    #: on device and apply metric-only cycles as compact delta scatters
+    #: (model/resident.py); structural changes bump the resident epoch
+    #: and fall back to one full rebuild + upload. Dense-pipeline only —
+    #: the per-entity reference path always uploads in full.
+    resident_state: bool = True
 
 
 @dataclass
@@ -208,6 +214,17 @@ class LoadMonitor:
         self._admin_retry = admin_retry
         self._admin_sleep_ms = sleep_ms
         self.registry = registry or MetricRegistry()
+        #: device-resident model state (None when disabled or on the
+        #: reference pipeline): the dense assembler routes every build
+        #: through it so metric-only cycles become delta scatters instead
+        #: of full uploads. Sensors land on this monitor's registry
+        #: (``ResidentState.*``).
+        from ..model.resident import ResidentClusterState
+        self.resident = (
+            ResidentClusterState(registry=self.registry,
+                                 collector=self.collector,
+                                 tracer=self.tracer)
+            if (c.resident_state and c.dense_pipeline) else None)
         # ref LoadMonitor.java:101 cluster-model-creation-timer; the
         # valid-windows / monitored-partitions gauges mirror
         # LoadMonitor.java:104-110 sensor registrations.
@@ -762,7 +779,7 @@ class LoadMonitor:
             brokers=len(ba.broker_ids), brokers_padded=Bpad,
             replica_slots_used=total, replica_slots_total=Ppad * R)
 
-        model = FlatClusterModel.from_numpy(
+        arrays = dict(
             replica_broker=rb, leader_load=lead_load,
             follower_load=foll_load, partition_topic=ptopic,
             partition_valid=pvalid, replica_offline=offline,
@@ -771,6 +788,19 @@ class LoadMonitor:
             broker_set=ba.broker_set, broker_alive=ba.alive,
             broker_new=ba.new, broker_demoted=ba.demoted,
             broker_broken_disk=ba.broken, broker_valid=ba.valid)
+        if self.resident is not None and result is not None:
+            # Resident path: metric-only cycles upload a compact load
+            # delta and reuse the device-resident structural buffers;
+            # anything else bumps the epoch and full-rebuilds. The arrays
+            # above are freshly built every cycle, so handing ownership
+            # to the resident state is safe. Placement-only builds
+            # (result is None — /load?capacity_only) bypass the resident
+            # state entirely: their zero load planes would clobber the
+            # mirrors and turn the next real cycle into a full-size
+            # "delta" (the same reason _last_good never caches them).
+            model = self.resident.update(arrays)
+        else:
+            model = FlatClusterModel.from_numpy(**arrays)
         from ..model.spec import ClusterMetadata
         metadata = ClusterMetadata(
             broker_ids=ba.broker_ids, broker_index=bindex,

@@ -558,7 +558,9 @@ def main(argv=None) -> int:
     app = build_app(config, admin)
     app.facade.start_up(
         precompute_interval_s=config.get_int("proposal.expiration.ms") / 1000,
-        skip_loading=config.get_boolean("skip.loading.samples"))
+        skip_loading=config.get_boolean("skip.loading.samples"),
+        freshness_target_ms=config.get_long("proposals.freshness.target.ms"),
+        start_prewarm=config.get_boolean("prewarm.on.start"))
     app.facade.detector.start_detection()
     app.start()
     print(f"cruise-control-tpu listening on "

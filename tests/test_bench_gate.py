@@ -85,6 +85,25 @@ def test_whatif_bench_smoke_gate():
     assert out["speedup"] is not None and out["vs_dispatch"] is not None
 
 
+def test_resident_delta_bench_smoke_gate():
+    """run_resident_delta_bench on a toy cluster: exercises the
+    full-upload -> warm -> delta-cycle harness end-to-end with its
+    always-on exactness gates (delta rows == churned rows, zero compiles
+    after warmup, no epoch drift — the helper raises otherwise). Tier-1
+    safe: the >= 10x h2d-byte gate is judged at bench scale only
+    (gate=False here — the delta bucket's padding dominates a 128-row
+    toy axis)."""
+    import bench
+    out = bench.run_resident_delta_bench(num_brokers=6, num_partitions=96,
+                                         churn_pct=5.0, cycles=2,
+                                         emit_row=False, gate=False)
+    assert out["rows_per_cycle"] == 4
+    assert out["recompiles"] == 0
+    assert out["epoch"] == 1
+    assert 0 < out["delta_bytes"] < out["full_bytes"]
+    assert out["delta_s"] > 0 and out["full_s"] > 0
+
+
 def test_device_stats_bench_smoke_gate():
     """run_device_stats_bench on a toy cluster. The warm-recompile gate
     is ALWAYS on (deterministic at any scale: after one warmup optimize,

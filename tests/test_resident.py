@@ -1,0 +1,530 @@
+"""Resident device cluster state + proposal-freshness loop tests:
+
+- delta/full/noop parity property test — N cycles of delta ingest onto
+  the resident model vs a full host rebuild are BIT-IDENTICAL, including
+  the epoch-bump full-rebuild path (broker death, partition add);
+- ProposalCache freshness SLO unit behavior (age/lag gauges, breach
+  meter, refresh_once semantics);
+- the tier-1 resident-path gate: >=3 consecutive metric-only propose
+  cycles over the real HTTP stack report 0 compile events AND 0
+  full-model h2d uploads via /devicestats (extends PR 6's
+  zero-recompile gate);
+- chaos cross-check: the broker-kill scenario bumps the resident epoch,
+  the served model reflects the new topology (no stale resident arrays),
+  and the heal restores invariants.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.metricdef import partition_metric_def
+from cruise_control_tpu.executor import Executor, SimulatedKafkaCluster
+from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+
+WINDOW_MS = 1000
+
+#: every FlatClusterModel field — the parity tests compare all of them.
+MODEL_FIELDS = (
+    "replica_broker", "leader_load", "follower_load", "partition_topic",
+    "partition_valid", "replica_offline", "replica_pref_pos",
+    "broker_capacity", "broker_rack", "broker_host", "broker_set",
+    "broker_alive", "broker_new", "broker_demoted", "broker_broken_disk",
+    "broker_valid")
+
+
+def _assert_models_identical(a, b, what=""):
+    for f in MODEL_FIELDS:
+        av, bv = np.asarray(getattr(a, f)), np.asarray(getattr(b, f))
+        assert np.array_equal(av, bv), f"{what}: model.{f} diverged"
+
+
+def _build_sim(num_brokers=4, partitions=24):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    for p in range(partitions):
+        sim.add_partition(f"t{p % 3}", p,
+                          [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=10.0 + p)
+    return sim
+
+
+class _Feed:
+    """Deterministic dense sample feed shared by several monitors.
+
+    A metric-only cycle ingests the new value matrix into TWO windows so
+    the changed window rolls out of the in-flight slot (the aggregator
+    never serves the current window) and the change is visible to the
+    next model build.
+    """
+
+    def __init__(self, sim, monitors):
+        self.monitors = monitors
+        self.keys = sorted(sim.describe_partitions())
+        self.next_window = 0
+
+    def refresh_keys(self, sim):
+        self.keys = sorted(sim.describe_partitions())
+
+    def ingest(self, vals, windows=1):
+        P = len(self.keys)
+        for _ in range(windows):
+            times = np.full(P, self.next_window * WINDOW_MS + 100, np.int64)
+            for m in self.monitors:
+                m.partition_aggregator.add_samples_dense(
+                    self.keys, times, vals)
+            self.next_window += 1
+
+    @property
+    def now_ms(self):
+        return self.next_window * WINDOW_MS
+
+
+def _base_vals(P):
+    M = partition_metric_def().size()
+    # Small integers: window means over identical values are exact, so
+    # an unchanged partition produces a bit-identical load row — and the
+    # summed CPU load stays well inside the default broker capacity (the
+    # gate test's proposes are audited against the hard capacity goals).
+    return ((np.arange(P * M, dtype=np.float64).reshape(P, M) % 8) + 1.0)
+
+
+# ----------------------------------------------------- parity property test
+
+def test_resident_delta_parity_with_full_rebuild():
+    """N cycles of delta ingest onto the resident model vs a from-scratch
+    host rebuild: every model array bit-identical every cycle, including
+    structural epoch bumps (broker kill/restart, partition add) and the
+    post-bump return to the delta path."""
+    sim = _build_sim()
+    cfg = dict(num_windows=4, window_ms=WINDOW_MS, min_samples_per_window=1)
+    mon_r = LoadMonitor(sim, MonitorConfig(**cfg))
+    mon_f = LoadMonitor(sim, MonitorConfig(**cfg, resident_state=False))
+    feed = _Feed(sim, [mon_r, mon_f])
+    resident = mon_r.resident
+    assert resident is not None
+
+    P = len(feed.keys)
+    vals = _base_vals(P)
+    feed.ingest(vals, windows=4)
+
+    def build_and_compare(what):
+        r = mon_r.cluster_model(feed.now_ms)
+        f = mon_f.cluster_model(feed.now_ms)
+        _assert_models_identical(r.model, f.model, what)
+        assert r.metadata.partition_keys == f.metadata.partition_keys
+        return r
+
+    build_and_compare("initial full build")
+    assert resident.epoch == 1 and resident.last_update == "full"
+
+    # Metric-only cycles: a rotating sliver of partitions changes load.
+    rng = np.random.default_rng(7)
+    for cycle in range(3):
+        rows = rng.choice(P, size=3, replace=False)
+        vals = vals.copy()
+        vals[rows] += 1.0 + cycle
+        feed.ingest(vals, windows=2)
+        build_and_compare(f"delta cycle {cycle}")
+        assert resident.epoch == 1, "metric-only cycle bumped the epoch"
+        assert resident.last_update == "delta"
+        assert resident.last_delta_rows >= len(rows)
+
+    # Structural change #1: broker death -> epoch bump, full rebuild.
+    sim.kill_broker(1)
+    r = build_and_compare("post broker-kill rebuild")
+    assert resident.epoch == 2 and resident.last_update == "full"
+    dead_row = r.metadata.broker_index[1]
+    assert not bool(np.asarray(r.model.broker_alive)[dead_row])
+    sim.restart_broker(1)
+    build_and_compare("post broker-restart rebuild")
+    assert resident.epoch == 3
+
+    # Structural change #2: partition add (same padded shapes).
+    sim.add_partition("t0", P, [0, 2], size_mb=99.0)
+    feed.refresh_keys(sim)
+    vals = np.vstack([vals, _base_vals(P + 1)[-1:]])
+    feed.ingest(vals, windows=2)
+    build_and_compare("post partition-add rebuild")
+    assert resident.epoch == 4 and resident.last_update == "full"
+
+    # And back to the delta path after the bump.
+    vals = vals.copy()
+    vals[0] += 5.0
+    feed.ingest(vals, windows=2)
+    build_and_compare("delta after epoch bump")
+    assert resident.epoch == 4 and resident.last_update == "delta"
+
+
+def test_resident_noop_cycle_reuses_model_and_uploads_nothing():
+    """A rebuild with unchanged samples is a noop: same device model
+    object served, zero delta rows/bytes."""
+    sim = _build_sim()
+    mon = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                                         min_samples_per_window=1))
+    feed = _Feed(sim, [mon])
+    feed.ingest(_base_vals(len(feed.keys)), windows=4)
+    r1 = mon.cluster_model(feed.now_ms)
+    r2 = mon.cluster_model(feed.now_ms)
+    res = mon.resident
+    assert r2.model is r1.model
+    assert res.last_update == "noop" and res.noop_cycles == 1
+    assert res.last_delta_rows == 0 and res.last_delta_bytes == 0
+
+
+def test_placement_only_build_bypasses_resident_state():
+    """/load?capacity_only builds a placement-only model (zero load
+    planes): it must NOT touch the resident state — its zeros would
+    clobber the mirrors and turn the next real cycle into a full-size
+    'delta' (the same reason the monitor never caches placement-only
+    results as last-good)."""
+    sim = _build_sim()
+    mon = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                                         min_samples_per_window=1))
+    feed = _Feed(sim, [mon])
+    vals = _base_vals(len(feed.keys))
+    feed.ingest(vals, windows=4)
+    r1 = mon.cluster_model(feed.now_ms)
+    res = mon.resident
+    snap = dict(res.to_json())
+    placement = mon.cluster_model(feed.now_ms,
+                                  populate_replica_placement_only=True)
+    assert placement.model is not r1.model          # its own full build
+    assert dict(res.to_json()) == snap              # resident untouched
+    # The next real metric cycle is still a sliver-sized delta.
+    vals = vals.copy()
+    vals[7] += 2.0
+    feed.ingest(vals, windows=2)
+    mon.cluster_model(feed.now_ms)
+    assert res.last_update == "delta"
+    assert res.last_delta_rows == 1
+
+
+def test_resident_warmup_compiles_delta_bucket_ahead():
+    """warmup() pre-compiles the smallest delta bucket: the first real
+    delta cycle then dispatches with no compile event."""
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    sim = _build_sim()
+    mon = LoadMonitor(sim, MonitorConfig(num_windows=4, window_ms=WINDOW_MS,
+                                         min_samples_per_window=1))
+    feed = _Feed(sim, [mon])
+    vals = _base_vals(len(feed.keys))
+    feed.ingest(vals, windows=4)
+    assert mon.resident.warmup() is False     # nothing resident yet
+    mon.cluster_model(feed.now_ms)
+    assert mon.resident.warmup() is True
+    snap = default_collector().snapshot()
+    vals = vals.copy()
+    vals[3] += 2.0
+    feed.ingest(vals, windows=2)
+    mon.cluster_model(feed.now_ms)
+    after = default_collector().snapshot()
+    assert mon.resident.last_update == "delta"
+    assert after["compileEvents"] == snap["compileEvents"], (
+        "warmed delta bucket recompiled on the first real delta")
+
+
+# ------------------------------------------------ freshness SLO unit tests
+
+class _FakeModelResult:
+    model = None
+    metadata = None
+    stale = False
+    scenario_label = None
+
+
+class _FakeMonitor:
+    def __init__(self):
+        self.generation = 0
+
+    def cluster_model(self, now_ms):
+        return _FakeModelResult()
+
+
+class _FakeOptimizer:
+    def optimize(self, model, metadata, options):
+        return object()
+
+
+def test_proposal_freshness_age_lag_and_breach():
+    from cruise_control_tpu.api.precompute import ProposalCache
+    clock = {"ms": 1000}
+    cache = ProposalCache(_FakeMonitor(), _FakeOptimizer(),
+                          now_ms=lambda: clock["ms"])
+    cache.freshness_target_ms = 100
+    mon = cache.monitor
+
+    assert cache.freshness_age_ms() is None    # nothing cached yet
+    assert cache.refresh_once() is True        # first fill
+    assert cache.valid()
+    assert cache.freshness_age_ms() == 0 and cache.freshness_lag_ms() == 0
+
+    clock["ms"] = 1500
+    assert cache.freshness_age_ms() == 500     # result ages...
+    assert cache.freshness_lag_ms() == 0       # ...but still answers gen
+    assert cache.refresh_once() is False       # valid: no recompute
+
+    # Generation moves; recompute lands fast -> no breach.
+    mon.generation = 1
+    clock["ms"] = 1550
+    assert cache.refresh_once() is True
+    assert cache.freshness_json()["breaches"] == 0
+
+    # Generation moves, observed, recompute lands late -> ONE breach.
+    mon.generation = 2
+    cache.observe_generation()
+    clock["ms"] = 2500
+    assert cache.freshness_lag_ms() == 950
+    assert cache.refresh_once() is True
+    j = cache.freshness_json()
+    assert j["breaches"] == 1 and j["lagMs"] == 0 and j["valid"]
+    # The satellite gauge is on the scrape surface.
+    text = cache.registry.expose_text()
+    assert "cc_ProposalCache_freshness_age_ms" in text
+    assert "cc_ProposalCache_freshness_slo_breaches_total 1" in text
+
+
+def test_freshness_breach_marked_when_recompute_never_lands():
+    """A persistent compute failure is the worst freshness outage: the
+    tick itself must mark the breach (once per generation) when a
+    previously-warm cache's lag passes the target — the alerting meter
+    cannot stay flat just because no recompute ever landed."""
+    from cruise_control_tpu.api.precompute import ProposalCache
+    clock = {"ms": 1000}
+    mon = _FakeMonitor()
+    opt = _FakeOptimizer()
+    cache = ProposalCache(mon, opt, now_ms=lambda: clock["ms"])
+    cache.freshness_target_ms = 100
+    assert cache.refresh_once() is True        # warm fill
+    mon.generation = 1
+    opt.optimize = lambda *a: (_ for _ in ()).throw(RuntimeError("down"))
+    cache.observe_generation()
+    clock["ms"] = 1500                         # lag 500 > target 100
+    assert cache.refresh_once() is False       # compute fails...
+    assert cache.freshness_json()["breaches"] == 1   # ...breach marked
+    clock["ms"] = 2000
+    assert cache.refresh_once() is False
+    assert cache.freshness_json()["breaches"] == 1   # once per generation
+    mon.generation = 2
+    cache.observe_generation()
+    clock["ms"] = 3000
+    cache.refresh_once()
+    assert cache.freshness_json()["breaches"] == 2   # new generation
+
+
+def test_freshness_first_fill_is_not_a_breach():
+    """Startup warm-in (no prior cache) is exempt: that cost is what the
+    startup pre-warm hides, not an SLO violation."""
+    from cruise_control_tpu.api.precompute import ProposalCache
+    clock = {"ms": 0}
+    cache = ProposalCache(_FakeMonitor(), _FakeOptimizer(),
+                          now_ms=lambda: clock["ms"])
+    cache.freshness_target_ms = 10
+    cache.observe_generation()
+    clock["ms"] = 5000                         # way past target
+    assert cache.refresh_once() is True
+    assert cache.freshness_json()["breaches"] == 0
+
+
+# --------------------------------------- tier-1 resident-path gate (HTTP)
+
+@pytest.fixture(scope="module")
+def resident_stack():
+    """Full HTTP stack over the resident monitor with a mutable clock and
+    a deterministic sample feed. Shares the chaos suite's cached
+    optimizer so the goal chain compiles once per process."""
+    from cruise_control_tpu.api import CruiseControlApp, KafkaCruiseControl
+    from cruise_control_tpu.chaos.harness import default_optimizer
+    sim = _build_sim(4, 16)
+    monitor = LoadMonitor(sim, MonitorConfig(num_windows=4,
+                                             window_ms=WINDOW_MS,
+                                             min_samples_per_window=1))
+    feed = _Feed(sim, [monitor])
+    vals = _base_vals(len(feed.keys))
+    feed.ingest(vals, windows=4)
+    clock = {"ms": feed.now_ms}
+    facade = KafkaCruiseControl(sim, monitor,
+                                optimizer=default_optimizer(),
+                                executor=Executor(sim),
+                                now_ms=lambda: clock["ms"])
+    app = CruiseControlApp(facade, port=0)
+    app.start()
+    yield sim, facade, app, feed, clock, vals
+    app.stop()
+
+
+def _get_devicestats(app) -> dict:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/devicestats", timeout=60) as resp:
+        return json.loads(resp.read())
+
+
+def _propose(app) -> None:
+    from test_api import call
+    status, body, _ = call(
+        app, "POST", "rebalance",
+        "dryrun=true&ignore_proposal_cache=true&get_response_timeout_s=300")
+    assert status == 200, body
+
+
+def test_resident_metric_cycles_zero_compiles_zero_full_uploads(
+        resident_stack):
+    """THE tier-1 resident gate: after warmup, >=3 consecutive
+    METRIC-ONLY propose cycles on the resident path must report — via
+    /devicestats — 0 compile events AND 0 full-model uploads per cycle
+    (the cycle's h2d bytes are exactly the compact delta payload)."""
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    sim, facade, app, feed, clock, vals = resident_stack
+    resident = facade.monitor.resident
+    assert resident is not None
+
+    _propose(app)                  # warmup propose (may compile the chain)
+    assert resident.epoch == 1
+    resident.warmup()              # pre-compile the delta-ingest bucket
+    full_bytes = resident.last_full_bytes
+    assert full_bytes > 0
+    snap = default_collector().snapshot()
+    full_rebuilds_before = resident.full_rebuilds
+
+    for cycle in range(3):
+        # Metric-only change: two partitions' load moves, topology fixed.
+        vals = vals.copy()
+        vals[[2 + cycle, 9]] += 3.0
+        feed.ingest(vals, windows=2)
+        clock["ms"] = feed.now_ms
+        _propose(app)
+        stats = _get_devicestats(app)
+        resident_json = stats["resident"]
+        assert resident_json["lastUpdate"] == "delta", resident_json
+        assert resident_json["epoch"] == 1
+        assert resident_json["fullRebuilds"] == full_rebuilds_before
+        last = stats["transfers"]["lastCycle"]
+        assert last["compileEvents"] == 0, (
+            f"metric-only cycle {cycle} compiled: "
+            f"{stats['compile']['recentEvents'][-5:]}")
+        # The whole cycle's h2d is the delta payload — no full-model
+        # upload hid inside the cycle — and it is a fraction of a full
+        # upload even at toy scale.
+        assert last["h2dBytes"] == resident_json["lastDeltaBytes"]
+        assert 0 < last["h2dBytes"] < full_bytes
+    after = default_collector().snapshot()
+    assert after["compileEvents"] == snap["compileEvents"]
+    assert after["aotCompileEvents"] == snap["aotCompileEvents"]
+
+
+def test_devicestats_surfaces_resident_and_freshness(resident_stack):
+    """Satellite: /devicestats carries the resident section + proposal
+    freshness; /state mirrors them (DeviceStats substate + AnalyzerState
+    freshness fields); the plaintext renderer includes both."""
+    from test_api import call
+    sim, facade, app, feed, clock, vals = resident_stack
+    if facade.device_stats.last_cycle is None:
+        _propose(app)
+    stats = _get_devicestats(app)
+    assert stats["resident"]["epoch"] >= 1
+    assert set(stats["proposalFreshness"]) >= {
+        "valid", "ageMs", "lagMs", "targetMs", "computations", "breaches"}
+    status, body, _ = call(app, "GET", "state",
+                           "substates=analyzer,device_stats")
+    assert status == 200
+    assert body["DeviceStats"]["resident"]["epoch"] == \
+        stats["resident"]["epoch"]
+    assert "proposalFreshnessAgeMs" in body["AnalyzerState"]
+    assert "proposalFreshnessLagMs" in body["AnalyzerState"]
+    # Plaintext rendering of the new sections.
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/devicestats?json=false",
+            timeout=60) as resp:
+        text = resp.read().decode()
+    assert "resident state: epoch" in text
+    assert "proposal freshness:" in text
+
+
+def test_facade_prewarm_builds_and_warms(resident_stack):
+    """prewarm(): builds a model through the resident path and warms the
+    delta bucket + goal chain; repeated prewarm adds no compile events
+    (everything already warm)."""
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    sim, facade, app, feed, clock, vals = resident_stack
+    out = facade.prewarm()
+    assert out["status"] == "warmed"
+    snap = default_collector().snapshot()
+    out = facade.prewarm()                      # second warm: all cached
+    assert out["status"] == "warmed"
+    after = default_collector().snapshot()
+    assert after["compileEvents"] == snap["compileEvents"]
+
+
+# ------------------------------------------------------ chaos cross-check
+
+def test_chaos_broker_kill_bumps_epoch_no_stale_arrays():
+    """Chaos cross-check (tier-1 half): the broker-kill scenario through
+    the FULL wired stack bumps the resident epoch on the topology
+    change, the very next served model reflects the dead broker (no
+    stale resident arrays), and the restart bumps again with invariants
+    intact. Detection is held off so the scenario isolates the
+    monitor-side contract; the heal-through-resident-path variant below
+    is ``slow`` (every test_chaos heal already drives the resident path
+    — it is on by default — so tier-1 pays the expensive healing-fix
+    optimizer only once, in that suite)."""
+    from cruise_control_tpu.chaos import (ChaosHarness, check_invariants,
+                                          snapshot_topology)
+    h = ChaosHarness(seed=23)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    resident = h.monitor.resident
+    assert resident is not None and resident.epoch >= 1
+    epoch0 = resident.epoch
+    s0 = h.engine.step
+    h.engine.schedule(s0 + 1, "kill_broker", broker=1)
+    h.engine.schedule(s0 + 3, "restart_broker", broker=1)
+    for _ in range(2):
+        h.step(detect=False)
+    assert not h.sim.describe_cluster().get(1, True)
+    # The very next model build must full-rebuild: the resident arrays
+    # now describe a topology that no longer exists.
+    res = h.monitor.cluster_model(h.engine.now_ms())
+    assert resident.epoch > epoch0, "broker kill did not bump the epoch"
+    assert resident.last_update == "full"
+    dead_row = res.metadata.broker_index[1]
+    assert not bool(np.asarray(res.model.broker_alive)[dead_row]), (
+        "resident model served stale broker_alive after topology change")
+    epoch_dead = resident.epoch
+    for _ in range(3):
+        h.step(detect=False)
+    assert h.sim.describe_cluster().get(1, False)
+    res = h.monitor.cluster_model(h.engine.now_ms())
+    assert resident.epoch > epoch_dead, "restart did not bump the epoch"
+    alive_row = res.metadata.broker_index[1]
+    assert bool(np.asarray(res.model.broker_alive)[alive_row])
+    problems = check_invariants(h.sim, base, h.executor)
+    assert not problems, problems
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_broker_kill_heals_through_resident_path():
+    """Chaos cross-check (full): broker kill + restart with detection and
+    self-healing ON — the epoch bumps on the topology change and the
+    heal (whose replans are computed from resident-path models) restores
+    all invariants."""
+    from cruise_control_tpu.chaos import (ChaosHarness, check_invariants,
+                                          snapshot_topology)
+    h = ChaosHarness(seed=23)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    resident = h.monitor.resident
+    epoch0 = resident.epoch
+    s0 = h.engine.step
+    h.engine.schedule(s0 + 2, "kill_broker", broker=1)
+    h.engine.schedule(s0 + 9, "restart_broker", broker=1)
+    h.steps_until(lambda: not h.sim.describe_cluster().get(1, True), 20,
+                  what="scheduled broker kill")
+    h.monitor.cluster_model(h.engine.now_ms())
+    assert resident.epoch > epoch0
+    h.steps_until(h.healed, 200, what="post-crash recovery")
+    problems = check_invariants(h.sim, base, h.executor)
+    assert not problems, problems
