@@ -75,7 +75,7 @@ def log(*args):
 
 
 def emit(metric: str, value, unit: str, vs_baseline, *, vs_target=None,
-         vs_greedy=None) -> None:
+         vs_greedy=None, mesh_devices=None) -> None:
     """The one JSON line the driver records. ``platform`` self-certifies
     where the number was measured (tpu vs cpu fallback) so a BENCH artifact
     can never silently pass off a fallback run as a TPU result.
@@ -97,6 +97,11 @@ def emit(metric: str, value, unit: str, vs_baseline, *, vs_target=None,
         row["vs_target"] = vs_target
     if vs_greedy is not None:
         row["vs_greedy"] = vs_greedy
+    if mesh_devices is not None:
+        # Scale-tier rows: 0 = unsharded, N = N-way partition-axis mesh
+        # (sharded and unsharded captures of one metric must never read
+        # as the same series).
+        row["mesh_devices"] = mesh_devices
     print(json.dumps(row), flush=True)
 
 
@@ -682,13 +687,17 @@ def residual(util, counts, nb, threshold=1.10):
 
 
 def build_flat_direct(num_brokers: int, num_partitions: int, rf: int,
-                      seed: int = 42, place_on: int | None = None):
+                      seed: int = 42, place_on: int | None = None,
+                      mesh=None, return_arrays: bool = False):
     """Array-native model construction for the scale scenarios — no
     per-partition Python objects (1M PartitionSpecs would dominate the
     run). Skewed like build_spec: half the partitions crowd 20% of brokers.
     ``place_on`` restricts the initial placement to the first N brokers
-    (the add-brokers variant: the rest exist empty and NEW)."""
-    import jax.numpy as jnp
+    (the add-brokers variant: the rest exist empty and NEW). ``mesh``
+    uploads the model as partition-axis shards (from_numpy(mesh=...) —
+    the sharded full-rebuild path the 10Kx1M tier measures);
+    ``return_arrays`` additionally hands back the host arrays so callers
+    can re-measure the upload in isolation."""
     from cruise_control_tpu.model.flat import FlatClusterModel
     from cruise_control_tpu.model.spec import ClusterMetadata, _round_up
     rng = np.random.default_rng(seed)
@@ -717,25 +726,24 @@ def build_flat_direct(num_brokers: int, num_partitions: int, rf: int,
     num_topics = max(P // 500, 1)
     ptopic = np.full(Ppad, -1, np.int32)
     ptopic[:P] = np.arange(P) % num_topics
-    model = FlatClusterModel(
-        replica_broker=jnp.asarray(rb),
-        leader_load=jnp.asarray(lead), follower_load=jnp.asarray(foll),
-        partition_topic=jnp.asarray(ptopic),
-        partition_valid=jnp.asarray(np.arange(Ppad) < P),
-        replica_offline=jnp.zeros((Ppad, rf), bool),
-        replica_pref_pos=jnp.asarray(
-            np.tile(np.arange(rf, dtype=np.int32), (Ppad, 1))),
-        broker_capacity=jnp.asarray(np.tile(
-            np.array([100.0, 1e6, 1e6, 1e8], np.float32), (Bpad, 1))),
-        broker_rack=jnp.asarray((np.arange(Bpad) % max(B // 10, 1)
-                                 ).astype(np.int32)),
-        broker_host=jnp.asarray(np.arange(Bpad, dtype=np.int32)),
-        broker_set=jnp.full((Bpad,), -1, jnp.int32),
-        broker_alive=jnp.asarray(np.arange(Bpad) < B),
-        broker_new=jnp.zeros((Bpad,), bool),
-        broker_demoted=jnp.zeros((Bpad,), bool),
-        broker_broken_disk=jnp.zeros((Bpad,), bool),
-        broker_valid=jnp.asarray(np.arange(Bpad) < B))
+    arrays = dict(
+        replica_broker=rb,
+        leader_load=lead, follower_load=foll,
+        partition_topic=ptopic,
+        partition_valid=np.arange(Ppad) < P,
+        replica_offline=np.zeros((Ppad, rf), bool),
+        replica_pref_pos=np.tile(np.arange(rf, dtype=np.int32), (Ppad, 1)),
+        broker_capacity=np.tile(
+            np.array([100.0, 1e6, 1e6, 1e8], np.float32), (Bpad, 1)),
+        broker_rack=(np.arange(Bpad) % max(B // 10, 1)).astype(np.int32),
+        broker_host=np.arange(Bpad, dtype=np.int32),
+        broker_set=np.full((Bpad,), -1, np.int32),
+        broker_alive=np.arange(Bpad) < B,
+        broker_new=np.zeros((Bpad,), bool),
+        broker_demoted=np.zeros((Bpad,), bool),
+        broker_broken_disk=np.zeros((Bpad,), bool),
+        broker_valid=np.arange(Bpad) < B)
+    model = FlatClusterModel.from_numpy(mesh=mesh, **arrays)
     topics = [f"t{i}" for i in range(num_topics)]
     keys = [(topics[i % num_topics], i) for i in range(P)]
     metadata = ClusterMetadata(
@@ -746,29 +754,46 @@ def build_flat_direct(num_brokers: int, num_partitions: int, rf: int,
         partition_index={k: i for i, k in enumerate(keys)},
         racks=[f"r{i}" for i in range(max(B // 10, 1))],
         hosts=[f"h{i}" for i in range(B)], broker_sets=[])
+    if return_arrays:
+        return model, metadata, arrays
     return model, metadata
 
 
 def _make_mesh(n: int):
-    """Build an n-device mesh for the optimizer (0/absent -> no mesh).
-    On the single real TPU chip this is a 1-device mesh (a no-op layout);
-    correctness of the >1-device path is covered on the virtual 8-CPU mesh
-    (tests/test_parallel.py + dryrun_multichip)."""
+    """Build an n-device mesh for the optimizer (0/absent -> no mesh,
+    -1 -> all visible devices, matching search.mesh.devices). On the
+    single real TPU chip this is a 1-device mesh (a no-op layout);
+    correctness of the >1-device path is covered on the virtual 8-CPU
+    mesh (tests/test_parallel.py + dryrun_multichip)."""
     if not n:
         return None
     import jax
-    from cruise_control_tpu.parallel import make_mesh
-    n = min(n, len(jax.devices()))
-    mesh = make_mesh(n)
+    from cruise_control_tpu.parallel import make_mesh, resolve_mesh_devices
+    mesh = make_mesh(resolve_mesh_devices(n))
     log(f"  mesh: {dict(mesh.shape)} over {mesh.devices.size} "
         f"{jax.devices()[0].platform} device(s)")
     return mesh
 
 
+#: padding-waste gate at the scale tiers (%): multiple-of-128 partitions
+#: + multiple-of-8 brokers sit well under this at 10Kx1M (~0.006% /
+#: 0%); the gate exists so a pad-bucketing regression (e.g. a
+#: power-of-two floor, near-2x HBM at 1M partitions) fails the tier
+#: loudly instead of silently doubling device memory.
+SCALE_PADDING_BUDGET_PCT = 10.0
+
+
 def run_scale_scenario(n: int, mesh_devices: int = 0,
-                       variant: str = "rebalance"):
-    """Scenario #3/#4: wall-clock of a full proposal computation at scale,
-    plus the dense-ingest throughput feeding it.
+                       variant: str = "rebalance", *,
+                       brokers: int | None = None,
+                       partitions: int | None = None) -> dict:
+    """Scenario #3/#4 — the GATED scale tier: wall-clock of a full
+    proposal computation at scale, the dense-ingest throughput feeding
+    it, and the device-runtime rows (warm-cycle h2d/d2h bytes, sharded
+    full-rebuild upload bytes, padding waste, peak device memory) with
+    the padding/HBM budgets asserted. Always emitted: every scenario-3/4
+    run carries the full row set (tpu_watch.sh records them into
+    TPU_RESULTS.md / MULTICHIP artifacts).
 
     ``variant`` (BASELINE.md row 4 names the add/remove-broker scenarios):
 
@@ -784,18 +809,31 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
       per-proposal contract, GoalOptimizer.java:458-497 +
       config/cruisecontrol.properties:96) with nothing waived: the
       north-star scale at the reference's full problem statement.
+
+    ``brokers``/``partitions`` override the scenario's scale (the
+    tier-gate smoke test runs the identical code path at a CI-sized
+    cluster; the emitted metric names keep the scenario's canonical
+    scale label so dashboards never mix scales).
     """
     from cruise_control_tpu.analyzer import (OptimizationOptions,
                                              SearchConfig, TpuGoalOptimizer,
                                              goals_by_name)
     from cruise_control_tpu.core.aggregator import MetricSampleAggregator
     from cruise_control_tpu.core.metricdef import partition_metric_def
-    cfgd = SCALE_SCENARIOS[n]
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    cfgd = dict(SCALE_SCENARIOS[n])
+    if brokers is not None:
+        cfgd["brokers"] = brokers
+    if partitions is not None:
+        cfgd["partitions"] = partitions
+    mesh = _make_mesh(mesh_devices)
+    collector = default_collector()
     t0 = time.monotonic()
     B = cfgd["brokers"]
     n_new = max(B // 20, 1) if variant == "add_brokers" else 0
-    model, md = build_flat_direct(B, cfgd["partitions"], cfgd["rf"],
-                                  place_on=(B - n_new) or None)
+    model, md, host_arrays = build_flat_direct(
+        B, cfgd["partitions"], cfgd["rf"], place_on=(B - n_new) or None,
+        mesh=mesh, return_arrays=True)
     if variant == "add_brokers":
         import jax.numpy as jnp
         new_mask = np.zeros(model.num_brokers_padded, bool)
@@ -851,7 +889,7 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
     if variant == "fullchain" and "fullchain_swaps" in cfgd:
         cfg_kw["num_swap_candidates"] = cfgd["fullchain_swaps"]
     opt = TpuGoalOptimizer(goals=goals, config=SearchConfig(**cfg_kw),
-                           mesh=_make_mesh(mesh_devices))
+                           mesh=mesh)
     t0 = time.monotonic()
     res_cold = opt.optimize(model, md, OptimizationOptions(
         seed=0, waived_hard_goals=waive))
@@ -860,6 +898,9 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
     res = opt.optimize(model, md, OptimizationOptions(
         seed=1, waived_hard_goals=waive))
     warm = time.monotonic() - t0
+    # The optimizer brackets itself in a collector cycle, so lastCycle
+    # is the warm run's h2d/d2h/compile delta (no extra syncs).
+    warm_cycle = dict(collector.last_cycle or {})
     log(f"  search: cold {cold:.1f}s warm {warm:.1f}s "
         f"moves={res.num_moves} proposals={len(res.proposals)}")
     for g in res.goal_results:
@@ -872,10 +913,101 @@ def run_scale_scenario(n: int, mesh_devices: int = 0,
             f"{'ok' if g.satisfied else 'VIOLATED'}")
     if waive:
         log(f"  waived hard-goal audits: {sorted(waive)}")
+
+    # Padding + memory are read BEFORE the isolated re-upload below: the
+    # gate must measure the SERVING footprint, not the bench artifact's
+    # temporary second model copy.
+    padding = collector.padding_from_model(model)
+    memory = collector.memory_snapshot()
+    # Per-device peak: the HBM budget is one device's capacity (the
+    # allocator peak is already per-device; the live fallback's
+    # peakDeviceLiveBytes is the worst single device).
+    peak_bytes = (memory.get("allocatorPeakBytes")
+                  or memory.get("peakDeviceLiveBytes") or 0)
+
+    # Budget gates (the tier is GATED, not just reported): padding waste
+    # against the tier budget always (worst of the partition/broker
+    # axes, same rule as DeviceStatsCollector.budget_status); peak
+    # memory when a budget is configured (CC_BENCH_HBM_BUDGET_BYTES —
+    # on-chip captures set it to the HBM size, CPU hosts have no
+    # meaningful ceiling). Computed locally — the serving collector's
+    # configured budgets stay untouched.
+    import os
+    hbm_budget = int(os.environ.get("CC_BENCH_HBM_BUDGET_BYTES", "0"))
+    worst_waste = max(padding["partitionWastePct"],
+                      padding["brokerWastePct"])
+    status = {"paddingWastePct": worst_waste,
+              "paddingWasteBudgetPct": SCALE_PADDING_BUDGET_PCT,
+              "peakBytes": peak_bytes,
+              "hbmBudgetBytes": hbm_budget or None,
+              "paddingOverBudget": worst_waste > SCALE_PADDING_BUDGET_PCT,
+              "hbmOverBudget": bool(hbm_budget
+                                    and peak_bytes > hbm_budget)}
+
+    # Full-rebuild upload, measured in isolation (after the memory
+    # READING above — this temporarily doubles model residency): the h2d
+    # bytes and wall clock of shipping the whole model host->device
+    # (per-device SHARDS under a mesh — the monolithic-upload bottleneck
+    # this tier watches).
+    snap = collector.snapshot()
+    t0 = time.monotonic()
+    from cruise_control_tpu.model.flat import FlatClusterModel
+    import jax as _jax
+    # Block on the WHOLE model pytree: transfers are async, and the big
+    # float load planes would otherwise still be streaming when the
+    # clock stops.
+    _jax.block_until_ready(
+        FlatClusterModel.from_numpy(mesh=mesh, **host_arrays))
+    rebuild_upload_s = time.monotonic() - t0
+    rebuild_h2d = collector.snapshot()["h2dBytes"] - snap["h2dBytes"]
+    n_mesh = 0 if mesh is None else int(mesh.devices.size)
+    log(f"  device: warm-cycle h2d {warm_cycle.get('h2dBytes')} d2h "
+        f"{warm_cycle.get('d2hBytes')} bytes; full-rebuild upload "
+        f"{rebuild_h2d} bytes in {rebuild_upload_s:.2f}s"
+        + (f" ({n_mesh}-way sharded)" if mesh is not None
+           else " (unsharded)")
+        + f"; padding waste {padding['partitionWastePct']}% partitions / "
+        f"{padding['brokerWastePct']}% brokers; peak mem {peak_bytes} "
+        f"bytes ({memory['source']})")
+
     metric = cfgd["metric"] + ("" if variant == "rebalance"
                                else f"_{variant}")
+    scale_tag = metric.rsplit("wall_clock_", 1)[-1]
     vs_target = round(cfgd["target_s"] / warm, 3) if warm > 0 else None
-    emit(metric, round(warm, 3), "s", vs_target, vs_target=vs_target)
+    # Every tier row carries mesh_devices so sharded (4::-1) and
+    # unsharded captures of the same metric stay distinguishable in
+    # TPU_RESULTS.md / dashboards.
+    emit(metric, round(warm, 3), "s", vs_target, vs_target=vs_target,
+         mesh_devices=n_mesh)
+    emit(f"h2d_bytes_per_cycle_{scale_tag}",
+         warm_cycle.get("h2dBytes"), "bytes", None, mesh_devices=n_mesh)
+    emit(f"full_rebuild_h2d_bytes_{scale_tag}", rebuild_h2d, "bytes",
+         None, mesh_devices=n_mesh)
+    # The row records the GATED quantity (worst axis) so the captured
+    # series can actually show a budget regression coming.
+    emit(f"padding_waste_pct_{scale_tag}", worst_waste, "%", None,
+         mesh_devices=n_mesh)
+    emit(f"peak_hbm_bytes_{scale_tag}", peak_bytes, "bytes", None,
+         mesh_devices=n_mesh)
+    # Gates raise AFTER the rows are out: a breach run must still land
+    # its data points in the capture (the regression the series exists
+    # to show), and a failing exit code still fails the tier.
+    if status["paddingOverBudget"]:
+        raise RuntimeError(
+            f"scale-tier padding gate: waste {worst_waste}% exceeds the "
+            f"{SCALE_PADDING_BUDGET_PCT}% budget — check the "
+            "model.*.pad.multiple knobs (docs/scaling.md)")
+    if status["hbmOverBudget"]:
+        raise RuntimeError(
+            f"scale-tier memory gate: peak {peak_bytes} bytes "
+            f"exceeds the {hbm_budget}-byte budget — shard the model "
+            "(search.mesh.devices) or trim windows (docs/scaling.md "
+            "degrade path)")
+    return {"cold_s": cold, "warm_s": warm, "vs_target": vs_target,
+            "warm_cycle": warm_cycle, "rebuild_h2d": rebuild_h2d,
+            "rebuild_upload_s": rebuild_upload_s, "padding": padding,
+            "peak_bytes": peak_bytes, "budget": status,
+            "moves": res.num_moves, "mesh_devices": n_mesh}
 
 
 def run_replan_scenario(num_requests: int = 30, mesh_devices: int = 0):
@@ -991,7 +1123,8 @@ def main():
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
-                         "(clamped to available devices; 0 = unsharded)")
+                         "(clamped to available devices; 0 = unsharded, "
+                         "-1 = all visible devices)")
     ap.add_argument("--variant", default="rebalance",
                     choices=("rebalance", "add_brokers", "remove_brokers",
                              "fullchain"),

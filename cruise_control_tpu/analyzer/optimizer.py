@@ -284,8 +284,8 @@ class TpuGoalOptimizer:
         # Mesh identity in the key: the same chain object jit-caches per
         # input sharding, but warmup events are keyed by *shape* signature
         # — a chain warmed unsharded must not satisfy a sharded warmup.
-        mesh_key = (None if self.mesh is None
-                    else tuple(str(d) for d in self.mesh.devices.flat))
+        from ..parallel.sharding import mesh_fingerprint
+        mesh_key = mesh_fingerprint(self.mesh)
         key = _shared_chain_key(cfg, goals, mesh_key)
         # Locked get-or-create against the PROCESS-WIDE registry:
         # optimizers are shared across request threads (facade

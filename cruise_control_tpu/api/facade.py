@@ -89,10 +89,17 @@ class KafkaCruiseControl:
         #: the SAME goal chain the optimizer serves — /simulate and the
         #: resilience detector share its compiled sweep programs.
         from ..whatif import WhatIfEngine
-        self.whatif = WhatIfEngine(goals=self.optimizer.goals,
-                                   constraint=self.optimizer.constraint,
-                                   tracer=self.optimizer.tracer,
-                                   collector=self.optimizer.collector)
+        self.whatif = WhatIfEngine(
+            goals=self.optimizer.goals,
+            constraint=self.optimizer.constraint,
+            tracer=self.optimizer.tracer,
+            collector=self.optimizer.collector,
+            mesh=self.optimizer.mesh,
+            # Scenario re-pads must land on the same shape buckets the
+            # monitor builds with, or BrokerAdd/TopicAdd growth compiles
+            # off-bucket sweep variants.
+            partition_pad_multiple=monitor.config.partition_pad_multiple,
+            broker_pad_multiple=monitor.config.broker_pad_multiple)
         # Shared with the metrics processor so a TRAIN-fitted regression
         # feeds CPU estimation for samples that lack broker CPU.
         self.cpu_model = cpu_model or LinearRegressionModelParameters()

@@ -310,6 +310,23 @@ _SCHEMAS = {
                                       "backend path (device_memory_stats "
                                       "on TPU/GPU, live_arrays on CPU)"},
             "padding": {"type": "object", "nullable": True},
+            "budget": {
+                "type": "object",
+                "description": "standing against the configured "
+                               "padding/HBM budgets "
+                               "(device.padding.waste.budget.pct / "
+                               "device.hbm.budget.bytes; docs/scaling.md)",
+                "properties": {
+                    "paddingWastePct": {"type": "number",
+                                        "nullable": True},
+                    "paddingWasteBudgetPct": {"type": "number",
+                                              "nullable": True},
+                    "peakBytes": {"type": "integer", "nullable": True},
+                    "hbmBudgetBytes": {"type": "integer",
+                                       "nullable": True},
+                    "paddingOverBudget": {"type": "boolean"},
+                    "hbmOverBudget": {"type": "boolean"},
+                }},
             "resident": {
                 "type": "object", "nullable": True,
                 "description": "device-resident model state "

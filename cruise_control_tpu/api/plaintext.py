@@ -180,6 +180,21 @@ def _render_devicestats(payload: dict) -> str:
                  f"{padding.get('partitionsPadded')}), brokers "
                  f"{padding.get('brokerWastePct')}%, replica slots "
                  f"{padding.get('replicaSlotWastePct', '-')}%")
+    budget = payload.get("budget")
+    if budget and (budget.get("paddingWasteBudgetPct") is not None
+                   or budget.get("hbmBudgetBytes") is not None):
+        flags = [name for name, key in
+                 (("PADDING-OVER-BUDGET", "paddingOverBudget"),
+                  ("HBM-OVER-BUDGET", "hbmOverBudget"))
+                 if budget.get(key)]
+        def _or_dash(key):
+            v = budget.get(key)
+            return "-" if v is None else v
+        text += (f"\nbudget: padding {_or_dash('paddingWastePct')}% / "
+                 f"{_or_dash('paddingWasteBudgetPct')}%, peak "
+                 f"{_or_dash('peakBytes')} / "
+                 f"{_or_dash('hbmBudgetBytes')} bytes"
+                 + (f"  ** {' '.join(flags)} **" if flags else "  ok"))
     resident = payload.get("resident")
     if resident:
         text += (f"\nresident state: epoch {resident.get('epoch')} "

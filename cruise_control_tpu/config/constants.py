@@ -56,6 +56,34 @@ def _monitor_defs(d: ConfigDef) -> None:
                  "scatters (model/resident.py); structural changes bump "
                  "the resident epoch and fall back to a full "
                  "rebuild+upload. Requires monitor.dense.pipeline.")
+    d.define("model.partition.pad.multiple", ConfigType.INT, 128,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Pad the flat model's partition axis to the next "
+                 "multiple of this. Coarser multiples mean fewer "
+                 "recompiles under partition churn but more padded-row "
+                 "HBM waste (a power-of-two bucket at 1M partitions can "
+                 "waste near 2x); the padding-waste budget watches the "
+                 "outcome. See docs/scaling.md.")
+    d.define("model.broker.pad.multiple", ConfigType.INT, 8,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Pad the flat model's broker axis to the next multiple "
+                 "of this.")
+    d.define("device.padding.waste.budget.pct", ConfigType.DOUBLE, 0.0,
+             validator=Range.between(0.0, 100.0), importance=Importance.LOW,
+             doc="Padding-waste budget (%): when the worst of the "
+                 "partition/broker-axis waste ratios exceeds this, the "
+                 "device-stats collector warns and /devicestats flags "
+                 "paddingOverBudget; the 10Kx1M bench tier fails on it. "
+                 "0 = unenforced (small demo clusters legitimately pad "
+                 "heavily).")
+    d.define("device.hbm.budget.bytes", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Peak device-memory budget in bytes (0 = unenforced): "
+                 "peak live bytes above this flag hbmOverBudget on "
+                 "/devicestats and fail the 10Kx1M bench tier. When the "
+                 "model cannot fit one device, shard it "
+                 "(search.mesh.devices) — degrade path in "
+                 "docs/scaling.md.")
     d.define("monitor.serve.stale.on.incomplete", ConfigType.BOOLEAN, True,
              importance=Importance.LOW,
              doc="When sample dropouts push the window history below "
@@ -267,12 +295,16 @@ def _analyzer_defs(d: ConfigDef) -> None:
              validator=Range.at_least(1), importance=Importance.LOW,
              doc="Iteration cap per goal pass")
     d.define("search.mesh.devices", ConfigType.INT, 0,
-             validator=Range.at_least(0), importance=Importance.LOW,
-             doc="Shard the optimizer over an N-device jax.sharding.Mesh "
-                 "(partition axis sharded, broker axis replicated). 0 = "
-                 "unsharded; N is clamped to the devices jax exposes. On "
-                 "multi-chip TPU hosts this puts the goal search's "
-                 "per-iteration broker aggregates on ICI all-reduces.")
+             validator=Range.at_least(-1), importance=Importance.LOW,
+             doc="Shard the device programs (optimizer walk, what-if "
+                 "sweep, resident model upload, hard-goal audit) over an "
+                 "N-device jax.sharding.Mesh (partition axis sharded, "
+                 "broker axis replicated). 0 = unsharded; -1 = all "
+                 "visible devices; N is clamped to the devices jax "
+                 "exposes. On multi-chip TPU hosts this puts the "
+                 "per-iteration broker aggregates on ICI all-reduces. "
+                 "Mutually exclusive with search.branches "
+                 "(docs/scaling.md has sizing guidance).")
     d.define("search.branches", ConfigType.INT, 0,
              validator=Range.at_least(0), importance=Importance.LOW,
              doc="Best-of-N independent search branches over the local "
@@ -846,6 +878,34 @@ class CruiseControlConfig(AbstractConfig):
     def __init__(self, props):
         super().__init__(cruise_control_config_def(), props,
                          allow_unknown=True)
+        self._sanity_check_cross_keys()
+
+    def _sanity_check_cross_keys(self) -> None:
+        """Cross-key validation at PARSE time (ref the reference's
+        KafkaCruiseControlConfig sanityCheck* methods): a conflicting
+        properties file must fail at startup with an actionable message,
+        not deep inside the first optimizer construction."""
+        from ..core.config import ConfigException
+        branches = self.get_int("search.branches")
+        mesh = self.get_int("search.mesh.devices")
+        if branches > 1 and mesh != 0:
+            raise ConfigException(
+                "search.branches and search.mesh.devices are mutually "
+                "exclusive: branches replicate the model per device "
+                "(best-of-N independent searches), the mesh shards one "
+                f"model across devices. Got search.branches={branches}, "
+                f"search.mesh.devices={mesh} — unset one of them "
+                "(docs/scaling.md explains when each wins).")
+        # Even sharding: every padded partition count is a multiple of
+        # the pad multiple, so the multiple itself must divide by the
+        # mesh device count. mesh == -1 (all devices) re-checks at
+        # startup when the count is known (serve.build_app).
+        if mesh > 0:
+            from ..model.spec import check_even_sharding
+            check_even_sharding(
+                self.get_int("model.partition.pad.multiple"), mesh,
+                what="model.partition.pad.multiple",
+                exc=ConfigException)
 
     # ---------------------------------------------------- subsystem views
     def monitor_config(self) -> MonitorConfig:
@@ -870,7 +930,10 @@ class CruiseControlConfig(AbstractConfig):
                 "monitor.serve.stale.on.incomplete"),
             max_stale_model_age_ms=self.get_int(
                 "monitor.max.stale.model.age.ms"),
-            resident_state=self.get_boolean("monitor.resident.state"))
+            resident_state=self.get_boolean("monitor.resident.state"),
+            partition_pad_multiple=self.get_int(
+                "model.partition.pad.multiple"),
+            broker_pad_multiple=self.get_int("model.broker.pad.multiple"))
 
     def balancing_constraint(self) -> BalancingConstraint:
         return BalancingConstraint(

@@ -142,12 +142,20 @@ def _install_listeners() -> None:
 # --------------------------------------------------------------------------
 
 def shape_key(*trees) -> tuple:
-    """Hashable (shape, dtype) signature over the pytree leaves — the same
-    bucket notion the engine's warmup events key on. Metadata only: never
-    touches device buffers."""
+    """Hashable (shape, dtype, sharding) signature over the pytree leaves
+    — the same bucket notion the engine's warmup events key on. Metadata
+    only: never touches device buffers.
+
+    Sharding is part of the bucket: jit specializes per input layout, so
+    dispatching the same shapes under a different mesh (or device count)
+    genuinely compiles a NEW executable — without the sharding in the
+    key that compile would be misclassified as an alarming
+    ``signature-change`` recompile of an already-compiled bucket. Host
+    numpy leaves (no ``sharding``) key as None."""
     import jax
     return tuple((tuple(getattr(x, "shape", ())),
-                  str(getattr(x, "dtype", type(x).__name__)))
+                  str(getattr(x, "dtype", type(x).__name__)),
+                  getattr(x, "sharding", None))
                  for x in jax.tree_util.tree_leaves(trees))
 
 
@@ -158,16 +166,34 @@ def bucket_label(key: tuple) -> str:
     return f"leaves{len(key)}-{abs(hash(key)) % 0xFFFFFF:06x}"
 
 
+def device_bytes(leaf) -> int:
+    """Actual allocated bytes for one array (metadata read, no sync).
+
+    For a sharded ``jax.Array``, ``.nbytes`` reports the GLOBAL logical
+    size — as if every device held the whole thing — which is wrong in
+    both directions under a mesh: a partition-sharded [P, 4] plane costs
+    each device only 1/Nth of it, while a replicated [B, 4] aggregate
+    costs N whole copies. Summing the addressable shards' sizes reports
+    what the allocator actually holds (sharded -> logical total split
+    across devices, replicated -> N x logical). Host numpy arrays fall
+    through to plain ``nbytes``."""
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is not None:
+        try:
+            return sum(int(s.data.nbytes) for s in shards)
+        except Exception:  # pragma: no cover — deleted/donated buffers
+            pass
+    nbytes = getattr(leaf, "nbytes", None)
+    return int(nbytes) if nbytes is not None else 0
+
+
 def tree_bytes(tree) -> int:
-    """Total ``nbytes`` over the pytree leaves (host numpy or device
-    arrays; metadata read, no sync)."""
+    """Total actual bytes over the pytree leaves (host numpy or device
+    arrays; metadata read, no sync). Device leaves are counted at their
+    addressable-shard sizes — see :func:`device_bytes`."""
     import jax
-    total = 0
-    for leaf in jax.tree_util.tree_leaves(tree):
-        nbytes = getattr(leaf, "nbytes", None)
-        if nbytes is not None:
-            total += int(nbytes)
-    return total
+    return sum(device_bytes(leaf)
+               for leaf in jax.tree_util.tree_leaves(tree))
 
 
 class CompileEvent:
@@ -339,6 +365,22 @@ class DeviceStatsCollector:
         self._last_cycle: dict | None = None
         self._padding: dict | None = None
         self._peak_live_bytes = 0
+        #: high-water allocator peak (bytes_in_use peaks include XLA
+        #: scratch the live-arrays sum cannot see) — the budget gate
+        #: compares against the worst of the per-device peaks.
+        self._peak_alloc_bytes = 0
+        #: high-water PER-DEVICE live bytes (max over devices of the
+        #: bytes its addressable shards hold). The HBM budget is a
+        #: per-device quantity: an N-way-sharded model's cross-device
+        #: total never shrinks under sharding, so gating on the total
+        #: would flag models that fit each device comfortably.
+        self._peak_device_live_bytes = 0
+        #: configured budgets (None = unenforced): padding waste as a
+        #: max pct over the observed axes, device memory as peak bytes.
+        #: serve.py wires them from device.padding.waste.budget.pct /
+        #: device.hbm.budget.bytes; the 10Kx1M bench tier asserts them.
+        self._padding_budget_pct: float | None = None
+        self._hbm_budget_bytes: int | None = None
         name = MetricRegistry.name
         g = DEVICE_RUNTIME_SENSOR
         self._compile_counter = self.registry.counter(
@@ -491,8 +533,9 @@ class DeviceStatsCollector:
             self._d2h_bytes += int(nbytes)
         self._d2h_counter.inc(int(nbytes))
 
-    #: staticmethod re-export so call sites need only the collector.
+    #: staticmethod re-exports so call sites need only the collector.
     tree_bytes = staticmethod(tree_bytes)
+    device_bytes = staticmethod(device_bytes)
 
     @contextlib.contextmanager
     def cycle(self, label: str = "propose"):
@@ -543,11 +586,42 @@ class DeviceStatsCollector:
         live = peak_alloc = in_use = None
         source = "unavailable"
         num_live = None
+        device_live = None
         try:
             import jax
             arrays = jax.live_arrays()
             num_live = len(arrays)
-            live = sum(int(a.nbytes) for a in arrays)
+            # Addressable-shard sizes, not logical nbytes: under a mesh a
+            # replicated array really holds N copies and a sharded one
+            # 1/Nth per device — see device_bytes. Per-device buckets as
+            # well: the HBM budget compares against the WORST single
+            # device, not the cross-device total (which sharding never
+            # shrinks).
+            live = 0
+            per_device: dict = {}
+            for a in arrays:
+                shards = getattr(a, "addressable_shards", None)
+                if shards is None:
+                    live += device_bytes(a)
+                    continue
+                try:
+                    arr_per_device: dict = {}
+                    for s in shards:
+                        nbytes = int(s.data.nbytes)
+                        arr_per_device[s.device] = (
+                            arr_per_device.get(s.device, 0) + nbytes)
+                except Exception:
+                    # Deleted/donated buffer mid-walk (same guard as
+                    # device_bytes): fall back to what nbytes reports,
+                    # losing only this array's per-device attribution —
+                    # the snapshot (and the allocator read below) must
+                    # not abort on one bad array.
+                    live += device_bytes(a)
+                else:
+                    for d, b in arr_per_device.items():
+                        live += b
+                        per_device[d] = per_device.get(d, 0) + b
+            device_live = max(per_device.values(), default=live)
             source = "live_arrays"
             stats = jax.devices()[0].memory_stats()
             if stats:
@@ -559,8 +633,16 @@ class DeviceStatsCollector:
         if live is not None:
             with self._lock:
                 self._peak_live_bytes = max(self._peak_live_bytes, live)
+                self._peak_device_live_bytes = max(
+                    self._peak_device_live_bytes, device_live or 0)
+        if peak_alloc:
+            with self._lock:
+                self._peak_alloc_bytes = max(self._peak_alloc_bytes,
+                                             int(peak_alloc))
         return {"liveBytes": live, "numLiveArrays": num_live,
                 "peakLiveBytes": self._peak_live_bytes or None,
+                "maxDeviceLiveBytes": device_live,
+                "peakDeviceLiveBytes": self._peak_device_live_bytes or None,
                 "allocatorBytesInUse": in_use,
                 "allocatorPeakBytes": peak_alloc,
                 "source": source}
@@ -590,6 +672,17 @@ class DeviceStatsCollector:
                 replicaSlotWastePct=waste(replica_slots_used,
                                           replica_slots_total))
         self._padding = padding
+        budget = self._padding_budget_pct
+        if budget is not None:
+            worst = max(padding["partitionWastePct"],
+                        padding["brokerWastePct"])
+            if worst > budget:
+                LOG.warning(
+                    "padding waste %.1f%% exceeds the configured budget "
+                    "of %.1f%% (partitions %d/%d, brokers %d/%d) — "
+                    "check the model.*.pad.multiple knobs "
+                    "(docs/scaling.md)", worst, budget,
+                    partitions, partitions_padded, brokers, brokers_padded)
         return padding
 
     def padding_from_model(self, model) -> dict:
@@ -606,6 +699,51 @@ class DeviceStatsCollector:
             brokers=int(bvalid.sum()), brokers_padded=bvalid.size,
             replica_slots_used=int(rvalid.sum()),
             replica_slots_total=int(rvalid.size))
+
+    # ---------------------------------------------------------- budgets
+    def set_budgets(self, *, padding_waste_pct: float | None = None,
+                    hbm_bytes: int | None = None) -> None:
+        """Configure the padding/memory budgets (0/None = unenforced).
+        Budgets never fail the serving path — they surface on
+        /devicestats (``budget`` section), warn in the log, and GATE the
+        10Kx1M bench tier; the won't-fit degrade path is operator policy
+        (docs/scaling.md)."""
+        self._padding_budget_pct = padding_waste_pct or None
+        self._hbm_budget_bytes = hbm_bytes or None
+
+    def budget_status(self, *, refresh_memory: bool = False) -> dict:
+        """Current standing against the configured budgets. The padding
+        reading is the worst of the partition/broker axes (replica-slot
+        waste is workload-shaped — RF variance — not a pad-multiple
+        choice, so it informs but does not gate). ``refresh_memory``
+        re-snapshots memory (a live_arrays walk on CPU); the default
+        reads the cached peaks. The memory reading is PER-DEVICE — the
+        HBM budget is one device's capacity, and sharding never shrinks
+        the cross-device total — taken as the worst of the per-device
+        live peak and the backend allocator's peak (peak_bytes_in_use
+        includes XLA scratch/temporaries the live sum cannot see)."""
+        padding = self._padding or {}
+        waste = None
+        if padding:
+            waste = max(padding.get("partitionWastePct") or 0.0,
+                        padding.get("brokerWastePct") or 0.0)
+        if refresh_memory:
+            self.memory_snapshot()
+        peak = max(self._peak_device_live_bytes,
+                   self._peak_alloc_bytes) or None
+        out = {
+            "paddingWastePct": waste,
+            "paddingWasteBudgetPct": self._padding_budget_pct,
+            "peakBytes": peak,
+            "hbmBudgetBytes": self._hbm_budget_bytes,
+        }
+        out["paddingOverBudget"] = bool(
+            self._padding_budget_pct is not None and waste is not None
+            and waste > self._padding_budget_pct)
+        out["hbmOverBudget"] = bool(
+            self._hbm_budget_bytes is not None and peak is not None
+            and peak > self._hbm_budget_bytes)
+        return out
 
     # ------------------------------------------------------------ reads
     def compile_count(self) -> int:
@@ -654,6 +792,7 @@ class DeviceStatsCollector:
             },
             "memory": self.memory_snapshot(),
             "padding": self._padding,
+            "budget": self.budget_status(),
         }
 
 

@@ -85,23 +85,46 @@ class FlatClusterModel:
 
     # ------------------------------------------------------------ properties
     @classmethod
-    def from_numpy(cls, **arrays) -> "FlatClusterModel":
-        """Build from host-side numpy arrays (one ``jnp.asarray`` per
-        field). The assembly point for every array-native construction
-        path — ``flatten_spec``, the monitor's dense pipeline, bench's
-        direct builders — which also makes it the ONE choke point for
-        host->device transfer accounting: every model upload is metered
-        on the PROCESS-DEFAULT device-runtime collector (nbytes metadata
-        only, no sync). Deliberately the default, not an injected
-        collector: a classmethod constructor has no wiring surface, and
-        every production path runs on the default ledger — stacks built
-        with a private collector miss these bytes (documented
-        tradeoff)."""
+    def from_numpy(cls, *, mesh=None, **arrays) -> "FlatClusterModel":
+        """Build from host-side numpy arrays. The assembly point for
+        every array-native construction path — ``flatten_spec``, the
+        monitor's dense pipeline, bench's direct builders — which also
+        makes it the ONE choke point for host->device transfer
+        accounting: every model upload is metered on the PROCESS-DEFAULT
+        device-runtime collector (metadata only, no sync). Deliberately
+        the default, not an injected collector: a classmethod constructor
+        has no wiring surface, and every production path runs on the
+        default ledger — stacks built with a private collector miss
+        these bytes (documented tradeoff).
+
+        ``mesh``: place each field directly under the partition-axis
+        layout (``parallel/sharding.py``: [P, ...] fields shard, broker
+        fields replicate) via per-field ``jax.device_put`` — the runtime
+        then ships per-device SHARDS instead of one monolithic array
+        that a downstream ``shard_model`` would immediately re-lay-out;
+        at 1M partitions that monolithic round trip is the host-assembly
+        bottleneck the 10Kx1M tier profiles. Metered at addressable-shard
+        sizes (replicated fields genuinely cost one copy per device)."""
         from ..core.runtime_obs import default_collector
+        if mesh is None:
+            default_collector().record_h2d(
+                sum(int(a.nbytes) for a in arrays.values()
+                    if isinstance(a, np.ndarray)))
+            return cls(**{name: jnp.asarray(a)
+                          for name, a in arrays.items()})
+        from ..core.runtime_obs import device_bytes
+        from ..parallel.sharding import host_array_shardings
+        from .spec import check_even_sharding
+        Ppad = arrays["replica_broker"].shape[0]
+        check_even_sharding(Ppad, int(mesh.devices.size),
+                            what="padded partition count")
+        shardings = host_array_shardings(arrays, mesh, Ppad)
+        placed = {name: jax.device_put(a, shardings[name])
+                  for name, a in arrays.items()}
         default_collector().record_h2d(
-            sum(int(a.nbytes) for a in arrays.values()
+            sum(device_bytes(placed[name]) for name, a in arrays.items()
                 if isinstance(a, np.ndarray)))
-        return cls(**{name: jnp.asarray(a) for name, a in arrays.items()})
+        return cls(**placed)
 
     @property
     def num_partitions_padded(self) -> int:

@@ -90,7 +90,7 @@ class ResidentClusterState:
     """
 
     def __init__(self, *, registry=None, collector=None, tracer=None,
-                 delta_pad_multiple: int = 512) -> None:
+                 delta_pad_multiple: int = 512, mesh=None) -> None:
         import jax
 
         from ..core.runtime_obs import default_collector
@@ -104,6 +104,14 @@ class ResidentClusterState:
         #: O(log P) while keeping small steady-state deltas in ONE
         #: pre-warmable bucket.
         self.delta_pad_multiple = int(delta_pad_multiple)
+        #: optional jax.sharding.Mesh: full rebuilds upload per-device
+        #: SHARDS straight into the partition-axis layout
+        #: (from_numpy(mesh=...)), so the resident buffers are already
+        #: laid out for the sharded optimizer/what-if programs and no
+        #: cycle ever re-shards them; the delta scatter runs on the
+        #: sharded planes (GSPMD partitions the row scatter, payloads
+        #: replicate — they are KB-sized).
+        self.mesh = mesh
         self._lock = threading.Lock()
         self._model = None                      # FlatClusterModel | None
         self._host: dict[str, np.ndarray] = {}  # host mirrors, by field
@@ -155,7 +163,7 @@ class ResidentClusterState:
         self.epoch += 1
         self.full_rebuilds += 1
         self._full_counter.inc()
-        self._model = FlatClusterModel.from_numpy(**arrays)
+        self._model = FlatClusterModel.from_numpy(mesh=self.mesh, **arrays)
         self._host = dict(arrays)
         self.last_update = "full"
         self.last_delta_rows = 0

@@ -24,6 +24,25 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def check_even_sharding(count: int, n_devices: int, *, what: str,
+                        exc: type = ValueError) -> None:
+    """The partition axis must split EVENLY across a mesh —
+    ``jax.device_put`` rejects uneven shardings with an error naming
+    neither the knob nor the fix (layout rule: parallel/sharding.py).
+    One definition shared by the config parse check, the startup
+    re-check with the resolved device count, and the sharded upload
+    path, so the rule can never drift between them. Lives here (not in
+    ``parallel/``) so config parsing stays jax-import-free."""
+    if n_devices and count % n_devices:
+        raise exc(
+            f"{what}={count} is not divisible by the mesh device count "
+            f"{n_devices}: padded partition counts could not shard "
+            "evenly across the mesh (every model placement would "
+            "fail). Pick a value divisible by the device count — the "
+            "default pad multiple 128 works for any power-of-two mesh "
+            "up to 128 (docs/scaling.md).")
+
+
 @dataclass
 class BrokerSpec:
     """One broker (ref ``model/Broker.java``): identity, placement, capacity,

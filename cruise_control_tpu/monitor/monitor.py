@@ -95,6 +95,15 @@ class MonitorConfig:
     #: and fall back to one full rebuild + upload. Dense-pipeline only —
     #: the per-entity reference path always uploads in full.
     resident_state: bool = True
+    #: partition-axis pad multiple (model.partition.pad.multiple): the
+    #: padded partition count is the next multiple of this, trading
+    #: recompiles on partition churn against padded-row HBM waste. At 1M
+    #: partitions a coarse (e.g. power-of-two) bucket can waste near 2x
+    #: device memory, so the multiple is an explicit knob with a
+    #: padding-waste budget watching it (docs/scaling.md).
+    partition_pad_multiple: int = 128
+    #: broker-axis pad multiple (model.broker.pad.multiple).
+    broker_pad_multiple: int = 8
 
 
 @dataclass
@@ -175,7 +184,7 @@ class LoadMonitor:
                  broker_set_resolver=None,
                  max_concurrent_model_builds: int = 2,
                  registry=None, tracer=None, collector=None,
-                 admin_retry=None, sleep_ms=None) -> None:
+                 admin_retry=None, sleep_ms=None, mesh=None) -> None:
         from ..core.runtime_obs import default_collector
         from ..core.sensors import (LOAD_MONITOR_SENSOR, MetricRegistry)
         from ..core.tracing import default_tracer
@@ -214,16 +223,21 @@ class LoadMonitor:
         self._admin_retry = admin_retry
         self._admin_sleep_ms = sleep_ms
         self.registry = registry or MetricRegistry()
+        #: optional jax.sharding.Mesh (search.mesh.devices, wired by
+        #: serve.py): dense model builds upload straight into the
+        #: partition-axis sharded layout, so the optimizer/what-if
+        #: programs consume the resident buffers without a re-shard.
+        self.mesh = mesh
+        from ..model.resident import ResidentClusterState
         #: device-resident model state (None when disabled or on the
         #: reference pipeline): the dense assembler routes every build
         #: through it so metric-only cycles become delta scatters instead
         #: of full uploads. Sensors land on this monitor's registry
         #: (``ResidentState.*``).
-        from ..model.resident import ResidentClusterState
         self.resident = (
             ResidentClusterState(registry=self.registry,
                                  collector=self.collector,
-                                 tracer=self.tracer)
+                                 tracer=self.tracer, mesh=mesh)
             if (c.resident_state and c.dense_pipeline) else None)
         # ref LoadMonitor.java:101 cluster-model-creation-timer; the
         # valid-windows / monitored-partitions gauges mirror
@@ -551,7 +565,10 @@ class LoadMonitor:
         pspecs, windows, window_times = self._partition_specs(
             partitions, alive, result, extra_offline)
         spec = ClusterSpec(brokers=brokers, partitions=pspecs)
-        model, metadata = flatten_spec(spec)
+        model, metadata = flatten_spec(
+            spec,
+            partition_pad_multiple=self.config.partition_pad_multiple,
+            broker_pad_multiple=self.config.broker_pad_multiple)
         # Padding accounting from shape metadata + the spec (no device
         # read); the structural-issue meter lives on the dense path only —
         # checking here would cost a device fetch of the just-uploaded
@@ -642,7 +659,8 @@ class LoadMonitor:
         from ..model.spec import _round_up, flatten_brokers
 
         c = self.config
-        ba = flatten_brokers(brokers)
+        ba = flatten_brokers(brokers,
+                             broker_pad_multiple=c.broker_pad_multiple)
         bindex = ba.broker_index
         Bpad = ba.padded
         keys = sorted(partitions)
@@ -669,7 +687,7 @@ class LoadMonitor:
         partition_index = {k: i for i, k in enumerate(keys)}
 
         R = max(int(rep_counts.max()) if P else 1, 1)
-        Ppad = _round_up(P, 128)
+        Ppad = _round_up(P, c.partition_pad_multiple)
         sentinel = Bpad
         rb = np.full((Ppad, R), sentinel, np.int32)
         if total:
@@ -800,7 +818,7 @@ class LoadMonitor:
             # "delta" (the same reason _last_good never caches them).
             model = self.resident.update(arrays)
         else:
-            model = FlatClusterModel.from_numpy(**arrays)
+            model = FlatClusterModel.from_numpy(mesh=self.mesh, **arrays)
         from ..model.spec import ClusterMetadata
         metadata = ClusterMetadata(
             broker_ids=ba.broker_ids, broker_index=bindex,

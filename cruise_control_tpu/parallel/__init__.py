@@ -8,11 +8,16 @@ are scatter-adds from sharded [P, R] arrays into replicated [B1, ...] rows
 (an implicit psum), and candidate top-k runs shard-local then gathers.
 """
 
+from ._compat import shard_map
 from .branches import (BRANCH_AXIS, make_branch_mesh, make_branched_search,
                        select_best)
-from .sharding import (PARTITION_AXIS, make_mesh, model_shardings,
+from .sharding import (PARTITION_AXIS, host_array_shardings, make_mesh,
+                       mesh_fingerprint, model_shardings,
+                       resolve_mesh_devices, scenario_batch_shardings,
                        shard_model, sharded_state_shardings)
 
-__all__ = ["PARTITION_AXIS", "make_mesh", "model_shardings", "shard_model",
-           "sharded_state_shardings", "BRANCH_AXIS", "make_branch_mesh",
+__all__ = ["PARTITION_AXIS", "make_mesh", "mesh_fingerprint",
+           "model_shardings", "resolve_mesh_devices", "shard_model",
+           "shard_map", "sharded_state_shardings", "host_array_shardings",
+           "scenario_batch_shardings", "BRANCH_AXIS", "make_branch_mesh",
            "make_branched_search", "select_best"]

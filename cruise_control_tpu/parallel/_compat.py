@@ -1,0 +1,28 @@
+"""jax version compatibility for the parallel layer.
+
+The ``shard_map`` entry point moved out of ``jax.experimental`` in
+jax 0.8 and renamed its replication-checker kwarg (``check_rep`` ->
+``check_vma``) on the way. This shim is the ONE place that reasoning
+lives: every module that needs shard_map imports :func:`shard_map` from
+here (enforced by a lint test in ``tests/test_parallel.py`` — a second
+copy of the try/except would drift the kwarg handling the moment the
+next rename lands).
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map   # jax >= 0.8
+    _CHECK_KW = "check_vma"
+except ImportError:   # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(fn, **kwargs):
+    """``jax.shard_map`` with the replication checker OFF under the
+    version-correct kwarg name. The callers here derive per-shard
+    behavior from ``axis_index`` (branch seeds), which makes outputs
+    intentionally non-replicated — the checker would reject them."""
+    kwargs[_CHECK_KW] = False
+    return _shard_map(fn, **kwargs)

@@ -26,25 +26,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map   # jax >= 0.8
-    _CHECK_KW = "check_vma"
-except ImportError:   # pragma: no cover — older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-    _CHECK_KW = "check_rep"
-
 from ..analyzer.constraint import SearchConfig
 from ..analyzer.engine import make_chain_step
 from ..analyzer.goals import GoalKernel
+from ._compat import shard_map
 
 BRANCH_AXIS = "branch"
-
-
-def shard_map(fn, **kwargs):
-    # axis_index-derived seeds make outputs intentionally non-replicated;
-    # the replication checker must be off (kwarg renamed across versions).
-    kwargs[_CHECK_KW] = False
-    return _shard_map(fn, **kwargs)
 
 
 def make_branch_mesh(n_branches: int | None = None) -> Mesh:

@@ -39,6 +39,25 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devices), (PARTITION_AXIS,))
 
 
+def resolve_mesh_devices(n: int) -> int:
+    """Resolve the ``search.mesh.devices`` config value to a concrete
+    device count: ``-1`` means "all visible devices", positive values
+    clamp to what jax exposes, ``0`` stays 0 (no mesh)."""
+    if n == 0:
+        return 0
+    available = len(jax.devices())
+    return available if n < 0 else min(n, available)
+
+
+def mesh_fingerprint(mesh: Mesh | None):
+    """Hashable identity of a mesh for program-cache keys (None = no
+    mesh). Device objects themselves are process-stable but their hash
+    is not guaranteed across jax versions; the string ids are."""
+    if mesh is None:
+        return None
+    return tuple(str(d) for d in mesh.devices.flat)
+
+
 def _spec_for(leaf: jax.Array, num_partitions_padded: int) -> P:
     """Partition-axis leaves shard on dim 0; everything else replicates."""
     if leaf.ndim >= 1 and leaf.shape[0] == num_partitions_padded:
@@ -63,3 +82,26 @@ def sharded_state_shardings(state, mesh: Mesh, num_partitions_padded: int):
     return jax.tree.map(
         lambda leaf: NamedSharding(mesh, _spec_for(leaf, num_partitions_padded)),
         state)
+
+
+def host_array_shardings(arrays: dict, mesh: Mesh,
+                         num_partitions_padded: int) -> dict:
+    """NamedShardings for a ``FlatClusterModel.from_numpy`` kwarg dict of
+    HOST arrays — same layout rule as :func:`model_shardings` (partition
+    axis shards, broker axis replicates), applied before the upload so a
+    full rebuild ships per-device shards instead of one monolithic array
+    followed by a device-side reshard."""
+    return {name: NamedSharding(mesh, _spec_for(a, num_partitions_padded))
+            for name, a in arrays.items()}
+
+
+def scenario_batch_shardings(mesh: Mesh, num_partitions_padded: int, tree):
+    """Shardings for the what-if engine's per-scenario parameter arrays:
+    ``[S, P]``-shaped leaves shard the partition axis (dim 1, the big
+    one); the scenario axis and every broker-indexed parameter replicate
+    — the vmapped sweep then partitions exactly like the goal passes."""
+    def spec(leaf):
+        if leaf.ndim >= 2 and leaf.shape[1] == num_partitions_padded:
+            return P(None, PARTITION_AXIS, *([None] * (leaf.ndim - 2)))
+        return P()
+    return jax.tree.map(lambda l: NamedSharding(mesh, spec(l)), tree)

@@ -48,10 +48,34 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     bset_file = config.get_string("broker.set.config.file")
     broker_set_resolver = (FileBrokerSetResolver(bset_file) if bset_file
                            else None)
+    # The mesh is resolved before the monitor so model BUILDS upload
+    # partition-axis shards from the start (resident state + optimizer +
+    # what-if all consume the same layout). -1 = all visible devices.
+    mesh = None
+    mesh_devices = config.get_int("search.mesh.devices")
+    if mesh_devices:
+        from .parallel import make_mesh, resolve_mesh_devices
+        mesh = make_mesh(resolve_mesh_devices(mesh_devices))
+        # Re-check even sharding with the RESOLVED device count (the
+        # parse-time check covers explicit N; -1 resolves only here).
+        from .core.config import ConfigException
+        from .model.spec import check_even_sharding
+        check_even_sharding(
+            config.get_int("model.partition.pad.multiple"),
+            int(mesh.devices.size),
+            what="model.partition.pad.multiple", exc=ConfigException)
+    # Padding/HBM budgets land on the process-default device-stats
+    # collector (0 = unenforced): breaches warn + flag /devicestats.
+    from .core.runtime_obs import default_collector
+    default_collector().set_budgets(
+        padding_waste_pct=config.get_double(
+            "device.padding.waste.budget.pct"),
+        hbm_bytes=config.get_int("device.hbm.budget.bytes"))
     monitor = LoadMonitor(admin, config.monitor_config(),
                           capacity_resolver=resolver,
                           broker_set_resolver=broker_set_resolver,
-                          admin_retry=config.executor_config().admin_retry)
+                          admin_retry=config.executor_config().admin_retry,
+                          mesh=mesh)
     store_dir = config.get_string("sample.store.dir")
     store = FileSampleStore(store_dir) if store_dir else NoopSampleStore()
     cpu_model = LinearRegressionModelParameters()
@@ -72,13 +96,6 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         sampling_interval_ms=config.get_int("metric.sampling.interval.ms"))
     constraint = config.balancing_constraint()
     goal_names = config.get_list("default.goals")
-    mesh = None
-    mesh_devices = config.get_int("search.mesh.devices")
-    if mesh_devices:
-        import jax
-
-        from .parallel import make_mesh
-        mesh = make_mesh(min(mesh_devices, len(jax.devices())))
     branches = config.get_int("search.branches")
     if branches > 1:
         import jax

@@ -140,8 +140,16 @@ class WhatIfEngine:
     """
 
     def __init__(self, goals=None, constraint: BalancingConstraint | None = None,
-                 *, registry=None, tracer=None, collector=None,
+                 *, registry=None, tracer=None, collector=None, mesh=None,
                  scenario_pad_multiple: int = 8,
+                 # Model re-pad buckets for scenarios that outgrow the
+                 # live model's padding slack (BrokerAdd/TopicAdd) — wire
+                 # the SAME multiples the monitor builds with
+                 # (model.*.pad.multiple; the facade does) or the re-pad
+                 # lands on off-bucket shapes and compiles extra sweep
+                 # variants per growth step.
+                 partition_pad_multiple: int = 128,
+                 broker_pad_multiple: int = 8,
                  # Covers a full N-2 pairwise sweep up to 128 brokers
                  # (128*127/2 = 8128); per-scenario [S, P] parameter
                  # arrays scale the footprint, so operators with huge
@@ -160,8 +168,20 @@ class WhatIfEngine:
         self.collector = collector or default_collector()
         self.goals = (goals if goals is not None
                       else default_goals(self.constraint))
+        #: optional jax.sharding.Mesh (search.mesh.devices — the same
+        #: mesh the optimizer runs on): the template model and the
+        #: ``[S, P]`` per-scenario parameter planes shard the partition
+        #: axis, so the vmapped sweep partitions exactly like the goal
+        #: passes (broker-indexed parameters and the scenario axis
+        #: replicate; the per-scenario broker aggregates ride the same
+        #: ICI all-reduce — parallel/sharding.py layout note).
+        self.mesh = mesh
+        from ..parallel.sharding import mesh_fingerprint
+        self._mesh_key = mesh_fingerprint(mesh)
         import threading
         self.scenario_pad_multiple = scenario_pad_multiple
+        self.partition_pad_multiple = partition_pad_multiple
+        self.broker_pad_multiple = broker_pad_multiple
         self.max_scenarios = max_scenarios
         self.program_cache_size = program_cache_size
         # The engine is shared between HTTP request threads (/simulate)
@@ -204,17 +224,7 @@ class WhatIfEngine:
             batch = self._materialize(model, metadata, scenarios)
             goals = [g.bind(metadata) for g in self.goals]
             program = self._program_for(batch, goals, metadata)
-            # Per-scenario parameter upload: the sweep's host->device
-            # cost (the template model is already resident).
-            self.collector.record_h2d(
-                batch.dead.nbytes + batch.add.nbytes
-                + batch.cap_scale.nbytes + batch.pscale.nbytes
-                + batch.pvalid.nbytes)
-            out = program(batch.template,
-                          jnp.asarray(batch.dead), jnp.asarray(batch.add),
-                          jnp.asarray(batch.cap_scale),
-                          jnp.asarray(batch.pscale),
-                          jnp.asarray(batch.pvalid))
+            out = program(*self._place_batch(batch))
             fetched = jax.device_get(out)
             self.collector.record_d2h(self.collector.tree_bytes(fetched))
             (viol, vscale, headroom, hfrac, pressure, unavailable,
@@ -244,7 +254,7 @@ class WhatIfEngine:
         debug/test surface (the sweep itself never materializes these
         outside the device program)."""
         batch = self._materialize(model, metadata, scenarios)
-        key = ("transform",) + self._shape_key(batch)
+        key = ("transform",) + self._shape_key(batch) + (self._mesh_key,)
         with self._programs_lock:
             program = self._programs.get(key)
             if program is None:
@@ -253,13 +263,40 @@ class WhatIfEngine:
                         "whatif.transform",
                         jax.jit(jax.vmap(self._transform_fn(),
                                          in_axes=(None, 0, 0, 0, 0, 0)))))
-        stacked, _has_alive = program(
-            batch.template,
-            jnp.asarray(batch.dead), jnp.asarray(batch.add),
-            jnp.asarray(batch.cap_scale), jnp.asarray(batch.pscale),
-            jnp.asarray(batch.pvalid))
+        stacked, _has_alive = program(*self._place_batch(batch))
         return [jax.tree.map(lambda a, i=i: a[i], stacked)
                 for i in range(batch.num_real)]
+
+    def _place_batch(self, batch: _Batch):
+        """Device placement + h2d metering for one materialized batch:
+        the sweep program's argument tuple. Under a mesh the template and
+        the [S, P] parameter planes upload as partition-axis shards
+        (broker/scenario parameters replicate — metered at their real
+        per-device cost); unsharded, everything rides plain asarray."""
+        params = {"dead": batch.dead, "add": batch.add,
+                  "cap_scale": batch.cap_scale, "pscale": batch.pscale,
+                  "pvalid": batch.pvalid}
+        if self.mesh is None:
+            # Per-scenario parameter upload: the sweep's host->device
+            # cost (the template model is already resident).
+            self.collector.record_h2d(
+                sum(a.nbytes for a in params.values()))
+            return (batch.template,) + tuple(
+                jnp.asarray(params[k]) for k in
+                ("dead", "add", "cap_scale", "pscale", "pvalid"))
+        from ..core.runtime_obs import device_bytes
+        from ..parallel.sharding import (scenario_batch_shardings,
+                                         shard_model)
+        template = shard_model(batch.template, self.mesh)
+        shardings = scenario_batch_shardings(
+            self.mesh, batch.template.num_partitions_padded, params)
+        placed = {k: jax.device_put(a, shardings[k])
+                  for k, a in params.items()}
+        self.collector.record_h2d(
+            sum(device_bytes(a) for a in placed.values()))
+        return (template,) + tuple(
+            placed[k] for k in ("dead", "add", "cap_scale", "pscale",
+                                "pvalid"))
 
     # -------------------------------------------------------- device side
     @staticmethod
@@ -333,7 +370,8 @@ class WhatIfEngine:
         num_topics = metadata.num_topics + batch.num_staged_topics
         key = (("sweep",) + self._shape_key(batch)
                + (tuple((g.name, g.bind_signature()) for g in goals),
-                  num_topics if needs_topics else None, needs_tlc))
+                  num_topics if needs_topics else None, needs_tlc,
+                  self._mesh_key))
         with self._programs_lock:
             program = self._programs.get(key)
             if program is not None:
@@ -412,7 +450,10 @@ class WhatIfEngine:
         need_p = sum(s.partitions for s in topic_adds)
         need_r = max([s.rf for s in topic_adds], default=0)
         model = _ensure_padding(model, int((~bvalid).sum()), need_b,
-                                int((~pvalid0).sum()), need_p, need_r)
+                                int((~pvalid0).sum()), need_p, need_r,
+                                partition_pad_multiple=
+                                self.partition_pad_multiple,
+                                broker_pad_multiple=self.broker_pad_multiple)
         bvalid = np.asarray(model.broker_valid)
         balive = np.asarray(model.broker_alive)
         pvalid0 = np.asarray(model.partition_valid)
@@ -603,17 +644,21 @@ class WhatIfEngine:
 
 
 def _ensure_padding(model: FlatClusterModel, spare_b: int, need_b: int,
-                    spare_p: int, need_p: int, need_r: int
-                    ) -> FlatClusterModel:
+                    spare_p: int, need_p: int, need_r: int, *,
+                    partition_pad_multiple: int = 128,
+                    broker_pad_multiple: int = 8) -> FlatClusterModel:
     """Re-pad the model (host-side) when the scenario batch needs more
     padding broker rows / partition rows / replica slots than the live
     model carries. Rare (BrokerAdd / TopicAdd beyond the pad slack) —
     costs one numpy round-trip and a fresh program compile for the new
-    shapes."""
+    shapes. The multiples mirror the model builder's configured pad
+    buckets so the re-pad stays on-bucket."""
     B = model.num_brokers_padded
     P, R = model.replica_broker.shape
-    new_B = B if need_b <= spare_b else _round_up(B + need_b - spare_b, 8)
-    new_P = P if need_p <= spare_p else _round_up(P + need_p - spare_p, 128)
+    new_B = (B if need_b <= spare_b
+             else _round_up(B + need_b - spare_b, broker_pad_multiple))
+    new_P = (P if need_p <= spare_p
+             else _round_up(P + need_p - spare_p, partition_pad_multiple))
     new_R = max(R, need_r)
     if (new_B, new_P, new_R) == (B, P, R):
         return model

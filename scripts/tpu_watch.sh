@@ -24,33 +24,43 @@ print(p)
 sys.exit(0 if p == 'tpu' else 1)" >/dev/null 2>&1
 }
 
-capture() {  # capture <scenario[:variant]> <timeout_s>
-  local spec="$1" tmo="$2" n v tag ts out log
-  n="${spec%%:*}"; v="${spec#*:}"; [ "$v" = "$spec" ] && v=""
-  tag="s${n}${v:+_$v}"
+capture() {  # capture <scenario[:variant[:meshN]]> <timeout_s>
+  # meshN shards the run over an N-device mesh (-1 = all devices) — the
+  # scenario-4 sharded rows ride the same ladder as the single-chip ones.
+  local spec="$1" tmo="$2" n v m tag ts out log
+  IFS=: read -r n v m <<< "$spec"
+  tag="s${n}${v:+_$v}${m:+_mesh${m#-1}}"
   ts=$(date +%s)
   out="bench_tpu/${tag}_${ts}.json"
   log="bench_tpu/${tag}_${ts}.log"
   local args=(--scenario "$n"); [ -n "$v" ] && args+=(--variant "$v")
+  [ -n "$m" ] && args+=(--mesh "$m")
   echo "[tpu_watch] $(date -u +%FT%TZ) $tag (timeout ${tmo}s)" >> bench_tpu/watch.log
   timeout "$tmo" python bench.py "${args[@]}" > "$out" 2> "$log"
   local rc=$?
-  if [ $rc -ne 0 ] || ! grep -q '"platform": "tpu"' "$out"; then
+  if ! grep -q '"platform": "tpu"' "$out"; then
+    # No on-chip rows at all (CPU fallback, crash before any emit):
+    # nothing worth keeping.
     echo "[tpu_watch]   $tag: rc=$rc platform=$(grep -o '"platform": "[a-z]*"' "$out" | head -1) — discarded" >> bench_tpu/watch.log
     rm -f "$out"
     return 1
   fi
-  echo "[tpu_watch]   $tag OK: $(cat "$out")" >> bench_tpu/watch.log
+  # rc != 0 WITH tpu rows = a gated tier breached (bench emits its rows
+  # before raising): record the rows — they ARE the regression evidence
+  # — marked FAILED so the history never reads a breach as a pass.
+  local verdict="OK"
+  [ $rc -ne 0 ] && verdict="FAILED rc=$rc (gate breach? see $log)"
+  echo "[tpu_watch]   $tag $verdict: $(cat "$out")" >> bench_tpu/watch.log
   # Tee into the TRACKED results file (bench_tpu/ is gitignored; the
   # driver commits uncommitted work at round end, so on-chip numbers
   # captured after the last interactive turn still reach the repo).
   {
-    echo "$(date -u +%FT%TZ) $tag:"
+    echo "$(date -u +%FT%TZ) $tag ($verdict):"
     echo '```json'
     cat "$out"
     echo '```'
   } >> TPU_RESULTS.md
-  return 0
+  [ $rc -eq 0 ]
 }
 
 while true; do
@@ -64,7 +74,10 @@ while true; do
     # rest), but re-probe between them so a dead tunnel short-circuits
     # the ladder. Demo (1) last: its fused 15-goal serial compile is the
     # longest cold cost for the least fresh value in a short window.
-    for spec in 2 5 4 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 4::-1 = the sharded 10Kx1M tier (partition axis over every visible
+    # chip) right after the single-chip headline, so the sharded-vs-
+    # unsharded A/B lands in one tunnel window.
+    for spec in 2 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
         2|1) tmo=3600 ;; 5) tmo=2400 ;; 4:fullchain) tmo=7200 ;;
@@ -76,6 +89,7 @@ while true; do
     if probe; then
       capture 2 1200
       capture 4 3600
+      capture 4::-1 3600
       capture 4:fullchain 5400
     fi
   else

@@ -5,6 +5,8 @@ the dense monitor→model pipeline bench."""
 
 import sys
 
+import pytest
+
 sys.path.insert(0, ".")
 
 
@@ -120,3 +122,32 @@ def test_device_stats_bench_smoke_gate():
     assert out["transfer_bytes"] > 0
     assert 0.0 <= out["padding"]["partitionWastePct"] < 100.0
     assert default_collector().enabled   # A/B harness must restore
+
+
+@pytest.mark.slow
+def test_scale_tier_gate_smoke():
+    """The GATED scale tier (run_scale_scenario) at a CI-sized cluster,
+    sharded over 2 devices: the full row set must come back (warm cycle
+    transfers, sharded full-rebuild h2d, padding, peak memory) with the
+    padding budget satisfied and the model genuinely shipped as shards.
+    Marked slow — it compiles the 4-goal chain for fresh shapes; the
+    real 10Kx1M numbers come from bench.py --scenario 4 / tpu_watch.sh
+    (this asserts the tier's gate machinery, not the scale)."""
+    import jax
+
+    import bench
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices "
+                    "(--xla_force_host_platform_device_count)")
+    out = bench.run_scale_scenario(4, mesh_devices=2,
+                                   brokers=64, partitions=8_192)
+    # The tier's gate budgets must not leak onto the process default.
+    assert default_collector().budget_status()[
+        "paddingWasteBudgetPct"] is None
+    assert out["mesh_devices"] == 2
+    assert out["warm_s"] > 0
+    assert out["rebuild_h2d"] > 0
+    assert out["warm_cycle"].get("d2hBytes", 0) > 0
+    assert not out["budget"]["paddingOverBudget"]
+    assert out["padding"]["partitionWastePct"] < bench.SCALE_PADDING_BUDGET_PCT

@@ -139,6 +139,78 @@ def test_monitor_dense_pipeline_config_wiring():
     ).monitor_config().dense_pipeline is False
 
 
+def test_branches_and_mesh_mutually_exclusive_at_parse_time():
+    """search.branches vs search.mesh.devices: the conflict must fail
+    when the PROPERTIES parse, with an actionable message — not deep
+    inside the first TpuGoalOptimizer construction."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    with pytest.raises(ConfigException) as exc:
+        CruiseControlConfig({"search.branches": "4",
+                             "search.mesh.devices": "2"})
+    msg = str(exc.value)
+    assert "search.branches" in msg and "search.mesh.devices" in msg
+    assert "unset one" in msg
+    # -1 (= all visible devices) conflicts too: it still means a mesh.
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"search.branches": "2",
+                             "search.mesh.devices": "-1"})
+    # Either alone is fine; branches <= 1 never conflicts (0/1 = off).
+    CruiseControlConfig({"search.branches": "4"})
+    CruiseControlConfig({"search.mesh.devices": "2"})
+    CruiseControlConfig({"search.branches": "1",
+                         "search.mesh.devices": "2"})
+
+
+def test_pad_multiple_must_divide_mesh_devices():
+    """Even sharding is a placement-time hard requirement (device_put
+    rejects uneven partition axes): a pad multiple not divisible by the
+    mesh device count must fail at config parse, not on the first model
+    build."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    with pytest.raises(ConfigException) as exc:
+        CruiseControlConfig({"search.mesh.devices": "8",
+                             "model.partition.pad.multiple": "100"})
+    assert "divisible" in str(exc.value)
+    # Divisible combinations parse; -1 defers the check to startup
+    # (device count unknown at parse time).
+    CruiseControlConfig({"search.mesh.devices": "8",
+                         "model.partition.pad.multiple": "256"})
+    CruiseControlConfig({"search.mesh.devices": "-1",
+                         "model.partition.pad.multiple": "100"})
+
+
+def test_mesh_devices_minus_one_means_all_devices():
+    """search.mesh.devices=-1 parses (validator floor is -1) and resolves
+    to every visible device; below -1 is rejected."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    from cruise_control_tpu.parallel import resolve_mesh_devices
+    import jax
+    cfg = CruiseControlConfig({"search.mesh.devices": "-1"})
+    n = cfg.get_int("search.mesh.devices")
+    assert n == -1
+    assert resolve_mesh_devices(n) == len(jax.devices())
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"search.mesh.devices": "-2"})
+
+
+def test_pad_multiple_and_budget_config_wiring():
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    mc = CruiseControlConfig({}).monitor_config()
+    assert mc.partition_pad_multiple == 128
+    assert mc.broker_pad_multiple == 8
+    cfg = CruiseControlConfig({"model.partition.pad.multiple": "512",
+                               "model.broker.pad.multiple": "16",
+                               "device.padding.waste.budget.pct": "12.5",
+                               "device.hbm.budget.bytes": "1000000"})
+    mc = cfg.monitor_config()
+    assert mc.partition_pad_multiple == 512
+    assert mc.broker_pad_multiple == 16
+    assert cfg.get_double("device.padding.waste.budget.pct") == 12.5
+    assert cfg.get_int("device.hbm.budget.bytes") == 1_000_000
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"model.partition.pad.multiple": "0"})
+
+
 def test_executor_config_wiring():
     from cruise_control_tpu.config.constants import CruiseControlConfig
     cfg = CruiseControlConfig({

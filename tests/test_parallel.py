@@ -212,6 +212,31 @@ def test_branched_search_beats_single_on_constrained_budget():
     assert branched < single * 0.995, (branched, single)
 
 
+def test_shard_map_imports_only_through_compat_shim():
+    """Lint gate: the jax>=0.8 shard_map import (and its renamed
+    replication-checker kwarg) is version-sensitive — exactly ONE module,
+    parallel/_compat.py, may import it from jax; everyone else reuses
+    the shim. A second copy would silently drift the kwarg handling on
+    the next jax rename."""
+    import pathlib
+    import re
+    pkg = pathlib.Path(
+        __import__("cruise_control_tpu").__file__).resolve().parent
+    pattern = re.compile(
+        r"from\s+jax(\.experimental)?(\.shard_map)?\s+import\s+"
+        r"[^\n]*shard_map|import\s+jax\.experimental\.shard_map")
+    offenders = []
+    for path in pkg.rglob("*.py"):
+        rel = path.relative_to(pkg).as_posix()
+        if rel == "parallel/_compat.py":
+            continue
+        if pattern.search(path.read_text()):
+            offenders.append(rel)
+    assert not offenders, (
+        f"modules importing shard_map directly from jax (use "
+        f"parallel._compat.shard_map): {offenders}")
+
+
 def test_audited_branch_selection_prefers_gate_passing_branch():
     """select_best_audited: a branch that satisfies the audited hard
     goals beats a chain-lexicographically better branch that violates

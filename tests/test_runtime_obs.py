@@ -129,6 +129,35 @@ def test_transfer_accounting_and_cycle():
     assert snap["h2dBytes"] == 100 and snap["d2hBytes"] == 50
 
 
+def test_shard_aware_byte_accounting_on_host_mesh():
+    """device_bytes/tree_bytes/memory accounting under a 2-device host
+    mesh report addressable-shard sizes, not logical totals: a
+    partition-sharded plane costs its logical bytes split across the
+    devices, a replicated one costs a full copy PER device — ``nbytes``
+    (the old accounting) gets the replicated case wrong by the device
+    count."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from cruise_control_tpu.core.runtime_obs import device_bytes
+    from cruise_control_tpu.parallel import make_mesh
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    mesh = make_mesh(2)
+    host = np.ones((128, 4), np.float32)
+    sharded = jax.device_put(host, NamedSharding(mesh, P("p")))
+    replicated = jax.device_put(host, NamedSharding(mesh, P()))
+    assert device_bytes(host) == host.nbytes
+    assert device_bytes(sharded) == host.nbytes
+    assert sharded.nbytes == host.nbytes          # logical == global
+    assert device_bytes(replicated) == 2 * host.nbytes
+    assert replicated.nbytes == host.nbytes       # the lie this fixes
+    assert tree_bytes({"s": sharded, "r": replicated, "h": host}) \
+        == 4 * host.nbytes
+    # memory_snapshot's live-bytes fallback counts the real residency.
+    c = _collector()
+    live = c.memory_snapshot()["liveBytes"]
+    assert live is None or live >= 3 * host.nbytes
+
+
 def test_model_upload_meters_h2d():
     """FlatClusterModel.from_numpy is the one upload choke point: the
     process-default collector's h2d counter grows by the model's bytes."""
