@@ -283,6 +283,129 @@ def run_whatif_n1_bench(num_brokers: int = NUM_BROKERS,
             "scenarios_per_s": scn_per_s}
 
 
+def run_fleet_propose_bench(num_clusters: int = 16,
+                            num_brokers: int = NUM_BROKERS,
+                            num_partitions: int = NUM_PARTITIONS, *,
+                            goal_names: list | None = None,
+                            repeats: int = 3, seed: int = 3,
+                            emit_row: bool = True, gate: bool = True
+                            ) -> dict:
+    """Fleet-scale batched propose (ISSUE 10): ``num_clusters`` member
+    clusters optimized by ONE cluster-sharded device dispatch
+    (fleet/engine.py — each device runs the unmodified single-cluster
+    goal chain over its slice of the ``[C, ...]`` axis) vs the
+    status-quo: looping the warm single-cluster ``optimize`` over the
+    same member models, one at a time.
+
+    Three always-on gates ride every run (any scale — they are
+    deterministic correctness, not performance):
+
+    - **bit-identical parity**: the fleet dispatch's proposals must equal
+      the sequential loop's, member by member, byte for byte;
+    - **zero warm recompiles**: repeat fleet dispatches after the first
+      must compile nothing on the device-runtime ledger;
+    - **one dispatch group**: homogeneous members must never silently
+      split into per-group dispatches (that would fake the amortization).
+
+    The ``>= 5x`` clusters/s gate is judged at bench scale only
+    (16 x 100x20k on CPU; ``gate=False`` for the tier-1 smoke): the win
+    is real device-level concurrency, so it needs real (or forced-host)
+    devices — scenario 6 forces 16 virtual CPU devices before jax
+    initializes."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig,
+                                             TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    from cruise_control_tpu.fleet import FleetModel, FleetOptimizer
+    from cruise_control_tpu.model.spec import flatten_spec
+    goals = goals_by_name(goal_names or GOALS)
+    spec = build_spec(num_brokers=num_brokers,
+                      num_partitions=num_partitions)
+    model, md = flatten_spec(spec)
+    # Per-cluster load variation: same topology, deterministically
+    # scaled loads — heterogeneous enough that every member's search
+    # does real distinct work, homogeneous enough for one dispatch
+    # group.
+    members = []
+    for c in range(num_clusters):
+        f = jnp.float32(1.0 + 0.01 * c)
+        members.append((f"cluster-{c:02d}",
+                        model.replace(leader_load=model.leader_load * f,
+                                      follower_load=model.follower_load
+                                      * f), md))
+    fleet = FleetModel.stack(members)
+    opt = TpuGoalOptimizer(
+        goals=goals,
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=512))
+    fleet_opt = FleetOptimizer(opt)
+    opts = OptimizationOptions(seed=seed, skip_hard_goal_check=True)
+
+    # Sequential baseline: the existing warm single-cluster path looped
+    # over the members (compile once on member 0, then time the loop).
+    opt.optimize(fleet.members[0].model, fleet.members[0].metadata, opts)
+    t0 = time.monotonic()
+    seq_results = [opt.optimize(m.model, m.metadata, opts)
+                   for m in fleet.members]
+    seq_s = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    fleet_results = fleet_opt.propose(fleet, opts)        # cold
+    cold_s = time.monotonic() - t0
+    if fleet_opt._groups_gauge_val != 1:
+        raise RuntimeError(
+            f"fleet bench split into {fleet_opt._groups_gauge_val} "
+            "dispatch groups — homogeneous members must share ONE "
+            "compiled program")
+    collector = default_collector()
+    before = collector.snapshot()
+    warm_s = float("inf")
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        fleet_results = fleet_opt.propose(fleet, opts)
+        warm_s = min(warm_s, time.monotonic() - t0)
+    after = collector.snapshot()
+    recompiles = (after["compileEvents"] + after["aotCompileEvents"]
+                  - before["compileEvents"] - before["aotCompileEvents"])
+    if recompiles:
+        raise RuntimeError(
+            f"fleet warm-recompile gate: {recompiles} compile events "
+            f"across {repeats} warm fleet dispatches (expected 0)")
+    for m, fr, sr in zip(fleet.members, fleet_results, seq_results):
+        if [p.to_json() for p in fr.proposals] \
+                != [p.to_json() for p in sr.proposals] \
+                or fr.num_moves != sr.num_moves:
+            raise RuntimeError(
+                f"fleet parity gate: {m.cluster_id} batched proposals "
+                "differ from the sequential per-cluster propose")
+
+    clusters_per_s = num_clusters / warm_s if warm_s > 0 else 0.0
+    speedup = seq_s / warm_s if warm_s > 0 else None
+    log(f"fleet propose ({num_clusters} x {num_brokers}x{num_partitions},"
+        f" {len(goals)} goals, {len(jax.devices())} devices): cold "
+        f"{cold_s:.2f}s warm {warm_s:.3f}s ({clusters_per_s:.1f} "
+        f"clusters/s); sequential loop {seq_s:.2f}s "
+        f"({'n/a' if speedup is None else f'{speedup:.1f}x'}); "
+        "parity bit-identical, 0 warm recompiles")
+    if gate and (speedup is None or speedup < 5.0):
+        raise RuntimeError(
+            f"fleet batching gate: batched propose only "
+            f"{speedup if speedup is None else round(speedup, 2)}x over "
+            f"{num_clusters} sequential per-cluster proposes (need >= 5x)")
+    if emit_row:
+        emit("fleet_propose_clusters_per_s", round(clusters_per_s, 3),
+             "clusters/s", round(speedup, 3) if speedup else None)
+    return {"cold_s": cold_s, "warm_s": warm_s, "seq_s": seq_s,
+            "speedup": speedup, "clusters_per_s": clusters_per_s,
+            "clusters": num_clusters, "recompiles": recompiles,
+            "devices": len(jax.devices())}
+
+
 def run_tracer_overhead_bench(num_brokers: int = 50,
                               num_partitions: int = 5_000, *,
                               goal_names: list | None = None,
@@ -1117,10 +1240,12 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5),
+                    choices=(1, 2, 3, 4, 5, 6),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
-                         "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99)")
+                         "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
+                         "6 = fleet batched propose, 16 clusters x "
+                         "100x20K)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -1142,6 +1267,16 @@ def main():
     platform = ensure_live_backend()
     global _RESOLVED_PLATFORM
     _RESOLVED_PLATFORM = platform
+    if args.scenario == 6 and platform.startswith("cpu"):
+        # The fleet dispatch shards the CLUSTER axis over devices; on a
+        # CPU host that concurrency needs forced virtual devices, set
+        # BEFORE jax initializes (real accelerators use their own).
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=16"
+            ).strip()
     import jax
     if args.scenario != 2:
         log(f"platform: {platform} -> {jax.devices()[0].platform}")
@@ -1156,6 +1291,11 @@ def main():
             run_demo_scenario()
         elif args.scenario == 5:
             run_replan_scenario(mesh_devices=args.mesh)
+        elif args.scenario == 6:
+            if args.mesh:
+                log("--mesh is ignored for scenario 6: the fleet "
+                    "dispatch owns the device axis (cluster sharding)")
+            run_fleet_propose_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

@@ -882,11 +882,33 @@ class TpuGoalOptimizer:
         low-utilization threshold; recommend shrinking to the smallest
         broker count that keeps utilization under the usable ceiling.
         """
+        from ..model.flat import broker_utilization
+        util = np.asarray(jax.device_get(broker_utilization(final)))
+        alive = np.asarray(jax.device_get(final.broker_alive
+                                          & final.broker_valid))
+        caps = np.asarray(jax.device_get(final.broker_capacity))
+
+        def placement():
+            # Lazy: only the shrink branch reads the placement, and the
+            # [P, R] fetch is real money at the 10Kx1M tier.
+            return (np.asarray(jax.device_get(final.replica_broker)),
+                    np.asarray(jax.device_get(final.broker_rack)))
+        return self._provision_verdict_from_host(
+            util, alive, caps, final.num_brokers_padded, goal_results,
+            placement=placement)
+
+    def _provision_verdict_from_host(self, util, alive, caps, B,
+                                     goal_results, *, placement):
+        """Host half of :meth:`_provision_verdict`, on already-fetched
+        arrays — the fleet layer computes every member's utilization in
+        one batched program and one stacked fetch, then runs this per
+        member with zero further device reads. ``placement`` is a lazy
+        ``() -> (replica_broker, broker_rack)`` (only the shrink branch
+        needs it)."""
         from ..detector.provisioner import (ProvisionRecommendation,
                                             ProvisionResponse,
                                             ProvisionStatus)
         from ..core.resources import RESOURCE_NAMES, Resource
-        from ..model.flat import broker_utilization
         cst = self.constraint
         response = ProvisionResponse()
 
@@ -898,10 +920,6 @@ class TpuGoalOptimizer:
                     "headroomPct": round(
                         100.0 * (1.0 - total / max(usable_total, 1e-9)),
                         2)}
-        util = np.asarray(jax.device_get(broker_utilization(final)))
-        alive = np.asarray(jax.device_get(final.broker_alive
-                                          & final.broker_valid))
-        caps = np.asarray(jax.device_get(final.broker_capacity))
         n_alive = max(int(alive.sum()), 1)
         violated_capacity = {g.name for g in goal_results
                              if g.hard and not g.satisfied
@@ -939,11 +957,10 @@ class TpuGoalOptimizer:
             # racks (rack-aware placement headroom) — a rack count, not a
             # broker count: when the alive brokers don't cover that many
             # racks, no shrink is recommended at all.
-            rb = np.asarray(jax.device_get(final.replica_broker))
-            valid_rb = rb < final.num_brokers_padded
+            rb, racks = placement()
+            valid_rb = rb < B
             total_replicas = int(valid_rb.sum())
             max_rf = int(valid_rb.sum(axis=1).max()) if rb.size else 0
-            racks = np.asarray(jax.device_get(final.broker_rack))
             num_alive_racks = len(set(racks[alive].tolist()))
             if num_alive_racks < max_rf + cst.overprovisioned_min_extra_racks:
                 if not response.recommendations:

@@ -46,7 +46,7 @@ class KafkaCruiseControl:
                  options_generator=None,
                  cpu_model: LinearRegressionModelParameters | None = None,
                  now_ms=None, admin_retry: RetryPolicy | None = None,
-                 sleep_ms=None) -> None:
+                 sleep_ms=None, cluster_id: str | None = None) -> None:
         self.admin = admin
         self.monitor = monitor
         self.task_runner = task_runner
@@ -83,8 +83,17 @@ class KafkaCruiseControl:
         #: StaleClusterModelError; operators who prefer availability
         #: over topology freshness during sample outages)
         self.allow_stale_execution = False
+        #: this stack's cluster identity (fleet.cluster.id when the fleet
+        #: layer is on): scopes the proposal cache so a fleet tick can
+        #: never serve another member's proposals through this facade.
+        self.cluster_id = cluster_id
         self.proposal_cache = ProposalCache(monitor, self.optimizer,
-                                            now_ms=self._now_ms)
+                                            now_ms=self._now_ms,
+                                            cache_id=cluster_id)
+        #: fleet registry (fleet/registry.py) when the fleet control
+        #: plane is enabled — serves /fleet and /fleet/rebalance and the
+        #: fleet section of /devicestats. None = single-cluster mode.
+        self.fleet = None
         #: what-if scenario engine scoring hypothetical topologies with
         #: the SAME goal chain the optimizer serves — /simulate and the
         #: resilience detector share its compiled sweep programs.
@@ -162,6 +171,14 @@ class KafkaCruiseControl:
             fetcher = getattr(self.task_runner, "fetcher", None)
             if fetcher is not None and hasattr(fetcher, "registry"):
                 regs.append(fetcher.registry)
+            if self.fleet is not None:
+                # Member registries arrive cluster-namespaced (the
+                # LOCAL cluster's monitor is deduped by identity above;
+                # remote members render as cc_<cluster>_*).
+                from ..core.sensors import NamespacedRegistry as _NR
+                regs.extend(r for r in self.fleet.scrape_registries()
+                            if not isinstance(r, _NR)
+                            or r.inner is not self.monitor.registry)
             return regs + list(self.extra_registries)
 
         self.registry = CompositeRegistry(_registries)
@@ -188,26 +205,32 @@ class KafkaCruiseControl:
                  start_precompute: bool = True,
                  skip_loading: bool = False,
                  freshness_target_ms: int = 0,
-                 start_prewarm: bool = False) -> None:
+                 start_prewarm: bool = False,
+                 precompute_watch_only: bool = False) -> None:
         """ref startUp() KafkaCruiseControl.java:221-227.
         ``skip_loading`` bypasses sample-store replay (ref
         skip.loading.samples). ``freshness_target_ms`` arms the proposal
         freshness SLO (proposals.freshness.target.ms; 0 = plain interval
         refresher); ``start_prewarm`` launches the background startup
-        pre-warm (prewarm.on.start)."""
+        pre-warm (prewarm.on.start). ``precompute_watch_only`` keeps the
+        freshness/breach accounting but never recomputes — the fleet
+        mode, where the registry's batched tick refills the cache."""
         if self.task_runner is not None and \
                 self.task_runner.state.value == "NOT_STARTED":
             self.task_runner.start(self._now_ms(), skip_loading=skip_loading)
         if start_precompute:
             self.proposal_cache.start_refresher(
                 precompute_interval_s, self._now_ms,
-                freshness_target_ms=freshness_target_ms)
+                freshness_target_ms=freshness_target_ms,
+                watch_only=precompute_watch_only)
         if start_prewarm:
             self.start_prewarm()
         if self.detector is not None:
             self.detector.start_detection()
 
     def shutdown(self) -> None:
+        if self.fleet is not None:
+            self.fleet.stop()
         self.proposal_cache.stop()
         self._prewarm_stop.set()
         if self._prewarm_thread is not None:
@@ -801,17 +824,36 @@ class KafkaCruiseControl:
 
     def device_stats_json(self) -> dict:
         """The full ``/devicestats`` payload: the device-runtime ledger
-        plus the resident-state section (epoch, last delta rows/bytes)
-        and the proposal-freshness readout — one dump answering "what is
-        resident, how fresh are the proposals, what did the runtime
-        pay"."""
+        plus the resident-state section (epoch, last delta rows/bytes),
+        the proposal-freshness readout, and — when the fleet control
+        plane is on — the fleet section (cluster count, shape bucket,
+        last batched-dispatch wall clock)."""
         payload = self.device_stats.to_json()
         resident = getattr(self.monitor, "resident", None)
         payload["resident"] = (resident.to_json()
                                if resident is not None else None)
         payload["proposalFreshness"] = self.proposal_cache.freshness_json(
             self._now_ms())
+        payload["fleet"] = (self.fleet.stats_json()
+                            if self.fleet is not None else None)
         return payload
+
+    # -------------------------------------------------------- fleet ops
+    def fleet_summary(self) -> dict:
+        """``GET /fleet``: per-cluster balance/freshness/risk summary.
+        With the fleet layer off this is an honest ``enabled: false``
+        rather than an error — dashboards poll it unconditionally."""
+        if self.fleet is None:
+            return {"enabled": False, "numClusters": 0, "clusters": []}
+        return self.fleet.summary_json(self._now_ms())
+
+    def fleet_rebalance(self) -> dict:
+        """``POST /fleet/rebalance``: force one fleet tick now (every
+        member recomputes and re-caches); execution stays per-cluster."""
+        if self.fleet is None:
+            raise ValueError(
+                "fleet control plane is disabled (fleet.enabled=false)")
+        return self.fleet.rebalance(self._now_ms())
 
     def state(self, substates: list[str] | None = None) -> dict:
         """ref GetStateRunnable -> CruiseControlState with substates."""

@@ -97,6 +97,14 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
                            "and padding waste",
                     [("json", "boolean",
                       "false renders the fixed-width text table")]),
+    "fleet": ("get", "Fleet summary: per-cluster balance score, proposal "
+                     "freshness and N-1 risk from the batched control "
+                     "plane (also at /fleet)", []),
+    "fleet_rebalance": ("post", "Force one fleet tick: every member "
+                                "recomputes through the batched [C] "
+                                "dispatch and re-caches its proposals; "
+                                "execution stays per-cluster (also at "
+                                "/fleet/rebalance)", []),
 }
 
 
@@ -354,12 +362,59 @@ _SCHEMAS = {
                                "ageMs how old the cached result is",
                 "properties": {
                     "valid": {"type": "boolean"},
+                    "cacheId": {"type": "string", "nullable": True},
                     "ageMs": {"type": "integer", "nullable": True},
                     "lagMs": {"type": "integer", "nullable": True},
                     "targetMs": {"type": "integer", "nullable": True},
                     "computations": {"type": "integer"},
                     "breaches": {"type": "integer"},
                 }},
+            "fleet": {
+                "type": "object", "nullable": True,
+                "description": "fleet control plane (fleet/registry.py): "
+                               "cluster count, current shape bucket and "
+                               "the last batched dispatch's wall clock; "
+                               "null when fleet.enabled=false",
+                "properties": {
+                    "clusterCount": {"type": "integer"},
+                    "ticks": {"type": "integer"},
+                    "bucket": {"type": "object", "nullable": True},
+                    "lastDispatchMs": {"type": "number",
+                                       "nullable": True},
+                    "lastTickMs": {"type": "integer", "nullable": True},
+                }},
+        }},
+    "FleetSummary": {
+        "type": "object",
+        "description": "per-cluster fleet readout (fleet/registry.py): "
+                       "balance score = fraction of chain goals "
+                       "satisfied, freshness = the member cache's SLO "
+                       "view, risk = the batched N-1 sweep's verdict",
+        "properties": {
+            "enabled": {"type": "boolean"},
+            "numClusters": {"type": "integer"},
+            "ticks": {"type": "integer"},
+            "lastTickMs": {"type": "integer", "nullable": True},
+            "bucket": {"type": "object", "nullable": True},
+            "lastDispatchMs": {"type": "number", "nullable": True},
+            "clusters": {"type": "array", "items": {
+                "type": "object",
+                "properties": {
+                    "clusterId": {"type": "string"},
+                    "ready": {"type": "boolean"},
+                    "generation": {"type": "integer", "nullable": True},
+                    "balanceScore": {"type": "number"},
+                    "violatedGoals": {"type": "array",
+                                      "items": {"type": "string"}},
+                    "violatedHardGoals": {"type": "array",
+                                          "items": {"type": "string"}},
+                    "numProposals": {"type": "integer"},
+                    "numMoves": {"type": "integer"},
+                    "staleModel": {"type": "boolean"},
+                    "freshness": {"type": "object"},
+                    "risk": {"type": "object", "nullable": True},
+                    "lastError": {"type": "string", "nullable": True},
+                }}},
         }},
 }
 
@@ -393,6 +448,8 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
             ok.update(_ref("TraceEvents"))
         elif name == "devicestats":
             ok.update(_ref("DeviceStats"))
+        elif name in ("fleet", "fleet_rebalance"):
+            ok.update(_ref("FleetSummary"))
         # JSON is the documented default body (json defaults true): every
         # 200 advertises application/json — a typed $ref where one
         # exists, a generic object otherwise.

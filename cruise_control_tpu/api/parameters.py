@@ -540,6 +540,16 @@ class PauseResumeParameters(EndpointParameters):
     """ref PauseResumeParameters.java (reason is in COMMON_PARAMS)."""
 
 
+class FleetParameters(EndpointParameters):
+    """``GET /fleet`` — the fleet summary takes only the common params
+    (json=false renders the fixed-width table)."""
+
+
+class FleetRebalanceParameters(EndpointParameters):
+    """``POST /fleet/rebalance`` — a forced fleet tick; proposals land
+    in the member caches, execution stays per-cluster."""
+
+
 #: endpoint -> parameter class (ref CruiseControlEndPoint -> Parameters
 #: wiring in KafkaCruiseControlServlet)
 ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
@@ -568,6 +578,8 @@ ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
     "pause_sampling": PauseResumeParameters,
     "resume_sampling": PauseResumeParameters,
     "simulate": SimulateParameters,
+    "fleet": FleetParameters,
+    "fleet_rebalance": FleetRebalanceParameters,
 }
 
 

@@ -211,6 +211,44 @@ def _render_devicestats(payload: dict) -> str:
                  f"{fresh.get('targetMs')} ms), "
                  f"{fresh.get('computations')} computations, "
                  f"{fresh.get('breaches')} SLO breaches")
+    fleet = payload.get("fleet")
+    if fleet:
+        bucket = fleet.get("bucket") or {}
+        text += (f"\nfleet: {fleet.get('clusterCount')} clusters, "
+                 f"{fleet.get('ticks')} ticks, bucket "
+                 f"{bucket.get('clustersPadded', '-')}x"
+                 f"{bucket.get('brokersPadded', '-')}x"
+                 f"{bucket.get('partitionsPadded', '-')}, last dispatch "
+                 f"{fleet.get('lastDispatchMs')} ms")
+    return text
+
+
+def _render_fleet(payload: dict) -> str:
+    if not payload.get("enabled"):
+        return "fleet control plane disabled (fleet.enabled=false)"
+    rows = []
+    for c in payload.get("clusters", []):
+        fresh = c.get("freshness") or {}
+        risk = c.get("risk") or {}
+        rows.append([
+            c.get("clusterId"),
+            "ready" if c.get("ready") else "NOT-READY",
+            c.get("generation"),
+            c.get("balanceScore", "-"),
+            c.get("numProposals", "-"),
+            "yes" if fresh.get("valid") else "no",
+            fresh.get("ageMs", "-"),
+            risk.get("maxRisk", "-"),
+            risk.get("riskiestBroker", "-")])
+    text = _table(["CLUSTER", "STATE", "GEN", "BALANCE", "PROPOSALS",
+                   "FRESH", "AGE-MS", "N1-RISK", "RISKIEST"], rows)
+    bucket = payload.get("bucket") or {}
+    text += (f"\n\n{payload.get('numClusters')} clusters, "
+             f"{payload.get('ticks')} ticks, bucket "
+             f"{bucket.get('clustersPadded', '-')}x"
+             f"{bucket.get('brokersPadded', '-')}x"
+             f"{bucket.get('partitionsPadded', '-')}, last dispatch "
+             f"{payload.get('lastDispatchMs')} ms")
     return text
 
 
@@ -218,6 +256,8 @@ _RENDERERS = {
     "load": _render_load,
     "simulate": _render_simulate,
     "devicestats": _render_devicestats,
+    "fleet": _render_fleet,
+    "fleet_rebalance": _render_fleet,
     "partition_load": _render_partition_load,
     "proposals": _render_proposals,
     "rebalance": _render_proposals,

@@ -36,10 +36,20 @@ LOG = logging.getLogger(__name__)
 class ProposalCache:
     def __init__(self, monitor, optimizer, *,
                  options: OptimizationOptions | None = None,
-                 registry=None, now_ms=None) -> None:
+                 registry=None, now_ms=None,
+                 cache_id: str | None = None) -> None:
         from ..core.sensors import MetricRegistry
         self.monitor = monitor
         self.optimizer = optimizer
+        #: cluster-scoped cache identity (fleet members): carried into
+        #: the freshness gauge/meter names so two members' series never
+        #: collide on one scrape, and enforced by :meth:`store` so a
+        #: result computed for one cluster can never serve another —
+        #: generation numbers are per-monitor counters, so two clusters
+        #: can easily sit at the SAME generation int and generation
+        #: keying alone cannot tell them apart. None = the single-cluster
+        #: default (names unchanged).
+        self.cache_id = cache_id
         # The cache is a dry-run measurement: a hard goal that cannot be
         # satisfied is a *cacheable finding* (served with its provision
         # verdict), not an error to re-burn compute on every refresh tick.
@@ -68,14 +78,19 @@ class ProposalCache:
         #: landing after a newer one was marked cannot double-count)
         self._breach_marked_gen: int | None = None
         self.registry = registry or MetricRegistry()
+        # Cluster-scoped sensor group: fleet members' freshness series
+        # render as ProposalCache.<cache_id>.freshness-* so one merged
+        # scrape over many members stays unambiguous.
+        group = (f"ProposalCache.{cache_id}" if cache_id
+                 else "ProposalCache")
         name = MetricRegistry.name
         self._breaches = self.registry.meter(
-            name("ProposalCache", "freshness-slo-breaches"))
-        self.registry.gauge(name("ProposalCache", "freshness-age-ms"),
+            name(group, "freshness-slo-breaches"))
+        self.registry.gauge(name(group, "freshness-age-ms"),
                             self.freshness_age_ms)
-        self.registry.gauge(name("ProposalCache", "freshness-lag-ms"),
+        self.registry.gauge(name(group, "freshness-lag-ms"),
                             self.freshness_lag_ms)
-        self.registry.gauge(name("ProposalCache", "freshness-target-ms"),
+        self.registry.gauge(name(group, "freshness-target-ms"),
                             lambda: self.freshness_target_ms or None)
 
     # ------------------------------------------------------------- reads
@@ -130,6 +145,7 @@ class ProposalCache:
         """The ``proposalFreshness`` section of ``/devicestats``."""
         now = now_ms if now_ms is not None else self._now_ms_fn()
         return {"valid": self.valid(),
+                "cacheId": self.cache_id,
                 "ageMs": self.freshness_age_ms(now),
                 "lagMs": self.freshness_lag_ms(now),
                 "targetMs": self.freshness_target_ms or None,
@@ -220,15 +236,23 @@ class ProposalCache:
             self.freshness_target_ms)
 
     def store(self, result, *, generation: int,
-              scenario_label: str | None = None) -> bool:
+              scenario_label: str | None = None,
+              cache_id: str | None = None) -> bool:
         """Offer an externally computed OptimizerResult to the cache.
 
-        The ONLY write path besides :meth:`_compute`, with two guards:
+        The ONLY write path besides :meth:`_compute`, with three guards:
 
         - **scenario rejection** (hard error): results computed from a
           what-if scenario transform carry the scenario label and are
           refused outright — ``/simulate`` and the resilience detector's
           proactive sweeps can never poison the live-cluster cache.
+        - **cluster scoping** (hard error): when this cache carries a
+          ``cache_id`` (a fleet member), a result offered under a
+          DIFFERENT id is a wiring bug — generation ints are
+          per-monitor counters, so two clusters at the same generation
+          would otherwise cross-serve each other's proposals silently.
+          A result offered with no id at all is likewise refused on an
+          id-scoped cache (the fleet tick always stamps its writes).
         - **generation keying** (soft reject): a result computed against
           any generation other than the monitor's CURRENT one is dropped
           (returns False) — by the time it arrives it describes a
@@ -239,6 +263,11 @@ class ProposalCache:
                 f"proposal cache refuses scenario-modified result "
                 f"{scenario_label!r}: only live-cluster optimizations "
                 "may be cached")
+        if self.cache_id is not None and cache_id != self.cache_id:
+            raise ValueError(
+                f"proposal cache {self.cache_id!r} refuses result "
+                f"offered for cluster {cache_id!r}: fleet members must "
+                "never cross-serve proposals")
         with self._lock:
             if generation != self.monitor.generation:
                 return False
@@ -255,12 +284,14 @@ class ProposalCache:
             self._cached_at_ms = None
 
     # ------------------------------------------- background refresh loop
-    def refresh_once(self, now_ms_fn=None) -> bool:
+    def refresh_once(self, now_ms_fn=None, *, compute: bool = True) -> bool:
         """One freshness tick: observe the generation, recompute when the
         cache no longer answers it. Returns True when a recompute ran
         (False on cache-valid ticks and on compute failures — monitor
         not ready / transient errors retry next tick, ref :160-167 skip
-        states)."""
+        states). ``compute=False`` is the watch-only form: full breach
+        accounting, no recompute — for caches whose refills arrive from
+        elsewhere (the fleet tick's batched store)."""
         fn = now_ms_fn or self._now_ms_fn
         now = fn()
         self.observe_generation(now)
@@ -279,6 +310,8 @@ class ProposalCache:
             if (had_cache and gen is not None and lag is not None
                     and lag > self.freshness_target_ms):
                 self._mark_breach(gen, lag)
+        if not compute:
+            return False
         try:
             self.get(fn())
             return True
@@ -286,11 +319,17 @@ class ProposalCache:
             return False
 
     def start_refresher(self, interval_s: float, now_ms_fn, *,
-                        freshness_target_ms: int = 0) -> None:
+                        freshness_target_ms: int = 0,
+                        watch_only: bool = False) -> None:
         """ref the precompute thread started by KafkaCruiseControl.startUp
         (KafkaCruiseControl.java:225). With a freshness target the tick
         tightens to ``min(interval, target/4)`` so a generation bump is
-        noticed (and recomputed) well inside the SLO window."""
+        noticed (and recomputed) well inside the SLO window.
+
+        ``watch_only``: keep the full freshness/breach accounting but
+        never recompute — for fleet members, whose caches are refilled
+        by the registry's batched tick (a second per-cluster compute
+        racing it would just duplicate device work)."""
         if self._refresher is not None:
             return
         # Fresh stop event per start (stop() leaves the old one set):
@@ -313,10 +352,13 @@ class ProposalCache:
             # describe sweeps before it can raise. Doubling up to the
             # plain interval restores the pre-SLO cadence under
             # persistent failure; any success (or a valid cache) snaps
-            # back to the fast tick.
+            # back to the fast tick. (Watch-only loops never compute, so
+            # they always tick fast — breach observation is cheap.)
             delay = tick
             while not stop.wait(delay):
-                if self.refresh_once(now_ms_fn) or self.valid():
+                if self.refresh_once(now_ms_fn,
+                                     compute=not watch_only) \
+                        or watch_only or self.valid():
                     delay = tick
                 else:
                     delay = min(max(delay * 2, tick), interval_s)

@@ -30,6 +30,9 @@ ENDPOINT_MIN_ROLE: dict[str, Role] = {
     # simulate is a pure read (dry-run what-if analysis), VIEWER like
     # proposals despite being a POST.
     "simulate": Role.VIEWER,
+    # fleet summary is a read; a forced fleet recompute is USER-level
+    # like rebalance (it only refreshes member caches, never executes).
+    "fleet": Role.VIEWER, "fleet_rebalance": Role.USER,
     "rebalance": Role.USER, "add_broker": Role.USER,
     "remove_broker": Role.USER, "demote_broker": Role.USER,
     "fix_offline_replicas": Role.USER, "topic_configuration": Role.USER,

@@ -40,17 +40,21 @@ _PLAINTEXT_MARKER = "x-cc-render-plaintext"
 
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
-                 "permissions", "bootstrap", "train", "openapi"}
+                 "permissions", "bootstrap", "train", "openapi", "fleet"}
 POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                   "fix_offline_replicas", "demote_broker",
                   "topic_configuration", "rightsize", "remove_disks",
                   "stop_proposal_execution", "pause_sampling",
-                  "resume_sampling", "admin", "review", "simulate"}
+                  "resume_sampling", "admin", "review", "simulate",
+                  "fleet_rebalance"}
 #: POSTs that execute immediately even with two-step verification on
 #: (ref Purgatory: REVIEW itself and flow-control endpoints skip review;
 #: simulate is a pure read — a what-if sweep mutates nothing, so parking
-#: it for review would only delay the answer).
-NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution", "simulate"}
+#: it for review would only delay the answer; fleet_rebalance only
+#: refreshes the members' proposal caches — execution stays behind the
+#: per-cluster endpoints, which keep their review parking).
+NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution", "simulate",
+                      "fleet_rebalance"}
 #: bare GET handlers outside the servlet endpoint table (observability
 #: surfaces + the API explorer) — instrumented through the same shared
 #: request-timing wrapper as every dispatched endpoint.
@@ -646,6 +650,10 @@ class CruiseControlApp:
                     raise ValueError(
                         f"parameter scenarios is not valid JSON: {e}")
             return 200, facade.simulate(payload), {}
+        if endpoint == "fleet":
+            return 200, facade.fleet_summary(), {}
+        if endpoint == "fleet_rebalance":
+            return 200, facade.fleet_rebalance(), {}
         return 404, {"errorMessage": f"unknown endpoint {endpoint}"}, {}
 
     def _admin(self, params: ParsedParams) -> dict:
@@ -815,6 +823,15 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
                     (render("devicestats", payload) + "\n").encode(),
                     dict(app.cors))
         return json_resp(200, payload)
+    # /fleet and /fleet/rebalance: REST-shaped aliases for the fleet
+    # endpoints (also reachable at their flat servlet names). Rewritten
+    # before the flat-path check so they dispatch through the ordinary
+    # typed/secured handler path.
+    rest = parts[1:] if parts[:1] == ["kafkacruisecontrol"] else parts
+    if rest == ["fleet", "rebalance"]:
+        parts = ["kafkacruisecontrol", "fleet_rebalance"]
+    elif rest == ["fleet"]:
+        parts = ["kafkacruisecontrol", "fleet"]
     if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
         return json_resp(404, {"errorMessage": f"bad path {parsed.path}"})
     endpoint = parts[1].lower()

@@ -596,6 +596,29 @@ def _detector_defs(d: ConfigDef) -> None:
                  "the default covers an N-2 pairwise sweep up to 128 "
                  "brokers — lower it to bound device memory on very "
                  "large partition counts)")
+    d.define("fleet.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Fleet control plane (fleet/registry.py): this process "
+                 "balances MANY clusters through one batched [C] device "
+                 "dispatch per tick. The local stack registers as the "
+                 "first member (fleet.cluster.id); further members join "
+                 "programmatically via facade.fleet.register(). Mutually "
+                 "exclusive with search.mesh.devices and search.branches "
+                 "— the fleet owns the device axis (docs/fleet.md).")
+    d.define("fleet.tick.ms", ConfigType.LONG, 30_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Shared fleet tick interval: every tick builds each "
+                 "member's model and refreshes stale member proposal "
+                 "caches in one batched dispatch (docs/fleet.md)")
+    d.define("fleet.max.clusters", ConfigType.INT, 64,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Registration cap on fleet members; bounds the [C] "
+                 "batch the device program compiles for")
+    d.define("fleet.cluster.id", ConfigType.STRING, "local",
+             importance=Importance.LOW,
+             doc="This stack's cluster id inside the fleet: scopes its "
+                 "proposal cache (ProposalCache.<id>.* sensors) so fleet "
+                 "members never cross-serve proposals")
     d.define("kafka.broker.failure.detection.enable", ConfigType.BOOLEAN,
              False, importance=Importance.LOW,
              doc="Use metadata-polling broker failure detection (the "
@@ -896,6 +919,15 @@ class CruiseControlConfig(AbstractConfig):
                 f"model across devices. Got search.branches={branches}, "
                 f"search.mesh.devices={mesh} — unset one of them "
                 "(docs/scaling.md explains when each wins).")
+        if self.get_boolean("fleet.enabled") and (mesh != 0
+                                                  or branches > 1):
+            raise ConfigException(
+                "fleet.enabled is mutually exclusive with "
+                "search.mesh.devices and search.branches: the fleet "
+                "shards the CLUSTER axis over the local devices, so "
+                "neither the partition-axis mesh nor best-of-N branches "
+                f"can own them too. Got search.branches={branches}, "
+                f"search.mesh.devices={mesh} (docs/fleet.md).")
         # Even sharding: every padded partition count is a multiple of
         # the pad multiple, so the multiple itself must divide by the
         # mesh device count. mesh == -1 (all devices) re-checks at

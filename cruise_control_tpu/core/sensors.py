@@ -185,18 +185,25 @@ def _family_names(base: str, s: object) -> tuple[str, ...]:
     return (base,)
 
 
-def _flatten_names(items: list[tuple[str, object]]) -> dict[str, str]:
-    """Dotted sensor name -> unique ``cc_`` series base.
+def _flatten_names(items: list[tuple[str, object]]) -> list[str]:
+    """Per-item unique ``cc_`` series base (positional — aligned with
+    ``items``).
 
     Flattening maps every non-alphanumeric to ``_``, so distinct dotted
     names can collide (``A.b-c`` and ``A.b.c`` both flatten to
-    ``cc_A_b_c``) — which used to emit duplicate ``# TYPE`` blocks, an
-    exposition-format violation. Uniqueness is enforced on the RENDERED
-    family names (kind suffixes included: a Counter ``A.b`` and a Gauge
-    ``A.b.total`` both render family ``cc_A_b_total``), disambiguated
-    deterministically (sorted input order) with a numeric suffix."""
+    ``cc_A_b_c``) — and a merged multi-registry scrape can even carry
+    the SAME dotted name twice (two fleet members' monitors). Both used
+    to emit duplicate ``# TYPE`` blocks, an exposition-format violation.
+    Uniqueness is enforced positionally on the RENDERED family names
+    (kind suffixes included: a Counter ``A.b`` and a Gauge ``A.b.total``
+    both render family ``cc_A_b_total``), disambiguated deterministically
+    (sorted input order) with a numeric suffix. Suffix-deduped families
+    are format-legal but unattributable — fleet scrapes must namespace
+    per-cluster registries instead (:class:`NamespacedRegistry`;
+    tests/prom_lint.py's ``forbid_unlabeled_duplicates`` rejects the
+    suffix form)."""
     assigned: set[str] = set()
-    out: dict[str, str] = {}
+    out: list[str] = []
     for name, s in items:
         base = "cc_" + "".join(ch if (ch.isalnum() or ch == "_") else "_"
                                for ch in name)
@@ -205,7 +212,7 @@ def _flatten_names(items: list[tuple[str, object]]) -> dict[str, str]:
             i += 1
             candidate = f"{base}_{i}"
         assigned.update(_family_names(candidate, s))
-        out[name] = candidate
+        out.append(candidate)
     return out
 
 
@@ -222,8 +229,7 @@ def _render_exposition(items: list[tuple[str, object]]) -> str:
         lines.append(f"# HELP {series} sensor {dotted}")
         lines.append(f"# TYPE {series} {kind}")
 
-    for name, s in items:
-        base = flat[name]
+    for (name, s), base in zip(items, flat):
         if isinstance(s, Counter):
             family(f"{base}_total", name, "counter")
             lines.append(f"{base}_total {s.count}")
@@ -387,6 +393,54 @@ class CompositeRegistry:
             for name, s in snap():
                 merged.setdefault(name, s)
         return _render_exposition(sorted(merged.items())) + "".join(foreign)
+
+
+class NamespacedRegistry:
+    """Read-only prefix view over a registry: every dotted sensor name
+    renders as ``<prefix>.<name>``.
+
+    The fleet layer's scrape problem: registries from multiple
+    ``LoadMonitor``/``ProposalCache`` instances (one per member cluster)
+    carry IDENTICAL group-prefixed names, so a merged exposition used to
+    fall back to ``_flatten_names``' numeric-suffix disambiguation
+    (``cc_LoadMonitor_..._2``) — unlabeled duplicates nobody can
+    attribute to a cluster. Wrapping each member's registries in a
+    ``NamespacedRegistry(reg, cluster_id)`` renders
+    ``cc_<cluster>_LoadMonitor_...`` instead; ``tests/prom_lint.py``'s
+    ``forbid_unlabeled_duplicates`` rejects the un-namespaced form.
+
+    ``get``/``names`` resolve PREFIXED names (the merge surface); the
+    inner registry keeps answering its own un-prefixed names for the
+    subsystem that owns it.
+    """
+
+    def __init__(self, inner, prefix: str) -> None:
+        if not prefix:
+            raise ValueError("NamespacedRegistry requires a prefix")
+        self.inner = inner
+        self.prefix = prefix
+
+    def _wrap(self, name: str) -> str:
+        return f"{self.prefix}.{name}"
+
+    def snapshot(self) -> list[tuple[str, object]]:
+        return [(self._wrap(n), s) for n, s in self.inner.snapshot()]
+
+    def get(self, name: str):
+        pre = f"{self.prefix}."
+        if not name.startswith(pre):
+            return None
+        return self.inner.get(name[len(pre):])
+
+    def names(self) -> list[str]:
+        return sorted(self._wrap(n) for n in self.inner.names())
+
+    def to_json(self) -> dict:
+        return {self._wrap(n): s.to_json()
+                for n, s in self.inner.snapshot()}
+
+    def expose_text(self) -> str:
+        return _render_exposition(self.snapshot())
 
 
 #: Sensor group names (ref CruiseControlMetrics sensor name constants).

@@ -1,0 +1,17 @@
+"""Fleet-scale control plane (L-fleet): one process balancing many
+clusters through ONE batched device dispatch per tick.
+
+``model/fleet.py`` stacks per-cluster flat models into ``[C, ...]``
+arrays; ``engine.py`` runs the full optimize loop (goal chain +
+hard-goal audit + polish) and the N-1 resilience sweep over the cluster
+axis in one dispatch each; ``registry.py`` is the host side — per-cluster
+monitors feeding the shared tick, per-cluster proposal caches, anomaly
+fan-out, and the ``/fleet`` API surface.
+"""
+
+from ..model.fleet import FleetMember, FleetModel
+from .engine import CLUSTER_AXIS, FleetOptimizer
+from .registry import FleetRegistry
+
+__all__ = ["FleetMember", "FleetModel", "FleetOptimizer", "FleetRegistry",
+           "CLUSTER_AXIS"]

@@ -1,0 +1,807 @@
+"""Fleet device engine: one dispatch optimizes (and risk-scores) a whole
+fleet of clusters.
+
+The scenario axis taught us how to batch *scoring* (``whatif/engine.py``
+vmaps a pure scorer over ``[S]``); the cluster axis must batch the full
+*search*. vmapping the goal-chain passes is the wrong tool there — the
+batching rewrite turns every converged-goal ``lax.cond`` early-exit into
+both-branches execution and batches the hot scatter paths, measured
+SLOWER than the sequential loop on CPU. Instead the fleet walk shards
+the cluster axis over a device mesh (``shard_map``, like
+``parallel/branches.py`` does for search branches) and runs the
+UNMODIFIED single-cluster pass functions per cluster via ``lax.map``
+(a scan — real control flow, no batching rewrite). Consequences:
+
+- **bit-identical by construction**: each cluster executes exactly the
+  program the single-cluster optimizer would run on the same (fleet-
+  bucket-padded) model, so fleet proposals equal sequential per-cluster
+  proposals byte for byte (tier-1 gated in ``tests/test_fleet.py``);
+- **real amortization**: clusters run concurrently across devices
+  (measured 12x over the sequential loop for 16 x (100 brokers x 20k
+  partitions) on a 24-core CPU host with 16 virtual devices) and the
+  whole fleet costs ONE dispatch + one host sync per walk instead of
+  ``C x G`` dispatches;
+- **one compiled program per fleet bucket**: the program cache keys on
+  (shapes, cluster bucket, goal binding) through the shared
+  ``parallel/batching.ProgramCache`` — the machinery lifted out of the
+  what-if engine.
+
+Host-side orchestration (polish rounds, self-check, hard-goal gate,
+proposal diffing) mirrors ``TpuGoalOptimizer._optimize_impl``'s per-goal
+path exactly, with per-cluster ``enabled`` masks standing in for the
+host's per-cluster control flow: a disabled (converged or padding)
+cluster's pass is a runtime ``lax.cond`` skip, not a masked execution.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..analyzer.engine import violation_stack
+from ..analyzer.optimizer import (GoalResult, OptimizationFailureError,
+                                  OptimizerResult, _as_jnp)
+from ..analyzer.options import OptimizationOptions
+from ..analyzer.state import build_context, init_state
+from ..model.fleet import FleetModel
+from ..parallel._compat import shard_map
+from ..parallel.batching import ProgramCache, round_up
+from ..whatif.engine import (make_scenario_scorer, risk_scores,
+                             violated_matrix)
+
+LOG = logging.getLogger(__name__)
+
+CLUSTER_AXIS = "cluster"
+
+
+def _tree_specs(tree, spec):
+    return jax.tree.map(lambda _: spec, tree)
+
+
+def _shape_sig(*trees) -> tuple:
+    return tuple((tuple(a.shape), str(a.dtype))
+                 for a in jax.tree.leaves(trees))
+
+
+class FleetOptimizer:
+    """Batched fleet propose/score on top of a single-cluster
+    ``TpuGoalOptimizer`` (whose goals, search config, constraint,
+    options generator, registered hard goals and compiled-chain registry
+    it shares — the fleet walk re-traces the SAME pass functions the
+    sequential path compiled, so the process-wide ``_SHARED_CHAINS``
+    stays the one source of chain identity).
+
+    Members whose scaled search config or goal binding differ (pattern
+    goals resolving against different topic sets, topic-count state of
+    different widths) cannot share one traced program; :meth:`propose`
+    groups members by that compiled identity and runs one dispatch per
+    group — the documented degrade path for heterogeneous fleets
+    (docs/fleet.md). A homogeneous fleet is always one group.
+    """
+
+    def __init__(self, optimizer, *, max_devices: int | None = None,
+                 scenario_pad_multiple: int = 8,
+                 program_cache_size: int = 8,
+                 registry=None, tracer=None, collector=None) -> None:
+        from ..core.runtime_obs import default_collector
+        from ..core.sensors import MetricRegistry
+        from ..core.tracing import default_tracer
+        if getattr(optimizer, "branches", 0) and optimizer.branches > 1:
+            raise ValueError(
+                "fleet batching and search.branches are mutually "
+                "exclusive: both own the device axis")
+        if getattr(optimizer, "mesh", None) is not None:
+            raise ValueError(
+                "fleet batching and search.mesh.devices are mutually "
+                "exclusive: the fleet shards the cluster axis, the mesh "
+                "the partition axis")
+        self.optimizer = optimizer
+        self.max_devices = max_devices
+        self.scenario_pad_multiple = scenario_pad_multiple
+        self._programs = ProgramCache(program_cache_size)
+        self._meshes: dict[int, Mesh] = {}
+        self.registry = registry or MetricRegistry()
+        self.tracer = tracer or default_tracer()
+        self.collector = collector or default_collector()
+        name = MetricRegistry.name
+        self._propose_timer = self.registry.timer(
+            name("FleetOptimizer", "propose-timer"))
+        self._dispatch_timer = self.registry.timer(
+            name("FleetOptimizer", "dispatch-timer"))
+        self._clusters_meter = self.registry.meter(
+            name("FleetOptimizer", "clusters-proposed"))
+        self._groups_gauge_val = 0
+        self.registry.gauge(name("FleetOptimizer", "last-propose-groups"),
+                            lambda: self._groups_gauge_val)
+        #: wall clock of the most recent device dispatch (the
+        #: /devicestats fleet section reads this)
+        self.last_dispatch_s: float | None = None
+        self.last_layout: dict | None = None
+        #: cluster-axis shape floor: lay out every batch as if it held at
+        #: least this many clusters (padding slots run the per-goal skip
+        #: branch, nearly free). The registry pins it to its member count
+        #: so a tick over a SUBSET of members (some still warming in)
+        #: reuses the full fleet's compiled programs instead of
+        #: compiling one program set per distinct subset size.
+        self.cluster_bucket_floor: int = 0
+
+    # ---------------------------------------------------------- layout
+    def _device_cap(self) -> int:
+        cap = self.max_devices or len(jax.devices())
+        return max(min(cap, len(jax.devices())), 1)
+
+    def _layout(self, C: int) -> tuple[int, int, int]:
+        """(devices D, clusters-per-device k, padded cluster count) for a
+        C-cluster group: minimize padding slots subject to the device
+        cap — k = ceil(C / cap), D = ceil(C / k) — with the cluster
+        bucket floor applied first so nearby batch sizes share one
+        compiled shape."""
+        cap = self._device_cap()
+        C = max(C, self.cluster_bucket_floor or 0, 1)
+        k = math.ceil(C / cap)
+        D = math.ceil(C / k)
+        return D, k, D * k
+
+    def _mesh(self, D: int) -> Mesh:
+        mesh = self._meshes.get(D)
+        if mesh is None:
+            mesh = Mesh(np.asarray(jax.devices()[:D]), (CLUSTER_AXIS,))
+            self._meshes[D] = mesh
+        return mesh
+
+    # --------------------------------------------------------- propose
+    def propose(self, fleet: FleetModel,
+                options: OptimizationOptions | None = None) -> list:
+        """Optimize every fleet member; returns a list aligned with
+        ``fleet.members`` whose entries are ``OptimizerResult``s — or
+        ``OptimizationFailureError``s for members whose hard goals stay
+        violated under strict options (the sequential path raises; a
+        fleet dispatch must not let one member's failure destroy the
+        others' results)."""
+        options = options or OptimizationOptions()
+        t0 = time.monotonic()
+        C = fleet.num_clusters
+        with self.collector.cycle("fleet-propose"), \
+                self.tracer.span("fleet.propose", clusters=C) as sp:
+            prepared = [self._prepare_member(m, options)
+                        for m in fleet.members]
+            groups: dict[tuple, list[int]] = {}
+            for i, prep in enumerate(prepared):
+                groups.setdefault(prep["group_key"], []).append(i)
+            self._groups_gauge_val = len(groups)
+            if len(groups) > 1:
+                LOG.info(
+                    "fleet propose split into %d dispatch groups "
+                    "(heterogeneous search configs or goal bindings)",
+                    len(groups))
+            results: list = [None] * C
+            dispatch_s = 0.0
+            for idxs in groups.values():
+                dispatch_s += self._propose_group(
+                    fleet, prepared, idxs, results)
+            self.last_dispatch_s = dispatch_s
+            sp.set(groups=len(groups),
+                   dispatchMs=round(dispatch_s * 1e3, 3))
+        self._propose_timer.update(time.monotonic() - t0)
+        self._clusters_meter.mark(C)
+        return results
+
+    def _prepare_member(self, member, options: OptimizationOptions) -> dict:
+        """Mirror of ``TpuGoalOptimizer._prepare`` for one member (minus
+        mesh/chain-warmup): generated options, scaled config, bound
+        goals, audit set, search context and initial state — plus the
+        compiled-identity group key."""
+        opt = self.optimizer
+        md = member.metadata
+        model = member.model
+        opts = options
+        if opt.options_generator is not None:
+            opts = opt.options_generator.generate(opts, md)
+        cfg = opt.config.scaled_for(md.num_partitions, md.num_brokers)
+        if opts.fast_mode:
+            cfg = replace(
+                cfg,
+                max_iters_per_goal=max(cfg.max_iters_per_goal // 4, 16)
+            ).scaled_for(max(md.num_partitions // 4, 8), md.num_brokers)
+        goals = [g.bind(md) for g in opt.goals]
+        audit = opt._audit_goals_for(goals, md, opts)
+        Pn = model.num_partitions_padded
+        B = model.num_brokers_padded
+        masks = (opts.excluded_partition_mask(md, Pn),
+                 opts.replica_move_exclusion_mask(md, B),
+                 opts.broker_mask(md, B,
+                                  opts.excluded_brokers_for_leadership))
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals + audit)
+        needs_topics = needs_tlc or any(g.uses_topic_counts
+                                        for g in goals + audit)
+        num_topics = md.num_topics if needs_topics else None
+        group_key = (
+            cfg,
+            tuple((type(g), g.name, g.hard,
+                   getattr(g, "constraint", None), g.bind_signature())
+                  for g in goals),
+            tuple((g.name, g.bind_signature()) for g in audit),
+            num_topics, needs_tlc,
+            tuple(m is None for m in masks),
+            # The PRNG stream is shared across a group (one keys array
+            # per dispatch): an options generator varying the seed per
+            # cluster must split groups, or members would silently run
+            # under member 0's stream and break sequential parity.
+            opts.seed)
+        return {"member": member, "opts": opts, "cfg": cfg,
+                "goals": goals, "audit": audit, "masks": masks,
+                "num_topics": num_topics, "needs_tlc": needs_tlc,
+                "group_key": group_key}
+
+    @staticmethod
+    def _member_state_ctx(prep):
+        """Eager per-member state/ctx — exactly the sequential
+        ``_prepare``'s construction; the fallback when request options
+        carry exclusion masks (which are per-metadata arrays the batched
+        prepare program cannot bake in)."""
+        model = prep["member"].model
+        excluded_parts, repl_mask, lead_mask = prep["masks"]
+        ctx = build_context(
+            model,
+            excluded_partitions=None if excluded_parts is None
+            else jnp.asarray(excluded_parts),
+            excluded_brokers_for_replica_move=_as_jnp(repl_mask),
+            excluded_brokers_for_leadership=_as_jnp(lead_mask))
+        state = init_state(
+            model,
+            with_topic_counts=prep["num_topics"],
+            with_topic_leader_counts=prep["needs_tlc"])
+        return state, ctx
+
+    def _stack_padded(self, trees, pad: int):
+        """Stack per-member pytrees on a new leading axis, replicating
+        entry 0 into ``pad`` trailing padding slots (structurally valid,
+        engine-masked)."""
+        rows = list(trees) + [trees[0]] * pad
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *rows)
+
+    def _propose_group(self, fleet, prepared, idxs, results) -> float:
+        preps = [prepared[i] for i in idxs]
+        cfg = preps[0]["cfg"]
+        goals = preps[0]["goals"]
+        audit = preps[0]["audit"]
+        G = len(goals)
+        Cg = len(idxs)
+        D, k, C_pad = self._layout(Cg)
+        mesh = self._mesh(D)
+        # The process-wide compiled-chain registry is the one source of
+        # pass-function identity — the fleet walk re-traces exactly the
+        # passes the sequential path runs/compiled for this chain.
+        chain = self.optimizer._chain_for(cfg, goals)
+        pass_fns = list(chain._pass_fns)
+
+        if idxs == list(range(fleet.num_clusters)) \
+                and fleet.num_clusters_padded == C_pad:
+            # Whole-fleet single group: the FleetModel's stack IS the
+            # group stack — re-stacking 16 members' models every tick is
+            # measurable host time on the dispatch path.
+            group_models = fleet.stacked
+        else:
+            group_models = self._stack_padded(
+                [p["member"].model for p in preps], C_pad - Cg)
+        if all(m is None for p in preps for m in p["masks"]):
+            # Batched prepare: one program builds every member's search
+            # state + context from the stacked models — the eager
+            # per-member construction is 16 clusters' worth of small
+            # dispatches on the host's critical path every tick.
+            prepare = self._prepare_program(
+                _shape_sig(group_models), preps[0]["num_topics"],
+                preps[0]["needs_tlc"], mesh, D)
+            states, ctxs = prepare(group_models)
+        else:
+            pairs = [self._member_state_ctx(p) for p in preps]
+            states = self._stack_padded([s for s, _ in pairs], C_pad - Cg)
+            ctxs = self._stack_padded([c for _, c in pairs], C_pad - Cg)
+        shape_sig = _shape_sig(states, ctxs)
+        walk = self._walk_program(shape_sig, cfg, goals, pass_fns, mesh, D)
+        audit_fn = (self._audit_program(shape_sig, audit, mesh, D)
+                    if audit else None)
+        seed_key = jax.random.PRNGKey(preps[0]["opts"].seed)
+        keys_main = jnp.stack([jax.random.fold_in(seed_key, i)
+                               for i in range(G)])
+
+        enabled = np.zeros((C_pad, G), bool)
+        enabled[:Cg] = True
+        t_disp = time.monotonic()
+        audit_before = audit_fn(states, ctxs) if audit_fn is not None \
+            else None
+        with self.tracer.span("fleet.walk", clusters=Cg, devices=D,
+                              goals=G):
+            states, aux, iters, bounds, moves = walk(
+                states, ctxs, jnp.asarray(enabled), keys_main)
+            fetched = jax.device_get((aux, iters, bounds, moves))
+        self.collector.record_d2h(self.collector.tree_bytes(fetched))
+        (has_broken, scales, v0), iters_np, bounds_np, moves_np = fetched
+        iters_np = np.asarray(iters_np, np.int64)
+        moves_np = np.asarray(moves_np, np.int64)
+        bounds_np = np.asarray(bounds_np)
+
+        # Per-cluster trajectories/accounting, exactly the sequential
+        # walk's host bookkeeping (self-check included).
+        traj = [[[float(x) for x in v0[c]]] for c in range(Cg)]
+        accepted = np.zeros((Cg, G), np.int64)
+        #: each goal's PRE-pass reading — stack row i of the walk (the
+        #: stack after goal i-1; row 0 is the initial stack), exactly the
+        #: boundary the sequential loop records as violation_before.
+        before = np.zeros((Cg, G))
+        iters_total = iters_np[:Cg].copy()
+        prev_moves = np.zeros(Cg, np.int64)
+        for c in range(Cg):
+            cid = preps[c]["member"].cluster_id
+            boundary = np.asarray(v0[c])
+            for i, g in enumerate(goals):
+                before_i = float(boundary[i])
+                before[c, i] = before_i
+                boundary = bounds_np[c, i]
+                traj[c].append([float(x) for x in boundary])
+                accepted[c, i] = moves_np[c, i] - prev_moves[c]
+                prev_moves[c] = moves_np[c, i]
+                after_i = float(boundary[i])
+                if after_i > before_i * (1 + 1e-6) + 1e-6:
+                    if bool(has_broken[c]):
+                        LOG.warning(
+                            "fleet[%s]: goal %s worsened its own "
+                            "violation %.6g -> %.6g while draining broken"
+                            " brokers (self-check exempt)", cid, g.name,
+                            before_i, after_i)
+                    else:
+                        raise RuntimeError(
+                            f"fleet optimization self-check failed for "
+                            f"cluster {cid}: goal {g.name} worsened its "
+                            f"own violation {before_i:.6g} -> "
+                            f"{after_i:.6g}")
+
+        # Polish rounds — the per-goal path's semantics with per-cluster
+        # enabled masks: todo is each cluster's residual goals at round
+        # start, keys fold_in(key, 1000*(rnd+1)+i), and a fully-converged
+        # cluster runs nothing further. `~(x <= eps)` keeps NaN residuals
+        # in the todo set (broken-kernel case), like sequential.
+        polish_eps = min(cfg.epsilon, 1e-6)
+        boundary_np = bounds_np[:, -1, :].copy()        # [C_pad, G]
+        rounds = cfg.polish_passes + 1 if cfg.polish_passes else 0
+        for rnd in range(rounds):
+            enab = ~(boundary_np <= polish_eps)
+            enab[Cg:] = False
+            if not enab.any():
+                break
+            keys_rnd = jnp.stack([
+                jax.random.fold_in(seed_key, 1000 * (rnd + 1) + i)
+                for i in range(G)])
+            with self.tracer.span("fleet.polish", round=rnd,
+                                  clusters=int(enab.any(axis=1).sum())):
+                states, _aux2, it2, b2, m2 = walk(
+                    states, ctxs, jnp.asarray(enab), keys_rnd)
+                fetched = jax.device_get((it2, b2, m2))
+            self.collector.record_d2h(self.collector.tree_bytes(fetched))
+            it2, b2, m2 = (np.asarray(fetched[0], np.int64),
+                           np.asarray(fetched[1]),
+                           np.asarray(fetched[2], np.int64))
+            for c in range(Cg):
+                if not enab[c].any():
+                    continue       # cluster converged: no further rounds
+                for i in range(G):
+                    if not enab[c, i]:
+                        continue
+                    accepted[c, i] += m2[c, i] - prev_moves[c]
+                    prev_moves[c] = m2[c, i]
+                    iters_total[c, i] += it2[c, i]
+                # One trajectory row per polish ROUND, the sequential
+                # convention (the round-end boundary stack).
+                traj[c].append([float(x) for x in b2[c, -1]])
+            boundary_np = b2[:, -1, :].copy()
+        dispatch_s = time.monotonic() - t_disp
+
+        audit_after = None
+        if audit_fn is not None:
+            audit_before = jax.device_get(audit_before)
+            audit_after = jax.device_get(audit_fn(states, ctxs))
+            self.collector.record_d2h(self.collector.tree_bytes(
+                (audit_before, audit_after)))
+
+        # Batched finish: the per-member device work the sequential
+        # _finish pays one cluster at a time — placement planes for the
+        # proposal diff, the provision verdict's utilization recompute
+        # and broker planes — runs as ONE program and ONE stacked fetch
+        # for the whole group; everything after is per-member numpy.
+        finish = self._finish_program(shape_sig, mesh, D)
+        fetched = jax.device_get(
+            (finish(group_models, states.rb, states.offline, states.pos),
+             states.moves_applied))
+        self.collector.record_d2h(self.collector.tree_bytes(fetched))
+        (util_np, rb0_np, rb1_np, alive_np, caps_np, racks_np), moves_a \
+            = fetched
+        moves_applied = np.asarray(moves_a, np.int64)
+        walk_share = dispatch_s / max(Cg, 1)
+        for c, idx in enumerate(idxs):
+            results[idx] = self._finish_member(
+                fleet, preps[c], states, c, goals, audit,
+                audit_before, audit_after,
+                before=before[c], scales=np.asarray(scales[c]),
+                boundary=boundary_np[c], iters=iters_total[c],
+                accepted=accepted[c], trajectory=traj[c],
+                num_moves=int(moves_applied[c]), walk_share=walk_share,
+                util=np.asarray(util_np[c]),
+                rb0=np.asarray(rb0_np[c]), rb1=np.asarray(rb1_np[c]),
+                alive=np.asarray(alive_np[c]),
+                caps=np.asarray(caps_np[c]),
+                racks=np.asarray(racks_np[c]))
+        return dispatch_s
+
+    def _prepare_program(self, models_sig, num_topics, needs_tlc, mesh,
+                         D):
+        """Batched maskless prepare: ``stacked models -> (states, ctxs)``
+        via the same ``init_state``/``build_context`` the sequential path
+        runs eagerly, one cluster at a time inside ``lax.map`` (scan, no
+        batching rewrite — the ops and their results are the sequential
+        constructions')."""
+        key = (("fleet-prepare",) + models_sig + (num_topics, needs_tlc,
+                                                  D))
+
+        def build():
+            def one(model):
+                state = init_state(model,
+                                   with_topic_counts=num_topics,
+                                   with_topic_leader_counts=needs_tlc)
+                return state, build_context(model)
+
+            def body(models):
+                return jax.lax.map(one, models)
+
+            def run(models):
+                in_specs = (_tree_specs(models, P(CLUSTER_AXIS)),)
+                out_shape = jax.eval_shape(body, models)
+                out_specs = _tree_specs(out_shape, P(CLUSTER_AXIS))
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)(models)
+
+            return self.collector.track("fleet-prepare", jax.jit(run))
+
+        return self._programs.get_or_build(key, build)
+
+    def _finish_program(self, shape_sig, mesh, D):
+        """One batched program computing everything the per-member finish
+        reads off the device: initial/final placement planes, the
+        provision verdict's from-scratch broker utilization (matching the
+        sequential path's recompute, not the incrementally-maintained
+        ``state.util``), and the static broker planes."""
+        from ..model.flat import broker_utilization
+        key = (("fleet-finish",) + shape_sig + (D,))
+
+        def build():
+            def one(t):
+                model, rb, offline, pos = t
+                final = model.replace(replica_broker=rb,
+                                      replica_offline=offline,
+                                      replica_pref_pos=pos)
+                return (broker_utilization(final), model.replica_broker,
+                        rb, model.broker_alive & model.broker_valid,
+                        model.broker_capacity, model.broker_rack)
+
+            def body(models, rb, offline, pos):
+                return jax.lax.map(one, (models, rb, offline, pos))
+
+            def run(models, rb, offline, pos):
+                args = (models, rb, offline, pos)
+                in_specs = tuple(_tree_specs(a, P(CLUSTER_AXIS))
+                                 for a in args)
+                out_shape = jax.eval_shape(body, *args)
+                out_specs = _tree_specs(out_shape, P(CLUSTER_AXIS))
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)(*args)
+
+            return self.collector.track("fleet-finish", jax.jit(run))
+
+        return self._programs.get_or_build(key, build)
+
+    def _finish_member(self, fleet, prep, states, c, goals, audit,
+                       audit_before, audit_after, *, before, scales,
+                       boundary, iters, accepted, trajectory, num_moves,
+                       walk_share, util, rb0, rb1, alive, caps, racks):
+        """Per-member ``_finish`` on pre-fetched arrays (the batched
+        finish program's stacked read): proposal diff, audit verdicts,
+        provision verdict, telemetry — and the hard-goal gate, captured
+        as a returned ``OptimizationFailureError`` instead of raised."""
+        member = prep["member"]
+        opts = prep["opts"]
+        G = len(goals)
+        total_iters = max(int(iters.sum()), 1)
+        goal_results = []
+        for i, g in enumerate(goals):
+            goal_results.append(GoalResult(
+                name=g.name, hard=g.hard,
+                violation_before=float(before[i]),
+                violation_after=float(boundary[i]),
+                duration_s=walk_share * int(iters[i]) / total_iters,
+                iterations=int(iters[i]),
+                scale=float(scales[i]),
+                accepted=int(accepted[i])))
+        audit_results = []
+        if audit:
+            (va, sa) = audit_after
+            (vb, _sb) = audit_before
+            audit_results = [
+                GoalResult(name=g.name, hard=True,
+                           violation_before=float(vb[c][i]),
+                           violation_after=float(va[c][i]),
+                           duration_s=0.0, iterations=0,
+                           scale=float(sa[c][i]))
+                for i, g in enumerate(audit)]
+        final = member.model.replace(replica_broker=states.rb[c],
+                                     replica_offline=states.offline[c],
+                                     replica_pref_pos=states.pos[c])
+        from ..model.proposals import diff_replica_arrays
+        proposals = diff_replica_arrays(rb0, rb1, member.metadata,
+                                        member.model.broker_sentinel)
+        result = OptimizerResult(
+            proposals=proposals, goal_results=goal_results,
+            num_moves=num_moves,
+            duration_s=walk_share, final_model=final,
+            provision_response=self.optimizer._provision_verdict_from_host(
+                util, alive, caps, member.model.num_brokers_padded,
+                goal_results, placement=lambda: (rb1, racks)),
+            hard_goal_audit=audit_results,
+            telemetry=self.optimizer._record_goal_telemetry(
+                goal_results, trajectory, num_moves),
+            stale_model=member.stale)
+        if result.violated_hard_goals and not opts.skip_hard_goal_check:
+            return OptimizationFailureError(
+                f"fleet[{member.cluster_id}]: hard goals still violated "
+                f"after optimization: {result.violated_hard_goals}",
+                result)
+        return result
+
+    # ------------------------------------------------------ walk program
+    def _walk_program(self, shape_sig, cfg, goals, pass_fns, mesh, D):
+        key = (("fleet-walk",) + shape_sig
+               + (cfg, tuple((type(g), g.name, g.bind_signature())
+                             for g in goals), D))
+        return self._programs.get_or_build(
+            key, lambda: self._build_walk(goals, pass_fns, mesh))
+
+    def _build_walk(self, goals, pass_fns, mesh):
+        goals = tuple(goals)
+
+        def one_cluster(state, ctx, enabled, keys):
+            has_broken = state.offline.any()
+            scales = jnp.stack([g.violation_scale(state, ctx)
+                                for g in goals])
+            v0 = violation_stack(goals, state, ctx)
+            prev = v0
+            iters, bounds, moves = [], [], []
+            for i, run in enumerate(pass_fns):
+                def _do(st, _run=run, _i=i):
+                    return _run(st, ctx, keys[_i])
+
+                def _skip(st, _prev=prev):
+                    return (st, jnp.zeros((), jnp.int32), _prev,
+                            st.moves_applied)
+
+                state, it, stack, m = jax.lax.cond(
+                    enabled[i], _do, _skip, state)
+                prev = stack
+                iters.append(it)
+                bounds.append(stack)
+                moves.append(m)
+            return (state, (has_broken, scales, v0), jnp.stack(iters),
+                    jnp.stack(bounds), jnp.stack(moves))
+
+        def body(states, ctxs, enabled, keys):
+            # lax.map is a scan: clusters on one device run sequentially
+            # through REAL control flow (cond picks one branch at
+            # runtime, while_loops trip per cluster) — no vmap batching
+            # rewrite, hence bit-parity with the sequential path.
+            return jax.lax.map(
+                lambda t: one_cluster(t[0], t[1], t[2], keys),
+                (states, ctxs, enabled))
+
+        def run(states, ctxs, enabled, keys):
+            in_specs = (_tree_specs(states, P(CLUSTER_AXIS)),
+                        _tree_specs(ctxs, P(CLUSTER_AXIS)),
+                        P(CLUSTER_AXIS), P())
+            out_shape = jax.eval_shape(body, states, ctxs, enabled, keys)
+            out_specs = _tree_specs(out_shape, P(CLUSTER_AXIS))
+            return shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)(states, ctxs, enabled,
+                                                  keys)
+
+        return self.collector.track(
+            "fleet-walk", jax.jit(run, donate_argnums=(0,)))
+
+    def _audit_program(self, shape_sig, audit, mesh, D):
+        audit = tuple(audit)
+        key = (("fleet-audit",) + shape_sig
+               + (tuple((g.name, g.bind_signature()) for g in audit), D))
+
+        def build():
+            def one(state, ctx):
+                return (violation_stack(audit, state, ctx),
+                        jnp.stack([g.violation_scale(state, ctx)
+                                   for g in audit]))
+
+            def body(states, ctxs):
+                return jax.lax.map(lambda t: one(t[0], t[1]),
+                                   (states, ctxs))
+
+            def run(states, ctxs):
+                in_specs = (_tree_specs(states, P(CLUSTER_AXIS)),
+                            _tree_specs(ctxs, P(CLUSTER_AXIS)))
+                out_shape = jax.eval_shape(body, states, ctxs)
+                out_specs = _tree_specs(out_shape, P(CLUSTER_AXIS))
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)(states, ctxs)
+
+            return self.collector.track("fleet-audit", jax.jit(run))
+
+        return self._programs.get_or_build(key, build)
+
+    # ------------------------------------------------------- N-1 sweep
+    def sweep_n1(self, fleet: FleetModel) -> list[dict]:
+        """Per-cluster N-1 resilience risk for the whole fleet in ONE
+        dispatch: every alive broker of every member killed in turn,
+        scored by the shared scenario scorer (``whatif/engine.py``) over
+        a ``[C, S]`` grid — the cluster axis sharded like the walk, the
+        scenario axis vmapped like ``/simulate``. Returns one summary
+        dict per member (maxRisk / riskiestBroker / violatedHardGoals of
+        the riskiest loss), with risk numbers identical to a per-cluster
+        ``WhatIfEngine`` N-1 sweep at the same shapes."""
+        t0 = time.monotonic()
+        with self.collector.cycle("fleet-sweep"), \
+                self.tracer.span("fleet.sweep-n1",
+                                 clusters=fleet.num_clusters):
+            out = self._sweep_n1_impl(fleet)
+        self.last_dispatch_s = time.monotonic() - t0
+        return out
+
+    def _sweep_n1_impl(self, fleet: FleetModel) -> list[dict]:
+        members = fleet.members
+        C = len(members)
+        binds = [tuple((g.name, g.bind_signature())
+                       for g in (gg.bind(m.metadata)
+                                 for gg in self.optimizer.goals))
+                 for m in members]
+        topics = [m.metadata.num_topics for m in members]
+        if any(b != binds[0] for b in binds) or \
+                any(t != topics[0] for t in topics):
+            # Degrade path (docs/fleet.md): heterogeneous goal bindings /
+            # topic widths cannot share one scorer program — group like
+            # propose() would; for the sweep the simple split is
+            # per-subfleet recursion. The cluster-bucket floor is
+            # suspended for the sub-sweeps: padding a C=1 sweep up to
+            # the fleet size would score floor x S dead slots per member
+            # (the sweep has no skip mask — every slot is real work).
+            out: list[dict] = []
+            floor = self.cluster_bucket_floor
+            self.cluster_bucket_floor = 0
+            try:
+                for m in members:
+                    sub = FleetModel.stack([(m.cluster_id, m.model,
+                                             m.metadata)])
+                    out.extend(self._sweep_n1_impl(sub))
+            finally:
+                self.cluster_bucket_floor = floor
+            return out
+
+        goals = [g.bind(members[0].metadata) for g in self.optimizer.goals]
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals)
+        needs_topics = needs_tlc or any(g.uses_topic_counts for g in goals)
+        num_topics = topics[0]
+        B_f = members[0].model.num_brokers_padded
+        P_f = members[0].model.num_partitions_padded
+
+        alive_rows = []
+        for m in members:
+            bvalid = np.asarray(m.model.broker_valid)
+            balive = np.asarray(m.model.broker_alive)
+            alive_rows.append(np.nonzero(bvalid & balive)[0])
+        S = max((len(r) for r in alive_rows), default=1)
+        S_pad = round_up(S, self.scenario_pad_multiple)
+        D, k, C_pad = self._layout(C)
+        mesh = self._mesh(D)
+
+        dead = np.zeros((C_pad, S_pad, B_f), bool)
+        for c, rows in enumerate(alive_rows):
+            dead[c, np.arange(len(rows)), rows] = True
+        add = np.zeros((C_pad, B_f), bool)
+        cap_scale = np.ones((C_pad, B_f, 4), np.float32)
+
+        stacked = jax.tree.map(
+            lambda a: (jnp.concatenate(
+                [a, jnp.repeat(a[:1], C_pad - C, axis=0)])
+                if C_pad > C else a), fleet.stacked)
+        pscale = jnp.ones((C_pad, P_f), jnp.float32)
+        pvalid = stacked.partition_valid
+
+        sig = _shape_sig(stacked) + (S_pad,)
+        key = (("fleet-sweep",) + sig
+               + (tuple((g.name, g.bind_signature()) for g in goals),
+                  num_topics if needs_topics else None, needs_tlc, D))
+
+        def build():
+            scorer = make_scenario_scorer(
+                goals, self.optimizer.constraint.capacity_threshold,
+                num_topics=num_topics, needs_topics=needs_topics,
+                needs_tlc=needs_tlc)
+
+            def one(model, dead_c, add_c, cap_c, ps_c, pv_c):
+                viol, vscale, _hr, _hf, pressure, unavailable, n_off = \
+                    scorer(model, dead_c, add_c, cap_c, ps_c, pv_c)
+                return viol, vscale, pressure, unavailable, n_off
+
+            def per_cluster(t):
+                model, dead_c, add_c, cap_c, ps_c, pv_c = t
+                return jax.vmap(
+                    one, in_axes=(None, 0, None, None, None, None))(
+                    model, dead_c, add_c, cap_c, ps_c, pv_c)
+
+            def body(models, dead_b, add_b, cap_b, ps_b, pv_b):
+                return jax.lax.map(per_cluster,
+                                   (models, dead_b, add_b, cap_b, ps_b,
+                                    pv_b))
+
+            def run(models, dead_b, add_b, cap_b, ps_b, pv_b):
+                args = (models, dead_b, add_b, cap_b, ps_b, pv_b)
+                in_specs = tuple(_tree_specs(a, P(CLUSTER_AXIS))
+                                 for a in args)
+                out_shape = jax.eval_shape(body, *args)
+                out_specs = _tree_specs(out_shape, P(CLUSTER_AXIS))
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)(*args)
+
+            return self.collector.track("fleet-sweep", jax.jit(run))
+
+        program = self._programs.get_or_build(key, build)
+        self.collector.record_h2d(dead.nbytes + add.nbytes
+                                  + cap_scale.nbytes)
+        out = program(stacked, jnp.asarray(dead), jnp.asarray(add),
+                      jnp.asarray(cap_scale), pscale, pvalid)
+        fetched = jax.device_get(out)
+        self.collector.record_d2h(self.collector.tree_bytes(fetched))
+        viol, vscale, pressure, unavailable, _n_off = (
+            np.asarray(a) for a in fetched)
+
+        hard = np.array([g.hard for g in goals], bool)
+        violated = violated_matrix(viol, vscale)           # [C_pad, S, G]
+        n_hard = max(int(hard.sum()), 1)
+        n_soft = max(int((~hard).sum()), 1)
+        hard_frac = violated[..., hard].sum(axis=-1) / n_hard
+        soft_frac = violated[..., ~hard].sum(axis=-1) / n_soft
+        valid_parts = np.maximum(
+            np.asarray(jax.device_get(pvalid)).sum(axis=1), 1)[:, None]
+        risk = risk_scores(hard_frac, soft_frac, pressure,
+                           unavailable.astype(int), valid_parts)
+
+        summaries = []
+        for c, m in enumerate(members):
+            rows = alive_rows[c]
+            n = len(rows)
+            if n == 0:
+                summaries.append({"clusterId": m.cluster_id, "maxRisk": 0.0,
+                                  "riskiestBroker": None, "scenarios": 0})
+                continue
+            r = risk[c, :n]
+            worst = int(np.argmax(r))
+            broker_ids = m.metadata.broker_ids
+            worst_row = int(rows[worst])
+            summaries.append({
+                "clusterId": m.cluster_id,
+                "maxRisk": round(float(r[worst]), 4),
+                "riskiestBroker": (broker_ids[worst_row]
+                                   if worst_row < len(broker_ids)
+                                   else worst_row),
+                "violatedHardGoals": [
+                    g.name for g, v in zip(goals, violated[c, worst])
+                    if v and g.hard],
+                "scenarios": n})
+        return summaries

@@ -1,0 +1,381 @@
+"""Fleet registry: the host side of the fleet control plane.
+
+Owns per-cluster ``LoadMonitor`` instances (and their cluster-scoped
+``ProposalCache``s), drives ONE shared tick that builds every member's
+model, runs the batched fleet propose (and, on its configured cadence,
+the batched N-1 resilience sweep) through :class:`..fleet.FleetOptimizer`
+in one device dispatch, unstacks the per-cluster results back into each
+member's cache, and fans anomaly detection out per cluster. Surfaced as
+``GET /fleet`` (summary) and ``POST /fleet/rebalance`` (forced tick)
+through ``api/server.py``/``facade.py``, and as the ``fleet`` section of
+``/devicestats``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time as _time
+from dataclasses import dataclass, field
+
+from ..analyzer import OptimizationOptions
+from ..analyzer.optimizer import OptimizationFailureError
+from ..api.precompute import ProposalCache
+from ..model.fleet import FleetModel
+from .engine import FleetOptimizer
+
+LOG = logging.getLogger(__name__)
+
+
+@dataclass
+class FleetClusterHandle:
+    """One registered cluster: its monitor, its cluster-scoped proposal
+    cache, an optional per-cluster anomaly detector, and the registry's
+    last per-cluster readouts."""
+
+    cluster_id: str
+    monitor: object
+    cache: ProposalCache | None = None
+    detector: object = None
+    ready: bool = False
+    generation: int | None = None
+    last_error: str | None = None
+    last_risk: dict | None = None
+    last_summary: dict = field(default_factory=dict)
+
+
+class FleetRegistry:
+    """One control plane, many clusters, one dispatch per tick.
+
+    Members register with their own ``LoadMonitor`` (each monitor keeps
+    its private sample history and model generation); the shared tick
+    builds every ready member's model host-side (the members' resident
+    device state and delta-ingest paths apply unchanged), stacks them
+    into a :class:`FleetModel` shape bucket, and runs optimize across
+    the ``[C, ...]`` cluster axis as one device dispatch. Results land
+    in each member's generation- AND cluster-keyed cache, so the
+    members' ``/proposals`` reads stay cache hits with the same
+    freshness machinery the single-cluster path uses.
+    """
+
+    def __init__(self, optimizer, *, max_clusters: int = 64,
+                 broker_pad_multiple: int = 8,
+                 partition_pad_multiple: int = 128,
+                 risk_sweep_every: int = 1,
+                 options: OptimizationOptions | None = None,
+                 registry=None, tracer=None, collector=None,
+                 now_ms=None, max_devices: int | None = None) -> None:
+        from ..core.runtime_obs import default_collector
+        from ..core.sensors import MetricRegistry
+        from ..core.tracing import default_tracer
+        self.max_clusters = max_clusters
+        self.broker_pad_multiple = broker_pad_multiple
+        self.partition_pad_multiple = partition_pad_multiple
+        #: run the batched N-1 resilience sweep every Nth tick (0 = off).
+        self.risk_sweep_every = risk_sweep_every
+        #: the fleet tick is the members' background proposal refresher,
+        #: so it computes with the cache's dry-run semantics: an
+        #: unfixable hard goal is a cacheable finding, not an error to
+        #: re-burn one fleet dispatch on every tick.
+        self.options = options or OptimizationOptions(
+            skip_hard_goal_check=True)
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        self.registry = registry or MetricRegistry()
+        self.tracer = tracer or default_tracer()
+        self.collector = collector or default_collector()
+        self.engine = FleetOptimizer(optimizer, max_devices=max_devices,
+                                     registry=self.registry,
+                                     tracer=self.tracer,
+                                     collector=self.collector)
+        self._members: dict[str, FleetClusterHandle] = {}
+        self._lock = threading.RLock()
+        #: serializes whole ticks: the background ticker and a forced
+        #: POST /fleet/rebalance must never run two fleet dispatches
+        #: concurrently (duplicate device work + racing per-member
+        #: readout writes).
+        self._tick_lock = threading.Lock()
+        self._ticker: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.ticks = 0
+        self.last_tick_ms: int | None = None
+        self.last_bucket: dict | None = None
+        name = MetricRegistry.name
+        self._tick_timer = self.registry.timer(
+            name("FleetRegistry", "tick-timer"))
+        self._tick_errors = self.registry.meter(
+            name("FleetRegistry", "tick-failure-rate"))
+        self.registry.gauge(name("FleetRegistry", "clusters"),
+                            lambda: len(self._members))
+        self.registry.gauge(
+            name("FleetRegistry", "last-dispatch-ms"),
+            lambda: (None if self.engine.last_dispatch_s is None
+                     else round(self.engine.last_dispatch_s * 1e3, 3)))
+
+    # ----------------------------------------------------------- members
+    def register(self, cluster_id: str, monitor, *,
+                 proposal_cache: ProposalCache | None = None,
+                 detector=None) -> FleetClusterHandle:
+        """Add a cluster. ``proposal_cache`` defaults to a fresh
+        cluster-scoped cache over this monitor and the shared optimizer
+        (pass the facade's cache for the local cluster so ``/proposals``
+        serves fleet-computed results). The cache must carry this
+        cluster's id — that scoping is what makes cross-serving
+        impossible (``ProposalCache.store``)."""
+        with self._lock:
+            if cluster_id in self._members:
+                raise ValueError(f"cluster {cluster_id!r} already "
+                                 "registered")
+            if len(self._members) >= self.max_clusters:
+                raise ValueError(
+                    f"fleet is full: {self.max_clusters} clusters "
+                    "(fleet.max.clusters)")
+            if proposal_cache is None:
+                proposal_cache = ProposalCache(
+                    monitor, self.engine.optimizer,
+                    now_ms=self._now_ms, cache_id=cluster_id)
+            elif proposal_cache.cache_id != cluster_id:
+                raise ValueError(
+                    f"proposal cache id {proposal_cache.cache_id!r} does "
+                    f"not match cluster {cluster_id!r}")
+            handle = FleetClusterHandle(cluster_id=cluster_id,
+                                        monitor=monitor,
+                                        cache=proposal_cache,
+                                        detector=detector)
+            self._members[cluster_id] = handle
+            return handle
+
+    def deregister(self, cluster_id: str) -> None:
+        with self._lock:
+            self._members.pop(cluster_id, None)
+
+    @property
+    def cluster_ids(self) -> list[str]:
+        with self._lock:
+            return list(self._members)
+
+    def member(self, cluster_id: str) -> FleetClusterHandle:
+        with self._lock:
+            return self._members[cluster_id]
+
+    def scrape_registries(self) -> list:
+        """Cluster-namespaced views of every member's sensor registries
+        for the merged ``/metrics`` exposition: families render as
+        ``cc_<cluster>_LoadMonitor_...`` etc., so two members' identical
+        sensor names never collapse into unlabeled numeric-suffix
+        duplicates (tests/prom_lint.py rejects those)."""
+        from ..core.sensors import NamespacedRegistry
+        out = [self.registry]
+        with self._lock:
+            members = list(self._members.values())
+        for h in members:
+            reg = getattr(h.monitor, "registry", None)
+            if reg is not None:
+                out.append(NamespacedRegistry(reg, h.cluster_id))
+            if h.cache is not None and h.cache.registry is not reg:
+                # Cluster-scoped caches already carry the id in their
+                # group name (ProposalCache.<id>.*) — no second prefix.
+                out.append(h.cache.registry)
+        return out
+
+    # -------------------------------------------------------------- tick
+    def tick(self, now_ms: int | None = None, *,
+             force: bool = False) -> dict:
+        """One fleet cycle: build every member's model; when ANY member's
+        cache no longer answers its monitor generation (or ``force``),
+        run the batched propose for EVERY ready member — the dispatch is
+        batched anyway, and proposing only the stale subset would both
+        compile one program set per distinct subset size and leave the
+        others' risk readouts stale; then (on its cadence) the batched
+        N-1 risk sweep and the per-cluster anomaly fan-out. Ticks are
+        serialized (the background ticker vs a forced
+        ``/fleet/rebalance``). Returns the tick summary."""
+        with self._tick_lock:
+            return self._tick_locked(now_ms, force)
+
+    def _tick_locked(self, now_ms: int | None, force: bool) -> dict:
+        now = now_ms if now_ms is not None else self._now_ms()
+        t0 = _time.monotonic()
+        with self._lock:
+            members = list(self._members.values())
+        # Pin the engine's cluster-axis shape floor to the fleet size so
+        # a partial-readiness tick reuses the full fleet's compiled
+        # programs (padding slots are skip-branch cheap).
+        self.engine.cluster_bucket_floor = len(members)
+        ready: list[tuple[FleetClusterHandle, object]] = []
+        with self.tracer.span("fleet.tick", clusters=len(members)), \
+                self.collector.cycle("fleet-tick"):
+            for h in members:
+                try:
+                    result = h.monitor.cluster_model(now)
+                except Exception as e:
+                    h.ready = False
+                    h.last_error = f"{type(e).__name__}: {e}"
+                    continue
+                h.ready = True
+                h.last_error = None
+                h.generation = result.generation
+                ready.append((h, result))
+            summary = {"clusters": len(members), "ready": len(ready),
+                       "proposed": 0, "errors": 0, "skipped": 0}
+            if not ready:
+                self.ticks += 1
+                self.last_tick_ms = now
+                self._tick_timer.update(_time.monotonic() - t0)
+                return summary
+            need = force or any(h.cache is None or not h.cache.valid()
+                                for h, _ in ready)
+            todo = ready if need else []
+            summary["skipped"] = len(ready) - len(todo)
+            sweep_due = bool(self.risk_sweep_every
+                             and self.ticks % self.risk_sweep_every == 0)
+            if not todo and not sweep_due:
+                # Nothing to compute: don't pay the fleet stack (pad +
+                # device upload of every member's model) for a tick that
+                # would use none of it.
+                self.ticks += 1
+                self.last_tick_ms = now
+                self._tick_timer.update(_time.monotonic() - t0)
+                return summary
+            fleet = FleetModel.stack(
+                [(h.cluster_id, r.model, r.metadata, r.generation,
+                  r.stale) for h, r in ready],
+                broker_pad_multiple=self.broker_pad_multiple,
+                partition_pad_multiple=self.partition_pad_multiple)
+            self.last_bucket = fleet.bucket
+            if todo:
+                results = self.engine.propose(fleet, self.options)
+                for (h, r), res in zip(todo, results):
+                    if isinstance(res, OptimizationFailureError):
+                        h.last_error = str(res)
+                        summary["errors"] += 1
+                        res = res.result
+                    h.last_summary = self._cluster_summary(h, res)
+                    if h.cache is not None:
+                        stored = h.cache.store(res,
+                                               generation=r.generation,
+                                               cache_id=h.cluster_id)
+                        if not stored:
+                            LOG.info(
+                                "fleet[%s]: generation moved mid-"
+                                "dispatch (%s -> %s); result dropped",
+                                h.cluster_id, r.generation,
+                                h.monitor.generation)
+                    summary["proposed"] += 1
+            if sweep_due:
+                try:
+                    risks = self.engine.sweep_n1(fleet)
+                except Exception:
+                    LOG.warning("fleet N-1 sweep failed", exc_info=True)
+                    self._tick_errors.mark()
+                else:
+                    by_id = {r["clusterId"]: r for r in risks}
+                    for h, _ in ready:
+                        if h.cluster_id in by_id:
+                            h.last_risk = by_id[h.cluster_id]
+            # Anomaly fan-out: each member's detector sweep runs on the
+            # shared tick (AnomalyDetectorManager.run_once semantics) —
+            # one scheduler, per-cluster detection and self-healing.
+            for h, _ in ready:
+                if h.detector is None:
+                    continue
+                try:
+                    h.detector.run_once(now)
+                except Exception:
+                    LOG.warning("fleet[%s]: anomaly fan-out failed",
+                                h.cluster_id, exc_info=True)
+                    self._tick_errors.mark()
+        self.ticks += 1
+        self.last_tick_ms = now
+        self._tick_timer.update(_time.monotonic() - t0)
+        return summary
+
+    @staticmethod
+    def _cluster_summary(h: FleetClusterHandle, res) -> dict:
+        total = max(len(res.goal_results), 1)
+        violated = [g.name for g in res.goal_results if not g.satisfied]
+        return {
+            # Documented in docs/fleet.md: the fraction of the chain's
+            # goals currently satisfied — 1.0 is a fully balanced member.
+            "balanceScore": round(1.0 - len(violated) / total, 4),
+            "violatedGoals": violated,
+            "violatedHardGoals": res.violated_hard_goals,
+            "numProposals": len(res.proposals),
+            "numMoves": res.num_moves,
+            "staleModel": res.stale_model,
+        }
+
+    # -------------------------------------------------- background loop
+    def start(self, tick_interval_s: float) -> None:
+        """Background shared tick (fleet.tick.ms); idempotent."""
+        if self._ticker is not None and self._ticker.is_alive():
+            return
+        stop = threading.Event()
+        self._stop = stop
+
+        def loop():
+            while not stop.wait(tick_interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    LOG.warning("fleet tick failed", exc_info=True)
+                    self._tick_errors.mark()
+
+        self._ticker = threading.Thread(target=loop, daemon=True,
+                                        name="fleet-tick")
+        self._ticker.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=5)
+            self._ticker = None
+
+    # ----------------------------------------------------------- surface
+    def summary_json(self, now_ms: int | None = None) -> dict:
+        """The ``GET /fleet`` payload: per-cluster balance score,
+        freshness and risk, plus the shared bucket/dispatch readout."""
+        now = now_ms if now_ms is not None else self._now_ms()
+        with self._lock:
+            members = list(self._members.values())
+        clusters = []
+        for h in members:
+            row = {"clusterId": h.cluster_id,
+                   "ready": h.ready,
+                   "generation": h.generation,
+                   "lastError": h.last_error,
+                   **h.last_summary}
+            if h.cache is not None:
+                row["freshness"] = h.cache.freshness_json(now)
+            if h.last_risk is not None:
+                row["risk"] = h.last_risk
+            clusters.append(row)
+        return {"enabled": True,
+                "numClusters": len(members),
+                "ticks": self.ticks,
+                "lastTickMs": self.last_tick_ms,
+                "bucket": self.last_bucket,
+                "lastDispatchMs": (
+                    None if self.engine.last_dispatch_s is None
+                    else round(self.engine.last_dispatch_s * 1e3, 3)),
+                "clusters": clusters}
+
+    def stats_json(self) -> dict:
+        """The ``fleet`` section of ``/devicestats``: cluster count,
+        current shape bucket, last dispatch wall clock."""
+        return {"clusterCount": len(self._members),
+                "ticks": self.ticks,
+                "bucket": self.last_bucket,
+                "lastDispatchMs": (
+                    None if self.engine.last_dispatch_s is None
+                    else round(self.engine.last_dispatch_s * 1e3, 3)),
+                "lastTickMs": self.last_tick_ms}
+
+    def rebalance(self, now_ms: int | None = None) -> dict:
+        """``POST /fleet/rebalance``: force one tick now (every member
+        recomputes regardless of cache validity) and return the summary.
+        Proposals land in the members' caches; EXECUTION stays a
+        per-cluster decision through each cluster's own endpoints — a
+        fleet-wide execute-everything switch is exactly the blast radius
+        this layer exists to avoid."""
+        tick = self.tick(now_ms, force=True)
+        return {"tick": tick, **self.summary_json(now_ms)}

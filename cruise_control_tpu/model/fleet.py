@@ -1,0 +1,116 @@
+"""``FleetModel`` — many clusters' flat models stacked on a leading
+``[C, ...]`` cluster axis.
+
+One control plane balancing hundreds of clusters must not run one device
+program per cluster: the fleet layer pads every member's
+``FlatClusterModel`` to ONE shape bucket ``(B_f, P_f, R_f)`` (the shared
+:func:`..parallel.batching.pad_model_to` re-pad — new rows arrive
+invalid/empty, so a padded member scores bit-identically to its
+original), stacks the members into ``[C_pad, ...]`` arrays with a
+per-cluster validity mask, and hands the stack to ``fleet/engine.py``
+for one batched optimize/score dispatch. The cluster axis is itself
+padded to a bucket (``cluster_pad_multiple``; the fleet engine picks its
+device count as the multiple) so fleets of nearby sizes reuse one
+compiled program — the same bucket discipline the what-if engine applies
+to its scenario axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel.batching import pad_model_to, round_up
+from .flat import FlatClusterModel
+
+
+@dataclass
+class FleetMember:
+    """One cluster's slice of the fleet: its id, its model padded to the
+    fleet bucket, and its own (un-padded, real-count) metadata."""
+
+    cluster_id: str
+    model: FlatClusterModel        # padded to the fleet bucket
+    metadata: object               # ClusterMetadata (real counts)
+    generation: int = 0
+    stale: bool = False
+
+
+@dataclass
+class FleetModel:
+    """Per-cluster members + the ``[C_pad, ...]`` stacked model.
+
+    ``stacked`` is a ``FlatClusterModel`` whose every leaf carries a
+    leading cluster axis; slot ``c >= num_real`` replicates member 0
+    (cheap, structurally valid padding — the engine masks those slots out
+    of every result). ``cluster_valid`` is the authoritative mask."""
+
+    members: list[FleetMember]
+    stacked: FlatClusterModel
+    cluster_valid: np.ndarray       # bool[C_pad]
+    bucket: dict = field(default_factory=dict)
+
+    @property
+    def num_clusters(self) -> int:
+        return len(self.members)
+
+    @property
+    def num_clusters_padded(self) -> int:
+        return int(self.cluster_valid.shape[0])
+
+    def member_index(self, cluster_id: str) -> int:
+        for i, m in enumerate(self.members):
+            if m.cluster_id == cluster_id:
+                return i
+        raise KeyError(cluster_id)
+
+    @classmethod
+    def stack(cls, members, *, broker_pad_multiple: int = 8,
+              partition_pad_multiple: int = 128,
+              cluster_pad_multiple: int = 1) -> "FleetModel":
+        """Stack ``members`` — an iterable of ``(cluster_id, model,
+        metadata)`` or ``(cluster_id, model, metadata, generation,
+        stale)`` tuples — into one fleet bucket.
+
+        The bucket is the max padded shape over members, rounded up to
+        the configured multiples (wire the SAME ``model.*.pad.multiple``
+        values the monitors build with, or heterogeneous growth lands on
+        off-bucket shapes and compiles extra fleet programs per step).
+        """
+        rows = [tuple(m) for m in members]
+        if not rows:
+            raise ValueError("FleetModel.stack requires at least one member")
+        ids = [r[0] for r in rows]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate cluster ids in fleet: {ids}")
+        models = [r[1] for r in rows]
+        B_f = round_up(max(m.num_brokers_padded for m in models),
+                       broker_pad_multiple)
+        P_f = round_up(max(m.num_partitions_padded for m in models),
+                       partition_pad_multiple)
+        R_f = max(m.max_replication_factor for m in models)
+        padded = [pad_model_to(m, B_f, P_f, R_f) for m in models]
+        C = len(padded)
+        C_pad = round_up(C, cluster_pad_multiple)
+        fleet_members = []
+        for r, model in zip(rows, padded):
+            generation = r[3] if len(r) > 3 else 0
+            stale = bool(r[4]) if len(r) > 4 else False
+            fleet_members.append(FleetMember(
+                cluster_id=r[0], model=model, metadata=r[2],
+                generation=generation, stale=stale))
+        # Padding slots replicate member 0: structurally valid arrays the
+        # engine can run (and discard) without NaN hazards — an all-invalid
+        # dummy would divide by zero capacities in several goal kernels.
+        stack_list = padded + [padded[0]] * (C_pad - C)
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *stack_list)
+        cluster_valid = np.zeros(C_pad, bool)
+        cluster_valid[:C] = True
+        return cls(members=fleet_members, stacked=stacked,
+                   cluster_valid=cluster_valid,
+                   bucket={"clusters": C, "clustersPadded": C_pad,
+                           "brokersPadded": B_f, "partitionsPadded": P_f,
+                           "replicaSlots": R_f})

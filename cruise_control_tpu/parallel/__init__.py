@@ -9,6 +9,7 @@ are scatter-adds from sharded [P, R] arrays into replicated [B1, ...] rows
 """
 
 from ._compat import shard_map
+from .batching import ProgramCache, pad_model_to, round_up
 from .branches import (BRANCH_AXIS, make_branch_mesh, make_branched_search,
                        select_best)
 from .sharding import (PARTITION_AXIS, host_array_shardings, make_mesh,
@@ -20,4 +21,5 @@ __all__ = ["PARTITION_AXIS", "make_mesh", "mesh_fingerprint",
            "model_shardings", "resolve_mesh_devices", "shard_model",
            "shard_map", "sharded_state_shardings", "host_array_shardings",
            "scenario_batch_shardings", "BRANCH_AXIS", "make_branch_mesh",
-           "make_branched_search", "select_best"]
+           "make_branched_search", "select_best",
+           "ProgramCache", "pad_model_to", "round_up"]

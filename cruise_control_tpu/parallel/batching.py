@@ -1,0 +1,128 @@
+"""Shared shape-bucketed batched-program machinery.
+
+Both batched device subsystems — the what-if scenario engine
+(``whatif/engine.py``, a vmapped ``[S, ...]`` scenario axis) and the
+fleet control plane (``fleet/engine.py``, a cluster-sharded ``[C, ...]``
+axis) — follow the same recipe: pad the batch axis to a bucket multiple
+so nearby batch sizes reuse one compiled program, key the program on
+(shapes, bucket, goal binding), cache a bounded number of compiled
+variants behind a lock shared by request threads and background
+detectors, and host-side re-pad the flat model when a batch outgrows the
+live model's padding slack. This module is that recipe, lifted out of
+the what-if engine so the fleet path consumes the identical machinery
+instead of a second copy.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+def round_up(n: int, multiple: int) -> int:
+    """Next multiple of ``multiple`` at or above ``n`` (minimum one
+    bucket: a zero/negative count still compiles a real program)."""
+    if n <= 0:
+        return multiple
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+class ProgramCache:
+    """Bounded, thread-safe compiled-program cache.
+
+    Get-or-create holds the lock across the build so two racing first
+    callers (an HTTP request thread and a background detector — the
+    what-if engine's steady state; the fleet tick and a forced
+    ``/fleet/rebalance``) converge on ONE program object instead of each
+    paying a full XLA compile. FIFO-bounded like the optimizer's
+    audit-fn cache: cache keys can carry per-topic bind masks, so an
+    evolving topic set must not accumulate compiled programs forever.
+    An evicted program still in use keeps working through its holder's
+    reference; the next requester just rebuilds it.
+    """
+
+    def __init__(self, capacity: int = 8) -> None:
+        self.capacity = capacity
+        self._programs: dict = {}
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+    def get_or_build(self, key, build):
+        """Return the cached program for ``key``, building (under the
+        lock) and caching it on a miss."""
+        with self._lock:
+            program = self._programs.get(key)
+            if program is None:
+                program = build()
+                self._programs[key] = program
+                while len(self._programs) > self.capacity:
+                    self._programs.pop(next(iter(self._programs)))
+            return program
+
+
+def pad_model_to(model, new_B: int, new_P: int, new_R: int):
+    """Host-side re-pad of a ``FlatClusterModel`` to larger padded shapes
+    (``new_B`` brokers x ``new_P`` partitions x ``new_R`` replica slots).
+
+    The shared math behind the what-if engine's scenario re-pad (a
+    BrokerAdd/TopicAdd batch outgrowing the live model's padding slack)
+    and the fleet layer's shape-bucket stacking (heterogeneous member
+    clusters padded to one fleet bucket). New broker rows arrive invalid
+    (masked out of every reduction), new partition rows empty (replica
+    slots on the sentinel), so the padded model scores bit-identically to
+    the original. Costs one numpy round-trip + a metered re-upload; a
+    no-op when the shapes already match.
+    """
+    from ..model.flat import FlatClusterModel
+    B = model.num_brokers_padded
+    P, R = model.replica_broker.shape
+    if (new_B, new_P, new_R) == (B, P, R):
+        return model
+    if new_B < B or new_P < P or new_R < R:
+        raise ValueError(
+            f"pad_model_to cannot shrink: have ({B}, {P}, {R}), "
+            f"asked for ({new_B}, {new_P}, {new_R})")
+
+    rb = np.asarray(model.replica_broker)
+    out_rb = np.full((new_P, new_R), new_B, np.int32)
+    # The empty-slot sentinel is the one-past-last broker row, so it moves
+    # with the broker padding: every old-sentinel entry must be rewritten.
+    out_rb[:P, :R] = np.where(rb == B, new_B, rb)
+
+    def pad_p(arr, fill):
+        arr = np.asarray(arr)
+        out = np.full((new_P,) + arr.shape[1:], fill, arr.dtype)
+        out[:P] = arr
+        return out
+
+    def pad_b(arr, fill):
+        arr = np.asarray(arr)
+        out = np.full((new_B,) + arr.shape[1:], fill, arr.dtype)
+        out[:B] = arr
+        return out
+
+    pref = np.tile(np.arange(new_R, dtype=np.int32), (new_P, 1))
+    pref[:P, :R] = np.asarray(model.replica_pref_pos)
+    offline = np.zeros((new_P, new_R), bool)
+    offline[:P, :R] = np.asarray(model.replica_offline)
+    return FlatClusterModel.from_numpy(
+        replica_broker=out_rb,
+        leader_load=pad_p(model.leader_load, 0.0),
+        follower_load=pad_p(model.follower_load, 0.0),
+        partition_topic=pad_p(model.partition_topic, -1),
+        partition_valid=pad_p(model.partition_valid, False),
+        replica_offline=offline,
+        replica_pref_pos=pref,
+        broker_capacity=pad_b(model.broker_capacity, 0.0),
+        broker_rack=pad_b(model.broker_rack, 0),
+        broker_host=pad_b(model.broker_host, 0),
+        broker_set=pad_b(model.broker_set, -1),
+        broker_alive=pad_b(model.broker_alive, False),
+        broker_new=pad_b(model.broker_new, False),
+        broker_demoted=pad_b(model.broker_demoted, False),
+        broker_broken_disk=pad_b(model.broker_broken_disk, False),
+        broker_valid=pad_b(model.broker_valid, False))

@@ -127,11 +127,28 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         from .monitor.store import OnExecutionSampleStore
         fetcher.on_execution_store = OnExecutionSampleStore(
             FileSampleStore(on_exec_dir), executor.has_ongoing_execution)
+    fleet_enabled = config.get_boolean("fleet.enabled")
     facade = KafkaCruiseControl(admin, monitor, task_runner=runner,
                                 optimizer=optimizer, executor=executor,
                                 options_generator=options_generator,
                                 cpu_model=cpu_model,
-                                admin_retry=executor.config.admin_retry)
+                                admin_retry=executor.config.admin_retry,
+                                cluster_id=(config.get_string(
+                                    "fleet.cluster.id")
+                                    if fleet_enabled else None))
+    if fleet_enabled:
+        # Fleet control plane: the local stack is the first member (its
+        # monitor + cluster-scoped proposal cache), further clusters
+        # register programmatically. One batched [C] dispatch per tick
+        # refreshes every stale member cache (docs/fleet.md); the tick
+        # loop starts in main() alongside the facade's own refresher.
+        from .fleet import FleetRegistry
+        facade.fleet = FleetRegistry(
+            optimizer,
+            max_clusters=config.get_int("fleet.max.clusters"))
+        facade.fleet.register(
+            config.get_string("fleet.cluster.id"), monitor,
+            proposal_cache=facade.proposal_cache)
 
     # ref self.healing.goals + the reference's startup sanity check
     # (KafkaCruiseControlConfig sanityCheckGoalNames): a configured
@@ -577,7 +594,15 @@ def main(argv=None) -> int:
         precompute_interval_s=config.get_int("proposal.expiration.ms") / 1000,
         skip_loading=config.get_boolean("skip.loading.samples"),
         freshness_target_ms=config.get_long("proposals.freshness.target.ms"),
-        start_prewarm=config.get_boolean("prewarm.on.start"))
+        start_prewarm=config.get_boolean("prewarm.on.start"),
+        # With the fleet plane on, its shared tick refills the local
+        # member's cache (batched dispatch) — the refresher drops to
+        # watch-only: full freshness-SLO breach accounting, no second
+        # per-cluster compute racing the fleet tick. Blocking reads
+        # still compute on miss either way.
+        precompute_watch_only=app.facade.fleet is not None)
+    if app.facade.fleet is not None:
+        app.facade.fleet.start(config.get_long("fleet.tick.ms") / 1000.0)
     app.facade.detector.start_detection()
     app.start()
     print(f"cruise-control-tpu listening on "

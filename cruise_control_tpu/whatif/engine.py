@@ -37,6 +37,7 @@ from ..analyzer.engine import violation_stack
 from ..analyzer.state import build_context, init_state
 from ..core.resources import NUM_RESOURCES
 from ..model.flat import FlatClusterModel
+from ..parallel.batching import ProgramCache, pad_model_to, round_up
 from .spec import (BrokerAdd, BrokerLoss, CapacityResize, LoadScale,
                    RESOURCE_KEYS, Scenario, TopicAdd)
 
@@ -48,11 +49,34 @@ _RISK_PRESSURE_W = 0.7  # capacity pressure ramps 70% -> 130% of usable
 _RISK_PRESSURE_LO = 0.7
 _RISK_PRESSURE_SPAN = 0.6
 
+_round_up = round_up   # shared bucket math (parallel/batching.py)
 
-def _round_up(n: int, multiple: int) -> int:
-    if n <= 0:
-        return multiple
-    return ((n + multiple - 1) // multiple) * multiple
+
+def violated_matrix(viol: np.ndarray, vscale: np.ndarray) -> np.ndarray:
+    """Boolean violated-goal matrix with the same ulp-aware cutoff as
+    ``GoalResult.satisfied``: a broker landing exactly on a float32-summed
+    capacity limit is not a violation. Shared by the what-if report and
+    the fleet risk sweep."""
+    return viol > (1e-6 + 1e-6 * vscale)
+
+
+def risk_scores(hard_frac: np.ndarray, soft_frac: np.ndarray,
+                pressure: np.ndarray, unavailable: np.ndarray,
+                valid_parts: np.ndarray) -> np.ndarray:
+    """Composite [0, 1] risk (documented in docs/whatif.md): hard/soft
+    violation fractions, capacity-pressure ramp, and availability combine
+    as ``1 - prod(1 - term)``. One definition shared by the what-if
+    report builder and the fleet N-1 sweep so a fleet-reported risk means
+    exactly what ``/simulate`` reports."""
+    pressure_term = np.clip(
+        (pressure - _RISK_PRESSURE_LO) / _RISK_PRESSURE_SPAN, 0.0, 1.0)
+    avail_term = np.where(
+        unavailable > 0,
+        np.minimum(0.9 + 0.1 * unavailable / valid_parts, 1.0), 0.0)
+    return 1.0 - ((1.0 - _RISK_HARD_W * hard_frac)
+                  * (1.0 - _RISK_SOFT_W * soft_frac)
+                  * (1.0 - _RISK_PRESSURE_W * pressure_term)
+                  * (1.0 - avail_term))
 
 
 @dataclass
@@ -178,19 +202,16 @@ class WhatIfEngine:
         self.mesh = mesh
         from ..parallel.sharding import mesh_fingerprint
         self._mesh_key = mesh_fingerprint(mesh)
-        import threading
         self.scenario_pad_multiple = scenario_pad_multiple
         self.partition_pad_multiple = partition_pad_multiple
         self.broker_pad_multiple = broker_pad_multiple
         self.max_scenarios = max_scenarios
         self.program_cache_size = program_cache_size
         # The engine is shared between HTTP request threads (/simulate)
-        # and the detector background thread — get-or-create under a
-        # lock, like the optimizer's _chains (two racing first sweeps
-        # must converge on ONE program object, and eviction must not
-        # iterate a dict another thread is inserting into).
-        self._programs: dict = {}
-        self._programs_lock = threading.Lock()
+        # and the detector background thread — the shared ProgramCache
+        # (parallel/batching.py) holds its lock across the build, so two
+        # racing first sweeps converge on ONE program object.
+        self._programs = ProgramCache(program_cache_size)
         self.registry = registry or MetricRegistry()
         self.tracer = tracer or default_tracer()
         name = MetricRegistry.name
@@ -255,14 +276,11 @@ class WhatIfEngine:
         outside the device program)."""
         batch = self._materialize(model, metadata, scenarios)
         key = ("transform",) + self._shape_key(batch) + (self._mesh_key,)
-        with self._programs_lock:
-            program = self._programs.get(key)
-            if program is None:
-                program = self._cache_program(
-                    key, self.collector.track(
-                        "whatif.transform",
-                        jax.jit(jax.vmap(self._transform_fn(),
-                                         in_axes=(None, 0, 0, 0, 0, 0)))))
+        program = self._programs.get_or_build(
+            key, lambda: self.collector.track(
+                "whatif.transform",
+                jax.jit(jax.vmap(scenario_transform,
+                                 in_axes=(None, 0, 0, 0, 0, 0)))))
         stacked, _has_alive = program(*self._place_batch(batch))
         return [jax.tree.map(lambda a, i=i: a[i], stacked)
                 for i in range(batch.num_real)]
@@ -303,63 +321,7 @@ class WhatIfEngine:
     def _transform_fn():
         """(model, dead, add, cap_scale, pscale, pvalid) -> (model',
         has_alive[P]) — the pure per-scenario topology edit."""
-
-        def transform(model: FlatClusterModel, dead, add, cap_scale,
-                      pscale, pvalid):
-            B = model.num_brokers_padded
-            valid_b = model.broker_valid | add
-            alive_b = (model.broker_alive | add) & ~dead
-            capacity = model.broker_capacity * cap_scale
-            leader_load = model.leader_load * pscale[:, None]
-            follower_load = model.follower_load * pscale[:, None]
-            # Disabled partition rows (template padding this scenario does
-            # not enable) must stay empty: route their replicas to the
-            # sentinel so no scatter ever sees them.
-            rb = jnp.where(pvalid[:, None], model.replica_broker, B)
-            off = model.replica_offline & pvalid[:, None]
-            pref = model.replica_pref_pos
-
-            # Leadership failover: the alive, non-offline replica with the
-            # lowest preferred-order position takes over (Kafka elects from
-            # the ISR in assignment order; pref_pos IS that order).
-            P, R = rb.shape
-            alive1 = jnp.concatenate([alive_b & valid_b,
-                                      jnp.zeros((1,), bool)])
-            slot_valid = rb < B
-            electable = slot_valid & alive1[rb] & ~off
-            score = jnp.where(electable, pref, R + 1)
-            j = jnp.argmin(score, axis=1).astype(jnp.int32)
-            has_alive = electable.any(axis=1)
-            need = has_alive & ~electable[:, 0] & pvalid
-            rows = jnp.arange(P)
-            # Swap slot j <-> slot 0 (broker, preferred position, offline
-            # flag travel together); non-failover rows route the column
-            # write out of bounds (dropped). j > 0 whenever need holds:
-            # slot 0 scores R+1 then, strictly above any electable slot.
-            jw = jnp.where(need, j, R)
-            lead_j, lead_0 = rb[rows, j], rb[:, 0]
-            rb = rb.at[rows, jw].set(lead_0, mode="drop")
-            rb = rb.at[:, 0].set(jnp.where(need, lead_j, lead_0))
-            pref_j, pref_0 = pref[rows, j], pref[:, 0]
-            pref = pref.at[rows, jw].set(pref_0, mode="drop")
-            pref = pref.at[:, 0].set(jnp.where(need, pref_j, pref_0))
-            off_j, off_0 = off[rows, j], off[:, 0]
-            off = off.at[rows, jw].set(off_0, mode="drop")
-            off = off.at[:, 0].set(jnp.where(need, off_j, off_0))
-            # Every replica stranded on a dead/invalid broker is offline.
-            off = off | ((rb < B) & ~alive1[rb])
-
-            m = model.replace(
-                replica_broker=rb, replica_offline=off,
-                replica_pref_pos=pref,
-                leader_load=leader_load, follower_load=follower_load,
-                partition_valid=pvalid,
-                broker_capacity=capacity,
-                broker_alive=alive_b, broker_valid=valid_b,
-                broker_new=model.broker_new | add)
-            return m, has_alive
-
-        return transform
+        return scenario_transform
 
     def _program_for(self, batch: _Batch, goals, metadata):
         needs_tlc = any(g.uses_topic_leader_counts for g in goals)
@@ -372,60 +334,14 @@ class WhatIfEngine:
                + (tuple((g.name, g.bind_signature()) for g in goals),
                   num_topics if needs_topics else None, needs_tlc,
                   self._mesh_key))
-        with self._programs_lock:
-            program = self._programs.get(key)
-            if program is not None:
-                return program
-            return self._build_sweep_program(key, goals, num_topics,
-                                             needs_topics, needs_tlc)
-
-    def _build_sweep_program(self, key, goals, num_topics, needs_topics,
-                             needs_tlc):
-        transform = self._transform_fn()
-        cap_thr = jnp.asarray(self.constraint.capacity_threshold,
-                              jnp.float32)
-        goals = tuple(goals)
-
-        def one(model, dead, add, cap_scale, pscale, pvalid):
-            m, has_alive = transform(model, dead, add, cap_scale, pscale,
-                                     pvalid)
-            state = init_state(
-                m,
-                with_topic_counts=num_topics if needs_topics else None,
-                with_topic_leader_counts=needs_tlc)
-            ctx = build_context(m)
-            viol = violation_stack(goals, state, ctx)
-            vscale = jnp.stack([g.violation_scale(state, ctx)
-                                for g in goals])
-            B = m.num_brokers_padded
-            util = state.util[:B]
-            usable = m.broker_capacity * cap_thr[None, :]
-            alive = m.broker_alive & m.broker_valid
-            headroom = jnp.where(alive[:, None], usable - util, 0.0)
-            hfrac = jnp.where(
-                alive[:, None],
-                1.0 - util / jnp.maximum(usable, 1e-9), jnp.inf)
-            pressure = jnp.where(alive[:, None],
-                                 util / jnp.maximum(usable, 1e-9),
-                                 0.0).max()
-            unavailable = (m.partition_valid & ~has_alive).sum()
-            n_offline = (m.replica_offline & (m.replica_broker < B)).sum()
-            return viol, vscale, headroom, hfrac, pressure, unavailable, \
-                n_offline
-
-        return self._cache_program(
-            key, self.collector.track(
+        one = make_scenario_scorer(
+            goals, self.constraint.capacity_threshold,
+            num_topics=num_topics, needs_topics=needs_topics,
+            needs_tlc=needs_tlc)
+        return self._programs.get_or_build(
+            key, lambda: self.collector.track(
                 "whatif.sweep",
                 jax.jit(jax.vmap(one, in_axes=(None, 0, 0, 0, 0, 0)))))
-
-    def _cache_program(self, key, program):
-        self._programs[key] = program
-        # Bounded like the optimizer's audit-fn cache: bind signatures can
-        # carry per-topic masks, so an evolving topic set must not
-        # accumulate compiled programs forever.
-        while len(self._programs) > self.program_cache_size:
-            self._programs.pop(next(iter(self._programs)))
-        return program
 
     @staticmethod
     def _shape_key(batch: _Batch):
@@ -580,9 +496,10 @@ class WhatIfEngine:
                       n_offline, t0, stale_model) -> WhatIfReport:
         S = len(scenarios)
         hard = np.array([g.hard for g in goals], bool)
-        # Same ulp-aware cutoff as GoalResult.satisfied: a broker landing
-        # exactly on a float32-summed capacity limit is not a violation.
-        violated = viol[:S] > (1e-6 + 1e-6 * vscale[:S])
+        # Ulp-aware violation cutoff + composite risk: the shared
+        # definitions (violated_matrix / risk_scores) the fleet N-1 sweep
+        # reports through as well.
+        violated = violated_matrix(viol[:S], vscale[:S])
         n_hard = max(int(hard.sum()), 1)
         n_soft = max(int((~hard).sum()), 1)
         hard_frac = violated[:, hard].sum(axis=1) / n_hard
@@ -590,15 +507,8 @@ class WhatIfEngine:
         pressure = pressure[:S]
         unavailable = unavailable[:S].astype(int)
         valid_parts = batch.pvalid[:S].sum(axis=1).clip(min=1)
-        pressure_term = np.clip(
-            (pressure - _RISK_PRESSURE_LO) / _RISK_PRESSURE_SPAN, 0.0, 1.0)
-        avail_term = np.where(
-            unavailable > 0,
-            np.minimum(0.9 + 0.1 * unavailable / valid_parts, 1.0), 0.0)
-        risk = 1.0 - ((1.0 - _RISK_HARD_W * hard_frac)
-                      * (1.0 - _RISK_SOFT_W * soft_frac)
-                      * (1.0 - _RISK_PRESSURE_W * pressure_term)
-                      * (1.0 - avail_term))
+        risk = risk_scores(hard_frac, soft_frac, pressure, unavailable,
+                           valid_parts)
 
         def broker_label(row: int):
             if row in batch.new_broker_rows:
@@ -652,7 +562,8 @@ def _ensure_padding(model: FlatClusterModel, spare_b: int, need_b: int,
     model carries. Rare (BrokerAdd / TopicAdd beyond the pad slack) —
     costs one numpy round-trip and a fresh program compile for the new
     shapes. The multiples mirror the model builder's configured pad
-    buckets so the re-pad stays on-bucket."""
+    buckets so the re-pad stays on-bucket; the padding math itself is the
+    shared :func:`..parallel.batching.pad_model_to`."""
     B = model.num_brokers_padded
     P, R = model.replica_broker.shape
     new_B = (B if need_b <= spare_b
@@ -660,43 +571,107 @@ def _ensure_padding(model: FlatClusterModel, spare_b: int, need_b: int,
     new_P = (P if need_p <= spare_p
              else _round_up(P + need_p - spare_p, partition_pad_multiple))
     new_R = max(R, need_r)
-    if (new_B, new_P, new_R) == (B, P, R):
-        return model
+    return pad_model_to(model, new_B, new_P, new_R)
 
-    rb = np.asarray(model.replica_broker)
-    out_rb = np.full((new_P, new_R), new_B, np.int32)
-    out_rb[:P, :R] = np.where(rb == B, new_B, rb)
 
-    def pad_p(arr, fill):
-        arr = np.asarray(arr)
-        out = np.full((new_P,) + arr.shape[1:], fill, arr.dtype)
-        out[:P] = arr
-        return out
+def scenario_transform(model: FlatClusterModel, dead, add, cap_scale,
+                       pscale, pvalid):
+    """``(model, dead, add, cap_scale, pscale, pvalid) -> (model',
+    has_alive[P])`` — the pure per-scenario topology edit
+    (kill/add/resize/scale/enable plus leadership failover), shared by
+    the what-if engine's vmapped sweep and the fleet layer's
+    cluster-sharded N-1 sweep."""
+    B = model.num_brokers_padded
+    valid_b = model.broker_valid | add
+    alive_b = (model.broker_alive | add) & ~dead
+    capacity = model.broker_capacity * cap_scale
+    leader_load = model.leader_load * pscale[:, None]
+    follower_load = model.follower_load * pscale[:, None]
+    # Disabled partition rows (template padding this scenario does
+    # not enable) must stay empty: route their replicas to the
+    # sentinel so no scatter ever sees them.
+    rb = jnp.where(pvalid[:, None], model.replica_broker, B)
+    off = model.replica_offline & pvalid[:, None]
+    pref = model.replica_pref_pos
 
-    def pad_b(arr, fill):
-        arr = np.asarray(arr)
-        out = np.full((new_B,) + arr.shape[1:], fill, arr.dtype)
-        out[:B] = arr
-        return out
+    # Leadership failover: the alive, non-offline replica with the
+    # lowest preferred-order position takes over (Kafka elects from
+    # the ISR in assignment order; pref_pos IS that order).
+    P, R = rb.shape
+    alive1 = jnp.concatenate([alive_b & valid_b,
+                              jnp.zeros((1,), bool)])
+    slot_valid = rb < B
+    electable = slot_valid & alive1[rb] & ~off
+    score = jnp.where(electable, pref, R + 1)
+    j = jnp.argmin(score, axis=1).astype(jnp.int32)
+    has_alive = electable.any(axis=1)
+    need = has_alive & ~electable[:, 0] & pvalid
+    rows = jnp.arange(P)
+    # Swap slot j <-> slot 0 (broker, preferred position, offline
+    # flag travel together); non-failover rows route the column
+    # write out of bounds (dropped). j > 0 whenever need holds:
+    # slot 0 scores R+1 then, strictly above any electable slot.
+    jw = jnp.where(need, j, R)
+    lead_j, lead_0 = rb[rows, j], rb[:, 0]
+    rb = rb.at[rows, jw].set(lead_0, mode="drop")
+    rb = rb.at[:, 0].set(jnp.where(need, lead_j, lead_0))
+    pref_j, pref_0 = pref[rows, j], pref[:, 0]
+    pref = pref.at[rows, jw].set(pref_0, mode="drop")
+    pref = pref.at[:, 0].set(jnp.where(need, pref_j, pref_0))
+    off_j, off_0 = off[rows, j], off[:, 0]
+    off = off.at[rows, jw].set(off_0, mode="drop")
+    off = off.at[:, 0].set(jnp.where(need, off_j, off_0))
+    # Every replica stranded on a dead/invalid broker is offline.
+    off = off | ((rb < B) & ~alive1[rb])
 
-    pref = np.tile(np.arange(new_R, dtype=np.int32), (new_P, 1))
-    pref[:P, :R] = np.asarray(model.replica_pref_pos)
-    offline = np.zeros((new_P, new_R), bool)
-    offline[:P, :R] = np.asarray(model.replica_offline)
-    return FlatClusterModel.from_numpy(
-        replica_broker=out_rb,
-        leader_load=pad_p(model.leader_load, 0.0),
-        follower_load=pad_p(model.follower_load, 0.0),
-        partition_topic=pad_p(model.partition_topic, -1),
-        partition_valid=pad_p(model.partition_valid, False),
-        replica_offline=offline,
+    m = model.replace(
+        replica_broker=rb, replica_offline=off,
         replica_pref_pos=pref,
-        broker_capacity=pad_b(model.broker_capacity, 0.0),
-        broker_rack=pad_b(model.broker_rack, 0),
-        broker_host=pad_b(model.broker_host, 0),
-        broker_set=pad_b(model.broker_set, -1),
-        broker_alive=pad_b(model.broker_alive, False),
-        broker_new=pad_b(model.broker_new, False),
-        broker_demoted=pad_b(model.broker_demoted, False),
-        broker_broken_disk=pad_b(model.broker_broken_disk, False),
-        broker_valid=pad_b(model.broker_valid, False))
+        leader_load=leader_load, follower_load=follower_load,
+        partition_valid=pvalid,
+        broker_capacity=capacity,
+        broker_alive=alive_b, broker_valid=valid_b,
+        broker_new=model.broker_new | add)
+    return m, has_alive
+
+
+def make_scenario_scorer(goals, capacity_threshold, *, num_topics: int,
+                         needs_topics: bool, needs_tlc: bool):
+    """Build the per-scenario scoring function ``one(model, dead, add,
+    cap_scale, pscale, pvalid) -> (viol[G], vscale[G], headroom[B, 4],
+    hfrac[B, 4], pressure, unavailable, n_offline)`` — transform +
+    init_state/build_context + violation stack + headroom reductions.
+    The what-if engine vmaps it over the ``[S]`` scenario axis; the fleet
+    N-1 sweep nests it under a cluster axis. One definition, so a fleet
+    risk and a ``/simulate`` risk can never drift apart."""
+    cap_thr = jnp.asarray(capacity_threshold, jnp.float32)
+    goals = tuple(goals)
+
+    def one(model, dead, add, cap_scale, pscale, pvalid):
+        m, has_alive = scenario_transform(model, dead, add, cap_scale,
+                                          pscale, pvalid)
+        state = init_state(
+            m,
+            with_topic_counts=num_topics if needs_topics else None,
+            with_topic_leader_counts=needs_tlc)
+        ctx = build_context(m)
+        viol = violation_stack(goals, state, ctx)
+        vscale = jnp.stack([g.violation_scale(state, ctx)
+                            for g in goals])
+        B = m.num_brokers_padded
+        util = state.util[:B]
+        usable = m.broker_capacity * cap_thr[None, :]
+        alive = m.broker_alive & m.broker_valid
+        headroom = jnp.where(alive[:, None], usable - util, 0.0)
+        hfrac = jnp.where(
+            alive[:, None],
+            1.0 - util / jnp.maximum(usable, 1e-9), jnp.inf)
+        pressure = jnp.where(alive[:, None],
+                             util / jnp.maximum(usable, 1e-9),
+                             0.0).max()
+        unavailable = (m.partition_valid & ~has_alive).sum()
+        n_offline = (m.replica_offline & (m.replica_broker < B)).sum()
+        return viol, vscale, headroom, hfrac, pressure, unavailable, \
+            n_offline
+
+    return one

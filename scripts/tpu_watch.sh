@@ -76,11 +76,14 @@ while true; do
     # longest cold cost for the least fresh value in a short window.
     # 4::-1 = the sharded 10Kx1M tier (partition axis over every visible
     # chip) right after the single-chip headline, so the sharded-vs-
-    # unsharded A/B lands in one tunnel window.
-    for spec in 2 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # unsharded A/B lands in one tunnel window. 6 = the fleet batched
+    # propose (16 clusters x 100x20K, cluster axis sharded over the
+    # chips) — on real multi-chip hardware the clusters/s row measures
+    # genuine cross-chip concurrency, not forced-host virtual devices.
+    for spec in 2 6 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
-        2|1) tmo=3600 ;; 5) tmo=2400 ;; 4:fullchain) tmo=7200 ;;
+        2|1) tmo=3600 ;; 5|6) tmo=2400 ;; 4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac
       capture "$spec" "$tmo"

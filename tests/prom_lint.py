@@ -8,7 +8,9 @@ _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 
 def lint_prometheus_exposition(text: str,
-                               expect_families: tuple = ()) -> None:
+                               expect_families: tuple = (),
+                               forbid_unlabeled_duplicates: bool = False
+                               ) -> None:
     """Minimal text-format lint: unique # TYPE per series family, a HELP
     line per declared family, legal sample names, float-parsable values,
     and every sample belonging to a declared family.
@@ -16,10 +18,19 @@ def lint_prometheus_exposition(text: str,
     ``expect_families`` additionally asserts each named family is
     DECLARED in the exposition (how the device-runtime/tracing tests pin
     their gauge/counter families to the scrape surface — a renamed or
-    dropped family fails here, not in a dashboard)."""
+    dropped family fails here, not in a dashboard).
+
+    ``forbid_unlabeled_duplicates`` rejects the renderer's numeric-suffix
+    disambiguation of colliding dotted sensor names: two registries
+    carrying the SAME dotted name (e.g. two fleet members' LoadMonitor
+    sensors merged into one scrape) render as ``cc_X`` and ``cc_X_2`` —
+    families nobody can attribute to a cluster. Fleet-facing expositions
+    must namespace per-cluster registries (core/sensors.py
+    NamespacedRegistry) so every family's dotted HELP name is unique."""
     typed: set[str] = set()
     helped: set[str] = set()
     sample_names: set[str] = set()
+    dotted_families: dict[str, set[str]] = {}
     for line in text.splitlines():
         if not line.strip():
             continue
@@ -30,7 +41,19 @@ def lint_prometheus_exposition(text: str,
             typed.add(fam)
             continue
         if line.startswith("# HELP "):
-            helped.add(line.split()[2])
+            parts = line.split()
+            helped.add(parts[2])
+            # "# HELP <family> sensor <dotted-name>" — the renderer's
+            # HELP convention ties every family back to its dotted
+            # sensor; two families per dotted name means suffix-deduped
+            # cross-registry duplicates.
+            if len(parts) >= 5 and parts[3] == "sensor":
+                base = parts[2]
+                for suffix in ("_total", "_rate", "_seconds"):
+                    if base.endswith(suffix):
+                        base = base.removesuffix(suffix)
+                        break          # exactly one kind suffix per family
+                dotted_families.setdefault(parts[4], set()).add(base)
             continue
         assert not line.startswith("#"), f"unknown comment: {line}"
         sample, _, value = line.rpartition(" ")
@@ -48,3 +71,11 @@ def lint_prometheus_exposition(text: str,
     assert not missing, (
         f"expected families missing from exposition: {missing}; "
         f"have {sorted(typed)[:40]}...")
+    if forbid_unlabeled_duplicates:
+        dupes = {dotted: sorted(fams)
+                 for dotted, fams in dotted_families.items()
+                 if len(fams) > 1}
+        assert not dupes, (
+            "unlabeled cross-registry duplicates (numeric-suffix "
+            "disambiguation): namespace per-cluster registries with "
+            f"NamespacedRegistry instead — {dupes}")

@@ -124,6 +124,25 @@ def test_device_stats_bench_smoke_gate():
     assert default_collector().enabled   # A/B harness must restore
 
 
+def test_fleet_propose_bench_smoke_gate():
+    """run_fleet_propose_bench on a toy fleet: exercises the batched
+    [C] dispatch, the sequential baseline loop, and the three always-on
+    correctness gates end-to-end — bit-identical fleet-vs-sequential
+    proposals, zero warm recompiles, one dispatch group (the helper
+    raises on any of them). Tier-1 safe: no clusters/s gate at toy scale
+    — the >= 5x bar is judged at bench scale (16 x 100x20k, scenario 6),
+    where the cluster axis spans 16 forced-host devices."""
+    import bench
+    out = bench.run_fleet_propose_bench(
+        num_clusters=4, num_brokers=10, num_partitions=96,
+        goal_names=["ReplicaDistributionGoal"],
+        repeats=1, emit_row=False, gate=False)
+    assert out["clusters"] == 4
+    assert out["recompiles"] == 0
+    assert out["warm_s"] > 0 and out["seq_s"] > 0
+    assert out["speedup"] is not None and out["clusters_per_s"] > 0
+
+
 @pytest.mark.slow
 def test_scale_tier_gate_smoke():
     """The GATED scale tier (run_scale_scenario) at a CI-sized cluster,

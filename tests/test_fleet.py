@@ -1,0 +1,539 @@
+"""Fleet control plane tests (ISSUE 10):
+
+- THE tier-1 parity gate: batched ``[C]`` fleet propose is BIT-IDENTICAL
+  to sequential per-cluster propose for C=3 heterogeneous small clusters
+  (proposals, moves, violations, audit verdicts) — sharing the
+  process-wide compiled-chain registry so the sequential side compiles
+  its 2-goal chain once for the whole module;
+- fleet N-1 sweep risk == per-cluster WhatIfEngine risk at the same
+  (fleet-bucket) shapes;
+- dispatch grouping: members whose scaled search configs differ split
+  into per-group dispatches (the heterogeneity degrade path) and still
+  match sequential;
+- ProposalCache cluster scoping: fleet members can never cross-serve or
+  cross-invalidate each other's proposals;
+- sensor namespacing: merged scrapes over multiple monitors' registries
+  must not emit unlabeled numeric-suffix duplicate families
+  (prom_lint's ``forbid_unlabeled_duplicates``);
+- FleetRegistry: shared tick feeding per-cluster caches, the
+  zero-recompile gate across warm fleet ticks, the /devicestats fleet
+  section, and the /fleet + /fleet/rebalance API surface.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (OptimizationFailureError,
+                                         OptimizationOptions, SearchConfig,
+                                         TpuGoalOptimizer, goals_by_name)
+from cruise_control_tpu.core.runtime_obs import default_collector
+from cruise_control_tpu.fleet import FleetModel, FleetOptimizer, FleetRegistry
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+
+from prom_lint import lint_prometheus_exposition
+
+GOALS = ["ReplicaDistributionGoal", "DiskUsageDistributionGoal"]
+#: scaled_for must yield ONE config across the heterogeneous members
+#: (candidate pools clamp to real counts): every knob sits at or below
+#: the smallest cluster's clamp point.
+CFG = SearchConfig(num_replica_candidates=64, num_dest_candidates=4,
+                   num_swap_candidates=32, apply_per_iter=32,
+                   drain_batch=64, max_iters_per_goal=48)
+
+
+def _cluster(brokers, partitions, seed):
+    bs = [BrokerSpec(broker_id=i, rack=f"r{i % 4}") for i in range(brokers)]
+    ps = [PartitionSpec(topic=f"t{p % 5}", partition=p,
+                        replicas=[p % 2, 2 + p % 3],
+                        leader_load=(1.0, 10.0, 12.0,
+                                     60.0 + ((p * seed) % 13)))
+          for p in range(partitions)]
+    return flatten_spec(ClusterSpec(brokers=bs, partitions=ps))
+
+
+@pytest.fixture(scope="module")
+def fleet3():
+    """C=3 heterogeneous members (8/10/12 brokers, 96/128/160 partitions)
+    stacked to one fleet bucket."""
+    members = []
+    for i, (b, p) in enumerate([(8, 96), (10, 128), (12, 160)]):
+        model, md = _cluster(b, p, i + 3)
+        members.append((f"c{i}", model, md))
+    return FleetModel.stack(members, broker_pad_multiple=8,
+                            partition_pad_multiple=64)
+
+
+@pytest.fixture(scope="module")
+def opt():
+    """ONE single-cluster optimizer for the module: the sequential
+    baseline and the fleet engine share its compiled-chain registry, so
+    the 2-goal chain compiles once for the fleet-bucket shapes."""
+    return TpuGoalOptimizer(goals=goals_by_name(GOALS), config=CFG)
+
+
+@pytest.fixture(scope="module")
+def fleet_opt(opt):
+    return FleetOptimizer(opt)
+
+
+# ------------------------------------------------------------- parity gate
+
+def test_fleet_vs_sequential_propose_bit_identical(fleet3, opt, fleet_opt):
+    """THE tier-1 gate: one batched dispatch over [C] must serve byte-
+    for-byte the proposals the sequential per-cluster path computes from
+    the same (fleet-bucket-padded) member models — and it must be ONE
+    dispatch group for these heterogeneous members."""
+    opts = OptimizationOptions(seed=3, skip_hard_goal_check=True)
+    results = fleet_opt.propose(fleet3, opts)
+    assert fleet_opt._groups_gauge_val == 1
+    for member, fleet_res in zip(fleet3.members, results):
+        seq = opt.optimize(member.model, member.metadata, opts)
+        assert [p.to_json() for p in fleet_res.proposals] \
+            == [p.to_json() for p in seq.proposals], member.cluster_id
+        assert fleet_res.num_moves == seq.num_moves
+        assert [(g.name, g.violation_before, g.violation_after,
+                 g.iterations, g.accepted)
+                for g in fleet_res.goal_results] \
+            == [(g.name, g.violation_before, g.violation_after,
+                 g.iterations, g.accepted)
+                for g in seq.goal_results], member.cluster_id
+        assert fleet_res.violated_hard_goals == seq.violated_hard_goals
+
+
+def test_fleet_hard_goal_audit_parity(fleet3, opt, fleet_opt):
+    """Strict options: the off-chain hard-goal audit runs inside the
+    fleet dispatch and must reach the sequential path's verdicts; a
+    member whose hard goals stay violated comes back as a CAPTURED
+    OptimizationFailureError (the sequential path raises) so one bad
+    cluster cannot destroy the rest of the fleet's results."""
+    opts = OptimizationOptions(
+        seed=5, waived_hard_goals=frozenset({"RackAwareGoal",
+                                             "CpuCapacityGoal"}))
+    results = fleet_opt.propose(fleet3, opts)
+    for member, fleet_res in zip(fleet3.members, results):
+        try:
+            seq = opt.optimize(member.model, member.metadata, opts)
+            seq_failed = False
+        except OptimizationFailureError as e:
+            seq, seq_failed = e.result, True
+        fleet_failed = isinstance(fleet_res, OptimizationFailureError)
+        fr = fleet_res.result if fleet_failed else fleet_res
+        assert fleet_failed == seq_failed, member.cluster_id
+        assert [(g.name, g.satisfied, g.violation_before,
+                 g.violation_after) for g in fr.hard_goal_audit] \
+            == [(g.name, g.satisfied, g.violation_before,
+                 g.violation_after) for g in seq.hard_goal_audit]
+
+
+def test_fleet_n1_sweep_matches_whatif(fleet3, opt, fleet_opt):
+    """The batched fleet N-1 sweep reports the same risk, riskiest
+    broker and scenario count a per-cluster WhatIfEngine sweep computes
+    at the same shapes — same scorer, same risk formula, one dispatch."""
+    from cruise_control_tpu.whatif import WhatIfEngine, n1_sweep
+    sweeps = fleet_opt.sweep_n1(fleet3)
+    eng = WhatIfEngine(goals=opt.goals, constraint=opt.constraint)
+    for member, got in zip(fleet3.members, sweeps):
+        report = eng.sweep(member.model, member.metadata,
+                           n1_sweep(list(member.metadata.broker_ids)))
+        worst = report.riskiest()
+        assert got["clusterId"] == member.cluster_id
+        assert got["scenarios"] == report.num_scenarios
+        assert got["maxRisk"] == round(worst.risk, 4)
+        assert got["riskiestBroker"] in worst.scenario.brokers
+
+
+def test_fleet_grouping_degrades_on_mixed_configs(opt, fleet_opt):
+    """Members whose scaled search configs differ (a 3-broker toy clamps
+    num_dest_candidates below the others) cannot share one traced
+    program: propose splits them into per-group dispatches — and each
+    group still matches its sequential baseline."""
+    m0, md0 = _cluster(8, 96, 1)
+    bs = [BrokerSpec(broker_id=i, rack=f"r{i}") for i in range(3)]
+    ps = [PartitionSpec(topic=f"t{p % 5}", partition=p,
+                        replicas=[p % 3, (p + 1) % 3],
+                        leader_load=(1.0, 10.0, 12.0, 60.0 + (p % 9)))
+          for p in range(96)]
+    m1, md1 = flatten_spec(ClusterSpec(brokers=bs, partitions=ps))
+    fleet = FleetModel.stack([("a", m0, md0), ("b", m1, md1)],
+                             broker_pad_multiple=8,
+                             partition_pad_multiple=64)
+    opts = OptimizationOptions(seed=7, skip_hard_goal_check=True)
+    results = fleet_opt.propose(fleet, opts)
+    assert fleet_opt._groups_gauge_val == 2
+    for member, fleet_res in zip(fleet.members, results):
+        seq = opt.optimize(member.model, member.metadata, opts)
+        assert [p.to_json() for p in fleet_res.proposals] \
+            == [p.to_json() for p in seq.proposals], member.cluster_id
+        assert fleet_res.num_moves == seq.num_moves
+
+
+@pytest.mark.slow
+def test_fleet_heavy_c_parity():
+    """Heavier C (10 members over the 8-device test mesh, so devices
+    carry 2 clusters each through the lax.map path): spot-check parity
+    on first/middle/last members."""
+    opt = TpuGoalOptimizer(goals=goals_by_name(GOALS), config=CFG)
+    members = []
+    for i in range(10):
+        model, md = _cluster(8, 96, i)
+        members.append((f"h{i}", model, md))
+    fleet = FleetModel.stack(members, broker_pad_multiple=8,
+                             partition_pad_multiple=64)
+    opts = OptimizationOptions(seed=11, skip_hard_goal_check=True)
+    results = FleetOptimizer(opt).propose(fleet, opts)
+    for idx in (0, 5, 9):
+        member = fleet.members[idx]
+        seq = opt.optimize(member.model, member.metadata, opts)
+        assert [p.to_json() for p in results[idx].proposals] \
+            == [p.to_json() for p in seq.proposals]
+
+
+# --------------------------------------------------- cache cluster scoping
+
+class _StubMonitor:
+    def __init__(self, generation=1):
+        self.generation = generation
+
+
+class _StubResult:
+    stale_model = False
+
+
+def test_proposal_cache_cluster_scoping():
+    """Fleet members' caches are id-scoped: a result offered under the
+    wrong (or no) cluster id is a hard error, never a silent cross-serve
+    — generation ints are per-monitor counters, so two clusters at the
+    same generation would otherwise alias."""
+    mon_a, mon_b = _StubMonitor(5), _StubMonitor(5)
+    from cruise_control_tpu.api.precompute import ProposalCache
+    cache_a = ProposalCache(mon_a, optimizer=None, cache_id="a")
+    cache_b = ProposalCache(mon_b, optimizer=None, cache_id="b")
+    res = _StubResult()
+    assert cache_a.store(res, generation=5, cache_id="a")
+    assert cache_a.valid()
+    with pytest.raises(ValueError, match="cross-serve"):
+        cache_b.store(res, generation=5, cache_id="a")
+    with pytest.raises(ValueError, match="cross-serve"):
+        cache_b.store(res, generation=5)      # unstamped write
+    assert not cache_b.valid(), "cross store must not fill the cache"
+    # Generation keying stays the soft reject it always was.
+    assert not cache_a.store(res, generation=4, cache_id="a")
+    # Un-scoped caches (single-cluster default) accept unstamped writes.
+    cache_plain = ProposalCache(_StubMonitor(2), optimizer=None)
+    assert cache_plain.store(res, generation=2)
+    # The cache id is carried into the freshness sensor names + payload.
+    assert cache_a.registry.get(
+        "ProposalCache.a.freshness-slo-breaches") is not None
+    assert cache_a.freshness_json(0)["cacheId"] == "a"
+
+
+def test_watch_only_refresh_never_computes():
+    """Fleet members keep the freshness-SLO accounting through the
+    refresher in watch-only mode — but the refills come from the
+    batched fleet tick, so the watch tick must never compute (the
+    None optimizer here would crash if it tried)."""
+    from cruise_control_tpu.api.precompute import ProposalCache
+    cache = ProposalCache(_StubMonitor(3), optimizer=None, cache_id="w")
+    cache.freshness_target_ms = 1000
+    assert cache.refresh_once(lambda: 5000, compute=False) is False
+    assert cache.num_computations == 0
+    # Lag is still observed/reported (the SLO surface stays live).
+    assert cache.freshness_lag_ms(7000) == 2000
+
+
+# ------------------------------------------------------ sensor namespacing
+
+def test_namespaced_registry_prevents_unlabeled_duplicates():
+    """Two members' registries carry IDENTICAL dotted sensor names. The
+    shared renderer can only disambiguate by numeric family suffix
+    (``cc_X`` vs ``cc_X_2`` — unlabeled, unattributable; now rejected by
+    prom_lint's forbid_unlabeled_duplicates), and the name-keyed
+    composite merge would silently DROP the second cluster's series
+    entirely. Cluster-namespaced views render attributable
+    ``cc_<cluster>_*`` families: lint-clean, nothing dropped."""
+    from cruise_control_tpu.core.sensors import (CompositeRegistry,
+                                                 MetricRegistry,
+                                                 NamespacedRegistry,
+                                                 _render_exposition)
+    regs = []
+    for i in range(2):
+        reg = MetricRegistry()
+        reg.timer("LoadMonitor.cluster-model-creation-timer").update(0.1)
+        reg.meter("LoadMonitor.stale-models-served").mark(i + 1)
+        regs.append(reg)
+    # The un-namespaced merged scrape: every member's sensors in one
+    # rendered list — duplicate dotted names come out suffix-deduped.
+    merged = _render_exposition(
+        sorted(regs[0].snapshot() + regs[1].snapshot(),
+               key=lambda pair: pair[0]))
+    lint_prometheus_exposition(merged)        # format-legal...
+    with pytest.raises(AssertionError, match="unlabeled"):
+        lint_prometheus_exposition(merged,    # ...but unattributable
+                                   forbid_unlabeled_duplicates=True)
+    # The name-keyed composite merge is no fix: it keeps the exposition
+    # legal by silently serving only ONE cluster's series.
+    composite = CompositeRegistry(lambda: list(regs)).expose_text()
+    assert "cc_LoadMonitor_stale_models_served_total 1" in composite
+    assert "stale_models_served_total 2" not in composite
+    namespaced = CompositeRegistry(lambda: [
+        NamespacedRegistry(reg, f"c{i}")
+        for i, reg in enumerate(regs)]).expose_text()
+    lint_prometheus_exposition(namespaced,
+                               forbid_unlabeled_duplicates=True)
+    assert "cc_c0_LoadMonitor_cluster_model_creation_timer_seconds" \
+        in namespaced
+    assert "cc_c1_LoadMonitor_stale_models_served_total 2" in namespaced
+
+
+# ---------------------------------------------------------- fleet registry
+
+WINDOW_MS = 1000
+TICK_CFG = SearchConfig(num_replica_candidates=16, num_dest_candidates=4,
+                        num_swap_candidates=8, apply_per_iter=16,
+                        drain_batch=16, max_iters_per_goal=32)
+
+
+def _sim_cluster(num_brokers, partitions):
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    for p in range(partitions):
+        sim.add_partition(f"t{p % 3}", p,
+                          [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=10.0 + p)
+    return sim
+
+
+class _Feed:
+    """Deterministic dense sample feed (the test_resident pattern)."""
+
+    def __init__(self, sim, monitor):
+        from cruise_control_tpu.core.metricdef import partition_metric_def
+        self.monitor = monitor
+        self.keys = sorted(sim.describe_partitions())
+        self.M = partition_metric_def().size()
+        self.next_window = 0
+
+    def ingest(self, bump=0.0, windows=2):
+        P = len(self.keys)
+        vals = ((np.arange(P * self.M, dtype=np.float64)
+                 .reshape(P, self.M) % 8) + 1.0 + bump)
+        for _ in range(windows):
+            times = np.full(P, self.next_window * WINDOW_MS + 100,
+                            np.int64)
+            self.monitor.partition_aggregator.add_samples_dense(
+                self.keys, times, vals)
+            self.next_window += 1
+
+    @property
+    def now_ms(self):
+        return self.next_window * WINDOW_MS
+
+
+@pytest.fixture(scope="module")
+def fleet_registry():
+    """A 2-member fleet over simulated clusters with live sample feeds;
+    the module shares it so the tick-path programs compile once."""
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+    opt = TpuGoalOptimizer(goals=goals_by_name(GOALS), config=TICK_CFG)
+    clock = {"now": 0}
+    registry = FleetRegistry(opt, now_ms=lambda: clock["now"])
+    feeds = []
+    for cid, (b, p) in (("east", (4, 24)), ("west", (6, 32))):
+        sim = _sim_cluster(b, p)
+        mon = LoadMonitor(sim, MonitorConfig(num_windows=4,
+                                             window_ms=WINDOW_MS))
+        registry.register(cid, mon)
+        feeds.append(_Feed(sim, mon))
+    return registry, feeds, clock
+
+
+def _advance(feeds, clock, bump):
+    for f in feeds:
+        f.ingest(bump=bump)
+    clock["now"] = max(f.now_ms for f in feeds)
+
+
+def test_fleet_registry_tick_feeds_cluster_caches(fleet_registry):
+    registry, feeds, clock = fleet_registry
+    _advance(feeds, clock, bump=0.0)
+    summary = registry.tick()
+    assert summary == {"clusters": 2, "ready": 2, "proposed": 2,
+                       "errors": 0, "skipped": 0}
+    for cid in ("east", "west"):
+        h = registry.member(cid)
+        assert h.cache.valid(), cid
+        assert h.cache.cache_id == cid
+        assert h.last_summary["balanceScore"] >= 0.0
+        assert h.last_risk is not None and h.last_risk["scenarios"] > 0
+    # A cache-valid tick skips the dispatch entirely (the fleet tick is
+    # the members' background refresher, not a hot loop).
+    assert registry.tick()["skipped"] == 2
+
+
+def test_fleet_zero_recompile_gate_across_warm_ticks(fleet_registry):
+    """The tier-1 fleet extension of the zero-recompile gate: after the
+    warmup tick, >=3 consecutive fleet ticks (fresh samples each — full
+    model rebuild + batched propose + N-1 sweep) report ZERO compile
+    events on the device-runtime ledger."""
+    registry, feeds, clock = fleet_registry
+    _advance(feeds, clock, bump=1.0)
+    registry.tick()                               # warmup tick
+    collector = default_collector()
+    before = collector.snapshot()
+    for i in range(3):
+        _advance(feeds, clock, bump=2.0 + i)
+        summary = registry.tick()
+        assert summary["proposed"] == 2
+    after = collector.snapshot()
+    assert after["compileEvents"] == before["compileEvents"], \
+        "warm fleet ticks must not compile"
+    assert after["aotCompileEvents"] == before["aotCompileEvents"]
+    assert after["recompileEvents"] == before["recompileEvents"]
+
+
+def test_fleet_partial_readiness_reuses_programs():
+    """A member still warming in must not change the dispatch shapes:
+    the registry pins the engine's cluster-bucket floor to the MEMBER
+    count, so ticks over a partial ready subset — and the later
+    full-readiness tick — all reuse one compiled program set (a
+    per-subset-size program would recompile the walk on every
+    readiness change and defeat the amortization)."""
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+    opt = TpuGoalOptimizer(goals=goals_by_name(GOALS), config=TICK_CFG)
+    clock = {"now": 0}
+    registry = FleetRegistry(opt, now_ms=lambda: clock["now"])
+    feeds = []
+    for cid, (b, p) in (("a", (4, 24)), ("b", (6, 32)), ("late", (4, 24))):
+        sim = _sim_cluster(b, p)
+        mon = LoadMonitor(sim, MonitorConfig(num_windows=4,
+                                             window_ms=WINDOW_MS))
+        registry.register(cid, mon)
+        feeds.append(_Feed(sim, mon))
+    # Only a and b have samples; "late" stays NOT_READY.
+    _advance(feeds[:2], clock, bump=0.0)
+    assert registry.tick() == {"clusters": 3, "ready": 2, "proposed": 2,
+                               "errors": 0, "skipped": 0}   # warm-up tick
+    collector = default_collector()
+    before = collector.snapshot()
+    _advance(feeds[:2], clock, bump=1.0)
+    assert registry.tick()["proposed"] == 2
+    # "late" warms in: same cluster bucket (floor == member count), so
+    # the 3-ready tick reuses the programs the 2-ready ticks compiled.
+    feeds[2].ingest(bump=0.0, windows=feeds[0].next_window)
+    _advance(feeds, clock, bump=2.0)
+    summary = registry.tick()
+    assert summary["ready"] == 3 and summary["proposed"] == 3
+    after = collector.snapshot()
+    assert after["compileEvents"] == before["compileEvents"], \
+        "readiness changes within a fixed membership must not compile"
+    assert after["recompileEvents"] == before["recompileEvents"]
+    assert registry.member("late").cache.valid()
+
+
+def test_fleet_group_key_carries_seed(fleet3, fleet_opt):
+    """The PRNG stream is shared per dispatch group, so options whose
+    seed differs (an options generator varying it per cluster) must
+    split groups — otherwise members would run under another member's
+    stream and silently break sequential parity."""
+    p1 = fleet_opt._prepare_member(
+        fleet3.members[0],
+        OptimizationOptions(seed=1, skip_hard_goal_check=True))
+    p2 = fleet_opt._prepare_member(
+        fleet3.members[0],
+        OptimizationOptions(seed=2, skip_hard_goal_check=True))
+    assert p1["group_key"] != p2["group_key"]
+
+
+def test_fleet_summary_and_devicestats_section(fleet_registry):
+    registry, feeds, clock = fleet_registry
+    summary = registry.summary_json()
+    assert summary["enabled"] and summary["numClusters"] == 2
+    by_id = {c["clusterId"]: c for c in summary["clusters"]}
+    assert by_id["east"]["freshness"]["cacheId"] == "east"
+    assert by_id["west"]["risk"]["scenarios"] > 0
+    assert summary["bucket"]["clusters"] == 2
+    stats = registry.stats_json()
+    assert stats["clusterCount"] == 2
+    assert stats["bucket"]["brokersPadded"] >= 8
+    assert stats["lastDispatchMs"] is not None and stats["ticks"] >= 1
+    # Merged scrape over both members' registries must be lint-clean
+    # WITH the cross-cluster duplicate check armed.
+    from cruise_control_tpu.core.sensors import CompositeRegistry
+    text = CompositeRegistry(registry.scrape_registries).expose_text()
+    lint_prometheus_exposition(text, forbid_unlabeled_duplicates=True)
+    assert "cc_east_LoadMonitor" in text and "cc_west_LoadMonitor" in text
+
+
+def test_fleet_api_surface(fleet_registry):
+    """GET /fleet + POST /fleet/rebalance through the real router (path
+    aliases included), the /devicestats fleet section through the
+    facade, and the OpenAPI document carrying both endpoints."""
+    import json
+
+    from cruise_control_tpu.api import CruiseControlApp, KafkaCruiseControl
+    from cruise_control_tpu.api.server import route_request
+    registry, feeds, clock = fleet_registry
+    east = registry.member("east")
+    facade = KafkaCruiseControl(
+        east.monitor.admin, east.monitor,
+        optimizer=registry.engine.optimizer, cluster_id="east")
+    app = CruiseControlApp(facade, port=0)
+    app.start()
+    try:
+        status, _ctype, body, _h = route_request(
+            app, "GET", "/fleet", {}, b"", "127.0.0.1")
+        assert status == 200
+        assert json.loads(body)["enabled"] is False
+        facade.fleet = registry
+        status, _ctype, body, _h = route_request(
+            app, "GET", "/kafkacruisecontrol/fleet", {}, b"", "127.0.0.1")
+        payload = json.loads(body)
+        assert status == 200 and payload["numClusters"] == 2
+        _advance(feeds, clock, bump=9.0)
+        status, _ctype, body, _h = route_request(
+            app, "POST", "/fleet/rebalance", {}, b"", "127.0.0.1")
+        payload = json.loads(body)
+        assert status == 200 and payload["tick"]["proposed"] == 2
+        status, _ctype, body, _h = route_request(
+            app, "GET", "/fleet?json=false", {}, b"", "127.0.0.1")
+        assert status == 200 and b"CLUSTER" in body
+        dstats = facade.device_stats_json()
+        assert dstats["fleet"]["clusterCount"] == 2
+        from cruise_control_tpu.api.openapi import openapi_spec
+        spec = openapi_spec()
+        assert "post" in spec["paths"]["/kafkacruisecontrol/fleet_rebalance"]
+        assert "get" in spec["paths"]["/kafkacruisecontrol/fleet"]
+    finally:
+        app.stop()
+
+
+def test_fleet_registry_guards(fleet_registry):
+    registry, _feeds, _clock = fleet_registry
+    from cruise_control_tpu.api.precompute import ProposalCache
+    with pytest.raises(ValueError, match="already registered"):
+        registry.register("east", registry.member("east").monitor)
+    with pytest.raises(ValueError, match="does not match"):
+        registry.register(
+            "north", _StubMonitor(),
+            proposal_cache=ProposalCache(_StubMonitor(), optimizer=None,
+                                         cache_id="south"))
+    small = FleetRegistry(registry.engine.optimizer, max_clusters=1)
+    small.register("only", _StubMonitor())
+    with pytest.raises(ValueError, match="fleet is full"):
+        small.register("overflow", _StubMonitor())
+
+
+def test_fleet_engine_exclusivity_guards():
+    import jax
+    from cruise_control_tpu.parallel import make_mesh
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        FleetOptimizer(TpuGoalOptimizer(goals=goals_by_name(GOALS),
+                                        config=CFG, branches=2))
+    if len(jax.devices()) >= 2:
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            FleetOptimizer(TpuGoalOptimizer(goals=goals_by_name(GOALS),
+                                            config=CFG,
+                                            mesh=make_mesh(2)))

@@ -254,12 +254,15 @@ def test_goals_param_kafka_assigner_mode():
 
 def test_openapi_covers_all_endpoints():
     # 23 reference endpoints + the openapi document itself + this
-    # build's simulate (what-if sweeps), trace (span export) and
-    # devicestats (device-runtime ledger).
+    # build's simulate (what-if sweeps), trace (span export),
+    # devicestats (device-runtime ledger), and the fleet pair
+    # (fleet summary + fleet_rebalance forced tick).
     spec = openapi_spec()
-    assert len(ENDPOINTS) == 27
-    assert len(spec["paths"]) == 27
+    assert len(ENDPOINTS) == 29
+    assert len(spec["paths"]) == 29
     assert "get" in spec["paths"]["/kafkacruisecontrol/devicestats"]
+    assert "get" in spec["paths"]["/kafkacruisecontrol/fleet"]
+    assert "post" in spec["paths"]["/kafkacruisecontrol/fleet_rebalance"]
     reb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]
     names = {p["name"] for p in reb["parameters"]}
     assert {"dryrun", "goals", "kafka_assigner",
