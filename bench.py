@@ -406,6 +406,166 @@ def run_fleet_propose_bench(num_clusters: int = 16,
             "devices": len(jax.devices())}
 
 
+#: documented move-count tolerance for the multi-objective A/B gate: the
+#: population winner may spend up to this factor of the sequential
+#: chain's moves reaching its (no-worse) violation stacks. docs/search.md.
+MULTIOBJ_MOVE_TOLERANCE = 1.5
+#: documented quality tolerance (scale-NORMALIZED weighted-objective
+#: units): tuned-schedule quality may not exceed the fixed schedule's by
+#: more than this — mirrors the tuner's own 1.02x feasibility band on
+#: residuals that are ~O(1) normalized when not fully converged.
+MULTIOBJ_QUALITY_TOL = 0.05
+
+
+def run_multiobj_propose_bench(num_brokers: int = NUM_BROKERS,
+                               num_partitions: int = NUM_PARTITIONS, *,
+                               goal_names: list | None = None,
+                               population: int = 4,
+                               tune_trials: int = 4, tune_rungs: int = 2,
+                               repeats: int = 3, seed: int = 3,
+                               store_path: str | None = None,
+                               emit_row: bool = True, gate: bool = True
+                               ) -> dict:
+    """Tuned multi-objective population search vs the fixed-schedule
+    sequential chain (ISSUE 11). Three stages:
+
+    1. **baseline**: the sequential goal chain under the DEFAULT
+       ``SearchConfig`` — the fixed schedule every untuned process
+       serves — compile+warm, then best-of-``repeats`` warm propose;
+    2. **offline tuning**: successive-halving over the schedule space
+       (``analyzer/tuning.py``) on this very scenario, winner persisted
+       per shape bucket into the TunedConfigStore (the store a serving
+       process loads via ``search.tuning.enabled``);
+    3. **tuned population propose**: ``search.population=K`` under the
+       tuned schedule — every member the full chain on its own device
+       stream, joint weighted scoring, anchor member 0.
+
+    Emitted rows: ``multiobj_propose_wall_clock`` (tuned population warm
+    propose; vs_baseline/vs_greedy = fixed-schedule sequential warm /
+    tuned population warm — >1 means the learned schedule beats the
+    fixed one) and ``proposal_quality_delta`` (tuned population final
+    weighted objective minus sequential's, scale-normalized units —
+    <= 0 means no quality given up).
+
+    Always-on gates (any scale): zero warm recompiles on the population
+    path, quality delta within MULTIOBJ_QUALITY_TOL, move count within
+    MULTIOBJ_MOVE_TOLERANCE of sequential. The wall-clock >= 1x gate is
+    judged at bench scale only (``gate=False`` for the tier-1 smoke) —
+    population concurrency needs real (or forced-host) devices, which
+    scenario 7 forces like the fleet scenario does."""
+    import jax
+
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig,
+                                             TpuGoalOptimizer,
+                                             TunedConfigStore, autotune,
+                                             goals_by_name, plan_quality)
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    from cruise_control_tpu.model.spec import flatten_spec
+
+    names = goal_names or GOALS
+    spec = build_spec(num_brokers=num_brokers,
+                      num_partitions=num_partitions)
+    model, md = flatten_spec(spec)
+    opts = OptimizationOptions(seed=seed, skip_hard_goal_check=True)
+    base = SearchConfig()
+    # ONE scoring convention across the tuner's feasibility test, these
+    # gates, and the population A/B tests (analyzer/tuning.plan_quality).
+    quality = plan_quality
+
+    # 1. Fixed-schedule sequential baseline.
+    seq_opt = TpuGoalOptimizer(goals=goals_by_name(names), config=base)
+    seq_opt.optimize(model, md, opts)                  # compile + warm
+    seq_s, seq_res = float("inf"), None
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        seq_res = seq_opt.optimize(model, md, opts)
+        seq_s = min(seq_s, time.monotonic() - t0)
+    seq_q = quality(seq_res)
+
+    # 2. Offline tuning into the persisted store (the expensive half —
+    # each candidate schedule compiles its own chain; logged, not gated:
+    # tuning cost is paid offline, never on the serving path).
+    store = TunedConfigStore(store_path)
+    t0 = time.monotonic()
+    fields, history, bucket = autotune(
+        model, md, base=base, store=store, trials=tune_trials,
+        rungs=tune_rungs, seed=seed, goals=goals_by_name(names),
+        options=opts)
+    tune_s = time.monotonic() - t0
+    log(f"multiobj tuning: {len(history)} trials in {tune_s:.1f}s -> "
+        f"bucket {bucket} fields {fields or '(incumbent schedule kept)'}")
+
+    # 3. Tuned population propose (K members, anchor = sequential
+    # schedule under the TUNED config).
+    pop_opt = TpuGoalOptimizer(goals=goals_by_name(names), config=base,
+                               tuned_store=store, population=population)
+    t0 = time.monotonic()
+    pop_opt.optimize(model, md, opts)                  # compile + warm
+    cold_s = time.monotonic() - t0
+    collector = default_collector()
+    before = collector.snapshot()
+    pop_s, pop_res = float("inf"), None
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        pop_res = pop_opt.optimize(model, md, opts)
+        pop_s = min(pop_s, time.monotonic() - t0)
+    after = collector.snapshot()
+    recompiles = (after["compileEvents"] + after["aotCompileEvents"]
+                  - before["compileEvents"] - before["aotCompileEvents"])
+    if recompiles:
+        raise RuntimeError(
+            f"multiobj warm-recompile gate: {recompiles} compile events "
+            f"across {repeats} warm population proposes (expected 0)")
+    pop_q = quality(pop_res)
+    quality_delta = pop_q - seq_q
+    if quality_delta > MULTIOBJ_QUALITY_TOL:
+        raise RuntimeError(
+            f"multiobj quality gate: tuned population objective {pop_q:.4f}"
+            f" worse than fixed-schedule sequential {seq_q:.4f} by "
+            f"{quality_delta:.4f} (> {MULTIOBJ_QUALITY_TOL})")
+    # max(.., 1): a 0-move sequential baseline (already-balanced
+    # scenario) must not turn the multiplicative tolerance into "any
+    # population move fails" — same floor the tuner's feasibility test
+    # uses.
+    if pop_res.num_moves > max(seq_res.num_moves, 1) \
+            * MULTIOBJ_MOVE_TOLERANCE:
+        raise RuntimeError(
+            f"multiobj move gate: population plan spends "
+            f"{pop_res.num_moves} moves vs sequential "
+            f"{seq_res.num_moves} (tolerance {MULTIOBJ_MOVE_TOLERANCE}x)")
+    speedup = seq_s / pop_s if pop_s > 0 else None
+    pop_stats = (pop_res.telemetry or {}).get("population", {})
+    log(f"multiobj propose ({num_brokers}x{num_partitions}, "
+        f"{len(names)} goals, K={pop_stats.get('size')}, "
+        f"{len(jax.devices())} devices): fixed-seq warm {seq_s:.3f}s, "
+        f"tuned population cold {cold_s:.2f}s warm {pop_s:.3f}s "
+        f"({'n/a' if speedup is None else f'{speedup:.2f}x'}); quality "
+        f"delta {quality_delta:+.4f}, moves {pop_res.num_moves} vs "
+        f"{seq_res.num_moves}, winner {pop_stats.get('winner')} "
+        f"(front {pop_stats.get('paretoFrontSize')}), 0 warm recompiles")
+    if gate and (speedup is None or speedup < 1.0):
+        raise RuntimeError(
+            f"multiobj wall-clock gate: tuned population warm propose "
+            f"{pop_s:.3f}s did not beat the fixed-schedule sequential "
+            f"warm propose {seq_s:.3f}s (need >= 1x)")
+    if emit_row:
+        emit("multiobj_propose_wall_clock", round(pop_s, 3), "s",
+             round(speedup, 3) if speedup else None,
+             vs_greedy=round(speedup, 3) if speedup else None)
+        emit("proposal_quality_delta", round(quality_delta, 6),
+             "normalized-objective", None)
+    return {"seq_s": seq_s, "cold_s": cold_s, "pop_s": pop_s,
+            "speedup": speedup, "tune_s": tune_s,
+            "tuned_fields": fields, "bucket": bucket,
+            "trials": len(history),
+            "seq_quality": seq_q, "pop_quality": pop_q,
+            "quality_delta": quality_delta,
+            "seq_moves": seq_res.num_moves, "pop_moves": pop_res.num_moves,
+            "population": pop_stats, "recompiles": recompiles,
+            "devices": len(jax.devices())}
+
+
 def run_tracer_overhead_bench(num_brokers: int = 50,
                               num_partitions: int = 5_000, *,
                               goal_names: list | None = None,
@@ -1240,12 +1400,13 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6),
+                    choices=(1, 2, 3, 4, 5, 6, 7),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
                          "6 = fleet batched propose, 16 clusters x "
-                         "100x20K)")
+                         "100x20K, 7 = tuned multi-objective population "
+                         "search vs fixed-schedule sequential, 100x20K)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -1267,15 +1428,17 @@ def main():
     platform = ensure_live_backend()
     global _RESOLVED_PLATFORM
     _RESOLVED_PLATFORM = platform
-    if args.scenario == 6 and platform.startswith("cpu"):
-        # The fleet dispatch shards the CLUSTER axis over devices; on a
-        # CPU host that concurrency needs forced virtual devices, set
-        # BEFORE jax initializes (real accelerators use their own).
+    if args.scenario in (6, 7) and platform.startswith("cpu"):
+        # Scenario 6 shards the CLUSTER axis, scenario 7 the POPULATION
+        # axis over devices; on a CPU host that concurrency needs forced
+        # virtual devices, set BEFORE jax initializes (real accelerators
+        # use their own).
         import os
         flags = os.environ.get("XLA_FLAGS", "")
+        count = 16 if args.scenario == 6 else 8
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=16"
+                flags + f" --xla_force_host_platform_device_count={count}"
             ).strip()
     import jax
     if args.scenario != 2:
@@ -1296,6 +1459,11 @@ def main():
                 log("--mesh is ignored for scenario 6: the fleet "
                     "dispatch owns the device axis (cluster sharding)")
             run_fleet_propose_bench()
+        elif args.scenario == 7:
+            if args.mesh:
+                log("--mesh is ignored for scenario 7: the population "
+                    "dispatch owns the device axis (member replication)")
+            run_multiobj_propose_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

@@ -6,7 +6,8 @@ scoring on device; see :mod:`engine` for the search loop and :mod:`goals`
 for the goal catalog.
 """
 
-from .constraint import BalancingConstraint, SearchConfig
+from .constraint import (BalancingConstraint, PopulationConfig,
+                         SearchConfig)
 from .goals import (GOAL_REGISTRY, CapacityGoal, GoalKernel,
                     LeaderBytesInDistributionGoal,
                     LeaderReplicaDistributionGoal,
@@ -19,9 +20,13 @@ from .optimizer import (GoalResult, OptimizationFailureError,
 from .options import (DefaultOptimizationOptionsGenerator,
                       OptimizationOptions,
                       OptimizationOptionsGenerator)
+from .tuning import (SuccessiveHalvingTuner, TunedConfigStore, autotune,
+                     plan_quality, shape_bucket)
 
 __all__ = [
-    "BalancingConstraint", "SearchConfig", "GoalKernel", "CapacityGoal",
+    "BalancingConstraint", "PopulationConfig", "SearchConfig",
+    "SuccessiveHalvingTuner", "TunedConfigStore", "autotune",
+    "plan_quality", "shape_bucket", "GoalKernel", "CapacityGoal",
     "RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal",
     "ResourceDistributionGoal", "LeaderReplicaDistributionGoal",
     "LeaderBytesInDistributionGoal", "PotentialNwOutGoal",

@@ -153,3 +153,48 @@ class SearchConfig:
         return replace(self, num_replica_candidates=k, num_dest_candidates=d,
                        num_swap_candidates=s, apply_per_iter=m,
                        drain_batch=db)
+
+
+@dataclass(frozen=True)
+class PopulationConfig:
+    """Multi-objective population search over K candidate plans
+    (``search.population.*`` server config; parallel/population.py).
+
+    A population of K plans evolves in one jitted program: every member
+    runs the goal-chain walk under its own PRNG stream, between polish
+    generations the population is scored JOINTLY over all goals (the
+    violation stack, scale-normalized) and survivors reseed the losers,
+    and the served plan is the multi-objective winner. Member 0 is the
+    *anchor*: it always runs the exact sequential schedule (same key
+    stream, never adopts another member's state), so K=1 degenerates to
+    the sequential chain walk bit-for-bit and the winner can never score
+    worse than the sequential plan under the configured objective.
+    Frozen: the whole config is part of the compiled program's identity.
+    """
+
+    #: population size K; 0 = population search off. Sizes round up to
+    #: the next power of two (the K-bucket — nearby sizes share one
+    #: compiled program; the extra slots run as additional explorers).
+    size: int = 0
+    #: joint objective across goals: "weighted" = scale-normalized
+    #: weighted sum (hard goals weighted by hard_weight), "pareto" =
+    #: non-dominated (dominance-count) rank, weighted sum as tie-break.
+    objective: str = "weighted"
+    #: weight multiplier on hard goals' normalized violations in the
+    #: weighted objective — large enough that any hard residual dominates
+    #: every soft trade-off.
+    hard_weight: float = 1000.0
+    #: per-move penalty added to the weighted objective (0 = plans are
+    #: judged on violations alone); biases selection toward plans that
+    #: reach the same stacks with fewer executor actions.
+    move_weight: float = 0.0
+    #: fraction of the population that survives each generation (the
+    #: truncation-selection cut). Effective count is clamped to
+    #: [1, K-1]: slot 0 is force-anchored to the sequential lineage, so
+    #: only K-1 slots are free for survivors
+    #: (parallel/population.n_survivors).
+    survivor_fraction: float = 0.5
+
+    @property
+    def enabled(self) -> bool:
+        return self.size >= 1

@@ -46,6 +46,56 @@ def violation_stack(goals: Sequence[GoalKernel], state, ctx) -> jax.Array:
     return jnp.stack([g.violation(state, ctx) for g in goals])
 
 
+# ---------------------------------------------------------------------------
+# Joint multi-objective scoring over violation stacks (the population
+# search's selection math — parallel/population.py evaluates these inside
+# the jitted program, the optimizer's final winner pick re-runs them on the
+# fetched host copies; jnp works on both).
+# ---------------------------------------------------------------------------
+
+def normalized_stacks(stacks, scales):
+    """Scale-normalize violation stacks for cross-goal comparison:
+    ``stacks[..., g] / max(scale_g, 1)`` with satisfied goals clamped to
+    exactly 0 (the ulp-aware ``GoalResult.satisfied`` cutoff,
+    ``1e-6 + 1e-6 * scale``) so converged goals tie bit-exactly instead
+    of ranking on float dust. Goals measure violations in wildly
+    different units (load units vs replica counts); per-goal
+    ``violation_scale`` is the magnitude the float32 reductions run
+    over, making the normalized residuals dimensionless and summable."""
+    stacks = jnp.asarray(stacks, jnp.float32)
+    scales = jnp.asarray(scales, jnp.float32)
+    tol = 1e-6 + 1e-6 * scales
+    norm = stacks / jnp.maximum(scales, 1.0)
+    return jnp.where(stacks <= tol, 0.0, norm)
+
+
+def weighted_objective(stacks, scales, hard_mask, *, hard_weight: float,
+                       move_weight: float = 0.0, moves=None):
+    """f32[K] scalarized joint objective per plan: scale-normalized
+    violations summed with hard goals up-weighted by ``hard_weight``
+    (large enough that any hard residual dominates every soft trade-off),
+    plus an optional per-move penalty. Lower is better."""
+    norm = normalized_stacks(stacks, scales)
+    w = jnp.where(jnp.asarray(hard_mask, bool), hard_weight, 1.0)
+    obj = (norm * w).sum(axis=-1)
+    if move_weight and moves is not None:
+        obj = obj + move_weight * jnp.asarray(moves, jnp.float32)
+    return obj
+
+
+def pareto_ranks(stacks, scales):
+    """i32[K] dominance-count Pareto rank per plan over the normalized
+    violation stacks: ``rank[j]`` = number of plans that dominate plan j
+    (all goals <=, at least one strictly <). Rank 0 is the Pareto front;
+    its size is the population-diversity telemetry the optimizer
+    surfaces."""
+    n = normalized_stacks(stacks, scales)
+    le = (n[:, None, :] <= n[None, :, :]).all(axis=-1)
+    lt = (n[:, None, :] < n[None, :, :]).any(axis=-1)
+    dominates = le & lt                     # [K, K]: i dominates j
+    return dominates.sum(axis=0, dtype=jnp.int32)
+
+
 def _chain_accepts(prev_goals: Sequence[GoalKernel], state, ctx, cands):
     ok = jnp.ones(cands.p.shape, bool)
     for g in prev_goals:

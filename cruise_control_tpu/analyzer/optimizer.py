@@ -23,7 +23,7 @@ import numpy as np
 from ..model.flat import FlatClusterModel
 from ..model.proposals import ExecutionProposal, diff_proposals, proposal_summary
 from ..model.spec import ClusterMetadata
-from .constraint import BalancingConstraint, SearchConfig
+from .constraint import BalancingConstraint, PopulationConfig, SearchConfig
 from .engine import CompiledGoalChain
 from .goals import GoalKernel, default_goals
 from .options import OptimizationOptions
@@ -199,6 +199,24 @@ _SHARED_CHAINS: dict = {}
 _SHARED_CHAINS_MAX = 64
 _SHARED_CHAINS_LOCK = threading.Lock()
 
+#: Process-wide compiled population-search programs, for the same reason
+#: as ``_SHARED_CHAINS``: the facade's memoized goal-scoped optimizers
+#: and per-stack test fixtures build fresh TpuGoalOptimizer instances for
+#: identical (config, goal binding, K-bucket) tuples, and the population
+#: program (the full chain x (1 + polish rounds), traced once) is the
+#: most expensive single program in the repo. Bounded via the shared
+#: ProgramCache machinery (lock-across-build get-or-create, FIFO).
+def _population_programs():
+    global _POPULATION_PROGRAMS
+    with _SHARED_CHAINS_LOCK:
+        if _POPULATION_PROGRAMS is None:
+            from ..parallel.batching import ProgramCache
+            _POPULATION_PROGRAMS = ProgramCache(16)
+        return _POPULATION_PROGRAMS
+
+
+_POPULATION_PROGRAMS = None
+
 
 def _shared_chain_key(cfg: SearchConfig, goals, mesh_key):
     # name AND class: one class serves several catalog entries (the four
@@ -224,6 +242,8 @@ class TpuGoalOptimizer:
                  registry=None,
                  mesh=None,
                  branches: int = 0,
+                 population: "PopulationConfig | int | None" = None,
+                 tuned_store=None,
                  hard_goal_names: list[str] | None = None,
                  tracer=None, collector=None):
         from ..core.runtime_obs import default_collector
@@ -232,6 +252,52 @@ class TpuGoalOptimizer:
         self.constraint = constraint or BalancingConstraint()
         self.goals = goals if goals is not None else default_goals(self.constraint)
         self.config = config or SearchConfig()
+        #: per-shape-bucket tuned SearchConfig overrides
+        #: (analyzer/tuning.py TunedConfigStore, ``search.tuning.*``
+        #: server config): applied in _prepare BEFORE scaled_for, so a
+        #: warm process serves tuned schedules with zero recompiles
+        #: within a bucket (one tuned config per bucket = one chain key).
+        self.tuned_store = tuned_store
+        #: multi-objective population search over K candidate plans
+        #: (``search.population`` server config; parallel/population.py):
+        #: every member runs the full chain under its own PRNG stream in
+        #: ONE jitted program, generations are joint weighted/Pareto
+        #: scoring + truncation selection, and member 0 anchors the
+        #: sequential schedule (K=1 is bit-identical to the sequential
+        #: walk). size 0 = off. Mutually exclusive with branches/mesh —
+        #: both own the device axis.
+        if population is None:
+            population = PopulationConfig()
+        elif isinstance(population, int):
+            population = PopulationConfig(size=population)
+        self.population = population
+        if self.population.enabled:
+            if self.population.objective not in ("weighted", "pareto"):
+                raise ValueError(
+                    f"unknown population objective "
+                    f"{self.population.objective!r}: expected 'weighted' "
+                    "or 'pareto'")
+            if branches and int(branches) > 1:
+                raise ValueError(
+                    "search.population and search.branches are mutually "
+                    "exclusive: both replicate the model per device "
+                    "(the population IS the generalized branch pool)")
+            if mesh is not None:
+                raise ValueError(
+                    "search.population and search.mesh.devices are "
+                    "mutually exclusive: the population replicates the "
+                    "model per member, the mesh shards it")
+            if self.config.fused_chain:
+                raise ValueError(
+                    "search.population and search.fused.chain are "
+                    "mutually exclusive: the population program IS one "
+                    "fused dispatch already, and its polish rounds use "
+                    "the per-goal key schedule — running it against the "
+                    "fused sequential path would break the K=1 "
+                    "bit-parity anchor guarantee (docs/search.md)")
+        #: /devicestats `population` section — last run's joint-scoring
+        #: snapshot (None until a population optimize ran).
+        self.last_population_stats: dict | None = None
         #: the REGISTERED hard-goal set for the post-optimization audit
         #: (ref the ``hard.goals`` server config consumed by
         #: sanityCheckHardGoalPresence and GoalViolationDetector): None =
@@ -278,6 +344,28 @@ class TpuGoalOptimizer:
         # ref GoalOptimizer.java:128 proposal-computation-timer.
         self._proposal_timer = self.registry.timer(MetricRegistry.name(
             GOAL_OPTIMIZER_SENSOR, "proposal-computation-timer"))
+        if self.population.enabled:
+            # Population-search telemetry families (all fed from the
+            # end-of-chain fetch — no extra device reads): last Pareto-
+            # front size and winner slot, plans-evaluated meter. Gauges
+            # register ONCE per registry: goal-scoped optimizers (the
+            # facade's memoized builders) share the server optimizer's
+            # registry, and re-registering would rebind the lambdas to
+            # the newest instance — /metrics would then report a
+            # goal-scoped optimizer's stale snapshot instead of the
+            # serving loop's. First constructed (the server optimizer)
+            # wins; meters accumulate across instances by design.
+            name = MetricRegistry.name
+            for metric, key in (("population-pareto-front-size",
+                                 "paretoFrontSize"),
+                                ("population-winner-index", "winner")):
+                full = name(GOAL_OPTIMIZER_SENSOR, metric)
+                if self.registry.get(full) is None:
+                    self.registry.gauge(
+                        full, lambda _k=key: (
+                            self.last_population_stats or {}).get(_k, 0))
+            self._population_meter = self.registry.meter(
+                name(GOAL_OPTIMIZER_SENSOR, "population-plans-evaluated"))
 
     def _chain_for(self, cfg: SearchConfig, goals: list[GoalKernel]
                    ) -> CompiledGoalChain:
@@ -322,8 +410,15 @@ class TpuGoalOptimizer:
             model = shard_model(model, self.mesh)
         P = model.num_partitions_padded
         B = model.num_brokers_padded
-        cfg = self.config.scaled_for(metadata.num_partitions,
-                                     metadata.num_brokers)
+        # Tuned schedule lookup BEFORE the tiny-model clamp: one tuned
+        # config per shape bucket means one scaled cfg — hence one chain
+        # key and ZERO recompiles — for every model in the bucket.
+        base_cfg = self.config
+        if self.tuned_store is not None:
+            base_cfg = self.tuned_store.apply(
+                base_cfg, metadata.num_partitions, metadata.num_brokers)
+        cfg = base_cfg.scaled_for(metadata.num_partitions,
+                                  metadata.num_brokers)
         if options.fast_mode:
             cfg = replace(
                 cfg,
@@ -430,6 +525,15 @@ class TpuGoalOptimizer:
                 # at all. (aot_compile: the compile lands on /devicestats
                 # and as a compile.hard-goal-audit span.)
                 self._audit_fn_for(audit).aot_compile((state, ctx))
+            if self.population.enabled:
+                # The population path serves its one fused program (the
+                # per-goal passes never dispatch standalone) — warm that,
+                # through the persistent cache like the branched path.
+                from ..utils.platform import enable_compilation_cache
+                enable_compilation_cache()
+                run, _, _, _ = self._population_run_for(cfg, goals, chain)
+                run.aot_compile((state, ctx, key))
+                return
             if self.branches > 1:
                 # The branched path never runs the per-goal passes — warm
                 # the shard_map program it actually serves instead. AOT
@@ -457,7 +561,34 @@ class TpuGoalOptimizer:
                 bkey, make_branched_search(
                     goals, cfg, make_branch_mesh(self.branches),
                     collector=self.collector))
+            # FIFO-bounded like _SHARED_CHAINS: bind signatures carry
+            # per-topic masks, so a long-lived fleet process with
+            # churning shape buckets / topic sets would otherwise
+            # accumulate compiled shard_map programs forever. An evicted
+            # program still in flight keeps working through its holder's
+            # reference; the next requester just rebuilds it.
+            while len(self._branched_runs) > _SHARED_CHAINS_MAX:
+                self._branched_runs.pop(next(iter(self._branched_runs)))
         return run
+
+    def _population_run_for(self, cfg: SearchConfig, goals, chain):
+        """Get-or-build the population-search program for this (cfg, goal
+        binding, K-bucket) — keyed like the shared-chain registry plus
+        the population config, cached PROCESS-WIDE so fresh optimizer
+        instances for the same chain reuse one compiled program. Returns
+        ``(run, D devices, members per device, K bucket)``."""
+        from ..parallel.population import (make_population_mesh,
+                                           make_population_search,
+                                           population_layout)
+        D, k, K = population_layout(self.population.size)
+        key = ("population",
+               _shared_chain_key(cfg, goals, None),
+               self.population, D, k)
+        run = _population_programs().get_or_build(
+            key, lambda: make_population_search(
+                chain._pass_fns, goals, cfg, self.population,
+                make_population_mesh(D), k, collector=self.collector))
+        return run, D, k, K
 
     def optimize(self, model: FlatClusterModel, metadata: ClusterMetadata,
                  options: OptimizationOptions | None = None,
@@ -502,6 +633,11 @@ class TpuGoalOptimizer:
         # makes later processes skip XLA entirely). No-op once warmed.
         # (The branched path compiles its own shard_map program instead —
         # it never runs the per-goal passes.)
+        if self.population.enabled:
+            return self._optimize_population(model, metadata, options,
+                                             cfg, goals, chain, ctx,
+                                             state, key, t0, on_goal_start,
+                                             audit, audit_fn, audit_before)
         if self.branches > 1:
             return self._optimize_branched(model, metadata, options, cfg,
                                            goals, chain, ctx, state, key,
@@ -715,6 +851,137 @@ class TpuGoalOptimizer:
                             t0, ctx, audit, audit_fn, audit_before,
                             trajectory=trajectory)
 
+    def _optimize_population(self, model, metadata, options, cfg, goals,
+                             chain, ctx, state, key, t0, on_goal_start,
+                             audit=(), audit_fn=None, audit_before=None):
+        """Multi-objective population search (parallel/population.py): K
+        candidate plans evolve in ONE jitted program — every member runs
+        the chain walk under its own PRNG stream, polish generations are
+        joint weighted/Pareto scoring + truncation selection, and the
+        served plan is the multi-objective winner with hard-goal audit
+        verdicts dominating. Member 0 anchors the exact sequential
+        schedule, so K=1 is bit-identical to the sequential walk and the
+        winner never scores worse than the sequential plan under the
+        configured objective. ALL telemetry (per-member per-goal
+        acceptance, Pareto front size, survivor history) rides the one
+        end-of-chain fetch — zero extra device syncs (tier-1 gated)."""
+        from ..parallel.population import select_plan
+        run, D, k, K = self._population_run_for(cfg, goals, chain)
+        if on_goal_start is not None:
+            # One program = one truthful progress step (fused convention).
+            on_goal_start(f"PopulationSearch[{len(goals)}x{K}]")
+        with self.tracer.span("optimizer.walk", mode="population",
+                              population=K, devices=D,
+                              goals=len(goals)) as walk_span:
+            t_walk = time.monotonic()
+            (states, aux, iters, walk_bounds, polish_rows, moves,
+             accepted, perms, ranks, weighted) = run(state, ctx, key)
+            fetched = jax.device_get((aux, iters, walk_bounds,
+                                      polish_rows, moves, accepted,
+                                      perms, ranks, weighted))
+            self.collector.record_d2h(self.collector.tree_bytes(fetched))
+            ((has_broken_raw, scales_arr, v0), iters_np, wb_np, pr_np,
+             mv_np, acc_np, perm_np, rank_np, w_np) = fetched
+            v0 = np.asarray(v0)
+            wb_np = np.asarray(wb_np)
+            pr_np = np.asarray(pr_np)
+            boundary_np = pr_np[-1] if len(pr_np) else wb_np[:, -1, :]
+            state, best, _vbest = select_plan(
+                states, boundary_np, mv_np, rank_np, w_np,
+                self.population,
+                audit_eval=(None if audit_fn is None
+                            else lambda s: audit_fn(s, ctx)))
+            walk_span.set(winner=int(best))
+        walk_s = time.monotonic() - t_walk
+
+        has_broken = bool(has_broken_raw)
+        logger = logging.getLogger(__name__)
+        # Per-lineage self-check over the walk boundaries (the sequential
+        # "never worsen your own violation" assertion, ref
+        # AbstractGoal.java:110-119) — every surviving lineage is
+        # checked, with the broken-broker drain exemption.
+        for m in range(K):
+            boundary = v0
+            for i, g in enumerate(goals):
+                before_i = float(boundary[i])
+                boundary = wb_np[m, i]
+                after_i = float(boundary[i])
+                if after_i > before_i * (1 + 1e-6) + 1e-6:
+                    if has_broken:
+                        logger.warning(
+                            "population[%d]: goal %s worsened its own "
+                            "violation %.6g -> %.6g while draining broken "
+                            "brokers (self-check exempt)", m, g.name,
+                            before_i, after_i)
+                    else:
+                        raise RuntimeError(
+                            f"optimization self-check failed: population "
+                            f"member {m}, goal {g.name} worsened its own "
+                            f"violation {before_i:.6g} -> {after_i:.6g}")
+
+        # Winner bookkeeping — identical structure to the sequential
+        # loop's, read off the winner slot's lineage rows.
+        scales = [float(s) for s in np.asarray(scales_arr)]
+        total_iters = max(int(iters_np[best].sum()), 1)
+        goal_results: list[GoalResult] = []
+        for i, goal in enumerate(goals):
+            before_i = float((v0 if i == 0 else wb_np[best, i - 1])[i])
+            goal_results.append(GoalResult(
+                name=goal.name, hard=goal.hard,
+                violation_before=before_i,
+                violation_after=float(boundary_np[best][i]),
+                # One program: per-goal wall-clock is unobservable —
+                # attribute proportionally to iteration counts (fused
+                # convention).
+                duration_s=walk_s * int(iters_np[best, i]) / total_iters,
+                iterations=int(iters_np[best, i]),
+                scale=scales[i],
+                accepted=int(acc_np[best, i])))
+
+        # Winner trajectory, sequential convention: row 0 = initial
+        # stack, rows 1..G = walk boundaries, one row per polish round
+        # that actually ran (a round starting fully converged is the
+        # host loop's `break` — its unchanged row is dropped).
+        polish_eps = min(cfg.epsilon, 1e-6)
+        trajectory = [[float(x) for x in v0]]
+        trajectory += [[float(x) for x in wb_np[best, i]]
+                       for i in range(len(goals))]
+        prev_row = wb_np[best, -1]
+        for r in range(len(pr_np)):
+            if (prev_row <= polish_eps).all():
+                break
+            prev_row = pr_np[r, best]
+            trajectory.append([float(x) for x in prev_row])
+
+        # Front size straight off the program's fetched ranks — NO
+        # recomputation (an eager pareto_ranks here would be a fresh
+        # device dispatch on the serving path, invisible to the
+        # zero-syncs gate's device_get patching).
+        front = int((np.asarray(rank_np) == 0).sum())
+        pop_stats = {
+            "size": K,
+            "requested": self.population.size,
+            "devices": D,
+            "objective": self.population.objective,
+            "winner": int(best),
+            "winnerIsAnchor": bool(best == 0),
+            "paretoFrontSize": front,
+            "paretoRanks": [int(x) for x in np.asarray(rank_np)],
+            "weightedScores": [round(float(x), 6)
+                               for x in np.asarray(w_np)],
+            "movesPerMember": [int(x) for x in np.asarray(mv_np)],
+            # i32[K][G]: candidate acceptance per member per goal — the
+            # population-wide acceptance telemetry.
+            "perGoalAcceptance": np.asarray(acc_np).tolist(),
+            "survivorPerms": np.asarray(perm_np).tolist(),
+        }
+        self.last_population_stats = pop_stats
+        self._population_meter.mark(K)
+        return self._finish(model, metadata, options, state, goal_results,
+                            t0, ctx, audit, audit_fn, audit_before,
+                            trajectory=trajectory,
+                            extra_telemetry={"population": pop_stats})
+
     def _optimize_branched(self, model, metadata, options, cfg, goals,
                            chain, ctx, state, key, t0, on_goal_start,
                            audit=(), audit_fn=None, audit_before=None):
@@ -780,7 +1047,7 @@ class TpuGoalOptimizer:
 
     def _finish(self, model, metadata, options, state, goal_results, t0,
                 ctx=None, audit=(), audit_fn=None, audit_before=None,
-                trajectory=None):
+                trajectory=None, extra_telemetry=None):
         with self.tracer.span("optimizer.finish") as fin:
             audit_results: list[GoalResult] = []
             if audit_fn is not None:
@@ -804,14 +1071,21 @@ class TpuGoalOptimizer:
         duration_s = time.monotonic() - t0
         # ref GoalOptimizer.java:183 _proposalComputationTimer.update.
         self._proposal_timer.update(duration_s)
+        telemetry = self._record_goal_telemetry(goal_results, trajectory,
+                                                num_moves)
+        if extra_telemetry and telemetry is not None:
+            # Path-specific sections (the population search's joint-
+            # scoring snapshot) merge into the observable payload — all
+            # values came off the device with the same end-of-chain
+            # fetch.
+            telemetry.update(extra_telemetry)
         result = OptimizerResult(
             proposals=proposals, goal_results=goal_results,
             num_moves=num_moves,
             duration_s=duration_s, final_model=final,
             provision_response=self._provision_verdict(final, goal_results),
             hard_goal_audit=audit_results,
-            telemetry=self._record_goal_telemetry(goal_results, trajectory,
-                                                  num_moves))
+            telemetry=telemetry)
         if result.violated_hard_goals and not options.skip_hard_goal_check:
             in_chain = {g.name for g in goal_results
                         if g.hard and not g.satisfied}

@@ -338,6 +338,8 @@ class KafkaCruiseControl:
                     registry=self.optimizer.registry,
                     mesh=self.optimizer.mesh,
                     branches=self.optimizer.branches,
+                    population=self.optimizer.population,
+                    tuned_store=self.optimizer.tuned_store,
                     hard_goal_names=self.optimizer.hard_goal_names)
             self._goal_optimizers[key] = opt   # re-insert = most recent
             while len(self._goal_optimizers) > self.MAX_GOAL_OPTIMIZERS:
@@ -836,6 +838,15 @@ class KafkaCruiseControl:
             self._now_ms())
         payload["fleet"] = (self.fleet.stats_json()
                             if self.fleet is not None else None)
+        # Population-search snapshot (last run's joint-scoring readout —
+        # Pareto front size, per-goal acceptance across the population)
+        # and the tuned-schedule store's per-bucket fields + trial
+        # history. None when the respective mode is off — dashboards
+        # poll unconditionally.
+        payload["population"] = getattr(self.optimizer,
+                                        "last_population_stats", None)
+        store = getattr(self.optimizer, "tuned_store", None)
+        payload["tuning"] = store.to_json() if store is not None else None
         return payload
 
     # -------------------------------------------------------- fleet ops

@@ -383,6 +383,43 @@ _SCHEMAS = {
                                        "nullable": True},
                     "lastTickMs": {"type": "integer", "nullable": True},
                 }},
+            "population": {
+                "type": "object", "nullable": True,
+                "description": "multi-objective population search "
+                               "(parallel/population.py; docs/search.md)"
+                               ": last run's joint-scoring snapshot — "
+                               "null when search.population=0",
+                "properties": {
+                    "size": {"type": "integer"},
+                    "requested": {"type": "integer"},
+                    "devices": {"type": "integer"},
+                    "objective": {"type": "string"},
+                    "winner": {"type": "integer"},
+                    "winnerIsAnchor": {"type": "boolean"},
+                    "paretoFrontSize": {"type": "integer"},
+                    "paretoRanks": {"type": "array",
+                                    "items": {"type": "integer"}},
+                    "weightedScores": {"type": "array",
+                                       "items": {"type": "number"}},
+                    "movesPerMember": {"type": "array",
+                                       "items": {"type": "integer"}},
+                    "perGoalAcceptance": {"type": "array",
+                                          "items": {"type": "array"}},
+                    "survivorPerms": {"type": "array",
+                                      "items": {"type": "array"}},
+                }},
+            "tuning": {
+                "type": "object", "nullable": True,
+                "description": "tuned-search-schedule store "
+                               "(analyzer/tuning.py; docs/search.md): "
+                               "per-shape-bucket SearchConfig overrides "
+                               "+ tuner trial history — null when "
+                               "search.tuning.enabled=false",
+                "properties": {
+                    "version": {"type": "integer"},
+                    "path": {"type": "string"},
+                    "buckets": {"type": "object"},
+                }},
         }},
     "FleetSummary": {
         "type": "object",

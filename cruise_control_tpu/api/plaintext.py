@@ -220,6 +220,22 @@ def _render_devicestats(payload: dict) -> str:
                  f"{bucket.get('brokersPadded', '-')}x"
                  f"{bucket.get('partitionsPadded', '-')}, last dispatch "
                  f"{fleet.get('lastDispatchMs')} ms")
+    pop = payload.get("population")
+    if pop:
+        text += (f"\npopulation: K={pop.get('size')} "
+                 f"[{pop.get('objective')}], winner "
+                 f"{pop.get('winner')}"
+                 f"{' (anchor)' if pop.get('winnerIsAnchor') else ''}, "
+                 f"pareto front {pop.get('paretoFrontSize')}, moves "
+                 f"{pop.get('movesPerMember')}")
+    tuning = payload.get("tuning")
+    if tuning and tuning.get("buckets"):
+        rows = [[bkt, json.dumps(entry.get("fields", {}),
+                                 sort_keys=True),
+                 len(entry.get("history", []))]
+                for bkt, entry in sorted(tuning["buckets"].items())]
+        text += "\ntuned search configs:\n" + _table(
+            ["BUCKET", "FIELDS", "TRIALS"], rows)
     return text
 
 

@@ -323,6 +323,63 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "compute — small models served over a tunneled device; "
                  "per-goal wall-clock is then attributed by iteration "
                  "share instead of measured.")
+    d.define("search.population", ConfigType.INT, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Multi-objective population search over K candidate "
+                 "plans (parallel/population.py; docs/search.md): every "
+                 "member runs the goal chain under its own PRNG stream "
+                 "in ONE jitted program, polish generations score the "
+                 "whole population JOINTLY over all goals and reseed "
+                 "losers from survivors, and the served plan is the "
+                 "multi-objective winner. Member 0 anchors the exact "
+                 "sequential schedule (K=1 is bit-identical to the "
+                 "sequential walk). Sizes round up to the next power of "
+                 "two. 0 = off; mutually exclusive with search.branches, "
+                 "search.mesh.devices and fleet.enabled — each owns the "
+                 "device axis.")
+    d.define("search.population.objective", ConfigType.STRING, "weighted",
+             importance=Importance.LOW,
+             doc="Joint objective for population selection: 'weighted' = "
+                 "scale-normalized weighted sum over the violation stack "
+                 "(hard goals up-weighted by "
+                 "search.population.hard.weight), 'pareto' = dominance-"
+                 "count Pareto rank with the weighted sum as tie-break "
+                 "(docs/search.md).")
+    d.define("search.population.hard.weight", ConfigType.DOUBLE, 1000.0,
+             validator=Range.at_least(1.0), importance=Importance.LOW,
+             doc="Hard-goal weight multiplier in the population search's "
+                 "weighted joint objective — large enough that any hard "
+                 "residual dominates every soft trade-off.")
+    d.define("search.population.move.weight", ConfigType.DOUBLE, 0.0,
+             validator=Range.at_least(0.0), importance=Importance.LOW,
+             doc="Per-move penalty in the population search's weighted "
+                 "objective (0 = judge plans on violations alone): biases "
+                 "selection toward plans reaching the same stacks with "
+                 "fewer executor actions.")
+    d.define("search.tuning.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Load per-shape-bucket tuned SearchConfig overrides "
+                 "(analyzer/tuning.py TunedConfigStore) at optimizer "
+                 "construction: warm serving picks up tuned schedules "
+                 "with zero recompiles within a bucket. Tuning itself "
+                 "runs offline via bench scenarios (bench.py --scenario "
+                 "7); this key only wires the persisted store into the "
+                 "serving path (docs/search.md).")
+    d.define("search.tuning.store.path", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Path of the persisted tuned-config JSON (empty = the "
+                 "default .jax_cache/tuned/v<N>/search_configs.json, "
+                 "versioned like the XLA cache).")
+    d.define("search.tuning.trials", ConfigType.INT, 8,
+             validator=Range.at_least(2), importance=Importance.LOW,
+             doc="Candidate schedules sampled per tuning run (bench.py "
+                 "--scenario 7; the incumbent base schedule is always "
+                 "candidate 0 and never eliminated).")
+    d.define("search.tuning.rungs", ConfigType.INT, 2,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Successive-halving rungs per tuning run: each rung "
+                 "re-evaluates the surviving half with one more timed "
+                 "repeat.")
     d.define("goals", ConfigType.LIST, "", importance=Importance.HIGH,
              doc="Full supported goal list (reference key; default.goals "
                  "is the active chain — empty inherits the built-in order)")
@@ -919,15 +976,50 @@ class CruiseControlConfig(AbstractConfig):
                 f"model across devices. Got search.branches={branches}, "
                 f"search.mesh.devices={mesh} — unset one of them "
                 "(docs/scaling.md explains when each wins).")
+        population = self.get_int("search.population")
+        if population >= 1 and branches > 1:
+            raise ConfigException(
+                "search.population and search.branches are mutually "
+                "exclusive: the population IS the generalized branch "
+                "pool (every member runs the full chain under its own "
+                "PRNG stream, selection is multi-objective instead of "
+                f"lexicographic). Got search.population={population}, "
+                f"search.branches={branches} — unset search.branches "
+                "(docs/search.md).")
+        if population >= 1 and mesh != 0:
+            raise ConfigException(
+                "search.population and search.mesh.devices are mutually "
+                "exclusive: the population replicates the model per "
+                "member over the local devices, the mesh shards one "
+                f"model across them. Got search.population={population}, "
+                f"search.mesh.devices={mesh} — unset one of them "
+                "(docs/search.md vs docs/scaling.md for when each wins).")
+        if population >= 1 and self.get_boolean("search.fused.chain"):
+            raise ConfigException(
+                "search.population and search.fused.chain are mutually "
+                "exclusive: the population program is already one fused "
+                "dispatch, and its polish keys follow the per-goal "
+                "schedule — K=1 bit-parity anchors to the PER-GOAL "
+                f"sequential walk. Got search.population={population}, "
+                "search.fused.chain=true — unset one of them "
+                "(docs/search.md).")
+        objective = self.get_string("search.population.objective")
+        if objective not in ("weighted", "pareto"):
+            raise ConfigException(
+                f"search.population.objective must be 'weighted' or "
+                f"'pareto', got {objective!r} (docs/search.md).")
         if self.get_boolean("fleet.enabled") and (mesh != 0
-                                                  or branches > 1):
+                                                  or branches > 1
+                                                  or population >= 1):
             raise ConfigException(
                 "fleet.enabled is mutually exclusive with "
-                "search.mesh.devices and search.branches: the fleet "
-                "shards the CLUSTER axis over the local devices, so "
-                "neither the partition-axis mesh nor best-of-N branches "
-                f"can own them too. Got search.branches={branches}, "
-                f"search.mesh.devices={mesh} (docs/fleet.md).")
+                "search.mesh.devices, search.branches and "
+                "search.population: the fleet shards the CLUSTER axis "
+                "over the local devices, so neither the partition-axis "
+                "mesh, best-of-N branches nor the population axis can "
+                f"own them too. Got search.branches={branches}, "
+                f"search.mesh.devices={mesh}, "
+                f"search.population={population} (docs/fleet.md).")
         # Even sharding: every padded partition count is a multiple of
         # the pad multiple, so the multiple itself must divide by the
         # mesh device count. mesh == -1 (all devices) re-checks at
@@ -1014,6 +1106,16 @@ class CruiseControlConfig(AbstractConfig):
             num_swap_candidates=self.get_int("search.num.swap.candidates"),
             max_iters_per_goal=self.get_int("search.max.iters.per.goal"),
             fused_chain=self.get_boolean("search.fused.chain"))
+
+    def population_config(self):
+        """``search.population.*`` view (analyzer.PopulationConfig);
+        size 0 = population search off."""
+        from ..analyzer.constraint import PopulationConfig
+        return PopulationConfig(
+            size=self.get_int("search.population"),
+            objective=self.get_string("search.population.objective"),
+            hard_weight=self.get_double("search.population.hard.weight"),
+            move_weight=self.get_double("search.population.move.weight"))
 
     def executor_config(self) -> ExecutorConfig:
         throttle = self.get_int("default.replication.throttle")

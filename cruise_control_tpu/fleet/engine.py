@@ -102,6 +102,12 @@ class FleetOptimizer:
                 "fleet batching and search.mesh.devices are mutually "
                 "exclusive: the fleet shards the cluster axis, the mesh "
                 "the partition axis")
+        population = getattr(optimizer, "population", None)
+        if population is not None and population.enabled:
+            raise ValueError(
+                "fleet batching and search.population are mutually "
+                "exclusive: the fleet shards the cluster axis over the "
+                "local devices, the population replicates per member")
         self.optimizer = optimizer
         self.max_devices = max_devices
         self.scenario_pad_multiple = scenario_pad_multiple
@@ -204,7 +210,18 @@ class FleetOptimizer:
         opts = options
         if opt.options_generator is not None:
             opts = opt.options_generator.generate(opts, md)
-        cfg = opt.config.scaled_for(md.num_partitions, md.num_brokers)
+        # Tuned schedules (analyzer/tuning.py), the sequential
+        # _prepare's rule: per-shape-bucket overrides fold in BEFORE the
+        # tiny-model clamp. The resulting cfg is part of group_key below,
+        # so members in differently-tuned buckets split into separate
+        # dispatch GROUPS (each group one traced program under its own
+        # schedule) instead of silently running member 0's schedule —
+        # the same degrade path heterogeneous goal bindings take.
+        base_cfg = opt.config
+        if opt.tuned_store is not None:
+            base_cfg = opt.tuned_store.apply(
+                base_cfg, md.num_partitions, md.num_brokers)
+        cfg = base_cfg.scaled_for(md.num_partitions, md.num_brokers)
         if opts.fast_mode:
             cfg = replace(
                 cfg,

@@ -9,9 +9,12 @@ are scatter-adds from sharded [P, R] arrays into replicated [B1, ...] rows
 """
 
 from ._compat import shard_map
-from .batching import ProgramCache, pad_model_to, round_up
+from .batching import ProgramCache, pad_model_to, pow2_bucket, round_up
 from .branches import (BRANCH_AXIS, make_branch_mesh, make_branched_search,
                        select_best)
+from .population import (POPULATION_AXIS, make_population_mesh,
+                         make_population_search, population_layout,
+                         select_plan)
 from .sharding import (PARTITION_AXIS, host_array_shardings, make_mesh,
                        mesh_fingerprint, model_shardings,
                        resolve_mesh_devices, scenario_batch_shardings,
@@ -22,4 +25,6 @@ __all__ = ["PARTITION_AXIS", "make_mesh", "mesh_fingerprint",
            "shard_map", "sharded_state_shardings", "host_array_shardings",
            "scenario_batch_shardings", "BRANCH_AXIS", "make_branch_mesh",
            "make_branched_search", "select_best",
-           "ProgramCache", "pad_model_to", "round_up"]
+           "POPULATION_AXIS", "make_population_mesh",
+           "make_population_search", "population_layout", "select_plan",
+           "ProgramCache", "pad_model_to", "pow2_bucket", "round_up"]

@@ -28,6 +28,14 @@ def round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def pow2_bucket(n: int) -> int:
+    """Next power of two at or above ``n`` (minimum 1) — the bucket rule
+    shared by the population search's K axis and the tuner's shape
+    buckets: geometric buckets keep the compiled-program (and tuned-
+    config) count logarithmic in the sizes a long-lived process sees."""
+    return 1 << max(int(n) - 1, 0).bit_length()
+
+
 class ProgramCache:
     """Bounded, thread-safe compiled-program cache.
 

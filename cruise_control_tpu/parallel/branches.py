@@ -81,17 +81,37 @@ def make_branched_search(goals: Sequence[GoalKernel], cfg: SearchConfig,
         f"branched-search-x{mesh.devices.size}", jax.jit(run))
 
 
-def _checked_violations(violations) -> np.ndarray:
-    v = np.asarray(jax.device_get(violations))   # [n_branches, n_goals]
+def checked_violations(violations, what: str = "branched search"
+                       ) -> np.ndarray:
+    """Fetch a [N, G] violation matrix, failing loudly on NaN residuals.
+    A NaN means a broken goal kernel, and NaN compares False both ways so
+    any sort below could silently serve the broken plan — this is the
+    shared guard for every best-of-N selection (branches AND the
+    population search), matching the sequential walk's self-check."""
+    v = np.asarray(jax.device_get(violations))   # [N, n_goals]
     if np.isnan(v).any():
-        # A NaN residual means a broken goal kernel, and NaN compares
-        # False both ways so the lexicographic sort below could silently
-        # serve the broken branch — fail as loudly as the sequential
-        # walk's self-check does.
         bad = sorted(set(np.nonzero(np.isnan(v))[0].tolist()))
         raise RuntimeError(
-            f"branched search produced NaN violations on branches {bad}")
+            f"{what} produced NaN violations on members {bad}")
     return v
+
+
+_checked_violations = checked_violations
+
+
+def audit_violation_count(audit_eval, member_state) -> int:
+    """Number of audited hard goals a plan leaves violated — the ONE
+    definition of the audit verdict used for best-of-N selection (the
+    branched search and the population search both rank on it; the
+    ulp-aware cutoff is ``GoalResult.satisfied``'s rule, 1e-6 + 1e-6 *
+    scale). ``audit_eval(state) -> (f32[A] violations, f32[A] scales)``
+    is the optimizer's jitted audit program; evaluated host-side per
+    candidate plan — plan counts are device counts, so this is a
+    handful of tiny dispatches."""
+    av, scales = jax.device_get(audit_eval(member_state))
+    av = np.asarray(av, dtype=np.float64)
+    tol = 1e-6 + 1e-6 * np.asarray(scales, dtype=np.float64)
+    return int((av > tol).sum())
 
 
 def select_best(states, violations):
@@ -121,11 +141,7 @@ def select_best_audited(states, violations, audit_eval):
     keys = []
     for i in range(v.shape[0]):
         bstate = jax.tree.map(lambda x, _i=i: x[_i], states)
-        av, scales = jax.device_get(audit_eval(bstate))
-        av = np.asarray(av, dtype=np.float64)
-        tol = 1e-6 + 1e-6 * np.asarray(scales, dtype=np.float64)
-        # Same satisfied-rule as GoalResult: ulp-aware per-goal cutoff.
-        num_bad = int((av > tol).sum())
+        num_bad = audit_violation_count(audit_eval, bstate)
         keys.append((num_bad, tuple(v[i]), i))
     best = min(keys)[-1]
     state = jax.tree.map(lambda x: x[best], states)

@@ -1,0 +1,360 @@
+"""Multi-objective population search: K candidate plans evolve in ONE
+jitted program, scored jointly over every goal.
+
+The sequential optimizer walks the goal chain once; the branched search
+(:mod:`.branches`) runs N independent full chains and keeps the
+lexicographic best. This module is the next step (PAPERS.md:
+"Multi-Objective Optimization of Consumer Group Autoscaling", arxiv
+2402.06085): a *population* of K candidate plans where
+
+- every member runs the goal-chain walk — the UNMODIFIED pass functions
+  from the process-wide compiled chain (``CompiledGoalChain._pass_fns``,
+  the same functions the sequential path compiled), so each member's
+  moves come from exactly the engine's top-k / cross-product / conflict
+  machinery;
+- between polish generations the whole population is scored JOINTLY over
+  all goals — the violation stack, scale-normalized, reduced to a
+  weighted sum or a dominance-count Pareto rank
+  (``analyzer.engine.weighted_objective`` / ``pareto_ranks``) — and
+  truncation selection reseeds the losers from the survivors; an adopted
+  plan keeps evolving under its slot's own PRNG stream, so lineages
+  diverge again immediately;
+- the served plan is the multi-objective winner (host-side
+  :func:`select_plan`, hard-goal audit verdicts dominating like
+  ``branches.select_best_audited``).
+
+**Anchor guarantee**: member 0 always runs the exact sequential schedule
+— same key stream (``key`` itself, not a fold), never adopts another
+member's state (``perm[0] == 0``), per-goal polish skip decisions
+identical to the host loop's — so ``K=1`` degenerates to the sequential
+chain walk bit for bit (tier-1 gated), and because member 0 is always in
+the final selection pool, the winner can never score worse than the
+sequential plan under the configured objective.
+
+The population axis rides the same machinery as the branched search:
+``shard_map`` over a member mesh axis fans members across devices, an
+inner ``lax.map`` packs multiple members per device (real control flow —
+no vmap batching rewrite, the fleet lesson), and the compiled programs
+live in the shared :class:`.batching.ProgramCache`. K rounds up to the
+next power of two (:func:`.batching.pow2_bucket`) so nearby population
+sizes share one compiled program; the extra slots run as additional
+explorers.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..analyzer.constraint import PopulationConfig, SearchConfig
+from ..analyzer.engine import (pareto_ranks, violation_stack,
+                               weighted_objective)
+from ..analyzer.goals import GoalKernel
+from ._compat import shard_map
+from .batching import pow2_bucket
+from .branches import audit_violation_count, checked_violations
+
+POPULATION_AXIS = "member"
+
+#: PRNG stream salt for members > 0 (member 0 uses the request key
+#: verbatim — the anchor's stream must equal the sequential walk's).
+#: Distinct from the engine's internal fold_in salts (70_000 drain,
+#: 50_000 fused polish, 1000-series polish rounds).
+_MEMBER_KEY_SALT = 90_000
+
+
+def population_layout(size: int, device_cap: int | None = None
+                      ) -> tuple[int, int, int]:
+    """(devices D, members-per-device k, K bucket) for a K-member
+    population: K rounds up to the next power of two (the K-bucket —
+    nearby sizes reuse one compiled program), members fan out over up to
+    ``device_cap`` devices, the remainder packs via the inner
+    ``lax.map``. Powers of two keep the split even, so no padding slots
+    exist — every slot is a real explorer."""
+    cap = device_cap if device_cap is not None else len(jax.devices())
+    K = pow2_bucket(max(int(size), 1))
+    D = min(max(cap, 1), K)
+    while K % D:
+        D -= 1          # K is a power of two: lands on a power of two
+    return D, K // D, K
+
+
+def make_population_mesh(num_devices: int) -> Mesh:
+    """One mesh axis over the local devices, like ``make_branch_mesh``
+    but under the population's own axis name."""
+    devices = jax.devices()
+    if len(devices) < num_devices:
+        raise ValueError(f"need {num_devices} devices, "
+                         f"have {len(devices)}")
+    return Mesh(np.asarray(devices[:num_devices]), (POPULATION_AXIS,))
+
+
+def n_survivors(size: int, fraction: float) -> int:
+    """Survivor count for a K-member generation: ``ceil(K * fraction)``
+    clamped to ``[1, K-1]`` (for K > 1). The upper clamp matters: slot 0
+    is force-anchored to the sequential lineage AFTER the survivor
+    round-robin, so only K-1 slots are free — with K survivors the
+    top-ranked plan would hold ONLY slot 0 and be silently discarded by
+    the anchor override. Capping at K-1 guarantees every survivor
+    (including the rank winner at slot ``n_surv``) keeps at least one
+    slot."""
+    if size <= 1:
+        return 1
+    return max(1, min(math.ceil(size * fraction), size - 1))
+
+
+def _member_key(key: jax.Array, m: jax.Array) -> jax.Array:
+    # Member 0 is the anchor: ITS stream is the request key itself, so
+    # its walk/polish keys equal the sequential loop's fold_in series.
+    return jnp.where(m == 0, key,
+                     jax.random.fold_in(key, _MEMBER_KEY_SALT + m))
+
+
+def make_population_search(pass_fns: Sequence, goals: Sequence[GoalKernel],
+                           cfg: SearchConfig, pop_cfg: PopulationConfig,
+                           mesh: Mesh, k_per_dev: int, collector=None):
+    """Build ``run(state, ctx, key)`` — the whole population search as one
+    jitted program (single device dispatch + single host fetch per
+    optimize, like the fused chain).
+
+    ``pass_fns`` must be the compiled chain's raw pass functions
+    (``CompiledGoalChain._pass_fns`` — the process-wide shared-chain
+    registry stays the one source of pass identity, exactly as the fleet
+    walk consumes them).
+
+    Returns, for ``K = mesh.devices.size * k_per_dev`` members:
+
+    - ``states``: final SearchStates stacked on a leading [K] axis (left
+      on device; the winner is gathered after host-side selection),
+    - ``aux``: ``(offline.any(), f32[G] scales, f32[G] initial stack)``
+      — the sequential path's pre-pass readings, computed once,
+    - ``iters``: i32[K, G] per-member per-goal iteration totals,
+    - ``walk_bounds``: f32[K, G, G] — row i is slot m's plan's violation
+      stack after walk pass i (the sequential boundary bookkeeping;
+      histories follow adoptions, so a slot always carries its CURRENT
+      plan's lineage),
+    - ``polish_rows``: f32[R, K, G] round-end stacks (R polish rounds),
+    - ``moves``: i32[K] cumulative moves applied per member,
+    - ``accepted``: i32[K, G] per-member per-goal accepted-move counts,
+    - ``perms``: i32[R, K] the survivor permutation applied before each
+      polish generation (slot i's plan came from slot ``perms[r, i]``),
+    - ``ranks``: i32[K] final dominance-count Pareto ranks,
+    - ``weighted``: f32[K] final weighted-objective scores.
+
+    Everything the host needs rides this one program's outputs — the
+    population telemetry adds ZERO device syncs beyond the sequential
+    path's end-of-chain fetch (gated in tests/test_tracing.py).
+    """
+    goals = tuple(goals)
+    pass_fns = tuple(pass_fns)
+    G = len(goals)
+    D = int(mesh.devices.size)
+    K = D * int(k_per_dev)
+    R = cfg.polish_passes + 1 if cfg.polish_passes else 0
+    polish_eps = min(cfg.epsilon, 1e-6)
+    hard_mask = np.asarray([g.hard for g in goals], bool)
+    n_surv = n_survivors(K, pop_cfg.survivor_fraction)
+    use_pareto = pop_cfg.objective == "pareto"
+
+    def _member_walk(state, ctx, mkey):
+        """The sequential walk, one member: every pass in chain order,
+        keys fold_in(mkey, i) — identical to the host loop's
+        ``_walk_passes(chain, range(G), ...)`` schedule."""
+        iters, bounds, moves = [], [], []
+        for i, run_pass in enumerate(pass_fns):
+            state, it, stack, mv = run_pass(state, ctx,
+                                            jax.random.fold_in(mkey, i))
+            iters.append(it)
+            bounds.append(stack)
+            moves.append(mv)
+        return (state, jnp.stack(iters), jnp.stack(bounds),
+                jnp.stack(moves))
+
+    def _member_polish(state, ctx, mkey, boundary, rnd):
+        """One polish round, one member — the sequential loop's exact
+        semantics: skip decisions use the ROUND-START boundary (frozen),
+        keys fold_in(mkey, 1000*(rnd+1)+i), ``~(x <= eps)`` keeps NaN
+        residuals in the todo set, and a round whose starting boundary is
+        fully converged runs nothing (the host loop's ``break``)."""
+        round_do = jnp.any(~(boundary <= polish_eps))
+        prev_stack = boundary
+        iters, moves = [], []
+        for i, run_pass in enumerate(pass_fns):
+            todo = round_do & ~(boundary[i] <= polish_eps)
+
+            def _do(st, _p=run_pass, _i=i):
+                return _p(st, ctx,
+                          jax.random.fold_in(mkey, 1000 * (rnd + 1) + _i))
+
+            def _skip(st, _prev=prev_stack):
+                return (st, jnp.zeros((), jnp.int32), _prev,
+                        st.moves_applied)
+
+            state, it, stack, mv = jax.lax.cond(todo, _do, _skip, state)
+            prev_stack = stack
+            iters.append(it)
+            moves.append(mv)
+        return state, jnp.stack(iters), prev_stack, jnp.stack(moves)
+
+    def _rep_specs(tree):
+        return jax.tree.map(lambda _: P(), tree)
+
+    def _pop_specs(tree):
+        return jax.tree.map(lambda _: P(POPULATION_AXIS), tree)
+
+    def _walk_sm(state, ctx, key):
+        """shard_map'd walk: inputs replicate, each device evolves its
+        k_per_dev members via lax.map, outputs stack on the global [K]
+        member axis (the branches.py recipe with an inner member pack)."""
+        def body(state, ctx, key):
+            d = jax.lax.axis_index(POPULATION_AXIS)
+
+            def one(j):
+                m = d * k_per_dev + j
+                return _member_walk(state, ctx, _member_key(key, m))
+
+            return jax.lax.map(one, jnp.arange(k_per_dev))
+
+        out_struct = jax.eval_shape(
+            lambda s, c, k: _member_walk(s, c, k), state, ctx, key)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(_rep_specs(state), _rep_specs(ctx), P()),
+            out_specs=_pop_specs(out_struct))(state, ctx, key)
+
+    def _polish_sm(states, ctx, boundary, key, rnd):
+        def body(states, ctx, boundary, key):
+            d = jax.lax.axis_index(POPULATION_AXIS)
+
+            def one(t):
+                j, st, bnd = t
+                m = d * k_per_dev + j
+                return _member_polish(st, ctx, _member_key(key, m), bnd,
+                                      rnd)
+
+            return jax.lax.map(one, (jnp.arange(k_per_dev), states,
+                                     boundary))
+
+        state1 = jax.tree.map(lambda x: jax.ShapeDtypeStruct(
+            x.shape[1:], x.dtype), states)
+        bnd1 = jax.ShapeDtypeStruct(boundary.shape[1:], boundary.dtype)
+        out_struct = jax.eval_shape(
+            lambda s, c, b, k: _member_polish(s, c, k, b, rnd),
+            state1, ctx, bnd1, key)
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(_pop_specs(states), _rep_specs(ctx),
+                      P(POPULATION_AXIS), P()),
+            out_specs=_pop_specs(out_struct))(states, ctx, boundary, key)
+
+    def _scores(boundary, moves, scales):
+        weighted = weighted_objective(
+            boundary, scales, hard_mask, hard_weight=pop_cfg.hard_weight,
+            move_weight=pop_cfg.move_weight, moves=moves)
+        ranks = pareto_ranks(boundary, scales)
+        return ranks, weighted
+
+    def _survivor_perm(boundary, moves, scales):
+        """Truncation selection: rank by (Pareto rank when configured,)
+        weighted score with index tie-break, top n_surv survive, slot i
+        adopts survivor[i mod n_surv] — and slot 0 is ALWAYS re-anchored
+        to its own lineage (the sequential anchor never adopts; n_surv
+        <= K-1, see ``n_survivors``, so the override can never evict the
+        rank winner's only slot)."""
+        ranks, weighted = _scores(boundary, moves, scales)
+        primary = (ranks.astype(jnp.float32) if use_pareto
+                   else jnp.zeros_like(weighted))
+        order = jnp.lexsort((jnp.arange(K), weighted, primary))
+        survivors = order[:n_surv]
+        perm = survivors[jnp.arange(K) % n_surv]
+        return perm.at[0].set(0)
+
+    def run(state, ctx, key):
+        # The sequential path's pre-pass aux readings, computed ONCE for
+        # the shared initial state (all members start from the request
+        # model) — same definition as CompiledGoalChain._aux_impl.
+        aux = (state.offline.any(),
+               jnp.stack([g.violation_scale(state, ctx) for g in goals]),
+               violation_stack(goals, state, ctx))
+        scales = aux[1]
+        states, iters, walk_bounds, mv_walk = _walk_sm(state, ctx, key)
+        boundary = walk_bounds[:, -1, :]                        # [K, G]
+        accepted = mv_walk - jnp.concatenate(
+            [jnp.zeros((K, 1), mv_walk.dtype), mv_walk[:, :-1]], axis=1)
+        moves = mv_walk[:, -1]                                  # [K]
+        perms, rows = [], []
+        for rnd in range(R):
+            # Generation boundary: joint multi-objective scoring over the
+            # whole population, truncation selection, survivor adoption.
+            # The gather between shard_map regions reshards at the jit
+            # level (XLA inserts the collective); all per-member
+            # accounting follows its plan's lineage.
+            perm = _survivor_perm(boundary, moves, scales)
+            states = jax.tree.map(lambda x: x[perm], states)
+            boundary, iters = boundary[perm], iters[perm]
+            accepted, moves = accepted[perm], moves[perm]
+            # History follows the plan's LINEAGE: after every adoption the
+            # per-slot walk rows and earlier round rows are re-permuted
+            # too, so slot m's history is always its current plan's own
+            # history (the winner's trajectory reads straight off slot
+            # ``best`` — tiny [K, G] arrays, negligible cost).
+            walk_bounds = walk_bounds[perm]
+            rows = [r[perm] for r in rows]
+            states, it_r, b_r, mv_r = _polish_sm(states, ctx, boundary,
+                                                 key, rnd)
+            accepted = accepted + mv_r - jnp.concatenate(
+                [moves[:, None], mv_r[:, :-1]], axis=1)
+            moves = mv_r[:, -1]
+            iters = iters + it_r
+            boundary = b_r
+            perms.append(perm)
+            rows.append(boundary)
+        ranks, weighted = _scores(boundary, moves, scales)
+        polish_rows = (jnp.stack(rows) if rows
+                       else jnp.zeros((0, K, G), jnp.float32))
+        perm_arr = (jnp.stack(perms) if perms
+                    else jnp.zeros((0, K), jnp.int32))
+        return (states, aux, iters, walk_bounds, polish_rows, moves,
+                accepted, perm_arr, ranks, weighted)
+
+    # No donation: the initial state fans out to K member copies, so its
+    # buffer is never reusable in place (jit would warn on every call).
+    from ..core.runtime_obs import default_collector
+    return (collector or default_collector()).track(
+        f"population-search-x{K}", jax.jit(run))
+
+
+def select_plan(states, stacks, moves, ranks, weighted,
+                pop_cfg: PopulationConfig, audit_eval=None):
+    """Pick the served plan from the population: hard-goal audit verdicts
+    dominate (a gate-passing plan beats any gate-failing one — the
+    ``select_best_audited`` rule), then the configured joint objective
+    (Pareto rank when ``objective="pareto"``), then the weighted score,
+    then the lexicographic stack, ties toward the lower slot (slot 0 is
+    the sequential anchor, so "no worse than sequential" holds under the
+    configured objective by construction).
+
+    ``stacks``/``moves``/``ranks``/``weighted`` are the already-fetched
+    host copies; only the winner's state is gathered off the device.
+    Returns ``(state, winner_index, winner_stack)``."""
+    v = checked_violations(stacks, "population search")
+    ranks = np.asarray(ranks)
+    weighted = np.asarray(weighted)
+    moves = np.asarray(moves)
+    keys = []
+    for m in range(v.shape[0]):
+        num_bad = 0
+        if audit_eval is not None:
+            mstate = jax.tree.map(lambda x, _m=m: x[_m], states)
+            num_bad = audit_violation_count(audit_eval, mstate)
+        primary = (int(ranks[m]) if pop_cfg.objective == "pareto" else 0)
+        keys.append((num_bad, primary, float(weighted[m]), tuple(v[m]),
+                     int(moves[m]), m))
+    best = min(keys)[-1]
+    state = jax.tree.map(lambda x: x[best], states)
+    return state, best, v[best]

@@ -100,10 +100,23 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     if branches > 1:
         import jax
         branches = min(branches, len(jax.devices()))
+    # Tuned search schedules (search.tuning.*): per-shape-bucket
+    # SearchConfig overrides persisted by offline tuning runs (bench.py
+    # --scenario 7), loaded ONCE at construction so warm serving picks
+    # up tuned schedules with zero recompiles within a bucket.
+    tuned_store = None
+    if config.get_boolean("search.tuning.enabled"):
+        from .analyzer import TunedConfigStore
+        tuned_store = TunedConfigStore(
+            config.get_string("search.tuning.store.path") or None)
     optimizer = TpuGoalOptimizer(
         goals=goals_by_name(goal_names, constraint) if goal_names else None,
         constraint=constraint, config=config.search_config(), mesh=mesh,
         branches=branches,
+        # Multi-objective population search (search.population.*):
+        # parse-time exclusivity vs branches/mesh/fleet already held.
+        population=config.population_config(),
+        tuned_store=tuned_store,
         # ref hard.goals: the registered hard-goal set every optimization
         # is audited against post-run regardless of chain membership.
         hard_goal_names=config.get_list("hard.goals") or None)

@@ -80,10 +80,15 @@ while true; do
     # propose (16 clusters x 100x20K, cluster axis sharded over the
     # chips) — on real multi-chip hardware the clusters/s row measures
     # genuine cross-chip concurrency, not forced-host virtual devices.
-    for spec in 2 6 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 7 = the tuned multi-objective population search vs the fixed-
+    # schedule sequential propose (100x20K): tunes on-chip (the tuned
+    # store persists per shape bucket, so later serving runs pick the
+    # on-chip schedule up), then gates the population A/B.
+    for spec in 2 6 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
-        2|1) tmo=3600 ;; 5|6) tmo=2400 ;; 4:fullchain) tmo=7200 ;;
+        2|1) tmo=3600 ;; 5|6) tmo=2400 ;; 7) tmo=4800 ;;
+        4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac
       capture "$spec" "$tmo"

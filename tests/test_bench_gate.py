@@ -144,6 +144,41 @@ def test_fleet_propose_bench_smoke_gate():
 
 
 @pytest.mark.slow
+def test_multiobj_propose_bench_smoke_gate(tmp_path):
+    """run_multiobj_propose_bench on a toy cluster: exercises the full
+    tune -> persist -> tuned-population-propose harness end-to-end with
+    its always-on gates (zero warm recompiles on the population path,
+    quality delta within tolerance, move-count tolerance — the helper
+    raises on any of them). The >= 1x wall-clock gate is judged at
+    bench scale only (gate=False here — at toy scale dispatch overhead
+    dominates and the devices are virtual). Marked slow like the
+    scale-tier smoke: the tuner compiles one chain per candidate and
+    the tier-1 wall clock sits near its 870s cap — the population
+    quality/parity/recompile gates stay tier-1 in test_population.py,
+    and this harness runs at real scale via bench --scenario 7 /
+    tpu_watch ladder entry 7."""
+    import bench
+    out = bench.run_multiobj_propose_bench(
+        num_brokers=10, num_partitions=96,
+        goal_names=["ReplicaDistributionGoal"],
+        population=2, tune_trials=2, tune_rungs=1, repeats=1,
+        store_path=str(tmp_path / "tuned.json"),
+        emit_row=False, gate=False)
+    assert out["recompiles"] == 0
+    assert out["quality_delta"] <= bench.MULTIOBJ_QUALITY_TOL
+    assert out["pop_moves"] <= out["seq_moves"] * bench.MULTIOBJ_MOVE_TOLERANCE
+    assert out["trials"] >= 2 and out["bucket"]
+    assert out["seq_s"] > 0 and out["pop_s"] > 0 and out["tune_s"] > 0
+    assert out["population"].get("size") == 2
+    # The tuned store landed on disk in the versioned format.
+    from cruise_control_tpu.analyzer.tuning import TUNED_CONFIG_VERSION
+    import json
+    data = json.loads((tmp_path / "tuned.json").read_text())
+    assert data["version"] == TUNED_CONFIG_VERSION
+    assert out["bucket"] in data["buckets"]
+
+
+@pytest.mark.slow
 def test_scale_tier_gate_smoke():
     """The GATED scale tier (run_scale_scenario) at a CI-sized cluster,
     sharded over 2 devices: the full row set must come back (warm cycle

@@ -161,6 +161,63 @@ def test_branches_and_mesh_mutually_exclusive_at_parse_time():
                          "search.mesh.devices": "2"})
 
 
+def test_population_conflicts_fail_at_parse_time():
+    """search.population vs each device-axis owner: every conflict pair
+    must fail when the PROPERTIES parse with an actionable message
+    naming both keys (one regression test per pair, ISSUE 11)."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    # pair: population x branches
+    with pytest.raises(ConfigException) as exc:
+        CruiseControlConfig({"search.population": "4",
+                             "search.branches": "4"})
+    msg = str(exc.value)
+    assert "search.population" in msg and "search.branches" in msg
+    # pair: population x mesh (explicit N and -1 = all devices)
+    with pytest.raises(ConfigException) as exc:
+        CruiseControlConfig({"search.population": "4",
+                             "search.mesh.devices": "2"})
+    msg = str(exc.value)
+    assert "search.population" in msg and "search.mesh.devices" in msg
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"search.population": "2",
+                             "search.mesh.devices": "-1"})
+    # pair: population x fleet
+    with pytest.raises(ConfigException) as exc:
+        CruiseControlConfig({"search.population": "4",
+                             "fleet.enabled": "true"})
+    msg = str(exc.value)
+    assert "search.population" in msg and "fleet.enabled" in msg
+    # pair: population x fused chain (the population program is already
+    # one fused dispatch; its polish keys anchor to the PER-GOAL walk)
+    with pytest.raises(ConfigException) as exc:
+        CruiseControlConfig({"search.population": "4",
+                             "search.fused.chain": "true"})
+    msg = str(exc.value)
+    assert "search.population" in msg and "search.fused.chain" in msg
+    # K=1 still engages the population machinery: conflicts apply.
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"search.population": "1",
+                             "search.branches": "4"})
+    # Either alone is fine; 0 = off composes with everything.
+    CruiseControlConfig({"search.population": "4"})
+    CruiseControlConfig({"search.population": "0",
+                         "search.branches": "4"})
+    CruiseControlConfig({"search.population": "0",
+                         "search.mesh.devices": "2"})
+
+
+def test_population_objective_validated_at_parse_time():
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    with pytest.raises(ConfigException, match="weighted.*pareto"):
+        CruiseControlConfig({"search.population.objective": "fastest"})
+    for ok in ("weighted", "pareto"):
+        cfg = CruiseControlConfig({"search.population": "2",
+                                   "search.population.objective": ok})
+        assert cfg.population_config().objective == ok
+    assert CruiseControlConfig({"search.population": "3"}
+                               ).population_config().size == 3
+
+
 def test_pad_multiple_must_divide_mesh_devices():
     """Even sharding is a placement-time hard requirement (device_put
     rejects uneven partition axes): a pad multiple not divisible by the

@@ -447,6 +447,44 @@ def test_fleet_group_key_carries_seed(fleet3, fleet_opt):
     assert p1["group_key"] != p2["group_key"]
 
 
+def test_fleet_tuned_buckets_split_groups_not_prng_streams(tmp_path):
+    """Fleet composition under tuned schedules (ISSUE 11): members in
+    differently-TUNED shape buckets resolve to different search configs,
+    which are part of the dispatch-group key — they must land in
+    separate dispatch GROUPS (the documented heterogeneous degrade
+    path), while members sharing a bucket share one group. Group-key
+    level test: composition is decided in _prepare_member, no compiled
+    programs involved."""
+    from cruise_control_tpu.analyzer import TunedConfigStore, shape_bucket
+    store = TunedConfigStore(str(tmp_path / "tuned.json"))
+    # Members: a/b share bucket b8p128 (8 brokers, 96/100 partitions);
+    # c sits in b16p128 (10 brokers). Tune the two buckets differently.
+    ma, mda = _cluster(8, 96, 1)
+    mb, mdb = _cluster(8, 100, 2)
+    mc, mdc = _cluster(10, 128, 3)
+    assert shape_bucket(96, 8) == shape_bucket(100, 8)
+    assert shape_bucket(96, 8) != shape_bucket(128, 10)
+    store.record(96, 8, {"max_iters_per_goal": 32}, save=False)
+    store.record(128, 10, {"max_iters_per_goal": 40}, save=False)
+    tuned_opt = TpuGoalOptimizer(goals=goals_by_name(GOALS), config=CFG,
+                                 tuned_store=store)
+    f_opt = FleetOptimizer(tuned_opt)
+    fleet = FleetModel.stack([("a", ma, mda), ("b", mb, mdb),
+                              ("c", mc, mdc)],
+                             broker_pad_multiple=8,
+                             partition_pad_multiple=64)
+    opts = OptimizationOptions(seed=7, skip_hard_goal_check=True)
+    pa, pb, pc = [f_opt._prepare_member(m, opts) for m in fleet.members]
+    assert pa["cfg"].max_iters_per_goal == 32
+    assert pc["cfg"].max_iters_per_goal == 40
+    # Same bucket -> same tuned cfg -> ONE group; different bucket ->
+    # split (and the split is the CONFIG, never the PRNG stream: the
+    # seed component stays equal).
+    assert pa["group_key"] == pb["group_key"]
+    assert pa["group_key"] != pc["group_key"]
+    assert pa["group_key"][-1] == pc["group_key"][-1] == opts.seed
+
+
 def test_fleet_summary_and_devicestats_section(fleet_registry):
     registry, feeds, clock = fleet_registry
     summary = registry.summary_json()

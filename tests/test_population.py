@@ -1,0 +1,398 @@
+"""Multi-objective population search + schedule auto-tuning (ISSUE 11).
+
+Tier-1 gates: K=1 population search is BIT-IDENTICAL to the sequential
+chain walk (the anchor guarantee), K=2 never scores worse than
+sequential under the joint objective with moves in tolerance, tuned
+configs serve with zero recompiles within a shape bucket. Heavy K is
+marked slow. Compiled population programs are process-wide
+(``_POPULATION_PROGRAMS``) and the chain passes ride the shared
+``_SHARED_CHAINS`` registry, so the paired tests here (and the tracing
+gate in test_tracing.py) compile each program once per suite run.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                         PopulationConfig, SearchConfig,
+                                         TpuGoalOptimizer, goals_by_name)
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+
+#: shared search schedule for every compiled test program in this module
+#: (and the tracing gate), sized for COMPILE cost — the population
+#: program inlines the whole chain once per generation: small pools, ONE
+#: polish pass (chain traced 3x, not 4x), no swap candidates and no
+#: bulk-drain prologue (each would add a traced sub-machine to every
+#: pass body; engine/parallel tests cover them). The slow K=8 soak runs
+#: a swap-enabled schedule below.
+CFG = SearchConfig(num_replica_candidates=64, num_dest_candidates=8,
+                   num_swap_candidates=0, apply_per_iter=32,
+                   max_iters_per_goal=48, drain_rounds=0,
+                   polish_passes=1)
+PARITY_GOALS = ["ReplicaDistributionGoal", "DiskUsageDistributionGoal"]
+#: the K=2 dynamics tests run a single-goal chain — the population
+#: program inlines chain x (1 + polish rounds), so goal count is the
+#: compile-cost knob tier-1 cares about.
+AB_GOALS = ["ReplicaDistributionGoal"]
+OPTS = OptimizationOptions(seed=5, skip_hard_goal_check=True)
+
+
+def _model(partitions=128, brokers=8, pad_to=None):
+    brokers_ = [BrokerSpec(broker_id=i, rack=f"r{i % 4}")
+                for i in range(brokers)]
+    parts = [PartitionSpec(topic=f"t{p % 8}", partition=p,
+                           replicas=[p % 2, 2 + p % 2],
+                           leader_load=(1.0, 10.0, 12.0, 80.0 + p % 7))
+             for p in range(partitions)]
+    return flatten_spec(ClusterSpec(brokers=brokers_, partitions=parts),
+                        pad_partitions_to=pad_to or partitions)
+
+
+@pytest.fixture(scope="module")
+def model_md():
+    return _model()
+
+
+@pytest.fixture(scope="module")
+def seq_result(model_md):
+    model, md = model_md
+    opt = TpuGoalOptimizer(goals=goals_by_name(PARITY_GOALS), config=CFG)
+    return opt.optimize(model, md, OPTS)
+
+
+# ------------------------------------------------------------ tier-1 gates
+
+def test_population_k1_bit_identical_to_sequential(model_md, seq_result):
+    """THE parity gate: search.population=1 runs the whole population
+    machinery (shard_map over one member, selection, in-program polish)
+    and must reproduce the sequential chain walk bit for bit — member 0
+    is the anchor, its key stream IS the sequential stream."""
+    model, md = model_md
+    pop = TpuGoalOptimizer(goals=goals_by_name(PARITY_GOALS), config=CFG,
+                           population=1).optimize(model, md, OPTS)
+    seq = seq_result
+    assert pop.num_moves == seq.num_moves
+    assert [p.to_json() for p in pop.proposals] \
+        == [p.to_json() for p in seq.proposals]
+    np.testing.assert_array_equal(
+        np.asarray(pop.final_model.replica_broker),
+        np.asarray(seq.final_model.replica_broker))
+    np.testing.assert_array_equal(
+        np.asarray(pop.final_model.replica_pref_pos),
+        np.asarray(seq.final_model.replica_pref_pos))
+    for gp, gs in zip(pop.goal_results, seq.goal_results):
+        assert gp.name == gs.name
+        assert gp.violation_before == gs.violation_before
+        assert gp.violation_after == gs.violation_after
+        assert gp.iterations == gs.iterations
+        assert gp.accepted == gs.accepted
+    # Telemetry trajectory parity: same walk rows, same polish rows.
+    assert pop.telemetry["violationTrajectory"] \
+        == seq.telemetry["violationTrajectory"]
+    # The population section reports the degenerate pool honestly.
+    ps = pop.telemetry["population"]
+    assert ps["size"] == 1 and ps["winner"] == 0
+    assert ps["winnerIsAnchor"] and ps["paretoFrontSize"] == 1
+
+
+def test_population_k2_no_worse_than_sequential_and_telemetry(model_md):
+    """Quality A/B at K=2: the served plan's weighted joint objective is
+    <= the sequential plan's (the anchor sits in the final pool), move
+    counts stay within the documented 1.5x tolerance, and the joint-
+    scoring telemetry is internally consistent."""
+    from cruise_control_tpu.analyzer import plan_quality as quality
+    model, md = model_md
+
+    seq = TpuGoalOptimizer(goals=goals_by_name(AB_GOALS),
+                           config=CFG).optimize(model, md, OPTS)
+    opt = TpuGoalOptimizer(goals=goals_by_name(AB_GOALS), config=CFG,
+                           population=2)
+    pop = opt.optimize(model, md, OPTS)
+    assert quality(pop) <= quality(seq) + 1e-6
+    assert pop.num_moves <= seq.num_moves * 1.5
+    ps = pop.telemetry["population"]
+    assert ps["size"] == 2 and ps["objective"] == "weighted"
+    assert 1 <= ps["paretoFrontSize"] <= 2
+    assert len(ps["perGoalAcceptance"]) == 2
+    # Acceptance accounting telescopes member-exactly: the winner's
+    # per-goal accepted counts ARE the goal_results', and they sum to
+    # the served move count.
+    assert ps["perGoalAcceptance"][ps["winner"]] \
+        == [g.accepted for g in pop.goal_results]
+    assert sum(g.accepted for g in pop.goal_results) == pop.num_moves
+    assert ps["movesPerMember"][ps["winner"]] == pop.num_moves
+    # Selection anchoring: slot 0 never adopts (perm[0] == 0).
+    for perm in ps["survivorPerms"]:
+        assert perm[0] == 0
+    # /devicestats snapshot mirrors the result's section.
+    assert opt.last_population_stats == ps
+
+    # Determinism: same key -> same winner, same plan.
+    pop2 = opt.optimize(model, md, OPTS)
+    assert pop2.telemetry["population"] == ps
+    assert pop2.num_moves == pop.num_moves
+
+
+def test_tuned_store_serves_with_zero_recompiles_within_bucket(tmp_path):
+    """Two models with different raw sizes in ONE shape bucket (and one
+    padded shape) must reuse the compiled chain of the tuned schedule:
+    after the first optimize, further optimizes across the bucket report
+    ZERO compile events — the tuned-schedule analog of the warm-path
+    recompile gates."""
+    from cruise_control_tpu.analyzer import TunedConfigStore
+    from cruise_control_tpu.core.runtime_obs import DeviceStatsCollector
+    store = TunedConfigStore(str(tmp_path / "tuned.json"))
+    # A distinctive schedule so this test owns a fresh compiled chain on
+    # its own collector (63 never appears elsewhere in the suite). The
+    # tuned drain_batch sits BELOW both raw sizes so the scaled config
+    # is size-invariant across the bucket — at production scale that
+    # invariance is automatic (pools clamp only for tiny models).
+    store.record(250, 8, {"max_iters_per_goal": 63, "polish_passes": 1,
+                          "drain_batch": 128})
+    collector = DeviceStatsCollector()
+    opt = TpuGoalOptimizer(goals=goals_by_name(AB_GOALS), config=CFG,
+                           tuned_store=store, collector=collector)
+    # Different raw sizes, ONE padded shape (the pad bucket) and ONE
+    # tuned bucket (pow2(250) == pow2(256) == 256 -> b8p256).
+    m1, md1 = _model(partitions=250, pad_to=256)
+    m2, md2 = _model(partitions=256)
+    r1 = opt.optimize(m1, md1, OPTS)
+    assert r1.num_moves > 0
+    before = collector.snapshot()
+    opt.optimize(m2, md2, OPTS)
+    opt.optimize(m1, md1, OPTS)
+    after = collector.snapshot()
+    assert after["compileEvents"] == before["compileEvents"], (
+        "tuned-bucket recompile gate: models within one shape bucket "
+        "must share the tuned compiled chain")
+    assert after["aotCompileEvents"] == before["aotCompileEvents"]
+
+
+# ------------------------------------------------------- scoring units
+
+def test_pareto_ranks_and_weighted_objective_units():
+    from cruise_control_tpu.analyzer.engine import (normalized_stacks,
+                                                    pareto_ranks,
+                                                    weighted_objective)
+    stacks = np.asarray([[0.0, 2.0],     # front (best on goal 0)
+                         [1.0, 1.0],     # front (trade-off)
+                         [1.0, 2.0],     # dominated by both above
+                         [2.0, 3.0]])    # dominated by everything
+    scales = np.asarray([0.0, 0.0])
+    ranks = np.asarray(pareto_ranks(stacks, scales))
+    assert ranks.tolist() == [0, 0, 2, 3]
+    # Satisfied-clamp: residuals under the ulp cutoff normalize to
+    # exactly 0, so converged goals tie bit-exactly.
+    scales_big = np.asarray([1e6, 1e6])
+    n = np.asarray(normalized_stacks(np.asarray([[0.5, 2e6]]), scales_big))
+    assert n[0, 0] == 0.0 and n[0, 1] == pytest.approx(2.0)
+    # Hard weighting dominates soft trade-offs; move weight breaks ties.
+    hard = np.asarray([True, False])
+    w = np.asarray(weighted_objective(stacks, scales, hard,
+                                      hard_weight=1000.0))
+    assert w[0] < w[1]                 # 0*1000+2 < 1*1000+1
+    w_mv = np.asarray(weighted_objective(
+        np.zeros((2, 2)), scales, hard, hard_weight=1000.0,
+        move_weight=0.1, moves=np.asarray([10, 2])))
+    assert w_mv[1] < w_mv[0]
+
+
+def test_population_layout_buckets_power_of_two():
+    from cruise_control_tpu.parallel import population_layout, pow2_bucket
+    assert pow2_bucket(0) == 1 and pow2_bucket(1) == 1
+    assert pow2_bucket(3) == 4 and pow2_bucket(4) == 4
+    assert pow2_bucket(5) == 8
+    # 8 virtual devices (conftest): K buckets split evenly, remainder
+    # packs per device.
+    assert population_layout(1) == (1, 1, 1)
+    assert population_layout(3) == (4, 1, 4)       # bucket 4
+    assert population_layout(8) == (8, 1, 8)
+    assert population_layout(9) == (8, 2, 16)      # bucket 16, 2/device
+    assert population_layout(4, device_cap=2) == (2, 2, 4)
+    assert population_layout(4, device_cap=3) == (2, 2, 4)  # even split
+
+
+def test_survivor_count_clamped_below_population_size():
+    """n_survivors caps at K-1: slot 0 is force-anchored after the
+    survivor round-robin, so with K survivors the top-ranked plan would
+    hold ONLY slot 0 and be silently evicted by the anchor override —
+    any fraction, even 1.0, must leave the rank winner a free slot."""
+    from cruise_control_tpu.parallel.population import n_survivors
+    assert n_survivors(1, 0.5) == 1
+    assert n_survivors(2, 0.5) == 1
+    assert n_survivors(2, 1.0) == 1          # never K
+    assert n_survivors(4, 0.5) == 2
+    assert n_survivors(4, 1.0) == 3          # clamped to K-1
+    assert n_survivors(8, 0.01) == 1         # floor
+    assert n_survivors(8, 0.75) == 6
+
+
+def test_select_plan_audit_dominates():
+    """A gate-passing plan beats a jointly-better gate-failing one (the
+    select_best_audited rule carried over to the population)."""
+    from cruise_control_tpu.parallel import select_plan
+    states = {"x": jax.numpy.asarray([[0.0], [1.0]])}
+    stacks = np.asarray([[0.0, 1.0], [0.0, 2.0]])
+    audit_by_member = {0.0: ([5.0], [0.0]),     # slot 0 fails the audit
+                       1.0: ([0.0], [0.0])}
+
+    def audit_eval(mstate):
+        av, sc = audit_by_member[float(mstate["x"][0])]
+        return jax.numpy.asarray(av), jax.numpy.asarray(sc)
+
+    pop = PopulationConfig(size=2)
+    _, best_plain, _ = select_plan(states, stacks,
+                                   np.asarray([3, 3]),
+                                   np.asarray([0, 1]),
+                                   np.asarray([1.0, 2.0]), pop)
+    assert best_plain == 0
+    picked, best, v = select_plan(states, stacks, np.asarray([3, 3]),
+                                  np.asarray([0, 1]),
+                                  np.asarray([1.0, 2.0]), pop,
+                                  audit_eval=audit_eval)
+    assert best == 1
+    assert float(picked["x"][0]) == 1.0
+    assert tuple(v) == (0.0, 2.0)
+
+
+def test_select_plan_rejects_nan_stacks():
+    from cruise_control_tpu.parallel import select_plan
+    states = {"x": jax.numpy.asarray([[0.0]])}
+    with pytest.raises(RuntimeError, match="NaN"):
+        select_plan(states, np.asarray([[np.nan]]), np.asarray([0]),
+                    np.asarray([0]), np.asarray([0.0]),
+                    PopulationConfig(size=1))
+
+
+def test_population_ctor_exclusivity():
+    from cruise_control_tpu.parallel import make_mesh
+    with pytest.raises(ValueError, match="search.branches"):
+        TpuGoalOptimizer(population=2, branches=4)
+    with pytest.raises(ValueError, match="search.mesh.devices"):
+        TpuGoalOptimizer(population=2, mesh=make_mesh(2))
+    with pytest.raises(ValueError, match="objective"):
+        TpuGoalOptimizer(population=PopulationConfig(size=2,
+                                                     objective="bogus"))
+    from dataclasses import replace
+    with pytest.raises(ValueError, match="fused.chain"):
+        TpuGoalOptimizer(population=2,
+                         config=replace(CFG, fused_chain=True))
+    # 0 = off: composes with anything.
+    TpuGoalOptimizer(population=0, branches=4)
+
+
+# --------------------------------------------------------- tuner units
+
+def _stub_eval(wall_by_iters):
+    def ev(fields, rung, repeats):
+        f = dict(max_iters_per_goal=256, polish_passes=2)
+        f.update(fields)
+        return {"wall_s": wall_by_iters(f), "moves": 100,
+                "quality": 5.0 if f["polish_passes"] == 0 else 1.0}
+    return ev
+
+
+def test_successive_halving_picks_fast_feasible_schedule():
+    from cruise_control_tpu.analyzer import SuccessiveHalvingTuner
+    ev = _stub_eval(lambda f: abs(f["max_iters_per_goal"] - 128) / 100
+                    + 1.0)
+    tuner = SuccessiveHalvingTuner(evaluate=ev, trials=12, rungs=3,
+                                   seed=1)
+    best, history = tuner.tune()
+    assert best, "a faster feasible schedule exists and must win"
+    assert best.get("polish_passes") != 0        # infeasible never wins
+    assert history and all(h["rung"] < 3 for h in history)
+    # Incumbent rows are flagged and present at every rung.
+    assert sum(1 for h in history if h["incumbent"]) >= 1
+    # Determinism: same seed, same outcome.
+    best2, _ = SuccessiveHalvingTuner(evaluate=ev, trials=12, rungs=3,
+                                      seed=1).tune()
+    assert best2 == best
+
+
+def test_successive_halving_incumbent_survives_infeasible_pool():
+    from cruise_control_tpu.analyzer import SuccessiveHalvingTuner
+
+    def ev(fields, rung, repeats):
+        # Every candidate is faster but gives up quality.
+        return {"wall_s": 0.1 if fields else 2.0,
+                "quality": 9.0 if fields else 1.0, "moves": 100}
+
+    best, history = SuccessiveHalvingTuner(evaluate=ev, trials=6,
+                                           rungs=2, seed=3).tune()
+    assert best == {}, "the incumbent schedule must win"
+    assert any(not h["feasible"] for h in history)
+
+
+def test_tuned_store_round_trip_and_versioning(tmp_path):
+    from cruise_control_tpu.analyzer import TunedConfigStore, shape_bucket
+    from cruise_control_tpu.analyzer.tuning import TUNED_CONFIG_VERSION
+    path = tmp_path / "tuned.json"
+    store = TunedConfigStore(str(path))
+    bucket = store.record(20_000, 100, {"num_swap_candidates": 512},
+                          history=[{"rung": 0}])
+    assert bucket == shape_bucket(20_000, 100) == "b128p32768"
+    # Same bucket (pow2 box), different raw shapes -> same overrides.
+    assert TunedConfigStore(str(path)).apply(
+        SearchConfig(), 19_000, 90).num_swap_candidates == 512
+    # Other buckets untouched; unknown fields rejected loudly.
+    assert store.apply(SearchConfig(), 500, 10) == SearchConfig()
+    with pytest.raises(ValueError, match="not tunable"):
+        store.record(100, 10, {"epsilon": 0.5})
+    # Version discipline: a stale file is IGNORED (re-tune to
+    # regenerate), never half-applied.
+    data = json.loads(path.read_text())
+    assert data["version"] == TUNED_CONFIG_VERSION
+    data["version"] = TUNED_CONFIG_VERSION + 1
+    path.write_text(json.dumps(data))
+    stale = TunedConfigStore(str(path))
+    assert stale.apply(SearchConfig(), 20_000, 100) == SearchConfig()
+    assert len(stale) == 0
+    # to_json carries the trial history for /devicestats.
+    assert store.to_json()["buckets"][bucket]["history"] == [{"rung": 0}]
+    # Corrupted VALUES degrade to the base config with a warning (the
+    # store contract) — never a trace-time crash on the serving path.
+    data = json.loads(path.read_text())
+    data["version"] = TUNED_CONFIG_VERSION
+    data["buckets"][bucket]["fields"] = {"num_swap_candidates": "512",
+                                         "max_iters_per_goal": -3,
+                                         "polish_passes": True,
+                                         "drain_batch": 2048}
+    path.write_text(json.dumps(data))
+    corrupt = TunedConfigStore(str(path))
+    applied = corrupt.apply(SearchConfig(), 20_000, 100)
+    assert applied.num_swap_candidates == SearchConfig().num_swap_candidates
+    assert applied.max_iters_per_goal == SearchConfig().max_iters_per_goal
+    assert applied.polish_passes == SearchConfig().polish_passes
+    assert applied.drain_batch == 2048      # valid field still applies
+
+
+# ------------------------------------------------------------- slow tier
+
+@pytest.mark.slow
+def test_population_k8_pareto_converges_and_anchors(model_md):
+    """Heavy-K soak (slow): K=8 across the 8 virtual devices under the
+    Pareto objective — every surviving lineage converges the 2-goal
+    chain, selection keys stay anchored, and the front size is sane."""
+    from dataclasses import replace
+    model, md = model_md
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(PARITY_GOALS),
+        # Full machinery for the soak: swaps + drain prologue back on
+        # (its own compile — slow tier pays it, tier-1 does not).
+        config=replace(CFG, num_swap_candidates=64, drain_rounds=4),
+        population=PopulationConfig(size=8, objective="pareto"))
+    res = opt.optimize(model, md, OPTS)
+    ps = res.telemetry["population"]
+    assert ps["size"] == 8 and ps["objective"] == "pareto"
+    assert 1 <= ps["paretoFrontSize"] <= 8
+    assert all(perm[0] == 0 for perm in ps["survivorPerms"])
+    for g in res.goal_results:
+        assert g.violation_after <= 1e-5, (g.name, g.violation_after)
+    from cruise_control_tpu.model.flat import sanity_check
+    assert all(int(v) == 0 for v in np.asarray(
+        list(sanity_check(res.final_model).values())))

@@ -340,3 +340,55 @@ def test_tracing_adds_zero_device_syncs(stack, monkeypatch):
         tracer.enabled = True
     assert with_tracing == without, (
         f"tracing changed host-fetch counts: {with_tracing} vs {without}")
+
+
+def test_population_tracing_adds_zero_device_syncs(monkeypatch):
+    """The zero-extra-syncs gate EXTENDED to the population path (ISSUE
+    11): the population search's joint-scoring telemetry (Pareto front,
+    per-member acceptance, survivor history) must ride the one
+    end-of-chain fetch — optimize() performs exactly as many host
+    fetches with tracing enabled as disabled. Mirrors
+    test_tracing_adds_zero_device_syncs; the fixture matches
+    tests/test_population.py exactly, so the compiled population
+    program is reused from the process-wide registry (alphabetical test
+    order: test_population runs first), not recompiled here."""
+    import jax
+
+    from cruise_control_tpu.analyzer import TpuGoalOptimizer, goals_by_name
+    from test_population import CFG, OPTS, PARITY_GOALS, _model
+    model, md = _model()
+    opt = TpuGoalOptimizer(goals=goals_by_name(PARITY_GOALS), config=CFG,
+                           population=1)
+    opt.optimize(model, md, OPTS)       # warm (cached program -> cheap)
+
+    counts = {"device_get": 0, "block": 0}
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def counting_get(x):
+        counts["device_get"] += 1
+        return real_get(x)
+
+    def counting_block(x):
+        counts["block"] += 1
+        return real_block(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_get)
+    monkeypatch.setattr(jax, "block_until_ready", counting_block)
+    tracer = opt.tracer
+
+    def run_counted(enabled: bool) -> dict:
+        tracer.enabled = enabled
+        counts.update(device_get=0, block=0)
+        res = opt.optimize(model, md, OPTS)
+        assert res.telemetry["population"]["paretoFrontSize"] >= 1
+        assert sum(g.accepted for g in res.goal_results) == res.num_moves
+        return dict(counts)
+
+    try:
+        with_tracing = run_counted(True)
+        without = run_counted(False)
+    finally:
+        tracer.enabled = True
+    assert with_tracing == without, (
+        f"tracing changed population host-fetch counts: "
+        f"{with_tracing} vs {without}")
