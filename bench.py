@@ -877,6 +877,153 @@ def run_chaos_recovery_bench(*, seed: int = 11, emit_row: bool = True,
     return {"steps": steps, "seed": seed, "wall_s": wall_s}
 
 
+def run_snapshot_restore_bench(num_brokers: int = NUM_BROKERS,
+                               num_partitions: int = NUM_PARTITIONS, *,
+                               goal_names: list | None = None,
+                               emit_row: bool = True, gate: bool = True
+                               ) -> dict:
+    """Restart-warmth row: restore-to-warm-serve from a crash-safe
+    snapshot vs the cold start path, on the served facade at bench scale.
+
+    Process 1 (the "pre-crash" control plane) ingests a synthetic
+    workload, pays the honest cold start — ``prewarm()`` (model build +
+    resident warmup + AOT goal-chain compile) plus the first
+    ``proposals()`` computation — and writes one snapshot. Process 2 (the
+    "restart") shares no monitor state: a fresh monitor with ZERO sample
+    history restores the snapshot and serves. Reported:
+
+    - ``snapshot_restore_wall_clock`` — restore + first warm
+      ``/proposals`` serve; vs_baseline = cold start over it. **Gated
+      >= 5x at bench scale** (the acceptance bar; toy smoke runs pass
+      gate=False because the suite's shared compiled chains make the
+      cold path artificially cheap there).
+
+    Always asserted, every scale: the restored process serves proposals
+    BIT-IDENTICAL to the pre-crash ones, generation-valid (zero new
+    cache computations), with ZERO compile events across restore+serve
+    (read off the /devicestats collector), and the restored result stays
+    stale-flagged (execution gated until a live model build)."""
+    import os
+    import tempfile
+
+    from cruise_control_tpu.api.facade import KafkaCruiseControl
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    from cruise_control_tpu.core.snapshot import SnapshotManager
+    from cruise_control_tpu.analyzer import (SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+
+    window_ms = 1000
+    windows = 4
+    num_topics = max(num_partitions // 100, 1)
+
+    def build_sim():
+        sim = SimulatedKafkaCluster()
+        for b in range(num_brokers):
+            sim.add_broker(b)
+        for p in range(num_partitions):
+            # Skewed onto 20% of brokers so proposals carry real moves.
+            pool = max(num_brokers // 5, 2) if p % 2 == 0 else num_brokers
+            sim.add_partition(f"t{p % num_topics}", p,
+                              [p % pool, (p + 1) % pool],
+                              size_mb=50.0 + (p % 100))
+        return sim
+
+    def build_stack(sim, optimizer, *, ingest: bool):
+        monitor = LoadMonitor(sim, MonitorConfig(
+            num_windows=windows, window_ms=window_ms,
+            min_samples_per_window=1))
+        if ingest:
+            mdef = partition_metric_def()
+            keys = sorted(sim.describe_partitions())
+            P = len(keys)
+            vals = ((np.arange(P * mdef.size(), dtype=np.float64)
+                     .reshape(P, mdef.size()) % 97) + 1.0)
+            for w in range(windows + 1):
+                times = np.full(P, w * window_ms + 100, np.int64)
+                monitor.partition_aggregator.add_samples_dense(keys, times,
+                                                               vals)
+        now = (windows + 1) * window_ms
+        return KafkaCruiseControl(sim, monitor, optimizer=optimizer,
+                                  now_ms=lambda: now)
+
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names or GOALS[:3]),
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=256))
+    sim = build_sim()
+
+    # --- process 1: the honest cold start, then one snapshot write.
+    facade1 = build_stack(sim, opt, ingest=True)
+    t0 = time.monotonic()
+    facade1.prewarm()
+    pre = facade1.proposals()
+    cold_s = time.monotonic() - t0
+    snap_dir = tempfile.mkdtemp(prefix="cc-snap-bench-")
+    snap_path = os.path.join(snap_dir, "cc.snapshot")
+    facade1.attach_snapshotter(SnapshotManager(snap_path))
+    written = facade1.snapshotter.write(facade1._now_ms(),
+                                        facade1.snapshot_payload())
+    if not written:
+        raise RuntimeError("snapshot write failed; see log")
+
+    # --- process 2: fresh monitor, zero samples, restore + serve.
+    facade2 = build_stack(sim, opt, ingest=False)
+    facade2.attach_snapshotter(SnapshotManager(snap_path))
+    collector = facade2.device_stats
+    snap = collector.snapshot()
+    t0 = time.monotonic()
+    if not facade2.restore_from_snapshot():
+        raise RuntimeError("snapshot restore refused; see log")
+    served = facade2.proposals()
+    restore_s = time.monotonic() - t0
+    after = collector.snapshot()
+
+    recompiles = ((after["compileEvents"] + after["aotCompileEvents"]
+                   + after["recompileEvents"])
+                  - (snap["compileEvents"] + snap["aotCompileEvents"]
+                     + snap["recompileEvents"]))
+    if recompiles != 0:
+        raise RuntimeError(
+            f"restored warm path compiled {recompiles} programs (want 0) "
+            "— restore must compose with the persistent cache; see "
+            "/devicestats recentEvents")
+    identical = ([p.to_json() for p in served.proposals]
+                 == [p.to_json() for p in pre.proposals])
+    if not identical:
+        raise RuntimeError(
+            "restored process served different proposals than the "
+            "pre-crash process — the bit-identical restore contract is "
+            "broken")
+    if facade2.proposal_cache.num_computations != \
+            facade1.proposal_cache.num_computations:
+        raise RuntimeError(
+            "restore was not generation-valid: the restored cache "
+            "recomputed instead of serving the snapshot entry")
+    if not served.stale_model:
+        raise RuntimeError("restored proposals must stay stale-flagged "
+                           "(execution gated until a live model build)")
+    speedup = cold_s / restore_s if restore_s > 0 else None
+    log(f"snapshot restore ({num_brokers}x{num_partitions}): "
+        f"restore-to-warm-serve {restore_s:.3f}s vs cold start "
+        f"{cold_s:.2f}s ({speedup:.1f}x); snapshot "
+        f"{facade1.snapshotter.to_json()['bytes']} bytes, 0 compiles "
+        "on the restored path")
+    if gate and (speedup is None or speedup < 5.0):
+        raise RuntimeError(
+            f"snapshot restore gate: {restore_s:.3f}s is only "
+            f"{speedup:.1f}x faster than the {cold_s:.2f}s cold start "
+            "(want >= 5x)")
+    if emit_row:
+        emit("snapshot_restore_wall_clock", round(restore_s, 3), "s",
+             round(speedup, 1) if speedup else None)
+    return {"cold_s": cold_s, "restore_s": restore_s, "speedup": speedup,
+            "recompiles": recompiles, "identical": identical,
+            "snapshot_bytes": facade1.snapshotter.to_json()["bytes"]}
+
+
 def build_spec(num_brokers: int = NUM_BROKERS,
                num_partitions: int = NUM_PARTITIONS):
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
@@ -1489,6 +1636,9 @@ def main():
     # Robustness: steps from injected broker crash to restored
     # balancedness through the full heal loop.
     run_chaos_recovery_bench()
+    # Crash-safety: restore-to-warm-serve from the snapshot must beat the
+    # cold start >= 5x with zero compiles and bit-identical proposals.
+    run_snapshot_restore_bench()
     # What-if engine: batched N-1 sweep vs sequential single-scenario
     # evaluation (>= 5x gate).
     run_whatif_n1_bench()

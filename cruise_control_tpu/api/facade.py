@@ -162,6 +162,14 @@ class KafkaCruiseControl:
         self._prewarm_thread: threading.Thread | None = None
         self._prewarm_stop = threading.Event()
 
+        #: crash-safe snapshot manager (core/snapshot.py) — wire via
+        #: :meth:`attach_snapshotter`. None = snapshots disabled.
+        self.snapshotter = None
+        #: leader elector (core/leader.py) — wire via
+        #: :meth:`attach_elector`. None = single-process mode: this
+        #: process is unconditionally the leader.
+        self.elector = None
+
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
                     self.executor.registry, self.whatif.registry]
@@ -215,6 +223,12 @@ class KafkaCruiseControl:
         pre-warm (prewarm.on.start). ``precompute_watch_only`` keeps the
         freshness/breach accounting but never recomputes — the fleet
         mode, where the registry's batched tick refills the cache."""
+        # Snapshot restore FIRST — before the refresher could race a
+        # recompute and before prewarm: a restored resident model +
+        # generation-valid cache means prewarm's model build rides the
+        # resident buffers and the first /proposals is a cache read.
+        if self.snapshotter is not None:
+            self.restore_from_snapshot()
         if self.task_runner is not None and \
                 self.task_runner.state.value == "NOT_STARTED":
             self.task_runner.start(self._now_ms(), skip_loading=skip_loading)
@@ -238,6 +252,14 @@ class KafkaCruiseControl:
             self._prewarm_thread = None
         if self.detector is not None:
             self.detector.stop_detection()
+        # Clean shutdown: persist one final snapshot (the restart serves
+        # warm from it) and hand leadership off immediately instead of
+        # letting the lease run out under a standby.
+        if self.snapshotter is not None and (self.elector is None
+                                             or self.elector.is_leader()):
+            self.snapshotter.write(self._now_ms(), self.snapshot_payload())
+        if self.elector is not None:
+            self.elector.resign(self._now_ms())
 
     def prewarm(self) -> dict:
         """Pre-warm the serving path's compiled programs: build one
@@ -303,6 +325,130 @@ class KafkaCruiseControl:
         self._prewarm_thread = threading.Thread(target=loop, daemon=True,
                                                 name="startup-prewarm")
         self._prewarm_thread.start()
+
+    # ------------------------------------------------- snapshot + HA role
+    def attach_snapshotter(self, snapshotter) -> None:
+        """Wire a :class:`~cruise_control_tpu.core.snapshot.
+        SnapshotManager`: its ``Snapshot.*`` sensors join the scrape
+        view; ``start_up`` restores from it, ``ha_tick`` writes on
+        cadence, ``shutdown`` writes a final snapshot."""
+        self.snapshotter = snapshotter
+        self.extra_registries.append(snapshotter.registry)
+
+    def attach_elector(self, elector) -> None:
+        """Wire a :class:`~cruise_control_tpu.core.leader.LeaderElector`:
+        one leader owns optimization + execution, this process's
+        executor is fenced under its epoch, and the ``HA.*`` sensors
+        join the scrape view."""
+        self.elector = elector
+        self.executor.fence = elector
+        self.extra_registries.append(elector.registry)
+
+    def ha_role(self) -> str:
+        """``leader`` (single-process mode included) or ``standby``."""
+        if self.elector is None:
+            return "leader"
+        return "leader" if self.elector.is_leader() else "standby"
+
+    def ha_json(self) -> dict:
+        """The role readout served on ``/state`` (ServerRole) and
+        ``/devicestats`` (ha section)."""
+        if self.elector is None:
+            return {"enabled": False, "role": "leader", "leaderId": None,
+                    "fencingEpoch": None}
+        return {"enabled": True, **self.elector.to_json()}
+
+    def _refuse_if_not_leader(self) -> None:
+        """Execution gate shared by every non-dryrun path: standby
+        replicas serve reads only — execution endpoints answer 503 with
+        the leader's identity (server.py maps NotLeaderError)."""
+        if self.elector is not None and not self.elector.is_leader():
+            from ..core.leader import NotLeaderError
+            raise NotLeaderError(
+                "this process is a standby replica; execution is owned "
+                f"by the leader ({self.elector.leader_id() or 'unknown'})",
+                leader_id=self.elector.leader_id())
+
+    def snapshot_payload(self) -> dict:
+        """Everything a restarted process needs to serve warm — the
+        composition core/snapshot.py persists. Host-side data only plus
+        the (picklable) cached OptimizerResult; no live object graphs."""
+        resident = getattr(self.monitor, "resident", None)
+        resident_state = (resident.export_state()
+                          if resident is not None else None)
+        return {
+            "clusterId": self.cluster_id,
+            "generation": self.monitor.generation,
+            "resident": ({"epoch": resident_state[0],
+                          "arrays": resident_state[1]}
+                         if resident_state is not None else None),
+            "proposalCache": self.proposal_cache.export_state(),
+            "fencingEpoch": (self.elector.epoch
+                             if self.elector is not None else 0),
+        }
+
+    def restore_from_snapshot(self, now_ms: int | None = None) -> bool:
+        """Apply the persisted snapshot so this process serves warm:
+        seed the monitor generation, rebuild the resident device buffers
+        from the host mirrors (bit-identical by construction), install
+        the cached proposals (stale-flagged: served immediately, but the
+        stale-execution gate refuses to ACT on them until a live model
+        build confirms the topology — how a stale snapshot trips the
+        refusal), and raise the fencing-epoch floor. Returns True when a
+        snapshot was applied; corrupt/version-skewed/stale files are
+        metered + logged by the manager and this returns False (cold
+        path)."""
+        if self.snapshotter is None:
+            return False
+        now = now_ms if now_ms is not None else self._now_ms()
+
+        def _validate(payload):
+            if payload.get("clusterId") != self.cluster_id:
+                return ("cluster-mismatch",
+                        f"snapshot was taken for cluster "
+                        f"{payload.get('clusterId')!r}, this process "
+                        f"serves {self.cluster_id!r}")
+            return None
+
+        payload = self.snapshotter.restore(now, validate=_validate)
+        if payload is None:
+            return False
+        self.monitor.seed_generation(payload.get("generation", 0))
+        resident = getattr(self.monitor, "resident", None)
+        res_state = payload.get("resident")
+        if resident is not None and res_state is not None:
+            resident.restore(res_state["epoch"], res_state["arrays"])
+        cache_state = payload.get("proposalCache")
+        if cache_state is not None:
+            self.proposal_cache.restore_state(cache_state)
+        if self.elector is not None:
+            self.elector.observe_epoch_floor(
+                payload.get("fencingEpoch", 0))
+        LOG.info(
+            "restored serving state from snapshot: generation %s, "
+            "resident %s, cached proposals %s (generation %s) — serving "
+            "warm; execution stays gated until a live model build",
+            payload.get("generation"),
+            "restored" if (resident is not None and res_state) else "none",
+            "restored" if cache_state else "none",
+            cache_state["generation"] if cache_state else None)
+        return True
+
+    def ha_tick(self, now_ms: int | None = None) -> str:
+        """One serving-loop HA round: run the election, write the
+        cadenced snapshot when leading, refresh from the leader's newer
+        snapshot when standing by. Returns the current role. Cheap
+        no-op when neither snapshots nor HA are wired."""
+        now = now_ms if now_ms is not None else self._now_ms()
+        role = (self.elector.tick(now) if self.elector is not None
+                else "leader")
+        if self.snapshotter is not None:
+            if role == "leader":
+                self.snapshotter.maybe_write(now, self.snapshot_payload)
+            elif self.snapshotter.newer_snapshot_available():
+                # Standby: serve the leader's latest published state.
+                self.restore_from_snapshot(now)
+        return role
 
     # ------------------------------------------------------ goal-based ops
     #: LRU bound on memoized goal-scoped optimizers — goal lists come from
@@ -433,7 +579,13 @@ class KafkaCruiseControl:
     def _maybe_execute(self, res: OptimizerResult, dryrun: bool,
                        uuid: str, progress: OperationProgress | None,
                        **executor_kwargs):
-        if dryrun or not res.proposals:
+        if dryrun:
+            return None
+        # Leadership BEFORE the empty-proposal no-op: a standby must 503
+        # every execution request (telling the client where the leader
+        # is), not silently succeed when the plan happens to be empty.
+        self._refuse_if_not_leader()
+        if not res.proposals:
             return None
         self._refuse_stale_execution(res.stale_model)
         if progress:
@@ -847,6 +999,11 @@ class KafkaCruiseControl:
                                         "last_population_stats", None)
         store = getattr(self.optimizer, "tuned_store", None)
         payload["tuning"] = store.to_json() if store is not None else None
+        # Crash-safety + HA readouts (null-safe: dashboards poll
+        # unconditionally whether or not the layer is wired).
+        payload["snapshot"] = (self.snapshotter.to_json()
+                               if self.snapshotter is not None else None)
+        payload["ha"] = self.ha_json()
         return payload
 
     # -------------------------------------------------------- fleet ops
@@ -871,7 +1028,10 @@ class KafkaCruiseControl:
         wanted = {s.lower() for s in (substates or
                                       ["monitor", "executor", "analyzer",
                                        "anomaly_detector"])}
-        out: dict = {}
+        # Role metadata rides EVERY state response (like "version"): a
+        # client must be able to tell a standby from the leader without
+        # knowing to ask for it (the HA runbook's first diagnostic).
+        out: dict = {"ServerRole": self.ha_json()}
         # Numeric self-metrics snapshot (ref the JMX-exposed Dropwizard
         # registry; substates=sensors scopes a response to just these).
         if "sensors" in wanted:
@@ -981,6 +1141,8 @@ class KafkaCruiseControl:
                                     "after": res.balance_violation_after},
                "iterations": res.iterations,
                "moves": [m.to_json() for m in res.moves]}
+        if not dryrun:
+            self._refuse_if_not_leader()
         if not dryrun and res.moves:
             self._refuse_stale_execution(result.stale)
             if progress:

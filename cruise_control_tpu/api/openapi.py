@@ -30,7 +30,9 @@ _COMMON_ASYNC_PARAMS = [
 
 #: endpoint -> (method, summary, extra params)
 ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
-    "state": ("get", "Monitor/executor/analyzer/anomaly-detector state",
+    "state": ("get", "Monitor/executor/analyzer/anomaly-detector state; "
+                     "every response carries ServerRole (leader|standby "
+                     "+ fencing epoch — docs/operations.md §HA)",
               [("substates", "string", "comma-separated subset")]),
     "load": ("get", "Per-broker load snapshot", []),
     "partition_load": ("get", "Per-partition resource load, sorted",
@@ -419,6 +421,44 @@ _SCHEMAS = {
                     "version": {"type": "integer"},
                     "path": {"type": "string"},
                     "buckets": {"type": "object"},
+                }},
+            "snapshot": {
+                "type": "object", "nullable": True,
+                "description": "crash-safe serving-state snapshot "
+                               "(core/snapshot.py): write cadence + the "
+                               "per-reason restore-refusal counters "
+                               "(corrupt / version-skew / stale / "
+                               "cluster-mismatch) an operator alerts on "
+                               "— null when snapshot.path is unset",
+                "properties": {
+                    "path": {"type": "string"},
+                    "intervalMs": {"type": "integer"},
+                    "maxAgeMs": {"type": "integer", "nullable": True},
+                    "writes": {"type": "integer"},
+                    "writeFailures": {"type": "integer"},
+                    "restores": {"type": "integer"},
+                    "restoreFallbacks": {"type": "object"},
+                    "lastWriteMs": {"type": "integer", "nullable": True},
+                    "bytes": {"type": "integer", "nullable": True},
+                }},
+            "ha": {
+                "type": "object",
+                "description": "leader/standby role readout "
+                               "(core/leader.py; also on every /state "
+                               "response as ServerRole): the fencing "
+                               "epoch is the monotonic token every "
+                               "executor mutation is stamped under",
+                "properties": {
+                    "enabled": {"type": "boolean"},
+                    "role": {"type": "string",
+                             "enum": ["leader", "standby"]},
+                    "identity": {"type": "string"},
+                    "leaderId": {"type": "string", "nullable": True},
+                    "fencingEpoch": {"type": "integer", "nullable": True},
+                    "observedEpoch": {"type": "integer",
+                                      "nullable": True},
+                    "leaseUntilMs": {"type": "integer", "nullable": True},
+                    "takeovers": {"type": "integer"},
                 }},
         }},
     "FleetSummary": {

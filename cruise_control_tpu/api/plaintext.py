@@ -236,6 +236,22 @@ def _render_devicestats(payload: dict) -> str:
                 for bkt, entry in sorted(tuning["buckets"].items())]
         text += "\ntuned search configs:\n" + _table(
             ["BUCKET", "FIELDS", "TRIALS"], rows)
+    snap = payload.get("snapshot")
+    if snap:
+        fallbacks = snap.get("restoreFallbacks") or {}
+        refused = ", ".join(f"{k}={v}" for k, v in sorted(fallbacks.items())
+                            if v) or "none"
+        text += (f"\nsnapshot: {snap.get('writes')} writes "
+                 f"({snap.get('writeFailures')} failed), "
+                 f"{snap.get('restores')} restores, refused: {refused}, "
+                 f"last write {snap.get('lastWriteMs')} ms "
+                 f"({snap.get('bytes')} bytes)")
+    ha = payload.get("ha")
+    if ha and ha.get("enabled"):
+        text += (f"\nha: {ha.get('role')} [{ha.get('identity')}], leader "
+                 f"{ha.get('leaderId')}, fencing epoch "
+                 f"{ha.get('fencingEpoch')}, {ha.get('takeovers')} "
+                 f"takeovers")
     return text
 
 

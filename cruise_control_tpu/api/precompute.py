@@ -283,6 +283,40 @@ class ProposalCache:
             self._cached_generation = None
             self._cached_at_ms = None
 
+    # -------------------------------------------------- snapshot/restore
+    def export_state(self) -> dict | None:
+        """The cache entry + generation keying + freshness stamps for the
+        crash-safe snapshot (core/snapshot.py); None when empty. The
+        result object is immutable by convention (readers never mutate
+        it), so it is exported by reference."""
+        with self._lock:
+            if self._cached is None:
+                return None
+            return {"result": self._cached,
+                    "generation": self._cached_generation,
+                    "cachedAtMs": self._cached_at_ms,
+                    "numComputations": self.num_computations}
+
+    def restore_state(self, state: dict) -> None:
+        """Install a snapshot's cache entry. The restored result is
+        force-flagged ``stale_model``: a restarted process may *serve* it
+        immediately (reads are bounded-staleness by design) but must not
+        *execute* it until a live model build confirms the topology — the
+        stale-execution gate (facade._refuse_stale_execution) enforces
+        exactly that, which is how a stale-snapshot restore trips the
+        refusal instead of acting on a dead cluster's plan. Bypasses the
+        ``store()`` guards deliberately: the caller (facade restore)
+        already verified the snapshot's cluster identity and seeded the
+        monitor generation to the snapshot's."""
+        from dataclasses import replace
+        result = replace(state["result"], stale_model=True)
+        with self._lock:
+            self._cached = result
+            self._cached_generation = state["generation"]
+            self._cached_at_ms = state["cachedAtMs"]
+            self.num_computations = state.get("numComputations", 0)
+            self._lock.notify_all()
+
     # ------------------------------------------- background refresh loop
     def refresh_once(self, now_ms_fn=None, *, compute: bool = True) -> bool:
         """One freshness tick: observe the generation, recompute when the

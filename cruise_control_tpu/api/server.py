@@ -26,6 +26,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..analyzer import OptimizationOptions
+from ..core.leader import NotLeaderError
 from .facade import KafkaCruiseControl
 from .parameters import ParsedParams, parse_endpoint_params
 from .purgatory import Purgatory
@@ -362,6 +363,13 @@ class CruiseControlApp:
             return 200, result, hdrs
         except (TimeoutError, _FuturesTimeout):
             return 202, {"progress": existing.progress.to_json(),
+                         "userTaskId": existing.user_task_id}, hdrs
+        except NotLeaderError as e:
+            # Standby replica: execution endpoints answer 503 with the
+            # leader's identity so clients (and LBs) can redirect — reads
+            # keep being served here (docs/operations.md §HA).
+            return 503, {"errorMessage": str(e),
+                         "leaderId": e.leader_id,
                          "userTaskId": existing.user_task_id}, hdrs
         except Exception as e:  # operation failed
             return 500, {"errorMessage": str(e),
@@ -876,6 +884,11 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         # server fault (deviation from the reference, which 500s here —
         # see TooManyUserTasksError).
         status, payload, extra = 429, {"errorMessage": str(e)}, {}
+    except NotLeaderError as e:
+        # Sync execution path on a standby replica (async paths map this
+        # inside _handle_async, keeping their User-Task-ID header).
+        status, payload, extra = 503, {"errorMessage": str(e),
+                                       "leaderId": e.leader_id}, {}
     except Exception as e:
         status, payload, extra = 500, {"errorMessage": str(e)}, {}
     # json=false: fixed-width text tables (ref the response classes'

@@ -14,11 +14,23 @@ suite and the ``chaos_recovery_steps`` bench row.
 Every fault decision derives from ``(seed, step/call counter)`` — never
 wall clock or global RNG — so any failing run replays exactly from its
 seed (see docs/robustness.md, "Replaying a failing seed").
+
+Process-level faults (this PR's tentpole proving ground): the
+``crash_process`` fault kills the control plane mid-execution
+(:class:`ProcessCrashed`; restart via :meth:`ChaosHarness.restart`
+restores from the crash-safe snapshot), :func:`corrupt_snapshot`
+truncates / bit-flips the snapshot before restore, and
+:class:`HAFailoverHarness` runs leader + warm standby as two full stacks
+over one sim with the fencing ledger
+(:func:`check_fencing_invariants`) auditing every mutation.
 """
 
-from .engine import ChaosAdminClient, ChaosEngine, ChaosSampler, FaultEvent
+from .engine import (ChaosAdminClient, ChaosEngine, ChaosSampler,
+                     FaultEvent, ProcessCrashed)
+from .ha import HAFailoverHarness, MutationStamp, corrupt_snapshot
 from .harness import ChaosHarness, build_sim, default_optimizer
-from .invariants import check_invariants, snapshot_topology
+from .invariants import (check_fencing_invariants, check_invariants,
+                         snapshot_topology)
 
 __all__ = [
     "ChaosAdminClient",
@@ -26,8 +38,13 @@ __all__ = [
     "ChaosHarness",
     "ChaosSampler",
     "FaultEvent",
+    "HAFailoverHarness",
+    "MutationStamp",
+    "ProcessCrashed",
     "build_sim",
+    "check_fencing_invariants",
     "check_invariants",
+    "corrupt_snapshot",
     "default_optimizer",
     "snapshot_topology",
 ]

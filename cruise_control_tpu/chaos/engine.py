@@ -37,6 +37,21 @@ from ..executor.kafka_admin import (AdminOperationError, AdminTimeoutError,
 from ..monitor.sampler import Samples
 
 
+class ProcessCrashed(RuntimeError):
+    """A scheduled ``crash_process`` fault fired: the control plane
+    "dies" at this exact simulated instant. Propagates out of whatever
+    the stack was doing (the executor's sleeps included); the
+    ``simulates_process_crash`` marker tells the executor to skip ALL
+    teardown — no abort RPCs, no throttle cleanup, state abandoned —
+    exactly what a real SIGKILL leaves behind. The harness driver
+    catches it, marks the stack crashed, and restarts from the
+    snapshot."""
+
+    #: checked by Executor's finally block (duck-typed: the executor
+    #: must not import the chaos package).
+    simulates_process_crash = True
+
+
 @dataclass
 class FaultEvent:
     """One scheduled fault: ``action`` (an :data:`ChaosEngine.ACTIONS`
@@ -153,7 +168,8 @@ class ChaosEngine:
     #: action name -> handler(self, **kwargs); the schedule vocabulary
     ACTIONS = ("kill_broker", "restart_broker", "fail_logdir",
                "stall_broker", "unstall_broker", "admin_error_rate",
-               "admin_burst", "drop_samples", "clock_jump")
+               "admin_burst", "drop_samples", "clock_jump",
+               "crash_process")
 
     def __init__(self, sim, *, seed: int = 0, step_ms: int = 1000,
                  events: list[FaultEvent] | None = None) -> None:
@@ -258,6 +274,15 @@ class ChaosEngine:
 
     def _do_drop_samples(self, rate: float) -> None:
         self.sample_drop_rate = min(max(rate, 0.0), 1.0)
+
+    def _do_crash_process(self) -> None:
+        """Process-level fault: kill the control plane at this exact
+        simulated instant — mid-execution when the executor happens to be
+        sleeping across the scheduled step (same determinism contract as
+        every other fault). Raises; see :class:`ProcessCrashed`."""
+        raise ProcessCrashed(
+            f"chaos: control-plane process crashed at t={self.sim.now_ms}ms "
+            f"(seed={self.seed})")
 
     def _do_clock_jump(self, ms: int) -> None:
         """Forward clock jump: simulated time leaps (windows roll, time

@@ -1,0 +1,243 @@
+"""Two-process HA failover harness: leader + warm standby over ONE
+simulated cluster, one deterministic clock, and one shared snapshot file.
+
+Extends the single-stack :class:`~.harness.ChaosHarness` pattern to the
+failure mode it cannot express: the control plane itself dies. Each
+"process" is a full wired stack (monitor → facade → executor) with its
+own :class:`~cruise_control_tpu.core.leader.LeaderElector` on the shared
+admin backend; mutations flow through a per-process
+:class:`RecordingAdmin` that stamps every mutating RPC with the issuer's
+fencing epoch — the raw material for the fencing invariants
+(:func:`~.invariants.check_fencing_invariants`).
+
+Also home to :func:`corrupt_snapshot`, the seeded snapshot-corruption
+fault (truncate / bit-flip) the crash-restore scenarios inject before a
+restart.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from .engine import ChaosEngine
+from .harness import ChaosHarness, build_sim
+
+#: admin SPI methods that mutate cluster state — the fencing surface.
+MUTATING_RPCS = ("alter_partition_reassignments",
+                 "elect_preferred_leaders", "alter_replica_log_dirs",
+                 "alter_broker_config", "alter_topic_config")
+
+
+@dataclass
+class MutationStamp:
+    """One mutating admin RPC as issued: when, by whom, under which
+    fencing epoch, and whether the issuer's lease was still current —
+    the ledger the fencing-epoch invariants audit."""
+
+    now_ms: int
+    process: str
+    method: str
+    epoch: int
+    lease_current: bool
+    #: broker ids this call ADDS replicas to, per partition (reassignment
+    #: calls only) — the double-apply audit key: the same (tp, broker)
+    #: add appearing under two different epochs means a proposal executed
+    #: twice across failover.
+    adds: dict | None = None
+
+
+class RecordingAdmin:
+    """Per-process admin wrapper stamping mutating RPCs with the issuing
+    process's fencing epoch. Election traffic (the reserved HA topic's
+    config) is pass-through — it IS the lease protocol, not a fenced
+    cluster mutation."""
+
+    def __init__(self, inner, process: str, stamps: list,
+                 now_ms) -> None:
+        from ..core.leader import HA_TOPIC
+        self.inner = inner
+        self.process = process
+        self.stamps = stamps
+        self._now_ms = now_ms
+        self._ha_topic = HA_TOPIC
+        #: set after the elector exists (the elector is built over THIS
+        #: wrapper, which is built before it).
+        self.elector = None
+
+    def __getattr__(self, name):
+        inner_fn = getattr(self.inner, name)
+        if name not in MUTATING_RPCS:
+            return inner_fn
+
+        def stamped(*args, **kwargs):
+            if (name == "alter_topic_config" and args
+                    and args[0] == self._ha_topic):
+                return inner_fn(*args, **kwargs)   # election traffic
+            adds = None
+            if name == "alter_partition_reassignments" and args:
+                # Raw-sim read (bypassing chaos injections): the audit
+                # bookkeeping must never perturb the injected fault
+                # sequence the actual call path sees.
+                raw = getattr(self.inner, "inner", self.inner)
+                current = raw.describe_partitions()
+                pending = raw.list_partition_reassignments()
+                adds = {}
+                for tp, target in args[0].items():
+                    if target is None:
+                        continue   # cancellation removes, never adds
+                    info = current.get(tp)
+                    have = set(info.replicas) if info is not None else set()
+                    # Re-asserting a move whose copy is ALREADY in flight
+                    # is idempotent (Kafka and the sim both dedupe) — a
+                    # new leader re-submitting the deposed leader's
+                    # in-flight plan is convergence, not double-apply.
+                    # Only brokers whose data copy would START here count.
+                    inflight = (set(pending[tp].adding)
+                                if tp in pending else set())
+                    new = [b for b in target
+                           if b not in have and b not in inflight]
+                    if new:
+                        adds[tp] = new
+            e = self.elector
+            # Invoke FIRST, stamp on success only: a chaos-injected admin
+            # failure means nothing landed on the cluster — ledgering it
+            # as applied would make a legitimate re-issue by the next
+            # leader read as a false double-apply.
+            out = inner_fn(*args, **kwargs)
+            self.stamps.append(MutationStamp(
+                now_ms=self._now_ms(), process=self.process,
+                method=name,
+                epoch=(e.epoch if e is not None else 0),
+                lease_current=(e.is_leader() if e is not None else True),
+                adds=adds))
+            return out
+
+        return stamped
+
+
+def corrupt_snapshot(path: str, *, mode: str = "truncate",
+                     seed: int = 0) -> None:
+    """Deterministically damage a snapshot file the way crashes and disks
+    do: ``truncate`` cuts the payload mid-byte (torn write without the
+    atomic rename), ``bitflip`` flips one payload bit chosen by ``seed``
+    (silent media corruption). The restore path must refuse both via the
+    checksum — never serve them."""
+    with open(path, "rb") as f:
+        raw = bytearray(f.read())
+    if mode == "truncate":
+        del raw[max(len(raw) // 2, 1):]
+    elif mode == "bitflip":
+        # Flip a bit inside the pickle payload (past the header line so
+        # the refusal exercises the checksum, not the header parse).
+        start = raw.index(b"\n") + 1
+        if start >= len(raw):
+            start = 0
+        pos = start + (seed * 2654435761) % max(len(raw) - start, 1)
+        raw[pos] ^= 1 << (seed % 8)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as f:
+        f.write(bytes(raw))
+
+
+class HAFailoverHarness:
+    """Leader + standby stacks sharing one sim, one engine, one snapshot.
+
+    Drive with :meth:`step` (ONE engine tick per step; every live
+    process samples and runs its HA tick in name order — deterministic,
+    so process "a" wins the first election). Kill a process with
+    :meth:`kill` (hard crash: it simply stops being driven; its lease
+    expires on the shared clock and the standby takes over), resurrect
+    it with :meth:`restart`.
+    """
+
+    def __init__(self, *, seed: int = 0, step_ms: int = 1000,
+                 snapshot_dir: str, sim=None, optimizer=None,
+                 lease_steps: int = 4, snapshot_interval_steps: int = 1,
+                 goals: list[str] | None = None,
+                 processes: tuple[str, ...] = ("a", "b")) -> None:
+        self.sim = sim or build_sim()
+        self.engine = ChaosEngine(self.sim, seed=seed, step_ms=step_ms)
+        self.snapshot_path = os.path.join(snapshot_dir, "cc.snapshot")
+        self.stamps: list[MutationStamp] = []
+        self._optimizer = optimizer
+        self._goals = goals
+        self._lease_steps = lease_steps
+        self._interval_steps = snapshot_interval_steps
+        self.procs: dict[str, ChaosHarness] = {}
+        for name in processes:
+            self._spawn(name)
+
+    def _spawn(self, name: str, *, restore: bool = False) -> ChaosHarness:
+        admin = RecordingAdmin(self.engine.admin, name, self.stamps,
+                               self.engine.now_ms)
+        h = ChaosHarness(
+            self.sim, engine=self.engine, admin=admin,
+            optimizer=self._optimizer, goals=self._goals,
+            snapshot_path=self.snapshot_path,
+            snapshot_interval_steps=self._interval_steps,
+            ha_identity=name, ha_lease_steps=self._lease_steps)
+        admin.elector = h.facade.elector
+        if restore:
+            h.facade.restore_from_snapshot(self.engine.now_ms())
+        self.procs[name] = h
+        return h
+
+    # -------------------------------------------------------------- loop
+    def step(self, *, detect: bool = False) -> None:
+        """One shared-clock step: advance the engine once, then drive
+        every live process's sampling + HA tick (+ optional detection)
+        at the same simulated instant, in name order."""
+        self.engine.tick()
+        now = self.engine.now_ms()
+        for name in sorted(self.procs):
+            h = self.procs[name]
+            if h.crashed:
+                continue
+            try:
+                h.runner.maybe_run_sampling(now)
+            except Exception:
+                h.sampling_failures += 1
+            h.facade.ha_tick(now)
+            if detect:
+                try:
+                    h.detector.run_once(now)
+                except Exception:
+                    h.detector_round_failures += 1
+
+    def run(self, steps: int, *, detect: bool = False) -> None:
+        for _ in range(steps):
+            self.step(detect=detect)
+
+    def steps_until(self, predicate, max_steps: int, *,
+                    what: str = "condition") -> int:
+        for i in range(max_steps):
+            if predicate():
+                return i
+            self.step()
+        raise AssertionError(
+            f"{what} not reached within {max_steps} steps "
+            f"(seed={self.engine.seed}); chaos log:\n  "
+            + "\n  ".join(self.engine.applied[-20:]))
+
+    # ------------------------------------------------------------- roles
+    def leader(self) -> str | None:
+        """Name of the process currently holding the lease, if any."""
+        for name in sorted(self.procs):
+            h = self.procs[name]
+            if not h.crashed and h.facade.elector.is_leader():
+                return name
+        return None
+
+    def kill(self, name: str) -> None:
+        """Hard-crash a process: it stops being driven mid-lease (no
+        resign, no final snapshot — the standby must wait out the lease,
+        exactly like a real SIGKILL'd leader)."""
+        self.procs[name].crash()
+
+    def restart(self, name: str) -> ChaosHarness:
+        """Resurrect a crashed process as a fresh stack restored from
+        the shared snapshot (its elector starts at epoch 0 standby; the
+        snapshot's fencing-epoch floor keeps monotonicity)."""
+        return self._spawn(name, restore=True)

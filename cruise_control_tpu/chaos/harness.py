@@ -79,10 +79,26 @@ class ChaosHarness:
                  admin_retry: RetryPolicy | None = None,
                  serve_stale_on_incomplete: bool = True,
                  fetch_max_retries: int = 1,
-                 optimizer: TpuGoalOptimizer | None = None) -> None:
+                 optimizer: TpuGoalOptimizer | None = None,
+                 engine: ChaosEngine | None = None,
+                 admin=None,
+                 snapshot_path: str | None = None,
+                 snapshot_interval_steps: int = 1,
+                 snapshot_max_age_ms: int = 0,
+                 ha_identity: str | None = None,
+                 ha_lease_steps: int = 5) -> None:
+        """``engine``/``admin`` overrides support restart-from-snapshot
+        (the replacement stack keeps the crashed stack's clock + fault
+        schedule) and the two-process HA harness (per-process admin
+        wrappers over one shared engine). ``snapshot_path`` wires a
+        SnapshotManager (written every ``snapshot_interval_steps`` by
+        ha_tick inside :meth:`step`); ``ha_identity`` wires a
+        LeaderElector on the simulated clock and fences the executor."""
         self.sim = sim or build_sim()
-        self.engine = ChaosEngine(self.sim, seed=seed, step_ms=step_ms)
-        admin = self.engine.admin
+        self.engine = engine or ChaosEngine(self.sim, seed=seed,
+                                            step_ms=step_ms)
+        step_ms = self.engine.step_ms
+        admin = admin or self.engine.admin
         goals = goals or list(DEFAULT_GOALS)
 
         admin_retry = admin_retry or RetryPolicy(
@@ -131,28 +147,85 @@ class ChaosHarness:
         self.detector.register(BrokerFailureDetector(admin), step_ms)
         self.detector.register(DiskFailureDetector(admin), step_ms)
         self.facade.detector = self.detector
+        if snapshot_path:
+            from ..core.snapshot import SnapshotManager
+            self.facade.attach_snapshotter(SnapshotManager(
+                snapshot_path,
+                interval_ms=max(snapshot_interval_steps, 1) * step_ms,
+                max_age_ms=snapshot_max_age_ms))
+        if ha_identity:
+            from ..core.leader import LeaderElector
+            self.facade.attach_elector(LeaderElector(
+                admin, ha_identity, lease_ms=ha_lease_steps * step_ms,
+                now_ms=self.engine.now_ms))
+        #: set by :meth:`crash` — a crashed stack must not be driven.
+        self.crashed = False
         #: sampling rounds that raised (chaos-injected; retried next tick)
         self.sampling_failures = 0
         #: detector rounds that raised clear through run_once (the
         #: background loop would log+meter these; the harness counts them)
         self.detector_round_failures = 0
         self.runner.start(self.engine.now_ms(), skip_loading=True)
+        self._restart_kwargs = dict(
+            goals=goals,
+            # The RESOLVED admin + retry policy: a restart must keep any
+            # wrapping admin (the HA fencing ledger) and the configured
+            # backoff, not silently revert to the raw engine defaults.
+            admin=admin, admin_retry=admin_retry,
+            self_healing_threshold_steps=self_healing_threshold_steps,
+            replica_movement_timeout_ms=replica_movement_timeout_ms,
+            stuck_execution_timeout_ms=stuck_execution_timeout_ms,
+            serve_stale_on_incomplete=serve_stale_on_incomplete,
+            fetch_max_retries=fetch_max_retries,
+            snapshot_path=snapshot_path,
+            snapshot_interval_steps=snapshot_interval_steps,
+            snapshot_max_age_ms=snapshot_max_age_ms,
+            ha_identity=ha_identity, ha_lease_steps=ha_lease_steps)
 
     # -------------------------------------------------------------- loop
     def step(self, *, detect: bool = True) -> None:
         """One serving-loop iteration: advance time one step (applying due
-        faults), sample if due, run one detection+healing round."""
+        faults), sample if due, run the HA/snapshot tick, run one
+        detection+healing round."""
         self.engine.tick()
         now = self.engine.now_ms()
         try:
             self.runner.maybe_run_sampling(now)
         except Exception:
             self.sampling_failures += 1
+        # Election + cadenced snapshot write / standby refresh — the
+        # serve.py main-loop tick, on the simulated clock (no-op unless
+        # the harness wired snapshot_path / ha_identity).
+        self.facade.ha_tick(now)
         if detect:
             try:
                 self.detector.run_once(now)
             except Exception:
                 self.detector_round_failures += 1
+
+    # ------------------------------------------------------ crash/restart
+    def crash(self) -> None:
+        """Mark this stack dead (a :class:`~.engine.ProcessCrashed` fault
+        or an explicit hard kill). No teardown runs — threads, locks and
+        the executor reservation are abandoned exactly as a SIGKILL
+        would leave them; the sim cluster (and any in-flight reassignment
+        copies) keeps running on the shared clock."""
+        self.crashed = True
+
+    def restart(self, *, restore: bool = True) -> "ChaosHarness":
+        """Process restart: build a NEW stack over the SAME sim + engine
+        (clock, pending fault schedule, and the cluster's in-flight state
+        persist across the crash) and — when ``restore`` — apply the
+        snapshot the way ``facade.start_up`` does, so the restarted
+        process serves warm. Returns the new harness; the crashed one
+        must not be driven again."""
+        self.crash()
+        h = ChaosHarness(
+            self.sim, engine=self.engine, optimizer=self.facade.optimizer,
+            **self._restart_kwargs)
+        if restore and h.facade.snapshotter is not None:
+            h.facade.restore_from_snapshot(self.engine.now_ms())
+        return h
 
     def run(self, steps: int, *, detect: bool = True) -> None:
         for _ in range(steps):

@@ -68,6 +68,16 @@ def check_invariants(sim, baseline: dict, executor=None, *,
             f"executor reservation not released: state "
             f"{executor.state.value}")
 
+    if executor is not None and getattr(executor, "fence", None) is not None:
+        # Fencing hygiene on the surviving executor: its captured token
+        # must never exceed the elector's current epoch (a token from the
+        # future means epoch bookkeeping went backwards somewhere).
+        token = executor._fence_token
+        if token is not None and token > executor.fence.epoch:
+            problems.append(
+                f"executor fencing token {token} exceeds elector epoch "
+                f"{executor.fence.epoch} (epoch not monotonic)")
+
     if require_healthy:
         offline_fn = getattr(sim, "offline_replicas", None)
         offline = offline_fn() if offline_fn is not None else set()
@@ -88,4 +98,47 @@ def check_invariants(sim, baseline: dict, executor=None, *,
         bad_offline = {(t, p, b) for (t, p, b) in offline}
         if bad_offline:
             problems.append(f"offline replicas remain: {sorted(bad_offline)}")
+    return problems
+
+
+def check_fencing_invariants(stamps) -> list[str]:
+    """Audit a failover run's mutation ledger (chaos/ha.py
+    ``MutationStamp`` list) against the fencing contract:
+
+    - **Epoch monotonicity**: once a mutation under epoch E lands, no
+      mutation under an epoch < E may follow — a deposed leader that
+      keeps mutating after its successor's first write is the dueling-
+      controllers bug fencing exists to prevent.
+    - **Lease-current issuance**: every mutation was issued while its
+      process's lease was locally current (the executor's fence check
+      plus the facade's leadership gate guarantee this; a stamp with
+      ``lease_current=False`` means a mutation escaped both).
+    - **No double-applied proposal**: the same (partition, added-broker)
+      replica placement is never submitted under two different epochs —
+      the new leader recomputes from the live cluster, so a move the old
+      leader already applied (or left in flight) must never be re-issued.
+    """
+    problems: list[str] = []
+    max_epoch = 0
+    adds_seen: dict[tuple, int] = {}   # (tp, broker) -> epoch of first add
+    for s in stamps:
+        if s.epoch < max_epoch:
+            problems.append(
+                f"[{s.now_ms}ms] {s.process}/{s.method}: epoch {s.epoch} "
+                f"after epoch {max_epoch} already mutated (fencing "
+                "monotonicity violated)")
+        max_epoch = max(max_epoch, s.epoch)
+        if not s.lease_current:
+            problems.append(
+                f"[{s.now_ms}ms] {s.process}/{s.method}: mutation issued "
+                f"without a current lease (epoch {s.epoch})")
+        for tp, brokers in (s.adds or {}).items():
+            for b in brokers:
+                first = adds_seen.setdefault((tp, b), s.epoch)
+                if first != s.epoch:
+                    problems.append(
+                        f"[{s.now_ms}ms] {s.process}: replica add "
+                        f"{tp}->{b} re-applied under epoch {s.epoch} "
+                        f"(first applied under epoch {first}) — proposal "
+                        "executed twice across failover")
     return problems

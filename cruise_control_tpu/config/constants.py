@@ -265,6 +265,60 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "bucket + AOT goal-chain compile into the versioned "
                  ".jax_cache/v<N> directory, so steady-state cycles "
                  "dispatch with zero compiles.")
+    d.define("snapshot.path", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="Crash-safe serving-state snapshot file "
+                 "(core/snapshot.py): the resident host mirrors + epoch, "
+                 "monitor generation, cached proposals + freshness "
+                 "stamps, and the HA fencing epoch, written atomically "
+                 "(tmp + fsync + rename) on the snapshot.interval.ms "
+                 "cadence and on clean shutdown; start_up restores it "
+                 "BEFORE prewarm so a restarted process serves "
+                 "generation-valid cached proposals within seconds "
+                 "(docs/operations.md §Snapshot/restore). Corrupt, "
+                 "truncated or version-skewed files are checksum-"
+                 "detected, metered (Snapshot.restore-*) and refused — "
+                 "the process then starts cold, loudly. Empty = "
+                 "snapshots disabled. Standby processes (ha.enabled) "
+                 "poll the same path for the leader's newer snapshots.")
+    d.define("snapshot.interval.ms", ConfigType.LONG, 60_000,
+             validator=Range.at_least(1000), importance=Importance.LOW,
+             doc="Cadence of the leader's snapshot writes. The restart "
+                 "warm-serve window is bounded by one interval of "
+                 "staleness; restored proposals are stale-flagged either "
+                 "way, so execution waits for a live model build.")
+    d.define("snapshot.max.age.ms", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Refuse restoring snapshots older than this (metered "
+                 "Snapshot.restore-stale; the topology has likely moved "
+                 "on). 0 = no age bound — safe because restored results "
+                 "are execution-gated by the stale-model refusal until "
+                 "live samples confirm the topology.")
+    d.define("ha.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM,
+             doc="Warm-standby high availability (core/leader.py): "
+                 "lease-based leader election through the admin "
+                 "backend's topic-config store (reserved topic "
+                 "__cruise_control_ha). One leader owns optimization + "
+                 "execution; standbys restore from the shared "
+                 "snapshot.path and serve reads — execution endpoints "
+                 "answer 503 with the leader's identity. Every admin "
+                 "mutation the executor issues is fenced under the "
+                 "leader's monotonic fencing epoch: a deposed leader's "
+                 "in-flight execution aborts at the next phase boundary "
+                 "(docs/operations.md §HA).")
+    d.define("ha.identity", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="This process's identity in the leader-election record "
+                 "(shown by standbys' 503s and /state ServerRole). "
+                 "Empty = derived from hostname + port + pid.")
+    d.define("ha.lease.ms", ConfigType.LONG, 15_000,
+             validator=Range.at_least(1000), importance=Importance.LOW,
+             doc="Leadership lease duration. Failover detection time is "
+                 "one lease; must comfortably dominate clock skew and "
+                 "serving-loop pauses (a leader that cannot renew "
+                 "self-demotes — and self-fences — at its own "
+                 "deadline).")
     d.define("default.goals", ConfigType.LIST, "",
              importance=Importance.HIGH, doc="Goal chain (empty = built-in)")
     d.define("hard.goals", ConfigType.LIST, "", importance=Importance.MEDIUM,

@@ -388,6 +388,15 @@ class MetricSampleAggregator:
         with self._lock:
             return self._generation
 
+    def seed_generation(self, generation: int) -> None:
+        """Raise the generation counter to at least ``generation`` —
+        snapshot restore (core/snapshot.py): a restarted process resumes
+        the pre-crash numbering so a restored generation-keyed cache is
+        valid until real ingest rolls a window, and every later bump is
+        strictly greater than anything the pre-crash process issued."""
+        with self._lock:
+            self._generation = max(self._generation, int(generation))
+
     @property
     def window_ms(self) -> int:
         return self._window_ms

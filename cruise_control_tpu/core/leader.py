@@ -1,0 +1,248 @@
+"""Warm-standby leader election + fencing through the admin backend.
+
+One leader owns optimization and execution; standby processes restore
+from the shared snapshot (core/snapshot.py) and serve the read endpoints.
+The election medium is the **existing admin backend** — the lease record
+lives in the dynamic config of a reserved topic (``__cruise_control_ha``),
+so any backend implementing the :class:`~cruise_control_tpu.executor.
+admin.ClusterAdminClient` SPI (the simulated cluster, a real Kafka via a
+plugin) carries it with no extra dependency, and chaos-injected admin
+faults exercise the election path like every other RPC.
+
+**Fencing.** Each takeover increments a monotonic ``fencing epoch``; the
+executor captures the epoch at execution start and re-checks
+:meth:`LeaderElector.is_current` at every phase boundary and progress
+poll — a deposed leader's in-flight execution aborts instead of dueling
+with the new leader. ``is_current`` is *local*: it compares against the
+lease deadline this process last wrote, so a paused/partitioned leader
+stops mutating the moment its own lease runs out even when it cannot
+reach the admin backend (the classic GC-pause double-leader scenario).
+The new leader only acquires after that same deadline passes, so the two
+can never overlap (modulo clock skew — ``ha.lease.ms`` must dominate it).
+
+The record is read-modify-write (the admin SPI has no compare-and-set);
+two standbys racing the same expired lease within one read-write window
+could both claim it. Ticks are cheap, leases are many ticks long, and the
+epoch still totally orders any such overlap — acceptable for a control
+plane whose mutations are additionally epoch-fenced, and documented in
+docs/operations.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import time as _time
+
+LOG = logging.getLogger(__name__)
+
+#: reserved topic whose dynamic config carries the lease record.
+HA_TOPIC = "__cruise_control_ha"
+
+#: lease record keys (stored as strings, like every dynamic config).
+_K_LEADER = "ha.leader.id"
+_K_EPOCH = "ha.leader.epoch"
+_K_UNTIL = "ha.lease.until.ms"
+
+#: sensor group for the HA series (``HA.*``).
+HA_SENSOR = "HA"
+
+
+class NotLeaderError(RuntimeError):
+    """An execution endpoint was called on a standby replica. Carries the
+    current leader's identity so the API layer can answer 503 with a
+    redirect hint (the reference pattern for follower-serving systems)."""
+
+    def __init__(self, message: str, leader_id: str | None = None) -> None:
+        super().__init__(message)
+        self.leader_id = leader_id
+
+
+class LeaderElector:
+    """Lease-based election over the admin backend's topic-config store.
+
+    Drive :meth:`tick` on the serving cadence (``facade.ha_tick``); read
+    :meth:`is_leader` / :attr:`epoch` between ticks. Single-writer per
+    process; not thread-safe against concurrent ticks (the facade ticks
+    from one loop)."""
+
+    def __init__(self, admin, identity: str, *, lease_ms: int = 15_000,
+                 now_ms=None, registry=None) -> None:
+        import threading
+
+        from .sensors import MetricRegistry
+        self.admin = admin
+        self.identity = identity
+        self.lease_ms = int(lease_ms)
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        #: serializes tick/keepalive/resign — the serving loop ticks from
+        #: the main thread while a blocked execution keepalives from its
+        #: worker thread.
+        self._tick_lock = threading.Lock()
+        self._role = "standby"
+        #: fencing epoch under which THIS process last held leadership
+        #: (0 = never led); stable across renewals, bumps on takeover.
+        self.epoch = 0
+        #: highest epoch ever observed in the record — the monotonicity
+        #: floor a takeover must exceed (snapshot restore seeds it too,
+        #: so a restarted leader can never reuse a pre-crash epoch even
+        #: when the admin record was lost with the cluster).
+        self.observed_epoch = 0
+        self._lease_until = 0
+        self._last_leader_id: str | None = None
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        self._takeovers = self.registry.counter(name(HA_SENSOR,
+                                                     "takeovers"))
+        self._election_errors = self.registry.meter(
+            name(HA_SENSOR, "election-error-rate"))
+        self.registry.gauge(name(HA_SENSOR, "is-leader"),
+                            lambda: int(self.is_leader()))
+        self.registry.gauge(name(HA_SENSOR, "fencing-epoch"),
+                            lambda: self.epoch or None)
+
+    # ------------------------------------------------------------- reads
+    @property
+    def role(self) -> str:
+        return self._role
+
+    def is_leader(self) -> bool:
+        """Leader AND inside the lease we last wrote. Local-only: a
+        leader that cannot renew self-demotes at its own deadline."""
+        return (self._role == "leader"
+                and self._now_ms() < self._lease_until)
+
+    def is_current(self, token: int | None) -> bool:
+        """The executor's fencing check: does this process still hold
+        leadership under the epoch captured at execution start?"""
+        return token is not None and self.epoch == token \
+            and self.is_leader()
+
+    def leader_id(self) -> str | None:
+        """Last observed leader identity (ourselves when leading)."""
+        return self.identity if self.is_leader() else self._last_leader_id
+
+    def observe_epoch_floor(self, epoch: int) -> None:
+        """Raise the takeover floor (snapshot restore: a pre-crash epoch
+        must never be reused by the restarted process)."""
+        self.observed_epoch = max(self.observed_epoch, int(epoch or 0))
+
+    # -------------------------------------------------------------- tick
+    def tick(self, now_ms: int | None = None) -> str:
+        """One election round: renew our lease, or take over an expired /
+        vacant one, or observe the current leader. Returns the role."""
+        with self._tick_lock:
+            return self._tick_locked(now_ms)
+
+    def keepalive(self, now_ms: int | None = None) -> None:
+        """Pure lease renewal — called from the executor's fence check so
+        a leader blocked in a long execution keeps its lease alive for as
+        long as it is actually running and can reach the admin backend.
+        Strictly weaker than :meth:`tick`: it only ever EXTENDS a lease
+        that is still current, never takes over — a leader that wakes up
+        past its own deadline (the GC-pause scenario) finds its lease
+        gone and the fence check aborts the execution."""
+        now = now_ms if now_ms is not None else self._now_ms()
+        with self._tick_lock:
+            if self._role == "leader" and now < self._lease_until:
+                if self._write(self.epoch, now + self.lease_ms):
+                    self._lease_until = now + self.lease_ms
+
+    def _tick_locked(self, now_ms: int | None = None) -> str:
+        now = now_ms if now_ms is not None else self._now_ms()
+        try:
+            record = self.admin.describe_topic_config(HA_TOPIC)
+        except Exception as exc:   # noqa: BLE001 — admin faults are chaos fodder
+            self._election_errors.mark()
+            LOG.warning("leader-election read failed (%s: %s); %s",
+                        type(exc).__name__, exc,
+                        "holding lease locally" if self._role == "leader"
+                        else "staying standby")
+            # Cannot see the record: a leader keeps leading only while
+            # its own lease holds (is_leader() checks the deadline);
+            # a standby stays standby.
+            if self._role == "leader" and now >= self._lease_until:
+                self._demote("lease expired during election outage")
+            return self._role
+        holder = record.get(_K_LEADER) or None
+        epoch = int(record.get(_K_EPOCH, "0") or 0)
+        until = int(record.get(_K_UNTIL, "0") or 0)
+        self.observed_epoch = max(self.observed_epoch, epoch)
+        self._last_leader_id = holder
+
+        if holder == self.identity and self._role == "leader" \
+                and now < until:
+            # Renewal: same epoch, extended lease.
+            if self._write(self.epoch, now + self.lease_ms):
+                self._lease_until = now + self.lease_ms
+            elif now >= self._lease_until:
+                self._demote("lease expired and renewal failed")
+        elif holder is None or now >= until or holder == self.identity:
+            # Vacant, expired, or OUR OWN lease from a previous
+            # incarnation (a leader that crashed and restarted under the
+            # same identity within its lease): reclaimable immediately —
+            # nobody else can hold it — but only under a strictly higher
+            # epoch, never by "renewing" with this incarnation's epoch 0
+            # (which would both wedge leadership forever and regress the
+            # recorded epoch below the predecessor's mutations).
+            new_epoch = max(epoch, self.observed_epoch, self.epoch) + 1
+            if self._write(new_epoch, now + self.lease_ms):
+                was = self._role
+                self.epoch = new_epoch
+                self.observed_epoch = max(self.observed_epoch, new_epoch)
+                self._lease_until = now + self.lease_ms
+                self._role = "leader"
+                self._last_leader_id = self.identity
+                self._takeovers.inc()
+                LOG.warning(
+                    "%s took leadership (fencing epoch %d, previous "
+                    "holder %s, was %s)", self.identity, new_epoch,
+                    holder or "<none>", was)
+        else:
+            if self._role == "leader":
+                self._demote(f"deposed by {holder} (epoch {epoch})")
+            self._role = "standby"
+        return self._role
+
+    def resign(self, now_ms: int | None = None) -> None:
+        """Clean-shutdown handoff: expire our lease NOW (epoch kept in
+        the record for the successor's floor) so a standby takes over on
+        its next tick instead of waiting out ``ha.lease.ms``."""
+        with self._tick_lock:
+            if self._role != "leader":
+                return
+            if self._write(self.epoch, 0, holder=""):
+                LOG.info("%s resigned leadership (epoch %d)",
+                         self.identity, self.epoch)
+            self._demote("resigned")
+
+    # ----------------------------------------------------------- helpers
+    def _demote(self, why: str) -> None:
+        if self._role == "leader":
+            LOG.warning("%s stepping down to standby: %s (epoch %d)",
+                        self.identity, why, self.epoch)
+        self._role = "standby"
+        self._lease_until = 0
+
+    def _write(self, epoch: int, until_ms: int,
+               holder: str | None = None) -> bool:
+        try:
+            self.admin.alter_topic_config(HA_TOPIC, {
+                _K_LEADER: self.identity if holder is None else holder,
+                _K_EPOCH: str(epoch),
+                _K_UNTIL: str(int(until_ms)),
+            })
+            return True
+        except Exception as exc:   # noqa: BLE001
+            self._election_errors.mark()
+            LOG.warning("leader-election write failed (%s: %s)",
+                        type(exc).__name__, exc)
+            return False
+
+    def to_json(self) -> dict:
+        return {"identity": self.identity,
+                "role": "leader" if self.is_leader() else "standby",
+                "leaderId": self.leader_id(),
+                "fencingEpoch": self.epoch or None,
+                "observedEpoch": self.observed_epoch or None,
+                "leaseUntilMs": self._lease_until or None,
+                "takeovers": self._takeovers.count}

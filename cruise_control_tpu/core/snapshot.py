@@ -1,0 +1,343 @@
+"""Crash-safe serving-state snapshots: versioned, checksummed, atomic.
+
+A restarted control plane used to repay the full cold path — model build,
+AOT warmup, first proposal computation — before ``/proposals`` was warm
+again. :class:`SnapshotManager` persists everything needed to serve warm
+(the resident host mirrors + epoch, the monitor generation, the
+``ProposalCache`` entry with its freshness stamps, the HA fencing epoch)
+so ``facade.start_up`` can restore it *before* ``prewarm()`` and a
+restarted process serves generation-valid cached proposals within
+seconds; restore composes with the persistent ``.jax_cache/v<N>`` so no
+XLA compiles are repaid either (arxiv 1602.03770's stance: restart is a
+stateful reconfiguration, not a cold start).
+
+File format (one file, written atomically — tmp + fsync + ``os.replace``,
+the same discipline as ``analyzer/tuning.py``)::
+
+    <header JSON line>\n<pickle payload bytes>
+
+The header carries the format version, the payload byte length and its
+SHA-256 — a truncated, bit-flipped, or version-skewed file is **detected
+at restore time**, metered (``Snapshot.restore-corrupt`` /
+``-version-skew`` / ``-stale``), logged loudly, and refused: the caller
+then falls back to the cold path. A bad snapshot is never silently
+served.
+
+The payload is an opaque dict — composition lives on the facade
+(:meth:`~cruise_control_tpu.api.facade.KafkaCruiseControl.snapshot_payload`)
+so this module stays free of model/API imports.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import logging
+import os
+import pickle
+import threading
+import time as _time
+
+LOG = logging.getLogger(__name__)
+
+#: bump when the payload composition changes incompatibly; a restore from
+#: any other version is refused (metered) and the process starts cold.
+SNAPSHOT_VERSION = 1
+
+_MAGIC = "ccsnap"
+
+#: sensor group for the snapshot series (``Snapshot.*``).
+SNAPSHOT_SENSOR = "Snapshot"
+
+
+class SnapshotError(Exception):
+    """A snapshot that must not be restored. ``reason`` is one of
+    ``missing | corrupt | version-skew | stale | cluster-mismatch`` —
+    the restore-fallback meter it lands on."""
+
+    def __init__(self, reason: str, message: str) -> None:
+        super().__init__(message)
+        self.reason = reason
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """tmp + fsync + ``os.replace``: the file at ``path`` is always either
+    the previous complete version or the new complete version — a crash
+    mid-write can never leave a torn file (the discipline
+    ``analyzer/tuning.py`` established, with the fsync the durable-state
+    contract additionally requires)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """Atomic JSON persistence for the small durable side files
+    (failed-broker stamps, idempotence cache): a crash mid-``json.dump``
+    straight onto the live file used to leave a torn document that
+    crashed the next load."""
+    atomic_write_bytes(path, json.dumps(obj).encode("utf-8"))
+
+
+#: module prefixes the snapshot payload may legitimately reference:
+#: this package's dataclasses, numpy/jax array reconstruction, and the
+#: stdlib pieces their reduce protocols use. Everything else —
+#: ``os.system``, ``subprocess``, ``builtins.eval`` and the rest of the
+#: classic pickle gadget surface — is refused at unpickle time, so a
+#: writable snapshot path is not arbitrary code execution. (The file is
+#: still part of the control plane's trust boundary, like
+#: ``.jax_cache``: keep it writable by the serving user only; see
+#: docs/operations.md.)
+_ALLOWED_MODULE_PREFIXES = ("cruise_control_tpu.", "numpy", "jax.",
+                            "jaxlib.", "collections", "copyreg",
+                            "_codecs")
+
+#: the only builtins a legitimate payload reduce needs (no getattr /
+#: eval / exec / open / __import__).
+_ALLOWED_BUILTINS = frozenset({
+    "dict", "list", "tuple", "set", "frozenset", "bytearray", "complex",
+    "slice", "range", "object", "int", "float", "bool", "str", "bytes",
+    "NoneType"})
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Allowlisted unpickling for snapshot payloads (see
+    ``_ALLOWED_MODULE_PREFIXES``)."""
+
+    def find_class(self, module, name):
+        if module == "builtins":
+            if name in _ALLOWED_BUILTINS:
+                return super().find_class(module, name)
+        elif any(module == p.rstrip(".") or module.startswith(p)
+                 for p in _ALLOWED_MODULE_PREFIXES):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"snapshot payload references forbidden global "
+            f"{module}.{name} (not in the snapshot allowlist)")
+
+
+def write_snapshot(path: str, payload: dict, *,
+                   now_ms: int | None = None) -> int:
+    """Serialize ``payload`` and write it atomically. Returns the total
+    bytes written. Raises OSError/pickle errors to the caller (the
+    manager meters them)."""
+    body = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    header = {
+        "magic": _MAGIC,
+        "version": SNAPSHOT_VERSION,
+        "payloadBytes": len(body),
+        "sha256": hashlib.sha256(body).hexdigest(),
+        "createdMs": int(now_ms if now_ms is not None
+                         else _time.time() * 1000),
+    }
+    blob = json.dumps(header, sort_keys=True).encode("utf-8") + b"\n" + body
+    atomic_write_bytes(path, blob)
+    return len(blob)
+
+
+def read_snapshot(path: str, *, max_age_ms: int = 0,
+                  now_ms: int | None = None) -> tuple[dict, dict]:
+    """Read + validate a snapshot. Returns ``(header, payload)``; raises
+    :class:`SnapshotError` (with a classified ``reason``) on anything
+    less than a fully-verified, version-current, age-current file.
+
+    Validation order matters: the checksum is verified BEFORE the
+    version/age checks so a corrupt file can never masquerade as a clean
+    version skew (its header bytes are untrusted until the body hash —
+    which covers the declared length — holds)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except FileNotFoundError:
+        raise SnapshotError("missing", f"no snapshot at {path}")
+    except OSError as exc:
+        raise SnapshotError("corrupt", f"unreadable snapshot {path}: {exc}")
+    head, sep, body = raw.partition(b"\n")
+    try:
+        header = json.loads(head)
+        if not isinstance(header, dict) or header.get("magic") != _MAGIC:
+            raise ValueError("bad magic")
+    except ValueError:
+        raise SnapshotError("corrupt",
+                            f"snapshot {path}: unparseable header")
+    if not sep or len(body) != header.get("payloadBytes"):
+        raise SnapshotError(
+            "corrupt",
+            f"snapshot {path}: truncated payload ({len(body)} of "
+            f"{header.get('payloadBytes')} bytes)")
+    if hashlib.sha256(body).hexdigest() != header.get("sha256"):
+        raise SnapshotError("corrupt",
+                            f"snapshot {path}: checksum mismatch")
+    if header.get("version") != SNAPSHOT_VERSION:
+        raise SnapshotError(
+            "version-skew",
+            f"snapshot {path}: version {header.get('version')} != "
+            f"{SNAPSHOT_VERSION} (format changed; starting cold)")
+    if max_age_ms and now_ms is not None:
+        age = now_ms - int(header.get("createdMs", 0))
+        if age > max_age_ms:
+            raise SnapshotError(
+                "stale",
+                f"snapshot {path}: {age} ms old exceeds "
+                f"snapshot.max.age.ms={max_age_ms} (topology has likely "
+                "moved on; starting cold)")
+    try:
+        payload = _RestrictedUnpickler(io.BytesIO(body)).load()
+    except Exception as exc:   # noqa: BLE001 — any unpickle failure = corrupt
+        raise SnapshotError("corrupt",
+                            f"snapshot {path}: payload unpickle failed "
+                            f"({type(exc).__name__}: {exc})")
+    if not isinstance(payload, dict):
+        raise SnapshotError("corrupt",
+                            f"snapshot {path}: payload is not a dict")
+    return header, payload
+
+
+class SnapshotManager:
+    """Cadenced, metered snapshot persistence for one serving process.
+
+    Best-effort on IO like :class:`~cruise_control_tpu.analyzer.tuning.
+    TunedConfigStore`: a write failure is metered + logged (the serving
+    loop must not die for a full disk), a restore failure is metered per
+    reason and the caller starts cold. Thread-safe."""
+
+    def __init__(self, path: str, *, interval_ms: int = 60_000,
+                 max_age_ms: int = 0, registry=None) -> None:
+        from .sensors import MetricRegistry
+        self.path = path
+        self.interval_ms = int(interval_ms)
+        #: 0 = no age bound (a restored snapshot is still execution-gated
+        #: by the stale-model refusal either way; see facade restore).
+        self.max_age_ms = int(max_age_ms)
+        self._lock = threading.Lock()
+        self._last_write_ms: int | None = None
+        self._last_bytes = 0
+        #: createdMs of the newest snapshot this process has WRITTEN or
+        #: RESTORED — the floor `newer_snapshot_available` compares
+        #: against, so a just-deposed leader never "refreshes" from its
+        #: own older file and regresses its live cache.
+        self._seen_created_ms: int | None = None
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        g = SNAPSHOT_SENSOR
+        self._writes = self.registry.counter(name(g, "writes"))
+        self._write_failures = self.registry.meter(
+            name(g, "write-failure-rate"))
+        self._restores = self.registry.counter(name(g, "restores"))
+        #: one meter per refusal class — the alertable signals an operator
+        #: needs to tell "disk bit-rot" from "deploy skew" from "old file"
+        self._fallbacks = {
+            reason: self.registry.meter(name(g, f"restore-{reason}"))
+            for reason in ("corrupt", "version-skew", "stale",
+                           "cluster-mismatch")}
+        self.registry.gauge(name(g, "last-write-ms"),
+                            lambda: self._last_write_ms)
+        self.registry.gauge(name(g, "bytes"), lambda: self._last_bytes)
+
+    # ------------------------------------------------------------ writes
+    def maybe_write(self, now_ms: int, payload_fn) -> bool:
+        """Cadenced write: serialize+persist when ``interval_ms`` has
+        elapsed since the last successful write. ``payload_fn`` is called
+        only when due (payload composition walks the resident mirrors)."""
+        with self._lock:
+            if (self._last_write_ms is not None
+                    and now_ms - self._last_write_ms < self.interval_ms):
+                return False
+        return self.write(now_ms, payload_fn()) is not None
+
+    def write(self, now_ms: int, payload: dict) -> int | None:
+        """Unconditional write (the clean-shutdown path). Returns bytes
+        written, or None on (metered, logged) failure."""
+        try:
+            n = write_snapshot(self.path, payload, now_ms=now_ms)
+        except Exception as exc:   # noqa: BLE001 — serving must survive IO
+            self._write_failures.mark()
+            LOG.warning("snapshot write to %s failed (%s: %s); serving "
+                        "continues, restart will be cold", self.path,
+                        type(exc).__name__, exc)
+            return None
+        with self._lock:
+            self._last_write_ms = now_ms
+            self._last_bytes = n
+            self._seen_created_ms = max(self._seen_created_ms or 0,
+                                        int(now_ms))
+        self._writes.inc()
+        LOG.debug("snapshot written to %s (%d bytes)", self.path, n)
+        return n
+
+    # ----------------------------------------------------------- restore
+    def restore(self, now_ms: int, validate=None) -> dict | None:
+        """Read+validate the snapshot. Returns the payload, or None after
+        metering + loudly logging the refusal (missing file is the quiet
+        first-boot case). ``validate(payload)`` — returning ``None`` to
+        accept or ``(reason, message)`` to refuse — runs the caller's
+        domain checks (cluster identity) BEFORE this manager counts the
+        restore or marks the file as seen: a refused snapshot must land
+        only on its refusal meter, never on ``restores``."""
+        try:
+            header, payload = read_snapshot(self.path,
+                                            max_age_ms=self.max_age_ms,
+                                            now_ms=now_ms)
+        except SnapshotError as exc:
+            if exc.reason == "missing":
+                LOG.info("no snapshot at %s; starting cold", self.path)
+            else:
+                self._fallbacks[exc.reason].mark()
+                LOG.error("snapshot restore REFUSED (%s): %s — falling "
+                          "back to the cold start path", exc.reason, exc)
+            return None
+        if validate is not None:
+            refusal = validate(payload)
+            if refusal is not None:
+                self.refuse(*refusal)
+                return None
+        self._restores.inc()
+        with self._lock:
+            self._seen_created_ms = max(self._seen_created_ms or 0,
+                                        int(header.get("createdMs", 0)))
+        return payload
+
+    def refuse(self, reason: str, message: str) -> None:
+        """Domain-level restore refusal (e.g. cluster-id mismatch): same
+        metering + loud logging as the format-level checks."""
+        self._fallbacks[reason].mark()
+        LOG.error("snapshot restore REFUSED (%s): %s — falling back to "
+                  "the cold start path", reason, message)
+
+    def newer_snapshot_available(self) -> bool:
+        """Whether the file on disk was created after anything this
+        manager wrote or restored — the standby's cheap poll (one open +
+        one header line read; the payload is not touched). A deposed
+        leader polling its OWN last snapshot sees False: restoring it
+        would regress the live cache to an interval-old state."""
+        with self._lock:
+            seen = self._seen_created_ms
+        try:
+            with open(self.path, "rb") as f:
+                head = io.BufferedReader(f).readline()
+            header = json.loads(head)
+            created = int(header.get("createdMs", 0))
+        except (OSError, ValueError):
+            return False
+        return seen is None or created > seen
+
+    def to_json(self) -> dict:
+        """The ``snapshot`` section of ``/devicestats``."""
+        with self._lock:
+            return {
+                "path": self.path,
+                "intervalMs": self.interval_ms,
+                "maxAgeMs": self.max_age_ms or None,
+                "writes": self._writes.count,
+                "writeFailures": self._write_failures.count,
+                "restores": self._restores.count,
+                "restoreFallbacks": {r: m.count
+                                     for r, m in self._fallbacks.items()},
+                "lastWriteMs": self._last_write_ms,
+                "bytes": self._last_bytes or None,
+            }

@@ -13,16 +13,20 @@ from __future__ import annotations
 
 import fnmatch
 import json
+import logging
 import os
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from ..core.anomaly import PercentileMetricAnomalyFinder
+from ..core.snapshot import atomic_write_json
 from ..core.metricdef import BrokerMetric
 from .anomalies import (BrokerFailures, DiskFailures, GoalViolations,
                         KafkaMetricAnomaly, MaintenanceEvent, SlowBrokers,
                         TopicReplicationFactorAnomaly)
+
+LOG = logging.getLogger(__name__)
 
 
 class BrokerFailureDetector:
@@ -42,9 +46,20 @@ class BrokerFailureDetector:
         self.persist_path = persist_path
         self._failed_since: dict[int, int] = {}
         if persist_path and os.path.exists(persist_path):
-            with open(persist_path, encoding="utf-8") as f:
-                self._failed_since = {int(k): int(v)
-                                      for k, v in json.load(f).items()}
+            # A corrupt/torn/empty stamp file must not crash the detector
+            # thread at 3am: warn + start fresh (the stamps only widen
+            # the notifier thresholds — recoverable state, unlike the
+            # failures it tracks). Writes are atomic now, but files
+            # written by the pre-atomic code (or a full disk) survive.
+            try:
+                with open(persist_path, encoding="utf-8") as f:
+                    self._failed_since = {int(k): int(v)
+                                          for k, v in json.load(f).items()}
+            except (OSError, ValueError) as exc:
+                LOG.warning(
+                    "failed-broker stamp file %s unreadable (%s: %s); "
+                    "starting with empty failure history", persist_path,
+                    type(exc).__name__, exc)
 
     def detect(self, now_ms: int) -> list[BrokerFailures]:
         alive = self.admin.describe_cluster()
@@ -61,9 +76,14 @@ class BrokerFailureDetector:
                                failed_brokers=dict(self._failed_since))]
 
     def _persist(self) -> None:
+        # Atomic (tmp + fsync + rename): a crash mid-dump used to leave a
+        # torn JSON document on the LIVE file, poisoning the next start.
         if self.persist_path:
-            with open(self.persist_path, "w", encoding="utf-8") as f:
-                json.dump(self._failed_since, f)
+            try:
+                atomic_write_json(self.persist_path, self._failed_since)
+            except OSError as exc:
+                LOG.warning("could not persist failed-broker stamps to "
+                            "%s: %s", self.persist_path, exc)
 
 
 class DiskFailureDetector:
@@ -252,17 +272,25 @@ class IdempotenceCache:
         self._now_ms = now_ms or (lambda: int(_t.time() * 1000))
         self._seen: dict[str, int] = {}
         if persist_path:
+            # OSError included: any unreadable/torn cache degrades to an
+            # empty one (duplicates within the retention window may then
+            # re-execute — the documented trade for not crashing).
             try:
                 with open(persist_path, encoding="utf-8") as f:
                     self._seen = {k: int(v)
                                   for k, v in json.load(f).items()}
-            except (FileNotFoundError, ValueError):
+            except (OSError, ValueError):
                 pass
 
     def _persist(self) -> None:
+        # Atomic like the failed-broker stamps: a torn idempotence cache
+        # is worse than an empty one (it crashes the reader), and a LOST
+        # one re-executes accepted plans.
         if self.persist_path:
-            with open(self.persist_path, "w", encoding="utf-8") as f:
-                json.dump(self._seen, f)
+            try:
+                atomic_write_json(self.persist_path, self._seen)
+            except OSError:
+                pass   # best-effort, same contract as the tolerant load
 
     def _prune(self, now: int) -> None:
         cutoff = now - self.retention_ms

@@ -268,6 +268,17 @@ class Executor:
             _n(EXECUTOR_SENSOR, "teardown-failure-rate"))
         self._watchdog_aborts = self.registry.counter(
             _n(EXECUTOR_SENSOR, "watchdog-forced-aborts"))
+        self._fencing_aborts = self.registry.counter(
+            _n(EXECUTOR_SENSOR, "fencing-forced-aborts"))
+        #: leadership fence (core/leader.py LeaderElector, or any object
+        #: with ``epoch`` + ``is_current(token)``). When set, every
+        #: execution captures the fencing epoch at start and re-checks it
+        #: at each phase boundary / progress poll: a deposed leader's
+        #: in-flight execution aborts instead of dueling with the new
+        #: leader. None = unfenced (single-process default).
+        self.fence = None
+        self._fence_token: int | None = None
+        self._fenced = False
         self._exec_started_ms = 0
         self.registry.gauge(
             _n(EXECUTOR_SENSOR, "has-ongoing-execution"),
@@ -367,9 +378,38 @@ class Executor:
                 self._current_uuid or "(no-uuid)", elapsed, deadline)
             self._stop_requested.set()
 
+    def _fence_check(self) -> None:
+        """Leadership fence: an execution whose fencing epoch is no
+        longer current (this process lost, resigned, or outlived its
+        lease) stops mutating at the next check point — the stop flag
+        aborts every phase loop, and the abort path skips cluster-side
+        cancellations (see _abort_in_flight) so the only process issuing
+        admin mutations is the new leader."""
+        if (self.fence is None or self._fenced
+                or self._stop_requested.is_set()):
+            return
+        # Keep the lease alive while we are demonstrably running: a
+        # leader blocked in a long execution renews from its own poll
+        # loop (renew-only — a lease that already lapsed stays lapsed,
+        # so a paused process still fences below).
+        keepalive = getattr(self.fence, "keepalive", None)
+        if keepalive is not None:
+            keepalive(self._now_ms())
+        if not self.fence.is_current(self._fence_token):
+            self._fenced = True
+            self._fencing_aborts.inc()
+            OPERATION_LOG.error(
+                "Execution %s FENCED: fencing epoch %s is no longer "
+                "current (leadership lost); aborting at the next phase "
+                "boundary without cluster-side cancellation",
+                self._current_uuid or "(no-uuid)", self._fence_token)
+            self._stop_requested.set()
+
     def state_json(self) -> dict:
         """Serialized for the /state endpoint (ref ExecutorState.java)."""
         out = {"state": self._state.value}
+        if self.fence is not None:
+            out["fencingEpoch"] = self._fence_token
         tm = self._task_manager
         if tm is not None:
             out["taskSummary"] = tm.tracker.summary()
@@ -436,6 +476,16 @@ class Executor:
             from dataclasses import replace as _dc_replace
             cc = _dc_replace(cc, **concurrency_overrides)
         self._check_movement_cap(cc)
+        # Leadership gate BEFORE the reservation: a standby (or a leader
+        # whose lease already lapsed) must refuse outright, not consume
+        # the single-execution slot and abort one poll later.
+        if self.fence is not None \
+                and not self.fence.is_current(self.fence.epoch):
+            from ..core.leader import NotLeaderError
+            raise NotLeaderError(
+                "refusing execution: this process does not hold the "
+                "leadership lease",
+                leader_id=getattr(self.fence, "leader_id", lambda: None)())
         with self._lock:
             if self.has_ongoing_execution():
                 raise OngoingExecutionError(
@@ -447,6 +497,12 @@ class Executor:
         started = self._now_ms()
         self._exec_started_ms = started
         self._executions_started.inc()
+        # Fencing epoch captured ONCE at start: every later check compares
+        # against this token, so a takeover mid-execution (epoch moved)
+        # fences even if this process later wins leadership back.
+        self._fenced = False
+        self._fence_token = (self.fence.epoch if self.fence is not None
+                             else None)
         uid = uuid or "(no-uuid)"
         tm = self._task_manager
         throttler = ReplicationThrottleHelper(
@@ -499,10 +555,12 @@ class Executor:
             self.notifier.on_execution_started(uuid)
             OPERATION_LOG.info(
                 "Execution %s started: %d inter-broker, %d intra-broker, "
-                "%d leadership tasks", uid, len(inter),
+                "%d leadership tasks%s", uid, len(inter),
                 len(intra_broker_moves or []),
                 sum(1 for t in tasks
-                    if t.task_type is TaskType.LEADER_ACTION))
+                    if t.task_type is TaskType.LEADER_ACTION),
+                (f" (fencing epoch {self._fence_token})"
+                 if self._fence_token is not None else ""))
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
             with self.tracer.span("executor.inter-broker-phase"):
                 self._run_inter_broker_phase(planner, concurrency, adjuster,
@@ -520,13 +578,28 @@ class Executor:
                 OPERATION_LOG.info(
                     "Execution %s: leadership phase complete", uid)
         finally:
+            # A simulated hard process crash (chaos crash_process fault)
+            # must behave like a real one: no teardown, no cleanup RPCs,
+            # state abandoned exactly as the dying process would leave it
+            # — the restart-from-snapshot path owns recovery.
+            if getattr(sys.exc_info()[1], "simulates_process_crash",
+                       False):
+                raise
             try:
                 stopped = self._stop_requested.is_set()
                 if stopped:
                     self._state = ExecutorState.STOPPING_EXECUTION
                     self._abort_in_flight()
-                self._teardown_call("clearThrottles",
-                                    throttler.clear_throttles)
+                if self._fenced:
+                    # Throttle configs now belong to the new leader —
+                    # a deposed epoch must not clear them (see the
+                    # fenced-abort note in _abort_in_flight).
+                    OPERATION_LOG.warning(
+                        "Fenced abort: leaving replication throttles to "
+                        "the new leader")
+                else:
+                    self._teardown_call("clearThrottles",
+                                        throttler.clear_throttles)
                 if removed_brokers:
                     self.recently_removed_brokers |= removed_brokers
                 if demoted_brokers:
@@ -578,6 +651,11 @@ class Executor:
         planner.begin_phase(tm.tracker.tasks_in(tt, TaskState.PENDING), ctx)
         while (tm.tracker.num_remaining(tt) > 0
                and not self._stop_requested.is_set()):
+            # Fence BEFORE building/submitting a batch: a deposed leader
+            # must not issue one more mutation on its way out.
+            self._fence_check()
+            if self._stop_requested.is_set():
+                break
             pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
             in_progress = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
             batch = planner.inter_broker_batch(pending, in_progress,
@@ -605,6 +683,9 @@ class Executor:
                 break
             self._sleep_ms(self._progress_interval_ms)
             self._watchdog_check()
+            self._fence_check()
+            if self._fenced:
+                break   # no more RPCs — the poll itself issues cancels
             self._poll_inter_broker_progress()
             self._maybe_alert_slow_tasks()
             now = self._now_ms()
@@ -628,6 +709,7 @@ class Executor:
         # is still a member of the new replica set; proposals that also
         # demand a leader change finish with a preferred election (the
         # reassignment made new_replicas[0] the preferred replica).
+        self._fence_check()
         needs_election = [
             t.topic_partition
             for t in tm.tracker.tasks_in(tt, TaskState.COMPLETED)
@@ -698,6 +780,9 @@ class Executor:
         tt = TaskType.INTRA_BROKER_REPLICA_ACTION
         while (tm.tracker.num_remaining(tt) > 0
                and not self._stop_requested.is_set()):
+            self._fence_check()
+            if self._stop_requested.is_set():
+                break
             pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
             in_progress = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
             batch = planner.intra_broker_batch(pending, in_progress, concurrency)
@@ -738,6 +823,9 @@ class Executor:
         tt = TaskType.LEADER_ACTION
         while (tm.tracker.num_remaining(tt) > 0
                and not self._stop_requested.is_set()):
+            self._fence_check()
+            if self._stop_requested.is_set():
+                break
             pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
             batch = planner.leadership_batch(pending, concurrency)
             if not batch:
@@ -793,7 +881,18 @@ class Executor:
                     cancels[t.topic_partition] = None
                 tm.tracker.transition(t, TaskState.ABORTING, now)
                 aborting.append(t)
-        if cancels:
+        if cancels and self._fenced:
+            # A FENCED abort issues no cluster-side cancellations: the
+            # new leader already owns those partitions and a late cancel
+            # from the deposed epoch could kill ITS reassignments — the
+            # exact duel fencing exists to prevent. In-flight copies
+            # either complete (Kafka keeps streaming) or the new leader
+            # manages them; tasks still transition ABORTED locally.
+            OPERATION_LOG.warning(
+                "Fenced abort: leaving %d in-flight reassignment(s) to "
+                "the new leader (no cancellation RPC issued)",
+                len(cancels))
+        elif cancels:
             self._teardown_call("cancelInFlightReassignments",
                                 self.admin.alter_partition_reassignments,
                                 cancels)

@@ -120,6 +120,7 @@ class ResidentClusterState:
         self.full_rebuilds = 0
         self.delta_cycles = 0
         self.noop_cycles = 0
+        self.restores = 0
         self.last_update: str | None = None      # "full" | "delta" | "noop"
         self.last_delta_rows = 0
         self.last_delta_bytes = 0
@@ -216,6 +217,41 @@ class ResidentClusterState:
             k *= 2
         return min(k, padded)
 
+    # -------------------------------------------------- snapshot/restore
+    def export_state(self) -> tuple[int, dict[str, np.ndarray]] | None:
+        """``(epoch, host mirrors)`` for the crash-safe snapshot
+        (core/snapshot.py), or None before the first full rebuild. The
+        mirrors are returned by reference — they are never mutated in
+        place (update() replaces them wholesale), so the snapshot writer
+        may serialize them without copying."""
+        with self._lock:
+            if self._model is None:
+                return None
+            return self.epoch, dict(self._host)
+
+    def restore(self, epoch: int, arrays: dict[str, np.ndarray]) -> None:
+        """Rebuild the resident device buffers from a snapshot's host
+        mirrors. The device model is bit-identical to the pre-crash one
+        by construction (``from_numpy`` is deterministic over the same
+        host arrays); the epoch resumes at ``max(saved, current)`` so
+        post-restore structural changes still bump monotonically. Counts
+        as a ``restore``, not a full rebuild — dashboards can tell a
+        warm restart from a structural churn storm."""
+        from .flat import FlatClusterModel
+        with self._lock, self.tracer.span("resident.restore"):
+            self._model = FlatClusterModel.from_numpy(mesh=self.mesh,
+                                                      **arrays)
+            self._host = dict(arrays)
+            self.epoch = max(self.epoch, int(epoch))
+            self.restores += 1
+            self.last_update = "restore"
+            self.last_delta_rows = 0
+            self.last_delta_bytes = 0
+            self.last_full_bytes = sum(int(a.nbytes)
+                                       for a in arrays.values())
+            LOG.info("resident state restored from snapshot (epoch %d, "
+                     "%d bytes uploaded)", self.epoch, self.last_full_bytes)
+
     # ------------------------------------------------------------ warmup
     def warmup(self) -> bool:
         """Pre-compile the delta-ingest program for the smallest row
@@ -263,6 +299,7 @@ class ResidentClusterState:
             "fullRebuilds": self.full_rebuilds,
             "deltaCycles": self.delta_cycles,
             "noopCycles": self.noop_cycles,
+            "restores": self.restores,
             "lastUpdate": self.last_update,
             "lastDeltaRows": self.last_delta_rows,
             "lastDeltaBytes": self.last_delta_bytes,

@@ -359,6 +359,13 @@ class LoadMonitor:
         proposal cache's staleness key, ref ModelGeneration)."""
         return self.partition_aggregator.generation
 
+    def seed_generation(self, generation: int) -> None:
+        """Snapshot restore: resume the pre-crash generation numbering
+        (monotonic raise — see MetricSampleAggregator.seed_generation)
+        so the restored proposal cache is generation-valid until real
+        sample ingest rolls a window."""
+        self.partition_aggregator.seed_generation(generation)
+
     def retain_current_topology(self) -> None:
         """Drop aggregator state for partitions no longer in the cluster
         (ref LoadMonitor's aggregator cleaner :813)."""

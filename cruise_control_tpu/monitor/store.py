@@ -10,12 +10,15 @@ the SPI is the same store/replay contract (``SampleStore.java``).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 from typing import Protocol
 
 from .sampler import Samples
 from .samples import BrokerMetricSample, PartitionMetricSample
+
+LOG = logging.getLogger(__name__)
 
 
 class SampleStore(Protocol):
@@ -52,6 +55,11 @@ class FileSampleStore:
         os.makedirs(directory, exist_ok=True)
         self._dir = directory
         self._retention_ms = retention_ms
+        #: records skipped on replay because the line would not parse —
+        #: a crash mid-append leaves a torn trailing line; it used to
+        #: poison the whole replay (one json.loads error killed the
+        #: LOADING state). Metered here, surfaced via the warning log.
+        self.skipped_records = 0
         self._lock = threading.Lock()
         self._pfile = open(os.path.join(directory, "partition_samples.jsonl"),
                            "a", encoding="utf-8")
@@ -118,16 +126,32 @@ class FileSampleStore:
             bsamples = [s for s in bsamples if s.time_ms >= horizon]
         return Samples(psamples, bsamples)
 
-    @staticmethod
-    def _read(path: str, parse):
+    def _read(self, path: str, parse):
         out = []
         if not os.path.exists(path):
             return out
+        skipped = 0
         with open(path, encoding="utf-8") as f:
             for line in f:
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                # Crash-tolerance: a process dying mid-append leaves a
+                # torn trailing line (and, on weirder filesystems, a
+                # NUL-padded hole). Skip + meter the unparseable record
+                # instead of failing the whole replay — losing one
+                # sample is noise; losing N hours of history repays the
+                # entire warm-in.
+                try:
                     out.append(parse(json.loads(line)))
+                except (ValueError, KeyError, TypeError):
+                    skipped += 1
+        if skipped:
+            self.skipped_records += skipped
+            LOG.warning(
+                "sample replay from %s skipped %d unparseable record(s) "
+                "(torn append from a crash mid-write; replay continues "
+                "with the remaining history)", path, skipped)
         return out
 
     def close(self) -> None:

@@ -163,6 +163,28 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             config.get_string("fleet.cluster.id"), monitor,
             proposal_cache=facade.proposal_cache)
 
+    # Crash-safe snapshots + warm-standby HA (docs/operations.md
+    # §Snapshot/restore & HA): the manager restores in start_up (before
+    # prewarm) and writes on the ha_tick cadence in main(); the elector
+    # fences the executor under its epoch.
+    snap_path = config.get_string("snapshot.path")
+    if snap_path:
+        from .core.snapshot import SnapshotManager
+        facade.attach_snapshotter(SnapshotManager(
+            snap_path,
+            interval_ms=config.get_long("snapshot.interval.ms"),
+            max_age_ms=config.get_long("snapshot.max.age.ms")))
+    if config.get_boolean("ha.enabled"):
+        import os as _os
+        import socket as _socket
+
+        from .core.leader import LeaderElector
+        identity = config.get_string("ha.identity") or (
+            f"{_socket.gethostname()}:"
+            f"{config.get_int('webserver.http.port')}-{_os.getpid()}")
+        facade.attach_elector(LeaderElector(
+            admin, identity, lease_ms=config.get_long("ha.lease.ms")))
+
     # ref self.healing.goals + the reference's startup sanity check
     # (KafkaCruiseControlConfig sanityCheckGoalNames): a configured
     # self-healing chain must cover every registered hard goal, or fixes
@@ -639,6 +661,14 @@ def main(argv=None) -> int:
                 runner.maybe_run_sampling(now)
             except Exception:
                 pass   # transient sampler failure: retry next tick
+            try:
+                # Election + cadenced snapshot write (leader) / newer-
+                # snapshot refresh (standby); no-op when neither is on.
+                app.facade.ha_tick(now)
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "ha/snapshot tick failed; retrying next tick",
+                    exc_info=True)
     finally:
         app.stop()
     return 0

@@ -897,3 +897,57 @@ def test_openapi_simulate_and_trace_round_trip(stack):
     for ref in refs(spec):
         assert ref.startswith("#/components/schemas/"), ref
         assert ref.rsplit("/", 1)[1] in schemas, ref
+
+
+def test_state_carries_server_role(stack):
+    """Every /state response leads with ServerRole — single-process mode
+    reports an unconditional leader with HA disabled."""
+    _, _, app = stack
+    status, body, _ = call(app, "GET", "state")
+    assert status == 200
+    assert body["ServerRole"] == {"enabled": False, "role": "leader",
+                                  "leaderId": None, "fencingEpoch": None}
+
+
+def test_standby_execution_returns_503_with_leader_id(stack):
+    """A standby replica answers execution endpoints with 503 + the
+    leader's identity (clients/LBs redirect there), keeps serving reads,
+    and reports its role on /state — the HTTP face of NotLeaderError."""
+    from cruise_control_tpu.core.leader import HA_TOPIC, LeaderElector
+    sim, facade, app = stack
+    # A real elector observing a lease held by another process.
+    sim.alter_topic_config(HA_TOPIC, {
+        "ha.leader.id": "other-process:9090-1",
+        "ha.leader.epoch": "5",
+        "ha.lease.until.ms": str(10**15)})
+    elector = LeaderElector(sim, "this-process", now_ms=lambda: 4000)
+    facade.attach_elector(elector)
+    try:
+        assert elector.tick(4000) == "standby"
+        # The refusal lands when the async task completes: poll 202s
+        # through with the task id like any client.
+        status, body, headers = call(app, "POST", "rebalance",
+                                     "dryrun=false", expect=503)
+        for _ in range(120):
+            if status != 202:
+                break
+            time.sleep(0.5)
+            status, body, headers = call(
+                app, "POST", "rebalance", "dryrun=false",
+                headers={"User-Task-ID": body["userTaskId"]}, expect=503)
+        assert status == 503, (status, body)
+        assert body["leaderId"] == "other-process:9090-1"
+        assert "standby" in body["errorMessage"]
+        # Reads keep flowing on the standby.
+        status, body, _ = call(app, "POST", "rebalance", "dryrun=true")
+        assert status == 200
+        status, body, _ = call(app, "GET", "state")
+        assert body["ServerRole"]["role"] == "standby"
+        assert body["ServerRole"]["leaderId"] == "other-process:9090-1"
+    finally:
+        facade.elector = None
+        facade.executor.fence = None
+        facade.extra_registries.remove(elector.registry)
+        sim.alter_topic_config(HA_TOPIC, {"ha.leader.id": None,
+                                          "ha.lease.until.ms": None,
+                                          "ha.leader.epoch": None})

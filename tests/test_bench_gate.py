@@ -205,3 +205,23 @@ def test_scale_tier_gate_smoke():
     assert out["warm_cycle"].get("d2hBytes", 0) > 0
     assert not out["budget"]["paddingOverBudget"]
     assert out["padding"]["partitionWastePct"] < bench.SCALE_PADDING_BUDGET_PCT
+
+
+def test_snapshot_restore_bench_smoke_gate():
+    """run_snapshot_restore_bench on a toy cluster: exercises the cold
+    start -> snapshot -> fresh-process restore harness end-to-end with
+    its always-on exactness gates (bit-identical proposals, generation-
+    valid cache, zero compiles on the restored path, stale-flagged
+    result — the helper raises otherwise). Tier-1 safe: the >= 5x
+    restore-vs-cold gate is judged at bench scale only (gate=False here
+    — the suite's shared compiled chains make the toy cold path
+    artificially cheap)."""
+    import bench
+    out = bench.run_snapshot_restore_bench(
+        num_brokers=8, num_partitions=96,
+        goal_names=["ReplicaDistributionGoal"],
+        emit_row=False, gate=False)
+    assert out["identical"] is True
+    assert out["recompiles"] == 0
+    assert out["restore_s"] > 0 and out["cold_s"] > 0
+    assert out["snapshot_bytes"] > 0

@@ -559,3 +559,251 @@ def test_whatif_prediction_matches_post_kill_reality(optimizer, chaos_seed):
     drive_to_health(h, base,
                     "test_whatif_prediction_matches_post_kill_reality",
                     budget=150)
+
+
+# ------------------------------------- process-level faults (PR 12):
+# the control plane itself crashes, restarts from snapshot, and fails
+# over between leader and warm standby under the fencing contract.
+
+def make_slow_harness(optimizer, seed, tmp_path, *, rate_mb_s=5.0,
+                      **kwargs):
+    """Skewed topology at a SLOW copy rate (each move spans steps), so a
+    scheduled process crash always lands with copies in flight, plus the
+    snapshot manager at a 1-step cadence."""
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=rate_mb_s,
+                       logdirs=("logdir0", "logdir1"))
+    for p in range(16):
+        sim.add_partition(f"t{p % 3}", p, [p % 2, (p + 1) % 2],
+                          size_mb=10.0 + p)
+    return ChaosHarness(sim, seed=seed, optimizer=optimizer,
+                        snapshot_path=str(tmp_path / "cc.snapshot"),
+                        **kwargs)
+
+
+def test_process_crash_midexecution_restarts_warm(optimizer, chaos_seed,
+                                                  tmp_path):
+    """Crash-at-step: the control plane dies mid-execution (no teardown,
+    no cleanup RPCs — a SIGKILL), the cluster keeps streaming its
+    in-flight copies, and the restarted process restores the snapshot,
+    serves the pre-crash proposals warm with zero XLA compiles, and
+    drives the cluster back to health."""
+    from cruise_control_tpu.chaos import ProcessCrashed
+    h = make_slow_harness(optimizer, _pick(chaos_seed, 7), tmp_path)
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    pre = h.facade.proposals()
+    assert pre.proposals
+    h.step(detect=False)                   # cadenced snapshot write
+    h.engine.schedule(h.engine.step + 2, "crash_process")
+    with pytest.raises(ProcessCrashed):
+        h.facade.rebalance(dryrun=False, options=OptimizationOptions(seed=0),
+                           ignore_proposal_cache=True)
+    assert h.sim.list_partition_reassignments(), (
+        "the crash must land with copies in flight\n"
+        + _repro("test_process_crash_midexecution_restarts_warm",
+                 h.engine.seed))
+
+    before = h.facade.device_stats.snapshot()
+    h2 = h.restart()
+    served = h2.facade.proposals()
+    assert [p.to_json() for p in served.proposals] == \
+        [p.to_json() for p in pre.proposals]
+    after = h2.facade.device_stats.snapshot()
+    assert after["compileEvents"] == before["compileEvents"]
+    assert after["aotCompileEvents"] == before["aotCompileEvents"]
+    # The restart resumes the loop: in-flight copies finish on the sim
+    # side, detection/healing clean up the remainder.
+    try:
+        h2.steps_until(h2.healed, 200, what="post-restart recovery")
+    except AssertionError as exc:
+        raise AssertionError(
+            f"{exc}\n"
+            + _repro("test_process_crash_midexecution_restarts_warm",
+                     h.engine.seed)) from None
+    assert_invariants(h2, base,
+                      "test_process_crash_midexecution_restarts_warm")
+
+
+def test_leader_kill_failover_no_double_apply(optimizer, chaos_seed,
+                                              tmp_path):
+    """Leader-kill-with-failover: the leader crashes mid-execution, the
+    standby waits out the lease, takes over under a higher fencing
+    epoch, recomputes from the LIVE cluster and executes — and the
+    mutation ledger proves no proposal executed twice and the epochs
+    never went backwards."""
+    from cruise_control_tpu.chaos import (HAFailoverHarness, ProcessCrashed,
+                                          check_fencing_invariants)
+    seed = _pick(chaos_seed, 9)
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=5.0, logdirs=("logdir0", "logdir1"))
+    for p in range(16):
+        sim.add_partition(f"t{p % 3}", p, [p % 2, (p + 1) % 2],
+                          size_mb=10.0 + p)
+    ha = HAFailoverHarness(seed=seed, snapshot_dir=str(tmp_path), sim=sim,
+                           optimizer=optimizer)
+    base = snapshot_topology(ha.sim)
+    for _ in range(12):
+        ha.step()
+    leader = ha.leader()
+    assert leader is not None
+    lh = ha.procs[leader]
+
+    lh.engine.schedule(lh.engine.step + 2, "crash_process")
+    with pytest.raises(ProcessCrashed):
+        lh.facade.rebalance(dryrun=False, options=OptimizationOptions(seed=0),
+                            ignore_proposal_cache=True)
+    ha.kill(leader)
+    old_epoch = lh.facade.elector.epoch
+
+    standby = next(p for p in ha.procs if p != leader)
+    ha.steps_until(lambda: ha.leader() == standby, 30, what="failover")
+    sh = ha.procs[standby]
+    assert sh.facade.elector.epoch > old_epoch
+    for _ in range(6):
+        ha.step()                          # windows roll on the new leader
+    res, exec_res = sh.facade.rebalance(
+        dryrun=False, options=OptimizationOptions(seed=0),
+        ignore_proposal_cache=True)
+    assert exec_res is not None
+    for _ in range(5):
+        ha.step()
+
+    problems = check_fencing_invariants(ha.stamps)
+    assert not problems, (
+        f"fencing invariants violated (seed={seed}):\n  "
+        + "\n  ".join(problems)
+        + "\n" + _repro("test_leader_kill_failover_no_double_apply", seed))
+    epochs = {s.epoch for s in ha.stamps}
+    assert len(epochs) >= 2, "both reigns must have mutated"
+    assert_invariants(sh, base, "test_leader_kill_failover_no_double_apply")
+
+
+def test_deposed_leader_fences_without_cancel_rpcs(optimizer, chaos_seed,
+                                                   tmp_path):
+    """The GC-pause double-leader scenario: the clock leaps past the
+    lease mid-execution; the executor's fence check finds the lease gone
+    and aborts at the next phase boundary WITHOUT issuing cancellation
+    RPCs (the in-flight copies now belong to the successor), releasing
+    the reservation and demoting to standby."""
+    from cruise_control_tpu.chaos import (HAFailoverHarness,
+                                          check_fencing_invariants)
+    seed = _pick(chaos_seed, 21)
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=5.0, logdirs=("logdir0", "logdir1"))
+    for p in range(16):
+        sim.add_partition(f"t{p % 3}", p, [p % 2, (p + 1) % 2],
+                          size_mb=10.0 + p)
+    ha = HAFailoverHarness(seed=seed, snapshot_dir=str(tmp_path), sim=sim,
+                           optimizer=optimizer, lease_steps=4)
+    for _ in range(12):
+        ha.step()
+    leader = ha.leader()
+    lh = ha.procs[leader]
+    lh.engine.schedule(lh.engine.step + 2, "clock_jump",
+                       ms=6 * lh.engine.step_ms)
+    res, exec_res = lh.facade.rebalance(
+        dryrun=False, options=OptimizationOptions(seed=0),
+        ignore_proposal_cache=True)
+    assert lh.executor._fencing_aborts.count == 1
+    assert not lh.executor.has_ongoing_execution()   # reservation released
+    assert lh.facade.ha_role() == "standby"
+    counts = exec_res.state_counts["INTER_BROKER_REPLICA_ACTION"]
+    assert counts.get("ABORTED", 0) > 0
+    # No cancellation RPC was issued: the in-flight copies are still
+    # streaming on the cluster after the fenced abort returned.
+    assert ha.sim.list_partition_reassignments(), (
+        "fenced abort must leave in-flight reassignments to the successor"
+        + "\n" + _repro("test_deposed_leader_fences_without_cancel_rpcs",
+                        seed))
+    ha.steps_until(lambda: ha.leader() is not None, 30, what="re-election")
+    assert not check_fencing_invariants(ha.stamps)
+
+
+def test_standby_serves_warm_reads_refuses_execution(optimizer, chaos_seed,
+                                                     tmp_path):
+    """The warm-standby serving contract: the standby refreshes from the
+    leader's snapshots (same cached proposals, generation-valid), serves
+    reads, reports its role on /state — and answers every execution
+    attempt with NotLeaderError carrying the leader's identity, even
+    when the plan would be empty."""
+    from cruise_control_tpu.chaos import HAFailoverHarness
+    from cruise_control_tpu.core.leader import NotLeaderError
+    ha = HAFailoverHarness(seed=_pick(chaos_seed, 5),
+                           snapshot_dir=str(tmp_path),
+                           optimizer=optimizer)
+    for _ in range(12):
+        ha.step()
+    leader = ha.leader()
+    lh = ha.procs[leader]
+    pre = lh.facade.proposals()            # leader fills + snapshots
+    ha.step()                              # write, then standby refreshes
+    ha.step()
+    standby = next(p for p in ha.procs if p != leader)
+    sh = ha.procs[standby]
+
+    state = sh.facade.state()
+    assert state["ServerRole"]["role"] == "standby"
+    assert state["ServerRole"]["leaderId"] == leader
+    cached = sh.facade.proposal_cache.export_state()
+    assert cached is not None, "standby must refresh from the snapshot"
+    assert [p.to_json() for p in cached["result"].proposals] == \
+        [p.to_json() for p in pre.proposals]
+
+    with pytest.raises(NotLeaderError) as exc:
+        sh.facade.rebalance(dryrun=False)
+    assert exc.value.leader_id == leader
+    assert sh.facade.rebalance(dryrun=True) is not None   # reads served
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", SOAK_SEEDS[:10])
+def test_crash_failover_soak(optimizer, chaos_seed, seed, tmp_path):
+    """Randomized-seed soak of the full crash→failover→restart cycle:
+    leader killed mid-execution at a seed-dependent point, standby takes
+    over and re-balances, the crashed process restarts as a warm standby
+    — fencing ledger and cluster invariants audited every run."""
+    from cruise_control_tpu.chaos import (HAFailoverHarness, ProcessCrashed,
+                                          check_fencing_invariants)
+    seed = chaos_seed if chaos_seed is not None else seed
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=5.0, logdirs=("logdir0", "logdir1"))
+    for p in range(16):
+        sim.add_partition(f"t{p % 3}", p, [p % 2, (p + 1) % 2],
+                          size_mb=10.0 + p)
+    ha = HAFailoverHarness(seed=seed, snapshot_dir=str(tmp_path), sim=sim,
+                           optimizer=optimizer)
+    base = snapshot_topology(ha.sim)
+    for _ in range(12):
+        ha.step()
+    leader = ha.leader()
+    lh = ha.procs[leader]
+    lh.engine.schedule(lh.engine.step + 1 + seed % 4, "crash_process")
+    try:
+        lh.facade.rebalance(dryrun=False,
+                            options=OptimizationOptions(seed=0),
+                            ignore_proposal_cache=True)
+    except ProcessCrashed:
+        pass
+    ha.kill(leader)
+    standby = next(p for p in ha.procs if p != leader)
+    ha.steps_until(lambda: ha.leader() == standby, 30, what="failover")
+    sh = ha.procs[standby]
+    for _ in range(6):
+        ha.step()
+    sh.facade.rebalance(dryrun=False, options=OptimizationOptions(seed=0),
+                        ignore_proposal_cache=True)
+    restarted = ha.restart(leader)
+    for _ in range(5):
+        ha.step()
+    assert restarted.facade.ha_role() == "standby"
+    problems = check_fencing_invariants(ha.stamps)
+    assert not problems, (
+        f"fencing invariants violated (seed={seed}):\n  "
+        + "\n  ".join(problems)
+        + "\n" + _repro("test_crash_failover_soak", seed))
+    assert_invariants(sh, base, "test_crash_failover_soak")

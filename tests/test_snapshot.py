@@ -1,0 +1,499 @@
+"""Crash-safe snapshot/restore suite: file-format refusal matrix, manager
+cadence + metering, the facade round-trip (a restarted process serves
+bit-identical cached proposals with zero XLA compiles), the stale-
+execution gate on restored results, and the torn-file satellites
+(JSONL sample replay, detector persistence).
+
+The full-stack cases ride the chaos harness with the module-shared
+optimizer (same compiled chains as tests/test_chaos.py), so the suite
+adds no XLA compilation of its own.
+"""
+
+import json
+import os
+
+import pytest
+
+from cruise_control_tpu.core.snapshot import (SNAPSHOT_VERSION,
+                                              SnapshotError, SnapshotManager,
+                                              atomic_write_json,
+                                              read_snapshot, write_snapshot)
+
+# ---------------------------------------------------------------- format
+
+
+def _payload():
+    return {"clusterId": "c1", "generation": 7,
+            "arrays": {"x": list(range(64))}}
+
+
+def test_write_read_round_trip(tmp_path):
+    path = str(tmp_path / "s.snap")
+    n = write_snapshot(path, _payload(), now_ms=123)
+    assert n == os.path.getsize(path)
+    header, payload = read_snapshot(path)
+    assert payload == _payload()
+    assert header["version"] == SNAPSHOT_VERSION
+    assert header["createdMs"] == 123
+
+
+def test_atomic_write_never_leaves_tmp(tmp_path):
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, _payload())
+    write_snapshot(path, _payload())
+    assert os.listdir(tmp_path) == ["s.snap"]
+
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+def test_corrupt_file_refused(tmp_path, mode):
+    from cruise_control_tpu.chaos import corrupt_snapshot
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, _payload())
+    corrupt_snapshot(path, mode=mode, seed=3)
+    with pytest.raises(SnapshotError) as exc:
+        read_snapshot(path)
+    assert exc.value.reason == "corrupt"
+
+
+def test_bitflip_every_offset_refused(tmp_path):
+    """Property: a single flipped payload bit is ALWAYS refused — the
+    checksum leaves no silent-corruption window anywhere in the body."""
+    from cruise_control_tpu.chaos import corrupt_snapshot
+    path = str(tmp_path / "s.snap")
+    for seed in range(16):
+        write_snapshot(path, _payload())
+        corrupt_snapshot(path, mode="bitflip", seed=seed)
+        with pytest.raises(SnapshotError):
+            read_snapshot(path)
+
+
+def test_version_skew_refused(tmp_path):
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, _payload())
+    with open(path, "rb") as f:
+        head, body = f.read().split(b"\n", 1)
+    header = json.loads(head)
+    header["version"] = SNAPSHOT_VERSION + 1
+    with open(path, "wb") as f:
+        f.write(json.dumps(header).encode() + b"\n" + body)
+    with pytest.raises(SnapshotError) as exc:
+        read_snapshot(path)
+    assert exc.value.reason == "version-skew"
+
+
+def test_stale_snapshot_refused_by_age(tmp_path):
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, _payload(), now_ms=1_000)
+    # Within the bound: fine; past it: refused as stale.
+    read_snapshot(path, max_age_ms=60_000, now_ms=50_000)
+    with pytest.raises(SnapshotError) as exc:
+        read_snapshot(path, max_age_ms=60_000, now_ms=62_000)
+    assert exc.value.reason == "stale"
+
+
+def test_missing_and_garbage_headers(tmp_path):
+    with pytest.raises(SnapshotError) as exc:
+        read_snapshot(str(tmp_path / "absent.snap"))
+    assert exc.value.reason == "missing"
+    path = str(tmp_path / "junk.snap")
+    for junk in (b"", b"not json\npayload", b"{\"magic\": \"other\"}\nxx"):
+        with open(path, "wb") as f:
+            f.write(junk)
+        with pytest.raises(SnapshotError) as exc:
+            read_snapshot(path)
+        assert exc.value.reason == "corrupt"
+
+
+# --------------------------------------------------------------- manager
+
+
+def test_manager_cadence_and_meters(tmp_path):
+    mgr = SnapshotManager(str(tmp_path / "s.snap"), interval_ms=10_000)
+    calls = []
+
+    def payload():
+        calls.append(1)
+        return _payload()
+
+    assert mgr.maybe_write(1_000, payload)
+    assert not mgr.maybe_write(5_000, payload)       # inside the interval
+    assert mgr.maybe_write(11_000, payload)
+    assert len(calls) == 2                           # lazy composition
+    assert mgr.to_json()["writes"] == 2
+    assert mgr.restore(12_000) == _payload()
+    assert mgr.to_json()["restores"] == 1
+
+
+def test_manager_refusals_metered_per_reason(tmp_path):
+    from cruise_control_tpu.chaos import corrupt_snapshot
+    path = str(tmp_path / "s.snap")
+    mgr = SnapshotManager(path, max_age_ms=1_000)
+    assert mgr.restore(0) is None                    # missing: not metered
+    assert all(v == 0 for v in mgr.to_json()["restoreFallbacks"].values())
+    mgr.write(0, _payload())
+    corrupt_snapshot(path, mode="truncate")
+    assert mgr.restore(10) is None
+    mgr.write(0, _payload())
+    assert mgr.restore(5_000) is None                # older than max age
+    mgr.refuse("cluster-mismatch", "wrong cluster")
+    fb = mgr.to_json()["restoreFallbacks"]
+    assert fb == {"corrupt": 1, "version-skew": 0, "stale": 1,
+                  "cluster-mismatch": 1}
+
+
+def test_manager_write_failure_is_survivable(tmp_path):
+    bad = tmp_path / "not-a-dir"
+    bad.write_text("file, not dir")
+    mgr = SnapshotManager(str(bad / "s.snap"))
+    assert mgr.write(0, _payload()) is None          # metered, no raise
+    assert mgr.to_json()["writeFailures"] == 1
+
+
+def test_newer_snapshot_available(tmp_path):
+    path = str(tmp_path / "s.snap")
+    mgr = SnapshotManager(path)
+    assert not mgr.newer_snapshot_available()        # nothing on disk
+    write_snapshot(path, _payload(), now_ms=500)     # pre-existing file
+    assert mgr.newer_snapshot_available()            # never seen by us
+    mgr.restore(600)
+    assert not mgr.newer_snapshot_available()
+    # A deposed leader polling its OWN last write must see nothing new
+    # (restoring it would regress the live cache to an older state).
+    mgr.write(1_000, _payload())
+    assert not mgr.newer_snapshot_available()
+    write_snapshot(path, _payload(), now_ms=3_000)   # the NEW leader wrote
+    assert mgr.newer_snapshot_available()
+
+
+def test_prometheus_families_lint_clean(tmp_path):
+    """Snapshot.* and HA.* land on /metrics as lint-clean families."""
+    from prom_lint import lint_prometheus_exposition
+
+    from cruise_control_tpu.core.leader import LeaderElector
+    from cruise_control_tpu.core.sensors import CompositeRegistry
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    mgr = SnapshotManager(str(tmp_path / "s.snap"))
+    mgr.write(0, _payload())
+    mgr.restore(1)
+    sim = SimulatedKafkaCluster()
+    el = LeaderElector(sim, "p1", now_ms=lambda: 0)
+    el.tick(0)
+    text = CompositeRegistry(
+        lambda: [mgr.registry, el.registry]).expose_text()
+    lint_prometheus_exposition(text, expect_families=(
+        "cc_Snapshot_writes_total", "cc_Snapshot_restores_total",
+        "cc_Snapshot_restore_corrupt_total",
+        "cc_Snapshot_write_failure_rate_total", "cc_Snapshot_bytes",
+        "cc_HA_takeovers_total", "cc_HA_is_leader",
+        "cc_HA_fencing_epoch", "cc_HA_election_error_rate_total"))
+
+
+def test_malicious_pickle_payload_refused(tmp_path):
+    """A snapshot file is shared state: its payload must unpickle under
+    the module allowlist only — a crafted payload referencing os.system
+    (the classic pickle gadget) is refused as corrupt, never executed,
+    even with a perfectly valid header and checksum."""
+    import os as _os
+
+    class Evil:
+        def __reduce__(self):
+            return (_os.system, ("echo pwned",))
+
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, {"clusterId": None, "evil": Evil()})
+    with pytest.raises(SnapshotError) as exc:
+        read_snapshot(path)
+    assert exc.value.reason == "corrupt"
+    assert "forbidden global" in str(exc.value)
+
+
+def test_validate_refusal_counts_only_as_fallback(tmp_path):
+    """A domain-refused snapshot (cluster mismatch) must land ONLY on
+    its refusal meter: restores stays 0 and the file is not marked seen
+    (a later valid snapshot at the same path must still be noticed)."""
+    path = str(tmp_path / "s.snap")
+    mgr = SnapshotManager(path)
+    write_snapshot(path, _payload(), now_ms=1_000)
+    out = mgr.restore(2_000, validate=lambda p: (
+        "cluster-mismatch", "snapshot belongs to another cluster"))
+    assert out is None
+    j = mgr.to_json()
+    assert j["restores"] == 0
+    assert j["restoreFallbacks"]["cluster-mismatch"] == 1
+    assert mgr.newer_snapshot_available()            # never applied
+    assert mgr.restore(3_000, validate=lambda p: None) == _payload()
+    assert mgr.to_json()["restores"] == 1
+
+
+def test_failed_mutation_is_not_ledgered():
+    """A chaos-failed admin mutation lands nothing on the cluster, so it
+    must not appear in the fencing ledger — otherwise the next leader's
+    legitimate re-issue reads as a false double-apply."""
+    from cruise_control_tpu.chaos.ha import RecordingAdmin
+
+    class FailingAdmin:
+        def describe_partitions(self):
+            return {}
+
+        def list_partition_reassignments(self):
+            return {}
+
+        def alter_partition_reassignments(self, targets):
+            raise RuntimeError("chaos: injected admin failure")
+
+    stamps = []
+    admin = RecordingAdmin(FailingAdmin(), "p1", stamps, lambda: 0)
+    with pytest.raises(RuntimeError):
+        admin.alter_partition_reassignments({("t0", 0): [1, 2]})
+    assert stamps == []
+
+
+def test_restarted_leader_reclaims_own_lease_with_higher_epoch(tmp_path):
+    """A leader that crashes and restarts under the same identity while
+    its old lease is still current must RECLAIM it under a strictly
+    higher epoch — never 'renew' with the fresh incarnation's epoch 0
+    (which would wedge leadership forever: perpetually-extended lease,
+    role forever standby, epoch regressed below the predecessor's
+    mutations)."""
+    from cruise_control_tpu.core.leader import LeaderElector
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    sim = SimulatedKafkaCluster()
+    el1 = LeaderElector(sim, "p1", lease_ms=60_000, now_ms=lambda: 0)
+    assert el1.tick(1_000) == "leader"
+    assert el1.epoch == 1
+    # "Crash": el1 is simply never driven again; same identity restarts
+    # with a fresh elector while the old lease is far from expiry.
+    el2 = LeaderElector(sim, "p1", lease_ms=60_000, now_ms=lambda: 0)
+    assert el2.tick(2_000) == "leader"
+    assert el2.epoch == 2                            # strictly higher
+    assert el2.is_leader()
+    # And a third party later observes the bumped epoch, not a reset.
+    el3 = LeaderElector(sim, "p2", lease_ms=60_000, now_ms=lambda: 0)
+    el3.tick(3_000)
+    assert el3.observed_epoch == 2
+
+
+# ------------------------------------------------- torn-file satellites
+
+
+def test_sample_replay_skips_torn_trailing_line(tmp_path):
+    """Crash mid-append leaves a torn last line: replay must keep every
+    complete record, skip + meter the torn one (it used to raise and
+    poison the whole LOADING replay)."""
+    from cruise_control_tpu.monitor.sampler import Samples
+    from cruise_control_tpu.monitor.samples import PartitionMetricSample
+    from cruise_control_tpu.monitor.store import FileSampleStore
+    store = FileSampleStore(str(tmp_path))
+    good = [PartitionMetricSample(topic="t0", partition=p,
+                                  time_ms=1000 + p, values={})
+            for p in range(3)]
+    store.store_samples(Samples(good, []))
+    store.close()
+    with open(tmp_path / "partition_samples.jsonl", "a",
+              encoding="utf-8") as f:
+        f.write('{"entity": ["t0", 99], "time_ms": 4')   # torn mid-write
+    store2 = FileSampleStore(str(tmp_path))
+    out = store2.load_samples()
+    assert [s.entity for s in out.partition_samples] == \
+        [s.entity for s in good]
+    assert store2.skipped_records == 1
+    store2.close()
+
+
+def test_sample_replay_skips_nul_padded_hole(tmp_path):
+    from cruise_control_tpu.monitor.sampler import Samples
+    from cruise_control_tpu.monitor.samples import BrokerMetricSample
+    from cruise_control_tpu.monitor.store import FileSampleStore
+    store = FileSampleStore(str(tmp_path))
+    store.store_samples(Samples(
+        [], [BrokerMetricSample(broker_id=1, time_ms=500, values={})]))
+    store.close()
+    with open(tmp_path / "broker_samples.jsonl", "a", encoding="utf-8") as f:
+        f.write("\x00" * 32 + "\n")
+    store2 = FileSampleStore(str(tmp_path))
+    out = store2.load_samples()
+    assert len(out.broker_samples) == 1
+    assert store2.skipped_records == 1
+    store2.close()
+
+
+def test_detector_persistence_is_atomic_and_tolerant(tmp_path):
+    """failed_brokers.json: writes go tmp+rename (no torn file is ever
+    visible), and a corrupt/empty file from an earlier crash warns and
+    starts fresh instead of killing the detector thread."""
+    from cruise_control_tpu.detector import BrokerFailureDetector
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    path = tmp_path / "failed.json"
+    path.write_text('{"1": 12')                      # torn pre-atomic file
+    sim = SimulatedKafkaCluster()
+    sim.add_broker(0)
+    sim.add_broker(1)
+    det = BrokerFailureDetector(sim, persist_path=str(path))
+    assert det._failed_since == {}                   # fresh, not crashed
+    sim.kill_broker(1)
+    det.detect(1_000)
+    assert json.loads(path.read_text()) == {"1": 1000}
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
+def test_idempotence_cache_tolerates_corrupt_file(tmp_path):
+    from cruise_control_tpu.detector.detectors import IdempotenceCache
+    path = tmp_path / "seen.json"
+    path.write_text("{corrupt")
+    cache = IdempotenceCache(persist_path=str(path),
+                             now_ms=lambda: 1_000)
+    assert cache.check_and_add("fix-1")              # fresh, not crashed
+    assert json.loads(path.read_text()) == {"fix-1": 1000}
+
+
+def test_atomic_write_json_replaces_whole_document(tmp_path):
+    path = str(tmp_path / "doc.json")
+    atomic_write_json(path, {"a": 1})
+    atomic_write_json(path, {"b": 2})
+    assert json.loads(open(path).read()) == {"b": 2}
+    assert os.listdir(tmp_path) == ["doc.json"]
+
+
+# ------------------------------------------- full-stack restore (shared
+# optimizer: these compile nothing beyond the chaos suite's chains)
+
+
+@pytest.fixture(scope="module")
+def optimizer():
+    from cruise_control_tpu.chaos import default_optimizer
+    return default_optimizer()
+
+
+def make_harness(optimizer, tmp_path, **kwargs):
+    """Skewed 4-broker stack (so proposals always carry real moves) with
+    the snapshot manager wired at a 1-step cadence."""
+    from cruise_control_tpu.chaos import ChaosHarness
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    sim = SimulatedKafkaCluster()
+    for b in range(4):
+        sim.add_broker(b, rate_mb_s=10_000.0, logdirs=("logdir0", "logdir1"))
+    for p in range(16):
+        sim.add_partition(f"t{p % 3}", p, [p % 2, (p + 1) % 2],
+                          size_mb=10.0 + p)
+    return ChaosHarness(sim, seed=3, optimizer=optimizer,
+                        snapshot_path=str(tmp_path / "cc.snapshot"),
+                        **kwargs)
+
+
+def _warm_with_cached_proposals(h):
+    h.warmup()
+    res = h.facade.proposals()
+    assert res.proposals, "skewed sim must yield real moves"
+    h.step(detect=False)            # ha_tick writes the cadenced snapshot
+    return res
+
+
+def test_restore_round_trip_is_bit_identical(optimizer, tmp_path):
+    """The acceptance property: a restarted process restores the cache
+    and resident mirrors bit-identically, serves them generation-valid
+    with ZERO XLA compile events, and the snapshot section of
+    /devicestats records the restore."""
+    h = make_harness(optimizer, tmp_path)
+    pre = _warm_with_cached_proposals(h)
+    pre_state = h.facade.proposal_cache.export_state()
+    pre_resident = h.monitor.resident.export_state()
+    generation = h.monitor.generation
+
+    before = h.facade.device_stats.snapshot()
+    h2 = h.restart()
+    post_state = h2.facade.proposal_cache.export_state()
+    assert post_state is not None
+    assert post_state["generation"] == pre_state["generation"]
+    assert [p.to_json() for p in post_state["result"].proposals] == \
+        [p.to_json() for p in pre.proposals]
+    assert post_state["result"].stale_model    # execution stays gated
+
+    # Generation-valid: the monitor resumed the pre-crash numbering, so
+    # the restored entry is served as-is (no recompute).
+    assert h2.monitor.generation == generation
+    n_pre = post_state["numComputations"]
+    served = h2.facade.proposals()
+    assert [p.to_json() for p in served.proposals] == \
+        [p.to_json() for p in pre.proposals]
+    assert h2.facade.proposal_cache.num_computations == n_pre
+
+    # Resident mirrors restored bit-identically (same host arrays in,
+    # same device model out by construction).
+    import numpy as np
+    post_resident = h2.monitor.resident.export_state()
+    assert post_resident[0] >= pre_resident[0]
+    assert sorted(post_resident[1]) == sorted(pre_resident[1])
+    for k, a in pre_resident[1].items():
+        assert np.array_equal(np.asarray(a),
+                              np.asarray(post_resident[1][k])), k
+
+    # Zero compiles across crash -> restore -> warm serve.
+    after = h2.facade.device_stats.snapshot()
+    for key in ("compileEvents", "aotCompileEvents", "recompileEvents"):
+        assert after[key] == before[key], key
+
+    snap_json = h2.facade.device_stats_json()["snapshot"]
+    assert snap_json["restores"] == 1
+    assert h2.facade.device_stats_json()["ha"]["role"] == "leader"
+    # The restarted stack keeps the resolved admin (a restart must not
+    # silently unwrap a recording/chaos admin back to the raw engine).
+    assert h2.facade.admin is h.facade.admin
+
+
+def test_restored_proposals_trip_stale_execution_gate(optimizer, tmp_path):
+    """A restored cache is serve-only: acting on it before a live model
+    build must raise StaleClusterModelError (the stale-snapshot
+    acceptance scenario — the pre-crash topology may be long gone),
+    while the operator override still works."""
+    from cruise_control_tpu.monitor import StaleClusterModelError
+    h = make_harness(optimizer, tmp_path)
+    _warm_with_cached_proposals(h)
+    h2 = h.restart()
+    with pytest.raises(StaleClusterModelError):
+        h2.facade.rebalance(dryrun=False)
+    assert not h2.executor.has_ongoing_execution()
+    h2.facade.allow_stale_execution = True
+    res, exec_res = h2.facade.rebalance(dryrun=False)
+    assert exec_res is not None
+
+
+def test_corrupt_snapshot_falls_back_cold(optimizer, tmp_path):
+    """Truncate/bit-flip before restore: the restart must refuse the
+    file (metered), start cold, and still be able to warm up and serve
+    — corruption costs the warm start, never correctness."""
+    from cruise_control_tpu.chaos import corrupt_snapshot
+    h = make_harness(optimizer, tmp_path)
+    _warm_with_cached_proposals(h)
+    path = h.facade.snapshotter.path
+    corrupt_snapshot(path, mode="truncate")
+    h2 = h.restart()
+    assert h2.facade.proposal_cache.export_state() is None
+    assert h2.facade.snapshotter.to_json()["restoreFallbacks"]["corrupt"] == 1
+    # Cold path still works end to end.
+    h2.warmup()
+    assert h2.facade.proposals().proposals
+
+
+def test_version_skewed_snapshot_falls_back_cold(optimizer, tmp_path,
+                                                 monkeypatch):
+    h = make_harness(optimizer, tmp_path)
+    _warm_with_cached_proposals(h)
+    monkeypatch.setattr("cruise_control_tpu.core.snapshot.SNAPSHOT_VERSION",
+                        SNAPSHOT_VERSION + 1)
+    h2 = h.restart()
+    assert h2.facade.proposal_cache.export_state() is None
+    fb = h2.facade.snapshotter.to_json()["restoreFallbacks"]
+    assert fb["version-skew"] == 1
+
+
+def test_cluster_mismatch_refused(optimizer, tmp_path):
+    """A snapshot from another cluster must never be applied — the
+    fleet-scoping rule extended to the durability layer."""
+    h = make_harness(optimizer, tmp_path)
+    _warm_with_cached_proposals(h)
+    h2 = h.restart(restore=False)
+    h2.facade.cluster_id = "other-cluster"
+    assert not h2.facade.restore_from_snapshot(h2.engine.now_ms())
+    fb = h2.facade.snapshotter.to_json()["restoreFallbacks"]
+    assert fb["cluster-mismatch"] == 1
+    assert h2.facade.proposal_cache.export_state() is None
