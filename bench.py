@@ -566,6 +566,167 @@ def run_multiobj_propose_bench(num_brokers: int = NUM_BROKERS,
             "devices": len(jax.devices())}
 
 
+#: bench-scale backtest-accuracy bar for the forecast fit (the ISSUE-13
+#: acceptance gate, judged on clean synthetic diurnal+growth traces at
+#: every scale — it is a deterministic model-quality bound, not a
+#: wall-clock number). docs/forecasting.md §Accuracy.
+FORECAST_MAPE_BUDGET = 0.15
+
+
+def run_forecast_sweep_bench(num_clusters: int = 4,
+                             num_brokers: int = NUM_BROKERS,
+                             num_partitions: int = NUM_PARTITIONS, *,
+                             goal_names: list | None = None,
+                             history_windows: int = 96,
+                             repeats: int = 3, emit_row: bool = True,
+                             gate: bool = True) -> dict:
+    """Forecast pipeline (ISSUE 13): host-side per-topic trajectory
+    fitting over a synthetic diurnal+growth window history, then the
+    fitted (horizon x quantile) grid scored across ``num_clusters``
+    fleet members as ONE ``[C, S]`` batched trajectory dispatch
+    (fleet/engine.py ``sweep_trajectories``) vs the status quo: looping
+    the warm single-cluster ``WhatIfEngine`` sweep per member.
+
+    Three always-on gates (deterministic at any scale):
+
+    - **backtest accuracy**: worst 1-window-holdout MAPE over the fitted
+      topics stays <= ``FORECAST_MAPE_BUDGET`` (the traces are clean
+      diurnal + linear growth — the acceptance-criteria shapes);
+    - **scoring parity**: every fleet row must match the single-cluster
+      sweep of the same scenario (the summary rows round to 4 decimals);
+    - **zero warm recompiles**: repeat fleet trajectory dispatches after
+      the first compile nothing on the device-runtime ledger.
+
+    The ``>= 1x`` wall-clock bar vs the sequential loop is judged at
+    bench scale only (``gate=False`` for the tier-1 toy smoke). Emits
+    ``forecast_backtest_mape`` + ``forecast_sweep_wall_clock``."""
+    import jax
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import TpuGoalOptimizer, goals_by_name
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    from cruise_control_tpu.fleet import FleetModel, FleetOptimizer
+    from cruise_control_tpu.forecast import fit_topic_forecasts
+    from cruise_control_tpu.model.spec import flatten_spec
+    from cruise_control_tpu.whatif import TrajectoryScale, WhatIfEngine
+    goals = goals_by_name(goal_names or GOALS)
+    spec = build_spec(num_brokers=num_brokers,
+                      num_partitions=num_partitions)
+    model, md = flatten_spec(spec)
+
+    # --- fit stage: 1-minute windows, 24-window (diurnal) seasonality.
+    # Each live topic gets a deterministic level + growth + diurnal
+    # trace with mild noise — the acceptance-criteria trace shapes at
+    # fleet topic count.
+    window_ms = 60_000
+    W, K = history_windows, 24
+    topics = sorted(md.topic_index)
+    rng = np.random.default_rng(13)
+    x = np.arange(W, dtype=float)
+    series = {}
+    for i, t in enumerate(topics):
+        level = 200.0 + 10.0 * (i % 17)
+        slope = 0.05 * (i % 5) * level / W
+        amp = 0.2 * level
+        y = (level + slope * x + amp * np.sin(2 * np.pi * x / K)
+             + rng.normal(0.0, 0.01 * level, W))
+        vals = np.stack([0.01 * y, y, 0.5 * y,
+                         5.0 * level + slope * x])   # cpu/nwIn/nwOut/disk
+        series[t] = (vals, np.ones(W, bool))
+    t0 = time.monotonic()
+    fits = fit_topic_forecasts(series, window_ms,
+                               seasonal_period_ms=K * window_ms,
+                               min_history_windows=3, fitted_at_ms=0)
+    fit_s = time.monotonic() - t0
+    mape = fits.worst_backtest_mape()
+    if mape is None or mape > FORECAST_MAPE_BUDGET:
+        raise RuntimeError(
+            f"forecast backtest gate: worst 1-window-holdout MAPE "
+            f"{mape} over {len(fits)} topics exceeds "
+            f"{FORECAST_MAPE_BUDGET} on clean diurnal+growth traces")
+
+    # --- sweep stage: the +1h/+6h/+24h x p50/p90 grid, factors from the
+    # fit, scored across C members in one [C, S] dispatch.
+    grid = [TrajectoryScale(horizon_ms=h, quantile=q,
+                            factors=tuple(sorted(
+                                fits.factors(h, q).items())))
+            for h in (3_600_000, 21_600_000, 86_400_000)
+            for q in (0.5, 0.9)]
+    S = len(grid)
+    members = []
+    for c in range(num_clusters):
+        f = jnp.float32(1.0 + 0.01 * c)
+        members.append((f"cluster-{c:02d}",
+                        model.replace(leader_load=model.leader_load * f,
+                                      follower_load=model.follower_load
+                                      * f), md))
+    fleet = FleetModel.stack(members)
+    fleet_opt = FleetOptimizer(TpuGoalOptimizer(goals=goals))
+
+    t0 = time.monotonic()
+    out = fleet_opt.sweep_trajectories(fleet, grid)        # cold
+    cold_s = time.monotonic() - t0
+    collector = default_collector()
+    before = collector.snapshot()
+    warm_s = float("inf")
+    for _ in range(repeats):
+        t0 = time.monotonic()
+        out = fleet_opt.sweep_trajectories(fleet, grid)
+        warm_s = min(warm_s, time.monotonic() - t0)
+    after = collector.snapshot()
+    recompiles = (after["compileEvents"] + after["aotCompileEvents"]
+                  - before["compileEvents"] - before["aotCompileEvents"])
+    if recompiles:
+        raise RuntimeError(
+            f"forecast warm-recompile gate: {recompiles} compile events "
+            f"across {repeats} warm [C={num_clusters}, S={S}] trajectory "
+            "dispatches (expected 0)")
+
+    # Sequential baseline: the warm single-cluster what-if sweep looped
+    # over the members (compile once on member 0, then time the loop) —
+    # doubles as the always-on scoring-parity gate.
+    eng = WhatIfEngine(goals=goals)
+    eng.sweep(fleet.members[0].model, fleet.members[0].metadata, grid)
+    t0 = time.monotonic()
+    singles = [eng.sweep(m.model, m.metadata, grid)
+               for m in fleet.members]
+    seq_s = time.monotonic() - t0
+    for summary, single in zip(out, singles):
+        for row, o in zip(summary["scenarios"], single.outcomes):
+            if abs(row["risk"] - o.risk) > 1e-3 or \
+                    abs(row["capacityPressure"]
+                        - o.capacity_pressure) > 1e-3 or \
+                    row["violatedHardGoals"] != o.violated_hard_goals:
+                raise RuntimeError(
+                    f"forecast parity gate: fleet row for "
+                    f"{summary['clusterId']}/{row['scenario']} diverges "
+                    "from the single-cluster sweep of the same scenario")
+
+    speedup = seq_s / warm_s if warm_s > 0 else None
+    log(f"forecast sweep ({num_clusters} x {num_brokers}x"
+        f"{num_partitions}, {len(fits)} topics fitted in {fit_s:.2f}s "
+        f"worst MAPE {mape:.4f}, {S} scenarios, "
+        f"{len(jax.devices())} devices): cold {cold_s:.2f}s warm "
+        f"{warm_s:.3f}s; sequential loop {seq_s:.2f}s "
+        f"({'n/a' if speedup is None else f'{speedup:.1f}x'}); parity "
+        "ok, 0 warm recompiles")
+    if gate and (speedup is None or speedup < 1.0):
+        raise RuntimeError(
+            f"forecast sweep gate: batched [C, S] dispatch "
+            f"{warm_s:.3f}s did not beat the sequential per-member "
+            f"sweep loop {seq_s:.3f}s (need >= 1x)")
+    if emit_row:
+        emit("forecast_backtest_mape", round(mape, 6), "mape", None)
+        emit("forecast_sweep_wall_clock", round(warm_s, 3), "s",
+             round(speedup, 3) if speedup else None,
+             vs_greedy=round(speedup, 3) if speedup else None)
+    return {"fit_s": fit_s, "mape": mape, "topics": len(fits),
+            "scenarios": S, "clusters": num_clusters,
+            "cold_s": cold_s, "warm_s": warm_s, "seq_s": seq_s,
+            "speedup": speedup, "recompiles": recompiles,
+            "devices": len(jax.devices())}
+
+
 def run_tracer_overhead_bench(num_brokers: int = 50,
                               num_partitions: int = 5_000, *,
                               goal_names: list | None = None,
@@ -1547,13 +1708,15 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
                          "6 = fleet batched propose, 16 clusters x "
                          "100x20K, 7 = tuned multi-objective population "
-                         "search vs fixed-schedule sequential, 100x20K)")
+                         "search vs fixed-schedule sequential, 100x20K, "
+                         "8 = forecast fit + [C, S] fleet trajectory "
+                         "sweep, 4 clusters x 100x20K)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -1575,11 +1738,11 @@ def main():
     platform = ensure_live_backend()
     global _RESOLVED_PLATFORM
     _RESOLVED_PLATFORM = platform
-    if args.scenario in (6, 7) and platform.startswith("cpu"):
-        # Scenario 6 shards the CLUSTER axis, scenario 7 the POPULATION
-        # axis over devices; on a CPU host that concurrency needs forced
-        # virtual devices, set BEFORE jax initializes (real accelerators
-        # use their own).
+    if args.scenario in (6, 7, 8) and platform.startswith("cpu"):
+        # Scenarios 6/8 shard the CLUSTER axis, scenario 7 the
+        # POPULATION axis over devices; on a CPU host that concurrency
+        # needs forced virtual devices, set BEFORE jax initializes
+        # (real accelerators use their own).
         import os
         flags = os.environ.get("XLA_FLAGS", "")
         count = 16 if args.scenario == 6 else 8
@@ -1611,6 +1774,11 @@ def main():
                 log("--mesh is ignored for scenario 7: the population "
                     "dispatch owns the device axis (member replication)")
             run_multiobj_propose_bench()
+        elif args.scenario == 8:
+            if args.mesh:
+                log("--mesh is ignored for scenario 8: the trajectory "
+                    "dispatch owns the device axis (cluster sharding)")
+            run_forecast_sweep_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

@@ -109,6 +109,17 @@ class KafkaCruiseControl:
             # off-bucket sweep variants.
             partition_pad_multiple=monitor.config.partition_pad_multiple,
             broker_pad_multiple=monitor.config.broker_pad_multiple)
+        #: forecast engine (forecast/engine.py): fits per-topic load
+        #: trajectories from the monitor's window history and scores
+        #: them through the SAME what-if engine — /forecast, the
+        #: capacity-forecast detector and the ``forecast`` scenario
+        #: source of /simulate all share this one instance (one fit,
+        #: one compiled sweep program set). serve.py reconfigures it
+        #: from the forecast.* keys and wires the persistence store.
+        from ..forecast import ForecastEngine
+        self.forecast = ForecastEngine(
+            monitor, self.whatif, tracer=self.optimizer.tracer,
+            collector=self.optimizer.collector, now_ms=self._now_ms)
         # Shared with the metrics processor so a TRAIN-fitted regression
         # feeds CPU estimation for samples that lack broker CPU.
         self.cpu_model = cpu_model or LinearRegressionModelParameters()
@@ -157,6 +168,10 @@ class KafkaCruiseControl:
         #: proposal-freshness sensors (ProposalCache.freshness-*-ms
         #: gauges + the SLO-breach meter) join the scrape view.
         self.extra_registries.append(self.proposal_cache.registry)
+
+        #: Forecast.* sensors (fit/sweep timers, topics-fitted,
+        #: backtest-mape, time-to-breach-ms gauges) join the scrape view.
+        self.extra_registries.append(self.forecast.registry)
 
         #: startup pre-warm thread (see :meth:`start_prewarm`).
         self._prewarm_thread: threading.Thread | None = None
@@ -844,10 +859,37 @@ class KafkaCruiseControl:
         from ..whatif import alive_broker_ids, parse_scenarios
         result = self.monitor.cluster_model(self._now_ms())
         scenarios = parse_scenarios(
-            payload, alive_broker_ids(result.model, result.metadata))
+            payload, alive_broker_ids(result.model, result.metadata),
+            # {"type": "forecast"} sources resolve through the fitted
+            # per-topic forecasts into concrete TrajectoryScale specs.
+            forecaster=self.forecast.trajectory_scenario)
         report = self.whatif.sweep(result.model, result.metadata,
                                    scenarios, stale_model=result.stale)
         return report.to_json()
+
+    def forecast_json(self) -> dict:
+        """``GET /forecast``: the fitted-trajectory summary and the
+        cached sweep report (computed on first call; POST /forecast
+        forces a refit + fresh sweep)."""
+        self.forecast.maybe_refresh(self._now_ms())
+        return self.forecast.report_json()
+
+    def forecast_refresh(self) -> dict:
+        """``POST /forecast``: refit forecasts from the current window
+        history and run one trajectory sweep NOW. A monitor with no
+        aggregated windows yet is a client-retryable not-ready state —
+        HTTP 400, as rest-api.md documents — not a server fault."""
+        from ..core.aggregator import NotEnoughValidWindowsError
+        now = self._now_ms()
+        try:
+            self.forecast.refresh(now)
+            self.forecast.sweep(now)
+        except NotEnoughValidWindowsError as e:
+            raise ValueError(
+                f"no aggregated windows to fit forecasts from yet "
+                f"({e}); retry once the monitor has sampled at least "
+                f"one window") from e
+        return self.forecast.report_json()
 
     def load(self, populate_disk_info: bool = False,
              capacity_only: bool = False) -> dict:
@@ -990,6 +1032,10 @@ class KafkaCruiseControl:
             self._now_ms())
         payload["fleet"] = (self.fleet.stats_json()
                             if self.fleet is not None else None)
+        # Forecast-engine snapshot (fit counts, worst backtest error,
+        # last sweep's time-to-breach) — always present; dashboards poll
+        # unconditionally.
+        payload["forecast"] = self.forecast.stats_json()
         # Population-search snapshot (last run's joint-scoring readout —
         # Pareto front size, per-goal acceptance across the population)
         # and the tuned-schedule store's per-bucket fields + trial

@@ -107,6 +107,16 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
                                 "dispatch and re-caches its proposals; "
                                 "execution stays per-cluster (also at "
                                 "/fleet/rebalance)", []),
+    "forecast": ("get", "Fitted per-topic load trajectories + the "
+                        "projected-horizon sweep report (risk, capacity "
+                        "pressure and time-to-breach per horizon x "
+                        "quantile; docs/forecasting.md)", []),
+    "forecast_refresh": ("post", "Refit forecasts from the current "
+                                 "window history and run one trajectory "
+                                 "sweep now (also POST /forecast); "
+                                 "host-side fitting + a dry-run scoring "
+                                 "dispatch — provisioning stays behind "
+                                 "rightsize / the detector", []),
 }
 
 
@@ -279,6 +289,60 @@ _SCHEMAS = {
                                                 "broker fraction"},
                     "worstBroker": {},
                 }}},
+        }},
+    "ForecastReport": {
+        "type": "object",
+        "description": "fitted-trajectory summary + projected-horizon "
+                       "sweep (forecast/engine.py ForecastReport; "
+                       "docs/forecasting.md)",
+        "properties": {
+            "version": {"type": "integer"},
+            "enabled": {"type": "boolean"},
+            "horizonsMs": {"type": "array", "items": {"type": "integer"}},
+            "quantiles": {"type": "array", "items": {"type": "number"}},
+            "fits": {"type": "integer"},
+            "sweeps": {"type": "integer"},
+            "storePath": {"type": "string", "nullable": True},
+            "fittedTopics": {"type": "integer", "nullable": True},
+            "fittedAtMs": {"type": "integer", "nullable": True},
+            "worstBacktestMape": {
+                "type": "number", "nullable": True,
+                "description": "worst 1-window-holdout relative error "
+                               "over fitted topics"},
+            "timeToBreachMs": {
+                "type": "integer", "nullable": True,
+                "description": "estimated ms until projected capacity "
+                               "pressure crosses 1.0 (null = no breach "
+                               "inside the scored horizons)"},
+            "lastSweepMs": {"type": "integer", "nullable": True},
+            "topics": {"type": "object",
+                       "description": "per-topic fit summary (degrade "
+                                      "rung, backtest error, per-window "
+                                      "trend)"},
+            "report": {"type": "object", "nullable": True, "properties": {
+                "generatedAtMs": {"type": "integer"},
+                "durationMs": {"type": "number"},
+                "staleModel": {"type": "boolean"},
+                "timeToBreachMs": {"type": "integer", "nullable": True},
+                "breachHorizonMs": {"type": "integer", "nullable": True},
+                "breachQuantile": {"type": "number", "nullable": True},
+                "baseline": {"type": "object", "nullable": True},
+                "horizons": {"type": "array", "items": {
+                    "type": "object", "properties": {
+                        "horizonMs": {"type": "integer"},
+                        "quantile": {"type": "number"},
+                        "risk": {"type": "number"},
+                        "capacityPressure": {"type": "number"},
+                        "violatedGoals": {"type": "array",
+                                          "items": {"type": "string"}},
+                        "violatedHardGoals": {"type": "array",
+                                              "items": {"type": "string"}},
+                        "headroom": {"type": "object"},
+                        "worstBroker": {},
+                        "maxFactor": {"type": "number"},
+                        "scenario": {"type": "string"},
+                    }}},
+            }},
         }},
     "TraceEvents": {
         "type": "object",
@@ -527,6 +591,8 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
             ok.update(_ref("DeviceStats"))
         elif name in ("fleet", "fleet_rebalance"):
             ok.update(_ref("FleetSummary"))
+        elif name in ("forecast", "forecast_refresh"):
+            ok.update(_ref("ForecastReport"))
         # JSON is the documented default body (json defaults true): every
         # 200 advertises application/json — a typed $ref where one
         # exists, a generic object otherwise.

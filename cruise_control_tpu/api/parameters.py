@@ -550,6 +550,18 @@ class FleetRebalanceParameters(EndpointParameters):
     in the member caches, execution stays per-cluster."""
 
 
+class ForecastParameters(EndpointParameters):
+    """``GET /forecast`` — the fitted-trajectory summary and cached
+    sweep report (json=false renders the fixed-width horizon table)."""
+
+
+class ForecastRefreshParameters(EndpointParameters):
+    """``POST /forecast`` — force a refit from the current window
+    history plus one fresh trajectory sweep. Purely host-side fitting
+    + a dry-run scoring dispatch; provisioning actions stay behind
+    rightsize / the capacity-forecast detector."""
+
+
 #: endpoint -> parameter class (ref CruiseControlEndPoint -> Parameters
 #: wiring in KafkaCruiseControlServlet)
 ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
@@ -580,6 +592,8 @@ ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
     "simulate": SimulateParameters,
     "fleet": FleetParameters,
     "fleet_rebalance": FleetRebalanceParameters,
+    "forecast": ForecastParameters,
+    "forecast_refresh": ForecastRefreshParameters,
 }
 
 

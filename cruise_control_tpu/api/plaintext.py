@@ -220,6 +220,14 @@ def _render_devicestats(payload: dict) -> str:
                  f"{bucket.get('brokersPadded', '-')}x"
                  f"{bucket.get('partitionsPadded', '-')}, last dispatch "
                  f"{fleet.get('lastDispatchMs')} ms")
+    forecast = payload.get("forecast")
+    if forecast and forecast.get("fittedTopics") is not None:
+        ttb = forecast.get("timeToBreachMs")
+        text += (f"\nforecast: {forecast.get('fittedTopics')} topics "
+                 f"fitted ({forecast.get('fits')} fits / "
+                 f"{forecast.get('sweeps')} sweeps), worst backtest MAPE "
+                 f"{forecast.get('worstBacktestMape')}, time to breach "
+                 + (f"{ttb} ms" if ttb is not None else "none projected"))
     pop = payload.get("population")
     if pop:
         text += (f"\npopulation: K={pop.get('size')} "
@@ -284,8 +292,38 @@ def _render_fleet(payload: dict) -> str:
     return text
 
 
+def _render_forecast(payload: dict) -> str:
+    report = payload.get("report") or {}
+    rows = []
+    baseline = report.get("baseline")
+    if baseline:
+        rows.append(["now", "-",
+                     _num(float(baseline.get("risk", 0.0))),
+                     _num(float(baseline.get("capacityPressure", 0.0))),
+                     _num(float(baseline.get("maxFactor", 1.0))),
+                     ",".join(baseline.get("violatedHardGoals", []))
+                     or "-"])
+    for o in report.get("horizons", []):
+        rows.append([f"+{o.get('horizonMs')}ms",
+                     f"p{int(round(float(o.get('quantile', 0.5)) * 100))}",
+                     _num(float(o.get("risk", 0.0))),
+                     _num(float(o.get("capacityPressure", 0.0))),
+                     _num(float(o.get("maxFactor", 1.0))),
+                     ",".join(o.get("violatedHardGoals", [])) or "-"])
+    text = _table(["HORIZON", "QUANTILE", "RISK", "PRESSURE", "MAXFACTOR",
+                   "HARD_VIOLATIONS"], rows)
+    ttb = payload.get("timeToBreachMs")
+    text += (f"\n\ntopics fitted: {payload.get('fittedTopics')}, worst "
+             f"backtest MAPE: {payload.get('worstBacktestMape')}, time to "
+             f"breach: " + (f"{ttb} ms" if ttb is not None else "none "
+                            "projected"))
+    return text
+
+
 _RENDERERS = {
     "load": _render_load,
+    "forecast": _render_forecast,
+    "forecast_refresh": _render_forecast,
     "simulate": _render_simulate,
     "devicestats": _render_devicestats,
     "fleet": _render_fleet,

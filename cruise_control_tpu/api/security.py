@@ -33,6 +33,9 @@ ENDPOINT_MIN_ROLE: dict[str, Role] = {
     # fleet summary is a read; a forced fleet recompute is USER-level
     # like rebalance (it only refreshes member caches, never executes).
     "fleet": Role.VIEWER, "fleet_rebalance": Role.USER,
+    # forecast report is a read; forcing a refit + sweep is USER-level
+    # like fleet_rebalance (compute, never execution).
+    "forecast": Role.VIEWER, "forecast_refresh": Role.USER,
     "rebalance": Role.USER, "add_broker": Role.USER,
     "remove_broker": Role.USER, "demote_broker": Role.USER,
     "fix_offline_replicas": Role.USER, "topic_configuration": Role.USER,

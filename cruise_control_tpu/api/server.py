@@ -41,21 +41,24 @@ _PLAINTEXT_MARKER = "x-cc-render-plaintext"
 
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
-                 "permissions", "bootstrap", "train", "openapi", "fleet"}
+                 "permissions", "bootstrap", "train", "openapi", "fleet",
+                 "forecast"}
 POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                   "fix_offline_replicas", "demote_broker",
                   "topic_configuration", "rightsize", "remove_disks",
                   "stop_proposal_execution", "pause_sampling",
                   "resume_sampling", "admin", "review", "simulate",
-                  "fleet_rebalance"}
+                  "fleet_rebalance", "forecast_refresh"}
 #: POSTs that execute immediately even with two-step verification on
 #: (ref Purgatory: REVIEW itself and flow-control endpoints skip review;
 #: simulate is a pure read — a what-if sweep mutates nothing, so parking
 #: it for review would only delay the answer; fleet_rebalance only
 #: refreshes the members' proposal caches — execution stays behind the
-#: per-cluster endpoints, which keep their review parking).
+#: per-cluster endpoints, which keep their review parking;
+#: forecast_refresh only refits host-side forecasts and re-scores a
+#: dry-run sweep — provisioning actions stay behind rightsize/detector).
 NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution", "simulate",
-                      "fleet_rebalance"}
+                      "fleet_rebalance", "forecast_refresh"}
 #: bare GET handlers outside the servlet endpoint table (observability
 #: surfaces + the API explorer) — instrumented through the same shared
 #: request-timing wrapper as every dispatched endpoint.
@@ -662,6 +665,10 @@ class CruiseControlApp:
             return 200, facade.fleet_summary(), {}
         if endpoint == "fleet_rebalance":
             return 200, facade.fleet_rebalance(), {}
+        if endpoint == "forecast":
+            return 200, facade.forecast_json(), {}
+        if endpoint == "forecast_refresh":
+            return 200, facade.forecast_refresh(), {}
         return 404, {"errorMessage": f"unknown endpoint {endpoint}"}, {}
 
     def _admin(self, params: ParsedParams) -> dict:
@@ -840,6 +847,12 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         parts = ["kafkacruisecontrol", "fleet_rebalance"]
     elif rest == ["fleet"]:
         parts = ["kafkacruisecontrol", "fleet"]
+    elif rest == ["forecast"]:
+        # GET /forecast reads the cached trajectory report (viewer);
+        # POST /forecast forces a refit + fresh sweep (user) — one REST
+        # path, two servlet endpoints, split by method here.
+        parts = ["kafkacruisecontrol",
+                 "forecast_refresh" if method == "POST" else "forecast"]
     if len(parts) != 2 or parts[0] != "kafkacruisecontrol":
         return json_resp(404, {"errorMessage": f"bad path {parsed.path}"})
     endpoint = parts[1].lower()

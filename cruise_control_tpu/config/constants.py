@@ -641,7 +641,7 @@ def _detector_defs(d: ConfigDef) -> None:
              importance=Importance.HIGH, doc="Master self-healing switch")
     for name in ("broker.failure", "goal.violation", "disk.failure",
                  "topic.anomaly", "metric.anomaly", "maintenance.event",
-                 "broker.risk"):
+                 "broker.risk", "capacity.forecast"):
         d.define(f"self.healing.{name}.enabled", ConfigType.BOOLEAN, False,
                  importance=Importance.MEDIUM,
                  doc=f"Self-healing for {name} anomalies")
@@ -707,6 +707,67 @@ def _detector_defs(d: ConfigDef) -> None:
                  "the default covers an N-2 pairwise sweep up to 128 "
                  "brokers — lower it to bound device memory on very "
                  "large partition counts)")
+    # Forecast engine + proactive provisioning (forecast/;
+    # docs/forecasting.md).
+    d.define("forecast.enabled", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Forecast engine (forecast/engine.py): fit per-topic "
+                 "load trajectories from the aggregated window history "
+                 "and score projected horizons as batched what-if "
+                 "sweeps. False disables the capacity-forecast detector "
+                 "and the /forecast sweep machinery (the endpoint still "
+                 "answers with enabled=false state).")
+    d.define("forecast.horizon.ms", ConfigType.LIST,
+             "3600000,21600000,86400000",
+             importance=Importance.LOW,
+             doc="Forecast horizons (ms, comma-separated; default "
+                 "+1h/+6h/+24h): every (horizon x quantile) point "
+                 "becomes one scenario of the batched trajectory sweep. "
+                 "Each must be a positive integer (parse-time check).")
+    d.define("forecast.interval.ms", ConfigType.LONG, 1_800_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Capacity-forecast detector interval AND the refit "
+                 "staleness bound (a fit older than this, or from an "
+                 "older model generation, refits lazily); 0 disables "
+                 "the scheduled detector (on-demand /forecast still "
+                 "works).")
+    d.define("forecast.quantiles", ConfigType.LIST, "0.5,0.9",
+             importance=Importance.LOW,
+             doc="Projection quantiles (comma-separated, each in "
+                 "(0, 1); parse-time check). The largest is the "
+                 "detection quantile proactive provisioning judges "
+                 "breaches at.")
+    d.define("forecast.min.history.windows", ConfigType.INT, 3,
+             validator=Range.at_least(2), importance=Importance.LOW,
+             doc="Windows required before a topic gets a trend fit; "
+                 "shorter histories degrade to a flat persistence "
+                 "forecast (docs/forecasting.md degrade ladder).")
+    d.define("forecast.seasonal.period.ms", ConfigType.LONG, 86_400_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Seasonal period of the diurnal component (default "
+                 "24 h). Histories shorter than one period — or a "
+                 "period under two windows — degrade to level+trend. "
+                 "0 disables seasonality.")
+    d.define("forecast.store.path", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="Persisted fitted-forecast JSON (empty = the default "
+                 ".jax_cache/forecast/v<N>/forecasts.json, next to the "
+                 "tuned-config store) so restarts serve projections "
+                 "without refitting cold.")
+    d.define("provision.partition.count.enabled", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="Let the capacity-forecast detector propose partition-"
+                 "count growth for hot topics (forecast-informed "
+                 "targets, executed through the provisioner's "
+                 "create-partitions path). False keeps broker-add "
+                 "recommendations only.")
+    d.define("provision.partition.count.max.skew", ConfigType.DOUBLE, 4.0,
+             validator=Range.at_least(1.0), importance=Importance.LOW,
+             doc="Topics whose partition-load skew (max/mean) exceeds "
+                 "this get NO partition-count recommendation: with a "
+                 "skewed key distribution the hot partition keeps its "
+                 "load no matter how many siblings exist "
+                 "(arxiv 2205.09415).")
     d.define("fleet.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.LOW,
              doc="Fleet control plane (fleet/registry.py): this process "
@@ -1074,6 +1135,40 @@ class CruiseControlConfig(AbstractConfig):
                 f"own them too. Got search.branches={branches}, "
                 f"search.mesh.devices={mesh}, "
                 f"search.population={population} (docs/fleet.md).")
+        # Forecast list keys: LIST-typed values get per-element
+        # validation here (the ConfigDef layer only types the list) —
+        # a malformed horizon/quantile must fail the deploy, not the
+        # first detector round at 3am.
+        horizons = self.get_list("forecast.horizon.ms")
+        if self.get_boolean("forecast.enabled") and not horizons:
+            raise ConfigException(
+                "forecast.horizon.ms must name at least one horizon "
+                "while forecast.enabled=true (an empty list would "
+                "silently reduce every sweep to the +0 baseline and "
+                "the detector could never project a breach)")
+        for raw in horizons:
+            try:
+                ok = int(raw) > 0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ConfigException(
+                    f"forecast.horizon.ms entries must be positive "
+                    f"integers (ms), got {raw!r} in {horizons}")
+        quantiles = self.get_list("forecast.quantiles")
+        if self.get_boolean("forecast.enabled") and not quantiles:
+            raise ConfigException(
+                "forecast.quantiles must name at least one quantile "
+                "while forecast.enabled=true")
+        for raw in quantiles:
+            try:
+                ok = 0.0 < float(raw) < 1.0
+            except (TypeError, ValueError):
+                ok = False
+            if not ok:
+                raise ConfigException(
+                    f"forecast.quantiles entries must be numbers in "
+                    f"(0, 1), got {raw!r} in {quantiles}")
         # Even sharding: every padded partition count is a multiple of
         # the pad multiple, so the multiple itself must divide by the
         # mesh device count. mesh == -1 (all devices) re-checks at
@@ -1170,6 +1265,26 @@ class CruiseControlConfig(AbstractConfig):
             objective=self.get_string("search.population.objective"),
             hard_weight=self.get_double("search.population.hard.weight"),
             move_weight=self.get_double("search.population.move.weight"))
+
+    def forecast_config(self):
+        """``forecast.*`` / ``provision.partition.count.*`` view
+        (forecast.ForecastConfig); list values are parse-time validated
+        in ``_sanity_check_cross_keys``."""
+        from ..forecast import ForecastConfig
+        return ForecastConfig(
+            enabled=self.get_boolean("forecast.enabled"),
+            horizons_ms=tuple(int(h) for h in
+                              self.get_list("forecast.horizon.ms")),
+            quantiles=tuple(float(q) for q in
+                            self.get_list("forecast.quantiles")),
+            interval_ms=self.get_int("forecast.interval.ms"),
+            min_history_windows=self.get_int(
+                "forecast.min.history.windows"),
+            seasonal_period_ms=self.get_int("forecast.seasonal.period.ms"),
+            partition_count_enabled=self.get_boolean(
+                "provision.partition.count.enabled"),
+            partition_count_max_skew=self.get_double(
+                "provision.partition.count.max.skew"))
 
     def executor_config(self) -> ExecutorConfig:
         throttle = self.get_int("default.replication.throttle")

@@ -1,11 +1,11 @@
 """Detector layer (L5): anomaly detection + self-healing (ref
 ``cruise-control/.../detector/``)."""
 
-from .anomalies import (BrokerFailures, BrokerRisk, DiskFailures,
-                        GoalViolations, KafkaAnomaly, KafkaAnomalyType,
-                        KafkaMetricAnomaly, MaintenanceEvent,
-                        MaintenanceEventType, SlowBrokers,
-                        TopicReplicationFactorAnomaly)
+from .anomalies import (BrokerFailures, BrokerRisk, CapacityForecast,
+                        DiskFailures, GoalViolations, KafkaAnomaly,
+                        KafkaAnomalyType, KafkaMetricAnomaly,
+                        MaintenanceEvent, MaintenanceEventType,
+                        SlowBrokers, TopicReplicationFactorAnomaly)
 from .detectors import (BalancednessWeights, BrokerFailureDetector,
                         DiskFailureDetector, GoalViolationDetector,
                         MaintenanceEventDetector, MaintenanceEventReader,
@@ -22,7 +22,8 @@ from .provisioner import (BasicProvisioner, Provisioner,
                           ProvisionStatus)
 
 __all__ = [
-    "BrokerFailures", "BrokerRisk", "ResilienceDetector",
+    "BrokerFailures", "BrokerRisk", "CapacityForecast",
+    "ResilienceDetector",
     "DiskFailures", "GoalViolations", "KafkaAnomaly",
     "KafkaAnomalyType", "KafkaMetricAnomaly", "MaintenanceEvent",
     "MaintenanceEventType", "SlowBrokers", "TopicReplicationFactorAnomaly",

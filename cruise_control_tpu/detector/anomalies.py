@@ -25,9 +25,13 @@ class KafkaAnomalyType(enum.IntEnum):
     METRIC_ANOMALY = 3
     TOPIC_ANOMALY = 4
     GOAL_VIOLATION = 5
-    #: predicted (what-if) risk, not a live fault — lowest priority:
+    #: predicted (what-if) risk, not a live fault — low priority:
     #: every realized anomaly outranks a forecast
     BROKER_RISK = 6
+    #: predicted capacity pressure from the load-trajectory forecast
+    #: (forecast/detector.py) — like BROKER_RISK, a projection: lowest
+    #: priority, provisioning evidence rather than a self-healing drain
+    CAPACITY_FORECAST = 7
 
 
 _ids = itertools.count()
@@ -277,6 +281,56 @@ class BrokerRisk(KafkaAnomaly):
         out["maxRisk"] = round(self.max_risk, 4)
         if self.recommendation is not None:
             out["recommendation"] = self.recommendation.to_json()
+        return out
+
+
+@dataclass
+class CapacityForecast(KafkaAnomaly):
+    """Predicted capacity breach from the load-trajectory forecast
+    (forecast/detector.py): at the scored horizon/quantile the projected
+    load violates hard goals or exceeds usable capacity. Arrives BEFORE
+    the pressure materializes — the whole point — so the urgency signal
+    (``time_to_breach_ms``) rides the reason string every notifier
+    alert renders, and the 'fix' is provisioning (broker adds and/or
+    partition-count growth for hot topics), never a drain of a cluster
+    that is still healthy today.
+    """
+
+    #: estimated ms until the projected breach (linear interpolation
+    #: over the scored horizons' capacity pressure)
+    time_to_breach_ms: int | None = None
+    #: the (horizon, quantile) point the breach was scored at
+    horizon_ms: int = 0
+    quantile: float = 0.9
+    #: ProvisionRecommendations (broker add + per-topic partition
+    #: counts), each carrying time_to_breach_ms + forecast provenance
+    recommendations: list = field(default_factory=list)
+    max_risk: float = 0.0
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.CAPACITY_FORECAST
+
+    def reason(self) -> str:
+        when = ("unknown" if self.time_to_breach_ms is None
+                else f"~{self.time_to_breach_ms / 60000.0:.0f} min")
+        return (f"Forecast breach at +{self.horizon_ms}ms "
+                f"p{int(round(self.quantile * 100))} "
+                f"(time to breach {when}, risk {self.max_risk:.2f})")
+
+    def fix(self, facade) -> bool:
+        detector = getattr(facade, "detector", None)
+        provisioner = getattr(detector, "provisioner", None)
+        if provisioner is None or not self.recommendations:
+            return False
+        provisioner.rightsize(recommendations=list(self.recommendations))
+        return True
+
+    def to_json(self) -> dict:
+        out = super().to_json()
+        out["timeToBreachMs"] = self.time_to_breach_ms
+        out["horizonMs"] = self.horizon_ms
+        out["quantile"] = self.quantile
+        out["maxRisk"] = round(self.max_risk, 4)
+        out["recommendations"] = [r.to_json()
+                                  for r in self.recommendations]
         return out
 
 

@@ -324,15 +324,22 @@ class AnomalyDetectorManager:
         """ref AnomalyDetectorState.java:424."""
         balancedness = None
         resilience = None
+        time_to_breach = None
         for sched in self._schedules:
             if hasattr(sched.detector, "last_balancedness"):
                 balancedness = sched.detector.last_balancedness
             if hasattr(sched.detector, "last_resilience"):
                 resilience = sched.detector.last_resilience
+            if hasattr(sched.detector, "last_time_to_breach_ms"):
+                time_to_breach = sched.detector.last_time_to_breach_ms
         return {
             # 100 = the last N-1 sweep found every single-broker loss
             # survivable (resilience detector; None = not registered/run)
             "resilienceScore": resilience,
+            # estimated ms until the forecast trajectory's projected
+            # capacity breach (capacity-forecast detector; None = not
+            # registered/run or no breach projected)
+            "forecastTimeToBreachMs": time_to_breach,
             "selfHealingEnabled": {
                 t.name: v for t, v in
                 self.notifier.self_healing_enabled().items()},

@@ -39,6 +39,16 @@ class ProvisionRecommendation:
     #: headroom from the resilience sweep). Excluded from hash/eq so the
     #: frozen record stays hashable despite the dict payload.
     headroom: dict | None = field(default=None, hash=False, compare=False)
+    #: urgency signal (no reference analog): estimated ms until the
+    #: predicted capacity breach materializes — None for reactive
+    #: verdicts (the breach already happened). Rendered in ``/state``
+    #: recent anomalies and every notifier alert message.
+    time_to_breach_ms: int | None = None
+    #: forecast provenance for predictive verdicts (fit timestamp,
+    #: horizon/quantile scored, backtest error — ForecastSet.provenance
+    #: plus the scoring point); None for reactive verdicts. Excluded
+    #: from hash/eq like ``headroom``.
+    forecast: dict | None = field(default=None, hash=False, compare=False)
 
     def to_json(self) -> dict:
         out: dict = {"status": self.status.value, "reason": self.reason}
@@ -52,6 +62,10 @@ class ProvisionRecommendation:
             out["resource"] = self.resource
         if self.headroom is not None:
             out["headroom"] = self.headroom
+        if self.time_to_breach_ms is not None:
+            out["timeToBreachMs"] = self.time_to_breach_ms
+        if self.forecast is not None:
+            out["forecast"] = self.forecast
         return out
 
 

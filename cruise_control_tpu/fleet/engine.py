@@ -822,3 +822,174 @@ class FleetOptimizer:
                     if v and g.hard],
                 "scenarios": n})
         return summaries
+
+    # ------------------------------------------------- trajectory sweep
+    def sweep_trajectories(self, fleet: FleetModel, trajectories
+                           ) -> list[dict]:
+        """Forecast trajectory sweep across the whole fleet in ONE
+        dispatch: the ``[S]`` projected-load scenario axis composed with
+        the ``[C]`` cluster axis — cluster axis sharded like the walk,
+        scenario axis vmapped like ``/simulate``, scored by the SAME
+        shared scenario scorer, so a fleet-projected risk means exactly
+        what a single-cluster forecast sweep reports.
+
+        ``trajectories`` is either one scenario list (every member
+        scores the same horizon/quantile grid, factors resolved against
+        each member's own topics) or ``{cluster_id: [scenarios]}`` with
+        equal lengths (each member its own fitted factors). Returns one
+        summary per member with per-scenario risk/pressure rows."""
+        t0 = time.monotonic()
+        with self.collector.cycle("fleet-forecast"), \
+                self.tracer.span("fleet.sweep-trajectories",
+                                 clusters=fleet.num_clusters):
+            out = self._sweep_trajectories_impl(fleet, trajectories)
+        self.last_dispatch_s = time.monotonic() - t0
+        return out
+
+    def _sweep_trajectories_impl(self, fleet: FleetModel, trajectories
+                                 ) -> list[dict]:
+        from ..whatif.engine import trajectory_pscale_row
+        members = fleet.members
+        C = len(members)
+        if isinstance(trajectories, dict):
+            missing = [m.cluster_id for m in members
+                       if m.cluster_id not in trajectories]
+            if missing:
+                raise ValueError(
+                    f"sweep_trajectories: no trajectory grid for fleet "
+                    f"member(s) {missing}; the per-cluster dict form "
+                    f"must cover every member")
+            per_member = [trajectories[m.cluster_id] for m in members]
+        else:
+            per_member = [list(trajectories)] * C
+        if not per_member or not per_member[0]:
+            raise ValueError("sweep_trajectories requires at least one "
+                             "scenario")
+        S = len(per_member[0])
+        if any(len(t) != S for t in per_member):
+            raise ValueError(
+                "every member must score the same scenario count (one "
+                "compiled [C, S] grid); pad shorter trajectories with "
+                "no-op scenarios")
+
+        binds = [tuple((g.name, g.bind_signature())
+                       for g in (gg.bind(m.metadata)
+                                 for gg in self.optimizer.goals))
+                 for m in members]
+        topics = [m.metadata.num_topics for m in members]
+        if any(b != binds[0] for b in binds) or \
+                any(t != topics[0] for t in topics):
+            # Heterogeneous bindings: per-member recursion, same degrade
+            # path (and bucket-floor suspension) as the N-1 sweep.
+            out: list[dict] = []
+            floor = self.cluster_bucket_floor
+            self.cluster_bucket_floor = 0
+            try:
+                for m, traj in zip(members, per_member):
+                    sub = FleetModel.stack([(m.cluster_id, m.model,
+                                             m.metadata)])
+                    out.extend(self._sweep_trajectories_impl(sub, traj))
+            finally:
+                self.cluster_bucket_floor = floor
+            return out
+
+        goals = [g.bind(members[0].metadata) for g in self.optimizer.goals]
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals)
+        needs_topics = needs_tlc or any(g.uses_topic_counts for g in goals)
+        num_topics = topics[0]
+        B_f = members[0].model.num_brokers_padded
+        P_f = members[0].model.num_partitions_padded
+        S_pad = round_up(S, self.scenario_pad_multiple)
+        D, k, C_pad = self._layout(C)
+        mesh = self._mesh(D)
+
+        # Per-(cluster, scenario) load-scale planes: each member's
+        # factors resolve against its OWN topic ids; padding rows (both
+        # axes) are factor-1 no-ops.
+        pscale = np.ones((C_pad, S_pad, P_f), np.float32)
+        for c, (m, traj) in enumerate(zip(members, per_member)):
+            ptopic = np.asarray(m.model.partition_topic)
+            for s, scn in enumerate(traj):
+                pscale[c, s] = trajectory_pscale_row(
+                    scn, m.metadata.topic_index, ptopic)
+
+        stacked = jax.tree.map(
+            lambda a: (jnp.concatenate(
+                [a, jnp.repeat(a[:1], C_pad - C, axis=0)])
+                if C_pad > C else a), fleet.stacked)
+        pvalid = stacked.partition_valid
+
+        sig = _shape_sig(stacked) + (S_pad,)
+        key = (("fleet-forecast",) + sig
+               + (tuple((g.name, g.bind_signature()) for g in goals),
+                  num_topics if needs_topics else None, needs_tlc, D))
+
+        def build():
+            scorer = make_scenario_scorer(
+                goals, self.optimizer.constraint.capacity_threshold,
+                num_topics=num_topics, needs_topics=needs_topics,
+                needs_tlc=needs_tlc)
+
+            def one(model, ps, pv):
+                B = model.num_brokers_padded
+                no_dead = jnp.zeros((B,), bool)
+                no_cap = jnp.ones((B, 4), jnp.float32)
+                viol, vscale, _hr, _hf, pressure, unavailable, n_off = \
+                    scorer(model, no_dead, no_dead, no_cap, ps, pv)
+                return viol, vscale, pressure, unavailable, n_off
+
+            def per_cluster(t):
+                model, ps_c, pv_c = t
+                return jax.vmap(one, in_axes=(None, 0, None))(
+                    model, ps_c, pv_c)
+
+            def body(models, ps_b, pv_b):
+                return jax.lax.map(per_cluster, (models, ps_b, pv_b))
+
+            def run(models, ps_b, pv_b):
+                args = (models, ps_b, pv_b)
+                in_specs = tuple(_tree_specs(a, P(CLUSTER_AXIS))
+                                 for a in args)
+                out_shape = jax.eval_shape(body, *args)
+                out_specs = _tree_specs(out_shape, P(CLUSTER_AXIS))
+                return shard_map(body, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs)(*args)
+
+            return self.collector.track("fleet-forecast", jax.jit(run))
+
+        program = self._programs.get_or_build(key, build)
+        self.collector.record_h2d(pscale.nbytes)
+        out = program(stacked, jnp.asarray(pscale), pvalid)
+        fetched = jax.device_get(out)
+        self.collector.record_d2h(self.collector.tree_bytes(fetched))
+        viol, vscale, pressure, unavailable, _n_off = (
+            np.asarray(a) for a in fetched)
+
+        hard = np.array([g.hard for g in goals], bool)
+        violated = violated_matrix(viol, vscale)        # [C_pad, S_pad, G]
+        n_hard = max(int(hard.sum()), 1)
+        n_soft = max(int((~hard).sum()), 1)
+        hard_frac = violated[..., hard].sum(axis=-1) / n_hard
+        soft_frac = violated[..., ~hard].sum(axis=-1) / n_soft
+        valid_parts = np.maximum(
+            np.asarray(jax.device_get(pvalid)).sum(axis=1), 1)[:, None]
+        risk = risk_scores(hard_frac, soft_frac, pressure,
+                           unavailable.astype(int), valid_parts)
+
+        summaries = []
+        for c, (m, traj) in enumerate(zip(members, per_member)):
+            rows = [{"scenario": scn.name,
+                     "horizonMs": scn.horizon_ms,
+                     "quantile": scn.quantile,
+                     "risk": round(float(risk[c, s]), 4),
+                     "capacityPressure": round(float(pressure[c, s]), 4),
+                     "violatedHardGoals": [
+                         g.name for g, v in zip(goals, violated[c, s])
+                         if v and g.hard]}
+                    for s, scn in enumerate(traj)]
+            worst = max(range(S), key=lambda s: risk[c, s])
+            summaries.append({"clusterId": m.cluster_id,
+                              "maxRisk": round(float(risk[c, worst]), 4),
+                              "riskiest": traj[worst].name,
+                              "scenarios": rows})
+        return summaries

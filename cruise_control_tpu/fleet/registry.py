@@ -41,6 +41,7 @@ class FleetClusterHandle:
     generation: int | None = None
     last_error: str | None = None
     last_risk: dict | None = None
+    last_forecast: dict | None = None
     last_summary: dict = field(default_factory=dict)
 
 
@@ -289,6 +290,52 @@ class FleetRegistry:
         self._tick_timer.update(_time.monotonic() - t0)
         return summary
 
+    def forecast_sweep(self, trajectories, now_ms: int | None = None
+                       ) -> list[dict]:
+        """Sweep projected load trajectories across EVERY ready member
+        in one batched ``[C, S]`` dispatch (``FleetOptimizer.
+        sweep_trajectories`` — the scenario axis composed with the
+        cluster axis). ``trajectories`` is one
+        :class:`~..whatif.TrajectoryScale` grid (each member's factors
+        resolve against its own topics) or ``{cluster_id: grid}``.
+        Per-member summaries land on the handles for ``/fleet``.
+        Serialized with the background tick on the tick mutex — both
+        paths dispatch on the shared engine and pin its cluster-axis
+        shape floor."""
+        now = now_ms if now_ms is not None else self._now_ms()
+        with self._tick_lock:
+            return self._forecast_sweep_locked(trajectories, now)
+
+    def _forecast_sweep_locked(self, trajectories, now: int) -> list[dict]:
+        with self._lock:
+            members = list(self._members.values())
+        self.engine.cluster_bucket_floor = len(members)
+        ready = []
+        for h in members:
+            try:
+                result = h.monitor.cluster_model(now)
+            except Exception as e:
+                h.ready = False
+                h.last_error = f"{type(e).__name__}: {e}"
+                continue
+            h.ready = True
+            h.last_error = None
+            ready.append((h, result))
+        if not ready:
+            return []
+        fleet = FleetModel.stack(
+            [(h.cluster_id, r.model, r.metadata, r.generation, r.stale)
+             for h, r in ready],
+            broker_pad_multiple=self.broker_pad_multiple,
+            partition_pad_multiple=self.partition_pad_multiple)
+        self.last_bucket = fleet.bucket
+        summaries = self.engine.sweep_trajectories(fleet, trajectories)
+        by_id = {s["clusterId"]: s for s in summaries}
+        for h, _ in ready:
+            if h.cluster_id in by_id:
+                h.last_forecast = by_id[h.cluster_id]
+        return summaries
+
     @staticmethod
     def _cluster_summary(h: FleetClusterHandle, res) -> dict:
         total = max(len(res.goal_results), 1)
@@ -348,6 +395,10 @@ class FleetRegistry:
                 row["freshness"] = h.cache.freshness_json(now)
             if h.last_risk is not None:
                 row["risk"] = h.last_risk
+            if h.last_forecast is not None:
+                row["forecast"] = {
+                    "maxRisk": h.last_forecast.get("maxRisk"),
+                    "riskiest": h.last_forecast.get("riskiest")}
             clusters.append(row)
         return {"enabled": True,
                 "numClusters": len(members),

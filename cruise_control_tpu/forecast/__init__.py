@@ -1,0 +1,30 @@
+"""Forecast subsystem (L-forecast): predictive load trajectories,
+proactive provisioning and partition-count proposals — the time axis on
+top of the what-if scenario machinery (docs/forecasting.md).
+
+- :mod:`.model` — deterministic per-topic level+trend+seasonal fits
+  over the aggregator's window history, with confidence intervals, a
+  backtest error metric, and persistence next to the tuned-config store;
+- :mod:`.engine` — :class:`ForecastEngine`: fits -> ``TrajectoryScale``
+  scenario batches -> batched ``WhatIfEngine`` sweeps (zero new device
+  programs) -> time-to-breach estimates;
+- :mod:`.detector` — :class:`CapacityForecastDetector`: the scheduled
+  loop converting predicted-horizon violations into
+  ``ProvisionRecommendation``s BEFORE pressure materializes.
+"""
+
+from .model import (FORECAST_STORE_VERSION, ForecastSet, ForecastStore,
+                    TopicForecast, fit_series, fit_topic_forecasts,
+                    quantile_z)
+from .engine import (DEFAULT_HORIZONS_MS, DEFAULT_QUANTILES,
+                     ForecastConfig, ForecastEngine, ForecastReport,
+                     HorizonOutcome, time_to_breach_ms)
+from .detector import CapacityForecastDetector
+
+__all__ = [
+    "FORECAST_STORE_VERSION", "TopicForecast", "ForecastSet",
+    "ForecastStore", "fit_series", "fit_topic_forecasts", "quantile_z",
+    "ForecastConfig", "ForecastEngine", "ForecastReport",
+    "HorizonOutcome", "time_to_breach_ms", "DEFAULT_HORIZONS_MS",
+    "DEFAULT_QUANTILES", "CapacityForecastDetector",
+]

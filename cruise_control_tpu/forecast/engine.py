@@ -1,0 +1,549 @@
+"""Forecast engine: observed history -> fitted trajectories -> batched
+what-if sweeps -> proactive readouts.
+
+The closing of the loop ROADMAP item 2 asked for: the aggregator already
+holds the ``[E, M, W]`` window history, the what-if engine already
+scores scenario batches in one vmapped dispatch, and the detector/
+provisioner path already actuates recommendations. This engine is the
+glue — it fits per-topic forecasts from the windows (forecast/model.py),
+materializes forecast horizons as :class:`~..whatif.TrajectoryScale`
+scenario batches, and runs them through the UNMODIFIED
+``WhatIfEngine`` — zero new device programs for scoring; a trajectory
+sweep compiles and caches exactly like an N-1 sweep of the same shapes.
+
+Surfaced as ``GET/POST /forecast``, the ``forecast`` section of
+``/devicestats``, and the ``Forecast.*`` sensor family; the scheduled
+:class:`~.detector.CapacityForecastDetector` drives the same engine on
+its interval. See docs/forecasting.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..core.aggregator import (AggregationOptions, Extrapolation,
+                               NotEnoughValidWindowsError)
+from ..core.metricdef import KafkaMetric
+from ..whatif.spec import TrajectoryScale
+from .model import ForecastSet, ForecastStore, fit_topic_forecasts
+
+LOG = logging.getLogger(__name__)
+
+#: default forecast horizons: +1h / +6h / +24h (forecast.horizon.ms)
+DEFAULT_HORIZONS_MS = (3_600_000, 21_600_000, 86_400_000)
+#: default projection quantiles: median + p90 (forecast.quantiles)
+DEFAULT_QUANTILES = (0.5, 0.9)
+
+
+@dataclass
+class ForecastConfig:
+    """The ``forecast.*`` / ``provision.partition.count.*`` config view
+    (config/constants.py validates these at parse time)."""
+
+    enabled: bool = True
+    horizons_ms: tuple[int, ...] = DEFAULT_HORIZONS_MS
+    quantiles: tuple[float, ...] = DEFAULT_QUANTILES
+    interval_ms: int = 1_800_000
+    min_history_windows: int = 3
+    seasonal_period_ms: int = 86_400_000
+    partition_count_enabled: bool = True
+    #: a topic whose per-partition load skew (max/mean) exceeds this is
+    #: NOT given a partition-count recommendation: with a skewed key
+    #: distribution the hot partition keeps its load no matter how many
+    #: siblings exist (arxiv 2205.09415's partitioning constraint).
+    partition_count_max_skew: float = 4.0
+    #: growth factor below which no partition-count change is proposed
+    #: (churning counts for noise-level growth costs consumer rebalances)
+    partition_count_min_factor: float = 1.1
+
+    @property
+    def detection_quantile(self) -> float:
+        """The quantile proactive provisioning judges breaches at: the
+        most pessimistic configured quantile."""
+        return max(self.quantiles) if self.quantiles else 0.9
+
+
+@dataclass
+class HorizonOutcome:
+    """One (horizon, quantile) point of a trajectory sweep: the what-if
+    scorecard plus the projection that produced it."""
+
+    horizon_ms: int
+    quantile: float
+    risk: float
+    capacity_pressure: float
+    violated_goals: list[str]
+    violated_hard_goals: list[str]
+    headroom: dict
+    worst_broker: object
+    max_factor: float
+    scenario_name: str
+
+    def to_json(self) -> dict:
+        return {"horizonMs": self.horizon_ms, "quantile": self.quantile,
+                "risk": round(self.risk, 4),
+                "capacityPressure": round(self.capacity_pressure, 4),
+                "violatedGoals": self.violated_goals,
+                "violatedHardGoals": self.violated_hard_goals,
+                "headroom": self.headroom,
+                "worstBroker": self.worst_broker,
+                "maxFactor": round(self.max_factor, 4),
+                "scenario": self.scenario_name}
+
+
+@dataclass
+class ForecastReport:
+    """One trajectory sweep over the live model: the baseline (+0)
+    outcome, every (horizon, quantile) outcome, and the derived
+    time-to-breach estimate."""
+
+    outcomes: list[HorizonOutcome]
+    baseline: HorizonOutcome | None
+    time_to_breach_ms: int | None
+    breach_horizon_ms: int | None
+    breach_quantile: float | None
+    duration_s: float
+    generated_at_ms: int
+    stale_model: bool = False
+
+    def to_json(self) -> dict:
+        return {"generatedAtMs": self.generated_at_ms,
+                "durationMs": round(self.duration_s * 1e3, 3),
+                "staleModel": self.stale_model,
+                "timeToBreachMs": self.time_to_breach_ms,
+                "breachHorizonMs": self.breach_horizon_ms,
+                "breachQuantile": self.breach_quantile,
+                "baseline": (self.baseline.to_json()
+                             if self.baseline is not None else None),
+                "horizons": [o.to_json() for o in self.outcomes]}
+
+
+def time_to_breach_ms(points: list[tuple[int, float]],
+                      threshold: float = 1.0) -> int | None:
+    """Linear-interpolated time until capacity pressure crosses
+    ``threshold``, from (horizon_ms, pressure) points sorted by horizon
+    (the +0 baseline included). None when no horizon reaches it. The
+    EARLIEST breached point wins — a cluster already over the threshold
+    at its first scored horizon reports that horizon (0 for the
+    baseline), never a later crossing of a declining curve. The first
+    crossing segment is interpolated — pressure between scored horizons
+    is approximated linearly, which the chaos cross-check validates
+    against realized load."""
+    pts = sorted(points)
+    for (h0, p0), (h1, p1) in zip(pts, pts[1:]):
+        if p0 >= threshold:
+            return int(h0)
+        if p1 >= threshold:
+            frac = (threshold - p0) / (p1 - p0)
+            return int(round(h0 + frac * (h1 - h0)))
+    if pts and pts[-1][1] >= threshold:
+        return int(pts[-1][0])
+    return None
+
+
+class ForecastEngine:
+    """Fits, persists, projects and scores per-topic load trajectories.
+
+    Shares the facade's :class:`~..whatif.WhatIfEngine` (same compiled
+    sweep programs as ``/simulate`` and the resilience detector) and the
+    monitor's partition aggregator (the fit reads the SAME windows the
+    model builder gathers). Thread-safe: the detector thread and HTTP
+    requests serialize refits on one lock; sweeps ride the what-if
+    engine's own program-cache locking.
+    """
+
+    def __init__(self, monitor, whatif, *,
+                 config: ForecastConfig | None = None,
+                 store: ForecastStore | None = None,
+                 registry=None, tracer=None, collector=None,
+                 now_ms=None) -> None:
+        from ..core.runtime_obs import default_collector
+        from ..core.sensors import MetricRegistry
+        from ..core.tracing import default_tracer
+        self.monitor = monitor
+        self.whatif = whatif
+        self.config = config or ForecastConfig()
+        #: persistence slot (forecast/model.py ForecastStore) — None =
+        #: in-memory only; serve.py wires the store so restarts serve
+        #: projections without refitting cold.
+        self.store = store
+        self.registry = registry or MetricRegistry()
+        self.tracer = tracer or default_tracer()
+        self.collector = collector or default_collector()
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._lock = threading.RLock()
+        #: last completed fit (restored from the store when wired)
+        self.last_fit: ForecastSet | None = (store.load()
+                                             if store is not None else None)
+        #: last completed trajectory sweep
+        self.last_report: ForecastReport | None = None
+        #: (generation, {topic: per-partition NW_IN means}) cached off
+        #: the last topic_series dense pass — partition_skew reads it
+        self._partition_loads: tuple[int, dict] | None = None
+        self.num_fits = 0
+        self.num_sweeps = 0
+        name = MetricRegistry.name
+        self._fit_timer = self.registry.timer(name("Forecast", "fit-timer"))
+        self._sweep_timer = self.registry.timer(
+            name("Forecast", "sweep-timer"))
+        self._refresh_meter = self.registry.meter(
+            name("Forecast", "refresh-rate"))
+        self.registry.gauge(
+            name("Forecast", "topics-fitted"),
+            lambda: None if self.last_fit is None else len(self.last_fit))
+        self.registry.gauge(
+            name("Forecast", "backtest-mape"),
+            lambda: (None if self.last_fit is None
+                     else self.last_fit.worst_backtest_mape()))
+        self.registry.gauge(
+            name("Forecast", "time-to-breach-ms"),
+            lambda: (None if self.last_report is None
+                     else self.last_report.time_to_breach_ms))
+        self.registry.gauge(
+            name("Forecast", "horizon-max-risk"),
+            lambda: (None if (self.last_report is None
+                              or not self.last_report.outcomes)
+                     else max(o.risk for o in self.last_report.outcomes)))
+
+    # -------------------------------------------------------------- fitting
+    def topic_series(self, now_ms: int
+                     ) -> tuple[dict, int, int]:
+        """Per-topic window series from the monitor's partition
+        aggregator: topic -> (values[4, W], valid[W]) where values sums
+        the 4 resource metrics over the topic's partitions per window
+        (valid cells only). Returns (series, window_ms, generation)."""
+        agg = self.monitor.partition_aggregator
+        result = agg.aggregate(0, now_ms,
+                               AggregationOptions(min_valid_windows=1),
+                               use_dense=True)
+        d = result.dense
+        if d is None or not d.window_times_ms:
+            raise NotEnoughValidWindowsError(
+                "no aggregated windows to fit forecasts from")
+        E, _M, W = d.values.shape
+        no_valid = Extrapolation.NO_VALID_EXTRAPOLATION.value
+        cell_valid = d.extrapolations != no_valid          # [E, W]
+        metrics = [KafkaMetric.CPU_USAGE, KafkaMetric.LEADER_BYTES_IN,
+                   KafkaMetric.LEADER_BYTES_OUT, KafkaMetric.DISK_USAGE]
+        vals = d.values[:, metrics, :]                      # [E, 4, W]
+        vals = np.where(cell_valid[:, None, :], vals, 0.0)
+
+        topics = sorted({t for t, _p in d.entities})
+        tindex = {t: i for i, t in enumerate(topics)}
+        rows = np.fromiter((tindex[t] for t, _p in d.entities),
+                           np.int64, E)
+        T = len(topics)
+        sums = np.zeros((T, 4, W))
+        np.add.at(sums, rows, vals)
+        valid = np.zeros((T, W), bool)
+        np.logical_or.at(valid, rows, cell_valid)
+        series = {t: (sums[i], valid[i]) for t, i in tindex.items()}
+        # Per-partition NW_IN means off the SAME dense pass, cached for
+        # partition_skew() — a detector round must not pay a second
+        # full [E, M, W] aggregation just to read the skew.
+        nval = cell_valid.sum(axis=1)
+        pmean = np.where(nval > 0,
+                         vals[:, 1, :].sum(axis=1) / np.maximum(nval, 1),
+                         0.0)
+        ploads: dict[str, list] = {}
+        for (topic, _p), m in zip(d.entities, pmean):
+            ploads.setdefault(topic, []).append(float(m))
+        self._partition_loads = (
+            result.generation,
+            {t: np.asarray(v) for t, v in ploads.items()})
+        return series, agg.window_ms, result.generation
+
+    def refresh(self, now_ms: int | None = None) -> ForecastSet:
+        """Fit (and persist) forecasts from the current window history.
+        Raises ``NotEnoughValidWindowsError`` while the monitor has no
+        aggregated windows at all — the caller (detector / POST) decides
+        whether that is skip-quietly or an HTTP error — and
+        ``ValueError`` (HTTP 400) when forecasting is disabled."""
+        if not self.config.enabled:
+            raise ValueError(
+                "forecasting is disabled (forecast.enabled=false)")
+        now = now_ms if now_ms is not None else self._now_ms()
+        with self._lock, self._fit_timer.time(), \
+                self.tracer.span("forecast.fit") as sp:
+            series, window_ms, generation = self.topic_series(now)
+            fits = fit_topic_forecasts(
+                series, window_ms,
+                seasonal_period_ms=self.config.seasonal_period_ms,
+                min_history_windows=self.config.min_history_windows,
+                fitted_at_ms=now, generation=generation)
+            self.last_fit = fits
+            self.num_fits += 1
+            self._refresh_meter.mark()
+            if self.store is not None:
+                self.store.save(fits)
+            sp.set(topics=len(fits),
+                   worstMape=fits.worst_backtest_mape())
+        return fits
+
+    def maybe_refresh(self, now_ms: int | None = None
+                      ) -> ForecastSet | None:
+        """Refit when the last fit is older than ``interval_ms``
+        (``<= 0`` = no age bound) or the model generation moved; serve
+        the cached fit otherwise. Returns None (instead of raising)
+        when no history exists yet, and the cached fit untouched when
+        forecasting is disabled (the kill switch must kill the
+        compute, not just the detector schedule)."""
+        if not self.config.enabled:
+            return self.last_fit
+        now = now_ms if now_ms is not None else self._now_ms()
+        with self._lock:
+            fit = self.last_fit
+            fresh = (fit is not None
+                     and fit.generation == self.monitor.generation
+                     and (self.config.interval_ms <= 0
+                          or now - fit.fitted_at_ms
+                          < self.config.interval_ms))
+        if fresh:
+            return fit
+        try:
+            return self.refresh(now)
+        except NotEnoughValidWindowsError:
+            return self.last_fit
+
+    # ---------------------------------------------------------- projection
+    @staticmethod
+    def _scenario_from_fit(fit: ForecastSet, horizon_ms: int,
+                           quantile: float) -> TrajectoryScale:
+        factors = tuple(sorted(fit.factors(horizon_ms, quantile).items()))
+        return TrajectoryScale(horizon_ms=int(horizon_ms),
+                               quantile=float(quantile), factors=factors)
+
+    def _fitted(self, now_ms: int | None = None) -> ForecastSet:
+        """The current fit, refreshed if stale. Raises ``ValueError``
+        (HTTP 400) while nothing is fitted yet."""
+        fit = self.maybe_refresh(now_ms)
+        if fit is None or not len(fit):
+            raise ValueError(
+                "no fitted forecasts yet (the monitor needs at least one "
+                "aggregated window; POST /forecast to force a refit)")
+        return fit
+
+    def trajectory_scenario(self, horizon_ms: int,
+                            quantile: float) -> TrajectoryScale:
+        """The concrete scenario spec for one (horizon, quantile) point
+        of the last fit — the ``{"type": "forecast"}`` resolver
+        ``parse_scenarios`` calls. Raises ``ValueError`` (HTTP 400)
+        while nothing is fitted yet."""
+        return self._scenario_from_fit(self._fitted(), horizon_ms,
+                                       quantile)
+
+    def trajectory_scenarios(self, now_ms: int | None = None
+                             ) -> list[TrajectoryScale]:
+        """The configured sweep grid: a +0 baseline scenario (factors at
+        horizon 0 of the median — the pressure anchor time-to-breach
+        interpolates from) plus every (horizon x quantile) point, all
+        resolved against ONE fit — a refit landing mid-grid must never
+        mix two fits in one report (time-to-breach interpolates across
+        the whole grid)."""
+        fit = self._fitted(now_ms)
+        grid = [self._scenario_from_fit(fit, 0, 0.5)]
+        for h in self.config.horizons_ms:
+            for q in self.config.quantiles:
+                grid.append(self._scenario_from_fit(fit, h, q))
+        return grid
+
+    # --------------------------------------------------------------- sweeps
+    def sweep(self, now_ms: int | None = None) -> ForecastReport:
+        """Score the configured trajectory grid against the live model
+        through the shared WhatIfEngine (ONE batched dispatch) and
+        derive the time-to-breach estimate."""
+        now = now_ms if now_ms is not None else self._now_ms()
+        scenarios = self.trajectory_scenarios(now)
+        t0 = time.monotonic()
+        with self._sweep_timer.time(), \
+                self.tracer.span("forecast.sweep",
+                                 scenarios=len(scenarios)) as sp:
+            result = self.monitor.cluster_model(now)
+            report = self.whatif.sweep(result.model, result.metadata,
+                                       scenarios,
+                                       stale_model=result.stale)
+            out = self._build_report(scenarios, report, now,
+                                     time.monotonic() - t0)
+            sp.set(timeToBreachMs=out.time_to_breach_ms)
+        with self._lock:
+            self.last_report = out
+            self.num_sweeps += 1
+        return out
+
+    def _build_report(self, scenarios, report, now: int,
+                      duration_s: float) -> ForecastReport:
+        outcomes: list[HorizonOutcome] = []
+        baseline: HorizonOutcome | None = None
+        for scn, o in zip(scenarios, report.outcomes):
+            ho = HorizonOutcome(
+                horizon_ms=scn.horizon_ms, quantile=scn.quantile,
+                risk=o.risk, capacity_pressure=o.capacity_pressure,
+                violated_goals=o.violated_goals,
+                violated_hard_goals=o.violated_hard_goals,
+                headroom=o.headroom, worst_broker=o.worst_broker,
+                max_factor=max((f for _t, f in scn.factors),
+                               default=1.0),
+                scenario_name=scn.name)
+            if scn.horizon_ms == 0:
+                baseline = ho
+            else:
+                outcomes.append(ho)
+        q = self.config.detection_quantile
+        points = [(0, baseline.capacity_pressure)] if baseline else []
+        points += [(o.horizon_ms, o.capacity_pressure) for o in outcomes
+                   if o.quantile == q]
+        ttb = time_to_breach_ms(points)
+        breach_h = breach_q = None
+        for o in sorted(outcomes, key=lambda o: o.horizon_ms):
+            if o.quantile == q and (o.violated_hard_goals
+                                    or o.capacity_pressure >= 1.0):
+                breach_h, breach_q = o.horizon_ms, o.quantile
+                break
+        if ttb is None and breach_h is not None:
+            # Hard-goal breach without a pressure crossing: the horizon
+            # itself is the honest bound.
+            ttb = breach_h
+        return ForecastReport(outcomes=outcomes, baseline=baseline,
+                              time_to_breach_ms=ttb,
+                              breach_horizon_ms=breach_h,
+                              breach_quantile=breach_q,
+                              duration_s=duration_s,
+                              generated_at_ms=now,
+                              stale_model=report.stale_model)
+
+    # ----------------------------------------------- partition-count logic
+    def partition_skew(self) -> dict[str, float]:
+        """Per-topic partition-load skew (max / mean partition NW_IN
+        over the latest valid windows) — the key-distribution proxy the
+        partition-count rule honors (arxiv 2205.09415: adding
+        partitions only relieves load the keys actually spread).
+        Served from the per-partition means the last ``topic_series``
+        pass cached (same generation = same windows); only a stale or
+        missing cache pays a fresh aggregation."""
+        cached = self._partition_loads
+        if cached is not None and cached[0] == self.monitor.generation:
+            series_now = cached[1]
+        else:
+            try:
+                series_now = self._per_partition_load()
+            except NotEnoughValidWindowsError:
+                return {}
+        out: dict[str, float] = {}
+        for topic, loads in series_now.items():
+            if len(loads) == 0:
+                continue
+            mean = float(np.mean(loads))
+            if mean <= 0:
+                out[topic] = 1.0
+            else:
+                out[topic] = float(np.max(loads)) / mean
+        return out
+
+    def _per_partition_load(self) -> dict[str, np.ndarray]:
+        """topic -> per-partition mean NW_IN over each partition's valid
+        windows (the skew numerator/denominator source)."""
+        agg = self.monitor.partition_aggregator
+        result = agg.aggregate(0, self._now_ms(),
+                               AggregationOptions(min_valid_windows=1),
+                               use_dense=True)
+        d = result.dense
+        if d is None:
+            raise NotEnoughValidWindowsError("no dense aggregate")
+        no_valid = Extrapolation.NO_VALID_EXTRAPOLATION.value
+        valid = d.extrapolations != no_valid
+        nw_in = d.values[:, KafkaMetric.LEADER_BYTES_IN, :]
+        nval = valid.sum(axis=1)
+        mean = np.where(nval > 0,
+                        (nw_in * valid).sum(axis=1) / np.maximum(nval, 1),
+                        0.0)
+        out: dict[str, list] = {}
+        for (topic, _p), m in zip(d.entities, mean):
+            out.setdefault(topic, []).append(float(m))
+        return {t: np.asarray(v) for t, v in out.items()}
+
+    def partition_count_targets(self, horizon_ms: int, quantile: float,
+                                partition_counts: dict[str, int]
+                                ) -> list[dict]:
+        """Forecast-informed partition-count targets for hot topics:
+        keep projected per-partition load at the horizon no worse than
+        today's by growing the count with the projected factor —
+        ``target = ceil(count * factor)`` — skipping topics whose
+        key-distribution skew caps the benefit and growth below the
+        configured noise floor. Counts only ever grow (Kafka cannot
+        shrink a topic's partition count)."""
+        fit = self.last_fit
+        if fit is None or not self.config.partition_count_enabled:
+            return []
+        skews = self.partition_skew()
+        cfg = self.config
+        out = []
+        for topic, factor in sorted(
+                fit.factors(horizon_ms, quantile).items()):
+            count = partition_counts.get(topic)
+            if not count or factor < cfg.partition_count_min_factor:
+                continue
+            skew = skews.get(topic, 1.0)
+            if skew > cfg.partition_count_max_skew:
+                LOG.info(
+                    "forecast: topic %s projects %.2fx at +%dms but its "
+                    "partition-load skew %.1f exceeds %.1f — partitions "
+                    "would not relieve the hot key; skipping",
+                    topic, factor, horizon_ms, skew,
+                    cfg.partition_count_max_skew)
+                continue
+            target = int(np.ceil(count * factor))
+            if target > count:
+                out.append({"topic": topic, "current": count,
+                            "target": target,
+                            "factor": round(float(factor), 4),
+                            "skew": round(float(skew), 4)})
+        return out
+
+    # --------------------------------------------------------------- state
+    def stats_json(self) -> dict:
+        """The ``forecast`` section of ``/devicestats``."""
+        with self._lock:
+            fit, report = self.last_fit, self.last_report
+        return {
+            "enabled": self.config.enabled,
+            "horizonsMs": list(self.config.horizons_ms),
+            "quantiles": list(self.config.quantiles),
+            "fits": self.num_fits, "sweeps": self.num_sweeps,
+            "storePath": self.store.path if self.store is not None else None,
+            "fittedTopics": None if fit is None else len(fit),
+            "fittedAtMs": None if fit is None else fit.fitted_at_ms,
+            "worstBacktestMape": (None if fit is None
+                                  else fit.worst_backtest_mape()),
+            "timeToBreachMs": (None if report is None
+                               else report.time_to_breach_ms),
+            "lastSweepMs": (None if report is None
+                            else report.generated_at_ms),
+        }
+
+    def report_json(self) -> dict:
+        """The ``GET /forecast`` payload: fit summary + the cached (or
+        first-computed) trajectory report. With ``forecast.enabled``
+        off the endpoint still answers — enabled=false state, whatever
+        report was cached, and NO fit/sweep compute (the kill-switch
+        contract in configuration.md)."""
+        with self._lock:
+            report = self.last_report
+        if report is None and self.config.enabled:
+            report = self.sweep()
+        with self._lock:
+            fit = self.last_fit
+        return {
+            **self.stats_json(),
+            "topics": ({} if fit is None
+                       else {t: {"degraded": f.degraded,
+                                 "backtestMape": f.backtest_mape,
+                                 "trendPerWindow": [
+                                     round(float(v), 6) for v in f.trend]}
+                             for t, f in sorted(fit.forecasts.items())}),
+            "report": None if report is None else report.to_json(),
+        }

@@ -1,0 +1,432 @@
+"""Per-topic load forecast fitting: level + trend + diurnal seasonality.
+
+No reference analog — the reference control plane is purely reactive.
+This module turns the aggregator's windowed history (the same
+``[E, M, W]`` cube the monitor builds models from) into per-topic,
+per-resource forecasts the what-if machinery can project forward
+(PAPERS.md: "Integrative Dynamic Reconfiguration", arxiv 1602.03770 —
+one reconfiguration plane acting ahead of workload shifts).
+
+Model form (documented in docs/forecasting.md): for each topic and each
+of the four resource metrics, the window series ``y_w`` decomposes as
+
+    y_w = level + trend * w + seasonal[w mod K] + eps,   eps ~ N(0, sigma)
+
+fitted deterministically — ordinary least squares for level/trend,
+phase-bucket residual means for the seasonal component (K = seasonal
+period / window width), sample std for sigma. Seasonality is only fitted
+when the history covers at least one full period; shorter histories
+degrade to level+trend (and histories under ``min_history_windows``
+degrade to a flat persistence forecast) — the degrade ladder is explicit
+state on the fit, never a silent zero.
+
+Everything here is host-side numpy and seeded by nothing: the same
+window history always fits the same model (the backtest property tests
+rely on that).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from dataclasses import dataclass, field
+from statistics import NormalDist
+
+import numpy as np
+
+from ..whatif.spec import RESOURCE_KEYS
+
+LOG = logging.getLogger(__name__)
+
+#: Version of the persisted forecast format. A change to the model form
+#: bumps it and retires stale files predictably (the TunedConfigStore /
+#: ``.jax_cache/v<N>`` discipline — forecasts persist NEXT to the tuned
+#: configs, see :meth:`ForecastStore.default_path`).
+FORECAST_STORE_VERSION = 1
+
+#: floor for relative errors / scale factors so an idle topic (level 0)
+#: never divides by zero or explodes a factor.
+_EPS = 1e-9
+
+
+def quantile_z(quantile: float) -> float:
+    """Normal z-score of ``quantile`` (0.5 -> 0, 0.9 -> 1.2816): the
+    confidence-interval multiplier on the fitted residual sigma."""
+    if not 0.0 < quantile < 1.0:
+        raise ValueError(f"quantile must be in (0, 1), got {quantile}")
+    return NormalDist().inv_cdf(quantile)
+
+
+@dataclass
+class TopicForecast:
+    """One topic's fitted forecast: 4 per-resource component fits.
+
+    ``level``/``trend`` are in window units (``x = 0`` at the OLDEST
+    fitted window; predictions extrapolate from ``num_windows - 1``),
+    ``seasonal`` is ``[4, K]`` (K = 0 when degraded to level+trend),
+    ``sigma`` the per-resource residual std. ``degraded`` names the
+    ladder rung: ``none`` (full model), ``no-seasonal`` (history < one
+    period), ``persistence`` (history < min_history_windows: flat
+    last-level forecast, trend zero)."""
+
+    topic: str
+    window_ms: int
+    num_windows: int
+    level: np.ndarray            # f64[4] — intercept at x = 0
+    trend: np.ndarray            # f64[4] — per-window slope
+    seasonal: np.ndarray         # f64[4, K]; K == 0 when not fitted
+    sigma: np.ndarray            # f64[4]
+    last_phase: int              # (last fitted window index) mod K
+    backtest_mape: float | None  # 1-window-holdout relative error
+    #: the MODEL's expected-utilization basis per resource — mean over
+    #: valid windows for CPU/NW, latest valid window for DISK, exactly
+    #: the monitor's per-metric ValueComputingStrategy. The scale
+    #: factor projects the predicted load CHANGE onto this basis (see
+    #: :meth:`factor`), so ``factor x model load`` tracks what the
+    #: monitor's own estimator will report at the horizon — the same
+    #: quantity the breach-replay chaos test measures.
+    basis: np.ndarray = field(default=None)
+    #: current (x = num_windows - 1) fitted value per resource, seasonal
+    #: included — the display-side "load right now"
+    current: np.ndarray = field(default=None)
+    degraded: str = "none"
+
+    def __post_init__(self):
+        if self.current is None:
+            self.current = self.predict(0.0, 0.5)
+        if self.basis is None:
+            self.basis = np.asarray(self.current, float).copy()
+
+    @property
+    def season_windows(self) -> int:
+        return int(self.seasonal.shape[1]) if self.seasonal.size else 0
+
+    def predict(self, horizon_windows: float, quantile: float
+                ) -> np.ndarray:
+        """Predicted per-resource load ``horizon_windows`` past the last
+        fitted window, at ``quantile`` (floored at 0 — load is never
+        negative)."""
+        x = (self.num_windows - 1) + horizon_windows
+        y = self.level + self.trend * x
+        K = self.season_windows
+        if K:
+            phase = int(round(x)) % K
+            y = y + self.seasonal[:, phase]
+        z = quantile_z(quantile)
+        return np.maximum(y + z * self.sigma, 0.0)
+
+    def factor(self, horizon_ms: float, quantile: float) -> float:
+        """Projected load-scale factor at ``horizon_ms``:
+        ``1 + (y_hat(t + h, q) - y_hat(t, 0.5)) / basis``, maximized
+        over live resources (the tightest resource drives capacity
+        risk). Projecting the predicted load *change* onto the model's
+        expected-utilization basis means ``factor x model load`` is the
+        load the monitor's own estimator reports once the projection
+        realizes — for a trending series the trailing mean shifts by
+        exactly ``trend x h`` — so sweep pressure, time-to-breach, and
+        the breach-replay measurement all share one scale. Idle
+        resources (basis ~ 0) are excluded; an entirely idle topic
+        projects 1.0."""
+        h = horizon_ms / self.window_ms
+        pred = self.predict(h, quantile)
+        now = self.predict(0.0, 0.5)
+        basis = np.asarray(self.basis, float)
+        live = basis > _EPS
+        if not live.any():
+            return 1.0
+        delta = np.max((pred[live] - now[live]) / basis[live])
+        return max(1.0 + float(delta), 0.0)
+
+    def to_json(self) -> dict:
+        return {
+            "topic": self.topic, "windowMs": self.window_ms,
+            "numWindows": self.num_windows,
+            "level": [round(float(v), 6) for v in self.level],
+            "trend": [round(float(v), 8) for v in self.trend],
+            "seasonal": [[round(float(v), 6) for v in row]
+                         for row in self.seasonal],
+            "sigma": [round(float(v), 6) for v in self.sigma],
+            "lastPhase": self.last_phase,
+            "basis": [round(float(v), 6) for v in self.basis],
+            "current": [round(float(v), 6) for v in self.current],
+            "backtestMape": (None if self.backtest_mape is None
+                             else round(float(self.backtest_mape), 6)),
+            "degraded": self.degraded,
+        }
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "TopicForecast":
+        seasonal = np.asarray(obj.get("seasonal", []), float)
+        if seasonal.ndim != 2:
+            seasonal = np.zeros((4, 0))
+        return cls(
+            topic=str(obj["topic"]), window_ms=int(obj["windowMs"]),
+            num_windows=int(obj["numWindows"]),
+            level=np.asarray(obj["level"], float),
+            trend=np.asarray(obj["trend"], float),
+            seasonal=seasonal,
+            sigma=np.asarray(obj["sigma"], float),
+            last_phase=int(obj.get("lastPhase", 0)),
+            backtest_mape=obj.get("backtestMape"),
+            basis=(np.asarray(obj["basis"], float)
+                   if "basis" in obj else None),
+            current=np.asarray(obj["current"], float),
+            degraded=str(obj.get("degraded", "none")))
+
+
+@dataclass
+class ForecastSet:
+    """The whole fitted pool: topic -> :class:`TopicForecast` plus the
+    fit provenance every downstream consumer (scenario factors,
+    recommendations, /forecast) carries along."""
+
+    forecasts: dict[str, TopicForecast]
+    fitted_at_ms: int
+    window_ms: int
+    generation: int = 0
+
+    def __len__(self) -> int:
+        return len(self.forecasts)
+
+    def worst_backtest_mape(self) -> float | None:
+        errs = [f.backtest_mape for f in self.forecasts.values()
+                if f.backtest_mape is not None]
+        return max(errs) if errs else None
+
+    def factors(self, horizon_ms: float, quantile: float
+                ) -> dict[str, float]:
+        return {t: f.factor(horizon_ms, quantile)
+                for t, f in self.forecasts.items()}
+
+    def provenance(self) -> dict:
+        """The fields a ProvisionRecommendation carries as forecast
+        provenance (docs/forecasting.md §Provenance)."""
+        return {"fittedAtMs": self.fitted_at_ms,
+                "windowMs": self.window_ms,
+                "generation": self.generation,
+                "numTopics": len(self.forecasts),
+                "worstBacktestMape": self.worst_backtest_mape()}
+
+    def to_json(self) -> dict:
+        return {"fittedAtMs": self.fitted_at_ms,
+                "windowMs": self.window_ms,
+                "generation": self.generation,
+                "topics": {t: f.to_json()
+                           for t, f in sorted(self.forecasts.items())}}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "ForecastSet":
+        return cls(forecasts={t: TopicForecast.from_json(f)
+                              for t, f in obj.get("topics", {}).items()},
+                   fitted_at_ms=int(obj.get("fittedAtMs", 0)),
+                   window_ms=int(obj.get("windowMs", 1)),
+                   generation=int(obj.get("generation", 0)))
+
+
+def _ols(x: np.ndarray, y: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized least-squares line fit per row of ``y`` ([R, N]) over
+    shared abscissa ``x`` ([N]); returns (intercept[R], slope[R])."""
+    n = len(x)
+    if n < 2:
+        lvl = y[:, -1] if n else np.zeros(y.shape[0])
+        return lvl, np.zeros(y.shape[0])
+    xm = x.mean()
+    ym = y.mean(axis=1)
+    denom = float(((x - xm) ** 2).sum())
+    if denom <= 0.0:
+        return ym, np.zeros(y.shape[0])
+    slope = ((x - xm)[None, :] * (y - ym[:, None])).sum(axis=1) / denom
+    return ym - slope * xm, slope
+
+
+def _decompose(x: np.ndarray, y: np.ndarray, K: int
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Level/trend + K-phase seasonal decomposition with one backfitting
+    refinement: a history covering a non-integer number of periods makes
+    plain OLS absorb part of the seasonal swing as a spurious slope, so
+    after the first seasonal estimate the trend is REFIT on the
+    seasonally-adjusted series and the seasonal recomputed. Returns
+    (level[R], trend[R], seasonal[R, K], residual[R, N])."""
+    R = y.shape[0]
+    seasonal = np.zeros((R, max(K, 0)))
+    phases = x.astype(int) % K if K >= 2 else None
+    level = trend = None
+    for _ in range(2 if K >= 2 else 1):
+        adjusted = y - seasonal[:, phases] if K >= 2 else y
+        level, trend = _ols(x, adjusted)
+        resid = y - (level[:, None] + trend[:, None] * x[None, :])
+        if K < 2:
+            return level, trend, np.zeros((R, 0)), resid
+        for p in range(K):
+            sel = phases == p
+            if sel.any():
+                seasonal[:, p] = resid[:, sel].mean(axis=1)
+        # Re-center so the seasonal component carries no net level (the
+        # OLS already owns the mean).
+        seasonal -= seasonal.mean(axis=1, keepdims=True)
+    resid = (y - (level[:, None] + trend[:, None] * x[None, :])
+             - seasonal[:, phases])
+    return level, trend, seasonal, resid
+
+
+def fit_series(topic: str, values: np.ndarray, valid: np.ndarray,
+               window_ms: int, *, season_windows: int = 0,
+               min_history_windows: int = 3) -> TopicForecast:
+    """Fit one topic from its ``[4, W]`` window series.
+
+    ``valid[W]`` marks windows with real samples — invalid columns are
+    excluded from every regression (they are zero-filled in the cube and
+    would silently drag the level down). Deterministic; see the module
+    docstring for the model form and degrade ladder."""
+    values = np.asarray(values, float)
+    valid = np.asarray(valid, bool)
+    W = values.shape[1]
+    x_all = np.arange(W, dtype=float)
+    x = x_all[valid]
+    y = values[:, valid]
+    n = len(x)
+
+    # The model's expected-utilization basis (mean over valid windows
+    # for CPU/NW, LATEST valid window for DISK — the monitor's
+    # per-metric ValueComputingStrategy), so a factor applied to a live
+    # model's loads reproduces the predicted absolute load.
+    if n:
+        basis = y.mean(axis=1)
+        basis[3] = y[3, -1]
+    else:
+        basis = np.zeros(4)
+
+    if n < max(min_history_windows, 2):
+        # Persistence: too little history for a slope anyone should act
+        # on — forecast the last seen level, flat.
+        lvl = y[:, -1] if n else np.zeros(4)
+        return TopicForecast(
+            topic=topic, window_ms=window_ms, num_windows=W,
+            level=lvl, trend=np.zeros(4), seasonal=np.zeros((4, 0)),
+            sigma=np.zeros(4), last_phase=0, backtest_mape=None,
+            basis=basis, degraded="persistence")
+
+    K = int(season_windows)
+    fit_seasonal = K >= 2 and n >= K
+    level, trend, seasonal, resid = _decompose(
+        x, y, K if fit_seasonal else 0)
+    degraded = "none" if fit_seasonal else "no-seasonal"
+    sigma = resid.std(axis=1) if n > 1 else np.zeros(4)
+
+    backtest = _backtest_mape(x, y, season_windows=K if degraded == "none"
+                              else 0)
+    return TopicForecast(
+        topic=topic, window_ms=window_ms, num_windows=W,
+        level=level, trend=trend, seasonal=seasonal, sigma=sigma,
+        last_phase=(int(x[-1]) % K) if K >= 2 and degraded == "none" else 0,
+        backtest_mape=backtest, basis=basis, degraded=degraded)
+
+
+def _backtest_mape(x: np.ndarray, y: np.ndarray, *,
+                   season_windows: int) -> float | None:
+    """One-window-holdout backtest: fit on all but the last valid
+    window, predict it, report the mean relative error over resources
+    with meaningful load. The accuracy number every fit carries (and
+    the bench's ``forecast_backtest_mape`` row aggregates)."""
+    if len(x) < 3:
+        return None
+    xf, yf = x[:-1], y[:, :-1]
+    K = season_windows if (season_windows >= 2
+                           and len(xf) >= season_windows) else 0
+    level, trend, seasonal, _resid = _decompose(xf, yf, K)
+    pred = level + trend * x[-1]
+    if K >= 2:
+        pred = pred + seasonal[:, int(x[-1]) % K]
+    actual = y[:, -1]
+    live = np.abs(actual) > _EPS
+    if not live.any():
+        return None
+    return float(np.mean(np.abs(pred[live] - actual[live])
+                         / np.abs(actual[live])))
+
+
+def fit_topic_forecasts(series: dict[str, tuple[np.ndarray, np.ndarray]],
+                        window_ms: int, *, seasonal_period_ms: int,
+                        min_history_windows: int, fitted_at_ms: int,
+                        generation: int = 0) -> ForecastSet:
+    """Fit every topic in ``series`` (topic -> (values[4, W],
+    valid[W])). The seasonal bucket count K = period / window width; a
+    period that does not cleanly cover >= 2 windows disables the
+    seasonal component for the whole fit."""
+    K = int(seasonal_period_ms // window_ms) if window_ms > 0 else 0
+    if K < 2:
+        K = 0
+    forecasts = {
+        topic: fit_series(topic, values, valid, window_ms,
+                          season_windows=K,
+                          min_history_windows=min_history_windows)
+        for topic, (values, valid) in sorted(series.items())}
+    return ForecastSet(forecasts=forecasts, fitted_at_ms=fitted_at_ms,
+                       window_ms=window_ms, generation=generation)
+
+
+class ForecastStore:
+    """Fitted forecasts persisted as one JSON file next to the tuned
+    search configs, so restarts serve projections without refitting cold
+    (same contract as TunedConfigStore: best-effort IO, versioned,
+    thread-safe)."""
+
+    def __init__(self, path: str | None = None):
+        self.path = path or self.default_path()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def default_path() -> str:
+        from ..utils.platform import DEFAULT_CACHE_DIR
+        return os.path.join(DEFAULT_CACHE_DIR, "forecast",
+                            f"v{FORECAST_STORE_VERSION}", "forecasts.json")
+
+    def load(self) -> ForecastSet | None:
+        """The persisted fit, or None (missing / unreadable /
+        version-skewed files degrade to a cold refit, logged)."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if data.get("version") != FORECAST_STORE_VERSION:
+            LOG.warning(
+                "ignoring persisted forecasts at %s: version %s != %d "
+                "(stale format — refit regenerates)",
+                self.path, data.get("version"), FORECAST_STORE_VERSION)
+            return None
+        try:
+            fits = ForecastSet.from_json(data.get("forecasts", {}))
+        except (KeyError, TypeError, ValueError) as exc:
+            LOG.warning("corrupt persisted forecasts at %s (%s); "
+                        "refitting cold", self.path, exc)
+            return None
+        LOG.info("loaded %d persisted topic forecasts from %s",
+                 len(fits), self.path)
+        return fits
+
+    def save(self, fits: ForecastSet) -> str | None:
+        """Persist (best-effort, atomic tmp+rename). Returns the path
+        written, or None on IO failure (logged — the engine must keep
+        serving either way)."""
+        payload = {"version": FORECAST_STORE_VERSION,
+                   "forecasts": fits.to_json()}
+        with self._lock:
+            try:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                tmp = f"{self.path}.tmp"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, indent=1, sort_keys=True)
+                os.replace(tmp, self.path)
+                return self.path
+            except OSError as exc:
+                LOG.warning("could not persist forecasts to %s: %s",
+                            self.path, exc)
+                return None
+
+
+#: resource axis labels shared with the what-if layer (cpu, nwIn,
+#: nwOut, disk) — re-exported so consumers need not import whatif.
+RESOURCES = RESOURCE_KEYS

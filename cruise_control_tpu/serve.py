@@ -303,6 +303,25 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             ResilienceDetector(monitor, facade.whatif,
                                registry=detector.registry),
             resilience_interval)
+    # Forecast engine + proactive capacity provisioning (forecast/;
+    # docs/forecasting.md): reconfigure the facade's engine from the
+    # forecast.* keys, wire the persistence store (fitted models restart
+    # warm, next to the tuned-config store), and schedule the
+    # capacity-forecast detector on its interval.
+    forecast_cfg = config.forecast_config()
+    facade.forecast.config = forecast_cfg
+    if forecast_cfg.enabled:
+        from .forecast import CapacityForecastDetector, ForecastStore
+        facade.forecast.store = ForecastStore(
+            config.get_string("forecast.store.path") or None)
+        persisted = facade.forecast.store.load()
+        if persisted is not None and facade.forecast.last_fit is None:
+            facade.forecast.last_fit = persisted
+        if forecast_cfg.interval_ms > 0:
+            detector.register(
+                CapacityForecastDetector(monitor, facade.forecast,
+                                         registry=detector.registry),
+                forecast_cfg.interval_ms)
     # ref maintenance.event.reader.class (empty = maintenance events
     # disabled, the reference default): the reader drains operator-
     # announced plans with idempotence de-dup; MaintenanceEvent.fix reads

@@ -9,12 +9,14 @@ sequential model rebuilds.
 """
 
 from .spec import (BrokerAdd, BrokerLoss, CapacityResize, LoadScale,
-                   Scenario, TopicAdd, alive_broker_ids, n1_sweep, n2_sweep,
-                   parse_scenarios)
-from .engine import ScenarioOutcome, WhatIfEngine, WhatIfReport
+                   Scenario, TopicAdd, TrajectoryScale, alive_broker_ids,
+                   n1_sweep, n2_sweep, parse_scenarios)
+from .engine import (ScenarioOutcome, WhatIfEngine, WhatIfReport,
+                     trajectory_pscale_row)
 
 __all__ = [
     "Scenario", "BrokerLoss", "BrokerAdd", "CapacityResize", "LoadScale",
-    "TopicAdd", "n1_sweep", "n2_sweep", "alive_broker_ids",
-    "parse_scenarios", "WhatIfEngine", "WhatIfReport", "ScenarioOutcome",
+    "TopicAdd", "TrajectoryScale", "n1_sweep", "n2_sweep",
+    "alive_broker_ids", "parse_scenarios", "WhatIfEngine", "WhatIfReport",
+    "ScenarioOutcome", "trajectory_pscale_row",
 ]

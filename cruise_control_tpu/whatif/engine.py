@@ -39,7 +39,7 @@ from ..core.resources import NUM_RESOURCES
 from ..model.flat import FlatClusterModel
 from ..parallel.batching import ProgramCache, pad_model_to, round_up
 from .spec import (BrokerAdd, BrokerLoss, CapacityResize, LoadScale,
-                   RESOURCE_KEYS, Scenario, TopicAdd)
+                   RESOURCE_KEYS, Scenario, TopicAdd, TrajectoryScale)
 
 #: risk-score shape constants (documented in docs/whatif.md): the four
 #: component terms combine as 1 - prod(1 - term), each term in [0, 1].
@@ -447,6 +447,10 @@ class WhatIfEngine:
                         tids.append(tid)
                     sel = np.isin(np.asarray(model.partition_topic), tids)
                     pscale[s_i, sel] *= scn.factor
+            elif isinstance(scn, TrajectoryScale):
+                pscale[s_i, :] *= trajectory_pscale_row(
+                    scn, metadata.topic_index,
+                    np.asarray(model.partition_topic))
             elif isinstance(scn, TopicAdd):
                 if scn.rf > len(alive_rows):
                     raise ValueError(
@@ -572,6 +576,24 @@ def _ensure_padding(model: FlatClusterModel, spare_b: int, need_b: int,
              else _round_up(P + need_p - spare_p, partition_pad_multiple))
     new_R = max(R, need_r)
     return pad_model_to(model, new_B, new_P, new_R)
+
+
+def trajectory_pscale_row(scn: TrajectoryScale, topic_index: dict,
+                          partition_topic: np.ndarray) -> np.ndarray:
+    """One scenario's ``[P]`` partition load-scale plane from a
+    :class:`TrajectoryScale` spec: ``default_factor`` everywhere, each
+    forecast topic's factor on its partitions. Topics no longer in the
+    live metadata are skipped (a stale forecast entry degrades, never
+    errors). Shared by the what-if materializer and the fleet layer's
+    ``[C, S]`` trajectory sweep, so a fleet-projected factor means
+    exactly what a ``/simulate`` one does."""
+    row = np.full(partition_topic.shape, scn.default_factor, np.float32)
+    for topic, factor in scn.factors:
+        tid = topic_index.get(topic)
+        if tid is None:
+            continue
+        row[partition_topic == tid] = factor
+    return row
 
 
 def scenario_transform(model: FlatClusterModel, dead, add, cap_scale,

@@ -23,6 +23,11 @@ Scenario types:
   all four resources) — models traffic growth.
 - :class:`TopicAdd` — a new topic with projected per-partition load,
   placed round-robin over alive brokers.
+- :class:`TrajectoryScale` — per-topic load factors at one forecast
+  (horizon, quantile) point: the materialized form of a fitted load
+  trajectory (forecast/engine.py). A ``{"type": "forecast", ...}``
+  request resolves through the server's forecast engine into exactly
+  this spec, so the JSON echo of a forecast sweep round-trips.
 """
 
 from __future__ import annotations
@@ -163,6 +168,47 @@ class TopicAdd(Scenario):
         return out
 
 
+@dataclass(frozen=True)
+class TrajectoryScale(Scenario):
+    """Per-topic load factors at one projected (horizon, quantile)
+    point. ``factors`` carries (topic, factor) pairs from a fitted
+    forecast; topics without an entry scale by ``default_factor``
+    (1.0 = unchanged). Topics that disappeared since the fit are
+    skipped at materialization — a stale forecast entry must degrade,
+    not 400 a sweep of the live cluster."""
+
+    horizon_ms: int
+    quantile: float
+    factors: tuple[tuple[str, float], ...] = ()
+    default_factor: float = 1.0
+    label: str = "forecast"
+
+    @property
+    def name(self) -> str:
+        return (f"{self.label}:+{_fmt_horizon(self.horizon_ms)}"
+                f":p{int(round(self.quantile * 100))}")
+
+    def to_json(self) -> dict:
+        out: dict = {"type": "trajectory_scale",
+                     "horizonMs": self.horizon_ms,
+                     "quantile": self.quantile,
+                     "factors": {t: f for t, f in self.factors}}
+        if self.default_factor != 1.0:
+            out["defaultFactor"] = self.default_factor
+        if self.label != "forecast":
+            out["label"] = self.label
+        return out
+
+
+def _fmt_horizon(horizon_ms: int) -> str:
+    """Compact horizon label: 3600000 -> "1h", 90000 -> "90s"."""
+    s = horizon_ms / 1000.0
+    for width, unit in ((86400, "d"), (3600, "h"), (60, "m")):
+        if s >= width and s % width == 0:
+            return f"{int(s // width)}{unit}"
+    return f"{s:g}s"
+
+
 # ---------------------------------------------------------------- sweeps
 
 def n1_sweep(broker_ids: list[int]) -> list[BrokerLoss]:
@@ -273,14 +319,48 @@ def _parse_topic(obj: dict) -> TopicAdd:
                     else _load4(fl, "topic_add"))
 
 
-def parse_scenarios(payload: dict, broker_ids: list[int]
-                    ) -> list[Scenario]:
+@_parser("trajectory_scale")
+def _parse_trajectory(obj: dict) -> TrajectoryScale:
+    horizon_ms = int(obj.get("horizonMs", 0))
+    if horizon_ms < 0:
+        raise ValueError("trajectory_scale: horizonMs must be >= 0")
+    quantile = float(obj.get("quantile", 0.5))
+    if not 0.0 < quantile < 1.0:
+        raise ValueError("trajectory_scale: quantile must be in (0, 1)")
+    raw = obj.get("factors", {})
+    if not isinstance(raw, dict):
+        raise ValueError("trajectory_scale: 'factors' must be an object "
+                         "{topic: factor}")
+    factors = []
+    for t, f in sorted(raw.items()):
+        f = float(f)
+        if f < 0:
+            raise ValueError(
+                f"trajectory_scale: factor for topic {t!r} must be >= 0")
+        factors.append((str(t), f))
+    default = float(obj.get("defaultFactor", 1.0))
+    if default < 0:
+        raise ValueError("trajectory_scale: defaultFactor must be >= 0")
+    return TrajectoryScale(horizon_ms=horizon_ms, quantile=quantile,
+                           factors=tuple(factors), default_factor=default,
+                           label=str(obj.get("label", "forecast")))
+
+
+def parse_scenarios(payload: dict, broker_ids: list[int],
+                    forecaster=None) -> list[Scenario]:
     """Parse a ``/simulate`` request payload into scenario specs.
 
     Accepts either ``{"sweep": "N1"|"N2"}`` (expanded over
     ``broker_ids``) or ``{"scenarios": [{"type": ...}, ...]}``.
     Raises ``ValueError`` (HTTP 400) on anything malformed — validation
     happens before any device work is scheduled.
+
+    ``forecaster`` resolves ``{"type": "forecast", "horizonMs": ...,
+    "quantile": ...}`` scenario sources into concrete
+    :class:`TrajectoryScale` specs from the server's fitted forecasts
+    (``KafkaCruiseControl.simulate`` wires the forecast engine's
+    ``trajectory_scenario``); without one, forecast sources are a
+    validation error.
     """
     sweep = payload.get("sweep")
     raw = payload.get("scenarios")
@@ -301,10 +381,32 @@ def parse_scenarios(payload: dict, broker_ids: list[int]
     for i, obj in enumerate(raw):
         if not isinstance(obj, dict):
             raise ValueError(f"scenario #{i} is not an object: {obj!r}")
+        if obj.get("type") == "forecast":
+            # Forecast scenario source: resolved against the server's
+            # fitted per-topic forecasts into a TrajectoryScale, so the
+            # response echoes the concrete factors it scored (and that
+            # echo round-trips through the trajectory_scale parser).
+            if forecaster is None:
+                raise ValueError(
+                    f"scenario #{i}: 'forecast' scenarios need a fitted "
+                    "forecast source (forecast.enabled on the server)")
+            if "horizonMs" not in obj:
+                raise ValueError(
+                    f"scenario #{i}: forecast requires horizonMs")
+            horizon_ms = int(obj["horizonMs"])
+            if horizon_ms < 0:
+                raise ValueError(
+                    f"scenario #{i}: forecast horizonMs must be >= 0")
+            quantile = float(obj.get("quantile", 0.9))
+            if not 0.0 < quantile < 1.0:
+                raise ValueError(
+                    f"scenario #{i}: forecast quantile must be in (0, 1)")
+            out.append(forecaster(horizon_ms, quantile))
+            continue
         parser = _PARSERS.get(obj.get("type"))
         if parser is None:
             raise ValueError(
                 f"scenario #{i}: unknown type {obj.get('type')!r}; "
-                f"supported: {sorted(_PARSERS)}")
+                f"supported: {sorted(_PARSERS) + ['forecast']}")
         out.append(parser(obj))
     return out

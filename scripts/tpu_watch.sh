@@ -84,10 +84,14 @@ while true; do
     # schedule sequential propose (100x20K): tunes on-chip (the tuned
     # store persists per shape bucket, so later serving runs pick the
     # on-chip schedule up), then gates the population A/B.
-    for spec in 2 6 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 8 = the forecast pipeline (host fit + [C, S] fleet trajectory
+    # sweep, 4 clusters x 100x20K): the trajectory dispatch rides the
+    # same compiled scenario scorer scenario 6 warms, so it slots right
+    # after the fleet propose for a warm compile cache.
+    for spec in 2 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
-        2|1) tmo=3600 ;; 5|6) tmo=2400 ;; 7) tmo=4800 ;;
+        2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7) tmo=4800 ;;
         4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac

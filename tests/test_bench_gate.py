@@ -143,6 +143,28 @@ def test_fleet_propose_bench_smoke_gate():
     assert out["speedup"] is not None and out["clusters_per_s"] > 0
 
 
+def test_forecast_sweep_bench_smoke_gate():
+    """run_forecast_sweep_bench on a toy fleet: exercises the synthetic
+    fit, the [C, S] fleet trajectory dispatch, the sequential baseline
+    loop, and the three always-on gates end-to-end — backtest MAPE
+    within budget, fleet-vs-single scoring parity, zero warm recompiles
+    (the helper raises on any of them). Tier-1 safe: no wall-clock gate
+    at toy scale — the >= 1x bar is judged at bench scale
+    (4 x 100x20K, scenario 8)."""
+    import bench
+    out = bench.run_forecast_sweep_bench(
+        num_clusters=2, num_brokers=10, num_partitions=96,
+        goal_names=["ReplicaDistributionGoal"],
+        history_windows=48, repeats=1, emit_row=False, gate=False)
+    assert out["clusters"] == 2 and out["scenarios"] == 6
+    assert out["topics"] == 96              # t0..t95 from build_spec
+    assert out["mape"] is not None
+    assert out["mape"] <= bench.FORECAST_MAPE_BUDGET
+    assert out["recompiles"] == 0
+    assert out["fit_s"] > 0 and out["warm_s"] > 0 and out["seq_s"] > 0
+    assert out["speedup"] is not None
+
+
 @pytest.mark.slow
 def test_multiobj_propose_bench_smoke_gate(tmp_path):
     """run_multiobj_propose_bench on a toy cluster: exercises the full

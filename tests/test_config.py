@@ -365,3 +365,30 @@ def test_topic_config_providers(tmp_path):
     ap = AdminTopicConfigProvider(sim)
     assert ap.topic_configs("t0")["min.insync.replicas"] == "2"
     assert ap.topic_configs("missing") == {}
+
+
+def test_forecast_list_keys_validated_at_parse_time():
+    """forecast.horizon.ms / forecast.quantiles: malformed or empty
+    lists must fail the deploy, not the first detector round (ISSUE 13;
+    an empty horizon list would silently reduce every sweep to the +0
+    baseline)."""
+    from cruise_control_tpu.config.constants import CruiseControlConfig
+    ok = CruiseControlConfig({"forecast.horizon.ms": "60000,3600000",
+                              "forecast.quantiles": "0.5,0.95"})
+    fc = ok.forecast_config()
+    assert fc.horizons_ms == (60000, 3600000)
+    assert fc.quantiles == (0.5, 0.95)
+    assert fc.detection_quantile == 0.95
+    for props in ({"forecast.horizon.ms": ""},
+                  {"forecast.horizon.ms": "60000,banana"},
+                  {"forecast.horizon.ms": "-5"},
+                  {"forecast.quantiles": ""},
+                  {"forecast.quantiles": "1.5"},
+                  {"forecast.quantiles": "0.5,nope"}):
+        with pytest.raises(ConfigException, match="forecast"):
+            CruiseControlConfig(props)
+    # the kill switch also kills the validation teeth for emptiness
+    off = CruiseControlConfig({"forecast.enabled": "false",
+                               "forecast.horizon.ms": "",
+                               "forecast.quantiles": ""})
+    assert off.forecast_config().enabled is False

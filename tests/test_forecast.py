@@ -1,0 +1,738 @@
+"""Forecast subsystem tests: deterministic fit units, the backtest
+accuracy gate on synthetic diurnal/growth traces, sweep-vs-manual
+scenario parity, the detector -> provisioner flow (fires BEFORE the
+simulated breach step), partition-count execution through the mock
+admin, the fleet [C, S] trajectory sweep with its zero-warm-recompile
+gate, and the /forecast API surface.
+
+Shapes and goal chains stay tiny and shared module-wide (tier-1 runs
+near the 870s cap); the chaos cross-check replaying projected load is
+marked slow.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import TpuGoalOptimizer, goals_by_name
+from cruise_control_tpu.core.metricdef import partition_metric_def
+from cruise_control_tpu.executor import SimulatedKafkaCluster
+from cruise_control_tpu.forecast import (CapacityForecastDetector,
+                                         ForecastConfig, ForecastEngine,
+                                         ForecastStore, fit_series,
+                                         fit_topic_forecasts,
+                                         quantile_z, time_to_breach_ms)
+from cruise_control_tpu.forecast.model import ForecastSet
+from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+from cruise_control_tpu.whatif import (LoadScale, TrajectoryScale,
+                                       WhatIfEngine, parse_scenarios)
+
+WINDOW_MS = 1000
+#: two-goal chain shared by every device-touching test in this module
+GOALS = ["NetworkInboundCapacityGoal", "ReplicaDistributionGoal"]
+
+
+# ------------------------------------------------------------- fit units
+
+def _trace(W, level=100.0, slope=0.0, amp=0.0, period=24, noise=0.0,
+           seed=7):
+    x = np.arange(W, dtype=float)
+    y = level + slope * x + amp * np.sin(2 * np.pi * x / period)
+    if noise:
+        y = y + np.random.default_rng(seed).normal(0.0, noise, W)
+    return np.tile(y, (4, 1))
+
+
+def test_fit_recovers_linear_trend_exactly():
+    W = 24
+    f = fit_series("t", _trace(W, level=10.0, slope=0.5),
+                   np.ones(W, bool), WINDOW_MS, season_windows=0)
+    np.testing.assert_allclose(f.trend, 0.5, atol=1e-9)
+    np.testing.assert_allclose(f.level, 10.0, atol=1e-9)
+    assert f.degraded == "no-seasonal"
+    # prediction at +4 windows continues the line
+    np.testing.assert_allclose(f.predict(4.0, 0.5),
+                               10.0 + 0.5 * (W - 1 + 4), atol=1e-9)
+
+
+def test_fit_recovers_diurnal_seasonal_component():
+    W, K = 72, 24
+    f = fit_series("t", _trace(W, level=100.0, slope=1.0, amp=20.0,
+                               period=K),
+                   np.ones(W, bool), WINDOW_MS, season_windows=K)
+    assert f.degraded == "none"
+    assert f.season_windows == K
+    # seasonal swing ~ +-20 recovered; residual sigma is small
+    assert 15.0 < f.seasonal[0].max() < 25.0
+    assert f.sigma[0] < 3.0
+    # the trend is not polluted by the seasonal swing (backfitting)
+    np.testing.assert_allclose(f.trend, 1.0, atol=0.1)
+
+
+def test_fit_is_deterministic():
+    W = 48
+    y = _trace(W, slope=0.3, amp=5.0, noise=1.0)
+    a = fit_series("t", y, np.ones(W, bool), WINDOW_MS, season_windows=24)
+    b = fit_series("t", y, np.ones(W, bool), WINDOW_MS, season_windows=24)
+    np.testing.assert_array_equal(a.level, b.level)
+    np.testing.assert_array_equal(a.seasonal, b.seasonal)
+    assert a.backtest_mape == b.backtest_mape
+
+
+def test_fit_degrade_ladder():
+    # < min_history_windows: flat persistence forecast
+    f = fit_series("t", _trace(2, slope=5.0), np.ones(2, bool), WINDOW_MS,
+                   season_windows=24, min_history_windows=3)
+    assert f.degraded == "persistence"
+    np.testing.assert_array_equal(f.trend, 0.0)
+    # history < one seasonal period: level+trend only, no seasonal
+    f2 = fit_series("t", _trace(10, slope=1.0), np.ones(10, bool),
+                    WINDOW_MS, season_windows=24)
+    assert f2.degraded == "no-seasonal" and f2.season_windows == 0
+    # invalid windows are excluded from the regression, not read as 0
+    W = 12
+    valid = np.ones(W, bool)
+    valid[3] = False
+    y = _trace(W, level=50.0, slope=2.0)
+    y[:, 3] = 0.0                      # the zero-filled invalid column
+    f3 = fit_series("t", y, valid, WINDOW_MS, season_windows=0)
+    np.testing.assert_allclose(f3.trend, 2.0, atol=1e-9)
+
+
+def test_quantiles_and_confidence():
+    assert quantile_z(0.5) == pytest.approx(0.0)
+    assert quantile_z(0.9) == pytest.approx(1.2816, abs=1e-3)
+    with pytest.raises(ValueError):
+        quantile_z(1.0)
+    W = 48
+    f = fit_series("t", _trace(W, level=100.0, noise=5.0),
+                   np.ones(W, bool), WINDOW_MS, season_windows=0)
+    # p90 strictly above p50 once there is residual noise
+    assert (f.predict(1.0, 0.9) > f.predict(1.0, 0.5)).all()
+    assert f.factor(WINDOW_MS, 0.9) > f.factor(WINDOW_MS, 0.5)
+
+
+def test_idle_topic_projects_factor_one():
+    W = 12
+    f = fit_series("t", np.zeros((4, W)), np.ones(W, bool), WINDOW_MS,
+                   season_windows=0)
+    assert f.factor(10 * WINDOW_MS, 0.9) == 1.0
+
+
+def test_forecast_json_and_store_round_trip(tmp_path):
+    W = 48
+    fits = fit_topic_forecasts(
+        {"t0": (_trace(W, slope=0.5), np.ones(W, bool)),
+         "t1": (_trace(W, amp=10.0, period=12), np.ones(W, bool))},
+        WINDOW_MS, seasonal_period_ms=12 * WINDOW_MS,
+        min_history_windows=3, fitted_at_ms=1234, generation=7)
+    rt = ForecastSet.from_json(json.loads(json.dumps(fits.to_json())))
+    assert rt.fitted_at_ms == 1234 and rt.generation == 7
+    for t in ("t0", "t1"):
+        assert rt.forecasts[t].factor(6 * WINDOW_MS, 0.9) == pytest.approx(
+            fits.forecasts[t].factor(6 * WINDOW_MS, 0.9), abs=1e-6)
+    store = ForecastStore(str(tmp_path / "forecasts.json"))
+    assert store.save(fits) is not None
+    loaded = store.load()
+    assert loaded is not None and len(loaded) == 2
+    # to_json rounds floats to 6 decimals — compare at that precision
+    assert loaded.worst_backtest_mape() == pytest.approx(
+        fits.worst_backtest_mape(), abs=1e-6)
+    # version skew is refused (degrade to cold refit), never crashes
+    bad = json.loads((tmp_path / "forecasts.json").read_text())
+    bad["version"] = 999
+    (tmp_path / "forecasts.json").write_text(json.dumps(bad))
+    assert store.load() is None
+
+
+@pytest.mark.parametrize("kind,kwargs", [
+    ("growth", dict(level=50.0, slope=2.0)),
+    ("steep-growth", dict(level=20.0, slope=8.0)),
+    ("diurnal", dict(level=200.0, amp=40.0, period=24)),
+    ("diurnal-growth", dict(level=100.0, slope=1.5, amp=25.0, period=24)),
+    ("noisy-growth", dict(level=100.0, slope=2.0, noise=3.0)),
+])
+def test_backtest_accuracy_gate(kind, kwargs):
+    """Acceptance gate: on synthetic diurnal + linear-growth traces the
+    1-window-holdout forecast MAPE stays <= 15%."""
+    W = 72
+    f = fit_series(kind, _trace(W, **kwargs), np.ones(W, bool), WINDOW_MS,
+                   season_windows=24)
+    assert f.backtest_mape is not None
+    assert f.backtest_mape <= 0.15, (kind, f.backtest_mape)
+
+
+def test_time_to_breach_interpolation():
+    assert time_to_breach_ms([(0, 0.5), (100, 0.75), (200, 1.25)]) == 150
+    assert time_to_breach_ms([(0, 0.5), (100, 0.8)]) is None
+    assert time_to_breach_ms([(0, 1.2), (100, 1.5)]) == 0
+    # earliest breached point wins, even on a declining curve
+    assert time_to_breach_ms([(0, 1.5), (100, 1.0)]) == 0
+    # non-monotone curve: the first crossing segment is interpolated,
+    # a later dip back under the threshold doesn't move it
+    assert time_to_breach_ms([(0, 0.5), (100, 1.5), (200, 0.9)]) == 50
+
+
+# ------------------------------------------------- spec + parse round-trip
+
+def test_trajectory_scale_spec_round_trip():
+    scn = TrajectoryScale(horizon_ms=3_600_000, quantile=0.9,
+                          factors=(("a", 1.5), ("b", 0.8)))
+    assert scn.name == "forecast:+1h:p90"
+    (parsed,) = parse_scenarios({"scenarios": [scn.to_json()]}, [0, 1])
+    assert parsed == scn
+
+
+def test_trajectory_scale_validation():
+    for bad in (
+            {"type": "trajectory_scale", "horizonMs": -1, "quantile": 0.5},
+            {"type": "trajectory_scale", "horizonMs": 1, "quantile": 1.5},
+            {"type": "trajectory_scale", "horizonMs": 1, "quantile": 0.5,
+             "factors": {"t": -2.0}},
+            {"type": "trajectory_scale", "horizonMs": 1, "quantile": 0.5,
+             "factors": [1, 2]}):
+        with pytest.raises(ValueError):
+            parse_scenarios({"scenarios": [bad]}, [0])
+
+
+def test_forecast_scenario_source_resolves_through_forecaster():
+    calls = []
+
+    def forecaster(horizon_ms, quantile):
+        calls.append((horizon_ms, quantile))
+        return TrajectoryScale(horizon_ms=horizon_ms, quantile=quantile,
+                               factors=(("t", 2.0),))
+
+    out = parse_scenarios(
+        {"scenarios": [{"type": "forecast", "horizonMs": 60_000},
+                       {"type": "forecast", "horizonMs": 120_000,
+                        "quantile": 0.5}]},
+        [0], forecaster=forecaster)
+    assert calls == [(60_000, 0.9), (120_000, 0.5)]
+    assert [s.horizon_ms for s in out] == [60_000, 120_000]
+    # without a forecaster the source is a validation error (HTTP 400)
+    with pytest.raises(ValueError, match="forecast"):
+        parse_scenarios({"scenarios": [{"type": "forecast",
+                                        "horizonMs": 1}]}, [0])
+    with pytest.raises(ValueError, match="horizonMs"):
+        parse_scenarios({"scenarios": [{"type": "forecast"}]}, [0],
+                        forecaster=forecaster)
+
+
+# ------------------------------------------------------- engine fixtures
+
+def build_monitor(*, growth_per_window=8.0, base=700.0, windows=8,
+                  num_brokers=4, partitions=16, skewed=False,
+                  num_windows=None):
+    """A monitor with a deterministic ingested history: topic t1's
+    per-partition NW_IN grows ``growth_per_window`` per window from
+    ``base``; t0 stays flat. ``skewed`` places t1 on brokers {0, 1}
+    only, so growth breaches one broker first. ``num_windows`` (default
+    ``windows``) sizes the aggregator ring separately from the history
+    fed, so a replay can extend the trace while measuring over the same
+    trailing window the forecast basis used."""
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b)
+    for p in range(partitions):
+        if skewed and p % 2 == 1:
+            reps = [p % 2, (p + 2) % 2]        # t1 -> brokers 0/1
+            reps = [0, 1] if p % 4 == 1 else [1, 0]
+        else:
+            reps = [p % num_brokers, (p + 1) % num_brokers]
+        sim.add_partition(f"t{p % 2}", p, reps, size_mb=10.0)
+    mon = LoadMonitor(sim, MonitorConfig(
+        num_windows=num_windows or windows, window_ms=WINDOW_MS,
+        min_samples_per_window=1))
+    mdef = partition_metric_def()
+    keys = sorted(sim.describe_partitions())
+    for w in range(windows + 1):
+        vals = np.zeros((len(keys), mdef.size()))
+        for i, (t, _p) in enumerate(keys):
+            nw_in = base / 8.0 + (growth_per_window * w if t == "t1"
+                                  else 0.0)
+            vals[i, :4] = [1.0, nw_in, nw_in / 2.0, 10.0]
+        times = np.full(len(keys), w * WINDOW_MS + 100, np.int64)
+        mon.partition_aggregator.add_samples_dense(keys, times, vals)
+    return sim, mon, (windows + 1) * WINDOW_MS
+
+
+@pytest.fixture(scope="module")
+def whatif_engine():
+    return WhatIfEngine(goals=goals_by_name(GOALS))
+
+
+@pytest.fixture(scope="module")
+def forecast_stack(whatif_engine):
+    sim, mon, now = build_monitor()
+    cfg = ForecastConfig(horizons_ms=(4_000, 16_000),
+                         quantiles=(0.5, 0.9),
+                         min_history_windows=3,
+                         seasonal_period_ms=0)
+    eng = ForecastEngine(mon, whatif_engine, config=cfg,
+                         now_ms=lambda: now)
+    return sim, mon, eng, now
+
+
+def test_engine_fit_and_factors(forecast_stack):
+    _sim, _mon, eng, now = forecast_stack
+    fits = eng.refresh(now)
+    assert len(fits) == 2
+    assert fits.worst_backtest_mape() <= 0.15
+    scn = eng.trajectory_scenario(4_000, 0.5)
+    factors = dict(scn.factors)
+    # t0 is flat, t1 grows
+    assert factors["t0"] == pytest.approx(1.0, abs=0.01)
+    assert factors["t1"] > 1.2
+    # deterministic refit: same history, same factors
+    assert eng.trajectory_scenario(4_000, 0.5).factors == scn.factors
+
+
+def test_sweep_vs_manual_scenario_parity(forecast_stack, whatif_engine):
+    """The forecast sweep must score exactly what a manual /simulate of
+    the same TrajectoryScale batch scores — same engine, same program,
+    same risk numbers."""
+    _sim, mon, eng, now = forecast_stack
+    report = eng.sweep(now)
+    scenarios = eng.trajectory_scenarios()
+    result = mon.cluster_model(now)
+    manual = whatif_engine.sweep(result.model, result.metadata, scenarios)
+    got = ([report.baseline] if report.baseline else []) + report.outcomes
+    assert len(got) == len(manual.outcomes)
+    for ho, mo in zip(got, manual.outcomes):
+        assert ho.risk == pytest.approx(mo.risk, abs=1e-9)
+        assert ho.capacity_pressure == pytest.approx(
+            mo.capacity_pressure, abs=1e-9)
+        assert ho.violated_hard_goals == mo.violated_hard_goals
+
+
+def test_trajectory_scale_equals_per_topic_load_scale(forecast_stack,
+                                                      whatif_engine):
+    """A single-topic TrajectoryScale is semantically a per-topic
+    LoadScale — the two specs must score identically."""
+    _sim, mon, _eng, now = forecast_stack
+    result = mon.cluster_model(now)
+    rep = whatif_engine.sweep(
+        result.model, result.metadata,
+        [TrajectoryScale(horizon_ms=1000, quantile=0.9,
+                         factors=(("t1", 2.0),)),
+         LoadScale(2.0, topics=("t1",))])
+    a, b = rep.outcomes
+    assert a.risk == pytest.approx(b.risk, abs=1e-9)
+    assert a.capacity_pressure == pytest.approx(b.capacity_pressure,
+                                                abs=1e-9)
+    assert a.violated_goals == b.violated_goals
+
+
+def test_stale_topic_in_factors_degrades(forecast_stack, whatif_engine):
+    """A forecast fitted before a topic was deleted must not 400 the
+    sweep — the stale entry is skipped at materialization."""
+    _sim, mon, _eng, now = forecast_stack
+    result = mon.cluster_model(now)
+    rep = whatif_engine.sweep(
+        result.model, result.metadata,
+        [TrajectoryScale(horizon_ms=1000, quantile=0.9,
+                         factors=(("deleted-topic", 9.0),)),
+         LoadScale(1.0)])
+    a, b = rep.outcomes
+    assert a.risk == pytest.approx(b.risk, abs=1e-9)   # no-op in effect
+
+
+def test_refresh_on_empty_monitor_is_client_error(whatif_engine):
+    """POST /forecast before the monitor has any aggregated windows is
+    a retryable not-ready state: the facade translates the aggregator's
+    NotEnoughValidWindowsError into ValueError (the HTTP 400 path
+    rest-api.md documents), never a 500."""
+    from cruise_control_tpu.core.aggregator import NotEnoughValidWindowsError
+    sim = SimulatedKafkaCluster()
+    sim.add_broker(0, rate_mb_s=1000.0)
+    sim.add_partition("t0", 0, [0], size_mb=1.0)
+    mon = LoadMonitor(sim, MonitorConfig(num_windows=4,
+                                         window_ms=WINDOW_MS,
+                                         min_samples_per_window=1))
+    eng = ForecastEngine(mon, whatif_engine, now_ms=lambda: 0)
+    with pytest.raises(NotEnoughValidWindowsError):
+        eng.refresh(0)
+    from cruise_control_tpu.api.facade import KafkaCruiseControl
+    facade = KafkaCruiseControl(sim, mon, now_ms=lambda: 0)
+    with pytest.raises(ValueError, match="retry once the monitor"):
+        facade.forecast_refresh()
+
+
+def test_disabled_engine_answers_without_compute(whatif_engine):
+    """forecast.enabled=false is a kill switch: GET /forecast's payload
+    still answers (enabled=false, report null) but fits nothing and
+    sweeps nothing."""
+    _sim, mon, now = build_monitor()
+    eng = ForecastEngine(mon, whatif_engine,
+                         config=ForecastConfig(enabled=False),
+                         now_ms=lambda: now)
+    out = eng.report_json()
+    assert out["enabled"] is False
+    assert out["report"] is None and out["topics"] == {}
+    assert eng.num_fits == 0 and eng.num_sweeps == 0
+    with pytest.raises(ValueError, match="disabled"):
+        eng.refresh(now)
+
+
+# --------------------------------------------- detector -> provisioner
+
+def test_detector_fires_before_simulated_breach(whatif_engine):
+    """The chaos-clock acceptance gate: with load trending toward the
+    capacity bound, the detector raises CAPACITY_FORECAST (with a
+    positive time-to-breach) while current pressure is still below 1 —
+    i.e. BEFORE the breach step — and replaying the true trend up to
+    the predicted breach time really does reach the bound."""
+    sim, mon, now = build_monitor(growth_per_window=50.0, base=5600.0,
+                                  windows=8)
+    cfg = ForecastConfig(horizons_ms=(4_000, 10_000), quantiles=(0.9,),
+                         min_history_windows=3, seasonal_period_ms=0,
+                         partition_count_enabled=True)
+    eng = ForecastEngine(mon, whatif_engine, config=cfg,
+                         now_ms=lambda: now)
+    det = CapacityForecastDetector(mon, eng)
+    anomalies = det.detect(now)
+    report = det.last_report
+    assert report is not None
+    # current pressure is still healthy: the breach has NOT happened yet
+    assert report.baseline.capacity_pressure < 1.0
+    assert anomalies, "detector must fire ahead of the projected breach"
+    (anomaly,) = anomalies
+    assert anomaly.time_to_breach_ms is not None
+    assert 0 < anomaly.time_to_breach_ms <= 10_000
+    assert anomaly.recommendations
+    rec = anomaly.recommendations[0]
+    assert rec.num_brokers and rec.num_brokers >= 1
+    assert rec.time_to_breach_ms == anomaly.time_to_breach_ms
+    assert rec.forecast and rec.forecast["quantile"] == 0.9
+    assert "breach in" in rec.reason        # the notifier urgency signal
+    assert "time to breach" in anomaly.reason()
+    # the recommendation renders its urgency + provenance in JSON (the
+    # /state recent-anomalies path)
+    j = anomaly.to_json()
+    assert j["timeToBreachMs"] == anomaly.time_to_breach_ms
+    assert j["recommendations"][0]["timeToBreachMs"] is not None
+    assert "forecast" in j["recommendations"][0]
+    # replay the true trend up to the predicted breach step, measured
+    # over the SAME trailing window the forecast basis used: pressure
+    # really crosses 1 there (the forecast was a prediction, not a
+    # hallucination)
+    breach_w = int(np.ceil(anomaly.time_to_breach_ms / WINDOW_MS))
+    sim2, mon2, now2 = build_monitor(growth_per_window=50.0, base=5600.0,
+                                     windows=8 + breach_w, num_windows=8)
+    result = mon2.cluster_model(now2)
+    rep = whatif_engine.sweep(result.model, result.metadata,
+                              [LoadScale(1.0)])
+    assert rep.outcomes[0].capacity_pressure >= 0.98
+
+
+def test_partition_count_recommendation_and_skew_constraint(
+        whatif_engine):
+    sim, mon, now = build_monitor(growth_per_window=50.0, base=5600.0,
+                                  windows=8)
+    cfg = ForecastConfig(horizons_ms=(10_000,), quantiles=(0.9,),
+                         min_history_windows=3, seasonal_period_ms=0)
+    eng = ForecastEngine(mon, whatif_engine, config=cfg,
+                         now_ms=lambda: now)
+    eng.refresh(now)
+    counts = {}
+    for t, _p in sim.describe_partitions():
+        counts[t] = counts.get(t, 0) + 1
+    targets = eng.partition_count_targets(10_000, 0.9, counts)
+    assert targets and targets[0]["topic"] == "t1"
+    assert targets[0]["target"] > targets[0]["current"]
+    # skew constraint: a cap below the observed (uniform ~1.0) skew
+    # suppresses the recommendation
+    eng.config.partition_count_max_skew = 0.5
+    assert eng.partition_count_targets(10_000, 0.9, counts) == []
+    eng.config.partition_count_max_skew = 4.0
+    # the master switch wins
+    eng.config.partition_count_enabled = False
+    assert eng.partition_count_targets(10_000, 0.9, counts) == []
+
+
+def test_partition_count_executes_through_mock_admin(whatif_engine):
+    """Acceptance: recommendation -> anomaly -> notifier FIX ->
+    provisioner -> the admin's create-partitions path, end to end
+    through the AnomalyDetectorManager."""
+    from cruise_control_tpu.api.facade import KafkaCruiseControl
+    from cruise_control_tpu.detector import (AnomalyDetectorManager,
+                                             KafkaAnomalyType)
+    sim, mon, now = build_monitor(growth_per_window=50.0, base=5600.0,
+                                  windows=8)
+    facade = KafkaCruiseControl(
+        sim, mon, optimizer=TpuGoalOptimizer(goals=goals_by_name(GOALS)),
+        now_ms=lambda: now)
+    manager = AnomalyDetectorManager(facade, provisioner_enabled=True)
+    facade.detector = manager
+    cfg = ForecastConfig(horizons_ms=(4_000, 10_000), quantiles=(0.9,),
+                         min_history_windows=3, seasonal_period_ms=0)
+    facade.forecast.config = cfg
+    det = CapacityForecastDetector(mon, facade.forecast,
+                                   registry=manager.registry)
+    manager.register(det, interval_ms=1_000)
+    before = sum(1 for (t, _p) in sim.describe_partitions() if t == "t1")
+    summary = manager.run_once(now)
+    assert summary["detected"] == 1 and summary["fixed"] == 1
+    after = sum(1 for (t, _p) in sim.describe_partitions() if t == "t1")
+    assert after > before
+    # the desired-total semantics: re-running does not double-grow past
+    # the target (BasicProvisioner ignores topics already at target)
+    anomalies = det.detect(now)
+    if anomalies:
+        for rec in anomalies[0].recommendations:
+            if rec.num_partitions:
+                assert rec.num_partitions <= after * 2
+    # /state carries the urgency readout
+    state = manager.state_json()
+    assert state["forecastTimeToBreachMs"] is not None
+    assert state["recentAnomalies"][
+        KafkaAnomalyType.CAPACITY_FORECAST.name]
+
+
+def test_detector_skips_degraded_cluster(whatif_engine):
+    sim, mon, now = build_monitor(growth_per_window=50.0, base=5600.0)
+    eng = ForecastEngine(mon, whatif_engine,
+                         config=ForecastConfig(horizons_ms=(4_000,),
+                                               quantiles=(0.9,),
+                                               seasonal_period_ms=0),
+                         now_ms=lambda: now)
+    det = CapacityForecastDetector(mon, eng)
+    sim.kill_broker(0)
+    assert det.detect(now) == []
+    assert det.last_time_to_breach_ms is None
+
+
+# ------------------------------------------------- fleet [C, S] compose
+
+def test_fleet_trajectory_sweep_parity_and_zero_warm_recompiles(
+        whatif_engine):
+    """Acceptance: the S-scenario x C-member trajectory sweep runs as
+    ONE batched dispatch, scores identically to per-cluster WhatIfEngine
+    sweeps, and compiles nothing on the warm path (the /devicestats
+    compile ledger stays at zero recompiles)."""
+    from cruise_control_tpu.core.runtime_obs import DeviceStatsCollector
+    from cruise_control_tpu.fleet.engine import FleetOptimizer
+    from cruise_control_tpu.model.fleet import FleetModel
+
+    _sim_a, mon_a, now = build_monitor(growth_per_window=8.0)
+    _sim_b, mon_b, _ = build_monitor(growth_per_window=30.0)
+    ra = mon_a.cluster_model(now)
+    rb = mon_b.cluster_model(now)
+    fleet = FleetModel.stack([("a", ra.model, ra.metadata),
+                              ("b", rb.model, rb.metadata)])
+    collector = DeviceStatsCollector()
+    opt = TpuGoalOptimizer(goals=goals_by_name(GOALS))
+    fopt = FleetOptimizer(opt, collector=collector)
+    grid = [TrajectoryScale(horizon_ms=0, quantile=0.5),
+            TrajectoryScale(horizon_ms=4_000, quantile=0.9,
+                            factors=(("t1", 1.6),)),
+            TrajectoryScale(horizon_ms=16_000, quantile=0.9,
+                            factors=(("t1", 2.4),))]
+    out = fopt.sweep_trajectories(fleet, grid)
+    assert [s["clusterId"] for s in out] == ["a", "b"]
+    # parity: per-member single-cluster sweeps score the same grid
+    for member, result in (("a", ra), ("b", rb)):
+        single = whatif_engine.sweep(result.model, result.metadata, grid)
+        rows = next(s for s in out
+                    if s["clusterId"] == member)["scenarios"]
+        assert len(rows) == len(single.outcomes)
+        for row, o in zip(rows, single.outcomes):
+            # summary rows round to 4 decimals
+            assert row["risk"] == pytest.approx(o.risk, abs=1e-4)
+            assert row["capacityPressure"] == pytest.approx(
+                o.capacity_pressure, abs=1e-4)
+            assert row["violatedHardGoals"] == o.violated_hard_goals
+    # warm path: a second sweep dispatches the SAME program — zero
+    # recompiles on the compile ledger /devicestats serves
+    out2 = fopt.sweep_trajectories(fleet, grid)
+    assert out2 == out
+    stats = collector.to_json()
+    assert stats["compile"]["recompileEvents"] == 0
+    prog = stats["compile"]["byProgram"]["fleet-forecast"]
+    assert prog["dispatches"] == 2 and prog["compiles"] == 1
+    # dict form must cover every member: a missing cluster id is a
+    # ValueError (HTTP 400 path), never a raw KeyError
+    with pytest.raises(ValueError, match="no trajectory grid"):
+        fopt.sweep_trajectories(fleet, {"a": grid})
+
+
+# ------------------------------------------------------------ API layer
+
+@pytest.fixture(scope="module")
+def api_stack():
+    from test_api import build_stack
+    sim, facade, app = build_stack()
+    yield sim, facade, app
+    app.stop()
+
+
+def _call(app, method, endpoint, params="", expect=200):
+    from test_api import call
+    return call(app, method, endpoint, params, expect=expect)
+
+
+def test_forecast_endpoint_get_and_post(api_stack):
+    _sim, facade, app = api_stack
+    status, body, _ = _call(app, "GET", "forecast")
+    assert status == 200
+    assert body["fittedTopics"] and body["report"]["horizons"]
+    assert body["report"]["baseline"] is not None
+    # POST /forecast (method-split path) forces a refit + fresh sweep
+    sweeps_before = facade.forecast.num_sweeps
+    status, body2, _ = _call(app, "POST", "forecast")
+    assert status == 200
+    assert facade.forecast.num_sweeps > sweeps_before
+    assert body2["fits"] >= body["fits"]
+    # the /devicestats forecast section reports the engine snapshot and
+    # the warm sweep path compiled nothing new
+    payload = facade.device_stats_json()
+    assert payload["forecast"]["fittedTopics"] == body["fittedTopics"]
+    status, body3, _ = _call(app, "POST", "forecast")
+    assert status == 200
+    assert facade.device_stats_json()["compile"]["recompileEvents"] == 0
+
+
+def test_forecast_plaintext_table(api_stack):
+    _sim, _facade, app = api_stack
+    import urllib.request
+    url = (f"http://127.0.0.1:{app.port}/kafkacruisecontrol/forecast"
+           f"?json=false")
+    with urllib.request.urlopen(url, timeout=60) as resp:
+        text = resp.read().decode()
+        ctype = resp.headers["Content-Type"]
+    assert "text/plain" in ctype
+    assert "HORIZON" in text and "PRESSURE" in text
+    assert "topics fitted:" in text
+
+
+def test_simulate_accepts_forecast_source(api_stack):
+    _sim, _facade, app = api_stack
+    scenarios = json.dumps([{"type": "forecast", "horizonMs": 4000,
+                             "quantile": 0.9}])
+    status, body, _ = _call(
+        app, "POST", "simulate",
+        "scenarios=" + urllib_quote(scenarios))
+    assert status == 200
+    (out,) = body["scenarios"]
+    assert out["scenario"]["type"] == "trajectory_scale"
+    assert out["name"].startswith("forecast:+4s:p90")
+    # the echoed concrete spec round-trips through parse_scenarios
+    parsed = parse_scenarios({"scenarios": [out["scenario"]]}, [0])
+    assert parsed[0].horizon_ms == 4000
+
+
+def urllib_quote(s):
+    import urllib.parse
+    return urllib.parse.quote(s)
+
+
+def test_forecast_roles(api_stack):
+    from cruise_control_tpu.api.security import ENDPOINT_MIN_ROLE, Role
+    assert ENDPOINT_MIN_ROLE["forecast"] is Role.VIEWER
+    assert ENDPOINT_MIN_ROLE["forecast_refresh"] is Role.USER
+
+
+def test_openapi_forecast_schema_ref_round_trip(api_stack):
+    """Docs satellite: the endpoint count covers the forecast pair and
+    every $ref in the document resolves into components.schemas."""
+    _sim, _facade, app = api_stack
+    from cruise_control_tpu.api.openapi import ENDPOINTS
+    status, spec, _ = _call(app, "GET", "openapi")
+    assert status == 200
+    assert len(spec["paths"]) == len(ENDPOINTS)
+    for ep in ("forecast", "forecast_refresh"):
+        path = spec["paths"][f"/kafkacruisecontrol/{ep}"]
+        method = next(iter(path))
+        ref = path[method]["responses"]["200"]["content"][
+            "application/json"]["schema"]["$ref"]
+        assert ref == "#/components/schemas/ForecastReport"
+
+    def refs(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "$ref":
+                    yield v
+                else:
+                    yield from refs(v)
+        elif isinstance(node, list):
+            for v in node:
+                yield from refs(v)
+
+    for ref in refs(spec):
+        name = ref.rsplit("/", 1)[-1]
+        assert name in spec["components"]["schemas"], ref
+
+
+# --------------------------------------------------- chaos cross-check
+
+@pytest.mark.slow
+def test_chaos_cross_check_recommendation_realizes_headroom(
+        whatif_engine):
+    """Apply the partition-count recommendation on the mock admin,
+    replay the PROJECTED load as real windows, and verify the realized
+    capacity pressure matches what the forecast sweep predicted for the
+    provisioned topology (within 10%) — i.e. the predicted headroom is
+    realized, not just asserted."""
+    growth, base, windows = 50.0, 5600.0, 8
+    sim, mon, now = build_monitor(growth_per_window=growth, base=base,
+                                  windows=windows)
+    cfg = ForecastConfig(horizons_ms=(6_000,), quantiles=(0.9,),
+                         min_history_windows=3, seasonal_period_ms=0)
+    eng = ForecastEngine(mon, whatif_engine, config=cfg,
+                         now_ms=lambda: now)
+    eng.refresh(now)
+    scn = eng.trajectory_scenario(6_000, 0.9)
+    factor = dict(scn.factors)["t1"]
+    assert factor > 1.0
+
+    # Apply the recommendation: grow t1's partition count by the factor
+    # through the admin's create-partitions path.
+    counts = {}
+    for t, _p in sim.describe_partitions():
+        counts[t] = counts.get(t, 0) + 1
+    (target,) = eng.partition_count_targets(6_000, 0.9, counts)
+    sim.create_partitions("t1", target["target"] - target["current"],
+                          rf=2, size_mb=10.0)
+
+    # Prediction on the PROVISIONED topology: rebuild the model (the new
+    # partitions exist, unloaded yet) and score the projected factors.
+    mon_p = LoadMonitor(sim, MonitorConfig(num_windows=windows,
+                                           window_ms=WINDOW_MS,
+                                           min_samples_per_window=1))
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    mdef = partition_metric_def()
+    keys = sorted(sim.describe_partitions())
+    t1_count = sum(1 for (t, _p) in keys if t == "t1")
+
+    def feed(monitor, w, t1_total_rate):
+        vals = np.zeros((len(keys), mdef.size()))
+        for i, (t, _p) in enumerate(keys):
+            nw_in = (t1_total_rate / t1_count if t == "t1"
+                     else base / 8.0)
+            vals[i, :4] = [1.0, nw_in, nw_in / 2.0, 10.0]
+        times = np.full(len(keys), w * WINDOW_MS + 100, np.int64)
+        monitor.partition_aggregator.add_samples_dense(keys, times, vals)
+
+    # Seed the provisioned monitor with the CURRENT load (total t1 rate
+    # as of the last fitted window, spread over the grown count).
+    t1_now = (base / 8.0 + growth * windows) * 8   # 8 original partitions
+    for w in range(windows + 1):
+        feed(mon_p, w, t1_now)
+    res_p = mon_p.cluster_model(now)
+    predicted = whatif_engine.sweep(res_p.model, res_p.metadata,
+                                    [scn]).outcomes[0].capacity_pressure
+
+    # Replay: the projected load ACTUALLY arrives (factor x current).
+    mon_r = LoadMonitor(sim, MonitorConfig(num_windows=windows,
+                                           window_ms=WINDOW_MS,
+                                           min_samples_per_window=1))
+    for w in range(windows + 1):
+        feed(mon_r, w, t1_now * factor)
+    res_r = mon_r.cluster_model(now)
+    realized = whatif_engine.sweep(res_r.model, res_r.metadata,
+                                   [LoadScale(1.0)]
+                                   ).outcomes[0].capacity_pressure
+    assert realized == pytest.approx(predicted, rel=0.10), \
+        (predicted, realized)

@@ -255,14 +255,17 @@ def test_goals_param_kafka_assigner_mode():
 def test_openapi_covers_all_endpoints():
     # 23 reference endpoints + the openapi document itself + this
     # build's simulate (what-if sweeps), trace (span export),
-    # devicestats (device-runtime ledger), and the fleet pair
-    # (fleet summary + fleet_rebalance forced tick).
+    # devicestats (device-runtime ledger), the fleet pair
+    # (fleet summary + fleet_rebalance forced tick), and the forecast
+    # pair (trajectory report + forecast_refresh forced refit).
     spec = openapi_spec()
-    assert len(ENDPOINTS) == 29
-    assert len(spec["paths"]) == 29
+    assert len(ENDPOINTS) == 31
+    assert len(spec["paths"]) == 31
     assert "get" in spec["paths"]["/kafkacruisecontrol/devicestats"]
     assert "get" in spec["paths"]["/kafkacruisecontrol/fleet"]
     assert "post" in spec["paths"]["/kafkacruisecontrol/fleet_rebalance"]
+    assert "get" in spec["paths"]["/kafkacruisecontrol/forecast"]
+    assert "post" in spec["paths"]["/kafkacruisecontrol/forecast_refresh"]
     reb = spec["paths"]["/kafkacruisecontrol/rebalance"]["post"]
     names = {p["name"] for p in reb["parameters"]}
     assert {"dryrun", "goals", "kafka_assigner",
