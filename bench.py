@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import threading
 import time
 
 import numpy as np
@@ -1185,6 +1186,277 @@ def run_snapshot_restore_bench(num_brokers: int = NUM_BROKERS,
             "snapshot_bytes": facade1.snapshotter.to_json()["bytes"]}
 
 
+def run_api_throughput_bench(num_brokers: int = 50,
+                             num_partitions: int = 5_000, *,
+                             threads: int = 8, duration_s: float = 2.0,
+                             goal_names: list | None = None,
+                             emit_row: bool = True, gate: bool = True
+                             ) -> dict:
+    """Heavy-traffic read tier: closed-loop mixed GET traffic against a
+    warm served stack, render cache ON vs OFF (the per-request-render
+    baseline). Real HTTP (keep-alive, ``threads`` client threads) over
+    the stock threading engine; mix = GET /proposals + /state +
+    /devicestats round-robin.
+
+    Reported:
+
+    - ``api_requests_per_s`` — cached read throughput; vs_baseline =
+      cached / per-request-render. **Gated >= 5x at bench scale** (toy
+      smoke runs pass gate=False: tiny response bodies make the
+      baseline's re-render artificially cheap there).
+    - ``api_read_p99_ms`` — cached read p99 latency; vs_baseline =
+      baseline p99 over it.
+
+    Always asserted, every scale: ZERO device dispatches attributable
+    to cached reads (compile events AND host<->device transfer bytes
+    flat across the cached GET-only phase, read off the /devicestats
+    collector), ETag-consistent responses under concurrent generation
+    bumps + a trickle of POST /rebalance (one ETag never names two
+    different bodies; If-None-Match answers 304 with zero body bytes),
+    and zero 5xx anywhere."""
+    import hashlib
+    import http.client
+
+    from cruise_control_tpu.api.facade import KafkaCruiseControl
+    from cruise_control_tpu.api.server import CruiseControlApp
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    from cruise_control_tpu.analyzer import (SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+
+    window_ms = 1000
+    windows = 4
+    num_topics = max(num_partitions // 100, 1)
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b)
+    for p in range(num_partitions):
+        pool = max(num_brokers // 5, 2) if p % 2 == 0 else num_brokers
+        sim.add_partition(f"t{p % num_topics}", p,
+                          [p % pool, (p + 1) % pool],
+                          size_mb=50.0 + (p % 100))
+    monitor = LoadMonitor(sim, MonitorConfig(
+        num_windows=windows, window_ms=window_ms,
+        min_samples_per_window=1))
+    mdef = partition_metric_def()
+    keys = sorted(sim.describe_partitions())
+    P = len(keys)
+    vals = ((np.arange(P * mdef.size(), dtype=np.float64)
+             .reshape(P, mdef.size()) % 97) + 1.0)
+    next_window = [0]
+
+    def ingest_window():
+        w = next_window[0]
+        next_window[0] += 1
+        times = np.full(P, w * window_ms + 100, np.int64)
+        monitor.partition_aggregator.add_samples_dense(keys, times, vals)
+        now_box[0] = (w + 1) * window_ms
+
+    now_box = [0]
+    for _ in range(windows + 1):
+        ingest_window()
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names or GOALS[:2]),
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=256))
+    facade = KafkaCruiseControl(sim, monitor, optimizer=opt,
+                                now_ms=lambda: now_box[0])
+    app = CruiseControlApp(facade, port=0, max_active_tasks=1024)
+    app.start()
+    try:
+        # Warm serve: one proposal computation published; the read tier
+        # under test never recomputes it (mixed phase excepted).
+        facade.proposals()
+        mix = ["/kafkacruisecontrol/proposals", "/kafkacruisecontrol/state",
+               "/kafkacruisecontrol/devicestats"]
+
+        def drive(label, duration, *, with_writes=False):
+            """Closed-loop phase: returns (completed, statuses, lat_s,
+            etag->body-hash map)."""
+            stop = threading.Event()
+            outs = []
+
+            def reader(my):
+                conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                                  timeout=60)
+                i = 0
+                while not stop.is_set():
+                    path = mix[i % len(mix)]
+                    i += 1
+                    t0 = time.monotonic()
+                    try:
+                        conn.request("GET", path)
+                        resp = conn.getresponse()
+                        body = resp.read()
+                    except Exception:
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", app.port, timeout=60)
+                        my["transport_errors"] += 1
+                        continue
+                    my["lat"].append(time.monotonic() - t0)
+                    my["statuses"][resp.status] = (
+                        my["statuses"].get(resp.status, 0) + 1)
+                    etag = resp.getheader("ETag")
+                    if etag and resp.status == 200:
+                        my["pairs"].append(
+                            (etag, hashlib.sha256(body).hexdigest()))
+                conn.close()
+
+            def writer(my):
+                # The trickle: generation bumps (a new sampling window
+                # lands) interleaved with dryrun rebalances — the write
+                # traffic the cached readers must stay coherent under.
+                conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                                  timeout=120)
+                while not stop.is_set():
+                    ingest_window()
+                    try:
+                        conn.request(
+                            "POST",
+                            "/kafkacruisecontrol/rebalance?dryrun=true"
+                            "&get_response_timeout_s=60")
+                        resp = conn.getresponse()
+                        resp.read()
+                        my["statuses"][resp.status] = (
+                            my["statuses"].get(resp.status, 0) + 1)
+                    except Exception:
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", app.port, timeout=120)
+                        my["transport_errors"] += 1
+                    stop.wait(0.2)
+                conn.close()
+
+            ts = []
+            for _ in range(threads):
+                my = {"lat": [], "statuses": {}, "pairs": [],
+                      "transport_errors": 0}
+                outs.append(my)
+                ts.append(threading.Thread(target=reader, args=(my,),
+                                           daemon=True))
+            if with_writes:
+                my = {"lat": [], "statuses": {}, "pairs": [],
+                      "transport_errors": 0}
+                outs.append(my)
+                ts.append(threading.Thread(target=writer, args=(my,),
+                                           daemon=True))
+            for t in ts:
+                t.start()
+            time.sleep(duration)
+            stop.set()
+            for t in ts:
+                t.join(timeout=180)
+            statuses: dict[int, int] = {}
+            lat: list[float] = []
+            etags: dict[str, set] = {}
+            transport_errors = 0
+            for my in outs:
+                for s, n in my["statuses"].items():
+                    statuses[s] = statuses.get(s, 0) + n
+                lat.extend(my["lat"])
+                transport_errors += my["transport_errors"]
+                for etag, digest in my["pairs"]:
+                    etags.setdefault(etag, set()).add(digest)
+            completed = sum(n for s, n in statuses.items() if s < 500)
+            bad = {s: n for s, n in statuses.items() if s >= 500}
+            if bad or transport_errors:
+                raise RuntimeError(
+                    f"api throughput bench ({label}): {bad or ''} 5xx "
+                    f"responses / {transport_errors} transport errors "
+                    "(want zero)")
+            torn = {e: d for e, d in etags.items() if len(d) > 1}
+            if torn:
+                raise RuntimeError(
+                    f"api throughput bench ({label}): one ETag named "
+                    f"multiple bodies (torn read): {sorted(torn)[:3]}")
+            log(f"api bench phase {label}: {completed} requests in "
+                f"{duration:.1f}s ({completed / duration:.0f} req/s), "
+                f"statuses {statuses}")
+            return completed, statuses, lat, etags
+
+        # --- phase U: the per-request-render baseline (cache off).
+        facade.rendercache.enabled = False
+        drive("warm-baseline", min(duration_s / 4, 0.5))   # JIT the path
+        u_done, _, u_lat, _ = drive("uncached", duration_s)
+
+        # --- phase C: cached reads; device-dispatch accounting around it.
+        facade.rendercache.enabled = True
+        facade.rendercache.enable(ttl_ms=250)
+        drive("warm-cached", min(duration_s / 4, 0.5))
+        collector = facade.device_stats
+        before = collector.snapshot()
+        c_done, _, c_lat, _ = drive("cached", duration_s)
+        after = collector.snapshot()
+        dispatches = {k: after[k] - before[k]
+                      for k in ("compileEvents", "aotCompileEvents",
+                                "recompileEvents", "h2dBytes", "d2hBytes")}
+        if any(dispatches.values()):
+            raise RuntimeError(
+                "cached GET phase touched the device: "
+                f"{dispatches} (want all zero — reads must be served "
+                "from published bytes)")
+
+        # --- conditional requests: a revalidation answers 304, no body.
+        conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                          timeout=60)
+        conn.request("GET", "/kafkacruisecontrol/proposals")
+        resp = conn.getresponse()
+        resp.read()
+        etag = resp.getheader("ETag")
+        if resp.status != 200 or not etag:
+            raise RuntimeError(
+                f"cached GET /proposals: {resp.status}, ETag {etag!r} "
+                "(want 200 with a strong validator)")
+        conn.request("GET", "/kafkacruisecontrol/proposals",
+                     headers={"If-None-Match": etag})
+        resp = conn.getresponse()
+        body = resp.read()
+        conn.close()
+        if resp.status != 304 or body:
+            raise RuntimeError(
+                f"If-None-Match revalidation: {resp.status} with "
+                f"{len(body)} body bytes (want 304, zero bytes)")
+
+        # --- phase M: cached reads under generation bumps + dryrun
+        # rebalances (coherence gates live inside drive()).
+        drive("mixed", duration_s, with_writes=True)
+
+        u_rps = u_done / duration_s
+        c_rps = c_done / duration_s
+        speedup = c_rps / u_rps if u_rps else None
+
+        def p99_ms(lat):
+            if not lat:
+                return None
+            return sorted(lat)[min(int(0.99 * len(lat)),
+                                   len(lat) - 1)] * 1000.0
+
+        u_p99, c_p99 = p99_ms(u_lat), p99_ms(c_lat)
+        log(f"api read tier ({num_brokers}x{num_partitions}, {threads} "
+            f"threads): {c_rps:.0f} req/s cached vs {u_rps:.0f} req/s "
+            f"per-request render ({speedup:.1f}x); p99 {c_p99:.2f} ms "
+            f"vs {u_p99:.2f} ms; 0 device dispatches on cached reads")
+        if gate and (speedup is None or speedup < 5.0):
+            raise RuntimeError(
+                f"api throughput gate: cached serving is only "
+                f"{speedup:.1f}x the per-request-render baseline "
+                "(want >= 5x)")
+        if emit_row:
+            emit("api_requests_per_s", round(c_rps, 1), "req/s",
+                 round(speedup, 1) if speedup else None)
+            emit("api_read_p99_ms", round(c_p99, 3), "ms",
+                 round(u_p99 / c_p99, 1) if c_p99 else None)
+        return {"uncached_rps": u_rps, "cached_rps": c_rps,
+                "speedup": speedup, "uncached_p99_ms": u_p99,
+                "cached_p99_ms": c_p99, "dispatches": dispatches,
+                "rendercache": facade.rendercache.to_json()}
+    finally:
+        app.stop()
+
+
 def build_spec(num_brokers: int = NUM_BROKERS,
                num_partitions: int = NUM_PARTITIONS):
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
@@ -1708,7 +1980,7 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7, 8),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
@@ -1716,7 +1988,8 @@ def main():
                          "100x20K, 7 = tuned multi-objective population "
                          "search vs fixed-schedule sequential, 100x20K, "
                          "8 = forecast fit + [C, S] fleet trajectory "
-                         "sweep, 4 clusters x 100x20K)")
+                         "sweep, 4 clusters x 100x20K, 9 = heavy-traffic "
+                         "API read tier, cached vs per-request render)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -1779,6 +2052,11 @@ def main():
                 log("--mesh is ignored for scenario 8: the trajectory "
                     "dispatch owns the device axis (cluster sharding)")
             run_forecast_sweep_bench()
+        elif args.scenario == 9:
+            if args.mesh:
+                log("--mesh is ignored for scenario 9: the read tier "
+                    "serves published bytes (no device work at all)")
+            run_api_throughput_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

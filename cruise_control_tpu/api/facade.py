@@ -9,6 +9,7 @@ only parses parameters and serializes results.
 from __future__ import annotations
 
 import fnmatch
+import json
 import logging
 import threading
 import time as _time
@@ -205,6 +206,88 @@ class KafkaCruiseControl:
             return regs + list(self.extra_registries)
 
         self.registry = CompositeRegistry(_registries)
+
+        #: serving-tier render cache (api/rendercache.py): per-endpoint
+        #: immutable pre-serialized response snapshots keyed on the
+        #: lock-free change counters (monitor generation, resident epoch,
+        #: registry shape). Lives on the facade — both web engines route
+        #: through it — and the precompute refresher tick re-publishes
+        #: the auto-refresh set so hot entries stay warm.
+        from .rendercache import RenderCache
+        self.rendercache = RenderCache()
+        self.extra_registries.append(self.rendercache.registry)
+        self._register_render_endpoints()
+        self.proposal_cache.on_tick.append(self.rendercache.refresh)
+
+    def _register_render_endpoints(self) -> None:
+        """Wire the read-tier endpoints into the render cache.
+
+        Key model: ``base_key`` is the cheap lock-free triple (model
+        generation, resident epoch, scrape-surface shape) every response
+        body depends on; endpoints whose bytes can move without those
+        counters (executor phase inside /state, live meter values inside
+        /metrics) default to ``ttl_ms=0`` (cache OFF — tier-1 stacks and
+        single-user CLIs always see fresh bytes) and are flipped to a
+        ttl micro-cache by ``rendercache.enable()`` on serving/bench
+        stacks. /proposals is exact: its body is a pure function of the
+        published proposal-cache entry, so the (generation, entry seq)
+        key alone bounds staleness and it caches everywhere."""
+        from .rendercache import Uncacheable
+        rc = self.rendercache
+
+        def base_key() -> tuple:
+            resident = getattr(self.monitor, "resident", None)
+            return (self.monitor.generation,
+                    resident.epoch if resident is not None else -1,
+                    self.registry.mutation_count)
+
+        def proposals_key() -> tuple:
+            e = self.proposal_cache.fast_entry()
+            if e is None:
+                raise Uncacheable("proposal cache cold or stale")
+            return (e.generation, e.seq)
+
+        def proposals_payload() -> dict:
+            e = self.proposal_cache.fast_entry()
+            if e is None:
+                raise Uncacheable("proposal cache cold or stale")
+            # The servlet response shape (server.py builds the same dict
+            # on the uncached path); lazy import to dodge the cycle.
+            from .server import _optimization_response
+            return _optimization_response(e.result, None)
+
+        rc.register("proposals", proposals_key, proposals_payload,
+                    ttl_ms=None, plaintext=True, auto_refresh=True)
+        rc.register("state", base_key, lambda: self.state(None),
+                    ttl_ms=0, plaintext=True, auto_refresh=True)
+        rc.register("kafka_cluster_state", base_key,
+                    lambda: self.kafka_cluster_state(), ttl_ms=0,
+                    plaintext=True)
+        rc.register("load", base_key, lambda: self.load(), ttl_ms=0,
+                    plaintext=True)
+        rc.register("devicestats",
+                    lambda: base_key() + (self.device_stats.cycle_seq,),
+                    self.device_stats_json, ttl_ms=0, plaintext=True,
+                    auto_refresh=True)
+        rc.register("fleet", base_key, self.fleet_summary, ttl_ms=0,
+                    plaintext=True)
+        rc.register("forecast", base_key, self.forecast_json, ttl_ms=0,
+                    plaintext=True)
+        rc.register("metrics", lambda: (self.registry.mutation_count,),
+                    self.registry.expose_text,
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                    ttl_ms=0, raw=True)
+        rc.register("trace", base_key,
+                    lambda: json.dumps(self.tracer.to_chrome_trace()),
+                    ttl_ms=0, raw=True)
+
+        def explorer_payload() -> str:
+            from .openapi import api_explorer_html
+            return api_explorer_html()
+
+        rc.register("explorer", lambda: (), explorer_payload,
+                    content_type="text/html; charset=utf-8",
+                    ttl_ms=None, raw=True)
 
     def _admin_read(self, fn, *args):
         """Run a read-only admin RPC under the shared retry policy:
@@ -460,8 +543,12 @@ class KafkaCruiseControl:
         if self.snapshotter is not None:
             if role == "leader":
                 self.snapshotter.maybe_write(now, self.snapshot_payload)
-            elif self.snapshotter.newer_snapshot_available():
+            elif (self.snapshotter.standby_should_poll(now)
+                  and self.snapshotter.newer_snapshot_available()):
                 # Standby: serve the leader's latest published state.
+                # The fast-poll throttle (interval/4, or immediately on
+                # a local-process peer write) keeps the stat() cadence
+                # bounded without widening the staleness window.
                 self.restore_from_snapshot(now)
         return role
 

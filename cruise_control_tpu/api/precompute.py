@@ -33,6 +33,28 @@ from ..analyzer import OptimizationOptions
 LOG = logging.getLogger(__name__)
 
 
+class CacheEntry:
+    """Immutable published cache entry — the lock-free read surface.
+
+    Writers build a fresh instance under the Condition and publish it
+    with ONE attribute store (atomic under the GIL); readers grab the
+    reference with one attribute load and get a consistent
+    (result, generation, stamp, seq) tuple without ever touching the
+    Condition. ``seq`` increments per publish, so render caches keyed on
+    it notice a same-generation refill (a fleet tick re-store)."""
+
+    __slots__ = ("result", "generation", "cached_at_ms", "seq")
+
+    def __init__(self, result, generation, cached_at_ms, seq) -> None:
+        object.__setattr__(self, "result", result)
+        object.__setattr__(self, "generation", generation)
+        object.__setattr__(self, "cached_at_ms", cached_at_ms)
+        object.__setattr__(self, "seq", seq)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("CacheEntry is immutable")
+
+
 class ProposalCache:
     def __init__(self, monitor, optimizer, *,
                  options: OptimizationOptions | None = None,
@@ -56,12 +78,23 @@ class ProposalCache:
         # Readers that execute re-apply strict semantics (facade.rebalance).
         self.options = options or OptimizationOptions(
             skip_hard_goal_check=True)
+        # Writer-side Condition: _compute/store/restore/invalidate and
+        # BLOCKING readers (get() waiting on an in-flight compute) take
+        # it; the hot read path never does — it reads ``_entry``.
         self._lock = threading.Condition()
         self._cached = None            # OptimizerResult
         self._cached_generation: int | None = None
+        #: published immutable CacheEntry | None — ONE attribute read
+        #: serves the lock-free fast path (peek/valid/get-when-warm).
+        self._entry: CacheEntry | None = None
+        self._entry_seq = 0
         self._computing = False
         self._refresher: threading.Thread | None = None
         self._stop = threading.Event()
+        #: callbacks invoked (exception-safe) at the end of every
+        #: refresh tick — the facade's render cache re-publishes its
+        #: response snapshots here, off the serving hot path.
+        self.on_tick: list = []
         self.num_computations = 0
         # ---- freshness SLO bookkeeping -------------------------------
         self._now_ms_fn = now_ms or (lambda: int(_time.time() * 1000))
@@ -95,16 +128,36 @@ class ProposalCache:
 
     # ------------------------------------------------------------- reads
     def peek(self):
-        """The cached OptimizerResult without blocking or recompute (may
-        be stale or None) — for gauges that must never trigger work."""
-        with self._lock:
-            return self._cached
+        """The cached OptimizerResult without blocking, recompute, or any
+        lock (may be stale or None) — for gauges that must never trigger
+        work and for the serving tier's hot path."""
+        e = self._entry
+        return e.result if e is not None else None
+
+    def fast_entry(self) -> CacheEntry | None:
+        """Lock-free generation-valid read: the published immutable entry
+        when it answers the monitor's CURRENT generation, else None. The
+        render cache serves ``GET /proposals`` off this — one attribute
+        load plus one int compare, no Condition, no facade lock."""
+        e = self._entry
+        if e is not None and e.generation == self.monitor.generation:
+            return e
+        return None
 
     def valid(self) -> bool:
-        """ref validCachedProposal GoalOptimizer.java:232-239."""
-        with self._lock:
-            return (self._cached is not None
-                    and self._cached_generation == self.monitor.generation)
+        """ref validCachedProposal GoalOptimizer.java:232-239 (lock-free:
+        reads the published entry)."""
+        return self.fast_entry() is not None
+
+    def _publish_locked(self) -> None:
+        """Mirror the Condition-side fields into a fresh immutable entry
+        (caller holds the Condition). One attribute store publishes."""
+        if self._cached is None:
+            self._entry = None
+            return
+        self._entry_seq += 1
+        self._entry = CacheEntry(self._cached, self._cached_generation,
+                                 self._cached_at_ms, self._entry_seq)
 
     def observe_generation(self, now_ms: int | None = None) -> None:
         """Stamp when the monitor's generation last moved — the anchor
@@ -157,6 +210,11 @@ class ProposalCache:
         computation) when stale (ref blocking read :304-352). A waiter whose
         in-flight computation fails takes over the computation itself (so
         the original error surfaces rather than a bogus timeout)."""
+        # Warm fast path: one published-entry read. No Condition — N
+        # concurrent readers of a generation-valid cache never serialize.
+        e = self.fast_entry()
+        if e is not None:
+            return e.result
         deadline = _time.monotonic() + timeout_s
         while True:
             with self._lock:
@@ -209,6 +267,7 @@ class ProposalCache:
             self._cached_generation = gen
             self._cached_at_ms = done_ms
             self.num_computations += 1
+            self._publish_locked()
             self._lock.notify_all()
             catch_up = (done_ms - gen_changed0
                         if gen_changed0 is not None else None)
@@ -274,6 +333,7 @@ class ProposalCache:
             self._cached = result
             self._cached_generation = generation
             self._cached_at_ms = self._now_ms_fn()
+            self._publish_locked()
             self._lock.notify_all()
             return True
 
@@ -282,6 +342,7 @@ class ProposalCache:
             self._cached = None
             self._cached_generation = None
             self._cached_at_ms = None
+            self._entry = None
 
     # -------------------------------------------------- snapshot/restore
     def export_state(self) -> dict | None:
@@ -315,6 +376,7 @@ class ProposalCache:
             self._cached_generation = state["generation"]
             self._cached_at_ms = state["cachedAtMs"]
             self.num_computations = state.get("numComputations", 0)
+            self._publish_locked()
             self._lock.notify_all()
 
     # ------------------------------------------- background refresh loop
@@ -330,6 +392,7 @@ class ProposalCache:
         now = fn()
         self.observe_generation(now)
         if self.valid():
+            self._notify_tick()
             return False
         # A persistent compute failure is the WORST freshness outage:
         # mark the breach from the tick itself (once per generation) the
@@ -345,12 +408,23 @@ class ProposalCache:
                     and lag > self.freshness_target_ms):
                 self._mark_breach(gen, lag)
         if not compute:
+            self._notify_tick()
             return False
         try:
             self.get(fn())
             return True
         except Exception:
             return False
+        finally:
+            self._notify_tick()
+
+    def _notify_tick(self) -> None:
+        for cb in list(self.on_tick):
+            try:
+                cb()
+            except Exception:          # pragma: no cover - defensive
+                LOG.debug("proposal-cache on_tick hook failed",
+                          exc_info=True)
 
     def start_refresher(self, interval_s: float, now_ms_fn, *,
                         freshness_target_ms: int = 0,

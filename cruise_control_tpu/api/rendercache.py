@@ -1,0 +1,311 @@
+"""Serving-tier render cache: immutable pre-rendered response snapshots.
+
+The heavy-traffic read problem (ROADMAP 4a, PAPERS.md arxiv 2207.02026's
+read-plane/optimization-plane split): the reference serves its hot read
+endpoints from cached state (``GET /proposals`` is a cache read,
+``GoalOptimizer.java:232-352``), but a naive rebuild still pays — per
+request — the facade ``RLock``, the ``ProposalCache`` condition, a JSON
+re-serialization of a payload that has not changed, and a ``Lock`` per
+request-rate meter. Under N request threads those serialize the whole
+read tier on a handful of locks while the bytes they produce are
+byte-identical.
+
+This module publishes, per endpoint, ONE immutable
+:class:`RenderedEntry` — pre-serialized JSON bytes (the final
+``{"version": 1, ...}`` envelope), the optional ``json=false``
+plaintext rendering, and a strong ``ETag`` — keyed on the stack's
+cheap, lock-free change detectors:
+
+- the monitor's **model generation** (bumps when an aggregation window
+  rolls — the proposal cache's own staleness key),
+- the resident store's **epoch** (bumps on structural device rebuilds),
+- the facade registry's **mutation count** (bumps on sensor
+  registration — the scrape-surface shape),
+
+plus per-endpoint extras (the published proposal entry's ``seq``, the
+device-stats collector's ``cycle_seq``). Writers — the precompute
+refresher tick, the fleet tick's re-store, a devicestats cycle landing,
+or the first request after a key moved — render under the normal locks
+and publish with one dict store. Readers (``api/server.py``'s
+``route_request``) do one dict read plus one key compare; on an
+``If-None-Match`` hit they answer ``304`` without building a byte of
+body. The facade ``RLock`` and the ``ProposalCache`` condition are
+never touched on the cached path.
+
+Freshness model (documented in docs/operations.md §Serving-tier
+tuning): ``ttl_ms=None`` means the key alone bounds staleness (exact
+for ``/proposals`` — the body is a pure function of the published cache
+entry — and for the static explorer page). Endpoints whose payloads
+embed live values the key cannot see (``/state``'s executor phase,
+``/metrics`` values, ``/devicestats`` memory numbers) use a ttl
+micro-cache: within the window every request shares one render; past
+it the next request re-renders. ``ttl_ms=0`` disables caching for the
+endpoint entirely (the tier-1 default for live-value endpoints — tests
+and single-user stacks always see fresh bytes; ``enable()`` flips the
+serving profile on for production/bench stacks).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable
+
+LOG = logging.getLogger(__name__)
+
+#: endpoints enable() flips from "always fresh" to ttl micro-caching.
+LIVE_VALUE_ENDPOINTS = ("state", "kafka_cluster_state", "devicestats",
+                        "fleet", "forecast", "trace", "metrics")
+
+
+class Uncacheable(Exception):
+    """Raised by a key/payload function when the endpoint cannot be
+    served from cache right now (e.g. the proposal cache is cold or
+    generation-invalid) — the caller falls through to the full path."""
+
+
+class RenderedEntry:
+    """One immutable published response snapshot. Replaced wholesale,
+    never mutated, so a reader that grabbed the reference always has a
+    consistent (etag, body) pair — torn reads are structurally
+    impossible."""
+
+    __slots__ = ("endpoint", "key", "etag", "body", "text",
+                 "content_type", "seq", "expires_mono")
+
+    def __init__(self, endpoint, key, etag, body, text, content_type,
+                 seq, expires_mono) -> None:
+        object.__setattr__(self, "endpoint", endpoint)
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "etag", etag)
+        object.__setattr__(self, "body", body)
+        object.__setattr__(self, "text", text)
+        object.__setattr__(self, "content_type", content_type)
+        object.__setattr__(self, "seq", seq)
+        object.__setattr__(self, "expires_mono", expires_mono)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("RenderedEntry is immutable")
+
+
+class _Renderer:
+    __slots__ = ("endpoint", "key_fn", "payload_fn", "content_type",
+                 "ttl_ms", "raw", "plaintext", "auto_refresh")
+
+    def __init__(self, endpoint, key_fn, payload_fn, content_type,
+                 ttl_ms, raw, plaintext, auto_refresh) -> None:
+        self.endpoint = endpoint
+        self.key_fn = key_fn
+        self.payload_fn = payload_fn
+        self.content_type = content_type
+        self.ttl_ms = ttl_ms
+        self.raw = raw
+        self.plaintext = plaintext
+        self.auto_refresh = auto_refresh
+
+
+class RenderCache:
+    """Generation-keyed immutable response snapshots for the read tier.
+
+    Thread model: ``get()`` is lock-free (one dict read, one key
+    compare, striped hit counters). ``_render_and_publish`` serializes
+    writers on a small publish lock — writers are rare (key moves, ttl
+    expiries, refresher ticks) and the lock is never held while a
+    cached read is served.
+    """
+
+    def __init__(self, *, registry=None) -> None:
+        from ..core.sensors import MetricRegistry
+        self._renderers: dict[str, _Renderer] = {}
+        self._entries: dict[str, RenderedEntry] = {}
+        self._publish_lock = threading.Lock()
+        #: endpoints that have been served through the cache at least
+        #: once — the only ones refresh() keeps warm (set.add is
+        #: GIL-atomic; a lost race just delays warm-keeping one request).
+        self._hot: set[str] = set()
+        self._seq = 0
+        #: master switch — the bench's A/B baseline flips it off.
+        self.enabled = True
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        g = "RenderCache"
+        self._hits = self.registry.striped_counter(name(g, "hits"))
+        self._misses = self.registry.striped_counter(name(g, "misses"))
+        self._renders = self.registry.counter(name(g, "renders"))
+        self.registry.gauge(name(g, "endpoints"),
+                            lambda: len(self._renderers))
+        self.registry.gauge(name(g, "published"),
+                            lambda: len(self._entries))
+
+    # -------------------------------------------------------- registration
+    def register(self, endpoint: str, key_fn: Callable[[], tuple],
+                 payload_fn: Callable[[], object], *,
+                 content_type: str = "application/json",
+                 ttl_ms: int | None = None, raw: bool = False,
+                 plaintext: bool = False,
+                 auto_refresh: bool = False) -> None:
+        """Wire an endpoint into the cache.
+
+        ``raw`` payload functions return ``str``/``bytes`` served as-is
+        under ``content_type`` (``/metrics``, ``/trace``, the explorer);
+        JSON payload functions return the response dict, serialized here
+        into the final ``{"version": 1, ...}`` envelope bytes (and, with
+        ``plaintext``, the ``json=false`` text rendering). ``ttl_ms``:
+        None = key-only, 0 = disabled, >0 = micro-cache window.
+        ``auto_refresh`` marks the endpoint for :meth:`refresh` (the
+        refresher-tick publish set)."""
+        self._renderers[endpoint] = _Renderer(
+            endpoint, key_fn, payload_fn, content_type, ttl_ms, raw,
+            plaintext, auto_refresh)
+
+    def set_ttl(self, endpoint: str, ttl_ms: int | None) -> None:
+        r = self._renderers.get(endpoint)
+        if r is None:
+            raise KeyError(f"no renderer registered for {endpoint!r}")
+        r.ttl_ms = ttl_ms
+        self._entries.pop(endpoint, None)
+
+    def enable(self, ttl_ms: int = 500, *,
+               metrics_ttl_ms: int | None = None) -> None:
+        """Flip the serving profile on: live-value endpoints get a
+        ``ttl_ms`` micro-cache (``/metrics`` optionally tighter — scrape
+        staleness tolerances differ from dashboard ones). Key-only
+        endpoints (``/proposals``, explorer) are always on."""
+        for ep in LIVE_VALUE_ENDPOINTS:
+            if ep in self._renderers:
+                ttl = ttl_ms
+                if ep == "metrics" and metrics_ttl_ms is not None:
+                    ttl = metrics_ttl_ms
+                self.set_ttl(ep, ttl)
+
+    # --------------------------------------------------------------- reads
+    def get(self, endpoint: str) -> RenderedEntry | None:
+        """The lock-free fast read: published entry if its key still
+        matches (and its ttl window is open), else None. Never renders,
+        never blocks, never takes a lock."""
+        if not self.enabled:
+            return None
+        entry = self._entries.get(endpoint)
+        if entry is None:
+            return None
+        if (entry.expires_mono is not None
+                and time.monotonic() >= entry.expires_mono):
+            self._misses.inc()
+            return None
+        r = self._renderers.get(endpoint)
+        if r is None:
+            return None
+        try:
+            key = r.key_fn()
+        except Uncacheable:
+            return None
+        if entry.key != key:
+            self._misses.inc()
+            return None
+        self._hits.inc()
+        return entry
+
+    def lookup_or_render(self, endpoint: str) -> RenderedEntry | None:
+        """Serve the published entry, or render+publish inline (the
+        first request after a key moved pays the render; everyone behind
+        it reads the new entry lock-free). None when the endpoint is not
+        registered, disabled (ttl 0), or currently uncacheable — the
+        caller falls through to the full request path."""
+        if not self.enabled:
+            return None
+        r = self._renderers.get(endpoint)
+        if r is None or r.ttl_ms == 0:
+            return None
+        # Mark the endpoint hot: refresh() keeps only actually-served
+        # endpoints warm, so control planes nobody is polling (and unit
+        # tests churning generations) never pay background renders.
+        self._hot.add(endpoint)
+        entry = self.get(endpoint)
+        if entry is not None:
+            return entry
+        try:
+            return self._render_and_publish(r)
+        except Uncacheable:
+            return None
+
+    # -------------------------------------------------------------- writes
+    def _render_and_publish(self, r: _Renderer) -> RenderedEntry:
+        with self._publish_lock:
+            # A racing writer may have published while we waited.
+            entry = self.get(r.endpoint)
+            if entry is not None:
+                return entry
+            key = r.key_fn()
+            payload = r.payload_fn()
+            if r.raw:
+                body = (payload.encode() if isinstance(payload, str)
+                        else bytes(payload))
+                text = None
+            else:
+                body = json.dumps({"version": 1, **payload}).encode()
+                text = None
+                if r.plaintext:
+                    from .plaintext import render as render_text
+                    # Trailing newline matches the uncached json=false
+                    # path byte-for-byte (server.py appends it).
+                    text = (render_text(r.endpoint, payload)
+                            + "\n").encode()
+            self._seq += 1
+            etag = '"cc-{}-{}-{}"'.format(
+                r.endpoint, self._seq,
+                "-".join(str(k) for k in key))
+            expires = None
+            if r.ttl_ms is not None:
+                expires = time.monotonic() + r.ttl_ms / 1000.0
+            entry = RenderedEntry(r.endpoint, key, etag, body, text,
+                                  r.content_type, self._seq, expires)
+            self._entries[r.endpoint] = entry
+            self._renders.inc()
+            return entry
+
+    def refresh(self) -> int:
+        """Re-publish every stale auto-refresh endpoint — the precompute
+        refresher tick / fleet tick hook, keeping the hot entries warm
+        so requests almost never pay a render. Exception-safe (a cold
+        proposal cache is normal); returns the number published."""
+        published = 0
+        if not self.enabled:
+            return published
+        for ep, r in list(self._renderers.items()):
+            if not r.auto_refresh or r.ttl_ms == 0:
+                continue
+            # Warm-keeping applies only to endpoints traffic has
+            # actually hit: rendering the full proposals payload on
+            # every generation bump is pure overhead when nobody polls.
+            if ep not in self._hot:
+                continue
+            if self.get(ep) is not None:
+                continue
+            try:
+                self._render_and_publish(r)
+                published += 1
+            except Uncacheable:
+                continue
+            except Exception:
+                LOG.debug("render-cache refresh failed for %s", ep,
+                          exc_info=True)
+        return published
+
+    def invalidate(self, endpoint: str | None = None) -> None:
+        if endpoint is None:
+            self._entries.clear()
+        else:
+            self._entries.pop(endpoint, None)
+
+    def to_json(self) -> dict:
+        return {"enabled": self.enabled,
+                "endpoints": {
+                    ep: {"ttlMs": r.ttl_ms,
+                         "published": ep in self._entries,
+                         "autoRefresh": r.auto_refresh}
+                    for ep, r in sorted(self._renderers.items())},
+                "hits": self._hits.count,
+                "misses": self._misses.count,
+                "renders": self._renders.count}

@@ -149,17 +149,25 @@ class CruiseControlApp:
         # Pre-built enum-keyed sensor maps (the reference keys its servlet
         # sensors by the CruiseControlEndPoint enum): no per-request
         # registry lookups or name formatting on the dispatch path.
+        # Striped variants: a mark/update is a per-thread append (no
+        # shared Lock), so N request threads never serialize on their own
+        # instrumentation — the scrape drains the stripes.
         _sensor_eps = (("GET", GET_ENDPOINTS | AUX_GET_ENDPOINTS),
                        ("POST", POST_ENDPOINTS))
         self._request_meters = {
-            (m, e): self.registry.meter(
+            (m, e): self.registry.striped_meter(
                 f"KafkaCruiseControlServlet.{e}-request-rate")
             for m, eps in _sensor_eps for e in eps}
         self._success_timers = {
-            (m, e): self.registry.timer(
+            (m, e): self.registry.striped_timer(
                 f"KafkaCruiseControlServlet.{e}-successful-"
                 f"request-execution-timer")
             for m, eps in _sensor_eps for e in eps}
+        # Conditional-request accounting: a 304 is a SUCCESS (the client
+        # has the current bytes) with its own count per endpoint.
+        self._not_modified = {
+            e: self.registry.striped_counter(f"api.{e}.not-modified")
+            for e in GET_ENDPOINTS | AUX_GET_ENDPOINTS}
         self._aio = None
         self.server = None
         if engine == "asyncio":
@@ -248,6 +256,10 @@ class CruiseControlApp:
             sp.set(status=status)
             if meter is not None and status not in (401, 403, 405):
                 meter.mark()
+            if status == 304:
+                nm = self._not_modified.get(endpoint)
+                if nm is not None:
+                    nm.inc()
             if timer is not None and status < 400:
                 timer.update(time.monotonic() - t0)
 
@@ -752,6 +764,85 @@ def _optimization_response(res, exec_res, verbose: bool = False) -> dict:
     return out
 
 
+#: GET endpoints the render cache may serve (bare requests, plus the
+#: ``json=`` flag): the servlet read tier + the bare observability
+#: handlers. Anything with other parameters (verbose, substates, ...)
+#: takes the full typed path.
+CACHED_GET_ENDPOINTS = {"proposals", "state", "kafka_cluster_state",
+                        "load", "devicestats", "fleet", "forecast",
+                        "metrics", "trace", "explorer"}
+#: access-control names for the bare handlers (identical gates to their
+#: uncached handlers above; servlet endpoints check their own name).
+_CACHED_ACCESS = {"metrics": "state", "trace": "state",
+                  "devicestats": "state", "explorer": "openapi"}
+
+
+def _cached_get(app: "CruiseControlApp", parts: list, parsed,
+                headers: dict) -> tuple[int, str, bytes, dict] | None:
+    """The read tier's lock-free fast path: serve a GET straight from
+    the facade's render cache — one dict read, an ETag compare, striped
+    counter bumps. No facade ``RLock``, no ``ProposalCache`` condition,
+    no tracer-span or Meter lock is touched. Returns the full response
+    tuple, or None to fall through to the ordinary dispatch path (which
+    re-runs access control and produces identical bytes, minus the
+    ETag)."""
+    rc = getattr(app.facade, "rendercache", None)
+    if rc is None or not rc.enabled:
+        return None
+    if parts in ([], ["kafkacruisecontrol"]):
+        endpoint = "explorer"
+    else:
+        rest = parts[1:] if parts[:1] == ["kafkacruisecontrol"] else parts
+        if len(rest) != 1:
+            return None
+        endpoint = rest[0].lower()
+        if endpoint not in CACHED_GET_ENDPOINTS:
+            return None
+    params = {k.lower(): v for k, v in parse_qs(parsed.query).items()}
+    if set(params) - {"json"}:
+        return None
+    try:
+        check_access(app.security, _CACHED_ACCESS.get(endpoint, endpoint),
+                     headers)
+    except AuthorizationError:
+        # Full path re-checks and emits the 401/403 with its challenge.
+        return None
+    t0 = time.monotonic()
+    entry = rc.lookup_or_render(endpoint)
+    if entry is None:
+        return None
+    wants_text = (params.get("json", ["true"])[0].strip().lower()
+                  in ("false", "0", "no"))
+    if wants_text:
+        if entry.text is None:
+            return None
+        body, ctype = entry.text, "text/plain; charset=utf-8"
+        # Representation-specific strong ETag: the text bytes differ
+        # from the JSON bytes, so their validators must too.
+        etag = entry.etag[:-1] + '-txt"'
+    else:
+        body, ctype = entry.body, entry.content_type
+        etag = entry.etag
+    meter = app._request_meters.get(("GET", endpoint))
+    timer = app._success_timers.get(("GET", endpoint))
+    inm = headers.get("if-none-match")
+    if (inm is not None
+            and etag in {t.strip() for t in inm.split(",")}):
+        if meter is not None:
+            meter.mark()
+        nm = app._not_modified.get(endpoint)
+        if nm is not None:
+            nm.inc()
+        if timer is not None:
+            timer.update(time.monotonic() - t0)
+        return 304, ctype, b"", {**app.cors, "ETag": etag}
+    if meter is not None:
+        meter.mark()
+    if timer is not None:
+        timer.update(time.monotonic() - t0)
+    return 200, ctype, body, {**app.cors, "ETag": etag}
+
+
 def route_request(app: "CruiseControlApp", method: str, raw_path: str,
                   headers: dict, body: bytes, peer: str
                   ) -> tuple[int, str, bytes, dict]:
@@ -775,6 +866,14 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         # CORS preflight (ref webserver.http.cors.*).
         return ((200 if app.cors else 405), "application/json", b"",
                 dict(app.cors))
+    # Render-cache fast path: both engines' hot GETs (cached or
+    # disabled per endpoint — see facade._register_render_endpoints)
+    # short-circuit here; a None falls through to the handlers below,
+    # which stay the source of truth for the response bytes.
+    if method == "GET":
+        fast = _cached_get(app, parts, parsed, headers)
+        if fast is not None:
+            return fast
     # Root: a self-contained API explorer (the stand-in for the
     # reference's swagger-ui webroot). Gated by the same security
     # provider as the endpoints it documents (VIEWER, like openapi).

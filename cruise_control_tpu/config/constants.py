@@ -294,6 +294,19 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "on). 0 = no age bound — safe because restored results "
                  "are execution-gated by the stale-model refusal until "
                  "live samples confirm the topology.")
+    d.define("webserver.rendercache.ttl.ms", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Serving-tier micro-cache window for live-value read "
+                 "endpoints (/state, /devicestats, /fleet, /forecast, "
+                 "/metrics, /trace — api/rendercache.py): cached GETs "
+                 "serve immutable pre-serialized snapshots with strong "
+                 "ETags, touching no facade lock and dispatching "
+                 "nothing to the device. Bounds staleness WITHIN one "
+                 "generation only — generation/epoch changes still "
+                 "invalidate immediately. 0 (default) = live endpoints "
+                 "render fresh per request; pure-function endpoints "
+                 "(/proposals, the API explorer) are cached either way "
+                 "(docs/operations.md §Serving-tier tuning).")
     d.define("ha.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.MEDIUM,
              doc="Warm-standby high availability (core/leader.py): "

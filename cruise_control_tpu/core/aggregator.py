@@ -385,8 +385,11 @@ class MetricSampleAggregator:
     # ------------------------------------------------------------------ api
     @property
     def generation(self) -> int:
-        with self._lock:
-            return self._generation
+        # Deliberately lock-free: a single int attribute read is atomic
+        # under the GIL and the counter is monotonic, so the serving
+        # tier's generation-keyed cache reads never contend with ingest
+        # holding the aggregator lock.
+        return self._generation
 
     def seed_generation(self, generation: int) -> None:
         """Raise the generation counter to at least ``generation`` —

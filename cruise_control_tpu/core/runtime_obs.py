@@ -363,6 +363,9 @@ class DeviceStatsCollector:
         self._h2d_bytes = 0
         self._d2h_bytes = 0
         self._last_cycle: dict | None = None
+        #: bumps when an outermost cycle() records — the /devicestats
+        #: render cache keys on it so cached reads republish per cycle.
+        self.cycle_seq = 0
         self._padding: dict | None = None
         self._peak_live_bytes = 0
         #: high-water allocator peak (bytes_in_use peaks include XLA
@@ -573,6 +576,7 @@ class DeviceStatsCollector:
                 "compileEvents": (self.compile_count()
                                   + self.aot_compile_count() - compiles0),
                 "durationMs": round((time.perf_counter() - t0) * 1e3, 3)}
+            self.cycle_seq += 1
 
     @property
     def last_cycle(self) -> dict | None:

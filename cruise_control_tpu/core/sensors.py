@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Callable
 
 
@@ -48,6 +49,34 @@ class Counter:
 
     def to_json(self) -> dict:
         return {"type": "counter", "count": self._value}
+
+
+class StripedCounter(Counter):
+    """Lock-free ``inc``: each thread owns a private cell (only the owner
+    thread read-modify-writes it, so the CPython ``+=`` race vanishes
+    without a lock); reads sum the stripes at scrape time. Renders as a
+    plain counter family — striping changes the write path, never the
+    scrape surface."""
+
+    __slots__ = ("_stripes",)
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._stripes: dict[int, list[int]] = {}
+
+    def inc(self, n: int = 1) -> None:
+        ident = threading.get_ident()
+        cell = self._stripes.get(ident)
+        if cell is None:
+            self._stripes[ident] = cell = [0]
+        cell[0] += n
+
+    @property
+    def count(self) -> int:
+        return self._value + sum(c[0] for c in list(self._stripes.values()))
+
+    def to_json(self) -> dict:
+        return {"type": "counter", "count": self.count}
 
 
 class Meter:
@@ -86,6 +115,62 @@ class Meter:
     def to_json(self) -> dict:
         return {"type": "meter", "count": self._count,
                 "rate_per_s": round(self.rate(), 6)}
+
+
+class StripedMeter(Meter):
+    """Meter whose ``mark`` takes no lock: marks land on a per-thread
+    deque (``deque.append`` is atomic; only the scrape side pops), and
+    every read drains the stripes into the base meter under its lock.
+    N request threads marking one request-rate meter stop serializing on
+    the meter's ``Lock`` — contention moves to the scrape, which is rare.
+    Renders identically to :class:`Meter` (same families)."""
+
+    __slots__ = ("_stripes",)
+
+    def __init__(self, window_s: float = 60.0,
+                 now: Callable[[], float] | None = None) -> None:
+        super().__init__(window_s, now)
+        self._stripes: dict[int, deque] = {}
+
+    def mark(self, n: int = 1) -> None:
+        ident = threading.get_ident()
+        d = self._stripes.get(ident)
+        if d is None:
+            self._stripes[ident] = d = deque()
+        d.append((self._now(), n))
+
+    def _drain_locked(self) -> None:
+        for d in list(self._stripes.values()):
+            while True:
+                try:
+                    t, n = d.popleft()
+                except IndexError:
+                    break
+                self._count += n
+                self._events.append((t, n))
+        cutoff = self._now() - self._window_s
+        if self._events and self._events[0][0] < cutoff:
+            # Stripes drain slightly out of order; filter, don't pop-front.
+            self._events = [(t, n) for t, n in self._events if t >= cutoff]
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            self._drain_locked()
+            return self._count
+
+    def rate(self) -> float:
+        now = self._now()
+        cutoff = now - self._window_s
+        with self._lock:
+            self._drain_locked()
+            total = sum(n for t, n in self._events if t >= cutoff)
+        return total / self._window_s
+
+    def to_json(self) -> dict:
+        rate = self.rate()                      # drains the stripes
+        return {"type": "meter", "count": self._count,
+                "rate_per_s": round(rate, 6)}
 
 
 class Timer:
@@ -138,6 +223,58 @@ class Timer:
                 "p50_s": round(self.quantile(0.50), 6),
                 "p95_s": round(self.quantile(0.95), 6),
                 "p99_s": round(self.quantile(0.99), 6)}
+
+
+class StripedTimer(Timer):
+    """Timer whose ``update`` takes no lock (per-thread deques, drained
+    into the base reservoir on any read — see :class:`StripedMeter`).
+    Renders identically to :class:`Timer` (same summary family)."""
+
+    __slots__ = ("_stripes",)
+
+    def __init__(self, reservoir: int = 1024) -> None:
+        super().__init__(reservoir)
+        self._stripes: dict[int, deque] = {}
+
+    def update(self, seconds: float) -> None:
+        ident = threading.get_ident()
+        d = self._stripes.get(ident)
+        if d is None:
+            self._stripes[ident] = d = deque()
+        d.append(seconds)
+
+    def _flush(self) -> None:
+        with self._lock:
+            for d in list(self._stripes.values()):
+                while True:
+                    try:
+                        seconds = d.popleft()
+                    except IndexError:
+                        break
+                    self._count += 1
+                    self._sum += seconds
+                    self._max = max(self._max, seconds)
+                    if len(self._reservoir) >= self._cap:
+                        self._reservoir.pop(0)
+                    self._reservoir.append(seconds)
+
+    @property
+    def count(self) -> int:
+        self._flush()
+        return self._count
+
+    @property
+    def mean_s(self) -> float:
+        self._flush()
+        return self._sum / self._count if self._count else 0.0
+
+    def quantile(self, q: float) -> float:
+        self._flush()
+        return super().quantile(q)
+
+    def to_json(self) -> dict:
+        self._flush()
+        return super().to_json()
 
 
 class _TimerContext:
@@ -216,13 +353,17 @@ def _flatten_names(items: list[tuple[str, object]]) -> list[str]:
     return out
 
 
-def _render_exposition(items: list[tuple[str, object]]) -> str:
+def _render_exposition(items: list[tuple[str, object]],
+                       flat: list[str] | None = None) -> str:
     """Prometheus text exposition over sorted (dotted name, sensor) pairs —
     the ONE renderer behind both ``MetricRegistry.expose_text`` and the
     composite view (so merged registries cannot emit duplicate ``# TYPE``
     blocks either). Every series family carries a ``# HELP`` line naming
-    the original dotted sensor."""
-    flat = _flatten_names(items)
+    the original dotted sensor. ``flat`` lets callers reuse a cached
+    :func:`_flatten_names` result (the merge/sort/flatten structure is
+    the expensive scrape half; values are always read live)."""
+    if flat is None:
+        flat = _flatten_names(items)
     lines: list[str] = []
 
     def family(series: str, dotted: str, kind: str) -> None:
@@ -271,6 +412,16 @@ class MetricRegistry:
     def __init__(self) -> None:
         self._sensors: dict[str, object] = {}
         self._lock = threading.Lock()
+        #: bumps on every STRUCTURAL change (new sensor, replaced gauge).
+        #: Values changing does not count — the exposition render cache
+        #: keys on this to reuse the merge/flatten structure while still
+        #: reading every value live at scrape time.
+        self._mutations = 0
+        self._render_cache: tuple | None = None
+
+    @property
+    def mutation_count(self) -> int:
+        return self._mutations
 
     @staticmethod
     def name(group: str, sensor: str) -> str:
@@ -282,6 +433,7 @@ class MetricRegistry:
             if s is None:
                 s = factory()
                 self._sensors[name] = s
+                self._mutations += 1
             elif not isinstance(s, kind):
                 raise TypeError(
                     f"sensor {name!r} already registered as "
@@ -291,18 +443,30 @@ class MetricRegistry:
     def counter(self, name: str) -> Counter:
         return self._get_or_create(name, Counter, Counter)
 
+    def striped_counter(self, name: str) -> StripedCounter:
+        return self._get_or_create(name, StripedCounter, StripedCounter)
+
     def meter(self, name: str, window_s: float = 60.0,
               now: Callable[[], float] | None = None) -> Meter:
         return self._get_or_create(
             name, lambda: Meter(window_s, now), Meter)
 
+    def striped_meter(self, name: str, window_s: float = 60.0,
+                      now: Callable[[], float] | None = None) -> StripedMeter:
+        return self._get_or_create(
+            name, lambda: StripedMeter(window_s, now), StripedMeter)
+
     def timer(self, name: str) -> Timer:
         return self._get_or_create(name, Timer, Timer)
+
+    def striped_timer(self, name: str) -> StripedTimer:
+        return self._get_or_create(name, StripedTimer, StripedTimer)
 
     def gauge(self, name: str, fn: Callable[[], float]) -> Gauge:
         with self._lock:
             g = Gauge(fn)
             self._sensors[name] = g
+            self._mutations += 1
             return g
 
     def get(self, name: str):
@@ -332,8 +496,21 @@ class MetricRegistry:
         ``_count``/``_sum`` and quantile series (a summary), meters
         ``_total`` and ``_rate``, counters ``_total``, gauges the bare
         name. Every family carries ``# HELP`` and exactly one ``# TYPE``.
+
+        The merge/sort/flatten structure is cached and invalidated by the
+        registry's mutation counter, so steady-state scrapes only format
+        values — they stop re-sorting and re-deduplicating family names
+        every time (the Prometheus-scrape hot path).
         """
-        return _render_exposition(self.snapshot())
+        muts = self._mutations
+        cache = self._render_cache
+        if cache is not None and cache[0] == muts:
+            items, flat = cache[1], cache[2]
+        else:
+            items = self.snapshot()
+            flat = _flatten_names(items)
+            self._render_cache = (muts, items, flat)
+        return _render_exposition(items, flat)
 
 
 class CompositeRegistry:
@@ -346,6 +523,7 @@ class CompositeRegistry:
 
     def __init__(self, sources: Callable[[], list[MetricRegistry]]) -> None:
         self._raw_sources = sources
+        self._render_cache: tuple | None = None
 
     def _sources(self) -> list[MetricRegistry]:
         # Dedupe by identity: subsystems wired with ONE shared registry
@@ -363,6 +541,15 @@ class CompositeRegistry:
             if s is not None:
                 return s
         return None
+
+    @property
+    def mutation_count(self) -> int:
+        """Structural-change key over every source (len guards source
+        attach/detach; per-source counters only grow, so the sum plus the
+        count detects any structural change)."""
+        sources = self._sources()
+        return len(sources) + sum(
+            getattr(reg, "mutation_count", 0) for reg in sources)
 
     def names(self) -> list[str]:
         out: set[str] = set()
@@ -383,16 +570,32 @@ class CompositeRegistry:
         # registries without the snapshot() merge surface (a nested
         # composite, a custom extra_registries entry) keep the old
         # concatenation behavior rather than breaking the scrape.
-        merged: dict[str, object] = {}
-        foreign: list[str] = []
-        for reg in self._sources():
-            snap = getattr(reg, "snapshot", None)
-            if snap is None:
-                foreign.append(reg.expose_text())
-                continue
-            for name, s in snap():
-                merged.setdefault(name, s)
-        return _render_exposition(sorted(merged.items())) + "".join(foreign)
+        #
+        # The merged structure (sorted items + flattened family names) is
+        # cached against the sources' mutation counters, so a /metrics
+        # scrape of a quiet fleet re-renders values but never re-merges,
+        # re-sorts, or re-deduplicates hundreds of families per request.
+        sources = self._sources()
+        snap_sources = [r for r in sources
+                        if getattr(r, "snapshot", None) is not None]
+        foreign = [r for r in sources
+                   if getattr(r, "snapshot", None) is None]
+        key = tuple(getattr(r, "mutation_count", -1) for r in snap_sources)
+        cache = self._render_cache
+        if (cache is not None and cache[0] == key and -1 not in key
+                and len(cache[1]) == len(snap_sources)
+                and all(a is b for a, b in zip(cache[1], snap_sources))):
+            items, flat = cache[2], cache[3]
+        else:
+            merged: dict[str, object] = {}
+            for reg in snap_sources:
+                for name, s in reg.snapshot():
+                    merged.setdefault(name, s)
+            items = sorted(merged.items())
+            flat = _flatten_names(items)
+            self._render_cache = (key, list(snap_sources), items, flat)
+        return _render_exposition(items, flat) + "".join(
+            r.expose_text() for r in foreign)
 
 
 class NamespacedRegistry:
@@ -419,6 +622,10 @@ class NamespacedRegistry:
             raise ValueError("NamespacedRegistry requires a prefix")
         self.inner = inner
         self.prefix = prefix
+
+    @property
+    def mutation_count(self) -> int:
+        return getattr(self.inner, "mutation_count", 0)
 
     def _wrap(self, name: str) -> str:
         return f"{self.prefix}.{name}"

@@ -38,6 +38,7 @@ import os
 import pickle
 import threading
 import time as _time
+import weakref
 
 LOG = logging.getLogger(__name__)
 
@@ -206,17 +207,43 @@ class SnapshotManager:
     loop must not die for a full disk), a restore failure is metered per
     reason and the caller starts cold. Thread-safe."""
 
+    #: every live manager in this process — a successful write notifies
+    #: same-path peers (the in-process HA harness runs leader + standby
+    #: over one file) so a standby's next ha_tick restores immediately
+    #: instead of waiting out the poll throttle. Weak: a dropped stack's
+    #: manager must not be kept alive by the peer registry.
+    _managers: "weakref.WeakSet[SnapshotManager]" = weakref.WeakSet()
+
     def __init__(self, path: str, *, interval_ms: int = 60_000,
                  max_age_ms: int = 0, registry=None) -> None:
         from .sensors import MetricRegistry
         self.path = path
+        self._abspath = os.path.abspath(path)
         self.interval_ms = int(interval_ms)
         #: 0 = no age bound (a restored snapshot is still execution-gated
         #: by the stale-model refusal either way; see facade restore).
         self.max_age_ms = int(max_age_ms)
+        #: standby freshness poll cadence: interval/4 halves the expected
+        #: write->restore staleness gap vs polling at the write interval,
+        #: and the mtime fast path below makes each poll one stat().
+        self.standby_poll_interval_ms = max(self.interval_ms // 4, 1)
+        #: post-write hooks ``fn(now_ms, nbytes)`` — local-process
+        #: subscribers (warm standbys, tests) that want to react to a
+        #: published snapshot without polling. Exception-safe.
+        self.on_write: list = []
         self._lock = threading.Lock()
         self._last_write_ms: int | None = None
         self._last_bytes = 0
+        #: throttle state for :meth:`standby_should_poll`.
+        self._next_poll_ms: int | None = None
+        self._peer_wrote = False
+        #: ((mtime_ns, size, seen) -> bool) memo for
+        #: :meth:`newer_snapshot_available` — an unchanged file answers
+        #: from one stat() without re-reading the header.
+        self._poll_cache: tuple | None = None
+        #: how far behind the leader the last restored snapshot was
+        #: (restore-time now_ms minus the header's createdMs).
+        self._last_staleness_ms: int | None = None
         #: createdMs of the newest snapshot this process has WRITTEN or
         #: RESTORED — the floor `newer_snapshot_available` compares
         #: against, so a just-deposed leader never "refreshes" from its
@@ -238,6 +265,9 @@ class SnapshotManager:
         self.registry.gauge(name(g, "last-write-ms"),
                             lambda: self._last_write_ms)
         self.registry.gauge(name(g, "bytes"), lambda: self._last_bytes)
+        self.registry.gauge(name(g, "standby-staleness-ms"),
+                            lambda: self._last_staleness_ms)
+        SnapshotManager._managers.add(self)
 
     # ------------------------------------------------------------ writes
     def maybe_write(self, now_ms: int, payload_fn) -> bool:
@@ -268,7 +298,41 @@ class SnapshotManager:
                                         int(now_ms))
         self._writes.inc()
         LOG.debug("snapshot written to %s (%d bytes)", self.path, n)
+        # Local-process fan-out: wake same-file peers (the in-process HA
+        # harness's standby) and this manager's subscribers so freshness
+        # never waits out the standby poll throttle.
+        for peer in list(SnapshotManager._managers):
+            if peer is not self and peer._abspath == self._abspath:
+                peer._note_peer_write()
+        for hook in list(self.on_write):
+            try:
+                hook(now_ms, n)
+            except Exception:   # noqa: BLE001 — hooks must not kill writes
+                LOG.warning("snapshot on_write hook failed", exc_info=True)
         return n
+
+    def _note_peer_write(self) -> None:
+        """A same-path peer published a snapshot: the next
+        :meth:`standby_should_poll` answers True regardless of the
+        throttle window."""
+        with self._lock:
+            self._peer_wrote = True
+
+    def standby_should_poll(self, now_ms: int) -> bool:
+        """Standby-side freshness-poll throttle: True at most every
+        ``standby_poll_interval_ms`` — or immediately when a same-process
+        peer just wrote (the multi-process case pays at worst one quarter
+        interval of extra staleness; the sensor above measures it)."""
+        with self._lock:
+            if self._peer_wrote:
+                self._peer_wrote = False
+                self._next_poll_ms = now_ms + self.standby_poll_interval_ms
+                return True
+            if (self._next_poll_ms is not None
+                    and now_ms < self._next_poll_ms):
+                return False
+            self._next_poll_ms = now_ms + self.standby_poll_interval_ms
+            return True
 
     # ----------------------------------------------------------- restore
     def restore(self, now_ms: int, validate=None) -> dict | None:
@@ -300,6 +364,8 @@ class SnapshotManager:
         with self._lock:
             self._seen_created_ms = max(self._seen_created_ms or 0,
                                         int(header.get("createdMs", 0)))
+            self._last_staleness_ms = max(
+                0, now_ms - int(header.get("createdMs", 0)))
         return payload
 
     def refuse(self, reason: str, message: str) -> None:
@@ -317,14 +383,42 @@ class SnapshotManager:
         would regress the live cache to an interval-old state."""
         with self._lock:
             seen = self._seen_created_ms
+            cached = self._poll_cache
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            with self._lock:
+                self._poll_cache = None
+            return False
+        # mtime fast path: an unchanged file (same mtime_ns + size) with
+        # an unchanged floor answers from the stat alone — the header is
+        # re-read only when the file or the floor actually moved, so the
+        # interval/4 standby poll costs one stat() in steady state.
+        key = (st.st_mtime_ns, st.st_size, seen)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         try:
             with open(self.path, "rb") as f:
                 head = io.BufferedReader(f).readline()
             header = json.loads(head)
             created = int(header.get("createdMs", 0))
         except (OSError, ValueError):
+            with self._lock:
+                self._poll_cache = None
             return False
-        return seen is None or created > seen
+        result = seen is None or created > seen
+        # Racy-mtime guard (the git index trick): filesystem timestamps
+        # have coarse granularity, so a file modified within the last
+        # few ticks could be rewritten again without its mtime moving.
+        # Only memoize once the mtime is comfortably in the past — fresh
+        # files re-read the header on every poll.
+        if _time.time_ns() - st.st_mtime_ns > 50_000_000:
+            with self._lock:
+                self._poll_cache = (key, result)
+        else:
+            with self._lock:
+                self._poll_cache = None
+        return result
 
     def to_json(self) -> dict:
         """The ``snapshot`` section of ``/devicestats``."""
@@ -340,4 +434,6 @@ class SnapshotManager:
                                      for r, m in self._fallbacks.items()},
                 "lastWriteMs": self._last_write_ms,
                 "bytes": self._last_bytes or None,
+                "standbyPollIntervalMs": self.standby_poll_interval_ms,
+                "standbyStalenessMs": self._last_staleness_ms,
             }

@@ -167,6 +167,14 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
     # §Snapshot/restore & HA): the manager restores in start_up (before
     # prewarm) and writes on the ha_tick cadence in main(); the elector
     # fences the executor under its epoch.
+    # Heavy-traffic read tier (docs/operations.md §Serving-tier
+    # tuning): a positive TTL opts the live-value endpoints into the
+    # render-cache micro-cache window; pure-function endpoints
+    # (/proposals, the explorer) are cached regardless.
+    rc_ttl = config.get_long("webserver.rendercache.ttl.ms")
+    if rc_ttl > 0:
+        facade.rendercache.enable(ttl_ms=rc_ttl)
+
     snap_path = config.get_string("snapshot.path")
     if snap_path:
         from .core.snapshot import SnapshotManager

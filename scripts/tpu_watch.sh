@@ -88,10 +88,15 @@ while true; do
     # sweep, 4 clusters x 100x20K): the trajectory dispatch rides the
     # same compiled scenario scorer scenario 6 warms, so it slots right
     # after the fleet propose for a warm compile cache.
-    for spec in 2 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 9 = the heavy-traffic API read tier (cached vs per-request
+    # render): host-side HTTP serving with the device idle — cheap, so
+    # it rides early in the ladder and certifies the 0-dispatch gate on
+    # whatever backend the tunnel exposes.
+    for spec in 2 9 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
         2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7) tmo=4800 ;;
+        9) tmo=1800 ;;
         4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac

@@ -951,3 +951,206 @@ def test_standby_execution_returns_503_with_leader_id(stack):
         sim.alter_topic_config(HA_TOPIC, {"ha.leader.id": None,
                                           "ha.lease.until.ms": None,
                                           "ha.leader.epoch": None})
+
+
+# ----------------------------------------------------- serving-tier cache
+
+def test_render_cache_profile_and_etags(stack):
+    """The serving-tier render cache: /proposals (a pure function of the
+    published cache entry) serves pre-rendered bytes with a strong ETag
+    everywhere; live-value endpoints default to ttl 0 (cache OFF — every
+    GET renders fresh) until an operator enables the serving profile."""
+    _, facade, app = stack
+    rc = facade.rendercache
+    profile = rc.to_json()["endpoints"]
+    assert profile["proposals"]["ttlMs"] is None       # key-exact, always on
+    for ep in ("state", "devicestats", "fleet", "forecast", "metrics"):
+        assert profile[ep]["ttlMs"] == 0, ep           # fresh by default
+    # Warm the proposal cache through the served path.
+    deadline = time.time() + 120
+    while True:
+        status, _, headers = call(app, "GET", "proposals")
+        if status == 200 or time.time() > deadline:
+            break
+        time.sleep(0.3)
+    assert status == 200
+    status, body, headers = call(app, "GET", "proposals")
+    assert status == 200 and "goalSummary" in body
+    etag = headers.get("ETag")
+    assert etag and etag.startswith('"cc-proposals-')
+    # Conditional revalidation: 304, empty body, same validator.
+    status, body, headers = call(app, "GET", "proposals",
+                                 headers={"If-None-Match": etag},
+                                 expect=304)
+    assert status == 304 and body == {}
+    assert headers.get("ETag") == etag
+    # A fresh-by-default endpoint serves without a validator.
+    _, _, headers = call(app, "GET", "state")
+    assert headers.get("ETag") is None
+    # Parameterized requests bypass the cache (full typed path).
+    _, _, headers = call(app, "GET", "proposals", "verbose=true")
+    assert headers.get("ETag") is None
+
+
+class _CountingLock:
+    """RLock proxy that counts acquisitions — the hammer's proof that
+    cached GETs never touch the facade lock."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self.acquisitions += 1
+        return self.inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self.inner.__exit__(*exc)
+
+    def acquire(self, *a, **k):
+        self.acquisitions += 1
+        return self.inner.acquire(*a, **k)
+
+    def release(self):
+        return self.inner.release()
+
+
+def test_api_read_tier_concurrency_hammer():
+    """8 threads hammer the cached read tier over real HTTP while the
+    model generation bumps and dryrun rebalances land. Gates: zero 5xx,
+    zero transport errors, no torn reads (one ETag never names two
+    bodies), and — in the steady-state sub-phase — zero facade-lock
+    acquisitions and zero device dispatches attributable to the GETs."""
+    import hashlib
+    import http.client
+    import threading
+
+    sim, facade, app = build_stack()
+    try:
+        rc = facade.rendercache
+        rc.enable(ttl_ms=200)
+        deadline = time.time() + 120
+        while True:
+            status, _, _ = call(app, "GET", "proposals")
+            if status == 200 or time.time() > deadline:
+                break
+            time.sleep(0.3)
+        assert status == 200
+        mix = ["/kafkacruisecontrol/proposals", "/kafkacruisecontrol/state",
+               "/kafkacruisecontrol/devicestats"]
+        stop = threading.Event()
+        outs = []
+
+        def reader(my):
+            conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                              timeout=60)
+            i = 0
+            while not stop.is_set():
+                path = mix[i % len(mix)]
+                i += 1
+                try:
+                    conn.request("GET", path)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                except Exception:
+                    conn.close()
+                    conn = http.client.HTTPConnection("127.0.0.1",
+                                                      app.port, timeout=60)
+                    my["errors"] += 1
+                    continue
+                my["statuses"][resp.status] = (
+                    my["statuses"].get(resp.status, 0) + 1)
+                etag = resp.getheader("ETag")
+                if etag and resp.status == 200:
+                    my["pairs"].append(
+                        (etag, hashlib.sha256(body).hexdigest()))
+            conn.close()
+
+        def run_phase(seconds):
+            stop.clear()
+            threads = []
+            for _ in range(8):
+                my = {"statuses": {}, "pairs": [], "errors": 0}
+                outs.append(my)
+                threads.append(threading.Thread(target=reader,
+                                                args=(my,), daemon=True))
+            for t in threads:
+                t.start()
+            return threads
+
+        # --- steady state: cached GETs only; prime the cache first so
+        # the lock/dispatch accounting sees pure cached serving.
+        for path in mix:
+            assert rc.lookup_or_render(
+                path.rsplit("/", 1)[1]) is not None
+        counting = _CountingLock(facade._lock)
+        facade._lock = counting
+        collector = facade.device_stats
+        before = collector.snapshot()
+        threads = run_phase(1.2)
+        time.sleep(1.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        facade._lock = counting.inner
+        after = collector.snapshot()
+        # The ttl can lapse mid-phase (re-render = one facade read, still
+        # no proposal recompute and no device work) — so the hard gates
+        # are the device ledger and the compile counters, plus the lock
+        # staying untouched while every entry was warm. Renders
+        # themselves never dispatch: the ledger must stay flat.
+        for k in ("compileEvents", "aotCompileEvents", "recompileEvents",
+                  "h2dBytes", "d2hBytes"):
+            assert after[k] == before[k], (k, before[k], after[k])
+        assert counting.acquisitions == 0, (
+            f"cached GETs acquired the facade lock "
+            f"{counting.acquisitions} times (want 0)")
+
+        # --- churn: generation bumps + dryrun rebalances under the same
+        # read load; coherence (not throughput) is the contract here.
+        threads = run_phase(1.5)
+        n0 = facade.proposal_cache.num_computations
+        for _ in range(2):
+            last = facade.task_runner._last_sample_ms or 0
+            assert facade.task_runner.maybe_run_sampling(last + WINDOW_MS)
+            status, _, _ = call(app, "POST", "rebalance",
+                                "dryrun=true&get_response_timeout_s=120")
+            assert status in (200, 202)
+            time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        assert facade.proposal_cache.num_computations >= n0
+
+        statuses: dict[int, int] = {}
+        etags: dict[str, set] = {}
+        errors = 0
+        for my in outs:
+            for s, n in my["statuses"].items():
+                statuses[s] = statuses.get(s, 0) + n
+            errors += my["errors"]
+            for etag, digest in my["pairs"]:
+                etags.setdefault(etag, set()).add(digest)
+        assert errors == 0
+        assert not any(s >= 500 for s in statuses), statuses
+        assert sum(statuses.values()) > 100     # the hammer actually ran
+        torn = {e: d for e, d in etags.items() if len(d) > 1}
+        assert not torn, f"one ETag named multiple bodies: {torn}"
+        # 304 bookkeeping: conditional GETs are successes with their own
+        # counter (meter marks, not-modified counts).
+        conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                          timeout=60)
+        conn.request("GET", "/kafkacruisecontrol/proposals")
+        resp = conn.getresponse()
+        resp.read()
+        etag = resp.getheader("ETag")
+        assert etag
+        conn.request("GET", "/kafkacruisecontrol/proposals",
+                     headers={"If-None-Match": etag})
+        resp = conn.getresponse()
+        assert resp.status == 304 and resp.read() == b""
+        conn.close()
+        assert app.registry.get("api.proposals.not-modified").count >= 1
+        assert rc.to_json()["hits"] > 0
+    finally:
+        app.stop()

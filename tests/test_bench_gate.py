@@ -247,3 +247,29 @@ def test_snapshot_restore_bench_smoke_gate():
     assert out["recompiles"] == 0
     assert out["restore_s"] > 0 and out["cold_s"] > 0
     assert out["snapshot_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_api_throughput_bench_smoke_gate():
+    """run_api_throughput_bench on a toy cluster: exercises the full
+    serving A/B harness end-to-end (baseline render-per-request phase,
+    cache enable, cached phase, conditional-request check, mixed
+    read/write phase) with its always-on gates — zero device dispatches
+    across the cached GET-only phase, ETag-consistent bodies under
+    concurrent generation bumps, zero 5xx, 304s with empty bodies (the
+    helper raises on any breach). The >= 5x throughput gate is judged
+    at bench scale only (gate=False here — toy response bodies make the
+    per-request-render baseline artificially cheap). Marked slow: it
+    compiles a 2-goal chain and runs ~2 s of closed-loop HTTP."""
+    import bench
+    out = bench.run_api_throughput_bench(
+        num_brokers=6, num_partitions=60, threads=4, duration_s=0.4,
+        goal_names=["ReplicaDistributionGoal"],
+        emit_row=False, gate=False)
+    assert out["uncached_rps"] > 0 and out["cached_rps"] > 0
+    assert out["speedup"] is not None and out["speedup"] > 0
+    assert out["cached_p99_ms"] > 0
+    # The dispatch ledger must report a flat line for the cached phase.
+    assert all(v == 0 for v in out["dispatches"].values())
+    rc = out["rendercache"]
+    assert rc["enabled"] and rc["hits"] > 0

@@ -413,3 +413,145 @@ def test_fetcher_and_detector_catalog_sensors(stack):
         assert v in (0, 1), (key, v)
         values.append(v)
     assert sum(values) <= 1
+
+
+# -------------------------------------------------- striped sensors (PR 15)
+# The heavy-traffic read tier moves per-request marks off the sensor
+# locks: per-thread stripes, drained at scrape time. These tests pin the
+# two contracts that make that safe — multi-thread counts never lose a
+# mark, and the scrape surface (families, values) is identical to the
+# unstriped sensors.
+
+
+def test_striped_counter_concurrent_never_loses_increments():
+    import threading
+    from cruise_control_tpu.core.sensors import StripedCounter
+    c = StripedCounter()
+    threads, per = 8, 20_000
+
+    def worker():
+        for _ in range(per):
+            c.inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.count == threads * per
+    assert c.to_json() == {"type": "counter", "count": threads * per}
+
+
+def test_striped_meter_and_timer_concurrent_drain():
+    import threading
+    from cruise_control_tpu.core.sensors import StripedMeter, StripedTimer
+    clock = [0.0]
+    m = StripedMeter(window_s=10.0, now=lambda: clock[0])
+    timer = StripedTimer()
+
+    def worker():
+        for _ in range(1_000):
+            m.mark()
+            timer.update(0.002)
+
+    ts = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # Reads drain every stripe: nothing in flight is lost.
+    assert m.count == 8_000
+    assert m.rate() == pytest.approx(800.0)     # 8000 events / 10 s window
+    clock[0] = 20.0                              # burst ages out
+    assert m.rate() == pytest.approx(0.0)
+    assert m.count == 8_000                      # lifetime count survives
+    assert timer.count == 8_000
+    assert timer.mean_s == pytest.approx(0.002)
+    # Interleaved mark-while-scraping: a reader mid-drain never tears.
+    m.mark(5)
+    assert m.count == 8_005
+
+
+def test_striped_sensors_render_identical_families():
+    """Striping changes the write path only: a registry holding striped
+    sensors renders byte-identical Prometheus text to one holding the
+    plain variants fed the same updates."""
+    from cruise_control_tpu.core.sensors import MetricRegistry
+    clock = [5.0]
+    plain, striped = MetricRegistry(), MetricRegistry()
+    plain.counter("Api.hits").inc(3)
+    striped.striped_counter("Api.hits").inc(3)
+    plain.meter("Api.req-rate", window_s=10.0, now=lambda: clock[0]).mark(4)
+    striped.striped_meter("Api.req-rate", window_s=10.0,
+                          now=lambda: clock[0]).mark(4)
+    for ms in (1, 2, 3):
+        plain.timer("Api.latency").update(ms / 1000.0)
+        striped.striped_timer("Api.latency").update(ms / 1000.0)
+    assert plain.expose_text() == striped.expose_text()
+
+
+def test_expose_text_structure_cache_keeps_values_live():
+    """The exposition render cache keys on the mutation counter: value
+    changes re-render live numbers from the cached structure; only a
+    structural change (new sensor, replaced gauge) rebuilds it."""
+    reg = MetricRegistry()
+    c = reg.counter("G.c")
+    muts = reg.mutation_count
+    text1 = reg.expose_text()
+    assert "cc_G_c_total 0" in text1
+    c.inc(7)
+    text2 = reg.expose_text()
+    assert "cc_G_c_total 7" in text2            # value is live...
+    assert reg.mutation_count == muts           # ...with no rebuild
+    reg.gauge("G.g", lambda: 42)
+    assert reg.mutation_count > muts
+    assert "cc_G_g 42" in reg.expose_text()
+
+
+def test_merged_fleet_scrape_striped_flush_no_duplicate_families():
+    """Satellite gate (PR 15): a merged fleet-style scrape over
+    registries holding striped sensors lints clean — the stripe flush
+    must never surface a sensor under two families."""
+    import threading
+    from prom_lint import lint_prometheus_exposition
+    from cruise_control_tpu.core.sensors import (CompositeRegistry,
+                                                 MetricRegistry)
+    a, b = MetricRegistry(), MetricRegistry()
+    # Same dotted names on both sides of the merge (the fleet scrape
+    # merges per-cluster registries that register identical families).
+    for reg in (a, b):
+        reg.striped_counter("api.state.not-modified").inc(2)
+        reg.striped_meter("KafkaCruiseControlServlet.state-request-rate")
+        reg.striped_timer("KafkaCruiseControlServlet.state-request-timer")
+        reg.counter("Snapshot.writes").inc()
+
+    # Flush from many threads while one thread scrapes repeatedly. The
+    # writers pace themselves (the drain loop must be able to win) but
+    # every scrape still races live stripe appends.
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            for _ in range(200):
+                a.get("api.state.not-modified").inc()
+                b.get("KafkaCruiseControlServlet.state-request-rate").mark()
+                a.get("KafkaCruiseControlServlet.state-request-timer").update(
+                    0.001)
+            stop.wait(0.002)
+
+    workers = [threading.Thread(target=hammer) for _ in range(4)]
+    for w in workers:
+        w.start()
+    composite = CompositeRegistry(lambda: [a, b])
+    try:
+        for _ in range(20):
+            text = composite.expose_text()
+            lint_prometheus_exposition(
+                text,
+                expect_families=("cc_api_state_not_modified_total",
+                                 "cc_Snapshot_writes_total"),
+                forbid_unlabeled_duplicates=True)
+    finally:
+        stop.set()
+        for w in workers:
+            w.join()

@@ -497,3 +497,86 @@ def test_cluster_mismatch_refused(optimizer, tmp_path):
     fb = h2.facade.snapshotter.to_json()["restoreFallbacks"]
     assert fb["cluster-mismatch"] == 1
     assert h2.facade.proposal_cache.export_state() is None
+
+
+# ------------------------------------------------- standby read tier
+# (PR 15: interval/4 freshness polling, in-process write fan-out and
+# the staleness gauge the serving-tier docs point operators at.)
+
+
+def test_standby_poll_throttle_and_peer_write_bypass(tmp_path):
+    """The standby freshness poll runs at interval/4, and a same-path
+    leader write bypasses the throttle so an in-process standby restores
+    on its very next ha_tick instead of waiting the window out."""
+    path = str(tmp_path / "s.snap")
+    standby = SnapshotManager(path, interval_ms=10_000)
+    assert standby.standby_poll_interval_ms == 2_500
+    assert standby.to_json()["standbyPollIntervalMs"] == 2_500
+    assert standby.standby_should_poll(0)
+    assert not standby.standby_should_poll(1_000)     # inside the window
+    assert not standby.standby_should_poll(2_499)
+    assert standby.standby_should_poll(2_500)
+    # A same-path leader write wakes the standby immediately...
+    leader = SnapshotManager(path, interval_ms=10_000)
+    assert leader.write(3_000, _payload()) is not None
+    assert standby.standby_should_poll(3_001)
+    # ...exactly once: the bypass re-arms the throttle.
+    assert not standby.standby_should_poll(3_002)
+    # A write on a DIFFERENT path must not wake this standby.
+    other = SnapshotManager(str(tmp_path / "other.snap"),
+                            interval_ms=10_000)
+    assert other.write(6_000, _payload()) is not None
+    assert not standby.standby_should_poll(5_000)
+    # The writer itself never self-notifies (a leader must not treat its
+    # own snapshot as news).
+    assert leader.write(20_000, _payload()) is not None
+    assert not leader._peer_wrote
+
+
+def test_on_write_hooks_fire_and_survive_exceptions(tmp_path):
+    """``on_write`` subscribers get (now_ms, nbytes); a raising hook is
+    logged, not fatal — later hooks still run and the write counts."""
+    mgr = SnapshotManager(str(tmp_path / "s.snap"))
+    seen = []
+
+    def bad(now_ms, n):
+        raise RuntimeError("boom")
+
+    mgr.on_write.append(bad)
+    mgr.on_write.append(lambda now_ms, n: seen.append((now_ms, n)))
+    n = mgr.write(1_234, _payload())
+    assert n is not None
+    assert seen == [(1_234, n)]
+    assert mgr.to_json()["writes"] == 1
+
+
+def test_standby_staleness_gauge(tmp_path):
+    """Restoring records how far behind the leader the snapshot was
+    (restore-time now_ms minus the header's createdMs) and exposes it
+    both as the Snapshot.standby-staleness-ms gauge and in to_json."""
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, _payload(), now_ms=1_000)
+    mgr = SnapshotManager(path)
+    assert mgr.to_json()["standbyStalenessMs"] is None
+    assert mgr.restore(4_500) == _payload()
+    assert mgr.to_json()["standbyStalenessMs"] == 3_500
+    assert mgr.registry.get("Snapshot.standby-staleness-ms").value() == 3_500
+
+
+def test_newer_snapshot_available_mtime_memo(tmp_path):
+    """The stat()-only fast path memoizes per (mtime, size, floor): an
+    unchanged file answers without re-reading the header, and a restore
+    (floor move) self-invalidates the memo without any explicit hook."""
+    path = str(tmp_path / "s.snap")
+    write_snapshot(path, _payload(), now_ms=2_000)
+    # Age the mtime past the racy-clean guard so the memo engages.
+    os.utime(path, (0, 0))
+    mgr = SnapshotManager(path)
+    assert mgr.newer_snapshot_available()
+    assert mgr._poll_cache is not None                # memo populated
+    memo = mgr._poll_cache
+    assert mgr.newer_snapshot_available()            # answered from memo
+    assert mgr._poll_cache is memo
+    # Restoring moves the floor -> key mismatch -> fresh header read.
+    assert mgr.restore(3_000) == _payload()
+    assert not mgr.newer_snapshot_available()
