@@ -1457,6 +1457,365 @@ def run_api_throughput_bench(num_brokers: int = 50,
         app.stop()
 
 
+def _fanout_topology(num_brokers: int, num_partitions: int):
+    """Deterministic topology shared by the scenario-10 leader and every
+    replica process: a replica restores the leader's snapshot into an
+    identically-shaped stack (same broker/partition layout, same monitor
+    window geometry), so snapshot + delta frames apply cleanly."""
+    from cruise_control_tpu.executor import SimulatedKafkaCluster
+    from cruise_control_tpu.monitor import LoadMonitor, MonitorConfig
+
+    num_topics = max(num_partitions // 100, 1)
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b)
+    for p in range(num_partitions):
+        pool = max(num_brokers // 5, 2) if p % 2 == 0 else num_brokers
+        sim.add_partition(f"t{p % num_topics}", p,
+                          [p % pool, (p + 1) % pool],
+                          size_mb=50.0 + (p % 100))
+    monitor = LoadMonitor(sim, MonitorConfig(
+        num_windows=4, window_ms=1000, min_samples_per_window=1))
+    return sim, monitor
+
+
+def _fanout_replica_main(node_id, leader_port, snap_path, num_brokers,
+                         num_partitions, max_staleness_ms, ready_q,
+                         stop_ev):
+    """Scenario-10 replica process: bootstrap from the leader's snapshot,
+    follow the delta stream over HTTP (``session.tick(now, "standby")``
+    on a driver thread — this process has no elector, so ``ha_tick``
+    would wrongly treat it as a leader), and serve the render-cache GET
+    surface on its own port. Reports (port, state) once STREAMING."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from cruise_control_tpu.api.facade import KafkaCruiseControl
+        from cruise_control_tpu.api.server import CruiseControlApp
+        from cruise_control_tpu.core.replication import HttpReplicationClient
+        from cruise_control_tpu.core.snapshot import SnapshotManager
+
+        sim, monitor = _fanout_topology(num_brokers, num_partitions)
+        facade = KafkaCruiseControl(sim, monitor)
+        facade.attach_snapshotter(SnapshotManager(snap_path))
+        session = facade.attach_replication_channel(
+            HttpReplicationClient("127.0.0.1", leader_port, timeout_s=10),
+            node_id=node_id, max_staleness_ms=max_staleness_ms)
+        app = CruiseControlApp(facade, port=0, max_active_tasks=1024)
+        app.start()
+        facade.rendercache.enable(ttl_ms=250)
+        stop = threading.Event()
+
+        def follow():
+            while not stop.is_set():
+                try:
+                    session.tick(int(time.time() * 1000), "standby")
+                except Exception:
+                    pass
+                stop.wait(0.05)
+
+        t = threading.Thread(target=follow, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and session.state != "STREAMING":
+            time.sleep(0.05)
+        ready_q.put(("ready", node_id, app.port, session.state))
+        stop_ev.wait(600)
+        stop.set()
+        t.join(timeout=5)
+        app.stop()
+    except Exception:
+        import traceback
+        ready_q.put(("error", node_id, traceback.format_exc(), None))
+
+
+def _fanout_client_main(port, threads, warmup_s, duration_s, out_q):
+    """Scenario-10 load generator: one PROCESS per target node (client
+    work in the serving process would contend on its GIL and flatten the
+    fan-out signal), ``threads`` keep-alive readers inside. Counts only
+    the post-warmup window; any 5xx — including a bounded-staleness 503,
+    which a healthy streaming replica must never answer — fails the run
+    in the parent."""
+    import http.client
+
+    mix = ["/kafkacruisecontrol/proposals", "/kafkacruisecontrol/state",
+           "/kafkacruisecontrol/load"]
+    outs = []
+
+    def reader(my):
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        t_count = time.monotonic() + warmup_s
+        t_end = t_count + duration_s
+        i = 0
+        while time.monotonic() < t_end:
+            path = mix[i % len(mix)]
+            i += 1
+            counting = time.monotonic() >= t_count
+            try:
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                resp.read()
+            except Exception:
+                conn.close()
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                if counting:
+                    my["transport_errors"] += 1
+                continue
+            if counting:
+                my["statuses"][resp.status] = (
+                    my["statuses"].get(resp.status, 0) + 1)
+        conn.close()
+
+    ts = []
+    for _ in range(threads):
+        my = {"statuses": {}, "transport_errors": 0}
+        outs.append(my)
+        ts.append(threading.Thread(target=reader, args=(my,), daemon=True))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=warmup_s + duration_s + 120)
+    statuses: dict = {}
+    transport_errors = 0
+    for my in outs:
+        for s, n in my["statuses"].items():
+            statuses[s] = statuses.get(s, 0) + n
+        transport_errors += my["transport_errors"]
+    out_q.put({"port": port, "statuses": statuses,
+               "transport_errors": transport_errors})
+
+
+def run_replica_fanout_bench(num_brokers: int = 50,
+                             num_partitions: int = 5_000, *,
+                             replicas: int = 2, threads: int = 6,
+                             duration_s: float = 4.0,
+                             max_staleness_ms: int = 10_000,
+                             goal_names: list | None = None,
+                             emit_row: bool = True, gate: bool = True
+                             ) -> dict:
+    """Replicated serving plane (scenario 10): one leader process
+    (this one) streaming snapshot deltas to ``replicas`` standby
+    PROCESSES that serve the render-cache GET surface, vs the same
+    aggregate client load pointed at the leader alone.
+
+    Phases (client load always runs from ``1 + replicas`` separate
+    processes so client-side GIL contention is identical in both):
+
+    - **leader-only baseline** — every client process hammers the
+      leader's port while the stream keeps flowing in the background.
+    - **fan-out** — one client process per node (leader + replicas).
+
+    Reported: ``replica_fanout_api_requests_per_s`` — aggregate fan-out
+    req/s; vs_baseline = fan-out / leader-only. **Gated >= 1.8x at
+    2 replicas, bench scale** (toy smokes pass gate=False). The gate
+    additionally needs real parallel serving capacity — at least
+    ``2 * (1 + replicas)`` host cores (one per serving node, one per
+    client process); on a smaller host every process timeshares the
+    same cores, fan-out measures scheduler overhead instead of scaling,
+    and the gate is WAIVED with a loud log (the row still emits).
+
+    Always asserted, every scale: zero 5xx and zero transport errors in
+    every counted window — a bounded-staleness 503 is a 5xx, so this
+    doubles as the staleness gate under load — plus, read off each
+    replica's ``/devicestats`` AFTER the fan-out phase: state STREAMING,
+    ``framesApplied > 0`` (the stream genuinely fed it), and
+    ``streamLagMs <= maxStalenessMs``."""
+    import http.client
+    import multiprocessing
+    import os
+    import tempfile
+
+    from cruise_control_tpu.api.facade import KafkaCruiseControl
+    from cruise_control_tpu.api.server import CruiseControlApp
+    from cruise_control_tpu.analyzer import (SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.core.metricdef import partition_metric_def
+    from cruise_control_tpu.core.replication import ReplicationChannel
+    from cruise_control_tpu.core.snapshot import SnapshotManager
+
+    cores = os.cpu_count() or 1
+    need = 2 * (1 + replicas)
+    if gate and cores < need:
+        log(f"replica fanout gate WAIVED: host has {cores} CPU cores < "
+            f"{need} (one per serving node + one per client process). "
+            "Every process timeshares the same cores, so fan-out would "
+            "measure scheduler overhead, not serving capacity — the "
+            ">= 1.8x gate is judged on the bench host.")
+        gate = False
+
+    window_ms = 1000
+    sim, monitor = _fanout_topology(num_brokers, num_partitions)
+    mdef = partition_metric_def()
+    keys = sorted(sim.describe_partitions())
+    P = len(keys)
+    vals = ((np.arange(P * mdef.size(), dtype=np.float64)
+             .reshape(P, mdef.size()) % 97) + 1.0)
+
+    def ingest(t_ms):
+        times = np.full(P, int(t_ms), np.int64)
+        monitor.partition_aggregator.add_samples_dense(keys, times, vals)
+
+    now = int(time.time() * 1000)
+    for w in range(5, 0, -1):           # fill the window history to now
+        ingest(now - w * window_ms + 100)
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names or GOALS[:2]),
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=256))
+    facade = KafkaCruiseControl(sim, monitor, optimizer=opt)
+    tmp = tempfile.mkdtemp(prefix="fanout_bench_")
+    snap_path = os.path.join(tmp, "serving.snap")
+    facade.attach_snapshotter(SnapshotManager(snap_path, interval_ms=500))
+    facade.attach_replication_channel(
+        ReplicationChannel(capacity=512), node_id="leader",
+        max_staleness_ms=max_staleness_ms)
+    app = CruiseControlApp(facade, port=0, max_active_tasks=1024)
+    app.start()
+    ctx = multiprocessing.get_context("spawn")
+    stop_ev = ctx.Event()
+    stop_driver = threading.Event()
+    procs = []
+    try:
+        facade.proposals()              # published entry rides the snapshot
+        facade.rendercache.enable(ttl_ms=250)
+        facade.ha_tick(int(time.time() * 1000))   # first snapshot + frame
+
+        def driver():
+            # The write plane under the read tier: fresh sample windows
+            # land, ha_tick publishes delta frames and the cadenced
+            # snapshot — replicas must stay within the staleness bound
+            # WHILE the stream moves, not on a frozen leader.
+            while not stop_driver.is_set():
+                ingest(int(time.time() * 1000))
+                facade.ha_tick(int(time.time() * 1000))
+                stop_driver.wait(0.25)
+
+        drv = threading.Thread(target=driver, daemon=True)
+        drv.start()
+
+        ready_q = ctx.Queue()
+        for i in range(replicas):
+            p = ctx.Process(target=_fanout_replica_main,
+                            args=(f"replica-{i}", app.port, snap_path,
+                                  num_brokers, num_partitions,
+                                  max_staleness_ms, ready_q, stop_ev),
+                            daemon=True)
+            p.start()
+            procs.append(p)
+        replica_ports = []
+        for _ in range(replicas):
+            kind, node, port, state = ready_q.get(timeout=180)
+            if kind != "ready":
+                raise RuntimeError(f"replica {node} died during "
+                                   f"bootstrap:\n{port}")
+            if state != "STREAMING":
+                raise RuntimeError(f"replica {node} never reached "
+                                   f"STREAMING (stuck in {state})")
+            replica_ports.append(port)
+        log(f"fanout bench: {replicas} replicas streaming on ports "
+            f"{replica_ports} (leader {app.port})")
+
+        def drive(label, targets):
+            """One client process per target; returns aggregate req/s
+            over the counted windows. Gates zero 5xx / transport errors."""
+            out_q = ctx.Queue()
+            cs = [ctx.Process(target=_fanout_client_main,
+                              args=(port, threads, 0.5, duration_s, out_q),
+                              daemon=True)
+                  for port in targets]
+            for c in cs:
+                c.start()
+            results = [out_q.get(timeout=duration_s + 300)
+                       for _ in cs]
+            for c in cs:
+                c.join(timeout=60)
+            statuses: dict = {}
+            transport_errors = 0
+            for r in results:
+                for s, n in r["statuses"].items():
+                    statuses[s] = statuses.get(s, 0) + n
+                transport_errors += r["transport_errors"]
+            bad = {s: n for s, n in statuses.items() if s >= 500}
+            if bad or transport_errors:
+                raise RuntimeError(
+                    f"replica fanout bench ({label}): {bad or ''} 5xx "
+                    f"responses / {transport_errors} transport errors "
+                    "(want zero — a bounded-staleness 503 under load "
+                    "is a contract breach on a streaming replica)")
+            completed = sum(statuses.values())
+            rps = completed / duration_s
+            log(f"fanout bench phase {label}: {completed} requests "
+                f"({rps:.0f} req/s aggregate), statuses {statuses}")
+            return rps, statuses
+
+        # --- phase L: every client process on the leader alone.
+        leader_targets = [app.port] * (1 + replicas)
+        base_rps, _ = drive("leader-only", leader_targets)
+        # --- phase F: one client process per serving node.
+        fanout_rps, statuses = drive("fan-out", [app.port] + replica_ports)
+
+        # The staleness readout, AFTER the measured window: each replica
+        # must still be streaming, genuinely delta-fed, within bound.
+        replication = []
+        for port in replica_ports:
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=60)
+            conn.request("GET", "/kafkacruisecontrol/devicestats")
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"replica :{port} /devicestats: {resp.status}")
+            rep = body["replication"]
+            replication.append(rep)
+            if rep["state"] != "STREAMING":
+                raise RuntimeError(
+                    f"replica :{port} left the stream during the bench: "
+                    f"{rep['state']}")
+            if not rep["framesApplied"]:
+                raise RuntimeError(
+                    f"replica :{port} applied zero delta frames — it "
+                    "served from the bootstrap snapshot alone")
+            if rep["streamLagMs"] is None \
+                    or rep["streamLagMs"] > rep["maxStalenessMs"]:
+                raise RuntimeError(
+                    f"replica :{port} beyond the staleness bound after "
+                    f"the measured window: lag {rep['streamLagMs']} ms "
+                    f"> {rep['maxStalenessMs']} ms")
+
+        speedup = fanout_rps / base_rps if base_rps else None
+        lag_ms = max(r["streamLagMs"] for r in replication)
+        log(f"replica fanout ({num_brokers}x{num_partitions}, "
+            f"{replicas} replicas, {threads} threads/client): "
+            f"{fanout_rps:.0f} req/s aggregate vs {base_rps:.0f} req/s "
+            f"leader-only ({speedup:.2f}x); max stream lag {lag_ms} ms "
+            f"(bound {max_staleness_ms} ms)")
+        if gate and (speedup is None or speedup < 1.8):
+            raise RuntimeError(
+                f"replica fanout gate: {replicas} replicas scaled the "
+                f"aggregate read tier only {speedup:.2f}x over the "
+                "leader alone (want >= 1.8x at 2 replicas)")
+        if emit_row:
+            emit("replica_fanout_api_requests_per_s", round(fanout_rps, 1),
+                 "req/s", round(speedup, 2) if speedup else None)
+            emit("replica_fanout_stream_lag_ms", lag_ms, "ms", None)
+        return {"leader_only_rps": base_rps, "fanout_rps": fanout_rps,
+                "speedup": speedup, "replicas": replicas,
+                "statuses": statuses, "max_stream_lag_ms": lag_ms,
+                "replication": replication}
+    finally:
+        stop_driver.set()
+        stop_ev.set()
+        for p in procs:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+        app.stop()
+
+
 def build_spec(num_brokers: int = NUM_BROKERS,
                num_partitions: int = NUM_PARTITIONS):
     from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
@@ -1980,7 +2339,7 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
@@ -1989,7 +2348,9 @@ def main():
                          "search vs fixed-schedule sequential, 100x20K, "
                          "8 = forecast fit + [C, S] fleet trajectory "
                          "sweep, 4 clusters x 100x20K, 9 = heavy-traffic "
-                         "API read tier, cached vs per-request render)")
+                         "API read tier, cached vs per-request render, "
+                         "10 = replicated serving plane, 2 streaming "
+                         "read replicas vs the leader alone)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -2057,6 +2418,12 @@ def main():
                 log("--mesh is ignored for scenario 9: the read tier "
                     "serves published bytes (no device work at all)")
             run_api_throughput_bench()
+        elif args.scenario == 10:
+            if args.mesh:
+                log("--mesh is ignored for scenario 10: the replicated "
+                    "read tier is host-side HTTP serving (replica "
+                    "processes pin themselves to CPU)")
+            run_replica_fanout_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

@@ -1,0 +1,129 @@
+"""Write-path admission control: per-principal token-bucket rate limits.
+
+The serving plane's POST surface used to take every request straight
+into the task queues — under a flood the only backpressure was the
+active-task cap, shared across every caller, so one noisy principal
+could starve the rest and the operator had no per-source throttle at
+all. :class:`AdmissionController` sits between ``check_access`` (which
+resolves the :class:`~cruise_control_tpu.api.security.Principal`) and
+dispatch: every POST draws one token from its principal's bucket, and an
+empty bucket answers **429 + ``Retry-After``** (the seconds until the
+next token — shedding is an instruction to back off, never a 5xx).
+
+Buckets refill continuously at ``rate_per_s`` up to ``burst``; the
+bucket map is LRU-bounded (``max_principals``) so an attacker minting
+principal names cannot grow host memory. Everything is metered under the
+``Admission.*`` sensor group — throttle rate, admitted count, live
+principal count — so a shedding tier is visible on ``/metrics`` before
+users notice.
+
+Read paths (GET) are never admission-gated: reads scale through the
+render cache and the replica tier (core/replication.py), writes through
+this throttle + the bounded task queues (api/tasks.py).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time as _time
+from collections import OrderedDict
+
+#: sensor group for the admission series (``Admission.*``).
+ADMISSION_SENSOR = "Admission"
+
+
+class AdmissionLimitError(Exception):
+    """A principal's token bucket is empty: the server maps this to
+    429 with ``Retry-After: retry_after_s``."""
+
+    def __init__(self, message: str, *, retry_after_s: int,
+                 principal: str) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(1, int(retry_after_s))
+        self.principal = principal
+
+
+class _Bucket:
+    """One principal's continuously-refilling token bucket."""
+
+    __slots__ = ("tokens", "stamp_ms")
+
+    def __init__(self, burst: float, now_ms: int) -> None:
+        self.tokens = float(burst)
+        self.stamp_ms = int(now_ms)
+
+    def take(self, now_ms: int, rate_per_s: float,
+             burst: float) -> float:
+        """Draw one token. Returns 0.0 on admission, else the seconds
+        until a token will be available."""
+        elapsed_s = max(0, now_ms - self.stamp_ms) / 1000.0
+        self.tokens = min(burst, self.tokens + elapsed_s * rate_per_s)
+        self.stamp_ms = int(now_ms)
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / rate_per_s
+
+
+class AdmissionController:
+    """Per-principal write admission for one serving process.
+
+    Thread-safe; shared by every server thread. ``now_ms`` is injectable
+    for deterministic tests (defaults to wall clock)."""
+
+    def __init__(self, *, rate_per_s: float = 5.0, burst: int = 10,
+                 max_principals: int = 1024, now_ms=None,
+                 registry=None) -> None:
+        from ..core.sensors import MetricRegistry
+        if rate_per_s <= 0:
+            raise ValueError("admission rate must be positive")
+        self.rate_per_s = float(rate_per_s)
+        self.burst = float(max(1, burst))
+        self.max_principals = int(max_principals)
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        self._lock = threading.Lock()
+        #: principal name -> bucket, LRU-evicted at max_principals
+        self._buckets: OrderedDict[str, _Bucket] = OrderedDict()
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        g = ADMISSION_SENSOR
+        self._admitted = self.registry.counter(name(g, "admitted"))
+        self._throttled = self.registry.meter(name(g, "throttled-rate"))
+        self.registry.gauge(name(g, "principals"),
+                            lambda: len(self._buckets))
+
+    def admit(self, principal: str, now_ms: int | None = None) -> None:
+        """Draw one token for ``principal`` or raise
+        :class:`AdmissionLimitError` with the back-off hint. One bucket
+        per principal: a flooding caller exhausts only its own budget —
+        everyone else's tokens are untouched."""
+        now = int(now_ms if now_ms is not None else self._now_ms())
+        with self._lock:
+            bucket = self._buckets.get(principal)
+            if bucket is None:
+                bucket = _Bucket(self.burst, now)
+                self._buckets[principal] = bucket
+                while len(self._buckets) > self.max_principals:
+                    self._buckets.popitem(last=False)
+            else:
+                self._buckets.move_to_end(principal)
+            wait_s = bucket.take(now, self.rate_per_s, self.burst)
+        if wait_s > 0:
+            self._throttled.mark()
+            raise AdmissionLimitError(
+                f"principal {principal!r} exceeded the write admission "
+                f"rate ({self.rate_per_s:g}/s, burst {self.burst:g})",
+                retry_after_s=math.ceil(wait_s), principal=principal)
+        self._admitted.inc()
+
+    def to_json(self) -> dict:
+        with self._lock:
+            principals = len(self._buckets)
+        return {
+            "ratePerS": self.rate_per_s,
+            "burst": self.burst,
+            "principals": principals,
+            "admitted": self._admitted.count,
+            "throttled": self._throttled.count,
+        }

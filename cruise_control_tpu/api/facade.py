@@ -185,6 +185,14 @@ class KafkaCruiseControl:
         #: :meth:`attach_elector`. None = single-process mode: this
         #: process is unconditionally the leader.
         self.elector = None
+        #: snapshot-delta streaming session (core/replication.py) — wire
+        #: via :meth:`attach_replication_channel`. None = the standby
+        #: refreshes by snapshot mtime-poll (the pre-streaming path).
+        self.replication = None
+        #: proposal-cache (generation, seq) last shipped on the stream —
+        #: the publisher's dedup key, so the full cached result is only
+        #: re-serialized when the entry actually moved.
+        self._streamed_proposals_key = None
 
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
@@ -241,14 +249,25 @@ class KafkaCruiseControl:
                     resident.epoch if resident is not None else -1,
                     self.registry.mutation_count)
 
-        def proposals_key() -> tuple:
+        def serving_entry():
+            """Generation-valid entry — or, on a replication follower,
+            the newest replicated entry: its age is policed by the
+            bounded-staleness read gate, not by generation strictness
+            (the follower's generation rides the stream ahead of the
+            leader's last proposal export)."""
             e = self.proposal_cache.fast_entry()
+            if e is None and self._follower_serving():
+                e = self.proposal_cache.latest_entry()
+            return e
+
+        def proposals_key() -> tuple:
+            e = serving_entry()
             if e is None:
                 raise Uncacheable("proposal cache cold or stale")
             return (e.generation, e.seq)
 
         def proposals_payload() -> dict:
-            e = self.proposal_cache.fast_entry()
+            e = serving_entry()
             if e is None:
                 raise Uncacheable("proposal cache cold or stale")
             # The servlet response shape (server.py builds the same dict
@@ -442,6 +461,164 @@ class KafkaCruiseControl:
         self.executor.fence = elector
         self.extra_registries.append(elector.registry)
 
+    def attach_replication_channel(self, channel, *, node_id: str,
+                                   max_staleness_ms: int = 5_000,
+                                   poll_wait_ms: int = 0,
+                                   ledger: list | None = None):
+        """Wire snapshot-delta streaming over ``channel`` (a
+        :class:`~cruise_control_tpu.core.replication.ReplicationChannel`
+        or an :class:`~cruise_control_tpu.core.replication.
+        HttpReplicationClient`): ``ha_tick`` publishes frames when
+        leading and follows the stream when standing by (replacing the
+        snapshot mtime-poll), replica reads gate on the bounded-
+        staleness contract (:meth:`read_refusal`), and the
+        ``Replication.*`` sensors join the scrape view. Returns the
+        session."""
+        from ..core.replication import ReplicationSession
+        resident = getattr(self.monitor, "resident", None)
+        if resident is not None:
+            resident.enable_delta_capture()
+        # Follower serving path: with no local sample flow, model reads
+        # serve the stream-fed resident state (stale-flagged — the
+        # execution gate still refuses to act on it).
+        if hasattr(self.monitor, "serve_from_resident"):
+            self.monitor.serve_from_resident = True
+        session = ReplicationSession(
+            node_id=node_id, channel=channel,
+            clocks=self._replication_clocks,
+            build_frame=self._build_replication_frame,
+            fencing_epoch=lambda: (self.elector.epoch
+                                   if self.elector is not None else 0),
+            apply_frame=self._apply_replication_frame,
+            resync=self._replication_resync,
+            on_fence=(self.elector.observe_epoch_floor
+                      if self.elector is not None else None),
+            max_staleness_ms=max_staleness_ms,
+            poll_wait_ms=poll_wait_ms, ledger=ledger,
+            now_ms=self._now_ms)
+        self.replication = session
+        self.extra_registries.append(session.registry)
+        if getattr(channel, "registry", None) is not None \
+                and channel.registry is not session.registry:
+            self.extra_registries.append(channel.registry)
+        return session
+
+    def _replication_clocks(self) -> dict:
+        """The logical-clock tuple the stream is keyed on — exactly the
+        counters the render cache keys already derive from, so a replica
+        that applied a frame serves byte-identical cached GETs."""
+        resident = getattr(self.monitor, "resident", None)
+        entry = self.proposal_cache.fast_entry()
+        return {
+            "generation": self.monitor.generation,
+            "residentEpoch": (resident.epoch
+                              if resident is not None else -1),
+            "residentIngest": (resident.ingest_seq
+                               if resident is not None else -1),
+            "mutationCount": self.registry.mutation_count,
+            "proposalSeq": (entry.seq if entry is not None else None),
+        }
+
+    def _build_replication_frame(self) -> dict | None:
+        """Leader-side frame body: the resident delta entries captured
+        since the last publish, the proposal-cache export when its entry
+        moved, and the monitor generation. ``None`` when there is
+        genuinely nothing to say."""
+        resident = getattr(self.monitor, "resident", None)
+        body = None
+        if resident is not None:
+            entries, overflow = resident.drain_deltas()
+            if overflow:
+                # Capture overflow lost deltas: ship a structural marker
+                # so followers resync instead of silently diverging.
+                entries = [{"structural": True,
+                            "ingest": resident.ingest_seq,
+                            "epoch": resident.epoch}]
+            if entries:
+                body = {"entries": entries, "epoch": resident.epoch,
+                        "ingest": resident.ingest_seq}
+        proposals = None
+        entry = self.proposal_cache.fast_entry()
+        key = ((entry.generation, entry.seq)
+               if entry is not None else None)
+        if key is not None and key != self._streamed_proposals_key:
+            proposals = self.proposal_cache.export_state()
+            self._streamed_proposals_key = key
+        # Clock-only movement (generation bump, registry shape) still
+        # publishes: followers key their render caches off the counters.
+        return {
+            "clusterId": self.cluster_id,
+            "generation": self.monitor.generation,
+            "resident": body,
+            "proposalCache": proposals,
+        }
+
+    def _apply_replication_frame(self, frame: dict) -> str:
+        """Follower-side domain apply. Gap-safe by construction: a
+        delta entry that is not contiguously applicable (structural
+        marker, epoch bump, ingest mismatch) answers ``"resync"`` and
+        the session falls back to the full snapshot."""
+        if frame.get("clusterId") not in (None, self.cluster_id):
+            return "skipped"      # another cluster's stream — never apply
+        applied = False
+        resident = getattr(self.monitor, "resident", None)
+        body = frame.get("resident")
+        if body is not None and resident is not None:
+            for entry in body.get("entries", ()):
+                if int(entry.get("ingest", 0)) <= resident.ingest_seq:
+                    continue      # already covered by the snapshot
+                if not resident.apply_delta(entry):
+                    return "resync"
+                applied = True
+        generation = frame.get("generation")
+        if generation is not None \
+                and generation > self.monitor.generation:
+            self.monitor.seed_generation(generation)
+            applied = True
+        proposals = frame.get("proposalCache")
+        if proposals is not None:
+            self.proposal_cache.restore_state(proposals)
+            applied = True
+        return "applied" if applied else "skipped"
+
+    def _replication_resync(self) -> int | None:
+        """Full-snapshot bootstrap/resync for the stream follower.
+        Returns the leader-clock ms the restored state is fresh as of,
+        or None when no newer snapshot was restorable (the session stays
+        in SYNCING/RESYNC and retries next tick)."""
+        if self.snapshotter is None:
+            return None
+        now = self._now_ms()
+        if not self.snapshotter.newer_snapshot_available():
+            return None
+        if not self.restore_from_snapshot(now):
+            return None
+        staleness = self.snapshotter._last_staleness_ms or 0
+        return now - staleness
+
+    def _follower_serving(self) -> bool:
+        """True when this process serves reads FROM the stream (a
+        replication follower): cached proposals serve by newest
+        replicated entry instead of recomputing, and model reads fall
+        back to the resident state when local sample history is short
+        (monitor._serve_resident)."""
+        return (self.replication is not None
+                and self.replication.role != "leader")
+
+    def read_refusal(self) -> dict | None:
+        """The replica read gate: ``None`` when this process may serve
+        GETs (always, without replication wired — the pre-streaming
+        standby contract is unchanged), else the bounded-staleness
+        refusal descriptor (server.py maps it to 503 + ``Retry-After``
+        with the leader's identity in the JSON body)."""
+        if self.replication is None:
+            return None
+        refusal = self.replication.read_refusal(self._now_ms())
+        if refusal is not None:
+            refusal["leaderId"] = (self.elector.leader_id()
+                                   if self.elector is not None else None)
+        return refusal
+
     def ha_role(self) -> str:
         """``leader`` (single-process mode included) or ``standby``."""
         if self.elector is None:
@@ -478,7 +655,8 @@ class KafkaCruiseControl:
             "clusterId": self.cluster_id,
             "generation": self.monitor.generation,
             "resident": ({"epoch": resident_state[0],
-                          "arrays": resident_state[1]}
+                          "arrays": resident_state[1],
+                          "ingestSeq": resident.ingest_seq}
                          if resident_state is not None else None),
             "proposalCache": self.proposal_cache.export_state(),
             "fencingEpoch": (self.elector.epoch
@@ -515,7 +693,8 @@ class KafkaCruiseControl:
         resident = getattr(self.monitor, "resident", None)
         res_state = payload.get("resident")
         if resident is not None and res_state is not None:
-            resident.restore(res_state["epoch"], res_state["arrays"])
+            resident.restore(res_state["epoch"], res_state["arrays"],
+                             ingest_seq=res_state.get("ingestSeq"))
         cache_state = payload.get("proposalCache")
         if cache_state is not None:
             self.proposal_cache.restore_state(cache_state)
@@ -540,7 +719,15 @@ class KafkaCruiseControl:
         now = now_ms if now_ms is not None else self._now_ms()
         role = (self.elector.tick(now) if self.elector is not None
                 else "leader")
-        if self.snapshotter is not None:
+        if self.replication is not None:
+            # Streaming mode: the leader publishes delta frames (and
+            # still writes the cadenced full snapshot — it remains the
+            # bootstrap/resync path); the standby follows the stream
+            # instead of mtime-polling the file.
+            if role == "leader" and self.snapshotter is not None:
+                self.snapshotter.maybe_write(now, self.snapshot_payload)
+            self.replication.tick(now, role)
+        elif self.snapshotter is not None:
             if role == "leader":
                 self.snapshotter.maybe_write(now, self.snapshot_payload)
             elif (self.snapshotter.standby_should_poll(now)
@@ -933,6 +1120,14 @@ class KafkaCruiseControl:
             return self._optimize(progress, goals,
                                   OptimizationOptions(
                                       skip_hard_goal_check=True))
+        if self._follower_serving():
+            # Replication follower: never recompute — serve the newest
+            # replicated entry (stale-flagged at restore, so the
+            # execution gate refuses to act on it) and let the
+            # bounded-staleness read gate police its age.
+            e = self.proposal_cache.latest_entry()
+            if e is not None:
+                return e.result
         return self.proposal_cache.get(self._now_ms())
 
     def simulate(self, payload: dict) -> dict:
@@ -1137,6 +1332,9 @@ class KafkaCruiseControl:
         payload["snapshot"] = (self.snapshotter.to_json()
                                if self.snapshotter is not None else None)
         payload["ha"] = self.ha_json()
+        payload["replication"] = (self.replication.to_json()
+                                  if self.replication is not None
+                                  else None)
         return payload
 
     # -------------------------------------------------------- fleet ops

@@ -149,6 +149,17 @@ class ProposalCache:
         reads the published entry)."""
         return self.fast_entry() is not None
 
+    def latest_entry(self) -> CacheEntry | None:
+        """The newest published entry regardless of generation validity
+        (lock-free; None when empty). The replication follower-serving
+        path reads this: a stream-fed replica's generation advances with
+        the leader's frames while its proposal entry only moves when the
+        leader re-exports one, so generation-strict ``fast_entry`` would
+        refuse an entry that is merely one export behind — the replica
+        serves the newest replicated entry and lets the bounded-staleness
+        contract (core/replication.py read_refusal) police its age."""
+        return self._entry
+
     def _publish_locked(self) -> None:
         """Mirror the Condition-side fields into a fresh immutable entry
         (caller holds the Condition). One attribute store publishes."""

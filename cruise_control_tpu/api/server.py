@@ -27,6 +27,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..analyzer import OptimizationOptions
 from ..core.leader import NotLeaderError
+from .admission import AdmissionLimitError
 from .facade import KafkaCruiseControl
 from .parameters import ParsedParams, parse_endpoint_params
 from .purgatory import Purgatory
@@ -62,7 +63,17 @@ NO_REVIEW_REQUIRED = {"review", "stop_proposal_execution", "simulate",
 #: bare GET handlers outside the servlet endpoint table (observability
 #: surfaces + the API explorer) — instrumented through the same shared
 #: request-timing wrapper as every dispatched endpoint.
-AUX_GET_ENDPOINTS = {"metrics", "trace", "devicestats", "explorer"}
+AUX_GET_ENDPOINTS = {"metrics", "trace", "devicestats", "explorer",
+                     "replication_stream"}
+#: GET endpoints a read replica refuses while its stream lag exceeds
+#: replication.max.staleness.ms (503 + leaderId + Retry-After): the
+#: cluster-state surfaces where stale answers mislead. Observability
+#: endpoints (/metrics, /devicestats, /trace, the explorer) and the
+#: admin bookkeeping GETs stay up on a lagging replica — that is
+#: exactly when an operator needs to scrape it.
+STALENESS_GATED_ENDPOINTS = {"state", "load", "partition_load",
+                             "proposals", "kafka_cluster_state", "fleet",
+                             "forecast"}
 
 #: per-request access log (ref webserver.accesslog.enabled; the reference
 #: writes an NCSA access log through Jetty)
@@ -103,16 +114,31 @@ class CruiseControlApp:
                  ssl_context=None,
                  parameter_overrides: dict | None = None,
                  engine: str = "threading",
-                 max_block_time_ms: int | None = None) -> None:
+                 max_block_time_ms: int | None = None,
+                 admission_rate_per_s: float | None = None,
+                 admission_burst: int | None = None) -> None:
         # None = use the component's own default (single source of truth
         # in tasks.py / purgatory.py); values are forwarded only when set.
         self.facade = facade
+        from ..core.sensors import MetricRegistry as _MR
+        self.registry = _MR()
         task_kwargs = {k: v for k, v in (
             ("max_active_tasks", max_active_tasks),
             ("completed_task_retention_ms", completed_task_retention_ms),
             ("max_cached_completed", max_cached_completed_tasks),
         ) if v is not None}
-        self.tasks = UserTaskManager(**task_kwargs)
+        self.tasks = UserTaskManager(registry=self.registry, **task_kwargs)
+        #: write-path admission control (api/admission.py): None =
+        #: disabled (tier-1 stacks and single-user CLIs are unthrottled;
+        #: serving deployments set admission.rate.per.sec).
+        self.admission = None
+        if admission_rate_per_s is not None:
+            from .admission import AdmissionController
+            self.admission = AdmissionController(
+                rate_per_s=admission_rate_per_s,
+                burst=(admission_burst if admission_burst is not None
+                       else 10),
+                registry=self.registry)
         purgatory_kwargs = {k: v for k, v in (
             ("retention_ms", purgatory_retention_ms),
             ("max_requests", purgatory_max_requests)) if v is not None}
@@ -141,9 +167,9 @@ class CruiseControlApp:
         # Per-endpoint request sensors (ref the KafkaCruiseControlServlet
         # sensor table: <endpoint>-request-rate and
         # <endpoint>-successful-request-execution-timer), merged into the
-        # facade's scrape view.
-        from ..core.sensors import MetricRegistry as _MR
-        self.registry = _MR()
+        # facade's scrape view. One registry per app — the task-queue and
+        # admission sensors above share it, so backpressure is scraped
+        # alongside the request rates.
         if hasattr(facade, "extra_registries"):
             facade.extra_registries.append(self.registry)
         # Pre-built enum-keyed sensor maps (the reference keys its servlet
@@ -283,6 +309,13 @@ class CruiseControlApp:
         if method == "POST" and endpoint not in POST_ENDPOINTS:
             return 405, {"errorMessage": f"{endpoint} is not a POST endpoint"}, {}
 
+        # Write-path admission: every POST draws from its principal's
+        # token bucket BEFORE any work is parked, parsed or queued. An
+        # empty bucket raises AdmissionLimitError -> 429 + Retry-After
+        # (mapped by route_request); GETs are never admission-gated.
+        if method == "POST" and self.admission is not None:
+            self.admission.admit(principal.name)
+
         # ref request.reason.required: mutating requests must say why
         # (recorded in the access/audit logs).
         if (method == "POST" and self.reason_required
@@ -382,10 +415,13 @@ class CruiseControlApp:
         except NotLeaderError as e:
             # Standby replica: execution endpoints answer 503 with the
             # leader's identity so clients (and LBs) can redirect — reads
-            # keep being served here (docs/operations.md §HA).
+            # keep being served here (docs/operations.md §HA). Retry-After
+            # covers clients that retry the same node instead of
+            # redirecting: back off one lease beat, don't hot-loop.
             return 503, {"errorMessage": str(e),
                          "leaderId": e.leader_id,
-                         "userTaskId": existing.user_task_id}, hdrs
+                         "userTaskId": existing.user_task_id}, {
+                             **hdrs, "Retry-After": "1"}
         except Exception as e:  # operation failed
             return 500, {"errorMessage": str(e),
                          "userTaskId": existing.user_task_id}, hdrs
@@ -866,6 +902,24 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         # CORS preflight (ref webserver.http.cors.*).
         return ((200 if app.cors else 405), "application/json", b"",
                 dict(app.cors))
+    # Bounded-staleness gate: a read replica whose stream lag exceeds
+    # replication.max.staleness.ms refuses the cluster-state GETs with
+    # 503 + the leader's identity, BEFORE the render-cache fast path —
+    # a stale cached body must never short-circuit past the refusal.
+    # Leaders and unreplicated deployments answer None and skip this.
+    if method == "GET":
+        rest0 = parts[1:] if parts[:1] == ["kafkacruisecontrol"] else parts
+        if (len(rest0) == 1
+                and rest0[0].lower() in STALENESS_GATED_ENDPOINTS):
+            refusal_fn = getattr(app.facade, "read_refusal", None)
+            refusal = refusal_fn() if refusal_fn is not None else None
+            if refusal is not None:
+                return json_resp(
+                    503, {"errorMessage":
+                          "replica is beyond the bounded-staleness "
+                          "contract; redirect to the leader",
+                          **refusal},
+                    {"Retry-After": "1"})
     # Render-cache fast path: both engines' hot GETs (cached or
     # disabled per endpoint — see facade._register_render_endpoints)
     # short-circuit here; a None falls through to the handlers below,
@@ -937,6 +991,51 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
                     (render("devicestats", payload) + "\n").encode(),
                     dict(app.cors))
         return json_resp(200, payload)
+    # /replication_stream: the leader's delta push channel
+    # (core/replication.py) — long-poll GET with ?cursor=<next-seq> and
+    # ?wait_ms=<hold-open budget>. The payload is the restricted-pickle
+    # frame batch (decode_stream_payload), a replica-to-leader transport
+    # surface rather than a public JSON API; followers treat any non-200
+    # as a stream cut and re-poll. Viewer-gated like /state.
+    if method == "GET" and parts in (["replication_stream"],
+                                     ["kafkacruisecontrol",
+                                      "replication_stream"]):
+        try:
+            check_access(app.security, "state", headers)
+        except AuthorizationError as e:
+            return json_resp(e.status, {"errorMessage": str(e)},
+                             _auth_headers(e, app.security))
+        session = getattr(app.facade, "replication", None)
+        channel = getattr(session, "channel", None)
+        # A DualChannel node serves its LOCAL ring (never proxies its
+        # peer); a plain ReplicationChannel serves itself.
+        channel = getattr(channel, "ring", channel)
+        if channel is None or not hasattr(channel, "publish"):
+            # Not wired, or this node is itself a follower over HTTP
+            # (its "channel" is a client, not the ring buffer).
+            return json_resp(404, {"errorMessage":
+                                   "replication streaming is not "
+                                   "enabled on this node"})
+        q = parse_qs(parsed.query)
+        try:
+            cursor = int(q.get("cursor", ["0"])[0])
+            wait_ms = min(int(q.get("wait_ms", ["0"])[0]), 30_000)
+        except ValueError:
+            return json_resp(400, {"errorMessage":
+                                   "cursor and wait_ms must be integers"})
+        from ..core.replication import encode_stream_payload
+        with app.request_timing("GET", "replication_stream") as outcome:
+            res = channel.poll(cursor, session._now_ms(), wait_ms=wait_ms)
+            if res is None:
+                # A chaos cut (or a not-yet-serving channel): tell the
+                # follower to back off and re-poll.
+                outcome["status"] = 503
+                return json_resp(503, {"errorMessage":
+                                       "replication stream unavailable"},
+                                 {"Retry-After": "1"})
+            data = encode_stream_payload(res)
+            outcome["status"] = 200
+        return 200, "application/octet-stream", data, dict(app.cors)
     # /fleet and /fleet/rebalance: REST-shaped aliases for the fleet
     # endpoints (also reachable at their flat servlet names). Rewritten
     # before the flat-path check so they dispatch through the ordinary
@@ -994,13 +1093,22 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
     except TooManyUserTasksError as e:
         # Capacity pushback is the client's signal to back off, not a
         # server fault (deviation from the reference, which 500s here —
-        # see TooManyUserTasksError).
-        status, payload, extra = 429, {"errorMessage": str(e)}, {}
+        # see TooManyUserTasksError). Retry-After makes the shed an
+        # instruction: the queue drains, the retry succeeds.
+        status, payload = 429, {"errorMessage": str(e)}
+        extra = {"Retry-After": str(e.retry_after_s)}
+    except AdmissionLimitError as e:
+        # Per-principal write throttle (api/admission.py): the bucket's
+        # own refill time rides the Retry-After header.
+        status, payload = 429, {"errorMessage": str(e),
+                                "principal": e.principal}
+        extra = {"Retry-After": str(e.retry_after_s)}
     except NotLeaderError as e:
         # Sync execution path on a standby replica (async paths map this
         # inside _handle_async, keeping their User-Task-ID header).
-        status, payload, extra = 503, {"errorMessage": str(e),
-                                       "leaderId": e.leader_id}, {}
+        status, payload = 503, {"errorMessage": str(e),
+                                "leaderId": e.leader_id}
+        extra = {"Retry-After": "1"}
     except Exception as e:
         status, payload, extra = 500, {"errorMessage": str(e)}, {}
     # json=false: fixed-width text tables (ref the response classes'

@@ -25,7 +25,12 @@ class TooManyUserTasksError(RuntimeError):
     429 — a deliberate improvement over the reference, whose equivalent
     RuntimeException (``UserTaskManager.java:496``) surfaces as a 500;
     429 tells clients to back off and retry rather than report a server
-    fault."""
+    fault. ``retry_after_s`` rides the ``Retry-After`` response header
+    so shedding is an instruction, not just a rejection."""
+
+    def __init__(self, message: str, *, retry_after_s: int = 1) -> None:
+        super().__init__(message)
+        self.retry_after_s = max(1, int(retry_after_s))
 
 
 class TaskState(enum.Enum):
@@ -64,7 +69,9 @@ class UserTaskManager:
     def __init__(self, max_active_tasks: int = 25,
                  completed_task_retention_ms: int = 24 * 3600 * 1000,
                  num_threads: int = 8,
-                 max_cached_completed: int = 100) -> None:
+                 max_cached_completed: int = 100,
+                 registry=None) -> None:
+        from ..core.sensors import MetricRegistry
         self._tasks: dict[str, UserTaskInfo] = {}
         self._lock = threading.RLock()
         self._pool = ThreadPoolExecutor(max_workers=num_threads,
@@ -77,11 +84,28 @@ class UserTaskManager:
         #: per-scope monitor/admin caches are a deliberate deviation
         #: (docs/deviations.md §8).
         self.max_cached_completed = max_cached_completed
+        #: backpressure meters: queue depth (active = queued + running —
+        #: the cap bounds BOTH, queues can never grow without bound) and
+        #: the shed rate an operator alerts on.
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        self.registry.gauge(name("UserTasks", "active-depth"),
+                            self.active_count)
+        self._rejections = self.registry.meter(
+            name("UserTasks", "rejected-rate"))
+
+    def active_count(self) -> int:
+        """Active tasks = running + queued behind the pool: the bounded
+        quantity ``max_active_tasks`` caps."""
+        with self._lock:
+            return sum(1 for t in self._tasks.values()
+                       if t.state is TaskState.ACTIVE)
 
     def _ensure_capacity_locked(self) -> None:
         active = sum(1 for t in self._tasks.values()
                      if t.state is TaskState.ACTIVE)
         if active >= self.max_active_tasks:
+            self._rejections.mark()
             raise TooManyUserTasksError(
                 f"too many active user tasks ({active})")
 

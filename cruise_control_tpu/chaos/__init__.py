@@ -23,6 +23,13 @@ truncates / bit-flips the snapshot before restore, and
 :class:`HAFailoverHarness` runs leader + warm standby as two full stacks
 over one sim with the fencing ledger
 (:func:`check_fencing_invariants`) auditing every mutation.
+
+Replication-stream faults: ``cut_stream`` severs the leader's
+snapshot-delta push channel (follower polls read as a dead connection)
+and ``delay_stream`` adds ordered delivery delay — both step-keyed and
+seed-replayable like every other fault — while
+:func:`check_replication_invariants` audits the replica stream ledger
+(no deposed-epoch applies, no double-applies, refusals stay refused).
 """
 
 from .engine import (ChaosAdminClient, ChaosEngine, ChaosSampler,
@@ -30,7 +37,7 @@ from .engine import (ChaosAdminClient, ChaosEngine, ChaosSampler,
 from .ha import HAFailoverHarness, MutationStamp, corrupt_snapshot
 from .harness import ChaosHarness, build_sim, default_optimizer
 from .invariants import (check_fencing_invariants, check_invariants,
-                         snapshot_topology)
+                         check_replication_invariants, snapshot_topology)
 
 __all__ = [
     "ChaosAdminClient",
@@ -44,6 +51,7 @@ __all__ = [
     "build_sim",
     "check_fencing_invariants",
     "check_invariants",
+    "check_replication_invariants",
     "corrupt_snapshot",
     "default_optimizer",
     "snapshot_topology",

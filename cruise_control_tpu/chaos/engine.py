@@ -169,7 +169,7 @@ class ChaosEngine:
     ACTIONS = ("kill_broker", "restart_broker", "fail_logdir",
                "stall_broker", "unstall_broker", "admin_error_rate",
                "admin_burst", "drop_samples", "clock_jump",
-               "crash_process")
+               "crash_process", "cut_stream", "delay_stream")
 
     def __init__(self, sim, *, seed: int = 0, step_ms: int = 1000,
                  events: list[FaultEvent] | None = None) -> None:
@@ -190,6 +190,14 @@ class ChaosEngine:
         self.admin_bursts: dict[str, tuple[str, int]] = {}
         #: probability a sampling round is dropped wholesale
         self.sample_drop_rate = 0.0
+        #: replication-stream faults (read by ReplicationChannel when the
+        #: engine is its fault_source): a cut makes every poll answer
+        #: None (follower reads it as a severed connection); a delay
+        #: withholds frames younger than the given age, modelling a slow
+        #: link without reordering (frames still deliver in sequence once
+        #: old enough).
+        self.stream_cut = False
+        self.stream_delay_ms = 0
         self._admin_counters: dict[str, int] = {}
         self._saved_rates: dict[int, float] = {}
         #: clock offset applied on top of sim time (clock_jump faults)
@@ -283,6 +291,18 @@ class ChaosEngine:
         raise ProcessCrashed(
             f"chaos: control-plane process crashed at t={self.sim.now_ms}ms "
             f"(seed={self.seed})")
+
+    def _do_cut_stream(self, on: bool = True) -> None:
+        """Sever (or restore, ``on=False``) the replication push channel:
+        follower polls return None, lag grows, the replica transitions
+        STREAMING -> LAGGING and starts refusing gated reads."""
+        self.stream_cut = bool(on)
+
+    def _do_delay_stream(self, ms: int = 0) -> None:
+        """Add ``ms`` of one-way delivery delay to the replication
+        stream (0 restores the instant link). Delayed frames are hidden,
+        not dropped — they deliver in order once old enough."""
+        self.stream_delay_ms = max(0, int(ms))
 
     def _do_clock_jump(self, ms: int) -> None:
         """Forward clock jump: simulated time leaps (windows roll, time

@@ -156,7 +156,9 @@ class HAFailoverHarness:
                  snapshot_dir: str, sim=None, optimizer=None,
                  lease_steps: int = 4, snapshot_interval_steps: int = 1,
                  goals: list[str] | None = None,
-                 processes: tuple[str, ...] = ("a", "b")) -> None:
+                 processes: tuple[str, ...] = ("a", "b"),
+                 replication: bool = False,
+                 max_staleness_ms: int = 5_000) -> None:
         self.sim = sim or build_sim()
         self.engine = ChaosEngine(self.sim, seed=seed, step_ms=step_ms)
         self.snapshot_path = os.path.join(snapshot_dir, "cc.snapshot")
@@ -165,6 +167,19 @@ class HAFailoverHarness:
         self._goals = goals
         self._lease_steps = lease_steps
         self._interval_steps = snapshot_interval_steps
+        #: snapshot-delta streaming (core/replication.py): one shared
+        #: in-process channel standing in for the leader's
+        #: /replication_stream endpoint, with the ENGINE as its fault
+        #: source — cut_stream/delay_stream faults land on every
+        #: follower's polls, step-keyed and replayable like any other
+        #: fault. The shared ReplicaStamp ledger is the replication
+        #: audit trail (invariants.check_replication_invariants).
+        self.channel = None
+        self.delta_stamps: list = []
+        self._max_staleness_ms = max_staleness_ms
+        if replication:
+            from ..core.replication import ReplicationChannel
+            self.channel = ReplicationChannel(fault_source=self.engine)
         self.procs: dict[str, ChaosHarness] = {}
         for name in processes:
             self._spawn(name)
@@ -179,6 +194,11 @@ class HAFailoverHarness:
             snapshot_interval_steps=self._interval_steps,
             ha_identity=name, ha_lease_steps=self._lease_steps)
         admin.elector = h.facade.elector
+        if self.channel is not None:
+            h.facade.attach_replication_channel(
+                self.channel, node_id=name,
+                max_staleness_ms=self._max_staleness_ms,
+                ledger=self.delta_stamps)
         if restore:
             h.facade.restore_from_snapshot(self.engine.now_ms())
         self.procs[name] = h
@@ -188,17 +208,24 @@ class HAFailoverHarness:
     def step(self, *, detect: bool = False) -> None:
         """One shared-clock step: advance the engine once, then drive
         every live process's sampling + HA tick (+ optional detection)
-        at the same simulated instant, in name order."""
+        at the same simulated instant, in name order.
+
+        With replication on, only the leader samples: replicas are
+        stream-fed (their resident state advances by applied deltas, so
+        an independently-sampling replica would fork its ingest chain
+        and thrash through RESYNC instead of following)."""
         self.engine.tick()
         now = self.engine.now_ms()
         for name in sorted(self.procs):
             h = self.procs[name]
             if h.crashed:
                 continue
-            try:
-                h.runner.maybe_run_sampling(now)
-            except Exception:
-                h.sampling_failures += 1
+            if (self.channel is None
+                    or h.facade.elector.is_leader()):
+                try:
+                    h.runner.maybe_run_sampling(now)
+                except Exception:
+                    h.sampling_failures += 1
             h.facade.ha_tick(now)
             if detect:
                 try:

@@ -142,3 +142,49 @@ def check_fencing_invariants(stamps) -> list[str]:
                         f"(first applied under epoch {first}) — proposal "
                         "executed twice across failover")
     return problems
+
+
+def check_replication_invariants(stamps) -> list[str]:
+    """Audit a replicated run's stream ledger (core/replication.py
+    ``ReplicaStamp`` list) against the snapshot-delta contract:
+
+    - **No deposed-epoch applies**: per follower, once a frame stamped
+      with fencing epoch E is applied, no frame with epoch < E may be
+      applied afterwards — a deposed leader's straggler deltas must be
+      *refused* (the ``refused-epoch`` action), never folded into replica
+      state.
+    - **No double-applies / ordering**: per follower, applied sequence
+      numbers are strictly increasing — the same frame applied twice (or
+      out of order) means the cursor went backwards.
+    - **Refusals are terminal for the frame**: a (node, seq) that was
+      refused for its epoch is never later applied by the same node.
+    """
+    problems: list[str] = []
+    max_applied_epoch: dict[str, int] = {}
+    last_applied_seq: dict[str, int] = {}
+    refused: set[tuple[str, int]] = set()
+    for s in stamps:
+        if s.action == "refused-epoch":
+            refused.add((s.node, s.seq))
+            continue
+        if s.action not in ("applied", "skipped"):
+            continue   # resync markers reset nothing audited here
+        if s.action == "applied" and (s.node, s.seq) in refused:
+            problems.append(
+                f"[{s.now_ms}ms] {s.node}: seq {s.seq} applied after "
+                f"being refused for a deposed epoch")
+        floor = max_applied_epoch.get(s.node, 0)
+        if s.epoch < floor:
+            problems.append(
+                f"[{s.now_ms}ms] {s.node}: frame seq {s.seq} from epoch "
+                f"{s.epoch} {s.action} after epoch {floor} was already "
+                f"{s.action} (deposed leader's delta folded into replica "
+                "state)")
+        max_applied_epoch[s.node] = max(floor, s.epoch)
+        last = last_applied_seq.get(s.node, -1)
+        if s.seq <= last:
+            problems.append(
+                f"[{s.now_ms}ms] {s.node}: seq {s.seq} {s.action} after "
+                f"seq {last} (duplicate or out-of-order apply)")
+        last_applied_seq[s.node] = s.seq
+    return problems

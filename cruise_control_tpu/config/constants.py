@@ -332,6 +332,67 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "serving-loop pauses (a leader that cannot renew "
                  "self-demotes — and self-fences — at its own "
                  "deadline).")
+    d.define("replication.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM,
+             doc="Snapshot-delta streaming to read replicas "
+                 "(core/replication.py). Requires ha.enabled + "
+                 "snapshot.path: the leader publishes the resident "
+                 "delta payloads + logical-clock stamps over "
+                 "/replication_stream; standbys follow the stream "
+                 "(SYNCING -> STREAMING; full snapshots stay the "
+                 "bootstrap/RESYNC path), serve the read surface under "
+                 "the bounded-staleness contract, and refuse frames "
+                 "below their fencing-epoch floor — a deposed leader's "
+                 "stream is never applied (docs/operations.md "
+                 "§Replication).")
+    d.define("replication.max.staleness.ms", ConfigType.LONG, 5_000,
+             validator=Range.at_least(100), importance=Importance.MEDIUM,
+             doc="Bounded-staleness read contract for stream-fed "
+                 "replicas: while stream lag (Replication.stream-lag-ms) "
+                 "is within this bound, replicas serve the cluster-state "
+                 "GETs; beyond it they answer 503 + leaderId + "
+                 "Retry-After rather than serve stale state "
+                 "(STREAMING -> LAGGING, metered).")
+    d.define("replication.leader.endpoint", ConfigType.STRING, "",
+             importance=Importance.MEDIUM,
+             doc="host:port of the leader's REST listener this node "
+                 "follows while standing by (front the leader with a "
+                 "stable VIP/LB name so failover does not require "
+                 "reconfiguration). Empty = this node only serves the "
+                 "stream — leader-only wiring, or an in-process channel "
+                 "attached programmatically (the chaos/bench "
+                 "harnesses).")
+    d.define("replication.buffer.frames", ConfigType.INT, 256,
+             validator=Range.at_least(8), importance=Importance.LOW,
+             doc="Leader-side ring capacity of the delta push channel. "
+                 "A follower whose cursor falls off the ring resyncs "
+                 "from the full snapshot (metered Replication.resyncs) "
+                 "— bigger buffers ride out longer stalls at the cost "
+                 "of retained frame memory.")
+    d.define("replication.poll.wait.ms", ConfigType.LONG, 10_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Long-poll hold-open budget a follower requests from "
+                 "the leader's /replication_stream: the leader parks "
+                 "the poll until a frame arrives or the budget lapses. "
+                 "0 = plain polling (chaos/sim harnesses).")
+    d.define("admission.rate.limit.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM,
+             doc="Per-principal write admission control "
+                 "(api/admission.py): every POST draws a token from the "
+                 "caller's bucket before any parsing or queueing; an "
+                 "empty bucket answers 429 + Retry-After (never a 5xx). "
+                 "GETs are never admission-gated. Principals come from "
+                 "the security provider (anonymous under AllowAll — "
+                 "pair with a real provider for per-user isolation).")
+    d.define("admission.principal.rate.per.sec", ConfigType.DOUBLE, 5.0,
+             validator=Range.at_least(0.001), importance=Importance.LOW,
+             doc="Steady-state token refill rate of each principal's "
+                 "bucket (writes per second, continuously refilled).")
+    d.define("admission.principal.burst", ConfigType.INT, 10,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Bucket depth: the burst of back-to-back writes one "
+                 "principal may issue before the steady-state rate "
+                 "applies.")
     d.define("default.goals", ConfigType.LIST, "",
              importance=Importance.HIGH, doc="Goal chain (empty = built-in)")
     d.define("hard.goals", ConfigType.LIST, "", importance=Importance.MEDIUM,

@@ -1,0 +1,573 @@
+"""Leader→replica snapshot-delta streaming: the replicated serving plane.
+
+The crash-safe snapshot (core/snapshot.py) made ONE process restartable;
+the HA elector (core/leader.py) made a warm standby take over. But the
+standby's freshness came from polling the snapshot file's mtime — a
+whole-payload restore per change, bounded below by the write interval.
+This module streams the *increments* instead: the leader publishes
+**frames** carrying the resident metric-delta payloads the model layer
+already computes (``(idx, rows)`` arrays — see
+``ResidentClusterState._metric_delta``) plus the logical-clock stamps the
+render cache keys on (monitor generation / resident epoch + ingest seq /
+registry mutation count / proposal-cache entry seq), and replicas apply
+them in order. Full snapshots remain the bootstrap/resync path — a
+replica that falls off the stream restores the file, then rejoins.
+
+Three pieces:
+
+- :class:`ReplicationChannel` — the leader-side bounded frame ring.
+  In-process followers (the chaos harness) poll it directly; remote
+  followers long-poll it over ``GET /replication_stream`` (the server
+  serves :func:`encode_stream_payload` bytes;
+  :class:`HttpReplicationClient` is the matching follower-side adapter).
+  The chaos engine is wired in as ``fault_source``: its ``stream_cut`` /
+  ``stream_delay_ms`` state (the ``cut_stream`` / ``delay_stream``
+  actions) drops or delays delivery deterministically.
+- :class:`ReplicationSession` — one per process, both roles. The leader
+  side publishes a frame whenever the clock tuple moved; the follower
+  side runs the explicit resync state machine **SYNCING → STREAMING →
+  LAGGING → RESYNC** (every transition metered), maintains the
+  ``Replication.stream-lag-ms`` gauge, and **fence-checks every frame**:
+  a frame stamped with a fencing epoch below the highest epoch this
+  follower has seen is refused outright — a deposed leader's stream is
+  never applied. The session is written against narrow callables
+  (``clocks`` / ``build_frame`` / ``apply_frame`` / ``resync``) so the
+  state machine unit-tests with trivial fakes; the facade wires the real
+  adapters (``attach_replication_channel``).
+- :class:`ReplicaStamp` — the apply ledger. When a shared list is passed
+  in (the chaos harness does), every applied / skipped / refused frame
+  and every resync lands on it, and
+  :func:`~cruise_control_tpu.chaos.invariants.
+  check_replication_invariants` audits the whole run: applied seqs
+  strictly increase per node, applied fencing epochs never regress, no
+  frame applies twice.
+
+Consistency model: frames carry the resident ``ingest_seq`` chain
+(``baseIngest`` → ``ingest`` per delta entry), so a follower applies a
+delta only onto the exact state it diffs against; any gap — missed
+frames, a structural rebuild (epoch bump), capture overflow — degrades
+to RESYNC via the snapshot, never to a silently-divergent model. Reads
+on a replica are safe exactly when the session is STREAMING within
+``replication.max.staleness.ms`` (:meth:`ReplicationSession.
+read_refusal`); the server maps anything else to 503 + ``Retry-After`` +
+``leaderId``.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+import threading
+from collections import deque
+from dataclasses import dataclass
+
+LOG = logging.getLogger(__name__)
+
+#: sensor group for the streaming series (``Replication.*``).
+REPLICATION_SENSOR = "Replication"
+
+#: follower state machine states, in the nominal lifecycle order.
+SYNCING = "SYNCING"
+STREAMING = "STREAMING"
+LAGGING = "LAGGING"
+RESYNC = "RESYNC"
+STATES = (SYNCING, STREAMING, LAGGING, RESYNC)
+_STATE_CODE = {s: i for i, s in enumerate(STATES)}
+
+
+@dataclass
+class PollResult:
+    """One poll of the frame ring, as seen by a follower."""
+
+    #: frames visible to this cursor (delivery-delayed ones withheld)
+    frames: list
+    #: newest PUBLISHED seq — including frames a delay fault is hiding,
+    #: so a follower can tell "caught up" from "the stream is stalled"
+    head_seq: int
+    #: oldest seq still retained by the ring
+    base_seq: int
+    #: leader clock at poll service time — the follower's freshness
+    #: reference when fully caught up
+    now_ms: int
+    #: the cursor fell off the ring (frames were evicted unseen): the
+    #: follower must RESYNC from the snapshot, the stream has a hole
+    reset: bool
+
+
+@dataclass
+class ReplicaStamp:
+    """One follower-side frame decision — the replication apply ledger
+    (the streaming analogue of ``chaos.ha.MutationStamp``)."""
+
+    now_ms: int
+    node: str
+    #: frame seq (``-1`` for resync entries, which are not frame-keyed)
+    seq: int
+    #: the frame's fencing epoch (resync entries: the follower's floor)
+    epoch: int
+    #: ``applied | skipped | refused-epoch | resync``
+    action: str
+    reason: str | None = None
+
+
+class ReplicationChannel:
+    """Bounded in-memory frame ring with long-poll delivery.
+
+    The leader's session publishes; followers poll by cursor (the next
+    seq they want). Overflow evicts the oldest frames — a follower whose
+    cursor fell below the ring base gets ``reset=True`` and must resync
+    from the snapshot. ``fault_source`` (the chaos engine) is consulted
+    on every poll: ``stream_cut`` drops delivery wholesale (returns
+    ``None`` — no contact, the follower's lag grows),
+    ``stream_delay_ms`` withholds frames until they are old enough —
+    both seeded, step-keyed faults that replay byte-identically.
+    """
+
+    def __init__(self, *, capacity: int = 256, fault_source=None,
+                 registry=None) -> None:
+        from .sensors import MetricRegistry
+        self.capacity = int(capacity)
+        #: object exposing ``stream_cut`` / ``stream_delay_ms`` (the
+        #: chaos engine); None = no fault injection.
+        self.fault_source = fault_source
+        self._cond = threading.Condition()
+        self._frames: deque = deque()
+        self._next_seq = 1
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        g = REPLICATION_SENSOR
+        self._published = self.registry.counter(name(g, "frames-published"))
+        self._evicted = self.registry.counter(name(g, "frames-evicted"))
+        self._polls = self.registry.counter(name(g, "polls"))
+        self._polls_dropped = self.registry.counter(
+            name(g, "polls-dropped"))
+        self.registry.gauge(name(g, "frames-buffered"),
+                            lambda: len(self._frames))
+
+    # ------------------------------------------------------------ leader
+    def publish(self, frame: dict, now_ms: int) -> int:
+        """Stamp + append one frame; wakes long-poll waiters. Returns
+        the assigned seq."""
+        with self._cond:
+            seq = self._next_seq
+            self._next_seq += 1
+            frame["seq"] = seq
+            frame["stampMs"] = int(now_ms)
+            self._frames.append(frame)
+            while len(self._frames) > self.capacity:
+                self._frames.popleft()
+                self._evicted.inc()
+            self._cond.notify_all()
+        self._published.inc()
+        return seq
+
+    @property
+    def head_seq(self) -> int:
+        return self._next_seq - 1
+
+    @property
+    def base_seq(self) -> int:
+        with self._cond:
+            return self._frames[0]["seq"] if self._frames else self._next_seq
+
+    # ---------------------------------------------------------- follower
+    def poll(self, cursor: int, now_ms: int,
+             wait_ms: int = 0) -> PollResult | None:
+        """Frames from ``cursor`` on (``cursor <= 0`` = from the ring
+        base — the post-resync rejoin, never a reset). ``wait_ms > 0``
+        long-polls (REAL time — only the HTTP serving path uses it; the
+        simulated-clock harness polls with 0). Returns ``None`` when a
+        ``cut_stream`` fault is active: no contact at all."""
+        fs = self.fault_source
+        if fs is not None and getattr(fs, "stream_cut", False):
+            self._polls_dropped.inc()
+            return None
+        delay = int(getattr(fs, "stream_delay_ms", 0) or 0) if fs else 0
+        self._polls.inc()
+        with self._cond:
+            result = self._visible(cursor, now_ms, delay)
+            if wait_ms > 0 and not result.frames and not result.reset \
+                    and result.head_seq < max(cursor, 1):
+                self._cond.wait(timeout=wait_ms / 1000.0)
+                # Re-check the fault state: a cut that landed while we
+                # were parked must not deliver.
+                if fs is not None and getattr(fs, "stream_cut", False):
+                    self._polls_dropped.inc()
+                    return None
+                delay = (int(getattr(fs, "stream_delay_ms", 0) or 0)
+                         if fs else 0)
+                result = self._visible(cursor, now_ms, delay)
+        return result
+
+    def _visible(self, cursor: int, now_ms: int, delay: int) -> PollResult:
+        base = (self._frames[0]["seq"] if self._frames else self._next_seq)
+        start = cursor if cursor > 0 else base
+        frames = [f for f in self._frames
+                  if f["seq"] >= start and f["stampMs"] + delay <= now_ms]
+        return PollResult(frames=frames, head_seq=self._next_seq - 1,
+                          base_seq=base, now_ms=int(now_ms),
+                          reset=0 < cursor < base)
+
+    def to_json(self) -> dict:
+        with self._cond:
+            return {
+                "capacity": self.capacity,
+                "buffered": len(self._frames),
+                "headSeq": self._next_seq - 1,
+                "baseSeq": (self._frames[0]["seq"] if self._frames
+                            else self._next_seq),
+                "published": self._published.count,
+                "evicted": self._evicted.count,
+                "polls": self._polls.count,
+                "pollsDropped": self._polls_dropped.count,
+            }
+
+
+# ------------------------------------------------------- wire encoding
+def encode_stream_payload(res: PollResult) -> bytes:
+    """Serialize a poll result for the ``/replication_stream`` response
+    body (dicts + numpy arrays only — round-trips through the snapshot
+    allowlist)."""
+    return pickle.dumps(
+        {"frames": res.frames, "headSeq": res.head_seq,
+         "baseSeq": res.base_seq, "nowMs": res.now_ms, "reset": res.reset},
+        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def decode_stream_payload(raw: bytes) -> PollResult:
+    """Decode a ``/replication_stream`` body with the same restricted
+    unpickler the snapshot restore path trusts: the stream shares the
+    snapshot's trust boundary (leader-authenticated, allowlisted
+    globals), never arbitrary code execution."""
+    from .snapshot import _RestrictedUnpickler
+    obj = _RestrictedUnpickler(io.BytesIO(raw)).load()
+    return PollResult(frames=list(obj["frames"]),
+                      head_seq=int(obj["headSeq"]),
+                      base_seq=int(obj["baseSeq"]),
+                      now_ms=int(obj["nowMs"]), reset=bool(obj["reset"]))
+
+
+class HttpReplicationClient:
+    """Follower-side channel adapter long-polling a leader's
+    ``/replication_stream`` endpoint (the multi-process deployment path;
+    in-process stacks poll the :class:`ReplicationChannel` directly).
+    Satisfies the same ``poll(cursor, now_ms, wait_ms)`` protocol; any
+    transport error reads as "no contact" (``None``) — the follower's
+    lag grows and the state machine degrades exactly as under a
+    ``cut_stream`` fault."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 30.0,
+                 headers: dict | None = None) -> None:
+        self.host = host
+        self.port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.headers = dict(headers or {})
+
+    def poll(self, cursor: int, now_ms: int,
+             wait_ms: int = 0) -> PollResult | None:
+        import http.client
+        path = (f"/kafkacruisecontrol/replication_stream?json=true"
+                f"&cursor={int(cursor)}&wait_ms={int(wait_ms)}")
+        try:
+            conn = http.client.HTTPConnection(
+                self.host, self.port,
+                timeout=self.timeout_s + wait_ms / 1000.0)
+            try:
+                conn.request("GET", path, headers=self.headers)
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return None
+                return decode_stream_payload(body)
+            finally:
+                conn.close()
+        except Exception:   # noqa: BLE001 — transport failure = no contact
+            return None
+
+
+class DualChannel:
+    """The multi-process node wiring (serve.py): publish into the local
+    ring — served to followers at ``/replication_stream`` — and follow
+    the configured peer over HTTP when standing by. The session only
+    publishes while leading and only polls while following, so the two
+    halves never race; the server endpoint resolves ``.ring`` to serve
+    the local buffer rather than proxying the peer."""
+
+    def __init__(self, ring: ReplicationChannel,
+                 client: HttpReplicationClient) -> None:
+        self.ring = ring
+        self.client = client
+
+    def publish(self, frame: dict, now_ms: int) -> int:
+        return self.ring.publish(frame, now_ms)
+
+    def poll(self, cursor: int, now_ms: int,
+             wait_ms: int = 0) -> PollResult | None:
+        return self.client.poll(cursor, now_ms, wait_ms=wait_ms)
+
+    def to_json(self) -> dict:
+        return {"ring": self.ring.to_json(),
+                "peer": f"{self.client.host}:{self.client.port}"}
+
+
+class ReplicationSession:
+    """One process's end of the stream — leader publisher + follower
+    state machine, role-switched every :meth:`tick`.
+
+    The constructor takes narrow callables instead of the facade so the
+    state machine is unit-testable with fakes:
+
+    - ``clocks()`` → dict of logical clocks; the leader publishes a new
+      frame exactly when this moved since the last publish.
+    - ``build_frame()`` → frame body dict (resident delta entries,
+      proposal-cache export, generation) or None for nothing-to-say.
+    - ``fencing_epoch()`` → this process's current fencing epoch; stamps
+      every published frame.
+    - ``apply_frame(frame)`` → ``"applied" | "skipped" | "resync"`` —
+      the follower-side domain apply (resident deltas, proposal cache,
+      generation seed). Must be gap-safe: anything it cannot apply
+      contiguously answers ``"resync"``.
+    - ``resync()`` → leader-clock ms the restored state is fresh as of,
+      or None when no (newer) snapshot was restorable — the full-
+      snapshot bootstrap/fallback path.
+    - ``on_fence(epoch)`` (optional) → observed-epoch feedthrough to the
+      elector, so a follower that has seen epoch E never later ACCEPTS
+      a lease takeover below it.
+    """
+
+    def __init__(self, *, node_id: str, channel, clocks, build_frame,
+                 fencing_epoch, apply_frame, resync,
+                 max_staleness_ms: int = 5_000, poll_wait_ms: int = 0,
+                 registry=None, ledger: list | None = None,
+                 on_fence=None, now_ms=None) -> None:
+        import time as _time
+
+        from .sensors import MetricRegistry
+        self.node_id = node_id
+        self.channel = channel
+        self.clocks = clocks
+        self.build_frame = build_frame
+        self.fencing_epoch = fencing_epoch
+        self.apply_frame = apply_frame
+        self.resync = resync
+        self.max_staleness_ms = int(max_staleness_ms)
+        #: long-poll window handed to the channel (serving deployments;
+        #: simulated-clock harnesses keep 0)
+        self.poll_wait_ms = int(poll_wait_ms)
+        self.on_fence = on_fence
+        self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
+        #: shared apply ledger (:class:`ReplicaStamp`) — None = unaudited
+        self.ledger = ledger
+        self.role = "standby"
+        self.state = SYNCING
+        #: next frame seq this follower wants (0 = rejoin at ring base)
+        self.cursor = 0
+        #: leader-clock ms through which this process is known
+        #: consistent; None = never synced at all
+        self.fresh_ms: int | None = None
+        self.stream_lag_ms: int | None = None
+        #: highest fencing epoch seen on any frame — the refusal floor
+        self.fence_floor = 0
+        self._published_clocks = None
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        g = REPLICATION_SENSOR
+        self._applied = self.registry.counter(name(g, "frames-applied"))
+        self._skipped = self.registry.counter(name(g, "frames-skipped"))
+        self._refused = self.registry.counter(
+            name(g, "frames-refused-epoch"))
+        self._resyncs = self.registry.counter(name(g, "resyncs"))
+        self._poll_failures = self.registry.counter(
+            name(g, "poll-failures"))
+        self._read_refusals = self.registry.meter(
+            name(g, "read-refusal-rate"))
+        self._transitions = {
+            s: self.registry.counter(
+                name(g, f"transitions-to-{s.lower()}"))
+            for s in STATES}
+        self.registry.gauge(name(g, "stream-lag-ms"),
+                            lambda: self.stream_lag_ms)
+        self.registry.gauge(name(g, "state"),
+                            lambda: _STATE_CODE[self.state])
+        self.registry.gauge(name(g, "fence-floor"),
+                            lambda: self.fence_floor)
+        self.registry.gauge(name(g, "cursor"), lambda: self.cursor)
+
+    # ----------------------------------------------------- state machine
+    def _enter(self, state: str, reason: str = "") -> None:
+        if state == self.state:
+            return
+        LOG.info("replication[%s]: %s -> %s%s", self.node_id, self.state,
+                 state, f" ({reason})" if reason else "")
+        self.state = state
+        self._transitions[state].inc()
+
+    def tick(self, now_ms: int, role: str) -> None:
+        """One HA-loop round. ``role`` comes from the elector tick the
+        facade just ran (``leader`` | ``standby``)."""
+        if role == "leader":
+            if self.role != "leader":
+                self.role = "leader"
+                # A promoted follower is the source of truth now: its
+                # stream position is moot.
+                self._enter(STREAMING, "promoted to leader")
+            self._leader_tick(now_ms)
+            return
+        if self.role != "standby":
+            self.role = "standby"
+            # Deposed (or never-led): rejoin the stream from scratch —
+            # the new leader's snapshot is the only safe base.
+            self._published_clocks = None
+            self.cursor = 0
+            self._enter(SYNCING, "demoted to standby")
+        self._follower_tick(now_ms)
+
+    # ------------------------------------------------------------ leader
+    def _leader_tick(self, now_ms: int) -> None:
+        self.fresh_ms = int(now_ms)
+        self.stream_lag_ms = 0
+        c = self.clocks()
+        if c == self._published_clocks:
+            return
+        frame = self.build_frame()
+        if frame is None:
+            self._published_clocks = c
+            return
+        epoch = int(self.fencing_epoch())
+        self.fence_floor = max(self.fence_floor, epoch)
+        frame["fencingEpoch"] = epoch
+        frame["clocks"] = dict(c)
+        frame["node"] = self.node_id
+        self.channel.publish(frame, now_ms)
+        self._published_clocks = c
+
+    # ---------------------------------------------------------- follower
+    def _follower_tick(self, now_ms: int) -> None:
+        if self.state in (SYNCING, RESYNC):
+            as_of = self.resync()
+            if as_of is None:
+                self._update_lag(now_ms)
+                return
+            self._resyncs.inc()
+            self.fresh_ms = int(as_of)
+            self.cursor = 0     # rejoin at the ring base; ingest-chain
+            self._stamp(now_ms, -1, self.fence_floor, "resync",
+                        "restored from snapshot")
+            self._enter(STREAMING, "resynced from snapshot")
+
+        res = self.channel.poll(self.cursor, now_ms,
+                                wait_ms=self.poll_wait_ms)
+        if res is None:
+            self._poll_failures.inc()
+            self._update_lag(now_ms)
+            return
+        if res.reset:
+            self._enter(RESYNC, f"cursor {self.cursor} fell off ring "
+                                f"(base {res.base_seq})")
+            self._update_lag(now_ms)
+            return
+        for frame in res.frames:
+            self.cursor = frame["seq"] + 1
+            if not self._handle(frame, now_ms):
+                break               # entered RESYNC — stop applying
+        else:
+            if self.cursor <= 0:
+                # Nothing visible yet: park at the ring base (NOT past
+                # the head — frames a delay fault is hiding must still
+                # deliver once old enough).
+                self.cursor = res.base_seq
+            if self.cursor > res.head_seq:
+                # Fully caught up — fresh as of the leader's poll-time
+                # clock, even if no frame arrived this round.
+                self.fresh_ms = max(self.fresh_ms or 0, res.now_ms)
+        self._update_lag(now_ms)
+
+    def _handle(self, frame: dict, now_ms: int) -> bool:
+        """Apply one frame. Returns False when the session entered
+        RESYNC (the caller must stop applying this batch)."""
+        epoch = int(frame.get("fencingEpoch", 0))
+        if epoch < self.fence_floor:
+            # A deposed leader's frame: refuse, never apply. The cursor
+            # still advances — the frame is dead, not pending.
+            self._refused.inc()
+            self._stamp(now_ms, frame["seq"], epoch, "refused-epoch",
+                        f"below fence floor {self.fence_floor}")
+            return True
+        if epoch > self.fence_floor:
+            self.fence_floor = epoch
+            if self.on_fence is not None:
+                self.on_fence(epoch)
+        outcome = self.apply_frame(frame)
+        if outcome == "resync":
+            self._stamp(now_ms, frame["seq"], epoch, "resync",
+                        "frame not contiguously applicable")
+            self._enter(RESYNC, f"frame {frame['seq']} not applicable")
+            return False
+        if outcome == "applied":
+            self._applied.inc()
+        else:
+            self._skipped.inc()
+        self._stamp(now_ms, frame["seq"], epoch, outcome)
+        self.fresh_ms = max(self.fresh_ms or 0, int(frame["stampMs"]))
+        return True
+
+    def _update_lag(self, now_ms: int) -> None:
+        if self.fresh_ms is None:
+            self.stream_lag_ms = None
+            return
+        self.stream_lag_ms = max(0, int(now_ms) - self.fresh_ms)
+        if self.state == STREAMING \
+                and self.stream_lag_ms > self.max_staleness_ms:
+            self._enter(LAGGING,
+                        f"lag {self.stream_lag_ms}ms > "
+                        f"{self.max_staleness_ms}ms")
+        elif self.state == LAGGING \
+                and self.stream_lag_ms <= self.max_staleness_ms:
+            self._enter(STREAMING, "lag back within bound")
+
+    def _stamp(self, now_ms: int, seq: int, epoch: int, action: str,
+               reason: str | None = None) -> None:
+        if self.ledger is not None:
+            self.ledger.append(ReplicaStamp(
+                now_ms=int(now_ms), node=self.node_id, seq=seq,
+                epoch=epoch, action=action, reason=reason))
+
+    # ------------------------------------------------------------- reads
+    def read_refusal(self, now_ms: int | None = None) -> dict | None:
+        """The bounded-staleness read contract: ``None`` when this
+        process may serve reads (leader always; replica while STREAMING
+        within ``max_staleness_ms``), else the refusal descriptor the
+        server maps to 503 + ``Retry-After``. Metered."""
+        if self.role == "leader":
+            return None
+        now = int(now_ms if now_ms is not None else self._now_ms())
+        lag = (max(0, now - self.fresh_ms)
+               if self.fresh_ms is not None else None)
+        if self.state == STREAMING and lag is not None \
+                and lag <= self.max_staleness_ms:
+            return None
+        self._read_refusals.mark()
+        return {"state": self.state, "streamLagMs": lag,
+                "maxStalenessMs": self.max_staleness_ms}
+
+    def to_json(self) -> dict:
+        """The ``replication`` section of ``/devicestats``."""
+        out = {
+            "role": self.role,
+            "state": self.state,
+            "cursor": self.cursor,
+            "streamLagMs": self.stream_lag_ms,
+            "maxStalenessMs": self.max_staleness_ms,
+            "fenceFloor": self.fence_floor,
+            "framesApplied": self._applied.count,
+            "framesSkipped": self._skipped.count,
+            "framesRefusedEpoch": self._refused.count,
+            "resyncs": self._resyncs.count,
+            "pollFailures": self._poll_failures.count,
+            "readRefusals": self._read_refusals.count,
+        }
+        chan_json = getattr(self.channel, "to_json", None)
+        if chan_json is not None:
+            out["channel"] = chan_json()
+        return out

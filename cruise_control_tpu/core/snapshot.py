@@ -256,6 +256,8 @@ class SnapshotManager:
         self._write_failures = self.registry.meter(
             name(g, "write-failure-rate"))
         self._restores = self.registry.counter(name(g, "restores"))
+        self._hook_failures = self.registry.meter(
+            name(g, "on-write-hook-failures"))
         #: one meter per refusal class — the alertable signals an operator
         #: needs to tell "disk bit-rot" from "deploy skew" from "old file"
         self._fallbacks = {
@@ -308,7 +310,12 @@ class SnapshotManager:
             try:
                 hook(now_ms, n)
             except Exception:   # noqa: BLE001 — hooks must not kill writes
-                LOG.warning("snapshot on_write hook failed", exc_info=True)
+                # Metered + named: a dead stream publisher riding this
+                # hook must be an alertable signal, not a silent warning.
+                self._hook_failures.mark()
+                LOG.warning("snapshot on_write hook %r failed",
+                            getattr(hook, "__name__", repr(hook)),
+                            exc_info=True)
         return n
 
     def _note_peer_write(self) -> None:
@@ -429,6 +436,7 @@ class SnapshotManager:
                 "maxAgeMs": self.max_age_ms or None,
                 "writes": self._writes.count,
                 "writeFailures": self._write_failures.count,
+                "onWriteHookFailures": self._hook_failures.count,
                 "restores": self._restores.count,
                 "restoreFallbacks": {r: m.count
                                      for r, m in self._fallbacks.items()},

@@ -117,6 +117,18 @@ class ResidentClusterState:
         self._host: dict[str, np.ndarray] = {}  # host mirrors, by field
         #: bumps on every structural full rebuild; 0 = nothing resident yet
         self.epoch = 0
+        #: counts every state-changing ingest (full rebuild or delta; a
+        #: noop leaves it alone) — the contiguity chain replication
+        #: frames carry (core/replication.py): a replica applies a delta
+        #: only when its own ingest_seq equals the delta's base.
+        self.ingest_seq = 0
+        #: replica-side deltas applied via :meth:`apply_delta`
+        self.applied_deltas = 0
+        #: leader-side capture log for the stream publisher; None until
+        #: :meth:`enable_delta_capture` (the default path pays nothing).
+        self._delta_log: list | None = None
+        self._delta_log_limit = 0
+        self._delta_overflow = False
         self.full_rebuilds = 0
         self.delta_cycles = 0
         self.noop_cycles = 0
@@ -139,6 +151,14 @@ class ResidentClusterState:
                             lambda: self.last_delta_bytes)
 
     # ------------------------------------------------------------- update
+    @property
+    def model(self):
+        """The resident :class:`FlatClusterModel` (None before the first
+        build/restore). The replication follower-serving path reads this
+        directly: on a stream-fed replica the resident state IS the
+        serving model — no local sample history exists to rebuild from."""
+        return self._model
+
     def update(self, arrays: dict[str, np.ndarray]):
         """Fold one assembled cycle into the resident state.
 
@@ -162,6 +182,15 @@ class ResidentClusterState:
     def _full_rebuild(self, arrays: dict[str, np.ndarray]) -> None:
         from .flat import FlatClusterModel
         self.epoch += 1
+        self.ingest_seq += 1
+        if self._delta_log is not None:
+            # A structural rebuild cannot ship as a delta: drop the
+            # pending entries and leave a marker so the publisher tells
+            # followers to resync from the next snapshot.
+            self._delta_log.clear()
+            self._delta_log.append({"structural": True,
+                                    "ingest": self.ingest_seq,
+                                    "epoch": self.epoch})
         self.full_rebuilds += 1
         self._full_counter.inc()
         self._model = FlatClusterModel.from_numpy(mesh=self.mesh, **arrays)
@@ -205,6 +234,20 @@ class ResidentClusterState:
                                           follower_load=new_foll)
         self._host["leader_load"] = lead
         self._host["follower_load"] = foll
+        base = self.ingest_seq
+        self.ingest_seq += 1
+        if self._delta_log is not None:
+            # The padded payload arrays are freshly built and never
+            # mutated after the scatter — safe to share by reference.
+            self._delta_log.append({
+                "structural": False, "baseIngest": base,
+                "ingest": self.ingest_seq, "epoch": self.epoch,
+                "idx": rows.astype(np.int32),
+                "lead": lead_rows[:rows.size],
+                "foll": foll_rows[:rows.size]})
+            while len(self._delta_log) > self._delta_log_limit:
+                self._delta_log.pop(0)
+                self._delta_overflow = True
         self.delta_cycles += 1
         self._delta_counter.inc()
         self.last_update = "delta"
@@ -216,6 +259,78 @@ class ResidentClusterState:
         while k < n:
             k *= 2
         return min(k, padded)
+
+    # ------------------------------------------------- delta streaming
+    def enable_delta_capture(self, limit: int = 64) -> None:
+        """Start logging metric-delta payloads for the replication
+        publisher (core/replication.py). ``limit`` bounds host memory:
+        overflow drops the oldest entries and flags the drain, which the
+        publisher turns into a follower resync marker."""
+        with self._lock:
+            self._delta_log_limit = int(limit)
+            if self._delta_log is None:
+                self._delta_log = []
+
+    def drain_deltas(self) -> tuple[list, bool]:
+        """``(entries, overflowed)``: the captured delta entries since
+        the last drain (ownership transfers to the caller). Entries are
+        ingest-chained dicts — see ``_metric_delta`` / ``_full_rebuild``
+        for the two shapes."""
+        with self._lock:
+            if self._delta_log is None:
+                return [], False
+            entries, self._delta_log = self._delta_log, []
+            overflow, self._delta_overflow = self._delta_overflow, False
+            return entries, overflow
+
+    def apply_delta(self, entry: dict) -> bool:
+        """Replica-side ingest of one streamed delta entry: scatter the
+        rows into the resident device planes and the host mirrors,
+        exactly as the leader's ``_metric_delta`` did. Applies ONLY when
+        contiguous (same epoch, ``baseIngest`` equals this replica's
+        ``ingest_seq``) — anything else returns False and the caller
+        must resync from a full snapshot; a divergent model is never
+        served."""
+        with self._lock:
+            if (self._model is None or entry.get("structural")
+                    or int(entry.get("epoch", -1)) != self.epoch
+                    or int(entry.get("baseIngest", -1)) != self.ingest_seq):
+                return False
+            idx = np.asarray(entry["idx"], np.int32)
+            lead_rows = np.asarray(entry["lead"])
+            foll_rows = np.asarray(entry["foll"])
+            n = int(idx.size)
+            host_lead = self._host["leader_load"]
+            P = host_lead.shape[0]
+            K = self._bucket(n, P)
+            pidx = np.full(K, P, np.int32)
+            pidx[:n] = idx
+            plead = np.zeros((K, lead_rows.shape[1]), host_lead.dtype)
+            plead[:n] = lead_rows
+            pfoll = np.zeros((K, foll_rows.shape[1]),
+                             self._host["follower_load"].dtype)
+            pfoll[:n] = foll_rows
+            self.collector.record_h2d(
+                pidx.nbytes + plead.nbytes + pfoll.nbytes)
+            new_lead, new_foll = self._scatter(
+                self._model.leader_load, self._model.follower_load,
+                pidx, plead, pfoll)
+            self._model = self._model.replace(leader_load=new_lead,
+                                              follower_load=new_foll)
+            # Host mirrors are replaced wholesale, never mutated in
+            # place (snapshot export shares them by reference).
+            for field, rows_arr in (("leader_load", lead_rows),
+                                    ("follower_load", foll_rows)):
+                mirror = self._host[field].copy()
+                mirror[idx] = rows_arr
+                self._host[field] = mirror
+            self.ingest_seq = int(entry["ingest"])
+            self.applied_deltas += 1
+            self.delta_cycles += 1
+            self._delta_counter.inc()
+            self.last_update = "delta"
+            self.last_delta_rows = n
+            return True
 
     # -------------------------------------------------- snapshot/restore
     def export_state(self) -> tuple[int, dict[str, np.ndarray]] | None:
@@ -229,7 +344,8 @@ class ResidentClusterState:
                 return None
             return self.epoch, dict(self._host)
 
-    def restore(self, epoch: int, arrays: dict[str, np.ndarray]) -> None:
+    def restore(self, epoch: int, arrays: dict[str, np.ndarray], *,
+                ingest_seq: int | None = None) -> None:
         """Rebuild the resident device buffers from a snapshot's host
         mirrors. The device model is bit-identical to the pre-crash one
         by construction (``from_numpy`` is deterministic over the same
@@ -243,6 +359,13 @@ class ResidentClusterState:
                                                       **arrays)
             self._host = dict(arrays)
             self.epoch = max(self.epoch, int(epoch))
+            if ingest_seq is not None:
+                # Rejoining a replication stream: the snapshot pins the
+                # contiguity chain position the next delta must extend.
+                self.ingest_seq = int(ingest_seq)
+            if self._delta_log is not None:
+                self._delta_log.clear()
+                self._delta_overflow = False
             self.restores += 1
             self.last_update = "restore"
             self.last_delta_rows = 0
@@ -296,6 +419,8 @@ class ResidentClusterState:
         model = self._model
         out = {
             "epoch": self.epoch,
+            "ingestSeq": self.ingest_seq,
+            "appliedDeltas": self.applied_deltas,
             "fullRebuilds": self.full_rebuilds,
             "deltaCycles": self.delta_cycles,
             "noopCycles": self.noop_cycles,

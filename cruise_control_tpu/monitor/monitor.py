@@ -239,6 +239,11 @@ class LoadMonitor:
                                  collector=self.collector,
                                  tracer=self.tracer, mesh=mesh)
             if (c.resident_state and c.dense_pipeline) else None)
+        #: replication opt-in (facade.attach_replication_channel): when
+        #: the local sample history cannot satisfy a model build, serve
+        #: the stream-fed resident model instead of failing the read —
+        #: the follower serving path (:meth:`_serve_resident`).
+        self.serve_from_resident = False
         # ref LoadMonitor.java:101 cluster-model-creation-timer; the
         # valid-windows / monitored-partitions gauges mirror
         # LoadMonitor.java:104-110 sensor registrations.
@@ -437,6 +442,8 @@ class LoadMonitor:
             except NotEnoughValidWindowsException:
                 stale = self._serve_stale(now_ms, requirements)
                 if stale is None:
+                    stale = self._serve_resident(now_ms, requirements)
+                if stale is None:
                     raise
                 sp.set(stale=True,
                        generation=stale.generation)
@@ -518,6 +525,63 @@ class LoadMonitor:
         # caller who received it fresh — never flip .stale under them.
         result = copy.copy(result)
         result.stale = True
+        return result
+
+    def _serve_resident(self, now_ms: int,
+                        requirements) -> "ClusterModelResult | None":
+        """Follower serving path (core/replication.py): a stream-fed
+        replica has NO local sample history — the replicated
+        device-resident model is its serving state. Build the structural
+        planes from the local admin view (placement-only: zero-load,
+        resident mirrors untouched) and substitute the resident model's
+        arrays, so /load, /partition_load and friends serve the
+        leader's streamed numbers. The result is stale-flagged: reads
+        are bounded by the replication staleness contract instead of
+        local completeness, and the stale-execution gate keeps refusing
+        to ACT on it. Assumes leader and replica watch the SAME cluster
+        (identical sorted partition keys — true by construction for
+        replicas of one serving plane); a topology drift shows up as a
+        shape mismatch and falls through to the completeness error."""
+        res = self.resident
+        if not self.serve_from_resident or res is None \
+                or res.model is None:
+            return None
+        try:
+            result = self._build_model(now_ms, requirements, True)
+        except Exception:
+            return None
+        model = res.model
+        if (tuple(np.asarray(model.replica_broker).shape)
+                != tuple(np.asarray(result.model.replica_broker).shape)):
+            LOG.warning(
+                "resident-serve refused: replicated model shape %s != "
+                "local admin-derived shape %s (topology drift?)",
+                tuple(np.asarray(model.replica_broker).shape),
+                tuple(np.asarray(result.model.replica_broker).shape))
+            return None
+        result.model = model
+        # Patch the replicated loads into the lazy spec view: without
+        # this, /partition_load and other spec consumers would read the
+        # placement-only build's zero loads.
+        base_factory = result._spec_factory
+
+        def patched_spec():
+            spec = base_factory()
+            lead = np.asarray(model.leader_load)
+            foll = np.asarray(model.follower_load)
+            for i, p in enumerate(spec.partitions):
+                p.leader_load = tuple(float(x) for x in lead[i])
+                p.follower_load = tuple(float(x) for x in foll[i])
+            return spec
+
+        result._spec_factory = patched_spec
+        result._spec = None
+        result.stale = True
+        self._stale_served.mark()
+        self._last_model_stale = True
+        LOG.debug("serving resident-backed model (replication follower "
+                  "path): generation %d, epoch %d", result.generation,
+                  res.epoch)
         return result
 
     def _build_model(self, now_ms, requirements, placement_only):

@@ -192,6 +192,36 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             f"{config.get_int('webserver.http.port')}-{_os.getpid()}")
         facade.attach_elector(LeaderElector(
             admin, identity, lease_ms=config.get_long("ha.lease.ms")))
+        # Snapshot-delta streaming to read replicas (core/replication.py;
+        # docs/operations.md §Replication): the leader publishes resident
+        # deltas into the local ring (served at /replication_stream);
+        # with a peer endpoint configured this node follows it while
+        # standing by. Full snapshots stay the bootstrap/RESYNC path, so
+        # snapshot.path is required.
+        if config.get_boolean("replication.enabled"):
+            if not snap_path:
+                raise ValueError(
+                    "replication.enabled requires snapshot.path (full "
+                    "snapshots are the bootstrap/resync path)")
+            from .core.replication import (DualChannel,
+                                           HttpReplicationClient,
+                                           ReplicationChannel)
+            ring = ReplicationChannel(
+                capacity=config.get_int("replication.buffer.frames"))
+            channel = ring
+            peer = config.get_string("replication.leader.endpoint")
+            if peer:
+                peer_host, _, peer_port = peer.rpartition(":")
+                channel = DualChannel(ring, HttpReplicationClient(
+                    peer_host or "127.0.0.1", int(peer_port)))
+            facade.attach_replication_channel(
+                channel, node_id=identity,
+                max_staleness_ms=config.get_long(
+                    "replication.max.staleness.ms"),
+                poll_wait_ms=config.get_long("replication.poll.wait.ms"))
+    elif config.get_boolean("replication.enabled"):
+        raise ValueError("replication.enabled requires ha.enabled (the "
+                         "stream's roles come from the leader elector)")
 
     # ref self.healing.goals + the reference's startup sanity check
     # (KafkaCruiseControlConfig sanityCheckGoalNames): a configured
@@ -428,7 +458,12 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         parameter_overrides=parameter_overrides,
         engine=config.get_string("webserver.engine"),
         max_block_time_ms=config.get_long(
-            "webserver.request.maxBlockTimeMs"))
+            "webserver.request.maxBlockTimeMs"),
+        admission_rate_per_s=(
+            config.get_double("admission.principal.rate.per.sec")
+            if config.get_boolean("admission.rate.limit.enabled")
+            else None),
+        admission_burst=config.get_int("admission.principal.burst"))
 
 
 class _AgentPipelineSampler:

@@ -92,11 +92,16 @@ while true; do
     # render): host-side HTTP serving with the device idle — cheap, so
     # it rides early in the ladder and certifies the 0-dispatch gate on
     # whatever backend the tunnel exposes.
-    for spec in 2 9 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 10 = the replicated serving plane (leader + 2 snapshot-delta
+    # streaming read replicas vs the leader alone): host-side like 9 —
+    # replica processes pin to CPU — so it rides right behind it; the
+    # >= 1.8x fan-out gate and the bounded-staleness readout both run
+    # at bench scale here.
+    for spec in 2 9 10 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
         2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7) tmo=4800 ;;
-        9) tmo=1800 ;;
+        9|10) tmo=1800 ;;
         4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac

@@ -1,0 +1,242 @@
+"""Write-path admission control tests: per-principal token buckets in
+isolation, the 429 + ``Retry-After`` contract over real HTTP, the
+queue-full shed-and-drain path, and the multi-threaded POST overload
+hammer (the serving plane must shed with 429s — never a 5xx — and the
+user-task queue must stay bounded throughout)."""
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import pytest
+
+from cruise_control_tpu.api import (BasicSecurityProvider, CruiseControlApp,
+                                    KafkaCruiseControl, Role)
+from cruise_control_tpu.api.admission import (AdmissionController,
+                                              AdmissionLimitError)
+from cruise_control_tpu.executor import SimulatedKafkaCluster
+from cruise_control_tpu.monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                                        MetricFetcherManager, MonitorConfig,
+                                        SyntheticWorkloadSampler)
+
+WINDOW_MS = 1000
+
+
+# ------------------------------------------------------ controller unit
+def test_per_principal_bucket_isolation():
+    ctrl = AdmissionController(rate_per_s=1.0, burst=2, now_ms=lambda: 0)
+    ctrl.admit("alice")
+    ctrl.admit("alice")
+    with pytest.raises(AdmissionLimitError) as err:
+        ctrl.admit("alice")
+    assert err.value.principal == "alice"
+    assert err.value.retry_after_s >= 1
+    # alice's flood spent only alice's tokens: bob admits at the same
+    # instant, twice, untouched
+    ctrl.admit("bob")
+    ctrl.admit("bob")
+    json_state = ctrl.to_json()
+    assert json_state["admitted"] == 4 and json_state["throttled"] == 1
+
+
+def test_retry_after_is_the_bucket_refill_time():
+    ctrl = AdmissionController(rate_per_s=0.5, burst=1, now_ms=lambda: 0)
+    ctrl.admit("p")
+    with pytest.raises(AdmissionLimitError) as err:
+        ctrl.admit("p")
+    # one whole token at 0.5/s is 2s away; Retry-After is its ceiling
+    assert err.value.retry_after_s == 2
+
+
+def test_bucket_refills_continuously():
+    now = [0]
+    ctrl = AdmissionController(rate_per_s=2.0, burst=1,
+                               now_ms=lambda: now[0])
+    ctrl.admit("p")
+    with pytest.raises(AdmissionLimitError):
+        ctrl.admit("p")
+    now[0] = 600    # 0.6s * 2/s = 1.2 tokens accrued
+    ctrl.admit("p")
+
+
+def test_principal_map_is_lru_bounded():
+    ctrl = AdmissionController(rate_per_s=1.0, burst=1, max_principals=4,
+                               now_ms=lambda: 0)
+    for i in range(10):
+        ctrl.admit(f"p{i}")
+    assert ctrl.to_json()["principals"] == 4
+    # p0 was evicted: it re-enters with a FRESH bucket (the bound trades
+    # a little forgiveness for bounded memory), so this admits
+    ctrl.admit("p0")
+
+
+def test_admission_rate_must_be_positive():
+    with pytest.raises(ValueError):
+        AdmissionController(rate_per_s=0)
+
+
+# ------------------------------------------------------------ http layer
+def build_app(*, admission_rate_per_s=None, admission_burst=None,
+              max_active_tasks=None, security=None):
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b, rate_mb_s=10_000.0)
+    for p in range(6):
+        sim.add_partition("t0", p, [p % 3, (p + 1) % 3], size_mb=10.0)
+    monitor = LoadMonitor(sim, MonitorConfig(
+        num_windows=4, window_ms=WINDOW_MS, min_samples_per_window=1))
+    runner = LoadMonitorTaskRunner(
+        monitor, MetricFetcherManager(SyntheticWorkloadSampler(sim)),
+        sampling_interval_ms=WINDOW_MS)
+    runner.start(-1, skip_loading=True)
+    for w in range(4):
+        assert runner.maybe_run_sampling((w + 1) * WINDOW_MS - 1)
+    facade = KafkaCruiseControl(sim, monitor, task_runner=runner,
+                                now_ms=lambda: 4 * WINDOW_MS)
+    app = CruiseControlApp(facade, port=0, security=security,
+                           admission_rate_per_s=admission_rate_per_s,
+                           admission_burst=admission_burst,
+                           max_active_tasks=max_active_tasks)
+    app.start()
+    return app
+
+
+def auth(user):
+    tok = base64.b64encode(f"{user}:pw".encode()).decode()
+    return {"Authorization": f"Basic {tok}"}
+
+
+def call(app, method, endpoint, params="", headers=None):
+    url = f"http://127.0.0.1:{app.port}/kafkacruisecontrol/{endpoint}"
+    if params and method == "GET":
+        url += f"?{params}"
+    data = params.encode() if method == "POST" else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, json.loads(resp.read()), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}"), dict(e.headers)
+
+
+USERS = {u: ("pw", Role.ADMIN)
+         for u in ["alice", "bob"] + [f"u{i}" for i in range(8)]}
+
+
+@pytest.fixture(scope="module")
+def throttle_app():
+    app = build_app(admission_rate_per_s=2.0, admission_burst=3,
+                    security=BasicSecurityProvider(dict(USERS)))
+    yield app
+    app.stop()
+
+
+def test_post_flood_sheds_429_with_retry_after(throttle_app):
+    app = throttle_app
+    statuses = []
+    throttled_headers, throttled_body = None, None
+    for i in range(10):
+        ep = "pause_sampling" if i % 2 == 0 else "resume_sampling"
+        status, body, hdrs = call(app, "POST", ep, headers=auth("alice"))
+        statuses.append(status)
+        if status == 429 and throttled_headers is None:
+            throttled_headers, throttled_body = hdrs, body
+    assert 200 in statuses and 429 in statuses
+    assert set(statuses) <= {200, 429}       # shedding is never a 5xx
+    assert int(throttled_headers["Retry-After"]) >= 1
+    assert "alice" in throttled_body["errorMessage"]
+    # bob's bucket is untouched by alice's flood
+    status, _, _ = call(app, "POST", "resume_sampling", headers=auth("bob"))
+    assert status == 200
+
+
+def test_reads_are_never_admission_gated(throttle_app):
+    app = throttle_app
+    # empty alice's bucket with POSTs...
+    while call(app, "POST", "resume_sampling",
+               headers=auth("alice"))[0] == 200:
+        pass
+    # ...reads still serve: GETs scale through the cache/replica tier,
+    # only the write path sheds
+    status, body, _ = call(app, "GET", "state", "substates=monitor",
+                           headers=auth("alice"))
+    assert status == 200 and "MonitorState" in body
+
+
+def test_queue_full_sheds_429_then_drains():
+    app = build_app(max_active_tasks=1)
+    try:
+        gate = threading.Event()
+        app.tasks.submit("rebalance", "rebalance", lambda p: gate.wait(30))
+        # the one active slot is held: a new async POST sheds at submit
+        # time — before any work is scheduled — as a retryable 429
+        status, body, hdrs = call(app, "POST", "rebalance",
+                                  "dryrun=true&get_response_timeout_s=0.01")
+        assert status == 429
+        assert int(hdrs["Retry-After"]) >= 1
+        assert "too many active user tasks" in body["errorMessage"]
+        gate.set()
+        deadline = time.monotonic() + 10
+        while app.tasks.active_count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert app.tasks.active_count() == 0
+        # drained: async submissions flow again
+        status, body, _ = call(app, "GET", "bootstrap", "start=0&end=0")
+        assert status == 200 and "bootstrapped" in body["message"]
+    finally:
+        app.stop()
+
+
+def test_overload_hammer_zero_5xx_and_bounded_queue():
+    """8 concurrent writers flooding the POST surface: every response is
+    an admission (200) or a shed (429 + Retry-After) — never a 5xx — and
+    the user-task queue never exceeds its cap."""
+    app = build_app(admission_rate_per_s=5.0, admission_burst=3,
+                    security=BasicSecurityProvider(dict(USERS)))
+    try:
+        max_active_seen = [0]
+        stop = threading.Event()
+
+        def watch_queue():
+            while not stop.is_set():
+                max_active_seen[0] = max(max_active_seen[0],
+                                         app.tasks.active_count())
+                time.sleep(0.005)
+
+        watcher = threading.Thread(target=watch_queue, daemon=True)
+        watcher.start()
+
+        def hammer(worker):
+            out = []
+            hdr = auth(f"u{worker}")
+            for i in range(25):
+                ep = ("pause_sampling" if (worker + i) % 2 == 0
+                      else "resume_sampling")
+                status, body, hdrs = call(app, "POST", ep, headers=hdr)
+                out.append((status, hdrs.get("Retry-After")))
+            return out
+
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            results = [r for f in [pool.submit(hammer, w) for w in range(8)]
+                       for r in f.result()]
+        stop.set()
+        watcher.join(timeout=2)
+
+        statuses = [s for s, _ in results]
+        assert len(statuses) == 200
+        assert set(statuses) <= {200, 429}, f"5xx under overload: {statuses}"
+        assert statuses.count(429) > 0       # the flood WAS shed
+        assert statuses.count(200) >= 8      # every principal got burst
+        assert all(ra is not None and int(ra) >= 1
+                   for s, ra in results if s == 429)
+        assert max_active_seen[0] <= app.tasks.max_active_tasks
+        admission = app.admission.to_json()
+        assert admission["admitted"] + admission["throttled"] == 200
+        assert admission["principals"] == 8
+    finally:
+        app.stop()

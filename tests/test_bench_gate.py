@@ -250,6 +250,35 @@ def test_snapshot_restore_bench_smoke_gate():
 
 
 @pytest.mark.slow
+def test_replica_fanout_bench_smoke_gate():
+    """run_replica_fanout_bench on a toy cluster with ONE replica
+    process: exercises the whole scenario-10 harness end-to-end —
+    snapshot bootstrap in a spawned process, HTTP delta streaming until
+    STREAMING, per-node client processes, leader-only vs fan-out phases
+    — with its always-on gates (zero 5xx including bounded-staleness
+    503s in every counted window, replica still STREAMING with
+    framesApplied > 0 and streamLagMs within bound after the measured
+    window; the helper raises on any breach). The >= 1.8x fan-out gate
+    is judged at bench scale with 2 replicas only (gate=False here — a
+    single toy replica plus process-spawn jitter says nothing about
+    scaling). Marked slow: it spawns replica + client processes (each a
+    fresh CPU-pinned interpreter) and runs ~2 s of closed-loop HTTP."""
+    import bench
+    out = bench.run_replica_fanout_bench(
+        num_brokers=6, num_partitions=60, replicas=1, threads=2,
+        duration_s=1.0, goal_names=["ReplicaDistributionGoal"],
+        emit_row=False, gate=False)
+    assert out["replicas"] == 1
+    assert out["leader_only_rps"] > 0 and out["fanout_rps"] > 0
+    assert out["speedup"] is not None and out["speedup"] > 0
+    rep = out["replication"][0]
+    assert rep["state"] == "STREAMING"
+    assert rep["framesApplied"] > 0
+    assert rep["streamLagMs"] <= rep["maxStalenessMs"]
+    assert out["max_stream_lag_ms"] <= 10_000
+
+
+@pytest.mark.slow
 def test_api_throughput_bench_smoke_gate():
     """run_api_throughput_bench on a toy cluster: exercises the full
     serving A/B harness end-to-end (baseline render-per-request phase,

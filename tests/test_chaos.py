@@ -759,6 +759,96 @@ def test_standby_serves_warm_reads_refuses_execution(optimizer, chaos_seed,
     assert sh.facade.rebalance(dryrun=True) is not None   # reads served
 
 
+def test_replicated_midstream_leader_kill(optimizer, chaos_seed, tmp_path):
+    """The replicated-serving-plane gate: leader + two stream-fed read
+    replicas, the stream severed at the instant the leader dies. Proves
+    via the stream ledger that (a) no deposed epoch's delta is ever
+    folded into replica state — a straggler frame from the dead reign is
+    refused by fence floor; (b) failover promotes exactly one writer;
+    (c) replicas transition to LAGGING and refuse gated reads while the
+    stream is down, and reconverge to STREAMING within the staleness
+    bound once it is restored."""
+    from cruise_control_tpu.chaos import (HAFailoverHarness,
+                                          check_fencing_invariants,
+                                          check_replication_invariants)
+    seed = _pick(chaos_seed, 33)
+    ha = HAFailoverHarness(seed=seed, snapshot_dir=str(tmp_path),
+                           optimizer=optimizer, processes=("a", "b", "c"),
+                           replication=True, max_staleness_ms=2000)
+    for _ in range(12):
+        ha.step()
+    leader = ha.leader()
+    assert leader is not None
+    replicas = sorted(n for n in ha.procs if n != leader)
+    for name in replicas:
+        sess = ha.procs[name].facade.replication
+        assert sess.state == "STREAMING"
+        assert sess.read_refusal() is None
+    assert any(s.action == "applied" for s in ha.delta_stamps), \
+        "stream must be flowing before the kill"
+
+    # Sever the transport at the same instant the leader dies (a real
+    # leader crash cuts its /replication_stream connections too).
+    old_epoch = ha.procs[leader].facade.elector.epoch
+    ha.engine.schedule(ha.engine.step + 1, "cut_stream")
+    ha.step()
+    ha.kill(leader)
+
+    # While the stream is down, lag outgrows the bound: replicas go
+    # LAGGING and refuse the gated reads — never serve beyond staleness.
+    lagged = False
+    for _ in range(6):
+        ha.step()
+        for name in replicas:
+            sess = ha.procs[name].facade.replication
+            if sess.role == "standby" and sess.read_refusal() is not None:
+                lagged = True
+    assert lagged, "cut stream must push replicas past the staleness bound"
+
+    # Failover: exactly one successor, under a strictly higher epoch.
+    ha.steps_until(lambda: ha.leader() is not None, 30, what="failover")
+    new_leader = ha.leader()
+    assert new_leader != leader
+    new_epoch = ha.procs[new_leader].facade.elector.epoch
+    assert new_epoch > old_epoch
+    live_leading = [n for n, h in ha.procs.items()
+                    if not h.crashed and h.facade.elector.is_leader()]
+    assert live_leading == [new_leader]
+
+    # Transport restored: the surviving follower reconverges and the new
+    # reign's frames start applying under the higher epoch.
+    ha.engine.schedule(ha.engine.step + 1, "cut_stream", on=False)
+    follower = next(n for n in replicas if n != new_leader)
+    fs = ha.procs[follower].facade.replication
+    ha.steps_until(lambda: fs.state == "STREAMING"
+                   and fs.read_refusal() is None, 30,
+                   what="follower reconvergence")
+    ha.steps_until(lambda: any(s.action == "applied"
+                               and s.epoch >= new_epoch
+                               for s in ha.delta_stamps), 30,
+                   what="new reign streaming")
+
+    # A straggler frame from the deposed reign finally flushes out of
+    # the dead leader's socket buffer: the follower must refuse it by
+    # epoch — ledgered, never applied.
+    ha.channel.publish({"fencingEpoch": old_epoch, "node": leader,
+                        "clusterId": "stale", "clocks": {}},
+                       ha.engine.now_ms())
+    for _ in range(3):
+        ha.step()
+    assert any(s.action == "refused-epoch" for s in ha.delta_stamps), \
+        "deposed straggler frame must be refused by the fence floor"
+
+    problems = (check_replication_invariants(ha.delta_stamps)
+                + check_fencing_invariants(ha.stamps))
+    assert not problems, (
+        f"replicated failover invariants violated (seed={seed}):\n  "
+        + "\n  ".join(problems)
+        + "\n" + _repro("test_replicated_midstream_leader_kill", seed))
+    assert fs.read_refusal() is None
+    assert fs.stream_lag_ms <= fs.max_staleness_ms
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", SOAK_SEEDS[:10])
 def test_crash_failover_soak(optimizer, chaos_seed, seed, tmp_path):

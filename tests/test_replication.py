@@ -1,0 +1,321 @@
+"""Replication-plane unit tests: the frame ring, the wire codec, and the
+SYNCING -> STREAMING -> LAGGING -> RESYNC follower state machine — all
+with trivial fakes (the ReplicationSession constructor takes narrow
+callables precisely so these tests need no facade, no JAX model, no
+HTTP). The multi-process end-to-end path is covered by
+tests/test_chaos.py (mid-stream leader kill) and the scenario-10 bench
+smoke in tests/test_bench_gate.py."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.core.replication import (
+    LAGGING, RESYNC, STREAMING, SYNCING, DualChannel, PollResult,
+    ReplicationChannel, ReplicationSession, decode_stream_payload,
+    encode_stream_payload)
+
+
+class Faults:
+    """Stand-in for the chaos engine's fault surface."""
+
+    def __init__(self):
+        self.stream_cut = False
+        self.stream_delay_ms = 0
+
+
+# --------------------------------------------------------------- channel
+def test_channel_publish_assigns_seq_and_stamp():
+    ch = ReplicationChannel(capacity=8)
+    assert ch.publish({"a": 1}, 100) == 1
+    assert ch.publish({"a": 2}, 200) == 2
+    res = ch.poll(0, 300)
+    assert [f["seq"] for f in res.frames] == [1, 2]
+    assert [f["stampMs"] for f in res.frames] == [100, 200]
+    assert res.head_seq == 2 and res.base_seq == 1
+    assert not res.reset
+
+
+def test_channel_poll_from_cursor_and_reset_after_overflow():
+    ch = ReplicationChannel(capacity=2)
+    for i in range(5):
+        ch.publish({"i": i}, 1000 + i)
+    # capacity 2: only seqs 4, 5 retained
+    assert ch.base_seq == 4 and ch.head_seq == 5
+    res = ch.poll(4, 2000)
+    assert [f["seq"] for f in res.frames] == [4, 5] and not res.reset
+    # a cursor that fell below the ring base is a hole -> reset
+    res = ch.poll(2, 2000)
+    assert res.reset
+    # cursor <= 0 means "from the base" (post-resync rejoin), never reset
+    res = ch.poll(0, 2000)
+    assert not res.reset and [f["seq"] for f in res.frames] == [4, 5]
+
+
+def test_channel_cut_stream_is_no_contact():
+    faults = Faults()
+    ch = ReplicationChannel(capacity=8, fault_source=faults)
+    ch.publish({}, 100)
+    assert ch.poll(0, 200) is not None
+    faults.stream_cut = True
+    assert ch.poll(0, 200) is None
+    assert ch.to_json()["pollsDropped"] == 1
+    faults.stream_cut = False
+    assert ch.poll(0, 200) is not None
+
+
+def test_channel_delay_withholds_until_old_enough():
+    faults = Faults()
+    ch = ReplicationChannel(capacity=8, fault_source=faults)
+    ch.publish({"n": 1}, 1000)
+    ch.publish({"n": 2}, 1500)
+    faults.stream_delay_ms = 400
+    res = ch.poll(0, 1600)
+    # only the frame stamped 1000 is >= 400ms old; head_seq still shows
+    # the withheld frame so a follower can tell stalled from caught-up
+    assert [f["n"] for f in res.frames] == [1]
+    assert res.head_seq == 2
+    res = ch.poll(0, 1900)
+    assert [f["n"] for f in res.frames] == [1, 2]
+
+
+def test_stream_payload_roundtrip_with_arrays():
+    frames = [{"seq": 7, "stampMs": 123, "idx": np.arange(4, dtype=np.int64),
+               "rows": np.ones((4, 3), dtype=np.float64)}]
+    res = PollResult(frames=frames, head_seq=7, base_seq=3, now_ms=456,
+                     reset=False)
+    out = decode_stream_payload(encode_stream_payload(res))
+    assert out.head_seq == 7 and out.base_seq == 3
+    assert out.now_ms == 456 and out.reset is False
+    np.testing.assert_array_equal(out.frames[0]["idx"], frames[0]["idx"])
+    np.testing.assert_array_equal(out.frames[0]["rows"], frames[0]["rows"])
+
+
+def test_stream_payload_refuses_arbitrary_globals():
+    # the stream shares the snapshot's restricted-unpickler trust
+    # boundary: a payload smuggling a code object must not load
+    evil = pickle.dumps({"frames": [{"f": print}], "headSeq": 1,
+                         "baseSeq": 1, "nowMs": 0, "reset": False})
+    with pytest.raises(Exception):
+        decode_stream_payload(evil)
+
+
+def test_dual_channel_routes_publish_local_poll_remote():
+    ring = ReplicationChannel(capacity=8)
+    polled = []
+
+    class FakeClient:
+        host, port = "peer", 9090
+
+        def poll(self, cursor, now_ms, wait_ms=0):
+            polled.append((cursor, now_ms, wait_ms))
+            return PollResult(frames=[], head_seq=0, base_seq=1,
+                              now_ms=now_ms, reset=False)
+
+    dual = DualChannel(ring, FakeClient())
+    assert dual.publish({"x": 1}, 100) == 1
+    assert ring.head_seq == 1            # publish went to the local ring
+    res = dual.poll(5, 200, wait_ms=50)  # poll went to the peer client
+    assert polled == [(5, 200, 50)] and res.head_seq == 0
+    assert dual.to_json()["peer"] == "peer:9090"
+
+
+# --------------------------------------------------------------- session
+def make_follower(channel, *, node="r1", ledger=None, max_staleness_ms=500,
+                  apply_outcome="applied", resync_as_of=None,
+                  on_fence=None):
+    """A follower session over scripted fakes. ``resync_as_of`` is a
+    mutable list: pop-from-front per resync() call (empty -> None)."""
+    applied = []
+    as_of = list(resync_as_of or [])
+
+    def apply_frame(frame):
+        applied.append(frame)
+        return apply_outcome() if callable(apply_outcome) else apply_outcome
+
+    session = ReplicationSession(
+        node_id=node, channel=channel, clocks=lambda: {},
+        build_frame=lambda: None, fencing_epoch=lambda: 0,
+        apply_frame=apply_frame,
+        resync=lambda: as_of.pop(0) if as_of else None,
+        max_staleness_ms=max_staleness_ms, ledger=ledger,
+        on_fence=on_fence)
+    session.applied_frames = applied
+    return session
+
+
+def test_leader_publishes_exactly_when_clocks_move():
+    ch = ReplicationChannel(capacity=8)
+    clocks = {"generation": 1}
+    built = []
+
+    def build_frame():
+        built.append(dict(clocks))
+        return {"payload": len(built)}
+
+    session = ReplicationSession(
+        node_id="leader", channel=ch, clocks=lambda: dict(clocks),
+        build_frame=build_frame, fencing_epoch=lambda: 3,
+        apply_frame=lambda f: "applied", resync=lambda: None)
+    session.tick(1000, "leader")
+    assert session.role == "leader" and session.state == STREAMING
+    assert ch.head_seq == 1
+    # unchanged clocks: no new frame, however many ticks
+    session.tick(1100, "leader")
+    session.tick(1200, "leader")
+    assert ch.head_seq == 1 and len(built) == 1
+    clocks["generation"] = 2
+    session.tick(1300, "leader")
+    assert ch.head_seq == 2
+    frame = ch.poll(2, 2000).frames[0]
+    assert frame["fencingEpoch"] == 3
+    assert frame["clocks"] == {"generation": 2}
+    assert frame["node"] == "leader" and frame["stampMs"] == 1300
+    # the leader is always fresh and always serves reads
+    assert session.stream_lag_ms == 0
+    assert session.read_refusal(now_ms=99_999) is None
+
+
+def test_leader_nothing_to_say_records_clocks_without_frame():
+    ch = ReplicationChannel(capacity=8)
+    session = ReplicationSession(
+        node_id="leader", channel=ch, clocks=lambda: {"g": 1},
+        build_frame=lambda: None, fencing_epoch=lambda: 0,
+        apply_frame=lambda f: "applied", resync=lambda: None)
+    session.tick(1000, "leader")
+    session.tick(1100, "leader")
+    assert ch.head_seq == 0
+
+
+def test_follower_syncing_to_streaming_and_applies_in_order():
+    ch = ReplicationChannel(capacity=8)
+    ledger = []
+    follower = make_follower(ch, ledger=ledger, resync_as_of=[900])
+    # no snapshot yet -> stays SYNCING, refuses reads
+    no_snap = make_follower(ch, node="r0")
+    no_snap.tick(1000, "standby")
+    assert no_snap.state == SYNCING
+    assert no_snap.read_refusal(now_ms=1000) == {
+        "state": SYNCING, "streamLagMs": None, "maxStalenessMs": 500}
+
+    follower.tick(1000, "standby")
+    assert follower.state == STREAMING
+    assert follower.fresh_ms == 1000  # caught up: fresh as of poll time
+    assert ledger[0].action == "resync" and ledger[0].seq == -1
+    ch.publish({"n": 1}, 1050)
+    ch.publish({"n": 2}, 1060)
+    follower.tick(1100, "standby")
+    assert [f["n"] for f in follower.applied_frames] == [1, 2]
+    assert follower.cursor == 3
+    assert follower.fresh_ms == 1100   # applied through head -> poll time
+    assert [s.action for s in ledger] == ["resync", "applied", "applied"]
+    assert [s.seq for s in ledger] == [-1, 1, 2]
+    assert follower.read_refusal(now_ms=1200) is None
+    json = follower.to_json()
+    assert json["state"] == STREAMING and json["framesApplied"] == 2
+
+
+def test_follower_lags_on_cut_and_recovers():
+    faults = Faults()
+    ch = ReplicationChannel(capacity=8, fault_source=faults)
+    follower = make_follower(ch, resync_as_of=[1000], max_staleness_ms=500)
+    follower.tick(1000, "standby")
+    assert follower.state == STREAMING
+    faults.stream_cut = True
+    follower.tick(1300, "standby")
+    assert follower.state == STREAMING      # within bound, just stale
+    assert follower.stream_lag_ms == 300
+    follower.tick(1600, "standby")          # 600ms > 500ms bound
+    assert follower.state == LAGGING
+    refusal = follower.read_refusal(now_ms=1600)
+    assert refusal["state"] == LAGGING and refusal["streamLagMs"] == 600
+    assert refusal["maxStalenessMs"] == 500
+    assert follower.to_json()["pollFailures"] == 2
+    faults.stream_cut = False
+    follower.tick(1700, "standby")          # contact again: fresh now
+    assert follower.state == STREAMING
+    assert follower.read_refusal(now_ms=1700) is None
+
+
+def test_follower_resyncs_when_cursor_falls_off_ring():
+    ch = ReplicationChannel(capacity=2)
+    ledger = []
+    follower = make_follower(ch, ledger=ledger,
+                             resync_as_of=[1000, 2000])
+    follower.tick(1000, "standby")
+    assert follower.state == STREAMING and follower.cursor == 1
+    for i in range(5):                      # evicts seqs 1-3 unseen
+        ch.publish({"i": i}, 1100 + i)
+    follower.tick(1200, "standby")
+    assert follower.state == RESYNC
+    assert follower.applied_frames == []    # nothing applied over a hole
+    follower.tick(1300, "standby")          # snapshot restore + rejoin
+    assert follower.state == STREAMING
+    assert [f["i"] for f in follower.applied_frames] == [3, 4]
+    assert follower.to_json()["resyncs"] == 2
+    assert [s.action for s in ledger] == [
+        "resync", "resync", "applied", "applied"]
+
+
+def test_follower_resyncs_on_non_contiguous_apply():
+    ch = ReplicationChannel(capacity=8)
+    ledger = []
+    outcomes = iter(["applied", "resync", "applied", "applied", "applied"])
+    follower = make_follower(ch, ledger=ledger,
+                             apply_outcome=lambda: next(outcomes),
+                             resync_as_of=[1000, 2000])
+    follower.tick(1000, "standby")
+    for i in range(3):
+        ch.publish({"i": i}, 1100 + i)
+    follower.tick(1200, "standby")
+    # frame 1 applied, frame 2 gapped -> RESYNC, frame 3 NOT attempted
+    assert follower.state == RESYNC
+    assert len(follower.applied_frames) == 2
+    follower.tick(1300, "standby")
+    assert follower.state == STREAMING
+    # post-resync rejoin replays from the ring base: seq 3 now lands
+    assert follower.applied_frames[-1]["i"] == 2
+    actions = [s.action for s in ledger]
+    assert actions == ["resync", "applied", "resync", "resync",
+                       "applied", "applied", "applied"]
+
+
+def test_fence_floor_refuses_deposed_leader_frames():
+    ch = ReplicationChannel(capacity=8)
+    ledger = []
+    fenced = []
+    follower = make_follower(ch, ledger=ledger, resync_as_of=[1000],
+                             on_fence=fenced.append)
+    follower.tick(1000, "standby")
+    ch.publish({"fencingEpoch": 2, "n": "new-leader"}, 1100)
+    ch.publish({"fencingEpoch": 1, "n": "deposed"}, 1110)
+    ch.publish({"fencingEpoch": 2, "n": "new-leader-2"}, 1120)
+    follower.tick(1200, "standby")
+    # the epoch-1 frame is dead, not pending: refused, cursor advanced
+    assert [f["n"] for f in follower.applied_frames] == [
+        "new-leader", "new-leader-2"]
+    assert follower.cursor == 4
+    assert follower.fence_floor == 2
+    assert fenced == [2]                    # raised once, fed to elector
+    stamps = {s.seq: s.action for s in ledger if s.seq > 0}
+    assert stamps == {1: "applied", 2: "refused-epoch", 3: "applied"}
+    assert follower.to_json()["framesRefusedEpoch"] == 1
+
+
+def test_promotion_and_demotion_reset_stream_position():
+    ch = ReplicationChannel(capacity=8)
+    follower = make_follower(ch, resync_as_of=[1000, 2000])
+    follower.tick(1000, "standby")
+    ch.publish({"i": 0}, 1050)
+    follower.tick(1100, "standby")
+    assert follower.cursor == 2
+    follower.tick(1200, "leader")
+    assert follower.role == "leader" and follower.state == STREAMING
+    # deposed: rejoin the stream from scratch off the new leader's base
+    follower.tick(1300, "standby")
+    assert follower.role == "standby"
+    assert follower.state in (SYNCING, STREAMING)
+    assert follower.cursor in (0, 2)        # reset, then resync rejoined
+    transitions = follower.to_json()
+    assert transitions["resyncs"] == 2
