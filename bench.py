@@ -1039,6 +1039,318 @@ def run_chaos_recovery_bench(*, seed: int = 11, emit_row: bool = True,
     return {"steps": steps, "seed": seed, "wall_s": wall_s}
 
 
+class _LatencyAdmin:
+    """Admin proxy charging a fixed wall-clock RTT per RPC against the
+    simulated cluster. The latency burns OUTSIDE the lock (network time —
+    the part a pipelined executor can overlap); the sim call itself is
+    serialized (the sim is not thread-safe). With the executor's
+    ``sleep_ms`` bound to the sim clock (near-zero wall), measured wall
+    time is (RPC rounds x RTT) minus whatever the pipeline overlaps —
+    exactly the quantity scenario 11 compares."""
+
+    concurrent_safe = True
+
+    def __init__(self, sim, latency_s: float):
+        self._sim = sim
+        self._latency_s = latency_s
+        self._latency_lock = threading.Lock()
+        self.calls = 0
+
+    def __getattr__(self, name):
+        inner = getattr(self._sim, name)
+        if not callable(inner):
+            return inner
+
+        def call(*args, **kwargs):
+            time.sleep(self._latency_s)
+            with self._latency_lock:
+                self.calls += 1
+                return inner(*args, **kwargs)
+        return call
+
+
+class _BenchFlippingFence:
+    """Elector stand-in deposing the executor after N fence checks —
+    mid-pipeline, between batch admission and completion."""
+
+    def __init__(self, flips_after: int):
+        self.epoch = 7
+        self._checks = 0
+        self._flips_after = flips_after
+
+    def is_current(self, token) -> bool:
+        self._checks += 1
+        return self._checks <= self._flips_after
+
+    def leader_id(self) -> str:
+        return "bench-successor"
+
+
+def run_executor_schedule_bench(*, num_brokers: int = 8,
+                                partitions: int = 48,
+                                size_mb: float = 200.0,
+                                rate_mb_s: float = 25.0,
+                                rpc_latency_ms: float = 4.0,
+                                chaos: bool = True, chaos_seed: int = 11,
+                                chaos_max_steps: int = 200,
+                                emit_row: bool = True,
+                                gate: bool = True) -> dict:
+    """Scenario 11: device-scheduled pipelined execution vs the greedy
+    sequential per-batch executor, identical sim + identical RPC tax.
+
+    Both sides drive the same follower-rotation plan through a
+    ``SimulatedKafkaCluster`` wrapped in :class:`_LatencyAdmin` (fixed
+    wall RTT per admin RPC, sim calls serialized, latency overlappable).
+    Copy time runs on the *sim* clock (free wall), so wall-clock measures
+    exactly what the pipelined phase optimizes: RPC rounds and their
+    overlap. The greedy baseline re-plans per batch and polls every
+    progress interval; the scheduled side admits precomputed batches,
+    skips ETA-covered polls and overlaps the poll round's reads.
+
+    **Gated** (the acceptance bar):
+
+    - ``executor_moves_per_s`` >= 3x the greedy baseline;
+    - zero hard-goal violations at every batch boundary
+      (``unrepaired_violations == 0`` from the on-device audit);
+    - zero warm recompiles across the scheduled run (schedule build +
+      pipelined batches share one compiled program);
+    - scheduled and greedy runs converge to the SAME final placement
+      with zero verify failures;
+    - a mid-pipeline fence flip aborts without cancelling in-flight
+      copies, releases the reservation, and the drained cluster passes
+      ``check_invariants`` (fencing ledger clean);
+    - with ``chaos=True``: ``time_to_balanced_steps`` on the canonical
+      crash-recovery scenario is no worse than greedy, with the device
+      path provably engaged (schedule stats present)."""
+    from cruise_control_tpu.analyzer.goals import goals_by_name
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    from cruise_control_tpu.executor import (
+        ConcurrencyConfig, DeviceMoveScheduler,
+        ExecutionConcurrencyManager, Executor, ExecutorConfig, SimClock,
+        SimulatedKafkaCluster)
+    from cruise_control_tpu.executor.strategy import StrategyContext
+    from cruise_control_tpu.model.proposals import ExecutionProposal
+    from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                               PartitionSpec, flatten_spec)
+
+    def make_sim(size=size_mb, rate=rate_mb_s):
+        sim = SimulatedKafkaCluster()
+        for b in range(num_brokers):
+            sim.add_broker(b, rate_mb_s=rate, logdirs=("logdir0",
+                                                       "logdir1"))
+        for p in range(partitions):
+            sim.add_partition(f"t{p % 4}", p,
+                              [p % num_brokers, (p + 1) % num_brokers],
+                              size_mb=size)
+        return sim
+
+    def rotation(sim):
+        out = []
+        for (topic, part), info in sorted(sim.describe_partitions()
+                                          .items()):
+            reps = list(info.replicas)
+            out.append(ExecutionProposal(
+                topic, part, old_leader=info.leader,
+                old_replicas=tuple(reps),
+                new_replicas=(reps[0], (reps[1] + 1) % num_brokers)))
+        return out
+
+    def make_executor(sim, latency_s):
+        admin = _LatencyAdmin(sim, latency_s)
+        clock = SimClock(sim)
+        cfg = ExecutorConfig(progress_check_interval_ms=100,
+                             min_progress_check_interval_ms=100,
+                             concurrency=cc,
+                             concurrency_adjuster_enabled=False)
+        return Executor(admin, cfg, now_ms=clock.now_ms,
+                        sleep_ms=clock.sleep_ms), admin
+
+    latency_s = rpc_latency_ms / 1000.0
+    cc = ConcurrencyConfig(num_concurrent_partition_movements_per_broker=2)
+    # The audit gates HARD goals (capacity): a rotation plan's transient
+    # replica-count imbalance is inherent to move ordering — no batching
+    # repairs it — while blowing a capacity ceiling mid-plan is exactly
+    # the failure the boundary audit exists to catch. Disk capacity is
+    # sized tight: steady state ~2400 MB/broker, worst legal transient
+    # +2 in-flight copies (the per-broker cap) = ~2800 MB; capacity 4500
+    # at the default 0.8 disk threshold gives a 3600 MB usable ceiling —
+    # clears the legal transient, catches a pile-up.
+    goals = tuple(goals_by_name(["ReplicaCapacityGoal",
+                                 "DiskCapacityGoal"]))
+    sim_a, sim_b = make_sim(), make_sim()
+    props_a, props_b = rotation(sim_a), rotation(sim_b)
+    # Spec mirror of the sim for the boundary hard-goal audit.
+    spec = ClusterSpec(
+        brokers=[BrokerSpec(b, rack=f"r{b}",
+                            capacity=(1e6, 1e6, 1e6, 4500.0))
+                 for b in range(num_brokers)],
+        partitions=[PartitionSpec(t, p, list(info.replicas),
+                                  leader_load=(1.0, 1.0, 1.0, size_mb))
+                    for (t, p), info in
+                    sorted(sim_a.describe_partitions().items())])
+    model, md = flatten_spec(spec)
+    ctx = StrategyContext(partition_size_mb={
+        (p.topic, p.partition): size_mb for p in props_a})
+    throttle = int(rate_mb_s * 1e6)
+    scheduler = DeviceMoveScheduler()
+
+    def build_schedule():
+        return scheduler.schedule(
+            props_a, ExecutionConcurrencyManager(cc), model=model,
+            metadata=md, goals=goals, strategy_context=ctx,
+            throttle_bytes=throttle)
+
+    build_schedule()                 # cold: first-fit + audit compiles
+    collector = default_collector()
+    before = collector.snapshot()
+    ex_a, _ = make_executor(sim_a, latency_s)
+    t0 = time.monotonic()
+    sched = build_schedule()         # warm: in the timed window
+    res_a = ex_a.execute_proposals(props_a, uuid="bench-sched",
+                                   schedule=sched,
+                                   throttle_bytes=throttle)
+    sched_wall = time.monotonic() - t0
+    after = collector.snapshot()
+    recompiles = (after["compileEvents"] + after["aotCompileEvents"]
+                  - before["compileEvents"] - before["aotCompileEvents"])
+    stats = ex_a.last_schedule_stats
+
+    ex_b, _ = make_executor(sim_b, latency_s)
+    t0 = time.monotonic()
+    res_b = ex_b.execute_proposals(props_b, uuid="bench-greedy",
+                                   throttle_bytes=throttle)
+    greedy_wall = time.monotonic() - t0
+
+    moves = sched.num_moves
+    sched_mps = moves / sched_wall if sched_wall > 0 else float("inf")
+    greedy_mps = moves / greedy_wall if greedy_wall > 0 else float("inf")
+    ratio = sched_mps / greedy_mps if greedy_mps > 0 else float("inf")
+    place_a = {tp: tuple(i.replicas)
+               for tp, i in sim_a.describe_partitions().items()}
+    place_b = {tp: tuple(i.replicas)
+               for tp, i in sim_b.describe_partitions().items()}
+    log(f"executor schedule bench: {moves} moves in "
+        f"{len(sched.batches)} batches, rtt {rpc_latency_ms}ms | "
+        f"scheduled {sched_wall:.2f}s ({sched_mps:.1f} mv/s, "
+        f"{stats['polls_skipped']} polls skipped, "
+        f"{stats['overlapped_rounds']} overlapped rounds) vs greedy "
+        f"{greedy_wall:.2f}s ({greedy_mps:.1f} mv/s) -> {ratio:.1f}x")
+    problems = []
+    if not (res_a.succeeded and res_b.succeeded):
+        problems.append("a side failed: scheduled="
+                        f"{res_a.succeeded} greedy={res_b.succeeded}")
+    if place_a != place_b:
+        problems.append("scheduled and greedy final placements diverge")
+    if stats["verify_failures"]:
+        problems.append(f"{stats['verify_failures']} verify failures")
+    if sched.stats["unrepaired_violations"]:
+        problems.append(f"{sched.stats['unrepaired_violations']} "
+                        "hard-goal violations at batch boundaries")
+    if recompiles:
+        problems.append(f"{recompiles} warm recompiles across the "
+                        "scheduled run (expected 0)")
+
+    # Mid-pipeline fence flip: abort without cancel RPCs, reservation
+    # released, ledger + invariants clean once the successor's copies
+    # drain on the sim clock.
+    from cruise_control_tpu.chaos import check_invariants, snapshot_topology
+    sim_f = make_sim(size=500.0, rate=5.0)           # long copies
+    props_f = rotation(sim_f)
+    base_f = snapshot_topology(sim_f)
+    sched_f = scheduler.schedule(props_f, ExecutionConcurrencyManager(cc))
+    clock_f = SimClock(sim_f)
+    ex_f = Executor(sim_f,
+                    ExecutorConfig(progress_check_interval_ms=100,
+                                   concurrency=cc,
+                                   concurrency_adjuster_enabled=False),
+                    now_ms=clock_f.now_ms, sleep_ms=clock_f.sleep_ms)
+    ex_f.fence = _BenchFlippingFence(flips_after=3)
+    ex_f.execute_proposals(props_f, uuid="bench-fence", schedule=sched_f)
+    if ex_f._fencing_aborts.count != 1:
+        problems.append("fence flip did not abort the pipelined phase "
+                        f"exactly once ({ex_f._fencing_aborts.count})")
+    if ex_f.has_ongoing_execution():
+        problems.append("reservation still held after fenced abort")
+    if not sim_f.list_partition_reassignments():
+        problems.append("fenced abort cancelled in-flight reassignments "
+                        "(they belong to the successor)")
+    for _ in range(400):                             # drain on sim time
+        clock_f.sleep_ms(1000)
+        if not sim_f.list_partition_reassignments():
+            break
+    problems += check_invariants(sim_f, base_f, ex_f)
+
+    # Chaos comparison: canonical crash-recovery scenario, greedy vs
+    # device-scheduled facade path; steps-to-balanced must not regress.
+    steps_greedy = steps_sched = None
+    if chaos:
+        from cruise_control_tpu.chaos import ChaosHarness
+
+        def chaos_steps(device_scheduling):
+            h = ChaosHarness(seed=chaos_seed)
+            h.executor.config.device_scheduling = device_scheduling
+            base = snapshot_topology(h.sim)
+            h.warmup()
+            s0 = h.engine.step
+            h.engine.schedule(s0 + 2, "kill_broker", broker=1)
+            h.engine.schedule(s0 + 9, "restart_broker", broker=1)
+            h.steps_until(
+                lambda: not h.sim.describe_cluster().get(1, True), 20,
+                what="scheduled broker kill")
+            steps = h.steps_until(h.healed, chaos_max_steps,
+                                  what="post-crash recovery")
+            bad = check_invariants(h.sim, base, h.executor)
+            if bad:
+                raise RuntimeError(
+                    "executor schedule bench: chaos leg "
+                    f"(device={device_scheduling}) violated invariants: "
+                    + "; ".join(bad))
+            return steps, h
+
+        steps_greedy, _ = chaos_steps(False)
+        steps_sched, h_sched = chaos_steps(True)
+        log(f"chaos time_to_balanced: scheduled {steps_sched} steps vs "
+            f"greedy {steps_greedy} steps (seed={chaos_seed})")
+        if h_sched.executor.last_schedule_stats is None:
+            problems.append("device scheduling never engaged during the "
+                            "chaos heal (degraded to greedy silently)")
+
+    # Structural always-on gates raise regardless of ``gate`` — only the
+    # wall-clock ratio and the chaos step comparison are scale-dependent.
+    if problems:
+        raise RuntimeError("executor schedule bench always-on gates: "
+                           + "; ".join(problems))
+    if gate and steps_sched is not None and steps_sched > steps_greedy:
+        raise RuntimeError(
+            f"time_to_balanced gate: {steps_sched} steps scheduled vs "
+            f"{steps_greedy} greedy (must not regress)")
+    if gate and ratio < 3.0:
+        raise RuntimeError(
+            f"executor_moves_per_s gate: scheduled {sched_mps:.1f} mv/s "
+            f"is only {ratio:.1f}x greedy {greedy_mps:.1f} mv/s "
+            "(want >= 3x)")
+    if emit_row:
+        emit("executor_moves_per_s", round(sched_mps, 1), "moves/s",
+             round(ratio, 2), vs_greedy=round(ratio, 2))
+        if steps_sched is not None:
+            emit("time_to_balanced_steps", steps_sched, "steps",
+                 round(steps_greedy / steps_sched, 2)
+                 if steps_sched else None,
+                 vs_greedy=round(steps_greedy / steps_sched, 2)
+                 if steps_sched else None)
+    return {"moves": moves, "batches": len(sched.batches),
+            "sched_wall_s": sched_wall, "greedy_wall_s": greedy_wall,
+            "sched_moves_per_s": sched_mps,
+            "greedy_moves_per_s": greedy_mps, "ratio": ratio,
+            "polls_skipped": stats["polls_skipped"],
+            "polls_performed": stats["polls_performed"],
+            "overlapped_rounds": stats["overlapped_rounds"],
+            "recompiles": recompiles,
+            "unrepaired_violations":
+                sched.stats["unrepaired_violations"],
+            "steps_greedy": steps_greedy, "steps_sched": steps_sched}
+
+
 def run_snapshot_restore_bench(num_brokers: int = NUM_BROKERS,
                                num_partitions: int = NUM_PARTITIONS, *,
                                goal_names: list | None = None,
@@ -2339,7 +2651,7 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
@@ -2350,7 +2662,9 @@ def main():
                          "sweep, 4 clusters x 100x20K, 9 = heavy-traffic "
                          "API read tier, cached vs per-request render, "
                          "10 = replicated serving plane, 2 streaming "
-                         "read replicas vs the leader alone)")
+                         "read replicas vs the leader alone, "
+                         "11 = device-scheduled pipelined executor vs "
+                         "greedy sequential per-batch execution)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -2424,6 +2738,12 @@ def main():
                     "read tier is host-side HTTP serving (replica "
                     "processes pin themselves to CPU)")
             run_replica_fanout_bench()
+        elif args.scenario == 11:
+            if args.mesh:
+                log("--mesh is ignored for scenario 11: the schedule "
+                    "program batches one cluster's moves (no data "
+                    "parallelism to shard)")
+            run_executor_schedule_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

@@ -193,6 +193,15 @@ class KafkaCruiseControl:
         #: the publisher's dedup key, so the full cached result is only
         #: re-serialized when the entry actually moved.
         self._streamed_proposals_key = None
+        #: device move scheduler (executor/schedule.py), built lazily on
+        #: the first execution with ``executor.device.scheduling`` on —
+        #: shares the optimizer's collector/tracer so its programs ride
+        #: the same recompile gate and span view.
+        self._move_scheduler = None
+        #: last forecast-deferral outcome (counts + topic sets) for the
+        #: /devicestats executor section; None until a deferral-enabled
+        #: execution ran.
+        self._last_deferral: dict | None = None
 
         def _registries():
             regs = [self.optimizer.registry, self.monitor.registry,
@@ -464,6 +473,7 @@ class KafkaCruiseControl:
     def attach_replication_channel(self, channel, *, node_id: str,
                                    max_staleness_ms: int = 5_000,
                                    poll_wait_ms: int = 0,
+                                   coalesce_ms: int = 0,
                                    ledger: list | None = None):
         """Wire snapshot-delta streaming over ``channel`` (a
         :class:`~cruise_control_tpu.core.replication.ReplicationChannel`
@@ -494,8 +504,8 @@ class KafkaCruiseControl:
             on_fence=(self.elector.observe_epoch_floor
                       if self.elector is not None else None),
             max_staleness_ms=max_staleness_ms,
-            poll_wait_ms=poll_wait_ms, ledger=ledger,
-            now_ms=self._now_ms)
+            poll_wait_ms=poll_wait_ms, coalesce_ms=coalesce_ms,
+            ledger=ledger, now_ms=self._now_ms)
         self.replication = session
         self.extra_registries.append(session.registry)
         if getattr(channel, "registry", None) is not None \
@@ -877,10 +887,109 @@ class KafkaCruiseControl:
         if not res.proposals:
             return None
         self._refuse_stale_execution(res.stale_model)
+        proposals = list(res.proposals)
+        cfg = self.executor.config
+        if cfg.forecast_deferral_enabled:
+            proposals = self._apply_forecast_deferral(proposals,
+                                                      executor_kwargs)
+            if not proposals:
+                return None
+        if cfg.device_scheduling and "schedule" not in executor_kwargs:
+            schedule = self._device_schedule(proposals, executor_kwargs)
+            if schedule is not None:
+                executor_kwargs["schedule"] = schedule
         if progress:
             progress.add_step("ExecutingProposals")
-        return self.executor.execute_proposals(res.proposals, uuid=uuid,
+        return self.executor.execute_proposals(proposals, uuid=uuid,
                                                **executor_kwargs)
+
+    def _apply_forecast_deferral(self, proposals, executor_kwargs):
+        """PR 13 follow-up: drop heals the forecast predicts obsolete and
+        front-load leadership for projected-hot topics. Median projection
+        at the configured horizon — deferral is a central-tendency call,
+        not a tail-risk one (the quantile sweep stays a /forecast
+        analysis surface). No fit yet -> defer nothing (never block an
+        execution on forecast availability)."""
+        from ..executor.schedule import forecast_filter
+        cfg = self.executor.config
+        try:
+            scenario = self.forecast.trajectory_scenario(
+                cfg.forecast_deferral_horizon_ms, 0.5)
+        except ValueError:
+            return proposals
+        kept, deferred, hot = forecast_filter(
+            proposals, scenario,
+            shrink_below=cfg.forecast_deferral_shrink_factor,
+            hot_above=cfg.forecast_hot_factor)
+        hot_moving = hot & {p.topic for p in kept}
+        self._last_deferral = {
+            "deferredMoves": len(deferred),
+            "deferredTopics": sorted({p.topic for p in deferred}),
+            "hotTopics": sorted(hot_moving),
+            "horizonMs": cfg.forecast_deferral_horizon_ms,
+        }
+        if deferred:
+            LOG.info(
+                "forecast deferral: holding %d move(s) on %d topic(s) "
+                "projected below x%.2f at horizon %dms",
+                len(deferred), len(self._last_deferral["deferredTopics"]),
+                cfg.forecast_deferral_shrink_factor,
+                cfg.forecast_deferral_horizon_ms)
+        if hot_moving:
+            executor_kwargs.setdefault("leadership_priority_topics",
+                                       hot_moving)
+        return kept
+
+    def _device_schedule(self, proposals, executor_kwargs):
+        """Build the device-side :class:`MoveSchedule` for this
+        execution. Any failure degrades to the host greedy planner (the
+        documented degrade path) — scheduling is an optimization, never
+        an availability dependency."""
+        if not any(p.has_replica_action for p in proposals):
+            return None
+        from ..executor.concurrency import ExecutionConcurrencyManager
+        from ..executor.schedule import DeviceMoveScheduler
+        from ..executor.strategy import StrategyContext, strategy_chain
+        cfg = self.executor.config
+        try:
+            result = self.monitor.cluster_model(self._now_ms(), None)
+            model, metadata = result.model, result.metadata
+            goals = self.optimizer._audit_goals_for([], metadata,
+                                                    OptimizationOptions())
+            cc = cfg.concurrency
+            if executor_kwargs.get("concurrency_overrides"):
+                from dataclasses import replace as _dc_replace
+                cc = _dc_replace(cc,
+                                 **executor_kwargs["concurrency_overrides"])
+            # Sizes for the strategy order + per-batch ETA: the model's
+            # disk load, restricted to the partitions actually moving.
+            keys = {(p.topic, p.partition) for p in proposals}
+            disk = np.asarray(model.leader_load)[:, 3]
+            sizes = {k: float(disk[i])
+                     for i, k in enumerate(metadata.partition_keys)
+                     if k in keys}
+            ctx = StrategyContext(partition_size_mb=sizes)
+            names = (executor_kwargs.get("strategy_names")
+                     or list(cfg.default_strategy_names) or None)
+            if self._move_scheduler is None:
+                self._move_scheduler = DeviceMoveScheduler(
+                    collector=self.optimizer.collector,
+                    tracer=self.optimizer.tracer)
+            return self._move_scheduler.schedule(
+                proposals, ExecutionConcurrencyManager(cc),
+                model=model, metadata=metadata, goals=goals,
+                capacity_threshold=self.optimizer.constraint
+                .capacity_threshold,
+                strategy=strategy_chain(names), strategy_context=ctx,
+                throttle_bytes=(
+                    executor_kwargs.get("throttle_bytes")
+                    or cfg.default_replication_throttle_bytes),
+                bandwidth_mb_per_batch=cfg.schedule_bandwidth_mb_per_batch,
+                max_repair_rounds=cfg.schedule_max_repair_rounds)
+        except Exception:
+            LOG.exception("device move scheduling failed; degrading to "
+                          "the host greedy planner")
+            return None
 
     def rebalance(self, goals: list[str] | None = None, dryrun: bool = True,
                   options: OptimizationOptions | None = None, uuid: str = "",
@@ -1335,6 +1444,14 @@ class KafkaCruiseControl:
         payload["replication"] = (self.replication.to_json()
                                   if self.replication is not None
                                   else None)
+        # Device-scheduled execution readout: the last pipelined run's
+        # batch/poll/verify counters plus the last forecast-deferral
+        # outcome. Null until the first scheduled execution — dashboards
+        # poll unconditionally.
+        stats = getattr(self.executor, "last_schedule_stats", None)
+        payload["executor"] = (
+            None if stats is None and self._last_deferral is None
+            else {"schedule": stats, "forecastDeferral": self._last_deferral})
         return payload
 
     # -------------------------------------------------------- fleet ops

@@ -375,6 +375,17 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "the leader's /replication_stream: the leader parks "
                  "the poll until a frame arrives or the budget lapses. "
                  "0 = plain polling (chaos/sim harnesses).")
+    d.define("replication.coalesce.ms", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Leader-side frame coalescing window: consecutive "
+                 "delta-only frames produced within this window merge "
+                 "into one frame before publish (metered "
+                 "Replication.frames-coalesced), cutting ring pressure "
+                 "under high-churn ingest — a follower otherwise falls "
+                 "off the ring and pays a full resync. Structural "
+                 "frames (snapshots, epoch changes, proposal-cache "
+                 "updates) always flush immediately. 0 disables "
+                 "coalescing.")
     d.define("admission.rate.limit.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.MEDIUM,
              doc="Per-principal write admission control "
@@ -691,6 +702,43 @@ def _executor_defs(d: ConfigDef) -> None:
              doc="Force-abort an execution (and release the "
                  "single-execution reservation) still in flight past "
                  "this deadline; 0 disables the watchdog")
+    d.define("executor.device.scheduling", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM,
+             doc="Compute the inter-broker batch assignment on the device "
+                 "(first-fit under concurrency caps, batch boundaries "
+                 "audited against the hard goals) and run the pipelined "
+                 "executor (overlapped admin RPC rounds, ETA-based poll "
+                 "skipping, completion placement verify). False = the "
+                 "host greedy planner, the documented degrade path")
+    d.define("executor.schedule.bandwidth.mb.per.batch", ConfigType.DOUBLE,
+             -1.0, importance=Importance.LOW,
+             doc="Per-destination-broker inbound MB budget per scheduled "
+                 "batch (device scheduling only); -1 disables the "
+                 "bandwidth constraint — disabled keeps the schedule "
+                 "bit-identical to the host greedy planner")
+    d.define("executor.schedule.max.repair.rounds", ConfigType.INT, 4,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Bisection-repair rounds when a scheduled batch boundary "
+                 "violates a hard goal (each round splits the first "
+                 "offending batch)")
+    d.define("executor.forecast.deferral.enabled", ConfigType.BOOLEAN,
+             False, importance=Importance.LOW,
+             doc="Consult forecast trajectories before executing: defer "
+                 "heals on topics projected to shrink (the imbalance is "
+                 "predicted to dissolve) and pre-position leaders for "
+                 "projected-hot topics first")
+    d.define("executor.forecast.deferral.horizon.ms", ConfigType.LONG,
+             3_600_000, validator=Range.at_least(1),
+             importance=Importance.LOW,
+             doc="Forecast horizon for execution deferral decisions")
+    d.define("executor.forecast.deferral.shrink.factor", ConfigType.DOUBLE,
+             0.7, importance=Importance.LOW,
+             doc="Defer a topic's replica moves when its projected load "
+                 "factor falls below this")
+    d.define("executor.forecast.hot.factor", ConfigType.DOUBLE, 1.5,
+             importance=Importance.LOW,
+             doc="Pre-position leadership first for topics projected "
+                 "above this load factor")
 
 
 def _detector_defs(d: ConfigDef) -> None:
@@ -1426,4 +1474,20 @@ class CruiseControlConfig(AbstractConfig):
                 # byte-identical.
                 seed=int.from_bytes(os.urandom(4), "little")),
             stuck_execution_timeout_ms=self.get_int(
-                "execution.stuck.watchdog.timeout.ms"))
+                "execution.stuck.watchdog.timeout.ms"),
+            device_scheduling=self.get_boolean(
+                "executor.device.scheduling"),
+            schedule_bandwidth_mb_per_batch=(
+                None if (bw := self.get_double(
+                    "executor.schedule.bandwidth.mb.per.batch")) <= 0
+                else bw),
+            schedule_max_repair_rounds=self.get_int(
+                "executor.schedule.max.repair.rounds"),
+            forecast_deferral_enabled=self.get_boolean(
+                "executor.forecast.deferral.enabled"),
+            forecast_deferral_horizon_ms=self.get_int(
+                "executor.forecast.deferral.horizon.ms"),
+            forecast_deferral_shrink_factor=self.get_double(
+                "executor.forecast.deferral.shrink.factor"),
+            forecast_hot_factor=self.get_double(
+                "executor.forecast.hot.factor"))

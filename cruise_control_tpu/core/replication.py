@@ -339,6 +339,7 @@ class ReplicationSession:
     def __init__(self, *, node_id: str, channel, clocks, build_frame,
                  fencing_epoch, apply_frame, resync,
                  max_staleness_ms: int = 5_000, poll_wait_ms: int = 0,
+                 coalesce_ms: int = 0, coalesce_max_entries: int = 256,
                  registry=None, ledger: list | None = None,
                  on_fence=None, now_ms=None) -> None:
         import time as _time
@@ -355,6 +356,16 @@ class ReplicationSession:
         #: long-poll window handed to the channel (serving deployments;
         #: simulated-clock harnesses keep 0)
         self.poll_wait_ms = int(poll_wait_ms)
+        #: merge window for consecutive delta-only frames (0 = publish
+        #: every frame immediately). A held frame adds at most
+        #: ``coalesce_ms`` to follower freshness, so keep it well under
+        #: ``max_staleness_ms``.
+        self.coalesce_ms = int(coalesce_ms)
+        #: flush a pending merged frame once it carries this many
+        #: resident entries, regardless of window age
+        self.coalesce_max_entries = int(coalesce_max_entries)
+        self._pending_frame: dict | None = None
+        self._pending_since_ms: int | None = None
         self.on_fence = on_fence
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         #: shared apply ledger (:class:`ReplicaStamp`) — None = unaudited
@@ -380,6 +391,8 @@ class ReplicationSession:
         self._resyncs = self.registry.counter(name(g, "resyncs"))
         self._poll_failures = self.registry.counter(
             name(g, "poll-failures"))
+        self._coalesced = self.registry.counter(
+            name(g, "frames-coalesced"))
         self._read_refusals = self.registry.meter(
             name(g, "read-refusal-rate"))
         self._transitions = {
@@ -417,7 +430,12 @@ class ReplicationSession:
         if self.role != "standby":
             self.role = "standby"
             # Deposed (or never-led): rejoin the stream from scratch —
-            # the new leader's snapshot is the only safe base.
+            # the new leader's snapshot is the only safe base. A frame
+            # still held for coalescing is from the deposed term; the
+            # new leader's stream supersedes it (followers heal any gap
+            # through the ingest-chain resync), so drop, never publish.
+            self._pending_frame = None
+            self._pending_since_ms = None
             self._published_clocks = None
             self.cursor = 0
             self._enter(SYNCING, "demoted to standby")
@@ -429,18 +447,97 @@ class ReplicationSession:
         self.stream_lag_ms = 0
         c = self.clocks()
         if c == self._published_clocks:
+            # Clocks idle, but a held frame still ages toward its window.
+            self._flush_pending_if_due(now_ms)
             return
         frame = self.build_frame()
         if frame is None:
             self._published_clocks = c
+            self._flush_pending_if_due(now_ms)
             return
         epoch = int(self.fencing_epoch())
         self.fence_floor = max(self.fence_floor, epoch)
         frame["fencingEpoch"] = epoch
         frame["clocks"] = dict(c)
         frame["node"] = self.node_id
-        self.channel.publish(frame, now_ms)
+        if self.coalesce_ms > 0 and self._coalescible(frame):
+            self._buffer_frame(frame, now_ms)
+        else:
+            # Structural / snapshot-bearing frames never coalesce; a
+            # held delta must go out FIRST so followers apply in ingest
+            # order.
+            self._flush_pending(now_ms)
+            self.channel.publish(frame, now_ms)
         self._published_clocks = c
+        self._flush_pending_if_due(now_ms)
+
+    # Under high-churn ingest every window roll emits one small delta
+    # frame; at ring capacity that churn evicts older frames and forces
+    # follower resyncs. Coalescing merges consecutive delta-only frames
+    # (plain resident entries, no structural markers, no proposal-cache
+    # body) inside a ``coalesce_ms`` window into one frame before
+    # publish. Safe because follower apply is per-entry idempotent and
+    # keyed by ingest sequence — a merged frame applies exactly like its
+    # constituents in order.
+    @staticmethod
+    def _coalescible(frame: dict) -> bool:
+        if frame.get("proposalCache") is not None:
+            return False
+        resident = frame.get("resident")
+        if resident is None:
+            # Clock-only heartbeat: merging is just "keep the newest".
+            return True
+        return not any(e.get("structural") for e in resident.get(
+            "entries", ()))
+
+    def _buffer_frame(self, frame: dict, now_ms: int) -> None:
+        pending = self._pending_frame
+        if pending is None:
+            self._pending_frame = frame
+            self._pending_since_ms = int(now_ms)
+            return
+        if not self._merge_into(pending, frame):
+            self._flush_pending(now_ms)
+            self._pending_frame = frame
+            self._pending_since_ms = int(now_ms)
+            return
+        self._coalesced.inc()
+        if (len((pending.get("resident") or {}).get("entries", ()))
+                >= self.coalesce_max_entries):
+            self._flush_pending(now_ms)
+
+    @staticmethod
+    def _merge_into(pending: dict, frame: dict) -> bool:
+        """Merge ``frame`` (newer) into ``pending`` in place; False when
+        the two can't merge (different resident epoch — entries from
+        different window generations must not share a frame)."""
+        pb, fb = pending.get("resident"), frame.get("resident")
+        if pb is not None and fb is not None:
+            if pb.get("epoch") != fb.get("epoch"):
+                return False
+            pb["entries"] = list(pb.get("entries", ())) + list(
+                fb.get("entries", ()))
+            pb["ingest"] = fb.get("ingest", pb.get("ingest"))
+        elif fb is not None:
+            pending["resident"] = fb
+        # Newest metadata wins: followers treat the merged frame as the
+        # latest word from this leader term.
+        for key in ("clusterId", "generation", "fencingEpoch", "clocks",
+                    "node"):
+            if key in frame:
+                pending[key] = frame[key]
+        return True
+
+    def _flush_pending(self, now_ms: int) -> None:
+        if self._pending_frame is not None:
+            self.channel.publish(self._pending_frame, now_ms)
+            self._pending_frame = None
+            self._pending_since_ms = None
+
+    def _flush_pending_if_due(self, now_ms: int) -> None:
+        if (self._pending_frame is not None
+                and now_ms - self._pending_since_ms >= self.coalesce_ms):
+            self._flush_pending(now_ms)
 
     # ---------------------------------------------------------- follower
     def _follower_tick(self, now_ms: int) -> None:
@@ -565,6 +662,9 @@ class ReplicationSession:
             "framesRefusedEpoch": self._refused.count,
             "resyncs": self._resyncs.count,
             "pollFailures": self._poll_failures.count,
+            "framesCoalesced": self._coalesced.count,
+            "coalesceMs": self.coalesce_ms,
+            "pendingCoalesced": self._pending_frame is not None,
             "readRefusals": self._read_refusals.count,
         }
         chan_json = getattr(self.channel, "to_json", None)

@@ -17,6 +17,8 @@ from .concurrency import (ConcurrencyAdjuster, ConcurrencyConfig,
 from .executor import (ExecutionResult, Executor, ExecutorConfig,
                        ExecutorNotifier, ExecutorState, OngoingExecutionError)
 from .planner import ExecutionTaskPlanner
+from .schedule import (DeviceMoveScheduler, MoveSchedule,
+                       ScheduleAuditError, forecast_filter)
 from .simulated import SimClock, SimulatedKafkaCluster
 from .strategy import (StrategyContext, ReplicaMovementStrategy,
                        STRATEGY_REGISTRY, strategy_chain)
@@ -31,7 +33,9 @@ __all__ = [
     "ConcurrencyAdjuster", "ConcurrencyConfig", "ConcurrencyType",
     "ExecutionConcurrencyManager", "ExecutionResult", "Executor",
     "ExecutorConfig", "ExecutorNotifier", "ExecutorState",
-    "OngoingExecutionError", "ExecutionTaskPlanner", "SimClock",
+    "OngoingExecutionError", "ExecutionTaskPlanner",
+    "DeviceMoveScheduler", "MoveSchedule", "ScheduleAuditError",
+    "forecast_filter", "SimClock",
     "SimulatedKafkaCluster", "StrategyContext", "ReplicaMovementStrategy",
     "STRATEGY_REGISTRY", "strategy_chain", "ExecutionTask",
     "ExecutionTaskManager", "ExecutionTaskTracker", "IntraBrokerReplicaMove",

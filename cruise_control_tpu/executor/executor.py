@@ -111,6 +111,24 @@ class ExecutorConfig:
     #: deadline is force-aborted and the single-execution reservation
     #: released (0 = disabled; ref execution.stuck.watchdog.timeout.ms)
     stuck_execution_timeout_ms: int = 0
+    #: executor.device.scheduling: compute inter-broker batches on the
+    #: device (schedule.DeviceMoveScheduler) + run the pipelined phase.
+    #: False = host greedy planner (the documented degrade path). The
+    #: facade reads this to decide whether to build a MoveSchedule.
+    device_scheduling: bool = False
+    #: executor.schedule.bandwidth.mb.per.batch (None = unconstrained —
+    #: keeps the device schedule bit-identical to the greedy planner)
+    schedule_bandwidth_mb_per_batch: float | None = None
+    #: executor.schedule.max.repair.rounds: bisection-repair budget for
+    #: hard-goal-violating batch boundaries
+    schedule_max_repair_rounds: int = 4
+    #: executor.forecast.deferral.*: consult forecast trajectories to
+    #: defer heals on projected-shrinking topics and pre-position
+    #: leaders for projected-hot topics (PR 13 follow-up)
+    forecast_deferral_enabled: bool = False
+    forecast_deferral_horizon_ms: int = 3_600_000
+    forecast_deferral_shrink_factor: float = 0.7
+    forecast_hot_factor: float = 1.5
 
 
 @dataclass
@@ -264,6 +282,16 @@ class Executor:
         # failure mode the chaos suite exists to prevent.
         self._admin_retries = self.registry.meter(
             _n(EXECUTOR_SENSOR, "admin-retry-rate"))
+        # Scheduled-pipeline sensors: a completed-but-misplaced
+        # reassignment (verify step) and the ETA-skipped poll rounds the
+        # pipelined phase avoided must both be observable on /metrics.
+        self._verify_failures = self.registry.meter(
+            _n(EXECUTOR_SENSOR, "scheduled-verify-failure-rate"))
+        self._polls_skipped = self.registry.counter(
+            _n(EXECUTOR_SENSOR, "scheduled-polls-skipped"))
+        #: last scheduled execution's pipeline statistics (devicestats'
+        #: ``executor`` section; None until a scheduled execution ran)
+        self.last_schedule_stats: dict | None = None
         self._teardown_failures = self.registry.meter(
             _n(EXECUTOR_SENSOR, "teardown-failure-rate"))
         self._watchdog_aborts = self.registry.counter(
@@ -458,6 +486,8 @@ class Executor:
                           concurrency_overrides: dict | None = None,
                           progress_check_interval_ms: int | None = None,
                           throttle_excluded_brokers: set[int] | None = None,
+                          schedule=None,
+                          leadership_priority_topics: set[str] | None = None,
                           ) -> ExecutionResult:
         """Apply proposals to the cluster; blocks until done/stopped (ref
         ``executeProposals`` ``Executor.java:810`` + ProposalExecutionRunnable).
@@ -467,7 +497,16 @@ class Executor:
         names to per-request values and ``progress_check_interval_ms``
         overrides the poll cadence for THIS execution only (ref the
         per-request concurrency/interval parameters the runnables read,
-        e.g. ``RebalanceParameters`` CONCURRENT_*_PARAM)."""
+        e.g. ``RebalanceParameters`` CONCURRENT_*_PARAM).
+
+        ``schedule`` (a :class:`.schedule.MoveSchedule` over THESE
+        proposals) switches the inter-broker phase to the pipelined
+        executor: precomputed batches, one overlapped admin-RPC round per
+        poll, ETA-based poll skipping, and a placement-verify step on
+        completion. None = the host greedy planner (the documented
+        degrade path). ``leadership_priority_topics`` front-loads those
+        topics' leadership moves (forecast-projected hot topics get their
+        leaders pre-positioned first)."""
         # Pure parameter validation BEFORE the single-execution
         # reservation: a rejected request must not consume the slot, emit
         # an orphan on_execution_finished, or count as an execution.
@@ -562,9 +601,15 @@ class Executor:
                 (f" (fencing epoch {self._fence_token})"
                  if self._fence_token is not None else ""))
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
-            with self.tracer.span("executor.inter-broker-phase"):
-                self._run_inter_broker_phase(planner, concurrency, adjuster,
-                                             strategy_context)
+            if schedule is not None and schedule.batches:
+                with self.tracer.span("executor.inter-broker-phase",
+                                      scheduled=True):
+                    self._run_scheduled_inter_broker_phase(
+                        schedule, proposals, concurrency, adjuster)
+            else:
+                with self.tracer.span("executor.inter-broker-phase"):
+                    self._run_inter_broker_phase(planner, concurrency,
+                                                 adjuster, strategy_context)
             if not self._stop_requested.is_set():
                 OPERATION_LOG.info(
                     "Execution %s: inter-broker phase complete", uid)
@@ -573,7 +618,8 @@ class Executor:
                 self._run_intra_broker_phase(planner, concurrency)
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
             with self.tracer.span("executor.leadership-phase"):
-                self._run_leadership_phase(planner, concurrency)
+                self._run_leadership_phase(planner, concurrency,
+                                           leadership_priority_topics)
             if not self._stop_requested.is_set():
                 OPERATION_LOG.info(
                     "Execution %s: leadership phase complete", uid)
@@ -688,23 +734,7 @@ class Executor:
                 break   # no more RPCs — the poll itself issues cancels
             self._poll_inter_broker_progress()
             self._maybe_alert_slow_tasks()
-            now = self._now_ms()
-            if (adjuster is not None
-                    and now - self._last_adjust_ms
-                    >= self.config.concurrency_adjuster_interval_ms):
-                self._last_adjust_ms = now
-                alive = self._admin_call("describeCluster",
-                                         self.admin.describe_cluster)
-                metrics = {b: self.admin.broker_metrics(b)
-                           for b, up in alive.items() if up}
-                # Partitions at/below min-ISR are the cluster-wide brake
-                # (ref Executor.java:560-584 min-ISR based adjustment).
-                num_min_isr = sum(
-                    1 for info in self._admin_call(
-                        "describePartitions",
-                        self.admin.describe_partitions).values()
-                    if len(info.isr) <= 1 and len(info.replicas) > 1)
-                adjuster.refresh(metrics, num_min_isr_partitions=num_min_isr)
+            self._maybe_adjust_concurrency(adjuster)
         # A completed reassignment leaves the old leader in charge when it
         # is still a member of the new replica set; proposals that also
         # demand a leader change finish with a preferred election (the
@@ -718,6 +748,214 @@ class Executor:
             self._admin_call("electPreferredLeaders",
                              self.admin.elect_preferred_leaders,
                              needs_election)
+
+    def _maybe_adjust_concurrency(self, adjuster) -> None:
+        """Adjuster refresh every concurrency_adjuster_interval_ms (ref
+        Executor.java:560-584 min-ISR based adjustment): broker metrics
+        feed AIMD, partitions at/below min-ISR are the cluster-wide
+        brake. Shared by the greedy and scheduled inter-broker loops."""
+        now = self._now_ms()
+        if (adjuster is None
+                or now - self._last_adjust_ms
+                < self.config.concurrency_adjuster_interval_ms):
+            return
+        self._last_adjust_ms = now
+        alive = self._admin_call("describeCluster",
+                                 self.admin.describe_cluster)
+        metrics = {b: self.admin.broker_metrics(b)
+                   for b, up in alive.items() if up}
+        num_min_isr = sum(
+            1 for info in self._admin_call(
+                "describePartitions",
+                self.admin.describe_partitions).values()
+            if len(info.isr) <= 1 and len(info.replicas) > 1)
+        adjuster.refresh(metrics, num_min_isr_partitions=num_min_isr)
+
+    def _overlapped_admin(self, calls: list[tuple]) -> list:
+        """Run ``[(what, fn, *args), ...]`` admin RPCs as one round,
+        returning results in input order. Calls overlap on a thread pool
+        ONLY when the admin client declares ``concurrent_safe`` — the
+        simulated cluster replays chaos deterministically precisely
+        because RPCs arrive in program order, so overlap is opt-in per
+        backend (the bench's latency-modeling wrapper opts in; a real
+        AdminClient is thread-safe and would too). Every call still rides
+        the shared retry policy via :meth:`_admin_call`."""
+        if len(calls) > 1 and getattr(self.admin, "concurrent_safe",
+                                      False):
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(calls)) as pool:
+                futures = [pool.submit(self._admin_call, c[0], c[1],
+                                       *c[2:]) for c in calls]
+                return [f.result() for f in futures]
+        return [self._admin_call(c[0], c[1], *c[2:]) for c in calls]
+
+    def _run_scheduled_inter_broker_phase(self, schedule, proposals,
+                                          concurrency, adjuster) -> None:
+        """Pipelined inter-broker phase over a precomputed
+        :class:`.schedule.MoveSchedule`.
+
+        Differences from the greedy loop, in decreasing order of wall
+        time saved against a latency-bearing admin backend:
+
+        - **ETA-based poll skipping**: the schedule knows each batch's
+          inbound bytes per destination and the throttle rate, so polls
+          are skipped while the copy provably cannot have finished —
+          fence/watchdog checks still run EVERY interval; only the RPCs
+          are skipped. An underestimate degrades to extra poll rounds.
+        - **Overlapped RPC rounds**: each poll round issues its three
+          reads (list reassignments, cluster liveness, partition
+          placements) as one :meth:`_overlapped_admin` round.
+        - **Same-round placement verify**: a task absent from the ongoing
+          set is checked against its target placement IN THE SAME round
+          (COMPLETED is terminal, so the verdict must precede the
+          transition); a mismatch is DEAD + metered, not silent success.
+
+        Batch admission is a barrier: batch N+1 submits only when every
+        previously submitted task is terminal, so the cluster only ever
+        rests at the exact boundary placements the scheduler audited
+        against the hard goals. The fence gate runs before every
+        admission and after every sleep, same as the greedy loop."""
+        tm = self._task_manager
+        tt = TaskType.INTER_BROKER_REPLICA_ACTION
+        by_prop = {id(t.proposal): t
+                   for t in tm.tracker.tasks_in(tt, TaskState.PENDING)}
+        batches: list[list[ExecutionTask]] = []
+        for idxs in schedule.batches:
+            tasks = [by_prop[id(proposals[i])] for i in idxs
+                     if 0 <= i < len(proposals)
+                     and id(proposals[i]) in by_prop]
+            if tasks:
+                batches.append(tasks)
+        stats = {"batches": len(batches),
+                 "moves": sum(len(b) for b in batches),
+                 "polls_performed": 0, "polls_skipped": 0,
+                 "overlapped_rounds": 0, "verify_failures": 0,
+                 "eta_waits": 0}
+        etas = list(schedule.eta_ms) + [None] * (len(batches)
+                                                 - len(schedule.eta_ms))
+        next_batch = 0
+        poll_due_ms = 0
+        while (tm.tracker.num_remaining(tt) > 0
+               and not self._stop_requested.is_set()):
+            self._fence_check()
+            if self._stop_requested.is_set():
+                break
+            in_flight = tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS)
+            calls: list[tuple] = []
+            admit: list[ExecutionTask] | None = None
+            if not in_flight and next_batch < len(batches):
+                admit = batches[next_batch]
+                targets = {t.topic_partition: list(t.proposal.new_replicas)
+                           for t in admit}
+                calls.append(("alterPartitionReassignments",
+                              self.admin.alter_partition_reassignments,
+                              targets))
+            now = self._now_ms()
+            do_poll = bool(in_flight) and now >= poll_due_ms
+            if in_flight and not do_poll:
+                stats["polls_skipped"] += 1
+                self._polls_skipped.inc()
+            if do_poll:
+                stats["polls_performed"] += 1
+                calls += [("listPartitionReassignments",
+                           self.admin.list_partition_reassignments),
+                          ("describeCluster",
+                           self.admin.describe_cluster),
+                          ("describePartitions",
+                           self.admin.describe_partitions)]
+            if len(calls) > 1:
+                stats["overlapped_rounds"] += 1
+            results = self._overlapped_admin(calls)
+            if admit is not None:
+                errors = results.pop(0)
+                now = self._now_ms()
+                for t in admit:
+                    tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                    if errors.get(t.topic_partition) is not None:
+                        tm.tracker.transition(t, TaskState.DEAD, now)
+                eta = etas[next_batch] if next_batch < len(etas) else None
+                if eta:
+                    poll_due_ms = now + eta
+                    stats["eta_waits"] += 1
+                else:
+                    poll_due_ms = 0
+                next_batch += 1
+            if do_poll:
+                ongoing, alive, parts = results
+                self._process_scheduled_poll(ongoing, alive, parts, stats)
+            elif (admit is None and not in_flight
+                  and next_batch >= len(batches)):
+                # Remaining tasks are in no batch (stale/filtered
+                # proposals): mirror the greedy loop's unschedulable
+                # handling so the phase terminates.
+                now = self._now_ms()
+                for t in tm.tracker.tasks_in(tt, TaskState.PENDING):
+                    tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
+                    tm.tracker.transition(t, TaskState.DEAD, now)
+                break
+            if tm.tracker.num_remaining(tt) <= 0:
+                break
+            self._sleep_ms(self._progress_interval_ms)
+            self._watchdog_check()
+            self._fence_check()
+            if self._fenced:
+                break
+            self._maybe_alert_slow_tasks()
+            self._maybe_adjust_concurrency(adjuster)
+        self.last_schedule_stats = {**schedule.stats, **stats}
+        self._fence_check()
+        needs_election = [
+            t.topic_partition
+            for t in tm.tracker.tasks_in(tt, TaskState.COMPLETED)
+            if t.proposal.has_leader_action]
+        if needs_election and not self._stop_requested.is_set():
+            self._admin_call("electPreferredLeaders",
+                             self.admin.elect_preferred_leaders,
+                             needs_election)
+
+    def _process_scheduled_poll(self, ongoing, alive, parts, stats) -> None:
+        """One scheduled-phase poll round's bookkeeping: verify-then-
+        complete, dead-destination/timeout cancellation — the greedy
+        poll's semantics plus the placement-verify step."""
+        tm = self._task_manager
+        tt = TaskType.INTER_BROKER_REPLICA_ACTION
+        now = self._now_ms()
+        cancels: dict[tuple[str, int], None] = {}
+        for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
+            tp = t.topic_partition
+            if tp not in ongoing:
+                info = parts.get(tp)
+                if (info is not None
+                        and list(info.replicas)
+                        == list(t.proposal.new_replicas)):
+                    tm.tracker.transition(t, TaskState.COMPLETED, now)
+                    self._partition_move_meter.mark()
+                else:
+                    # The reassignment vanished from the ongoing set but
+                    # the placement does not match the proposal (e.g. an
+                    # external agent rewrote it): claiming success would
+                    # poison every later plan's baseline.
+                    stats["verify_failures"] += 1
+                    self._verify_failures.mark()
+                    tm.tracker.transition(t, TaskState.DEAD, now)
+                    OPERATION_LOG.warning(
+                        "Scheduled execution: %s completed with placement "
+                        "%s != proposed %s; marking DEAD", tp,
+                        None if info is None else list(info.replicas),
+                        list(t.proposal.new_replicas))
+                continue
+            dest_dead = any(not alive.get(b, False)
+                            for b in t.proposal.replicas_to_add)
+            timed_out = (t.start_time_ms is not None and
+                         now - t.start_time_ms
+                         > self.config.replica_movement_timeout_ms)
+            if dest_dead or timed_out:
+                cancels[tp] = None
+                tm.tracker.transition(t, TaskState.DEAD, now)
+        if cancels:
+            self._admin_call("cancelDeadReassignments",
+                             self.admin.alter_partition_reassignments,
+                             cancels)
 
     def _maybe_alert_slow_tasks(self) -> None:
         """Log tasks in flight past the alerting threshold, at most once
@@ -817,8 +1055,16 @@ class Executor:
                 elif not alive.get(t.proposal.broker_id, False):
                     tm.tracker.transition(t, TaskState.DEAD, now)
 
-    def _run_leadership_phase(self, planner, concurrency) -> None:
-        """ref moveLeaderships Executor.java:1742 -> electLeaders batches."""
+    def _run_leadership_phase(self, planner, concurrency,
+                              priority_topics: set[str] | None = None
+                              ) -> None:
+        """ref moveLeaderships Executor.java:1742 -> electLeaders batches.
+
+        ``priority_topics`` (forecast-projected hot topics) front-load:
+        their leadership moves fill the earliest batches so projected-hot
+        partitions get their leaders pre-positioned before the traffic
+        arrives — a stable partition, so equal-priority tasks keep the
+        tracker's execution-id order."""
         tm = self._task_manager
         tt = TaskType.LEADER_ACTION
         while (tm.tracker.num_remaining(tt) > 0
@@ -827,6 +1073,11 @@ class Executor:
             if self._stop_requested.is_set():
                 break
             pending = tm.tracker.tasks_in(tt, TaskState.PENDING)
+            if priority_topics:
+                pending = sorted(
+                    pending,
+                    key=lambda t: (0 if t.proposal.topic in priority_topics
+                                   else 1, t.execution_id))
             batch = planner.leadership_batch(pending, concurrency)
             if not batch:
                 break

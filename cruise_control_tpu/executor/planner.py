@@ -18,6 +18,20 @@ class ExecutionTaskPlanner:
         self.strategy = strategy or strategy_chain(None)
         self._ordered: list[ExecutionTask] | None = None
 
+    def sort_key(self, task: ExecutionTask, ctx: StrategyContext):
+        """Total-order sort key: the strategy chain's key first, then an
+        explicit typed tie-break. Chains built by ``strategy_chain`` end
+        in execution-id order, but a caller-supplied bare strategy can
+        tie — and Python's stable sort would then fall back to the
+        *insertion order* of the list being sorted, which differs across
+        processes (tracker iteration after a restore, a replayed plan).
+        The device scheduler and the host batcher must order identically
+        in every process, so equal strategy keys break on
+        ``(task_type, execution_id)`` — typed values, no ``id()`` or
+        insertion-order dependence."""
+        return (self.strategy.key(task, ctx), task.task_type.value,
+                task.execution_id)
+
     def begin_phase(self, tasks: list[ExecutionTask],
                     ctx: StrategyContext | None = None) -> None:
         """Sort the phase's tasks by the strategy chain ONCE (ref
@@ -30,12 +44,12 @@ class ExecutionTaskPlanner:
         calls."""
         ctx = ctx or StrategyContext()
         self._ordered = sorted(tasks,
-                               key=lambda t: self.strategy.key(t, ctx))
+                               key=lambda t: self.sort_key(t, ctx))
 
     def _in_order(self, pending: list[ExecutionTask],
                   ctx: StrategyContext) -> list[ExecutionTask]:
         if self._ordered is None:
-            return sorted(pending, key=lambda t: self.strategy.key(t, ctx))
+            return sorted(pending, key=lambda t: self.sort_key(t, ctx))
         live = {id(t) for t in pending}
         if len(self._ordered) == len(pending):
             # Cheap identity check before trusting the cached order:
@@ -50,7 +64,7 @@ class ExecutionTaskPlanner:
         # skipped begin_phase for them): the cache can't order what it
         # doesn't contain — sort the actual list rather than silently
         # dropping the uncovered tasks from every batch.
-        return sorted(pending, key=lambda t: self.strategy.key(t, ctx))
+        return sorted(pending, key=lambda t: self.sort_key(t, ctx))
 
     def inter_broker_batch(self, pending: list[ExecutionTask],
                            in_progress: list[ExecutionTask],

@@ -1,0 +1,539 @@
+"""Device-side move scheduling: batched conflict-aware batching.
+
+The host greedy planner (``planner.ExecutionTaskPlanner.inter_broker_batch``)
+walks the strategy-ordered task list once per round, occupying per-broker
+concurrency slots — O(rounds x moves) Python at the 10Kx1M tier's ~300K
+moves/plan. This module computes the ENTIRE batch assignment in one
+``lax.fori_loop`` device program: first-fit over the strategy order, where
+move *i* lands in the lowest-indexed batch whose touched brokers all have
+spare concurrency cap, the batch is under the cluster movement cap, and
+(optionally) the per-destination bandwidth budget holds.
+
+First-fit over the strategy order is provably IDENTICAL to running the host
+greedy batcher to quiescence batch-by-batch: greedy round *k* takes, in
+order, every remaining move whose brokers have spare cap in round *k* —
+which is exactly the set first-fit assigns index *k* (a move skipped by
+greedy in round *k* is skipped because a slot is full, so first-fit also
+rejects batch *k* for it; induction over the order). The bit-identical
+parity is regression-tested (``tests/test_schedule.py``) and makes the host
+planner the drop-in degrade path.
+
+Intermediate-placement safety (arxiv 1602.03770's integrated
+reconfiguration planning): every batch boundary's placement — the initial
+model with the first *c* scheduled moves applied — is scored through the
+UNMODIFIED what-if machinery (``make_scenario_scorer`` with no-op scenario
+parameters, the same ``violated_matrix`` ulp cutoff) against the
+registered hard-goal audit set, all boundaries in one vmapped dispatch.
+A violating boundary triggers bisection repair: the first offending batch
+splits in two (a subset of a cap-feasible batch stays cap-feasible), the
+boundaries re-audit, bounded rounds.
+
+Both programs ride tracked compile accounting (``executor.schedule`` /
+``executor.schedule.audit``) so the bench's zero-warm-recompile gate
+covers them.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..model.flat import FlatClusterModel
+from ..model.proposals import ExecutionProposal
+from ..parallel.batching import ProgramCache, pow2_bucket
+from .concurrency import ExecutionConcurrencyManager
+from .planner import ExecutionTaskPlanner
+from .strategy import StrategyContext
+from .tasks import ExecutionTask, TaskType
+
+logger = logging.getLogger(__name__)
+
+#: Sentinel per-broker cap for the padding broker row — large enough to
+#: never constrain, small enough to stay an exact int32.
+_PAD_CAP = 1 << 30
+
+
+@dataclass
+class MoveSchedule:
+    """A full batch assignment for one execution's inter-broker moves.
+
+    ``batches`` holds tuples of indices into the ORIGINAL proposal list
+    the scheduler was given (not task ids — the executor re-attaches its
+    own tasks by proposal identity). Batch order is execution order; the
+    order within a batch is the strategy order, same as the host planner
+    emits.
+    """
+
+    batches: list[tuple[int, ...]]
+    #: per-batch estimated copy time (max over destination brokers of
+    #: inbound MB / throttled rate), None when no throttle rate is known
+    eta_ms: list[float | None]
+    stats: dict = field(default_factory=dict)
+
+    @property
+    def num_moves(self) -> int:
+        return sum(len(b) for b in self.batches)
+
+    def to_json(self) -> dict:
+        return {"numBatches": len(self.batches),
+                "numMoves": self.num_moves,
+                "batchSizes": [len(b) for b in self.batches],
+                "etaMs": [None if e is None else round(e, 1)
+                          for e in self.eta_ms],
+                "stats": dict(self.stats)}
+
+
+def _first_fit_program(M: int, W: int, K: int):
+    """Build the batched first-fit assignment fn for static shapes
+    (M moves x W touched-broker slots, K batch slots).
+
+    State: ``count int32[B1, K]`` per-(broker row, batch) occupied slots,
+    ``size int32[K]`` per-batch move count, ``mb float32[B1, K]``
+    per-(destination row, batch) inbound MB, ``assign int32[M]``. Per
+    move: gather the touched rows' occupancy, test every batch at once,
+    take the first feasible index (``argmax`` over the bool row), scatter
+    the occupancy back. Infeasible-everywhere (possible only under a
+    finite bandwidth budget — the cap-only bound below guarantees a slot)
+    assigns the sentinel ``K``; the host spills those to trailing
+    singleton batches.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def run(rows, dest_rows, sizes_mb, valid, caps, cluster_cap,
+            bw_budget):
+        B1 = caps.shape[0]
+
+        def body(i, st):
+            count, size, mb, assign = st
+            r = rows[i]                              # int32[W]
+            occ = count[r]                           # [W, K]
+            cap_ok = jnp.all(occ < caps[r][:, None], axis=0)     # [K]
+            size_ok = size < cluster_cap                          # [K]
+            d = dest_rows[i]                          # int32[W]
+            dmb = mb[d]                               # [W, K]
+            is_dest = (d < B1 - 1)[:, None]
+            # Bandwidth: a destination under budget, OR carrying nothing
+            # yet (the first move into a broker is always admitted — a
+            # single oversized partition must not become unschedulable).
+            bw_ok = jnp.all(~is_dest
+                            | (dmb + sizes_mb[i] <= bw_budget)
+                            | (dmb == 0.0), axis=0)               # [K]
+            ok = cap_ok & size_ok & bw_ok & valid[i]
+            k = jnp.where(ok.any(), jnp.argmax(ok), K)
+            count = count.at[r, k].add(1, mode="drop")
+            size = size.at[k].add(jnp.where(valid[i], 1, 0),
+                                  mode="drop")
+            mb = mb.at[d, k].add(jnp.where(is_dest[:, 0],
+                                           sizes_mb[i], 0.0),
+                                 mode="drop")
+            assign = assign.at[i].set(
+                jnp.where(valid[i], k.astype(jnp.int32), K))
+            return count, size, mb, assign
+
+        init = (jnp.zeros((B1, K), jnp.int32),
+                jnp.zeros((K,), jnp.int32),
+                jnp.zeros((B1, K), jnp.float32),
+                jnp.full((M,), K, jnp.int32))
+        *_, assign = jax.lax.fori_loop(0, M, body, init)
+        return assign
+
+    return run
+
+
+class DeviceMoveScheduler:
+    """Batched move scheduling + intermediate-placement audit.
+
+    One instance per facade/executor wiring; program caches are bounded
+    and keyed on pow2-bucketed shapes so steady-state executions reuse
+    compiled programs (the bench gates zero warm recompiles across
+    pipelined batches).
+    """
+
+    def __init__(self, collector=None, tracer=None) -> None:
+        from ..core.runtime_obs import default_collector
+        from ..core.tracing import default_tracer
+        self.collector = collector or default_collector()
+        self.tracer = tracer or default_tracer()
+        self._programs = ProgramCache(capacity=8)
+        self._audit_programs = ProgramCache(capacity=8)
+
+    # ------------------------------------------------------------ schedule
+    def schedule(self, proposals: list[ExecutionProposal],
+                 concurrency: ExecutionConcurrencyManager,
+                 *,
+                 model: FlatClusterModel | None = None,
+                 metadata=None,
+                 goals=(),
+                 capacity_threshold=None,
+                 strategy=None,
+                 strategy_context: StrategyContext | None = None,
+                 throttle_bytes: int | None = None,
+                 bandwidth_mb_per_batch: float | None = None,
+                 max_repair_rounds: int = 4,
+                 strict: bool = False) -> MoveSchedule:
+        """Compute the full batch assignment for ``proposals``'
+        inter-broker moves.
+
+        ``model``/``metadata``/``goals`` enable the intermediate-boundary
+        hard-goal audit (skipped when absent — e.g. the parity tests);
+        ``goals`` are BOUND goal kernels (the facade passes the
+        optimizer's registered hard-goal audit set). ``strict`` raises
+        when repair cannot clear a boundary violation; otherwise the
+        schedule ships with ``stats['unrepaired_violations']`` set and
+        the executor's caller decides.
+        """
+        ctx = strategy_context or StrategyContext()
+        with self.tracer.span("executor.schedule",
+                              moves=len(proposals)):
+            order = self._strategy_order(proposals, strategy, ctx)
+            if not order:
+                return MoveSchedule(batches=[], eta_ms=[],
+                                    stats={"moves": 0, "batches": 0})
+            assign = self._assign(order, proposals, concurrency,
+                                  metadata, ctx,
+                                  bandwidth_mb_per_batch)
+            batches = self._group(order, assign)
+            stats = {"moves": len(order), "batches": len(batches),
+                     "boundaries_audited": 0, "repair_rounds": 0,
+                     "unrepaired_violations": 0, "spilled_moves":
+                     int((assign >= _SPILL).sum()) if len(assign) else 0}
+            if goals and model is not None and metadata is not None:
+                batches = self._audit_and_repair(
+                    batches, proposals, model, metadata, goals,
+                    capacity_threshold, stats,
+                    max_repair_rounds=max_repair_rounds, strict=strict)
+            eta = [self._batch_eta_ms(b, proposals, ctx, throttle_bytes)
+                   for b in batches]
+            stats["batches"] = len(batches)
+            return MoveSchedule(batches=batches, eta_ms=eta, stats=stats)
+
+    # ------------------------------------------------------ strategy order
+    def _strategy_order(self, proposals, strategy, ctx):
+        """Indices of the inter-broker proposals in strategy order —
+        EXACTLY the order the host planner's ``begin_phase`` would sort
+        the corresponding tasks into (shim tasks carry list positions as
+        execution ids; the inter subset's relative id order matches the
+        task manager's interleaved sequential ids)."""
+        planner = ExecutionTaskPlanner(strategy)
+        shims = [ExecutionTask(i, p, TaskType.INTER_BROKER_REPLICA_ACTION)
+                 for i, p in enumerate(proposals)
+                 if p.has_replica_action]
+        shims.sort(key=lambda t: planner.sort_key(t, ctx))
+        return [t.execution_id for t in shims]
+
+    # ------------------------------------------------------------- assign
+    def _assign(self, order, proposals, concurrency, metadata, ctx,
+                bandwidth_mb_per_batch):
+        """Run the device first-fit program; returns ``int32[len(order)]``
+        batch indices aligned with ``order``."""
+        import jax.numpy as jnp
+
+        M = len(order)
+        # Broker-row universe: metadata rows when available (aligns with
+        # the audit model), else a dense local index over the ids seen.
+        if metadata is not None:
+            bindex = metadata.broker_index
+            row_ids = list(metadata.broker_ids)
+            B = len(row_ids)
+        else:
+            row_ids = sorted({b for i in order
+                              for b in (*proposals[i].replicas_to_add,
+                                        *proposals[i].replicas_to_remove)})
+            bindex = {b: r for r, b in enumerate(row_ids)}
+            B = len(row_ids)
+        touched = [tuple(proposals[i].replicas_to_add)
+                   + tuple(proposals[i].replicas_to_remove)
+                   for i in order]
+        dests = [tuple(proposals[i].replicas_to_add) for i in order]
+        W = max((len(t) for t in touched), default=1)
+        rows = np.full((M, W), B, np.int32)
+        dest_rows = np.full((M, W), B, np.int32)
+        touch_count: dict[int, int] = {}
+        for m, t in enumerate(touched):
+            for j, b in enumerate(t):
+                r = bindex[b]
+                rows[m, j] = r
+                touch_count[r] = touch_count.get(r, 0) + 1
+            for j, b in enumerate(dests[m]):
+                dest_rows[m, j] = bindex[b]
+        sizes = np.array(
+            [float(ctx.partition_size_mb.get(
+                (proposals[i].topic, proposals[i].partition), 0.0))
+             for i in order], np.float32)
+        caps = np.full((B + 1,), _PAD_CAP, np.int32)
+        for r in range(B):
+            caps[r] = min(concurrency.inter_broker_cap(row_ids[r]),
+                          _PAD_CAP)
+        ccap = max(int(concurrency.cluster_movement_cap), 1)
+
+        # First-fit batch-index bound under caps alone: move i can be
+        # rejected from batch k only by a full batch (at most
+        # floor((M-1)/ccap) of those precede its slot) or by one of its
+        # brokers at cap (broker b fills at most floor((touch_b-1)/cap_b)
+        # batches with EARLIER moves). K = 1 + the worst move's bound.
+        full_b = (M - 1) // ccap
+        worst = 0
+        for m in range(M):
+            s = sum((touch_count[r] - 1) // max(int(caps[r]), 1)
+                    for r in set(int(x) for x in rows[m] if x < B))
+            worst = max(worst, s)
+        # A finite bandwidth budget can split batches the caps alone
+        # admit; the first-move-per-destination rule bounds the extra
+        # batches by the busiest destination's move count.
+        bw_extra = 0
+        if bandwidth_mb_per_batch:
+            dest_count: dict[int, int] = {}
+            for m in range(M):
+                for b in set(int(x) for x in dest_rows[m] if x < B):
+                    dest_count[b] = dest_count.get(b, 0) + 1
+            bw_extra = max(dest_count.values(), default=1) - 1
+        K = min(M, 1 + full_b + worst + bw_extra)
+        K = min(pow2_bucket(K), pow2_bucket(M))
+        M_pad = pow2_bucket(M)
+        rows_p = np.full((M_pad, W), B, np.int32)
+        rows_p[:M] = rows
+        dest_p = np.full((M_pad, W), B, np.int32)
+        dest_p[:M] = dest_rows
+        sizes_p = np.zeros((M_pad,), np.float32)
+        sizes_p[:M] = sizes
+        valid = np.zeros((M_pad,), bool)
+        valid[:M] = True
+        bw = (np.float32(bandwidth_mb_per_batch)
+              if bandwidth_mb_per_batch else np.float32(np.inf))
+
+        key = (M_pad, W, B + 1, K)
+        program = self._programs.get_or_build(
+            key, lambda: self.collector.track(
+                "executor.schedule",
+                _jit_first_fit(M_pad, W, K)))
+        self.collector.record_h2d(rows_p.nbytes + dest_p.nbytes
+                                  + sizes_p.nbytes + valid.nbytes
+                                  + caps.nbytes)
+        assign = np.array(program(
+            jnp.asarray(rows_p), jnp.asarray(dest_p),
+            jnp.asarray(sizes_p), jnp.asarray(valid),
+            jnp.asarray(caps), jnp.int32(ccap), jnp.asarray(bw)))[:M]
+        # Spilled moves (finite-bandwidth corner): sentinel K → trailing
+        # singleton batches, marked for stats via the _SPILL offset.
+        if (assign >= K).any():
+            nxt = int(assign[assign < K].max(initial=-1)) + 1
+            for m in np.nonzero(assign >= K)[0]:
+                assign[m] = _SPILL + nxt
+                nxt += 1
+        return assign
+
+    @staticmethod
+    def _group(order, assign) -> list[tuple[int, ...]]:
+        """Batch index array -> ordered list of original-index tuples."""
+        by_k: dict[int, list[int]] = {}
+        for pos, k in enumerate(assign):
+            by_k.setdefault(int(k) % _SPILL, []).append(order[pos])
+        return [tuple(by_k[k]) for k in sorted(by_k)]
+
+    # -------------------------------------------------------------- audit
+    def _audit_and_repair(self, batches, proposals, model, metadata,
+                          goals, capacity_threshold, stats, *,
+                          max_repair_rounds, strict):
+        """Score every batch boundary's placement against the hard-goal
+        audit set; bisect-split offending batches, bounded rounds."""
+        from ..whatif.engine import violated_matrix
+        goals = tuple(goals)
+        if capacity_threshold is None:
+            capacity_threshold = np.ones(4, np.float32)
+        for rnd in range(max_repair_rounds + 1):
+            bad = self._violating_boundaries(
+                batches, proposals, model, metadata, goals,
+                capacity_threshold, violated_matrix)
+            stats["boundaries_audited"] += len(batches)
+            if not bad:
+                return batches
+            if rnd == max_repair_rounds:
+                break
+            stats["repair_rounds"] += 1
+            first = bad[0]
+            batch = batches[first]
+            if len(batch) <= 1:
+                # A single move violating a hard goal mid-flight cannot
+                # be split further — the plan itself walks through the
+                # violation. Record and stop burning rounds.
+                break
+            mid = len(batch) // 2
+            batches = (batches[:first]
+                       + [tuple(batch[:mid]), tuple(batch[mid:])]
+                       + batches[first + 1:])
+            logger.info("executor.schedule: boundary %d violated hard "
+                        "goals; split batch into %d+%d (round %d)",
+                        first, mid, len(batch) - mid, rnd + 1)
+        stats["unrepaired_violations"] = len(bad)
+        msg = (f"move schedule leaves {len(bad)} batch boundaries in "
+               f"hard-goal violation after {max_repair_rounds} repair "
+               f"rounds")
+        if strict:
+            raise ScheduleAuditError(msg)
+        logger.warning("executor.schedule: %s", msg)
+        return batches
+
+    def _violating_boundaries(self, batches, proposals, model, metadata,
+                              goals, capacity_threshold, violated_matrix):
+        """Indices of batches whose post-batch placement violates any
+        audit goal — one vmapped device dispatch over all boundaries."""
+        import jax.numpy as jnp
+
+        P, R = model.replica_broker.shape
+        B = model.num_brokers_padded
+        # Apply-order: moves sorted by (batch, in-batch position) — the
+        # boundary after batch k is then a PREFIX of this order, so the
+        # whole audit vmaps over one int count per boundary.
+        flat = [i for b in batches for i in b]
+        M = len(flat)
+        prop_rows = np.full((max(M, 1),), P, np.int32)     # OOB = dropped
+        new_rb = np.full((max(M, 1), R), B, np.int32)
+        for m, i in enumerate(flat):
+            p = proposals[i]
+            row = metadata.partition_index.get((p.topic, p.partition))
+            if row is None:
+                continue           # stale proposal; executor validates
+            prop_rows[m] = row
+            for j, b in enumerate(p.new_replicas[:R]):
+                new_rb[m, j] = metadata.broker_index.get(b, B)
+        counts = np.cumsum([len(b) for b in batches]).astype(np.int32)
+        Kb = len(counts)
+        Kb_pad = pow2_bucket(Kb)
+        counts_p = np.zeros((Kb_pad,), np.int32)
+        counts_p[:Kb] = counts
+
+        needs_tlc = any(g.uses_topic_leader_counts for g in goals)
+        needs_topics = needs_tlc or any(g.uses_topic_counts
+                                        for g in goals)
+        num_topics = metadata.num_topics
+        key = (pow2_bucket(max(M, 1)), Kb_pad, (P, R), B,
+               tuple((g.name, g.bind_signature()) for g in goals),
+               num_topics if needs_topics else None, needs_tlc)
+        M_pad = pow2_bucket(max(M, 1))
+        rows_p = np.full((M_pad,), P, np.int32)
+        rows_p[:len(prop_rows)] = prop_rows
+        rb_p = np.full((M_pad, R), B, np.int32)
+        rb_p[:len(new_rb)] = new_rb
+        program = self._audit_programs.get_or_build(
+            key, lambda: self._build_audit_program(
+                goals, capacity_threshold, num_topics=num_topics,
+                needs_topics=needs_topics, needs_tlc=needs_tlc))
+        self.collector.record_h2d(rows_p.nbytes + rb_p.nbytes
+                                  + counts_p.nbytes)
+        viol, vscale = program(model, jnp.asarray(rows_p),
+                               jnp.asarray(rb_p),
+                               jnp.asarray(counts_p))
+        violated = violated_matrix(np.asarray(viol)[:Kb],
+                                   np.asarray(vscale)[:Kb])
+        return [k for k in range(Kb) if violated[k].any()]
+
+    def _build_audit_program(self, goals, capacity_threshold, *,
+                             num_topics, needs_topics, needs_tlc):
+        """jit(vmap(boundary count -> audit-goal violations)) through the
+        UNMODIFIED what-if scorer (no-op scenario parameters): one
+        scoring convention for proposals, simulations, and schedules."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..whatif.engine import make_scenario_scorer
+        one = make_scenario_scorer(
+            goals, capacity_threshold, num_topics=num_topics,
+            needs_topics=needs_topics, needs_tlc=needs_tlc)
+
+        def boundary(mdl, prop_rows, new_rb, count):
+            P, R = mdl.replica_broker.shape
+            B = mdl.num_brokers_padded
+            applied = (jnp.arange(prop_rows.shape[0]) < count)[:, None]
+            cur = mdl.replica_broker.at[prop_rows].get(mode="fill",
+                                                       fill_value=B)
+            rb = mdl.replica_broker.at[prop_rows].set(
+                jnp.where(applied, new_rb, cur), mode="drop")
+            pref = mdl.replica_pref_pos.at[prop_rows].set(
+                jnp.where(applied,
+                          jnp.arange(R, dtype=jnp.int32)[None, :],
+                          mdl.replica_pref_pos.at[prop_rows].get(
+                              mode="fill", fill_value=0)),
+                mode="drop")
+            off = mdl.replica_offline.at[prop_rows].set(
+                jnp.where(applied, False,
+                          mdl.replica_offline.at[prop_rows].get(
+                              mode="fill", fill_value=False)),
+                mode="drop")
+            m2 = mdl.replace(replica_broker=rb, replica_pref_pos=pref,
+                             replica_offline=off)
+            nb = m2.broker_capacity.shape[0]
+            viol, vscale, *_ = one(
+                m2,
+                jnp.zeros((nb,), bool), jnp.zeros((nb,), bool),
+                jnp.ones_like(m2.broker_capacity),
+                jnp.ones((P,), jnp.float32),
+                m2.partition_valid)
+            return viol, vscale
+
+        return self.collector.track(
+            "executor.schedule.audit",
+            jax.jit(jax.vmap(boundary,
+                             in_axes=(None, None, None, 0))))
+
+    # ---------------------------------------------------------------- eta
+    @staticmethod
+    def _batch_eta_ms(batch, proposals, ctx, throttle_bytes):
+        """Estimated batch copy time: worst destination broker's inbound
+        MB over the throttled replication rate. The executor uses it to
+        SKIP poll RPCs while copies are provably still in flight (an
+        underestimate just costs extra poll rounds)."""
+        if not throttle_bytes:
+            return None
+        rate_mb_s = float(throttle_bytes) / 1e6
+        if rate_mb_s <= 0:
+            return None
+        inbound: dict[int, float] = {}
+        for i in batch:
+            p = proposals[i]
+            mb = float(ctx.partition_size_mb.get(
+                (p.topic, p.partition), 0.0))
+            for b in p.replicas_to_add:
+                inbound[b] = inbound.get(b, 0.0) + mb
+        if not inbound:
+            return 0.0
+        return max(inbound.values()) / rate_mb_s * 1000.0
+
+
+class ScheduleAuditError(RuntimeError):
+    """Raised (strict mode) when bisection repair cannot produce a
+    schedule whose every batch boundary passes the hard-goal audit."""
+
+
+#: Spilled-move batch-index offset (see ``_assign``): indices >= _SPILL
+#: encode trailing singleton batches for bandwidth-infeasible moves.
+_SPILL = 1 << 20
+
+
+def _jit_first_fit(M: int, W: int, K: int):
+    import jax
+    return jax.jit(_first_fit_program(M, W, K))
+
+
+def forecast_filter(proposals: list[ExecutionProposal], scenario, *,
+                    shrink_below: float, hot_above: float):
+    """PR 13 follow-up: partition the proposal list by the forecast's
+    projected per-topic load factors.
+
+    ``scenario`` is a ``TrajectoryScale`` (``forecast.engine
+    .trajectory_scenario``). Topics projected to shrink below
+    ``shrink_below`` get their heals DEFERRED (the imbalance they fix is
+    predicted to dissolve — executing it now moves data twice); topics
+    projected above ``hot_above`` are returned as the hot set the
+    executor pre-positions leaders for first. Returns ``(kept, deferred,
+    hot_topics)``; ``kept``/``deferred`` preserve input order.
+    """
+    factors = dict(getattr(scenario, "factors", ()) or ())
+    shrink = {t for t, f in factors.items() if f < shrink_below}
+    hot = {t for t, f in factors.items() if f >= hot_above}
+    kept, deferred = [], []
+    for p in proposals:
+        (deferred if p.topic in shrink else kept).append(p)
+    return kept, deferred, hot

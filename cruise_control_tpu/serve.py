@@ -218,7 +218,8 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                 channel, node_id=identity,
                 max_staleness_ms=config.get_long(
                     "replication.max.staleness.ms"),
-                poll_wait_ms=config.get_long("replication.poll.wait.ms"))
+                poll_wait_ms=config.get_long("replication.poll.wait.ms"),
+                coalesce_ms=config.get_long("replication.coalesce.ms"))
     elif config.get_boolean("replication.enabled"):
         raise ValueError("replication.enabled requires ha.enabled (the "
                          "stream's roles come from the leader elector)")

@@ -97,11 +97,15 @@ while true; do
     # replica processes pin to CPU — so it rides right behind it; the
     # >= 1.8x fan-out gate and the bounded-staleness readout both run
     # at bench scale here.
-    for spec in 2 9 10 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 11 = device-scheduled pipelined executor vs greedy sequential
+    # per-batch execution: the schedule/audit programs are the only
+    # device work (sim + RPC tax are host-side), so it is cheap and
+    # rides early behind the serving-plane rows.
+    for spec in 2 9 10 11 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
         2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7) tmo=4800 ;;
-        9|10) tmo=1800 ;;
+        9|10|11) tmo=1800 ;;
         4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac

@@ -302,3 +302,24 @@ def test_api_throughput_bench_smoke_gate():
     assert all(v == 0 for v in out["dispatches"].values())
     rc = out["rendercache"]
     assert rc["enabled"] and rc["hits"] > 0
+
+
+@pytest.mark.slow
+def test_executor_schedule_bench_smoke_gate():
+    """run_executor_schedule_bench end-to-end at bench shape minus the
+    chaos harness legs: the scheduled and greedy executors drive the
+    same rotation plan through the latency-taxed sim admin, the boundary
+    hard-goal audit must come back clean, the warm run must not
+    recompile, and the fence-flip leg must abort without cancelling
+    in-flight copies and pass the chaos invariants (the helper raises
+    on any breach — gate=False only waives the wall-clock ratio and the
+    chaos step comparison, which are judged at full bench scale).
+    Marked slow: real RTT sleeps put ~10 s of wall on the greedy side."""
+    import bench
+    out = bench.run_executor_schedule_bench(
+        chaos=False, emit_row=False, gate=False)
+    assert out["moves"] == 48 and out["batches"] > 1
+    assert out["unrepaired_violations"] == 0
+    assert out["recompiles"] == 0
+    assert out["polls_skipped"] > out["polls_performed"]
+    assert out["sched_moves_per_s"] > 0 and out["greedy_moves_per_s"] > 0

@@ -64,3 +64,36 @@ def test_caps_respected_with_cached_order():
         for b in (*t.proposal.replicas_to_add, *t.proposal.replicas_to_remove):
             slots[b] = slots.get(b, 0) + 1
     assert all(v <= conc.inter_broker_cap(b) for b, v in slots.items())
+
+
+def test_equal_key_bare_strategy_orders_identically_across_shuffles():
+    """Regression for the typed tie-break in ``sort_key``: a bare
+    caller-supplied strategy whose keys all tie must still produce ONE
+    canonical order regardless of the insertion order of the task list
+    (tracker iteration after a restore, a replayed plan) — the device
+    scheduler and the host batcher must agree in every process."""
+    import random
+
+    from cruise_control_tpu.executor.strategy import (ReplicaMovementStrategy,
+                                                      StrategyContext)
+
+    class AllTie(ReplicaMovementStrategy):
+        name = "AllTie"
+
+        def key(self, task, ctx):
+            return 0
+
+    ctx = StrategyContext()
+    tasks = [_task(i, i % 3, (i + 1) % 3) for i in range(50)]
+    orders = []
+    for seed in (1, 2, 3):
+        shuffled = list(tasks)
+        random.Random(seed).shuffle(shuffled)
+        planner = ExecutionTaskPlanner(AllTie())
+        planner.begin_phase(shuffled, ctx)
+        batch = planner.inter_broker_batch(
+            shuffled, [], ExecutionConcurrencyManager(), ctx)
+        orders.append([t.execution_id for t in batch])
+    assert orders[0] == orders[1] == orders[2]
+    # and the tie-break is the typed (task_type, execution_id) order
+    assert orders[0] == sorted(orders[0])

@@ -319,3 +319,143 @@ def test_promotion_and_demotion_reset_stream_position():
     assert follower.cursor in (0, 2)        # reset, then resync rejoined
     transitions = follower.to_json()
     assert transitions["resyncs"] == 2
+
+
+# ------------------------------------------------------------ coalescing
+def _delta(ingest, *, epoch=7, structural=False):
+    """One window-roll delta frame as _build_replication_frame shapes it."""
+    entry = {"ingest": ingest}
+    if structural:
+        entry["structural"] = True
+    return {"clusterId": "c", "generation": ingest,
+            "resident": {"entries": [entry], "epoch": epoch,
+                         "ingest": ingest},
+            "proposalCache": None}
+
+
+def make_coalescing_leader(ch, frames, clocks, *, coalesce_ms=300,
+                           max_entries=256):
+    """Leader session whose build_frame pops scripted frames; ``clocks``
+    is a mutable dict the test advances to trigger publishes."""
+    return ReplicationSession(
+        node_id="leader", channel=ch, clocks=lambda: dict(clocks),
+        build_frame=lambda: frames.pop(0), fencing_epoch=lambda: 5,
+        apply_frame=lambda f: "applied", resync=lambda: None,
+        coalesce_ms=coalesce_ms, coalesce_max_entries=max_entries)
+
+
+def test_leader_coalesces_consecutive_delta_frames():
+    ch = ReplicationChannel(capacity=8)
+    clocks = {"residentIngest": 0}
+    frames = [_delta(i) for i in range(1, 6)]
+    leader = make_coalescing_leader(ch, frames, clocks)
+    for i, t in enumerate((1000, 1010, 1020, 1030, 1040), start=1):
+        clocks["residentIngest"] = i
+        leader.tick(t, "leader")
+    # all five deltas merged into one pending frame, nothing on the ring
+    assert ch.head_seq == 0
+    assert leader.to_json()["framesCoalesced"] == 4
+    # window elapses with idle clocks -> the merged frame flushes
+    leader.tick(1400, "leader")
+    assert ch.head_seq == 1
+    frame = ch.poll(0, 2000).frames[0]
+    assert [e["ingest"] for e in frame["resident"]["entries"]] == [
+        1, 2, 3, 4, 5]
+    assert frame["resident"]["ingest"] == 5       # newest wins
+    assert frame["generation"] == 5
+    assert frame["fencingEpoch"] == 5
+    assert frame["clocks"] == {"residentIngest": 5}
+
+
+def test_structural_frame_flushes_pending_delta_first():
+    ch = ReplicationChannel(capacity=8)
+    clocks = {"i": 0}
+    frames = [_delta(1), _delta(2, structural=True)]
+    leader = make_coalescing_leader(ch, frames, clocks)
+    clocks["i"] = 1
+    leader.tick(1000, "leader")
+    assert ch.head_seq == 0                       # delta held
+    clocks["i"] = 2
+    leader.tick(1010, "leader")
+    # structural frames never coalesce; the held delta ships FIRST so
+    # followers apply in ingest order
+    res = ch.poll(0, 2000)
+    assert [f["resident"]["ingest"] for f in res.frames] == [1, 2]
+    assert res.frames[1]["resident"]["entries"][0]["structural"]
+
+
+def test_epoch_boundary_splits_coalesced_frames():
+    ch = ReplicationChannel(capacity=8)
+    clocks = {"i": 0}
+    frames = [_delta(1, epoch=7), _delta(2, epoch=8)]
+    leader = make_coalescing_leader(ch, frames, clocks)
+    clocks["i"] = 1
+    leader.tick(1000, "leader")
+    clocks["i"] = 2
+    leader.tick(1010, "leader")
+    # entries from different window generations must not share a frame:
+    # the epoch-7 delta flushed, the epoch-8 one is now pending
+    assert ch.head_seq == 1
+    assert ch.poll(0, 2000).frames[0]["resident"]["epoch"] == 7
+    assert leader.to_json()["framesCoalesced"] == 0
+    leader.tick(2000, "leader")                   # window flush
+    assert ch.head_seq == 2
+
+
+def test_coalescing_relieves_ring_pressure():
+    """The regression satellite: churn that overflowed the ring (forcing
+    follower resyncs) streams as one frame once coalescing is on."""
+    # Without coalescing: 12 deltas through a capacity-4 ring evict the
+    # follower's cursor -> reset -> resync.
+    raw = ReplicationChannel(capacity=4)
+    clocks = {"i": 0}
+    leader = make_coalescing_leader(raw, [_delta(i) for i in range(1, 13)],
+                                    clocks, coalesce_ms=0)
+    for i in range(1, 13):
+        clocks["i"] = i
+        leader.tick(1000 + 10 * i, "leader")
+    assert raw.head_seq == 12
+    assert raw.poll(1, 2000).reset                # cursor 1 fell off
+    # With coalescing: the same churn inside one window is ONE frame —
+    # a follower at cursor 1 streams it, no reset, every entry present.
+    ring = ReplicationChannel(capacity=4)
+    clocks = {"i": 0}
+    leader = make_coalescing_leader(ring, [_delta(i) for i in range(1, 13)],
+                                    clocks, coalesce_ms=300)
+    for i in range(1, 13):
+        clocks["i"] = i
+        leader.tick(1000 + 10 * i, "leader")
+    leader.tick(1500, "leader")                   # window flush
+    assert ring.head_seq == 1
+    res = ring.poll(1, 2000)
+    assert not res.reset
+    assert [e["ingest"] for e in res.frames[0]["resident"]["entries"]] == \
+        list(range(1, 13))
+    assert leader.to_json()["framesCoalesced"] == 11
+
+
+def test_max_entries_flushes_oversize_pending_frame():
+    ch = ReplicationChannel(capacity=8)
+    clocks = {"i": 0}
+    frames = [_delta(i) for i in range(1, 5)]
+    leader = make_coalescing_leader(ch, frames, clocks, max_entries=3)
+    for i in range(1, 5):
+        clocks["i"] = i
+        leader.tick(1000 + i, "leader")
+    # the 3rd merge hits the cap and flushes; the 4th starts a new frame
+    assert ch.head_seq == 1
+    assert len(ch.poll(0, 2000).frames[0]["resident"]["entries"]) == 3
+
+
+def test_demotion_drops_pending_coalesced_frame():
+    ch = ReplicationChannel(capacity=8)
+    clocks = {"i": 0}
+    leader = make_coalescing_leader(ch, [_delta(1)], clocks)
+    clocks["i"] = 1
+    leader.tick(1000, "leader")
+    assert ch.head_seq == 0                       # held
+    # deposed mid-window: the held frame is from the old term — dropped,
+    # never published, even long after the window
+    leader.tick(1100, "standby")
+    leader.tick(9000, "standby")
+    assert ch.head_seq == 0

@@ -1,0 +1,550 @@
+"""Device move-scheduler tests: bit-identical parity with the host greedy
+planner (the degrade-path contract), intermediate-boundary hard-goal
+safety, bisection repair, and the pipelined executor phase (ETA poll
+skipping, placement verify, mid-overlap fence abort) — all against the
+deterministic SimulatedKafkaCluster / hand-built flat models, no sleeps."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.goals import goals_by_name
+from cruise_control_tpu.executor import (
+    ConcurrencyConfig, DeviceMoveScheduler, ExecutionConcurrencyManager,
+    Executor, ExecutorConfig, ExecutionTaskPlanner, MoveSchedule,
+    ScheduleAuditError, SimClock, SimulatedKafkaCluster, TaskState,
+    TaskType, forecast_filter)
+from cruise_control_tpu.executor.strategy import StrategyContext
+from cruise_control_tpu.executor.tasks import ExecutionTask
+from cruise_control_tpu.model.proposals import ExecutionProposal
+from cruise_control_tpu.model.spec import (BrokerSpec, ClusterSpec,
+                                           PartitionSpec, flatten_spec)
+
+
+@pytest.fixture(scope="module")
+def scheduler():
+    return DeviceMoveScheduler()
+
+
+def host_greedy_batches(proposals, concurrency, ctx=None):
+    """Run the host planner to quiescence batch-by-batch: the reference
+    batching the device program must reproduce bit-identically."""
+    ctx = ctx or StrategyContext()
+    planner = ExecutionTaskPlanner()
+    tasks = [ExecutionTask(i, p, TaskType.INTER_BROKER_REPLICA_ACTION)
+             for i, p in enumerate(proposals) if p.has_replica_action]
+    planner.begin_phase(tasks, ctx)
+    pending = list(tasks)
+    batches = []
+    while pending:
+        batch = planner.inter_broker_batch(pending, [], concurrency, ctx)
+        if not batch:
+            break
+        batches.append(tuple(t.execution_id for t in batch))
+        done = {id(t) for t in batch}
+        pending = [t for t in pending if id(t) not in done]
+    return batches
+
+
+def ring_proposals(num_brokers=6, partitions=24):
+    """A follower-rotation plan touching every broker unevenly."""
+    out = []
+    for p in range(partitions):
+        src = p % num_brokers
+        dst = (p + 2) % num_brokers
+        out.append(ExecutionProposal(
+            f"t{p % 3}", p, old_leader=(p + 1) % num_brokers,
+            old_replicas=((p + 1) % num_brokers, src),
+            new_replicas=((p + 1) % num_brokers, dst)))
+    return out
+
+
+# ------------------------------------------------------------------ parity
+@pytest.mark.parametrize("per_broker,cluster_cap", [
+    (1, 100), (2, 100), (1, 3), (5, 4)])
+def test_device_first_fit_matches_host_greedy(scheduler, per_broker,
+                                              cluster_cap):
+    proposals = ring_proposals()
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=per_broker,
+        max_num_cluster_partition_movements=cluster_cap)
+    sched = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc))
+    assert sched.batches == host_greedy_batches(
+        proposals, ExecutionConcurrencyManager(cc))
+    assert sched.num_moves == len(proposals)
+    assert sched.stats["spilled_moves"] == 0
+
+
+def test_parity_at_concurrency_one_is_fully_serial(scheduler):
+    """concurrency=1 everywhere: both sides must emit the exact
+    strategy-order singleton sequence."""
+    proposals = ring_proposals(num_brokers=4, partitions=8)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=1,
+        max_num_cluster_partition_movements=1)
+    sched = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc))
+    host = host_greedy_batches(proposals, ExecutionConcurrencyManager(cc))
+    assert sched.batches == host
+    assert all(len(b) == 1 for b in sched.batches)
+
+
+def test_bandwidth_budget_caps_batch_inbound(scheduler):
+    """A finite per-destination budget splits batches the cap alone
+    would admit; an infinite budget reproduces exact greedy parity."""
+    proposals = [ExecutionProposal("t", p, old_leader=0,
+                                   old_replicas=(0, 1),
+                                   new_replicas=(0, 2))
+                 for p in range(4)]
+    sizes = {("t", p): 100.0 for p in range(4)}
+    ctx = StrategyContext(partition_size_mb=sizes)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=10)
+    sched = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc),
+                               strategy_context=ctx,
+                               bandwidth_mb_per_batch=200.0)
+    # 4 x 100MB into broker 2 under a 200MB budget: two per batch
+    assert [len(b) for b in sched.batches] == [2, 2]
+    wide = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc),
+                              strategy_context=ctx)
+    assert wide.batches == host_greedy_batches(
+        proposals, ExecutionConcurrencyManager(cc), ctx)
+
+
+def test_oversized_move_spills_to_singleton_batch(scheduler):
+    """A single move larger than the whole budget must still schedule
+    (first-move-per-destination admission), and a move that can never
+    join any batch spills to a trailing singleton rather than dropping."""
+    proposals = [ExecutionProposal("t", p, old_leader=0,
+                                   old_replicas=(0, 1),
+                                   new_replicas=(0, 2))
+                 for p in range(3)]
+    ctx = StrategyContext(partition_size_mb={("t", p): 500.0
+                                             for p in range(3)})
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=10)
+    sched = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc),
+                               strategy_context=ctx,
+                               bandwidth_mb_per_batch=100.0)
+    # each 500MB move busts the 100MB budget alone -> all singletons
+    assert [len(b) for b in sched.batches] == [1, 1, 1]
+    assert sched.num_moves == 3
+
+
+def test_eta_reflects_worst_destination_inbound(scheduler):
+    proposals = ring_proposals(num_brokers=4, partitions=4)
+    ctx = StrategyContext(partition_size_mb={
+        (p.topic, p.partition): 50.0 for p in proposals})
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=4)
+    sched = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc),
+                               strategy_context=ctx,
+                               throttle_bytes=10_000_000)  # 10 MB/s
+    assert sched.batches and sched.eta_ms[0] is not None
+    # worst destination carries at least one 50MB copy at 10MB/s
+    assert sched.eta_ms[0] >= 5_000.0
+    no_throttle = scheduler.schedule(
+        proposals, ExecutionConcurrencyManager(cc), strategy_context=ctx)
+    assert all(e is None for e in no_throttle.eta_ms)
+
+
+# ------------------------------------------------------- boundary audit
+AUDIT_GOALS = ["ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+
+
+def audit_spec(num_brokers=4, partitions=12, rf=2):
+    return ClusterSpec(
+        brokers=[BrokerSpec(b, rack=f"r{b}",
+                            capacity=(1000.0, 1000.0, 1000.0, 1000.0))
+                 for b in range(num_brokers)],
+        partitions=[PartitionSpec(
+            f"t{p % 2}", p, [p % num_brokers, (p + 1) % num_brokers],
+            leader_load=(1.0, 2.0, 3.0, 4.0))
+            for p in range(partitions)])
+
+
+def test_boundary_audit_passes_on_balanced_plan(scheduler):
+    """A balance-preserving rotation plan: every batch boundary must
+    clear the hard-goal audit with zero repairs."""
+    model, md = flatten_spec(audit_spec())
+    proposals = [ExecutionProposal(
+        f"t{p % 2}", p, old_leader=p % 4,
+        old_replicas=(p % 4, (p + 1) % 4),
+        new_replicas=(p % 4, (p + 2) % 4)) for p in range(12)]
+    goals = tuple(goals_by_name(AUDIT_GOALS))
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    sched = scheduler.schedule(
+        proposals, ExecutionConcurrencyManager(cc), model=model,
+        metadata=md, goals=goals)
+    assert sched.stats["unrepaired_violations"] == 0
+    assert sched.stats["boundaries_audited"] >= len(sched.batches)
+    # parity with the unaudited assignment: auditing a clean plan must
+    # not change the batches
+    assert sched.batches == scheduler.schedule(
+        proposals, ExecutionConcurrencyManager(cc)).batches
+
+
+def test_boundary_placements_verified_independently(scheduler):
+    """Replay each audited boundary on the host (spec-level) and score it
+    through a fresh what-if engine: the device audit's verdict must hold
+    under independent reconstruction."""
+    from cruise_control_tpu.whatif import LoadScale, WhatIfEngine
+    spec = audit_spec()
+    model, md = flatten_spec(spec)
+    proposals = [ExecutionProposal(
+        f"t{p % 2}", p, old_leader=p % 4,
+        old_replicas=(p % 4, (p + 1) % 4),
+        new_replicas=(p % 4, (p + 2) % 4)) for p in range(12)]
+    goals = tuple(goals_by_name(AUDIT_GOALS))
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    sched = scheduler.schedule(
+        proposals, ExecutionConcurrencyManager(cc), model=model,
+        metadata=md, goals=goals)
+    assert sched.stats["unrepaired_violations"] == 0
+    engine = WhatIfEngine(goals=goals_by_name(AUDIT_GOALS))
+    placement = {(p.topic, p.partition): list(p.replicas)
+                 for p in spec.partitions}
+    applied = dict(placement)
+    for batch in sched.batches:
+        for i in batch:
+            prop = proposals[i]
+            applied[(prop.topic, prop.partition)] = list(prop.new_replicas)
+        bspec = audit_spec()
+        for part in bspec.partitions:
+            part.replicas = list(applied[(part.topic, part.partition)])
+            part.preferred_replicas = list(part.replicas)
+        bmodel, bmd = flatten_spec(bspec)
+        report = engine.sweep(bmodel, bmd, [LoadScale(1.0)])
+        violated = report.outcomes[0].violated_goals
+        assert not violated, (
+            f"boundary after batch {batch} violates {violated}")
+
+
+def test_bisection_repair_splits_first_offending_batch(scheduler,
+                                                       monkeypatch):
+    """Deterministic repair-mechanism check: report batch 0 as violating
+    until it is a singleton — the scheduler must halve it each round,
+    keep move order, and converge within the round budget."""
+    proposals = ring_proposals(num_brokers=4, partitions=8)
+    model, md = flatten_spec(audit_spec(partitions=8))
+    goals = tuple(goals_by_name(AUDIT_GOALS))
+    calls = {"n": 0}
+
+    def fake_violations(batches, *a, **k):
+        calls["n"] += 1
+        return [0] if len(batches[0]) > 1 else []
+
+    monkeypatch.setattr(scheduler, "_violating_boundaries",
+                        fake_violations)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=8)
+    sched = scheduler.schedule(
+        proposals, ExecutionConcurrencyManager(cc), model=model,
+        metadata=md, goals=goals, max_repair_rounds=6)
+    flat = [i for b in sched.batches for i in b]
+    base = scheduler.schedule(proposals, ExecutionConcurrencyManager(cc))
+    assert flat == [i for b in base.batches for i in b]  # order kept
+    assert len(sched.batches[0]) == 1
+    assert sched.stats["repair_rounds"] > 0
+    assert sched.stats["unrepaired_violations"] == 0
+
+
+def test_strict_mode_raises_on_unrepairable_violation(scheduler,
+                                                      monkeypatch):
+    proposals = ring_proposals(num_brokers=4, partitions=4)
+    model, md = flatten_spec(audit_spec(partitions=4))
+    monkeypatch.setattr(scheduler, "_violating_boundaries",
+                        lambda batches, *a, **k: [0])
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=8)
+    with pytest.raises(ScheduleAuditError):
+        scheduler.schedule(
+            proposals, ExecutionConcurrencyManager(cc), model=model,
+            metadata=md, goals=tuple(goals_by_name(AUDIT_GOALS)),
+            max_repair_rounds=2, strict=True)
+    relaxed = scheduler.schedule(
+        proposals, ExecutionConcurrencyManager(cc), model=model,
+        metadata=md, goals=tuple(goals_by_name(AUDIT_GOALS)),
+        max_repair_rounds=2)
+    assert relaxed.stats["unrepaired_violations"] > 0
+
+
+# --------------------------------------------------- pipelined execution
+def make_cluster(num_brokers=4, partitions=8, size_mb=50.0, rate=100.0):
+    sim = SimulatedKafkaCluster()
+    for b in range(num_brokers):
+        sim.add_broker(b, rate_mb_s=rate, logdirs=("logdir0", "logdir1"))
+    for p in range(partitions):
+        sim.add_partition("t", p, [p % num_brokers, (p + 1) % num_brokers],
+                          size_mb=size_mb)
+    return sim
+
+
+def make_executor(sim, **cfg_kwargs):
+    clock = SimClock(sim)
+    cfg = ExecutorConfig(progress_check_interval_ms=100, **cfg_kwargs)
+    return Executor(sim, cfg, now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+
+
+def rotation_proposals(sim, num_brokers=4):
+    out = []
+    for (topic, part), info in sorted(sim.describe_partitions().items()):
+        reps = list(info.replicas)
+        out.append(ExecutionProposal(
+            topic, part, old_leader=info.leader,
+            old_replicas=tuple(reps),
+            new_replicas=(reps[0], (reps[1] + 1) % num_brokers)))
+    return out
+
+
+def test_scheduled_execution_matches_greedy_final_state(scheduler):
+    sim_a, sim_b = make_cluster(), make_cluster()
+    props_a, props_b = rotation_proposals(sim_a), rotation_proposals(sim_b)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    ex_a = make_executor(sim_a,
+                         concurrency=cc, concurrency_adjuster_enabled=False)
+    sched = scheduler.schedule(props_a, ExecutionConcurrencyManager(cc))
+    res_a = ex_a.execute_proposals(props_a, uuid="sched", schedule=sched)
+    ex_b = make_executor(sim_b,
+                         concurrency=cc, concurrency_adjuster_enabled=False)
+    res_b = ex_b.execute_proposals(props_b, uuid="greedy")
+    assert res_a.succeeded and res_b.succeeded
+    place_a = {tp: i.replicas for tp, i in
+               sim_a.describe_partitions().items()}
+    place_b = {tp: i.replicas for tp, i in
+               sim_b.describe_partitions().items()}
+    assert place_a == place_b
+    stats = ex_a.last_schedule_stats
+    assert stats["verify_failures"] == 0
+    assert stats["batches"] == len(sched.batches)
+    assert not sim_a.list_partition_reassignments()
+
+
+def test_eta_poll_skipping_saves_poll_rounds(scheduler):
+    """With a throttle-derived ETA the pipelined loop must skip poll RPC
+    rounds while copies are provably in flight — and still complete."""
+    sim = make_cluster(size_mb=200.0, rate=10.0)     # 20s per copy
+    props = rotation_proposals(sim)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    ctx = StrategyContext(partition_size_mb={
+        (p.topic, p.partition): 200.0 for p in props})
+    sched = scheduler.schedule(props, ExecutionConcurrencyManager(cc),
+                               strategy_context=ctx,
+                               throttle_bytes=10_000_000)
+    assert any(e for e in sched.eta_ms)
+    ex = make_executor(sim, concurrency=cc,
+                       concurrency_adjuster_enabled=False)
+    res = ex.execute_proposals(props, uuid="eta", schedule=sched)
+    assert res.succeeded
+    stats = ex.last_schedule_stats
+    assert stats["polls_skipped"] > 0
+    assert stats["eta_waits"] == len(sched.batches)
+    assert stats["polls_performed"] < stats["polls_skipped"]
+
+
+class _TamperedAdmin:
+    """Admin proxy whose describe_partitions lies about one partition's
+    placement — the verify step must catch it."""
+
+    def __init__(self, sim, lie_tp):
+        self._sim = sim
+        self._lie_tp = lie_tp
+
+    def __getattr__(self, name):
+        return getattr(self._sim, name)
+
+    def describe_partitions(self):
+        from dataclasses import replace
+        parts = dict(self._sim.describe_partitions())
+        parts[self._lie_tp] = replace(parts[self._lie_tp],
+                                      replicas=[99, 98])
+        return parts
+
+
+def test_verify_step_rejects_mismatched_placement(scheduler):
+    sim = make_cluster()
+    props = rotation_proposals(sim)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    sched = scheduler.schedule(props, ExecutionConcurrencyManager(cc))
+    admin = _TamperedAdmin(sim, ("t", 0))
+    clock = SimClock(sim)
+    ex = Executor(admin,
+                  ExecutorConfig(progress_check_interval_ms=100,
+                                 concurrency=cc,
+                                 concurrency_adjuster_enabled=False),
+                  now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    res = ex.execute_proposals(props, uuid="tamper", schedule=sched)
+    stats = ex.last_schedule_stats
+    assert stats["verify_failures"] >= 1
+    assert res.num_dead_tasks >= 1
+    counts = res.state_counts[TaskType.INTER_BROKER_REPLICA_ACTION.value]
+    assert counts.get("DEAD", 0) >= 1
+    # every other move completed and verified
+    assert counts.get("COMPLETED", 0) == len(props) - counts["DEAD"]
+
+
+class _FlippingFence:
+    """Elector stand-in that deposes the executor after N is_current
+    checks — mid-pipeline, between admission and completion."""
+
+    def __init__(self, flips_after):
+        self.epoch = 7
+        self._checks = 0
+        self._flips_after = flips_after
+
+    def is_current(self, token):
+        self._checks += 1
+        return self._checks <= self._flips_after
+
+    def leader_id(self):
+        return "other-node"
+
+
+def test_mid_pipeline_fence_aborts_without_cancel_rpcs(scheduler):
+    """Chaos satellite: deposed mid-overlap, the scheduled phase must
+    abort at the next fence point WITHOUT cancelling in-flight
+    reassignments (they belong to the successor) and release the
+    single-execution reservation."""
+    sim = make_cluster(size_mb=500.0, rate=5.0)      # long copies
+    props = rotation_proposals(sim)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    sched = scheduler.schedule(props, ExecutionConcurrencyManager(cc))
+    ex = make_executor(sim, concurrency=cc,
+                       concurrency_adjuster_enabled=False)
+    ex.fence = _FlippingFence(flips_after=3)
+    res = ex.execute_proposals(props, uuid="fenced", schedule=sched)
+    assert ex._fencing_aborts.count == 1
+    assert not ex.has_ongoing_execution()            # reservation released
+    counts = res.state_counts[TaskType.INTER_BROKER_REPLICA_ACTION.value]
+    assert counts.get("ABORTED", 0) > 0
+    # in-flight copies left streaming for the successor: no cancel RPC
+    assert sim.list_partition_reassignments(), (
+        "fenced abort must leave in-flight reassignments untouched")
+
+
+class _CountingAdmin:
+    """Admin proxy counting concurrent entries; concurrent_safe opt-in."""
+
+    concurrent_safe = True
+
+    def __init__(self, sim):
+        import threading
+        self._sim = sim
+        self._lock = threading.Lock()
+        self._inside = 0
+        self.max_inside = 0
+
+    def __getattr__(self, name):
+        inner = getattr(self._sim, name)
+        if not callable(inner):
+            return inner
+
+        def wrapped(*a, **k):
+            with self._lock:
+                self._inside += 1
+                self.max_inside = max(self.max_inside, self._inside)
+            try:
+                return inner(*a, **k)
+            finally:
+                with self._lock:
+                    self._inside -= 1
+        return wrapped
+
+
+def test_overlapped_admin_runs_reads_concurrently(scheduler):
+    """concurrent_safe admin: the poll round's three reads overlap on
+    the thread pool; results still come back in input order."""
+    sim = make_cluster()
+    admin = _CountingAdmin(sim)
+    clock = SimClock(sim)
+    cc = ConcurrencyConfig(
+        num_concurrent_partition_movements_per_broker=2)
+    ex = Executor(admin,
+                  ExecutorConfig(progress_check_interval_ms=100,
+                                 concurrency=cc,
+                                 concurrency_adjuster_enabled=False),
+                  now_ms=clock.now_ms, sleep_ms=clock.sleep_ms)
+    props = rotation_proposals(sim)
+    sched = scheduler.schedule(props, ExecutionConcurrencyManager(cc))
+    res = ex.execute_proposals(props, uuid="overlap", schedule=sched)
+    assert res.succeeded
+    assert ex.last_schedule_stats["overlapped_rounds"] > 0
+    # sim calls are serialized by the sim's own lock-free design here;
+    # what matters is results stayed aligned (succeeded == placements ok)
+    parts = sim.describe_partitions()
+    for p in props:
+        assert list(parts[(p.topic, p.partition)].replicas) == \
+            list(p.new_replicas)
+
+
+# ------------------------------------------------------ forecast deferral
+class _Scenario:
+    def __init__(self, factors):
+        self.factors = tuple(sorted(factors.items()))
+
+
+def test_forecast_filter_partitions_by_projected_factor():
+    props = [ExecutionProposal(t, 0, old_leader=0, old_replicas=(0, 1),
+                               new_replicas=(0, 2))
+             for t in ("shrinking", "steady", "hot")]
+    kept, deferred, hot = forecast_filter(
+        props, _Scenario({"shrinking": 0.4, "steady": 1.0, "hot": 2.0}),
+        shrink_below=0.7, hot_above=1.5)
+    assert [p.topic for p in deferred] == ["shrinking"]
+    assert [p.topic for p in kept] == ["steady", "hot"]
+    assert hot == {"hot"}
+    # unknown topics (no forecast) are never deferred
+    kept2, deferred2, _ = forecast_filter(
+        props, _Scenario({}), shrink_below=0.7, hot_above=1.5)
+    assert not deferred2 and len(kept2) == 3
+
+
+def test_leadership_priority_topics_front_loads_hot_leaders():
+    sim = SimulatedKafkaCluster()
+    for b in range(3):
+        sim.add_broker(b, rate_mb_s=100.0, logdirs=("logdir0",))
+    for p in range(6):
+        sim.add_partition(f"t{p}", 0, [p % 3, (p + 1) % 3], size_mb=1.0)
+    # pure leadership transfers (same replica set, new leader)
+    props = [ExecutionProposal(
+        f"t{p}", 0, old_leader=p % 3,
+        old_replicas=(p % 3, (p + 1) % 3),
+        new_replicas=((p + 1) % 3, p % 3)) for p in range(6)]
+    cc = ConcurrencyConfig(num_concurrent_leader_movements=2)
+    ex = make_executor(sim, concurrency=cc,
+                       concurrency_adjuster_enabled=False)
+    order = []
+    orig = sim.elect_preferred_leaders
+
+    def spy(tps):
+        order.extend(tps)
+        return orig(tps)
+
+    sim.elect_preferred_leaders = spy
+    res = ex.execute_proposals(props, uuid="prio",
+                               leadership_priority_topics={"t4", "t5"})
+    assert res.succeeded
+    first_wave = {t for t, _ in order[:2]}
+    assert first_wave == {"t4", "t5"}
+
+
+# ------------------------------------------------- empty / edge schedules
+def test_empty_and_leadership_only_plans_yield_empty_schedule(scheduler):
+    cc = ConcurrencyConfig()
+    assert scheduler.schedule([], ExecutionConcurrencyManager(cc)).batches \
+        == []
+    # leadership-only transfers (same replica SET) carry no replica
+    # action: nothing for the inter-broker schedule
+    lead_only = [ExecutionProposal("t", 0, old_leader=0,
+                                   old_replicas=(0, 1),
+                                   new_replicas=(1, 0))]
+    sched = scheduler.schedule(lead_only, ExecutionConcurrencyManager(cc))
+    assert sched.batches == [] and sched.num_moves == 0
+    mixed = lead_only + [ExecutionProposal(
+        "t", 1, old_leader=0, old_replicas=(0, 1), new_replicas=(0, 2))]
+    sched = scheduler.schedule(mixed, ExecutionConcurrencyManager(cc))
+    assert sched.batches == [(1,)]      # only the replica move scheduled
