@@ -782,6 +782,116 @@ def run_tracer_overhead_bench(num_brokers: int = 50,
             "overhead_pct": overhead_pct}
 
 
+def run_event_journal_overhead_bench(num_brokers: int = 50,
+                                     num_partitions: int = 5_000, *,
+                                     goal_names: list | None = None,
+                                     repeats: int = 5,
+                                     emit_row: bool = True,
+                                     gate: bool = True) -> dict:
+    """Flight-recorder overhead on the warm propose path: one served
+    proposal = one warm optimize plus the journal rows the facade writes
+    for it (optimizer/plan-selected -> propose/served, cause-linked,
+    plus a detector heartbeat), A/B with the journal enabled vs
+    disabled. Best-of-``repeats`` per mode to shed scheduler noise.
+
+    Two gates. The wall-clock gate: enabled must stay within 2% of
+    disabled — a recorder that taxes the propose path defeats its
+    purpose. The sync gate (ALWAYS on, any scale — it is deterministic):
+    the enabled serve must issue exactly as many explicit host syncs
+    (jax.device_get / jax.block_until_ready) as the disabled one; the
+    journal is host-side bookkeeping and must never touch the device."""
+    import jax
+
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig, TpuGoalOptimizer,
+                                             goals_by_name)
+    from cruise_control_tpu.core.events import EventJournal
+    model, md = build_flat_direct(num_brokers, num_partitions, RF)
+    opt = TpuGoalOptimizer(
+        goals=goals_by_name(goal_names or GOALS),
+        config=SearchConfig(num_replica_candidates=512,
+                            num_dest_candidates=16, apply_per_iter=512,
+                            max_iters_per_goal=256))
+    run_opts = dict(skip_hard_goal_check=True)
+    opt.optimize(model, md, OptimizationOptions(seed=0, **run_opts))  # warm
+    journal = EventJournal(capacity=4096, node="bench")
+
+    def serve_once():
+        res = opt.optimize(model, md, OptimizationOptions(seed=1, **run_opts))
+        # The decision chain the facade journals per served proposal.
+        plan = journal.record("optimizer", "plan-selected",
+                              detail={"numProposals": len(res.proposals)})
+        journal.record("propose", "served", cause=plan,
+                       detail={"source": "fresh",
+                               "numProposals": len(res.proposals)})
+        journal.record("detector", "round-complete",
+                       detail={"anomalies": 0})
+        return res
+
+    def best_of(enabled: bool) -> float:
+        journal.enabled = enabled
+        t_best = float("inf")
+        for _ in range(repeats):
+            t0 = time.monotonic()
+            serve_once()
+            t_best = min(t_best, time.monotonic() - t0)
+        return t_best
+
+    # Sync gate first: count explicit host syncs for one serve per mode.
+    counts = {"n": 0}
+    orig_get, orig_block = jax.device_get, jax.block_until_ready
+
+    def counting(fn):
+        def wrapped(*a, **kw):
+            counts["n"] += 1
+            return fn(*a, **kw)
+        return wrapped
+
+    jax.device_get = counting(orig_get)
+    jax.block_until_ready = counting(orig_block)
+    try:
+        journal.enabled = False
+        serve_once()
+        syncs_disabled = counts["n"]
+        counts["n"] = 0
+        journal.enabled = True
+        serve_once()
+        syncs_enabled = counts["n"]
+    finally:
+        jax.device_get = orig_get
+        jax.block_until_ready = orig_block
+        journal.enabled = True
+    if syncs_enabled != syncs_disabled:
+        raise RuntimeError(
+            f"journal device-sync gate: {syncs_enabled} explicit syncs "
+            f"with the journal enabled vs {syncs_disabled} disabled — "
+            "the flight recorder must stay pure host-side bookkeeping")
+
+    try:
+        disabled_s = best_of(False)
+        enabled_s = best_of(True)
+    finally:
+        journal.enabled = True
+    overhead_pct = ((enabled_s - disabled_s) / disabled_s * 100.0
+                    if disabled_s > 0 else 0.0)
+    log(f"event journal overhead ({num_brokers}x{num_partitions}): "
+        f"enabled {enabled_s:.3f}s disabled {disabled_s:.3f}s "
+        f"({overhead_pct:+.2f}%), {journal.last_seq} rows journaled, "
+        f"{syncs_enabled} == {syncs_disabled} host syncs per serve")
+    if gate and overhead_pct > 2.0:
+        raise RuntimeError(
+            f"event journal overhead gate: {overhead_pct:.2f}% > 2% "
+            f"(enabled {enabled_s:.3f}s vs disabled {disabled_s:.3f}s)")
+    if emit_row:
+        emit("event_journal_overhead_propose_path_pct",
+             round(max(overhead_pct, 0.0), 3), "%", None)
+    return {"enabled_s": enabled_s, "disabled_s": disabled_s,
+            "overhead_pct": overhead_pct,
+            "syncs_enabled": syncs_enabled,
+            "syncs_disabled": syncs_disabled,
+            "rows": journal.last_seq}
+
+
 def run_device_stats_bench(num_brokers: int = NUM_BROKERS,
                            num_partitions: int = NUM_PARTITIONS, *,
                            goal_names: list | None = None, cycles: int = 3,
@@ -2651,7 +2761,7 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
@@ -2664,7 +2774,9 @@ def main():
                          "10 = replicated serving plane, 2 streaming "
                          "read replicas vs the leader alone, "
                          "11 = device-scheduled pipelined executor vs "
-                         "greedy sequential per-batch execution)")
+                         "greedy sequential per-batch execution, "
+                         "12 = flight-recorder journal overhead on the "
+                         "warm propose path, enabled vs disabled)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -2744,6 +2856,11 @@ def main():
                     "program batches one cluster's moves (no data "
                     "parallelism to shard)")
             run_executor_schedule_bench()
+        elif args.scenario == 12:
+            if args.mesh:
+                log("--mesh is ignored for scenario 12: the journal is "
+                    "host-side bookkeeping (no device work to shard)")
+            run_event_journal_overhead_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

@@ -157,6 +157,35 @@ class KafkaCruiseControl:
         self.tracer = self.optimizer.tracer
         self.extra_registries.append(self.tracer.registry)
 
+        #: control-plane flight recorder (core/events.py): the causal
+        #: decision journal every subsystem records into — serves
+        #: /history, rides /trace as instant events, streams to read
+        #: replicas, and persists through the snapshot payload. Always
+        #: constructed (appends are cheap and `enabled=False` no-ops
+        #: them); serve.py reconfigures it from the events.* keys. Its
+        #: EventJournal.* counters join the scrape view.
+        from ..core.events import EventJournal
+        self.journal = EventJournal(tracer=self.tracer,
+                                    now_ms=self._now_ms)
+        self.extra_registries.append(self.journal.registry)
+        self.executor.journal = self.journal
+        #: SLO burn-rate evaluator (core/slo.py), wired by serve.py from
+        #: the slo.* keys; None = no SLO evaluation. ha_tick drives it
+        #: so standbys (which run no detector loop) still evaluate the
+        #: standby-staleness objective.
+        self.slo = None
+        #: (plan object, journal seq) pairs for the last few served
+        #: plans — the propose→serve causality link (a cached entry's
+        #: plan-selected event is recorded once, then every serve of
+        #: that same result names it as cause).
+        self._recent_plans: list = []
+        #: journal seq last shipped on the replication stream — the
+        #: publisher's delta cursor.
+        self._streamed_journal_seq = 0
+        #: id() of the last journaled population-stats dict (one
+        #: population-winner event per optimize run, not per serve).
+        self._journaled_pop_id = None
+
         #: device-runtime ledger serving /devicestats and the DeviceStats
         #: substate of /state — the optimizer's collector (the process
         #: default unless overridden), shared by every subsystem wired
@@ -306,8 +335,10 @@ class KafkaCruiseControl:
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                     ttl_ms=0, raw=True)
         rc.register("trace", base_key,
-                    lambda: json.dumps(self.tracer.to_chrome_trace()),
+                    lambda: json.dumps(self.trace_json()),
                     ttl_ms=0, raw=True)
+        # /history is deliberately NOT render-cached: its filters are
+        # per-request and the journal is already a lock-cheap ring read.
 
         def explorer_payload() -> str:
             from .openapi import api_explorer_html
@@ -459,6 +490,7 @@ class KafkaCruiseControl:
         view; ``start_up`` restores from it, ``ha_tick`` writes on
         cadence, ``shutdown`` writes a final snapshot."""
         self.snapshotter = snapshotter
+        snapshotter.journal = self.journal
         self.extra_registries.append(snapshotter.registry)
 
     def attach_elector(self, elector) -> None:
@@ -468,6 +500,7 @@ class KafkaCruiseControl:
         join the scrape view."""
         self.elector = elector
         self.executor.fence = elector
+        elector.journal = self.journal
         self.extra_registries.append(elector.registry)
 
     def attach_replication_channel(self, channel, *, node_id: str,
@@ -506,6 +539,12 @@ class KafkaCruiseControl:
             max_staleness_ms=max_staleness_ms,
             poll_wait_ms=poll_wait_ms, coalesce_ms=coalesce_ms,
             ledger=ledger, now_ms=self._now_ms)
+        session.journal = self.journal
+        if self.journal.node is None:
+            # Journal rows need a node identity the moment this process
+            # joins a multi-process topology (replica-vs-leader
+            # provenance on /history); serve.py may have set one already.
+            self.journal.node = node_id
         self.replication = session
         self.extra_registries.append(session.registry)
         if getattr(channel, "registry", None) is not None \
@@ -527,6 +566,9 @@ class KafkaCruiseControl:
                                if resident is not None else -1),
             "mutationCount": self.registry.mutation_count,
             "proposalSeq": (entry.seq if entry is not None else None),
+            # Journal-only decisions (a refusal, a heal outcome) must
+            # still publish a frame — replicas serve /history locally.
+            "journalSeq": self.journal.last_seq,
         }
 
     def _build_replication_frame(self) -> dict | None:
@@ -554,6 +596,14 @@ class KafkaCruiseControl:
         if key is not None and key != self._streamed_proposals_key:
             proposals = self.proposal_cache.export_state()
             self._streamed_proposals_key = key
+        # Journal delta since the last shipped seq: replicas apply the
+        # leader's decisions into their own ring and serve /history
+        # locally (fence-checked with the rest of the frame).
+        journal_delta = self.journal.export_delta(
+            self._streamed_journal_seq)
+        if journal_delta:
+            self._streamed_journal_seq = max(
+                e["seq"] for e in journal_delta)
         # Clock-only movement (generation bump, registry shape) still
         # publishes: followers key their render caches off the counters.
         return {
@@ -561,6 +611,7 @@ class KafkaCruiseControl:
             "generation": self.monitor.generation,
             "resident": body,
             "proposalCache": proposals,
+            "journal": journal_delta or None,
         }
 
     def _apply_replication_frame(self, frame: dict) -> str:
@@ -589,6 +640,11 @@ class KafkaCruiseControl:
         if proposals is not None:
             self.proposal_cache.restore_state(proposals)
             applied = True
+        journal_delta = frame.get("journal")
+        if journal_delta:
+            if self.journal.apply_remote(
+                    journal_delta, source_node=frame.get("node")):
+                applied = True
         return "applied" if applied else "skipped"
 
     def _replication_resync(self) -> int | None:
@@ -649,6 +705,9 @@ class KafkaCruiseControl:
         the leader's identity (server.py maps NotLeaderError)."""
         if self.elector is not None and not self.elector.is_leader():
             from ..core.leader import NotLeaderError
+            self.journal.record(
+                "execute", "refused-not-leader", severity="warn",
+                detail={"leaderId": self.elector.leader_id()})
             raise NotLeaderError(
                 "this process is a standby replica; execution is owned "
                 f"by the leader ({self.elector.leader_id() or 'unknown'})",
@@ -671,6 +730,7 @@ class KafkaCruiseControl:
             "proposalCache": self.proposal_cache.export_state(),
             "fencingEpoch": (self.elector.epoch
                              if self.elector is not None else 0),
+            "journal": self.journal.export_state(),
         }
 
     def restore_from_snapshot(self, now_ms: int | None = None) -> bool:
@@ -711,6 +771,12 @@ class KafkaCruiseControl:
         if self.elector is not None:
             self.elector.observe_epoch_floor(
                 payload.get("fencingEpoch", 0))
+        journal_state = payload.get("journal")
+        if journal_state:
+            # Merge (never replace): the restoring process's own events —
+            # including the restore-refusal trail that may have preceded
+            # this successful restore — stay in its ring.
+            self.journal.restore_state(journal_state)
         LOG.info(
             "restored serving state from snapshot: generation %s, "
             "resident %s, cached proposals %s (generation %s) — serving "
@@ -729,6 +795,12 @@ class KafkaCruiseControl:
         now = now_ms if now_ms is not None else self._now_ms()
         role = (self.elector.tick(now) if self.elector is not None
                 else "leader")
+        if self.slo is not None:
+            # Rides ha_tick (not only the detector loop) so standby
+            # processes evaluate the standby-staleness objective too;
+            # interval-throttled internally.
+            self.slo.evaluate(now)
+        self.journal.maybe_persist(now)
         if self.replication is not None:
             # Streaming mode: the leader publishes delta frames (and
             # still writes the cadenced full snapshot — it remains the
@@ -869,6 +941,9 @@ class KafkaCruiseControl:
                 source_stale
                 or self.monitor.history_stale(self._now_ms())):
             from ..monitor import StaleClusterModelError
+            self.journal.record(
+                "execute", "refused-stale-model", severity="warn",
+                detail={"sourceStale": bool(source_stale)})
             raise StaleClusterModelError(
                 "refusing non-dryrun execution against a stale cluster "
                 "model (source model stale-served: "
@@ -898,6 +973,16 @@ class KafkaCruiseControl:
             schedule = self._device_schedule(proposals, executor_kwargs)
             if schedule is not None:
                 executor_kwargs["schedule"] = schedule
+                stats = dict(schedule.stats)
+                self.journal.record(
+                    "execute", "schedule-built",
+                    severity=("warn" if stats.get("unrepaired_violations")
+                              else "info"),
+                    detail={k: stats.get(k) for k in
+                            ("batches", "moves", "repair_rounds",
+                             "boundaries_audited",
+                             "unrepaired_violations")
+                            if k in stats})
         if progress:
             progress.add_step("ExecutingProposals")
         return self.executor.execute_proposals(proposals, uuid=uuid,
@@ -1217,6 +1302,48 @@ class KafkaCruiseControl:
         return res, exec_res
 
     # ----------------------------------------------------------- get ops
+    def _journal_plan(self, res: OptimizerResult) -> int | None:
+        """Journal the plan-selection decision ONCE per distinct result
+        object (cached entries serve the same object repeatedly), so
+        every later served event chains back to one plan-selected seq.
+        Identity scan over ≤8 recent plans: O(1) on the warm path."""
+        for r, s in self._recent_plans:
+            if r is res:
+                return s
+        if not self.journal.enabled:
+            return None
+        if res.violated_hard_goals:
+            self.journal.record(
+                "optimizer", "hard-goal-violation", severity="warn",
+                detail={"violated": [str(g)
+                                     for g in res.violated_hard_goals]})
+        seq = self.journal.record(
+            "optimizer", "plan-selected",
+            detail={"numProposals": len(res.proposals),
+                    "staleModel": bool(res.stale_model)})
+        pop = getattr(self.optimizer, "last_population_stats", None)
+        if pop is not None and id(pop) != self._journaled_pop_id:
+            self._journaled_pop_id = id(pop)
+            self.journal.record(
+                "optimizer", "population-winner", cause=seq,
+                detail={"winner": pop.get("winner"),
+                        "winnerIsAnchor": pop.get("winnerIsAnchor"),
+                        "size": pop.get("size"),
+                        "paretoFrontSize": pop.get("paretoFrontSize")})
+        self._recent_plans.append((res, seq))
+        del self._recent_plans[:-8]
+        return seq
+
+    def _journal_propose(self, res: OptimizerResult, source: str) -> None:
+        """The propose→serve causality pair on the serving path."""
+        if not self.journal.enabled:
+            return
+        self.journal.record(
+            "propose", "served", cause=self._journal_plan(res),
+            detail={"source": source,
+                    "numProposals": len(res.proposals),
+                    "staleModel": bool(res.stale_model)})
+
     def proposals(self, ignore_cache: bool = False,
                   goals: list[str] | None = None,
                   progress: OperationProgress | None = None) -> OptimizerResult:
@@ -1226,9 +1353,11 @@ class KafkaCruiseControl:
         cache path. A request naming ``goals`` always computes fresh — the
         cache only holds default-chain results."""
         if ignore_cache or goals:
-            return self._optimize(progress, goals,
-                                  OptimizationOptions(
-                                      skip_hard_goal_check=True))
+            res = self._optimize(progress, goals,
+                                 OptimizationOptions(
+                                     skip_hard_goal_check=True))
+            self._journal_propose(res, "fresh")
+            return res
         if self._follower_serving():
             # Replication follower: never recompute — serve the newest
             # replicated entry (stale-flagged at restore, so the
@@ -1236,8 +1365,11 @@ class KafkaCruiseControl:
             # bounded-staleness read gate police its age.
             e = self.proposal_cache.latest_entry()
             if e is not None:
+                self._journal_propose(e.result, "replicated-cache")
                 return e.result
-        return self.proposal_cache.get(self._now_ms())
+        res = self.proposal_cache.get(self._now_ms())
+        self._journal_propose(res, "cache")
+        return res
 
     def simulate(self, payload: dict) -> dict:
         """What-if scenario sweep over the live cluster model (the
@@ -1453,6 +1585,31 @@ class KafkaCruiseControl:
             None if stats is None and self._last_deferral is None
             else {"schedule": stats, "forecastDeferral": self._last_deferral})
         return payload
+
+    # ------------------------------------------------- flight recorder
+    def trace_json(self) -> dict:
+        """``GET /trace``: the Chrome-trace export — spans from the
+        tracer plus the journal's decisions as instant ("i") events on
+        the same perf_counter timeline, so a decision row sits visually
+        between the spans that produced it."""
+        trace = self.tracer.to_chrome_trace()
+        trace["traceEvents"] = list(trace.get("traceEvents", ())) + \
+            self.journal.chrome_instant_events(self.tracer._epoch)
+        return trace
+
+    def history_json(self, categories: list[str] | None = None,
+                     severity: str | None = None, since_seq: int = 0,
+                     limit: int = 256) -> dict:
+        """``GET /history``: the filtered decision journal. Served
+        locally on EVERY role — a read replica answers from the journal
+        it applied off the leader's stream (plus its own local events),
+        which is what makes post-failover forensics possible when the
+        old leader is gone."""
+        out = self.journal.history_json(
+            categories=categories, min_severity=severity,
+            since_seq=since_seq, limit=limit)
+        out["role"] = self.ha_role()
+        return out
 
     # -------------------------------------------------------- fleet ops
     def fleet_summary(self) -> dict:

@@ -117,6 +117,11 @@ ENDPOINTS: dict[str, tuple[str, str, list[tuple[str, str, str]]]] = {
                                  "host-side fitting + a dry-run scoring "
                                  "dispatch — provisioning stays behind "
                                  "rightsize / the detector", []),
+    "history": ("get", "Control-plane flight recorder: the causal "
+                       "decision journal (core/events.py) with "
+                       "category/severity/since_seq filters; replicas "
+                       "serve the leader's streamed journal merged with "
+                       "their own (docs/observability.md)", []),
 }
 
 
@@ -343,6 +348,35 @@ _SCHEMAS = {
                         "scenario": {"type": "string"},
                     }}},
             }},
+        }},
+    "EventHistory": {
+        "type": "object",
+        "description": "flight-recorder journal read (core/events.py "
+                       "EventJournal); events are causally linked via "
+                       "cause -> seq",
+        "properties": {
+            "version": {"type": "integer"},
+            "node": {"type": "string", "nullable": True},
+            "role": {"type": "string"},
+            "lastSeq": {"type": "integer"},
+            "numEvents": {"type": "integer"},
+            "dropped": {"type": "integer"},
+            "capacity": {"type": "integer"},
+            "events": {"type": "array", "items": {
+                "type": "object", "properties": {
+                    "seq": {"type": "integer"},
+                    "tsMs": {"type": "integer"},
+                    "category": {"type": "string"},
+                    "action": {"type": "string"},
+                    "severity": {"type": "string",
+                                 "enum": ["info", "warn", "error"]},
+                    "epoch": {"type": "integer", "nullable": True},
+                    "spanId": {"type": "string", "nullable": True},
+                    "cause": {"type": "integer", "nullable": True,
+                              "description": "seq of the causing event"},
+                    "node": {"type": "string", "nullable": True},
+                    "detail": {"type": "object", "nullable": True},
+                }}},
         }},
     "TraceEvents": {
         "type": "object",
@@ -593,6 +627,8 @@ def openapi_spec(base_path: str = "/kafkacruisecontrol") -> dict:
             ok.update(_ref("FleetSummary"))
         elif name in ("forecast", "forecast_refresh"):
             ok.update(_ref("ForecastReport"))
+        elif name == "history":
+            ok.update(_ref("EventHistory"))
         # JSON is the documented default body (json defaults true): every
         # 200 advertises application/json — a typed $ref where one
         # exists, a generic object otherwise.

@@ -555,6 +555,19 @@ class ForecastParameters(EndpointParameters):
     sweep report (json=false renders the fixed-width horizon table)."""
 
 
+class HistoryParameters(EndpointParameters):
+    """``GET /history`` — the control-plane flight recorder
+    (core/events.py). Filters narrow the journal read; ``since_seq``
+    makes polling incremental (json=false renders the fixed-width
+    event table)."""
+
+    PARAMS = (Param("category", "csv_str"),
+              Param("severity", "enum",
+                    choices=("INFO", "WARN", "ERROR")),
+              Param("since_seq", "int", default=0, min_value=0),
+              Param("limit", "int", default=256, min_value=1))
+
+
 class ForecastRefreshParameters(EndpointParameters):
     """``POST /forecast`` — force a refit from the current window
     history plus one fresh trajectory sweep. Purely host-side fitting
@@ -594,6 +607,7 @@ ENDPOINT_PARAMETERS: dict[str, type[EndpointParameters]] = {
     "fleet_rebalance": FleetRebalanceParameters,
     "forecast": ForecastParameters,
     "forecast_refresh": ForecastRefreshParameters,
+    "history": HistoryParameters,
 }
 
 

@@ -320,7 +320,28 @@ def _render_forecast(payload: dict) -> str:
     return text
 
 
+def _render_history(payload: dict) -> str:
+    rows = []
+    for e in payload.get("events", []):
+        detail = e.get("detail")
+        rows.append([e.get("seq"), e.get("tsMs"),
+                     e.get("category", ""), e.get("action", ""),
+                     e.get("severity", ""),
+                     e.get("epoch") if e.get("epoch") is not None else "-",
+                     e.get("cause") if e.get("cause") is not None else "-",
+                     e.get("node") or "-",
+                     json.dumps(detail, sort_keys=True) if detail else "-"])
+    text = _table(["SEQ", "TS_MS", "CATEGORY", "ACTION", "SEV", "EPOCH",
+                   "CAUSE", "NODE", "DETAIL"], rows)
+    text += (f"\n\nrole: {payload.get('role')}, node: "
+             f"{payload.get('node') or '-'}, lastSeq: "
+             f"{payload.get('lastSeq')}, shown: {payload.get('numEvents')},"
+             f" dropped: {payload.get('dropped')}")
+    return text
+
+
 _RENDERERS = {
+    "history": _render_history,
     "load": _render_load,
     "forecast": _render_forecast,
     "forecast_refresh": _render_forecast,

@@ -36,6 +36,8 @@ ENDPOINT_MIN_ROLE: dict[str, Role] = {
     # forecast report is a read; forcing a refit + sweep is USER-level
     # like fleet_rebalance (compute, never execution).
     "forecast": Role.VIEWER, "forecast_refresh": Role.USER,
+    # the flight recorder is a read-only forensic surface
+    "history": Role.VIEWER,
     "rebalance": Role.USER, "add_broker": Role.USER,
     "remove_broker": Role.USER, "demote_broker": Role.USER,
     "fix_offline_replicas": Role.USER, "topic_configuration": Role.USER,

@@ -43,7 +43,7 @@ _PLAINTEXT_MARKER = "x-cc-render-plaintext"
 GET_ENDPOINTS = {"state", "load", "partition_load", "proposals",
                  "kafka_cluster_state", "user_tasks", "review_board",
                  "permissions", "bootstrap", "train", "openapi", "fleet",
-                 "forecast"}
+                 "forecast", "history"}
 POST_ENDPOINTS = {"rebalance", "add_broker", "remove_broker",
                   "fix_offline_replicas", "demote_broker",
                   "topic_configuration", "rightsize", "remove_disks",
@@ -717,6 +717,16 @@ class CruiseControlApp:
             return 200, facade.forecast_json(), {}
         if endpoint == "forecast_refresh":
             return 200, facade.forecast_refresh(), {}
+        if endpoint == "history":
+            # The flight recorder is an observability surface: never
+            # render-cached and never staleness-gated — a lagging replica's
+            # own journal is exactly what post-failover forensics needs.
+            severity = params.get("severity")
+            return 200, facade.history_json(
+                categories=params.get("category"),
+                severity=severity.lower() if severity else None,
+                since_seq=params.get("since_seq", 0),
+                limit=params.get("limit", 256)), {}
         return 404, {"errorMessage": f"unknown endpoint {endpoint}"}, {}
 
     def _admin(self, params: ParsedParams) -> dict:
@@ -966,7 +976,7 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
             return json_resp(e.status, {"errorMessage": str(e)},
                              _auth_headers(e, app.security))
         with app.request_timing("GET", "trace") as outcome:
-            body = json.dumps(app.facade.tracer.to_chrome_trace()).encode()
+            body = json.dumps(app.facade.trace_json()).encode()
             outcome["status"] = 200
         return 200, "application/json", body, {}
     # /devicestats: the device-runtime ledger (compile lifecycle,
@@ -1103,6 +1113,12 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
         status, payload = 429, {"errorMessage": str(e),
                                 "principal": e.principal}
         extra = {"Retry-After": str(e.retry_after_s)}
+        journal = getattr(app.facade, "journal", None)
+        if journal is not None:
+            journal.record("admission", "shed-429", severity="warn",
+                           detail={"principal": e.principal,
+                                   "endpoint": endpoint,
+                                   "retryAfterS": e.retry_after_s})
     except NotLeaderError as e:
         # Sync execution path on a standby replica (async paths map this
         # inside _handle_async, keeping their User-Task-ID header).

@@ -404,6 +404,77 @@ def _analyzer_defs(d: ConfigDef) -> None:
              doc="Bucket depth: the burst of back-to-back writes one "
                  "principal may issue before the steady-state rate "
                  "applies.")
+    d.define("events.enabled", ConfigType.BOOLEAN, True,
+             importance=Importance.MEDIUM,
+             doc="Control-plane flight recorder (core/events.py "
+                 "EventJournal): every decision point journals a "
+                 "structured, causally-linked event served at /history, "
+                 "exported to /trace and streamed to read replicas. "
+                 "Disabling turns record() into a no-op (the A/B switch "
+                 "the overhead bench gates on).")
+    d.define("events.ring.capacity", ConfigType.INT, 4096,
+             validator=Range.at_least(64), importance=Importance.LOW,
+             doc="Bounded event ring size; older events drop (counted "
+                 "in EventJournal.dropped) once full.")
+    d.define("events.segment.path", ConfigType.STRING, "",
+             importance=Importance.LOW,
+             doc="JSONL journal segment file for crash-safe persistence "
+                 "(tmp + fsync + replace; one .prev rotation at "
+                 "events.segment.rotate.bytes). Empty = in-memory only. "
+                 "Restored through the restricted decoder on startup — "
+                 "malformed lines are refused and metered, never "
+                 "crash-looped.")
+    d.define("events.segment.rotate.bytes", ConfigType.LONG, 262_144,
+             validator=Range.at_least(4096), importance=Importance.LOW,
+             doc="Rotate the active journal segment to .prev once its "
+                 "encoded size crosses this bound.")
+    d.define("events.persist.interval.ms", ConfigType.LONG, 30_000,
+             validator=Range.at_least(100), importance=Importance.LOW,
+             doc="Journal persistence cadence off ha_tick (only with "
+                 "events.segment.path set).")
+    d.define("events.categories", ConfigType.LIST, "",
+             importance=Importance.LOW,
+             doc="Category allow-list filter (propose, optimizer, "
+                 "execute, election, replication, admission, detector, "
+                 "snapshot, slo). Empty = record everything.")
+    d.define("slo.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.MEDIUM,
+             doc="Burn-rate SLO evaluator (core/slo.py): fast+slow "
+                 "window violation fractions over proposal freshness "
+                 "lag, replication stream lag and standby snapshot "
+                 "staleness; breaches journal slo events and raise the "
+                 "lowest-priority SLO_BREACH anomaly through the "
+                 "notifier path (alert-only — fix() declines).")
+    d.define("slo.fast.window.ms", ConfigType.LONG, 60_000,
+             validator=Range.at_least(1_000), importance=Importance.LOW,
+             doc="Fast burn-rate window (page-worthy burn).")
+    d.define("slo.slow.window.ms", ConfigType.LONG, 600_000,
+             validator=Range.at_least(10_000), importance=Importance.LOW,
+             doc="Slow burn-rate window (sustained burn confirmation).")
+    d.define("slo.fast.burn.threshold", ConfigType.DOUBLE, 0.5,
+             validator=Range.between(0.0, 1.0), importance=Importance.LOW,
+             doc="Violation fraction the fast window must reach; a "
+                 "breach needs BOTH windows over threshold (the "
+                 "multiwindow burn-rate alert shape).")
+    d.define("slo.slow.burn.threshold", ConfigType.DOUBLE, 0.25,
+             validator=Range.between(0.0, 1.0), importance=Importance.LOW,
+             doc="Violation fraction the slow window must reach.")
+    d.define("slo.evaluation.interval.ms", ConfigType.LONG, 5_000,
+             validator=Range.at_least(100), importance=Importance.LOW,
+             doc="Sampling cadence of the SLO evaluator (driven from "
+                 "ha_tick and the detector loop; internally throttled).")
+    d.define("slo.proposal.freshness.target.ms", ConfigType.LONG, 600_000,
+             validator=Range.at_least(1_000), importance=Importance.LOW,
+             doc="Objective target: proposal-cache age above this "
+                 "counts the sample as violating.")
+    d.define("slo.replication.lag.target.ms", ConfigType.LONG, 5_000,
+             validator=Range.at_least(100), importance=Importance.LOW,
+             doc="Objective target: replication stream lag above this "
+                 "counts the sample as violating.")
+    d.define("slo.standby.staleness.target.ms", ConfigType.LONG, 120_000,
+             validator=Range.at_least(1_000), importance=Importance.LOW,
+             doc="Objective target: standby snapshot staleness above "
+                 "this counts the sample as violating.")
     d.define("default.goals", ConfigType.LIST, "",
              importance=Importance.HIGH, doc="Goal chain (empty = built-in)")
     d.define("hard.goals", ConfigType.LIST, "", importance=Importance.MEDIUM,
@@ -763,7 +834,7 @@ def _detector_defs(d: ConfigDef) -> None:
              importance=Importance.HIGH, doc="Master self-healing switch")
     for name in ("broker.failure", "goal.violation", "disk.failure",
                  "topic.anomaly", "metric.anomaly", "maintenance.event",
-                 "broker.risk", "capacity.forecast"):
+                 "broker.risk", "capacity.forecast", "slo.breach"):
         d.define(f"self.healing.{name}.enabled", ConfigType.BOOLEAN, False,
                  importance=Importance.MEDIUM,
                  doc=f"Self-healing for {name} anomalies")

@@ -1,0 +1,497 @@
+"""Control-plane flight recorder: the causal decision journal.
+
+The span tracer (core/tracing.py) answers "where did the latency go" and
+the sensor registry (core/sensors.py) answers "how often"; neither
+answers the operator's first question after an incident: **what did the
+control plane decide, and why**. This module adds that axis: a
+thread-safe bounded ring of structured :class:`Event` records — one per
+control-plane *decision* (a proposal served or refused, a heal
+dispatched, a fence abort, a replica refusing a deposed leader's frame,
+an SLO burn-rate breach) — with:
+
+- **Causality chains.** Every event may name a ``cause`` seq, so the
+  anomaly-detected → fix-dispatched → fix-outcome chain (and the
+  plan-selected → served chain) reads as a linked list on ``/history``.
+- **Trace linkage.** Events capture the recording thread's current
+  SpanTracer span id, so a ``/history`` row jumps straight to the
+  ``/trace`` span that produced it; the journal also exports Chrome
+  instant ("i") events merged into the ``/trace`` payload.
+- **Crash-safe JSONL segments.** ``persist()`` rewrites the active
+  segment atomically (tmp + fsync + ``os.replace`` — the
+  core/snapshot.py discipline) and rotates a full segment to
+  ``<path>.prev`` with one more ``os.replace``; restore re-reads both
+  with a *restricted decode* (strict per-line shape validation, refused
+  lines metered) because the segment sits on the same trust boundary as
+  the snapshot file.
+- **Replication.** ``export_delta`` / ``apply_remote`` let the
+  replication session ship the leader's journal to read replicas
+  (fence-checked like every frame), so ``/history`` serves locally on a
+  replica and post-failover forensics can splice both processes'
+  journals by (node, seq).
+- **Zero device syncs.** Appends read the host clock only; the warm
+  propose path's overhead is gated <2% by bench scenario 12 (the same
+  bar as the tracer).
+
+``enabled = False`` turns the whole journal into a no-op — the bench's
+A/B switch, mirroring :class:`~cruise_control_tpu.core.tracing.
+SpanTracer`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable
+
+from .sensors import MetricRegistry
+
+LOG = logging.getLogger(__name__)
+
+#: sensor group for the journal series (``EventJournal.*``).
+EVENT_SENSOR = "EventJournal"
+
+#: the closed category set — one striped counter per category is
+#: pre-created at construction so the Prometheus family set is stable
+#: (merged-scrape lint asserts HELP-completeness against it).
+CATEGORIES = ("propose", "optimizer", "execute", "election", "replication",
+              "admission", "detector", "snapshot", "slo")
+
+#: severity ladder, least to most severe (the /history ``severity``
+#: filter is a minimum-severity cut).
+SEVERITIES = ("info", "warn", "error")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+class Event:
+    """One recorded decision (immutable once appended)."""
+
+    __slots__ = ("seq", "ts_ms", "perf_s", "category", "action", "severity",
+                 "epoch", "span_id", "cause", "node", "detail")
+
+    def __init__(self, seq: int, ts_ms: int, perf_s: float, category: str,
+                 action: str, severity: str, epoch: int | None,
+                 span_id: int | None, cause: int | None, node: str | None,
+                 detail: dict | None) -> None:
+        self.seq = seq
+        self.ts_ms = ts_ms
+        self.perf_s = perf_s
+        self.category = category
+        self.action = action
+        self.severity = severity
+        self.epoch = epoch
+        self.span_id = span_id
+        self.cause = cause
+        self.node = node
+        self.detail = detail
+
+    def to_json(self) -> dict:
+        return {"seq": self.seq, "tsMs": self.ts_ms,
+                "category": self.category, "action": self.action,
+                "severity": self.severity, "epoch": self.epoch,
+                "spanId": self.span_id, "cause": self.cause,
+                "node": self.node, "detail": self.detail}
+
+
+def _event_from_json(obj) -> Event | None:
+    """Restricted decode for the trust boundary (segment restore and
+    replicated journal frames): strict shape validation per record —
+    wrong types, unknown categories/severities, or a non-dict detail all
+    refuse the record rather than poisoning the ring. Returns None on
+    refusal (the caller meters it)."""
+    if not isinstance(obj, dict):
+        return None
+    try:
+        seq = int(obj["seq"])
+        ts_ms = int(obj["tsMs"])
+        category = obj["category"]
+        action = obj["action"]
+        severity = obj.get("severity", "info")
+    except (KeyError, TypeError, ValueError):
+        return None
+    if seq < 1 or category not in CATEGORIES or severity not in SEVERITIES:
+        return None
+    if not isinstance(action, str) or not action or len(action) > 128:
+        return None
+    epoch = obj.get("epoch")
+    cause = obj.get("cause")
+    span_id = obj.get("spanId")
+    node = obj.get("node")
+    detail = obj.get("detail")
+    if epoch is not None and not isinstance(epoch, int):
+        return None
+    if cause is not None and not isinstance(cause, int):
+        return None
+    if span_id is not None and not isinstance(span_id, int):
+        return None
+    if node is not None and not isinstance(node, str):
+        return None
+    if detail is not None and not isinstance(detail, dict):
+        return None
+    return Event(seq, ts_ms, 0.0, category, action, severity, epoch,
+                 span_id, cause, node, detail)
+
+
+class EventJournal:
+    """Thread-safe bounded decision ring + JSONL segment persistence.
+
+    ``capacity`` bounds memory (oldest events drop, counted);
+    ``enabled`` turns :meth:`record` into a no-op (the overhead A/B
+    switch); ``categories`` restricts recording to a subset (the
+    per-category enable — None records everything)."""
+
+    def __init__(self, capacity: int = 4096, *,
+                 registry: MetricRegistry | None = None,
+                 tracer=None, node: str | None = None,
+                 segment_path: str | None = None,
+                 rotate_bytes: int = 262_144,
+                 persist_interval_ms: int = 30_000,
+                 categories: Iterable[str] | None = None,
+                 now_ms: Callable[[], int] | None = None) -> None:
+        self.capacity = int(capacity)
+        self.enabled = True
+        self.node = node
+        self.segment_path = segment_path
+        self.rotate_bytes = int(rotate_bytes)
+        self.persist_interval_ms = int(persist_interval_ms)
+        self.categories = (frozenset(categories)
+                           if categories is not None else None)
+        self.tracer = tracer
+        self._now_ms = now_ms or (lambda: int(time.time() * 1000))
+        self._perf = time.perf_counter
+        self._ring: "deque[Event]" = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dropped = 0
+        #: per-node max seq applied via :meth:`apply_remote` — the
+        #: replication dedup floor (cursor rejoins re-deliver frames).
+        self._remote_floors: dict[str, int] = {}
+        self._last_persist_ms: int | None = None
+        #: seq floor of the active segment: events below it graduated to
+        #: ``<path>.prev`` at the last rotation.
+        self._persist_floor = 1
+        self._last_persisted_seq = 0
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        g = EVENT_SENSOR
+        # Pre-created per-category/per-severity striped counters: the
+        # record hot path never creates sensors (registry mutations
+        # invalidate the scrape render cache) and the family set is
+        # scrape-stable from construction.
+        self._cat_counters = {
+            c: self.registry.striped_counter(name(g, f"events-{c}"))
+            for c in CATEGORIES}
+        self._sev_counters = {
+            s: self.registry.striped_counter(name(g, f"severity-{s}"))
+            for s in SEVERITIES}
+        self._applied_remote = self.registry.counter(
+            name(g, "applied-remote"))
+        self._refused_records = self.registry.counter(
+            name(g, "refused-records"))
+        self._persist_writes = self.registry.counter(
+            name(g, "persist-writes"))
+        self._persist_failures = self.registry.meter(
+            name(g, "persist-failure-rate"))
+        self.registry.gauge(name(g, "last-seq"), lambda: self._seq)
+        self.registry.gauge(name(g, "dropped"), lambda: self._dropped)
+
+    def configure(self, *, enabled: bool | None = None,
+                  capacity: int | None = None,
+                  segment_path: str | None = None,
+                  rotate_bytes: int | None = None,
+                  persist_interval_ms: int | None = None,
+                  categories: Iterable[str] | None = None,
+                  node: str | None = None) -> None:
+        """Apply the ``events.*`` config keys to a journal the facade
+        already constructed (serve.py wiring). None leaves a field as-is;
+        a capacity change re-bounds the ring in place."""
+        if enabled is not None:
+            self.enabled = bool(enabled)
+        if capacity is not None and int(capacity) != self.capacity:
+            with self._lock:
+                self.capacity = int(capacity)
+                self._ring = deque(self._ring, maxlen=self.capacity)
+        if segment_path is not None:
+            self.segment_path = segment_path or None
+        if rotate_bytes is not None:
+            self.rotate_bytes = int(rotate_bytes)
+        if persist_interval_ms is not None:
+            self.persist_interval_ms = int(persist_interval_ms)
+        if categories is not None:
+            unknown = sorted(set(categories) - set(CATEGORIES))
+            if unknown:
+                raise ValueError(f"unknown event categories {unknown} "
+                                 f"(known: {CATEGORIES})")
+            self.categories = frozenset(categories) or None
+        if node is not None:
+            self.node = node
+
+    # ----------------------------------------------------------- recording
+    def record(self, category: str, action: str, *,
+               severity: str = "info", cause: int | None = None,
+               epoch: int | None = None,
+               detail: dict | None = None) -> int | None:
+        """Append one decision. Returns the assigned seq (the handle a
+        later event passes as ``cause``), or None when disabled or the
+        category is filtered out. Host-clock only — zero device syncs."""
+        if not self.enabled:
+            return None
+        if category not in CATEGORIES:
+            raise ValueError(f"unknown event category {category!r} "
+                             f"(known: {CATEGORIES})")
+        if self.categories is not None and category not in self.categories:
+            return None
+        if severity not in SEVERITIES:
+            severity = "info"
+        span_id = (self.tracer.current_span_id()
+                   if self.tracer is not None else None)
+        ts_ms = self._now_ms()
+        perf_s = self._perf()
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            if len(self._ring) >= self.capacity:
+                self._dropped += 1
+            self._ring.append(Event(seq, ts_ms, perf_s, category, action,
+                                    severity, epoch, span_id, cause,
+                                    self.node, detail))
+        # Striped counters: lock-free inc, outside the ring lock.
+        self._cat_counters[category].inc()
+        self._sev_counters[severity].inc()
+        return seq
+
+    # -------------------------------------------------------------- reads
+    @property
+    def last_seq(self) -> int:
+        return self._seq
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def events(self) -> list[Event]:
+        with self._lock:
+            return list(self._ring)
+
+    def query(self, *, categories: Iterable[str] | None = None,
+              min_severity: str | None = None, since_seq: int = 0,
+              limit: int = 256) -> list[Event]:
+        """Filtered read, newest-last. ``categories`` is an exact-match
+        set; ``min_severity`` is a floor on the severity ladder;
+        ``since_seq`` is exclusive; ``limit`` keeps the newest rows."""
+        cats = frozenset(categories) if categories else None
+        floor = _SEV_RANK.get(min_severity, 0) if min_severity else 0
+        out = [e for e in self.events()
+               if e.seq > since_seq
+               and (cats is None or e.category in cats)
+               and _SEV_RANK[e.severity] >= floor]
+        return out[-max(int(limit), 0):]
+
+    def history_json(self, *, categories: Iterable[str] | None = None,
+                     min_severity: str | None = None, since_seq: int = 0,
+                     limit: int = 256) -> dict:
+        """The ``GET /history`` payload."""
+        rows = self.query(categories=categories, min_severity=min_severity,
+                          since_seq=since_seq, limit=limit)
+        return {"node": self.node, "lastSeq": self._seq,
+                "numEvents": len(self._ring), "dropped": self._dropped,
+                "capacity": self.capacity,
+                "events": [e.to_json() for e in rows]}
+
+    def to_json(self, limit: int = 64) -> dict:
+        """Bounded snapshot for ``/state`` embedding."""
+        return self.history_json(limit=limit)
+
+    def chrome_instant_events(self, epoch_s: float) -> list[dict]:
+        """Chrome-trace instant ("i") events merged into the ``/trace``
+        payload — ``epoch_s`` is the tracer's perf_counter epoch so the
+        journal rides the same timeline as the spans. Remotely-applied
+        events carry their *arrival* perf stamp (the leader's
+        perf_counter is meaningless here)."""
+        pid = os.getpid()
+        return [{"name": f"{e.category}.{e.action}", "ph": "i",
+                 "cat": "journal", "s": "p",
+                 "ts": round((e.perf_s - epoch_s) * 1e6, 3),
+                 "pid": pid, "tid": 0,
+                 "args": {"seq": e.seq, "severity": e.severity,
+                          "cause": e.cause, "epoch": e.epoch,
+                          "spanId": e.span_id}}
+                for e in self.events() if e.perf_s]
+
+    # -------------------------------------------------------- replication
+    def export_delta(self, since_seq: int, limit: int = 512) -> list[dict]:
+        """Events with ``seq > since_seq`` as JSON dicts — the
+        replication frame body. Bounded: a replica that missed more than
+        ``limit`` events catches the rest on later frames (seqs are
+        contiguous per node, so nothing is silently skipped as long as
+        the publisher advances its cursor by what it shipped)."""
+        out = [e.to_json() for e in self.events() if e.seq > since_seq]
+        return out[:max(int(limit), 0)]
+
+    def apply_remote(self, entries: list, *,
+                     source_node: str | None = None) -> int:
+        """Apply a leader's journal delta (replication follower side).
+        Strictly validated per record; duplicates (cursor rejoins
+        re-deliver frames) dedup on a per-node seq floor; the local seq
+        counter jumps past every applied seq so local events stay
+        monotonic above them. Returns the number applied."""
+        if not isinstance(entries, (list, tuple)):
+            return 0
+        applied = 0
+        now_perf = self._perf()
+        with self._lock:
+            for obj in entries:
+                ev = _event_from_json(obj)
+                if ev is None:
+                    self._refused_records.inc()
+                    continue
+                node = ev.node or source_node or "remote"
+                if ev.seq <= self._remote_floors.get(node, 0):
+                    continue            # re-delivered duplicate
+                self._remote_floors[node] = ev.seq
+                ev.node = node          # remote rows always name a node
+                ev.perf_s = now_perf
+                if len(self._ring) >= self.capacity:
+                    self._dropped += 1
+                self._ring.append(ev)
+                self._seq = max(self._seq, ev.seq)
+                applied += 1
+        if applied:
+            self._applied_remote.inc(applied)
+        return applied
+
+    # ----------------------------------------------------------- snapshot
+    def export_state(self) -> dict:
+        """Snapshot-payload section (host-side JSON data only)."""
+        return {"seq": self._seq,
+                "events": [e.to_json() for e in self.events()]}
+
+    def restore_state(self, state) -> int:
+        """Merge a snapshot's journal section (restart warm-restore and
+        the replica resync path). Reuses the remote-apply validation and
+        dedup; local events already in the ring are preserved."""
+        if not isinstance(state, dict):
+            return 0
+        n = self.apply_remote(state.get("events") or [])
+        with self._lock:
+            self._seq = max(self._seq, int(state.get("seq", 0) or 0))
+        return n
+
+    # -------------------------------------------------------- persistence
+    def persist(self, now_ms: int | None = None) -> int | None:
+        """Rewrite the active JSONL segment atomically (tmp + fsync +
+        ``os.replace``); when the active segment would exceed
+        ``rotate_bytes`` the previously-persisted content graduates to
+        ``<path>.prev`` first (one more atomic ``os.replace``), so a
+        crash at any point leaves both files complete. Best-effort on
+        IO (metered + logged). Returns bytes written, or None."""
+        if not self.segment_path:
+            return None
+        from .snapshot import atomic_write_bytes
+        with self._lock:
+            events = list(self._ring)
+            floor = self._persist_floor
+            last = self._last_persisted_seq
+        # Only THIS process's events persist to its segment (remote rows
+        # re-arrive over the stream or the snapshot); events recorded
+        # before the node id was configured count as local.
+        active = [e for e in events
+                  if e.seq >= floor and e.node in (None, self.node)]
+        data = self._encode(active)
+        if len(data) > self.rotate_bytes and last >= floor:
+            # Rotate: the old active file (events floor..last) becomes
+            # .prev; the fresh active carries only the newer events.
+            try:
+                os.replace(self.segment_path, self.segment_path + ".prev")
+            except FileNotFoundError:
+                pass
+            except OSError as exc:
+                self._persist_failures.mark()
+                LOG.warning("journal segment rotation failed (%s); "
+                            "keeping one segment", exc)
+            floor = last + 1
+            active = [e for e in active if e.seq >= floor]
+            data = self._encode(active)
+        try:
+            atomic_write_bytes(self.segment_path, data)
+        except Exception as exc:   # noqa: BLE001 — serving must survive IO
+            self._persist_failures.mark()
+            LOG.warning("journal persist to %s failed (%s: %s)",
+                        self.segment_path, type(exc).__name__, exc)
+            return None
+        with self._lock:
+            self._persist_floor = floor
+            self._last_persisted_seq = max(
+                self._last_persisted_seq,
+                max((e.seq for e in active), default=0))
+            self._last_persist_ms = (now_ms if now_ms is not None
+                                     else self._now_ms())
+        self._persist_writes.inc()
+        return len(data)
+
+    def maybe_persist(self, now_ms: int) -> bool:
+        """Cadenced persist (the ha_tick hook): write when
+        ``persist_interval_ms`` elapsed since the last one."""
+        if not self.segment_path:
+            return False
+        with self._lock:
+            if (self._last_persist_ms is not None
+                    and now_ms - self._last_persist_ms
+                    < self.persist_interval_ms):
+                return False
+            if self._seq <= self._last_persisted_seq:
+                self._last_persist_ms = now_ms
+                return False
+        return self.persist(now_ms) is not None
+
+    @staticmethod
+    def _encode(events: list[Event]) -> bytes:
+        lines = [json.dumps(e.to_json(), sort_keys=True, default=str)
+                 for e in events]
+        return ("\n".join(lines) + "\n").encode("utf-8") if lines else b""
+
+    def restore_from_disk(self) -> int:
+        """Reload persisted segments (``.prev`` first, then the active
+        one) through the restricted per-line decode; malformed lines are
+        metered and skipped, never fatal. The local seq counter resumes
+        past the highest restored seq. Returns events restored."""
+        if not self.segment_path:
+            return 0
+        restored = 0
+        max_seq = 0
+        for path in (self.segment_path + ".prev", self.segment_path):
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+            except OSError:
+                continue
+            for line in raw.splitlines():
+                if not line.strip():
+                    continue
+                try:
+                    obj = json.loads(line)
+                except ValueError:
+                    self._refused_records.inc()
+                    continue
+                ev = _event_from_json(obj)
+                if ev is None:
+                    self._refused_records.inc()
+                    continue
+                with self._lock:
+                    if len(self._ring) >= self.capacity:
+                        self._dropped += 1
+                    self._ring.append(ev)
+                max_seq = max(max_seq, ev.seq)
+                restored += 1
+        if restored:
+            with self._lock:
+                self._seq = max(self._seq, max_seq)
+                self._persist_floor = max_seq + 1
+                self._last_persisted_seq = max(self._last_persisted_seq,
+                                               max_seq)
+            LOG.info("restored %d journal event(s) from %s (resuming at "
+                     "seq %d)", restored, self.segment_path, self._seq + 1)
+        return restored

@@ -89,6 +89,9 @@ class LeaderElector:
         self.observed_epoch = 0
         self._lease_until = 0
         self._last_leader_id: str | None = None
+        #: decision journal (core/events.py), attached by the facade —
+        #: epoch transitions are THE election decisions worth recording.
+        self.journal = None
         self.registry = registry or MetricRegistry()
         name = MetricRegistry.name
         self._takeovers = self.registry.counter(name(HA_SENSOR,
@@ -193,6 +196,13 @@ class LeaderElector:
                 self._role = "leader"
                 self._last_leader_id = self.identity
                 self._takeovers.inc()
+                if self.journal is not None:
+                    self.journal.record(
+                        "election", "took-leadership", severity="warn",
+                        epoch=new_epoch,
+                        detail={"identity": self.identity,
+                                "previousHolder": holder,
+                                "previousEpoch": epoch, "wasRole": was})
                 LOG.warning(
                     "%s took leadership (fencing epoch %d, previous "
                     "holder %s, was %s)", self.identity, new_epoch,
@@ -218,6 +228,11 @@ class LeaderElector:
     # ----------------------------------------------------------- helpers
     def _demote(self, why: str) -> None:
         if self._role == "leader":
+            if self.journal is not None:
+                self.journal.record(
+                    "election", "stepped-down", severity="warn",
+                    epoch=self.epoch,
+                    detail={"identity": self.identity, "why": why})
             LOG.warning("%s stepping down to standby: %s (epoch %d)",
                         self.identity, why, self.epoch)
         self._role = "standby"

@@ -381,6 +381,11 @@ class ReplicationSession:
         #: highest fencing epoch seen on any frame — the refusal floor
         self.fence_floor = 0
         self._published_clocks = None
+        #: decision journal (core/events.py), attached by the facade —
+        #: state-machine transitions and fence refusals are recorded
+        #: locally on each process (the refusing replica's own journal
+        #: is the forensic record of a deposed leader's frames).
+        self.journal = None
         self.registry = registry or MetricRegistry()
         name = MetricRegistry.name
         g = REPLICATION_SENSOR
@@ -413,6 +418,12 @@ class ReplicationSession:
             return
         LOG.info("replication[%s]: %s -> %s%s", self.node_id, self.state,
                  state, f" ({reason})" if reason else "")
+        if self.journal is not None:
+            self.journal.record(
+                "replication", "state-transition",
+                severity="warn" if state in (LAGGING, RESYNC) else "info",
+                epoch=self.fence_floor or None,
+                detail={"from": self.state, "to": state, "reason": reason})
         self.state = state
         self._transitions[state].inc()
 
@@ -520,6 +531,12 @@ class ReplicationSession:
             pb["ingest"] = fb.get("ingest", pb.get("ingest"))
         elif fb is not None:
             pending["resident"] = fb
+        # Journal deltas append in order — each entry carries its own
+        # seq, so a merged frame applies exactly like its constituents.
+        fj = frame.get("journal")
+        if fj:
+            pending["journal"] = list(pending.get("journal") or ()) \
+                + list(fj)
         # Newest metadata wins: followers treat the merged frame as the
         # latest word from this leader term.
         for key in ("clusterId", "generation", "fencingEpoch", "clocks",
@@ -588,6 +605,16 @@ class ReplicationSession:
             # A deposed leader's frame: refuse, never apply. The cursor
             # still advances — the frame is dead, not pending.
             self._refused.inc()
+            if self.journal is not None:
+                # Recorded in the REPLICA's own journal (never applied
+                # from the deposed stream) — the post-failover forensic
+                # evidence that the fence held.
+                self.journal.record(
+                    "replication", "frame-refused-epoch", severity="warn",
+                    epoch=epoch,
+                    detail={"seq": frame.get("seq"),
+                            "fenceFloor": self.fence_floor,
+                            "fromNode": frame.get("node")})
             self._stamp(now_ms, frame["seq"], epoch, "refused-epoch",
                         f"below fence floor {self.fence_floor}")
             return True

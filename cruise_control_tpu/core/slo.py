@@ -1,0 +1,217 @@
+"""SLO burn-rate evaluation over the control plane's freshness signals.
+
+Gauges tell an operator the *current* proposal-freshness lag, replication
+stream lag, and standby snapshot staleness; they do not tell them when to
+page. This module closes that gap with the standard multi-window,
+multi-burn-rate recipe: each objective keeps a **fast** window (is the
+error budget burning *right now*) and a **slow** window (has it been
+burning *long enough to matter*), and a breach fires only when **both**
+windows' violation fractions exceed their thresholds — fast-only spikes
+and slow-decaying history alone don't page, which is what keeps the
+alert anti-flappy.
+
+On a new breach the evaluator journals an ``slo`` event (severity warn)
+and queues a lowest-priority :class:`SLO_BREACH` anomaly for the
+detector manager, which routes it through the existing notifier path
+(alert-only: its ``fix()`` declines self-healing). Recovery journals a
+cause-linked ``recovered`` event closing the chain.
+
+Windows are sample-based over wall-ms timestamps; ``evaluate`` is
+interval-throttled so both ``ha_tick`` (standby processes run no
+detector loop but still need standby-staleness alerts) and the detector
+manager (leader) can call it at their own cadence without double work.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Callable
+
+from .sensors import MetricRegistry
+
+LOG = logging.getLogger(__name__)
+
+#: sensor group for the evaluator's series (``SLO.*``).
+SLO_SENSOR = "SLO"
+
+
+class _Objective:
+    __slots__ = ("name", "read_fn", "target_ms", "fast", "slow",
+                 "breached", "breach_seq", "last_observed")
+
+    def __init__(self, name: str, read_fn: Callable[[], float | None],
+                 target_ms: float) -> None:
+        self.name = name
+        self.read_fn = read_fn
+        self.target_ms = float(target_ms)
+        self.fast: "deque[tuple[int, bool]]" = deque()
+        self.slow: "deque[tuple[int, bool]]" = deque()
+        self.breached = False
+        self.breach_seq: int | None = None
+        self.last_observed: float | None = None
+
+
+def _burn(window: "deque[tuple[int, bool]]") -> float:
+    """Violation fraction in the window — the budget burn rate
+    normalized to [0, 1] (1.0 = every sample over target)."""
+    if not window:
+        return 0.0
+    return sum(1 for _, bad in window if bad) / len(window)
+
+
+class SLOEvaluator:
+    """Multi-window burn-rate evaluator feeding journal + anomaly path.
+
+    ``add_objective`` registers a named signal (a callable returning the
+    observed lag in ms, or None when there is no data yet — no-data is
+    *not* a violation). ``evaluate(now_ms)`` samples every objective and
+    returns newly-fired breach dicts; ``detect(now_ms)`` adapts that to
+    the AnomalyDetectorManager detector protocol, draining pending
+    breaches as :class:`~cruise_control_tpu.detector.anomalies.SLOBreach`
+    anomalies."""
+
+    def __init__(self, *, journal=None,
+                 registry: MetricRegistry | None = None,
+                 fast_window_ms: int = 60_000,
+                 slow_window_ms: int = 600_000,
+                 fast_burn_threshold: float = 0.5,
+                 slow_burn_threshold: float = 0.25,
+                 interval_ms: int = 5_000) -> None:
+        self.journal = journal
+        self.enabled = True
+        self.fast_window_ms = int(fast_window_ms)
+        self.slow_window_ms = int(slow_window_ms)
+        self.fast_burn_threshold = float(fast_burn_threshold)
+        self.slow_burn_threshold = float(slow_burn_threshold)
+        self.interval_ms = int(interval_ms)
+        self._last_eval_ms: int | None = None
+        self._objectives: dict[str, _Objective] = {}
+        self._pending_breaches: list[dict] = []
+        self.registry = registry or MetricRegistry()
+        name = MetricRegistry.name
+        self._breaches = self.registry.counter(name(SLO_SENSOR, "breaches"))
+        self._recoveries = self.registry.counter(
+            name(SLO_SENSOR, "recoveries"))
+        self.registry.gauge(
+            name(SLO_SENSOR, "objectives-breached"),
+            lambda: sum(1 for o in self._objectives.values() if o.breached))
+
+    def add_objective(self, name_: str,
+                      read_fn: Callable[[], float | None],
+                      target_ms: float) -> None:
+        obj = _Objective(name_, read_fn, target_ms)
+        self._objectives[name_] = obj
+        name = MetricRegistry.name
+        self.registry.gauge(name(SLO_SENSOR, f"{name_}-fast-burn"),
+                            lambda o=obj: _burn(o.fast))
+        self.registry.gauge(name(SLO_SENSOR, f"{name_}-slow-burn"),
+                            lambda o=obj: _burn(o.slow))
+        self.registry.gauge(
+            name(SLO_SENSOR, f"{name_}-observed-ms"),
+            lambda o=obj: -1.0 if o.last_observed is None else o.last_observed)
+
+    @property
+    def objectives(self) -> dict:
+        return self._objectives
+
+    # ---------------------------------------------------------- evaluation
+    def evaluate(self, now_ms: int, *, force: bool = False) -> list[dict]:
+        """Sample every objective once; fire/clear breaches on the
+        two-window rule. Interval-throttled unless ``force``. Returns
+        the breach dicts fired by *this* call."""
+        if not self.enabled:
+            return []
+        if (not force and self._last_eval_ms is not None
+                and now_ms - self._last_eval_ms < self.interval_ms):
+            return []
+        self._last_eval_ms = now_ms
+        fired: list[dict] = []
+        for obj in self._objectives.values():
+            try:
+                observed = obj.read_fn()
+            except Exception as exc:   # noqa: BLE001 — a broken signal
+                LOG.warning("SLO objective %s read failed: %s", obj.name, exc)
+                observed = None
+            obj.last_observed = (float(observed)
+                                 if observed is not None else None)
+            if observed is not None:
+                bad = float(observed) > obj.target_ms
+                obj.fast.append((now_ms, bad))
+                obj.slow.append((now_ms, bad))
+            for window, span in ((obj.fast, self.fast_window_ms),
+                                 (obj.slow, self.slow_window_ms)):
+                while window and window[0][0] < now_ms - span:
+                    window.popleft()
+            fast_burn = _burn(obj.fast)
+            slow_burn = _burn(obj.slow)
+            breaching = (len(obj.fast) > 0 and len(obj.slow) > 0
+                         and fast_burn >= self.fast_burn_threshold
+                         and slow_burn >= self.slow_burn_threshold)
+            if breaching and not obj.breached:
+                obj.breached = True
+                self._breaches.inc()
+                breach = {"objective": obj.name,
+                          "observedMs": obj.last_observed,
+                          "targetMs": obj.target_ms,
+                          "fastBurn": round(fast_burn, 4),
+                          "slowBurn": round(slow_burn, 4),
+                          "nowMs": now_ms}
+                if self.journal is not None:
+                    obj.breach_seq = self.journal.record(
+                        "slo", "breach", severity="warn", detail=breach)
+                breach["journalSeq"] = obj.breach_seq
+                self._pending_breaches.append(breach)
+                fired.append(breach)
+                LOG.warning("SLO breach: %s observed=%.0fms target=%.0fms "
+                            "fast-burn=%.2f slow-burn=%.2f", obj.name,
+                            obj.last_observed or -1, obj.target_ms,
+                            fast_burn, slow_burn)
+            elif obj.breached and not breaching:
+                obj.breached = False
+                self._recoveries.inc()
+                if self.journal is not None:
+                    self.journal.record(
+                        "slo", "recovered", cause=obj.breach_seq,
+                        detail={"objective": obj.name,
+                                "fastBurn": round(fast_burn, 4),
+                                "slowBurn": round(slow_burn, 4)})
+                obj.breach_seq = None
+                LOG.info("SLO recovered: %s", obj.name)
+        return fired
+
+    # ------------------------------------------------- detector protocol
+    def detect(self, now_ms: int) -> list:
+        """AnomalyDetectorManager hook: evaluate, then drain pending
+        breaches as SLO_BREACH anomalies (alert-only via the notifier
+        path; lowest priority so real faults always heal first)."""
+        self.evaluate(now_ms)
+        if not self._pending_breaches:
+            return []
+        # Local import: detector package pulls in the notifier stack;
+        # core modules must not import it at module load.
+        from ..detector.anomalies import SLOBreach
+        out = []
+        for b in self._pending_breaches:
+            out.append(SLOBreach(
+                detected_ms=now_ms, objective=b["objective"],
+                observed_ms=b.get("observedMs"),
+                target_ms=b["targetMs"], fast_burn=b["fastBurn"],
+                slow_burn=b["slowBurn"],
+                journal_seq=b.get("journalSeq")))
+        self._pending_breaches = []
+        return out
+
+    def to_json(self) -> dict:
+        return {"enabled": self.enabled,
+                "fastWindowMs": self.fast_window_ms,
+                "slowWindowMs": self.slow_window_ms,
+                "fastBurnThreshold": self.fast_burn_threshold,
+                "slowBurnThreshold": self.slow_burn_threshold,
+                "objectives": [
+                    {"name": o.name, "targetMs": o.target_ms,
+                     "observedMs": o.last_observed,
+                     "fastBurn": round(_burn(o.fast), 4),
+                     "slowBurn": round(_burn(o.slow), 4),
+                     "breached": o.breached}
+                    for o in self._objectives.values()]}

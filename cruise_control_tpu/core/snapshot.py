@@ -249,6 +249,9 @@ class SnapshotManager:
         #: against, so a just-deposed leader never "refreshes" from its
         #: own older file and regresses its live cache.
         self._seen_created_ms: int | None = None
+        #: decision journal (core/events.py), attached by the facade —
+        #: snapshot writes/restores/refusals are durability decisions.
+        self.journal = None
         self.registry = registry or MetricRegistry()
         name = MetricRegistry.name
         g = SNAPSHOT_SENSOR
@@ -299,6 +302,9 @@ class SnapshotManager:
             self._seen_created_ms = max(self._seen_created_ms or 0,
                                         int(now_ms))
         self._writes.inc()
+        if self.journal is not None:
+            self.journal.record("snapshot", "write",
+                                detail={"bytes": n, "path": self.path})
         LOG.debug("snapshot written to %s (%d bytes)", self.path, n)
         # Local-process fan-out: wake same-file peers (the in-process HA
         # harness's standby) and this manager's subscribers so freshness
@@ -359,6 +365,10 @@ class SnapshotManager:
                 LOG.info("no snapshot at %s; starting cold", self.path)
             else:
                 self._fallbacks[exc.reason].mark()
+                if self.journal is not None:
+                    self.journal.record(
+                        "snapshot", "restore-refused", severity="error",
+                        detail={"reason": exc.reason, "message": str(exc)})
                 LOG.error("snapshot restore REFUSED (%s): %s — falling "
                           "back to the cold start path", exc.reason, exc)
             return None
@@ -373,12 +383,21 @@ class SnapshotManager:
                                         int(header.get("createdMs", 0)))
             self._last_staleness_ms = max(
                 0, now_ms - int(header.get("createdMs", 0)))
+        if self.journal is not None:
+            self.journal.record(
+                "snapshot", "restore",
+                detail={"createdMs": int(header.get("createdMs", 0)),
+                        "stalenessMs": self._last_staleness_ms})
         return payload
 
     def refuse(self, reason: str, message: str) -> None:
         """Domain-level restore refusal (e.g. cluster-id mismatch): same
         metering + loud logging as the format-level checks."""
         self._fallbacks[reason].mark()
+        if self.journal is not None:
+            self.journal.record(
+                "snapshot", "restore-refused", severity="error",
+                detail={"reason": reason, "message": message})
         LOG.error("snapshot restore REFUSED (%s): %s — falling back to "
                   "the cold start path", reason, message)
 

@@ -32,6 +32,11 @@ class KafkaAnomalyType(enum.IntEnum):
     #: (forecast/detector.py) — like BROKER_RISK, a projection: lowest
     #: priority, provisioning evidence rather than a self-healing drain
     CAPACITY_FORECAST = 7
+    #: SLO burn-rate breach from core/slo.py — an alerting signal about
+    #: the control plane's own freshness, not a cluster fault: lowest
+    #: priority of all so every real (or even projected) anomaly
+    #: outranks it in the heal queue
+    SLO_BREACH = 8
 
 
 _ids = itertools.count()
@@ -331,6 +336,47 @@ class CapacityForecast(KafkaAnomaly):
         out["maxRisk"] = round(self.max_risk, 4)
         out["recommendations"] = [r.to_json()
                                   for r in self.recommendations]
+        return out
+
+
+@dataclass
+class SLOBreach(KafkaAnomaly):
+    """Burn-rate breach of a control-plane SLO (core/slo.py): the fast
+    AND slow windows of one objective (proposal freshness, replication
+    stream lag, standby staleness) both exceeded their burn thresholds.
+    Alert-only: like KafkaMetricAnomaly its ``fix()`` declines — the
+    breach is about the control plane itself, so rebalancing the data
+    plane cannot cure it. It rides the notifier path for paging and the
+    journal chain for forensics (``journal_seq`` links back to the
+    ``slo``/``breach`` event the evaluator recorded)."""
+
+    objective: str = ""
+    observed_ms: float | None = None
+    target_ms: float = 0.0
+    fast_burn: float = 0.0
+    slow_burn: float = 0.0
+    journal_seq: int | None = None
+    anomaly_type: KafkaAnomalyType = KafkaAnomalyType.SLO_BREACH
+
+    def reason(self) -> str:
+        observed = ("n/a" if self.observed_ms is None
+                    else f"{self.observed_ms:.0f}ms")
+        return (f"SLO burn-rate breach: {self.objective} observed "
+                f"{observed} vs target {self.target_ms:.0f}ms "
+                f"(fast burn {self.fast_burn:.2f}, "
+                f"slow burn {self.slow_burn:.2f})")
+
+    def fix(self, facade) -> bool:
+        return False   # alert-only: the breach is in the control plane
+
+    def to_json(self) -> dict:
+        out = super().to_json()
+        out["objective"] = self.objective
+        out["observedMs"] = self.observed_ms
+        out["targetMs"] = self.target_ms
+        out["fastBurn"] = self.fast_burn
+        out["slowBurn"] = self.slow_burn
+        out["journalSeq"] = self.journal_seq
         return out
 
 

@@ -217,6 +217,16 @@ class AnomalyDetectorManager:
             history = self.recent_anomalies[anomaly.anomaly_type]
             history.append(anomaly.to_json())
             del history[:-self.num_cached_recent_anomalies]
+        journal = getattr(self.facade, "journal", None)
+        if journal is not None:
+            # Head of the causal chain: detected → fix-dispatched →
+            # fix-outcome. The seq rides the anomaly so the dispatch
+            # event can name it as its cause.
+            anomaly._journal_seq = journal.record(
+                "detector", "anomaly-detected",
+                detail={"anomalyId": anomaly.anomaly_id,
+                        "anomalyType": anomaly.anomaly_type.name,
+                        "reason": anomaly.reason()})
 
     def _handle_queue(self, now: int) -> dict:
         fixed, rechecks, ignored = 0, 0, 0
@@ -265,6 +275,14 @@ class AnomalyDetectorManager:
                 self._time_to_start_fix.update(
                     max(now - anomaly.detected_ms, 0) / 1000.0)
                 self.ongoing_self_healing = anomaly.anomaly_id
+                journal = getattr(self.facade, "journal", None)
+                dispatched_seq = None
+                if journal is not None:
+                    dispatched_seq = journal.record(
+                        "detector", "fix-dispatched",
+                        cause=getattr(anomaly, "_journal_seq", None),
+                        detail={"anomalyId": anomaly.anomaly_id,
+                                "anomalyType": anomaly.anomaly_type.name})
                 try:
                     with self.tracer.span(
                             "detector.heal",
@@ -274,8 +292,21 @@ class AnomalyDetectorManager:
                         sp.set(fixed=bool(ok))
                     if not ok:
                         self.num_self_healing_failed += 1
+                    if journal is not None:
+                        journal.record(
+                            "detector", "fix-outcome",
+                            severity="info" if ok else "warn",
+                            cause=dispatched_seq,
+                            detail={"anomalyId": anomaly.anomaly_id,
+                                    "fixed": bool(ok)})
                 except Exception:
                     self.num_self_healing_failed += 1
+                    if journal is not None:
+                        journal.record(
+                            "detector", "fix-outcome", severity="error",
+                            cause=dispatched_seq,
+                            detail={"anomalyId": anomaly.anomaly_id,
+                                    "fixed": False, "crashed": True})
                     LOG.exception("self-healing fix for %s (%s) failed",
                                   anomaly.anomaly_id,
                                   anomaly.anomaly_type.name)

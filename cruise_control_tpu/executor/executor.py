@@ -308,6 +308,11 @@ class Executor:
         self._fence_token: int | None = None
         self._fenced = False
         self._exec_started_ms = 0
+        #: decision journal (core/events.py), attached by the facade —
+        #: execution admits/completions/aborts are the decisions that
+        #: mutate the real cluster, the ones forensics cares most about.
+        self.journal = None
+        self._exec_journal_seq: int | None = None
         self.registry.gauge(
             _n(EXECUTOR_SENSOR, "has-ongoing-execution"),
             lambda: int(self.has_ongoing_execution()))
@@ -426,6 +431,11 @@ class Executor:
         if not self.fence.is_current(self._fence_token):
             self._fenced = True
             self._fencing_aborts.inc()
+            if self.journal is not None:
+                self.journal.record(
+                    "execute", "fence-abort", severity="error",
+                    epoch=self._fence_token, cause=self._exec_journal_seq,
+                    detail={"uuid": self._current_uuid})
             OPERATION_LOG.error(
                 "Execution %s FENCED: fencing epoch %s is no longer "
                 "current (leadership lost); aborting at the next phase "
@@ -521,6 +531,10 @@ class Executor:
         if self.fence is not None \
                 and not self.fence.is_current(self.fence.epoch):
             from ..core.leader import NotLeaderError
+            if self.journal is not None:
+                self.journal.record(
+                    "execute", "refused-not-leader", severity="warn",
+                    detail={"uuid": uuid})
             raise NotLeaderError(
                 "refusing execution: this process does not hold the "
                 "leadership lease",
@@ -536,6 +550,11 @@ class Executor:
         started = self._now_ms()
         self._exec_started_ms = started
         self._executions_started.inc()
+        self._exec_journal_seq = (self.journal.record(
+            "execute", "started",
+            epoch=self.fence.epoch if self.fence is not None else None,
+            detail={"uuid": uuid, "numProposals": len(proposals)})
+            if self.journal is not None else None)
         # Fencing epoch captured ONCE at start: every later check compares
         # against this token, so a takeover mid-execution (epoch moved)
         # fences even if this process later wins leadership back.
@@ -671,6 +690,21 @@ class Executor:
                     (result.finished_ms - result.started_ms) / 1000.0)
                 exec_span.set(stopped=stopped, deadTasks=dead,
                               outcome=outcome)
+                if self.journal is not None:
+                    self.journal.record(
+                        "execute",
+                        ("fenced-abort" if self._fenced
+                         else "stopped" if stopped
+                         else "failed" if exc else "completed"),
+                        severity=("error" if self._fenced or exc
+                                  else "warn" if stopped or dead
+                                  else "info"),
+                        cause=self._exec_journal_seq,
+                        epoch=self._fence_token,
+                        detail={"uuid": uuid, "deadTasks": dead,
+                                "stateCounts": dict(result.state_counts),
+                                **({"error": type(exc).__name__}
+                                   if exc else {})})
             finally:
                 # Cleanup itself raising must STILL release the
                 # single-execution reservation — a wedged
@@ -712,6 +746,15 @@ class Executor:
                 errors = self._admin_call(
                     "alterPartitionReassignments",
                     self.admin.alter_partition_reassignments, targets)
+                if self.journal is not None:
+                    self.journal.record(
+                        "execute", "batch-admitted",
+                        cause=self._exec_journal_seq,
+                        epoch=self._fence_token,
+                        detail={"numTasks": len(batch),
+                                "numErrors": sum(
+                                    1 for e in errors.values()
+                                    if e is not None)})
                 now = self._now_ms()
                 for t in batch:
                     if errors.get(t.topic_partition) is None:
@@ -868,6 +911,16 @@ class Executor:
             results = self._overlapped_admin(calls)
             if admit is not None:
                 errors = results.pop(0)
+                if self.journal is not None:
+                    self.journal.record(
+                        "execute", "batch-admitted",
+                        cause=self._exec_journal_seq,
+                        epoch=self._fence_token,
+                        detail={"batchIndex": next_batch,
+                                "numTasks": len(admit),
+                                "numErrors": sum(
+                                    1 for e in errors.values()
+                                    if e is not None)})
                 now = self._now_ms()
                 for t in admit:
                     tm.tracker.transition(t, TaskState.IN_PROGRESS, now)
@@ -921,6 +974,7 @@ class Executor:
         tt = TaskType.INTER_BROKER_REPLICA_ACTION
         now = self._now_ms()
         cancels: dict[tuple[str, int], None] = {}
+        completed = 0
         for t in tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
             tp = t.topic_partition
             if tp not in ongoing:
@@ -930,6 +984,7 @@ class Executor:
                         == list(t.proposal.new_replicas)):
                     tm.tracker.transition(t, TaskState.COMPLETED, now)
                     self._partition_move_meter.mark()
+                    completed += 1
                 else:
                     # The reassignment vanished from the ongoing set but
                     # the placement does not match the proposal (e.g. an
@@ -938,6 +993,16 @@ class Executor:
                     stats["verify_failures"] += 1
                     self._verify_failures.mark()
                     tm.tracker.transition(t, TaskState.DEAD, now)
+                    if self.journal is not None:
+                        self.journal.record(
+                            "execute", "verify-failure", severity="error",
+                            cause=self._exec_journal_seq,
+                            epoch=self._fence_token,
+                            detail={"topicPartition": list(tp),
+                                    "observed": (None if info is None
+                                                 else list(info.replicas)),
+                                    "proposed": list(
+                                        t.proposal.new_replicas)})
                     OPERATION_LOG.warning(
                         "Scheduled execution: %s completed with placement "
                         "%s != proposed %s; marking DEAD", tp,
@@ -952,6 +1017,14 @@ class Executor:
             if dest_dead or timed_out:
                 cancels[tp] = None
                 tm.tracker.transition(t, TaskState.DEAD, now)
+        if completed and self.journal is not None \
+                and not tm.tracker.tasks_in(tt, TaskState.IN_PROGRESS):
+            # The whole admitted batch verified and drained — the
+            # admit/complete pair brackets each scheduled batch.
+            self.journal.record(
+                "execute", "batch-completed",
+                cause=self._exec_journal_seq, epoch=self._fence_token,
+                detail={"numVerified": completed})
         if cancels:
             self._admin_call("cancelDeadReassignments",
                              self.admin.alter_partition_reassignments,

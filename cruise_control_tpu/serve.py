@@ -163,6 +163,21 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             config.get_string("fleet.cluster.id"), monitor,
             proposal_cache=facade.proposal_cache)
 
+    # Control-plane flight recorder (core/events.py; docs/observability.md
+    # §Flight recorder): reconfigure the facade-built journal from the
+    # events.* keys and reload any persisted segment BEFORE the decision
+    # points start firing, so post-restart /history still shows the
+    # pre-crash tail.
+    facade.journal.configure(
+        enabled=config.get_boolean("events.enabled"),
+        capacity=config.get_int("events.ring.capacity"),
+        segment_path=config.get_string("events.segment.path"),
+        rotate_bytes=config.get_long("events.segment.rotate.bytes"),
+        persist_interval_ms=config.get_long("events.persist.interval.ms"),
+        categories=config.get_list("events.categories") or None)
+    if facade.journal.segment_path:
+        facade.journal.restore_from_disk()
+
     # Crash-safe snapshots + warm-standby HA (docs/operations.md
     # §Snapshot/restore & HA): the manager restores in start_up (before
     # prewarm) and writes on the ha_tick cadence in main(); the elector
@@ -389,6 +404,38 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         detector.register(MaintenanceEventDetector(reader), interval)
     facade.maintenance_stop_ongoing = config.get_boolean(
         "maintenance.event.stop.ongoing.execution")
+    # Burn-rate SLO evaluator (core/slo.py; docs/observability.md §SLO
+    # burn rates): samples the freshness signals on both the detector
+    # loop (leader) and ha_tick (standbys — they run no detector loop
+    # but still need standby-staleness alerts); breaches journal slo
+    # events and raise the alert-only SLO_BREACH anomaly.
+    if config.get_boolean("slo.enabled"):
+        from .core.slo import SLOEvaluator
+        slo = SLOEvaluator(
+            journal=facade.journal,
+            fast_window_ms=config.get_long("slo.fast.window.ms"),
+            slow_window_ms=config.get_long("slo.slow.window.ms"),
+            fast_burn_threshold=config.get_double("slo.fast.burn.threshold"),
+            slow_burn_threshold=config.get_double("slo.slow.burn.threshold"),
+            interval_ms=config.get_long("slo.evaluation.interval.ms"))
+        slo.add_objective(
+            "proposal-freshness",
+            lambda: facade.proposal_cache.freshness_age_ms(facade._now_ms()),
+            config.get_long("slo.proposal.freshness.target.ms"))
+        slo.add_objective(
+            "replication-stream-lag",
+            lambda: (facade.replication.stream_lag_ms
+                     if facade.replication is not None else None),
+            config.get_long("slo.replication.lag.target.ms"))
+        slo.add_objective(
+            "standby-staleness",
+            lambda: (facade.snapshotter._last_staleness_ms
+                     if facade.snapshotter is not None
+                     and facade.ha_role() != "leader" else None),
+            config.get_long("slo.standby.staleness.target.ms"))
+        facade.slo = slo
+        facade.extra_registries.append(slo.registry)
+        detector.register(slo, config.get_long("slo.evaluation.interval.ms"))
     facade.detector = detector
 
     security = None

@@ -101,11 +101,14 @@ while true; do
     # per-batch execution: the schedule/audit programs are the only
     # device work (sim + RPC tax are host-side), so it is cheap and
     # rides early behind the serving-plane rows.
-    for spec in 2 9 10 11 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 12 = flight-recorder journal overhead on the warm propose path
+    # (enabled vs disabled, <2% gate + zero-added-sync gate): rides the
+    # compile cache scenario 2 warms, so it is cheap right behind it.
+    for spec in 2 12 9 10 11 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
         2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7) tmo=4800 ;;
-        9|10|11) tmo=1800 ;;
+        9|10|11|12) tmo=1800 ;;
         4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;
       esac

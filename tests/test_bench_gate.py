@@ -45,6 +45,29 @@ def test_tracer_overhead_bench_smoke_gate():
     assert default_tracer().enabled   # the harness must restore the switch
 
 
+@pytest.mark.slow
+def test_event_journal_overhead_bench_smoke_gate():
+    """run_event_journal_overhead_bench on a toy cluster: exercises the
+    journal A/B harness end-to-end (disable → enable → restore) and its
+    ALWAYS-on zero-added-device-sync gate (deterministic at any scale:
+    the enabled serve must issue exactly the syncs the disabled one
+    does — the helper raises otherwise). Tier-1 keeps the journal's
+    sync discipline covered in test_events.py; the <2% wall-clock bar
+    is judged at bench scale (scenario 12 / tpu_watch ladder entry 12),
+    where best-of-N repeats shed the noise that would dominate here.
+    Marked slow: the tier-1 wall clock sits near its 870s cap and this
+    compiles a fresh toy chain."""
+    import bench
+    out = bench.run_event_journal_overhead_bench(
+        num_brokers=8, num_partitions=64,
+        goal_names=["ReplicaDistributionGoal"],
+        repeats=1, emit_row=False, gate=False)
+    assert out["enabled_s"] > 0 and out["disabled_s"] > 0
+    assert "overhead_pct" in out
+    assert out["syncs_enabled"] == out["syncs_disabled"]
+    assert out["rows"] > 0   # the enabled serves really journaled
+
+
 def test_chaos_recovery_bench_smoke_gate():
     """run_chaos_recovery_bench end-to-end: the scripted crash must heal
     within the step budget with clean invariants (the helper raises on

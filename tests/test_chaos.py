@@ -849,6 +849,86 @@ def test_replicated_midstream_leader_kill(optimizer, chaos_seed, tmp_path):
     assert fs.stream_lag_ms <= fs.max_staleness_ms
 
 
+def test_journal_forensics_across_leader_kill(optimizer, chaos_seed,
+                                              tmp_path):
+    """Post-failover forensics on the flight recorder: the leader's
+    cause-linked decisions stream into the replicas' journals, so after
+    the leader dies (a) a replica's /history still answers with the dead
+    reign's propose chain, (b) the successor's own journal records the
+    epoch transition, and (c) a deposed straggler frame is refused AND
+    the refusal is journaled replica-side — the evidence trail spans
+    both processes, spliced by (node, seq)."""
+    from cruise_control_tpu.chaos import HAFailoverHarness
+    seed = _pick(chaos_seed, 47)
+    ha = HAFailoverHarness(seed=seed, snapshot_dir=str(tmp_path),
+                           optimizer=optimizer, processes=("a", "b", "c"),
+                           replication=True, max_staleness_ms=2000)
+    for _ in range(12):
+        ha.step()
+    leader = ha.leader()
+    assert leader is not None
+    lh = ha.procs[leader]
+    old_epoch = lh.facade.elector.epoch
+    lh.facade.proposals()                   # journals plan-selected->served
+    for _ in range(3):
+        ha.step()                           # the journal delta streams out
+
+    replicas = sorted(n for n in ha.procs if n != leader)
+    hist = ha.procs[replicas[0]].facade.history_json(limit=1024)
+    assert hist["role"] != "leader"
+    rows = {(e["node"], e["seq"]): e for e in hist["events"]}
+    served = [e for e in hist["events"]
+              if e["node"] == leader and e["category"] == "propose"
+              and e["action"] == "served"]
+    assert served, (
+        "leader's served decision must stream to the replica\n"
+        + _repro("test_journal_forensics_across_leader_kill", seed))
+    cause = served[-1]["cause"]
+    assert cause is not None
+    assert rows[(leader, cause)]["action"] == "plan-selected"
+
+    ha.kill(leader)
+    ha.steps_until(lambda: ha.leader() is not None, 30, what="failover")
+    successor = ha.leader()
+    assert successor != leader
+    sh = ha.procs[successor]
+    new_epoch = sh.facade.elector.epoch
+    assert new_epoch > old_epoch
+    # the successor's OWN journal records the epoch transition
+    takes = [e for e in sh.facade.journal.events()
+             if e.category == "election" and e.action == "took-leadership"
+             and e.node == successor]
+    assert takes and takes[-1].epoch == new_epoch
+
+    # wait for the new reign's frames to raise the followers' fence
+    # floor, then flush a straggler from the dead leader's reign
+    ha.steps_until(lambda: any(s.action == "applied"
+                               and s.epoch >= new_epoch
+                               for s in ha.delta_stamps), 30,
+                   what="new reign streaming")
+    follower = next(n for n in replicas if n != successor)
+    ha.channel.publish({"fencingEpoch": old_epoch, "node": leader,
+                        "clusterId": "stale", "clocks": {}},
+                       ha.engine.now_ms())
+    for _ in range(3):
+        ha.step()
+    refused = [e for e in ha.procs[follower].facade.journal.events()
+               if e.category == "replication"
+               and e.action == "frame-refused-epoch"]
+    assert refused, (
+        "the refusal must be journaled replica-side\n"
+        + _repro("test_journal_forensics_across_leader_kill", seed))
+    assert refused[-1].detail["fromNode"] == leader
+    assert refused[-1].detail["fenceFloor"] >= new_epoch
+    assert refused[-1].severity == "warn"
+
+    # the successor (an ex-replica) still carries the dead reign's rows:
+    # /history splices both processes' journals by (node, seq)
+    merged = sh.facade.history_json(limit=1024)
+    nodes = {e["node"] for e in merged["events"]}
+    assert leader in nodes and successor in nodes
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("seed", SOAK_SEEDS[:10])
 def test_crash_failover_soak(optimizer, chaos_seed, seed, tmp_path):

@@ -425,7 +425,7 @@ def test_anomaly_check_defers_then_fires():
     assert out["fixed"] == 1 and fixed_calls
 
 
-def test_self_healing_goals_config_wiring_and_startup_validation():
+def test_self_healing_goals_config_wiring_and_startup_validation(tmp_path):
     """self.healing.goals reaches the facade (the anomaly fix() paths
     optimize with it) and is validated at deploy time: it must resolve
     and must cover every registered hard goal (ref
@@ -442,6 +442,7 @@ def test_self_healing_goals_config_wiring_and_startup_validation():
         sim.add_partition("t", 0, [0, 1], size_mb=10.0)
         return build_app(CruiseControlConfig({
             "webserver.http.port": "0",
+            "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
             "hard.goals": hard,
             "self.healing.goals": healing}), admin=sim)
 
@@ -458,7 +459,7 @@ def test_self_healing_goals_config_wiring_and_startup_validation():
     assert app_for("").facade.self_healing_goals is None
 
 
-def test_detection_goals_scope_the_violation_detector():
+def test_detection_goals_scope_the_violation_detector(tmp_path):
     """anomaly.detection.goals selects the chain the violation detector
     dry-runs (default: the reference's 4 leading hard goals)."""
     from cruise_control_tpu.config.constants import CruiseControlConfig
@@ -468,8 +469,10 @@ def test_detection_goals_scope_the_violation_detector():
     for b in range(3):
         sim.add_broker(b)
     sim.add_partition("t", 0, [0, 1], size_mb=10.0)
-    app = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
-                    admin=sim)
+    app = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "failed_brokers.json")}),
+        admin=sim)
     gv = [s.detector for s in app.facade.detector._schedules
           if type(s.detector).__name__ == "GoalViolationDetector"]
     assert gv, "GoalViolationDetector not registered"
@@ -478,7 +481,7 @@ def test_detection_goals_scope_the_violation_detector():
         "ReplicaCapacityGoal", "DiskCapacityGoal"]
 
 
-def test_distribution_threshold_multiplier_relaxes_detection():
+def test_distribution_threshold_multiplier_relaxes_detection(tmp_path):
     """goal.violation.distribution.threshold.multiplier: the violation
     detector's optimizer runs with RELAXED distribution thresholds
     (anti-flap, ref ReplicaDistributionAbstractGoal
@@ -493,6 +496,7 @@ def test_distribution_threshold_multiplier_relaxes_detection():
     sim.add_partition("t", 0, [0, 1], size_mb=10.0)
     app = build_app(CruiseControlConfig({
         "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
         "goal.violation.distribution.threshold.multiplier": "2.0",
         "anomaly.detection.goals": "ReplicaDistributionGoal,"
                                    "DiskUsageDistributionGoal"}), admin=sim)
@@ -518,14 +522,15 @@ def test_distribution_threshold_multiplier_relaxes_detection():
     assert gv[0].optimizer.hard_goal_names == (
         app.facade.optimizer.hard_goal_names)
     # Multiplier 1.0 (default) keeps one shared optimizer path.
-    app2 = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
-                     admin=sim)
+    app2 = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "fb2.json")}), admin=sim)
     gv2 = [s.detector for s in app2.facade.detector._schedules
            if type(s.detector).__name__ == "GoalViolationDetector"]
     assert gv2[0].optimizer.constraint is app2.facade.optimizer.constraint
 
 
-def test_provisioner_enable_and_rf_rack_skip_wiring():
+def test_provisioner_enable_and_rf_rack_skip_wiring(tmp_path):
     """provisioner.enable=false -> /rightsize reports no provisioner;
     replication.factor.self.healing.skip.rack.awareness.check wires the
     RF-fix rack waiver onto the facade."""
@@ -538,6 +543,7 @@ def test_provisioner_enable_and_rf_rack_skip_wiring():
     sim.add_partition("t", 0, [0, 1], size_mb=10.0)
     app = build_app(CruiseControlConfig({
         "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
         "provisioner.enable": "false",
         "replication.factor.self.healing.skip.rack.awareness.check":
             "true"}), admin=sim)
@@ -546,7 +552,9 @@ def test_provisioner_enable_and_rf_rack_skip_wiring():
         "provisionerState": "No provisioner configured"}
     assert app.facade.rf_self_healing_skip_rack_check is True
     # Default: provisioner present, rack check enforced.
-    app2 = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
+    app2 = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "fb2.json")}),
                      admin=sim)
     assert app2.facade.detector.provisioner is not None
     assert app2.facade.rf_self_healing_skip_rack_check is False
@@ -628,7 +636,7 @@ def test_provision_verdict_shrink_floors():
     assert res2.provision_response.status is ProvisionStatus.RIGHT_SIZED
 
 
-def test_maintenance_reader_served_wiring():
+def test_maintenance_reader_served_wiring(tmp_path):
     """maintenance.event.reader.class registers the maintenance detector
     with the idempotence config; the stop-ongoing flag reaches the
     facade. Empty (the default) leaves maintenance disabled."""
@@ -641,6 +649,7 @@ def test_maintenance_reader_served_wiring():
     sim.add_partition("t", 0, [0, 1], size_mb=10.0)
     app = build_app(CruiseControlConfig({
         "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
         "maintenance.event.reader.class":
             "cruise_control_tpu.detector.MaintenanceEventReader",
         "maintenance.event.enable.idempotence": "true",
@@ -663,13 +672,14 @@ def test_maintenance_reader_served_wiring():
         detected_ms=1, event_type=MaintenanceEventType.REBALANCE)) is False
     assert len(med[0].detect(0)) == 1
     # Default: disabled.
-    app2 = build_app(CruiseControlConfig({"webserver.http.port": "0"}),
-                     admin=sim)
+    app2 = build_app(CruiseControlConfig({
+        "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "fb2.json")}), admin=sim)
     assert not [s for s in app2.facade.detector._schedules
                 if type(s.detector).__name__ == "MaintenanceEventDetector"]
 
 
-def test_healing_goals_validation_accepts_rack_alternative():
+def test_healing_goals_validation_accepts_rack_alternative(tmp_path):
     """self.healing.goals carrying RackAwareDistributionGoal (the
     documented relaxation) satisfies the RackAwareGoal requirement —
     same rule the hard-goal audit applies."""
@@ -682,6 +692,7 @@ def test_healing_goals_validation_accepts_rack_alternative():
     sim.add_partition("t", 0, [0, 1], size_mb=10.0)
     app = build_app(CruiseControlConfig({
         "webserver.http.port": "0",
+        "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
         "hard.goals": "RackAwareGoal,DiskCapacityGoal",
         "self.healing.goals": "RackAwareDistributionGoal,DiskCapacityGoal,"
                               "ReplicaDistributionGoal"}), admin=sim)

@@ -44,7 +44,13 @@ class Stack:
     follows wall clock; sampling fires at its interval)."""
 
     def __init__(self, sim, extra_config=None, tick_s=0.05):
+        import os
+        import tempfile
         cfg = {
+            # detector persistence stays out of the repo cwd (callers may
+            # still override with their own tmp_path)
+            "failed.brokers.file.path": os.path.join(
+                tempfile.mkdtemp(prefix="cc-e2e-"), "failed_brokers.json"),
             "webserver.http.port": "0",
             "default.goals": GOALS,
             "num.partition.metrics.windows": "4",

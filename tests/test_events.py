@@ -1,0 +1,553 @@
+"""Flight-recorder tests: the causal decision journal (core/events.py),
+the SLO burn-rate evaluator (core/slo.py), the ``GET /history`` surface
+(auth floor, filters, plaintext), journal replication to read replicas
+with fence-refused deposed frames, and the merged-scrape Prometheus lint
+for the ``EventJournal.*`` / ``SLO.*`` families."""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from prom_lint import lint_prometheus_exposition
+
+from cruise_control_tpu.api import BasicSecurityProvider, Role
+from cruise_control_tpu.core.events import CATEGORIES, EventJournal
+from cruise_control_tpu.core.slo import SLOEvaluator
+
+
+# ------------------------------------------------------------ journal unit
+
+def test_record_assigns_seqs_and_cause_chain():
+    j = EventJournal(capacity=16)
+    a = j.record("detector", "anomaly-detected",
+                 detail={"anomalyId": "brokerfailures-0"})
+    b = j.record("detector", "fix-dispatched", cause=a)
+    c = j.record("detector", "fix-outcome", cause=b, severity="warn")
+    assert (a, b, c) == (1, 2, 3)
+    evs = j.query()
+    assert [e.cause for e in evs] == [None, a, b]
+    payload = j.history_json()
+    assert payload["lastSeq"] == c and payload["numEvents"] == 3
+    row = payload["events"][0]
+    assert set(row) == {"seq", "tsMs", "category", "action", "severity",
+                        "epoch", "spanId", "cause", "node", "detail"}
+    assert row["detail"] == {"anomalyId": "brokerfailures-0"}
+    # unknown category is a programming error; unknown severity is data
+    # from callers and degrades to info instead of raising on a hot path
+    with pytest.raises(ValueError):
+        j.record("nonsense", "x")
+    s = j.record("propose", "served", severity="shouty")
+    assert j.query(since_seq=s - 1)[0].severity == "info"
+
+
+def test_ring_bound_drops_and_capacity_reconfigure():
+    j = EventJournal(capacity=4)
+    for i in range(10):
+        j.record("execute", f"e{i}")
+    assert len(j.query(limit=100)) == 4
+    assert j.dropped == 6 and j.last_seq == 10
+    assert j.history_json()["dropped"] == 6
+    # re-bounding the ring in place keeps the surviving events
+    j.configure(capacity=8)
+    assert [e.action for e in j.query(limit=100)] == [
+        "e6", "e7", "e8", "e9"]
+    j.record("execute", "e10")
+    assert len(j.query(limit=100)) == 5
+
+
+def test_disabled_and_category_filtering():
+    j = EventJournal(capacity=8)
+    j.configure(enabled=False)
+    assert j.record("propose", "served") is None
+    assert j.query() == []
+    j.configure(enabled=True, categories=["slo", "election"])
+    assert j.record("propose", "served") is None      # filtered out
+    assert j.record("slo", "breach", severity="warn") is not None
+    with pytest.raises(ValueError):
+        j.configure(categories=["bogus"])
+    # empty category list means "no restriction", not "record nothing"
+    j.configure(categories=[])
+    assert j.record("propose", "served") is not None
+
+
+def test_query_filter_semantics():
+    j = EventJournal(capacity=32)
+    s1 = j.record("propose", "served")
+    s2 = j.record("execute", "started")
+    s3 = j.record("execute", "verify-failure", severity="error")
+    s4 = j.record("election", "took-leadership", severity="warn", epoch=7)
+    assert [e.seq for e in j.query(categories=["execute"])] == [s2, s3]
+    # min_severity is a floor on the ladder, not an exact match
+    assert [e.seq for e in j.query(min_severity="warn")] == [s3, s4]
+    # since_seq is exclusive; limit keeps the NEWEST rows
+    assert [e.seq for e in j.query(since_seq=s2)] == [s3, s4]
+    assert [e.seq for e in j.query(limit=2)] == [s3, s4]
+    assert [e.seq for e in j.query(categories=["execute", "election"],
+                                   min_severity="warn",
+                                   since_seq=s1, limit=1)] == [s4]
+    assert j.query(categories=["snapshot"]) == []
+    _ = s1
+
+
+def test_persist_restore_roundtrip_and_rotation(tmp_path):
+    path = str(tmp_path / "journal.jsonl")
+    j = EventJournal(capacity=64, segment_path=path, rotate_bytes=100_000,
+                     persist_interval_ms=1000, node="a")
+    a = j.record("snapshot", "write", detail={"bytes": 123})
+    b = j.record("execute", "started", cause=a)
+    assert j.persist(now_ms=0) > 0
+    # cadence: nothing new -> no rewrite until the interval elapses
+    assert j.maybe_persist(500) is False
+    j.record("execute", "completed", cause=b)
+    assert j.maybe_persist(999) is False       # interval not yet elapsed
+    assert j.maybe_persist(2000) is True
+    # cold restart: the pre-crash tail is back, seq counter resumes above
+    j2 = EventJournal(capacity=64, segment_path=path, node="a")
+    assert j2.restore_from_disk() == 3
+    assert [e.action for e in j2.query()] == ["write", "started",
+                                              "completed"]
+    assert j2.query()[1].cause == a
+    nxt = j2.record("election", "took-leadership", severity="warn")
+    assert nxt == 4
+    # rotation: a tiny rotate_bytes graduates the persisted content to
+    # .prev on each rewrite — at most two segments survive, the oldest
+    # rows age out (bounded disk, like the ring bounds memory)
+    j2.configure(rotate_bytes=10)
+    for i in range(3):
+        j2.record("execute", f"r{i}")
+        j2.persist(now_ms=10_000 + i)
+    assert (tmp_path / "journal.jsonl.prev").exists()
+    j3 = EventJournal(capacity=64, segment_path=path, node="a")
+    assert j3.restore_from_disk() == 2       # .prev + active, newest rows
+    assert [e.action for e in j3.query(limit=100)] == ["r1", "r2"]
+    assert j3.last_seq == j2.last_seq
+
+
+def test_restore_refuses_malformed_lines(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    good = {"seq": 3, "tsMs": 1, "category": "propose", "action": "served"}
+    lines = [
+        "not json at all",
+        json.dumps({"seq": "x", "tsMs": 1, "category": "propose",
+                    "action": "served"}),             # bad seq type
+        json.dumps({"seq": 1, "tsMs": 1, "category": "evil",
+                    "action": "served"}),             # unknown category
+        json.dumps({"seq": 2, "tsMs": 1, "category": "propose",
+                    "action": "served", "detail": ["not", "a", "dict"]}),
+        json.dumps(good),
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    j = EventJournal(capacity=8, segment_path=str(path))
+    refused_before = j.registry.get("EventJournal.refused-records").count
+    assert j.restore_from_disk() == 1
+    assert [e.seq for e in j.query()] == [3]
+    assert j.registry.get("EventJournal.refused-records").count \
+        == refused_before + 4
+
+
+def test_apply_remote_validates_dedups_and_stamps_node():
+    j = EventJournal(capacity=8, node="r1")
+    delta = [{"seq": 1, "tsMs": 10, "category": "propose",
+              "action": "served", "severity": "info"},
+             {"seq": 2, "tsMs": 20, "category": "election",
+              "action": "took-leadership", "severity": "warn", "epoch": 3}]
+    assert j.apply_remote(delta, source_node="leader") == 2
+    evs = j.query()
+    assert [e.node for e in evs] == ["leader", "leader"]
+    # re-delivered frame (cursor rejoin): per-node floor dedups it
+    assert j.apply_remote(delta, source_node="leader") == 0
+    # malformed entries are refused + metered, valid ones still apply
+    bad = [{"seq": -1, "tsMs": 0, "category": "propose", "action": "x"},
+           "not-a-dict",
+           {"seq": 3, "tsMs": 30, "category": "propose", "action": "ok"}]
+    assert j.apply_remote(bad, source_node="leader") == 1
+    assert j.registry.get("EventJournal.applied-remote").count == 3
+    assert j.registry.get("EventJournal.refused-records").count == 2
+    # the local seq counter jumped past every applied seq, so local
+    # events stay monotonic above the stream
+    local = j.record("snapshot", "restore")
+    assert local == 4
+    # a different node's seq 1 is NOT a duplicate of leader's seq 1
+    other = [{"seq": 1, "tsMs": 40, "category": "propose",
+              "action": "served", "node": "leader2"}]
+    assert j.apply_remote(other) == 1
+
+
+def test_chrome_instants_skip_remote_rows_without_perf():
+    j = EventJournal(capacity=8, node="r1")
+    j.record("propose", "served")
+    j.apply_remote([{"seq": 5, "tsMs": 1, "category": "slo",
+                     "action": "breach"}], source_node="leader")
+    names = [t["name"] for t in j.chrome_instant_events(0.0)]
+    # remote rows carry an ARRIVAL perf stamp so they still plot
+    assert "propose.served" in names and "slo.breach" in names
+    for t in j.chrome_instant_events(0.0):
+        assert t["ph"] == "i" and t["cat"] == "journal"
+
+
+# ------------------------------------------------------------ SLO evaluator
+
+def test_slo_two_window_breach_and_recovery_chain():
+    j = EventJournal(capacity=64)
+    reading = {"v": 5.0}
+    slo = SLOEvaluator(journal=j, fast_window_ms=1000, slow_window_ms=5000,
+                       fast_burn_threshold=0.5, slow_burn_threshold=0.25,
+                       interval_ms=100)
+    slo.add_objective("proposal-freshness", lambda: reading["v"], 10.0)
+    # no data is NOT a violation
+    reading["v"] = None
+    assert slo.evaluate(0, force=True) == []
+    reading["v"] = 5.0
+    for t in (200, 400, 600, 800):          # healthy history
+        assert slo.evaluate(t) == []
+    # interval throttle: a call inside the interval does not sample
+    obj = slo.objectives["proposal-freshness"]
+    n = len(obj.slow)
+    assert slo.evaluate(810) == [] and len(obj.slow) == n
+    # a fast-window spike alone must NOT page (slow burn still low)
+    reading["v"] = 50.0
+    assert slo.evaluate(4000) == []
+    assert obj.breached is False
+    # sustained burn: both windows over threshold -> exactly one breach
+    fired = slo.evaluate(4200)
+    assert len(fired) == 1
+    br = fired[0]
+    assert br["objective"] == "proposal-freshness"
+    assert br["observedMs"] == 50.0 and br["targetMs"] == 10.0
+    assert br["fastBurn"] >= 0.5 and br["slowBurn"] >= 0.25
+    assert slo.evaluate(4400) == []          # already breached: no re-fire
+    breach_ev = [e for e in j.query() if e.category == "slo"][-1]
+    assert breach_ev.action == "breach" and breach_ev.severity == "warn"
+    assert br["journalSeq"] == breach_ev.seq
+    # recovery: bad samples age out of both windows -> cause-linked close
+    reading["v"] = 5.0
+    for t in (9600, 9800, 10_000, 10_200):
+        slo.evaluate(t)
+    assert obj.breached is False
+    rec = [e for e in j.query() if e.category == "slo"
+           and e.action == "recovered"]
+    assert rec and rec[-1].cause == breach_ev.seq
+    assert slo.registry.get("SLO.breaches").count == 1
+    assert slo.registry.get("SLO.recoveries").count == 1
+    js = slo.to_json()
+    assert js["objectives"][0]["breached"] is False
+
+
+def test_slo_detect_emits_alert_only_anomaly():
+    from cruise_control_tpu.detector import KafkaAnomalyType
+    j = EventJournal(capacity=32)
+    slo = SLOEvaluator(journal=j, fast_window_ms=100, slow_window_ms=200,
+                       interval_ms=10)
+    slo.add_objective("replication-stream-lag", lambda: 99.0, 1.0)
+    slo.evaluate(0, force=True)
+    anomalies = slo.detect(20)
+    assert len(anomalies) == 1
+    a = anomalies[0]
+    assert a.anomaly_type is KafkaAnomalyType.SLO_BREACH
+    # lowest priority: real faults always heal before an SLO page
+    assert int(a.anomaly_type) == max(int(t) for t in KafkaAnomalyType)
+    assert a.fix(None) is False              # alert-only, never self-heals
+    row = a.to_json()
+    assert row["objective"] == "replication-stream-lag"
+    assert row["observedMs"] == 99.0 and row["targetMs"] == 1.0
+    assert row["journalSeq"] == j.last_seq
+    assert slo.detect(40) == []              # pending queue drained
+
+
+def test_detector_manager_chain_detect_dispatch_outcome():
+    """The causal chain on /history: anomaly-detected -> fix-dispatched
+    -> fix-outcome, each event naming its predecessor as ``cause``."""
+    from cruise_control_tpu.detector import (AnomalyDetectorManager,
+                                             AnomalyNotificationResult,
+                                             NotificationAction)
+    journal = EventJournal(capacity=64)
+
+    class _Executor:
+        def has_ongoing_execution(self):
+            return False
+
+    class _Facade:
+        admin = None
+        executor = _Executor()
+
+    class _FixNow:
+        def on_anomaly(self, anomaly, now_ms):
+            return NotificationAction(AnomalyNotificationResult.FIX)
+
+        def self_healing_enabled(self):
+            return {}
+
+    facade = _Facade()
+    facade.journal = journal
+    mgr = AnomalyDetectorManager(facade, _FixNow(), now_ms=lambda: 0,
+                                 provisioner_enabled=False)
+    slo = SLOEvaluator(journal=journal, fast_window_ms=100,
+                       slow_window_ms=200, interval_ms=10)
+    slo.add_objective("standby-staleness", lambda: 77.0, 1.0)
+    mgr.register(slo, interval_ms=10)
+    out = mgr.run_once(50)
+    assert out["detected"] == 1 and out["fixed"] == 1
+    evs = {e.seq: e for e in journal.query(limit=100)}
+    chain = [e for e in evs.values() if e.category == "detector"]
+    by_action = {e.action: e for e in chain}
+    detected = by_action["anomaly-detected"]
+    dispatched = by_action["fix-dispatched"]
+    outcome = by_action["fix-outcome"]
+    assert detected.detail["anomalyType"] == "SLO_BREACH"
+    assert dispatched.cause == detected.seq
+    assert outcome.cause == dispatched.seq
+    # SLOBreach.fix() declines: the outcome says so at warn severity
+    assert outcome.severity == "warn" and outcome.detail["fixed"] is False
+    # the chain's head sits AFTER the slo breach event that spawned it
+    breach = next(e for e in evs.values() if e.category == "slo")
+    assert breach.seq < detected.seq
+
+
+# ----------------------------------------------------- journal replication
+
+def test_journal_replication_parity_and_fence_refusal():
+    """Session-level contract: the leader's journal delta rides the
+    replication frame, the replica serves the cause-linked chain from its
+    OWN journal, duplicate frames dedup, and a deposed leader's frame is
+    refused by fence floor AND journaled replica-side as forensic
+    evidence."""
+    from cruise_control_tpu.core.replication import (ReplicationChannel,
+                                                     ReplicationSession)
+    jl = EventJournal(capacity=64, node="leader")
+    jr = EventJournal(capacity=64, node="r1")
+    ch = ReplicationChannel(capacity=16)
+    streamed = {"seq": 0}
+
+    def build_frame():
+        delta = jl.export_delta(streamed["seq"])
+        if delta:
+            streamed["seq"] = max(e["seq"] for e in delta)
+        return {"journal": delta or None}
+
+    leader = ReplicationSession(
+        node_id="leader", channel=ch,
+        clocks=lambda: {"journalSeq": jl.last_seq},
+        build_frame=build_frame, fencing_epoch=lambda: 2,
+        apply_frame=lambda f: "applied", resync=lambda: None)
+
+    def apply_frame(frame):
+        delta = frame.get("journal")
+        if delta:
+            jr.apply_remote(delta, source_node=frame.get("node"))
+        return "applied"
+
+    follower = ReplicationSession(
+        node_id="r1", channel=ch, clocks=lambda: {},
+        build_frame=lambda: None, fencing_epoch=lambda: 0,
+        apply_frame=apply_frame, resync=lambda: 900)
+    follower.journal = jr
+
+    plan = jl.record("optimizer", "plan-selected", detail={"proposals": 3})
+    jl.record("propose", "served", cause=plan, detail={"source": "fresh"})
+    leader.tick(1000, "leader")
+    follower.tick(1100, "standby")
+    # parity: the replica answers /history locally with the leader's chain
+    hist = jr.history_json(categories=["propose", "optimizer"])
+    rows = {e["seq"]: e for e in hist["events"]}
+    assert rows[plan]["node"] == "leader"
+    served = next(e for e in hist["events"] if e["action"] == "served")
+    assert served["cause"] == plan
+    assert served["detail"] == {"source": "fresh"}
+    # journal-only decisions move the clocks: a second decision with no
+    # other state change still ships a frame
+    jl.record("execute", "refused-not-leader", severity="warn")
+    leader.tick(2000, "leader")
+    follower.tick(2100, "standby")
+    assert any(e.action == "refused-not-leader" for e in jr.query(limit=50))
+    # duplicate delivery (cursor rejoin) dedups on the per-node floor
+    assert jr.apply_remote(jl.export_delta(0), source_node="leader") == 0
+    # replica-local events stay monotonic above the stream
+    assert jr.record("snapshot", "restore") > jl.last_seq
+
+    # the deposed straggler: epoch below the fence floor -> refused,
+    # never folded into the replica's journal, and the refusal itself is
+    # journaled replica-side
+    ch.publish({"fencingEpoch": 1, "node": "old-leader", "clocks": {},
+                "journal": [{"seq": 99, "tsMs": 0, "category": "propose",
+                             "action": "served"}]}, 2200)
+    follower.tick(2300, "standby")
+    assert not any(e.seq == 99 for e in jr.query(limit=100))
+    refused = [e for e in jr.query(limit=100)
+               if e.action == "frame-refused-epoch"]
+    assert len(refused) == 1
+    assert refused[0].severity == "warn" and refused[0].node == "r1"
+    assert refused[0].detail["fromNode"] == "old-leader"
+    assert refused[0].detail["fenceFloor"] == 2
+
+
+# ------------------------------------------------------- /history surface
+
+USERS = {"admin": ("pw", Role.ADMIN), "viewer": ("pw", Role.VIEWER)}
+
+
+def _auth(user):
+    tok = base64.b64encode(f"{user}:pw".encode()).decode()
+    return {"Authorization": f"Basic {tok}"}
+
+
+@pytest.fixture(scope="module")
+def secured_stack():
+    from test_api import build_stack
+    sim, facade, app = build_stack(security=BasicSecurityProvider(USERS))
+    yield sim, facade, app
+    app.stop()
+
+
+def test_history_requires_auth_and_viewer_floor(secured_stack):
+    from test_api import call
+    _, facade, app = secured_stack
+    call(app, "GET", "history", expect=401)
+    # VIEWER is the floor: /history is read-only forensics
+    status, body, _ = call(app, "GET", "history", headers=_auth("viewer"))
+    assert status == 200
+    assert body["version"] == 1
+    assert body["role"] == facade.ha_role()
+    assert body["capacity"] == facade.journal.capacity
+    status, _body, _ = call(app, "GET", "history", headers=_auth("admin"))
+    assert status == 200
+
+
+def test_history_filters_plaintext_and_bad_params(secured_stack):
+    from test_api import call
+    _, facade, app = secured_stack
+    j = facade.journal
+    a = j.record("execute", "started")
+    j.record("execute", "verify-failure", severity="error", cause=a)
+    j.record("election", "took-leadership", severity="warn", epoch=7)
+    status, body, _ = call(app, "GET", "history",
+                           "category=execute&severity=ERROR",
+                           headers=_auth("viewer"))
+    assert status == 200 and body["events"]
+    assert all(e["category"] == "execute" and e["severity"] == "error"
+               for e in body["events"])
+    assert body["events"][-1]["cause"] == a
+    # csv category filter admits several categories at once
+    status, body, _ = call(app, "GET", "history",
+                           "category=execute,election&severity=WARN",
+                           headers=_auth("viewer"))
+    assert {e["category"] for e in body["events"]} == {"execute",
+                                                       "election"}
+    # since_seq is exclusive and limit keeps the newest rows
+    status, body, _ = call(app, "GET", "history",
+                           f"since_seq={a}&limit=1", headers=_auth("admin"))
+    assert len(body["events"]) == 1 and body["events"][0]["seq"] > a
+    # parameter validation stays the API layer's job: bad enum -> 400
+    call(app, "GET", "history", "severity=LOUD", headers=_auth("viewer"),
+         expect=400)
+    call(app, "GET", "history", "limit=0", headers=_auth("viewer"),
+         expect=400)
+    # plaintext rendering (json=false): the fixed-width forensic table
+    url = (f"http://127.0.0.1:{app.port}/kafkacruisecontrol/history"
+           "?json=false&category=election")
+    req = urllib.request.Request(url, headers=_auth("viewer"))
+    with urllib.request.urlopen(req, timeout=60) as r:
+        text = r.read().decode()
+    assert not text.lstrip().startswith("{")
+    assert "SEQ" in text and "CAUSE" in text
+    assert "took-leadership" in text
+    assert "role:" in text and "lastSeq:" in text
+
+
+def test_propose_chain_sources_and_trace_merge(secured_stack):
+    """plan-selected -> served, cause-linked; a cache re-serve journals a
+    second served row with the SAME cause; /trace carries the journal as
+    instant events."""
+    _, facade, app = secured_stack
+    facade.proposals(ignore_cache=True)      # explicit fresh computation
+    evs = facade.journal.query(limit=200)
+    served = [e for e in evs
+              if e.category == "propose" and e.action == "served"]
+    assert served and served[-1].detail["source"] == "fresh"
+    cause = served[-1].cause
+    plan = next(e for e in evs if e.seq == cause)
+    assert plan.category == "optimizer" and plan.action == "plan-selected"
+    facade.proposals()                       # fills + serves the cache
+    served2 = [e for e in facade.journal.query(limit=200)
+               if e.category == "propose" and e.action == "served"]
+    assert served2[-1].detail["source"] == "cache"
+    cache_cause = served2[-1].cause
+    facade.proposals()                       # cache hit: same plan object
+    served3 = [e for e in facade.journal.query(limit=200)
+               if e.category == "propose" and e.action == "served"]
+    assert len(served3) == len(served2) + 1
+    assert served3[-1].cause == cache_cause  # identity-deduped plan event
+    assert served3[-1].detail["source"] == "cache"
+    # the dedup means ONE plan-selected row per distinct plan
+    plans = [e for e in facade.journal.query(limit=200)
+             if e.action == "plan-selected" and e.seq == cache_cause]
+    assert len(plans) == 1
+    trace = facade.trace_json()
+    instants = [t for t in trace["traceEvents"]
+                if t.get("ph") == "i" and t.get("cat") == "journal"]
+    assert any(t["name"] == "propose.served" for t in instants)
+    assert any(t["args"]["cause"] == cause for t in instants
+               if t["name"] == "propose.served")
+
+
+# ----------------------------------------------------------- scrape lint
+
+def test_merged_fleet_scrape_lint_journal_and_slo_families():
+    """EventJournal.* / SLO.* families are HELP-complete on a scrape and
+    duplicate-free on a merged fleet scrape (NamespacedRegistry per
+    member — the same bar test_fleet holds the LoadMonitor families
+    to)."""
+    from cruise_control_tpu.core.sensors import (CompositeRegistry,
+                                                 NamespacedRegistry,
+                                                 _render_exposition)
+    members = []
+    for i in range(2):
+        j = EventJournal(capacity=8)
+        j.record("propose", "served", severity="warn")
+        slo = SLOEvaluator(journal=j)
+        slo.add_objective("proposal-freshness", lambda: 20.0, 10.0)
+        slo.evaluate(1000, force=True)
+        members.append((j, slo))
+    regs = [r for j, s in members for r in (j.registry, s.registry)]
+    # single-member scrape: every family declared at construction, HELP
+    # lines present even before any traffic touches a series
+    one = CompositeRegistry(lambda: regs[:2]).expose_text()
+    lint_prometheus_exposition(one, expect_families=(
+        "cc_EventJournal_events_propose_total",
+        "cc_EventJournal_events_slo_total",
+        "cc_EventJournal_severity_warn_total",
+        "cc_EventJournal_applied_remote_total",
+        "cc_EventJournal_refused_records_total",
+        "cc_EventJournal_persist_writes_total",
+        "cc_EventJournal_last_seq",
+        "cc_EventJournal_dropped",
+        "cc_SLO_breaches_total",
+        "cc_SLO_recoveries_total",
+        "cc_SLO_objectives_breached",
+        "cc_SLO_proposal_freshness_fast_burn",
+        "cc_SLO_proposal_freshness_slow_burn",
+        "cc_SLO_proposal_freshness_observed_ms"))
+    # the naive two-member merge suffix-dedupes colliding families:
+    # rejected as unattributable
+    pairs = sorted(regs[0].snapshot() + regs[2].snapshot(),
+                   key=lambda pair: pair[0])
+    with pytest.raises(AssertionError, match="unlabeled"):
+        lint_prometheus_exposition(_render_exposition(pairs),
+                                   forbid_unlabeled_duplicates=True)
+    # the namespaced fleet scrape: attributable and duplicate-free
+    namespaced = CompositeRegistry(lambda: [
+        NamespacedRegistry(r, f"c{i}")
+        for i, (j, s) in enumerate(members)
+        for r in (j.registry, s.registry)]).expose_text()
+    lint_prometheus_exposition(namespaced,
+                               forbid_unlabeled_duplicates=True)
+    assert "cc_c0_EventJournal_events_propose_total" in namespaced
+    assert "cc_c1_SLO_breaches_total" in namespaced
+
+
+def test_category_counters_cover_the_closed_set():
+    j = EventJournal(capacity=4)
+    names = j.registry.names()
+    for c in CATEGORIES:
+        assert f"EventJournal.events-{c}" in names, c

@@ -55,6 +55,8 @@ class _Served:
             "anomaly.detection.interval.ms": "3600000",
             "goal.violation.detection.interval.ms": "3600000",
             "proposal.expiration.ms": "3600000",
+            # detector persistence stays under tmp_path, never the cwd
+            "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
             **extra}
         path = tmp_path / "cruisecontrol.properties"
         path.write_text("".join(f"{k}={v}\n" for k, v in props.items()))
@@ -136,9 +138,14 @@ def _assert_scale_proposals(body, sim):
     assert dests - set(range(10)), "nothing moved onto the empty brokers"
 
 
+@pytest.mark.slow
 def test_meshed_precompute_proposal_fetch_through_properties_file(tmp_path):
     """Properties file -> monitor -> PRECOMPUTE -> GET /proposals, with
-    the optimizer sharded over the 8-device mesh (search.mesh.devices)."""
+    the optimizer sharded over the 8-device mesh (search.mesh.devices).
+
+    slow: ~70s (mesh-sharded compiles at 50x2000 scale); the tier-1
+    representative for this file is
+    test_branched_rebalance_through_properties_file."""
     sim = _skewed_sim()
     served = _Served(tmp_path, sim, {"search.mesh.devices": "8"})
     try:

@@ -135,8 +135,14 @@ def test_kafka_assigner_mode():
     assert all(v == 0 for v in sanity_check(res.final_model).values())
 
 
+@pytest.mark.slow
 def test_full_default_chain_with_new_goals():
-    """The complete default chain (now 16 goals) runs end to end."""
+    """The complete default chain (now 16 goals) runs end to end.
+
+    slow: ~120s of one-off goal compiles on a 1-core CPU runner; the
+    chain's tier-1 representative is
+    test_branched_rebalance_through_properties_file plus the per-goal
+    cases above, which share _SHARED_CHAINS compile shapes."""
     brokers = [BrokerSpec(broker_id=i, rack=f"r{i % 3}") for i in range(6)]
     rng = np.random.default_rng(5)
     parts = [PartitionSpec(f"t{p % 4}", p,

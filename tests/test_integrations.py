@@ -256,11 +256,12 @@ def test_openapi_covers_all_endpoints():
     # 23 reference endpoints + the openapi document itself + this
     # build's simulate (what-if sweeps), trace (span export),
     # devicestats (device-runtime ledger), the fleet pair
-    # (fleet summary + fleet_rebalance forced tick), and the forecast
-    # pair (trajectory report + forecast_refresh forced refit).
+    # (fleet summary + fleet_rebalance forced tick), the forecast
+    # pair (trajectory report + forecast_refresh forced refit), and
+    # history (the control-plane flight recorder).
     spec = openapi_spec()
-    assert len(ENDPOINTS) == 31
-    assert len(spec["paths"]) == 31
+    assert len(ENDPOINTS) == 32
+    assert len(spec["paths"]) == 32
     assert "get" in spec["paths"]["/kafkacruisecontrol/devicestats"]
     assert "get" in spec["paths"]["/kafkacruisecontrol/fleet"]
     assert "post" in spec["paths"]["/kafkacruisecontrol/fleet_rebalance"]
@@ -271,3 +272,16 @@ def test_openapi_covers_all_endpoints():
     assert {"dryrun", "goals", "kafka_assigner",
             "review_id"} <= names
     assert "basicAuth" in spec["components"]["securitySchemes"]
+    # /history: documented, typed, and its 200 $ref round-trips to a
+    # schema that actually exists in components (a dangling $ref renders
+    # as a broken link in every OpenAPI UI).
+    hist = spec["paths"]["/kafkacruisecontrol/history"]["get"]
+    assert {p["name"] for p in hist["parameters"]} >= {
+        "category", "severity", "since_seq", "limit"}
+    ref = hist["responses"]["200"]["content"]["application/json"][
+        "schema"]["$ref"]
+    schema_name = ref.rsplit("/", 1)[1]
+    schema = spec["components"]["schemas"][schema_name]
+    assert "events" in schema["properties"]
+    event_props = schema["properties"]["events"]["items"]["properties"]
+    assert {"seq", "cause", "category", "severity"} <= set(event_props)

@@ -51,9 +51,11 @@ def test_reference_properties_file_parses():
 
 
 @pytest.fixture(scope="module")
-def served():
+def served(tmp_path_factory):
     from cruise_control_tpu.serve import _demo_cluster, build_app
     cfg = CruiseControlConfig({
+        "failed.brokers.file.path": str(
+            tmp_path_factory.mktemp("detector") / "failed_brokers.json"),
         "partition.metrics.window.ms": "1000",
         "num.partition.metrics.windows": "4",
         "broker.metrics.window.ms": "1000",
@@ -127,13 +129,14 @@ def test_cccli_parser_covers_endpoint_catalog():
         assert endpoint in subs, endpoint
 
 
-def test_mesh_config_wires_sharded_optimizer_into_served_stack():
+def test_mesh_config_wires_sharded_optimizer_into_served_stack(tmp_path):
     """search.mesh.devices shards the SERVED optimizer (the config path a
     multi-chip TPU host uses): rebalance through build_app converges with
     the 8-device virtual mesh and produces a consistent plan."""
     from cruise_control_tpu.serve import build_app
     from cruise_control_tpu.executor import SimulatedKafkaCluster
     cfg = CruiseControlConfig({
+        "failed.brokers.file.path": str(tmp_path / "failed_brokers.json"),
         "partition.metrics.window.ms": "1000",
         "num.partition.metrics.windows": "4",
         "broker.metrics.window.ms": "1000",
