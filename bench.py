@@ -892,6 +892,94 @@ def run_event_journal_overhead_bench(num_brokers: int = 50,
             "rows": journal.last_seq}
 
 
+def run_move_budget_bench(num_members: int = 16, budget: int = 96,
+                          local_cap: int = 8, seed: int = 0, *,
+                          emit_row: bool = True, gate: bool = True) -> dict:
+    """Scenario 13: the fleet move-budget coordinator's convergence tax
+    (fleet/budget.py). M member clusters all violating hard goals heal
+    concurrently; each can execute at most ``local_cap`` moves per tick
+    on its own (its executor concurrency cap), and the budgeted run
+    additionally draws every move from ONE fleet-wide per-tick budget.
+    Host-side toy dynamics on purpose: the quantity under test is the
+    allocator (starvation-freedom, urgency ordering, the throughput a
+    global cap costs), not the optimizer — the registry wiring is chaos-
+    gated in tests/test_chaos_fleet.py.
+
+    Three gates, all deterministic: (a) per-tick granted moves never
+    exceed the budget (carry-over disabled for the gate run), (b) two
+    identical runs produce the identical grant history, (c) total
+    time-to-balanced under the budget stays within 1.5x of unbudgeted —
+    a budget sized at ~75% of aggregate demand must throttle the burst,
+    not wedge convergence."""
+    from cruise_control_tpu.core.retry import deterministic_uniform
+    from cruise_control_tpu.fleet import (BudgetRequest,
+                                          MoveBudgetCoordinator)
+
+    #: seeded heterogeneous backlogs: every member starts in hard-goal
+    #: violation with 20..80 outstanding moves.
+    def initial_backlogs():
+        return {f"c{i:02d}": 20 + int(60 * deterministic_uniform(
+            seed, "budget-backlog", i)) for i in range(num_members)}
+
+    def run(budget_per_tick: int, max_ticks: int = 1_000):
+        coord = MoveBudgetCoordinator(budget_per_tick=budget_per_tick,
+                                      carry_max_ticks=0)
+        backlog = initial_backlogs()
+        history, ticks = [], 0
+        while any(backlog.values()):
+            ticks += 1
+            if ticks > max_ticks:
+                raise RuntimeError(
+                    f"move-budget bench: no convergence in {max_ticks} "
+                    f"ticks (budget {budget_per_tick})")
+            requests = [
+                BudgetRequest(cluster_id=cid,
+                              requested=min(left, local_cap),
+                              hard_violations=1,
+                              # Bigger backlog = nearer forecast breach.
+                              time_to_breach_ms=60_000 * local_cap
+                              // max(left, 1))
+                for cid, left in backlog.items() if left > 0]
+            grants = coord.allocate(requests, ticks)
+            history.append(tuple(sorted(
+                (cid, g.granted) for cid, g in grants.items())))
+            for cid, g in grants.items():
+                backlog[cid] -= min(g.granted, backlog[cid])
+        return ticks, history
+
+    t0 = time.monotonic()
+    unbudgeted_ticks, _ = run(0)
+    budgeted_ticks, hist1 = run(budget)
+    _, hist2 = run(budget)
+    wall_s = time.monotonic() - t0
+    worst_tick = max(sum(g for _, g in tick) for tick in hist1)
+    ratio = budgeted_ticks / unbudgeted_ticks
+    log(f"move budget ({num_members} members, budget {budget}, local cap "
+        f"{local_cap}): balanced in {budgeted_ticks} ticks vs "
+        f"{unbudgeted_ticks} unbudgeted ({ratio:.2f}x), worst tick "
+        f"granted {worst_tick}/{budget}, {wall_s:.2f}s host-side")
+    if gate:
+        if worst_tick > budget:
+            raise RuntimeError(
+                f"move-budget gate: a tick granted {worst_tick} moves > "
+                f"budget {budget}")
+        if hist1 != hist2:
+            raise RuntimeError(
+                "move-budget gate: two identical runs produced different "
+                "grant histories — allocation must be deterministic")
+        if ratio > 1.5:
+            raise RuntimeError(
+                f"move-budget gate: time-to-balanced ratio {ratio:.2f}x "
+                f"> 1.5x unbudgeted ({budgeted_ticks} vs "
+                f"{unbudgeted_ticks} ticks)")
+    if emit_row:
+        emit("fleet_move_budget_time_to_balanced_ratio", round(ratio, 3),
+             "x", 1.5)
+    return {"budgeted_ticks": budgeted_ticks,
+            "unbudgeted_ticks": unbudgeted_ticks, "ratio": ratio,
+            "worst_tick_granted": worst_tick, "budget": budget}
+
+
 def run_device_stats_bench(num_brokers: int = NUM_BROKERS,
                            num_partitions: int = NUM_PARTITIONS, *,
                            goal_names: list | None = None, cycles: int = 3,
@@ -2761,7 +2849,7 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
@@ -2776,7 +2864,9 @@ def main():
                          "11 = device-scheduled pipelined executor vs "
                          "greedy sequential per-batch execution, "
                          "12 = flight-recorder journal overhead on the "
-                         "warm propose path, enabled vs disabled)")
+                         "warm propose path, enabled vs disabled, "
+                         "13 = fleet move-budget coordinator, budgeted "
+                         "vs unbudgeted convergence)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -2861,6 +2951,12 @@ def main():
                 log("--mesh is ignored for scenario 12: the journal is "
                     "host-side bookkeeping (no device work to shard)")
             run_event_journal_overhead_bench()
+        elif args.scenario == 13:
+            if args.mesh:
+                log("--mesh is ignored for scenario 13: budget "
+                    "allocation is host-side arithmetic (no device "
+                    "work to shard)")
+            run_move_budget_bench()
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

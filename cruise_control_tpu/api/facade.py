@@ -363,6 +363,7 @@ class KafkaCruiseControl:
         return self.admin_retry.call(fn, *args,
                                      retry_on=RETRYABLE_ADMIN_ERRORS,
                                      sleep_ms=self._admin_sleep_ms,
+                                     now_ms=self._now_ms,
                                      on_retry=on_retry)
 
     # ----------------------------------------------------------- lifecycle

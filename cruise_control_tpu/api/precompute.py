@@ -355,6 +355,23 @@ class ProposalCache:
             self._cached_at_ms = None
             self._entry = None
 
+    def mark_stale(self) -> bool:
+        """Republish the current entry force-flagged ``stale_model`` (the
+        same degradation :meth:`restore_state` applies to a restored
+        snapshot). The fleet registry calls this when a member degrades
+        or quarantines: its last-good proposals keep SERVING (reads are
+        bounded-staleness by design) but the stale-execution gate
+        (facade._refuse_stale_execution) refuses to ACT on them until a
+        live fetch rebuilds the model. Returns False when the cache is
+        empty or already stale-flagged (idempotent)."""
+        from dataclasses import replace
+        with self._lock:
+            if self._cached is None or self._cached.stale_model:
+                return False
+            self._cached = replace(self._cached, stale_model=True)
+            self._publish_locked()
+            return True
+
     # -------------------------------------------------- snapshot/restore
     def export_state(self) -> dict | None:
         """The cache entry + generation keying + freshness stamps for the

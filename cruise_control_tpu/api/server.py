@@ -1043,7 +1043,17 @@ def route_request(app: "CruiseControlApp", method: str, raw_path: str,
                 return json_resp(503, {"errorMessage":
                                        "replication stream unavailable"},
                                  {"Retry-After": "1"})
-            data = encode_stream_payload(res)
+            # Delta compression is negotiated: only a poller that
+            # advertised compress=1 may receive a compressed payload
+            # (replication.compress.min.bytes sets the ring's
+            # threshold; 0 disables server-side).
+            wants_compressed = q.get("compress", ["0"])[0] == "1"
+            data = encode_stream_payload(
+                res,
+                compress_min_bytes=(
+                    getattr(channel, "compress_min_bytes", 0)
+                    if wants_compressed else 0),
+                stats=channel)
             outcome["status"] = 200
         return 200, "application/octet-stream", data, dict(app.cors)
     # /fleet and /fleet/rebalance: REST-shaped aliases for the fleet

@@ -34,6 +34,7 @@ seed-replayable like every other fault — while
 
 from .engine import (ChaosAdminClient, ChaosEngine, ChaosSampler,
                      FaultEvent, ProcessCrashed)
+from .fleet import ChaosEndpoint, ChaosFleetHarness
 from .ha import HAFailoverHarness, MutationStamp, corrupt_snapshot
 from .harness import ChaosHarness, build_sim, default_optimizer
 from .invariants import (check_fencing_invariants, check_invariants,
@@ -41,7 +42,9 @@ from .invariants import (check_fencing_invariants, check_invariants,
 
 __all__ = [
     "ChaosAdminClient",
+    "ChaosEndpoint",
     "ChaosEngine",
+    "ChaosFleetHarness",
     "ChaosHarness",
     "ChaosSampler",
     "FaultEvent",

@@ -169,7 +169,9 @@ class ChaosEngine:
     ACTIONS = ("kill_broker", "restart_broker", "fail_logdir",
                "stall_broker", "unstall_broker", "admin_error_rate",
                "admin_burst", "drop_samples", "clock_jump",
-               "crash_process", "cut_stream", "delay_stream")
+               "crash_process", "cut_stream", "delay_stream",
+               "kill_endpoint", "restart_endpoint", "delay_endpoint",
+               "flap_endpoint")
 
     def __init__(self, sim, *, seed: int = 0, step_ms: int = 1000,
                  events: list[FaultEvent] | None = None) -> None:
@@ -198,6 +200,13 @@ class ChaosEngine:
         #: old enough).
         self.stream_cut = False
         self.stream_delay_ms = 0
+        #: fleet-member endpoint faults (PR-19, keyed by member id; read
+        #: by ChaosEndpoint): a killed endpoint times out every admin
+        #: call; a delay burns sim time per call; a flap alternates the
+        #: endpoint up/down every ``period`` steps.
+        self.endpoints_down: set[str] = set()
+        self.endpoint_delay_ms: dict[str, int] = {}
+        self.endpoint_flap: dict[str, int] = {}
         self._admin_counters: dict[str, int] = {}
         self._saved_rates: dict[int, float] = {}
         #: clock offset applied on top of sim time (clock_jump faults)
@@ -303,6 +312,44 @@ class ChaosEngine:
         stream (0 restores the instant link). Delayed frames are hidden,
         not dropped — they deliver in order once old enough."""
         self.stream_delay_ms = max(0, int(ms))
+
+    def _do_kill_endpoint(self, member: str) -> None:
+        """Kill a fleet member's WHOLE admin/sampler endpoint: every
+        call from the coordinating plane times out (the member cluster
+        itself may be fine — this is the network/control-plane failure
+        domain the quarantine machine isolates)."""
+        self.endpoints_down.add(member)
+        self.endpoint_flap.pop(member, None)
+
+    def _do_restart_endpoint(self, member: str) -> None:
+        self.endpoints_down.discard(member)
+        self.endpoint_flap.pop(member, None)
+
+    def _do_delay_endpoint(self, member: str, ms: int = 0) -> None:
+        """Add ``ms`` of per-call latency to a member endpoint (0
+        restores). The caller's deadline decides whether the slowed call
+        still lands or counts as missed."""
+        if ms <= 0:
+            self.endpoint_delay_ms.pop(member, None)
+        else:
+            self.endpoint_delay_ms[member] = int(ms)
+
+    def _do_flap_endpoint(self, member: str, period: int = 1) -> None:
+        """Flap a member endpoint: alternates down/up every ``period``
+        steps, keyed off the shared step counter (down on even
+        ``step // period`` parity) so replay reproduces the exact same
+        up/down lattice."""
+        self.endpoints_down.discard(member)
+        self.endpoint_flap[member] = max(int(period), 1)
+
+    def endpoint_down(self, member: str) -> bool:
+        """Is this member's endpoint unreachable right now?"""
+        if member in self.endpoints_down:
+            return True
+        period = self.endpoint_flap.get(member)
+        if period:
+            return (self.step // period) % 2 == 0
+        return False
 
     def _do_clock_jump(self, ms: int) -> None:
         """Forward clock jump: simulated time leaps (windows roll, time

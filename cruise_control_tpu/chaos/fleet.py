@@ -1,0 +1,282 @@
+"""Multi-cluster chaos: M member stacks over ONE simulated clock.
+
+The fleet plane's failure domains are whole member *endpoints* — the
+admin/sampler surface the coordinating control plane reaches a member
+cluster through — so the faults here scope per member, not per broker:
+``kill_endpoint`` makes every call to one member time out,
+``delay_endpoint`` adds per-call latency the caller's deadline
+arbitrates, ``flap_endpoint`` alternates up/down on the shared step
+counter. :class:`ChaosEndpoint` is the interposition point;
+:class:`ChaosFleetHarness` wires M (sim, monitor, sampler) member stacks
+into one :class:`~cruise_control_tpu.fleet.FleetRegistry` (journal,
+notifier, and optionally a move-budget coordinator attached) and drives
+everything step-by-step off one :class:`~.engine.ChaosEngine`.
+
+Determinism contract: the registry runs ``fetch_workers=0`` (serial
+fetches in registration order) and the member monitors carry NO retry
+policy, so the only thing that advances the shared simulated clock is
+the engine itself plus the explicit latency an endpoint-delay fault
+burns — the same ``(schedule, seed)`` pair replays byte-identically
+(:meth:`ChaosFleetHarness.digest`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from ..core.events import EventJournal
+from ..detector import SelfHealingNotifier
+from ..executor.kafka_admin import AdminTimeoutError
+from ..fleet import FleetRegistry, MemberHealth, MoveBudgetCoordinator
+from ..monitor import (LoadMonitor, LoadMonitorTaskRunner,
+                       MetricFetcherManager, MonitorConfig,
+                       NotEnoughValidWindowsException)
+from ..monitor.sampler import SyntheticWorkloadSampler
+from .engine import ChaosEngine, ChaosSampler
+from .harness import DEFAULT_GOALS, build_sim, default_optimizer
+
+
+class ChaosEndpoint:
+    """A member cluster's admin/sampler endpoint under chaos: every
+    public call consults the shared engine's per-member fault state
+    before delegating to the member sim.
+
+    - endpoint down (killed, or in a flap's down phase): the call raises
+      :class:`AdminTimeoutError` immediately — the whole endpoint is
+      unreachable, not one RPC.
+    - endpoint delayed: the call burns the delay in *simulated* time
+      (bounded by ``call_deadline_ms``); a delay past the deadline is a
+      timeout. The burn rides ``engine.sleep_ms`` so scheduled faults
+      still land at their exact timestamps mid-call.
+    """
+
+    def __init__(self, inner, engine: ChaosEngine, member_id: str, *,
+                 call_deadline_ms: int = 0) -> None:
+        self.inner = inner
+        self.engine = engine
+        self.member_id = member_id
+        self.call_deadline_ms = call_deadline_ms
+        self.calls = 0
+        self.failed_calls = 0
+
+    def _gate(self, name: str) -> None:
+        self.calls += 1
+        eng = self.engine
+        delay = eng.endpoint_delay_ms.get(self.member_id, 0)
+        if delay:
+            burn = (min(delay, self.call_deadline_ms)
+                    if self.call_deadline_ms else delay)
+            eng.sleep_ms(burn)
+            if self.call_deadline_ms and delay > self.call_deadline_ms:
+                self.failed_calls += 1
+                raise AdminTimeoutError(
+                    f"endpoint {self.member_id!r}: {name} exceeded "
+                    f"{self.call_deadline_ms} ms deadline "
+                    f"({delay} ms injected delay)")
+        if eng.endpoint_down(self.member_id):
+            self.failed_calls += 1
+            raise AdminTimeoutError(
+                f"endpoint {self.member_id!r} unreachable: {name}")
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if name.startswith("_") or not callable(attr):
+            return attr
+
+        def call(*args, **kwargs):
+            self._gate(name)
+            return attr(*args, **kwargs)
+
+        call.__name__ = name
+        return call
+
+
+class _FleetMember:
+    """One member's stack: its own sim + endpoint + monitor + sampler
+    feed, registered into the shared registry."""
+
+    def __init__(self, member_id: str, sim, engine: ChaosEngine, *,
+                 step_ms: int, call_deadline_ms: int) -> None:
+        self.id = member_id
+        self.sim = sim
+        self.endpoint = ChaosEndpoint(sim, engine, member_id,
+                                      call_deadline_ms=call_deadline_ms)
+        # No admin retry policy and no stale serving: a degraded member
+        # must FAIL its fetch (the health machine's signal), and a
+        # readmission probe must succeed only once the model genuinely
+        # rebuilds from fresh post-recovery samples.
+        self.monitor = LoadMonitor(self.endpoint, MonitorConfig(
+            num_windows=4, window_ms=2 * step_ms,
+            min_samples_per_window=1,
+            num_broker_windows=4, broker_window_ms=2 * step_ms,
+            serve_stale_on_incomplete=False))
+        self.sampler = ChaosSampler(
+            SyntheticWorkloadSampler(self.endpoint), engine)
+        self.fetcher = MetricFetcherManager(self.sampler, max_retries=1)
+        self.runner = LoadMonitorTaskRunner(
+            self.monitor, self.fetcher, sampling_interval_ms=step_ms)
+        self.sampling_failures = 0
+        self.handle = None   # set by ChaosFleetHarness after register
+
+
+class ChaosFleetHarness:
+    """M member stacks + one FleetRegistry on one chaos clock.
+
+    Defaults are chaos-test scale and shape-shared with the rest of the
+    chaos suite (``build_sim`` members, ``default_optimizer`` chain):
+    quarantine after 2 degraded ticks, breakers tripping on 2 failures
+    inside a 8-step rolling window, reopening after 2 steps.
+    """
+
+    def __init__(self, member_ids=("east", "west", "south"), *,
+                 seed: int = 0, step_ms: int = 1000,
+                 goals: list[str] | None = None,
+                 optimizer=None,
+                 quarantine_after: int = 2,
+                 breaker_failures: int = 2,
+                 breaker_open_steps: int = 2,
+                 breaker_window_steps: int = 8,
+                 call_deadline_ms: int = 0,
+                 budget_per_tick: int = 0,
+                 budget_carry_max_ticks: int = 2) -> None:
+        member_ids = list(member_ids)
+        if not member_ids:
+            raise ValueError("a fleet needs at least one member")
+        sims = {mid: build_sim() for mid in member_ids}
+        # The FIRST member's sim carries the engine clock; siblings are
+        # advanced to the same now on every step.
+        self.engine = ChaosEngine(sims[member_ids[0]], seed=seed,
+                                  step_ms=step_ms)
+        self.step_ms = step_ms
+        self.journal = EventJournal(512, node="fleet",
+                                    now_ms=self.engine.now_ms,
+                                    categories=("fleet",))
+        self.notifier = SelfHealingNotifier(
+            alert_threshold_ms=step_ms,
+            self_healing_threshold_ms=3 * step_ms)
+        self.budget = (MoveBudgetCoordinator(
+            budget_per_tick=budget_per_tick,
+            carry_max_ticks=budget_carry_max_ticks,
+            journal=self.journal) if budget_per_tick > 0 else None)
+        goals = goals or list(DEFAULT_GOALS)
+        self.registry = FleetRegistry(
+            optimizer or default_optimizer(goals),
+            now_ms=self.engine.now_ms,
+            fetch_workers=0,                 # serial: replay-deterministic
+            quarantine_after=quarantine_after,
+            seed=seed,
+            breaker_window_ms=breaker_window_steps * step_ms,
+            breaker_failures=breaker_failures,
+            breaker_open_ms=breaker_open_steps * step_ms,
+            journal=self.journal, notifier=self.notifier,
+            budget=self.budget)
+        self.members: dict[str, _FleetMember] = {}
+        for mid in member_ids:
+            m = _FleetMember(mid, sims[mid], self.engine,
+                             step_ms=step_ms,
+                             call_deadline_ms=call_deadline_ms)
+            m.handle = self.registry.register(
+                mid, m.monitor, endpoint=f"chaos://{mid}")
+            m.runner.start(self.engine.now_ms(), skip_loading=True)
+            self.members[mid] = m
+        #: health-transition log: one line per observed per-member change
+        self.transitions: list[str] = []
+        self._last_health = {mid: MemberHealth.HEALTHY
+                             for mid in member_ids}
+        #: simulated ms each registry tick consumed (latency invariant:
+        #: a dead endpoint fails instantly, so sibling ticks burn 0)
+        self.tick_sim_cost_ms: list[int] = []
+
+    # -------------------------------------------------------------- loop
+    def step(self, *, tick: bool = True) -> dict | None:
+        """One fleet-plane iteration: advance the shared clock one step
+        (applying due faults), advance every member sim to now, run the
+        members' sampling rounds, then (``tick``) one registry tick."""
+        self.engine.tick()
+        now = self.engine.now_ms()
+        for m in self.members.values():
+            m.sim.advance_to(now)
+            try:
+                m.runner.maybe_run_sampling(now)
+            except Exception:   # noqa: BLE001 — chaos-injected
+                m.sampling_failures += 1
+        if not tick:
+            return None
+        before = self.engine.now_ms()
+        summary = self.registry.tick(before)
+        self.tick_sim_cost_ms.append(self.engine.now_ms() - before)
+        self._record_transitions()
+        return summary
+
+    def _record_transitions(self) -> None:
+        now = self.engine.now_ms()
+        for mid, m in self.members.items():
+            health = m.handle.health
+            if health != self._last_health[mid]:
+                self.transitions.append(
+                    f"[{now}ms] {mid}: "
+                    f"{self._last_health[mid]} -> {health}")
+                self._last_health[mid] = health
+
+    def run(self, steps: int, *, tick: bool = True) -> None:
+        for _ in range(steps):
+            self.step(tick=tick)
+
+    def warmup(self, max_steps: int = 12) -> None:
+        """Sampling-only steps until EVERY member can build a model,
+        then one forced registry tick (compiles the fleet dispatch and
+        fills every member's cache) — the pre-fault baseline."""
+        for _ in range(max_steps):
+            self.step(tick=False)
+            now = self.engine.now_ms()
+            try:
+                for m in self.members.values():
+                    m.monitor.cluster_model(now)
+            except NotEnoughValidWindowsException:
+                continue
+            self.registry.tick(now, force=True)
+            self._record_transitions()
+            return
+        raise AssertionError(
+            f"fleet never warmed in {max_steps} steps "
+            f"(seed={self.engine.seed})")
+
+    def steps_until(self, predicate, max_steps: int, *,
+                    what: str = "condition") -> int:
+        for i in range(max_steps):
+            if predicate():
+                return i
+            self.step()
+        raise AssertionError(
+            f"{what} not reached within {max_steps} steps "
+            f"(seed={self.engine.seed}); transitions:\n  "
+            + "\n  ".join(self.transitions)
+            + "\nchaos log:\n  " + "\n  ".join(self.engine.applied[-20:]))
+
+    # --------------------------------------------------------- predicates
+    def health(self, member_id: str) -> str:
+        return self.members[member_id].handle.health
+
+    def quarantined(self, member_id: str) -> bool:
+        return self.health(member_id) == MemberHealth.QUARANTINED
+
+    def healthy(self, member_id: str) -> bool:
+        return self.health(member_id) == MemberHealth.HEALTHY
+
+    # ------------------------------------------------------------- replay
+    def digest(self) -> str:
+        """Replay fingerprint: health transitions + applied-fault log +
+        the journal's deterministic fields (perf stamps excluded — they
+        ride the host perf counter, everything else rides the sim
+        clock). Two runs of the same ``(schedule, seed)`` must match
+        byte-identically."""
+        events = [(e.seq, e.ts_ms, e.category, e.action, e.severity,
+                   e.cause, e.epoch, e.detail)
+                  for e in self.journal.events()]
+        payload = {"transitions": self.transitions,
+                   "applied": self.engine.applied,
+                   "journal": events}
+        return hashlib.sha256(
+            json.dumps(payload, sort_keys=True,
+                       default=repr).encode()).hexdigest()

@@ -158,7 +158,8 @@ class HAFailoverHarness:
                  goals: list[str] | None = None,
                  processes: tuple[str, ...] = ("a", "b"),
                  replication: bool = False,
-                 max_staleness_ms: int = 5_000) -> None:
+                 max_staleness_ms: int = 5_000,
+                 non_promotable: tuple[str, ...] = ()) -> None:
         self.sim = sim or build_sim()
         self.engine = ChaosEngine(self.sim, seed=seed, step_ms=step_ms)
         self.snapshot_path = os.path.join(snapshot_dir, "cc.snapshot")
@@ -180,6 +181,9 @@ class HAFailoverHarness:
         if replication:
             from ..core.replication import ReplicationChannel
             self.channel = ReplicationChannel(fault_source=self.engine)
+        #: processes whose electors are ineligible for takeover (pure
+        #: read replicas: ``replication.replica.promotable=false``)
+        self._non_promotable = set(non_promotable)
         self.procs: dict[str, ChaosHarness] = {}
         for name in processes:
             self._spawn(name)
@@ -192,7 +196,8 @@ class HAFailoverHarness:
             optimizer=self._optimizer, goals=self._goals,
             snapshot_path=self.snapshot_path,
             snapshot_interval_steps=self._interval_steps,
-            ha_identity=name, ha_lease_steps=self._lease_steps)
+            ha_identity=name, ha_lease_steps=self._lease_steps,
+            ha_promotable=name not in self._non_promotable)
         admin.elector = h.facade.elector
         if self.channel is not None:
             h.facade.attach_replication_channel(

@@ -86,7 +86,8 @@ class ChaosHarness:
                  snapshot_interval_steps: int = 1,
                  snapshot_max_age_ms: int = 0,
                  ha_identity: str | None = None,
-                 ha_lease_steps: int = 5) -> None:
+                 ha_lease_steps: int = 5,
+                 ha_promotable: bool = True) -> None:
         """``engine``/``admin`` overrides support restart-from-snapshot
         (the replacement stack keeps the crashed stack's clock + fault
         schedule) and the two-process HA harness (per-process admin
@@ -157,7 +158,7 @@ class ChaosHarness:
             from ..core.leader import LeaderElector
             self.facade.attach_elector(LeaderElector(
                 admin, ha_identity, lease_ms=ha_lease_steps * step_ms,
-                now_ms=self.engine.now_ms))
+                now_ms=self.engine.now_ms, eligible=ha_promotable))
         #: set by :meth:`crash` — a crashed stack must not be driven.
         self.crashed = False
         #: sampling rounds that raised (chaos-injected; retried next tick)
@@ -180,7 +181,8 @@ class ChaosHarness:
             snapshot_path=snapshot_path,
             snapshot_interval_steps=snapshot_interval_steps,
             snapshot_max_age_ms=snapshot_max_age_ms,
-            ha_identity=ha_identity, ha_lease_steps=ha_lease_steps)
+            ha_identity=ha_identity, ha_lease_steps=ha_lease_steps,
+            ha_promotable=ha_promotable)
 
     # -------------------------------------------------------------- loop
     def step(self, *, detect: bool = True) -> None:

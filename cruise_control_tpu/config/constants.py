@@ -386,6 +386,27 @@ def _analyzer_defs(d: ConfigDef) -> None:
                  "frames (snapshots, epoch changes, proposal-cache "
                  "updates) always flush immediately. 0 disables "
                  "coalescing.")
+    d.define("replication.compress.min.bytes", ConfigType.INT, 4096,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Delta-compression threshold for /replication_stream "
+                 "responses: raw payloads at least this long are "
+                 "zlib-compressed on the wire (kept only when smaller). "
+                 "Negotiated per poll — only followers advertising "
+                 "compress=1 (every HttpReplicationClient since the "
+                 "flag existed) receive compressed bytes, so mixed-"
+                 "version fleets degrade to raw pickles, never to "
+                 "decode errors. Ratio metered as "
+                 "Replication.compression-ratio. 0 disables.")
+    d.define("replication.replica.promotable", ConfigType.BOOLEAN, True,
+             importance=Importance.LOW,
+             doc="May this stream-following replica TAKE leadership when "
+                 "the lease lapses? True (default) keeps the classic "
+                 "warm-standby failover. False pins the node as a pure "
+                 "read replica: its elector still observes the "
+                 "holder/epoch (reads, fencing floor) but the takeover "
+                 "branch is closed — use for scale-out read serving "
+                 "where promotion is an operator decision "
+                 "(docs/operations.md §Replication).")
     d.define("admission.rate.limit.enabled", ConfigType.BOOLEAN, False,
              importance=Importance.MEDIUM,
              doc="Per-principal write admission control "
@@ -767,6 +788,14 @@ def _executor_defs(d: ConfigDef) -> None:
     d.define("admin.retry.max.backoff.ms", ConfigType.LONG, 10_000,
              validator=Range.at_least(0), importance=Importance.LOW,
              doc="Backoff ceiling for admin retries")
+    d.define("admin.retry.deadline.ms", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Overall wall-clock budget across ALL attempts of one "
+                 "retried admin RPC: attempts are bounded but a "
+                 "slow-FAILING endpoint can stretch any per-call "
+                 "deadline through the backoff sleeps. When the next "
+                 "backoff would overshoot this budget the last error "
+                 "propagates instead of sleeping. 0 = unbounded.")
     d.define("execution.stuck.watchdog.timeout.ms", ConfigType.LONG,
              21_600_000, validator=Range.at_least(0),
              importance=Importance.LOW,
@@ -984,6 +1013,64 @@ def _detector_defs(d: ConfigDef) -> None:
              doc="This stack's cluster id inside the fleet: scopes its "
                  "proposal cache (ProposalCache.<id>.* sensors) so fleet "
                  "members never cross-serve proposals")
+    d.define("fleet.quarantine.after.ticks", ConfigType.INT, 3,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Consecutive degraded fleet ticks (failed/deadline-"
+                 "missed model fetches) before a member is QUARANTINED: "
+                 "excluded from the batched dispatch, its cached "
+                 "proposals stale-flagged (execution refuses them), "
+                 "FLEET_MEMBER_QUARANTINED raised through the anomaly "
+                 "plane, and the walk journaled with a cause chain "
+                 "(docs/fleet.md §Failure domains)")
+    d.define("fleet.fetch.workers", ConfigType.INT, 4,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Thread-pool width for the per-tick member model-fetch "
+                 "round (overlapped with device dispatch; quarantine "
+                 "probes ride the same pool). 0 = serial fetches in "
+                 "registration order — fully deterministic, what the "
+                 "chaos harness uses")
+    d.define("fleet.fetch.deadline.ms", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Per-member wall-clock budget for one fleet-tick model "
+                 "fetch (pooled fetches only): a member that misses it "
+                 "is skipped THIS tick and marked degraded — one slow "
+                 "member delays the shared tick by at most this much. "
+                 "0 = wait indefinitely")
+    d.define("fleet.call.deadline.ms", ConfigType.LONG, 10_000,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Hard per-call deadline on remote member admin/sampler "
+                 "calls (fleet.member.<id>.endpoint backends): a call "
+                 "that returns past it still raises CallDeadlineExceeded "
+                 "and feeds the member's breaker. 0 disables")
+    d.define("fleet.breaker.window.ms", ConfigType.LONG, 60_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Rolling window the per-member circuit breaker counts "
+                 "call failures over (fleet/backends.py)")
+    d.define("fleet.breaker.failures", ConfigType.INT, 3,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Failures inside fleet.breaker.window.ms that trip the "
+                 "member's breaker OPEN: further calls fast-fail "
+                 "(CircuitOpenError) without burning their deadline, "
+                 "until a seeded-jitter half-open probe succeeds")
+    d.define("fleet.breaker.open.ms", ConfigType.LONG, 30_000,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Base OPEN hold before the breaker schedules its "
+                 "half-open probe (actual delay is 1±0.5 jittered, "
+                 "seeded — deterministic under the chaos clock, "
+                 "desynchronized across members in production)")
+    d.define("fleet.move.budget.per.tick", ConfigType.INT, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Fleet-wide concurrent-move budget granted per tick "
+                 "(fleet/budget.py): members' proposal demands are "
+                 "ranked by urgency (hard-goal violations first, then "
+                 "forecast time-to-breach) and granted shares that never "
+                 "sum above the budget; denials carry over. 0 = "
+                 "unbudgeted (every member self-throttles locally only)")
+    d.define("fleet.budget.carry.max.ticks", ConfigType.INT, 2,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Cap on unused move-budget carried into later ticks, "
+                 "expressed in multiples of fleet.move.budget.per.tick "
+                 "— bounds the post-idle burst")
     d.define("kafka.broker.failure.detection.enable", ConfigType.BOOLEAN,
              False, importance=Importance.LOW,
              doc="Use metadata-polling broker failure detection (the "
@@ -1537,6 +1624,7 @@ class CruiseControlConfig(AbstractConfig):
                 max_attempts=self.get_int("admin.retry.max.attempts"),
                 backoff_ms=self.get_int("admin.retry.backoff.ms"),
                 max_backoff_ms=self.get_int("admin.retry.max.backoff.ms"),
+                deadline_ms=self.get_long("admin.retry.deadline.ms"),
                 # Per-process random jitter seed: fleet instances must
                 # not back off in lockstep after a shared controller
                 # hiccup (pid would read 1 in every container, so it

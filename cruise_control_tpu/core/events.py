@@ -58,7 +58,7 @@ EVENT_SENSOR = "EventJournal"
 #: pre-created at construction so the Prometheus family set is stable
 #: (merged-scrape lint asserts HELP-completeness against it).
 CATEGORIES = ("propose", "optimizer", "execute", "election", "replication",
-              "admission", "detector", "snapshot", "slo")
+              "admission", "detector", "snapshot", "slo", "fleet")
 
 #: severity ladder, least to most severe (the /history ``severity``
 #: filter is a minimum-severity cut).

@@ -66,13 +66,20 @@ class LeaderElector:
     from one loop)."""
 
     def __init__(self, admin, identity: str, *, lease_ms: int = 15_000,
-                 now_ms=None, registry=None) -> None:
+                 now_ms=None, registry=None, eligible: bool = True) -> None:
         import threading
 
         from .sensors import MetricRegistry
         self.admin = admin
         self.identity = identity
         self.lease_ms = int(lease_ms)
+        #: may this process ever TAKE leadership? An ineligible elector
+        #: (a pure read replica: ``replication.replica.promotable=false``)
+        #: still ticks — it observes the holder/epoch for /state and the
+        #: executor's fence floor — but the takeover branch is closed, so
+        #: it can never become the writer no matter how long the lease
+        #: stays vacant.
+        self.eligible = bool(eligible)
         self._now_ms = now_ms or (lambda: int(_time.time() * 1000))
         #: serializes tick/keepalive/resign — the serving loop ticks from
         #: the main thread while a blocked execution keepalives from its
@@ -179,6 +186,10 @@ class LeaderElector:
                 self._lease_until = now + self.lease_ms
             elif now >= self._lease_until:
                 self._demote("lease expired and renewal failed")
+        elif not self.eligible:
+            # Not promotable: observe only. The vacancy is someone
+            # else's to claim.
+            self._role = "standby"
         elif holder is None or now >= until or holder == self.identity:
             # Vacant, expired, or OUR OWN lease from a previous
             # incarnation (a leader that crashed and restarted under the
@@ -256,6 +267,7 @@ class LeaderElector:
     def to_json(self) -> dict:
         return {"identity": self.identity,
                 "role": "leader" if self.is_leader() else "standby",
+                "promotable": self.eligible,
                 "leaderId": self.leader_id(),
                 "fencingEpoch": self.epoch or None,
                 "observedEpoch": self.observed_epoch or None,

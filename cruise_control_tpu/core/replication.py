@@ -59,6 +59,7 @@ import io
 import logging
 import pickle
 import threading
+import zlib
 from collections import deque
 from dataclasses import dataclass
 
@@ -66,6 +67,14 @@ LOG = logging.getLogger(__name__)
 
 #: sensor group for the streaming series (``Replication.*``).
 REPLICATION_SENSOR = "Replication"
+
+#: wire prefix marking a zlib-compressed stream payload. A raw pickle
+#: (protocol >= 2) always starts with ``b"\x80"``, so the prefix is
+#: unambiguous — :func:`decode_stream_payload` dispatches on it, which
+#: is what lets an upgraded follower decode both forms while an old
+#: follower (which never advertises ``compress=1``) only ever receives
+#: raw pickles.
+COMPRESSED_MAGIC = b"CCZ1"
 
 #: follower state machine states, in the nominal lifecycle order.
 SYNCING = "SYNCING"
@@ -125,12 +134,19 @@ class ReplicationChannel:
     """
 
     def __init__(self, *, capacity: int = 256, fault_source=None,
-                 registry=None) -> None:
+                 registry=None, compress_min_bytes: int = 0) -> None:
         from .sensors import MetricRegistry
         self.capacity = int(capacity)
         #: object exposing ``stream_cut`` / ``stream_delay_ms`` (the
         #: chaos engine); None = no fault injection.
         self.fault_source = fault_source
+        #: HTTP payload compression threshold
+        #: (``replication.compress.min.bytes``): poll responses whose raw
+        #: encoding is at least this long are zlib-compressed — but ONLY
+        #: for followers that advertised support (``compress=1`` on the
+        #: poll query). 0 disables. The serving handler reads this off
+        #: the ring it resolved.
+        self.compress_min_bytes = int(compress_min_bytes)
         self._cond = threading.Condition()
         self._frames: deque = deque()
         self._next_seq = 1
@@ -142,8 +158,16 @@ class ReplicationChannel:
         self._polls = self.registry.counter(name(g, "polls"))
         self._polls_dropped = self.registry.counter(
             name(g, "polls-dropped"))
+        self._payload_raw = self.registry.counter(
+            name(g, "payload-bytes-raw"))
+        self._payload_wire = self.registry.counter(
+            name(g, "payload-bytes-wire"))
+        self._payloads_compressed = self.registry.counter(
+            name(g, "payloads-compressed"))
         self.registry.gauge(name(g, "frames-buffered"),
                             lambda: len(self._frames))
+        self.registry.gauge(name(g, "compression-ratio"),
+                            self.compression_ratio)
 
     # ------------------------------------------------------------ leader
     def publish(self, frame: dict, now_ms: int) -> int:
@@ -200,6 +224,21 @@ class ReplicationChannel:
                 result = self._visible(cursor, now_ms, delay)
         return result
 
+    def note_payload(self, raw_len: int, wire_len: int) -> None:
+        """Meter one encoded poll response: raw vs on-the-wire bytes
+        (called by :func:`encode_stream_payload` when this ring is passed
+        as ``stats``) — the compression-ratio series."""
+        self._payload_raw.inc(int(raw_len))
+        self._payload_wire.inc(int(wire_len))
+        if wire_len < raw_len:
+            self._payloads_compressed.inc()
+
+    def compression_ratio(self) -> float | None:
+        """wire/raw byte ratio over all encoded payloads (1.0 = nothing
+        saved; None until a payload was served)."""
+        raw = self._payload_raw.count
+        return (self._payload_wire.count / raw) if raw else None
+
     def _visible(self, cursor: int, now_ms: int, delay: int) -> PollResult:
         base = (self._frames[0]["seq"] if self._frames else self._next_seq)
         start = cursor if cursor > 0 else base
@@ -221,26 +260,53 @@ class ReplicationChannel:
                 "evicted": self._evicted.count,
                 "polls": self._polls.count,
                 "pollsDropped": self._polls_dropped.count,
+                "compressMinBytes": self.compress_min_bytes,
+                "payloadsCompressed": self._payloads_compressed.count,
+                "compressionRatio": self.compression_ratio(),
             }
 
 
 # ------------------------------------------------------- wire encoding
-def encode_stream_payload(res: PollResult) -> bytes:
+def encode_stream_payload(res: PollResult, *, compress_min_bytes: int = 0,
+                          stats=None) -> bytes:
     """Serialize a poll result for the ``/replication_stream`` response
     body (dicts + numpy arrays only — round-trips through the snapshot
-    allowlist)."""
-    return pickle.dumps(
+    allowlist).
+
+    ``compress_min_bytes > 0`` enables delta compression: a raw encoding
+    at least that long is zlib-compressed behind the
+    :data:`COMPRESSED_MAGIC` prefix — kept only when it actually shrank
+    (metric deltas are float arrays; tiny batches can inflate). The
+    caller passes 0 unless the *poller* advertised support
+    (``compress=1``), so a pre-compression follower always gets a plain
+    pickle. ``stats`` (the serving ring) gets ``note_payload(raw_len,
+    wire_len)`` for the compression-ratio series."""
+    raw = pickle.dumps(
         {"frames": res.frames, "headSeq": res.head_seq,
          "baseSeq": res.base_seq, "nowMs": res.now_ms, "reset": res.reset},
         protocol=pickle.HIGHEST_PROTOCOL)
+    data = raw
+    if compress_min_bytes and len(raw) >= int(compress_min_bytes):
+        packed = COMPRESSED_MAGIC + zlib.compress(raw)
+        if len(packed) < len(raw):
+            data = packed
+    note = getattr(stats, "note_payload", None)
+    if note is not None:
+        note(len(raw), len(data))
+    return data
 
 
 def decode_stream_payload(raw: bytes) -> PollResult:
     """Decode a ``/replication_stream`` body with the same restricted
     unpickler the snapshot restore path trusts: the stream shares the
     snapshot's trust boundary (leader-authenticated, allowlisted
-    globals), never arbitrary code execution."""
+    globals), never arbitrary code execution. Transparently inflates
+    compressed payloads (the :data:`COMPRESSED_MAGIC` prefix) — the
+    decompression happens *before* the restricted unpickle, so the trust
+    boundary is unchanged."""
     from .snapshot import _RestrictedUnpickler
+    if raw.startswith(COMPRESSED_MAGIC):
+        raw = zlib.decompress(raw[len(COMPRESSED_MAGIC):])
     obj = _RestrictedUnpickler(io.BytesIO(raw)).load()
     return PollResult(frames=list(obj["frames"]),
                       head_seq=int(obj["headSeq"]),
@@ -267,8 +333,11 @@ class HttpReplicationClient:
     def poll(self, cursor: int, now_ms: int,
              wait_ms: int = 0) -> PollResult | None:
         import http.client
+        # compress=1 advertises that THIS follower can inflate
+        # COMPRESSED_MAGIC payloads; the leader only compresses for
+        # pollers that say so (old followers keep getting raw pickles).
         path = (f"/kafkacruisecontrol/replication_stream?json=true"
-                f"&cursor={int(cursor)}&wait_ms={int(wait_ms)}")
+                f"&cursor={int(cursor)}&wait_ms={int(wait_ms)}&compress=1")
         try:
             conn = http.client.HTTPConnection(
                 self.host, self.port,

@@ -56,6 +56,13 @@ class RetryPolicy:
     #: per process so fleet instances decorrelate their retry waves
     #: instead of re-colliding in sync after a shared controller hiccup.
     seed: int = 0
+    #: overall wall-clock budget across ALL attempts of one ``call``
+    #: (admin.retry.deadline.ms). Attempts are bounded but elapsed time
+    #: is not: a slow-FAILING endpoint can stretch any per-call deadline
+    #: through the backoff sleeps. When the budget would be exceeded by
+    #: the next backoff, the last exception propagates instead of
+    #: sleeping. 0 = unbounded (the pre-existing behavior).
+    deadline_ms: int = 0
 
     def delay_ms(self, attempt: int, seed: int | None = None) -> int:
         """Backoff before the attempt AFTER 0-based ``attempt``."""
@@ -67,16 +74,24 @@ class RetryPolicy:
         return max(int(base * scale), 0)
 
     def call(self, fn, *args, retry_on: tuple = (), sleep_ms=None,
-             on_retry=None, seed: int | None = None, **kwargs):
+             on_retry=None, seed: int | None = None, now_ms=None,
+             **kwargs):
         """Invoke ``fn(*args, **kwargs)`` under this policy.
 
         ``on_retry(attempt, delay_ms, exc)`` fires before each backoff
         sleep (meters/logs hook); a non-``retry_on`` exception — or the
-        final retryable one — propagates unchanged.
+        final retryable one — propagates unchanged. ``now_ms`` is the
+        clock the ``deadline_ms`` budget is measured on: pass the same
+        simulated clock as ``sleep_ms`` so chaos replays of a
+        deadline-cut retry ladder stay byte-identical (defaults to the
+        process monotonic clock).
         """
         if sleep_ms is None:
             sleep_ms = lambda ms: _time.sleep(ms / 1000.0)  # noqa: E731
+        if now_ms is None:
+            now_ms = lambda: int(_time.monotonic() * 1000)  # noqa: E731
         attempts = max(self.max_attempts, 1)
+        start = now_ms() if self.deadline_ms else 0
         for attempt in range(attempts):
             try:
                 return fn(*args, **kwargs)
@@ -84,6 +99,12 @@ class RetryPolicy:
                 if attempt == attempts - 1:
                     raise
                 delay = self.delay_ms(attempt, seed)
+                if self.deadline_ms:
+                    elapsed = now_ms() - start
+                    if elapsed + delay > self.deadline_ms:
+                        # Sleeping would overshoot the budget; the call
+                        # has already consumed its wall-clock allowance.
+                        raise
                 if on_retry is not None:
                     on_retry(attempt, delay, exc)
                 sleep_ms(delay)

@@ -32,11 +32,18 @@ class KafkaAnomalyType(enum.IntEnum):
     #: (forecast/detector.py) — like BROKER_RISK, a projection: lowest
     #: priority, provisioning evidence rather than a self-healing drain
     CAPACITY_FORECAST = 7
+    #: a fleet member's endpoint walked DEGRADED → QUARANTINED
+    #: (fleet/registry.py health state machine) — alert-only: the
+    #: member's DATA plane may be perfectly healthy behind an
+    #: unreachable endpoint, so the only safe "fix" is the registry's
+    #: own half-open probe/readmission cycle, not a drain. Still a
+    #: realized control-plane fault, so it outranks the SLO page below
+    FLEET_MEMBER_QUARANTINED = 8
     #: SLO burn-rate breach from core/slo.py — an alerting signal about
     #: the control plane's own freshness, not a cluster fault: lowest
     #: priority of all so every real (or even projected) anomaly
     #: outranks it in the heal queue
-    SLO_BREACH = 8
+    SLO_BREACH = 9
 
 
 _ids = itertools.count()
@@ -376,6 +383,44 @@ class SLOBreach(KafkaAnomaly):
         out["targetMs"] = self.target_ms
         out["fastBurn"] = self.fast_burn
         out["slowBurn"] = self.slow_burn
+        out["journalSeq"] = self.journal_seq
+        return out
+
+
+@dataclass
+class FleetMemberQuarantined(KafkaAnomaly):
+    """A fleet member crossed the quarantine threshold: N consecutive
+    degraded ticks (breaker open / fetch deadline missed / fetch error)
+    and the registry excluded it from the fleet stack and dispatch
+    (fleet/registry.py). Alert-only: ``fix()`` declines — readmission is
+    the registry's own half-open probe → warm rebuild → rejoin cycle,
+    and draining a cluster because its ENDPOINT is unreachable would
+    punish a healthy data plane. ``journal_seq`` links the quarantine
+    event in the flight recorder (``fleet`` category) for cause-chain
+    forensics."""
+
+    cluster_id: str = ""
+    degraded_ticks: int = 0
+    breaker_state: str = ""
+    last_error: str | None = None
+    journal_seq: int | None = None
+    anomaly_type: KafkaAnomalyType = \
+        KafkaAnomalyType.FLEET_MEMBER_QUARANTINED
+
+    def reason(self) -> str:
+        return (f"Fleet member {self.cluster_id!r} quarantined after "
+                f"{self.degraded_ticks} degraded ticks (breaker "
+                f"{self.breaker_state}; last error: {self.last_error})")
+
+    def fix(self, facade) -> bool:
+        return False   # alert-only: readmission is the registry's probe
+
+    def to_json(self) -> dict:
+        out = super().to_json()
+        out["clusterId"] = self.cluster_id
+        out["degradedTicks"] = self.degraded_ticks
+        out["breakerState"] = self.breaker_state
+        out["lastError"] = self.last_error
         out["journalSeq"] = self.journal_seq
         return out
 

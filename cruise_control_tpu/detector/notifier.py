@@ -84,6 +84,13 @@ class SelfHealingNotifier(AnomalyNotifier):
         atype = anomaly.anomaly_type
         if isinstance(anomaly, BrokerFailures):
             return self._on_broker_failure(anomaly, now_ms)
+        if atype is KafkaAnomalyType.FLEET_MEMBER_QUARANTINED:
+            # Alert-only regardless of the enabled map: the member's data
+            # plane may be perfectly healthy behind an unreachable
+            # endpoint — there is nothing a local fix could move, and the
+            # registry's own readmission probes are the recovery path.
+            self._alert(f"{atype.name}: {anomaly.reason()}", False)
+            return NotificationAction(AnomalyNotificationResult.IGNORE)
         if not self._enabled.get(atype, False):
             self._alert(f"{atype.name}: {anomaly.reason()} "
                         "(self-healing disabled)", False)

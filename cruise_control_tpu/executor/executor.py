@@ -377,7 +377,8 @@ class Executor:
                 delay_ms)
         return self.config.admin_retry.call(
             fn, *args, retry_on=RETRYABLE_ADMIN_ERRORS,
-            sleep_ms=self._sleep_ms, on_retry=on_retry, **kwargs)
+            sleep_ms=self._sleep_ms, now_ms=self._now_ms,
+            on_retry=on_retry, **kwargs)
 
     def _teardown_call(self, what: str, fn, *args, **kwargs):
         """Teardown-path variant of :meth:`_admin_call`: retries like the

@@ -7,11 +7,25 @@ hard-goal audit + polish) and the N-1 resilience sweep over the cluster
 axis in one dispatch each; ``registry.py`` is the host side — per-cluster
 monitors feeding the shared tick, per-cluster proposal caches, anomaly
 fan-out, and the ``/fleet`` API surface.
+
+Fault isolation (PR 19): ``backends.py`` wraps per-member remote
+endpoints with deadlines, shared retry, and a per-member circuit
+breaker; the registry runs a HEALTHY → DEGRADED → QUARANTINED →
+READMITTING health machine per member so one unreachable cluster
+endpoint degrades ONE member while siblings keep their tick cadence;
+``budget.py`` grants per-tick moves from one fleet-wide budget,
+urgency-weighted.
 """
 
 from ..model.fleet import FleetMember, FleetModel
+from .backends import (CallDeadlineExceeded, CircuitBreaker,
+                       CircuitOpenError, MemberHealth, RemoteBackend)
+from .budget import BudgetGrant, BudgetRequest, MoveBudgetCoordinator
 from .engine import CLUSTER_AXIS, FleetOptimizer
 from .registry import FleetRegistry
 
-__all__ = ["FleetMember", "FleetModel", "FleetOptimizer", "FleetRegistry",
+__all__ = ["BudgetGrant", "BudgetRequest", "CallDeadlineExceeded",
+           "CircuitBreaker", "CircuitOpenError", "FleetMember",
+           "FleetModel", "FleetOptimizer", "FleetRegistry",
+           "MemberHealth", "MoveBudgetCoordinator", "RemoteBackend",
            "CLUSTER_AXIS"]

@@ -184,7 +184,8 @@ class LoadMonitor:
                  broker_set_resolver=None,
                  max_concurrent_model_builds: int = 2,
                  registry=None, tracer=None, collector=None,
-                 admin_retry=None, sleep_ms=None, mesh=None) -> None:
+                 admin_retry=None, sleep_ms=None, now_ms=None,
+                 mesh=None) -> None:
         from ..core.runtime_obs import default_collector
         from ..core.sensors import (LOAD_MONITOR_SENSOR, MetricRegistry)
         from ..core.tracing import default_tracer
@@ -222,6 +223,11 @@ class LoadMonitor:
         #: library default, so toy stacks keep exact-call semantics.
         self._admin_retry = admin_retry
         self._admin_sleep_ms = sleep_ms
+        #: clock the retry policy's overall deadline budget is measured
+        #: on (admin.retry.deadline.ms) — the chaos harness passes its
+        #: engine clock alongside the engine sleep so deadline cuts
+        #: replay byte-identically.
+        self._admin_now_ms = now_ms
         self.registry = registry or MetricRegistry()
         #: optional jax.sharding.Mesh (search.mesh.devices, wired by
         #: serve.py): dense model builds upload straight into the
@@ -305,6 +311,7 @@ class LoadMonitor:
                 attempt + 1, delay_ms)
         return self._admin_retry.call(fn, retry_on=RETRYABLE_ADMIN_ERRORS,
                                       sleep_ms=self._admin_sleep_ms,
+                                      now_ms=self._admin_now_ms,
                                       on_retry=on_retry)
 
     def _topology_snapshot(self, ttl_s: float = 5.0) -> dict:

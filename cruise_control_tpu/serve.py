@@ -151,17 +151,52 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                                     if fleet_enabled else None))
     if fleet_enabled:
         # Fleet control plane: the local stack is the first member (its
-        # monitor + cluster-scoped proposal cache), further clusters
-        # register programmatically. One batched [C] dispatch per tick
-        # refreshes every stale member cache (docs/fleet.md); the tick
-        # loop starts in main() alongside the facade's own refresher.
-        from .fleet import FleetRegistry
+        # monitor + cluster-scoped proposal cache); every
+        # fleet.member.<id>.endpoint key adds a remote member whose
+        # admin rides a RemoteBackend failure domain (per-call deadline
+        # + retry + circuit breaker — docs/fleet.md §Failure domains).
+        # One batched [C] dispatch per tick refreshes every stale member
+        # cache; the tick loop starts in main() alongside the facade's
+        # own refresher.
+        from .core.retry import NO_RETRY
+        from .fleet import (FleetRegistry, MoveBudgetCoordinator,
+                            RemoteBackend)
+        budget = None
+        if config.get_int("fleet.move.budget.per.tick") > 0:
+            budget = MoveBudgetCoordinator(
+                budget_per_tick=config.get_int("fleet.move.budget.per.tick"),
+                carry_max_ticks=config.get_int("fleet.budget.carry.max.ticks"),
+                journal=facade.journal)
         facade.fleet = FleetRegistry(
             optimizer,
-            max_clusters=config.get_int("fleet.max.clusters"))
+            max_clusters=config.get_int("fleet.max.clusters"),
+            quarantine_after=config.get_int("fleet.quarantine.after.ticks"),
+            fetch_workers=config.get_int("fleet.fetch.workers"),
+            fetch_deadline_ms=config.get_long("fleet.fetch.deadline.ms"),
+            breaker_window_ms=config.get_long("fleet.breaker.window.ms"),
+            breaker_failures=config.get_int("fleet.breaker.failures"),
+            breaker_open_ms=config.get_long("fleet.breaker.open.ms"),
+            journal=facade.journal, budget=budget)
         facade.fleet.register(
             config.get_string("fleet.cluster.id"), monitor,
             proposal_cache=facade.proposal_cache)
+        call_deadline = config.get_long("fleet.call.deadline.ms")
+        for mid, ep in FleetRegistry.member_endpoints(config).items():
+            # Each remote member gets its own admin client (the
+            # admin.client.class plugin in real deployments, a demo sim
+            # otherwise) behind a RemoteBackend carrying the member's
+            # endpoint — its breaker doubles as the health-machine
+            # breaker, so backend call failures and fleet-tick fetch
+            # failures share one rolling window.
+            backend = RemoteBackend(
+                mid, _make_admin(config), endpoint=ep,
+                retry=executor.config.admin_retry or NO_RETRY,
+                call_deadline_ms=call_deadline)
+            facade.fleet.register(
+                mid, LoadMonitor(backend, config.monitor_config(),
+                                 capacity_resolver=resolver,
+                                 admin_retry=None),
+                backend=backend)
 
     # Control-plane flight recorder (core/events.py; docs/observability.md
     # §Flight recorder): reconfigure the facade-built journal from the
@@ -206,7 +241,10 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
             f"{_socket.gethostname()}:"
             f"{config.get_int('webserver.http.port')}-{_os.getpid()}")
         facade.attach_elector(LeaderElector(
-            admin, identity, lease_ms=config.get_long("ha.lease.ms")))
+            admin, identity, lease_ms=config.get_long("ha.lease.ms"),
+            # replication.replica.promotable=false pins a pure read
+            # replica: its elector observes but never takes the lease.
+            eligible=config.get_boolean("replication.replica.promotable")))
         # Snapshot-delta streaming to read replicas (core/replication.py;
         # docs/operations.md §Replication): the leader publishes resident
         # deltas into the local ring (served at /replication_stream);
@@ -222,7 +260,9 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                                            HttpReplicationClient,
                                            ReplicationChannel)
             ring = ReplicationChannel(
-                capacity=config.get_int("replication.buffer.frames"))
+                capacity=config.get_int("replication.buffer.frames"),
+                compress_min_bytes=config.get_int(
+                    "replication.compress.min.bytes"))
             channel = ring
             peer = config.get_string("replication.leader.endpoint")
             if peer:
@@ -288,6 +328,10 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
         self_healing_threshold_ms=config.get_int(
             "broker.failure.self.healing.threshold.ms"),
         enabled={t: healing_for(t) for t in KafkaAnomalyType})
+    if facade.fleet is not None:
+        # Built before the notifier existed: quarantine anomalies
+        # (FLEET_MEMBER_QUARANTINED, alert-only) route through it.
+        facade.fleet.notifier = notifier
     detector = AnomalyDetectorManager(
         facade, notifier,
         fixable_broker_count_threshold=config.get_int(

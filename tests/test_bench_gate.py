@@ -346,3 +346,18 @@ def test_executor_schedule_bench_smoke_gate():
     assert out["recompiles"] == 0
     assert out["polls_skipped"] > out["polls_performed"]
     assert out["sched_moves_per_s"] > 0 and out["greedy_moves_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_move_budget_bench_smoke_gate():
+    """run_move_budget_bench at full bench shape WITH its gates armed:
+    the run is host-side arithmetic (milliseconds), so the smoke can
+    afford to let the in-function gates fire — per-tick grants never
+    exceed the budget, two identical runs produce the identical grant
+    history, and the budgeted time-to-balanced stays within 1.5x of
+    unbudgeted."""
+    import bench
+    out = bench.run_move_budget_bench(emit_row=False, gate=True)
+    assert out["worst_tick_granted"] <= out["budget"]
+    assert out["budgeted_ticks"] >= out["unbudgeted_ticks"]
+    assert out["ratio"] <= 1.5

@@ -764,7 +764,10 @@ def test_replicated_midstream_leader_kill(optimizer, chaos_seed, tmp_path):
     replicas, the stream severed at the instant the leader dies. Proves
     via the stream ledger that (a) no deposed epoch's delta is ever
     folded into replica state — a straggler frame from the dead reign is
-    refused by fence floor; (b) failover promotes exactly one writer;
+    refused by fence floor; (b) failover promotes exactly one writer —
+    and only a PROMOTABLE one: replica "c" runs with
+    replication.replica.promotable=false semantics (elector ineligible),
+    so the vacancy must fall to "b" no matter the timing;
     (c) replicas transition to LAGGING and refuse gated reads while the
     stream is down, and reconverge to STREAMING within the staleness
     bound once it is restored."""
@@ -774,7 +777,8 @@ def test_replicated_midstream_leader_kill(optimizer, chaos_seed, tmp_path):
     seed = _pick(chaos_seed, 33)
     ha = HAFailoverHarness(seed=seed, snapshot_dir=str(tmp_path),
                            optimizer=optimizer, processes=("a", "b", "c"),
-                           replication=True, max_staleness_ms=2000)
+                           replication=True, max_staleness_ms=2000,
+                           non_promotable=("c",))
     for _ in range(12):
         ha.step()
     leader = ha.leader()
@@ -809,6 +813,13 @@ def test_replicated_midstream_leader_kill(optimizer, chaos_seed, tmp_path):
     ha.steps_until(lambda: ha.leader() is not None, 30, what="failover")
     new_leader = ha.leader()
     assert new_leader != leader
+    # Auto-promotion respects eligibility: the non-promotable replica
+    # "c" observed the vacancy but never claimed it.
+    assert new_leader != "c"
+    c_elector = ha.procs["c"].facade.elector
+    assert not c_elector.eligible and c_elector.epoch == 0
+    assert not any(s.process == "c" for s in ha.stamps), \
+        "a non-promotable replica must never issue fenced mutations"
     new_epoch = ha.procs[new_leader].facade.elector.epoch
     assert new_epoch > old_epoch
     live_leading = [n for n, h in ha.procs.items()

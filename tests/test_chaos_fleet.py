@@ -1,0 +1,216 @@
+"""Fleet-plane chaos: whole member-*endpoint* faults through the
+fault-isolated fleet registry (fleet/registry.py health machine +
+fleet/backends.py breakers) driven by ChaosFleetHarness.
+
+The failure domain here is one member cluster's admin/sampler endpoint —
+kill, flap, delay — and the contract is isolation: the faulted member
+walks HEALTHY → DEGRADED → QUARANTINED (its cached proposals stale-flag
+and refuse execution; the anomaly plane alerts; the flight recorder
+keeps the cause chain) while the sibling members' shared tick keeps its
+cadence and its compiled programs. Recovery walks QUARANTINED →
+READMITTING → HEALTHY through seeded half-open breaker probes. Every
+scenario replays byte-identically from its seed
+(``--chaos-seed=N`` overrides, same as tests/test_chaos.py).
+"""
+
+import pytest
+
+from cruise_control_tpu.chaos import (ChaosFleetHarness, check_invariants,
+                                      default_optimizer, snapshot_topology)
+from cruise_control_tpu.core.runtime_obs import default_collector
+from cruise_control_tpu.fleet import MemberHealth
+
+pytestmark = pytest.mark.chaos
+
+MEMBERS = ("east", "west", "south")
+
+
+@pytest.fixture(scope="module")
+def optimizer():
+    """Shared with tests/test_chaos.py via the process-wide
+    default_optimizer cache: the fleet dispatch compiles once."""
+    return default_optimizer()
+
+
+@pytest.fixture
+def chaos_seed(request):
+    return request.config.getoption("--chaos-seed")
+
+
+def _pick(chaos_seed, default):
+    return default if chaos_seed is None else chaos_seed
+
+
+def _run_kill_scenario(optimizer, seed, *, mid_asserts=None
+                       ) -> ChaosFleetHarness:
+    """The headline schedule: warm 3-member fleet, kill one member's
+    whole endpoint, walk it to QUARANTINED, restart the endpoint, walk
+    it back to HEALTHY. Deterministic in ``seed``."""
+    h = ChaosFleetHarness(MEMBERS, seed=seed, optimizer=optimizer)
+    h.warmup()
+    h.engine.schedule(h.engine.step + 1, "kill_endpoint", member="west")
+    h.steps_until(lambda: h.quarantined("west"), 8,
+                  what="west quarantined")
+    if mid_asserts is not None:
+        mid_asserts(h)
+    h.engine.schedule(h.engine.step + 1, "restart_endpoint",
+                      member="west")
+    h.steps_until(lambda: h.healthy("west"), 30, what="west readmitted")
+    return h
+
+
+def test_fleet_member_endpoint_kill_quarantine_and_readmit(
+        optimizer, chaos_seed):
+    """Headline: kill one member's endpoint mid-run. The dead member is
+    skipped the same tick (siblings' tick completes without burning sim
+    time on it), walks DEGRADED → QUARANTINED within the configured
+    ticks, its cached proposals refuse execution, the quarantine is
+    alerted + journaled with a cause chain — and readmission converges
+    with the invariant set clean and ZERO recompiles."""
+    seed = _pick(chaos_seed, 7)
+    baselines = None
+    compile_base = {}
+
+    def mid(h: ChaosFleetHarness):
+        # The dead member's tick skips were free for the siblings: no
+        # registry tick burned simulated time waiting on the endpoint
+        # (kill = instant timeout; the tick-latency invariant).
+        assert all(c == 0 for c in h.tick_sim_cost_ms), h.tick_sim_cost_ms
+        # Siblings never left HEALTHY.
+        assert h.healthy("east") and h.healthy("south"), h.transitions
+        assert all(" west: " in t for t in h.transitions), h.transitions
+        # Last-good proposals survive but are stale-flagged — exactly the
+        # flag facade._refuse_stale_execution raises
+        # StaleClusterModelError on for non-dryrun execution.
+        entry = h.members["west"].handle.cache.latest_entry()
+        assert entry is not None and entry.result.stale_model
+        # Anomaly plane: FLEET_MEMBER_QUARANTINED alerted (alert-only).
+        assert any("FLEET_MEMBER_QUARANTINED" in a
+                   for a in h.notifier.alerts), h.notifier.alerts
+        # Flight recorder: quarantine journaled, cause-linked to the
+        # degradation that started the walk.
+        events = {e.action: e for e in h.journal.query(
+            categories=["fleet"])}
+        assert "member-degraded" in events, events
+        quar = events["member-quarantined"]
+        assert quar.severity == "error"
+        assert quar.cause == events["member-degraded"].seq
+        assert quar.detail["clusterId"] == "west"
+
+    h = ChaosFleetHarness(MEMBERS, seed=seed, optimizer=optimizer)
+    h.warmup()
+    baselines = {mid_: snapshot_topology(m.sim)
+                 for mid_, m in h.members.items()}
+    compile_base = default_collector().snapshot()
+    h.engine.schedule(h.engine.step + 1, "kill_endpoint", member="west")
+    h.steps_until(lambda: h.quarantined("west"), 8,
+                  what="west quarantined")
+    mid(h)
+    h.engine.schedule(h.engine.step + 1, "restart_endpoint",
+                      member="west")
+    h.steps_until(lambda: h.healthy("west"), 30, what="west readmitted")
+    # Readmission path journaled too (probe success → warm rebuild).
+    actions = [e.action for e in h.journal.query(categories=["fleet"])]
+    assert "member-readmitting" in actions
+    assert "member-readmitted" in actions
+    # The full walk — 3-ready ticks, 2-ready quarantine ticks, probes,
+    # 3-ready readmitted ticks — reused the warmup's compiled programs:
+    # the cluster-bucket floor pins to the TOTAL member count, so
+    # excluding a quarantined member is the partial-readiness path, not
+    # a new shape.
+    after = default_collector().snapshot()
+    assert after["compileEvents"] == compile_base["compileEvents"], \
+        "quarantine/readmit must not change dispatch shapes"
+    assert after["recompileEvents"] == compile_base["recompileEvents"]
+    # Post-recovery: every member cluster upholds the chaos contract
+    # (the endpoint fault never touched the data plane).
+    for mid_, m in h.members.items():
+        problems = check_invariants(m.sim, baselines[mid_])
+        assert not problems, f"{mid_}: {problems} (seed={seed})"
+    # And the recovered member serves fresh (non-stale) proposals again.
+    entry = h.members["west"].handle.cache.latest_entry()
+    assert entry is not None and not entry.result.stale_model
+
+
+def test_fleet_kill_scenario_replays_byte_identically(
+        optimizer, chaos_seed):
+    """The whole scenario — health transitions, applied faults, journal
+    contents — is a pure function of (schedule, seed): two runs produce
+    identical digests. Serial fetches + probe scheduling off the seeded
+    breaker jitter are what make this hold."""
+    seed = _pick(chaos_seed, 7)
+    d1 = _run_kill_scenario(optimizer, seed).digest()
+    d2 = _run_kill_scenario(optimizer, seed).digest()
+    assert d1 == d2
+
+
+def test_fleet_endpoint_delay_respects_call_deadline(
+        optimizer, chaos_seed):
+    """A *slow* (not dead) endpoint: injected per-call latency above the
+    backend call deadline times out — the member degrades like a kill,
+    but each fetch burns at most one deadline's worth of simulated time,
+    so a slow member delays the shared tick by a bounded, configured
+    amount instead of wedging it."""
+    seed = _pick(chaos_seed, 5)
+    h = ChaosFleetHarness(MEMBERS, seed=seed, optimizer=optimizer,
+                          call_deadline_ms=500)
+    h.warmup()
+    h.engine.schedule(h.engine.step + 1, "delay_endpoint",
+                      member="south", ms=5_000)
+    h.run(2)
+    handle = h.members["south"].handle
+    assert handle.health in (MemberHealth.DEGRADED,
+                             MemberHealth.QUARANTINED), handle.health
+    assert "deadline" in (handle.last_error or ""), handle.last_error
+    # Tick latency bound: the fetch fails on its FIRST gated admin call,
+    # so each tick consumed at most the 500 ms call deadline.
+    assert all(c <= 500 for c in h.tick_sim_cost_ms[-2:]), \
+        h.tick_sim_cost_ms
+    assert h.healthy("east") and h.healthy("west")
+
+
+@pytest.mark.slow
+def test_fleet_endpoint_flap_is_caught_by_the_breaker(
+        optimizer, chaos_seed):
+    """A flapping endpoint (up/down every step) never accumulates the
+    consecutive degraded ticks quarantine wants — but the breaker's
+    rolling window counts ALL failures, trips OPEN, and fast-fails the
+    member into a steady degraded walk that DOES quarantine: flap
+    protection is the breaker's job, not the tick counter's."""
+    seed = _pick(chaos_seed, 13)
+    h = ChaosFleetHarness(MEMBERS, seed=seed, optimizer=optimizer)
+    h.warmup()
+    h.engine.schedule(h.engine.step + 1, "flap_endpoint", member="west",
+                      period=1)
+    h.steps_until(lambda: h.quarantined("west"), 20,
+                  what="flapping west quarantined")
+    assert h.members["west"].handle.breaker.open_count >= 1
+    # Stop the flap; the member readmits through the same probe path.
+    h.engine.schedule(h.engine.step + 1, "restart_endpoint",
+                      member="west")
+    h.steps_until(lambda: h.healthy("west"), 30, what="west readmitted")
+
+
+@pytest.mark.slow
+def test_fleet_move_budget_toy_smoke(optimizer, chaos_seed):
+    """Toy budget smoke (the real gate is bench scenario 13): with a
+    fleet-wide per-tick budget wired, forced ticks journal allocations,
+    per-tick grants never exceed budget + carry headroom, and every
+    member's summary row carries its grant."""
+    seed = _pick(chaos_seed, 3)
+    h = ChaosFleetHarness(MEMBERS, seed=seed, optimizer=optimizer,
+                          budget_per_tick=4, budget_carry_max_ticks=2)
+    h.warmup()
+    for _ in range(3):
+        h.step()
+        h.registry.tick(h.engine.now_ms(), force=True)
+    budget_events = [e for e in h.journal.query(categories=["fleet"])
+                     if e.action == "budget-allocated"]
+    assert budget_events, "budgeted ticks must journal allocations"
+    for e in budget_events:
+        assert e.detail["budget"] == 4
+        assert e.detail["granted"] <= 4 + 2 * 4, e.detail
+    summary = h.registry.summary_json(h.engine.now_ms())
+    assert summary["budget"]["budgetPerTick"] == 4
+    granted_rows = [c.get("budget") for c in summary["clusters"]]
+    assert any(g is not None for g in granted_rows), granted_rows

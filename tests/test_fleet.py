@@ -361,7 +361,7 @@ def test_fleet_registry_tick_feeds_cluster_caches(fleet_registry):
     _advance(feeds, clock, bump=0.0)
     summary = registry.tick()
     assert summary == {"clusters": 2, "ready": 2, "proposed": 2,
-                       "errors": 0, "skipped": 0}
+                       "errors": 0, "skipped": 0, "quarantined": 0}
     for cid in ("east", "west"):
         h = registry.member(cid)
         assert h.cache.valid(), cid
@@ -415,7 +415,8 @@ def test_fleet_partial_readiness_reuses_programs():
     # Only a and b have samples; "late" stays NOT_READY.
     _advance(feeds[:2], clock, bump=0.0)
     assert registry.tick() == {"clusters": 3, "ready": 2, "proposed": 2,
-                               "errors": 0, "skipped": 0}   # warm-up tick
+                               "errors": 0, "skipped": 0,
+                               "quarantined": 0}   # warm-up tick
     collector = default_collector()
     before = collector.snapshot()
     _advance(feeds[:2], clock, bump=1.0)

@@ -1,0 +1,343 @@
+"""Pure-unit coverage for the fleet failure-domain pieces: the
+circuit breaker's seeded window math and half-open probe scheduling
+(fleet/backends.py), the RemoteBackend deadline/fast-fail wrapper, the
+registry's member health state machine driven with fake monitors (no
+goal chains, no JAX — tier-1 cheap), and the move-budget coordinator's
+deterministic urgency-weighted allocation (fleet/budget.py)."""
+
+import pytest
+
+from cruise_control_tpu.core.events import EventJournal
+from cruise_control_tpu.detector import SelfHealingNotifier
+from cruise_control_tpu.fleet import (BudgetRequest, CallDeadlineExceeded,
+                                      CircuitBreaker, CircuitOpenError,
+                                      FleetRegistry, MemberHealth,
+                                      MoveBudgetCoordinator, RemoteBackend)
+
+
+# --------------------------------------------------------------- breaker
+def test_breaker_counts_failures_in_rolling_window_only():
+    b = CircuitBreaker(window_ms=1_000, failure_threshold=2, open_ms=500)
+    b.record_failure(0)
+    # Second failure lands after the first slid out of the window: no
+    # trip — only failures inside window_ms count together.
+    b.record_failure(2_000)
+    assert b.state == CircuitBreaker.CLOSED
+    assert b.failures_in_window(2_000) == 1
+    b.record_failure(2_500)
+    assert b.state == CircuitBreaker.OPEN
+    assert b.open_count == 1
+
+
+def test_breaker_probe_time_is_seeded_deterministic_and_bounded():
+    mk = lambda: CircuitBreaker(window_ms=1_000, failure_threshold=1,
+                                open_ms=1_000, jitter=0.2, seed=7,
+                                name="east")
+    b1, b2 = mk(), mk()
+    b1.record_failure(100)
+    b2.record_failure(100)
+    # Same (seed, name, episode) -> identical probe schedule: the chaos
+    # replay gate depends on this.
+    assert b1.probe_at == b2.probe_at
+    assert 100 + 800 <= b1.probe_at <= 100 + 1_200
+    # A different member's breaker draws a different jitter (the probes
+    # must not resonate fleet-wide against a periodic fault).
+    b3 = CircuitBreaker(window_ms=1_000, failure_threshold=1,
+                        open_ms=1_000, jitter=0.2, seed=7, name="west")
+    b3.record_failure(100)
+    assert b3.probe_at != b1.probe_at
+
+
+def test_breaker_half_open_admits_one_probe_and_reopens_on_failure():
+    b = CircuitBreaker(window_ms=1_000, failure_threshold=1, open_ms=500,
+                       jitter=0.0, seed=3, name="m")
+    b.record_failure(100)
+    assert b.state == CircuitBreaker.OPEN and b.probe_at == 600
+    assert not b.allow(400)           # not due yet: fail fast
+    assert b.allow(600)               # exactly one probe admitted
+    assert b.state == CircuitBreaker.HALF_OPEN
+    assert not b.allow(600)           # single-flight: no second caller
+    b.record_failure(650)             # probe failed: re-open, re-jitter
+    assert b.state == CircuitBreaker.OPEN and b.open_count == 2
+    assert b.probe_at == 650 + 500
+    assert b.allow(b.probe_at)
+    b.record_success(1_200)           # probe success heals completely
+    assert b.state == CircuitBreaker.CLOSED
+    assert b.failures_in_window(1_200) == 0 and b.probe_at is None
+
+
+# --------------------------------------------------------------- backend
+class _Clock:
+    def __init__(self):
+        self.t = 0
+
+    def now(self):
+        return self.t
+
+
+class _Target:
+    """Fake admin endpoint whose calls burn simulated time."""
+
+    def __init__(self, clock, cost_ms=0, fail=False):
+        self._clock = clock
+        self.cost_ms = cost_ms
+        self.fail = fail
+        self.calls = 0
+        self.cluster_id = "c0"   # non-callable: passes through
+
+    def describe_cluster(self):
+        self.calls += 1
+        self._clock.t += self.cost_ms
+        if self.fail:
+            raise RuntimeError("endpoint down")
+        return [0, 1]
+
+
+def test_remote_backend_deadline_feeds_breaker_and_fast_fails():
+    clock = _Clock()
+    target = _Target(clock, cost_ms=600)
+    breaker = CircuitBreaker(window_ms=10_000, failure_threshold=1,
+                             open_ms=5_000, jitter=0.0)
+    be = RemoteBackend("east", target, endpoint="grpc://east:1",
+                       breaker=breaker, call_deadline_ms=500,
+                       now_ms=clock.now)
+    # The call returns, but too late: charged to the breaker and refused.
+    with pytest.raises(CallDeadlineExceeded):
+        be.describe_cluster()
+    assert be.deadline_misses == 1 and breaker.state == CircuitBreaker.OPEN
+    # Breaker OPEN: the next call fast-fails WITHOUT touching the target.
+    calls_before = target.calls
+    with pytest.raises(CircuitOpenError):
+        be.describe_cluster()
+    assert target.calls == calls_before and be.fast_fails == 1
+    # Non-callable attributes pass straight through the proxy.
+    assert be.cluster_id == "c0"
+    assert be.to_json()["deadlineMisses"] == 1
+
+
+def test_remote_backend_success_heals_breaker():
+    clock = _Clock()
+    target = _Target(clock, cost_ms=10, fail=True)
+    breaker = CircuitBreaker(window_ms=10_000, failure_threshold=1,
+                             open_ms=100, jitter=0.0)
+    be = RemoteBackend("west", target, breaker=breaker,
+                       call_deadline_ms=500, now_ms=clock.now)
+    with pytest.raises(RuntimeError):
+        be.describe_cluster()
+    assert breaker.state == CircuitBreaker.OPEN
+    target.fail = False
+    clock.t = breaker.probe_at        # probe due
+    assert be.describe_cluster() == [0, 1]
+    assert breaker.state == CircuitBreaker.CLOSED
+    assert be.calls == 2 and be.failures == 1
+
+
+# --------------------------------------------- registry health machine
+class _FakeCache:
+    def __init__(self, cache_id):
+        self.cache_id = cache_id
+        self.stale = False
+
+    def mark_stale(self):
+        was = self.stale
+        self.stale = True
+        return not was
+
+
+class _FakeResult:
+    generation = 1
+
+
+class _FakeMonitor:
+    def __init__(self):
+        self.fail = False
+
+    def cluster_model(self, now):
+        if isinstance(self.fail, Exception):
+            raise self.fail
+        if self.fail:
+            raise RuntimeError("no samples")
+        return _FakeResult()
+
+
+def _registry(**kw):
+    """A FleetRegistry over a dummy optimizer: the engine is never
+    dispatched here — only the health machine runs."""
+    journal = EventJournal(64, node="t", categories=("fleet",))
+    notifier = SelfHealingNotifier(alert_threshold_ms=1,
+                                   self_healing_threshold_ms=2)
+    reg = FleetRegistry(object(), fetch_workers=0, journal=journal,
+                        notifier=notifier, **kw)
+    return reg, journal, notifier
+
+
+def _member(reg, cid="m1", **kw):
+    mon = _FakeMonitor()
+    h = reg.register(cid, mon, proposal_cache=_FakeCache(cid), **kw)
+    return h, mon
+
+
+def _fail_fetch(reg, h, now):
+    for got, _res, err, fault in reg._fetch_round([h], now):
+        assert err is not None and fault
+        reg._on_fetch_fail(got, now, err)
+
+
+def test_health_machine_walks_degraded_quarantined_readmitting():
+    reg, journal, notifier = _registry(quarantine_after=2,
+                                       breaker_failures=2,
+                                       breaker_open_ms=1_000)
+    h, mon = _member(reg)
+    mon.fail = True
+    _fail_fetch(reg, h, 1_000)
+    assert h.health == MemberHealth.DEGRADED and h.degraded_ticks == 1
+    assert h.cache.stale           # last-good proposals refuse execution
+    _fail_fetch(reg, h, 2_000)
+    assert h.health == MemberHealth.QUARANTINED
+    assert any("FLEET_MEMBER_QUARANTINED" in a for a in notifier.alerts)
+    events = {e.action: e for e in journal.query(categories=["fleet"])}
+    assert events["member-quarantined"].cause \
+        == events["member-degraded"].seq
+    # Probe not due while the breaker holds OPEN: no probe submitted.
+    assert reg._submit_probes([h], h.breaker.probe_at - 1) == []
+    # Due probe succeeds -> READMITTING; next tick's fetch -> HEALTHY.
+    mon.fail = False
+    reg._collect_probes(reg._submit_probes([h], h.breaker.probe_at),
+                        h.breaker.probe_at)
+    assert h.health == MemberHealth.READMITTING
+    reg._on_fetch_ok(h, 5_000, _FakeResult())
+    assert h.health == MemberHealth.HEALTHY and h.degraded_ticks == 0
+    actions = [e.action for e in journal.query(categories=["fleet"])]
+    assert actions[-2:] == ["member-readmitting", "member-readmitted"]
+
+
+def test_cold_monitor_is_not_ready_never_a_fault():
+    """NotEnoughValidWindows is a cold data plane, not an endpoint
+    fault: the member is skipped (ready False, lastError set) but the
+    breaker stays CLOSED, health stays HEALTHY, and a READMITTING
+    member warming back up is not re-quarantined for it."""
+    from cruise_control_tpu.core.aggregator import \
+        NotEnoughValidWindowsError
+
+    reg, journal, notifier = _registry(quarantine_after=1,
+                                       breaker_failures=1,
+                                       breaker_open_ms=1_000)
+    h, mon = _member(reg)
+    mon.fail = NotEnoughValidWindowsError("0 valid windows")
+
+    def fetch(now):
+        rows = reg._fetch_round([h], now)
+        (got, res, err, fault), = rows
+        return err, fault
+
+    for now in (1_000, 2_000, 3_000):
+        err, fault = fetch(now)
+        assert err and not fault
+        reg._on_fetch_not_ready(h, err)
+    assert h.health == MemberHealth.HEALTHY and not h.ready
+    assert h.breaker.state == "CLOSED"
+    assert "NotEnoughValidWindows" in h.last_error
+    assert not h.cache.stale
+    assert notifier.alerts == []
+    # READMITTING + cold stays READMITTING (no requarantine): the real
+    # fault quarantines it, the recovered-but-cold endpoint probes back
+    # to READMITTING, cold fetches are skipped until it warms.
+    mon.fail = RuntimeError("endpoint dead")
+    _fail_fetch(reg, h, 10_000)
+    assert h.health == MemberHealth.QUARANTINED
+    mon.fail = NotEnoughValidWindowsError("0 valid windows")
+    reg._collect_probes(reg._submit_probes([h], h.breaker.probe_at),
+                        h.breaker.probe_at)
+    assert h.health == MemberHealth.READMITTING   # transport answered
+    err, fault = fetch(20_000)
+    assert err and not fault
+    reg._on_fetch_not_ready(h, err)
+    assert h.health == MemberHealth.READMITTING   # not requarantined
+    mon.fail = False
+    reg._on_fetch_ok(h, 21_000, _FakeResult())
+    assert h.health == MemberHealth.HEALTHY
+
+
+def test_readmission_hysteresis_requarantines_without_degraded_walk():
+    reg, journal, _ = _registry(quarantine_after=2, breaker_failures=2,
+                                breaker_open_ms=1_000)
+    h, mon = _member(reg)
+    mon.fail = True
+    _fail_fetch(reg, h, 1_000)
+    _fail_fetch(reg, h, 2_000)
+    assert h.health == MemberHealth.QUARANTINED
+    mon.fail = False
+    probe_at = h.breaker.probe_at
+    reg._collect_probes(reg._submit_probes([h], probe_at), probe_at)
+    assert h.health == MemberHealth.READMITTING
+    # First post-probe fetch fails: straight back to QUARANTINED (no
+    # DEGRADED detour — a flapping member must not re-enter the pool).
+    mon.fail = True
+    _fail_fetch(reg, h, probe_at + 500)
+    assert h.health == MemberHealth.QUARANTINED
+    actions = [e.action for e in journal.query(categories=["fleet"])]
+    assert actions[-1] == "member-requarantined"
+
+
+def test_probe_failure_keeps_quarantine_and_retrips_breaker():
+    reg, _, _ = _registry(quarantine_after=1, breaker_failures=1,
+                          breaker_open_ms=1_000)
+    h, mon = _member(reg)
+    mon.fail = True
+    _fail_fetch(reg, h, 1_000)
+    assert h.health == MemberHealth.QUARANTINED
+    probe_at = h.breaker.probe_at
+    reg._collect_probes(reg._submit_probes([h], probe_at), probe_at)
+    assert h.health == MemberHealth.QUARANTINED
+    assert h.breaker.open_count == 2     # probe failure re-jittered
+
+
+# ---------------------------------------------------------------- budget
+def _req(cid, requested, hard=0, tt=None):
+    return BudgetRequest(cluster_id=cid, requested=requested,
+                         hard_violations=hard, time_to_breach_ms=tt)
+
+
+def test_budget_grants_never_exceed_budget_and_order_by_urgency():
+    coord = MoveBudgetCoordinator(budget_per_tick=10, carry_max_ticks=0)
+    grants = coord.allocate([_req("calm", 8),
+                             _req("violating", 8, hard=2),
+                             _req("breaching", 8, tt=30_000)], 0)
+    assert sum(g.granted for g in grants.values()) <= 10
+    # Hard violations dominate, then the nearer forecast breach.
+    assert grants["violating"].granted >= grants["breaching"].granted
+    assert grants["breaching"].granted >= grants["calm"].granted
+    assert grants["violating"].urgency > grants["breaching"].urgency \
+        > grants["calm"].urgency
+    assert grants["calm"].denied == 8 - grants["calm"].granted
+
+
+def test_budget_allocation_is_deterministic():
+    reqs = [_req("b", 5, hard=1), _req("a", 5, hard=1), _req("c", 9)]
+    g1 = MoveBudgetCoordinator(budget_per_tick=7).allocate(list(reqs), 0)
+    g2 = MoveBudgetCoordinator(budget_per_tick=7).allocate(list(reqs), 0)
+    assert {c: g.to_json() for c, g in g1.items()} \
+        == {c: g.to_json() for c, g in g2.items()}
+
+
+def test_budget_carry_over_is_capped_and_spendable():
+    coord = MoveBudgetCoordinator(budget_per_tick=4, carry_max_ticks=1)
+    # Quiet tick: only 1 of 4 units used -> 3 leftover, capped at 4.
+    coord.allocate([_req("a", 1)], 0)
+    assert coord.carry == 3
+    # Burst tick: budget + carry-over both spendable, nothing beyond.
+    grants = coord.allocate([_req("a", 100)], 1)
+    assert grants["a"].granted == 4 + 3
+    assert coord.carry == 0
+    j = coord.to_json()
+    assert j["totalGranted"] == 8 and j["carryMax"] == 4
+
+
+def test_budget_zero_means_unbudgeted_grant_all():
+    journal = EventJournal(16, node="t", categories=("fleet",))
+    coord = MoveBudgetCoordinator(budget_per_tick=0, journal=journal)
+    grants = coord.allocate([_req("a", 50), _req("b", 7, hard=3)], 0)
+    assert grants["a"].granted == 50 and grants["b"].granted == 7
+    (event,) = journal.query(categories=["fleet"])
+    assert event.detail["budget"] is None
+    assert event.detail["granted"] == 57 and event.detail["denied"] == 0

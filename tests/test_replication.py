@@ -12,9 +12,9 @@ import numpy as np
 import pytest
 
 from cruise_control_tpu.core.replication import (
-    LAGGING, RESYNC, STREAMING, SYNCING, DualChannel, PollResult,
-    ReplicationChannel, ReplicationSession, decode_stream_payload,
-    encode_stream_payload)
+    COMPRESSED_MAGIC, LAGGING, RESYNC, STREAMING, SYNCING, DualChannel,
+    PollResult, ReplicationChannel, ReplicationSession,
+    decode_stream_payload, encode_stream_payload)
 
 
 class Faults:
@@ -90,6 +90,41 @@ def test_stream_payload_roundtrip_with_arrays():
     assert out.now_ms == 456 and out.reset is False
     np.testing.assert_array_equal(out.frames[0]["idx"], frames[0]["idx"])
     np.testing.assert_array_equal(out.frames[0]["rows"], frames[0]["rows"])
+
+
+def test_stream_payload_compresses_above_threshold_and_meters():
+    # Metric-delta rows are repetitive float arrays: zlib wins big. The
+    # serving ring (passed as stats) meters raw vs wire bytes.
+    frames = [{"seq": 1, "stampMs": 5,
+               "rows": np.zeros((64, 16), dtype=np.float64)}]
+    res = PollResult(frames=frames, head_seq=1, base_seq=1, now_ms=9,
+                     reset=False)
+    ring = ReplicationChannel(capacity=8, compress_min_bytes=256)
+    wire = encode_stream_payload(res, compress_min_bytes=256, stats=ring)
+    assert wire.startswith(COMPRESSED_MAGIC)
+    raw = encode_stream_payload(res)
+    assert len(wire) < len(raw)
+    out = decode_stream_payload(wire)
+    np.testing.assert_array_equal(out.frames[0]["rows"],
+                                  frames[0]["rows"])
+    assert out.head_seq == 1 and out.now_ms == 9
+    j = ring.to_json()
+    assert j["payloadsCompressed"] == 1
+    assert 0 < j["compressionRatio"] < 1.0
+
+
+def test_stream_payload_below_threshold_or_unnegotiated_stays_raw():
+    res = PollResult(frames=[{"seq": 1, "stampMs": 5}], head_seq=1,
+                     base_seq=1, now_ms=9, reset=False)
+    # Below the threshold: raw pickle on the wire.
+    small = encode_stream_payload(res, compress_min_bytes=1_000_000)
+    assert small.startswith(b"\x80")
+    # Threshold 0 is what the server passes for a poller that did NOT
+    # advertise compress=1 (an old follower): always a raw pickle, which
+    # any decoder version loads.
+    legacy = encode_stream_payload(res)
+    assert legacy.startswith(b"\x80")
+    assert decode_stream_payload(legacy).head_seq == 1
 
 
 def test_stream_payload_refuses_arbitrary_globals():

@@ -86,3 +86,67 @@ def test_on_retry_hook_sees_attempt_delay_and_exception():
     policy.call(fn, retry_on=(TimeoutError,), sleep_ms=lambda ms: None,
                 on_retry=lambda a, d, e: seen.append((a, d, str(e))))
     assert seen == [(0, 50, "transient #1"), (1, 100, "transient #2")]
+
+
+class _FakeClock:
+    """Simulated ms clock the deadline budget + sleeps share (the shape
+    the monitor/facade/executor call sites wire: the SAME clock feeds
+    ``now_ms`` and advances on ``sleep_ms``)."""
+
+    def __init__(self, per_call_cost_ms=0):
+        self.now = 0
+        self.per_call_cost_ms = per_call_cost_ms
+        self.sleeps = []
+
+    def now_ms(self):
+        return self.now
+
+    def sleep_ms(self, ms):
+        self.sleeps.append(ms)
+        self.now += ms
+
+
+def test_deadline_budget_cuts_retry_ladder_short():
+    # 4 attempts would sleep 100+200+400 = 700 ms; a 250 ms budget must
+    # stop after the first backoff (100 + 200 > 250) and raise the LAST
+    # transient error rather than sleep past the deadline.
+    policy = RetryPolicy(max_attempts=4, backoff_ms=100, jitter=0.0,
+                         deadline_ms=250)
+    clock = _FakeClock()
+    fn = Flaky(99)
+    with pytest.raises(TimeoutError, match="transient #2"):
+        policy.call(fn, retry_on=(TimeoutError,),
+                    sleep_ms=clock.sleep_ms, now_ms=clock.now_ms)
+    assert fn.calls == 2
+    assert clock.sleeps == [100]   # second backoff would overshoot
+
+
+def test_deadline_counts_time_spent_inside_the_call():
+    # The budget is wall-clock across ATTEMPTS, not just sleeps: a
+    # slow-failing endpoint (300 ms per attempt) burns the budget even
+    # though the first backoff alone would fit.
+    clock = _FakeClock()
+
+    def slow_fail():
+        clock.now += 300
+        raise TimeoutError("slow")
+
+    policy = RetryPolicy(max_attempts=5, backoff_ms=10, jitter=0.0,
+                         deadline_ms=320)
+    with pytest.raises(TimeoutError):
+        policy.call(slow_fail, retry_on=(TimeoutError,),
+                    sleep_ms=clock.sleep_ms, now_ms=clock.now_ms)
+    # attempt 0 costs 300, backoff 10 fits (310 <= 320); attempt 1
+    # brings elapsed to 610 — the next backoff is refused.
+    assert clock.sleeps == [10]
+
+
+def test_zero_deadline_is_unbounded():
+    policy = RetryPolicy(max_attempts=4, backoff_ms=100, jitter=0.0,
+                         deadline_ms=0)
+    clock = _FakeClock()
+    fn = Flaky(3)
+    policy.call(fn, retry_on=(TimeoutError,),
+                sleep_ms=clock.sleep_ms, now_ms=clock.now_ms)
+    assert fn.calls == 4
+    assert clock.sleeps == [100, 200, 400]
