@@ -610,6 +610,7 @@ def run_forecast_sweep_bench(num_clusters: int = 4,
     from cruise_control_tpu.forecast import fit_topic_forecasts
     from cruise_control_tpu.model.spec import flatten_spec
     from cruise_control_tpu.whatif import TrajectoryScale, WhatIfEngine
+    from cruise_control_tpu.workload import diurnal_growth_series
     goals = goals_by_name(goal_names or GOALS)
     spec = build_spec(num_brokers=num_brokers,
                       num_partitions=num_partitions)
@@ -618,22 +619,13 @@ def run_forecast_sweep_bench(num_clusters: int = 4,
     # --- fit stage: 1-minute windows, 24-window (diurnal) seasonality.
     # Each live topic gets a deterministic level + growth + diurnal
     # trace with mild noise — the acceptance-criteria trace shapes at
-    # fleet topic count.
+    # fleet topic count, generated through the workload pattern package
+    # (seed 13, byte-identical to the builder this bench used to inline;
+    # tests/test_workload.py pins that equivalence).
     window_ms = 60_000
     W, K = history_windows, 24
     topics = sorted(md.topic_index)
-    rng = np.random.default_rng(13)
-    x = np.arange(W, dtype=float)
-    series = {}
-    for i, t in enumerate(topics):
-        level = 200.0 + 10.0 * (i % 17)
-        slope = 0.05 * (i % 5) * level / W
-        amp = 0.2 * level
-        y = (level + slope * x + amp * np.sin(2 * np.pi * x / K)
-             + rng.normal(0.0, 0.01 * level, W))
-        vals = np.stack([0.01 * y, y, 0.5 * y,
-                         5.0 * level + slope * x])   # cpu/nwIn/nwOut/disk
-        series[t] = (vals, np.ones(W, bool))
+    series = diurnal_growth_series(topics, W, day_windows=K, seed=13)
     t0 = time.monotonic()
     fits = fit_topic_forecasts(series, window_ms,
                                seasonal_period_ms=K * window_ms,
@@ -725,6 +717,169 @@ def run_forecast_sweep_bench(num_clusters: int = 4,
             "scenarios": S, "clusters": num_clusters,
             "cold_s": cold_s, "warm_s": warm_s, "seq_s": seq_s,
             "speedup": speedup, "recompiles": recompiles,
+            "devices": len(jax.devices())}
+
+
+def run_workload_regime_bench(num_brokers: int = NUM_BROKERS,
+                              num_partitions: int = NUM_PARTITIONS, *,
+                              goal_names: list | None = None,
+                              history_windows: int = 192,
+                              tune_trials: int = 0, tune_rungs: int = 2,
+                              seed: int = 3,
+                              store_path: str | None = None,
+                              emit_row: bool = True,
+                              gate: bool = True) -> dict:
+    """Trace-driven workload plane (ISSUE 20), two stages:
+
+    1. **pattern-class forecast gates** (pure host): one seeded trace
+       over EVERY registered pattern class (``workload/patterns.py`` —
+       steady, diurnal+growth, flash crowd, weekly, step migration,
+       correlated burst, skew drift), fitted through the full degrade
+       ladder (daily + weekly seasonality + residual changepoint
+       truncation); the worst 1-window-holdout MAPE of every class must
+       stay <= ``FORECAST_MAPE_BUDGET``. Emits one
+       ``forecast_mape_<class>`` row per class.
+    2. **regime-aware online tuning** (device): an untuned sequential
+       propose is the quality baseline; then a ``RegimeTuningLoop``
+       drives scripted aggregate series through steady -> flash crowd ->
+       step migration, ensuring a tuned config per ``(bucket, regime)``
+       and flipping the optimizer's ``active_regime``. After one warm-up
+       pass over the phases, a second scripted pass re-optimizes in each
+       regime — the device-runtime ledger must show ZERO compile events
+       (tuned configs join the chain key; shifts swap cached chains).
+       Gate: no phase's tuned quality regresses the untuned baseline by
+       more than ``MULTIOBJ_QUALITY_TOL``. Emits
+       ``proposal_quality_delta`` (worst phase) and
+       ``workload_regime_recompiles``.
+
+    ``tune_trials <= 1`` pins the incumbent schedule per regime with no
+    per-candidate compiles (the tier-1 smoke mode); the bench default
+    can raise it to run the real successive-halving tuner per regime."""
+    import jax
+
+    from cruise_control_tpu.analyzer import (OptimizationOptions,
+                                             SearchConfig,
+                                             TpuGoalOptimizer,
+                                             TunedConfigStore,
+                                             goals_by_name, plan_quality)
+    from cruise_control_tpu.core.runtime_obs import default_collector
+    from cruise_control_tpu.model.spec import flatten_spec
+    from cruise_control_tpu.workload import (SPEC_REGISTRY,
+                                             RegimeDetector,
+                                             RegimeTuningLoop,
+                                             backtest_by_class,
+                                             generate_trace)
+
+    # --- stage 1: per-class MAPE gates on one multi-class trace (two
+    # topics per class, 1-minute windows, 24-window days, 8-day span so
+    # the weekly rung has >= one full cycle of history).
+    window_ms, day_windows = 60_000, 24
+    specs = list(SPEC_REGISTRY.values())
+    wl_topics = [f"wl-{i:03d}" for i in range(2 * len(specs))]
+    t0 = time.monotonic()
+    trace = generate_trace(specs, wl_topics,
+                           num_windows=history_windows,
+                           window_ms=window_ms, seed=13,
+                           day_windows=day_windows)
+    mapes = backtest_by_class(
+        trace, seasonal_period_ms=day_windows * window_ms,
+        week_period_ms=7 * day_windows * window_ms,
+        changepoint_min_shift=6.0)
+    fit_s = time.monotonic() - t0
+    for cls, mape in sorted(mapes.items()):
+        if mape is None or mape > FORECAST_MAPE_BUDGET:
+            raise RuntimeError(
+                f"workload forecast gate: pattern class {cls} worst "
+                f"1-window-holdout MAPE {mape} exceeds "
+                f"{FORECAST_MAPE_BUDGET}")
+    log(f"workload classes ({len(wl_topics)} topics x "
+        f"{history_windows} windows, fitted in {fit_s:.2f}s): " +
+        ", ".join(f"{c}={m:.4f}" for c, m in sorted(mapes.items())))
+
+    # --- stage 2: regime loop over scripted aggregate series. Each
+    # series is shaped so RegimeDetector.classify returns the phase's
+    # label (steady tail ~1x, flash crowd spikes 8x then decays, step
+    # holds 2.5x).
+    goals = goals_by_name(goal_names or GOALS)
+    spec = build_spec(num_brokers=num_brokers,
+                      num_partitions=num_partitions)
+    model, md = flatten_spec(spec)
+    opts = OptimizationOptions(seed=seed, skip_hard_goal_check=True)
+    base = SearchConfig()
+
+    flat = np.full(24, 100.0)
+    phases = [
+        ("steady", np.concatenate([flat, np.full(8, 105.0)])),
+        ("flash_crowd", np.concatenate(
+            [flat, [800.0, 700.0, 500.0, 300.0, 200.0, 150.0, 120.0,
+                    105.0]])),
+        ("step_migration", np.concatenate([flat, np.full(8, 250.0)])),
+    ]
+
+    untuned = TpuGoalOptimizer(goals=goals, config=base)
+    untuned.optimize(model, md, opts)                  # compile + warm
+    untuned_q = plan_quality(untuned.optimize(model, md, opts))
+
+    store = TunedConfigStore(store_path)
+    opt = TpuGoalOptimizer(goals=goals, config=base, tuned_store=store)
+    loop = RegimeTuningLoop(opt, store, RegimeDetector(min_dwell=1),
+                            trials=tune_trials, rungs=tune_rungs,
+                            seed=seed, goals=goals, options=opts)
+    # Warm-up pass: tune (or pin) each regime's config and compile its
+    # chain once.
+    for name, series in phases:
+        event = loop.on_series(series, model, md)
+        if loop.detector.regime != name:
+            raise RuntimeError(
+                f"workload regime script error: series for {name} "
+                f"classified as {loop.detector.regime}")
+        if event is not None and event["regime"] != name:
+            raise RuntimeError(
+                f"workload regime event mismatch: {event}")
+        opt.optimize(model, md, opts)
+
+    # Scripted pass: same shift sequence warm — zero compile events.
+    collector = default_collector()
+    before = collector.snapshot()
+    qualities, regime_s = {}, float("inf")
+    for name, series in phases:
+        loop.on_series(series, model, md)
+        t0 = time.monotonic()
+        res = opt.optimize(model, md, opts)
+        regime_s = min(regime_s, time.monotonic() - t0)
+        qualities[name] = plan_quality(res)
+    after = collector.snapshot()
+    recompiles = (after["compileEvents"] + after["aotCompileEvents"]
+                  - before["compileEvents"] - before["aotCompileEvents"])
+    if recompiles:
+        raise RuntimeError(
+            f"workload regime recompile gate: {recompiles} compile "
+            f"events across the warm steady -> flash_crowd -> "
+            f"step_migration pass (expected 0: tuned configs join the "
+            "chain key, shifts must swap cached chains)")
+    quality_delta = max(q - untuned_q for q in qualities.values())
+    if quality_delta > MULTIOBJ_QUALITY_TOL:
+        worst = max(qualities, key=qualities.get)
+        raise RuntimeError(
+            f"workload regime quality gate: {worst} tuned objective "
+            f"{qualities[worst]:.4f} worse than untuned {untuned_q:.4f} "
+            f"by {quality_delta:.4f} (> {MULTIOBJ_QUALITY_TOL})")
+    log(f"workload regime loop ({num_brokers}x{num_partitions}, "
+        f"{len(goals)} goals, trials={tune_trials}, "
+        f"{len(jax.devices())} devices): {len(loop.detector.shifts)} "
+        f"shifts, {loop.retunes} retunes, warm propose {regime_s:.3f}s, "
+        f"quality delta {quality_delta:+.4f}, 0 warm recompiles")
+    if emit_row:
+        for cls, mape in sorted(mapes.items()):
+            emit(f"forecast_mape_{cls}", round(mape, 6), "mape", None)
+        emit("proposal_quality_delta", round(quality_delta, 6),
+             "normalized-objective", None)
+        emit("workload_regime_recompiles", recompiles, "count", None)
+    return {"mapes": mapes, "fit_s": fit_s, "topics": len(wl_topics),
+            "untuned_quality": untuned_q, "qualities": qualities,
+            "quality_delta": quality_delta, "recompiles": recompiles,
+            "shifts": len(loop.detector.shifts),
+            "retunes": loop.retunes, "regime_s": regime_s,
             "devices": len(jax.devices())}
 
 
@@ -2849,7 +3004,8 @@ _RESOLVED_PLATFORM: str | None = None
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--scenario", type=int, default=2,
-                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13),
+                    choices=(1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
+                             14),
                     help="BASELINE.md scenario (1 = 3-broker demo, "
                          "2 = 100x20K vs greedy, "
                          "3 = 1Kx200K, 4 = 10Kx1M, 5 = replan p99, "
@@ -2866,7 +3022,10 @@ def main():
                          "12 = flight-recorder journal overhead on the "
                          "warm propose path, enabled vs disabled, "
                          "13 = fleet move-budget coordinator, budgeted "
-                         "vs unbudgeted convergence)")
+                         "vs unbudgeted convergence, "
+                         "14 = trace-driven workload plane, per-class "
+                         "forecast MAPE gates + regime-aware online "
+                         "tuning with zero warm recompiles)")
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the optimizer over an N-device mesh "
                          "(clamped to available devices; 0 = unsharded, "
@@ -2957,6 +3116,12 @@ def main():
                     "allocation is host-side arithmetic (no device "
                     "work to shard)")
             run_move_budget_bench()
+        elif args.scenario == 14:
+            if args.mesh:
+                log("--mesh is ignored for scenario 14: the regime loop "
+                    "drives the sequential single-cluster chain (no "
+                    "data parallelism to shard)")
+            run_workload_regime_bench(tune_trials=4)
         else:
             run_scale_scenario(args.scenario, mesh_devices=args.mesh,
                                variant=args.variant)

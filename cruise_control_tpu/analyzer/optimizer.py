@@ -258,6 +258,13 @@ class TpuGoalOptimizer:
         #: warm process serves tuned schedules with zero recompiles
         #: within a bucket (one tuned config per bucket = one chain key).
         self.tuned_store = tuned_store
+        #: the active traffic regime (workload/regime.py vocabulary),
+        #: flipped by the continuous tuning loop on regime shifts. A
+        #: regime qualifies the tuned-store lookup — ``(bucket, regime)``
+        #: entries win over plain buckets — and therefore the chain /
+        #: dispatch-group key, so a shift between already-warm regimes
+        #: swaps WHICH cached chain runs without compiling a new one.
+        self.active_regime: str | None = None
         #: multi-objective population search over K candidate plans
         #: (``search.population`` server config; parallel/population.py):
         #: every member runs the full chain under its own PRNG stream in
@@ -416,7 +423,8 @@ class TpuGoalOptimizer:
         base_cfg = self.config
         if self.tuned_store is not None:
             base_cfg = self.tuned_store.apply(
-                base_cfg, metadata.num_partitions, metadata.num_brokers)
+                base_cfg, metadata.num_partitions, metadata.num_brokers,
+                regime=self.active_regime)
         cfg = base_cfg.scaled_for(metadata.num_partitions,
                                   metadata.num_brokers)
         if options.fast_mode:

@@ -93,12 +93,18 @@ def plan_quality(result, hard_weight: float = 1000.0) -> float:
         stacks, scales, hard, hard_weight=hard_weight))[0])
 
 
-def shape_bucket(num_partitions: int, num_brokers: int) -> str:
+def shape_bucket(num_partitions: int, num_brokers: int,
+                 regime: str | None = None) -> str:
     """Power-of-two shape bucket key, e.g. ``b128p32768`` — the
     granularity tuned configs persist at (shared with the population
-    K-bucket rule via ``parallel.batching.pow2_bucket``)."""
+    K-bucket rule via ``parallel.batching.pow2_bucket``). A traffic
+    ``regime`` (workload/regime.py's vocabulary) qualifies the key —
+    ``b128p32768@flash_crowd`` — so the continuous tuning loop persists
+    one schedule per (shape, regime) pair; lookups fall back to the
+    un-regimed bucket when the pair is untuned."""
     from ..parallel.batching import pow2_bucket
-    return f"b{pow2_bucket(num_brokers)}p{pow2_bucket(num_partitions)}"
+    base = f"b{pow2_bucket(num_brokers)}p{pow2_bucket(num_partitions)}"
+    return f"{base}@{regime}" if regime else base
 
 
 class TunedConfigStore:
@@ -163,15 +169,23 @@ class TunedConfigStore:
                         "%s", self.path, exc)
             return None
 
-    def lookup(self, num_partitions: int, num_brokers: int) -> dict | None:
+    def lookup(self, num_partitions: int, num_brokers: int, *,
+               regime: str | None = None,
+               fallback: bool = True) -> dict | None:
         """Tuned field overrides for this shape's bucket, or None.
-        Values are validated, not just keys: a corrupted or hand-edited
-        store (string/negative/bool values) must DEGRADE to the base
-        config with a warning — the class contract — not crash the
-        first optimize at trace time."""
-        bucket = shape_bucket(num_partitions, num_brokers)
+        With a ``regime``, the regime-qualified entry wins; an untuned
+        pair falls back to the un-regimed bucket (``fallback=False``
+        disables that — the tuning loop's "has this pair been tuned"
+        probe). Values are validated, not just keys: a corrupted or
+        hand-edited store (string/negative/bool values) must DEGRADE to
+        the base config with a warning — the class contract — not crash
+        the first optimize at trace time."""
+        bucket = shape_bucket(num_partitions, num_brokers, regime=regime)
         with self._lock:
             entry = self._buckets.get(bucket)
+            if entry is None and regime and fallback:
+                bucket = shape_bucket(num_partitions, num_brokers)
+                entry = self._buckets.get(bucket)
         if not entry or not isinstance(entry.get("fields"), dict):
             return None
         fields, bad = {}, []
@@ -190,33 +204,36 @@ class TunedConfigStore:
         return fields
 
     def apply(self, cfg: SearchConfig, num_partitions: int,
-              num_brokers: int) -> SearchConfig:
+              num_brokers: int, *,
+              regime: str | None = None) -> SearchConfig:
         """``cfg`` with this bucket's tuned overrides folded in (identity
-        when the bucket is untuned). Callers apply this BEFORE
+        when the bucket is untuned; with a ``regime``, the qualified
+        entry wins over the plain bucket). Callers apply this BEFORE
         ``scaled_for`` so the tiny-model clamp still bounds whatever the
         tuner picked."""
-        fields = self.lookup(num_partitions, num_brokers)
+        fields = self.lookup(num_partitions, num_brokers, regime=regime)
         if not fields:
             return cfg
         return replace(cfg, **fields)
 
     def record(self, num_partitions: int, num_brokers: int,
                fields: dict, history: list | None = None,
-               save: bool = True) -> str:
+               save: bool = True, regime: str | None = None) -> str:
         """Store tuned ``fields`` (a TUNABLE_FIELDS subset) for the
-        shape's bucket, with the tuner's trial history; returns the
-        bucket key."""
+        shape's bucket — regime-qualified when ``regime`` is given —
+        with the tuner's trial history; returns the bucket key."""
         unknown = set(fields) - set(TUNABLE_FIELDS)
         if unknown:
             raise ValueError(f"not tunable SearchConfig fields: "
                              f"{sorted(unknown)}")
-        bucket = shape_bucket(num_partitions, num_brokers)
+        bucket = shape_bucket(num_partitions, num_brokers, regime=regime)
         with self._lock:
             self._buckets[bucket] = {
                 "fields": dict(fields),
                 "tunedAtMs": int(time.time() * 1000),
                 "shapes": {"numPartitions": num_partitions,
                            "numBrokers": num_brokers},
+                "regime": regime,
                 "history": list(history or []),
             }
         if save:
@@ -383,11 +400,13 @@ def make_optimizer_evaluator(model, metadata, *, base: SearchConfig
 def autotune(model, metadata, *, base: SearchConfig | None = None,
              store: TunedConfigStore | None = None, trials: int = 8,
              rungs: int = 2, seed: int = 0, goals=None, constraint=None,
-             options=None, save: bool = True):
+             options=None, save: bool = True,
+             regime: str | None = None):
     """End-to-end tuning for one bench scenario: successive-halving over
     the schedule space, winner recorded into the store under the
-    scenario's shape bucket. Returns ``(fields, history, bucket)`` —
-    ``fields`` empty when the base schedule won."""
+    scenario's shape bucket (regime-qualified when the continuous loop
+    passes the active ``regime``). Returns ``(fields, history,
+    bucket)`` — ``fields`` empty when the base schedule won."""
     base = base or SearchConfig()
     tuner = SuccessiveHalvingTuner(
         evaluate=make_optimizer_evaluator(model, metadata, base=base,
@@ -396,9 +415,10 @@ def autotune(model, metadata, *, base: SearchConfig | None = None,
                                           options=options),
         trials=trials, rungs=rungs, seed=seed)
     fields, history = tuner.tune()
-    bucket = shape_bucket(metadata.num_partitions, metadata.num_brokers)
+    bucket = shape_bucket(metadata.num_partitions, metadata.num_brokers,
+                          regime=regime)
     if store is not None:
         bucket = store.record(metadata.num_partitions,
                               metadata.num_brokers, fields,
-                              history=history, save=save)
+                              history=history, save=save, regime=regime)
     return fields, history, bucket

@@ -97,7 +97,8 @@ class _FleetMember:
     feed, registered into the shared registry."""
 
     def __init__(self, member_id: str, sim, engine: ChaosEngine, *,
-                 step_ms: int, call_deadline_ms: int) -> None:
+                 step_ms: int, call_deadline_ms: int,
+                 sampler=None) -> None:
         self.id = member_id
         self.sim = sim
         self.endpoint = ChaosEndpoint(sim, engine, member_id,
@@ -111,8 +112,19 @@ class _FleetMember:
             min_samples_per_window=1,
             num_broker_windows=4, broker_window_ms=2 * step_ms,
             serve_stale_on_incomplete=False))
+        # ``sampler`` swaps the inner metric source per member (e.g. a
+        # trace-replaying workload.TraceSampler for burst-clocked
+        # soaks); a callable without get_samples is a factory receiving
+        # the member's chaos endpoint (members' sims are built
+        # internally, so the caller cannot pre-bind one). The
+        # ChaosSampler wrap stays, so injected endpoint / metrics
+        # faults still apply to replayed traffic.
+        if sampler is not None and callable(sampler) \
+                and not hasattr(sampler, "get_samples"):
+            sampler = sampler(self.endpoint)
         self.sampler = ChaosSampler(
-            SyntheticWorkloadSampler(self.endpoint), engine)
+            sampler if sampler is not None
+            else SyntheticWorkloadSampler(self.endpoint), engine)
         self.fetcher = MetricFetcherManager(self.sampler, max_retries=1)
         self.runner = LoadMonitorTaskRunner(
             self.monitor, self.fetcher, sampling_interval_ms=step_ms)
@@ -139,7 +151,12 @@ class ChaosFleetHarness:
                  breaker_window_steps: int = 8,
                  call_deadline_ms: int = 0,
                  budget_per_tick: int = 0,
-                 budget_carry_max_ticks: int = 2) -> None:
+                 budget_carry_max_ticks: int = 2,
+                 samplers: dict | None = None) -> None:
+        """``samplers`` maps member id -> inner MetricSampler override,
+        either an instance or a factory ``(endpoint) -> sampler``
+        (members absent from the map keep the synthetic live-state
+        sampler) — the trace-replay hook burst-clocked fleet soaks use."""
         member_ids = list(member_ids)
         if not member_ids:
             raise ValueError("a fleet needs at least one member")
@@ -175,7 +192,8 @@ class ChaosFleetHarness:
         for mid in member_ids:
             m = _FleetMember(mid, sims[mid], self.engine,
                              step_ms=step_ms,
-                             call_deadline_ms=call_deadline_ms)
+                             call_deadline_ms=call_deadline_ms,
+                             sampler=(samplers or {}).get(mid))
             m.handle = self.registry.register(
                 mid, m.monitor, endpoint=f"chaos://{mid}")
             m.runner.start(self.engine.now_ms(), skip_loading=True)

@@ -87,14 +87,20 @@ class ChaosHarness:
                  snapshot_max_age_ms: int = 0,
                  ha_identity: str | None = None,
                  ha_lease_steps: int = 5,
-                 ha_promotable: bool = True) -> None:
+                 ha_promotable: bool = True,
+                 sampler=None) -> None:
         """``engine``/``admin`` overrides support restart-from-snapshot
         (the replacement stack keeps the crashed stack's clock + fault
         schedule) and the two-process HA harness (per-process admin
         wrappers over one shared engine). ``snapshot_path`` wires a
         SnapshotManager (written every ``snapshot_interval_steps`` by
         ha_tick inside :meth:`step`); ``ha_identity`` wires a
-        LeaderElector on the simulated clock and fences the executor."""
+        LeaderElector on the simulated clock and fences the executor.
+        ``sampler`` swaps the inner MetricSampler (default: the
+        synthetic live-state sampler) — e.g. a trace-replaying
+        ``workload.TraceSampler`` for burst-clocked soaks; the harness
+        still wraps it in :class:`ChaosSampler` so injected
+        metrics-endpoint faults apply."""
         self.sim = sim or build_sim()
         self.engine = engine or ChaosEngine(self.sim, seed=seed,
                                             step_ms=step_ms)
@@ -110,8 +116,9 @@ class ChaosHarness:
             num_broker_windows=4, broker_window_ms=2 * step_ms,
             serve_stale_on_incomplete=serve_stale_on_incomplete),
             admin_retry=admin_retry, sleep_ms=self.engine.sleep_ms)
-        self.sampler = ChaosSampler(SyntheticWorkloadSampler(admin),
-                                    self.engine)
+        self.sampler = ChaosSampler(
+            sampler if sampler is not None
+            else SyntheticWorkloadSampler(admin), self.engine)
         self.fetcher = MetricFetcherManager(self.sampler,
                                             max_retries=fetch_max_retries)
         self.runner = LoadMonitorTaskRunner(
@@ -182,7 +189,7 @@ class ChaosHarness:
             snapshot_interval_steps=snapshot_interval_steps,
             snapshot_max_age_ms=snapshot_max_age_ms,
             ha_identity=ha_identity, ha_lease_steps=ha_lease_steps,
-            ha_promotable=ha_promotable)
+            ha_promotable=ha_promotable, sampler=sampler)
 
     # -------------------------------------------------------------- loop
     def step(self, *, detect: bool = True) -> None:

@@ -976,6 +976,67 @@ def _detector_defs(d: ConfigDef) -> None:
                  ".jax_cache/forecast/v<N>/forecasts.json, next to the "
                  "tuned-config store) so restarts serve projections "
                  "without refitting cold.")
+    d.define("forecast.weekly.period.ms", ConfigType.LONG, 0,
+             validator=Range.at_least(0), importance=Importance.LOW,
+             doc="Weekly-seasonality period (normally 604800000 = 7 "
+                 "days): arms the day-of-week residual rung of the "
+                 "degrade ladder when the period covers >= 14 windows "
+                 "of history. 0 (default) disables — the fit is then "
+                 "bit-identical to the pre-weekly model "
+                 "(docs/workloads.md).")
+    d.define("forecast.changepoint.min.shift", ConfigType.DOUBLE, 0.0,
+             validator=Range.at_least(0.0), importance=Importance.LOW,
+             doc="Residual-changepoint threshold in robust-sigma units "
+                 "(CUSUM split of the post-fit residual): a persistent "
+                 "level shift at least this many sigmas (and >= 5% of "
+                 "the median level) truncates the fit history to the "
+                 "post-shift suffix, so step migrations stop dragging "
+                 "the trend. 0 (default) disables truncation; 6.0 is "
+                 "the bench-validated setting (docs/workloads.md).")
+    d.define("workload.trace.seed", ConfigType.LONG, 13,
+             importance=Importance.LOW,
+             doc="Seed of the deterministic trace-driven workload "
+                 "generator (workload/generator.py): every consumer "
+                 "(bench scenario 14, chaos soaks, forecast backtests) "
+                 "derives byte-identical traces from it "
+                 "(docs/workloads.md §Determinism).")
+    d.define("workload.trace.windows", ConfigType.INT, 192,
+             validator=Range.at_least(2), importance=Importance.LOW,
+             doc="Windows per generated workload trace (default 192 = "
+                 "8 days of 24-window days: enough history to arm the "
+                 "weekly forecast rung).")
+    d.define("workload.day.windows", ConfigType.INT, 24,
+             validator=Range.at_least(2), importance=Importance.LOW,
+             doc="Windows per synthetic day in generated traces — the "
+                 "diurnal period every pattern class shapes its cycle "
+                 "around (workload/patterns.py).")
+    d.define("tuning.regime.enabled", ConfigType.BOOLEAN, False,
+             importance=Importance.LOW,
+             doc="Continuous regime-aware tuning (workload/regime.py): "
+                 "a scheduled detector classifies the traffic regime "
+                 "(steady / flash_crowd / step_migration) from the "
+                 "aggregated window series and re-resolves the tuned "
+                 "schedule per (shape bucket, regime) on shift. Tuned "
+                 "configs join the compiled-chain key, so shifts "
+                 "between warm regimes never recompile "
+                 "(docs/workloads.md §Regime loop).")
+    d.define("tuning.regime.burst.ratio", ConfigType.DOUBLE, 2.0,
+             validator=Range.at_least(1.0), importance=Importance.LOW,
+             doc="A recent window must exceed this multiple of the "
+                 "median baseline before the regime detector considers "
+                 "anything but steady")
+    d.define("tuning.regime.persist.frac", ConfigType.DOUBLE, 0.6,
+             validator=Range.between(0.0, 1.0),
+             importance=Importance.LOW,
+             doc="Latest windows holding >= this fraction of the "
+                 "recent peak classify as step_migration (the "
+                 "elevation persists); below it, flash_crowd (it is "
+                 "decaying)")
+    d.define("tuning.regime.min.dwell", ConfigType.INT, 1,
+             validator=Range.at_least(1), importance=Importance.LOW,
+             doc="Consecutive detector rounds agreeing on a new regime "
+                 "before the switch commits — hysteresis so a noisy "
+                 "boundary cannot thrash the tuner")
     d.define("provision.partition.count.enabled", ConfigType.BOOLEAN, True,
              importance=Importance.LOW,
              doc="Let the capacity-forecast detector propose partition-"
@@ -1561,10 +1622,23 @@ class CruiseControlConfig(AbstractConfig):
             min_history_windows=self.get_int(
                 "forecast.min.history.windows"),
             seasonal_period_ms=self.get_int("forecast.seasonal.period.ms"),
+            week_period_ms=self.get_int("forecast.weekly.period.ms"),
+            changepoint_min_shift=self.get_double(
+                "forecast.changepoint.min.shift"),
             partition_count_enabled=self.get_boolean(
                 "provision.partition.count.enabled"),
             partition_count_max_skew=self.get_double(
                 "provision.partition.count.max.skew"))
+
+    def regime_detector(self):
+        """``tuning.regime.*`` view: a configured
+        ``workload.RegimeDetector`` (the serving-path regime loop's
+        classifier; ``tuning.regime.enabled`` gates the wiring)."""
+        from ..workload import RegimeDetector
+        return RegimeDetector(
+            burst_ratio=self.get_double("tuning.regime.burst.ratio"),
+            persist_frac=self.get_double("tuning.regime.persist.frac"),
+            min_dwell=self.get_int("tuning.regime.min.dwell"))
 
     def executor_config(self) -> ExecutorConfig:
         throttle = self.get_int("default.replication.throttle")

@@ -220,7 +220,8 @@ class FleetOptimizer:
         base_cfg = opt.config
         if opt.tuned_store is not None:
             base_cfg = opt.tuned_store.apply(
-                base_cfg, md.num_partitions, md.num_brokers)
+                base_cfg, md.num_partitions, md.num_brokers,
+                regime=opt.active_regime)
         cfg = base_cfg.scaled_for(md.num_partitions, md.num_brokers)
         if opts.fast_mode:
             cfg = replace(

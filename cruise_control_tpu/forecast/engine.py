@@ -51,6 +51,12 @@ class ForecastConfig:
     interval_ms: int = 1_800_000
     min_history_windows: int = 3
     seasonal_period_ms: int = 86_400_000
+    #: weekly rung period (forecast.weekly.period.ms); 0 disables. Arms
+    #: day-of-week residual buckets when it covers >= 14 windows.
+    week_period_ms: int = 0
+    #: residual changepoint threshold in robust-sigma units
+    #: (forecast.changepoint.min.shift); 0 disables truncation.
+    changepoint_min_shift: float = 0.0
     partition_count_enabled: bool = True
     #: a topic whose per-partition load skew (max/mean) exceeds this is
     #: NOT given a partition-count recommendation: with a skewed key
@@ -275,7 +281,9 @@ class ForecastEngine:
                 series, window_ms,
                 seasonal_period_ms=self.config.seasonal_period_ms,
                 min_history_windows=self.config.min_history_windows,
-                fitted_at_ms=now, generation=generation)
+                fitted_at_ms=now, generation=generation,
+                week_period_ms=self.config.week_period_ms,
+                changepoint_min_shift=self.config.changepoint_min_shift)
             self.last_fit = fits
             self.num_fits += 1
             self._refresh_meter.mark()

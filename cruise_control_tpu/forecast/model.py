@@ -14,11 +14,26 @@ of the four resource metrics, the window series ``y_w`` decomposes as
 
 fitted deterministically — ordinary least squares for level/trend,
 phase-bucket residual means for the seasonal component (K = seasonal
-period / window width), sample std for sigma. Seasonality is only fitted
-when the history covers at least one full period; shorter histories
-degrade to level+trend (and histories under ``min_history_windows``
-degrade to a flat persistence forecast) — the degrade ladder is explicit
-state on the fit, never a silent zero.
+period / window width), sample std for sigma. Two opt-in rungs extend
+the ladder (ROADMAP item 5's richer forecast forms):
+
+- **weekly seasonality** (``week_windows`` = windows per week): seven
+  day-of-week residual buckets fitted on top of the daily component,
+  only when the history covers >= one full week (shorter histories
+  degrade to ``no-weekly``);
+- **changepoint detection** (``changepoint_min_shift`` > 0): a robust
+  CUSUM split on the fit residual — when the pre/post split means
+  differ by >= ``min_shift`` x the median residual diff, the fit
+  TRUNCATES to the post-changepoint suffix (original window
+  coordinates kept) and refits, so a step migration or a passed flash
+  crowd stops polluting the level. Up to three truncation rounds, so a
+  burst (two shifts: up then down) resolves to the clean tail.
+
+Seasonality is only fitted when the history covers at least one full
+period; shorter histories degrade to level+trend (and histories under
+``min_history_windows`` degrade to a flat persistence forecast) — the
+degrade ladder (none -> no-weekly -> no-seasonal -> persistence) is
+explicit state on the fit, never a silent zero.
 
 Everything here is host-side numpy and seeded by nothing: the same
 window history always fits the same model (the backtest property tests
@@ -44,7 +59,7 @@ LOG = logging.getLogger(__name__)
 #: bumps it and retires stale files predictably (the TunedConfigStore /
 #: ``.jax_cache/v<N>`` discipline — forecasts persist NEXT to the tuned
 #: configs, see :meth:`ForecastStore.default_path`).
-FORECAST_STORE_VERSION = 1
+FORECAST_STORE_VERSION = 2
 
 #: floor for relative errors / scale factors so an idle topic (level 0)
 #: never divides by zero or explodes a factor.
@@ -92,8 +107,19 @@ class TopicForecast:
     #: included — the display-side "load right now"
     current: np.ndarray = field(default=None)
     degraded: str = "none"
+    #: day-of-week residual buckets ``[4, 7]`` (empty when the weekly
+    #: rung was not requested or not fittable)
+    week_seasonal: np.ndarray = field(default=None)
+    #: windows per week the weekly buckets were fitted at (0 = no
+    #: weekly component; bucket of window x = ``(x % Kw) * 7 // Kw``)
+    week_windows: int = 0
+    #: original window index the fit was truncated at by changepoint
+    #: detection (None = no changepoint found / detection off)
+    changepoint_window: int | None = None
 
     def __post_init__(self):
+        if self.week_seasonal is None:
+            self.week_seasonal = np.zeros((4, 0))
         if self.current is None:
             self.current = self.predict(0.0, 0.5)
         if self.basis is None:
@@ -114,6 +140,10 @@ class TopicForecast:
         if K:
             phase = int(round(x)) % K
             y = y + self.seasonal[:, phase]
+        Kw = self.week_windows
+        if Kw >= 2 and self.week_seasonal.size:
+            wphase = (int(round(x)) % Kw) * 7 // Kw
+            y = y + self.week_seasonal[:, wphase]
         z = quantile_z(quantile)
         return np.maximum(y + z * self.sigma, 0.0)
 
@@ -154,6 +184,10 @@ class TopicForecast:
             "backtestMape": (None if self.backtest_mape is None
                              else round(float(self.backtest_mape), 6)),
             "degraded": self.degraded,
+            "weekSeasonal": [[round(float(v), 6) for v in row]
+                             for row in self.week_seasonal],
+            "weekWindows": self.week_windows,
+            "changepointWindow": self.changepoint_window,
         }
 
     @classmethod
@@ -161,7 +195,14 @@ class TopicForecast:
         seasonal = np.asarray(obj.get("seasonal", []), float)
         if seasonal.ndim != 2:
             seasonal = np.zeros((4, 0))
+        week = np.asarray(obj.get("weekSeasonal", []), float)
+        if week.ndim != 2:
+            week = np.zeros((4, 0))
+        cp = obj.get("changepointWindow")
         return cls(
+            week_seasonal=week,
+            week_windows=int(obj.get("weekWindows", 0)),
+            changepoint_window=None if cp is None else int(cp),
             topic=str(obj["topic"]), window_ms=int(obj["windowMs"]),
             num_windows=int(obj["numWindows"]),
             level=np.asarray(obj["level"], float),
@@ -271,14 +312,91 @@ def _decompose(x: np.ndarray, y: np.ndarray, K: int
     return level, trend, seasonal, resid
 
 
+def _fit_components(x: np.ndarray, y: np.ndarray, K: int, Kw: int
+                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                               np.ndarray, np.ndarray]:
+    """:func:`_decompose` plus the weekly rung: with ``Kw`` (windows per
+    week) >= 2, seven day-of-week residual buckets are backfitted on top
+    of the daily component, alternating until the buckets settle (up to
+    8 rounds — with unbalanced bucket occupancy, e.g. history ending
+    mid-week, fewer rounds leave a biased trend whose residual ramp
+    false-trips the changepoint rung). ``Kw = 0`` is EXACTLY the
+    pre-weekly fit (the back-compat anchor the ladder tests pin).
+    Returns (level[R], trend[R], seasonal[R, K], week[R, 7],
+    residual[R, N])."""
+    R = y.shape[0]
+    week = np.zeros((R, 7))
+    if Kw < 2:
+        level, trend, seasonal, resid = _decompose(x, y, K)
+        return level, trend, seasonal, week, resid
+    wph = (x.astype(int) % Kw) * 7 // Kw
+    phases = x.astype(int) % K if K >= 2 else None
+    r_full = None
+    for _ in range(8):
+        prev = week.copy()
+        adjusted = y - week[:, wph]
+        level, trend, seasonal, _resid = _decompose(x, adjusted, K)
+        base = level[:, None] + trend[:, None] * x[None, :]
+        if K >= 2:
+            base = base + seasonal[:, phases]
+        r_full = y - base
+        for d in range(7):
+            sel = wph == d
+            if sel.any():
+                week[:, d] = r_full[:, sel].mean(axis=1)
+        week -= week.mean(axis=1, keepdims=True)
+        if np.abs(week - prev).max() <= 1e-9 * (1.0 + np.abs(level).max()):
+            break
+    resid = r_full - week[:, wph]
+    return level, trend, seasonal, week, resid
+
+
+def _changepoint_split(resid: np.ndarray, y: np.ndarray,
+                       min_shift: float, min_tail: int) -> int | None:
+    """Best CUSUM split of the fit residual: the index ``j`` (at least
+    ``min_tail`` from either edge) maximizing the pre/post mean
+    difference, normalized per resource by the median absolute
+    window-to-window diff (a robust noise scale a genuine level shift
+    barely moves). A candidate shift must ALSO move at least 5% of the
+    resource's median level — a near-perfect fit of a smooth series has
+    a tiny diff scale, and without the relative floor residual wiggles
+    from an imperfect seasonal backfit read as many-sigma shifts.
+    Returns ``j`` when the best eligible shift reaches ``min_shift``,
+    else None. Periodic structure the ladder already fitted never trips
+    this — it tests the RESIDUAL."""
+    _R, n = resid.shape
+    if n < 2 * min_tail or min_tail < 1:
+        return None
+    scale = (np.median(np.abs(np.diff(resid, axis=1)), axis=1)
+             + _EPS)                                        # [R]
+    floor = 0.05 * np.median(np.abs(y), axis=1) + _EPS      # [R]
+    csum = np.cumsum(resid, axis=1)
+    total = csum[:, -1:]
+    js = np.arange(min_tail, n - min_tail + 1)
+    pre_mean = csum[:, js - 1] / js
+    post_mean = (total - csum[:, js - 1]) / (n - js)
+    shift = np.abs(post_mean - pre_mean)                    # [R, |js|]
+    ratio = np.where(shift >= floor[:, None],
+                     shift / scale[:, None], 0.0)
+    best = ratio.max(axis=0)
+    k = int(np.argmax(best))
+    if best[k] >= min_shift:
+        return int(js[k])
+    return None
+
+
 def fit_series(topic: str, values: np.ndarray, valid: np.ndarray,
                window_ms: int, *, season_windows: int = 0,
-               min_history_windows: int = 3) -> TopicForecast:
+               week_windows: int = 0, min_history_windows: int = 3,
+               changepoint_min_shift: float = 0.0) -> TopicForecast:
     """Fit one topic from its ``[4, W]`` window series.
 
     ``valid[W]`` marks windows with real samples — invalid columns are
     excluded from every regression (they are zero-filled in the cube and
-    would silently drag the level down). Deterministic; see the module
+    would silently drag the level down). ``week_windows`` (windows per
+    week, >= 14 to arm) and ``changepoint_min_shift`` (> 0 to arm) are
+    the opt-in ladder rungs — both default OFF, reproducing the
+    pre-extension fit bit for bit. Deterministic; see the module
     docstring for the model form and degrade ladder."""
     values = np.asarray(values, float)
     valid = np.asarray(valid, bool)
@@ -308,24 +426,60 @@ def fit_series(topic: str, values: np.ndarray, valid: np.ndarray,
             sigma=np.zeros(4), last_phase=0, backtest_mape=None,
             basis=basis, degraded="persistence")
 
-    K = int(season_windows)
-    fit_seasonal = K >= 2 and n >= K
-    level, trend, seasonal, resid = _decompose(
-        x, y, K if fit_seasonal else 0)
-    degraded = "none" if fit_seasonal else "no-seasonal"
+    K_req, Kw_req = int(season_windows), int(week_windows)
+
+    def _feasible(m: int) -> tuple[int, int]:
+        K = K_req if (K_req >= 2 and m >= K_req) else 0
+        Kw = Kw_req if (Kw_req >= 14 and m >= Kw_req) else 0
+        return K, Kw
+
+    # Changepoint rung: fit, test the residual for a persistent level
+    # shift, truncate to the post-shift suffix, repeat (<= 3 rounds — a
+    # completed burst needs two cuts: its onset, then its decay edge).
+    cp_window = None
+    if changepoint_min_shift > 0.0:
+        min_tail = max(min_history_windows, 4)
+        for _ in range(3):
+            if len(x) < 2 * min_tail:
+                break
+            K, Kw = _feasible(len(x))
+            _l, _t, _s, _w, resid = _fit_components(x, y, K, Kw)
+            j = _changepoint_split(resid, y, changepoint_min_shift,
+                                   min_tail)
+            if j is None:
+                break
+            cp_window = int(x[j])
+            x, y = x[j:], y[:, j:]
+
+    n = len(x)
+    K, Kw = _feasible(n)
+    fit_seasonal = K >= 2
+    fit_weekly = Kw >= 14
+    level, trend, seasonal, week, resid = _fit_components(x, y, K, Kw)
+    if not fit_seasonal:
+        degraded = "no-seasonal"
+    elif Kw_req >= 14 and not fit_weekly:
+        degraded = "no-weekly"
+    else:
+        degraded = "none"
     sigma = resid.std(axis=1) if n > 1 else np.zeros(4)
 
-    backtest = _backtest_mape(x, y, season_windows=K if degraded == "none"
-                              else 0)
+    backtest = _backtest_mape(x, y,
+                              season_windows=K if fit_seasonal else 0,
+                              week_windows=Kw if fit_weekly else 0)
     return TopicForecast(
         topic=topic, window_ms=window_ms, num_windows=W,
         level=level, trend=trend, seasonal=seasonal, sigma=sigma,
-        last_phase=(int(x[-1]) % K) if K >= 2 and degraded == "none" else 0,
-        backtest_mape=backtest, basis=basis, degraded=degraded)
+        last_phase=(int(x[-1]) % K) if fit_seasonal else 0,
+        backtest_mape=backtest, basis=basis, degraded=degraded,
+        week_seasonal=week if fit_weekly else np.zeros((4, 0)),
+        week_windows=Kw if fit_weekly else 0,
+        changepoint_window=cp_window)
 
 
 def _backtest_mape(x: np.ndarray, y: np.ndarray, *,
-                   season_windows: int) -> float | None:
+                   season_windows: int,
+                   week_windows: int = 0) -> float | None:
     """One-window-holdout backtest: fit on all but the last valid
     window, predict it, report the mean relative error over resources
     with meaningful load. The accuracy number every fit carries (and
@@ -335,10 +489,14 @@ def _backtest_mape(x: np.ndarray, y: np.ndarray, *,
     xf, yf = x[:-1], y[:, :-1]
     K = season_windows if (season_windows >= 2
                            and len(xf) >= season_windows) else 0
-    level, trend, seasonal, _resid = _decompose(xf, yf, K)
+    Kw = week_windows if (week_windows >= 14
+                          and len(xf) >= week_windows) else 0
+    level, trend, seasonal, week, _resid = _fit_components(xf, yf, K, Kw)
     pred = level + trend * x[-1]
     if K >= 2:
         pred = pred + seasonal[:, int(x[-1]) % K]
+    if Kw >= 14:
+        pred = pred + week[:, (int(x[-1]) % Kw) * 7 // Kw]
     actual = y[:, -1]
     live = np.abs(actual) > _EPS
     if not live.any():
@@ -350,18 +508,26 @@ def _backtest_mape(x: np.ndarray, y: np.ndarray, *,
 def fit_topic_forecasts(series: dict[str, tuple[np.ndarray, np.ndarray]],
                         window_ms: int, *, seasonal_period_ms: int,
                         min_history_windows: int, fitted_at_ms: int,
-                        generation: int = 0) -> ForecastSet:
+                        generation: int = 0, week_period_ms: int = 0,
+                        changepoint_min_shift: float = 0.0) -> ForecastSet:
     """Fit every topic in ``series`` (topic -> (values[4, W],
     valid[W])). The seasonal bucket count K = period / window width; a
     period that does not cleanly cover >= 2 windows disables the
-    seasonal component for the whole fit."""
+    seasonal component for the whole fit. ``week_period_ms`` arms the
+    weekly rung the same way (7 day-of-week buckets, needs >= 14
+    covered windows); ``changepoint_min_shift`` > 0 arms residual
+    changepoint truncation (see :func:`fit_series`)."""
     K = int(seasonal_period_ms // window_ms) if window_ms > 0 else 0
     if K < 2:
         K = 0
+    Kw = int(week_period_ms // window_ms) if window_ms > 0 else 0
+    if Kw < 14:
+        Kw = 0
     forecasts = {
         topic: fit_series(topic, values, valid, window_ms,
-                          season_windows=K,
-                          min_history_windows=min_history_windows)
+                          season_windows=K, week_windows=Kw,
+                          min_history_windows=min_history_windows,
+                          changepoint_min_shift=changepoint_min_shift)
         for topic, (values, valid) in sorted(series.items())}
     return ForecastSet(forecasts=forecasts, fitted_at_ms=fitted_at_ms,
                        window_ms=window_ms, generation=generation)

@@ -420,6 +420,26 @@ def build_app(config: CruiseControlConfig, admin=None) -> CruiseControlApp:
                 CapacityForecastDetector(monitor, facade.forecast,
                                          registry=detector.registry),
                 forecast_cfg.interval_ms)
+    # Regime-aware continuous tuning (workload/regime.py;
+    # docs/workloads.md §Regime loop): classify the traffic regime off
+    # the aggregated window series each detector round and re-resolve
+    # the optimizer's tuned schedule per (shape bucket, regime) on
+    # shift. Serving-path default is incumbent-pinning (trials=0 — no
+    # per-candidate compiles); offline runs (bench --scenario 14) fill
+    # the store with genuinely tuned per-regime schedules.
+    if config.get_boolean("tuning.regime.enabled"):
+        from .workload import RegimeShiftDetector, RegimeTuningLoop
+        if optimizer.tuned_store is None:
+            from .analyzer import TunedConfigStore
+            optimizer.tuned_store = TunedConfigStore(
+                config.get_string("search.tuning.store.path") or None)
+        detector.register(
+            RegimeShiftDetector(
+                monitor,
+                RegimeTuningLoop(optimizer, optimizer.tuned_store,
+                                 config.regime_detector()),
+                registry=detector.registry),
+            interval)
     # ref maintenance.event.reader.class (empty = maintenance events
     # disabled, the reference default): the reader drains operator-
     # announced plans with idempotence de-dup; MaintenanceEvent.fix reads

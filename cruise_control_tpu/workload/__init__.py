@@ -1,0 +1,31 @@
+"""Trace-driven workload plane (ROADMAP item 5): pattern-class load
+generation, consumer adapters, and regime-aware online tuning.
+
+See docs/workloads.md for the pattern classes, the trace schema, the
+three consumer adapters (bench / chaos / forecast backtests), and the
+regime -> tuner flow.
+"""
+
+from .adapters import (TraceSampler, backtest_by_class,
+                       schedule_burst_faults)
+from .generator import (TRACE_RESOURCES, TopicTrace, WorkloadTrace,
+                        diurnal_growth_series, generate_trace)
+from .patterns import (DOW_OFFSETS, PATTERN_CLASSES, SPEC_REGISTRY,
+                       CorrelatedBurstSpec, DiurnalGrowthSpec,
+                       FlashCrowdSpec, PatternSpec, SkewDriftSpec,
+                       StepMigrationSpec, WeeklySpec, base_level,
+                       stack_resources)
+from .regime import (REGIMES, RegimeDetector, RegimeShiftDetector,
+                     RegimeTuningLoop, aggregate_series)
+
+__all__ = [
+    "TRACE_RESOURCES", "TopicTrace", "WorkloadTrace",
+    "diurnal_growth_series", "generate_trace",
+    "DOW_OFFSETS", "PATTERN_CLASSES", "SPEC_REGISTRY",
+    "CorrelatedBurstSpec", "DiurnalGrowthSpec", "FlashCrowdSpec",
+    "PatternSpec", "SkewDriftSpec", "StepMigrationSpec", "WeeklySpec",
+    "base_level", "stack_resources",
+    "TraceSampler", "backtest_by_class", "schedule_burst_faults",
+    "REGIMES", "RegimeDetector", "RegimeShiftDetector",
+    "RegimeTuningLoop", "aggregate_series",
+]

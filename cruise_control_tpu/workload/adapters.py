@@ -1,0 +1,196 @@
+"""Narrow adapters feeding the generated traces to existing consumers.
+
+Three consumers, three adapters, zero changes to the consumers' own
+contracts (docs/workloads.md):
+
+- :class:`TraceSampler` — a ``MetricSampler`` replaying a
+  :class:`~.generator.WorkloadTrace` against a simulated cluster, the
+  drop-in replacement for ``SyntheticWorkloadSampler`` in the chaos
+  harnesses (``ChaosHarness(sampler=...)``);
+- :func:`schedule_burst_faults` — the trace-clocked chaos hook: maps
+  the trace's burst windows onto ``ChaosEngine`` steps so faults land
+  DURING bursts, deterministically;
+- :func:`backtest_by_class` — per-pattern-class worst holdout MAPE
+  through the forecast ladder (the scenario-14 gate rows).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.metricdef import BrokerMetric, KafkaMetric
+from ..monitor.samples import BrokerMetricSample, PartitionMetricSample
+from ..monitor.sampler import Samples, SamplerAssignment
+from .generator import WorkloadTrace
+
+
+class TraceSampler:
+    """Replay a workload trace as metric samples over a simulated
+    cluster.
+
+    Window selection: sample time ``end_ms`` maps to trace window
+    ``(end_ms // window_ms) % num_windows`` (``window_ms`` defaults to
+    the trace's own width; chaos harnesses pass their monitor window so
+    one trace window advances per sampling round; the modulo loops the
+    trace for soaks longer than the trace). A topic's window load
+    spreads across its live partitions by the trace's share matrix when
+    the class has one (skew drift), uniformly otherwise; topics the
+    trace does not know get ``default_bytes_in`` flat. Broker samples
+    sum the leader/follower shares exactly like
+    ``SyntheticWorkloadSampler``, so processor CPU attribution
+    round-trips the same way."""
+
+    parallel_safe = False
+
+    def __init__(self, cluster, trace: WorkloadTrace, *,
+                 window_ms: int | None = None, loop: bool = True,
+                 cpu_per_byte: float = 0.001,
+                 default_bytes_in: float = 50.0):
+        self.cluster = cluster
+        self.trace = trace
+        self.window_ms = window_ms or trace.window_ms
+        self.loop = loop
+        self.cpu_per_byte = cpu_per_byte
+        self.default_bytes_in = default_bytes_in
+
+    def window_at(self, end_ms: int) -> int:
+        w = int(end_ms // max(self.window_ms, 1))
+        if self.loop:
+            return w % self.trace.num_windows
+        return min(w, self.trace.num_windows - 1)
+
+    def _partition_rates(self, tp: tuple[str, int], w: int,
+                         topic_parts: dict[str, list[int]]
+                         ) -> tuple[float, float]:
+        tt = self.trace.topics.get(tp[0])
+        if tt is None:
+            bytes_in = self.default_bytes_in
+            return bytes_in, bytes_in * 1.5
+        live = topic_parts.get(tp[0]) or [tp[1]]
+        if tt.shares is not None:
+            P = tt.shares.shape[1]
+            share = float(tt.shares[w, tp[1] % P])
+            # Renormalize over the partition ids actually live in the
+            # sim (the trace's P and the sim's ids/count need not
+            # match — a sim topic's partitions are not necessarily
+            # numbered 0..count-1).
+            norm = float(tt.shares[w, np.asarray(live) % P].sum())
+            share = share / max(norm, 1e-12)
+        else:
+            share = 1.0 / len(live)
+        return float(tt.values[1, w]) * share, float(tt.values[2, w]) * share
+
+    def get_samples(self, assignment: SamplerAssignment) -> Samples:
+        infos = self.cluster.describe_partitions()
+        t = assignment.end_ms
+        w = self.window_at(t)
+        topic_parts: dict[str, list[int]] = {}
+        for topic, p in infos:
+            topic_parts.setdefault(topic, []).append(p)
+        psamples: list[PartitionMetricSample] = []
+        by_broker_in: dict[int, float] = {}
+        by_broker_out: dict[int, float] = {}
+        by_broker_disk: dict[int, float] = {}
+        for tp in assignment.partitions:
+            info = infos.get(tp)
+            if info is None:
+                continue
+            bytes_in, bytes_out = self._partition_rates(tp, w,
+                                                        topic_parts)
+            s = PartitionMetricSample(tp[0], tp[1], t)
+            s.record(KafkaMetric.LEADER_BYTES_IN, bytes_in)
+            s.record(KafkaMetric.LEADER_BYTES_OUT, bytes_out)
+            s.record(KafkaMetric.DISK_USAGE, info.size_mb)
+            s.record(KafkaMetric.PRODUCE_RATE, bytes_in / 10.0)
+            s.record(KafkaMetric.FETCH_RATE, bytes_out / 10.0)
+            s.record(KafkaMetric.MESSAGE_IN_RATE, bytes_in / 100.0)
+            s.record(KafkaMetric.REPLICATION_BYTES_IN_RATE,
+                     bytes_in * max(len(info.replicas) - 1, 0))
+            s.record(KafkaMetric.CPU_USAGE,
+                     self.cpu_per_byte * (bytes_in + bytes_out))
+            psamples.append(s)
+            by_broker_in[info.leader] = (by_broker_in.get(info.leader, 0.0)
+                                         + bytes_in)
+            by_broker_out[info.leader] = (by_broker_out.get(info.leader,
+                                                            0.0)
+                                          + bytes_out)
+            for b in info.replicas:
+                by_broker_disk[b] = (by_broker_disk.get(b, 0.0)
+                                     + info.size_mb)
+                if b != info.leader:
+                    by_broker_in[b] = by_broker_in.get(b, 0.0) + bytes_in
+        bsamples: list[BrokerMetricSample] = []
+        alive = self.cluster.describe_cluster()
+        for b in assignment.brokers:
+            if not alive.get(b, False):
+                continue
+            s = BrokerMetricSample(b, t)
+            tot_in = by_broker_in.get(b, 0.0)
+            tot_out = by_broker_out.get(b, 0.0)
+            s.record(BrokerMetric.CPU_USAGE,
+                     self.cpu_per_byte * (tot_in + tot_out))
+            s.record(BrokerMetric.LEADER_BYTES_IN, tot_in)
+            s.record(BrokerMetric.LEADER_BYTES_OUT, tot_out)
+            s.record(BrokerMetric.DISK_USAGE, by_broker_disk.get(b, 0.0))
+            metrics = self.cluster.broker_metrics(b)
+            s.record(BrokerMetric.BROKER_LOG_FLUSH_TIME_MS_MEAN,
+                     metrics.get("log_flush_time_ms", 0.0))
+            bsamples.append(s)
+        return Samples(psamples, bsamples)
+
+
+def schedule_burst_faults(engine, trace: WorkloadTrace, *,
+                          window_ms: int | None = None,
+                          action: str = "kill_broker",
+                          recover: str | None = "restart_broker",
+                          at_frac: float = 0.25,
+                          recover_after_windows: int = 4,
+                          **kwargs) -> list[int]:
+    """Schedule one ``action`` INSIDE each of the trace's burst ranges
+    (at ``at_frac`` through the range — mid-ramp by default, so the
+    fault lands while load is still climbing), plus the paired
+    ``recover`` action ``recover_after_windows`` later. ``window_ms``
+    maps trace windows to engine steps and must match the replaying
+    :class:`TraceSampler`'s. Returns the scheduled fault steps (the
+    soak's assertion anchors). ``kwargs`` go to both actions (e.g.
+    ``broker=2``)."""
+    window_ms = window_ms or trace.window_ms
+    steps: list[int] = []
+    for s, e in trace.burst_windows():
+        w = s + int((e - s) * at_frac)
+        step = w * window_ms // engine.step_ms
+        engine.schedule(step, action, **kwargs)
+        if recover is not None:
+            back = ((w + recover_after_windows) * window_ms
+                    // engine.step_ms)
+            engine.schedule(back, recover, **kwargs)
+        steps.append(step)
+    return steps
+
+
+def backtest_by_class(trace: WorkloadTrace, *,
+                      seasonal_period_ms: int | None = None,
+                      week_period_ms: int = 0,
+                      changepoint_min_shift: float = 0.0,
+                      min_history_windows: int = 3
+                      ) -> dict[str, float]:
+    """Worst 1-window-holdout MAPE per pattern class, fitted through
+    the forecast degrade ladder (weekly + changepoint rungs included
+    when enabled) — the ``forecast_mape_<class>`` bench rows. Classes
+    whose fits carry no backtest (degenerate histories) are omitted."""
+    from ..forecast import fit_topic_forecasts
+    if seasonal_period_ms is None:
+        seasonal_period_ms = trace.day_windows * trace.window_ms
+    fits = fit_topic_forecasts(
+        trace.topic_series(), trace.window_ms,
+        seasonal_period_ms=seasonal_period_ms,
+        week_period_ms=week_period_ms,
+        changepoint_min_shift=changepoint_min_shift,
+        min_history_windows=min_history_windows, fitted_at_ms=0)
+    out: dict[str, float] = {}
+    for cls, topics in trace.classes().items():
+        errs = [fits.forecasts[t].backtest_mape for t in topics
+                if fits.forecasts[t].backtest_mape is not None]
+        if errs:
+            out[cls] = max(errs)
+    return out

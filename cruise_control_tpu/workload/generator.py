@@ -1,0 +1,148 @@
+"""Seeded trace generation: pattern specs -> per-topic window traces.
+
+One :func:`generate_trace` call materializes a :class:`WorkloadTrace`:
+per-topic ``[4, W]`` resource series (cpu / nwIn / nwOut / disk — the
+forecast fit's order) plus optional ``[W, P]`` per-partition shares,
+each labeled with its pattern class. The trace is the single source the
+three consumers adapt from (docs/workloads.md):
+
+- forecast backtests read :meth:`WorkloadTrace.topic_series` (exactly
+  the ``fit_topic_forecasts`` input schema);
+- chaos soaks replay it through ``workload.adapters.TraceSampler`` and
+  clock fault injection off :meth:`WorkloadTrace.burst_windows`;
+- bench scenario 14 groups MAPE gates by :meth:`WorkloadTrace.classes`.
+
+Determinism: ONE ``np.random.default_rng(seed)`` stream, consumed spec
+``prepare`` hooks first (in spec order) then topics in topic order —
+:meth:`WorkloadTrace.digest` is the byte-level witness the determinism
+test pins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .patterns import DiurnalGrowthSpec, PatternSpec
+
+#: resource row order of every trace (shared with forecast/model.py)
+TRACE_RESOURCES = ("cpu", "nwIn", "nwOut", "disk")
+
+
+@dataclass
+class TopicTrace:
+    """One topic's generated trace: resource values, pattern label,
+    optional per-partition shares, and the class's burst ranges."""
+
+    topic: str
+    pattern: str
+    values: np.ndarray                     # f64[4, W]
+    shares: np.ndarray | None = None       # f64[W, P], rows sum to 1
+    bursts: list = field(default_factory=list)   # [(start_w, end_w)]
+
+
+@dataclass
+class WorkloadTrace:
+    """The generated workload: topic -> :class:`TopicTrace` plus the
+    provenance (seed, window width) every consumer carries along."""
+
+    window_ms: int
+    num_windows: int
+    seed: int
+    day_windows: int
+    topics: dict[str, TopicTrace]
+
+    def __len__(self) -> int:
+        return len(self.topics)
+
+    def topic_series(self) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+        """The forecast-fit adapter: topic -> (values[4, W], valid[W])
+        with every window valid (the generator never produces holes —
+        dropouts are the chaos engine's job)."""
+        ones = np.ones(self.num_windows, bool)
+        return {t: (tt.values, ones) for t, tt in self.topics.items()}
+
+    def classes(self) -> dict[str, list[str]]:
+        """pattern label -> sorted topic list (the per-class gate axis)."""
+        out: dict[str, list[str]] = {}
+        for t, tt in self.topics.items():
+            out.setdefault(tt.pattern, []).append(t)
+        return {k: sorted(v) for k, v in sorted(out.items())}
+
+    def burst_windows(self) -> list[tuple[int, int]]:
+        """Merged union of every topic's burst ranges, sorted — the
+        trace-clocked chaos hook's fault anchors."""
+        ranges = sorted(r for tt in self.topics.values()
+                        for r in tt.bursts)
+        merged: list[tuple[int, int]] = []
+        for s, e in ranges:
+            if merged and s <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+            else:
+                merged.append((s, e))
+        return merged
+
+    def aggregate(self, resource: int = 1) -> np.ndarray:
+        """Cluster-aggregate series of one resource row (default nwIn)
+        summed over topics — the regime detector's input shape."""
+        return np.sum([tt.values[resource]
+                       for tt in self.topics.values()], axis=0)
+
+    def digest(self) -> str:
+        """sha256 over every topic's values (+ shares) in topic order —
+        the byte-identical determinism witness."""
+        h = hashlib.sha256()
+        for t in sorted(self.topics):
+            tt = self.topics[t]
+            h.update(t.encode())
+            h.update(np.ascontiguousarray(tt.values).tobytes())
+            if tt.shares is not None:
+                h.update(np.ascontiguousarray(tt.shares).tobytes())
+        return h.hexdigest()
+
+
+def generate_trace(specs: list[PatternSpec], topics: list[str], *,
+                   num_windows: int, window_ms: int = 60_000,
+                   seed: int = 0, day_windows: int = 24,
+                   partitions: int = 8) -> WorkloadTrace:
+    """Generate one trace: topic ``i`` is assigned ``specs[i % len]``
+    (round-robin, so a multi-class trace interleaves classes across the
+    topic list). One seeded rng, consumed ``prepare`` first then topics
+    in order — see the module docstring's determinism contract."""
+    if not specs:
+        raise ValueError("generate_trace needs at least one PatternSpec")
+    if num_windows < 2:
+        raise ValueError(f"num_windows must be >= 2, got {num_windows}")
+    rng = np.random.default_rng(seed)
+    states = [spec.prepare(rng, num_windows, day_windows)
+              for spec in specs]
+    x = np.arange(num_windows, dtype=float)
+    out: dict[str, TopicTrace] = {}
+    for i, t in enumerate(topics):
+        spec = specs[i % len(specs)]
+        state = states[i % len(specs)]
+        values = spec.topic_values(rng, i, x, day_windows, state)
+        shares = spec.topic_shares(i, num_windows, partitions, state)
+        out[t] = TopicTrace(topic=t, pattern=spec.pattern, values=values,
+                            shares=shares,
+                            bursts=spec.burst_windows(num_windows, state))
+    return WorkloadTrace(window_ms=window_ms, num_windows=num_windows,
+                         seed=seed, day_windows=day_windows, topics=out)
+
+
+def diurnal_growth_series(topics: list[str], num_windows: int, *,
+                          day_windows: int = 24, seed: int = 13
+                          ) -> dict[str, tuple[np.ndarray, np.ndarray]]:
+    """The scenario-8 fit traces, generated through the pattern class:
+    byte-identical to the inline builder bench.py shipped before the
+    workload package existed (level lattice ``200 + 10*(i%17)``, growth
+    ``0.05*(i%5)*level/W``, 20% diurnal amplitude, 1% noise from
+    ``default_rng(seed)`` consumed in topic order) — the dedupe
+    satellite's seed-stability contract, pinned by
+    tests/test_workload.py against a frozen copy of the old code."""
+    trace = generate_trace([DiurnalGrowthSpec()], list(topics),
+                           num_windows=num_windows, seed=seed,
+                           day_windows=day_windows)
+    return trace.topic_series()

@@ -1,0 +1,288 @@
+"""First-class workload pattern classes for the trace-driven generator.
+
+No reference analog — the reference control plane replays recorded
+metrics; here the *shapes* of production traffic are the model
+(ROADMAP item 5): flash crowds, weekly seasonality, step migrations,
+correlated multi-topic bursts, and partition-skew drift (the
+key-distribution constraint of arxiv 2205.09415 makes skew traces
+mandatory for any credible partition-load model). Each
+:class:`PatternSpec` is a small, composable recipe that turns a topic
+index + shared abscissa into one ``[4, W]`` per-resource window trace
+(cpu / nwIn / nwOut / disk — the forecast fit's resource order) and,
+for skewed classes, a ``[W, P]`` per-partition share matrix.
+
+Determinism contract: a spec consumes the generator's single seeded rng
+a FIXED number of draws per topic (independent of which other specs run
+or of the partition count), so the same ``(specs, topics, seed)`` always
+produces byte-identical traces — the property tests and the bench's
+seed-stable scenario-8 dedupe both rely on it. ``prepare`` runs once per
+spec (in spec order) before any topic is generated; correlated classes
+draw their shared latents there.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import ClassVar
+
+import numpy as np
+
+#: canonical pattern-class labels, the vocabulary bench rows
+#: (``forecast_mape_<class>``) and the regime detector share
+PATTERN_CLASSES = ("steady", "diurnal_growth", "flash_crowd", "weekly",
+                   "step_migration", "correlated_burst", "skew_drift")
+
+
+def base_level(i: int) -> float:
+    """The per-topic base load level — the same deterministic lattice
+    bench.py's scenario-8 inline builder used (``200 + 10 * (i % 17)``),
+    kept as THE level convention so every pattern class produces
+    comparable magnitudes."""
+    return 200.0 + 10.0 * (i % 17)
+
+
+def stack_resources(y: np.ndarray, level: float,
+                    disk: np.ndarray | None = None) -> np.ndarray:
+    """The ``[4, W]`` resource stack from one nwIn series: cpu tracks
+    bytes at 1%, nwOut at half fan-out, disk flat at 5x level unless the
+    class supplies its own (the scenario-8 conventions)."""
+    if disk is None:
+        disk = np.full_like(y, 5.0 * level)
+    return np.stack([0.01 * y, y, 0.5 * y, disk])
+
+
+@dataclass(frozen=True)
+class PatternSpec:
+    """Base spec: steady load with mild relative noise.
+
+    Subclasses override :meth:`topic_values` (and optionally
+    :meth:`prepare` / :meth:`topic_shares` / :meth:`burst_windows`).
+    ``noise`` is the relative sigma of the per-window jitter every class
+    applies (0 disables — shares are always noise-free)."""
+
+    pattern: ClassVar[str] = "steady"
+    noise: float = 0.01
+
+    def prepare(self, rng: np.random.Generator, num_windows: int,
+                day_windows: int) -> dict:
+        """Shared latent state drawn ONCE per spec before any topic
+        (correlated classes pick their common burst here)."""
+        return {}
+
+    def _noise(self, rng: np.random.Generator, level: float,
+               num_windows: int) -> np.ndarray:
+        # Always consume the same number of draws, even at noise=0, so
+        # toggling noise never re-phases the stream for later topics.
+        eps = rng.normal(0.0, 0.01 * level, num_windows)
+        return eps * (self.noise / 0.01) if self.noise != 0.01 else eps
+
+    def topic_values(self, rng: np.random.Generator, i: int,
+                     x: np.ndarray, day_windows: int,
+                     state: dict) -> np.ndarray:
+        level = base_level(i)
+        y = level + self._noise(rng, level, len(x))
+        return stack_resources(y, level)
+
+    def topic_shares(self, i: int, num_windows: int,
+                     partitions: int, state: dict) -> np.ndarray | None:
+        """Per-partition share matrix ``[W, P]`` (rows sum to 1), or
+        None for classes whose load spreads uniformly."""
+        return None
+
+    def burst_windows(self, num_windows: int,
+                      state: dict) -> list[tuple[int, int]]:
+        """Half-open ``[start, end)`` window ranges where this class is
+        bursting — the trace-clocked chaos hook injects faults here."""
+        return []
+
+
+@dataclass(frozen=True)
+class DiurnalGrowthSpec(PatternSpec):
+    """Level + linear growth + diurnal sinusoid — byte-identical to the
+    inline trace builder bench.py scenario 8 shipped with (the dedupe
+    satellite's seed-stability contract): same level lattice, same
+    slope/amplitude rules, same single ``rng.normal`` draw per topic."""
+
+    pattern: ClassVar[str] = "diurnal_growth"
+
+    def topic_values(self, rng, i, x, day_windows, state):
+        W = len(x)
+        level = base_level(i)
+        slope = 0.05 * (i % 5) * level / W
+        amp = 0.2 * level
+        y = (level + slope * x + amp * np.sin(2 * np.pi * x / day_windows)
+             + rng.normal(0.0, 0.01 * level, W))
+        return np.stack([0.01 * y, y, 0.5 * y,
+                         5.0 * level + slope * x])   # cpu/nwIn/nwOut/disk
+
+
+@dataclass(frozen=True)
+class FlashCrowdSpec(PatternSpec):
+    """A flash crowd: steady baseline, then a ramp to ``peak_ratio`` x
+    level, a hold, and a linear decay back — the canonical viral-event
+    shape. The burst is a LEVEL excursion, not a trend, so a fit without
+    changepoint handling smears it into the level; the changepoint rung
+    truncates to the post-burst suffix and recovers the clean baseline."""
+
+    pattern: ClassVar[str] = "flash_crowd"
+    peak_ratio: float = 8.0
+    ramp_windows: int = 4
+    hold_windows: int = 6
+    decay_windows: int = 12
+    at_frac: float = 0.5
+
+    def _profile(self, num_windows: int) -> np.ndarray:
+        at = int(num_windows * self.at_frac)
+        b = np.zeros(num_windows)
+        r, h, d = self.ramp_windows, self.hold_windows, self.decay_windows
+        up = np.arange(1, r + 1) / r
+        down = 1.0 - np.arange(1, d + 1) / d
+        prof = np.concatenate([up, np.ones(h), down])
+        end = min(at + len(prof), num_windows)
+        b[at:end] = prof[:end - at]
+        return b
+
+    def topic_values(self, rng, i, x, day_windows, state):
+        level = base_level(i)
+        b = self._profile(len(x))
+        y = (level * (1.0 + (self.peak_ratio - 1.0) * b)
+             + self._noise(rng, level, len(x)))
+        return stack_resources(y, level)
+
+    def burst_windows(self, num_windows, state):
+        at = int(num_windows * self.at_frac)
+        end = min(at + self.ramp_windows + self.hold_windows
+                  + self.decay_windows, num_windows)
+        return [(at, end)]
+
+
+#: additive day-of-week load offsets (fraction of level), Mon..Sun —
+#: midweek ramps up, Friday peaks, the weekend craters (the e-commerce
+#: shape the paper's deployment balances around)
+DOW_OFFSETS = (0.0, 0.05, 0.12, 0.04, 0.25, -0.28, -0.38)
+
+
+@dataclass(frozen=True)
+class WeeklySpec(PatternSpec):
+    """Weekly seasonality: a daily sinusoid plus additive day-of-week
+    offsets (``DOW_OFFSETS``). A day is ``day_windows`` windows and a
+    week is exactly 7 days, matching the forecast ladder's weekly-bucket
+    rule — the weekly rung fits this class to noise level; without it
+    the weekend offset alone is a ~38% level error."""
+
+    pattern: ClassVar[str] = "weekly"
+    daily_amp: float = 0.2
+
+    def topic_values(self, rng, i, x, day_windows, state):
+        level = base_level(i)
+        dow = np.asarray(DOW_OFFSETS)[
+            (x.astype(int) // day_windows) % 7]
+        y = (level * (1.0 + self.daily_amp
+                      * np.sin(2 * np.pi * x / day_windows) + dow)
+             + self._noise(rng, level, len(x)))
+        return stack_resources(y, level)
+
+
+@dataclass(frozen=True)
+class StepMigrationSpec(PatternSpec):
+    """A step migration: load jumps to ``step_ratio`` x level at window
+    ``at_frac * W`` and STAYS there (a workload migrating onto the
+    cluster). The changepoint rung must locate the step and fit the
+    post-step suffix; the regime detector classifies the sustained
+    elevation as ``step_migration``."""
+
+    pattern: ClassVar[str] = "step_migration"
+    step_ratio: float = 2.5
+    at_frac: float = 2.0 / 3.0
+
+    def step_window(self, num_windows: int) -> int:
+        return int(num_windows * self.at_frac)
+
+    def topic_values(self, rng, i, x, day_windows, state):
+        level = base_level(i)
+        at = self.step_window(len(x))
+        y = (level * (1.0 + (self.step_ratio - 1.0) * (x >= at))
+             + self._noise(rng, level, len(x)))
+        return stack_resources(y, level)
+
+
+@dataclass(frozen=True)
+class CorrelatedBurstSpec(PatternSpec):
+    """A correlated multi-topic burst: EVERY topic assigned this spec
+    bursts over the same windows (the shared latent drawn in
+    :meth:`prepare`), with a per-topic amplitude scale — the
+    cross-topic correlation that makes aggregate headroom, not
+    per-topic headroom, the binding constraint."""
+
+    pattern: ClassVar[str] = "correlated_burst"
+    peak_ratio: float = 5.0
+    ramp_windows: int = 2
+    hold_windows: int = 4
+    decay_windows: int = 6
+    #: fixed burst-start fraction; None draws it from the shared rng
+    at_frac: float | None = None
+
+    def prepare(self, rng, num_windows, day_windows):
+        if self.at_frac is not None:
+            at = int(num_windows * self.at_frac)
+        else:
+            at = int(rng.integers(num_windows // 4,
+                                  max(num_windows // 2, num_windows // 4 + 1)))
+        return {"at": at}
+
+    def topic_values(self, rng, i, x, day_windows, state):
+        level = base_level(i)
+        amp = 0.75 + 0.5 * rng.random()     # per-topic burst severity
+        b = np.zeros(len(x))
+        r, h, d = self.ramp_windows, self.hold_windows, self.decay_windows
+        prof = np.concatenate([np.arange(1, r + 1) / r, np.ones(h),
+                               1.0 - np.arange(1, d + 1) / d])
+        at = state["at"]
+        end = min(at + len(prof), len(x))
+        b[at:end] = prof[:end - at]
+        y = (level * (1.0 + (self.peak_ratio - 1.0) * amp * b)
+             + self._noise(rng, level, len(x)))
+        return stack_resources(y, level)
+
+    def burst_windows(self, num_windows, state):
+        at = state["at"]
+        end = min(at + self.ramp_windows + self.hold_windows
+                  + self.decay_windows, num_windows)
+        return [(at, end)]
+
+
+@dataclass(frozen=True)
+class SkewDriftSpec(PatternSpec):
+    """Partition-skew drift: topic-level load stays steady but the
+    per-partition key distribution is Zipf with an exponent drifting
+    ``zipf_a0 -> zipf_a1`` across the trace — a hot key emerging. The
+    share matrix is noise-free and analytic, so the property test can
+    recover the exponent trajectory exactly (arxiv 2205.09415's
+    constraint: partition counts cannot relieve a skewed key)."""
+
+    pattern: ClassVar[str] = "skew_drift"
+    zipf_a0: float = 1.01
+    zipf_a1: float = 2.0
+
+    def exponent(self, w: int, num_windows: int) -> float:
+        frac = w / max(num_windows - 1, 1)
+        return self.zipf_a0 + (self.zipf_a1 - self.zipf_a0) * frac
+
+    def topic_shares(self, i, num_windows, partitions, state):
+        ranks = np.arange(1, partitions + 1, dtype=float)
+        a = np.asarray([self.exponent(w, num_windows)
+                        for w in range(num_windows)])
+        raw = ranks[None, :] ** (-a[:, None])          # [W, P]
+        return raw / raw.sum(axis=1, keepdims=True)
+
+
+#: pattern label -> default spec instance (the bench / docs registry)
+SPEC_REGISTRY = {
+    "steady": PatternSpec(),
+    "diurnal_growth": DiurnalGrowthSpec(),
+    "flash_crowd": FlashCrowdSpec(),
+    "weekly": WeeklySpec(),
+    "step_migration": StepMigrationSpec(),
+    "correlated_burst": CorrelatedBurstSpec(),
+    "skew_drift": SkewDriftSpec(),
+}

@@ -1,0 +1,267 @@
+"""Traffic-regime detection and the continuous online-tuning loop.
+
+Closes ROADMAP item 5's tuning loop: PR 11's successive-halving tuner
+ran offline per shape bucket; here a :class:`RegimeDetector` classifies
+the ACTIVE traffic regime from the cluster-aggregate window series (the
+same aggregator cube the forecast fits read), and a
+:class:`RegimeTuningLoop` reacts to shifts — ensuring a tuned config
+exists per ``(shape bucket, regime)`` in the ``TunedConfigStore`` and
+flipping the optimizer's ``active_regime`` so lookups resolve to the
+regime's schedule. The tuned config joins the compiled-chain /
+dispatch-group key exactly as buckets do today, so once each regime's
+chain is warm a shift changes WHICH cached chain runs, never compiles a
+new one (the zero-warm-recompile gate of bench scenario 14).
+
+Classification is pure host numpy over the recent window tail:
+
+- ``steady`` — no recent window exceeds ``burst_ratio`` x the robust
+  (median) baseline;
+- ``flash_crowd`` — an excursion that is already decaying (the latest
+  windows sit well below the recent peak);
+- ``step_migration`` — a sustained elevation (the latest windows hold
+  near the recent peak).
+
+Hysteresis (``min_dwell`` consecutive classifications before a switch)
+keeps a noisy boundary from thrashing the tuner.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+import numpy as np
+
+LOG = logging.getLogger(__name__)
+
+#: the regime vocabulary (store keys qualify buckets with these labels)
+REGIMES = ("steady", "flash_crowd", "step_migration")
+
+_EPS = 1e-9
+
+
+@dataclass
+class RegimeDetector:
+    """Stateful classifier over cluster-aggregate window series.
+
+    :meth:`classify` is stateless (the property tests drive it
+    directly); :meth:`observe` adds the dwell hysteresis and the shift
+    journal."""
+
+    #: a recent window must exceed this multiple of the baseline median
+    #: before anything but ``steady`` is considered
+    burst_ratio: float = 2.0
+    #: latest windows at >= this fraction of the recent peak = the
+    #: elevation persists (step), below = it is decaying (flash crowd)
+    persist_frac: float = 0.6
+    #: how many trailing windows count as "recent"
+    tail_windows: int = 8
+    #: consecutive classifications required before switching regime
+    min_dwell: int = 1
+    regime: str = "steady"
+    shifts: list = field(default_factory=list)
+    _pending: str | None = None
+    _pending_count: int = 0
+
+    def classify(self, series) -> str:
+        """Classify one aggregate series (most recent window last)."""
+        y = np.asarray(series, float)
+        W = len(y)
+        if W < 4:
+            return "steady"
+        t = min(self.tail_windows, max(W // 4, 1))
+        base = float(np.median(y[:-t]))
+        if base <= _EPS:
+            return "steady"
+        r = y[-t:] / base
+        peak = float(r.max())
+        if peak < self.burst_ratio:
+            return "steady"
+        last = float(np.mean(r[-max(t // 4, 1):]))
+        if last >= self.persist_frac * peak:
+            return "step_migration"
+        return "flash_crowd"
+
+    def observe(self, series, now_ms: int = 0) -> tuple[str, bool]:
+        """Classify and update the dwell state machine; returns
+        ``(active regime, shifted this observation)``."""
+        label = self.classify(series)
+        if label == self.regime:
+            self._pending, self._pending_count = None, 0
+            return self.regime, False
+        if label == self._pending:
+            self._pending_count += 1
+        else:
+            self._pending, self._pending_count = label, 1
+        if self._pending_count < self.min_dwell:
+            return self.regime, False
+        prev, self.regime = self.regime, label
+        self._pending, self._pending_count = None, 0
+        self.shifts.append({"fromRegime": prev, "toRegime": label,
+                            "atMs": int(now_ms)})
+        LOG.info("workload regime shift: %s -> %s (at %dms)", prev,
+                 label, now_ms)
+        return self.regime, True
+
+
+def aggregate_series(monitor, now_ms: int,
+                     metric: int | None = None) -> np.ndarray:
+    """Cluster-aggregate per-window series (default LEADER_BYTES_IN)
+    from the monitor's partition aggregator — the regime detector's
+    live input, read off the SAME dense cube the forecast fit uses.
+    Raises ``NotEnoughValidWindowsError`` while no window is valid."""
+    from ..core.aggregator import (AggregationOptions, Extrapolation,
+                                   NotEnoughValidWindowsError)
+    from ..core.metricdef import KafkaMetric
+    if metric is None:
+        metric = KafkaMetric.LEADER_BYTES_IN
+    agg = monitor.partition_aggregator
+    result = agg.aggregate(0, now_ms,
+                           AggregationOptions(min_valid_windows=1),
+                           use_dense=True)
+    d = result.dense
+    if d is None or not d.window_times_ms:
+        raise NotEnoughValidWindowsError(
+            "no aggregated windows to classify a regime from")
+    no_valid = Extrapolation.NO_VALID_EXTRAPOLATION.value
+    valid = d.extrapolations != no_valid                  # [E, W]
+    vals = np.where(valid, d.values[:, metric, :], 0.0)
+    wvalid = valid.any(axis=0)
+    if not wvalid.any():
+        raise NotEnoughValidWindowsError(
+            "no valid windows to classify a regime from")
+    return vals.sum(axis=0)[wvalid]
+
+
+class RegimeTuningLoop:
+    """Continuous tuning: regime shifts re-resolve (and, when budgeted,
+    re-tune) the optimizer's schedule.
+
+    ``trials <= 1`` pins the incumbent schedule per regime WITHOUT any
+    per-candidate compiles (an empty-override record — the cheap mode
+    tier-1 and the scenario-14 smoke run); ``trials > 1`` runs the full
+    successive-halving tuner for the new regime's ``(bucket, regime)``
+    key. Either way the optimizer's ``active_regime`` flips so its
+    ``_prepare`` resolves the regime's schedule on the next optimize —
+    and because tuned configs join the chain key, a shift between
+    already-warm regimes never recompiles."""
+
+    def __init__(self, optimizer, store, detector: RegimeDetector
+                 | None = None, *, trials: int = 0, rungs: int = 1,
+                 seed: int = 0, goals=None, constraint=None,
+                 options=None, save: bool = True):
+        self.optimizer = optimizer
+        self.store = store
+        self.detector = detector or RegimeDetector()
+        self.trials = trials
+        self.rungs = rungs
+        self.seed = seed
+        self.goals = goals
+        self.constraint = constraint
+        self.options = options
+        self.save = save
+        self.retunes = 0
+        self.events: list[dict] = []
+        self._seen: set[str] = set()
+
+    def ensure_tuned(self, model, metadata, regime: str) -> dict:
+        """Make sure ``(bucket, regime)`` has a store entry; returns the
+        resolved field overrides. Exact-match lookup (no fallback): the
+        point is to pin the regime's schedule explicitly."""
+        from ..analyzer.tuning import autotune, shape_bucket
+        P, B = metadata.num_partitions, metadata.num_brokers
+        fields = self.store.lookup(P, B, regime=regime, fallback=False)
+        if fields is not None:
+            return fields
+        if self.trials > 1 and model is not None:
+            fields, _history, _bucket = autotune(
+                model, metadata, base=self.optimizer.config,
+                store=self.store, trials=self.trials, rungs=self.rungs,
+                seed=self.seed, goals=self.goals,
+                constraint=self.constraint, options=self.options,
+                save=self.save, regime=regime)
+        else:
+            # Pin the incumbent schedule for this regime — zero compiles
+            # now, and an explicit slot the offline tuner can improve.
+            fields = {}
+            self.store.record(P, B, fields, regime=regime,
+                              save=self.save)
+        self.retunes += 1
+        LOG.info("regime %s tuned for bucket %s: %s", regime,
+                 shape_bucket(P, B, regime=regime), fields or "incumbent")
+        return fields
+
+    def on_series(self, series, model, metadata,
+                  now_ms: int = 0) -> dict | None:
+        """One loop iteration: classify, flip ``active_regime``, tune on
+        shift (or on first sight of a regime for this shape). Returns
+        the event dict when anything changed, else None."""
+        regime, shifted = self.detector.observe(series, now_ms)
+        self.optimizer.active_regime = regime
+        from ..analyzer.tuning import shape_bucket
+        key = shape_bucket(metadata.num_partitions,
+                           metadata.num_brokers, regime=regime)
+        if not shifted and key in self._seen:
+            return None
+        self._seen.add(key)
+        fields = self.ensure_tuned(model, metadata, regime)
+        event = {"regime": regime, "shifted": shifted, "bucket": key,
+                 "fields": dict(fields), "atMs": int(now_ms)}
+        self.events.append(event)
+        return event
+
+
+class RegimeShiftDetector:
+    """The scheduled serving-path hook (``tuning.regime.enabled``):
+    reads the aggregate series off the live monitor each round, drives
+    the tuning loop, and meters shifts/retunes. Implements the detector
+    protocol (``detect(now_ms) -> []``) — a regime shift is an input to
+    tuning, not an anomaly to heal, so it never raises anomalies."""
+
+    def __init__(self, monitor, loop: RegimeTuningLoop, *,
+                 model_fn=None, registry=None) -> None:
+        self.monitor = monitor
+        self.loop = loop
+        #: optional () -> (model, metadata) supplier for full re-tuning;
+        #: None = incumbent-pinning mode reading shapes off the monitor
+        self.model_fn = model_fn
+        if registry is not None:
+            from ..core.sensors import MetricRegistry
+            name = MetricRegistry.name
+            self._shift_meter = registry.meter(
+                name("WorkloadRegime", "shift-rate"))
+            self._retune_meter = registry.meter(
+                name("WorkloadRegime", "retune-rate"))
+            registry.gauge(
+                name("WorkloadRegime", "active-regime-code"),
+                lambda: REGIMES.index(self.loop.detector.regime))
+        else:
+            self._shift_meter = self._retune_meter = None
+
+    def detect(self, now_ms: int) -> list:
+        from ..core.aggregator import NotEnoughValidWindowsError
+        try:
+            series = aggregate_series(self.monitor, now_ms)
+        except NotEnoughValidWindowsError:
+            return []
+        model = metadata = None
+        if self.model_fn is not None:
+            try:
+                model, metadata = self.model_fn()
+            except Exception:
+                model = metadata = None
+        if metadata is None:
+            try:
+                result = self.monitor.cluster_model(now_ms)
+                metadata = result.metadata
+            except Exception:
+                return []
+        before = self.loop.retunes
+        event = self.loop.on_series(series, model, metadata, now_ms)
+        if event is not None:
+            if event["shifted"] and self._shift_meter is not None:
+                self._shift_meter.mark()
+            if self.loop.retunes > before \
+                    and self._retune_meter is not None:
+                self._retune_meter.mark()
+        return []

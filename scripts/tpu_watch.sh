@@ -104,10 +104,15 @@ while true; do
     # 12 = flight-recorder journal overhead on the warm propose path
     # (enabled vs disabled, <2% gate + zero-added-sync gate): rides the
     # compile cache scenario 2 warms, so it is cheap right behind it.
-    for spec in 2 12 9 10 11 6 8 7 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
+    # 14 = the trace-driven workload plane (per-class forecast MAPE
+    # gates + regime-aware online tuning): the fit stage is host-side,
+    # the regime loop tunes per (bucket, regime) on-chip and certifies
+    # the zero-warm-recompile shift gate; it rides behind scenario 7 so
+    # the tuner's compile cache is hot.
+    for spec in 2 12 9 10 11 6 8 7 14 5 4 4::-1 4:fullchain 3 4:add_brokers 4:remove_brokers 1; do
       probe || break
       case "$spec" in
-        2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7) tmo=4800 ;;
+        2|1) tmo=3600 ;; 5|6|8) tmo=2400 ;; 7|14) tmo=4800 ;;
         9|10|11|12) tmo=1800 ;;
         4:fullchain) tmo=7200 ;;
         *) tmo=5400 ;;

@@ -223,6 +223,37 @@ def test_multiobj_propose_bench_smoke_gate(tmp_path):
     assert out["bucket"] in data["buckets"]
 
 
+def test_workload_regime_bench_smoke_gate(tmp_path):
+    """run_workload_regime_bench (scenario 14) on a toy cluster in
+    incumbent-pinning mode (tune_trials=0 — no per-candidate compiles,
+    so the smoke stays tier-1): exercises the per-pattern-class MAPE
+    gates, the scripted steady -> flash_crowd -> step_migration regime
+    loop, the zero-warm-recompile shift gate, and the quality gate
+    end-to-end (the helper raises on any breach). The full
+    successive-halving tuning path runs at bench scale via
+    --scenario 14 / tpu_watch ladder entry 14."""
+    import bench
+    from cruise_control_tpu.workload import PATTERN_CLASSES
+    out = bench.run_workload_regime_bench(
+        num_brokers=10, num_partitions=96,
+        goal_names=["ReplicaDistributionGoal"],
+        tune_trials=0, store_path=str(tmp_path / "tuned.json"),
+        emit_row=False, gate=False)
+    assert set(out["mapes"]) == set(PATTERN_CLASSES)
+    assert all(m <= bench.FORECAST_MAPE_BUDGET
+               for m in out["mapes"].values())
+    assert out["recompiles"] == 0
+    assert out["quality_delta"] <= bench.MULTIOBJ_QUALITY_TOL
+    assert out["shifts"] >= 2           # the scripted pass really shifted
+    assert out["retunes"] == 3          # one per regime, first sight only
+    # Regime-qualified buckets landed in the persisted store.
+    import json
+    data = json.loads((tmp_path / "tuned.json").read_text())
+    assert any("@steady" in b for b in data["buckets"])
+    assert any("@flash_crowd" in b for b in data["buckets"])
+    assert any("@step_migration" in b for b in data["buckets"])
+
+
 @pytest.mark.slow
 def test_scale_tier_gate_smoke():
     """The GATED scale tier (run_scale_scenario) at a CI-sized cluster,

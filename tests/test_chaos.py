@@ -347,6 +347,44 @@ def test_remove_disks_respects_stale_gate(optimizer, chaos_seed):
                       require_healthy=False)
 
 
+def test_flash_crowd_burst_fault_heals_under_replayed_load(optimizer,
+                                                           chaos_seed):
+    """Trace-driven soak: the monitor samples a replayed flash-crowd
+    trace (workload.TraceSampler swapped in for the synthetic sampler)
+    and the trace-clocked schedule hook lands a broker kill MID-BURST —
+    self-healing drains the dead broker while the replayed load is
+    still elevated, and the scheduled restart rejoins it."""
+    from cruise_control_tpu.workload import (FlashCrowdSpec, TraceSampler,
+                                             generate_trace,
+                                             schedule_burst_faults)
+    seed = _pick(chaos_seed, 9)
+    sim = build_sim()
+    W = 64
+    trace = generate_trace([FlashCrowdSpec()], ["t0", "t1", "t2"],
+                           num_windows=W, seed=seed)
+    window_ms = 2_000                    # = the harness monitor window
+    h = ChaosHarness(sim, seed=seed, optimizer=optimizer,
+                     sampler=TraceSampler(sim, trace,
+                                          window_ms=window_ms))
+    base = snapshot_topology(h.sim)
+    h.warmup()
+    steps = schedule_burst_faults(h.engine, trace, window_ms=window_ms,
+                                  broker=1)
+    assert len(steps) == 1
+    (s, e), = trace.burst_windows()
+    kill_w = steps[0] * h.engine.step_ms // window_ms
+    assert s <= kill_w < e, "the hook must aim inside the burst"
+    # the replayed load at the kill window IS the elevated burst value
+    assert trace.topics["t0"].values[1, kill_w] \
+        > 2.0 * trace.topics["t0"].values[1, 0]
+    h.steps_until(lambda: not h.sim.describe_cluster().get(1, True),
+                  steps[0] + 5, what="trace-clocked broker kill")
+    drive_to_health(
+        h, base, "test_flash_crowd_burst_fault_heals_under_replayed_load",
+        budget=160)
+    assert h.detector.num_self_healing_started >= 1
+
+
 # ------------------------------------------------ hardening unit layer
 
 def test_detector_failures_are_logged_and_metered(caplog):

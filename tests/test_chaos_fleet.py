@@ -144,6 +144,51 @@ def test_fleet_kill_scenario_replays_byte_identically(
     assert d1 == d2
 
 
+@pytest.mark.slow
+def test_fleet_burst_clocked_member_kill_and_readmit(optimizer,
+                                                     chaos_seed):
+    """Burst-clocked fleet soak: one member replays a flash-crowd trace
+    (the ``samplers`` factory hook binds a workload.TraceSampler to the
+    member's chaos endpoint) and the trace-clocked hook kills that
+    member's WHOLE endpoint mid-burst. Isolation holds — siblings never
+    leave HEALTHY — and the scheduled restart readmits the member.
+
+    Slow-marked (tier-1 budget): the burst-clock mechanics stay tier-1
+    in tests/test_chaos.py's single-cluster burst soak and the
+    TraceSampler / schedule_burst_faults units in
+    tests/test_workload.py; the endpoint-kill quarantine walk itself
+    stays tier-1 in test_fleet_member_endpoint_kill_quarantine_and_
+    readmit."""
+    from cruise_control_tpu.workload import (FlashCrowdSpec, TraceSampler,
+                                             generate_trace,
+                                             schedule_burst_faults)
+    seed = _pick(chaos_seed, 13)
+    W = 64
+    trace = generate_trace([FlashCrowdSpec(at_frac=0.25)],
+                           ["t0", "t1", "t2"], num_windows=W, seed=seed)
+    window_ms = 2_000                    # = the member monitor window
+    h = ChaosFleetHarness(
+        MEMBERS, seed=seed, optimizer=optimizer,
+        samplers={"west": lambda endpoint: TraceSampler(
+            endpoint, trace, window_ms=window_ms)})
+    assert h.members["west"].sampler.inner.__class__ is TraceSampler
+    h.warmup()
+    steps = schedule_burst_faults(h.engine, trace, window_ms=window_ms,
+                                  action="kill_endpoint",
+                                  recover="restart_endpoint",
+                                  member="west")
+    (s, e), = trace.burst_windows()
+    kill_w = steps[0] * h.engine.step_ms // window_ms
+    assert s <= kill_w < e, "the hook must aim inside the burst"
+    h.steps_until(lambda: h.quarantined("west"), steps[0] + 10,
+                  what="west quarantined mid-burst")
+    # quarantine happened while the trace was still bursting
+    assert h.engine.step * h.engine.step_ms // window_ms < e
+    assert h.healthy("east") and h.healthy("south"), h.transitions
+    h.steps_until(lambda: h.healthy("west"), 40, what="west readmitted")
+    assert all(" west: " in t for t in h.transitions), h.transitions
+
+
 def test_fleet_endpoint_delay_respects_call_deadline(
         optimizer, chaos_seed):
     """A *slow* (not dead) endpoint: injected per-call latency above the

@@ -198,7 +198,13 @@ def test_broker_death_heals_through_served_stack(tmp_path, oplog):
         stack.close()
 
 
+@pytest.mark.slow
 def test_disk_failure_heals_through_served_stack():
+    """Slow-marked (PR 20, ~30s): disk-failure healing itself stays
+    tier-1 in tests/test_chaos.py::test_logdir_failure_heals, and the
+    served detect→heal→execute-over-HTTP flow stays tier-1 in
+    test_under_replication_heals_through_served_stack on the same
+    make_sim/Stack compile shapes."""
     sim = make_sim()
     stack = Stack(sim)
     try:
@@ -312,11 +318,19 @@ def test_rightsize_endpoint_through_served_stack():
         stack.close()
 
 
+@pytest.mark.slow
 def test_admin_disable_self_healing_gates_the_fix():
     """POST /admin?disable_self_healing_for=broker_failure must stop the
     automatic drain (alerts still fire); re-enabling lets the deferred
     fix proceed (ref AdminParameters self-healing toggles +
-    SelfHealingNotifier per-type switches)."""
+    SelfHealingNotifier per-type switches).
+
+    Slow-marked (PR 20, ~36s): the admin-toggle parse path stays tier-1
+    in tests/test_parameters.py, the /admin endpoint wiring in
+    tests/test_api.py::test_admin_endpoint, and the per-type switch
+    semantics in tests/test_detector.py's SelfHealingNotifier cases —
+    this case's unique surface is only the end-to-end defer/resume
+    walk, which the tier-1 served-stack heal flows keep compiled."""
     sim = make_sim()
     stack = Stack(sim)
 

@@ -100,6 +100,52 @@ def test_fit_degrade_ladder():
     np.testing.assert_allclose(f3.trend, 2.0, atol=1e-9)
 
 
+def test_fit_weekly_rung_and_ladder():
+    # 2-window days, 14-window weeks: the smallest armable weekly rung
+    W, Kw = 16, 14
+    offsets = np.array([0.0, 5.0, 12.0, 4.0, 25.0, -28.0, -38.0])
+    x = np.arange(W, dtype=float)
+    y = np.tile(100.0 + offsets[(x.astype(int) % Kw) * 7 // Kw], (4, 1))
+    f = fit_series("t", y, np.ones(W, bool), WINDOW_MS,
+                   season_windows=2, week_windows=Kw)
+    assert f.degraded == "none" and f.week_windows == Kw
+    # the day-of-week buckets recover the additive offsets (backfit
+    # converges to ~1e-2 — trend/week identifiability at 16 windows)
+    np.testing.assert_allclose(f.week_seasonal[1] - f.week_seasonal[1][0],
+                               offsets - offsets[0], atol=0.05)
+    # history < one week: the weekly rung degrades, the rest still fits
+    f2 = fit_series("t", y[:, :10], np.ones(10, bool), WINDOW_MS,
+                    season_windows=2, week_windows=Kw)
+    assert f2.degraded == "no-weekly" and f2.week_windows == 0
+    # predictions continue the weekly cycle, not the flat mean: window
+    # 22 lands in the Friday bucket (22 % 14 = 8 -> dow 4), window 27
+    # in the Sunday trough (27 % 14 = 13 -> dow 6)
+    hi = f.predict(float(22 - (W - 1)), 0.5)[1]
+    lo = f.predict(float(27 - (W - 1)), 0.5)[1]
+    assert hi - lo > 55.0           # ~ offsets[4] - offsets[6] = 63
+
+
+def test_fit_changepoint_rung_json_round_trip():
+    W, at = 48, 32
+    x = np.arange(W, dtype=float)
+    y = np.tile(100.0 + 150.0 * (x >= at), (4, 1))
+    f = fit_series("t", y, np.ones(W, bool), WINDOW_MS,
+                   season_windows=0, changepoint_min_shift=6.0)
+    assert f.changepoint_window is not None
+    assert abs(f.changepoint_window - at) <= 1
+    np.testing.assert_allclose(f.level, 250.0, atol=1.0)
+    # the new ladder fields survive the store round trip
+    fits = fit_topic_forecasts(
+        {"t": (y, np.ones(W, bool))}, WINDOW_MS, seasonal_period_ms=0,
+        changepoint_min_shift=6.0, min_history_windows=3, fitted_at_ms=0)
+    rt = ForecastSet.from_json(json.loads(json.dumps(fits.to_json())))
+    g = rt.forecasts["t"]
+    assert g.changepoint_window == f.changepoint_window
+    assert g.week_windows == 0
+    np.testing.assert_allclose(g.predict(2.0, 0.5), f.predict(2.0, 0.5),
+                               atol=1e-5)
+
+
 def test_quantiles_and_confidence():
     assert quantile_z(0.5) == pytest.approx(0.0)
     assert quantile_z(0.9) == pytest.approx(1.2816, abs=1e-3)

@@ -1,0 +1,431 @@
+"""Workload-plane tests: seeded trace determinism (byte-identical
+digests), the scenario-8 dedupe contract (the pattern-class generator
+reproduces bench.py's old inline builder bit-for-bit), per-class
+property tests (flash-crowd peak ratio, step location, weekly DOW
+structure, correlated-burst shared latent, skew-drift Zipf exponent
+trajectory), the forecast ladder's weekly + changepoint rungs on
+generated traces, the regime detector's classification + dwell
+hysteresis, the regime tuning loop over the scripted
+steady -> flash crowd -> step migration phases, the regime-qualified
+TunedConfigStore keys, the WorkloadRegime scrape families, and the
+chaos adapters (TraceSampler replay sums, trace-clocked fault steps).
+
+Everything here is pure host numpy — no jit, no device dispatch — so
+the whole module rides tier-1 at interpreter speed.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.tuning import (TunedConfigStore,
+                                                shape_bucket)
+from cruise_control_tpu.core.metricdef import KafkaMetric
+from cruise_control_tpu.core.sensors import MetricRegistry
+from cruise_control_tpu.forecast import fit_series
+from cruise_control_tpu.monitor.sampler import SamplerAssignment
+from cruise_control_tpu.workload import (PATTERN_CLASSES, REGIMES,
+                                         SPEC_REGISTRY,
+                                         CorrelatedBurstSpec,
+                                         DiurnalGrowthSpec,
+                                         FlashCrowdSpec, PatternSpec,
+                                         RegimeDetector,
+                                         RegimeShiftDetector,
+                                         RegimeTuningLoop, SkewDriftSpec,
+                                         StepMigrationSpec, TraceSampler,
+                                         WeeklySpec, backtest_by_class,
+                                         diurnal_growth_series,
+                                         generate_trace,
+                                         schedule_burst_faults)
+from cruise_control_tpu.workload.patterns import DOW_OFFSETS, base_level
+
+from prom_lint import lint_prometheus_exposition
+
+WINDOW_MS = 60_000
+
+
+def _topics(n, prefix="wl"):
+    return [f"{prefix}-{i:03d}" for i in range(n)]
+
+
+# ------------------------------------------------------ determinism
+
+def test_trace_digest_is_seed_deterministic():
+    specs = [SPEC_REGISTRY[c] for c in PATTERN_CLASSES]
+    kw = dict(num_windows=96, window_ms=WINDOW_MS, day_windows=24)
+    a = generate_trace(specs, _topics(14), seed=13, **kw)
+    b = generate_trace(specs, _topics(14), seed=13, **kw)
+    c = generate_trace(specs, _topics(14), seed=14, **kw)
+    assert a.digest() == b.digest()
+    assert a.digest() != c.digest()
+    # the per-topic arrays themselves are byte-equal, not just hashed
+    for t in a.topics:
+        np.testing.assert_array_equal(a.topics[t].values,
+                                      b.topics[t].values)
+
+
+def test_diurnal_growth_matches_frozen_inline_builder():
+    """The scenario-8 dedupe contract: ``diurnal_growth_series`` is
+    byte-identical to the inline trace builder bench.py shipped before
+    the workload package (frozen here verbatim), so the bench's
+    seed-stable numbers cannot move."""
+    W, K, seed = 96, 24, 13
+    topics = _topics(23, prefix="topic")
+    # --- frozen copy of the old bench.py scenario-8 inline builder ---
+    rng = np.random.default_rng(seed)
+    x = np.arange(W, dtype=float)
+    frozen = {}
+    for i, t in enumerate(topics):
+        level = 200.0 + 10.0 * (i % 17)
+        slope = 0.05 * (i % 5) * level / W
+        amp = 0.2 * level
+        y = (level + slope * x + amp * np.sin(2 * np.pi * x / K)
+             + rng.normal(0.0, 0.01 * level, W))
+        vals = np.stack([0.01 * y, y, 0.5 * y,
+                         5.0 * level + slope * x])
+        frozen[t] = (vals, np.ones(W, bool))
+    # --- the deduped path ---
+    series = diurnal_growth_series(topics, W, day_windows=K, seed=seed)
+    assert set(series) == set(frozen)
+    for t in topics:
+        assert series[t][0].tobytes() == frozen[t][0].tobytes()
+        np.testing.assert_array_equal(series[t][1], frozen[t][1])
+
+
+def test_generate_trace_validates_inputs():
+    with pytest.raises(ValueError):
+        generate_trace([], _topics(2), num_windows=8)
+    with pytest.raises(ValueError):
+        generate_trace([PatternSpec()], _topics(2), num_windows=1)
+
+
+# ------------------------------------------------- class properties
+
+def test_flash_crowd_peak_ratio_and_burst_range():
+    spec = FlashCrowdSpec(noise=0.0)
+    tr = generate_trace([spec], ["t"], num_windows=64, seed=1)
+    tt = tr.topics["t"]
+    level = base_level(0)
+    # noise-free: the hold plateau is exactly peak_ratio x level, the
+    # baseline exactly level
+    assert np.isclose(tt.values[1].max(), spec.peak_ratio * level)
+    assert np.isclose(tt.values[1].min(), level)
+    (s, e), = tt.bursts
+    assert s == 32 and e == 32 + 4 + 6 + 12
+    # the excursion lives entirely inside the declared burst range
+    outside = np.r_[tt.values[1][:s], tt.values[1][e:]]
+    np.testing.assert_allclose(outside, level)
+
+
+def test_step_migration_location_and_ratio():
+    spec = StepMigrationSpec(noise=0.0)
+    W = 96
+    tr = generate_trace([spec], ["t"], num_windows=W, seed=1)
+    y = tr.topics["t"].values[1]
+    at = spec.step_window(W)
+    assert at == W * 2 // 3
+    level = base_level(0)
+    np.testing.assert_allclose(y[:at], level)
+    np.testing.assert_allclose(y[at:], spec.step_ratio * level)
+
+
+def test_weekly_day_of_week_offsets():
+    """Per-day window means recover DOW_OFFSETS exactly: the daily
+    sinusoid sums to zero over each full day, leaving
+    ``level * (1 + offset[dow])``."""
+    K = 24
+    W = 2 * 7 * K          # two full weeks
+    tr = generate_trace([WeeklySpec(noise=0.0)], ["t"],
+                        num_windows=W, day_windows=K, seed=1)
+    y = tr.topics["t"].values[1]
+    level = base_level(0)
+    day_means = y.reshape(-1, K).mean(axis=1)       # [14]
+    for d in range(14):
+        assert np.isclose(day_means[d],
+                          level * (1.0 + DOW_OFFSETS[d % 7]))
+
+
+def test_correlated_burst_shares_one_latent_window():
+    spec = CorrelatedBurstSpec(noise=0.0)
+    W = 64
+    tr = generate_trace([spec], ["a", "b", "c"], num_windows=W, seed=5)
+    bursts = {tuple(tr.topics[t].bursts[0]) for t in tr.topics}
+    assert len(bursts) == 1                  # every topic, same window
+    (s, e), = bursts
+    assert W // 4 <= s < max(W // 2, W // 4 + 1)
+    # each topic peaks inside the shared range, with its own amplitude
+    peaks = {t: int(np.argmax(tr.topics[t].values[1]))
+             for t in tr.topics}
+    assert all(s <= p < e for p in peaks.values())
+    amps = {t: tr.topics[t].values[1].max() / base_level(i)
+            for i, t in enumerate(sorted(tr.topics))}
+    assert len(set(np.round(list(amps.values()), 6))) > 1
+
+
+def test_skew_drift_zipf_exponent_trajectory():
+    """The share matrix is analytic Zipf, so a log-log fit recovers the
+    drifting exponent exactly: ``zipf_a0`` at w=0, ``zipf_a1`` at the
+    last window."""
+    spec = SkewDriftSpec()
+    P, W = 16, 48
+    tr = generate_trace([spec], ["t"], num_windows=W, seed=1,
+                        partitions=P)
+    shares = tr.topics["t"].shares
+    assert shares.shape == (W, P)
+    np.testing.assert_allclose(shares.sum(axis=1), 1.0)
+    ranks = np.log(np.arange(1, P + 1, dtype=float))
+    for w, expect in ((0, spec.zipf_a0), (W - 1, spec.zipf_a1)):
+        slope = np.polyfit(ranks, np.log(shares[w]), 1)[0]
+        assert np.isclose(-slope, expect, atol=1e-9)
+    # drift is monotone toward the hotter exponent
+    top = shares[:, 0]
+    assert np.all(np.diff(top) > 0)
+
+
+def test_trace_classes_and_merged_bursts():
+    specs = [SPEC_REGISTRY[c] for c in PATTERN_CLASSES]
+    tr = generate_trace(specs, _topics(14), num_windows=96, seed=13)
+    classes = tr.classes()
+    assert set(classes) == set(PATTERN_CLASSES)
+    assert all(len(v) == 2 for v in classes.values())
+    merged = tr.burst_windows()
+    assert merged == sorted(merged)
+    assert all(s < e for s, e in merged)
+    # merged means no overlaps remain
+    assert all(merged[i][1] < merged[i + 1][0]
+               for i in range(len(merged) - 1))
+    assert tr.aggregate().shape == (96,)
+
+
+# ------------------------------------------ forecast ladder on traces
+
+def test_weekly_rung_beats_no_weekly_on_weekly_trace():
+    K = 24
+    Kw = 7 * K
+    W = Kw + K              # one week + one day of history
+    tr = generate_trace([WeeklySpec()], ["t"], num_windows=W,
+                        day_windows=K, seed=3)
+    vals = tr.topics["t"].values
+    valid = np.ones(W, bool)
+    with_week = fit_series("t", vals, valid, WINDOW_MS,
+                           season_windows=K, week_windows=Kw)
+    without = fit_series("t", vals, valid, WINDOW_MS,
+                         season_windows=K, week_windows=0)
+    assert with_week.degraded == "none"
+    assert with_week.week_windows == Kw
+    assert with_week.backtest_mape < without.backtest_mape
+    assert with_week.backtest_mape < 0.05
+
+
+def test_changepoint_rung_locates_step_and_fits_suffix():
+    spec = StepMigrationSpec()
+    W = 96
+    tr = generate_trace([spec], ["t"], num_windows=W, seed=3)
+    vals = tr.topics["t"].values
+    valid = np.ones(W, bool)
+    f = fit_series("t", vals, valid, WINDOW_MS, season_windows=0,
+                   changepoint_min_shift=6.0)
+    at = spec.step_window(W)
+    assert f.changepoint_window is not None
+    assert abs(f.changepoint_window - at) <= 2
+    # the fit converges to the post-step plateau, not the smeared mean
+    level = base_level(0)
+    assert abs(f.level[1] - spec.step_ratio * level) < 0.1 * level
+    off = fit_series("t", vals, valid, WINDOW_MS, season_windows=0)
+    assert off.changepoint_window is None
+
+
+def test_backtest_by_class_gates_every_pattern():
+    specs = [SPEC_REGISTRY[c] for c in PATTERN_CLASSES]
+    tr = generate_trace(specs, _topics(14), num_windows=192,
+                        window_ms=WINDOW_MS, day_windows=24, seed=13)
+    mapes = backtest_by_class(
+        tr, seasonal_period_ms=24 * WINDOW_MS,
+        week_period_ms=7 * 24 * WINDOW_MS, changepoint_min_shift=6.0)
+    assert set(mapes) == set(PATTERN_CLASSES)
+    worst = max(mapes, key=mapes.get)
+    assert mapes[worst] <= 0.15, f"{worst}: {mapes[worst]:.3f}"
+
+
+# ------------------------------------------------------ regime plane
+
+def _scripted(kind):
+    base = np.full(24, 100.0)
+    if kind == "steady":
+        return np.r_[base, np.full(8, 105.0)]
+    if kind == "flash_crowd":
+        return np.r_[base, [800, 700, 500, 300, 200, 150, 120, 105.0]]
+    return np.r_[base, np.full(8, 250.0)]        # step_migration
+
+
+@pytest.mark.parametrize("kind", REGIMES)
+def test_regime_detector_classifies_scripted_series(kind):
+    assert RegimeDetector().classify(_scripted(kind)) == kind
+
+
+def test_regime_detector_edge_inputs():
+    det = RegimeDetector()
+    assert det.classify([1.0, 2.0]) == "steady"          # too short
+    assert det.classify(np.zeros(32)) == "steady"        # zero baseline
+
+
+def test_regime_detector_dwell_hysteresis():
+    det = RegimeDetector(min_dwell=2)
+    regime, shifted = det.observe(_scripted("flash_crowd"), 1)
+    assert (regime, shifted) == ("steady", False)        # dwell 1 of 2
+    regime, shifted = det.observe(_scripted("flash_crowd"), 2)
+    assert (regime, shifted) == ("flash_crowd", True)
+    assert det.shifts == [{"fromRegime": "steady",
+                           "toRegime": "flash_crowd", "atMs": 2}]
+    # a one-observation blip back to steady does NOT flip the regime
+    regime, shifted = det.observe(_scripted("steady"), 3)
+    assert (regime, shifted) == ("flash_crowd", False)
+    regime, shifted = det.observe(_scripted("flash_crowd"), 4)
+    assert (regime, shifted) == ("flash_crowd", False)
+    assert det._pending_count == 0                       # blip reset
+
+
+def test_tuned_store_regime_qualified_keys(tmp_path):
+    store = TunedConfigStore(str(tmp_path / "tuned.json"))
+    store.record(96, 10, {"polish_passes": 2}, regime="flash_crowd",
+                 save=False)
+    # exact regime hit
+    assert store.lookup(96, 10, regime="flash_crowd",
+                        fallback=False) == {"polish_passes": 2}
+    # untuned pair: no fallback -> None; fallback -> un-regimed bucket
+    assert store.lookup(96, 10, regime="steady", fallback=False) is None
+    store.record(96, 10, {"polish_passes": 1}, save=False)
+    assert store.lookup(96, 10, regime="steady") == {"polish_passes": 1}
+    # a pinned incumbent ({} overrides) is a HIT, distinct from untuned
+    store.record(96, 10, {}, regime="steady", save=False)
+    assert store.lookup(96, 10, regime="steady", fallback=False) == {}
+    assert shape_bucket(96, 10, regime="steady").endswith("@steady")
+
+
+class _StubOptimizer:
+    active_regime = None
+
+
+class _StubMetadata:
+    num_partitions = 96
+    num_brokers = 10
+
+
+def test_regime_tuning_loop_scripted_phases(tmp_path):
+    """The scenario-14 control flow at unit scale: three scripted
+    phases, one retune per first-seen regime, active_regime flipped
+    every observation, zero retunes on revisit."""
+    store = TunedConfigStore(str(tmp_path / "tuned.json"))
+    opt = _StubOptimizer()
+    loop = RegimeTuningLoop(opt, store,
+                            RegimeDetector(min_dwell=1), trials=0)
+    md = _StubMetadata()
+    for i, kind in enumerate(REGIMES):
+        event = loop.on_series(_scripted(kind), None, md, now_ms=i)
+        assert opt.active_regime == kind
+        assert event is not None and event["regime"] == kind
+        assert event["fields"] == {}                 # incumbent pinned
+    assert loop.retunes == 3
+    assert len(loop.detector.shifts) == 2            # steady is initial
+    # revisiting an already-tuned regime is a no-op
+    assert loop.on_series(_scripted("steady"), None, md, 99) is not None
+    assert loop.on_series(_scripted("steady"), None, md, 100) is None
+    assert loop.retunes == 3
+    for regime in REGIMES:
+        assert store.lookup(96, 10, regime=regime, fallback=False) == {}
+
+
+def test_regime_shift_detector_scrape_families():
+    """The WorkloadRegime meters/gauge land on the scrape surface with
+    lintable families (tests/prom_lint.py contract)."""
+    reg = MetricRegistry()
+    loop = RegimeTuningLoop(_StubOptimizer(), None)
+    RegimeShiftDetector(None, loop, registry=reg)
+    lint_prometheus_exposition(
+        reg.expose_text(),
+        expect_families=("cc_WorkloadRegime_shift_rate_total",
+                         "cc_WorkloadRegime_retune_rate_total",
+                         "cc_WorkloadRegime_active_regime_code"),
+        forbid_unlabeled_duplicates=True)
+    gauge = reg.get(MetricRegistry.name("WorkloadRegime",
+                                        "active-regime-code"))
+    assert gauge.value() == REGIMES.index("steady")
+    loop.detector.regime = "step_migration"
+    assert gauge.value() == REGIMES.index("step_migration")
+
+
+# ----------------------------------------------------- chaos adapters
+
+def test_trace_sampler_replays_topic_loads():
+    from cruise_control_tpu.chaos.harness import build_sim
+    sim = build_sim()                       # topics t0/t1/t2, 16 parts
+    W = 16
+    tr = generate_trace([PatternSpec(noise=0.0)], ["t0", "t1", "t2"],
+                        num_windows=W, seed=1)
+    sampler = TraceSampler(sim, tr, window_ms=1000)
+    infos = sim.describe_partitions()
+    assignment = SamplerAssignment(partitions=sorted(infos),
+                                   brokers=sorted(sim.describe_cluster()),
+                                   start_ms=0, end_ms=3000)
+    samples = sampler.get_samples(assignment)
+    w = sampler.window_at(3000)
+    assert w == 3
+    by_topic: dict[str, float] = {}
+    for s in samples.partition_samples:
+        by_topic[s.topic] = (by_topic.get(s.topic, 0.0)
+                             + s.values[int(KafkaMetric.LEADER_BYTES_IN)])
+    for i, t in enumerate(["t0", "t1", "t2"]):
+        # uniform spread: partition loads sum back to the topic trace
+        assert np.isclose(by_topic[t], tr.topics[t].values[1, w])
+    # broker bytes-in covers leaders AND followers: each partition's
+    # load lands once per replica (rf=2 in build_sim)
+    from cruise_control_tpu.core.metricdef import BrokerMetric
+    total = sum(s.values[int(BrokerMetric.LEADER_BYTES_IN)]
+                for s in samples.broker_samples)
+    assert np.isclose(total, 2 * sum(by_topic.values()))
+
+
+def test_trace_sampler_skewed_shares_renormalize():
+    from cruise_control_tpu.chaos.harness import build_sim
+    sim = build_sim()
+    W = 8
+    tr = generate_trace([SkewDriftSpec(noise=0.0)], ["t0"],
+                        num_windows=W, seed=1, partitions=4)
+    sampler = TraceSampler(sim, tr, window_ms=1000, loop=False)
+    infos = sim.describe_partitions()
+    t0_parts = sorted(tp for tp in infos if tp[0] == "t0")
+    assignment = SamplerAssignment(partitions=t0_parts, brokers=[],
+                                   start_ms=0, end_ms=0)
+    samples = sampler.get_samples(assignment)
+    # the sim has 6 t0 partitions but the trace only 4 shares: the
+    # modulo-mapped shares renormalize so the topic total is preserved
+    total = sum(s.values[int(KafkaMetric.LEADER_BYTES_IN)]
+                for s in samples.partition_samples)
+    assert np.isclose(total, tr.topics["t0"].values[1, 0])
+    # loop=False clamps past the trace end instead of wrapping
+    assert sampler.window_at(10 ** 9) == W - 1
+
+
+def test_schedule_burst_faults_maps_windows_to_steps():
+    class FakeEngine:
+        step_ms = 1000
+
+        def __init__(self):
+            self.scheduled = []
+
+        def schedule(self, step, action, **kw):
+            self.scheduled.append((step, action, kw))
+
+    spec = FlashCrowdSpec()
+    W = 64
+    tr = generate_trace([spec], ["t"], num_windows=W, seed=1)
+    eng = FakeEngine()
+    steps = schedule_burst_faults(eng, tr, window_ms=2000, broker=2)
+    (s, e), = tr.burst_windows()
+    w = s + int((e - s) * 0.25)
+    assert steps == [w * 2000 // 1000]
+    assert eng.scheduled == [
+        (w * 2, "kill_broker", {"broker": 2}),
+        ((w + 4) * 2, "restart_broker", {"broker": 2})]
+    # every fault step lands strictly inside the burst range
+    for step in steps:
+        assert s <= step * 1000 // 2000 < e
